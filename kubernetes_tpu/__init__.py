@@ -1,0 +1,29 @@
+"""kubernetes_tpu — a TPU-native scheduling framework.
+
+A brand-new implementation of the capabilities of Kubernetes' kube-scheduler
+(reference: kubernetes/kubernetes @ ~v1.16), re-designed TPU-first:
+
+- Cluster state is a **columnar snapshot**: dense arrays over nodes and pods
+  (the tensor form of the reference's ``NodeInfo``,
+  ``pkg/scheduler/nodeinfo/node_info.go:50``).
+- Filter predicates are vectorized boolean (pods x nodes) masks; Score
+  priorities are vectorized f32 (pods x nodes) matrices. Set-membership
+  checks (labels, taints, ports, images) are encoded as multihot matrices so
+  they evaluate as matmuls on the MXU.
+- Assignment binds the whole pending queue at once: a capacity-aware batched
+  solver replaces the reference's one-pod-at-a-time loop
+  (``pkg/scheduler/scheduler.go:462`` scheduleOne).
+- Scale-out is jax.sharding over a device Mesh: the node axis is sharded,
+  score reductions ride ICI collectives — replacing the reference's
+  16-goroutine fan-out (``pkg/scheduler/core/generic_scheduler.go:531``) and
+  percentageOfNodesToScore subsampling.
+
+Host-side control-plane semantics (scheduling queue with backoff,
+assume-then-commit cache, event-driven requeue, preemption with PDBs,
+framework extension points) mirror the reference so behavior is checkable
+plugin-by-plugin.
+"""
+
+__version__ = "0.1.0"
+
+from kubernetes_tpu.api import types as api_types  # noqa: F401

@@ -1,0 +1,1 @@
+from kubernetes_tpu.api import types  # noqa: F401

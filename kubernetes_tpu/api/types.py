@@ -1,0 +1,271 @@
+"""Lightweight API object model — the slice of the Kubernetes v1 API the
+scheduler consumes.
+
+Mirrors (in spirit, not in code) the generated Go types under
+``staging/src/k8s.io/api/core/v1`` that the reference scheduler reads:
+Pod spec fields consumed by predicates/priorities
+(``pkg/scheduler/algorithm/predicates/predicates.go``) and Node status/spec
+fields aggregated into ``NodeInfo`` (``pkg/scheduler/nodeinfo/node_info.go``).
+
+These are plain Python dataclasses used at the host boundary only; the hot
+path operates on the columnar tensors built from them (see
+``kubernetes_tpu.snapshot``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Resources
+# ---------------------------------------------------------------------------
+
+#: Default requests used for scoring when a container declares none —
+#: reference: priorities/util/non_zero.go:31-33.
+DEFAULT_MILLI_CPU_REQUEST = 100
+DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024
+
+#: MaxPriority for 0-10 score scaling — reference: pkg/scheduler/api/types.go:35.
+MAX_PRIORITY = 10
+
+
+@dataclass
+class Resources:
+    """Aggregate resource quantities (the reference's ``nodeinfo.Resource``,
+    node_info.go:146): milli-CPU, memory bytes, ephemeral-storage bytes,
+    allowed pod count, plus named scalar/extended resources."""
+
+    cpu_milli: float = 0
+    memory: float = 0
+    ephemeral_storage: float = 0
+    pods: float = 0
+    scalars: Dict[str, float] = field(default_factory=dict)
+
+    def add(self, other: "Resources") -> "Resources":
+        out = Resources(
+            self.cpu_milli + other.cpu_milli,
+            self.memory + other.memory,
+            self.ephemeral_storage + other.ephemeral_storage,
+            self.pods + other.pods,
+            dict(self.scalars),
+        )
+        for k, v in other.scalars.items():
+            out.scalars[k] = out.scalars.get(k, 0) + v
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Selectors / affinity
+# ---------------------------------------------------------------------------
+
+#: Node-selector operators — apimachinery selection ops used by
+#: NodeSelectorRequirement (staging/src/k8s.io/api/core/v1/types.go).
+OP_IN = "In"
+OP_NOT_IN = "NotIn"
+OP_EXISTS = "Exists"
+OP_DOES_NOT_EXIST = "DoesNotExist"
+OP_GT = "Gt"
+OP_LT = "Lt"
+
+
+@dataclass
+class Requirement:
+    """One match expression: ``key <op> values``."""
+
+    key: str
+    operator: str
+    values: Tuple[str, ...] = ()
+
+
+@dataclass
+class NodeSelectorTerm:
+    """AND of requirements. Terms are ORed together within a selector."""
+
+    match_expressions: Tuple[Requirement, ...] = ()
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int = 1
+    preference: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
+
+
+@dataclass
+class LabelSelector:
+    """Label selector over *pods* (used by pod (anti)affinity, topology
+    spread, selector-spread owners, PDBs). ``match_labels`` is AND of
+    equality pairs; ``match_expressions`` AND of set requirements."""
+
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    match_expressions: Tuple[Requirement, ...] = ()
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        for k, v in self.match_labels.items():
+            if labels.get(k) != v:
+                return False
+        for r in self.match_expressions:
+            if r.operator == OP_IN:
+                if labels.get(r.key) not in r.values:
+                    return False
+            elif r.operator == OP_NOT_IN:
+                if r.key in labels and labels[r.key] in r.values:
+                    return False
+            elif r.operator == OP_EXISTS:
+                if r.key not in labels:
+                    return False
+            elif r.operator == OP_DOES_NOT_EXIST:
+                if r.key in labels:
+                    return False
+            else:
+                raise ValueError(f"bad pod label selector op {r.operator}")
+        return True
+
+
+@dataclass
+class PodAffinityTerm:
+    """Reference: v1.PodAffinityTerm — pods matching ``label_selector`` in
+    ``namespaces`` co-located by ``topology_key``."""
+
+    label_selector: LabelSelector = field(default_factory=LabelSelector)
+    topology_key: str = ""
+    namespaces: Tuple[str, ...] = ()  # empty => pod's own namespace
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int = 1
+    term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+
+@dataclass
+class Affinity:
+    node_required: Tuple[NodeSelectorTerm, ...] = ()  # ORed terms
+    node_preferred: Tuple[PreferredSchedulingTerm, ...] = ()
+    pod_affinity_required: Tuple[PodAffinityTerm, ...] = ()
+    pod_affinity_preferred: Tuple[WeightedPodAffinityTerm, ...] = ()
+    pod_anti_affinity_required: Tuple[PodAffinityTerm, ...] = ()
+    pod_anti_affinity_preferred: Tuple[WeightedPodAffinityTerm, ...] = ()
+
+
+@dataclass
+class TopologySpreadConstraint:
+    """Reference: v1.TopologySpreadConstraint (EvenPodsSpread feature,
+    predicates.go:1720 / priorities/even_pods_spread.go:86)."""
+
+    max_skew: int = 1
+    topology_key: str = ""
+    when_unsatisfiable: str = "DoNotSchedule"  # or "ScheduleAnyway"
+    label_selector: LabelSelector = field(default_factory=LabelSelector)
+
+
+# ---------------------------------------------------------------------------
+# Taints / tolerations
+# ---------------------------------------------------------------------------
+
+EFFECT_NO_SCHEDULE = "NoSchedule"
+EFFECT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
+EFFECT_NO_EXECUTE = "NoExecute"
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = EFFECT_NO_SCHEDULE
+
+
+@dataclass(frozen=True)
+class Toleration:
+    """Reference: v1.Toleration. ``operator`` is Exists or Equal; empty key
+    with Exists tolerates everything; empty effect matches all effects."""
+
+    key: str = ""
+    operator: str = "Equal"
+    value: str = ""
+    effect: str = ""
+
+    def tolerates(self, taint: Taint) -> bool:
+        # Reference: pkg/apis/core/v1/helper/helpers.go ToleratesTaint.
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.value == taint.value
+
+
+# ---------------------------------------------------------------------------
+# Pod / Node
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Pod:
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    node_name: str = ""  # spec.nodeName: set once bound (or pre-pinned)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Affinity = field(default_factory=Affinity)
+    tolerations: Tuple[Toleration, ...] = ()
+    priority: int = 0
+    requests: Resources = field(default_factory=Resources)
+    host_ports: Tuple[Tuple[str, str, int], ...] = ()  # (protocol, hostIP, port)
+    topology_spread: Tuple[TopologySpreadConstraint, ...] = ()
+    images: Tuple[str, ...] = ()  # container image names (ImageLocality)
+    #: selectors of owning Services/RCs/RSs/StatefulSets, provided by the
+    #: driver's listers — feeds SelectorSpreadPriority
+    #: (selector_spreading.go:99).
+    spread_selectors: Tuple[LabelSelector, ...] = ()
+    #: gang/coscheduling group (PodGroup); empty = no gang.
+    pod_group: str = ""
+    #: monotonically increasing arrival stamp used for queue ordering
+    #: (the reference orders activeQ by priority then timestamp).
+    queued_at: float = 0.0
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def effective_requests(self) -> Resources:
+        r = dataclasses.replace(self.requests, scalars=dict(self.requests.scalars))
+        r.pods = 1
+        return r
+
+    def nonzero_requests(self) -> Tuple[float, float]:
+        """(cpu_milli, memory) with scoring defaults — non_zero.go:42,:48."""
+        cpu = self.requests.cpu_milli or DEFAULT_MILLI_CPU_REQUEST
+        mem = self.requests.memory or DEFAULT_MEMORY_REQUEST
+        return cpu, mem
+
+    def tolerates(self, taint: Taint) -> bool:
+        return any(t.tolerates(taint) for t in self.tolerations)
+
+
+@dataclass
+class NodeCondition:
+    ready: bool = True
+    memory_pressure: bool = False
+    disk_pressure: bool = False
+    pid_pressure: bool = False
+    network_unavailable: bool = False
+
+
+@dataclass
+class Node:
+    name: str
+    labels: Dict[str, str] = field(default_factory=dict)
+    allocatable: Resources = field(default_factory=lambda: Resources(pods=110))
+    taints: Tuple[Taint, ...] = ()
+    unschedulable: bool = False
+    conditions: NodeCondition = field(default_factory=NodeCondition)
+    images: Dict[str, int] = field(default_factory=dict)  # name -> size bytes
+
+    def zone(self) -> Optional[str]:
+        # Reference zone labels: failure-domain.beta.kubernetes.io/zone.
+        return self.labels.get("failure-domain.beta.kubernetes.io/zone") or self.labels.get(
+            "topology.kubernetes.io/zone"
+        )

@@ -1,0 +1,665 @@
+"""Columnar cluster snapshot — the tensor form of the reference's scheduler
+cache snapshot.
+
+The reference keeps per-node ``NodeInfo`` structs (requested/allocatable
+resources, pods, used ports, taints, image states —
+``pkg/scheduler/nodeinfo/node_info.go:50,:146``) and re-snapshots them
+incrementally each cycle (``internal/cache/cache.go:211``
+UpdateNodeInfoSnapshot). Here the snapshot is *columnar*: one dense array per
+attribute across all nodes, plus multihot membership matrices for every
+string-set attribute (label pairs, taint ids, port ids, image ids), so that
+per-(pod,node) set intersections evaluate as integer matmuls on the MXU.
+
+Ragged selector logic (nodeSelector maps, NodeAffinity requirement trees) is
+compiled host-side into flat **expression tables** over a *selector-program*
+universe: each distinct selector structure is interned once, its expressions
+are rows of fixed-shape arrays, and the device evaluates all programs against
+all nodes with segment reductions (AND within term, OR across terms). Pods
+then just gather their program's row — deduplicating the (very common) case
+of thousands of pods sharing one pod-template's selector.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api.types import (
+    EFFECT_NO_EXECUTE,
+    EFFECT_NO_SCHEDULE,
+    EFFECT_PREFER_NO_SCHEDULE,
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    Affinity,
+    Node,
+    NodeSelectorTerm,
+    Pod,
+    Requirement,
+    Resources,
+    Toleration,
+)
+from kubernetes_tpu.utils.interner import Interner, bucket_size
+
+# Fixed resource columns; scalar/extended resources append after these.
+# Mirrors nodeinfo.Resource (node_info.go:146).
+RES_CPU, RES_MEM, RES_EPH, RES_PODS = 0, 1, 2, 3
+N_FIXED_RESOURCES = 4
+
+# Expression opcodes for the device-side selector interpreter.
+XOP_IN, XOP_NOT_IN, XOP_EXISTS, XOP_NOT_EXISTS, XOP_GT, XOP_LT = range(6)
+
+_OPCODE = {
+    OP_IN: XOP_IN,
+    OP_NOT_IN: XOP_NOT_IN,
+    OP_EXISTS: XOP_EXISTS,
+    OP_DOES_NOT_EXIST: XOP_NOT_EXISTS,
+    OP_GT: XOP_GT,
+    OP_LT: XOP_LT,
+}
+
+
+@dataclass(frozen=True)
+class CompiledExpr:
+    op: int
+    pair_ids: Tuple[int, ...] = ()  # In/NotIn: interned (key,value) ids
+    key_id: int = -1  # Exists/DoesNotExist/Gt/Lt: interned key id
+    literal: float = 0.0  # Gt/Lt
+
+
+class Universe:
+    """All interning state shared across snapshots. Grows monotonically;
+    device-side arrays are padded to power-of-two buckets so growth rarely
+    changes compiled shapes."""
+
+    def __init__(self) -> None:
+        self.node_names = Interner()
+        self.scalar_resources = Interner()
+        self.label_pairs = Interner()  # (key, value) referenced by selectors
+        self.label_keys = Interner()  # keys referenced by Exists/DNE/Gt/Lt
+        self.taints = Interner()  # (key, value, effect)
+        self.ports_pp = Interner()  # (protocol, port)
+        self.ports_pip = Interner()  # (protocol, hostIP, port), ip != wildcard
+        self.images = Interner()  # image name
+        self.image_sizes: List[float] = []
+        # selector programs: canonical repr -> id; terms[i] = list of terms,
+        # each term a list of CompiledExpr (AND within term, OR across terms)
+        self.sel_programs = Interner()
+        self.sel_program_terms: List[List[List[CompiledExpr]]] = []
+        # preferred programs: list of (weight, [CompiledExpr]) terms (summed)
+        self.pref_programs = Interner()
+        self.pref_program_terms: List[List[Tuple[float, List[CompiledExpr]]]] = []
+        # toleration sets
+        self.tol_sets = Interner()
+        self.tol_set_items: List[Tuple[Toleration, ...]] = []
+        # owner-selector sets (SelectorSpread) — (namespace, canonical sels)
+        self.owner_sets = Interner()
+        self.owner_set_items: List[Tuple[str, tuple]] = []
+
+    # -- resources ---------------------------------------------------------
+
+    def n_resources(self) -> int:
+        return N_FIXED_RESOURCES + len(self.scalar_resources)
+
+    def resource_vector(self, r: Resources, out_len: Optional[int] = None) -> np.ndarray:
+        for name in r.scalars:
+            self.scalar_resources.intern(name)
+        n = out_len or self.n_resources()
+        v = np.zeros((n,), np.float32)
+        v[RES_CPU] = r.cpu_milli
+        v[RES_MEM] = r.memory
+        v[RES_EPH] = r.ephemeral_storage
+        v[RES_PODS] = r.pods
+        for name, q in r.scalars.items():
+            v[N_FIXED_RESOURCES + self.scalar_resources.intern(name)] = q
+        return v
+
+    # -- selector compilation ---------------------------------------------
+
+    def _compile_requirement(self, r: Requirement) -> CompiledExpr:
+        op = _OPCODE[r.operator]
+        if op in (XOP_IN, XOP_NOT_IN):
+            pair_ids = tuple(self.label_pairs.intern((r.key, v)) for v in r.values)
+            return CompiledExpr(op=op, pair_ids=pair_ids)
+        key_id = self.label_keys.intern(r.key)
+        lit = 0.0
+        if op in (XOP_GT, XOP_LT):
+            lit = float(r.values[0]) if r.values else 0.0
+        return CompiledExpr(op=op, key_id=key_id, literal=lit)
+
+    def _compile_term(self, term: NodeSelectorTerm) -> List[CompiledExpr]:
+        return [self._compile_requirement(r) for r in term.match_expressions]
+
+    def intern_node_selector_program(
+        self, node_selector: Dict[str, str], affinity: Affinity
+    ) -> int:
+        """Compile a pod's required node-selection (spec.nodeSelector AND
+        RequiredDuringScheduling node affinity) into one program id.
+
+        Semantics follow predicates.PodMatchNodeSelector
+        (predicates.go:904 -> podMatchesNodeSelectorAndAffinityTerms):
+        nodeSelector map is AND of equality pairs; affinity required terms
+        are ORed, each term AND of expressions; both must pass.
+        """
+        terms: List[List[CompiledExpr]] = []
+        base: List[CompiledExpr] = [
+            self._compile_requirement(Requirement(k, OP_IN, (v,)))
+            for k, v in sorted(node_selector.items())
+        ]
+        if affinity.node_required:
+            for t in affinity.node_required:
+                terms.append(base + self._compile_term(t))
+        elif base:
+            terms.append(base)
+        if not terms:
+            return -1
+        key = tuple(
+            tuple((e.op, e.pair_ids, e.key_id, e.literal) for e in t) for t in terms
+        )
+        pid = self.sel_programs.intern(key)
+        if pid == len(self.sel_program_terms):
+            self.sel_program_terms.append(terms)
+        return pid
+
+    def intern_preferred_program(self, affinity: Affinity) -> int:
+        """PreferredDuringScheduling node affinity -> weighted term list
+        (priorities/node_affinity.go: score = sum of weights of matched
+        terms, then NormalizeReduce to 0-10)."""
+        if not affinity.node_preferred:
+            return -1
+        terms = [
+            (float(p.weight), self._compile_term(p.preference))
+            for p in affinity.node_preferred
+            if p.weight > 0 and p.preference.match_expressions
+        ]
+        if not terms:
+            return -1
+        key = tuple(
+            (w, tuple((e.op, e.pair_ids, e.key_id, e.literal) for e in t))
+            for w, t in terms
+        )
+        pid = self.pref_programs.intern(key)
+        if pid == len(self.pref_program_terms):
+            self.pref_program_terms.append(terms)
+        return pid
+
+    # -- tolerations -------------------------------------------------------
+
+    def intern_toleration_set(self, tolerations: Tuple[Toleration, ...]) -> int:
+        if not tolerations:
+            return -1
+        key = tuple(
+            (t.key, t.operator, t.value, t.effect) for t in tolerations
+        )
+        tid = self.tol_sets.intern(key)
+        if tid == len(self.tol_set_items):
+            self.tol_set_items.append(tuple(tolerations))
+        return tid
+
+    def intern_taint(self, key: str, value: str, effect: str) -> int:
+        return self.taints.intern((key, value, effect))
+
+    def intern_image(self, name: str, size: float) -> int:
+        iid = self.images.intern(name)
+        if iid == len(self.image_sizes):
+            self.image_sizes.append(float(size))
+        else:
+            # keep the max observed size (sizes should agree per name)
+            self.image_sizes[iid] = max(self.image_sizes[iid], float(size))
+        return iid
+
+    # -- owner selectors (SelectorSpread) ----------------------------------
+
+    def intern_owner_set(self, namespace: str, selectors) -> int:
+        if not selectors:
+            return -1
+        key = (
+            namespace,
+            tuple(
+                (
+                    tuple(sorted(s.match_labels.items())),
+                    tuple((r.key, r.operator, tuple(r.values)) for r in s.match_expressions),
+                )
+                for s in selectors
+            ),
+        )
+        oid = self.owner_sets.intern(key)
+        if oid == len(self.owner_set_items):
+            self.owner_set_items.append((namespace, tuple(selectors)))
+        return oid
+
+
+# ---------------------------------------------------------------------------
+# Packed tables (host-side numpy; converted to device arrays at the jit
+# boundary — see kubernetes_tpu.ops)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NodeTable:
+    """Columnar NodeInfo over all nodes. Row order is the packing order;
+    ``name_id[i]`` maps back to the node name."""
+
+    n: int
+    name_id: np.ndarray  # (N,) i32
+    allocatable: np.ndarray  # (N, R) f32
+    requested: np.ndarray  # (N, R) f32 — sum of scheduled pods' requests
+    nonzero_req: np.ndarray  # (N, 2) f32 — scoring request sums w/ defaults
+    pair_mh: np.ndarray  # (N, Up) i8 — has (key,value) for interned pairs
+    key_mh: np.ndarray  # (N, Uk) i8 — has key
+    key_val: np.ndarray  # (N, Uk) f32 — numeric label value (NaN if not)
+    taint_hard_mh: np.ndarray  # (N, Ut) i8 — NoSchedule|NoExecute taints
+    taint_soft_mh: np.ndarray  # (N, Ut) i8 — PreferNoSchedule taints
+    port_any_mh: np.ndarray  # (N, Upp) i8 — (proto,port) used by any pod
+    port_wild_mh: np.ndarray  # (N, Upp) i8 — used with wildcard hostIP
+    port_spec_mh: np.ndarray  # (N, Upip) i8 — used with specific hostIP
+    image_mh: np.ndarray  # (N, Ui) i8
+    owner_counts: np.ndarray  # (N, Uo) f32 — matching scheduled pods per owner set
+    ready: np.ndarray  # (N,) bool
+    schedulable: np.ndarray  # (N,) bool — NOT spec.unschedulable
+    mem_pressure: np.ndarray  # (N,) bool
+    disk_pressure: np.ndarray  # (N,) bool
+    pid_pressure: np.ndarray  # (N,) bool
+
+
+@dataclass
+class PodTable:
+    """Columnar pending-pod batch."""
+
+    n: int
+    req: np.ndarray  # (P, R) f32
+    nonzero_req: np.ndarray  # (P, 2) f32
+    selprog_id: np.ndarray  # (P,) i32, -1 = unconstrained
+    prefprog_id: np.ndarray  # (P,) i32, -1 = none
+    tolset_id: np.ndarray  # (P,) i32, -1 = no tolerations
+    name_req: np.ndarray  # (P,) i32, -1 = no spec.nodeName requirement
+    priority: np.ndarray  # (P,) i32
+    port_wild_pp: np.ndarray  # (P, Upp) i8 — wildcard-IP ports
+    port_spec_pp: np.ndarray  # (P, Upp) i8 — specific-IP ports, (proto,port) view
+    port_spec_pip: np.ndarray  # (P, Upip) i8
+    image_mh: np.ndarray  # (P, Ui) i8
+    owner_id: np.ndarray  # (P,) i32, -1 = no owning service/controller
+    order: np.ndarray  # (P,) i32 — original index of each row (sort tracking)
+
+
+@dataclass
+class SelectorTables:
+    """Flattened expression tables for required + preferred programs, plus
+    per-toleration-set tolerated-taint multihots."""
+
+    # required programs
+    n_exprs: int
+    n_terms: int
+    n_progs: int
+    expr_term: np.ndarray  # (E,) i32 — term id of each expr
+    expr_op: np.ndarray  # (E,) i32
+    expr_pairs_mh: np.ndarray  # (E, Up) i8
+    expr_key: np.ndarray  # (E,) i32 (index into key universe; -1 unused)
+    expr_lit: np.ndarray  # (E,) f32
+    term_prog: np.ndarray  # (T,) i32 — program id of each term
+    # preferred programs (flat weighted terms)
+    p_n_exprs: int
+    p_n_terms: int
+    p_n_progs: int
+    p_expr_term: np.ndarray
+    p_expr_op: np.ndarray
+    p_expr_pairs_mh: np.ndarray
+    p_expr_key: np.ndarray
+    p_expr_lit: np.ndarray
+    p_term_prog: np.ndarray
+    p_term_weight: np.ndarray  # (Tp,) f32
+    # tolerations
+    tol_hard_mh: np.ndarray  # (Stol, Ut) i8 — taint ids tolerated (hard effects)
+    tol_soft_mh: np.ndarray  # (Stol, Ut) i8 — PreferNoSchedule taint ids tolerated
+    image_sizes: np.ndarray  # (Ui,) f32
+
+
+def _pad_cols(a: np.ndarray, width: int) -> np.ndarray:
+    if a.shape[1] == width:
+        return a
+    out = np.zeros((a.shape[0], width), a.dtype)
+    out[:, : a.shape[1]] = a
+    return out
+
+
+class SnapshotPacker:
+    """Packs API objects into the columnar tables. The driver calls
+    ``intern_pod`` on arrival (so universes are stable by pack time), then
+    ``pack_nodes`` / ``pack_pods`` per scheduling cycle.
+
+    Column widths are padded to power-of-two buckets (``bucket_size``) so
+    that XLA shapes stay stable while universes grow.
+    """
+
+    def __init__(self, universe: Optional[Universe] = None) -> None:
+        self.u = universe or Universe()
+        self._pod_refs: Dict[str, Tuple[int, int, int, int]] = {}
+
+    # -- interning ---------------------------------------------------------
+
+    def intern_pod(self, pod: Pod) -> Tuple[int, int, int, int]:
+        """Returns (selprog, prefprog, tolset, owner) ids, cached per pod key."""
+        cached = self._pod_refs.get(pod.key())
+        if cached is not None:
+            return cached
+        u = self.u
+        refs = (
+            u.intern_node_selector_program(pod.node_selector, pod.affinity),
+            u.intern_preferred_program(pod.affinity),
+            u.intern_toleration_set(pod.tolerations),
+            u.intern_owner_set(pod.namespace, pod.spread_selectors),
+        )
+        for name in pod.requests.scalars:
+            u.scalar_resources.intern(name)
+        for proto, ip, port in pod.host_ports:
+            u.ports_pp.intern((proto, port))
+            if ip and ip != "0.0.0.0":
+                u.ports_pip.intern((proto, ip, port))
+        for img in pod.images:
+            iid = u.images.intern(img)
+            if iid == len(u.image_sizes):
+                u.image_sizes.append(0.0)
+        self._pod_refs[pod.key()] = refs
+        return refs
+
+    def intern_node(self, node: Node) -> int:
+        u = self.u
+        nid = u.node_names.intern(node.name)
+        for t in node.taints:
+            u.intern_taint(t.key, t.value, t.effect)
+        for img, size in node.images.items():
+            u.intern_image(img, size)
+        return nid
+
+    # -- widths ------------------------------------------------------------
+
+    def widths(self) -> Dict[str, int]:
+        u = self.u
+        return {
+            "R": u.n_resources(),
+            "Up": bucket_size(len(u.label_pairs)),
+            "Uk": bucket_size(len(u.label_keys)),
+            "Ut": bucket_size(len(u.taints)),
+            "Upp": bucket_size(len(u.ports_pp)),
+            "Upip": bucket_size(len(u.ports_pip)),
+            "Ui": bucket_size(len(u.images)),
+            "Uo": bucket_size(len(u.owner_sets)),
+        }
+
+    # -- nodes -------------------------------------------------------------
+
+    def pack_nodes(
+        self,
+        nodes: Sequence[Node],
+        scheduled_pods: Sequence[Pod] = (),
+    ) -> NodeTable:
+        u = self.u
+        for nd in nodes:
+            self.intern_node(nd)
+        for p in scheduled_pods:
+            self.intern_pod(p)
+        w = self.widths()
+        n = len(nodes)
+        R = w["R"]
+        name_id = np.full((n,), -1, np.int32)
+        allocatable = np.zeros((n, R), np.float32)
+        requested = np.zeros((n, R), np.float32)
+        nonzero_req = np.zeros((n, 2), np.float32)
+        pair_mh = np.zeros((n, w["Up"]), np.int8)
+        key_mh = np.zeros((n, w["Uk"]), np.int8)
+        key_val = np.full((n, w["Uk"]), np.nan, np.float32)
+        taint_hard = np.zeros((n, w["Ut"]), np.int8)
+        taint_soft = np.zeros((n, w["Ut"]), np.int8)
+        port_any = np.zeros((n, w["Upp"]), np.int8)
+        port_wild = np.zeros((n, w["Upp"]), np.int8)
+        port_spec = np.zeros((n, w["Upip"]), np.int8)
+        image_mh = np.zeros((n, w["Ui"]), np.int8)
+        owner_counts = np.zeros((n, w["Uo"]), np.float32)
+        ready = np.zeros((n,), bool)
+        schedulable = np.zeros((n,), bool)
+        mem_p = np.zeros((n,), bool)
+        disk_p = np.zeros((n,), bool)
+        pid_p = np.zeros((n,), bool)
+
+        row_of: Dict[int, int] = {}
+        for i, nd in enumerate(nodes):
+            nid = u.node_names.intern(nd.name)
+            row_of[nid] = i
+            name_id[i] = nid
+            allocatable[i] = self.u.resource_vector(nd.allocatable, R)
+            for k, v in nd.labels.items():
+                pi = u.label_pairs.lookup((k, v))
+                if pi >= 0:
+                    pair_mh[i, pi] = 1
+                ki = u.label_keys.lookup(k)
+                if ki >= 0:
+                    key_mh[i, ki] = 1
+                    try:
+                        key_val[i, ki] = float(int(v))
+                    except ValueError:
+                        pass
+            for t in nd.taints:
+                ti = u.intern_taint(t.key, t.value, t.effect)
+                if t.effect in (EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE):
+                    taint_hard[i, ti] = 1
+                elif t.effect == EFFECT_PREFER_NO_SCHEDULE:
+                    taint_soft[i, ti] = 1
+            for img, size in nd.images.items():
+                image_mh[i, u.intern_image(img, size)] = 1
+            ready[i] = nd.conditions.ready
+            schedulable[i] = not nd.unschedulable
+            mem_p[i] = nd.conditions.memory_pressure
+            disk_p[i] = nd.conditions.disk_pressure
+            pid_p[i] = nd.conditions.pid_pressure
+
+        # aggregate scheduled pods into node usage (NodeInfo.AddPod,
+        # node_info.go — requested, nonzeroRequest, usedPorts, pod count)
+        for p in scheduled_pods:
+            nid = u.node_names.lookup(p.node_name)
+            i = row_of.get(nid)
+            if i is None:
+                continue
+            requested[i] += self.u.resource_vector(p.effective_requests(), R)
+            nz_cpu, nz_mem = p.nonzero_requests()
+            nonzero_req[i, 0] += nz_cpu
+            nonzero_req[i, 1] += nz_mem
+            for proto, ip, port in p.host_ports:
+                ppi = u.ports_pp.intern((proto, port))
+                port_any[i, ppi] = 1
+                if not ip or ip == "0.0.0.0":
+                    port_wild[i, ppi] = 1
+                else:
+                    port_spec[i, u.ports_pip.intern((proto, ip, port))] = 1
+            oid = self._pod_refs.get(p.key(), (-1, -1, -1, -1))[3]
+            # owner_counts: for SelectorSpread we need, per owner-set, how
+            # many *matching* scheduled pods sit on each node. A scheduled
+            # pod contributes to owner set `o` if it matches o's selectors.
+            for o, (ns, sels) in enumerate(u.owner_set_items):
+                if ns == p.namespace and all(s.matches(p.labels) for s in sels):
+                    owner_counts[i, o] += 1
+
+        return NodeTable(
+            n=n,
+            name_id=name_id,
+            allocatable=allocatable,
+            requested=requested,
+            nonzero_req=nonzero_req,
+            pair_mh=pair_mh,
+            key_mh=key_mh,
+            key_val=key_val,
+            taint_hard_mh=taint_hard,
+            taint_soft_mh=taint_soft,
+            port_any_mh=port_any,
+            port_wild_mh=port_wild,
+            port_spec_mh=port_spec,
+            image_mh=image_mh,
+            owner_counts=owner_counts,
+            ready=ready,
+            schedulable=schedulable,
+            mem_pressure=mem_p,
+            disk_pressure=disk_p,
+            pid_pressure=pid_p,
+        )
+
+    # -- pods --------------------------------------------------------------
+
+    def pack_pods(self, pods: Sequence[Pod]) -> PodTable:
+        u = self.u
+        for p in pods:
+            self.intern_pod(p)
+        w = self.widths()
+        n = len(pods)
+        R = w["R"]
+        req = np.zeros((n, R), np.float32)
+        nonzero = np.zeros((n, 2), np.float32)
+        selprog = np.full((n,), -1, np.int32)
+        prefprog = np.full((n,), -1, np.int32)
+        tolset = np.full((n,), -1, np.int32)
+        name_req = np.full((n,), -1, np.int32)
+        priority = np.zeros((n,), np.int32)
+        port_wild_pp = np.zeros((n, w["Upp"]), np.int8)
+        port_spec_pp = np.zeros((n, w["Upp"]), np.int8)
+        port_spec_pip = np.zeros((n, w["Upip"]), np.int8)
+        image_mh = np.zeros((n, w["Ui"]), np.int8)
+        owner = np.full((n,), -1, np.int32)
+
+        for i, p in enumerate(pods):
+            refs = self.intern_pod(p)
+            selprog[i], prefprog[i], tolset[i], owner[i] = refs
+            req[i] = self.u.resource_vector(p.effective_requests(), R)
+            nonzero[i] = p.nonzero_requests()
+            if p.node_name:
+                name_req[i] = u.node_names.lookup(p.node_name)
+            priority[i] = p.priority
+            for proto, ip, port in p.host_ports:
+                ppi = u.ports_pp.intern((proto, port))
+                if not ip or ip == "0.0.0.0":
+                    port_wild_pp[i, ppi] = 1
+                else:
+                    port_spec_pp[i, ppi] = 1
+                    port_spec_pip[i, u.ports_pip.intern((proto, ip, port))] = 1
+            for img in p.images:
+                ii = u.images.lookup(img)
+                if ii >= 0:
+                    image_mh[i, ii] = 1
+
+        return PodTable(
+            n=n,
+            req=req,
+            nonzero_req=nonzero,
+            selprog_id=selprog,
+            prefprog_id=prefprog,
+            tolset_id=tolset,
+            name_req=name_req,
+            priority=priority,
+            port_wild_pp=port_wild_pp,
+            port_spec_pp=port_spec_pp,
+            port_spec_pip=port_spec_pip,
+            image_mh=image_mh,
+            owner_id=owner,
+            order=np.arange(n, dtype=np.int32),
+        )
+
+    # -- selector / toleration tables --------------------------------------
+
+    def pack_selector_tables(self) -> SelectorTables:
+        u = self.u
+        w = self.widths()
+
+        def flatten(programs, weighted: bool):
+            expr_term: List[int] = []
+            expr_op: List[int] = []
+            expr_pairs: List[Tuple[int, ...]] = []
+            expr_key: List[int] = []
+            expr_lit: List[float] = []
+            term_prog: List[int] = []
+            term_weight: List[float] = []
+            for prog_id, terms in enumerate(programs):
+                for term in terms:
+                    if weighted:
+                        weight, exprs = term
+                    else:
+                        weight, exprs = 1.0, term
+                    tid = len(term_prog)
+                    term_prog.append(prog_id)
+                    term_weight.append(weight)
+                    for e in exprs:
+                        expr_term.append(tid)
+                        expr_op.append(e.op)
+                        expr_pairs.append(e.pair_ids)
+                        expr_key.append(e.key_id)
+                        expr_lit.append(e.literal)
+            E, T = len(expr_term), len(term_prog)
+            pairs_mh = np.zeros((E, w["Up"]), np.int8)
+            for r, ids in enumerate(expr_pairs):
+                for pid in ids:
+                    pairs_mh[r, pid] = 1
+            return (
+                E,
+                T,
+                len(programs),
+                np.asarray(expr_term, np.int32),
+                np.asarray(expr_op, np.int32),
+                pairs_mh,
+                np.asarray(expr_key, np.int32),
+                np.asarray(expr_lit, np.float32),
+                np.asarray(term_prog, np.int32),
+                np.asarray(term_weight, np.float32),
+            )
+
+        (E, T, G, e_t, e_op, e_p, e_k, e_l, t_p, _) = flatten(
+            u.sel_program_terms, weighted=False
+        )
+        (pE, pT, pG, pe_t, pe_op, pe_p, pe_k, pe_l, pt_p, pt_w) = flatten(
+            u.pref_program_terms, weighted=True
+        )
+
+        # tolerated-taint multihots per toleration set
+        S = len(u.tol_set_items)
+        Ut = w["Ut"]
+        tol_hard = np.zeros((S, Ut), np.int8)
+        tol_soft = np.zeros((S, Ut), np.int8)
+        taint_items = u.taints.items()
+        from kubernetes_tpu.api.types import Taint  # local to avoid cycle noise
+
+        for s, tols in enumerate(u.tol_set_items):
+            for ti, (tk, tv, te) in enumerate(taint_items):
+                taint = Taint(tk, tv, te)
+                if any(t.tolerates(taint) for t in tols):
+                    if te in (EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE):
+                        tol_hard[s, ti] = 1
+                    elif te == EFFECT_PREFER_NO_SCHEDULE:
+                        tol_soft[s, ti] = 1
+
+        sizes = np.zeros((w["Ui"],), np.float32)
+        sizes[: len(u.image_sizes)] = np.asarray(u.image_sizes, np.float32)
+
+        return SelectorTables(
+            n_exprs=E,
+            n_terms=T,
+            n_progs=G,
+            expr_term=e_t,
+            expr_op=e_op,
+            expr_pairs_mh=e_p,
+            expr_key=e_k,
+            expr_lit=e_l,
+            term_prog=t_p,
+            p_n_exprs=pE,
+            p_n_terms=pT,
+            p_n_progs=pG,
+            p_expr_term=pe_t,
+            p_expr_op=pe_op,
+            p_expr_pairs_mh=pe_p,
+            p_expr_key=pe_k,
+            p_expr_lit=pe_l,
+            p_term_prog=pt_p,
+            p_term_weight=pt_w,
+            tol_hard_mh=tol_hard,
+            tol_soft_mh=tol_soft,
+            image_sizes=sizes,
+        )

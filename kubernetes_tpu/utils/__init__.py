@@ -1,0 +1,1 @@
+from kubernetes_tpu.utils.interner import Interner  # noqa: F401

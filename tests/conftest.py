@@ -1,0 +1,17 @@
+"""Test harness config: force JAX onto CPU with 8 virtual devices so the
+multi-chip sharding paths (jax.sharding.Mesh over the node axis) are
+exercised without TPU hardware — the analog of the reference running its
+integration suite against an in-process apiserver instead of a real cluster
+(test/integration/util/util.go:42).
+
+Must run before any jax import, hence env mutation at conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

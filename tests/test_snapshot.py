@@ -1,0 +1,121 @@
+"""Golden tests for the columnar snapshot packer (SURVEY.md §7.2 step 1)."""
+
+import numpy as np
+
+from kubernetes_tpu.api.types import OP_IN, Taint, Toleration
+from kubernetes_tpu.snapshot import RES_CPU, RES_MEM, RES_PODS, SnapshotPacker
+from kubernetes_tpu.testing import make_node, make_pod, node_affinity_required, req
+
+
+def test_pack_nodes_basic_resources():
+    pk = SnapshotPacker()
+    nodes = [make_node("n0", cpu_milli=1000, memory=2048, pods=10),
+             make_node("n1", cpu_milli=2000, memory=4096, pods=20)]
+    scheduled = [make_pod("p0", cpu_milli=100, memory=512, node_name="n0")]
+    for p in scheduled:
+        pk.intern_pod(p)
+    nt = pk.pack_nodes(nodes, scheduled)
+    assert nt.n == 2
+    assert nt.allocatable[0, RES_CPU] == 1000
+    assert nt.allocatable[1, RES_MEM] == 4096
+    assert nt.allocatable[0, RES_PODS] == 10
+    assert nt.requested[0, RES_CPU] == 100
+    assert nt.requested[0, RES_MEM] == 512
+    assert nt.requested[0, RES_PODS] == 1  # pod count rides the pods column
+    assert nt.requested[1].sum() == 0
+    # nonzero request uses scoring defaults only when request is 0
+    assert nt.nonzero_req[0, 0] == 100
+    assert nt.nonzero_req[0, 1] == 512
+
+
+def test_nonzero_defaults_applied():
+    pk = SnapshotPacker()
+    p = make_pod("p0", node_name="n0")  # no requests at all
+    pk.intern_pod(p)
+    nt = pk.pack_nodes([make_node("n0")], [p])
+    assert nt.nonzero_req[0, 0] == 100  # DefaultMilliCPURequest
+    assert nt.nonzero_req[0, 1] == 200 * 1024 * 1024
+
+
+def test_selector_program_interning_dedupes():
+    pk = SnapshotPacker()
+    pods = [make_pod(f"p{i}", node_selector={"disk": "ssd"}) for i in range(5)]
+    refs = [pk.intern_pod(p) for p in pods]
+    assert len({r[0] for r in refs}) == 1  # one shared program
+    assert len(pk.u.sel_programs) == 1
+    pt = pk.pack_pods(pods)
+    assert (pt.selprog_id == refs[0][0]).all()
+
+
+def test_selector_tables_flatten():
+    pk = SnapshotPacker()
+    a = node_affinity_required([req("zone", OP_IN, "a", "b")],
+                               [req("disk", OP_IN, "ssd")])
+    p = make_pod("p0", node_selector={"arch": "amd64"}, affinity=a)
+    selprog, _, _, _ = pk.intern_pod(p)
+    assert selprog == 0
+    st = pk.pack_selector_tables()
+    # two OR terms, each with the base nodeSelector expr + own expr
+    assert st.n_progs == 1
+    assert st.n_terms == 2
+    assert st.n_exprs == 4
+    assert (st.term_prog == 0).all()
+    # pair universe holds (arch,amd64), (zone,a), (zone,b), (disk,ssd)
+    assert len(pk.u.label_pairs) == 4
+
+
+def test_node_label_membership():
+    pk = SnapshotPacker()
+    p = make_pod("p0", node_selector={"disk": "ssd"})
+    pk.intern_pod(p)
+    nt = pk.pack_nodes([make_node("n0", labels={"disk": "ssd"}),
+                        make_node("n1", labels={"disk": "hdd"})])
+    pid = pk.u.label_pairs.lookup(("disk", "ssd"))
+    assert nt.pair_mh[0, pid] == 1
+    assert nt.pair_mh[1, pid] == 0
+
+
+def test_taints_and_toleration_sets():
+    pk = SnapshotPacker()
+    t_hard = Taint("dedicated", "gpu", "NoSchedule")
+    t_soft = Taint("flaky", "", "PreferNoSchedule")
+    tol = Toleration(key="dedicated", operator="Equal", value="gpu", effect="NoSchedule")
+    p_tol = make_pod("p0", tolerations=[tol])
+    p_plain = make_pod("p1")
+    pk.intern_pod(p_tol)
+    pk.intern_pod(p_plain)
+    nt = pk.pack_nodes([make_node("n0", taints=[t_hard, t_soft]), make_node("n1")])
+    st = pk.pack_selector_tables()
+    hard_id = pk.u.taints.lookup(("dedicated", "gpu", "NoSchedule"))
+    soft_id = pk.u.taints.lookup(("flaky", "", "PreferNoSchedule"))
+    assert nt.taint_hard_mh[0, hard_id] == 1
+    assert nt.taint_soft_mh[0, soft_id] == 1
+    assert nt.taint_hard_mh[1].sum() == 0
+    pt = pk.pack_pods([p_tol, p_plain])
+    assert pt.tolset_id[0] >= 0 and pt.tolset_id[1] == -1
+    assert st.tol_hard_mh[pt.tolset_id[0], hard_id] == 1
+    assert st.tol_soft_mh[pt.tolset_id[0], soft_id] == 0
+
+
+def test_host_ports_packing():
+    pk = SnapshotPacker()
+    sched = make_pod("s0", node_name="n0", host_ports=[("TCP", "", 8080)])
+    pend_conflict = make_pod("p0", host_ports=[("TCP", "", 8080)])
+    pend_ok = make_pod("p1", host_ports=[("TCP", "", 9090)])
+    for p in (sched, pend_conflict, pend_ok):
+        pk.intern_pod(p)
+    nt = pk.pack_nodes([make_node("n0"), make_node("n1")], [sched])
+    pt = pk.pack_pods([pend_conflict, pend_ok])
+    ppi = pk.u.ports_pp.lookup(("TCP", 8080))
+    assert nt.port_any_mh[0, ppi] == 1 and nt.port_wild_mh[0, ppi] == 1
+    assert nt.port_any_mh[1].sum() == 0
+    assert pt.port_wild_pp[0, ppi] == 1
+    assert pt.port_wild_pp[1, ppi] == 0
+
+
+def test_bucket_padding_stable():
+    from kubernetes_tpu.utils.interner import bucket_size
+    assert bucket_size(0) == 8
+    assert bucket_size(8) == 8
+    assert bucket_size(9) == 16
+    assert bucket_size(1000) == 1024
