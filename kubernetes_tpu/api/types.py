@@ -223,6 +223,9 @@ class Pod:
     spread_selectors: Tuple[LabelSelector, ...] = ()
     #: gang/coscheduling group (PodGroup); empty = no gang.
     pod_group: str = ""
+    #: UID of the controller ownerReference (RC/RS), feeds
+    #: NodePreferAvoidPodsPriority (node_prefer_avoid_pods.go).
+    owner_uid: str = ""
     #: monotonically increasing arrival stamp used for queue ordering
     #: (the reference orders activeQ by priority then timestamp).
     queued_at: float = 0.0
@@ -263,9 +266,24 @@ class Node:
     unschedulable: bool = False
     conditions: NodeCondition = field(default_factory=NodeCondition)
     images: Dict[str, int] = field(default_factory=dict)  # name -> size bytes
+    #: owner UIDs from the scheduler.alpha.kubernetes.io/preferAvoidPods
+    #: annotation (NodePreferAvoidPodsPriority).
+    prefer_avoid_owner_uids: Tuple[str, ...] = ()
 
     def zone(self) -> Optional[str]:
         # Reference zone labels: failure-domain.beta.kubernetes.io/zone.
         return self.labels.get("failure-domain.beta.kubernetes.io/zone") or self.labels.get(
             "topology.kubernetes.io/zone"
         )
+
+    def region(self) -> Optional[str]:
+        return self.labels.get("failure-domain.beta.kubernetes.io/region") or self.labels.get(
+            "topology.kubernetes.io/region"
+        )
+
+    def zone_key(self) -> Optional[Tuple[str, str]]:
+        """utilnode.GetZoneKey analog: (region, zone), None when unlabeled."""
+        z, r = self.zone(), self.region()
+        if z is None and r is None:
+            return None
+        return (r or "", z or "")

@@ -1,0 +1,1 @@
+from kubernetes_tpu.ops import arrays, predicates  # noqa: F401

@@ -1,0 +1,227 @@
+"""Device-side array bundles (jit-able pytrees) mirroring the host columnar
+tables, padded to power-of-two row buckets so XLA shapes stay stable as the
+cluster and pending queue grow/shrink (SURVEY.md §7.3.6 — the bucketing
+policy that avoids recompilation storms the way the reference avoids
+re-listing via incremental snapshots, cache.go:211)."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.snapshot import NodeTable, PodTable, SelectorTables
+from kubernetes_tpu.utils.interner import bucket_size
+
+
+class DeviceNodes(NamedTuple):
+    """Padded columnar NodeInfo on device. Rows >= n_valid are padding and
+    are marked unschedulable so every predicate rejects them."""
+
+    valid: jnp.ndarray  # (N,) bool
+    name_id: jnp.ndarray  # (N,) i32
+    allocatable: jnp.ndarray  # (N, R) f32
+    requested: jnp.ndarray  # (N, R) f32
+    nonzero_req: jnp.ndarray  # (N, 2) f32
+    pair_mh: jnp.ndarray  # (N, Up) f32 (f32 so memberships ride the MXU)
+    key_mh: jnp.ndarray  # (N, Uk) f32
+    key_val: jnp.ndarray  # (N, Uk) f32
+    key_num: jnp.ndarray  # (N, Uk) f32 — 1 when label parsed as integer
+    taint_hard_mh: jnp.ndarray  # (N, Ut) f32
+    taint_soft_mh: jnp.ndarray  # (N, Ut) f32
+    port_any_mh: jnp.ndarray  # (N, Upp) f32
+    port_wild_mh: jnp.ndarray  # (N, Upp) f32
+    port_spec_mh: jnp.ndarray  # (N, Upip) f32
+    image_mh: jnp.ndarray  # (N, Ui) f32
+    owner_counts: jnp.ndarray  # (N, Uo) f32
+    zone_id: jnp.ndarray  # (N,) i32
+    zone_valid: jnp.ndarray  # (Z,) bool — static shape = padded zone count
+    avoid_mh: jnp.ndarray  # (N, Uu) f32
+    ready: jnp.ndarray  # (N,) bool
+    schedulable: jnp.ndarray  # (N,) bool
+    mem_pressure: jnp.ndarray  # (N,) bool
+    disk_pressure: jnp.ndarray  # (N,) bool
+    pid_pressure: jnp.ndarray  # (N,) bool
+
+    @property
+    def n(self) -> int:
+        return self.name_id.shape[0]
+
+
+class DevicePods(NamedTuple):
+    valid: jnp.ndarray  # (P,) bool
+    req: jnp.ndarray  # (P, R) f32
+    nonzero_req: jnp.ndarray  # (P, 2) f32
+    selprog_id: jnp.ndarray  # (P,) i32
+    prefprog_id: jnp.ndarray  # (P,) i32
+    tolset_id: jnp.ndarray  # (P,) i32
+    name_req: jnp.ndarray  # (P,) i32
+    priority: jnp.ndarray  # (P,) i32
+    port_wild_pp: jnp.ndarray  # (P, Upp) f32
+    port_spec_pp: jnp.ndarray  # (P, Upp) f32
+    port_spec_pip: jnp.ndarray  # (P, Upip) f32
+    image_mh: jnp.ndarray  # (P, Ui) f32
+    owner_id: jnp.ndarray  # (P,) i32
+    owner_uid_id: jnp.ndarray  # (P,) i32
+    owner_match_mh: jnp.ndarray  # (P, Uo) f32
+    order: jnp.ndarray  # (P,) i32
+
+    @property
+    def n(self) -> int:
+        return self.selprog_id.shape[0]
+
+
+class DeviceSelectors(NamedTuple):
+    """Flattened selector programs + toleration tables. Padded rows carry
+    explicit valid masks; AND/OR segment reductions use neutral fills."""
+
+    expr_valid: jnp.ndarray  # (E,) bool
+    expr_term: jnp.ndarray  # (E,) i32
+    expr_op: jnp.ndarray  # (E,) i32
+    expr_pairs_mh: jnp.ndarray  # (E, Up) f32
+    expr_key: jnp.ndarray  # (E,) i32
+    expr_lit: jnp.ndarray  # (E,) f32
+    term_valid: jnp.ndarray  # (T,) bool
+    term_prog: jnp.ndarray  # (T,) i32
+    p_expr_valid: jnp.ndarray
+    p_expr_term: jnp.ndarray
+    p_expr_op: jnp.ndarray
+    p_expr_pairs_mh: jnp.ndarray
+    p_expr_key: jnp.ndarray
+    p_expr_lit: jnp.ndarray
+    p_term_valid: jnp.ndarray
+    p_term_prog: jnp.ndarray
+    p_term_weight: jnp.ndarray  # (Tp,) f32
+    tol_hard_mh: jnp.ndarray  # (S, Ut) f32
+    tol_soft_mh: jnp.ndarray  # (S, Ut) f32
+    image_sizes: jnp.ndarray  # (Ui,) f32
+    # program-count masks: their STATIC shapes carry the padded program
+    # counts into segment reductions (ints in a pytree would be traced).
+    prog_valid: jnp.ndarray  # (G,) bool
+    p_prog_valid: jnp.ndarray  # (Gp,) bool
+
+
+def _pad_rows(a: np.ndarray, rows: int, fill=0) -> np.ndarray:
+    if a.shape[0] == rows:
+        return a
+    out = np.full((rows,) + a.shape[1:], fill, a.dtype)
+    out[: a.shape[0]] = a
+    return out
+
+
+def nodes_to_device(t: NodeTable, pad_to: int | None = None) -> DeviceNodes:
+    n_pad = pad_to or bucket_size(max(t.n, 1))
+    valid = np.zeros((n_pad,), bool)
+    valid[: t.n] = True
+    f32 = lambda a: jnp.asarray(_pad_rows(a.astype(np.float32), n_pad))
+    return DeviceNodes(
+        valid=jnp.asarray(valid),
+        name_id=jnp.asarray(_pad_rows(t.name_id, n_pad, -1)),
+        allocatable=f32(t.allocatable),
+        requested=f32(t.requested),
+        nonzero_req=f32(t.nonzero_req),
+        pair_mh=f32(t.pair_mh),
+        key_mh=f32(t.key_mh),
+        key_val=f32(t.key_val),
+        key_num=f32(t.key_num),
+        taint_hard_mh=f32(t.taint_hard_mh),
+        taint_soft_mh=f32(t.taint_soft_mh),
+        port_any_mh=f32(t.port_any_mh),
+        port_wild_mh=f32(t.port_wild_mh),
+        port_spec_mh=f32(t.port_spec_mh),
+        image_mh=f32(t.image_mh),
+        owner_counts=f32(t.owner_counts),
+        zone_id=jnp.asarray(_pad_rows(t.zone_id, n_pad, -1)),
+        zone_valid=jnp.asarray(t.zone_valid),
+        avoid_mh=f32(t.avoid_mh),
+        ready=jnp.asarray(_pad_rows(t.ready, n_pad, False)),
+        schedulable=jnp.asarray(_pad_rows(t.schedulable, n_pad, False)),
+        mem_pressure=jnp.asarray(_pad_rows(t.mem_pressure, n_pad, True)),
+        disk_pressure=jnp.asarray(_pad_rows(t.disk_pressure, n_pad, True)),
+        pid_pressure=jnp.asarray(_pad_rows(t.pid_pressure, n_pad, True)),
+    )
+
+
+def pods_to_device(t: PodTable, pad_to: int | None = None) -> DevicePods:
+    p_pad = pad_to or bucket_size(max(t.n, 1))
+    valid = np.zeros((p_pad,), bool)
+    valid[: t.n] = True
+    f32 = lambda a: jnp.asarray(_pad_rows(a.astype(np.float32), p_pad))
+    i32 = lambda a, fill=-1: jnp.asarray(_pad_rows(a, p_pad, fill))
+    return DevicePods(
+        valid=jnp.asarray(valid),
+        req=f32(t.req),
+        nonzero_req=f32(t.nonzero_req),
+        selprog_id=i32(t.selprog_id),
+        prefprog_id=i32(t.prefprog_id),
+        tolset_id=i32(t.tolset_id),
+        name_req=i32(t.name_req),
+        priority=i32(t.priority, 0),
+        port_wild_pp=f32(t.port_wild_pp),
+        port_spec_pp=f32(t.port_spec_pp),
+        port_spec_pip=f32(t.port_spec_pip),
+        image_mh=f32(t.image_mh),
+        owner_id=i32(t.owner_id),
+        owner_uid_id=i32(t.owner_uid_id),
+        owner_match_mh=f32(t.owner_match_mh),
+        order=i32(t.order, -1),
+    )
+
+
+def selectors_to_device(t: SelectorTables) -> DeviceSelectors:
+    def pack(n_e, n_t, e_term, e_op, e_pairs, e_key, e_lit, t_prog, t_w=None):
+        e_pad = bucket_size(max(n_e, 1))
+        t_pad = bucket_size(max(n_t, 1))
+        ev = np.zeros((e_pad,), bool)
+        ev[:n_e] = True
+        tv = np.zeros((t_pad,), bool)
+        tv[:n_t] = True
+        out = dict(
+            expr_valid=jnp.asarray(ev),
+            expr_term=jnp.asarray(_pad_rows(e_term, e_pad, 0)),
+            expr_op=jnp.asarray(_pad_rows(e_op, e_pad, 0)),
+            expr_pairs_mh=jnp.asarray(_pad_rows(e_pairs.astype(np.float32), e_pad)),
+            expr_key=jnp.asarray(_pad_rows(e_key, e_pad, -1)),
+            expr_lit=jnp.asarray(_pad_rows(e_lit, e_pad, 0.0)),
+            term_valid=jnp.asarray(tv),
+            term_prog=jnp.asarray(_pad_rows(t_prog, t_pad, 0)),
+        )
+        if t_w is not None:
+            out["term_weight"] = jnp.asarray(_pad_rows(t_w, t_pad, 0.0))
+        return out
+
+    r = pack(t.n_exprs, t.n_terms, t.expr_term, t.expr_op, t.expr_pairs_mh,
+             t.expr_key, t.expr_lit, t.term_prog)
+    p = pack(t.p_n_exprs, t.p_n_terms, t.p_expr_term, t.p_expr_op,
+             t.p_expr_pairs_mh, t.p_expr_key, t.p_expr_lit, t.p_term_prog,
+             t.p_term_weight)
+    s_pad = bucket_size(max(t.tol_hard_mh.shape[0], 1))
+    return DeviceSelectors(
+        expr_valid=r["expr_valid"],
+        expr_term=r["expr_term"],
+        expr_op=r["expr_op"],
+        expr_pairs_mh=r["expr_pairs_mh"],
+        expr_key=r["expr_key"],
+        expr_lit=r["expr_lit"],
+        term_valid=r["term_valid"],
+        term_prog=r["term_prog"],
+        p_expr_valid=p["expr_valid"],
+        p_expr_term=p["expr_term"],
+        p_expr_op=p["expr_op"],
+        p_expr_pairs_mh=p["expr_pairs_mh"],
+        p_expr_key=p["expr_key"],
+        p_expr_lit=p["expr_lit"],
+        p_term_valid=p["term_valid"],
+        p_term_prog=p["term_prog"],
+        p_term_weight=p["term_weight"],
+        tol_hard_mh=jnp.asarray(_pad_rows(t.tol_hard_mh.astype(np.float32), s_pad)),
+        tol_soft_mh=jnp.asarray(_pad_rows(t.tol_soft_mh.astype(np.float32), s_pad)),
+        image_sizes=jnp.asarray(t.image_sizes),
+        prog_valid=jnp.asarray(
+            _pad_rows(np.ones((t.n_progs,), bool), bucket_size(max(t.n_progs, 1)), False)
+        ),
+        p_prog_valid=jnp.asarray(
+            _pad_rows(np.ones((t.p_n_progs,), bool), bucket_size(max(t.p_n_progs, 1)), False)
+        ),
+    )

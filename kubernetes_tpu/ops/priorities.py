@@ -1,0 +1,241 @@
+"""Vectorized Score priorities — the reference's Map/Reduce priority library
+(``pkg/scheduler/algorithm/priorities/``) recast as fused (pods x nodes) f32
+kernels.
+
+The reference maps each priority per node under a 16-goroutine fan-out
+(``generic_scheduler.go:738``), reduces (normalizes) per pod, then takes the
+weighted sum (``:799-829``). Here each priority emits the whole (P, N) matrix
+at once; reduces are per-row ops; the weighted sum is one fused combine.
+
+Go's integer arithmetic (scores are int64 0..10 with repeated integer
+division) is emulated with ``floor(x + eps)`` in f32 — exact on realistic
+resource values; see ``_idiv``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.api.types import MAX_PRIORITY
+from kubernetes_tpu.ops.arrays import DeviceNodes, DevicePods, DeviceSelectors
+from kubernetes_tpu.ops.predicates import preferred_program_score
+
+_EPS = 1e-5
+
+
+def _idiv(num: jnp.ndarray, den: jnp.ndarray) -> jnp.ndarray:
+    """Go-style integer division num/den in f32: floor with a small epsilon
+    to absorb f32 rounding below exact integer ratios."""
+    return jnp.floor(num / jnp.maximum(den, 1e-30) + _EPS)
+
+
+def _normalize_reduce(raw: jnp.ndarray, mask: jnp.ndarray, reverse: bool) -> jnp.ndarray:
+    """priorities/reduce.go NormalizeReduce: per pod, scale scores so the max
+    becomes MaxPriority; if max==0 -> all MaxPriority when reversed, else 0.
+
+    ``mask`` is the pod's Filter feasibility row — the reference reduces over
+    the *filtered* node list only (PrioritizeNodes receives filteredNodes,
+    generic_scheduler.go:684), so the max is taken over feasible nodes."""
+    masked = jnp.where(mask, raw, 0.0)
+    mx = jnp.max(masked, axis=1, keepdims=True)  # (P, 1)
+    scaled = _idiv(MAX_PRIORITY * raw, jnp.where(mx > 0, mx, 1.0))
+    scaled = jnp.where(mx > 0, scaled, 0.0)
+    if reverse:
+        scaled = jnp.where(mx > 0, MAX_PRIORITY - scaled, float(MAX_PRIORITY))
+    return scaled
+
+
+def _requested_fractions(pods: DevicePods, nodes: DeviceNodes):
+    """(cpu, mem) total nonzero-request fractions after placing each pod on
+    each node — shared scaffold of the ResourceAllocationPriority family
+    (resource_allocation.go:39)."""
+    cpu_req = pods.nonzero_req[:, 0:1] + nodes.nonzero_req[None, :, 0]
+    mem_req = pods.nonzero_req[:, 1:2] + nodes.nonzero_req[None, :, 1]
+    cpu_cap = nodes.allocatable[None, :, 0]
+    mem_cap = nodes.allocatable[None, :, 1]
+    return cpu_req, mem_req, cpu_cap, mem_cap
+
+
+def least_requested(pods, nodes, sel, mask) -> jnp.ndarray:
+    """least_requested.go: ((cap-req)*10/cap + (cap-req)*10/cap)/2, integer
+    divisions preserved; req>cap or cap==0 scores 0."""
+    cpu_req, mem_req, cpu_cap, mem_cap = _requested_fractions(pods, nodes)
+
+    def score(req, cap):
+        s = _idiv((cap - req) * MAX_PRIORITY, cap)
+        return jnp.where((cap <= 0) | (req > cap), 0.0, s)
+
+    return _idiv(score(cpu_req, cpu_cap) + score(mem_req, mem_cap), 2.0)
+
+
+def most_requested(pods, nodes, sel, mask) -> jnp.ndarray:
+    """most_requested.go: (req*10/cap) averaged — the bin-packing dual."""
+    cpu_req, mem_req, cpu_cap, mem_cap = _requested_fractions(pods, nodes)
+
+    def score(req, cap):
+        s = _idiv(req * MAX_PRIORITY, cap)
+        return jnp.where((cap <= 0) | (req > cap), 0.0, s)
+
+    return _idiv(score(cpu_req, cpu_cap) + score(mem_req, mem_cap), 2.0)
+
+
+def balanced_allocation(pods, nodes, sel, mask) -> jnp.ndarray:
+    """balanced_resource_allocation.go (two-resource form): score =
+    int((1 - |cpuFrac - memFrac|) * 10); any fraction >= 1 scores 0."""
+    cpu_req, mem_req, cpu_cap, mem_cap = _requested_fractions(pods, nodes)
+    cf = jnp.where(cpu_cap > 0, cpu_req / jnp.maximum(cpu_cap, 1e-30), 1.0)
+    mf = jnp.where(mem_cap > 0, mem_req / jnp.maximum(mem_cap, 1e-30), 1.0)
+    diff = jnp.abs(cf - mf)
+    score = jnp.floor((1.0 - diff) * MAX_PRIORITY + _EPS)
+    return jnp.where((cf >= 1.0) | (mf >= 1.0), 0.0, score)
+
+
+def node_affinity(pods, nodes, sel, mask) -> jnp.ndarray:
+    """node_affinity.go: weight-sum of matched PreferredDuringScheduling
+    terms, NormalizeReduce(10, false)."""
+    prog = preferred_program_score(sel, nodes)  # (Gp, N)
+    idx = jnp.clip(pods.prefprog_id, 0, prog.shape[0] - 1)
+    raw = jnp.where((pods.prefprog_id >= 0)[:, None], prog[idx], 0.0)
+    return _normalize_reduce(raw, mask, reverse=False)
+
+
+def taint_toleration(pods, nodes, sel, mask) -> jnp.ndarray:
+    """taint_toleration.go: count PreferNoSchedule taints not tolerated,
+    NormalizeReduce(10, reverse=true)."""
+    tol_idx = jnp.clip(pods.tolset_id, 0, sel.tol_soft_mh.shape[0] - 1)
+    tol_rows = jnp.where((pods.tolset_id >= 0)[:, None], sel.tol_soft_mh[tol_idx], 0.0)
+    soft_count = jnp.sum(nodes.taint_soft_mh, axis=1)  # (N,)
+    tolerated = tol_rows @ nodes.taint_soft_mh.T  # (P, N)
+    intolerable = soft_count[None, :] - tolerated
+    return _normalize_reduce(intolerable, mask, reverse=True)
+
+
+def image_locality(pods, nodes, sel, mask) -> jnp.ndarray:
+    """image_locality.go: sum of (size * nodes-with-image/total-nodes) over
+    the pod's images present on the node, clamped to [23MB, 1000MB] and
+    scaled to 0..10."""
+    mb = 1024.0 * 1024.0
+    lo, hi = 23.0 * mb, 1000.0 * mb
+    total = jnp.maximum(jnp.sum(nodes.valid.astype(jnp.float32)), 1.0)
+    num_nodes = jnp.sum(
+        jnp.where(nodes.valid[:, None], nodes.image_mh, 0.0), axis=0
+    )  # (Ui,) nodes having each image
+    spread = num_nodes / total
+    # truncation to int64 per image (scaledImageScore) then summed
+    scaled = jnp.floor(sel.image_sizes * spread + _EPS)  # (Ui,)
+    sum_scores = pods.image_mh @ (nodes.image_mh * scaled[None, :]).T  # (P, N)
+    clamped = jnp.clip(sum_scores, lo, hi)
+    return _idiv(MAX_PRIORITY * (clamped - lo), hi - lo)
+
+
+def selector_spread(pods, nodes, sel, mask) -> jnp.ndarray:
+    """selector_spreading.go: map = count of same-namespace pods on the node
+    matching all owner selectors; reduce = 10*(max-count)/max blended 1/3
+    with the zone-level equivalent at 2/3 (zoneWeighting, :34) when zones
+    exist."""
+    idx = jnp.clip(pods.owner_id, 0, nodes.owner_counts.shape[1] - 1)
+    counts = jnp.where(
+        (pods.owner_id >= 0)[:, None], nodes.owner_counts.T[idx], 0.0
+    )  # (P, N)
+    counts = jnp.where(mask, counts, 0.0)
+    max_node = jnp.max(counts, axis=1, keepdims=True)  # (P, 1)
+
+    # zone aggregation as a one-hot matmul: Zmat (N, Z)
+    n_zones = nodes.zone_valid.shape[0]
+    has_zone = nodes.zone_id >= 0
+    zid = jnp.clip(nodes.zone_id, 0, n_zones - 1)
+    zmat = (
+        (zid[:, None] == jnp.arange(n_zones)[None, :])
+        & has_zone[:, None]
+    ).astype(jnp.float32)  # (N, Z)
+    zcounts = counts @ zmat  # (P, Z) — per-pod per-zone matched-pod totals
+    # zones present *for this pod* = zones containing a feasible node
+    # (the reference builds countsByZone from the pod's scored node list)
+    zone_present = (mask.astype(jnp.float32) @ zmat) > 0  # (P, Z)
+    max_zone = jnp.max(jnp.where(zone_present, zcounts, -jnp.inf), axis=1, keepdims=True)
+    have_zones = jnp.any(zone_present, axis=1, keepdims=True)  # (P, 1)
+
+    node_score = jnp.where(
+        max_node > 0,
+        MAX_PRIORITY * (max_node - counts) / jnp.maximum(max_node, 1e-30),
+        float(MAX_PRIORITY),
+    )
+    zcount_of_node = jnp.take_along_axis(
+        zcounts, jnp.broadcast_to(zid[None, :], (zcounts.shape[0], zid.shape[0])), axis=1
+    )  # (P, N)
+    zone_score = jnp.where(
+        max_zone > 0,
+        MAX_PRIORITY * (max_zone - zcount_of_node) / jnp.maximum(max_zone, 1e-30),
+        float(MAX_PRIORITY),
+    )
+    blend = jnp.where(
+        have_zones & has_zone[None, :],
+        node_score * (1.0 / 3.0) + zone_score * (2.0 / 3.0),
+        node_score,
+    )
+    return jnp.floor(blend + _EPS)  # reference truncates the final float
+
+
+def node_prefer_avoid(pods, nodes, sel, mask) -> jnp.ndarray:
+    """node_prefer_avoid_pods.go: 0 when the node's preferAvoidPods
+    annotation lists the pod's controller owner, else 10 (weight 10000 in
+    the default provider drowns other priorities)."""
+    idx = jnp.clip(pods.owner_uid_id, 0, nodes.avoid_mh.shape[1] - 1)
+    avoided = jnp.where(
+        (pods.owner_uid_id >= 0)[:, None], nodes.avoid_mh.T[idx], 0.0
+    )
+    return jnp.where(avoided > 0, 0.0, float(MAX_PRIORITY))
+
+
+def equal_priority(pods, nodes, sel, mask) -> jnp.ndarray:
+    """generic_scheduler.go:840 EqualPriority."""
+    return jnp.ones((pods.req.shape[0], nodes.allocatable.shape[0]), jnp.float32)
+
+
+PriorityFn = Callable[..., jnp.ndarray]  # (pods, nodes, sel, mask) -> (P, N)
+
+#: Registry name -> kernel; names mirror factory registrations
+#: (algorithmprovider/defaults/register_priorities.go).
+PRIORITY_REGISTRY: Dict[str, PriorityFn] = {
+    "LeastRequestedPriority": least_requested,
+    "MostRequestedPriority": most_requested,
+    "BalancedResourceAllocation": balanced_allocation,
+    "NodeAffinityPriority": node_affinity,
+    "TaintTolerationPriority": taint_toleration,
+    "ImageLocalityPriority": image_locality,
+    "SelectorSpreadPriority": selector_spread,
+    "NodePreferAvoidPodsPriority": node_prefer_avoid,
+    "EqualPriority": equal_priority,
+}
+
+#: Default provider weights (defaults.go:119 defaultPriorities; InterPodAffinity
+#: and EvenPodsSpread join in the topology milestone).
+DEFAULT_WEIGHTS: Dict[str, float] = {
+    "SelectorSpreadPriority": 1,
+    "LeastRequestedPriority": 1,
+    "BalancedResourceAllocation": 1,
+    "NodePreferAvoidPodsPriority": 10000,
+    "NodeAffinityPriority": 1,
+    "TaintTolerationPriority": 1,
+    "ImageLocalityPriority": 1,
+}
+
+
+def run_priorities(
+    pods: DevicePods,
+    nodes: DeviceNodes,
+    sel: DeviceSelectors,
+    mask: jnp.ndarray,
+    weights: Dict[str, float] | None = None,
+) -> jnp.ndarray:
+    """PrioritizeNodes (generic_scheduler.go:684): weighted sum of all
+    enabled priorities -> (P, N) f32 total score."""
+    weights = DEFAULT_WEIGHTS if weights is None else weights
+    total = jnp.zeros((pods.req.shape[0], nodes.allocatable.shape[0]), jnp.float32)
+    for name, w in weights.items():
+        if w:
+            total = total + w * PRIORITY_REGISTRY[name](pods, nodes, sel, mask)
+    return total

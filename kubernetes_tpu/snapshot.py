@@ -21,6 +21,7 @@ of thousands of pods sharing one pod-template's selector.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -53,6 +54,9 @@ N_FIXED_RESOURCES = 4
 
 # Expression opcodes for the device-side selector interpreter.
 XOP_IN, XOP_NOT_IN, XOP_EXISTS, XOP_NOT_EXISTS, XOP_GT, XOP_LT = range(6)
+
+#: Go strconv.ParseInt-compatible integer syntax (ASCII digits, optional sign)
+_GO_INT_RE = re.compile(r"^[+-]?[0-9]+$")
 
 _OPCODE = {
     OP_IN: XOP_IN,
@@ -100,6 +104,10 @@ class Universe:
         # owner-selector sets (SelectorSpread) — (namespace, canonical sels)
         self.owner_sets = Interner()
         self.owner_set_items: List[Tuple[str, tuple]] = []
+        # zone keys (region, zone) — SelectorSpread zone weighting
+        self.zones = Interner()
+        # controller owner UIDs — NodePreferAvoidPods
+        self.owner_uids = Interner()
 
     # -- resources ---------------------------------------------------------
 
@@ -252,7 +260,8 @@ class NodeTable:
     nonzero_req: np.ndarray  # (N, 2) f32 — scoring request sums w/ defaults
     pair_mh: np.ndarray  # (N, Up) i8 — has (key,value) for interned pairs
     key_mh: np.ndarray  # (N, Uk) i8 — has key
-    key_val: np.ndarray  # (N, Uk) f32 — numeric label value (NaN if not)
+    key_val: np.ndarray  # (N, Uk) f32 — numeric label value (0 if not)
+    key_num: np.ndarray  # (N, Uk) i8 — label value parsed as integer OK
     taint_hard_mh: np.ndarray  # (N, Ut) i8 — NoSchedule|NoExecute taints
     taint_soft_mh: np.ndarray  # (N, Ut) i8 — PreferNoSchedule taints
     port_any_mh: np.ndarray  # (N, Upp) i8 — (proto,port) used by any pod
@@ -260,6 +269,9 @@ class NodeTable:
     port_spec_mh: np.ndarray  # (N, Upip) i8 — used with specific hostIP
     image_mh: np.ndarray  # (N, Ui) i8
     owner_counts: np.ndarray  # (N, Uo) f32 — matching scheduled pods per owner set
+    zone_id: np.ndarray  # (N,) i32 — interned (region, zone); -1 unlabeled
+    zone_valid: np.ndarray  # (Z,) bool — static zone-universe size carrier
+    avoid_mh: np.ndarray  # (N, Uu) i8 — preferAvoidPods owner UIDs
     ready: np.ndarray  # (N,) bool
     schedulable: np.ndarray  # (N,) bool — NOT spec.unschedulable
     mem_pressure: np.ndarray  # (N,) bool
@@ -284,6 +296,10 @@ class PodTable:
     port_spec_pip: np.ndarray  # (P, Upip) i8
     image_mh: np.ndarray  # (P, Ui) i8
     owner_id: np.ndarray  # (P,) i32, -1 = no owning service/controller
+    owner_uid_id: np.ndarray  # (P,) i32, -1 = no controller ownerRef
+    #: which owner sets this pod's labels match — placing the pod bumps
+    #: those columns of NodeTable.owner_counts (device-side spread update)
+    owner_match_mh: np.ndarray  # (P, Uo) i8
     order: np.ndarray  # (P,) i32 — original index of each row (sort tracking)
 
 
@@ -317,6 +333,18 @@ class SelectorTables:
     tol_hard_mh: np.ndarray  # (Stol, Ut) i8 — taint ids tolerated (hard effects)
     tol_soft_mh: np.ndarray  # (Stol, Ut) i8 — PreferNoSchedule taint ids tolerated
     image_sizes: np.ndarray  # (Ui,) f32
+
+
+def _matching_owner_sets(u: Universe, pod: Pod) -> List[int]:
+    """Owner-set ids whose (namespace, selectors) match this pod — the
+    single source of truth for SelectorSpread matching, used for both
+    NodeTable.owner_counts and PodTable.owner_match_mh (which the
+    assignment usage updates assume are computed identically)."""
+    return [
+        o
+        for o, (ns, sels) in enumerate(u.owner_set_items)
+        if ns == pod.namespace and all(s.matches(pod.labels) for s in sels)
+    ]
 
 
 def _pad_cols(a: np.ndarray, width: int) -> np.ndarray:
@@ -364,6 +392,8 @@ class SnapshotPacker:
             iid = u.images.intern(img)
             if iid == len(u.image_sizes):
                 u.image_sizes.append(0.0)
+        if pod.owner_uid:
+            u.owner_uids.intern(pod.owner_uid)
         self._pod_refs[pod.key()] = refs
         return refs
 
@@ -389,6 +419,7 @@ class SnapshotPacker:
             "Upip": bucket_size(len(u.ports_pip)),
             "Ui": bucket_size(len(u.images)),
             "Uo": bucket_size(len(u.owner_sets)),
+            "Uu": bucket_size(len(u.owner_uids)),
         }
 
     # -- nodes -------------------------------------------------------------
@@ -412,7 +443,8 @@ class SnapshotPacker:
         nonzero_req = np.zeros((n, 2), np.float32)
         pair_mh = np.zeros((n, w["Up"]), np.int8)
         key_mh = np.zeros((n, w["Uk"]), np.int8)
-        key_val = np.full((n, w["Uk"]), np.nan, np.float32)
+        key_val = np.zeros((n, w["Uk"]), np.float32)
+        key_num = np.zeros((n, w["Uk"]), np.int8)
         taint_hard = np.zeros((n, w["Ut"]), np.int8)
         taint_soft = np.zeros((n, w["Ut"]), np.int8)
         port_any = np.zeros((n, w["Upp"]), np.int8)
@@ -420,6 +452,8 @@ class SnapshotPacker:
         port_spec = np.zeros((n, w["Upip"]), np.int8)
         image_mh = np.zeros((n, w["Ui"]), np.int8)
         owner_counts = np.zeros((n, w["Uo"]), np.float32)
+        zone_id = np.full((n,), -1, np.int32)
+        avoid_mh = np.zeros((n, w["Uu"]), np.int8)
         ready = np.zeros((n,), bool)
         schedulable = np.zeros((n,), bool)
         mem_p = np.zeros((n,), bool)
@@ -439,10 +473,11 @@ class SnapshotPacker:
                 ki = u.label_keys.lookup(k)
                 if ki >= 0:
                     key_mh[i, ki] = 1
-                    try:
+                    # strict integer syntax like Go strconv.ParseInt —
+                    # Python int() would accept "1_0"/" 10 "/unicode digits
+                    if _GO_INT_RE.match(v):
                         key_val[i, ki] = float(int(v))
-                    except ValueError:
-                        pass
+                        key_num[i, ki] = 1
             for t in nd.taints:
                 ti = u.intern_taint(t.key, t.value, t.effect)
                 if t.effect in (EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE):
@@ -451,6 +486,13 @@ class SnapshotPacker:
                     taint_soft[i, ti] = 1
             for img, size in nd.images.items():
                 image_mh[i, u.intern_image(img, size)] = 1
+            zk = nd.zone_key()
+            if zk is not None:
+                zone_id[i] = u.zones.intern(zk)
+            for uid in nd.prefer_avoid_owner_uids:
+                ui = u.owner_uids.lookup(uid)
+                if ui >= 0:
+                    avoid_mh[i, ui] = 1
             ready[i] = nd.conditions.ready
             schedulable[i] = not nd.unschedulable
             mem_p[i] = nd.conditions.memory_pressure
@@ -475,13 +517,11 @@ class SnapshotPacker:
                     port_wild[i, ppi] = 1
                 else:
                     port_spec[i, u.ports_pip.intern((proto, ip, port))] = 1
-            oid = self._pod_refs.get(p.key(), (-1, -1, -1, -1))[3]
             # owner_counts: for SelectorSpread we need, per owner-set, how
             # many *matching* scheduled pods sit on each node. A scheduled
             # pod contributes to owner set `o` if it matches o's selectors.
-            for o, (ns, sels) in enumerate(u.owner_set_items):
-                if ns == p.namespace and all(s.matches(p.labels) for s in sels):
-                    owner_counts[i, o] += 1
+            for o in _matching_owner_sets(u, p):
+                owner_counts[i, o] += 1
 
         return NodeTable(
             n=n,
@@ -492,6 +532,7 @@ class SnapshotPacker:
             pair_mh=pair_mh,
             key_mh=key_mh,
             key_val=key_val,
+            key_num=key_num,
             taint_hard_mh=taint_hard,
             taint_soft_mh=taint_soft,
             port_any_mh=port_any,
@@ -499,6 +540,11 @@ class SnapshotPacker:
             port_spec_mh=port_spec,
             image_mh=image_mh,
             owner_counts=owner_counts,
+            zone_id=zone_id,
+            zone_valid=(
+                np.arange(bucket_size(max(len(u.zones), 1))) < len(u.zones)
+            ),
+            avoid_mh=avoid_mh,
             ready=ready,
             schedulable=schedulable,
             mem_pressure=mem_p,
@@ -527,6 +573,8 @@ class SnapshotPacker:
         port_spec_pip = np.zeros((n, w["Upip"]), np.int8)
         image_mh = np.zeros((n, w["Ui"]), np.int8)
         owner = np.full((n,), -1, np.int32)
+        owner_uid = np.full((n,), -1, np.int32)
+        owner_match = np.zeros((n, w["Uo"]), np.int8)
 
         for i, p in enumerate(pods):
             refs = self.intern_pod(p)
@@ -547,6 +595,12 @@ class SnapshotPacker:
                 ii = u.images.lookup(img)
                 if ii >= 0:
                     image_mh[i, ii] = 1
+            if p.owner_uid:
+                # lookup, not intern: widths are frozen for this pack; the
+                # uid was interned on arrival (intern_pod)
+                owner_uid[i] = u.owner_uids.lookup(p.owner_uid)
+            for o in _matching_owner_sets(u, p):
+                owner_match[i, o] = 1
 
         return PodTable(
             n=n,
@@ -562,6 +616,8 @@ class SnapshotPacker:
             port_spec_pip=port_spec_pip,
             image_mh=image_mh,
             owner_id=owner,
+            owner_uid_id=owner_uid,
+            owner_match_mh=owner_match,
             order=np.arange(n, dtype=np.int32),
         )
 

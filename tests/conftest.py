@@ -4,14 +4,23 @@ exercised without TPU hardware — the analog of the reference running its
 integration suite against an in-process apiserver instead of a real cluster
 (test/integration/util/util.go:42).
 
-Must run before any jax import, hence env mutation at conftest import time.
+The container's interpreter startup hook (PYTHONPATH sitecustomize)
+registers the remote-TPU PJRT plugin and pins jax's ``jax_platforms``
+config, so overriding the env var alone is not enough — we also update the
+config before any backend initializes. Tests must never touch the TPU
+tunnel: it is a single shared chip and a wedged claim hangs every later
+jax.devices() call in the whole container.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (import after env mutation is the point)
+
+jax.config.update("jax_platforms", "cpu")

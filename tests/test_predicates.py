@@ -1,0 +1,164 @@
+"""Differential tests: vectorized predicate kernels vs the Go-faithful
+Python oracle (tests/pyref.py) on randomized clusters — the analog of the
+reference's predicates_test.go table tests plus fuzzing."""
+
+import random
+
+import numpy as np
+
+import pyref
+from kubernetes_tpu.api.types import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    NodeCondition,
+    Resources,
+    Taint,
+    Toleration,
+)
+from kubernetes_tpu.ops.arrays import nodes_to_device, pods_to_device, selectors_to_device
+from kubernetes_tpu.ops.predicates import decode_reasons, run_predicates
+from kubernetes_tpu.snapshot import SnapshotPacker
+from kubernetes_tpu.testing import make_node, make_pod, node_affinity_required, req
+
+
+def random_cluster(rng, n_nodes=12, n_sched=20, n_pending=15):
+    zones = ["z0", "z1", "z2"]
+    nodes = []
+    for i in range(n_nodes):
+        labels = {"disk": rng.choice(["ssd", "hdd"]), "cores": str(rng.choice([4, 16, 64, "many"]))}
+        taints = []
+        if rng.random() < 0.3:
+            taints.append(Taint("dedicated", rng.choice(["gpu", "db"]), "NoSchedule"))
+        if rng.random() < 0.2:
+            taints.append(Taint("flaky", "", "PreferNoSchedule"))
+        nodes.append(
+            make_node(
+                f"n{i}",
+                cpu_milli=rng.choice([1000, 4000, 16000]),
+                memory=rng.choice([2**30, 8 * 2**30]),
+                pods=rng.choice([3, 10, 110]),
+                labels=labels,
+                zone=rng.choice(zones),
+                taints=taints,
+                unschedulable=rng.random() < 0.1,
+                conditions=NodeCondition(
+                    ready=rng.random() > 0.1,
+                    memory_pressure=rng.random() < 0.15,
+                    disk_pressure=rng.random() < 0.1,
+                    pid_pressure=rng.random() < 0.05,
+                ),
+            )
+        )
+
+    def random_pod(name, bound):
+        kw = {}
+        if rng.random() < 0.5:
+            kw["cpu_milli"] = rng.choice([0, 100, 500, 2000])
+            kw["memory"] = rng.choice([0, 2**28, 2**30])
+        if rng.random() < 0.3:
+            kw["node_selector"] = {"disk": rng.choice(["ssd", "hdd", "nvme"])}
+        if rng.random() < 0.25:
+            op = rng.choice([OP_IN, OP_NOT_IN, OP_EXISTS, OP_DOES_NOT_EXIST, OP_GT, OP_LT])
+            if op in (OP_GT, OP_LT):
+                r = req("cores", op, str(rng.choice([8, 32])))
+            elif op in (OP_EXISTS, OP_DOES_NOT_EXIST):
+                r = req(rng.choice(["disk", "gpu-type"]), op)
+            else:
+                r = req("disk", op, *rng.sample(["ssd", "hdd", "nvme"], k=rng.choice([1, 2])))
+            kw["affinity"] = node_affinity_required([r])
+        if rng.random() < 0.3:
+            kw["tolerations"] = [
+                Toleration(
+                    key="dedicated",
+                    operator=rng.choice(["Equal", "Exists"]),
+                    value=rng.choice(["gpu", "db"]),
+                    effect=rng.choice(["NoSchedule", ""]),
+                )
+            ]
+        if rng.random() < 0.3:
+            kw["host_ports"] = [("TCP", rng.choice(["", "10.0.0.1"]), rng.choice([80, 8080]))]
+        if bound:
+            kw["node_name"] = f"n{rng.randrange(n_nodes)}"
+        elif rng.random() < 0.1:
+            kw["node_name"] = f"n{rng.randrange(n_nodes)}"  # pre-pinned pending pod
+        return make_pod(name, **kw)
+
+    scheduled = [random_pod(f"s{i}", True) for i in range(n_sched)]
+    pending = [random_pod(f"p{i}", False) for i in range(n_pending)]
+    return nodes, scheduled, pending
+
+
+def oracle_mask(nodes, scheduled, pending):
+    by_node = {nd.name: [] for nd in nodes}
+    for p in scheduled:
+        if p.node_name in by_node:
+            by_node[p.node_name].append(p)
+    out = np.zeros((len(pending), len(nodes)), bool)
+    for i, pod in enumerate(pending):
+        for j, nd in enumerate(nodes):
+            out[i, j] = pyref.feasible(pod, nd, by_node[nd.name])
+    return out
+
+
+def device_mask(nodes, scheduled, pending):
+    pk = SnapshotPacker()
+    for p in list(scheduled) + list(pending):
+        pk.intern_pod(p)
+    nt = pk.pack_nodes(nodes, scheduled)
+    pt = pk.pack_pods(pending)
+    st = pk.pack_selector_tables()
+    res = run_predicates(pods_to_device(pt), nodes_to_device(nt), selectors_to_device(st))
+    mask = np.asarray(res.mask)[: len(pending), : len(nodes)]
+    reasons = np.asarray(res.reasons)[: len(pending), : len(nodes)]
+    return mask, reasons
+
+
+def test_differential_random_clusters():
+    for seed in range(12):
+        rng = random.Random(seed)
+        nodes, scheduled, pending = random_cluster(rng)
+        want = oracle_mask(nodes, scheduled, pending)
+        got, reasons = device_mask(nodes, scheduled, pending)
+        if not (got == want).all():
+            i, j = np.argwhere(got != want)[0]
+            raise AssertionError(
+                f"seed {seed}: pod {pending[i].name} vs node {nodes[j].name}: "
+                f"device={got[i, j]} oracle={want[i, j]} "
+                f"reasons={decode_reasons(int(reasons[i, j]))}\n"
+                f"pod={pending[i]}\nnode={nodes[j]}"
+            )
+
+
+def test_reason_codes_surface():
+    nodes = [make_node("a", cpu_milli=100, pods=10)]
+    pod = make_pod("p", cpu_milli=500)
+    got, reasons = device_mask(nodes, [], [pod])
+    assert not got[0, 0]
+    assert decode_reasons(int(reasons[0, 0])) == ("PodFitsResources",)
+
+
+def test_taint_tolerated_ok():
+    t = Taint("dedicated", "gpu", "NoSchedule")
+    nodes = [make_node("a", taints=[t])]
+    tol = Toleration(key="dedicated", operator="Equal", value="gpu", effect="NoSchedule")
+    ok_pod = make_pod("ok", tolerations=[tol])
+    bad_pod = make_pod("bad")
+    got, reasons = device_mask(nodes, [], [ok_pod, bad_pod])
+    assert got[0, 0]
+    assert not got[1, 0]
+    assert "PodToleratesNodeTaints" in decode_reasons(int(reasons[1, 0]))
+
+
+def test_port_wildcard_conflicts():
+    sched = make_pod("s", node_name="a", host_ports=[("TCP", "", 80)])
+    nodes = [make_node("a"), make_node("b")]
+    specific = make_pod("p1", host_ports=[("TCP", "10.0.0.1", 80)])
+    other_port = make_pod("p2", host_ports=[("TCP", "", 81)])
+    got, _ = device_mask(nodes, [sched], [specific, other_port])
+    assert not got[0, 0]  # specific IP conflicts with wildcard use
+    assert got[0, 1]
+    assert got[1, 0]  # different port fine

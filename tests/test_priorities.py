@@ -1,0 +1,172 @@
+"""Differential tests: vectorized priority kernels vs the Go-faithful
+oracle — analog of priorities' *_test.go table tests plus fuzzing."""
+
+import random
+
+import numpy as np
+
+import pyref
+from kubernetes_tpu.api.types import LabelSelector, Taint, Toleration
+from kubernetes_tpu.ops.arrays import nodes_to_device, pods_to_device, selectors_to_device
+from kubernetes_tpu.ops import priorities as prio
+from kubernetes_tpu.ops.predicates import run_predicates
+from kubernetes_tpu.snapshot import SnapshotPacker
+from kubernetes_tpu.testing import make_node, make_pod, node_affinity_preferred, req
+from test_predicates import random_cluster
+
+
+def build(nodes, scheduled, pending):
+    pk = SnapshotPacker()
+    for p in list(scheduled) + list(pending):
+        pk.intern_pod(p)
+    nt = pk.pack_nodes(nodes, scheduled)
+    pt = pk.pack_pods(pending)
+    st = pk.pack_selector_tables()
+    dn, dp, ds = nodes_to_device(nt), pods_to_device(pt), selectors_to_device(st)
+    mask = run_predicates(dp, dn, ds).mask
+    return dn, dp, ds, mask
+
+
+def crop(a, pending, nodes):
+    return np.asarray(a)[: len(pending), : len(nodes)]
+
+
+def by_node(nodes, scheduled):
+    d = {nd.name: [] for nd in nodes}
+    for p in scheduled:
+        if p.node_name in d:
+            d[p.node_name].append(p)
+    return d
+
+
+def assert_matches(got, want, pending, nodes, mask, name):
+    want = np.asarray(want, np.float64)
+    ok = (np.abs(got - want) < 1e-6) | ~mask
+    if not ok.all():
+        i, j = np.argwhere(~ok)[0]
+        raise AssertionError(
+            f"{name}: pod {pending[i].name} node {nodes[j].name}: "
+            f"device={got[i, j]} oracle={want[i, j]}\npod={pending[i]}\nnode={nodes[j]}"
+        )
+
+
+def test_resource_allocation_family_differential():
+    for seed in range(6):
+        rng = random.Random(100 + seed)
+        nodes, scheduled, pending = random_cluster(rng, n_nodes=10, n_sched=25, n_pending=10)
+        dn, dp, ds, mask = build(nodes, scheduled, pending)
+        npods = by_node(nodes, scheduled)
+        m = crop(mask, pending, nodes)
+        for name, kernel, oracle in [
+            ("least", prio.least_requested, pyref.least_requested_score),
+            ("most", prio.most_requested, pyref.most_requested_score),
+            ("balanced", prio.balanced_allocation, pyref.balanced_allocation_score),
+        ]:
+            got = crop(kernel(dp, dn, ds, mask), pending, nodes)
+            want = [
+                [oracle(p, nd, npods[nd.name]) for nd in nodes] for p in pending
+            ]
+            assert_matches(got, want, pending, nodes, m, name)
+
+
+def test_taint_toleration_differential():
+    for seed in range(6):
+        rng = random.Random(200 + seed)
+        nodes, scheduled, pending = random_cluster(rng, n_nodes=10, n_sched=5, n_pending=10)
+        dn, dp, ds, mask = build(nodes, scheduled, pending)
+        m = crop(mask, pending, nodes)
+        got = crop(prio.taint_toleration(dp, dn, ds, mask), pending, nodes)
+        want = pyref.taint_toleration_scores(pending, nodes, m)
+        assert_matches(got, want, pending, nodes, m, "taint_toleration")
+
+
+def test_node_affinity_preferred_differential():
+    rng = random.Random(7)
+    nodes = [
+        make_node(f"n{i}", labels={"disk": rng.choice(["ssd", "hdd"]), "tier": rng.choice(["a", "b"])})
+        for i in range(8)
+    ]
+    pending = []
+    for i in range(8):
+        aff = node_affinity_preferred(
+            (rng.choice([1, 5, 50]), [req("disk", "In", "ssd")]),
+            (rng.choice([1, 10]), [req("tier", "In", rng.choice(["a", "b"]))]),
+        )
+        pending.append(make_pod(f"p{i}", affinity=aff))
+    pending.append(make_pod("noaff"))
+    dn, dp, ds, mask = build(nodes, [], pending)
+    m = crop(mask, pending, nodes)
+    got = crop(prio.node_affinity(dp, dn, ds, mask), pending, nodes)
+    want = pyref.node_affinity_scores(pending, nodes, m)
+    assert_matches(got, want, pending, nodes, m, "node_affinity")
+
+
+def test_selector_spread_differential():
+    for seed in range(5):
+        rng = random.Random(300 + seed)
+        svc = LabelSelector(match_labels={"app": "web"})
+        nodes = [
+            make_node(f"n{i}", zone=rng.choice(["z0", "z1", None]))
+            for i in range(9)
+        ]
+        scheduled = [
+            make_pod(
+                f"s{i}",
+                node_name=f"n{rng.randrange(9)}",
+                labels={"app": rng.choice(["web", "db"])},
+            )
+            for i in range(15)
+        ]
+        pending = [
+            make_pod(f"p{i}", labels={"app": "web"}, spread_selectors=(svc,))
+            for i in range(4)
+        ] + [make_pod("plain")]
+        dn, dp, ds, mask = build(nodes, scheduled, pending)
+        m = crop(mask, pending, nodes)
+        got = crop(prio.selector_spread(dp, dn, ds, mask), pending, nodes)
+        want = pyref.selector_spread_scores(pending, nodes, by_node(nodes, scheduled), m)
+        assert_matches(got, want, pending, nodes, m, "selector_spread")
+
+
+def test_image_locality_differential():
+    rng = random.Random(9)
+    imgs = {f"img{k}": rng.choice([10, 50, 300, 900]) * 1024 * 1024 for k in range(6)}
+    nodes = [
+        make_node(f"n{i}", images={k: v for k, v in imgs.items() if rng.random() < 0.5})
+        for i in range(8)
+    ]
+    pending = [
+        make_pod(f"p{i}", images=tuple(rng.sample(sorted(imgs), k=rng.choice([1, 2, 3]))))
+        for i in range(6)
+    ]
+    dn, dp, ds, mask = build(nodes, [], pending)
+    m = crop(mask, pending, nodes)
+    got = crop(prio.image_locality(dp, dn, ds, mask), pending, nodes)
+    want = pyref.image_locality_scores(pending, nodes)
+    assert_matches(got, want, pending, nodes, m, "image_locality")
+
+
+def test_node_prefer_avoid_differential():
+    nodes = [
+        make_node("a", prefer_avoid_owner_uids=("rc-1",)),
+        make_node("b"),
+    ]
+    pending = [
+        make_pod("p1", owner_uid="rc-1"),
+        make_pod("p2", owner_uid="rc-2"),
+        make_pod("p3"),
+    ]
+    dn, dp, ds, mask = build(nodes, [], pending)
+    m = crop(mask, pending, nodes)
+    got = crop(prio.node_prefer_avoid(dp, dn, ds, mask), pending, nodes)
+    want = pyref.prefer_avoid_scores(pending, nodes)
+    assert_matches(got, want, pending, nodes, m, "prefer_avoid")
+
+
+def test_weighted_sum_runs():
+    rng = random.Random(11)
+    nodes, scheduled, pending = random_cluster(rng, n_nodes=6, n_sched=8, n_pending=5)
+    dn, dp, ds, mask = build(nodes, scheduled, pending)
+    total = prio.run_priorities(dp, dn, ds, mask)
+    assert total.shape == mask.shape
+    assert np.isfinite(np.asarray(total)).all()
