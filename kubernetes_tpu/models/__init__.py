@@ -1,0 +1,1 @@
+from kubernetes_tpu.models import cluster  # noqa: F401

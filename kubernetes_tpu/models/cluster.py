@@ -1,0 +1,144 @@
+"""Synthetic cluster/workload generators mirroring the reference's perf
+fixtures: ``test/utils/runners.go`` node/pod strategies and the
+scheduler_perf templates (``test/integration/scheduler_perf``):
+
+- base node = 4 CPU / 32Gi / 110 pods (scheduler_test.go:49-58)
+- base pod  = 100m CPU / 500Mi (runners.go:1233 MakePodSpec)
+
+These drive unit benches, the fake-cluster E2E tests, and bench.py.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    LabelSelector,
+    Node,
+    NodeSelectorTerm,
+    Pod,
+    PreferredSchedulingTerm,
+    Requirement,
+    Resources,
+    TopologySpreadConstraint,
+)
+
+GI = 2**30
+MI = 2**20
+
+
+def base_node(name: str, zone: Optional[str] = None, labels: Optional[Dict[str, str]] = None) -> Node:
+    lab = dict(labels or {})
+    lab.setdefault("kubernetes.io/hostname", name)
+    if zone:
+        lab["failure-domain.beta.kubernetes.io/zone"] = zone
+    return Node(
+        name=name,
+        labels=lab,
+        allocatable=Resources(cpu_milli=4000, memory=32 * GI, pods=110),
+    )
+
+
+def base_pod(name: str, namespace: str = "default", **kw) -> Pod:
+    kw.setdefault("requests", Resources(cpu_milli=100, memory=500 * MI))
+    return Pod(name=name, namespace=namespace, **kw)
+
+
+def make_nodes(
+    n: int,
+    zones: int = 0,
+    label_strategy: Optional[Tuple[str, str]] = None,
+) -> List[Node]:
+    """TrivialNodePrepareStrategy / LabelNodePrepareStrategy analogs."""
+    out = []
+    for i in range(n):
+        labels = {}
+        if label_strategy:
+            labels[label_strategy[0]] = label_strategy[1]
+        zone = f"zone-{i % zones}" if zones else None
+        out.append(base_node(f"node-{i}", zone=zone, labels=labels))
+    return out
+
+
+def make_pods(
+    n: int,
+    name_prefix: str = "pod",
+    assigned_round_robin_over: int = 0,
+    rng: Optional[random.Random] = None,
+) -> List[Pod]:
+    """Uniform base pods; optionally pre-bound round-robin over nodes (the
+    'existing pods' population of BenchmarkScheduling)."""
+    out = []
+    for i in range(n):
+        p = base_pod(f"{name_prefix}-{i}")
+        if assigned_round_robin_over:
+            p.node_name = f"node-{i % assigned_round_robin_over}"
+        out.append(p)
+    return out
+
+
+def make_spread_pods(
+    n: int,
+    n_services: int,
+    name_prefix: str = "svc-pod",
+) -> List[Pod]:
+    """Pods owned by services (SelectorSpread workload): n pods spread over
+    n_services label selectors."""
+    out = []
+    for i in range(n):
+        svc = i % n_services
+        labels = {"app": f"svc-{svc}"}
+        sel = LabelSelector(match_labels=dict(labels))
+        p = base_pod(f"{name_prefix}-{i}", labels=labels)
+        p.spread_selectors = (sel,)
+        out.append(p)
+    return out
+
+
+def make_affinity_pods(
+    n: int,
+    zones: int,
+    name_prefix: str = "aff-pod",
+    rng: Optional[random.Random] = None,
+) -> List[Pod]:
+    """NodeAffinity benchmark analog (scheduler_bench_test.go:251
+    BenchmarkSchedulingNodeAffinity: pods requiring a random zone)."""
+    rng = rng or random.Random(0)
+    out = []
+    for i in range(n):
+        z = rng.randrange(zones)
+        aff = Affinity(
+            node_required=(
+                NodeSelectorTerm(
+                    (
+                        Requirement(
+                            "failure-domain.beta.kubernetes.io/zone",
+                            "In",
+                            (f"zone-{z}",),
+                        ),
+                    )
+                ),
+            )
+        )
+        p = base_pod(f"{name_prefix}-{i}")
+        p.affinity = aff
+        out.append(p)
+    return out
+
+
+def make_gang_pods(
+    n_groups: int,
+    group_size: int,
+    name_prefix: str = "gang",
+) -> List[Pod]:
+    """Gang/coscheduling workload (BASELINE config 4): groups of pods that
+    must schedule all-or-nothing."""
+    out = []
+    for g in range(n_groups):
+        for i in range(group_size):
+            p = base_pod(f"{name_prefix}-{g}-{i}")
+            p.pod_group = f"{name_prefix}-{g}"
+            out.append(p)
+    return out

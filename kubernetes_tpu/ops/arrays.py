@@ -39,6 +39,7 @@ class DeviceNodes(NamedTuple):
     zone_valid: jnp.ndarray  # (Z,) bool — static shape = padded zone count
     avoid_mh: jnp.ndarray  # (N, Uu) f32
     ready: jnp.ndarray  # (N,) bool
+    network_unavailable: jnp.ndarray  # (N,) bool
     schedulable: jnp.ndarray  # (N,) bool
     mem_pressure: jnp.ndarray  # (N,) bool
     disk_pressure: jnp.ndarray  # (N,) bool
@@ -136,6 +137,7 @@ def nodes_to_device(t: NodeTable, pad_to: int | None = None) -> DeviceNodes:
         zone_valid=jnp.asarray(t.zone_valid),
         avoid_mh=f32(t.avoid_mh),
         ready=jnp.asarray(_pad_rows(t.ready, n_pad, False)),
+        network_unavailable=jnp.asarray(_pad_rows(t.network_unavailable, n_pad, True)),
         schedulable=jnp.asarray(_pad_rows(t.schedulable, n_pad, False)),
         mem_pressure=jnp.asarray(_pad_rows(t.mem_pressure, n_pad, True)),
         disk_pressure=jnp.asarray(_pad_rows(t.disk_pressure, n_pad, True)),

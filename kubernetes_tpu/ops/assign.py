@@ -194,7 +194,7 @@ def _batch_impl(pods, nodes, sel, weights_key, max_rounds, per_node_cap):
         cap_ok = within < per_node_cap
         # one port-bearing pod per node per round (conservative, exact)
         hp_s = has_port[order2].astype(jnp.int32)
-        hp_prefix = (jnp.cumsum(hp_s) - hp_s) - (jnp.cumsum(hp_s) - hp_s)[seg_starts]
+        hp_prefix = _segment_prefix(hp_s[:, None], seg_starts)[:, 0]
         port_ok = (hp_s == 0) | (hp_prefix == 0)
         acc_s = (c_s >= 0) & fits & cap_ok & port_ok
         accepted = jnp.zeros((P,), bool).at[order2].set(acc_s)

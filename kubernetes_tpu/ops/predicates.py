@@ -141,8 +141,11 @@ def run_predicates(
         # (N,) bool fail → broadcast to all pods
         return jnp.where(fail_row[None, :], jnp.int32(1 << bit), 0)
 
-    # CheckNodeCondition (predicates.go:1625): not-ready fails all pods.
-    reasons |= nodewise(~nodes.ready, BIT["CheckNodeCondition"])
+    # CheckNodeCondition (predicates.go:1625): not-ready or
+    # network-unavailable fails all pods.
+    reasons |= nodewise(
+        ~nodes.ready | nodes.network_unavailable, BIT["CheckNodeCondition"]
+    )
     # CheckNodeUnschedulable (eventhandlers/defaults wiring; spec.unschedulable)
     reasons |= nodewise(~nodes.schedulable, BIT["CheckNodeUnschedulable"])
     # CheckNode{Disk,PID}Pressure fail for every pod (predicates.go:1605,:1615)
@@ -166,8 +169,9 @@ def run_predicates(
     taint_fail = (hard_count[None, :] - tolerated) > 0
     reasons |= jnp.where(taint_fail, jnp.int32(1 << BIT["PodToleratesNodeTaints"]), 0)
 
-    # PodFitsHost (predicates.go:916)
-    host_fail = (pods.name_req >= 0)[:, None] & (
+    # PodFitsHost (predicates.go:916). name_req: -1 = unconstrained,
+    # -2 = pinned to an unknown node (fails everywhere), >=0 = must equal.
+    host_fail = (pods.name_req != -1)[:, None] & (
         pods.name_req[:, None] != nodes.name_id[None, :]
     )
     reasons |= jnp.where(host_fail, jnp.int32(1 << BIT["PodFitsHost"]), 0)

@@ -1,0 +1,7 @@
+from kubernetes_tpu.parallel.mesh import (  # noqa: F401
+    NODE_AXIS,
+    make_mesh,
+    replicate,
+    shard_cluster,
+    shard_nodes,
+)

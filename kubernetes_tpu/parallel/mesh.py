@@ -1,0 +1,68 @@
+"""Device-mesh scale-out — the framework's replacement for everything the
+reference does to survive big clusters: the 16-goroutine fan-outs
+(``generic_scheduler.go:531,:738``), adaptive node subsampling
+(``numFeasibleNodesToFind`` ``:437``), and the single-active-scheduler
+leader-election model (scheduling itself never scales out in the
+reference; HA is active-passive, ``tools/leaderelection``).
+
+Design (SURVEY.md §2.4, BASELINE config 5): the **node axis is sharded**
+across a ``jax.sharding.Mesh``; pods and selector tables are replicated.
+Every kernel in ``ops/`` is written as plain jnp over the full arrays, so
+XLA's SPMD partitioner (GSPMD) splits the (pods x nodes) matmuls along the
+node dimension and inserts the cross-device collectives itself — per-pod
+max-reductions (NormalizeReduce, argmax host selection) become all-reduces
+riding ICI, exactly the "annotate shardings, let XLA insert collectives"
+recipe. No NCCL/MPI analog is hand-written, and none is needed.
+
+On one host this runs over ``xla_force_host_platform_device_count`` virtual
+devices; on a TPU slice the same code spans real chips; multi-host extends
+the mesh over DCN via ``jax.distributed`` initialization.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubernetes_tpu.ops.arrays import DeviceNodes, DevicePods, DeviceSelectors
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over all (or given) devices; the single axis shards nodes."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (NODE_AXIS,))
+
+
+def shard_nodes(nodes: DeviceNodes, mesh: Mesh) -> DeviceNodes:
+    """Place node-axis arrays sharded along the mesh; universe-shaped arrays
+    (zone_valid) replicated. Node buckets are powers of two, so any
+    power-of-two device count divides them."""
+    n = nodes.allocatable.shape[0]
+    sharded = NamedSharding(mesh, P(NODE_AXIS))
+    replicated = NamedSharding(mesh, P())
+
+    def place(a):
+        spec = sharded if a.ndim >= 1 and a.shape[0] == n else replicated
+        if a.ndim >= 2 and a.shape[0] == n:
+            spec = NamedSharding(mesh, P(NODE_AXIS, *([None] * (a.ndim - 1))))
+        return jax.device_put(a, spec)
+
+    return DeviceNodes(*[place(f) for f in nodes])
+
+
+def replicate(tree, mesh: Mesh):
+    """Replicate a pytree (pods, selector tables) across the mesh."""
+    spec = NamedSharding(mesh, P())
+    return jax.tree.map(lambda a: jax.device_put(a, spec), tree)
+
+
+def shard_cluster(
+    pods: DevicePods, nodes: DeviceNodes, sel: DeviceSelectors, mesh: Mesh
+):
+    """One-call placement for a scheduling cycle's inputs."""
+    return replicate(pods, mesh), shard_nodes(nodes, mesh), replicate(sel, mesh)

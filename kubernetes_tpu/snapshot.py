@@ -137,7 +137,13 @@ class Universe:
         key_id = self.label_keys.intern(r.key)
         lit = 0.0
         if op in (XOP_GT, XOP_LT):
-            lit = float(r.values[0]) if r.values else 0.0
+            v = r.values[0] if r.values else ""
+            if not _GO_INT_RE.match(v):
+                # unparsable Gt/Lt literal: the reference's selector
+                # conversion errors and the term matches nothing — encode
+                # as an unsatisfiable In-set, never crash the pack
+                return CompiledExpr(op=XOP_IN, pair_ids=())
+            lit = float(int(v))
         return CompiledExpr(op=op, key_id=key_id, literal=lit)
 
     def _compile_term(self, term: NodeSelectorTerm) -> List[CompiledExpr]:
@@ -161,7 +167,16 @@ class Universe:
         ]
         if affinity.node_required:
             for t in affinity.node_required:
-                terms.append(base + self._compile_term(t))
+                # an empty NodeSelectorTerm matches NO objects (apimachinery
+                # helpers: "nil or empty term matches no objects") — skip it
+                # rather than letting `base` alone stand in for the branch
+                if t.match_expressions:
+                    terms.append(base + self._compile_term(t))
+            if not terms:
+                # required affinity present but every term empty: the pod
+                # can match nothing — emit one unsatisfiable term (empty
+                # In-set evaluates false on every node)
+                terms.append([CompiledExpr(op=XOP_IN, pair_ids=())])
         elif base:
             terms.append(base)
         if not terms:
@@ -273,6 +288,7 @@ class NodeTable:
     zone_valid: np.ndarray  # (Z,) bool — static zone-universe size carrier
     avoid_mh: np.ndarray  # (N, Uu) i8 — preferAvoidPods owner UIDs
     ready: np.ndarray  # (N,) bool
+    network_unavailable: np.ndarray  # (N,) bool
     schedulable: np.ndarray  # (N,) bool — NOT spec.unschedulable
     mem_pressure: np.ndarray  # (N,) bool
     disk_pressure: np.ndarray  # (N,) bool
@@ -347,14 +363,6 @@ def _matching_owner_sets(u: Universe, pod: Pod) -> List[int]:
     ]
 
 
-def _pad_cols(a: np.ndarray, width: int) -> np.ndarray:
-    if a.shape[1] == width:
-        return a
-    out = np.zeros((a.shape[0], width), a.dtype)
-    out[:, : a.shape[1]] = a
-    return out
-
-
 class SnapshotPacker:
     """Packs API objects into the columnar tables. The driver calls
     ``intern_pod`` on arrival (so universes are stable by pack time), then
@@ -366,13 +374,15 @@ class SnapshotPacker:
 
     def __init__(self, universe: Optional[Universe] = None) -> None:
         self.u = universe or Universe()
-        self._pod_refs: Dict[str, Tuple[int, int, int, int]] = {}
+        self._pod_refs: Dict[tuple, Tuple[int, int, int, int]] = {}
 
     # -- interning ---------------------------------------------------------
 
     def intern_pod(self, pod: Pod) -> Tuple[int, int, int, int]:
-        """Returns (selprog, prefprog, tolset, owner) ids, cached per pod key."""
-        cached = self._pod_refs.get(pod.key())
+        """Returns (selprog, prefprog, tolset, owner) ids, cached per pod
+        identity (namespace/name/uid — uid so a deleted-and-recreated pod
+        with different spec is re-interned)."""
+        cached = self._pod_refs.get((pod.key(), pod.uid))
         if cached is not None:
             return cached
         u = self.u
@@ -394,7 +404,7 @@ class SnapshotPacker:
                 u.image_sizes.append(0.0)
         if pod.owner_uid:
             u.owner_uids.intern(pod.owner_uid)
-        self._pod_refs[pod.key()] = refs
+        self._pod_refs[(pod.key(), pod.uid)] = refs
         return refs
 
     def intern_node(self, node: Node) -> int:
@@ -404,6 +414,8 @@ class SnapshotPacker:
             u.intern_taint(t.key, t.value, t.effect)
         for img, size in node.images.items():
             u.intern_image(img, size)
+        for name in node.allocatable.scalars:
+            u.scalar_resources.intern(name)
         return nid
 
     # -- widths ------------------------------------------------------------
@@ -455,6 +467,7 @@ class SnapshotPacker:
         zone_id = np.full((n,), -1, np.int32)
         avoid_mh = np.zeros((n, w["Uu"]), np.int8)
         ready = np.zeros((n,), bool)
+        net_unavail = np.zeros((n,), bool)
         schedulable = np.zeros((n,), bool)
         mem_p = np.zeros((n,), bool)
         disk_p = np.zeros((n,), bool)
@@ -494,6 +507,7 @@ class SnapshotPacker:
                 if ui >= 0:
                     avoid_mh[i, ui] = 1
             ready[i] = nd.conditions.ready
+            net_unavail[i] = nd.conditions.network_unavailable
             schedulable[i] = not nd.unschedulable
             mem_p[i] = nd.conditions.memory_pressure
             disk_p[i] = nd.conditions.disk_pressure
@@ -546,6 +560,7 @@ class SnapshotPacker:
             ),
             avoid_mh=avoid_mh,
             ready=ready,
+            network_unavailable=net_unavail,
             schedulable=schedulable,
             mem_pressure=mem_p,
             disk_pressure=disk_p,
@@ -582,7 +597,11 @@ class SnapshotPacker:
             req[i] = self.u.resource_vector(p.effective_requests(), R)
             nonzero[i] = p.nonzero_requests()
             if p.node_name:
-                name_req[i] = u.node_names.lookup(p.node_name)
+                nid = u.node_names.lookup(p.node_name)
+                # -2 = pinned to a node that does not exist: PodFitsHost
+                # (predicates.go:916) must fail on every node, unlike -1
+                # ("no requirement")
+                name_req[i] = nid if nid >= 0 else -2
             priority[i] = p.priority
             for proto, ip, port in p.host_ports:
                 ppi = u.ports_pp.intern((proto, port))

@@ -62,15 +62,20 @@ def pod_match_node_selector(pod: Pod, node: Node) -> bool:
             return False
     terms = pod.affinity.node_required
     if terms:
-        return any(
-            _match_expressions(node, t.match_expressions) for t in terms
-        )
+        return any(_term_matches(node, t) for t in terms)
     return True
 
 
 def pod_fits_host(pod: Pod, node: Node) -> bool:
     """predicates.go:916 PodFitsHost."""
     return not pod.node_name or pod.node_name == node.name
+
+
+def _term_matches(node: Node, term) -> bool:
+    # empty term matches no objects (apimachinery helpers semantics)
+    if not term.match_expressions:
+        return False
+    return _match_expressions(node, term.match_expressions)
 
 
 def pod_fits_resources(pod: Pod, node: Node, node_pods: Sequence[Pod]) -> bool:
@@ -129,6 +134,7 @@ def pod_fits_host_ports(pod: Pod, node_pods: Sequence[Pod]) -> bool:
 def feasible(pod: Pod, node: Node, node_pods: Sequence[Pod]) -> bool:
     return (
         node.conditions.ready
+        and not node.conditions.network_unavailable
         and not node.unschedulable
         and not node.conditions.disk_pressure
         and not node.conditions.pid_pressure

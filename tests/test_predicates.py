@@ -162,3 +162,54 @@ def test_port_wildcard_conflicts():
     assert not got[0, 0]  # specific IP conflicts with wildcard use
     assert got[0, 1]
     assert got[1, 0]  # different port fine
+
+
+def test_empty_affinity_term_matches_nothing():
+    # apimachinery: an empty required NodeSelectorTerm matches NO objects
+    from kubernetes_tpu.api.types import Affinity, NodeSelectorTerm
+
+    nodes = [make_node("a")]
+    pod = make_pod("p", affinity=Affinity(node_required=(NodeSelectorTerm(()),)))
+    got, reasons = device_mask(nodes, [], [pod])
+    assert not got[0, 0]
+    assert "PodMatchNodeSelector" in decode_reasons(int(reasons[0, 0]))
+
+
+def test_pinned_to_unknown_node_fails_everywhere():
+    nodes = [make_node("a"), make_node("b")]
+    pod = make_pod("p", node_name="deleted-node")
+    got, reasons = device_mask(nodes, [], [pod])
+    assert not got.any()
+    assert "PodFitsHost" in decode_reasons(int(reasons[0, 0]))
+
+
+def test_network_unavailable_fails_all_pods():
+    nodes = [make_node("a", conditions=NodeCondition(ready=True, network_unavailable=True)),
+             make_node("b")]
+    pod = make_pod("p", cpu_milli=100)
+    got, reasons = device_mask(nodes, [], [pod])
+    assert not got[0, 0] and got[0, 1]
+    assert "CheckNodeCondition" in decode_reasons(int(reasons[0, 0]))
+
+
+def test_node_declared_scalar_resource_packs():
+    # node declares an extended resource no pod requests: must not crash,
+    # and a pod requesting it schedules only there
+    gpu_node = make_node("gpu")
+    gpu_node.allocatable.scalars["example.com/gpu"] = 4
+    plain = make_node("plain")
+    wants_gpu = make_pod("g", scalars={"example.com/gpu": 1})
+    plain_pod = make_pod("p", cpu_milli=100)
+    got, _ = device_mask([gpu_node, plain], [], [wants_gpu, plain_pod])
+    assert got[0, 0] and not got[0, 1]
+    assert got[1, 0] and got[1, 1]
+
+
+def test_malformed_gt_literal_matches_nothing():
+    from kubernetes_tpu.api.types import OP_GT
+
+    nodes = [make_node("a", labels={"cores": "64"})]
+    pod = make_pod("p", affinity=node_affinity_required([req("cores", OP_GT, "lots")]))
+    got, reasons = device_mask(nodes, [], [pod])
+    assert not got[0, 0]
+    assert "PodMatchNodeSelector" in decode_reasons(int(reasons[0, 0]))
