@@ -128,6 +128,60 @@ def make_affinity_pods(
     return out
 
 
+def make_anti_affinity_pods(
+    n: int,
+    n_groups: int = 8,
+    topology_key: str = "kubernetes.io/hostname",
+    name_prefix: str = "anti-pod",
+) -> List[Pod]:
+    """BenchmarkSchedulingPodAntiAffinity analog
+    (scheduler_bench_test.go:71): pods with required anti-affinity against
+    their own group label on a topology key — at most one pod per group per
+    topology domain."""
+    from kubernetes_tpu.api.types import PodAffinityTerm
+
+    out = []
+    for i in range(n):
+        g = i % max(n_groups, 1)
+        labels = {"anti-group": f"g{g}"}
+        p = base_pod(f"{name_prefix}-{i}", labels=labels)
+        p.affinity = Affinity(
+            pod_anti_affinity_required=(
+                PodAffinityTerm(
+                    label_selector=LabelSelector(match_labels=dict(labels)),
+                    topology_key=topology_key,
+                ),
+            )
+        )
+        out.append(p)
+    return out
+
+
+def make_spread_constraint_pods(
+    n: int,
+    topology_key: str = "failure-domain.beta.kubernetes.io/zone",
+    max_skew: int = 1,
+    hard: bool = True,
+    name_prefix: str = "spread-pod",
+) -> List[Pod]:
+    """EvenPodsSpread workload: every pod carries one spread constraint over
+    ``topology_key`` against the shared app label."""
+    out = []
+    for i in range(n):
+        labels = {"spread-app": "app"}
+        p = base_pod(f"{name_prefix}-{i}", labels=labels)
+        p.topology_spread = (
+            TopologySpreadConstraint(
+                max_skew=max_skew,
+                topology_key=topology_key,
+                when_unsatisfiable="DoNotSchedule" if hard else "ScheduleAnyway",
+                label_selector=LabelSelector(match_labels=dict(labels)),
+            ),
+        )
+        out.append(p)
+    return out
+
+
 def make_gang_pods(
     n_groups: int,
     group_size: int,

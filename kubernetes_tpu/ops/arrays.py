@@ -11,7 +11,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 import numpy as np
 
-from kubernetes_tpu.snapshot import NodeTable, PodTable, SelectorTables
+from kubernetes_tpu.snapshot import NodeTable, PodTable, SelectorTables, TopologyTables
 from kubernetes_tpu.utils.interner import bucket_size
 
 
@@ -44,6 +44,11 @@ class DeviceNodes(NamedTuple):
     mem_pressure: jnp.ndarray  # (N,) bool
     disk_pressure: jnp.ndarray  # (N,) bool
     pid_pressure: jnp.ndarray  # (N,) bool
+    topo_pair_id: jnp.ndarray  # (N, K) i32 — -1 = key absent
+    matcher_counts: jnp.ndarray  # (N, M) f32
+    anti_counts: jnp.ndarray  # (N, Ua) f32
+    sym_counts: jnp.ndarray  # (N, Us) f32
+    aff_pod_count: jnp.ndarray  # (N,) f32
 
     @property
     def n(self) -> int:
@@ -67,6 +72,15 @@ class DevicePods(NamedTuple):
     owner_uid_id: jnp.ndarray  # (P,) i32
     owner_match_mh: jnp.ndarray  # (P, Uo) f32
     order: jnp.ndarray  # (P,) i32
+    matcher_mh: jnp.ndarray  # (P, M) f32
+    affprog_id: jnp.ndarray  # (P,) i32
+    prefaffprog_id: jnp.ndarray  # (P,) i32
+    spread_hard_id: jnp.ndarray  # (P,) i32
+    spread_soft_id: jnp.ndarray  # (P,) i32
+    self_aff_match: jnp.ndarray  # (P,) bool
+    anti_term_mh: jnp.ndarray  # (P, Ua) f32
+    sym_term_mh: jnp.ndarray  # (P, Us) f32
+    has_aff: jnp.ndarray  # (P,) bool
 
     @property
     def n(self) -> int:
@@ -101,6 +115,53 @@ class DeviceSelectors(NamedTuple):
     # counts into segment reductions (ints in a pytree would be traced).
     prog_valid: jnp.ndarray  # (G,) bool
     p_prog_valid: jnp.ndarray  # (Gp,) bool
+
+
+class DeviceTopology(NamedTuple):
+    """Padded inter-pod-affinity / topology-spread term tables. Row tables
+    carry valid masks; padded rows point their ``*_prog`` at the dump
+    program (index = padded program count) so segment reductions stay
+    neutral. ``*_m_onehot`` matrices turn matcher-id gathers into MXU
+    matmuls against the (N, M) / (P, M) count matrices."""
+
+    pair_valid: jnp.ndarray  # (Utp,) bool
+    # required (anti)affinity rows
+    ra_valid: jnp.ndarray  # (Ta,) bool
+    ra_prog: jnp.ndarray  # (Ta,) i32 — pad rows -> Ga (dump)
+    ra_key: jnp.ndarray  # (Ta,) i32
+    ra_m_onehot: jnp.ndarray  # (Ta, M) f32
+    ra_anti: jnp.ndarray  # (Ta,) bool
+    ga_valid: jnp.ndarray  # (Ga,) bool
+    # preferred rows
+    rp_valid: jnp.ndarray
+    rp_prog: jnp.ndarray
+    rp_key: jnp.ndarray
+    rp_m_onehot: jnp.ndarray
+    rp_w: jnp.ndarray  # (Tp,) f32 signed, pad 0
+    gp_valid: jnp.ndarray  # (Gp,) bool
+    # anti-term columns
+    at_key: jnp.ndarray  # (Ua,) i32
+    at_m_onehot: jnp.ndarray  # (Ua, M) f32
+    # sym-term columns
+    st_key: jnp.ndarray  # (Us,) i32
+    st_m_onehot: jnp.ndarray  # (Us, M) f32
+    st_w: jnp.ndarray  # (Us,) f32
+    st_hard: jnp.ndarray  # (Us,) f32
+    # spread hard
+    sh_valid: jnp.ndarray  # (Tsh,) bool
+    sh_prog: jnp.ndarray  # (Tsh,) i32 — pad -> Gsh
+    sh_key: jnp.ndarray
+    sh_m_onehot: jnp.ndarray  # (Tsh, M)
+    sh_skew: jnp.ndarray  # (Tsh,) f32
+    shp_selprog: jnp.ndarray  # (Gsh,) i32, -1 = unconstrained
+    shp_valid: jnp.ndarray  # (Gsh,) bool
+    # spread soft
+    ss_valid: jnp.ndarray
+    ss_prog: jnp.ndarray
+    ss_key: jnp.ndarray
+    ss_m_onehot: jnp.ndarray
+    ssp_selprog: jnp.ndarray
+    ssp_valid: jnp.ndarray
 
 
 def _pad_rows(a: np.ndarray, rows: int, fill=0) -> np.ndarray:
@@ -142,6 +203,11 @@ def nodes_to_device(t: NodeTable, pad_to: int | None = None) -> DeviceNodes:
         mem_pressure=jnp.asarray(_pad_rows(t.mem_pressure, n_pad, True)),
         disk_pressure=jnp.asarray(_pad_rows(t.disk_pressure, n_pad, True)),
         pid_pressure=jnp.asarray(_pad_rows(t.pid_pressure, n_pad, True)),
+        topo_pair_id=jnp.asarray(_pad_rows(t.topo_pair_id, n_pad, -1)),
+        matcher_counts=f32(t.matcher_counts),
+        anti_counts=f32(t.anti_counts),
+        sym_counts=f32(t.sym_counts),
+        aff_pod_count=f32(t.aff_pod_count),
     )
 
 
@@ -168,6 +234,15 @@ def pods_to_device(t: PodTable, pad_to: int | None = None) -> DevicePods:
         owner_uid_id=i32(t.owner_uid_id),
         owner_match_mh=f32(t.owner_match_mh),
         order=i32(t.order, -1),
+        matcher_mh=f32(t.matcher_mh),
+        affprog_id=i32(t.affprog_id),
+        prefaffprog_id=i32(t.prefaffprog_id),
+        spread_hard_id=i32(t.spread_hard_id),
+        spread_soft_id=i32(t.spread_soft_id),
+        self_aff_match=jnp.asarray(_pad_rows(t.self_aff_match, p_pad, False)),
+        anti_term_mh=f32(t.anti_term_mh),
+        sym_term_mh=f32(t.sym_term_mh),
+        has_aff=jnp.asarray(_pad_rows(t.has_aff, p_pad, False)),
     )
 
 
@@ -226,4 +301,65 @@ def selectors_to_device(t: SelectorTables) -> DeviceSelectors:
         p_prog_valid=jnp.asarray(
             _pad_rows(np.ones((t.p_n_progs,), bool), bucket_size(max(t.p_n_progs, 1)), False)
         ),
+    )
+
+
+def topology_to_device(t: TopologyTables) -> DeviceTopology:
+    M = t.n_matchers
+
+    def onehot(m_idx: np.ndarray, rows: int) -> jnp.ndarray:
+        oh = np.zeros((rows, M), np.float32)
+        r = np.arange(len(m_idx))
+        if len(m_idx):
+            oh[r, np.clip(m_idx, 0, M - 1)] = 1.0
+        return jnp.asarray(oh)
+
+    def valid(n: int, rows: int) -> jnp.ndarray:
+        v = np.zeros((rows,), bool)
+        v[:n] = True
+        return jnp.asarray(v)
+
+    Ta = bucket_size(max(t.ra_n_rows, 1), 4)
+    Ga = bucket_size(max(t.ra_n_progs, 1), 4)
+    Tp = bucket_size(max(t.rp_n_rows, 1), 4)
+    Gp = bucket_size(max(t.rp_n_progs, 1), 4)
+    Tsh = bucket_size(max(t.sh_n_rows, 1), 4)
+    Gsh = bucket_size(max(t.sh_n_progs, 1), 4)
+    Tss = bucket_size(max(t.ss_n_rows, 1), 4)
+    Gss = bucket_size(max(t.ss_n_progs, 1), 4)
+    n_pairs_pad = bucket_size(max(t.n_pairs, 1))
+    i32 = lambda a, rows, fill: jnp.asarray(_pad_rows(a, rows, fill))
+    return DeviceTopology(
+        pair_valid=valid(t.n_pairs, n_pairs_pad),
+        ra_valid=valid(t.ra_n_rows, Ta),
+        ra_prog=i32(t.ra_prog, Ta, Ga),
+        ra_key=i32(t.ra_key, Ta, 0),
+        ra_m_onehot=onehot(_pad_rows(t.ra_m, Ta, 0), Ta),
+        ra_anti=jnp.asarray(_pad_rows(t.ra_anti, Ta, False)),
+        ga_valid=valid(t.ra_n_progs, Ga),
+        rp_valid=valid(t.rp_n_rows, Tp),
+        rp_prog=i32(t.rp_prog, Tp, Gp),
+        rp_key=i32(t.rp_key, Tp, 0),
+        rp_m_onehot=onehot(_pad_rows(t.rp_m, Tp, 0), Tp),
+        rp_w=jnp.asarray(_pad_rows(t.rp_w, Tp, 0.0)),
+        gp_valid=valid(t.rp_n_progs, Gp),
+        at_key=jnp.asarray(t.at_key),
+        at_m_onehot=onehot(t.at_m, t.at_m.shape[0]),
+        st_key=jnp.asarray(t.st_key),
+        st_m_onehot=onehot(t.st_m, t.st_m.shape[0]),
+        st_w=jnp.asarray(t.st_w),
+        st_hard=jnp.asarray(t.st_hard),
+        sh_valid=valid(t.sh_n_rows, Tsh),
+        sh_prog=i32(t.sh_prog, Tsh, Gsh),
+        sh_key=i32(t.sh_key, Tsh, 0),
+        sh_m_onehot=onehot(_pad_rows(t.sh_m, Tsh, 0), Tsh),
+        sh_skew=jnp.asarray(_pad_rows(t.sh_skew, Tsh, 0.0)),
+        shp_selprog=i32(t.shp_selprog, Gsh, -1),
+        shp_valid=valid(t.sh_n_progs, Gsh),
+        ss_valid=valid(t.ss_n_rows, Tss),
+        ss_prog=i32(t.ss_prog, Tss, Gss),
+        ss_key=i32(t.ss_key, Tss, 0),
+        ss_m_onehot=onehot(_pad_rows(t.ss_m, Tss, 0), Tss),
+        ssp_selprog=i32(t.ssp_selprog, Gss, -1),
+        ssp_valid=valid(t.ss_n_progs, Gss),
     )

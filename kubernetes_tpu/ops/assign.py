@@ -49,6 +49,10 @@ class UsageState(NamedTuple):
     port_wild: jnp.ndarray  # (N, Upp)
     port_spec: jnp.ndarray  # (N, Upip)
     owner_counts: jnp.ndarray  # (N, Uo)
+    matcher_counts: jnp.ndarray  # (N, M)
+    anti_counts: jnp.ndarray  # (N, Ua)
+    sym_counts: jnp.ndarray  # (N, Us)
+    aff_pod_count: jnp.ndarray  # (N,)
 
 
 def usage_from_nodes(nodes: DeviceNodes) -> UsageState:
@@ -59,6 +63,10 @@ def usage_from_nodes(nodes: DeviceNodes) -> UsageState:
         port_wild=nodes.port_wild_mh,
         port_spec=nodes.port_spec_mh,
         owner_counts=nodes.owner_counts,
+        matcher_counts=nodes.matcher_counts,
+        anti_counts=nodes.anti_counts,
+        sym_counts=nodes.sym_counts,
+        aff_pod_count=nodes.aff_pod_count,
     )
 
 
@@ -70,6 +78,10 @@ def nodes_with_usage(nodes: DeviceNodes, u: UsageState) -> DeviceNodes:
         port_wild_mh=u.port_wild,
         port_spec_mh=u.port_spec,
         owner_counts=u.owner_counts,
+        matcher_counts=u.matcher_counts,
+        anti_counts=u.anti_counts,
+        sym_counts=u.sym_counts,
+        aff_pod_count=u.aff_pod_count,
     )
 
 
@@ -89,6 +101,12 @@ def _apply_batch(u: UsageState, pods: DevicePods, node_idx: jnp.ndarray,
         port_wild=u.port_wild.at[tgt].max(pods.port_wild_pp * w),
         port_spec=u.port_spec.at[tgt].max(pods.port_spec_pip * w),
         owner_counts=u.owner_counts.at[tgt].add(pods.owner_match_mh * w),
+        matcher_counts=u.matcher_counts.at[tgt].add(pods.matcher_mh * w),
+        anti_counts=u.anti_counts.at[tgt].add(pods.anti_term_mh * w),
+        sym_counts=u.sym_counts.at[tgt].add(pods.sym_term_mh * w),
+        aff_pod_count=u.aff_pod_count.at[tgt].add(
+            pods.has_aff.astype(jnp.float32) * w[:, 0]
+        ),
     )
 
 
@@ -107,7 +125,7 @@ def queue_order(pods: DevicePods) -> jnp.ndarray:
 
 
 @partial(jax.jit, static_argnames=("weights_key",))
-def _greedy_impl(pods, nodes, sel, weights_key):
+def _greedy_impl(pods, nodes, sel, topo, weights_key):
     weights = dict(weights_key) if weights_key else None
     P = pods.req.shape[0]
     perm = queue_order(pods)
@@ -116,8 +134,8 @@ def _greedy_impl(pods, nodes, sel, weights_key):
     def step(u, p):
         pod = _pod_slice(pods, p)
         cur = nodes_with_usage(nodes, u)
-        mask = run_predicates(pod, cur, sel).mask  # (1, N)
-        score = run_priorities(pod, cur, sel, mask, weights)
+        mask = run_predicates(pod, cur, sel, topo).mask  # (1, N)
+        score = run_priorities(pod, cur, sel, mask, weights, topo)
         masked = jnp.where(mask, score, NEG)
         best = jnp.argmax(masked[0])
         ok = mask[0, best] & pod.valid[0]
@@ -134,11 +152,12 @@ def greedy_assign(
     nodes: DeviceNodes,
     sel: DeviceSelectors,
     weights: Optional[Dict[str, float]] = None,
+    topo=None,
 ) -> Tuple[jnp.ndarray, UsageState]:
     """Serial-parity solver. Returns (assigned node row per pod or -1,
     final usage)."""
     key = tuple(sorted(weights.items())) if weights else None
-    return _greedy_impl(pods, nodes, sel, key)
+    return _greedy_impl(pods, nodes, sel, topo, key)
 
 
 def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray:
@@ -150,7 +169,7 @@ def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray
 
 
 @partial(jax.jit, static_argnames=("weights_key", "max_rounds", "per_node_cap"))
-def _batch_impl(pods, nodes, sel, weights_key, max_rounds, per_node_cap):
+def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap):
     weights = dict(weights_key) if weights_key else None
     P = pods.req.shape[0]
     perm = queue_order(pods)
@@ -158,13 +177,22 @@ def _batch_impl(pods, nodes, sel, weights_key, max_rounds, per_node_cap):
     has_port = (
         jnp.sum(pods.port_wild_pp, axis=1) + jnp.sum(pods.port_spec_pp, axis=1)
     ) > 0
+    if topo is not None:
+        from kubernetes_tpu.ops.topology import sensitive_keys
+
+        # (P, K) topology keys along which same-round co-admission into one
+        # topology group could violate required anti-affinity / hard spread
+        # (static over rounds; the per-round escape check is inside the loop)
+        sens = sensitive_keys(pods, topo, nodes.topo_pair_id.shape[1])
+    else:
+        sens = None
 
     def round_body(carry):
         assigned, u, _, rnd = carry
         cur = nodes_with_usage(nodes, u)
         active = (assigned == -1) & pods.valid
-        mask = run_predicates(pods, cur, sel).mask & active[:, None]
-        score = run_priorities(pods, cur, sel, mask, weights)
+        mask = run_predicates(pods, cur, sel, topo).mask & active[:, None]
+        score = run_priorities(pods, cur, sel, mask, weights, topo)
         masked = jnp.where(mask, score, NEG)
         choice = jnp.argmax(masked, axis=1).astype(jnp.int32)  # (P,)
         feasible = jnp.take_along_axis(mask, choice[:, None], axis=1)[:, 0]
@@ -199,6 +227,40 @@ def _batch_impl(pods, nodes, sel, weights_key, max_rounds, per_node_cap):
         acc_s = (c_s >= 0) & fits & cap_ok & port_ok
         accepted = jnp.zeros((P,), bool).at[order2].set(acc_s)
 
+        if topo is not None:
+            from kubernetes_tpu.ops.topology import self_escape_active
+
+            big = jnp.int32(2**30)
+
+            def first_per_group(ok, gate, key):
+                """Keep only the lowest-rank gated pod per group; ungated
+                pods pass through."""
+                gkey = jnp.where(gate, key, big)
+                o = jnp.lexsort((rank, gkey))
+                gk_s = gkey[o]
+                starts = jnp.searchsorted(gk_s, gk_s, side="left")
+                within = jnp.arange(P, dtype=jnp.int32) - starts
+                keep_s = (gk_s == big) | (within == 0)
+                keep = jnp.zeros((P,), bool).at[o].set(keep_s)
+                return ok & (keep | ~gate)
+
+            # one topo-sensitive pod per topology pair per round — the
+            # batched guard for anti-affinity / hard-spread interactions
+            # among same-round admissions (the serial loop never needs
+            # this; in-batch it replaces per-pod cache updates)
+            ok = accepted
+            tpid = nodes.topo_pair_id
+            for k in range(tpid.shape[1]):
+                pair = tpid[jnp.clip(choice, 0, tpid.shape[0] - 1), k]
+                gate = ok & (choice >= 0) & sens[:, k] & (pair >= 0)
+                ok = first_per_group(ok, gate, pair)
+            # one self-match escapee per affinity program per round: the
+            # second first-pod-of-a-group must wait and join the first
+            esc = self_escape_active(pods, cur, topo)
+            gate_e = ok & (choice >= 0) & esc
+            ok = first_per_group(ok, gate_e, pods.affprog_id)
+            accepted = ok
+
         new_assigned = jnp.where(accepted, choice, assigned)
         u = _apply_batch(u, pods, jnp.where(accepted, choice, 0), accepted)
         progressed = jnp.any(accepted)
@@ -221,10 +283,11 @@ def batch_assign(
     weights: Optional[Dict[str, float]] = None,
     max_rounds: int = 256,
     per_node_cap: int = 1,
+    topo=None,
 ) -> Tuple[jnp.ndarray, UsageState, jnp.ndarray]:
     """Fast batched solver. Returns (assigned row per pod or -1, final
     usage, rounds executed). ``per_node_cap`` bounds admissions per node per
     round (see _batch_impl); with P pending pods and N nodes expect about
     ceil(P / (N * cap)) rounds on uniform workloads."""
     key = tuple(sorted(weights.items())) if weights else None
-    return _batch_impl(pods, nodes, sel, key, max_rounds, per_node_cap)
+    return _batch_impl(pods, nodes, sel, topo, key, max_rounds, per_node_cap)

@@ -21,7 +21,12 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from kubernetes_tpu.ops.arrays import DeviceNodes, DevicePods, DeviceSelectors
+from kubernetes_tpu.ops.arrays import (
+    DeviceNodes,
+    DevicePods,
+    DeviceSelectors,
+    DeviceTopology,
+)
 from kubernetes_tpu.snapshot import (
     RES_PODS,
     XOP_EXISTS,
@@ -46,6 +51,8 @@ PREDICATE_BITS = (
     "PodFitsHostPorts",          # bit 7
     "PodMatchNodeSelector",      # bit 8
     "PodFitsResources",          # bit 9
+    "MatchInterPodAffinity",     # bit 10
+    "EvenPodsSpread",            # bit 11
 )
 BIT = {name: i for i, name in enumerate(PREDICATE_BITS)}
 
@@ -125,14 +132,19 @@ class FilterResult(NamedTuple):
 
 
 def run_predicates(
-    pods: DevicePods, nodes: DeviceNodes, sel: DeviceSelectors
+    pods: DevicePods,
+    nodes: DeviceNodes,
+    sel: DeviceSelectors,
+    topo: DeviceTopology | None = None,
 ) -> FilterResult:
     """The fused Filter pass: all predicates, all (pod, node) pairs.
 
     Equivalent surface: findNodesThatFit (generic_scheduler.go:460) with the
-    default predicate set (algorithmprovider/defaults/defaults.go:40), minus
-    volume predicates (stubbed as always-true for now; pluggable mask
-    providers compose via logical AND downstream).
+    default predicate set (algorithmprovider/defaults/defaults.go:40) plus
+    feature-gated EvenPodsSpread, minus volume predicates (stubbed as
+    always-true for now; pluggable mask providers compose via logical AND
+    downstream). ``topo=None`` skips the inter-pod-affinity/spread passes
+    (cheaper trace for workloads with no such terms).
     """
     P, N = pods.req.shape[0], nodes.allocatable.shape[0]
     reasons = jnp.zeros((P, N), jnp.int32)
@@ -192,6 +204,19 @@ def run_predicates(
     prog_idx = jnp.clip(pods.selprog_id, 0, prog.shape[0] - 1)
     sel_ok = jnp.where((pods.selprog_id >= 0)[:, None], prog[prog_idx], True)
     reasons |= jnp.where(~sel_ok, jnp.int32(1 << BIT["PodMatchNodeSelector"]), 0)
+
+    if topo is not None:
+        from kubernetes_tpu.ops.topology import (
+            even_pods_spread_mask,
+            inter_pod_affinity_mask,
+        )
+
+        # MatchInterPodAffinity (predicates.go:1211)
+        aff_ok = inter_pod_affinity_mask(pods, nodes, topo)
+        reasons |= jnp.where(~aff_ok, jnp.int32(1 << BIT["MatchInterPodAffinity"]), 0)
+        # EvenPodsSpread (predicates.go:1720)
+        spread_ok = even_pods_spread_mask(pods, nodes, topo, prog)
+        reasons |= jnp.where(~spread_ok, jnp.int32(1 << BIT["EvenPodsSpread"]), 0)
 
     # PodFitsResources (predicates.go:779): the pod-count cap always applies;
     # the remaining columns are checked only when the pod requests *anything*

@@ -59,7 +59,7 @@ def _requested_fractions(pods: DevicePods, nodes: DeviceNodes):
     return cpu_req, mem_req, cpu_cap, mem_cap
 
 
-def least_requested(pods, nodes, sel, mask) -> jnp.ndarray:
+def least_requested(pods, nodes, sel, topo, mask) -> jnp.ndarray:
     """least_requested.go: ((cap-req)*10/cap + (cap-req)*10/cap)/2, integer
     divisions preserved; req>cap or cap==0 scores 0."""
     cpu_req, mem_req, cpu_cap, mem_cap = _requested_fractions(pods, nodes)
@@ -71,7 +71,7 @@ def least_requested(pods, nodes, sel, mask) -> jnp.ndarray:
     return _idiv(score(cpu_req, cpu_cap) + score(mem_req, mem_cap), 2.0)
 
 
-def most_requested(pods, nodes, sel, mask) -> jnp.ndarray:
+def most_requested(pods, nodes, sel, topo, mask) -> jnp.ndarray:
     """most_requested.go: (req*10/cap) averaged — the bin-packing dual."""
     cpu_req, mem_req, cpu_cap, mem_cap = _requested_fractions(pods, nodes)
 
@@ -82,7 +82,7 @@ def most_requested(pods, nodes, sel, mask) -> jnp.ndarray:
     return _idiv(score(cpu_req, cpu_cap) + score(mem_req, mem_cap), 2.0)
 
 
-def balanced_allocation(pods, nodes, sel, mask) -> jnp.ndarray:
+def balanced_allocation(pods, nodes, sel, topo, mask) -> jnp.ndarray:
     """balanced_resource_allocation.go (two-resource form): score =
     int((1 - |cpuFrac - memFrac|) * 10); any fraction >= 1 scores 0."""
     cpu_req, mem_req, cpu_cap, mem_cap = _requested_fractions(pods, nodes)
@@ -93,7 +93,7 @@ def balanced_allocation(pods, nodes, sel, mask) -> jnp.ndarray:
     return jnp.where((cf >= 1.0) | (mf >= 1.0), 0.0, score)
 
 
-def node_affinity(pods, nodes, sel, mask) -> jnp.ndarray:
+def node_affinity(pods, nodes, sel, topo, mask) -> jnp.ndarray:
     """node_affinity.go: weight-sum of matched PreferredDuringScheduling
     terms, NormalizeReduce(10, false)."""
     prog = preferred_program_score(sel, nodes)  # (Gp, N)
@@ -102,7 +102,7 @@ def node_affinity(pods, nodes, sel, mask) -> jnp.ndarray:
     return _normalize_reduce(raw, mask, reverse=False)
 
 
-def taint_toleration(pods, nodes, sel, mask) -> jnp.ndarray:
+def taint_toleration(pods, nodes, sel, topo, mask) -> jnp.ndarray:
     """taint_toleration.go: count PreferNoSchedule taints not tolerated,
     NormalizeReduce(10, reverse=true)."""
     tol_idx = jnp.clip(pods.tolset_id, 0, sel.tol_soft_mh.shape[0] - 1)
@@ -113,7 +113,7 @@ def taint_toleration(pods, nodes, sel, mask) -> jnp.ndarray:
     return _normalize_reduce(intolerable, mask, reverse=True)
 
 
-def image_locality(pods, nodes, sel, mask) -> jnp.ndarray:
+def image_locality(pods, nodes, sel, topo, mask) -> jnp.ndarray:
     """image_locality.go: sum of (size * nodes-with-image/total-nodes) over
     the pod's images present on the node, clamped to [23MB, 1000MB] and
     scaled to 0..10."""
@@ -131,7 +131,7 @@ def image_locality(pods, nodes, sel, mask) -> jnp.ndarray:
     return _idiv(MAX_PRIORITY * (clamped - lo), hi - lo)
 
 
-def selector_spread(pods, nodes, sel, mask) -> jnp.ndarray:
+def selector_spread(pods, nodes, sel, topo, mask) -> jnp.ndarray:
     """selector_spreading.go: map = count of same-namespace pods on the node
     matching all owner selectors; reduce = 10*(max-count)/max blended 1/3
     with the zone-level equivalent at 2/3 (zoneWeighting, :34) when zones
@@ -179,7 +179,7 @@ def selector_spread(pods, nodes, sel, mask) -> jnp.ndarray:
     return jnp.floor(blend + _EPS)  # reference truncates the final float
 
 
-def node_prefer_avoid(pods, nodes, sel, mask) -> jnp.ndarray:
+def node_prefer_avoid(pods, nodes, sel, topo, mask) -> jnp.ndarray:
     """node_prefer_avoid_pods.go: 0 when the node's preferAvoidPods
     annotation lists the pod's controller owner, else 10 (weight 10000 in
     the default provider drowns other priorities)."""
@@ -190,12 +190,35 @@ def node_prefer_avoid(pods, nodes, sel, mask) -> jnp.ndarray:
     return jnp.where(avoided > 0, 0.0, float(MAX_PRIORITY))
 
 
-def equal_priority(pods, nodes, sel, mask) -> jnp.ndarray:
+def equal_priority(pods, nodes, sel, topo, mask) -> jnp.ndarray:
     """generic_scheduler.go:840 EqualPriority."""
     return jnp.ones((pods.req.shape[0], nodes.allocatable.shape[0]), jnp.float32)
 
 
-PriorityFn = Callable[..., jnp.ndarray]  # (pods, nodes, sel, mask) -> (P, N)
+def inter_pod_affinity(pods, nodes, sel, topo, mask) -> jnp.ndarray:
+    """interpod_affinity.go CalculateInterPodAffinityPriority (symmetric
+    weighted term counts, min/max-normalized). No-op (all zeros) when no
+    topology tables were packed."""
+    if topo is None:
+        return jnp.zeros((pods.req.shape[0], nodes.allocatable.shape[0]), jnp.float32)
+    from kubernetes_tpu.ops.topology import inter_pod_affinity_score
+
+    return inter_pod_affinity_score(pods, nodes, topo, mask)
+
+
+def even_pods_spread(pods, nodes, sel, topo, mask) -> jnp.ndarray:
+    """even_pods_spread.go CalculateEvenPodsSpreadPriority (feature-gated in
+    the reference; enabled here whenever soft constraints exist)."""
+    if topo is None:
+        return jnp.zeros((pods.req.shape[0], nodes.allocatable.shape[0]), jnp.float32)
+    from kubernetes_tpu.ops.predicates import selector_program_match
+    from kubernetes_tpu.ops.topology import even_pods_spread_score
+
+    prog = selector_program_match(sel, nodes)
+    return even_pods_spread_score(pods, nodes, topo, prog, mask)
+
+
+PriorityFn = Callable[..., jnp.ndarray]  # (pods, nodes, sel, topo, mask) -> (P, N)
 
 #: Registry name -> kernel; names mirror factory registrations
 #: (algorithmprovider/defaults/register_priorities.go).
@@ -209,12 +232,16 @@ PRIORITY_REGISTRY: Dict[str, PriorityFn] = {
     "SelectorSpreadPriority": selector_spread,
     "NodePreferAvoidPodsPriority": node_prefer_avoid,
     "EqualPriority": equal_priority,
+    "InterPodAffinityPriority": inter_pod_affinity,
+    "EvenPodsSpreadPriority": even_pods_spread,
 }
 
-#: Default provider weights (defaults.go:119 defaultPriorities; InterPodAffinity
-#: and EvenPodsSpread join in the topology milestone).
+#: Default provider weights (defaults.go:119 defaultPriorities).
+#: EvenPodsSpreadPriority joins via the EvenPodsSpread feature gate
+#: (defaults.go:91-100), not the default set.
 DEFAULT_WEIGHTS: Dict[str, float] = {
     "SelectorSpreadPriority": 1,
+    "InterPodAffinityPriority": 1,
     "LeastRequestedPriority": 1,
     "BalancedResourceAllocation": 1,
     "NodePreferAvoidPodsPriority": 10000,
@@ -230,6 +257,7 @@ def run_priorities(
     sel: DeviceSelectors,
     mask: jnp.ndarray,
     weights: Dict[str, float] | None = None,
+    topo=None,
 ) -> jnp.ndarray:
     """PrioritizeNodes (generic_scheduler.go:684): weighted sum of all
     enabled priorities -> (P, N) f32 total score."""
@@ -237,5 +265,5 @@ def run_priorities(
     total = jnp.zeros((pods.req.shape[0], nodes.allocatable.shape[0]), jnp.float32)
     for name, w in weights.items():
         if w:
-            total = total + w * PRIORITY_REGISTRY[name](pods, nodes, sel, mask)
+            total = total + w * PRIORITY_REGISTRY[name](pods, nodes, sel, topo, mask)
     return total

@@ -1,0 +1,477 @@
+"""Inter-pod affinity and topology-spread kernels — the vectorized form of
+the reference's hardest predicates/priorities (SURVEY.md §7.3 #1):
+
+- ``InterPodAffinityMatches`` (predicates.go:1211): required pod
+  (anti)affinity of the incoming pod AND the symmetric check that no
+  *existing* pod's required anti-affinity forbids the incoming pod
+  (``satisfiesExistingPodsAntiAffinity``), including the
+  first-pod-of-a-group self-match escape (predicates.go:1437).
+- ``EvenPodsSpreadPredicate`` (predicates.go:1720): hard maxSkew
+  constraints with the candidate-node minimum from
+  ``getTPMapMatchingSpreadConstraints`` (metadata.go:194).
+- ``CalculateInterPodAffinityPriority`` (interpod_affinity.go:46) with full
+  symmetry (existing pods' hard/soft terms scoring the incoming pod).
+- ``CalculateEvenPodsSpreadPriority`` (even_pods_spread.go:86).
+
+Representation: topology *pairs* (key, value) are interned host-side; each
+node carries ``topo_pair_id (N, K)`` — its pair per topology key. All counts
+the reference stores in ``topologyPairsMaps`` (metadata.go:65) become
+segment-sums over the node axis of per-node count matrices
+(``matcher_counts``/``anti_counts``/``sym_counts``), which the assignment
+loop updates by scatter-add as pods land — so in-batch placements influence
+later rounds exactly like the reference's serial cache updates.
+
+Matcher-id gathers are expressed as one-hot matmuls against the (·, M)
+count matrices so the heavy lifting rides the MXU; the K-loop is unrolled
+(K = padded topology-key count, single digits in practice).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops.arrays import (
+    DeviceNodes,
+    DevicePods,
+    DeviceSelectors,
+    DeviceTopology,
+)
+
+_INF = 3e38
+
+
+def _group_counts(topo_pair_id: jnp.ndarray, counts: jnp.ndarray, n_pairs: int) -> jnp.ndarray:
+    """G[tp, c] = sum of counts[n, c] over nodes n whose pair set includes
+    tp. Output has ``n_pairs + 1`` rows; the last row is a dump for nodes
+    lacking a key."""
+    K = topo_pair_id.shape[1]
+    G = jnp.zeros((n_pairs + 1, counts.shape[1]), jnp.float32)
+    for k in range(K):
+        idx = jnp.where(topo_pair_id[:, k] >= 0, topo_pair_id[:, k], n_pairs)
+        G = G + jax.ops.segment_sum(counts, idx, num_segments=n_pairs + 1)
+    return G
+
+
+def _row_counts(
+    G: jnp.ndarray,
+    topo_pair_id: jnp.ndarray,
+    row_key: jnp.ndarray,
+    row_m_onehot: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per term-row t (topology key row_key[t], matcher one-hot row) and node
+    n: the matcher count within n's topology group of that key.
+    Returns (cnt (T, N), has_key (T, N))."""
+    N, K = topo_pair_id.shape
+    T = row_key.shape[0]
+    n_pairs = G.shape[0] - 1
+    cnt = jnp.zeros((T, N), jnp.float32)
+    has = jnp.zeros((T, N), bool)
+    for k in range(K):
+        idx = topo_pair_id[:, k]
+        hk = idx >= 0
+        Gk = G[jnp.where(hk, idx, n_pairs)]  # (N, C)
+        cnt_k = row_m_onehot @ Gk.T  # (T, N) MXU
+        rs = (row_key == k)[:, None]
+        cnt = jnp.where(rs, jnp.where(hk[None, :], cnt_k, 0.0), cnt)
+        has = jnp.where(rs, hk[None, :], has)
+    return cnt, has
+
+
+def _col_gather(Gc: jnp.ndarray, topo_pair_id: jnp.ndarray, col_key: jnp.ndarray) -> jnp.ndarray:
+    """(N, C): Gc[topo_pair_id[n, col_key[c]], c]; 0 where the node lacks
+    column c's key. Gc is (n_pairs+1, C) with per-column keys."""
+    N, K = topo_pair_id.shape
+    C = col_key.shape[0]
+    n_pairs = Gc.shape[0] - 1
+    out = jnp.zeros((N, C), jnp.float32)
+    for k in range(K):
+        idx = topo_pair_id[:, k]
+        hk = (idx >= 0)[:, None]
+        Gk = Gc[jnp.where(idx >= 0, idx, n_pairs)]  # (N, C)
+        cm = (col_key == k)[None, :]
+        out = jnp.where(cm & hk, Gk, out)
+    return out
+
+
+def _has_key_rows(topo_pair_id: jnp.ndarray, row_key: jnp.ndarray) -> jnp.ndarray:
+    """(T, N) bool: node has topology key row_key[t]."""
+    N, K = topo_pair_id.shape
+    has = jnp.zeros((row_key.shape[0], N), bool)
+    for k in range(K):
+        hk = topo_pair_id[:, k] >= 0
+        has = jnp.where((row_key == k)[:, None], hk[None, :], has)
+    return has
+
+
+def _seg_all(flags: jnp.ndarray, seg: jnp.ndarray, num: int) -> jnp.ndarray:
+    """Segmented AND with neutral True (flags already neutralized on invalid
+    rows by the caller)."""
+    return jax.ops.segment_min(flags.astype(jnp.int32), seg, num_segments=num) > 0
+
+
+def _seg_any(flags: jnp.ndarray, seg: jnp.ndarray, num: int) -> jnp.ndarray:
+    return jax.ops.segment_max(flags.astype(jnp.int32), seg, num_segments=num) > 0
+
+
+def inter_pod_affinity_mask(
+    pods: DevicePods, nodes: DeviceNodes, topo: DeviceTopology
+) -> jnp.ndarray:
+    """(P, N) bool — InterPodAffinityMatches (predicates.go:1211)."""
+    P = pods.valid.shape[0]
+    N = nodes.valid.shape[0]
+    n_pairs = topo.pair_valid.shape[0]
+    tpid = nodes.topo_pair_id
+
+    # (a) existing pods' required anti-affinity vs the incoming pod
+    # (satisfiesExistingPodsAntiAffinity): node fails when any of its
+    # topology pairs holds a pod whose anti-term matches the incoming pod.
+    A = _group_counts(tpid, nodes.anti_counts, n_pairs)  # (Utp+1, Ua)
+    AG = _col_gather(A, tpid, topo.at_key)  # (N, Ua)
+    pm_anti = pods.matcher_mh @ topo.at_m_onehot.T  # (P, Ua) — does p match term a
+    ok = (pm_anti @ AG.T) <= 0.5  # (P, N)
+
+    # (b) the incoming pod's own required terms
+    G = _group_counts(tpid, nodes.matcher_counts, n_pairs)  # (Utp+1, M)
+    cnt, has = _row_counts(G, tpid, topo.ra_key, topo.ra_m_onehot)  # (Ta, N)
+    n_progs = topo.ga_valid.shape[0]
+    seg = topo.ra_prog  # pad rows -> n_progs (dump)
+    num = n_progs + 1
+
+    is_aff = topo.ra_valid & ~topo.ra_anti
+    is_anti = topo.ra_valid & topo.ra_anti
+    row_hit = has & (cnt > 0.5)
+
+    # The reference merges a pod's term matches into ONE pair map keyed by
+    # (topologyKey, value) (metadata.go topologyPairsMaps): term t passes at
+    # node n if ANY same-key term of the same program hit n's pair. Replicate
+    # by OR-ing row hits within (program, key) groups before the per-term
+    # checks.
+    K = tpid.shape[1]
+    seg2 = seg * K + topo.ra_key  # (prog, key) group id
+    num2 = num * K
+    aff_pair = _seg_any(row_hit & is_aff[:, None], seg2, num2)  # (num2, N)
+    anti_pair = _seg_any(row_hit & is_anti[:, None], seg2, num2)
+
+    # nodeMatchesAllTopologyTerms: every affinity row's (key, value) pair is
+    # populated; anti rows are neutral-True here.
+    aff_all = _seg_all(
+        jnp.where(is_aff[:, None], has & aff_pair[seg2], True), seg, num
+    )  # (Ga+1, N)
+    # nodeMatchesAnyTopologyTerm for anti rows
+    anti_any = _seg_any(
+        jnp.where(is_anti[:, None], has & anti_pair[seg2], False), seg, num
+    )
+
+    # self-match escape: the merged affinity-pair map is empty (no existing
+    # pod matches any affinity term on a keyed node) AND the pod matches its
+    # own terms (predicates.go:1437).
+    mc_tot = jnp.sum(
+        jnp.where(has, (topo.ra_m_onehot @ nodes.matcher_counts.T), 0.0), axis=1
+    )  # (Ta,) total matching pods per row over keyed nodes
+    prog_empty = _seg_all(jnp.where(is_aff, mc_tot <= 0.5, True), seg, num)  # (Ga+1,)
+    prog_has_aff = _seg_any(is_aff, seg, num)  # (Ga+1,)
+
+    gid = jnp.clip(pods.affprog_id, 0, n_progs)  # (P,)
+    has_prog = pods.affprog_id >= 0
+    aff_ok = (
+        ~prog_has_aff[gid][:, None]
+        | aff_all[gid]
+        | (prog_empty[gid] & pods.self_aff_match)[:, None]
+    )  # (P, N)
+    anti_ok = ~anti_any[gid]
+    ok = ok & jnp.where(has_prog[:, None], aff_ok & anti_ok, True)
+    return ok
+
+
+def _spread_candidates(
+    sel_match: jnp.ndarray,  # (Gsel, N) from selector_program_match
+    nodes: DeviceNodes,
+    prog_selprog: jnp.ndarray,  # (Gs,) i32
+    row_prog: jnp.ndarray,  # (T,) i32 (pads -> Gs)
+    row_key: jnp.ndarray,  # (T,)
+    row_valid: jnp.ndarray,  # (T,)
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per spread program: (cand, keys_ok), both (Gs+1, N).
+    ``cand`` = nodes that count toward pair totals/min: pass the pod's node
+    selector AND carry every constraint's topology key (metadata.go:232-238).
+    ``keys_ok`` = key presence alone (the soft-score eligibility,
+    even_pods_spread.go initialize() checks only NodeLabelsMatch)."""
+    n_selprogs = sel_match.shape[0]
+    Gs = prog_selprog.shape[0]
+    sel_ok = jnp.where(
+        (prog_selprog >= 0)[:, None],
+        sel_match[jnp.clip(prog_selprog, 0, n_selprogs - 1)],
+        True,
+    )  # (Gs, N)
+    has = _has_key_rows(nodes.topo_pair_id, row_key)  # (T, N)
+    keys_ok = _seg_all(
+        jnp.where(row_valid[:, None], has, True), row_prog, Gs + 1
+    ) & nodes.valid[None, :]  # (Gs+1, N)
+    cand = keys_ok & jnp.concatenate([sel_ok, jnp.zeros((1, sel_ok.shape[1]), bool)])
+    return cand, keys_ok
+
+
+def _spread_pair_counts(
+    nodes: DeviceNodes,
+    topo_n_pairs: int,
+    cand_row: jnp.ndarray,  # (T, N) candidacy per row
+    row_key: jnp.ndarray,
+    row_m_onehot: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per row t and pair tp: (matching-pod count, candidate-node count),
+    accumulated over candidate nodes only. Returns (C, Pres), both
+    (n_pairs+1, T)."""
+    tpid = nodes.topo_pair_id
+    K = tpid.shape[1]
+    mc = row_m_onehot @ nodes.matcher_counts.T  # (T, N) matching pods per node
+    vals = jnp.where(cand_row, mc, 0.0)
+    pres = cand_row.astype(jnp.float32)
+    C = jnp.zeros((topo_n_pairs + 1, row_key.shape[0]), jnp.float32)
+    Pres = jnp.zeros_like(C)
+    for k in range(K):
+        idx = jnp.where(tpid[:, k] >= 0, tpid[:, k], topo_n_pairs)
+        colk = (row_key == k)[None, :]
+        C = C + jax.ops.segment_sum(
+            jnp.where(colk, vals.T, 0.0), idx, num_segments=topo_n_pairs + 1
+        )
+        Pres = Pres + jax.ops.segment_sum(
+            jnp.where(colk, pres.T, 0.0), idx, num_segments=topo_n_pairs + 1
+        )
+    return C, Pres
+
+
+def _pair_gather_rows(
+    C: jnp.ndarray, tpid: jnp.ndarray, row_key: jnp.ndarray
+) -> jnp.ndarray:
+    """cnt (T, N): C[topo_pair_id[n, k_t], t]; 0 where key absent."""
+    N, K = tpid.shape
+    T = row_key.shape[0]
+    n_pairs = C.shape[0] - 1
+    out = jnp.zeros((T, N), jnp.float32)
+    for k in range(K):
+        idx = tpid[:, k]
+        hk = (idx >= 0)[None, :]
+        Ck = C[jnp.where(idx >= 0, idx, n_pairs)].T  # (T, N)
+        rs = (row_key == k)[:, None]
+        out = jnp.where(rs & hk, Ck, out)
+    return out
+
+
+def even_pods_spread_mask(
+    pods: DevicePods,
+    nodes: DeviceNodes,
+    topo: DeviceTopology,
+    sel_match: jnp.ndarray,  # (Gsel, N) required-selector program matches
+) -> jnp.ndarray:
+    """(P, N) bool — EvenPodsSpreadPredicate (predicates.go:1720):
+    matchNum + selfMatch - minMatchNum <= maxSkew per hard constraint."""
+    P = pods.valid.shape[0]
+    N = nodes.valid.shape[0]
+    n_pairs = topo.pair_valid.shape[0]
+    tpid = nodes.topo_pair_id
+    Gsh = topo.shp_valid.shape[0]
+
+    cand, _ = _spread_candidates(
+        sel_match, nodes, topo.shp_selprog, topo.sh_prog, topo.sh_key, topo.sh_valid
+    )  # (Gsh+1, N)
+    cand_row = cand[topo.sh_prog]  # (Tsh, N)
+    C, Pres = _spread_pair_counts(nodes, n_pairs, cand_row, topo.sh_key, topo.sh_m_onehot)
+    # min match per row over pairs seen on candidate nodes (metadata.go:285);
+    # rows with no candidate pairs keep +INF -> skew check passes.
+    minm = jnp.min(
+        jnp.where(Pres[:n_pairs] > 0.5, C[:n_pairs], _INF), axis=0
+    )  # (Tsh,)
+    cntn = _pair_gather_rows(C, tpid, topo.sh_key)  # (Tsh, N)
+    has = _has_key_rows(tpid, topo.sh_key)
+    thr = jnp.minimum(minm + topo.sh_skew, _INF)  # (Tsh,)
+    ok0 = cntn <= thr[:, None] + 0.5  # selfMatch = 0
+    ok1 = cntn + 1.0 <= thr[:, None] + 0.5  # selfMatch = 1
+    fail0 = topo.sh_valid[:, None] & (~has | ~ok0)  # (Tsh, N)
+    d = (topo.sh_valid[:, None] & (~has | ~ok1) & ~fail0).astype(jnp.float32)
+    F0 = _seg_any(fail0, topo.sh_prog, Gsh + 1)  # (Gsh+1, N)
+
+    self_m = pods.matcher_mh @ topo.sh_m_onehot.T  # (P, Tsh)
+    own_row = pods.spread_hard_id[:, None] == topo.sh_prog[None, :]  # (P, Tsh)
+    extra = jnp.where(own_row, self_m, 0.0) @ d  # (P, N)
+
+    gid = jnp.clip(pods.spread_hard_id, 0, Gsh)
+    fail = F0[gid] | (extra > 0.5)
+    return jnp.where((pods.spread_hard_id >= 0)[:, None], ~fail, True)
+
+
+def even_pods_spread_score(
+    pods: DevicePods,
+    nodes: DeviceNodes,
+    topo: DeviceTopology,
+    sel_match: jnp.ndarray,
+    mask: jnp.ndarray,  # (P, N) Filter feasibility (the "filtered nodes")
+) -> jnp.ndarray:
+    """(P, N) f32 — CalculateEvenPodsSpreadPriority (even_pods_spread.go:86):
+    10 * (total - count) / (total - min), over filtered candidate nodes."""
+    n_pairs = topo.pair_valid.shape[0]
+    tpid = nodes.topo_pair_id
+    Gss = topo.ssp_valid.shape[0]
+
+    cand, keys_ok = _spread_candidates(
+        sel_match, nodes, topo.ssp_selprog, topo.ss_prog, topo.ss_key, topo.ss_valid
+    )  # (Gss+1, N)
+    cand_row = cand[topo.ss_prog]
+    C, _ = _spread_pair_counts(nodes, n_pairs, cand_row, topo.ss_key, topo.ss_m_onehot)
+    cntn = _pair_gather_rows(C, tpid, topo.ss_key)  # (Tss, N)
+    # per-program per-node credit: sum of pair counts over its constraints
+    # (the node's own pairs only — gather already zeroes missing keys, and
+    # candidates have all keys anyway)
+    CS = jax.ops.segment_sum(
+        jnp.where(topo.ss_valid[:, None], cntn, 0.0), topo.ss_prog,
+        num_segments=Gss + 1,
+    )  # (Gss+1, N)
+
+    gid = jnp.clip(pods.spread_soft_id, 0, Gss)
+    has_prog = pods.spread_soft_id >= 0
+    cnt_p = CS[gid]  # (P, N)
+    # scoring eligibility: filtered nodes with every topology key present —
+    # the selector is NOT re-checked here (initialize() vs processAllNode
+    # asymmetry in even_pods_spread.go)
+    el = keys_ok[gid] & mask
+    total = jnp.sum(jnp.where(el, cnt_p, 0.0), axis=1, keepdims=True)  # (P, 1)
+    minc = jnp.min(jnp.where(el, cnt_p, _INF), axis=1, keepdims=True)
+    any_el = jnp.any(el, axis=1, keepdims=True)
+    diff = total - jnp.where(any_el, minc, 0.0)
+    score = jnp.where(
+        diff > 0,
+        jnp.floor(10.0 * (total - cnt_p) / jnp.maximum(diff, 1e-30) + 1e-5),
+        10.0,
+    )
+    score = jnp.where(el, score, 0.0)
+    return jnp.where(has_prog[:, None], score, 0.0)
+
+
+def _key_onehot(keys: jnp.ndarray, K: int) -> jnp.ndarray:
+    """(T, K) f32 one-hot of per-row topology-key indices."""
+    return (keys[:, None] == jnp.arange(K)[None, :]).astype(jnp.float32)
+
+
+def sensitive_keys(pods: DevicePods, topo: DeviceTopology, K: int) -> jnp.ndarray:
+    """(P, K) bool: topology keys along which admitting this pod in the same
+    round as another pod of the same topology group could violate a required
+    anti-affinity or hard-spread constraint (either direction). Used by the
+    batch solver to serialize such admissions per topology pair per round —
+    the batched analog of the serial loop's implicit ordering
+    (scheduler.go:462). Keys of *affinity* terms are excluded: affinity
+    counts only grow, so a pass can never be invalidated by same-round
+    admissions (the self-match escape is handled separately by
+    ``self_escape_active``)."""
+    n_progs = topo.ga_valid.shape[0]
+    Gsh = topo.shp_valid.shape[0]
+
+    # own required anti-affinity keys, via the pod's program
+    anti_rows = (topo.ra_valid & topo.ra_anti).astype(jnp.float32)[:, None] * _key_onehot(
+        topo.ra_key, K
+    )  # (Ta, K)
+    prog_anti = (
+        jax.ops.segment_sum(anti_rows, topo.ra_prog, num_segments=n_progs + 1) > 0.5
+    )  # (Ga+1, K)
+    own_anti = jnp.where(
+        (pods.affprog_id >= 0)[:, None],
+        prog_anti[jnp.clip(pods.affprog_id, 0, n_progs)],
+        False,
+    )
+    # own hard-spread keys
+    sh_rows = topo.sh_valid.astype(jnp.float32)[:, None] * _key_onehot(topo.sh_key, K)
+    prog_sh = (
+        jax.ops.segment_sum(sh_rows, topo.sh_prog, num_segments=Gsh + 1) > 0.5
+    )
+    own_sh = jnp.where(
+        (pods.spread_hard_id >= 0)[:, None],
+        prog_sh[jnp.clip(pods.spread_hard_id, 0, Gsh)],
+        False,
+    )
+    # keys of universe anti-terms whose matcher matches this pod (the pod
+    # could break an already-admitted pod's anti constraint)
+    pm_anti = pods.matcher_mh @ topo.at_m_onehot.T  # (P, Ua)
+    match_anti = (pm_anti @ _key_onehot(topo.at_key, K)) > 0.5
+    # keys of hard-spread rows whose selector matches this pod (its landing
+    # shifts another pod's skew within the round)
+    pm_sh = (pods.matcher_mh @ topo.sh_m_onehot.T) * topo.sh_valid[None, :]
+    match_sh = (pm_sh @ _key_onehot(topo.sh_key, K)) > 0.5
+    return own_anti | own_sh | match_anti | match_sh
+
+
+def self_escape_active(
+    pods: DevicePods, nodes: DeviceNodes, topo: DeviceTopology
+) -> jnp.ndarray:
+    """(P,) bool: the pod's required-affinity check is passing via the
+    first-pod-of-a-group escape (empty pair map + self match) under the
+    CURRENT counts. Two escapees of one program must not be admitted in the
+    same round — the second must join the first's topology group."""
+    has = _has_key_rows(nodes.topo_pair_id, topo.ra_key)  # (Ta, N)
+    mc_tot = jnp.sum(
+        jnp.where(has, (topo.ra_m_onehot @ nodes.matcher_counts.T), 0.0), axis=1
+    )  # (Ta,)
+    n_progs = topo.ga_valid.shape[0]
+    seg = topo.ra_prog
+    num = n_progs + 1
+    is_aff = topo.ra_valid & ~topo.ra_anti
+    prog_empty = _seg_all(jnp.where(is_aff, mc_tot <= 0.5, True), seg, num)
+    prog_has_aff = _seg_any(is_aff, seg, num)
+    gid = jnp.clip(pods.affprog_id, 0, n_progs)
+    return (
+        (pods.affprog_id >= 0)
+        & prog_has_aff[gid]
+        & prog_empty[gid]
+        & pods.self_aff_match
+    )
+
+
+def inter_pod_affinity_score(
+    pods: DevicePods,
+    nodes: DeviceNodes,
+    topo: DeviceTopology,
+    mask: jnp.ndarray,
+    hard_pod_affinity_weight: float = 1.0,
+) -> jnp.ndarray:
+    """(P, N) f32 — CalculateInterPodAffinityPriority (interpod_affinity.go):
+    weighted term counts (incoming preferred terms + symmetric existing-pod
+    terms), min/max-normalized to 0..10 per pod over feasible nodes."""
+    n_pairs = topo.pair_valid.shape[0]
+    tpid = nodes.topo_pair_id
+    Gp = topo.gp_valid.shape[0]
+
+    # incoming pod's preferred terms: +/-w per matching existing pod in the
+    # node's topology group of the term's key
+    G = _group_counts(tpid, nodes.matcher_counts, n_pairs)
+    cnt, has = _row_counts(G, tpid, topo.rp_key, topo.rp_m_onehot)  # (Tp, N)
+    w_cnt = topo.rp_w[:, None] * jnp.where(has, cnt, 0.0)
+    S_in = jax.ops.segment_sum(
+        jnp.where(topo.rp_valid[:, None], w_cnt, 0.0), topo.rp_prog,
+        num_segments=Gp + 1,
+    )  # (Gp+1, N)
+    gid = jnp.clip(pods.prefaffprog_id, 0, Gp)
+    score_in = jnp.where((pods.prefaffprog_id >= 0)[:, None], S_in[gid], 0.0)
+
+    # symmetry: existing pods' hard-affinity (x hardPodAffinityWeight),
+    # soft-affinity (+w) and soft-anti-affinity (-w) terms that match the
+    # incoming pod, credited to the existing pod's whole topology group
+    S = _group_counts(tpid, nodes.sym_counts, n_pairs)  # (Utp+1, Us)
+    SG = _col_gather(S, tpid, topo.st_key)  # (N, Us)
+    pm_sym = pods.matcher_mh @ topo.st_m_onehot.T  # (P, Us)
+    w_eff = topo.st_w + topo.st_hard * hard_pod_affinity_weight  # (Us,)
+    score_sym = (pm_sym * w_eff[None, :]) @ SG.T  # (P, N)
+
+    counts = score_in + score_sym
+    # "counted" nodes (pm.counts non-nil): pod has (anti)affinity, or the
+    # node hosts pods with affinity (interpod_affinity.go:121-127)
+    counted = pods.has_aff[:, None] | (nodes.aff_pod_count > 0.5)[None, :]
+    el = mask & counted
+    mx = jnp.maximum(jnp.max(jnp.where(el, counts, 0.0), axis=1, keepdims=True), 0.0)
+    mn = jnp.minimum(jnp.min(jnp.where(el, counts, 0.0), axis=1, keepdims=True), 0.0)
+    diff = mx - mn
+    score = jnp.where(
+        (diff > 0) & counted,
+        jnp.floor(10.0 * jnp.maximum(counts - mn, 0.0) / jnp.maximum(diff, 1e-30) + 1e-5),
+        0.0,
+    )
+    return score

@@ -62,7 +62,16 @@ def replicate(tree, mesh: Mesh):
 
 
 def shard_cluster(
-    pods: DevicePods, nodes: DeviceNodes, sel: DeviceSelectors, mesh: Mesh
+    pods: DevicePods,
+    nodes: DeviceNodes,
+    sel: DeviceSelectors,
+    mesh: Mesh,
+    topo=None,
 ):
-    """One-call placement for a scheduling cycle's inputs."""
-    return replicate(pods, mesh), shard_nodes(nodes, mesh), replicate(sel, mesh)
+    """One-call placement for a scheduling cycle's inputs. Topology term
+    tables (DeviceTopology) are universe-shaped -> replicated; the dynamic
+    per-node count matrices live inside ``nodes`` and shard with it."""
+    out = (replicate(pods, mesh), shard_nodes(nodes, mesh), replicate(sel, mesh))
+    if topo is not None:
+        return out + (replicate(topo, mesh),)
+    return out

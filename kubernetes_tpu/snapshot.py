@@ -38,6 +38,7 @@ from kubernetes_tpu.api.types import (
     OP_LT,
     OP_NOT_IN,
     Affinity,
+    LabelSelector,
     Node,
     NodeSelectorTerm,
     Pod,
@@ -66,6 +67,20 @@ _OPCODE = {
     OP_GT: XOP_GT,
     OP_LT: XOP_LT,
 }
+
+# Sym-term kinds: the three classes of an *existing* pod's affinity terms
+# that score the incoming pod by symmetry
+# (priorities/interpod_affinity.go:46 CalculateInterPodAffinityPriority):
+# required affinity (weight = hardPodAffinityWeight), preferred affinity
+# (+w), preferred anti-affinity (-w).
+SYM_HARD_AFF, SYM_SOFT_AFF, SYM_SOFT_ANTI = 0, 1, 2
+
+
+def _canon_selector(sel: LabelSelector):
+    return (
+        tuple(sorted(sel.match_labels.items())),
+        tuple((r.key, r.operator, tuple(r.values)) for r in sel.match_expressions),
+    )
 
 
 @dataclass(frozen=True)
@@ -108,6 +123,31 @@ class Universe:
         self.zones = Interner()
         # controller owner UIDs — NodePreferAvoidPods
         self.owner_uids = Interner()
+        # ---- inter-pod affinity / topology spread universes --------------
+        # (tensor form of predicates/metadata.go topologyPairsMaps :65)
+        self.topo_keys = Interner()  # topology key strings
+        self.topo_pairs = Interner()  # (key_id, value)
+        # pod matchers: (namespaces-or-None, canonical selector) evaluated
+        # against POD labels — shared by affinity terms & spread constraints
+        self.pod_matchers = Interner()
+        self.pod_matcher_items: List[Tuple[Optional[Tuple[str, ...]], LabelSelector]] = []
+        # required (anti)affinity programs: rows (key_id, matcher_id, is_anti)
+        self.aff_programs = Interner()
+        self.aff_program_rows: List[List[Tuple[int, int, bool]]] = []
+        # preferred (anti)affinity programs: rows (key_id, matcher_id, ±weight)
+        self.pref_aff_programs = Interner()
+        self.pref_aff_program_rows: List[List[Tuple[int, int, float]]] = []
+        # distinct required anti-affinity terms of ANY pod — the symmetry
+        # check (satisfiesExistingPodsAntiAffinity, predicates.go:~1400)
+        self.anti_terms = Interner()  # (key_id, matcher_id)
+        # distinct symmetric scoring terms: (key_id, matcher_id, weight, kind)
+        self.sym_terms = Interner()
+        # topology-spread programs: (rows, selprog_id); candidacy of a node
+        # depends on the pod's node selector (metadata.go:232)
+        self.spread_hard_programs = Interner()  # rows (key, matcher, maxSkew)
+        self.spread_hard_program_rows: List[Tuple[Tuple[Tuple[int, int, int], ...], int]] = []
+        self.spread_soft_programs = Interner()  # rows (key, matcher)
+        self.spread_soft_program_rows: List[Tuple[Tuple[Tuple[int, int], ...], int]] = []
 
     # -- resources ---------------------------------------------------------
 
@@ -236,6 +276,160 @@ class Universe:
             self.image_sizes[iid] = max(self.image_sizes[iid], float(size))
         return iid
 
+    # -- inter-pod affinity / spread ---------------------------------------
+
+    def intern_matcher(
+        self, namespaces: Optional[Tuple[str, ...]], selector: LabelSelector
+    ) -> int:
+        """(namespaces, selector) program matched against pods.
+        ``namespaces=None`` = match any namespace (the soft-spread priority
+        deliberately skips the namespace check — even_pods_spread.go:137)."""
+        key = (tuple(sorted(namespaces)) if namespaces is not None else None,
+               _canon_selector(selector))
+        mid = self.pod_matchers.intern(key)
+        if mid == len(self.pod_matcher_items):
+            self.pod_matcher_items.append((namespaces, selector))
+        return mid
+
+    def matcher_matches(self, mid: int, pod: Pod) -> bool:
+        ns, sel = self.pod_matcher_items[mid]
+        if ns is not None and pod.namespace not in ns:
+            return False
+        return sel.matches(pod.labels)
+
+    def _intern_pod_aff_term(self, pod: Pod, term) -> Tuple[int, int]:
+        """(key_id, matcher_id) for one PodAffinityTerm; empty namespaces
+        default to the defining pod's namespace (priorities/util
+        GetNamespacesFromPodAffinityTerm)."""
+        k = self.topo_keys.intern(term.topology_key)
+        m = self.intern_matcher(term.namespaces or (pod.namespace,), term.label_selector)
+        return k, m
+
+    def intern_affinity_program(self, pod: Pod) -> int:
+        """Required pod (anti)affinity of ``pod`` -> program id; also seeds
+        the anti-term and sym-term universes with this pod's terms (the
+        contributions it will make as an *existing* pod)."""
+        a = pod.affinity
+        if not (a.pod_affinity_required or a.pod_anti_affinity_required):
+            self._seed_sym_terms(pod)
+            return -1
+        rows: List[Tuple[int, int, bool]] = []
+        for t in a.pod_affinity_required:
+            k, m = self._intern_pod_aff_term(pod, t)
+            rows.append((k, m, False))
+        for t in a.pod_anti_affinity_required:
+            k, m = self._intern_pod_aff_term(pod, t)
+            rows.append((k, m, True))
+            self.anti_terms.intern((k, m))
+        self._seed_sym_terms(pod)
+        key = tuple(rows)
+        pid = self.aff_programs.intern(key)
+        if pid == len(self.aff_program_rows):
+            self.aff_program_rows.append(rows)
+        return pid
+
+    def _seed_sym_terms(self, pod: Pod) -> None:
+        a = pod.affinity
+        for t in a.pod_affinity_required:
+            k, m = self._intern_pod_aff_term(pod, t)
+            self.sym_terms.intern((k, m, 1.0, SYM_HARD_AFF))
+        for wt in a.pod_affinity_preferred:
+            k, m = self._intern_pod_aff_term(pod, wt.term)
+            self.sym_terms.intern((k, m, float(wt.weight), SYM_SOFT_AFF))
+        for wt in a.pod_anti_affinity_preferred:
+            k, m = self._intern_pod_aff_term(pod, wt.term)
+            self.sym_terms.intern((k, m, float(wt.weight), SYM_SOFT_ANTI))
+
+    def pod_sym_term_ids(self, pod: Pod) -> List[int]:
+        """Sym-term ids this pod carries as an existing pod (lookup only)."""
+        out = []
+        a = pod.affinity
+        for t in a.pod_affinity_required:
+            k, m = self._intern_pod_aff_term(pod, t)
+            out.append(self.sym_terms.lookup((k, m, 1.0, SYM_HARD_AFF)))
+        for wt in a.pod_affinity_preferred:
+            k, m = self._intern_pod_aff_term(pod, wt.term)
+            out.append(self.sym_terms.lookup((k, m, float(wt.weight), SYM_SOFT_AFF)))
+        for wt in a.pod_anti_affinity_preferred:
+            k, m = self._intern_pod_aff_term(pod, wt.term)
+            out.append(self.sym_terms.lookup((k, m, float(wt.weight), SYM_SOFT_ANTI)))
+        return [i for i in out if i >= 0]
+
+    def pod_anti_term_ids(self, pod: Pod) -> List[int]:
+        out = []
+        for t in pod.affinity.pod_anti_affinity_required:
+            k, m = self._intern_pod_aff_term(pod, t)
+            out.append(self.anti_terms.lookup((k, m)))
+        return [i for i in out if i >= 0]
+
+    def intern_pref_affinity_program(self, pod: Pod) -> int:
+        """Preferred pod (anti)affinity -> signed weighted rows (the
+        incoming-pod half of CalculateInterPodAffinityPriority)."""
+        a = pod.affinity
+        if not (a.pod_affinity_preferred or a.pod_anti_affinity_preferred):
+            return -1
+        rows: List[Tuple[int, int, float]] = []
+        for wt in a.pod_affinity_preferred:
+            k, m = self._intern_pod_aff_term(pod, wt.term)
+            rows.append((k, m, float(wt.weight)))
+        for wt in a.pod_anti_affinity_preferred:
+            k, m = self._intern_pod_aff_term(pod, wt.term)
+            rows.append((k, m, -float(wt.weight)))
+        key = tuple(rows)
+        pid = self.pref_aff_programs.intern(key)
+        if pid == len(self.pref_aff_program_rows):
+            self.pref_aff_program_rows.append(rows)
+        return pid
+
+    def intern_spread_programs(self, pod: Pod, selprog_id: int) -> Tuple[int, int]:
+        """(hard_id, soft_id) topology-spread programs. Hard constraints
+        match same-namespace pods (metadata.go:246); soft constraints match
+        any namespace (even_pods_spread.go:137 — alpha quirk preserved)."""
+        hard: List[Tuple[int, int, int]] = []
+        soft: List[Tuple[int, int]] = []
+        for c in pod.topology_spread:
+            k = self.topo_keys.intern(c.topology_key)
+            if c.when_unsatisfiable == "DoNotSchedule":
+                m = self.intern_matcher((pod.namespace,), c.label_selector)
+                hard.append((k, m, int(c.max_skew)))
+            else:
+                m = self.intern_matcher(None, c.label_selector)
+                soft.append((k, m))
+        hid = sid = -1
+        if hard:
+            key = (tuple(hard), selprog_id)
+            hid = self.spread_hard_programs.intern(key)
+            if hid == len(self.spread_hard_program_rows):
+                self.spread_hard_program_rows.append((tuple(hard), selprog_id))
+        if soft:
+            key = (tuple(soft), selprog_id)
+            sid = self.spread_soft_programs.intern(key)
+            if sid == len(self.spread_soft_program_rows):
+                self.spread_soft_program_rows.append((tuple(soft), selprog_id))
+        return hid, sid
+
+    def self_aff_match(self, pod: Pod) -> bool:
+        """targetPodMatchesAffinityOfPod(pod, pod): the pod matches the
+        namespace+selector of ALL its required affinity terms — the
+        first-pod-of-a-group escape hatch (predicates.go:1437)."""
+        terms = pod.affinity.pod_affinity_required
+        if not terms:
+            return False
+        for t in terms:
+            ns = t.namespaces or (pod.namespace,)
+            if pod.namespace not in ns or not t.label_selector.matches(pod.labels):
+                return False
+        return True
+
+    def pod_matcher_row(self, pod: Pod, width: int) -> np.ndarray:
+        """Multihot of matchers this pod satisfies — its contribution to
+        per-node matcher counts when it is (or becomes) scheduled."""
+        row = np.zeros((width,), np.int8)
+        for mid in range(len(self.pod_matcher_items)):
+            if self.matcher_matches(mid, pod):
+                row[mid] = 1
+        return row
+
     # -- owner selectors (SelectorSpread) ----------------------------------
 
     def intern_owner_set(self, namespace: str, selectors) -> int:
@@ -293,6 +487,12 @@ class NodeTable:
     mem_pressure: np.ndarray  # (N,) bool
     disk_pressure: np.ndarray  # (N,) bool
     pid_pressure: np.ndarray  # (N,) bool
+    # ---- inter-pod affinity / spread state -------------------------------
+    topo_pair_id: np.ndarray  # (N, K) i32 — node's pair per topo key; -1 absent
+    matcher_counts: np.ndarray  # (N, M) f32 — scheduled pods matching matcher m
+    anti_counts: np.ndarray  # (N, Ua) f32 — pods carrying required anti term a
+    sym_counts: np.ndarray  # (N, Us) f32 — pods carrying sym scoring term s
+    aff_pod_count: np.ndarray  # (N,) f32 — pods with any (anti)affinity
 
 
 @dataclass
@@ -317,6 +517,16 @@ class PodTable:
     #: those columns of NodeTable.owner_counts (device-side spread update)
     owner_match_mh: np.ndarray  # (P, Uo) i8
     order: np.ndarray  # (P,) i32 — original index of each row (sort tracking)
+    # ---- inter-pod affinity / spread -------------------------------------
+    matcher_mh: np.ndarray  # (P, M) i8 — matchers this pod satisfies
+    affprog_id: np.ndarray  # (P,) i32 — required (anti)affinity program; -1 none
+    prefaffprog_id: np.ndarray  # (P,) i32 — preferred program; -1 none
+    spread_hard_id: np.ndarray  # (P,) i32
+    spread_soft_id: np.ndarray  # (P,) i32
+    self_aff_match: np.ndarray  # (P,) bool — pod matches own affinity terms
+    anti_term_mh: np.ndarray  # (P, Ua) i8 — its required anti terms
+    sym_term_mh: np.ndarray  # (P, Us) f32 — its sym terms (counts, can repeat)
+    has_aff: np.ndarray  # (P,) bool — any pod (anti)affinity at all
 
 
 @dataclass
@@ -351,6 +561,67 @@ class SelectorTables:
     image_sizes: np.ndarray  # (Ui,) f32
 
 
+@dataclass
+class TopologyTables:
+    """Flattened inter-pod-affinity + topology-spread term tables — the
+    static (per-universe) half of the topologyPairsMaps machinery
+    (predicates/metadata.go:65); the dynamic half is the per-node count
+    matrices in NodeTable (matcher/anti/sym counts) that the assignment
+    loop updates as pods land."""
+
+    n_pairs: int  # true topo-pair count (arrays padded to bucket)
+    n_matchers: int  # matcher-universe width M (bucketed, = widths()["M"])
+    # required (anti)affinity rows
+    ra_n_rows: int
+    ra_n_progs: int
+    ra_prog: np.ndarray  # (Ta,) i32
+    ra_key: np.ndarray  # (Ta,) i32 — topo-key index
+    ra_m: np.ndarray  # (Ta,) i32 — matcher id
+    ra_anti: np.ndarray  # (Ta,) bool
+    # preferred rows (signed weights)
+    rp_n_rows: int
+    rp_n_progs: int
+    rp_prog: np.ndarray
+    rp_key: np.ndarray
+    rp_m: np.ndarray
+    rp_w: np.ndarray  # (Tp,) f32 signed
+    # anti-term table (columns of NodeTable.anti_counts)
+    at_key: np.ndarray  # (Ua,) i32
+    at_m: np.ndarray  # (Ua,) i32
+    # sym-term table (columns of NodeTable.sym_counts)
+    st_key: np.ndarray  # (Us,) i32
+    st_m: np.ndarray  # (Us,) i32
+    st_w: np.ndarray  # (Us,) f32 — signed soft weight; 0 for hard terms
+    st_hard: np.ndarray  # (Us,) f32 — 1 for hard-affinity terms
+    # spread hard rows + per-program candidacy selector
+    sh_n_rows: int
+    sh_n_progs: int
+    sh_prog: np.ndarray
+    sh_key: np.ndarray
+    sh_m: np.ndarray
+    sh_skew: np.ndarray  # (Tsh,) f32
+    shp_selprog: np.ndarray  # (Gsh,) i32 — node-selector program; -1 = all
+    # spread soft rows
+    ss_n_rows: int
+    ss_n_progs: int
+    ss_prog: np.ndarray
+    ss_key: np.ndarray
+    ss_m: np.ndarray
+    ssp_selprog: np.ndarray  # (Gss,) i32
+
+
+def _pod_has_affinity(pod: Pod) -> bool:
+    """NodeInfo.PodsWithAffinity membership: any pod (anti)affinity,
+    required or preferred (nodeinfo/node_info.go AddPod)."""
+    a = pod.affinity
+    return bool(
+        a.pod_affinity_required
+        or a.pod_anti_affinity_required
+        or a.pod_affinity_preferred
+        or a.pod_anti_affinity_preferred
+    )
+
+
 def _matching_owner_sets(u: Universe, pod: Pod) -> List[int]:
     """Owner-set ids whose (namespace, selectors) match this pod — the
     single source of truth for SelectorSpread matching, used for both
@@ -378,19 +649,26 @@ class SnapshotPacker:
 
     # -- interning ---------------------------------------------------------
 
-    def intern_pod(self, pod: Pod) -> Tuple[int, int, int, int]:
-        """Returns (selprog, prefprog, tolset, owner) ids, cached per pod
-        identity (namespace/name/uid — uid so a deleted-and-recreated pod
-        with different spec is re-interned)."""
+    def intern_pod(self, pod: Pod) -> Tuple[int, ...]:
+        """Returns (selprog, prefprog, tolset, owner, affprog, prefaffprog,
+        spread_hard, spread_soft) ids, cached per pod identity
+        (namespace/name/uid — uid so a deleted-and-recreated pod with
+        different spec is re-interned)."""
         cached = self._pod_refs.get((pod.key(), pod.uid))
         if cached is not None:
             return cached
         u = self.u
+        selprog = u.intern_node_selector_program(pod.node_selector, pod.affinity)
+        spread_hard, spread_soft = u.intern_spread_programs(pod, selprog)
         refs = (
-            u.intern_node_selector_program(pod.node_selector, pod.affinity),
+            selprog,
             u.intern_preferred_program(pod.affinity),
             u.intern_toleration_set(pod.tolerations),
             u.intern_owner_set(pod.namespace, pod.spread_selectors),
+            u.intern_affinity_program(pod),
+            u.intern_pref_affinity_program(pod),
+            spread_hard,
+            spread_soft,
         )
         for name in pod.requests.scalars:
             u.scalar_resources.intern(name)
@@ -418,6 +696,16 @@ class SnapshotPacker:
             u.scalar_resources.intern(name)
         return nid
 
+    def _intern_node_topo_pairs(self, node: Node) -> None:
+        """Intern this node's (topo key, value) pairs for every topo key the
+        universe knows; must run after all pods of the cycle are interned so
+        the key set is complete."""
+        u = self.u
+        for kid, key in enumerate(u.topo_keys.items()):
+            v = node.labels.get(key)
+            if v is not None:
+                u.topo_pairs.intern((kid, v))
+
     # -- widths ------------------------------------------------------------
 
     def widths(self) -> Dict[str, int]:
@@ -432,6 +720,11 @@ class SnapshotPacker:
             "Ui": bucket_size(len(u.images)),
             "Uo": bucket_size(len(u.owner_sets)),
             "Uu": bucket_size(len(u.owner_uids)),
+            "K": bucket_size(len(u.topo_keys), 2),
+            "Utp": bucket_size(len(u.topo_pairs)),
+            "M": bucket_size(len(u.pod_matchers)),
+            "Ua": bucket_size(len(u.anti_terms), 4),
+            "Us": bucket_size(len(u.sym_terms), 4),
         }
 
     # -- nodes -------------------------------------------------------------
@@ -446,6 +739,8 @@ class SnapshotPacker:
             self.intern_node(nd)
         for p in scheduled_pods:
             self.intern_pod(p)
+        for nd in nodes:
+            self._intern_node_topo_pairs(nd)
         w = self.widths()
         n = len(nodes)
         R = w["R"]
@@ -472,6 +767,11 @@ class SnapshotPacker:
         mem_p = np.zeros((n,), bool)
         disk_p = np.zeros((n,), bool)
         pid_p = np.zeros((n,), bool)
+        topo_pair_id = np.full((n, w["K"]), -1, np.int32)
+        matcher_counts = np.zeros((n, w["M"]), np.float32)
+        anti_counts = np.zeros((n, w["Ua"]), np.float32)
+        sym_counts = np.zeros((n, w["Us"]), np.float32)
+        aff_pod_count = np.zeros((n,), np.float32)
 
         row_of: Dict[int, int] = {}
         for i, nd in enumerate(nodes):
@@ -512,6 +812,10 @@ class SnapshotPacker:
             mem_p[i] = nd.conditions.memory_pressure
             disk_p[i] = nd.conditions.disk_pressure
             pid_p[i] = nd.conditions.pid_pressure
+            for kid, key in enumerate(u.topo_keys.items()):
+                v = nd.labels.get(key)
+                if v is not None:
+                    topo_pair_id[i, kid] = u.topo_pairs.lookup((kid, v))
 
         # aggregate scheduled pods into node usage (NodeInfo.AddPod,
         # node_info.go — requested, nonzeroRequest, usedPorts, pod count)
@@ -536,6 +840,14 @@ class SnapshotPacker:
             # pod contributes to owner set `o` if it matches o's selectors.
             for o in _matching_owner_sets(u, p):
                 owner_counts[i, o] += 1
+            # inter-pod affinity / spread count matrices
+            matcher_counts[i] += self.u.pod_matcher_row(p, w["M"])
+            for a in u.pod_anti_term_ids(p):
+                anti_counts[i, a] += 1
+            for s in u.pod_sym_term_ids(p):
+                sym_counts[i, s] += 1
+            if _pod_has_affinity(p):
+                aff_pod_count[i] += 1
 
         return NodeTable(
             n=n,
@@ -565,6 +877,11 @@ class SnapshotPacker:
             mem_pressure=mem_p,
             disk_pressure=disk_p,
             pid_pressure=pid_p,
+            topo_pair_id=topo_pair_id,
+            matcher_counts=matcher_counts,
+            anti_counts=anti_counts,
+            sym_counts=sym_counts,
+            aff_pod_count=aff_pod_count,
         )
 
     # -- pods --------------------------------------------------------------
@@ -590,10 +907,27 @@ class SnapshotPacker:
         owner = np.full((n,), -1, np.int32)
         owner_uid = np.full((n,), -1, np.int32)
         owner_match = np.zeros((n, w["Uo"]), np.int8)
+        matcher_mh = np.zeros((n, w["M"]), np.int8)
+        affprog = np.full((n,), -1, np.int32)
+        prefaffprog = np.full((n,), -1, np.int32)
+        spread_hard = np.full((n,), -1, np.int32)
+        spread_soft = np.full((n,), -1, np.int32)
+        self_aff = np.zeros((n,), bool)
+        anti_term_mh = np.zeros((n, w["Ua"]), np.float32)
+        sym_term_mh = np.zeros((n, w["Us"]), np.float32)
+        has_aff = np.zeros((n,), bool)
 
         for i, p in enumerate(pods):
             refs = self.intern_pod(p)
-            selprog[i], prefprog[i], tolset[i], owner[i] = refs
+            (selprog[i], prefprog[i], tolset[i], owner[i],
+             affprog[i], prefaffprog[i], spread_hard[i], spread_soft[i]) = refs
+            matcher_mh[i] = u.pod_matcher_row(p, w["M"])
+            for a in u.pod_anti_term_ids(p):
+                anti_term_mh[i, a] += 1
+            for s in u.pod_sym_term_ids(p):
+                sym_term_mh[i, s] += 1
+            self_aff[i] = u.self_aff_match(p)
+            has_aff[i] = _pod_has_affinity(p)
             req[i] = self.u.resource_vector(p.effective_requests(), R)
             nonzero[i] = p.nonzero_requests()
             if p.node_name:
@@ -638,6 +972,15 @@ class SnapshotPacker:
             owner_uid_id=owner_uid,
             owner_match_mh=owner_match,
             order=np.arange(n, dtype=np.int32),
+            matcher_mh=matcher_mh,
+            affprog_id=affprog,
+            prefaffprog_id=prefaffprog,
+            spread_hard_id=spread_hard,
+            spread_soft_id=spread_soft,
+            self_aff_match=self_aff,
+            anti_term_mh=anti_term_mh,
+            sym_term_mh=sym_term_mh,
+            has_aff=has_aff,
         )
 
     # -- selector / toleration tables --------------------------------------
@@ -737,4 +1080,107 @@ class SnapshotPacker:
             tol_hard_mh=tol_hard,
             tol_soft_mh=tol_soft,
             image_sizes=sizes,
+        )
+
+    # -- topology / inter-pod affinity tables ------------------------------
+
+    def pack_topology_tables(self) -> TopologyTables:
+        u = self.u
+        w = self.widths()
+
+        def flat(progs_rows, with_extra: bool):
+            prog_l: List[int] = []
+            key_l: List[int] = []
+            m_l: List[int] = []
+            extra_l: List[float] = []
+            for pid, rows in enumerate(progs_rows):
+                for row in rows:
+                    prog_l.append(pid)
+                    key_l.append(row[0])
+                    m_l.append(row[1])
+                    if with_extra:
+                        extra_l.append(float(row[2]))
+            return prog_l, key_l, m_l, extra_l
+
+        # required rows: extra = is_anti
+        ra_prog, ra_key, ra_m, ra_anti = flat(u.aff_program_rows, True)
+        rp_prog, rp_key, rp_m, rp_w = flat(u.pref_aff_program_rows, True)
+
+        Ua, Us = w["Ua"], w["Us"]
+        at_key = np.zeros((Ua,), np.int32)
+        at_m = np.zeros((Ua,), np.int32)
+        for a, (k, m) in enumerate(u.anti_terms.items()):
+            at_key[a], at_m[a] = k, m
+        st_key = np.zeros((Us,), np.int32)
+        st_m = np.zeros((Us,), np.int32)
+        st_w = np.zeros((Us,), np.float32)
+        st_hard = np.zeros((Us,), np.float32)
+        for s, (k, m, wt, kind) in enumerate(u.sym_terms.items()):
+            st_key[s], st_m[s] = k, m
+            if kind == SYM_HARD_AFF:
+                st_hard[s] = 1.0
+            elif kind == SYM_SOFT_AFF:
+                st_w[s] = wt
+            else:
+                st_w[s] = -wt
+
+        sh_prog: List[int] = []
+        sh_key: List[int] = []
+        sh_m: List[int] = []
+        sh_skew: List[float] = []
+        shp_sel: List[int] = []
+        for pid, (rows, selprog) in enumerate(u.spread_hard_program_rows):
+            shp_sel.append(selprog)
+            for (k, m, skew) in rows:
+                sh_prog.append(pid)
+                sh_key.append(k)
+                sh_m.append(m)
+                sh_skew.append(float(skew))
+        ss_prog: List[int] = []
+        ss_key: List[int] = []
+        ss_m: List[int] = []
+        ssp_sel: List[int] = []
+        for pid, (rows, selprog) in enumerate(u.spread_soft_program_rows):
+            ssp_sel.append(selprog)
+            for (k, m) in rows:
+                ss_prog.append(pid)
+                ss_key.append(k)
+                ss_m.append(m)
+
+        i32 = lambda x: np.asarray(x, np.int32)
+        f32 = lambda x: np.asarray(x, np.float32)
+        return TopologyTables(
+            n_pairs=len(u.topo_pairs),
+            n_matchers=w["M"],
+            ra_n_rows=len(ra_prog),
+            ra_n_progs=len(u.aff_program_rows),
+            ra_prog=i32(ra_prog),
+            ra_key=i32(ra_key),
+            ra_m=i32(ra_m),
+            ra_anti=np.asarray(ra_anti, bool) if ra_anti else np.zeros((0,), bool),
+            rp_n_rows=len(rp_prog),
+            rp_n_progs=len(u.pref_aff_program_rows),
+            rp_prog=i32(rp_prog),
+            rp_key=i32(rp_key),
+            rp_m=i32(rp_m),
+            rp_w=f32(rp_w),
+            at_key=at_key,
+            at_m=at_m,
+            st_key=st_key,
+            st_m=st_m,
+            st_w=st_w,
+            st_hard=st_hard,
+            sh_n_rows=len(sh_prog),
+            sh_n_progs=len(u.spread_hard_program_rows),
+            sh_prog=i32(sh_prog),
+            sh_key=i32(sh_key),
+            sh_m=i32(sh_m),
+            sh_skew=f32(sh_skew),
+            shp_selprog=i32(shp_sel),
+            ss_n_rows=len(ss_prog),
+            ss_n_progs=len(u.spread_soft_program_rows),
+            ss_prog=i32(ss_prog),
+            ss_key=i32(ss_key),
+            ss_m=i32(ss_m),
+            ssp_selprog=i32(ssp_sel),
         )

@@ -153,6 +153,241 @@ def feasible(pod: Pod, node: Node, node_pods: Sequence[Pod]) -> bool:
     )
 
 
+# -- inter-pod affinity / topology spread (predicates.go:1211,:1720) --------
+
+
+def _term_matches_pod(defining_pod: Pod, term, target: Pod) -> bool:
+    """PodMatchesTermsNamespaceAndSelector: empty namespaces default to the
+    defining pod's namespace."""
+    ns = term.namespaces or (defining_pod.namespace,)
+    return target.namespace in ns and term.label_selector.matches(target.labels)
+
+
+def _same_topology(a: Node, b: Node, key: str) -> bool:
+    """priorityutil.NodesHaveSameTopologyKey."""
+    return key in a.labels and key in b.labels and a.labels[key] == b.labels[key]
+
+
+def _pod_has_affinity(p: Pod) -> bool:
+    a = p.affinity
+    return bool(
+        a.pod_affinity_required
+        or a.pod_anti_affinity_required
+        or a.pod_affinity_preferred
+        or a.pod_anti_affinity_preferred
+    )
+
+
+def inter_pod_affinity_feasible(
+    pod: Pod, node: Node, nodes: Sequence[Node], node_pods: Dict[str, List[Pod]]
+) -> bool:
+    """InterPodAffinityMatches via the metadata path (merged pair maps)."""
+    by_name = {nd.name: nd for nd in nodes}
+    existing = [(e, by_name[n]) for n in node_pods for e in node_pods[n] if n in by_name]
+
+    # satisfiesExistingPodsAntiAffinity: merged (key, value) pairs from
+    # existing pods' required anti terms that match the incoming pod
+    anti_pairs = set()
+    for e, en in existing:
+        for t in e.affinity.pod_anti_affinity_required:
+            if _term_matches_pod(e, t, pod):
+                v = en.labels.get(t.topology_key)
+                if v is not None:
+                    anti_pairs.add((t.topology_key, v))
+    for k, v in node.labels.items():
+        if (k, v) in anti_pairs:
+            return False
+
+    aff_terms = pod.affinity.pod_affinity_required
+    if aff_terms:
+        pairs = set()
+        for e, en in existing:
+            for t in aff_terms:
+                if _term_matches_pod(pod, t, e):
+                    v = en.labels.get(t.topology_key)
+                    if v is not None:
+                        pairs.add((t.topology_key, v))
+        match_all = all(
+            t.topology_key in node.labels
+            and (t.topology_key, node.labels[t.topology_key]) in pairs
+            for t in aff_terms
+        )
+        if not match_all:
+            self_ok = all(_term_matches_pod(pod, t, pod) for t in aff_terms)
+            if not (len(pairs) == 0 and self_ok):
+                return False
+
+    anti_terms = pod.affinity.pod_anti_affinity_required
+    if anti_terms:
+        pairs = set()
+        for e, en in existing:
+            for t in anti_terms:
+                if _term_matches_pod(pod, t, e):
+                    v = en.labels.get(t.topology_key)
+                    if v is not None:
+                        pairs.add((t.topology_key, v))
+        for t in anti_terms:
+            v = node.labels.get(t.topology_key)
+            if v is not None and (t.topology_key, v) in pairs:
+                return False
+    return True
+
+
+def even_pods_spread_feasible(
+    pod: Pod, node: Node, nodes: Sequence[Node], node_pods: Dict[str, List[Pod]]
+) -> bool:
+    """EvenPodsSpreadPredicate via getTPMapMatchingSpreadConstraints."""
+    constraints = [c for c in pod.topology_spread if c.when_unsatisfiable == "DoNotSchedule"]
+    if not constraints:
+        return True
+
+    def candidate(nd: Node) -> bool:
+        return pod_match_node_selector(pod, nd) and all(
+            c.topology_key in nd.labels for c in constraints
+        )
+
+    # pair -> SET of pods (union across same-key constraints, metadata.go
+    # addTopologyPair uses a pod set)
+    pair_pods: Dict[Tuple[str, str], set] = {}
+    for nd in nodes:
+        if not candidate(nd):
+            continue
+        for c in constraints:
+            pair = (c.topology_key, nd.labels[c.topology_key])
+            s = pair_pods.setdefault(pair, set())
+            for e in node_pods.get(nd.name, []):
+                if e.namespace == pod.namespace and c.label_selector.matches(e.labels):
+                    s.add((e.namespace, e.name))
+    min_match: Dict[str, int] = {}
+    for (k, _v), s in pair_pods.items():
+        if k not in min_match or len(s) < min_match[k]:
+            min_match[k] = len(s)
+
+    for c in constraints:
+        v = node.labels.get(c.topology_key)
+        if v is None:
+            return False
+        if c.topology_key not in min_match:
+            continue  # MaxInt32 sentinel: skew can't exceed
+        self_match = 1 if c.label_selector.matches(pod.labels) else 0
+        match_num = len(pair_pods.get((c.topology_key, v), set()))
+        if match_num + self_match - min_match[c.topology_key] > c.max_skew:
+            return False
+    return True
+
+
+def interpod_affinity_scores(
+    pods: Sequence[Pod],
+    nodes: Sequence[Node],
+    node_pods: Dict[str, List[Pod]],
+    feasible_mask,
+    hard_weight: float = 1.0,
+) -> List[List[int]]:
+    """CalculateInterPodAffinityPriority with full symmetry."""
+    by_name = {nd.name: nd for nd in nodes}
+    existing = [(e, by_name[n]) for n in node_pods for e in node_pods[n] if n in by_name]
+    out = []
+    for i, pod in enumerate(pods):
+        has_aff = _pod_has_affinity(pod)
+        counted = {
+            nd.name
+            for nd in nodes
+            if has_aff or any(_pod_has_affinity(e) for e in node_pods.get(nd.name, []))
+        }
+        counts: Dict[str, float] = {n: 0.0 for n in counted}
+        for e, en in existing:
+            for nd in nodes:
+                if nd.name not in counts:
+                    continue
+                a = pod.affinity
+                for wt in a.pod_affinity_preferred:
+                    if _term_matches_pod(pod, wt.term, e) and _same_topology(nd, en, wt.term.topology_key):
+                        counts[nd.name] += wt.weight
+                for wt in a.pod_anti_affinity_preferred:
+                    if _term_matches_pod(pod, wt.term, e) and _same_topology(nd, en, wt.term.topology_key):
+                        counts[nd.name] -= wt.weight
+                ea = e.affinity
+                for t in ea.pod_affinity_required:
+                    if hard_weight > 0 and _term_matches_pod(e, t, pod) and _same_topology(nd, en, t.topology_key):
+                        counts[nd.name] += hard_weight
+                for wt in ea.pod_affinity_preferred:
+                    if _term_matches_pod(e, wt.term, pod) and _same_topology(nd, en, wt.term.topology_key):
+                        counts[nd.name] += wt.weight
+                for wt in ea.pod_anti_affinity_preferred:
+                    if _term_matches_pod(e, wt.term, pod) and _same_topology(nd, en, wt.term.topology_key):
+                        counts[nd.name] -= wt.weight
+        idx = [j for j in range(len(nodes)) if feasible_mask[i][j] and nodes[j].name in counts]
+        mx = max([counts[nodes[j].name] for j in idx], default=0.0)
+        mn = min([counts[nodes[j].name] for j in idx], default=0.0)
+        mx, mn = max(mx, 0.0), min(mn, 0.0)
+        row = [0] * len(nodes)
+        for j in range(len(nodes)):
+            if nodes[j].name in counts and mx - mn > 0:
+                row[j] = int(MAX_PRIORITY * (counts[nodes[j].name] - mn) / (mx - mn))
+        out.append(row)
+    return out
+
+
+def even_pods_spread_scores(
+    pods: Sequence[Pod],
+    nodes: Sequence[Node],
+    node_pods: Dict[str, List[Pod]],
+    feasible_mask,
+) -> List[List[int]]:
+    """CalculateEvenPodsSpreadPriority (even_pods_spread.go:86)."""
+    out = []
+    for i, pod in enumerate(pods):
+        constraints = [c for c in pod.topology_spread if c.when_unsatisfiable == "ScheduleAnyway"]
+        row = [0] * len(nodes)
+        if not constraints:
+            out.append(row)
+            continue
+        filtered = [nodes[j] for j in range(len(nodes)) if feasible_mask[i][j]]
+        keyed = lambda nd: all(c.topology_key in nd.labels for c in constraints)
+        # initialize(): eligibility + pair init from filtered keyed nodes
+        eligible = {nd.name for nd in filtered if keyed(nd)}
+        pair_counts: Dict[Tuple[str, str], float] = {}
+        for nd in filtered:
+            if keyed(nd):
+                for c in constraints:
+                    pair_counts.setdefault((c.topology_key, nd.labels[c.topology_key]), 0.0)
+        # processAllNode: count from ALL selector-passing keyed nodes
+        for nd in nodes:
+            if not (pod_match_node_selector(pod, nd) and keyed(nd)):
+                continue
+            for c in constraints:
+                pair = (c.topology_key, nd.labels[c.topology_key])
+                if pair not in pair_counts:
+                    continue
+                pair_counts[pair] += sum(
+                    1 for e in node_pods.get(nd.name, [])
+                    if c.label_selector.matches(e.labels)  # NO namespace check
+                )
+        node_counts: Dict[str, float] = {}
+        total = 0.0
+        for nd in nodes:
+            if nd.name not in eligible:
+                continue
+            s = 0.0
+            for c in constraints:
+                v = nd.labels.get(c.topology_key)
+                if v is not None:
+                    s += pair_counts.get((c.topology_key, v), 0.0)
+            node_counts[nd.name] = s
+            total += s
+        min_count = min(node_counts.values(), default=0.0)
+        diff = total - min_count
+        for j, nd in enumerate(nodes):
+            if nd.name not in node_counts:
+                continue
+            if diff == 0:
+                row[j] = MAX_PRIORITY
+            else:
+                row[j] = int(MAX_PRIORITY * (total - node_counts[nd.name]) / diff)
+        out.append(row)
+    return out
+
+
 # -- priorities -------------------------------------------------------------
 
 

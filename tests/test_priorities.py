@@ -62,7 +62,7 @@ def test_resource_allocation_family_differential():
             ("most", prio.most_requested, pyref.most_requested_score),
             ("balanced", prio.balanced_allocation, pyref.balanced_allocation_score),
         ]:
-            got = crop(kernel(dp, dn, ds, mask), pending, nodes)
+            got = crop(kernel(dp, dn, ds, None, mask), pending, nodes)
             want = [
                 [oracle(p, nd, npods[nd.name]) for nd in nodes] for p in pending
             ]
@@ -75,7 +75,7 @@ def test_taint_toleration_differential():
         nodes, scheduled, pending = random_cluster(rng, n_nodes=10, n_sched=5, n_pending=10)
         dn, dp, ds, mask = build(nodes, scheduled, pending)
         m = crop(mask, pending, nodes)
-        got = crop(prio.taint_toleration(dp, dn, ds, mask), pending, nodes)
+        got = crop(prio.taint_toleration(dp, dn, ds, None, mask), pending, nodes)
         want = pyref.taint_toleration_scores(pending, nodes, m)
         assert_matches(got, want, pending, nodes, m, "taint_toleration")
 
@@ -96,7 +96,7 @@ def test_node_affinity_preferred_differential():
     pending.append(make_pod("noaff"))
     dn, dp, ds, mask = build(nodes, [], pending)
     m = crop(mask, pending, nodes)
-    got = crop(prio.node_affinity(dp, dn, ds, mask), pending, nodes)
+    got = crop(prio.node_affinity(dp, dn, ds, None, mask), pending, nodes)
     want = pyref.node_affinity_scores(pending, nodes, m)
     assert_matches(got, want, pending, nodes, m, "node_affinity")
 
@@ -123,7 +123,7 @@ def test_selector_spread_differential():
         ] + [make_pod("plain")]
         dn, dp, ds, mask = build(nodes, scheduled, pending)
         m = crop(mask, pending, nodes)
-        got = crop(prio.selector_spread(dp, dn, ds, mask), pending, nodes)
+        got = crop(prio.selector_spread(dp, dn, ds, None, mask), pending, nodes)
         want = pyref.selector_spread_scores(pending, nodes, by_node(nodes, scheduled), m)
         assert_matches(got, want, pending, nodes, m, "selector_spread")
 
@@ -141,7 +141,7 @@ def test_image_locality_differential():
     ]
     dn, dp, ds, mask = build(nodes, [], pending)
     m = crop(mask, pending, nodes)
-    got = crop(prio.image_locality(dp, dn, ds, mask), pending, nodes)
+    got = crop(prio.image_locality(dp, dn, ds, None, mask), pending, nodes)
     want = pyref.image_locality_scores(pending, nodes)
     assert_matches(got, want, pending, nodes, m, "image_locality")
 
@@ -158,7 +158,7 @@ def test_node_prefer_avoid_differential():
     ]
     dn, dp, ds, mask = build(nodes, [], pending)
     m = crop(mask, pending, nodes)
-    got = crop(prio.node_prefer_avoid(dp, dn, ds, mask), pending, nodes)
+    got = crop(prio.node_prefer_avoid(dp, dn, ds, None, mask), pending, nodes)
     want = pyref.prefer_avoid_scores(pending, nodes)
     assert_matches(got, want, pending, nodes, m, "prefer_avoid")
 

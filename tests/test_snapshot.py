@@ -52,7 +52,7 @@ def test_selector_tables_flatten():
     a = node_affinity_required([req("zone", OP_IN, "a", "b")],
                                [req("disk", OP_IN, "ssd")])
     p = make_pod("p0", node_selector={"arch": "amd64"}, affinity=a)
-    selprog, _, _, _ = pk.intern_pod(p)
+    selprog = pk.intern_pod(p)[0]
     assert selprog == 0
     st = pk.pack_selector_tables()
     # two OR terms, each with the base nodeSelector expr + own expr

@@ -1,0 +1,376 @@
+"""Differential tests for inter-pod affinity + topology spread — the analog
+of predicates_test.go (TestInterPodAffinity*, TestEvenPodsSpreadPredicate)
+and priorities' interpod_affinity_test.go / even_pods_spread_test.go, run as
+device-vs-oracle comparisons over randomized clusters."""
+
+import random
+
+import numpy as np
+
+import pyref
+from kubernetes_tpu.api.types import (
+    Affinity,
+    LabelSelector,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from kubernetes_tpu.ops.arrays import (
+    nodes_to_device,
+    pods_to_device,
+    selectors_to_device,
+    topology_to_device,
+)
+from kubernetes_tpu.ops.predicates import BIT, run_predicates
+from kubernetes_tpu.ops.topology import (
+    even_pods_spread_score,
+    inter_pod_affinity_score,
+)
+from kubernetes_tpu.ops.predicates import selector_program_match
+from kubernetes_tpu.snapshot import SnapshotPacker
+from kubernetes_tpu.testing import make_node, make_pod
+
+HOSTNAME = "kubernetes.io/hostname"
+ZONE = "zone"
+
+
+def build(nodes, scheduled, pending):
+    pk = SnapshotPacker()
+    for p in list(scheduled) + list(pending):
+        pk.intern_pod(p)
+    nt = pk.pack_nodes(nodes, scheduled)
+    pt = pk.pack_pods(pending)
+    st = pk.pack_selector_tables()
+    tt = pk.pack_topology_tables()
+    dn, dp = nodes_to_device(nt), pods_to_device(pt)
+    ds, dt = selectors_to_device(st), topology_to_device(tt)
+    return dn, dp, ds, dt
+
+
+def by_node(nodes, scheduled):
+    d = {nd.name: [] for nd in nodes}
+    for p in scheduled:
+        if p.node_name in d:
+            d[p.node_name].append(p)
+    return d
+
+
+def oracle_mask(pending, nodes, node_pods):
+    rows = []
+    for p in pending:
+        rows.append([
+            pyref.feasible(p, nd, node_pods[nd.name])
+            and pyref.inter_pod_affinity_feasible(p, nd, nodes, node_pods)
+            and pyref.even_pods_spread_feasible(p, nd, nodes, node_pods)
+            for nd in nodes
+        ])
+    return np.asarray(rows)
+
+
+def term(key, labels, namespaces=()):
+    return PodAffinityTerm(
+        label_selector=LabelSelector(match_labels=dict(labels)),
+        topology_key=key,
+        namespaces=tuple(namespaces),
+    )
+
+
+def random_affinity_cluster(rng, n_nodes=10, n_sched=20, n_pending=12):
+    nodes = [
+        make_node(f"n{i}", labels={ZONE: f"z{i % 3}"})
+        for i in range(n_nodes)
+    ]
+    apps = ["web", "db", "cache"]
+    scheduled = []
+    for i in range(n_sched):
+        app = rng.choice(apps)
+        p = make_pod(
+            f"s{i}",
+            node_name=f"n{rng.randrange(n_nodes)}",
+            labels={"app": app},
+            namespace=rng.choice(["default", "other"]),
+        )
+        r = rng.random()
+        if r < 0.25:
+            # existing pod with required anti-affinity (symmetry pressure)
+            p.affinity = Affinity(
+                pod_anti_affinity_required=(term(rng.choice([HOSTNAME, ZONE]), {"app": app}),)
+            )
+        elif r < 0.4:
+            p.affinity = Affinity(
+                pod_affinity_required=(term(ZONE, {"app": rng.choice(apps)}),)
+            )
+        elif r < 0.55:
+            p.affinity = Affinity(
+                pod_affinity_preferred=(
+                    WeightedPodAffinityTerm(rng.choice([1, 5]), term(ZONE, {"app": rng.choice(apps)})),
+                ),
+                pod_anti_affinity_preferred=(
+                    WeightedPodAffinityTerm(rng.choice([1, 3]), term(HOSTNAME, {"app": app})),
+                ),
+            )
+        scheduled.append(p)
+    pending = []
+    for i in range(n_pending):
+        app = rng.choice(apps)
+        p = make_pod(f"p{i}", labels={"app": app}, namespace=rng.choice(["default", "other"]))
+        r = rng.random()
+        if r < 0.25:
+            p.affinity = Affinity(
+                pod_affinity_required=(term(ZONE, {"app": rng.choice(apps)}),)
+            )
+        elif r < 0.45:
+            p.affinity = Affinity(
+                pod_anti_affinity_required=(term(rng.choice([HOSTNAME, ZONE]), {"app": app}),)
+            )
+        elif r < 0.6:
+            p.affinity = Affinity(
+                pod_affinity_required=(term(ZONE, {"app": app}),),  # maybe self-match
+                pod_anti_affinity_required=(term(HOSTNAME, {"app": app}),),
+            )
+        elif r < 0.8:
+            p.affinity = Affinity(
+                pod_affinity_preferred=(
+                    WeightedPodAffinityTerm(rng.choice([2, 7]), term(ZONE, {"app": rng.choice(apps)})),
+                ),
+                pod_anti_affinity_preferred=(
+                    WeightedPodAffinityTerm(rng.choice([1, 4]), term(ZONE, {"app": rng.choice(apps)})),
+                ),
+            )
+        pending.append(p)
+    return nodes, scheduled, pending
+
+
+def test_inter_pod_affinity_mask_differential():
+    for seed in range(8):
+        rng = random.Random(500 + seed)
+        nodes, scheduled, pending = random_affinity_cluster(rng)
+        dn, dp, ds, dt = build(nodes, scheduled, pending)
+        got = np.asarray(run_predicates(dp, dn, ds, dt).mask)[: len(pending), : len(nodes)]
+        want = oracle_mask(pending, nodes, by_node(nodes, scheduled))
+        if not (got == want).all():
+            i, j = np.argwhere(got != want)[0]
+            reasons = np.asarray(run_predicates(dp, dn, ds, dt).reasons)[i, j]
+            raise AssertionError(
+                f"seed {seed}: pod {pending[i].name} node {nodes[j].name}: "
+                f"device={got[i,j]} oracle={want[i,j]} reasons={reasons:#x}\n"
+                f"pod={pending[i]}"
+            )
+
+
+def test_self_match_first_pod_of_group():
+    """A pod with affinity to its own labels must schedule when no matching
+    pod exists anywhere (predicates.go:1437)."""
+    nodes = [make_node(f"n{i}", labels={ZONE: "z0"}) for i in range(3)]
+    lone = make_pod("lone", labels={"app": "solo"})
+    lone.affinity = Affinity(pod_affinity_required=(term(ZONE, {"app": "solo"}),))
+    stranger = make_pod("stranger", labels={"app": "x"})
+    stranger.affinity = Affinity(pod_affinity_required=(term(ZONE, {"app": "nonexistent"}),))
+    dn, dp, ds, dt = build(nodes, [], [lone, stranger])
+    mask = np.asarray(run_predicates(dp, dn, ds, dt).mask)
+    assert mask[0, :3].all()  # self-match escape
+    assert not mask[1, :3].any()  # no self-match, no existing match
+
+
+def test_existing_anti_affinity_symmetry():
+    """An existing pod with required anti-affinity against app=web on a zone
+    keeps web pods out of that whole zone."""
+    nodes = [make_node(f"n{i}", labels={ZONE: f"z{i % 2}"}) for i in range(4)]
+    guard = make_pod("guard", labels={"app": "guard"}, node_name="n0")
+    guard.affinity = Affinity(pod_anti_affinity_required=(term(ZONE, {"app": "web"}),))
+    web = make_pod("web", labels={"app": "web"})
+    other = make_pod("other", labels={"app": "db"})
+    dn, dp, ds, dt = build(nodes, [guard], [web, other])
+    mask = np.asarray(run_predicates(dp, dn, ds, dt).mask)
+    # z0 = n0, n2 blocked for web; z1 = n1, n3 open
+    assert not mask[0, 0] and not mask[0, 2]
+    assert mask[0, 1] and mask[0, 3]
+    assert mask[1, :4].all()
+
+
+def random_spread_cluster(rng, n_nodes=9, n_sched=18, n_pending=8):
+    nodes = [
+        make_node(f"n{i}", labels={ZONE: f"z{i % 3}"})
+        for i in range(n_nodes)
+    ]
+    scheduled = [
+        make_pod(
+            f"s{i}",
+            node_name=f"n{rng.randrange(n_nodes)}",
+            labels={"app": rng.choice(["web", "db"])},
+            namespace=rng.choice(["default", "other"]),
+        )
+        for i in range(n_sched)
+    ]
+    pending = []
+    for i in range(n_pending):
+        p = make_pod(f"p{i}", labels={"app": "web"})
+        cons = []
+        if rng.random() < 0.7:
+            cons.append(TopologySpreadConstraint(
+                max_skew=rng.choice([1, 2]),
+                topology_key=rng.choice([ZONE, HOSTNAME]),
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"app": "web"}),
+            ))
+        if rng.random() < 0.5:
+            cons.append(TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=ZONE,
+                when_unsatisfiable="ScheduleAnyway",
+                label_selector=LabelSelector(match_labels={"app": rng.choice(["web", "db"])}),
+            ))
+        p.topology_spread = tuple(cons)
+        if rng.random() < 0.3:
+            p.node_selector = {ZONE: rng.choice(["z0", "z1"])}
+        pending.append(p)
+    return nodes, scheduled, pending
+
+
+def test_even_pods_spread_mask_differential():
+    for seed in range(8):
+        rng = random.Random(700 + seed)
+        nodes, scheduled, pending = random_spread_cluster(rng)
+        dn, dp, ds, dt = build(nodes, scheduled, pending)
+        got = np.asarray(run_predicates(dp, dn, ds, dt).mask)[: len(pending), : len(nodes)]
+        want = oracle_mask(pending, nodes, by_node(nodes, scheduled))
+        if not (got == want).all():
+            i, j = np.argwhere(got != want)[0]
+            raise AssertionError(
+                f"seed {seed}: pod {pending[i].name} node {nodes[j].name}: "
+                f"device={got[i,j]} oracle={want[i,j]}\npod={pending[i]}"
+            )
+
+
+def test_interpod_affinity_score_differential():
+    for seed in range(6):
+        rng = random.Random(900 + seed)
+        nodes, scheduled, pending = random_affinity_cluster(rng, n_nodes=8, n_sched=14, n_pending=8)
+        dn, dp, ds, dt = build(nodes, scheduled, pending)
+        mask = run_predicates(dp, dn, ds, dt).mask
+        got = np.asarray(inter_pod_affinity_score(dp, dn, dt, mask))[: len(pending), : len(nodes)]
+        node_pods = by_node(nodes, scheduled)
+        m = np.asarray(mask)[: len(pending), : len(nodes)]
+        want = np.asarray(
+            pyref.interpod_affinity_scores(pending, nodes, node_pods, m), np.float64
+        )
+        ok = (np.abs(got - want) < 1e-6) | ~m
+        if not ok.all():
+            i, j = np.argwhere(~ok)[0]
+            raise AssertionError(
+                f"seed {seed}: pod {pending[i].name} node {nodes[j].name}: "
+                f"device={got[i,j]} oracle={want[i,j]}\npod={pending[i]}"
+            )
+
+
+def test_batch_assign_anti_affinity_in_round():
+    """Regression: with per_node_cap > 1, mutually anti-affine pods must NOT
+    co-locate within one admission round (code-review finding r1)."""
+    from kubernetes_tpu.ops.assign import batch_assign, greedy_assign
+
+    nodes = [make_node(f"n{i}", labels={ZONE: f"z{i % 2}"}) for i in range(4)]
+    pend = []
+    for i in range(4):
+        p = make_pod(f"x{i}", labels={"app": "x"}, cpu_milli=100, memory=2**28)
+        p.affinity = Affinity(
+            pod_anti_affinity_required=(term(HOSTNAME, {"app": "x"}),)
+        )
+        pend.append(p)
+    dn, dp, ds, dt = build(nodes, [], pend)
+    for cap in (1, 4):
+        a, _, _ = batch_assign(dp, dn, ds, per_node_cap=cap, topo=dt)
+        a = np.asarray(a)[:4]
+        placed = a[a >= 0]
+        assert len(placed) == 4 and len(set(placed.tolist())) == 4, (cap, a)
+    g, _ = greedy_assign(dp, dn, ds, topo=dt)
+    g = np.asarray(g)[:4]
+    assert len(set(g[g >= 0].tolist())) == len(g[g >= 0]) == 4
+
+
+def test_batch_assign_zone_anti_affinity_in_round():
+    """Zone-scope anti-affinity: same-round admissions to *different nodes*
+    of one zone must also be serialized (violation possible even at
+    per_node_cap=1)."""
+    from kubernetes_tpu.ops.assign import batch_assign
+
+    nodes = [make_node(f"n{i}", labels={ZONE: f"z{i % 2}"}) for i in range(6)]
+    pend = []
+    for i in range(4):
+        p = make_pod(f"x{i}", labels={"app": "x"}, cpu_milli=100, memory=2**28)
+        p.affinity = Affinity(
+            pod_anti_affinity_required=(term(ZONE, {"app": "x"}),)
+        )
+        pend.append(p)
+    dn, dp, ds, dt = build(nodes, [], pend)
+    a, _, _ = batch_assign(dp, dn, ds, per_node_cap=4, topo=dt)
+    a = np.asarray(a)[:4]
+    placed = a[a >= 0]
+    zones = [int(n) % 2 for n in placed]
+    assert len(placed) == 2 and len(set(zones)) == 2, a
+
+
+def test_batch_assign_spread_in_round():
+    """Hard spread maxSkew=1 must hold within rounds at per_node_cap > 1."""
+    from kubernetes_tpu.ops.assign import batch_assign
+
+    nodes = [make_node(f"n{i}", labels={ZONE: f"z{i % 3}"}) for i in range(9)]
+    pend = []
+    for i in range(9):
+        p = make_pod(f"s{i}", labels={"app": "web"}, cpu_milli=100, memory=2**28)
+        p.topology_spread = (TopologySpreadConstraint(
+            1, ZONE, "DoNotSchedule", LabelSelector(match_labels={"app": "web"})
+        ),)
+        pend.append(p)
+    dn, dp, ds, dt = build(nodes, [], pend)
+    a, _, _ = batch_assign(dp, dn, ds, per_node_cap=8, topo=dt)
+    a = np.asarray(a)[:9]
+    assert (a >= 0).all(), a
+    zc = {}
+    for n in a:
+        zc[int(n) % 3] = zc.get(int(n) % 3, 0) + 1
+    assert max(zc.values()) - min(zc.values()) <= 1, zc
+
+
+def test_batch_assign_single_escapee_per_round():
+    """Two first-pods-of-a-group (self-match escape) must land in the SAME
+    topology group — the second may not escape in the same round."""
+    from kubernetes_tpu.ops.assign import batch_assign
+
+    nodes = [make_node(f"n{i}", labels={ZONE: f"z{i % 3}"}) for i in range(6)]
+    pend = []
+    for i in range(3):
+        p = make_pod(f"g{i}", labels={"app": "gang"}, cpu_milli=100, memory=2**28)
+        p.affinity = Affinity(
+            pod_affinity_required=(term(ZONE, {"app": "gang"}),)
+        )
+        pend.append(p)
+    dn, dp, ds, dt = build(nodes, [], pend)
+    a, _, _ = batch_assign(dp, dn, ds, per_node_cap=4, topo=dt)
+    a = np.asarray(a)[:3]
+    assert (a >= 0).all(), a
+    zones = {int(n) % 3 for n in a}
+    assert len(zones) == 1, f"gang split across zones: {a}"
+
+
+def test_even_pods_spread_score_differential():
+    for seed in range(6):
+        rng = random.Random(1100 + seed)
+        nodes, scheduled, pending = random_spread_cluster(rng)
+        dn, dp, ds, dt = build(nodes, scheduled, pending)
+        mask = run_predicates(dp, dn, ds, dt).mask
+        sel_match = selector_program_match(ds, dn)
+        got = np.asarray(even_pods_spread_score(dp, dn, dt, sel_match, mask))[
+            : len(pending), : len(nodes)
+        ]
+        node_pods = by_node(nodes, scheduled)
+        m = np.asarray(mask)[: len(pending), : len(nodes)]
+        want = np.asarray(
+            pyref.even_pods_spread_scores(pending, nodes, node_pods, m), np.float64
+        )
+        ok = (np.abs(got - want) < 1e-6) | ~m
+        if not ok.all():
+            i, j = np.argwhere(~ok)[0]
+            raise AssertionError(
+                f"seed {seed}: pod {pending[i].name} node {nodes[j].name}: "
+                f"device={got[i,j]} oracle={want[i,j]}\npod={pending[i]}"
+            )
