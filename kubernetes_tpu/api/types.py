@@ -229,6 +229,9 @@ class Pod:
     #: monotonically increasing arrival stamp used for queue ordering
     #: (the reference orders activeQ by priority then timestamp).
     queued_at: float = 0.0
+    #: status.nominatedNodeName — set by preemption so the victim's node
+    #: holds capacity for this pod while it retries (scheduler.go:316).
+    nominated_node_name: str = ""
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"

@@ -1,0 +1,282 @@
+"""Scheduler cache — in-memory truth about nodes and (assumed) pods, with
+generation-tracked incremental snapshot packing.
+
+Reference: ``pkg/scheduler/internal/cache/cache.go``. Two ideas carry over
+directly:
+
+1. **Assumed-pod state machine** (``cache/interface.go:36-47``): the driver
+   optimistically AssumePod()s a pod onto its chosen node the moment the
+   algorithm picks it, so the next cycle sees the capacity as used while the
+   binding RPC is still in flight. FinishBinding starts a TTL; if the bound
+   pod add never arrives from the watch before the TTL, the assumption
+   expires and capacity frees (``cache.go:674`` cleanupAssumedPods).
+   ForgetPod undoes an assumption on bind failure (``scheduler.go:447``).
+
+2. **Generation-ordered incremental snapshots** (``cache.go:211``
+   UpdateNodeInfoSnapshot, ``cache.go:135`` moveNodeInfoToHead): every
+   mutation bumps a per-node generation; snapshotting recomputes only rows
+   whose generation passed the last snapshot. Here the columnar NodeTable is
+   cached and only dirty node rows are repacked (a full repack happens only
+   when universe widths or the node set shape change — rare by design,
+   since widths are power-of-two bucketed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.snapshot import NodeTable, SnapshotPacker
+
+#: cache.go — factory.NewConfigFactory wires a 30 s assumed-pod TTL.
+DEFAULT_ASSUME_TTL_S = 30.0
+
+# assumed-pod states
+_ASSUMED = "assumed"  # Assume() called, bind in flight
+_EXPIRING = "expiring"  # FinishBinding() called, TTL armed
+_ADDED = "added"  # confirmed via watch AddPod
+
+
+class CacheError(Exception):
+    pass
+
+
+class SchedulerCache:
+    """Host-side cluster cache. Thread-free by design (the driver is a
+    single loop around device dispatch); the watch pump calls the mutators
+    between cycles."""
+
+    def __init__(
+        self,
+        packer: Optional[SnapshotPacker] = None,
+        ttl_s: float = DEFAULT_ASSUME_TTL_S,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.packer = packer or SnapshotPacker()
+        self.ttl_s = ttl_s
+        self.clock = clock
+        self._nodes: Dict[str, Node] = {}
+        self._pods_by_node: Dict[str, Dict[str, Pod]] = {}
+        self._pod_state: Dict[str, str] = {}  # key -> assumed state
+        self._pod_node: Dict[str, str] = {}  # key -> node name
+        self._pod_deadline: Dict[str, float] = {}  # key -> expiry (EXPIRING only)
+        self._dirty: Set[str] = set()  # node names needing row repack
+        self._shape_dirty = True  # node set / widths changed => full repack
+        # cached snapshot state
+        self._table: Optional[NodeTable] = None
+        self._row_of: Dict[str, int] = {}
+        self._widths_key: Optional[Tuple] = None
+
+    # -- introspection -----------------------------------------------------
+
+    def node(self, name: str) -> Optional[Node]:
+        return self._nodes.get(name)
+
+    def nodes(self) -> List[Node]:
+        return list(self._nodes.values())
+
+    def pods_on(self, node_name: str) -> List[Pod]:
+        return list(self._pods_by_node.get(node_name, {}).values())
+
+    def is_assumed(self, pod_key: str) -> bool:
+        return self._pod_state.get(pod_key) in (_ASSUMED, _EXPIRING)
+
+    def pod_count(self) -> int:
+        return sum(len(m) for m in self._pods_by_node.values())
+
+    # -- assumed-pod state machine ----------------------------------------
+
+    def assume_pod(self, pod: Pod, node_name: str) -> None:
+        """cache.go:275 AssumePod — place the pod in the cache now, before
+        the binding is durable."""
+        key = pod.key()
+        if key in self._pod_state:
+            raise CacheError(f"pod {key} already in cache ({self._pod_state[key]})")
+        self.packer.intern_pod(pod)
+        p = dataclasses.replace(pod, node_name=node_name)
+        self._pods_by_node.setdefault(node_name, {})[key] = p
+        self._pod_state[key] = _ASSUMED
+        self._pod_node[key] = node_name
+        self._mark_dirty(node_name)
+
+    def finish_binding(self, pod_key: str) -> None:
+        """cache.go FinishBinding — arm the TTL; the watch-confirmed AddPod
+        must arrive before it fires."""
+        if self._pod_state.get(pod_key) == _ASSUMED:
+            self._pod_state[pod_key] = _EXPIRING
+            self._pod_deadline[pod_key] = self.clock() + self.ttl_s
+
+    def forget_pod(self, pod_key: str) -> None:
+        """cache.go ForgetPod — undo an assumption (bind failed)."""
+        if self._pod_state.get(pod_key) not in (_ASSUMED, _EXPIRING):
+            raise CacheError(f"pod {pod_key} is not assumed")
+        self._drop_pod(pod_key)
+
+    def cleanup_expired(self) -> List[str]:
+        """cache.go:674 cleanupAssumedPods — expire overdue assumptions;
+        returns the expired keys (the driver logs/metrics them)."""
+        now = self.clock()
+        expired = [
+            k
+            for k, d in self._pod_deadline.items()
+            if d <= now and self._pod_state.get(k) == _EXPIRING
+        ]
+        for k in expired:
+            self._drop_pod(k)
+        return expired
+
+    # -- watch-driven mutations -------------------------------------------
+
+    def add_pod(self, pod: Pod) -> None:
+        """Watch AddPod for an assigned pod: confirms an assumption or adds
+        an unseen pod (cache.go AddPod)."""
+        key = pod.key()
+        state = self._pod_state.get(key)
+        if state in (_ASSUMED, _EXPIRING):
+            cached_node = self._pod_node.get(key)
+            if cached_node != pod.node_name:
+                # assumed onto the wrong node — trust the API (cache.go logs
+                # and re-adds)
+                self._drop_pod(key)
+                self._insert_pod(pod)
+            else:
+                self._pod_state[key] = _ADDED
+                self._pod_deadline.pop(key, None)
+                # refresh the stored object to the API's version
+                self._pods_by_node[pod.node_name][key] = pod
+                self._mark_dirty(pod.node_name)
+        elif state is None:
+            self._insert_pod(pod)
+        # state == ADDED: duplicate add — treat as update
+        else:
+            self.update_pod(pod)
+
+    def update_pod(self, pod: Pod) -> None:
+        key = pod.key()
+        old_node = self._pod_node.get(key)
+        if old_node is not None and old_node != pod.node_name:
+            self._drop_pod(key)
+            self._insert_pod(pod)
+            return
+        if old_node is None:
+            self._insert_pod(pod)
+            return
+        self.packer.intern_pod(pod)
+        self._pods_by_node[old_node][key] = pod
+        self._mark_dirty(old_node)
+
+    def remove_pod(self, pod_key: str) -> None:
+        if pod_key in self._pod_node:
+            self._drop_pod(pod_key)
+
+    def add_node(self, node: Node) -> None:
+        self.packer.intern_node(node)
+        self._nodes[node.name] = node
+        self._pods_by_node.setdefault(node.name, {})
+        self._shape_dirty = True
+
+    def update_node(self, node: Node) -> None:
+        if node.name not in self._nodes:
+            self.add_node(node)
+            return
+        self.packer.intern_node(node)
+        self._nodes[node.name] = node
+        self._mark_dirty(node.name)
+
+    def remove_node(self, name: str) -> None:
+        self._nodes.pop(name, None)
+        # pods on the node stay until their own delete events arrive
+        # (reference keeps the NodeInfo if pods remain; we simply drop the
+        # row — those pods no longer contribute to any schedulable node)
+        self._shape_dirty = True
+
+    # -- internals ---------------------------------------------------------
+
+    def _insert_pod(self, pod: Pod) -> None:
+        if not pod.node_name:
+            raise CacheError(f"pod {pod.key()} has no node assignment")
+        self.packer.intern_pod(pod)
+        self._pods_by_node.setdefault(pod.node_name, {})[pod.key()] = pod
+        self._pod_state[pod.key()] = _ADDED
+        self._pod_node[pod.key()] = pod.node_name
+        self._mark_dirty(pod.node_name)
+
+    def _drop_pod(self, key: str) -> None:
+        node = self._pod_node.pop(key)
+        self._pod_state.pop(key, None)
+        self._pod_deadline.pop(key, None)
+        pods = self._pods_by_node.get(node)
+        if pods:
+            pods.pop(key, None)
+        self._mark_dirty(node)
+
+    def _mark_dirty(self, node_name: str) -> None:
+        if node_name in self._nodes:
+            self._dirty.add(node_name)
+
+    # -- snapshot ----------------------------------------------------------
+
+    def snapshot(self) -> NodeTable:
+        """UpdateNodeInfoSnapshot (cache.go:211): return the columnar
+        NodeTable, recomputing only dirty rows when shapes allow. Interning
+        happens at mutation time (add/update/assume), so a clean-cache call
+        is O(1) — the width comparison below catches any universe growth
+        those mutations (or the driver interning pending pods) caused."""
+        wkey = tuple(sorted(self.packer.widths().items()))
+
+        if (
+            self._shape_dirty
+            or self._table is None
+            or wkey != self._widths_key
+        ):
+            return self._full_repack(wkey)
+
+        if not self._dirty:
+            return self._table
+
+        # incremental: repack only dirty rows. pack_nodes row computation is
+        # node-local (cross-node info lives in the shared universe), so a
+        # subset pack yields rows identical to a full pack.
+        dirty = [n for n in self._dirty if n in self._nodes]
+        sub_nodes = [self._nodes[n] for n in dirty]
+        sub_pods = [p for n in dirty for p in self._pods_by_node.get(n, {}).values()]
+        sub = self.packer.pack_nodes(sub_nodes, sub_pods)
+        if tuple(sorted(self.packer.widths().items())) != wkey:
+            # packing grew a universe mid-flight — fall back to full
+            return self._full_repack(tuple(sorted(self.packer.widths().items())))
+        t = self._table
+        for j, name in enumerate(dirty):
+            i = self._row_of[name]
+            for f in dataclasses.fields(NodeTable):
+                if f.name in ("n", "zone_valid"):
+                    continue
+                getattr(t, f.name)[i] = getattr(sub, f.name)[j]
+        # zone_valid is universe-shaped; refresh from the subset pack
+        self._table = dataclasses.replace(t, zone_valid=sub.zone_valid)
+        self._dirty.clear()
+        return self._table
+
+    def _full_repack(self, wkey: Tuple) -> NodeTable:
+        nodes = list(self._nodes.values())
+        pods = [
+            p
+            for name in self._nodes
+            for p in self._pods_by_node.get(name, {}).values()
+        ]
+        self._table = self.packer.pack_nodes(nodes, pods)
+        self._row_of = {nd.name: i for i, nd in enumerate(nodes)}
+        self._widths_key = tuple(sorted(self.packer.widths().items()))
+        self._dirty.clear()
+        self._shape_dirty = False
+        return self._table
+
+    def node_order(self) -> List[str]:
+        """Row order of the last snapshot (row index -> node name)."""
+        out = [""] * len(self._row_of)
+        for name, i in self._row_of.items():
+            out[i] = name
+        return out

@@ -1,0 +1,268 @@
+"""The scheduler driver — the batched analog of the reference's control
+loop (``pkg/scheduler/scheduler.go:256`` Run / ``:462`` scheduleOne).
+
+Where the reference pops ONE pod, filters/scores all nodes for it, assumes,
+and binds asynchronously, this driver pops the WHOLE activeQ, solves the
+batch on device (filter mask + score matrix + assignment rounds, see
+``ops/assign.py``), then assumes + binds every placed pod and routes every
+unplaced pod through the same error path as the reference
+(record backoff → AddUnschedulableIfNotPresent, ``factory.go``
+MakeDefaultErrorFunc):
+
+    cycle():
+      queue.tick(); cache.cleanup_expired()          # wait.Until loops
+      batch = queue.pop_batch()                      # NextPod, batched
+      snapshot = cache.snapshot()                    # UpdateNodeInfoSnapshot
+      assigned = solve(batch, snapshot)              # Schedule(), batched
+      for pod, node in assigned:
+        cache.assume_pod(pod, node)                  # scheduler.go:538
+        binder.bind(pod, node)                       # scheduler.go:598
+        cache.finish_binding(...)                    # async part, inlined
+      for pod in unassigned:
+        queue.record_failure(pod)                    # podBackoff.BackoffPod
+        queue.add_unschedulable_if_not_present(...)  # scheduler.go:493 error path
+
+Binding is synchronous here because in-process binders are function calls;
+a driver integrating with a real control plane wraps its RPC in the Binder
+and may run it on a thread pool — the cache's assume/expire machinery
+already tolerates that (it exists for exactly that asynchrony).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Protocol, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.cache import SchedulerCache
+from kubernetes_tpu.ops.arrays import (
+    nodes_to_device,
+    pods_to_device,
+    selectors_to_device,
+    topology_to_device,
+)
+from kubernetes_tpu.queue import SchedulingQueue
+from kubernetes_tpu.utils.interner import bucket_size
+
+
+class Binder(Protocol):
+    """The scheduler's only write — POST pods/{name}/binding
+    (registry/core/pod/storage/storage.go:154 BindingREST.Create)."""
+
+    def bind(self, pod: Pod, node_name: str) -> None: ...
+
+
+class RecordingBinder:
+    """Test binder capturing bindings (the mock binder of
+    scheduler_test.go:1031)."""
+
+    def __init__(self) -> None:
+        self.bindings: List[Tuple[str, str]] = []
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        self.bindings.append((pod.key(), node_name))
+
+
+@dataclass
+class CycleResult:
+    """What one driver cycle did (inputs to metrics + events)."""
+
+    attempted: int = 0
+    scheduled: int = 0
+    unschedulable: int = 0
+    bind_errors: int = 0
+    rounds: int = 0
+    assignments: Dict[str, str] = field(default_factory=dict)  # pod key -> node
+    failure_reasons: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    elapsed_s: float = 0.0
+
+
+class Scheduler:
+    """Batched scheduling driver over a cache + queue + device solver."""
+
+    def __init__(
+        self,
+        cache: Optional[SchedulerCache] = None,
+        queue: Optional[SchedulingQueue] = None,
+        binder: Optional[Binder] = None,
+        weights: Optional[Dict[str, float]] = None,
+        solver: str = "batch",
+        per_node_cap: int = 4,
+        max_rounds: int = 128,
+        max_batch: int = 8192,
+        clock: Callable[[], float] = time.monotonic,
+        event_sink: Optional[Callable[[str, Pod, str], None]] = None,
+    ) -> None:
+        self.cache = cache or SchedulerCache(clock=clock)
+        self.queue = queue or SchedulingQueue(clock=clock)
+        self.binder = binder or RecordingBinder()
+        self.weights = weights
+        self.solver = solver
+        self.per_node_cap = per_node_cap
+        self.max_rounds = max_rounds
+        self.max_batch = max_batch
+        self.clock = clock
+        #: event_sink(reason, pod, message) — Scheduled / FailedScheduling /
+        #: Preempted (scheduler.go:274,:335,:457); wired to the events
+        #: recorder by the host shim.
+        self.event_sink = event_sink or (lambda *_: None)
+
+    # -- ingestion (AddAllEventHandlers analog; the informer pump or test
+    # drives these) --------------------------------------------------------
+
+    def on_pod_add(self, pod: Pod) -> None:
+        """eventhandlers.go:215/:256 — unassigned pods queue for scheduling;
+        assigned pods enter the cache and may unblock affinity waiters."""
+        if pod.node_name:
+            self.cache.add_pod(pod)
+            self.queue.assigned_pod_added(pod)
+        else:
+            self.queue.add(pod)
+
+    def on_pod_update(self, old: Pod, new: Pod) -> None:
+        if new.node_name:
+            # add_pod (not update_pod): an unassigned->assigned transition
+            # must CONFIRM a pending assumption, or the TTL would expire a
+            # successfully bound pod and double-book its capacity
+            self.cache.add_pod(new)
+            # AssignedPodUpdated: wake only affinity-matching waiters, not
+            # the whole unschedulableQ (eventhandlers.go)
+            self.queue.assigned_pod_added(new)
+        else:
+            self.queue.update(old.key(), new)
+
+    def on_pod_delete(self, pod: Pod) -> None:
+        if pod.node_name:
+            self.cache.remove_pod(pod.key())
+            self.queue.move_all_to_active()
+        else:
+            self.queue.delete(pod.key())
+
+    def on_node_add(self, node) -> None:
+        self.cache.add_node(node)
+        self.queue.move_all_to_active()
+
+    def on_node_update(self, node) -> None:
+        self.cache.update_node(node)
+        self.queue.move_all_to_active()
+
+    def on_node_delete(self, name: str) -> None:
+        self.cache.remove_node(name)
+
+    # -- the cycle ---------------------------------------------------------
+
+    def schedule_cycle(self) -> CycleResult:
+        """One batched scheduling pass over everything in activeQ."""
+        from kubernetes_tpu.ops.assign import (
+            batch_assign,
+            greedy_assign,
+        )
+        from kubernetes_tpu.ops.predicates import decode_reasons, run_predicates
+
+        t0 = self.clock()
+        res = CycleResult()
+        self.queue.tick()
+        self.cache.cleanup_expired()
+        batch = self.queue.pop_batch(self.max_batch)
+        if not batch:
+            return res
+        cycle = self.queue.scheduling_cycle
+        res.attempted = len(batch)
+
+        # pack: pods first (their programs grow universes), then snapshot
+        pk = self.cache.packer
+        for p in batch:
+            pk.intern_pod(p)
+        nt = self.cache.snapshot()
+        pt = pk.pack_pods(batch)
+        dn = nodes_to_device(nt)
+        dp = pods_to_device(pt, pad_to=bucket_size(max(len(batch), 1)))
+        ds = selectors_to_device(pk.pack_selector_tables())
+        dt = topology_to_device(pk.pack_topology_tables()) if _has_topo(pk.u) else None
+
+        if self.solver == "greedy":
+            assigned, usage = greedy_assign(dp, dn, ds, self.weights, topo=dt)
+            rounds = len(batch)
+        else:
+            assigned, usage, rounds = batch_assign(
+                dp, dn, ds, self.weights,
+                max_rounds=self.max_rounds,
+                per_node_cap=self.per_node_cap,
+                topo=dt,
+            )
+        assigned = np.asarray(assigned)[: len(batch)]
+        res.rounds = int(rounds) if self.solver != "greedy" else rounds
+        node_order = self.cache.node_order()
+
+        # reasons for the unplaced: one more filter pass against the
+        # post-assignment usage (what the serial loop would have seen last)
+        failed_idx = [i for i, a in enumerate(assigned) if a < 0]
+        reasons_row: Dict[int, Tuple[str, ...]] = {}
+        if failed_idx:
+            from kubernetes_tpu.ops.assign import nodes_with_usage
+
+            fr = run_predicates(dp, nodes_with_usage(dn, usage), ds, dt)
+            rmat = np.asarray(fr.reasons)
+            nvalid = np.asarray(dn.valid)
+            for i in failed_idx:
+                # a pod's reason set = union over valid nodes of failed bits
+                bits = int(np.bitwise_or.reduce(rmat[i][nvalid])) if nvalid.any() else 0
+                reasons_row[i] = decode_reasons(bits)
+
+        for i, pod in enumerate(batch):
+            target = int(assigned[i])
+            if target >= 0:
+                node_name = node_order[target]
+                try:
+                    self.cache.assume_pod(pod, node_name)
+                except Exception:
+                    # already in cache (e.g. duplicate queue entry) — requeue
+                    self._fail(pod, cycle, res, ("AssumeError",))
+                    continue
+                try:
+                    self.binder.bind(pod, node_name)
+                except Exception as e:  # bind RPC failed -> Forget + retry
+                    self.cache.forget_pod(pod.key())
+                    res.bind_errors += 1
+                    self._fail(pod, cycle, res, (f"BindError:{e}",))
+                    continue
+                self.cache.finish_binding(pod.key())
+                self.queue.nominated.delete(pod)
+                res.scheduled += 1
+                res.assignments[pod.key()] = node_name
+                self.event_sink("Scheduled", pod, node_name)
+            else:
+                self._fail(pod, cycle, res, reasons_row.get(i, ()))
+        res.elapsed_s = self.clock() - t0
+        return res
+
+    def _fail(self, pod: Pod, cycle: int, res: CycleResult, reasons) -> None:
+        res.unschedulable += 1
+        res.failure_reasons[pod.key()] = tuple(reasons)
+        self.queue.record_failure(pod)
+        self.queue.add_unschedulable_if_not_present(pod, cycle)
+        self.event_sink("FailedScheduling", pod, ",".join(reasons))
+
+    def run_until_settled(self, max_cycles: int = 50) -> List[CycleResult]:
+        """Drive cycles until nothing schedules (tests + sim harness)."""
+        out = []
+        for _ in range(max_cycles):
+            r = self.schedule_cycle()
+            out.append(r)
+            if r.scheduled == 0 and r.attempted == 0:
+                break
+        return out
+
+
+def _has_topo(u) -> bool:
+    return bool(
+        len(u.aff_programs)
+        or len(u.pref_aff_programs)
+        or len(u.spread_hard_programs)
+        or len(u.spread_soft_programs)
+        or len(u.anti_terms)
+        or len(u.sym_terms)
+    )

@@ -1,0 +1,194 @@
+"""Driver-loop tests — the analog of ``pkg/scheduler/scheduler_test.go``
+(scheduleOne driven with a mock binder capturing bindings) plus queue/cache
+integration: retry-on-event, bind-failure Forget, assume-capacity carry."""
+
+import pytest
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.scheduler import RecordingBinder, Scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _sched(**kw):
+    clk = FakeClock()
+    kw.setdefault("clock", clk)
+    s = Scheduler(**kw)
+    return s, clk
+
+
+def test_schedules_all_when_capacity_allows():
+    s, _ = _sched()
+    for i in range(4):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=4000))
+    for i in range(8):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=500))
+    r = s.schedule_cycle()
+    assert r.attempted == 8 and r.scheduled == 8 and r.unschedulable == 0
+    assert len(s.binder.bindings) == 8
+    # all pods assumed in cache
+    assert s.cache.pod_count() == 8
+
+
+def test_unschedulable_gets_reasons_and_requeues():
+    s, clk = _sched()
+    s.on_node_add(make_node("n0", cpu_milli=1000, pods=10))
+    for i in range(3):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=600))
+    r = s.schedule_cycle()
+    assert r.scheduled == 1
+    assert r.unschedulable == 2
+    for key, reasons in r.failure_reasons.items():
+        assert "PodFitsResources" in reasons
+    # failed pods sit in unschedulableQ (no move request since)
+    assert s.queue.pending_counts()["unschedulable"] == 2
+
+
+def test_retry_after_node_add():
+    s, clk = _sched()
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    s.on_pod_add(make_pod("a", cpu_milli=800))
+    s.on_pod_add(make_pod("b", cpu_milli=800))
+    r1 = s.schedule_cycle()
+    assert r1.scheduled == 1 and r1.unschedulable == 1
+
+    # new node arrives -> MoveAllToActiveQueue; backoff must elapse first
+    s.on_node_add(make_node("n1", cpu_milli=1000))
+    clk.advance(2.0)
+    r2 = s.schedule_cycle()
+    assert r2.scheduled == 1
+    assert {n for _, n in s.binder.bindings} == {"n0", "n1"}
+
+
+class FailingBinder:
+    def __init__(self, fail_keys):
+        self.fail_keys = set(fail_keys)
+        self.bindings = []
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        if pod.key() in self.fail_keys:
+            self.fail_keys.discard(pod.key())  # fail once
+            raise RuntimeError("apiserver unavailable")
+        self.bindings.append((pod.key(), node_name))
+
+
+def test_bind_failure_forgets_and_retries():
+    binder = FailingBinder({"default/a"})
+    s, clk = _sched(binder=binder)
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    s.on_pod_add(make_pod("a", cpu_milli=800))
+    r1 = s.schedule_cycle()
+    assert r1.bind_errors == 1 and r1.scheduled == 0
+    # capacity was released (ForgetPod), pod requeued; a cluster event +
+    # backoff expiry brings it back
+    assert s.cache.pod_count() == 0
+    s.queue.move_all_to_active()
+    clk.advance(2.0)
+    r2 = s.schedule_cycle()
+    assert r2.scheduled == 1
+    assert binder.bindings == [("default/a", "n0")]
+
+
+def test_assumed_capacity_visible_across_cycles():
+    s, clk = _sched()
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    s.on_pod_add(make_pod("a", cpu_milli=800))
+    assert s.schedule_cycle().scheduled == 1
+    # second pod cannot double-book the assumed capacity
+    s.on_pod_add(make_pod("b", cpu_milli=800))
+    r = s.schedule_cycle()
+    assert r.scheduled == 0 and r.unschedulable == 1
+
+
+def test_priority_order_wins_contention():
+    s, _ = _sched()
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    s.on_pod_add(make_pod("low", cpu_milli=800, priority=1))
+    s.on_pod_add(make_pod("high", cpu_milli=800, priority=100))
+    r = s.schedule_cycle()
+    assert r.assignments.get("default/high") == "n0"
+    assert "default/low" in r.failure_reasons
+
+
+def test_greedy_solver_parity_small():
+    s1, _ = _sched(solver="batch")
+    s2, _ = _sched(solver="greedy")
+    for s in (s1, s2):
+        for i in range(3):
+            s.on_node_add(make_node(f"n{i}", cpu_milli=2000))
+        for i in range(5):
+            s.on_pod_add(make_pod(f"p{i}", cpu_milli=700))
+    r1 = s1.schedule_cycle()
+    r2 = s2.schedule_cycle()
+    assert r1.scheduled == r2.scheduled == 5
+
+
+def test_events_emitted():
+    events = []
+    s, _ = _sched(event_sink=lambda reason, pod, msg: events.append((reason, pod.name)))
+    s.on_node_add(make_node("n0", cpu_milli=1000, pods=10))
+    s.on_pod_add(make_pod("ok", cpu_milli=100))
+    s.on_pod_add(make_pod("toobig", cpu_milli=5000))
+    s.schedule_cycle()
+    assert ("Scheduled", "ok") in events
+    assert ("FailedScheduling", "toobig") in events
+
+
+def test_pod_update_confirms_assumption():
+    """The watch's unassigned->assigned UPDATE (not just Add) must confirm
+    the assumption — otherwise the TTL expires a successfully bound pod and
+    its capacity double-books."""
+    s, clk = _sched()
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    old = make_pod("a", cpu_milli=800)
+    s.on_pod_add(old)
+    assert s.schedule_cycle().scheduled == 1
+    bound = make_pod("a", cpu_milli=800, node_name="n0")
+    s.on_pod_update(old, bound)
+    assert not s.cache.is_assumed("default/a")
+    clk.advance(31)
+    s.cache.cleanup_expired()
+    assert s.cache.pod_count() == 1  # still there
+    s.on_pod_add(make_pod("b", cpu_milli=800))
+    r = s.schedule_cycle()
+    assert r.scheduled == 0  # no double-booking
+
+
+def test_queue_update_preserves_fifo_position():
+    from kubernetes_tpu.queue import SchedulingQueue
+
+    clk = FakeClock(100.0)
+    q = SchedulingQueue(clock=clk)
+    a = make_pod("a")
+    q.add(a)
+    clk.advance(100)
+    b = make_pod("b")
+    q.add(b)
+    # watch delivers a fresh API object for b (queued_at unset)
+    q.update(b.key(), make_pod("b", node_selector={"x": "y"}))
+    assert [p.name for p in q.pop_batch()] == ["a", "b"]
+
+
+def test_run_until_settled_drains_queue():
+    s, clk = _sched()
+
+    # wrap the clock ticks into the loop: advance between cycles so backoff
+    # never starves progress
+    for i in range(2):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=4000, pods=4))
+    for i in range(12):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=100))
+    results = s.run_until_settled()
+    total = sum(r.scheduled for r in results)
+    assert total == 8  # pods cap: 4 per node x 2 nodes
+    assert s.queue.pending_counts()["unschedulable"] == 4

@@ -1,0 +1,178 @@
+"""Unit tests for the scheduling queue — the analog of
+``pkg/scheduler/internal/queue/scheduling_queue_test.go``."""
+
+from kubernetes_tpu.api.types import Affinity, LabelSelector, PodAffinityTerm
+from kubernetes_tpu.queue import (
+    INITIAL_BACKOFF_S,
+    MAX_BACKOFF_S,
+    UNSCHEDULABLEQ_FLUSH_S,
+    PodBackoffMap,
+    SchedulingQueue,
+)
+from kubernetes_tpu.testing import make_pod
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_pop_order_priority_then_fifo():
+    q = SchedulingQueue(clock=FakeClock())
+    low1 = make_pod("low1", priority=0)
+    high = make_pod("high", priority=10)
+    low2 = make_pod("low2", priority=0)
+    for p in (low1, high, low2):
+        q.add(p)
+    assert [p.name for p in q.pop_batch()] == ["high", "low1", "low2"]
+    assert q.scheduling_cycle == 1
+
+
+def test_unschedulable_goes_to_backoff_after_move_request():
+    clk = FakeClock()
+    q = SchedulingQueue(clock=clk)
+    a, b = make_pod("a"), make_pod("b")
+    q.add(a)
+    q.add(b)
+    batch = q.pop_batch()
+    cycle = q.scheduling_cycle
+
+    # no move request since the pod's cycle -> unschedulableQ
+    q.record_failure(batch[0])
+    q.add_unschedulable_if_not_present(batch[0], cycle)
+    assert q.pending_counts()["unschedulable"] == 1
+
+    # a move request DURING scheduling -> pod must go to backoffQ instead
+    # (the lost-wakeup defense, scheduling_queue.go:127-134). Pod a is also
+    # swept to backoffQ by the move request itself (still backing off).
+    q.move_all_to_active()
+    q.record_failure(batch[1])
+    q.add_unschedulable_if_not_present(batch[1], cycle)
+    counts = q.pending_counts()
+    assert counts == {"active": 0, "backoff": 2, "unschedulable": 0}
+
+
+def test_backoff_expiry_exponential():
+    clk = FakeClock()
+    bm = PodBackoffMap()
+    bm.backoff_pod("k", clk())
+    assert bm.backoff_time("k") == INITIAL_BACKOFF_S
+    bm.backoff_pod("k", clk())
+    assert bm.backoff_time("k") == 2 * INITIAL_BACKOFF_S
+    for _ in range(10):
+        bm.backoff_pod("k", clk())
+    assert bm.backoff_time("k") == MAX_BACKOFF_S
+
+
+def test_flush_backoff_completed():
+    clk = FakeClock()
+    q = SchedulingQueue(clock=clk)
+    p = make_pod("p")
+    q.add(p)
+    (popped,) = q.pop_batch()
+    q.record_failure(popped)
+    q.move_all_to_active()  # force backoff path
+    q.add_unschedulable_if_not_present(popped, q.scheduling_cycle)
+    assert q.pending_counts()["backoff"] == 1
+    q.tick()
+    assert q.pending_counts()["backoff"] == 1  # 1 s not elapsed
+    clk.advance(1.1)
+    q.tick()
+    assert q.pending_counts() == {"active": 1, "backoff": 0, "unschedulable": 0}
+
+
+def test_unschedulable_leftover_flush_after_60s():
+    clk = FakeClock()
+    q = SchedulingQueue(clock=clk)
+    p = make_pod("p")
+    q.add(p)
+    (popped,) = q.pop_batch()
+    q.add_unschedulable_if_not_present(popped, q.scheduling_cycle)
+    clk.advance(UNSCHEDULABLEQ_FLUSH_S - 1)
+    q.tick()
+    assert q.pending_counts()["unschedulable"] == 1
+    clk.advance(2)
+    q.tick()
+    assert q.pending_counts()["unschedulable"] == 0
+    assert q.pending_counts()["active"] == 1
+
+
+def test_assigned_pod_added_moves_affinity_waiters():
+    clk = FakeClock()
+    q = SchedulingQueue(clock=clk)
+    waiter = make_pod(
+        "waiter",
+        affinity=Affinity(
+            pod_affinity_required=(
+                PodAffinityTerm(
+                    label_selector=LabelSelector(match_labels={"app": "db"}),
+                    topology_key="kubernetes.io/hostname",
+                ),
+            )
+        ),
+    )
+    other = make_pod("other")
+    q.add(waiter)
+    q.add(other)
+    batch = q.pop_batch()
+    for p in batch:
+        q.add_unschedulable_if_not_present(p, q.scheduling_cycle)
+    assert q.pending_counts()["unschedulable"] == 2
+
+    # a non-matching assigned pod moves nothing
+    q.assigned_pod_added(make_pod("x", labels={"app": "web"}, node_name="n1"))
+    assert q.pending_counts()["unschedulable"] == 2
+    # a matching one moves only the waiter
+    q.assigned_pod_added(make_pod("db-1", labels={"app": "db"}, node_name="n1"))
+    counts = q.pending_counts()
+    assert counts["unschedulable"] == 1 and counts["active"] == 1
+
+
+def test_update_unschedulable_moves_to_active():
+    q = SchedulingQueue(clock=FakeClock())
+    p = make_pod("p")
+    q.add(p)
+    (popped,) = q.pop_batch()
+    q.add_unschedulable_if_not_present(popped, q.scheduling_cycle)
+    newp = make_pod("p", node_selector={"disk": "ssd"})
+    newp.queued_at = popped.queued_at
+    q.update(popped.key(), newp)
+    assert q.pending_counts()["active"] == 1
+
+
+def test_delete_removes_everywhere_and_clears_backoff():
+    q = SchedulingQueue(clock=FakeClock())
+    p = make_pod("p")
+    q.add(p)
+    q.record_failure(p)
+    q.delete(p.key())
+    assert len(q) == 0
+    assert q.backoff_map.backoff_time(p.key()) == 0.0
+
+
+def test_nominated_pod_map():
+    q = SchedulingQueue(clock=FakeClock())
+    p = make_pod("p", priority=5)
+    p.nominated_node_name = "node-1"
+    q.add(p)
+    assert [x.name for x in q.nominated.pods_for_node("node-1")] == ["p"]
+    q.delete(p.key())
+    assert q.nominated.pods_for_node("node-1") == []
+
+
+def test_pop_batch_respects_max():
+    q = SchedulingQueue(clock=FakeClock())
+    for i in range(5):
+        q.add(make_pod(f"p{i}"))
+    first = q.pop_batch(2)
+    assert len(first) == 2
+    assert q.scheduling_cycle == 1
+    rest = q.pop_batch()
+    assert len(rest) == 3
+    assert q.scheduling_cycle == 2
