@@ -232,6 +232,14 @@ class Pod:
     #: status.nominatedNodeName — set by preemption so the victim's node
     #: holds capacity for this pod while it retries (scheduler.go:316).
     nominated_node_name: str = ""
+    #: status.startTime (seconds) — preemption tie-break tier 5
+    #: (generic_scheduler.go:862 pickOneNodeForPreemption: latest start time
+    #: of the highest-priority victim wins).
+    start_time: float = 0.0
+    #: metadata.deletionTimestamp analog (0 = live). A terminating
+    #: lower-priority pod on the nominated node blocks re-preemption
+    #: (generic_scheduler.go:1190 podEligibleToPreemptOthers).
+    deletion_timestamp: float = 0.0
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
@@ -249,6 +257,21 @@ class Pod:
 
     def tolerates(self, taint: Taint) -> bool:
         return any(t.tolerates(taint) for t in self.tolerations)
+
+
+@dataclass
+class PodDisruptionBudget:
+    """The slice of policy/v1beta1 PodDisruptionBudget preemption consumes:
+    selector + status.disruptionsAllowed (checked by
+    ``filterPodsWithPDBViolation``, generic_scheduler.go:1129)."""
+
+    name: str = ""
+    namespace: str = "default"
+    selector: LabelSelector = field(default_factory=LabelSelector)
+    disruptions_allowed: int = 0
+
+    def matches(self, pod: Pod) -> bool:
+        return pod.namespace == self.namespace and self.selector.matches(pod.labels)
 
 
 @dataclass

@@ -125,7 +125,7 @@ def queue_order(pods: DevicePods) -> jnp.ndarray:
 
 
 @partial(jax.jit, static_argnames=("weights_key",))
-def _greedy_impl(pods, nodes, sel, topo, weights_key):
+def _greedy_impl(pods, nodes, sel, topo, weights_key, extra_mask):
     weights = dict(weights_key) if weights_key else None
     P = pods.req.shape[0]
     perm = queue_order(pods)
@@ -134,7 +134,8 @@ def _greedy_impl(pods, nodes, sel, topo, weights_key):
     def step(u, p):
         pod = _pod_slice(pods, p)
         cur = nodes_with_usage(nodes, u)
-        mask = run_predicates(pod, cur, sel, topo).mask  # (1, N)
+        extra = jax.lax.dynamic_index_in_dim(extra_mask, p, axis=0, keepdims=True)
+        mask = run_predicates(pod, cur, sel, topo).mask & extra  # (1, N)
         score = run_priorities(pod, cur, sel, mask, weights, topo)
         masked = jnp.where(mask, score, NEG)
         best = jnp.argmax(masked[0])
@@ -153,11 +154,18 @@ def greedy_assign(
     sel: DeviceSelectors,
     weights: Optional[Dict[str, float]] = None,
     topo=None,
+    extra_mask: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, UsageState]:
     """Serial-parity solver. Returns (assigned node row per pod or -1,
-    final usage)."""
+    final usage). ``extra_mask`` (P, N) ANDs into feasibility — the driver
+    feeds the nominated-pods pass-A mask through it (podFitsOnNode's
+    two-pass rule, generic_scheduler.go:610)."""
     key = tuple(sorted(weights.items())) if weights else None
-    return _greedy_impl(pods, nodes, sel, topo, key)
+    if extra_mask is None:
+        extra_mask = jnp.ones(
+            (pods.req.shape[0], nodes.allocatable.shape[0]), bool
+        )
+    return _greedy_impl(pods, nodes, sel, topo, key, extra_mask)
 
 
 def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray:
@@ -169,7 +177,8 @@ def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray
 
 
 @partial(jax.jit, static_argnames=("weights_key", "max_rounds", "per_node_cap"))
-def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap):
+def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
+                extra_mask):
     weights = dict(weights_key) if weights_key else None
     P = pods.req.shape[0]
     perm = queue_order(pods)
@@ -191,7 +200,7 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap):
         assigned, u, _, rnd = carry
         cur = nodes_with_usage(nodes, u)
         active = (assigned == -1) & pods.valid
-        mask = run_predicates(pods, cur, sel, topo).mask & active[:, None]
+        mask = run_predicates(pods, cur, sel, topo).mask & active[:, None] & extra_mask
         score = run_priorities(pods, cur, sel, mask, weights, topo)
         masked = jnp.where(mask, score, NEG)
         choice = jnp.argmax(masked, axis=1).astype(jnp.int32)  # (P,)
@@ -284,10 +293,17 @@ def batch_assign(
     max_rounds: int = 256,
     per_node_cap: int = 1,
     topo=None,
+    extra_mask: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, UsageState, jnp.ndarray]:
     """Fast batched solver. Returns (assigned row per pod or -1, final
     usage, rounds executed). ``per_node_cap`` bounds admissions per node per
     round (see _batch_impl); with P pending pods and N nodes expect about
-    ceil(P / (N * cap)) rounds on uniform workloads."""
+    ceil(P / (N * cap)) rounds on uniform workloads. ``extra_mask`` as in
+    :func:`greedy_assign`."""
     key = tuple(sorted(weights.items())) if weights else None
-    return _batch_impl(pods, nodes, sel, topo, key, max_rounds, per_node_cap)
+    if extra_mask is None:
+        extra_mask = jnp.ones(
+            (pods.req.shape[0], nodes.allocatable.shape[0]), bool
+        )
+    return _batch_impl(pods, nodes, sel, topo, key, max_rounds, per_node_cap,
+                       extra_mask)

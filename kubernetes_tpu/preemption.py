@@ -1,0 +1,248 @@
+"""Preemption — exact host-side victim selection over the cache, mirroring
+``genericScheduler.Preempt`` (``pkg/scheduler/core/generic_scheduler.go:316``)
+and its helpers:
+
+- eligibility (``:1190`` podEligibleToPreemptOthers)
+- candidate pruning (``:1167`` nodesWherePreemptionMightHelp — only nodes
+  whose filter failures are *resolvable by removing pods* qualify)
+- victim selection with the reprieve loop (``:1079`` selectVictimsOnNode:
+  remove all lower-priority pods, verify the preemptor fits, then try to
+  re-add each candidate victim highest-priority-first — PDB-violating pods
+  reprieved first — keeping those whose return doesn't break the fit)
+- the 6-tier lexicographic node pick (``:862`` pickOneNodeForPreemption)
+
+Division of labor with the device: the *filter* pass that discovered the
+failures ran batched on TPU and produced per-(pod, node) failure-reason
+bitmasks; this module consumes those bits to prune candidates, then runs the
+exact what-if semantics host-side via the sequential reference predicates
+(``kubernetes_tpu.seqref``) — preemption is rare and victim counts are
+small, so the ragged reprieve loop is not worth tensorizing (the reference
+itself re-runs full predicates per what-if). A batched coarse pre-filter
+remains possible later via the reasons matrix alone.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu import seqref
+from kubernetes_tpu.api.types import Node, Pod, PodDisruptionBudget
+from kubernetes_tpu.ops.predicates import BIT
+
+#: Failure bits that deleting pods can possibly clear. Complement of the
+#: reference's unresolvable list (generic_scheduler.go:65-84): node
+#: conditions, unschedulable flag, taints, selector/hostname mismatches
+#: cannot be fixed by preemption.
+RESOLVABLE_BITS = (
+    (1 << BIT["PodFitsResources"])
+    | (1 << BIT["PodFitsHostPorts"])
+    | (1 << BIT["MatchInterPodAffinity"])
+    | (1 << BIT["EvenPodsSpread"])
+)
+
+
+@dataclass
+class PreemptionResult:
+    node_name: str
+    victims: List[Pod] = field(default_factory=list)
+    num_pdb_violations: int = 0
+    #: lower-priority pods nominated on the chosen node whose nomination
+    #: must be cleared (scheduler.go:330 getLowerPriorityNominatedPods)
+    clear_nominations: List[Pod] = field(default_factory=list)
+
+
+def pod_eligible_to_preempt_others(
+    pod: Pod, node_pods_of: Dict[str, List[Pod]]
+) -> bool:
+    """generic_scheduler.go:1190 — a pod that already triggered a preemption
+    (has a nominated node) waits while any lower-priority pod there is still
+    terminating."""
+    nom = pod.nominated_node_name
+    if nom and nom in node_pods_of:
+        for p in node_pods_of[nom]:
+            if p.deletion_timestamp and p.priority < pod.priority:
+                return False
+    return True
+
+
+def nodes_where_preemption_might_help(
+    reason_bits_by_node: Dict[str, int]
+) -> List[str]:
+    """generic_scheduler.go:1167 — keep nodes whose every failure bit is
+    resolvable by removing pods. Nodes with no failure bits (feasible or
+    padding) are not candidates."""
+    return [
+        n
+        for n, bits in reason_bits_by_node.items()
+        if bits and (bits & ~RESOLVABLE_BITS) == 0
+    ]
+
+
+def _fits_with(
+    pod: Pod,
+    node: Node,
+    nodes: Sequence[Node],
+    node_pods_of: Dict[str, List[Pod]],
+) -> bool:
+    """Full predicate check of ``pod`` on ``node`` against the given
+    hypothetical cluster state (podFitsOnNode's predicate set as evaluated
+    during preemption what-ifs)."""
+    return (
+        seqref.feasible(pod, node, node_pods_of.get(node.name, []))
+        and seqref.inter_pod_affinity_feasible(pod, node, nodes, node_pods_of)
+        and seqref.even_pods_spread_feasible(pod, node, nodes, node_pods_of)
+    )
+
+
+def select_victims_on_node(
+    pod: Pod,
+    node: Node,
+    nodes: Sequence[Node],
+    node_pods_of: Dict[str, List[Pod]],
+    pdbs: Sequence[PodDisruptionBudget] = (),
+    nominated_pods_of: Optional[Dict[str, List[Pod]]] = None,
+) -> Optional[Tuple[List[Pod], int]]:
+    """selectVictimsOnNode (generic_scheduler.go:1079). Returns
+    (victims, num_pdb_violations) or None when preemption can't help.
+
+    ``nominated_pods_of`` — pods nominated onto nodes by earlier
+    preemptions. The reference's what-if fit check passes the scheduling
+    queue into podFitsOnNode, so higher/equal-priority nominated pods count
+    as phantom occupants (they are never selectable as victims): without
+    this, a second preemptor would claim capacity already promised to the
+    first."""
+    pods_here = list(node_pods_of.get(node.name, []))
+    potential = [p for p in pods_here if p.priority < pod.priority]
+    keep = [p for p in pods_here if p.priority >= pod.priority]
+    phantoms = [
+        p
+        for p in (nominated_pods_of or {}).get(node.name, [])
+        if p.priority >= pod.priority and p.key() != pod.key()
+    ]
+
+    # hypothetical state: all lower-priority pods gone, phantoms present
+    state = dict(node_pods_of)
+    state[node.name] = keep + phantoms
+    if not _fits_with(pod, node, nodes, state):
+        return None
+
+    violating, non_violating = filter_pods_with_pdb_violation(potential, pdbs)
+    victims: List[Pod] = []
+    num_violations = 0
+
+    def reprieve(p: Pod) -> bool:
+        state[node.name] = state[node.name] + [p]
+        if _fits_with(pod, node, nodes, state):
+            return True  # keep it — not a victim
+        state[node.name] = state[node.name][:-1]
+        return False
+
+    # highest-priority first within each group; PDB-violating group first so
+    # it gets the best chance of reprieve (generic_scheduler.go:1110-1125)
+    for p in sorted(violating, key=lambda q: -q.priority):
+        if not reprieve(p):
+            victims.append(p)
+            num_violations += 1
+    for p in sorted(non_violating, key=lambda q: -q.priority):
+        if not reprieve(p):
+            victims.append(p)
+    return victims, num_violations
+
+
+def filter_pods_with_pdb_violation(
+    pods: Sequence[Pod], pdbs: Sequence[PodDisruptionBudget]
+) -> Tuple[List[Pod], List[Pod]]:
+    """generic_scheduler.go:1129 — split pods into (would violate a PDB,
+    would not): a pod violates when any matching PDB has no disruptions
+    left."""
+    violating, ok = [], []
+    for p in pods:
+        if any(pdb.matches(p) and pdb.disruptions_allowed <= 0 for pdb in pdbs):
+            violating.append(p)
+        else:
+            ok.append(p)
+    return violating, ok
+
+
+def pick_one_node(
+    candidates: Dict[str, Tuple[List[Pod], int]]
+) -> Optional[str]:
+    """pickOneNodeForPreemption (generic_scheduler.go:862): lexicographic
+    tie-break —
+      1. fewest PDB violations
+      2. lowest highest-victim priority
+      3. smallest sum of victim priorities
+      4. fewest victims
+      5. latest start time of the highest-priority victim
+      6. first remaining (stable iteration order).
+    A node with NO victims wins immediately (the reference returns it)."""
+    if not candidates:
+        return None
+    names = list(candidates)
+    for n in names:
+        if not candidates[n][0]:
+            return n
+
+    def metrics(n: str):
+        victims, pdb = candidates[n]
+        high = max(v.priority for v in victims)
+        return (
+            pdb,
+            high,
+            sum(v.priority for v in victims),
+            len(victims),
+            -max(v.start_time for v in victims if v.priority == high),
+        )
+
+    m = {n: metrics(n) for n in names}
+    for tier in range(5):
+        best = min(v[tier] for v in (m[n] for n in names))
+        names = [n for n in names if m[n][tier] == best]
+        if len(names) == 1:
+            return names[0]
+    return names[0]
+
+
+def preempt(
+    pod: Pod,
+    nodes: Sequence[Node],
+    node_pods_of: Dict[str, List[Pod]],
+    reason_bits_by_node: Dict[str, int],
+    pdbs: Sequence[PodDisruptionBudget] = (),
+    nominated_pods_of: Optional[Dict[str, List[Pod]]] = None,
+) -> Optional[PreemptionResult]:
+    """The full Preempt flow for one unschedulable pod. ``node_pods_of``
+    maps node name -> pods (from the cache); ``reason_bits_by_node`` is the
+    pod's row of the device filter pass; ``nominated_pods_of`` maps node
+    name -> pods currently nominated there (phantom occupants for the
+    what-if checks, and the source for nomination clearing)."""
+    if not pod_eligible_to_preempt_others(pod, node_pods_of):
+        return None
+    by_name = {nd.name: nd for nd in nodes}
+    candidates: Dict[str, Tuple[List[Pod], int]] = {}
+    for name in nodes_where_preemption_might_help(reason_bits_by_node):
+        nd = by_name.get(name)
+        if nd is None:
+            continue
+        r = select_victims_on_node(
+            pod, nd, nodes, node_pods_of, pdbs,
+            nominated_pods_of=nominated_pods_of,
+        )
+        if r is not None:
+            candidates[name] = r
+    chosen = pick_one_node(candidates)
+    if chosen is None:
+        return None
+    victims, pdb_violations = candidates[chosen]
+    clear = [
+        p
+        for p in (nominated_pods_of or {}).get(chosen, [])
+        if p.priority < pod.priority
+    ]
+    return PreemptionResult(
+        node_name=chosen,
+        victims=victims,
+        num_pdb_violations=pdb_violations,
+        clear_nominations=clear,
+    )

@@ -109,6 +109,9 @@ class NominatedPodMap:
     def pods_for_node(self, node_name: str) -> List[Pod]:
         return list(self._by_node.get(node_name, ()))
 
+    def items(self) -> List[Tuple[str, List[Pod]]]:
+        return [(n, list(ps)) for n, ps in self._by_node.items()]
+
     def node_of(self, pod_key: str) -> Optional[str]:
         return self._node_of.get(pod_key)
 

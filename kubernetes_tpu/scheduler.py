@@ -34,6 +34,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_tpu.api.types import Pod
@@ -44,8 +46,16 @@ from kubernetes_tpu.ops.arrays import (
     selectors_to_device,
     topology_to_device,
 )
+from kubernetes_tpu.ops.predicates import run_predicates
 from kubernetes_tpu.queue import SchedulingQueue
 from kubernetes_tpu.utils.interner import bucket_size
+
+
+@jax.jit
+def _filter_pass(dp, dn, ds, dt):
+    """One standalone filter evaluation (reasons + mask) — used for the
+    nominated-pods pass-A mask and for failure-reason reporting."""
+    return run_predicates(dp, dn, ds, dt)
 
 
 class Binder(Protocol):
@@ -77,6 +87,8 @@ class CycleResult:
     rounds: int = 0
     assignments: Dict[str, str] = field(default_factory=dict)  # pod key -> node
     failure_reasons: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    preempted: int = 0  # victims deleted this cycle
+    nominations: Dict[str, str] = field(default_factory=dict)  # pod -> node
     elapsed_s: float = 0.0
 
 
@@ -95,6 +107,10 @@ class Scheduler:
         max_batch: int = 8192,
         clock: Callable[[], float] = time.monotonic,
         event_sink: Optional[Callable[[str, Pod, str], None]] = None,
+        enable_preemption: bool = True,
+        max_preemptions_per_cycle: int = 16,
+        pdb_lister: Optional[Callable[[], List]] = None,
+        victim_deleter: Optional[Callable[[Pod], None]] = None,
     ) -> None:
         self.cache = cache or SchedulerCache(clock=clock)
         self.queue = queue or SchedulingQueue(clock=clock)
@@ -109,6 +125,16 @@ class Scheduler:
         #: Preempted (scheduler.go:274,:335,:457); wired to the events
         #: recorder by the host shim.
         self.event_sink = event_sink or (lambda *_: None)
+        self.enable_preemption = enable_preemption
+        self.max_preemptions_per_cycle = max_preemptions_per_cycle
+        #: PDBs come from a lister (the disruption controller maintains
+        #: their status in the reference; here the hub/sim supplies them)
+        self.pdb_lister = pdb_lister or (lambda: [])
+        #: victim_deleter(pod): issue the victim's deletion. Default: mark
+        #: terminating and remove from cache immediately (grace period 0).
+        #: A hub integration instead posts the delete and lets the watch
+        #: remove it, keeping the victim visible as terminating meanwhile.
+        self.victim_deleter = victim_deleter
 
     # -- ingestion (AddAllEventHandlers analog; the informer pump or test
     # drives these) --------------------------------------------------------
@@ -157,10 +183,13 @@ class Scheduler:
     def schedule_cycle(self) -> CycleResult:
         """One batched scheduling pass over everything in activeQ."""
         from kubernetes_tpu.ops.assign import (
+            _apply_batch,
             batch_assign,
             greedy_assign,
+            nodes_with_usage,
+            usage_from_nodes,
         )
-        from kubernetes_tpu.ops.predicates import decode_reasons, run_predicates
+        from kubernetes_tpu.ops.predicates import decode_reasons
 
         t0 = self.clock()
         res = CycleResult()
@@ -174,17 +203,46 @@ class Scheduler:
 
         # pack: pods first (their programs grow universes), then snapshot
         pk = self.cache.packer
+        batch_keys = {p.key() for p in batch}
+        nominated = self._nominated_pods(exclude=batch_keys)
         for p in batch:
             pk.intern_pod(p)
+        for p, _ in nominated:
+            pk.intern_pod(p)
         nt = self.cache.snapshot()
+        node_order = self.cache.node_order()
         pt = pk.pack_pods(batch)
         dn = nodes_to_device(nt)
         dp = pods_to_device(pt, pad_to=bucket_size(max(len(batch), 1)))
         ds = selectors_to_device(pk.pack_selector_tables())
         dt = topology_to_device(pk.pack_topology_tables()) if _has_topo(pk.u) else None
 
+        # nominated-pods pass A (podFitsOnNode two-pass rule,
+        # generic_scheduler.go:610): feasibility must ALSO hold with the
+        # nominated pods counted onto their nodes. Divergence from the
+        # reference, documented: ALL nominated pods are added, not only
+        # those of higher/equal priority — strictly more conservative (a
+        # pod may wait one extra cycle; capacity is never double-promised).
+        extra_mask = None
+        if nominated:
+            row_of = {name: i for i, name in enumerate(node_order)}
+            nom_pods = [p for p, _ in nominated]
+            dpn = pods_to_device(pk.pack_pods(nom_pods))
+            nom_rows = np.zeros((dpn.valid.shape[0],), np.int32)
+            nom_ok = np.zeros((dpn.valid.shape[0],), bool)
+            for j, (_, node) in enumerate(nominated):
+                r = row_of.get(node, -1)
+                nom_rows[j], nom_ok[j] = max(r, 0), r >= 0
+            u_nom = _apply_batch(
+                usage_from_nodes(dn), dpn, jnp.asarray(nom_rows),
+                jnp.asarray(nom_ok) & dpn.valid,
+            )
+            extra_mask = _filter_pass(dp, nodes_with_usage(dn, u_nom), ds, dt).mask
+
         if self.solver == "greedy":
-            assigned, usage = greedy_assign(dp, dn, ds, self.weights, topo=dt)
+            assigned, usage = greedy_assign(
+                dp, dn, ds, self.weights, topo=dt, extra_mask=extra_mask
+            )
             rounds = len(batch)
         else:
             assigned, usage, rounds = batch_assign(
@@ -192,19 +250,18 @@ class Scheduler:
                 max_rounds=self.max_rounds,
                 per_node_cap=self.per_node_cap,
                 topo=dt,
+                extra_mask=extra_mask,
             )
         assigned = np.asarray(assigned)[: len(batch)]
         res.rounds = int(rounds) if self.solver != "greedy" else rounds
-        node_order = self.cache.node_order()
 
         # reasons for the unplaced: one more filter pass against the
         # post-assignment usage (what the serial loop would have seen last)
         failed_idx = [i for i, a in enumerate(assigned) if a < 0]
         reasons_row: Dict[int, Tuple[str, ...]] = {}
+        rmat = None
         if failed_idx:
-            from kubernetes_tpu.ops.assign import nodes_with_usage
-
-            fr = run_predicates(dp, nodes_with_usage(dn, usage), ds, dt)
+            fr = _filter_pass(dp, nodes_with_usage(dn, usage), ds, dt)
             rmat = np.asarray(fr.reasons)
             nvalid = np.asarray(dn.valid)
             for i in failed_idx:
@@ -236,8 +293,82 @@ class Scheduler:
                 self.event_sink("Scheduled", pod, node_name)
             else:
                 self._fail(pod, cycle, res, reasons_row.get(i, ()))
+
+        # preemption (scheduler.go:493 -> preempt, §3.3): failed pods try to
+        # evict lower-priority pods; winners get a nominated node and retry
+        if self.enable_preemption and failed_idx and rmat is not None:
+            self._run_preemption(batch, failed_idx, rmat, node_order, res)
         res.elapsed_s = self.clock() - t0
         return res
+
+    def _nominated_pods(self, exclude) -> List[Tuple[Pod, str]]:
+        """(pod, node) for every nominated pod not in the current batch and
+        whose node still exists."""
+        out: List[Tuple[Pod, str]] = []
+        for node_name, pods in self.queue.nominated.items():
+            if self.cache.node(node_name) is None:
+                continue
+            for p in pods:
+                if p.key() not in exclude:
+                    out.append((p, node_name))
+        return out
+
+    def _run_preemption(self, batch, failed_idx, rmat, node_order, res) -> None:
+        from kubernetes_tpu.preemption import preempt
+
+        nodes = self.cache.nodes()
+        node_pods_of = {nd.name: self.cache.pods_on(nd.name) for nd in nodes}
+        pdbs = list(self.pdb_lister())
+        order = sorted(failed_idx, key=lambda i: -batch[i].priority)
+        done = 0
+        for i in order:
+            if done >= self.max_preemptions_per_cycle:
+                break
+            pod = batch[i]
+            reason_bits = {
+                name: int(rmat[i, r])
+                for r, name in enumerate(node_order)
+                if name
+            }
+            result = preempt(
+                pod, nodes, node_pods_of, reason_bits, pdbs,
+                nominated_pods_of=dict(self.queue.nominated.items()),
+            )
+            if result is None:
+                continue
+            now = self.clock()
+            for v in result.victims:
+                v.deletion_timestamp = now
+                self.event_sink("Preempted", v, f"by {pod.key()}")
+                if self.victim_deleter is not None:
+                    # deletion goes through the hub; the victim stays in the
+                    # cache as terminating until the watch delete arrives
+                    self.victim_deleter(v)
+                else:
+                    self.cache.remove_pod(v.key())
+                # either way, later preemptors in this cycle must not
+                # re-select (and re-delete) the same victims
+                node_pods_of[result.node_name] = [
+                    p
+                    for p in node_pods_of[result.node_name]
+                    if p.key() != v.key()
+                ]
+            # clear lower-priority nominations on the chosen node
+            # (scheduler.go:330 getLowerPriorityNominatedPods)
+            for p in result.clear_nominations:
+                p.nominated_node_name = ""
+                self.queue.nominated.delete(p)
+            pod.nominated_node_name = result.node_name
+            self.queue.nominated.add(pod, result.node_name)
+            res.preempted += len(result.victims)
+            res.nominations[pod.key()] = result.node_name
+            done += 1
+        if res.preempted and self.victim_deleter is None:
+            # the victims' delete "events" happened inline (grace 0); the
+            # reference's watch delete -> MoveAllToActiveQueue wakeup must
+            # happen here too or the nominated preemptor sits in
+            # unschedulableQ until the 60 s leftover flush
+            self.queue.move_all_to_active()
 
     def _fail(self, pod: Pod, cycle: int, res: CycleResult, reasons) -> None:
         res.unschedulable += 1

@@ -1,0 +1,285 @@
+"""Preemption tests — the analog of the preemption scenarios in
+``core/generic_scheduler_test.go`` (selectVictimsOnNode, PDB reprieve,
+pickOneNodeForPreemption tie-breaks) plus driver E2E: preempt -> nominated
+capacity held -> preemptor lands next cycle."""
+
+from kubernetes_tpu.api.types import LabelSelector, PodDisruptionBudget
+from kubernetes_tpu.preemption import (
+    PreemptionResult,
+    filter_pods_with_pdb_violation,
+    nodes_where_preemption_might_help,
+    pick_one_node,
+    pod_eligible_to_preempt_others,
+    preempt,
+    select_victims_on_node,
+)
+from kubernetes_tpu.ops.predicates import BIT
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _cluster(n_nodes=2, cpu=2000):
+    nodes = [make_node(f"n{i}", cpu_milli=cpu, pods=10) for i in range(n_nodes)]
+    return nodes
+
+
+def test_nodes_where_preemption_might_help_filters_unresolvable():
+    bits = {
+        "res": 1 << BIT["PodFitsResources"],
+        "sel": 1 << BIT["PodMatchNodeSelector"],
+        "mixed": (1 << BIT["PodFitsResources"]) | (1 << BIT["PodToleratesNodeTaints"]),
+        "ok": 0,
+        "ports": 1 << BIT["PodFitsHostPorts"],
+        "aff": 1 << BIT["MatchInterPodAffinity"],
+    }
+    assert sorted(nodes_where_preemption_might_help(bits)) == ["aff", "ports", "res"]
+
+
+def test_select_victims_minimal_set():
+    """Reprieve keeps pods that still fit: only the cheapest sufficient
+    victims are evicted, highest-priority pods reprieved first."""
+    nodes = _cluster(1, cpu=2000)
+    v_lo = make_pod("lo", cpu_milli=500, priority=1, node_name="n0")
+    v_mid = make_pod("mid", cpu_milli=500, priority=5, node_name="n0")
+    v_hi = make_pod("hi", cpu_milli=500, priority=8, node_name="n0")
+    preemptor = make_pod("p", cpu_milli=800, priority=10)
+    r = select_victims_on_node(
+        preemptor, nodes[0], nodes, {"n0": [v_lo, v_mid, v_hi]}
+    )
+    assert r is not None
+    victims, pdb = r
+    # need to free 300m: reprieve hi (fits: 500+500+800=1800<=2000? after
+    # removing all three, fit check: 800 fits; re-add hi -> 1300 ok; re-add
+    # mid -> 1800 ok; re-add lo -> 2300 > 2000 -> victim
+    assert [v.name for v in victims] == ["lo"] and pdb == 0
+
+
+def test_select_victims_none_when_high_priority_blocks():
+    nodes = _cluster(1, cpu=1000)
+    blocker = make_pod("b", cpu_milli=900, priority=100, node_name="n0")
+    preemptor = make_pod("p", cpu_milli=500, priority=10)
+    assert select_victims_on_node(preemptor, nodes[0], nodes, {"n0": [blocker]}) is None
+
+
+def test_pdb_reprieve_prefers_sparing_protected_pods():
+    """PDB-violating candidates are reprieved first, so the eviction falls
+    on unprotected pods when possible."""
+    nodes = _cluster(1, cpu=2000)
+    protected = make_pod("prot", cpu_milli=700, priority=2, node_name="n0",
+                         labels={"app": "critical"})
+    plain = make_pod("plain", cpu_milli=700, priority=2, node_name="n0")
+    pdb = PodDisruptionBudget(
+        name="pdb", selector=LabelSelector(match_labels={"app": "critical"}),
+        disruptions_allowed=0,
+    )
+    preemptor = make_pod("p", cpu_milli=1200, priority=10)
+    victims, nviol = select_victims_on_node(
+        preemptor, nodes[0], nodes, {"n0": [protected, plain]}, pdbs=[pdb]
+    )
+    # freeing 600m requires one eviction; the protected pod is re-added
+    # first and kept, the plain pod becomes the victim
+    assert [v.name for v in victims] == ["plain"] and nviol == 0
+
+
+def test_filter_pods_with_pdb_violation():
+    a = make_pod("a", labels={"app": "x"})
+    b = make_pod("b", labels={"app": "y"})
+    pdb = PodDisruptionBudget(selector=LabelSelector(match_labels={"app": "x"}),
+                              disruptions_allowed=0)
+    pdb_open = PodDisruptionBudget(selector=LabelSelector(match_labels={"app": "y"}),
+                                   disruptions_allowed=2)
+    viol, ok = filter_pods_with_pdb_violation([a, b], [pdb, pdb_open])
+    assert [p.name for p in viol] == ["a"] and [p.name for p in ok] == ["b"]
+
+
+def test_pick_one_node_tiers():
+    v = lambda name, pri, start=0.0: make_pod(name, priority=pri, start_time=start)
+    # tier 1: fewest PDB violations
+    assert pick_one_node({
+        "a": ([v("x", 5)], 1),
+        "b": ([v("y", 9)], 0),
+    }) == "b"
+    # tier 2: lowest highest-victim priority
+    assert pick_one_node({
+        "a": ([v("x", 9)], 0),
+        "b": ([v("y", 3)], 0),
+    }) == "b"
+    # tier 3: smallest priority sum
+    assert pick_one_node({
+        "a": ([v("x", 5), v("x2", 5)], 0),
+        "b": ([v("y", 5), v("y2", 1)], 0),
+    }) == "b"
+    # tier 4: fewest victims
+    assert pick_one_node({
+        "a": ([v("x", 5), v("x2", 5)], 0),
+        "b": ([v("y", 5), v("y2", 5), v("y3", 0)], 0),
+    }) == "a"
+    # tier 5: latest start time of highest-priority victim
+    assert pick_one_node({
+        "a": ([v("x", 5, start=10.0)], 0),
+        "b": ([v("y", 5, start=99.0)], 0),
+    }) == "b"
+    # empty-victims node wins outright
+    assert pick_one_node({"a": ([v("x", 5)], 0), "b": ([], 0)}) == "b"
+    assert pick_one_node({}) is None
+
+
+def test_eligibility_blocked_by_terminating_victim():
+    p = make_pod("p", priority=10)
+    p.nominated_node_name = "n0"
+    dying = make_pod("victim", priority=1, node_name="n0")
+    dying.deletion_timestamp = 123.0
+    assert not pod_eligible_to_preempt_others(p, {"n0": [dying]})
+    dying.deletion_timestamp = 0.0
+    assert pod_eligible_to_preempt_others(p, {"n0": [dying]})
+
+
+def test_preempt_end_to_end_function():
+    nodes = _cluster(2, cpu=1000)
+    low0 = make_pod("l0", cpu_milli=900, priority=1, node_name="n0")
+    low1 = make_pod("l1", cpu_milli=900, priority=5, node_name="n1")
+    preemptor = make_pod("p", cpu_milli=900, priority=10)
+    bits = {
+        "n0": 1 << BIT["PodFitsResources"],
+        "n1": 1 << BIT["PodFitsResources"],
+    }
+    r = preempt(preemptor, nodes, {"n0": [low0], "n1": [low1]}, bits)
+    assert isinstance(r, PreemptionResult)
+    # tier 2: lowest highest-victim priority -> n0 (victim priority 1 < 5)
+    assert r.node_name == "n0" and [v.name for v in r.victims] == ["l0"]
+
+
+# -- driver E2E -------------------------------------------------------------
+
+
+def _sched(**kw):
+    clk = FakeClock()
+    kw.setdefault("clock", clk)
+    return Scheduler(**kw), clk
+
+
+def test_driver_preempts_and_schedules_next_cycle():
+    s, clk = _sched()
+    events = []
+    s.event_sink = lambda reason, pod, msg: events.append((reason, pod.name))
+    s.on_node_add(make_node("n0", cpu_milli=1000, pods=10))
+    s.on_pod_add(make_pod("low", cpu_milli=900, priority=1))
+    r1 = s.schedule_cycle()
+    assert r1.scheduled == 1
+
+    s.on_pod_add(make_pod("high", cpu_milli=900, priority=50))
+    r2 = s.schedule_cycle()
+    assert r2.unschedulable == 1
+    assert r2.preempted == 1
+    assert r2.nominations == {"default/high": "n0"}
+    assert ("Preempted", "low") in events
+    # victim removed (grace 0); nominated capacity holds for high
+    assert s.cache.pod_count() == 0
+
+    # the inline victim deletion must have woken the queue itself (the
+    # watch-delete -> MoveAllToActiveQueue analog); only backoff remains
+    clk.advance(2.0)
+    r3 = s.schedule_cycle()
+    assert r3.assignments.get("default/high") == "n0"
+
+
+def test_nominated_capacity_blocks_lower_priority_poachers():
+    """While 'high' waits nominated on n0, a new lower-priority pod must
+    not steal the freed capacity (the two-pass nominated rule)."""
+    s, clk = _sched()
+    s.on_node_add(make_node("n0", cpu_milli=1000, pods=10))
+    s.on_pod_add(make_pod("low", cpu_milli=900, priority=1))
+    assert s.schedule_cycle().scheduled == 1
+    s.on_pod_add(make_pod("high", cpu_milli=900, priority=50))
+    r = s.schedule_cycle()
+    assert r.nominations == {"default/high": "n0"}
+
+    # poacher arrives while high is still waiting in unschedulableQ
+    s.on_pod_add(make_pod("poacher", cpu_milli=900, priority=5))
+    r2 = s.schedule_cycle()
+    assert r2.scheduled == 0 and "default/poacher" in r2.failure_reasons
+
+    # high itself still lands (auto-wakeup + backoff expiry)
+    clk.advance(2.0)
+    r3 = s.schedule_cycle()
+    assert r3.assignments.get("default/high") == "n0"
+
+
+def test_preemption_respects_pdb_across_nodes():
+    """Node whose victims violate no PDB wins tier 1."""
+    s, clk = _sched(pdb_lister=lambda: [
+        PodDisruptionBudget(selector=LabelSelector(match_labels={"app": "guarded"}),
+                            disruptions_allowed=0)
+    ])
+    s.on_node_add(make_node("n0", cpu_milli=1000, pods=10))
+    s.on_node_add(make_node("n1", cpu_milli=1000, pods=10))
+    s.on_pod_add(make_pod("guarded", cpu_milli=900, priority=1, labels={"app": "guarded"}))
+    s.on_pod_add(make_pod("plain", cpu_milli=900, priority=1))
+    r = s.schedule_cycle()
+    assert r.scheduled == 2
+    guarded_node = r.assignments["default/guarded"]
+    plain_node = r.assignments["default/plain"]
+
+    s.on_pod_add(make_pod("big", cpu_milli=900, priority=50))
+    r2 = s.schedule_cycle()
+    assert r2.nominations["default/big"] == plain_node != guarded_node
+
+
+def test_two_preemptors_nominate_distinct_nodes():
+    """Nominated pods are phantom occupants in later what-if checks (the
+    reference passes the scheduling queue into podFitsOnNode), so two
+    same-cycle preemptors spread across two victims' nodes instead of both
+    being promised the first freed node."""
+    s, clk = _sched()
+    for i in range(2):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=1000, pods=10))
+    for i in range(2):
+        s.on_pod_add(make_pod(f"low{i}", cpu_milli=900, priority=1))
+    assert s.schedule_cycle().scheduled == 2
+    s.on_pod_add(make_pod("hi0", cpu_milli=900, priority=50))
+    s.on_pod_add(make_pod("hi1", cpu_milli=900, priority=40))
+    r = s.schedule_cycle()
+    assert r.preempted == 2
+    assert sorted(r.nominations.values()) == ["n0", "n1"]
+    clk.advance(2.0)
+    r2 = s.schedule_cycle()
+    assert sorted(r2.assignments) == ["default/hi0", "default/hi1"]
+
+
+def test_hub_deleter_no_double_eviction_in_one_cycle():
+    """With a victim_deleter (hub mode), two failed pods in one cycle must
+    not both select and re-delete the same victim."""
+    deleted = []
+    s, clk = _sched(victim_deleter=lambda v: deleted.append(v.key()))
+    s.on_node_add(make_node("n0", cpu_milli=1000, pods=10))
+    s.on_pod_add(make_pod("low", cpu_milli=900, priority=1))
+    assert s.schedule_cycle().scheduled == 1
+    s.on_pod_add(make_pod("h1", cpu_milli=900, priority=50))
+    s.on_pod_add(make_pod("h2", cpu_milli=900, priority=40))
+    r = s.schedule_cycle()
+    assert deleted == ["default/low"]
+    assert r.preempted == 1
+    # the victim stays cached as terminating until the watch delete arrives
+    assert s.cache.pod_count() == 1
+
+
+def test_no_preemption_when_disabled():
+    s, _ = _sched(enable_preemption=False)
+    s.on_node_add(make_node("n0", cpu_milli=1000, pods=10))
+    s.on_pod_add(make_pod("low", cpu_milli=900, priority=1))
+    s.schedule_cycle()
+    s.on_pod_add(make_pod("high", cpu_milli=900, priority=50))
+    r = s.schedule_cycle()
+    assert r.preempted == 0 and r.nominations == {}
+    assert s.cache.pod_count() == 1
