@@ -198,6 +198,78 @@ class Toleration:
 
 
 # ---------------------------------------------------------------------------
+# Volumes
+# ---------------------------------------------------------------------------
+
+#: Volume source kinds the volume predicates recognize (the slice of
+#: v1.VolumeSource / v1.PersistentVolumeSource the reference's volume
+#: predicates consume — predicates.go:216 isVolumeConflict,
+#: :555-620 VolumeFilters, csi_volume_predicate.go).
+VOL_GCE_PD = "gce-pd"
+VOL_AWS_EBS = "aws-ebs"
+VOL_AZURE_DISK = "azure-disk"
+VOL_CINDER = "cinder"
+VOL_RBD = "rbd"
+VOL_ISCSI = "iscsi"
+VOL_CSI = "csi"
+
+#: binding modes (storage.k8s.io/v1 VolumeBindingMode)
+BINDING_IMMEDIATE = "Immediate"
+BINDING_WAIT_FOR_FIRST_CONSUMER = "WaitForFirstConsumer"
+
+
+@dataclass(frozen=True)
+class PodVolume:
+    """One spec.volumes entry reduced to what the volume predicates read.
+
+    Either an inline cloud volume (``kind`` + ``handle``: pdName / volumeID /
+    diskName / "pool/image" for RBD / IQN for ISCSI) or a PVC reference
+    (``pvc`` set; kind/handle then resolve through PVC -> PV)."""
+
+    kind: str = ""
+    handle: str = ""
+    read_only: bool = False
+    pvc: str = ""  # persistentVolumeClaim.claimName
+
+
+@dataclass
+class PersistentVolume:
+    """Slice of v1.PersistentVolume: source identity, zone labels
+    (VolumeZoneChecker reads only the two failure-domain label keys,
+    predicates.go:645), node affinity (volume binder), claim binding."""
+
+    name: str
+    kind: str = ""  # VOL_* source kind; VOL_CSI uses ``driver`` too
+    handle: str = ""
+    driver: str = ""  # CSI driver name when kind == VOL_CSI
+    labels: Dict[str, str] = field(default_factory=dict)
+    node_affinity: Tuple[NodeSelectorTerm, ...] = ()  # ORed terms
+    storage_class: str = ""
+    claim_ref: str = ""  # "namespace/name" of bound claim; "" = available
+
+
+@dataclass
+class PersistentVolumeClaim:
+    name: str
+    namespace: str = "default"
+    volume_name: str = ""  # bound PV name; "" = unbound
+    storage_class: str = ""
+
+
+@dataclass
+class StorageClass:
+    name: str
+    binding_mode: str = BINDING_IMMEDIATE
+    #: provisioner name; non-empty and not the no-provisioner sentinel means
+    #: dynamic provisioning can satisfy an unbound delayed-binding claim
+    #: (volume scheduling lib: checkVolumeProvisions).
+    provisioner: str = ""
+
+    def provisionable(self) -> bool:
+        return bool(self.provisioner) and self.provisioner != "kubernetes.io/no-provisioner"
+
+
+# ---------------------------------------------------------------------------
 # Pod / Node
 # ---------------------------------------------------------------------------
 
@@ -240,6 +312,8 @@ class Pod:
     #: lower-priority pod on the nominated node blocks re-preemption
     #: (generic_scheduler.go:1190 podEligibleToPreemptOthers).
     deletion_timestamp: float = 0.0
+    #: spec.volumes reduced to what the volume predicates consume.
+    volumes: Tuple[PodVolume, ...] = ()
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"

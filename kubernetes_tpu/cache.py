@@ -218,6 +218,13 @@ class SchedulerCache:
         if node_name in self._nodes:
             self._dirty.add(node_name)
 
+    def invalidate_snapshot(self) -> None:
+        """Force a full repack on the next snapshot(). Needed when state
+        OUTSIDE the node/pod tables changes row contents — e.g. a PVC
+        rebinding changes which volume tokens scheduled pods resolve to
+        without any node or pod mutation marking rows dirty."""
+        self._shape_dirty = True
+
     # -- snapshot ----------------------------------------------------------
 
     def snapshot(self) -> NodeTable:

@@ -11,7 +11,13 @@ from typing import NamedTuple
 import jax.numpy as jnp
 import numpy as np
 
-from kubernetes_tpu.snapshot import NodeTable, PodTable, SelectorTables, TopologyTables
+from kubernetes_tpu.snapshot import (
+    NodeTable,
+    PodTable,
+    SelectorTables,
+    TopologyTables,
+    VolumeTables,
+)
 from kubernetes_tpu.utils.interner import bucket_size
 
 
@@ -49,6 +55,13 @@ class DeviceNodes(NamedTuple):
     anti_counts: jnp.ndarray  # (N, Ua) f32
     sym_counts: jnp.ndarray  # (N, Us) f32
     aff_pod_count: jnp.ndarray  # (N,) f32
+    vol_any_mh: jnp.ndarray  # (N, Uv) f32
+    vol_rw_mh: jnp.ndarray  # (N, Uv) f32
+    pd_mh: jnp.ndarray  # (N, Uvd) f32
+    pd_limit: jnp.ndarray  # (N, 4) f32
+    csi_mh: jnp.ndarray  # (N, Uvc) f32
+    csi_limit: jnp.ndarray  # (N, Dc) f32 — +inf = no limit
+    has_zone_label: jnp.ndarray  # (N,) bool
 
     @property
     def n(self) -> int:
@@ -81,6 +94,11 @@ class DevicePods(NamedTuple):
     anti_term_mh: jnp.ndarray  # (P, Ua) f32
     sym_term_mh: jnp.ndarray  # (P, Us) f32
     has_aff: jnp.ndarray  # (P,) bool
+    vol_any_mh: jnp.ndarray  # (P, Uv) f32
+    vol_rw_mh: jnp.ndarray  # (P, Uv) f32
+    pd_mh: jnp.ndarray  # (P, Uvd) f32
+    csi_mh: jnp.ndarray  # (P, Uvc) f32
+    vol_error: jnp.ndarray  # (P,) bool
 
     @property
     def n(self) -> int:
@@ -164,6 +182,24 @@ class DeviceTopology(NamedTuple):
     ssp_valid: jnp.ndarray
 
 
+class DeviceVolumes(NamedTuple):
+    """Volume-constraint tables: universe metadata (token kinds/escapes)
+    plus this batch's VolumeZone rows and VolumeBinding CNF clauses."""
+
+    conflict_escape: jnp.ndarray  # (Uv,) f32
+    pd_type_onehot: jnp.ndarray  # (Uvd, 4) f32
+    csi_driver_onehot: jnp.ndarray  # (Uvc, Dc) f32
+    vz_valid: jnp.ndarray  # (Rv,) bool
+    vz_pod: jnp.ndarray  # (Rv,) i32 — pad rows -> 0 with valid False
+    vz_pairs_mh: jnp.ndarray  # (Rv, Up) f32
+    vb_row_valid: jnp.ndarray  # (Rb,) bool
+    vb_row_clause: jnp.ndarray  # (Rb,) i32
+    vb_row_prog: jnp.ndarray  # (Rb,) i32
+    vb_clause_valid: jnp.ndarray  # (Cb,) bool
+    vb_clause_pod: jnp.ndarray  # (Cb,) i32
+    vb_clause_bound: jnp.ndarray  # (Cb,) bool
+
+
 def _pad_rows(a: np.ndarray, rows: int, fill=0) -> np.ndarray:
     if a.shape[0] == rows:
         return a
@@ -208,6 +244,15 @@ def nodes_to_device(t: NodeTable, pad_to: int | None = None) -> DeviceNodes:
         anti_counts=f32(t.anti_counts),
         sym_counts=f32(t.sym_counts),
         aff_pod_count=f32(t.aff_pod_count),
+        vol_any_mh=f32(t.vol_any_mh),
+        vol_rw_mh=f32(t.vol_rw_mh),
+        pd_mh=f32(t.pd_mh),
+        pd_limit=jnp.asarray(_pad_rows(t.pd_limit.astype(np.float32), n_pad, 0.0)),
+        csi_mh=f32(t.csi_mh),
+        csi_limit=jnp.asarray(
+            _pad_rows(t.csi_limit.astype(np.float32), n_pad, np.inf)
+        ),
+        has_zone_label=jnp.asarray(_pad_rows(t.has_zone_label, n_pad, False)),
     )
 
 
@@ -243,6 +288,11 @@ def pods_to_device(t: PodTable, pad_to: int | None = None) -> DevicePods:
         anti_term_mh=f32(t.anti_term_mh),
         sym_term_mh=f32(t.sym_term_mh),
         has_aff=jnp.asarray(_pad_rows(t.has_aff, p_pad, False)),
+        vol_any_mh=f32(t.vol_any_mh),
+        vol_rw_mh=f32(t.vol_rw_mh),
+        pd_mh=f32(t.pd_mh),
+        csi_mh=f32(t.csi_mh),
+        vol_error=jnp.asarray(_pad_rows(t.vol_error, p_pad, False)),
     )
 
 
@@ -301,6 +351,40 @@ def selectors_to_device(t: SelectorTables) -> DeviceSelectors:
         p_prog_valid=jnp.asarray(
             _pad_rows(np.ones((t.p_n_progs,), bool), bucket_size(max(t.p_n_progs, 1)), False)
         ),
+    )
+
+
+def volumes_to_device(t: VolumeTables) -> DeviceVolumes:
+    from kubernetes_tpu.volumes import N_PD_FILTERS
+
+    def onehot(idx: np.ndarray, width: int) -> jnp.ndarray:
+        oh = np.zeros((len(idx), width), np.float32)
+        if len(idx):
+            oh[np.arange(len(idx)), np.clip(idx, 0, width - 1)] = 1.0
+        return jnp.asarray(oh)
+
+    def valid(n: int, rows: int) -> jnp.ndarray:
+        v = np.zeros((rows,), bool)
+        v[:n] = True
+        return jnp.asarray(v)
+
+    Rv = bucket_size(max(t.vz_n_rows, 1), 4)
+    Rb = bucket_size(max(t.vb_n_rows, 1), 4)
+    Cb = bucket_size(max(t.vb_n_clauses, 1), 4)
+    Dc = bucket_size(max(t.n_csi_drivers, 1), 4)
+    return DeviceVolumes(
+        conflict_escape=jnp.asarray(t.conflict_escape),
+        pd_type_onehot=onehot(t.pd_type, N_PD_FILTERS),
+        csi_driver_onehot=onehot(t.csi_driver, Dc),
+        vz_valid=valid(t.vz_n_rows, Rv),
+        vz_pod=jnp.asarray(_pad_rows(t.vz_pod, Rv, 0)),
+        vz_pairs_mh=jnp.asarray(_pad_rows(t.vz_pairs_mh.astype(np.float32), Rv)),
+        vb_row_valid=valid(t.vb_n_rows, Rb),
+        vb_row_clause=jnp.asarray(_pad_rows(t.vb_row_clause, Rb, 0)),
+        vb_row_prog=jnp.asarray(_pad_rows(t.vb_row_prog, Rb, 0)),
+        vb_clause_valid=valid(t.vb_n_clauses, Cb),
+        vb_clause_pod=jnp.asarray(_pad_rows(t.vb_clause_pod, Cb, 0)),
+        vb_clause_bound=jnp.asarray(_pad_rows(t.vb_clause_bound, Cb, False)),
     )
 
 

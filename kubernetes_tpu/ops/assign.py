@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from kubernetes_tpu.ops.arrays import DeviceNodes, DevicePods, DeviceSelectors
-from kubernetes_tpu.ops.predicates import run_predicates
+from kubernetes_tpu.ops.predicates import run_predicates, static_volume_reasons
 from kubernetes_tpu.ops.priorities import run_priorities
 
 NEG = -1e30
@@ -53,6 +53,10 @@ class UsageState(NamedTuple):
     anti_counts: jnp.ndarray  # (N, Ua)
     sym_counts: jnp.ndarray  # (N, Us)
     aff_pod_count: jnp.ndarray  # (N,)
+    vol_any: jnp.ndarray  # (N, Uv)
+    vol_rw: jnp.ndarray  # (N, Uv)
+    pd_mh: jnp.ndarray  # (N, Uvd)
+    csi_mh: jnp.ndarray  # (N, Uvc)
 
 
 def usage_from_nodes(nodes: DeviceNodes) -> UsageState:
@@ -67,6 +71,10 @@ def usage_from_nodes(nodes: DeviceNodes) -> UsageState:
         anti_counts=nodes.anti_counts,
         sym_counts=nodes.sym_counts,
         aff_pod_count=nodes.aff_pod_count,
+        vol_any=nodes.vol_any_mh,
+        vol_rw=nodes.vol_rw_mh,
+        pd_mh=nodes.pd_mh,
+        csi_mh=nodes.csi_mh,
     )
 
 
@@ -82,6 +90,10 @@ def nodes_with_usage(nodes: DeviceNodes, u: UsageState) -> DeviceNodes:
         anti_counts=u.anti_counts,
         sym_counts=u.sym_counts,
         aff_pod_count=u.aff_pod_count,
+        vol_any_mh=u.vol_any,
+        vol_rw_mh=u.vol_rw,
+        pd_mh=u.pd_mh,
+        csi_mh=u.csi_mh,
     )
 
 
@@ -107,6 +119,10 @@ def _apply_batch(u: UsageState, pods: DevicePods, node_idx: jnp.ndarray,
         aff_pod_count=u.aff_pod_count.at[tgt].add(
             pods.has_aff.astype(jnp.float32) * w[:, 0]
         ),
+        vol_any=u.vol_any.at[tgt].max(pods.vol_any_mh * w),
+        vol_rw=u.vol_rw.at[tgt].max(pods.vol_rw_mh * w),
+        pd_mh=u.pd_mh.at[tgt].max(pods.pd_mh * w),
+        csi_mh=u.csi_mh.at[tgt].max(pods.csi_mh * w),
     )
 
 
@@ -125,17 +141,25 @@ def queue_order(pods: DevicePods) -> jnp.ndarray:
 
 
 @partial(jax.jit, static_argnames=("weights_key",))
-def _greedy_impl(pods, nodes, sel, topo, weights_key, extra_mask):
+def _greedy_impl(pods, nodes, sel, topo, vol, weights_key, extra_mask,
+                 static_vol=None):
     weights = dict(weights_key) if weights_key else None
     P = pods.req.shape[0]
     perm = queue_order(pods)
     u0 = usage_from_nodes(nodes)
+    if vol is not None and static_vol is None:
+        static_vol = static_volume_reasons(pods, nodes, sel, vol)
 
     def step(u, p):
         pod = _pod_slice(pods, p)
         cur = nodes_with_usage(nodes, u)
         extra = jax.lax.dynamic_index_in_dim(extra_mask, p, axis=0, keepdims=True)
-        mask = run_predicates(pod, cur, sel, topo).mask & extra  # (1, N)
+        sv = (
+            jax.lax.dynamic_index_in_dim(static_vol, p, axis=0, keepdims=True)
+            if static_vol is not None
+            else None
+        )
+        mask = run_predicates(pod, cur, sel, topo, vol, sv).mask & extra  # (1, N)
         score = run_priorities(pod, cur, sel, mask, weights, topo)
         masked = jnp.where(mask, score, NEG)
         best = jnp.argmax(masked[0])
@@ -155,6 +179,8 @@ def greedy_assign(
     weights: Optional[Dict[str, float]] = None,
     topo=None,
     extra_mask: Optional[jnp.ndarray] = None,
+    vol=None,
+    static_vol: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, UsageState]:
     """Serial-parity solver. Returns (assigned node row per pod or -1,
     final usage). ``extra_mask`` (P, N) ANDs into feasibility — the driver
@@ -165,7 +191,8 @@ def greedy_assign(
         extra_mask = jnp.ones(
             (pods.req.shape[0], nodes.allocatable.shape[0]), bool
         )
-    return _greedy_impl(pods, nodes, sel, topo, key, extra_mask)
+    return _greedy_impl(pods, nodes, sel, topo, vol, key, extra_mask,
+                        static_vol)
 
 
 def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray:
@@ -178,14 +205,26 @@ def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray
 
 @partial(jax.jit, static_argnames=("weights_key", "max_rounds", "per_node_cap"))
 def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
-                extra_mask):
+                extra_mask, vol=None, static_vol=None):
     weights = dict(weights_key) if weights_key else None
     P = pods.req.shape[0]
     perm = queue_order(pods)
     rank = jnp.zeros((P,), jnp.int32).at[perm].set(jnp.arange(P, dtype=jnp.int32))
+    # pods carrying host ports or attach-counted/conflict-checked volumes
+    # are admitted at most one per node per round (conservative, exact):
+    # their feasibility couples across same-round admissions to one node
     has_port = (
         jnp.sum(pods.port_wild_pp, axis=1) + jnp.sum(pods.port_spec_pp, axis=1)
     ) > 0
+    if vol is not None:
+        has_port = has_port | (
+            jnp.sum(pods.vol_any_mh, axis=1)
+            + jnp.sum(pods.pd_mh, axis=1)
+            + jnp.sum(pods.csi_mh, axis=1)
+            > 0
+        )
+    if vol is not None and static_vol is None:
+        static_vol = static_volume_reasons(pods, nodes, sel, vol)
     if topo is not None:
         from kubernetes_tpu.ops.topology import sensitive_keys
 
@@ -200,7 +239,11 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
         assigned, u, _, rnd = carry
         cur = nodes_with_usage(nodes, u)
         active = (assigned == -1) & pods.valid
-        mask = run_predicates(pods, cur, sel, topo).mask & active[:, None] & extra_mask
+        mask = (
+            run_predicates(pods, cur, sel, topo, vol, static_vol).mask
+            & active[:, None]
+            & extra_mask
+        )
         score = run_priorities(pods, cur, sel, mask, weights, topo)
         masked = jnp.where(mask, score, NEG)
         choice = jnp.argmax(masked, axis=1).astype(jnp.int32)  # (P,)
@@ -294,6 +337,8 @@ def batch_assign(
     per_node_cap: int = 1,
     topo=None,
     extra_mask: Optional[jnp.ndarray] = None,
+    vol=None,
+    static_vol: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, UsageState, jnp.ndarray]:
     """Fast batched solver. Returns (assigned row per pod or -1, final
     usage, rounds executed). ``per_node_cap`` bounds admissions per node per
@@ -306,4 +351,4 @@ def batch_assign(
             (pods.req.shape[0], nodes.allocatable.shape[0]), bool
         )
     return _batch_impl(pods, nodes, sel, topo, key, max_rounds, per_node_cap,
-                       extra_mask)
+                       extra_mask, vol, static_vol)

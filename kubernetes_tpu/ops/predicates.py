@@ -53,6 +53,12 @@ PREDICATE_BITS = (
     "PodFitsResources",          # bit 9
     "MatchInterPodAffinity",     # bit 10
     "EvenPodsSpread",            # bit 11
+    "NoDiskConflict",            # bit 12
+    "MaxVolumeCount",            # bit 13 (all four in-tree checkers + CSI)
+    "NoVolumeZoneConflict",      # bit 14
+    "VolumeNodeConflict",        # bit 15 (CheckVolumeBinding, bound PVCs)
+    "VolumeBindConflict",        # bit 16 (CheckVolumeBinding, unbound PVCs)
+    "VolumeError",               # bit 17 (unresolvable PVC/PV state)
 )
 BIT = {name: i for i, name in enumerate(PREDICATE_BITS)}
 
@@ -136,15 +142,17 @@ def run_predicates(
     nodes: DeviceNodes,
     sel: DeviceSelectors,
     topo: DeviceTopology | None = None,
+    vol=None,
+    static_reasons: jnp.ndarray | None = None,
 ) -> FilterResult:
     """The fused Filter pass: all predicates, all (pod, node) pairs.
 
     Equivalent surface: findNodesThatFit (generic_scheduler.go:460) with the
     default predicate set (algorithmprovider/defaults/defaults.go:40) plus
-    feature-gated EvenPodsSpread, minus volume predicates (stubbed as
-    always-true for now; pluggable mask providers compose via logical AND
-    downstream). ``topo=None`` skips the inter-pod-affinity/spread passes
-    (cheaper trace for workloads with no such terms).
+    feature-gated EvenPodsSpread. ``topo=None`` skips the
+    inter-pod-affinity/spread passes and ``vol=None`` (a
+    :class:`~kubernetes_tpu.ops.arrays.DeviceVolumes`) the five volume
+    predicates — cheaper traces for workloads without such constraints.
     """
     P, N = pods.req.shape[0], nodes.allocatable.shape[0]
     reasons = jnp.zeros((P, N), jnp.int32)
@@ -218,6 +226,11 @@ def run_predicates(
         spread_ok = even_pods_spread_mask(pods, nodes, topo, prog)
         reasons |= jnp.where(~spread_ok, jnp.int32(1 << BIT["EvenPodsSpread"]), 0)
 
+    if vol is not None:
+        reasons |= _dynamic_volume_reasons(pods, nodes, vol)
+    if static_reasons is not None:
+        reasons |= static_reasons
+
     # PodFitsResources (predicates.go:779): the pod-count cap always applies;
     # the remaining columns are checked only when the pod requests *anything*
     # (predicates.go:803-809: an all-zero request short-circuits), and then
@@ -229,6 +242,122 @@ def run_predicates(
     # padding: invalid nodes/pods are infeasible with no reasons surfaced
     mask = (reasons == 0) & nodes.valid[None, :] & pods.valid[:, None]
     return FilterResult(mask=mask, reasons=reasons)
+
+
+def _dynamic_volume_reasons(
+    pods: DevicePods, nodes: DeviceNodes, vol
+) -> jnp.ndarray:
+    """Usage-dependent volume predicates (they read node volume state that
+    changes as pods land, so they re-evaluate every assignment round):
+
+    - NoDiskConflict (predicates.go:275): shared conflict token where not
+      both mounts are read-only (GCE-PD/ISCSI/RBD escape; EBS never does).
+    - MaxPDVolumeCount (:404) + CSI limits (csi_volume_predicate.go:54):
+      per-kind unique-volume counts vs per-node attach limits.
+
+    All terms are pod-row-local (no cross-pod segments), so single-row pod
+    slices in the serial parity path evaluate correctly.
+    """
+    P, N = pods.req.shape[0], nodes.allocatable.shape[0]
+    reasons = jnp.zeros((P, N), jnp.int32)
+
+    # ---- NoDiskConflict --------------------------------------------------
+    esc = vol.conflict_escape  # (Uv,)
+    conflicts = (
+        (pods.vol_any_mh * (1.0 - esc)) @ nodes.vol_any_mh.T
+        + (pods.vol_any_mh * esc) @ nodes.vol_rw_mh.T
+        + (pods.vol_rw_mh * esc) @ nodes.vol_any_mh.T
+    )
+    reasons |= jnp.where(conflicts > 0, jnp.int32(1 << BIT["NoDiskConflict"]), 0)
+
+    # ---- MaxPDVolumeCount (4 in-tree kinds, statically unrolled) ---------
+    # each checker quick-returns when the pod has no relevant volumes
+    # (predicates.go:471), so limits only bind pods that carry that kind —
+    # including pods whose volumes are all already mounted on an over-limit
+    # node (numNewVolumes may be 0 but the count check still runs :516)
+    count_fail = jnp.zeros((P, N), bool)
+    for t in range(vol.pd_type_onehot.shape[1]):
+        tm = vol.pd_type_onehot[:, t]  # (Uvd,)
+        podt = pods.pd_mh * tm
+        nodet = nodes.pd_mh * tm
+        has_t = jnp.sum(podt, axis=1) > 0  # (P,)
+        node_cnt = jnp.sum(nodet, axis=1)  # (N,)
+        new = jnp.sum(podt, axis=1)[:, None] - podt @ nodet.T  # (P, N)
+        over = node_cnt[None, :] + new > nodes.pd_limit[:, t][None, :]
+        count_fail |= has_t[:, None] & over
+
+    # ---- CSI per-driver limits ------------------------------------------
+    # the CSI checker only examines drivers the pod *adds* volumes for
+    # (csi_volume_predicate.go:104 iterates newVolumeCount), so an
+    # already-mounted-only pod passes even on an over-limit node
+    for d in range(vol.csi_driver_onehot.shape[1]):
+        dm = vol.csi_driver_onehot[:, d]
+        podd = pods.csi_mh * dm
+        noded = nodes.csi_mh * dm
+        node_cnt = jnp.sum(noded, axis=1)
+        new = jnp.sum(podd, axis=1)[:, None] - podd @ noded.T
+        over = node_cnt[None, :] + new > nodes.csi_limit[:, d][None, :]
+        count_fail |= (new > 0) & over
+    reasons |= jnp.where(count_fail, jnp.int32(1 << BIT["MaxVolumeCount"]), 0)
+    return reasons
+
+
+def static_volume_reasons(
+    pods: DevicePods, nodes: DeviceNodes, sel: DeviceSelectors, vol
+) -> jnp.ndarray:
+    """Usage-independent volume predicates, computed once per scheduling
+    cycle and ORed into every round's reasons via ``static_reasons``:
+
+    - NoVolumeZoneConflict (predicates.go:632): bound PVs' failure-domain
+      labels vs node labels.
+    - CheckVolumeBinding (:1666): PV node-affinity CNF over selector
+      programs (rows reference this pod batch, so this must be evaluated
+      against the same batch layout as ``pack_pods``).
+    - VolumeError: unresolvable PVC/PV state fails the pod everywhere.
+    """
+    P, N = pods.req.shape[0], nodes.allocatable.shape[0]
+    reasons = jnp.zeros((P, N), jnp.int32)
+    prog = selector_program_match(sel, nodes)  # (G, N)
+
+    # ---- NoVolumeZoneConflict -------------------------------------------
+    # row passes where the node carries an allowed (key, value) pair or has
+    # no zone labels at all (the nodeConstraints fast path)
+    row_hit = (vol.vz_pairs_mh @ nodes.pair_mh.T) > 0  # (Rv, N)
+    row_bad = (~row_hit) & nodes.has_zone_label[None, :] & vol.vz_valid[:, None]
+    vz_bad = jax.ops.segment_max(
+        row_bad.astype(jnp.int32), vol.vz_pod, num_segments=P
+    )  # (P, N)
+    reasons |= jnp.where(vz_bad > 0, jnp.int32(1 << BIT["NoVolumeZoneConflict"]), 0)
+
+    # ---- CheckVolumeBinding (CNF over PV-affinity programs) -------------
+    Cb = vol.vb_clause_pod.shape[0]
+    row_m = prog[jnp.clip(vol.vb_row_prog, 0, prog.shape[0] - 1)]  # (Rb, N)
+    row_m = row_m & vol.vb_row_valid[:, None]
+    clause_ok = (
+        jax.ops.segment_max(
+            row_m.astype(jnp.int32), vol.vb_row_clause, num_segments=Cb
+        )
+        > 0
+    )  # (Cb, N); a clause with no rows (no candidate PV) stays False
+    clause_bad = (~clause_ok) & vol.vb_clause_valid[:, None]
+    bound_bad = jax.ops.segment_max(
+        (clause_bad & vol.vb_clause_bound[:, None]).astype(jnp.int32),
+        vol.vb_clause_pod,
+        num_segments=P,
+    )
+    unbound_bad = jax.ops.segment_max(
+        (clause_bad & ~vol.vb_clause_bound[:, None]).astype(jnp.int32),
+        vol.vb_clause_pod,
+        num_segments=P,
+    )
+    reasons |= jnp.where(bound_bad > 0, jnp.int32(1 << BIT["VolumeNodeConflict"]), 0)
+    reasons |= jnp.where(unbound_bad > 0, jnp.int32(1 << BIT["VolumeBindConflict"]), 0)
+
+    # ---- unresolvable volume state: fails everywhere --------------------
+    reasons |= jnp.where(
+        pods.vol_error[:, None], jnp.int32(1 << BIT["VolumeError"]), 0
+    )
+    return reasons
 
 
 def resource_fit_mask(

@@ -39,6 +39,11 @@ RESOLVABLE_BITS = (
     | (1 << BIT["PodFitsHostPorts"])
     | (1 << BIT["MatchInterPodAffinity"])
     | (1 << BIT["EvenPodsSpread"])
+    # disk conflicts and attach-count limits clear when mounting pods are
+    # evicted; zone/node-affinity/bind conflicts do not (the reference lists
+    # ErrVolume{Zone,Node,Bind}Conflict as unresolvable)
+    | (1 << BIT["NoDiskConflict"])
+    | (1 << BIT["MaxVolumeCount"])
 )
 
 
@@ -84,6 +89,7 @@ def _fits_with(
     node: Node,
     nodes: Sequence[Node],
     node_pods_of: Dict[str, List[Pod]],
+    vol_state=None,
 ) -> bool:
     """Full predicate check of ``pod`` on ``node`` against the given
     hypothetical cluster state (podFitsOnNode's predicate set as evaluated
@@ -92,6 +98,12 @@ def _fits_with(
         seqref.feasible(pod, node, node_pods_of.get(node.name, []))
         and seqref.inter_pod_affinity_feasible(pod, node, nodes, node_pods_of)
         and seqref.even_pods_spread_feasible(pod, node, nodes, node_pods_of)
+        and (
+            vol_state is None
+            or seqref.volumes_feasible(
+                pod, node, node_pods_of.get(node.name, []), vol_state
+            )
+        )
     )
 
 
@@ -102,6 +114,7 @@ def select_victims_on_node(
     node_pods_of: Dict[str, List[Pod]],
     pdbs: Sequence[PodDisruptionBudget] = (),
     nominated_pods_of: Optional[Dict[str, List[Pod]]] = None,
+    vol_state=None,
 ) -> Optional[Tuple[List[Pod], int]]:
     """selectVictimsOnNode (generic_scheduler.go:1079). Returns
     (victims, num_pdb_violations) or None when preemption can't help.
@@ -124,7 +137,7 @@ def select_victims_on_node(
     # hypothetical state: all lower-priority pods gone, phantoms present
     state = dict(node_pods_of)
     state[node.name] = keep + phantoms
-    if not _fits_with(pod, node, nodes, state):
+    if not _fits_with(pod, node, nodes, state, vol_state):
         return None
 
     violating, non_violating = filter_pods_with_pdb_violation(potential, pdbs)
@@ -133,7 +146,7 @@ def select_victims_on_node(
 
     def reprieve(p: Pod) -> bool:
         state[node.name] = state[node.name] + [p]
-        if _fits_with(pod, node, nodes, state):
+        if _fits_with(pod, node, nodes, state, vol_state):
             return True  # keep it — not a victim
         state[node.name] = state[node.name][:-1]
         return False
@@ -211,6 +224,7 @@ def preempt(
     reason_bits_by_node: Dict[str, int],
     pdbs: Sequence[PodDisruptionBudget] = (),
     nominated_pods_of: Optional[Dict[str, List[Pod]]] = None,
+    vol_state=None,
 ) -> Optional[PreemptionResult]:
     """The full Preempt flow for one unschedulable pod. ``node_pods_of``
     maps node name -> pods (from the cache); ``reason_bits_by_node`` is the
@@ -228,6 +242,7 @@ def preempt(
         r = select_victims_on_node(
             pod, nd, nodes, node_pods_of, pdbs,
             nominated_pods_of=nominated_pods_of,
+            vol_state=vol_state,
         )
         if r is not None:
             candidates[name] = r

@@ -52,10 +52,19 @@ from kubernetes_tpu.utils.interner import bucket_size
 
 
 @jax.jit
-def _filter_pass(dp, dn, ds, dt):
+def _filter_pass(dp, dn, ds, dt, dv=None, sv=None):
     """One standalone filter evaluation (reasons + mask) — used for the
     nominated-pods pass-A mask and for failure-reason reporting."""
-    return run_predicates(dp, dn, ds, dt)
+    return run_predicates(dp, dn, ds, dt, dv, sv)
+
+
+@jax.jit
+def _static_vol_pass(dp, dn, ds, dv):
+    """Usage-independent volume reasons, computed once per cycle and shared
+    by the solver rounds and the reporting passes."""
+    from kubernetes_tpu.ops.predicates import static_volume_reasons
+
+    return static_volume_reasons(dp, dn, ds, dv)
 
 
 class Binder(Protocol):
@@ -166,6 +175,7 @@ class Scheduler:
             self.queue.move_all_to_active()
         else:
             self.queue.delete(pod.key())
+        self.cache.packer.forget_pod(pod.key())
 
     def on_node_add(self, node) -> None:
         self.cache.add_node(node)
@@ -177,6 +187,17 @@ class Scheduler:
 
     def on_node_delete(self, name: str) -> None:
         self.cache.remove_node(name)
+
+    def set_volume_state(self, pvcs=(), pvs=(), classes=()) -> None:
+        """PV/PVC/StorageClass informer feed. Any volume-state change can
+        make pods schedulable, so the unschedulable queue resweeps (the
+        reference moves on PV/PVC add/update events, eventhandlers.go).
+        The cached node snapshot is invalidated: scheduled pods' volume
+        tokens (NodeTable.pd_mh/csi_mh/vol_*_mh) depend on PVC->PV
+        resolution, which just changed under them."""
+        self.cache.packer.set_volume_state(pvcs, pvs, classes)
+        self.cache.invalidate_snapshot()
+        self.queue.move_all_to_active()
 
     # -- the cycle ---------------------------------------------------------
 
@@ -216,6 +237,12 @@ class Scheduler:
         dp = pods_to_device(pt, pad_to=bucket_size(max(len(batch), 1)))
         ds = selectors_to_device(pk.pack_selector_tables())
         dt = topology_to_device(pk.pack_topology_tables()) if _has_topo(pk.u) else None
+        dv = sv = None
+        if any(p.volumes for p in batch):
+            from kubernetes_tpu.ops.arrays import volumes_to_device
+
+            dv = volumes_to_device(pk.pack_volume_tables(batch))
+            sv = _static_vol_pass(dp, dn, ds, dv)
 
         # nominated-pods pass A (podFitsOnNode two-pass rule,
         # generic_scheduler.go:610): feasibility must ALSO hold with the
@@ -237,11 +264,14 @@ class Scheduler:
                 usage_from_nodes(dn), dpn, jnp.asarray(nom_rows),
                 jnp.asarray(nom_ok) & dpn.valid,
             )
-            extra_mask = _filter_pass(dp, nodes_with_usage(dn, u_nom), ds, dt).mask
+            extra_mask = _filter_pass(
+                dp, nodes_with_usage(dn, u_nom), ds, dt, dv, sv
+            ).mask
 
         if self.solver == "greedy":
             assigned, usage = greedy_assign(
-                dp, dn, ds, self.weights, topo=dt, extra_mask=extra_mask
+                dp, dn, ds, self.weights, topo=dt, extra_mask=extra_mask,
+                vol=dv, static_vol=sv,
             )
             rounds = len(batch)
         else:
@@ -251,6 +281,8 @@ class Scheduler:
                 per_node_cap=self.per_node_cap,
                 topo=dt,
                 extra_mask=extra_mask,
+                vol=dv,
+                static_vol=sv,
             )
         assigned = np.asarray(assigned)[: len(batch)]
         res.rounds = int(rounds) if self.solver != "greedy" else rounds
@@ -261,7 +293,7 @@ class Scheduler:
         reasons_row: Dict[int, Tuple[str, ...]] = {}
         rmat = None
         if failed_idx:
-            fr = _filter_pass(dp, nodes_with_usage(dn, usage), ds, dt)
+            fr = _filter_pass(dp, nodes_with_usage(dn, usage), ds, dt, dv, sv)
             rmat = np.asarray(fr.reasons)
             nvalid = np.asarray(dn.valid)
             for i in failed_idx:
@@ -333,6 +365,7 @@ class Scheduler:
             result = preempt(
                 pod, nodes, node_pods_of, reason_bits, pdbs,
                 nominated_pods_of=dict(self.queue.nominated.items()),
+                vol_state=self.cache.packer.resolve_volumes,
             )
             if result is None:
                 continue

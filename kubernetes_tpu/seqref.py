@@ -629,3 +629,145 @@ def serial_schedule(
         node_pods[nodes[best_j].name].append(placed)
         out[i] = (best_j, float(best_s))
     return out
+
+
+# -- volume predicates (predicates.go:275,:404,:632,:1666; csi_volume_ -------
+# predicate.go:54) — sequential oracles over the same VolumeState model
+
+
+def _resolved(pod: Pod, state):
+    """``state`` is either a VolumeState or a cached resolver callable
+    (e.g. SnapshotPacker.resolve_volumes) — preemption what-ifs re-check
+    the same pods many times, so the driver passes the memoized form."""
+    if callable(state):
+        return state(pod)
+    from kubernetes_tpu.volumes import resolve_pod_volumes
+
+    return resolve_pod_volumes(pod, state)
+
+
+def no_disk_conflict(pod: Pod, node_pods: Sequence[Pod], state) -> bool:
+    """NoDiskConflict (predicates.go:275): inline GCE-PD/EBS/RBD/ISCSI
+    volumes vs volumes of pods already on the node; read-only mounts escape
+    for every kind but EBS (isVolumeConflict :216)."""
+    from kubernetes_tpu.volumes import CONFLICT_RO_ESCAPE
+
+    mine = _resolved(pod, state).conflict
+    for ep in node_pods:
+        theirs = _resolved(ep, state).conflict
+        for kind, handle, ro in mine:
+            for ekind, ehandle, ero in theirs:
+                if kind == ekind and handle == ehandle:
+                    if not (CONFLICT_RO_ESCAPE[kind] and ro and ero):
+                        return False
+    return True
+
+
+def max_pd_volume_count(
+    pod: Pod, node: Node, node_pods: Sequence[Pod], state
+) -> bool:
+    """All four MaxPDVolumeCountChecker instances (predicates.go:404)."""
+    from kubernetes_tpu.volumes import N_PD_FILTERS, node_pd_limits
+
+    limits = node_pd_limits(node)
+    new = _resolved(pod, state).pd
+    if not new:
+        return True
+    existing: set = set()
+    for ep in node_pods:
+        existing.update(_resolved(ep, state).pd)
+    for t in range(N_PD_FILTERS):
+        if not any(v[0] == t for v in new):
+            continue  # this checker quick-returns (predicates.go:471)
+        n_existing = sum(1 for e in existing if e[0] == t)
+        n_new = sum(1 for v in set(new) if v[0] == t and v not in existing)
+        if n_existing + n_new > limits[t]:
+            return False
+    return True
+
+
+def csi_max_volume_count(
+    pod: Pod, node: Node, node_pods: Sequence[Pod], state
+) -> bool:
+    """CSIMaxVolumeLimitChecker (csi_volume_predicate.go:54)."""
+    from kubernetes_tpu.volumes import CSI_LIMIT_PREFIX
+
+    new = set(_resolved(pod, state).csi)
+    if not new:
+        return True
+    existing: set = set()
+    for ep in node_pods:
+        existing.update(_resolved(ep, state).csi)
+    new -= existing
+    drivers = {d for d, _ in new} | {d for d, _ in existing}
+    for d in drivers:
+        limit = node.allocatable.scalars.get(CSI_LIMIT_PREFIX + d)
+        if limit is None:
+            continue
+        cur = sum(1 for e in existing if e[0] == d)
+        add = sum(1 for v in new if v[0] == d)
+        if add and cur + add > limit:
+            return False
+    return True
+
+
+def volume_zone(pod: Pod, node: Node, state) -> Tuple[bool, bool]:
+    """NoVolumeZoneConflict (predicates.go:632). Returns (ok, error)."""
+    from kubernetes_tpu.volumes import node_has_zone_label
+
+    rv = _resolved(pod, state)
+    if rv.error:
+        return False, True
+    if not node_has_zone_label(node):
+        return True, False
+    for key, allowed in rv.zone_rows:
+        if node.labels.get(key, "") not in allowed:
+            return False, False
+    return True, False
+
+
+def volume_binding(pod: Pod, node: Node, state) -> Tuple[bool, bool, bool]:
+    """CheckVolumeBinding (predicates.go:1666 -> FindPodVolumes).
+    Returns (bound_satisfied, unbound_satisfied, error)."""
+    rv = _resolved(pod, state)
+    if rv.error:
+        return False, False, True
+    bound_ok = True
+    for terms in rv.bound_affinity:
+        if not any(
+            t.match_expressions and _match_expressions(node, t.match_expressions)
+            for t in terms
+        ):
+            bound_ok = False
+    unbound_ok = True
+    for cands in rv.unbound_clauses:
+        satisfied = False
+        for terms in cands:
+            if not terms or any(
+                t.match_expressions and _match_expressions(node, t.match_expressions)
+                for t in terms
+            ):
+                satisfied = True
+                break
+        if not satisfied:
+            unbound_ok = False
+    return bound_ok, unbound_ok, False
+
+
+def volumes_feasible(
+    pod: Pod, node: Node, node_pods: Sequence[Pod], state
+) -> bool:
+    """AND of all five volume predicates (the default-provider volume set,
+    defaults.go:40)."""
+    vz_ok, vz_err = volume_zone(pod, node, state)
+    b_ok, u_ok, vb_err = volume_binding(pod, node, state)
+    return (
+        not vz_err
+        and not vb_err
+        and vz_ok
+        and b_ok
+        and u_ok
+        and no_disk_conflict(pod, node_pods, state)
+        and max_pd_volume_count(pod, node, node_pods, state)
+        and csi_max_volume_count(pod, node, node_pods, state)
+    )

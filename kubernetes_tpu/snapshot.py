@@ -47,6 +47,16 @@ from kubernetes_tpu.api.types import (
     Toleration,
 )
 from kubernetes_tpu.utils.interner import Interner, bucket_size
+from kubernetes_tpu.volumes import (
+    CONFLICT_RO_ESCAPE,
+    CSI_LIMIT_PREFIX,
+    N_PD_FILTERS,
+    ResolvedVolumes,
+    VolumeState,
+    node_has_zone_label,
+    node_pd_limits,
+    resolve_pod_volumes,
+)
 
 # Fixed resource columns; scalar/extended resources append after these.
 # Mirrors nodeinfo.Resource (node_info.go:146).
@@ -148,6 +158,12 @@ class Universe:
         self.spread_hard_program_rows: List[Tuple[Tuple[Tuple[int, int, int], ...], int]] = []
         self.spread_soft_programs = Interner()  # rows (key, matcher)
         self.spread_soft_program_rows: List[Tuple[Tuple[Tuple[int, int], ...], int]] = []
+        # ---- volume universes (kubernetes_tpu.volumes) -------------------
+        self.vol_conflict = Interner()  # (kind, handle) — NoDiskConflict tokens
+        self.vol_conflict_escape: List[bool] = []  # read-only escape per token
+        self.pd_volumes = Interner()  # (filter_idx, token) — MaxPDVolumeCount
+        self.csi_drivers = Interner()  # CSI driver names
+        self.csi_volumes = Interner()  # (driver_id, handle)
 
     # -- resources ---------------------------------------------------------
 
@@ -430,6 +446,37 @@ class Universe:
                 row[mid] = 1
         return row
 
+    # -- volumes -----------------------------------------------------------
+
+    def intern_volume_refs(self, rv: ResolvedVolumes) -> None:
+        """Seed the volume universes (+ zone label pairs + PV-affinity
+        selector programs) with one pod's resolved volumes so widths are
+        stable by pack time."""
+        for kind, handle, _ro in rv.conflict:
+            cid = self.vol_conflict.intern((kind, handle))
+            if cid == len(self.vol_conflict_escape):
+                self.vol_conflict_escape.append(CONFLICT_RO_ESCAPE[kind])
+        for fi, tok in rv.pd:
+            self.pd_volumes.intern((fi, tok))
+        for driver, handle in rv.csi:
+            d = self.csi_drivers.intern(driver)
+            self.csi_volumes.intern((d, handle))
+        for key, allowed in rv.zone_rows:
+            for z in allowed:
+                self.label_pairs.intern((key, z))
+        for terms in rv.bound_affinity:
+            self.intern_node_selector_program({}, Affinity(node_required=tuple(terms)))
+        for cands in rv.unbound_clauses:
+            for terms in cands:
+                if terms:
+                    self.intern_node_selector_program(
+                        {}, Affinity(node_required=tuple(terms))
+                    )
+
+    def pv_affinity_program(self, terms) -> int:
+        """Selector-program id of a PV's node affinity (already interned)."""
+        return self.intern_node_selector_program({}, Affinity(node_required=tuple(terms)))
+
     # -- owner selectors (SelectorSpread) ----------------------------------
 
     def intern_owner_set(self, namespace: str, selectors) -> int:
@@ -493,6 +540,14 @@ class NodeTable:
     anti_counts: np.ndarray  # (N, Ua) f32 — pods carrying required anti term a
     sym_counts: np.ndarray  # (N, Us) f32 — pods carrying sym scoring term s
     aff_pod_count: np.ndarray  # (N,) f32 — pods with any (anti)affinity
+    # ---- volume state ----------------------------------------------------
+    vol_any_mh: np.ndarray  # (N, Uv) i8 — conflict token mounted by any pod
+    vol_rw_mh: np.ndarray  # (N, Uv) i8 — mounted NOT read-only by some pod
+    pd_mh: np.ndarray  # (N, Uvd) i8 — count-checked volume tokens present
+    pd_limit: np.ndarray  # (N, 4) f32 — attach limit per in-tree filter kind
+    csi_mh: np.ndarray  # (N, Uvc) i8 — CSI volume tokens present
+    csi_limit: np.ndarray  # (N, Dc) f32 — per-driver limit; +inf = none
+    has_zone_label: np.ndarray  # (N,) bool — VolumeZone fast-path carrier
 
 
 @dataclass
@@ -527,6 +582,12 @@ class PodTable:
     anti_term_mh: np.ndarray  # (P, Ua) i8 — its required anti terms
     sym_term_mh: np.ndarray  # (P, Us) f32 — its sym terms (counts, can repeat)
     has_aff: np.ndarray  # (P,) bool — any pod (anti)affinity at all
+    # ---- volumes ---------------------------------------------------------
+    vol_any_mh: np.ndarray  # (P, Uv) i8
+    vol_rw_mh: np.ndarray  # (P, Uv) i8
+    pd_mh: np.ndarray  # (P, Uvd) i8
+    csi_mh: np.ndarray  # (P, Uvc) i8
+    vol_error: np.ndarray  # (P,) bool — unresolvable volume state
 
 
 @dataclass
@@ -610,6 +671,30 @@ class TopologyTables:
     ssp_selprog: np.ndarray  # (Gss,) i32
 
 
+@dataclass
+class VolumeTables:
+    """Universe-level volume metadata + batch-level zone/binding constraint
+    rows for one pending-pod pack (row indices reference that batch)."""
+
+    conflict_escape: np.ndarray  # (Uv,) f32 — read-only escape per token
+    pd_type: np.ndarray  # (Uvd,) i32 — filter kind of each count token
+    csi_driver: np.ndarray  # (Uvc,) i32 — driver id of each CSI token
+    n_csi_drivers: int
+    # VolumeZone rows: AND across a pod's rows; a row passes on nodes that
+    # carry one of the allowed (key, value) label pairs or no zone labels
+    vz_n_rows: int
+    vz_pod: np.ndarray  # (Rv,) i32
+    vz_pairs_mh: np.ndarray  # (Rv, Up) i8
+    # VolumeBinding CNF: AND over clauses; clause = OR over rows, each row
+    # one PV-affinity selector program; empty clause = unsatisfiable
+    vb_n_rows: int
+    vb_n_clauses: int
+    vb_row_clause: np.ndarray  # (Rb,) i32
+    vb_row_prog: np.ndarray  # (Rb,) i32
+    vb_clause_pod: np.ndarray  # (Cb,) i32
+    vb_clause_bound: np.ndarray  # (Cb,) bool — bound- vs unbound-PVC clause
+
+
 def _pod_has_affinity(pod: Pod) -> bool:
     """NodeInfo.PodsWithAffinity membership: any pod (anti)affinity,
     required or preferred (nodeinfo/node_info.go AddPod)."""
@@ -646,6 +731,39 @@ class SnapshotPacker:
     def __init__(self, universe: Optional[Universe] = None) -> None:
         self.u = universe or Universe()
         self._pod_refs: Dict[tuple, Tuple[int, int, int, int]] = {}
+        # volume listers + per-pod resolution cache (state-dependent, so
+        # cached separately from _pod_refs and dropped on state change)
+        self.vol_state = VolumeState()
+        self._vol_pods: Dict[tuple, Pod] = {}
+        self._vol_cache: Dict[tuple, ResolvedVolumes] = {}
+
+    # -- volume state ------------------------------------------------------
+
+    def set_volume_state(self, pvcs=(), pvs=(), classes=()) -> None:
+        """Replace the PVC/PV/StorageClass listers (informer feed analog).
+        All known pods' volumes re-resolve so universes stay complete."""
+        self.vol_state = VolumeState.build(pvcs, pvs, classes)
+        self._vol_cache.clear()
+        for pod in self._vol_pods.values():
+            self.resolve_volumes(pod)
+
+    def resolve_volumes(self, pod: Pod) -> ResolvedVolumes:
+        key = (pod.key(), pod.uid)
+        rv = self._vol_cache.get(key)
+        if rv is None:
+            rv = resolve_pod_volumes(pod, self.vol_state)
+            self.u.intern_volume_refs(rv)
+            self._vol_cache[key] = rv
+        return rv
+
+    def forget_pod(self, pod_key: str) -> None:
+        """Drop per-pod memoization for a deleted pod so churn doesn't grow
+        the caches (and set_volume_state doesn't re-resolve dead pods)
+        forever. Universe tokens stay — interners are append-only by design
+        (bucketed widths make stale entries cheap)."""
+        for cache in (self._pod_refs, self._vol_cache, self._vol_pods):
+            for k in [k for k in cache if k[0] == pod_key]:
+                del cache[k]
 
     # -- interning ---------------------------------------------------------
 
@@ -654,6 +772,9 @@ class SnapshotPacker:
         spread_hard, spread_soft) ids, cached per pod identity
         (namespace/name/uid — uid so a deleted-and-recreated pod with
         different spec is re-interned)."""
+        if pod.volumes:
+            self._vol_pods[(pod.key(), pod.uid)] = pod
+            self.resolve_volumes(pod)
         cached = self._pod_refs.get((pod.key(), pod.uid))
         if cached is not None:
             return cached
@@ -725,6 +846,10 @@ class SnapshotPacker:
             "M": bucket_size(len(u.pod_matchers)),
             "Ua": bucket_size(len(u.anti_terms), 4),
             "Us": bucket_size(len(u.sym_terms), 4),
+            "Uv": bucket_size(len(u.vol_conflict), 4),
+            "Uvd": bucket_size(len(u.pd_volumes), 4),
+            "Uvc": bucket_size(len(u.csi_volumes), 4),
+            "Dc": bucket_size(len(u.csi_drivers), 4),
         }
 
     # -- nodes -------------------------------------------------------------
@@ -772,6 +897,14 @@ class SnapshotPacker:
         anti_counts = np.zeros((n, w["Ua"]), np.float32)
         sym_counts = np.zeros((n, w["Us"]), np.float32)
         aff_pod_count = np.zeros((n,), np.float32)
+        vol_any = np.zeros((n, w["Uv"]), np.int8)
+        vol_rw = np.zeros((n, w["Uv"]), np.int8)
+        pd_mh = np.zeros((n, w["Uvd"]), np.int8)
+        pd_limit = np.zeros((n, N_PD_FILTERS), np.float32)
+        csi_mh = np.zeros((n, w["Uvc"]), np.int8)
+        csi_limit = np.full((n, w["Dc"]), np.inf, np.float32)
+        has_zone = np.zeros((n,), bool)
+        driver_names = u.csi_drivers.items()
 
         row_of: Dict[int, int] = {}
         for i, nd in enumerate(nodes):
@@ -816,6 +949,12 @@ class SnapshotPacker:
                 v = nd.labels.get(key)
                 if v is not None:
                     topo_pair_id[i, kid] = u.topo_pairs.lookup((kid, v))
+            pd_limit[i] = node_pd_limits(nd)
+            has_zone[i] = node_has_zone_label(nd)
+            for d, driver in enumerate(driver_names):
+                lim = nd.allocatable.scalars.get(CSI_LIMIT_PREFIX + driver)
+                if lim is not None:
+                    csi_limit[i, d] = lim
 
         # aggregate scheduled pods into node usage (NodeInfo.AddPod,
         # node_info.go — requested, nonzeroRequest, usedPorts, pod count)
@@ -848,6 +987,18 @@ class SnapshotPacker:
                 sym_counts[i, s] += 1
             if _pod_has_affinity(p):
                 aff_pod_count[i] += 1
+            if p.volumes:
+                rv = self.resolve_volumes(p)
+                for kind, handle, ro in rv.conflict:
+                    cid = u.vol_conflict.lookup((kind, handle))
+                    vol_any[i, cid] = 1
+                    if not ro:
+                        vol_rw[i, cid] = 1
+                for fi, tok in rv.pd:
+                    pd_mh[i, u.pd_volumes.lookup((fi, tok))] = 1
+                for driver, handle in rv.csi:
+                    d = u.csi_drivers.lookup(driver)
+                    csi_mh[i, u.csi_volumes.lookup((d, handle))] = 1
 
         return NodeTable(
             n=n,
@@ -882,6 +1033,13 @@ class SnapshotPacker:
             anti_counts=anti_counts,
             sym_counts=sym_counts,
             aff_pod_count=aff_pod_count,
+            vol_any_mh=vol_any,
+            vol_rw_mh=vol_rw,
+            pd_mh=pd_mh,
+            pd_limit=pd_limit,
+            csi_mh=csi_mh,
+            csi_limit=csi_limit,
+            has_zone_label=has_zone,
         )
 
     # -- pods --------------------------------------------------------------
@@ -916,6 +1074,11 @@ class SnapshotPacker:
         anti_term_mh = np.zeros((n, w["Ua"]), np.float32)
         sym_term_mh = np.zeros((n, w["Us"]), np.float32)
         has_aff = np.zeros((n,), bool)
+        vol_any = np.zeros((n, w["Uv"]), np.int8)
+        vol_rw = np.zeros((n, w["Uv"]), np.int8)
+        pd_mh = np.zeros((n, w["Uvd"]), np.int8)
+        csi_mh = np.zeros((n, w["Uvc"]), np.int8)
+        vol_error = np.zeros((n,), bool)
 
         for i, p in enumerate(pods):
             refs = self.intern_pod(p)
@@ -954,6 +1117,19 @@ class SnapshotPacker:
                 owner_uid[i] = u.owner_uids.lookup(p.owner_uid)
             for o in _matching_owner_sets(u, p):
                 owner_match[i, o] = 1
+            if p.volumes:
+                rv = self.resolve_volumes(p)
+                vol_error[i] = rv.error
+                for kind, handle, ro in rv.conflict:
+                    cid = u.vol_conflict.lookup((kind, handle))
+                    vol_any[i, cid] = 1
+                    if not ro:
+                        vol_rw[i, cid] = 1
+                for fi, tok in rv.pd:
+                    pd_mh[i, u.pd_volumes.lookup((fi, tok))] = 1
+                for driver, handle in rv.csi:
+                    d = u.csi_drivers.lookup(driver)
+                    csi_mh[i, u.csi_volumes.lookup((d, handle))] = 1
 
         return PodTable(
             n=n,
@@ -981,6 +1157,86 @@ class SnapshotPacker:
             anti_term_mh=anti_term_mh,
             sym_term_mh=sym_term_mh,
             has_aff=has_aff,
+            vol_any_mh=vol_any,
+            vol_rw_mh=vol_rw,
+            pd_mh=pd_mh,
+            csi_mh=csi_mh,
+            vol_error=vol_error,
+        )
+
+    # -- volume tables -----------------------------------------------------
+
+    def pack_volume_tables(self, pods: Sequence[Pod]) -> VolumeTables:
+        """Universe volume metadata + zone/binding constraint rows for this
+        pending batch (row indices reference the batch's row order, which
+        must match the ``pack_pods`` call for the same sequence)."""
+        u = self.u
+        w = self.widths()
+        esc = np.zeros((w["Uv"],), np.float32)
+        esc[: len(u.vol_conflict_escape)] = np.asarray(
+            u.vol_conflict_escape, np.float32
+        )
+        pd_type = np.zeros((w["Uvd"],), np.int32)
+        for t, (fi, _tok) in enumerate(u.pd_volumes.items()):
+            pd_type[t] = fi
+        csi_driver = np.zeros((w["Uvc"],), np.int32)
+        for t, (d, _h) in enumerate(u.csi_volumes.items()):
+            csi_driver[t] = d
+
+        vz_pod: List[int] = []
+        vz_rows: List[List[int]] = []
+        vb_row_clause: List[int] = []
+        vb_row_prog: List[int] = []
+        vb_clause_pod: List[int] = []
+        vb_clause_bound: List[bool] = []
+        for i, p in enumerate(pods):
+            if not p.volumes:
+                continue
+            rv = self.resolve_volumes(p)
+            for key, allowed in rv.zone_rows:
+                pair_ids = [
+                    u.label_pairs.lookup((key, z))
+                    for z in allowed
+                    if u.label_pairs.lookup((key, z)) >= 0
+                ]
+                vz_pod.append(i)
+                vz_rows.append(pair_ids)
+            for terms in rv.bound_affinity:
+                cid = len(vb_clause_pod)
+                vb_clause_pod.append(i)
+                vb_clause_bound.append(True)
+                vb_row_clause.append(cid)
+                vb_row_prog.append(u.pv_affinity_program(terms))
+            for cands in rv.unbound_clauses:
+                if any(not t for t in cands):
+                    continue  # an unconstrained candidate satisfies any node
+                cid = len(vb_clause_pod)
+                vb_clause_pod.append(i)
+                vb_clause_bound.append(False)
+                for terms in cands:
+                    vb_row_clause.append(cid)
+                    vb_row_prog.append(u.pv_affinity_program(terms))
+
+        Rv = len(vz_pod)
+        vz_pairs = np.zeros((Rv, w["Up"]), np.int8)
+        for r, ids in enumerate(vz_rows):
+            for pid in ids:
+                vz_pairs[r, pid] = 1
+        i32 = lambda x: np.asarray(x, np.int32)
+        return VolumeTables(
+            conflict_escape=esc,
+            pd_type=pd_type,
+            csi_driver=csi_driver,
+            n_csi_drivers=len(u.csi_drivers),
+            vz_n_rows=Rv,
+            vz_pod=i32(vz_pod),
+            vz_pairs_mh=vz_pairs,
+            vb_n_rows=len(vb_row_clause),
+            vb_n_clauses=len(vb_clause_pod),
+            vb_row_clause=i32(vb_row_clause),
+            vb_row_prog=i32(vb_row_prog),
+            vb_clause_pod=i32(vb_clause_pod),
+            vb_clause_bound=np.asarray(vb_clause_bound, bool),
         )
 
     # -- selector / toleration tables --------------------------------------

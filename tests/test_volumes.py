@@ -1,0 +1,538 @@
+"""Volume predicate tests: targeted table cases + randomized differential
+tests against the sequential oracle — the analog of the reference's
+max_attachable_volume_predicate_test.go / predicates_test.go volume cases
+and scheduler_bench_test.go's InTreePVs/CSIPVs variants."""
+
+import random
+
+import numpy as np
+
+import pyref
+from kubernetes_tpu.api.types import (
+    BINDING_IMMEDIATE,
+    BINDING_WAIT_FOR_FIRST_CONSUMER,
+    VOL_AWS_EBS,
+    VOL_CSI,
+    VOL_GCE_PD,
+    VOL_ISCSI,
+    NodeSelectorTerm,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PodVolume,
+    Resources,
+    StorageClass,
+)
+from kubernetes_tpu.ops.arrays import (
+    nodes_to_device,
+    pods_to_device,
+    selectors_to_device,
+    volumes_to_device,
+)
+from kubernetes_tpu.ops.predicates import (
+    BIT,
+    run_predicates,
+    static_volume_reasons,
+)
+from kubernetes_tpu.snapshot import SnapshotPacker
+from kubernetes_tpu.testing import make_node, make_pod, req
+
+
+def pack_all(nodes, scheduled, pending, pvcs=(), pvs=(), classes=()):
+    pk = SnapshotPacker()
+    pk.set_volume_state(pvcs, pvs, classes)
+    for p in list(scheduled) + list(pending):
+        pk.intern_pod(p)
+    nt = pk.pack_nodes(nodes, scheduled)
+    pt = pk.pack_pods(pending)
+    st = pk.pack_selector_tables()
+    vt = pk.pack_volume_tables(pending)
+    dn = nodes_to_device(nt)
+    dp = pods_to_device(pt)
+    ds = selectors_to_device(st)
+    dv = volumes_to_device(vt)
+    sv = static_volume_reasons(dp, dn, ds, dv)
+    res = run_predicates(dp, dn, ds, None, dv, sv)
+    mask = np.asarray(res.mask)[: len(pending), : len(nodes)]
+    reasons = np.asarray(res.reasons)[: len(pending), : len(nodes)]
+    return mask, reasons, pk
+
+
+def gce(handle, ro=False):
+    return PodVolume(kind=VOL_GCE_PD, handle=handle, read_only=ro)
+
+
+def ebs(handle, ro=False):
+    return PodVolume(kind=VOL_AWS_EBS, handle=handle, read_only=ro)
+
+
+# ---------------------------------------------------------------------------
+# NoDiskConflict
+# ---------------------------------------------------------------------------
+
+
+def test_no_disk_conflict_gce_read_only_escape():
+    nodes = [make_node("n0"), make_node("n1")]
+    scheduled = [
+        make_pod("s0", node_name="n0", volumes=(gce("d1", ro=True),)),
+        make_pod("s1", node_name="n1", volumes=(gce("d1", ro=False),)),
+    ]
+    pending = [
+        make_pod("p-ro", volumes=(gce("d1", ro=True),)),
+        make_pod("p-rw", volumes=(gce("d1", ro=False),)),
+        make_pod("p-other", volumes=(gce("d2"),)),
+    ]
+    mask, reasons, _ = pack_all(nodes, scheduled, pending)
+    # read-only vs read-only: ok on n0, conflict on n1 (rw mount there)
+    assert mask[0, 0] and not mask[0, 1]
+    # rw conflicts with both
+    assert not mask[1, 0] and not mask[1, 1]
+    assert reasons[1, 0] & (1 << BIT["NoDiskConflict"])
+    # different disk never conflicts
+    assert mask[2, 0] and mask[2, 1]
+
+
+def test_no_disk_conflict_ebs_no_escape():
+    nodes = [make_node("n0")]
+    scheduled = [make_pod("s0", node_name="n0", volumes=(ebs("v1", ro=True),))]
+    pending = [make_pod("p0", volumes=(ebs("v1", ro=True),))]
+    mask, _, _ = pack_all(nodes, scheduled, pending)
+    assert not mask[0, 0]  # EBS conflicts even when both read-only
+
+
+# ---------------------------------------------------------------------------
+# MaxPDVolumeCount
+# ---------------------------------------------------------------------------
+
+
+def azure(handle):
+    return PodVolume(kind="azure-disk", handle=handle)
+
+
+def test_max_pd_volume_count_limit_and_dedup():
+    # allocatable override: only 2 Azure disks attachable (azure-disk is
+    # count-checked but NOT conflict-checked, so the dedup case stays pure)
+    n0 = make_node("n0")
+    n0.allocatable.scalars["attachable-volumes-azure-disk"] = 2
+    scheduled = [
+        make_pod("s0", node_name="n0", volumes=(azure("a"), azure("b"))),
+    ]
+    pending = [
+        make_pod("p-new", volumes=(azure("c"),)),  # would be 3rd unique -> fail
+        make_pod("p-dup", volumes=(azure("a"),)),  # already mounted -> ok
+        make_pod("p-none"),  # no volumes -> ok
+        make_pod("p-ebs", volumes=(ebs("x"),)),  # different kind -> ok
+    ]
+    mask, reasons, _ = pack_all([n0], scheduled, pending)
+    assert not mask[0, 0]
+    assert reasons[0, 0] & (1 << BIT["MaxVolumeCount"])
+    assert mask[1, 0] and mask[2, 0] and mask[3, 0]
+
+
+def test_max_pd_unknown_pvc_counts_everywhere():
+    n0 = make_node("n0")
+    n0.allocatable.scalars["attachable-volumes-gce-pd"] = 1
+    n0.allocatable.scalars["attachable-volumes-aws-ebs"] = 1
+    scheduled = [make_pod("s0", node_name="n0", volumes=(gce("a"),))]
+    # missing PVC: counted toward every checker AND a volume error
+    pending = [make_pod("p0", volumes=(PodVolume(pvc="ghost"),))]
+    mask, reasons, _ = pack_all([n0], scheduled, pending)
+    assert not mask[0, 0]
+    assert reasons[0, 0] & (1 << BIT["VolumeError"])
+
+
+def test_pvc_resolved_pd_counts():
+    n0 = make_node("n0")
+    n0.allocatable.scalars["attachable-volumes-aws-ebs"] = 1
+    pvcs = [
+        PersistentVolumeClaim("c1", volume_name="pv1"),
+        PersistentVolumeClaim("c2", volume_name="pv2"),
+    ]
+    pvs = [
+        PersistentVolume("pv1", kind=VOL_AWS_EBS, handle="vol-1"),
+        PersistentVolume("pv2", kind=VOL_AWS_EBS, handle="vol-2"),
+    ]
+    scheduled = [make_pod("s0", node_name="n0", volumes=(PodVolume(pvc="c1"),))]
+    pending = [
+        make_pod("p-over", volumes=(PodVolume(pvc="c2"),)),  # 2nd unique EBS
+        make_pod("p-same", volumes=(PodVolume(pvc="c1"),)),  # same volume
+    ]
+    mask, _, _ = pack_all([n0], scheduled, pending, pvcs=pvcs, pvs=pvs)
+    assert not mask[0, 0]
+    assert mask[1, 0]
+
+
+# ---------------------------------------------------------------------------
+# CSI limits
+# ---------------------------------------------------------------------------
+
+
+def test_csi_per_driver_limits():
+    n0 = make_node("n0")
+    n0.allocatable.scalars["attachable-volumes-csi-ebs.csi.aws.com"] = 1
+    n1 = make_node("n1")  # no limit declared -> unlimited
+    pvcs = [
+        PersistentVolumeClaim("c1", volume_name="pv1"),
+        PersistentVolumeClaim("c2", volume_name="pv2"),
+    ]
+    pvs = [
+        PersistentVolume("pv1", kind=VOL_CSI, driver="ebs.csi.aws.com", handle="h1"),
+        PersistentVolume("pv2", kind=VOL_CSI, driver="ebs.csi.aws.com", handle="h2"),
+    ]
+    scheduled = [make_pod("s0", node_name="n0", volumes=(PodVolume(pvc="c1"),))]
+    pending = [make_pod("p0", volumes=(PodVolume(pvc="c2"),))]
+    mask, reasons, _ = pack_all([n0, n1], scheduled, pending, pvcs=pvcs, pvs=pvs)
+    assert not mask[0, 0]  # over the driver limit on n0
+    assert reasons[0, 0] & (1 << BIT["MaxVolumeCount"])
+    assert mask[0, 1]  # n1 has no limit
+
+
+# ---------------------------------------------------------------------------
+# VolumeZone
+# ---------------------------------------------------------------------------
+
+
+def test_volume_zone_labels():
+    nodes = [
+        make_node("n-a", zone="us-a"),
+        make_node("n-b", zone="us-b"),
+        make_node("n-none"),  # no zone labels: passes everything
+    ]
+    pvcs = [PersistentVolumeClaim("c1", volume_name="pv1")]
+    pvs = [
+        PersistentVolume(
+            "pv1",
+            kind=VOL_GCE_PD,
+            handle="d1",
+            labels={"failure-domain.beta.kubernetes.io/zone": "us-a__us-c"},
+        )
+    ]
+    pending = [make_pod("p0", volumes=(PodVolume(pvc="c1"),))]
+    mask, reasons, _ = pack_all(nodes, [], pending, pvcs=pvcs, pvs=pvs)
+    assert mask[0, 0]  # us-a allowed
+    assert not mask[0, 1]  # us-b not in the '__' set
+    assert reasons[0, 1] & (1 << BIT["NoVolumeZoneConflict"])
+    assert mask[0, 2]  # unzoned node passes
+
+
+def test_volume_zone_unbound_immediate_errors():
+    nodes = [make_node("n0")]
+    pvcs = [PersistentVolumeClaim("c1", storage_class="fast")]  # unbound
+    classes = [StorageClass("fast", binding_mode=BINDING_IMMEDIATE)]
+    pending = [make_pod("p0", volumes=(PodVolume(pvc="c1"),))]
+    mask, reasons, _ = pack_all(nodes, [], pending, pvcs=pvcs, classes=classes)
+    assert not mask[0, 0]
+    assert reasons[0, 0] & (1 << BIT["VolumeError"])
+
+
+# ---------------------------------------------------------------------------
+# VolumeBinding
+# ---------------------------------------------------------------------------
+
+
+def _pv_affinity(key, *values):
+    return (NodeSelectorTerm((req(key, "In", *values),)),)
+
+
+def test_volume_binding_bound_pv_affinity():
+    nodes = [make_node("n-a", zone="us-a"), make_node("n-b", zone="us-b")]
+    pvcs = [PersistentVolumeClaim("c1", volume_name="pv1")]
+    pvs = [
+        PersistentVolume(
+            "pv1",
+            kind=VOL_CSI,
+            driver="d",
+            handle="h",
+            node_affinity=_pv_affinity("failure-domain.beta.kubernetes.io/zone", "us-a"),
+        )
+    ]
+    pending = [make_pod("p0", volumes=(PodVolume(pvc="c1"),))]
+    mask, reasons, _ = pack_all(nodes, [], pending, pvcs=pvcs, pvs=pvs)
+    assert mask[0, 0]
+    assert not mask[0, 1]
+    assert reasons[0, 1] & (1 << BIT["VolumeNodeConflict"])
+
+
+def test_volume_binding_unbound_wffc():
+    nodes = [make_node("n-a", zone="us-a"), make_node("n-b", zone="us-b")]
+    classes = [
+        StorageClass("local", binding_mode=BINDING_WAIT_FOR_FIRST_CONSUMER),
+        StorageClass(
+            "dyn",
+            binding_mode=BINDING_WAIT_FOR_FIRST_CONSUMER,
+            provisioner="csi.example.com",
+        ),
+        StorageClass("empty", binding_mode=BINDING_WAIT_FOR_FIRST_CONSUMER),
+    ]
+    pvcs = [
+        PersistentVolumeClaim("c-local", storage_class="local"),
+        PersistentVolumeClaim("c-dyn", storage_class="dyn"),
+        PersistentVolumeClaim("c-empty", storage_class="empty"),
+    ]
+    pvs = [
+        PersistentVolume(
+            "pv-a",
+            storage_class="local",
+            node_affinity=_pv_affinity("failure-domain.beta.kubernetes.io/zone", "us-a"),
+        )
+    ]
+    pending = [
+        make_pod("p-local", volumes=(PodVolume(pvc="c-local"),)),
+        make_pod("p-dyn", volumes=(PodVolume(pvc="c-dyn"),)),
+        make_pod("p-empty", volumes=(PodVolume(pvc="c-empty"),)),
+    ]
+    mask, reasons, _ = pack_all(nodes, [], pending, pvcs=pvcs, pvs=pvs, classes=classes)
+    # candidate PV only matches us-a
+    assert mask[0, 0] and not mask[0, 1]
+    assert reasons[0, 1] & (1 << BIT["VolumeBindConflict"])
+    # provisionable class satisfies everywhere
+    assert mask[1, 0] and mask[1, 1]
+    # no candidates, no provisioner: unsatisfiable everywhere
+    assert not mask[2, 0] and not mask[2, 1]
+
+
+# ---------------------------------------------------------------------------
+# randomized differential test vs the sequential oracle
+# ---------------------------------------------------------------------------
+
+
+def _random_volume(rng, pvc_names):
+    r = rng.random()
+    if r < 0.35:
+        return gce(f"d{rng.randrange(4)}", ro=rng.random() < 0.5)
+    if r < 0.5:
+        return ebs(f"v{rng.randrange(4)}", ro=rng.random() < 0.5)
+    if r < 0.6:
+        return PodVolume(
+            kind=VOL_ISCSI, handle=f"iqn{rng.randrange(3)}", read_only=rng.random() < 0.5
+        )
+    return PodVolume(pvc=rng.choice(pvc_names))
+
+
+def test_differential_random_volume_clusters():
+    rng = random.Random(7)
+    zone_key = "failure-domain.beta.kubernetes.io/zone"
+    for trial in range(6):
+        zones = ["za", "zb", "zc"]
+        classes = [
+            StorageClass("imm", binding_mode=BINDING_IMMEDIATE),
+            StorageClass("wffc", binding_mode=BINDING_WAIT_FOR_FIRST_CONSUMER),
+            StorageClass(
+                "dyn",
+                binding_mode=BINDING_WAIT_FOR_FIRST_CONSUMER,
+                provisioner="p.example.com",
+            ),
+        ]
+        pvs = []
+        for i in range(8):
+            kind = rng.choice([VOL_GCE_PD, VOL_AWS_EBS, VOL_CSI, ""])
+            pvs.append(
+                PersistentVolume(
+                    f"pv{i}",
+                    kind=kind,
+                    handle=f"h{rng.randrange(5)}",
+                    driver="drv.io" if kind == VOL_CSI else "",
+                    labels=(
+                        {zone_key: "__".join(rng.sample(zones, rng.randrange(1, 3)))}
+                        if rng.random() < 0.5
+                        else {}
+                    ),
+                    node_affinity=(
+                        _pv_affinity(zone_key, rng.choice(zones))
+                        if rng.random() < 0.4
+                        else ()
+                    ),
+                    storage_class=rng.choice(["imm", "wffc", "dyn", ""]),
+                    claim_ref="x/claimed" if rng.random() < 0.3 else "",
+                )
+            )
+        pvc_names = []
+        pvcs = []
+        for i in range(8):
+            name = f"c{i}"
+            pvc_names.append(name)
+            pvcs.append(
+                PersistentVolumeClaim(
+                    name,
+                    volume_name=f"pv{rng.randrange(10)}" if rng.random() < 0.7 else "",
+                    storage_class=rng.choice(["imm", "wffc", "dyn", ""]),
+                )
+            )
+        pvc_names.append("ghost")
+
+        nodes = []
+        for i in range(6):
+            nd = make_node(
+                f"n{i}",
+                zone=rng.choice(zones) if rng.random() < 0.7 else None,
+            )
+            if rng.random() < 0.5:
+                nd.allocatable.scalars["attachable-volumes-gce-pd"] = rng.choice([1, 2])
+            if rng.random() < 0.5:
+                nd.allocatable.scalars["attachable-volumes-aws-ebs"] = rng.choice([1, 2])
+            if rng.random() < 0.5:
+                nd.allocatable.scalars["attachable-volumes-csi-drv.io"] = rng.choice([1, 2])
+            nodes.append(nd)
+
+        def rand_pod(name, bound):
+            vols = tuple(
+                _random_volume(rng, pvc_names)
+                for _ in range(rng.randrange(0, 3))
+            )
+            return make_pod(
+                name,
+                node_name=f"n{rng.randrange(len(nodes))}" if bound else "",
+                volumes=vols,
+            )
+
+        scheduled = [rand_pod(f"s{i}", True) for i in range(10)]
+        pending = [rand_pod(f"p{i}", False) for i in range(12)]
+
+        mask, _, pk = pack_all(nodes, scheduled, pending, pvcs, pvs, classes)
+
+        by_node = {nd.name: [] for nd in nodes}
+        for p in scheduled:
+            by_node[p.node_name].append(p)
+        state = pk.vol_state
+        for i, pod in enumerate(pending):
+            for j, nd in enumerate(nodes):
+                want = pyref.feasible(pod, nd, by_node[nd.name]) and pyref.volumes_feasible(
+                    pod, nd, by_node[nd.name], state
+                )
+                assert mask[i, j] == want, (
+                    f"trial {trial} pod {pod.name} node {nd.name}: "
+                    f"kernel={mask[i, j]} oracle={want}"
+                )
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: batch assignment respects attach limits across rounds
+# ---------------------------------------------------------------------------
+
+
+def test_batch_assign_respects_attach_limits():
+    from kubernetes_tpu.ops.assign import batch_assign
+
+    nodes = []
+    for i in range(3):
+        nd = make_node(f"n{i}")
+        nd.allocatable.scalars["attachable-volumes-gce-pd"] = 2
+        nodes.append(nd)
+    # 9 pods each with a unique PD: only 2 can land per node -> 6 placed
+    pending = [make_pod(f"p{i}", volumes=(gce(f"disk-{i}"),)) for i in range(9)]
+    pk = SnapshotPacker()
+    for p in pending:
+        pk.intern_pod(p)
+    nt = pk.pack_nodes(nodes, [])
+    pt = pk.pack_pods(pending)
+    st = pk.pack_selector_tables()
+    vt = pk.pack_volume_tables(pending)
+    dn = nodes_to_device(nt)
+    dp = pods_to_device(pt)
+    ds = selectors_to_device(st)
+    dv = volumes_to_device(vt)
+    assigned, _, _ = batch_assign(dp, dn, ds, vol=dv)
+    a = np.asarray(assigned)[: len(pending)]
+    placed = a[a >= 0]
+    assert len(placed) == 6
+    for j in range(3):
+        assert np.sum(placed == j) <= 2
+
+
+# ---------------------------------------------------------------------------
+# driver integration: volume state flows through Scheduler cycles
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_honors_volume_state_and_rebind_wakeup():
+    from kubernetes_tpu.scheduler import Scheduler
+
+    class FakeClock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clk = FakeClock()
+    sched = Scheduler(enable_preemption=False, clock=clk)
+    for i in range(2):
+        nd = make_node(f"n{i}", zone=f"z{i}")
+        sched.on_node_add(nd)
+    # claim initially unbound with an immediate class -> volume error ->
+    # unschedulable
+    sched.set_volume_state(
+        pvcs=[PersistentVolumeClaim("c1", storage_class="std")],
+        classes=[StorageClass("std", binding_mode=BINDING_IMMEDIATE)],
+    )
+    pod = make_pod("p0", volumes=(PodVolume(pvc="c1"),))
+    sched.on_pod_add(pod)
+    res = sched.schedule_cycle()
+    assert res.scheduled == 0 and res.unschedulable == 1
+    assert "VolumeError" in res.failure_reasons[pod.key()]
+
+    # the claim binds to a PV pinned to z1 -> pod wakes up and lands on n1
+    sched.set_volume_state(
+        pvcs=[PersistentVolumeClaim("c1", volume_name="pv1", storage_class="std")],
+        pvs=[
+            PersistentVolume(
+                "pv1",
+                kind=VOL_GCE_PD,
+                handle="d1",
+                node_affinity=_pv_affinity(
+                    "failure-domain.beta.kubernetes.io/zone", "z1"
+                ),
+            )
+        ],
+        classes=[StorageClass("std", binding_mode=BINDING_IMMEDIATE)],
+    )
+    clk.t += 30.0  # clear the pod's backoff window
+    sched.run_until_settled()
+    assert dict(sched.binder.bindings).get("default/p0") == "n1"
+
+
+def test_volume_state_change_invalidates_node_snapshot():
+    """Regression: a PVC rebinding changes which tokens *scheduled* pods
+    resolve to; the cached NodeTable must repack or the kernel sees stale
+    node-side mounts (found by review: set_volume_state never dirtied the
+    cache)."""
+    from kubernetes_tpu.scheduler import Scheduler
+
+    class FakeClock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clk = FakeClock()
+    sched = Scheduler(enable_preemption=False, clock=clk)
+    n0 = make_node("n0")
+    n0.allocatable.scalars["attachable-volumes-gce-pd"] = 1
+    sched.on_node_add(n0)
+    # scheduled pod x mounts PVC c1 -> PV h1 (1/1 attached)
+    sched.set_volume_state(
+        pvcs=[
+            PersistentVolumeClaim("c1", volume_name="pv1"),
+            PersistentVolumeClaim("c2", volume_name="pv2"),
+        ],
+        pvs=[
+            PersistentVolume("pv1", kind=VOL_GCE_PD, handle="h1"),
+            PersistentVolume("pv2", kind=VOL_GCE_PD, handle="h2"),
+        ],
+    )
+    sched.on_pod_add(make_pod("x", node_name="n0", volumes=(PodVolume(pvc="c1"),)))
+    sched.schedule_cycle()  # caches the NodeTable
+
+    # c1 rebinds to pv2 (same handle as c2): pod y mounting c2 now shares
+    # the one attached disk -> must be feasible
+    sched.set_volume_state(
+        pvcs=[
+            PersistentVolumeClaim("c1", volume_name="pv2"),
+            PersistentVolumeClaim("c2", volume_name="pv2"),
+        ],
+        pvs=[
+            PersistentVolume("pv1", kind=VOL_GCE_PD, handle="h1"),
+            PersistentVolume("pv2", kind=VOL_GCE_PD, handle="h2"),
+        ],
+    )
+    sched.on_pod_add(make_pod("y", volumes=(PodVolume(pvc="c2"),)))
+    res = sched.schedule_cycle()
+    assert res.scheduled == 1, res.failure_reasons
