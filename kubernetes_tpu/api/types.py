@@ -314,6 +314,10 @@ class Pod:
     deletion_timestamp: float = 0.0
     #: spec.volumes reduced to what the volume predicates consume.
     volumes: Tuple[PodVolume, ...] = ()
+    #: container resource LIMITS (cpu/mem only) — consumed solely by
+    #: ResourceLimitsPriority (priorities/resource_limits.go getResourceLimits:
+    #: sum of containers, max'd with init containers).
+    limits: Resources = field(default_factory=Resources)
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"

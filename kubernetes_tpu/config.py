@@ -1,0 +1,344 @@
+"""Configuration: feature gates, ComponentConfig, and legacy Policy.
+
+Mirrors the reference's three config layers (SURVEY.md §5 config/flag
+system):
+
+- **feature gates** — ``pkg/features/kube_features.go`` catalog through
+  ``component-base/featuregate``; parsed from ``K=V,K2=V2`` strings.
+- **ComponentConfig** — the versioned ``KubeSchedulerConfiguration``
+  (``pkg/scheduler/apis/config/types.go:43-101``): algorithm source,
+  percentageOfNodesToScore, bindTimeout, leader election, plugins.
+- **legacy Policy** — JSON/ConfigMap predicate+priority selection
+  (``pkg/scheduler/api/types.go:46``), decoded here from dicts into an
+  enabled-predicate bitmask, a priority weights dict (with custom
+  registrations for parameterized priorities), and extender configs.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.ops.predicates import BIT, PREDICATE_BITS
+
+# ---------------------------------------------------------------------------
+# Feature gates (pkg/features/kube_features.go @ v1.16 defaults, scheduler-
+# relevant subset)
+# ---------------------------------------------------------------------------
+
+DEFAULT_FEATURE_GATES: Dict[str, bool] = {
+    "EvenPodsSpread": False,          # alpha (kube_features.go:479)
+    "AttachVolumeLimit": True,        # beta
+    "BalanceAttachedNodeVolumes": False,  # alpha
+    "ResourceLimitsPriorityFunction": False,  # alpha
+    "TaintNodesByCondition": True,    # beta->GA
+    "PodOverhead": False,             # alpha
+    "NonPreemptingPriority": False,   # alpha
+    "PodPriority": True,              # GA
+    "CSIMigration": False,            # alpha
+    "LocalStorageCapacityIsolation": True,  # beta
+}
+
+
+class FeatureGates:
+    """component-base/featuregate/feature_gate.go: known-gate map with
+    defaults; Set() parses the --feature-gates=K=V flag format."""
+
+    def __init__(self, overrides: Optional[Dict[str, bool]] = None) -> None:
+        self._gates = dict(DEFAULT_FEATURE_GATES)
+        if overrides:
+            for k, v in overrides.items():
+                self._set(k, v)
+
+    def _set(self, name: str, value: bool) -> None:
+        if name not in self._gates:
+            raise ValueError(f"unknown feature gate {name!r}")
+        self._gates[name] = bool(value)
+
+    def set_from_string(self, spec: str) -> None:
+        """Parse "K=true,K2=false" (featuregate.Set)."""
+        for part in spec.split(","):
+            if not part.strip():
+                continue
+            k, _, v = part.partition("=")
+            if v.lower() not in ("true", "false"):
+                raise ValueError(f"invalid feature gate value {part!r}")
+            self._set(k.strip(), v.lower() == "true")
+
+    def enabled(self, name: str) -> bool:
+        if name not in self._gates:
+            raise ValueError(f"unknown feature gate {name!r}")
+        return self._gates[name]
+
+
+#: process-default gates (utilfeature.DefaultFeatureGate analog)
+default_feature_gates = FeatureGates()
+
+
+# ---------------------------------------------------------------------------
+# ComponentConfig (apis/config/types.go:43 KubeSchedulerConfiguration)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LeaderElectionConfig:
+    leader_elect: bool = True
+    lease_duration_s: float = 15.0
+    renew_deadline_s: float = 10.0
+    retry_period_s: float = 2.0
+    lock_object_namespace: str = "kube-system"
+    lock_object_name: str = "kube-scheduler"
+
+
+@dataclass
+class KubeSchedulerConfiguration:
+    """The typed component config. Reference fields keep their meanings;
+    the ``solver``/``per_node_cap``/``max_batch`` block is this
+    implementation's addition (batched-solver tuning)."""
+
+    scheduler_name: str = "default-scheduler"
+    algorithm_provider: str = "DefaultProvider"
+    policy: Optional["Policy"] = None  # overrides algorithm_provider
+    hard_pod_affinity_symmetric_weight: int = 1
+    percentage_of_nodes_to_score: int = 0  # 0 = adaptive default (50->5%)
+    bind_timeout_seconds: float = 600.0
+    leader_election: LeaderElectionConfig = field(default_factory=LeaderElectionConfig)
+    feature_gates: FeatureGates = field(default_factory=FeatureGates)
+    # batched-solver tuning (no reference analog)
+    solver: str = "batch"
+    per_node_cap: int = 4
+    max_rounds: int = 128
+    max_batch: int = 8192
+
+
+# ---------------------------------------------------------------------------
+# Legacy Policy (pkg/scheduler/api/types.go:46)
+# ---------------------------------------------------------------------------
+
+#: policy predicate name -> failure-reason bits it controls
+#: (predicates.go:54-111 registration names)
+PREDICATE_NAME_BITS: Dict[str, int] = {
+    "PodFitsResources": 1 << BIT["PodFitsResources"],
+    "PodFitsHostPorts": 1 << BIT["PodFitsHostPorts"],
+    "HostName": 1 << BIT["PodFitsHost"],
+    "MatchNodeSelector": 1 << BIT["PodMatchNodeSelector"],
+    "GeneralPredicates": (
+        (1 << BIT["PodFitsResources"]) | (1 << BIT["PodFitsHost"])
+        | (1 << BIT["PodFitsHostPorts"]) | (1 << BIT["PodMatchNodeSelector"])
+    ),
+    "NoDiskConflict": 1 << BIT["NoDiskConflict"],
+    "MaxEBSVolumeCount": 1 << BIT["MaxVolumeCount"],
+    "MaxGCEPDVolumeCount": 1 << BIT["MaxVolumeCount"],
+    "MaxAzureDiskVolumeCount": 1 << BIT["MaxVolumeCount"],
+    "MaxCinderVolumeCount": 1 << BIT["MaxVolumeCount"],
+    "MaxCSIVolumeCountPred": 1 << BIT["MaxVolumeCount"],
+    "NoVolumeZoneConflict": 1 << BIT["NoVolumeZoneConflict"],
+    "CheckVolumeBinding": (
+        (1 << BIT["VolumeNodeConflict"]) | (1 << BIT["VolumeBindConflict"])
+    ),
+    "PodToleratesNodeTaints": 1 << BIT["PodToleratesNodeTaints"],
+    "CheckNodeMemoryPressure": 1 << BIT["CheckNodeMemoryPressure"],
+    "CheckNodeDiskPressure": 1 << BIT["CheckNodeDiskPressure"],
+    "CheckNodePIDPressure": 1 << BIT["CheckNodePIDPressure"],
+    "CheckNodeCondition": 1 << BIT["CheckNodeCondition"],
+    "CheckNodeUnschedulable": 1 << BIT["CheckNodeUnschedulable"],
+    "MatchInterPodAffinity": 1 << BIT["MatchInterPodAffinity"],
+    "EvenPodsSpread": 1 << BIT["EvenPodsSpread"],
+}
+
+#: always-enforced regardless of Policy (RegisterMandatoryFitPredicate:
+#: CheckNodeCondition register_predicates.go:119; PodToleratesNodeTaints +
+#: CheckNodeUnschedulable under TaintNodesByCondition defaults.go:78-80)
+#: plus VolumeError (unresolvable state is never schedulable).
+MANDATORY_BITS = (
+    (1 << BIT["CheckNodeCondition"])
+    | (1 << BIT["PodToleratesNodeTaints"])
+    | (1 << BIT["CheckNodeUnschedulable"])
+    | (1 << BIT["VolumeError"])
+)
+
+ALL_PREDICATE_BITS = (1 << len(PREDICATE_BITS)) - 1
+
+#: default provider predicate set (defaults.go:40 defaultPredicates)
+DEFAULT_PREDICATE_NAMES = (
+    "NoVolumeZoneConflict",
+    "MaxEBSVolumeCount",
+    "MaxGCEPDVolumeCount",
+    "MaxAzureDiskVolumeCount",
+    "MaxCSIVolumeCountPred",
+    "MatchInterPodAffinity",
+    "NoDiskConflict",
+    "GeneralPredicates",
+    "CheckNodeMemoryPressure",
+    "CheckNodeDiskPressure",
+    "CheckNodePIDPressure",
+    "CheckNodeCondition",
+    "PodToleratesNodeTaints",
+    "CheckVolumeBinding",
+)
+
+#: default provider priorities (defaults.go:119 defaultPriorities) —
+#: single source of truth lives next to the kernels
+from kubernetes_tpu.ops.priorities import DEFAULT_WEIGHTS as DEFAULT_PRIORITY_WEIGHTS  # noqa: E402
+
+
+def default_predicate_mask(gates: Optional[FeatureGates] = None) -> int:
+    """Enabled-bit mask of the default provider + feature-gated additions
+    (ApplyFeatureGates defaults.go:59: EvenPodsSpread joins when gated
+    on)."""
+    gates = gates or default_feature_gates
+    bits = MANDATORY_BITS
+    for name in DEFAULT_PREDICATE_NAMES:
+        bits |= PREDICATE_NAME_BITS[name]
+    if gates.enabled("EvenPodsSpread"):
+        bits |= PREDICATE_NAME_BITS["EvenPodsSpread"]
+    return bits
+
+
+def default_priority_weights(gates: Optional[FeatureGates] = None) -> Dict[str, float]:
+    gates = gates or default_feature_gates
+    w = dict(DEFAULT_PRIORITY_WEIGHTS)
+    if gates.enabled("EvenPodsSpread"):
+        w["EvenPodsSpreadPriority"] = 1
+    if gates.enabled("ResourceLimitsPriorityFunction"):
+        w["ResourceLimitsPriority"] = 1
+    return w
+
+
+@dataclass
+class ExtenderConfig:
+    """pkg/scheduler/api/types.go:203 — out-of-process extender endpoint."""
+
+    url_prefix: str = ""
+    filter_verb: str = ""
+    preempt_verb: str = ""
+    prioritize_verb: str = ""
+    bind_verb: str = ""
+    weight: int = 1
+    enable_https: bool = False
+    http_timeout_s: float = 30.0
+    node_cache_capable: bool = False
+    managed_resources: Tuple[str, ...] = ()
+    ignorable: bool = False
+
+
+@dataclass
+class Policy:
+    """Decoded legacy Policy: the effective predicate mask, priority
+    weights (custom parameterized priorities pre-registered under their
+    policy names), and extenders."""
+
+    predicate_mask: int = ALL_PREDICATE_BITS
+    priority_weights: Dict[str, float] = field(default_factory=dict)
+    extenders: List[ExtenderConfig] = field(default_factory=list)
+    hard_pod_affinity_symmetric_weight: int = 1
+    always_check_all_predicates: bool = False
+
+
+_policy_prio_seq = 0
+
+
+def _register_unique(name: str, fn) -> str:
+    """Register a policy-parameterized priority kernel under a unique
+    internal name. Registrations go to a process-global registry (the
+    weights dicts must reference hashable names across the jit boundary),
+    so two policies configuring the SAME name with different parameters
+    must not collide — each load gets its own entry; the Policy's weights
+    dict carries the internal name."""
+    global _policy_prio_seq
+    from kubernetes_tpu.ops import priorities as prio
+
+    _policy_prio_seq += 1
+    internal = f"{name}#{_policy_prio_seq}"
+    prio.register_priority(internal, fn)
+    return internal
+
+
+def load_policy(
+    data, universe=None, gates: Optional[FeatureGates] = None
+) -> Policy:
+    """Decode a Policy JSON document (dict or JSON string) the way
+    CreateFromConfig (factory.go:356) interprets it:
+
+    - predicates **unspecified** -> default provider set; **empty list** ->
+      only mandatory predicates;
+    - priorities **unspecified** -> default priorities; **empty list** ->
+      none;
+    - parameterized priorities (LabelPreference,
+      RequestedToCapacityRatioArguments) register custom kernels under the
+      policy's name (``universe`` — a snapshot Universe — is required to
+      intern label keys for LabelPreference).
+    """
+    from kubernetes_tpu.ops import priorities as prio
+
+    if isinstance(data, str):
+        data = json.loads(data)
+    gates = gates or default_feature_gates
+    out = Policy()
+    out.hard_pod_affinity_symmetric_weight = int(
+        data.get("hardPodAffinitySymmetricWeight", 1)
+    )
+    out.always_check_all_predicates = bool(
+        data.get("alwaysCheckAllPredicates", False)
+    )
+
+    if "predicates" not in data:
+        out.predicate_mask = default_predicate_mask(gates)
+    else:
+        bits = MANDATORY_BITS
+        for p in data["predicates"]:
+            name = p["name"]
+            if name in PREDICATE_NAME_BITS:
+                bits |= PREDICATE_NAME_BITS[name]
+            # custom predicates (CheckNodeLabelPresence / CheckServiceAffinity)
+            # attach as framework plugins — see policy_framework_plugins()
+        out.predicate_mask = bits
+
+    if "priorities" not in data:
+        out.priority_weights = default_priority_weights(gates)
+    else:
+        weights: Dict[str, float] = {}
+        for p in data["priorities"]:
+            name, weight = p["name"], float(p.get("weight", 1))
+            arg = p.get("argument") or {}
+            if "labelPreference" in arg:
+                if universe is None:
+                    raise ValueError("LabelPreference needs the packer universe")
+                lp = arg["labelPreference"]
+                key_id = universe.label_keys.intern(lp["label"])
+                name = _register_unique(
+                    name, prio.make_node_label(key_id, bool(lp.get("presence", True)))
+                )
+            elif "requestedToCapacityRatioArguments" in arg:
+                pts = arg["requestedToCapacityRatioArguments"]["utilizationShape"]
+                shape = tuple(
+                    (int(q["utilization"]), int(q["score"])) for q in pts
+                )
+                name = _register_unique(
+                    name, prio.make_requested_to_capacity_ratio(shape)
+                )
+            elif name not in prio.PRIORITY_REGISTRY:
+                raise ValueError(f"unknown priority {name!r}")
+            weights[name] = weight
+        out.priority_weights = weights
+
+    for e in data.get("extenders", data.get("extenderConfigs", [])) or []:
+        out.extenders.append(
+            ExtenderConfig(
+                url_prefix=e.get("urlPrefix", ""),
+                filter_verb=e.get("filterVerb", ""),
+                preempt_verb=e.get("preemptVerb", ""),
+                prioritize_verb=e.get("prioritizeVerb", ""),
+                bind_verb=e.get("bindVerb", ""),
+                weight=int(e.get("weight", 1)),
+                enable_https=bool(e.get("enableHttps", False)),
+                http_timeout_s=float(e.get("httpTimeout", 30.0)),
+                node_cache_capable=bool(e.get("nodeCacheCapable", False)),
+                managed_resources=tuple(
+                    r.get("name", "") for r in e.get("managedResources", []) or []
+                ),
+                ignorable=bool(e.get("ignorable", False)),
+            )
+        )
+    return out

@@ -99,6 +99,7 @@ class DevicePods(NamedTuple):
     pd_mh: jnp.ndarray  # (P, Uvd) f32
     csi_mh: jnp.ndarray  # (P, Uvc) f32
     vol_error: jnp.ndarray  # (P,) bool
+    limits: jnp.ndarray  # (P, 2) f32 cpu/mem limits
 
     @property
     def n(self) -> int:
@@ -293,6 +294,7 @@ def pods_to_device(t: PodTable, pad_to: int | None = None) -> DevicePods:
         pd_mh=f32(t.pd_mh),
         csi_mh=f32(t.csi_mh),
         vol_error=jnp.asarray(_pad_rows(t.vol_error, p_pad, False)),
+        limits=f32(t.limits),
     )
 
 

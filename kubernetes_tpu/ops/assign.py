@@ -142,8 +142,8 @@ def queue_order(pods: DevicePods) -> jnp.ndarray:
 
 @partial(jax.jit, static_argnames=("weights_key",))
 def _greedy_impl(pods, nodes, sel, topo, vol, weights_key, extra_mask,
-                 static_vol=None):
-    weights = dict(weights_key) if weights_key else None
+                 static_vol=None, enabled_mask=None, extra_score=None):
+    weights = dict(weights_key) if weights_key is not None else None
     P = pods.req.shape[0]
     perm = queue_order(pods)
     u0 = usage_from_nodes(nodes)
@@ -159,8 +159,15 @@ def _greedy_impl(pods, nodes, sel, topo, vol, weights_key, extra_mask,
             if static_vol is not None
             else None
         )
-        mask = run_predicates(pod, cur, sel, topo, vol, sv).mask & extra  # (1, N)
+        mask = (
+            run_predicates(pod, cur, sel, topo, vol, sv, enabled_mask).mask
+            & extra
+        )  # (1, N)
         score = run_priorities(pod, cur, sel, mask, weights, topo)
+        if extra_score is not None:
+            score = score + jax.lax.dynamic_index_in_dim(
+                extra_score, p, axis=0, keepdims=True
+            )
         masked = jnp.where(mask, score, NEG)
         best = jnp.argmax(masked[0])
         ok = mask[0, best] & pod.valid[0]
@@ -181,18 +188,20 @@ def greedy_assign(
     extra_mask: Optional[jnp.ndarray] = None,
     vol=None,
     static_vol: Optional[jnp.ndarray] = None,
+    enabled_mask: Optional[int] = None,
+    extra_score: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, UsageState]:
     """Serial-parity solver. Returns (assigned node row per pod or -1,
     final usage). ``extra_mask`` (P, N) ANDs into feasibility — the driver
     feeds the nominated-pods pass-A mask through it (podFitsOnNode's
     two-pass rule, generic_scheduler.go:610)."""
-    key = tuple(sorted(weights.items())) if weights else None
+    key = tuple(sorted(weights.items())) if weights is not None else None
     if extra_mask is None:
         extra_mask = jnp.ones(
             (pods.req.shape[0], nodes.allocatable.shape[0]), bool
         )
     return _greedy_impl(pods, nodes, sel, topo, vol, key, extra_mask,
-                        static_vol)
+                        static_vol, enabled_mask, extra_score)
 
 
 def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray:
@@ -205,8 +214,9 @@ def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray
 
 @partial(jax.jit, static_argnames=("weights_key", "max_rounds", "per_node_cap"))
 def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
-                extra_mask, vol=None, static_vol=None):
-    weights = dict(weights_key) if weights_key else None
+                extra_mask, vol=None, static_vol=None, enabled_mask=None,
+                extra_score=None):
+    weights = dict(weights_key) if weights_key is not None else None
     P = pods.req.shape[0]
     perm = queue_order(pods)
     rank = jnp.zeros((P,), jnp.int32).at[perm].set(jnp.arange(P, dtype=jnp.int32))
@@ -240,11 +250,13 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
         cur = nodes_with_usage(nodes, u)
         active = (assigned == -1) & pods.valid
         mask = (
-            run_predicates(pods, cur, sel, topo, vol, static_vol).mask
+            run_predicates(pods, cur, sel, topo, vol, static_vol, enabled_mask).mask
             & active[:, None]
             & extra_mask
         )
         score = run_priorities(pods, cur, sel, mask, weights, topo)
+        if extra_score is not None:
+            score = score + extra_score
         masked = jnp.where(mask, score, NEG)
         choice = jnp.argmax(masked, axis=1).astype(jnp.int32)  # (P,)
         feasible = jnp.take_along_axis(mask, choice[:, None], axis=1)[:, 0]
@@ -262,6 +274,16 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
         free = (nodes.allocatable - u.requested)  # (N, R)
         free_s = free[jnp.clip(c_s, 0, free.shape[0] - 1)]
         fits = jnp.all(prefix + req_s <= free_s + 1e-6, axis=1)
+        if enabled_mask is not None:
+            # a Policy that bypasses PodFitsResources must also bypass the
+            # in-round capacity admission guard (it exists only to keep
+            # same-round co-admissions consistent with that predicate)
+            from kubernetes_tpu.ops.predicates import BIT as _BIT
+
+            res_enforced = (
+                jnp.int32(enabled_mask) & jnp.int32(1 << _BIT["PodFitsResources"])
+            ) > 0
+            fits = fits | ~res_enforced
         # admission cap: at most `per_node_cap` pods land on a node per
         # round. All pods in a round score against the SAME usage state, so
         # unbounded admission herds the whole queue onto the current-best
@@ -339,16 +361,18 @@ def batch_assign(
     extra_mask: Optional[jnp.ndarray] = None,
     vol=None,
     static_vol: Optional[jnp.ndarray] = None,
+    enabled_mask: Optional[int] = None,
+    extra_score: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, UsageState, jnp.ndarray]:
     """Fast batched solver. Returns (assigned row per pod or -1, final
     usage, rounds executed). ``per_node_cap`` bounds admissions per node per
     round (see _batch_impl); with P pending pods and N nodes expect about
     ceil(P / (N * cap)) rounds on uniform workloads. ``extra_mask`` as in
     :func:`greedy_assign`."""
-    key = tuple(sorted(weights.items())) if weights else None
+    key = tuple(sorted(weights.items())) if weights is not None else None
     if extra_mask is None:
         extra_mask = jnp.ones(
             (pods.req.shape[0], nodes.allocatable.shape[0]), bool
         )
     return _batch_impl(pods, nodes, sel, topo, key, max_rounds, per_node_cap,
-                       extra_mask, vol, static_vol)
+                       extra_mask, vol, static_vol, enabled_mask, extra_score)

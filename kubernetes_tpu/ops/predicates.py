@@ -144,6 +144,7 @@ def run_predicates(
     topo: DeviceTopology | None = None,
     vol=None,
     static_reasons: jnp.ndarray | None = None,
+    enabled_mask=None,
 ) -> FilterResult:
     """The fused Filter pass: all predicates, all (pod, node) pairs.
 
@@ -153,6 +154,10 @@ def run_predicates(
     inter-pod-affinity/spread passes and ``vol=None`` (a
     :class:`~kubernetes_tpu.ops.arrays.DeviceVolumes`) the five volume
     predicates — cheaper traces for workloads without such constraints.
+    ``enabled_mask`` (int bitmask over PREDICATE_BITS) selects the policy's
+    predicate set: disabled predicates' failure bits are cleared before the
+    feasibility mask forms (CreateFromConfig semantics, factory.go:356);
+    mandatory bits should already be included by the config layer.
     """
     P, N = pods.req.shape[0], nodes.allocatable.shape[0]
     reasons = jnp.zeros((P, N), jnp.int32)
@@ -239,6 +244,8 @@ def run_predicates(
     res_fail = ~resource_fit_mask(pods.req, nodes.allocatable, nodes.requested)
     reasons |= jnp.where(res_fail, jnp.int32(1 << BIT["PodFitsResources"]), 0)
 
+    if enabled_mask is not None:
+        reasons &= jnp.int32(enabled_mask)
     # padding: invalid nodes/pods are infeasible with no reasons surfaced
     mask = (reasons == 0) & nodes.valid[None, :] & pods.valid[:, None]
     return FilterResult(mask=mask, reasons=reasons)

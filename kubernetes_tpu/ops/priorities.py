@@ -218,6 +218,83 @@ def even_pods_spread(pods, nodes, sel, topo, mask) -> jnp.ndarray:
     return even_pods_spread_score(pods, nodes, topo, prog, mask)
 
 
+#: RequestedToCapacityRatio default shape: least-utilized preferred
+#: (requested_to_capacity_ratio.go:41 defaultFunctionShape).
+DEFAULT_FUNCTION_SHAPE = ((0, 10), (100, 0))
+
+
+def _broken_linear(p: jnp.ndarray, shape) -> jnp.ndarray:
+    """buildBrokenLinearFunction (requested_to_capacity_ratio.go:110):
+    piecewise-linear through integer (utilization, score) points with Go
+    int64 division (truncation toward zero — jnp.fix). ``shape`` is a
+    static tuple so each segment unrolls into the trace."""
+    xs = [float(x) for x, _ in shape]
+    ys = [float(y) for _, y in shape]
+    out = jnp.full_like(p, ys[-1])
+    for i in reversed(range(len(xs))):
+        if i == 0:
+            seg = jnp.full_like(p, ys[0])
+        else:
+            seg = ys[i - 1] + jnp.trunc(
+                (ys[i] - ys[i - 1]) * (p - xs[i - 1]) / (xs[i] - xs[i - 1])
+            )
+        out = jnp.where(p <= xs[i], seg, out)
+    return out
+
+
+def make_requested_to_capacity_ratio(shape=DEFAULT_FUNCTION_SHAPE) -> "PriorityFn":
+    """RequestedToCapacityRatioResourceAllocationPriority
+    (requested_to_capacity_ratio.go:87): per-resource utilization percent
+    through the shape function, cpu/mem averaged with integer division.
+
+    Utilization uses the scaffold's requested = pod nonzero request + node
+    nonzero usage (resource_allocation.go:49-58). The percent floor adds a
+    1e-4 epsilon before flooring: Go computes (cap-req)*100/cap in exact
+    int64 while we ride f32 — the epsilon absorbs representation error so
+    exact-integer percentages (the common round-number case) floor the Go
+    way; adversarial near-boundary byte counts may differ by 1 score step.
+    """
+
+    def one(req, cap):
+        bad = (cap <= 0) | (req > cap)
+        pct = 100.0 - jnp.floor(
+            (cap - req) * 100.0 / jnp.maximum(cap, 1.0) + 1e-4
+        )
+        return _broken_linear(jnp.where(bad, 100.0, pct), shape)
+
+    def kernel(pods, nodes, sel, topo, mask) -> jnp.ndarray:
+        cpu_req, mem_req, cpu_cap, mem_cap = _requested_fractions(pods, nodes)
+        cpu = one(cpu_req, jnp.broadcast_to(cpu_cap, cpu_req.shape))
+        mem = one(mem_req, jnp.broadcast_to(mem_cap, mem_req.shape))
+        return jnp.trunc((cpu + mem) / 2.0)
+
+    return kernel
+
+
+def make_node_label(key_id: int, presence: bool) -> "PriorityFn":
+    """NodeLabelPriority (node_label.go:47): MaxPriority when the node's
+    having label ``key_id`` agrees with ``presence``, else 0. ``key_id``
+    indexes the label-key universe (intern the label before packing)."""
+
+    def kernel(pods, nodes, sel, topo, mask) -> jnp.ndarray:
+        has = nodes.key_mh[:, key_id] > 0  # (N,)
+        hit = has if presence else ~has
+        row = jnp.where(hit, float(MAX_PRIORITY), 0.0)
+        return jnp.broadcast_to(row[None, :], (pods.req.shape[0], nodes.n))
+
+    return kernel
+
+
+def resource_limits(pods, nodes, sel, topo, mask) -> jnp.ndarray:
+    """ResourceLimitsPriority (resource_limits.go:44): score 1 when the
+    node's allocatable satisfies the pod's cpu OR memory limit (a declared,
+    non-zero limit that fits), else 0."""
+    cap = nodes.allocatable  # (N, R); cols 0/1 = cpu_milli/memory (RES_CPU/RES_MEM)
+    cpu_ok = (pods.limits[:, 0:1] > 0) & (pods.limits[:, 0:1] <= cap[:, 0][None, :])
+    mem_ok = (pods.limits[:, 1:2] > 0) & (pods.limits[:, 1:2] <= cap[:, 1][None, :])
+    return (cpu_ok | mem_ok).astype(jnp.float32)
+
+
 PriorityFn = Callable[..., jnp.ndarray]  # (pods, nodes, sel, topo, mask) -> (P, N)
 
 #: Registry name -> kernel; names mirror factory registrations
@@ -234,7 +311,17 @@ PRIORITY_REGISTRY: Dict[str, PriorityFn] = {
     "EqualPriority": equal_priority,
     "InterPodAffinityPriority": inter_pod_affinity,
     "EvenPodsSpreadPriority": even_pods_spread,
+    "RequestedToCapacityRatioPriority": make_requested_to_capacity_ratio(),
+    "ResourceLimitsPriority": resource_limits,
 }
+
+
+def register_priority(name: str, fn: PriorityFn) -> None:
+    """Add a custom-configured priority (the factory/plugins.go
+    RegisterPriorityMapReduceFunction analog) — e.g. a NodeLabelPriority
+    bound to a specific label, or a RequestedToCapacityRatio with a custom
+    shape. Weights dicts may then reference ``name``."""
+    PRIORITY_REGISTRY[name] = fn
 
 #: Default provider weights (defaults.go:119 defaultPriorities).
 #: EvenPodsSpreadPriority joins via the EvenPodsSpread feature gate

@@ -119,11 +119,32 @@ class NominatedPodMap:
         return len(self._node_of)
 
 
+class _CmpKey:
+    """heapq adapter for a custom less(podA, podB) comparator; the seq
+    breaks ties stably."""
+
+    __slots__ = ("less", "pod", "seq")
+
+    def __init__(self, less, pod: Pod, seq: int) -> None:
+        self.less, self.pod, self.seq = less, pod, seq
+
+    def __lt__(self, other: "_CmpKey") -> bool:
+        if self.less(self.pod, other.pod):
+            return True
+        if self.less(other.pod, self.pod):
+            return False
+        return self.seq < other.seq
+
+
 class SchedulingQueue:
     """The 3-queue priority structure. All times come from the injected
     ``clock`` so tests are deterministic."""
 
-    def __init__(self, clock: Callable[[], float] = time.monotonic) -> None:
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        less: Optional[Callable[[Pod, Pod], bool]] = None,
+    ) -> None:
         self.clock = clock
         self._seq = itertools.count()
         self._active: List[_ActiveEntry] = []  # heap
@@ -135,11 +156,17 @@ class SchedulingQueue:
         self.nominated = NominatedPodMap()
         self.scheduling_cycle = 0
         self.move_request_cycle = -1
+        #: custom QueueSort comparator (framework queue-sort plugin,
+        #: interface.go:131); None = priority desc then arrival asc.
+        self._less = less
 
     # -- internal ----------------------------------------------------------
 
     def _push_active(self, pod: Pod) -> None:
-        key = (-pod.priority, pod.queued_at, next(self._seq))
+        if self._less is None:
+            key = (-pod.priority, pod.queued_at, next(self._seq))
+        else:
+            key = _CmpKey(self._less, pod, next(self._seq))
         heapq.heappush(self._active, _ActiveEntry(key, pod))
         self._in_active[pod.key()] = pod
 

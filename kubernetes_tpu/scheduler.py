@@ -52,10 +52,16 @@ from kubernetes_tpu.utils.interner import bucket_size
 
 
 @jax.jit
-def _filter_pass(dp, dn, ds, dt, dv=None, sv=None):
+def _filter_pass(dp, dn, ds, dt, dv=None, sv=None, em=None):
     """One standalone filter evaluation (reasons + mask) — used for the
     nominated-pods pass-A mask and for failure-reason reporting."""
-    return run_predicates(dp, dn, ds, dt, dv, sv)
+    return run_predicates(dp, dn, ds, dt, dv, sv, em)
+
+
+def _new_cycle_state():
+    from kubernetes_tpu.framework import CycleState
+
+    return CycleState()
 
 
 @jax.jit
@@ -98,6 +104,7 @@ class CycleResult:
     failure_reasons: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
     preempted: int = 0  # victims deleted this cycle
     nominations: Dict[str, str] = field(default_factory=dict)  # pod -> node
+    waiting: int = 0  # pods parked by Permit plugins this cycle
     elapsed_s: float = 0.0
 
 
@@ -120,9 +127,21 @@ class Scheduler:
         max_preemptions_per_cycle: int = 16,
         pdb_lister: Optional[Callable[[], List]] = None,
         victim_deleter: Optional[Callable[[Pod], None]] = None,
+        framework=None,
+        pred_mask: Optional[int] = None,
     ) -> None:
+        from kubernetes_tpu.framework import Framework
+
+        self.framework = framework or Framework(clock=clock)
+        #: enabled-predicate bitmask (config.Policy.predicate_mask);
+        #: None = every implemented predicate enforced
+        self.pred_mask = pred_mask
+        #: per-pod CycleState, alive from prefilter to bind/fail
+        self._cycle_states: Dict[str, object] = {}
         self.cache = cache or SchedulerCache(clock=clock)
-        self.queue = queue or SchedulingQueue(clock=clock)
+        self.queue = queue or SchedulingQueue(
+            clock=clock, less=self.framework.queue_sort_less()
+        )
         self.binder = binder or RecordingBinder()
         self.weights = weights
         self.solver = solver
@@ -144,6 +163,27 @@ class Scheduler:
         #: A hub integration instead posts the delete and lets the watch
         #: remove it, keeping the victim visible as terminating meanwhile.
         self.victim_deleter = victim_deleter
+
+    @classmethod
+    def from_config(cls, cfg, **kw) -> "Scheduler":
+        """Build a Scheduler from a KubeSchedulerConfiguration — the
+        CreateFromProvider / CreateFromConfig seam (factory.go:346,:356)."""
+        from kubernetes_tpu.config import (
+            default_predicate_mask,
+            default_priority_weights,
+        )
+
+        if cfg.policy is not None:
+            kw.setdefault("pred_mask", cfg.policy.predicate_mask)
+            kw.setdefault("weights", dict(cfg.policy.priority_weights))
+        else:
+            kw.setdefault("pred_mask", default_predicate_mask(cfg.feature_gates))
+            kw.setdefault("weights", default_priority_weights(cfg.feature_gates))
+        kw.setdefault("solver", cfg.solver)
+        kw.setdefault("per_node_cap", cfg.per_node_cap)
+        kw.setdefault("max_rounds", cfg.max_rounds)
+        kw.setdefault("max_batch", cfg.max_batch)
+        return cls(**kw)
 
     # -- ingestion (AddAllEventHandlers analog; the informer pump or test
     # drives these) --------------------------------------------------------
@@ -170,12 +210,24 @@ class Scheduler:
             self.queue.update(old.key(), new)
 
     def on_pod_delete(self, pod: Pod) -> None:
+        key = pod.key()
+        # a Permit-parked pod is assumed in the cache and holds capacity —
+        # deletion must release both the wait entry and the assumption
+        wp = self.framework.waiting.get(key)
+        if wp is not None:
+            self.framework.waiting.remove(key)
+            self.cache.forget_pod(key)
+            self.framework.run_unreserve(
+                self._cycle_states.get(key) or _new_cycle_state(), wp.pod,
+                wp.node_name,
+            )
         if pod.node_name:
-            self.cache.remove_pod(pod.key())
+            self.cache.remove_pod(key)
             self.queue.move_all_to_active()
         else:
-            self.queue.delete(pod.key())
-        self.cache.packer.forget_pod(pod.key())
+            self.queue.delete(key)
+        self.cache.packer.forget_pod(key)
+        self._cycle_states.pop(key, None)
 
     def on_node_add(self, node) -> None:
         self.cache.add_node(node)
@@ -212,15 +264,36 @@ class Scheduler:
         )
         from kubernetes_tpu.ops.predicates import decode_reasons
 
+        from kubernetes_tpu.framework import CycleState
+
         t0 = self.clock()
         res = CycleResult()
         self.queue.tick()
         self.cache.cleanup_expired()
+        self._process_waiting(res)
         batch = self.queue.pop_batch(self.max_batch)
         if not batch:
+            res.elapsed_s = self.clock() - t0
             return res
         cycle = self.queue.scheduling_cycle
         res.attempted = len(batch)
+        fw = self.framework
+
+        # PreFilter (framework.go RunPrefilterPlugins): any non-success
+        # aborts that pod's cycle before it reaches the device
+        kept = []
+        for p in batch:
+            st = CycleState()
+            self._cycle_states[p.key()] = st
+            status = fw.run_prefilter(st, p)
+            if status.is_success():
+                kept.append(p)
+            else:
+                self._fail(p, cycle, res, (f"PreFilter:{status.message}",))
+        batch = kept
+        if not batch:
+            res.elapsed_s = self.clock() - t0
+            return res
 
         # pack: pods first (their programs grow universes), then snapshot
         pk = self.cache.packer
@@ -244,13 +317,50 @@ class Scheduler:
             dv = volumes_to_device(pk.pack_volume_tables(batch))
             sv = _static_vol_pass(dp, dn, ds, dv)
 
+        # framework Filter/Score contributions: device batch plugins give
+        # whole (P, N) matrices; host plugins evaluate per (pod, nodeName)
+        # once per cycle (the non-tensorizable escape hatch)
+        extra_score = None
+        batch_state = CycleState()
+        fw_mask = fw.run_filter_batch(batch_state, dp, dn, ds)
+        fw_score = fw.run_score_batch(batch_state, dp, dn, ds)
+        if fw_score is not None:
+            extra_score = fw_score
+        early_fail: Dict[int, str] = {}
+        if fw.has_host_filters() or fw.has_host_scores():
+            hm = np.ones((dp.valid.shape[0], dn.valid.shape[0]), bool)
+            hs = np.zeros((dp.valid.shape[0], dn.valid.shape[0]), np.float32)
+            for i, p in enumerate(batch):
+                st = self._cycle_states[p.key()]
+                try:
+                    for j, name in enumerate(node_order):
+                        if fw.has_host_filters():
+                            hm[i, j] = fw.run_host_filter(st, p, name).is_success()
+                        if fw.has_host_scores() and hm[i, j]:
+                            hs[i, j] = fw.run_host_score(st, p, name)
+                except RuntimeError as e:
+                    # a Score plugin error aborts only THIS pod's cycle
+                    # (the reference returns an error from PrioritizeNodes
+                    # for that pod; other pods proceed)
+                    hm[i, :] = False
+                    early_fail[i] = f"ScorePlugin:{e}"
+            if fw.has_host_filters():
+                m = jnp.asarray(hm)
+                fw_mask = m if fw_mask is None else (fw_mask & m)
+            if fw.has_host_scores():
+                extra_score = (
+                    jnp.asarray(hs)
+                    if extra_score is None
+                    else extra_score + jnp.asarray(hs)
+                )
+
         # nominated-pods pass A (podFitsOnNode two-pass rule,
         # generic_scheduler.go:610): feasibility must ALSO hold with the
         # nominated pods counted onto their nodes. Divergence from the
         # reference, documented: ALL nominated pods are added, not only
         # those of higher/equal priority — strictly more conservative (a
         # pod may wait one extra cycle; capacity is never double-promised).
-        extra_mask = None
+        extra_mask = fw_mask
         if nominated:
             row_of = {name: i for i, name in enumerate(node_order)}
             nom_pods = [p for p, _ in nominated]
@@ -264,14 +374,16 @@ class Scheduler:
                 usage_from_nodes(dn), dpn, jnp.asarray(nom_rows),
                 jnp.asarray(nom_ok) & dpn.valid,
             )
-            extra_mask = _filter_pass(
-                dp, nodes_with_usage(dn, u_nom), ds, dt, dv, sv
+            nom_mask = _filter_pass(
+                dp, nodes_with_usage(dn, u_nom), ds, dt, dv, sv, self.pred_mask
             ).mask
+            extra_mask = nom_mask if extra_mask is None else (extra_mask & nom_mask)
 
         if self.solver == "greedy":
             assigned, usage = greedy_assign(
                 dp, dn, ds, self.weights, topo=dt, extra_mask=extra_mask,
-                vol=dv, static_vol=sv,
+                vol=dv, static_vol=sv, enabled_mask=self.pred_mask,
+                extra_score=extra_score,
             )
             rounds = len(batch)
         else:
@@ -283,6 +395,8 @@ class Scheduler:
                 extra_mask=extra_mask,
                 vol=dv,
                 static_vol=sv,
+                enabled_mask=self.pred_mask,
+                extra_score=extra_score,
             )
         assigned = np.asarray(assigned)[: len(batch)]
         res.rounds = int(rounds) if self.solver != "greedy" else rounds
@@ -293,7 +407,9 @@ class Scheduler:
         reasons_row: Dict[int, Tuple[str, ...]] = {}
         rmat = None
         if failed_idx:
-            fr = _filter_pass(dp, nodes_with_usage(dn, usage), ds, dt, dv, sv)
+            fr = _filter_pass(
+                dp, nodes_with_usage(dn, usage), ds, dt, dv, sv, self.pred_mask
+            )
             rmat = np.asarray(fr.reasons)
             nvalid = np.asarray(dn.valid)
             for i in failed_idx:
@@ -301,30 +417,43 @@ class Scheduler:
                 bits = int(np.bitwise_or.reduce(rmat[i][nvalid])) if nvalid.any() else 0
                 reasons_row[i] = decode_reasons(bits)
 
+        from kubernetes_tpu.framework import WAIT as _WAIT
+
         for i, pod in enumerate(batch):
             target = int(assigned[i])
-            if target >= 0:
-                node_name = node_order[target]
-                try:
-                    self.cache.assume_pod(pod, node_name)
-                except Exception:
-                    # already in cache (e.g. duplicate queue entry) — requeue
-                    self._fail(pod, cycle, res, ("AssumeError",))
-                    continue
-                try:
-                    self.binder.bind(pod, node_name)
-                except Exception as e:  # bind RPC failed -> Forget + retry
-                    self.cache.forget_pod(pod.key())
-                    res.bind_errors += 1
-                    self._fail(pod, cycle, res, (f"BindError:{e}",))
-                    continue
-                self.cache.finish_binding(pod.key())
-                self.queue.nominated.delete(pod)
-                res.scheduled += 1
-                res.assignments[pod.key()] = node_name
-                self.event_sink("Scheduled", pod, node_name)
-            else:
-                self._fail(pod, cycle, res, reasons_row.get(i, ()))
+            if target < 0:
+                reasons = (
+                    (early_fail[i],) if i in early_fail else reasons_row.get(i, ())
+                )
+                self._fail(pod, cycle, res, reasons)
+                continue
+            node_name = node_order[target]
+            st = self._cycle_states.get(pod.key()) or CycleState()
+            # Reserve (scheduler.go:531 RunReservePlugins, before assume)
+            rs = fw.run_reserve(st, pod, node_name)
+            if not rs.is_success():
+                fw.run_unreserve(st, pod, node_name)
+                self._fail(pod, cycle, res, (f"Reserve:{rs.message}",))
+                continue
+            try:
+                self.cache.assume_pod(pod, node_name)
+            except Exception:
+                # already in cache (e.g. duplicate queue entry) — requeue
+                fw.run_unreserve(st, pod, node_name)
+                self._fail(pod, cycle, res, ("AssumeError",))
+                continue
+            # Permit (scheduler.go:561): Wait parks the pod (still assumed,
+            # capacity held) until allow/reject/timeout
+            ps = fw.run_permit(st, pod, node_name)
+            if ps.code == _WAIT:
+                res.waiting += 1
+                continue
+            if not ps.is_success():
+                self.cache.forget_pod(pod.key())
+                fw.run_unreserve(st, pod, node_name)
+                self._fail(pod, cycle, res, (f"Permit:{ps.message}",))
+                continue
+            self._bind_pod(pod, node_name, st, res)
 
         # preemption (scheduler.go:493 -> preempt, §3.3): failed pods try to
         # evict lower-priority pods; winners get a nominated node and retry
@@ -332,6 +461,70 @@ class Scheduler:
             self._run_preemption(batch, failed_idx, rmat, node_order, res)
         res.elapsed_s = self.clock() - t0
         return res
+
+    def _bind_pod(self, pod: Pod, node_name: str, st, res: CycleResult) -> bool:
+        """PreBind -> Bind (plugins, else default binder) -> PostBind —
+        the tail of the reference's async binding goroutine
+        (scheduler.go:580,:598,:442-457). Any failure forgets the
+        assumption and requeues."""
+        from kubernetes_tpu.framework import SKIP as _SKIP
+
+        fw = self.framework
+        cycle = self.queue.scheduling_cycle
+
+        def reject(reason: str) -> bool:
+            self.cache.forget_pod(pod.key())
+            res.bind_errors += 1
+            fw.run_unreserve(st, pod, node_name)
+            self._fail(pod, cycle, res, (reason,))
+            self._cycle_states.pop(pod.key(), None)
+            return False
+
+        s = fw.run_prebind(st, pod, node_name)
+        if not s.is_success():
+            return reject(f"PreBind:{s.message}")
+        bs = fw.run_bind(st, pod, node_name)
+        if bs.code == _SKIP:
+            try:
+                self.binder.bind(pod, node_name)
+            except Exception as e:  # bind RPC failed -> Forget + retry
+                return reject(f"BindError:{e}")
+        elif not bs.is_success():
+            return reject(f"Bind:{bs.message}")
+        self.cache.finish_binding(pod.key())
+        self.queue.nominated.delete(pod)
+        res.scheduled += 1
+        res.assignments[pod.key()] = node_name
+        fw.run_postbind(st, pod, node_name)
+        self._cycle_states.pop(pod.key(), None)
+        self.event_sink("Scheduled", pod, node_name)
+        return True
+
+    def _process_waiting(self, res: CycleResult) -> None:
+        """Resolve Permit waits (waiting_pods_map.go consumers): allowed
+        pods proceed to binding; rejected or timed-out pods are forgotten
+        and requeued — the reference rejects on timeout
+        (framework.go RunPermitPlugins wait loop)."""
+        from kubernetes_tpu.framework import CycleState
+
+        fw = self.framework
+        now = self.clock()
+        for wp in fw.waiting.items():
+            key = wp.pod.key()
+            st = self._cycle_states.get(key) or CycleState()
+            if wp.rejected is not None or (not wp.allowed and now >= wp.deadline):
+                fw.waiting.remove(key)
+                self.cache.forget_pod(key)
+                fw.run_unreserve(st, wp.pod, wp.node_name)
+                reason = wp.rejected or "permit timeout"
+                self._fail(
+                    wp.pod, self.queue.scheduling_cycle, res,
+                    (f"Permit:{reason}",),
+                )
+                self._cycle_states.pop(key, None)
+            elif wp.allowed:
+                fw.waiting.remove(key)
+                self._bind_pod(wp.pod, wp.node_name, st, res)
 
     def _nominated_pods(self, exclude) -> List[Tuple[Pod, str]]:
         """(pod, node) for every nominated pod not in the current batch and
@@ -406,6 +599,7 @@ class Scheduler:
     def _fail(self, pod: Pod, cycle: int, res: CycleResult, reasons) -> None:
         res.unschedulable += 1
         res.failure_reasons[pod.key()] = tuple(reasons)
+        self._cycle_states.pop(pod.key(), None)  # cycle over for this pod
         self.queue.record_failure(pod)
         self.queue.add_unschedulable_if_not_present(pod, cycle)
         self.event_sink("FailedScheduling", pod, ",".join(reasons))

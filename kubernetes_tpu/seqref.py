@@ -771,3 +771,63 @@ def volumes_feasible(
         and max_pd_volume_count(pod, node, node_pods, state)
         and csi_max_volume_count(pod, node, node_pods, state)
     )
+
+
+# -- RequestedToCapacityRatio / NodeLabel / ResourceLimits priorities --------
+# (requested_to_capacity_ratio.go, node_label.go, resource_limits.go)
+
+
+def _go_div(a: int, b: int) -> int:
+    """Go int64 division: truncation toward zero."""
+    q = abs(a) // abs(b)
+    return -q if (a < 0) != (b < 0) else q
+
+
+def broken_linear(shape) -> "callable":
+    """buildBrokenLinearFunction (requested_to_capacity_ratio.go:110)."""
+    def f(p: int) -> int:
+        n = len(shape)
+        for i in range(n):
+            if p <= shape[i][0]:
+                if i == 0:
+                    return shape[0][1]
+                x0, y0 = shape[i - 1]
+                x1, y1 = shape[i]
+                return y0 + _go_div((y1 - y0) * (p - x0), (x1 - x0))
+        return shape[n - 1][1]
+
+    return f
+
+
+def requested_to_capacity_score(
+    pod: Pod, node: Node, node_pods: Sequence[Pod],
+    shape=((0, 10), (100, 0)),
+) -> int:
+    """RequestedToCapacityRatioResourceAllocationPriority scorer
+    (requested_to_capacity_ratio.go:87-103) on exact integer math."""
+    raw = broken_linear(shape)
+
+    def one(req: int, cap: int) -> int:
+        if cap == 0 or req > cap:
+            return raw(100)
+        return raw(100 - _go_div((cap - req) * 100, cap))
+
+    used_cpu, used_mem = _nonzero_used(node_pods)
+    p_cpu, p_mem = pod.nonzero_requests()
+    cpu = one(int(used_cpu + p_cpu), int(node.allocatable.cpu_milli))
+    mem = one(int(used_mem + p_mem), int(node.allocatable.memory))
+    return _go_div(cpu + mem, 2)
+
+
+def node_label_score(node: Node, label: str, presence: bool) -> int:
+    """NodeLabelPriority (node_label.go:47)."""
+    exists = label in node.labels
+    return MAX_PRIORITY if exists == presence else 0
+
+
+def resource_limits_score(pod: Pod, node: Node) -> int:
+    """ResourceLimitsPriority (resource_limits.go:44): 1 when a declared
+    cpu OR memory limit fits within allocatable."""
+    cpu_ok = 0 < pod.limits.cpu_milli <= node.allocatable.cpu_milli
+    mem_ok = 0 < pod.limits.memory <= node.allocatable.memory
+    return 1 if (cpu_ok or mem_ok) else 0

@@ -588,6 +588,8 @@ class PodTable:
     pd_mh: np.ndarray  # (P, Uvd) i8
     csi_mh: np.ndarray  # (P, Uvc) i8
     vol_error: np.ndarray  # (P,) bool — unresolvable volume state
+    #: (P, 2) f32 cpu/mem LIMITS (ResourceLimitsPriority)
+    limits: np.ndarray = None
 
 
 @dataclass
@@ -1079,6 +1081,7 @@ class SnapshotPacker:
         pd_mh = np.zeros((n, w["Uvd"]), np.int8)
         csi_mh = np.zeros((n, w["Uvc"]), np.int8)
         vol_error = np.zeros((n,), bool)
+        limits = np.zeros((n, 2), np.float32)
 
         for i, p in enumerate(pods):
             refs = self.intern_pod(p)
@@ -1093,6 +1096,8 @@ class SnapshotPacker:
             has_aff[i] = _pod_has_affinity(p)
             req[i] = self.u.resource_vector(p.effective_requests(), R)
             nonzero[i] = p.nonzero_requests()
+            limits[i, 0] = p.limits.cpu_milli
+            limits[i, 1] = p.limits.memory
             if p.node_name:
                 nid = u.node_names.lookup(p.node_name)
                 # -2 = pinned to a node that does not exist: PodFitsHost
@@ -1162,6 +1167,7 @@ class SnapshotPacker:
             pd_mh=pd_mh,
             csi_mh=csi_mh,
             vol_error=vol_error,
+            limits=limits,
         )
 
     # -- volume tables -----------------------------------------------------
@@ -1194,11 +1200,8 @@ class SnapshotPacker:
                 continue
             rv = self.resolve_volumes(p)
             for key, allowed in rv.zone_rows:
-                pair_ids = [
-                    u.label_pairs.lookup((key, z))
-                    for z in allowed
-                    if u.label_pairs.lookup((key, z)) >= 0
-                ]
+                ids = (u.label_pairs.lookup((key, z)) for z in allowed)
+                pair_ids = [pid for pid in ids if pid >= 0]
                 vz_pod.append(i)
                 vz_rows.append(pair_ids)
             for terms in rv.bound_affinity:

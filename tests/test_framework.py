@@ -1,0 +1,372 @@
+"""Framework extension points + ComponentConfig/Policy tests — the analog
+of the reference's framework_test.go and scheduler integration
+framework_test.go plugin hooks (PreFilter/Filter/Score/Reserve/Permit/
+PreBind/Bind/PostBind/Unreserve), plus Policy decode semantics."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from kubernetes_tpu import config as cfg
+from kubernetes_tpu.framework import (
+    ERROR,
+    SKIP,
+    SUCCESS,
+    UNSCHEDULABLE,
+    WAIT,
+    CycleState,
+    Framework,
+    Plugin,
+    Status,
+)
+from kubernetes_tpu.ops.predicates import BIT
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def sched_with(plugins, **kw):
+    clk = FakeClock()
+    s = Scheduler(
+        framework=Framework(plugins=plugins, clock=clk),
+        clock=clk,
+        enable_preemption=False,
+        **kw,
+    )
+    return s, clk
+
+
+# ---------------------------------------------------------------------------
+# extension points through the driver
+# ---------------------------------------------------------------------------
+
+
+def test_prefilter_rejects_pod():
+    class RejectBig(Plugin):
+        def pre_filter(self, state, pod):
+            if pod.requests.cpu_milli > 1000:
+                return Status(UNSCHEDULABLE, "too big")
+            return None
+
+    s, _ = sched_with([RejectBig()])
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("small", cpu_milli=100))
+    s.on_pod_add(make_pod("big", cpu_milli=4000))
+    res = s.schedule_cycle()
+    assert res.scheduled == 1
+    assert "PreFilter:prefilter plugin RejectBig: too big" in res.failure_reasons[
+        "default/big"
+    ]
+
+
+def test_batch_filter_and_score_plugins():
+    class OnlyNode1(Plugin):
+        """Device-side batch filter: mask everything but node row 1."""
+
+        def filter_batch(self, state, dp, dn, ds):
+            m = jnp.zeros((dp.valid.shape[0], dn.valid.shape[0]), bool)
+            return m.at[:, 1].set(True)
+
+    s, _ = sched_with([OnlyNode1()])
+    for i in range(3):
+        s.on_node_add(make_node(f"n{i}"))
+    s.on_pod_add(make_pod("p0"))
+    res = s.schedule_cycle()
+    assert res.assignments["default/p0"] == s.cache.node_order()[1]
+
+
+def test_host_filter_and_score_plugins():
+    class AvoidN0(Plugin):
+        def filter(self, state, pod, node_name):
+            return Status(UNSCHEDULABLE, "no") if node_name == "n0" else None
+
+    class PreferN2(Plugin):
+        def score(self, state, pod, node_name):
+            return (100 if node_name == "n2" else 0), None
+
+        def score_weight(self):
+            return 2.0
+
+    s, _ = sched_with([AvoidN0(), PreferN2()])
+    for i in range(3):
+        s.on_node_add(make_node(f"n{i}"))
+    s.on_pod_add(make_pod("p0"))
+    res = s.schedule_cycle()
+    assert res.assignments["default/p0"] == "n2"
+
+
+def test_reserve_failure_requeues():
+    class FailReserve(Plugin):
+        def reserve(self, state, pod, node_name):
+            return Status(ERROR, "nope")
+
+        def unreserve(self, state, pod, node_name):
+            self.unreserved = pod.key()
+
+    p = FailReserve()
+    s, _ = sched_with([p])
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("p0"))
+    res = s.schedule_cycle()
+    assert res.scheduled == 0 and res.unschedulable == 1
+    assert p.unreserved == "default/p0"
+    assert not s.cache.is_assumed("default/p0")
+
+
+def test_permit_wait_allow_and_timeout():
+    class Gate(Plugin):
+        def permit(self, state, pod, node_name):
+            return Status(WAIT, ""), 10.0
+
+    gate = Gate()
+    s, clk = sched_with([gate])
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("p-allow"))
+    s.on_pod_add(make_pod("p-late"))
+    res = s.schedule_cycle()
+    assert res.waiting == 2 and res.scheduled == 0
+    # capacity is held while waiting
+    assert s.cache.is_assumed("default/p-allow")
+
+    s.framework.waiting.get("default/p-allow").allow()
+    res2 = s.schedule_cycle()
+    assert dict(s.binder.bindings)["default/p-allow"] == "n0"
+
+    clk.t += 30.0  # p-late times out -> forgotten + requeued
+    res3 = s.schedule_cycle()
+    assert any("Permit:" in r for r in res3.failure_reasons.get("default/p-late", ()))
+    assert not s.cache.is_assumed("default/p-late")
+
+
+def test_permit_reject():
+    class Gate(Plugin):
+        def permit(self, state, pod, node_name):
+            return Status(WAIT, ""), 100.0
+
+    s, _ = sched_with([Gate()])
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("p0"))
+    s.schedule_cycle()
+    s.framework.waiting.get("default/p0").reject("denied")
+    res = s.schedule_cycle()
+    assert "Permit:denied" in res.failure_reasons["default/p0"]
+    assert not s.cache.is_assumed("default/p0")
+
+
+def test_prebind_failure_frees_capacity():
+    class FailPreBind(Plugin):
+        def __init__(self):
+            self.calls = 0
+
+        def pre_bind(self, state, pod, node_name):
+            self.calls += 1
+            return Status(ERROR, "boom") if self.calls == 1 else None
+
+    s, clk = sched_with([FailPreBind()])
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    s.on_pod_add(make_pod("p0", cpu_milli=800))
+    res = s.schedule_cycle()
+    assert res.scheduled == 0 and res.bind_errors == 1
+    assert not s.cache.is_assumed("default/p0")
+    # capacity was freed: the pod schedules on retry
+    clk.t += 30.0
+    s.queue.move_all_to_active()
+    res2 = s.schedule_cycle()
+    assert res2.scheduled == 1
+
+
+def test_bind_plugin_handles_and_postbind_runs():
+    bound = []
+
+    class CustomBinder(Plugin):
+        def bind(self, state, pod, node_name):
+            if pod.name.startswith("mine-"):
+                bound.append((pod.key(), node_name))
+                return Status(SUCCESS)
+            return Status(SKIP, "")
+
+        def post_bind(self, state, pod, node_name):
+            bound.append(("post", pod.key()))
+
+    s, _ = sched_with([CustomBinder()])
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("mine-a"))
+    s.on_pod_add(make_pod("other-b"))
+    res = s.schedule_cycle()
+    assert res.scheduled == 2
+    assert ("default/mine-a", "n0") in bound
+    assert ("post", "default/mine-a") in bound and ("post", "default/other-b") in bound
+    # the default binder only saw the skipped pod
+    assert dict(s.binder.bindings) == {"default/other-b": "n0"}
+
+
+def test_queue_sort_plugin_orders_pops():
+    class ByName(Plugin):
+        def less(self, a, b):
+            return a.name < b.name
+
+    s, _ = sched_with([ByName()])
+    s.on_node_add(make_node("n0"))
+    for name in ["zeta", "alpha", "mid"]:
+        s.on_pod_add(make_pod(name, priority=len(name)))  # priority ignored
+    batch = s.queue.pop_batch(1)
+    assert batch[0].name == "alpha"
+
+
+# ---------------------------------------------------------------------------
+# config: feature gates, policy decode, from_config
+# ---------------------------------------------------------------------------
+
+
+def test_feature_gates_parse_and_defaults():
+    g = cfg.FeatureGates()
+    assert g.enabled("AttachVolumeLimit") and not g.enabled("EvenPodsSpread")
+    g.set_from_string("EvenPodsSpread=true,AttachVolumeLimit=false")
+    assert g.enabled("EvenPodsSpread") and not g.enabled("AttachVolumeLimit")
+    try:
+        g.set_from_string("NoSuchGate=true")
+        assert False
+    except ValueError:
+        pass
+
+
+def test_default_masks_and_gated_additions():
+    base = cfg.default_predicate_mask()
+    assert not (base & (1 << BIT["EvenPodsSpread"]))
+    g = cfg.FeatureGates({"EvenPodsSpread": True, "ResourceLimitsPriorityFunction": True})
+    gated = cfg.default_predicate_mask(g)
+    assert gated & (1 << BIT["EvenPodsSpread"])
+    w = cfg.default_priority_weights(g)
+    assert w["EvenPodsSpreadPriority"] == 1 and w["ResourceLimitsPriority"] == 1
+
+
+def test_load_policy_predicates_and_priorities():
+    from kubernetes_tpu.snapshot import Universe
+
+    u = Universe()
+    pol = cfg.load_policy(
+        {
+            "predicates": [{"name": "HostName"}, {"name": "PodFitsResources"}],
+            "priorities": [
+                {"name": "LeastRequestedPriority", "weight": 2},
+                {
+                    "name": "RackSpread",
+                    "weight": 3,
+                    "argument": {
+                        "labelPreference": {"label": "rack", "presence": True}
+                    },
+                },
+                {
+                    "name": "Packing",
+                    "weight": 1,
+                    "argument": {
+                        "requestedToCapacityRatioArguments": {
+                            "utilizationShape": [
+                                {"utilization": 0, "score": 0},
+                                {"utilization": 100, "score": 10},
+                            ]
+                        }
+                    },
+                },
+            ],
+            "extenders": [
+                {"urlPrefix": "http://x/", "filterVerb": "filter", "weight": 5}
+            ],
+        },
+        universe=u,
+    )
+    # mandatory bits always present; selector NOT enabled
+    assert pol.predicate_mask & (1 << BIT["PodFitsHost"])
+    assert pol.predicate_mask & (1 << BIT["CheckNodeCondition"])
+    assert not (pol.predicate_mask & (1 << BIT["PodMatchNodeSelector"]))
+    # parameterized priorities register under unique internal names (two
+    # policies may configure the same name with different parameters)
+    from kubernetes_tpu.ops.priorities import PRIORITY_REGISTRY
+
+    by_prefix = {k.split("#")[0]: (k, v) for k, v in pol.priority_weights.items()}
+    assert by_prefix["LeastRequestedPriority"][1] == 2
+    assert by_prefix["RackSpread"][1] == 3 and by_prefix["Packing"][1] == 1
+    assert by_prefix["RackSpread"][0] in PRIORITY_REGISTRY
+    assert by_prefix["Packing"][0] in PRIORITY_REGISTRY
+    assert pol.extenders[0].url_prefix == "http://x/" and pol.extenders[0].weight == 5
+    del PRIORITY_REGISTRY[by_prefix["RackSpread"][0]]
+    del PRIORITY_REGISTRY[by_prefix["Packing"][0]]
+
+
+def test_policy_disables_resource_predicate_end_to_end():
+    # Policy enabling ONLY HostName: a pod over the node's capacity still
+    # schedules because PodFitsResources is bypassed
+    pol = cfg.load_policy(
+        {"predicates": [{"name": "HostName"}], "priorities": []}
+    )
+    conf = cfg.KubeSchedulerConfiguration(policy=pol)
+    clk = FakeClock()
+    s = Scheduler.from_config(conf, clock=clk, enable_preemption=False)
+    s.on_node_add(make_node("tiny", cpu_milli=100))
+    s.on_pod_add(make_pod("huge", cpu_milli=99999))
+    res = s.schedule_cycle()
+    assert res.scheduled == 1
+
+    # same pod with the default provider mask: rejected
+    s2 = Scheduler.from_config(
+        cfg.KubeSchedulerConfiguration(), clock=FakeClock(), enable_preemption=False
+    )
+    s2.on_node_add(make_node("tiny", cpu_milli=100))
+    s2.on_pod_add(make_pod("huge", cpu_milli=99999))
+    res2 = s2.schedule_cycle()
+    assert res2.scheduled == 0
+    assert "PodFitsResources" in res2.failure_reasons["default/huge"]
+
+
+def test_delete_of_permit_parked_pod_frees_capacity():
+    """Regression (review): deleting a pod parked by Permit must remove the
+    waiting entry and forget the assumption, or its capacity leaks and a
+    later allow() binds a deleted pod."""
+    class Gate(Plugin):
+        def permit(self, state, pod, node_name):
+            return Status(WAIT, ""), 100.0
+
+        def unreserve(self, state, pod, node_name):
+            self.unreserved = pod.key()
+
+    gate = Gate()
+    s, _ = sched_with([Gate() if False else gate])
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    parked = make_pod("parked", cpu_milli=900)
+    s.on_pod_add(parked)
+    s.schedule_cycle()
+    assert s.cache.is_assumed("default/parked")
+    s.on_pod_delete(parked)
+    assert s.framework.waiting.get("default/parked") is None
+    assert not s.cache.is_assumed("default/parked")
+    assert gate.unreserved == "default/parked"
+    # the freed capacity is usable immediately
+    s.on_pod_add(make_pod("next", cpu_milli=900))
+    res = s.schedule_cycle()
+    assert res.waiting == 1  # made it past Filter into Permit
+
+
+def test_empty_priorities_policy_means_no_scoring():
+    """Regression (review): weights={} must mean NO priorities (policy with
+    an empty list), not the default suite."""
+    pol = cfg.load_policy({"priorities": []})
+    assert pol.priority_weights == {}
+    s = Scheduler.from_config(
+        cfg.KubeSchedulerConfiguration(policy=pol),
+        clock=FakeClock(), enable_preemption=False,
+    )
+    # busy node vs idle node: with no priorities every feasible node scores
+    # 0 and the solver takes the lowest row index deterministically
+    s.on_node_add(make_node("a-busy", cpu_milli=10000))
+    s.on_node_add(make_node("b-idle", cpu_milli=10000))
+    s.on_pod_add(make_pod("pre", cpu_milli=9000, node_name="a-busy"))
+    s.on_pod_add(make_pod("p0", cpu_milli=100))
+    res = s.schedule_cycle()
+    # LeastRequested would pick b-idle; no-priorities picks the first row
+    assert res.assignments["default/p0"] == "a-busy"

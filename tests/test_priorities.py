@@ -170,3 +170,97 @@ def test_weighted_sum_runs():
     total = prio.run_priorities(dp, dn, ds, mask)
     assert total.shape == mask.shape
     assert np.isfinite(np.asarray(total)).all()
+
+
+def test_requested_to_capacity_ratio_differential():
+    shapes = [
+        ((0, 10), (100, 0)),  # default: prefer least utilized
+        ((0, 0), (100, 10)),  # bin-packing
+        ((0, 0), (50, 10), (100, 5)),  # peak at 50%
+    ]
+    for seed in range(4):
+        rng = random.Random(500 + seed)
+        nodes, scheduled, pending = random_cluster(rng, n_nodes=10, n_sched=25, n_pending=10)
+        dn, dp, ds, mask = build(nodes, scheduled, pending)
+        npods = by_node(nodes, scheduled)
+        m = crop(mask, pending, nodes)
+        for shape in shapes:
+            kernel = prio.make_requested_to_capacity_ratio(shape)
+            got = crop(kernel(dp, dn, ds, None, mask), pending, nodes)
+            want = [
+                [
+                    pyref.requested_to_capacity_score(p, nd, npods[nd.name], shape)
+                    for nd in nodes
+                ]
+                for p in pending
+            ]
+            assert_matches(got, want, pending, nodes, m, f"RTCR{shape}")
+
+
+def test_node_label_priority():
+    nodes = [
+        make_node("n0", labels={"disktype": "ssd"}),
+        make_node("n1"),
+    ]
+    pending = [make_pod("p0")]
+    pk = SnapshotPacker()
+    key_id = pk.u.label_keys.intern("disktype")
+    for p in pending:
+        pk.intern_pod(p)
+    dn = nodes_to_device(pk.pack_nodes(nodes, []))
+    dp = pods_to_device(pk.pack_pods(pending))
+    ds = selectors_to_device(pk.pack_selector_tables())
+    mask = run_predicates(dp, dn, ds).mask
+    got_p = crop(prio.make_node_label(key_id, True)(dp, dn, ds, None, mask), pending, nodes)
+    got_a = crop(prio.make_node_label(key_id, False)(dp, dn, ds, None, mask), pending, nodes)
+    for j, nd in enumerate(nodes):
+        assert got_p[0, j] == pyref.node_label_score(nd, "disktype", True)
+        assert got_a[0, j] == pyref.node_label_score(nd, "disktype", False)
+
+
+def test_resource_limits_priority_differential():
+    from kubernetes_tpu.api.types import Resources
+
+    nodes = [
+        make_node("n-big", cpu_milli=32000, memory=64 * 2**30),
+        make_node("n-small", cpu_milli=500, memory=2**28),
+    ]
+    pending = [
+        make_pod("p-none"),  # no limits -> 0 everywhere
+        make_pod("p-cpu", limits=Resources(cpu_milli=1000)),
+        make_pod("p-both", limits=Resources(cpu_milli=100, memory=2**30)),
+        make_pod("p-huge", limits=Resources(cpu_milli=64000, memory=2**40)),
+    ]
+    pk = SnapshotPacker()
+    for p in pending:
+        pk.intern_pod(p)
+    dn = nodes_to_device(pk.pack_nodes(nodes, []))
+    dp = pods_to_device(pk.pack_pods(pending))
+    ds = selectors_to_device(pk.pack_selector_tables())
+    mask = run_predicates(dp, dn, ds).mask
+    got = crop(prio.resource_limits(dp, dn, ds, None, mask), pending, nodes)
+    for i, p in enumerate(pending):
+        for j, nd in enumerate(nodes):
+            assert got[i, j] == pyref.resource_limits_score(p, nd), (p.name, nd.name)
+
+
+def test_register_custom_priority_in_weighted_sum():
+    nodes = [make_node("n0", labels={"gpu": "true"}), make_node("n1")]
+    pending = [make_pod("p0")]
+    pk = SnapshotPacker()
+    key_id = pk.u.label_keys.intern("gpu")
+    for p in pending:
+        pk.intern_pod(p)
+    dn = nodes_to_device(pk.pack_nodes(nodes, []))
+    dp = pods_to_device(pk.pack_pods(pending))
+    ds = selectors_to_device(pk.pack_selector_tables())
+    mask = run_predicates(dp, dn, ds).mask
+    prio.register_priority("NodeLabelPriority/gpu", prio.make_node_label(key_id, True))
+    try:
+        total = crop(
+            prio.run_priorities(dp, dn, ds, mask, {"NodeLabelPriority/gpu": 2.0}),
+            pending, nodes,
+        )
+        assert total[0, 0] == 20.0 and total[0, 1] == 0.0
+    finally:
+        del prio.PRIORITY_REGISTRY["NodeLabelPriority/gpu"]
