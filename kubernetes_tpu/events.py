@@ -1,0 +1,95 @@
+"""Event recording — the client-go ``tools/events``/``tools/record``
+analog. The reference scheduler emits Scheduled / FailedScheduling /
+Preempted events (scheduler.go:274,:335,:457) through a broadcaster that
+aggregates duplicates (same object+reason+message bump a count rather than
+creating new objects).
+
+Here: a host-side :class:`EventRecorder` with the same aggregation,
+fan-out to sinks (the hub shim posts them to the API; tests and the sim
+read them directly)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.types import Pod
+
+TYPE_NORMAL = "Normal"
+TYPE_WARNING = "Warning"
+
+#: the reasons the scheduler emits (scheduler.go / eventhandlers)
+REASON_SCHEDULED = "Scheduled"
+REASON_FAILED = "FailedScheduling"
+REASON_PREEMPTED = "Preempted"
+
+_REASON_TYPE = {
+    REASON_SCHEDULED: TYPE_NORMAL,
+    REASON_FAILED: TYPE_WARNING,
+    REASON_PREEMPTED: TYPE_WARNING,
+}
+
+
+@dataclass
+class Event:
+    type: str
+    reason: str
+    object_key: str  # namespace/name of the involved pod
+    message: str
+    count: int = 1
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+
+
+class EventRecorder:
+    """Aggregating recorder: events with the same (object, reason, message)
+    within the aggregation window bump ``count`` (the
+    EventAggregator/eventBroadcaster behavior that keeps event storms from
+    flooding etcd)."""
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        sinks: Optional[List[Callable[[Event], None]]] = None,
+        max_events: int = 10000,
+    ) -> None:
+        self.clock = clock
+        self.sinks = sinks or []
+        self.max_events = max_events
+        self._events: Dict[Tuple[str, str, str], Event] = {}
+
+    def event(self, reason: str, pod: Pod, message: str) -> Event:
+        now = self.clock()
+        key = (pod.key(), reason, message)
+        ev = self._events.get(key)
+        if ev is not None:
+            ev.count += 1
+            ev.last_timestamp = now
+        else:
+            if len(self._events) >= self.max_events:
+                # drop the oldest (bounded store; the hub is the real sink)
+                oldest = min(self._events, key=lambda k: self._events[k].last_timestamp)
+                del self._events[oldest]
+            ev = Event(
+                type=_REASON_TYPE.get(reason, TYPE_NORMAL),
+                reason=reason,
+                object_key=pod.key(),
+                message=message,
+                first_timestamp=now,
+                last_timestamp=now,
+            )
+            self._events[key] = ev
+        for sink in self.sinks:
+            sink(ev)
+        return ev
+
+    def sink(self) -> Callable[[str, Pod, str], None]:
+        """Adapter matching the driver's event_sink signature."""
+        return lambda reason, pod, message: self.event(reason, pod, message)
+
+    def events(self, object_key: Optional[str] = None) -> List[Event]:
+        evs = list(self._events.values())
+        if object_key is not None:
+            evs = [e for e in evs if e.object_key == object_key]
+        return sorted(evs, key=lambda e: e.first_timestamp)

@@ -1,0 +1,242 @@
+"""Scheduler extender — the out-of-process HTTP+JSON webhook protocol
+(``pkg/scheduler/core/extender.go`` HTTPExtender; wire types
+``pkg/scheduler/api/types.go:240-345``).
+
+This is the integration seam for a Go control plane: the wire shapes
+(ExtenderArgs / ExtenderFilterResult / ExtenderBindingArgs /
+ExtenderPreemptionArgs) keep the reference's JSON field names, so an
+existing extender webhook works against this scheduler unchanged, and —
+symmetrically — a Go kube-scheduler pointed at this framework running
+behind :class:`ExtenderServer` offloads its filter/prioritize work to the
+TPU batch kernels (BASELINE's "scheduler-extender protocol" target).
+
+``nodeCacheCapable`` mode exchanges node *names* only (the extender keeps
+its own cache), which is also how the TPU service keeps the columnar
+snapshot resident device-side instead of shipping node objects per pod.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.config import ExtenderConfig
+
+# ---------------------------------------------------------------------------
+# v1-shaped JSON serialization (the minimal slice extenders read)
+# ---------------------------------------------------------------------------
+
+
+def pod_to_json(pod: Pod) -> dict:
+    """A v1.Pod-shaped document carrying the fields the scheduler consumes
+    (metadata + the scheduling-relevant spec/status slice)."""
+    return {
+        "metadata": {
+            "name": pod.name,
+            "namespace": pod.namespace,
+            "uid": pod.uid or pod.key(),
+            "labels": dict(pod.labels),
+        },
+        "spec": {
+            "nodeName": pod.node_name,
+            "nodeSelector": dict(pod.node_selector),
+            "priority": pod.priority,
+            "containers": [
+                {
+                    "name": "main",
+                    "resources": {
+                        "requests": {
+                            "cpu": f"{int(pod.requests.cpu_milli)}m",
+                            "memory": str(int(pod.requests.memory)),
+                            **{k: str(v) for k, v in pod.requests.scalars.items()},
+                        }
+                    },
+                }
+            ],
+        },
+        "status": {"nominatedNodeName": pod.nominated_node_name},
+    }
+
+
+def node_to_json(node) -> dict:
+    return {
+        "metadata": {"name": node.name, "labels": dict(node.labels)},
+        "status": {
+            "allocatable": {
+                "cpu": f"{int(node.allocatable.cpu_milli)}m",
+                "memory": str(int(node.allocatable.memory)),
+                "pods": str(int(node.allocatable.pods)),
+            }
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# HTTP extender client
+# ---------------------------------------------------------------------------
+
+
+class ExtenderError(Exception):
+    pass
+
+
+class HTTPExtender:
+    """core/extender.go:42 — POSTs JSON to urlPrefix/verb. ``transport``
+    is injectable for tests (callable(url, payload_dict, timeout) ->
+    response dict); the default uses urllib."""
+
+    def __init__(
+        self,
+        config: ExtenderConfig,
+        transport: Optional[Callable[[str, dict, float], dict]] = None,
+    ) -> None:
+        self.config = config
+        self._transport = transport or _urllib_transport
+
+    def name(self) -> str:
+        return self.config.url_prefix
+
+    def is_ignorable(self) -> bool:
+        return self.config.ignorable
+
+    def is_binder(self) -> bool:
+        return bool(self.config.bind_verb)
+
+    def supports_preemption(self) -> bool:
+        return bool(self.config.preempt_verb)
+
+    def is_interested(self, pod: Pod) -> bool:
+        """extender.go:417 IsInterested: no managed resources = interested
+        in everything; otherwise only pods requesting one of them."""
+        if not self.config.managed_resources:
+            return True
+        managed = set(self.config.managed_resources)
+        return any(name in managed for name in pod.requests.scalars)
+
+    def _send(self, verb: str, args: dict) -> dict:
+        url = self.config.url_prefix.rstrip("/") + "/" + verb
+        return self._transport(url, args, self.config.http_timeout_s)
+
+    # -- verbs -------------------------------------------------------------
+
+    def filter(
+        self, pod: Pod, node_names: Sequence[str], nodes_by_name: Dict[str, object]
+    ) -> Tuple[List[str], Dict[str, str]]:
+        """Returns (feasible node names, failed nodes map). Raises
+        ExtenderError on transport/remote error (caller applies the
+        Ignorable policy, generic_scheduler.go:539-566)."""
+        if not self.config.filter_verb:
+            return list(node_names), {}
+        args: dict = {"pod": pod_to_json(pod)}
+        if self.config.node_cache_capable:
+            args["nodenames"] = list(node_names)
+        else:
+            args["nodes"] = {
+                "items": [node_to_json(nodes_by_name[n]) for n in node_names]
+            }
+        try:
+            result = self._send(self.config.filter_verb, args)
+        except Exception as e:
+            raise ExtenderError(str(e))
+        if result.get("error"):
+            raise ExtenderError(result["error"])
+        if self.config.node_cache_capable and result.get("nodenames") is not None:
+            names = list(result["nodenames"])
+        elif result.get("nodes") is not None:
+            names = [
+                item["metadata"]["name"] for item in result["nodes"].get("items", [])
+            ]
+        else:
+            names = list(node_names)
+        return names, dict(result.get("failedNodes") or {})
+
+    def prioritize(
+        self, pod: Pod, node_names: Sequence[str], nodes_by_name: Dict[str, object]
+    ) -> Tuple[Dict[str, float], int]:
+        """Returns ({node: score}, weight) — the caller adds
+        score*weight into the total (extender.go:318)."""
+        if not self.config.prioritize_verb:
+            return {n: 0.0 for n in node_names}, 1
+        args: dict = {"pod": pod_to_json(pod)}
+        if self.config.node_cache_capable:
+            args["nodenames"] = list(node_names)
+        else:
+            args["nodes"] = {
+                "items": [node_to_json(nodes_by_name[n]) for n in node_names]
+            }
+        try:
+            result = self._send(self.config.prioritize_verb, args)
+        except Exception as e:
+            raise ExtenderError(str(e))
+        scores = {hp["host"]: float(hp["score"]) for hp in (result or [])}
+        return scores, self.config.weight
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        """extender.go:360 — delegate the binding to the extender."""
+        args = {
+            "podName": pod.name,
+            "podNamespace": pod.namespace,
+            "podUID": pod.uid or pod.key(),
+            "node": node_name,
+        }
+        result = self._send(self.config.bind_verb, args)
+        if result and result.get("error"):
+            raise ExtenderError(result["error"])
+
+    def process_preemption(
+        self, pod: Pod, victims_by_node: Dict[str, Tuple[List[Pod], int]]
+    ) -> Dict[str, Tuple[List[Pod], int]]:
+        """extender.go:135 ProcessPreemption: the extender may drop
+        candidate nodes or shrink victim lists. Node-cache-capable wire
+        form (metaVictims, pod UIDs only)."""
+        if not self.config.preempt_verb:
+            return victims_by_node
+        pods_by_uid = {
+            v.uid or v.key(): v
+            for victims, _ in victims_by_node.values()
+            for v in victims
+        }
+        args = {
+            "pod": pod_to_json(pod),
+            "nodeNameToMetaVictims": {
+                node: {
+                    "pods": [{"uid": v.uid or v.key()} for v in victims],
+                    "numPDBViolations": npdb,
+                }
+                for node, (victims, npdb) in victims_by_node.items()
+            },
+        }
+        try:
+            result = self._send(self.config.preempt_verb, args)
+        except Exception as e:
+            raise ExtenderError(str(e))
+        out: Dict[str, Tuple[List[Pod], int]] = {}
+        for node, mv in (result.get("nodeNameToMetaVictims") or {}).items():
+            victims = [
+                pods_by_uid[p["uid"]]
+                for p in mv.get("pods", [])
+                if p.get("uid") in pods_by_uid
+            ]
+            out[node] = (victims, int(mv.get("numPDBViolations", 0)))
+        return out
+
+
+def _urllib_transport(url: str, payload: dict, timeout: float) -> dict:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode() or "{}")
+
+
+def build_extenders(
+    configs: Sequence[ExtenderConfig],
+    transport: Optional[Callable] = None,
+) -> List[HTTPExtender]:
+    return [HTTPExtender(c, transport) for c in configs]

@@ -1,0 +1,149 @@
+"""Leader election — active-passive HA for the scheduler, mirroring
+client-go ``tools/leaderelection`` (``leaderelection.go:317``
+tryAcquireOrRenew): CAS on a lease record with holder identity, lease
+duration, renew deadline, and retry period. The scheduler only runs while
+leading (app/server.go:261 OnStartedLeading -> sched.Run).
+
+The lock is pluggable: :class:`InMemoryLock` for tests/single-process,
+:class:`FileLock` (atomic rename CAS) for multi-process on one host; a hub
+integration would CAS a Lease API object. The elector is tick-driven (no
+background threads) so the sim/driver controls time."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from kubernetes_tpu.config import LeaderElectionConfig
+
+
+@dataclass
+class LeaderElectionRecord:
+    """resourcelock.LeaderElectionRecord wire shape."""
+
+    holder_identity: str = ""
+    lease_duration_s: float = 15.0
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    leader_transitions: int = 0
+
+
+class InMemoryLock:
+    """Shared-object lock for in-process elections (tests, sim)."""
+
+    def __init__(self) -> None:
+        self._record: Optional[LeaderElectionRecord] = None
+
+    def get(self) -> Optional[LeaderElectionRecord]:
+        return self._record
+
+    def create_or_update(self, record: LeaderElectionRecord, old) -> bool:
+        """CAS: succeeds only if the current record still equals ``old``
+        (the optimistic-concurrency resourceVersion check)."""
+        if self._record is not old:
+            return False
+        self._record = record
+        return True
+
+
+class FileLock:
+    """File-based lock: read-modify-write with atomic rename; the loaded
+    JSON doubles as the resourceVersion (compare-and-swap on content)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+
+    def get(self) -> Optional[LeaderElectionRecord]:
+        try:
+            with open(self.path) as f:
+                d = json.load(f)
+            return LeaderElectionRecord(**d)
+        except (OSError, ValueError):
+            return None
+
+    def create_or_update(self, record: LeaderElectionRecord, old) -> bool:
+        cur = self.get()
+        if (cur is None) != (old is None):
+            return False
+        if cur is not None and old is not None and cur.__dict__ != old.__dict__:
+            return False
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(record.__dict__, f)
+        os.replace(tmp, self.path)
+        return True
+
+
+class LeaderElector:
+    """leaderelection.go LeaderElector, tick-driven. Call ``tick()`` at
+    least every retry_period; it acquires/renews and fires the callbacks."""
+
+    def __init__(
+        self,
+        identity: str,
+        lock,
+        config: Optional[LeaderElectionConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self.identity = identity
+        self.lock = lock
+        self.config = config or LeaderElectionConfig()
+        self.clock = clock
+        self.on_started_leading = on_started_leading or (lambda: None)
+        self.on_stopped_leading = on_stopped_leading or (lambda: None)
+        self._leading = False
+        self._observed: Optional[LeaderElectionRecord] = None
+        self._observed_at: float = 0.0
+
+    def is_leader(self) -> bool:
+        return self._leading
+
+    def tick(self) -> bool:
+        """tryAcquireOrRenew (leaderelection.go:317). Returns leading."""
+        now = self.clock()
+        cur = self.lock.get()
+        if cur is not None and cur != self._observed:
+            self._observed = cur
+            self._observed_at = now
+
+        if cur is not None and cur.holder_identity != self.identity:
+            # someone else holds it; steal only once their lease expires
+            if self._observed_at + cur.lease_duration_s > now:
+                self._set_leading(False)
+                return False
+
+        new = LeaderElectionRecord(
+            holder_identity=self.identity,
+            lease_duration_s=self.config.lease_duration_s,
+            acquire_time=(
+                cur.acquire_time
+                if cur is not None and cur.holder_identity == self.identity
+                else now
+            ),
+            renew_time=now,
+            leader_transitions=(
+                cur.leader_transitions
+                if cur is not None and cur.holder_identity == self.identity
+                else (cur.leader_transitions + 1 if cur is not None else 0)
+            ),
+        )
+        if not self.lock.create_or_update(new, cur):
+            self._set_leading(False)
+            return False
+        self._observed = new
+        self._observed_at = now
+        self._set_leading(True)
+        return True
+
+    def _set_leading(self, leading: bool) -> None:
+        if leading and not self._leading:
+            self._leading = True
+            self.on_started_leading()
+        elif not leading and self._leading:
+            self._leading = False
+            self.on_stopped_leading()

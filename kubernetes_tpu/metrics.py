@@ -1,0 +1,230 @@
+"""Prometheus-compatible metrics, mirroring the reference scheduler's
+metric names and shapes (``pkg/scheduler/metrics/metrics.go``) so existing
+dashboards/SLO scrapes (e.g. the e2e latency gates,
+test/e2e/framework/metrics/latencies.go:257) keep working:
+
+- ``scheduler_schedule_attempts_total{result}`` (counter; result ∈
+  scheduled|unschedulable|error — metrics.go:54)
+- ``scheduler_scheduling_duration_seconds{operation}`` (summary by phase —
+  metrics.go:66; quantiles 0.5/0.9/0.99)
+- ``scheduler_e2e_scheduling_duration_seconds`` (histogram, buckets
+  exp(0.001, ×2, 15) — metrics.go:88)
+- per-phase algorithm histograms, binding latency, preemption counters,
+  ``scheduler_pending_pods{queue}`` gauges.
+
+Implementation is a small text-exposition registry (no client library in
+the image); histograms use the reference's bucket layouts.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def exponential_buckets(start: float, factor: float, count: int) -> List[float]:
+    return [start * (factor ** i) for i in range(count)]
+
+
+_DEF_BUCKETS = exponential_buckets(0.001, 2, 15)  # metrics.go:91 et al.
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        return tuple(labels.get(k, "") for k in self.label_names)
+
+    def _fmt_labels(self, key: Tuple[str, ...], extra: str = "") -> str:
+        parts = [f'{k}="{v}"' for k, v in zip(self.label_names, key)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        k = self._key(labels)
+        self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = []
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}{self._fmt_labels(k)} {v}")
+        return out
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        self._values[self._key(labels)] = float(value)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_, label_names=(), buckets: Optional[List[float]] = None):
+        super().__init__(name, help_, label_names)
+        self.buckets = sorted(buckets or _DEF_BUCKETS)
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sum: Dict[Tuple[str, ...], float] = {}
+        self._n: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        k = self._key(labels)
+        counts = self._counts.setdefault(k, [0] * len(self.buckets))
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                counts[i] += 1
+        self._sum[k] = self._sum.get(k, 0.0) + value
+        self._n[k] = self._n.get(k, 0) + 1
+
+    def count(self, **labels) -> int:
+        return self._n.get(self._key(labels), 0)
+
+    def expose(self) -> List[str]:
+        out = []
+        for k in sorted(self._n):
+            for i, b in enumerate(self.buckets):
+                out.append(
+                    f"{self.name}_bucket{self._fmt_labels(k, f'le=\"{b}\"')} "
+                    f"{self._counts[k][i]}"
+                )
+            out.append(
+                f"{self.name}_bucket{self._fmt_labels(k, 'le=\"+Inf\"')} {self._n[k]}"
+            )
+            out.append(f"{self.name}_sum{self._fmt_labels(k)} {self._sum[k]}")
+            out.append(f"{self.name}_count{self._fmt_labels(k)} {self._n[k]}")
+        return out
+
+
+class Summary(_Metric):
+    """SummaryVec analog (scheduling_duration_seconds is a summary with
+    precomputed quantiles, metrics.go:64). Keeps a bounded sample window."""
+
+    kind = "summary"
+    objectives = (0.5, 0.9, 0.99)
+
+    def __init__(self, name, help_, label_names=(), max_samples: int = 4096):
+        super().__init__(name, help_, label_names)
+        self.max_samples = max_samples
+        self._samples: Dict[Tuple[str, ...], List[float]] = {}
+        self._sum: Dict[Tuple[str, ...], float] = {}
+        self._n: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        k = self._key(labels)
+        s = self._samples.setdefault(k, [])
+        s.append(value)
+        if len(s) > self.max_samples:
+            del s[: len(s) // 2]
+        self._sum[k] = self._sum.get(k, 0.0) + value
+        self._n[k] = self._n.get(k, 0) + 1
+
+    def quantile(self, q: float, **labels) -> float:
+        s = sorted(self._samples.get(self._key(labels), []))
+        if not s:
+            return float("nan")
+        return s[min(len(s) - 1, int(math.ceil(q * len(s))) - 1)]
+
+    def expose(self) -> List[str]:
+        out = []
+        for k in sorted(self._n):
+            for q in self.objectives:
+                out.append(
+                    f"{self.name}{self._fmt_labels(k, f'quantile=\"{q}\"')} "
+                    f"{self.quantile(q, **dict(zip(self.label_names, k)))}"
+                )
+            out.append(f"{self.name}_sum{self._fmt_labels(k)} {self._sum[k]}")
+            out.append(f"{self.name}_count{self._fmt_labels(k)} {self._n[k]}")
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: List[_Metric] = []
+        self._lock = threading.Lock()
+
+    def register(self, m):
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            for m in self._metrics:
+                lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# TYPE {m.name} {m.kind}")
+                lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+class SchedulerMetrics:
+    """The reference's metric set (metrics.Register, metrics.go:186),
+    recorded by the driver each cycle."""
+
+    # result labels (metrics.go:41-49)
+    SCHEDULED, UNSCHEDULABLE, ERROR = "scheduled", "unschedulable", "error"
+
+    def __init__(self, registry: Optional[Registry] = None) -> None:
+        r = self.registry = registry or Registry()
+        self.schedule_attempts = r.register(Counter(
+            "scheduler_schedule_attempts_total",
+            "Number of attempts to schedule pods, by result.",
+            ["result"],
+        ))
+        self.scheduling_duration = r.register(Summary(
+            "scheduler_scheduling_duration_seconds",
+            "Scheduling latency split by sub-parts of the scheduling operation.",
+            ["operation"],
+        ))
+        self.e2e_scheduling_duration = r.register(Histogram(
+            "scheduler_e2e_scheduling_duration_seconds",
+            "E2e scheduling latency (scheduling algorithm + binding).",
+        ))
+        self.algorithm_duration = r.register(Histogram(
+            "scheduler_scheduling_algorithm_duration_seconds",
+            "Scheduling algorithm latency.",
+        ))
+        self.predicate_duration = r.register(Histogram(
+            "scheduler_scheduling_algorithm_predicate_evaluation_seconds",
+            "Scheduling algorithm predicate evaluation duration.",
+        ))
+        self.priority_duration = r.register(Histogram(
+            "scheduler_scheduling_algorithm_priority_evaluation_seconds",
+            "Scheduling algorithm priority evaluation duration.",
+        ))
+        self.preemption_duration = r.register(Histogram(
+            "scheduler_scheduling_algorithm_preemption_evaluation_seconds",
+            "Scheduling algorithm preemption evaluation duration.",
+        ))
+        self.binding_duration = r.register(Histogram(
+            "scheduler_binding_duration_seconds", "Binding latency.",
+        ))
+        self.preemption_victims = r.register(Counter(
+            "scheduler_pod_preemption_victims", "Number of selected preemption victims",
+        ))
+        self.preemption_attempts = r.register(Counter(
+            "scheduler_total_preemption_attempts",
+            "Total preemption attempts in the cluster till now",
+        ))
+        self.pending_pods = r.register(Gauge(
+            "scheduler_pending_pods",
+            "Number of pending pods, by the queue type.",
+            ["queue"],
+        ))

@@ -225,6 +225,7 @@ def preempt(
     pdbs: Sequence[PodDisruptionBudget] = (),
     nominated_pods_of: Optional[Dict[str, List[Pod]]] = None,
     vol_state=None,
+    extenders: Sequence = (),
 ) -> Optional[PreemptionResult]:
     """The full Preempt flow for one unschedulable pod. ``node_pods_of``
     maps node name -> pods (from the cache); ``reason_bits_by_node`` is the
@@ -246,6 +247,18 @@ def preempt(
         )
         if r is not None:
             candidates[name] = r
+    # extender.ProcessPreemption (generic_scheduler.go:350): preemption-
+    # capable extenders may drop candidate nodes or shrink victim lists;
+    # ignorable extenders drop out on error
+    for ext in extenders:
+        if not candidates:
+            break
+        try:
+            candidates = ext.process_preemption(pod, candidates)
+        except Exception:
+            if getattr(ext, "is_ignorable", lambda: False)():
+                continue
+            return None
     chosen = pick_one_node(candidates)
     if chosen is None:
         return None

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
 import jax
@@ -62,6 +63,15 @@ def _new_cycle_state():
     from kubernetes_tpu.framework import CycleState
 
     return CycleState()
+
+
+@partial(jax.jit, static_argnames=("weights_key",))
+def _score_pass(dp, dn, ds, dt, mask, weights_key):
+    """Standalone priority evaluation for the exact host solver."""
+    from kubernetes_tpu.ops.priorities import run_priorities
+
+    w = dict(weights_key) if weights_key is not None else None
+    return run_priorities(dp, dn, ds, mask, w, dt)
 
 
 @jax.jit
@@ -129,10 +139,22 @@ class Scheduler:
         victim_deleter: Optional[Callable[[Pod], None]] = None,
         framework=None,
         pred_mask: Optional[int] = None,
+        extenders=(),
+        metrics=None,
+        trace_threshold_s: float = 1.0,
     ) -> None:
         from kubernetes_tpu.framework import Framework
+        from kubernetes_tpu.metrics import SchedulerMetrics
 
         self.framework = framework or Framework(clock=clock)
+        #: HTTPExtender list (core/extender.go), called after the built-in
+        #: filter/score passes for interested pods
+        self.extenders = list(extenders)
+        self.metrics = metrics or SchedulerMetrics()
+        #: cycles slower than this log their step trace (utiltrace
+        #: LogIfLong; default is cycle-scale, not the reference's per-pod
+        #: 100ms, since one cycle schedules a whole batch)
+        self.trace_threshold_s = trace_threshold_s
         #: enabled-predicate bitmask (config.Policy.predicate_mask);
         #: None = every implemented predicate enforced
         self.pred_mask = pred_mask
@@ -176,6 +198,10 @@ class Scheduler:
         if cfg.policy is not None:
             kw.setdefault("pred_mask", cfg.policy.predicate_mask)
             kw.setdefault("weights", dict(cfg.policy.priority_weights))
+            if cfg.policy.extenders:
+                from kubernetes_tpu.extender import build_extenders
+
+                kw.setdefault("extenders", build_extenders(cfg.policy.extenders))
         else:
             kw.setdefault("pred_mask", default_predicate_mask(cfg.feature_gates))
             kw.setdefault("weights", default_priority_weights(cfg.feature_gates))
@@ -265,15 +291,18 @@ class Scheduler:
         from kubernetes_tpu.ops.predicates import decode_reasons
 
         from kubernetes_tpu.framework import CycleState
+        from kubernetes_tpu.utils.trace import Trace
 
         t0 = self.clock()
         res = CycleResult()
+        trace = Trace("Scheduling cycle", clock=self.clock)
         self.queue.tick()
         self.cache.cleanup_expired()
         self._process_waiting(res)
         batch = self.queue.pop_batch(self.max_batch)
         if not batch:
             res.elapsed_s = self.clock() - t0
+            self._record_metrics(res)
             return res
         cycle = self.queue.scheduling_cycle
         res.attempted = len(batch)
@@ -293,6 +322,7 @@ class Scheduler:
         batch = kept
         if not batch:
             res.elapsed_s = self.clock() - t0
+            self._record_metrics(res)
             return res
 
         # pack: pods first (their programs grow universes), then snapshot
@@ -316,6 +346,7 @@ class Scheduler:
 
             dv = volumes_to_device(pk.pack_volume_tables(batch))
             sv = _static_vol_pass(dp, dn, ds, dv)
+        trace.step(f"snapshot packed ({len(batch)} pods, {nt.n} nodes)")
 
         # framework Filter/Score contributions: device batch plugins give
         # whole (P, N) matrices; host plugins evaluate per (pod, nodeName)
@@ -354,6 +385,22 @@ class Scheduler:
                     else extra_score + jnp.asarray(hs)
                 )
 
+        # one shared built-in filter pass against the initial usage, used
+        # by the extender path and the exact solver (avoid re-evaluating)
+        base_fr = None
+        if self.extenders or self.solver == "exact":
+            base_fr = _filter_pass(dp, dn, ds, dt, dv, sv, self.pred_mask)
+
+        # scheduler extenders (generic_scheduler.go:539-566: after built-in
+        # predicates; prioritize adds weight*score to the totals :799-829)
+        if self.extenders:
+            em, es = self._run_extenders(batch, base_fr, node_order, early_fail)
+            if em is not None:
+                fw_mask = em if fw_mask is None else (fw_mask & em)
+            if es is not None:
+                extra_score = es if extra_score is None else extra_score + es
+            trace.step("extenders done")
+
         # nominated-pods pass A (podFitsOnNode two-pass rule,
         # generic_scheduler.go:610): feasibility must ALSO hold with the
         # nominated pods counted onto their nodes. Divergence from the
@@ -386,6 +433,10 @@ class Scheduler:
                 extra_score=extra_score,
             )
             rounds = len(batch)
+        elif self.solver == "exact":
+            assigned, usage, rounds = self._exact_solve(
+                dp, dn, ds, dt, base_fr, extra_mask, extra_score
+            )
         else:
             assigned, usage, rounds = batch_assign(
                 dp, dn, ds, self.weights,
@@ -400,6 +451,9 @@ class Scheduler:
             )
         assigned = np.asarray(assigned)[: len(batch)]
         res.rounds = int(rounds) if self.solver != "greedy" else rounds
+        solve_s = trace.total_s()
+        trace.step(f"solve done ({res.rounds} rounds)")
+        self.metrics.algorithm_duration.observe(solve_s)
 
         # reasons for the unplaced: one more filter pass against the
         # post-assignment usage (what the serial loop would have seen last)
@@ -455,12 +509,177 @@ class Scheduler:
                 continue
             self._bind_pod(pod, node_name, st, res)
 
+        trace.step(f"bound {res.scheduled}, failed {res.unschedulable}")
+
         # preemption (scheduler.go:493 -> preempt, §3.3): failed pods try to
         # evict lower-priority pods; winners get a nominated node and retry
         if self.enable_preemption and failed_idx and rmat is not None:
+            pt0 = self.clock()
             self._run_preemption(batch, failed_idx, rmat, node_order, res)
+            self.metrics.preemption_duration.observe(self.clock() - pt0)
+            trace.step(f"preemption ({res.preempted} victims)")
         res.elapsed_s = self.clock() - t0
+        self._record_metrics(res, solve_s)
+        trace.log_if_long(self.trace_threshold_s)
         return res
+
+    def _record_metrics(self, res: CycleResult, solve_s: float = 0.0) -> None:
+        """pkg/scheduler/metrics names; per-pod attempt counts, cycle-level
+        durations, queue-depth gauges. Bind errors already passed scheduling
+        and count ONLY under "error" (the reference's result labels are
+        disjoint per attempt)."""
+        m = self.metrics
+        m.schedule_attempts.inc(res.scheduled, result=m.SCHEDULED)
+        m.schedule_attempts.inc(
+            max(res.unschedulable - res.bind_errors, 0), result=m.UNSCHEDULABLE
+        )
+        m.schedule_attempts.inc(res.bind_errors, result=m.ERROR)
+        if res.attempted or res.scheduled or res.unschedulable:
+            m.e2e_scheduling_duration.observe(res.elapsed_s)
+            m.scheduling_duration.observe(solve_s, operation="scheduling_algorithm")
+        for q, depth in self.queue.pending_counts().items():
+            m.pending_pods.set(depth, queue=q)
+
+    def _exact_solve(self, dp, dn, ds, dt, base_fr, extra_mask, extra_score):
+        """Exact one-shot assignment: device filter+score once, then the
+        native Hungarian solver with per-node slot capacities
+        (native/ktpu.cc; SURVEY.md §7.2 step 5's exact branch). Maximizes
+        the batch's total score instead of auction rounds — for gang /
+        offline packing where quality beats wall-clock. Multi-resource
+        feasibility beyond slot counts is validated sequentially in queue
+        order; in-batch coupling of ports/volumes/topology is NOT modeled
+        here (use the round solver for such workloads)."""
+        from kubernetes_tpu import native
+        from kubernetes_tpu.ops.assign import _apply_batch, usage_from_nodes
+        from kubernetes_tpu.ops.predicates import BIT
+        from kubernetes_tpu.snapshot import RES_PODS
+
+        mask = np.asarray(base_fr.mask)
+        if extra_mask is not None:
+            mask = mask & np.asarray(extra_mask)
+        wkey = (
+            tuple(sorted(self.weights.items()))
+            if self.weights is not None
+            else None
+        )
+        score = np.asarray(_score_pass(dp, dn, ds, dt, jnp.asarray(mask), wkey))
+        if extra_score is not None:
+            score = score + np.asarray(extra_score)
+
+        alloc = np.asarray(dn.allocatable)
+        node_valid = np.asarray(dn.valid)
+        valid = np.asarray(dp.valid)
+        preq = np.asarray(dp.req)
+        order = np.lexsort((np.asarray(dp.order), -np.asarray(dp.priority)))
+
+        # assign -> validate rounds: the slot capacity only encodes the pod
+        # count; multi-resource feasibility is enforced by sequential
+        # validation, and rejected pods re-solve against the updated usage
+        # until a fixpoint (usually 1-2 rounds)
+        # a Policy bypassing PodFitsResources also bypasses the resource
+        # gating here (mirrors the batch solver's admission-guard bypass)
+        res_on = self.pred_mask is None or bool(
+            self.pred_mask & (1 << BIT["PodFitsResources"])
+        )
+        P = mask.shape[0]
+        assigned_final = np.full((P,), -1, np.int32)
+        used = np.asarray(dn.requested).copy()
+        active = valid.copy()
+        rounds = 0
+        for _ in range(16):
+            if not active.any():
+                break
+            rounds += 1
+            fit = np.all(
+                used[None, :, :] + preq[:, None, :] <= alloc[None, :, :] + 1e-6,
+                axis=2,
+            ) if res_on else np.ones((P, alloc.shape[0]), bool)
+            # slot capacity: the pod-count column is exact; the other
+            # resource columns bound the count via the SMALLEST active
+            # request (an upper bound — still validated below — that keeps
+            # the Hungarian from piling far more pods on a node than any
+            # resource could admit, which would burn a round per few pods)
+            free = np.maximum(alloc - used, 0.0)  # (N, R)
+            min_req = np.where(
+                active[:, None], preq, np.inf
+            ).min(axis=0)  # (R,) smallest request per resource
+            with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
+                per_res = np.where(
+                    min_req > 0, np.floor(free / np.maximum(min_req, 1e-30)), np.inf
+                )
+            cap = np.where(
+                node_valid, np.nanmin(per_res, axis=1), 0
+            )
+            cap = np.where(np.isfinite(cap), cap, free[:, RES_PODS]).astype(np.int64)
+            if not res_on:
+                cap = np.where(node_valid, P, 0).astype(np.int64)
+            m = mask & fit & active[:, None]
+            a = native.exact_assign(score, m, cap)
+            progress = False
+            for p in order:
+                if not active[p] or a[p] < 0:
+                    continue
+                t = a[p]
+                if not res_on or np.all(used[t] + preq[p] <= alloc[t] + 1e-6):
+                    used[t] += preq[p]
+                    assigned_final[p] = t
+                    active[p] = False
+                    progress = True
+            if not progress:
+                break
+        acc = jnp.asarray(assigned_final >= 0) & dp.valid
+        usage = _apply_batch(
+            usage_from_nodes(dn), dp,
+            jnp.asarray(np.maximum(assigned_final, 0)), acc,
+        )
+        return jnp.asarray(assigned_final), usage, rounds
+
+    def _run_extenders(self, batch, base_fr, node_order, early_fail):
+        """Call each extender's Filter then Prioritize for interested pods
+        against the built-in-feasible node set (``base_fr`` — the shared
+        per-cycle filter pass). Ignorable extenders drop out on error;
+        others fail the pod (generic_scheduler.go:539-566)."""
+        from kubernetes_tpu.extender import ExtenderError
+
+        interested = [
+            (i, p) for i, p in enumerate(batch)
+            if any(e.is_interested(p) for e in self.extenders)
+        ]
+        if not interested:
+            return None, None
+        base = np.asarray(base_fr.mask)
+        rows = {n: j for j, n in enumerate(node_order)}
+        nodes_by_name = {nd.name: nd for nd in self.cache.nodes()}
+        em = np.ones(base.shape, bool)
+        es = np.zeros(base.shape, np.float32)
+        for i, pod in interested:
+            feasible = [n for n in node_order if base[i, rows[n]]]
+            allowed = set(feasible)
+            for ext in self.extenders:
+                if not ext.is_interested(pod):
+                    continue
+                try:
+                    names, _failed = ext.filter(
+                        pod, [n for n in feasible if n in allowed], nodes_by_name
+                    )
+                    allowed &= set(names)
+                    scores, weight = ext.prioritize(
+                        pod, sorted(allowed), nodes_by_name
+                    )
+                    for n, sc in scores.items():
+                        if n in rows:
+                            es[i, rows[n]] += weight * sc
+                except ExtenderError as e:
+                    if ext.is_ignorable():
+                        continue  # skip this extender (extender.go:124)
+                    allowed = set()
+                    early_fail[i] = f"Extender:{e}"
+                    break
+            keep = np.zeros(base.shape[1], bool)
+            for n in allowed:
+                keep[rows[n]] = True
+            em[i] = keep
+        return jnp.asarray(em), jnp.asarray(es)
 
     def _bind_pod(self, pod: Pod, node_name: str, st, res: CycleResult) -> bool:
         """PreBind -> Bind (plugins, else default binder) -> PostBind —
@@ -483,14 +702,23 @@ class Scheduler:
         s = fw.run_prebind(st, pod, node_name)
         if not s.is_success():
             return reject(f"PreBind:{s.message}")
+        bt0 = self.clock()
         bs = fw.run_bind(st, pod, node_name)
         if bs.code == _SKIP:
+            # an interested binder-extender takes the binding over the
+            # default binder (extender.go:360,:382)
+            binder = self.binder
+            for ext in self.extenders:
+                if ext.is_binder() and ext.is_interested(pod):
+                    binder = ext
+                    break
             try:
-                self.binder.bind(pod, node_name)
+                binder.bind(pod, node_name)
             except Exception as e:  # bind RPC failed -> Forget + retry
                 return reject(f"BindError:{e}")
         elif not bs.is_success():
             return reject(f"Bind:{bs.message}")
+        self.metrics.binding_duration.observe(self.clock() - bt0)
         self.cache.finish_binding(pod.key())
         self.queue.nominated.delete(pod)
         res.scheduled += 1
@@ -555,13 +783,16 @@ class Scheduler:
                 for r, name in enumerate(node_order)
                 if name
             }
+            self.metrics.preemption_attempts.inc()
             result = preempt(
                 pod, nodes, node_pods_of, reason_bits, pdbs,
                 nominated_pods_of=dict(self.queue.nominated.items()),
                 vol_state=self.cache.packer.resolve_volumes,
+                extenders=[e for e in self.extenders if e.supports_preemption()],
             )
             if result is None:
                 continue
+            self.metrics.preemption_victims.inc(len(result.victims))
             now = self.clock()
             for v in result.victims:
                 v.deletion_timestamp = now

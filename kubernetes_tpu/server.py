@@ -1,0 +1,191 @@
+"""HTTP serving shim: healthz + metrics + the extender-protocol server.
+
+Two serving roles, mirroring the reference's two integration surfaces:
+
+- :func:`serve_scheduler` — the component's own ``/healthz`` + ``/metrics``
+  endpoints (app/server.go:214-234 installs these on every scheduler).
+- :class:`ExtenderServer` — the *reverse* integration seam from
+  BASELINE: this framework served AS a scheduler extender. A stock Go
+  kube-scheduler configured with an HTTPExtender pointing here (verbs
+  ``filter``/``prioritize``, ``nodeCacheCapable: true``) offloads
+  filtering/scoring to the TPU batch kernels while keeping its own
+  control loop; wire shapes follow pkg/scheduler/api/types.go:284-345.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+import numpy as np
+
+from kubernetes_tpu.api.types import Pod, Resources
+
+_CPU_RE = re.compile(r"^(\d+)m$")
+
+
+def parse_quantity(s: str, is_cpu: bool = False) -> float:
+    """Minimal resource.Quantity parse: '100m' cpu, plain ints, Ki/Mi/Gi."""
+    s = str(s)
+    m = _CPU_RE.match(s)
+    if m:
+        return float(m.group(1))
+    suffixes = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
+                "k": 1e3, "M": 1e6, "G": 1e9}
+    for suf, mult in suffixes.items():
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * mult
+    v = float(s)
+    return v * 1000 if is_cpu else v  # whole cpus -> milli
+
+
+def pod_from_json(d: dict) -> Pod:
+    """Inverse of extender.pod_to_json for the fields the kernels read."""
+    meta = d.get("metadata", {})
+    spec = d.get("spec", {})
+    requests = Resources()
+    for c in spec.get("containers", []):
+        req = (c.get("resources") or {}).get("requests") or {}
+        for name, q in req.items():
+            if name == "cpu":
+                requests.cpu_milli += parse_quantity(q, is_cpu=True)
+            elif name == "memory":
+                requests.memory += parse_quantity(q)
+            elif name == "ephemeral-storage":
+                requests.ephemeral_storage += parse_quantity(q)
+            else:
+                requests.scalars[name] = requests.scalars.get(name, 0) + parse_quantity(q)
+    return Pod(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        uid=meta.get("uid", ""),
+        labels=dict(meta.get("labels") or {}),
+        node_name=spec.get("nodeName", ""),
+        node_selector=dict(spec.get("nodeSelector") or {}),
+        priority=int(spec.get("priority") or 0),
+        requests=requests,
+        nominated_node_name=(d.get("status") or {}).get("nominatedNodeName", ""),
+    )
+
+
+class ExtenderServer:
+    """Serves filter/prioritize over the scheduler's cache snapshot using
+    the device kernels — one pod per request (the extender protocol is
+    per-pod), but filtering/scoring the whole node axis in one fused pass.
+    """
+
+    def __init__(self, scheduler) -> None:
+        self.scheduler = scheduler
+
+    # -- request handling --------------------------------------------------
+
+    def handle(self, verb: str, payload: dict) -> dict:
+        if verb == "filter":
+            return self._filter(payload)
+        if verb == "prioritize":
+            return self._prioritize(payload)
+        return {"error": f"unknown verb {verb!r}"}
+
+    def _evaluate(self, payload: dict):
+        from kubernetes_tpu.ops.arrays import (
+            nodes_to_device,
+            pods_to_device,
+            selectors_to_device,
+        )
+        from kubernetes_tpu.ops.predicates import decode_reasons, run_predicates
+        from kubernetes_tpu.ops.priorities import run_priorities
+
+        s = self.scheduler
+        pod = pod_from_json(payload["pod"])
+        requested = payload.get("nodenames")
+        pk = s.cache.packer
+        pk.intern_pod(pod)
+        nt = s.cache.snapshot()
+        node_order = s.cache.node_order()
+        dn = nodes_to_device(nt)
+        dp = pods_to_device(pk.pack_pods([pod]))
+        ds = selectors_to_device(pk.pack_selector_tables())
+        fr = run_predicates(dp, dn, ds, None, None, None, s.pred_mask)
+        score = run_priorities(dp, dn, ds, fr.mask, s.weights)
+        mask = np.asarray(fr.mask)[0]
+        reasons = np.asarray(fr.reasons)[0]
+        scores = np.asarray(score)[0]
+        rows: Dict[str, int] = {n: i for i, n in enumerate(node_order)}
+        names = requested if requested is not None else node_order
+        return pod, names, rows, mask, reasons, scores
+
+    def _filter(self, payload: dict) -> dict:
+        from kubernetes_tpu.ops.predicates import decode_reasons
+
+        _, names, rows, mask, reasons, _ = self._evaluate(payload)
+        ok, failed = [], {}
+        for n in names:
+            i = rows.get(n)
+            if i is None:
+                failed[n] = "node not in snapshot"
+            elif mask[i]:
+                ok.append(n)
+            else:
+                failed[n] = ",".join(decode_reasons(int(reasons[i]))) or "infeasible"
+        return {"nodenames": ok, "failedNodes": failed, "error": ""}
+
+    def _prioritize(self, payload: dict) -> dict:
+        _, names, rows, mask, _, scores = self._evaluate(payload)
+        out = []
+        for n in names:
+            i = rows.get(n)
+            # extender scores ride a 0-10 scale like in-tree priorities
+            val = float(scores[i]) if i is not None and mask[i] else 0.0
+            out.append({"host": n, "score": int(max(0.0, min(10.0, val)))})
+        return out
+
+
+def serve_scheduler(
+    scheduler,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    extender: Optional[ExtenderServer] = None,
+) -> ThreadingHTTPServer:
+    """Start the healthz/metrics (+ optional extender) server on a daemon
+    thread; returns the server (``.server_address`` has the bound port,
+    ``.shutdown()`` stops it)."""
+
+    sched = scheduler
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _respond(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._respond(200, b"ok", "text/plain")
+            elif self.path == "/metrics":
+                body = sched.metrics.registry.expose().encode()
+                self._respond(200, body, "text/plain; version=0.0.4")
+            else:
+                self._respond(404, b"not found", "text/plain")
+
+        def do_POST(self):
+            if extender is None:
+                self._respond(404, b"no extender", "text/plain")
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n).decode() or "{}")
+            verb = self.path.strip("/").split("/")[-1]
+            result = extender.handle(verb, payload)
+            self._respond(200, json.dumps(result).encode(), "application/json")
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv

@@ -738,6 +738,9 @@ class SnapshotPacker:
         self.vol_state = VolumeState()
         self._vol_pods: Dict[tuple, Pod] = {}
         self._vol_cache: Dict[tuple, ResolvedVolumes] = {}
+        # per-pod resource vectors (R-dependent; recomputed when the scalar
+        # universe grows) feeding the native usage aggregation
+        self._vec_cache: Dict[tuple, Tuple[int, np.ndarray, np.ndarray]] = {}
 
     # -- volume state ------------------------------------------------------
 
@@ -763,9 +766,29 @@ class SnapshotPacker:
         the caches (and set_volume_state doesn't re-resolve dead pods)
         forever. Universe tokens stay — interners are append-only by design
         (bucketed widths make stale entries cheap)."""
-        for cache in (self._pod_refs, self._vol_cache, self._vol_pods):
+        for cache in (self._pod_refs, self._vol_cache, self._vol_pods,
+                      self._vec_cache):
             for k in [k for k in cache if k[0] == pod_key]:
                 del cache[k]
+
+    def _pod_vectors(self, pods: Sequence[Pod], R: int):
+        """(P, R) request matrix + (P, 2) nonzero matrix, cached per pod
+        (invalidated when the resource universe width changes)."""
+        req = np.zeros((len(pods), R), np.float32)
+        nz = np.zeros((len(pods), 2), np.float32)
+        for idx, p in enumerate(pods):
+            ck = (p.key(), p.uid)
+            ent = self._vec_cache.get(ck)
+            if ent is None or ent[0] != R:
+                ent = (
+                    R,
+                    self.u.resource_vector(p.effective_requests(), R),
+                    np.asarray(p.nonzero_requests(), np.float32),
+                )
+                self._vec_cache[ck] = ent
+            req[idx] = ent[1]
+            nz[idx] = ent[2]
+        return req, nz
 
     # -- interning ---------------------------------------------------------
 
@@ -959,16 +982,30 @@ class SnapshotPacker:
                     csi_limit[i, d] = lim
 
         # aggregate scheduled pods into node usage (NodeInfo.AddPod,
-        # node_info.go — requested, nonzeroRequest, usedPorts, pod count)
-        for p in scheduled_pods:
-            nid = u.node_names.lookup(p.node_name)
-            i = row_of.get(nid)
-            if i is None:
+        # node_info.go — requested, nonzeroRequest, usedPorts, pod count).
+        # The resource columns — every pod contributes, dominating full
+        # repacks at scale — scatter-add through the native kernel
+        # (native/ktpu.cc aggregate_usage) with cached per-pod vectors;
+        # the sparse attributes (ports/owners/matchers/affinity/volumes)
+        # stay in Python, gated so pods without them cost nothing.
+        from kubernetes_tpu import native
+
+        pod_rows = np.fromiter(
+            (
+                row_of.get(u.node_names.lookup(p.node_name), -1)
+                for p in scheduled_pods
+            ),
+            np.int32,
+            count=len(scheduled_pods),
+        )
+        req_mat, nz_mat = self._pod_vectors(scheduled_pods, R)
+        native.aggregate_usage(req_mat, nz_mat, pod_rows, requested, nonzero_req)
+
+        has_matchers = bool(u.pod_matcher_items)
+        has_owners = bool(u.owner_set_items)
+        for p, i in zip(scheduled_pods, pod_rows):
+            if i < 0:
                 continue
-            requested[i] += self.u.resource_vector(p.effective_requests(), R)
-            nz_cpu, nz_mem = p.nonzero_requests()
-            nonzero_req[i, 0] += nz_cpu
-            nonzero_req[i, 1] += nz_mem
             for proto, ip, port in p.host_ports:
                 ppi = u.ports_pp.intern((proto, port))
                 port_any[i, ppi] = 1
@@ -979,15 +1016,17 @@ class SnapshotPacker:
             # owner_counts: for SelectorSpread we need, per owner-set, how
             # many *matching* scheduled pods sit on each node. A scheduled
             # pod contributes to owner set `o` if it matches o's selectors.
-            for o in _matching_owner_sets(u, p):
-                owner_counts[i, o] += 1
+            if has_owners:
+                for o in _matching_owner_sets(u, p):
+                    owner_counts[i, o] += 1
             # inter-pod affinity / spread count matrices
-            matcher_counts[i] += self.u.pod_matcher_row(p, w["M"])
-            for a in u.pod_anti_term_ids(p):
-                anti_counts[i, a] += 1
-            for s in u.pod_sym_term_ids(p):
-                sym_counts[i, s] += 1
+            if has_matchers:
+                matcher_counts[i] += self.u.pod_matcher_row(p, w["M"])
             if _pod_has_affinity(p):
+                for a in u.pod_anti_term_ids(p):
+                    anti_counts[i, a] += 1
+                for s in u.pod_sym_term_ids(p):
+                    sym_counts[i, s] += 1
                 aff_pod_count[i] += 1
             if p.volumes:
                 rv = self.resolve_volumes(p)

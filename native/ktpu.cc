@@ -1,0 +1,115 @@
+// Native host-side kernels for the TPU batch scheduler.
+//
+// The reference's "native layer" is the Go runtime itself (SURVEY.md §2.3:
+// no C/C++/CUDA beyond build/pause/pause.c); ours splits between XLA (the
+// device compute path) and this library (the host runtime hot spots):
+//
+//  - hungarian_solve: exact rectangular assignment (shortest augmenting
+//    path with potentials, O(P²·S)) — the optimal-transport counterpart to
+//    the device's auction rounds, used for contended/gang batches where
+//    solution quality is worth an exact solve (SURVEY.md §7.2 step 5).
+//  - aggregate_usage: scatter-add of per-pod resource vectors into the
+//    columnar node usage arrays — the inner loop of snapshot packing
+//    (NodeInfo.AddPod, nodeinfo/node_info.go), which dominates full
+//    repacks at 5k nodes / 30k pods when done in Python.
+//
+// Exposed as a plain C ABI consumed via ctypes (kubernetes_tpu/native.py).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <vector>
+
+extern "C" {
+
+// Exact max-score rectangular assignment.
+//   score:   row-major (n_rows x n_cols); entries <= -1e29 mean infeasible.
+//   row_to_col: out, length n_rows; -1 = left unassigned (no feasible col
+//               or the optimum leaves the row out).
+// Each column holds at most one row. Rows that cannot be feasibly placed
+// never steal a column from rows that can (infeasible edges cost BIG).
+void hungarian_solve(int32_t n_rows, int32_t n_cols, const float* score,
+                     int32_t* row_to_col) {
+  const double BIG = 1e12;  // cost of an infeasible edge
+  const double INF = std::numeric_limits<double>::infinity();
+  // minimize cost = -score (shift not needed for correctness of argmin)
+  // potentials u[row], v[col]; match[col] = row matched to col (1-based 0)
+  std::vector<double> u(n_rows + 1, 0.0), v(n_cols + 1, 0.0);
+  std::vector<int32_t> match(n_cols + 1, 0);  // 0 = free
+  std::vector<int32_t> way(n_cols + 1, 0);
+
+  auto cost_at = [&](int32_t r, int32_t c) -> double {
+    float s = score[(int64_t)r * n_cols + c];
+    if (s <= -1e29f) return BIG;
+    return -(double)s;
+  };
+
+  for (int32_t r = 1; r <= n_rows; ++r) {
+    // Dijkstra-like shortest augmenting path from row r over cols.
+    std::vector<double> minv(n_cols + 1, INF);
+    std::vector<char> used(n_cols + 1, 0);
+    int32_t j0 = 0;
+    match[0] = r;
+    do {
+      used[j0] = 1;
+      int32_t i0 = match[j0], j1 = 0;
+      double delta = INF;
+      for (int32_t j = 1; j <= n_cols; ++j) {
+        if (used[j]) continue;
+        double cur = cost_at(i0 - 1, j - 1) - u[i0] - v[j];
+        if (cur < minv[j]) {
+          minv[j] = cur;
+          way[j] = j0;
+        }
+        if (minv[j] < delta) {
+          delta = minv[j];
+          j1 = j;
+        }
+      }
+      for (int32_t j = 0; j <= n_cols; ++j) {
+        if (used[j]) {
+          u[match[j]] += delta;
+          v[j] -= delta;
+        } else {
+          minv[j] -= delta;
+        }
+      }
+      j0 = j1;
+    } while (match[j0] != 0);
+    // augment along the alternating path
+    do {
+      int32_t j1 = way[j0];
+      match[j0] = match[j1];
+      j0 = j1;
+    } while (j0);
+  }
+
+  for (int32_t r = 0; r < n_rows; ++r) row_to_col[r] = -1;
+  for (int32_t j = 1; j <= n_cols; ++j) {
+    int32_t r = match[j];
+    if (r > 0 && cost_at(r - 1, j - 1) < BIG) row_to_col[r - 1] = j - 1;
+  }
+}
+
+// Scatter-add pod resource vectors into node usage columns.
+//   pod_req:     (n_pods x n_res) f32
+//   pod_nz:      (n_pods x 2) f32  (nonzero cpu/mem for scoring)
+//   pod_row:     (n_pods) i32 node row per pod; <0 = skip
+//   out_req:     (n_nodes x n_res) f32, accumulated in place
+//   out_nz:      (n_nodes x 2) f32
+void aggregate_usage(int32_t n_pods, int32_t n_res, const float* pod_req,
+                     const float* pod_nz, const int32_t* pod_row,
+                     int32_t n_nodes, float* out_req, float* out_nz) {
+  for (int32_t p = 0; p < n_pods; ++p) {
+    int32_t r = pod_row[p];
+    if (r < 0 || r >= n_nodes) continue;
+    const float* src = pod_req + (int64_t)p * n_res;
+    float* dst = out_req + (int64_t)r * n_res;
+    for (int32_t k = 0; k < n_res; ++k) dst[k] += src[k];
+    out_nz[(int64_t)r * 2 + 0] += pod_nz[(int64_t)p * 2 + 0];
+    out_nz[(int64_t)r * 2 + 1] += pod_nz[(int64_t)p * 2 + 1];
+  }
+}
+
+}  // extern "C"

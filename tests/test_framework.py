@@ -336,7 +336,7 @@ def test_delete_of_permit_parked_pod_frees_capacity():
             self.unreserved = pod.key()
 
     gate = Gate()
-    s, _ = sched_with([Gate() if False else gate])
+    s, _ = sched_with([gate])
     s.on_node_add(make_node("n0", cpu_milli=1000))
     parked = make_pod("parked", cpu_milli=900)
     s.on_pod_add(parked)

@@ -1,0 +1,259 @@
+"""Integration-shim tests: extender protocol (both directions, over real
+HTTP like test/integration/scheduler/extender_test.go), metrics exposition,
+event aggregation, operation tracing, and leader election."""
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubernetes_tpu.config import ExtenderConfig, LeaderElectionConfig
+from kubernetes_tpu.events import REASON_FAILED, REASON_SCHEDULED, EventRecorder
+from kubernetes_tpu.extender import HTTPExtender, build_extenders
+from kubernetes_tpu.leaderelection import InMemoryLock, LeaderElector
+from kubernetes_tpu.metrics import SchedulerMetrics
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+from kubernetes_tpu.utils.trace import Trace
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# a tiny extender webhook (the fake extender of extender_test.go)
+# ---------------------------------------------------------------------------
+
+
+def start_fake_extender(filter_fn=None, prioritize_fn=None, bind_log=None):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n).decode())
+            verb = self.path.strip("/")
+            if verb == "filter":
+                out = filter_fn(payload)
+            elif verb == "prioritize":
+                out = prioritize_fn(payload)
+            elif verb == "bind":
+                bind_log.append(payload)
+                out = {"error": ""}
+            else:
+                out = {"error": f"bad verb {verb}"}
+            body = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def test_extender_filter_prioritize_bind_over_http():
+    bind_log = []
+
+    def filt(payload):
+        # reject node n0; wire shape: nodeCacheCapable name lists
+        names = [n for n in payload["nodenames"] if n != "n0"]
+        return {
+            "nodenames": names,
+            "failedNodes": {"n0": "extender says no"},
+            "error": "",
+        }
+
+    def prio(payload):
+        return [
+            {"host": n, "score": 10 if n == "n2" else 1}
+            for n in payload["nodenames"]
+        ]
+
+    srv, url = start_fake_extender(filt, prio, bind_log)
+    try:
+        cfgs = [ExtenderConfig(
+            url_prefix=url, filter_verb="filter", prioritize_verb="prioritize",
+            bind_verb="bind", weight=5, node_cache_capable=True,
+        )]
+        s = Scheduler(
+            extenders=build_extenders(cfgs), clock=FakeClock(),
+            enable_preemption=False,
+        )
+        for i in range(3):
+            s.on_node_add(make_node(f"n{i}"))
+        s.on_pod_add(make_pod("p0"))
+        res = s.schedule_cycle()
+        # filter removed n0; prioritize (weight 5) pushes n2 over n1
+        assert res.assignments["default/p0"] == "n2"
+        # the binder-extender took the binding: default binder untouched
+        assert s.binder.bindings == []
+        assert bind_log and bind_log[0]["node"] == "n2"
+        assert bind_log[0]["podName"] == "p0"
+    finally:
+        srv.shutdown()
+
+
+def test_extender_error_policy():
+    # unreachable extender: ignorable -> scheduling proceeds; otherwise the
+    # pod fails with an Extender reason
+    cfg_bad = ExtenderConfig(url_prefix="http://127.0.0.1:9", filter_verb="filter",
+                             http_timeout_s=0.2)
+    s = Scheduler(extenders=build_extenders([cfg_bad]), clock=FakeClock(),
+                  enable_preemption=False)
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("p0"))
+    res = s.schedule_cycle()
+    assert res.scheduled == 0
+    assert any("Extender:" in r for r in res.failure_reasons["default/p0"])
+
+    cfg_ign = ExtenderConfig(url_prefix="http://127.0.0.1:9", filter_verb="filter",
+                             http_timeout_s=0.2, ignorable=True)
+    s2 = Scheduler(extenders=build_extenders([cfg_ign]), clock=FakeClock(),
+                   enable_preemption=False)
+    s2.on_node_add(make_node("n0"))
+    s2.on_pod_add(make_pod("p0"))
+    res2 = s2.schedule_cycle()
+    assert res2.scheduled == 1
+
+
+def test_extender_managed_resources_gate_interest():
+    ext = HTTPExtender(ExtenderConfig(
+        url_prefix="http://x", managed_resources=("example.com/gpu",)
+    ))
+    assert not ext.is_interested(make_pod("plain"))
+    assert ext.is_interested(make_pod("gpu", scalars={"example.com/gpu": 1}))
+
+
+# ---------------------------------------------------------------------------
+# serving the framework AS an extender (the reverse seam)
+# ---------------------------------------------------------------------------
+
+
+def test_extender_server_reverse_seam():
+    from kubernetes_tpu.server import ExtenderServer, serve_scheduler
+
+    s = Scheduler(clock=FakeClock(), enable_preemption=False)
+    s.on_node_add(make_node("big", cpu_milli=32000))
+    s.on_node_add(make_node("small", cpu_milli=200))
+    srv = serve_scheduler(s, extender=ExtenderServer(s))
+    try:
+        port = srv.server_address[1]
+
+        def post(verb, payload):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/{verb}",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read().decode())
+
+        # a Go scheduler would POST exactly this shape
+        args = {
+            "pod": {
+                "metadata": {"name": "w", "namespace": "default"},
+                "spec": {"containers": [
+                    {"resources": {"requests": {"cpu": "1000m", "memory": "1Gi"}}}
+                ]},
+            },
+            "nodenames": ["big", "small"],
+        }
+        out = post("filter", args)
+        assert out["nodenames"] == ["big"]
+        assert "PodFitsResources" in out["failedNodes"]["small"]
+        scores = post("prioritize", args)
+        assert {h["host"] for h in scores} == {"big", "small"}
+
+        # healthz + metrics ride the same server
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=60) as r:
+            assert r.read() == b"ok"
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=60) as r:
+            text = r.read().decode()
+        assert "scheduler_schedule_attempts_total" in text
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# metrics / events / trace / leader election units
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_recorded_by_cycle():
+    clk = FakeClock()
+    s = Scheduler(clock=clk, enable_preemption=False)
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    s.on_pod_add(make_pod("ok", cpu_milli=100))
+    s.on_pod_add(make_pod("big", cpu_milli=5000))
+    s.schedule_cycle()
+    m = s.metrics
+    assert m.schedule_attempts.value(result="scheduled") == 1
+    assert m.schedule_attempts.value(result="unschedulable") == 1
+    assert m.e2e_scheduling_duration.count() == 1
+    assert m.pending_pods.value(queue="unschedulable") == 1
+    text = m.registry.expose()
+    assert 'scheduler_schedule_attempts_total{result="scheduled"} 1' in text
+    assert "scheduler_e2e_scheduling_duration_seconds_bucket" in text
+
+
+def test_event_recorder_aggregates():
+    clk = FakeClock()
+    rec = EventRecorder(clock=clk)
+    s = Scheduler(clock=clk, enable_preemption=False, event_sink=rec.sink())
+    s.on_node_add(make_node("n0", cpu_milli=100))
+    s.on_pod_add(make_pod("big", cpu_milli=5000))
+    s.schedule_cycle()
+    clk.t += 120
+    s.queue.move_all_to_active()
+    s.schedule_cycle()
+    evs = rec.events("default/big")
+    assert len(evs) == 1 and evs[0].reason == REASON_FAILED and evs[0].count == 2
+    s.on_pod_add(make_pod("ok", cpu_milli=10))
+    s.schedule_cycle()
+    assert rec.events("default/ok")[0].reason == REASON_SCHEDULED
+
+
+def test_trace_log_if_long():
+    clk = FakeClock()
+    tr = Trace("op", clock=clk, pod="x")
+    clk.t += 0.02
+    tr.step("fast part")
+    clk.t += 0.2
+    tr.step("slow part")
+    text = tr.log_if_long(0.1)
+    assert text and "slow part" in text and "op" in text
+    tr2 = Trace("quick", clock=clk)
+    assert tr2.log_if_long(0.1) is None
+
+
+def test_leader_election_failover():
+    clk = FakeClock()
+    lock = InMemoryLock()
+    cfg = LeaderElectionConfig(lease_duration_s=15)
+    events = []
+    a = LeaderElector("a", lock, cfg, clk,
+                      on_started_leading=lambda: events.append("a+"),
+                      on_stopped_leading=lambda: events.append("a-"))
+    b = LeaderElector("b", lock, cfg, clk,
+                      on_started_leading=lambda: events.append("b+"))
+    assert a.tick() and a.is_leader()
+    assert not b.tick() and not b.is_leader()  # lease held by a
+    clk.t += 10
+    assert a.tick()  # renew
+    assert not b.tick()
+    # a dies; b waits out the full lease from its last observation
+    clk.t += 14
+    assert not b.tick()
+    clk.t += 2  # now past a's lease
+    assert b.tick() and b.is_leader()
+    assert events == ["a+", "b+"]
+    rec = lock.get()
+    assert rec.holder_identity == "b" and rec.leader_transitions == 1
