@@ -295,6 +295,13 @@ class Pod:
     spread_selectors: Tuple[LabelSelector, ...] = ()
     #: gang/coscheduling group (PodGroup); empty = no gang.
     pod_group: str = ""
+    #: PodGroup minMember: the group schedules only when at least this many
+    #: members are present AND all present members place together. 0 =
+    #: all-present-members atomicity only (single-batch gangs). Declaring
+    #: the true group size makes atomicity hold across batches: a straggler
+    #: group fragment (late arrival, backoff desync, max_batch split) rolls
+    #: back instead of binding partially.
+    pod_group_min_available: int = 0
     #: UID of the controller ownerReference (RC/RS), feeds
     #: NodePreferAvoidPodsPriority (node_prefer_avoid_pods.go).
     owner_uid: str = ""

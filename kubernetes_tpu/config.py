@@ -101,7 +101,11 @@ class KubeSchedulerConfiguration:
     algorithm_provider: str = "DefaultProvider"
     policy: Optional["Policy"] = None  # overrides algorithm_provider
     hard_pod_affinity_symmetric_weight: int = 1
-    percentage_of_nodes_to_score: int = 0  # 0 = adaptive default (50->5%)
+    #: 100 = score every node (this framework's default: the dense batch
+    #: solver evaluates all nodes in one fused pass, so the reference's
+    #: default subsampling would only hurt quality); 0 = the reference's
+    #: adaptive 50%->5% rule (parity runs); 1-99 = fixed percent.
+    percentage_of_nodes_to_score: int = 100
     bind_timeout_seconds: float = 600.0
     leader_election: LeaderElectionConfig = field(default_factory=LeaderElectionConfig)
     feature_gates: FeatureGates = field(default_factory=FeatureGates)

@@ -212,10 +212,11 @@ def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray
     return excl - excl[seg_starts]
 
 
-@partial(jax.jit, static_argnames=("weights_key", "max_rounds", "per_node_cap"))
+@partial(jax.jit, static_argnames=("weights_key", "max_rounds", "per_node_cap",
+                                   "use_sinkhorn"))
 def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
                 extra_mask, vol=None, static_vol=None, enabled_mask=None,
-                extra_score=None):
+                extra_score=None, use_sinkhorn=False):
     weights = dict(weights_key) if weights_key is not None else None
     P = pods.req.shape[0]
     perm = queue_order(pods)
@@ -258,7 +259,40 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
         if extra_score is not None:
             score = score + extra_score
         masked = jnp.where(mask, score, NEG)
-        choice = jnp.argmax(masked, axis=1).astype(jnp.int32)  # (P,)
+        if use_sinkhorn:
+            # choose from the entropic-OT transport plan instead of the raw
+            # per-pod argmax: the plan balances the whole batch against node
+            # capacities, so contended pods pre-spread instead of colliding
+            # (ops/sinkhorn.py; SURVEY.md §7.2 step 5)
+            from kubernetes_tpu.ops.sinkhorn import sinkhorn_plan
+            from kubernetes_tpu.snapshot import RES_PODS
+
+            # column capacity: how many ACTIVE pods could land on each node,
+            # bounded per resource by the smallest active request — the pod
+            # count column alone (~110/node) almost never binds, which would
+            # degrade the plan to a per-row softmax with no pre-spreading
+            free = jnp.maximum(nodes.allocatable - u.requested, 0.0)  # (N, R)
+            min_req = jnp.min(
+                jnp.where(
+                    active[:, None] & (pods.req > 0), pods.req, jnp.inf
+                ),
+                axis=0,
+            )  # (R,)
+            per_res = jnp.where(
+                jnp.isfinite(min_req),
+                jnp.floor(free / jnp.maximum(min_req, 1e-30)),
+                jnp.inf,
+            )
+            slots = jnp.min(per_res, axis=1)
+            slots = jnp.where(
+                jnp.isfinite(slots), slots, free[:, RES_PODS]
+            )
+            plan = sinkhorn_plan(masked, mask, slots)
+            choice = jnp.argmax(
+                jnp.where(mask, plan, -1.0), axis=1
+            ).astype(jnp.int32)
+        else:
+            choice = jnp.argmax(masked, axis=1).astype(jnp.int32)  # (P,)
         feasible = jnp.take_along_axis(mask, choice[:, None], axis=1)[:, 0]
         choice = jnp.where(feasible, choice, -1)
 
@@ -363,6 +397,7 @@ def batch_assign(
     static_vol: Optional[jnp.ndarray] = None,
     enabled_mask: Optional[int] = None,
     extra_score: Optional[jnp.ndarray] = None,
+    use_sinkhorn: bool = False,
 ) -> Tuple[jnp.ndarray, UsageState, jnp.ndarray]:
     """Fast batched solver. Returns (assigned row per pod or -1, final
     usage, rounds executed). ``per_node_cap`` bounds admissions per node per
@@ -375,4 +410,5 @@ def batch_assign(
             (pods.req.shape[0], nodes.allocatable.shape[0]), bool
         )
     return _batch_impl(pods, nodes, sel, topo, key, max_rounds, per_node_cap,
-                       extra_mask, vol, static_vol, enabled_mask, extra_score)
+                       extra_mask, vol, static_vol, enabled_mask, extra_score,
+                       use_sinkhorn)

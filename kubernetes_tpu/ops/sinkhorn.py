@@ -1,0 +1,180 @@
+"""Sinkhorn optimal-transport scoring for batched/gang assignment
+(SURVEY.md §2.4/§7.2 step 5: "Sinkhorn optimal-transport / auction
+algorithm for gang & global assignment (PodGroup config)").
+
+The round solver's per-pod argmax is myopic: every pod bids its best node
+regardless of global contention. The entropic-OT plan instead balances the
+whole batch against node capacities — pod p's row of the transport plan
+already discounts nodes other pods need more — so argmax-of-plan choices
+collide far less and pack gangs coherently.
+
+Formulation: unbalanced entropic OT with
+  - row marginals: each schedulable pod ships (at most) mass 1,
+  - column marginals: node j receives AT MOST ``capacity_j`` (inequality —
+    the column scaling only ever scales *down*, the standard unbalanced
+    Sinkhorn treatment of capacity upper bounds),
+  - kernel K = exp(score/eps) on feasible (pod, node) pairs.
+
+Iterations run in log space for stability. Two implementations: pure jnp
+(`_scale_jnp`, differentiable, any backend) and a Pallas TPU kernel pair
+(`_scale_pallas`) that tiles the (P, N) log-kernel through VMEM — row and
+column logsumexp reductions each fused into one pass per iteration
+(pallas_guide.md patterns; selected via ``use_pallas``/KTPU_PALLAS)."""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _row_lse(logk: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    return jax.scipy.special.logsumexp(logk + v[None, :], axis=1)
+
+
+def _col_lse(logk: jnp.ndarray, u: jnp.ndarray) -> jnp.ndarray:
+    return jax.scipy.special.logsumexp(logk + u[:, None], axis=0)
+
+
+def _scale_jnp(logk, log_r, log_c, iters):
+    """Alternating log-domain scaling; columns clipped at 0 (inequality)."""
+
+    def body(carry, _):
+        u, v = carry
+        u = log_r - _row_lse(logk, v)
+        u = jnp.where(jnp.isfinite(u), u, NEG_INF)
+        v = jnp.minimum(log_c - _col_lse(logk, u), 0.0)
+        v = jnp.where(jnp.isfinite(v), v, 0.0)
+        return (u, v), None
+
+    P, N = logk.shape
+    (u, v), _ = jax.lax.scan(
+        body, (jnp.zeros((P,)), jnp.zeros((N,))), None, length=iters
+    )
+    return u, v
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernels: tiled row/column logsumexp scaling passes
+# ---------------------------------------------------------------------------
+
+
+def _u_kernel(logk_ref, v_ref, logr_ref, u_ref):
+    """One row-scaling pass over a (Bp, N) tile: u = log_r - lse(logk+v)."""
+    x = logk_ref[:] + v_ref[:]  # (Bp, N)
+    m = jnp.max(x, axis=1, keepdims=True)
+    m = jnp.maximum(m, NEG_INF)  # all-masked rows stay finite
+    lse = jnp.log(jnp.sum(jnp.exp(x - m), axis=1, keepdims=True) + 1e-30) + m
+    u = logr_ref[:] - lse[:, 0]
+    u_ref[:] = jnp.where(u > NEG_INF / 2, u, NEG_INF)
+
+
+def _v_kernel(logk_ref, u_ref, logc_ref, v_ref):
+    """One column-scaling pass over a (P, Bn) tile, clipped at 0."""
+    x = logk_ref[:] + u_ref[:][:, None]  # (P, Bn)
+    m = jnp.max(x, axis=0, keepdims=True)
+    m = jnp.maximum(m, NEG_INF)
+    lse = jnp.log(jnp.sum(jnp.exp(x - m), axis=0, keepdims=True) + 1e-30) + m
+    v = jnp.minimum(logc_ref[:] - lse[0, :], 0.0)
+    v_ref[:] = jnp.where(v > NEG_INF / 2, v, 0.0)
+
+
+def _scale_pallas(logk, log_r, log_c, iters, block_p=256, block_n=512,
+                  interpret=False):
+    from jax.experimental import pallas as pl
+
+    P0, N0 = logk.shape
+    bp, bn = min(block_p, P0), min(block_n, N0)
+    # pad to block multiples (grid uses exact division); padded rows ship
+    # nothing (log_r = -inf) and padded columns accept nothing (their
+    # kernel column is -inf so their v never matters)
+    P = ((P0 + bp - 1) // bp) * bp
+    N = ((N0 + bn - 1) // bn) * bn
+    if (P, N) != (P0, N0):
+        logk = jnp.pad(logk, ((0, P - P0), (0, N - N0)),
+                       constant_values=NEG_INF)
+        log_r = jnp.pad(log_r, (0, P - P0), constant_values=NEG_INF)
+        log_c = jnp.pad(log_c, (0, N - N0), constant_values=NEG_INF)
+    u_call = pl.pallas_call(
+        _u_kernel,
+        grid=(P // bp,),
+        in_specs=[
+            pl.BlockSpec((bp, N), lambda i: (i, 0)),
+            pl.BlockSpec((N,), lambda i: (0,)),
+            pl.BlockSpec((bp,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((bp,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((P,), logk.dtype),
+        interpret=interpret,
+    )
+    v_call = pl.pallas_call(
+        _v_kernel,
+        grid=(N // bn,),
+        in_specs=[
+            pl.BlockSpec((P, bn), lambda j: (0, j)),
+            pl.BlockSpec((P,), lambda j: (0,)),
+            pl.BlockSpec((bn,), lambda j: (j,)),
+        ],
+        out_specs=pl.BlockSpec((bn,), lambda j: (j,)),
+        out_shape=jax.ShapeDtypeStruct((N,), logk.dtype),
+        interpret=interpret,
+    )
+
+    def body(carry, _):
+        u, v = carry
+        u = u_call(logk, v, log_r)
+        v = v_call(logk, u, log_c)
+        return (u, v), None
+
+    (u, v), _ = jax.lax.scan(
+        body, (jnp.zeros((P,), logk.dtype), jnp.zeros((N,), logk.dtype)),
+        None, length=iters,
+    )
+    return u[:P0], v[:N0]
+
+
+def use_pallas() -> bool:
+    """Pallas path policy: on by default on real TPU, opt-in elsewhere
+    (KTPU_PALLAS=1 forces interpret-mode execution for testing)."""
+    env = os.environ.get("KTPU_PALLAS", "")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def sinkhorn_plan(
+    score: jnp.ndarray,
+    mask: jnp.ndarray,
+    capacity: jnp.ndarray,
+    eps: float = 0.5,
+    iters: int = 25,
+    pallas: Optional[bool] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Transport plan (P, N): plan[p, j] ≈ how much of pod p's unit demand
+    node j serves at equilibrium. Row sums <= 1 (== 1 when the pod fits
+    anywhere with spare capacity); column sums <= capacity + O(tolerance).
+    """
+    score = score.astype(jnp.float32)
+    row_ok = jnp.any(mask, axis=1)
+    logk = jnp.where(mask, score / eps, NEG_INF)
+    log_r = jnp.where(row_ok, 0.0, NEG_INF)  # demand 1 per schedulable pod
+    log_c = jnp.where(capacity > 0, jnp.log(jnp.maximum(capacity, 1e-30)), NEG_INF)
+    if pallas is None:
+        pallas = use_pallas()
+    if pallas:
+        interp = (jax.default_backend() != "tpu") if interpret is None else interpret
+        u, v = _scale_pallas(logk, log_r, log_c, iters, interpret=interp)
+    else:
+        u, v = _scale_jnp(logk, log_r, log_c, iters)
+    plan = jnp.exp(
+        jnp.clip(logk + u[:, None] + v[None, :], NEG_INF, 30.0)
+    )
+    return jnp.where(mask, plan, 0.0)

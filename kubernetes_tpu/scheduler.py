@@ -142,9 +142,11 @@ class Scheduler:
         extenders=(),
         metrics=None,
         trace_threshold_s: float = 1.0,
+        percentage_of_nodes_to_score: Optional[int] = None,
     ) -> None:
         from kubernetes_tpu.framework import Framework
         from kubernetes_tpu.metrics import SchedulerMetrics
+        from kubernetes_tpu.nodetree import NodeTree
 
         self.framework = framework or Framework(clock=clock)
         #: HTTPExtender list (core/extender.go), called after the built-in
@@ -164,6 +166,14 @@ class Scheduler:
         self.queue = queue or SchedulingQueue(
             clock=clock, less=self.framework.queue_sort_less()
         )
+        #: node-search truncation (percentageOfNodesToScore): None =
+        #: evaluate every node (the dense solver's natural mode); 0 =
+        #: the reference's adaptive 50%→5% rule; 1-99 = fixed percent.
+        #: Truncated cycles restrict the solve to the next K nodes in
+        #: zone round-robin order (NodeTree) so consecutive cycles sweep
+        #: different zones, like the reference's resumable enumeration.
+        self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
+        self.node_tree = NodeTree()
         self.binder = binder or RecordingBinder()
         self.weights = weights
         self.solver = solver
@@ -209,6 +219,15 @@ class Scheduler:
         kw.setdefault("per_node_cap", cfg.per_node_cap)
         kw.setdefault("max_rounds", cfg.max_rounds)
         kw.setdefault("max_batch", cfg.max_batch)
+        # 100 (the config default) = no truncation; 0 = the reference's
+        # adaptive rule; 1-99 fixed — passed through verbatim so the
+        # adaptive mode stays expressible from config
+        kw.setdefault(
+            "percentage_of_nodes_to_score",
+            None
+            if cfg.percentage_of_nodes_to_score >= 100
+            else cfg.percentage_of_nodes_to_score,
+        )
         return cls(**kw)
 
     # -- ingestion (AddAllEventHandlers analog; the informer pump or test
@@ -257,13 +276,21 @@ class Scheduler:
 
     def on_node_add(self, node) -> None:
         self.cache.add_node(node)
+        self.node_tree.add_node(node)
         self.queue.move_all_to_active()
 
     def on_node_update(self, node) -> None:
+        old = self.cache.node(node.name)
+        if old is not None:
+            self.node_tree.remove_node(old)
         self.cache.update_node(node)
+        self.node_tree.add_node(node)
         self.queue.move_all_to_active()
 
     def on_node_delete(self, name: str) -> None:
+        old = self.cache.node(name)
+        if old is not None:
+            self.node_tree.remove_node(old)
         self.cache.remove_node(name)
 
     def set_volume_state(self, pvcs=(), pvs=(), classes=()) -> None:
@@ -385,6 +412,22 @@ class Scheduler:
                     else extra_score + jnp.asarray(hs)
                 )
 
+        # node-search truncation: restrict this cycle's solve to the next
+        # K nodes in zone rotation (numFeasibleNodesToFind semantics)
+        if self.percentage_of_nodes_to_score is not None:
+            from kubernetes_tpu.nodetree import num_feasible_nodes_to_find
+
+            k = num_feasible_nodes_to_find(
+                nt.n, self.percentage_of_nodes_to_score
+            )
+            if k < nt.n:
+                subset = set(self.node_tree.take(k))
+                col = np.zeros((dn.valid.shape[0],), bool)
+                for j, name in enumerate(node_order):
+                    col[j] = name in subset
+                cm = jnp.asarray(col)[None, :]
+                fw_mask = cm if fw_mask is None else (fw_mask & cm)
+
         # one shared built-in filter pass against the initial usage, used
         # by the extender path and the exact solver (avoid re-evaluating)
         base_fr = None
@@ -448,8 +491,39 @@ class Scheduler:
                 static_vol=sv,
                 enabled_mask=self.pred_mask,
                 extra_score=extra_score,
+                use_sinkhorn=(self.solver == "sinkhorn"),
             )
-        assigned = np.asarray(assigned)[: len(batch)]
+        assigned = np.array(assigned)[: len(batch)]  # writable copy
+
+        # gang scheduling (PodGroup all-or-nothing; the coscheduling-plugin
+        # semantics BASELINE config 4 targets): a group binds only when ALL
+        # its present members placed AND at least minMember members are
+        # present (pod_group_min_available — guards against group fragments
+        # straddling batches); otherwise every member rolls back
+        gang_failed: Dict[int, str] = {}
+        gang_groups: Dict[str, List[int]] = {}
+        for gi, gp in enumerate(batch):
+            if gp.pod_group:
+                gang_groups.setdefault(gp.pod_group, []).append(gi)
+        for gname, idxs in gang_groups.items():
+            need = max([batch[gi].pod_group_min_available for gi in idxs] + [0])
+            incomplete = len(idxs) < need or any(assigned[gi] < 0 for gi in idxs)
+            if incomplete:
+                for gi in idxs:
+                    if assigned[gi] >= 0:
+                        assigned[gi] = -1
+                        gang_failed[gi] = f"GangIncomplete:{gname}"
+        if gang_failed:
+            # rebuild usage from the FINAL assignment: the solver's usage
+            # still contains the rolled-back members, and phantom occupancy
+            # would poison the failure-reason pass and preemption
+            pad_assigned = np.full((dp.valid.shape[0],), -1, np.int64)
+            pad_assigned[: len(batch)] = assigned
+            usage = _apply_batch(
+                usage_from_nodes(dn), dp,
+                jnp.asarray(np.maximum(pad_assigned, 0)),
+                jnp.asarray(pad_assigned >= 0) & dp.valid,
+            )
         res.rounds = int(rounds) if self.solver != "greedy" else rounds
         solve_s = trace.total_s()
         trace.step(f"solve done ({res.rounds} rounds)")
@@ -476,9 +550,12 @@ class Scheduler:
         for i, pod in enumerate(batch):
             target = int(assigned[i])
             if target < 0:
-                reasons = (
-                    (early_fail[i],) if i in early_fail else reasons_row.get(i, ())
-                )
+                if i in early_fail:
+                    reasons = (early_fail[i],)
+                elif i in gang_failed:
+                    reasons = (gang_failed[i],)
+                else:
+                    reasons = reasons_row.get(i, ())
                 self._fail(pod, cycle, res, reasons)
                 continue
             node_name = node_order[target]
@@ -513,9 +590,10 @@ class Scheduler:
 
         # preemption (scheduler.go:493 -> preempt, §3.3): failed pods try to
         # evict lower-priority pods; winners get a nominated node and retry
-        if self.enable_preemption and failed_idx and rmat is not None:
+        preemptable_idx = [i for i in failed_idx if i not in gang_failed]
+        if self.enable_preemption and preemptable_idx and rmat is not None:
             pt0 = self.clock()
-            self._run_preemption(batch, failed_idx, rmat, node_order, res)
+            self._run_preemption(batch, preemptable_idx, rmat, node_order, res)
             self.metrics.preemption_duration.observe(self.clock() - pt0)
             trace.step(f"preemption ({res.preempted} victims)")
         res.elapsed_s = self.clock() - t0

@@ -1,0 +1,193 @@
+"""Hollow-cluster simulation — the kubemark analog (SURVEY.md §4 item d:
+"hollow-node-style simulation for end-to-end queue dynamics: churn,
+backoff, preemption").
+
+Where kubemark runs real kubelets with fake runtimes against a real
+control plane, this harness runs the real scheduler (queue, cache,
+solvers, preemption, volume state) against a simulated hub that owns the
+source of truth and feeds the scheduler's event handlers exactly like an
+informer pump: pod/node create/delete churn, flaky bindings, node
+flapping, replica controllers maintaining workloads. The cache-vs-truth
+comparer (``debugger.compare``) is the consistency oracle after every
+step."""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.debugger import compare
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+class SimClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FlakyBinder:
+    """Binder whose RPC fails with probability ``fail_rate`` — exercising
+    the Forget-and-requeue path (scheduler.go:447)."""
+
+    def __init__(self, hub: "HollowCluster", fail_rate: float, rng) -> None:
+        self.hub = hub
+        self.fail_rate = fail_rate
+        self.rng = rng
+        self.failures = 0
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        if self.rng.random() < self.fail_rate:
+            self.failures += 1
+            raise RuntimeError("simulated bind RPC failure")
+        self.hub.confirm_binding(pod, node_name)
+
+
+@dataclass
+class ReplicaSet:
+    """A hollow controller: keeps ``replicas`` pods named ``{name}-i``
+    alive (recreating deleted ones with fresh uids), the way the
+    replicaset controller reconciles."""
+
+    name: str
+    replicas: int
+    cpu_milli: float = 100
+    memory: float = 256 * 2**20
+    priority: int = 0
+    next_idx: int = 0
+    live: Dict[str, Pod] = field(default_factory=dict)
+
+
+class HollowCluster:
+    """Owns the truth (pods/nodes) and pumps watch events at the scheduler.
+    All scheduler interaction goes through the event-handler surface, like
+    the reference's AddAllEventHandlers wiring."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        bind_fail_rate: float = 0.0,
+        scheduler_kw: Optional[dict] = None,
+    ) -> None:
+        self.rng = random.Random(seed)
+        self.clock = SimClock()
+        self.truth_pods: Dict[str, Pod] = {}  # key -> pod (node_name = truth)
+        self.truth_nodes: Dict[str, Node] = {}
+        self.replicasets: Dict[str, ReplicaSet] = {}
+        self.binder = FlakyBinder(self, bind_fail_rate, self.rng)
+        self.sched = Scheduler(
+            binder=self.binder, clock=self.clock, **(scheduler_kw or {})
+        )
+        self.bound_total = 0
+
+    # -- truth mutations (each pumps the corresponding watch event) --------
+
+    def add_node(self, node: Node) -> None:
+        self.truth_nodes[node.name] = node
+        self.sched.on_node_add(node)
+
+    def remove_node(self, name: str) -> None:
+        """Node vanishes; its pods are lost and deleted by the hub (the
+        node-lifecycle/GC path, heavily simplified)."""
+        self.truth_nodes.pop(name, None)
+        for key, p in list(self.truth_pods.items()):
+            if p.node_name == name:
+                self.delete_pod(key)
+        self.sched.on_node_delete(name)
+
+    def create_pod(self, pod: Pod) -> None:
+        self.truth_pods[pod.key()] = pod
+        self.sched.on_pod_add(pod)
+
+    def delete_pod(self, key: str) -> None:
+        pod = self.truth_pods.pop(key, None)
+        if pod is not None:
+            self.sched.on_pod_delete(pod)
+            for rs in self.replicasets.values():
+                rs.live.pop(key, None)
+
+    def confirm_binding(self, pod: Pod, node_name: str) -> None:
+        """The apiserver accepted the binding: truth updates and the watch
+        event confirms the scheduler's assumption."""
+        old = self.truth_pods[pod.key()]
+        import dataclasses
+
+        new = dataclasses.replace(old, node_name=node_name)
+        self.truth_pods[pod.key()] = new
+        self.bound_total += 1
+        self.sched.on_pod_update(old, new)
+
+    # -- controllers / churn ------------------------------------------------
+
+    def add_replicaset(self, rs: ReplicaSet) -> None:
+        self.replicasets[rs.name] = rs
+
+    def reconcile_controllers(self) -> None:
+        for rs in self.replicasets.values():
+            while len(rs.live) < rs.replicas:
+                name = f"{rs.name}-{rs.next_idx}"
+                rs.next_idx += 1
+                pod = make_pod(
+                    name,
+                    cpu_milli=rs.cpu_milli,
+                    memory=rs.memory,
+                    priority=rs.priority,
+                    labels={"rs": rs.name},
+                )
+                pod.uid = f"{name}#{rs.next_idx}"
+                rs.live[pod.key()] = pod
+                self.create_pod(pod)
+
+    def churn(self, kill_pods: int = 0, flap_nodes: int = 0) -> None:
+        """Random disruption: delete bound pods, bounce nodes."""
+        bound = [k for k, p in self.truth_pods.items() if p.node_name]
+        for key in self.rng.sample(bound, min(kill_pods, len(bound))):
+            self.delete_pod(key)
+        names = list(self.truth_nodes)
+        for name in self.rng.sample(names, min(flap_nodes, len(names))):
+            self.remove_node(name)
+
+    # -- run ----------------------------------------------------------------
+
+    def step(self, dt: float = 15.0):
+        """One sim tick: reconcile controllers, run a scheduling cycle,
+        advance time (so backoffs expire across ticks)."""
+        self.reconcile_controllers()
+        res = self.sched.schedule_cycle()
+        self.clock.advance(dt)
+        return res
+
+    def check_consistency(self) -> None:
+        """Invariants after any step:
+        - cache matches truth (comparer),
+        - no node over-committed in truth (cpu/memory/pod count),
+        - every truth-bound pod landed on a live node."""
+        truth = {k: p.node_name for k, p in self.truth_pods.items()}
+        node_diffs, pod_diffs = compare(self.sched, truth, list(self.truth_nodes))
+        assert not node_diffs, f"cache/truth node diffs: {node_diffs}"
+        assert not pod_diffs, f"cache/truth pod diffs: {pod_diffs}"
+        by_node: Dict[str, List[Pod]] = {}
+        for p in self.truth_pods.values():
+            if p.node_name:
+                assert p.node_name in self.truth_nodes, (
+                    f"{p.key()} bound to dead node {p.node_name}"
+                )
+                by_node.setdefault(p.node_name, []).append(p)
+        for name, pods in by_node.items():
+            nd = self.truth_nodes[name]
+            cpu = sum(p.requests.cpu_milli for p in pods)
+            mem = sum(p.requests.memory for p in pods)
+            assert cpu <= nd.allocatable.cpu_milli + 1e-6, f"{name} cpu overcommit"
+            assert mem <= nd.allocatable.memory + 1e-6, f"{name} mem overcommit"
+            assert len(pods) <= nd.allocatable.pods, f"{name} pod-count overcommit"
+
+    def pending_count(self) -> int:
+        return sum(1 for p in self.truth_pods.values() if not p.node_name)

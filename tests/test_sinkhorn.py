@@ -1,0 +1,156 @@
+"""Sinkhorn OT solver + gang scheduling tests (BASELINE config 4:
+gang/coscheduling via batched Sinkhorn assignment)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops.sinkhorn import sinkhorn_plan
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+class FakeClock:
+    t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_plan_respects_marginals():
+    rng = np.random.RandomState(0)
+    P, N = 64, 16
+    score = rng.uniform(0, 10, (P, N)).astype(np.float32)
+    mask = rng.uniform(size=(P, N)) > 0.2
+    mask[5] = False  # one fully infeasible pod
+    cap = rng.randint(1, 8, N).astype(np.float32)
+    plan = np.asarray(sinkhorn_plan(jnp.asarray(score), jnp.asarray(mask),
+                                    jnp.asarray(cap), iters=60, pallas=False))
+    rows = plan.sum(1)
+    cols = plan.sum(0)
+    assert np.all(rows <= 1.0 + 1e-3)
+    assert np.all(cols <= cap + 0.05 * cap + 1e-2)
+    assert rows[5] == 0.0  # infeasible pod ships nothing
+    assert np.all(plan[~mask] == 0.0)
+
+
+def test_pallas_interpret_matches_jnp():
+    rng = np.random.RandomState(1)
+    P, N = 32, 24
+    score = rng.uniform(0, 10, (P, N)).astype(np.float32)
+    mask = rng.uniform(size=(P, N)) > 0.3
+    cap = rng.randint(1, 5, N).astype(np.float32)
+    a = np.asarray(sinkhorn_plan(jnp.asarray(score), jnp.asarray(mask),
+                                 jnp.asarray(cap), iters=20, pallas=False))
+    b = np.asarray(sinkhorn_plan(jnp.asarray(score), jnp.asarray(mask),
+                                 jnp.asarray(cap), iters=20, pallas=True,
+                                 interpret=True))
+    assert np.allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_sinkhorn_solver_schedules_contended_batch():
+    s = Scheduler(solver="sinkhorn", clock=FakeClock(), enable_preemption=False)
+    for i in range(8):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=2000))
+    for i in range(16):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=1000))
+    res = s.schedule_cycle()
+    assert res.scheduled == 16
+    counts = {}
+    for n in res.assignments.values():
+        counts[n] = counts.get(n, 0) + 1
+    assert max(counts.values()) <= 2  # capacity respected, spread out
+
+
+def test_gang_all_or_nothing():
+    s = Scheduler(clock=FakeClock(), enable_preemption=False)
+    for i in range(4):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=4000))
+    # group A: all feasible -> schedules atomically
+    for i in range(3):
+        s.on_pod_add(make_pod(f"a{i}", cpu_milli=500, pod_group="A"))
+    # group B: one member demands the impossible -> whole group holds back
+    s.on_pod_add(make_pod("b0", cpu_milli=500, pod_group="B"))
+    s.on_pod_add(make_pod("b1", cpu_milli=999999, pod_group="B"))
+    # a singleton is unaffected
+    s.on_pod_add(make_pod("solo", cpu_milli=500))
+    res = s.schedule_cycle()
+    assert res.scheduled == 4  # a0,a1,a2 + solo
+    assert all(f"default/a{i}" in res.assignments for i in range(3))
+    assert "default/solo" in res.assignments
+    assert "default/b0" not in res.assignments
+    assert res.failure_reasons["default/b0"] == ("GangIncomplete:B",)
+    assert "PodFitsResources" in res.failure_reasons["default/b1"]
+    # no partial capacity held for the failed gang
+    assert not s.cache.is_assumed("default/b0")
+
+
+def test_gang_schedules_when_whole_group_fits_later():
+    clk = FakeClock()
+    s = Scheduler(clock=clk, enable_preemption=False)
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    s.on_pod_add(make_pod("g0", cpu_milli=600, pod_group="G"))
+    s.on_pod_add(make_pod("g1", cpu_milli=600, pod_group="G"))
+    res = s.schedule_cycle()
+    assert res.scheduled == 0  # only one fits -> rollback
+    # capacity grows: both fit now
+    s.on_node_add(make_node("n1", cpu_milli=1000))
+    clk.t += 30
+    s.queue.move_all_to_active()
+    res2 = s.schedule_cycle()
+    assert res2.scheduled == 2
+
+
+def test_gang_rollback_leaves_no_phantom_state():
+    """Regression (review): rolled-back gang members must not appear in the
+    usage fed to the failure-reason pass, must not trigger preemption
+    nominations, and must not hold capacity."""
+    clk = FakeClock()
+    s = Scheduler(clock=clk)  # preemption ON
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    s.on_pod_add(make_pod("g0", cpu_milli=600, pod_group="G"))
+    s.on_pod_add(make_pod("g1", cpu_milli=600, pod_group="G"))
+    res = s.schedule_cycle()
+    assert res.scheduled == 0
+    assert res.nominations == {} and res.preempted == 0
+    assert res.failure_reasons["default/g0"][0].startswith("GangIncomplete")
+    # full capacity must be available to the next arrival
+    s.on_pod_add(make_pod("big", cpu_milli=1000))
+    res2 = s.schedule_cycle()
+    assert res2.assignments.get("default/big") == "n0"
+
+
+def test_gang_min_available_blocks_fragment():
+    """Regression (review): a group fragment smaller than minMember must
+    not bind, even though every present member fits."""
+    clk = FakeClock()
+    s = Scheduler(clock=clk, enable_preemption=False)
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    s.on_pod_add(make_pod("g0", cpu_milli=100, pod_group="G",
+                          pod_group_min_available=2))
+    res = s.schedule_cycle()
+    assert res.scheduled == 0
+    assert res.failure_reasons["default/g0"] == ("GangIncomplete:G",)
+    # the missing member arrives; the fragment rejoins after the 60s
+    # unschedulable resweep (new-pod creates don't wake unschedulables in
+    # the reference either — scheduling_queue.go:368)
+    clk.t += 70
+    s.on_pod_add(make_pod("g1", cpu_milli=100, pod_group="G",
+                          pod_group_min_available=2))
+    res2 = s.schedule_cycle()
+    assert res2.scheduled == 2
+
+
+def test_pallas_handles_unpadded_shapes():
+    """Regression (review): non-block-multiple shapes must not read
+    uninitialized memory (grid floor division)."""
+    rng = np.random.RandomState(2)
+    P, N = 303, 41
+    score = rng.uniform(0, 10, (P, N)).astype(np.float32)
+    mask = rng.uniform(size=(P, N)) > 0.3
+    cap = rng.randint(1, 5, N).astype(np.float32)
+    a = np.asarray(sinkhorn_plan(jnp.asarray(score), jnp.asarray(mask),
+                                 jnp.asarray(cap), iters=15, pallas=False))
+    b = np.asarray(sinkhorn_plan(jnp.asarray(score), jnp.asarray(mask),
+                                 jnp.asarray(cap), iters=15, pallas=True,
+                                 interpret=True))
+    assert np.allclose(a, b, rtol=1e-4, atol=1e-5)
