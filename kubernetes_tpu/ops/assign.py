@@ -258,7 +258,21 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
         score = run_priorities(pods, cur, sel, mask, weights, topo)
         if extra_score is not None:
             score = score + extra_score
-        masked = jnp.where(mask, score, NEG)
+        # deterministic tie-break spread — the batched analog of
+        # selectHost's randomized round-robin among max-scoring nodes
+        # (generic_scheduler.go:292). Without it, a uniform workload herds
+        # every pod onto the same lowest-index argmax node each round and
+        # throughput collapses to per_node_cap pods/round. Scores are
+        # shifted per row so the top candidates sit near 0 (raw scores can
+        # reach 1e5 via the 10000-weight NodePreferAvoidPods term, where
+        # f32 ulp would swallow any safe jitter), then a (pod, node) hash
+        # below the integer score quantum permutes EQUAL-score choices.
+        pj = jnp.arange(P, dtype=jnp.uint32)
+        nj = jnp.arange(mask.shape[1], dtype=jnp.uint32)
+        h = pj[:, None] * jnp.uint32(2654435761) + nj[None, :] * jnp.uint32(974593)
+        jitter = (h % jnp.uint32(8192)).astype(jnp.float32) * (0.5 / 8192.0)
+        rowmax = jnp.max(jnp.where(mask, score, NEG), axis=1, keepdims=True)
+        masked = jnp.where(mask, score - rowmax + jitter, NEG)
         if use_sinkhorn:
             # choose from the entropic-OT transport plan instead of the raw
             # per-pod argmax: the plan balances the whole batch against node
