@@ -354,6 +354,11 @@ class PodDisruptionBudget:
     namespace: str = "default"
     selector: LabelSelector = field(default_factory=LabelSelector)
     disruptions_allowed: int = 0
+    #: spec.minAvailable (int form): when set, a disruption controller
+    #: (pkg/controller/disruption) maintains ``disruptions_allowed`` =
+    #: max(0, currentHealthy - minAvailable); when None the status field
+    #: is whatever the feed set (static-lister mode).
+    min_available: Optional[int] = None
 
     def matches(self, pod: Pod) -> bool:
         return pod.namespace == self.namespace and self.selector.matches(pod.labels)

@@ -51,12 +51,18 @@ class InMemoryLock:
 
 class FileLock:
     """File-based lock: read-modify-write with atomic rename; the loaded
-    JSON doubles as the resourceVersion (compare-and-swap on content)."""
+    JSON doubles as the resourceVersion (compare-and-swap on content).
+    The compare and the replace are made atomic by holding an OS mutex
+    (``fcntl.flock`` on a sidecar file) across the read-modify-write —
+    without it two candidates can both pass the compare and both become
+    leader (split brain), the exact failure leader election exists to
+    prevent (tryAcquireOrRenew, leaderelection.go:317, relies on the
+    apiserver's CAS being atomic)."""
 
     def __init__(self, path: str) -> None:
         self.path = path
 
-    def get(self) -> Optional[LeaderElectionRecord]:
+    def _read(self) -> Optional[LeaderElectionRecord]:
         try:
             with open(self.path) as f:
                 d = json.load(f)
@@ -64,17 +70,31 @@ class FileLock:
         except (OSError, ValueError):
             return None
 
+    def get(self) -> Optional[LeaderElectionRecord]:
+        return self._read()
+
     def create_or_update(self, record: LeaderElectionRecord, old) -> bool:
-        cur = self.get()
-        if (cur is None) != (old is None):
-            return False
-        if cur is not None and old is not None and cur.__dict__ != old.__dict__:
-            return False
-        tmp = f"{self.path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(record.__dict__, f)
-        os.replace(tmp, self.path)
-        return True
+        import fcntl
+
+        with open(f"{self.path}.lock", "a+") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                cur = self._read()
+                if (cur is None) != (old is None):
+                    return False
+                if (
+                    cur is not None
+                    and old is not None
+                    and cur.__dict__ != old.__dict__
+                ):
+                    return False
+                tmp = f"{self.path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(record.__dict__, f)
+                os.replace(tmp, self.path)
+                return True
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
 
 
 class LeaderElector:

@@ -196,3 +196,69 @@ def make_gang_pods(
             p.pod_group = f"{name_prefix}-{g}"
             out.append(p)
     return out
+
+
+def make_pod_affinity_pods(
+    n: int,
+    n_groups: int = 8,
+    topology_key: str = "failure-domain.beta.kubernetes.io/zone",
+    name_prefix: str = "aff2-pod",
+) -> List[Pod]:
+    """BenchmarkSchedulingPodAffinity analog (scheduler_bench_test.go:224):
+    pods with required pod affinity to their OWN group label on a topology
+    key — the first pod of a group seeds a domain (the self-match escape),
+    the rest co-locate."""
+    from kubernetes_tpu.api.types import PodAffinityTerm
+
+    out = []
+    for i in range(n):
+        g = i % max(n_groups, 1)
+        labels = {"aff-group": f"g{g}"}
+        p = base_pod(f"{name_prefix}-{i}", labels=labels)
+        p.affinity = Affinity(
+            pod_affinity_required=(
+                PodAffinityTerm(
+                    label_selector=LabelSelector(match_labels=dict(labels)),
+                    topology_key=topology_key,
+                ),
+            )
+        )
+        out.append(p)
+    return out
+
+
+def make_pv_pods(
+    n: int,
+    kind: str = "gce-pd",
+    name_prefix: str = "pv-pod",
+) -> Tuple[List[Pod], List["PersistentVolumeClaim"], List["PersistentVolume"]]:
+    """BenchmarkSchedulingInTreePVs / BenchmarkSchedulingCSIPVs analog
+    (scheduler_bench_test.go:120,:184): one pre-bound PVC/PV pair per pod
+    (immediate binding), exercising the attach-limit and zone kernels.
+    Returns (pods, pvcs, pvs)."""
+    from kubernetes_tpu.api.types import (
+        PersistentVolume,
+        PersistentVolumeClaim,
+        PodVolume,
+    )
+
+    pods, pvcs, pvs = [], [], []
+    for i in range(n):
+        pv = PersistentVolume(
+            name=f"{name_prefix}-pv-{i}",
+            kind=kind,
+            handle=f"{name_prefix}-disk-{i}",
+            driver="test.csi.driver" if kind == "csi" else "",
+            claim_ref=f"default/{name_prefix}-pvc-{i}",
+        )
+        pvc = PersistentVolumeClaim(
+            name=f"{name_prefix}-pvc-{i}",
+            namespace="default",
+            volume_name=pv.name,
+        )
+        p = base_pod(f"{name_prefix}-{i}")
+        p.volumes = (PodVolume(pvc=pvc.name),)
+        pods.append(p)
+        pvcs.append(pvc)
+        pvs.append(pv)
+    return pods, pvcs, pvs

@@ -258,21 +258,34 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
         score = run_priorities(pods, cur, sel, mask, weights, topo)
         if extra_score is not None:
             score = score + extra_score
+        # ---- bidder window: the next K pods the serial loop would pop ----
+        # Only the top K = N*per_node_cap active pods (by queue rank) that
+        # have at least one feasible node may bid this round. Per-round
+        # admissions are capped at K anyway, so this costs no throughput,
+        # and it makes priority ordering a structural invariant: a pod can
+        # be admitted only when fewer than K feasible higher-rank pods are
+        # still waiting (the serial loop is the K=1 case). Pods with no
+        # feasible node don't consume window slots — the serial loop pops
+        # them, fails them, and moves on (they may become feasible later in
+        # the batch as affinity targets land).
+        feasible_any = jnp.any(mask, axis=1)
+        wkey = jnp.where(active & feasible_any, rank, jnp.int32(P + 1))
+        worder = jnp.argsort(wkey)
+        arank = jnp.zeros((P,), jnp.int32).at[worder].set(
+            jnp.arange(P, dtype=jnp.int32)
+        )
+        window = nodes.allocatable.shape[0] * per_node_cap
+        mask = mask & (active & feasible_any & (arank < window))[:, None]
         # deterministic tie-break spread — the batched analog of
         # selectHost's randomized round-robin among max-scoring nodes
         # (generic_scheduler.go:292). Without it, a uniform workload herds
-        # every pod onto the same lowest-index argmax node each round and
-        # throughput collapses to per_node_cap pods/round. Scores are
-        # shifted per row so the top candidates sit near 0 (raw scores can
-        # reach 1e5 via the 10000-weight NodePreferAvoidPods term, where
-        # f32 ulp would swallow any safe jitter), then a (pod, node) hash
-        # below the integer score quantum permutes EQUAL-score choices.
-        pj = jnp.arange(P, dtype=jnp.uint32)
-        nj = jnp.arange(mask.shape[1], dtype=jnp.uint32)
-        h = pj[:, None] * jnp.uint32(2654435761) + nj[None, :] * jnp.uint32(974593)
-        jitter = (h % jnp.uint32(8192)).astype(jnp.float32) * (0.5 / 8192.0)
+        # every bidder onto the same lowest-index argmax node each round
+        # and throughput collapses to per_node_cap pods/round. Each bidder
+        # rotates among its EXACTLY-tied best nodes by its dense window
+        # index, so the best-ranked bidder still takes the lowest node
+        # index (deterministic) and equal-score cohorts fan out evenly.
         rowmax = jnp.max(jnp.where(mask, score, NEG), axis=1, keepdims=True)
-        masked = jnp.where(mask, score - rowmax + jitter, NEG)
+        masked = jnp.where(mask, score - rowmax, NEG)
         if use_sinkhorn:
             # choose from the entropic-OT transport plan instead of the raw
             # per-pod argmax: the plan balances the whole batch against node
@@ -302,11 +315,20 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
                 jnp.isfinite(slots), slots, free[:, RES_PODS]
             )
             plan = sinkhorn_plan(masked, mask, slots)
-            choice = jnp.argmax(
-                jnp.where(mask, plan, -1.0), axis=1
-            ).astype(jnp.int32)
+            # identical pods get identical plan rows (Sinkhorn scaling
+            # preserves row identity), so the plan argmax needs the same
+            # rotation tie-break as the raw-score branch or a uniform
+            # cohort herds onto one node at per_node_cap pods/round
+            pmasked = jnp.where(mask, plan, -1.0)
+            prowmax = jnp.max(pmasked, axis=1, keepdims=True)
+            tied = mask & (pmasked >= prowmax)
         else:
-            choice = jnp.argmax(masked, axis=1).astype(jnp.int32)  # (P,)
+            tied = mask & (score >= rowmax)
+        tcount = jnp.sum(tied, axis=1).astype(jnp.int32)
+        rot = jnp.where(tcount > 0, arank % jnp.maximum(tcount, 1), 0)
+        pos = jnp.cumsum(tied.astype(jnp.int32), axis=1)
+        pick = tied & (pos == (rot + 1)[:, None])
+        choice = jnp.argmax(pick, axis=1).astype(jnp.int32)  # (P,)
         feasible = jnp.take_along_axis(mask, choice[:, None], axis=1)[:, 0]
         choice = jnp.where(feasible, choice, -1)
 

@@ -143,6 +143,7 @@ class Scheduler:
         metrics=None,
         trace_threshold_s: float = 1.0,
         percentage_of_nodes_to_score: Optional[int] = None,
+        volume_binder=None,
     ) -> None:
         from kubernetes_tpu.framework import Framework
         from kubernetes_tpu.metrics import SchedulerMetrics
@@ -195,6 +196,11 @@ class Scheduler:
         #: A hub integration instead posts the delete and lets the watch
         #: remove it, keeping the victim visible as terminating meanwhile.
         self.victim_deleter = victim_deleter
+        #: delayed-binding PVC lifecycle (volume_binder.go:30): assume at
+        #: assume time, commit at bind time, roll back on any forget
+        from kubernetes_tpu.volumes import VolumeBinder
+
+        self.volume_binder = volume_binder or VolumeBinder(self.cache.packer)
 
     @classmethod
     def from_config(cls, cfg, **kw) -> "Scheduler":
@@ -244,10 +250,31 @@ class Scheduler:
 
     def on_pod_update(self, old: Pod, new: Pod) -> None:
         if new.node_name:
+            # a Permit-parked pod bound by another writer must leave the
+            # waiting map BEFORE cache.add_pod flips its state to ADDED —
+            # otherwise _process_waiting later calls forget_pod on a
+            # non-assumed pod and aborts the whole cycle (same cleanup
+            # on_pod_delete does for parked pods)
+            wp = self.framework.waiting.get(new.key())
+            if wp is not None:
+                self.framework.waiting.remove(new.key())
+                self.volume_binder.forget_pod_volumes(new.key())
+                self.framework.run_unreserve(
+                    self._cycle_states.get(new.key()) or _new_cycle_state(),
+                    wp.pod, wp.node_name,
+                )
+            self._cycle_states.pop(new.key(), None)
             # add_pod (not update_pod): an unassigned->assigned transition
             # must CONFIRM a pending assumption, or the TTL would expire a
             # successfully bound pod and double-book its capacity
             self.cache.add_pod(new)
+            # ... and must LEAVE the scheduling queue: the reference's
+            # unassigned-pod informer filter turns this transition into a
+            # queue delete (eventhandlers.go addAllEventHandlers pod
+            # FilterFunc). Without it, a pod bound by another writer (HA
+            # peer, competing scheduler) would be scheduled again here and
+            # double-booked.
+            self.queue.delete(new.key())
             # AssignedPodUpdated: wake only affinity-matching waiters, not
             # the whole unschedulableQ (eventhandlers.go)
             self.queue.assigned_pod_added(new)
@@ -262,6 +289,7 @@ class Scheduler:
         if wp is not None:
             self.framework.waiting.remove(key)
             self.cache.forget_pod(key)
+            self.volume_binder.forget_pod_volumes(key)
             self.framework.run_unreserve(
                 self._cycle_states.get(key) or _new_cycle_state(), wp.pod,
                 wp.node_name,
@@ -396,12 +424,16 @@ class Scheduler:
                             hm[i, j] = fw.run_host_filter(st, p, name).is_success()
                         if fw.has_host_scores() and hm[i, j]:
                             hs[i, j] = fw.run_host_score(st, p, name)
-                except RuntimeError as e:
-                    # a Score plugin error aborts only THIS pod's cycle
-                    # (the reference returns an error from PrioritizeNodes
-                    # for that pod; other pods proceed)
+                except Exception as e:
+                    # ANY host-plugin failure (a raising Filter or Score
+                    # plugin included) aborts only THIS pod's cycle — the
+                    # reference converts plugin errors into a per-pod
+                    # error status (RunFilterPlugins/PrioritizeNodes
+                    # return an error for that pod; other pods proceed);
+                    # letting it propagate would abort the whole batch
+                    # with popped pods never requeued
                     hm[i, :] = False
-                    early_fail[i] = f"ScorePlugin:{e}"
+                    early_fail[i] = f"HostPlugin:{e}"
             if fw.has_host_filters():
                 m = jnp.asarray(hm)
                 fw_mask = m if fw_mask is None else (fw_mask & m)
@@ -560,9 +592,24 @@ class Scheduler:
                 continue
             node_name = node_order[target]
             st = self._cycle_states.get(pod.key()) or CycleState()
+            # AssumePodVolumes (scheduler.go:523 assumeVolumes, before
+            # Reserve): reserve a PV per unbound delayed-binding claim for
+            # THIS node; a racing claimant earlier in the batch may have
+            # taken the last one — then this pod fails and requeues.
+            # A reservation held from a PREVIOUS cycle (Permit-parked pod
+            # popped again) must survive this attempt's failure paths.
+            vols_held_before = pod.key() in self.volume_binder.assumed
+            vok, vmsg = self.volume_binder.assume_pod_volumes(
+                pod, self.cache.node(node_name)
+            )
+            if not vok:
+                self._fail(pod, cycle, res, (f"VolumeBinding:{vmsg}",))
+                continue
             # Reserve (scheduler.go:531 RunReservePlugins, before assume)
             rs = fw.run_reserve(st, pod, node_name)
             if not rs.is_success():
+                if not vols_held_before:
+                    self.volume_binder.forget_pod_volumes(pod.key())
                 fw.run_unreserve(st, pod, node_name)
                 self._fail(pod, cycle, res, (f"Reserve:{rs.message}",))
                 continue
@@ -570,6 +617,8 @@ class Scheduler:
                 self.cache.assume_pod(pod, node_name)
             except Exception:
                 # already in cache (e.g. duplicate queue entry) — requeue
+                if not vols_held_before:
+                    self.volume_binder.forget_pod_volumes(pod.key())
                 fw.run_unreserve(st, pod, node_name)
                 self._fail(pod, cycle, res, ("AssumeError",))
                 continue
@@ -581,6 +630,7 @@ class Scheduler:
                 continue
             if not ps.is_success():
                 self.cache.forget_pod(pod.key())
+                self.volume_binder.forget_pod_volumes(pod.key())
                 fw.run_unreserve(st, pod, node_name)
                 self._fail(pod, cycle, res, (f"Permit:{ps.message}",))
                 continue
@@ -771,12 +821,24 @@ class Scheduler:
 
         def reject(reason: str) -> bool:
             self.cache.forget_pod(pod.key())
+            self.volume_binder.forget_pod_volumes(pod.key())
             res.bind_errors += 1
             fw.run_unreserve(st, pod, node_name)
             self._fail(pod, cycle, res, (reason,))
             self._cycle_states.pop(pod.key(), None)
             return False
 
+        # BindPodVolumes (scheduler.go:550 bindVolumes, first step of the
+        # async binding phase): commit the assumed PVC->PV claims; a write
+        # failure forgets the pod AND releases un-committed reservations
+        try:
+            committed = self.volume_binder.bind_pod_volumes(pod)
+        except Exception as e:
+            return reject(f"VolumeBinding:{e}")
+        if committed:
+            # the pod's volume tokens (zone labels, attach counts of its
+            # now-bound PVs) changed; the packed node snapshot must rebuild
+            self.cache.invalidate_snapshot()
         s = fw.run_prebind(st, pod, node_name)
         if not s.is_success():
             return reject(f"PreBind:{s.message}")
@@ -821,6 +883,7 @@ class Scheduler:
             if wp.rejected is not None or (not wp.allowed and now >= wp.deadline):
                 fw.waiting.remove(key)
                 self.cache.forget_pod(key)
+                self.volume_binder.forget_pod_volumes(key)
                 fw.run_unreserve(st, wp.pod, wp.node_name)
                 reason = wp.rejected or "permit timeout"
                 self._fail(

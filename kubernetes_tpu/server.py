@@ -134,11 +134,25 @@ class ExtenderServer:
 
     def _prioritize(self, payload: dict) -> dict:
         _, names, rows, mask, _, scores = self._evaluate(payload)
+        # extender scores ride a 0-10 scale like in-tree priorities. The
+        # fused kernel total is a weighted SUM of 0-10 terms (routinely
+        # >10), so normalize per request — max feasible score maps to 10,
+        # the reference's reduce-style normalization (_normalize_reduce /
+        # NormalizeReduce, priorities/reduce.go) — before the clamp;
+        # clamping raw totals would saturate every node at 10 and erase
+        # the ranking signal this seam exists to carry.
+        vals = {
+            n: float(scores[rows[n]])
+            for n in names
+            if rows.get(n) is not None and mask[rows[n]]
+        }
+        top = max(vals.values(), default=0.0)
+        scale = 10.0 / top if top > 0 else 0.0
         out = []
         for n in names:
-            i = rows.get(n)
-            # extender scores ride a 0-10 scale like in-tree priorities
-            val = float(scores[i]) if i is not None and mask[i] else 0.0
+            val = vals.get(n, 0.0) * scale
+            # integer floor like the Go reduce (score*MaxPriority/maxCount
+            # in int64 arithmetic), so near-ties stay distinguishable
             out.append({"host": n, "score": int(max(0.0, min(10.0, val)))})
         return out
 

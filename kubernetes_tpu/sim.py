@@ -9,18 +9,40 @@ source of truth and feeds the scheduler's event handlers exactly like an
 informer pump: pod/node create/delete churn, flaky bindings, node
 flapping, replica controllers maintaining workloads. The cache-vs-truth
 comparer (``debugger.compare``) is the consistency oracle after every
-step."""
+step.
+
+The hub is an optimistic-concurrency store, not a plain dict (the single
+most important architectural fact of the reference, SURVEY.md §1):
+
+- every object write bumps a global revision and the object's
+  resourceVersion (etcd3/store.go:236 GuaranteedUpdate);
+- the Binding subresource is a CAS: it fails with :class:`Conflict` if
+  the pod is gone, was recreated (uid mismatch), or already has a node
+  (registry/core/pod/storage/storage.go:154 BindingREST.Create →
+  assignPod);
+- watch events can be DELAYED (``event_delay_ticks``): the scheduler then
+  acts on stale state and its writes hit conflicts, exactly like a real
+  informer lagging etcd — per-object event order is always preserved,
+  like a real watch stream;
+- a competing writer (``competing_bind_rate``) binds pending pods behind
+  the scheduler's back — the HA-peer / external-controller race.
+"""
 
 from __future__ import annotations
 
+import heapq
 import random
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
-from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.api.types import EFFECT_NO_EXECUTE, Node, Pod, Taint
 from kubernetes_tpu.debugger import compare
 from kubernetes_tpu.scheduler import Scheduler
 from kubernetes_tpu.testing import make_node, make_pod
+
+
+class Conflict(Exception):
+    """Optimistic-concurrency write rejection (apierrors.IsConflict)."""
 
 
 class SimClock:
@@ -36,19 +58,25 @@ class SimClock:
 
 class FlakyBinder:
     """Binder whose RPC fails with probability ``fail_rate`` — exercising
-    the Forget-and-requeue path (scheduler.go:447)."""
+    the Forget-and-requeue path (scheduler.go:447). Hub-side CAS
+    rejections (:class:`Conflict`) propagate through the same surface."""
 
     def __init__(self, hub: "HollowCluster", fail_rate: float, rng) -> None:
         self.hub = hub
         self.fail_rate = fail_rate
         self.rng = rng
         self.failures = 0
+        self.conflicts = 0
 
     def bind(self, pod: Pod, node_name: str) -> None:
         if self.rng.random() < self.fail_rate:
             self.failures += 1
             raise RuntimeError("simulated bind RPC failure")
-        self.hub.confirm_binding(pod, node_name)
+        try:
+            self.hub.confirm_binding(pod, node_name)
+        except Conflict:
+            self.conflicts += 1
+            raise
 
 
 @dataclass
@@ -67,63 +95,201 @@ class ReplicaSet:
 
 
 class HollowCluster:
-    """Owns the truth (pods/nodes) and pumps watch events at the scheduler.
-    All scheduler interaction goes through the event-handler surface, like
-    the reference's AddAllEventHandlers wiring."""
+    """Owns the truth (pods/nodes) behind a versioned store and pumps
+    watch events at the scheduler. All scheduler interaction goes through
+    the event-handler surface, like the reference's AddAllEventHandlers
+    wiring; all hub writes go through :meth:`_commit`, the GuaranteedUpdate
+    analog."""
 
     def __init__(
         self,
         seed: int = 0,
         bind_fail_rate: float = 0.0,
         scheduler_kw: Optional[dict] = None,
+        event_delay_ticks: int = 0,
+        competing_bind_rate: float = 0.0,
+        node_grace_s: float = 40.0,
+        eviction_wait_s: float = 30.0,
+        zone_eviction_rate: int = 1000,
     ) -> None:
         self.rng = random.Random(seed)
         self.clock = SimClock()
         self.truth_pods: Dict[str, Pod] = {}  # key -> pod (node_name = truth)
         self.truth_nodes: Dict[str, Node] = {}
+        #: per-object resourceVersion (etcd mod_revision analog)
+        self.resource_version: Dict[str, int] = {}
+        self._revision = 0  # global etcd revision
         self.replicasets: Dict[str, ReplicaSet] = {}
+        #: live PDB objects; the disruption-controller analog maintains
+        #: their status and the scheduler's pdb_lister reads them directly
+        self.pdbs: List = []
+        # node-lifecycle state (heartbeats, unreachable taints, eviction)
+        self.dead_kubelets: set = set()
+        self.heartbeats: Dict[str, float] = {}
+        self._taint_time: Dict[str, float] = {}
+        self.node_grace_s = node_grace_s
+        self.eviction_wait_s = eviction_wait_s
+        self.zone_eviction_rate = zone_eviction_rate
         self.binder = FlakyBinder(self, bind_fail_rate, self.rng)
-        self.sched = Scheduler(
-            binder=self.binder, clock=self.clock, **(scheduler_kw or {})
-        )
+        kw = dict(scheduler_kw or {})
+        kw.setdefault("pdb_lister", lambda: list(self.pdbs))
+        self.sched = Scheduler(binder=self.binder, clock=self.clock, **kw)
         self.bound_total = 0
+        self.competing_bind_rate = competing_bind_rate
+        self.competing_bound = 0
+        # watch plumbing: events deliver after 0..event_delay_ticks ticks,
+        # per-object order preserved (heap keyed by due-tick then seq)
+        self.event_delay_ticks = event_delay_ticks
+        self._tick = 0
+        self._seq = 0
+        self._watch_q: List[tuple] = []  # (due, seq, deliver_fn)
+        self._obj_last_due: Dict[str, int] = {}
+
+    # -- versioned store core ---------------------------------------------
+
+    def _commit(self, obj_key: str) -> int:
+        """Bump the global revision and stamp the object — every truth
+        write funnels through here (etcd3/store.go:236)."""
+        self._revision += 1
+        self.resource_version[obj_key] = self._revision
+        return self._revision
+
+    def _emit(self, obj_key: str, deliver: Callable[[], None]) -> None:
+        """Queue a watch event. Delivery may lag (``event_delay_ticks``)
+        but is never reordered for the same object — a later event for an
+        object is due no earlier than its previous one, like a per-object
+        watch stream."""
+        if self.event_delay_ticks <= 0:
+            deliver()
+            return
+        due = self._tick + self.rng.randint(0, self.event_delay_ticks)
+        due = max(due, self._obj_last_due.get(obj_key, 0))
+        self._obj_last_due[obj_key] = due
+        self._seq += 1
+        heapq.heappush(self._watch_q, (due, self._seq, deliver))
+
+    def flush_events(self, up_to: Optional[int] = None) -> int:
+        """Deliver all watch events due at or before ``up_to`` (default:
+        the current tick). Returns how many were delivered."""
+        up_to = self._tick if up_to is None else up_to
+        n = 0
+        while self._watch_q and self._watch_q[0][0] <= up_to:
+            _, _, deliver = heapq.heappop(self._watch_q)
+            deliver()
+            n += 1
+        return n
+
+    def settle(self) -> None:
+        """Drain every in-flight watch event and GC orphans — the
+        'informers caught up' state the consistency oracle compares."""
+        while self._watch_q:
+            self.flush_events(up_to=self._watch_q[0][0])
+        self.gc_orphaned()
+        while self._watch_q:
+            self.flush_events(up_to=self._watch_q[0][0])
 
     # -- truth mutations (each pumps the corresponding watch event) --------
 
     def add_node(self, node: Node) -> None:
         self.truth_nodes[node.name] = node
-        self.sched.on_node_add(node)
+        self.heartbeats[node.name] = self.clock.t
+        self._commit(f"nodes/{node.name}")
+        self._emit(f"nodes/{node.name}", lambda: self.sched.on_node_add(node))
 
     def remove_node(self, name: str) -> None:
         """Node vanishes; its pods are lost and deleted by the hub (the
         node-lifecycle/GC path, heavily simplified)."""
-        self.truth_nodes.pop(name, None)
+        if self.truth_nodes.pop(name, None) is None:
+            return
+        self.heartbeats.pop(name, None)
+        self._taint_time.pop(name, None)
+        self.dead_kubelets.discard(name)
+        self._commit(f"nodes/{name}")
         for key, p in list(self.truth_pods.items()):
             if p.node_name == name:
                 self.delete_pod(key)
-        self.sched.on_node_delete(name)
+        self._emit(f"nodes/{name}", lambda: self.sched.on_node_delete(name))
 
     def create_pod(self, pod: Pod) -> None:
         self.truth_pods[pod.key()] = pod
-        self.sched.on_pod_add(pod)
+        self._commit(f"pods/{pod.key()}")
+        self._emit(f"pods/{pod.key()}", lambda: self.sched.on_pod_add(pod))
 
     def delete_pod(self, key: str) -> None:
         pod = self.truth_pods.pop(key, None)
         if pod is not None:
-            self.sched.on_pod_delete(pod)
+            self._commit(f"pods/{key}")
+            self._emit(f"pods/{key}", lambda: self.sched.on_pod_delete(pod))
             for rs in self.replicasets.values():
                 rs.live.pop(key, None)
 
     def confirm_binding(self, pod: Pod, node_name: str) -> None:
-        """The apiserver accepted the binding: truth updates and the watch
-        event confirms the scheduler's assumption."""
-        old = self.truth_pods[pod.key()]
+        """The Binding subresource: a CAS write (BindingREST.Create →
+        assignPod, storage.go:154,:210). Raises :class:`Conflict` when the
+        scheduler's view was stale — pod deleted, pod recreated under the
+        same key, or already bound by another writer."""
+        key = pod.key()
+        cur = self.truth_pods.get(key)
+        if cur is None:
+            raise Conflict(f'pods "{key}" not found (deleted mid-bind)')
+        if cur.uid != pod.uid:
+            raise Conflict(f'pods "{key}" uid changed (recreated mid-bind)')
+        if cur.node_name:
+            raise Conflict(
+                f'pods "{key}" is already assigned to node "{cur.node_name}"'
+            )
         import dataclasses
 
-        new = dataclasses.replace(old, node_name=node_name)
-        self.truth_pods[pod.key()] = new
+        new = dataclasses.replace(cur, node_name=node_name)
+        self.truth_pods[key] = new
+        self._commit(f"pods/{key}")
         self.bound_total += 1
-        self.sched.on_pod_update(old, new)
+        self._emit(f"pods/{key}", lambda: self.sched.on_pod_update(cur, new))
+
+    def gc_orphaned(self) -> None:
+        """Delete truth pods bound to nodes that no longer exist — the
+        node-lifecycle-controller/GC eviction a real cluster runs when a
+        binding lands on a node that died meanwhile (the apiserver accepts
+        such bindings; assignPod does not check node existence)."""
+        for key, p in list(self.truth_pods.items()):
+            if p.node_name and p.node_name not in self.truth_nodes:
+                self.delete_pod(key)
+        self.kubelet_admission()
+
+    def kubelet_admission(self) -> None:
+        """The kubelet-admission analog (pkg/kubelet/lifecycle/predicate.go
+        enforces GeneralPredicates on arrival): the apiserver happily
+        accepts double-booked bindings — two schedulers racing on a stale
+        view CAN overcommit a node in truth (the Binding CAS only guards
+        the pod, not node capacity). On a real cluster the kubelet then
+        rejects the late arrivals (OutOfcpu); here the LAST-bound pods
+        (highest resourceVersion) are evicted until the node fits, and
+        their controllers recreate them."""
+        by_node: Dict[str, List[str]] = {}
+        for key, p in self.truth_pods.items():
+            if p.node_name:
+                by_node.setdefault(p.node_name, []).append(key)
+        for name, keys in by_node.items():
+            nd = self.truth_nodes.get(name)
+            if nd is None:
+                continue
+            # arrival order = resourceVersion of the binding write
+            keys.sort(key=lambda k: self.resource_version.get(f"pods/{k}", 0))
+            cpu = mem = cnt = 0.0
+            for k in keys:
+                p = self.truth_pods[k]
+                cpu += p.requests.cpu_milli
+                mem += p.requests.memory
+                cnt += 1
+                if (
+                    cpu > nd.allocatable.cpu_milli + 1e-6
+                    or mem > nd.allocatable.memory + 1e-6
+                    or cnt > nd.allocatable.pods
+                ):
+                    self.delete_pod(k)
+                    cpu -= p.requests.cpu_milli
+                    mem -= p.requests.memory
+                    cnt -= 1
 
     # -- controllers / churn ------------------------------------------------
 
@@ -155,21 +321,166 @@ class HollowCluster:
         for name in self.rng.sample(names, min(flap_nodes, len(names))):
             self.remove_node(name)
 
+    # -- disruption controller (pkg/controller/disruption) ------------------
+
+    def add_pdb(self, pdb) -> None:
+        self.pdbs.append(pdb)
+
+    def reconcile_pdbs(self) -> None:
+        """Maintain PDB status the way the disruption controller does:
+        disruptionsAllowed = max(0, currentHealthy - minAvailable), where
+        healthy = bound, non-terminating matching pods (updatePdbStatus,
+        pkg/controller/disruption/disruption.go)."""
+        for pdb in self.pdbs:
+            if pdb.min_available is None:
+                continue
+            healthy = sum(
+                1
+                for p in self.truth_pods.values()
+                if p.node_name and not p.deletion_timestamp and pdb.matches(p)
+            )
+            pdb.disruptions_allowed = max(0, healthy - pdb.min_available)
+
+    # -- node lifecycle controller (node_lifecycle_controller.go) -----------
+
+    TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
+
+    def kill_kubelet(self, name: str) -> None:
+        """The node's kubelet stops heartbeating — the node object remains
+        (unlike :meth:`remove_node`); the lifecycle controller must notice
+        via heartbeat age, not via a delete event."""
+        self.dead_kubelets.add(name)
+
+    def heal_kubelet(self, name: str) -> None:
+        self.dead_kubelets.discard(name)
+
+    def _update_node(self, node: Node) -> None:
+        self.truth_nodes[node.name] = node
+        self._commit(f"nodes/{node.name}")
+        self._emit(f"nodes/{node.name}", lambda: self.sched.on_node_update(node))
+
+    def monitor_node_health(self) -> None:
+        """monitorNodeHealth (:660): heartbeat older than the grace period
+        ⇒ Ready=Unknown + NoExecute unreachable taint; a fresh heartbeat
+        ⇒ restore. Then NoExecute eviction (:579): pods on a tainted node
+        that don't tolerate it are evicted once their toleration window
+        (here: ``eviction_wait_s``) passes — rate-limited per zone
+        (handleDisruption/setLimiterInZone, :998,:1096)."""
+        import dataclasses
+
+        now = self.clock.t
+        for name in list(self.truth_nodes):
+            if name not in self.dead_kubelets:
+                self.heartbeats[name] = now
+        for name, nd in list(self.truth_nodes.items()):
+            age = now - self.heartbeats.get(name, now)
+            tainted = any(t.key == self.TAINT_UNREACHABLE for t in nd.taints)
+            if age > self.node_grace_s and not tainted:
+                new = dataclasses.replace(
+                    nd,
+                    conditions=dataclasses.replace(nd.conditions, ready=False),
+                    taints=nd.taints
+                    + (Taint(self.TAINT_UNREACHABLE, effect=EFFECT_NO_EXECUTE),),
+                )
+                self._taint_time[name] = now
+                self._update_node(new)
+            elif age <= self.node_grace_s and tainted:
+                new = dataclasses.replace(
+                    nd,
+                    conditions=dataclasses.replace(nd.conditions, ready=True),
+                    taints=tuple(
+                        t for t in nd.taints if t.key != self.TAINT_UNREACHABLE
+                    ),
+                )
+                self._taint_time.pop(name, None)
+                self._update_node(new)
+        # NoExecute eviction, zone-rate-limited
+        evicted_in_zone: Dict[str, int] = {}
+        for key, p in list(self.truth_pods.items()):
+            if not p.node_name:
+                continue
+            nd = self.truth_nodes.get(p.node_name)
+            if nd is None:
+                continue
+            t0 = self._taint_time.get(nd.name)
+            if t0 is None or now - t0 <= self.eviction_wait_s:
+                continue
+            if any(
+                tol.tolerates(Taint(self.TAINT_UNREACHABLE, effect=EFFECT_NO_EXECUTE))
+                for tol in p.tolerations
+            ):
+                continue
+            zone = nd.zone() or ""
+            if evicted_in_zone.get(zone, 0) >= self.zone_eviction_rate:
+                continue
+            evicted_in_zone[zone] = evicted_in_zone.get(zone, 0) + 1
+            self.delete_pod(key)
+
+    def competing_writer(self) -> None:
+        """An HA peer / external controller binding pending pods behind the
+        scheduler's back. Every such bind is a legal hub write (capacity
+        checked against truth), so any later scheduler bind for the same
+        pod MUST hit the CAS conflict and Forget+requeue."""
+        if self.competing_bind_rate <= 0:
+            return
+        free: Dict[str, List[float]] = {}
+        for name, nd in self.truth_nodes.items():
+            free[name] = [nd.allocatable.cpu_milli, nd.allocatable.memory,
+                          nd.allocatable.pods]
+        for p in self.truth_pods.values():
+            if p.node_name and p.node_name in free:
+                f = free[p.node_name]
+                f[0] -= p.requests.cpu_milli
+                f[1] -= p.requests.memory
+                f[2] -= 1
+        for key, p in list(self.truth_pods.items()):
+            if p.node_name or self.rng.random() >= self.competing_bind_rate:
+                continue
+            fits = [
+                n for n, f in free.items()
+                if f[0] >= p.requests.cpu_milli and f[1] >= p.requests.memory
+                and f[2] >= 1
+            ]
+            if not fits:
+                continue
+            target = self.rng.choice(fits)
+            try:
+                self.confirm_binding(p, target)
+            except Conflict:
+                continue
+            f = free[target]
+            f[0] -= p.requests.cpu_milli
+            f[1] -= p.requests.memory
+            f[2] -= 1
+            self.competing_bound += 1
+
     # -- run ----------------------------------------------------------------
 
     def step(self, dt: float = 15.0):
-        """One sim tick: reconcile controllers, run a scheduling cycle,
-        advance time (so backoffs expire across ticks)."""
+        """One sim tick: deliver due watch events, GC orphans, let the
+        competing writer race, reconcile controllers, run a scheduling
+        cycle, advance time (so backoffs expire across ticks)."""
+        self._tick += 1
+        self.flush_events()
+        self.gc_orphaned()
+        self.monitor_node_health()
+        self.reconcile_pdbs()
         self.reconcile_controllers()
+        # the competing writer races AFTER new pods exist but BEFORE the
+        # scheduler's cycle — the window where the scheduler's view goes
+        # stale and its binds must CAS-fail
+        self.competing_writer()
         res = self.sched.schedule_cycle()
         self.clock.advance(dt)
         return res
 
     def check_consistency(self) -> None:
-        """Invariants after any step:
+        """Invariants at the settled state (all watch events delivered —
+        the comparer in the reference also reads the synced informer view):
         - cache matches truth (comparer),
         - no node over-committed in truth (cpu/memory/pod count),
         - every truth-bound pod landed on a live node."""
+        self.settle()
         truth = {k: p.node_name for k, p in self.truth_pods.items()}
         node_diffs, pod_diffs = compare(self.sched, truth, list(self.truth_nodes))
         assert not node_diffs, f"cache/truth node diffs: {node_diffs}"

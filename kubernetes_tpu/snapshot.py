@@ -752,6 +752,16 @@ class SnapshotPacker:
         for pod in self._vol_pods.values():
             self.resolve_volumes(pod)
 
+    def refresh_volume_resolutions(self) -> None:
+        """Invalidate memoized volume resolutions — the assume/bind
+        lifecycle mutates claim state in place (assumed_claims overlay,
+        committed claimRefs), which changes unbound-clause candidate sets
+        for other claimants. Lazy: re-resolution happens on the next
+        resolve_volumes call per pod (the pack paths all go through it),
+        so N lifecycle transitions in one cycle cost one re-resolution
+        sweep at the next pack, not N eager sweeps."""
+        self._vol_cache.clear()
+
     def resolve_volumes(self, pod: Pod) -> ResolvedVolumes:
         key = (pod.key(), pod.uid)
         rv = self._vol_cache.get(key)

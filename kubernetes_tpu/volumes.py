@@ -117,6 +117,44 @@ def label_zones_to_set(value: str) -> Tuple[str, ...]:
     return tuple(z for z in value.split("__") if z)
 
 
+def _match_requirement(labels: Dict[str, str], req) -> bool:
+    """v1helper.MatchNodeSelectorTerms requirement evaluation."""
+    val = labels.get(req.key)
+    op = req.operator
+    if op == "In":
+        return val is not None and val in req.values
+    if op == "NotIn":
+        return val is None or val not in req.values
+    if op == "Exists":
+        return req.key in labels
+    if op == "DoesNotExist":
+        return req.key not in labels
+    if op in ("Gt", "Lt"):
+        try:
+            lhs = int(val) if val is not None else None
+            rhs = int(req.values[0])
+        except (TypeError, ValueError):
+            return False
+        if lhs is None:
+            return False
+        return lhs > rhs if op == "Gt" else lhs < rhs
+    return False
+
+
+def match_node_selector_terms(labels: Dict[str, str], terms) -> bool:
+    """ORed NodeSelectorTerms, each ANDing its requirements — how a PV's
+    node affinity is checked against a node (VolumeBindingChecker,
+    predicates.go:1666 → volumeutil.CheckNodeAffinity). A term with no
+    match_expressions matches NOTHING (apimachinery nodeSelectorTerm
+    semantics — same rule the seqref oracle's _term_matches documents;
+    deliberately re-implemented here because seqref stays test-only)."""
+    return any(
+        bool(term.match_expressions)
+        and all(_match_requirement(labels, r) for r in term.match_expressions)
+        for term in terms
+    )
+
+
 @dataclass
 class VolumeState:
     """The PVC/PV/StorageClass listers the volume predicates consult —
@@ -126,6 +164,9 @@ class VolumeState:
     pvcs: Dict[Tuple[str, str], PersistentVolumeClaim] = field(default_factory=dict)
     pvs: Dict[str, PersistentVolume] = field(default_factory=dict)
     classes: Dict[str, StorageClass] = field(default_factory=dict)
+    #: pv name -> "ns/name" of the claim the scheduler has ASSUMED onto it
+    #: (the binder's pvCache assume overlay): reserved but not yet written
+    assumed_claims: Dict[str, str] = field(default_factory=dict)
 
     @staticmethod
     def build(
@@ -156,7 +197,9 @@ class VolumeState:
         return [
             pv
             for pv in self.pvs.values()
-            if not pv.claim_ref and pv.storage_class == storage_class
+            if not pv.claim_ref
+            and pv.name not in self.assumed_claims
+            and pv.storage_class == storage_class
         ]
 
 
@@ -250,3 +293,120 @@ def resolve_pod_volumes(pod: Pod, state: VolumeState) -> ResolvedVolumes:
     out.pd = sorted(set(out.pd))
     out.csi = sorted(set(out.csi))
     return out
+
+
+class VolumeBinder:
+    """The delayed-binding PVC lifecycle inside the scheduling flow — the
+    analog of ``pkg/scheduler/volumebinder/volume_binder.go:30`` wrapping
+    the volume scheduling library:
+
+    - :meth:`assume_pod_volumes` (scheduler.go:523 assumeVolumes →
+      AssumePodVolumes): at assume time, pick ONE available compatible PV
+      per unbound WaitForFirstConsumer claim for the chosen node and
+      reserve it in the assumed overlay, so no concurrent claimant —
+      in-batch or next-cycle — can take it;
+    - :meth:`bind_pod_volumes` (scheduler.go:550 bindVolumes →
+      BindPodVolumes): commit the reserved claims (PV.claimRef +
+      PVC.volumeName) through ``writer`` — an API write in a real
+      deployment, injectable so tests/sims can make it conflict;
+    - :meth:`forget_pod_volumes`: roll back reservations whenever the pod's
+      assumption is forgotten (Permit reject/timeout, bind failure,
+      deletion while parked).
+    """
+
+    def __init__(self, packer, writer=None) -> None:
+        self.packer = packer
+        self.writer = writer or self._local_write
+        #: pod key -> [(pvc, pv)] reserved picks awaiting bind
+        self.assumed: Dict[str, List[Tuple[PersistentVolumeClaim, PersistentVolume]]] = {}
+
+    @property
+    def state(self) -> VolumeState:
+        return self.packer.vol_state
+
+    def _local_write(self, pvc: PersistentVolumeClaim, pv: PersistentVolume) -> None:
+        """Default commit: mutate the local listers (the sim hub's truth)."""
+        pv.claim_ref = f"{pvc.namespace}/{pvc.name}"
+        pvc.volume_name = pv.name
+
+    def assume_pod_volumes(self, pod: Pod, node: Node) -> Tuple[bool, str]:
+        """Returns (ok, message). ok=True with no reservations made is the
+        reference's allBound=true fast path."""
+        if not any(v.pvc for v in pod.volumes):
+            return True, ""
+        if pod.key() in self.assumed:
+            # reservation already held (e.g. a Permit-parked pod popped
+            # again via a duplicate queue entry) — re-assuming would
+            # overwrite and leak the prior picks
+            return True, ""
+        st = self.state
+        picks: List[Tuple[PersistentVolumeClaim, PersistentVolume]] = []
+
+        def rollback() -> None:
+            for _, pv in picks:
+                st.assumed_claims.pop(pv.name, None)
+
+        for v in pod.volumes:
+            if not v.pvc:
+                continue
+            pvc = st.pvc(pod.namespace, v.pvc)
+            if pvc is None:
+                rollback()
+                return False, f'persistentvolumeclaim "{v.pvc}" not found'
+            if pvc.volume_name:
+                continue  # already bound
+            sc = st.storage_class(pvc.storage_class) if pvc.storage_class else None
+            if sc is None or sc.binding_mode != BINDING_WAIT_FOR_FIRST_CONSUMER:
+                rollback()
+                return False, f'pod has unbound immediate PersistentVolumeClaims ("{v.pvc}")'
+            if sc.provisionable():
+                continue  # dynamic provisioning satisfies it post-bind
+            cand = None
+            for pv in st.available_pvs(pvc.storage_class):
+                if not pv.node_affinity or match_node_selector_terms(
+                    node.labels, pv.node_affinity
+                ):
+                    cand = pv
+                    break
+            if cand is None:
+                rollback()
+                return False, (
+                    f'no matching PersistentVolume for claim "{v.pvc}" on '
+                    f'node "{node.name}"'
+                )
+            st.assumed_claims[cand.name] = f"{pod.namespace}/{pvc.name}"
+            picks.append((pvc, cand))
+        if picks:
+            self.assumed[pod.key()] = picks
+            self.packer.refresh_volume_resolutions()
+        return True, ""
+
+    def bind_pod_volumes(self, pod: Pod) -> bool:
+        """Commit reserved claims. Returns True if any write happened.
+        A writer failure releases the remaining reservations and re-raises
+        (the pod is then Forgotten + requeued; already-committed claims
+        stay bound, exactly like real API writes that landed — the next
+        attempt sees those PVCs bound and only assumes the rest)."""
+        picks = self.assumed.pop(pod.key(), None)
+        if not picks:
+            return False
+        st = self.state
+        try:
+            for pvc, pv in picks:
+                self.writer(pvc, pv)
+                st.assumed_claims.pop(pv.name, None)
+        except Exception:
+            for pvc, pv in picks:
+                if pvc.volume_name != pv.name:  # not committed
+                    st.assumed_claims.pop(pv.name, None)
+            self.packer.refresh_volume_resolutions()
+            raise
+        self.packer.refresh_volume_resolutions()
+        return True
+
+    def forget_pod_volumes(self, pod_key: str) -> None:
+        picks = self.assumed.pop(pod_key, None)
+        if picks:
+            for _, pv in picks:
+                self.state.assumed_claims.pop(pv.name, None)
+            self.packer.refresh_volume_resolutions()

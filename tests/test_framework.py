@@ -370,3 +370,28 @@ def test_empty_priorities_policy_means_no_scoring():
     res = s.schedule_cycle()
     # LeastRequested would pick b-idle; no-priorities picks the first row
     assert res.assignments["default/p0"] == "a-busy"
+
+
+def test_host_plugin_arbitrary_exception_fails_only_that_pod():
+    """Advisor fix: a host Filter/Score plugin raising ANY exception must
+    become a per-pod failure (the reference converts plugin errors into a
+    per-pod status), not abort the whole batch with popped pods lost."""
+
+    class ExplodesOnP1(Plugin):
+        def filter(self, state, pod, node_name):
+            if pod.name == "p1":
+                raise ValueError("boom")
+            return None
+
+    s, _ = sched_with([ExplodesOnP1()])
+    for i in range(2):
+        s.on_node_add(make_node(f"n{i}"))
+    s.on_pod_add(make_pod("p0"))
+    s.on_pod_add(make_pod("p1"))
+    s.on_pod_add(make_pod("p2"))
+    res = s.schedule_cycle()
+    assert "default/p0" in res.assignments
+    assert "default/p2" in res.assignments
+    assert "default/p1" not in res.assignments
+    (reason,) = res.failure_reasons["default/p1"]
+    assert "HostPlugin" in reason and "boom" in reason

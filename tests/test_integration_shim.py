@@ -257,3 +257,79 @@ def test_leader_election_failover():
     assert events == ["a+", "b+"]
     rec = lock.get()
     assert rec.holder_identity == "b" and rec.leader_transitions == 1
+
+
+def test_filelock_interleaved_cas_single_winner(tmp_path):
+    """Split-brain regression (advisor): two candidates that both read the
+    same record must not both win the CAS — the flock makes the
+    read-compare-write atomic, so the loser observes the winner's write."""
+    from kubernetes_tpu.leaderelection import FileLock, LeaderElectionRecord
+
+    path = str(tmp_path / "lease.json")
+    a, b = FileLock(path), FileLock(path)
+    rec_a = LeaderElectionRecord("a", 15, 0.0, 0.0, 0)
+    rec_b = LeaderElectionRecord("b", 15, 0.0, 0.0, 0)
+
+    # interleave: while A is inside its locked read-modify-write, B starts
+    # the same CAS from the same observed (None) state and blocks on the
+    # flock; once A lands, B must re-read, see A's record, and lose.
+    results = {}
+    b_started = threading.Event()
+
+    def b_attempt():
+        b_started.set()
+        results["b"] = b.create_or_update(rec_b, None)
+
+    orig_read = a._read
+
+    def hooked_read():
+        out = orig_read()
+        threading.Thread(target=b_attempt, daemon=True).start()
+        b_started.wait(5)
+        import time as _t
+
+        _t.sleep(0.05)  # give B time to reach (and block on) the flock
+        return out
+
+    a._read = hooked_read
+    results["a"] = a.create_or_update(rec_a, None)
+    a._read = orig_read
+    # wait for B to finish
+    for _ in range(100):
+        if "b" in results:
+            break
+        import time as _t
+
+        _t.sleep(0.05)
+    assert results["a"] is True
+    assert results["b"] is False
+    assert a.get().holder_identity == "a"
+
+
+def test_extender_server_prioritize_normalizes_to_0_10():
+    """Advisor fix: the fused kernel total routinely exceeds 10; the server
+    must normalize per request (max feasible node -> 10) instead of
+    clamping everything to the ceiling, or the seam carries no ranking."""
+    from kubernetes_tpu.server import ExtenderServer
+
+    s = Scheduler(clock=lambda: 0.0, enable_preemption=False)
+    s.on_node_add(make_node("idle", cpu_milli=32000, memory=64 * 2**30))
+    s.on_node_add(make_node("busy", cpu_milli=32000, memory=64 * 2**30))
+    s.on_node_add(make_node("tiny", cpu_milli=100))
+    s.on_pod_add(make_pod("filler", cpu_milli=30000, node_name="busy"))
+    ext = ExtenderServer(s)
+    out = ext._prioritize(
+        {
+            "pod": {
+                "metadata": {"name": "w", "namespace": "default"},
+                "spec": {"containers": [
+                    {"resources": {"requests": {"cpu": "1000m", "memory": "1Gi"}}}
+                ]},
+            },
+            "nodenames": ["idle", "busy", "tiny"],
+        }
+    )
+    scores = {h["host"]: h["score"] for h in out}
+    assert scores["idle"] == 10  # best feasible node maps to the ceiling
+    assert 0 < scores["busy"] < 10  # ranking signal survives
+    assert scores["tiny"] == 0  # infeasible

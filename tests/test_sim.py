@@ -178,3 +178,232 @@ def test_sim_preemption_under_pressure():
             break
     highs = [p for p in hc.truth_pods.values() if p.labels.get("rs") == "high"]
     assert len(highs) == 4 and all(p.node_name for p in highs)
+
+
+# ---------------------------------------------------------------------------
+# hub fidelity: resourceVersion CAS, conflicts, stale watches (VERDICT r1 #3)
+# ---------------------------------------------------------------------------
+
+
+def test_hub_resource_versions_monotonic():
+    from kubernetes_tpu.sim import Conflict
+
+    hc = HollowCluster(seed=1)
+    hc.add_node(make_node("n0"))
+    rv_node = hc.resource_version["nodes/n0"]
+    hc.create_pod(make_pod("p0"))
+    rv_pod = hc.resource_version["pods/default/p0"]
+    assert rv_pod > rv_node > 0
+    hc.confirm_binding(hc.truth_pods["default/p0"], "n0")
+    assert hc.resource_version["pods/default/p0"] > rv_pod
+
+
+def test_binding_cas_rejects_stale_writes():
+    import pytest
+
+    from kubernetes_tpu.sim import Conflict
+
+    hc = HollowCluster(seed=2)
+    hc.add_node(make_node("n0"))
+    hc.create_pod(make_pod("p0"))
+    stale = hc.truth_pods["default/p0"]
+
+    # double bind: second writer loses
+    hc.confirm_binding(stale, "n0")
+    with pytest.raises(Conflict, match="already assigned"):
+        hc.confirm_binding(stale, "n0")
+
+    # deleted mid-bind
+    hc.create_pod(make_pod("p1"))
+    stale1 = hc.truth_pods["default/p1"]
+    hc.delete_pod("default/p1")
+    with pytest.raises(Conflict, match="not found"):
+        hc.confirm_binding(stale1, "n0")
+
+    # recreated under the same key (uid changes) mid-bind
+    p2 = make_pod("p2")
+    p2.uid = "gen-1"
+    hc.create_pod(p2)
+    stale2 = hc.truth_pods["default/p2"]
+    hc.delete_pod("default/p2")
+    p2b = make_pod("p2")
+    p2b.uid = "gen-2"
+    hc.create_pod(p2b)
+    with pytest.raises(Conflict, match="uid changed"):
+        hc.confirm_binding(stale2, "n0")
+
+
+def test_bind_conflict_forget_and_requeue_end_to_end():
+    """A competing writer binds pods behind the scheduler's back; every
+    scheduler bind for such a pod must CAS-fail, Forget, and requeue, and
+    the system must still converge with no double booking."""
+    hc = HollowCluster(seed=3, competing_bind_rate=0.3)
+    for i in range(6):
+        hc.add_node(make_node(f"n{i}", cpu_milli=4000))
+    hc.add_replicaset(ReplicaSet("web", replicas=40, cpu_milli=400))
+    for _ in range(20):
+        hc.step()
+        hc.check_consistency()
+        if hc.pending_count() == 0:
+            break
+    assert hc.pending_count() == 0
+    assert hc.competing_bound > 0  # the race actually happened
+    # every pod bound exactly once in truth; no capacity violation
+    # (check_consistency already asserted overcommit invariants)
+    assert hc.bound_total == 40
+
+
+def test_delayed_watch_events_stale_reads_converge():
+    """Watch events lag up to 3 ticks: the scheduler schedules against
+    stale state (nodes it thinks exist may be gone; pods it thinks are
+    pending may be bound). Conflicts + Forget/requeue + GC must converge
+    to a consistent settled state."""
+    hc = HollowCluster(seed=4, event_delay_ticks=3, competing_bind_rate=0.15)
+    for i in range(8):
+        hc.add_node(make_node(f"n{i}", cpu_milli=4000))
+    hc.settle()  # nodes visible before workload arrives
+    hc.add_replicaset(ReplicaSet("api", replicas=50, cpu_milli=300))
+    for t in range(30):
+        hc.step()
+        if t % 7 == 6:
+            hc.churn(kill_pods=3, flap_nodes=1)
+    for _ in range(25):  # drain: backoffs, delayed events, recreated pods
+        hc.step()
+        if hc.pending_count() == 0 and not hc._watch_q:
+            break
+    hc.check_consistency()
+    assert hc.pending_count() == 0
+    assert len(hc.truth_nodes) < 8  # flaps happened
+    # conflict path exercised: flaky ordering must have produced at least
+    # one CAS rejection or competing bind during the run
+    assert hc.binder.conflicts + hc.competing_bound > 0
+
+
+def test_binding_to_dead_node_is_gced():
+    """The apiserver accepts bindings to dead nodes (assignPod does not
+    check node existence); the node-lifecycle/GC analog must clean up."""
+    from kubernetes_tpu.sim import Conflict
+
+    hc = HollowCluster(seed=5)
+    hc.add_node(make_node("n0"))
+    hc.add_node(make_node("n1"))
+    hc.create_pod(make_pod("p0"))
+    # hub-side: n1 dies, but a (stale) writer still binds p0 there
+    del hc.truth_nodes["n1"]
+    hc.confirm_binding(hc.truth_pods["default/p0"], "n1")
+    assert hc.truth_pods["default/p0"].node_name == "n1"
+    hc.gc_orphaned()
+    assert "default/p0" not in hc.truth_pods
+
+
+# ---------------------------------------------------------------------------
+# node-lifecycle + disruption controllers (VERDICT r1 #5/#8)
+# ---------------------------------------------------------------------------
+
+
+def test_node_lifecycle_heartbeat_taint_eviction_and_recovery():
+    """kill_kubelet stops heartbeats (node object stays): after the grace
+    period the lifecycle controller taints NoExecute + marks NotReady; the
+    scheduler avoids the node; after the toleration window its pods are
+    evicted and rescheduled elsewhere; healing the kubelet untaints."""
+    hc = HollowCluster(seed=11, node_grace_s=40.0, eviction_wait_s=30.0)
+    for i in range(4):
+        hc.add_node(make_node(f"n{i}", cpu_milli=8000))
+    hc.add_replicaset(ReplicaSet("svc", replicas=12, cpu_milli=500))
+    for _ in range(3):
+        hc.step(dt=15.0)
+    hc.check_consistency()
+    assert hc.pending_count() == 0
+    victim = next(p.node_name for p in hc.truth_pods.values() if p.node_name)
+    n_on_victim = sum(
+        1 for p in hc.truth_pods.values() if p.node_name == victim
+    )
+    assert n_on_victim > 0
+    hc.kill_kubelet(victim)
+    for _ in range(3):  # grace (40s) passes at dt=15 -> tainted
+        hc.step(dt=15.0)
+    nd = hc.truth_nodes[victim]
+    assert any(t.key == HollowCluster.TAINT_UNREACHABLE for t in nd.taints)
+    assert not nd.conditions.ready
+    for _ in range(8):  # eviction wait passes; replicas recreated elsewhere
+        hc.step(dt=15.0)
+    hc.check_consistency()
+    assert all(p.node_name != victim for p in hc.truth_pods.values())
+    assert hc.pending_count() == 0  # rescheduled on the healthy nodes
+    # recovery: heartbeats resume -> taint cleared, node schedulable again
+    hc.heal_kubelet(victim)
+    hc.step(dt=15.0)
+    nd = hc.truth_nodes[victim]
+    assert not any(t.key == HollowCluster.TAINT_UNREACHABLE for t in nd.taints)
+    assert nd.conditions.ready
+
+
+def test_pdb_status_maintained_by_disruption_controller():
+    from kubernetes_tpu.api.types import LabelSelector, PodDisruptionBudget
+
+    hc = HollowCluster(seed=12)
+    for i in range(3):
+        hc.add_node(make_node(f"n{i}", cpu_milli=8000))
+    pdb = PodDisruptionBudget(
+        name="keep3",
+        selector=LabelSelector(match_labels={"rs": "guarded"}),
+        min_available=3,
+    )
+    hc.add_pdb(pdb)
+    hc.add_replicaset(ReplicaSet("guarded", replicas=5, cpu_milli=500))
+    for _ in range(3):
+        hc.step()
+    assert hc.pending_count() == 0
+    hc.step()
+    assert pdb.disruptions_allowed == 2  # 5 healthy - 3 minAvailable
+    # two guarded pods die -> healthy drops -> budget goes to 0... then the
+    # replicaset recreates them and the budget recovers
+    hc.churn(kill_pods=2)
+    hc.reconcile_pdbs()
+    assert pdb.disruptions_allowed <= 1
+    for _ in range(4):
+        hc.step()
+    assert pdb.disruptions_allowed == 2
+
+
+def test_preemption_respects_live_pdb_status():
+    """Preemption's victim choice reads the LIVE budget: it must pick the
+    node whose victims violate no PDB (pickOneNodeForPreemption tier 1,
+    generic_scheduler.go:862; filterPodsWithPDBViolation :1129)."""
+    from kubernetes_tpu.api.types import LabelSelector, PodDisruptionBudget
+
+    hc = HollowCluster(seed=13)
+    hc.add_node(make_node("n-guarded", cpu_milli=1000))
+    hc.add_node(make_node("n-free", cpu_milli=1000))
+    hc.add_pdb(
+        PodDisruptionBudget(
+            name="guard",
+            selector=LabelSelector(match_labels={"rs": "guarded"}),
+            min_available=2,  # both guarded pods needed -> 0 disruptions
+        )
+    )
+    # fill each node with one low-pri pod; only "guarded" ones carry the PDB
+    guarded = make_pod("g0", cpu_milli=800, priority=0, labels={"rs": "guarded"})
+    free = make_pod("f0", cpu_milli=800, priority=0, labels={"rs": "free"})
+    hc.create_pod(guarded)
+    hc.create_pod(free)
+    # second guarded pod elsewhere keeps minAvailable meaningful
+    g1 = make_pod("g1", cpu_milli=100, priority=0, labels={"rs": "guarded"})
+    hc.create_pod(g1)
+    for _ in range(3):
+        hc.step()
+    assert hc.pending_count() == 0
+    # a high-priority pod arrives needing 800m: must evict f0, not g0
+    hc.create_pod(make_pod("boss", cpu_milli=800, priority=100))
+    for _ in range(6):
+        hc.step()
+        for key, p in list(hc.truth_pods.items()):
+            if p.deletion_timestamp:
+                hc.delete_pod(key)
+        if hc.truth_pods.get("default/boss", None) is not None and \
+           hc.truth_pods["default/boss"].node_name:
+            break
+    assert "default/g0" in hc.truth_pods  # PDB-protected pod survived
+    assert "default/f0" not in hc.truth_pods  # unprotected pod evicted
+    boss = hc.truth_pods["default/boss"]
+    assert boss.node_name == "n-free"

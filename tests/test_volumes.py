@@ -536,3 +536,221 @@ def test_volume_state_change_invalidates_node_snapshot():
     sched.on_pod_add(make_pod("y", volumes=(PodVolume(pvc="c2"),)))
     res = sched.schedule_cycle()
     assert res.scheduled == 1, res.failure_reasons
+
+
+# ---------------------------------------------------------------------------
+# volume-binding lifecycle: AssumePodVolumes / BindPodVolumes / rollback
+# (volume_binder.go:30; scheduler.go:523 assumeVolumes, :550 bindVolumes)
+# ---------------------------------------------------------------------------
+
+
+def _wffc_world(n_pvs=1, zone="us-a"):
+    """One WaitForFirstConsumer class, n available zone-affine PVs."""
+    classes = [StorageClass("local", binding_mode=BINDING_WAIT_FOR_FIRST_CONSUMER)]
+    pvs = [
+        PersistentVolume(
+            f"pv-{i}",
+            storage_class="local",
+            node_affinity=_pv_affinity(
+                "failure-domain.beta.kubernetes.io/zone", zone
+            ),
+        )
+        for i in range(n_pvs)
+    ]
+    return classes, pvs
+
+
+def test_assume_bind_lifecycle_end_to_end():
+    from kubernetes_tpu.scheduler import Scheduler
+
+    classes, pvs = _wffc_world(n_pvs=1)
+    pvcs = [PersistentVolumeClaim("c0", storage_class="local")]
+    s = Scheduler(clock=lambda: 0.0, enable_preemption=False)
+    s.on_node_add(make_node("n-a", zone="us-a"))
+    s.on_node_add(make_node("n-b", zone="us-b"))
+    s.set_volume_state(pvcs, pvs, classes)
+    s.on_pod_add(make_pod("p0", volumes=(PodVolume(pvc="c0"),)))
+    res = s.schedule_cycle()
+    # CheckVolumeBinding restricts to the PV's zone; bind commits the claim
+    assert res.assignments["default/p0"] == "n-a"
+    st = s.cache.packer.vol_state
+    assert st.pvc("default", "c0").volume_name == "pv-0"
+    assert st.pv("pv-0").claim_ref == "default/c0"
+    assert not st.assumed_claims  # reservation became a real binding
+    assert not s.volume_binder.assumed
+
+
+def test_racing_claimants_one_pv_one_winner():
+    """Two pods want the single available PV in the same batch: the first
+    assumes it; the second must fail VolumeBinding at assume time (NOT be
+    double-placed) and requeue; it schedules when a new PV appears."""
+    from kubernetes_tpu.scheduler import Scheduler
+
+    classes, pvs = _wffc_world(n_pvs=1)
+    pvcs = [
+        PersistentVolumeClaim("c0", storage_class="local"),
+        PersistentVolumeClaim("c1", storage_class="local"),
+    ]
+    clk = {"t": 0.0}
+    s = Scheduler(clock=lambda: clk["t"], enable_preemption=False)
+    s.on_node_add(make_node("n-a", zone="us-a"))
+    s.set_volume_state(pvcs, pvs, classes)
+    s.on_pod_add(make_pod("p0", volumes=(PodVolume(pvc="c0"),)))
+    s.on_pod_add(make_pod("p1", volumes=(PodVolume(pvc="c1"),)))
+    res = s.schedule_cycle()
+    assert res.scheduled == 1
+    winner = next(iter(res.assignments))
+    loser = {"default/p0": "default/p1", "default/p1": "default/p0"}[winner]
+    assert any("VolumeBinding" in r or "CheckVolumeBinding" in r
+               for r in res.failure_reasons[loser])
+    st = s.cache.packer.vol_state
+    assert st.pv("pv-0").claim_ref  # committed to the winner
+    # a second PV arrives -> resweep -> the loser binds it
+    pv2 = PersistentVolume(
+        "pv-1", storage_class="local",
+        node_affinity=_pv_affinity("failure-domain.beta.kubernetes.io/zone", "us-a"),
+    )
+    clk["t"] += 30.0
+    s.set_volume_state(pvcs, list(pvs) + [pv2], classes)
+    res2 = s.schedule_cycle()
+    assert loser in res2.assignments
+    st = s.cache.packer.vol_state  # set_volume_state rebuilt the listers
+    assert st.pv("pv-1").claim_ref == loser.replace("default/p", "default/c")
+
+
+def test_bind_pod_volumes_failure_rolls_back_and_requeues():
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.volumes import VolumeBinder
+
+    classes, pvs = _wffc_world(n_pvs=1)
+    pvcs = [PersistentVolumeClaim("c0", storage_class="local")]
+    calls = {"n": 0}
+
+    def flaky_writer(pvc, pv):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("pv write conflict")
+        pv.claim_ref = f"{pvc.namespace}/{pvc.name}"
+        pvc.volume_name = pv.name
+
+    clk = {"t": 0.0}
+    s = Scheduler(clock=lambda: clk["t"], enable_preemption=False)
+    s.volume_binder = VolumeBinder(s.cache.packer, writer=flaky_writer)
+    s.on_node_add(make_node("n-a", zone="us-a"))
+    s.set_volume_state(pvcs, pvs, classes)
+    s.on_pod_add(make_pod("p0", volumes=(PodVolume(pvc="c0"),)))
+    res = s.schedule_cycle()
+    assert res.scheduled == 0 and res.bind_errors == 1
+    assert any("VolumeBinding" in r for r in res.failure_reasons["default/p0"])
+    st = s.cache.packer.vol_state
+    # rollback: reservation released, nothing committed, pod forgotten
+    assert not st.assumed_claims
+    assert not st.pv("pv-0").claim_ref
+    assert not s.cache.is_assumed("default/p0")
+    # retry succeeds (writer works the second time)
+    clk["t"] += 30.0
+    s.queue.move_all_to_active()
+    res2 = s.schedule_cycle()
+    assert res2.assignments["default/p0"] == "n-a"
+    assert st.pv("pv-0").claim_ref == "default/c0"
+
+
+def test_assume_skips_provisionable_and_bound_claims():
+    from kubernetes_tpu.snapshot import SnapshotPacker
+    from kubernetes_tpu.volumes import VolumeBinder
+
+    classes = [
+        StorageClass("local", binding_mode=BINDING_WAIT_FOR_FIRST_CONSUMER),
+        StorageClass(
+            "dyn", binding_mode=BINDING_WAIT_FOR_FIRST_CONSUMER,
+            provisioner="csi.example.com",
+        ),
+    ]
+    pvcs = [
+        PersistentVolumeClaim("c-dyn", storage_class="dyn"),
+        PersistentVolumeClaim("c-bound", storage_class="local", volume_name="pv-x"),
+    ]
+    pvs = [PersistentVolume("pv-x", storage_class="local", claim_ref="default/c-bound")]
+    pk = SnapshotPacker()
+    pk.set_volume_state(pvcs, pvs, classes)
+    vb = VolumeBinder(pk)
+    pod = make_pod("p", volumes=(PodVolume(pvc="c-dyn"), PodVolume(pvc="c-bound")))
+    ok, msg = vb.assume_pod_volumes(pod, make_node("n0"))
+    assert ok and not vb.assumed  # nothing to reserve
+    assert not vb.bind_pod_volumes(pod)  # nothing to write
+
+
+def test_parked_pod_repop_keeps_volume_reservation():
+    """Review regression: a Permit-parked pod re-popped via a duplicate
+    queue entry must NOT overwrite/leak its PV reservation, and the failed
+    re-attempt (AssumeError) must not release the parked reservation."""
+    from kubernetes_tpu.framework import Framework, Plugin, Status, WAIT
+    from kubernetes_tpu.scheduler import Scheduler
+
+    class Gate(Plugin):
+        def permit(self, state, pod, node_name):
+            return Status(WAIT, ""), 100.0
+
+    classes, pvs = _wffc_world(n_pvs=2)
+    pvcs = [PersistentVolumeClaim("c0", storage_class="local")]
+    clk = {"t": 0.0}
+    s = Scheduler(
+        framework=Framework(plugins=[Gate()], clock=lambda: clk["t"]),
+        clock=lambda: clk["t"], enable_preemption=False,
+    )
+    s.on_node_add(make_node("n-a", zone="us-a"))
+    s.set_volume_state(pvcs, pvs, classes)
+    pod = make_pod("p0", volumes=(PodVolume(pvc="c0"),))
+    s.on_pod_add(pod)
+    res = s.schedule_cycle()
+    assert res.waiting == 1
+    st = s.cache.packer.vol_state
+    assert len(st.assumed_claims) == 1  # one PV reserved
+    held = dict(s.volume_binder.assumed)
+    # duplicate queue entry: an update event for the still-pending pod
+    s.queue.add(pod)
+    s.schedule_cycle()  # re-pop -> AssumeError path
+    # the parked reservation survived, nothing leaked
+    assert len(st.assumed_claims) == 1
+    assert s.volume_binder.assumed == held
+    # allow -> bind commits the ORIGINAL pick
+    s.framework.waiting.get("default/p0").allow()
+    res3 = s.schedule_cycle()
+    assert dict(s.binder.bindings).get("default/p0") == "n-a"
+    assert st.pvc("default", "c0").volume_name
+    assert not st.assumed_claims
+
+
+def test_parked_pod_bound_by_competing_writer_cleans_waiting():
+    """Review regression: a Permit-parked pod bound by another writer must
+    leave the waiting map and release its PV reservation; the next cycle
+    must not abort with a CacheError."""
+    from kubernetes_tpu.framework import Framework, Plugin, Status, WAIT
+    from kubernetes_tpu.scheduler import Scheduler
+
+    class Gate(Plugin):
+        def permit(self, state, pod, node_name):
+            return Status(WAIT, ""), 100.0
+
+    classes, pvs = _wffc_world(n_pvs=1)
+    pvcs = [PersistentVolumeClaim("c0", storage_class="local")]
+    clk = {"t": 0.0}
+    s = Scheduler(
+        framework=Framework(plugins=[Gate()], clock=lambda: clk["t"]),
+        clock=lambda: clk["t"], enable_preemption=False,
+    )
+    s.on_node_add(make_node("n-a", zone="us-a"))
+    s.set_volume_state(pvcs, pvs, classes)
+    pod = make_pod("p0", volumes=(PodVolume(pvc="c0"),))
+    s.on_pod_add(pod)
+    res = s.schedule_cycle()
+    assert res.waiting == 1
+    # competing writer binds it in truth; the watch event arrives
+    import dataclasses
+
+    bound = dataclasses.replace(pod, node_name="n-a")
+    s.on_pod_update(pod, bound)
+    assert s.framework.waiting.get("default/p0") is None
+    assert not s.cache.packer.vol_state.assumed_claims  # reservation freed
+    clk["t"] += 200.0  # past the permit deadline
+    s.schedule_cycle()  # must not raise
