@@ -96,6 +96,25 @@ class Histogram(_Metric):
     def count(self, **labels) -> int:
         return self._n.get(self._key(labels), 0)
 
+    def quantile(self, q: float, **labels) -> float:
+        """Prometheus histogram_quantile analog: linear interpolation
+        inside the first bucket whose cumulative count reaches q·n."""
+        k = self._key(labels)
+        n = self._n.get(k, 0)
+        if n == 0:
+            return 0.0
+        target = q * n
+        counts = self._counts[k]
+        lo = 0.0
+        prev = 0
+        for i, b in enumerate(self.buckets):
+            if counts[i] >= target:
+                in_bucket = counts[i] - prev
+                frac = (target - prev) / max(in_bucket, 1)
+                return lo + (b - lo) * min(frac, 1.0)
+            lo, prev = b, counts[i]
+        return self.buckets[-1]
+
     def expose(self) -> List[str]:
         out = []
         for k in sorted(self._n):

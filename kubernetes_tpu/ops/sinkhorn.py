@@ -65,36 +65,58 @@ def _scale_jnp(logk, log_r, log_c, iters):
 
 
 def _u_kernel(logk_ref, v_ref, logr_ref, u_ref):
-    """One row-scaling pass over a (Bp, N) tile: u = log_r - lse(logk+v)."""
-    x = logk_ref[:] + v_ref[:]  # (Bp, N)
+    """One row-scaling pass over a (Bp, N) tile: u = log_r - lse(logk+v).
+
+    All vector operands are (1, X) row vectors: Mosaic requires the
+    minor-most dim to follow the (8, 128) f32 tiling, and 1-D blocks get
+    a T(256)-style layout that conflicts with XLA's T(1024) vector layout
+    (the round-2 Mosaic verification failure)."""
+    x = logk_ref[:] + v_ref[:]  # (Bp, N) + (1, N)
     m = jnp.max(x, axis=1, keepdims=True)
     m = jnp.maximum(m, NEG_INF)  # all-masked rows stay finite
     lse = jnp.log(jnp.sum(jnp.exp(x - m), axis=1, keepdims=True) + 1e-30) + m
-    u = logr_ref[:] - lse[:, 0]
-    u_ref[:] = jnp.where(u > NEG_INF / 2, u, NEG_INF)
+    u = logr_ref[0, :] - lse[:, 0]
+    u_ref[0, :] = jnp.where(u > NEG_INF / 2, u, NEG_INF)
 
 
 def _v_kernel(logk_ref, u_ref, logc_ref, v_ref):
     """One column-scaling pass over a (P, Bn) tile, clipped at 0."""
-    x = logk_ref[:] + u_ref[:][:, None]  # (P, Bn)
+    x = logk_ref[:] + u_ref[0, :][:, None]  # (P, Bn)
     m = jnp.max(x, axis=0, keepdims=True)
     m = jnp.maximum(m, NEG_INF)
     lse = jnp.log(jnp.sum(jnp.exp(x - m), axis=0, keepdims=True) + 1e-30) + m
-    v = jnp.minimum(logc_ref[:] - lse[0, :], 0.0)
-    v_ref[:] = jnp.where(v > NEG_INF / 2, v, 0.0)
+    v = jnp.minimum(logc_ref[0, :] - lse[0, :], 0.0)
+    v_ref[0, :] = jnp.where(v > NEG_INF / 2, v, 0.0)
 
 
-def _scale_pallas(logk, log_r, log_c, iters, block_p=256, block_n=512,
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+BLOCK_P, BLOCK_N = 256, 512
+
+
+def _block_shapes(P0: int, N0: int, block_p: int = BLOCK_P,
+                  block_n: int = BLOCK_N) -> Tuple[int, int, int, int]:
+    """(bp, bn, padded P, padded N) — ONE place for the block/padding
+    arithmetic so the compile probe and the real call can never diverge.
+    Block dims double as lane dims of the (1, bp)/(1, bn) vector tiles, so
+    both must be multiples of 128 (f32 lane tiling); bp is also the
+    sublane dim of the (bp, N) tile (multiple of 8 — implied by 128)."""
+    bp = min(block_p, _round_up(P0, 128))
+    bn = min(block_n, _round_up(N0, 128))
+    return bp, bn, _round_up(P0, bp), _round_up(N0, bn)
+
+
+def _scale_pallas(logk, log_r, log_c, iters, block_p=BLOCK_P, block_n=BLOCK_N,
                   interpret=False):
     from jax.experimental import pallas as pl
 
     P0, N0 = logk.shape
-    bp, bn = min(block_p, P0), min(block_n, N0)
     # pad to block multiples (grid uses exact division); padded rows ship
     # nothing (log_r = -inf) and padded columns accept nothing (their
     # kernel column is -inf so their v never matters)
-    P = ((P0 + bp - 1) // bp) * bp
-    N = ((N0 + bn - 1) // bn) * bn
+    bp, bn, P, N = _block_shapes(P0, N0, block_p, block_n)
     if (P, N) != (P0, N0):
         logk = jnp.pad(logk, ((0, P - P0), (0, N - N0)),
                        constant_values=NEG_INF)
@@ -105,11 +127,11 @@ def _scale_pallas(logk, log_r, log_c, iters, block_p=256, block_n=512,
         grid=(P // bp,),
         in_specs=[
             pl.BlockSpec((bp, N), lambda i: (i, 0)),
-            pl.BlockSpec((N,), lambda i: (0,)),
-            pl.BlockSpec((bp,), lambda i: (i,)),
+            pl.BlockSpec((1, N), lambda i: (0, 0)),
+            pl.BlockSpec((1, bp), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((bp,), lambda i: (i,)),
-        out_shape=jax.ShapeDtypeStruct((P,), logk.dtype),
+        out_specs=pl.BlockSpec((1, bp), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, P), logk.dtype),
         interpret=interpret,
     )
     v_call = pl.pallas_call(
@@ -117,25 +139,47 @@ def _scale_pallas(logk, log_r, log_c, iters, block_p=256, block_n=512,
         grid=(N // bn,),
         in_specs=[
             pl.BlockSpec((P, bn), lambda j: (0, j)),
-            pl.BlockSpec((P,), lambda j: (0,)),
-            pl.BlockSpec((bn,), lambda j: (j,)),
+            pl.BlockSpec((1, P), lambda j: (0, 0)),
+            pl.BlockSpec((1, bn), lambda j: (0, j)),
         ],
-        out_specs=pl.BlockSpec((bn,), lambda j: (j,)),
-        out_shape=jax.ShapeDtypeStruct((N,), logk.dtype),
+        out_specs=pl.BlockSpec((1, bn), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((1, N), logk.dtype),
         interpret=interpret,
     )
+    log_r2 = log_r[None, :]
+    log_c2 = log_c[None, :]
 
     def body(carry, _):
         u, v = carry
-        u = u_call(logk, v, log_r)
-        v = v_call(logk, u, log_c)
+        u = u_call(logk, v, log_r2)
+        v = v_call(logk, u, log_c2)
         return (u, v), None
 
     (u, v), _ = jax.lax.scan(
-        body, (jnp.zeros((P,), logk.dtype), jnp.zeros((N,), logk.dtype)),
+        body,
+        (jnp.zeros((1, P), logk.dtype), jnp.zeros((1, N), logk.dtype)),
         None, length=iters,
     )
-    return u[:P0], v[:N0]
+    return u[0, :P0], v[0, :N0]
+
+
+@functools.lru_cache(maxsize=64)
+def _pallas_compiles(P: int, N: int) -> bool:
+    """One-time compile probe at the exact padded shape: Mosaic layout
+    verification happens at compile time inside whatever jit wraps the
+    solver, where a try/except around the traced call can't catch it. A
+    failed probe downgrades to `_scale_jnp` (same math, any backend)
+    instead of killing the whole gang variant (round-2 weak #9)."""
+    try:
+        u, v = jax.jit(functools.partial(_scale_pallas, iters=1))(
+            jnp.zeros((P, N), jnp.float32),
+            jnp.zeros((P,), jnp.float32),
+            jnp.zeros((N,), jnp.float32),
+        )
+        jax.block_until_ready((u, v))
+        return True
+    except Exception:
+        return False
 
 
 def use_pallas() -> bool:
@@ -171,6 +215,13 @@ def sinkhorn_plan(
         pallas = use_pallas()
     if pallas:
         interp = (jax.default_backend() != "tpu") if interpret is None else interpret
+        if not interp:
+            # compiled mode: probe the exact padded shape first; fall back
+            # to the jnp path on Mosaic failure instead of propagating a
+            # compile error out of the caller's jit
+            _, _, P, N = _block_shapes(*logk.shape)
+            pallas = _pallas_compiles(P, N)
+    if pallas:
         u, v = _scale_pallas(logk, log_r, log_c, iters, interpret=interp)
     else:
         u, v = _scale_jnp(logk, log_r, log_c, iters)

@@ -1,0 +1,73 @@
+"""Real-TPU compiled-mode Pallas tests (VERDICT r2 #1c: the one mode that
+matters had zero coverage).
+
+Kept OUTSIDE tests/ on purpose: tests/conftest.py pins the whole suite to
+the 8-virtual-device CPU mesh and must never touch the TPU tunnel (a
+wedged claim hangs every later backend init in the container). Run these
+manually on a machine with the real chip:
+
+    python -m pytest tests_tpu/ -q
+
+They skip everywhere else.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu", reason="requires a real TPU backend"
+)
+
+
+@pytest.mark.parametrize("shape", [(64, 16), (303, 41), (2048, 1024)])
+def test_compiled_pallas_matches_jnp(shape):
+    from kubernetes_tpu.ops.sinkhorn import sinkhorn_plan
+
+    P, N = shape
+    rng = np.random.RandomState(0)
+    score = jnp.asarray(rng.uniform(0, 10, (P, N)).astype(np.float32))
+    mask = jnp.asarray(rng.uniform(size=(P, N)) > 0.3)
+    cap = jnp.asarray(rng.randint(1, 5, N).astype(np.float32))
+    a = np.asarray(sinkhorn_plan(score, mask, cap, iters=15, pallas=False))
+    b = np.asarray(
+        sinkhorn_plan(score, mask, cap, iters=15, pallas=True, interpret=False)
+    )
+    assert np.allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_compile_probe_passes_at_gang_scale():
+    """The config-4 gang shape (1k groups x 32 pods over 5k nodes) must
+    compile — the round-2 Mosaic layout failure reproduced exactly here."""
+    from kubernetes_tpu.ops.sinkhorn import _block_shapes, _pallas_compiles
+
+    _, _, P, N = _block_shapes(8192, 5120)
+    assert _pallas_compiles(P, N)
+
+
+def test_gang_batch_assign_compiled_end_to_end():
+    """The full gang path (batch_assign with use_sinkhorn=True) on the
+    real chip — the code path BENCH's gang variant runs."""
+    from kubernetes_tpu.models.cluster import make_gang_pods, make_nodes
+    from kubernetes_tpu.ops.arrays import (
+        nodes_to_device,
+        pods_to_device,
+        selectors_to_device,
+    )
+    from kubernetes_tpu.ops.assign import batch_assign
+    from kubernetes_tpu.snapshot import SnapshotPacker
+
+    nodes = make_nodes(64, zones=4)
+    pods = make_gang_pods(8, 16)
+    pk = SnapshotPacker()
+    for p in pods:
+        pk.intern_pod(p)
+    dn = nodes_to_device(pk.pack_nodes(nodes, []))
+    ds = selectors_to_device(pk.pack_selector_tables())
+    dp = pods_to_device(pk.pack_pods(pods), pad_to=128)
+    assigned, usage, rounds = batch_assign(dp, dn, ds, per_node_cap=8,
+                                           use_sinkhorn=True)
+    a = np.asarray(assigned)[: len(pods)]
+    assert (a >= 0).all()
