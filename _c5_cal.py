@@ -1,0 +1,35 @@
+import os, time
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+print("jax up, devices:", len(jax.devices()), flush=True)
+from bench import Workload, build_variant
+t0 = time.time()
+from kubernetes_tpu.models.cluster import make_nodes, make_pods
+nodes = make_nodes(50000, zones=10)
+print(f"make_nodes: {time.time()-t0:.1f}s", flush=True)
+t0 = time.time()
+w = Workload(nodes, [], make_pods(2048, "bench"))
+print(f"Workload pack: {time.time()-t0:.1f}s", flush=True)
+from kubernetes_tpu.parallel import make_mesh, shard_nodes, replicate
+from kubernetes_tpu.ops.assign import batch_assign, nodes_with_usage
+mesh = make_mesh()
+t0 = time.time()
+dn = shard_nodes(w.dn, mesh); ds = replicate(w.ds, mesh)
+print(f"shard_nodes: {time.time()-t0:.1f}s", flush=True)
+t0 = time.time()
+dp, dv = w.device_batch(w.pending[:1024], 1024)
+dp = replicate(dp, mesh)
+print(f"batch pack: {time.time()-t0:.1f}s", flush=True)
+t0 = time.time()
+a, u, r = batch_assign(dp, dn, ds, per_node_cap=8)
+a.block_until_ready()
+print(f"first batch (compile incl): {time.time()-t0:.1f}s rounds={int(r)}", flush=True)
+t0 = time.time()
+dp, dv = w.device_batch(w.pending[1024:2048], 1024)
+dp = replicate(dp, mesh)
+a, u, r = batch_assign(dp, nodes_with_usage(dn, u), ds, per_node_cap=8)
+placed = int((np.asarray(a)[:1024] >= 0).sum())
+dt = time.time()-t0
+print(f"steady batch: {dt:.2f}s = {1024/dt:.0f} pods/s placed={placed}", flush=True)
+import resource
+print(f"peak rss: {resource.getrusage(resource.RUSAGE_SELF).ru_maxrss/1e6:.1f} GB", flush=True)

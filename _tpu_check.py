@@ -1,0 +1,15 @@
+import time, numpy as np, jax, jax.numpy as jnp
+print("backend:", jax.default_backend(), jax.devices(), flush=True)
+from kubernetes_tpu.ops.sinkhorn import sinkhorn_plan
+
+rng = np.random.RandomState(0)
+for (P, N) in [(303, 41), (8192, 5120)]:
+    score = jnp.asarray(rng.uniform(0, 10, (P, N)).astype(np.float32))
+    mask = jnp.asarray(rng.uniform(size=(P, N)) > 0.3)
+    cap = jnp.asarray(rng.randint(1, 5, N).astype(np.float32))
+    t0 = time.time()
+    b = np.asarray(sinkhorn_plan(score, mask, cap, iters=15, pallas=True, interpret=False))
+    t1 = time.time()
+    a = np.asarray(sinkhorn_plan(score, mask, cap, iters=15, pallas=False))
+    print(f"P={P} N={N} pallas_wall={t1-t0:.1f}s allclose={np.allclose(a,b,rtol=1e-4,atol=1e-5)} maxdiff={np.abs(np.asarray(a)-b).max():.2e}", flush=True)
+print("OK", flush=True)

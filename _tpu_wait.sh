@@ -1,0 +1,11 @@
+#!/bin/bash
+for i in $(seq 1 200); do
+  if timeout 90 python -u -c "import jax; print(jax.devices())" >/dev/null 2>&1; then
+    echo "tunnel clear after attempt $i at $(date +%T)"
+    timeout 560 python -u _tpu_check.py 2>&1 | grep -v WARNING
+    exit 0
+  fi
+  echo "attempt $i: still wedged at $(date +%T)"
+  sleep 60
+done
+echo "never cleared"

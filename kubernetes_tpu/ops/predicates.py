@@ -62,6 +62,98 @@ PREDICATE_BITS = (
 )
 BIT = {name: i for i, name in enumerate(PREDICATE_BITS)}
 
+# Human-readable failure text per predicate bit, mirroring the reference's
+# error vars (algorithm/predicates/error.go:35-79) so FitError events read
+# identically. CheckNodeCondition and PodFitsResources are special-cased in
+# :func:`fit_error_message` (split into not-ready/network-unavailable and
+# per-resource "Insufficient <res>" counts respectively).
+REASON_MESSAGES = {
+    "CheckNodeUnschedulable": "node(s) were unschedulable",
+    "PodToleratesNodeTaints": "node(s) had taints that the pod didn't tolerate",
+    "CheckNodeMemoryPressure": "node(s) had memory pressure",
+    "CheckNodeDiskPressure": "node(s) had disk pressure",
+    "CheckNodePIDPressure": "node(s) had pid pressure",
+    "PodFitsHost": "node(s) didn't match the requested hostname",
+    "PodFitsHostPorts": "node(s) didn't have free ports for the requested pod ports",
+    "PodMatchNodeSelector": "node(s) didn't match node selector",
+    "MatchInterPodAffinity": "node(s) didn't match pod affinity/anti-affinity",
+    "EvenPodsSpread": "node(s) didn't match pod topology spread constraints",
+    "NoDiskConflict": "node(s) had no available disk",
+    "MaxVolumeCount": "node(s) exceed max volume count",
+    "NoVolumeZoneConflict": "node(s) had no available volume zone",
+    "VolumeNodeConflict": "node(s) had volume node affinity conflict",
+    "VolumeBindConflict": "node(s) didn't find available persistent volumes to bind",
+    "VolumeError": "node(s) had unresolvable volume state",
+}
+
+
+def fit_error_message(rrow, nvalid, req, free, ready, net_unavail,
+                      res_names) -> str:
+    """FitError.Error() parity (core/generic_scheduler.go:105-122): build
+    "0/N nodes are available: <count> <reason>, ..." with per-reason NODE
+    COUNTS (sorted as strings, like sortReasonsHistogram), instead of the
+    round-2 bare union of reason names.
+
+    ``rrow`` (N,) int32 reason bits for one pod; ``nvalid`` (N,) node
+    validity; ``req`` (R,) the pod's request; ``free`` (N, R) allocatable
+    minus final usage; ``ready``/``net_unavail`` (N,) node flags;
+    ``res_names`` resource-column names. All numpy, host-side — this runs
+    only for unplaced pods.
+
+    Two splits recover reference fidelity lost to bit packing:
+    - PodFitsResources → per-resource "Insufficient cpu/memory/..."
+      (InsufficientResourceError.GetReason, error.go:111).
+    - CheckNodeCondition → "node(s) were not ready" vs "node(s) had
+      unavailable network" (error.go:67,:69; a node can contribute both,
+      matching CheckNodeConditionPredicate's reasons list,
+      predicates.go:1631-1640).
+    """
+    import numpy as np
+
+    hist: dict = {}
+    r = np.asarray(rrow)[nvalid]
+    n = int(np.count_nonzero(nvalid))
+    for name, b in BIT.items():
+        fired = ((r >> b) & 1).astype(bool)
+        cnt = int(fired.sum())
+        if not cnt:
+            continue
+        if name == "PodFitsResources":
+            fv = free[nvalid]
+            # all-zero-request pods fail ONLY on the pod-count cap
+            # (resource_fit_mask's pods_only branch; predicates.go:803-809
+            # quick-return) — scanning every column would fabricate
+            # "Insufficient cpu" counts on overcommitted nodes
+            nonzero = any(
+                req[ri] > 0 for ri in range(len(res_names))
+                if res_names[ri] != "pods"
+            )
+            cols = (
+                range(len(res_names)) if nonzero
+                else [res_names.index("pods")]
+            )
+            for ri in cols:
+                c = int((fired & (req[ri] > fv[:, ri] + 1e-6)).sum())
+                if c:
+                    key = f"Insufficient {res_names[ri]}"
+                    hist[key] = hist.get(key, 0) + c
+        elif name == "CheckNodeCondition":
+            c_nr = int((fired & ~ready[nvalid]).sum())
+            c_nu = int((fired & net_unavail[nvalid]).sum())
+            if c_nr:
+                hist["node(s) were not ready"] = (
+                    hist.get("node(s) were not ready", 0) + c_nr
+                )
+            if c_nu:
+                hist["node(s) had unavailable network"] = (
+                    hist.get("node(s) had unavailable network", 0) + c_nu
+                )
+        else:
+            msg = REASON_MESSAGES[name]
+            hist[msg] = hist.get(msg, 0) + cnt
+    parts = sorted(f"{v} {k}" for k, v in hist.items())
+    return f"0/{n} nodes are available: {', '.join(parts)}."
+
 
 def selector_program_match(sel: DeviceSelectors, nodes: DeviceNodes) -> jnp.ndarray:
     """(G, N) bool: does node satisfy required selector program g?
@@ -167,7 +259,11 @@ def run_predicates(
         return jnp.where(fail_row[None, :], jnp.int32(1 << bit), 0)
 
     # CheckNodeCondition (predicates.go:1625): not-ready or
-    # network-unavailable fails all pods.
+    # network-unavailable fails all pods. Full condition list parity with
+    # v1.16 (predicates.go:1631-1640): only NodeReady and
+    # NodeNetworkUnavailable are consulted — the out-of-disk condition no
+    # longer exists at this version (no OutOfDisk reference anywhere under
+    # pkg/scheduler/); spec.unschedulable is the separate bit below.
     reasons |= nodewise(
         ~nodes.ready | nodes.network_unavailable, BIT["CheckNodeCondition"]
     )

@@ -203,7 +203,11 @@ def pick_one_node(
         return (
             pdb,
             high,
-            sum(v.priority for v in victims),
+            # each victim contributes priority + (MaxInt32+1) so the count
+            # of victims dominates negative priorities — a node with few
+            # negative-priority victims must not lose to one with fewer
+            # total-priority but more pods (generic_scheduler.go:921-928)
+            sum(v.priority + 2**31 for v in victims),
             len(victims),
             -max(v.start_time for v in victims if v.priority == high),
         )

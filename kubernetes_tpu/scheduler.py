@@ -112,6 +112,9 @@ class CycleResult:
     rounds: int = 0
     assignments: Dict[str, str] = field(default_factory=dict)  # pod key -> node
     failure_reasons: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    #: pod key -> FitError.Error()-shaped message with per-reason node
+    #: counts (only for pods that failed the filter pass)
+    fit_errors: Dict[str, str] = field(default_factory=dict)
     preempted: int = 0  # victims deleted this cycle
     nominations: Dict[str, str] = field(default_factory=dict)  # pod -> node
     waiting: int = 0  # pods parked by Permit plugins this cycle
@@ -178,6 +181,8 @@ class Scheduler:
         self.binder = binder or RecordingBinder()
         self.weights = weights
         self.solver = solver
+        #: count of exact->round auto-fallbacks (port/volume/topology batches)
+        self.exact_fallbacks = 0
         self.per_node_cap = per_node_cap
         self.max_rounds = max_rounds
         self.max_batch = max_batch
@@ -501,14 +506,48 @@ class Scheduler:
             ).mask
             extra_mask = nom_mask if extra_mask is None else (extra_mask & nom_mask)
 
-        if self.solver == "greedy":
+        solver = self.solver
+        if solver == "exact":
+            # The exact Hungarian models capacity as per-node SLOTS only:
+            # in-batch coupling through host ports, volumes, or topology
+            # terms is not in its constraint matrix, so two co-admitted
+            # pods could silently conflict. Round 2 documented the blind
+            # spot in a docstring; now it's structural — hazardous batches
+            # auto-fall back to the round solver, which models all three
+            # (one-per-node-per-round guards in ops/assign.py).
+            hazards = []
+            # batch-scoped: in-batch coupling needs THIS batch's pods to
+            # carry terms (dt reflects the monotonic universe — one
+            # affinity pod ever seen would disable exact forever)
+            if dt is not None and any(
+                p.affinity.pod_affinity_required
+                or p.affinity.pod_anti_affinity_required
+                or p.affinity.pod_affinity_preferred
+                or p.affinity.pod_anti_affinity_preferred
+                or p.topology_spread
+                for p in batch
+            ):
+                hazards.append("topology")
+            if dv is not None:
+                hazards.append("volumes")
+            if float(np.asarray(jnp.sum(dp.port_wild_pp))
+                     + np.asarray(jnp.sum(dp.port_spec_pp))) > 0:
+                hazards.append("host-ports")
+            if hazards:
+                self.exact_fallbacks += 1
+                trace.step(
+                    f"exact solver unsafe with {'+'.join(hazards)}; "
+                    "using round solver"
+                )
+                solver = "batch"
+        if solver == "greedy":
             assigned, usage = greedy_assign(
                 dp, dn, ds, self.weights, topo=dt, extra_mask=extra_mask,
                 vol=dv, static_vol=sv, enabled_mask=self.pred_mask,
                 extra_score=extra_score,
             )
             rounds = len(batch)
-        elif self.solver == "exact":
+        elif solver == "exact":
             assigned, usage, rounds = self._exact_solve(
                 dp, dn, ds, dt, base_fr, extra_mask, extra_score
             )
@@ -523,7 +562,7 @@ class Scheduler:
                 static_vol=sv,
                 enabled_mask=self.pred_mask,
                 extra_score=extra_score,
-                use_sinkhorn=(self.solver == "sinkhorn"),
+                use_sinkhorn=(solver == "sinkhorn"),
             )
         assigned = np.array(assigned)[: len(batch)]  # writable copy
 
@@ -556,7 +595,7 @@ class Scheduler:
                 jnp.asarray(np.maximum(pad_assigned, 0)),
                 jnp.asarray(pad_assigned >= 0) & dp.valid,
             )
-        res.rounds = int(rounds) if self.solver != "greedy" else rounds
+        res.rounds = int(rounds) if solver != "greedy" else rounds
         solve_s = trace.total_s()
         trace.step(f"solve done ({res.rounds} rounds)")
         self.metrics.algorithm_duration.observe(solve_s)
@@ -565,17 +604,33 @@ class Scheduler:
         # post-assignment usage (what the serial loop would have seen last)
         failed_idx = [i for i, a in enumerate(assigned) if a < 0]
         reasons_row: Dict[int, Tuple[str, ...]] = {}
+        fit_msgs: Dict[int, str] = {}
         rmat = None
         if failed_idx:
+            from kubernetes_tpu.ops.predicates import fit_error_message
+            from kubernetes_tpu.snapshot import FIXED_RESOURCE_NAMES
+
             fr = _filter_pass(
                 dp, nodes_with_usage(dn, usage), ds, dt, dv, sv, self.pred_mask
             )
             rmat = np.asarray(fr.reasons)
             nvalid = np.asarray(dn.valid)
+            free = np.asarray(dn.allocatable) - np.asarray(usage.requested)
+            reqs = np.asarray(dp.req)
+            ready = np.asarray(dn.ready)
+            netun = np.asarray(dn.network_unavailable)
+            res_names = (list(FIXED_RESOURCE_NAMES)
+                         + pk.u.scalar_resources.items())[: reqs.shape[1]]
             for i in failed_idx:
                 # a pod's reason set = union over valid nodes of failed bits
                 bits = int(np.bitwise_or.reduce(rmat[i][nvalid])) if nvalid.any() else 0
                 reasons_row[i] = decode_reasons(bits)
+                if bits:
+                    # FitError-shaped event text with per-reason node
+                    # counts ("2 Insufficient cpu, 3 node(s) had taints...")
+                    fit_msgs[i] = fit_error_message(
+                        rmat[i], nvalid, reqs[i], free, ready, netun, res_names
+                    )
 
         from kubernetes_tpu.framework import WAIT as _WAIT
 
@@ -588,7 +643,14 @@ class Scheduler:
                     reasons = (gang_failed[i],)
                 else:
                     reasons = reasons_row.get(i, ())
-                self._fail(pod, cycle, res, reasons)
+                # only filter-pass failures carry the FitError text; gang
+                # rollbacks and plugin failures keep their own status (a
+                # gang member may fit everywhere — a fabricated "0/N nodes
+                # are available" would be a lie)
+                msg = (fit_msgs.get(i)
+                       if i not in early_fail and i not in gang_failed
+                       else None)
+                self._fail(pod, cycle, res, reasons, message=msg)
                 continue
             node_name = node_order[target]
             st = self._cycle_states.get(pod.key()) or CycleState()
@@ -968,13 +1030,21 @@ class Scheduler:
             # unschedulableQ until the 60 s leftover flush
             self.queue.move_all_to_active()
 
-    def _fail(self, pod: Pod, cycle: int, res: CycleResult, reasons) -> None:
+    def _fail(self, pod: Pod, cycle: int, res: CycleResult, reasons,
+              message: str = None) -> None:
         res.unschedulable += 1
         res.failure_reasons[pod.key()] = tuple(reasons)
+        if message is not None:
+            res.fit_errors[pod.key()] = message
         self._cycle_states.pop(pod.key(), None)  # cycle over for this pod
         self.queue.record_failure(pod)
         self.queue.add_unschedulable_if_not_present(pod, cycle)
-        self.event_sink("FailedScheduling", pod, ",".join(reasons))
+        # events carry the FitError-shaped per-reason node counts when the
+        # failure came from the filter pass (FitError.Error parity,
+        # generic_scheduler.go:105-122); plugin/gang failures keep their
+        # status text
+        self.event_sink("FailedScheduling", pod,
+                        message if message is not None else ",".join(reasons))
 
     def run_until_settled(self, max_cycles: int = 50) -> List[CycleResult]:
         """Drive cycles until nothing schedules (tests + sim harness)."""

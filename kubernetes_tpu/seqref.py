@@ -587,7 +587,79 @@ def serial_schedule(
     """The reference's serial driver loop (scheduler.go:462 scheduleOne):
     pods in activeQ order (priority desc, arrival asc), each scoring the
     cluster as it stands, argmax with lowest-index tie-break. Returns
-    (node_index or -1, winning score) per pod, in the original pod order."""
+    (node_index or -1, winning score) per pod, in the original pod order.
+    Base predicates/priorities only; :func:`serial_schedule_full` adds the
+    topology + volume surface over the same loop."""
+    return _serial_schedule(pending, nodes, scheduled, full=False,
+                            vol_state=None)
+
+
+def serial_schedule_full(
+    pending: Sequence[Pod],
+    nodes: Sequence[Node],
+    scheduled: Sequence[Pod],
+    vol_state=None,
+) -> List[Tuple[int, float]]:
+    """:func:`serial_schedule` with the FULL default surface — inter-pod
+    affinity, topology spread, and (when ``vol_state`` is given) the five
+    volume predicates — the end-to-end oracle for the differential fuzz
+    campaign (SURVEY §4 implication (a)). Metadata is recomputed per pod
+    against the live node_pods state, exactly like scheduleOne's
+    GetMetadata each cycle (predicates/metadata.go:152)."""
+    return _serial_schedule(pending, nodes, scheduled, full=True,
+                            vol_state=vol_state)
+
+
+def _oracle_assume_volumes(pod: Pod, node: Node, state) -> None:
+    """Mirror VolumeBinder.assume_pod_volumes' PV picks (volumes.py:332):
+    after the oracle places a pod, unbound WaitForFirstConsumer claims take
+    the first compatible available PV so later pods in the same run see it
+    as spoken for — without this, delayed-binding PV capacity would be
+    double-spent and the oracle would diverge from the driver's
+    assume-then-commit flow."""
+    from kubernetes_tpu.volumes import (
+        BINDING_WAIT_FOR_FIRST_CONSUMER,
+        match_node_selector_terms,
+    )
+
+    for v in pod.volumes:
+        if not v.pvc:
+            continue
+        pvc = state.pvc(pod.namespace, v.pvc)
+        if pvc is None or pvc.volume_name:
+            continue
+        sc = state.storage_class(pvc.storage_class) if pvc.storage_class else None
+        if (sc is None or sc.binding_mode != BINDING_WAIT_FOR_FIRST_CONSUMER
+                or sc.provisionable()):
+            continue
+        for pv in state.available_pvs(pvc.storage_class):
+            if not pv.node_affinity or match_node_selector_terms(
+                node.labels, pv.node_affinity
+            ):
+                state.assumed_claims[pv.name] = f"{pod.namespace}/{pvc.name}"
+                break
+
+
+def _serial_schedule(
+    pending: Sequence[Pod],
+    nodes: Sequence[Node],
+    scheduled: Sequence[Pod],
+    full: bool,
+    vol_state,
+) -> List[Tuple[int, float]]:
+    """One shared loop for both oracles (the score blend and tie-break live
+    HERE only). ``full`` adds interpod-affinity + spread feasibility and
+    the InterPodAffinityPriority score (weight 1, defaults.go:119);
+    ``vol_state`` adds the five volume predicates plus assume-tracking.
+    Placed pods keep their full spec (dataclasses.replace) so later pods
+    see their labels/affinity/volumes as existing state."""
+    import dataclasses
+
+    if vol_state is not None:
+        # private assumed-claims ledger: the oracle mutates it as it places
+        vol_state = dataclasses.replace(
+            vol_state, assumed_claims=dict(vol_state.assumed_claims)
+        )
     node_pods: Dict[str, List[Pod]] = {nd.name: [] for nd in nodes}
     for p in scheduled:
         if p.node_name in node_pods:
@@ -597,18 +669,34 @@ def serial_schedule(
     out: List[Tuple[int, float]] = [(-1, 0.0)] * len(pending)
     for i in order:
         pod = pending[i]
-        mask = [[feasible(pod, nd, node_pods[nd.name]) for nd in nodes]]
-        if not any(mask[0]):
+        row = []
+        for nd in nodes:
+            ok = feasible(pod, nd, node_pods[nd.name])
+            if ok and full:
+                ok = (
+                    inter_pod_affinity_feasible(pod, nd, nodes, node_pods)
+                    and even_pods_spread_feasible(pod, nd, nodes, node_pods)
+                )
+            if ok and vol_state is not None:
+                ok = volumes_feasible(pod, nd, node_pods[nd.name], vol_state)
+            row.append(ok)
+        if not any(row):
             continue
+        mask = [row]
         w = DEFAULT_WEIGHTS
         taint = taint_toleration_scores([pod], nodes, mask)[0]
         aff = node_affinity_scores([pod], nodes, mask)[0]
         spread = selector_spread_scores([pod], nodes, node_pods, mask)[0]
         img = image_locality_scores([pod], nodes)[0]
         avoid = prefer_avoid_scores([pod], nodes)[0]
+        ipa = (
+            interpod_affinity_scores([pod], nodes, node_pods, mask)[0]
+            if full
+            else [0] * len(nodes)
+        )
         best_j, best_s = -1, None
         for j, nd in enumerate(nodes):
-            if not mask[0][j]:
+            if not row[j]:
                 continue
             s = (
                 w["LeastRequestedPriority"] * least_requested_score(pod, nd, node_pods[nd.name])
@@ -618,15 +706,14 @@ def serial_schedule(
                 + w["SelectorSpreadPriority"] * spread[j]
                 + w["ImageLocalityPriority"] * img[j]
                 + w["NodePreferAvoidPodsPriority"] * avoid[j]
+                + ipa[j]  # InterPodAffinityPriority weight 1 (defaults.go:119)
             )
             if best_s is None or s > best_s:
                 best_j, best_s = j, s
-        placed = Pod(
-            name=pod.name, namespace=pod.namespace, labels=dict(pod.labels),
-            node_name=nodes[best_j].name, requests=pod.requests,
-            host_ports=pod.host_ports, tolerations=pod.tolerations,
-        )
+        placed = dataclasses.replace(pod, node_name=nodes[best_j].name)
         node_pods[nodes[best_j].name].append(placed)
+        if vol_state is not None:
+            _oracle_assume_volumes(placed, nodes[best_j], vol_state)
         out[i] = (best_j, float(best_s))
     return out
 

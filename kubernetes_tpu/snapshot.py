@@ -62,6 +62,8 @@ from kubernetes_tpu.volumes import (
 # Mirrors nodeinfo.Resource (node_info.go:146).
 RES_CPU, RES_MEM, RES_EPH, RES_PODS = 0, 1, 2, 3
 N_FIXED_RESOURCES = 4
+#: column names in RES_* order (events/FitError text; scalars append after)
+FIXED_RESOURCE_NAMES = ("cpu", "memory", "ephemeral-storage", "pods")
 
 # Expression opcodes for the device-side selector interpreter.
 XOP_IN, XOP_NOT_IN, XOP_EXISTS, XOP_NOT_EXISTS, XOP_GT, XOP_LT = range(6)

@@ -192,3 +192,97 @@ def test_run_until_settled_drains_queue():
     total = sum(r.scheduled for r in results)
     assert total == 8  # pods cap: 4 per node x 2 nodes
     assert s.queue.pending_counts()["unschedulable"] == 4
+
+
+def test_fit_error_per_reason_node_counts():
+    """FitError.Error parity (generic_scheduler.go:105-122): events carry
+    per-reason NODE COUNTS, not a bare union of reason names."""
+    from kubernetes_tpu.api.types import Taint
+
+    from kubernetes_tpu.events import EventRecorder
+
+    rec = EventRecorder()
+    s = Scheduler(clock=FakeClock(), enable_preemption=False,
+                  event_sink=rec.sink())
+    # two nodes too small (Insufficient cpu), one tainted but big enough
+    s.on_node_add(make_node("small-0", cpu_milli=500))
+    s.on_node_add(make_node("small-1", cpu_milli=500))
+    s.on_node_add(make_node("tainted", cpu_milli=64000,
+                            taints=(Taint("k", "v", "NoSchedule"),)))
+    s.on_pod_add(make_pod("p", cpu_milli=1000))
+    res = s.schedule_cycle()
+    assert res.scheduled == 0
+    msg = res.fit_errors["default/p"]
+    assert msg.startswith("0/3 nodes are available: ")
+    assert "2 Insufficient cpu" in msg
+    assert "1 node(s) had taints that the pod didn't tolerate" in msg
+    assert msg.endswith(".")
+    # the event text matches the fit error
+    ev = [e for e in rec.events("default/p")
+          if e.reason == "FailedScheduling"]
+    assert ev and ev[-1].message == msg
+
+
+def test_fit_error_splits_insufficient_resources():
+    s = Scheduler(clock=FakeClock(), enable_preemption=False)
+    s.on_node_add(make_node("n0", cpu_milli=500, memory=2**30))
+    s.on_pod_add(make_pod("p", cpu_milli=1000, memory=2 * 2**30))
+    res = s.schedule_cycle()
+    msg = res.fit_errors["default/p"]
+    assert "1 Insufficient cpu" in msg and "1 Insufficient memory" in msg
+
+
+def test_exact_solver_falls_back_on_host_ports():
+    """The exact Hungarian cannot model in-batch port coupling; such
+    batches must auto-fall back to the round solver (VERDICT r2 #6)."""
+    s = Scheduler(solver="exact", clock=FakeClock(), enable_preemption=False)
+    for i in range(2):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=4000))
+    # three pods demanding the same host port: at most one per node
+    for i in range(3):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=100,
+                              host_ports=(("", "TCP", 8080),)))
+    res = s.schedule_cycle()
+    assert s.exact_fallbacks == 1
+    assert res.scheduled == 2  # one per node; the third waits
+    nodes = list(res.assignments.values())
+    assert len(set(nodes)) == 2
+
+
+def test_exact_solver_still_used_for_plain_batches():
+    s = Scheduler(solver="exact", clock=FakeClock(), enable_preemption=False)
+    for i in range(4):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=2000))
+    for i in range(8):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=900))
+    res = s.schedule_cycle()
+    assert s.exact_fallbacks == 0
+    assert res.scheduled == 8
+
+
+def test_exact_solver_hazard_is_batch_scoped():
+    """A topology pod seen in an earlier cycle must not disable the exact
+    solver for later plain batches (the universe interners are monotonic;
+    the hazard check must look at THIS batch)."""
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        LabelSelector,
+        PodAffinityTerm,
+    )
+
+    clk = FakeClock()
+    s = Scheduler(solver="exact", clock=clk, enable_preemption=False)
+    for i in range(2):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=4000))
+    aff = Affinity(pod_anti_affinity_required=(PodAffinityTerm(
+        label_selector=LabelSelector(match_labels={"app": "x"}),
+        topology_key="kubernetes.io/hostname",
+    ),))
+    s.on_pod_add(make_pod("a0", cpu_milli=100, labels={"app": "x"},
+                          affinity=aff))
+    s.schedule_cycle()
+    assert s.exact_fallbacks == 1
+    s.on_pod_add(make_pod("plain", cpu_milli=100))
+    res = s.schedule_cycle()
+    assert res.scheduled == 1
+    assert s.exact_fallbacks == 1  # no new fallback: batch had no topo terms

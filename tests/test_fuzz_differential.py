@@ -1,0 +1,320 @@
+"""Differential fuzz campaign (VERDICT r2 #7; SURVEY §4 implication (a)):
+randomized clusters mixing topology + volumes + priorities, the FULL
+driver (Scheduler, greedy solver) vs the sequential oracle
+(seqref.serial_schedule_full) end-to-end, many seeds; plus the preemption
+scenario tables ported from core/generic_scheduler_test.go:1198
+(TestPickOneNodeForPreemption) run against our victim-selection + 6-tier
+pick.
+
+Seed count: FUZZ_SEEDS env (default 200). All seeds share one label/zone
+vocabulary and fixed-size pod groups so interner universes land in the
+same power-of-two buckets — one jit compile serves the whole campaign.
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+import pyref
+from kubernetes_tpu.api.types import (
+    Affinity,
+    LabelSelector,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.models.cluster import make_pv_pods
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+from kubernetes_tpu.volumes import VolumeState
+
+ZONE = "failure-domain.beta.kubernetes.io/zone"
+HOSTNAME = "kubernetes.io/hostname"
+
+N_SEEDS = int(os.environ.get("FUZZ_SEEDS", 200))
+
+
+class FakeClock:
+    t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _term(key, labels):
+    return PodAffinityTerm(
+        label_selector=LabelSelector(match_labels=dict(labels)),
+        topology_key=key,
+    )
+
+
+def fuzz_cluster(rng: random.Random):
+    """One randomized cluster drawing every constraint family from a FIXED
+    vocabulary (stable interner buckets across seeds)."""
+    n_nodes = 8
+    apps = ["web", "db", "cache", "batch"]
+    nodes = [
+        make_node(
+            f"n{i}",
+            cpu_milli=rng.choice([2000, 4000, 8000]),
+            memory=rng.choice([4 * 2**30, 16 * 2**30]),
+            pods=rng.choice([6, 110]),
+            labels={"disk": rng.choice(["ssd", "hdd"])},
+            zone=f"z{i % 3}",
+        )
+        for i in range(n_nodes)
+    ]
+    existing = []
+    for i in range(10):
+        app = rng.choice(apps)
+        p = make_pod(
+            f"old{i}",
+            cpu_milli=rng.choice([100, 500]),
+            memory=2**28,
+            labels={"app": app},
+            node_name=f"n{rng.randrange(n_nodes)}",
+        )
+        if rng.random() < 0.3:
+            p.affinity = Affinity(
+                pod_anti_affinity_required=(_term(ZONE, {"app": app}),)
+            )
+        existing.append(p)
+
+    pending = []
+    for i in range(6):  # base pods with priorities
+        pending.append(
+            make_pod(
+                f"base{i}",
+                cpu_milli=rng.choice([0, 100, 1000]),
+                memory=rng.choice([0, 2**28]),
+                labels={"app": rng.choice(apps)},
+                priority=rng.choice([0, 0, 10, 100]),
+                node_selector=(
+                    {"disk": rng.choice(["ssd", "hdd"])}
+                    if rng.random() < 0.4
+                    else None
+                ),
+            )
+        )
+    for i in range(4):  # pod-affinity / anti-affinity pods
+        app = rng.choice(apps)
+        kind = rng.random()
+        aff = (
+            Affinity(pod_affinity_required=(_term(ZONE, {"app": app}),))
+            if kind < 0.5
+            else Affinity(
+                pod_anti_affinity_required=(
+                    _term(rng.choice([ZONE, HOSTNAME]), {"app": app}),
+                )
+            )
+        )
+        pending.append(
+            make_pod(
+                f"aff{i}",
+                cpu_milli=100,
+                memory=2**27,
+                labels={"app": app},
+                affinity=aff,
+                priority=rng.choice([0, 10]),
+            )
+        )
+    for i in range(3):  # topology-spread pods
+        app = rng.choice(apps)
+        pending.append(
+            make_pod(
+                f"spr{i}",
+                cpu_milli=100,
+                memory=2**27,
+                labels={"app": app},
+                topology_spread=(
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=ZONE,
+                        when_unsatisfiable=(
+                            "DoNotSchedule"
+                            if rng.random() < 0.5
+                            else "ScheduleAnyway"
+                        ),
+                        label_selector=LabelSelector(
+                            match_labels={"app": app}
+                        ),
+                    ),
+                ),
+            )
+        )
+    # volume pods: pre-bound PVC/PV pairs (gce-pd attach limits + zones)
+    vol_pods, pvcs, pvs = make_pv_pods(3, kind="gce-pd", name_prefix="fz-pv")
+    pending.extend(vol_pods)
+    rng.shuffle(pending)
+    return nodes, existing, pending, pvcs, pvs
+
+
+def test_fuzz_driver_vs_full_oracle():
+    """End-to-end: Scheduler (greedy solver, no preemption) must place
+    every pod exactly where the sequential oracle does."""
+    mismatches = []
+    for seed in range(N_SEEDS):
+        rng = random.Random(9000 + seed)
+        nodes, existing, pending, pvcs, pvs = fuzz_cluster(rng)
+        s = Scheduler(solver="greedy", clock=FakeClock(),
+                      enable_preemption=False)
+        s.set_volume_state(pvcs, pvs, ())
+        for nd in nodes:
+            s.on_node_add(nd)
+        for p in existing:
+            s.on_pod_add(p)
+        for p in pending:
+            s.on_pod_add(p)
+        res = s.schedule_cycle()
+
+        vol_state = VolumeState(
+            pvcs={(c.namespace, c.name): c for c in pvcs},
+            pvs={v.name: v for v in pvs},
+        )
+        want = pyref.serial_schedule_full(pending, nodes, existing, vol_state)
+        for i, pod in enumerate(pending):
+            got = res.assignments.get(pod.key())
+            exp = nodes[want[i][0]].name if want[i][0] >= 0 else None
+            if got != exp:
+                mismatches.append(
+                    f"seed {seed}: {pod.name}: driver={got} oracle={exp}\n"
+                    f"  pod={pod}"
+                )
+                break  # first divergence per seed is enough
+    assert not mismatches, "\n".join(mismatches[:5]) + (
+        f"\n... {len(mismatches)} seed(s) diverged of {N_SEEDS}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# TestPickOneNodeForPreemption tables (generic_scheduler_test.go:1198-1396)
+# ported scenario-for-scenario: nodes are 5x the default request (500m /
+# 1000MB), containers small=1x/medium=2x/large=3x/veryLarge=5x the default
+# (100m / 200MB), and the expected machine is the reference's expectation.
+# ---------------------------------------------------------------------------
+
+MILLI = 100
+MEM = 200 * 1024 * 1024
+NEG, LOW, MID, HIGH, VERY_HIGH = -100, 0, 100, 1000, 10000
+
+
+def _n(name):
+    return make_node(name, cpu_milli=5 * MILLI, memory=5 * MEM, pods=110)
+
+
+def _p(name, node, size, pri, start=0.0):
+    return make_pod(name, cpu_milli=size * MILLI, memory=size * MEM,
+                    node_name=node, priority=pri, start_time=start)
+
+
+def _pick(preemptor_size, preemptor_pri, node_names, victims):
+    """Run selectVictimsOnNode over each node then pickOneNodeForPreemption
+    — the exact flow the reference table drives (test body :1390-1396)."""
+    from kubernetes_tpu.preemption import pick_one_node, select_victims_on_node
+
+    nodes = [_n(n) for n in node_names]
+    node_pods = {n: [] for n in node_names}
+    for v in victims:
+        node_pods[v.node_name].append(v)
+    preemptor = make_pod("preemptor", cpu_milli=preemptor_size * MILLI,
+                         memory=preemptor_size * MEM, priority=preemptor_pri)
+    candidates = {}
+    for nd in nodes:
+        r = select_victims_on_node(preemptor, nd, nodes, node_pods)
+        if r is not None:
+            candidates[nd.name] = r
+    return pick_one_node(candidates)
+
+
+def test_pick_no_node_needs_preemption():
+    got = _pick(3, HIGH, ["machine1"], [_p("m1.1", "machine1", 1, MID)])
+    assert got == "machine1"
+
+
+def test_pick_fits_on_both_when_preempted():
+    got = _pick(3, HIGH, ["machine1", "machine2"], [
+        _p("m1.1", "machine1", 3, MID), _p("m2.1", "machine2", 3, MID)])
+    assert got in ("machine1", "machine2")
+
+
+def test_pick_prefers_no_preemption_node():
+    got = _pick(3, HIGH, ["machine1", "machine2", "machine3"], [
+        _p("m1.1", "machine1", 3, MID), _p("m2.1", "machine2", 3, MID)])
+    assert got == "machine3"
+
+
+def test_pick_min_highest_priority():
+    got = _pick(5, HIGH, ["machine1", "machine2", "machine3"], [
+        _p("m1.1", "machine1", 2, MID), _p("m1.2", "machine1", 3, MID),
+        _p("m2.1", "machine2", 2, MID), _p("m2.2", "machine2", 2, LOW),
+        _p("m3.1", "machine3", 2, LOW), _p("m3.2", "machine3", 2, LOW)])
+    assert got == "machine3"
+
+
+def test_pick_min_priority_sum_when_highest_equal():
+    got = _pick(5, HIGH, ["machine1", "machine2", "machine3"], [
+        _p("m1.1", "machine1", 2, MID), _p("m1.2", "machine1", 3, MID),
+        _p("m2.1", "machine2", 3, MID), _p("m2.2", "machine2", 2, LOW),
+        _p("m3.1", "machine3", 2, MID), _p("m3.2", "machine3", 2, MID)])
+    assert got == "machine2"
+
+
+def test_pick_min_pod_count_when_sums_equal():
+    got = _pick(5, HIGH, ["machine1", "machine2", "machine3"], [
+        _p("m1.1", "machine1", 1, MID), _p("m1.2", "machine1", 1, NEG),
+        _p("m1.3", "machine1", 1, MID), _p("m1.4", "machine1", 1, NEG),
+        _p("m2.1", "machine2", 3, MID), _p("m2.2", "machine2", 2, NEG),
+        _p("m3.1", "machine3", 2, MID), _p("m3.2", "machine3", 1, NEG),
+        _p("m3.3", "machine3", 1, LOW)])
+    assert got == "machine2"
+
+
+def test_pick_sum_of_adjusted_priorities():
+    got = _pick(5, HIGH, ["machine1", "machine2", "machine3"], [
+        _p("m1.1", "machine1", 1, MID), _p("m1.2", "machine1", 1, NEG),
+        _p("m1.3", "machine1", 1, NEG),
+        _p("m2.1", "machine2", 3, MID), _p("m2.2", "machine2", 2, NEG),
+        _p("m3.1", "machine3", 2, MID), _p("m3.2", "machine3", 1, NEG),
+        _p("m3.3", "machine3", 1, LOW)])
+    assert got == "machine2"
+
+
+def test_pick_non_overlapping_tiers():
+    got = _pick(5, VERY_HIGH,
+                ["machine1", "machine2", "machine3", "machine4"], [
+        _p("m1.1", "machine1", 1, MID), _p("m1.2", "machine1", 1, LOW),
+        _p("m1.3", "machine1", 1, LOW),
+        _p("m2.1", "machine2", 3, HIGH),
+        _p("m3.1", "machine3", 2, MID), _p("m3.2", "machine3", 1, LOW),
+        _p("m3.3", "machine3", 1, LOW), _p("m3.4", "machine3", 2, LOW),
+        _p("m4.1", "machine4", 2, MID), _p("m4.2", "machine4", 1, MID),
+        _p("m4.3", "machine4", 1, MID), _p("m4.4", "machine4", 1, NEG)])
+    assert got == "machine1"
+
+
+def test_pick_latest_start_time_per_machine():
+    d3, d4, d2 = 103.0, 104.0, 102.0  # relative start days
+    got = _pick(5, HIGH, ["machine1", "machine2", "machine3"], [
+        _p("m1.1", "machine1", 2, MID, d3), _p("m1.2", "machine1", 2, MID, d3),
+        _p("m2.1", "machine2", 2, MID, d4), _p("m2.2", "machine2", 2, MID, d4),
+        _p("m3.1", "machine3", 2, MID, d2), _p("m3.2", "machine3", 2, MID, d2)])
+    assert got == "machine2"
+
+
+def test_pick_latest_start_time_all_distinct():
+    d = {k: 100.0 + k for k in range(2, 8)}
+    got = _pick(5, HIGH, ["machine1", "machine2", "machine3"], [
+        _p("m1.1", "machine1", 2, MID, d[5]), _p("m1.2", "machine1", 2, MID, d[3]),
+        _p("m2.1", "machine2", 2, MID, d[6]), _p("m2.2", "machine2", 2, MID, d[2]),
+        _p("m3.1", "machine3", 2, MID, d[4]), _p("m3.2", "machine3", 2, MID, d[7])])
+    assert got == "machine3"
+
+
+def test_pick_mixed_priority_latest_start():
+    d = {k: 100.0 + k for k in range(2, 8)}
+    got = _pick(5, HIGH, ["machine1", "machine2", "machine3"], [
+        _p("m1.1", "machine1", 2, LOW, d[5]), _p("m1.2", "machine1", 2, MID, d[3]),
+        _p("m2.1", "machine2", 2, MID, d[7]), _p("m2.2", "machine2", 2, LOW, d[2]),
+        _p("m3.1", "machine3", 2, LOW, d[4]), _p("m3.2", "machine3", 2, MID, d[6])])
+    assert got == "machine2"

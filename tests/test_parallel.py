@@ -48,3 +48,88 @@ def test_sharded_batch_assign_matches_single_device():
     sdp, sdn, sds = shard_cluster(dp, dn, ds, mesh)
     got, _, rounds = batch_assign(sdp, sdn, sds)
     assert (np.asarray(got) == np.asarray(want)).all()
+
+
+# ---------------------------------------------------------------------------
+# Sharded-vs-single equality for the hard kernels (VERDICT r1/r2 ask):
+# topology segment-sums, volume predicates, and the sinkhorn plan all
+# reduce over the SHARDED node axis — exactly where GSPMD has to insert
+# collectives, and exactly what the earlier tests avoided.
+# ---------------------------------------------------------------------------
+
+
+def _pack(nodes, existing, pending, pvcs=(), pvs=()):
+    from kubernetes_tpu.ops.arrays import topology_to_device, volumes_to_device
+
+    pk = SnapshotPacker()
+    if pvcs or pvs:
+        pk.set_volume_state(pvcs, pvs, ())
+    for p in list(existing) + list(pending):
+        pk.intern_pod(p)
+    dn = nodes_to_device(pk.pack_nodes(nodes, existing))
+    dp = pods_to_device(pk.pack_pods(pending))
+    ds = selectors_to_device(pk.pack_selector_tables())
+    tt = pk.pack_topology_tables()
+    dt = topology_to_device(tt) if tt.n_pairs else None
+    dv = (
+        volumes_to_device(pk.pack_volume_tables(pending))
+        if (pvcs or pvs or any(p.volumes for p in pending))
+        else None
+    )
+    return dp, dn, ds, dt, dv
+
+
+def test_sharded_topology_matches_single_device():
+    """Spread constraints + pod affinity: per-pair count matrices reduce
+    along the sharded node axis (ops/topology.py segment ops)."""
+    from kubernetes_tpu.models.cluster import (
+        make_pod_affinity_pods,
+        make_spread_constraint_pods,
+    )
+    from kubernetes_tpu.parallel import replicate
+
+    nodes = make_nodes(64, zones=4)
+    existing = make_pods(48, "old", assigned_round_robin_over=64)
+    pending = (make_spread_constraint_pods(48, hard=True)
+               + make_pod_affinity_pods(48, n_groups=6))
+    dp, dn, ds, dt, _ = _pack(nodes, existing, pending)
+    assert dt is not None
+    want, _, _ = batch_assign(dp, dn, ds, topo=dt)
+    mesh = make_mesh()
+    sdp, sdn, sds, sdt = shard_cluster(dp, dn, ds, mesh, topo=dt)
+    got, _, _ = batch_assign(sdp, sdn, sds, topo=sdt)
+    assert (np.asarray(got) == np.asarray(want)).all()
+
+
+def test_sharded_volumes_match_single_device():
+    """PV/PVC workload: attach limits + zone conflicts computed against
+    sharded per-node volume state."""
+    from kubernetes_tpu.models.cluster import make_pv_pods
+    from kubernetes_tpu.parallel import replicate
+
+    nodes = make_nodes(32, zones=4)
+    pending, pvcs, pvs = make_pv_pods(64, kind="gce-pd")
+    dp, dn, ds, dt, dv = _pack(nodes, [], pending, pvcs=pvcs, pvs=pvs)
+    assert dv is not None
+    want, _, _ = batch_assign(dp, dn, ds, vol=dv)
+    mesh = make_mesh()
+    sdp, sdn, sds = shard_cluster(dp, dn, ds, mesh)
+    sdv = replicate(dv, mesh)
+    got, _, _ = batch_assign(sdp, sdn, sds, vol=sdv)
+    assert (np.asarray(got) == np.asarray(want)).all()
+
+
+def test_sharded_sinkhorn_matches_single_device():
+    """Sinkhorn plan: row/column logsumexp scaling — the column pass is a
+    reduction across the sharded node axis every iteration."""
+    nodes = make_nodes(32, zones=4)
+    # varied existing usage -> distinct node scores, so plan argmaxes are
+    # not float-tie sensitive to collective reduction order
+    existing = make_pods(80, "old", assigned_round_robin_over=32)
+    pending = make_pods(96, "pend")
+    dp, dn, ds, _, _ = _pack(nodes, existing, pending)
+    want, _, _ = batch_assign(dp, dn, ds, use_sinkhorn=True)
+    mesh = make_mesh()
+    sdp, sdn, sds = shard_cluster(dp, dn, ds, mesh)
+    got, _, _ = batch_assign(sdp, sdn, sds, use_sinkhorn=True)
+    assert (np.asarray(got) == np.asarray(want)).all()
