@@ -167,6 +167,31 @@ def node_resources_score(alloc, requested, assigned):
     }
 
 
+class ShardedWorkload:
+    """Wraps a Workload for mesh execution: nodes sharded along the node
+    axis, pods/selectors replicated (parallel/mesh.py design; BASELINE
+    config 5). run_batched works unchanged — GSPMD splits the (P x N)
+    kernels along the sharded axis and inserts the collectives."""
+
+    def __init__(self, w, mesh):
+        from kubernetes_tpu.parallel import replicate, shard_nodes
+
+        self._w = w
+        self._mesh = mesh
+        self._replicate = replicate
+        self.pending = w.pending
+        self.dn = shard_nodes(w.dn, mesh)
+        self.ds = replicate(w.ds, mesh)
+        self.dt = replicate(w.dt, mesh) if w.dt is not None else None
+
+    def device_batch(self, chunk, pad):
+        dp, dv = self._w.device_batch(chunk, pad)
+        return (
+            self._replicate(dp, self._mesh),
+            self._replicate(dv, self._mesh) if dv is not None else None,
+        )
+
+
 class Workload:
     """A packed cluster + pending queue, ready to schedule in batches."""
 
@@ -511,6 +536,40 @@ def main() -> None:
     except Exception as e:
         RESULT["errors"].append(f"score_parity: {short_err(e)}")
         log(f"score_parity FAILED: {short_err(e)}")
+
+    # ---- BASELINE config 5: 50k nodes, node axis sharded over the mesh ----
+    # On the driver's single TPU the mesh is degenerate (1 device) but the
+    # full sharding machinery runs; the 8-virtual-device CPU-mesh evidence
+    # lives in benchres/config5_cpu_mesh.json (XLA CPU compile of the
+    # 50k-node graph takes ~11min/shape on the 1-core bench host — too
+    # slow to repeat every run; re-measure it manually with
+    # scripts/bench_config5_cpu_mesh.py).
+    if os.environ.get("BENCH_C5", "1" if platform != "cpu" else "0") == "1":
+        try:
+            import resource
+
+            import jax
+
+            from kubernetes_tpu.parallel import make_mesh
+
+            c5n = int(os.environ.get("BENCH_C5_NODES", 50000))
+            c5p = int(os.environ.get("BENCH_C5_PODS", 200000))
+            c5b = int(os.environ.get("BENCH_C5_BATCH", 4096))
+            w5 = ShardedWorkload(build_variant("base", c5n, 0, c5p),
+                                 make_mesh())
+            r5 = run_batched(w5, c5b, cap=8, latency=True)
+            r5["nodes"] = c5n
+            r5["devices"] = len(jax.devices())
+            r5["batch"] = c5b
+            r5["peak_rss_gb"] = round(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 2
+            )
+            RESULT["extras"]["config5_sharded_50k"] = r5
+            log(f"config5 {c5n}x{c5p}: {r5}")
+            del w5
+        except Exception as e:
+            RESULT["errors"].append(f"config5: {short_err(e)}")
+            log(f"config5 FAILED: {short_err(e)}")
 
     # ---- BASELINE config 4: gang/coscheduling, 1k groups x 32 pods ----
     # Sinkhorn vs plain argmax rounds on the same workload: throughput,

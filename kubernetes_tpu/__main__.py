@@ -1,0 +1,7 @@
+"""``python -m kubernetes_tpu`` — cmd/kube-scheduler/scheduler.go:33 main."""
+
+import sys
+
+from kubernetes_tpu.cli import main
+
+sys.exit(main())

@@ -88,6 +88,15 @@ class SchedulerCache:
     def pod_count(self) -> int:
         return sum(len(m) for m in self._pods_by_node.values())
 
+    def pod(self, key: str) -> Optional[Pod]:
+        node = self._pod_node.get(key)
+        if node is None:
+            return None
+        return self._pods_by_node.get(node, {}).get(key)
+
+    def node_count(self) -> int:
+        return len(self._nodes)
+
     # -- assumed-pod state machine ----------------------------------------
 
     def assume_pod(self, pod: Pod, node_name: str) -> None:

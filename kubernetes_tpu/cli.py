@@ -1,0 +1,311 @@
+"""Executable entry point — the analog of ``cmd/kube-scheduler``
+(``scheduler.go:33`` main → ``app/server.go:65`` NewSchedulerCommand →
+``:161`` Run): flags → ComponentConfig file decode → validation → healthz/
+metrics server → leader election → the scheduling loop.
+
+    python -m kubernetes_tpu --config scheduler.yaml
+    python -m kubernetes_tpu --validate-only --config scheduler.yaml
+
+The config file is the versioned ``KubeSchedulerConfiguration`` in YAML or
+JSON (apis/config/types.go:43 field meanings; snake_case keys). Flags
+override file values the way the reference's options layer overlays the
+decoded object (app/options/options.go). Invalid configs are rejected with
+field-path errors like ``apis/config/validation`` does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import signal
+import sys
+import time
+from typing import List, Optional
+
+from kubernetes_tpu.config import (
+    DEFAULT_FEATURE_GATES,
+    FeatureGates,
+    KubeSchedulerConfiguration,
+    LeaderElectionConfig,
+    load_policy,
+)
+
+VALID_SOLVERS = ("batch", "greedy", "exact", "sinkhorn")
+
+#: component-base leader-election jitter factor (leaderelection.go:56) —
+#: renewDeadline must exceed retryPeriod * JitterFactor
+JITTER_FACTOR = 1.2
+
+
+class ConfigError(ValueError):
+    """Decode/validation failure; ``errors`` lists field-path messages."""
+
+    def __init__(self, errors: List[str]):
+        super().__init__("; ".join(errors))
+        self.errors = errors
+
+
+def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
+    """ValidateKubeSchedulerConfiguration (apis/config/validation/
+    validation.go:27) plus checks for this implementation's solver block.
+    Returns field-path error strings; empty = valid."""
+    errs: List[str] = []
+    if not cfg.scheduler_name:
+        errs.append("schedulerName: Required value")
+    if not 0 <= cfg.hard_pod_affinity_symmetric_weight <= 100:
+        errs.append(
+            f"hardPodAffinitySymmetricWeight: Invalid value "
+            f"{cfg.hard_pod_affinity_symmetric_weight}: not in valid range 0-100"
+        )
+    if not 0 <= cfg.percentage_of_nodes_to_score <= 100:
+        errs.append(
+            f"percentageOfNodesToScore: Invalid value "
+            f"{cfg.percentage_of_nodes_to_score}: not in valid range 0-100"
+        )
+    if cfg.bind_timeout_seconds is None or cfg.bind_timeout_seconds < 0:
+        errs.append("bindTimeoutSeconds: Required value")
+    le = cfg.leader_election
+    if le.leader_elect:  # validated only when enabled (validation.go:57-59)
+        if le.lease_duration_s <= 0:
+            errs.append("leaderElection.leaseDuration: must be greater than zero")
+        if le.renew_deadline_s <= 0:
+            errs.append("leaderElection.renewDeadline: must be greater than zero")
+        if le.retry_period_s <= 0:
+            errs.append("leaderElection.retryPeriod: must be greater than zero")
+        if le.lease_duration_s <= le.renew_deadline_s:
+            errs.append(
+                "leaderElection.leaseDuration: must be greater than renewDeadline"
+            )
+        if le.renew_deadline_s <= JITTER_FACTOR * le.retry_period_s:
+            errs.append(
+                "leaderElection.renewDeadline: must be greater than "
+                f"retryPeriod*JitterFactor ({JITTER_FACTOR})"
+            )
+        if not le.lock_object_namespace:
+            errs.append("leaderElection.lockObjectNamespace: Required value")
+        if not le.lock_object_name:
+            errs.append("leaderElection.lockObjectName: Required value")
+    # solver block (no reference analog; this implementation's tuning)
+    if cfg.solver not in VALID_SOLVERS:
+        errs.append(
+            f"solver: Unsupported value {cfg.solver!r}: "
+            f"supported values: {', '.join(VALID_SOLVERS)}"
+        )
+    if cfg.per_node_cap < 1:
+        errs.append("perNodeCap: must be at least 1")
+    if cfg.max_rounds < 1:
+        errs.append("maxRounds: must be at least 1")
+    if cfg.max_batch < 1:
+        errs.append("maxBatch: must be at least 1")
+    # unknown feature gates are rejected earlier, at FeatureGates
+    # construction (featuregate.Set errors on unknown names)
+    return errs
+
+
+_CONFIG_FIELDS = {f.name for f in dataclasses.fields(KubeSchedulerConfiguration)}
+_LE_FIELDS = {f.name for f in dataclasses.fields(LeaderElectionConfig)}
+
+
+def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
+    """Decode a mapping into the typed config, rejecting unknown fields
+    (the reference's strict ComponentConfig decode fails on unknowns)."""
+    if not isinstance(doc, dict):
+        raise ConfigError([f"{path or 'config'}: expected a mapping"])
+    errs: List[str] = []
+    kw: dict = {}
+    for key, val in doc.items():
+        if key in ("apiVersion", "kind"):
+            continue  # accepted for file-shape parity, not interpreted
+        if key == "leader_election":
+            if not isinstance(val, dict):
+                errs.append("leaderElection: expected a mapping")
+                continue
+            unknown = set(val) - _LE_FIELDS
+            if unknown:
+                errs.append(
+                    f"leaderElection: unknown field(s) {sorted(unknown)}"
+                )
+                continue
+            kw["leader_election"] = LeaderElectionConfig(**val)
+        elif key == "feature_gates":
+            if not isinstance(val, dict):
+                errs.append("featureGates: expected a mapping")
+                continue
+            try:
+                kw["feature_gates"] = FeatureGates(overrides=dict(val))
+            except ValueError as e:
+                errs.append(f"featureGates: {e}")
+        elif key == "policy":
+            kw["policy"] = load_policy(val)
+        elif key in _CONFIG_FIELDS:
+            kw[key] = val
+        else:
+            errs.append(f"{key}: unknown field")
+    if errs:
+        raise ConfigError(errs)
+    try:
+        return KubeSchedulerConfiguration(**kw)
+    except TypeError as e:
+        raise ConfigError([str(e)])
+
+
+def load_config_file(path: str) -> KubeSchedulerConfiguration:
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        import yaml
+
+        try:
+            doc = yaml.safe_load(text)
+        except yaml.YAMLError as e:
+            raise ConfigError([f"{path}: not valid JSON or YAML: {e}"])
+    return decode_config(doc or {}, path)
+
+
+def parse_feature_gates(spec: str) -> dict:
+    """--feature-gates K=true,K2=false (component-base flag syntax)."""
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ConfigError([f"feature-gates: missing '=' in {part!r}"])
+        k, v = part.split("=", 1)
+        if v.lower() not in ("true", "false"):
+            raise ConfigError([f"feature-gates.{k}: must be true|false"])
+        out[k.strip()] = v.lower() == "true"
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kubernetes_tpu",
+        description="TPU-native scheduler (kube-scheduler capability analog)",
+    )
+    p.add_argument("--config", help="KubeSchedulerConfiguration file (YAML/JSON)")
+    p.add_argument("--policy-config-file",
+                   help="legacy Policy file (scheduler.go:178 policy source)")
+    p.add_argument("--feature-gates", default="",
+                   help="comma-separated K=true|false overrides")
+    p.add_argument("--scheduler-name", default=None)
+    p.add_argument("--solver", default=None, choices=VALID_SOLVERS)
+    p.add_argument("--per-node-cap", type=int, default=None)
+    p.add_argument("--percentage-of-nodes-to-score", type=int, default=None)
+    p.add_argument("--leader-elect", default=None, choices=("true", "false"))
+    p.add_argument("--lock-file", default=None,
+                   help="leader-election lock file (FileLock path)")
+    p.add_argument("--bind-address", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=10251,
+                   help="healthz/metrics port (0 = ephemeral)")
+    p.add_argument("--validate-only", action="store_true",
+                   help="decode + validate, print result, exit")
+    p.add_argument("--cycle-interval", type=float, default=0.25,
+                   help="seconds between scheduling cycles when idle")
+    return p
+
+
+def resolve_config(args) -> KubeSchedulerConfiguration:
+    """File → flag overlay → validation (the options.Complete/Validate
+    flow, app/server.go:133-148)."""
+    cfg = (load_config_file(args.config) if args.config
+           else KubeSchedulerConfiguration())
+    if args.policy_config_file:
+        with open(args.policy_config_file) as f:
+            cfg = dataclasses.replace(cfg, policy=load_policy(json.load(f)))
+    overlay = {}
+    if args.scheduler_name is not None:
+        overlay["scheduler_name"] = args.scheduler_name
+    if args.solver is not None:
+        overlay["solver"] = args.solver
+    if args.per_node_cap is not None:
+        overlay["per_node_cap"] = args.per_node_cap
+    if args.percentage_of_nodes_to_score is not None:
+        overlay["percentage_of_nodes_to_score"] = args.percentage_of_nodes_to_score
+    if args.leader_elect is not None:
+        overlay["leader_election"] = dataclasses.replace(
+            cfg.leader_election, leader_elect=args.leader_elect == "true"
+        )
+    if args.feature_gates:
+        # flag gates overlay file gates in place (featuregate.Set on the
+        # already-decoded object, options.go ApplyFeatureGates order)
+        try:
+            cfg.feature_gates.set_from_string(args.feature_gates)
+        except ValueError as e:
+            raise ConfigError([f"featureGates: {e}"])
+    if overlay:
+        cfg = dataclasses.replace(cfg, **overlay)
+    errors = validate_config(cfg)
+    if errors:
+        raise ConfigError(errors)
+    return cfg
+
+
+def run(cfg: KubeSchedulerConfiguration, args, stop_event=None) -> None:
+    """The serve loop (app/server.go:161 Run): healthz/metrics server up
+    first, then leader election gates the scheduling loop — a non-leader
+    keeps serving healthz and ticking the elector (active-passive HA)."""
+    import os
+    import threading
+
+    from kubernetes_tpu.leaderelection import FileLock, InMemoryLock, LeaderElector
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.server import serve_scheduler
+
+    sched = Scheduler.from_config(cfg)
+    srv = serve_scheduler(sched, host=args.bind_address, port=args.port)
+    host, port = srv.server_address[:2]
+    print(f"serving healthz/metrics on {host}:{port}", file=sys.stderr)
+
+    stop = stop_event or threading.Event()
+
+    def _sig(_s, _f):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+
+    elector = None
+    if cfg.leader_election.leader_elect:
+        lock = (FileLock(args.lock_file) if args.lock_file else InMemoryLock())
+        elector = LeaderElector(
+            identity=f"{os.uname().nodename}_{os.getpid()}",
+            lock=lock,
+            config=cfg.leader_election,
+        )
+    try:
+        while not stop.is_set():
+            if elector is not None and not elector.tick():
+                stop.wait(cfg.leader_election.retry_period_s)
+                continue
+            r = sched.schedule_cycle()
+            if r.attempted == 0:
+                stop.wait(args.cycle_interval)
+    finally:
+        srv.shutdown()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        cfg = resolve_config(args)
+    except ConfigError as e:
+        for err in e.errors:
+            print(f"invalid configuration: {err}", file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if args.validate_only:
+        print(f"configuration valid: scheduler={cfg.scheduler_name} "
+              f"solver={cfg.solver}")
+        return 0
+    run(cfg, args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

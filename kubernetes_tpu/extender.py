@@ -62,14 +62,35 @@ def pod_to_json(pod: Pod) -> dict:
 
 
 def node_to_json(node) -> dict:
+    c = node.conditions
+    conditions = [
+        {"type": "Ready", "status": "True" if c.ready else "False"},
+        {"type": "MemoryPressure",
+         "status": "True" if c.memory_pressure else "False"},
+        {"type": "DiskPressure",
+         "status": "True" if c.disk_pressure else "False"},
+        {"type": "PIDPressure",
+         "status": "True" if c.pid_pressure else "False"},
+        {"type": "NetworkUnavailable",
+         "status": "True" if c.network_unavailable else "False"},
+    ]
     return {
         "metadata": {"name": node.name, "labels": dict(node.labels)},
+        "spec": {
+            "unschedulable": node.unschedulable,
+            "taints": [
+                {"key": t.key, "value": t.value, "effect": t.effect}
+                for t in node.taints
+            ],
+        },
         "status": {
             "allocatable": {
                 "cpu": f"{int(node.allocatable.cpu_milli)}m",
                 "memory": str(int(node.allocatable.memory)),
                 "pods": str(int(node.allocatable.pods)),
-            }
+                **{k: str(v) for k, v in node.allocatable.scalars.items()},
+            },
+            "conditions": conditions,
         },
     }
 

@@ -1,0 +1,262 @@
+"""gRPC streaming shim — the BASELINE-named integration seam: a gRPC
+service speaking extender-shaped messages (api/types.go:284-330) with a
+bidirectional snapshot-delta stream so the node cache stays resident
+service-side (nodeCacheCapable semantics, ExtenderConfig api/types.go:203).
+
+Transport layering vs the reference: where the HTTP webhook seam
+(extender.py / server.py ExtenderServer) re-sends state per request, this
+seam is level-triggered like the control plane itself — the client streams
+watch deltas (SyncState), the service applies them to the scheduler's
+cache and acks with the applied revision (the resume point, mirroring
+watch bookmarks), and Filter/Prioritize then travel with node NAMES only.
+
+The service stubs are hand-wired over ``grpc.method_handlers_generic_
+handler`` with the protoc-generated message classes
+(``proto/extender_pb2.py``) — the environment ships protoc + grpcio but
+not the grpc_tools codegen plugin, and the generic-handler API is exactly
+what generated ``*_pb2_grpc.py`` code calls underneath.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent import futures
+from typing import Iterator, Optional
+
+import grpc
+
+from kubernetes_tpu.api.types import Node, NodeCondition, Resources, Taint
+from kubernetes_tpu.proto import extender_pb2 as pb
+from kubernetes_tpu.server import ExtenderServer, parse_quantity, pod_from_json
+
+SERVICE_NAME = "ktpu.TpuScheduler"
+
+
+def node_from_json(d: dict) -> Node:
+    """Inverse of extender.node_to_json for the fields the kernels read."""
+    meta = d.get("metadata", {})
+    status = d.get("status", {})
+    alloc = status.get("allocatable") or {}
+    res = Resources(
+        cpu_milli=parse_quantity(alloc.get("cpu", "0"), is_cpu=True),
+        memory=parse_quantity(alloc.get("memory", "0")),
+        pods=parse_quantity(alloc.get("pods", "110")),
+    )
+    for name, q in alloc.items():
+        if name not in ("cpu", "memory", "pods", "ephemeral-storage"):
+            res.scalars[name] = parse_quantity(q)
+    if "ephemeral-storage" in alloc:
+        res.ephemeral_storage = parse_quantity(alloc["ephemeral-storage"])
+    spec = d.get("spec") or {}
+    taints = tuple(
+        Taint(key=t.get("key", ""), value=t.get("value", ""),
+              effect=t.get("effect", ""))
+        for t in (spec.get("taints") or [])
+    )
+    # conditions: the two mandatory-predicate inputs plus the pressure
+    # flags (CheckNodeConditionPredicate reads Ready/NetworkUnavailable;
+    # absent Ready stays True — node_to_json always emits it)
+    flags = {
+        c.get("type"): c.get("status") == "True"
+        for c in (status.get("conditions") or [])
+    }
+    cond = NodeCondition(
+        ready=flags.get("Ready", True),
+        memory_pressure=flags.get("MemoryPressure", False),
+        disk_pressure=flags.get("DiskPressure", False),
+        pid_pressure=flags.get("PIDPressure", False),
+        network_unavailable=flags.get("NetworkUnavailable", False),
+    )
+    return Node(
+        name=meta.get("name", ""),
+        labels=dict(meta.get("labels") or {}),
+        allocatable=res,
+        taints=taints,
+        conditions=cond,
+        unschedulable=bool(spec.get("unschedulable", False)),
+    )
+
+
+class TpuSchedulerService:
+    """Service implementation over a live Scheduler (its cache is the
+    resident snapshot the deltas feed)."""
+
+    def __init__(self, scheduler) -> None:
+        self.scheduler = scheduler
+        self.extender = ExtenderServer(scheduler)
+        self._lock = threading.Lock()  # deltas serialize against verbs
+        self.revision = 0
+
+    # -- SyncState (bidi stream) -------------------------------------------
+
+    def sync_state(self, request_iterator: Iterator[pb.SnapshotDelta],
+                   context) -> Iterator[pb.SyncAck]:
+        s = self.scheduler
+        for delta in request_iterator:
+            with self._lock:
+                for nd in delta.nodes:
+                    if nd.op == pb.NodeDelta.REMOVE:
+                        s.on_node_delete(nd.name)
+                    else:
+                        node = node_from_json(json.loads(nd.node_json))
+                        if nd.op == pb.NodeDelta.ADD:
+                            s.on_node_add(node)
+                        else:
+                            s.on_node_update(node)
+                for pd in delta.pods:
+                    if pd.op == pb.PodDelta.REMOVE:
+                        known = s.cache.pod(pd.key) or s.queue.pod(pd.key)
+                        if known is None:  # unseen key: synthesize for cleanup
+                            ns, _, name = pd.key.partition("/")
+                            from kubernetes_tpu.api.types import Pod as _Pod
+
+                            known = _Pod(name=name, namespace=ns)
+                        s.on_pod_delete(known)
+                    else:
+                        pod = pod_from_json(json.loads(pd.pod_json))
+                        known = s.cache.pod(pd.key) or s.queue.pod(pd.key)
+                        if known is not None:
+                            # the UPDATE path owns the queue-removal /
+                            # assumption-confirm / Permit-wait invariants
+                            # (scheduler.py on_pod_update) — routing
+                            # updates through on_pod_add would double-book
+                            # a bound pod's capacity
+                            s.on_pod_update(known, pod)
+                        else:
+                            s.on_pod_add(pod)
+                self.revision = max(self.revision, delta.revision)
+                n_nodes = s.cache.node_count()
+            yield pb.SyncAck(revision=self.revision,
+                            nodes_in_snapshot=n_nodes)
+
+    # -- unary verbs --------------------------------------------------------
+
+    def filter(self, request: pb.ExtenderArgs, context) -> pb.ExtenderFilterResult:
+        with self._lock:
+            payload = {"pod": json.loads(request.pod_json)}
+            if request.node_names:
+                payload["nodenames"] = list(request.node_names)
+            try:
+                r = self.extender.handle("filter", payload)
+            except Exception as e:  # verb errors ride the result message
+                return pb.ExtenderFilterResult(error=str(e))
+        return pb.ExtenderFilterResult(
+            node_names=r.get("nodenames", []),
+            failed_nodes=r.get("failedNodes", {}),
+            error=r.get("error", ""),
+        )
+
+    def prioritize(self, request: pb.ExtenderArgs, context) -> pb.HostPriorityList:
+        with self._lock:
+            payload = {"pod": json.loads(request.pod_json)}
+            if request.node_names:
+                payload["nodenames"] = list(request.node_names)
+            try:
+                r = self.extender.handle("prioritize", payload)
+            except Exception as e:
+                return pb.HostPriorityList(error=str(e))
+        out = pb.HostPriorityList()
+        for item in r:
+            out.items.add(host=item["host"], score=item["score"])
+        return out
+
+    def bind(self, request: pb.Binding, context) -> pb.BindResult:
+        """The Binding-subresource write (BindingREST.Create → assignPod,
+        registry/core/pod/storage/storage.go:154): a pending pod moves
+        from the queue into the cache bound to the target node."""
+        s = self.scheduler
+        with self._lock:
+            key = request.pod_key
+            if s.cache.pod(key) is not None:
+                return pb.BindResult(ok=False,
+                                     error=f"pod {key!r} already bound")
+            pod = s.queue.pod(key)
+            if pod is None:
+                return pb.BindResult(ok=False,
+                                     error=f"pod {key!r} not in snapshot")
+            try:
+                s.queue.delete(key)
+                s.cache.assume_pod(pod, request.node)
+                s.binder.bind(pod, request.node)
+                s.cache.finish_binding(key)
+            except Exception as e:
+                try:
+                    s.cache.forget_pod(key)
+                except Exception:
+                    pass
+                # bind failure re-queues (scheduler.go:447 error path) —
+                # dropping the pod from both queue and cache would strand
+                # it until the client re-sends an ADD delta
+                s.queue.add(pod)
+                return pb.BindResult(ok=False, error=str(e))
+        return pb.BindResult(ok=True, error="")
+
+
+def _handlers(svc: TpuSchedulerService) -> grpc.GenericRpcHandler:
+    rpcs = {
+        "SyncState": grpc.stream_stream_rpc_method_handler(
+            svc.sync_state,
+            request_deserializer=pb.SnapshotDelta.FromString,
+            response_serializer=pb.SyncAck.SerializeToString,
+        ),
+        "Filter": grpc.unary_unary_rpc_method_handler(
+            svc.filter,
+            request_deserializer=pb.ExtenderArgs.FromString,
+            response_serializer=pb.ExtenderFilterResult.SerializeToString,
+        ),
+        "Prioritize": grpc.unary_unary_rpc_method_handler(
+            svc.prioritize,
+            request_deserializer=pb.ExtenderArgs.FromString,
+            response_serializer=pb.HostPriorityList.SerializeToString,
+        ),
+        "Bind": grpc.unary_unary_rpc_method_handler(
+            svc.bind,
+            request_deserializer=pb.Binding.FromString,
+            response_serializer=pb.BindResult.SerializeToString,
+        ),
+    }
+    return grpc.method_handlers_generic_handler(SERVICE_NAME, rpcs)
+
+
+def serve_grpc(scheduler, address: str = "127.0.0.1:0",
+               max_workers: int = 8):
+    """Start the gRPC service; returns (server, bound_port)."""
+    svc = TpuSchedulerService(scheduler)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((_handlers(svc),))
+    port = server.add_insecure_port(address)
+    server.start()
+    return server, port
+
+
+class GrpcSchedulerClient:
+    """The Go-side shim's view: typed stubs over a channel (what a
+    generated *_pb2_grpc.Stub provides)."""
+
+    def __init__(self, target: str):
+        self.channel = grpc.insecure_channel(target)
+        base = f"/{SERVICE_NAME}/"
+        self.sync_state = self.channel.stream_stream(
+            base + "SyncState",
+            request_serializer=pb.SnapshotDelta.SerializeToString,
+            response_deserializer=pb.SyncAck.FromString,
+        )
+        self.filter = self.channel.unary_unary(
+            base + "Filter",
+            request_serializer=pb.ExtenderArgs.SerializeToString,
+            response_deserializer=pb.ExtenderFilterResult.FromString,
+        )
+        self.prioritize = self.channel.unary_unary(
+            base + "Prioritize",
+            request_serializer=pb.ExtenderArgs.SerializeToString,
+            response_deserializer=pb.HostPriorityList.FromString,
+        )
+        self.bind = self.channel.unary_unary(
+            base + "Bind",
+            request_serializer=pb.Binding.SerializeToString,
+            response_deserializer=pb.BindResult.FromString,
+        )
+
+    def close(self) -> None:
+        self.channel.close()

@@ -175,6 +175,13 @@ class SchedulingQueue:
         heapq.heappush(self._backoff, (expiry, next(self._seq), pod.key()))
         self._in_backoff[pod.key()] = pod
 
+    def pod(self, key: str) -> Optional[Pod]:
+        """Look up a queued pod by key across the three sub-queues."""
+        p = self._in_active.get(key) or self._in_backoff.get(key)
+        if p is None and key in self._unschedulable:
+            p = self._unschedulable[key][0]
+        return p
+
     def _contains(self, key: str) -> bool:
         return key in self._in_active or key in self._in_backoff or key in self._unschedulable
 

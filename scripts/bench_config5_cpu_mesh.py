@@ -1,0 +1,82 @@
+"""One-off BASELINE config-5 evidence on the 8-virtual-device CPU mesh:
+50k nodes sharded along the node axis, batches of pods pushed through the
+mesh-sharded solver (bench.ShardedWorkload path). Run:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/bench_config5_cpu_mesh.py > benchres/config5_cpu_mesh.json
+
+Committed as an artifact because XLA's CPU compile of the 50k-node graph
+costs ~11 minutes per shape signature on the 1-core bench host (measured
+r3) — too slow to repeat inside every bench.py run. The compile cost is a
+property of single-core XLA-CPU, not of the sharded program: the same
+graph on TPU compiles in tens of seconds (bench.py config5 section).
+"""
+
+import json
+import os
+import resource
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from bench import ShardedWorkload, Workload, build_variant, node_resources_score
+from kubernetes_tpu.ops.assign import batch_assign, nodes_with_usage
+from kubernetes_tpu.parallel import make_mesh
+
+N_NODES = int(os.environ.get("C5_NODES", 50000))
+BATCH = int(os.environ.get("C5_BATCH", 4096))
+N_BATCHES = int(os.environ.get("C5_BATCHES", 3))
+
+out = {
+    "workload": f"{N_NODES} nodes, {N_BATCHES}x{BATCH} base pods, cap=8",
+    "devices": len(jax.devices()),
+    "platform": jax.default_backend(),
+    "batches": [],
+}
+
+t0 = time.time()
+w = ShardedWorkload(
+    build_variant("base", N_NODES, 0, BATCH * N_BATCHES), make_mesh()
+)
+out["build_pack_shard_s"] = round(time.time() - t0, 1)
+
+dn_cur = w.dn
+usage = None
+placed_total = 0
+for b in range(N_BATCHES):
+    chunk = w.pending[b * BATCH : (b + 1) * BATCH]
+    t0 = time.time()
+    dp, dv = w.device_batch(chunk, BATCH)
+    assigned, usage, rounds = batch_assign(dp, dn_cur, w.ds, per_node_cap=8)
+    a = np.asarray(assigned)[: len(chunk)]
+    dt = time.time() - t0
+    placed = int((a >= 0).sum())
+    placed_total += placed
+    dn_cur = nodes_with_usage(dn_cur, usage)
+    out["batches"].append({
+        "batch": b,
+        "wall_s": round(dt, 2),
+        "placed": placed,
+        "rounds": int(rounds),
+        "pods_per_sec": round(len(chunk) / dt, 1),
+    })
+    print(f"# batch {b}: {dt:.1f}s rounds={int(rounds)} placed={placed}",
+          file=sys.stderr, flush=True)
+
+# steady state = last batch (earlier batches pay XLA compiles for fresh
+# sharding signatures)
+out["steady_pods_per_sec"] = out["batches"][-1]["pods_per_sec"]
+out["placed_total"] = placed_total
+out["peak_rss_gb"] = round(
+    resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 2
+)
+print(json.dumps(out, indent=1))

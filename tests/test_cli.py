@@ -1,0 +1,176 @@
+"""CLI entry-point tests — the options→config→validation→serve flow of
+cmd/kube-scheduler (app/server.go:65 NewSchedulerCommand, :161 Run;
+apis/config/validation). Includes a real end-to-end boot: subprocess
+`python -m kubernetes_tpu` from a config file, /healthz + /metrics polled,
+clean SIGTERM shutdown."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.cli import (
+    ConfigError,
+    build_parser,
+    decode_config,
+    load_config_file,
+    resolve_config,
+    validate_config,
+)
+from kubernetes_tpu.config import KubeSchedulerConfiguration, LeaderElectionConfig
+
+
+def _resolve(argv):
+    return resolve_config(build_parser().parse_args(argv))
+
+
+def test_defaults_are_valid():
+    assert validate_config(KubeSchedulerConfiguration()) == []
+
+
+def test_validation_rejects_bad_fields():
+    cfg = KubeSchedulerConfiguration(
+        scheduler_name="",
+        percentage_of_nodes_to_score=150,
+        hard_pod_affinity_symmetric_weight=-1,
+        solver="magic",
+        per_node_cap=0,
+    )
+    errs = validate_config(cfg)
+    joined = "\n".join(errs)
+    for frag in ("schedulerName", "percentageOfNodesToScore",
+                 "hardPodAffinitySymmetricWeight", "solver", "perNodeCap"):
+        assert frag in joined, (frag, errs)
+
+
+def test_validation_leader_election_rules():
+    # renewDeadline must be < leaseDuration and > retryPeriod*1.2
+    cfg = KubeSchedulerConfiguration(
+        leader_election=LeaderElectionConfig(
+            leader_elect=True, lease_duration_s=5.0, renew_deadline_s=10.0,
+            retry_period_s=2.0,
+        )
+    )
+    errs = validate_config(cfg)
+    assert any("leaseDuration" in e for e in errs)
+    # disabled leader election skips those checks (validation.go:57-59)
+    cfg2 = KubeSchedulerConfiguration(
+        leader_election=LeaderElectionConfig(
+            leader_elect=False, lease_duration_s=-1.0,
+        )
+    )
+    assert validate_config(cfg2) == []
+
+
+def test_decode_rejects_unknown_fields():
+    with pytest.raises(ConfigError) as ei:
+        decode_config({"scheduler_name": "x", "not_a_field": 1})
+    assert "not_a_field" in str(ei.value)
+
+
+def test_decode_accepts_apiversion_kind():
+    cfg = decode_config({
+        "apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
+        "kind": "KubeSchedulerConfiguration",
+        "scheduler_name": "s",
+    })
+    assert cfg.scheduler_name == "s"
+
+
+def test_flag_overlay_and_gates(tmp_path):
+    f = tmp_path / "cfg.yaml"
+    f.write_text("scheduler_name: from-file\nsolver: greedy\n")
+    cfg = _resolve(["--config", str(f), "--solver", "batch",
+                    "--feature-gates", "EvenPodsSpread=false"])
+    assert cfg.scheduler_name == "from-file"  # file value kept
+    assert cfg.solver == "batch"  # flag wins
+    assert not cfg.feature_gates.enabled("EvenPodsSpread")
+
+
+def test_unknown_feature_gate_rejected(tmp_path):
+    with pytest.raises(ConfigError) as ei:
+        _resolve(["--feature-gates", "NotAGate=true"])
+    assert "NotAGate" in str(ei.value)
+
+
+def test_config_file_json(tmp_path):
+    f = tmp_path / "cfg.json"
+    f.write_text(json.dumps({"scheduler_name": "j", "per_node_cap": 2}))
+    cfg = load_config_file(str(f))
+    assert cfg.scheduler_name == "j" and cfg.per_node_cap == 2
+
+
+def test_cli_validate_only_exit_codes(tmp_path):
+    from kubernetes_tpu.cli import main
+
+    good = tmp_path / "good.yaml"
+    good.write_text("scheduler_name: ok\n")
+    assert main(["--validate-only", "--config", str(good)]) == 0
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("nope: 1\n")
+    assert main(["--validate-only", "--config", str(bad)]) == 1
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_cli_boots_server_from_config_file(tmp_path):
+    """End-to-end: `python -m kubernetes_tpu --config f` boots, serves
+    /healthz + /metrics, and shuts down cleanly on SIGTERM."""
+    cfg = tmp_path / "sched.yaml"
+    cfg.write_text(
+        "scheduler_name: e2e\n"
+        "solver: batch\n"
+        "leader_election:\n"
+        "  leader_elect: true\n"  # exercise elector + lock file
+    )
+    lock = tmp_path / "leader.lock"
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubernetes_tpu", "--config", str(cfg),
+         "--port", str(port), "--lock-file", str(lock)],
+        env=env, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    try:
+        deadline = time.time() + 60
+        body = None
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"process exited rc={proc.returncode}: "
+                    f"{proc.stderr.read().decode()[-500:]}"
+                )
+            try:
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=2
+                ).read()
+                break
+            except OSError:
+                time.sleep(0.3)
+        assert body == b"ok"
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "scheduler_schedule_attempts_total" in metrics
+        assert lock.exists()  # leader elected via the file lock
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=30)
+        assert proc.returncode == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
