@@ -394,10 +394,14 @@ def topology_to_device(t: TopologyTables) -> DeviceTopology:
     M = t.n_matchers
 
     def onehot(m_idx: np.ndarray, rows: int) -> jnp.ndarray:
+        # negative ids (padding) get an all-zero row, NOT a clipped alias
+        # of matcher 0 — the pm_* matmuls are the only validity gate the
+        # at/st tables have
         oh = np.zeros((rows, M), np.float32)
-        r = np.arange(len(m_idx))
-        if len(m_idx):
-            oh[r, np.clip(m_idx, 0, M - 1)] = 1.0
+        ok = np.asarray(m_idx) >= 0
+        r = np.arange(len(m_idx))[ok]
+        if len(r):
+            oh[r, np.clip(np.asarray(m_idx)[ok], 0, M - 1)] = 1.0
         return jnp.asarray(oh)
 
     def valid(n: int, rows: int) -> jnp.ndarray:

@@ -1417,12 +1417,20 @@ class SnapshotPacker:
         rp_prog, rp_key, rp_m, rp_w = flat(u.pref_aff_program_rows, True)
 
         Ua, Us = w["Ua"], w["Us"]
-        at_key = np.zeros((Ua,), np.int32)
-        at_m = np.zeros((Ua,), np.int32)
+        # padding rows MUST carry matcher -1: a zero-filled row aliases
+        # (key 0, matcher 0) — real interned ids — and every pod matching
+        # matcher 0 would spuriously read as anti-term-matched. That
+        # aliasing made sensitive_keys() flag ALL soft-spread/affinity
+        # pods and serialize admissions to one per topology pair per
+        # round (206 rounds for a 2048-pod soft-spread batch, round-3
+        # profiling; the round-2 "topology kernels are the slow path"
+        # finding was THIS, not kernel cost).
+        at_key = np.full((Ua,), -1, np.int32)
+        at_m = np.full((Ua,), -1, np.int32)
         for a, (k, m) in enumerate(u.anti_terms.items()):
             at_key[a], at_m[a] = k, m
-        st_key = np.zeros((Us,), np.int32)
-        st_m = np.zeros((Us,), np.int32)
+        st_key = np.full((Us,), -1, np.int32)
+        st_m = np.full((Us,), -1, np.int32)
         st_w = np.zeros((Us,), np.float32)
         st_hard = np.zeros((Us,), np.float32)
         for s, (k, m, wt, kind) in enumerate(u.sym_terms.items()):

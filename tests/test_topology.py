@@ -374,3 +374,30 @@ def test_even_pods_spread_score_differential():
                 f"seed {seed}: pod {pending[i].name} node {nodes[j].name}: "
                 f"device={got[i,j]} oracle={want[i,j]}\npod={pending[i]}"
             )
+
+
+def test_padding_rows_do_not_alias_matcher_zero():
+    """Regression (r3 profiling): zero-filled padding rows in the at/st
+    universes aliased (key 0, matcher 0) — real ids — so sensitive_keys()
+    flagged every soft-spread/affinity pod and the batch solver serialized
+    admissions to one per topology pair per round (206 rounds for a
+    2048-pod soft-spread batch instead of 2)."""
+    from kubernetes_tpu.models.cluster import (
+        make_nodes,
+        make_pods,
+        make_spread_constraint_pods,
+    )
+    from kubernetes_tpu.ops.topology import sensitive_keys
+
+    nodes = make_nodes(16, zones=4)
+    existing = make_pods(8, "old", assigned_round_robin_over=16)
+    pending = make_spread_constraint_pods(32, hard=False)  # soft only
+    dn, dp, ds, dt = build(nodes, existing, pending)
+    sens = np.asarray(sensitive_keys(dp, dt, dn.topo_pair_id.shape[1]))
+    assert not sens.any(), "soft-only spread pods must not be serialized"
+    # and the batch places everything fast (2 rounds, not one per pair)
+    from kubernetes_tpu.ops.assign import batch_assign
+
+    a, _, rounds = batch_assign(dp, dn, ds, topo=dt, per_node_cap=8)
+    assert int((np.asarray(a)[:32] >= 0).sum()) == 32
+    assert int(rounds) <= 4
