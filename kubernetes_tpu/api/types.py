@@ -315,6 +315,10 @@ class Pod:
     #: (generic_scheduler.go:862 pickOneNodeForPreemption: latest start time
     #: of the highest-priority victim wins).
     start_time: float = 0.0
+    #: spec.preemptionPolicy: "PreemptLowerPriority" (default) or "Never".
+    #: Honored when the NonPreemptingPriority feature gate is on
+    #: (podEligibleToPreemptOthers, generic_scheduler.go:1191).
+    preemption_policy: str = "PreemptLowerPriority"
     #: metadata.deletionTimestamp analog (0 = live). A terminating
     #: lower-priority pod on the nominated node blocks re-preemption
     #: (generic_scheduler.go:1190 podEligibleToPreemptOthers).

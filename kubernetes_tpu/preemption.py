@@ -58,11 +58,15 @@ class PreemptionResult:
 
 
 def pod_eligible_to_preempt_others(
-    pod: Pod, node_pods_of: Dict[str, List[Pod]]
+    pod: Pod, node_pods_of: Dict[str, List[Pod]],
+    enable_non_preempting: bool = False,
 ) -> bool:
     """generic_scheduler.go:1190 — a pod that already triggered a preemption
     (has a nominated node) waits while any lower-priority pod there is still
-    terminating."""
+    terminating; with the NonPreemptingPriority gate on, a PreemptNever
+    policy disqualifies outright (:1191-1194)."""
+    if enable_non_preempting and pod.preemption_policy == "Never":
+        return False
     nom = pod.nominated_node_name
     if nom and nom in node_pods_of:
         for p in node_pods_of[nom]:
@@ -230,13 +234,15 @@ def preempt(
     nominated_pods_of: Optional[Dict[str, List[Pod]]] = None,
     vol_state=None,
     extenders: Sequence = (),
+    enable_non_preempting: bool = False,
 ) -> Optional[PreemptionResult]:
     """The full Preempt flow for one unschedulable pod. ``node_pods_of``
     maps node name -> pods (from the cache); ``reason_bits_by_node`` is the
     pod's row of the device filter pass; ``nominated_pods_of`` maps node
     name -> pods currently nominated there (phantom occupants for the
     what-if checks, and the source for nomination clearing)."""
-    if not pod_eligible_to_preempt_others(pod, node_pods_of):
+    if not pod_eligible_to_preempt_others(pod, node_pods_of,
+                                          enable_non_preempting):
         return None
     by_name = {nd.name: nd for nd in nodes}
     candidates: Dict[str, Tuple[List[Pod], int]] = {}

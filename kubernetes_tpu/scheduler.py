@@ -137,6 +137,7 @@ class Scheduler:
         clock: Callable[[], float] = time.monotonic,
         event_sink: Optional[Callable[[str, Pod, str], None]] = None,
         enable_preemption: bool = True,
+        enable_non_preempting: bool = False,
         max_preemptions_per_cycle: int = 16,
         pdb_lister: Optional[Callable[[], List]] = None,
         victim_deleter: Optional[Callable[[Pod], None]] = None,
@@ -183,6 +184,8 @@ class Scheduler:
         self.solver = solver
         #: count of exact->round auto-fallbacks (port/volume/topology batches)
         self.exact_fallbacks = 0
+        #: NonPreemptingPriority feature gate: honor preemption_policy=Never
+        self.enable_non_preempting = enable_non_preempting
         self.per_node_cap = per_node_cap
         self.max_rounds = max_rounds
         self.max_batch = max_batch
@@ -227,6 +230,10 @@ class Scheduler:
             kw.setdefault("pred_mask", default_predicate_mask(cfg.feature_gates))
             kw.setdefault("weights", default_priority_weights(cfg.feature_gates))
         kw.setdefault("solver", cfg.solver)
+        kw.setdefault(
+            "enable_non_preempting",
+            cfg.feature_gates.enabled("NonPreemptingPriority"),
+        )
         kw.setdefault("per_node_cap", cfg.per_node_cap)
         kw.setdefault("max_rounds", cfg.max_rounds)
         kw.setdefault("max_batch", cfg.max_batch)
@@ -992,6 +999,7 @@ class Scheduler:
                 nominated_pods_of=dict(self.queue.nominated.items()),
                 vol_state=self.cache.packer.resolve_volumes,
                 extenders=[e for e in self.extenders if e.supports_preemption()],
+                enable_non_preempting=self.enable_non_preempting,
             )
             if result is None:
                 continue

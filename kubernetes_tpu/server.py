@@ -68,6 +68,8 @@ def pod_from_json(d: dict) -> Pod:
         priority=int(spec.get("priority") or 0),
         requests=requests,
         nominated_node_name=(d.get("status") or {}).get("nominatedNodeName", ""),
+        preemption_policy=spec.get("preemptionPolicy")
+        or "PreemptLowerPriority",
     )
 
 

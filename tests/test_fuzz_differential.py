@@ -19,9 +19,11 @@ import pytest
 
 import pyref
 from kubernetes_tpu.api.types import (
+    OP_EXISTS,
     Affinity,
     LabelSelector,
     PodAffinityTerm,
+    Requirement,
     TopologySpreadConstraint,
 )
 from kubernetes_tpu.models.cluster import make_pv_pods
@@ -318,3 +320,115 @@ def test_pick_mixed_priority_latest_start():
         _p("m2.1", "machine2", 2, MID, d[7]), _p("m2.2", "machine2", 2, LOW, d[2]),
         _p("m3.1", "machine3", 2, LOW, d[4]), _p("m3.2", "machine3", 2, MID, d[6])])
     assert got == "machine2"
+
+
+# ---------------------------------------------------------------------------
+# TestPreempt tables (generic_scheduler_test.go:1525-1793) — end-to-end
+# through the DRIVER: the preemptor fails its cycle, preemption evicts the
+# expected victims and nominates the expected node, and the preemptor lands
+# there the next cycle.
+# ---------------------------------------------------------------------------
+
+
+def _driver_preempt(nodes, existing, preemptor, **kw):
+    clk = FakeClock()
+    deleted = []
+    s = Scheduler(clock=clk, victim_deleter=lambda v: deleted.append(v.name),
+                  **kw)
+    for nd in nodes:
+        s.on_node_add(nd)
+    for p in existing:
+        s.on_pod_add(p)
+    s.on_pod_add(preemptor)
+    res = s.schedule_cycle()
+    return s, res, deleted
+
+
+def test_preempt_basic_logic():
+    """'basic preemption logic': machine1's two small low-pri pods are the
+    cheapest eviction; machine2's high-pri pod is untouchable."""
+    nodes = [_n(f"machine{i}") for i in (1, 2, 3)]
+    existing = [
+        _p("m1.1", "machine1", 1, LOW), _p("m1.2", "machine1", 1, LOW),
+        _p("m2.1", "machine2", 3, HIGH),
+        _p("m3.1", "machine3", 2, MID),
+    ]
+    preemptor = make_pod("pod1", cpu_milli=5 * MILLI, memory=5 * MEM,
+                         priority=HIGH)
+    s, res, deleted = _driver_preempt(nodes, existing, preemptor)
+    assert res.nominations.get("default/pod1") == "machine1"
+    assert sorted(deleted) == ["m1.1", "m1.2"]
+
+
+def test_preempt_prefers_node_needing_none():
+    """'One node doesn't need any preemption': empty machine3 takes the pod
+    without any eviction."""
+    nodes = [_n(f"machine{i}") for i in (1, 2, 3)]
+    existing = [
+        _p("m1.1", "machine1", 1, LOW), _p("m1.2", "machine1", 1, LOW),
+        _p("m2.1", "machine2", 3, HIGH),
+    ]
+    preemptor = make_pod("pod1", cpu_milli=5 * MILLI, memory=5 * MEM,
+                         priority=HIGH)
+    s, res, deleted = _driver_preempt(nodes, existing, preemptor)
+    assert res.assignments.get("default/pod1") == "machine3"
+    assert deleted == [] and res.preempted == 0
+
+
+def test_preempt_topology_spread_constraints():
+    """'preemption for topology spread constraints': skew forces node-b;
+    only low-pri pod-b1 is evictable."""
+    mk = lambda name, zone: make_node(
+        name, cpu_milli=64000, labels={
+            "zone": zone, "kubernetes.io/hostname": name,
+        })
+    nodes = [mk("node-a", "zone1"), mk("node-b", "zone1"),
+             mk("node-x", "zone2")]
+    lab = {"foo": ""}
+    existing = [
+        make_pod("pod-a1", node_name="node-a", priority=HIGH, labels=lab),
+        make_pod("pod-a2", node_name="node-a", priority=HIGH, labels=lab),
+        make_pod("pod-b1", node_name="node-b", priority=LOW, labels=lab),
+        make_pod("pod-x1", node_name="node-x", priority=HIGH, labels=lab),
+        make_pod("pod-x2", node_name="node-x", priority=HIGH, labels=lab),
+    ]
+    sel = LabelSelector(match_expressions=(
+        Requirement("foo", OP_EXISTS),
+    ))
+    preemptor = make_pod("p", priority=HIGH, labels=lab)
+    preemptor.topology_spread = (
+        TopologySpreadConstraint(max_skew=1, topology_key="zone",
+                                 when_unsatisfiable="DoNotSchedule",
+                                 label_selector=sel),
+        TopologySpreadConstraint(max_skew=1,
+                                 topology_key="kubernetes.io/hostname",
+                                 when_unsatisfiable="DoNotSchedule",
+                                 label_selector=sel),
+    )
+    s, res, deleted = _driver_preempt(nodes, existing, preemptor)
+    assert res.nominations.get("default/p") == "node-b"
+    assert deleted == ["pod-b1"]
+
+
+def test_preempt_policy_never_blocks():
+    """'no preempting in pod': PreemptNever + NonPreemptingPriority gate on
+    -> no preemption anywhere."""
+    nodes = [_n(f"machine{i}") for i in (1, 2, 3)]
+    existing = [
+        _p("m1.1", "machine1", 1, LOW), _p("m1.2", "machine1", 1, LOW),
+        _p("m2.1", "machine2", 3, HIGH),
+        _p("m3.1", "machine3", 2, MID),
+    ]
+    preemptor = make_pod("pod1", cpu_milli=5 * MILLI, memory=5 * MEM,
+                         priority=HIGH)
+    preemptor.preemption_policy = "Never"
+    s, res, deleted = _driver_preempt(nodes, existing, preemptor,
+                                      enable_non_preempting=True)
+    assert res.nominations == {} and deleted == []
+    # gate off -> the policy is ignored (alpha default, kube_features.go)
+    s2, res2, deleted2 = _driver_preempt(
+        nodes, existing,
+        make_pod("pod1", cpu_milli=5 * MILLI, memory=5 * MEM, priority=HIGH),
+        enable_non_preempting=False,
+    )
+    assert res2.nominations.get("default/pod1") == "machine1"

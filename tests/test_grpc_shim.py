@@ -196,3 +196,12 @@ def test_bind_failure_requeues_pod(shim):
     # pod is back in the queue, not stranded
     assert sched.queue.pod("default/w") is not None
     assert sched.cache.pod("default/w") is None
+
+
+def test_pod_json_carries_preemption_policy():
+    from kubernetes_tpu.server import pod_from_json
+
+    p = make_pod("np", cpu_milli=100)
+    p.preemption_policy = "Never"
+    back = pod_from_json(pod_to_json(p))
+    assert back.preemption_policy == "Never"
