@@ -30,8 +30,10 @@ most important architectural fact of the reference, SURVEY.md §1):
 
 from __future__ import annotations
 
+import bisect
 import heapq
 import random
+import weakref
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -43,6 +45,12 @@ from kubernetes_tpu.testing import make_node, make_pod
 
 class Conflict(Exception):
     """Optimistic-concurrency write rejection (apierrors.IsConflict)."""
+
+
+class Compacted(Exception):
+    """Watch cursor fell behind the compaction floor — the etcd
+    ErrCompacted ("required revision has been compacted") that forces a
+    client-go Reflector relist (reflector.go ListAndWatch error path)."""
 
 
 class SimClock:
@@ -144,15 +152,64 @@ class HollowCluster:
         self._seq = 0
         self._watch_q: List[tuple] = []  # (due, seq, deliver_fn)
         self._obj_last_due: Dict[str, int] = {}
+        #: append-only watch history: (rev, obj_key, type, obj-or-None)
+        self._history: List[tuple] = []
+        self._compacted_rev = 0
+        #: open watch cursors (weak: a dropped Reflector frees its history)
+        self._cursors: "weakref.WeakSet" = weakref.WeakSet()
 
     # -- versioned store core ---------------------------------------------
 
-    def _commit(self, obj_key: str) -> int:
-        """Bump the global revision and stamp the object — every truth
-        write funnels through here (etcd3/store.go:236)."""
+    def _commit(self, obj_key: str, event_type: str, obj) -> int:
+        """Bump the global revision, stamp the object, and append the
+        event to the watch HISTORY — every truth write funnels through
+        here (etcd3/store.go:236 GuaranteedUpdate; the history log is the
+        etcd WAL/watchable-store analog that lets any number of watch
+        cursors replay from a revision). ``event_type``/``obj`` are
+        REQUIRED: a defaulted ('MODIFIED', None) entry would replay as
+        on_node_update(None) in a Reflector far from the buggy call site.
+
+        History is recorded only while watch cursors are open — with no
+        watcher it would just pin every historical object (etcd compacts
+        periodically for the same reason; see :meth:`step`)."""
         self._revision += 1
         self.resource_version[obj_key] = self._revision
+        if self._cursors:
+            self._history.append((self._revision, obj_key, event_type, obj))
+        else:
+            self._compacted_rev = self._revision
         return self._revision
+
+    def compact(self, rev: Optional[int] = None) -> None:
+        """Drop watch history at or below ``rev`` (etcd compaction,
+        mvcc/kvstore_compaction.go). Cursors behind the floor get
+        :class:`Compacted` on their next poll and must relist."""
+        rev = self._revision if rev is None else rev
+        self._compacted_rev = max(self._compacted_rev, rev)
+        self._history = [e for e in self._history if e[0] > self._compacted_rev]
+
+    def watch(self, since_rev: int) -> "WatchCursor":
+        """Open an independent watch cursor starting AFTER ``since_rev``
+        (apiserver watch ?resourceVersion= semantics). Any number of
+        cursors may be open — the watch-cacher fan-out (cacher.go: N
+        watchers cost one history log)."""
+        if since_rev < self._compacted_rev:
+            raise Compacted(
+                f"required revision {since_rev} has been compacted "
+                f"(floor {self._compacted_rev})"
+            )
+        cur = WatchCursor(self, since_rev)
+        self._cursors.add(cur)
+        return cur
+
+    def list_state(self):
+        """LIST at the current revision: (revision, nodes, pods) snapshots
+        — the Reflector's relist source (reflector.go:159)."""
+        return (
+            self._revision,
+            dict(self.truth_nodes),
+            dict(self.truth_pods),
+        )
 
     def _emit(self, obj_key: str, deliver: Callable[[], None]) -> None:
         """Queue a watch event. Delivery may lag (``event_delay_ticks``)
@@ -193,7 +250,7 @@ class HollowCluster:
     def add_node(self, node: Node) -> None:
         self.truth_nodes[node.name] = node
         self.heartbeats[node.name] = self.clock.t
-        self._commit(f"nodes/{node.name}")
+        self._commit(f"nodes/{node.name}", "ADDED", node)
         self._emit(f"nodes/{node.name}", lambda: self.sched.on_node_add(node))
 
     def remove_node(self, name: str) -> None:
@@ -204,7 +261,7 @@ class HollowCluster:
         self.heartbeats.pop(name, None)
         self._taint_time.pop(name, None)
         self.dead_kubelets.discard(name)
-        self._commit(f"nodes/{name}")
+        self._commit(f"nodes/{name}", "DELETED", None)
         for key, p in list(self.truth_pods.items()):
             if p.node_name == name:
                 self.delete_pod(key)
@@ -212,13 +269,13 @@ class HollowCluster:
 
     def create_pod(self, pod: Pod) -> None:
         self.truth_pods[pod.key()] = pod
-        self._commit(f"pods/{pod.key()}")
+        self._commit(f"pods/{pod.key()}", "ADDED", pod)
         self._emit(f"pods/{pod.key()}", lambda: self.sched.on_pod_add(pod))
 
     def delete_pod(self, key: str) -> None:
         pod = self.truth_pods.pop(key, None)
         if pod is not None:
-            self._commit(f"pods/{key}")
+            self._commit(f"pods/{key}", "DELETED", None)
             self._emit(f"pods/{key}", lambda: self.sched.on_pod_delete(pod))
             for rs in self.replicasets.values():
                 rs.live.pop(key, None)
@@ -242,7 +299,7 @@ class HollowCluster:
 
         new = dataclasses.replace(cur, node_name=node_name)
         self.truth_pods[key] = new
-        self._commit(f"pods/{key}")
+        self._commit(f"pods/{key}", "MODIFIED", new)
         self.bound_total += 1
         self._emit(f"pods/{key}", lambda: self.sched.on_pod_update(cur, new))
 
@@ -356,7 +413,7 @@ class HollowCluster:
 
     def _update_node(self, node: Node) -> None:
         self.truth_nodes[node.name] = node
-        self._commit(f"nodes/{node.name}")
+        self._commit(f"nodes/{node.name}", "MODIFIED", node)
         self._emit(f"nodes/{node.name}", lambda: self.sched.on_node_update(node))
 
     def monitor_node_health(self) -> None:
@@ -471,6 +528,11 @@ class HollowCluster:
         # stale and its binds must CAS-fail
         self.competing_writer()
         res = self.sched.schedule_cycle()
+        # periodic compaction to the slowest open cursor (etcd's
+        # auto-compaction): history stays bounded by watcher lag, not by
+        # sim length
+        floor = min((c.rev for c in self._cursors), default=self._revision)
+        self.compact(floor)
         self.clock.advance(dt)
         return res
 
@@ -502,3 +564,134 @@ class HollowCluster:
 
     def pending_count(self) -> int:
         return sum(1 for p in self.truth_pods.values() if not p.node_name)
+
+
+class WatchCursor:
+    """One watcher's position in the hub's history — the apiserver watch
+    stream a client holds. Independent cursors = watch fan-out
+    (storage/cacher/cacher.go: many watchers, one event source)."""
+
+    def __init__(self, hub: HollowCluster, since_rev: int) -> None:
+        self.hub = hub
+        self.rev = since_rev
+
+    def poll(self) -> List[tuple]:
+        """Events after this cursor's revision, advancing it. Raises
+        :class:`Compacted` when the cursor fell behind the compaction
+        floor (the relist trigger)."""
+        if self.rev < self.hub._compacted_rev:
+            raise Compacted(
+                f"required revision {self.rev} has been compacted "
+                f"(floor {self.hub._compacted_rev})"
+            )
+        h = self.hub._history
+        i = bisect.bisect_right(h, self.rev, key=lambda e: e[0])
+        out = h[i:]
+        self.rev = max(self.rev, self.hub._revision)
+        return out
+
+
+class Reflector:
+    """client-go Reflector.ListAndWatch (tools/cache/reflector.go:159)
+    over the hub's versioned store, feeding a scheduler's event-handler
+    surface (the SharedInformer seam):
+
+    - LIST at a revision, deliver the snapshot as adds/updates/deletes
+      RELATIVE to what this reflector already delivered (DeltaFIFO.Replace
+      semantics — a relist must emit deletes for objects that vanished
+      while the watch was down);
+    - WATCH from that revision, translating history events into
+      on_pod_add/on_pod_update/on_pod_delete/on_node_* calls;
+    - a :class:`Compacted` watch error relists (reflector.go's
+      "too old resource version" path);
+    - resync() re-delivers every known object as a no-op update (the
+      SharedInformer resync period).
+    """
+
+    def __init__(self, hub: HollowCluster, sink) -> None:
+        self.hub = hub
+        self.sink = sink
+        self.pods: Dict[str, Pod] = {}
+        self.nodes: Dict[str, Node] = {}
+        self.relists = 0
+        self._cursor: Optional[WatchCursor] = None
+
+    # -- list+watch --------------------------------------------------------
+
+    def list_and_watch(self) -> None:
+        rev, nodes, pods = self.hub.list_state()
+        # Replace(): adds for new, updates for changed, deletes for gone
+        for name, nd in nodes.items():
+            if name not in self.nodes:
+                self.sink.on_node_add(nd)
+            elif self.nodes[name] is not nd:
+                self.sink.on_node_update(nd)
+        for name in list(self.nodes):
+            if name not in nodes:
+                self.sink.on_node_delete(name)
+        for key, p in pods.items():
+            old = self.pods.get(key)
+            if old is None:
+                self.sink.on_pod_add(p)
+            elif old is not p:
+                if old.uid != p.uid or (old.node_name and not p.node_name):
+                    # deleted-and-recreated while the watch was down: a
+                    # single update would leave the stale bound pod in the
+                    # sink's cache (scheduler on_pod_update's unassigned
+                    # branch never removes) — replay as delete+add
+                    self.sink.on_pod_delete(old)
+                    self.sink.on_pod_add(p)
+                else:
+                    self.sink.on_pod_update(old, p)
+        for key, old in list(self.pods.items()):
+            if key not in pods:
+                self.sink.on_pod_delete(old)
+        self.nodes, self.pods = nodes, pods
+        self._cursor = self.hub.watch(rev)
+
+    def pump(self) -> int:
+        """Deliver pending watch events; relist on compaction. Returns the
+        number of events delivered (relist counts as one)."""
+        if self._cursor is None:
+            self.list_and_watch()
+            return 1
+        try:
+            events = self._cursor.poll()
+        except Compacted:
+            self.relists += 1
+            self.list_and_watch()
+            return 1
+        for _, obj_key, etype, obj in events:
+            kind, _, ident = obj_key.partition("/")
+            if kind == "nodes":
+                if etype == "ADDED":
+                    self.nodes[ident] = obj
+                    self.sink.on_node_add(obj)
+                elif etype == "MODIFIED":
+                    self.nodes[ident] = obj
+                    self.sink.on_node_update(obj)
+                else:
+                    self.nodes.pop(ident, None)
+                    self.sink.on_node_delete(ident)
+            else:
+                if etype == "ADDED":
+                    self.pods[ident] = obj
+                    self.sink.on_pod_add(obj)
+                elif etype == "MODIFIED":
+                    old = self.pods.get(ident, obj)
+                    self.pods[ident] = obj
+                    self.sink.on_pod_update(old, obj)
+                else:
+                    old = self.pods.pop(ident, None)
+                    if old is not None:
+                        self.sink.on_pod_delete(old)
+        return len(events)
+
+    def resync(self) -> None:
+        """Re-deliver every known object as an update — the SharedInformer
+        resync loop (shared_informer.go resyncPeriod); handlers must treat
+        it as a no-op when nothing changed."""
+        for nd in self.nodes.values():
+            self.sink.on_node_update(nd)
+        for key, p in self.pods.items():
+            self.sink.on_pod_update(p, p)
