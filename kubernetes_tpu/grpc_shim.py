@@ -27,6 +27,7 @@ from typing import Iterator, Optional
 import grpc
 
 from kubernetes_tpu.api.types import Node, NodeCondition, Resources, Taint
+from kubernetes_tpu.extender import node_to_json, pod_to_json
 from kubernetes_tpu.proto import extender_pb2 as pb
 from kubernetes_tpu.server import ExtenderServer, parse_quantity, pod_from_json
 
@@ -161,6 +162,26 @@ class TpuSchedulerService:
             out.items.add(host=item["host"], score=item["score"])
         return out
 
+    def get_state(self, request: pb.StateRequest, context) -> pb.StateSnapshot:
+        """Read-only snapshot dump for tooling (the ktpu CLI's 'get'
+        source): cache nodes, bound/assumed pods, queued pods."""
+        s = self.scheduler
+        with self._lock:
+            out = pb.StateSnapshot(revision=self.revision)
+            if request.kind in ("", "nodes"):
+                for nd in s.cache.nodes():
+                    out.node_json.append(json.dumps(node_to_json(nd)))
+            if request.kind in ("", "pods"):
+                for nd in s.cache.nodes():
+                    for p in s.cache.pods_on(nd.name):
+                        out.pod_json.append(json.dumps(pod_to_json(p)))
+                for qname, pods in s.queue.pending_pods().items():
+                    for p in pods:
+                        out.pending_json.append(json.dumps(
+                            {"queue": qname, "pod": pod_to_json(p)}
+                        ))
+        return out
+
     def bind(self, request: pb.Binding, context) -> pb.BindResult:
         """The Binding-subresource write (BindingREST.Create → assignPod,
         registry/core/pod/storage/storage.go:154): a pending pod moves
@@ -215,6 +236,11 @@ def _handlers(svc: TpuSchedulerService) -> grpc.GenericRpcHandler:
             request_deserializer=pb.Binding.FromString,
             response_serializer=pb.BindResult.SerializeToString,
         ),
+        "GetState": grpc.unary_unary_rpc_method_handler(
+            svc.get_state,
+            request_deserializer=pb.StateRequest.FromString,
+            response_serializer=pb.StateSnapshot.SerializeToString,
+        ),
     }
     return grpc.method_handlers_generic_handler(SERVICE_NAME, rpcs)
 
@@ -235,6 +261,7 @@ class GrpcSchedulerClient:
     generated *_pb2_grpc.Stub provides)."""
 
     def __init__(self, target: str):
+        self.target = target
         self.channel = grpc.insecure_channel(target)
         base = f"/{SERVICE_NAME}/"
         self.sync_state = self.channel.stream_stream(
@@ -256,6 +283,11 @@ class GrpcSchedulerClient:
             base + "Bind",
             request_serializer=pb.Binding.SerializeToString,
             response_deserializer=pb.BindResult.FromString,
+        )
+        self.get_state = self.channel.unary_unary(
+            base + "GetState",
+            request_serializer=pb.StateRequest.SerializeToString,
+            response_deserializer=pb.StateSnapshot.FromString,
         )
 
     def close(self) -> None:

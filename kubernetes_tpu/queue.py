@@ -175,6 +175,14 @@ class SchedulingQueue:
         heapq.heappush(self._backoff, (expiry, next(self._seq), pod.key()))
         self._in_backoff[pod.key()] = pod
 
+    def pending_pods(self) -> Dict[str, List[Pod]]:
+        """Snapshot of queued pods by sub-queue (tooling/state dumps)."""
+        return {
+            "active": list(self._in_active.values()),
+            "backoff": list(self._in_backoff.values()),
+            "unschedulable": [p for p, _ in self._unschedulable.values()],
+        }
+
     def pod(self, key: str) -> Optional[Pod]:
         """Look up a queued pod by key across the three sub-queues."""
         p = self._in_active.get(key) or self._in_backoff.get(key)

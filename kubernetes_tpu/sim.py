@@ -102,6 +102,104 @@ class ReplicaSet:
     live: Dict[str, Pod] = field(default_factory=dict)
 
 
+class HollowKubelet:
+    """Per-node hollow node agent — the kubemark hollow-node analog
+    (pkg/kubemark/hollow_kubelet.go:44: real kubelet logic, fake
+    runtime), covering the slice of pkg/kubelet the scheduler's
+    correctness depends on:
+
+    - **admission** (lifecycle/predicate.go GeneralPredicates at
+      arrival): the apiserver accepts double-booked bindings, so two
+      schedulers racing on stale views CAN overcommit a node in truth;
+      this kubelet admits bound pods in binding-arrival order
+      (resourceVersion) and evicts the over-committed tail (OutOfcpu),
+      whose controllers then recreate them;
+    - **node-status heartbeats** (kubelet_node_status.go): refreshed
+      every sync while alive; the node-lifecycle controller CONSUMES the
+      age (it never refreshes — killing this kubelet is how the
+      unreachable-taint path is exercised);
+    - **pressure conditions** (eviction-manager thresholds): memory
+      usage beyond ``mem_pressure_frac`` of allocatable reports
+      MemoryPressure in node status (MODIFIED event), which the
+      scheduler's CheckNodeMemoryPressure then enforces against
+      BestEffort pods.
+    """
+
+    def __init__(self, hub: "HollowCluster", node_name: str,
+                 mem_pressure_frac: float = 0.95) -> None:
+        self.hub = hub
+        self.name = node_name
+        self.alive = True
+        self.mem_pressure_frac = mem_pressure_frac
+
+    def pods(self) -> List[Pod]:
+        return [p for p in self.hub.truth_pods.values()
+                if p.node_name == self.name]
+
+    def heartbeat(self) -> None:
+        if self.alive:
+            self.hub.heartbeats[self.name] = self.hub.clock.t
+
+    def admit(self, keys: Optional[List[str]] = None) -> None:
+        """GeneralPredicates at arrival; evict the over-committed tail in
+        binding order (latest bindings lose, like late OutOfcpu arrivals).
+        ``keys`` lets the hub pass a pre-grouped pod list (one O(P) pass
+        for all nodes instead of one scan per node)."""
+        nd = self.hub.truth_nodes.get(self.name)
+        if nd is None:
+            return
+        if keys is None:
+            keys = [k for k, p in self.hub.truth_pods.items()
+                    if p.node_name == self.name]
+        keys = sorted(
+            keys, key=lambda k: self.hub.resource_version.get(f"pods/{k}", 0))
+        cpu = mem = cnt = 0.0
+        for k in keys:
+            p = self.hub.truth_pods[k]
+            cpu += p.requests.cpu_milli
+            mem += p.requests.memory
+            cnt += 1
+            if (
+                cpu > nd.allocatable.cpu_milli + 1e-6
+                or mem > nd.allocatable.memory + 1e-6
+                or cnt > nd.allocatable.pods
+            ):
+                self.hub.delete_pod(k)
+                cpu -= p.requests.cpu_milli
+                mem -= p.requests.memory
+                cnt -= 1
+
+    def update_conditions(self) -> None:
+        """Report MemoryPressure when usage crosses the eviction-manager
+        threshold; clear it when usage recedes. Status writes go through
+        the hub (a node MODIFIED watch event, like a real status PATCH)."""
+        import dataclasses
+
+        nd = self.hub.truth_nodes.get(self.name)
+        if nd is None or not self.alive:
+            return
+        used_mem = sum(p.requests.memory for p in self.pods())
+        pressured = used_mem > self.mem_pressure_frac * max(
+            nd.allocatable.memory, 1e-9
+        )
+        if pressured != nd.conditions.memory_pressure:
+            self.hub._update_node(dataclasses.replace(
+                nd,
+                conditions=dataclasses.replace(
+                    nd.conditions, memory_pressure=pressured
+                ),
+            ))
+
+    def sync(self) -> None:
+        """One syncLoop iteration (kubelet.go:1816 analog, hollow).
+        Admission is NOT repeated here — the hub's kubelet_admission pass
+        (run from gc_orphaned every tick) already enforced it with one
+        grouped scan."""
+        self.heartbeat()
+        if self.alive:
+            self.update_conditions()
+
+
 class HollowCluster:
     """Owns the truth (pods/nodes) behind a versioned store and pumps
     watch events at the scheduler. All scheduler interaction goes through
@@ -133,6 +231,8 @@ class HollowCluster:
         self.pdbs: List = []
         # node-lifecycle state (heartbeats, unreachable taints, eviction)
         self.dead_kubelets: set = set()
+        #: per-node hollow agents (kubemark hollow-node registry)
+        self.kubelets: Dict[str, HollowKubelet] = {}
         self.heartbeats: Dict[str, float] = {}
         self._taint_time: Dict[str, float] = {}
         self.node_grace_s = node_grace_s
@@ -249,6 +349,7 @@ class HollowCluster:
 
     def add_node(self, node: Node) -> None:
         self.truth_nodes[node.name] = node
+        self.kubelets[node.name] = HollowKubelet(self, node.name)
         self.heartbeats[node.name] = self.clock.t
         self._commit(f"nodes/{node.name}", "ADDED", node)
         self._emit(f"nodes/{node.name}", lambda: self.sched.on_node_add(node))
@@ -259,6 +360,7 @@ class HollowCluster:
         if self.truth_nodes.pop(name, None) is None:
             return
         self.heartbeats.pop(name, None)
+        self.kubelets.pop(name, None)
         self._taint_time.pop(name, None)
         self.dead_kubelets.discard(name)
         self._commit(f"nodes/{name}", "DELETED", None)
@@ -314,39 +416,17 @@ class HollowCluster:
         self.kubelet_admission()
 
     def kubelet_admission(self) -> None:
-        """The kubelet-admission analog (pkg/kubelet/lifecycle/predicate.go
-        enforces GeneralPredicates on arrival): the apiserver happily
-        accepts double-booked bindings — two schedulers racing on a stale
-        view CAN overcommit a node in truth (the Binding CAS only guards
-        the pod, not node capacity). On a real cluster the kubelet then
-        rejects the late arrivals (OutOfcpu); here the LAST-bound pods
-        (highest resourceVersion) are evicted until the node fits, and
-        their controllers recreate them."""
+        """Run every node's kubelet admission pass (the per-node logic
+        lives in :class:`HollowKubelet.admit`, lifecycle/predicate.go
+        analog). Called from gc_orphaned so consistency holds even
+        between sync ticks; runs for dead kubelets too — the truth
+        invariant (no over-committed node) predates the agent split."""
         by_node: Dict[str, List[str]] = {}
         for key, p in self.truth_pods.items():
             if p.node_name:
                 by_node.setdefault(p.node_name, []).append(key)
-        for name, keys in by_node.items():
-            nd = self.truth_nodes.get(name)
-            if nd is None:
-                continue
-            # arrival order = resourceVersion of the binding write
-            keys.sort(key=lambda k: self.resource_version.get(f"pods/{k}", 0))
-            cpu = mem = cnt = 0.0
-            for k in keys:
-                p = self.truth_pods[k]
-                cpu += p.requests.cpu_milli
-                mem += p.requests.memory
-                cnt += 1
-                if (
-                    cpu > nd.allocatable.cpu_milli + 1e-6
-                    or mem > nd.allocatable.memory + 1e-6
-                    or cnt > nd.allocatable.pods
-                ):
-                    self.delete_pod(k)
-                    cpu -= p.requests.cpu_milli
-                    mem -= p.requests.memory
-                    cnt -= 1
+        for name, kl in list(self.kubelets.items()):
+            kl.admit(by_node.get(name, []))
 
     # -- controllers / churn ------------------------------------------------
 
@@ -407,9 +487,14 @@ class HollowCluster:
         (unlike :meth:`remove_node`); the lifecycle controller must notice
         via heartbeat age, not via a delete event."""
         self.dead_kubelets.add(name)
+        if name in self.kubelets:
+            self.kubelets[name].alive = False
 
     def heal_kubelet(self, name: str) -> None:
         self.dead_kubelets.discard(name)
+        if name in self.kubelets:
+            self.kubelets[name].alive = True
+            self.kubelets[name].heartbeat()
 
     def _update_node(self, node: Node) -> None:
         self.truth_nodes[node.name] = node
@@ -426,9 +511,6 @@ class HollowCluster:
         import dataclasses
 
         now = self.clock.t
-        for name in list(self.truth_nodes):
-            if name not in self.dead_kubelets:
-                self.heartbeats[name] = now
         for name, nd in list(self.truth_nodes.items()):
             age = now - self.heartbeats.get(name, now)
             tainted = any(t.key == self.TAINT_UNREACHABLE for t in nd.taints)
@@ -520,6 +602,8 @@ class HollowCluster:
         self._tick += 1
         self.flush_events()
         self.gc_orphaned()
+        for kl in list(self.kubelets.values()):  # syncLoop ticks
+            kl.sync()
         self.monitor_node_health()
         self.reconcile_pdbs()
         self.reconcile_controllers()

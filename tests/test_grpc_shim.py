@@ -205,3 +205,49 @@ def test_pod_json_carries_preemption_policy():
     p.preemption_policy = "Never"
     back = pod_from_json(pod_to_json(p))
     assert back.preemption_policy == "Never"
+
+
+def test_kubectl_get_describe_top(shim, capsys):
+    """The ktpu CLI (pkg/kubectl analog) over GetState: get/top/describe,
+    including the per-node scheduling explanation for a pending pod."""
+    from kubernetes_tpu import kubectl
+    from kubernetes_tpu.api.types import Taint
+
+    sched, client = shim
+    list(client.sync_state(iter([
+        _delta(1,
+               nodes=[make_node("big", cpu_milli=64000),
+                      make_node("small", cpu_milli=500)],
+               pods=[make_pod("w", cpu_milli=100),
+                     make_pod("stuck", cpu_milli=1000)]),
+    ])))
+    sched.schedule_cycle()  # w + stuck land on big (small is too small)
+    server = client.target
+
+    assert kubectl.main(["--server", server, "get", "nodes"]) == 0
+    out = capsys.readouterr().out
+    assert "big" in out and "Ready" in out
+
+    assert kubectl.main(["--server", server, "get", "pods"]) == 0
+    out = capsys.readouterr().out
+    assert "Bound" in out and "w" in out
+
+    assert kubectl.main(["--server", server, "top", "nodes"]) == 0
+    out = capsys.readouterr().out
+    assert "CPU%" in out
+
+    assert kubectl.main(["--server", server, "describe", "node", "big"]) == 0
+    out = capsys.readouterr().out
+    assert "Allocatable" in out and "Requested" in out
+
+    # a pending pod gets the per-node explanation from the real kernels
+    big_pod = make_pod("toobig", cpu_milli=100000)
+    d = pb.SnapshotDelta(revision=2)
+    d.pods.add(op=pb.PodDelta.ADD, key="default/toobig",
+               pod_json=json.dumps(pod_to_json(big_pod)))
+    list(client.sync_state(iter([d])))
+    assert kubectl.main(
+        ["--server", server, "describe", "pod", "toobig"]) == 0
+    out = capsys.readouterr().out
+    assert "Scheduling explanation" in out
+    assert "PodFitsResources" in out

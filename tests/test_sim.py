@@ -555,3 +555,61 @@ def test_history_stays_bounded_without_watchers():
     hub.create_pod(make_pod("x", cpu_milli=10))
     assert len(hub._history) == 1  # recorded only while watched
     assert len(cur.poll()) == 1
+
+
+# ---------------------------------------------------------------------------
+# HollowKubelet (per-node hollow agent; pkg/kubemark/hollow_kubelet.go:44)
+# ---------------------------------------------------------------------------
+
+
+def test_hollow_kubelet_reports_memory_pressure():
+    """Crossing the eviction-manager threshold reports MemoryPressure in
+    node status; the scheduler then rejects BestEffort pods there
+    (CheckNodeMemoryPressure, predicates.go:1583); receding clears it."""
+    from kubernetes_tpu.sim import HollowCluster
+
+    hub = HollowCluster(seed=7)
+    hub.add_node(make_node("n0", cpu_milli=64000, memory=10 * 2**30))
+    hub.add_node(make_node("n1", cpu_milli=64000, memory=10 * 2**30))
+    # fill n0 beyond 95% memory via the hub (competing-writer style bind)
+    big = make_pod("hog", cpu_milli=100, memory=int(9.7 * 2**30))
+    hub.create_pod(big)
+    hub.settle()
+    hub.sched.schedule_cycle()
+    hub.settle()
+    hogged = hub.truth_pods["default/hog"].node_name
+    hub.kubelets[hogged].sync()
+    assert hub.truth_nodes[hogged].conditions.memory_pressure
+    hub.settle()
+    # BestEffort pod (zero requests) avoids the pressured node
+    hub.create_pod(make_pod("be", cpu_milli=0))
+    hub.settle()
+    res = hub.sched.schedule_cycle()
+    other = "n1" if hogged == "n0" else "n0"
+    assert res.assignments.get("default/be") == other
+    # hog leaves -> pressure clears on the next sync
+    hub.delete_pod("default/hog")
+    hub.kubelets[hogged].sync()
+    assert not hub.truth_nodes[hogged].conditions.memory_pressure
+
+
+def test_hollow_kubelet_owns_heartbeats():
+    """monitor_node_health only CONSUMES heartbeat age; a dead kubelet's
+    node goes unreachable because nothing refreshes it."""
+    from kubernetes_tpu.sim import HollowCluster
+
+    hub = HollowCluster(seed=8, node_grace_s=40.0)
+    hub.add_node(make_node("n0", cpu_milli=4000))
+    hub.kill_kubelet("n0")
+    assert not hub.kubelets["n0"].alive
+    for _ in range(5):
+        hub.step(dt=15.0)
+    nd = hub.truth_nodes["n0"]
+    assert not nd.conditions.ready
+    assert any(t.key == hub.TAINT_UNREACHABLE for t in nd.taints)
+    hub.heal_kubelet("n0")
+    assert hub.kubelets["n0"].alive
+    for _ in range(3):
+        hub.step(dt=15.0)
+    nd = hub.truth_nodes["n0"]
+    assert nd.conditions.ready and not nd.taints
