@@ -432,3 +432,83 @@ def test_preempt_policy_never_blocks():
         enable_non_preempting=False,
     )
     assert res2.nominations.get("default/pod1") == "machine1"
+
+
+# ---------------------------------------------------------------------------
+# TestNodesWherePreemptionMightHelp (generic_scheduler_test.go:1415) —
+# reason-bit resolvability tables. Two documented adaptations:
+# (a) nodes ABSENT from the failure map (the reference's always-expected
+#     "machine4") are not candidates here: the batched driver only enters
+#     preemption for pods that failed on EVERY node, so zero-bit rows are
+#     padding, never feasible nodes;
+# (b) our single MatchInterPodAffinity bit does not split the reference's
+#     ErrPodAffinityRulesNotMatch (pod's OWN affinity rules, unresolvable)
+#     from ErrPodAffinityNotMatch (resolvable) — we treat both as
+#     resolvable, a conservative superset whose extra candidates victim
+#     selection then rejects.
+# ---------------------------------------------------------------------------
+
+
+def _bits(*names):
+    from kubernetes_tpu.ops.predicates import BIT
+
+    out = 0
+    for n in names:
+        out |= 1 << BIT[n]
+    return out
+
+
+def _might_help(bits_by_node):
+    from kubernetes_tpu.preemption import nodes_where_preemption_might_help
+
+    return set(nodes_where_preemption_might_help(bits_by_node))
+
+
+def test_preemption_help_no_node_attempted():
+    assert _might_help({
+        "machine1": _bits("PodMatchNodeSelector"),
+        "machine2": _bits("PodFitsHost"),
+        "machine3": _bits("PodToleratesNodeTaints"),
+        "machine4": _bits("CheckNodeUnschedulable"),
+    }) == set()
+
+
+def test_preemption_help_interpod_affinity_tried():
+    assert _might_help({
+        "machine1": _bits("MatchInterPodAffinity"),
+        "machine2": _bits("PodFitsHost"),
+        "machine3": _bits("CheckNodeUnschedulable"),
+    }) == {"machine1"}
+
+
+def test_preemption_help_mixed_predicates():
+    assert _might_help({
+        "machine1": _bits("PodMatchNodeSelector", "CheckNodeDiskPressure",
+                          "PodFitsResources"),
+        "machine2": _bits("PodFitsHost", "NoDiskConflict"),
+        "machine3": _bits("PodFitsResources"),
+    }) == {"machine3"}
+
+
+def test_preemption_help_node_conditions_unresolvable():
+    assert _might_help({
+        "machine1": _bits("CheckNodeDiskPressure"),
+        "machine2": _bits("CheckNodePIDPressure"),
+        "machine3": _bits("CheckNodeMemoryPressure"),
+        "machine4": _bits("CheckNodeCondition"),
+    }) == set()
+
+
+def test_preemption_help_volume_errors_unresolvable():
+    assert _might_help({
+        "machine1": _bits("NoVolumeZoneConflict"),
+        "machine2": _bits("VolumeNodeConflict"),
+        "machine3": _bits("VolumeBindConflict"),
+    }) == set()
+
+
+def test_preemption_help_topology_spread_tried():
+    assert _might_help({
+        "machine1": _bits("EvenPodsSpread"),
+        "machine2": _bits("EvenPodsSpread", "PodFitsHost"),
+    }) == {"machine1"}
