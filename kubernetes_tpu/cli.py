@@ -201,6 +201,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--bind-address", default="127.0.0.1")
     p.add_argument("--port", type=int, default=10251,
                    help="healthz/metrics port (0 = ephemeral)")
+    p.add_argument("--v", type=int, default=None,
+                   help="log verbosity (klog --v analog; KTPU_V env)")
     p.add_argument("--validate-only", action="store_true",
                    help="decode + validate, print result, exit")
     p.add_argument("--cycle-interval", type=float, default=0.25,
@@ -290,6 +292,10 @@ def run(cfg: KubeSchedulerConfiguration, args, stop_event=None) -> None:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.v is not None:
+        from kubernetes_tpu.utils.klog import set_verbosity
+
+        set_verbosity(args.v)
     try:
         cfg = resolve_config(args)
     except ConfigError as e:

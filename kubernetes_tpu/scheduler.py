@@ -49,6 +49,7 @@ from kubernetes_tpu.ops.arrays import (
 )
 from kubernetes_tpu.ops.predicates import run_predicates
 from kubernetes_tpu.queue import SchedulingQueue
+from kubernetes_tpu.utils import klog
 from kubernetes_tpu.utils.interner import bucket_size
 
 
@@ -542,6 +543,8 @@ class Scheduler:
                 hazards.append("host-ports")
             if hazards:
                 self.exact_fallbacks += 1
+                klog.V(4).info("exact solver unsafe (%s); using round "
+                               "solver", "+".join(hazards))
                 trace.step(
                     f"exact solver unsafe with {'+'.join(hazards)}; "
                     "using round solver"
@@ -716,6 +719,11 @@ class Scheduler:
             self.metrics.preemption_duration.observe(self.clock() - pt0)
             trace.step(f"preemption ({res.preempted} victims)")
         res.elapsed_s = self.clock() - t0
+        klog.V(3).info(
+            "cycle %d: attempted=%d scheduled=%d unschedulable=%d "
+            "rounds=%d %.3fs", cycle, res.attempted, res.scheduled,
+            res.unschedulable, res.rounds, res.elapsed_s,
+        )
         self._record_metrics(res, solve_s)
         trace.log_if_long(self.trace_threshold_s)
         return res
@@ -889,6 +897,8 @@ class Scheduler:
         cycle = self.queue.scheduling_cycle
 
         def reject(reason: str) -> bool:
+            klog.warning("bind of %s to %s failed: %s", pod.key(),
+                         node_name, reason)
             self.cache.forget_pod(pod.key())
             self.volume_binder.forget_pod_volumes(pod.key())
             res.bind_errors += 1

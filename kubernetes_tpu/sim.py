@@ -100,6 +100,46 @@ class ReplicaSet:
     priority: int = 0
     next_idx: int = 0
     live: Dict[str, Pod] = field(default_factory=dict)
+    #: owning Deployment name ("" = standalone) — the ownerReference the
+    #: GC pass consults (never inferred from the name)
+    owner: str = ""
+
+
+@dataclass
+class Deployment:
+    """Hollow deployment controller (pkg/controller/deployment): owns a
+    ReplicaSet sized to ``replicas``; scale() resizes it (rollouts beyond
+    scaling are out of the scheduler's blast radius)."""
+
+    name: str
+    replicas: int
+    cpu_milli: float = 100
+    memory: float = 256 * 2**20
+    priority: int = 0
+
+    def rs_name(self) -> str:
+        return f"{self.name}-rs"
+
+
+@dataclass
+class Job:
+    """Hollow job controller (pkg/controller/job): keeps up to
+    ``parallelism`` active pods until ``completions`` pods have run for
+    ``duration_s`` each (the hollow runtime "finishes" them — the
+    run-to-completion lifecycle the scheduler must keep feeding)."""
+
+    name: str
+    completions: int
+    parallelism: int = 1
+    duration_s: float = 30.0
+    cpu_milli: float = 100
+    memory: float = 256 * 2**20
+    next_idx: int = 0
+    succeeded: int = 0
+    active: Dict[str, Pod] = field(default_factory=dict)
+
+    def done(self) -> bool:
+        return self.succeeded >= self.completions
 
 
 class HollowKubelet:
@@ -226,6 +266,11 @@ class HollowCluster:
         self.resource_version: Dict[str, int] = {}
         self._revision = 0  # global etcd revision
         self.replicasets: Dict[str, ReplicaSet] = {}
+        self.deployments: Dict[str, Deployment] = {}
+        self.jobs: Dict[str, Job] = {}
+        #: pod key -> bind commit time (job completion clock; set by
+        #: confirm_binding)
+        self._bound_at: Dict[str, float] = {}
         #: live PDB objects; the disruption-controller analog maintains
         #: their status and the scheduler's pdb_lister reads them directly
         self.pdbs: List = []
@@ -377,6 +422,7 @@ class HollowCluster:
     def delete_pod(self, key: str) -> None:
         pod = self.truth_pods.pop(key, None)
         if pod is not None:
+            self._bound_at.pop(key, None)
             self._commit(f"pods/{key}", "DELETED", None)
             self._emit(f"pods/{key}", lambda: self.sched.on_pod_delete(pod))
             for rs in self.replicasets.values():
@@ -402,6 +448,7 @@ class HollowCluster:
         new = dataclasses.replace(cur, node_name=node_name)
         self.truth_pods[key] = new
         self._commit(f"pods/{key}", "MODIFIED", new)
+        self._bound_at[key] = self.clock.t
         self.bound_total += 1
         self._emit(f"pods/{key}", lambda: self.sched.on_pod_update(cur, new))
 
@@ -433,21 +480,78 @@ class HollowCluster:
     def add_replicaset(self, rs: ReplicaSet) -> None:
         self.replicasets[rs.name] = rs
 
+    def add_deployment(self, d: Deployment) -> None:
+        self.deployments[d.name] = d
+
+    def scale_deployment(self, name: str, replicas: int) -> None:
+        d = self.deployments.get(name)
+        if d is None:
+            raise KeyError(f"deployment {name!r} not found")
+        d.replicas = replicas
+
+    def delete_deployment(self, name: str) -> None:
+        """Cascading delete: the GC pass removes the orphaned ReplicaSet
+        and its pods (ownerReference chain, pkg/controller/garbagecollector
+        foreground deletion)."""
+        self.deployments.pop(name, None)
+
+    def add_job(self, j: Job) -> None:
+        self.jobs[j.name] = j
+
     def reconcile_controllers(self) -> None:
+        # deployment -> replicaset (create/scale)
+        for d in self.deployments.values():
+            rs = self.replicasets.get(d.rs_name())
+            if rs is None:
+                rs = ReplicaSet(d.rs_name(), d.replicas, d.cpu_milli,
+                                d.memory, d.priority, owner=d.name)
+                self.replicasets[rs.name] = rs
+            rs.replicas = d.replicas
+        # garbage collector: deployment gone -> cascade its RS + pods
+        # (ownership is the explicit owner field, never a name pattern)
+        for name in list(self.replicasets):
+            rs = self.replicasets[name]
+            if rs.owner and rs.owner not in self.deployments:
+                for key in list(rs.live):
+                    self.delete_pod(key)
+                del self.replicasets[name]
+        # replicaset scale-down (deployment shrink or direct resize)
+        for rs in self.replicasets.values():
+            extra = len(rs.live) - rs.replicas
+            if extra > 0:
+                for key in list(rs.live)[:extra]:
+                    self.delete_pod(key)
+        def spawn(prefix: str, idx: int, labels: dict, cpu, mem, pri=0):
+            pod = make_pod(f"{prefix}-{idx}", cpu_milli=cpu, memory=mem,
+                           priority=pri, labels=labels)
+            pod.uid = f"{prefix}-{idx}#{idx}"
+            self.create_pod(pod)
+            return pod
+
+        # jobs: finish pods that ran their duration; keep parallelism fed
+        for j in self.jobs.values():
+            for key in list(j.active):
+                if key not in self.truth_pods:
+                    j.active.pop(key)  # evicted/killed: controller re-adds
+                    continue
+                t0 = self._bound_at.get(key)
+                if t0 is not None and self.clock.t - t0 >= j.duration_s:
+                    j.succeeded += 1
+                    j.active.pop(key)
+                    self.delete_pod(key)  # Succeeded -> cleaned up
+            while (not j.done()
+                   and len(j.active) < j.parallelism
+                   and j.succeeded + len(j.active) < j.completions):
+                j.next_idx += 1
+                pod = spawn(j.name, j.next_idx, {"job": j.name},
+                            j.cpu_milli, j.memory)
+                j.active[pod.key()] = pod
         for rs in self.replicasets.values():
             while len(rs.live) < rs.replicas:
-                name = f"{rs.name}-{rs.next_idx}"
                 rs.next_idx += 1
-                pod = make_pod(
-                    name,
-                    cpu_milli=rs.cpu_milli,
-                    memory=rs.memory,
-                    priority=rs.priority,
-                    labels={"rs": rs.name},
-                )
-                pod.uid = f"{name}#{rs.next_idx}"
+                pod = spawn(rs.name, rs.next_idx, {"rs": rs.name},
+                            rs.cpu_milli, rs.memory, rs.priority)
                 rs.live[pod.key()] = pod
-                self.create_pod(pod)
 
     def churn(self, kill_pods: int = 0, flap_nodes: int = 0) -> None:
         """Random disruption: delete bound pods, bounce nodes."""

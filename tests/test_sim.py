@@ -613,3 +613,68 @@ def test_hollow_kubelet_owns_heartbeats():
         hub.step(dt=15.0)
     nd = hub.truth_nodes["n0"]
     assert nd.conditions.ready and not nd.taints
+
+
+# ---------------------------------------------------------------------------
+# Deployment / Job controllers + ownerRef GC
+# (kube-controller-manager analogs, controllermanager.go:376-412 registry)
+# ---------------------------------------------------------------------------
+
+
+def test_deployment_scales_and_cascade_deletes():
+    from kubernetes_tpu.sim import Deployment, HollowCluster
+
+    hub = HollowCluster(seed=10, scheduler_kw={"enable_preemption": False})
+    for i in range(4):
+        hub.add_node(make_node(f"n{i}", cpu_milli=4000))
+    hub.add_deployment(Deployment("web", replicas=6))
+    for _ in range(3):
+        hub.step()
+    hub.check_consistency()
+    live = [k for k in hub.truth_pods if k.startswith("default/web-rs-")]
+    assert len(live) == 6
+    # scale down
+    hub.scale_deployment("web", 2)
+    for _ in range(3):
+        hub.step()
+    hub.check_consistency()
+    live = [k for k in hub.truth_pods if k.startswith("default/web-rs-")]
+    assert len(live) == 2
+    # cascading delete via the GC pass
+    hub.delete_deployment("web")
+    for _ in range(2):
+        hub.step()
+    hub.check_consistency()
+    assert not any(k.startswith("default/web-rs-") for k in hub.truth_pods)
+    assert "web-rs" not in hub.replicasets
+
+
+def test_job_runs_to_completion_through_scheduler():
+    from kubernetes_tpu.sim import HollowCluster, Job
+
+    hub = HollowCluster(seed=11, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=4000))
+    hub.add_job(Job("batch", completions=5, parallelism=2, duration_s=20.0))
+    for _ in range(25):
+        hub.step(dt=15.0)
+        if hub.jobs["batch"].done():
+            break
+    j = hub.jobs["batch"]
+    assert j.done() and j.succeeded == 5
+    # finished pods are cleaned up; no stragglers left
+    assert not any(k.startswith("default/batch-") for k in hub.truth_pods)
+    hub.check_consistency()
+
+
+def test_standalone_rs_with_rs_suffix_survives_gc():
+    """Regression (r3 review): GC must use the explicit owner field, not a
+    name pattern — a standalone ReplicaSet named '*-rs' is nobody's child."""
+    from kubernetes_tpu.sim import HollowCluster, ReplicaSet
+
+    hub = HollowCluster(seed=12, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=4000))
+    hub.add_replicaset(ReplicaSet("standalone-rs", replicas=3))
+    hub.step()
+    assert "standalone-rs" in hub.replicasets
+    assert sum(1 for k in hub.truth_pods
+               if k.startswith("default/standalone-rs-")) == 3
