@@ -86,7 +86,10 @@ class TpuSchedulerService:
     def __init__(self, scheduler) -> None:
         self.scheduler = scheduler
         self.extender = ExtenderServer(scheduler)
-        self._lock = threading.Lock()  # deltas serialize against verbs
+        #: deltas serialize against verbs; a service-side cycle loop must
+        #: hold this too (sync_state mutates the same cache/queue)
+        self.lock = threading.Lock()
+        self._lock = self.lock  # internal alias
         self.revision = 0
 
     # -- SyncState (bidi stream) -------------------------------------------
@@ -246,9 +249,11 @@ def _handlers(svc: TpuSchedulerService) -> grpc.GenericRpcHandler:
 
 
 def serve_grpc(scheduler, address: str = "127.0.0.1:0",
-               max_workers: int = 8):
-    """Start the gRPC service; returns (server, bound_port)."""
-    svc = TpuSchedulerService(scheduler)
+               max_workers: int = 8, service=None):
+    """Start the gRPC service; returns (server, bound_port). Pass an
+    existing ``service`` to share it with a service-side cycle loop (which
+    must hold ``service.lock`` around schedule_cycle)."""
+    svc = service or TpuSchedulerService(scheduler)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers((_handlers(svc),))
     port = server.add_insecure_port(address)

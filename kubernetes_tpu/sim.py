@@ -415,6 +415,11 @@ class HollowCluster:
         self._emit(f"nodes/{name}", lambda: self.sched.on_node_delete(name))
 
     def create_pod(self, pod: Pod) -> None:
+        if not pod.uid:
+            # the apiserver assigns metadata.uid at create; an empty uid
+            # would break the Binding CAS's recreated-pod check for any
+            # consumer that round-trips pods through the JSON seam
+            pod.uid = f"{pod.key()}#u{self._revision + 1}"
         self.truth_pods[pod.key()] = pod
         self._commit(f"pods/{pod.key()}", "ADDED", pod)
         self._emit(f"pods/{pod.key()}", lambda: self.sched.on_pod_add(pod))
