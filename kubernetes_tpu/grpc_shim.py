@@ -89,7 +89,6 @@ class TpuSchedulerService:
         #: deltas serialize against verbs; a service-side cycle loop must
         #: hold this too (sync_state mutates the same cache/queue)
         self.lock = threading.Lock()
-        self._lock = self.lock  # internal alias
         self.revision = 0
 
     # -- SyncState (bidi stream) -------------------------------------------
@@ -98,7 +97,7 @@ class TpuSchedulerService:
                    context) -> Iterator[pb.SyncAck]:
         s = self.scheduler
         for delta in request_iterator:
-            with self._lock:
+            with self.lock:
                 for nd in delta.nodes:
                     if nd.op == pb.NodeDelta.REMOVE:
                         s.on_node_delete(nd.name)
@@ -137,7 +136,7 @@ class TpuSchedulerService:
     # -- unary verbs --------------------------------------------------------
 
     def filter(self, request: pb.ExtenderArgs, context) -> pb.ExtenderFilterResult:
-        with self._lock:
+        with self.lock:
             payload = {"pod": json.loads(request.pod_json)}
             if request.node_names:
                 payload["nodenames"] = list(request.node_names)
@@ -152,7 +151,7 @@ class TpuSchedulerService:
         )
 
     def prioritize(self, request: pb.ExtenderArgs, context) -> pb.HostPriorityList:
-        with self._lock:
+        with self.lock:
             payload = {"pod": json.loads(request.pod_json)}
             if request.node_names:
                 payload["nodenames"] = list(request.node_names)
@@ -169,7 +168,7 @@ class TpuSchedulerService:
         """Read-only snapshot dump for tooling (the ktpu CLI's 'get'
         source): cache nodes, bound/assumed pods, queued pods."""
         s = self.scheduler
-        with self._lock:
+        with self.lock:
             out = pb.StateSnapshot(revision=self.revision)
             if request.kind in ("", "nodes"):
                 for nd in s.cache.nodes():
@@ -190,7 +189,7 @@ class TpuSchedulerService:
         registry/core/pod/storage/storage.go:154): a pending pod moves
         from the queue into the cache bound to the target node."""
         s = self.scheduler
-        with self._lock:
+        with self.lock:
             key = request.pod_key
             if s.cache.pod(key) is not None:
                 return pb.BindResult(ok=False,
@@ -253,6 +252,12 @@ def serve_grpc(scheduler, address: str = "127.0.0.1:0",
     """Start the gRPC service; returns (server, bound_port). Pass an
     existing ``service`` to share it with a service-side cycle loop (which
     must hold ``service.lock`` around schedule_cycle)."""
+    if service is not None and service.scheduler is not scheduler:
+        raise ValueError(
+            "serve_grpc: `service` wraps a different Scheduler than the one "
+            "passed — RPCs would act on service.scheduler while the caller "
+            "drives the other"
+        )
     svc = service or TpuSchedulerService(scheduler)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers((_handlers(svc),))

@@ -95,6 +95,15 @@ def _round_up(x: int, m: int) -> int:
 
 BLOCK_P, BLOCK_N = 256, 512
 
+# Per-slab VMEM budget for the (bp, N)/(P, bn) logk tiles. Mosaic
+# double-buffers each input block, and the strictest compile path in play
+# (the axon tunnel's chipless AOT helper) enforces a 16 MiB scoped-vmem
+# stack limit — at the gang shape (8192x5120) a (P, bn=512) slab is
+# 16 MiB -> 32 MiB double-buffered and the compile dies with a scoped
+# vmem OOM even though the on-device JIT path accepts it. 4 MiB per slab
+# (8 MiB buffered) keeps both kernels comfortably inside every path.
+VMEM_SLAB_BUDGET = 4 * 1024 * 1024
+
 
 def _block_shapes(P0: int, N0: int, block_p: int = BLOCK_P,
                   block_n: int = BLOCK_N) -> Tuple[int, int, int, int]:
@@ -102,10 +111,26 @@ def _block_shapes(P0: int, N0: int, block_p: int = BLOCK_P,
     arithmetic so the compile probe and the real call can never diverge.
     Block dims double as lane dims of the (1, bp)/(1, bn) vector tiles, so
     both must be multiples of 128 (f32 lane tiling); bp is also the
-    sublane dim of the (bp, N) tile (multiple of 8 — implied by 128)."""
+    sublane dim of the (bp, N) tile (multiple of 8 — implied by 128).
+    Blocks shrink (floor 128) until each kernel's logk slab fits
+    VMEM_SLAB_BUDGET; shapes where even the 128-floor slab exceeds it
+    (P or N ~> 8k on the other axis) fail the compile probe and take the
+    jnp path."""
     bp = min(block_p, _round_up(P0, 128))
     bn = min(block_n, _round_up(N0, 128))
-    return bp, bn, _round_up(P0, bp), _round_up(N0, bn)
+    # Fixed-point shrink: each check uses the FINAL padded extent of the
+    # other axis, so a (bp, bn, P, N) result re-fed through this function
+    # (as the compile probe does via _scale_pallas) reproduces itself —
+    # the probe always exercises the exact kernel config of the real call.
+    while True:
+        P, N = _round_up(P0, bp), _round_up(N0, bn)
+        if bp > 128 and bp * N * 4 > VMEM_SLAB_BUDGET:
+            bp -= 128
+            continue
+        if bn > 128 and P * bn * 4 > VMEM_SLAB_BUDGET:
+            bn -= 128
+            continue
+        return bp, bn, P, N
 
 
 def _scale_pallas(logk, log_r, log_c, iters, block_p=BLOCK_P, block_n=BLOCK_N,
@@ -164,14 +189,18 @@ def _scale_pallas(logk, log_r, log_c, iters, block_p=BLOCK_P, block_n=BLOCK_N,
 
 
 @functools.lru_cache(maxsize=64)
-def _pallas_compiles(P: int, N: int) -> bool:
-    """One-time compile probe at the exact padded shape: Mosaic layout
-    verification happens at compile time inside whatever jit wraps the
-    solver, where a try/except around the traced call can't catch it. A
-    failed probe downgrades to `_scale_jnp` (same math, any backend)
-    instead of killing the whole gang variant (round-2 weak #9)."""
+def _pallas_compiles(bp: int, bn: int, P: int, N: int) -> bool:
+    """One-time compile probe at the exact padded shape AND block config:
+    Mosaic layout/vmem verification happens at compile time inside
+    whatever jit wraps the solver, where a try/except around the traced
+    call can't catch it. A failed probe downgrades to `_scale_jnp` (same
+    math, any backend) instead of killing the whole gang variant
+    (round-2 weak #9). Passing (bp, bn) pins the probed kernel to the
+    real call's config — `_block_shapes` is a fixed point on padded
+    shapes, so `_scale_pallas` inside recomputes the identical tiling."""
     try:
-        u, v = jax.jit(functools.partial(_scale_pallas, iters=1))(
+        u, v = jax.jit(functools.partial(
+            _scale_pallas, iters=1, block_p=bp, block_n=bn))(
             jnp.zeros((P, N), jnp.float32),
             jnp.zeros((P,), jnp.float32),
             jnp.zeros((N,), jnp.float32),
@@ -219,8 +248,7 @@ def sinkhorn_plan(
             # compiled mode: probe the exact padded shape first; fall back
             # to the jnp path on Mosaic failure instead of propagating a
             # compile error out of the caller's jit
-            _, _, P, N = _block_shapes(*logk.shape)
-            pallas = _pallas_compiles(P, N)
+            pallas = _pallas_compiles(*_block_shapes(*logk.shape))
     if pallas:
         u, v = _scale_pallas(logk, log_r, log_c, iters, interpret=interp)
     else:

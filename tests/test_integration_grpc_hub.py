@@ -15,6 +15,7 @@ hub's truth.
 """
 
 import json
+import random
 
 import pytest
 
@@ -28,7 +29,7 @@ from kubernetes_tpu.grpc_shim import (
 )
 from kubernetes_tpu.proto import extender_pb2 as pb
 from kubernetes_tpu.scheduler import Scheduler
-from kubernetes_tpu.sim import Conflict, HollowCluster, ReplicaSet
+from kubernetes_tpu.sim import FlakyBinder, HollowCluster, ReplicaSet
 from kubernetes_tpu.testing import make_node, make_pod
 
 NODE_OPS = {"ADDED": pb.NodeDelta.ADD, "MODIFIED": pb.NodeDelta.UPDATE,
@@ -37,21 +38,13 @@ POD_OPS = {"ADDED": pb.PodDelta.ADD, "MODIFIED": pb.PodDelta.UPDATE,
            "DELETED": pb.PodDelta.REMOVE}
 
 
-class HubBinder:
+def HubBinder(hub: HollowCluster) -> FlakyBinder:
     """The service's Binder in this deployment: POST the binding to the
-    hub's CAS subresource. A Conflict (stale view) raises through the
-    driver's bind-error path (Forget + requeue, scheduler.go:447)."""
-
-    def __init__(self, hub: HollowCluster) -> None:
-        self.hub = hub
-        self.conflicts = 0
-
-    def bind(self, pod, node_name: str) -> None:
-        try:
-            self.hub.confirm_binding(pod, node_name)
-        except Conflict:
-            self.conflicts += 1
-            raise
+    hub's CAS subresource (fail_rate=0 — only hub-side CAS Conflicts
+    raise, through the driver's bind-error path, scheduler.go:447). Own
+    rng: FlakyBinder draws per bind and sharing hub.rng would perturb
+    the hub's seeded determinism."""
+    return FlakyBinder(hub, 0.0, random.Random(0))
 
 
 class GrpcBridge:

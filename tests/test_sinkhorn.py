@@ -154,3 +154,26 @@ def test_pallas_handles_unpadded_shapes():
                                  jnp.asarray(cap), iters=15, pallas=True,
                                  interpret=True))
     assert np.allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_block_shapes_fixed_point():
+    """The compile probe re-derives the tiling from the padded shape via
+    `_scale_pallas`; `_block_shapes` must therefore be a fixed point on
+    its own output or the probe validates a different kernel config than
+    the real call runs (round-3 review finding)."""
+    from kubernetes_tpu.ops.sinkhorn import VMEM_SLAB_BUDGET, _block_shapes
+
+    shapes = [(8192, 5120), (64, 16), (303, 41), (2048, 1024), (2300, 4000),
+              (8192, 128), (1, 1), (4096, 50176), (100000, 128), (513, 4097)]
+    for P0, N0 in shapes:
+        bp, bn, P, N = _block_shapes(P0, N0)
+        assert bp % 128 == 0 and bn % 128 == 0
+        assert P % bp == 0 and N % bn == 0 and P >= P0 and N >= N0
+        # slabs within budget whenever shrinkage could still act
+        if bp > 128:
+            assert bp * N * 4 <= VMEM_SLAB_BUDGET
+        if bn > 128:
+            assert P * bn * 4 <= VMEM_SLAB_BUDGET
+        # fixed point: re-deriving from the padded shape with the chosen
+        # blocks as caps reproduces the identical config
+        assert _block_shapes(P, N, bp, bn) == (bp, bn, P, N)

@@ -43,8 +43,7 @@ def test_compile_probe_passes_at_gang_scale():
     compile — the round-2 Mosaic layout failure reproduced exactly here."""
     from kubernetes_tpu.ops.sinkhorn import _block_shapes, _pallas_compiles
 
-    _, _, P, N = _block_shapes(8192, 5120)
-    assert _pallas_compiles(P, N)
+    assert _pallas_compiles(*_block_shapes(8192, 5120))
 
 
 def test_gang_batch_assign_compiled_end_to_end():
