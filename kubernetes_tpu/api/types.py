@@ -179,12 +179,16 @@ class Taint:
 @dataclass(frozen=True)
 class Toleration:
     """Reference: v1.Toleration. ``operator`` is Exists or Equal; empty key
-    with Exists tolerates everything; empty effect matches all effects."""
+    with Exists tolerates everything; empty effect matches all effects.
+    ``toleration_seconds`` (NoExecute only): None = tolerate forever;
+    N = the NoExecute taint manager evicts after N seconds
+    (pkg/controller/nodelifecycle/scheduler/taint_manager.go)."""
 
     key: str = ""
     operator: str = "Equal"
     value: str = ""
     effect: str = ""
+    toleration_seconds: Optional[float] = None
 
     def tolerates(self, taint: Taint) -> bool:
         # Reference: pkg/apis/core/v1/helper/helpers.go ToleratesTaint.
@@ -285,6 +289,11 @@ class Pod:
     affinity: Affinity = field(default_factory=Affinity)
     tolerations: Tuple[Toleration, ...] = ()
     priority: int = 0
+    #: spec.priorityClassName — resolved to ``priority`` (and
+    #: ``preemption_policy``) by the Priority admission plugin
+    #: (plugin/pkg/admission/priority/admission.go); the scheduler itself
+    #: only ever reads the resolved integer.
+    priority_class_name: str = ""
     requests: Resources = field(default_factory=Resources)
     host_ports: Tuple[Tuple[str, str, int], ...] = ()  # (protocol, hostIP, port)
     topology_spread: Tuple[TopologySpreadConstraint, ...] = ()

@@ -1,0 +1,141 @@
+"""Cloud-provider seam — the in-tree cloud provider analog (SURVEY §2.2
+"cloud providers: legacy in-tree AWS/GCE/Azure"; reference
+``pkg/cloudprovider/providers`` via the ``cloudprovider.Interface`` in
+``staging/src/k8s.io/cloud-provider/cloud.go`` and the cloud node
+controller ``staging/src/k8s.io/cloud-provider/controllers/node``).
+
+What the scheduler stack actually needs from a cloud: node *initialization*
+(zone/region labels the topology kernels key on, provider IDs, addresses)
+and node *existence* (is a quiet node dead or just slow — the node
+lifecycle controller asks the cloud before deleting). Both are behind
+:class:`CloudProvider`; :class:`FakeCloud` is the hollow in-tree provider
+(the containervm/fake analog ``pkg/cloudprovider/providers/fake``).
+
+Flow (cloud_node_controller.go syncNode): nodes register with the
+``uninitialized`` NoSchedule taint; the controller looks the instance up
+in the cloud, stamps provider ID + zone/region labels + addresses, and
+removes the taint — only then does the scheduler see a feasible node.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.types import EFFECT_NO_SCHEDULE, Node, Taint
+
+#: cloudprovider.TaintExternalCloudProvider — kubelets register with this
+#: until the cloud controller initializes them (api/core/v1/well_known_taints)
+TAINT_UNINITIALIZED = "node.cloudprovider.kubernetes.io/uninitialized"
+
+LABEL_ZONE = "failure-domain.beta.kubernetes.io/zone"
+LABEL_REGION = "failure-domain.beta.kubernetes.io/region"
+LABEL_INSTANCE_TYPE = "beta.kubernetes.io/instance-type"
+
+
+@dataclass
+class Instance:
+    """One cloud VM record (the slice of Instances/Zones the node
+    controller consumes)."""
+
+    name: str
+    provider_id: str = ""
+    zone: str = ""
+    region: str = ""
+    instance_type: str = ""
+    addresses: Tuple[Tuple[str, str], ...] = ()  # (type, address)
+    exists: bool = True
+
+
+class CloudProvider:
+    """cloudprovider.Interface slice: Instances + Zones. Implementations
+    raise KeyError for unknown nodes (the NotFound the controller maps
+    to 'instance gone')."""
+
+    def instance(self, node_name: str) -> Instance:
+        raise NotImplementedError
+
+    def instance_exists(self, node_name: str) -> bool:
+        try:
+            return self.instance(node_name).exists
+        except KeyError:
+            return False
+
+
+class FakeCloud(CloudProvider):
+    """The fake in-tree provider: a dict of instances, mutable by tests
+    (terminate() is the cloud-side VM deletion the lifecycle controller
+    must notice)."""
+
+    def __init__(self, provider: str = "fake") -> None:
+        self.provider = provider
+        self.instances: Dict[str, Instance] = {}
+
+    def add_instance(self, inst: Instance) -> None:
+        if not inst.provider_id:
+            inst.provider_id = f"{self.provider}://{inst.name}"
+        self.instances[inst.name] = inst
+
+    def terminate(self, node_name: str) -> None:
+        if node_name in self.instances:
+            self.instances[node_name].exists = False
+
+    def instance(self, node_name: str) -> Instance:
+        return self.instances[node_name]
+
+
+def uninitialized_node(name: str, **node_kw) -> Node:
+    """A node as the kubelet registers it under an external cloud
+    provider: tainted uninitialized, no zone labels yet."""
+    nd = Node(name, **node_kw)
+    return dataclasses.replace(
+        nd, taints=nd.taints + (Taint(TAINT_UNINITIALIZED, value="true",
+                                      effect=EFFECT_NO_SCHEDULE),))
+
+
+class CloudNodeController:
+    """cloud_node_controller.go syncNode + the lifecycle half
+    (cloud_node_lifecycle_controller.go): initialize tainted nodes from
+    the cloud; delete nodes whose instance is gone."""
+
+    def __init__(self, hub, cloud: CloudProvider) -> None:
+        self.hub = hub
+        self.cloud = cloud
+        self.initialized = 0
+        self.deleted = 0
+
+    def reconcile(self) -> None:
+        for name, nd in list(self.hub.truth_nodes.items()):
+            tainted = any(t.key == TAINT_UNINITIALIZED for t in nd.taints)
+            if tainted:
+                try:
+                    inst = self.cloud.instance(name)
+                except KeyError:
+                    continue  # not in the cloud yet; retry next sync
+                if not inst.exists:
+                    # terminated before initialization finished: never
+                    # un-taint a dead VM — remove it outright
+                    self.hub.remove_node(name)
+                    self.deleted += 1
+                    continue
+                labels = dict(nd.labels)
+                if inst.zone:
+                    labels[LABEL_ZONE] = inst.zone
+                if inst.region:
+                    labels[LABEL_REGION] = inst.region
+                if inst.instance_type:
+                    labels[LABEL_INSTANCE_TYPE] = inst.instance_type
+                new = dataclasses.replace(
+                    nd,
+                    labels=labels,
+                    taints=tuple(t for t in nd.taints
+                                 if t.key != TAINT_UNINITIALIZED),
+                )
+                self.hub._update_node(new)
+                self.initialized += 1
+            elif not self.cloud.instance_exists(name):
+                # the VM is gone at the provider: remove the node object
+                # (cloud_node_lifecycle_controller.go MonitorNodes)
+                self.hub.remove_node(name)
+                self.deleted += 1

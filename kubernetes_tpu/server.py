@@ -15,7 +15,6 @@ Two serving roles, mirroring the reference's two integration surfaces:
 from __future__ import annotations
 
 import json
-import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
@@ -24,22 +23,14 @@ import numpy as np
 
 from kubernetes_tpu.api.types import Pod, Resources
 
-_CPU_RE = re.compile(r"^(\d+)m$")
+def parse_quantity(s, is_cpu: bool = False) -> float:
+    """Wire-seam quantity decode: cpu strings → milli-CPU, everything
+    else → base units. Full suffix grammar lives in
+    :mod:`kubernetes_tpu.api.quantity` (apimachinery ParseQuantity
+    analog)."""
+    from kubernetes_tpu.api import quantity
 
-
-def parse_quantity(s: str, is_cpu: bool = False) -> float:
-    """Minimal resource.Quantity parse: '100m' cpu, plain ints, Ki/Mi/Gi."""
-    s = str(s)
-    m = _CPU_RE.match(s)
-    if m:
-        return float(m.group(1))
-    suffixes = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
-                "k": 1e3, "M": 1e6, "G": 1e9}
-    for suf, mult in suffixes.items():
-        if s.endswith(suf):
-            return float(s[: -len(suf)]) * mult
-    v = float(s)
-    return v * 1000 if is_cpu else v  # whole cpus -> milli
+    return quantity.parse_cpu(s) if is_cpu else quantity.parse_quantity(s)
 
 
 def pod_from_json(d: dict) -> Pod:

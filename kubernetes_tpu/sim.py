@@ -37,8 +37,22 @@ import weakref
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from kubernetes_tpu.admission import (
+    NS_ACTIVE,
+    NS_TERMINATING,
+    AdmissionError,
+    Namespace,
+    QuotaController,
+    default_chain,
+)
 from kubernetes_tpu.api.types import EFFECT_NO_EXECUTE, Node, Pod, Taint
+from kubernetes_tpu.cloud import CloudNodeController
 from kubernetes_tpu.debugger import compare
+from kubernetes_tpu.proxy import (
+    ClusterIPAllocator,
+    EndpointsController,
+    ServiceProxy,
+)
 from kubernetes_tpu.scheduler import Scheduler
 from kubernetes_tpu.testing import make_node, make_pod
 
@@ -257,6 +271,7 @@ class HollowCluster:
         node_grace_s: float = 40.0,
         eviction_wait_s: float = 30.0,
         zone_eviction_rate: int = 1000,
+        admission: bool = False,
     ) -> None:
         self.rng = random.Random(seed)
         self.clock = SimClock()
@@ -283,6 +298,31 @@ class HollowCluster:
         self.node_grace_s = node_grace_s
         self.eviction_wait_s = eviction_wait_s
         self.zone_eviction_rate = zone_eviction_rate
+        # service dataplane (kube-proxy analog, kubernetes_tpu/proxy.py):
+        # Service/Endpoints truth + per-node hollow proxies
+        self.services: Dict[str, object] = {}
+        self.endpoints: Dict[str, object] = {}
+        self.proxies: Dict[str, object] = {}
+        self.ip_alloc = ClusterIPAllocator()
+        self.endpoints_controller = EndpointsController(self)
+        # apiserver admission chain (kubernetes_tpu/admission.py) —
+        # opt-in like --enable-admission-plugins; when off, creates land
+        # unexamined (the legacy hub behavior most sims exercise)
+        self.namespaces: Dict[str, Namespace] = {
+            "default": Namespace("default", NS_ACTIVE),
+            "kube-system": Namespace("kube-system", NS_ACTIVE),
+        }
+        self.priority_classes: Dict[str, object] = {}
+        self.quotas: List = []
+        self.admission = (
+            default_chain(self.namespaces, self.priority_classes, self.quotas)
+            if admission else None
+        )
+        self.quota_controller = QuotaController(self)
+        #: cloud node controller (kubernetes_tpu/cloud.py) — None until
+        #: attach_cloud(); once attached, EVERY node is cloud-managed
+        #: (instance gone at the provider ⇒ node object removed)
+        self.cloud_controller = None
         self.binder = FlakyBinder(self, bind_fail_rate, self.rng)
         kw = dict(scheduler_kw or {})
         kw.setdefault("pdb_lister", lambda: list(self.pdbs))
@@ -395,6 +435,7 @@ class HollowCluster:
     def add_node(self, node: Node) -> None:
         self.truth_nodes[node.name] = node
         self.kubelets[node.name] = HollowKubelet(self, node.name)
+        self.proxies[node.name] = ServiceProxy(node.name, self.clock)
         self.heartbeats[node.name] = self.clock.t
         self._commit(f"nodes/{node.name}", "ADDED", node)
         self._emit(f"nodes/{node.name}", lambda: self.sched.on_node_add(node))
@@ -406,6 +447,7 @@ class HollowCluster:
             return
         self.heartbeats.pop(name, None)
         self.kubelets.pop(name, None)
+        self.proxies.pop(name, None)
         self._taint_time.pop(name, None)
         self.dead_kubelets.discard(name)
         self._commit(f"nodes/{name}", "DELETED", None)
@@ -415,6 +457,8 @@ class HollowCluster:
         self._emit(f"nodes/{name}", lambda: self.sched.on_node_delete(name))
 
     def create_pod(self, pod: Pod) -> None:
+        if self.admission is not None:
+            pod = self.admission.run(pod)  # raises AdmissionError on 403
         if not pod.uid:
             # the apiserver assigns metadata.uid at create; an empty uid
             # would break the Binding CAS's recreated-pod check for any
@@ -480,6 +524,77 @@ class HollowCluster:
         for name, kl in list(self.kubelets.items()):
             kl.admit(by_node.get(name, []))
 
+    def attach_cloud(self, cloud) -> None:
+        """Run the cluster under an external cloud provider: the cloud
+        node controller initializes uninitialized-tainted nodes and
+        removes nodes whose instance died (kubernetes_tpu/cloud.py)."""
+        self.cloud_controller = CloudNodeController(self, cloud)
+
+    # -- namespaces / priority classes / quotas (admission seam) -------------
+
+    def add_namespace(self, name: str) -> None:
+        self.namespaces[name] = Namespace(name, NS_ACTIVE)
+
+    def terminate_namespace(self, name: str) -> None:
+        """Mark Terminating; the namespace-controller pass in step() then
+        drains its content and removes it (pkg/controller/namespace)."""
+        ns = self.namespaces.get(name)
+        if ns is not None:
+            ns.phase = NS_TERMINATING
+
+    def add_priority_class(self, cls) -> None:
+        self.priority_classes[cls.name] = cls
+
+    def add_quota(self, quota) -> None:
+        self.quotas.append(quota)
+        self.quota_controller.reconcile()
+
+    def reconcile_namespaces(self) -> None:
+        for name, ns in list(self.namespaces.items()):
+            if ns.phase != NS_TERMINATING:
+                continue
+            remaining = [k for k, p in self.truth_pods.items()
+                         if p.namespace == name]
+            for key in remaining:
+                self.delete_pod(key)
+            if not remaining:
+                del self.namespaces[name]
+
+    # -- services / endpoints (kube-proxy seam) ------------------------------
+
+    def add_service(self, svc) -> None:
+        """Create a Service; the hub assigns the ClusterIP like the
+        apiserver's service-ip allocator (pkg/registry/core/service)."""
+        if not svc.cluster_ip:
+            svc.cluster_ip = self.ip_alloc.allocate()
+        else:
+            self.ip_alloc.reserve(svc.cluster_ip)
+        self.services[svc.key()] = svc
+        self._commit(f"services/{svc.key()}", "ADDED", svc)
+
+    def delete_service(self, key: str) -> None:
+        svc = self.services.pop(key, None)
+        if svc is not None:
+            if svc.cluster_ip:
+                self.ip_alloc.release(svc.cluster_ip)
+            self._commit(f"services/{key}", "DELETED", None)
+
+    def put_endpoints(self, ep) -> None:
+        verb = "MODIFIED" if ep.key() in self.endpoints else "ADDED"
+        self.endpoints[ep.key()] = ep
+        self._commit(f"endpoints/{ep.key()}", verb, ep)
+
+    def delete_endpoints(self, key: str) -> None:
+        if self.endpoints.pop(key, None) is not None:
+            self._commit(f"endpoints/{key}", "DELETED", None)
+
+    def sync_proxies(self) -> None:
+        """Every node's proxy recompiles its rule table from the current
+        (services, endpoints) snapshot — the per-node syncProxyRules pass
+        kubemark's hollow-proxy runs against fake iptables."""
+        for pr in self.proxies.values():
+            pr.sync(self.services, self.endpoints)
+
     # -- controllers / churn ------------------------------------------------
 
     def add_replicaset(self, rs: ReplicaSet) -> None:
@@ -530,7 +645,12 @@ class HollowCluster:
             pod = make_pod(f"{prefix}-{idx}", cpu_milli=cpu, memory=mem,
                            priority=pri, labels=labels)
             pod.uid = f"{prefix}-{idx}#{idx}"
-            self.create_pod(pod)
+            try:
+                self.create_pod(pod)
+            except AdmissionError:
+                # a real controller gets the 403 and retries next sync
+                # (quota may free up as pods finish)
+                return None
             return pod
 
         # jobs: finish pods that ran their duration; keep parallelism fed
@@ -550,12 +670,16 @@ class HollowCluster:
                 j.next_idx += 1
                 pod = spawn(j.name, j.next_idx, {"job": j.name},
                             j.cpu_milli, j.memory)
+                if pod is None:
+                    break
                 j.active[pod.key()] = pod
         for rs in self.replicasets.values():
             while len(rs.live) < rs.replicas:
                 rs.next_idx += 1
                 pod = spawn(rs.name, rs.next_idx, {"rs": rs.name},
                             rs.cpu_milli, rs.memory, rs.priority)
+                if pod is None:
+                    break
                 rs.live[pod.key()] = pod
 
     def churn(self, kill_pods: int = 0, flap_nodes: int = 0) -> None:
@@ -653,11 +777,24 @@ class HollowCluster:
             t0 = self._taint_time.get(nd.name)
             if t0 is None or now - t0 <= self.eviction_wait_s:
                 continue
-            if any(
-                tol.tolerates(Taint(self.TAINT_UNREACHABLE, effect=EFFECT_NO_EXECUTE))
-                for tol in p.tolerations
-            ):
-                continue
+            # NoExecute taint-manager semantics (taint_manager.go):
+            # tolerating without tolerationSeconds = never evicted;
+            # with tolerationSeconds = evicted once the window passes
+            # (DefaultTolerationSeconds admission stamps 300 s on pods
+            # that declare nothing)
+            tols = [
+                tol for tol in p.tolerations
+                if tol.tolerates(Taint(self.TAINT_UNREACHABLE,
+                                       effect=EFFECT_NO_EXECUTE))
+            ]
+            if tols:
+                secs = [t.toleration_seconds for t in tols]
+                if any(s is None for s in secs):
+                    continue
+                # getMinTolerationTime (taint_manager.go): the SHORTEST
+                # matching window bounds how long the pod may stay
+                if now - t0 <= min(secs):
+                    continue
             zone = nd.zone() or ""
             if evicted_in_zone.get(zone, 0) >= self.zone_eviction_rate:
                 continue
@@ -715,7 +852,15 @@ class HollowCluster:
             kl.sync()
         self.monitor_node_health()
         self.reconcile_pdbs()
+        if self.cloud_controller is not None:
+            self.cloud_controller.reconcile()
+        if self.admission is not None:
+            self.reconcile_namespaces()
+            self.quota_controller.reconcile()
         self.reconcile_controllers()
+        if self.services or self.endpoints:
+            self.endpoints_controller.reconcile()
+            self.sync_proxies()
         # the competing writer races AFTER new pods exist but BEFORE the
         # scheduler's cycle — the window where the scheduler's view goes
         # stale and its binds must CAS-fail
@@ -754,6 +899,21 @@ class HollowCluster:
             assert cpu <= nd.allocatable.cpu_milli + 1e-6, f"{name} cpu overcommit"
             assert mem <= nd.allocatable.memory + 1e-6, f"{name} mem overcommit"
             assert len(pods) <= nd.allocatable.pods, f"{name} pod-count overcommit"
+        # service dataplane: endpoints/proxies agree with (services, pods)
+        if self.services:
+            self.endpoints_controller.reconcile()
+            self.sync_proxies()
+            for key, svc in self.services.items():
+                ep = self.endpoints.get(key)
+                assert ep is not None, f"service {key} has no Endpoints"
+                want = sorted(
+                    p.key() for p in self.truth_pods.values()
+                    if svc.selects(p) and p.node_name and not p.deletion_timestamp
+                )
+                got = sorted(a.pod_key for a in ep.ready)
+                assert got == want, f"{key} endpoints drift: {got} != {want}"
+                for a in ep.ready:
+                    assert self.truth_pods[a.pod_key].node_name == a.node_name
 
     def pending_count(self) -> int:
         return sum(1 for p in self.truth_pods.values() if not p.node_name)

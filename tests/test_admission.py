@@ -1,0 +1,231 @@
+"""Admission-chain tests (kubernetes_tpu/admission.py; reference
+staging apiserver admission interfaces + plugin/pkg/admission/{priority,
+defaulttolerationseconds,resourcequota,namespace/lifecycle})."""
+
+import pytest
+
+from kubernetes_tpu.admission import (
+    DEFAULT_TOLERATION_SECONDS,
+    AdmissionError,
+    PriorityClass,
+    ResourceQuota,
+)
+from kubernetes_tpu.api.types import EFFECT_NO_EXECUTE, Toleration
+from kubernetes_tpu.sim import HollowCluster, ReplicaSet
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _hub(**kw):
+    hub = HollowCluster(seed=3, admission=True, **kw)
+    for i in range(3):
+        hub.add_node(make_node(f"n{i}", cpu_milli=4000))
+    return hub
+
+
+# -- PriorityAdmission -------------------------------------------------------
+
+
+def test_priority_class_resolves_to_integer():
+    hub = _hub()
+    hub.add_priority_class(PriorityClass("high", 1000))
+    p = make_pod("a")
+    p.priority_class_name = "high"
+    hub.create_pod(p)
+    got = hub.truth_pods["default/a"]
+    assert got.priority == 1000
+
+
+def test_unknown_priority_class_rejected():
+    hub = _hub()
+    p = make_pod("a")
+    p.priority_class_name = "nope"
+    with pytest.raises(AdmissionError, match="no PriorityClass"):
+        hub.create_pod(p)
+    assert "default/a" not in hub.truth_pods
+    assert hub.admission.rejected == 1
+
+
+def test_global_default_class_applies_to_unnamed_pods():
+    hub = _hub()
+    hub.add_priority_class(PriorityClass("standard", 7, global_default=True))
+    hub.create_pod(make_pod("a"))
+    got = hub.truth_pods["default/a"]
+    assert got.priority == 7 and got.priority_class_name == "standard"
+
+
+def test_system_critical_builtin():
+    hub = _hub()
+    p = make_pod("a", namespace="kube-system")
+    p.priority_class_name = "system-cluster-critical"
+    hub.create_pod(p)
+    assert hub.truth_pods["kube-system/a"].priority == 2_000_000_000
+
+
+def test_never_preempting_class_sets_policy():
+    hub = _hub()
+    hub.add_priority_class(
+        PriorityClass("polite", 500, preemption_policy="Never"))
+    p = make_pod("a")
+    p.priority_class_name = "polite"
+    hub.create_pod(p)
+    got = hub.truth_pods["default/a"]
+    assert got.priority == 500 and got.preemption_policy == "Never"
+
+
+# -- DefaultTolerationSeconds ------------------------------------------------
+
+
+def test_default_tolerations_appended():
+    hub = _hub()
+    hub.create_pod(make_pod("a"))
+    got = hub.truth_pods["default/a"]
+    keys = {t.key: t for t in got.tolerations}
+    for key in ("node.kubernetes.io/not-ready",
+                "node.kubernetes.io/unreachable"):
+        assert keys[key].toleration_seconds == DEFAULT_TOLERATION_SECONDS
+        assert keys[key].effect == EFFECT_NO_EXECUTE
+
+
+def test_declared_toleration_not_overridden():
+    hub = _hub()
+    p = make_pod("a")
+    p.tolerations = (Toleration(key="node.kubernetes.io/unreachable",
+                                operator="Exists",
+                                effect=EFFECT_NO_EXECUTE,
+                                toleration_seconds=5),)
+    hub.create_pod(p)
+    got = hub.truth_pods["default/a"]
+    mine = [t for t in got.tolerations
+            if t.key == "node.kubernetes.io/unreachable"]
+    assert len(mine) == 1 and mine[0].toleration_seconds == 5
+
+
+def test_toleration_seconds_honored_by_noexecute_eviction():
+    """A pod whose unreachable toleration expires IS evicted; one
+    tolerating forever is NOT (taint_manager.go semantics)."""
+    hub = _hub(node_grace_s=40.0, eviction_wait_s=30.0)
+    expiring = make_pod("expiring")
+    expiring.tolerations = (
+        Toleration(key="node.kubernetes.io/unreachable", operator="Exists",
+                   effect=EFFECT_NO_EXECUTE, toleration_seconds=60),)
+    forever = make_pod("forever")
+    forever.tolerations = (
+        Toleration(key="node.kubernetes.io/unreachable", operator="Exists",
+                   effect=EFFECT_NO_EXECUTE),)  # None = tolerate forever
+    hub.create_pod(expiring)
+    hub.create_pod(forever)
+    for _ in range(3):
+        hub.step()
+    assert hub.truth_pods["default/expiring"].node_name
+    node = hub.truth_pods["default/expiring"].node_name
+    # strand BOTH pods' nodes
+    for name in {hub.truth_pods[k].node_name
+                 for k in ("default/expiring", "default/forever")}:
+        hub.kill_kubelet(name)
+    for _ in range(12):  # 12 * 15s: grace(40) + window(60) well passed
+        hub.step()
+    hub.settle()
+    assert "default/expiring" not in hub.truth_pods
+    assert "default/forever" in hub.truth_pods
+
+
+# -- ResourceQuota -----------------------------------------------------------
+
+
+def test_quota_rejects_over_limit_creates():
+    hub = _hub()
+    hub.add_quota(ResourceQuota("q", hard_pods=2))
+    hub.create_pod(make_pod("a"))
+    hub.create_pod(make_pod("b"))
+    with pytest.raises(AdmissionError, match="exceeded quota"):
+        hub.create_pod(make_pod("c"))
+    assert len(hub.truth_pods) == 2
+
+
+def test_quota_cpu_dimension():
+    hub = _hub()
+    hub.add_quota(ResourceQuota("q", hard_cpu_milli=250))
+    hub.create_pod(make_pod("a", cpu_milli=200))
+    with pytest.raises(AdmissionError, match="requests.cpu"):
+        hub.create_pod(make_pod("b", cpu_milli=100))
+
+
+def test_quota_released_on_delete_via_controller():
+    hub = _hub()
+    hub.add_quota(ResourceQuota("q", hard_pods=1))
+    hub.create_pod(make_pod("a"))
+    with pytest.raises(AdmissionError):
+        hub.create_pod(make_pod("b"))
+    hub.delete_pod("default/a")
+    hub.step()  # quota controller recalculates used from truth
+    hub.create_pod(make_pod("b"))
+    assert "default/b" in hub.truth_pods
+
+
+def test_quota_scoped_to_namespace():
+    hub = _hub()
+    hub.add_namespace("other")
+    hub.add_quota(ResourceQuota("q", namespace="other", hard_pods=0))
+    hub.create_pod(make_pod("a"))  # default ns unaffected
+    with pytest.raises(AdmissionError):
+        hub.create_pod(make_pod("b", namespace="other"))
+
+
+def test_replicaset_controller_survives_quota_403():
+    """Controllers get the 403 and keep reconciling; scale resumes once
+    quota frees (the resourcequota replenishment loop)."""
+    hub = _hub()
+    hub.add_quota(ResourceQuota("q", hard_pods=2))
+    hub.add_replicaset(ReplicaSet("web", 4))
+    for _ in range(3):
+        hub.step()
+    assert sum(1 for k in hub.truth_pods if k.startswith("default/web-")) == 2
+    hub.quotas[0].hard_pods = 4
+    for _ in range(3):
+        hub.step()
+    hub.check_consistency()
+    assert sum(1 for k in hub.truth_pods if k.startswith("default/web-")) == 4
+
+
+# -- NamespaceLifecycle ------------------------------------------------------
+
+
+def test_terminating_namespace_rejects_creates_and_drains():
+    hub = _hub()
+    hub.add_namespace("doomed")
+    hub.create_pod(make_pod("a", namespace="doomed"))
+    for _ in range(2):
+        hub.step()
+    hub.terminate_namespace("doomed")
+    with pytest.raises(AdmissionError, match="being terminated"):
+        hub.create_pod(make_pod("b", namespace="doomed"))
+    for _ in range(2):
+        hub.step()
+    hub.settle()
+    assert "doomed/a" not in hub.truth_pods
+    assert "doomed" not in hub.namespaces  # controller removed it when empty
+    hub.check_consistency()
+
+
+def test_min_toleration_window_bounds_eviction():
+    """Two matching tolerations (10 s and 600 s): the SHORTEST window
+    governs (taint_manager.go getMinTolerationTime; review r3 finding)."""
+    hub = _hub(node_grace_s=40.0, eviction_wait_s=30.0)
+    p = make_pod("two-windows")
+    p.tolerations = (
+        Toleration(key="node.kubernetes.io/unreachable", operator="Exists",
+                   effect=EFFECT_NO_EXECUTE, toleration_seconds=10),
+        Toleration(key="node.kubernetes.io/unreachable", operator="Exists",
+                   effect=EFFECT_NO_EXECUTE, toleration_seconds=600),
+    )
+    hub.create_pod(p)
+    for _ in range(3):
+        hub.step()
+    node = hub.truth_pods["default/two-windows"].node_name
+    assert node
+    hub.kill_kubelet(node)
+    # grace(40) + wait(30) + min-window(10) < 8*15s; max-window would be 600
+    for _ in range(8):
+        hub.step()
+    hub.settle()
+    assert "default/two-windows" not in hub.truth_pods

@@ -1,0 +1,79 @@
+"""kubeadm-analog bootstrap tests (kubernetes_tpu/bootstrap.py;
+reference cmd/kubeadm/app/cmd/{init,join}.go, app/preflight/checks.go,
+app/phases/{markcontrolplane,bootstraptoken})."""
+
+import pytest
+
+from kubernetes_tpu.api.types import Toleration
+from kubernetes_tpu.bootstrap import (
+    LABEL_CONTROL_PLANE,
+    TAINT_CONTROL_PLANE,
+    BootstrapError,
+    InitConfig,
+    create_token,
+    init_cluster,
+    join_node,
+    preflight,
+)
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def test_preflight_rejects_bad_config():
+    with pytest.raises(BootstrapError, match="cluster_name"):
+        preflight(InitConfig(cluster_name=""))
+    with pytest.raises(BootstrapError, match="resources"):
+        preflight(InitConfig(control_plane_cpu_milli=0))
+    with pytest.raises(BootstrapError, match="token_ttl"):
+        preflight(InitConfig(token_ttl_s=-1))
+
+
+def test_init_marks_control_plane_and_mints_token():
+    hub, token = init_cluster()
+    cp = hub.truth_nodes["control-plane"]
+    assert LABEL_CONTROL_PLANE in cp.labels
+    assert any(t.key == TAINT_CONTROL_PLANE for t in cp.taints)
+    tid, _, secret = token.partition(".")
+    assert len(tid) == 6 and len(secret) == 16
+    # workloads don't land on the master...
+    hub.create_pod(make_pod("app"))
+    hub.step()
+    assert not hub.truth_pods["default/app"].node_name
+    # ...unless they tolerate the taint (kube-system components do)
+    sys = make_pod("sys", namespace="kube-system")
+    sys.tolerations = (Toleration(key=TAINT_CONTROL_PLANE,
+                                  operator="Exists"),)
+    hub.create_pod(sys)
+    for _ in range(3):
+        hub.step()
+    assert hub.truth_pods["kube-system/sys"].node_name == "control-plane"
+
+
+def test_join_registers_node_and_cluster_schedules():
+    hub, token = init_cluster()
+    for i in range(2):
+        join_node(hub, token, make_node(f"worker-{i}", cpu_milli=4000))
+    hub.create_pod(make_pod("app"))
+    for _ in range(3):
+        hub.step()
+    hub.check_consistency()
+    assert hub.truth_pods["default/app"].node_name.startswith("worker-")
+
+
+def test_join_rejects_bad_and_expired_tokens():
+    hub, token = init_cluster(InitConfig(token_ttl_s=60.0))
+    with pytest.raises(BootstrapError, match="unknown or malformed"):
+        join_node(hub, "zzzzzz.0000000000000000", make_node("w0"))
+    hub.clock.advance(61.0)
+    with pytest.raises(BootstrapError, match="expired"):
+        join_node(hub, token, make_node("w0"))
+    # a fresh token heals the flow (kubeadm token create)
+    token2 = create_token(hub)
+    join_node(hub, token2, make_node("w0"))
+    assert "w0" in hub.truth_nodes
+
+
+def test_join_rejects_duplicate_node():
+    hub, token = init_cluster()
+    join_node(hub, token, make_node("w0"))
+    with pytest.raises(BootstrapError, match="already registered"):
+        join_node(hub, token, make_node("w0"))

@@ -1,0 +1,100 @@
+"""Cloud-provider seam tests (kubernetes_tpu/cloud.py; reference
+staging/src/k8s.io/cloud-provider: cloud.go Interface,
+controllers/node/node_controller.go syncNode,
+node_lifecycle_controller.go MonitorNodes)."""
+
+from kubernetes_tpu.cloud import (
+    LABEL_ZONE,
+    TAINT_UNINITIALIZED,
+    FakeCloud,
+    Instance,
+    uninitialized_node,
+)
+from kubernetes_tpu.sim import HollowCluster
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _hub_with_cloud(zones=("a", "b")):
+    hub = HollowCluster(seed=5)
+    cloud = FakeCloud()
+    hub.attach_cloud(cloud)
+    for i, z in enumerate(zones):
+        cloud.add_instance(Instance(f"n{i}", zone=z, region="r1",
+                                    instance_type="v5e-8"))
+        nd = uninitialized_node(f"n{i}", allocatable=make_node("x").allocatable)
+        hub.add_node(nd)
+    return hub, cloud
+
+
+def test_uninitialized_taint_blocks_scheduling():
+    hub, cloud = _hub_with_cloud()
+    # keep nodes uninitialized: detach the controller for this test
+    hub.cloud_controller = None
+    hub.create_pod(make_pod("a"))
+    hub.step()
+    assert not hub.truth_pods["default/a"].node_name  # taint repels
+
+
+def test_controller_initializes_nodes_then_pods_schedule():
+    hub, cloud = _hub_with_cloud()
+    hub.create_pod(make_pod("a"))
+    for _ in range(3):
+        hub.step()
+    hub.check_consistency()
+    nd = hub.truth_nodes["n0"]
+    assert all(t.key != TAINT_UNINITIALIZED for t in nd.taints)
+    assert nd.labels[LABEL_ZONE] == "a"
+    assert nd.zone() == "a"  # topology kernels key on this
+    assert hub.truth_pods["default/a"].node_name
+
+
+def test_zone_labels_feed_topology_spread():
+    """Cloud-stamped zones are the failure domains even_spread uses."""
+    hub, cloud = _hub_with_cloud(zones=("a", "a", "b", "b"))
+    for _ in range(2):
+        hub.step()
+    zones = {hub.truth_nodes[f"n{i}"].zone() for i in range(4)}
+    assert zones == {"a", "b"}
+
+
+def test_instance_termination_removes_node_and_reschedules():
+    hub, cloud = _hub_with_cloud()
+    hub.create_pod(make_pod("a"))
+    for _ in range(3):
+        hub.step()
+    node = hub.truth_pods["default/a"].node_name
+    cloud.terminate(node)
+    for _ in range(3):
+        hub.step()
+    hub.settle()
+    assert node not in hub.truth_nodes
+    assert hub.cloud_controller.deleted == 1
+
+
+def test_unknown_instance_left_tainted_until_cloud_catches_up():
+    hub = HollowCluster(seed=5)
+    cloud = FakeCloud()
+    hub.attach_cloud(cloud)
+    hub.add_node(uninitialized_node("late"))
+    hub.step()
+    assert any(t.key == TAINT_UNINITIALIZED
+               for t in hub.truth_nodes["late"].taints)
+    cloud.add_instance(Instance("late", zone="z"))
+    hub.step()
+    assert all(t.key != TAINT_UNINITIALIZED
+               for t in hub.truth_nodes["late"].taints)
+    hub.check_consistency()
+
+
+def test_vm_terminated_while_uninitialized_is_removed_not_untainted():
+    """A dead instance must never be initialized into schedulability
+    (review r3 finding: exists=False in the tainted branch)."""
+    hub = HollowCluster(seed=5)
+    cloud = FakeCloud()
+    hub.attach_cloud(cloud)
+    cloud.add_instance(Instance("doomed", zone="z"))
+    hub.add_node(uninitialized_node("doomed"))
+    cloud.terminate("doomed")
+    hub.step()
+    assert "doomed" not in hub.truth_nodes
+    assert hub.cloud_controller.deleted == 1

@@ -1,0 +1,206 @@
+"""Service VIP dataplane tests — the kube-proxy analog
+(kubernetes_tpu/proxy.py; reference pkg/proxy/iptables/proxier.go:283
+syncProxyRules, pkg/controller/endpoint/endpoints_controller.go)."""
+
+import collections
+
+from kubernetes_tpu.api.types import Resources
+from kubernetes_tpu.proxy import (
+    AFFINITY_CLIENT_IP,
+    ClusterIPAllocator,
+    EndpointAddress,
+    Endpoints,
+    Service,
+    ServicePort,
+    ServiceProxy,
+)
+from kubernetes_tpu.sim import HollowCluster, ReplicaSet
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _cluster(n_nodes=4, cpu=4000.0):
+    hub = HollowCluster(seed=7)
+    for i in range(n_nodes):
+        hub.add_node(make_node(f"n{i}", cpu_milli=cpu))
+    return hub
+
+
+def _web_service(**kw):
+    return Service("web", selector={"app": "web"},
+                   ports=(ServicePort("http", 80, 8080),), **kw)
+
+
+def test_endpoints_track_bound_matching_pods():
+    hub = _cluster()
+    hub.add_service(_web_service())
+    hub.add_replicaset(ReplicaSet("other", 2))  # labels rs=other: no match
+    for i in range(3):
+        p = make_pod(f"web-{i}", labels={"app": "web"})
+        hub.create_pod(p)
+    for _ in range(4):
+        hub.step()
+    hub.check_consistency()
+    ep = hub.endpoints["default/web"]
+    assert sorted(a.pod_key for a in ep.ready) == [
+        "default/web-0", "default/web-1", "default/web-2"
+    ]
+    # each address carries the real binding node
+    for a in ep.ready:
+        assert hub.truth_pods[a.pod_key].node_name == a.node_name
+    # pending pods (none left) / non-matching pods are excluded
+    assert all("other" not in a.pod_key for a in ep.ready)
+
+
+def test_endpoints_move_on_pod_delete_and_reschedule():
+    hub = _cluster(n_nodes=2)
+    hub.add_service(_web_service())
+    hub.add_replicaset(ReplicaSet("web", 2))
+    # label the RS pods into the service: ReplicaSet spawns with rs=web
+    hub.services["default/web"].selector = {"rs": "web"}
+    for _ in range(4):
+        hub.step()
+    ep = hub.endpoints["default/web"]
+    assert len(ep.ready) == 2
+    victim = ep.ready[0].pod_key
+    hub.delete_pod(victim)
+    hub.step()  # controller recreates; scheduler rebinds; endpoints follow
+    hub.step()
+    hub.check_consistency()
+    ep2 = hub.endpoints["default/web"]
+    assert len(ep2.ready) == 2
+    assert victim not in {a.pod_key for a in ep2.ready}
+
+
+def test_service_delete_removes_endpoints_and_releases_ip():
+    hub = _cluster(n_nodes=1)
+    svc = _web_service()
+    hub.add_service(svc)
+    ip = svc.cluster_ip
+    assert ip
+    hub.step()
+    assert "default/web" in hub.endpoints
+    hub.delete_service("default/web")
+    hub.step()
+    assert "default/web" not in hub.endpoints
+    # released IP is reallocatable
+    svc2 = Service("web2", selector={"app": "w2"})
+    hub.add_service(svc2)
+    assert svc2.cluster_ip  # allocator still serving
+
+
+def test_proxy_resolves_vip_to_ready_backend():
+    hub = _cluster()
+    hub.add_service(_web_service())
+    for i in range(3):
+        hub.create_pod(make_pod(f"web-{i}", labels={"app": "web"}))
+    for _ in range(3):
+        hub.step()
+    svc = hub.services["default/web"]
+    seen = set()
+    for node, proxy in hub.proxies.items():
+        b = proxy.resolve(svc.cluster_ip, 80, client="10.0.0.9")
+        assert b is not None and b.pod_key.startswith("default/web-")
+        seen.add(b.pod_key)
+    # unknown VIP/port rejects (None)
+    assert hub.proxies["n0"].resolve(svc.cluster_ip, 81) is None
+    assert hub.proxies["n0"].resolve("10.96.9.9", 80) is None
+
+
+def test_proxy_distribution_roughly_uniform():
+    """The statistic-random chain spreads distinct clients across
+    backends (proxier.go's --probability 1/n cascade)."""
+    proxy = ServiceProxy("n0")
+    backends = tuple(EndpointAddress(f"default/web-{i}", f"n{i}")
+                     for i in range(4))
+    svc = Service("web", cluster_ip="10.96.0.1",
+                  ports=(ServicePort("http", 80, 8080),))
+    ep = Endpoints("web", ready=backends)
+    proxy.sync({svc.key(): svc}, {ep.key(): ep})
+    counts = collections.Counter(
+        proxy.resolve("10.96.0.1", 80, client=f"10.1.0.{i}").pod_key
+        for i in range(400)
+    )
+    assert set(counts) == {b.pod_key for b in backends}
+    assert min(counts.values()) > 400 / 4 * 0.5  # no starved backend
+
+
+def test_client_ip_session_affinity_sticks_and_expires():
+    class FakeClock:
+        t = 0.0
+
+    clock = FakeClock()
+    proxy = ServiceProxy("n0", clock)
+    backends = tuple(EndpointAddress(f"default/web-{i}", "n0")
+                     for i in range(8))
+    svc = Service("web", cluster_ip="10.96.0.1",
+                  ports=(ServicePort("http", 80, 8080),),
+                  session_affinity=AFFINITY_CLIENT_IP, affinity_seconds=60)
+    ep = Endpoints("web", ready=backends)
+    proxy.sync({svc.key(): svc}, {ep.key(): ep})
+    first = proxy.resolve("10.96.0.1", 80, client="1.2.3.4")
+    for _ in range(10):  # sticky while inside the window
+        clock.t += 5
+        assert proxy.resolve("10.96.0.1", 80, client="1.2.3.4") == first
+    clock.t += 61  # window expired since last hit -> re-pick allowed
+    again = proxy.resolve("10.96.0.1", 80, client="1.2.3.4")
+    assert again in backends
+    # sticky backend drained -> re-pick among the survivors
+    ep2 = Endpoints("web", ready=tuple(b for b in backends if b != first))
+    proxy.sync({svc.key(): svc}, {ep2.key(): ep2})
+    assert proxy.resolve("10.96.0.1", 80, client="1.2.3.4") != first
+
+
+def test_node_port_routing():
+    proxy = ServiceProxy("n0")
+    svc = Service("web", cluster_ip="10.96.0.1",
+                  ports=(ServicePort("http", 80, 8080, node_port=30080),))
+    ep = Endpoints("web", ready=(EndpointAddress("default/web-0", "n1"),))
+    proxy.sync({svc.key(): svc}, {ep.key(): ep})
+    assert proxy.resolve_node_port(30080).pod_key == "default/web-0"
+    assert proxy.resolve_node_port(30081) is None
+
+
+def test_no_ready_endpoints_rejects():
+    hub = _cluster(n_nodes=1)
+    hub.add_service(_web_service())
+    hub.step()
+    svc = hub.services["default/web"]
+    assert hub.proxies["n0"].resolve(svc.cluster_ip, 80) is None
+
+
+def test_cluster_ip_allocator_unique_and_reusable():
+    al = ClusterIPAllocator()
+    ips = {al.allocate() for _ in range(300)}
+    assert len(ips) == 300
+    al.release("10.96.0.5")
+    assert "10.96.0.5" in {al.allocate() for _ in range(300)}
+
+
+def test_preset_cluster_ip_reserved_in_allocator():
+    """An explicit spec.clusterIP must be reserved so the allocator never
+    hands the same VIP to a second service (review r3 finding)."""
+    hub = _cluster(n_nodes=1)
+    hub.add_service(Service("pinned", selector={"x": "y"},
+                            cluster_ip="10.96.0.1"))
+    hub.add_service(Service("auto", selector={"a": "b"}))
+    assert hub.services["default/auto"].cluster_ip != "10.96.0.1"
+
+
+def test_selectorless_service_keeps_manual_endpoints():
+    """Selector-less services carry manually-managed Endpoints (the
+    external-backend pattern); the controller must neither overwrite nor
+    GC them (endpoints_controller.go nil-selector early return)."""
+    hub = _cluster(n_nodes=1)
+    hub.add_service(Service("ext", selector={}))
+    hub.put_endpoints(Endpoints(
+        "ext", ready=(EndpointAddress("external/backend", ""),)))
+    for _ in range(2):
+        hub.step()
+    ep = hub.endpoints["default/ext"]
+    assert [a.pod_key for a in ep.ready] == ["external/backend"]
+    svc = hub.services["default/ext"]
+    assert hub.proxies["n0"].resolve(svc.cluster_ip, 0) is None  # no port 0
+    # service delete DOES GC the manual endpoints
+    hub.delete_service("default/ext")
+    hub.step()
+    assert "default/ext" not in hub.endpoints

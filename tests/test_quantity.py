@@ -1,0 +1,83 @@
+"""resource.Quantity parsing tests (kubernetes_tpu/api/quantity.py;
+reference apimachinery/pkg/api/resource/quantity_test.go table style)."""
+
+import pytest
+
+from kubernetes_tpu.api.quantity import (
+    format_cpu,
+    format_memory,
+    parse_cpu,
+    parse_memory,
+    parse_quantity,
+)
+
+
+@pytest.mark.parametrize("s,want", [
+    ("0", 0.0),
+    ("1", 1.0),
+    ("100m", 0.1),
+    ("1.5", 1.5),
+    (".5", 0.5),
+    ("1Ki", 1024.0),
+    ("1Mi", 2**20),
+    ("1Gi", 2**30),
+    ("8Ti", 8 * 2**40),
+    ("1Pi", 2**50),
+    ("1Ei", 2**60),
+    ("1k", 1000.0),
+    ("1M", 1e6),
+    ("500G", 5e11),
+    ("1T", 1e12),
+    ("100n", 1e-7),
+    ("50u", 5e-5),
+    ("1e3", 1000.0),
+    ("1E3", 1000.0),
+    ("1.5e2", 150.0),
+    ("1e-3", 0.001),
+    ("-1Gi", -float(2**30)),
+    ("+2", 2.0),
+    (5, 5.0),
+    (2.5, 2.5),
+])
+def test_parse_quantity_table(s, want):
+    assert parse_quantity(s) == pytest.approx(want)
+
+
+@pytest.mark.parametrize("bad", ["", "abc", "1GiB", "Gi", "1.2.3", "1 Gi",
+                                 "0x1", "--1", "1ee3", "mi"])
+def test_parse_quantity_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_quantity(bad)
+
+
+def test_cpu_and_memory_units():
+    assert parse_cpu("250m") == 250.0
+    assert parse_cpu("2") == 2000.0
+    assert parse_cpu(1.5) == 1500.0
+    assert parse_memory("1Gi") == 2**30
+    assert parse_memory("512Mi") == 512 * 2**20
+
+
+def test_format_round_trips():
+    assert format_cpu(250) == "250m"
+    assert format_cpu(2000) == "2"
+    assert parse_cpu(format_cpu(1337)) == 1337
+    assert format_memory(2**30) == "1Gi"
+    assert format_memory(3 * 2**20) == "3Mi"
+    assert parse_memory(format_memory(768 * 2**20)) == 768 * 2**20
+
+
+def test_wire_seam_uses_full_grammar():
+    """server.pod_from_json now accepts the full suffix set (old minimal
+    parser choked on Pi/exponent forms)."""
+    from kubernetes_tpu.server import pod_from_json
+
+    pod = pod_from_json({
+        "metadata": {"name": "p", "namespace": "default"},
+        "spec": {"containers": [
+            {"resources": {"requests": {"cpu": "1.5", "memory": "1e9"}}},
+            {"resources": {"requests": {"cpu": "250m", "memory": "1Gi"}}},
+        ]},
+    })
+    assert pod.requests.cpu_milli == pytest.approx(1750.0)
+    assert pod.requests.memory == pytest.approx(1e9 + 2**30)
