@@ -49,10 +49,60 @@ Also recorded in "extras" (BASELINE.md promises; VERDICT r2 #3/#4/#5):
 import json
 import os
 import re
+import signal
 import sys
 import time
+from contextlib import contextmanager
 
 BASELINE_PODS_PER_SEC = 100.0
+
+
+class SectionTimeout(Exception):
+    """A bench section exceeded its deadline (usually the shared TPU
+    tunnel's remote-compile helper wedging mid-compile — the poll loop
+    then sleeps forever; observed live in round 3 on a variant-grid
+    compile after every earlier section succeeded)."""
+
+
+class BenchTerminated(BaseException):
+    """SIGTERM from the driver. BaseException on purpose: it must fly past
+    every per-section ``except Exception`` so the only handler is the
+    top-level one that emits the partial JSON record and exits."""
+
+
+@contextmanager
+def deadline(seconds: float):
+    """SIGALRM watchdog for one section: a wedged device compile raises
+    SectionTimeout into the section's except-clause instead of hanging
+    the whole bench past the driver's kill (which emits NOTHING — the
+    round-1/2 artifact failure). Main-thread only (bench is).
+    ``seconds <= 0`` disables the watchdog (BENCH_DEADLINE_SCALE=0).
+
+    Caveat: CPython delivers signals only between bytecodes, so an alarm
+    cannot interrupt a single blocking native call that never returns to
+    the interpreter; the ``arm_emergency_emitter`` thread is the backstop
+    for that class (XLA calls release the GIL, so the thread still runs)."""
+    if seconds <= 0:
+        yield
+        return
+
+    state = {"done": False}
+
+    def onalarm(signum, frame):
+        # the alarm can fire in the gap between the with-body's last
+        # statement and the finally below; a completed section must not be
+        # poisoned by a tail-race timeout
+        if not state["done"]:
+            raise SectionTimeout(f"section exceeded {seconds:.0f}s deadline")
+
+    old = signal.signal(signal.SIGALRM, onalarm)
+    signal.alarm(max(1, int(seconds)))
+    try:
+        yield
+        state["done"] = True
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 _ANSI = re.compile(r"\x1b\[[0-9;]*[a-zA-Z]|\x1b\].*?(\x07|\x1b\\)")
 
@@ -76,13 +126,54 @@ RESULT = {
 }
 
 
+_EMITTED = False
+
+
 def emit(rc: int = 0) -> None:
+    # a second SIGTERM (or a straggler alarm) landing mid-print would
+    # corrupt the one line that matters — go deaf to both first
+    try:
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        signal.alarm(0)
+    except (ValueError, OSError):
+        pass  # non-main thread (emergency emitter) can't touch signals
+    global _EMITTED
+    _EMITTED = True
     # drain stderr first: if the driver merges the two streams, a partially
     # flushed stderr line interleaved into stdout corrupts the JSON record
     sys.stderr.flush()
     print(json.dumps(RESULT))
     sys.stdout.flush()
     sys.exit(rc)
+
+
+def arm_emergency_emitter(deadline_s: float) -> None:
+    """Backstop for wedges no signal can reach: if the main thread is stuck
+    inside one native call (signals are only delivered between bytecodes),
+    SIGALRM/SIGTERM handlers never run and the process would die by SIGKILL
+    emitting nothing. This daemon thread emits the partial record at the
+    global wall-clock deadline instead — XLA/tunnel calls release the GIL,
+    so the thread keeps running while the main thread is blocked."""
+    import threading
+
+    t0 = time.monotonic()
+
+    def watch():
+        while time.monotonic() - t0 < deadline_s:
+            time.sleep(5)
+            if _EMITTED:
+                return
+        if not _EMITTED:
+            RESULT["errors"].append(
+                f"emergency emit: main thread unresponsive past "
+                f"{deadline_s:.0f}s global deadline"
+            )
+            sys.stderr.flush()
+            print(json.dumps(RESULT))
+            sys.stdout.flush()
+            os._exit(0)
+
+    threading.Thread(target=watch, daemon=True, name="emergency-emit").start()
 
 
 def log(msg: str) -> None:
@@ -430,6 +521,10 @@ def run_cpu_ratio(n_nodes, n_existing, n_pending, batch, timeout_s=1200.0):
         "BENCH_EXISTING": str(n_existing),
         "BENCH_PODS": str(n_pending),
         "BENCH_BATCH": str(batch),
+        # the subprocess timeout below is the child's real guard; its own
+        # section deadlines (sized for TPU) would fire mid-headline on the
+        # much slower 1-core CPU and silently null the ratio
+        "BENCH_DEADLINE_SCALE": "0",
     })
     env.pop("XLA_FLAGS", None)  # no virtual-device splitting: one CPU "chip"
     r = subprocess.run(
@@ -448,6 +543,14 @@ def run_cpu_ratio(n_nodes, n_existing, n_pending, batch, timeout_s=1200.0):
 
 
 def main() -> None:
+    # the driver kills a stuck bench with SIGTERM, which by default dies
+    # emitting NOTHING — convert it into the BaseException path so the
+    # partial record still lands before the driver escalates to SIGKILL
+    def on_sigterm(signum, frame):
+        raise BenchTerminated("SIGTERM")
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+    dscale = float(os.environ.get("BENCH_DEADLINE_SCALE", 1.0))
     platform = init_platform()
     RESULT["extras"]["platform"] = platform
     log(f"platform={platform}")
@@ -465,6 +568,9 @@ def main() -> None:
     # r1/r2 failure mode). The headline itself is never skipped.
     t_start = time.perf_counter()
     budget_s = float(os.environ.get("BENCH_TIME_BUDGET_S", 2400))
+    # 50% slack past the soft budget for in-flight sections, then the
+    # thread-based backstop fires (native-blocked wedge; see its docstring)
+    arm_emergency_emitter(budget_s * 1.5)
 
     def over_budget(section: str) -> bool:
         spent = time.perf_counter() - t_start
@@ -487,8 +593,9 @@ def main() -> None:
 
     # ---- headline: 5k nodes x 30k pods, cap=8 ----
     try:
-        w = build_variant("base", n_nodes, n_existing, n_pending)
-        head = run_batched(w, batch, cap=8, latency=True)
+        with deadline(900 * dscale):
+            w = build_variant("base", n_nodes, n_existing, n_pending)
+            head = run_batched(w, batch, cap=8, latency=True)
         RESULT["metric"] = (
             f"pods scheduled/sec, {n_nodes}-node/{n_pending}-pod "
             "scheduler_perf-style batch workload"
@@ -516,11 +623,12 @@ def main() -> None:
             raise InterruptedError
         cn = int(os.environ.get("BENCH_CONTENDED_NODES", 1000))
         cp = int(os.environ.get("BENCH_CONTENDED_PODS", 4000 if light else 30000))
-        wc = build_variant("base", cn, 0, cp)
-        sweep = {"nodes": cn, "pods": cp}
-        for cap in (1, 4, 8):
-            sweep[str(cap)] = run_batched(wc, batch, cap=cap)
-            log(f"contended cap={cap}: {sweep[str(cap)]}")
+        with deadline(600 * dscale):
+            wc = build_variant("base", cn, 0, cp)
+            sweep = {"nodes": cn, "pods": cp}
+            for cap in (1, 4, 8):
+                sweep[str(cap)] = run_batched(wc, batch, cap=cap)
+                log(f"contended cap={cap}: {sweep[str(cap)]}")
         RESULT["extras"]["cap_sweep_contended"] = sweep
         del wc
     except InterruptedError:
@@ -541,10 +649,11 @@ def main() -> None:
         try:
             rn = int(os.environ.get("BENCH_RATIO_NODES", 1000))
             rp = int(os.environ.get("BENCH_RATIO_PODS", 4000))
-            wm = build_variant("base", rn, rn // 2, rp)
-            tpu_mini = run_batched(wm, min(rp, batch), cap=8)
-            del wm
-            cpu = run_cpu_ratio(rn, rn // 2, rp, min(rp, batch))
+            with deadline(1500 * dscale):  # child timeout is 1200
+                wm = build_variant("base", rn, rn // 2, rp)
+                tpu_mini = run_batched(wm, min(rp, batch), cap=8)
+                del wm
+                cpu = run_cpu_ratio(rn, rn // 2, rp, min(rp, batch))
             cpu_tput = cpu.get("value", 0.0)
             RESULT["extras"]["cpu_ratio"] = {
                 "nodes": rn, "pods": rp,
@@ -569,16 +678,20 @@ def main() -> None:
             raise InterruptedError
         pn = int(os.environ.get("BENCH_PARITY_NODES", 1000))
         pp = int(os.environ.get("BENCH_PARITY_PODS", 5000))
-        wp = build_variant("base", pn, pn // 5, pp)
-        seq = run_sequential(wp)
+        with deadline(600 * dscale):
+            wp = build_variant("base", pn, pn // 5, pp)
+            seq = run_sequential(wp)
         parity = {"nodes": pn, "pods": pp, "sequential": seq}
+        # recorded up front and mutated in place: a timeout on a later cap
+        # must not discard the measurements already paid for
+        RESULT["extras"]["score_parity"] = parity
         for cap in (1, 8):
-            b = run_batched(wp, pp, cap=cap)
+            with deadline(300 * dscale):
+                b = run_batched(wp, pp, cap=cap)
             b["score_vs_sequential"] = round(
                 b["score"]["mean_score"] / max(seq["score"]["mean_score"], 1e-9), 4
             )
             parity[f"batch_cap{cap}"] = b
-        RESULT["extras"]["score_parity"] = parity
         log(f"score_parity: {parity}")
         del wp
     except InterruptedError:
@@ -606,9 +719,10 @@ def main() -> None:
             c5n = int(os.environ.get("BENCH_C5_NODES", 50000))
             c5p = int(os.environ.get("BENCH_C5_PODS", 200000))
             c5b = int(os.environ.get("BENCH_C5_BATCH", 4096))
-            w5 = ShardedWorkload(build_variant("base", c5n, 0, c5p),
-                                 make_mesh())
-            r5 = run_batched(w5, c5b, cap=8, latency=True)
+            with deadline(900 * dscale):
+                w5 = ShardedWorkload(build_variant("base", c5n, 0, c5p),
+                                     make_mesh())
+                r5 = run_batched(w5, c5b, cap=8, latency=True)
             r5["nodes"] = c5n
             r5["devices"] = len(jax.devices())
             r5["batch"] = c5b
@@ -637,10 +751,13 @@ def main() -> None:
         gnodes = make_nodes(gn, zones=10)
         gpods = make_gang_pods(gg, gsz)
         gang = {"groups": gg, "group_size": gsz, "nodes": gn}
+        # recorded up front so a timeout on argmax keeps the sinkhorn run
+        RESULT["extras"][f"gang_{gg}x{gsz}"] = gang
         for sname, sk in (("sinkhorn", True), ("argmax", False)):
-            wg = Workload(gnodes, [], gpods)
-            r = run_batched(wg, min(len(gpods), batch), cap=8,
-                            use_sinkhorn=sk, return_assigned=True)
+            with deadline(450 * dscale):
+                wg = Workload(gnodes, [], gpods)
+                r = run_batched(wg, min(len(gpods), batch), cap=8,
+                                use_sinkhorn=sk, return_assigned=True)
             a = r.pop("_assigned")
             placed_by_group = (a.reshape(gg, gsz) >= 0).all(axis=1)
             r["groups_fully_placed"] = int(placed_by_group.sum())
@@ -650,7 +767,6 @@ def main() -> None:
             gang[sname] = r
             log(f"gang_{gg}x{gsz}/{sname}: {r}")
             del wg
-        RESULT["extras"][f"gang_{gg}x{gsz}"] = gang
     except InterruptedError:
         pass
     except Exception as e:
@@ -661,22 +777,41 @@ def main() -> None:
     pairs = GRID_PAIRS if os.environ.get("BENCH_GRID") == "1" else ((1000, 1000),)
     vpods = int(os.environ.get("BENCH_VARIANT_PODS", 512 if light else 2048))
     grid = {}
-    for name in VARIANTS:
-        for vn, vex in pairs:
-            if over_budget(f"variant:{name}"):
-                break
-            try:
+    wedges = 0  # consecutive per-entry deadline hits
+    worklist = [(name, vn, vex) for name in VARIANTS for vn, vex in pairs]
+    for i, (name, vn, vex) in enumerate(worklist):
+        if over_budget(f"variant:{name}"):
+            break
+        if wedges >= 2:
+            # a wedged tunnel compile rarely recovers: after two
+            # consecutive hits, stop burning the remaining budget
+            RESULT["errors"].append(
+                f"variant grid aborted: wedged backend "
+                f"({len(worklist) - i} entries skipped)"
+            )
+            log("variant grid aborted: wedged backend")
+            break
+        try:
+            # scale with node count: the 5000-node grid pairs legitimately
+            # take longer to compile+solve than the default 1000-node pair,
+            # and a slow-but-healthy backend must not read as wedged
+            with deadline(240 * dscale * max(1, vn // 1000)):
                 wv = build_variant(name, vn, vex, vpods)
                 r = run_batched(
                     wv, min(vpods, batch), cap=8,
                     use_sinkhorn=(name == "gang"),
                 )
-                grid[f"{name}/{vn}x{vex}"] = r
-                log(f"{name}/{vn}x{vex}: {r}")
-                del wv
-            except Exception as e:
-                RESULT["errors"].append(f"{name}/{vn}x{vex}: {short_err(e)}")
-                log(f"{name}/{vn}x{vex} FAILED: {short_err(e)}")
+            grid[f"{name}/{vn}x{vex}"] = r
+            log(f"{name}/{vn}x{vex}: {r}")
+            wedges = 0
+            del wv
+        except SectionTimeout as e:
+            wedges += 1
+            RESULT["errors"].append(f"{name}/{vn}x{vex}: {short_err(e)}")
+            log(f"{name}/{vn}x{vex} TIMED OUT: {short_err(e)}")
+        except Exception as e:
+            RESULT["errors"].append(f"{name}/{vn}x{vex}: {short_err(e)}")
+            log(f"{name}/{vn}x{vex} FAILED: {short_err(e)}")
     RESULT["extras"]["variants"] = grid
 
     emit(0)
