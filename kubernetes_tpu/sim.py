@@ -45,7 +45,14 @@ from kubernetes_tpu.admission import (
     QuotaController,
     default_chain,
 )
-from kubernetes_tpu.api.types import EFFECT_NO_EXECUTE, Node, Pod, Taint
+from kubernetes_tpu.api.types import (
+    EFFECT_NO_EXECUTE,
+    EFFECT_NO_SCHEDULE,
+    Node,
+    Pod,
+    Taint,
+    Toleration,
+)
 from kubernetes_tpu.cloud import CloudNodeController
 from kubernetes_tpu.debugger import compare
 from kubernetes_tpu.proxy import (
@@ -54,7 +61,12 @@ from kubernetes_tpu.proxy import (
     ServiceProxy,
 )
 from kubernetes_tpu.scheduler import Scheduler
-from kubernetes_tpu.testing import make_node, make_pod
+from kubernetes_tpu.testing import (
+    make_node,
+    make_pod,
+    node_affinity_required,
+    req,
+)
 
 
 class Conflict(Exception):
@@ -154,6 +166,102 @@ class Job:
 
     def done(self) -> bool:
         return self.succeeded >= self.completions
+
+
+#: the tolerations the daemonset controller stamps on every daemon pod
+#: (pkg/controller/daemon/util AddOrUpdateDaemonPodTolerations): NoExecute
+#: outage taints never evict daemons, and the NoSchedule condition taints
+#: (TaintNodesByCondition) don't keep them out
+DAEMON_TOLERATIONS = (
+    Toleration(key="node.kubernetes.io/unreachable", operator="Exists",
+               effect=EFFECT_NO_EXECUTE),
+    Toleration(key="node.kubernetes.io/not-ready", operator="Exists",
+               effect=EFFECT_NO_EXECUTE),
+    Toleration(key="node.kubernetes.io/unschedulable", operator="Exists",
+               effect=EFFECT_NO_SCHEDULE),
+    Toleration(key="node.kubernetes.io/disk-pressure", operator="Exists",
+               effect=EFFECT_NO_SCHEDULE),
+    Toleration(key="node.kubernetes.io/memory-pressure", operator="Exists",
+               effect=EFFECT_NO_SCHEDULE),
+    Toleration(key="node.kubernetes.io/pid-pressure", operator="Exists",
+               effect=EFFECT_NO_SCHEDULE),
+)
+
+
+@dataclass
+class DaemonSet:
+    """Hollow daemonset controller (pkg/controller/daemon). v1.16 default
+    (ScheduleDaemonSetPods GA'd that cycle, daemon_controller.go): daemon
+    pods flow through the DEFAULT scheduler, pinned to their node by
+    required node affinity — the reference pins on the metadata.name
+    field selector; our columnar packer interns the equivalent
+    ``kubernetes.io/hostname`` label every node carries, so the pin is a
+    hostname In-term. Pods carry :data:`DAEMON_TOLERATIONS` so the
+    node-lifecycle NoExecute taint path leaves them in place."""
+
+    name: str
+    cpu_milli: float = 50
+    memory: float = 128 * 2**20
+    priority: int = 0
+    #: node-eligibility selector (spec.template.spec.nodeSelector);
+    #: empty = every node
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    #: pod key -> node name it is pinned to
+    live: Dict[str, str] = field(default_factory=dict)
+
+    def should_keep(self, node: Node) -> bool:
+        """v1.16 shouldContinueRunning: an existing daemon pod stays
+        unless the node left the selector or carries an untolerated
+        NoExecute taint — outage (NotReady) and cordon do NOT evict
+        daemons (daemon_controller.go nodeShouldRunDaemonPod)."""
+        if not all(node.labels.get(k) == v
+                   for k, v in self.node_selector.items()):
+            return False
+        return not any(
+            t.effect == EFFECT_NO_EXECUTE
+            and not any(tol.tolerates(t) for tol in DAEMON_TOLERATIONS)
+            for t in node.taints
+        )
+
+    def can_place(self, node: Node) -> bool:
+        """v1.16 shouldSchedule: create a NEW daemon pod only where our
+        scheduler would actually place it. Deviation from the reference:
+        this hub models cordon/pressure/not-ready as spec+condition bits
+        which the predicates enforce regardless of tolerations (the
+        reference's TaintNodesByCondition taint form is what the daemon
+        tolerations bypass), so such nodes are deferred — the next sync
+        after recovery creates the pod — instead of parked-on forever."""
+        if not self.should_keep(node):
+            return False
+        if node.unschedulable or node.conditions.disk_pressure \
+                or node.conditions.pid_pressure \
+                or not node.conditions.ready \
+                or node.conditions.network_unavailable:
+            return False
+        return not any(
+            t.effect == EFFECT_NO_SCHEDULE
+            and not any(tol.tolerates(t) for tol in DAEMON_TOLERATIONS)
+            for t in node.taints
+        )
+
+
+@dataclass
+class StatefulSet:
+    """Hollow statefulset controller (pkg/controller/statefulset,
+    OrderedReady pod management — stateful_set_control.go): ordinal i is
+    created only once 0..i-1 are bound (the hollow Running+Ready);
+    scale-down removes the highest ordinal first, one per sync; a deleted
+    middle ordinal is recreated under the SAME name (stable identity)
+    with a fresh apiserver-assigned uid."""
+
+    name: str
+    replicas: int
+    cpu_milli: float = 100
+    memory: float = 256 * 2**20
+    priority: int = 0
+
+    def pod_name(self, ordinal: int) -> str:
+        return f"{self.name}-{ordinal}"
 
 
 class HollowKubelet:
@@ -283,6 +391,8 @@ class HollowCluster:
         self.replicasets: Dict[str, ReplicaSet] = {}
         self.deployments: Dict[str, Deployment] = {}
         self.jobs: Dict[str, Job] = {}
+        self.daemonsets: Dict[str, DaemonSet] = {}
+        self.statefulsets: Dict[str, StatefulSet] = {}
         #: pod key -> bind commit time (job completion clock; set by
         #: confirm_binding)
         self._bound_at: Dict[str, float] = {}
@@ -476,6 +586,8 @@ class HollowCluster:
             self._emit(f"pods/{key}", lambda: self.sched.on_pod_delete(pod))
             for rs in self.replicasets.values():
                 rs.live.pop(key, None)
+            for ds in self.daemonsets.values():
+                ds.live.pop(key, None)
 
     def confirm_binding(self, pod: Pod, node_name: str) -> None:
         """The Binding subresource: a CAS write (BindingREST.Create →
@@ -618,6 +730,29 @@ class HollowCluster:
     def add_job(self, j: Job) -> None:
         self.jobs[j.name] = j
 
+    def add_daemonset(self, ds: DaemonSet) -> None:
+        self.daemonsets[ds.name] = ds
+
+    def delete_daemonset(self, name: str) -> None:
+        """Foreground cascade: removing the DaemonSet deletes its pods
+        (the GC the ownerReference chain drives in the reference)."""
+        ds = self.daemonsets.pop(name, None)
+        if ds is not None:
+            for key in list(ds.live):
+                self.delete_pod(key)
+
+    def add_statefulset(self, ss: StatefulSet) -> None:
+        self.statefulsets[ss.name] = ss
+
+    def scale_statefulset(self, name: str, replicas: int) -> None:
+        self.statefulsets[name].replicas = replicas
+
+    def delete_statefulset(self, name: str) -> None:
+        if self.statefulsets.pop(name, None) is not None:
+            for key, p in list(self.truth_pods.items()):
+                if p.labels.get("ss") == name:
+                    self.delete_pod(key)
+
     def reconcile_controllers(self) -> None:
         # deployment -> replicaset (create/scale)
         for d in self.deployments.values():
@@ -681,6 +816,71 @@ class HollowCluster:
                 if pod is None:
                     break
                 rs.live[pod.key()] = pod
+
+        # daemonsets: exactly one pod per eligible node, pinned by
+        # required node affinity and pushed through the regular scheduler
+        # (v1.16 ScheduleDaemonSetPods); pods whose node vanished, fell
+        # out of the selector, or got bound somewhere other than their pin
+        # (a competing writer ignoring affinity — the apiserver accepts
+        # such bindings) are deleted — the controller's per-node
+        # expectations pass (daemon_controller.go manage())
+        for ds in self.daemonsets.values():
+            keep = {n.name for n in self.truth_nodes.values()
+                    if ds.should_keep(n)}
+            for key, node_name in list(ds.live.items()):
+                p = self.truth_pods.get(key)
+                mispinned = (p is not None and p.node_name
+                             and p.node_name != node_name)
+                if node_name not in keep or mispinned:
+                    self.delete_pod(key)
+            have = set(ds.live.values())
+            for node_name in sorted(
+                    n.name for n in self.truth_nodes.values()
+                    if ds.can_place(n) and n.name not in have):
+                pod = make_pod(
+                    f"{ds.name}-{node_name}",
+                    cpu_milli=ds.cpu_milli, memory=ds.memory,
+                    priority=ds.priority, labels={"ds": ds.name},
+                    affinity=node_affinity_required(
+                        [req("kubernetes.io/hostname", "In", node_name)]
+                    ),
+                    tolerations=DAEMON_TOLERATIONS,
+                )
+                try:
+                    self.create_pod(pod)
+                except AdmissionError:
+                    continue
+                ds.live[pod.key()] = node_name
+
+        # statefulsets: OrderedReady — scale down highest ordinal first
+        # (one per sync), otherwise create the lowest missing ordinal only
+        # once every predecessor is bound (stateful_set_control.go)
+        for ss in self.statefulsets.values():
+            by_ord: Dict[int, Pod] = {}
+            for p in self.truth_pods.values():
+                if p.labels.get("ss") != ss.name:
+                    continue
+                try:
+                    by_ord[int(p.name.rsplit("-", 1)[1])] = p
+                except (IndexError, ValueError):
+                    continue
+            over = [o for o in by_ord if o >= ss.replicas]
+            if over:
+                self.delete_pod(by_ord[max(over)].key())
+                continue  # one termination per sync; creation waits
+            for o in range(ss.replicas):
+                p = by_ord.get(o)
+                if p is None:
+                    pod = make_pod(ss.pod_name(o), cpu_milli=ss.cpu_milli,
+                                   memory=ss.memory, priority=ss.priority,
+                                   labels={"ss": ss.name})
+                    try:
+                        self.create_pod(pod)
+                    except AdmissionError:
+                        pass
+                    break
+                if not p.node_name:
+                    break  # predecessor not Running yet: hold the line
 
     def churn(self, kill_pods: int = 0, flap_nodes: int = 0) -> None:
         """Random disruption: delete bound pods, bounce nodes."""

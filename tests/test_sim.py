@@ -678,3 +678,200 @@ def test_standalone_rs_with_rs_suffix_survives_gc():
     assert "standalone-rs" in hub.replicasets
     assert sum(1 for k in hub.truth_pods
                if k.startswith("default/standalone-rs-")) == 3
+
+
+# ---------------------------------------------------------------------------
+# DaemonSet / StatefulSet controllers
+# (pkg/controller/daemon manage(), pkg/controller/statefulset OrderedReady)
+# ---------------------------------------------------------------------------
+
+
+def test_daemonset_one_pod_per_node_through_scheduler():
+    """ScheduleDaemonSetPods (v1.16 default): the controller only creates
+    affinity-pinned pods; the DEFAULT scheduler places each on exactly its
+    node. Nodes added later get their daemon pod on the next sync; removed
+    nodes' pods are GC'd and not recreated elsewhere."""
+    from kubernetes_tpu.sim import DaemonSet, HollowCluster
+
+    hub = HollowCluster(seed=21, scheduler_kw={"enable_preemption": False})
+    for i in range(4):
+        hub.add_node(make_node(f"n{i}", cpu_milli=4000))
+    hub.add_daemonset(DaemonSet("fluentd"))
+    for _ in range(2):
+        hub.step()
+    hub.check_consistency()
+    placed = {p.node_name for p in hub.truth_pods.values()
+              if p.labels.get("ds") == "fluentd"}
+    assert placed == {f"n{i}" for i in range(4)}  # one per node, pinned
+    # node join -> daemon pod follows
+    hub.add_node(make_node("n4", cpu_milli=4000))
+    for _ in range(2):
+        hub.step()
+    assert any(p.node_name == "n4" for p in hub.truth_pods.values()
+               if p.labels.get("ds") == "fluentd")
+    # node gone -> its daemon pod is deleted, never rescheduled elsewhere
+    hub.remove_node("n2")
+    for _ in range(2):
+        hub.step()
+    hub.check_consistency()
+    ds_pods = [p for p in hub.truth_pods.values()
+               if p.labels.get("ds") == "fluentd"]
+    assert len(ds_pods) == 4 and all(p.node_name != "n2" for p in ds_pods)
+    # cascade delete
+    hub.delete_daemonset("fluentd")
+    hub.step()
+    assert not any(p.labels.get("ds") == "fluentd"
+                   for p in hub.truth_pods.values())
+
+
+def test_daemonset_node_selector_limits_eligibility():
+    from kubernetes_tpu.sim import DaemonSet, HollowCluster
+
+    hub = HollowCluster(seed=22, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("gpu-0", cpu_milli=4000, labels={"accel": "gpu"}))
+    hub.add_node(make_node("cpu-0", cpu_milli=4000))
+    hub.add_daemonset(DaemonSet("driver", node_selector={"accel": "gpu"}))
+    for _ in range(2):
+        hub.step()
+    placed = {p.node_name for p in hub.truth_pods.values()
+              if p.labels.get("ds") == "driver"}
+    assert placed == {"gpu-0"}
+
+
+def test_daemonset_pods_tolerate_unreachable_taint():
+    """The taint manager evicts ordinary pods from an unreachable node;
+    daemon pods carry the Exists/NoExecute tolerations the daemonset
+    controller stamps (daemon/util AddOrUpdateDaemonPodTolerations) and
+    must stay bound for the whole outage."""
+    from kubernetes_tpu.sim import DaemonSet, HollowCluster, ReplicaSet
+
+    hub = HollowCluster(seed=23, node_grace_s=40.0, eviction_wait_s=30.0)
+    for i in range(3):
+        hub.add_node(make_node(f"n{i}", cpu_milli=8000))
+    hub.add_daemonset(DaemonSet("fluentd"))
+    hub.add_replicaset(ReplicaSet("svc", replicas=6, cpu_milli=500))
+    for _ in range(3):
+        hub.step(dt=15.0)
+    assert hub.pending_count() == 0
+    hub.kill_kubelet("n1")
+    for _ in range(11):  # grace + eviction window pass
+        hub.step(dt=15.0)
+    hub.check_consistency()
+    on_n1 = [p for p in hub.truth_pods.values() if p.node_name == "n1"]
+    assert [p.labels.get("ds") for p in on_n1] == ["fluentd"]  # only the daemon
+    hub.heal_kubelet("n1")
+    hub.step(dt=15.0)
+    assert any(p.node_name == "n1" for p in hub.truth_pods.values()
+               if p.labels.get("ds") == "fluentd")
+
+
+def test_statefulset_ordered_creation_and_reverse_scale_down():
+    from kubernetes_tpu.sim import HollowCluster, StatefulSet
+
+    hub = HollowCluster(seed=24, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=8000))
+    hub.add_statefulset(StatefulSet("db", replicas=3))
+    seen_order = []
+    for _ in range(5):
+        hub.step()
+        for p in hub.truth_pods.values():
+            if p.labels.get("ss") == "db" and p.name not in seen_order:
+                seen_order.append(p.name)
+    assert seen_order == ["db-0", "db-1", "db-2"]  # strict ordinal order
+    hub.check_consistency()
+    # reverse-order scale down, one per sync
+    hub.scale_statefulset("db", 1)
+    hub.step()
+    names = sorted(p.name for p in hub.truth_pods.values()
+                   if p.labels.get("ss") == "db")
+    assert names == ["db-0", "db-1"]  # db-2 went first
+    hub.step()
+    names = sorted(p.name for p in hub.truth_pods.values()
+                   if p.labels.get("ss") == "db")
+    assert names == ["db-0"]
+
+
+def test_statefulset_stable_identity_fresh_uid():
+    """A deleted middle ordinal is recreated under the SAME name before
+    any higher work proceeds, with a fresh apiserver-assigned uid (the
+    Binding CAS distinguishes incarnations by uid)."""
+    from kubernetes_tpu.sim import HollowCluster, StatefulSet
+
+    hub = HollowCluster(seed=25, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=8000))
+    hub.add_statefulset(StatefulSet("db", replicas=3))
+    for _ in range(5):
+        hub.step()
+    old_uid = hub.truth_pods["default/db-1"].uid
+    hub.delete_pod("default/db-1")
+    for _ in range(2):
+        hub.step()
+    hub.check_consistency()
+    new = hub.truth_pods["default/db-1"]
+    assert new.uid != old_uid and new.node_name  # same identity, new life
+
+
+def test_daemonset_repairs_mispinned_pod():
+    """The apiserver accepts a Binding that violates required node
+    affinity (assignPod does not re-check predicates); a competing writer
+    can therefore land a daemon pod on the wrong node. The controller's
+    expectations pass must delete the mispin and recreate it on its node
+    (r3 review: ds.live trusted the intended node and never repaired)."""
+    from kubernetes_tpu.sim import DaemonSet, HollowCluster
+
+    hub = HollowCluster(seed=26, scheduler_kw={"enable_preemption": False})
+    for i in range(3):
+        hub.add_node(make_node(f"n{i}", cpu_milli=4000))
+    hub.add_daemonset(DaemonSet("fluentd"))
+    hub.step()
+    hub.settle()
+    # forge a competing-writer mispin: rebind n0's daemon pod onto n1
+    key = "default/fluentd-n0"
+    pod = hub.truth_pods[key]
+    assert pod.node_name == "n0"
+    import dataclasses
+    hub.truth_pods[key] = dataclasses.replace(pod, node_name="")
+    hub.confirm_binding(hub.truth_pods[key], "n1")
+    hub.sched.on_pod_update(pod, hub.truth_pods[key])
+    for _ in range(3):
+        hub.step()
+    hub.check_consistency()
+    by_node = {p.node_name for p in hub.truth_pods.values()
+               if p.labels.get("ds") == "fluentd"}
+    assert by_node == {"n0", "n1", "n2"}
+    assert hub.truth_pods["default/fluentd-n0"].node_name == "n0"
+
+
+def test_daemonset_defers_cordoned_and_tainted_nodes():
+    """shouldSchedule vs shouldContinueRunning: a cordoned or untolerated-
+    tainted node gets NO daemon pod (no permanently-pending pod parked on
+    it), but the pod appears on the sync after the gate clears."""
+    from kubernetes_tpu.api.types import Taint
+    from kubernetes_tpu.sim import DaemonSet, HollowCluster
+
+    hub = HollowCluster(seed=27, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("ok", cpu_milli=4000))
+    cordoned = make_node("cordoned", cpu_milli=4000)
+    cordoned.unschedulable = True
+    hub.add_node(cordoned)
+    hub.add_node(make_node("dedicated", cpu_milli=4000,
+                           taints=[Taint("team", "infra")]))
+    hub.add_daemonset(DaemonSet("fluentd"))
+    for _ in range(2):
+        hub.step()
+    assert hub.pending_count() == 0  # nothing parked forever
+    placed = {p.node_name for p in hub.truth_pods.values()
+              if p.labels.get("ds") == "fluentd"}
+    assert placed == {"ok"}
+    # uncordon + untaint -> next syncs place the daemons
+    import dataclasses
+    hub._update_node(dataclasses.replace(
+        hub.truth_nodes["cordoned"], unschedulable=False))
+    hub._update_node(dataclasses.replace(
+        hub.truth_nodes["dedicated"], taints=()))
+    for _ in range(2):
+        hub.step()
+    hub.check_consistency()
+    placed = {p.node_name for p in hub.truth_pods.values()
+              if p.labels.get("ds") == "fluentd"}
+    assert placed == {"ok", "cordoned", "dedicated"}
