@@ -1,0 +1,343 @@
+"""Hand-computed scenario tables ported (semantically, not textually) from
+the reference's unit suites — the absolute-value counterpart to the
+differential tests: every expectation below is derived by hand from the
+reference's documented formulas, then asserted against BOTH the device
+kernels and (via the shared harnesses) the oracle.
+
+Sources:
+- algorithm/predicates/predicates_test.go (TestPodFitsResources,
+  TestPodFitsHost, TestPodFitsHostPorts, TestPodMatchesNodeSelectorTerms
+  shapes, TestPodToleratesNodeTaints)
+- algorithm/priorities/least_requested_test.go, most_requested_test.go,
+  balanced_resource_allocation_test.go, taint_toleration_test.go,
+  image_locality_test.go, selector_spreading_test.go
+"""
+
+import numpy as np
+
+import pyref
+from kubernetes_tpu.api.types import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    LabelSelector,
+    Taint,
+    Toleration,
+)
+from kubernetes_tpu.ops import priorities as prio
+from kubernetes_tpu.ops.predicates import decode_reasons
+from kubernetes_tpu.testing import make_node, make_pod, node_affinity_required, req
+from test_predicates import device_mask, oracle_mask
+from test_priorities import build, by_node, crop
+
+GB = 2**30
+MB = 2**20
+
+
+def reasons_of(reasons, i, j):
+    return decode_reasons(int(reasons[i, j]))
+
+
+def both_masks(nodes, existing, pending):
+    """Device mask + reasons, with the pyref oracle asserted to agree —
+    every predicate table below therefore pins BOTH implementations to
+    the hand-computed expectation."""
+    mask, reasons = device_mask(nodes, existing, pending)
+    want = oracle_mask(nodes, existing, pending)
+    assert (mask == want).all(), "device/oracle mask divergence"
+    return mask, reasons
+
+
+# ---------------------------------------------------------------------------
+# TestPodFitsResources (predicates_test.go): cpu/memory/scalar/pod-count
+# accounting, request > free → the per-resource insufficiency reason
+# ---------------------------------------------------------------------------
+
+
+def test_pod_fits_resources_table():
+    node = make_node("n0", cpu_milli=4000, memory=8 * GB, pods=10)
+    existing = [make_pod("e0", cpu_milli=3000, memory=5 * GB, node_name="n0")]
+    cases = [
+        # (pod kwargs, fits, must-have reason)
+        (dict(), True, None),                                   # no requests
+        (dict(cpu_milli=1000, memory=3 * GB), True, None),      # exactly free
+        (dict(cpu_milli=1001), False, "PodFitsResources"),      # cpu over by 1m
+        # memory accounting is f32 on device: the contract is byte-exact
+        # only up to float32 ulp (512B at 8GB — the reference's int64 math
+        # is exact; our overcommit bound is ~6e-8 relative, far below the
+        # kubelet's own accounting noise). Test at a representable margin.
+        (dict(memory=3 * GB + MB), False, "PodFitsResources"),
+        (dict(cpu_milli=2000, memory=4 * GB), False, "PodFitsResources"),
+    ]
+    pending = [make_pod(f"p{i}", **kw) for i, (kw, _, _) in enumerate(cases)]
+    mask, reasons = both_masks([node], existing, pending)
+    for i, (kw, fits, reason) in enumerate(cases):
+        assert bool(mask[i, 0]) == fits, (i, kw, reasons_of(reasons, i, 0))
+        if reason:
+            assert reason in reasons_of(reasons, i, 0)
+
+
+def test_pod_count_limit():
+    # allowedPodNumber is a resource like any other (predicates.go:779
+    # podFitsOnNode resource loop): a full node rejects even a no-request pod
+    node = make_node("n0", cpu_milli=4000, pods=2)
+    existing = [make_pod(f"e{i}", node_name="n0") for i in range(2)]
+    mask, reasons = both_masks([node], existing, [make_pod("p")])
+    assert not mask[0, 0]
+    assert "PodFitsResources" in reasons_of(reasons, 0, 0)
+
+
+def test_scalar_resource_accounting():
+    node = make_node("n0")
+    node.allocatable.scalars["example.com/gpu"] = 2
+    existing = [make_pod("e0", node_name="n0", scalars={"example.com/gpu": 1})]
+    fits = make_pod("p0", scalars={"example.com/gpu": 1})
+    over = make_pod("p1", scalars={"example.com/gpu": 2})
+    mask, reasons = both_masks([node], existing, [fits, over])
+    assert mask[0, 0] and not mask[1, 0]
+    assert "PodFitsResources" in reasons_of(reasons, 1, 0)
+
+
+# ---------------------------------------------------------------------------
+# TestPodFitsHost (predicates.go:916): spec.nodeName pins to exactly one node
+# ---------------------------------------------------------------------------
+
+
+def test_pod_fits_host_table():
+    nodes = [make_node("n0"), make_node("n1")]
+    pinned = make_pod("p0", node_name="n0")
+    free = make_pod("p1")
+    mask, reasons = both_masks(nodes, [], [pinned, free])
+    assert mask[0, 0] and not mask[0, 1]
+    assert "PodFitsHost" in reasons_of(reasons, 0, 1)
+    assert mask[1, 0] and mask[1, 1]
+
+
+# ---------------------------------------------------------------------------
+# TestPodFitsHostPorts (predicates.go:1084 + HostPortInfo host_ports.go:47):
+# conflicts are (protocol, ip, port) aware with 0.0.0.0 wildcarding
+# ---------------------------------------------------------------------------
+
+
+def test_host_ports_table():
+    node = make_node("n0")
+    existing = [make_pod("e0", node_name="n0",
+                         host_ports=[("TCP", "10.0.0.1", 8080)])]
+    cases = [
+        ([("TCP", "10.0.0.1", 8080)], False),  # exact conflict
+        ([("TCP", "10.0.0.2", 8080)], True),   # different IP
+        ([("UDP", "10.0.0.1", 8080)], True),   # different protocol
+        ([("TCP", "10.0.0.1", 8081)], True),   # different port
+        ([("TCP", "", 8080)], False),          # wildcard vs specific
+        ([("TCP", "0.0.0.0", 8080)], False),   # explicit wildcard too
+    ]
+    pending = [make_pod(f"p{i}", host_ports=hp)
+               for i, (hp, _) in enumerate(cases)]
+    mask, reasons = both_masks([node], existing, pending)
+    for i, (hp, fits) in enumerate(cases):
+        assert bool(mask[i, 0]) == fits, (hp, reasons_of(reasons, i, 0))
+        if not fits:
+            assert "PodFitsHostPorts" in reasons_of(reasons, i, 0)
+
+
+def test_wildcard_existing_blocks_specific():
+    node = make_node("n0")
+    existing = [make_pod("e0", node_name="n0", host_ports=[("TCP", "", 80)])]
+    mask, _ = both_masks([node], existing,
+                          [make_pod("p", host_ports=[("TCP", "10.1.1.1", 80)])])
+    assert not mask[0, 0]
+
+
+# ---------------------------------------------------------------------------
+# Node-selector operator semantics (v1helper.MatchNodeSelectorTerms —
+# terms OR, expressions AND, NotIn/DoesNotExist match absent keys)
+# ---------------------------------------------------------------------------
+
+
+def test_node_selector_operator_table():
+    node = make_node("n0", labels={"disk": "ssd", "cores": "16"})
+    cases = [
+        ([req("disk", OP_IN, "ssd", "nvme")], True),
+        ([req("disk", OP_IN, "hdd")], False),
+        ([req("disk", OP_NOT_IN, "hdd")], True),
+        ([req("gpu", OP_NOT_IN, "a100")], True),      # absent key: NotIn matches
+        ([req("disk", OP_EXISTS)], True),
+        ([req("gpu", OP_EXISTS)], False),
+        ([req("gpu", OP_DOES_NOT_EXIST)], True),
+        ([req("cores", OP_GT, "8")], True),
+        ([req("cores", OP_GT, "16")], False),          # strict
+        ([req("cores", OP_LT, "32")], True),
+        # one term, two expressions: AND (second fails)
+        ([req("disk", OP_IN, "ssd"), req("cores", OP_GT, "64")], False),
+    ]
+    pending = [make_pod(f"p{i}", affinity=node_affinity_required(rs))
+               for i, (rs, _) in enumerate(cases)]
+    mask, reasons = both_masks([node], [], pending)
+    for i, (rs, fits) in enumerate(cases):
+        assert bool(mask[i, 0]) == fits, (i, rs)
+        if not fits:
+            assert "PodMatchNodeSelector" in reasons_of(reasons, i, 0)
+
+
+def test_node_selector_terms_are_ored():
+    node = make_node("n0", labels={"disk": "ssd"})
+    pod = make_pod("p", affinity=node_affinity_required(
+        [req("disk", OP_IN, "hdd")],      # term 1 fails
+        [req("disk", OP_IN, "ssd")],      # term 2 matches → fits
+    ))
+    mask, _ = both_masks([node], [], [pod])
+    assert mask[0, 0]
+
+
+# ---------------------------------------------------------------------------
+# TestPodToleratesNodeTaints (predicates.go:1546): only NoSchedule/NoExecute
+# effects filter; Equal/Exists operators; empty-key Exists tolerates all
+# ---------------------------------------------------------------------------
+
+
+def test_taint_toleration_predicate_table():
+    nodes = [
+        make_node("plain"),
+        make_node("noschedule", taints=[Taint("dedicated", "gpu")]),
+        make_node("noexecute",
+                  taints=[Taint("critical", "", "NoExecute")]),
+        make_node("prefer",
+                  taints=[Taint("flaky", "", "PreferNoSchedule")]),
+    ]
+    cases = [
+        ((), [True, False, False, True]),  # PreferNoSchedule never filters
+        ((Toleration(key="dedicated", operator="Equal", value="gpu",
+                     effect="NoSchedule"),),
+         [True, True, False, True]),
+        ((Toleration(key="dedicated", operator="Equal", value="db",
+                     effect="NoSchedule"),),
+         [True, False, False, True]),      # value mismatch
+        ((Toleration(key="dedicated", operator="Exists"),),
+         [True, True, False, True]),       # empty effect matches all effects
+        ((Toleration(operator="Exists"),),
+         [True, True, True, True]),        # empty key: tolerate everything
+        ((Toleration(key="critical", operator="Exists",
+                     effect="NoExecute"),),
+         [True, False, True, True]),
+    ]
+    pending = [make_pod(f"p{i}", tolerations=tols)
+               for i, (tols, _) in enumerate(cases)]
+    mask, reasons = both_masks(nodes, [], pending)
+    for i, (tols, want) in enumerate(cases):
+        got = [bool(mask[i, j]) for j in range(len(nodes))]
+        assert got == want, (i, tols, got)
+        for j, fits in enumerate(want):
+            if not fits:
+                assert "PodToleratesNodeTaints" in reasons_of(reasons, i, j)
+
+
+# ---------------------------------------------------------------------------
+# Priority tables with hand-computed absolute scores
+# ---------------------------------------------------------------------------
+
+
+def test_least_and_most_requested_scores():
+    # least_requested.go: int((cap-req)*10/cap) per resource, averaged with
+    # integer division; most_requested.go is the dual int(req*10/cap).
+    # Requests go through the nonzero defaults (non_zero.go:42,:48).
+    node = make_node("n0", cpu_milli=4000, memory=8 * GB)
+    quarter = make_pod("quarter", cpu_milli=1000, memory=2 * GB)
+    zero = make_pod("zero")  # defaults: 100m cpu, 200MB memory
+    over = make_pod("over", cpu_milli=5000, memory=GB)
+    dn, dp, ds, mask = build([node], [], [quarter, zero, over])
+    least = crop(prio.least_requested(dp, dn, ds, None, mask),
+                 [quarter, zero, over], [node])
+    most = crop(prio.most_requested(dp, dn, ds, None, mask),
+                [quarter, zero, over], [node])
+    # quarter: cpu int(3000*10/4000)=7, mem int(6G*10/8G)=7 → (7+7)/2=7
+    assert least[0, 0] == 7.0
+    # zero: cpu int(3900*10/4000)=9; mem int((8G-200MB)*10/8G)=9 → 9
+    assert least[1, 0] == 9.0
+    # over: cpu request > capacity scores 0; mem int(7G*10/8G)=8 → int(8/2)=4
+    assert least[2, 0] == 4.0
+    # most: quarter cpu int(1000*10/4000)=2, mem int(2G*10/8G)=2 → 2
+    assert most[0, 0] == 2.0
+    assert most[1, 0] == 0.0   # int(100*10/4000)=0, int(200MB*10/8G)=0
+    assert most[2, 0] == 0.0   # over-capacity cpu scores 0; (0+1)/2 = 0
+    # the oracle must land on the same hand-computed constants
+    for p, l, m in [(quarter, 7, 2), (zero, 9, 0), (over, 4, 0)]:
+        assert pyref.least_requested_score(p, node, []) == l
+        assert pyref.most_requested_score(p, node, []) == m
+
+
+def test_balanced_allocation_scores():
+    # balanced_resource_allocation.go:41: int((1 - |cpuFrac-memFrac|) * 10);
+    # any fraction >= 1 → 0
+    node = make_node("n0", cpu_milli=4000, memory=8 * GB)
+    balanced = make_pod("b", cpu_milli=1000, memory=2 * GB)    # 0.25 / 0.25
+    skewed = make_pod("s", cpu_milli=2000, memory=2 * GB)      # 0.50 / 0.25
+    full = make_pod("f", cpu_milli=4000, memory=2 * GB)        # 1.00 → 0
+    dn, dp, ds, mask = build([node], [], [balanced, skewed, full])
+    got = crop(prio.balanced_allocation(dp, dn, ds, None, mask),
+               [balanced, skewed, full], [node])
+    assert got[0, 0] == 10.0
+    assert got[1, 0] == 7.0    # int((1-0.25)*10)
+    assert got[2, 0] == 0.0
+    for p, want in [(balanced, 10), (skewed, 7), (full, 0)]:
+        assert pyref.balanced_allocation_score(p, node, []) == want
+
+
+def test_taint_toleration_priority_scores():
+    # taint_toleration.go: count untolerated PreferNoSchedule taints,
+    # NormalizeReduce(10, reverse=true) → 10*(max-count)/max
+    nodes = [
+        make_node("clean"),
+        make_node("one", taints=[Taint("a", "", "PreferNoSchedule")]),
+        make_node("two", taints=[Taint("a", "", "PreferNoSchedule"),
+                                 Taint("b", "", "PreferNoSchedule")]),
+    ]
+    pod = make_pod("p")
+    dn, dp, ds, mask = build(nodes, [], [pod])
+    got = crop(prio.taint_toleration(dp, dn, ds, None, mask), [pod], nodes)
+    assert list(got[0]) == [10.0, 5.0, 0.0]
+    m = crop(mask, [pod], nodes)
+    assert pyref.taint_toleration_scores([pod], nodes, m)[0] == [10, 5, 0]
+
+
+def test_image_locality_scores():
+    # image_locality.go: sumScores = Σ size*(nodes-with-image/total-nodes),
+    # clamped to [23MB, 1000MB], scaled → int(10*(x-lo)/(hi-lo))
+    img = {"registry/app:v1": 500 * MB}
+    nodes = [make_node("with", images=img), make_node("without")]
+    pod = make_pod("p", images=("registry/app:v1",))
+    dn, dp, ds, mask = build(nodes, [], [pod])
+    got = crop(prio.image_locality(dp, dn, ds, None, mask), [pod], nodes)
+    # spread = 1/2 → scaled = 250MB; int(10*(250-23)/(1000-23)) = 2
+    assert got[0, 0] == 2.0
+    assert got[0, 1] == 0.0    # below the 23MB floor after clamping
+    assert pyref.image_locality_scores([pod], nodes)[0] == [2, 0]
+
+
+def test_selector_spread_zone_weighting():
+    # selector_spreading.go:34 zoneWeighting=2/3: with zones present,
+    # score = (1/3)*nodeScore + (2/3)*zoneScore, each 10*(max-count)/max
+    svc = LabelSelector(match_labels={"app": "web"})
+    nodes = [
+        make_node("a0", zone="za"),
+        make_node("a1", zone="za"),
+        make_node("b0", zone="zb"),
+    ]
+    scheduled = [
+        make_pod("e0", node_name="a0", labels={"app": "web"}),
+        make_pod("e1", node_name="a0", labels={"app": "web"}),
+        make_pod("e2", node_name="a1", labels={"app": "web"}),
+    ]
+    pod = make_pod("p", labels={"app": "web"}, spread_selectors=(svc,))
+    dn, dp, ds, mask = build(nodes, scheduled, [pod])
+    got = crop(prio.selector_spread(dp, dn, ds, None, mask), [pod], nodes)
+    # node counts: a0=2, a1=1, b0=0 (maxCount 2) → node scores 0, 5, 10
+    # zone counts: za=3, zb=0 (maxZone 3)        → zone scores 0, 0, 10
+    # final = int((1/3)*node + (2/3)*zone) — the reduce truncates to int
+    want = [0.0, 1.0, 10.0]  # a1: int(5/3) = 1
+    assert np.allclose(got[0], want, atol=1e-4), (list(got[0]), want)
+    m = crop(mask, [pod], nodes)
+    assert pyref.selector_spread_scores(
+        [pod], nodes, by_node(nodes, scheduled), m)[0] == want
