@@ -1,0 +1,364 @@
+"""REST registry over the hub — the kube-apiserver's resource surface
+(SURVEY §1 layer 2: pkg/master + pkg/registry + the generic apiserver),
+serving the slice of the v1 API this framework's clients consume.
+
+The storage semantics come from the hub itself (kubernetes_tpu/sim.py is
+the etcd3+registry analog: global revision, per-object resourceVersion,
+CAS bindings, watch history with compaction); this module is the HTTP
+facade the reference builds in staging/src/k8s.io/apiserver:
+
+- GET    /api/v1/pods                         list (all namespaces)
+- GET    /api/v1/namespaces/{ns}/pods         list (one namespace)
+- POST   /api/v1/namespaces/{ns}/pods         create (admission → 403)
+- GET    /api/v1/namespaces/{ns}/pods/{name}  read
+- DELETE /api/v1/namespaces/{ns}/pods/{name}  delete
+- POST   /api/v1/namespaces/{ns}/pods/{name}/binding
+         the Binding subresource — the scheduler's one write
+         (registry/core/pod/storage/storage.go:154 BindingREST.Create);
+         409 Conflict on the CAS failures assignPod surfaces
+- GET    /api/v1/nodes[/{name}], POST /api/v1/nodes, DELETE, PUT
+         PUT enforces the resourceVersion precondition the way
+         GuaranteedUpdate does (etcd3/store.go:236): stale rv → 409
+- GET    /api/v1/watch/{pods|nodes}?resourceVersion=N
+         NDJSON event drain from the hub's watch history; a compacted
+         rv → 410 Gone with reason=Expired (the client relists, exactly
+         client-go Reflector's "too old resource version" path)
+
+Status errors use the metav1.Status shape so a client-go-style consumer
+can switch on reason/code.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from kubernetes_tpu.admission import AdmissionError
+from kubernetes_tpu.extender import node_to_json, pod_to_json
+from kubernetes_tpu.grpc_shim import node_from_json
+from kubernetes_tpu.server import pod_from_json
+from kubernetes_tpu.sim import Compacted, Conflict, HollowCluster
+
+
+def status_doc(code: int, reason: str, message: str) -> dict:
+    return {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "status": "Failure",
+        "reason": reason,
+        "message": message,
+        "code": code,
+    }
+
+
+def _with_rv(doc: dict, hub: HollowCluster, obj_key: str) -> dict:
+    doc.setdefault("metadata", {})["resourceVersion"] = str(
+        hub.resource_version.get(obj_key, 0)
+    )
+    return doc
+
+
+class RestServer:
+    """Serve the hub's registry over HTTP. ``serve()`` returns the bound
+    port; ``close()`` shuts down."""
+
+    #: how many revisions of history the server keeps alive for poll-
+    #: watchers (the watch cache's bounded event window — cacher.go keeps
+    #: a capacity-bounded cyclic buffer so watchers survive etcd
+    #: compaction for a while; beyond it they get 410 and relist)
+    WATCH_WINDOW = 2000
+
+    def __init__(self, hub: HollowCluster, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.hub = hub
+        # the anchor cursor pins the hub's auto-compaction floor so that
+        # stateless HTTP watchers (transient cursors) can resume from an
+        # rv they saw in an earlier poll; _trim (run on every request)
+        # keeps the pin — and therefore retained history — bounded
+        self._anchor = hub.watch(hub._revision)
+        # serializes check-then-act mutations: the hub's CAS semantics
+        # must hold across ThreadingHTTPServer handler threads too
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _respond(self, code: int, doc) -> None:
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _fail(self, code: int, reason: str, message: str) -> None:
+                self._respond(code, status_doc(code, reason, message))
+
+            def do_GET(self):
+                outer._trim()
+                outer._get(self)
+
+            def do_POST(self):
+                outer._trim()
+                with outer._lock:
+                    outer._post(self)
+
+            def do_PUT(self):
+                outer._trim()
+                with outer._lock:
+                    outer._put(self)
+
+            def do_DELETE(self):
+                outer._trim()
+                with outer._lock:
+                    outer._delete(self)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    def serve(self) -> int:
+        self._thread.start()
+        return self.port
+
+    def _trim(self) -> None:
+        """Advance the compaction pin, keeping at most WATCH_WINDOW
+        revisions of history alive regardless of request mix."""
+        self._anchor.rev = max(self._anchor.rev,
+                               self.hub._revision - self.WATCH_WINDOW)
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- routing helpers ----------------------------------------------------
+
+    @staticmethod
+    def _route(path: str):
+        """Split '/api/v1/...' into segments after the version."""
+        parts = [p for p in path.split("/") if p]
+        if parts[:2] != ["api", "v1"]:
+            return None
+        return parts[2:]
+
+    @staticmethod
+    def _read_body(h):
+        """Parsed JSON body, or None (after a 400 response) on garbage."""
+        n = int(h.headers.get("Content-Length", 0))
+        raw = h.rfile.read(n) or b"{}"
+        try:
+            doc = json.loads(raw)
+        except ValueError:
+            h._fail(400, "BadRequest", "request body is not valid JSON")
+            return None
+        if not isinstance(doc, dict):
+            h._fail(400, "BadRequest", "request body must be a JSON object")
+            return None
+        return doc
+
+    # -- GET ----------------------------------------------------------------
+
+    def _get(self, h) -> None:
+        url = urlparse(h.path)
+        seg = self._route(url.path)
+        hub = self.hub
+        if not seg:
+            return h._fail(404, "NotFound", h.path)
+        if seg[0] == "watch":
+            return self._watch(h, seg[1:], parse_qs(url.query))
+        if seg == ["nodes"]:
+            items = [
+                _with_rv(node_to_json(n), hub, f"nodes/{n.name}")
+                for n in hub.truth_nodes.values()
+            ]
+            return h._respond(200, {
+                "kind": "NodeList", "apiVersion": "v1",
+                "metadata": {"resourceVersion": str(hub._revision)},
+                "items": items,
+            })
+        if len(seg) == 2 and seg[0] == "nodes":
+            n = hub.truth_nodes.get(seg[1])
+            if n is None:
+                return h._fail(404, "NotFound", f'nodes "{seg[1]}" not found')
+            return h._respond(200, _with_rv(node_to_json(n), hub,
+                                            f"nodes/{n.name}"))
+        ns = None
+        if seg[0] == "namespaces" and len(seg) >= 3:
+            ns, seg = seg[1], seg[2:]
+        if seg == ["pods"]:
+            items = [
+                _with_rv(pod_to_json(p), hub, f"pods/{p.key()}")
+                for p in hub.truth_pods.values()
+                if ns is None or p.namespace == ns
+            ]
+            return h._respond(200, {
+                "kind": "PodList", "apiVersion": "v1",
+                "metadata": {"resourceVersion": str(hub._revision)},
+                "items": items,
+            })
+        if len(seg) == 2 and seg[0] == "pods" and ns is not None:
+            p = hub.truth_pods.get(f"{ns}/{seg[1]}")
+            if p is None:
+                return h._fail(404, "NotFound", f'pods "{seg[1]}" not found')
+            return h._respond(200, _with_rv(pod_to_json(p), hub,
+                                            f"pods/{p.key()}"))
+        return h._fail(404, "NotFound", h.path)
+
+    # -- watch --------------------------------------------------------------
+
+    def _watch(self, h, seg, query) -> None:
+        """Drain currently-available events after ?resourceVersion as
+        NDJSON and close — the chunked-frame watch with the client
+        re-polling from its last seen rv (the cacher's delegation to
+        etcd watch, compressed to a poll per request)."""
+        if seg not in (["pods"], ["nodes"]):
+            return h._fail(404, "NotFound", "/".join(seg))
+        kind = seg[0]
+        try:
+            rv = int((query.get("resourceVersion") or ["0"])[0])
+        except ValueError:
+            return h._fail(400, "BadRequest",
+                           "resourceVersion must be an integer")
+        try:
+            events = self.hub.watch(rv).poll()
+        except Compacted as e:
+            return h._fail(410, "Expired", str(e))
+        lines = []
+        for rev, obj_key, etype, obj in events:
+            if not obj_key.startswith(kind + "/"):
+                continue
+            if obj is None:
+                # pod keys are "pods/ns/name" — a DELETED frame must carry
+                # namespace and name separately or informer caches keyed
+                # on (ns, name) never evict the entry
+                rest = obj_key.split("/", 1)[1]
+                if kind == "pods" and "/" in rest:
+                    ns, name = rest.split("/", 1)
+                    meta = {"name": name, "namespace": ns}
+                else:
+                    meta = {"name": rest}
+                meta["resourceVersion"] = str(rev)
+                doc = {"metadata": meta}
+            else:
+                doc = pod_to_json(obj) if kind == "pods" else node_to_json(obj)
+                doc.setdefault("metadata", {})["resourceVersion"] = str(rev)
+            lines.append(json.dumps({"type": etype, "object": doc}))
+        body = ("\n".join(lines) + ("\n" if lines else "")).encode()
+        h.send_response(200)
+        h.send_header("Content-Type", "application/json;stream=watch")
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)
+
+    # -- POST ---------------------------------------------------------------
+
+    def _post(self, h) -> None:
+        seg = self._route(urlparse(h.path).path)
+        hub = self.hub
+        if not seg:
+            return h._fail(404, "NotFound", h.path)
+        body = self._read_body(h)
+        if body is None:
+            return  # 400 already sent
+        if seg == ["nodes"]:
+            node = node_from_json(body)
+            if node.name in hub.truth_nodes:
+                return h._fail(409, "AlreadyExists",
+                               f'nodes "{node.name}" already exists')
+            hub.add_node(node)
+            return h._respond(201, _with_rv(node_to_json(node), hub,
+                                            f"nodes/{node.name}"))
+        if seg[0] == "namespaces" and len(seg) >= 3:
+            ns, seg = seg[1], seg[2:]
+            if seg == ["pods"]:
+                pod = pod_from_json(body)
+                pod.namespace = ns
+                if pod.key() in hub.truth_pods:
+                    return h._fail(409, "AlreadyExists",
+                                   f'pods "{pod.name}" already exists')
+                try:
+                    hub.create_pod(pod)
+                except AdmissionError as e:
+                    return h._fail(403, "Forbidden", str(e))
+                # serialize the STORED object: admission may have rewritten
+                # the pod (mutating plugins return a new copy) and the hub
+                # assigned metadata.uid on that admitted copy, not ours
+                stored = hub.truth_pods[pod.key()]
+                return h._respond(201, _with_rv(pod_to_json(stored), hub,
+                                                f"pods/{stored.key()}"))
+            if len(seg) == 3 and seg[0] == "pods" and seg[2] == "binding":
+                key = f"{ns}/{seg[1]}"
+                pod = hub.truth_pods.get(key)
+                if pod is None:
+                    return h._fail(404, "NotFound",
+                                   f'pods "{seg[1]}" not found')
+                target = (body.get("target") or {}).get("name", "")
+                claimed_uid = (body.get("metadata") or {}).get("uid", pod.uid)
+                import dataclasses
+                try:
+                    hub.confirm_binding(
+                        dataclasses.replace(pod, uid=claimed_uid,
+                                            node_name=""),
+                        target,
+                    )
+                except Conflict as e:
+                    return h._fail(409, "Conflict", str(e))
+                return h._respond(201, status_doc(201, "", "")
+                                  | {"status": "Success"})
+        return h._fail(404, "NotFound", h.path)
+
+    # -- PUT (GuaranteedUpdate CAS) -----------------------------------------
+
+    def _put(self, h) -> None:
+        seg = self._route(urlparse(h.path).path)
+        hub = self.hub
+        if not seg or len(seg) != 2 or seg[0] != "nodes":
+            return h._fail(404, "NotFound", h.path)
+        cur = hub.truth_nodes.get(seg[1])
+        if cur is None:
+            return h._fail(404, "NotFound", f'nodes "{seg[1]}" not found')
+        body = self._read_body(h)
+        if body is None:
+            return  # 400 already sent
+        want_rv = str((body.get("metadata") or {}).get("resourceVersion", ""))
+        cur_rv = str(hub.resource_version.get(f"nodes/{seg[1]}", 0))
+        if want_rv and want_rv != cur_rv:
+            return h._fail(
+                409, "Conflict",
+                f"Operation cannot be fulfilled on nodes \"{seg[1]}\": "
+                f"object has been modified (rv {cur_rv}, submitted {want_rv})",
+            )
+        node = node_from_json(body)
+        if node.name != seg[1]:
+            return h._fail(400, "BadRequest", "name mismatch")
+        hub._update_node(node)
+        return h._respond(200, _with_rv(node_to_json(node), hub,
+                                        f"nodes/{node.name}"))
+
+    # -- DELETE -------------------------------------------------------------
+
+    def _delete(self, h) -> None:
+        seg = self._route(urlparse(h.path).path)
+        hub = self.hub
+        if not seg:
+            return h._fail(404, "NotFound", h.path)
+        if len(seg) == 2 and seg[0] == "nodes":
+            if seg[1] not in hub.truth_nodes:
+                return h._fail(404, "NotFound", f'nodes "{seg[1]}" not found')
+            hub.remove_node(seg[1])
+            return h._respond(200, status_doc(200, "", "")
+                              | {"status": "Success"})
+        if seg[0] == "namespaces" and len(seg) == 4 and seg[2] == "pods":
+            key = f"{seg[1]}/{seg[3]}"
+            if key not in hub.truth_pods:
+                return h._fail(404, "NotFound", f'pods "{seg[3]}" not found')
+            hub.delete_pod(key)
+            return h._respond(200, status_doc(200, "", "")
+                              | {"status": "Success"})
+        return h._fail(404, "NotFound", h.path)

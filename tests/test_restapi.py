@@ -1,0 +1,251 @@
+"""REST registry tests — the apiserver facade over the hub
+(kubernetes_tpu/restapi.py), exercised with a plain HTTP client the way
+the reference's integration tier drives an in-process apiserver
+(test/integration/util/util.go:42 StartApiserver)."""
+
+import http.client
+import json
+
+from kubernetes_tpu.restapi import RestServer
+from kubernetes_tpu.sim import HollowCluster
+
+
+def start(hub):
+    srv = RestServer(hub)
+    port = srv.serve()
+    return srv, port
+
+
+def req(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request(method, path, json.dumps(body) if body is not None else None)
+    r = conn.getresponse()
+    data = r.read()
+    conn.close()
+    return r.status, json.loads(data) if data else None
+
+
+NODE = {
+    "metadata": {"name": "n0", "labels": {"kubernetes.io/hostname": "n0"}},
+    "status": {"allocatable": {"cpu": "4000m", "memory": "8589934592",
+                               "pods": "110"}},
+}
+
+
+def make_pod_doc(name, cpu="100m"):
+    return {
+        "metadata": {"name": name},
+        "spec": {"containers": [
+            {"name": "main", "resources": {"requests": {"cpu": cpu}}}
+        ]},
+    }
+
+
+def test_crud_and_list_resource_versions():
+    hub = HollowCluster(seed=1, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        code, _ = req(port, "POST", "/api/v1/nodes", NODE)
+        assert code == 201
+        code, doc = req(port, "POST", "/api/v1/nodes", NODE)
+        assert code == 409 and doc["reason"] == "AlreadyExists"
+        code, doc = req(port, "GET", "/api/v1/nodes")
+        assert code == 200 and doc["kind"] == "NodeList"
+        assert len(doc["items"]) == 1
+        assert int(doc["metadata"]["resourceVersion"]) >= 1
+
+        code, doc = req(port, "POST", "/api/v1/namespaces/default/pods",
+                        make_pod_doc("web"))
+        assert code == 201
+        assert doc["metadata"]["uid"]  # apiserver-assigned
+        code, doc = req(port, "GET", "/api/v1/namespaces/default/pods/web")
+        assert code == 200 and doc["metadata"]["name"] == "web"
+        code, doc = req(port, "GET", "/api/v1/namespaces/other/pods/web")
+        assert code == 404
+        code, _ = req(port, "DELETE", "/api/v1/namespaces/default/pods/web")
+        assert code == 200
+        code, _ = req(port, "GET", "/api/v1/namespaces/default/pods/web")
+        assert code == 404
+    finally:
+        srv.close()
+
+
+def test_scheduler_binds_pods_created_via_rest():
+    hub = HollowCluster(seed=2, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        req(port, "POST", "/api/v1/nodes", NODE)
+        for i in range(3):
+            req(port, "POST", "/api/v1/namespaces/default/pods",
+                make_pod_doc(f"w{i}"))
+        hub.step()
+        hub.settle()
+        code, doc = req(port, "GET", "/api/v1/pods")
+        assert code == 200 and len(doc["items"]) == 3
+        assert all(it["spec"]["nodeName"] == "n0" for it in doc["items"])
+        hub.check_consistency()
+    finally:
+        srv.close()
+
+
+def test_binding_subresource_cas():
+    hub = HollowCluster(seed=3, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        req(port, "POST", "/api/v1/nodes", NODE)
+        req(port, "POST", "/api/v1/namespaces/default/pods",
+            make_pod_doc("web"))
+        code, _ = req(port, "POST",
+                      "/api/v1/namespaces/default/pods/web/binding",
+                      {"target": {"name": "n0"}})
+        assert code == 201
+        assert hub.truth_pods["default/web"].node_name == "n0"
+        # already bound → Conflict (assignPod's already-assigned branch)
+        code, doc = req(port, "POST",
+                        "/api/v1/namespaces/default/pods/web/binding",
+                        {"target": {"name": "n0"}})
+        assert code == 409 and doc["reason"] == "Conflict"
+        # recreated pod: binding with the OLD uid must hit the uid CAS
+        req(port, "DELETE", "/api/v1/namespaces/default/pods/web")
+        req(port, "POST", "/api/v1/namespaces/default/pods",
+            make_pod_doc("web"))
+        code, doc = req(port, "POST",
+                        "/api/v1/namespaces/default/pods/web/binding",
+                        {"target": {"name": "n0"},
+                         "metadata": {"uid": "stale-uid"}})
+        assert code == 409 and "uid changed" in doc["message"]
+    finally:
+        srv.close()
+
+
+def test_put_node_resource_version_precondition():
+    hub = HollowCluster(seed=4, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        req(port, "POST", "/api/v1/nodes", NODE)
+        code, doc = req(port, "GET", "/api/v1/nodes/n0")
+        rv = doc["metadata"]["resourceVersion"]
+        upd = dict(NODE)
+        upd["metadata"] = {"name": "n0", "resourceVersion": rv,
+                           "labels": {"tier": "gold"}}
+        code, doc = req(port, "PUT", "/api/v1/nodes/n0", upd)
+        assert code == 200
+        assert hub.truth_nodes["n0"].labels.get("tier") == "gold"
+        # stale rv → 409 (GuaranteedUpdate CAS, etcd3/store.go:236)
+        code, doc = req(port, "PUT", "/api/v1/nodes/n0", upd)
+        assert code == 409 and doc["reason"] == "Conflict"
+    finally:
+        srv.close()
+
+
+def test_watch_stream_and_compaction_gone():
+    hub = HollowCluster(seed=5, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        code, doc = req(port, "GET", "/api/v1/nodes")
+        rv0 = int(doc["metadata"]["resourceVersion"])
+        req(port, "POST", "/api/v1/nodes", NODE)
+        req(port, "POST", "/api/v1/namespaces/default/pods",
+            make_pod_doc("web"))
+        hub.step()
+        hub.settle()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", f"/api/v1/watch/pods?resourceVersion={rv0}")
+        r = conn.getresponse()
+        events = [json.loads(l) for l in r.read().splitlines() if l]
+        conn.close()
+        types = [e["type"] for e in events]
+        assert types[0] == "ADDED"            # the create
+        assert "MODIFIED" in types            # the bind
+        assert all(e["object"]["metadata"]["resourceVersion"] for e in events)
+        # node events never leak into the pod watch
+        assert all("nodeName" in e["object"].get("spec", {}) for e in events)
+        # compaction: watching an expired rv → 410 Gone, reason Expired
+        hub.compact(hub._revision)
+        code, doc = req(port, "GET",
+                        f"/api/v1/watch/pods?resourceVersion={rv0}")
+        assert code == 410 and doc["reason"] == "Expired"
+    finally:
+        srv.close()
+
+
+def test_admission_rejection_surfaces_as_403():
+    hub = HollowCluster(seed=6, admission=True,
+                        scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        req(port, "POST", "/api/v1/nodes", NODE)
+        # lifecycle/admission.go: creates into a terminating namespace 403
+        hub.add_namespace("doomed")
+        hub.terminate_namespace("doomed")
+        code, doc = req(port, "POST", "/api/v1/namespaces/doomed/pods",
+                        make_pod_doc("web"))
+        assert code == 403 and doc["reason"] == "Forbidden"
+        # a healthy namespace still admits
+        code, _ = req(port, "POST", "/api/v1/namespaces/default/pods",
+                      make_pod_doc("web"))
+        assert code == 201
+    finally:
+        srv.close()
+
+
+def test_api_root_and_malformed_inputs():
+    hub = HollowCluster(seed=7, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        for method in ("GET", "POST", "DELETE"):
+            code, doc = req(port, method, "/api/v1")
+            assert code == 404, (method, code)
+        code, doc = req(port, "GET", "/api/v1/watch/pods?resourceVersion=abc")
+        assert code == 400 and doc["reason"] == "BadRequest"
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", "/api/v1/nodes", "not json{")
+        r = conn.getresponse()
+        doc = json.loads(r.read())
+        conn.close()
+        assert r.status == 400 and doc["reason"] == "BadRequest"
+    finally:
+        srv.close()
+
+
+def test_created_pod_response_carries_stored_uid():
+    """With admission on, mutating plugins replace the pod and the hub
+    assigns uid on the admitted copy — the 201 body must serialize the
+    STORED object so clients can use its uid as a binding precondition."""
+    hub = HollowCluster(seed=8, admission=True,
+                        scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        req(port, "POST", "/api/v1/nodes", NODE)
+        code, doc = req(port, "POST", "/api/v1/namespaces/default/pods",
+                        make_pod_doc("web"))
+        assert code == 201
+        uid = doc["metadata"]["uid"]
+        assert uid == hub.truth_pods["default/web"].uid
+        code, _ = req(port, "POST",
+                      "/api/v1/namespaces/default/pods/web/binding",
+                      {"target": {"name": "n0"}, "metadata": {"uid": uid}})
+        assert code == 201
+    finally:
+        srv.close()
+
+
+def test_watch_delete_frame_has_namespace_and_name():
+    hub = HollowCluster(seed=9, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        rv0 = hub._revision
+        req(port, "POST", "/api/v1/namespaces/default/pods",
+            make_pod_doc("web"))
+        req(port, "DELETE", "/api/v1/namespaces/default/pods/web")
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", f"/api/v1/watch/pods?resourceVersion={rv0}")
+        r = conn.getresponse()
+        events = [json.loads(l) for l in r.read().splitlines() if l]
+        conn.close()
+        dels = [e for e in events if e["type"] == "DELETED"]
+        assert len(dels) == 1
+        meta = dels[0]["object"]["metadata"]
+        assert meta["name"] == "web" and meta["namespace"] == "default"
+    finally:
+        srv.close()
