@@ -264,6 +264,49 @@ class StatefulSet:
         return f"{self.name}-{ordinal}"
 
 
+@dataclass
+class CronJob:
+    """Hollow cronjob controller (pkg/controller/cronjob): spawns a Job
+    every ``every_s`` sim-seconds. concurrencyPolicy semantics from
+    cronjob_controller.go syncOne: Allow runs jobs side by side, Forbid
+    skips a tick while the previous job is active, Replace deletes the
+    active job's pods and starts fresh. Finished jobs beyond
+    ``history_limit`` are GC'd (successfulJobsHistoryLimit)."""
+
+    name: str
+    every_s: float
+    completions: int = 1
+    parallelism: int = 1
+    duration_s: float = 15.0
+    concurrency: str = "Allow"  # Allow | Forbid | Replace
+    history_limit: int = 3
+    cpu_milli: float = 100
+    memory: float = 256 * 2**20
+    next_run: float = 0.0
+    runs: int = 0
+    #: job names spawned by this cron, oldest first
+    spawned: List[str] = field(default_factory=list)
+
+
+@dataclass
+class HorizontalPodAutoscaler:
+    """Hollow HPA (pkg/controller/podautoscaler horizontal.go): scales a
+    Deployment between min/max replicas toward
+    desired = ceil(current * currentUtilization / target), with the 10%
+    tolerance dead-band (GetResourceReplicas, replica_calculator.go:89).
+    The hollow metric source is ``load_fn`` — a callable returning the
+    current average utilization (the sim's stand-in for the metrics
+    pipeline the reference scrapes)."""
+
+    name: str
+    deployment: str
+    min_replicas: int
+    max_replicas: int
+    target_utilization: float = 0.5
+    load_fn: Optional[Callable[[], float]] = None
+    tolerance: float = 0.1
+
+
 class HollowKubelet:
     """Per-node hollow node agent — the kubemark hollow-node analog
     (pkg/kubemark/hollow_kubelet.go:44: real kubelet logic, fake
@@ -393,6 +436,8 @@ class HollowCluster:
         self.jobs: Dict[str, Job] = {}
         self.daemonsets: Dict[str, DaemonSet] = {}
         self.statefulsets: Dict[str, StatefulSet] = {}
+        self.cronjobs: Dict[str, CronJob] = {}
+        self.hpas: Dict[str, HorizontalPodAutoscaler] = {}
         #: pod key -> bind commit time (job completion clock; set by
         #: confirm_binding)
         self._bound_at: Dict[str, float] = {}
@@ -741,6 +786,13 @@ class HollowCluster:
             for key in list(ds.live):
                 self.delete_pod(key)
 
+    def add_cronjob(self, cj: CronJob) -> None:
+        cj.next_run = self.clock.t
+        self.cronjobs[cj.name] = cj
+
+    def add_hpa(self, hpa: HorizontalPodAutoscaler) -> None:
+        self.hpas[hpa.name] = hpa
+
     def add_statefulset(self, ss: StatefulSet) -> None:
         self.statefulsets[ss.name] = ss
 
@@ -754,6 +806,59 @@ class HollowCluster:
                     self.delete_pod(key)
 
     def reconcile_controllers(self) -> None:
+        import math
+
+        # hpa: scale the target deployment toward the metric target
+        # (podautoscaler/horizontal.go; desired = ceil(current * ratio),
+        # 10% tolerance dead-band per replica_calculator.go:89) — runs
+        # before the deployment sync so the new size propagates this tick
+        for hpa in self.hpas.values():
+            d = self.deployments.get(hpa.deployment)
+            if d is None or hpa.load_fn is None:
+                continue
+            current = max(1, d.replicas)
+            target = hpa.target_utilization
+            ratio = (float(hpa.load_fn()) / target) if target > 0 else 1.0
+            desired = current if abs(ratio - 1.0) <= hpa.tolerance \
+                else math.ceil(current * ratio)
+            d.replicas = min(hpa.max_replicas,
+                             max(hpa.min_replicas, desired))
+
+        # cronjobs: spawn Jobs on schedule (cronjob_controller.go syncOne);
+        # a multi-period clock jump still launches one run per sync — the
+        # reference's missed-start accounting compressed to its effect
+        for cj in self.cronjobs.values():
+            if self.clock.t < cj.next_run:
+                continue
+            active = [jn for jn in cj.spawned
+                      if jn in self.jobs and not self.jobs[jn].done()]
+            if active and cj.concurrency == "Forbid":
+                # skipped runs are dropped, never queued: catch the
+                # schedule up past NOW or a long-running job would be
+                # followed by a burst of back-to-back make-up runs
+                while cj.next_run <= self.clock.t:
+                    cj.next_run += cj.every_s
+                continue
+            if active and cj.concurrency == "Replace":
+                for jn in active:
+                    j = self.jobs.pop(jn)
+                    for key in list(j.active):
+                        self.delete_pod(key)
+                    cj.spawned.remove(jn)
+            cj.runs += 1
+            jn = f"{cj.name}-{cj.runs}"
+            while jn in self.jobs:
+                # a foreign job already owns this name: the apiserver
+                # would reject the duplicate create — never overwrite it
+                cj.runs += 1
+                jn = f"{cj.name}-{cj.runs}"
+            self.jobs[jn] = Job(jn, completions=cj.completions,
+                                parallelism=cj.parallelism,
+                                duration_s=cj.duration_s,
+                                cpu_milli=cj.cpu_milli, memory=cj.memory)
+            cj.spawned.append(jn)
+            cj.next_run += cj.every_s
+
         # deployment -> replicaset (create/scale)
         for d in self.deployments.values():
             rs = self.replicasets.get(d.rs_name())
@@ -881,6 +986,16 @@ class HollowCluster:
                     break
                 if not p.node_name:
                     break  # predecessor not Running yet: hold the line
+
+        # cronjob history GC — after the jobs pass above so jobs that
+        # finished THIS sync count against successfulJobsHistoryLimit
+        for cj in self.cronjobs.values():
+            finished = [jn for jn in cj.spawned
+                        if jn in self.jobs and self.jobs[jn].done()]
+            while len(finished) > cj.history_limit:
+                jn = finished.pop(0)
+                cj.spawned.remove(jn)
+                del self.jobs[jn]
 
     def churn(self, kill_pods: int = 0, flap_nodes: int = 0) -> None:
         """Random disruption: delete bound pods, bounce nodes."""

@@ -875,3 +875,128 @@ def test_daemonset_defers_cordoned_and_tainted_nodes():
     placed = {p.node_name for p in hub.truth_pods.values()
               if p.labels.get("ds") == "fluentd"}
     assert placed == {"ok", "cordoned", "dedicated"}
+
+
+# ---------------------------------------------------------------------------
+# CronJob / HPA controllers
+# (pkg/controller/cronjob syncOne, pkg/controller/podautoscaler horizontal.go)
+# ---------------------------------------------------------------------------
+
+
+def test_cronjob_spawns_on_schedule_and_gcs_history():
+    from kubernetes_tpu.sim import CronJob, HollowCluster
+
+    hub = HollowCluster(seed=31, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=8000))
+    hub.add_cronjob(CronJob("tick", every_s=30.0, duration_s=10.0,
+                            history_limit=2))
+    for _ in range(12):
+        hub.step(dt=15.0)  # 180s -> 6 scheduled runs
+    cj = hub.cronjobs["tick"]
+    assert cj.runs == 6
+    # history trimmed to the limit: only the newest finished jobs remain
+    finished = [jn for jn in cj.spawned if hub.jobs[jn].done()]
+    assert len(finished) <= 2
+    hub.check_consistency()
+
+
+def test_cronjob_forbid_skips_while_active():
+    from kubernetes_tpu.sim import CronJob, HollowCluster
+
+    hub = HollowCluster(seed=32, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=8000))
+    # each run outlives the period: Forbid must skip overlapping starts
+    hub.add_cronjob(CronJob("slow", every_s=15.0, duration_s=120.0,
+                            concurrency="Forbid"))
+    for _ in range(6):
+        hub.step(dt=15.0)
+    cj = hub.cronjobs["slow"]
+    assert cj.runs == 1  # later ticks all skipped while run 1 is active
+    active = [p for p in hub.truth_pods.values()
+              if p.labels.get("job", "").startswith("slow-")]
+    assert len(active) == 1
+
+
+def test_cronjob_replace_preempts_active_run():
+    from kubernetes_tpu.sim import CronJob, HollowCluster
+
+    hub = HollowCluster(seed=33, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=8000))
+    hub.add_cronjob(CronJob("fresh", every_s=15.0, duration_s=120.0,
+                            concurrency="Replace"))
+    for _ in range(4):
+        hub.step(dt=15.0)
+    cj = hub.cronjobs["fresh"]
+    assert cj.runs == 4  # every tick replaces the previous run
+    live_jobs = {jn for jn in cj.spawned if jn in hub.jobs}
+    assert live_jobs == {"fresh-4"}
+    hub.check_consistency()
+
+
+def test_hpa_scales_deployment_with_load():
+    from kubernetes_tpu.sim import (
+        Deployment,
+        HollowCluster,
+        HorizontalPodAutoscaler,
+    )
+
+    hub = HollowCluster(seed=34, scheduler_kw={"enable_preemption": False})
+    for i in range(4):
+        hub.add_node(make_node(f"n{i}", cpu_milli=8000))
+    hub.add_deployment(Deployment("web", replicas=2))
+    load = {"util": 1.0}  # 2x the 0.5 target -> double the replicas
+    hub.add_hpa(HorizontalPodAutoscaler(
+        "web-hpa", deployment="web", min_replicas=2, max_replicas=10,
+        target_utilization=0.5, load_fn=lambda: load["util"]))
+    hub.step()
+    assert hub.deployments["web"].replicas == 4
+    hub.step()
+    assert hub.deployments["web"].replicas == 8
+    hub.step()
+    assert hub.deployments["web"].replicas == 10  # max clamp
+    # load collapses -> scale down to the min clamp
+    load["util"] = 0.01
+    hub.step()
+    assert hub.deployments["web"].replicas == 2
+    # inside the 10% tolerance dead-band: no resize
+    load["util"] = 0.52
+    hub.step()
+    assert hub.deployments["web"].replicas == 2
+    for _ in range(2):
+        hub.step()
+    hub.check_consistency()
+
+
+def test_cronjob_forbid_drops_missed_runs_no_burst():
+    """Regression (r3 review): while a long job blocks Forbid, the
+    schedule must catch up past NOW — finishing the job must not unleash
+    a burst of make-up runs for every missed period."""
+    from kubernetes_tpu.sim import CronJob, HollowCluster
+
+    hub = HollowCluster(seed=35, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=8000))
+    hub.add_cronjob(CronJob("slow", every_s=10.0, duration_s=100.0,
+                            concurrency="Forbid"))
+    for _ in range(10):  # run 1 finishes at t=105; fresh run at t=120
+        hub.step(dt=15.0)
+    cj = hub.cronjobs["slow"]
+    assert cj.runs == 2  # run 1, then exactly one fresh run after it ended
+    assert cj.next_run > 120.0
+
+
+def test_cronjob_never_overwrites_foreign_job():
+    """Regression (r3 review): a user Job occupying '{cron}-{n}' must not
+    be clobbered — the apiserver would reject the duplicate create."""
+    from kubernetes_tpu.sim import CronJob, HollowCluster, Job
+
+    hub = HollowCluster(seed=36, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=8000))
+    user_job = Job("tick-1", completions=3, duration_s=200.0)
+    hub.add_job(user_job)
+    hub.add_cronjob(CronJob("tick", every_s=30.0, duration_s=10.0))
+    for _ in range(3):
+        hub.step(dt=15.0)
+    assert hub.jobs["tick-1"] is user_job  # untouched
+    cj = hub.cronjobs["tick"]
+    assert "tick-1" not in cj.spawned and cj.spawned[0] == "tick-2"
+    hub.check_consistency()
