@@ -75,8 +75,36 @@ def node_to_json(node) -> dict:
         {"type": "NetworkUnavailable",
          "status": "True" if c.network_unavailable else "False"},
     ]
+    meta = {"name": node.name, "labels": dict(node.labels)}
+    if node.prefer_avoid_owner_uids:
+        # the reference carries this via the preferAvoidPods annotation
+        # (scheduler.alpha.kubernetes.io/preferAvoidPods, priorities/
+        # node_prefer_avoid_pods.go) — keep the wire shape
+        meta["annotations"] = {
+            "scheduler.alpha.kubernetes.io/preferAvoidPods": json.dumps({
+                "preferAvoidPods": [
+                    {"podSignature": {"podController": {"uid": uid}}}
+                    for uid in node.prefer_avoid_owner_uids
+                ]
+            })
+        }
+    status = {
+        "allocatable": {
+            "cpu": f"{int(node.allocatable.cpu_milli)}m",
+            "memory": str(int(node.allocatable.memory)),
+            "pods": str(int(node.allocatable.pods)),
+            "ephemeral-storage": str(int(node.allocatable.ephemeral_storage)),
+            **{k: str(v) for k, v in node.allocatable.scalars.items()},
+        },
+        "conditions": conditions,
+    }
+    if node.images:
+        status["images"] = [
+            {"names": [name], "sizeBytes": int(size)}
+            for name, size in node.images.items()
+        ]
     return {
-        "metadata": {"name": node.name, "labels": dict(node.labels)},
+        "metadata": meta,
         "spec": {
             "unschedulable": node.unschedulable,
             "taints": [
@@ -84,15 +112,7 @@ def node_to_json(node) -> dict:
                 for t in node.taints
             ],
         },
-        "status": {
-            "allocatable": {
-                "cpu": f"{int(node.allocatable.cpu_milli)}m",
-                "memory": str(int(node.allocatable.memory)),
-                "pods": str(int(node.allocatable.pods)),
-                **{k: str(v) for k, v in node.allocatable.scalars.items()},
-            },
-            "conditions": conditions,
-        },
+        "status": status,
     }
 
 

@@ -69,6 +69,23 @@ def node_from_json(d: dict) -> Node:
         pid_pressure=flags.get("PIDPressure", False),
         network_unavailable=flags.get("NetworkUnavailable", False),
     )
+    images = {}
+    for img in status.get("images") or []:
+        for name in img.get("names") or []:
+            images[name] = float(img.get("sizeBytes", 0))
+    avoid = ()
+    ann = (meta.get("annotations") or {}).get(
+        "scheduler.alpha.kubernetes.io/preferAvoidPods"
+    )
+    if ann:
+        try:
+            avoid = tuple(
+                e["podSignature"]["podController"]["uid"]
+                for e in json.loads(ann).get("preferAvoidPods", [])
+                if e.get("podSignature", {}).get("podController", {}).get("uid")
+            )
+        except (ValueError, TypeError, KeyError, AttributeError):
+            avoid = ()  # malformed annotation ignored, like the reference
     return Node(
         name=meta.get("name", ""),
         labels=dict(meta.get("labels") or {}),
@@ -76,6 +93,8 @@ def node_from_json(d: dict) -> Node:
         taints=taints,
         conditions=cond,
         unschedulable=bool(spec.get("unschedulable", False)),
+        images=images,
+        prefer_avoid_owner_uids=avoid,
     )
 
 

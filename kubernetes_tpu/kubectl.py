@@ -1,7 +1,10 @@
 """``ktpu`` — the kubectl-shaped operator CLI for this framework's scope
 (the `pkg/kubectl` analog restricted to what the scheduler service owns):
-inspect the service's resident snapshot over the gRPC seam and EXPLAIN
-scheduling decisions with the real device kernels.
+inspect the service's resident snapshot over the gRPC seam, EXPLAIN
+scheduling decisions with the real device kernels, and mutate cluster
+state through the REST registry.
+
+Read verbs (gRPC seam, --server HOST:PORT):
 
     python -m kubernetes_tpu.kubectl --server 127.0.0.1:PORT get nodes
     python -m kubernetes_tpu.kubectl --server ... get pods
@@ -9,10 +12,20 @@ scheduling decisions with the real device kernels.
     python -m kubernetes_tpu.kubectl --server ... describe node n3
     python -m kubernetes_tpu.kubectl --server ... top nodes
 
+Mutation verbs (REST registry, --api-server HOST:PORT — restapi.py):
+
+    python -m kubernetes_tpu.kubectl --api-server ... create -f pod.json
+    python -m kubernetes_tpu.kubectl --api-server ... delete pod web-0
+    python -m kubernetes_tpu.kubectl --api-server ... delete node n3
+    python -m kubernetes_tpu.kubectl --api-server ... cordon n3
+    python -m kubernetes_tpu.kubectl --api-server ... uncordon n3
+
 ``describe pod`` on a pending pod runs the Filter/Prioritize verbs against
 every node in the snapshot and prints the per-node failure reasons /
 scores — `kubectl describe pod` events plus `kubectl get events` rolled
-into the scheduler's own explanation (FitError text shapes).
+into the scheduler's own explanation (FitError text shapes). ``cordon``
+is the kubectl drain primitive: a resourceVersion-preconditioned PUT
+retried on 409, the client side of GuaranteedUpdate.
 """
 
 from __future__ import annotations
@@ -216,6 +229,85 @@ def cmd_describe(client, args) -> int:
     return 1
 
 
+class RestClient:
+    """HTTP client for the REST registry (restapi.py)."""
+
+    def __init__(self, target: str):
+        host, _, port = target.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+
+    def call(self, method: str, path: str, body=None):
+        import http.client
+
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        conn.request(method, path,
+                     json.dumps(body) if body is not None else None)
+        r = conn.getresponse()
+        data = r.read()
+        conn.close()
+        return r.status, json.loads(data) if data else None
+
+
+def _rest_fail(doc) -> int:
+    msg = (doc or {}).get("message") or (doc or {}).get("reason") or "error"
+    print(f"Error: {msg}", file=sys.stderr)
+    return 1
+
+
+def cmd_create(rest: RestClient, args) -> int:
+    with open(args.filename) as f:
+        doc = json.load(f)
+    kind = doc.get("kind") or ("Node" if "allocatable" in
+                               (doc.get("status") or {}) else "Pod")
+    if kind == "Node":
+        code, out = rest.call("POST", "/api/v1/nodes", doc)
+        what = f"node/{(doc.get('metadata') or {}).get('name', '?')}"
+    else:
+        ns = (doc.get("metadata") or {}).get("namespace") or args.namespace
+        code, out = rest.call("POST", f"/api/v1/namespaces/{ns}/pods", doc)
+        what = f"pod/{(doc.get('metadata') or {}).get('name', '?')}"
+    if code != 201:
+        return _rest_fail(out)
+    print(f"{what} created")
+    return 0
+
+
+def cmd_delete(rest: RestClient, args) -> int:
+    if args.kind in ("node", "nodes"):
+        code, out = rest.call("DELETE", f"/api/v1/nodes/{args.name}")
+        what = f"node/{args.name}"
+    else:
+        code, out = rest.call(
+            "DELETE", f"/api/v1/namespaces/{args.namespace}/pods/{args.name}"
+        )
+        what = f"pod/{args.name}"
+    if code != 200:
+        return _rest_fail(out)
+    print(f"{what} deleted")
+    return 0
+
+
+def cmd_cordon(rest: RestClient, args, unschedulable: bool) -> int:
+    # kubectl cordon: read-modify-write with the resourceVersion
+    # precondition, retried on 409 — the client half of GuaranteedUpdate
+    # (etcd3/store.go:236); bounded attempts like RetryOnConflict
+    for _ in range(5):
+        code, node = rest.call("GET", f"/api/v1/nodes/{args.name}")
+        if code != 200:
+            return _rest_fail(node)
+        node.setdefault("spec", {})["unschedulable"] = unschedulable
+        code, out = rest.call("PUT", f"/api/v1/nodes/{args.name}", node)
+        if code == 200:
+            print(f"node/{args.name} "
+                  f"{'cordoned' if unschedulable else 'uncordoned'}")
+            return 0
+        if code != 409:
+            return _rest_fail(out)
+    print(f"Error: conflict updating node/{args.name} after 5 retries",
+          file=sys.stderr)
+    return 1
+
+
 class _Client:
     """Thin wrapper adding get_state_snapshot() sugar."""
 
@@ -237,7 +329,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="ktpu", description="kubectl-shaped CLI for the TPU scheduler"
     )
-    p.add_argument("--server", required=True, help="gRPC service HOST:PORT")
+    p.add_argument("--server", help="gRPC service HOST:PORT (read verbs)")
+    p.add_argument("--api-server",
+                   help="REST registry HOST:PORT (mutation verbs)")
     sub = p.add_subparsers(dest="cmd", required=True)
     g = sub.add_parser("get")
     g.add_argument("kind")
@@ -246,8 +340,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     d = sub.add_parser("describe")
     d.add_argument("kind")
     d.add_argument("name")
+    c = sub.add_parser("create")
+    c.add_argument("-f", "--filename", required=True)
+    c.add_argument("-n", "--namespace", default="default")
+    de = sub.add_parser("delete")
+    de.add_argument("kind", choices=["pod", "pods", "node", "nodes"])
+    de.add_argument("name")
+    de.add_argument("-n", "--namespace", default="default")
+    for verb in ("cordon", "uncordon"):
+        cv = sub.add_parser(verb)
+        cv.add_argument("name")
     args = p.parse_args(argv)
 
+    if args.cmd in ("create", "delete", "cordon", "uncordon"):
+        if not args.api_server:
+            p.error(f"{args.cmd} requires --api-server")
+        rest = RestClient(args.api_server)
+        if args.cmd == "create":
+            return cmd_create(rest, args)
+        if args.cmd == "delete":
+            return cmd_delete(rest, args)
+        return cmd_cordon(rest, args, unschedulable=(args.cmd == "cordon"))
+
+    if not args.server:
+        p.error(f"{args.cmd} requires --server")
     client = _Client(args.server)
     try:
         if args.cmd == "get":

@@ -249,3 +249,66 @@ def test_watch_delete_frame_has_namespace_and_name():
         assert meta["name"] == "web" and meta["namespace"] == "default"
     finally:
         srv.close()
+
+
+def test_ktpu_mutation_verbs_over_rest(tmp_path, capsys):
+    """kubectl-shaped mutation path: create -f, cordon/uncordon (CAS
+    read-modify-write loop), delete — all against the REST registry."""
+    from kubernetes_tpu.kubectl import main as ktpu
+
+    hub = HollowCluster(seed=41, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    api = f"127.0.0.1:{port}"
+    try:
+        nf = tmp_path / "node.json"
+        nf.write_text(json.dumps({"kind": "Node", **NODE}))
+        assert ktpu(["--api-server", api, "create", "-f", str(nf)]) == 0
+        assert "n0" in hub.truth_nodes
+        pf = tmp_path / "pod.json"
+        pf.write_text(json.dumps(make_pod_doc("web")))
+        assert ktpu(["--api-server", api, "create", "-f", str(pf)]) == 0
+        assert "default/web" in hub.truth_pods
+        # duplicate create surfaces the AlreadyExists Status
+        assert ktpu(["--api-server", api, "create", "-f", str(pf)]) == 1
+
+        assert ktpu(["--api-server", api, "cordon", "n0"]) == 0
+        assert hub.truth_nodes["n0"].unschedulable
+        assert ktpu(["--api-server", api, "uncordon", "n0"]) == 0
+        assert not hub.truth_nodes["n0"].unschedulable
+
+        assert ktpu(["--api-server", api, "delete", "pod", "web"]) == 0
+        assert "default/web" not in hub.truth_pods
+        assert ktpu(["--api-server", api, "delete", "node", "n0"]) == 0
+        assert not hub.truth_nodes
+        assert ktpu(["--api-server", api, "delete", "node", "n0"]) == 1
+        out = capsys.readouterr()
+        assert "created" in out.out and "cordoned" in out.out
+    finally:
+        srv.close()
+
+
+def test_node_json_round_trip_lossless():
+    """cordon's read-modify-write PUTs the GET body back: images and the
+    preferAvoidPods annotation must survive the round trip or a cordon
+    silently erases ImageLocality/NodePreferAvoidPods inputs."""
+    from kubernetes_tpu.extender import node_to_json
+    from kubernetes_tpu.grpc_shim import node_from_json
+    from kubernetes_tpu.testing import make_node
+
+    nd = make_node("n0", cpu_milli=4000, labels={"disk": "ssd"},
+                   images={"registry/app:v1": 500 * 2**20})
+    nd.prefer_avoid_owner_uids = ("rc-1", "rc-2")
+    nd.unschedulable = True
+    nd.allocatable.ephemeral_storage = 5 * 2**30
+    back = node_from_json(node_to_json(nd))
+    assert back.images == {"registry/app:v1": 500 * 2**20}
+    assert back.prefer_avoid_owner_uids == ("rc-1", "rc-2")
+    assert back.unschedulable and back.labels == nd.labels
+    assert back.allocatable.cpu_milli == nd.allocatable.cpu_milli
+    assert back.allocatable.ephemeral_storage == 5 * 2**30
+    # malformed preferAvoidPods annotations are ignored, never a crash
+    doc = node_to_json(nd)
+    for bad in ('{"preferAvoidPods": [42]}', "[]", "not json"):
+        doc["metadata"]["annotations"] = {
+            "scheduler.alpha.kubernetes.io/preferAvoidPods": bad}
+        assert node_from_json(doc).prefer_avoid_owner_uids == ()
