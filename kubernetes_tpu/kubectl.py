@@ -257,8 +257,15 @@ def _rest_fail(doc) -> int:
 def cmd_create(rest: RestClient, args) -> int:
     with open(args.filename) as f:
         doc = json.load(f)
-    kind = doc.get("kind") or ("Node" if "allocatable" in
-                               (doc.get("status") or {}) else "Pod")
+    kind = doc.get("kind")
+    if not kind:
+        # kubectl refuses kind-less docs; guessing here could create a
+        # bogus Pod out of a hand-written Node manifest
+        print(f"Error: {args.filename} is missing 'kind'", file=sys.stderr)
+        return 1
+    if kind not in ("Pod", "Node"):
+        print(f"Error: unsupported kind {kind!r}", file=sys.stderr)
+        return 1
     if kind == "Node":
         code, out = rest.call("POST", "/api/v1/nodes", doc)
         what = f"node/{(doc.get('metadata') or {}).get('name', '?')}"
@@ -355,12 +362,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd in ("create", "delete", "cordon", "uncordon"):
         if not args.api_server:
             p.error(f"{args.cmd} requires --api-server")
-        rest = RestClient(args.api_server)
-        if args.cmd == "create":
-            return cmd_create(rest, args)
-        if args.cmd == "delete":
-            return cmd_delete(rest, args)
-        return cmd_cordon(rest, args, unschedulable=(args.cmd == "cordon"))
+        try:
+            rest = RestClient(args.api_server)
+        except ValueError:
+            p.error(f"--api-server must be HOST:PORT, got {args.api_server!r}")
+        try:
+            if args.cmd == "create":
+                return cmd_create(rest, args)
+            if args.cmd == "delete":
+                return cmd_delete(rest, args)
+            return cmd_cordon(rest, args,
+                              unschedulable=(args.cmd == "cordon"))
+        except OSError as e:
+            print(f"Error: cannot reach API server {args.api_server}: {e}",
+                  file=sys.stderr)
+            return 1
 
     if not args.server:
         p.error(f"{args.cmd} requires --server")

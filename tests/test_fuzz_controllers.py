@@ -1,0 +1,131 @@
+"""Control-plane fuzz: random mixes of every hollow controller under
+churn, flaky binds, delayed watch events, competing writers, and node
+outages — settled state must satisfy the consistency oracle and each
+controller's own invariant. The control-plane counterpart of
+tests/test_fuzz_differential.py (SURVEY §4 implication d: hollow-node
+style simulation for end-to-end dynamics), shaped like the reference's
+integration-tier soak tests rather than any single table."""
+
+import random
+
+from kubernetes_tpu.sim import (
+    CronJob,
+    DaemonSet,
+    Deployment,
+    HollowCluster,
+    HorizontalPodAutoscaler,
+    Job,
+    ReplicaSet,
+    StatefulSet,
+)
+from kubernetes_tpu.testing import make_node
+
+N_SEEDS = 25
+
+
+def build_random_cluster(rng, seed):
+    hub = HollowCluster(
+        seed=seed,
+        bind_fail_rate=rng.choice([0.0, 0.05]),
+        event_delay_ticks=rng.choice([0, 1]),
+        competing_bind_rate=rng.choice([0.0, 0.1]),
+        scheduler_kw={"enable_preemption": False},
+    )
+    zones = ["za", "zb"]
+    n_nodes = rng.randrange(4, 9)
+    for i in range(n_nodes):
+        hub.add_node(make_node(f"n{i}", cpu_milli=8000, memory=16 * 2**30,
+                               zone=rng.choice(zones)))
+    # random controller mix
+    if rng.random() < 0.8:
+        hub.add_deployment(Deployment("web", replicas=rng.randrange(2, 8)))
+    if rng.random() < 0.5:
+        hub.add_replicaset(ReplicaSet("raw", replicas=rng.randrange(1, 5),
+                                      cpu_milli=300))
+    if rng.random() < 0.6:
+        hub.add_daemonset(DaemonSet("agent"))
+    if rng.random() < 0.6:
+        hub.add_statefulset(StatefulSet("db", replicas=rng.randrange(2, 5)))
+    if rng.random() < 0.5:
+        hub.add_job(Job("batch", completions=rng.randrange(2, 6),
+                        parallelism=2, duration_s=20.0))
+    if rng.random() < 0.5:
+        hub.add_cronjob(CronJob("cron", every_s=rng.choice([30.0, 45.0]),
+                                duration_s=15.0,
+                                concurrency=rng.choice(
+                                    ["Allow", "Forbid", "Replace"])))
+    if "web" in hub.deployments and rng.random() < 0.5:
+        util = {"u": rng.choice([0.3, 0.5, 1.0])}
+        hub.add_hpa(HorizontalPodAutoscaler(
+            "web-hpa", "web", min_replicas=2, max_replicas=8,
+            target_utilization=0.5, load_fn=lambda: util["u"]))
+        hub._fuzz_util = util  # mutated mid-run below
+    return hub
+
+
+def check_controller_invariants(hub):
+    """Each controller's own contract at a settled state."""
+    # deployments own an RS sized to spec
+    for d in hub.deployments.values():
+        rs = hub.replicasets[d.rs_name()]
+        assert rs.replicas == d.replicas
+    # replicasets: exactly `replicas` live pods tracked AND in truth
+    for rs in hub.replicasets.values():
+        assert len(rs.live) == rs.replicas, (rs.name, len(rs.live))
+        for key in rs.live:
+            assert key in hub.truth_pods
+    # daemonsets: one pod per keep-eligible node, each on its pinned node
+    for ds in hub.daemonsets.values():
+        placed = {}
+        for key, node_name in ds.live.items():
+            p = hub.truth_pods[key]
+            if p.node_name:
+                assert p.node_name == node_name, (key, p.node_name, node_name)
+            placed[node_name] = placed.get(node_name, 0) + 1
+        assert all(v == 1 for v in placed.values())
+        for nd in hub.truth_nodes.values():
+            if ds.can_place(nd):
+                assert nd.name in placed, f"daemon missing on {nd.name}"
+    # statefulsets: contiguous ordinals 0..replicas-1 once settled
+    for ss in hub.statefulsets.values():
+        ords = sorted(
+            int(p.name.rsplit("-", 1)[1])
+            for p in hub.truth_pods.values()
+            if p.labels.get("ss") == ss.name
+        )
+        assert ords == list(range(ss.replicas)), (ss.name, ords)
+    # cronjobs: history bounded; spawned jobs exist
+    for cj in hub.cronjobs.values():
+        done = [jn for jn in cj.spawned if hub.jobs[jn].done()]
+        assert len(done) <= cj.history_limit + 1
+        for jn in cj.spawned:
+            assert jn in hub.jobs
+    # hpa: deployment size within bounds
+    for hpa in hub.hpas.values():
+        d = hub.deployments.get(hpa.deployment)
+        if d is not None:
+            assert hpa.min_replicas <= d.replicas <= hpa.max_replicas
+
+
+def test_controller_fuzz_campaign():
+    for seed in range(N_SEEDS):
+        rng = random.Random(7000 + seed)
+        hub = build_random_cluster(rng, seed)
+        try:
+            for tick in range(14):
+                if tick == 5 and hasattr(hub, "_fuzz_util"):
+                    hub._fuzz_util["u"] = rng.choice([0.2, 0.9])
+                if tick == 7 and rng.random() < 0.5:
+                    hub.churn(kill_pods=rng.randrange(0, 4),
+                              flap_nodes=rng.randrange(0, 2))
+                if tick == 9 and rng.random() < 0.3 and hub.truth_nodes:
+                    victim = rng.choice(sorted(hub.truth_nodes))
+                    hub.kill_kubelet(victim)
+                hub.step(dt=15.0)
+            # settle: quiesce the control plane with no new disruptions
+            for _ in range(6):
+                hub.step(dt=15.0)
+            hub.check_consistency()
+            check_controller_invariants(hub)
+        except AssertionError as e:
+            raise AssertionError(f"seed {seed}: {e}") from e

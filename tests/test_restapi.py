@@ -265,7 +265,7 @@ def test_ktpu_mutation_verbs_over_rest(tmp_path, capsys):
         assert ktpu(["--api-server", api, "create", "-f", str(nf)]) == 0
         assert "n0" in hub.truth_nodes
         pf = tmp_path / "pod.json"
-        pf.write_text(json.dumps(make_pod_doc("web")))
+        pf.write_text(json.dumps({"kind": "Pod", **make_pod_doc("web")}))
         assert ktpu(["--api-server", api, "create", "-f", str(pf)]) == 0
         assert "default/web" in hub.truth_pods
         # duplicate create surfaces the AlreadyExists Status
@@ -281,8 +281,16 @@ def test_ktpu_mutation_verbs_over_rest(tmp_path, capsys):
         assert ktpu(["--api-server", api, "delete", "node", "n0"]) == 0
         assert not hub.truth_nodes
         assert ktpu(["--api-server", api, "delete", "node", "n0"]) == 1
+        # kind-less manifests are refused, never guessed into a Pod
+        kindless = tmp_path / "kindless.json"
+        kindless.write_text(json.dumps({"metadata": {"name": "n9"}}))
+        assert ktpu(["--api-server", api, "create", "-f", str(kindless)]) == 1
+        assert "default/n9" not in hub.truth_pods
+        # unreachable server: clean error, not a traceback
+        assert ktpu(["--api-server", "127.0.0.1:9", "cordon", "n0"]) == 1
         out = capsys.readouterr()
         assert "created" in out.out and "cordoned" in out.out
+        assert "missing 'kind'" in out.err and "cannot reach" in out.err
     finally:
         srv.close()
 
