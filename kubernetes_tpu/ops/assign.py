@@ -32,7 +32,11 @@ import jax
 import jax.numpy as jnp
 
 from kubernetes_tpu.ops.arrays import DeviceNodes, DevicePods, DeviceSelectors
-from kubernetes_tpu.ops.predicates import run_predicates, static_volume_reasons
+from kubernetes_tpu.ops.predicates import (
+    run_predicates,
+    static_predicate_reasons,
+    static_volume_reasons,
+)
 from kubernetes_tpu.ops.priorities import run_priorities
 
 NEG = -1e30
@@ -147,8 +151,10 @@ def _greedy_impl(pods, nodes, sel, topo, vol, weights_key, extra_mask,
     P = pods.req.shape[0]
     perm = queue_order(pods)
     u0 = usage_from_nodes(nodes)
+    # static predicate bits hoisted out of the scan; each step slices its row
+    static_bits, prog = static_predicate_reasons(pods, nodes, sel)
     if vol is not None and static_vol is None:
-        static_vol = static_volume_reasons(pods, nodes, sel, vol)
+        static_vol = static_volume_reasons(pods, nodes, sel, vol, prog=prog)
 
     def step(u, p):
         pod = _pod_slice(pods, p)
@@ -159,8 +165,10 @@ def _greedy_impl(pods, nodes, sel, topo, vol, weights_key, extra_mask,
             if static_vol is not None
             else None
         )
+        sb = jax.lax.dynamic_index_in_dim(static_bits, p, axis=0, keepdims=True)
         mask = (
-            run_predicates(pod, cur, sel, topo, vol, sv, enabled_mask).mask
+            run_predicates(pod, cur, sel, topo, vol, sv, enabled_mask,
+                           hoisted=(sb, prog)).mask
             & extra
         )  # (1, N)
         score = run_priorities(pod, cur, sel, mask, weights, topo)
@@ -234,8 +242,14 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
             + jnp.sum(pods.csi_mh, axis=1)
             > 0
         )
+    # usage-invariant predicate bits + selector program table, computed
+    # ONCE against the base nodes: the round loop below re-evaluates only
+    # the dynamic predicates (resources/ports/topology/volumes) against
+    # the usage-updated node view
+    hoisted = static_predicate_reasons(pods, nodes, sel)
     if vol is not None and static_vol is None:
-        static_vol = static_volume_reasons(pods, nodes, sel, vol)
+        static_vol = static_volume_reasons(pods, nodes, sel, vol,
+                                           prog=hoisted[1])
     if topo is not None:
         from kubernetes_tpu.ops.topology import sensitive_keys
 
@@ -251,7 +265,8 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
         cur = nodes_with_usage(nodes, u)
         active = (assigned == -1) & pods.valid
         mask = (
-            run_predicates(pods, cur, sel, topo, vol, static_vol, enabled_mask).mask
+            run_predicates(pods, cur, sel, topo, vol, static_vol,
+                           enabled_mask, hoisted=hoisted).mask
             & active[:, None]
             & extra_mask
         )

@@ -229,28 +229,21 @@ class FilterResult(NamedTuple):
     reasons: jnp.ndarray  # (P, N) int32 — failed-predicate bitmask
 
 
-def run_predicates(
+def static_predicate_reasons(
     pods: DevicePods,
     nodes: DeviceNodes,
     sel: DeviceSelectors,
-    topo: DeviceTopology | None = None,
-    vol=None,
-    static_reasons: jnp.ndarray | None = None,
-    enabled_mask=None,
-) -> FilterResult:
-    """The fused Filter pass: all predicates, all (pod, node) pairs.
+):
+    """Usage-invariant predicate bits plus the node-selector program match
+    table, as ``(reasons (P,N) int32, prog (G,N) bool)``.
 
-    Equivalent surface: findNodesThatFit (generic_scheduler.go:460) with the
-    default predicate set (algorithmprovider/defaults/defaults.go:40) plus
-    feature-gated EvenPodsSpread. ``topo=None`` skips the
-    inter-pod-affinity/spread passes and ``vol=None`` (a
-    :class:`~kubernetes_tpu.ops.arrays.DeviceVolumes`) the five volume
-    predicates — cheaper traces for workloads without such constraints.
-    ``enabled_mask`` (int bitmask over PREDICATE_BITS) selects the policy's
-    predicate set: disabled predicates' failure bits are cleared before the
-    feasibility mask forms (CreateFromConfig semantics, factory.go:356);
-    mandatory bits should already be included by the config layer.
-    """
+    Everything here reads only node fields :func:`nodes_with_usage` never
+    replaces — conditions, spec.unschedulable, pressure flags, taints,
+    hostname, and label membership — so the assignment round loops hoist
+    this once per batch and pass it back via ``run_predicates(hoisted=)``.
+    The device twin of the reference's per-cycle predicate-metadata
+    precomputation (metadata.go:152 GetMetadata: compute shared state
+    once, reuse across every node evaluation in the cycle)."""
     P, N = pods.req.shape[0], nodes.allocatable.shape[0]
     reasons = jnp.zeros((P, N), jnp.int32)
 
@@ -297,22 +290,55 @@ def run_predicates(
     )
     reasons |= jnp.where(host_fail, jnp.int32(1 << BIT["PodFitsHost"]), 0)
 
+    # PodMatchNodeSelector (predicates.go:904) via selector programs
+    prog = selector_program_match(sel, nodes)  # (G, N)
+    prog_idx = jnp.clip(pods.selprog_id, 0, prog.shape[0] - 1)
+    sel_ok = jnp.where((pods.selprog_id >= 0)[:, None], prog[prog_idx], True)
+    reasons |= jnp.where(~sel_ok, jnp.int32(1 << BIT["PodMatchNodeSelector"]), 0)
+    return reasons, prog
+
+
+def run_predicates(
+    pods: DevicePods,
+    nodes: DeviceNodes,
+    sel: DeviceSelectors,
+    topo: DeviceTopology | None = None,
+    vol=None,
+    static_reasons: jnp.ndarray | None = None,
+    enabled_mask=None,
+    hoisted=None,
+) -> FilterResult:
+    """The fused Filter pass: all predicates, all (pod, node) pairs.
+
+    Equivalent surface: findNodesThatFit (generic_scheduler.go:460) with the
+    default predicate set (algorithmprovider/defaults/defaults.go:40) plus
+    feature-gated EvenPodsSpread. ``topo=None`` skips the
+    inter-pod-affinity/spread passes and ``vol=None`` (a
+    :class:`~kubernetes_tpu.ops.arrays.DeviceVolumes`) the five volume
+    predicates — cheaper traces for workloads without such constraints.
+    ``enabled_mask`` (int bitmask over PREDICATE_BITS) selects the policy's
+    predicate set: disabled predicates' failure bits are cleared before the
+    feasibility mask forms (CreateFromConfig semantics, factory.go:356);
+    mandatory bits should already be included by the config layer.
+    ``hoisted`` takes :func:`static_predicate_reasons` output computed
+    once per batch against the BASE nodes; the usage-updated ``nodes``
+    passed per round then only feed the dynamic predicates.
+    """
+    if hoisted is None:
+        reasons, prog = static_predicate_reasons(pods, nodes, sel)
+    else:
+        reasons, prog = hoisted
+
     # PodFitsHostPorts (predicates.go:1084, host_ports.go conflict rules):
     # wildcard-IP pod ports conflict with any same-(proto,port) use; specific
     # -IP ports conflict with wildcard uses of (proto,port) or identical
-    # (proto,ip,port) uses.
+    # (proto,ip,port) uses. Usage-dependent: bound pods add port rows.
     conflicts = (
         pods.port_wild_pp @ nodes.port_any_mh.T
         + pods.port_spec_pp @ nodes.port_wild_mh.T
         + pods.port_spec_pip @ nodes.port_spec_mh.T
     )
     reasons |= jnp.where(conflicts > 0, jnp.int32(1 << BIT["PodFitsHostPorts"]), 0)
-
-    # PodMatchNodeSelector (predicates.go:904) via selector programs
-    prog = selector_program_match(sel, nodes)  # (G, N)
-    prog_idx = jnp.clip(pods.selprog_id, 0, prog.shape[0] - 1)
-    sel_ok = jnp.where((pods.selprog_id >= 0)[:, None], prog[prog_idx], True)
-    reasons |= jnp.where(~sel_ok, jnp.int32(1 << BIT["PodMatchNodeSelector"]), 0)
 
     if topo is not None:
         from kubernetes_tpu.ops.topology import (
@@ -406,7 +432,8 @@ def _dynamic_volume_reasons(
 
 
 def static_volume_reasons(
-    pods: DevicePods, nodes: DeviceNodes, sel: DeviceSelectors, vol
+    pods: DevicePods, nodes: DeviceNodes, sel: DeviceSelectors, vol,
+    prog: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Usage-independent volume predicates, computed once per scheduling
     cycle and ORed into every round's reasons via ``static_reasons``:
@@ -417,10 +444,15 @@ def static_volume_reasons(
       programs (rows reference this pod batch, so this must be evaluated
       against the same batch layout as ``pack_pods``).
     - VolumeError: unresolvable PVC/PV state fails the pod everywhere.
+
+    ``prog`` accepts the selector table from
+    :func:`static_predicate_reasons` so a cycle evaluates the (G, N)
+    program match once, not twice.
     """
     P, N = pods.req.shape[0], nodes.allocatable.shape[0]
     reasons = jnp.zeros((P, N), jnp.int32)
-    prog = selector_program_match(sel, nodes)  # (G, N)
+    if prog is None:
+        prog = selector_program_match(sel, nodes)  # (G, N)
 
     # ---- NoVolumeZoneConflict -------------------------------------------
     # row passes where the node carries an allowed (key, value) pair or has
