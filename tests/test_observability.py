@@ -1,0 +1,163 @@
+"""Observability-layer unit tests: the metrics registry's exposition and
+quantile math (pkg/scheduler/metrics + prometheus client semantics) and
+the klog-style leveled logger (vendor/k8s.io/klog V-gates)."""
+
+import logging
+
+import pytest
+
+from kubernetes_tpu import metrics as m
+from kubernetes_tpu.utils import klog
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+def test_exponential_buckets_shape():
+    # metrics.go:89 e2e_scheduling_duration_seconds: exp(0.001, x2, 15)
+    b = m.exponential_buckets(0.001, 2, 15)
+    assert len(b) == 15
+    assert b[0] == pytest.approx(0.001)
+    assert b[1] == pytest.approx(0.002)
+    assert b[-1] == pytest.approx(0.001 * 2**14)
+
+
+def test_counter_labels_and_exposition():
+    c = m.Counter("schedule_attempts_total", "h", ("result",))
+    c.inc(result="scheduled")
+    c.inc(2, result="error")
+    assert c.value(result="scheduled") == 1
+    assert c.value(result="error") == 2
+    # exact exposition lines: substring matching would let wrong values
+    # (20.0, 2.5) slip through
+    assert c.expose() == [
+        'schedule_attempts_total{result="error"} 2.0',
+        'schedule_attempts_total{result="scheduled"} 1.0',
+    ]
+
+
+def test_gauge_set_overwrites():
+    g = m.Gauge("pending_pods", "h")
+    g.set(7)
+    g.set(3)
+    assert g.value() == 3
+
+
+def test_histogram_buckets_cumulative_and_exposition():
+    h = m.Histogram("lat", "h", buckets=[1.0, 2.0, 4.0])
+    for v in (0.5, 1.5, 1.5, 3.0, 100.0):
+        h.observe(v)
+    # cumulative le counts: <=1: 1, <=2: 3, <=4: 4, +Inf: 5 — exact lines
+    assert h.expose() == [
+        'lat_bucket{le="1.0"} 1',
+        'lat_bucket{le="2.0"} 3',
+        'lat_bucket{le="4.0"} 4',
+        'lat_bucket{le="+Inf"} 5',
+        "lat_sum 106.5",
+        "lat_count 5",
+    ]
+
+
+def test_histogram_quantile_interpolation():
+    # histogram_quantile semantics: linear interpolation inside the first
+    # bucket whose cumulative count reaches q*n
+    h = m.Histogram("lat", "h", buckets=[1.0, 2.0, 4.0])
+    for _ in range(50):
+        h.observe(0.5)   # bucket <=1
+    for _ in range(50):
+        h.observe(1.5)   # bucket <=2
+    # p50 -> target 50 reached exactly at bucket 1.0 boundary
+    assert h.quantile(0.5) == pytest.approx(1.0)
+    # p75 -> target 75; bucket (1,2] holds ranks 51..100; frac=(75-50)/50
+    assert h.quantile(0.75) == pytest.approx(1.0 + 0.5 * 1.0)
+    # beyond the largest finite bucket: clamp to it
+    h2 = m.Histogram("x", "h", buckets=[1.0])
+    h2.observe(10.0)
+    assert h2.quantile(0.99) == 1.0
+    # empty histogram
+    assert m.Histogram("e", "h", buckets=[1.0]).quantile(0.9) == 0.0
+
+
+def test_summary_quantile_exact():
+    s = m.Summary("dur", "h")
+    for v in range(1, 101):
+        s.observe(float(v))
+    assert s.quantile(0.5) == pytest.approx(50.0, abs=1.0)
+    assert s.quantile(0.99) == pytest.approx(99.0, abs=1.0)
+
+
+def test_registry_exposes_all_kinds():
+    r = m.Registry()
+    c = m.Counter("a_total", "help a")
+    h = m.Histogram("b_seconds", "help b", buckets=[1.0])
+    r.register(c)
+    r.register(h)
+    c.inc()
+    h.observe(0.5)
+    lines = r.expose().splitlines()
+    assert "a_total 1.0" in lines
+    assert 'b_seconds_bucket{le="1.0"} 1' in lines
+    assert "# TYPE a_total counter" in lines
+    assert "# TYPE b_seconds histogram" in lines
+
+
+# ---------------------------------------------------------------------------
+# klog
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _reset_verbosity():
+    old = klog.verbosity()
+    yield
+    klog.set_verbosity(old)
+
+
+def test_v_gate_truthiness():
+    klog.set_verbosity(2)
+    assert bool(klog.V(1)) and bool(klog.V(2))
+    assert not bool(klog.V(3))
+    klog.set_verbosity(0)
+    assert not bool(klog.V(1))
+
+
+def test_v_info_respects_gate(caplog):
+    klog.set_verbosity(2)
+    with caplog.at_level(logging.DEBUG, logger="kubernetes_tpu"):
+        klog.V(2).info("visible %d", 42)
+        klog.V(5).info("hidden %d", 99)
+    messages = [r.getMessage() for r in caplog.records]
+    assert "visible 42" in messages
+    assert all("hidden" not in msg for msg in messages)
+
+
+def test_plain_levels_always_emit(caplog):
+    klog.set_verbosity(0)
+    with caplog.at_level(logging.INFO, logger="kubernetes_tpu"):
+        klog.info("i %s", "x")
+        klog.warning("w")
+        klog.error("e")
+    levels = [r.levelno for r in caplog.records]
+    assert logging.INFO in levels and logging.WARNING in levels \
+        and logging.ERROR in levels
+
+
+def test_v_gate_guards_expensive_formatting():
+    """The klog.V(n) idiom exists so disabled levels cost nothing: the
+    gate must be decidable without formatting the message."""
+    klog.set_verbosity(0)
+    gate = klog.V(10)
+    assert not gate
+    # the caller pattern: `if klog.V(10): klog.V(10).info(expensive())`
+    # never calls expensive(); the gate object itself must not format
+    calls = []
+
+    class Exploding:
+        def __str__(self):
+            calls.append(1)
+            return "boom"
+
+    gate.info("%s", Exploding())  # disabled: must not format
+    assert calls == []
