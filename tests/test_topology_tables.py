@@ -1,0 +1,244 @@
+"""Hand-computed scenario tables for the two hardest kernels (SURVEY §7.3
+rank 1): inter-pod affinity and topology spread. The absolute-value
+counterpart to tests/test_topology.py's differential fuzz — every
+expectation below is derived by hand from the reference semantics, then
+asserted against the device kernels AND the oracle.
+
+Sources: algorithm/predicates/predicates_test.go (TestInterPodAffinity,
+TestEvenPodsSpreadPredicate), algorithm/priorities/interpod_affinity.go:46
+(hard-affinity symmetry weight), even_pods_spread.go:86."""
+
+import numpy as np
+
+import pyref
+from kubernetes_tpu.api.types import (
+    Affinity,
+    LabelSelector,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from kubernetes_tpu.ops.predicates import BIT, run_predicates
+from kubernetes_tpu.ops.topology import inter_pod_affinity_score
+from kubernetes_tpu.testing import make_node, make_pod
+from test_topology import HOSTNAME, ZONE, build, by_node, oracle_mask, term
+
+
+def masks(nodes, scheduled, pending):
+    dn, dp, ds, dt = build(nodes, scheduled, pending)
+    res = run_predicates(dp, dn, ds, dt)
+    got = np.asarray(res.mask)[: len(pending), : len(nodes)]
+    want = oracle_mask(pending, nodes, by_node(nodes, scheduled))
+    assert (got == want).all(), "device/oracle divergence"
+    reasons = np.asarray(res.reasons)[: len(pending), : len(nodes)]
+    return got, reasons
+
+
+def zone_nodes():
+    # n0,n1 in z0; n2,n3 in z1
+    return [make_node(f"n{i}", labels={ZONE: f"z{i // 2}"}) for i in range(4)]
+
+
+# ---------------------------------------------------------------------------
+# inter-pod affinity filter tables (TestInterPodAffinity shapes)
+# ---------------------------------------------------------------------------
+
+
+def test_required_affinity_zone_scoped():
+    nodes = zone_nodes()
+    web = make_pod("web", node_name="n0", labels={"app": "web"})
+    wants_web = make_pod("p", affinity=Affinity(
+        pod_affinity_required=(term(ZONE, {"app": "web"}),)))
+    got, reasons = masks(nodes, [web], [wants_web])
+    assert list(got[0]) == [True, True, False, False]  # whole z0, never z1
+    assert reasons[0, 2] & (1 << BIT["MatchInterPodAffinity"])
+
+
+def test_required_anti_affinity_zone_vs_hostname_scope():
+    nodes = zone_nodes()
+    web = make_pod("web", node_name="n0", labels={"app": "web"})
+    avoid_zone = make_pod("pz", affinity=Affinity(
+        pod_anti_affinity_required=(term(ZONE, {"app": "web"}),)))
+    avoid_host = make_pod("ph", affinity=Affinity(
+        pod_anti_affinity_required=(term(HOSTNAME, {"app": "web"}),)))
+    got, _ = masks(nodes, [web], [avoid_zone, avoid_host])
+    assert list(got[0]) == [False, False, True, True]  # zone scope
+    assert list(got[1]) == [False, True, True, True]   # only the host
+
+
+def test_affinity_namespace_scoping():
+    """Empty namespaces = the POD's own namespace; explicit namespaces
+    select across (predicates.go metadata namespace sets)."""
+    nodes = zone_nodes()
+    other_web = make_pod("w", node_name="n0", labels={"app": "web"},
+                         namespace="other")
+    own_ns = make_pod("p0", affinity=Affinity(
+        pod_affinity_required=(term(ZONE, {"app": "web"}),)))
+    cross_ns = make_pod("p1", affinity=Affinity(
+        pod_affinity_required=(term(ZONE, {"app": "web"},
+                                    namespaces=("other",)),)))
+    got, _ = masks(nodes, [other_web], [own_ns, cross_ns])
+    # own-namespace selector finds no match anywhere (and the pod doesn't
+    # self-match app=web) -> infeasible everywhere
+    assert not got[0].any()
+    assert list(got[1]) == [True, True, False, False]
+
+
+def test_existing_pod_anti_affinity_symmetry_filters_incoming():
+    """Symmetry (satisfiesExistingPodsAntiAffinity, predicates.go:1424):
+    an incoming pod that MATCHES an existing pod's required anti-affinity
+    term is kept out of that pod's topology domain, even though the
+    incoming pod declares nothing itself."""
+    nodes = zone_nodes()
+    hermit = make_pod("hermit", node_name="n2", labels={"app": "db"},
+                      affinity=Affinity(pod_anti_affinity_required=(
+                          term(ZONE, {"app": "web"}),)))
+    incoming_web = make_pod("p0", labels={"app": "web"})
+    incoming_db = make_pod("p1", labels={"app": "db"})
+    got, _ = masks(nodes, [hermit], [incoming_web, incoming_db])
+    assert list(got[0]) == [True, True, False, False]  # z1 is hermit's zone
+    assert list(got[1]) == [True, True, True, True]    # db unaffected
+
+
+def test_hard_affinity_symmetry_scores_not_filters():
+    """interpod_affinity.go:159-175: an existing pod's REQUIRED affinity
+    term matching the incoming pod contributes hardPodAffinityWeight to
+    the score in that domain — it never filters.
+
+    Lazy-allocation subtlety (interpod_affinity.go:117-124): when the
+    incoming pod has NO affinity constraints of its own, pm.counts is
+    allocated only for nodes that carry affinity pods; at this reference
+    snapshot processTerm (:85) would nil-deref crediting an unallocated
+    domain-mate (a latent upstream bug, fixed post-snapshot). Kernel and
+    oracle implement the sane no-panic reading: unallocated nodes simply
+    receive no credit. Both cases pinned here."""
+    nodes = zone_nodes()
+    clingy = make_pod("clingy", node_name="n0", labels={"app": "db"},
+                      affinity=Affinity(pod_affinity_required=(
+                          term(ZONE, {"app": "web"}),)))
+
+    def run(incoming):
+        dn, dp, ds, dt = build(nodes, [clingy], [incoming])
+        mask = run_predicates(dp, dn, ds, dt).mask
+        assert np.asarray(mask)[:1, :4].all()  # never filters
+        score = np.asarray(inter_pod_affinity_score(dp, dn, dt, mask))[0, :4]
+        m = np.asarray(mask)[:1, :4]
+        want = pyref.interpod_affinity_scores(
+            [incoming], nodes, by_node(nodes, [clingy]), m)
+        assert [round(x, 4) for x in want[0]] == list(score)
+        return list(score)
+
+    # constraint-less incoming: only n0 (the node carrying the affinity
+    # pod) is allocated, so the credit reaches it alone
+    bare = make_pod("p0", labels={"app": "web"})
+    assert run(bare) == [10.0, 0.0, 0.0, 0.0]
+    # incoming WITH its own (irrelevant) preferred term: lazyInit
+    # allocates every node and the credit covers the whole z0 domain
+    chatty = make_pod("p1", labels={"app": "web"}, affinity=Affinity(
+        pod_affinity_preferred=(
+            WeightedPodAffinityTerm(1, term(ZONE, {"app": "nothing"})),)))
+    assert run(chatty) == [10.0, 10.0, 0.0, 0.0]
+
+
+def test_preferred_affinity_weights_and_normalization():
+    nodes = zone_nodes()
+    web = make_pod("web", node_name="n0", labels={"app": "web"})
+    db = make_pod("db", node_name="n2", labels={"app": "db"})
+    p = make_pod("p", affinity=Affinity(
+        pod_affinity_preferred=(
+            WeightedPodAffinityTerm(7, term(ZONE, {"app": "web"})),
+        ),
+        pod_anti_affinity_preferred=(
+            WeightedPodAffinityTerm(3, term(ZONE, {"app": "db"})),
+        ),
+    ))
+    dn, dp, ds, dt = build(nodes, [web, db], [p])
+    mask = run_predicates(dp, dn, ds, dt).mask
+    score = np.asarray(inter_pod_affinity_score(dp, dn, dt, mask))[0, :4]
+    # raw: z0 = +7, z1 = -3 -> normalized over [max=7, min=-3]: z0 -> 10,
+    # z1 -> 0 (NormalizeReduce maps min..max to 0..10)
+    assert list(score) == [10.0, 10.0, 0.0, 0.0]
+
+
+# ---------------------------------------------------------------------------
+# topology spread filter tables (TestEvenPodsSpreadPredicate shapes)
+# ---------------------------------------------------------------------------
+
+
+def spread(max_skew=1, key=ZONE, when="DoNotSchedule", labels=None):
+    return TopologySpreadConstraint(
+        max_skew=max_skew, topology_key=key, when_unsatisfiable=when,
+        label_selector=LabelSelector(match_labels=dict(labels or {"app": "web"})),
+    )
+
+
+def six_zone_nodes():
+    # z0: n0,n1; z1: n2,n3; z2: n4,n5
+    return [make_node(f"n{i}", labels={ZONE: f"z{i // 2}"}) for i in range(6)]
+
+
+def test_hard_spread_max_skew_boundary():
+    nodes = six_zone_nodes()
+    # matching counts: z0=2, z1=1, z2=0
+    existing = [
+        make_pod("e0", node_name="n0", labels={"app": "web"}),
+        make_pod("e1", node_name="n1", labels={"app": "web"}),
+        make_pod("e2", node_name="n2", labels={"app": "web"}),
+    ]
+    p = make_pod("p", labels={"app": "web"},
+                 topology_spread=(spread(max_skew=1),))
+    got, reasons = masks(nodes, existing, [p])
+    # skew after placing = count(zone)+1 - min(counts) ; min=0 (z2)
+    # z0: 3-0 > 1 no; z1: 2-0 > 1 no; z2: 1-0 <= 1 yes
+    assert list(got[0]) == [False, False, False, False, True, True]
+    assert reasons[0, 0] & (1 << BIT["EvenPodsSpread"])
+    # maxSkew=2 admits z1 as well
+    p2 = make_pod("p2", labels={"app": "web"},
+                  topology_spread=(spread(max_skew=2),))
+    got2, _ = masks(nodes, existing, [p2])
+    assert list(got2[0]) == [False, False, True, True, True, True]
+
+
+def test_soft_spread_never_filters():
+    nodes = six_zone_nodes()
+    existing = [make_pod("e0", node_name="n0", labels={"app": "web"})]
+    p = make_pod("p", labels={"app": "web"},
+                 topology_spread=(spread(when="ScheduleAnyway"),))
+    got, _ = masks(nodes, existing, [p])
+    assert got[0].all()
+
+
+def test_spread_selector_mismatch_counts_nothing():
+    nodes = six_zone_nodes()
+    existing = [make_pod("e0", node_name="n0", labels={"app": "db"})]
+    p = make_pod("p", labels={"app": "web"},
+                 topology_spread=(spread(),))
+    got, _ = masks(nodes, existing, [p])
+    assert got[0].all()  # db pods don't count toward the web constraint
+
+
+def test_spread_node_missing_topology_key_infeasible():
+    # predicates.go:1755: a node without the constraint's key cannot
+    # satisfy a DoNotSchedule constraint
+    nodes = six_zone_nodes() + [make_node("bare")]  # no zone label
+    p = make_pod("p", labels={"app": "web"},
+                 topology_spread=(spread(),))
+    got, _ = masks(nodes, [], [p])
+    assert got[0, :6].all() and not got[0, 6]
+
+
+def test_two_constraints_are_anded():
+    # zone constraint pushes to z2; hostname constraint (maxSkew=1) rules
+    # out n4 where a matching pod already runs
+    nodes = six_zone_nodes()
+    existing = [
+        make_pod("e0", node_name="n0", labels={"app": "web"}),
+        make_pod("e1", node_name="n2", labels={"app": "web"}),
+        make_pod("e2", node_name="n4", labels={"app": "web"}),
+    ]
+    # zone counts 1,1,1 -> any zone ok at maxSkew=1 (2-1<=1)
+    # hostname counts: n0=1,n2=1,n4=1 others 0, min=0 -> occupied hosts
+    # would reach skew 2 > 1
+    p = make_pod("p", labels={"app": "web"},
+                 topology_spread=(spread(), spread(key=HOSTNAME)))
+    got, _ = masks(nodes, existing, [p])
+    assert list(got[0]) == [False, True, False, True, False, True]
