@@ -294,6 +294,10 @@ class Pod:
     #: (plugin/pkg/admission/priority/admission.go); the scheduler itself
     #: only ever reads the resolved integer.
     priority_class_name: str = ""
+    #: spec.schedulerName — which scheduler is responsible for this pod
+    #: (eventhandlers.go:328 responsibleForPod; the multi-scheduler seam,
+    #: test/integration/scheduler TestMultipleSchedulers)
+    scheduler_name: str = "default-scheduler"
     requests: Resources = field(default_factory=Resources)
     host_ports: Tuple[Tuple[str, str, int], ...] = ()  # (protocol, hostIP, port)
     topology_spread: Tuple[TopologySpreadConstraint, ...] = ()

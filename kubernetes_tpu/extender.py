@@ -44,6 +44,7 @@ def pod_to_json(pod: Pod) -> dict:
             "nodeName": pod.node_name,
             "nodeSelector": dict(pod.node_selector),
             "priority": pod.priority,
+            "schedulerName": pod.scheduler_name,
             "preemptionPolicy": pod.preemption_policy,
             "containers": [
                 {

@@ -149,11 +149,19 @@ class Scheduler:
         trace_threshold_s: float = 1.0,
         percentage_of_nodes_to_score: Optional[int] = None,
         volume_binder=None,
+        scheduler_name: str = "default-scheduler",
     ) -> None:
         from kubernetes_tpu.framework import Framework
         from kubernetes_tpu.metrics import SchedulerMetrics
         from kubernetes_tpu.nodetree import NodeTree
 
+        #: which pods this scheduler is responsible for
+        #: (eventhandlers.go:328 responsibleForPod — the multi-scheduler
+        #: seam): unassigned pods naming another scheduler never enter the
+        #: queue; assigned pods ALWAYS enter the cache, whoever bound them,
+        #: because their capacity is consumed either way (the reference's
+        #: assigned-pod informer carries no scheduler-name filter)
+        self.scheduler_name = scheduler_name
         self.framework = framework or Framework(clock=clock)
         #: HTTPExtender list (core/extender.go), called after the built-in
         #: filter/score passes for interested pods
@@ -238,6 +246,7 @@ class Scheduler:
         kw.setdefault("per_node_cap", cfg.per_node_cap)
         kw.setdefault("max_rounds", cfg.max_rounds)
         kw.setdefault("max_batch", cfg.max_batch)
+        kw.setdefault("scheduler_name", cfg.scheduler_name)
         # 100 (the config default) = no truncation; 0 = the reference's
         # adaptive rule; 1-99 fixed — passed through verbatim so the
         # adaptive mode stays expressible from config
@@ -252,13 +261,20 @@ class Scheduler:
     # -- ingestion (AddAllEventHandlers analog; the informer pump or test
     # drives these) --------------------------------------------------------
 
+    def responsible_for(self, pod: Pod) -> bool:
+        """eventhandlers.go:328 responsibleForPod: spec.schedulerName must
+        name THIS scheduler for its unassigned pods to be queued here."""
+        return pod.scheduler_name == self.scheduler_name
+
     def on_pod_add(self, pod: Pod) -> None:
-        """eventhandlers.go:215/:256 — unassigned pods queue for scheduling;
-        assigned pods enter the cache and may unblock affinity waiters."""
+        """eventhandlers.go:215/:256 — unassigned pods queue for scheduling
+        (only this scheduler's, per the informer FilterFunc); assigned pods
+        enter the cache whoever bound them, and may unblock affinity
+        waiters."""
         if pod.node_name:
             self.cache.add_pod(pod)
             self.queue.assigned_pod_added(pod)
-        else:
+        elif self.responsible_for(pod):
             self.queue.add(pod)
 
     def on_pod_update(self, old: Pod, new: Pod) -> None:
@@ -291,8 +307,15 @@ class Scheduler:
             # AssignedPodUpdated: wake only affinity-matching waiters, not
             # the whole unschedulableQ (eventhandlers.go)
             self.queue.assigned_pod_added(new)
-        else:
+        elif self.responsible_for(new):
             self.queue.update(old.key(), new)
+        elif self.responsible_for(old):
+            # responsible -> not-responsible transition: the reference's
+            # FilteringResourceEventHandler turns this into a Delete, so
+            # the stale spec must leave our queues (schedulerName is
+            # immutable in the real API, but this ingestion surface takes
+            # arbitrary updates)
+            self.queue.delete(old.key())
 
     def on_pod_delete(self, pod: Pod) -> None:
         key = pod.key()

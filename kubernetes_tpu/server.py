@@ -57,6 +57,7 @@ def pod_from_json(d: dict) -> Pod:
         node_name=spec.get("nodeName", ""),
         node_selector=dict(spec.get("nodeSelector") or {}),
         priority=int(spec.get("priority") or 0),
+        scheduler_name=spec.get("schedulerName") or "default-scheduler",
         requests=requests,
         nominated_node_name=(d.get("status") or {}).get("nominatedNodeName", ""),
         preemption_policy=spec.get("preemptionPolicy")

@@ -1000,3 +1000,88 @@ def test_cronjob_never_overwrites_foreign_job():
     cj = hub.cronjobs["tick"]
     assert "tick-1" not in cj.spawned and cj.spawned[0] == "tick-2"
     hub.check_consistency()
+
+
+def test_multiple_schedulers_split_responsibility():
+    """TestMultipleSchedulers analog (test/integration/scheduler,
+    eventhandlers.go:328 responsibleForPod): a pod naming a different
+    scheduler is invisible to the default scheduler's queue but its
+    BOUND form still consumes capacity in every scheduler's cache."""
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.sim import HollowCluster, Reflector
+
+    hub = HollowCluster(seed=61, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=4000))
+    hub.create_pod(make_pod("mine", cpu_milli=500))
+    foreign = make_pod("theirs", cpu_milli=3000)
+    foreign.scheduler_name = "custom-scheduler"
+    hub.create_pod(foreign)
+
+    hub.step()
+    hub.settle()
+    # default scheduler bound only its own pod; the foreign one pends
+    assert hub.truth_pods["default/mine"].node_name == "n0"
+    assert hub.truth_pods["default/theirs"].node_name == ""
+    assert hub.pending_count() == 1
+
+    # the custom scheduler, fed through a Reflector, picks it up
+    custom = Scheduler(clock=hub.clock, enable_preemption=False,
+                       scheduler_name="custom-scheduler",
+                       binder=hub.binder)
+    r = Reflector(hub, custom)
+    r.list_and_watch()
+    res = custom.schedule_cycle()
+    assert res.scheduled == 1
+    hub.settle()
+    assert hub.truth_pods["default/theirs"].node_name == "n0"
+    hub.check_consistency()
+
+    # capacity accounting: the foreign BOUND pod (3000m) now crowds out
+    # the default scheduler — a 2000m pod of its own cannot fit
+    r.pump()
+    hub.create_pod(make_pod("mine2", cpu_milli=2000))
+    hub.step()
+    hub.settle()
+    assert hub.truth_pods["default/mine2"].node_name == ""
+    # while a 500m pod still fits beside it
+    hub.create_pod(make_pod("mine3", cpu_milli=500))
+    hub.step()
+    hub.settle()
+    assert hub.truth_pods["default/mine3"].node_name == "n0"
+    hub.check_consistency()
+
+
+def test_foreign_pod_update_stays_out_of_queue():
+    from kubernetes_tpu.scheduler import Scheduler
+
+    s = Scheduler(enable_preemption=False)
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    p = make_pod("x", cpu_milli=100)
+    p.scheduler_name = "other"
+    s.on_pod_add(p)
+    import dataclasses
+    s.on_pod_update(p, dataclasses.replace(p, labels={"a": "b"}))
+    res = s.schedule_cycle()
+    assert res.attempted == 0 and res.assignments == {}
+
+
+def test_responsibility_handover_dequeues():
+    """Regression (r3 review): an update that moves a queued pod to a
+    different schedulerName must dequeue it here (the reference's
+    FilteringResourceEventHandler emits a Delete on the transition)."""
+    import dataclasses
+
+    from kubernetes_tpu.scheduler import Scheduler
+
+    s = Scheduler(enable_preemption=False)
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    p = make_pod("x", cpu_milli=100)
+    s.on_pod_add(p)
+    s.on_pod_update(p, dataclasses.replace(p, scheduler_name="other"))
+    res = s.schedule_cycle()
+    assert res.attempted == 0 and res.assignments == {}
+    # and the reverse handover queues it
+    q = dataclasses.replace(p, scheduler_name="other")
+    s.on_pod_update(q, p)
+    res = s.schedule_cycle()
+    assert res.scheduled == 1
