@@ -30,8 +30,10 @@ can switch on reason/code.
 
 from __future__ import annotations
 
+import collections
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -40,6 +42,51 @@ from kubernetes_tpu.extender import node_to_json, pod_to_json
 from kubernetes_tpu.grpc_shim import node_from_json
 from kubernetes_tpu.server import pod_from_json
 from kubernetes_tpu.sim import Compacted, Conflict, HollowCluster
+
+
+class AuditLog:
+    """Request-level audit trail — the apiserver audit subsystem's shape
+    (staging/src/k8s.io/apiserver/pkg/audit: policy level, one event per
+    request at ResponseComplete) over this facade.
+
+    Levels mirror audit.Level: ``"None"`` drops everything, ``"Metadata"``
+    records verb/resource/code/latency, ``"Request"`` additionally keeps
+    the request body. Entries land in a bounded ring (the in-memory
+    backend) and optionally stream to ``sink`` (the log-backend seam —
+    a callable per JSON-able entry dict)."""
+
+    def __init__(self, level: str = "Metadata", capacity: int = 1024,
+                 sink=None) -> None:
+        if level not in ("None", "Metadata", "Request"):
+            raise ValueError(f"unknown audit level {level!r}")
+        self.level = level
+        self.capacity = capacity
+        self.sink = sink
+        self.entries: "collections.deque" = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, verb: str, path: str, code: int, latency_s: float,
+               body=None) -> None:
+        if self.level == "None":
+            return
+        entry = {
+            "stage": "ResponseComplete",
+            "verb": verb,
+            "requestURI": path,
+            "code": code,
+            "latency_s": round(latency_s, 6),
+        }
+        if self.level == "Request" and body is not None:
+            entry["requestObject"] = body
+        with self._lock:
+            self.entries.append(entry)
+        if self.sink is not None:
+            try:
+                self.sink(entry)
+            except Exception:
+                # a failing log backend must never fail (or noise up) the
+                # request it audits; the ring entry is already stored
+                pass
 
 
 def status_doc(code: int, reason: str, message: str) -> dict:
@@ -71,8 +118,9 @@ class RestServer:
     WATCH_WINDOW = 2000
 
     def __init__(self, hub: HollowCluster, host: str = "127.0.0.1",
-                 port: int = 0) -> None:
+                 port: int = 0, audit: "AuditLog | None" = None) -> None:
         self.hub = hub
+        self.audit = audit
         # the anchor cursor pins the hub's auto-compaction floor so that
         # stateless HTTP watchers (transient cursors) can resume from an
         # rv they saw in an earlier poll; _trim (run on every request)
@@ -88,6 +136,7 @@ class RestServer:
                 pass
 
             def _respond(self, code: int, doc) -> None:
+                self._code = code  # for the audit trail
                 body = json.dumps(doc).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -99,23 +148,39 @@ class RestServer:
                 self._respond(code, status_doc(code, reason, message))
 
             def do_GET(self):
-                outer._trim()
-                outer._get(self)
+                outer._begin(self)
+                t0 = time.perf_counter()
+                try:
+                    outer._get(self)
+                finally:
+                    outer._record_audit(self, "get", t0)
 
             def do_POST(self):
-                outer._trim()
-                with outer._lock:
-                    outer._post(self)
+                outer._begin(self)
+                t0 = time.perf_counter()
+                try:
+                    with outer._lock:
+                        outer._post(self)
+                finally:
+                    outer._record_audit(self, "create", t0)
 
             def do_PUT(self):
-                outer._trim()
-                with outer._lock:
-                    outer._put(self)
+                outer._begin(self)
+                t0 = time.perf_counter()
+                try:
+                    with outer._lock:
+                        outer._put(self)
+                finally:
+                    outer._record_audit(self, "update", t0)
 
             def do_DELETE(self):
-                outer._trim()
-                with outer._lock:
-                    outer._delete(self)
+                outer._begin(self)
+                t0 = time.perf_counter()
+                try:
+                    with outer._lock:
+                        outer._delete(self)
+                finally:
+                    outer._record_audit(self, "delete", t0)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_port
@@ -132,6 +197,33 @@ class RestServer:
         revisions of history alive regardless of request mix."""
         self._anchor.rev = max(self._anchor.rev,
                                self.hub._revision - self.WATCH_WINDOW)
+
+    def _begin(self, h) -> None:
+        """Per-request entry: trim history and clear per-request handler
+        state — on a keep-alive connection the handler INSTANCE is reused,
+        so stale _code/_audit_body from the previous request would be
+        audited for the next one."""
+        self._trim()
+        h._code = 0
+        h._audit_body = None
+
+    def _record_audit(self, h, verb: str, t0: float) -> None:
+        if self.audit is None:
+            return
+        path = h.path
+        if verb == "get":
+            # apiserver verb resolution: collection reads are list, the
+            # watch prefix is watch (request.go RequestInfo). Resolve on
+            # the LAST path segment — a node legally named "gpu-nodes"
+            # must not turn its single-object get into a list
+            parts = [p for p in path.split("?", 1)[0].split("/") if p]
+            if "watch" in parts:
+                verb = "watch"
+            elif parts and parts[-1] in ("pods", "nodes"):
+                verb = "list"
+        self.audit.record(verb, path, getattr(h, "_code", 0),
+                          time.perf_counter() - t0,
+                          body=getattr(h, "_audit_body", None))
 
     def close(self) -> None:
         self._httpd.shutdown()
@@ -160,6 +252,7 @@ class RestServer:
         if not isinstance(doc, dict):
             h._fail(400, "BadRequest", "request body must be a JSON object")
             return None
+        h._audit_body = doc  # Request-level audit keeps the object
         return doc
 
     # -- GET ----------------------------------------------------------------
@@ -250,6 +343,7 @@ class RestServer:
                 doc.setdefault("metadata", {})["resourceVersion"] = str(rev)
             lines.append(json.dumps({"type": etype, "object": doc}))
         body = ("\n".join(lines) + ("\n" if lines else "")).encode()
+        h._code = 200  # streamed response bypasses _respond
         h.send_response(200)
         h.send_header("Content-Type", "application/json;stream=watch")
         h.send_header("Content-Length", str(len(body)))

@@ -320,3 +320,58 @@ def test_node_json_round_trip_lossless():
         doc["metadata"]["annotations"] = {
             "scheduler.alpha.kubernetes.io/preferAvoidPods": bad}
         assert node_from_json(doc).prefer_avoid_owner_uids == ()
+
+
+def test_audit_log_records_requests():
+    """apiserver audit analog: one ResponseComplete entry per request,
+    verbs resolved like RequestInfo (get/list/watch/create/update/delete),
+    Request level keeping the body, bounded ring, sink streaming."""
+    from kubernetes_tpu.restapi import AuditLog
+
+    streamed = []
+    audit = AuditLog(level="Request", capacity=4, sink=streamed.append)
+    hub = HollowCluster(seed=71, scheduler_kw={"enable_preemption": False})
+    srv = RestServer(hub, audit=audit)
+    port = srv.serve()
+    try:
+        req(port, "POST", "/api/v1/nodes", NODE)
+        req(port, "GET", "/api/v1/nodes")
+        req(port, "GET", "/api/v1/nodes/n0")
+        req(port, "GET", "/api/v1/watch/pods?resourceVersion=0")
+        req(port, "DELETE", "/api/v1/nodes/n0")
+        # ResponseComplete is recorded after the body is written, so the
+        # client can observe the response before the entry lands — wait
+        import time
+        deadline = time.monotonic() + 5
+        while len(streamed) < 5 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # entries can land slightly out of request order (recorded after
+        # the response is written), so compare as a multiset
+        verbs = sorted((e["verb"], e["code"]) for e in streamed)
+        assert verbs == sorted([("create", 201), ("list", 200),
+                                ("get", 200), ("watch", 200),
+                                ("delete", 200)])
+        create = next(e for e in streamed
+                      if e.get("requestObject", {}).get("metadata", {})
+                      .get("name") == "n0")
+        assert create["verb"] == "create"
+        assert create["stage"] == "ResponseComplete"
+        assert all(e["latency_s"] >= 0 for e in streamed)
+        # ring bounded at capacity (5 requests, cap 4)
+        assert len(audit.entries) == 4
+    finally:
+        srv.close()
+
+
+def test_audit_levels():
+    from kubernetes_tpu.restapi import AuditLog
+
+    meta = AuditLog(level="Metadata")
+    meta.record("create", "/x", 201, 0.01, body={"secret": 1})
+    assert "requestObject" not in meta.entries[0]
+    none = AuditLog(level="None")
+    none.record("create", "/x", 201, 0.01)
+    assert len(none.entries) == 0
+    import pytest
+    with pytest.raises(ValueError):
+        AuditLog(level="Panic")
