@@ -212,14 +212,17 @@ class RestServer:
             return
         path = h.path
         if verb == "get":
-            # apiserver verb resolution: collection reads are list, the
-            # watch prefix is watch (request.go RequestInfo). Resolve on
-            # the LAST path segment — a node legally named "gpu-nodes"
-            # must not turn its single-object get into a list
-            parts = [p for p in path.split("?", 1)[0].split("/") if p]
-            if "watch" in parts:
+            # apiserver verb resolution (request.go RequestInfo) is
+            # POSITIONAL: "watch" only as the segment right after the
+            # version prefix, "list" only for exact collection routes —
+            # substring checks would misread a namespace or node that
+            # happens to be NAMED watch/pods/nodes
+            seg = self._route(path.split("?", 1)[0]) or []
+            if seg[:1] == ["watch"]:
                 verb = "watch"
-            elif parts and parts[-1] in ("pods", "nodes"):
+            elif seg in (["pods"], ["nodes"]) or (
+                    len(seg) == 3 and seg[0] == "namespaces"
+                    and seg[2] == "pods"):
                 verb = "list"
         self.audit.record(verb, path, getattr(h, "_code", 0),
                           time.perf_counter() - t0,

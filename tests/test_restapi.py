@@ -375,3 +375,32 @@ def test_audit_levels():
     import pytest
     with pytest.raises(ValueError):
         AuditLog(level="Panic")
+
+
+def test_audit_verb_resolution_is_positional():
+    """Regression (r3 review): a node literally named 'watch' or 'pods'
+    must audit as get, and /namespaces/watch/pods as list — RequestInfo
+    resolution is positional, never substring."""
+    from kubernetes_tpu.restapi import AuditLog
+
+    streamed = []
+    audit = AuditLog(sink=streamed.append)
+    hub = HollowCluster(seed=72, scheduler_kw={"enable_preemption": False})
+    srv = RestServer(hub, audit=audit)
+    port = srv.serve()
+    try:
+        weird = dict(NODE); weird["metadata"] = {"name": "watch"}
+        req(port, "POST", "/api/v1/nodes", weird)
+        req(port, "GET", "/api/v1/nodes/watch")          # get, not watch
+        req(port, "GET", "/api/v1/namespaces/watch/pods")  # list
+        req(port, "GET", "/api/v1/watch/pods?resourceVersion=0")  # watch
+        import time
+        t0 = time.monotonic()
+        while len(streamed) < 4 and time.monotonic() - t0 < 5:
+            time.sleep(0.01)
+        by_uri = {e["requestURI"].split("?")[0]: e["verb"] for e in streamed}
+        assert by_uri["/api/v1/nodes/watch"] == "get"
+        assert by_uri["/api/v1/namespaces/watch/pods"] == "list"
+        assert by_uri["/api/v1/watch/pods"] == "watch"
+    finally:
+        srv.close()
