@@ -26,4 +26,17 @@ plugin-by-plugin.
 
 __version__ = "0.1.0"
 
+
+def version_info() -> dict:
+    """pkg/version analog (version/base.go Get()): the version document
+    every component exposes via --version and /version."""
+    import platform as _platform
+
+    return {
+        "gitVersion": f"v{__version__}",
+        "compatibleReference": "kubernetes v1.16 (scheduler capability set)",
+        "platform": f"{_platform.system().lower()}/{_platform.machine()}",
+        "pythonVersion": _platform.python_version(),
+    }
+
 from kubernetes_tpu.api import types as api_types  # noqa: F401

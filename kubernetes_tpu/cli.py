@@ -205,6 +205,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="log verbosity (klog --v analog; KTPU_V env)")
     p.add_argument("--validate-only", action="store_true",
                    help="decode + validate, print result, exit")
+    p.add_argument("--version", action="store_true",
+                   help="print version info and exit (pkg/version analog)")
     p.add_argument("--cycle-interval", type=float, default=0.25,
                    help="seconds between scheduling cycles when idle")
     return p
@@ -292,6 +294,11 @@ def run(cfg: KubeSchedulerConfiguration, args, stop_event=None) -> None:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.version:
+        from kubernetes_tpu import version_info
+
+        print(json.dumps(version_info()))
+        return 0
     if args.v is not None:
         from kubernetes_tpu.utils.klog import set_verbosity
 

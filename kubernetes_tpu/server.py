@@ -180,6 +180,11 @@ def serve_scheduler(
             elif self.path == "/metrics":
                 body = sched.metrics.registry.expose().encode()
                 self._respond(200, body, "text/plain; version=0.0.4")
+            elif self.path == "/version":
+                from kubernetes_tpu import version_info
+
+                self._respond(200, json.dumps(version_info()).encode(),
+                              "application/json")
             else:
                 self._respond(404, b"not found", "text/plain")
 

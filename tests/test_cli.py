@@ -174,3 +174,35 @@ def test_cli_boots_server_from_config_file(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait()
+
+
+def test_version_flag_and_endpoint():
+    """pkg/version analog: --version prints the version document; the
+    serving mux exposes /version like every reference component."""
+    import json as _json
+
+    from kubernetes_tpu.cli import main
+
+    import io, contextlib
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert main(["--version"]) == 0
+    doc = _json.loads(buf.getvalue())
+    assert doc["gitVersion"].startswith("v0.")
+    assert "compatibleReference" in doc
+
+    import http.client
+
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.server import serve_scheduler
+
+    srv = serve_scheduler(Scheduler(enable_preemption=False), port=0)
+    try:
+        conn = http.client.HTTPConnection(*srv.server_address, timeout=10)
+        conn.request("GET", "/version")
+        r = conn.getresponse()
+        doc2 = _json.loads(r.read())
+        conn.close()
+        assert r.status == 200 and doc2 == doc
+    finally:
+        srv.shutdown()
