@@ -138,8 +138,6 @@ def test_long_soak_mixed_control_plane():
     oracle checked at intervals, not just at the end. Catches slow
     drifts (leaked queue entries, usage creep, history growth) that
     short scenario tests cannot."""
-    import random
-
     rng = random.Random(424242)
     hub = HollowCluster(
         seed=424242, bind_fail_rate=0.03, event_delay_ticks=1,
