@@ -1,0 +1,142 @@
+"""Real-TPU compiled-mode solver tests — the hardware half of the CPU
+suite's coverage. Skip everywhere but a live TPU backend (see
+test_sinkhorn_compiled.py for why these live outside tests/).
+
+Run manually when the shared chip is healthy:
+
+    python -m pytest tests_tpu/ -q
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu", reason="requires a real TPU backend"
+)
+
+
+def build(nodes, existing, pending, pad_to=None):
+    from kubernetes_tpu.ops.arrays import (
+        nodes_to_device,
+        pods_to_device,
+        selectors_to_device,
+    )
+    from kubernetes_tpu.snapshot import SnapshotPacker
+
+    pk = SnapshotPacker()
+    for p in list(existing) + list(pending):
+        pk.intern_pod(p)
+    dn = nodes_to_device(pk.pack_nodes(nodes, existing))
+    dp = pods_to_device(pk.pack_pods(pending), pad_to=pad_to)
+    ds = selectors_to_device(pk.pack_selector_tables())
+    return dn, dp, ds
+
+
+def test_predicates_compiled_matches_oracle():
+    """The fused Filter pass on hardware agrees with the oracle at a
+    mixed-constraint shape (taints, selectors, ports, pressure)."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    import pyref
+    from kubernetes_tpu.ops.predicates import run_predicates
+    from test_predicates import oracle_mask, random_cluster
+
+    import random
+
+    rng = random.Random(7)
+    nodes, scheduled, pending = random_cluster(rng, n_nodes=64, n_sched=80,
+                                               n_pending=48)
+    dn, dp, ds = build(nodes, scheduled, pending)
+    got = np.asarray(run_predicates(dp, dn, ds).mask)[: len(pending),
+                                                     : len(nodes)]
+    want = oracle_mask(nodes, scheduled, pending)
+    assert (got == want).all()
+
+
+def test_batch_assign_compiled_base_shape():
+    """The round solver at a bench-like shape: everything places, the
+    result obeys capacity, and a repeat run hits the compile cache."""
+    import time
+
+    from kubernetes_tpu.models.cluster import make_nodes, make_pods
+    from kubernetes_tpu.ops.assign import batch_assign
+
+    nodes = make_nodes(1000, zones=10)
+    pending = make_pods(4096)
+    dn, dp, ds = build(nodes, [], pending)
+    t0 = time.perf_counter()
+    assigned, usage, rounds = batch_assign(dp, dn, ds, per_node_cap=8)
+    a = np.asarray(assigned)[: len(pending)]
+    first = time.perf_counter() - t0
+    assert (a >= 0).all()
+    # capacity honored at the final usage state
+    req = np.asarray(usage.requested)
+    alloc = np.asarray(dn.allocatable)
+    assert (req <= alloc + 1e-3).all()
+    # warm path: same shapes must not recompile (cache hit = far faster)
+    t0 = time.perf_counter()
+    assigned2, _, _ = batch_assign(dp, dn, ds, per_node_cap=8)
+    jax.block_until_ready(assigned2)
+    warm = time.perf_counter() - t0
+    assert warm < max(1.0, first / 5)
+
+
+def test_greedy_matches_batch_cap1_on_uniform_workload():
+    """Serial-parity greedy and cap=1 rounds agree on placement count and
+    aggregate usage for a uniform workload on hardware."""
+    from kubernetes_tpu.models.cluster import make_nodes, make_pods
+    from kubernetes_tpu.ops.assign import batch_assign, greedy_assign
+
+    nodes = make_nodes(128, zones=4)
+    pending = make_pods(512)
+    dn, dp, ds = build(nodes, [], pending)
+    g, gu = greedy_assign(dp, dn, ds)
+    b, bu, _ = batch_assign(dp, dn, ds, per_node_cap=1)
+    ga = np.asarray(g)[: len(pending)]
+    ba = np.asarray(b)[: len(pending)]
+    assert (ga >= 0).sum() == (ba >= 0).sum() == len(pending)
+    assert np.allclose(np.asarray(gu.requested).sum(axis=0),
+                       np.asarray(bu.requested).sum(axis=0), atol=1e-3)
+
+
+def test_topology_kernels_compiled():
+    """Inter-pod affinity + spread on hardware: the in-batch anti-affinity
+    guard holds (2N pods with self anti-affinity over N nodes place
+    exactly N, all distinct)."""
+    from kubernetes_tpu.api.types import Affinity, LabelSelector, PodAffinityTerm
+    from kubernetes_tpu.ops.arrays import topology_to_device
+    from kubernetes_tpu.ops.assign import batch_assign
+    from kubernetes_tpu.snapshot import SnapshotPacker
+    from kubernetes_tpu.ops.arrays import (
+        nodes_to_device,
+        pods_to_device,
+        selectors_to_device,
+    )
+    from kubernetes_tpu.testing import make_node, make_pod
+
+    N = 32
+    nodes = [make_node(f"n{i}") for i in range(N)]
+    term = PodAffinityTerm(
+        label_selector=LabelSelector(match_labels={"app": "solo"}),
+        topology_key="kubernetes.io/hostname",
+    )
+    pending = [
+        make_pod(f"p{i}", labels={"app": "solo"},
+                 affinity=Affinity(pod_anti_affinity_required=(term,)))
+        for i in range(2 * N)
+    ]
+    pk = SnapshotPacker()
+    for p in pending:
+        pk.intern_pod(p)
+    dn = nodes_to_device(pk.pack_nodes(nodes, []))
+    dp = pods_to_device(pk.pack_pods(pending), pad_to=128)
+    ds = selectors_to_device(pk.pack_selector_tables())
+    dt = topology_to_device(pk.pack_topology_tables())
+    assigned, _, _ = batch_assign(dp, dn, ds, topo=dt, per_node_cap=8)
+    a = np.asarray(assigned)[: len(pending)]
+    placed = a[a >= 0]
+    assert len(placed) == N
+    assert len(set(placed.tolist())) == N  # all distinct hosts
