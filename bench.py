@@ -272,6 +272,7 @@ class ShardedWorkload:
         self._mesh = mesh
         self._replicate = replicate
         self.pending = w.pending
+        self.skip_prio = w.skip_prio
         self.dn = shard_nodes(w.dn, mesh)
         self.ds = replicate(w.ds, mesh)
         self.dt = replicate(w.dt, mesh) if w.dt is not None else None
@@ -305,10 +306,16 @@ class Workload:
         for p in list(existing) + list(pending):
             pk.intern_pod(p)
         self.pk = pk
-        self.dn = nodes_to_device(pk.pack_nodes(nodes, existing))
+        nt = pk.pack_nodes(nodes, existing)
+        self.dn = nodes_to_device(nt)
         self.ds = selectors_to_device(pk.pack_selector_tables())
         tt = pk.pack_topology_tables()
         self.dt = topology_to_device(tt) if tt.n_pairs else None
+        # host-side feature gate over the WHOLE pending set (each batch is
+        # a subset, so absence over all pending implies absence per batch)
+        from kubernetes_tpu.ops.priorities import empty_priorities
+
+        self.skip_prio = empty_priorities(nt, pk.pack_pods(pending))
         self.has_vol = bool(pvcs or pvs) or any(p.volumes for p in pending)
         self._volumes_to_device = volumes_to_device
         self._pods_to_device = pods_to_device
@@ -348,7 +355,8 @@ def run_batched(w: Workload, batch: int, cap: int, use_sinkhorn: bool = False,
     # warmup compile on the first batch shape (excluded from timing)
     dp0, dv0 = w.device_batch(pending[:batch], batch)
     a, u, r = batch_assign(dp0, w.dn, w.ds, topo=w.dt, vol=dv0,
-                           per_node_cap=cap, use_sinkhorn=use_sinkhorn)
+                           per_node_cap=cap, use_sinkhorn=use_sinkhorn,
+                           skip_priorities=w.skip_prio)
     jax.block_until_ready(a)
 
     t0 = time.perf_counter()
@@ -367,7 +375,7 @@ def run_batched(w: Workload, batch: int, cap: int, use_sinkhorn: bool = False,
         ts = time.perf_counter()
         assigned, usage, rounds = batch_assign(
             dp, dn_cur, w.ds, topo=w.dt, vol=dv, per_node_cap=cap,
-            use_sinkhorn=use_sinkhorn,
+            use_sinkhorn=use_sinkhorn, skip_priorities=w.skip_prio,
         )
         a = np.asarray(assigned)[: len(chunk)]  # device sync + readback
         solve_s += time.perf_counter() - ts
@@ -432,10 +440,12 @@ def run_sequential(w: Workload):
     from kubernetes_tpu.utils.interner import bucket_size
 
     dp, dv = w.device_batch(w.pending, bucket_size(len(w.pending)))
-    a, u = greedy_assign(dp, w.dn, w.ds, topo=w.dt, vol=dv)
+    a, u = greedy_assign(dp, w.dn, w.ds, topo=w.dt, vol=dv,
+                         skip_priorities=w.skip_prio)
     jax.block_until_ready(a)  # compile excluded
     t0 = time.perf_counter()
-    a, u = greedy_assign(dp, w.dn, w.ds, topo=w.dt, vol=dv)
+    a, u = greedy_assign(dp, w.dn, w.ds, topo=w.dt, vol=dv,
+                         skip_priorities=w.skip_prio)
     a = np.asarray(a)[: len(w.pending)]
     elapsed = time.perf_counter() - t0
     placed = int((a >= 0).sum())

@@ -144,9 +144,10 @@ def queue_order(pods: DevicePods) -> jnp.ndarray:
     return jnp.lexsort((pods.order, -pri))
 
 
-@partial(jax.jit, static_argnames=("weights_key",))
+@partial(jax.jit, static_argnames=("weights_key", "skip_key"))
 def _greedy_impl(pods, nodes, sel, topo, vol, weights_key, extra_mask,
-                 static_vol=None, enabled_mask=None, extra_score=None):
+                 static_vol=None, enabled_mask=None, extra_score=None,
+                 skip_key=()):
     weights = dict(weights_key) if weights_key is not None else None
     P = pods.req.shape[0]
     perm = queue_order(pods)
@@ -171,7 +172,8 @@ def _greedy_impl(pods, nodes, sel, topo, vol, weights_key, extra_mask,
                            hoisted=(sb, prog)).mask
             & extra
         )  # (1, N)
-        score = run_priorities(pod, cur, sel, mask, weights, topo)
+        score = run_priorities(pod, cur, sel, mask, weights, topo,
+                               skip=skip_key)
         if extra_score is not None:
             score = score + jax.lax.dynamic_index_in_dim(
                 extra_score, p, axis=0, keepdims=True
@@ -198,18 +200,22 @@ def greedy_assign(
     static_vol: Optional[jnp.ndarray] = None,
     enabled_mask: Optional[int] = None,
     extra_score: Optional[jnp.ndarray] = None,
+    skip_priorities=(),
 ) -> Tuple[jnp.ndarray, UsageState]:
     """Serial-parity solver. Returns (assigned node row per pod or -1,
     final usage). ``extra_mask`` (P, N) ANDs into feasibility — the driver
     feeds the nominated-pods pass-A mask through it (podFitsOnNode's
-    two-pass rule, generic_scheduler.go:610)."""
+    two-pass rule, generic_scheduler.go:610). ``skip_priorities``: names
+    from :func:`~kubernetes_tpu.ops.priorities.empty_priorities`, whose
+    kernels are replaced by their exact constants (static jit key)."""
     key = tuple(sorted(weights.items())) if weights is not None else None
     if extra_mask is None:
         extra_mask = jnp.ones(
             (pods.req.shape[0], nodes.allocatable.shape[0]), bool
         )
     return _greedy_impl(pods, nodes, sel, topo, vol, key, extra_mask,
-                        static_vol, enabled_mask, extra_score)
+                        static_vol, enabled_mask, extra_score,
+                        skip_key=tuple(skip_priorities))
 
 
 def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray:
@@ -221,10 +227,10 @@ def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray
 
 
 @partial(jax.jit, static_argnames=("weights_key", "max_rounds", "per_node_cap",
-                                   "use_sinkhorn"))
+                                   "use_sinkhorn", "skip_key"))
 def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
                 extra_mask, vol=None, static_vol=None, enabled_mask=None,
-                extra_score=None, use_sinkhorn=False):
+                extra_score=None, use_sinkhorn=False, skip_key=()):
     weights = dict(weights_key) if weights_key is not None else None
     P = pods.req.shape[0]
     perm = queue_order(pods)
@@ -270,7 +276,8 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
             & active[:, None]
             & extra_mask
         )
-        score = run_priorities(pods, cur, sel, mask, weights, topo)
+        score = run_priorities(pods, cur, sel, mask, weights, topo,
+                               skip=skip_key)
         if extra_score is not None:
             score = score + extra_score
         # ---- bidder window: the next K pods the serial loop would pop ----
@@ -449,6 +456,7 @@ def batch_assign(
     enabled_mask: Optional[int] = None,
     extra_score: Optional[jnp.ndarray] = None,
     use_sinkhorn: bool = False,
+    skip_priorities=(),
 ) -> Tuple[jnp.ndarray, UsageState, jnp.ndarray]:
     """Fast batched solver. Returns (assigned row per pod or -1, final
     usage, rounds executed). ``per_node_cap`` bounds admissions per node per
@@ -462,4 +470,4 @@ def batch_assign(
         )
     return _batch_impl(pods, nodes, sel, topo, key, max_rounds, per_node_cap,
                        extra_mask, vol, static_vol, enabled_mask, extra_score,
-                       use_sinkhorn)
+                       use_sinkhorn, skip_key=tuple(skip_priorities))

@@ -338,6 +338,57 @@ DEFAULT_WEIGHTS: Dict[str, float] = {
 }
 
 
+#: the exact full-matrix constant each kernel produces when its inputs are
+#: absent from the snapshot (verified by tests/test_priorities.py gating
+#: equality): reverse-normalized kernels and spread/avoid fill MaxPriority
+#: everywhere (NormalizeReduce's max==0 branch is mask-independent),
+#: forward-normalized and sum-based kernels fill 0.
+EMPTY_CONSTANTS: Dict[str, float] = {
+    "NodeAffinityPriority": 0.0,
+    "TaintTolerationPriority": float(MAX_PRIORITY),
+    "ImageLocalityPriority": 0.0,
+    "SelectorSpreadPriority": float(MAX_PRIORITY),
+    "NodePreferAvoidPodsPriority": float(MAX_PRIORITY),
+    "ResourceLimitsPriority": 0.0,
+}
+
+#: the stock kernels the constants were derived from: register_priority()
+#: may rebind a registry name, and the gate must never constant-fold a
+#: custom kernel (its empty-input behavior is unknown)
+_STOCK_KERNELS: Dict[str, PriorityFn] = {
+    name: PRIORITY_REGISTRY[name] for name in EMPTY_CONSTANTS
+}
+
+
+def empty_priorities(node_table, pod_table) -> tuple:
+    """Host-side feature gate (the device twin of the reference skipping
+    plugins a profile doesn't enable): names whose kernels provably
+    produce their :data:`EMPTY_CONSTANTS` for THIS snapshot because the
+    inputs they read are entirely absent. Computed on the packed host
+    tables (numpy, no device sync) and threaded into the solvers as a
+    STATIC jit key — the round loop then adds a scalar instead of paying
+    the kernel's matmul + masked reductions every round
+    (benchres/solver_profile_cpu.json: these were 2/3 of scoring cost on
+    constraint-light workloads)."""
+    import numpy as np
+
+    out = []
+    if pod_table.prefprog_id.size == 0 or (pod_table.prefprog_id < 0).all():
+        out.append("NodeAffinityPriority")  # no preferred node affinity
+    if node_table.taint_soft_mh.size == 0 or node_table.taint_soft_mh.sum() == 0:
+        out.append("TaintTolerationPriority")  # no PreferNoSchedule taints
+    if pod_table.image_mh.size == 0 or pod_table.image_mh.sum() == 0:
+        out.append("ImageLocalityPriority")  # no pod lists images
+    if pod_table.owner_id.size == 0 or (pod_table.owner_id < 0).all():
+        out.append("SelectorSpreadPriority")  # no spread-owner selectors
+    if (node_table.avoid_mh.size == 0 or node_table.avoid_mh.sum() == 0
+            or (pod_table.owner_uid_id < 0).all()):
+        out.append("NodePreferAvoidPodsPriority")
+    if pod_table.limits is None or np.asarray(pod_table.limits).max(initial=0) <= 0:
+        out.append("ResourceLimitsPriority")
+    return tuple(out)
+
+
 def run_priorities(
     pods: DevicePods,
     nodes: DeviceNodes,
@@ -345,12 +396,20 @@ def run_priorities(
     mask: jnp.ndarray,
     weights: Dict[str, float] | None = None,
     topo=None,
+    skip=(),
 ) -> jnp.ndarray:
     """PrioritizeNodes (generic_scheduler.go:684): weighted sum of all
-    enabled priorities -> (P, N) f32 total score."""
+    enabled priorities -> (P, N) f32 total score. ``skip`` names kernels
+    (from :func:`empty_priorities`) replaced by their exact
+    :data:`EMPTY_CONSTANTS` scalar."""
     weights = DEFAULT_WEIGHTS if weights is None else weights
     total = jnp.zeros((pods.req.shape[0], nodes.allocatable.shape[0]), jnp.float32)
     for name, w in weights.items():
-        if w:
+        if not w:
+            continue
+        if (name in skip and name in EMPTY_CONSTANTS
+                and PRIORITY_REGISTRY[name] is _STOCK_KERNELS[name]):
+            total = total + w * EMPTY_CONSTANTS[name]
+        else:
             total = total + w * PRIORITY_REGISTRY[name](pods, nodes, sel, topo, mask)
     return total

@@ -48,6 +48,7 @@ from kubernetes_tpu.ops.arrays import (
     topology_to_device,
 )
 from kubernetes_tpu.ops.predicates import run_predicates
+from kubernetes_tpu.ops.priorities import empty_priorities
 from kubernetes_tpu.queue import SchedulingQueue
 from kubernetes_tpu.utils import klog
 from kubernetes_tpu.utils.interner import bucket_size
@@ -427,6 +428,10 @@ class Scheduler:
         nt = self.cache.snapshot()
         node_order = self.cache.node_order()
         pt = pk.pack_pods(batch)
+        # host-side feature gate: priorities whose inputs are absent from
+        # THIS snapshot are replaced by their exact constants inside the
+        # solve (static jit key; ops/priorities.empty_priorities)
+        skip_prio = empty_priorities(nt, pt)
         dn = nodes_to_device(nt)
         dp = pods_to_device(pt, pad_to=bucket_size(max(len(batch), 1)))
         ds = selectors_to_device(pk.pack_selector_tables())
@@ -577,7 +582,7 @@ class Scheduler:
             assigned, usage = greedy_assign(
                 dp, dn, ds, self.weights, topo=dt, extra_mask=extra_mask,
                 vol=dv, static_vol=sv, enabled_mask=self.pred_mask,
-                extra_score=extra_score,
+                extra_score=extra_score, skip_priorities=skip_prio,
             )
             rounds = len(batch)
         elif solver == "exact":
@@ -596,6 +601,7 @@ class Scheduler:
                 enabled_mask=self.pred_mask,
                 extra_score=extra_score,
                 use_sinkhorn=(solver == "sinkhorn"),
+                skip_priorities=skip_prio,
             )
         assigned = np.array(assigned)[: len(batch)]  # writable copy
 

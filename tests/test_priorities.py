@@ -3,6 +3,7 @@ oracle — analog of priorities' *_test.go table tests plus fuzzing."""
 
 import random
 
+import jax.numpy as jnp
 import numpy as np
 
 import pyref
@@ -264,3 +265,113 @@ def test_register_custom_priority_in_weighted_sum():
         assert total[0, 0] == 20.0 and total[0, 1] == 0.0
     finally:
         del prio.PRIORITY_REGISTRY["NodeLabelPriority/gpu"]
+
+
+def test_empty_feature_gate_is_exact():
+    """empty_priorities + EMPTY_CONSTANTS (the host-side feature gate the
+    solvers thread through as a static jit key) must be EXACT: on a
+    snapshot without the gated features, the gated weighted sum equals
+    the full computation bit-for-bit over the whole matrix."""
+    import numpy as np
+
+    from kubernetes_tpu.ops.priorities import (
+        EMPTY_CONSTANTS,
+        empty_priorities,
+        run_priorities,
+    )
+    from kubernetes_tpu.snapshot import SnapshotPacker
+    from kubernetes_tpu.models.cluster import make_nodes, make_pods
+
+    nodes = make_nodes(64, zones=4)
+    existing = make_pods(32, "old", assigned_round_robin_over=64)
+    pending = make_pods(48)
+    pk = SnapshotPacker()
+    for p in existing + pending:
+        pk.intern_pod(p)
+    nt = pk.pack_nodes(nodes, existing)
+    pt = pk.pack_pods(pending)
+    gate = empty_priorities(nt, pt)
+    # the base workload has none of the gated features
+    assert set(EMPTY_CONSTANTS) == set(gate)
+    dn, dp, ds = (nodes_to_device(nt), pods_to_device(pt),
+                  selectors_to_device(pk.pack_selector_tables()))
+    mask = run_predicates(dp, dn, ds).mask
+    full = np.asarray(run_priorities(dp, dn, ds, mask))
+    gated = np.asarray(run_priorities(dp, dn, ds, mask, skip=gate))
+    assert (full == gated).all()
+
+
+def test_empty_feature_gate_respects_present_features():
+    """Each feature's presence must disarm exactly its gate."""
+    from kubernetes_tpu.api.types import Taint
+    from kubernetes_tpu.ops.priorities import empty_priorities
+    from kubernetes_tpu.snapshot import SnapshotPacker
+    from kubernetes_tpu.testing import node_affinity_preferred
+
+    def gate_for(nodes, pending):
+        pk = SnapshotPacker()
+        for p in pending:
+            pk.intern_pod(p)
+        return empty_priorities(pk.pack_nodes(nodes, []),
+                                pk.pack_pods(pending))
+
+    base_nodes = [make_node("n0")]
+    # preferred node affinity present
+    p = make_pod("a", affinity=node_affinity_preferred(
+        (3, [req("disk", "In", "ssd")])))
+    assert "NodeAffinityPriority" not in gate_for(base_nodes, [p])
+    # soft taints present
+    soft = [make_node("n0", taints=[Taint("flaky", "", "PreferNoSchedule")])]
+    assert "TaintTolerationPriority" not in gate_for(soft, [make_pod("b")])
+    # pod images present
+    assert "ImageLocalityPriority" not in gate_for(
+        base_nodes, [make_pod("c", images=("app:v1",))])
+    # spread owners present
+    svc = LabelSelector(match_labels={"app": "web"})
+    assert "SelectorSpreadPriority" not in gate_for(
+        base_nodes, [make_pod("d", labels={"app": "web"},
+                              spread_selectors=(svc,))])
+    # avoid annotation + owner uid present
+    avoid = make_node("n0")
+    avoid.prefer_avoid_owner_uids = ("rc-1",)
+    assert "NodePreferAvoidPodsPriority" not in gate_for(
+        [avoid], [make_pod("e", owner_uid="rc-1")])
+    # limits present
+    from kubernetes_tpu.api.types import Resources
+
+    assert "ResourceLimitsPriority" not in gate_for(
+        base_nodes, [make_pod("f", limits=Resources(cpu_milli=500))])
+
+
+def test_gate_never_folds_custom_kernels():
+    """Regression (r3 review): register_priority may rebind a gated stock
+    name; the gate must then call the custom kernel, never its stock
+    constant."""
+    import numpy as np
+
+    from kubernetes_tpu.ops import priorities as P
+    from kubernetes_tpu.snapshot import SnapshotPacker
+    from kubernetes_tpu.models.cluster import make_nodes, make_pods
+
+    nodes, pending = make_nodes(8, zones=2), make_pods(6)
+    pk = SnapshotPacker()
+    for p in pending:
+        pk.intern_pod(p)
+    nt, pt = pk.pack_nodes(nodes, []), pk.pack_pods(pending)
+    gate = P.empty_priorities(nt, pt)
+    assert "ImageLocalityPriority" in gate
+    dn, dp, ds = (nodes_to_device(nt), pods_to_device(pt),
+                  selectors_to_device(pk.pack_selector_tables()))
+    mask = run_predicates(dp, dn, ds).mask
+    stock = P.PRIORITY_REGISTRY["ImageLocalityPriority"]
+    try:
+        P.register_priority(
+            "ImageLocalityPriority",
+            lambda pods, nodes, sel, topo, m: jnp.full(
+                (pods.req.shape[0], nodes.allocatable.shape[0]), 7.0),
+        )
+        got = np.asarray(P.run_priorities(
+            dp, dn, ds, mask, {"ImageLocalityPriority": 1.0}, skip=gate))
+        assert (got == 7.0).all()  # custom kernel ran; constant 0 did not
+    finally:
+        P.register_priority("ImageLocalityPriority", stock)
