@@ -144,10 +144,10 @@ def queue_order(pods: DevicePods) -> jnp.ndarray:
     return jnp.lexsort((pods.order, -pri))
 
 
-@partial(jax.jit, static_argnames=("weights_key", "skip_key"))
+@partial(jax.jit, static_argnames=("weights_key", "skip_key", "no_ports"))
 def _greedy_impl(pods, nodes, sel, topo, vol, weights_key, extra_mask,
                  static_vol=None, enabled_mask=None, extra_score=None,
-                 skip_key=()):
+                 skip_key=(), no_ports=False):
     weights = dict(weights_key) if weights_key is not None else None
     P = pods.req.shape[0]
     perm = queue_order(pods)
@@ -169,7 +169,7 @@ def _greedy_impl(pods, nodes, sel, topo, vol, weights_key, extra_mask,
         sb = jax.lax.dynamic_index_in_dim(static_bits, p, axis=0, keepdims=True)
         mask = (
             run_predicates(pod, cur, sel, topo, vol, sv, enabled_mask,
-                           hoisted=(sb, prog)).mask
+                           hoisted=(sb, prog), no_ports=no_ports).mask
             & extra
         )  # (1, N)
         score = run_priorities(pod, cur, sel, mask, weights, topo,
@@ -201,6 +201,7 @@ def greedy_assign(
     enabled_mask: Optional[int] = None,
     extra_score: Optional[jnp.ndarray] = None,
     skip_priorities=(),
+    no_ports: bool = False,
 ) -> Tuple[jnp.ndarray, UsageState]:
     """Serial-parity solver. Returns (assigned node row per pod or -1,
     final usage). ``extra_mask`` (P, N) ANDs into feasibility — the driver
@@ -215,7 +216,7 @@ def greedy_assign(
         )
     return _greedy_impl(pods, nodes, sel, topo, vol, key, extra_mask,
                         static_vol, enabled_mask, extra_score,
-                        skip_key=tuple(skip_priorities))
+                        skip_key=tuple(skip_priorities), no_ports=no_ports)
 
 
 def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray:
@@ -227,10 +228,11 @@ def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray
 
 
 @partial(jax.jit, static_argnames=("weights_key", "max_rounds", "per_node_cap",
-                                   "use_sinkhorn", "skip_key"))
+                                   "use_sinkhorn", "skip_key", "no_ports"))
 def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
                 extra_mask, vol=None, static_vol=None, enabled_mask=None,
-                extra_score=None, use_sinkhorn=False, skip_key=()):
+                extra_score=None, use_sinkhorn=False, skip_key=(),
+                no_ports=False):
     weights = dict(weights_key) if weights_key is not None else None
     P = pods.req.shape[0]
     perm = queue_order(pods)
@@ -272,7 +274,8 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
         active = (assigned == -1) & pods.valid
         mask = (
             run_predicates(pods, cur, sel, topo, vol, static_vol,
-                           enabled_mask, hoisted=hoisted).mask
+                           enabled_mask, hoisted=hoisted,
+                           no_ports=no_ports).mask
             & active[:, None]
             & extra_mask
         )
@@ -457,6 +460,7 @@ def batch_assign(
     extra_score: Optional[jnp.ndarray] = None,
     use_sinkhorn: bool = False,
     skip_priorities=(),
+    no_ports: bool = False,
 ) -> Tuple[jnp.ndarray, UsageState, jnp.ndarray]:
     """Fast batched solver. Returns (assigned row per pod or -1, final
     usage, rounds executed). ``per_node_cap`` bounds admissions per node per
@@ -470,4 +474,5 @@ def batch_assign(
         )
     return _batch_impl(pods, nodes, sel, topo, key, max_rounds, per_node_cap,
                        extra_mask, vol, static_vol, enabled_mask, extra_score,
-                       use_sinkhorn, skip_key=tuple(skip_priorities))
+                       use_sinkhorn, skip_key=tuple(skip_priorities),
+                       no_ports=no_ports)

@@ -307,6 +307,7 @@ def run_predicates(
     static_reasons: jnp.ndarray | None = None,
     enabled_mask=None,
     hoisted=None,
+    no_ports: bool = False,
 ) -> FilterResult:
     """The fused Filter pass: all predicates, all (pod, node) pairs.
 
@@ -323,6 +324,9 @@ def run_predicates(
     ``hoisted`` takes :func:`static_predicate_reasons` output computed
     once per batch against the BASE nodes; the usage-updated ``nodes``
     passed per round then only feed the dynamic predicates.
+    ``no_ports`` (static, from :func:`pods_have_no_ports` on the host
+    table) skips the three port-conflict matmuls — exact when no pending
+    pod declares host ports, since conflicts would be identically zero.
     """
     if hoisted is None:
         reasons, prog = static_predicate_reasons(pods, nodes, sel)
@@ -333,12 +337,15 @@ def run_predicates(
     # wildcard-IP pod ports conflict with any same-(proto,port) use; specific
     # -IP ports conflict with wildcard uses of (proto,port) or identical
     # (proto,ip,port) uses. Usage-dependent: bound pods add port rows.
-    conflicts = (
-        pods.port_wild_pp @ nodes.port_any_mh.T
-        + pods.port_spec_pp @ nodes.port_wild_mh.T
-        + pods.port_spec_pip @ nodes.port_spec_mh.T
-    )
-    reasons |= jnp.where(conflicts > 0, jnp.int32(1 << BIT["PodFitsHostPorts"]), 0)
+    if not no_ports:
+        conflicts = (
+            pods.port_wild_pp @ nodes.port_any_mh.T
+            + pods.port_spec_pp @ nodes.port_wild_mh.T
+            + pods.port_spec_pip @ nodes.port_spec_mh.T
+        )
+        reasons |= jnp.where(
+            conflicts > 0, jnp.int32(1 << BIT["PodFitsHostPorts"]), 0
+        )
 
     if topo is not None:
         from kubernetes_tpu.ops.topology import (
@@ -516,6 +523,14 @@ def resource_fit_mask(
             nonzero = nz if nonzero is None else (nonzero | nz)
     pods_only = pod_req[:, RES_PODS : RES_PODS + 1] <= free[None, :, RES_PODS] + 1e-6
     return jnp.where(nonzero[:, None], full, pods_only)
+
+
+def pods_have_no_ports(pod_table) -> bool:
+    """Host-side gate companion to ``run_predicates(no_ports=)``: True when
+    no pending pod in the packed table declares host ports."""
+    return (pod_table.port_wild_pp.sum() == 0
+            and pod_table.port_spec_pp.sum() == 0
+            and pod_table.port_spec_pip.sum() == 0)
 
 
 def decode_reasons(bitmask: int) -> Tuple[str, ...]:

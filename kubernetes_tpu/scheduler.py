@@ -47,7 +47,7 @@ from kubernetes_tpu.ops.arrays import (
     selectors_to_device,
     topology_to_device,
 )
-from kubernetes_tpu.ops.predicates import run_predicates
+from kubernetes_tpu.ops.predicates import pods_have_no_ports, run_predicates
 from kubernetes_tpu.ops.priorities import empty_priorities
 from kubernetes_tpu.queue import SchedulingQueue
 from kubernetes_tpu.utils import klog
@@ -428,10 +428,13 @@ class Scheduler:
         nt = self.cache.snapshot()
         node_order = self.cache.node_order()
         pt = pk.pack_pods(batch)
-        # host-side feature gate: priorities whose inputs are absent from
+        # host-side feature gates: priorities whose inputs are absent from
         # THIS snapshot are replaced by their exact constants inside the
-        # solve (static jit key; ops/priorities.empty_priorities)
+        # solve, and the port-conflict matmuls are skipped for port-free
+        # batches (static jit keys; ops/priorities.empty_priorities,
+        # ops/predicates.pods_have_no_ports)
         skip_prio = empty_priorities(nt, pt)
+        no_ports = pods_have_no_ports(pt)
         dn = nodes_to_device(nt)
         dp = pods_to_device(pt, pad_to=bucket_size(max(len(batch), 1)))
         ds = selectors_to_device(pk.pack_selector_tables())
@@ -566,8 +569,7 @@ class Scheduler:
                 hazards.append("topology")
             if dv is not None:
                 hazards.append("volumes")
-            if float(np.asarray(jnp.sum(dp.port_wild_pp))
-                     + np.asarray(jnp.sum(dp.port_spec_pp))) > 0:
+            if not no_ports:  # host-side gate already knows; no device sync
                 hazards.append("host-ports")
             if hazards:
                 self.exact_fallbacks += 1
@@ -583,6 +585,7 @@ class Scheduler:
                 dp, dn, ds, self.weights, topo=dt, extra_mask=extra_mask,
                 vol=dv, static_vol=sv, enabled_mask=self.pred_mask,
                 extra_score=extra_score, skip_priorities=skip_prio,
+                no_ports=no_ports,
             )
             rounds = len(batch)
         elif solver == "exact":
@@ -602,6 +605,7 @@ class Scheduler:
                 extra_score=extra_score,
                 use_sinkhorn=(solver == "sinkhorn"),
                 skip_priorities=skip_prio,
+                no_ports=no_ports,
             )
         assigned = np.array(assigned)[: len(batch)]  # writable copy
 

@@ -213,3 +213,33 @@ def test_malformed_gt_literal_matches_nothing():
     got, reasons = device_mask(nodes, [], [pod])
     assert not got[0, 0]
     assert "PodMatchNodeSelector" in decode_reasons(int(reasons[0, 0]))
+
+
+def test_no_ports_gate_is_exact_and_disarms():
+    """run_predicates(no_ports=True) (the host gate pods_have_no_ports
+    feeds the solvers as a static key) must be exact on port-free batches
+    and must disarm as soon as any pending pod declares a port."""
+    from kubernetes_tpu.ops.predicates import pods_have_no_ports
+
+    rng = random.Random(99)
+    nodes, scheduled, pending = random_cluster(rng, n_nodes=10, n_sched=15,
+                                               n_pending=12)
+    portless = [p for p in pending if not p.host_ports]
+    pk = SnapshotPacker()
+    for p in list(scheduled) + portless:
+        pk.intern_pod(p)
+    nt = pk.pack_nodes(nodes, scheduled)
+    pt = pk.pack_pods(portless)
+    assert pods_have_no_ports(pt)
+    dn, dp, ds = (nodes_to_device(nt), pods_to_device(pt),
+                  selectors_to_device(pk.pack_selector_tables()))
+    full = run_predicates(dp, dn, ds)
+    gated = run_predicates(dp, dn, ds, no_ports=True)
+    assert (np.asarray(full.mask) == np.asarray(gated.mask)).all()
+    assert (np.asarray(full.reasons) == np.asarray(gated.reasons)).all()
+    # a port-bearing pod disarms the gate
+    pk2 = SnapshotPacker()
+    withport = portless + [make_pod("ported", host_ports=[("TCP", "", 80)])]
+    for p in withport:
+        pk2.intern_pod(p)
+    assert not pods_have_no_ports(pk2.pack_pods(withport))
