@@ -274,6 +274,8 @@ class ShardedWorkload:
         self.pending = w.pending
         self.skip_prio = w.skip_prio
         self.no_ports = w.no_ports
+        self.no_pod_affinity = w.no_pod_affinity
+        self.no_spread = w.no_spread
         self.dn = shard_nodes(w.dn, mesh)
         self.ds = replicate(w.ds, mesh)
         self.dt = replicate(w.dt, mesh) if w.dt is not None else None
@@ -314,12 +316,10 @@ class Workload:
         self.dt = topology_to_device(tt) if tt.n_pairs else None
         # host-side feature gate over the WHOLE pending set (each batch is
         # a subset, so absence over all pending implies absence per batch)
-        from kubernetes_tpu.ops.predicates import pods_have_no_ports
-        from kubernetes_tpu.ops.priorities import empty_priorities
+        from kubernetes_tpu.ops.priorities import solver_gates
 
-        all_pt = pk.pack_pods(pending)
-        self.skip_prio = empty_priorities(nt, all_pt)
-        self.no_ports = pods_have_no_ports(all_pt)
+        (self.skip_prio, self.no_ports, self.no_pod_affinity,
+         self.no_spread) = solver_gates(nt, pk.pack_pods(pending))
         self.has_vol = bool(pvcs or pvs) or any(p.volumes for p in pending)
         self._volumes_to_device = volumes_to_device
         self._pods_to_device = pods_to_device
@@ -360,7 +360,9 @@ def run_batched(w: Workload, batch: int, cap: int, use_sinkhorn: bool = False,
     dp0, dv0 = w.device_batch(pending[:batch], batch)
     a, u, r = batch_assign(dp0, w.dn, w.ds, topo=w.dt, vol=dv0,
                            per_node_cap=cap, use_sinkhorn=use_sinkhorn,
-                           skip_priorities=w.skip_prio, no_ports=w.no_ports)
+                           skip_priorities=w.skip_prio, no_ports=w.no_ports,
+                           no_pod_affinity=w.no_pod_affinity,
+                           no_spread=w.no_spread)
     jax.block_until_ready(a)
 
     t0 = time.perf_counter()
@@ -380,7 +382,8 @@ def run_batched(w: Workload, batch: int, cap: int, use_sinkhorn: bool = False,
         assigned, usage, rounds = batch_assign(
             dp, dn_cur, w.ds, topo=w.dt, vol=dv, per_node_cap=cap,
             use_sinkhorn=use_sinkhorn, skip_priorities=w.skip_prio,
-            no_ports=w.no_ports,
+            no_ports=w.no_ports, no_pod_affinity=w.no_pod_affinity,
+            no_spread=w.no_spread,
         )
         a = np.asarray(assigned)[: len(chunk)]  # device sync + readback
         solve_s += time.perf_counter() - ts
@@ -446,11 +449,15 @@ def run_sequential(w: Workload):
 
     dp, dv = w.device_batch(w.pending, bucket_size(len(w.pending)))
     a, u = greedy_assign(dp, w.dn, w.ds, topo=w.dt, vol=dv,
-                         skip_priorities=w.skip_prio, no_ports=w.no_ports)
+                         skip_priorities=w.skip_prio, no_ports=w.no_ports,
+                         no_pod_affinity=w.no_pod_affinity,
+                         no_spread=w.no_spread)
     jax.block_until_ready(a)  # compile excluded
     t0 = time.perf_counter()
     a, u = greedy_assign(dp, w.dn, w.ds, topo=w.dt, vol=dv,
-                         skip_priorities=w.skip_prio, no_ports=w.no_ports)
+                         skip_priorities=w.skip_prio, no_ports=w.no_ports,
+                         no_pod_affinity=w.no_pod_affinity,
+                         no_spread=w.no_spread)
     a = np.asarray(a)[: len(w.pending)]
     elapsed = time.perf_counter() - t0
     placed = int((a >= 0).sum())

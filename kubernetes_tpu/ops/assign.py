@@ -144,10 +144,12 @@ def queue_order(pods: DevicePods) -> jnp.ndarray:
     return jnp.lexsort((pods.order, -pri))
 
 
-@partial(jax.jit, static_argnames=("weights_key", "skip_key", "no_ports"))
+@partial(jax.jit, static_argnames=("weights_key", "skip_key", "no_ports",
+                                   "no_pod_affinity", "no_spread"))
 def _greedy_impl(pods, nodes, sel, topo, vol, weights_key, extra_mask,
                  static_vol=None, enabled_mask=None, extra_score=None,
-                 skip_key=(), no_ports=False):
+                 skip_key=(), no_ports=False, no_pod_affinity=False,
+                 no_spread=False):
     weights = dict(weights_key) if weights_key is not None else None
     P = pods.req.shape[0]
     perm = queue_order(pods)
@@ -169,7 +171,9 @@ def _greedy_impl(pods, nodes, sel, topo, vol, weights_key, extra_mask,
         sb = jax.lax.dynamic_index_in_dim(static_bits, p, axis=0, keepdims=True)
         mask = (
             run_predicates(pod, cur, sel, topo, vol, sv, enabled_mask,
-                           hoisted=(sb, prog), no_ports=no_ports).mask
+                           hoisted=(sb, prog), no_ports=no_ports,
+                           no_pod_affinity=no_pod_affinity,
+                           no_spread=no_spread).mask
             & extra
         )  # (1, N)
         score = run_priorities(pod, cur, sel, mask, weights, topo,
@@ -202,6 +206,8 @@ def greedy_assign(
     extra_score: Optional[jnp.ndarray] = None,
     skip_priorities=(),
     no_ports: bool = False,
+    no_pod_affinity: bool = False,
+    no_spread: bool = False,
 ) -> Tuple[jnp.ndarray, UsageState]:
     """Serial-parity solver. Returns (assigned node row per pod or -1,
     final usage). ``extra_mask`` (P, N) ANDs into feasibility — the driver
@@ -216,7 +222,9 @@ def greedy_assign(
         )
     return _greedy_impl(pods, nodes, sel, topo, vol, key, extra_mask,
                         static_vol, enabled_mask, extra_score,
-                        skip_key=tuple(skip_priorities), no_ports=no_ports)
+                        skip_key=tuple(skip_priorities), no_ports=no_ports,
+                        no_pod_affinity=no_pod_affinity,
+                        no_spread=no_spread)
 
 
 def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray:
@@ -228,11 +236,12 @@ def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray
 
 
 @partial(jax.jit, static_argnames=("weights_key", "max_rounds", "per_node_cap",
-                                   "use_sinkhorn", "skip_key", "no_ports"))
+                                   "use_sinkhorn", "skip_key", "no_ports",
+                                   "no_pod_affinity", "no_spread"))
 def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
                 extra_mask, vol=None, static_vol=None, enabled_mask=None,
                 extra_score=None, use_sinkhorn=False, skip_key=(),
-                no_ports=False):
+                no_ports=False, no_pod_affinity=False, no_spread=False):
     weights = dict(weights_key) if weights_key is not None else None
     P = pods.req.shape[0]
     perm = queue_order(pods)
@@ -258,12 +267,15 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
     if vol is not None and static_vol is None:
         static_vol = static_volume_reasons(pods, nodes, sel, vol,
                                            prog=hoisted[1])
-    if topo is not None:
+    if topo is not None and not (no_pod_affinity and no_spread):
         from kubernetes_tpu.ops.topology import sensitive_keys
 
         # (P, K) topology keys along which same-round co-admission into one
         # topology group could violate required anti-affinity / hard spread
-        # (static over rounds; the per-round escape check is inside the loop)
+        # (static over rounds; the per-round escape check is inside the
+        # loop). Skipped when BOTH batch gates hold: a universe matcher
+        # left by a long-gone affinity pod would otherwise mark clean pods
+        # topology-sensitive and serialize their admissions per pair.
         sens = sensitive_keys(pods, topo, nodes.topo_pair_id.shape[1])
     else:
         sens = None
@@ -275,7 +287,9 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
         mask = (
             run_predicates(pods, cur, sel, topo, vol, static_vol,
                            enabled_mask, hoisted=hoisted,
-                           no_ports=no_ports).mask
+                           no_ports=no_ports,
+                           no_pod_affinity=no_pod_affinity,
+                           no_spread=no_spread).mask
             & active[:, None]
             & extra_mask
         )
@@ -396,7 +410,7 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
         acc_s = (c_s >= 0) & fits & cap_ok & port_ok
         accepted = jnp.zeros((P,), bool).at[order2].set(acc_s)
 
-        if topo is not None:
+        if sens is not None:
             from kubernetes_tpu.ops.topology import self_escape_active
 
             big = jnp.int32(2**30)
@@ -423,11 +437,13 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
                 pair = tpid[jnp.clip(choice, 0, tpid.shape[0] - 1), k]
                 gate = ok & (choice >= 0) & sens[:, k] & (pair >= 0)
                 ok = first_per_group(ok, gate, pair)
-            # one self-match escapee per affinity program per round: the
-            # second first-pod-of-a-group must wait and join the first
-            esc = self_escape_active(pods, cur, topo)
-            gate_e = ok & (choice >= 0) & esc
-            ok = first_per_group(ok, gate_e, pods.affprog_id)
+            if not no_pod_affinity:
+                # one self-match escapee per affinity program per round:
+                # the second first-pod-of-a-group must wait and join the
+                # first (affinity-only machinery)
+                esc = self_escape_active(pods, cur, topo)
+                gate_e = ok & (choice >= 0) & esc
+                ok = first_per_group(ok, gate_e, pods.affprog_id)
             accepted = ok
 
         new_assigned = jnp.where(accepted, choice, assigned)
@@ -461,6 +477,8 @@ def batch_assign(
     use_sinkhorn: bool = False,
     skip_priorities=(),
     no_ports: bool = False,
+    no_pod_affinity: bool = False,
+    no_spread: bool = False,
 ) -> Tuple[jnp.ndarray, UsageState, jnp.ndarray]:
     """Fast batched solver. Returns (assigned row per pod or -1, final
     usage, rounds executed). ``per_node_cap`` bounds admissions per node per
@@ -475,4 +493,5 @@ def batch_assign(
     return _batch_impl(pods, nodes, sel, topo, key, max_rounds, per_node_cap,
                        extra_mask, vol, static_vol, enabled_mask, extra_score,
                        use_sinkhorn, skip_key=tuple(skip_priorities),
-                       no_ports=no_ports)
+                       no_ports=no_ports, no_pod_affinity=no_pod_affinity,
+                       no_spread=no_spread)

@@ -308,6 +308,8 @@ def run_predicates(
     enabled_mask=None,
     hoisted=None,
     no_ports: bool = False,
+    no_pod_affinity: bool = False,
+    no_spread: bool = False,
 ) -> FilterResult:
     """The fused Filter pass: all predicates, all (pod, node) pairs.
 
@@ -353,12 +355,26 @@ def run_predicates(
             inter_pod_affinity_mask,
         )
 
-        # MatchInterPodAffinity (predicates.go:1211)
-        aff_ok = inter_pod_affinity_mask(pods, nodes, topo)
-        reasons |= jnp.where(~aff_ok, jnp.int32(1 << BIT["MatchInterPodAffinity"]), 0)
-        # EvenPodsSpread (predicates.go:1720)
-        spread_ok = even_pods_spread_mask(pods, nodes, topo, prog)
-        reasons |= jnp.where(~spread_ok, jnp.int32(1 << BIT["EvenPodsSpread"]), 0)
+        # The topology universe (dt) is MONOTONIC over a packer's life —
+        # one affinity pod ever seen keeps it non-None forever — so the
+        # batch-scoped static gates below matter for long-lived drivers:
+        # no_pod_affinity (batch has no (anti)affinity pods AND the
+        # node-side anti/sym count matrices are all zero) skips the
+        # affinity pass incl. the symmetry filter; no_spread (batch has no
+        # topologySpreadConstraints) skips the spread pass. Both exact:
+        # with those inputs zero the masks are identically all-true.
+        if not no_pod_affinity:
+            # MatchInterPodAffinity (predicates.go:1211)
+            aff_ok = inter_pod_affinity_mask(pods, nodes, topo)
+            reasons |= jnp.where(
+                ~aff_ok, jnp.int32(1 << BIT["MatchInterPodAffinity"]), 0
+            )
+        if not no_spread:
+            # EvenPodsSpread (predicates.go:1720)
+            spread_ok = even_pods_spread_mask(pods, nodes, topo, prog)
+            reasons |= jnp.where(
+                ~spread_ok, jnp.int32(1 << BIT["EvenPodsSpread"]), 0
+            )
 
     if vol is not None:
         reasons |= _dynamic_volume_reasons(pods, nodes, vol)

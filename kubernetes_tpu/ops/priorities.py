@@ -350,6 +350,9 @@ EMPTY_CONSTANTS: Dict[str, float] = {
     "SelectorSpreadPriority": float(MAX_PRIORITY),
     "NodePreferAvoidPodsPriority": float(MAX_PRIORITY),
     "ResourceLimitsPriority": 0.0,
+    # the two topology scores normalize all-zero raw forward -> 0
+    "InterPodAffinityPriority": 0.0,
+    "EvenPodsSpreadPriority": 0.0,
 }
 
 #: the stock kernels the constants were derived from: register_priority()
@@ -386,7 +389,30 @@ def empty_priorities(node_table, pod_table) -> tuple:
         out.append("NodePreferAvoidPodsPriority")
     if pod_table.limits is None or np.asarray(pod_table.limits).max(initial=0) <= 0:
         out.append("ResourceLimitsPriority")
+    # topology scores: gate only with full evidence — no (anti)affinity on
+    # any batch pod AND zero node-side anti/sym term counts (symmetry
+    # inputs from existing pods); spread presence is a packed pod column
+    if (not pod_table.has_aff.any()
+            and node_table.anti_counts.sum() == 0
+            and node_table.sym_counts.sum() == 0):
+        out.append("InterPodAffinityPriority")
+    if ((pod_table.spread_hard_id < 0).all()
+            and (pod_table.spread_soft_id < 0).all()):
+        out.append("EvenPodsSpreadPriority")
     return tuple(out)
+
+
+def solver_gates(node_table, pod_table):
+    """The one evidence rule every solver caller needs, in one place:
+    ``(skip_priorities, no_ports, no_pod_affinity, no_spread)`` for this
+    snapshot+batch. The two topology MASK gates share the score gates'
+    evidence by construction."""
+    from kubernetes_tpu.ops.predicates import pods_have_no_ports
+
+    skip = empty_priorities(node_table, pod_table)
+    return (skip, pods_have_no_ports(pod_table),
+            "InterPodAffinityPriority" in skip,
+            "EvenPodsSpreadPriority" in skip)
 
 
 def run_priorities(

@@ -47,8 +47,8 @@ from kubernetes_tpu.ops.arrays import (
     selectors_to_device,
     topology_to_device,
 )
-from kubernetes_tpu.ops.predicates import pods_have_no_ports, run_predicates
-from kubernetes_tpu.ops.priorities import empty_priorities
+from kubernetes_tpu.ops.predicates import run_predicates
+from kubernetes_tpu.ops.priorities import solver_gates
 from kubernetes_tpu.queue import SchedulingQueue
 from kubernetes_tpu.utils import klog
 from kubernetes_tpu.utils.interner import bucket_size
@@ -433,8 +433,7 @@ class Scheduler:
         # solve, and the port-conflict matmuls are skipped for port-free
         # batches (static jit keys; ops/priorities.empty_priorities,
         # ops/predicates.pods_have_no_ports)
-        skip_prio = empty_priorities(nt, pt)
-        no_ports = pods_have_no_ports(pt)
+        skip_prio, no_ports, no_pod_aff, no_spread = solver_gates(nt, pt)
         dn = nodes_to_device(nt)
         dp = pods_to_device(pt, pad_to=bucket_size(max(len(batch), 1)))
         ds = selectors_to_device(pk.pack_selector_tables())
@@ -585,7 +584,8 @@ class Scheduler:
                 dp, dn, ds, self.weights, topo=dt, extra_mask=extra_mask,
                 vol=dv, static_vol=sv, enabled_mask=self.pred_mask,
                 extra_score=extra_score, skip_priorities=skip_prio,
-                no_ports=no_ports,
+                no_ports=no_ports, no_pod_affinity=no_pod_aff,
+                no_spread=no_spread,
             )
             rounds = len(batch)
         elif solver == "exact":
@@ -605,7 +605,8 @@ class Scheduler:
                 extra_score=extra_score,
                 use_sinkhorn=(solver == "sinkhorn"),
                 skip_priorities=skip_prio,
-                no_ports=no_ports,
+                no_ports=no_ports, no_pod_affinity=no_pod_aff,
+                no_spread=no_spread,
             )
         assigned = np.array(assigned)[: len(batch)]  # writable copy
 

@@ -401,3 +401,79 @@ def test_padding_rows_do_not_alias_matcher_zero():
     a, _, rounds = batch_assign(dp, dn, ds, topo=dt, per_node_cap=8)
     assert int((np.asarray(a)[:32] >= 0).sum()) == 32
     assert int(rounds) <= 4
+
+
+def test_topology_gates_exact_and_disarm():
+    """Batch-scoped topology gates (no_pod_affinity / no_spread static
+    keys): on a CLEAN batch whose packer universe has seen affinity
+    before (the monotonic-dt case long-lived drivers hit), gated and
+    ungated passes must agree bit-for-bit; any affinity/spread/symmetry
+    evidence must disarm the corresponding gate."""
+    from kubernetes_tpu.ops.priorities import empty_priorities
+    from kubernetes_tpu.testing import make_pod as mk
+
+    # universe polluted by an affinity pod that is NOT in this batch/cluster
+    ghost = mk("ghost", labels={"app": "x"})
+    ghost.affinity = Affinity(pod_affinity_required=(term(ZONE, {"app": "x"}),))
+    nodes = [make_node(f"n{i}", labels={ZONE: f"z{i % 2}"}) for i in range(6)]
+    scheduled = [mk(f"s{i}", node_name=f"n{i % 6}", labels={"app": "db"})
+                 for i in range(4)]
+    pending = [mk(f"p{i}", labels={"app": "web"}) for i in range(5)]
+
+    pk = SnapshotPacker()
+    pk.intern_pod(ghost)  # grows the topology universe; dt stays non-None
+    for p in scheduled + pending:
+        pk.intern_pod(p)
+    nt = pk.pack_nodes(nodes, scheduled)
+    pt = pk.pack_pods(pending)
+    dn, dp = nodes_to_device(nt), pods_to_device(pt)
+    ds = selectors_to_device(pk.pack_selector_tables())
+    dt = topology_to_device(pk.pack_topology_tables())
+    assert dt is not None
+
+    gate = empty_priorities(nt, pt)
+    assert "InterPodAffinityPriority" in gate
+    assert "EvenPodsSpreadPriority" in gate
+
+    full = run_predicates(dp, dn, ds, dt)
+    gated = run_predicates(dp, dn, ds, dt, no_pod_affinity=True,
+                           no_spread=True)
+    assert (np.asarray(full.mask) == np.asarray(gated.mask)).all()
+    assert (np.asarray(full.reasons) == np.asarray(gated.reasons)).all()
+
+    # disarm: an existing pod with required anti-affinity (symmetry
+    # evidence lives node-side) must disarm the affinity gate even though
+    # no PENDING pod declares anything
+    hermit = mk("hermit", node_name="n0", labels={"app": "db"})
+    hermit.affinity = Affinity(
+        pod_anti_affinity_required=(term(ZONE, {"app": "web"}),))
+    pk2 = SnapshotPacker()
+    for p in [hermit] + pending:
+        pk2.intern_pod(p)
+    nt2 = pk2.pack_nodes(nodes, [hermit])
+    pt2 = pk2.pack_pods(pending)
+    gate2 = empty_priorities(nt2, pt2)
+    assert "InterPodAffinityPriority" not in gate2
+
+    # disarm: a pending pod with a spread constraint (packed column)
+    spready = mk("sp", labels={"app": "web"})
+    spready.topology_spread = (TopologySpreadConstraint(
+        max_skew=1, topology_key=ZONE,
+        label_selector=LabelSelector(match_labels={"app": "web"})),)
+    pksp = SnapshotPacker()
+    for p in pending + [spready]:
+        pksp.intern_pod(p)
+    gate3 = empty_priorities(pksp.pack_nodes(nodes, []),
+                             pksp.pack_pods(pending + [spready]))
+    assert "EvenPodsSpreadPriority" not in gate3
+
+    # disarm: a pending pod with preferred affinity
+    chatty = mk("ch", labels={"app": "web"})
+    chatty.affinity = Affinity(pod_affinity_preferred=(
+        WeightedPodAffinityTerm(1, term(ZONE, {"app": "web"})),))
+    pk3 = SnapshotPacker()
+    for p in pending + [chatty]:
+        pk3.intern_pod(p)
+    nt3 = pk3.pack_nodes(nodes, [])
+    pt3 = pk3.pack_pods(pending + [chatty])
+    assert "InterPodAffinityPriority" not in empty_priorities(nt3, pt3)
