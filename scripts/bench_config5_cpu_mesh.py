@@ -56,7 +56,13 @@ for b in range(N_BATCHES):
     chunk = w.pending[b * BATCH : (b + 1) * BATCH]
     t0 = time.time()
     dp, dv = w.device_batch(chunk, BATCH)
-    assigned, usage, rounds = batch_assign(dp, dn_cur, w.ds, per_node_cap=8)
+    # feature gates included since round 3 (benchres/config5_cpu_mesh.json
+    # was recorded BEFORE gating — expect a faster number on re-measure)
+    assigned, usage, rounds = batch_assign(
+        dp, dn_cur, w.ds, per_node_cap=8, skip_priorities=w.skip_prio,
+        no_ports=w.no_ports, no_pod_affinity=w.no_pod_affinity,
+        no_spread=w.no_spread,
+    )
     a = np.asarray(assigned)[: len(chunk)]
     dt = time.time() - t0
     placed = int((a >= 0).sum())
