@@ -6,6 +6,7 @@ tests/test_fuzz_differential.py (SURVEY §4 implication d: hollow-node
 style simulation for end-to-end dynamics), shaped like the reference's
 integration-tier soak tests rather than any single table."""
 
+import os
 import random
 
 from kubernetes_tpu.sim import (
@@ -20,7 +21,7 @@ from kubernetes_tpu.sim import (
 )
 from kubernetes_tpu.testing import make_node
 
-N_SEEDS = 25
+N_SEEDS = int(os.environ.get("CONTROLLER_FUZZ_SEEDS", 25))
 
 
 def build_random_cluster(rng, seed):
