@@ -41,9 +41,15 @@ Also recorded in "extras" (BASELINE.md promises; VERDICT r2 #3/#4/#5):
 - gang_1000x32: BASELINE config 4 — sinkhorn vs argmax on 1k groups x 32
   pods: throughput, rounds, all-or-nothing group success rate, score.
 - variant grid: PodAntiAffinity, PodAffinity, NodeAffinity,
-  SelectorSpread, EvenPodsSpread, in-tree PVs, CSI PVs, gang/sinkhorn
+  SelectorSpread, EvenPodsSpread, in-tree PVs, CSI PVs, gang
   (scheduler_bench_test.go:71-270 analogs) at 1000 nodes x 1000 pods
-  (full 4-pair grid via BENCH_GRID=1).
+  (full 4-pair grid via BENCH_GRID=1); every entry uses the default
+  argmax rounds — the gang_NxM section records sinkhorn separately.
+
+All solver calls thread the host-side feature gates (solver_gates:
+priorities with absent inputs become exact constants; port-free batches
+skip the port matmuls; clean batches skip the topology passes) — the
+same static keys the driver uses, bit-identical placements.
 """
 
 import json
