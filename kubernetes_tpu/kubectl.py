@@ -125,14 +125,22 @@ def cmd_get(client, args) -> int:
         print(_fmt_table(
             ["NAME", "STATUS", "TAINTS", "CPU", "MEMORY", "PODS"], rows))
     elif args.kind in ("pods", "pod", "po"):
+        # -n scopes like kubectl; -A (or omitting both on this all-ns
+        # snapshot surface) lists everything
+        want_ns = None if getattr(args, "all_namespaces", False) \
+            else getattr(args, "namespace", None)
         rows = []
         for p in st.bound:
             m = p["metadata"]
+            if want_ns and m["namespace"] != want_ns:
+                continue
             rows.append([m["namespace"], m["name"], "Bound",
                          p["spec"].get("nodeName", ""),
                          str(p["spec"].get("priority", 0))])
         for q, p in st.pending_q:
             m = p["metadata"]
+            if want_ns and m["namespace"] != want_ns:
+                continue
             status = "Pending" if q == "active" else f"Pending({q})"
             rows.append([m["namespace"], m["name"], status, "",
                          str(p["spec"].get("priority", 0))])
@@ -279,6 +287,28 @@ def cmd_create(rest: RestClient, args) -> int:
     return 0
 
 
+def cmd_get_events(rest: RestClient, args) -> int:
+    """kubectl get events: the hub's Event registry over REST, newest
+    last, kubectl's column shape; -A/--all-namespaces widens the scope."""
+    path = ("/api/v1/events" if args.all_namespaces
+            else f"/api/v1/namespaces/{args.namespace}/events")
+    code, doc = rest.call("GET", path)
+    if code != 200:
+        return _rest_fail(doc)
+    rows = [
+        [
+            str(it.get("count", 1)),
+            it.get("type", ""),
+            it.get("reason", ""),
+            f"pod/{it['involvedObject']['name']}",
+            it.get("message", "")[:80],
+        ]
+        for it in doc["items"]
+    ]
+    print(_fmt_table(["COUNT", "TYPE", "REASON", "OBJECT", "MESSAGE"], rows))
+    return 0
+
+
 def cmd_delete(rest: RestClient, args) -> int:
     if args.kind in ("node", "nodes"):
         code, out = rest.call("DELETE", f"/api/v1/nodes/{args.name}")
@@ -342,6 +372,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     sub = p.add_subparsers(dest="cmd", required=True)
     g = sub.add_parser("get")
     g.add_argument("kind")
+    g.add_argument("-n", "--namespace", default="default")
+    g.add_argument("-A", "--all-namespaces", action="store_true")
     t = sub.add_parser("top")
     t.add_argument("kind", choices=["nodes"])
     d = sub.add_parser("describe")
@@ -358,6 +390,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         cv = sub.add_parser(verb)
         cv.add_argument("name")
     args = p.parse_args(argv)
+
+    if args.cmd == "get" and args.kind == "events":
+        if not args.api_server:
+            p.error("get events requires --api-server")
+        try:
+            rest = RestClient(args.api_server)
+        except ValueError:
+            p.error(f"--api-server must be HOST:PORT, got {args.api_server!r}")
+        try:
+            return cmd_get_events(rest, args)
+        except OSError as e:
+            print(f"Error: cannot reach API server {args.api_server}: {e}",
+                  file=sys.stderr)
+            return 1
 
     if args.cmd in ("create", "delete", "cordon", "uncordon"):
         if not args.api_server:

@@ -287,6 +287,33 @@ class RestServer:
         ns = None
         if seg[0] == "namespaces" and len(seg) >= 3:
             ns, seg = seg[1], seg[2:]
+        if seg == ["events"]:
+            items = []
+            for key, ev in sorted(
+                    getattr(hub, "events_v1", {}).items(),
+                    key=lambda kv: kv[1].last_timestamp):
+                ev_ns, name = key.split("/", 1)
+                if ns is not None and ev_ns != ns:
+                    continue
+                items.append(_with_rv({
+                    "metadata": {"name": name, "namespace": ev_ns},
+                    "involvedObject": {
+                        "kind": "Pod",
+                        "namespace": ev.object_key.split("/", 1)[0],
+                        "name": ev.object_key.split("/", 1)[1],
+                    },
+                    "type": ev.type,
+                    "reason": ev.reason,
+                    "message": ev.message,
+                    "count": ev.count,
+                    "firstTimestamp": ev.first_timestamp,
+                    "lastTimestamp": ev.last_timestamp,
+                }, hub, f"events/{key}"))
+            return h._respond(200, {
+                "kind": "EventList", "apiVersion": "v1",
+                "metadata": {"resourceVersion": str(hub._revision)},
+                "items": items,
+            })
         if seg == ["pods"]:
             items = [
                 _with_rv(pod_to_json(p), hub, f"pods/{p.key()}")

@@ -481,6 +481,18 @@ class HollowCluster:
         self.binder = FlakyBinder(self, bind_fail_rate, self.rng)
         kw = dict(scheduler_kw or {})
         kw.setdefault("pdb_lister", lambda: list(self.pdbs))
+        # the scheduler's events land in the hub as API objects (the
+        # reference posts Events via client-go and the apiserver stores
+        # them; tools/record aggregation happens recorder-side, so the hub
+        # sees count-bumped upserts keyed like the events registry)
+        from kubernetes_tpu.events import EventRecorder
+
+        self.events_recorder = EventRecorder(
+            clock=self.clock, sinks=[self._store_event]
+        )
+        #: event-key -> Event, the hub's events registry slice
+        self.events_v1: Dict[str, object] = {}
+        kw.setdefault("event_sink", self.events_recorder.sink())
         self.sched = Scheduler(binder=self.binder, clock=self.clock, **kw)
         self.bound_total = 0
         self.competing_bind_rate = competing_bind_rate
@@ -519,6 +531,29 @@ class HollowCluster:
         else:
             self._compacted_rev = self._revision
         return self._revision
+
+    def _store_event(self, ev) -> None:
+        """Upsert an (aggregated) Event into the hub store — the
+        events-registry write client-go's recorder performs; same key for
+        the same (object, reason, message) series so aggregation bumps
+        resourceVersion instead of multiplying objects."""
+        import hashlib
+
+        series = hashlib.sha1(
+            f"{ev.object_key}|{ev.reason}|{ev.message}".encode()
+        ).hexdigest()[:10]
+        ns = ev.object_key.split("/", 1)[0]
+        key = f"{ns}/{ev.object_key.split('/', 1)[1]}.{series}"
+        verb = "MODIFIED" if key in self.events_v1 else "ADDED"
+        self.events_v1[key] = ev
+        # bounded like the recorder (and like etcd's event TTL): evict the
+        # stalest series; a later recurrence restarts its count at 1,
+        # matching what TTL'd-out reference events do
+        if len(self.events_v1) > 10000:
+            oldest = min(self.events_v1,
+                         key=lambda k: self.events_v1[k].last_timestamp)
+            del self.events_v1[oldest]
+        self._commit(f"events/{key}", verb, ev)
 
     def compact(self, rev: Optional[int] = None) -> None:
         """Drop watch history at or below ``rev`` (etcd compaction,
@@ -1331,6 +1366,13 @@ class Reflector:
             return 1
         for _, obj_key, etype, obj in events:
             kind, _, ident = obj_key.partition("/")
+            if kind not in ("nodes", "pods"):
+                # the history is shared across kinds (events, services,
+                # endpoints, ...); this reflector only syncs the two kinds
+                # the scheduler's informers watch — anything else would
+                # otherwise be fed into the pod handlers and crash
+                # (reflector filtering = the ListWatch's resource scoping)
+                continue
             if kind == "nodes":
                 if etype == "ADDED":
                     self.nodes[ident] = obj

@@ -404,3 +404,95 @@ def test_audit_verb_resolution_is_positional():
         assert by_uri["/api/v1/watch/pods"] == "watch"
     finally:
         srv.close()
+
+
+def test_events_registry_and_ktpu_get_events(capsys):
+    """The scheduler's events land in the hub as API objects (the
+    reference posts Events via client-go): Scheduled + FailedScheduling
+    retrievable over REST with aggregation counts, and ktpu renders the
+    kubectl column shape."""
+    from kubernetes_tpu.kubectl import main as ktpu
+
+    hub = HollowCluster(seed=91, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        req(port, "POST", "/api/v1/nodes", NODE)
+        req(port, "POST", "/api/v1/namespaces/default/pods",
+            make_pod_doc("ok"))
+        req(port, "POST", "/api/v1/namespaces/default/pods",
+            make_pod_doc("giant", cpu="64"))  # can never fit 4 CPUs
+        # cross the 60s unschedulable resweep so the giant pod is
+        # re-attempted and its FailedScheduling event aggregates
+        for _ in range(4):
+            hub.step(dt=40.0)
+        hub.settle()
+        code, doc = req(port, "GET", "/api/v1/namespaces/default/events")
+        assert code == 200 and doc["kind"] == "EventList"
+        by_reason = {}
+        for it in doc["items"]:
+            by_reason.setdefault(it["reason"], []).append(it)
+        assert any(e["involvedObject"]["name"] == "ok"
+                   for e in by_reason.get("Scheduled", []))
+        failed = [e for e in by_reason.get("FailedScheduling", [])
+                  if e["involvedObject"]["name"] == "giant"]
+        assert failed and "Insufficient cpu" in failed[0]["message"]
+        # aggregation: repeated failures bump count on ONE object
+        assert failed[0]["count"] >= 2
+        assert all(it["metadata"]["namespace"] == "default"
+                   for it in doc["items"])
+
+        assert ktpu(["--api-server", f"127.0.0.1:{port}",
+                     "get", "events"]) == 0
+        out = capsys.readouterr().out
+        assert "REASON" in out and "FailedScheduling" in out
+        assert "pod/giant" in out
+    finally:
+        srv.close()
+
+
+def test_reflector_ignores_foreign_kinds_in_history():
+    """Regression (r3 review): the hub's shared watch history now carries
+    Event (and service/endpoint) commits; a Reflector scoped to
+    pods+nodes must skip them instead of feeding them to pod handlers."""
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.sim import Reflector
+
+    hub = HollowCluster(seed=95, scheduler_kw={"enable_preemption": False})
+    hub.add_node(__import__("kubernetes_tpu.testing", fromlist=["make_node"])
+                 .make_node("n0", cpu_milli=4000))
+    shadow = Scheduler(clock=hub.clock, enable_preemption=False)
+    r = Reflector(hub, shadow)
+    r.list_and_watch()
+    hub.create_pod(__import__("kubernetes_tpu.testing", fromlist=["make_pod"])
+                   .make_pod("w", cpu_milli=100))
+    hub.step()  # scheduling emits Scheduled events into the history
+    hub.settle()
+    assert hub.events_v1  # events really are in the shared history
+    n = r.pump()          # must not crash on the event frames
+    assert n >= 1
+    assert shadow.cache.pod_count() == 1
+
+
+def test_ktpu_events_all_namespaces_flag(capsys):
+    from kubernetes_tpu.kubectl import main as ktpu
+
+    hub = HollowCluster(seed=96, admission=True,
+                        scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        req(port, "POST", "/api/v1/nodes", NODE)
+        hub.add_namespace("prod")
+        req(port, "POST", "/api/v1/namespaces/prod/pods", make_pod_doc("w"))
+        hub.step(); hub.settle()
+        # default namespace scope: the prod event is invisible
+        assert ktpu(["--api-server", f"127.0.0.1:{port}",
+                     "get", "events"]) == 0
+        out_default = capsys.readouterr().out
+        assert "pod/w" not in out_default
+        # -A widens to the cluster
+        assert ktpu(["--api-server", f"127.0.0.1:{port}",
+                     "get", "events", "-A"]) == 0
+        out_all = capsys.readouterr().out
+        assert "pod/w" in out_all and "Scheduled" in out_all
+    finally:
+        srv.close()
