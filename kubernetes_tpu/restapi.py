@@ -19,6 +19,9 @@ facade the reference builds in staging/src/k8s.io/apiserver:
 - GET    /api/v1/nodes[/{name}], POST /api/v1/nodes, DELETE, PUT
          PUT enforces the resourceVersion precondition the way
          GuaranteedUpdate does (etcd3/store.go:236): stale rv → 409
+- GET    /api/v1/[namespaces/{ns}/]{services|endpoints|events}
+         read-only lists of the dataplane kinds and the Event registry
+         (the events-recorder writes land here as API objects)
 - GET    /api/v1/watch/{pods|nodes}?resourceVersion=N
          NDJSON event drain from the hub's watch history; a compacted
          rv → 410 Gone with reason=Expired (the client relists, exactly
@@ -220,9 +223,11 @@ class RestServer:
             seg = self._route(path.split("?", 1)[0]) or []
             if seg[:1] == ["watch"]:
                 verb = "watch"
-            elif seg in (["pods"], ["nodes"]) or (
+            elif seg in (["pods"], ["nodes"], ["services"], ["endpoints"],
+                         ["events"]) or (
                     len(seg) == 3 and seg[0] == "namespaces"
-                    and seg[2] == "pods"):
+                    and seg[2] in ("pods", "services", "endpoints",
+                                   "events")):
                 verb = "list"
         self.audit.record(verb, path, getattr(h, "_code", 0),
                           time.perf_counter() - t0,
@@ -287,6 +292,64 @@ class RestServer:
         ns = None
         if seg[0] == "namespaces" and len(seg) >= 3:
             ns, seg = seg[1], seg[2:]
+        if seg == ["services"]:
+            items = []
+            for key, svc in sorted(hub.services.items()):
+                s_ns, name = key.split("/", 1)
+                if ns is not None and s_ns != ns:
+                    continue
+                items.append(_with_rv({
+                    "metadata": {"name": name, "namespace": s_ns},
+                    "spec": {
+                        "selector": dict(svc.selector),
+                        "clusterIP": svc.cluster_ip,
+                        "ports": [
+                            # v1 defaulting: targetPort falls back to port
+                            # (the apiserver's service defaulting)
+                            {"port": p.port,
+                             "targetPort": p.target_port or p.port,
+                             "protocol": p.protocol,
+                             **({"nodePort": p.node_port}
+                                if p.node_port else {})}
+                            for p in svc.ports
+                        ],
+                        "sessionAffinity": svc.session_affinity,
+                    },
+                }, hub, f"services/{key}"))
+            return h._respond(200, {
+                "kind": "ServiceList", "apiVersion": "v1",
+                "metadata": {"resourceVersion": str(hub._revision)},
+                "items": items,
+            })
+        if seg == ["endpoints"]:
+            def target_ref(a):
+                a_ns, a_name = a.pod_key.split("/", 1)
+                return {"kind": "Pod", "name": a_name, "namespace": a_ns}
+
+            items = []
+            for key, ep in sorted(hub.endpoints.items()):
+                e_ns, name = key.split("/", 1)
+                if ns is not None and e_ns != ns:
+                    continue
+                items.append(_with_rv({
+                    "metadata": {"name": name, "namespace": e_ns},
+                    "subsets": [{
+                        "addresses": [
+                            {"nodeName": a.node_name,
+                             "targetRef": target_ref(a)}
+                            for a in ep.ready
+                        ],
+                        "notReadyAddresses": [
+                            {"targetRef": target_ref(a)}
+                            for a in ep.not_ready
+                        ],
+                    }],
+                }, hub, f"endpoints/{key}"))
+            return h._respond(200, {
+                "kind": "EndpointsList", "apiVersion": "v1",
+                "metadata": {"resourceVersion": str(hub._revision)},
+                "items": items,
+            })
         if seg == ["events"]:
             items = []
             for key, ev in sorted(

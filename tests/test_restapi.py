@@ -496,3 +496,46 @@ def test_ktpu_events_all_namespaces_flag(capsys):
         assert "pod/w" in out_all and "Scheduled" in out_all
     finally:
         srv.close()
+
+
+def test_services_and_endpoints_lists():
+    """Read-only REST for the service dataplane kinds: ServiceList with
+    spec/clusterIP/ports, EndpointsList deriving live pod targets from
+    the endpoints controller."""
+    from kubernetes_tpu.proxy import Service, ServicePort
+
+    hub = HollowCluster(seed=97, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        req(port, "POST", "/api/v1/nodes", NODE)
+        hub.add_service(Service(
+            "web", selector={"app": "web"},
+            ports=(ServicePort(port=80, target_port=8080),
+                   ServicePort(port=443))))  # targetPort defaults to port
+        for i in range(2):
+            doc = make_pod_doc(f"w{i}")
+            doc["metadata"]["labels"] = {"app": "web"}
+            req(port, "POST", "/api/v1/namespaces/default/pods", doc)
+        # two steps: endpoints reconcile before the same tick's binds land
+        # (step order mirrors controller-manager vs scheduler asynchrony)
+        hub.step(); hub.step(); hub.settle()
+
+        code, doc = req(port, "GET", "/api/v1/namespaces/default/services")
+        assert code == 200 and doc["kind"] == "ServiceList"
+        assert len(doc["items"]) == 1
+        spec = doc["items"][0]["spec"]
+        assert spec["clusterIP"].startswith("10.96.")
+        assert spec["ports"] == [
+            {"port": 80, "targetPort": 8080, "protocol": "TCP"},
+            {"port": 443, "targetPort": 443, "protocol": "TCP"}]
+
+        code, doc = req(port, "GET", "/api/v1/endpoints")
+        assert code == 200 and doc["kind"] == "EndpointsList"
+        addrs = doc["items"][0]["subsets"][0]["addresses"]
+        assert sorted(a["targetRef"]["name"] for a in addrs) == ["w0", "w1"]
+        assert all(a["nodeName"] == "n0" for a in addrs)
+        # namespace scoping excludes
+        code, doc = req(port, "GET", "/api/v1/namespaces/other/services")
+        assert code == 200 and doc["items"] == []
+    finally:
+        srv.close()
