@@ -539,3 +539,50 @@ def test_services_and_endpoints_lists():
         assert code == 200 and doc["items"] == []
     finally:
         srv.close()
+
+
+def test_rest_fuzz_never_crashes_always_status():
+    """Property: whatever bytes arrive, every response is valid JSON with
+    a known code (2xx or a metav1.Status 4xx/410), and the server keeps
+    serving — no handler thread ever turns a bad request into a hang or
+    a non-JSON 500."""
+    import random
+
+    rng = random.Random(4242)
+    hub = HollowCluster(seed=98, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        req(port, "POST", "/api/v1/nodes", NODE)
+        segments = ["api", "v1", "pods", "nodes", "namespaces", "default",
+                    "watch", "binding", "events", "services", "endpoints",
+                    "", "..", "%2e", "n0", "watch", "x" * 64]
+        bodies = [None, {}, {"metadata": "notadict"}, {"kind": "Node"},
+                  {"target": {}}, {"metadata": {"resourceVersion": "x"}},
+                  [], 42, {"spec": {"containers": "no"}}]
+        methods = ["GET", "POST", "PUT", "DELETE"]
+        for i in range(120):
+            path = "/" + "/".join(
+                rng.choice(segments)
+                for _ in range(rng.randrange(1, 6))
+            )
+            if rng.random() < 0.3:
+                path += "?resourceVersion=" + rng.choice(["0", "abc", "-5"])
+            body = rng.choice(bodies)
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            try:
+                conn.request(rng.choice(methods), path,
+                             json.dumps(body) if body is not None else None)
+                r = conn.getresponse()
+                data = r.read()
+            finally:
+                conn.close()
+            doc = json.loads(data) if data else None
+            assert r.status in (200, 201, 400, 404, 409, 410, 501), (
+                path, r.status)
+            if r.status >= 400 and r.status != 501:
+                assert doc["kind"] == "Status", (path, doc)
+        # the server still works after the storm
+        code, doc = req(port, "GET", "/api/v1/nodes")
+        assert code == 200 and len(doc["items"]) == 1
+    finally:
+        srv.close()
