@@ -86,9 +86,15 @@ def node_from_json(d: dict) -> Node:
             )
         except (ValueError, TypeError, KeyError, AttributeError):
             avoid = ()  # malformed annotation ignored, like the reference
+    labels = dict(meta.get("labels") or {})
+    # the kubelet self-labels every node with kubernetes.io/hostname
+    # (pkg/kubelet well-known labels); nodes ingested without it would
+    # break hostname-pinned placement (DaemonSet affinity)
+    if meta.get("name"):
+        labels.setdefault("kubernetes.io/hostname", meta["name"])
     return Node(
         name=meta.get("name", ""),
-        labels=dict(meta.get("labels") or {}),
+        labels=labels,
         allocatable=res,
         taints=taints,
         conditions=cond,

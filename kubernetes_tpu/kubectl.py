@@ -125,8 +125,8 @@ def cmd_get(client, args) -> int:
         print(_fmt_table(
             ["NAME", "STATUS", "TAINTS", "CPU", "MEMORY", "PODS"], rows))
     elif args.kind in ("pods", "pod", "po"):
-        # -n scopes like kubectl; -A (or omitting both on this all-ns
-        # snapshot surface) lists everything
+        # kubectl-parity scoping: -n selects a namespace (defaulting to
+        # "default", like kubectl), -A lists every namespace
         want_ns = None if getattr(args, "all_namespaces", False) \
             else getattr(args, "namespace", None)
         rows = []

@@ -154,7 +154,11 @@ class RestServer:
                 outer._begin(self)
                 t0 = time.perf_counter()
                 try:
-                    outer._get(self)
+                    # reads hold the same lock as mutations: a list
+                    # comprehension over a hub dict must never race a
+                    # concurrent create/delete into a RuntimeError
+                    with outer._lock:
+                        outer._get(self)
                 finally:
                     outer._record_audit(self, "get", t0)
 
@@ -185,6 +189,7 @@ class RestServer:
                 finally:
                     outer._record_audit(self, "delete", t0)
 
+        self._closed = False
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_port
         self._thread = threading.Thread(
@@ -193,6 +198,18 @@ class RestServer:
 
     def serve(self) -> int:
         self._thread.start()
+
+        def trim_loop():
+            # request-driven trimming alone would pin the hub's
+            # compaction floor forever on an idle server; this keeps the
+            # retained history bounded regardless of traffic
+            while not self._closed:
+                self._trim()
+                time.sleep(1.0)
+
+        self._trimmer = threading.Thread(target=trim_loop, daemon=True,
+                                         name="rest-watch-trim")
+        self._trimmer.start()
         return self.port
 
     def _trim(self) -> None:
@@ -234,6 +251,7 @@ class RestServer:
                           body=getattr(h, "_audit_body", None))
 
     def close(self) -> None:
+        self._closed = True
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -486,6 +504,10 @@ class RestServer:
                     return h._fail(404, "NotFound",
                                    f'pods "{seg[1]}" not found')
                 target = (body.get("target") or {}).get("name", "")
+                if not target:
+                    # the real apiserver validates the binding target
+                    return h._fail(400, "BadRequest",
+                                   "binding target.name is required")
                 claimed_uid = (body.get("metadata") or {}).get("uid", pod.uid)
                 import dataclasses
                 try:

@@ -586,3 +586,40 @@ def test_rest_fuzz_never_crashes_always_status():
         assert code == 200 and len(doc["items"]) == 1
     finally:
         srv.close()
+
+
+def test_binding_requires_target_and_rest_nodes_get_hostname_label():
+    """Regressions (r3 review): an empty binding target is a 400, never a
+    phantom 'bound' pod; REST-ingested nodes get the kubelet's
+    kubernetes.io/hostname self-label so hostname-pinned placement
+    (DaemonSet affinity) works on them."""
+    from kubernetes_tpu.sim import DaemonSet
+
+    hub = HollowCluster(seed=99, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        bare = {"metadata": {"name": "plain"},  # no labels at all
+                "status": {"allocatable": {"cpu": "4000m",
+                                           "memory": "8589934592",
+                                           "pods": "110"}}}
+        req(port, "POST", "/api/v1/nodes", bare)
+        assert hub.truth_nodes["plain"].labels[
+            "kubernetes.io/hostname"] == "plain"
+        req(port, "POST", "/api/v1/namespaces/default/pods",
+            make_pod_doc("w"))
+        before = hub.bound_total
+        code, doc = req(port, "POST",
+                        "/api/v1/namespaces/default/pods/w/binding", {})
+        assert code == 400 and doc["reason"] == "BadRequest"
+        assert hub.bound_total == before
+        assert hub.truth_pods["default/w"].node_name == ""
+        # daemon pods pin by hostname: the REST-created node must take one
+        hub.add_daemonset(DaemonSet("agent"))
+        for _ in range(2):
+            hub.step()
+        hub.settle()
+        hub.check_consistency()
+        assert any(p.node_name == "plain" for p in hub.truth_pods.values()
+                   if p.labels.get("ds") == "agent")
+    finally:
+        srv.close()
