@@ -129,23 +129,44 @@ class RestServer:
         # rv they saw in an earlier poll; _trim (run on every request)
         # keeps the pin — and therefore retained history — bounded
         self._anchor = hub.watch(hub._revision)
-        # serializes check-then-act mutations: the hub's CAS semantics
-        # must hold across ThreadingHTTPServer handler threads too
-        self._lock = threading.Lock()
+        # serializes check-then-act mutations AND reads against the hub's
+        # own mutators (step()/controllers run under hub.lock on the
+        # driver thread): the CAS semantics and dict iterations must hold
+        # across ThreadingHTTPServer handler threads and the sim loop
+        self._lock = getattr(hub, "lock", None) or threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
 
-            def _respond(self, code: int, doc) -> None:
+            def _send_raw(self, code: int, ctype: str, body: bytes) -> None:
                 self._code = code  # for the audit trail
-                body = json.dumps(doc).encode()
+                if getattr(self, "_buffer_mode", False):
+                    # built under the hub lock, WRITTEN outside it — a
+                    # slow client must never wedge the hub on socket I/O
+                    self._buffered = (code, ctype, body)
+                    return
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _flush_buffered(self) -> None:
+                buffered, self._buffered = getattr(self, "_buffered", None), None
+                self._buffer_mode = False
+                if buffered is not None:
+                    code, ctype, body = buffered
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+            def _respond(self, code: int, doc) -> None:
+                self._send_raw(code, "application/json",
+                               json.dumps(doc).encode())
 
             def _fail(self, code: int, reason: str, message: str) -> None:
                 self._respond(code, status_doc(code, reason, message))
@@ -154,11 +175,15 @@ class RestServer:
                 outer._begin(self)
                 t0 = time.perf_counter()
                 try:
-                    # reads hold the same lock as mutations: a list
-                    # comprehension over a hub dict must never race a
-                    # concurrent create/delete into a RuntimeError
+                    # reads hold the same lock as mutations (and as
+                    # hub.step()): a list comprehension over a hub dict
+                    # must never race a concurrent create/delete. The
+                    # response is only BUFFERED under the lock; the socket
+                    # write happens after release.
+                    self._buffer_mode = True
                     with outer._lock:
                         outer._get(self)
+                    self._flush_buffered()
                 finally:
                     outer._record_audit(self, "get", t0)
 
@@ -454,12 +479,7 @@ class RestServer:
                 doc.setdefault("metadata", {})["resourceVersion"] = str(rev)
             lines.append(json.dumps({"type": etype, "object": doc}))
         body = ("\n".join(lines) + ("\n" if lines else "")).encode()
-        h._code = 200  # streamed response bypasses _respond
-        h.send_response(200)
-        h.send_header("Content-Type", "application/json;stream=watch")
-        h.send_header("Content-Length", str(len(body)))
-        h.end_headers()
-        h.wfile.write(body)
+        h._send_raw(200, "application/json;stream=watch", body)
 
     # -- POST ---------------------------------------------------------------
 

@@ -33,6 +33,7 @@ from __future__ import annotations
 import bisect
 import heapq
 import random
+import threading
 import weakref
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -425,6 +426,11 @@ class HollowCluster:
         admission: bool = False,
     ) -> None:
         self.rng = random.Random(seed)
+        #: serializes hub mutation against concurrent readers (the REST
+        #: facade shares this lock; re-entrant because step() nests hub
+        #: calls). The sim itself is single-threaded — the lock exists
+        #: for the serving facades.
+        self.lock = threading.RLock()
         self.clock = SimClock()
         self.truth_pods: Dict[str, Pod] = {}  # key -> pod (node_name = truth)
         self.truth_nodes: Dict[str, Node] = {}
@@ -1195,6 +1201,10 @@ class HollowCluster:
         """One sim tick: deliver due watch events, GC orphans, let the
         competing writer race, reconcile controllers, run a scheduling
         cycle, advance time (so backoffs expire across ticks)."""
+        with self.lock:
+            return self._step_locked(dt)
+
+    def _step_locked(self, dt: float):
         self._tick += 1
         self.flush_events()
         self.gc_orphaned()

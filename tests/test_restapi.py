@@ -623,3 +623,47 @@ def test_binding_requires_target_and_rest_nodes_get_hostname_label():
                    if p.labels.get("ds") == "agent")
     finally:
         srv.close()
+
+
+def test_concurrent_step_and_rest_reads():
+    """Regression (r3 review): hub.step() mutates truth dicts on the
+    driver thread; concurrent REST list reads must serialize against it
+    (shared hub lock) instead of racing into dropped connections."""
+    import threading
+
+    hub = HollowCluster(seed=100, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        req(port, "POST", "/api/v1/nodes", NODE)
+        from kubernetes_tpu.sim import Deployment
+        hub.add_deployment(Deployment("web", replicas=6))
+
+        errors = []
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    code, doc = req(port, "GET", "/api/v1/pods")
+                    assert code == 200 and doc["kind"] == "PodList"
+                    code, doc = req(port, "GET", "/api/v1/events")
+                    assert code == 200
+                except Exception as e:  # any dropped/non-JSON response
+                    errors.append(repr(e))
+                    return
+
+        threads = [threading.Thread(target=reader) for _ in range(3)]
+        for t in threads:
+            t.start()
+        # the driver thread churns the hub while readers hammer it
+        for i in range(30):
+            hub.scale_deployment("web", 2 + (i % 5))
+            hub.step()
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        hub.settle()
+        hub.check_consistency()
+    finally:
+        srv.close()
