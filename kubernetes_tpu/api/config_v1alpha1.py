@@ -1,0 +1,280 @@
+"""The scheduler ComponentConfig scheme: v1alpha1 <-> internal.
+
+The reference keeps the kube-scheduler's config types in two parallel
+packages — the internal form the code consumes
+(pkg/scheduler/apis/config/types.go:43) and the versioned wire form
+(pkg/scheduler/apis/config/v1alpha1, staging .../kube-scheduler/config/
+v1alpha1/types.go) — glued by a scheme that registers conversion and
+defaulting (pkg/scheduler/apis/config/scheme/scheme.go:31 AddToScheme).
+Here the internal form is :class:`kubernetes_tpu.config.
+KubeSchedulerConfiguration` (snake_case, float seconds) and this module
+is the versioned side:
+
+- :class:`KubeSchedulerConfigurationV1alpha1` — wire spelling
+  (camelCase field names, metav1.Duration strings like ``"15s"``);
+- ``set_defaults_*`` — v1alpha1 defaulting (v1alpha1/defaults.go:42):
+  note percentageOfNodesToScore defaults to 0 (= the adaptive 50%->5%
+  rule) in the VERSIONED type while this framework's internal default is
+  100 (dense batch solver scores everything) — exactly the kind of skew
+  the versioned/internal split exists to express;
+- conversions both ways, registered on :data:`SCHEME`;
+- :func:`parse_duration` / :func:`format_duration` — the metav1.Duration
+  wire form (Go time.ParseDuration subset).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kubernetes_tpu.api.scheme import Scheme, SchemeError
+from kubernetes_tpu.config import (
+    FeatureGates,
+    KubeSchedulerConfiguration,
+    LeaderElectionConfig,
+)
+
+GROUP_VERSION = "kubescheduler.config.k8s.io/v1alpha1"
+KIND = "KubeSchedulerConfiguration"
+
+_DUR_RE = re.compile(r"(\d+(?:\.\d+)?)(ms|us|µs|ns|h|m|s)")
+_UNIT_S = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 1e-3, "us": 1e-6,
+           "µs": 1e-6, "ns": 1e-9}
+
+
+def parse_duration(s) -> float:
+    """'1m30s' -> 90.0 (Go time.ParseDuration subset: positive decimal
+    components with h/m/s/ms/us/ns units; bare numbers rejected the way
+    metav1.Duration rejects them)."""
+    if isinstance(s, (int, float)) and not isinstance(s, bool):
+        # tolerate a raw number as seconds (YAML authors do this);
+        # the reference's strict JSON would reject it, but a one-way
+        # tolerance loses no information
+        return float(s)
+    if not isinstance(s, str) or not s:
+        raise SchemeError([f"duration: invalid value {s!r}"])
+    pos = 0
+    total = 0.0
+    for m in _DUR_RE.finditer(s):
+        if m.start() != pos:
+            raise SchemeError([f"duration: invalid value {s!r}"])
+        total += float(m.group(1)) * _UNIT_S[m.group(2)]
+        pos = m.end()
+    if pos != len(s):
+        raise SchemeError([f"duration: invalid value {s!r}"])
+    return total
+
+
+def format_duration(seconds: float) -> str:
+    """Seconds -> the canonical wire string ('90s' stays '1m30s'-free:
+    the reference emits the largest exact unit mix; whole seconds are by
+    far the common case so h/m/s composition is enough)."""
+    if seconds != seconds or seconds < 0:
+        raise SchemeError([f"duration: invalid value {seconds!r}"])
+    ns = round(seconds * 1e9)
+    if ns == 0:
+        return "0s"
+    out = []
+    for unit, unit_ns in (("h", 3_600_000_000_000), ("m", 60_000_000_000),
+                          ("s", 1_000_000_000), ("ms", 1_000_000),
+                          ("us", 1_000), ("ns", 1)):
+        q, ns = divmod(ns, unit_ns)
+        if q:
+            out.append(f"{q}{unit}")
+    return "".join(out)
+
+
+# -- versioned types (wire spelling) ----------------------------------------
+
+
+@dataclass
+class SchedulerAlgorithmSource:
+    """v1alpha1 SchedulerAlgorithmSource (types.go AlgorithmSource):
+    provider XOR policy; here policy carries the inline Policy mapping."""
+
+    provider: Optional[str] = None
+    policy: Optional[dict] = None
+
+
+@dataclass
+class LeaderElectionConfigurationV1alpha1:
+    leaderElect: Optional[bool] = None
+    leaseDuration: Optional[str] = None
+    renewDeadline: Optional[str] = None
+    retryPeriod: Optional[str] = None
+    lockObjectNamespace: Optional[str] = None
+    lockObjectName: Optional[str] = None
+
+
+@dataclass
+class KubeSchedulerConfigurationV1alpha1:
+    schedulerName: Optional[str] = None
+    algorithmSource: "SchedulerAlgorithmSource" = field(
+        default_factory=SchedulerAlgorithmSource)
+    hardPodAffinitySymmetricWeight: Optional[int] = None
+    percentageOfNodesToScore: Optional[int] = None
+    bindTimeoutSeconds: Optional[float] = None
+    leaderElection: "LeaderElectionConfigurationV1alpha1" = field(
+        default_factory=LeaderElectionConfigurationV1alpha1)
+    featureGates: Optional[dict] = None
+    # this implementation's solver block, versioned alongside (camelCase
+    # on the wire like every other field)
+    solver: Optional[str] = None
+    perNodeCap: Optional[int] = None
+    maxRounds: Optional[int] = None
+    maxBatch: Optional[int] = None
+
+
+# -- defaulting (v1alpha1/defaults.go:42) -----------------------------------
+
+
+def set_defaults_kube_scheduler_configuration(
+        obj: KubeSchedulerConfigurationV1alpha1):
+    if obj.schedulerName is None:
+        obj.schedulerName = "default-scheduler"
+    if obj.algorithmSource.provider is None and obj.algorithmSource.policy is None:
+        obj.algorithmSource.provider = "DefaultProvider"
+    if obj.hardPodAffinitySymmetricWeight is None:
+        obj.hardPodAffinitySymmetricWeight = 1
+    if obj.percentageOfNodesToScore is None:
+        # 0 selects the reference's adaptive 50%->5% rule — the versioned
+        # default; the internal type's own default is 100 (see module doc)
+        obj.percentageOfNodesToScore = 0
+    if obj.bindTimeoutSeconds is None:
+        obj.bindTimeoutSeconds = 600.0
+    le = obj.leaderElection
+    if le.leaderElect is None:
+        le.leaderElect = True
+    if le.leaseDuration is None:
+        le.leaseDuration = "15s"
+    if le.renewDeadline is None:
+        le.renewDeadline = "10s"
+    if le.retryPeriod is None:
+        le.retryPeriod = "2s"
+    if le.lockObjectNamespace is None:
+        le.lockObjectNamespace = "kube-system"
+    if le.lockObjectName is None:
+        le.lockObjectName = "kube-scheduler"
+    if obj.solver is None:
+        obj.solver = "batch"
+    if obj.perNodeCap is None:
+        obj.perNodeCap = 4
+    if obj.maxRounds is None:
+        obj.maxRounds = 128
+    if obj.maxBatch is None:
+        obj.maxBatch = 8192
+    return obj
+
+
+# -- conversions (v1alpha1/zz_generated.conversion.go shape) ----------------
+
+
+def _to_internal(v: KubeSchedulerConfigurationV1alpha1) -> KubeSchedulerConfiguration:
+    """Conversion proper. The default table lives in exactly one place
+    (set_defaults_*): defaulting is idempotent, so it is re-applied here
+    on a COPY unconditionally — Scheme.decode callers pay a no-op pass,
+    direct convert() callers with raw/partial objects get correct
+    defaults instead of a crash. Every error surfaces as SchemeError
+    with a field path, never a raw ValueError/KeyError."""
+    import copy
+
+    from kubernetes_tpu.config import load_policy
+
+    v = set_defaults_kube_scheduler_configuration(copy.deepcopy(v))
+    le = v.leaderElection
+    policy = None
+    if v.algorithmSource.policy is not None:
+        try:
+            policy = load_policy(v.algorithmSource.policy)
+        except SchemeError:
+            raise
+        except Exception as e:
+            raise SchemeError([f"algorithmSource.policy: {e}"])
+    try:
+        gates = FeatureGates(overrides=dict(v.featureGates or {}))
+    except ValueError as e:
+        raise SchemeError([f"featureGates: {e}"])
+    try:
+        bind_timeout = float(v.bindTimeoutSeconds)
+    except (TypeError, ValueError):
+        raise SchemeError([
+            f"bindTimeoutSeconds: invalid value {v.bindTimeoutSeconds!r}"
+        ])
+    return KubeSchedulerConfiguration(
+        scheduler_name=v.schedulerName,
+        algorithm_provider=v.algorithmSource.provider or "DefaultProvider",
+        policy=policy,
+        hard_pod_affinity_symmetric_weight=v.hardPodAffinitySymmetricWeight,
+        percentage_of_nodes_to_score=v.percentageOfNodesToScore,
+        bind_timeout_seconds=bind_timeout,
+        leader_election=LeaderElectionConfig(
+            leader_elect=le.leaderElect,
+            lease_duration_s=parse_duration(le.leaseDuration),
+            renew_deadline_s=parse_duration(le.renewDeadline),
+            retry_period_s=parse_duration(le.retryPeriod),
+            lock_object_namespace=le.lockObjectNamespace,
+            lock_object_name=le.lockObjectName,
+        ),
+        feature_gates=gates,
+        solver=v.solver,
+        per_node_cap=v.perNodeCap,
+        max_rounds=v.maxRounds,
+        max_batch=v.maxBatch,
+    )
+
+
+def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV1alpha1:
+    le = c.leader_election
+    gates = c.feature_gates.overrides() or None
+    return KubeSchedulerConfigurationV1alpha1(
+        schedulerName=c.scheduler_name,
+        algorithmSource=SchedulerAlgorithmSource(
+            provider=c.algorithm_provider if c.policy is None else None,
+            policy=None,  # Policy objects don't encode back (one-way,
+            # like the reference's file-referenced policy source)
+        ),
+        hardPodAffinitySymmetricWeight=c.hard_pod_affinity_symmetric_weight,
+        percentageOfNodesToScore=c.percentage_of_nodes_to_score,
+        bindTimeoutSeconds=c.bind_timeout_seconds,
+        leaderElection=LeaderElectionConfigurationV1alpha1(
+            leaderElect=le.leader_elect,
+            leaseDuration=format_duration(le.lease_duration_s),
+            renewDeadline=format_duration(le.renew_deadline_s),
+            retryPeriod=format_duration(le.retry_period_s),
+            lockObjectNamespace=le.lock_object_namespace,
+            lockObjectName=le.lock_object_name,
+        ),
+        featureGates=gates,
+        solver=c.solver,
+        perNodeCap=c.per_node_cap,
+        maxRounds=c.max_rounds,
+        maxBatch=c.max_batch,
+    )
+
+
+def new_scheme() -> Scheme:
+    """AddToScheme (scheme/scheme.go:39): register kinds, defaulting,
+    and both conversion directions on a fresh Scheme."""
+    s = Scheme()
+    s.register(GROUP_VERSION, KIND, KubeSchedulerConfigurationV1alpha1)
+    s.add_defaulting(KubeSchedulerConfigurationV1alpha1,
+                     set_defaults_kube_scheduler_configuration)
+    s.add_conversion(KubeSchedulerConfigurationV1alpha1,
+                     KubeSchedulerConfiguration, _to_internal)
+    s.add_conversion(KubeSchedulerConfiguration,
+                     KubeSchedulerConfigurationV1alpha1, _from_internal)
+    return s
+
+
+SCHEME = new_scheme()
+
+
+def decode(doc: dict) -> KubeSchedulerConfiguration:
+    """Versioned mapping -> internal config (the codec path the CLI
+    uses for apiVersion-tagged files)."""
+    return SCHEME.decode(doc, KubeSchedulerConfiguration)
+
+
+def encode(cfg: KubeSchedulerConfiguration) -> dict:
+    return SCHEME.encode(cfg, GROUP_VERSION, KIND)

@@ -1,0 +1,191 @@
+"""Versioned API machinery — the ``runtime.Scheme`` analog.
+
+The reference's entire API-stability story runs through one registry
+(staging/src/k8s.io/apimachinery/pkg/runtime/scheme.go:46): types are
+registered under a (group, version, kind), versioned objects get
+DEFAULTING functions, and CONVERSION functions map between each
+versioned type and a single internal ("hub") type.  Decoding is then
+always the same pipeline (serializer/codec_factory.go + conversion in
+scheme.go:340 Convert):
+
+    bytes -> recognize apiVersion/kind -> build the VERSIONED object
+    (strict: unknown fields are errors, serializer/json strict mode)
+    -> apply that version's defaults -> convert to INTERNAL
+
+and encoding is the reverse (internal -> convert to the requested
+version).  This module is that pipeline over plain dataclasses: versioned
+types are dataclasses whose FIELD NAMES are the wire spelling (camelCase,
+as in the reference's external types), the internal types are whatever
+the framework uses natively (snake_case dataclasses).
+
+Used by apis/config (the scheduler ComponentConfig scheme,
+pkg/scheduler/apis/config/scheme/scheme.go:31): see
+:mod:`kubernetes_tpu.api.config_v1alpha1`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Tuple, Type
+
+
+class SchemeError(ValueError):
+    """Decode/conversion failure; ``errors`` lists field-path messages."""
+
+    def __init__(self, errors: List[str]):
+        super().__init__("; ".join(errors))
+        self.errors = list(errors)
+
+
+class Scheme:
+    """Type registry + defaulting + conversion (scheme.go:46).
+
+    - :meth:`register` a versioned dataclass under its (apiVersion, kind);
+    - :meth:`add_defaulting` that version's SetDefaults_* function
+      (mutates or returns the versioned object — defaulting runs BEFORE
+      conversion, scheme.go:764 Default);
+    - :meth:`add_conversion` a (src_type, dst_type) function pair —
+      registered both ways for a round-trippable version;
+    - :meth:`decode` a JSON/YAML mapping all the way to the internal type;
+    - :meth:`convert` between any two registered types;
+    - :meth:`encode` an internal object back to a versioned mapping.
+    """
+
+    def __init__(self) -> None:
+        self._kinds: Dict[Tuple[str, str], Type] = {}
+        self._defaulters: Dict[Type, Callable] = {}
+        self._conversions: Dict[Tuple[Type, Type], Callable] = {}
+
+    # -- registration -------------------------------------------------------
+
+    def register(self, api_version: str, kind: str, typ: Type) -> None:
+        if not dataclasses.is_dataclass(typ):
+            raise TypeError(f"{typ!r} must be a dataclass")
+        self._kinds[(api_version, kind)] = typ
+
+    def add_defaulting(self, typ: Type, fn: Callable) -> None:
+        self._defaulters[typ] = fn
+
+    def add_conversion(self, src: Type, dst: Type, fn: Callable) -> None:
+        self._conversions[(src, dst)] = fn
+
+    def recognizes(self, api_version: str, kind: str) -> bool:
+        return (api_version, kind) in self._kinds
+
+    # -- pipeline -----------------------------------------------------------
+
+    def default(self, obj):
+        """Apply the registered defaulting function, if any (Default,
+        scheme.go:764). Returns the defaulted object."""
+        fn = self._defaulters.get(type(obj))
+        if fn is None:
+            return obj
+        return fn(obj) or obj
+
+    def convert(self, obj, to_type: Type):
+        """Convert between registered types (Convert, scheme.go:340).
+        Identity conversion is free; unknown pairs are errors, never a
+        silent field-copy (the reference's reflection fallback is a
+        DELIBERATE non-goal — silent structural conversion is how fields
+        get dropped)."""
+        if type(obj) is to_type:
+            return obj
+        fn = self._conversions.get((type(obj), to_type))
+        if fn is None:
+            raise SchemeError([
+                f"no conversion registered: {type(obj).__name__} -> "
+                f"{to_type.__name__}"
+            ])
+        return fn(obj)
+
+    def build(self, api_version: str, kind: str, doc: dict, path: str = ""):
+        """Mapping -> versioned object, strict (unknown fields are
+        field-path errors, the strict-serializer posture the reference
+        uses for ComponentConfig)."""
+        typ = self._kinds.get((api_version, kind))
+        if typ is None:
+            raise SchemeError([
+                f'no kind "{kind}" is registered for version "{api_version}"'
+            ])
+        return _build_dataclass(typ, doc, path or kind)
+
+    def decode(self, doc: dict, internal_type: Type):
+        """The full decode pipeline: recognize -> build versioned (strict)
+        -> default -> convert to ``internal_type``."""
+        if not isinstance(doc, dict):
+            raise SchemeError(["document: expected a mapping"])
+        api_version = doc.get("apiVersion", "")
+        kind = doc.get("kind", "")
+        if not api_version or not kind:
+            raise SchemeError(["apiVersion and kind are required"])
+        body = {k: v for k, v in doc.items() if k not in ("apiVersion", "kind")}
+        versioned = self.build(api_version, kind, body)
+        versioned = self.default(versioned)
+        return self.convert(versioned, internal_type)
+
+    def encode(self, obj, api_version: str, kind: str) -> dict:
+        """internal -> versioned mapping with apiVersion/kind stamped
+        (the codec's encode direction)."""
+        typ = self._kinds.get((api_version, kind))
+        if typ is None:
+            raise SchemeError([
+                f'no kind "{kind}" is registered for version "{api_version}"'
+            ])
+        versioned = self.convert(obj, typ)
+        out = {"apiVersion": api_version, "kind": kind}
+        out.update(_dataclass_to_doc(versioned))
+        return out
+
+
+def _build_dataclass(typ: Type, doc: dict, path: str):
+    """Strict recursive dataclass construction: every key must name a
+    field; mapping-valued fields whose type is itself a dataclass recurse
+    with an extended field path (the shape of field-path errors in
+    apimachinery validation)."""
+    if not isinstance(doc, dict):
+        raise SchemeError([f"{path}: expected a mapping"])
+    fields = {f.name: f for f in dataclasses.fields(typ)}
+    errs: List[str] = []
+    kw: dict = {}
+    for key, val in doc.items():
+        f = fields.get(key)
+        if f is None:
+            errs.append(f"{path}.{key}: unknown field")
+            continue
+        ftyp = f.type if isinstance(f.type, type) else None
+        # resolve string annotations against the dataclass's module (under
+        # `from __future__ import annotations` every annotation is its
+        # SOURCE text — an explicitly-quoted one keeps its quote chars)
+        if ftyp is None and isinstance(f.type, str):
+            import sys
+
+            mod = sys.modules.get(typ.__module__)
+            ftyp = getattr(mod, f.type.strip("'\""), None)
+        if ftyp is not None and dataclasses.is_dataclass(ftyp) and not (
+                dataclasses.is_dataclass(type(val))):
+            try:
+                kw[key] = _build_dataclass(ftyp, val, f"{path}.{key}")
+            except SchemeError as e:
+                errs.extend(e.errors)
+        else:
+            kw[key] = val
+    if errs:
+        raise SchemeError(errs)
+    try:
+        return typ(**kw)
+    except TypeError as e:
+        raise SchemeError([f"{path}: {e}"])
+
+
+def _dataclass_to_doc(obj) -> dict:
+    """Versioned dataclass -> plain mapping, recursing into nested
+    dataclasses, dropping None (the wire form omits unset pointers)."""
+    out = {}
+    for f in dataclasses.fields(obj):
+        v = getattr(obj, f.name)
+        if v is None:
+            continue
+        if dataclasses.is_dataclass(type(v)):
+            v = _dataclass_to_doc(v)
+        out[f.name] = v
+    return out

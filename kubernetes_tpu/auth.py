@@ -1,0 +1,173 @@
+"""Authentication and authorization for the REST facade.
+
+The reference fronts every apiserver request with a filter chain in a
+fixed order — authentication, then authorization, then admission
+(staging/src/k8s.io/apiserver/pkg/endpoints/filters/authentication.go:41
+WithAuthentication, authorization.go:42 WithAuthorization; chain assembly
+in pkg/server/config.go:639 DefaultBuildHandlerChain).  This module is
+that chain's TPU-framework analog, sized to the hollow control plane:
+
+- :class:`TokenAuthenticator` — the static bearer-token table
+  (plugin/pkg/authenticator/token/tokenfile/tokenfile.go:48): maps
+  ``Authorization: Bearer <token>`` to a :class:`UserInfo`.  Unknown
+  token => 401.  Absent credentials fall through to the anonymous user
+  ``system:anonymous`` in group ``system:unauthenticated`` when
+  ``anonymous`` is on (pkg/authentication/request/anonymous/anonymous.go:30),
+  else 401.
+- :class:`RuleAuthorizer` — an RBAC-lite rule list: each
+  :class:`Rule` names subjects (users and/or groups) and the
+  verbs/resources/namespaces they may touch, "*" wildcards allowed
+  (the shape of rbac/v1 PolicyRule, plugin/pkg/auth/authorizer/rbac/rbac.go:79
+  RuleAllows).  First matching rule allows; no match => deny
+  (RBAC is allow-only, deny is the absence of a grant).
+- :class:`AlwaysAllow` / :class:`AlwaysDeny` — the trivial authorizers
+  (pkg/auth/authorizer/abac ... authorizerfactory/builtin.go:26).
+- :func:`chain` — union of authorizers: first non-NO_OPINION decision
+  wins (pkg/authorization/union/union.go:47).
+
+The REST server (restapi.py) runs authenticate -> authorize before any
+handler logic, returns Status-shaped 401/403, and stamps the resolved
+identity into the audit entry (the reference's audit events carry
+``user.username`` the same way — apis/audit/types.go Event.User).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, NamedTuple, Optional, Sequence
+
+ALLOW = "allow"
+DENY = "deny"
+NO_OPINION = "no-opinion"
+
+
+class UserInfo(NamedTuple):
+    """user.Info (staging/src/k8s.io/apiserver/pkg/authentication/user/user.go:20)."""
+
+    name: str
+    groups: tuple = ()
+    uid: str = ""
+
+
+ANONYMOUS = UserInfo(name="system:anonymous",
+                     groups=("system:unauthenticated",))
+
+
+class Unauthenticated(Exception):
+    """Raised by an authenticator for a request that presented invalid
+    credentials (distinct from presenting none: invalid never falls
+    through to anonymous — authentication.go:50 'if err != nil ...401')."""
+
+
+class TokenAuthenticator:
+    """Static token table: ``{token: UserInfo}``.
+
+    ``authenticate(headers)`` returns the matched user, the anonymous
+    user (when enabled) for credential-less requests, or raises
+    :class:`Unauthenticated` for a malformed/unknown token."""
+
+    def __init__(self, tokens: dict, anonymous: bool = False) -> None:
+        for t, u in tokens.items():
+            if not isinstance(u, UserInfo):
+                raise TypeError(f"token {t!r} must map to UserInfo, got {u!r}")
+        self.tokens = dict(tokens)
+        self.anonymous = anonymous
+
+    def authenticate(self, headers) -> UserInfo:
+        raw = headers.get("Authorization", "") if headers else ""
+        parts = raw.split(None, 1)
+        if not raw or len(parts) != 2 or parts[0].lower() != "bearer" \
+                or not parts[1].strip():
+            # a non-Bearer scheme or empty token is NO OPINION, not a
+            # failure (bearertoken.go:30 returns nil,false,nil) — it
+            # falls through to the anonymous authenticator when enabled
+            if self.anonymous:
+                return ANONYMOUS
+            raise Unauthenticated("no credentials provided")
+        user = self.tokens.get(parts[1].strip())
+        if user is None:
+            # a PRESENT-but-unknown bearer token is a hard failure and
+            # never becomes anonymous (bearertoken.go:41 invalid token)
+            raise Unauthenticated("invalid bearer token")
+        return user
+
+
+class Attributes(NamedTuple):
+    """authorizer.Attributes (authorization/authorizer/interfaces.go:28):
+    who is doing what to which resource."""
+
+    user: UserInfo
+    verb: str  # get/list/watch/create/update/delete
+    resource: str  # pods/nodes/bindings/...
+    namespace: str = ""
+    name: str = ""
+
+
+class Rule(NamedTuple):
+    """One allow-rule. Empty/"*" entries are wildcards. ``subjects``
+    match either the username or any group the user carries."""
+
+    subjects: tuple  # usernames and/or group names
+    verbs: tuple = ("*",)
+    resources: tuple = ("*",)
+    namespaces: tuple = ("*",)
+
+    def matches(self, a: Attributes) -> bool:
+        subj = set(self.subjects)
+        if "*" not in subj and a.user.name not in subj and not (
+                subj & set(a.user.groups)):
+            return False
+
+        def hit(allowed: tuple, value: str) -> bool:
+            return "*" in allowed or value in allowed
+
+        return (hit(self.verbs, a.verb) and hit(self.resources, a.resource)
+                and hit(self.namespaces, a.namespace))
+
+
+class RuleAuthorizer:
+    """Allow iff any rule matches; otherwise NO_OPINION so a chain can
+    consult the next authorizer (rbac.go:79 — RBAC never denies, it
+    just fails to allow)."""
+
+    def __init__(self, rules: Iterable[Rule]) -> None:
+        self.rules = tuple(rules)
+
+    def authorize(self, a: Attributes) -> str:
+        return ALLOW if any(r.matches(a) for r in self.rules) else NO_OPINION
+
+
+class AlwaysAllow:
+    def authorize(self, a: Attributes) -> str:
+        return ALLOW
+
+
+class AlwaysDeny:
+    def authorize(self, a: Attributes) -> str:
+        return DENY
+
+
+class _Union:
+    def __init__(self, members: Sequence) -> None:
+        self.members = tuple(members)
+
+    def authorize(self, a: Attributes) -> str:
+        for m in self.members:
+            d = m.authorize(a)
+            if d != NO_OPINION:
+                return d
+        return NO_OPINION
+
+
+def chain(*authorizers) -> _Union:
+    """Union authorizer: first ALLOW or DENY wins; all-NO_OPINION is a
+    deny at the filter (union/union.go:47 + authorization.go:64)."""
+    return _Union(authorizers)
+
+
+def forbidden_message(a: Attributes) -> str:
+    """The reference's 403 message shape (responsewriters/errors.go:29):
+    'User \"x\" cannot create resource \"pods\" in namespace \"ns\"'."""
+    where = (f' in namespace "{a.namespace}"' if a.namespace
+             else " at the cluster scope")
+    return (f'User "{a.user.name}" cannot {a.verb} resource '
+            f'"{a.resource}"{where}')

@@ -6,10 +6,14 @@ metrics server → leader election → the scheduling loop.
     python -m kubernetes_tpu --config scheduler.yaml
     python -m kubernetes_tpu --validate-only --config scheduler.yaml
 
-The config file is the versioned ``KubeSchedulerConfiguration`` in YAML or
-JSON (apis/config/types.go:43 field meanings; snake_case keys). Flags
-override file values the way the reference's options layer overlays the
-decoded object (app/options/options.go). Invalid configs are rejected with
+The config file is the ``KubeSchedulerConfiguration`` in YAML or JSON
+(apis/config/types.go:43 field meanings) in one of two formats:
+``apiVersion: kubescheduler.config.k8s.io/v1alpha1``-tagged files use
+the VERSIONED wire spelling (camelCase keys, duration strings, v1alpha1
+defaulting — decoded through the api.scheme pipeline); untagged files
+use this implementation's native snake_case spelling. Flags override
+file values the way the reference's options layer overlays the decoded
+object (app/options/options.go). Invalid configs are rejected with
 field-path errors like ``apis/config/validation`` does.
 """
 
@@ -109,9 +113,28 @@ _LE_FIELDS = {f.name for f in dataclasses.fields(LeaderElectionConfig)}
 
 def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
     """Decode a mapping into the typed config, rejecting unknown fields
-    (the reference's strict ComponentConfig decode fails on unknowns)."""
+    (the reference's strict ComponentConfig decode fails on unknowns).
+
+    An ``apiVersion``/``kind`` pair the scheme recognizes routes through
+    the VERSIONED pipeline (build strict camelCase v1alpha1 -> default ->
+    convert to internal — apis/config/scheme); untagged mappings use this
+    implementation's native snake_case decode."""
     if not isinstance(doc, dict):
         raise ConfigError([f"{path or 'config'}: expected a mapping"])
+    api_version = doc.get("apiVersion", "")
+    if api_version:
+        from kubernetes_tpu.api.config_v1alpha1 import SCHEME
+        from kubernetes_tpu.api.scheme import SchemeError
+
+        if SCHEME.recognizes(api_version, doc.get("kind", "")):
+            try:
+                return SCHEME.decode(doc, KubeSchedulerConfiguration)
+            except SchemeError as e:
+                raise ConfigError(e.errors)
+        raise ConfigError([
+            f"apiVersion: no kind {doc.get('kind', '')!r} registered for "
+            f"{api_version!r}"
+        ])
     errs: List[str] = []
     kw: dict = {}
     for key, val in doc.items():

@@ -71,6 +71,20 @@ class FeatureGates:
             raise ValueError(f"unknown feature gate {name!r}")
         return self._gates[name]
 
+    # mutable (set_from_string), so equality only — no __hash__
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, FeatureGates)
+                and self._gates == other._gates)
+
+    __hash__ = None
+
+    def overrides(self) -> Dict[str, bool]:
+        """Gates differing from the process defaults — the round-trippable
+        spec (what a --feature-gates flag or versioned config would need
+        to say to reproduce this object)."""
+        return {k: v for k, v in self._gates.items()
+                if DEFAULT_FEATURE_GATES[k] != v}
+
 
 #: process-default gates (utilfeature.DefaultFeatureGate analog)
 default_feature_gates = FeatureGates()

@@ -41,6 +41,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from kubernetes_tpu.admission import AdmissionError
+from kubernetes_tpu.auth import (
+    ALLOW,
+    Attributes,
+    Unauthenticated,
+    forbidden_message,
+)
 from kubernetes_tpu.extender import node_to_json, pod_to_json
 from kubernetes_tpu.grpc_shim import node_from_json
 from kubernetes_tpu.server import pod_from_json
@@ -69,7 +75,7 @@ class AuditLog:
         self._lock = threading.Lock()
 
     def record(self, verb: str, path: str, code: int, latency_s: float,
-               body=None) -> None:
+               body=None, user=None) -> None:
         if self.level == "None":
             return
         entry = {
@@ -79,6 +85,11 @@ class AuditLog:
             "code": code,
             "latency_s": round(latency_s, 6),
         }
+        if user is not None:
+            # audit events carry the authenticated identity
+            # (apis/audit/types.go Event.User)
+            entry["user"] = {"username": user.name,
+                             "groups": list(user.groups)}
         if self.level == "Request" and body is not None:
             entry["requestObject"] = body
         with self._lock:
@@ -121,9 +132,28 @@ class RestServer:
     WATCH_WINDOW = 2000
 
     def __init__(self, hub: HollowCluster, host: str = "127.0.0.1",
-                 port: int = 0, audit: "AuditLog | None" = None) -> None:
+                 port: int = 0, audit: "AuditLog | None" = None,
+                 authn=None, authz=None) -> None:
+        """``authn``/``authz`` install the reference's request filter
+        chain in its order — authentication, then authorization, then
+        the handler (admission runs inside create paths), per
+        DefaultBuildHandlerChain (apiserver pkg/server/config.go:639).
+        ``authn=None`` (default) keeps the facade open — the reference's
+        --anonymous-auth + AlwaysAllow development posture. ``authz``
+        defaults to AlwaysAllow when only ``authn`` is given."""
         self.hub = hub
         self.audit = audit
+        if authz is not None and authn is None:
+            # an authorizer without an authenticator would silently
+            # enforce NOTHING (no identity to authorize) — refuse the
+            # looks-configured-but-open posture outright
+            raise ValueError(
+                "authz requires authn (enable anonymous auth via "
+                "TokenAuthenticator(tokens, anonymous=True) to authorize "
+                "credential-less requests)"
+            )
+        self.authn = authn
+        self.authz = authz
         # the anchor cursor pins the hub's auto-compaction floor so that
         # stateless HTTP watchers (transient cursors) can resume from an
         # rv they saw in an earlier poll; _trim (run on every request)
@@ -175,6 +205,8 @@ class RestServer:
                 outer._begin(self)
                 t0 = time.perf_counter()
                 try:
+                    if not outer._auth(self, "GET"):
+                        return
                     # reads hold the same lock as mutations (and as
                     # hub.step()): a list comprehension over a hub dict
                     # must never race a concurrent create/delete. The
@@ -191,6 +223,8 @@ class RestServer:
                 outer._begin(self)
                 t0 = time.perf_counter()
                 try:
+                    if not outer._auth(self, "POST"):
+                        return
                     with outer._lock:
                         outer._post(self)
                 finally:
@@ -200,6 +234,8 @@ class RestServer:
                 outer._begin(self)
                 t0 = time.perf_counter()
                 try:
+                    if not outer._auth(self, "PUT"):
+                        return
                     with outer._lock:
                         outer._put(self)
                 finally:
@@ -209,6 +245,8 @@ class RestServer:
                 outer._begin(self)
                 t0 = time.perf_counter()
                 try:
+                    if not outer._auth(self, "DELETE"):
+                        return
                     with outer._lock:
                         outer._delete(self)
                 finally:
@@ -251,29 +289,70 @@ class RestServer:
         self._trim()
         h._code = 0
         h._audit_body = None
+        h._user = None
+
+    def _auth(self, h, http_verb: str) -> bool:
+        """The authentication -> authorization filter pair, ahead of all
+        handler logic (WithAuthentication/WithAuthorization,
+        endpoints/filters/authentication.go:41, authorization.go:42).
+        Returns False after sending the Status-shaped 401/403."""
+        if self.authn is None:
+            return True
+        try:
+            user = self.authn.authenticate(h.headers)
+        except Unauthenticated as e:
+            h._fail(401, "Unauthorized", str(e))
+            return False
+        h._user = user
+        verb, resource, ns, name = self.request_info(http_verb, h.path)
+        attrs = Attributes(user=user, verb=verb, resource=resource,
+                           namespace=ns, name=name)
+        authz = self.authz
+        if authz is not None and authz.authorize(attrs) != ALLOW:
+            h._fail(403, "Forbidden", forbidden_message(attrs))
+            return False
+        return True
+
+    @staticmethod
+    def request_info(http_verb: str, path: str):
+        """(verb, resource, namespace, name) for authorization — the
+        RequestInfo resolver (endpoints/request/requestinfo.go:158):
+        POSITIONAL segments only, GET on an exact collection route is
+        "list", "watch" only as the segment after the version prefix,
+        subresources join the resource as "pods/binding" (the rbac/v1
+        resource spelling)."""
+        seg = RestServer._route(path.split("?", 1)[0])
+        verb = {"GET": "get", "POST": "create", "PUT": "update",
+                "DELETE": "delete"}.get(http_verb, http_verb.lower())
+        if not seg:
+            return verb, "", "", ""
+        if seg[0] == "watch":
+            return "watch", seg[1] if len(seg) > 1 else "", "", ""
+        ns = name = ""
+        resource, rest = seg[0], seg[1:]
+        if seg[0] == "namespaces" and len(seg) >= 3:
+            ns, resource, rest = seg[1], seg[2], seg[3:]
+        if rest:
+            name = rest[0]
+            if len(rest) >= 2:
+                resource = f"{resource}/{rest[1]}"
+        elif verb == "get":
+            verb = "list"
+        return verb, resource, ns, name
 
     def _record_audit(self, h, verb: str, t0: float) -> None:
         if self.audit is None:
             return
         path = h.path
         if verb == "get":
-            # apiserver verb resolution (request.go RequestInfo) is
-            # POSITIONAL: "watch" only as the segment right after the
-            # version prefix, "list" only for exact collection routes —
-            # substring checks would misread a namespace or node that
-            # happens to be NAMED watch/pods/nodes
-            seg = self._route(path.split("?", 1)[0]) or []
-            if seg[:1] == ["watch"]:
-                verb = "watch"
-            elif seg in (["pods"], ["nodes"], ["services"], ["endpoints"],
-                         ["events"]) or (
-                    len(seg) == 3 and seg[0] == "namespaces"
-                    and seg[2] in ("pods", "services", "endpoints",
-                                   "events")):
-                verb = "list"
+            # one resolver for audit AND authorization (request_info):
+            # positional RequestInfo semantics — "watch" only right after
+            # the version prefix, "list" only for nameless collections
+            verb = self.request_info("GET", path)[0]
         self.audit.record(verb, path, getattr(h, "_code", 0),
                           time.perf_counter() - t0,
-                          body=getattr(h, "_audit_body", None))
+                          body=getattr(h, "_audit_body", None),
+                          user=getattr(h, "_user", None))
 
     def close(self) -> None:
         self._closed = True

@@ -74,13 +74,28 @@ def test_decode_rejects_unknown_fields():
     assert "not_a_field" in str(ei.value)
 
 
-def test_decode_accepts_apiversion_kind():
+def test_decode_apiversion_routes_through_versioned_scheme():
+    # a recognized apiVersion/kind selects the VERSIONED (camelCase,
+    # defaulted) decode pipeline — apis/config/scheme semantics
     cfg = decode_config({
         "apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
         "kind": "KubeSchedulerConfiguration",
-        "scheduler_name": "s",
+        "schedulerName": "s",
     })
     assert cfg.scheduler_name == "s"
+    # v1alpha1 defaulting applied (NOT the internal default of 100)
+    assert cfg.percentage_of_nodes_to_score == 0
+
+    with pytest.raises(ConfigError) as ei:
+        decode_config({
+            "apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
+            "kind": "KubeSchedulerConfiguration",
+            "scheduler_name": "s",  # snake_case is not the wire spelling
+        })
+    assert "scheduler_name" in str(ei.value)
+
+    with pytest.raises(ConfigError):
+        decode_config({"apiVersion": "nope/v9", "kind": "X"})
 
 
 def test_flag_overlay_and_gates(tmp_path):

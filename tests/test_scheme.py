@@ -1,0 +1,174 @@
+"""Versioned API machinery tests — the runtime.Scheme analog
+(kubernetes_tpu/api/scheme.py) and the scheduler ComponentConfig scheme
+(api/config_v1alpha1.py): decode old-version YAML -> build strict ->
+default -> convert -> validate, and the encode round-trip."""
+
+import pytest
+
+from kubernetes_tpu.api.config_v1alpha1 import (
+    GROUP_VERSION,
+    KIND,
+    KubeSchedulerConfigurationV1alpha1,
+    decode,
+    encode,
+    format_duration,
+    parse_duration,
+)
+from kubernetes_tpu.api.scheme import Scheme, SchemeError
+from kubernetes_tpu.cli import validate_config
+from kubernetes_tpu.config import KubeSchedulerConfiguration
+
+
+# -- durations (metav1.Duration wire form) ----------------------------------
+
+def test_parse_duration_go_forms():
+    assert parse_duration("15s") == 15.0
+    assert parse_duration("1m30s") == 90.0
+    assert parse_duration("2h") == 7200.0
+    assert parse_duration("100ms") == 0.1
+    assert parse_duration("1.5s") == 1.5
+    for bad in ("", "s", "10", "5x", "1m 30s", None, [1]):
+        with pytest.raises(SchemeError):
+            parse_duration(bad)
+
+
+def test_format_duration_round_trips():
+    for s in (0.0, 2.0, 15.0, 90.0, 7200.0, 0.1, 1.5, 3661.0):
+        assert parse_duration(format_duration(s)) == pytest.approx(s)
+    assert format_duration(90.0) == "1m30s"
+    assert format_duration(0.0) == "0s"
+
+
+# -- generic Scheme ---------------------------------------------------------
+
+def test_scheme_rejects_unknown_fields_with_field_paths():
+    s = Scheme()
+    s.register(GROUP_VERSION, KIND, KubeSchedulerConfigurationV1alpha1)
+    with pytest.raises(SchemeError) as ei:
+        s.build(GROUP_VERSION, KIND, {"bogusField": 1,
+                                      "leaderElection": {"alsoBogus": 2}})
+    msgs = ei.value.errors
+    assert any("bogusField" in m for m in msgs)
+    assert any("leaderElection.alsoBogus" in m for m in msgs)
+
+
+def test_scheme_unknown_kind_and_missing_conversion():
+    s = Scheme()
+    with pytest.raises(SchemeError):
+        s.build("v9", "Nope", {})
+    s.register(GROUP_VERSION, KIND, KubeSchedulerConfigurationV1alpha1)
+    v = s.build(GROUP_VERSION, KIND, {})
+    with pytest.raises(SchemeError) as ei:
+        s.convert(v, KubeSchedulerConfiguration)
+    assert "no conversion registered" in str(ei.value)
+
+
+# -- the config scheme end to end -------------------------------------------
+
+def test_decode_versioned_yaml_default_convert_validate():
+    doc = {
+        "apiVersion": GROUP_VERSION,
+        "kind": KIND,
+        "schedulerName": "tpu-sched",
+        "leaderElection": {"leaseDuration": "30s", "renewDeadline": "20s"},
+        "featureGates": {"EvenPodsSpread": False},
+    }
+    cfg = decode(doc)
+    assert isinstance(cfg, KubeSchedulerConfiguration)
+    assert cfg.scheduler_name == "tpu-sched"
+    # explicit values survive conversion; durations parsed to seconds
+    assert cfg.leader_election.lease_duration_s == 30.0
+    assert cfg.leader_election.renew_deadline_s == 20.0
+    # unset nested fields got the v1alpha1 DEFAULTS (defaults.go:42)
+    assert cfg.leader_election.retry_period_s == 2.0
+    assert cfg.leader_election.lock_object_name == "kube-scheduler"
+    assert cfg.hard_pod_affinity_symmetric_weight == 1
+    assert cfg.percentage_of_nodes_to_score == 0  # versioned default
+    assert not cfg.feature_gates.enabled("EvenPodsSpread")
+    # the decoded object passes internal validation
+    assert validate_config(cfg) == []
+
+
+def test_versioned_default_differs_from_internal_default():
+    # the skew the Scheme exists to express: same field, different
+    # defaults per API surface
+    assert KubeSchedulerConfiguration().percentage_of_nodes_to_score == 100
+    assert decode({"apiVersion": GROUP_VERSION,
+                   "kind": KIND}).percentage_of_nodes_to_score == 0
+
+
+def test_encode_decode_round_trip_preserves_fields():
+    cfg = decode({
+        "apiVersion": GROUP_VERSION,
+        "kind": KIND,
+        "schedulerName": "rt",
+        "percentageOfNodesToScore": 37,
+        "bindTimeoutSeconds": 123.0,
+        "solver": "greedy",
+        "perNodeCap": 2,
+        "leaderElection": {"leaderElect": False, "retryPeriod": "3s"},
+        "featureGates": {"EvenPodsSpread": False},
+    })
+    doc = encode(cfg)
+    assert doc["apiVersion"] == GROUP_VERSION and doc["kind"] == KIND
+    assert doc["schedulerName"] == "rt"
+    assert doc["leaderElection"]["retryPeriod"] == "3s"
+    cfg2 = decode(doc)
+    assert cfg2 == cfg
+
+
+def test_decode_bad_duration_and_bad_gate_are_field_errors():
+    with pytest.raises(SchemeError):
+        decode({"apiVersion": GROUP_VERSION, "kind": KIND,
+                "leaderElection": {"leaseDuration": "abc"}})
+    with pytest.raises(SchemeError) as ei:
+        decode({"apiVersion": GROUP_VERSION, "kind": KIND,
+                "featureGates": {"NotAGate": True}})
+    assert "NotAGate" in str(ei.value)
+
+
+def test_conversion_errors_are_scheme_errors_not_raw_exceptions():
+    # a KeyError/ValueError escaping conversion would crash the CLI with
+    # a traceback instead of an 'invalid configuration' message
+    with pytest.raises(SchemeError) as ei:
+        decode({"apiVersion": GROUP_VERSION, "kind": KIND,
+                "bindTimeoutSeconds": "600s"})
+    assert "bindTimeoutSeconds" in str(ei.value)
+    with pytest.raises(SchemeError) as ei:
+        decode({"apiVersion": GROUP_VERSION, "kind": KIND,
+                "algorithmSource": {"policy": {
+                    "priorities": [{"weight": 1}]}}})  # missing 'name'
+    assert "policy" in str(ei.value)
+
+
+def test_direct_convert_of_partial_object_applies_defaults():
+    # the docstring promise: convert() of a raw versioned object (not
+    # via decode) still lands correct defaults, never a TypeError
+    from kubernetes_tpu.api.config_v1alpha1 import (
+        SCHEME,
+        LeaderElectionConfigurationV1alpha1,
+    )
+
+    v = KubeSchedulerConfigurationV1alpha1(
+        schedulerName="s",
+        leaderElection=LeaderElectionConfigurationV1alpha1(
+            leaseDuration="15s"))
+    cfg = SCHEME.convert(v, KubeSchedulerConfiguration)
+    assert cfg.bind_timeout_seconds == 600.0
+    assert cfg.leader_election.renew_deadline_s == 10.0
+    # and the input object was not mutated (defaulting ran on a copy)
+    assert v.bindTimeoutSeconds is None
+
+
+def test_policy_source_converts():
+    doc = {
+        "apiVersion": GROUP_VERSION,
+        "kind": KIND,
+        "algorithmSource": {"policy": {
+            "kind": "Policy",
+            "predicates": [{"name": "PodFitsResources"}],
+            "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+        }},
+    }
+    cfg = decode(doc)
+    assert cfg.policy is not None
