@@ -278,6 +278,26 @@ class StorageClass:
 # ---------------------------------------------------------------------------
 
 
+#: v1.PodPhase values (core/v1/types.go PodPhase) — the hollow lifecycle
+#: runs Pending -> Running -> Succeeded/Failed; deletion is the terminal
+#: observable either way in this hub
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+
+
+@dataclass
+class ReadinessProbe:
+    """The slice of v1.Probe the hollow prober consumes
+    (prober/worker.go): result gates the pod's Ready condition, which in
+    turn gates Endpoints membership. The probe TARGET is hollow — app
+    health is injected per pod via ``hub.set_app_health`` (the fake
+    runtime's answer), so tests drive readiness flips deterministically."""
+
+    initial_delay_s: float = 0.0
+
+
 @dataclass
 class Pod:
     name: str
@@ -342,6 +362,14 @@ class Pod:
     #: ResourceLimitsPriority (priorities/resource_limits.go getResourceLimits:
     #: sum of containers, max'd with init containers).
     limits: Resources = field(default_factory=Resources)
+    #: status.phase — maintained by the hollow kubelet lifecycle pass
+    #: (kuberuntime_manager.go:558 SyncPod compressed to phase hops)
+    phase: str = POD_PENDING
+    #: status Ready condition — meaningful only when ``readiness_probe``
+    #: is set (probe-less pods are ready the moment they run, the
+    #: no-probes default of the reference's status_manager)
+    ready: bool = False
+    readiness_probe: Optional[ReadinessProbe] = None
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"

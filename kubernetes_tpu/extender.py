@@ -59,7 +59,13 @@ def pod_to_json(pod: Pod) -> dict:
                 }
             ],
         },
-        "status": {"nominatedNodeName": pod.nominated_node_name},
+        "status": {
+            "nominatedNodeName": pod.nominated_node_name,
+            "phase": pod.phase,
+            **({"conditions": [{"type": "Ready",
+                                "status": "True" if pod.ready else "False"}]}
+               if pod.readiness_probe is not None else {}),
+        },
     }
 
 

@@ -291,6 +291,78 @@ def serve_grpc(scheduler, address: str = "127.0.0.1:0",
     return server, port
 
 
+class SnapshotDeltaBridge:
+    """The control-plane shim: pumps a hub's watch events to the service
+    as SnapshotDelta messages, preserving cross-kind event order (one
+    delta per contiguous same-kind run — a node delete must not reorder
+    around a pod bind). The deployment shape BASELINE targets: control
+    plane streaming deltas to the TPU VM service.
+
+    ``lock`` (pass the hub's own lock for a threaded driver) is held
+    around list/poll so reads never race hub mutations; the wire send
+    happens OUTSIDE it — a slow stream must not wedge the hub."""
+
+    def __init__(self, hub, client: "GrpcSchedulerClient",
+                 lock=None) -> None:
+        import contextlib
+
+        from kubernetes_tpu.extender import node_to_json, pod_to_json
+
+        self.hub = hub
+        self.client = client
+        self._node_json = node_to_json
+        self._pod_json = pod_to_json
+        self._lock = lock if lock is not None else contextlib.nullcontext()
+        with self._lock:
+            rev, nodes, pods = hub.list_state()
+        d = pb.SnapshotDelta(revision=rev)
+        for nd in nodes.values():
+            d.nodes.add(op=pb.NodeDelta.ADD, name=nd.name,
+                        node_json=json.dumps(node_to_json(nd)))
+        for p in pods.values():
+            d.pods.add(op=pb.PodDelta.ADD, key=p.key(),
+                       pod_json=json.dumps(pod_to_json(p)))
+        list(client.sync_state(iter([d])))
+        self.cursor = hub.watch(rev)
+
+    NODE_OPS = {"ADDED": pb.NodeDelta.ADD,
+                "MODIFIED": pb.NodeDelta.UPDATE,
+                "DELETED": pb.NodeDelta.REMOVE}
+    POD_OPS = {"ADDED": pb.PodDelta.ADD,
+               "MODIFIED": pb.PodDelta.UPDATE,
+               "DELETED": pb.PodDelta.REMOVE}
+
+    def pump(self) -> int:
+        node_ops, pod_ops = self.NODE_OPS, self.POD_OPS
+        with self._lock:
+            events = self.cursor.poll()
+        if not events:
+            return 0
+        deltas = []
+        cur_kind = None
+        d = None
+        for rev, obj_key, etype, obj in events:
+            kind, _, ident = obj_key.partition("/")
+            if kind not in ("nodes", "pods"):
+                continue  # leases/volumes/events are not scheduler feed
+            if d is None or kind != cur_kind:
+                d = pb.SnapshotDelta(revision=rev)
+                deltas.append(d)
+                cur_kind = kind
+            d.revision = rev
+            if kind == "nodes":
+                d.nodes.add(op=node_ops[etype], name=ident,
+                            node_json=(json.dumps(self._node_json(obj))
+                                       if obj is not None else ""))
+            else:
+                d.pods.add(op=pod_ops[etype], key=ident,
+                           pod_json=(json.dumps(self._pod_json(obj))
+                                     if obj is not None else ""))
+        if deltas:
+            list(self.client.sync_state(iter(deltas)))
+        return len(events)
+
+
 class GrpcSchedulerClient:
     """The Go-side shim's view: typed stubs over a channel (what a
     generated *_pb2_grpc.Stub provides)."""

@@ -5,9 +5,12 @@ duration, renew deadline, and retry period. The scheduler only runs while
 leading (app/server.go:261 OnStartedLeading -> sched.Run).
 
 The lock is pluggable: :class:`InMemoryLock` for tests/single-process,
-:class:`FileLock` (atomic rename CAS) for multi-process on one host; a hub
-integration would CAS a Lease API object. The elector is tick-driven (no
-background threads) so the sim/driver controls time."""
+:class:`FileLock` (atomic rename CAS) for multi-process on one host, and
+:class:`LeaseLock` CASing a coordination Lease API object through the
+hub — the reference's production path (resourcelock/leaselock.go via
+interface.go:100), which makes failover observable/mediated by the
+control plane itself. The elector is tick-driven (no background threads)
+so the sim/driver controls time."""
 
 from __future__ import annotations
 
@@ -95,6 +98,31 @@ class FileLock:
                 return True
             finally:
                 fcntl.flock(lockf, fcntl.LOCK_UN)
+
+
+class LeaseLock:
+    """CAS a Lease API object through the hub — the reference's
+    LeasesResourceLock (resourcelock/leaselock.go:86 Update does a
+    client-go Update whose optimistic concurrency is the stored
+    resourceVersion; here that is ``hub.cas_lease``). The rv observed at
+    :meth:`get` bounds the CAS window, so two candidates that both read
+    rv N can never both win the write."""
+
+    def __init__(self, hub, namespace: str = "kube-system",
+                 name: str = "kube-scheduler") -> None:
+        self.hub = hub
+        self.namespace = namespace
+        self.name = name
+        self._rv = 0
+
+    def get(self) -> Optional[LeaderElectionRecord]:
+        record, self._rv = self.hub.get_lease(self.namespace, self.name)
+        return record
+
+    def create_or_update(self, record: LeaderElectionRecord, old) -> bool:
+        return self.hub.cas_lease(
+            self.namespace, self.name, record, self._rv
+        ) is not None
 
 
 class LeaderElector:

@@ -21,17 +21,26 @@ Iterations run in log space for stability. Two implementations: pure jnp
 column logsumexp reductions each fused into one pass per iteration
 (pallas_guide.md patterns; selected via ``use_pallas``/KTPU_PALLAS).
 
-Measured honestly (round 3, CPU): on every workload tried — uniform
-gangs, scarce capacity (96-100% demand), heterogeneous big/small-pod
-gangs — the OT plan produced IDENTICAL placements, scores, and group
-success to the plain argmax path at 4-5x the solve cost. The round
-solver's rotation tie-break + per-node admission cap already delivers
-the pre-spreading the plan provides, and all-or-nothing gang semantics
-are enforced by the driver's reserve/rollback, not the solver. Argmax
-rounds are therefore the default; this path stays as an option (and the
-Pallas VMEM-tiling exemplar) for cost structures with genuinely
-non-uniform cross-pod preferences, where plan-vs-argmax divergence is
-still expected."""
+Measured honestly (rounds 3-4, CPU): on margin-ORDERED workloads —
+uniform gangs, scarce capacity (96-100% demand), heterogeneous
+big/small-pod gangs, image-locality margins — the OT plan produces
+IDENTICAL placements to the argmax rounds at 4-5x the solve cost: the
+round solver's score-ordered per-node admission already reaches the OT
+outcome whenever the contended nodes' scores are strictly ordered.
+Argmax rounds therefore stay the default.
+
+Where the plan DOES win (round 4, scripts/sinkhorn_quality.py): TOP-SCORE
+TIES with asymmetric second choices — two populations tie on scarce "hot"
+nodes but one's fallback is nearly free (hot=10/cold=9) while the other's
+craters (hot=10/cold=0). Argmax admission sees identical bids, so
+tie-breaks hand hot capacity to whichever population is favored by
+ordering (adversarial order: 0/32 steep pods on hot, 2048 aggregate
+affinity points); the transport plan prices hot-column contention so the
+flat rows keep mass on the plentiful near-equal cold columns (16/32,
+2192 points; optimum 2336). Opportunity cost is exactly the term per-pod
+argmax cannot represent — enable ``use_sinkhorn`` for workloads with
+tied contended preferences (pinned by
+tests/test_sinkhorn.py::test_plan_beats_argmax_on_tied_preferences)."""
 
 from __future__ import annotations
 

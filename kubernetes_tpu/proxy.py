@@ -105,6 +105,16 @@ class Endpoints:
 # ---------------------------------------------------------------------------
 
 
+def pod_endpoint_ready(p) -> bool:
+    """The one Endpoints-membership rule (endpoints_controller.go
+    shouldPodBeInEndpoints + the Ready-condition check): bound, not
+    terminating, and — when a readiness probe exists — probe-ready. A
+    probe-less pod is ready as soon as it is placed (the reference's
+    status_manager defaults Ready=true with no probes)."""
+    return bool(p.node_name) and not p.deletion_timestamp and (
+        p.readiness_probe is None or p.ready)
+
+
 class EndpointsController:
     """Reconciles Endpoints objects from (services, pods) truth —
     endpoints_controller.go syncService, driven from the hub's controller
@@ -135,7 +145,7 @@ class EndpointsController:
                 if not svc.selects(p):
                     continue
                 addr = EndpointAddress(p.key(), p.node_name)
-                if p.node_name and not p.deletion_timestamp:
+                if pod_endpoint_ready(p):
                     ready.append(addr)
                 else:
                     not_ready.append(addr)

@@ -130,22 +130,57 @@ class ReplicaSet:
     #: owning Deployment name ("" = standalone) — the ownerReference the
     #: GC pass consults (never inferred from the name)
     owner: str = ""
+    #: owning Deployment's template revision this RS realizes (the
+    #: pod-template-hash analog); orders old RSes during a rollout
+    revision: int = 0
 
 
 @dataclass
 class Deployment:
-    """Hollow deployment controller (pkg/controller/deployment): owns a
-    ReplicaSet sized to ``replicas``; scale() resizes it (rollouts beyond
-    scaling are out of the scheduler's blast radius)."""
+    """Hollow deployment controller (pkg/controller/deployment): one
+    ReplicaSet per template revision. A template change (:meth:`rollout`)
+    bumps the revision; the sync then surges the new RS up and drains the
+    old ones under the maxSurge/maxUnavailable budget — the RollingUpdate
+    reconciliation of rolling.go:31 (reconcileNewReplicaSet /
+    reconcileOldReplicaSets), with "available" = bound in this hollow
+    world. ``max_surge``/``max_unavailable`` take ints or "25%" strings
+    (intstr.GetValueFromIntOrPercent: surge rounds up, unavailable
+    rounds down)."""
 
     name: str
     replicas: int
     cpu_milli: float = 100
     memory: float = 256 * 2**20
     priority: int = 0
+    max_surge: object = 1
+    max_unavailable: object = 1
+    template_rev: int = 0
 
     def rs_name(self) -> str:
-        return f"{self.name}-rs"
+        """Name of the CURRENT revision's ReplicaSet."""
+        return f"{self.name}-rs-{self.template_rev}"
+
+    def rollout(self, cpu_milli=None, memory=None, priority=None) -> None:
+        """Change the pod template -> new revision (the spec update that
+        triggers deployment_controller.go getNewReplicaSet + rolling)."""
+        if cpu_milli is not None:
+            self.cpu_milli = cpu_milli
+        if memory is not None:
+            self.memory = memory
+        if priority is not None:
+            self.priority = priority
+        self.template_rev += 1
+
+
+def _int_or_percent(v, total: int, round_up: bool) -> int:
+    """intstr.GetValueFromIntOrPercent (apimachinery util/intstr): "25%"
+    resolves against ``total``, surge rounds up, unavailable down."""
+    import math
+
+    if isinstance(v, str) and v.endswith("%"):
+        f = float(v[:-1]) / 100.0 * total
+        return int(math.ceil(f) if round_up else math.floor(f))
+    return int(v)
 
 
 @dataclass
@@ -437,6 +472,22 @@ class HollowCluster:
         #: per-object resourceVersion (etcd mod_revision analog)
         self.resource_version: Dict[str, int] = {}
         self._revision = 0  # global etcd revision
+        #: coordination Leases ("ns/name" -> opaque record) — leader
+        #: election CASes these through the hub (resourcelock
+        #: interface.go:100); see get_lease/cas_lease
+        self.leases: Dict[str, object] = {}
+        #: volume API truth ("ns/name" -> PVC, name -> PV/StorageClass):
+        #: owned by the hub so the PV controller pass (reconcile_volumes,
+        #: pv_controller.go:236) and the scheduler's volume binder both
+        #: write through the versioned store
+        self.pvcs: Dict[str, object] = {}
+        self.pvs: Dict[str, object] = {}
+        self.storage_classes: Dict[str, object] = {}
+        #: hollow prober targets: pod key -> app health (default True);
+        #: the fake runtime's answer to readiness probes
+        self.app_health: Dict[str, bool] = {}
+        #: pod key -> Running transition time (probe initialDelay clock)
+        self._started_at: Dict[str, float] = {}
         self.replicasets: Dict[str, ReplicaSet] = {}
         self.deployments: Dict[str, Deployment] = {}
         self.jobs: Dict[str, Job] = {}
@@ -500,6 +551,10 @@ class HollowCluster:
         self.events_v1: Dict[str, object] = {}
         kw.setdefault("event_sink", self.events_recorder.sink())
         self.sched = Scheduler(binder=self.binder, clock=self.clock, **kw)
+        # the scheduler's delayed-binding commits (BindPodVolumes) write
+        # through the hub store so PVC/PV mutations get revisions and
+        # watch events like every other truth write
+        self.sched.volume_binder.writer = self._commit_volume_bind
         self.bound_total = 0
         self.competing_bind_rate = competing_bind_rate
         self.competing_bound = 0
@@ -668,6 +723,8 @@ class HollowCluster:
         pod = self.truth_pods.pop(key, None)
         if pod is not None:
             self._bound_at.pop(key, None)
+            self._started_at.pop(key, None)
+            self.app_health.pop(key, None)
             self._commit(f"pods/{key}", "DELETED", None)
             self._emit(f"pods/{key}", lambda: self.sched.on_pod_delete(pod))
             for rs in self.replicasets.values():
@@ -698,6 +755,149 @@ class HollowCluster:
         self._bound_at[key] = self.clock.t
         self.bound_total += 1
         self._emit(f"pods/{key}", lambda: self.sched.on_pod_update(cur, new))
+
+    def get_lease(self, namespace: str, name: str):
+        """Read a coordination Lease: ``(record, resourceVersion)`` —
+        rv 0 means the Lease does not exist yet (leaselock.go:53 Get)."""
+        with self.lock:
+            return (self.leases.get(f"{namespace}/{name}"),
+                    self.resource_version.get(f"leases/{namespace}/{name}", 0))
+
+    def cas_lease(self, namespace: str, name: str, record,
+                  expected_rv: int):
+        """Create/update a Lease iff its resourceVersion still equals
+        ``expected_rv`` (0 = must-not-exist). Returns the new rv, or None
+        on conflict — the apiserver CAS leader election rides on
+        (resourcelock/interface.go:100; GuaranteedUpdate semantics). The
+        check-and-swap is atomic under the hub lock, which is the whole
+        point of hub-mediated HA: two candidates racing the same rv
+        cannot both win."""
+        with self.lock:
+            obj_key = f"leases/{namespace}/{name}"
+            cur_rv = self.resource_version.get(obj_key, 0)
+            if cur_rv != expected_rv:
+                return None
+            self.leases[f"{namespace}/{name}"] = record
+            return self._commit(obj_key,
+                                "MODIFIED" if cur_rv else "ADDED", record)
+
+    # -- pod lifecycle (hollow kubelet SyncPod + prober) -------------------
+
+    def set_app_health(self, pod_key: str, healthy: bool) -> None:
+        """Inject the hollow app's probe answer (the fake runtime seam —
+        what kubemark's fake CRI would report)."""
+        self.app_health[pod_key] = healthy
+
+    def sync_pod_lifecycle(self) -> None:
+        """One SyncPod pass over all bound pods (kuberuntime_manager.go:558
+        compressed to phase hops; prober/worker.go for readiness):
+
+        - Pending + bound on a live kubelet -> Running (status MODIFIED);
+        - probed pods: Ready once past initialDelay AND the injected app
+          health is good; a later health flip flips Ready back — the
+          probe-failure path the endpoints controller must observe;
+        - probe-less pods never write Ready (they are ready-by-default,
+          see proxy.pod_endpoint_ready).
+
+        One O(P) scan for all nodes, like kubelet_admission."""
+        import dataclasses
+
+        from kubernetes_tpu.api.types import POD_PENDING, POD_RUNNING
+
+        for key, p in list(self.truth_pods.items()):
+            if not p.node_name:
+                continue
+            kl = self.kubelets.get(p.node_name)
+            if kl is None or not kl.alive:
+                continue
+            changes = {}
+            if p.phase == POD_PENDING:
+                changes["phase"] = POD_RUNNING
+                self._started_at[key] = self.clock.t
+            if p.readiness_probe is not None:
+                started = self._started_at.get(key)
+                ready = (
+                    started is not None
+                    and self.clock.t - started >= p.readiness_probe.initial_delay_s
+                    and self.app_health.get(key, True)
+                )
+                if ready != p.ready:
+                    changes["ready"] = ready
+            if changes:
+                new = dataclasses.replace(p, **changes)
+                self.truth_pods[key] = new
+                self._commit(f"pods/{key}", "MODIFIED", new)
+                self._emit(f"pods/{key}",
+                           lambda old=p, new=new: self.sched.on_pod_update(
+                               old, new))
+
+    # -- volume API + PV controller ----------------------------------------
+
+    def add_storage_class(self, sc) -> None:
+        self.storage_classes[sc.name] = sc
+        self._commit(f"storageclasses/{sc.name}", "ADDED", sc)
+        self._sync_volume_state()
+
+    def add_pv(self, pv) -> None:
+        self.pvs[pv.name] = pv
+        self._commit(f"persistentvolumes/{pv.name}", "ADDED", pv)
+        self._sync_volume_state()
+
+    def add_pvc(self, pvc) -> None:
+        self.pvcs[f"{pvc.namespace}/{pvc.name}"] = pvc
+        self._commit(f"persistentvolumeclaims/{pvc.namespace}/{pvc.name}",
+                     "ADDED", pvc)
+        self._sync_volume_state()
+
+    def _sync_volume_state(self) -> None:
+        """Push the hub's volume truth into the scheduler's listers (the
+        PV/PVC/StorageClass informer feed) — invalidates the snapshot and
+        resweeps unschedulables, scheduler.set_volume_state semantics."""
+        self.sched.set_volume_state(
+            list(self.pvcs.values()), list(self.pvs.values()),
+            list(self.storage_classes.values()),
+        )
+
+    def _commit_volume_bind(self, pvc, pv) -> None:
+        """The scheduler's BindPodVolumes write, routed through the hub
+        store: same in-place object mutation as the default writer plus
+        revision bumps + watch events for both objects."""
+        pv.claim_ref = f"{pvc.namespace}/{pvc.name}"
+        pvc.volume_name = pv.name
+        self._commit(f"persistentvolumes/{pv.name}", "MODIFIED", pv)
+        self._commit(f"persistentvolumeclaims/{pvc.namespace}/{pvc.name}",
+                     "MODIFIED", pvc)
+
+    def reconcile_volumes(self) -> None:
+        """The persistent-volume binder controller pass
+        (pv_controller.go:236 syncUnboundClaim): bind each pending
+        IMMEDIATE-mode PVC to an available compatible PV now; a
+        WaitForFirstConsumer claim waits for the scheduler (delayed
+        binding — its syncUnboundClaim branch checks the selected-node
+        annotation and defers). Newly-satisfiable pods wake via the
+        volume-state resweep."""
+        from kubernetes_tpu.api.types import BINDING_WAIT_FOR_FIRST_CONSUMER
+
+        bound_any = False
+        for key, pvc in self.pvcs.items():
+            if pvc.volume_name:
+                continue
+            sc = self.storage_classes.get(pvc.storage_class)
+            if (sc is not None
+                    and sc.binding_mode == BINDING_WAIT_FOR_FIRST_CONSUMER):
+                continue  # the scheduler owns delayed binding
+            assumed = self.sched.cache.packer.vol_state.assumed_claims
+            pick = None
+            for pv in self.pvs.values():
+                if (not pv.claim_ref and pv.name not in assumed
+                        and pv.storage_class == pvc.storage_class):
+                    pick = pv
+                    break
+            if pick is not None:
+                self._commit_volume_bind(pvc, pick)
+                bound_any = True
+        if bound_any:
+            self._sync_volume_state()
 
     def gc_orphaned(self) -> None:
         """Delete truth pods bound to nodes that no longer exist — the
@@ -900,27 +1100,87 @@ class HollowCluster:
             cj.spawned.append(jn)
             cj.next_run += cj.every_s
 
-        # deployment -> replicaset (create/scale)
+        # deployment -> replicasets (create/scale/rolling update)
         for d in self.deployments.values():
-            rs = self.replicasets.get(d.rs_name())
-            if rs is None:
-                rs = ReplicaSet(d.rs_name(), d.replicas, d.cpu_milli,
-                                d.memory, d.priority, owner=d.name)
-                self.replicasets[rs.name] = rs
-            rs.replicas = d.replicas
-        # garbage collector: deployment gone -> cascade its RS + pods
-        # (ownership is the explicit owner field, never a name pattern)
+            new_rs = self.replicasets.get(d.rs_name())
+            olds = [rs for rs in self.replicasets.values()
+                    if rs.owner == d.name and rs.name != d.rs_name()]
+            if new_rs is None:
+                # getNewReplicaSet: the new revision's RS starts at 0 when
+                # an old RS exists (the rolling path scales it), else at
+                # full size (first sync of a fresh deployment)
+                new_rs = ReplicaSet(d.rs_name(), 0 if olds else d.replicas,
+                                    d.cpu_milli, d.memory, d.priority,
+                                    owner=d.name, revision=d.template_rev)
+                self.replicasets[new_rs.name] = new_rs
+            if not olds:
+                new_rs.replicas = d.replicas
+                continue
+            # ---- RollingUpdate reconciliation (rolling.go:31) ----
+            # a mid-rollout SCALE-DOWN must bite immediately: the new RS
+            # never holds more than the (new) desired size, even while
+            # old RSes are still draining (review: without this clamp a
+            # shrink waits for the old RS to empty, holding quota)
+            new_rs.replicas = min(new_rs.replicas, d.replicas)
+            surge = _int_or_percent(d.max_surge, d.replicas, round_up=True)
+            max_unavail = _int_or_percent(d.max_unavailable, d.replicas,
+                                          round_up=False)
+            if surge == 0 and max_unavail == 0:
+                max_unavail = 1  # validation forbids both 0; fail safe
+            # old RSes never grow and never replace lost pods mid-rollout
+            # (the reference only ever scales them down; a dead old pod
+            # is rollout progress, not something to recreate)
+            for rs in olds:
+                rs.replicas = min(rs.replicas, len(rs.live))
+            # reconcileNewReplicaSet: grow the new RS within the surge
+            # budget (NewRSNewReplicas: total may reach replicas+surge)
+            total = new_rs.replicas + sum(rs.replicas for rs in olds)
+            if total < d.replicas + surge:
+                new_rs.replicas = min(
+                    d.replicas, new_rs.replicas + (d.replicas + surge - total)
+                )
+            # reconcileOldReplicaSets: unavailable (unbound) old pods are
+            # free to delete (cleanupUnhealthyReplicas), then drain down
+            # to the availability floor replicas-maxUnavailable
+            def available(rs):
+                return sum(
+                    1 for k in rs.live
+                    if k in self.truth_pods and self.truth_pods[k].node_name
+                )
+
+            for rs in olds:
+                rs.replicas -= min(rs.replicas, len(rs.live) - available(rs))
+            avail_total = available(new_rs) + sum(available(rs) for rs in olds)
+            can_kill = max(0, avail_total - (d.replicas - max_unavail))
+            for rs in sorted(olds, key=lambda r: r.revision):
+                if can_kill <= 0:
+                    break
+                down = min(rs.replicas, can_kill)
+                rs.replicas -= down
+                can_kill -= down
+        # garbage collector: deployment gone -> cascade its RS + pods;
+        # drained old-revision RSes are removed once empty (the hollow
+        # form of revisionHistoryLimit cleanup)
         for name in list(self.replicasets):
             rs = self.replicasets[name]
             if rs.owner and rs.owner not in self.deployments:
                 for key in list(rs.live):
                     self.delete_pod(key)
                 del self.replicasets[name]
-        # replicaset scale-down (deployment shrink or direct resize)
+            elif (rs.owner and rs.replicas == 0 and not rs.live
+                  and rs.owner in self.deployments
+                  and name != self.deployments[rs.owner].rs_name()):
+                del self.replicasets[name]
+        # replicaset scale-down (deployment shrink, rolling drain, or
+        # direct resize) — unassigned pods are deleted first, the
+        # ActivePods ranking of controller_utils.go:722, which is what
+        # keeps the rolling availability budget honest
         for rs in self.replicasets.values():
             extra = len(rs.live) - rs.replicas
             if extra > 0:
-                for key in list(rs.live)[:extra]:
+                victims = sorted(rs.live, key=lambda k: bool(
+                    k in self.truth_pods and self.truth_pods[k].node_name))
+                for key in victims[:extra]:
                     self.delete_pod(key)
         def spawn(prefix: str, idx: int, labels: dict, cpu, mem, pri=0):
             pod = make_pod(f"{prefix}-{idx}", cpu_milli=cpu, memory=mem,
@@ -944,6 +1204,18 @@ class HollowCluster:
                 if t0 is not None and self.clock.t - t0 >= j.duration_s:
                     j.succeeded += 1
                     j.active.pop(key)
+                    # terminal phase hop is observable in the watch
+                    # history BEFORE the delete (Running -> Succeeded ->
+                    # DELETED, the full lifecycle chain)
+                    import dataclasses
+
+                    from kubernetes_tpu.api.types import POD_SUCCEEDED
+
+                    done = dataclasses.replace(
+                        self.truth_pods[key], phase=POD_SUCCEEDED,
+                        ready=False)
+                    self.truth_pods[key] = done
+                    self._commit(f"pods/{key}", "MODIFIED", done)
                     self.delete_pod(key)  # Succeeded -> cleaned up
             while (not j.done()
                    and len(j.active) < j.parallelism
@@ -957,7 +1229,12 @@ class HollowCluster:
         for rs in self.replicasets.values():
             while len(rs.live) < rs.replicas:
                 rs.next_idx += 1
-                pod = spawn(rs.name, rs.next_idx, {"rs": rs.name},
+                # the owner label is revision-stable: a Service selecting
+                # {"deploy": name} spans old and new RSes mid-rollout
+                labels = {"rs": rs.name}
+                if rs.owner:
+                    labels["deploy"] = rs.owner
+                pod = spawn(rs.name, rs.next_idx, labels,
                             rs.cpu_milli, rs.memory, rs.priority)
                 if pod is None:
                     break
@@ -1210,6 +1487,7 @@ class HollowCluster:
         self.gc_orphaned()
         for kl in list(self.kubelets.values()):  # syncLoop ticks
             kl.sync()
+        self.sync_pod_lifecycle()
         self.monitor_node_health()
         self.reconcile_pdbs()
         if self.cloud_controller is not None:
@@ -1218,6 +1496,8 @@ class HollowCluster:
             self.reconcile_namespaces()
             self.quota_controller.reconcile()
         self.reconcile_controllers()
+        if self.pvcs or self.pvs:
+            self.reconcile_volumes()
         if self.services or self.endpoints:
             self.endpoints_controller.reconcile()
             self.sync_proxies()
@@ -1266,14 +1546,37 @@ class HollowCluster:
             for key, svc in self.services.items():
                 ep = self.endpoints.get(key)
                 assert ep is not None, f"service {key} has no Endpoints"
+                from kubernetes_tpu.proxy import pod_endpoint_ready
+
                 want = sorted(
                     p.key() for p in self.truth_pods.values()
-                    if svc.selects(p) and p.node_name and not p.deletion_timestamp
+                    if svc.selects(p) and pod_endpoint_ready(p)
                 )
                 got = sorted(a.pod_key for a in ep.ready)
                 assert got == want, f"{key} endpoints drift: {got} != {want}"
                 for a in ep.ready:
                     assert self.truth_pods[a.pod_key].node_name == a.node_name
+        # volume truth: PVC<->PV binding is mutual and exclusive (the
+        # pv_controller's own invariant: a bound pair references each
+        # other; no PV serves two claims)
+        claimants: Dict[str, str] = {}
+        for key, pvc in self.pvcs.items():
+            if not pvc.volume_name:
+                continue
+            pv = self.pvs.get(pvc.volume_name)
+            assert pv is not None, f"pvc {key} bound to unknown pv"
+            assert pv.claim_ref == key, (
+                f"pv {pv.name} claimRef {pv.claim_ref!r} != {key!r}"
+            )
+            assert claimants.setdefault(pvc.volume_name, key) == key, (
+                f"pv {pvc.volume_name} double-claimed"
+            )
+        for pv in self.pvs.values():
+            if pv.claim_ref:
+                pvc = self.pvcs.get(pv.claim_ref)
+                assert pvc is not None and pvc.volume_name == pv.name, (
+                    f"pv {pv.name} claimRef not reciprocated"
+                )
 
     def pending_count(self) -> int:
         return sum(1 for p in self.truth_pods.values() if not p.node_name)

@@ -748,8 +748,15 @@ class SnapshotPacker:
 
     def set_volume_state(self, pvcs=(), pvs=(), classes=()) -> None:
         """Replace the PVC/PV/StorageClass listers (informer feed analog).
-        All known pods' volumes re-resolve so universes stay complete."""
+        All known pods' volumes re-resolve so universes stay complete.
+        The ASSUME overlay carries over: reservations are binder state,
+        not lister data — an informer relist never clears the reference's
+        pvCache assumptions (assume wins until bind or forget), and a
+        hub-driven re-sync mid-Permit must not leak another claimant onto
+        a reserved PV."""
+        assumed = dict(self.vol_state.assumed_claims)
         self.vol_state = VolumeState.build(pvcs, pvs, classes)
+        self.vol_state.assumed_claims.update(assumed)
         self._vol_cache.clear()
         for pod in self._vol_pods.values():
             self.resolve_volumes(pod)

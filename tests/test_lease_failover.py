@@ -1,0 +1,127 @@
+"""Hub-backed leader election — HA mediated by the control plane itself.
+
+The reference's production leader election CASes a coordination Lease
+API object through the apiserver (resourcelock/leaselock.go, chosen via
+interface.go:100); failover is therefore observable in the object store
+and subject to the same optimistic concurrency as every other write.
+These tests pin that behavior for :class:`LeaseLock` + the hub, up to a
+full scheduler failover with zero double-binds (VERDICT r3 item 8)."""
+
+from kubernetes_tpu.config import LeaderElectionConfig
+from kubernetes_tpu.leaderelection import (
+    LeaderElectionRecord,
+    LeaderElector,
+    LeaseLock,
+)
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.sim import HollowCluster, Reflector
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def test_lease_lock_cas_through_hub():
+    hub = HollowCluster(seed=9)
+    clk = hub.clock
+    cfg = LeaderElectionConfig(lease_duration_s=15)
+    a = LeaderElector("a", LeaseLock(hub), cfg, clk)
+    b = LeaderElector("b", LeaseLock(hub), cfg, clk)
+    assert a.tick() and a.is_leader()
+    assert not b.tick()
+    rec, rv = hub.get_lease("kube-system", "kube-scheduler")
+    assert rec.holder_identity == "a" and rv > 0
+    clk.advance(10)
+    assert a.tick()  # renew CASes a new record -> rv bumps
+    _, rv2 = hub.get_lease("kube-system", "kube-scheduler")
+    assert rv2 > rv
+    assert not b.tick()  # b observes the renewal (expiry clock restarts)
+    # a dies; b steals only after the lease expires from ITS observation
+    clk.advance(14)
+    assert not b.tick()
+    clk.advance(2)
+    assert b.tick() and b.is_leader()
+    rec3, _ = hub.get_lease("kube-system", "kube-scheduler")
+    assert rec3.holder_identity == "b" and rec3.leader_transitions == 1
+
+
+def test_lease_cas_interleaved_single_winner():
+    """Split-brain guard: two candidates that both observed rv N race
+    the CAS; exactly one wins (the atomicity the apiserver provides and
+    hub.cas_lease reproduces under the hub lock)."""
+    hub = HollowCluster(seed=10)
+    la, lb = LeaseLock(hub), LeaseLock(hub)
+    assert la.get() is None and lb.get() is None  # both observe rv 0
+    ra = LeaderElectionRecord(holder_identity="a", renew_time=1.0)
+    rb = LeaderElectionRecord(holder_identity="b", renew_time=1.0)
+    assert la.create_or_update(ra, None)
+    assert not lb.create_or_update(rb, None)  # stale rv -> conflict
+    rec, _ = hub.get_lease("kube-system", "kube-scheduler")
+    assert rec.holder_identity == "a"
+
+
+def test_scheduler_failover_no_double_binds_queue_continuity():
+    """Kill the leader mid-run; the standby acquires the Lease through
+    the hub and finishes the queue. Every pod binds exactly once and
+    pods created before the failover are not lost."""
+    hub = HollowCluster(seed=11)
+    for i in range(4):
+        hub.add_node(make_node(f"n{i}", cpu_milli=4000))
+
+    clk = hub.clock
+    cfg = LeaderElectionConfig(
+        lease_duration_s=15, renew_deadline_s=10, retry_period_s=2
+    )
+
+    class Agent:
+        """One HA scheduler replica: elector + reflector-fed scheduler
+        binding through the hub (app/server.go:261 — the scheduling loop
+        runs only while leading)."""
+
+        def __init__(self, name):
+            self.sched = Scheduler(binder=hub.binder, clock=clk,
+                                   enable_preemption=False)
+            self.reflector = Reflector(hub, self.sched)
+            self.reflector.list_and_watch()
+            self.elector = LeaderElector(name, LeaseLock(hub), cfg, clk)
+            self.cycles = 0
+
+        def tick(self):
+            self.reflector.pump()  # informers run on leaders AND standbys
+            if self.elector.tick():
+                self.sched.schedule_cycle()
+                self.cycles += 1
+
+    a, b = Agent("a"), Agent("b")
+
+    for i in range(6):
+        hub.create_pod(make_pod(f"pre{i}", cpu_milli=500))
+    for _ in range(3):
+        a.tick()
+        b.tick()
+        clk.advance(2)
+    assert a.cycles > 0 and b.cycles == 0  # only the leader schedules
+    assert sum(1 for p in hub.truth_pods.values() if p.node_name) == 6
+
+    # pods created while the leader is dying: the standby must pick
+    # them up after failover (queue continuity through list+watch)
+    for i in range(6):
+        hub.create_pod(make_pod(f"mid{i}", cpu_milli=500))
+    # 'a' dies (stops ticking). 'b' keeps ticking and takes over once
+    # the lease expires from its last observation of a's renew.
+    took_over_at = None
+    for _ in range(12):
+        b.tick()
+        if b.elector.is_leader() and took_over_at is None:
+            took_over_at = clk()
+        clk.advance(2)
+    assert took_over_at is not None, "standby never acquired the lease"
+    assert b.cycles > 0
+    rec, _ = hub.get_lease("kube-system", "kube-scheduler")
+    assert rec.holder_identity == "b" and rec.leader_transitions == 1
+
+    # zero double-binds: every pod bound exactly once, CAS conflicts 0
+    assert hub.bound_total == 12
+    bound = {k: p.node_name for k, p in hub.truth_pods.items()}
+    assert all(bound.values()), bound
+    assert hub.binder.conflicts == 0
+    # queue continuity: the mid-failover pods all landed
+    assert all(bound[f"default/mid{i}"] for i in range(6))
+    hub.check_consistency()
