@@ -93,23 +93,34 @@ class TokenAuthenticator:
 
 class Attributes(NamedTuple):
     """authorizer.Attributes (authorization/authorizer/interfaces.go:28):
-    who is doing what to which resource."""
+    who is doing what to which resource. A NON-resource request
+    (discovery, /openapi/v2, /version — IsResourceRequest false) carries
+    ``resource=""`` and the raw ``path`` instead, matched by a Rule's
+    ``non_resource_urls`` the way RBAC's NonResourceURLs work."""
 
     user: UserInfo
     verb: str  # get/list/watch/create/update/delete
-    resource: str  # pods/nodes/bindings/...
+    resource: str  # pods/nodes/bindings/...; "" = non-resource request
     namespace: str = ""
     name: str = ""
+    path: str = ""  # non-resource URL (set iff resource == "")
 
 
 class Rule(NamedTuple):
     """One allow-rule. Empty/"*" entries are wildcards. ``subjects``
-    match either the username or any group the user carries."""
+    match either the username or any group the user carries.
+    ``non_resource_urls`` grants NON-resource paths (rbac/v1
+    PolicyRule.NonResourceURLs, matched by rbac.go:170
+    NonResourceURLMatches): exact paths or a trailing-``*`` prefix like
+    ``"/api/*"`` — a rule with them set matches ONLY non-resource
+    requests, and resource rules never match non-resource requests
+    (``resources=("*",)`` still means every RESOURCE, not discovery)."""
 
     subjects: tuple  # usernames and/or group names
     verbs: tuple = ("*",)
     resources: tuple = ("*",)
     namespaces: tuple = ("*",)
+    non_resource_urls: tuple = ()
 
     def matches(self, a: Attributes) -> bool:
         subj = set(self.subjects)
@@ -120,7 +131,17 @@ class Rule(NamedTuple):
         def hit(allowed: tuple, value: str) -> bool:
             return "*" in allowed or value in allowed
 
-        return (hit(self.verbs, a.verb) and hit(self.resources, a.resource)
+        if not hit(self.verbs, a.verb):
+            return False
+        if not a.resource:  # non-resource request: only URL rules apply
+            return any(
+                a.path == pat or (pat.endswith("*")
+                                  and a.path.startswith(pat[:-1]))
+                for pat in self.non_resource_urls
+            )
+        if self.non_resource_urls:
+            return False  # URL rules never grant resource requests
+        return (hit(self.resources, a.resource)
                 and hit(self.namespaces, a.namespace))
 
 
@@ -166,7 +187,10 @@ def chain(*authorizers) -> _Union:
 
 def forbidden_message(a: Attributes) -> str:
     """The reference's 403 message shape (responsewriters/errors.go:29):
-    'User \"x\" cannot create resource \"pods\" in namespace \"ns\"'."""
+    'User \"x\" cannot create resource \"pods\" in namespace \"ns\"';
+    non-resource requests name the path instead."""
+    if not a.resource:
+        return f'User "{a.user.name}" cannot {a.verb} path "{a.path}"'
     where = (f' in namespace "{a.namespace}"' if a.namespace
              else " at the cluster scope")
     return (f'User "{a.user.name}" cannot {a.verb} resource '

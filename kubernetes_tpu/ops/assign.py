@@ -267,6 +267,13 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
     if vol is not None and static_vol is None:
         static_vol = static_volume_reasons(pods, nodes, sel, vol,
                                            prog=hoisted[1])
+    # usage-invariant SCORING slice, once per batch: the static kernels'
+    # full matrices + the static raw map phases (ops/priorities.py
+    # hoist_priorities) — the round loop then pays only the per-round
+    # mask-dependent normalizes and the genuinely dynamic kernels
+    from kubernetes_tpu.ops.priorities import hoist_priorities
+
+    hoisted_prio = hoist_priorities(pods, nodes, sel, weights, skip_key)
     if topo is not None and not (no_pod_affinity and no_spread):
         from kubernetes_tpu.ops.topology import sensitive_keys
 
@@ -294,7 +301,7 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
             & extra_mask
         )
         score = run_priorities(pods, cur, sel, mask, weights, topo,
-                               skip=skip_key)
+                               skip=skip_key, hoisted=hoisted_prio)
         if extra_score is not None:
             score = score + extra_score
         # ---- bidder window: the next K pods the serial loop would pop ----

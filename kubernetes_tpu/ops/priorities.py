@@ -93,24 +93,36 @@ def balanced_allocation(pods, nodes, sel, topo, mask) -> jnp.ndarray:
     return jnp.where((cf >= 1.0) | (mf >= 1.0), 0.0, score)
 
 
+def _node_affinity_raw(pods, nodes, sel) -> jnp.ndarray:
+    """Usage-invariant raw weight sums (the map phase) — hoistable out of
+    the round loop; only the mask-dependent NormalizeReduce is per-round."""
+    prog = preferred_program_score(sel, nodes)  # (Gp, N)
+    idx = jnp.clip(pods.prefprog_id, 0, prog.shape[0] - 1)
+    return jnp.where((pods.prefprog_id >= 0)[:, None], prog[idx], 0.0)
+
+
 def node_affinity(pods, nodes, sel, topo, mask) -> jnp.ndarray:
     """node_affinity.go: weight-sum of matched PreferredDuringScheduling
     terms, NormalizeReduce(10, false)."""
-    prog = preferred_program_score(sel, nodes)  # (Gp, N)
-    idx = jnp.clip(pods.prefprog_id, 0, prog.shape[0] - 1)
-    raw = jnp.where((pods.prefprog_id >= 0)[:, None], prog[idx], 0.0)
-    return _normalize_reduce(raw, mask, reverse=False)
+    return _normalize_reduce(_node_affinity_raw(pods, nodes, sel), mask,
+                             reverse=False)
+
+
+def _taint_toleration_raw(pods, nodes, sel) -> jnp.ndarray:
+    """Usage-invariant intolerable-taint counts (taints never change
+    within a batch) — the matmul half of the kernel, hoistable."""
+    tol_idx = jnp.clip(pods.tolset_id, 0, sel.tol_soft_mh.shape[0] - 1)
+    tol_rows = jnp.where((pods.tolset_id >= 0)[:, None], sel.tol_soft_mh[tol_idx], 0.0)
+    soft_count = jnp.sum(nodes.taint_soft_mh, axis=1)  # (N,)
+    tolerated = tol_rows @ nodes.taint_soft_mh.T  # (P, N)
+    return soft_count[None, :] - tolerated
 
 
 def taint_toleration(pods, nodes, sel, topo, mask) -> jnp.ndarray:
     """taint_toleration.go: count PreferNoSchedule taints not tolerated,
     NormalizeReduce(10, reverse=true)."""
-    tol_idx = jnp.clip(pods.tolset_id, 0, sel.tol_soft_mh.shape[0] - 1)
-    tol_rows = jnp.where((pods.tolset_id >= 0)[:, None], sel.tol_soft_mh[tol_idx], 0.0)
-    soft_count = jnp.sum(nodes.taint_soft_mh, axis=1)  # (N,)
-    tolerated = tol_rows @ nodes.taint_soft_mh.T  # (P, N)
-    intolerable = soft_count[None, :] - tolerated
-    return _normalize_reduce(intolerable, mask, reverse=True)
+    return _normalize_reduce(_taint_toleration_raw(pods, nodes, sel), mask,
+                             reverse=True)
 
 
 def image_locality(pods, nodes, sel, topo, mask) -> jnp.ndarray:
@@ -415,6 +427,48 @@ def solver_gates(node_table, pod_table):
             "EvenPodsSpreadPriority" in skip)
 
 
+#: stock kernels whose full (P, N) score reads NO usage field and NO mask
+#: — computable once per batch and reused every round verbatim
+STATIC_FULL = ("ImageLocalityPriority", "NodePreferAvoidPodsPriority",
+               "ResourceLimitsPriority")
+#: stock kernels whose RAW map phase is usage-invariant but whose
+#: NormalizeReduce depends on the per-round feasibility mask:
+#: name -> (raw_fn, reverse)
+STATIC_RAW = {
+    "NodeAffinityPriority": (_node_affinity_raw, False),
+    "TaintTolerationPriority": (_taint_toleration_raw, True),
+}
+
+
+def hoist_priorities(pods, nodes, sel,
+                     weights: Dict[str, float] | None = None,
+                     skip=()) -> Dict[str, tuple]:
+    """The usage-invariant slice of scoring, computed ONCE per batch (the
+    device analog of the reference computing plugin-independent state
+    once per pod — and the round-4 answer to the profile finding that the
+    static kernels were ~2/3 of per-round scoring cost,
+    benchres/solver_profile_cpu.json). Returns ``{name: ("full", matrix)
+    | ("raw", raw_matrix, reverse)}`` for :func:`run_priorities` to
+    consume; skipped (gated) and custom-registered kernels are NOT
+    hoisted — the gate constant-folds the former and the latter's
+    static-ness is unknown."""
+    weights = DEFAULT_WEIGHTS if weights is None else weights
+    parts: Dict[str, tuple] = {}
+    for name, w in weights.items():
+        if not w or name in skip:
+            continue
+        if PRIORITY_REGISTRY.get(name) is not _STOCK_KERNELS.get(name):
+            continue
+        if name in STATIC_FULL:
+            parts[name] = ("full",
+                           PRIORITY_REGISTRY[name](pods, nodes, sel, None,
+                                                   None))
+        elif name in STATIC_RAW:
+            raw_fn, reverse = STATIC_RAW[name]
+            parts[name] = ("raw", raw_fn(pods, nodes, sel), reverse)
+    return parts
+
+
 def run_priorities(
     pods: DevicePods,
     nodes: DeviceNodes,
@@ -423,12 +477,17 @@ def run_priorities(
     weights: Dict[str, float] | None = None,
     topo=None,
     skip=(),
+    hoisted: Dict[str, tuple] | None = None,
 ) -> jnp.ndarray:
     """PrioritizeNodes (generic_scheduler.go:684): weighted sum of all
     enabled priorities -> (P, N) f32 total score. ``skip`` names kernels
     (from :func:`empty_priorities`) replaced by their exact
-    :data:`EMPTY_CONSTANTS` scalar."""
+    :data:`EMPTY_CONSTANTS` scalar. ``hoisted`` takes
+    :func:`hoist_priorities` output; accumulation stays in weights-dict
+    order with identical per-kernel arithmetic, so hoisted and unhoisted
+    totals are bit-identical (pinned by tests/test_priorities.py)."""
     weights = DEFAULT_WEIGHTS if weights is None else weights
+    hoisted = hoisted or {}
     total = jnp.zeros((pods.req.shape[0], nodes.allocatable.shape[0]), jnp.float32)
     for name, w in weights.items():
         if not w:
@@ -436,6 +495,11 @@ def run_priorities(
         if (name in skip and name in EMPTY_CONSTANTS
                 and PRIORITY_REGISTRY[name] is _STOCK_KERNELS[name]):
             total = total + w * EMPTY_CONSTANTS[name]
+        elif name in hoisted:
+            kind, val, *rest = hoisted[name]
+            term = val if kind == "full" else _normalize_reduce(
+                val, mask, rest[0])
+            total = total + w * term
         else:
             total = total + w * PRIORITY_REGISTRY[name](pods, nodes, sel, topo, mask)
     return total

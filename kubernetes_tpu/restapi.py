@@ -103,6 +103,88 @@ class AuditLog:
                 pass
 
 
+#: the facade's resource surface, the single source for discovery AND the
+#: OpenAPI document (name, kind, namespaced, verbs) — apiserver publishes
+#: the same table through /api/v1 APIResourceList
+#: (pkg/endpoints/discovery/resources) and /openapi/v2
+#: (pkg/server/routes/openapi.go:30)
+RESOURCES = (
+    ("pods", "Pod", True, ("create", "delete", "get", "list", "watch")),
+    ("pods/binding", "Binding", True, ("create",)),
+    ("nodes", "Node", False,
+     ("create", "delete", "get", "list", "update", "watch")),
+    ("services", "Service", True, ("list",)),
+    ("endpoints", "Endpoints", True, ("list",)),
+    ("events", "Event", True, ("list",)),
+)
+
+
+def api_resource_list() -> dict:
+    """GET /api/v1 — APIResourceList (discovery/resources analog)."""
+    return {
+        "kind": "APIResourceList",
+        "apiVersion": "v1",
+        "groupVersion": "v1",
+        "resources": [
+            {"name": name, "kind": kind, "namespaced": namespaced,
+             "verbs": list(verbs)}
+            for name, kind, namespaced, verbs in RESOURCES
+        ],
+    }
+
+
+def openapi_doc() -> dict:
+    """GET /openapi/v2 — a real (if minimal) swagger 2.0 document derived
+    from the same RESOURCES table the routes implement, so the published
+    surface can never drift from the served one. Operations carry the
+    x-kubernetes-action the reference stamps (routes/openapi.go serves
+    the aggregated spec; this facade's is hand-rolled but live)."""
+    verb_http = {"create": "post", "delete": "delete", "get": "get",
+                 "list": "get", "update": "put"}
+    paths: dict = {}
+    for name, kind, namespaced, verbs in RESOURCES:
+        base, _, sub = name.partition("/")
+        collection = (f"/api/v1/namespaces/{{namespace}}/{base}"
+                      if namespaced else f"/api/v1/{base}")
+        item = collection + "/{name}" + (f"/{sub}" if sub else "")
+        for verb in verbs:
+            if verb == "watch":
+                route, method, action = f"/api/v1/watch/{base}", "get", "watch"
+            elif verb == "create":
+                # a SUBRESOURCE create posts to the item path
+                # (/pods/{name}/binding); only base-resource creates post
+                # to the collection
+                route = item if sub else collection
+                method, action = "post", "create"
+            elif verb == "list":
+                route, method, action = collection, "get", "list"
+            else:
+                route, method, action = item, verb_http[verb], verb
+            op = {
+                "x-kubernetes-action": action,
+                "x-kubernetes-group-version-kind":
+                    {"group": "", "version": "v1", "kind": kind},
+                "responses": {"200": {"description": "OK"},
+                              "401": {"description": "Unauthorized"}},
+            }
+            paths.setdefault(route, {})[method] = op
+    return {
+        "swagger": "2.0",
+        "info": {"title": "kubernetes_tpu", "version": "v1"},
+        "paths": paths,
+        "definitions": {
+            "v1.Status": {"type": "object", "properties": {
+                "kind": {"type": "string"},
+                "apiVersion": {"type": "string"},
+                "status": {"type": "string"},
+                "reason": {"type": "string"},
+                "message": {"type": "string"},
+                "code": {"type": "integer"},
+            }},
+        },
+    }
+
+
 def status_doc(code: int, reason: str, message: str) -> dict:
     return {
         "kind": "Status",
@@ -305,8 +387,13 @@ class RestServer:
             return False
         h._user = user
         verb, resource, ns, name = self.request_info(http_verb, h.path)
-        attrs = Attributes(user=user, verb=verb, resource=resource,
-                           namespace=ns, name=name)
+        attrs = Attributes(
+            user=user, verb=verb, resource=resource, namespace=ns,
+            name=name,
+            # non-resource request (discovery/openapi/version): carry the
+            # raw path for NonResourceURLs rules
+            path="" if resource else h.path.split("?", 1)[0].rstrip("/"),
+        )
         authz = self.authz
         if authz is not None and authz.authorize(attrs) != ALLOW:
             h._fail(403, "Forbidden", forbidden_message(attrs))
@@ -389,6 +476,21 @@ class RestServer:
 
     def _get(self, h) -> None:
         url = urlparse(h.path)
+        path = url.path.rstrip("/")
+        # discovery + OpenAPI (nonResourceURLs in the reference's terms):
+        # /api -> APIVersions, /api/v1 -> APIResourceList,
+        # /openapi/v2 -> the live swagger doc, /version -> version info
+        if path == "/api":
+            return h._respond(200, {"kind": "APIVersions",
+                                    "versions": ["v1"]})
+        if path == "/api/v1":
+            return h._respond(200, api_resource_list())
+        if path == "/openapi/v2":
+            return h._respond(200, openapi_doc())
+        if path == "/version":
+            from kubernetes_tpu import version_info
+
+            return h._respond(200, version_info())
         seg = self._route(url.path)
         hub = self.hub
         if not seg:

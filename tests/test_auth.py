@@ -229,6 +229,40 @@ def test_rest_authorization_scopes_verbs():
         srv.close()
 
 
+def test_non_resource_urls_gate_discovery():
+    """Discovery/openapi/version are NON-resource requests: scoped
+    resource rules never cover them (rbac PolicyRule semantics), a
+    non_resource_urls rule does — including the trailing-* prefix form."""
+    from kubernetes_tpu.auth import Rule, RuleAuthorizer
+
+    hub = HollowCluster(seed=2)
+    # viewer has pods access but NO URL grants: discovery is 403
+    srv, port = start(hub, authn=TokenAuthenticator(TOKENS),
+                      authz=RuleAuthorizer(SCOPED_RULES))
+    try:
+        code, doc = req(port, "GET", "/api/v1", token="viewer-token")
+        assert code == 403 and 'path "/api/v1"' in doc["message"]
+    finally:
+        srv.close()
+    # with the URL rule, discovery opens but resources stay scoped
+    rules = list(SCOPED_RULES) + [
+        Rule(subjects=("system:authenticated",), verbs=("get",),
+             non_resource_urls=("/api", "/api/*", "/openapi/*", "/version")),
+    ]
+    srv, port = start(hub, authn=TokenAuthenticator(TOKENS),
+                      authz=RuleAuthorizer(rules))
+    try:
+        for path in ("/api", "/api/v1", "/openapi/v2", "/version"):
+            code, doc = req(port, "GET", path, token="viewer-token")
+            assert code == 200, (path, doc)
+        # the URL rule must NOT leak resource access
+        code, _ = req(port, "POST", "/api/v1/nodes", NODE,
+                      token="viewer-token")
+        assert code == 403
+    finally:
+        srv.close()
+
+
 def test_rest_anonymous_user_flows_through_authorizer():
     hub = HollowCluster(seed=1)
     srv, port = start(
@@ -256,6 +290,13 @@ def test_audit_records_identity_and_401s():
     try:
         req(port, "GET", "/api/v1/pods", token="viewer-token")
         req(port, "GET", "/api/v1/pods")  # 401 — still audited
+        # the audit append happens on the handler thread after the
+        # response is written — wait for it like the other audit tests
+        import time as _time
+
+        t0 = _time.monotonic()
+        while len(audit.entries) < 2 and _time.monotonic() - t0 < 5:
+            _time.sleep(0.01)
         entries = list(audit.entries)
         assert entries[0]["user"]["username"] == "viewer"
         assert "readers" in entries[0]["user"]["groups"]

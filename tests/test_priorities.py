@@ -375,3 +375,59 @@ def test_gate_never_folds_custom_kernels():
         assert (got == 7.0).all()  # custom kernel ran; constant 0 did not
     finally:
         P.register_priority("ImageLocalityPriority", stock)
+
+
+def test_hoisted_priorities_bit_identical():
+    """hoist_priorities + run_priorities(hoisted=) must reproduce the
+    unhoisted total BIT-FOR-BIT (same accumulation order, same per-kernel
+    arithmetic) across workloads exercising every hoisted kernel, both
+    mask shapes, and the gate interplay."""
+    import numpy as np
+
+    from kubernetes_tpu.ops.priorities import (
+        empty_priorities,
+        hoist_priorities,
+        run_priorities,
+    )
+    from bench import build_variant
+
+    for variant in ("base", "node_affinity", "selector_spread"):
+        w = build_variant(variant, 60, 30, 128)
+        dp, dv = w.device_batch(w.pending[:128], 128)
+        fr = run_predicates(dp, w.dn, w.ds, topo=w.dt, vol=dv)
+        for mask in (fr.mask,
+                     fr.mask & (np.arange(fr.mask.shape[1]) % 2 == 0)[None, :]):
+            for skip in ((), empty_priorities(
+                    w.pk.pack_nodes(w.nodes, w.existing),
+                    w.pk.pack_pods(w.pending))):
+                plain = run_priorities(dp, w.dn, w.ds, mask, topo=w.dt,
+                                       skip=skip)
+                hp = hoist_priorities(dp, w.dn, w.ds, skip=skip)
+                hoisted = run_priorities(dp, w.dn, w.ds, mask, topo=w.dt,
+                                         skip=skip, hoisted=hp)
+                assert (np.asarray(plain) == np.asarray(hoisted)).all(), (
+                    variant, skip)
+
+
+def test_hoist_skips_custom_kernels():
+    """A custom kernel registered over a stock name must never be
+    hoisted (its static-ness is unknown) — mirror of the gate's
+    _STOCK_KERNELS identity check."""
+    from kubernetes_tpu.ops.priorities import (
+        PRIORITY_REGISTRY,
+        hoist_priorities,
+        register_priority,
+    )
+    from bench import build_variant
+
+    w = build_variant("base", 20, 10, 32)
+    dp, _ = w.device_batch(w.pending[:32], 32)
+    stock = PRIORITY_REGISTRY["ImageLocalityPriority"]
+    try:
+        register_priority("ImageLocalityPriority",
+                          lambda p, n, s, t, m: stock(p, n, s, t, m))
+        hp = hoist_priorities(dp, w.dn, w.ds)
+        assert "ImageLocalityPriority" not in hp
+        assert "TaintTolerationPriority" in hp  # others still hoist
+    finally:
+        register_priority("ImageLocalityPriority", stock)

@@ -193,7 +193,11 @@ def test_api_root_and_malformed_inputs():
     hub = HollowCluster(seed=7, scheduler_kw={"enable_preemption": False})
     srv, port = start(hub)
     try:
-        for method in ("GET", "POST", "DELETE"):
+        # GET /api/v1 is the discovery APIResourceList (round 4); write
+        # verbs against the root stay 404
+        code, doc = req(port, "GET", "/api/v1")
+        assert code == 200 and doc["kind"] == "APIResourceList"
+        for method in ("POST", "DELETE"):
             code, doc = req(port, method, "/api/v1")
             assert code == 404, (method, code)
         code, doc = req(port, "GET", "/api/v1/watch/pods?resourceVersion=abc")
@@ -665,5 +669,93 @@ def test_concurrent_step_and_rest_reads():
         assert not errors, errors
         hub.settle()
         hub.check_consistency()
+    finally:
+        srv.close()
+
+
+def test_discovery_and_openapi_surface():
+    """Discovery (/api, /api/v1) + /openapi/v2 + /version — the
+    machine-readable surface description (routes/openapi.go:30,
+    endpoints/discovery). The OpenAPI paths are DERIVED from the same
+    RESOURCES table the routes implement, and this test closes the loop:
+    every published path template must answer (non-404) when
+    instantiated, so the published surface cannot drift from the served
+    one."""
+    hub = HollowCluster(seed=77, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        req(port, "POST", "/api/v1/nodes", NODE)
+        req(port, "POST", "/api/v1/namespaces/default/pods",
+            make_pod_doc("d0"))
+
+        code, doc = req(port, "GET", "/api")
+        assert code == 200 and doc["kind"] == "APIVersions"
+        assert doc["versions"] == ["v1"]
+
+        code, doc = req(port, "GET", "/api/v1")
+        assert code == 200 and doc["kind"] == "APIResourceList"
+        by_name = {r["name"]: r for r in doc["resources"]}
+        assert by_name["pods"]["namespaced"] and by_name["pods"]["kind"] == "Pod"
+        assert not by_name["nodes"]["namespaced"]
+        assert "watch" in by_name["pods"]["verbs"]
+        assert by_name["pods/binding"]["verbs"] == ["create"]
+
+        code, ver = req(port, "GET", "/version")
+        assert code == 200 and ver
+
+        code, spec = req(port, "GET", "/openapi/v2")
+        assert code == 200 and spec["swagger"] == "2.0"
+        # the served binding route must be published at its ITEM path
+        bind_route = "/api/v1/namespaces/{namespace}/pods/{name}/binding"
+        assert "post" in spec["paths"][bind_route]
+        gvk = spec["paths"][bind_route]["post"][
+            "x-kubernetes-group-version-kind"]
+        assert gvk["kind"] == "Binding"
+        # ...and the pods-collection POST still documents Pod creation
+        pods_col = "/api/v1/namespaces/{namespace}/pods"
+        assert spec["paths"][pods_col]["post"][
+            "x-kubernetes-group-version-kind"]["kind"] == "Pod"
+
+        # every published op, instantiated, must answer with the exact
+        # success code — not merely "not 404" (a 500 is drift too).
+        # Deletes run LAST (sorted below) so they cannot eat the
+        # fixtures other ops need; each delete re-creates what it ate.
+        ops = sorted(
+            ((method, route)
+             for route, methods in spec["paths"].items()
+             for method in methods),
+            key=lambda mr: (mr[0] == "delete", mr[1]))
+        for method, route in ops:
+            path = (route.replace("{namespace}", "default")
+                         .replace("{name}", "n0" if "/nodes" in route
+                                  else "d0"))
+            if "/watch/" in path:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=10)
+                conn.request("GET", path + "?resourceVersion=0")
+                r = conn.getresponse(); r.read(); conn.close()
+                assert r.status == 200, path
+                continue
+            body = None
+            want = {"get": (200,), "put": (200,), "delete": (200,)}[
+                method] if method != "post" else (201,)
+            if method == "post":
+                if path.endswith("/binding"):
+                    body = {"target": {"name": "n0"}}
+                    want = (201, 409)  # d0 may already be bound
+                elif "/nodes" in path:
+                    body, want = NODE, (201, 409)  # n0 exists
+                else:
+                    body = make_pod_doc("new1")
+            if method == "put":
+                _, body = req(port, "GET", "/api/v1/nodes/n0")
+            code, doc = req(port, method.upper(), path, body)
+            assert code in want, (method, path, code, doc)
+            if method == "delete":  # restore the fixture
+                if "/nodes" in path:
+                    req(port, "POST", "/api/v1/nodes", NODE)
+                else:
+                    req(port, "POST", "/api/v1/namespaces/default/pods",
+                        make_pod_doc("d0"))
     finally:
         srv.close()
