@@ -781,6 +781,127 @@ class HollowCluster:
             return self._commit(obj_key,
                                 "MODIFIED" if cur_rv else "ADDED", record)
 
+    # -- checkpoint / restore (etcd snapshot + restore analog) -------------
+
+    #: the attrs a checkpoint carries: the full API-state slice (what
+    #: etcd holds — objects, controller specs, dataplane truth) plus the
+    #: per-node kubelet clocks (the kubelet checkpointmanager analog:
+    #: pod lifecycle/probe state survives an agent restart)
+    _CHECKPOINT_ATTRS = (
+        "truth_nodes", "truth_pods", "resource_version", "leases",
+        "pvcs", "pvs", "storage_classes",
+        "replicasets", "deployments", "jobs", "daemonsets",
+        "statefulsets", "cronjobs", "hpas", "pdbs",
+        "services", "endpoints", "namespaces", "priority_classes",
+        "quotas", "ip_alloc", "events_v1",
+        "heartbeats", "dead_kubelets", "_taint_time",
+        "_bound_at", "_started_at", "app_health",
+    )
+
+    def _semantic_config(self) -> dict:
+        """The construction knobs that change cluster SEMANTICS — stamped
+        into checkpoints so restoring into a differently-configured hub
+        fails loudly instead of silently diverging (e.g. a hub saved with
+        admission on restored into one without would bypass quota)."""
+        return {
+            "admission": self.admission is not None,
+            "node_grace_s": self.node_grace_s,
+            "eviction_wait_s": self.eviction_wait_s,
+            "zone_eviction_rate": self.zone_eviction_rate,
+        }
+
+    def save_checkpoint(self, path: str) -> dict:
+        """Write a point-in-time snapshot of the hub's state — the etcd
+        backup analog (``etcdctl snapshot save``; etcd's snap files are
+        opaque binary and so is this one: pickled, because the faithful
+        JSON wire forms are deliberately lossy scheduling slices and a
+        checkpoint must round-trip EVERY field exactly or restore
+        corrupts constraints silently). Returns a small manifest."""
+        import pickle
+
+        with self.lock:
+            state = {"format": "ktpu-checkpoint/1",
+                     "revision": self._revision,
+                     "clock_t": self.clock.t,
+                     "config": self._semantic_config()}
+            for attr in self._CHECKPOINT_ATTRS:
+                state[attr] = getattr(self, attr)
+            blob = pickle.dumps(state)
+        with open(path, "wb") as f:
+            f.write(blob)
+        return {"revision": state["revision"],
+                "nodes": len(state["truth_nodes"]),
+                "pods": len(state["truth_pods"]),
+                "bytes": len(blob)}
+
+    def restore_checkpoint(self, path: str) -> dict:
+        """Restore a checkpoint into THIS (freshly constructed) hub —
+        the ``etcdctl snapshot restore`` + cold-start analog:
+
+        - object resourceVersions and the global revision are PRESERVED
+          (clients' stored rvs stay meaningful);
+        - the watch history is empty and the compaction floor sits at
+          the restored revision, so any watcher resuming from an old rv
+          gets Compacted and relists — exactly post-restore etcd;
+        - the scheduler is re-fed through its event-handler surface
+          (the informer relist a restarted control plane performs), so
+          its cache/queue rebuild from truth;
+        - per-node kubelet clocks (bound/started/probe health) come
+          back, so pod lifecycle resumes where it stopped.
+        """
+        import pickle
+
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        if state.get("format") != "ktpu-checkpoint/1":
+            raise ValueError(f"not a ktpu checkpoint: {path}")
+        want = state.get("config", {})
+        have = self._semantic_config()
+        if want and want != have:
+            diff = {k: (want[k], have.get(k))
+                    for k in want if want[k] != have.get(k)}
+            raise ValueError(
+                f"checkpoint/hub config mismatch (saved, this): {diff} — "
+                "construct the hub with the same semantics before restoring"
+            )
+        with self.lock:
+            self._revision = state["revision"]
+            self._compacted_rev = self._revision
+            self._history.clear()
+            self.clock.t = state["clock_t"]
+            for attr in self._CHECKPOINT_ATTRS:
+                cur = getattr(self, attr)
+                new = state[attr]
+                # the admission chain captured the namespaces/priority-
+                # class/quota CONTAINERS at construction (default_chain)
+                # — those must be updated IN PLACE or admission keeps
+                # enforcing against pre-restore state
+                if attr in ("namespaces", "priority_classes"):
+                    cur.clear()
+                    cur.update(new)
+                elif attr in ("quotas", "pdbs"):
+                    cur[:] = new
+                else:
+                    setattr(self, attr, new)
+            # rebuild the per-node agents (live objects, not state)
+            self.kubelets = {name: HollowKubelet(self, name)
+                             for name in self.truth_nodes}
+            self.proxies = {name: ServiceProxy(name, self.clock)
+                            for name in self.truth_nodes}
+            for name in self.dead_kubelets:
+                if name in self.kubelets:
+                    self.kubelets[name].alive = False
+            # informer relist into the scheduler
+            for node in self.truth_nodes.values():
+                self.sched.on_node_add(node)
+            for pod in self.truth_pods.values():
+                self.sched.on_pod_add(pod)
+            if self.pvcs or self.pvs or self.storage_classes:
+                self._sync_volume_state()
+        return {"revision": self._revision,
+                "nodes": len(self.truth_nodes),
+                "pods": len(self.truth_pods)}
+
     # -- pod lifecycle (hollow kubelet SyncPod + prober) -------------------
 
     def set_app_health(self, pod_key: str, healthy: bool) -> None:

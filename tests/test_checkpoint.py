@@ -1,0 +1,166 @@
+"""Checkpoint/restore — the etcd snapshot+restore analog plus the
+kubelet checkpointmanager slice (VERDICT r3 §5 'Checkpoint/resume:
+partial'): a running cluster saved mid-flight must come back in a fresh
+hub with revisions preserved, watchers forced to relist, controllers
+converging, and pod lifecycle clocks intact. Also the core/v1 object
+codec scheme (api/core_v1.py) — Pod/Node through the runtime.Scheme
+pipeline."""
+
+from kubernetes_tpu.api.core_v1 import decode_any, encode
+from kubernetes_tpu.api.scheme import SchemeError
+from kubernetes_tpu.api.types import (
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PodVolume,
+    ReadinessProbe,
+    StorageClass,
+)
+from kubernetes_tpu.sim import Compacted, Deployment, HollowCluster, Job
+from kubernetes_tpu.testing import make_node, make_pod
+
+import pytest
+
+
+# -- core/v1 codec scheme ---------------------------------------------------
+
+def test_core_v1_scheme_round_trips_pod_and_node():
+    pod = make_pod("p0", cpu_milli=250, labels={"app": "x"},
+                   node_name="n3", priority=7)
+    doc = encode(pod)
+    assert doc["apiVersion"] == "v1" and doc["kind"] == "Pod"
+    back = decode_any(doc)
+    assert (back.name, back.namespace, back.node_name, back.priority) == (
+        "p0", "default", "n3", 7)
+    assert back.requests.cpu_milli == 250 and back.labels == {"app": "x"}
+
+    node = make_node("n0", cpu_milli=8000)
+    ndoc = encode(node)
+    assert ndoc["kind"] == "Node"
+    nback = decode_any(ndoc)
+    assert nback.name == "n0"
+    assert nback.allocatable.cpu_milli == 8000
+
+    with pytest.raises(SchemeError):
+        decode_any({"apiVersion": "v2", "kind": "Pod"})
+    with pytest.raises(SchemeError):
+        encode(object())
+
+
+# -- hub checkpoint/restore -------------------------------------------------
+
+def _build_live_cluster(seed=41):
+    hub = HollowCluster(seed=seed, scheduler_kw={"enable_preemption": False})
+    for i in range(5):
+        hub.add_node(make_node(f"n{i}", cpu_milli=8000))
+    hub.add_deployment(Deployment("web", replicas=4))
+    hub.add_job(Job("batch", completions=3, parallelism=1, duration_s=60))
+    hub.add_storage_class(StorageClass("std"))
+    hub.add_pv(PersistentVolume("pv0", kind="gce-pd", handle="h",
+                                storage_class="std"))
+    hub.add_pvc(PersistentVolumeClaim("c0", storage_class="std"))
+    hub.create_pod(make_pod("vol-user", cpu_milli=100,
+                            volumes=(PodVolume(pvc="c0"),)))
+    hub.create_pod(make_pod(
+        "probed", cpu_milli=100,
+        readiness_probe=ReadinessProbe(initial_delay_s=5)))
+    for _ in range(4):
+        hub.step()
+    return hub
+
+
+def test_checkpoint_restore_preserves_state_and_resumes(tmp_path):
+    hub = _build_live_cluster()
+    # one pod created but NOT yet scheduled at checkpoint time — it must
+    # survive the restore and get scheduled by the restored control plane
+    hub.create_pod(make_pod("pending-at-save", cpu_milli=100))
+    path = str(tmp_path / "snap.ckpt")
+    manifest = hub.save_checkpoint(path)
+    assert manifest["nodes"] == 5 and manifest["revision"] > 0
+    want_rvs = dict(hub.resource_version)
+    want_bound = {k: p.node_name for k, p in hub.truth_pods.items()}
+    want_clock = hub.clock.t
+
+    cold = HollowCluster(seed=999,
+                         scheduler_kw={"enable_preemption": False})
+    got = cold.restore_checkpoint(path)
+    assert got["revision"] == manifest["revision"]
+    # resourceVersions preserved exactly (client rvs stay meaningful)
+    assert cold.resource_version == want_rvs
+    assert cold.clock.t == want_clock
+    assert {k: p.node_name for k, p in cold.truth_pods.items()} == want_bound
+    # the scheduler cache rebuilt from truth: the oracle must hold NOW
+    cold.check_consistency()
+    # a watcher resuming below the restored floor relists (etcd restore)
+    with pytest.raises(Compacted):
+        cold.watch(0)
+    # the restored control plane keeps working: pending pod schedules,
+    # controllers keep reconciling, volume truth stays mutual
+    for _ in range(4):
+        cold.step()
+    assert cold.truth_pods["default/pending-at-save"].node_name
+    assert cold.pvcs["default/c0"].volume_name == "pv0"
+    cold.check_consistency()
+
+
+def test_checkpoint_restores_kubelet_clocks_and_probe_state(tmp_path):
+    hub = _build_live_cluster(seed=42)
+    hub.set_app_health("default/probed", False)
+    hub.step()
+    path = str(tmp_path / "snap.ckpt")
+    hub.save_checkpoint(path)
+
+    cold = HollowCluster(seed=7, scheduler_kw={"enable_preemption": False})
+    cold.restore_checkpoint(path)
+    # probe override survived (checkpointmanager analog)
+    assert cold.app_health["default/probed"] is False
+    p = cold.truth_pods["default/probed"]
+    assert p.phase == "Running" and not p.ready
+    # recovery after restore flows through normally
+    cold.set_app_health("default/probed", True)
+    for _ in range(3):
+        cold.step()
+    assert cold.truth_pods["default/probed"].ready
+    cold.check_consistency()
+
+
+def test_checkpoint_carries_events_registry(tmp_path):
+    """Events are stored, REST-served API objects — they must survive a
+    restore alongside their resource_version lineage (review finding)."""
+    hub = _build_live_cluster(seed=43)
+    assert hub.events_v1, "expected scheduler events by now"
+    path = str(tmp_path / "snap.ckpt")
+    hub.save_checkpoint(path)
+    cold = HollowCluster(seed=3, scheduler_kw={"enable_preemption": False})
+    cold.restore_checkpoint(path)
+    assert cold.events_v1.keys() == hub.events_v1.keys()
+    some = next(iter(cold.events_v1))
+    assert cold.resource_version[f"events/{some}"] > 0
+
+
+def test_restore_rejects_config_mismatch(tmp_path):
+    """A checkpoint saved with admission ON must not restore into a hub
+    without it — silent semantic divergence becomes a loud error."""
+    hub = HollowCluster(seed=44, admission=True,
+                        scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=4000))
+    path = str(tmp_path / "snap.ckpt")
+    hub.save_checkpoint(path)
+    plain = HollowCluster(seed=5, scheduler_kw={"enable_preemption": False})
+    with pytest.raises(ValueError) as ei:
+        plain.restore_checkpoint(path)
+    assert "admission" in str(ei.value)
+    # matching construction restores fine
+    twin = HollowCluster(seed=6, admission=True,
+                         scheduler_kw={"enable_preemption": False})
+    twin.restore_checkpoint(path)
+    twin.check_consistency()
+
+
+def test_restore_rejects_garbage(tmp_path):
+    bad = tmp_path / "junk.ckpt"
+    import pickle
+
+    bad.write_bytes(pickle.dumps({"format": "something-else"}))
+    hub = HollowCluster(seed=1)
+    with pytest.raises(ValueError):
+        hub.restore_checkpoint(str(bad))
