@@ -287,6 +287,22 @@ POD_SUCCEEDED = "Succeeded"
 POD_FAILED = "Failed"
 
 
+@dataclass(frozen=True)
+class OwnerReference:
+    """The metav1.OwnerReference slice the GC dependency graph consumes
+    (garbagecollector.go:65 builds its graph from these): controller
+    kind + name. ``uid`` exists for wire-shape parity only — the hub's
+    GC matches by (kind, name), so a recreated same-name owner keeps the
+    previous incarnation's pods alive. That is a DOCUMENTED deviation
+    approximating the reference's adoption semantics (a recreated
+    controller with the same selector adopts matching orphans and
+    reaches the same end state for controller pods)."""
+
+    kind: str
+    name: str
+    uid: str = ""
+
+
 @dataclass
 class ReadinessProbe:
     """The slice of v1.Probe the hollow prober consumes
@@ -370,6 +386,10 @@ class Pod:
     #: no-probes default of the reference's status_manager)
     ready: bool = False
     readiness_probe: Optional[ReadinessProbe] = None
+    #: metadata.ownerReferences — the GC graph edges; a pod whose every
+    #: referenced controller is gone gets background-deleted
+    #: (sim.HollowCluster.gc_owner_graph)
+    owner_refs: Tuple["OwnerReference", ...] = ()
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"

@@ -50,6 +50,7 @@ from kubernetes_tpu.api.types import (
     EFFECT_NO_EXECUTE,
     EFFECT_NO_SCHEDULE,
     Node,
+    OwnerReference,
     Pod,
     Taint,
     Toleration,
@@ -199,6 +200,9 @@ class Job:
     next_idx: int = 0
     succeeded: int = 0
     active: Dict[str, Pod] = field(default_factory=dict)
+    #: owning CronJob name ("" = standalone) — the ownerReference edge
+    #: the GC graph walks (cronjob-spawned jobs cascade on its deletion)
+    owner: str = ""
 
     def done(self) -> bool:
         return self.succeeded >= self.completions
@@ -536,6 +540,13 @@ class HollowCluster:
         #: (instance gone at the provider ⇒ node object removed)
         self.cloud_controller = None
         self.binder = FlakyBinder(self, bind_fail_rate, self.rng)
+        # stable signature of the caller's scheduler knobs — compared by
+        # the checkpoint config guard (callables repr unstably and never
+        # round-trip anyway; they are live wiring, not semantics)
+        self._scheduler_kw_sig = tuple(sorted(
+            (k, repr(v)) for k, v in (scheduler_kw or {}).items()
+            if not callable(v)
+        ))
         kw = dict(scheduler_kw or {})
         kw.setdefault("pdb_lister", lambda: list(self.pdbs))
         # the scheduler's events land in the hub as API objects (the
@@ -808,6 +819,10 @@ class HollowCluster:
             "node_grace_s": self.node_grace_s,
             "eviction_wait_s": self.eviction_wait_s,
             "zone_eviction_rate": self.zone_eviction_rate,
+            "bind_fail_rate": self.binder.fail_rate,
+            "event_delay_ticks": self.event_delay_ticks,
+            "competing_bind_rate": self.competing_bind_rate,
+            "scheduler_kw": self._scheduler_kw_sig,
         }
 
     def save_checkpoint(self, path: str) -> dict:
@@ -819,6 +834,8 @@ class HollowCluster:
         corrupts constraints silently). Returns a small manifest."""
         import pickle
 
+        import dataclasses
+
         with self.lock:
             state = {"format": "ktpu-checkpoint/1",
                      "revision": self._revision,
@@ -826,6 +843,14 @@ class HollowCluster:
                      "config": self._semantic_config()}
             for attr in self._CHECKPOINT_ATTRS:
                 state[attr] = getattr(self, attr)
+            # HPA metric sources are live callables (lambdas in every real
+            # usage) — unpicklable and meaningless across processes. They
+            # are stripped here; restore documents re-wiring (set load_fn
+            # after restore, like any live callback).
+            state["hpas"] = {
+                k: dataclasses.replace(h, load_fn=None)
+                for k, h in self.hpas.items()
+            }
             blob = pickle.dumps(state)
         with open(path, "wb") as f:
             f.write(blob)
@@ -847,7 +872,10 @@ class HollowCluster:
           (the informer relist a restarted control plane performs), so
           its cache/queue rebuild from truth;
         - per-node kubelet clocks (bound/started/probe health) come
-          back, so pod lifecycle resumes where it stopped.
+          back, so pod lifecycle resumes where it stopped;
+        - HPA metric sources (``load_fn``) do NOT round-trip (live
+          callables): re-wire them after restore or the HPA holds its
+          last size.
         """
         import pickle
 
@@ -1019,6 +1047,37 @@ class HollowCluster:
                 bound_any = True
         if bound_any:
             self._sync_volume_state()
+
+    def gc_owner_graph(self) -> None:
+        """The ownerReference dependency-graph GC
+        (pkg/controller/garbagecollector/garbagecollector.go:65),
+        compressed to the hub's kind registry: an object whose every
+        controller owner no longer exists is background-deleted. Edges:
+        Pod -> ReplicaSet/Job/DaemonSet/StatefulSet (pod.owner_refs),
+        Job -> CronJob (job.owner), ReplicaSet -> Deployment (the
+        rs.owner cascade lives in reconcile_controllers). Adoption of
+        matching orphans is a deliberate non-goal."""
+        for name in [n for n, j in self.jobs.items()
+                     if j.owner and j.owner not in self.cronjobs]:
+            j = self.jobs.pop(name)
+            for key in list(j.active):
+                self.delete_pod(key)
+        kinds = self._owner_kinds()
+        for key, p in list(self.truth_pods.items()):
+            refs = p.owner_refs
+            if refs and not any(r.name in kinds.get(r.kind, {})
+                                for r in refs):
+                self.delete_pod(key)
+
+    def _owner_kinds(self) -> Dict[str, dict]:
+        return {
+            "Deployment": self.deployments,
+            "ReplicaSet": self.replicasets,
+            "Job": self.jobs,
+            "DaemonSet": self.daemonsets,
+            "StatefulSet": self.statefulsets,
+            "CronJob": self.cronjobs,
+        }
 
     def gc_orphaned(self) -> None:
         """Delete truth pods bound to nodes that no longer exist — the
@@ -1217,7 +1276,8 @@ class HollowCluster:
             self.jobs[jn] = Job(jn, completions=cj.completions,
                                 parallelism=cj.parallelism,
                                 duration_s=cj.duration_s,
-                                cpu_milli=cj.cpu_milli, memory=cj.memory)
+                                cpu_milli=cj.cpu_milli, memory=cj.memory,
+                                owner=cj.name)
             cj.spawned.append(jn)
             cj.next_run += cj.every_s
 
@@ -1303,9 +1363,11 @@ class HollowCluster:
                     k in self.truth_pods and self.truth_pods[k].node_name))
                 for key in victims[:extra]:
                     self.delete_pod(key)
-        def spawn(prefix: str, idx: int, labels: dict, cpu, mem, pri=0):
+        def spawn(prefix: str, idx: int, labels: dict, cpu, mem, pri=0,
+                  owner: "OwnerReference | None" = None):
             pod = make_pod(f"{prefix}-{idx}", cpu_milli=cpu, memory=mem,
-                           priority=pri, labels=labels)
+                           priority=pri, labels=labels,
+                           owner_refs=(owner,) if owner else ())
             pod.uid = f"{prefix}-{idx}#{idx}"
             try:
                 self.create_pod(pod)
@@ -1343,7 +1405,8 @@ class HollowCluster:
                    and j.succeeded + len(j.active) < j.completions):
                 j.next_idx += 1
                 pod = spawn(j.name, j.next_idx, {"job": j.name},
-                            j.cpu_milli, j.memory)
+                            j.cpu_milli, j.memory,
+                            owner=OwnerReference("Job", j.name))
                 if pod is None:
                     break
                 j.active[pod.key()] = pod
@@ -1356,7 +1419,8 @@ class HollowCluster:
                 if rs.owner:
                     labels["deploy"] = rs.owner
                 pod = spawn(rs.name, rs.next_idx, labels,
-                            rs.cpu_milli, rs.memory, rs.priority)
+                            rs.cpu_milli, rs.memory, rs.priority,
+                            owner=OwnerReference("ReplicaSet", rs.name))
                 if pod is None:
                     break
                 rs.live[pod.key()] = pod
@@ -1389,6 +1453,7 @@ class HollowCluster:
                         [req("kubernetes.io/hostname", "In", node_name)]
                     ),
                     tolerations=DAEMON_TOLERATIONS,
+                    owner_refs=(OwnerReference("DaemonSet", ds.name),),
                 )
                 try:
                     self.create_pod(pod)
@@ -1417,7 +1482,9 @@ class HollowCluster:
                 if p is None:
                     pod = make_pod(ss.pod_name(o), cpu_milli=ss.cpu_milli,
                                    memory=ss.memory, priority=ss.priority,
-                                   labels={"ss": ss.name})
+                                   labels={"ss": ss.name},
+                                   owner_refs=(OwnerReference(
+                                       "StatefulSet", ss.name),))
                     try:
                         self.create_pod(pod)
                     except AdmissionError:
@@ -1617,6 +1684,7 @@ class HollowCluster:
             self.reconcile_namespaces()
             self.quota_controller.reconcile()
         self.reconcile_controllers()
+        self.gc_owner_graph()
         if self.pvcs or self.pvs:
             self.reconcile_volumes()
         if self.services or self.endpoints:
@@ -1698,6 +1766,19 @@ class HollowCluster:
                 assert pvc is not None and pvc.volume_name == pv.name, (
                     f"pv {pv.name} claimRef not reciprocated"
                 )
+        # ownerRef graph: at the settled state no object may outlive its
+        # every controller owner (the GC pass must have converged)
+        kinds = self._owner_kinds()
+        for p in self.truth_pods.values():
+            if p.owner_refs:
+                assert any(r.name in kinds.get(r.kind, {})
+                           for r in p.owner_refs), (
+                    f"{p.key()} outlives its owners {p.owner_refs}"
+                )
+        for name, j in self.jobs.items():
+            assert not j.owner or j.owner in self.cronjobs, (
+                f"job {name} outlives CronJob {j.owner}"
+            )
 
     def pending_count(self) -> int:
         return sum(1 for p in self.truth_pods.values() if not p.node_name)

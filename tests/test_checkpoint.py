@@ -137,6 +137,34 @@ def test_checkpoint_carries_events_registry(tmp_path):
     assert cold.resource_version[f"events/{some}"] > 0
 
 
+def test_checkpoint_with_hpa_strips_and_rewires_metric_source(tmp_path):
+    """HPA load_fn is a live callable (a lambda in every real usage) —
+    it must not crash the pickle (review finding); restore re-wires."""
+    from kubernetes_tpu.sim import HorizontalPodAutoscaler
+
+    hub = HollowCluster(seed=45, scheduler_kw={"enable_preemption": False})
+    for i in range(6):
+        hub.add_node(make_node(f"n{i}", cpu_milli=8000))
+    hub.add_deployment(Deployment("web", replicas=2))
+    load = {"u": 1.0}
+    hub.add_hpa(HorizontalPodAutoscaler(
+        "h", deployment="web", min_replicas=2, max_replicas=8,
+        target_utilization=0.5, load_fn=lambda: load["u"]))
+    hub.step()
+    path = str(tmp_path / "snap.ckpt")
+    hub.save_checkpoint(path)  # must not raise PicklingError
+    cold = HollowCluster(seed=8, scheduler_kw={"enable_preemption": False})
+    cold.restore_checkpoint(path)
+    assert cold.hpas["h"].load_fn is None
+    before = cold.deployments["web"].replicas
+    cold.step()  # metric-less HPA holds the line
+    assert cold.deployments["web"].replicas == before
+    cold.hpas["h"].load_fn = lambda: 1.0  # re-wire: scaling resumes
+    cold.step()
+    assert cold.deployments["web"].replicas > before
+    cold.check_consistency()
+
+
 def test_restore_rejects_config_mismatch(tmp_path):
     """A checkpoint saved with admission ON must not restore into a hub
     without it — silent semantic divergence becomes a loud error."""

@@ -1,0 +1,90 @@
+"""OwnerReference-graph GC (VERDICT r3 'GC is orphan cleanup, not an
+ownerRef graph'): controller-spawned objects carry metadata.owner_refs
+edges and the hub's GC pass (garbagecollector.go:65 analog) background-
+deletes anything whose every controller owner is gone — including the
+two-level CronJob -> Job -> Pod cascade."""
+
+from kubernetes_tpu.api.types import OwnerReference
+from kubernetes_tpu.sim import CronJob, DaemonSet, Deployment, HollowCluster, Job
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _hub(seed=51, nodes=4):
+    hub = HollowCluster(seed=seed, scheduler_kw={"enable_preemption": False})
+    for i in range(nodes):
+        hub.add_node(make_node(f"n{i}", cpu_milli=8000))
+    return hub
+
+
+def test_spawned_pods_carry_owner_refs():
+    hub = _hub()
+    hub.add_deployment(Deployment("web", replicas=2))
+    hub.add_job(Job("work", completions=2, parallelism=1, duration_s=1e9))
+    hub.add_daemonset(DaemonSet("agent"))
+    for _ in range(2):
+        hub.step()
+    kinds = {r.kind for p in hub.truth_pods.values() for r in p.owner_refs}
+    assert kinds == {"ReplicaSet", "Job", "DaemonSet"}
+
+
+def test_owner_gone_pods_background_deleted():
+    hub = _hub(seed=52)
+    hub.add_job(Job("work", completions=5, parallelism=3, duration_s=1e9))
+    for _ in range(2):
+        hub.step()
+    assert sum(1 for p in hub.truth_pods.values()
+               if p.labels.get("job") == "work") == 3
+    # the owner vanishes WITHOUT explicit cascade (a raw registry del,
+    # not a delete_* helper) — the GRAPH must clean up, not the helper
+    del hub.jobs["work"]
+    for _ in range(2):
+        hub.step()
+    assert not any(p.labels.get("job") == "work"
+                   for p in hub.truth_pods.values())
+    hub.check_consistency()
+
+
+def test_cronjob_cascade_two_levels():
+    hub = _hub(seed=53)
+    hub.add_cronjob(CronJob("tick", every_s=10, completions=3,
+                            parallelism=1, duration_s=1e9))
+    for _ in range(3):
+        hub.step()
+    spawned = [n for n, j in hub.jobs.items() if j.owner == "tick"]
+    assert spawned and any(
+        r.kind == "Job" for p in hub.truth_pods.values()
+        for r in p.owner_refs)
+    del hub.cronjobs["tick"]
+    for _ in range(2):
+        hub.step()
+    # both levels collapsed: jobs gone, their pods gone
+    assert not any(j.owner == "tick" for j in hub.jobs.values())
+    assert not any(
+        any(r.kind == "Job" for r in p.owner_refs)
+        for p in hub.truth_pods.values())
+    hub.check_consistency()
+
+
+def test_live_owner_protects_pods():
+    hub = _hub(seed=54)
+    hub.add_deployment(Deployment("web", replicas=3))
+    for _ in range(3):
+        hub.step()
+    n = sum(1 for p in hub.truth_pods.values()
+            if p.labels.get("deploy") == "web")
+    assert n == 3
+    for _ in range(3):
+        hub.step()  # GC runs every tick; owned pods must persist
+    assert sum(1 for p in hub.truth_pods.values()
+               if p.labels.get("deploy") == "web") == 3
+    hub.check_consistency()
+
+
+def test_manual_pod_with_dead_ref_is_collected():
+    hub = _hub(seed=55)
+    pod = make_pod("stray", cpu_milli=100,
+                   owner_refs=(OwnerReference("ReplicaSet", "never-was"),))
+    hub.create_pod(pod)
+    hub.step()
+    assert "default/stray" not in hub.truth_pods
+    hub.check_consistency()
