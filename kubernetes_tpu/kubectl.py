@@ -309,6 +309,30 @@ def cmd_get_events(rest: RestClient, args) -> int:
     return 0
 
 
+def cmd_get_leases(rest: RestClient, args) -> int:
+    """kubectl get leases (coordination.k8s.io/v1): HA state over REST —
+    who holds each lock and how fresh the renewal is."""
+    path = ("/apis/coordination.k8s.io/v1/leases" if args.all_namespaces
+            else "/apis/coordination.k8s.io/v1/namespaces/"
+                 f"{args.namespace}/leases")
+    code, doc = rest.call("GET", path)
+    if code != 200:
+        return _rest_fail(doc)
+    rows = [
+        [
+            it["metadata"]["namespace"],
+            it["metadata"]["name"],
+            it["spec"].get("holderIdentity", ""),
+            str(it["spec"].get("leaseTransitions", 0)),
+            f"{it['spec'].get('renewTime', 0):.1f}",
+        ]
+        for it in doc["items"]
+    ]
+    print(_fmt_table(["NAMESPACE", "NAME", "HOLDER", "TRANSITIONS",
+                      "RENEWTIME"], rows))
+    return 0
+
+
 def cmd_delete(rest: RestClient, args) -> int:
     if args.kind in ("node", "nodes"):
         code, out = rest.call("DELETE", f"/api/v1/nodes/{args.name}")
@@ -391,14 +415,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         cv.add_argument("name")
     args = p.parse_args(argv)
 
-    if args.cmd == "get" and args.kind == "events":
+    if args.cmd == "get" and args.kind in ("events", "leases"):
         if not args.api_server:
-            p.error("get events requires --api-server")
+            p.error(f"get {args.kind} requires --api-server")
         try:
             rest = RestClient(args.api_server)
         except ValueError:
             p.error(f"--api-server must be HOST:PORT, got {args.api_server!r}")
         try:
+            if args.kind == "leases":
+                return cmd_get_leases(rest, args)
             return cmd_get_events(rest, args)
         except OSError as e:
             print(f"Error: cannot reach API server {args.api_server}: {e}",

@@ -119,6 +119,31 @@ RESOURCES = (
 )
 
 
+#: the one non-core group this facade serves: coordination.k8s.io/v1
+#: Leases (read-only — writes go through the hub CAS the leader election
+#: uses; exposing them read-only makes HA state API-observable the way
+#: `kubectl get leases -n kube-system` is in the reference)
+LEASE_GROUP = "coordination.k8s.io"
+GROUP_RESOURCES = (("leases", "Lease", True, ("get", "list")),)
+
+
+def lease_to_json(ns: str, name: str, record, rv: int) -> dict:
+    """coordination/v1 Lease wire shape from the stored election record
+    (resourcelock.LeaderElectionRecord fields -> LeaseSpec names,
+    leaselock.go:120 LeaderElectionRecordToLeaseSpec)."""
+    return {
+        "metadata": {"name": name, "namespace": ns,
+                     "resourceVersion": str(rv)},
+        "spec": {
+            "holderIdentity": record.holder_identity,
+            "leaseDurationSeconds": record.lease_duration_s,
+            "acquireTime": record.acquire_time,
+            "renewTime": record.renew_time,
+            "leaseTransitions": record.leader_transitions,
+        },
+    }
+
+
 def api_resource_list() -> dict:
     """GET /api/v1 — APIResourceList (discovery/resources analog)."""
     return {
@@ -168,6 +193,24 @@ def openapi_doc() -> dict:
                               "401": {"description": "Unauthorized"}},
             }
             paths.setdefault(route, {})[method] = op
+    # the coordination group's read-only lease routes
+    for name, kind, namespaced, verbs in GROUP_RESOURCES:
+        base = f"/apis/{LEASE_GROUP}/v1"
+        collection = f"{base}/namespaces/{{namespace}}/{name}"
+        gvk = {"group": LEASE_GROUP, "version": "v1", "kind": kind}
+        ok = {"200": {"description": "OK"},
+              "401": {"description": "Unauthorized"}}
+        if "list" in verbs:
+            paths[f"{base}/{name}"] = {"get": {
+                "x-kubernetes-action": "list",
+                "x-kubernetes-group-version-kind": gvk, "responses": ok}}
+            paths[collection] = {"get": {
+                "x-kubernetes-action": "list",
+                "x-kubernetes-group-version-kind": gvk, "responses": ok}}
+        if "get" in verbs:
+            paths[collection + "/{name}"] = {"get": {
+                "x-kubernetes-action": "get",
+                "x-kubernetes-group-version-kind": gvk, "responses": ok}}
     return {
         "swagger": "2.0",
         "info": {"title": "kubernetes_tpu", "version": "v1"},
@@ -407,8 +450,13 @@ class RestServer:
         POSITIONAL segments only, GET on an exact collection route is
         "list", "watch" only as the segment after the version prefix,
         subresources join the resource as "pods/binding" (the rbac/v1
-        resource spelling)."""
-        seg = RestServer._route(path.split("?", 1)[0])
+        resource spelling). Group-routed paths
+        (/apis/coordination.k8s.io/v1/...) resolve the same way — the
+        RBAC resource name carries no group prefix."""
+        p = path.split("?", 1)[0]
+        seg = RestServer._route(p)
+        if seg is None:
+            seg = RestServer._route_group(p)
         verb = {"GET": "get", "POST": "create", "PUT": "update",
                 "DELETE": "delete"}.get(http_verb, http_verb.lower())
         if not seg:
@@ -457,6 +505,15 @@ class RestServer:
         return parts[2:]
 
     @staticmethod
+    def _route_group(path: str):
+        """Split '/apis/coordination.k8s.io/v1/...' into segments after
+        the group-version (the apiserver's group routing layer)."""
+        parts = [p for p in path.split("/") if p]
+        if parts[:3] != ["apis", LEASE_GROUP, "v1"]:
+            return None
+        return parts[3:]
+
+    @staticmethod
     def _read_body(h):
         """Parsed JSON body, or None (after a 400 response) on garbage."""
         n = int(h.headers.get("Content-Length", 0))
@@ -485,6 +542,31 @@ class RestServer:
                                     "versions": ["v1"]})
         if path == "/api/v1":
             return h._respond(200, api_resource_list())
+        if path == "/apis":
+            return h._respond(200, {
+                "kind": "APIGroupList",
+                "groups": [{
+                    "name": LEASE_GROUP,
+                    "versions": [{"groupVersion": f"{LEASE_GROUP}/v1",
+                                  "version": "v1"}],
+                    "preferredVersion": {
+                        "groupVersion": f"{LEASE_GROUP}/v1",
+                        "version": "v1"},
+                }],
+            })
+        if path == f"/apis/{LEASE_GROUP}/v1":
+            return h._respond(200, {
+                "kind": "APIResourceList",
+                "groupVersion": f"{LEASE_GROUP}/v1",
+                "resources": [
+                    {"name": name, "kind": kind, "namespaced": namespaced,
+                     "verbs": list(verbs)}
+                    for name, kind, namespaced, verbs in GROUP_RESOURCES
+                ],
+            })
+        gseg = self._route_group(url.path)
+        if gseg is not None:
+            return self._get_lease(h, gseg)
         if path == "/openapi/v2":
             return h._respond(200, openapi_doc())
         if path == "/version":
@@ -618,6 +700,36 @@ class RestServer:
                 return h._fail(404, "NotFound", f'pods "{seg[1]}" not found')
             return h._respond(200, _with_rv(pod_to_json(p), hub,
                                             f"pods/{p.key()}"))
+        return h._fail(404, "NotFound", h.path)
+
+    def _get_lease(self, h, seg) -> None:
+        """Read-only Lease routes: list (all or one namespace) and get."""
+        hub = self.hub
+
+        def doc(key):
+            ns, name = key.split("/", 1)
+            return lease_to_json(
+                ns, name, hub.leases[key],
+                hub.resource_version.get(f"leases/{key}", 0))
+
+        ns = None
+        if seg[:1] == ["namespaces"] and len(seg) >= 3:
+            ns, seg = seg[1], seg[2:]
+        if seg == ["leases"]:
+            items = [doc(key) for key in sorted(hub.leases)
+                     if ns is None or key.split("/", 1)[0] == ns]
+            return h._respond(200, {
+                "kind": "LeaseList",
+                "apiVersion": f"{LEASE_GROUP}/v1",
+                "metadata": {"resourceVersion": str(hub._revision)},
+                "items": items,
+            })
+        if len(seg) == 2 and seg[0] == "leases" and ns is not None:
+            key = f"{ns}/{seg[1]}"
+            if key not in hub.leases:
+                return h._fail(404, "NotFound",
+                               f'leases "{seg[1]}" not found')
+            return h._respond(200, doc(key))
         return h._fail(404, "NotFound", h.path)
 
     # -- watch --------------------------------------------------------------

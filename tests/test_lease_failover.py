@@ -57,6 +57,53 @@ def test_lease_cas_interleaved_single_winner():
     assert rec.holder_identity == "a"
 
 
+def test_leases_observable_over_rest_and_ktpu(capsys):
+    """HA state is API-observable: the Lease the electors CAS shows up
+    under /apis/coordination.k8s.io/v1 (group discovery included) and in
+    `ktpu get leases` — the operator's `kubectl get leases -n
+    kube-system` loop."""
+    import http.client
+    import json
+
+    from kubernetes_tpu.kubectl import main as ktpu
+    from kubernetes_tpu.restapi import RestServer
+
+    hub = HollowCluster(seed=12)
+    cfg = LeaderElectionConfig(lease_duration_s=15)
+    a = LeaderElector("sched-a", LeaseLock(hub), cfg, hub.clock)
+    assert a.tick()
+    srv = RestServer(hub)
+    port = srv.serve()
+    try:
+        def get(path):
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            c.request("GET", path)
+            r = c.getresponse()
+            d = json.loads(r.read())
+            c.close()
+            return r.status, d
+
+        code, doc = get("/apis")
+        assert code == 200 and doc["groups"][0]["name"] == "coordination.k8s.io"
+        code, doc = get("/apis/coordination.k8s.io/v1/namespaces/"
+                        "kube-system/leases/kube-scheduler")
+        assert code == 200
+        assert doc["spec"]["holderIdentity"] == "sched-a"
+        rv1 = int(doc["metadata"]["resourceVersion"])
+        hub.clock.advance(5)
+        a.tick()  # renew -> rv bumps, visible over the API
+        code, doc = get("/apis/coordination.k8s.io/v1/leases")
+        assert code == 200 and len(doc["items"]) == 1
+        assert int(doc["items"][0]["metadata"]["resourceVersion"]) > rv1
+
+        rc = ktpu(["--api-server", f"127.0.0.1:{port}", "get", "leases",
+                   "-n", "kube-system"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "sched-a" in out and "kube-scheduler" in out
+    finally:
+        srv.close()
+
+
 def test_scheduler_failover_no_double_binds_queue_continuity():
     """Kill the leader mid-run; the standby acquires the Lease through
     the hub and finishes the queue. Every pod binds exactly once and

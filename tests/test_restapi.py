@@ -687,6 +687,13 @@ def test_discovery_and_openapi_surface():
         req(port, "POST", "/api/v1/nodes", NODE)
         req(port, "POST", "/api/v1/namespaces/default/pods",
             make_pod_doc("d0"))
+        # a Lease fixture so the group routes' {name} instantiation hits
+        # a real object (the drift loop substitutes name -> d0)
+        from kubernetes_tpu.leaderelection import LeaderElectionRecord
+
+        hub.cas_lease("default", "d0",
+                      LeaderElectionRecord(holder_identity="x",
+                                           renew_time=1.0), 0)
 
         code, doc = req(port, "GET", "/api")
         assert code == 200 and doc["kind"] == "APIVersions"

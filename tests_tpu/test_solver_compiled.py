@@ -7,6 +7,8 @@ Run manually when the shared chip is healthy:
     python -m pytest tests_tpu/ -q
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -39,7 +41,7 @@ def test_predicates_compiled_matches_oracle():
     mixed-constraint shape (taints, selectors, ports, pressure)."""
     import sys
 
-    sys.path.insert(0, "tests")
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
     import pyref
     from kubernetes_tpu.ops.predicates import run_predicates
     from test_predicates import oracle_mask, random_cluster
@@ -170,7 +172,7 @@ def test_sinkhorn_beats_argmax_on_tied_preferences_tpu():
     test_predicates_compiled_matches_oracle)."""
     import sys
 
-    sys.path.insert(0, "tests")
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
     from test_sinkhorn import run_tied_preferences_comparison
 
     scores = run_tied_preferences_comparison()
