@@ -241,30 +241,56 @@ class TpuSchedulerService:
         return pb.BindResult(ok=True, error="")
 
 
-def _handlers(svc: TpuSchedulerService) -> grpc.GenericRpcHandler:
+def _authed(fn, token):
+    """Bearer-token gate for one RPC behavior — the wire seam's analog of
+    the REST facade's WithAuthentication filter (the reference secures
+    this hop with TLS/token auth on the apiserver connection). The check
+    runs eagerly at call time, BEFORE any stream generator is returned,
+    so streaming RPCs reject as early as unary ones. A falsy token
+    (None or "") keeps the seam open on BOTH sides — an unset env var
+    must not produce a server demanding the empty bearer string."""
+    import hmac
+
+    if not token:
+        return fn
+    want = f"Bearer {token}"
+
+    def check(request_or_iterator, context):
+        md = dict(context.invocation_metadata())
+        # constant-time compare: this IS the authentication filter
+        if not hmac.compare_digest(md.get("authorization", ""), want):
+            context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                          "invalid bearer token")
+        return fn(request_or_iterator, context)
+
+    return check
+
+
+def _handlers(svc: TpuSchedulerService,
+              token: "str | None" = None) -> grpc.GenericRpcHandler:
     rpcs = {
         "SyncState": grpc.stream_stream_rpc_method_handler(
-            svc.sync_state,
+            _authed(svc.sync_state, token),
             request_deserializer=pb.SnapshotDelta.FromString,
             response_serializer=pb.SyncAck.SerializeToString,
         ),
         "Filter": grpc.unary_unary_rpc_method_handler(
-            svc.filter,
+            _authed(svc.filter, token),
             request_deserializer=pb.ExtenderArgs.FromString,
             response_serializer=pb.ExtenderFilterResult.SerializeToString,
         ),
         "Prioritize": grpc.unary_unary_rpc_method_handler(
-            svc.prioritize,
+            _authed(svc.prioritize, token),
             request_deserializer=pb.ExtenderArgs.FromString,
             response_serializer=pb.HostPriorityList.SerializeToString,
         ),
         "Bind": grpc.unary_unary_rpc_method_handler(
-            svc.bind,
+            _authed(svc.bind, token),
             request_deserializer=pb.Binding.FromString,
             response_serializer=pb.BindResult.SerializeToString,
         ),
         "GetState": grpc.unary_unary_rpc_method_handler(
-            svc.get_state,
+            _authed(svc.get_state, token),
             request_deserializer=pb.StateRequest.FromString,
             response_serializer=pb.StateSnapshot.SerializeToString,
         ),
@@ -273,10 +299,12 @@ def _handlers(svc: TpuSchedulerService) -> grpc.GenericRpcHandler:
 
 
 def serve_grpc(scheduler, address: str = "127.0.0.1:0",
-               max_workers: int = 8, service=None):
+               max_workers: int = 8, service=None, token=None):
     """Start the gRPC service; returns (server, bound_port). Pass an
     existing ``service`` to share it with a service-side cycle loop (which
-    must hold ``service.lock`` around schedule_cycle)."""
+    must hold ``service.lock`` around schedule_cycle). ``token`` gates
+    every RPC behind `authorization: Bearer <token>` metadata (the wire
+    seam's authentication filter); None/"" keeps the seam open."""
     if service is not None and service.scheduler is not scheduler:
         raise ValueError(
             "serve_grpc: `service` wraps a different Scheduler than the one "
@@ -285,7 +313,7 @@ def serve_grpc(scheduler, address: str = "127.0.0.1:0",
         )
     svc = service or TpuSchedulerService(scheduler)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
-    server.add_generic_rpc_handlers((_handlers(svc),))
+    server.add_generic_rpc_handlers((_handlers(svc, token),))
     port = server.add_insecure_port(address)
     server.start()
     return server, port
@@ -365,37 +393,52 @@ class SnapshotDeltaBridge:
 
 class GrpcSchedulerClient:
     """The Go-side shim's view: typed stubs over a channel (what a
-    generated *_pb2_grpc.Stub provides)."""
+    generated *_pb2_grpc.Stub provides). ``token`` attaches
+    `authorization: Bearer <token>` metadata to every call (the client
+    half of the seam's authentication)."""
 
-    def __init__(self, target: str):
+    def __init__(self, target: str, token: "str | None" = None):
         self.target = target
         self.channel = grpc.insecure_channel(target)
+        self._md = ([("authorization", f"Bearer {token}")]
+                    if token else None)
+
+        def with_md(callable_):
+            if self._md is None:
+                return callable_
+
+            def call(*a, **kw):
+                kw.setdefault("metadata", self._md)
+                return callable_(*a, **kw)
+
+            return call
+
         base = f"/{SERVICE_NAME}/"
-        self.sync_state = self.channel.stream_stream(
+        self.sync_state = with_md(self.channel.stream_stream(
             base + "SyncState",
             request_serializer=pb.SnapshotDelta.SerializeToString,
             response_deserializer=pb.SyncAck.FromString,
-        )
-        self.filter = self.channel.unary_unary(
+        ))
+        self.filter = with_md(self.channel.unary_unary(
             base + "Filter",
             request_serializer=pb.ExtenderArgs.SerializeToString,
             response_deserializer=pb.ExtenderFilterResult.FromString,
-        )
-        self.prioritize = self.channel.unary_unary(
+        ))
+        self.prioritize = with_md(self.channel.unary_unary(
             base + "Prioritize",
             request_serializer=pb.ExtenderArgs.SerializeToString,
             response_deserializer=pb.HostPriorityList.FromString,
-        )
-        self.bind = self.channel.unary_unary(
+        ))
+        self.bind = with_md(self.channel.unary_unary(
             base + "Bind",
             request_serializer=pb.Binding.SerializeToString,
             response_deserializer=pb.BindResult.FromString,
-        )
-        self.get_state = self.channel.unary_unary(
+        ))
+        self.get_state = with_md(self.channel.unary_unary(
             base + "GetState",
             request_serializer=pb.StateRequest.SerializeToString,
             response_deserializer=pb.StateSnapshot.FromString,
-        )
+        ))
 
     def close(self) -> None:
         self.channel.close()

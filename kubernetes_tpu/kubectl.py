@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Dict, List, Optional
 
@@ -372,11 +373,11 @@ def cmd_cordon(rest: RestClient, args, unschedulable: bool) -> int:
 class _Client:
     """Thin wrapper adding get_state_snapshot() sugar."""
 
-    def __init__(self, target: str):
+    def __init__(self, target: str, token=None):
         from kubernetes_tpu.grpc_shim import GrpcSchedulerClient
         from kubernetes_tpu.proto import extender_pb2 as pb
 
-        self._c = GrpcSchedulerClient(target)
+        self._c = GrpcSchedulerClient(target, token=token)
         self._pb = pb
 
     def get_state_snapshot(self):
@@ -393,6 +394,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--server", help="gRPC service HOST:PORT (read verbs)")
     p.add_argument("--api-server",
                    help="REST registry HOST:PORT (mutation verbs)")
+    p.add_argument("--token", default=os.environ.get("KTPU_TOKEN"),
+                   help="bearer token for a token-gated gRPC service "
+                        "(or KTPU_TOKEN env var)")
     sub = p.add_subparsers(dest="cmd", required=True)
     g = sub.add_parser("get")
     g.add_argument("kind")
@@ -452,13 +456,24 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if not args.server:
         p.error(f"{args.cmd} requires --server")
-    client = _Client(args.server)
+    import grpc
+
+    client = _Client(args.server, token=args.token)
     try:
         if args.cmd == "get":
             return cmd_get(client, args)
         if args.cmd == "top":
             return cmd_top(client, args)
         return cmd_describe(client, args)
+    except grpc.RpcError as e:
+        # kubectl-style one-line failures, not tracebacks: an
+        # UNAUTHENTICATED here means the service is token-gated —
+        # say how to supply one
+        hint = (" (pass --token or set KTPU_TOKEN)"
+                if e.code() == grpc.StatusCode.UNAUTHENTICATED else "")
+        print(f"Error from server: {e.code().name}: {e.details()}{hint}",
+              file=sys.stderr)
+        return 1
     finally:
         client.close()
 

@@ -282,3 +282,45 @@ def test_stream_reconnect_resumes_from_acked_revision(shim):
     res = sched.schedule_cycle()
     assert res.scheduled == 2                    # both pods, exactly once
     assert sorted(res.assignments) == ["default/w0", "default/w1"]
+
+
+def test_grpc_bearer_token_gates_every_rpc():
+    """The wire seam's authentication filter (serve_grpc token=): a
+    client without (or with a wrong) bearer token gets UNAUTHENTICATED
+    on unary AND streaming RPCs; the right token opens every verb; a
+    token-less server stays open (back-compat)."""
+    import grpc as grpc_mod
+
+    from kubernetes_tpu.proto import extender_pb2 as pb2
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import make_node
+
+    sched = Scheduler(enable_preemption=False)
+    sched.on_node_add(make_node("n0", cpu_milli=1000))
+    server, port = serve_grpc(sched, token="s3cret")
+    try:
+        for client_token in (None, "wrong"):
+            c = GrpcSchedulerClient(f"127.0.0.1:{port}", token=client_token)
+            with pytest.raises(grpc_mod.RpcError) as ei:
+                c.get_state(pb2.StateRequest())
+            assert ei.value.code() == grpc_mod.StatusCode.UNAUTHENTICATED
+            with pytest.raises(grpc_mod.RpcError) as ei:
+                list(c.sync_state(iter([pb2.SnapshotDelta(revision=1)])))
+            assert ei.value.code() == grpc_mod.StatusCode.UNAUTHENTICATED
+            c.close()
+        ok = GrpcSchedulerClient(f"127.0.0.1:{port}", token="s3cret")
+        st = ok.get_state(pb2.StateRequest())
+        assert len(st.node_json) == 1
+        acks = list(ok.sync_state(iter([pb2.SnapshotDelta(revision=7)])))
+        assert acks and acks[-1].revision == 7
+        ok.close()
+    finally:
+        server.stop(grace=None)
+
+    open_server, oport = serve_grpc(sched)  # no token -> open seam
+    try:
+        c = GrpcSchedulerClient(f"127.0.0.1:{oport}")
+        assert len(c.get_state(pb2.StateRequest()).node_json) == 1
+        c.close()
+    finally:
+        open_server.stop(grace=None)
