@@ -39,6 +39,11 @@ def pod_to_json(pod: Pod) -> dict:
             "namespace": pod.namespace,
             "uid": pod.uid or pod.key(),
             "labels": dict(pod.labels),
+            **({"ownerReferences": [
+                {"kind": r.kind, "name": r.name,
+                 **({"uid": r.uid} if r.uid else {})}
+                for r in pod.owner_refs
+            ]} if pod.owner_refs else {}),
         },
         "spec": {
             "nodeName": pod.node_name,

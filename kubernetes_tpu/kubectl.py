@@ -239,18 +239,23 @@ def cmd_describe(client, args) -> int:
 
 
 class RestClient:
-    """HTTP client for the REST registry (restapi.py)."""
+    """HTTP client for the REST registry (restapi.py). ``token`` sends
+    `Authorization: Bearer <token>` on every request — the client half
+    of the facade's authentication filter."""
 
-    def __init__(self, target: str):
+    def __init__(self, target: str, token=None):
         host, _, port = target.rpartition(":")
         self.host, self.port = host or "127.0.0.1", int(port)
+        self._headers = ({"Authorization": f"Bearer {token}"}
+                         if token else {})
 
     def call(self, method: str, path: str, body=None):
         import http.client
 
         conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
         conn.request(method, path,
-                     json.dumps(body) if body is not None else None)
+                     json.dumps(body) if body is not None else None,
+                     self._headers)
         r = conn.getresponse()
         data = r.read()
         conn.close()
@@ -334,6 +339,54 @@ def cmd_get_leases(rest: RestClient, args) -> int:
     return 0
 
 
+def cmd_drain(rest: RestClient, args) -> int:
+    """kubectl drain: cordon the node, then EVICT every pod on it
+    through the Eviction subresource (PDB-guarded; a 429 is reported and
+    leaves the pod — kubectl's retry loop compressed to one pass with an
+    honest exit code). DaemonSet-owned pods are skipped, kubectl's
+    --ignore-daemonsets posture (their controller would just repin
+    them)."""
+    rc = cmd_cordon(rest, args, unschedulable=True)
+    if rc != 0:
+        return rc
+    code, doc = rest.call("GET", "/api/v1/pods")
+    if code != 200:
+        return _rest_fail(doc)
+    blocked = []
+    for p in doc["items"]:
+        if p["spec"].get("nodeName") != args.name:
+            continue
+        m = p["metadata"]
+        refs = p["metadata"].get("ownerReferences") or []
+        if any(r.get("kind") == "DaemonSet" for r in refs):
+            print(f"ignoring DaemonSet-managed pod {m['name']}")
+            continue
+        code, out = rest.call(
+            "POST",
+            f"/api/v1/namespaces/{m['namespace']}/pods/{m['name']}/eviction",
+            {"kind": "Eviction",
+             "metadata": {"name": m["name"], "namespace": m["namespace"]}},
+        )
+        if code == 201:
+            print(f"pod/{m['name']} evicted")
+        elif code == 404:
+            # vanished between list and evict — exactly what drain
+            # wanted; kubectl treats this as success too
+            print(f"pod/{m['name']} already gone")
+        elif code == 429:
+            blocked.append(m["name"])
+            print(f"error when evicting pod/{m['name']}: "
+                  f"{out.get('message', '')}", file=sys.stderr)
+        else:
+            return _rest_fail(out)
+    if blocked:
+        print(f"drain incomplete: {len(blocked)} pod(s) blocked by "
+              "disruption budgets", file=sys.stderr)
+        return 1
+    print(f"node/{args.name} drained")
+    return 0
+
+
 def cmd_delete(rest: RestClient, args) -> int:
     if args.kind in ("node", "nodes"):
         code, out = rest.call("DELETE", f"/api/v1/nodes/{args.name}")
@@ -414,7 +467,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     de.add_argument("kind", choices=["pod", "pods", "node", "nodes"])
     de.add_argument("name")
     de.add_argument("-n", "--namespace", default="default")
-    for verb in ("cordon", "uncordon"):
+    for verb in ("cordon", "uncordon", "drain"):
         cv = sub.add_parser(verb)
         cv.add_argument("name")
     args = p.parse_args(argv)
@@ -423,7 +476,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not args.api_server:
             p.error(f"get {args.kind} requires --api-server")
         try:
-            rest = RestClient(args.api_server)
+            rest = RestClient(args.api_server, token=args.token)
         except ValueError:
             p.error(f"--api-server must be HOST:PORT, got {args.api_server!r}")
         try:
@@ -435,11 +488,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 1
 
-    if args.cmd in ("create", "delete", "cordon", "uncordon"):
+    if args.cmd in ("create", "delete", "cordon", "uncordon", "drain"):
         if not args.api_server:
             p.error(f"{args.cmd} requires --api-server")
         try:
-            rest = RestClient(args.api_server)
+            rest = RestClient(args.api_server, token=args.token)
         except ValueError:
             p.error(f"--api-server must be HOST:PORT, got {args.api_server!r}")
         try:
@@ -447,6 +500,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 return cmd_create(rest, args)
             if args.cmd == "delete":
                 return cmd_delete(rest, args)
+            if args.cmd == "drain":
+                return cmd_drain(rest, args)
             return cmd_cordon(rest, args,
                               unschedulable=(args.cmd == "cordon"))
         except OSError as e:

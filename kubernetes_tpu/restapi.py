@@ -111,6 +111,7 @@ class AuditLog:
 RESOURCES = (
     ("pods", "Pod", True, ("create", "delete", "get", "list", "watch")),
     ("pods/binding", "Binding", True, ("create",)),
+    ("pods/eviction", "Eviction", True, ("create",)),
     ("nodes", "Node", False,
      ("create", "delete", "get", "list", "update", "watch")),
     ("services", "Service", True, ("list",)),
@@ -810,6 +811,19 @@ class RestServer:
                 stored = hub.truth_pods[pod.key()]
                 return h._respond(201, _with_rv(pod_to_json(stored), hub,
                                                 f"pods/{stored.key()}"))
+            if len(seg) == 3 and seg[0] == "pods" and seg[2] == "eviction":
+                # the Eviction subresource (eviction.go:147): PDB-guarded
+                # graceful delete; an exhausted budget is 429
+                # TooManyRequests, exactly the apiserver's answer
+                key = f"{ns}/{seg[1]}"
+                if key not in hub.truth_pods:
+                    return h._fail(404, "NotFound",
+                                   f'pods "{seg[1]}" not found')
+                ok, msg = hub.evict_pod(key)
+                if not ok:
+                    return h._fail(429, "TooManyRequests", msg)
+                return h._respond(201, status_doc(201, "", "")
+                                  | {"status": "Success"})
             if len(seg) == 3 and seg[0] == "pods" and seg[2] == "binding":
                 key = f"{ns}/{seg[1]}"
                 pod = hub.truth_pods.get(key)

@@ -21,7 +21,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from kubernetes_tpu.api.types import Pod, Resources
+from kubernetes_tpu.api.types import OwnerReference, Pod, Resources
 
 def parse_quantity(s, is_cpu: bool = False) -> float:
     """Wire-seam quantity decode: cpu strings → milli-CPU, everything
@@ -54,6 +54,11 @@ def pod_from_json(d: dict) -> Pod:
         namespace=meta.get("namespace", "default"),
         uid=meta.get("uid", ""),
         labels=dict(meta.get("labels") or {}),
+        owner_refs=tuple(
+            OwnerReference(kind=r.get("kind", ""), name=r.get("name", ""),
+                           uid=r.get("uid", ""))
+            for r in (meta.get("ownerReferences") or [])
+        ),
         node_name=spec.get("nodeName", ""),
         node_selector=dict(spec.get("nodeSelector") or {}),
         priority=int(spec.get("priority") or 0),

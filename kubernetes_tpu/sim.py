@@ -1517,6 +1517,40 @@ class HollowCluster:
     def add_pdb(self, pdb) -> None:
         self.pdbs.append(pdb)
 
+    def evict_pod(self, key: str):
+        """The Eviction subresource's storage half (policy/v1beta1
+        Eviction; registry/core/pod/storage/eviction.go:147 checks every
+        covering PDB and PATCHes disruptionsAllowed down atomically):
+        returns (True, "") and deletes the pod, or (False, message) when
+        any covering budget is exhausted — the 429 the apiserver sends.
+        The disruption is charged IMMEDIATELY (all covering PDBs
+        decrement) so a burst of evictions cannot overshoot the budget
+        between disruption-controller passes."""
+        import dataclasses
+
+        pod = self.truth_pods.get(key)
+        if pod is None:
+            return False, f'pods "{key}" not found'
+        covering = [pdb for pdb in self.pdbs if pdb.matches(pod)]
+        if any(pdb.disruptions_allowed <= 0 for pdb in covering):
+            return False, (
+                "Cannot evict pod as it would violate the pod's "
+                "disruption budget."
+            )
+        for pdb in covering:
+            pdb.disruptions_allowed -= 1
+        # observable terminating hop (deletionTimestamp) before the
+        # delete — endpoints/watchers see the pod leave rotation first.
+        # clock.t can be 0.0 at sim start and deletionTimestamp's unset
+        # value is also 0.0, so floor at a positive epsilon or the hop
+        # would be invisible to every `not deletion_timestamp` consumer
+        terminating = dataclasses.replace(
+            pod, deletion_timestamp=self.clock.t or 1e-9)
+        self.truth_pods[key] = terminating
+        self._commit(f"pods/{key}", "MODIFIED", terminating)
+        self.delete_pod(key)
+        return True, ""
+
     def reconcile_pdbs(self) -> None:
         """Maintain PDB status the way the disruption controller does:
         disruptionsAllowed = max(0, currentHealthy - minAvailable), where

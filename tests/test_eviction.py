@@ -1,0 +1,126 @@
+"""Eviction subresource + kubectl drain (policy/v1beta1 Eviction,
+registry/core/pod/storage/eviction.go:147): PDB-guarded graceful
+deletes over REST, and the drain flow = cordon + evict-all with
+DaemonSet pods ignored and budget blocks reported honestly."""
+
+import json
+import http.client
+
+from kubernetes_tpu.api.types import LabelSelector, PodDisruptionBudget
+from kubernetes_tpu.kubectl import main as ktpu
+from kubernetes_tpu.restapi import RestServer
+from kubernetes_tpu.sim import DaemonSet, Deployment, HollowCluster
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _req(port, method, path, body=None):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    c.request(method, path, json.dumps(body) if body is not None else None)
+    r = c.getresponse()
+    d = r.read()
+    c.close()
+    return r.status, json.loads(d) if d else None
+
+
+def test_eviction_respects_pdb_budget():
+    hub = HollowCluster(seed=71, scheduler_kw={"enable_preemption": False})
+    for i in range(3):
+        hub.add_node(make_node(f"n{i}", cpu_milli=4000))
+    for i in range(3):
+        hub.create_pod(make_pod(f"w{i}", cpu_milli=100,
+                                labels={"app": "web"}))
+    hub.add_pdb(PodDisruptionBudget(
+        name="web-pdb", selector=LabelSelector(match_labels={"app": "web"}),
+        min_available=2))
+    for _ in range(2):
+        hub.step()  # bind + PDB status
+    srv = RestServer(hub)
+    port = srv.serve()
+    try:
+        # budget = 3 healthy - 2 minAvailable = 1 disruption allowed
+        code, _ = _req(port, "POST",
+                       "/api/v1/namespaces/default/pods/w0/eviction",
+                       {"kind": "Eviction"})
+        assert code == 201
+        assert "default/w0" not in hub.truth_pods
+        # the next one violates the budget -> 429, pod stays
+        code, doc = _req(port, "POST",
+                         "/api/v1/namespaces/default/pods/w1/eviction",
+                         {"kind": "Eviction"})
+        assert code == 429 and doc["reason"] == "TooManyRequests"
+        assert "disruption budget" in doc["message"]
+        assert "default/w1" in hub.truth_pods
+        # absent pod is a plain 404
+        code, _ = _req(port, "POST",
+                       "/api/v1/namespaces/default/pods/nope/eviction",
+                       {"kind": "Eviction"})
+        assert code == 404
+        # once the controller restores health, the budget reopens
+        for _ in range(3):
+            hub.step()
+        hub.check_consistency()
+    finally:
+        srv.close()
+
+
+def test_ktpu_drain_evicts_ignores_daemons_reports_blocks(capsys):
+    hub = HollowCluster(seed=72, scheduler_kw={"enable_preemption": False})
+    for i in range(4):
+        hub.add_node(make_node(f"n{i}", cpu_milli=8000))
+    hub.add_deployment(Deployment("web", replicas=4))
+    hub.add_daemonset(DaemonSet("agent"))
+    for _ in range(3):
+        hub.step()
+    srv = RestServer(hub)
+    port = srv.serve()
+    try:
+        target = next(p.node_name for p in hub.truth_pods.values()
+                      if p.labels.get("deploy") == "web")
+        rc = ktpu(["--api-server", f"127.0.0.1:{port}", "drain", target])
+        out = capsys.readouterr()
+        assert rc == 0, out.err
+        assert "drained" in out.out
+        assert "ignoring DaemonSet-managed pod" in out.out
+        # cordoned + empty of non-daemon pods
+        assert hub.truth_nodes[target].unschedulable
+        left = [p for p in hub.truth_pods.values()
+                if p.node_name == target]
+        assert all(
+            any(r.kind == "DaemonSet" for r in p.owner_refs) for p in left
+        ), left
+        # controllers repopulate ELSEWHERE (the cordon holds)
+        for _ in range(4):
+            hub.step()
+        web = [p for p in hub.truth_pods.values()
+               if p.labels.get("deploy") == "web"]
+        assert len(web) == 4
+        assert all(p.node_name and p.node_name != target for p in web)
+        hub.check_consistency()
+    finally:
+        srv.close()
+
+
+def test_ktpu_drain_blocked_by_pdb_exits_nonzero(capsys):
+    hub = HollowCluster(seed=73, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=8000))
+    hub.add_node(make_node("n1", cpu_milli=8000))
+    for i in range(2):
+        hub.create_pod(make_pod(f"w{i}", cpu_milli=100,
+                                labels={"app": "web"}))
+    hub.add_pdb(PodDisruptionBudget(
+        name="web-pdb", selector=LabelSelector(match_labels={"app": "web"}),
+        min_available=2))  # zero disruptions allowed
+    for _ in range(2):
+        hub.step()
+    srv = RestServer(hub)
+    port = srv.serve()
+    try:
+        target = hub.truth_pods["default/w0"].node_name
+        rc = ktpu(["--api-server", f"127.0.0.1:{port}", "drain", target])
+        out = capsys.readouterr()
+        assert rc == 1
+        assert "blocked by" in out.err and "disruption budget" in out.err
+        assert hub.truth_nodes[target].unschedulable  # cordon still applied
+        assert "default/w0" in hub.truth_pods or "default/w1" in hub.truth_pods
+    finally:
+        srv.close()

@@ -750,6 +750,8 @@ def test_discovery_and_openapi_surface():
                 if path.endswith("/binding"):
                     body = {"target": {"name": "n0"}}
                     want = (201, 409)  # d0 may already be bound
+                elif path.endswith("/eviction"):
+                    body, want = {"kind": "Eviction"}, (201, 429)
                 elif "/nodes" in path:
                     body, want = NODE, (201, 409)  # n0 exists
                 else:
@@ -758,7 +760,8 @@ def test_discovery_and_openapi_surface():
                 _, body = req(port, "GET", "/api/v1/nodes/n0")
             code, doc = req(port, method.upper(), path, body)
             assert code in want, (method, path, code, doc)
-            if method == "delete":  # restore the fixture
+            if method == "delete" or path.endswith("/eviction"):
+                # restore the fixture the op consumed
                 if "/nodes" in path:
                     req(port, "POST", "/api/v1/nodes", NODE)
                 else:
