@@ -118,6 +118,12 @@ class KubeSchedulerConfigurationV1alpha1:
     leaderElection: "LeaderElectionConfigurationV1alpha1" = field(
         default_factory=LeaderElectionConfigurationV1alpha1)
     featureGates: Optional[dict] = None
+    #: framework plugins: a flat enabled-name list (the per-extension-
+    #: point Plugins struct is recast — see config.py) and the
+    #: reference-shaped pluginConfig list of {name, args}
+    #: (apis/config/types.go:127)
+    plugins: Optional[list] = None
+    pluginConfig: Optional[list] = None
     # this implementation's solver block, versioned alongside (camelCase
     # on the wire like every other field)
     solver: Optional[str] = None
@@ -195,6 +201,34 @@ def _to_internal(v: KubeSchedulerConfigurationV1alpha1) -> KubeSchedulerConfigur
         gates = FeatureGates(overrides=dict(v.featureGates or {}))
     except ValueError as e:
         raise SchemeError([f"featureGates: {e}"])
+    plugins = v.plugins or []
+    if not (isinstance(plugins, list)
+            and all(isinstance(p, str) for p in plugins)):
+        # a scalar string would tuple() into characters; the reference's
+        # per-extension-point Plugins dict would tuple() into its keys —
+        # both decode into garbage silently without this check
+        raise SchemeError([
+            "plugins: expected a list of plugin names "
+            f"(got {type(plugins).__name__})"
+        ])
+    plugin_config = {}
+    for i, entry in enumerate(v.pluginConfig or []):
+        if not isinstance(entry, dict) or not entry.get("name"):
+            raise SchemeError([f"pluginConfig[{i}].name: Required value"])
+        unknown = set(entry) - {"name", "args"}
+        if unknown:
+            # strict-serializer posture, same as every other field
+            raise SchemeError([
+                f"pluginConfig[{i}].{k}: unknown field"
+                for k in sorted(unknown)
+            ])
+        args = entry.get("args") or {}
+        if not isinstance(args, dict):
+            raise SchemeError([
+                f"pluginConfig[{i}].args: expected a mapping "
+                f"(got {type(args).__name__})"
+            ])
+        plugin_config[entry["name"]] = dict(args)
     try:
         bind_timeout = float(v.bindTimeoutSeconds)
     except (TypeError, ValueError):
@@ -217,6 +251,8 @@ def _to_internal(v: KubeSchedulerConfigurationV1alpha1) -> KubeSchedulerConfigur
             lock_object_name=le.lockObjectName,
         ),
         feature_gates=gates,
+        plugins=tuple(plugins),
+        plugin_config=plugin_config,
         solver=v.solver,
         per_node_cap=v.perNodeCap,
         max_rounds=v.maxRounds,
@@ -246,6 +282,9 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
             lockObjectName=le.lock_object_name,
         ),
         featureGates=gates,
+        plugins=list(c.plugins) or None,
+        pluginConfig=[{"name": k, "args": dict(v)}
+                      for k, v in c.plugin_config.items()] or None,
         solver=c.solver,
         perNodeCap=c.per_node_cap,
         maxRounds=c.max_rounds,

@@ -123,6 +123,15 @@ class KubeSchedulerConfiguration:
     bind_timeout_seconds: float = 600.0
     leader_election: LeaderElectionConfig = field(default_factory=LeaderElectionConfig)
     feature_gates: FeatureGates = field(default_factory=FeatureGates)
+    #: framework plugins to enable, by PLUGIN_REGISTRY name. The
+    #: reference's Plugins struct (apis/config/types.go:98) enables per
+    #: extension point; this framework's Plugin classes implement points
+    #: by METHOD PRESENCE, so a flat enabled list is the honest recast —
+    #: a plugin participates at exactly the points it implements.
+    plugins: Tuple[str, ...] = ()
+    #: per-plugin args (PluginConfig, types.go:127): name -> args mapping
+    #: handed to the registered factory.
+    plugin_config: Dict[str, dict] = field(default_factory=dict)
     # batched-solver tuning (no reference analog)
     solver: str = "batch"
     per_node_cap: int = 4

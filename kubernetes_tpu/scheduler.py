@@ -248,6 +248,24 @@ class Scheduler:
         kw.setdefault("max_rounds", cfg.max_rounds)
         kw.setdefault("max_batch", cfg.max_batch)
         kw.setdefault("scheduler_name", cfg.scheduler_name)
+        if getattr(cfg, "plugins", ()) and "framework" not in kw:
+            # config-driven framework assembly (the NewFramework path,
+            # framework.go:88: registry factories + per-plugin args from
+            # PluginConfig). Unknown names fail loudly like the
+            # reference's NewFramework does.
+            from kubernetes_tpu.framework import PLUGIN_REGISTRY, Framework
+
+            built = []
+            for name in cfg.plugins:
+                factory = PLUGIN_REGISTRY.get(name)
+                if factory is None:
+                    raise ValueError(
+                        f"plugins: {name!r} is not registered "
+                        f"(known: {sorted(PLUGIN_REGISTRY)})"
+                    )
+                built.append(factory(dict(cfg.plugin_config.get(name, {}))))
+            kw["framework"] = Framework(
+                built, clock=kw.get("clock", time.monotonic))
         # 100 (the config default) = no truncation; 0 = the reference's
         # adaptive rule; 1-99 fixed — passed through verbatim so the
         # adaptive mode stays expressible from config

@@ -172,3 +172,86 @@ def test_policy_source_converts():
     }
     cfg = decode(doc)
     assert cfg.policy is not None
+
+
+def test_plugins_and_plugin_config_end_to_end():
+    """Plugins + PluginConfig (apis/config/types.go:98,:127): versioned
+    decode carries the enabled list and per-plugin args, round-trips,
+    and Scheduler.from_config assembles the framework from the registry
+    with those args — the NewFramework path, config file to running
+    plugin."""
+    from kubernetes_tpu.framework import PLUGIN_REGISTRY, Plugin, register_plugin
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import make_node, make_pod
+
+    class DenyLabeled(Plugin):
+        def __init__(self, args):
+            self.label = args.get("label", "quarantine")
+
+        def name(self):
+            return "DenyLabeled"
+
+        def pre_filter(self, state, pod):
+            from kubernetes_tpu.framework import UNSCHEDULABLE, Status
+
+            if pod.labels.get(self.label):
+                return Status(UNSCHEDULABLE,
+                              f"label {self.label} set")
+            return Status()
+
+    register_plugin("DenyLabeled", DenyLabeled)
+    try:
+        doc = {
+            "apiVersion": GROUP_VERSION,
+            "kind": KIND,
+            "plugins": ["DenyLabeled"],
+            "pluginConfig": [{"name": "DenyLabeled",
+                              "args": {"label": "blocked"}}],
+        }
+        cfg = decode(doc)
+        assert cfg.plugins == ("DenyLabeled",)
+        assert cfg.plugin_config == {"DenyLabeled": {"label": "blocked"}}
+        assert decode(encode(cfg)) == cfg  # round-trip
+
+        sched = Scheduler.from_config(cfg, enable_preemption=False)
+        sched.on_node_add(make_node("n0", cpu_milli=4000))
+        sched.on_pod_add(make_pod("ok", cpu_milli=100))
+        sched.on_pod_add(make_pod("nope", cpu_milli=100,
+                                  labels={"blocked": "1"}))
+        res = sched.schedule_cycle()
+        assert res.assignments.get("default/ok") == "n0"
+        assert "default/nope" not in res.assignments
+        reason = " ".join(res.failure_reasons.get("default/nope", ()))
+        assert "DenyLabeled" in reason and "blocked" in reason
+
+        # missing name in pluginConfig is a field-path error
+        with pytest.raises(SchemeError) as ei:
+            decode({"apiVersion": GROUP_VERSION, "kind": KIND,
+                    "pluginConfig": [{"args": {}}]})
+        assert "pluginConfig[0].name" in str(ei.value)
+        # unknown plugin name fails loudly at framework assembly
+        bad = decode({"apiVersion": GROUP_VERSION, "kind": KIND,
+                      "plugins": ["NotRegistered"]})
+        with pytest.raises(ValueError) as ei:
+            Scheduler.from_config(bad)
+        assert "NotRegistered" in str(ei.value)
+    finally:
+        PLUGIN_REGISTRY.pop("DenyLabeled", None)
+
+
+def test_plugin_config_strictness():
+    """Review regressions: scalar plugins, non-mapping args, and typo'd
+    entry keys are field-path SchemeErrors — never silent garbage or a
+    raw TypeError."""
+    with pytest.raises(SchemeError) as ei:
+        decode({"apiVersion": GROUP_VERSION, "kind": KIND,
+                "plugins": "DenyLabeled"})
+    assert "plugins" in str(ei.value)
+    with pytest.raises(SchemeError) as ei:
+        decode({"apiVersion": GROUP_VERSION, "kind": KIND,
+                "pluginConfig": [{"name": "X", "args": 5}]})
+    assert "pluginConfig[0].args" in str(ei.value)
+    with pytest.raises(SchemeError) as ei:
+        decode({"apiVersion": GROUP_VERSION, "kind": KIND,
+                "pluginConfig": [{"name": "X", "arg": {"a": 1}}]})
+    assert "pluginConfig[0].arg" in str(ei.value)
