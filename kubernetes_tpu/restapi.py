@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import collections
 import json
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -114,6 +115,7 @@ RESOURCES = (
     ("pods/eviction", "Eviction", True, ("create",)),
     ("nodes", "Node", False,
      ("create", "delete", "get", "list", "update", "watch")),
+    ("namespaces", "Namespace", False, ("create", "delete", "get", "list")),
     ("services", "Service", True, ("list",)),
     ("endpoints", "Endpoints", True, ("list",)),
     ("events", "Event", True, ("list",)),
@@ -227,6 +229,21 @@ def openapi_doc() -> dict:
             }},
         },
     }
+
+
+#: RFC-1123 DNS label — the apiserver's namespace/name validation
+#: (apimachinery validation.IsDNS1123Label); anything else (slashes,
+#: uppercase, 64+ chars) would mint objects no item route can address
+_DNS_LABEL = re.compile(r"^[a-z0-9]([-a-z0-9]{0,61}[a-z0-9])?$")
+
+
+def ns_to_json(hub, ns) -> dict:
+    """The one v1.Namespace document shape (phase is live controller
+    state), used by every namespace handler."""
+    return _with_rv({
+        "metadata": {"name": ns.name},
+        "status": {"phase": ns.phase},
+    }, hub, f"namespaces/{ns.name}")
 
 
 def status_doc(code: int, reason: str, message: str) -> dict:
@@ -596,6 +613,22 @@ class RestServer:
                 return h._fail(404, "NotFound", f'nodes "{seg[1]}" not found')
             return h._respond(200, _with_rv(node_to_json(n), hub,
                                             f"nodes/{n.name}"))
+        if seg[0] == "namespaces" and len(seg) <= 2:
+            # namespace discovery reads (registry/core/namespace): the
+            # lifecycle phase is live state — Terminating is what the
+            # namespace controller is mid-draining
+            if len(seg) == 1:
+                return h._respond(200, {
+                    "kind": "NamespaceList", "apiVersion": "v1",
+                    "metadata": {"resourceVersion": str(hub._revision)},
+                    "items": [ns_to_json(hub, n)
+                              for n in hub.namespaces.values()],
+                })
+            n = hub.namespaces.get(seg[1])
+            if n is None:
+                return h._fail(404, "NotFound",
+                               f'namespaces "{seg[1]}" not found')
+            return h._respond(200, ns_to_json(hub, n))
         ns = None
         if seg[0] == "namespaces" and len(seg) >= 3:
             ns, seg = seg[1], seg[2:]
@@ -793,6 +826,19 @@ class RestServer:
             hub.add_node(node)
             return h._respond(201, _with_rv(node_to_json(node), hub,
                                             f"nodes/{node.name}"))
+        if seg == ["namespaces"]:
+            name = (body.get("metadata") or {}).get("name", "")
+            if not name or not _DNS_LABEL.match(name):
+                # a non-DNS-label name (slash, uppercase, 64+) would mint
+                # an object no item route can ever address or delete
+                return h._fail(
+                    400, "BadRequest",
+                    "namespace metadata.name must be an RFC-1123 DNS label")
+            if name in hub.namespaces:
+                return h._fail(409, "AlreadyExists",
+                               f'namespaces "{name}" already exists')
+            hub.add_namespace(name)
+            return h._respond(201, ns_to_json(hub, hub.namespaces[name]))
         if seg[0] == "namespaces" and len(seg) >= 3:
             ns, seg = seg[1], seg[2:]
             if seg == ["pods"]:
@@ -890,6 +936,21 @@ class RestServer:
             hub.remove_node(seg[1])
             return h._respond(200, status_doc(200, "", "")
                               | {"status": "Success"})
+        if len(seg) == 2 and seg[0] == "namespaces":
+            # DELETE namespace = start termination; the namespace
+            # controller drains and removes it (the reference answers
+            # 200 with the Terminating-phase object, registry/core/
+            # namespace/storage Delete). Protection lives in the HUB
+            # guard so no seam can bypass it.
+            ns = hub.namespaces.get(seg[1])
+            if ns is None:
+                return h._fail(404, "NotFound",
+                               f'namespaces "{seg[1]}" not found')
+            try:
+                hub.terminate_namespace(seg[1])
+            except ValueError as e:
+                return h._fail(403, "Forbidden", str(e))
+            return h._respond(200, ns_to_json(hub, ns))
         if seg[0] == "namespaces" and len(seg) == 4 and seg[2] == "pods":
             key = f"{seg[1]}/{seg[3]}"
             if key not in hub.truth_pods:

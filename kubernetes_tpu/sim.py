@@ -1112,13 +1112,22 @@ class HollowCluster:
 
     def add_namespace(self, name: str) -> None:
         self.namespaces[name] = Namespace(name, NS_ACTIVE)
+        self._commit(f"namespaces/{name}", "ADDED", self.namespaces[name])
+
+    #: namespaces every entry point refuses to delete (the apiserver
+    #: protects these; one guard here so no seam can bypass it)
+    PROTECTED_NAMESPACES = ("default", "kube-system")
 
     def terminate_namespace(self, name: str) -> None:
         """Mark Terminating; the namespace-controller pass in step() then
-        drains its content and removes it (pkg/controller/namespace)."""
+        drains its content and removes it (pkg/controller/namespace).
+        Raises ValueError for protected system namespaces."""
+        if name in self.PROTECTED_NAMESPACES:
+            raise ValueError(f'namespaces "{name}" is protected')
         ns = self.namespaces.get(name)
         if ns is not None:
             ns.phase = NS_TERMINATING
+            self._commit(f"namespaces/{name}", "MODIFIED", ns)
 
     def add_priority_class(self, cls) -> None:
         self.priority_classes[cls.name] = cls
@@ -1128,15 +1137,45 @@ class HollowCluster:
         self.quota_controller.reconcile()
 
     def reconcile_namespaces(self) -> None:
+        """The namespace controller's deletion pass: drain EVERY
+        namespaced resource (pods, services+endpoints, events, leases,
+        PVCs — pkg/controller/namespace deletes all namespaced content
+        via discovery), then remove the namespace once empty."""
         for name, ns in list(self.namespaces.items()):
             if ns.phase != NS_TERMINATING:
                 continue
+            prefix = f"{name}/"
             remaining = [k for k, p in self.truth_pods.items()
                          if p.namespace == name]
             for key in remaining:
                 self.delete_pod(key)
+            for key in [k for k in self.services if k.startswith(prefix)]:
+                self.delete_service(key)
+            for key in [k for k in self.endpoints if k.startswith(prefix)]:
+                self.delete_endpoints(key)
+            for key in [k for k in self.events_v1 if k.startswith(prefix)]:
+                del self.events_v1[key]
+                self._commit(f"events/{key}", "DELETED", None)
+            for key in [k for k in self.leases if k.startswith(prefix)]:
+                del self.leases[key]
+                self._commit(f"leases/{key}", "DELETED", None)
+            dropped_pvc = False
+            for key in [k for k in self.pvcs if k.startswith(prefix)]:
+                pvc = self.pvcs.pop(key)
+                if pvc.volume_name and pvc.volume_name in self.pvs:
+                    # released PV keeps its claimRef cleared (Released->
+                    # Available is the hollow reclaim policy)
+                    self.pvs[pvc.volume_name].claim_ref = ""
+                    self._commit(f"persistentvolumes/{pvc.volume_name}",
+                                 "MODIFIED", self.pvs[pvc.volume_name])
+                self._commit(f"persistentvolumeclaims/{key}",
+                             "DELETED", None)
+                dropped_pvc = True
+            if dropped_pvc:
+                self._sync_volume_state()
             if not remaining:
                 del self.namespaces[name]
+                self._commit(f"namespaces/{name}", "DELETED", None)
 
     # -- services / endpoints (kube-proxy seam) ------------------------------
 
@@ -1717,6 +1756,13 @@ class HollowCluster:
         if self.admission is not None:
             self.reconcile_namespaces()
             self.quota_controller.reconcile()
+        elif any(ns.phase == NS_TERMINATING
+                 for ns in self.namespaces.values()):
+            # without the admission chain nothing STOPS creates into a
+            # terminating namespace, but a deletion must still drain —
+            # a REST DELETE namespace on an admission-less hub would
+            # otherwise terminate forever
+            self.reconcile_namespaces()
         self.reconcile_controllers()
         self.gc_owner_graph()
         if self.pvcs or self.pvs:

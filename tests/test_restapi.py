@@ -694,6 +694,8 @@ def test_discovery_and_openapi_surface():
         hub.cas_lease("default", "d0",
                       LeaderElectionRecord(holder_identity="x",
                                            renew_time=1.0), 0)
+        req(port, "POST", "/api/v1/namespaces",
+            {"metadata": {"name": "d0"}})  # namespace-route fixture
 
         code, doc = req(port, "GET", "/api")
         assert code == 200 and doc["kind"] == "APIVersions"
@@ -754,6 +756,9 @@ def test_discovery_and_openapi_surface():
                     body, want = {"kind": "Eviction"}, (201, 429)
                 elif "/nodes" in path:
                     body, want = NODE, (201, 409)  # n0 exists
+                elif path.endswith("/namespaces"):
+                    body = {"metadata": {"name": "d0"}}
+                    want = (201, 409)  # fixture namespace exists
                 else:
                     body = make_pod_doc("new1")
             if method == "put":
@@ -767,5 +772,103 @@ def test_discovery_and_openapi_surface():
                 else:
                     req(port, "POST", "/api/v1/namespaces/default/pods",
                         make_pod_doc("d0"))
+    finally:
+        srv.close()
+
+
+def test_namespace_crud_and_termination_drain():
+    """Namespace lifecycle over REST (registry/core/namespace +
+    pkg/controller/namespace): create -> Active; delete -> Terminating
+    (object still readable) -> the controller drains its pods and
+    removes it; system namespaces are protected."""
+    hub = HollowCluster(seed=79, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        req(port, "POST", "/api/v1/nodes", NODE)
+        code, doc = req(port, "POST", "/api/v1/namespaces",
+                        {"metadata": {"name": "team-a"}})
+        assert code == 201 and doc["status"]["phase"] == "Active"
+        code, _ = req(port, "POST", "/api/v1/namespaces",
+                      {"metadata": {"name": "team-a"}})
+        assert code == 409
+        code, doc = req(port, "GET", "/api/v1/namespaces")
+        assert code == 200 and doc["kind"] == "NamespaceList"
+        names = {i["metadata"]["name"] for i in doc["items"]}
+        assert {"default", "kube-system", "team-a"} <= names
+
+        # a pod in the namespace, bound by the scheduler
+        pod = make_pod_doc("w0")
+        code, _ = req(port, "POST", "/api/v1/namespaces/team-a/pods", pod)
+        assert code == 201
+        hub.step()
+        assert hub.truth_pods["team-a/w0"].node_name
+
+        code, doc = req(port, "DELETE", "/api/v1/namespaces/team-a")
+        assert code == 200 and doc["status"]["phase"] == "Terminating"
+        code, doc = req(port, "GET", "/api/v1/namespaces/team-a")
+        assert code == 200 and doc["status"]["phase"] == "Terminating"
+        for _ in range(3):
+            hub.step()  # controller drains + removes (admission-less hub)
+        code, _ = req(port, "GET", "/api/v1/namespaces/team-a")
+        assert code == 404
+        assert "team-a/w0" not in hub.truth_pods
+        hub.check_consistency()
+
+        for protected in ("default", "kube-system"):
+            code, doc = req(port, "DELETE", f"/api/v1/namespaces/{protected}")
+            assert code == 403, protected
+    finally:
+        srv.close()
+
+
+def test_namespace_validation_protection_and_full_drain():
+    """Review regressions: non-DNS-label names are 400 (a slash would
+    mint an unaddressable object), protection lives in the HUB guard,
+    and termination drains EVERY namespaced resource — not just pods."""
+    import pytest
+
+    from kubernetes_tpu.api.types import PersistentVolume, PersistentVolumeClaim, StorageClass
+    from kubernetes_tpu.leaderelection import LeaderElectionRecord
+    from kubernetes_tpu.proxy import Service, ServicePort
+
+    hub = HollowCluster(seed=80, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        for bad in ("a/b", "UPPER", "", "-lead", "x" * 64):
+            code, _ = req(port, "POST", "/api/v1/namespaces",
+                          {"metadata": {"name": bad}})
+            assert code == 400, bad
+        # hub-level protection guard (not a REST special case)
+        with pytest.raises(ValueError):
+            hub.terminate_namespace("kube-system")
+
+        req(port, "POST", "/api/v1/nodes", NODE)
+        req(port, "POST", "/api/v1/namespaces",
+            {"metadata": {"name": "team-b"}})
+        hub.add_service(Service("svc", namespace="team-b",
+                                selector={"app": "x"},
+                                ports=(ServicePort(port=80),)))
+        hub.add_storage_class(StorageClass("std"))
+        hub.add_pv(PersistentVolume("pvb", kind="gce-pd", handle="h",
+                                    storage_class="std"))
+        hub.add_pvc(PersistentVolumeClaim("claim", namespace="team-b",
+                                          storage_class="std"))
+        hub.cas_lease("team-b", "lock",
+                      LeaderElectionRecord(holder_identity="z",
+                                           renew_time=1.0), 0)
+        hub.step()  # PV controller binds the claim
+        assert hub.pvcs["team-b/claim"].volume_name == "pvb"
+
+        req(port, "DELETE", "/api/v1/namespaces/team-b")
+        for _ in range(3):
+            hub.step()
+        assert "team-b" not in hub.namespaces
+        assert not any(k.startswith("team-b/") for k in hub.services)
+        assert not any(k.startswith("team-b/") for k in hub.endpoints)
+        assert not any(k.startswith("team-b/") for k in hub.leases)
+        assert not any(k.startswith("team-b/") for k in hub.pvcs)
+        # the released PV is claimable again
+        assert hub.pvs["pvb"].claim_ref == ""
+        hub.check_consistency()
     finally:
         srv.close()
