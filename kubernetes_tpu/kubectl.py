@@ -387,6 +387,17 @@ def cmd_drain(rest: RestClient, args) -> int:
     return 0
 
 
+def cmd_get_namespaces(rest: RestClient, args) -> int:
+    """kubectl get namespaces: lifecycle phases over REST."""
+    code, doc = rest.call("GET", "/api/v1/namespaces")
+    if code != 200:
+        return _rest_fail(doc)
+    rows = [[it["metadata"]["name"], it["status"].get("phase", "")]
+            for it in doc["items"]]
+    print(_fmt_table(["NAME", "STATUS"], rows))
+    return 0
+
+
 def cmd_delete(rest: RestClient, args) -> int:
     if args.kind in ("node", "nodes"):
         code, out = rest.call("DELETE", f"/api/v1/nodes/{args.name}")
@@ -472,7 +483,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         cv.add_argument("name")
     args = p.parse_args(argv)
 
-    if args.cmd == "get" and args.kind in ("events", "leases"):
+    if args.cmd == "get" and args.kind in ("events", "leases",
+                                           "namespaces", "ns"):
         if not args.api_server:
             p.error(f"get {args.kind} requires --api-server")
         try:
@@ -482,6 +494,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         try:
             if args.kind == "leases":
                 return cmd_get_leases(rest, args)
+            if args.kind in ("namespaces", "ns"):
+                return cmd_get_namespaces(rest, args)
             return cmd_get_events(rest, args)
         except OSError as e:
             print(f"Error: cannot reach API server {args.api_server}: {e}",

@@ -124,3 +124,17 @@ def test_ktpu_drain_blocked_by_pdb_exits_nonzero(capsys):
         assert "default/w0" in hub.truth_pods or "default/w1" in hub.truth_pods
     finally:
         srv.close()
+
+
+def test_ktpu_get_namespaces(capsys):
+    hub = HollowCluster(seed=74, scheduler_kw={"enable_preemption": False})
+    hub.add_namespace("team-x")
+    srv = RestServer(hub)
+    port = srv.serve()
+    try:
+        rc = ktpu(["--api-server", f"127.0.0.1:{port}", "get", "namespaces"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "team-x" in out and "default" in out and "Active" in out
+    finally:
+        srv.close()

@@ -4,14 +4,19 @@ threaded surfaces: REST handler threads + the gRPC SyncState stream +
 the driver's hub.step(), all hammering one hub concurrently under
 seed-derived schedules.
 
-Each seed runs four concurrent actors with seeded jitter:
+Each seed runs six concurrent actors with seeded jitter:
   - driver: hub.step() churn (controllers, scheduler, kubelets),
   - REST writer: pod/node create+delete (every response must be
     HTTP-valid and Status-shaped on error),
   - REST reader: list + watch polls,
   - gRPC service: SnapshotDelta pump -> remote scheduler cycle -> CAS
     binds back into the hub (the deployment loop of
-    test_integration_grpc_hub, now racing the hub's own scheduler).
+    test_integration_grpc_hub, now racing the hub's own scheduler),
+  - evictor: PDB-guarded Eviction posts against whatever is bound
+    (only 201/404/429 are legal answers),
+  - elector pair: two LeaderElectors CASing the same hub Lease
+    (holder always one of them, rv monotonic; dual self-belief is
+    legal lease semantics when the sim clock jumps — see the actor).
 
 After the threads join, the settled state must satisfy the hub
 consistency oracle AND the remote service's cache must equal hub truth
@@ -147,8 +152,55 @@ def _run_seed(seed: int) -> None:
             bridge.pump()
             stop.wait(rng.random() * 0.004)
 
+    def evictor():
+        # the drain actor: evictions race binds/deletes/controllers;
+        # every answer must be one of the legal eviction outcomes
+        rng = random.Random(seed * 31 + 5)
+        while not stop.is_set():
+            code, doc = _http(port, "GET", "/api/v1/pods")
+            assert code == 200
+            bound = [p["metadata"] for p in doc["items"]
+                     if p["spec"].get("nodeName")]
+            if bound:
+                m = rng.choice(bound)
+                code, doc = _http(
+                    port, "POST",
+                    f"/api/v1/namespaces/{m['namespace']}/pods/"
+                    f"{m['name']}/eviction", {"kind": "Eviction"})
+                assert code in (201, 404, 429), (code, doc)
+            stop.wait(rng.random() * 0.006)
+
+    def elector_pair():
+        # two electors CAS the same hub Lease while the driver jumps the
+        # sim clock concurrently. Both believing they lead in one loop
+        # iteration is LEGAL lease semantics (the clock can jump past
+        # lease_duration between the two ticks — an expired leader only
+        # learns on its next tick, exactly like the reference); the
+        # invariant that must hold is hub-side: one record, a holder
+        # that is always one of the candidates, a monotonic rv.
+        from kubernetes_tpu.config import LeaderElectionConfig
+        from kubernetes_tpu.leaderelection import LeaderElector, LeaseLock
+
+        cfg = LeaderElectionConfig(lease_duration_s=3,
+                                   renew_deadline_s=2, retry_period_s=1)
+        a = LeaderElector("fz-a", LeaseLock(hub), cfg, hub.clock)
+        b = LeaderElector("fz-b", LeaseLock(hub), cfg, hub.clock)
+        rng = random.Random(seed * 31 + 6)
+        last_rv = 0
+        while not stop.is_set():
+            a.tick()
+            b.tick()
+            record, rv = hub.get_lease("kube-system", "kube-scheduler")
+            if record is not None:
+                assert record.holder_identity in ("fz-a", "fz-b"), record
+                assert rv >= last_rv, "lease rv went backwards"
+                last_rv = rv
+            stop.wait(rng.random() * 0.004)
+
+    actors = (driver, rest_writer, rest_reader, grpc_service, evictor,
+              elector_pair)
     threads = [threading.Thread(target=guarded(f), name=f.__name__)
-               for f in (driver, rest_writer, rest_reader, grpc_service)]
+               for f in actors]
     try:
         for t in threads:
             t.start()
