@@ -176,6 +176,18 @@ def set_defaults_kube_scheduler_configuration(
 # -- conversions (v1alpha1/zz_generated.conversion.go shape) ----------------
 
 
+def _dur(field_name: str, value) -> float:
+    """parse_duration with the FIELD PATH stamped into the error — the
+    module's error contract; a bare 'duration: invalid' gives the user
+    no way to locate which of three duration fields failed."""
+    try:
+        return parse_duration(value)
+    except SchemeError:
+        raise SchemeError([
+            f"leaderElection.{field_name}: invalid duration {value!r}"
+        ])
+
+
 def _to_internal(v: KubeSchedulerConfigurationV1alpha1) -> KubeSchedulerConfiguration:
     """Conversion proper. The default table lives in exactly one place
     (set_defaults_*): defaulting is idempotent, so it is re-applied here
@@ -244,9 +256,9 @@ def _to_internal(v: KubeSchedulerConfigurationV1alpha1) -> KubeSchedulerConfigur
         bind_timeout_seconds=bind_timeout,
         leader_election=LeaderElectionConfig(
             leader_elect=le.leaderElect,
-            lease_duration_s=parse_duration(le.leaseDuration),
-            renew_deadline_s=parse_duration(le.renewDeadline),
-            retry_period_s=parse_duration(le.retryPeriod),
+            lease_duration_s=_dur("leaseDuration", le.leaseDuration),
+            renew_deadline_s=_dur("renewDeadline", le.renewDeadline),
+            retry_period_s=_dur("retryPeriod", le.retryPeriod),
             lock_object_namespace=le.lockObjectNamespace,
             lock_object_name=le.lockObjectName,
         ),

@@ -61,6 +61,10 @@ def pod_to_json(pod: Pod) -> dict:
                             **{k: str(v) for k, v in pod.requests.scalars.items()},
                         }
                     },
+                    **({"readinessProbe": {
+                        "initialDelaySeconds":
+                            pod.readiness_probe.initial_delay_s}}
+                       if pod.readiness_probe is not None else {}),
                 }
             ],
         },

@@ -341,8 +341,14 @@ class SnapshotDeltaBridge:
         self._node_json = node_to_json
         self._pod_json = pod_to_json
         self._lock = lock if lock is not None else contextlib.nullcontext()
+        # LIST and cursor registration must be ONE atomic step: the hub
+        # only appends history while a cursor is open (sim._commit), so
+        # an event committed between list_state(rev) and watch(rev) —
+        # with no other cursor alive — would vanish without ever raising
+        # Compacted. The wire send happens after, outside the lock.
         with self._lock:
             rev, nodes, pods = hub.list_state()
+            self.cursor = hub.watch(rev)
         d = pb.SnapshotDelta(revision=rev)
         for nd in nodes.values():
             d.nodes.add(op=pb.NodeDelta.ADD, name=nd.name,
@@ -351,7 +357,6 @@ class SnapshotDeltaBridge:
             d.pods.add(op=pb.PodDelta.ADD, key=p.key(),
                        pod_json=json.dumps(pod_to_json(p)))
         list(client.sync_state(iter([d])))
-        self.cursor = hub.watch(rev)
 
     NODE_OPS = {"ADDED": pb.NodeDelta.ADD,
                 "MODIFIED": pb.NodeDelta.UPDATE,

@@ -336,6 +336,11 @@ def cmd_get_leases(rest: RestClient, args) -> int:
     ]
     print(_fmt_table(["NAMESPACE", "NAME", "HOLDER", "TRANSITIONS",
                       "RENEWTIME"], rows))
+    if not rows and not args.all_namespaces:
+        # the well-known scheduler lease lives in kube-system; an empty
+        # default-namespace table almost always means the wrong scope
+        print(f'No leases found in namespace "{args.namespace}" '
+              "(try -n kube-system or -A)", file=sys.stderr)
     return 0
 
 

@@ -35,9 +35,13 @@ def parse_quantity(s, is_cpu: bool = False) -> float:
 
 def pod_from_json(d: dict) -> Pod:
     """Inverse of extender.pod_to_json for the fields the kernels read."""
+    from kubernetes_tpu.api.types import POD_PENDING, ReadinessProbe
+
     meta = d.get("metadata", {})
     spec = d.get("spec", {})
+    status = d.get("status") or {}
     requests = Resources()
+    probe = None
     for c in spec.get("containers", []):
         req = (c.get("resources") or {}).get("requests") or {}
         for name, q in req.items():
@@ -49,7 +53,18 @@ def pod_from_json(d: dict) -> Pod:
                 requests.ephemeral_storage += parse_quantity(q)
             else:
                 requests.scalars[name] = requests.scalars.get(name, 0) + parse_quantity(q)
+        rp = c.get("readinessProbe")
+        if probe is None and rp is not None:
+            probe = ReadinessProbe(
+                initial_delay_s=float(rp.get("initialDelaySeconds", 0)))
+    ready = any(
+        c.get("type") == "Ready" and c.get("status") == "True"
+        for c in (status.get("conditions") or [])
+    )
     return Pod(
+        phase=status.get("phase", POD_PENDING),
+        ready=ready,
+        readiness_probe=probe,
         name=meta.get("name", ""),
         namespace=meta.get("namespace", "default"),
         uid=meta.get("uid", ""),

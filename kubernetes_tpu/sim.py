@@ -892,6 +892,15 @@ class HollowCluster:
                 f"checkpoint/hub config mismatch (saved, this): {diff} — "
                 "construct the hub with the same semantics before restoring"
             )
+        if self._revision != 0:
+            # a non-fresh hub has objects the scheduler already cached;
+            # wholesale truth replacement would leave them dangling there
+            # (pods assignable to nodes the checkpoint never had) — the
+            # same silent-divergence class the config guard refuses
+            raise ValueError(
+                "restore_checkpoint requires a freshly constructed hub "
+                f"(this one is at revision {self._revision})"
+            )
         with self.lock:
             self._revision = state["revision"]
             self._compacted_rev = self._revision

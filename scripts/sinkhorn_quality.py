@@ -1,28 +1,22 @@
 #!/usr/bin/env python
-"""Sinkhorn-vs-argmax placement QUALITY experiment (VERDICT r3 item 2:
+"""Sinkhorn-vs-argmax placement QUALITY evidence (VERDICT r3 item 2:
 "demonstrate a workload where the OT plan beats argmax rounds on
 placement quality ... or demote it").
 
 Round 3 established that on margin-ORDERED workloads (one population
 strictly outscores the other on the contended nodes) the round solver's
 score-ordered per-node admission already reaches the OT outcome. The
-residual gap is TOP-SCORE TIES with asymmetric second choices — the
-classic assignment-problem instance per-pod argmax cannot see:
+residual gap is TOP-SCORE TIES with asymmetric second choices — steep
+pods (hot=10, cold=0) tie with flat pods (hot=10, cold=9) on scarce hot
+nodes, flat population listed first so ordering tie-breaks oppose the
+steep pods. Per-pod argmax has no opportunity-cost term; the transport
+plan prices hot-column contention and routes flat mass to the plentiful
+near-equal cold columns.
 
-  - 8 "hot" nodes (zone=hot), 56 "cold" (zone=cold), 4 pod slots each;
-  - 32 STEEP pods: preferred node affinity hot=10, cold=0;
-  - 224 FLAT pods: preferred hot=10, cold=9 (their fallback is nearly
-    free — but they tie with steep pods on the hot nodes).
-
-Every pod's argmax bid is a hot node and the per-node admission sees
-IDENTICAL scores, so the tie-break (rotation) hands most of the 32 hot
-slots to flat pods (224 of the 256 bidders); steep pods spill to
-cold at 0. The transport plan instead prices hot capacity: flat rows
-keep most mass on the 56 cold columns (more room, near-equal score), so
-steep pods keep the hot slots — opportunity cost argmax has no term for.
-
-Prints per-solver steep/flat hot placement + affinity-score aggregate
-and a verdict line. Run with JAX_PLATFORMS=cpu for the wedge-safe path.
+The construction and the comparison are IMPORTED from
+tests/test_sinkhorn.py (the pinned single source — this script only
+scales it up), so the published evidence can never drift from the
+regression test. Run with JAX_PLATFORMS=cpu for the wedge-safe path.
 """
 from __future__ import annotations
 
@@ -30,124 +24,29 @@ import json
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
 
 if os.environ.get("JAX_PLATFORMS", "") == "cpu":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
 
-ZONE = "failure-domain.beta.kubernetes.io/zone"
-
-
-def build(n_hot=8, n_cold=56, n_steep=32, n_flat=224):
-    from kubernetes_tpu.api.types import (
-        Affinity,
-        Node,
-        NodeSelectorTerm,
-        Pod,
-        PreferredSchedulingTerm,
-        Requirement,
-        Resources,
-    )
-
-    def node(name, zone):
-        return Node(
-            name=name,
-            allocatable=Resources(cpu_milli=4000, memory=32 * 2**30,
-                                  pods=110),
-            labels={"kubernetes.io/hostname": name, ZONE: zone},
-        )
-
-    nodes = [node(f"hot{i}", "hot") for i in range(n_hot)] + [
-        node(f"cold{i}", "cold") for i in range(n_cold)
-    ]
-
-    def prefer(*weight_zone):
-        return Affinity(node_preferred=tuple(
-            PreferredSchedulingTerm(
-                weight=w,
-                preference=NodeSelectorTerm(
-                    (Requirement(ZONE, "In", (z,)),)),
-            )
-            for w, z in weight_zone
-        ))
-
-    pods = []
-    # FLAT pods first: index-order/rotation tie-breaks must not be what
-    # saves the steep pods (they would favor the early population)
-    for i in range(n_flat):
-        pods.append(Pod(name=f"flat{i}",
-                        requests=Resources(cpu_milli=900, memory=2**30),
-                        affinity=prefer((10, "hot"), (9, "cold"))))
-    for i in range(n_steep):
-        pods.append(Pod(name=f"steep{i}",
-                        requests=Resources(cpu_milli=900, memory=2**30),
-                        affinity=prefer((10, "hot"))))
-    return nodes, pods
-
-
-def solve(nodes, pods, use_sinkhorn):
-    import numpy as np
-
-    from kubernetes_tpu.ops.arrays import (
-        nodes_to_device,
-        pods_to_device,
-        selectors_to_device,
-    )
-    from kubernetes_tpu.ops.assign import batch_assign
-    from kubernetes_tpu.snapshot import SnapshotPacker
-
-    pk = SnapshotPacker()
-    for p in pods:
-        pk.intern_pod(p)
-    dn = nodes_to_device(pk.pack_nodes(nodes, []))
-    dp = pods_to_device(pk.pack_pods(pods))
-    ds = selectors_to_device(pk.pack_selector_tables())
-    assigned, usage, rounds = batch_assign(
-        dp, dn, ds, per_node_cap=2, use_sinkhorn=use_sinkhorn)
-    return np.asarray(assigned)[:len(pods)], int(rounds)
-
-
-def score(nodes, pods, assigned, n_hot):
-    hot = set(range(n_hot))
-    steep_on_hot = sum(1 for i, p in enumerate(pods)
-                       if p.name.startswith("steep") and assigned[i] in hot)
-    n_steep = sum(1 for p in pods if p.name.startswith("steep"))
-    flat_on_hot = sum(1 for i, p in enumerate(pods)
-                      if p.name.startswith("flat") and assigned[i] in hot)
-    # aggregate preferred-affinity satisfaction: the workload's quality
-    # axis (each steep-on-hot is worth +10; flat hot->cold costs only 1)
-    total = 0
-    for i, p in enumerate(pods):
-        if assigned[i] < 0:
-            continue
-        on_hot = assigned[i] in hot
-        if p.name.startswith("steep"):
-            total += 10 if on_hot else 0
-        else:
-            total += 10 if on_hot else 9
-    return {"steep_on_hot": steep_on_hot, "steep_total": n_steep,
-            "flat_on_hot": flat_on_hot,
-            "placed": int((assigned >= 0).sum()),
-            "affinity_points": total}
-
 
 def main():
-    nodes, pods = build()
-    out = {}
-    for name, flag in (("argmax", False), ("sinkhorn", True)):
-        assigned, rounds = solve(nodes, pods, flag)
-        rec = score(nodes, pods, assigned, n_hot=8)
-        rec["rounds"] = rounds
-        out[name] = rec
-    a, s = out["argmax"], out["sinkhorn"]
-    if s["affinity_points"] > a["affinity_points"]:
-        out["verdict"] = "sinkhorn_wins"
-    elif s == a:
-        out["verdict"] = "identical"
-    else:
-        out["verdict"] = "argmax_wins_or_mixed"
+    from test_sinkhorn import run_tied_preferences_comparison
+
+    sizes = dict(n_hot=8, n_cold=56, n_steep=32, n_flat=224)
+    results = run_tied_preferences_comparison(**sizes)
+    out = {
+        "workload": sizes,
+        "argmax_points": results[False],
+        "sinkhorn_points": results[True],
+        "verdict": ("sinkhorn_wins" if results[True] > results[False]
+                    else ("identical" if results[True] == results[False]
+                          else "argmax_wins")),
+    }
     print(json.dumps(out, indent=2))
 
 

@@ -126,7 +126,8 @@ def main() -> None:
     if not args.quick:
         out["shapes"]["even_spread/2000x4096"] = profile_shape(
             "even_spread", 2000, 4096, 500, full=False)
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    if os.path.dirname(args.out):
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out["shapes"], indent=2))

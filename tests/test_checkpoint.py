@@ -192,3 +192,36 @@ def test_restore_rejects_garbage(tmp_path):
     hub = HollowCluster(seed=1)
     with pytest.raises(ValueError):
         hub.restore_checkpoint(str(bad))
+
+
+def test_restore_requires_fresh_hub(tmp_path):
+    """Review regression: restoring into a hub that already has state
+    would leave pre-restore objects dangling in the scheduler cache —
+    refuse loudly, like the config-mismatch guard."""
+    hub = _build_live_cluster(seed=46)
+    path = str(tmp_path / "snap.ckpt")
+    hub.save_checkpoint(path)
+    dirty = HollowCluster(seed=4, scheduler_kw={"enable_preemption": False})
+    dirty.add_node(make_node("pre-existing", cpu_milli=1000))
+    with pytest.raises(ValueError) as ei:
+        dirty.restore_checkpoint(path)
+    assert "freshly constructed" in str(ei.value)
+
+
+def test_core_v1_round_trip_preserves_lifecycle_fields():
+    """Review regression: phase/Ready/readinessProbe must survive
+    encode->decode (they were emit-only; the bridge and codec silently
+    reset lifecycle state)."""
+    from kubernetes_tpu.api.types import POD_RUNNING
+
+    pod = make_pod("lp", cpu_milli=100,
+                   readiness_probe=ReadinessProbe(initial_delay_s=7.5))
+    pod.phase = POD_RUNNING
+    pod.ready = True
+    back = decode_any(encode(pod))
+    assert back.phase == POD_RUNNING and back.ready is True
+    assert back.readiness_probe is not None
+    assert back.readiness_probe.initial_delay_s == 7.5
+    # probe-less pods stay probe-less (no phantom Ready condition)
+    plain = decode_any(encode(make_pod("np", cpu_milli=10)))
+    assert plain.readiness_probe is None and plain.ready is False

@@ -179,7 +179,8 @@ def test_block_shapes_fixed_point():
         assert _block_shapes(P, N, bp, bn) == (bp, bn, P, N)
 
 
-def tied_preferences_workload():
+def tied_preferences_workload(n_hot=4, n_cold=20, n_steep=16,
+                              n_flat=80):
     """The ONE construction both the CPU and TPU quality tests pin
     (round-4 "prove it wins or demote it" verdict): steep pods (hot=10,
     cold=0) tie with flat pods (hot=10, cold=9) on scarce hot nodes,
@@ -197,7 +198,6 @@ def tied_preferences_workload():
     )
 
     ZONE = "failure-domain.beta.kubernetes.io/zone"
-    n_hot, n_cold, n_steep, n_flat = 4, 20, 16, 80
 
     def node(name, zone):
         return Node(name=name,
@@ -236,7 +236,7 @@ def tied_preferences_workload():
     return nodes, pods, points
 
 
-def run_tied_preferences_comparison():
+def run_tied_preferences_comparison(**sizes):
     """Solve the tied-preferences workload with argmax and with the OT
     plan; returns {False: points, True: points} after asserting both
     placements are full. Shared by the CPU test here and the compiled
@@ -249,7 +249,7 @@ def run_tied_preferences_comparison():
     from kubernetes_tpu.ops.assign import batch_assign
     from kubernetes_tpu.snapshot import SnapshotPacker
 
-    nodes, pods, points = tied_preferences_workload()
+    nodes, pods, points = tied_preferences_workload(**sizes)
     pk = SnapshotPacker()
     for p in pods:
         pk.intern_pod(p)
