@@ -153,9 +153,23 @@ class Deployment:
     cpu_milli: float = 100
     memory: float = 256 * 2**20
     priority: int = 0
+    #: "RollingUpdate" (default) or "Recreate" (deployment strategy,
+    #: apps/v1 DeploymentStrategy: Recreate kills ALL old pods before
+    #: any new one exists — downtime traded for never-mixed versions)
+    strategy: str = "RollingUpdate"
     max_surge: object = 1
     max_unavailable: object = 1
     template_rev: int = 0
+
+    def __post_init__(self):
+        # apps/v1 validation rejects unknown strategy values; a typo'd
+        # "recreate" silently rolling (and MIXING versions) would be the
+        # exact failure Recreate exists to prevent
+        if self.strategy not in ("RollingUpdate", "Recreate"):
+            raise ValueError(
+                f"Deployment.strategy must be 'RollingUpdate' or "
+                f"'Recreate', got {self.strategy!r}"
+            )
 
     def rs_name(self) -> str:
         """Name of the CURRENT revision's ReplicaSet."""
@@ -1344,6 +1358,17 @@ class HollowCluster:
                 self.replicasets[new_rs.name] = new_rs
             if not olds:
                 new_rs.replicas = d.replicas
+                continue
+            if d.strategy == "Recreate":
+                # recreate.go: scale every old RS to 0 first; the new RS
+                # only grows once NO old pod remains (never-mixed
+                # versions, at the cost of downtime)
+                for rs in olds:
+                    rs.replicas = 0
+                new_rs.replicas = (
+                    d.replicas
+                    if not any(rs.live for rs in olds) else 0
+                )
                 continue
             # ---- RollingUpdate reconciliation (rolling.go:31) ----
             # a mid-rollout SCALE-DOWN must bite immediately: the new RS

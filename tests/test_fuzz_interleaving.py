@@ -16,7 +16,10 @@ Each seed runs six concurrent actors with seeded jitter:
     (only 201/404/429 are legal answers),
   - elector pair: two LeaderElectors CASing the same hub Lease
     (holder always one of them, rv monotonic; dual self-belief is
-    legal lease semantics when the sim clock jumps — see the actor).
+    legal lease semantics when the sim clock jumps — see the actor),
+  - checkpointer: save_checkpoint under full churn — every snapshot
+    must be internally consistent (restorable into a fresh hub whose
+    oracle passes), proving the hub lock covers the whole state walk.
 
 After the threads join, the settled state must satisfy the hub
 consistency oracle AND the remote service's cache must equal hub truth
@@ -197,8 +200,31 @@ def _run_seed(seed: int) -> None:
                 last_rv = rv
             stop.wait(rng.random() * 0.004)
 
+    snapshots = []
+
+    def checkpointer():
+        # a checkpoint taken at ANY interleaving point must be a
+        # consistent cut (the save walks every registry under the hub
+        # lock); restorability is verified after the threads join
+        import tempfile
+
+        rng = random.Random(seed * 31 + 7)
+        n = 0
+        while not stop.is_set() and n < 3:
+            stop.wait(0.05 + rng.random() * 0.05)
+            # mkstemp: collision-free against concurrent suite runs on
+            # the same machine (fixed names would race another process's
+            # writes and unlinks)
+            fd, path = tempfile.mkstemp(prefix=f"fuzz_ckpt_{seed}_",
+                                        suffix=".ckpt")
+            os.close(fd)
+            manifest = hub.save_checkpoint(path)
+            assert manifest["revision"] >= 0
+            snapshots.append(path)
+            n += 1
+
     actors = (driver, rest_writer, rest_reader, grpc_service, evictor,
-              elector_pair)
+              elector_pair, checkpointer)
     threads = [threading.Thread(target=guarded(f), name=f.__name__)
                for f in actors]
     try:
@@ -217,6 +243,14 @@ def _run_seed(seed: int) -> None:
             truth = {k: p.node_name for k, p in hub.truth_pods.items()}
             nd, pd = compare(remote, truth, list(hub.truth_nodes))
         assert not nd and not pd, (seed, nd, pd)
+        # every mid-churn checkpoint is a consistent cut: it restores
+        # into a fresh hub whose own oracle passes
+        for path in snapshots:
+            cold = HollowCluster(seed=seed + 10_000,
+                                 scheduler_kw={"enable_preemption": False})
+            cold.restore_checkpoint(path)
+            cold.check_consistency()
+            os.unlink(path)
     finally:
         stop.set()
         rest.close()

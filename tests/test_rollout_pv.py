@@ -90,6 +90,34 @@ def test_rolling_update_completes_under_churn():
                 if rs.owner == "web"]) == 1
 
 
+def test_recreate_strategy_never_mixes_versions():
+    """Recreate (apps/v1 DeploymentStrategy, recreate.go): every old pod
+    is gone before ANY new-template pod exists — at no observation point
+    do the two revisions coexist; afterwards the full new set runs."""
+    hub = HollowCluster(seed=26, scheduler_kw={"enable_preemption": False})
+    for i in range(6):
+        hub.add_node(make_node(f"n{i}", cpu_milli=8000))
+    d = Deployment("web", replicas=4, strategy="Recreate")
+    hub.add_deployment(d)
+    for _ in range(3):
+        hub.step()
+    assert _bound(hub) == 4
+    d.rollout(cpu_milli=300)
+    mixed_seen = False
+    for _ in range(10):
+        hub.step()
+        cpus = {p.requests.cpu_milli for p in _web_pods(hub).values()}
+        if len(cpus) > 1:
+            mixed_seen = True
+    assert not mixed_seen, "Recreate must never mix template versions"
+    hub.check_consistency()
+    pods = _web_pods(hub)
+    assert len(pods) == 4 and all(p.node_name for p in pods.values())
+    assert all(p.requests.cpu_milli == 300 for p in pods.values())
+    assert len([rs for rs in hub.replicasets.values()
+                if rs.owner == "web"]) == 1
+
+
 def test_mid_rollout_scale_down_bites_immediately():
     """Review regression: shrinking a deployment WHILE a rollout is in
     flight must clamp the new RS at once — not after the old RS drains —
@@ -175,3 +203,11 @@ def test_delayed_binding_commits_through_hub_store():
     assert hub.pvs["pv-r2"].claim_ref == "default/lc"
     assert hub.resource_version["persistentvolumeclaims/default/lc"] > rv_before
     hub.check_consistency()
+
+
+def test_unknown_strategy_rejected():
+    import pytest
+
+    with pytest.raises(ValueError) as ei:
+        Deployment("web", replicas=1, strategy="recreate")  # typo'd case
+    assert "Recreate" in str(ei.value)
