@@ -392,6 +392,53 @@ def cmd_drain(rest: RestClient, args) -> int:
     return 0
 
 
+def cmd_get_deployments(rest: RestClient, args) -> int:
+    """kubectl get deployments: rollout state over the apps/v1 routes."""
+    code, doc = rest.call("GET", "/apis/apps/v1/deployments")
+    if code != 200:
+        return _rest_fail(doc)
+    rows = []
+    for it in doc["items"]:
+        st = it["status"]
+        rows.append([
+            it["metadata"]["name"],
+            f"{st.get('readyReplicas', 0)}/{it['spec'].get('replicas', 0)}",
+            str(st.get("updatedReplicas", 0)),
+            str(st.get("observedRevision", 0)),
+            it["spec"].get("strategy", ""),
+        ])
+    print(_fmt_table(["NAME", "READY", "UP-TO-DATE", "REVISION",
+                      "STRATEGY"], rows))
+    return 0
+
+
+def cmd_rollout_status(rest: RestClient, args) -> int:
+    """kubectl rollout status deployment/NAME, one-shot: prints the
+    current rollout state; exit 0 when complete (all replicas updated
+    and ready), 1 while in progress — scriptable polling instead of
+    kubectl's watch loop."""
+    kind, _, name = args.target.partition("/")
+    if kind not in ("deployment", "deploy", "deployments") or not name:
+        print(f"error: rollout status expects deployment/NAME, got "
+              f"{args.target!r}", file=sys.stderr)
+        return 2
+    code, doc = rest.call("GET", "/apis/apps/v1/namespaces/default/"
+                                 f"deployments/{name}")
+    if code != 200:
+        _rest_fail(doc)
+        return 2  # error, NOT "in progress": pollable scripts must stop
+    want = doc["spec"].get("replicas", 0)
+    st = doc["status"]
+    updated, ready = st.get("updatedReplicas", 0), st.get("readyReplicas", 0)
+    if updated >= want and ready >= want and st.get("replicas", 0) == want:
+        print(f'deployment "{name}" successfully rolled out '
+              f'({updated}/{want} updated)')
+        return 0
+    print(f'Waiting for deployment "{name}" rollout to finish: '
+          f'{updated} of {want} updated replicas are available...')
+    return 1
+
+
 def cmd_get_namespaces(rest: RestClient, args) -> int:
     """kubectl get namespaces: lifecycle phases over REST."""
     code, doc = rest.call("GET", "/api/v1/namespaces")
@@ -486,10 +533,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     for verb in ("cordon", "uncordon", "drain"):
         cv = sub.add_parser(verb)
         cv.add_argument("name")
+    ro = sub.add_parser("rollout")
+    ro.add_argument("verb", choices=["status"])
+    ro.add_argument("target")  # deployment/NAME
     args = p.parse_args(argv)
 
+    if args.cmd == "rollout":
+        if not args.api_server:
+            p.error("rollout requires --api-server")
+        try:
+            rest = RestClient(args.api_server, token=args.token)
+        except ValueError:
+            p.error(f"--api-server must be HOST:PORT, got {args.api_server!r}")
+        try:
+            return cmd_rollout_status(rest, args)
+        except OSError as e:
+            print(f"Error: cannot reach API server {args.api_server}: {e}",
+                  file=sys.stderr)
+            return 2
+
     if args.cmd == "get" and args.kind in ("events", "leases",
-                                           "namespaces", "ns"):
+                                           "namespaces", "ns",
+                                           "deployments", "deploy"):
         if not args.api_server:
             p.error(f"get {args.kind} requires --api-server")
         try:
@@ -501,6 +566,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 return cmd_get_leases(rest, args)
             if args.kind in ("namespaces", "ns"):
                 return cmd_get_namespaces(rest, args)
+            if args.kind in ("deployments", "deploy"):
+                return cmd_get_deployments(rest, args)
             return cmd_get_events(rest, args)
         except OSError as e:
             print(f"Error: cannot reach API server {args.api_server}: {e}",

@@ -122,12 +122,20 @@ RESOURCES = (
 )
 
 
-#: the one non-core group this facade serves: coordination.k8s.io/v1
-#: Leases (read-only — writes go through the hub CAS the leader election
-#: uses; exposing them read-only makes HA state API-observable the way
-#: `kubectl get leases -n kube-system` is in the reference)
+#: non-core groups this facade serves READ-ONLY (writes go through the
+#: hub seams that own them): coordination/v1 Leases make HA state
+#: API-observable; apps/v1 Deployments+ReplicaSets make rollout state
+#: observable (`kubectl get deploy` / `rollout status`). Controller
+#: objects live hub-side without namespaces; they present in "default",
+#: where their pods run.
 LEASE_GROUP = "coordination.k8s.io"
-GROUP_RESOURCES = (("leases", "Lease", True, ("get", "list")),)
+APPS_GROUP = "apps"
+GROUPS = {
+    LEASE_GROUP: (("leases", "Lease", True, ("get", "list")),),
+    APPS_GROUP: (("deployments", "Deployment", True, ("get", "list")),
+                 ("replicasets", "ReplicaSet", True, ("get", "list"))),
+}
+GROUP_RESOURCES = GROUPS[LEASE_GROUP]  # back-compat alias
 
 
 def lease_to_json(ns: str, name: str, record, rv: int) -> dict:
@@ -196,24 +204,28 @@ def openapi_doc() -> dict:
                               "401": {"description": "Unauthorized"}},
             }
             paths.setdefault(route, {})[method] = op
-    # the coordination group's read-only lease routes
-    for name, kind, namespaced, verbs in GROUP_RESOURCES:
-        base = f"/apis/{LEASE_GROUP}/v1"
-        collection = f"{base}/namespaces/{{namespace}}/{name}"
-        gvk = {"group": LEASE_GROUP, "version": "v1", "kind": kind}
-        ok = {"200": {"description": "OK"},
-              "401": {"description": "Unauthorized"}}
-        if "list" in verbs:
-            paths[f"{base}/{name}"] = {"get": {
-                "x-kubernetes-action": "list",
-                "x-kubernetes-group-version-kind": gvk, "responses": ok}}
-            paths[collection] = {"get": {
-                "x-kubernetes-action": "list",
-                "x-kubernetes-group-version-kind": gvk, "responses": ok}}
-        if "get" in verbs:
-            paths[collection + "/{name}"] = {"get": {
-                "x-kubernetes-action": "get",
-                "x-kubernetes-group-version-kind": gvk, "responses": ok}}
+    # the non-core groups' read-only routes
+    for group, resources in GROUPS.items():
+        for name, kind, namespaced, verbs in resources:
+            base = f"/apis/{group}/v1"
+            collection = f"{base}/namespaces/{{namespace}}/{name}"
+            gvk = {"group": group, "version": "v1", "kind": kind}
+            ok = {"200": {"description": "OK"},
+                  "401": {"description": "Unauthorized"}}
+            if "list" in verbs:
+                paths[f"{base}/{name}"] = {"get": {
+                    "x-kubernetes-action": "list",
+                    "x-kubernetes-group-version-kind": gvk,
+                    "responses": ok}}
+                paths[collection] = {"get": {
+                    "x-kubernetes-action": "list",
+                    "x-kubernetes-group-version-kind": gvk,
+                    "responses": ok}}
+            if "get" in verbs:
+                paths[collection + "/{name}"] = {"get": {
+                    "x-kubernetes-action": "get",
+                    "x-kubernetes-group-version-kind": gvk,
+                    "responses": ok}}
     return {
         "swagger": "2.0",
         "info": {"title": "kubernetes_tpu", "version": "v1"},
@@ -474,7 +486,8 @@ class RestServer:
         p = path.split("?", 1)[0]
         seg = RestServer._route(p)
         if seg is None:
-            seg = RestServer._route_group(p)
+            routed = RestServer._route_group(p)
+            seg = routed[1] if routed is not None else None
         verb = {"GET": "get", "POST": "create", "PUT": "update",
                 "DELETE": "delete"}.get(http_verb, http_verb.lower())
         if not seg:
@@ -524,12 +537,14 @@ class RestServer:
 
     @staticmethod
     def _route_group(path: str):
-        """Split '/apis/coordination.k8s.io/v1/...' into segments after
-        the group-version (the apiserver's group routing layer)."""
+        """Split '/apis/<group>/v1/...' into segments after the
+        group-version (the apiserver's group routing layer) for any
+        served group. Returns (group, segments) or None."""
         parts = [p for p in path.split("/") if p]
-        if parts[:3] != ["apis", LEASE_GROUP, "v1"]:
-            return None
-        return parts[3:]
+        if (len(parts) >= 3 and parts[0] == "apis" and parts[1] in GROUPS
+                and parts[2] == "v1"):
+            return parts[1], parts[3:]
+        return None
 
     @staticmethod
     def _read_body(h):
@@ -564,27 +579,30 @@ class RestServer:
             return h._respond(200, {
                 "kind": "APIGroupList",
                 "groups": [{
-                    "name": LEASE_GROUP,
-                    "versions": [{"groupVersion": f"{LEASE_GROUP}/v1",
+                    "name": g,
+                    "versions": [{"groupVersion": f"{g}/v1",
                                   "version": "v1"}],
-                    "preferredVersion": {
-                        "groupVersion": f"{LEASE_GROUP}/v1",
-                        "version": "v1"},
-                }],
+                    "preferredVersion": {"groupVersion": f"{g}/v1",
+                                         "version": "v1"},
+                } for g in sorted(GROUPS)],
             })
-        if path == f"/apis/{LEASE_GROUP}/v1":
-            return h._respond(200, {
-                "kind": "APIResourceList",
-                "groupVersion": f"{LEASE_GROUP}/v1",
-                "resources": [
-                    {"name": name, "kind": kind, "namespaced": namespaced,
-                     "verbs": list(verbs)}
-                    for name, kind, namespaced, verbs in GROUP_RESOURCES
-                ],
-            })
-        gseg = self._route_group(url.path)
-        if gseg is not None:
-            return self._get_lease(h, gseg)
+        for g, resources in GROUPS.items():
+            if path == f"/apis/{g}/v1":
+                return h._respond(200, {
+                    "kind": "APIResourceList",
+                    "groupVersion": f"{g}/v1",
+                    "resources": [
+                        {"name": name, "kind": kind,
+                         "namespaced": namespaced, "verbs": list(verbs)}
+                        for name, kind, namespaced, verbs in resources
+                    ],
+                })
+        routed = self._route_group(url.path)
+        if routed is not None:
+            group, gseg = routed
+            if group == LEASE_GROUP:
+                return self._get_lease(h, gseg)
+            return self._get_apps(h, gseg)
         if path == "/openapi/v2":
             return h._respond(200, openapi_doc())
         if path == "/version":
@@ -764,6 +782,89 @@ class RestServer:
                 return h._fail(404, "NotFound",
                                f'leases "{seg[1]}" not found')
             return h._respond(200, doc(key))
+        return h._fail(404, "NotFound", h.path)
+
+    def _get_apps(self, h, seg) -> None:
+        """Read-only apps/v1 routes: deployment + replicaset lists/gets
+        built from the hub's controller registries. Status carries the
+        rollout-relevant counts (deployment_controller syncStatus shape):
+        replicas (spec), updatedReplicas (current-revision pods),
+        readyReplicas (bound pods across revisions)."""
+        hub = self.hub
+
+        def bound(rs):
+            # ONE bound-pod predicate for both doc shapes (and the same
+            # rule the rolling reconcile's availability math uses)
+            return sum(1 for k in rs.live
+                       if k in hub.truth_pods
+                       and hub.truth_pods[k].node_name)
+
+        # controller objects are not individually versioned in the hub
+        # (hollow controllers mutate in place); item docs carry the
+        # GLOBAL revision so clients still get a usable change indicator
+        rv = {"resourceVersion": str(hub._revision)}
+
+        def rs_doc(rs):
+            return {
+                "metadata": {"name": rs.name, "namespace": "default",
+                             **rv,
+                             **({"ownerReferences": [
+                                 {"kind": "Deployment", "name": rs.owner}]}
+                                if rs.owner else {})},
+                "spec": {"replicas": rs.replicas},
+                "status": {"replicas": len(rs.live),
+                           "readyReplicas": bound(rs),
+                           "revision": rs.revision},
+            }
+
+        def deploy_doc(d):
+            owned = [rs for rs in hub.replicasets.values()
+                     if rs.owner == d.name]
+            new_rs = hub.replicasets.get(d.rs_name())
+            return {
+                "metadata": {"name": d.name, "namespace": "default", **rv},
+                "spec": {"replicas": d.replicas, "strategy": d.strategy},
+                "status": {
+                    "observedRevision": d.template_rev,
+                    "replicas": sum(len(rs.live) for rs in owned),
+                    "updatedReplicas": (bound(new_rs) if new_rs else 0),
+                    "readyReplicas": sum(bound(rs) for rs in owned),
+                },
+            }
+
+        ns = None
+        if seg[:1] == ["namespaces"] and len(seg) >= 3:
+            ns, seg = seg[1], seg[2:]
+        if ns not in (None, "default"):
+            # controller objects live in "default" (module doc); other
+            # namespaces legitimately have an EMPTY list of the KNOWN
+            # kinds — but an unknown resource is 404, not a mislabeled
+            # empty list
+            if seg == ["deployments"] or seg == ["replicasets"]:
+                return h._respond(200, {
+                    "kind": ("DeploymentList" if seg == ["deployments"]
+                             else "ReplicaSetList"),
+                    "apiVersion": "apps/v1",
+                    "metadata": {"resourceVersion": str(hub._revision)},
+                    "items": []})
+            return h._fail(404, "NotFound", h.path)
+        for kind, registry, doc in (
+                ("deployments", hub.deployments, deploy_doc),
+                ("replicasets", hub.replicasets, rs_doc)):
+            if seg == [kind]:
+                return h._respond(200, {
+                    "kind": ("DeploymentList" if kind == "deployments"
+                             else "ReplicaSetList"),
+                    "apiVersion": "apps/v1",
+                    "metadata": {"resourceVersion": str(hub._revision)},
+                    "items": [doc(o) for _, o in sorted(registry.items())],
+                })
+            if len(seg) == 2 and seg[0] == kind:
+                obj = registry.get(seg[1])
+                if obj is None:
+                    return h._fail(404, "NotFound",
+                                   f'{kind} "{seg[1]}" not found')
+                return h._respond(200, doc(obj))
         return h._fail(404, "NotFound", h.path)
 
     # -- watch --------------------------------------------------------------

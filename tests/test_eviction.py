@@ -138,3 +138,44 @@ def test_ktpu_get_namespaces(capsys):
         assert "team-x" in out and "default" in out and "Active" in out
     finally:
         srv.close()
+
+
+def test_apps_routes_and_ktpu_rollout_status(capsys):
+    """apps/v1 read-only routes + ktpu: `get deployments` shows rollout
+    counts; `rollout status` exits 1 mid-rollout and 0 when complete."""
+    hub = HollowCluster(seed=75, scheduler_kw={"enable_preemption": False})
+    for i in range(6):
+        hub.add_node(make_node(f"n{i}", cpu_milli=8000))
+    d = Deployment("web", replicas=3)
+    hub.add_deployment(d)
+    for _ in range(3):
+        hub.step()
+    srv = RestServer(hub)
+    port = srv.serve()
+    api = ["--api-server", f"127.0.0.1:{port}"]
+    try:
+        rc = ktpu(api + ["rollout", "status", "deployment/web"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "successfully rolled out" in out
+
+        d.rollout(cpu_milli=300)
+        hub.step()
+        rc = ktpu(api + ["rollout", "status", "deployment/web"])
+        out = capsys.readouterr().out
+        assert rc == 1 and "Waiting for deployment" in out
+        for _ in range(10):
+            hub.step()
+        rc = ktpu(api + ["rollout", "status", "deployment/web"])
+        assert rc == 0
+
+        rc = ktpu(api + ["get", "deployments"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "web" in out and "3/3" in out
+        # replicasets visible with ownerReferences
+        code, doc = _req(port, "GET", "/apis/apps/v1/replicasets")
+        assert code == 200
+        rs = [i for i in doc["items"]
+              if i["metadata"].get("ownerReferences")]
+        assert rs and rs[0]["metadata"]["ownerReferences"][0]["name"] == "web"
+    finally:
+        srv.close()

@@ -696,6 +696,11 @@ def test_discovery_and_openapi_surface():
                                            renew_time=1.0), 0)
         req(port, "POST", "/api/v1/namespaces",
             {"metadata": {"name": "d0"}})  # namespace-route fixture
+        # apps-group route fixtures ({name} -> d0 in both item routes)
+        from kubernetes_tpu.sim import Deployment, ReplicaSet
+
+        hub.add_deployment(Deployment("d0", replicas=1))
+        hub.add_replicaset(ReplicaSet("d0", replicas=0))
 
         code, doc = req(port, "GET", "/api")
         assert code == 200 and doc["kind"] == "APIVersions"
