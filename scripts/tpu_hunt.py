@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Round-4 TPU acquisition loop.
+"""Round-5 TPU acquisition loop.
 
 The container's single shared TPU chip (tunnelled ``axon`` platform) can
 wedge for hours: any ``jax.devices()`` then hangs forever in native code
@@ -10,13 +10,13 @@ persistent loop, not a one-shot probe:
   * every ``--interval`` seconds, probe backend init from a THROWAWAY
     subprocess under a timeout (a wedged claim hangs native code, so the
     probe must be killable from outside);
-  * append every probe outcome to ``benchres/tpu_probes_r04.jsonl`` —
+  * append every probe outcome to ``benchres/tpu_probes_r05.jsonl`` —
     the evidence trail VERDICT.md item 1 asks for;
   * the moment a probe proves the backend healthy, run the hardware
     payload in priority order (VERDICT.md round-4 item 1):
       (a) full 5k-node x 30k-pod headline bench + variants grid
-          -> benchres/bench_tpu_r04.json
-      (b) tests_tpu/ compiled-mode suite -> benchres/tests_tpu_r04.txt
+          -> benchres/bench_tpu_r05.json
+      (b) tests_tpu/ compiled-mode suite -> benchres/tests_tpu_r05.txt
       (c) per-phase solver profile on TPU -> benchres/solver_profile_tpu.json
     each stage in its own subprocess with its own timeout, so a wedge
     mid-payload cannot take the supervisor down;
@@ -35,7 +35,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PROBE_LOG = os.path.join(REPO, "benchres", "tpu_probes_r04.jsonl")
+PROBE_LOG = os.path.join(REPO, "benchres", "tpu_probes_r05.jsonl")
 DONE_MARK = os.path.join(REPO, "benchres", "TPU_PAYLOAD_DONE")
 
 PROBE_CODE = "import jax; print(jax.devices()[0].platform)"
@@ -111,14 +111,19 @@ def payload() -> None:
     bench_ok = run_stage(
         "bench_headline",
         [sys.executable, "bench.py"],
-        os.path.join(REPO, "benchres", "bench_tpu_r04.json"),
+        os.path.join(REPO, "benchres", "bench_tpu_r05.json"),
         timeout_s=4200,
-        extra_env={"BENCH_TIME_BUDGET_S": "2400"},
+        extra_env={"BENCH_TIME_BUDGET_S": "2400",
+                   # full document separate from the driver's end-of-round
+                   # benchres/bench_r05.json; stdout (compact line) is
+                   # captured to bench_tpu_r05.json by run_stage
+                   "BENCH_FULL_OUT": os.path.join(
+                       REPO, "benchres", "bench_tpu_r05_full.json")},
     )
     tests_ok = run_stage(
         "tests_tpu",
         [sys.executable, "-m", "pytest", "tests_tpu/", "-q", "--tb=short"],
-        os.path.join(REPO, "benchres", "tests_tpu_r04.txt"),
+        os.path.join(REPO, "benchres", "tests_tpu_r05.txt"),
         timeout_s=1800,
     )
     prof_ok = run_stage(
