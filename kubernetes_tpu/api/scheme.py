@@ -26,6 +26,8 @@ pkg/scheduler/apis/config/scheme/scheme.go:31): see
 from __future__ import annotations
 
 import dataclasses
+import functools
+import typing
 from typing import Callable, Dict, List, Tuple, Type
 
 
@@ -137,6 +139,21 @@ class Scheme:
         return out
 
 
+@functools.lru_cache(maxsize=None)
+def _type_hints(typ: Type) -> dict:
+    """Resolved annotations per type, cached: get_type_hints re-eval()s
+    every string annotation on each call, and bulk decode paths visit
+    the same handful of types thousands of times. It handles
+    Optional[...], cross-module references, and forward refs — the bare
+    getattr-on-module lookup it replaced silently resolved those to
+    None and skipped strict recursive construction, stuffing the raw
+    mapping into the field (ADVICE r4)."""
+    try:
+        return typing.get_type_hints(typ)
+    except Exception:
+        return {}
+
+
 def _build_dataclass(typ: Type, doc: dict, path: str):
     """Strict recursive dataclass construction: every key must name a
     field; mapping-valued fields whose type is itself a dataclass recurse
@@ -145,6 +162,7 @@ def _build_dataclass(typ: Type, doc: dict, path: str):
     if not isinstance(doc, dict):
         raise SchemeError([f"{path}: expected a mapping"])
     fields = {f.name: f for f in dataclasses.fields(typ)}
+    hints = _type_hints(typ)
     errs: List[str] = []
     kw: dict = {}
     for key, val in doc.items():
@@ -152,15 +170,13 @@ def _build_dataclass(typ: Type, doc: dict, path: str):
         if f is None:
             errs.append(f"{path}.{key}: unknown field")
             continue
-        ftyp = f.type if isinstance(f.type, type) else None
-        # resolve string annotations against the dataclass's module (under
-        # `from __future__ import annotations` every annotation is its
-        # SOURCE text — an explicitly-quoted one keeps its quote chars)
-        if ftyp is None and isinstance(f.type, str):
-            import sys
-
-            mod = sys.modules.get(typ.__module__)
-            ftyp = getattr(mod, f.type.strip("'\""), None)
+        ftyp = f.type if isinstance(f.type, type) else hints.get(key)
+        if typing.get_origin(ftyp) is typing.Union:
+            non_none = [a for a in typing.get_args(ftyp)
+                        if a is not type(None)]
+            ftyp = non_none[0] if len(non_none) == 1 else None
+        if not isinstance(ftyp, type):
+            ftyp = None
         if ftyp is not None and dataclasses.is_dataclass(ftyp) and not (
                 dataclasses.is_dataclass(type(val))):
             try:

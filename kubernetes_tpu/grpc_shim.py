@@ -346,16 +346,19 @@ class SnapshotDeltaBridge:
         # an event committed between list_state(rev) and watch(rev) —
         # with no other cursor alive — would vanish without ever raising
         # Compacted. The wire send happens after, outside the lock.
+        # list_state's dicts hold LIVE object references the hub mutates
+        # in place, so serialization must happen inside the lock too —
+        # same hazard pump() documents; only the wire send stays outside
         with self._lock:
             rev, nodes, pods = hub.list_state()
             self.cursor = hub.watch(rev)
-        d = pb.SnapshotDelta(revision=rev)
-        for nd in nodes.values():
-            d.nodes.add(op=pb.NodeDelta.ADD, name=nd.name,
-                        node_json=json.dumps(node_to_json(nd)))
-        for p in pods.values():
-            d.pods.add(op=pb.PodDelta.ADD, key=p.key(),
-                       pod_json=json.dumps(pod_to_json(p)))
+            d = pb.SnapshotDelta(revision=rev)
+            for nd in nodes.values():
+                d.nodes.add(op=pb.NodeDelta.ADD, name=nd.name,
+                            node_json=json.dumps(node_to_json(nd)))
+            for p in pods.values():
+                d.pods.add(op=pb.PodDelta.ADD, key=p.key(),
+                           pod_json=json.dumps(pod_to_json(p)))
         list(client.sync_state(iter([d])))
 
     NODE_OPS = {"ADDED": pb.NodeDelta.ADD,
@@ -367,30 +370,36 @@ class SnapshotDeltaBridge:
 
     def pump(self) -> int:
         node_ops, pod_ops = self.NODE_OPS, self.POD_OPS
+        # poll AND serialize under the lock: the hub commits live object
+        # references into watch history and mutates them in place, so a
+        # threaded driver racing this loop could tear the JSON (dict
+        # changed size mid-iteration) or stamp a delta whose body
+        # reflects a later revision than it claims. Only the wire send
+        # stays outside — a slow stream must not wedge the hub.
         with self._lock:
             events = self.cursor.poll()
-        if not events:
-            return 0
-        deltas = []
-        cur_kind = None
-        d = None
-        for rev, obj_key, etype, obj in events:
-            kind, _, ident = obj_key.partition("/")
-            if kind not in ("nodes", "pods"):
-                continue  # leases/volumes/events are not scheduler feed
-            if d is None or kind != cur_kind:
-                d = pb.SnapshotDelta(revision=rev)
-                deltas.append(d)
-                cur_kind = kind
-            d.revision = rev
-            if kind == "nodes":
-                d.nodes.add(op=node_ops[etype], name=ident,
-                            node_json=(json.dumps(self._node_json(obj))
-                                       if obj is not None else ""))
-            else:
-                d.pods.add(op=pod_ops[etype], key=ident,
-                           pod_json=(json.dumps(self._pod_json(obj))
-                                     if obj is not None else ""))
+            if not events:
+                return 0
+            deltas = []
+            cur_kind = None
+            d = None
+            for rev, obj_key, etype, obj in events:
+                kind, _, ident = obj_key.partition("/")
+                if kind not in ("nodes", "pods"):
+                    continue  # leases/volumes/events aren't scheduler feed
+                if d is None or kind != cur_kind:
+                    d = pb.SnapshotDelta(revision=rev)
+                    deltas.append(d)
+                    cur_kind = kind
+                d.revision = rev
+                if kind == "nodes":
+                    d.nodes.add(op=node_ops[etype], name=ident,
+                                node_json=(json.dumps(self._node_json(obj))
+                                           if obj is not None else ""))
+                else:
+                    d.pods.add(op=pod_ops[etype], key=ident,
+                               pod_json=(json.dumps(self._pod_json(obj))
+                                         if obj is not None else ""))
         if deltas:
             list(self.client.sync_state(iter(deltas)))
         return len(events)

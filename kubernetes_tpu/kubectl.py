@@ -354,13 +354,20 @@ def cmd_drain(rest: RestClient, args) -> int:
     rc = cmd_cordon(rest, args, unschedulable=True)
     if rc != 0:
         return rc
-    code, doc = rest.call("GET", "/api/v1/pods")
+    # server-side field selector: list ONLY this node's pods (the
+    # spec.nodeName selector kubelets live on, pod/strategy.go:197) —
+    # listing the world and filtering client-side is the anti-pattern
+    # the watch cache exists to prevent
+    from urllib.parse import quote
+
+    code, doc = rest.call(
+        "GET",
+        f"/api/v1/pods?fieldSelector={quote(f'spec.nodeName={args.name}')}",
+    )
     if code != 200:
         return _rest_fail(doc)
     blocked = []
     for p in doc["items"]:
-        if p["spec"].get("nodeName") != args.name:
-            continue
         m = p["metadata"]
         refs = p["metadata"].get("ownerReferences") or []
         if any(r.get("kind") == "DaemonSet" for r in refs):

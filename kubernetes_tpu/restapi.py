@@ -42,6 +42,15 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from kubernetes_tpu.admission import AdmissionError
+from kubernetes_tpu.api.selectors import (
+    SelectorError,
+    match_fields,
+    match_labels,
+    node_fields,
+    parse_field_selector,
+    parse_label_selector,
+    pod_fields,
+)
 from kubernetes_tpu.auth import (
     ALLOW,
     Attributes,
@@ -274,6 +283,52 @@ def _with_rv(doc: dict, hub: HollowCluster, obj_key: str) -> dict:
         hub.resource_version.get(obj_key, 0)
     )
     return doc
+
+
+class ListOptions:
+    """The server-evaluated slice of metav1.ListOptions (types.go:322):
+    labelSelector, fieldSelector, limit, continue. Parsed once per list
+    request; selector errors surface as 400 the way the apiserver's
+    option-decoding does."""
+
+    def __init__(self, query: dict) -> None:
+        self.label = parse_label_selector(
+            (query.get("labelSelector") or [""])[0])
+        self.field = parse_field_selector(
+            (query.get("fieldSelector") or [""])[0])
+        try:
+            self.limit = int((query.get("limit") or ["0"])[0])
+        except ValueError:
+            raise SelectorError("limit must be an integer")
+        if self.limit < 0:
+            raise SelectorError("limit must be non-negative")
+        self.cont = (query.get("continue") or [""])[0]
+
+    def matches(self, labels, fields) -> bool:
+        return (match_labels(self.label, labels)
+                and match_fields(self.field, fields))
+
+
+def encode_continue(rv: int, last_key: str) -> str:
+    """Opaque continuation token (pager contract,
+    apiserver/pkg/storage/etcd3/store.go encodeContinue): carries the
+    list revision and the key to resume AFTER."""
+    import base64
+
+    raw = json.dumps({"rv": rv, "start": last_key}).encode()
+    return base64.urlsafe_b64encode(raw).decode().rstrip("=")
+
+
+def decode_continue(token: str):
+    """-> (rv, start_after_key); raises SelectorError on garbage."""
+    import base64
+
+    try:
+        pad = "=" * (-len(token) % 4)
+        doc = json.loads(base64.urlsafe_b64decode(token + pad))
+        return int(doc["rv"]), str(doc["start"])
+    except Exception:
+        raise SelectorError("invalid continue token")
 
 
 class RestServer:
@@ -616,15 +671,12 @@ class RestServer:
         if seg[0] == "watch":
             return self._watch(h, seg[1:], parse_qs(url.query))
         if seg == ["nodes"]:
-            items = [
-                _with_rv(node_to_json(n), hub, f"nodes/{n.name}")
-                for n in hub.truth_nodes.values()
-            ]
-            return h._respond(200, {
-                "kind": "NodeList", "apiVersion": "v1",
-                "metadata": {"resourceVersion": str(hub._revision)},
-                "items": items,
-            })
+            return self._serve_list(
+                h, parse_qs(url.query), "NodeList",
+                list(hub.truth_nodes.values()),
+                node_fields, lambda n: n.labels,
+                lambda n: _with_rv(node_to_json(n), hub, f"nodes/{n.name}"),
+                lambda n: n.name)
         if len(seg) == 2 and seg[0] == "nodes":
             n = hub.truth_nodes.get(seg[1])
             if n is None:
@@ -736,16 +788,14 @@ class RestServer:
                 "items": items,
             })
         if seg == ["pods"]:
-            items = [
-                _with_rv(pod_to_json(p), hub, f"pods/{p.key()}")
-                for p in hub.truth_pods.values()
-                if ns is None or p.namespace == ns
-            ]
-            return h._respond(200, {
-                "kind": "PodList", "apiVersion": "v1",
-                "metadata": {"resourceVersion": str(hub._revision)},
-                "items": items,
-            })
+            return self._serve_list(
+                h, parse_qs(url.query), "PodList",
+                [p for p in hub.truth_pods.values()
+                 if ns is None or p.namespace == ns],
+                pod_fields, lambda p: p.labels,
+                lambda p: _with_rv(pod_to_json(p), hub,
+                                   f"pods/{p.key()}"),
+                lambda p: p.key())
         if len(seg) == 2 and seg[0] == "pods" and ns is not None:
             p = hub.truth_pods.get(f"{ns}/{seg[1]}")
             if p is None:
@@ -867,13 +917,76 @@ class RestServer:
                 return h._respond(200, doc(obj))
         return h._fail(404, "NotFound", h.path)
 
+    def _serve_list(self, h, query, kind, objs, obj_fields, obj_labels,
+                    to_json, key_of) -> None:
+        """One list pipeline for the selectable kinds: ListOptions parse →
+        hub-side selector evaluation BEFORE any serialization (the watch
+        cache's reason to exist — pod/strategy.go:197 MatchPod) → key-
+        ordered limit/continue pagination (pager contract).
+
+        Continuation fidelity: the reference serves every page of one
+        list at the token's revision straight from etcd. This hub keeps
+        only live truth + bounded watch history, so follow-up pages read
+        CURRENT state after the token's resume key; the token's revision
+        is still honored against the compaction floor — a token older
+        than retained history gets 410 Expired exactly like the
+        reference's "continue parameter is too old" path, telling the
+        client to restart the list."""
+        hub = self.hub
+        try:
+            opts = ListOptions(query)
+            selected = [o for o in objs
+                        if opts.matches(obj_labels(o), obj_fields(o))]
+        except SelectorError as e:
+            return h._fail(400, "BadRequest", str(e))
+        selected.sort(key=key_of)
+        # the revision every page of THIS list reports and re-encodes:
+        # continuation pages carry the ORIGINAL list revision forward
+        # (the reference's continue token does the same) — re-stamping
+        # with the current revision would let a slow pager outrun
+        # compaction without ever seeing the 410 restart signal
+        list_rv = hub._revision
+        if opts.cont:
+            try:
+                list_rv, start = decode_continue(opts.cont)
+            except SelectorError as e:
+                return h._fail(400, "BadRequest", str(e))
+            if list_rv < hub._compacted_rev:
+                return h._fail(
+                    410, "Expired",
+                    "the provided continue parameter is too old to display "
+                    "a consistent list result; restart the list without it")
+            selected = [o for o in selected if key_of(o) > start]
+        meta = {"resourceVersion": str(list_rv)}
+        if opts.limit and len(selected) > opts.limit:
+            remaining = len(selected) - opts.limit
+            selected = selected[:opts.limit]
+            meta["continue"] = encode_continue(list_rv,
+                                               key_of(selected[-1]))
+            meta["remainingItemCount"] = remaining
+        return h._respond(200, {
+            "kind": kind, "apiVersion": "v1", "metadata": meta,
+            "items": [to_json(o) for o in selected],
+        })
+
     # -- watch --------------------------------------------------------------
 
     def _watch(self, h, seg, query) -> None:
         """Drain currently-available events after ?resourceVersion as
         NDJSON and close — the chunked-frame watch with the client
         re-polling from its last seen rv (the cacher's delegation to
-        etcd watch, compressed to a poll per request)."""
+        etcd watch, compressed to a poll per request).
+
+        ``labelSelector``/``fieldSelector`` scope the feed the way the
+        cacher's watchFilterFunction does: matching ADDED/MODIFIED pass
+        through, a MODIFIED whose new state no longer matches becomes a
+        DELETED frame (the selector-scoped-feed contract informer caches
+        rely on), non-matching ADDED are dropped. One approximation vs
+        the reference: the cacher tracks prevObject and suppresses
+        DELETED frames for objects the watcher never matched; this
+        stateless poll-watch cannot, so such frames may be sent — an
+        informer cache ignores deletes of unknown keys, so the contract
+        holds."""
         if seg not in (["pods"], ["nodes"]):
             return h._fail(404, "NotFound", "/".join(seg))
         kind = seg[0]
@@ -883,6 +996,26 @@ class RestServer:
             return h._fail(400, "BadRequest",
                            "resourceVersion must be an integer")
         try:
+            lsel = parse_label_selector(
+                (query.get("labelSelector") or [""])[0])
+            fsel = parse_field_selector(
+                (query.get("fieldSelector") or [""])[0])
+            # reject unsupported field keys at request time, not per event
+            if fsel:
+                from kubernetes_tpu.api.types import Node as _N, Pod as _P
+
+                probe = (pod_fields(_P(name="probe")) if kind == "pods"
+                         else node_fields(_N(name="probe")))
+                match_fields(fsel, probe)
+        except SelectorError as e:
+            return h._fail(400, "BadRequest", str(e))
+
+        def selects(obj) -> bool:
+            fields = pod_fields(obj) if kind == "pods" else node_fields(obj)
+            return (match_labels(lsel, obj.labels)
+                    and match_fields(fsel, fields))
+
+        try:
             events = self.hub.watch(rv).poll()
         except Compacted as e:
             return h._fail(410, "Expired", str(e))
@@ -890,6 +1023,11 @@ class RestServer:
         for rev, obj_key, etype, obj in events:
             if not obj_key.startswith(kind + "/"):
                 continue
+            if (lsel or fsel) and obj is not None:
+                if not selects(obj):
+                    if etype == "ADDED":
+                        continue  # never matched this watcher's scope
+                    etype, obj = "DELETED", None  # left the selector
             if obj is None:
                 # pod keys are "pods/ns/name" — a DELETED frame must carry
                 # namespace and name separately or informer caches keyed

@@ -170,6 +170,21 @@ class Deployment:
                 f"Deployment.strategy must be 'RollingUpdate' or "
                 f"'Recreate', got {self.strategy!r}"
             )
+        # apps/v1 validation also rejects maxSurge=0 AND maxUnavailable=0
+        # (validation.go ValidateDeploymentStrategy) — but only as
+        # LITERAL values: a percentage that merely rounds to 0 at the
+        # current replica count is legal there and coerced at sync time
+        # (ResolveFenceposts), so the constructor matches that split
+        if self.strategy == "RollingUpdate":
+            def _literal_zero(v):
+                return v in (0, "0", "0%")
+
+            if _literal_zero(self.max_surge) and _literal_zero(
+                    self.max_unavailable):
+                raise ValueError(
+                    "Deployment maxSurge and maxUnavailable cannot both "
+                    "be 0 (the rollout could never progress)"
+                )
 
     def rs_name(self) -> str:
         """Name of the CURRENT revision's ReplicaSet."""
@@ -890,11 +905,41 @@ class HollowCluster:
         - HPA metric sources (``load_fn``) do NOT round-trip (live
           callables): re-wire them after restore or the HPA holds its
           last size.
+
+        Trust boundary: a checkpoint is a pickle stream, and unpickling
+        runs constructors — only restore checkpoints YOU saved (the
+        reference's etcd snapshots are data-only; this analog is not).
+        As a guard, deserialization goes through a restricted Unpickler
+        that only resolves framework/stdlib-container classes, so a
+        tampered stream referencing e.g. ``os.system`` fails to load
+        instead of executing.
         """
         import pickle
 
+        class _CheckpointUnpickler(pickle.Unpickler):
+            _SAFE_BUILTINS = frozenset({
+                "set", "frozenset", "list", "dict", "tuple", "bytearray",
+                "complex", "range", "slice", "object",
+            })
+
+            def find_class(self, module, name):
+                # dotted names make find_class getattr-WALK from the
+                # module (STACK_GLOBAL), so "kubernetes_tpu.x" + name
+                # "os.system" would escape the module allowlist through
+                # any module-level import — reject them outright
+                if "." not in name:
+                    if module.split(".")[0] in ("kubernetes_tpu",
+                                                "collections"):
+                        return super().find_class(module, name)
+                    if module == "builtins" and name in self._SAFE_BUILTINS:
+                        return super().find_class(module, name)
+                raise pickle.UnpicklingError(
+                    f"checkpoint references forbidden global "
+                    f"{module}.{name} — refusing to load"
+                )
+
         with open(path, "rb") as f:
-            state = pickle.load(f)
+            state = _CheckpointUnpickler(f).load()
         if state.get("format") != "ktpu-checkpoint/1":
             raise ValueError(f"not a ktpu checkpoint: {path}")
         want = state.get("config", {})
@@ -1380,7 +1425,11 @@ class HollowCluster:
             max_unavail = _int_or_percent(d.max_unavailable, d.replicas,
                                           round_up=False)
             if surge == 0 and max_unavail == 0:
-                max_unavail = 1  # validation forbids both 0; fail safe
+                # a percentage budget that rounds to 0 at this replica
+                # count (literal 0/0 is rejected at construction) — the
+                # reference coerces unavailable to 1 here so the rollout
+                # still progresses (intstr ResolveFenceposts)
+                max_unavail = 1
             # old RSes never grow and never replace lost pods mid-rollout
             # (the reference only ever scales them down; a dead old pod
             # is rollout progress, not something to recreate)
@@ -1937,21 +1986,52 @@ class Reflector:
     - a :class:`Compacted` watch error relists (reflector.go's
       "too old resource version" path);
     - resync() re-delivers every known object as a no-op update (the
-      SharedInformer resync period).
+      SharedInformer resync period);
+    - ``pod_label_selector``/``pod_field_selector`` scope the POD feed
+      the way the reference's ListWatch options do (a kubelet's pod
+      informer lists with ``spec.nodeName=<self>``, kubelet/config/
+      apiserver.go:32): selection happens at the feed layer before any
+      sink delivery, and a MODIFIED pod that leaves the selector is
+      delivered as a DELETE (watch-cache selector semantics), never
+      silently retained.
     """
 
-    def __init__(self, hub: HollowCluster, sink) -> None:
+    def __init__(self, hub: HollowCluster, sink,
+                 pod_label_selector: str = "",
+                 pod_field_selector: str = "") -> None:
+        from kubernetes_tpu.api.selectors import (
+            match_fields,
+            match_labels,
+            parse_field_selector,
+            parse_label_selector,
+            pod_fields,
+        )
+
         self.hub = hub
         self.sink = sink
         self.pods: Dict[str, Pod] = {}
         self.nodes: Dict[str, Node] = {}
         self.relists = 0
         self._cursor: Optional[WatchCursor] = None
+        self._lsel = parse_label_selector(pod_label_selector)
+        self._fsel = parse_field_selector(pod_field_selector)
+        # validate field keys NOW (ListOptions decoding rejects an
+        # unsupported field label at request time, not per object)
+        match_fields(self._fsel, pod_fields(Pod(name="probe")))
+        self._match_labels, self._match_fields = match_labels, match_fields
+        self._pod_fields = pod_fields
+
+    def _selects(self, p: Pod) -> bool:
+        if not self._lsel and not self._fsel:
+            return True
+        return (self._match_labels(self._lsel, p.labels)
+                and self._match_fields(self._fsel, self._pod_fields(p)))
 
     # -- list+watch --------------------------------------------------------
 
     def list_and_watch(self) -> None:
         rev, nodes, pods = self.hub.list_state()
+        pods = {k: p for k, p in pods.items() if self._selects(p)}
         # Replace(): adds for new, updates for changed, deletes for gone
         for name, nd in nodes.items():
             if name not in self.nodes:
@@ -2014,12 +2094,21 @@ class Reflector:
                     self.sink.on_node_delete(ident)
             else:
                 if etype == "ADDED":
-                    self.pods[ident] = obj
-                    self.sink.on_pod_add(obj)
+                    if self._selects(obj):
+                        self.pods[ident] = obj
+                        self.sink.on_pod_add(obj)
                 elif etype == "MODIFIED":
-                    old = self.pods.get(ident, obj)
-                    self.pods[ident] = obj
-                    self.sink.on_pod_update(old, obj)
+                    was = ident in self.pods
+                    now = self._selects(obj)
+                    if was and now:
+                        old = self.pods[ident]
+                        self.pods[ident] = obj
+                        self.sink.on_pod_update(old, obj)
+                    elif was:  # left the selector → DELETE, never retain
+                        self.sink.on_pod_delete(self.pods.pop(ident))
+                    elif now:  # entered the selector → ADD
+                        self.pods[ident] = obj
+                        self.sink.on_pod_add(obj)
                 else:
                     old = self.pods.pop(ident, None)
                     if old is not None:

@@ -225,3 +225,39 @@ def test_core_v1_round_trip_preserves_lifecycle_fields():
     # probe-less pods stay probe-less (no phantom Ready condition)
     plain = decode_any(encode(make_pod("np", cpu_milli=10)))
     assert plain.readiness_probe is None and plain.ready is False
+
+
+def test_restore_rejects_foreign_globals_in_checkpoint(tmp_path):
+    """The restore path unpickles through a restricted Unpickler: a
+    tampered stream referencing a non-framework global (the arbitrary-
+    code-execution vector of raw pickle.load) must fail to LOAD, not
+    execute (ADVICE r4 trust-boundary guard)."""
+    import pickle
+
+    path = str(tmp_path / "evil.ckpt")
+    with open(path, "wb") as f:
+        # a stream whose load would call os.system("true")
+        pickle.dump({"format": "ktpu-checkpoint/1",
+                     "payload": EvilPayload()}, f)
+    hub = HollowCluster(seed=1)
+    with pytest.raises(pickle.UnpicklingError) as ei:
+        hub.restore_checkpoint(path)
+    assert "forbidden global" in str(ei.value)
+
+    # dotted-name traversal through an allowed module (STACK_GLOBAL
+    # getattr-walk: module='kubernetes_tpu.native', name='os.system')
+    # must not escape the allowlist either
+    dotted = (b"\x80\x04\x8c\x15kubernetes_tpu.native\x8c\tos.system"
+              b"\x93\x8c\x04true\x85R.")
+    dpath = str(tmp_path / "dotted.ckpt")
+    with open(dpath, "wb") as f:
+        f.write(dotted)
+    with pytest.raises(pickle.UnpicklingError):
+        HollowCluster(seed=1).restore_checkpoint(dpath)
+
+
+class EvilPayload:
+    def __reduce__(self):
+        import os
+
+        return (os.system, ("true",))

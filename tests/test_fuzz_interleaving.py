@@ -132,6 +132,33 @@ def _run_seed(seed: int) -> None:
         while not stop.is_set():
             code, doc = _http(port, "GET", "/api/v1/pods")
             assert code == 200 and doc["kind"] == "PodList", (code, doc)
+            # selector property under churn: a server-filtered list must
+            # be a subset of the full list and agree with client-side
+            # evaluation of the same predicate over the full list's rv
+            # window (bounded by concurrent mutators: assert subset +
+            # field correctness of what WAS returned, not exact equality)
+            if rng.random() < 0.5:
+                full = {p["metadata"]["name"]: p for p in doc["items"]}
+                code, fdoc = _http(
+                    port, "GET",
+                    "/api/v1/pods?fieldSelector=spec.nodeName%21%3D")
+                assert code == 200, (code, fdoc)
+                for p in fdoc["items"]:
+                    assert p["spec"].get("nodeName"), p["metadata"]
+                code, ldoc = _http(port, "GET", "/api/v1/pods?limit=3")
+                assert code == 200 and len(ldoc["items"]) <= 3, ldoc
+                if "continue" in ldoc["metadata"]:
+                    tok = ldoc["metadata"]["continue"]
+                    code, cdoc = _http(
+                        port, "GET", f"/api/v1/pods?limit=50&continue={tok}")
+                    # 410 legal if churn compacted past the token
+                    assert code in (200, 410), (code, cdoc)
+                    if code == 200:
+                        first = {p["metadata"]["name"]
+                                 for p in ldoc["items"]}
+                        rest_names = {p["metadata"]["name"]
+                                      for p in cdoc["items"]}
+                        assert not (first & rest_names), "page overlap"
             code, doc = _http(port, "GET",
                               f"/api/v1/watch/pods?resourceVersion={rv}",
                               ndjson=True)

@@ -211,3 +211,24 @@ def test_unknown_strategy_rejected():
     with pytest.raises(ValueError) as ei:
         Deployment("web", replicas=1, strategy="recreate")  # typo'd case
     assert "Recreate" in str(ei.value)
+
+
+def test_zero_surge_zero_unavailable_rejected():
+    """apps/v1 ValidateDeploymentStrategy: maxSurge=0 + maxUnavailable=0
+    can neither surge nor drain — rejected at construction (ADVICE r4:
+    the old silent maxUnavailable=1 coercion proceeded with semantics the
+    user did not ask for)."""
+    import pytest
+
+    with pytest.raises(ValueError) as ei:
+        Deployment("web", replicas=4, max_surge=0, max_unavailable=0)
+    assert "cannot both" in str(ei.value)
+    with pytest.raises(ValueError):
+        Deployment("web", replicas=4, max_surge="0%", max_unavailable="0%")
+    # Recreate has no rolling budgets — 0/0 fields are inert there
+    Deployment("web", replicas=4, strategy="Recreate",
+               max_surge=0, max_unavailable=0)
+    # only LITERAL 0/0 is invalid (apps/v1 validation checks the spec
+    # values): a percentage that merely ROUNDS to 0 at this replica
+    # count is legal and coerced at sync time (ResolveFenceposts)
+    Deployment("web", replicas=2, max_surge=0, max_unavailable="25%")

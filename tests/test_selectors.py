@@ -1,0 +1,384 @@
+"""Server-side LIST selectors + pagination (VERDICT r4 item 2).
+
+The selector library (kubernetes_tpu/api/selectors.py) mirrors the
+labels.Parse requirement grammar and the fields =/!= grammar; the REST
+facade evaluates both hub-side BEFORE serialization (pod/strategy.go:197
+MatchPod), pages with limit/continue (pager contract), answers 410 for
+continue tokens older than retained history, and the Reflector scopes
+its pod feed with the same machinery (kubelet-style
+spec.nodeName informers)."""
+
+import pytest
+
+from kubernetes_tpu.api.selectors import (
+    SelectorError,
+    match_fields,
+    match_labels,
+    node_fields,
+    parse_field_selector,
+    parse_label_selector,
+    pod_fields,
+)
+from kubernetes_tpu.restapi import RestServer
+from kubernetes_tpu.sim import HollowCluster, Reflector
+from kubernetes_tpu.testing import make_node, make_pod
+
+from tests.test_restapi import make_pod_doc, req, start
+
+
+# -- grammar ----------------------------------------------------------------
+
+def test_label_selector_grammar():
+    labels = {"app": "web", "tier": "fe", "rank": "3"}
+    cases = [
+        ("app=web", True),
+        ("app==web", True),
+        ("app=db", False),
+        ("app!=db", True),
+        ("app!=web", False),
+        ("ghost!=x", True),            # != matches ABSENT keys
+        ("app in (web, db)", True),
+        ("app in (db)", False),
+        ("tier notin (be)", True),
+        ("tier notin (fe, be)", False),
+        ("ghost notin (x)", True),     # notin matches absent keys
+        ("app", True),                 # exists
+        ("ghost", False),
+        ("!ghost", True),              # not-exists
+        ("!app", False),
+        ("rank>2", True),
+        ("rank>3", False),
+        ("rank<4", True),
+        ("app=web,tier=fe", True),     # AND
+        ("app=web,tier=be", False),
+        ("", True),                    # Everything()
+    ]
+    for sel, want in cases:
+        assert match_labels(parse_label_selector(sel), labels) == want, sel
+
+
+def test_label_selector_parse_errors():
+    for bad in ("app in ()", "=x", "a=b=c", "rank>abc", "app in web"):
+        with pytest.raises(SelectorError):
+            parse_label_selector(bad)
+
+
+def test_field_selector_grammar_and_unsupported_key():
+    p = make_pod("p1", node_name="n3")
+    f = pod_fields(p)
+    assert match_fields(parse_field_selector("spec.nodeName=n3"), f)
+    assert not match_fields(parse_field_selector("spec.nodeName!=n3"), f)
+    assert match_fields(
+        parse_field_selector("metadata.name=p1,spec.nodeName=n3"), f)
+    with pytest.raises(SelectorError, match="not supported"):
+        match_fields(parse_field_selector("spec.bogus=x"), f)
+    with pytest.raises(SelectorError):
+        parse_field_selector("justakey")
+    nf = node_fields(make_node("n1"))
+    assert match_fields(parse_field_selector("spec.unschedulable=false"), nf)
+
+
+# -- REST -------------------------------------------------------------------
+
+def _cluster_with_pods():
+    hub = HollowCluster(seed=5, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    for i in range(3):
+        req(port, "POST", "/api/v1/nodes", {
+            "metadata": {"name": f"n{i}",
+                         "labels": {"kubernetes.io/hostname": f"n{i}",
+                                    "disk": "ssd" if i < 2 else "hdd"}},
+            "status": {"allocatable": {"cpu": "4000m",
+                                       "memory": "8589934592",
+                                       "pods": "110"}},
+        })
+    for i in range(6):
+        doc = make_pod_doc(f"p{i}")
+        doc["metadata"]["labels"] = {"app": "web" if i % 2 == 0 else "db",
+                                     "idx": str(i)}
+        req(port, "POST", "/api/v1/namespaces/default/pods", doc)
+    # bind p0,p1 to n0 the scheduler's way (Binding subresource)
+    for name in ("p0", "p1"):
+        code, _ = req(port, "POST",
+                      f"/api/v1/namespaces/default/pods/{name}/binding",
+                      {"target": {"name": "n0"}})
+        assert code == 201
+    return hub, srv, port
+
+
+def test_rest_list_label_and_field_selectors():
+    hub, srv, port = _cluster_with_pods()
+    try:
+        code, doc = req(port, "GET", "/api/v1/pods?labelSelector=app%3Dweb")
+        assert code == 200
+        assert sorted(p["metadata"]["name"] for p in doc["items"]) == [
+            "p0", "p2", "p4"]
+
+        code, doc = req(
+            port, "GET", "/api/v1/pods?fieldSelector=spec.nodeName%3Dn0")
+        assert code == 200
+        assert sorted(p["metadata"]["name"] for p in doc["items"]) == [
+            "p0", "p1"]
+
+        # combined: AND of both selectors
+        code, doc = req(
+            port, "GET",
+            "/api/v1/pods?labelSelector=app%3Dweb"
+            "&fieldSelector=spec.nodeName%3Dn0")
+        assert code == 200
+        assert [p["metadata"]["name"] for p in doc["items"]] == ["p0"]
+
+        # set-based + namespace-scoped route
+        code, doc = req(
+            port, "GET",
+            "/api/v1/namespaces/default/pods"
+            "?labelSelector=idx%20in%20(1,2,9)")
+        assert code == 200
+        assert sorted(p["metadata"]["name"] for p in doc["items"]) == [
+            "p1", "p2"]
+
+        # nodes: label + field selectors
+        code, doc = req(port, "GET", "/api/v1/nodes?labelSelector=disk%3Dssd")
+        assert code == 200 and len(doc["items"]) == 2
+        code, doc = req(
+            port, "GET", "/api/v1/nodes?fieldSelector=metadata.name%3Dn2")
+        assert code == 200
+        assert [n["metadata"]["name"] for n in doc["items"]] == ["n2"]
+
+        # errors: bad grammar and unsupported field label are 400s
+        code, doc = req(port, "GET", "/api/v1/pods?labelSelector=app%20in%20()")
+        assert code == 400 and doc["reason"] == "BadRequest"
+        code, doc = req(port, "GET", "/api/v1/pods?fieldSelector=spec.bogus%3Dx")
+        assert code == 400 and "not supported" in doc["message"]
+    finally:
+        srv.close()
+
+
+def test_rest_list_pagination_walk():
+    hub, srv, port = _cluster_with_pods()
+    try:
+        seen = []
+        path = "/api/v1/pods?limit=4"
+        code, doc = req(port, "GET", path)
+        assert code == 200 and len(doc["items"]) == 4
+        assert doc["metadata"]["remainingItemCount"] == 2
+        seen += [p["metadata"]["name"] for p in doc["items"]]
+        token = doc["metadata"]["continue"]
+        code, doc = req(port, "GET",
+                        f"/api/v1/pods?limit=4&continue={token}")
+        assert code == 200 and len(doc["items"]) == 2
+        assert "continue" not in doc["metadata"]
+        seen += [p["metadata"]["name"] for p in doc["items"]]
+        assert sorted(seen) == [f"p{i}" for i in range(6)]
+        assert len(seen) == len(set(seen))  # no duplicates across pages
+
+        # selectors compose with pagination (filter BEFORE paging)
+        code, doc = req(
+            port, "GET", "/api/v1/pods?labelSelector=app%3Dweb&limit=2")
+        assert code == 200 and len(doc["items"]) == 2
+        token = doc["metadata"]["continue"]
+        code, doc = req(
+            port, "GET",
+            f"/api/v1/pods?labelSelector=app%3Dweb&limit=2&continue={token}")
+        assert code == 200
+        assert [p["metadata"]["name"] for p in doc["items"]] == ["p4"]
+
+        code, doc = req(port, "GET", "/api/v1/pods?continue=garbage!!")
+        assert code == 400 and "continue" in doc["message"]
+    finally:
+        srv.close()
+
+
+def test_rest_continue_token_expires_with_compaction():
+    hub, srv, port = _cluster_with_pods()
+    try:
+        code, doc = req(port, "GET", "/api/v1/nodes?limit=1")
+        assert code == 200
+        token = doc["metadata"]["continue"]
+        # push the hub far past the server's watch window so the token's
+        # revision falls behind the compaction floor (the reference's
+        # "continue parameter is too old" path)
+        srv.WATCH_WINDOW = 5
+        for i in range(40):
+            hub.add_node(make_node(f"extra{i}"))
+        hub.compact()  # compaction honors the (advanced) anchor pin
+        code, doc = req(port, "GET", f"/api/v1/nodes?limit=1&continue={token}")
+        assert code == 410 and doc["reason"] == "Expired"
+    finally:
+        srv.close()
+
+
+# -- drain over the selector ------------------------------------------------
+
+def test_drain_lists_only_target_nodes_pods_server_side():
+    """ktpu drain now lists with fieldSelector=spec.nodeName=<node>: the
+    audited request URI proves the filtering happened at the server, and
+    only the target node's pods are evicted."""
+    from kubernetes_tpu.kubectl import main as ktpu
+    from kubernetes_tpu.restapi import AuditLog
+
+    hub = HollowCluster(seed=7, scheduler_kw={"enable_preemption": False})
+    audit = AuditLog(level="Metadata")
+    srv = RestServer(hub, audit=audit)
+    port = srv.serve()
+    try:
+        for i in range(2):
+            hub.add_node(make_node(f"n{i}", cpu_milli=4000, pods=110))
+        for i in range(4):
+            p = make_pod(f"p{i}")
+            hub.create_pod(p)
+            hub.confirm_binding(p, f"n{i % 2}")
+        rc = ktpu(["--api-server", f"127.0.0.1:{port}", "drain", "n0"])
+        assert rc == 0
+        lists = [e for e in audit.entries
+                 if e["verb"] == "list" and "/pods" in e["requestURI"]]
+        assert lists and all(
+            "fieldSelector=spec.nodeName%3Dn0" in e["requestURI"]
+            for e in lists)
+        left = [p.name for p in hub.truth_pods.values()]
+        assert sorted(left) == ["p1", "p3"]  # n1's pods untouched
+    finally:
+        srv.close()
+
+
+# -- Reflector scoping ------------------------------------------------------
+
+class RecordingSink:
+    def __init__(self):
+        self.log = []
+
+    def on_pod_add(self, p):
+        self.log.append(("add", p.key()))
+
+    def on_pod_update(self, old, new):
+        self.log.append(("update", new.key()))
+
+    def on_pod_delete(self, p):
+        self.log.append(("delete", p.key()))
+
+    def on_node_add(self, n):
+        pass
+
+    def on_node_update(self, n):
+        pass
+
+    def on_node_delete(self, name):
+        pass
+
+
+def test_reflector_field_selector_scopes_pod_feed():
+    """A kubelet-style reflector (spec.nodeName=n0) sees only its node's
+    pods; a pod rebinding away is delivered as a DELETE."""
+    hub = HollowCluster(seed=11, scheduler_kw={"enable_preemption": False})
+    for i in range(2):
+        hub.add_node(make_node(f"n{i}", cpu_milli=4000, pods=110))
+    p0, p1 = make_pod("p0"), make_pod("p1")
+    hub.create_pod(p0)
+    hub.create_pod(p1)
+    hub.confirm_binding(p0, "n0")
+    hub.confirm_binding(p1, "n1")
+
+    sink = RecordingSink()
+    r = Reflector(hub, sink, pod_field_selector="spec.nodeName=n0")
+    r.list_and_watch()
+    assert sink.log == [("add", "default/p0")]
+    assert set(r.pods) == {"default/p0"}
+
+    # a new pod bound to n0 enters the selector mid-watch
+    p2 = make_pod("p2")
+    hub.create_pod(p2)          # unbound: not selected
+    r.pump()
+    assert ("add", "default/p2") not in sink.log
+    hub.confirm_binding(p2, "n0")
+    r.pump()
+    assert ("add", "default/p2") in sink.log
+
+    # deletion of a selected pod is delivered
+    hub.delete_pod("default/p0")
+    r.pump()
+    assert ("delete", "default/p0") in sink.log
+
+    # unsupported field key fails at CONSTRUCTION, not per event
+    with pytest.raises(SelectorError):
+        Reflector(hub, sink, pod_field_selector="status.bogus=x")
+
+
+def test_reflector_label_selector_transition_delivers_delete():
+    hub = HollowCluster(seed=12, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=4000, pods=110))
+    p = make_pod("w0", labels={"app": "web"})
+    hub.create_pod(p)
+    sink = RecordingSink()
+    r = Reflector(hub, sink, pod_label_selector="app=web")
+    r.list_and_watch()
+    assert sink.log == [("add", "default/w0")]
+    # relabel out of the selector → DELETE (never silently retained).
+    # No public label-update verb exists on the hub (controllers mutate
+    # through their own seams), so commit the MODIFIED frame directly.
+    import dataclasses
+
+    new = dataclasses.replace(hub.truth_pods["default/w0"],
+                              labels={"app": "db"})
+    hub.truth_pods["default/w0"] = new
+    hub._commit("pods/default/w0", "MODIFIED", new)
+    r.pump()
+    assert sink.log[-1] == ("delete", "default/w0")
+
+
+def test_watch_honors_selectors_and_converts_leavers_to_deletes():
+    """The watch feed is selector-scoped like the cacher's
+    watchFilterFunction: non-matching ADDED dropped, matching events pass,
+    a MODIFIED that leaves the selector arrives as DELETED."""
+    hub, srv, port = _cluster_with_pods()
+    try:
+        code, doc = req(port, "GET", "/api/v1/pods?limit=1")
+        rv0 = int(doc["metadata"]["resourceVersion"])
+        # two new pods: one bound to n1 (enters scope), one unbound
+        for name in ("wp0", "wp1"):
+            d = make_pod_doc(name)
+            req(port, "POST", "/api/v1/namespaces/default/pods", d)
+        req(port, "POST", "/api/v1/namespaces/default/pods/wp0/binding",
+            {"target": {"name": "n1"}})
+
+        import http.client, json as _json
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", f"/api/v1/watch/pods?resourceVersion={rv0}"
+                            "&fieldSelector=spec.nodeName%3Dn1")
+        r = conn.getresponse()
+        frames = [_json.loads(l) for l in r.read().decode().splitlines() if l]
+        conn.close()
+        names = [(f["type"], f["object"]["metadata"]["name"]) for f in frames]
+        # wp1 (never matched) absent; wp0 appears only once bound to n1
+        assert all(n != "wp1" for _, n in names), names
+        assert ("MODIFIED", "wp0") in names or ("ADDED", "wp0") in names
+
+        # eviction/deletion of a matching pod arrives; and a bad selector
+        # on watch is 400 like on list
+        code, doc = req(port, "GET",
+                        "/api/v1/watch/pods?fieldSelector=spec.bogus%3Dx")
+        assert code == 400
+    finally:
+        srv.close()
+
+
+def test_continue_token_preserves_original_list_revision():
+    """Continuation pages carry the ORIGINAL list revision in both the
+    ListMeta and any further tokens — re-stamping with the live revision
+    would let a slow pager outrun compaction without the 410 signal."""
+    hub, srv, port = _cluster_with_pods()
+    try:
+        code, doc = req(port, "GET", "/api/v1/pods?limit=2")
+        rv0 = doc["metadata"]["resourceVersion"]
+        token = doc["metadata"]["continue"]
+        # churn the hub so the live revision moves past rv0
+        req(port, "POST", "/api/v1/namespaces/default/pods",
+            make_pod_doc("zz-later"))
+        code, doc = req(port, "GET", f"/api/v1/pods?limit=2&continue={token}")
+        assert code == 200
+        assert doc["metadata"]["resourceVersion"] == rv0
+        from kubernetes_tpu.restapi import decode_continue
+
+        assert decode_continue(doc["metadata"]["continue"])[0] == int(rv0)
+    finally:
+        srv.close()
