@@ -171,7 +171,13 @@ def _build_dataclass(typ: Type, doc: dict, path: str):
             errs.append(f"{path}.{key}: unknown field")
             continue
         ftyp = f.type if isinstance(f.type, type) else hints.get(key)
-        if typing.get_origin(ftyp) is typing.Union:
+        origin = typing.get_origin(ftyp)
+        # typing.Optional[X] has origin typing.Union; PEP 604 `X | None`
+        # has origin types.UnionType — both must unwrap or a nested
+        # dataclass silently skips strict construction
+        import types as _types
+
+        if origin is typing.Union or origin is _types.UnionType:
             non_none = [a for a in typing.get_args(ftyp)
                         if a is not type(None)]
             ftyp = non_none[0] if len(non_none) == 1 else None

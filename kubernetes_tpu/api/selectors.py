@@ -227,3 +227,18 @@ def node_fields(node) -> Dict[str, str]:
         "metadata.name": node.name,
         "spec.unschedulable": "true" if node.unschedulable else "false",
     }
+
+
+def validate_field_keys(reqs: Sequence[Requirement], kind: str) -> None:
+    """Reject unsupported field labels at REQUEST/CONSTRUCTION time, not
+    per object (ListOptions decoding semantics). ``kind``: "pods" or
+    "nodes". The one shared probe for every field-selector consumer
+    (REST list/watch, Reflector) — the selectable surface lives only in
+    pod_fields/node_fields."""
+    if not reqs:
+        return
+    from kubernetes_tpu.api.types import Node, Pod
+
+    probe = (pod_fields(Pod(name="probe")) if kind == "pods"
+             else node_fields(Node(name="probe")))
+    match_fields(reqs, probe)

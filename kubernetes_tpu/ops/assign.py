@@ -237,11 +237,13 @@ def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray
 
 @partial(jax.jit, static_argnames=("weights_key", "max_rounds", "per_node_cap",
                                    "use_sinkhorn", "skip_key", "no_ports",
-                                   "no_pod_affinity", "no_spread"))
+                                   "no_pod_affinity", "no_spread",
+                                   "fused_score"))
 def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
                 extra_mask, vol=None, static_vol=None, enabled_mask=None,
                 extra_score=None, use_sinkhorn=False, skip_key=(),
-                no_ports=False, no_pod_affinity=False, no_spread=False):
+                no_ports=False, no_pod_affinity=False, no_spread=False,
+                fused_score=True):
     weights = dict(weights_key) if weights_key is not None else None
     P = pods.req.shape[0]
     perm = queue_order(pods)
@@ -301,7 +303,8 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
             & extra_mask
         )
         score = run_priorities(pods, cur, sel, mask, weights, topo,
-                               skip=skip_key, hoisted=hoisted_prio)
+                               skip=skip_key, hoisted=hoisted_prio,
+                               fused=fused_score)
         if extra_score is not None:
             score = score + extra_score
         # ---- bidder window: the next K pods the serial loop would pop ----
@@ -370,10 +373,17 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
             tied = mask & (pmasked >= prowmax)
         else:
             tied = mask & (score >= rowmax)
-        tcount = jnp.sum(tied, axis=1).astype(jnp.int32)
+        # tie-position bookkeeping: counts are bounded by N, so the (P, N)
+        # cumsum rides int16 when N fits (half the memory traffic of the
+        # bandwidth-bound pass — profile finding, solver_profile_cpu.json)
+        # and the row total is the cumsum's last column instead of a
+        # second full reduction; integer arithmetic, bit-identical picks
+        cdtype = (jnp.int16 if nodes.allocatable.shape[0] <= 32766
+                  else jnp.int32)
+        pos = jnp.cumsum(tied.astype(cdtype), axis=1)  # (P, N)
+        tcount = pos[:, -1].astype(jnp.int32)
         rot = jnp.where(tcount > 0, arank % jnp.maximum(tcount, 1), 0)
-        pos = jnp.cumsum(tied.astype(jnp.int32), axis=1)
-        pick = tied & (pos == (rot + 1)[:, None])
+        pick = tied & (pos == (rot + 1)[:, None].astype(cdtype))
         choice = jnp.argmax(pick, axis=1).astype(jnp.int32)  # (P,)
         feasible = jnp.take_along_axis(mask, choice[:, None], axis=1)[:, 0]
         choice = jnp.where(feasible, choice, -1)
@@ -486,19 +496,34 @@ def batch_assign(
     no_ports: bool = False,
     no_pod_affinity: bool = False,
     no_spread: bool = False,
+    fused_score: bool = True,
 ) -> Tuple[jnp.ndarray, UsageState, jnp.ndarray]:
     """Fast batched solver. Returns (assigned row per pod or -1, final
     usage, rounds executed). ``per_node_cap`` bounds admissions per node per
     round (see _batch_impl); with P pending pods and N nodes expect about
     ceil(P / (N * cap)) rounds on uniform workloads. ``extra_mask`` as in
-    :func:`greedy_assign`."""
+    :func:`greedy_assign`.
+
+    ``fused_score`` (feature flag, default on): collapse the two hoisted
+    normalize-reduce scoring kernels into one single-output pass per
+    round (ops/priorities.py _fused_pair_normalize). Only engages when
+    the regrouped accumulation is provably exact (all-stock kernels,
+    integer weights) — bit-identical placements either way, pinned by
+    tests/test_priorities.py."""
     key = tuple(sorted(weights.items())) if weights is not None else None
     if extra_mask is None:
         extra_mask = jnp.ones(
             (pods.req.shape[0], nodes.allocatable.shape[0]), bool
         )
+    if fused_score:
+        # resolve the backend policy HERE so it becomes part of the jit
+        # key: use_pallas() reads env + backend at call time, and a
+        # policy flip must recompile, not hit a stale cache entry
+        from kubernetes_tpu.ops.fused_score import use_pallas
+
+        fused_score = use_pallas()
     return _batch_impl(pods, nodes, sel, topo, key, max_rounds, per_node_cap,
                        extra_mask, vol, static_vol, enabled_mask, extra_score,
                        use_sinkhorn, skip_key=tuple(skip_priorities),
                        no_ports=no_ports, no_pod_affinity=no_pod_affinity,
-                       no_spread=no_spread)
+                       no_spread=no_spread, fused_score=fused_score)

@@ -427,6 +427,58 @@ def solver_gates(node_table, pod_table):
             "EvenPodsSpreadPriority" in skip)
 
 
+#: a snapshot of the whole stock registry at import time: the fused
+#: normalize path (and its integer-sum exactness argument) only applies
+#: when every ACTIVE kernel is stock — register_priority() rebinding any
+#: name disables fusion for configs that use it
+_ALL_STOCK_KERNELS: Dict[str, PriorityFn] = dict(PRIORITY_REGISTRY)
+
+
+def _fusable(weights: Dict[str, float], skip) -> bool:
+    """True when the NA+TT fused accumulate is provably bit-identical:
+    every active kernel is stock (all stock kernels floor their scores to
+    integer-valued f32 — verified across priorities.py and topology.py)
+    and every weight is an integer, so all partial sums are exact f32
+    integers (< 2^24) and addition regrouping cannot round."""
+    for name, w in weights.items():
+        if not w or name in skip:
+            continue
+        if PRIORITY_REGISTRY.get(name) is not _ALL_STOCK_KERNELS.get(name):
+            return False
+        if float(w) != int(w):
+            return False
+    return True
+
+
+def _fused_pair_normalize(raw_fwd, raw_rev, mask, w_fwd, w_rev):
+    """One-output fused form of the two hoisted-raw normalizes
+    (NodeAffinity forward + TaintToleration reverse): on a
+    Pallas-capable backend this routes to the two-pass HBM-minimal
+    kernel pair (ops/fused_score.py); the jnp expression below is the
+    universal fallback — identical per-element arithmetic to two
+    :func:`_normalize_reduce` calls with the weighted pair landing as
+    ONE (P, N) term. Exactness of the regrouped accumulation is the
+    :func:`_fusable` integer argument; measured CPU effect of the jnp
+    form is neutral-to-negative (XLA:CPU's own fusion already wins —
+    benchres/fused_score_cpu.json), which is why the solver only engages
+    fusion under the Pallas policy (see batch_assign)."""
+    from kubernetes_tpu.ops.fused_score import fused_pair_normalize_device
+
+    out = fused_pair_normalize_device(raw_fwd, raw_rev, mask, w_fwd, w_rev)
+    if out is not None:
+        return out
+    masked_f = jnp.where(mask, raw_fwd, 0.0)
+    mxf = jnp.max(masked_f, axis=1, keepdims=True)
+    sf = _idiv(MAX_PRIORITY * raw_fwd, jnp.where(mxf > 0, mxf, 1.0))
+    sf = jnp.where(mxf > 0, sf, 0.0)
+    masked_r = jnp.where(mask, raw_rev, 0.0)
+    mxr = jnp.max(masked_r, axis=1, keepdims=True)
+    sr = _idiv(MAX_PRIORITY * raw_rev, jnp.where(mxr > 0, mxr, 1.0))
+    sr = jnp.where(mxr > 0, sr, 0.0)
+    sr = jnp.where(mxr > 0, MAX_PRIORITY - sr, float(MAX_PRIORITY))
+    return w_fwd * sf + w_rev * sr
+
+
 #: stock kernels whose full (P, N) score reads NO usage field and NO mask
 #: — computable once per batch and reused every round verbatim
 STATIC_FULL = ("ImageLocalityPriority", "NodePreferAvoidPodsPriority",
@@ -478,6 +530,7 @@ def run_priorities(
     topo=None,
     skip=(),
     hoisted: Dict[str, tuple] | None = None,
+    fused: bool = False,
 ) -> jnp.ndarray:
     """PrioritizeNodes (generic_scheduler.go:684): weighted sum of all
     enabled priorities -> (P, N) f32 total score. ``skip`` names kernels
@@ -485,13 +538,33 @@ def run_priorities(
     :data:`EMPTY_CONSTANTS` scalar. ``hoisted`` takes
     :func:`hoist_priorities` output; accumulation stays in weights-dict
     order with identical per-kernel arithmetic, so hoisted and unhoisted
-    totals are bit-identical (pinned by tests/test_priorities.py)."""
+    totals are bit-identical (pinned by tests/test_priorities.py).
+
+    ``fused=True`` additionally collapses the two hoisted-raw normalizes
+    (NodeAffinity + TaintToleration) into one single-output kernel —
+    applied only when :func:`_fusable` proves the regrouped accumulation
+    exact (all-stock kernels, integer weights), so it is ALWAYS
+    bit-identical; non-fusable configs silently take the standard path."""
     weights = DEFAULT_WEIGHTS if weights is None else weights
     hoisted = hoisted or {}
+    _NA, _TT = "NodeAffinityPriority", "TaintTolerationPriority"
+    fuse_pair = ()
+    if (fused and _fusable(weights, skip)
+            and all(n in hoisted and hoisted[n][0] == "raw"
+                    and weights.get(n) and n not in skip
+                    for n in (_NA, _TT))):
+        # dict order decides which name triggers the combined accumulate
+        fuse_pair = tuple(n for n in weights if n in (_NA, _TT))
     total = jnp.zeros((pods.req.shape[0], nodes.allocatable.shape[0]), jnp.float32)
     for name, w in weights.items():
         if not w:
             continue
+        if name in fuse_pair:
+            if name == fuse_pair[0]:
+                total = total + _fused_pair_normalize(
+                    hoisted[_NA][1], hoisted[_TT][1], mask,
+                    float(weights[_NA]), float(weights[_TT]))
+            continue  # second of the pair: already accumulated
         if (name in skip and name in EMPTY_CONSTANTS
                 and PRIORITY_REGISTRY[name] is _STOCK_KERNELS[name]):
             total = total + w * EMPTY_CONSTANTS[name]

@@ -50,6 +50,7 @@ from kubernetes_tpu.api.selectors import (
     parse_field_selector,
     parse_label_selector,
     pod_fields,
+    validate_field_keys,
 )
 from kubernetes_tpu.auth import (
     ALLOW,
@@ -935,8 +936,11 @@ class RestServer:
         hub = self.hub
         try:
             opts = ListOptions(query)
-            selected = [o for o in objs
-                        if opts.matches(obj_labels(o), obj_fields(o))]
+            if opts.label or opts.field:
+                selected = [o for o in objs
+                            if opts.matches(obj_labels(o), obj_fields(o))]
+            else:
+                selected = list(objs)  # hot path: no per-object field dicts
         except SelectorError as e:
             return h._fail(400, "BadRequest", str(e))
         selected.sort(key=key_of)
@@ -963,7 +967,11 @@ class RestServer:
             selected = selected[:opts.limit]
             meta["continue"] = encode_continue(list_rv,
                                                key_of(selected[-1]))
-            meta["remainingItemCount"] = remaining
+            if not (opts.label or opts.field):
+                # ListMeta contract: remainingItemCount is OMITTED on
+                # selector'd lists (the apiserver can't compute it
+                # exactly there and leaves the field unset)
+                meta["remainingItemCount"] = remaining
         return h._respond(200, {
             "kind": kind, "apiVersion": "v1", "metadata": meta,
             "items": [to_json(o) for o in selected],
@@ -1000,13 +1008,7 @@ class RestServer:
                 (query.get("labelSelector") or [""])[0])
             fsel = parse_field_selector(
                 (query.get("fieldSelector") or [""])[0])
-            # reject unsupported field keys at request time, not per event
-            if fsel:
-                from kubernetes_tpu.api.types import Node as _N, Pod as _P
-
-                probe = (pod_fields(_P(name="probe")) if kind == "pods"
-                         else node_fields(_N(name="probe")))
-                match_fields(fsel, probe)
+            validate_field_keys(fsel, kind)
         except SelectorError as e:
             return h._fail(400, "BadRequest", str(e))
 

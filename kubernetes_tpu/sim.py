@@ -2005,6 +2005,7 @@ class Reflector:
             parse_field_selector,
             parse_label_selector,
             pod_fields,
+            validate_field_keys,
         )
 
         self.hub = hub
@@ -2015,9 +2016,7 @@ class Reflector:
         self._cursor: Optional[WatchCursor] = None
         self._lsel = parse_label_selector(pod_label_selector)
         self._fsel = parse_field_selector(pod_field_selector)
-        # validate field keys NOW (ListOptions decoding rejects an
-        # unsupported field label at request time, not per object)
-        match_fields(self._fsel, pod_fields(Pod(name="probe")))
+        validate_field_keys(self._fsel, "pods")
         self._match_labels, self._match_fields = match_labels, match_fields
         self._pod_fields = pod_fields
 

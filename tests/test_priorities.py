@@ -431,3 +431,103 @@ def test_hoist_skips_custom_kernels():
         assert "TaintTolerationPriority" in hp  # others still hoist
     finally:
         register_priority("ImageLocalityPriority", stock)
+
+
+def test_fused_pair_normalize_bit_identical(monkeypatch):
+    """The fused NA+TT normalize must be bit-identical to the two
+    separate _normalize_reduce calls on every path: the jnp fallback
+    expression, AND the Pallas kernel pair (exercised in interpret mode
+    on CPU via KTPU_PALLAS=1 — the same kernels the TPU path compiles);
+    at the solver level, placements must be identical with the fusion
+    engaged vs disabled."""
+    import numpy as np
+
+    from kubernetes_tpu.ops.assign import batch_assign
+    from kubernetes_tpu.ops.priorities import (
+        _fused_pair_normalize,
+        _normalize_reduce,
+        empty_priorities,
+        hoist_priorities,
+        run_priorities,
+    )
+    from bench import build_variant
+
+    w = build_variant("node_affinity", 60, 30, 128)
+    dp, dv = w.device_batch(w.pending[:128], 128)
+    fr = run_predicates(dp, w.dn, w.ds, topo=w.dt, vol=dv)
+    hp = hoist_priorities(dp, w.dn, w.ds)
+    raw_na = hp["NodeAffinityPriority"][1]
+    raw_tt = hp["TaintTolerationPriority"][1]
+    want = (1.0 * np.asarray(_normalize_reduce(raw_na, fr.mask, False))
+            + 1.0 * np.asarray(_normalize_reduce(raw_tt, fr.mask, True)))
+
+    # jnp fallback expression
+    monkeypatch.setenv("KTPU_PALLAS", "0")
+    got_jnp = np.asarray(_fused_pair_normalize(raw_na, raw_tt, fr.mask,
+                                               1.0, 1.0))
+    assert (got_jnp == want).all()
+
+    # Pallas kernel pair, interpret mode (the TPU kernels' semantics)
+    monkeypatch.setenv("KTPU_PALLAS", "1")
+    got_pl = np.asarray(_fused_pair_normalize(raw_na, raw_tt, fr.mask,
+                                              1.0, 1.0))
+    assert (got_pl == want).all()
+
+    # run_priorities totals with the fusion engaged vs standard path
+    for skip in ((), empty_priorities(
+            w.pk.pack_nodes(w.nodes, w.existing),
+            w.pk.pack_pods(w.pending))):
+        fused = run_priorities(dp, w.dn, w.ds, fr.mask, topo=w.dt,
+                               skip=skip, hoisted=hp, fused=True)
+        monkeypatch.setenv("KTPU_PALLAS", "0")
+        plain = run_priorities(dp, w.dn, w.ds, fr.mask, topo=w.dt,
+                               skip=skip, hoisted=hp)
+        assert (np.asarray(plain) == np.asarray(fused)).all(), skip
+        monkeypatch.setenv("KTPU_PALLAS", "1")
+
+    # solver level: fusion engaged (interpret pallas) vs disabled
+    a_f, u_f, r_f = batch_assign(dp, w.dn, w.ds, topo=w.dt, vol=dv,
+                                 per_node_cap=4, fused_score=True)
+    monkeypatch.setenv("KTPU_PALLAS", "0")
+    a_u, u_u, r_u = batch_assign(dp, w.dn, w.ds, topo=w.dt, vol=dv,
+                                 per_node_cap=4, fused_score=False)
+    assert (np.asarray(a_f) == np.asarray(a_u)).all()
+    assert (np.asarray(u_f.requested) == np.asarray(u_u.requested)).all()
+    assert int(r_f) == int(r_u)
+
+
+def test_fused_pair_disengages_for_custom_kernels_and_float_weights():
+    """Fusion must fall back to the standard path whenever the
+    exactness proof doesn't hold: any custom-registered kernel among the
+    active weights, or a non-integer weight."""
+    import numpy as np
+
+    from kubernetes_tpu.ops.priorities import (
+        DEFAULT_WEIGHTS,
+        PRIORITY_REGISTRY,
+        _fusable,
+        hoist_priorities,
+        register_priority,
+        run_priorities,
+    )
+    from bench import build_variant
+
+    assert _fusable(DEFAULT_WEIGHTS, ())
+    assert not _fusable({**DEFAULT_WEIGHTS, "LeastRequestedPriority": 1.5}, ())
+
+    w = build_variant("node_affinity", 20, 10, 32)
+    dp, _ = w.device_batch(w.pending[:32], 32)
+    fr = run_predicates(dp, w.dn, w.ds, topo=w.dt)
+    stock = PRIORITY_REGISTRY["LeastRequestedPriority"]
+    try:
+        register_priority("LeastRequestedPriority",
+                          lambda p, n, s, t, m: stock(p, n, s, t, m) + 0.25)
+        assert not _fusable(DEFAULT_WEIGHTS, ())
+        hp = hoist_priorities(dp, w.dn, w.ds)
+        plain = run_priorities(dp, w.dn, w.ds, fr.mask, topo=w.dt, hoisted=hp)
+        fused = run_priorities(dp, w.dn, w.ds, fr.mask, topo=w.dt, hoisted=hp,
+                               fused=True)
+        # fused flag on, but fusion disengaged -> same graph, same result
+        assert (np.asarray(plain) == np.asarray(fused)).all()
+    finally:
+        register_priority("LeastRequestedPriority", stock)

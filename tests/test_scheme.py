@@ -255,3 +255,39 @@ def test_plugin_config_strictness():
         decode({"apiVersion": GROUP_VERSION, "kind": KIND,
                 "pluginConfig": [{"name": "X", "arg": {"a": 1}}]})
     assert "pluginConfig[0].arg" in str(ei.value)
+
+
+import dataclasses as _dc
+from typing import Optional as _Optional
+
+
+@_dc.dataclass
+class _UnwrapInner:
+    a: int = 0
+
+
+@_dc.dataclass
+class _OptOuter:
+    x: "_Optional[_UnwrapInner]" = None
+
+
+@_dc.dataclass
+class _PipeOuter:
+    x: "_UnwrapInner | None" = None
+
+
+def test_union_annotations_unwrap_for_strict_build():
+    """Optional[X] (typing.Union) AND PEP 604 `X | None` (types.UnionType)
+    field annotations must both unwrap to the nested dataclass so strict
+    recursive construction fires — the silent-validation-skip ADVICE r4
+    closed (plus the 604 spelling the first fix missed). Fixtures live at
+    module level: get_type_hints resolves annotations in module scope."""
+    import pytest
+
+    from kubernetes_tpu.api.scheme import SchemeError, _build_dataclass
+
+    for outer in (_OptOuter, _PipeOuter):
+        built = _build_dataclass(outer, {"x": {"a": 3}}, "spec")
+        assert isinstance(built.x, _UnwrapInner) and built.x.a == 3
+        with pytest.raises(SchemeError, match="unknown field"):
+            _build_dataclass(outer, {"x": {"bogus": 1}}, "spec")

@@ -172,10 +172,13 @@ def test_rest_list_pagination_walk():
         assert sorted(seen) == [f"p{i}" for i in range(6)]
         assert len(seen) == len(set(seen))  # no duplicates across pages
 
-        # selectors compose with pagination (filter BEFORE paging)
+        # selectors compose with pagination (filter BEFORE paging);
+        # remainingItemCount is OMITTED on selector'd lists (ListMeta
+        # contract — the apiserver leaves it unset there)
         code, doc = req(
             port, "GET", "/api/v1/pods?labelSelector=app%3Dweb&limit=2")
         assert code == 200 and len(doc["items"]) == 2
+        assert "remainingItemCount" not in doc["metadata"]
         token = doc["metadata"]["continue"]
         code, doc = req(
             port, "GET",

@@ -1,0 +1,58 @@
+"""Real-TPU compiled-mode tests for the fused scoring normalize
+(ops/fused_score.py — VERDICT r4 item 3's Pallas deliverable).
+
+Proves, on hardware, that (a) the Mosaic kernels actually COMPILE at the
+solver's shapes (the compile probe must return True, not silently
+downgrade — VERDICT r4 weak #3's "Pallas never exercised and nobody
+would notice" failure mode), and (b) the compiled output is bit-identical
+to the jnp normalize pair."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def test_pallas_pair_compiles_and_matches_at_solver_shapes():
+    from kubernetes_tpu.ops.fused_score import (
+        _block_shapes,
+        _pallas_compiles,
+        _pair_pallas,
+    )
+    from kubernetes_tpu.ops.priorities import _normalize_reduce
+
+    rng = np.random.default_rng(7)
+    for (P, N) in ((512, 1024), (4096, 8192)):
+        raw_f = jnp.asarray(
+            rng.integers(0, 50, (P, N)).astype(np.float32))
+        raw_r = jnp.asarray(
+            rng.integers(0, 5, (P, N)).astype(np.float32))
+        mask = jnp.asarray(rng.random((P, N)) < 0.7)
+        assert _pallas_compiles(*_block_shapes(P, N)), (
+            f"Mosaic compile failed at {(P, N)} — the TPU fused path "
+            "would silently downgrade")
+        got = jax.jit(lambda a, b, m: _pair_pallas(a, b, m, 1.0, 1.0))(
+            raw_f, raw_r, mask)
+        want = (_normalize_reduce(raw_f, mask, False)
+                + _normalize_reduce(raw_r, mask, True))
+        assert (np.asarray(got) == np.asarray(want)).all(), (P, N)
+
+
+def test_batch_assign_engages_fusion_on_tpu():
+    """On a real TPU the default policy turns fusion on; placements must
+    match the fusion-disabled solve bit-for-bit."""
+    import sys, os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import build_variant
+    from kubernetes_tpu.ops.assign import batch_assign
+    from kubernetes_tpu.ops.fused_score import use_pallas
+
+    assert use_pallas(), "default policy must be ON on tpu backend"
+    w = build_variant("node_affinity", 200, 100, 512)
+    dp, dv = w.device_batch(w.pending[:512], 512)
+    a_f, u_f, _ = batch_assign(dp, w.dn, w.ds, topo=w.dt, vol=dv,
+                               per_node_cap=4, fused_score=True)
+    a_u, u_u, _ = batch_assign(dp, w.dn, w.ds, topo=w.dt, vol=dv,
+                               per_node_cap=4, fused_score=False)
+    assert (np.asarray(a_f) == np.asarray(a_u)).all()
+    assert (np.asarray(u_f.requested) == np.asarray(u_u.requested)).all()
