@@ -419,6 +419,27 @@ def cmd_get_deployments(rest: RestClient, args) -> int:
     return 0
 
 
+def cmd_scale(rest: RestClient, args) -> int:
+    """kubectl scale deployment/NAME --replicas=N through the /scale
+    subresource (ScaleREST.Update, storage.go:230) — the same write the
+    HPA performs."""
+    kind, _, name = args.target.partition("/")
+    if kind not in ("deployment", "deploy", "deployments") or not name:
+        print(f"error: scale expects deployment/NAME, got "
+              f"{args.target!r}", file=sys.stderr)
+        return 2
+    code, doc = rest.call(
+        "PUT",
+        f"/apis/apps/v1/namespaces/{args.namespace}/deployments/"
+        f"{name}/scale",
+        {"kind": "Scale", "spec": {"replicas": args.replicas}},
+    )
+    if code != 200:
+        return _rest_fail(doc)
+    print(f"deployment.apps/{name} scaled")
+    return 0
+
+
 def cmd_rollout_status(rest: RestClient, args) -> int:
     """kubectl rollout status deployment/NAME, one-shot: prints the
     current rollout state; exit 0 when complete (all replicas updated
@@ -543,6 +564,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ro = sub.add_parser("rollout")
     ro.add_argument("verb", choices=["status"])
     ro.add_argument("target")  # deployment/NAME
+    sc = sub.add_parser("scale")
+    sc.add_argument("target")  # deployment/NAME
+    sc.add_argument("--replicas", type=int, required=True)
+    sc.add_argument("-n", "--namespace", default="default")
     args = p.parse_args(argv)
 
     if args.cmd == "rollout":
@@ -581,7 +606,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 1
 
-    if args.cmd in ("create", "delete", "cordon", "uncordon", "drain"):
+    if args.cmd in ("create", "delete", "cordon", "uncordon", "drain",
+                    "scale"):
         if not args.api_server:
             p.error(f"{args.cmd} requires --api-server")
         try:
@@ -595,6 +621,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 return cmd_delete(rest, args)
             if args.cmd == "drain":
                 return cmd_drain(rest, args)
+            if args.cmd == "scale":
+                return cmd_scale(rest, args)
             return cmd_cordon(rest, args,
                               unschedulable=(args.cmd == "cordon"))
         except OSError as e:

@@ -120,11 +120,12 @@ class AuditLog:
 #: (pkg/endpoints/discovery/resources) and /openapi/v2
 #: (pkg/server/routes/openapi.go:30)
 RESOURCES = (
-    ("pods", "Pod", True, ("create", "delete", "get", "list", "watch")),
+    ("pods", "Pod", True,
+     ("create", "delete", "get", "list", "patch", "watch")),
     ("pods/binding", "Binding", True, ("create",)),
     ("pods/eviction", "Eviction", True, ("create",)),
     ("nodes", "Node", False,
-     ("create", "delete", "get", "list", "update", "watch")),
+     ("create", "delete", "get", "list", "patch", "update", "watch")),
     ("namespaces", "Namespace", False, ("create", "delete", "get", "list")),
     ("services", "Service", True, ("list",)),
     ("endpoints", "Endpoints", True, ("list",)),
@@ -142,7 +143,9 @@ LEASE_GROUP = "coordination.k8s.io"
 APPS_GROUP = "apps"
 GROUPS = {
     LEASE_GROUP: (("leases", "Lease", True, ("get", "list")),),
-    APPS_GROUP: (("deployments", "Deployment", True, ("get", "list")),
+    APPS_GROUP: (("deployments", "Deployment", True,
+                  ("create", "delete", "get", "list", "patch", "update")),
+                 ("deployments/scale", "Scale", True, ("get", "update")),
                  ("replicasets", "ReplicaSet", True, ("get", "list"))),
 }
 GROUP_RESOURCES = GROUPS[LEASE_GROUP]  # back-compat alias
@@ -186,7 +189,7 @@ def openapi_doc() -> dict:
     x-kubernetes-action the reference stamps (routes/openapi.go serves
     the aggregated spec; this facade's is hand-rolled but live)."""
     verb_http = {"create": "post", "delete": "delete", "get": "get",
-                 "list": "get", "update": "put"}
+                 "list": "get", "update": "put", "patch": "patch"}
     paths: dict = {}
     for name, kind, namespaced, verbs in RESOURCES:
         base, _, sub = name.partition("/")
@@ -214,28 +217,31 @@ def openapi_doc() -> dict:
                               "401": {"description": "Unauthorized"}},
             }
             paths.setdefault(route, {})[method] = op
-    # the non-core groups' read-only routes
+    # the non-core groups' routes (same verb->route mapping as the core
+    # table; subresource names like "deployments/scale" route to the
+    # item path)
     for group, resources in GROUPS.items():
         for name, kind, namespaced, verbs in resources:
-            base = f"/apis/{group}/v1"
-            collection = f"{base}/namespaces/{{namespace}}/{name}"
+            gbase = f"/apis/{group}/v1"
+            res, _, sub = name.partition("/")
+            collection = f"{gbase}/namespaces/{{namespace}}/{res}"
+            item = collection + "/{name}" + (f"/{sub}" if sub else "")
             gvk = {"group": group, "version": "v1", "kind": kind}
             ok = {"200": {"description": "OK"},
                   "401": {"description": "Unauthorized"}}
-            if "list" in verbs:
-                paths[f"{base}/{name}"] = {"get": {
-                    "x-kubernetes-action": "list",
+            for verb in verbs:
+                if verb == "list":
+                    for route in (f"{gbase}/{res}", collection):
+                        paths.setdefault(route, {})["get"] = {
+                            "x-kubernetes-action": "list",
+                            "x-kubernetes-group-version-kind": gvk,
+                            "responses": ok}
+                    continue
+                route = collection if verb == "create" and not sub else item
+                paths.setdefault(route, {})[verb_http[verb]] = {
+                    "x-kubernetes-action": verb,
                     "x-kubernetes-group-version-kind": gvk,
-                    "responses": ok}}
-                paths[collection] = {"get": {
-                    "x-kubernetes-action": "list",
-                    "x-kubernetes-group-version-kind": gvk,
-                    "responses": ok}}
-            if "get" in verbs:
-                paths[collection + "/{name}"] = {"get": {
-                    "x-kubernetes-action": "get",
-                    "x-kubernetes-group-version-kind": gvk,
-                    "responses": ok}}
+                    "responses": ok}
     return {
         "swagger": "2.0",
         "info": {"title": "kubernetes_tpu", "version": "v1"},
@@ -266,6 +272,70 @@ def ns_to_json(hub, ns) -> dict:
         "metadata": {"name": ns.name},
         "status": {"phase": ns.phase},
     }, hub, f"namespaces/{ns.name}")
+
+
+def _rs_bound(hub, rs) -> int:
+    """ONE bound-pod predicate for every apps/v1 doc shape (and the same
+    rule the rolling reconcile's availability math uses)."""
+    return sum(1 for k in rs.live
+               if k in hub.truth_pods and hub.truth_pods[k].node_name)
+
+
+def apps_rs_doc(hub, rs) -> dict:
+    rv = {"resourceVersion": str(hub._revision)}
+    return {
+        "metadata": {"name": rs.name, "namespace": "default", **rv,
+                     **({"ownerReferences": [
+                         {"kind": "Deployment", "name": rs.owner}]}
+                        if rs.owner else {})},
+        "spec": {"replicas": rs.replicas},
+        "status": {"replicas": len(rs.live),
+                   "readyReplicas": _rs_bound(hub, rs),
+                   "revision": rs.revision},
+    }
+
+
+def apps_deploy_doc(hub, d) -> dict:
+    """v1.Deployment wire shape (deployment_controller syncStatus
+    counts). The spec carries the WRITABLE slice round-trippably —
+    template resources under spec.template so a merge patch of the
+    template drives a rollout the way patching the pod template image
+    does in the reference."""
+    owned = [rs for rs in hub.replicasets.values() if rs.owner == d.name]
+    new_rs = hub.replicasets.get(d.rs_name())
+    return {
+        "metadata": {"name": d.name, "namespace": "default",
+                     "resourceVersion": str(hub._revision)},
+        "spec": {
+            "replicas": d.replicas,
+            "strategy": d.strategy,
+            "maxSurge": d.max_surge,
+            "maxUnavailable": d.max_unavailable,
+            "template": {"cpuMilli": d.cpu_milli, "memory": d.memory,
+                         "priority": d.priority},
+        },
+        "status": {
+            "observedRevision": d.template_rev,
+            "replicas": sum(len(rs.live) for rs in owned),
+            "updatedReplicas": (_rs_bound(hub, new_rs) if new_rs else 0),
+            "readyReplicas": sum(_rs_bound(hub, rs) for rs in owned),
+        },
+    }
+
+
+def apps_scale_doc(hub, d) -> dict:
+    """autoscaling/v1 Scale — the /scale subresource document
+    (pkg/registry/apps/deployment/storage/storage.go:230 ScaleREST):
+    spec.replicas is the write surface HPA and kubectl scale drive."""
+    owned = [rs for rs in hub.replicasets.values() if rs.owner == d.name]
+    return {
+        "kind": "Scale", "apiVersion": "autoscaling/v1",
+        "metadata": {"name": d.name, "namespace": "default",
+                     "resourceVersion": str(hub._revision)},
+        "spec": {"replicas": d.replicas},
+        "status": {"replicas": sum(len(rs.live) for rs in owned),
+                   "selector": f"app={d.name}"},
+    }
 
 
 def status_doc(code: int, reason: str, message: str) -> dict:
@@ -308,6 +378,25 @@ class ListOptions:
     def matches(self, labels, fields) -> bool:
         return (match_labels(self.label, labels)
                 and match_fields(self.field, fields))
+
+
+def merge_patch(target, patch):
+    """RFC 7386 JSON Merge Patch — the semantics behind
+    Content-Type: application/merge-patch+json
+    (apiserver/pkg/endpoints/handlers/patch.go:59 PatchResource,
+    jsonmergepatch path): objects merge recursively, null DELETES the
+    key, everything else replaces."""
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(target, dict):
+        target = {}
+    out = dict(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = merge_patch(out.get(k), v)
+    return out
 
 
 def encode_continue(rv: int, last_key: str) -> str:
@@ -463,6 +552,17 @@ class RestServer:
                 finally:
                     outer._record_audit(self, "delete", t0)
 
+            def do_PATCH(self):
+                outer._begin(self)
+                t0 = time.perf_counter()
+                try:
+                    if not outer._auth(self, "PATCH"):
+                        return
+                    with outer._lock:
+                        outer._patch(self)
+                finally:
+                    outer._record_audit(self, "patch", t0)
+
         self._closed = False
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_port
@@ -545,7 +645,8 @@ class RestServer:
             routed = RestServer._route_group(p)
             seg = routed[1] if routed is not None else None
         verb = {"GET": "get", "POST": "create", "PUT": "update",
-                "DELETE": "delete"}.get(http_verb, http_verb.lower())
+                "DELETE": "delete", "PATCH": "patch"}.get(
+                    http_verb, http_verb.lower())
         if not seg:
             return verb, "", "", ""
         if seg[0] == "watch":
@@ -836,52 +937,19 @@ class RestServer:
         return h._fail(404, "NotFound", h.path)
 
     def _get_apps(self, h, seg) -> None:
-        """Read-only apps/v1 routes: deployment + replicaset lists/gets
-        built from the hub's controller registries. Status carries the
-        rollout-relevant counts (deployment_controller syncStatus shape):
-        replicas (spec), updatedReplicas (current-revision pods),
-        readyReplicas (bound pods across revisions)."""
+        """apps/v1 read routes: deployment + replicaset lists/gets (docs
+        built by the module-level apps_*_doc helpers, shared with the
+        write paths) plus the /scale subresource read. Controller objects
+        are not individually versioned in the hub (hollow controllers
+        mutate in place); item docs carry the GLOBAL revision so clients
+        still get a usable change indicator."""
         hub = self.hub
 
-        def bound(rs):
-            # ONE bound-pod predicate for both doc shapes (and the same
-            # rule the rolling reconcile's availability math uses)
-            return sum(1 for k in rs.live
-                       if k in hub.truth_pods
-                       and hub.truth_pods[k].node_name)
-
-        # controller objects are not individually versioned in the hub
-        # (hollow controllers mutate in place); item docs carry the
-        # GLOBAL revision so clients still get a usable change indicator
-        rv = {"resourceVersion": str(hub._revision)}
-
         def rs_doc(rs):
-            return {
-                "metadata": {"name": rs.name, "namespace": "default",
-                             **rv,
-                             **({"ownerReferences": [
-                                 {"kind": "Deployment", "name": rs.owner}]}
-                                if rs.owner else {})},
-                "spec": {"replicas": rs.replicas},
-                "status": {"replicas": len(rs.live),
-                           "readyReplicas": bound(rs),
-                           "revision": rs.revision},
-            }
+            return apps_rs_doc(hub, rs)
 
         def deploy_doc(d):
-            owned = [rs for rs in hub.replicasets.values()
-                     if rs.owner == d.name]
-            new_rs = hub.replicasets.get(d.rs_name())
-            return {
-                "metadata": {"name": d.name, "namespace": "default", **rv},
-                "spec": {"replicas": d.replicas, "strategy": d.strategy},
-                "status": {
-                    "observedRevision": d.template_rev,
-                    "replicas": sum(len(rs.live) for rs in owned),
-                    "updatedReplicas": (bound(new_rs) if new_rs else 0),
-                    "readyReplicas": sum(bound(rs) for rs in owned),
-                },
-            }
+            return apps_deploy_doc(hub, d)
 
         ns = None
         if seg[:1] == ["namespaces"] and len(seg) >= 3:
@@ -916,6 +984,13 @@ class RestServer:
                     return h._fail(404, "NotFound",
                                    f'{kind} "{seg[1]}" not found')
                 return h._respond(200, doc(obj))
+        if (len(seg) == 3 and seg[0] == "deployments"
+                and seg[2] == "scale"):
+            d = hub.deployments.get(seg[1])
+            if d is None:
+                return h._fail(404, "NotFound",
+                               f'deployments "{seg[1]}" not found')
+            return h._respond(200, apps_scale_doc(hub, d))
         return h._fail(404, "NotFound", h.path)
 
     def _serve_list(self, h, query, kind, objs, obj_fields, obj_labels,
@@ -1051,8 +1126,110 @@ class RestServer:
 
     # -- POST ---------------------------------------------------------------
 
+    # -- apps/v1 writes ------------------------------------------------------
+
+    @staticmethod
+    def _apps_ns_route(seg):
+        """('deployments', name_or_None, sub_or_None, ns) for a
+        namespaces-prefixed apps segment list, else None."""
+        ns = "default"
+        if seg[:1] == ["namespaces"] and len(seg) >= 3:
+            ns, seg = seg[1], seg[2:]
+        if not seg or seg[0] != "deployments":
+            return None
+        return (seg[0], seg[1] if len(seg) > 1 else None,
+                seg[2] if len(seg) > 2 else None, ns)
+
+    def _deployment_from_spec(self, name: str, spec: dict):
+        """Writable-spec doc -> Deployment, with apps/v1 validation
+        surfaced as ValueError (callers answer 422 Invalid). Every field
+        that would otherwise blow up LATER inside hub.step()'s rolling
+        reconcile — a remotely-triggered async crash — is validated
+        HERE: replicas non-negative, budgets int-or-percent."""
+        from kubernetes_tpu.sim import Deployment, _int_or_percent
+
+        tmpl = spec.get("template") or {}
+        replicas = int(spec.get("replicas", 1))
+        if replicas < 0:
+            raise ValueError("spec.replicas must be non-negative")
+        for field in ("maxSurge", "maxUnavailable"):
+            v = spec.get(field, 1)
+            try:
+                if _int_or_percent(v, max(replicas, 1),
+                                   round_up=True) < 0:
+                    raise ValueError
+            except (ValueError, TypeError, AttributeError):
+                raise ValueError(
+                    f"spec.{field} must be a non-negative integer or "
+                    f"percentage string, got {v!r}")
+        return Deployment(
+            name,
+            replicas=replicas,
+            cpu_milli=float(tmpl.get("cpuMilli", 100)),
+            memory=float(tmpl.get("memory", 256 * 2**20)),
+            priority=int(tmpl.get("priority", 0)),
+            strategy=spec.get("strategy", "RollingUpdate"),
+            max_surge=spec.get("maxSurge", 1),
+            max_unavailable=spec.get("maxUnavailable", 1),
+        )
+
+    def _post_deployment(self, h, name, ns, body) -> None:
+        hub = self.hub
+        if ns != "default":
+            return h._fail(
+                422, "Invalid",
+                "controller objects live in namespace \"default\" in this "
+                "hub (module doc, restapi.py GROUPS)")
+        if not name or not _DNS_LABEL.match(name):
+            return h._fail(422, "Invalid",
+                           "deployment metadata.name must be an RFC-1123 "
+                           "DNS label")
+        if name in hub.deployments:
+            return h._fail(409, "AlreadyExists",
+                           f'deployments "{name}" already exists')
+        try:
+            d = self._deployment_from_spec(name, body.get("spec") or {})
+        except (ValueError, TypeError) as e:
+            return h._fail(422, "Invalid", str(e))
+        hub.add_deployment(d)
+        return h._respond(201, apps_deploy_doc(hub, d))
+
+    def _apply_deployment_spec(self, h, d, spec: dict) -> None:
+        """Shared PUT/PATCH tail: validate the merged writable spec via a
+        probe construction (the same __post_init__ rules a create runs),
+        then apply — replicas through the scale seam, template changes
+        through rollout() so the revision bumps exactly when the
+        reference's getNewReplicaSet would."""
+        hub = self.hub
+        try:
+            probe = self._deployment_from_spec(d.name, spec)
+        except (ValueError, TypeError) as e:
+            return h._fail(422, "Invalid", str(e))
+        d.strategy = probe.strategy
+        d.max_surge = probe.max_surge
+        d.max_unavailable = probe.max_unavailable
+        if probe.replicas != d.replicas:
+            hub.scale_deployment(d.name, probe.replicas)
+        if (probe.cpu_milli, probe.memory, probe.priority) != (
+                d.cpu_milli, d.memory, d.priority):
+            d.rollout(cpu_milli=probe.cpu_milli, memory=probe.memory,
+                      priority=probe.priority)
+        return h._respond(200, apps_deploy_doc(hub, d))
+
     def _post(self, h) -> None:
-        seg = self._route(urlparse(h.path).path)
+        url_path = urlparse(h.path).path
+        routed = self._route_group(url_path)
+        if routed is not None:
+            group, gseg = routed
+            body = self._read_body(h)
+            if body is None:
+                return
+            r = self._apps_ns_route(gseg) if group == APPS_GROUP else None
+            if r is not None and r[1] is None and r[2] is None:
+                name = (body.get("metadata") or {}).get("name", "")
+                return self._post_deployment(h, name, r[3], body)
+            return h._fail(404, "NotFound", h.path)
+        seg = self._route(url_path)
         hub = self.hub
         if not seg:
             return h._fail(404, "NotFound", h.path)
@@ -1139,7 +1316,38 @@ class RestServer:
     # -- PUT (GuaranteedUpdate CAS) -----------------------------------------
 
     def _put(self, h) -> None:
-        seg = self._route(urlparse(h.path).path)
+        url_path = urlparse(h.path).path
+        routed = self._route_group(url_path)
+        if routed is not None:
+            group, gseg = routed
+            r = self._apps_ns_route(gseg) if group == APPS_GROUP else None
+            if r is None or r[1] is None:
+                return h._fail(404, "NotFound", h.path)
+            _, name, sub, ns = r
+            d = self.hub.deployments.get(name) if ns == "default" else None
+            if d is None:
+                return h._fail(404, "NotFound",
+                               f'deployments "{name}" not found')
+            body = self._read_body(h)
+            if body is None:
+                return
+            if sub == "scale":
+                # the Scale subresource write — HPA's and kubectl
+                # scale's contract (ScaleREST.Update, storage.go:230)
+                try:
+                    replicas = int((body.get("spec") or {})["replicas"])
+                    if replicas < 0:
+                        raise ValueError
+                except (KeyError, TypeError, ValueError):
+                    return h._fail(422, "Invalid",
+                                   "scale spec.replicas must be a "
+                                   "non-negative integer")
+                self.hub.scale_deployment(name, replicas)
+                return h._respond(200, apps_scale_doc(self.hub, d))
+            if sub is not None:
+                return h._fail(404, "NotFound", h.path)
+            return self._apply_deployment_spec(h, d, body.get("spec") or {})
+        seg = self._route(url_path)
         hub = self.hub
         if not seg or len(seg) != 2 or seg[0] != "nodes":
             return h._fail(404, "NotFound", h.path)
@@ -1164,10 +1372,137 @@ class RestServer:
         return h._respond(200, _with_rv(node_to_json(node), hub,
                                         f"nodes/{node.name}"))
 
+    # -- PATCH (RFC 7386 JSON merge patch) -----------------------------------
+
+    def _patch(self, h) -> None:
+        """PatchResource (apiserver/pkg/endpoints/handlers/patch.go:59),
+        merge-patch flavor only: the declarative update verb controllers
+        and kubectl apply ride. Routes: pods (metadata/labels — identity
+        and placement stay immutable, the Binding subresource owns
+        nodeName), nodes, and apps/v1 deployments (whose spec patch can
+        scale AND roll out — template changes bump the revision exactly
+        like patching the pod template image in the reference).
+
+        A patch body carrying metadata.resourceVersion is an optimistic
+        concurrency precondition (409 on mismatch), same as PUT — for
+        pods and nodes. Deployments are controller objects the hub does
+        not individually version (their docs carry the GLOBAL revision
+        as a change indicator only), so an rv precondition there cannot
+        mean what the client intends; such a patch is rejected 400
+        explicitly rather than silently dropping the precondition."""
+        ctype = h.headers.get("Content-Type", "").split(";")[0].strip()
+        if ctype != "application/merge-patch+json":
+            return h._fail(
+                415, "UnsupportedMediaType",
+                "only application/merge-patch+json is supported "
+                "(json-patch and strategic-merge-patch are not served)")
+        hub = self.hub
+        url_path = urlparse(h.path).path
+        patch = self._read_body(h)
+        if patch is None:
+            return
+
+        def rv_precondition_ok(obj_key: str) -> bool:
+            want = (patch.get("metadata") or {}).get("resourceVersion")
+            if want is None:
+                return True
+            cur_rv = str(hub.resource_version.get(obj_key, 0))
+            if str(want) != cur_rv:
+                h._fail(409, "Conflict",
+                        f"Operation cannot be fulfilled on {obj_key}: "
+                        f"object has been modified (rv {cur_rv}, "
+                        f"submitted {want})")
+                return False
+            return True
+
+        routed = self._route_group(url_path)
+        if routed is not None:
+            group, gseg = routed
+            r = self._apps_ns_route(gseg) if group == APPS_GROUP else None
+            if r is None or r[1] is None or r[2] is not None:
+                return h._fail(404, "NotFound", h.path)
+            _, name, _, ns = r
+            d = hub.deployments.get(name) if ns == "default" else None
+            if d is None:
+                return h._fail(404, "NotFound",
+                               f'deployments "{name}" not found')
+            if (patch.get("metadata") or {}).get("resourceVersion") is not None:
+                return h._fail(
+                    400, "BadRequest",
+                    "deployments are not individually versioned; "
+                    "resourceVersion preconditions are not supported on "
+                    "this resource")
+            cur_spec = apps_deploy_doc(hub, d)["spec"]
+            merged = merge_patch(cur_spec, patch.get("spec") or {})
+            return self._apply_deployment_spec(h, d, merged)
+
+        seg = self._route(url_path)
+        if seg and len(seg) == 2 and seg[0] == "nodes":
+            cur = hub.truth_nodes.get(seg[1])
+            if cur is None:
+                return h._fail(404, "NotFound",
+                               f'nodes "{seg[1]}" not found')
+            if not rv_precondition_ok(f"nodes/{seg[1]}"):
+                return
+            merged = merge_patch(node_to_json(cur), patch)
+            try:
+                node = node_from_json(merged)
+            except Exception as e:  # type-invalid merged doc is a 422,
+                return h._fail(422, "Invalid",  # never a dropped conn
+                               f"patched node document is invalid: {e!r}")
+            if node.name != seg[1]:
+                return h._fail(422, "Invalid",
+                               "metadata.name is immutable")
+            hub._update_node(node)
+            return h._respond(200, _with_rv(node_to_json(node), hub,
+                                            f"nodes/{node.name}"))
+        if (seg and len(seg) == 4 and seg[0] == "namespaces"
+                and seg[2] == "pods"):
+            ns, name = seg[1], seg[3]
+            key = f"{ns}/{name}"
+            cur = hub.truth_pods.get(key)
+            if cur is None:
+                return h._fail(404, "NotFound", f'pods "{name}" not found')
+            if not rv_precondition_ok(f"pods/{key}"):
+                return
+            merged = merge_patch(pod_to_json(cur), patch)
+            try:
+                pod = pod_from_json(merged)
+            except Exception as e:
+                return h._fail(422, "Invalid",
+                               f"patched pod document is invalid: {e!r}")
+            pod.namespace = ns
+            if pod.name != name:
+                return h._fail(422, "Invalid", "metadata.name is immutable")
+            try:
+                hub.replace_pod(pod)
+            except ValueError as e:  # uid/nodeName mutation attempts
+                return h._fail(422, "Invalid", str(e))
+            stored = hub.truth_pods[key]
+            return h._respond(200, _with_rv(pod_to_json(stored), hub,
+                                            f"pods/{key}"))
+        return h._fail(404, "NotFound", h.path)
+
     # -- DELETE -------------------------------------------------------------
 
     def _delete(self, h) -> None:
-        seg = self._route(urlparse(h.path).path)
+        url_path = urlparse(h.path).path
+        routed = self._route_group(url_path)
+        if routed is not None:
+            group, gseg = routed
+            r = self._apps_ns_route(gseg) if group == APPS_GROUP else None
+            if r is None or r[1] is None or r[2] is not None:
+                return h._fail(404, "NotFound", h.path)
+            _, name, _, ns = r
+            if ns != "default" or name not in self.hub.deployments:
+                return h._fail(404, "NotFound",
+                               f'deployments "{name}" not found')
+            # cascading: the ownerRef GC pass collects the orphaned RSes
+            # and their pods (sim.delete_deployment docstring)
+            self.hub.delete_deployment(name)
+            return h._respond(200, status_doc(200, "", "")
+                              | {"status": "Success"})
+        seg = self._route(url_path)
         hub = self.hub
         if not seg:
             return h._fail(404, "NotFound", h.path)

@@ -759,6 +759,25 @@ class HollowCluster:
         self._commit(f"pods/{pod.key()}", "ADDED", pod)
         self._emit(f"pods/{pod.key()}", lambda: self.sched.on_pod_add(pod))
 
+    def replace_pod(self, new: "Pod") -> None:
+        """Metadata-style update of an existing pod (the PATCH/PUT seam:
+        apiserver UpdatePodStatus/label updates). Identity and placement
+        are IMMUTABLE here — name/namespace/uid/node_name changes must go
+        through delete+create or the Binding subresource; violating that
+        would bypass the CAS semantics confirm_binding enforces."""
+        key = new.key()
+        cur = self.truth_pods.get(key)
+        if cur is None:
+            raise KeyError(f"pods {key!r} not found")
+        if new.uid != cur.uid or new.node_name != cur.node_name:
+            raise ValueError(
+                "replace_pod cannot change uid or nodeName (use the "
+                "Binding subresource / delete+create)"
+            )
+        self.truth_pods[key] = new
+        self._commit(f"pods/{key}", "MODIFIED", new)
+        self._emit(f"pods/{key}", lambda: self.sched.on_pod_update(cur, new))
+
     def delete_pod(self, key: str) -> None:
         pod = self.truth_pods.pop(key, None)
         if pod is not None:

@@ -701,6 +701,10 @@ def test_discovery_and_openapi_surface():
 
         hub.add_deployment(Deployment("d0", replicas=1))
         hub.add_replicaset(ReplicaSet("d0", replicas=0))
+        # a pod fixture for the item-routed PATCH op (empty merge patch
+        # must answer 200 against an existing object)
+        req(port, "POST", "/api/v1/namespaces/default/pods",
+            make_pod_doc("d0"))
 
         code, doc = req(port, "GET", "/api")
         assert code == 200 and doc["kind"] == "APIVersions"
@@ -751,7 +755,8 @@ def test_discovery_and_openapi_surface():
                 assert r.status == 200, path
                 continue
             body = None
-            want = {"get": (200,), "put": (200,), "delete": (200,)}[
+            want = {"get": (200,), "put": (200,), "delete": (200,),
+                    "patch": (200,)}[
                 method] if method != "post" else (201,)
             if method == "post":
                 if path.endswith("/binding"):
@@ -764,16 +769,40 @@ def test_discovery_and_openapi_surface():
                 elif path.endswith("/namespaces"):
                     body = {"metadata": {"name": "d0"}}
                     want = (201, 409)  # fixture namespace exists
+                elif path.endswith("/deployments"):
+                    body = {"metadata": {"name": "d0"}, "spec": {}}
+                    want = (201, 409)  # fixture deployment exists
                 else:
                     body = make_pod_doc("new1")
             if method == "put":
-                _, body = req(port, "GET", "/api/v1/nodes/n0")
+                if "/apis/apps/" in path:
+                    body = {"spec": {"replicas": 1}}
+                else:
+                    _, body = req(port, "GET", "/api/v1/nodes/n0")
+            if method == "patch":
+                # an empty merge patch is the no-op probe: 200 against
+                # every patchable published route
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=10)
+                conn.request("PATCH", path, "{}",
+                             {"Content-Type":
+                              "application/merge-patch+json"})
+                r = conn.getresponse()
+                data = r.read()
+                conn.close()
+                assert r.status == 200, (method, path, r.status, data)
+                continue
             code, doc = req(port, method.upper(), path, body)
             assert code in want, (method, path, code, doc)
             if method == "delete" or path.endswith("/eviction"):
                 # restore the fixture the op consumed
                 if "/nodes" in path:
                     req(port, "POST", "/api/v1/nodes", NODE)
+                elif "/deployments" in path:
+                    req(port, "POST",
+                        "/apis/apps/v1/namespaces/default/deployments",
+                        {"metadata": {"name": "d0"},
+                         "spec": {"replicas": 1}})
                 else:
                     req(port, "POST", "/api/v1/namespaces/default/pods",
                         make_pod_doc("d0"))
