@@ -1131,10 +1131,13 @@ class RestServer:
     @staticmethod
     def _apps_ns_route(seg):
         """('deployments', name_or_None, sub_or_None, ns) for a
-        namespaces-prefixed apps segment list, else None."""
-        ns = "default"
-        if seg[:1] == ["namespaces"] and len(seg) >= 3:
-            ns, seg = seg[1], seg[2:]
+        namespaces-prefixed apps segment list, else None. Writes REQUIRE
+        the namespaced form — the cluster-scoped spelling
+        (/apis/apps/v1/deployments/NAME) is not a published write route
+        and must 404, not silently mutate the default namespace."""
+        if seg[:1] != ["namespaces"] or len(seg) < 3:
+            return None
+        ns, seg = seg[1], seg[2:]
         if not seg or seg[0] != "deployments":
             return None
         return (seg[0], seg[1] if len(seg) > 1 else None,
@@ -1465,19 +1468,39 @@ class RestServer:
                 return h._fail(404, "NotFound", f'pods "{name}" not found')
             if not rv_precondition_ok(f"pods/{key}"):
                 return
-            merged = merge_patch(pod_to_json(cur), patch)
-            try:
-                pod = pod_from_json(merged)
-            except Exception as e:
-                return h._fail(422, "Invalid",
-                               f"patched pod document is invalid: {e!r}")
-            pod.namespace = ns
-            if pod.name != name:
+            # Pod PATCH is scoped to METADATA on this facade. The wire
+            # doc is a PARTIAL projection of the truth pod (tolerations,
+            # affinity, volumes, limits... are not all serialized), so
+            # rebuilding the pod from the merged doc would silently zero
+            # every non-wire field on a pure label patch; and a spec
+            # patch would bypass the quota/priority admission that
+            # guards create. Spec/status mutations therefore answer 422
+            # (the Binding subresource owns placement; delete+create is
+            # the spec-change path), and the stored pod is built by
+            # replacing ONLY metadata on the current truth object.
+            cur_doc = pod_to_json(cur)
+            merged = merge_patch(cur_doc, patch)
+            if (merged.get("spec") != cur_doc.get("spec")
+                    or merged.get("status") != cur_doc.get("status")):
+                return h._fail(
+                    422, "Invalid",
+                    "pod PATCH is limited to metadata on this facade "
+                    "(placement belongs to the Binding subresource; "
+                    "spec changes go through delete+create so admission "
+                    "re-runs)")
+            meta = merged.get("metadata") or {}
+            if meta.get("name") != name:
                 return h._fail(422, "Invalid", "metadata.name is immutable")
-            try:
-                hub.replace_pod(pod)
-            except ValueError as e:  # uid/nodeName mutation attempts
-                return h._fail(422, "Invalid", str(e))
+            if meta.get("namespace", ns) != ns:
+                return h._fail(422, "Invalid",
+                               "metadata.namespace is immutable")
+            if meta.get("uid", cur.uid) != cur.uid:
+                return h._fail(422, "Invalid", "metadata.uid is immutable")
+            import dataclasses
+
+            new = dataclasses.replace(
+                cur, labels=dict(meta.get("labels") or {}))
+            hub.replace_pod(new)
             stored = hub.truth_pods[key]
             return h._respond(200, _with_rv(pod_to_json(stored), hub,
                                             f"pods/{key}"))

@@ -35,14 +35,41 @@ if os.environ.get("JAX_PLATFORMS", "") == "cpu":
 
 
 def main():
-    from test_sinkhorn import run_tied_preferences_comparison
+    import numpy as np
+
+    from test_sinkhorn import (
+        run_tied_preferences_comparison,
+        tied_preferences_workload,
+    )
 
     sizes = dict(n_hot=8, n_cold=56, n_steep=32, n_flat=224)
     results = run_tied_preferences_comparison(**sizes)
+
+    # DEFAULT config (r5 auto-router, no flag): must match the plan
+    from kubernetes_tpu.ops.arrays import (
+        nodes_to_device,
+        pods_to_device,
+        selectors_to_device,
+    )
+    from kubernetes_tpu.ops.assign import batch_assign
+    from kubernetes_tpu.snapshot import SnapshotPacker
+
+    nodes, pods, points = tied_preferences_workload(**sizes)
+    pk = SnapshotPacker()
+    for p in pods:
+        pk.intern_pod(p)
+    a, _, _ = batch_assign(pods_to_device(pk.pack_pods(pods)),
+                           nodes_to_device(pk.pack_nodes(nodes, [])),
+                           selectors_to_device(pk.pack_selector_tables()),
+                           per_node_cap=2)
+    default_points = points(np.asarray(a)[:len(pods)])
+
     out = {
         "workload": sizes,
         "argmax_points": results[False],
         "sinkhorn_points": results[True],
+        "default_config_points": default_points,
+        "auto_router_engaged": default_points == results[True],
         "verdict": ("sinkhorn_wins" if results[True] > results[False]
                     else ("identical" if results[True] == results[False]
                           else "argmax_wins")),

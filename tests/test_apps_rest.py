@@ -252,3 +252,66 @@ def test_write_path_validation_rejects_crash_vectors():
         assert code == 422
     finally:
         srv.close()
+
+
+def test_pod_patch_preserves_non_wire_fields_and_scopes_to_metadata():
+    """Review findings r5 (pod PATCH): a pure label patch must not
+    disturb fields the wire doc doesn't carry (tolerations, queue
+    position, ...), spec/status mutations are 422 (quota admission
+    would be bypassed), metadata.namespace is immutable like name, and
+    the cluster-scoped apps write spelling 404s."""
+    from kubernetes_tpu.api.types import Toleration
+    from kubernetes_tpu.testing import make_pod
+
+    hub, srv, port = cluster()
+    try:
+        p = make_pod("tolerant", cpu_milli=100)
+        import dataclasses
+
+        p = dataclasses.replace(
+            p, tolerations=(Toleration(key="k", operator="Exists",
+                                       effect="NoExecute",
+                                       toleration_seconds=300),),
+            queued_at=42.0)
+        hub.create_pod(p)
+        before = hub.truth_pods["default/tolerant"]
+
+        code, doc = patch_req(
+            port, "/api/v1/namespaces/default/pods/tolerant",
+            {"metadata": {"labels": {"patched": "yes"}}})
+        assert code == 200
+        after = hub.truth_pods["default/tolerant"]
+        assert after.labels == {"patched": "yes"}
+        assert after.tolerations == before.tolerations  # NOT zeroed
+        assert after.queued_at == 42.0
+        assert after.uid == before.uid
+
+        # spec mutation through PATCH is rejected (not silently applied
+        # sans admission)
+        code, doc = patch_req(
+            port, "/api/v1/namespaces/default/pods/tolerant",
+            {"spec": {"containers": [{"name": "main", "resources":
+                                      {"requests": {"cpu": "64000m"}}}]}})
+        assert code == 422 and "admission" in doc["message"]
+        assert hub.truth_pods["default/tolerant"].requests.cpu_milli == 100
+
+        code, doc = patch_req(
+            port, "/api/v1/namespaces/default/pods/tolerant",
+            {"metadata": {"namespace": "other"}})
+        assert code == 422 and "namespace" in doc["message"]
+        code, doc = patch_req(
+            port, "/api/v1/namespaces/default/pods/tolerant",
+            {"metadata": {"uid": "forged"}})
+        assert code == 422 and "uid" in doc["message"]
+
+        # cluster-scoped write spellings are unpublished -> 404
+        req(port, "POST", "/apis/apps/v1/namespaces/default/deployments",
+            DEPLOY)
+        code, _ = req(port, "DELETE", "/apis/apps/v1/deployments/web")
+        assert code == 404
+        assert "web" in hub.deployments  # untouched
+        code, _ = req(port, "PUT", "/apis/apps/v1/deployments/web/scale",
+                      {"spec": {"replicas": 1}})
+        assert code == 404
+    finally:
+        srv.close()

@@ -258,8 +258,12 @@ def run_tied_preferences_comparison(**sizes):
     ds = selectors_to_device(pk.pack_selector_tables())
     results = {}
     for flag in (False, True):
+        # auto_sinkhorn OFF: this comparison characterizes pure argmax
+        # vs the plan (the r5 auto-router would route the False arm to
+        # the plan too — that equality is pinned by its own test)
         assigned, _, _ = batch_assign(dp, dn, ds, per_node_cap=2,
-                                      use_sinkhorn=flag)
+                                      use_sinkhorn=flag,
+                                      auto_sinkhorn=False)
         a = np.asarray(assigned)[:len(pods)]
         assert int((a >= 0).sum()) == len(pods)
         results[flag] = points(a)
@@ -273,3 +277,98 @@ def test_plan_beats_argmax_on_tied_preferences():
     near-equal cold columns — strictly better placement quality."""
     results = run_tied_preferences_comparison()
     assert results[True] > results[False], results
+
+
+def test_auto_routing_fires_on_tied_contention_by_default():
+    """VERDICT r4 item 5: the tied-preferences win must materialize
+    under DEFAULT config — no solver flag. The auto-router detects the
+    tie-contention cohort (pre-window, so queued tail populations
+    count) and routes the batch to the transport plan: default ==
+    forced-plan quality, strictly above the argmax-only path."""
+    from kubernetes_tpu.ops.arrays import (
+        nodes_to_device,
+        pods_to_device,
+        selectors_to_device,
+    )
+    from kubernetes_tpu.ops.assign import batch_assign
+    from kubernetes_tpu.snapshot import SnapshotPacker
+
+    nodes, pods, points = tied_preferences_workload()
+    pk = SnapshotPacker()
+    for p in pods:
+        pk.intern_pod(p)
+    dn = nodes_to_device(pk.pack_nodes(nodes, []))
+    dp = pods_to_device(pk.pack_pods(pods))
+    ds = selectors_to_device(pk.pack_selector_tables())
+    res = {}
+    for label, kw in (("default", {}),
+                      ("argmax_only", {"auto_sinkhorn": False}),
+                      ("forced_plan", {"use_sinkhorn": True})):
+        a, _, _ = batch_assign(dp, dn, ds, per_node_cap=2, **kw)
+        res[label] = points(np.asarray(a)[:len(pods)])
+    assert res["default"] == res["forced_plan"], res
+    assert res["default"] > res["argmax_only"], res
+
+
+def test_auto_routing_stays_on_argmax_for_plain_workloads():
+    """The router must NOT fire without the full win signature: a
+    uniform batch (everything ties everywhere -> no runner-up
+    asymmetry) and a margin-ordered batch (unique bests -> no tie
+    cohort) must produce placements IDENTICAL to the forced-argmax
+    path."""
+    from bench import build_variant
+    from kubernetes_tpu.ops.assign import batch_assign
+
+    # uniform: the headline base shape in miniature
+    w = build_variant("base", 40, 20, 128)
+    dp, dv = w.device_batch(w.pending[:128], 128)
+    a_auto, u_auto, r_auto = batch_assign(dp, w.dn, w.ds, vol=dv,
+                                          per_node_cap=2)
+    a_arg, u_arg, r_arg = batch_assign(dp, w.dn, w.ds, vol=dv,
+                                       per_node_cap=2,
+                                       auto_sinkhorn=False)
+    assert (np.asarray(a_auto) == np.asarray(a_arg)).all()
+    assert int(r_auto) == int(r_arg)
+
+    # margin-ordered: steep strictly outscores flat on the hot zone
+    # (unique bests -> tc0 == 1 everywhere -> empty cohort)
+    nodes, pods, _ = tied_preferences_workload()
+    from dataclasses import replace as dc_replace
+
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        NodeSelectorTerm,
+        PreferredSchedulingTerm,
+        Requirement,
+    )
+
+    ZONE = "failure-domain.beta.kubernetes.io/zone"
+    margin_pods = []
+    for p in pods:
+        if p.name.startswith("flat"):
+            # flat now PREFERS cold outright: no tie with steep on hot
+            aff = Affinity(node_preferred=(
+                PreferredSchedulingTerm(
+                    weight=10,
+                    preference=NodeSelectorTerm(
+                        (Requirement(ZONE, "In", ("cold",)),))),))
+            margin_pods.append(dc_replace(p, affinity=aff))
+        else:
+            margin_pods.append(p)
+    from kubernetes_tpu.ops.arrays import (
+        nodes_to_device,
+        pods_to_device,
+        selectors_to_device,
+    )
+    from kubernetes_tpu.snapshot import SnapshotPacker
+
+    pk = SnapshotPacker()
+    for p in margin_pods:
+        pk.intern_pod(p)
+    dn = nodes_to_device(pk.pack_nodes(nodes, []))
+    dp = pods_to_device(pk.pack_pods(margin_pods))
+    ds = selectors_to_device(pk.pack_selector_tables())
+    a_auto, _, _ = batch_assign(dp, dn, ds, per_node_cap=2)
+    a_arg, _, _ = batch_assign(dp, dn, ds, per_node_cap=2,
+                               auto_sinkhorn=False)
+    assert (np.asarray(a_auto) == np.asarray(a_arg)).all()
