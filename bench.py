@@ -593,6 +593,7 @@ def build_variant(name: str, n_nodes: int, n_existing: int, n_pending: int):
         make_pod_affinity_pods,
         make_pods,
         make_pv_pods,
+        make_secret_pods,
         make_spread_constraint_pods,
         make_spread_pods,
     )
@@ -612,6 +613,11 @@ def build_variant(name: str, n_nodes: int, n_existing: int, n_pending: int):
         pending = make_spread_pods(n_pending, n_services=max(8, n_pending // 100))
     elif name == "even_spread":
         pending = make_spread_constraint_pods(n_pending, hard=False)
+    elif name == "secrets":
+        # BenchmarkSchedulingSecrets (scheduler_bench_test.go:97): the
+        # per-pod volume fan-in variant — volumes present, no volume
+        # predicate does work
+        pending = make_secret_pods(n_pending)
     elif name == "pv_intree":
         pending, pvcs, pvs = make_pv_pods(n_pending, kind="gce-pd")
     elif name == "pv_csi":
@@ -624,6 +630,7 @@ def build_variant(name: str, n_nodes: int, n_existing: int, n_pending: int):
 
 
 VARIANTS = (
+    "secrets",
     "pod_anti_affinity",
     "pod_affinity",
     "node_affinity",

@@ -227,6 +227,28 @@ def make_pod_affinity_pods(
     return out
 
 
+def make_secret_pods(
+    n: int,
+    name_prefix: str = "secret-pod",
+) -> List[Pod]:
+    """BenchmarkSchedulingSecrets analog (scheduler_bench_test.go:97):
+    base pods whose spec.volumes carry a Secret — a volume that needs NO
+    scheduling predicate handling (resolve_pod_volumes classifies the
+    kind as neither conflict- nor limit-checked), so the variant
+    measures the per-pod volume FAN-IN cost (volume tables packed and
+    the volume kernels invoked per batch) against the base workload."""
+    from kubernetes_tpu.api.types import PodVolume
+
+    out = []
+    for i in range(n):
+        p = base_pod(f"{name_prefix}-{i}")
+        # the reference's strategy mounts one shared secret named
+        # "secret" in every pod
+        p.volumes = (PodVolume(kind="secret", handle="secret"),)
+        out.append(p)
+    return out
+
+
 def make_pv_pods(
     n: int,
     kind: str = "gce-pd",
