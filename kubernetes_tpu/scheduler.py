@@ -387,6 +387,17 @@ class Scheduler:
         self.cache.invalidate_snapshot()
         self.queue.move_all_to_active()
 
+    def set_attached_residue(self, residue) -> None:
+        """Actual-state feed from the attach-detach controller
+        (attach_detach_controller.go:102): per-node PV names attached
+        WITHOUT a live pod deriving them (detach-grace stragglers). They
+        occupy attach-limit slots, so the snapshot is invalidated and —
+        since a detach can free a slot a pending pod was waiting on —
+        unschedulables resweep like any volume-state change."""
+        self.cache.packer.attached_residue = dict(residue)
+        self.cache.invalidate_snapshot()
+        self.queue.move_all_to_active()
+
     # -- the cycle ---------------------------------------------------------
 
     def schedule_cycle(self) -> CycleResult:

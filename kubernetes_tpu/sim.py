@@ -137,6 +137,19 @@ class ReplicaSet:
 
 
 @dataclass
+class Attachment:
+    """Attach-detach controller actual-state record
+    (volume/attachdetach/cache/actual_state_of_world.go): one volume
+    attached to one node; ``detaching`` + ``detach_at`` model the
+    grace window before the reconciler issues the detach."""
+
+    volume: str
+    node: str
+    state: str = "attached"  # "attached" | "detaching"
+    detach_at: float = 0.0
+
+
+@dataclass
 class Deployment:
     """Hollow deployment controller (pkg/controller/deployment): one
     ReplicaSet per template revision. A template change (:meth:`rollout`)
@@ -516,6 +529,18 @@ class HollowCluster:
         self.pvcs: Dict[str, object] = {}
         self.pvs: Dict[str, object] = {}
         self.storage_classes: Dict[str, object] = {}
+        #: attach-detach controller actual state (attach_detach_
+        #: controller.go:102): volume identity -> Attachment. All
+        #: attachable volumes are treated single-attach (the PV model
+        #: carries no access modes; RWO is the conservative reading).
+        self.attachments: Dict[str, Attachment] = {}
+        #: detach grace: how long a no-longer-needed volume stays
+        #: attached before the reconciler detaches it (the
+        #: maxWaitForUnmount/timer analog, reconciler.go)
+        self.detach_grace_s: float = 30.0
+        self.attaches_total = 0
+        self.detaches_total = 0
+        self._last_residue: Dict[str, tuple] = {}
         #: hollow prober targets: pod key -> app health (default True);
         #: the fake runtime's answer to readiness probes
         self.app_health: Dict[str, bool] = {}
@@ -855,6 +880,7 @@ class HollowCluster:
         "quotas", "ip_alloc", "events_v1",
         "heartbeats", "dead_kubelets", "_taint_time",
         "_bound_at", "_started_at", "app_health",
+        "attachments",
     )
 
     def _semantic_config(self) -> dict:
@@ -871,6 +897,10 @@ class HollowCluster:
             "event_delay_ticks": self.event_delay_ticks,
             "competing_bind_rate": self.competing_bind_rate,
             "scheduler_kw": self._scheduler_kw_sig,
+            # detach_at timestamps inside checkpointed attachments are
+            # absolute and derived from this knob — a mismatched restore
+            # would silently change grace semantics mid-window
+            "detach_grace_s": self.detach_grace_s,
         }
 
     def save_checkpoint(self, path: str) -> dict:
@@ -985,6 +1015,11 @@ class HollowCluster:
             self._history.clear()
             self.clock.t = state["clock_t"]
             for attr in self._CHECKPOINT_ATTRS:
+                if attr not in state:
+                    # checkpoint predates this attr (same format tag):
+                    # keep the fresh hub's empty default instead of a
+                    # raw KeyError on a previously-valid file
+                    continue
                 cur = getattr(self, attr)
                 new = state[attr]
                 # the admission chain captured the namespaces/priority-
@@ -1103,6 +1138,128 @@ class HollowCluster:
         self._commit(f"persistentvolumes/{pv.name}", "MODIFIED", pv)
         self._commit(f"persistentvolumeclaims/{pvc.namespace}/{pvc.name}",
                      "MODIFIED", pvc)
+
+    def _desired_attachments(self) -> Dict[str, set]:
+        """Desired state: volume identity -> set of nodes with bound pods
+        whose volumes resolve to an attachable backend (in-tree PD kinds
+        or CSI) — the desired_state_of_world populator
+        (attach_detach_controller.go podAdd/Update -> desiredStateOfWorld).
+        A SET, not last-writer-wins: several live claimants of one PV on
+        different nodes are a real state the reconciler must refuse to
+        flap on (keep the existing attachment, never steal it). Inline
+        attachable volumes count too (identity "inline:kind:handle");
+        PVC-backed ones use the PV name so residue can re-resolve."""
+        from kubernetes_tpu.volumes import (
+            PD_FILTER_INDEX,
+            attachable_tokens,
+        )
+
+        want: Dict[str, set] = {}
+        for p in self.truth_pods.values():
+            if not p.node_name or not p.volumes:
+                continue
+            for v in p.volumes:
+                if v.pvc:
+                    pvc = self.pvcs.get(f"{p.namespace}/{v.pvc}")
+                    pv = (self.pvs.get(pvc.volume_name)
+                          if pvc is not None and pvc.volume_name else None)
+                    if pv is None:
+                        continue  # unbound/missing: nothing to attach yet
+                    if attachable_tokens(pv):
+                        want.setdefault(pv.name, set()).add(p.node_name)
+                elif v.kind in PD_FILTER_INDEX:
+                    want.setdefault(f"inline:{v.kind}:{v.handle}",
+                                    set()).add(p.node_name)
+        return want
+
+    def reconcile_attachments(self) -> None:
+        """The attach-detach reconciler (reconciler/reconciler.go):
+        converge actual attachments toward desired.
+
+        - attach when desired and unattached;
+        - a volume desired on a NEW node while still attached elsewhere
+          waits for the old attachment to detach first (the single-
+          attach / multi-attach guard — the reference refuses to attach
+          an RWO volume to a second node and surfaces FailedAttachVolume
+          until the detach completes);
+        - a no-longer-desired attachment enters ``detaching`` and is
+          removed only after ``detach_grace_s`` (maxWaitForUnmount
+          analog) — during the grace it still occupies an attach-limit
+          slot, which the scheduler sees via the residue feed;
+        - a volume that becomes desired again mid-grace on the SAME node
+          re-attaches in place (the reconciler cancels the detach).
+        """
+        want = self._desired_attachments()
+        t = self.clock.t
+        # expiry/detach FIRST: a grace window that ends this pass frees
+        # the volume for the attach loop below (one-pass convergence;
+        # attach-after-expiry ordering also keeps the oracle honest)
+        for vol, rec in list(self.attachments.items()):
+            desired_here = rec.node in want.get(vol, ())
+            if not desired_here:
+                if rec.state == "attached":
+                    rec.state = "detaching"
+                    rec.detach_at = t + self.detach_grace_s
+                elif t >= rec.detach_at:
+                    del self.attachments[vol]
+                    self.detaches_total += 1
+        for vol, nodes in want.items():
+            rec = self.attachments.get(vol)
+            if rec is None:
+                # deterministic choice among claimant nodes (several
+                # claimants on one unattached volume: lowest name wins,
+                # the rest wait on the multi-attach guard)
+                self.attachments[vol] = Attachment(volume=vol,
+                                                   node=min(nodes),
+                                                   state="attached")
+                self.attaches_total += 1
+            elif rec.node in nodes:
+                if rec.state == "detaching":
+                    rec.state = "attached"  # needed again: cancel detach
+                    rec.detach_at = 0.0
+            # rec.node not in nodes: multi-attach guard — the existing
+            # attachment is never stolen; it detaches via the loop above
+            # (not desired there) and a later pass attaches the claimant
+        # residue = attachments the scheduler cannot derive from live
+        # bound pods; push only on change (each push invalidates the
+        # snapshot and resweeps unschedulables)
+        residue: Dict[str, tuple] = {}
+        for vol, rec in self.attachments.items():
+            if (rec.node not in want.get(vol, ())
+                    and not vol.startswith("inline:")):
+                residue[rec.node] = residue.get(rec.node, ()) + (vol,)
+        if residue != self._last_residue:
+            self._last_residue = residue
+            self.sched.set_attached_residue(residue)
+
+    def check_attachment_invariants(self) -> None:
+        """Fuzz oracle: (a) single-attach — by construction one record
+        per volume, asserted against desired duplication; (b) every
+        bound pod's attachable volumes are attached to ITS node unless
+        blocked by a grace-period detach elsewhere (the multi-attach
+        wait); (c) no attachment without a desiring pod outlives the
+        grace window.
+
+        Converge-then-check (check_consistency's settle analog): binds
+        land at the END of a step, after that step's reconcile pass, so
+        the reconciler runs once more here — the invariants are about
+        the CONVERGED reconciler, not its one-tick lag."""
+        self.reconcile_attachments()
+        want = self._desired_attachments()
+        t = self.clock.t
+        for vol, nodes in want.items():
+            rec = self.attachments.get(vol)
+            assert rec is not None, f"desired volume {vol} never attached"
+            if rec.node not in nodes:
+                assert rec.state == "detaching", (
+                    f"{vol} attached to {rec.node} but desired on {nodes} "
+                    "without a pending detach (multi-attach guard broken)")
+        for vol, rec in self.attachments.items():
+            if rec.node not in want.get(vol, ()):
+                assert rec.state == "detaching", (
+                    f"stale attachment {vol}@{rec.node} not detaching")
+                assert rec.detach_at <= t + self.detach_grace_s + 1e-6, (
+                    f"{vol} grace window exceeds detach_grace_s")
 
     def reconcile_volumes(self) -> None:
         """The persistent-volume binder controller pass
@@ -1869,6 +2026,12 @@ class HollowCluster:
         self.gc_owner_graph()
         if self.pvcs or self.pvs:
             self.reconcile_volumes()
+        if (self.pvs or self.attachments
+                or any(p.volumes for p in self.truth_pods.values())):
+            # the any() covers INLINE attachable volumes (no PV objects
+            # in the cluster) — without it that half of the controller
+            # would never run
+            self.reconcile_attachments()
         if self.services or self.endpoints:
             self.endpoints_controller.reconcile()
             self.sync_proxies()

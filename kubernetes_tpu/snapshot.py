@@ -743,6 +743,14 @@ class SnapshotPacker:
         # per-pod resource vectors (R-dependent; recomputed when the scalar
         # universe grows) feeding the native usage aggregation
         self._vec_cache: Dict[tuple, Tuple[int, np.ndarray, np.ndarray]] = {}
+        #: node name -> PV names attached there WITHOUT a live bound pod
+        #: using them (the attach-detach controller's actual-state
+        #: residue: detach-grace stragglers). These occupy attach-limit
+        #: slots, so the volume-count predicates must see them even
+        #: though no pod's volumes derive them (attach_detach_controller
+        #: .go:102 — actual state feeds the scheduler via node.status
+        #: volumesAttached in the reference).
+        self.attached_residue: Dict[str, Tuple[str, ...]] = {}
 
     # -- volume state ------------------------------------------------------
 
@@ -910,6 +918,22 @@ class SnapshotPacker:
             self.intern_pod(p)
         for nd in nodes:
             self._intern_node_topo_pairs(nd)
+        if self.attached_residue:
+            # residue tokens must exist in the universes BEFORE widths()
+            # sizes the arrays (lookup returns -1 for unknown tokens)
+            from kubernetes_tpu.volumes import attachable_tokens
+
+            for pv_names in self.attached_residue.values():
+                for pv_name in pv_names:
+                    pv = self.vol_state.pv(pv_name)
+                    if pv is None:
+                        continue
+                    for kind, a, b in attachable_tokens(pv):
+                        if kind == "pd":
+                            u.pd_volumes.intern((a, b))
+                        else:
+                            u.csi_volumes.intern(
+                                (u.csi_drivers.intern(a), b))
         w = self.widths()
         n = len(nodes)
         R = w["R"]
@@ -1059,6 +1083,24 @@ class SnapshotPacker:
                 for driver, handle in rv.csi:
                     d = u.csi_drivers.lookup(driver)
                     csi_mh[i, u.csi_volumes.lookup((d, handle))] = 1
+
+        # attach-controller residue: volumes still attached (detach
+        # grace) with no live pod deriving them — they hold real
+        # attach-limit slots on the node until the controller detaches
+        if self.attached_residue:
+            from kubernetes_tpu.volumes import attachable_tokens
+
+            for i, node in enumerate(nodes):
+                for pv_name in self.attached_residue.get(node.name, ()):
+                    pv = self.vol_state.pv(pv_name)
+                    if pv is None:
+                        continue  # PV deleted mid-grace: slot freed
+                    for kind, a, b in attachable_tokens(pv):
+                        if kind == "pd":
+                            pd_mh[i, u.pd_volumes.lookup((a, b))] = 1
+                        else:
+                            d = u.csi_drivers.lookup(a)
+                            csi_mh[i, u.csi_volumes.lookup((d, b))] = 1
 
         return NodeTable(
             n=n,

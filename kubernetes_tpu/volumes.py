@@ -276,11 +276,11 @@ def resolve_pod_volumes(pod: Pod, state: VolumeState) -> ResolvedVolumes:
             tok = f"pvc:{pod.namespace}/{v.pvc}"
             out.pd.extend((i, tok) for i in range(N_PD_FILTERS))
             continue
-        fi = PD_FILTER_INDEX.get(pv.kind)
-        if fi is not None:
-            out.pd.append((fi, "h:" + pv.handle))
-        if pv.kind == VOL_CSI and pv.driver:
-            out.csi.append((pv.driver, pv.handle))
+        for akind, a, b in attachable_tokens(pv):
+            if akind == "pd":
+                out.pd.append((a, b))
+            else:
+                out.csi.append((a, b))
         for k in (LABEL_ZONE, LABEL_REGION):
             val = pv.labels.get(k)
             if val:
@@ -292,6 +292,22 @@ def resolve_pod_volumes(pod: Pod, state: VolumeState) -> ResolvedVolumes:
     # dedup count tokens (filterVolumes collects into a set)
     out.pd = sorted(set(out.pd))
     out.csi = sorted(set(out.csi))
+    return out
+
+
+def attachable_tokens(pv) -> list:
+    """The ONE PV -> attach-token classification (shared by
+    resolve_pod_volumes' bound-claim branch, the snapshot packer's
+    residue columns, and the attach-detach controller's desired-state
+    scan — three consumers that must never skew): a list of
+    ``("pd", filter_index, "h:"+handle)`` and/or
+    ``("csi", driver, handle)`` entries; empty = not attachable."""
+    out = []
+    fi = PD_FILTER_INDEX.get(pv.kind)
+    if fi is not None:
+        out.append(("pd", fi, "h:" + pv.handle))
+    if pv.kind == VOL_CSI and pv.driver:
+        out.append(("csi", pv.driver, pv.handle))
     return out
 
 

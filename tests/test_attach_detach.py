@@ -1,0 +1,227 @@
+"""Attach-detach controller (VERDICT r4 item 7) — the
+pkg/controller/volume/attachdetach analog: volumes attach when a pod
+binds, detach after a grace window when no pod needs them, the
+single-attach (multi-attach) guard holds, and — the scheduling-visible
+half — grace-period stragglers occupy REAL attach-limit slots through
+the scheduler's residue feed, so the CSI volume-limit predicate reads
+live attach state, not just live pods."""
+
+import dataclasses
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PodVolume,
+    Resources,
+    StorageClass,
+)
+from kubernetes_tpu.sim import HollowCluster
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def hub_with_nodes(n=2, seed=31, **node_scalars):
+    hub = HollowCluster(seed=seed, scheduler_kw={"enable_preemption": False})
+    for i in range(n):
+        nd = make_node(f"n{i}", cpu_milli=8000, pods=60)
+        for k, v in node_scalars.items():
+            nd.allocatable.scalars[k] = v
+        hub.add_node(nd)
+    return hub
+
+
+def add_bound_pv(hub, name, kind="gce-pd", driver="", sc="standard"):
+    hub.add_storage_class(StorageClass(sc))
+    hub.add_pv(PersistentVolume(name, kind=kind, handle=f"h-{name}",
+                                driver=driver, storage_class=sc))
+    hub.add_pvc(PersistentVolumeClaim(f"c-{name}", storage_class=sc))
+    return f"c-{name}"
+
+
+def settle(hub, ticks, dt=15.0):
+    for _ in range(ticks):
+        hub.step(dt=dt)
+
+
+def test_attach_on_bind_detach_after_grace():
+    hub = hub_with_nodes()
+    claim = add_bound_pv(hub, "pv0")
+    pod = make_pod("user0", cpu_milli=100)
+    pod = dataclasses.replace(pod, volumes=(PodVolume(pvc=claim),))
+    hub.create_pod(pod)
+    settle(hub, 3)
+    assert "pv0" in hub.attachments
+    rec = hub.attachments["pv0"]
+    node = hub.truth_pods["default/user0"].node_name
+    assert rec.state == "attached" and rec.node == node
+    assert hub.attaches_total == 1
+    hub.check_attachment_invariants()
+
+    # delete the pod: the attachment enters the grace window and is
+    # VISIBLE to the scheduler as residue, then detaches after grace
+    hub.delete_pod("default/user0")
+    hub.step(dt=1.0)
+    rec = hub.attachments["pv0"]
+    assert rec.state == "detaching"
+    assert hub.sched.cache.packer.attached_residue.get(node) == ("pv0",)
+    hub.check_attachment_invariants()
+    settle(hub, 4, dt=15.0)  # grace (30s) expires
+    assert "pv0" not in hub.attachments
+    assert hub.detaches_total == 1
+    assert not hub.sched.cache.packer.attached_residue
+    hub.check_consistency()
+
+
+def test_multi_attach_guard_waits_for_detach():
+    hub = hub_with_nodes()
+    claim = add_bound_pv(hub, "pv0")
+    p0 = dataclasses.replace(
+        make_pod("first", cpu_milli=100),
+        volumes=(PodVolume(pvc=claim),),
+        node_selector={"kubernetes.io/hostname": "n0"})
+    hub.create_pod(p0)
+    settle(hub, 3)
+    assert hub.attachments["pv0"].node == "n0"
+    hub.delete_pod("default/first")
+    hub.step(dt=1.0)  # detaching, grace running
+
+    # a second claimant on the OTHER node: must WAIT for the detach
+    p1 = dataclasses.replace(
+        make_pod("second", cpu_milli=100),
+        volumes=(PodVolume(pvc=claim),),
+        node_selector={"kubernetes.io/hostname": "n1"})
+    hub.create_pod(p1)
+    hub.step(dt=1.0)
+    rec = hub.attachments["pv0"]
+    if hub.truth_pods["default/second"].node_name:  # already scheduled
+        # desired on n1 while still attached to n0: guard holds
+        assert rec.node == "n0" and rec.state == "detaching"
+    hub.check_attachment_invariants()
+    settle(hub, 5, dt=15.0)  # grace expires -> detach -> re-attach on n1
+    rec = hub.attachments["pv0"]
+    assert rec.node == "n1" and rec.state == "attached"
+    assert hub.detaches_total >= 1 and hub.attaches_total >= 2
+    hub.check_attachment_invariants()
+
+
+def test_reattach_cancels_detach_on_same_node():
+    hub = hub_with_nodes(n=1)
+    claim = add_bound_pv(hub, "pv0")
+    p0 = dataclasses.replace(make_pod("a0", cpu_milli=100),
+                             volumes=(PodVolume(pvc=claim),))
+    hub.create_pod(p0)
+    settle(hub, 3)
+    hub.delete_pod("default/a0")
+    hub.step(dt=1.0)
+    assert hub.attachments["pv0"].state == "detaching"
+    # a new claimant lands on the same (only) node mid-grace
+    p1 = dataclasses.replace(make_pod("a1", cpu_milli=100),
+                             volumes=(PodVolume(pvc=claim),))
+    hub.create_pod(p1)
+    settle(hub, 2, dt=1.0)
+    rec = hub.attachments["pv0"]
+    assert rec.state == "attached" and rec.node == "n0"
+    assert hub.detaches_total == 0  # the detach was cancelled, not done
+    hub.check_attachment_invariants()
+
+
+def test_csi_limit_predicate_reads_live_attach_state():
+    """The money test: a node whose single CSI slot is occupied by a
+    grace-period straggler must REJECT a new CSI pod until the detach
+    frees the slot — the predicate reads actual attach state, not just
+    live pods' volumes."""
+    hub = hub_with_nodes(n=1, **{"attachable-volumes-csi-ebs.csi.aws.com": 1})
+    sc = "csi-sc"
+    hub.add_storage_class(StorageClass(sc))
+    hub.add_pv(PersistentVolume("csi-a", kind="csi", handle="vol-a",
+                                driver="ebs.csi.aws.com", storage_class=sc))
+    hub.add_pv(PersistentVolume("csi-b", kind="csi", handle="vol-b",
+                                driver="ebs.csi.aws.com", storage_class=sc))
+    hub.add_pvc(PersistentVolumeClaim("ca", storage_class=sc))
+    hub.add_pvc(PersistentVolumeClaim("cb", storage_class=sc))
+    settle(hub, 2)  # PV controller binds both claims
+
+    pa = dataclasses.replace(make_pod("pa", cpu_milli=100),
+                             volumes=(PodVolume(pvc="ca"),))
+    hub.create_pod(pa)
+    settle(hub, 3)
+    assert hub.truth_pods["default/pa"].node_name == "n0"
+    hub.delete_pod("default/pa")
+    hub.step(dt=1.0)  # straggler: csi-a attached, detaching, grace 30s
+
+    pb = dataclasses.replace(make_pod("pb", cpu_milli=100),
+                             volumes=(PodVolume(pvc="cb"),))
+    hub.create_pod(pb)
+    hub.step(dt=1.0)
+    # the slot is occupied by the residue: pb must NOT schedule
+    assert not hub.truth_pods["default/pb"].node_name, (
+        "CSI limit predicate ignored the attached straggler")
+    settle(hub, 5, dt=15.0)  # grace expires, residue clears, resweep
+    assert hub.truth_pods["default/pb"].node_name == "n0"
+    assert hub.attachments["csi-b"].node == "n0"
+    hub.check_attachment_invariants()
+    hub.check_consistency()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_attachment_invariants_under_churn(seed):
+    """Mini churn fuzz: volume pods created/deleted under seeded
+    schedules; the attachment oracle and the hub consistency oracle must
+    hold at every interval."""
+    import random
+
+    rng = random.Random(seed)
+    hub = hub_with_nodes(n=3, seed=100 + seed)
+    sc = "standard"
+    hub.add_storage_class(StorageClass(sc))
+    for i in range(6):
+        hub.add_pv(PersistentVolume(f"pv{i}", kind="gce-pd",
+                                    handle=f"h{i}", storage_class=sc))
+        hub.add_pvc(PersistentVolumeClaim(f"c{i}", storage_class=sc))
+    live = []
+    for tick in range(40):
+        r = rng.random()
+        if r < 0.35 and len(live) < 6:
+            name = f"vp{tick}"
+            claim = f"c{rng.randrange(6)}"
+            hub.create_pod(dataclasses.replace(
+                make_pod(name, cpu_milli=100),
+                volumes=(PodVolume(pvc=claim),)))
+            live.append(name)
+        elif r < 0.55 and live:
+            victim = live.pop(rng.randrange(len(live)))
+            hub.delete_pod(f"default/{victim}")
+        hub.step(dt=rng.choice([1.0, 5.0, 20.0]))
+        if tick % 5 == 0:
+            hub.check_attachment_invariants()
+    hub.settle()
+    hub.check_attachment_invariants()
+    hub.check_consistency()
+
+
+def test_shared_claim_never_flaps_existing_attachment():
+    """Review finding r5: two live claimants of ONE PV on different
+    nodes must not detach the volume out from under the first pod
+    (last-writer-wins desired state would flap per iteration order).
+    The existing attachment holds; the second claimant waits."""
+    hub = hub_with_nodes()
+    claim = add_bound_pv(hub, "pv0")
+    pa = dataclasses.replace(
+        make_pod("pa", cpu_milli=100), volumes=(PodVolume(pvc=claim),),
+        node_selector={"kubernetes.io/hostname": "n0"})
+    hub.create_pod(pa)
+    settle(hub, 3)
+    assert hub.attachments["pv0"].node == "n0"
+    pb = dataclasses.replace(
+        make_pod("pb", cpu_milli=100), volumes=(PodVolume(pvc=claim),),
+        node_selector={"kubernetes.io/hostname": "n1"})
+    hub.create_pod(pb)
+    attaches_before = hub.attaches_total
+    settle(hub, 6)
+    rec = hub.attachments["pv0"]
+    assert rec.node == "n0" and rec.state == "attached", (
+        "existing attachment was stolen/flapped")
+    assert hub.attaches_total == attaches_before  # no churn
+    assert hub.detaches_total == 0
+    hub.check_attachment_invariants()
