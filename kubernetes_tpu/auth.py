@@ -58,6 +58,19 @@ class Unauthenticated(Exception):
     through to anonymous — authentication.go:50 'if err != nil ...401')."""
 
 
+def _parse_bearer(headers) -> Optional[str]:
+    """The ONE bearer-header parse both authenticators share: the token
+    string, or None for credential-less/non-Bearer/empty (NO OPINION —
+    bearertoken.go:30 returns nil,false,nil; such requests fall through
+    to anonymous/fallback policy, they are not failures)."""
+    raw = headers.get("Authorization", "") if headers else ""
+    parts = raw.split(None, 1)
+    if (not raw or len(parts) != 2 or parts[0].lower() != "bearer"
+            or not parts[1].strip()):
+        return None
+    return parts[1].strip()
+
+
 class TokenAuthenticator:
     """Static token table: ``{token: UserInfo}``.
 
@@ -73,22 +86,93 @@ class TokenAuthenticator:
         self.anonymous = anonymous
 
     def authenticate(self, headers) -> UserInfo:
-        raw = headers.get("Authorization", "") if headers else ""
-        parts = raw.split(None, 1)
-        if not raw or len(parts) != 2 or parts[0].lower() != "bearer" \
-                or not parts[1].strip():
-            # a non-Bearer scheme or empty token is NO OPINION, not a
-            # failure (bearertoken.go:30 returns nil,false,nil) — it
-            # falls through to the anonymous authenticator when enabled
+        token = _parse_bearer(headers)
+        if token is None:
             if self.anonymous:
                 return ANONYMOUS
             raise Unauthenticated("no credentials provided")
-        user = self.tokens.get(parts[1].strip())
+        user = self.tokens.get(token)
         if user is None:
             # a PRESENT-but-unknown bearer token is a hard failure and
             # never becomes anonymous (bearertoken.go:41 invalid token)
             raise Unauthenticated("invalid bearer token")
         return user
+
+
+#: the reference's service-account identity shape
+#: (serviceaccount/util.go MakeUsername / MakeGroupNames)
+SA_USER_PREFIX = "system:serviceaccount:"
+SA_GROUP_ALL = "system:serviceaccounts"
+SA_GROUP_NS_PREFIX = "system:serviceaccounts:"
+
+
+def service_account_user(namespace: str, name: str) -> UserInfo:
+    """UserInfo for a pod/service-account identity:
+    ``system:serviceaccount:<ns>:<name>`` in the all-SAs group and the
+    per-namespace group — the exact triple RBAC bindings key on."""
+    return UserInfo(
+        name=f"{SA_USER_PREFIX}{namespace}:{name}",
+        groups=(SA_GROUP_ALL, f"{SA_GROUP_NS_PREFIX}{namespace}"),
+    )
+
+
+class ServiceAccountAuthenticator:
+    """Bearer-token authenticator over a LIVE token registry — the
+    consumer half of the tokens controller
+    (pkg/controller/serviceaccount/tokens_controller.go:73 mints; the
+    serviceaccount token authenticator validates). ``lookup`` is a
+    callable ``token -> UserInfo | None`` (the hub's revocable registry:
+    a deleted namespace revokes its tokens, and this authenticator sees
+    that immediately — no static table to go stale).
+
+    Composable: an unknown token consults ``fallback`` (another
+    authenticator, e.g. the static TokenAuthenticator for operator
+    tokens) before failing; credential-less requests delegate to the
+    fallback's anonymous policy, or honor ``anonymous`` here."""
+
+    def __init__(self, lookup, fallback=None, anonymous: bool = False):
+        self.lookup = lookup
+        self.fallback = fallback
+        self.anonymous = anonymous
+
+    def authenticate(self, headers) -> UserInfo:
+        token = _parse_bearer(headers)
+        if token is None:
+            if self.fallback is not None:
+                return self.fallback.authenticate(headers)
+            if self.anonymous:
+                return ANONYMOUS
+            raise Unauthenticated("no credentials provided")
+        user = self.lookup(token)
+        if user is not None:
+            return user
+        if self.fallback is not None:
+            return self.fallback.authenticate(headers)
+        raise Unauthenticated("invalid bearer token")
+
+
+class ServiceAccountNamespaceAuthorizer:
+    """RBAC-lite per-namespace binding for EVERY service account: the
+    identity minted for namespace X may touch resources ONLY in
+    namespace X (the edit-role-per-namespace binding the tokens
+    controller implies; a pod-identity token authorizes exactly its
+    namespace). Cluster-scoped and non-resource requests are
+    NO_OPINION — chain an explicit rule list for those."""
+
+    def __init__(self, verbs: tuple = ("get", "list", "watch", "create",
+                                       "update", "patch", "delete")):
+        self.verbs = tuple(verbs)
+
+    def authorize(self, a: "Attributes") -> str:
+        if not a.resource or not a.namespace:
+            return NO_OPINION
+        if a.verb not in self.verbs:
+            return NO_OPINION
+        for g in a.user.groups:
+            if (g.startswith(SA_GROUP_NS_PREFIX)
+                    and g[len(SA_GROUP_NS_PREFIX):] == a.namespace):
+                return ALLOW
+        return NO_OPINION
 
 
 class Attributes(NamedTuple):

@@ -248,11 +248,29 @@ def _authed(fn, token):
     runs eagerly at call time, BEFORE any stream generator is returned,
     so streaming RPCs reject as early as unary ones. A falsy token
     (None or "") keeps the seam open on BOTH sides — an unset env var
-    must not produce a server demanding the empty bearer string."""
+    must not produce a server demanding the empty bearer string.
+
+    ``token`` may also be a CALLABLE ``raw_token -> bool`` — a live
+    validator (e.g. the hub's service-account token registry), so the
+    gRPC seam consumes the same revocable identities the REST chain
+    does (tokens_controller analog)."""
     import hmac
 
     if not token:
         return fn
+
+    if callable(token):
+        def check(request_or_iterator, context):
+            md = dict(context.invocation_metadata())
+            raw = md.get("authorization", "")
+            ok = raw.startswith("Bearer ") and token(raw[len("Bearer "):])
+            if not ok:
+                context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                              "invalid bearer token")
+            return fn(request_or_iterator, context)
+
+        return check
+
     want = f"Bearer {token}"
 
     def check(request_or_iterator, context):

@@ -137,6 +137,19 @@ class ReplicaSet:
 
 
 @dataclass
+class ServiceAccount:
+    """v1.ServiceAccount slice: the identity object the serviceaccounts
+    controller guarantees per namespace and the tokens controller mints
+    credentials for (pkg/controller/serviceaccount)."""
+
+    name: str
+    namespace: str = "default"
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
 class Attachment:
     """Attach-detach controller actual-state record
     (volume/attachdetach/cache/actual_state_of_world.go): one volume
@@ -529,6 +542,14 @@ class HollowCluster:
         self.pvcs: Dict[str, object] = {}
         self.pvs: Dict[str, object] = {}
         self.storage_classes: Dict[str, object] = {}
+        #: service accounts + minted bearer tokens (the serviceaccounts
+        #: controller guarantees a "default" SA per Active namespace;
+        #: the tokens controller mints one token per SA —
+        #: tokens_controller.go:73). Tokens are REVOCABLE: namespace
+        #: termination deletes its SAs and their tokens, and the live
+        #: lookup (sa_token_user) answers None immediately.
+        self.service_accounts: Dict[str, ServiceAccount] = {}
+        self.sa_tokens: Dict[str, str] = {}  # token -> "ns/name"
         #: attach-detach controller actual state (attach_detach_
         #: controller.go:102): volume identity -> Attachment. All
         #: attachable volumes are treated single-attach (the PV model
@@ -880,7 +901,7 @@ class HollowCluster:
         "quotas", "ip_alloc", "events_v1",
         "heartbeats", "dead_kubelets", "_taint_time",
         "_bound_at", "_started_at", "app_health",
-        "attachments",
+        "attachments", "service_accounts", "sa_tokens",
     )
 
     def _semantic_config(self) -> dict:
@@ -1138,6 +1159,61 @@ class HollowCluster:
         self._commit(f"persistentvolumes/{pv.name}", "MODIFIED", pv)
         self._commit(f"persistentvolumeclaims/{pvc.namespace}/{pvc.name}",
                      "MODIFIED", pvc)
+
+    def reconcile_service_accounts(self) -> None:
+        """The serviceaccounts + tokens controller pair
+        (pkg/controller/serviceaccount/serviceaccounts_controller.go:46,
+        tokens_controller.go:73): every ACTIVE namespace carries a
+        "default" ServiceAccount, every ServiceAccount carries exactly
+        one minted bearer token, and a namespace leaving Active revokes
+        both — committed through the versioned store so identity churn
+        is watchable like any other object."""
+        active = {name for name, ns in self.namespaces.items()
+                  if ns.phase == NS_ACTIVE}
+        for ns in active:
+            sa = ServiceAccount("default", namespace=ns)
+            if sa.key() not in self.service_accounts:
+                self.service_accounts[sa.key()] = sa
+                self._commit(f"serviceaccounts/{sa.key()}", "ADDED", sa)
+        # revoke: SAs of gone/terminating namespaces
+        for key, sa in list(self.service_accounts.items()):
+            if sa.namespace not in active:
+                del self.service_accounts[key]
+                self._commit(f"serviceaccounts/{key}", "DELETED", None)
+        live_keys = set(self.service_accounts)
+        for token, key in list(self.sa_tokens.items()):
+            if key not in live_keys:
+                del self.sa_tokens[token]
+        minted = set(self.sa_tokens.values())
+        for key in live_keys - minted:
+            # opaque, unguessable-enough for the hollow plane; the mint
+            # revision makes a re-created namespace's token DIFFERENT
+            # from its predecessor's (revocation must stick)
+            token = f"sa-token-{key.replace('/', '-')}-{self._revision}"
+            self.sa_tokens[token] = key
+
+    def service_account_token(self, namespace: str,
+                              name: str = "default") -> str:
+        """The minted token for one SA (what a pod's projected token
+        volume would carry). KeyError when the controller hasn't minted
+        it (namespace missing/terminating)."""
+        key = f"{namespace}/{name}"
+        for token, k in self.sa_tokens.items():
+            if k == key:
+                return token
+        raise KeyError(f"no token minted for serviceaccount {key!r}")
+
+    def sa_token_user(self, token: str):
+        """Live lookup for the authenticators (REST:
+        auth.ServiceAccountAuthenticator; gRPC: serve_grpc's callable
+        token): UserInfo for a valid token, None for unknown/revoked."""
+        key = self.sa_tokens.get(token)
+        if key is None:
+            return None
+        ns, name = key.split("/", 1)
+        from kubernetes_tpu.auth import service_account_user
+
+        return service_account_user(ns, name)
 
     def _desired_attachments(self) -> Dict[str, set]:
         """Desired state: volume identity -> set of nodes with bound pods
@@ -2022,6 +2098,9 @@ class HollowCluster:
             # a REST DELETE namespace on an admission-less hub would
             # otherwise terminate forever
             self.reconcile_namespaces()
+        # unconditional: an (impossible today) empty namespaces dict must
+        # still REVOKE — gating here would freeze dead tokens alive
+        self.reconcile_service_accounts()
         self.reconcile_controllers()
         self.gc_owner_graph()
         if self.pvcs or self.pvs:
