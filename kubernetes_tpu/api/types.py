@@ -450,6 +450,12 @@ class Node:
     #: owner UIDs from the scheduler.alpha.kubernetes.io/preferAvoidPods
     #: annotation (NodePreferAvoidPodsPriority).
     prefer_avoid_owner_uids: Tuple[str, ...] = ()
+    #: metadata.annotations slice the hollow controllers write (the TTL
+    #: controller's node.alpha.kubernetes.io/ttl lives here)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    #: spec.podCIDR — allocated by the nodeipam range allocator
+    #: (pkg/controller/nodeipam/ipam/range_allocator.go)
+    pod_cidr: str = ""
 
     def zone(self) -> Optional[str]:
         # Reference zone labels: failure-domain.beta.kubernetes.io/zone.

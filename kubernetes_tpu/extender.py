@@ -92,18 +92,19 @@ def node_to_json(node) -> dict:
          "status": "True" if c.network_unavailable else "False"},
     ]
     meta = {"name": node.name, "labels": dict(node.labels)}
+    if node.annotations:
+        meta["annotations"] = dict(node.annotations)
     if node.prefer_avoid_owner_uids:
         # the reference carries this via the preferAvoidPods annotation
         # (scheduler.alpha.kubernetes.io/preferAvoidPods, priorities/
         # node_prefer_avoid_pods.go) — keep the wire shape
-        meta["annotations"] = {
-            "scheduler.alpha.kubernetes.io/preferAvoidPods": json.dumps({
+        meta.setdefault("annotations", {})[
+            "scheduler.alpha.kubernetes.io/preferAvoidPods"] = json.dumps({
                 "preferAvoidPods": [
                     {"podSignature": {"podController": {"uid": uid}}}
                     for uid in node.prefer_avoid_owner_uids
                 ]
             })
-        }
     status = {
         "allocatable": {
             "cpu": f"{int(node.allocatable.cpu_milli)}m",
@@ -123,6 +124,7 @@ def node_to_json(node) -> dict:
         "metadata": meta,
         "spec": {
             "unschedulable": node.unschedulable,
+            **({"podCIDR": node.pod_cidr} if node.pod_cidr else {}),
             "taints": [
                 {"key": t.key, "value": t.value, "effect": t.effect}
                 for t in node.taints

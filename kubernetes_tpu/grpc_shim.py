@@ -92,6 +92,11 @@ def node_from_json(d: dict) -> Node:
     # break hostname-pinned placement (DaemonSet affinity)
     if meta.get("name"):
         labels.setdefault("kubernetes.io/hostname", meta["name"])
+    # annotations round-trip EXCEPT preferAvoidPods, which parses into
+    # the dedicated field (node_to_json re-emits it from there — keeping
+    # both would double it on the next serialization)
+    annotations = {k: v for k, v in (meta.get("annotations") or {}).items()
+                   if k != "scheduler.alpha.kubernetes.io/preferAvoidPods"}
     return Node(
         name=meta.get("name", ""),
         labels=labels,
@@ -101,6 +106,8 @@ def node_from_json(d: dict) -> Node:
         unschedulable=bool(spec.get("unschedulable", False)),
         images=images,
         prefer_avoid_owner_uids=avoid,
+        annotations=annotations,
+        pod_cidr=spec.get("podCIDR", ""),
     )
 
 

@@ -249,13 +249,13 @@ class RestClient:
         self._headers = ({"Authorization": f"Bearer {token}"}
                          if token else {})
 
-    def call(self, method: str, path: str, body=None):
+    def call(self, method: str, path: str, body=None, headers=None):
         import http.client
 
         conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
         conn.request(method, path,
                      json.dumps(body) if body is not None else None,
-                     self._headers)
+                     {**self._headers, **(headers or {})})
         r = conn.getresponse()
         data = r.read()
         conn.close()
@@ -290,6 +290,58 @@ def cmd_create(rest: RestClient, args) -> int:
     if code != 201:
         return _rest_fail(out)
     print(f"{what} created")
+    return 0
+
+
+def cmd_apply(rest: RestClient, args) -> int:
+    """kubectl apply -f: declarative create-or-update. Absent -> POST;
+    present -> PATCH with the manifest as a JSON merge patch (the
+    facade's supported patch type). One deliberate simplification vs
+    kubectl: no last-applied three-way merge — fields you DROP from the
+    manifest are left as-is on the server, not deleted (delete a field
+    explicitly with null, RFC 7386)."""
+    with open(args.filename) as f:
+        doc = json.load(f)
+    kind = doc.get("kind")
+    name = (doc.get("metadata") or {}).get("name", "")
+    if not kind or not name:
+        print(f"Error: {args.filename} needs kind and metadata.name",
+              file=sys.stderr)
+        return 1
+    ns = (doc.get("metadata") or {}).get("namespace") or args.namespace
+    routes = {
+        "Pod": (f"/api/v1/namespaces/{ns}/pods", f"pod/{name}"),
+        "Node": ("/api/v1/nodes", f"node/{name}"),
+        "Deployment": (f"/apis/apps/v1/namespaces/{ns}/deployments",
+                       f"deployment.apps/{name}"),
+        "Namespace": ("/api/v1/namespaces", f"namespace/{name}"),
+    }
+    if kind not in routes:
+        print(f"Error: unsupported kind {kind!r}", file=sys.stderr)
+        return 1
+    collection, what = routes[kind]
+    code, cur = rest.call("GET", f"{collection}/{name}")
+    if code == 404:
+        code, out = rest.call("POST", collection, doc)
+        if code != 201:
+            return _rest_fail(out)
+        print(f"{what} created")
+        return 0
+    if code != 200:
+        return _rest_fail(cur)
+    if kind == "Namespace":
+        print(f"{what} unchanged")  # namespaces have no mutable spec here
+        return 0
+    # the FULL manifest goes as the patch: a pod whose spec genuinely
+    # changed gets the facade's 422 (spec changes need delete+create so
+    # admission re-runs) surfaced as a real failure — never a silent
+    # 'configured' that dropped the user's change
+    code, out = rest.call(
+        "PATCH", f"{collection}/{name}", doc,
+        headers={"Content-Type": "application/merge-patch+json"})
+    if code != 200:
+        return _rest_fail(out)
+    print(f"{what} configured")
     return 0
 
 
@@ -554,6 +606,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     c = sub.add_parser("create")
     c.add_argument("-f", "--filename", required=True)
     c.add_argument("-n", "--namespace", default="default")
+    ap_ = sub.add_parser("apply")
+    ap_.add_argument("-f", "--filename", required=True)
+    ap_.add_argument("-n", "--namespace", default="default")
     de = sub.add_parser("delete")
     de.add_argument("kind", choices=["pod", "pods", "node", "nodes"])
     de.add_argument("name")
@@ -607,7 +662,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 1
 
     if args.cmd in ("create", "delete", "cordon", "uncordon", "drain",
-                    "scale"):
+                    "scale", "apply"):
         if not args.api_server:
             p.error(f"{args.cmd} requires --api-server")
         try:
@@ -617,6 +672,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         try:
             if args.cmd == "create":
                 return cmd_create(rest, args)
+            if args.cmd == "apply":
+                return cmd_apply(rest, args)
             if args.cmd == "delete":
                 return cmd_delete(rest, args)
             if args.cmd == "drain":

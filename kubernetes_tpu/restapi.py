@@ -1482,12 +1482,27 @@ class RestServer:
             merged = merge_patch(cur_doc, patch)
             if (merged.get("spec") != cur_doc.get("spec")
                     or merged.get("status") != cur_doc.get("status")):
-                return h._fail(
-                    422, "Invalid",
-                    "pod PATCH is limited to metadata on this facade "
-                    "(placement belongs to the Binding subresource; "
-                    "spec changes go through delete+create so admission "
-                    "re-runs)")
+                # a textual mismatch can still be semantically identical
+                # (kubectl apply re-sends the manifest that CREATED the
+                # pod; its "100m"-style quantities differ from the
+                # server's canonical rendering): parse both through the
+                # same wire projection and compare with metadata
+                # normalized before rejecting
+                try:
+                    import dataclasses
+
+                    a = pod_from_json(merged)
+                    b = pod_from_json(cur_doc)
+                    same = dataclasses.replace(a, labels=b.labels) == b
+                except Exception:
+                    same = False
+                if not same:
+                    return h._fail(
+                        422, "Invalid",
+                        "pod PATCH is limited to metadata on this facade "
+                        "(placement belongs to the Binding subresource; "
+                        "spec changes go through delete+create so "
+                        "admission re-runs)")
             meta = merged.get("metadata") or {}
             if meta.get("name") != name:
                 return h._fail(422, "Invalid", "metadata.name is immutable")

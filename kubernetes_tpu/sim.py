@@ -550,6 +550,17 @@ class HollowCluster:
         #: lookup (sa_token_user) answers None immediately.
         self.service_accounts: Dict[str, ServiceAccount] = {}
         self.sa_tokens: Dict[str, str] = {}  # token -> "ns/name"
+        #: TTL controller hysteresis step (ttl_controller.go boundaryStep)
+        self._ttl_step = 0
+        #: nodeipam range allocator: cluster CIDR carved into per-node
+        #: blocks ("/8 + /24" covers 65536 nodes — the 50k story fits)
+        self.cluster_cidr = "10.0.0.0/8"
+        self.node_cidr_prefix = 24
+        self._cidr_subnets = None  # lazy (ip_network parse on first use)
+        self._cidr_alloc: Dict[str, int] = {}
+        self._cidr_next = 0
+        self._cidr_free: List[int] = []
+        self.cidr_exhausted_total = 0
         #: attach-detach controller actual state (attach_detach_
         #: controller.go:102): volume identity -> Attachment. All
         #: attachable volumes are treated single-attach (the PV model
@@ -1159,6 +1170,75 @@ class HollowCluster:
         self._commit(f"persistentvolumes/{pv.name}", "MODIFIED", pv)
         self._commit(f"persistentvolumeclaims/{pvc.namespace}/{pvc.name}",
                      "MODIFIED", pvc)
+
+    #: TTL controller boundary table (pkg/controller/ttl/ttl_controller
+    #: .go:102 ttlBoundaries): (size_min, size_max, ttl_seconds) with
+    #: overlapping min/max = the reference's hysteresis — the step only
+    #: moves when the count leaves the CURRENT band, so oscillation at a
+    #: boundary doesn't thrash every node's annotation
+    TTL_BOUNDARIES = ((0, 100, 0), (90, 500, 15), (450, 1000, 30),
+                      (900, 2000, 60), (1800, 10000, 300),
+                      (9000, 1 << 31, 600))
+    TTL_ANNOTATION = "node.alpha.kubernetes.io/ttl"
+
+    def reconcile_ttl(self) -> None:
+        """The TTL controller: annotate every node with the secret/
+        configmap cache TTL kubelets should use, scaled to cluster size
+        with hysteresis (ttl_controller.go:141,:182)."""
+        import dataclasses
+
+        count = len(self.truth_nodes)
+        while (self._ttl_step + 1 < len(self.TTL_BOUNDARIES)
+               and count > self.TTL_BOUNDARIES[self._ttl_step][1]):
+            self._ttl_step += 1
+        while (self._ttl_step > 0
+               and count < self.TTL_BOUNDARIES[self._ttl_step][0]):
+            self._ttl_step -= 1
+        want = str(self.TTL_BOUNDARIES[self._ttl_step][2])
+        for node in list(self.truth_nodes.values()):
+            if node.annotations.get(self.TTL_ANNOTATION) != want:
+                new = dataclasses.replace(
+                    node, annotations={**node.annotations,
+                                       self.TTL_ANNOTATION: want})
+                self._update_node(new)
+
+    def reconcile_node_ipam(self) -> None:
+        """The nodeipam range allocator (ipam/range_allocator.go): carve
+        one per-node podCIDR from the cluster CIDR; release a deleted
+        node's block back to the set; exhaustion surfaces as a counter
+        (the reference emits CIDRNotAvailable), never a crash."""
+        import dataclasses
+        import ipaddress
+
+        if self._cidr_subnets is None:
+            net = ipaddress.ip_network(self.cluster_cidr)
+            self._cidr_subnets = list(
+                net.subnets(new_prefix=self.node_cidr_prefix))
+            self._cidr_next = 0
+            self._cidr_free: List[int] = []
+        live = set(self.truth_nodes)
+        for name in [n for n in self._cidr_alloc if n not in live]:
+            self._cidr_free.append(self._cidr_alloc.pop(name))
+        for name, node in list(self.truth_nodes.items()):
+            if node.pod_cidr:
+                continue
+            if name in self._cidr_alloc:
+                # a delete+re-add with the same name between passes, or a
+                # wire write that dropped the field: the allocator still
+                # holds this node's block — re-stamp it instead of
+                # leaking the block AND leaving the node CIDR-less
+                idx = self._cidr_alloc[name]
+            elif self._cidr_free:
+                idx = self._cidr_free.pop()
+            elif self._cidr_next < len(self._cidr_subnets):
+                idx = self._cidr_next
+                self._cidr_next += 1
+            else:
+                self.cidr_exhausted_total += 1
+                continue
+            self._cidr_alloc[name] = idx
+            self._update_node(dataclasses.replace(
+                node, pod_cidr=str(self._cidr_subnets[idx])))
 
     def reconcile_service_accounts(self) -> None:
         """The serviceaccounts + tokens controller pair
@@ -2101,6 +2181,8 @@ class HollowCluster:
         # unconditional: an (impossible today) empty namespaces dict must
         # still REVOKE — gating here would freeze dead tokens alive
         self.reconcile_service_accounts()
+        self.reconcile_ttl()
+        self.reconcile_node_ipam()
         self.reconcile_controllers()
         self.gc_owner_graph()
         if self.pvcs or self.pvs:

@@ -315,3 +315,76 @@ def test_pod_patch_preserves_non_wire_fields_and_scopes_to_metadata():
         assert code == 404
     finally:
         srv.close()
+
+
+def test_ktpu_apply_create_then_configure(tmp_path, capsys):
+    """kubectl apply analog: absent -> created; present -> merge-patched
+    ('configured'); a deployment apply drives a real scale + rollout."""
+    import json as _json
+
+    from kubernetes_tpu.kubectl import main as ktpu
+
+    hub, srv, port = cluster()
+    try:
+        mf = tmp_path / "web.json"
+        doc = {"apiVersion": "apps/v1", "kind": "Deployment",
+               "metadata": {"name": "web"},
+               "spec": {"replicas": 2, "template": {"cpuMilli": 150}}}
+        mf.write_text(_json.dumps(doc))
+        rc = ktpu(["--api-server", f"127.0.0.1:{port}", "apply",
+                   "-f", str(mf)])
+        assert rc == 0
+        assert "created" in capsys.readouterr().out
+        settle(hub)
+        assert hub.deployments["web"].replicas == 2
+
+        doc["spec"] = {"replicas": 4, "template": {"cpuMilli": 250}}
+        mf.write_text(_json.dumps(doc))
+        rc = ktpu(["--api-server", f"127.0.0.1:{port}", "apply",
+                   "-f", str(mf)])
+        assert rc == 0
+        assert "configured" in capsys.readouterr().out
+        settle(hub, 60)
+        d = hub.deployments["web"]
+        assert d.replicas == 4 and d.template_rev == 1  # rollout happened
+        pods = [p for p in hub.truth_pods.values()
+                if p.name.startswith("web-")]
+        assert len(pods) == 4
+        assert all(p.requests.cpu_milli == 250 for p in pods)
+
+        # pod metadata apply: created then label-patched
+        pf = tmp_path / "p.json"
+        pdoc = {"kind": "Pod", "metadata": {"name": "solo",
+                                            "labels": {"v": "1"}},
+                "spec": {"containers": [{"name": "m", "resources":
+                                         {"requests": {"cpu": "50m"}}}]}}
+        pf.write_text(_json.dumps(pdoc))
+        assert ktpu(["--api-server", f"127.0.0.1:{port}", "apply",
+                     "-f", str(pf)]) == 0
+        pdoc["metadata"]["labels"] = {"v": "2"}
+        pf.write_text(_json.dumps(pdoc))
+        assert ktpu(["--api-server", f"127.0.0.1:{port}", "apply",
+                     "-f", str(pf)]) == 0
+        assert hub.truth_pods["default/solo"].labels == {"v": "2"}
+
+        # a genuine pod SPEC change must FAIL loudly (rc 1), never a
+        # silent 'configured' that dropped the change (review finding)
+        pdoc["spec"]["containers"][0]["resources"]["requests"]["cpu"] = "75m"
+        pf.write_text(_json.dumps(pdoc))
+        capsys.readouterr()
+        assert ktpu(["--api-server", f"127.0.0.1:{port}", "apply",
+                     "-f", str(pf)]) != 0
+        assert hub.truth_pods["default/solo"].requests.cpu_milli == 50
+
+        # nodeipam/TTL round-trip: a cordon (GET->PUT) must not wipe
+        # the controller-stamped podCIDR/annotations (review finding)
+        hub.step()
+        cidr = hub.truth_nodes["n0"].pod_cidr
+        assert cidr
+        assert ktpu(["--api-server", f"127.0.0.1:{port}",
+                     "cordon", "n0"]) == 0
+        assert hub.truth_nodes["n0"].pod_cidr == cidr
+        assert hub.truth_nodes["n0"].annotations.get(
+            "node.alpha.kubernetes.io/ttl") == "0"
+    finally:
+        srv.close()

@@ -1,0 +1,88 @@
+"""TTL controller + nodeipam range allocator (controller-breadth items
+from VERDICT r4 'what's missing' #4): cluster-size-scaled TTL
+annotations with hysteresis (ttl_controller.go:102) and per-node podCIDR
+allocation/release from the cluster CIDR (ipam/range_allocator.go)."""
+
+from kubernetes_tpu.sim import HollowCluster
+from kubernetes_tpu.testing import make_node
+
+
+def hub():
+    return HollowCluster(seed=61, scheduler_kw={"enable_preemption": False})
+
+
+def test_ttl_annotation_scales_with_cluster_size_with_hysteresis():
+    h = hub()
+    for i in range(5):
+        h.add_node(make_node(f"n{i}"))
+    h.step()
+    ttl = h.truth_nodes["n0"].annotations["node.alpha.kubernetes.io/ttl"]
+    assert ttl == "0"  # <=100 nodes
+
+    for i in range(5, 120):
+        h.add_node(make_node(f"n{i}"))
+    h.step()
+    assert h.truth_nodes["n0"].annotations[
+        "node.alpha.kubernetes.io/ttl"] == "15"  # crossed 100
+
+    # hysteresis: dropping to 95 (>= sizeMin 90 of the 15s band) keeps 15
+    for i in range(95, 120):
+        h.remove_node(f"n{i}")
+    h.step()
+    assert h.truth_nodes["n0"].annotations[
+        "node.alpha.kubernetes.io/ttl"] == "15"
+    # dropping below sizeMin 90 steps back down to 0
+    for i in range(80, 95):
+        h.remove_node(f"n{i}")
+    h.step()
+    assert h.truth_nodes["n0"].annotations[
+        "node.alpha.kubernetes.io/ttl"] == "0"
+    h.check_consistency()
+
+
+def test_nodeipam_allocates_unique_cidrs_and_recycles():
+    h = hub()
+    for i in range(6):
+        h.add_node(make_node(f"n{i}"))
+    h.step()
+    cidrs = {n.name: n.pod_cidr for n in h.truth_nodes.values()}
+    assert all(c.endswith("/24") for c in cidrs.values())
+    assert len(set(cidrs.values())) == 6  # unique blocks
+
+    # release on delete, recycle to a new node
+    released = cidrs["n3"]
+    h.remove_node("n3")
+    h.step()
+    h.add_node(make_node("n9"))
+    h.step()
+    assert h.truth_nodes["n9"].pod_cidr == released
+    h.check_consistency()
+
+
+def test_nodeipam_exhaustion_is_counted_not_crashed():
+    h = hub()
+    h.cluster_cidr = "10.0.0.0/30"  # one /32... /30 -> 4 /32s
+    h.node_cidr_prefix = 32
+    for i in range(6):
+        h.add_node(make_node(f"x{i}"))
+    h.step()
+    allocated = [n for n in h.truth_nodes.values() if n.pod_cidr]
+    assert len(allocated) == 4
+    assert h.cidr_exhausted_total >= 2
+    h.check_consistency()
+
+
+def test_nodeipam_readd_same_name_restamps_held_block():
+    """Review finding r5: delete + re-add with the same name between
+    reconcile passes must re-stamp the held block, not leak it while
+    leaving the node CIDR-less forever."""
+    h = hub()
+    h.add_node(make_node("n1"))
+    h.step()
+    cidr = h.truth_nodes["n1"].pod_cidr
+    assert cidr
+    h.remove_node("n1")
+    h.add_node(make_node("n1"))  # same pass: release loop sees it live
+    h.step()
+    assert h.truth_nodes["n1"].pod_cidr == cidr
+    h.check_consistency()
