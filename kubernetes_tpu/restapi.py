@@ -380,6 +380,27 @@ class ListOptions:
                 and match_fields(self.field, fields))
 
 
+def foreign_keys(doc, canon) -> list:
+    """Key paths present in ``doc`` that its canonical re-serialization
+    ``canon`` does not carry — i.e., fields OUTSIDE the wire projection.
+    A patch introducing such a field must be rejected, never silently
+    dropped (the projection would swallow it and the semantic-equality
+    check would wave the patch through)."""
+    out = []
+    if isinstance(doc, dict) and isinstance(canon, dict):
+        for k, v in doc.items():
+            if k not in canon:
+                out.append(k)
+            else:
+                out.extend(f"{k}.{p}" for p in foreign_keys(v, canon[k]))
+    elif isinstance(doc, list) and isinstance(canon, list):
+        for i, (a, b) in enumerate(zip(doc, canon)):
+            out.extend(f"[{i}].{p}" for p in foreign_keys(a, b))
+        if len(doc) > len(canon):
+            out.append(f"[{len(canon)}:]")
+    return out
+
+
 def merge_patch(target, patch):
     """RFC 7386 JSON Merge Patch — the semantics behind
     Content-Type: application/merge-patch+json
@@ -773,16 +794,27 @@ class RestServer:
         if seg[0] == "watch":
             return self._watch(h, seg[1:], parse_qs(url.query))
         if seg == ["nodes"]:
+            from kubernetes_tpu.api.protobuf import node_list_to_pb
+
             return self._serve_list(
                 h, parse_qs(url.query), "NodeList",
                 list(hub.truth_nodes.values()),
                 node_fields, lambda n: n.labels,
                 lambda n: _with_rv(node_to_json(n), hub, f"nodes/{n.name}"),
-                lambda n: n.name)
+                lambda n: n.name, to_pb_list=node_list_to_pb)
         if len(seg) == 2 and seg[0] == "nodes":
             n = hub.truth_nodes.get(seg[1])
             if n is None:
                 return h._fail(404, "NotFound", f'nodes "{seg[1]}" not found')
+            if self._wants_proto(h):
+                from kubernetes_tpu.api.protobuf import (
+                    PROTO_CONTENT_TYPE,
+                    encode_envelope,
+                    node_to_pb,
+                )
+
+                return h._send_raw(200, PROTO_CONTENT_TYPE,
+                                   encode_envelope("Node", node_to_pb(n)))
             return h._respond(200, _with_rv(node_to_json(n), hub,
                                             f"nodes/{n.name}"))
         if seg[0] == "namespaces" and len(seg) <= 2:
@@ -890,6 +922,8 @@ class RestServer:
                 "items": items,
             })
         if seg == ["pods"]:
+            from kubernetes_tpu.api.protobuf import pod_list_to_pb
+
             return self._serve_list(
                 h, parse_qs(url.query), "PodList",
                 [p for p in hub.truth_pods.values()
@@ -897,11 +931,20 @@ class RestServer:
                 pod_fields, lambda p: p.labels,
                 lambda p: _with_rv(pod_to_json(p), hub,
                                    f"pods/{p.key()}"),
-                lambda p: p.key())
+                lambda p: p.key(), to_pb_list=pod_list_to_pb)
         if len(seg) == 2 and seg[0] == "pods" and ns is not None:
             p = hub.truth_pods.get(f"{ns}/{seg[1]}")
             if p is None:
                 return h._fail(404, "NotFound", f'pods "{seg[1]}" not found')
+            if self._wants_proto(h):
+                from kubernetes_tpu.api.protobuf import (
+                    PROTO_CONTENT_TYPE,
+                    encode_envelope,
+                    pod_to_pb,
+                )
+
+                return h._send_raw(200, PROTO_CONTENT_TYPE,
+                                   encode_envelope("Pod", pod_to_pb(p)))
             return h._respond(200, _with_rv(pod_to_json(p), hub,
                                             f"pods/{p.key()}"))
         return h._fail(404, "NotFound", h.path)
@@ -993,8 +1036,14 @@ class RestServer:
             return h._respond(200, apps_scale_doc(hub, d))
         return h._fail(404, "NotFound", h.path)
 
+    @staticmethod
+    def _wants_proto(h) -> bool:
+        from kubernetes_tpu.api.protobuf import PROTO_CONTENT_TYPE
+
+        return PROTO_CONTENT_TYPE in (h.headers.get("Accept") or "")
+
     def _serve_list(self, h, query, kind, objs, obj_fields, obj_labels,
-                    to_json, key_of) -> None:
+                    to_json, key_of, to_pb_list=None) -> None:
         """One list pipeline for the selectable kinds: ListOptions parse →
         hub-side selector evaluation BEFORE any serialization (the watch
         cache's reason to exist — pod/strategy.go:197 MatchPod) → key-
@@ -1047,6 +1096,20 @@ class RestServer:
                 # selector'd lists (the apiserver can't compute it
                 # exactly there and leaves the field unset)
                 meta["remainingItemCount"] = remaining
+        if to_pb_list is not None and self._wants_proto(h):
+            # Accept: application/vnd.kubernetes.protobuf — the typed
+            # codec behind the k8s magic envelope (protobuf.go:95); the
+            # big-list wire-efficiency path of the 50k-node story
+            from kubernetes_tpu.api.protobuf import (
+                PROTO_CONTENT_TYPE,
+                encode_envelope,
+            )
+
+            msg = to_pb_list(selected, int(meta["resourceVersion"]))
+            msg.continue_token = meta.get("continue", "")
+            msg.remaining = meta.get("remainingItemCount", -1)
+            return h._send_raw(200, PROTO_CONTENT_TYPE,
+                               encode_envelope(kind, msg))
         return h._respond(200, {
             "kind": kind, "apiVersion": "v1", "metadata": meta,
             "items": [to_json(o) for o in selected],
@@ -1487,13 +1550,25 @@ class RestServer:
                 # pod; its "100m"-style quantities differ from the
                 # server's canonical rendering): parse both through the
                 # same wire projection and compare with metadata
-                # normalized before rejecting
+                # normalized — BUT only when the merged doc carries no
+                # fields OUTSIDE the projection. The projection ignores
+                # unknown fields, so without the foreign-key check a
+                # patch adding spec.tolerations or containers[0].image
+                # would compare equal and be SILENTLY dropped with a 200
+                # (review finding r5).
                 try:
                     import dataclasses
 
                     a = pod_from_json(merged)
                     b = pod_from_json(cur_doc)
-                    same = dataclasses.replace(a, labels=b.labels) == b
+                    canon = pod_to_json(a)
+                    same = (
+                        dataclasses.replace(a, labels=b.labels) == b
+                        and not foreign_keys(merged.get("spec"),
+                                             canon.get("spec"))
+                        and not foreign_keys(merged.get("status"),
+                                             canon.get("status"))
+                    )
                 except Exception:
                     same = False
                 if not same:

@@ -1214,29 +1214,48 @@ class HollowCluster:
             net = ipaddress.ip_network(self.cluster_cidr)
             self._cidr_subnets = list(
                 net.subnets(new_prefix=self.node_cidr_prefix))
+            self._cidr_index = {str(s): i
+                                for i, s in enumerate(self._cidr_subnets)}
             self._cidr_next = 0
             self._cidr_free: List[int] = []
         live = set(self.truth_nodes)
         for name in [n for n in self._cidr_alloc if n not in live]:
             self._cidr_free.append(self._cidr_alloc.pop(name))
+        used = set(self._cidr_alloc.values())
         for name, node in list(self.truth_nodes.items()):
             if node.pod_cidr:
+                # OCCUPY a pre-set CIDR (range_allocator occupyCIDRs): a
+                # node ingested with spec.podCIDR already assigned must
+                # claim its block or the allocator would hand the same
+                # subnet to the next CIDR-less node
+                idx = self._cidr_index.get(node.pod_cidr)
+                if idx is not None and name not in self._cidr_alloc:
+                    self._cidr_alloc[name] = idx
+                    used.add(idx)
                 continue
             if name in self._cidr_alloc:
-                # a delete+re-add with the same name between passes, or a
-                # wire write that dropped the field: the allocator still
-                # holds this node's block — re-stamp it instead of
-                # leaking the block AND leaving the node CIDR-less
+                # same-name delete+re-add (or a write that dropped the
+                # field): re-stamp the held block instead of leaking it
                 idx = self._cidr_alloc[name]
-            elif self._cidr_free:
-                idx = self._cidr_free.pop()
-            elif self._cidr_next < len(self._cidr_subnets):
-                idx = self._cidr_next
-                self._cidr_next += 1
             else:
-                self.cidr_exhausted_total += 1
-                continue
-            self._cidr_alloc[name] = idx
+                idx = None
+                while self._cidr_free:
+                    cand = self._cidr_free.pop()
+                    if cand not in used:
+                        idx = cand
+                        break
+                if idx is None:
+                    while (self._cidr_next < len(self._cidr_subnets)
+                           and self._cidr_next in used):
+                        self._cidr_next += 1
+                    if self._cidr_next < len(self._cidr_subnets):
+                        idx = self._cidr_next
+                        self._cidr_next += 1
+                    else:
+                        self.cidr_exhausted_total += 1
+                        continue
+                self._cidr_alloc[name] = idx
+                used.add(idx)
             self._update_node(dataclasses.replace(
                 node, pod_cidr=str(self._cidr_subnets[idx])))
 

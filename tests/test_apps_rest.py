@@ -388,3 +388,28 @@ def test_ktpu_apply_create_then_configure(tmp_path, capsys):
             "node.alpha.kubernetes.io/ttl") == "0"
     finally:
         srv.close()
+
+
+def test_pod_patch_rejects_fields_outside_the_wire_projection():
+    """Review finding r5 round 2: a patch introducing a spec field the
+    wire projection does not carry (tolerations, image, ...) must 422 —
+    the projection would silently swallow it and the semantic-equality
+    fallback would wave the patch through as a no-op."""
+    from tests.test_restapi import make_pod_doc
+
+    hub, srv, port = cluster()
+    try:
+        req(port, "POST", "/api/v1/namespaces/default/pods",
+            make_pod_doc("p0"))
+        for patch in (
+            {"spec": {"tolerations": [{"key": "k", "operator": "Exists"}]}},
+            {"spec": {"containers": [{"name": "main", "image": "nginx",
+                                      "resources": {"requests":
+                                                    {"cpu": "100m"}}}]}},
+            {"spec": {"activeDeadlineSeconds": 30}},
+        ):
+            code, doc = patch_req(
+                port, "/api/v1/namespaces/default/pods/p0", patch)
+            assert code == 422, (patch, code, doc)
+    finally:
+        srv.close()

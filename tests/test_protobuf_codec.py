@@ -1,0 +1,163 @@
+"""Typed protobuf codecs (VERDICT r4 missing #5) — proto/corev1.proto +
+api/protobuf.py: the codec must carry EXACTLY the published JSON wire
+slice (``from_pb(to_pb(x)) == from_json(to_json(x))``), ride the
+reference's magic+Unknown envelope (protobuf.go:42), serve on the REST
+facade behind Accept: application/vnd.kubernetes.protobuf, and feed the
+gRPC SyncState stream as typed deltas."""
+
+import dataclasses
+import http.client
+import json
+
+import pytest
+
+from kubernetes_tpu.api.protobuf import (
+    MAGIC,
+    PROTO_CONTENT_TYPE,
+    decode_envelope,
+    encode_envelope,
+    node_from_pb,
+    node_to_pb,
+    pod_from_pb,
+    pod_to_pb,
+)
+from kubernetes_tpu.api.types import (
+    OwnerReference,
+    ReadinessProbe,
+    Taint,
+)
+from kubernetes_tpu.extender import node_to_json, pod_to_json
+from kubernetes_tpu.grpc_shim import node_from_json
+from kubernetes_tpu.proto import corev1_pb2
+from kubernetes_tpu.server import pod_from_json
+from kubernetes_tpu.sim import HollowCluster
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def rich_pod():
+    return dataclasses.replace(
+        make_pod("p1", cpu_milli=250, labels={"app": "x"},
+                 node_name="n1", priority=5),
+        readiness_probe=ReadinessProbe(initial_delay_s=3.0),
+        owner_refs=(OwnerReference(kind="ReplicaSet", name="rs", uid="u1"),),
+        nominated_node_name="n2", node_selector={"disk": "ssd"})
+
+
+def rich_node():
+    n = make_node("n1", cpu_milli=4000)
+    n.allocatable.scalars["attachable-volumes-csi-x"] = 3
+    return dataclasses.replace(
+        n, taints=(Taint(key="k", value="v", effect="NoSchedule"),),
+        annotations={"node.alpha.kubernetes.io/ttl": "15"},
+        pod_cidr="10.0.1.0/24", prefer_avoid_owner_uids=("u9",),
+        images={"img:a": 2 ** 26})
+
+
+def test_codec_parity_with_json_wire_slice():
+    p, n = rich_pod(), rich_node()
+    assert pod_from_pb(pod_to_pb(p)) == pod_from_json(pod_to_json(p))
+    assert node_from_pb(node_to_pb(n)) == node_from_json(node_to_json(n))
+
+
+def test_envelope_magic_and_round_trip():
+    p = rich_pod()
+    data = encode_envelope("Pod", pod_to_pb(p))
+    assert data.startswith(MAGIC)
+    kind, raw = decode_envelope(data)
+    assert kind == "Pod"
+    msg = corev1_pb2.PodMsg()
+    msg.ParseFromString(raw)
+    assert pod_from_pb(msg) == pod_from_pb(pod_to_pb(p))
+    with pytest.raises(ValueError):
+        decode_envelope(b"{}" + data)
+
+
+def test_rest_lists_negotiate_protobuf():
+    from tests.test_restapi import make_pod_doc, req, start
+
+    hub = HollowCluster(seed=71, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        hub.add_node(make_node("n0", cpu_milli=8000, pods=60))
+        for i in range(3):
+            req(port, "POST", "/api/v1/namespaces/default/pods",
+                make_pod_doc(f"p{i}"))
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/api/v1/pods", None,
+                     {"Accept": PROTO_CONTENT_TYPE})
+        r = conn.getresponse()
+        body = r.read()
+        conn.close()
+        assert r.status == 200
+        assert r.getheader("Content-Type") == PROTO_CONTENT_TYPE
+        kind, raw = decode_envelope(body)
+        assert kind == "PodList"
+        lst = corev1_pb2.PodListMsg()
+        lst.ParseFromString(raw)
+        assert sorted(m.name for m in lst.items) == ["p0", "p1", "p2"]
+        assert lst.resource_version > 0
+
+        # selectors + pagination compose with the proto path
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/api/v1/pods?limit=2", None,
+                     {"Accept": PROTO_CONTENT_TYPE})
+        r = conn.getresponse()
+        body = r.read()
+        conn.close()
+        _, raw = decode_envelope(body)
+        lst = corev1_pb2.PodListMsg()
+        lst.ParseFromString(raw)
+        assert len(lst.items) == 2 and lst.continue_token
+        assert lst.remaining == 1
+
+        # item GET + node list
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/api/v1/nodes/n0", None,
+                     {"Accept": PROTO_CONTENT_TYPE})
+        r = conn.getresponse()
+        body = r.read()
+        conn.close()
+        kind, raw = decode_envelope(body)
+        assert kind == "Node"
+        msg = corev1_pb2.NodeMsg()
+        msg.ParseFromString(raw)
+        assert node_from_pb(msg) == hub.truth_nodes["n0"]
+
+        # a JSON client is untouched
+        code, doc = req(port, "GET", "/api/v1/pods")
+        assert code == 200 and doc["kind"] == "PodList"
+    finally:
+        srv.close()
+
+
+def test_grpc_feed_rides_typed_deltas():
+    grpc = pytest.importorskip("grpc")
+
+    from kubernetes_tpu.grpc_shim import (
+        GrpcSchedulerClient,
+        SnapshotDeltaBridge,
+        TpuSchedulerService,
+        serve_grpc,
+    )
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.sim import Reflector
+
+    hub = HollowCluster(seed=73, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=8000, pods=60))
+    remote = Scheduler(clock=hub.clock, enable_preemption=False)
+    svc = TpuSchedulerService(remote)
+    server, port = serve_grpc(remote, service=svc)
+    try:
+        client = GrpcSchedulerClient(f"127.0.0.1:{port}")
+        bridge = SnapshotDeltaBridge(hub, client, lock=hub.lock)
+        assert bridge.proto_feed  # typed deltas are the default
+        hub.create_pod(make_pod("w0", cpu_milli=100))
+        hub.step()
+        bridge.pump()
+        # the remote cache materialized objects from TYPED payloads
+        assert remote.cache.node("n0") is not None
+        assert (remote.cache.pod("default/w0") is not None
+                or remote.queue.pod("default/w0") is not None)
+    finally:
+        server.stop(0)
