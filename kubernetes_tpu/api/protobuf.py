@@ -50,9 +50,14 @@ def pod_to_pb(pod: Pod) -> pb.PodMsg:
         probe_initial_delay_s=(
             float(pod.readiness_probe.initial_delay_s)
             if pod.readiness_probe is not None else 0.0),
-        ready=bool(pod.ready),
+        # the Ready condition exists only for probed pods in the JSON
+        # slice (pod_to_json emits it conditionally) — mirror that here
+        # or from_pb(to_pb(x)) and from_json(to_json(x)) diverge on a
+        # probe-less ready=True pod
+        ready=bool(pod.ready) if pod.readiness_probe is not None else False,
         nominated_node_name=pod.nominated_node_name,
         phase=pod.phase,
+        deletion_timestamp=float(pod.deletion_timestamp),
     )
     m.labels.update(pod.labels)
     m.node_selector.update(pod.node_selector)
@@ -85,6 +90,7 @@ def pod_from_pb(m: pb.PodMsg) -> Pod:
         ready=m.ready,
         nominated_node_name=m.nominated_node_name,
         phase=m.phase or "Pending",
+        deletion_timestamp=m.deletion_timestamp,
     )
 
 

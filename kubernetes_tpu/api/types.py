@@ -285,6 +285,20 @@ POD_PENDING = "Pending"
 POD_RUNNING = "Running"
 POD_SUCCEEDED = "Succeeded"
 POD_FAILED = "Failed"
+#: node-unreachable: the pod may well still be running and holding its
+#: node's resources — NOT terminal (gc_controller.go:100)
+POD_UNKNOWN = "Unknown"
+
+
+def is_pod_terminated(pod) -> bool:
+    """isPodTerminated (pkg/controller/podgc/gc_controller.go:100): any
+    phase other than Pending/Running/Unknown is terminal. Terminal pods
+    hold no node resources (the kubelet has released them) and are
+    invisible to the scheduler — the reference scheduler's informer uses
+    a ``status.phase!=Succeeded,status.phase!=Failed`` field selector
+    (factory.go NewPodInformer), so a terminal phase hop reaches it as a
+    DELETE event."""
+    return pod.phase not in (POD_PENDING, POD_RUNNING, POD_UNKNOWN)
 
 
 @dataclass(frozen=True)
@@ -390,6 +404,13 @@ class Pod:
     #: referenced controller is gone gets background-deleted
     #: (sim.HollowCluster.gc_owner_graph)
     owner_refs: Tuple["OwnerReference", ...] = ()
+    #: run-to-completion analog (a container that exits 0 after this many
+    #: seconds of Running): the hollow kubelet hops the phase to
+    #: Succeeded and LEAVES the object in the store — the real kubelet
+    #: never deletes API pods; cleanup of terminal pods is the pod GC
+    #: controller's job (podgc/gc_controller.go:94 terminatedPodThreshold).
+    #: None = a service-style pod that runs until deleted.
+    run_duration_s: Optional[float] = None
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"

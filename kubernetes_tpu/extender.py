@@ -30,6 +30,30 @@ from kubernetes_tpu.config import ExtenderConfig
 # ---------------------------------------------------------------------------
 
 
+def _rfc3339(epoch_s: float) -> str:
+    """Seconds-epoch -> RFC3339 with microseconds (Go's time.Time JSON
+    unmarshal accepts fractional RFC3339, so a metav1.Time-shaped
+    consumer parses this; wire precision is 1 µs — the hub floors its
+    terminating epsilon there)."""
+    import datetime
+
+    return datetime.datetime.fromtimestamp(
+        epoch_s, datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+def rfc3339_to_epoch(v) -> float:
+    """Inverse of :func:`_rfc3339` (fractional seconds optional); also
+    accepts a bare number (the hub's internal clock is a float epoch)."""
+    import datetime
+
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v)
+    fmt = "%Y-%m-%dT%H:%M:%S.%fZ" if "." in s else "%Y-%m-%dT%H:%M:%SZ"
+    return datetime.datetime.strptime(s, fmt).replace(
+        tzinfo=datetime.timezone.utc).timestamp()
+
+
 def pod_to_json(pod: Pod) -> dict:
     """A v1.Pod-shaped document carrying the fields the scheduler consumes
     (metadata + the scheduling-relevant spec/status slice)."""
@@ -44,6 +68,13 @@ def pod_to_json(pod: Pod) -> dict:
                  **({"uid": r.uid} if r.uid else {})}
                 for r in pod.owner_refs
             ]} if pod.owner_refs else {}),
+            # metadata.deletionTimestamp as RFC3339 (metav1.Time
+            # unmarshals only from that shape — a float here would break
+            # any Go-side consumer of the extender wire). A terminating
+            # pod must cross the wire as terminating or the remote
+            # side's skipPodSchedule/preemption checks go blind.
+            **({"deletionTimestamp": _rfc3339(pod.deletion_timestamp)}
+               if pod.deletion_timestamp else {}),
         },
         "spec": {
             "nodeName": pod.node_name,

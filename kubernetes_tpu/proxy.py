@@ -111,8 +111,11 @@ def pod_endpoint_ready(p) -> bool:
     terminating, and — when a readiness probe exists — probe-ready. A
     probe-less pod is ready as soon as it is placed (the reference's
     status_manager defaults Ready=true with no probes)."""
-    return bool(p.node_name) and not p.deletion_timestamp and (
-        p.readiness_probe is None or p.ready)
+    from kubernetes_tpu.api.types import is_pod_terminated
+
+    return (bool(p.node_name) and not p.deletion_timestamp
+            and not is_pod_terminated(p)
+            and (p.readiness_probe is None or p.ready))
 
 
 class EndpointsController:

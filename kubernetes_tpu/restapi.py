@@ -1562,12 +1562,36 @@ class RestServer:
                     a = pod_from_json(merged)
                     b = pod_from_json(cur_doc)
                     canon = pod_to_json(a)
+                    # Foreign fields split two ways (review r5 round 5):
+                    # paths the TRUTH MODEL carries but the wire
+                    # projection doesn't (tolerations, affinity,
+                    # volumes, limits, ports...) are real data the
+                    # facade cannot patch — comparing would silently
+                    # drop a semantic change, so they 422. Paths modeled
+                    # NOWHERE (containers[].image, env...) are dropped
+                    # by the lenient decode exactly as POST drops them —
+                    # otherwise re-applying the manifest that CREATED
+                    # the pod (kubectl apply's 'unchanged' path) would
+                    # fail on fields the create accepted.
+                    # (containers[].image is deliberately NOT guarded:
+                    # the decode drops it at POST too, so truth never
+                    # holds a REST-created pod's image — lenient is the
+                    # only symmetric choice; ImageLocality images exist
+                    # for in-process pods only)
+                    guarded = ("tolerations", "affinity", "volumes",
+                               "limits", "ports", "restartPolicy",
+                               "topologySpreadConstraints",
+                               "priorityClassName")
+                    fk = [
+                        p
+                        for part in ("spec", "status")
+                        for p in foreign_keys(merged.get(part),
+                                              canon.get(part))
+                        if any(g in p for g in guarded)
+                    ]
                     same = (
                         dataclasses.replace(a, labels=b.labels) == b
-                        and not foreign_keys(merged.get("spec"),
-                                             canon.get("spec"))
-                        and not foreign_keys(merged.get("status"),
-                                             canon.get("status"))
+                        and not fk
                     )
                 except Exception:
                     same = False
@@ -1586,6 +1610,27 @@ class RestServer:
                                "metadata.namespace is immutable")
             if meta.get("uid", cur.uid) != cur.uid:
                 return h._fail(422, "Invalid", "metadata.uid is immutable")
+            # metadata keys the rebuild below actually carries: labels
+            # (mutable) + the server-owned fields echoed back verbatim.
+            # Anything else (annotations, finalizers, ownerReferences
+            # edits...) would be SILENTLY dropped by the
+            # labels-only rebuild — reject it instead (review finding:
+            # the spec/status gate never fires on a metadata-only
+            # patch, so this was the remaining silent-drop hole).
+            # Same split as the spec side: metadata the projection
+            # CARRIES (ownerReferences, deletionTimestamp) is
+            # server-owned — a patch may only echo it unchanged, else
+            # 422 (the labels-only rebuild cannot apply the edit).
+            # Metadata modeled nowhere (annotations, finalizers,
+            # managedFields — real kubectl apply always writes the
+            # last-applied annotation) is dropped as leniently as POST
+            # dropped it, keeping apply's 'unchanged' path working.
+            cur_meta = cur_doc.get("metadata") or {}
+            for k in ("ownerReferences", "deletionTimestamp"):
+                if k in meta and meta.get(k) != cur_meta.get(k):
+                    return h._fail(
+                        422, "Invalid",
+                        f"metadata.{k} is server-owned on this facade")
             import dataclasses
 
             new = dataclasses.replace(

@@ -289,7 +289,15 @@ class Scheduler:
         """eventhandlers.go:215/:256 — unassigned pods queue for scheduling
         (only this scheduler's, per the informer FilterFunc); assigned pods
         enter the cache whoever bound them, and may unblock affinity
-        waiters."""
+        waiters. Terminal pods never enter: the reference scheduler's pod
+        informer lists with ``status.phase!=Succeeded,status.phase!=Failed``
+        (factory.go NewPodInformer nonTerminatedPodSelector) — enforced at
+        this sink so EVERY feed (in-process emit, Reflector, gRPC bridge)
+        gets the same view without each needing the selector."""
+        from kubernetes_tpu.api.types import is_pod_terminated
+
+        if is_pod_terminated(pod):
+            return
         if pod.node_name:
             self.cache.add_pod(pod)
             self.queue.assigned_pod_added(pod)
@@ -297,6 +305,15 @@ class Scheduler:
             self.queue.add(pod)
 
     def on_pod_update(self, old: Pod, new: Pod) -> None:
+        from kubernetes_tpu.api.types import is_pod_terminated
+
+        if is_pod_terminated(new):
+            # terminal phase hop: the field-selected informer delivers
+            # this as a DELETE (the pod left the selector) — its node
+            # capacity is released even on feeds without the selector
+            # (the gRPC snapshot bridge, a selector-less Reflector)
+            self.on_pod_delete(new)
+            return
         if new.node_name:
             # a Permit-parked pod bound by another writer must leave the
             # waiting map BEFORE cache.add_pod flips its state to ADDED —
@@ -426,6 +443,10 @@ class Scheduler:
             self._record_metrics(res)
             return res
         cycle = self.queue.scheduling_cycle
+        # skipPodSchedule (scheduler.go:335): a pod already marked for
+        # deletion is dropped from the cycle, not retried — its DELETED
+        # event (kubelet kill or pod-GC) is the terminal outcome
+        batch = [p for p in batch if not p.deletion_timestamp]
         res.attempted = len(batch)
         fw = self.framework
 

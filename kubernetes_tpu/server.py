@@ -33,6 +33,14 @@ def parse_quantity(s, is_cpu: bool = False) -> float:
     return quantity.parse_cpu(s) if is_cpu else quantity.parse_quantity(s)
 
 
+def _parse_deletion_ts(v) -> float:
+    if not v:
+        return 0.0
+    from kubernetes_tpu.extender import rfc3339_to_epoch
+
+    return rfc3339_to_epoch(v)
+
+
 def pod_from_json(d: dict) -> Pod:
     """Inverse of extender.pod_to_json for the fields the kernels read."""
     from kubernetes_tpu.api.types import POD_PENDING, ReadinessProbe
@@ -82,6 +90,7 @@ def pod_from_json(d: dict) -> Pod:
         nominated_node_name=(d.get("status") or {}).get("nominatedNodeName", ""),
         preemption_policy=spec.get("preemptionPolicy")
         or "PreemptLowerPriority",
+        deletion_timestamp=_parse_deletion_ts(meta.get("deletionTimestamp")),
     )
 
 

@@ -258,6 +258,16 @@ class Job:
     #: owning CronJob name ("" = standalone) — the ownerReference edge
     #: the GC graph walks (cronjob-spawned jobs cascade on its deletion)
     owner: str = ""
+    #: spec.ttlSecondsAfterFinished (batch/v1 JobSpec): when set, the
+    #: TTL-after-finished controller deletes the Job this many seconds
+    #: after it finishes (ttlafterfinished_controller.go:263 needsCleanup:
+    #: finished AND ttl non-nil). None = keep forever (the default).
+    ttl_seconds_after_finished: Optional[float] = None
+    #: status.completionTime analog — stamped by the job sync on the tick
+    #: ``done()`` first becomes true; the TTL clock starts here, not at
+    #: the last pod's exit (timeLeft computes expiry from CompletionTime,
+    #: ttlafterfinished_controller.go:277).
+    finished_at: Optional[float] = None
 
     def done(self) -> bool:
         return self.succeeded >= self.completions
@@ -433,8 +443,13 @@ class HollowKubelet:
         self.mem_pressure_frac = mem_pressure_frac
 
     def pods(self) -> List[Pod]:
+        """Live (non-terminal) pods bound here — a Succeeded pod's
+        containers have exited, so it holds no resources and exerts no
+        memory pressure (the kubelet's podWorkers have released it)."""
+        from kubernetes_tpu.api.types import is_pod_terminated
+
         return [p for p in self.hub.truth_pods.values()
-                if p.node_name == self.name]
+                if p.node_name == self.name and not is_pod_terminated(p)]
 
     def heartbeat(self) -> None:
         if self.alive:
@@ -445,12 +460,18 @@ class HollowKubelet:
         binding order (latest bindings lose, like late OutOfcpu arrivals).
         ``keys`` lets the hub pass a pre-grouped pod list (one O(P) pass
         for all nodes instead of one scan per node)."""
+        from kubernetes_tpu.api.types import is_pod_terminated
+
         nd = self.hub.truth_nodes.get(self.name)
         if nd is None:
             return
         if keys is None:
             keys = [k for k, p in self.hub.truth_pods.items()
                     if p.node_name == self.name]
+        # terminal pods have released their resources (podWorker done) —
+        # they neither consume the budget nor get evicted by it
+        keys = [k for k in keys
+                if not is_pod_terminated(self.hub.truth_pods[k])]
         keys = sorted(
             keys, key=lambda k: self.hub.resource_version.get(f"pods/{k}", 0))
         cpu = mem = cnt = 0.0
@@ -588,6 +609,22 @@ class HollowCluster:
         #: pod key -> bind commit time (job completion clock; set by
         #: confirm_binding)
         self._bound_at: Dict[str, float] = {}
+        #: pod key -> create commit time (metadata.creationTimestamp
+        #: analog) — the pod GC's oldest-first ordering key
+        #: (gc_controller.go:117 byCreationTimestamp)
+        self._created_at: Dict[str, float] = {}
+        #: keys whose scheduler-side DELETE was already emitted at the
+        #: terminal phase hop (the informer field-selector turns
+        #: Running->Succeeded into a delete; the later object delete must
+        #: not emit a second one)
+        self._terminal_gone: set = set()
+        #: pod GC: keep at most this many terminal pods
+        #: (--terminated-pod-gc-threshold; 0 disables that half, the
+        #: controller-manager default — gc_controller.go:94)
+        self.terminated_pod_threshold: int = 0
+        self.pods_gced_total = 0
+        #: pod key -> graceful-deletion grace seconds (mark_terminating)
+        self._term_grace: Dict[str, float] = {}
         #: live PDB objects; the disruption-controller analog maintains
         #: their status and the scheduler's pdb_lister reads them directly
         self.pdbs: List = []
@@ -813,6 +850,8 @@ class HollowCluster:
             # consumer that round-trips pods through the JSON seam
             pod.uid = f"{pod.key()}#u{self._revision + 1}"
         self.truth_pods[pod.key()] = pod
+        self._created_at[pod.key()] = self.clock.t
+        self._terminal_gone.discard(pod.key())  # recreated key: fresh pod
         self._commit(f"pods/{pod.key()}", "ADDED", pod)
         self._emit(f"pods/{pod.key()}", lambda: self.sched.on_pod_add(pod))
 
@@ -840,9 +879,17 @@ class HollowCluster:
         if pod is not None:
             self._bound_at.pop(key, None)
             self._started_at.pop(key, None)
+            self._created_at.pop(key, None)
+            self._term_grace.pop(key, None)
             self.app_health.pop(key, None)
             self._commit(f"pods/{key}", "DELETED", None)
-            self._emit(f"pods/{key}", lambda: self.sched.on_pod_delete(pod))
+            if key in self._terminal_gone:
+                # the scheduler's field-selected informer already saw the
+                # delete at the terminal phase hop — no second event
+                self._terminal_gone.discard(key)
+            else:
+                self._emit(f"pods/{key}",
+                           lambda: self.sched.on_pod_delete(pod))
             for rs in self.replicasets.values():
                 rs.live.pop(key, None)
             for ds in self.daemonsets.values():
@@ -1102,16 +1149,56 @@ class HollowCluster:
         - probe-less pods never write Ready (they are ready-by-default,
           see proxy.pod_endpoint_ready).
 
+        - run-to-completion pods (``run_duration_s``) hop Running ->
+          Succeeded after their duration and STAY in the store — the
+          kubelet never deletes API pods; terminal cleanup is the pod
+          GC controller's (reconcile_pod_gc). The scheduler observes
+          the hop as a DELETE (its informer's
+          ``status.phase!=Succeeded,...`` field selector, factory.go
+          NewPodInformer) and the node's capacity is released.
+
         One O(P) scan for all nodes, like kubelet_admission."""
         import dataclasses
 
-        from kubernetes_tpu.api.types import POD_PENDING, POD_RUNNING
+        from kubernetes_tpu.api.types import (
+            POD_PENDING,
+            POD_RUNNING,
+            POD_SUCCEEDED,
+            is_pod_terminated,
+        )
 
         for key, p in list(self.truth_pods.items()):
             if not p.node_name:
                 continue
+            if is_pod_terminated(p):
+                if p.deletion_timestamp:
+                    # ran to completion while a graceful delete was
+                    # pending: the kill is already complete — finish the
+                    # delete now, independent of kubelet liveness
+                    self.delete_pod(key)
+                continue
             kl = self.kubelets.get(p.node_name)
             if kl is None or not kl.alive:
+                continue
+            if (p.deletion_timestamp
+                    and self.clock.t - p.deletion_timestamp
+                    >= self._term_grace.get(key, 30.0)):
+                # graceful kill complete: the kubelet's status sync
+                # triggers the final grace-0 delete (status_manager
+                # syncPod -> deletePod)
+                self.delete_pod(key)
+                continue
+            if (p.phase == POD_RUNNING and p.run_duration_s is not None
+                    and self._started_at.get(key) is not None
+                    and self.clock.t - self._started_at[key]
+                    >= p.run_duration_s):
+                done = dataclasses.replace(p, phase=POD_SUCCEEDED,
+                                           ready=False)
+                self.truth_pods[key] = done
+                self._commit(f"pods/{key}", "MODIFIED", done)
+                self._terminal_gone.add(key)
+                self._emit(f"pods/{key}",
+                           lambda pod=p: self.sched.on_pod_delete(pod))
                 continue
             changes = {}
             if p.phase == POD_PENDING:
@@ -1521,6 +1608,85 @@ class HollowCluster:
         for name, kl in list(self.kubelets.items()):
             kl.admit(by_node.get(name, []))
 
+    def mark_terminating(self, key: str, grace_s: float = 30.0) -> None:
+        """Graceful DELETE: stamp metadata.deletionTimestamp and let the
+        owning kubelet finish the kill after ``grace_s`` (the apiserver's
+        graceful-deletion path, registry/core/pod/strategy.go
+        CheckGracefulDelete). An UNBOUND pod has no kubelet to confirm
+        termination — that leak is exactly what the pod GC's
+        gcUnscheduledTerminating half collects (gc_controller.go:172)."""
+        import dataclasses
+
+        from kubernetes_tpu.api.types import is_pod_terminated
+
+        pod = self.truth_pods.get(key)
+        if pod is None or pod.deletion_timestamp:
+            return
+        if is_pod_terminated(pod):
+            # registry CheckGracefulDelete (pod/strategy.go): a pod whose
+            # containers have exited deletes immediately — grace is for
+            # running workloads, and no kubelet kill is pending
+            self.delete_pod(key)
+            return
+        terminating = dataclasses.replace(
+            pod, deletion_timestamp=self.clock.t or 1e-6)
+        self.truth_pods[key] = terminating
+        self._term_grace[key] = grace_s
+        self._commit(f"pods/{key}", "MODIFIED", terminating)
+        self._emit(f"pods/{key}",
+                   lambda old=pod, new=terminating:
+                   self.sched.on_pod_update(old, new))
+
+    def reconcile_pod_gc(self) -> None:
+        """The pod GC controller (podgc/gc_controller.go:94 gc), minus
+        the orphan half which lives in :meth:`gc_orphaned` (it doubles
+        as the consistency oracle's precondition so it runs more often):
+
+        - ``terminated_pod_threshold`` > 0: keep at most that many
+          terminal (Succeeded/Failed) pods, deleting oldest-by-creation
+          first (gc_controller.go:108 gcTerminated sorts
+          byCreationTimestamp and deletes count-threshold);
+        - unscheduled terminating pods (deletionTimestamp set, no node)
+          are force-deleted — no kubelet will ever confirm their
+          termination (gc_controller.go:172 gcUnscheduledTerminating).
+        """
+        from kubernetes_tpu.api.types import is_pod_terminated
+
+        if self.terminated_pod_threshold > 0:
+            terminated = [k for k, p in self.truth_pods.items()
+                          if is_pod_terminated(p)]
+            excess = len(terminated) - self.terminated_pod_threshold
+            if excess > 0:
+                terminated.sort(
+                    key=lambda k: (self._created_at.get(k, 0.0), k))
+                for k in terminated[:excess]:
+                    self.delete_pod(k)
+                    self.pods_gced_total += 1
+        for key, p in list(self.truth_pods.items()):
+            if p.deletion_timestamp and not p.node_name:
+                self.delete_pod(key)
+                self.pods_gced_total += 1
+
+    def reconcile_ttl_after_finished(self) -> None:
+        """The TTL-after-finished controller
+        (ttlafterfinished_controller.go:186 processJob): delete a
+        finished Job once ``ttl_seconds_after_finished`` has elapsed
+        since its completion time. The Job's leftover pods cascade
+        through the ownerRef GC graph (their Job owner is gone); a
+        spawning CronJob's bookkeeping entry is dropped so its
+        concurrency accounting can't see a ghost."""
+        for name in list(self.jobs):
+            j = self.jobs[name]
+            if (j.ttl_seconds_after_finished is None or not j.done()
+                    or j.finished_at is None):
+                continue
+            if self.clock.t - j.finished_at < j.ttl_seconds_after_finished:
+                continue
+            del self.jobs[name]
+            for cj in self.cronjobs.values():
+                if name in cj.spawned:
+                    cj.spawned.remove(name)
+
     def attach_cloud(self, cloud) -> None:
         """Run the cluster under an external cloud provider: the cloud
         node controller initializes uninitialized-tainted nodes and
@@ -1873,6 +2039,9 @@ class HollowCluster:
                     self.truth_pods[key] = done
                     self._commit(f"pods/{key}", "MODIFIED", done)
                     self.delete_pod(key)  # Succeeded -> cleaned up
+            if j.done() and j.finished_at is None:
+                # status.completionTime — the TTL-after-finished clock
+                j.finished_at = self.clock.t
             while (not j.done()
                    and len(j.active) < j.parallelism
                    and j.succeeded + len(j.active) < j.completions):
@@ -2018,7 +2187,7 @@ class HollowCluster:
         # value is also 0.0, so floor at a positive epsilon or the hop
         # would be invisible to every `not deletion_timestamp` consumer
         terminating = dataclasses.replace(
-            pod, deletion_timestamp=self.clock.t or 1e-9)
+            pod, deletion_timestamp=self.clock.t or 1e-6)
         self.truth_pods[key] = terminating
         self._commit(f"pods/{key}", "MODIFIED", terminating)
         self.delete_pod(key)
@@ -2202,8 +2371,10 @@ class HollowCluster:
         self.reconcile_service_accounts()
         self.reconcile_ttl()
         self.reconcile_node_ipam()
+        self.reconcile_ttl_after_finished()
         self.reconcile_controllers()
         self.gc_owner_graph()
+        self.reconcile_pod_gc()
         if self.pvcs or self.pvs:
             self.reconcile_volumes()
         if (self.pvs or self.attachments
@@ -2235,7 +2406,13 @@ class HollowCluster:
         - no node over-committed in truth (cpu/memory/pod count),
         - every truth-bound pod landed on a live node."""
         self.settle()
-        truth = {k: p.node_name for k, p in self.truth_pods.items()}
+        from kubernetes_tpu.api.types import is_pod_terminated
+
+        # terminal pods are deliberately absent from the scheduler cache
+        # (their phase hop reached it as a DELETE — the informer field
+        # selector); the comparer sees the same filtered view
+        truth = {k: p.node_name for k, p in self.truth_pods.items()
+                 if not is_pod_terminated(p)}
         node_diffs, pod_diffs = compare(self.sched, truth, list(self.truth_nodes))
         assert not node_diffs, f"cache/truth node diffs: {node_diffs}"
         assert not pod_diffs, f"cache/truth pod diffs: {pod_diffs}"
@@ -2245,6 +2422,8 @@ class HollowCluster:
                 assert p.node_name in self.truth_nodes, (
                     f"{p.key()} bound to dead node {p.node_name}"
                 )
+                if is_pod_terminated(p):
+                    continue  # exited containers hold no resources
                 by_node.setdefault(p.node_name, []).append(p)
         for name, pods in by_node.items():
             nd = self.truth_nodes[name]

@@ -390,11 +390,11 @@ def test_ktpu_apply_create_then_configure(tmp_path, capsys):
         srv.close()
 
 
-def test_pod_patch_rejects_fields_outside_the_wire_projection():
-    """Review finding r5 round 2: a patch introducing a spec field the
-    wire projection does not carry (tolerations, image, ...) must 422 —
-    the projection would silently swallow it and the semantic-equality
-    fallback would wave the patch through as a no-op."""
+def test_pod_patch_rejects_modeled_fields_outside_the_wire_projection():
+    """A patch touching a spec field the TRUTH MODEL carries but the
+    wire projection doesn't (tolerations, affinity, volumes, limits,
+    ports) must 422 — applying it is impossible and waving it through
+    would silently drop a real semantic change."""
     from tests.test_restapi import make_pod_doc
 
     hub, srv, port = cluster()
@@ -403,13 +403,78 @@ def test_pod_patch_rejects_fields_outside_the_wire_projection():
             make_pod_doc("p0"))
         for patch in (
             {"spec": {"tolerations": [{"key": "k", "operator": "Exists"}]}},
-            {"spec": {"containers": [{"name": "main", "image": "nginx",
-                                      "resources": {"requests":
-                                                    {"cpu": "100m"}}}]}},
-            {"spec": {"activeDeadlineSeconds": 30}},
+            {"spec": {"affinity": {"nodeAffinity": {}}}},
+            {"spec": {"containers": [{"name": "main", "resources": {
+                "requests": {"cpu": "100m"},
+                "limits": {"cpu": "200m"}}}]}},
         ):
             code, doc = patch_req(
                 port, "/api/v1/namespaces/default/pods/p0", patch)
             assert code == 422, (patch, code, doc)
+    finally:
+        srv.close()
+
+
+def test_pod_patch_apply_is_idempotent_on_unmodeled_fields():
+    """kubectl-apply idempotency (review finding r5 round 5): re-sending
+    the exact manifest that CREATED the pod must 200 as an unchanged
+    no-op even when it carries fields modeled NOWHERE (containers[0]
+    .image, env) — POST dropped them leniently, so the PATCH comparison
+    must drop them the same way, not 422."""
+    from tests.test_restapi import make_pod_doc
+
+    hub, srv, port = cluster()
+    try:
+        doc = make_pod_doc("p0")
+        doc["spec"]["containers"][0]["image"] = "nginx:1.25"
+        req(port, "POST", "/api/v1/namespaces/default/pods", doc)
+        code, out = patch_req(
+            port, "/api/v1/namespaces/default/pods/p0", doc)
+        assert code == 200, (code, out)
+        # and the stored pod is unchanged
+        assert hub.truth_pods["default/p0"].labels == (
+            doc["metadata"].get("labels") or {})
+    finally:
+        srv.close()
+
+
+def test_pod_patch_metadata_split_semantics():
+    """Metadata follows the same split as spec (review r5 round 5):
+    modeled-nowhere keys (annotations — real kubectl apply always
+    writes last-applied-configuration — finalizers) drop as leniently
+    as POST dropped them, keeping apply's 'unchanged' path working;
+    projection-carried server-owned keys (ownerReferences,
+    deletionTimestamp) may only be echoed unchanged — an edit 422s."""
+    from tests.test_restapi import make_pod_doc
+
+    hub, srv, port = cluster()
+    try:
+        req(port, "POST", "/api/v1/namespaces/default/pods",
+            make_pod_doc("p0"))
+        # lenient: annotations/finalizers are modeled nowhere
+        for patch in (
+            {"metadata": {"annotations": {
+                "kubectl.kubernetes.io/last-applied-configuration": "{}"}}},
+            {"metadata": {"finalizers": ["x"]}},
+        ):
+            code, doc = patch_req(
+                port, "/api/v1/namespaces/default/pods/p0", patch)
+            assert code == 200, (patch, code, doc)
+        # server-owned: an ownerReferences edit is rejected
+        code, doc = patch_req(
+            port, "/api/v1/namespaces/default/pods/p0",
+            {"metadata": {"ownerReferences": [
+                {"kind": "ReplicaSet", "name": "rs-x"}]}})
+        assert code == 422, (code, doc)
+        code, doc = patch_req(
+            port, "/api/v1/namespaces/default/pods/p0",
+            {"metadata": {"deletionTimestamp": "2026-01-01T00:00:00Z"}})
+        assert code == 422, (code, doc)
+        # labels still patch fine
+        code, doc = patch_req(
+            port, "/api/v1/namespaces/default/pods/p0",
+            {"metadata": {"labels": {"app": "web"}}})
+        assert code == 200, (code, doc)
+        assert hub.truth_pods["default/p0"].labels == {"app": "web"}
     finally:
         srv.close()

@@ -59,6 +59,24 @@ def test_codec_parity_with_json_wire_slice():
     assert node_from_pb(node_to_pb(n)) == node_from_json(node_to_json(n))
 
 
+def test_codec_parity_terminating_and_probeless_ready():
+    """Two review-r5 asymmetries pinned: (a) deletionTimestamp crosses
+    both wires — a terminating pod must not arrive live on the remote
+    side; (b) a probe-less ready=True pod serializes identically on
+    both (the JSON slice emits the Ready condition only for probed
+    pods; proto must mirror that, not carry ready unconditionally)."""
+    term = dataclasses.replace(rich_pod(), deletion_timestamp=17.5)
+    assert pod_from_pb(pod_to_pb(term)).deletion_timestamp == 17.5
+    assert pod_from_json(pod_to_json(term)).deletion_timestamp == 17.5
+    assert pod_from_pb(pod_to_pb(term)) == pod_from_json(pod_to_json(term))
+
+    probeless = dataclasses.replace(
+        make_pod("p2", cpu_milli=100, node_name="n1"), ready=True)
+    assert probeless.readiness_probe is None
+    assert (pod_from_pb(pod_to_pb(probeless))
+            == pod_from_json(pod_to_json(probeless)))
+
+
 def test_envelope_magic_and_round_trip():
     p = rich_pod()
     data = encode_envelope("Pod", pod_to_pb(p))
