@@ -571,6 +571,16 @@ class HollowCluster:
         #: lookup (sa_token_user) answers None immediately.
         self.service_accounts: Dict[str, ServiceAccount] = {}
         self.sa_tokens: Dict[str, str] = {}  # token -> "ns/name"
+        #: certificates.k8s.io: CSR objects + the live credential
+        #: registry the authn chain consults (cert -> (UserInfo,
+        #: not_after)); expired certs leave the registry — lookup-time
+        #: NotAfter (kubernetes_tpu/certificates.py)
+        self.csrs: Dict[str, object] = {}
+        self.signed_certs: Dict[str, tuple] = {}
+        self.cluster_ca = f"ktpu-ca:{seed}"
+        #: ConfigMaps ("ns/name" -> {"data": {...}}) — enough surface
+        #: for the root-CA publisher; namespace drain removes them
+        self.configmaps: Dict[str, dict] = {}
         #: TTL controller hysteresis step (ttl_controller.go boundaryStep)
         self._ttl_step = 0
         #: nodeipam range allocator: cluster CIDR carved into per-node
@@ -658,6 +668,13 @@ class HollowCluster:
             if admission else None
         )
         self.quota_controller = QuotaController(self)
+        from kubernetes_tpu.certificates import (
+            CertificateController,
+            RootCACertPublisher,
+        )
+
+        self.cert_controller = CertificateController(self)
+        self.root_ca_publisher = RootCACertPublisher(self)
         #: cloud node controller (kubernetes_tpu/cloud.py) — None until
         #: attach_cloud(); once attached, EVERY node is cloud-managed
         #: (instance gone at the provider ⇒ node object removed)
@@ -1401,6 +1418,50 @@ class HollowCluster:
 
         return service_account_user(ns, name)
 
+    def credential_user(self, credential: str):
+        """One lookup over EVERY live hub-minted identity — SA tokens
+        (tokens controller) and signed node certificates (CSR signer).
+        Plug into auth.ServiceAccountAuthenticator as ``lookup`` to
+        accept both on one seam."""
+        return (self.sa_token_user(credential)
+                or self.cert_user(credential))
+
+    # -- certificates.k8s.io (kubernetes_tpu/certificates.py) --------------
+
+    def create_csr(self, csr) -> None:
+        """CSR create (the apiserver stamps spec.username from the
+        authenticated requestor; callers of this seam have already
+        authenticated — node_bootstrap_csr builds the right shape)."""
+        if csr.name in self.csrs:
+            raise ValueError(
+                f'certificatesigningrequests "{csr.name}" already exists')
+        csr.created_at = self.clock.t
+        self.csrs[csr.name] = csr
+        self._commit(f"certificatesigningrequests/{csr.name}", "ADDED", csr)
+
+    def cert_user(self, credential: str):
+        """Live lookup for the authn chain: UserInfo for a valid signed
+        node credential, None for unknown/expired — the client-cert
+        verification path, modeled as a bearer credential (see
+        kubernetes_tpu/certificates.py module docstring)."""
+        entry = self.signed_certs.get(credential)
+        if entry is None:
+            return None
+        user, not_after = entry
+        if self.clock.t >= not_after:
+            return None
+        return user
+
+    def put_configmap(self, namespace: str, name: str, data: dict) -> None:
+        key = f"{namespace}/{name}"
+        etype = "MODIFIED" if key in self.configmaps else "ADDED"
+        self.configmaps[key] = {"data": dict(data)}
+        self._commit(f"configmaps/{key}", etype, self.configmaps[key])
+
+    def delete_configmap(self, key: str) -> None:
+        if self.configmaps.pop(key, None) is not None:
+            self._commit(f"configmaps/{key}", "DELETED", None)
+
     def _desired_attachments(self) -> Dict[str, set]:
         """Desired state: volume identity -> set of nodes with bound pods
         whose volumes resolve to an attachable backend (in-tree PD kinds
@@ -1744,6 +1805,8 @@ class HollowCluster:
             for key in [k for k in self.leases if k.startswith(prefix)]:
                 del self.leases[key]
                 self._commit(f"leases/{key}", "DELETED", None)
+            for key in [k for k in self.configmaps if k.startswith(prefix)]:
+                self.delete_configmap(key)
             dropped_pvc = False
             for key in [k for k in self.pvcs if k.startswith(prefix)]:
                 pvc = self.pvcs.pop(key)
@@ -2369,6 +2432,8 @@ class HollowCluster:
         # unconditional: an (impossible today) empty namespaces dict must
         # still REVOKE — gating here would freeze dead tokens alive
         self.reconcile_service_accounts()
+        self.cert_controller.reconcile()
+        self.root_ca_publisher.reconcile()
         self.reconcile_ttl()
         self.reconcile_node_ipam()
         self.reconcile_ttl_after_finished()

@@ -1,0 +1,152 @@
+"""certificates.k8s.io controllers: the kubelet TLS-bootstrap flow
+(approver recognizers + SubjectAccessReview, sarapprove.go:58), the
+signer minting live credentials (cfssl_signer.go:117), the cleaner
+(cleaner.go:40), NotAfter expiry at the authn lookup, and the root-CA
+publisher (rootcacertpublisher/publisher.go)."""
+
+import pytest
+
+from kubernetes_tpu.auth import (
+    Attributes,
+    ServiceAccountAuthenticator,
+    UserInfo,
+)
+from kubernetes_tpu.certificates import (
+    BOOTSTRAPPERS_GROUP,
+    NODES_GROUP,
+    ROOT_CA_CONFIGMAP,
+    CertificateSigningRequest,
+    is_node_client_csr,
+    is_self_node_client_csr,
+    node_bootstrap_csr,
+)
+from kubernetes_tpu.sim import HollowCluster
+from kubernetes_tpu.testing import make_node
+
+
+def _hub():
+    return HollowCluster(seed=91, scheduler_kw={"enable_preemption": False})
+
+
+def test_bootstrap_csr_is_approved_signed_and_authenticates():
+    """The full flow: bootstrap CSR -> approver (SAR against the
+    kubeadm-default bindings) -> signer -> the minted credential
+    authenticates as system:node:<name> in system:nodes."""
+    hub = _hub()
+    hub.add_node(make_node("n0", cpu_milli=4000))
+    hub.create_csr(node_bootstrap_csr("n0"))
+    hub.step()
+    csr = hub.csrs["csr-n0"]
+    assert csr.approved is True and csr.certificate
+    user = hub.cert_user(csr.certificate)
+    assert user == UserInfo(name="system:node:n0", groups=(NODES_GROUP,))
+    # and the composed authn seam accepts it as a bearer credential
+    authn = ServiceAccountAuthenticator(hub.credential_user)
+    got = authn.authenticate(
+        {"Authorization": f"Bearer {csr.certificate}"})
+    assert got.name == "system:node:n0"
+
+
+def test_unauthorized_requestor_stays_pending():
+    """A CSR whose requestor carries neither bootstrap nor nodes group
+    fails the SubjectAccessReview and stays PENDING — the reference
+    never auto-denies (sarapprove.go handle returns without updating)."""
+    hub = _hub()
+    csr = node_bootstrap_csr("nX", username="mallory", groups=("devs",))
+    hub.create_csr(csr)
+    hub.step()
+    assert hub.csrs["csr-nX"].approved is None
+    assert hub.csrs["csr-nX"].certificate == ""
+
+
+def test_self_renewal_requires_node_identity():
+    """selfnodeclient: only the node ITSELF (username == CN, nodes
+    group) takes the renewal binding; recognizer split per
+    sarapprove.go isSelfNodeClientCert."""
+    renew = node_bootstrap_csr(
+        "n0", username="system:node:n0", groups=(NODES_GROUP,))
+    assert is_self_node_client_csr(renew)
+    boot = node_bootstrap_csr("n0")
+    assert is_node_client_csr(boot) and not is_self_node_client_csr(boot)
+    hub = _hub()
+    hub.create_csr(renew)
+    hub.step()
+    assert hub.csrs["csr-n0"].certificate
+
+
+def test_wrong_usages_not_recognized():
+    """A CSR requesting server-auth usages is NOT a node-client shape —
+    unrecognized, left pending (certificate_controller_utils.go usage
+    set check)."""
+    csr = node_bootstrap_csr("n0")
+    csr.usages = ("server auth", "digital signature")
+    assert not is_node_client_csr(csr)
+    hub = _hub()
+    hub.create_csr(csr)
+    hub.step()
+    assert hub.csrs["csr-n0"].approved is None
+
+
+def test_certificate_expiry_revokes_at_lookup():
+    """NotAfter: an expired credential authenticates as nothing — the
+    registry drops it on the next controller pass."""
+    hub = _hub()
+    hub.cert_controller.cert_duration_s = 60.0
+    hub.create_csr(node_bootstrap_csr("n0"))
+    hub.step()
+    cert = hub.csrs["csr-n0"].certificate
+    assert hub.cert_user(cert) is not None
+    for _ in range(6):  # 90 s at the 15 s tick
+        hub.step()
+    assert hub.cert_user(cert) is None
+
+
+def test_cleaner_removes_csr_objects_not_credentials():
+    """cleaner.go: the signed CSR OBJECT ages out after its TTL, but the
+    issued credential lives until NotAfter."""
+    hub = _hub()
+    hub.cert_controller.signed_ttl_s = 30.0
+    hub.create_csr(node_bootstrap_csr("n0"))
+    hub.step()
+    cert = hub.csrs["csr-n0"].certificate
+    for _ in range(4):
+        hub.step()
+    assert "csr-n0" not in hub.csrs
+    assert hub.cert_user(cert) is not None
+    assert hub.cert_controller.cleaned_total == 1
+
+
+def test_duplicate_csr_create_rejected():
+    hub = _hub()
+    hub.create_csr(node_bootstrap_csr("n0"))
+    with pytest.raises(ValueError):
+        hub.create_csr(node_bootstrap_csr("n0"))
+
+
+def test_root_ca_published_to_every_active_namespace():
+    """rootcacertpublisher: kube-root-ca.crt in every Active namespace,
+    recreated if deleted, gone with the namespace."""
+    hub = _hub()
+    hub.add_namespace("team-a")
+    hub.step()
+    key = f"team-a/{ROOT_CA_CONFIGMAP}"
+    assert hub.configmaps[key]["data"]["ca.crt"] == hub.cluster_ca
+    assert f"default/{ROOT_CA_CONFIGMAP}" in hub.configmaps
+    # recreated when deleted
+    hub.delete_configmap(key)
+    hub.step()
+    assert key in hub.configmaps
+    # removed with the namespace
+    hub.terminate_namespace("team-a")
+    hub.step()
+    assert key not in hub.configmaps
+
+
+def test_csr_events_in_watch_history():
+    """The approval/signing hops are committed, watchable writes."""
+    hub = _hub()
+    cur = hub.watch(hub._revision)
+    hub.create_csr(node_bootstrap_csr("n0"))
+    hub.step()
+    kinds = [key.split("/")[0] for _, key, _, _ in cur.poll()]
+    assert "certificatesigningrequests" in kinds
