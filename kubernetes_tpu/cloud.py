@@ -49,9 +49,11 @@ class Instance:
 
 
 class CloudProvider:
-    """cloudprovider.Interface slice: Instances + Zones. Implementations
-    raise KeyError for unknown nodes (the NotFound the controller maps
-    to 'instance gone')."""
+    """cloudprovider.Interface slice: Instances + Zones, plus the
+    LoadBalancer and Routes halves the service/route controllers
+    consume (cloud.go LoadBalancer()/Routes()). Implementations raise
+    KeyError for unknown nodes (the NotFound the controller maps to
+    'instance gone')."""
 
     def instance(self, node_name: str) -> Instance:
         raise NotImplementedError
@@ -62,15 +64,51 @@ class CloudProvider:
         except KeyError:
             return False
 
+    # -- LoadBalancer (cloud.go:116) ---------------------------------------
+
+    def ensure_load_balancer(self, cluster: str, svc_key: str,
+                             node_names: Tuple[str, ...]) -> str:
+        """Create-or-update the external balancer for one service over
+        the given backend node set; returns the ingress address
+        (EnsureLoadBalancer is explicitly idempotent-upsert)."""
+        raise NotImplementedError
+
+    def ensure_load_balancer_deleted(self, cluster: str,
+                                     svc_key: str) -> None:
+        raise NotImplementedError
+
+    # -- Routes (cloud.go:134) ---------------------------------------------
+
+    def list_routes(self, cluster: str) -> Dict[str, str]:
+        """node name -> destination CIDR."""
+        raise NotImplementedError
+
+    def create_route(self, cluster: str, node_name: str,
+                     cidr: str) -> None:
+        raise NotImplementedError
+
+    def delete_route(self, cluster: str, node_name: str) -> None:
+        raise NotImplementedError
+
 
 class FakeCloud(CloudProvider):
-    """The fake in-tree provider: a dict of instances, mutable by tests
-    (terminate() is the cloud-side VM deletion the lifecycle controller
-    must notice)."""
+    """The fake in-tree provider: dicts of instances / balancers /
+    routes, mutable by tests (terminate() is the cloud-side VM deletion
+    the lifecycle controller must notice; ``fail_routes`` makes
+    create_route raise — the cloud-quota failure the route controller
+    must surface, not crash on)."""
 
     def __init__(self, provider: str = "fake") -> None:
         self.provider = provider
         self.instances: Dict[str, Instance] = {}
+        #: svc key -> {"ingress": ip, "nodes": (names...)}
+        self.load_balancers: Dict[str, dict] = {}
+        self._lb_next = 1
+        #: cluster routes: node name -> pod CIDR
+        self.routes: Dict[str, str] = {}
+        self.fail_routes = False
+        self.lb_calls = 0
+        self.route_calls = 0
 
     def add_instance(self, inst: Instance) -> None:
         if not inst.provider_id:
@@ -84,6 +122,35 @@ class FakeCloud(CloudProvider):
     def instance(self, node_name: str) -> Instance:
         return self.instances[node_name]
 
+    def ensure_load_balancer(self, cluster: str, svc_key: str,
+                             node_names: Tuple[str, ...]) -> str:
+        self.lb_calls += 1
+        lb = self.load_balancers.get(svc_key)
+        if lb is None:
+            # TEST-NET-1 — an address range no real backend answers
+            lb = {"ingress": f"192.0.2.{self._lb_next}", "nodes": ()}
+            self._lb_next += 1
+            self.load_balancers[svc_key] = lb
+        lb["nodes"] = tuple(sorted(node_names))
+        return lb["ingress"]
+
+    def ensure_load_balancer_deleted(self, cluster: str,
+                                     svc_key: str) -> None:
+        self.load_balancers.pop(svc_key, None)
+
+    def list_routes(self, cluster: str) -> Dict[str, str]:
+        return dict(self.routes)
+
+    def create_route(self, cluster: str, node_name: str,
+                     cidr: str) -> None:
+        self.route_calls += 1
+        if self.fail_routes:
+            raise RuntimeError("cloud route quota exceeded")
+        self.routes[node_name] = cidr
+
+    def delete_route(self, cluster: str, node_name: str) -> None:
+        self.routes.pop(node_name, None)
+
 
 def uninitialized_node(name: str, **node_kw) -> Node:
     """A node as the kubelet registers it under an external cloud
@@ -92,6 +159,99 @@ def uninitialized_node(name: str, **node_kw) -> Node:
     return dataclasses.replace(
         nd, taints=nd.taints + (Taint(TAINT_UNINITIALIZED, value="true",
                                       effect=EFFECT_NO_SCHEDULE),))
+
+
+class ServiceLBController:
+    """The service controller (pkg/controller/service/
+    service_controller.go:293 syncLoadBalancerIfNeeded): services of
+    Type=LoadBalancer get an external balancer over the READY,
+    schedulable node set; status.loadBalancer.ingress is written back
+    through the hub; a type change away from LoadBalancer (or service
+    deletion) tears the balancer down (needsCleanup). The node-set sync
+    (nodeSyncLoop, :632 includeNodeFromNodeList: Ready condition,
+    not-unschedulable) re-ensures every balancer when membership
+    changes."""
+
+    def __init__(self, hub, cloud: CloudProvider,
+                 cluster: str = "ktpu") -> None:
+        self.hub = hub
+        self.cloud = cloud
+        self.cluster = cluster
+        self.ensures = 0
+        self.teardowns = 0
+
+    def _backend_nodes(self) -> Tuple[str, ...]:
+        return tuple(sorted(
+            nd.name for nd in self.hub.truth_nodes.values()
+            if nd.conditions.ready and not nd.unschedulable))
+
+    def reconcile(self) -> None:
+        hub = self.hub
+        nodes = self._backend_nodes()
+        lb_services = set()
+        for key, svc in list(hub.services.items()):
+            if getattr(svc, "type", "ClusterIP") != "LoadBalancer":
+                continue
+            lb_services.add(key)
+            ingress = self.cloud.ensure_load_balancer(
+                self.cluster, key, nodes)
+            self.ensures += 1
+            if svc.load_balancer_ingress != ingress:
+                svc.load_balancer_ingress = ingress
+                hub._commit(f"services/{key}", "MODIFIED", svc)
+        # needsCleanup: balancers whose service is gone or no longer
+        # Type=LoadBalancer (the hub's delete_service cannot know about
+        # cloud state — this pass owns the teardown)
+        for key in [k for k in getattr(self.cloud, "load_balancers", {})
+                    if k not in lb_services]:
+            self.cloud.ensure_load_balancer_deleted(self.cluster, key)
+            self.teardowns += 1
+        # a service that LEFT LoadBalancer type keeps no stale ingress
+        for key, svc in hub.services.items():
+            if (getattr(svc, "type", "ClusterIP") != "LoadBalancer"
+                    and getattr(svc, "load_balancer_ingress", "")):
+                svc.load_balancer_ingress = ""
+                hub._commit(f"services/{key}", "MODIFIED", svc)
+
+
+class RouteController:
+    """The route controller (pkg/controller/route/
+    route_controller.go:139 reconcile): every node with a podCIDR gets
+    a cloud route; routes for deleted nodes (or stale CIDRs after a
+    same-name re-add) are removed. Success clears the node's
+    NetworkUnavailable condition (:222 updateNetworkingCondition) —
+    the gate that keeps pods off a node the dataplane can't reach;
+    a cloud-side create failure leaves the condition set and surfaces
+    as a counter, never a crash."""
+
+    def __init__(self, hub, cloud: CloudProvider,
+                 cluster: str = "ktpu") -> None:
+        self.hub = hub
+        self.cloud = cloud
+        self.cluster = cluster
+        self.create_failures = 0
+
+    def reconcile(self) -> None:
+        hub = self.hub
+        routes = self.cloud.list_routes(self.cluster)
+        want = {name: nd.pod_cidr
+                for name, nd in hub.truth_nodes.items() if nd.pod_cidr}
+        for name, cidr in routes.items():
+            if want.get(name) != cidr:
+                self.cloud.delete_route(self.cluster, name)
+        for name, cidr in want.items():
+            if routes.get(name) != cidr:
+                try:
+                    self.cloud.create_route(self.cluster, name, cidr)
+                except Exception:
+                    self.create_failures += 1
+                    continue
+            nd = hub.truth_nodes[name]
+            if nd.conditions.network_unavailable:
+                new = dataclasses.replace(
+                    nd, conditions=dataclasses.replace(
+                        nd.conditions, network_unavailable=False))
+                hub._update_node(new)
 
 
 class CloudNodeController:

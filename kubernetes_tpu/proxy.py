@@ -66,6 +66,12 @@ class Service:
     ports: Tuple[ServicePort, ...] = ()
     session_affinity: str = AFFINITY_NONE
     affinity_seconds: int = DEFAULT_AFFINITY_SECONDS
+    #: spec.type — ClusterIP/NodePort/LoadBalancer; LoadBalancer
+    #: additionally gets an external balancer from the service
+    #: controller when a cloud is attached (cloud.ServiceLBController)
+    type: str = "ClusterIP"
+    #: status.loadBalancer.ingress[0], written by the service controller
+    load_balancer_ingress: str = ""
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"

@@ -134,6 +134,12 @@ class ReplicaSet:
     #: owning Deployment's template revision this RS realizes (the
     #: pod-template-hash analog); orders old RSes during a rollout
     revision: int = 0
+    #: "ReplicaSet" or "ReplicationController" — the reference's RC
+    #: controller IS the ReplicaSet controller behind conversion
+    #: adapters (pkg/controller/replication/replication_controller.go:58
+    #: wraps replicaset.NewBaseController); the kind only changes the
+    #: ownerReference stamped on pods and the API group it serves under
+    kind: str = "ReplicaSet"
 
 
 @dataclass
@@ -610,6 +616,10 @@ class HollowCluster:
         #: pod key -> Running transition time (probe initialDelay clock)
         self._started_at: Dict[str, float] = {}
         self.replicasets: Dict[str, ReplicaSet] = {}
+        #: v1 ReplicationControllers — same machinery as ReplicaSets
+        #: (see ReplicaSet.kind), separate registry so the kinds can't
+        #: collide on a name
+        self.replication_controllers: Dict[str, ReplicaSet] = {}
         self.deployments: Dict[str, Deployment] = {}
         self.jobs: Dict[str, Job] = {}
         self.daemonsets: Dict[str, DaemonSet] = {}
@@ -679,6 +689,8 @@ class HollowCluster:
         #: attach_cloud(); once attached, EVERY node is cloud-managed
         #: (instance gone at the provider ⇒ node object removed)
         self.cloud_controller = None
+        self.service_lb_controller = None
+        self.route_controller = None
         self.binder = FlakyBinder(self, bind_fail_rate, self.rng)
         # stable signature of the caller's scheduler knobs — compared by
         # the checkpoint config guard (callables repr unstably and never
@@ -909,6 +921,8 @@ class HollowCluster:
                            lambda: self.sched.on_pod_delete(pod))
             for rs in self.replicasets.values():
                 rs.live.pop(key, None)
+            for rc in self.replication_controllers.values():
+                rc.live.pop(key, None)
             for ds in self.daemonsets.values():
                 ds.live.pop(key, None)
 
@@ -1640,6 +1654,7 @@ class HollowCluster:
         return {
             "Deployment": self.deployments,
             "ReplicaSet": self.replicasets,
+            "ReplicationController": self.replication_controllers,
             "Job": self.jobs,
             "DaemonSet": self.daemonsets,
             "StatefulSet": self.statefulsets,
@@ -1751,8 +1766,14 @@ class HollowCluster:
     def attach_cloud(self, cloud) -> None:
         """Run the cluster under an external cloud provider: the cloud
         node controller initializes uninitialized-tainted nodes and
-        removes nodes whose instance died (kubernetes_tpu/cloud.py)."""
+        removes nodes whose instance died; the service controller
+        provisions LoadBalancer services; the route controller installs
+        per-podCIDR cloud routes (kubernetes_tpu/cloud.py)."""
+        from kubernetes_tpu.cloud import RouteController, ServiceLBController
+
         self.cloud_controller = CloudNodeController(self, cloud)
+        self.service_lb_controller = ServiceLBController(self, cloud)
+        self.route_controller = RouteController(self, cloud)
 
     # -- namespaces / priority classes / quotas (admission seam) -------------
 
@@ -2054,11 +2075,12 @@ class HollowCluster:
                   and rs.owner in self.deployments
                   and name != self.deployments[rs.owner].rs_name()):
                 del self.replicasets[name]
-        # replicaset scale-down (deployment shrink, rolling drain, or
+        # replicaset/RC scale-down (deployment shrink, rolling drain, or
         # direct resize) — unassigned pods are deleted first, the
         # ActivePods ranking of controller_utils.go:722, which is what
         # keeps the rolling availability budget honest
-        for rs in self.replicasets.values():
+        for rs in (list(self.replicasets.values())
+                   + list(self.replication_controllers.values())):
             extra = len(rs.live) - rs.replicas
             if extra > 0:
                 victims = sorted(rs.live, key=lambda k: bool(
@@ -2115,17 +2137,24 @@ class HollowCluster:
                 if pod is None:
                     break
                 j.active[pod.key()] = pod
-        for rs in self.replicasets.values():
+        for rs in (list(self.replicasets.values())
+                   + list(self.replication_controllers.values())):
             while len(rs.live) < rs.replicas:
                 rs.next_idx += 1
                 # the owner label is revision-stable: a Service selecting
                 # {"deploy": name} spans old and new RSes mid-rollout
-                labels = {"rs": rs.name}
+                is_rc = rs.kind == "ReplicationController"
+                labels = {"rc": rs.name} if is_rc else {"rs": rs.name}
                 if rs.owner:
                     labels["deploy"] = rs.owner
-                pod = spawn(rs.name, rs.next_idx, labels,
+                # the reference's generateName random suffix is what
+                # keeps same-name RC and RS pods from colliding; the
+                # hollow deterministic naming needs a kind discriminator
+                # instead
+                pod = spawn(f"{rs.name}-rc" if is_rc else rs.name,
+                            rs.next_idx, labels,
                             rs.cpu_milli, rs.memory, rs.priority,
-                            owner=OwnerReference("ReplicaSet", rs.name))
+                            owner=OwnerReference(rs.kind, rs.name))
                 if pod is None:
                     break
                 rs.live[pod.key()] = pod
@@ -2218,6 +2247,20 @@ class HollowCluster:
             self.remove_node(name)
 
     # -- disruption controller (pkg/controller/disruption) ------------------
+
+    def add_replication_controller(self, name: str, replicas: int,
+                                   cpu_milli: float = 100,
+                                   memory: float = 256 * 2**20,
+                                   priority: int = 0) -> "ReplicaSet":
+        """v1 ReplicationController create — reconciled by the exact
+        ReplicaSet machinery (the reference's RC controller is the RS
+        controller behind conversion adapters, replication_controller
+        .go:58); pods carry kind=ReplicationController ownerReferences
+        so the GC graph keys on the right kind."""
+        rc = ReplicaSet(name, replicas, cpu_milli, memory, priority,
+                        kind="ReplicationController")
+        self.replication_controllers[name] = rc
+        return rc
 
     def add_pdb(self, pdb) -> None:
         self.pdbs.append(pdb)
@@ -2419,6 +2462,8 @@ class HollowCluster:
         self.reconcile_pdbs()
         if self.cloud_controller is not None:
             self.cloud_controller.reconcile()
+            self.service_lb_controller.reconcile()
+            self.route_controller.reconcile()
         if self.admission is not None:
             self.reconcile_namespaces()
             self.quota_controller.reconcile()

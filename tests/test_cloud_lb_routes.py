@@ -1,0 +1,157 @@
+"""Service LoadBalancer + route controllers over the cloud seam, and
+the v1 ReplicationController riding the ReplicaSet machinery.
+
+References: pkg/controller/service/service_controller.go:293
+syncLoadBalancerIfNeeded (+ :632 node inclusion), pkg/controller/route/
+route_controller.go:139 reconcile (+ NetworkUnavailable clearing),
+pkg/controller/replication/replication_controller.go:58 (RC == RS
+behind conversion adapters)."""
+
+import dataclasses
+
+from kubernetes_tpu.cloud import FakeCloud, Instance
+from kubernetes_tpu.proxy import Service, ServicePort
+from kubernetes_tpu.sim import HollowCluster
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _cloud_hub(n_nodes=2):
+    hub = HollowCluster(seed=17, scheduler_kw={"enable_preemption": False})
+    cloud = FakeCloud()
+    for i in range(n_nodes):
+        cloud.add_instance(Instance(f"n{i}", zone="z0", region="r0"))
+        hub.add_node(make_node(f"n{i}", cpu_milli=4000))
+    hub.attach_cloud(cloud)
+    return hub, cloud
+
+
+def test_lb_service_gets_ingress_over_ready_nodes():
+    hub, cloud = _cloud_hub()
+    hub.add_service(Service(
+        "web", selector={"app": "web"}, type="LoadBalancer",
+        ports=(ServicePort(port=80, target_port=8080),)))
+    hub.create_pod(make_pod("w1", cpu_milli=100, labels={"app": "web"}))
+    hub.step()
+    svc = hub.services["default/web"]
+    assert svc.load_balancer_ingress.startswith("192.0.2.")
+    lb = cloud.load_balancers["default/web"]
+    assert lb["nodes"] == ("n0", "n1")
+    hub.check_consistency()
+
+
+def test_lb_backend_set_tracks_node_membership():
+    """nodeSyncLoop: cordoning a node removes it from every balancer's
+    backend set on the next pass."""
+    hub, cloud = _cloud_hub()
+    hub.add_service(Service("web", selector={"app": "web"},
+                            type="LoadBalancer"))
+    hub.step()
+    assert cloud.load_balancers["default/web"]["nodes"] == ("n0", "n1")
+    nd = hub.truth_nodes["n0"]
+    hub._update_node(dataclasses.replace(nd, unschedulable=True))
+    hub.step()
+    assert cloud.load_balancers["default/web"]["nodes"] == ("n1",)
+
+
+def test_lb_torn_down_on_delete_and_type_change():
+    hub, cloud = _cloud_hub()
+    hub.add_service(Service("a", selector={"x": "1"}, type="LoadBalancer"))
+    hub.add_service(Service("b", selector={"x": "2"}, type="LoadBalancer"))
+    hub.step()
+    assert set(cloud.load_balancers) == {"default/a", "default/b"}
+    hub.delete_service("default/a")
+    hub.services["default/b"].type = "ClusterIP"
+    hub.step()
+    assert cloud.load_balancers == {}
+    assert hub.services["default/b"].load_balancer_ingress == ""
+
+
+def test_routes_follow_pod_cidrs_and_clear_network_condition():
+    """Every podCIDR node gets a cloud route; the route's creation
+    clears NetworkUnavailable; a deleted node's route is withdrawn."""
+    hub, cloud = _cloud_hub()
+    # nodes register network-unavailable until routes exist
+    for name in list(hub.truth_nodes):
+        nd = hub.truth_nodes[name]
+        hub._update_node(dataclasses.replace(
+            nd, conditions=dataclasses.replace(
+                nd.conditions, network_unavailable=True)))
+    hub.step()  # nodeipam assigns podCIDRs
+    hub.step()  # route controller installs on the next pass
+    want = {n: nd.pod_cidr for n, nd in hub.truth_nodes.items()}
+    assert cloud.list_routes("ktpu") == want
+    assert all(not nd.conditions.network_unavailable
+               for nd in hub.truth_nodes.values())
+    hub.remove_node("n1")
+    hub.step()
+    assert "n1" not in cloud.list_routes("ktpu")
+
+
+def test_route_create_failure_counts_not_crashes():
+    hub, cloud = _cloud_hub()
+    cloud.fail_routes = True
+    hub.step()  # nodeipam assigns podCIDRs
+    hub.step()  # route pass attempts creates and fails
+    assert hub.route_controller.create_failures > 0
+    cloud.fail_routes = False
+    hub.step()
+    assert cloud.list_routes("ktpu")  # retried and installed
+
+
+def test_replication_controller_keeps_replicas():
+    hub = HollowCluster(seed=23, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=4000, pods=16))
+    rc = hub.add_replication_controller("rc-a", replicas=3)
+    for _ in range(3):
+        hub.step()
+    assert len(rc.live) == 3
+    pods = [hub.truth_pods[k] for k in rc.live]
+    assert all(p.owner_refs[0].kind == "ReplicationController"
+               for p in pods)
+    assert all(p.labels.get("rc") == "rc-a" for p in pods)
+    # a killed pod is replaced with a fresh uid
+    victim = next(iter(rc.live))
+    hub.delete_pod(victim)
+    hub.step()
+    assert len(rc.live) == 3
+    hub.check_consistency()
+
+
+def test_replication_controller_cascade_on_delete():
+    """RC gone -> its pods cascade through the ownerRef GC graph."""
+    hub = HollowCluster(seed=29, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=4000, pods=16))
+    hub.add_replication_controller("rc-a", replicas=2)
+    for _ in range(2):
+        hub.step()
+    assert sum(1 for p in hub.truth_pods.values()
+               if p.labels.get("rc") == "rc-a") == 2
+    del hub.replication_controllers["rc-a"]
+    hub.step()
+    assert not any(p.labels.get("rc") == "rc-a"
+                   for p in hub.truth_pods.values())
+    hub.check_consistency()
+
+
+def test_rc_and_rs_same_name_do_not_collide():
+    """Separate registries + kind-keyed GC: an RS and an RC sharing a
+    name own their pods independently."""
+    from kubernetes_tpu.sim import ReplicaSet
+
+    hub = HollowCluster(seed=31, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=8000, pods=32))
+    hub.replicasets["twin"] = ReplicaSet("twin", 2)
+    hub.add_replication_controller("twin", replicas=2)
+    for _ in range(2):
+        hub.step()
+    rs_pods = [k for k, p in hub.truth_pods.items()
+               if p.owner_refs and p.owner_refs[0].kind == "ReplicaSet"]
+    rc_pods = [k for k, p in hub.truth_pods.items()
+               if p.owner_refs
+               and p.owner_refs[0].kind == "ReplicationController"]
+    assert len(rs_pods) == 2 and len(rc_pods) == 2
+    del hub.replication_controllers["twin"]
+    hub.step()
+    # only the RC's pods cascaded
+    assert all(k in hub.truth_pods for k in rs_pods)
+    assert not any(k in hub.truth_pods for k in rc_pods)
