@@ -1127,7 +1127,14 @@ class RestServer:
         cacher's watchFilterFunction does: matching ADDED/MODIFIED pass
         through, a MODIFIED whose new state no longer matches becomes a
         DELETED frame (the selector-scoped-feed contract informer caches
-        rely on), non-matching ADDED are dropped. One approximation vs
+        rely on), non-matching ADDED are dropped.
+
+        ``allowWatchBookmarks=true`` appends a final BOOKMARK frame
+        carrying the revision this poll reached (cacher.go
+        bookmarkAfterResourceVersion / watch_cache_interval): a watcher
+        whose selector filters out all traffic still advances its
+        anchor, so compaction of the quiet interval cannot 410 it into
+        a full relist — exactly the reference's reason for bookmarks. One approximation vs
         the reference: the cacher tracks prevObject and suppresses
         DELETED frames for objects the watcher never matched; this
         stateless poll-watch cannot, so such frames may be sent — an
@@ -1184,6 +1191,15 @@ class RestServer:
                 doc = pod_to_json(obj) if kind == "pods" else node_to_json(obj)
                 doc.setdefault("metadata", {})["resourceVersion"] = str(rev)
             lines.append(json.dumps({"type": etype, "object": doc}))
+        if (query.get("allowWatchBookmarks") or ["false"])[0] in (
+                "true", "1"):
+            mark = events[-1][0] if events else self.hub._revision
+            lines.append(json.dumps({
+                "type": "BOOKMARK",
+                "object": {"kind": "Pod" if kind == "pods" else "Node",
+                           "apiVersion": "v1",
+                           "metadata": {"resourceVersion": str(mark)}},
+            }))
         body = ("\n".join(lines) + ("\n" if lines else "")).encode()
         h._send_raw(200, "application/json;stream=watch", body)
 

@@ -169,6 +169,54 @@ def test_watch_stream_and_compaction_gone():
         srv.close()
 
 
+def test_watch_bookmarks_advance_quiet_watchers_past_compaction():
+    """allowWatchBookmarks (cacher.go bookmark events): a watcher whose
+    selector matches NO traffic still advances its resourceVersion via
+    the trailing BOOKMARK frame — so compacting the quiet interval does
+    not 410 it into a relist. Without bookmarks the same watcher is
+    expired."""
+    hub = HollowCluster(seed=51, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+
+    def watch(path):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", path)
+        r = conn.getresponse()
+        raw = r.read()
+        conn.close()
+        if r.status != 200:
+            return r.status, json.loads(raw)
+        return r.status, [json.loads(l) for l in raw.splitlines() if l]
+
+    try:
+        code, doc = req(port, "GET", "/api/v1/nodes")
+        rv0 = int(doc["metadata"]["resourceVersion"])
+        # traffic the selector will NOT match
+        req(port, "POST", "/api/v1/nodes", NODE)
+        for i in range(3):
+            req(port, "POST", "/api/v1/namespaces/default/pods",
+                make_pod_doc(f"web-{i}"))
+        sel = "app%3Dnothing-matches"
+        code, events = watch(
+            f"/api/v1/watch/pods?resourceVersion={rv0}"
+            f"&labelSelector={sel}&allowWatchBookmarks=true")
+        assert code == 200
+        assert [e["type"] for e in events] == ["BOOKMARK"]
+        mark = int(events[-1]["object"]["metadata"]["resourceVersion"])
+        assert mark > rv0
+        hub.compact(mark)  # the quiet interval is compacted away
+        # bookmark-anchored re-watch survives...
+        code, events = watch(
+            f"/api/v1/watch/pods?resourceVersion={mark}"
+            f"&labelSelector={sel}&allowWatchBookmarks=true")
+        assert code == 200
+        # ...while the bookmark-less anchor is expired
+        code, doc = watch(f"/api/v1/watch/pods?resourceVersion={rv0}")
+        assert code == 410 and doc["reason"] == "Expired"
+    finally:
+        srv.close()
+
+
 def test_admission_rejection_surfaces_as_403():
     hub = HollowCluster(seed=6, admission=True,
                         scheduler_kw={"enable_preemption": False})
