@@ -135,9 +135,7 @@ def join_node(hub: HollowCluster, token: str, node: Node) -> None:
     """``kubeadm join``: token discovery then kubelet self-registration.
     Raises :class:`BootstrapError` on a bad/expired token (the TLS
     bootstrap rejection)."""
-    tokens = getattr(hub, "bootstrap_tokens", None)
-    if tokens is None:
-        raise BootstrapError("join: cluster was not kubeadm-initialized")
+    tokens = hub.bootstrap_tokens
     tid, _, secret = token.partition(".")
     tok = tokens.get(tid)
     if tok is None or tok.secret != secret:
@@ -148,3 +146,80 @@ def join_node(hub: HollowCluster, token: str, node: Node) -> None:
     if node.name in hub.truth_nodes:
         raise BootstrapError(f"join: node {node.name!r} already registered")
     hub.add_node(node)  # kubelet self-registration (ADDED event + agent)
+
+
+# ---------------------------------------------------------------------------
+# Bootstrap-token controllers (pkg/controller/bootstrap)
+# ---------------------------------------------------------------------------
+
+#: where the signer publishes discovery state (bootstrapapi constants:
+#: the cluster-info ConfigMap in kube-public that `kubeadm join` reads
+#: ANONYMOUSLY, verified via a token-keyed detached signature)
+KUBE_PUBLIC = "kube-public"
+CLUSTER_INFO = "cluster-info"
+JWS_PREFIX = "jws-kubeconfig-"
+
+
+def _detached_signature(token_id: str, secret: str, content: str) -> str:
+    """The ComputeDetachedSignature analog (cluster-bootstrap/token/jws):
+    an HMAC keyed on the full token over the kubeconfig content —
+    possession of EITHER half alone cannot forge it, holding both
+    verifies the published CA out-of-band."""
+    import hashlib
+    import hmac as hmac_mod
+
+    return hmac_mod.new(f"{token_id}.{secret}".encode(), content.encode(),
+                        hashlib.sha256).hexdigest()
+
+
+def token_cleaner(hub: HollowCluster) -> int:
+    """TokenCleaner (bootstrap/tokencleaner.go:59): proactively delete
+    expired bootstrap tokens — join_node's lazy check only fires when
+    someone USES the dead token; this pass revokes it for the
+    authenticator too. Returns how many were deleted."""
+    dead = [tid for tid, tok in hub.bootstrap_tokens.items()
+            if tok.expired(hub.clock.t)]
+    for tid in dead:
+        del hub.bootstrap_tokens[tid]
+    return len(dead)
+
+
+def bootstrap_signer(hub: HollowCluster) -> None:
+    """BootstrapSigner (bootstrap/bootstrapsigner.go:73 signConfigMap):
+    maintain the kube-public/cluster-info ConfigMap — the kubeconfig
+    (cluster CA + endpoint) plus one ``jws-kubeconfig-<id>`` detached
+    signature per SIGNING-usage live token; signatures for gone tokens
+    are removed (the reference strips all and recomputes)."""
+    kubeconfig = (
+        f"apiVersion: v1\nkind: Config\nclusters:\n- cluster:\n"
+        f"    certificate-authority-data: {hub.cluster_ca}\n"
+        f"    server: https://{getattr(hub, 'cluster_config', None) and hub.cluster_config.control_plane_name or 'control-plane'}:6443\n"
+    )
+    data = {"kubeconfig": kubeconfig}
+    for tid, tok in hub.bootstrap_tokens.items():
+        if "signing" not in tok.usages or tok.expired(hub.clock.t):
+            continue
+        data[f"{JWS_PREFIX}{tid}"] = _detached_signature(
+            tid, tok.secret, kubeconfig)
+    cur = hub.configmaps.get(f"{KUBE_PUBLIC}/{CLUSTER_INFO}")
+    if cur is None or cur.get("data") != data:
+        hub.put_configmap(KUBE_PUBLIC, CLUSTER_INFO, data)
+
+
+def verify_cluster_info(hub: HollowCluster, token: str) -> str:
+    """The join-side discovery check (kubeadm token-based discovery:
+    fetch cluster-info anonymously, verify the JWS for YOUR token,
+    then trust the embedded CA). Returns the verified kubeconfig or
+    raises :class:`BootstrapError`."""
+    cm = hub.configmaps.get(f"{KUBE_PUBLIC}/{CLUSTER_INFO}")
+    if cm is None:
+        raise BootstrapError("discovery: cluster-info not published")
+    tid, _, secret = token.partition(".")
+    kubeconfig = cm["data"].get("kubeconfig", "")
+    sig = cm["data"].get(f"{JWS_PREFIX}{tid}")
+    if sig is None:
+        raise BootstrapError(
+            f"discovery: no signature for token id {tid!r}")
+    if sig != _detached_signature(tid, secret, kubeconfig):
+        raise BootstrapError("discovery: cluster-info signature mismatch")
+    return kubeconfig

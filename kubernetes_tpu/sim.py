@@ -670,7 +670,12 @@ class HollowCluster:
         self.namespaces: Dict[str, Namespace] = {
             "default": Namespace("default", NS_ACTIVE),
             "kube-system": Namespace("kube-system", NS_ACTIVE),
+            "kube-public": Namespace("kube-public", NS_ACTIVE),
         }
+        #: bootstrap tokens (kubeadm bootstraptoken phase mints; the
+        #: token-cleaner controller expires; the bootstrap signer signs
+        #: cluster-info with them — kubernetes_tpu/bootstrap.py)
+        self.bootstrap_tokens: Dict[str, object] = {}
         self.priority_classes: Dict[str, object] = {}
         self.quotas: List = []
         self.admission = (
@@ -1432,13 +1437,35 @@ class HollowCluster:
 
         return service_account_user(ns, name)
 
+    def bootstrap_token_user(self, credential: str):
+        """The bootstrap-token authenticator
+        (plugin/pkg/auth/authenticator/token/bootstrap): a live,
+        authentication-usage, unexpired ``id.secret`` token
+        authenticates as ``system:bootstrap:<id>`` in the
+        system:bootstrappers group — the identity whose CSRs the
+        approver's nodeclient binding admits."""
+        tid, dot, secret = credential.partition(".")
+        if not dot:
+            return None
+        tok = self.bootstrap_tokens.get(tid)
+        if (tok is None or tok.secret != secret
+                or "authentication" not in tok.usages
+                or tok.expired(self.clock.t)):
+            return None
+        from kubernetes_tpu.auth import UserInfo
+        from kubernetes_tpu.certificates import BOOTSTRAPPERS_GROUP
+
+        return UserInfo(name=f"system:bootstrap:{tid}",
+                        groups=(BOOTSTRAPPERS_GROUP,))
+
     def credential_user(self, credential: str):
         """One lookup over EVERY live hub-minted identity — SA tokens
-        (tokens controller) and signed node certificates (CSR signer).
-        Plug into auth.ServiceAccountAuthenticator as ``lookup`` to
-        accept both on one seam."""
+        (tokens controller), signed node certificates (CSR signer), and
+        bootstrap tokens. Plug into auth.ServiceAccountAuthenticator as
+        ``lookup`` to accept all three on one seam."""
         return (self.sa_token_user(credential)
-                or self.cert_user(credential))
+                or self.cert_user(credential)
+                or self.bootstrap_token_user(credential))
 
     # -- certificates.k8s.io (kubernetes_tpu/certificates.py) --------------
 
@@ -1783,7 +1810,7 @@ class HollowCluster:
 
     #: namespaces every entry point refuses to delete (the apiserver
     #: protects these; one guard here so no seam can bypass it)
-    PROTECTED_NAMESPACES = ("default", "kube-system")
+    PROTECTED_NAMESPACES = ("default", "kube-system", "kube-public")
 
     def terminate_namespace(self, name: str) -> None:
         """Mark Terminating; the namespace-controller pass in step() then
@@ -2479,6 +2506,18 @@ class HollowCluster:
         self.reconcile_service_accounts()
         self.cert_controller.reconcile()
         self.root_ca_publisher.reconcile()
+        if self.bootstrap_tokens or (
+                f"kube-public/cluster-info" in self.configmaps):
+            # bootstrap-token controllers (kubernetes_tpu/bootstrap.py):
+            # cleaner expires tokens, signer keeps cluster-info's
+            # signature set in lockstep with the live token set
+            from kubernetes_tpu.bootstrap import (
+                bootstrap_signer,
+                token_cleaner,
+            )
+
+            token_cleaner(self)
+            bootstrap_signer(self)
         self.reconcile_ttl()
         self.reconcile_node_ipam()
         self.reconcile_ttl_after_finished()

@@ -1,0 +1,95 @@
+"""Bootstrap-token controllers (pkg/controller/bootstrap): the signer
+maintaining kube-public/cluster-info with per-token detached signatures
+(bootstrapsigner.go:73), the cleaner expiring tokens
+(tokencleaner.go:59), the bootstrap-token authenticator feeding the CSR
+flow, and the full kubeadm-join trust path end to end: token ->
+verified discovery -> join -> CSR -> signed node credential."""
+
+import pytest
+
+from kubernetes_tpu.bootstrap import (
+    CLUSTER_INFO,
+    JWS_PREFIX,
+    KUBE_PUBLIC,
+    BootstrapError,
+    create_token,
+    init_cluster,
+    join_node,
+    token_cleaner,
+    verify_cluster_info,
+)
+from kubernetes_tpu.certificates import node_bootstrap_csr
+from kubernetes_tpu.testing import make_node
+
+
+def test_signer_publishes_cluster_info_with_signatures():
+    hub, token = init_cluster()
+    hub.step()
+    cm = hub.configmaps[f"{KUBE_PUBLIC}/{CLUSTER_INFO}"]
+    tid = token.split(".")[0]
+    assert hub.cluster_ca in cm["data"]["kubeconfig"]
+    assert f"{JWS_PREFIX}{tid}" in cm["data"]
+    # discovery verifies with the right token...
+    assert "certificate-authority-data" in verify_cluster_info(hub, token)
+    # ...and rejects a forged secret
+    with pytest.raises(BootstrapError):
+        verify_cluster_info(hub, f"{tid}.aaaaaaaaaaaaaaaa")
+
+
+def test_signature_set_tracks_live_tokens():
+    hub, token1 = init_cluster()
+    token2 = create_token(hub, ttl_s=30.0)  # expires after 2 ticks
+    hub.step()
+    cm = hub.configmaps[f"{KUBE_PUBLIC}/{CLUSTER_INFO}"]
+    assert len([k for k in cm["data"] if k.startswith(JWS_PREFIX)]) == 2
+    for _ in range(3):
+        hub.step()  # cleaner expires token2; signer strips its signature
+    tid2 = token2.split(".")[0]
+    assert tid2 not in hub.bootstrap_tokens
+    cm = hub.configmaps[f"{KUBE_PUBLIC}/{CLUSTER_INFO}"]
+    assert f"{JWS_PREFIX}{tid2}" not in cm["data"]
+    assert f"{JWS_PREFIX}{token1.split('.')[0]}" in cm["data"]
+
+
+def test_cleaner_revokes_for_authenticator_and_join():
+    hub, _ = init_cluster()
+    short = create_token(hub, ttl_s=10.0)
+    assert hub.bootstrap_token_user(short) is not None
+    hub.clock.advance(60.0)
+    assert token_cleaner(hub) == 1
+    assert hub.bootstrap_token_user(short) is None
+    with pytest.raises(BootstrapError):
+        join_node(hub, short, make_node("late", cpu_milli=1000))
+
+
+def test_bootstrap_token_authenticates_as_bootstrapper():
+    hub, token = init_cluster()
+    user = hub.credential_user(token)
+    assert user.name == f"system:bootstrap:{token.split('.')[0]}"
+    assert "system:bootstrappers" in user.groups
+
+
+def test_kubeadm_join_trust_path_end_to_end():
+    """The full node-onboarding story the reference's flow implements:
+    verify cluster-info with the token, join, submit the node-client
+    CSR under the bootstrap identity, get a signed credential that
+    authenticates as the node."""
+    hub, token = init_cluster()
+    hub.step()
+    verify_cluster_info(hub, token)                 # trust established
+    join_node(hub, token, make_node("n1", cpu_milli=4000))
+    user = hub.credential_user(token)               # bootstrap identity
+    hub.create_csr(node_bootstrap_csr(
+        "n1", username=user.name, groups=user.groups))
+    hub.step()                                       # approve + sign
+    cert = hub.csrs["csr-n1"].certificate
+    assert cert
+    node_user = hub.credential_user(cert)
+    assert node_user.name == "system:node:n1"
+    assert "system:nodes" in node_user.groups
+
+
+def test_kube_public_is_protected():
+    hub, _ = init_cluster()
+    with pytest.raises(ValueError):
+        hub.terminate_namespace("kube-public")
