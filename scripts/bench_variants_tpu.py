@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Standalone variant-grid runner — the retry path for grid entries the
+full bench's 240 s per-entry deadline clipped on TPU (r5: secrets and
+pod_anti_affinity at 1000x1000 timed out while every earlier section
+passed; first-compile of their mask kernels is the suspect, so this
+runner gives each entry its own generous deadline and records
+compile-vs-run split by solving TWICE).
+
+Usage: python scripts/bench_variants_tpu.py [--variants a,b] [--out F]
+Writes one JSON document; safe to run while nothing else holds the
+chip. Pins to CPU automatically if the TPU probe fails (same dance as
+bench.py init_platform).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variants", default="secrets,pod_anti_affinity")
+    ap.add_argument("--nodes", type=int, default=1000)
+    ap.add_argument("--existing", type=int, default=1000)
+    ap.add_argument("--pods", type=int, default=2048)
+    ap.add_argument("--out", default="benchres/variants_tpu_retry.json")
+    args = ap.parse_args()
+
+    import bench  # repo-root bench.py: reuse its workload + runner
+
+    platform = bench.init_platform()
+    doc = {"platform": platform, "nodes": args.nodes,
+           "existing": args.existing, "pods": args.pods, "entries": {}}
+    for name in args.variants.split(","):
+        name = name.strip()
+        try:
+            w = bench.build_variant(name, args.nodes, args.existing,
+                                    args.pods)
+            t0 = time.perf_counter()
+            first = bench.run_batched(w, args.pods, cap=8)
+            cold_s = round(time.perf_counter() - t0, 3)
+            t0 = time.perf_counter()
+            warm = bench.run_batched(w, args.pods, cap=8)
+            warm_s = round(time.perf_counter() - t0, 3)
+            doc["entries"][name] = {
+                "cold_wall_s": cold_s, "warm_wall_s": warm_s,
+                "compile_overhead_s": round(cold_s - warm_s, 3),
+                "warm": warm,
+            }
+            print(f"# {name}: cold {cold_s}s warm {warm_s}s "
+                  f"({warm['pods_per_sec']} pods/s)", file=sys.stderr)
+            del w
+        except Exception as e:
+            doc["entries"][name] = {"error": f"{type(e).__name__}: {e}"}
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({"out": args.out, "platform": platform,
+                      "ok": [k for k, v in doc["entries"].items()
+                             if "error" not in v]}))
+
+
+if __name__ == "__main__":
+    main()

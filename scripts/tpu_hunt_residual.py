@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Residual-payload TPU hunt (round 5, after the main payload).
+
+The 03:58–04:51 UTC window landed the headline bench; the three
+residual pieces each failed when the chip went back UNAVAILABLE the
+moment the bench released it (shared chip — see
+benchres/solver_profile_tpu.txt.stderr: UNAVAILABLE at init, not a
+hang). This loop probes on a cadence and, on the next healthy window,
+runs in priority order:
+
+  (a) tests_tpu/ (the Pallas-on-hardware validation VERDICT r4 weak #3
+      asks for)  -> benchres/tests_tpu_r05_retry.txt
+  (b) the two variant-grid entries the 240 s deadline clipped
+      (secrets, pod_anti_affinity) -> benchres/variants_tpu_retry.json
+  (c) the TPU solver phase profile -> benchres/solver_profile_tpu.json
+
+Each stage in its own killable subprocess; every outcome appended to
+benchres/tpu_probes_r05.jsonl. Exits when all three are done (marker
+benchres/TPU_RESIDUAL_DONE) — stages that already succeeded are
+skipped on later windows.
+
+Run detached:
+  nohup python scripts/tpu_hunt_residual.py >/tmp/tpu_hunt2.log 2>&1 &
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from tpu_hunt import REPO, probe, record, run_stage  # noqa: E402
+
+DONE_MARK = os.path.join(REPO, "benchres", "TPU_RESIDUAL_DONE")
+STATE = os.path.join(REPO, "benchres", "tpu_residual_state.json")
+
+
+def load_state() -> dict:
+    try:
+        with open(STATE) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def save_state(st: dict) -> None:
+    with open(STATE, "w") as f:
+        json.dump(st, f)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=900.0)
+    ap.add_argument("--probe-timeout", type=float, default=120.0)
+    args = ap.parse_args()
+    record({"event": "residual_hunt_start", "interval_s": args.interval})
+    st = load_state()
+    while True:
+        if probe(args.probe_timeout):
+            if not st.get("tests_tpu"):
+                st["tests_tpu"] = run_stage(
+                    "tests_tpu_retry",
+                    [sys.executable, "-m", "pytest", "tests_tpu/", "-q",
+                     "--tb=short"],
+                    os.path.join(REPO, "benchres", "tests_tpu_r05_retry.txt"),
+                    timeout_s=1800,
+                )
+                save_state(st)
+            if st.get("tests_tpu") and not st.get("variants"):
+                st["variants"] = run_stage(
+                    "variants_retry",
+                    [sys.executable, "scripts/bench_variants_tpu.py",
+                     "--out", "benchres/variants_tpu_retry.json"],
+                    os.path.join(REPO, "benchres", "variants_tpu_retry.out"),
+                    timeout_s=1800,
+                )
+                save_state(st)
+            if st.get("variants") and not st.get("profile"):
+                st["profile"] = run_stage(
+                    "solver_profile_retry",
+                    [sys.executable, "scripts/solver_profile.py",
+                     "--out", "benchres/solver_profile_tpu.json"],
+                    os.path.join(REPO, "benchres", "solver_profile_tpu.out"),
+                    timeout_s=1800,
+                )
+                save_state(st)
+            if all(st.get(k) for k in ("tests_tpu", "variants", "profile")):
+                with open(DONE_MARK, "w") as f:
+                    f.write("ok\n")
+                record({"event": "residual_done", **st})
+                return
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()
