@@ -367,6 +367,59 @@ def cmd_get_events(rest: RestClient, args) -> int:
     return 0
 
 
+def cmd_get_csr(rest: RestClient, args) -> int:
+    """kubectl get csr (certificates.k8s.io/v1beta1): the CSR flow's
+    observable state — requestor, subject, condition."""
+    code, doc = rest.call(
+        "GET", "/apis/certificates.k8s.io/v1beta1/"
+               "certificatesigningrequests")
+    if code != 200:
+        return _rest_fail(doc)
+    rows = []
+    for it in doc["items"]:
+        conds = [c["type"] for c in it["status"].get("conditions", [])]
+        cond = ",".join(conds) or "Pending"
+        if it["status"].get("certificateIssued"):
+            cond += ",Issued"
+        rows.append([
+            it["metadata"]["name"],
+            it["spec"].get("username", ""),
+            it["spec"].get("request", {}).get("commonName", ""),
+            cond,
+        ])
+    print(_fmt_table(["NAME", "REQUESTOR", "SUBJECT", "CONDITION"], rows))
+    return 0
+
+
+def cmd_get_configmaps(rest: RestClient, args) -> int:
+    """kubectl get configmaps: name + data-key count per namespace."""
+    path = ("/api/v1/configmaps" if args.all_namespaces
+            else f"/api/v1/namespaces/{args.namespace}/configmaps")
+    code, doc = rest.call("GET", path)
+    if code != 200:
+        return _rest_fail(doc)
+    rows = [[it["metadata"]["namespace"], it["metadata"]["name"],
+             str(len(it.get("data", {})))]
+            for it in doc["items"]]
+    print(_fmt_table(["NAMESPACE", "NAME", "DATA"], rows))
+    return 0
+
+
+def cmd_get_serviceaccounts(rest: RestClient, args) -> int:
+    """kubectl get serviceaccounts: the identities the tokens
+    controller maintains, with their token-secret references."""
+    path = ("/api/v1/serviceaccounts" if args.all_namespaces
+            else f"/api/v1/namespaces/{args.namespace}/serviceaccounts")
+    code, doc = rest.call("GET", path)
+    if code != 200:
+        return _rest_fail(doc)
+    rows = [[it["metadata"]["namespace"], it["metadata"]["name"],
+             str(len(it.get("secrets", [])))]
+            for it in doc["items"]]
+    print(_fmt_table(["NAMESPACE", "NAME", "SECRETS"], rows))
+    return 0
+
+
 def cmd_get_leases(rest: RestClient, args) -> int:
     """kubectl get leases (coordination.k8s.io/v1): HA state over REST —
     who holds each lock and how fresh the renewal is."""
@@ -641,7 +694,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.cmd == "get" and args.kind in ("events", "leases",
                                            "namespaces", "ns",
-                                           "deployments", "deploy"):
+                                           "deployments", "deploy",
+                                           "csr", "configmaps", "cm",
+                                           "serviceaccounts", "sa"):
         if not args.api_server:
             p.error(f"get {args.kind} requires --api-server")
         try:
@@ -655,6 +710,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 return cmd_get_namespaces(rest, args)
             if args.kind in ("deployments", "deploy"):
                 return cmd_get_deployments(rest, args)
+            if args.kind == "csr":
+                return cmd_get_csr(rest, args)
+            if args.kind in ("configmaps", "cm"):
+                return cmd_get_configmaps(rest, args)
+            if args.kind in ("serviceaccounts", "sa"):
+                return cmd_get_serviceaccounts(rest, args)
             return cmd_get_events(rest, args)
         except OSError as e:
             print(f"Error: cannot reach API server {args.api_server}: {e}",

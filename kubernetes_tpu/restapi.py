@@ -130,6 +130,8 @@ RESOURCES = (
     ("services", "Service", True, ("list",)),
     ("endpoints", "Endpoints", True, ("list",)),
     ("events", "Event", True, ("list",)),
+    ("serviceaccounts", "ServiceAccount", True, ("list",)),
+    ("configmaps", "ConfigMap", True, ("get", "list")),
 )
 
 
@@ -141,13 +143,19 @@ RESOURCES = (
 #: where their pods run.
 LEASE_GROUP = "coordination.k8s.io"
 APPS_GROUP = "apps"
+CERT_GROUP = "certificates.k8s.io"
 GROUPS = {
     LEASE_GROUP: (("leases", "Lease", True, ("get", "list")),),
     APPS_GROUP: (("deployments", "Deployment", True,
                   ("create", "delete", "get", "list", "patch", "update")),
                  ("deployments/scale", "Scale", True, ("get", "update")),
                  ("replicasets", "ReplicaSet", True, ("get", "list"))),
+    CERT_GROUP: (("certificatesigningrequests",
+                  "CertificateSigningRequest", False, ("get", "list")),),
 }
+#: group -> served version (the reference serves certificates at
+#: v1beta1 in this cycle — csr.go's capi group)
+GROUP_VERSIONS = {CERT_GROUP: "v1beta1"}
 GROUP_RESOURCES = GROUPS[LEASE_GROUP]  # back-compat alias
 
 
@@ -221,17 +229,21 @@ def openapi_doc() -> dict:
     # table; subresource names like "deployments/scale" route to the
     # item path)
     for group, resources in GROUPS.items():
+        gv = GROUP_VERSIONS.get(group, "v1")
         for name, kind, namespaced, verbs in resources:
-            gbase = f"/apis/{group}/v1"
+            gbase = f"/apis/{group}/{gv}"
             res, _, sub = name.partition("/")
-            collection = f"{gbase}/namespaces/{{namespace}}/{res}"
+            collection = (f"{gbase}/namespaces/{{namespace}}/{res}"
+                          if namespaced else f"{gbase}/{res}")
             item = collection + "/{name}" + (f"/{sub}" if sub else "")
-            gvk = {"group": group, "version": "v1", "kind": kind}
+            gvk = {"group": group, "version": gv, "kind": kind}
             ok = {"200": {"description": "OK"},
                   "401": {"description": "Unauthorized"}}
             for verb in verbs:
                 if verb == "list":
-                    for route in (f"{gbase}/{res}", collection):
+                    routes = ({f"{gbase}/{res}", collection}
+                              if namespaced else {collection})
+                    for route in sorted(routes):
                         paths.setdefault(route, {})["get"] = {
                             "x-kubernetes-action": "list",
                             "x-kubernetes-group-version-kind": gvk,
@@ -720,7 +732,7 @@ class RestServer:
         served group. Returns (group, segments) or None."""
         parts = [p for p in path.split("/") if p]
         if (len(parts) >= 3 and parts[0] == "apis" and parts[1] in GROUPS
-                and parts[2] == "v1"):
+                and parts[2] == GROUP_VERSIONS.get(parts[1], "v1")):
             return parts[1], parts[3:]
         return None
 
@@ -758,17 +770,22 @@ class RestServer:
                 "kind": "APIGroupList",
                 "groups": [{
                     "name": g,
-                    "versions": [{"groupVersion": f"{g}/v1",
-                                  "version": "v1"}],
-                    "preferredVersion": {"groupVersion": f"{g}/v1",
-                                         "version": "v1"},
+                    "versions": [{
+                        "groupVersion":
+                            f"{g}/{GROUP_VERSIONS.get(g, 'v1')}",
+                        "version": GROUP_VERSIONS.get(g, "v1")}],
+                    "preferredVersion": {
+                        "groupVersion":
+                            f"{g}/{GROUP_VERSIONS.get(g, 'v1')}",
+                        "version": GROUP_VERSIONS.get(g, "v1")},
                 } for g in sorted(GROUPS)],
             })
         for g, resources in GROUPS.items():
-            if path == f"/apis/{g}/v1":
+            gv = GROUP_VERSIONS.get(g, "v1")
+            if path == f"/apis/{g}/{gv}":
                 return h._respond(200, {
                     "kind": "APIResourceList",
-                    "groupVersion": f"{g}/v1",
+                    "groupVersion": f"{g}/{gv}",
                     "resources": [
                         {"name": name, "kind": kind,
                          "namespaced": namespaced, "verbs": list(verbs)}
@@ -780,6 +797,8 @@ class RestServer:
             group, gseg = routed
             if group == LEASE_GROUP:
                 return self._get_lease(h, gseg)
+            if group == CERT_GROUP:
+                return self._get_certs(h, gseg)
             return self._get_apps(h, gseg)
         if path == "/openapi/v2":
             return h._respond(200, openapi_doc())
@@ -858,7 +877,12 @@ class RestServer:
                             for p in svc.ports
                         ],
                         "sessionAffinity": svc.session_affinity,
+                        "type": getattr(svc, "type", "ClusterIP"),
                     },
+                    **({"status": {"loadBalancer": {"ingress": [
+                        {"ip": svc.load_balancer_ingress}]}}}
+                       if getattr(svc, "load_balancer_ingress", "")
+                       else {}),
                 }, hub, f"services/{key}"))
             return h._respond(200, {
                 "kind": "ServiceList", "apiVersion": "v1",
@@ -921,6 +945,49 @@ class RestServer:
                 "metadata": {"resourceVersion": str(hub._revision)},
                 "items": items,
             })
+        if seg == ["serviceaccounts"]:
+            items = []
+            for key in sorted(hub.service_accounts):
+                sa_ns, name = key.split("/", 1)
+                if ns is not None and sa_ns != ns:
+                    continue
+                items.append(_with_rv({
+                    "metadata": {"name": name, "namespace": sa_ns},
+                    # the tokens controller's credential, referenced the
+                    # way v1 SAs reference their token secrets (names
+                    # only — the secret VALUE never rides a list)
+                    "secrets": [{"name": f"{name}-token"}],
+                }, hub, f"serviceaccounts/{key}"))
+            return h._respond(200, {
+                "kind": "ServiceAccountList", "apiVersion": "v1",
+                "metadata": {"resourceVersion": str(hub._revision)},
+                "items": items,
+            })
+        if seg == ["configmaps"]:
+            items = []
+            for key in sorted(hub.configmaps):
+                cm_ns, name = key.split("/", 1)
+                if ns is not None and cm_ns != ns:
+                    continue
+                items.append(_with_rv({
+                    "metadata": {"name": name, "namespace": cm_ns},
+                    "data": dict(hub.configmaps[key].get("data", {})),
+                }, hub, f"configmaps/{key}"))
+            return h._respond(200, {
+                "kind": "ConfigMapList", "apiVersion": "v1",
+                "metadata": {"resourceVersion": str(hub._revision)},
+                "items": items,
+            })
+        if (len(seg) == 2 and seg[0] == "configmaps" and ns is not None):
+            key = f"{ns}/{seg[1]}"
+            cm = hub.configmaps.get(key)
+            if cm is None:
+                return h._fail(404, "NotFound",
+                               f'configmaps "{seg[1]}" not found')
+            return h._respond(200, _with_rv({
+                "metadata": {"name": seg[1], "namespace": ns},
+                "data": dict(cm.get("data", {})),
+            }, hub, f"configmaps/{key}"))
         if seg == ["pods"]:
             from kubernetes_tpu.api.protobuf import pod_list_to_pb
 
@@ -977,6 +1044,52 @@ class RestServer:
                 return h._fail(404, "NotFound",
                                f'leases "{seg[1]}" not found')
             return h._respond(200, doc(key))
+        return h._fail(404, "NotFound", h.path)
+
+    def _get_certs(self, h, seg) -> None:
+        """certificates.k8s.io/v1beta1 read routes: CSR list + get
+        (cluster-scoped). The status carries the approval condition and
+        whether a certificate was issued — the credential VALUE never
+        rides a list (same rule as SA token secrets)."""
+        hub = self.hub
+
+        def doc(csr):
+            conditions = []
+            if csr.approved is True:
+                conditions.append({"type": "Approved",
+                                   "message": csr.approval_message})
+            elif csr.approved is False:
+                conditions.append({"type": "Denied",
+                                   "message": csr.approval_message})
+            return _with_rv({
+                "metadata": {"name": csr.name},
+                "spec": {
+                    "username": csr.username,
+                    "groups": list(csr.groups),
+                    "usages": list(csr.usages),
+                    "request": {"commonName": csr.request_cn,
+                                "organizations": list(csr.request_orgs)},
+                },
+                "status": {
+                    "conditions": conditions,
+                    "certificateIssued": bool(csr.certificate),
+                },
+            }, hub, f"certificatesigningrequests/{csr.name}")
+
+        if seg == ["certificatesigningrequests"]:
+            return h._respond(200, {
+                "kind": "CertificateSigningRequestList",
+                "apiVersion": f"{CERT_GROUP}/v1beta1",
+                "metadata": {"resourceVersion": str(hub._revision)},
+                "items": [doc(hub.csrs[n]) for n in sorted(hub.csrs)],
+            })
+        if len(seg) == 2 and seg[0] == "certificatesigningrequests":
+            csr = hub.csrs.get(seg[1])
+            if csr is None:
+                return h._fail(
+                    404, "NotFound",
+                    f'certificatesigningrequests "{seg[1]}" not found')
+            return h._respond(200, doc(csr))
         return h._fail(404, "NotFound", h.path)
 
     def _get_apps(self, h, seg) -> None:

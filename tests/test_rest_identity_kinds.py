@@ -1,0 +1,123 @@
+"""REST read surface for the identity/config kinds the round-5
+controllers maintain: ServiceAccounts, ConfigMaps (root-CA +
+cluster-info publishers), certificates.k8s.io CSRs — plus Service
+Type/LoadBalancer status on the wire and the matching ktpu verbs."""
+
+from kubernetes_tpu.bootstrap import init_cluster
+from kubernetes_tpu.certificates import node_bootstrap_csr
+from kubernetes_tpu.kubectl import main as ktpu
+from kubernetes_tpu.restapi import RestServer
+from kubernetes_tpu.sim import HollowCluster
+
+from tests.test_restapi import req
+
+
+def start(hub):
+    srv = RestServer(hub, port=0)
+    srv.serve()
+    return srv, srv.port
+
+
+def test_serviceaccounts_and_configmaps_served():
+    hub = HollowCluster(seed=61, scheduler_kw={"enable_preemption": False})
+    hub.add_namespace("team-a")
+    hub.step()  # SA controller + root-CA publisher run
+    srv, port = start(hub)
+    try:
+        code, doc = req(port, "GET",
+                        "/api/v1/namespaces/team-a/serviceaccounts")
+        assert code == 200 and doc["kind"] == "ServiceAccountList"
+        assert [i["metadata"]["name"] for i in doc["items"]] == ["default"]
+        assert doc["items"][0]["secrets"] == [{"name": "default-token"}]
+
+        code, doc = req(port, "GET",
+                        "/api/v1/namespaces/team-a/configmaps")
+        assert code == 200
+        names = [i["metadata"]["name"] for i in doc["items"]]
+        assert "kube-root-ca.crt" in names
+        code, doc = req(
+            port, "GET",
+            "/api/v1/namespaces/team-a/configmaps/kube-root-ca.crt")
+        assert code == 200 and doc["data"]["ca.crt"] == hub.cluster_ca
+        # the token VALUE never rides the wire
+        import json as _json
+
+        assert hub.service_account_token("team-a", "default") not in _json.dumps(doc)
+    finally:
+        srv.close()
+
+
+def test_csrs_served_with_conditions():
+    hub = HollowCluster(seed=62, scheduler_kw={"enable_preemption": False})
+    hub.create_csr(node_bootstrap_csr("n0"))
+    hub.create_csr(node_bootstrap_csr(
+        "nX", username="mallory", groups=("devs",)))
+    hub.step()  # approve+sign n0; mallory stays pending
+    srv, port = start(hub)
+    try:
+        code, doc = req(
+            port, "GET",
+            "/apis/certificates.k8s.io/v1beta1/certificatesigningrequests")
+        assert code == 200 and len(doc["items"]) == 2
+        by_name = {i["metadata"]["name"]: i for i in doc["items"]}
+        ok = by_name["csr-n0"]["status"]
+        assert (ok["certificateIssued"]
+                and ok["conditions"][0]["type"] == "Approved")
+        pending = by_name["csr-nX"]["status"]
+        assert not pending["conditions"]
+        # the CREDENTIAL never rides the wire
+        cert = hub.csrs["csr-n0"].certificate
+        import json as _json
+
+        assert cert not in _json.dumps(doc)
+        # discovery advertises the group at v1beta1
+        code, doc = req(port, "GET", "/apis/certificates.k8s.io/v1beta1")
+        assert code == 200
+        assert doc["resources"][0]["name"] == "certificatesigningrequests"
+    finally:
+        srv.close()
+
+
+def test_lb_service_status_on_the_wire():
+    from kubernetes_tpu.cloud import FakeCloud, Instance
+    from kubernetes_tpu.proxy import Service
+    from kubernetes_tpu.testing import make_node
+
+    hub = HollowCluster(seed=63, scheduler_kw={"enable_preemption": False})
+    cloud = FakeCloud()
+    cloud.add_instance(Instance("n0", zone="z0"))
+    hub.add_node(make_node("n0", cpu_milli=1000))
+    hub.attach_cloud(cloud)
+    hub.add_service(Service("web", selector={"app": "w"},
+                            type="LoadBalancer"))
+    hub.step()
+    srv, port = start(hub)
+    try:
+        code, doc = req(port, "GET", "/api/v1/namespaces/default/services")
+        assert code == 200
+        svc = doc["items"][0]
+        assert svc["spec"]["type"] == "LoadBalancer"
+        assert svc["status"]["loadBalancer"]["ingress"][0]["ip"].startswith(
+            "192.0.2.")
+    finally:
+        srv.close()
+
+
+def test_ktpu_get_identity_kinds(capsys):
+    hub, token = init_cluster()
+    hub.create_csr(node_bootstrap_csr("n1"))
+    hub.step()
+    srv, port = start(hub)
+    try:
+        api = ["--api-server", f"127.0.0.1:{port}"]
+        assert ktpu(api + ["get", "csr"]) == 0
+        out = capsys.readouterr().out
+        assert "csr-n1" in out and "system:node:n1" in out
+        assert ktpu(api + ["get", "cm", "-n", "kube-public"]) == 0
+        out = capsys.readouterr().out
+        assert "cluster-info" in out
+        assert ktpu(api + ["get", "sa", "-A"]) == 0
+        out = capsys.readouterr().out
+        assert "kube-system" in out and "default" in out
+    finally:
+        srv.close()

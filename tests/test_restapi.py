@@ -786,6 +786,11 @@ def test_discovery_and_openapi_surface():
         # success code — not merely "not 404" (a 500 is drift too).
         # Deletes run LAST (sorted below) so they cannot eat the
         # fixtures other ops need; each delete re-creates what it ate.
+        # fixtures for the r5 read-only item routes ({name} -> d0):
+        hub.put_configmap("default", "d0", {"k": "v"})
+        from kubernetes_tpu.certificates import CertificateSigningRequest
+
+        hub.create_csr(CertificateSigningRequest(name="d0"))
         ops = sorted(
             ((method, route)
              for route, methods in spec["paths"].items()
