@@ -279,3 +279,110 @@ def forbidden_message(a: Attributes) -> str:
              else " at the cluster scope")
     return (f'User "{a.user.name}" cannot {a.verb} resource '
             f'"{a.resource}"{where}')
+
+
+# ---------------------------------------------------------------------------
+# rbac.authorization.k8s.io role/binding model + aggregation
+# ---------------------------------------------------------------------------
+
+
+class PolicyRule(NamedTuple):
+    """rbac/v1 PolicyRule — subject-LESS (who is the binding's job,
+    unlike this module's flat :class:`Rule` which couples both; the
+    role/binding split is what makes aggregation meaningful)."""
+
+    verbs: tuple = ("*",)
+    resources: tuple = ("*",)
+    namespaces: tuple = ("*",)
+    non_resource_urls: tuple = ()
+
+    def grants(self, a: Attributes) -> bool:
+        def hit(allowed: tuple, value: str) -> bool:
+            return "*" in allowed or value in allowed
+
+        if not hit(self.verbs, a.verb):
+            return False
+        if not a.resource:
+            return any(
+                a.path == pat or (pat.endswith("*")
+                                  and a.path.startswith(pat[:-1]))
+                for pat in self.non_resource_urls
+            )
+        if self.non_resource_urls:
+            return False
+        return (hit(self.resources, a.resource)
+                and hit(self.namespaces, a.namespace or "*"))
+
+
+class ClusterRole:
+    """rbac/v1 ClusterRole: named rule set, optionally AGGREGATED — when
+    ``aggregation_selectors`` is set, the aggregation controller
+    overwrites ``rules`` with the union of every other role matching
+    any selector (clusterroleaggregation_controller.go:76
+    syncClusterRole; the admin/edit/view stack is built this way)."""
+
+    def __init__(self, name, rules=(), labels=None,
+                 aggregation_selectors=()):
+        self.name = name
+        self.rules = tuple(rules)
+        self.labels = dict(labels or {})
+        #: each selector is a {label: value} dict (AND of pairs; the
+        #: reference's LabelSelectorAsSelector matchLabels form)
+        self.aggregation_selectors = tuple(
+            dict(s) for s in aggregation_selectors)
+
+
+class ClusterRoleBinding(NamedTuple):
+    """rbac/v1 ClusterRoleBinding: subjects -> one role by name."""
+
+    role: str
+    subjects: tuple  # usernames and/or group names
+
+
+class RBACAuthorizer:
+    """The role/binding resolver (rbac.go RBACAuthorizer): a request is
+    allowed iff some binding covers the user AND its role (with
+    aggregated rules already materialized by the controller) grants the
+    attributes. Reads LIVE role/binding dicts — pass the hub's."""
+
+    def __init__(self, roles, bindings) -> None:
+        self.roles = roles          # name -> ClusterRole (live dict)
+        self.bindings = bindings    # list of ClusterRoleBinding (live)
+
+    def authorize(self, a: Attributes) -> str:
+        names = {a.user.name, *a.user.groups}
+        for b in self.bindings:
+            if "*" not in b.subjects and not (names & set(b.subjects)):
+                continue
+            role = self.roles.get(b.role)
+            if role is not None and any(r.grants(a) for r in role.rules):
+                return ALLOW
+        return NO_OPINION
+
+
+def aggregate_cluster_roles(roles) -> int:
+    """One controller pass (clusterroleaggregation_controller.go:76):
+    for every role with an aggregation rule, rules := union (by-name
+    order, self excluded, deduped preserving order) of matching roles'
+    rules. Returns how many aggregated roles CHANGED."""
+    changed = 0
+    for name in sorted(roles):
+        role = roles[name]
+        if not role.aggregation_selectors:
+            continue
+        new_rules = []
+        for other_name in sorted(roles):
+            if other_name == name:
+                continue
+            other = roles[other_name]
+            if not any(all(other.labels.get(k) == v
+                           for k, v in sel.items())
+                       for sel in role.aggregation_selectors):
+                continue
+            for r in other.rules:
+                if r not in new_rules:
+                    new_rules.append(r)
+        if tuple(new_rules) != role.rules:
+            role.rules = tuple(new_rules)
+            changed += 1
+    return changed

@@ -577,6 +577,13 @@ class HollowCluster:
         #: lookup (sa_token_user) answers None immediately.
         self.service_accounts: Dict[str, ServiceAccount] = {}
         self.sa_tokens: Dict[str, str] = {}  # token -> "ns/name"
+        #: rbac.authorization.k8s.io: ClusterRoles (name -> auth.
+        #: ClusterRole) + ClusterRoleBindings; the aggregation
+        #: controller pass materializes aggregated roles' rules, and
+        #: auth.RBACAuthorizer(self.cluster_roles,
+        #: self.cluster_role_bindings) resolves them LIVE
+        self.cluster_roles: Dict[str, object] = {}
+        self.cluster_role_bindings: List = []
         #: certificates.k8s.io: CSR objects + the live credential
         #: registry the authn chain consults (cert -> (UserInfo,
         #: not_after)); expired certs leave the registry — lookup-time
@@ -2504,6 +2511,11 @@ class HollowCluster:
         # unconditional: an (impossible today) empty namespaces dict must
         # still REVOKE — gating here would freeze dead tokens alive
         self.reconcile_service_accounts()
+        if any(getattr(r, "aggregation_selectors", ())
+               for r in self.cluster_roles.values()):
+            from kubernetes_tpu.auth import aggregate_cluster_roles
+
+            aggregate_cluster_roles(self.cluster_roles)
         self.cert_controller.reconcile()
         self.root_ca_publisher.reconcile()
         if self.bootstrap_tokens or (

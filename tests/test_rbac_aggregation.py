@@ -1,0 +1,102 @@
+"""rbac/v1 role/binding model + the ClusterRole aggregation controller
+(clusterroleaggregation_controller.go:76 syncClusterRole): aggregated
+roles materialize the union of matching roles' rules; the
+RBACAuthorizer resolves bindings against the LIVE role dicts, so an
+aggregation update changes authorization without rebuilding anything."""
+
+from kubernetes_tpu.auth import (
+    ALLOW,
+    NO_OPINION,
+    Attributes,
+    ClusterRole,
+    ClusterRoleBinding,
+    PolicyRule,
+    RBACAuthorizer,
+    UserInfo,
+    aggregate_cluster_roles,
+)
+from kubernetes_tpu.sim import HollowCluster
+
+
+def _attrs(user, verb, resource, ns=""):
+    return Attributes(user=user, verb=verb, resource=resource,
+                      namespace=ns, name="", path="")
+
+
+ALICE = UserInfo(name="alice", groups=("devs",))
+
+
+def test_admin_edit_view_aggregation_stack():
+    """The reference's admin/edit/view roles are built exactly this
+    way: view aggregates rbac.authorization.k8s.io/aggregate-to-view
+    labeled roles; edit aggregates view + more; granting a new CRD's
+    reader role to view is ONE labeled role away."""
+    roles = {
+        "view": ClusterRole("view", aggregation_selectors=[
+            {"rbac.example.com/aggregate-to-view": "true"}]),
+        "pods-reader": ClusterRole(
+            "pods-reader",
+            rules=[PolicyRule(verbs=("get", "list"), resources=("pods",))],
+            labels={"rbac.example.com/aggregate-to-view": "true"}),
+    }
+    assert aggregate_cluster_roles(roles) == 1
+    assert roles["view"].rules == (
+        PolicyRule(verbs=("get", "list"), resources=("pods",)),)
+    # adding another labeled role extends view on the next pass
+    roles["cm-reader"] = ClusterRole(
+        "cm-reader",
+        rules=[PolicyRule(verbs=("get",), resources=("configmaps",))],
+        labels={"rbac.example.com/aggregate-to-view": "true"})
+    assert aggregate_cluster_roles(roles) == 1
+    assert len(roles["view"].rules) == 2
+    # idempotent once settled
+    assert aggregate_cluster_roles(roles) == 0
+
+
+def test_authorizer_resolves_bindings_live():
+    roles = {
+        "view": ClusterRole("view", aggregation_selectors=[
+            {"aggregate-to-view": "true"}]),
+    }
+    bindings = [ClusterRoleBinding(role="view", subjects=("devs",))]
+    authz = RBACAuthorizer(roles, bindings)
+    a = _attrs(ALICE, "get", "pods", "default")
+    assert authz.authorize(a) == NO_OPINION  # nothing aggregated yet
+    roles["pods-reader"] = ClusterRole(
+        "pods-reader", rules=[PolicyRule(verbs=("get",),
+                                         resources=("pods",))],
+        labels={"aggregate-to-view": "true"})
+    aggregate_cluster_roles(roles)
+    assert authz.authorize(a) == ALLOW  # same authorizer, live dicts
+    # RBAC never denies — an uncovered verb is NO_OPINION, not DENY
+    assert authz.authorize(
+        _attrs(ALICE, "delete", "pods", "default")) == NO_OPINION
+
+
+def test_hub_runs_aggregation_pass():
+    hub = HollowCluster(seed=41, scheduler_kw={"enable_preemption": False})
+    hub.cluster_roles["view"] = ClusterRole(
+        "view", aggregation_selectors=[{"to-view": "true"}])
+    hub.cluster_roles["leaf"] = ClusterRole(
+        "leaf", rules=[PolicyRule(verbs=("list",), resources=("nodes",))],
+        labels={"to-view": "true"})
+    hub.step()
+    assert hub.cluster_roles["view"].rules == (
+        PolicyRule(verbs=("list",), resources=("nodes",)),)
+
+
+def test_self_and_nonmatching_excluded():
+    roles = {
+        "agg": ClusterRole(
+            "agg", aggregation_selectors=[{"pick": "yes"}],
+            labels={"pick": "yes"},  # self-label must NOT self-include
+            rules=[PolicyRule(verbs=("x",), resources=("y",))]),
+        "other": ClusterRole(
+            "other", rules=[PolicyRule(verbs=("get",),
+                                       resources=("pods",))],
+            labels={"pick": "no"}),
+    }
+    aggregate_cluster_roles(roles)
+    # nothing matched: rules overwritten to empty (the reference PUTs
+    # the recomputed union, which may be empty)
+    assert roles["agg"].rules == ()
