@@ -250,6 +250,10 @@ class PersistentVolume:
     node_affinity: Tuple[NodeSelectorTerm, ...] = ()  # ORed terms
     storage_class: str = ""
     claim_ref: str = ""  # "namespace/name" of bound claim; "" = available
+    #: metadata.deletionTimestamp analog (0 = live): the PV-protection
+    #: finalizer keeps a claimed PV terminating-but-present until its
+    #: claim releases it (pv_protection_controller.go)
+    deletion_timestamp: float = 0.0
 
 
 @dataclass
@@ -258,6 +262,10 @@ class PersistentVolumeClaim:
     namespace: str = "default"
     volume_name: str = ""  # bound PV name; "" = unbound
     storage_class: str = ""
+    #: metadata.deletionTimestamp analog (0 = live): the PVC-protection
+    #: finalizer keeps an in-use claim terminating-but-present until no
+    #: live pod references it (pvc_protection_controller.go)
+    deletion_timestamp: float = 0.0
 
 
 @dataclass

@@ -1291,6 +1291,76 @@ class HollowCluster:
             list(self.storage_classes.values()),
         )
 
+    def delete_pvc(self, key: str) -> bool:
+        """DELETE of a PVC under the pvc-protection finalizer
+        (pvc_protection_controller.go): an in-use claim (some live,
+        non-terminal pod references it by name) is marked terminating
+        and kept; the protection pass finalizes the removal when the
+        last user is gone. Returns True when the object was removed
+        NOW, False when protection deferred it."""
+        pvc = self.pvcs.get(key)
+        if pvc is None:
+            return False
+        if self._pvc_in_use(key):
+            if not pvc.deletion_timestamp:
+                pvc.deletion_timestamp = self.clock.t or 1e-6
+                self._commit(f"persistentvolumeclaims/{key}",
+                             "MODIFIED", pvc)
+            return False
+        self._finalize_pvc_delete(key)
+        return True
+
+    def delete_pv(self, name: str) -> bool:
+        """DELETE of a PV under the pv-protection finalizer
+        (pv_protection_controller.go): a claimed PV stays terminating
+        until its claim releases it."""
+        pv = self.pvs.get(name)
+        if pv is None:
+            return False
+        if pv.claim_ref:
+            if not pv.deletion_timestamp:
+                pv.deletion_timestamp = self.clock.t or 1e-6
+                self._commit(f"persistentvolumes/{name}", "MODIFIED", pv)
+            return False
+        del self.pvs[name]
+        self._commit(f"persistentvolumes/{name}", "DELETED", None)
+        self._sync_volume_state()
+        return True
+
+    def _pvc_in_use(self, key: str) -> bool:
+        from kubernetes_tpu.api.types import is_pod_terminated
+
+        ns, name = key.split("/", 1)
+        return any(
+            p.namespace == ns and not is_pod_terminated(p)
+            and any(v.pvc == name for v in p.volumes)
+            for p in self.truth_pods.values()
+        )
+
+    def _finalize_pvc_delete(self, key: str) -> None:
+        pvc = self.pvcs.pop(key)
+        if pvc.volume_name and pvc.volume_name in self.pvs:
+            # Released -> Available (the hollow reclaim policy); a PV
+            # waiting on pv-protection may now finalize too
+            self.pvs[pvc.volume_name].claim_ref = ""
+            self._commit(f"persistentvolumes/{pvc.volume_name}",
+                         "MODIFIED", self.pvs[pvc.volume_name])
+        self._commit(f"persistentvolumeclaims/{key}", "DELETED", None)
+        self._sync_volume_state()
+
+    def reconcile_volume_protection(self) -> None:
+        """The two protection controllers' finalizer passes: terminating
+        PVCs whose last pod user is gone are removed (releasing their
+        PV); terminating PVs whose claim released them are removed."""
+        for key in [k for k, c in self.pvcs.items()
+                    if c.deletion_timestamp and not self._pvc_in_use(k)]:
+            self._finalize_pvc_delete(key)
+        for name in [n for n, pv in self.pvs.items()
+                     if pv.deletion_timestamp and not pv.claim_ref]:
+            del self.pvs[name]
+            self._commit(f"persistentvolumes/{name}", "DELETED", None)
+            self._sync_volume_state()
+
     def _commit_volume_bind(self, pvc, pv) -> None:
         """The scheduler's BindPodVolumes write, routed through the hub
         store: same in-place object mutation as the default writer plus
@@ -1644,8 +1714,8 @@ class HollowCluster:
 
         bound_any = False
         for key, pvc in self.pvcs.items():
-            if pvc.volume_name:
-                continue
+            if pvc.volume_name or pvc.deletion_timestamp:
+                continue  # bound, or terminating under pvc-protection
             sc = self.storage_classes.get(pvc.storage_class)
             if (sc is not None
                     and sc.binding_mode == BINDING_WAIT_FOR_FIRST_CONSUMER):
@@ -1653,7 +1723,8 @@ class HollowCluster:
             assumed = self.sched.cache.packer.vol_state.assumed_claims
             pick = None
             for pv in self.pvs.values():
-                if (not pv.claim_ref and pv.name not in assumed
+                if (not pv.claim_ref and not pv.deletion_timestamp
+                        and pv.name not in assumed
                         and pv.storage_class == pvc.storage_class):
                     pick = pv
                     break
@@ -2537,6 +2608,7 @@ class HollowCluster:
         self.gc_owner_graph()
         self.reconcile_pod_gc()
         if self.pvcs or self.pvs:
+            self.reconcile_volume_protection()
             self.reconcile_volumes()
         if (self.pvs or self.attachments
                 or any(p.volumes for p in self.truth_pods.values())):

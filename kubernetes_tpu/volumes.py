@@ -198,6 +198,7 @@ class VolumeState:
             pv
             for pv in self.pvs.values()
             if not pv.claim_ref
+            and not getattr(pv, "deletion_timestamp", 0.0)
             and pv.name not in self.assumed_claims
             and pv.storage_class == storage_class
         ]

@@ -1,0 +1,79 @@
+"""PVC/PV protection controllers (pvc_protection_controller.go,
+pv_protection_controller.go): finalizer semantics — an in-use PVC and a
+claimed PV survive deletion as terminating objects until their last
+user/claim releases them; terminating volume objects never bind."""
+
+from kubernetes_tpu.api.types import (
+    BINDING_IMMEDIATE,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PodVolume,
+    StorageClass,
+    VOL_GCE_PD,
+)
+from kubernetes_tpu.sim import HollowCluster
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _hub():
+    hub = HollowCluster(seed=83, scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=4000))
+    hub.add_storage_class(StorageClass("std", BINDING_IMMEDIATE))
+    hub.add_pv(PersistentVolume("pv-1", kind=VOL_GCE_PD, handle="d1",
+                                storage_class="std"))
+    hub.add_pvc(PersistentVolumeClaim("data", storage_class="std"))
+    return hub
+
+
+def test_in_use_pvc_deletion_deferred_until_pod_gone():
+    hub = _hub()
+    hub.create_pod(make_pod("user", cpu_milli=100,
+                            volumes=(PodVolume(pvc="data"),)))
+    hub.step()  # binder binds pvc->pv; scheduler places the pod
+    assert hub.pvcs["default/data"].volume_name == "pv-1"
+    assert hub.delete_pvc("default/data") is False  # in use: deferred
+    assert hub.pvcs["default/data"].deletion_timestamp > 0
+    hub.step()
+    assert "default/data" in hub.pvcs  # still protected
+    hub.delete_pod("default/user")
+    hub.step()  # protection pass finalizes
+    assert "default/data" not in hub.pvcs
+    # the PV was released (claimRef cleared)
+    assert hub.pvs["pv-1"].claim_ref == ""
+    hub.check_consistency()
+
+
+def test_unused_pvc_deletes_immediately():
+    hub = _hub()
+    assert hub.delete_pvc("default/data") is True
+    assert "default/data" not in hub.pvcs
+
+
+def test_claimed_pv_deletion_deferred_until_released():
+    hub = _hub()
+    hub.step()  # immediate binder binds data -> pv-1
+    assert hub.pvs["pv-1"].claim_ref == "default/data"
+    assert hub.delete_pv("pv-1") is False
+    assert hub.pvs["pv-1"].deletion_timestamp > 0
+    hub.step()
+    assert "pv-1" in hub.pvs  # protected while claimed
+    assert hub.delete_pvc("default/data") is True  # releases the PV
+    hub.step()  # pv-protection finalizes
+    assert "pv-1" not in hub.pvs
+
+
+def test_terminating_pv_never_binds():
+    hub = _hub()
+    hub.delete_pv("pv-1")  # unclaimed: gone immediately
+    hub.add_pv(PersistentVolume("pv-2", kind=VOL_GCE_PD, handle="d2",
+                                storage_class="std"))
+    # mark pv-2 terminating while a claim wants binding
+    hub.pvs["pv-2"].claim_ref = "x/y"
+    assert hub.delete_pv("pv-2") is False
+    hub.pvs["pv-2"].claim_ref = ""  # released, but still terminating
+    hub.pvs["pv-2"].deletion_timestamp = 1.0
+    # the binder pass must NOT pick a terminating PV for the live claim
+    hub.reconcile_volumes()
+    assert hub.pvcs["default/data"].volume_name == ""
+    hub.step()  # pv-protection finalizes the released terminating PV
+    assert "pv-2" not in hub.pvs
