@@ -361,28 +361,39 @@ class RBACAuthorizer:
 
 
 def aggregate_cluster_roles(roles) -> int:
-    """One controller pass (clusterroleaggregation_controller.go:76):
-    for every role with an aggregation rule, rules := union (by-name
-    order, self excluded, deduped preserving order) of matching roles'
-    rules. Returns how many aggregated roles CHANGED."""
-    changed = 0
-    for name in sorted(roles):
-        role = roles[name]
-        if not role.aggregation_selectors:
-            continue
-        new_rules = []
-        for other_name in sorted(roles):
-            if other_name == name:
+    """Aggregation to FIXPOINT (clusterroleaggregation_controller.go:76
+    syncClusterRole; the reference converges via re-enqueues on every
+    role write — one call here runs passes until nothing changes, so
+    CHAINED aggregation like view→edit→admin resolves regardless of
+    name order). Each pass: for every role with an aggregation rule,
+    rules := union (by-name order, self excluded, deduped preserving
+    order) of matching roles' rules. Returns how many role updates
+    happened across all passes (0 = already settled). Unions only ever
+    grow within a call, so the fixpoint exists even with selector
+    cycles; the pass bound is a backstop, not a truncation."""
+    total = 0
+    for _ in range(max(1, len(roles))):
+        changed = 0
+        for name in sorted(roles):
+            role = roles[name]
+            if not role.aggregation_selectors:
                 continue
-            other = roles[other_name]
-            if not any(all(other.labels.get(k) == v
-                           for k, v in sel.items())
-                       for sel in role.aggregation_selectors):
-                continue
-            for r in other.rules:
-                if r not in new_rules:
-                    new_rules.append(r)
-        if tuple(new_rules) != role.rules:
-            role.rules = tuple(new_rules)
-            changed += 1
-    return changed
+            new_rules = []
+            for other_name in sorted(roles):
+                if other_name == name:
+                    continue
+                other = roles[other_name]
+                if not any(all(other.labels.get(k) == v
+                               for k, v in sel.items())
+                           for sel in role.aggregation_selectors):
+                    continue
+                for r in other.rules:
+                    if r not in new_rules:
+                        new_rules.append(r)
+            if tuple(new_rules) != role.rules:
+                role.rules = tuple(new_rules)
+                changed += 1
+        total += changed
+        if not changed:
+            break
+    return total

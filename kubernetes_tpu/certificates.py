@@ -78,6 +78,9 @@ class CertificateSigningRequest:
     #: approval condition: None = pending, True = Approved, False = Denied
     approved: Optional[bool] = None
     approval_message: str = ""
+    #: when the approval condition landed (the condition timestamp the
+    #: cleaner keys denied-CSR age on — cleaner.go isDeniedExpired)
+    decided_at: Optional[float] = None
     #: status.certificate — the minted credential (empty until signed)
     certificate: str = ""
     created_at: float = 0.0
@@ -173,6 +176,7 @@ class CertificateController:
                 namespace="", name=csr.name, path="")
             if self.authorizer.authorize(a) == ALLOW:
                 csr.approved = True
+                csr.decided_at = self.hub.clock.t
                 csr.approval_message = (
                     "Auto approving kubelet client certificate after "
                     "SubjectAccessReview.")
@@ -213,7 +217,9 @@ class CertificateController:
         (the reference's issued certs likewise outlive their CSRs)."""
         now = self.hub.clock.t
         if csr.certificate or csr.approved is False:
-            ref = csr.signed_at if csr.certificate else csr.created_at
+            ref = (csr.signed_at if csr.certificate
+                   else csr.decided_at if csr.decided_at is not None
+                   else csr.created_at)
             return now - ref >= self.signed_ttl_s
         return now - csr.created_at >= self.pending_ttl_s
 
@@ -221,6 +227,12 @@ class CertificateController:
         hub = self.hub
         for name in sorted(hub.csrs):
             csr = hub.csrs[name]
+            if csr.approved is not None and csr.decided_at is None:
+                # externally-decided CSR (a test or operator flipped the
+                # condition directly): stamp the condition time now so
+                # the cleaner's TTL runs from the DECISION, not create —
+                # a denial is observable for its full signed_ttl window
+                csr.decided_at = hub.clock.t
             self._approve(csr)
             self._sign(csr)
             if self._clean(csr):

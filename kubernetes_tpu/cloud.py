@@ -77,6 +77,13 @@ class CloudProvider:
                                      svc_key: str) -> None:
         raise NotImplementedError
 
+    def list_load_balancers(self, cluster: str) -> Tuple[str, ...]:
+        """Service keys with a live balancer — what the service
+        controller's needsCleanup pass sweeps (GetLoadBalancer per
+        service in the reference; a listing here so one call covers
+        the sweep)."""
+        raise NotImplementedError
+
     # -- Routes (cloud.go:134) ---------------------------------------------
 
     def list_routes(self, cluster: str) -> Dict[str, str]:
@@ -137,6 +144,9 @@ class FakeCloud(CloudProvider):
     def ensure_load_balancer_deleted(self, cluster: str,
                                      svc_key: str) -> None:
         self.load_balancers.pop(svc_key, None)
+
+    def list_load_balancers(self, cluster: str) -> Tuple[str, ...]:
+        return tuple(sorted(self.load_balancers))
 
     def list_routes(self, cluster: str) -> Dict[str, str]:
         return dict(self.routes)
@@ -202,7 +212,7 @@ class ServiceLBController:
         # needsCleanup: balancers whose service is gone or no longer
         # Type=LoadBalancer (the hub's delete_service cannot know about
         # cloud state — this pass owns the teardown)
-        for key in [k for k in getattr(self.cloud, "load_balancers", {})
+        for key in [k for k in self.cloud.list_load_balancers(self.cluster)
                     if k not in lb_services]:
             self.cloud.ensure_load_balancer_deleted(self.cluster, key)
             self.teardowns += 1
@@ -231,6 +241,14 @@ class RouteController:
         self.cluster = cluster
         self.create_failures = 0
 
+    def _set_network_unavailable(self, name: str, value: bool) -> None:
+        nd = self.hub.truth_nodes.get(name)
+        if nd is None or nd.conditions.network_unavailable == value:
+            return
+        self.hub._update_node(dataclasses.replace(
+            nd, conditions=dataclasses.replace(
+                nd.conditions, network_unavailable=value)))
+
     def reconcile(self) -> None:
         hub = self.hub
         routes = self.cloud.list_routes(self.cluster)
@@ -244,14 +262,16 @@ class RouteController:
                 try:
                     self.cloud.create_route(self.cluster, name, cidr)
                 except Exception:
+                    # no working route: RAISE the condition (the
+                    # CheckNodeCondition predicate keeps pods off this
+                    # node) — updateNetworkingCondition's failure half;
+                    # a stale route was already withdrawn above, so
+                    # leaving the condition clear would claim a
+                    # dataplane that does not exist
                     self.create_failures += 1
+                    self._set_network_unavailable(name, True)
                     continue
-            nd = hub.truth_nodes[name]
-            if nd.conditions.network_unavailable:
-                new = dataclasses.replace(
-                    nd, conditions=dataclasses.replace(
-                        nd.conditions, network_unavailable=False))
-                hub._update_node(new)
+            self._set_network_unavailable(name, False)
 
 
 class CloudNodeController:

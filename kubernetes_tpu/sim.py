@@ -1933,20 +1933,11 @@ class HollowCluster:
                 self._commit(f"leases/{key}", "DELETED", None)
             for key in [k for k in self.configmaps if k.startswith(prefix)]:
                 self.delete_configmap(key)
-            dropped_pvc = False
+            # namespace pods were deleted above, so no pvc-protection
+            # deferral applies — finalize through the one teardown path
+            # (release PV claimRef, commit both deletes, volume resync)
             for key in [k for k in self.pvcs if k.startswith(prefix)]:
-                pvc = self.pvcs.pop(key)
-                if pvc.volume_name and pvc.volume_name in self.pvs:
-                    # released PV keeps its claimRef cleared (Released->
-                    # Available is the hollow reclaim policy)
-                    self.pvs[pvc.volume_name].claim_ref = ""
-                    self._commit(f"persistentvolumes/{pvc.volume_name}",
-                                 "MODIFIED", self.pvs[pvc.volume_name])
-                self._commit(f"persistentvolumeclaims/{key}",
-                             "DELETED", None)
-                dropped_pvc = True
-            if dropped_pvc:
-                self._sync_volume_state()
+                self._finalize_pvc_delete(key)
             if not remaining:
                 del self.namespaces[name]
                 self._commit(f"namespaces/{name}", "DELETED", None)

@@ -57,7 +57,8 @@ def main() -> None:
             del w
         except Exception as e:
             doc["entries"][name] = {"error": f"{type(e).__name__}: {e}"}
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    if os.path.dirname(args.out):
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(doc, f, indent=1)
     print(json.dumps({"out": args.out, "platform": platform,

@@ -87,15 +87,22 @@ def test_routes_follow_pod_cidrs_and_clear_network_condition():
     assert "n1" not in cloud.list_routes("ktpu")
 
 
-def test_route_create_failure_counts_not_crashes():
+def test_route_create_failure_raises_network_unavailable():
+    """A node without a working route must carry NetworkUnavailable
+    (route_controller.go:222 updateNetworkingCondition) — the
+    CheckNodeCondition predicate keeps pods off it; recovery clears."""
     hub, cloud = _cloud_hub()
     cloud.fail_routes = True
     hub.step()  # nodeipam assigns podCIDRs
     hub.step()  # route pass attempts creates and fails
     assert hub.route_controller.create_failures > 0
+    assert all(nd.conditions.network_unavailable
+               for nd in hub.truth_nodes.values())
     cloud.fail_routes = False
     hub.step()
     assert cloud.list_routes("ktpu")  # retried and installed
+    assert all(not nd.conditions.network_unavailable
+               for nd in hub.truth_nodes.values())
 
 
 def test_replication_controller_keeps_replicas():

@@ -53,6 +53,26 @@ def test_admin_edit_view_aggregation_stack():
     assert aggregate_cluster_roles(roles) == 0
 
 
+def test_chained_aggregation_resolves_in_one_call():
+    """view -> edit -> admin chained aggregation (the real stack's
+    shape): one aggregate pass must reach the fixpoint even though
+    'admin' sorts BEFORE its source 'edit' — the reference converges
+    via re-enqueues; here the function loops until settled."""
+    roles = {
+        "admin": ClusterRole("admin", aggregation_selectors=[
+            {"to-admin": "true"}]),
+        "edit": ClusterRole("edit", labels={"to-admin": "true"},
+                            aggregation_selectors=[{"to-edit": "true"}]),
+        "view": ClusterRole(
+            "view", labels={"to-edit": "true"},
+            rules=[PolicyRule(verbs=("get",), resources=("pods",))]),
+    }
+    aggregate_cluster_roles(roles)
+    assert PolicyRule(verbs=("get",), resources=("pods",)) in \
+        roles["admin"].rules
+    assert aggregate_cluster_roles(roles) == 0  # settled
+
+
 def test_authorizer_resolves_bindings_live():
     roles = {
         "view": ClusterRole("view", aggregation_selectors=[
