@@ -1003,6 +1003,14 @@ class HollowCluster:
         "heartbeats", "dead_kubelets", "_taint_time",
         "_bound_at", "_started_at", "app_health",
         "attachments", "service_accounts", "sa_tokens",
+        # round-5 state: identity/config registries an etcd restore
+        # preserves (losing signed_certs would orphan every node
+        # identity; losing configmaps breaks cluster-info discovery),
+        # plus pod-GC bookkeeping
+        "replication_controllers", "csrs", "signed_certs", "configmaps",
+        "bootstrap_tokens", "cluster_roles", "cluster_role_bindings",
+        "cluster_ca", "_created_at", "_term_grace", "_terminal_gone",
+        "terminated_pod_threshold",
     )
 
     def _semantic_config(self) -> dict:

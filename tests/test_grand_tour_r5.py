@@ -1,0 +1,132 @@
+"""Grand tour, round-5 edition: the identity/cloud/GC subsystems
+composing in ONE cluster story — kubeadm trust-path onboarding
+(bootstrap token → signed discovery → join → CSR → node credential),
+cloud LB + routes over the joined nodes, run-to-completion batch pods
+lingering and GC'd under the threshold, a TTL'd Job expiring, RBAC
+aggregation authorizing the NODE credential over REST — all surviving
+a mid-story checkpoint/restore (the registries an etcd restore must
+preserve). Each feature has focused tests; this pins composition."""
+
+import http.client
+import json
+
+from kubernetes_tpu.auth import (
+    ClusterRole,
+    ClusterRoleBinding,
+    PolicyRule,
+    ServiceAccountAuthenticator,
+)
+from kubernetes_tpu.api.types import is_pod_terminated
+from kubernetes_tpu.bootstrap import (
+    init_cluster,
+    join_node,
+    verify_cluster_info,
+)
+from kubernetes_tpu.certificates import node_bootstrap_csr
+from kubernetes_tpu.cloud import FakeCloud, Instance
+from kubernetes_tpu.proxy import Service, ServicePort
+from kubernetes_tpu.restapi import RestServer
+from kubernetes_tpu.sim import HollowCluster, Job
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def test_grand_tour_round5(tmp_path):
+    hub, token = init_cluster()
+    hub.terminated_pod_threshold = 2
+    cloud = FakeCloud()
+    hub.attach_cloud(cloud)
+    hub.step()  # signer publishes cluster-info
+
+    # --- node onboarding via the full trust path -------------------------
+    for i in range(2):
+        name = f"w{i}"
+        cloud.add_instance(Instance(name, zone="z0", region="r0"))
+        verify_cluster_info(hub, token)  # discovery trust check
+        join_node(hub, token, make_node(name, cpu_milli=8000, pods=32))
+        user = hub.credential_user(token)
+        hub.create_csr(node_bootstrap_csr(
+            name, username=user.name, groups=user.groups))
+    hub.step()  # approve + sign both CSRs; nodeipam assigns podCIDRs
+    node_cert = hub.csrs["csr-w0"].certificate
+    assert hub.cert_user(node_cert).name == "system:node:w0"
+
+    # --- cloud dataplane: LB service + routes ----------------------------
+    hub.add_service(Service("web", selector={"app": "web"},
+                            type="LoadBalancer",
+                            ports=(ServicePort(port=80),)))
+    hub.create_pod(make_pod("web-1", cpu_milli=200,
+                            labels={"app": "web"}))
+    # batch work: run-to-completion pods + a TTL'd Job
+    for i in range(4):
+        hub.create_pod(make_pod(f"batch-{i}", cpu_milli=100,
+                                run_duration_s=15.0))
+    hub.jobs["train"] = Job("train", completions=2, parallelism=2,
+                            duration_s=15.0,
+                            ttl_seconds_after_finished=45.0)
+    for _ in range(3):
+        hub.step()
+    assert hub.services["default/web"].load_balancer_ingress
+    assert set(cloud.list_routes("ktpu")) >= {"w0", "w1"}
+
+    # --- checkpoint mid-story, restore cold ------------------------------
+    path = str(tmp_path / "r5.ckpt")
+    hub.save_checkpoint(path)
+    cold = HollowCluster(seed=3)  # same semantic config as init_cluster's
+    cold.restore_checkpoint(path)
+    cold.attach_cloud(cloud)  # live wiring re-attached, like HPA load_fn
+    cold.check_consistency()
+    # identity registries survived: the node credential still works,
+    # discovery still verifies, bootstrap token still joins
+    assert cold.cert_user(node_cert).name == "system:node:w0"
+    verify_cluster_info(cold, token)
+
+    # --- the restored plane finishes the story ---------------------------
+    for _ in range(12):
+        cold.step()
+    # batch pods ran to completion; GC holds the threshold
+    terminal = [k for k, p in cold.truth_pods.items()
+                if is_pod_terminated(p)]
+    assert len(terminal) <= 2
+    # the oldest batch pod was collected (possibly pre-checkpoint —
+    # the threshold held across the restore either way)
+    assert "default/batch-0" not in cold.truth_pods
+    # the TTL'd job finished and aged out
+    assert "train" not in cold.jobs
+    # service pod still serving
+    assert cold.truth_pods["default/web-1"].node_name
+
+    # --- RBAC aggregation authorizes the NODE credential over REST -------
+    cold.cluster_roles["node-reader"] = ClusterRole(
+        "node-reader", aggregation_selectors=[{"to-node": "true"}])
+    cold.cluster_roles["pods-view"] = ClusterRole(
+        "pods-view", labels={"to-node": "true"},
+        rules=[PolicyRule(verbs=("get", "list"), resources=("pods",))])
+    cold.cluster_role_bindings.append(
+        ClusterRoleBinding(role="node-reader",
+                           subjects=("system:nodes",)))
+    cold.step()  # aggregation pass materializes node-reader
+    from kubernetes_tpu.auth import RBACAuthorizer
+
+    rest = RestServer(
+        cold,
+        authn=ServiceAccountAuthenticator(cold.credential_user),
+        authz=RBACAuthorizer(cold.cluster_roles,
+                             cold.cluster_role_bindings))
+    port = rest.serve()
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        c.request("GET", "/api/v1/pods",
+                  headers={"Authorization": f"Bearer {node_cert}"})
+        r = c.getresponse()
+        doc = json.loads(r.read())
+        c.close()
+        assert r.status == 200 and doc["kind"] == "PodList"
+        # the node credential may NOT delete pods (RBAC never granted it)
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        c.request("DELETE", "/api/v1/namespaces/default/pods/web-1",
+                  headers={"Authorization": f"Bearer {node_cert}"})
+        assert c.getresponse().status == 403
+        c.close()
+    finally:
+        rest.close()
+    cold.check_consistency()
