@@ -319,6 +319,29 @@ class DaemonSet:
     node_selector: Dict[str, str] = field(default_factory=dict)
     #: pod key -> node name it is pinned to
     live: Dict[str, str] = field(default_factory=dict)
+    #: current template revision (the controller-revision-hash analog;
+    #: daemon pods carry it as a label) — bumped by :meth:`rollout`
+    template_rev: int = 1
+    #: RollingUpdate maxUnavailable (update.go:48 — v1.16 default 1):
+    #: at most this many nodes may be without a CURRENT-revision daemon
+    #: pod due to the update at once
+    max_unavailable: int = 1
+
+    def rollout(self, cpu_milli=None, memory=None, priority=None) -> None:
+        """Template update (apps/v1 RollingUpdate updateStrategy): stale
+        daemon pods are replaced node by node under max_unavailable; the
+        history pass records a ControllerRevision per template."""
+        if cpu_milli is not None:
+            self.cpu_milli = cpu_milli
+        if memory is not None:
+            self.memory = memory
+        if priority is not None:
+            self.priority = priority
+        self.template_rev += 1
+
+    def template(self) -> dict:
+        return {"cpu_milli": self.cpu_milli, "memory": self.memory,
+                "priority": self.priority}
 
     def should_keep(self, node: Node) -> bool:
         """v1.16 shouldContinueRunning: an existing daemon pod stays
@@ -370,9 +393,47 @@ class StatefulSet:
     cpu_milli: float = 100
     memory: float = 256 * 2**20
     priority: int = 0
+    #: current template revision (updateRevision); pods carry it as the
+    #: controller-revision-hash label analog
+    template_rev: int = 1
+    #: RollingUpdate partition (stateful_set_control.go: only ordinals
+    #: >= partition update; a canary knob — 0 = update everything)
+    partition: int = 0
 
     def pod_name(self, ordinal: int) -> str:
         return f"{self.name}-{ordinal}"
+
+    def rollout(self, cpu_milli=None, memory=None, priority=None) -> None:
+        """Template update (apps/v1 RollingUpdate): stale pods with
+        ordinal >= partition are replaced highest-first, one per sync,
+        each waiting for its successor to run (OrderedReady)."""
+        if cpu_milli is not None:
+            self.cpu_milli = cpu_milli
+        if memory is not None:
+            self.memory = memory
+        if priority is not None:
+            self.priority = priority
+        self.template_rev += 1
+
+    def template(self) -> dict:
+        return {"cpu_milli": self.cpu_milli, "memory": self.memory,
+                "priority": self.priority}
+
+
+@dataclass
+class ControllerRevision:
+    """apps/v1 ControllerRevision (pkg/controller/history): an immutable
+    template snapshot DS/STS updates key on — the rollback target
+    `kubectl rollout undo` resolves. ``data`` is the hollow template
+    (cpu/memory/priority)."""
+
+    owner_kind: str
+    owner_name: str
+    revision: int
+    data: Dict = field(default_factory=dict)
+
+    def key(self) -> str:
+        return f"{self.owner_kind}/{self.owner_name}/{self.revision}"
 
 
 @dataclass
@@ -622,6 +683,10 @@ class HollowCluster:
         self.app_health: Dict[str, bool] = {}
         #: pod key -> Running transition time (probe initialDelay clock)
         self._started_at: Dict[str, float] = {}
+        #: apps/v1 ControllerRevisions (pkg/controller/history): template
+        #: snapshots per DS/STS revision, maintained by reconcile_history
+        self.controller_revisions: Dict[str, ControllerRevision] = {}
+        self.revision_history_limit = 10
         self.replicasets: Dict[str, ReplicaSet] = {}
         #: v1 ReplicationControllers — same machinery as ReplicaSets
         #: (see ReplicaSet.kind), separate registry so the kinds can't
@@ -1010,7 +1075,7 @@ class HollowCluster:
         "replication_controllers", "csrs", "signed_certs", "configmaps",
         "bootstrap_tokens", "cluster_roles", "cluster_role_bindings",
         "cluster_ca", "_created_at", "_term_grace", "_terminal_gone",
-        "terminated_pod_threshold",
+        "terminated_pod_threshold", "controller_revisions",
     )
 
     def _semantic_config(self) -> dict:
@@ -2038,8 +2103,49 @@ class HollowCluster:
                 if p.labels.get("ss") == name:
                     self.delete_pod(key)
 
+    def reconcile_history(self) -> None:
+        """The history controller (pkg/controller/history
+        ControllerRevisions): snapshot every DS/STS template revision,
+        GC beyond revisionHistoryLimit (oldest first, never the live
+        revision), drop revisions of deleted owners."""
+        owners = (
+            [("DaemonSet", n, d) for n, d in self.daemonsets.items()]
+            + [("StatefulSet", n, s) for n, s in self.statefulsets.items()]
+        )
+        live = set()
+        for kind, name, obj in owners:
+            key = f"{kind}/{name}/{obj.template_rev}"
+            if key not in self.controller_revisions:
+                self.controller_revisions[key] = ControllerRevision(
+                    kind, name, obj.template_rev, obj.template())
+            per_owner = sorted(
+                (cr for cr in self.controller_revisions.values()
+                 if cr.owner_kind == kind and cr.owner_name == name),
+                key=lambda cr: cr.revision)
+            while (len(per_owner) > self.revision_history_limit
+                   and per_owner[0].revision != obj.template_rev):
+                del self.controller_revisions[per_owner.pop(0).key()]
+            live.update(cr.key() for cr in per_owner)
+        for key in [k for k in self.controller_revisions if k not in live]:
+            del self.controller_revisions[key]
+
+    def rollback(self, kind: str, name: str, to_revision: int) -> None:
+        """``kubectl rollout undo --to-revision`` for DS/STS: re-apply
+        the stored revision's template. Like the reference, undo creates
+        a NEW revision carrying the old template (history is
+        append-only), and the rolling machinery replaces pods."""
+        cr = self.controller_revisions.get(f"{kind}/{name}/{to_revision}")
+        if cr is None:
+            raise KeyError(
+                f"{kind.lower()}s {name!r} has no revision {to_revision}")
+        obj = (self.daemonsets if kind == "DaemonSet"
+               else self.statefulsets)[name]
+        obj.rollout(**cr.data)
+
     def reconcile_controllers(self) -> None:
         import math
+
+        self.reconcile_history()
 
         # hpa: scale the target deployment toward the metric target
         # (podautoscaler/horizontal.go; desired = ceil(current * ratio),
@@ -2279,6 +2385,28 @@ class HollowCluster:
                              and p.node_name != node_name)
                 if node_name not in keep or mispinned:
                     self.delete_pod(key)
+            # RollingUpdate (daemon/update.go rollingUpdate): delete
+            # stale-revision daemon pods while at most max_unavailable
+            # nodes lack a RUNNING current-revision pod — the normal
+            # create loop below recreates with the new template (one
+            # node at a time at the default maxUnavailable=1)
+            want_rev = str(ds.template_rev)
+            # unavailable = daemon pods not RUNNING (any revision): a
+            # stale-but-running pod still serves — it does not charge
+            # the budget, it's what the budget lets us kill
+            unavail = sum(
+                1 for key in ds.live
+                if (p := self.truth_pods.get(key)) is None
+                or not p.node_name
+            )
+            budget = ds.max_unavailable - unavail
+            for key in sorted(ds.live):
+                if budget <= 0:
+                    break
+                p = self.truth_pods.get(key)
+                if p is not None and p.labels.get("rev") != want_rev:
+                    self.delete_pod(key)
+                    budget -= 1
             have = set(ds.live.values())
             for node_name in sorted(
                     n.name for n in self.truth_nodes.values()
@@ -2286,7 +2414,8 @@ class HollowCluster:
                 pod = make_pod(
                     f"{ds.name}-{node_name}",
                     cpu_milli=ds.cpu_milli, memory=ds.memory,
-                    priority=ds.priority, labels={"ds": ds.name},
+                    priority=ds.priority,
+                    labels={"ds": ds.name, "rev": want_rev},
                     affinity=node_affinity_required(
                         [req("kubernetes.io/hostname", "In", node_name)]
                     ),
@@ -2315,12 +2444,27 @@ class HollowCluster:
             if over:
                 self.delete_pod(by_ord[max(over)].key())
                 continue  # one termination per sync; creation waits
+            # RollingUpdate (stateful_set_control.go updateStatefulSet):
+            # ordinals >= partition whose revision is stale are deleted
+            # HIGHEST-first, one per sync, only while every pod is bound
+            # (OrderedReady never tears down into an unsettled set); the
+            # missing-ordinal create below recreates with the new
+            # template. Ordinals below the partition keep the old
+            # revision — the canary boundary.
+            want_rev = str(ss.template_rev)
+            if all(p.node_name for p in by_ord.values()):
+                stale = [o for o, p in by_ord.items()
+                         if o >= ss.partition
+                         and p.labels.get("rev") != want_rev]
+                if stale:
+                    self.delete_pod(by_ord[max(stale)].key())
+                    continue
             for o in range(ss.replicas):
                 p = by_ord.get(o)
                 if p is None:
                     pod = make_pod(ss.pod_name(o), cpu_milli=ss.cpu_milli,
                                    memory=ss.memory, priority=ss.priority,
-                                   labels={"ss": ss.name},
+                                   labels={"ss": ss.name, "rev": want_rev},
                                    owner_refs=(OwnerReference(
                                        "StatefulSet", ss.name),))
                     try:

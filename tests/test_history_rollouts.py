@@ -1,0 +1,119 @@
+"""ControllerRevisions + DaemonSet/StatefulSet rolling updates
+(pkg/controller/history, daemon/update.go rollingUpdate,
+stateful_set_control.go updateStatefulSet): template updates replace
+pods incrementally under their strategy's budget, every revision is
+snapshotted, history is bounded, and rollback re-applies a stored
+template as a NEW revision."""
+
+from kubernetes_tpu.sim import DaemonSet, HollowCluster, StatefulSet
+from kubernetes_tpu.testing import make_node
+
+
+def _hub(n_nodes=3):
+    hub = HollowCluster(seed=71, scheduler_kw={"enable_preemption": False})
+    for i in range(n_nodes):
+        hub.add_node(make_node(f"n{i}", cpu_milli=4000, pods=16))
+    return hub
+
+
+def _settle(hub, n=8):
+    for _ in range(n):
+        hub.step()
+
+
+def test_daemonset_rolling_update_one_node_at_a_time():
+    hub = _hub()
+    hub.daemonsets["agent"] = DaemonSet("agent", cpu_milli=100)
+    _settle(hub)
+    pods = [p for p in hub.truth_pods.values()
+            if p.labels.get("ds") == "agent"]
+    assert len(pods) == 3 and all(p.node_name for p in pods)
+
+    hub.daemonsets["agent"].rollout(cpu_milli=200)
+    # after ONE sync at maxUnavailable=1, at most one node's pod was
+    # replaced; the rest still run the old template
+    hub.step()
+    revs = [p.labels.get("rev") for p in hub.truth_pods.values()
+            if p.labels.get("ds") == "agent"]
+    assert revs.count("2") <= 1
+    _settle(hub)
+    pods = [p for p in hub.truth_pods.values()
+            if p.labels.get("ds") == "agent"]
+    assert len(pods) == 3
+    assert all(p.labels.get("rev") == "2" for p in pods)
+    assert all(p.requests.cpu_milli == 200 for p in pods)
+    hub.check_consistency()
+
+
+def test_statefulset_rolling_update_reverse_order_with_partition():
+    hub = _hub()
+    hub.statefulsets["db"] = StatefulSet("db", replicas=3, cpu_milli=100)
+    _settle(hub)
+    assert all(hub.truth_pods[f"default/db-{o}"].node_name
+               for o in range(3))
+
+    hub.statefulsets["db"].partition = 1  # canary: ordinal 0 keeps old
+    hub.statefulsets["db"].rollout(cpu_milli=250)
+    # highest stale ordinal goes first
+    hub.step()
+    assert ("default/db-2" not in hub.truth_pods
+            or hub.truth_pods["default/db-2"].labels.get("rev") == "2")
+    _settle(hub, 10)
+    p0 = hub.truth_pods["default/db-0"]
+    assert p0.labels.get("rev") == "1"          # below the partition
+    assert p0.requests.cpu_milli == 100
+    for o in (1, 2):
+        p = hub.truth_pods[f"default/db-{o}"]
+        assert p.labels.get("rev") == "2" and p.requests.cpu_milli == 250
+    # finishing the rollout: partition lowered to 0 updates the canary
+    hub.statefulsets["db"].partition = 0
+    _settle(hub, 6)
+    assert hub.truth_pods["default/db-0"].labels.get("rev") == "2"
+    hub.check_consistency()
+
+
+def test_controller_revisions_recorded_bounded_and_rollbackable():
+    hub = _hub(1)
+    ds = DaemonSet("agent", cpu_milli=100)
+    hub.daemonsets["agent"] = ds
+    _settle(hub, 2)
+    for i in range(12):  # 12 more revisions: history bounded at 10
+        ds.rollout(cpu_milli=100 + i)
+        hub.step()
+    revs = [cr.revision for cr in hub.controller_revisions.values()
+            if cr.owner_name == "agent"]
+    assert len(revs) <= hub.revision_history_limit
+    assert ds.template_rev in revs          # live revision always kept
+    # rollback to a retained old revision = NEW revision, old template
+    target = min(revs)
+    old_cpu = hub.controller_revisions[
+        f"DaemonSet/agent/{target}"].data["cpu_milli"]
+    before = ds.template_rev
+    hub.rollback("DaemonSet", "agent", target)
+    assert ds.template_rev == before + 1 and ds.cpu_milli == old_cpu
+    _settle(hub, 4)
+    pods = [p for p in hub.truth_pods.values()
+            if p.labels.get("ds") == "agent"]
+    assert pods and all(p.requests.cpu_milli == old_cpu for p in pods)
+
+
+def test_revisions_of_deleted_owner_are_dropped():
+    hub = _hub(1)
+    hub.statefulsets["db"] = StatefulSet("db", replicas=1)
+    _settle(hub, 2)
+    assert any(cr.owner_name == "db"
+               for cr in hub.controller_revisions.values())
+    del hub.statefulsets["db"]
+    hub.step()
+    assert not any(cr.owner_name == "db"
+                   for cr in hub.controller_revisions.values())
+
+
+def test_rollback_unknown_revision_is_loud():
+    hub = _hub(1)
+    hub.daemonsets["agent"] = DaemonSet("agent")
+    hub.step()
+    import pytest
+
+    with pytest.raises(KeyError):
+        hub.rollback("DaemonSet", "agent", 99)
