@@ -420,6 +420,66 @@ def cmd_get_serviceaccounts(rest: RestClient, args) -> int:
     return 0
 
 
+def cmd_get_daemonsets(rest: RestClient, args) -> int:
+    """kubectl get daemonsets: desired/ready/updated per DS."""
+    code, doc = rest.call("GET", "/apis/apps/v1/namespaces/default/"
+                                 "daemonsets")
+    if code != 200:
+        return _rest_fail(doc)
+    rows = [[it["metadata"]["name"],
+             str(it["status"]["desiredNumberScheduled"]),
+             str(it["status"]["numberReady"]),
+             str(it["status"]["updatedNumberScheduled"]),
+             str(it["status"]["observedRevision"])]
+            for it in doc["items"]]
+    print(_fmt_table(["NAME", "DESIRED", "READY", "UPDATED", "REV"], rows))
+    return 0
+
+
+def cmd_get_statefulsets(rest: RestClient, args) -> int:
+    """kubectl get statefulsets: replicas/ready/updated per STS."""
+    code, doc = rest.call("GET", "/apis/apps/v1/namespaces/default/"
+                                 "statefulsets")
+    if code != 200:
+        return _rest_fail(doc)
+    rows = [[it["metadata"]["name"],
+             f'{it["status"]["readyReplicas"]}/{it["spec"]["replicas"]}',
+             str(it["status"]["updatedReplicas"]),
+             str(it["status"]["observedRevision"])]
+            for it in doc["items"]]
+    print(_fmt_table(["NAME", "READY", "UPDATED", "REV"], rows))
+    return 0
+
+
+def cmd_rollout_history(rest: RestClient, args) -> int:
+    """kubectl rollout history: the ControllerRevision trail for one
+    DS/STS (kind/name target, like rollout status)."""
+    kind, _, name = args.target.partition("/")
+    kind_map = {"daemonset": "DaemonSet", "ds": "DaemonSet",
+                "statefulset": "StatefulSet", "sts": "StatefulSet"}
+    owner_kind = kind_map.get(kind.lower())
+    if owner_kind is None or not name:
+        print(f"Error: rollout history target must be "
+              f"daemonset/NAME or statefulset/NAME, got {args.target!r}",
+              file=sys.stderr)
+        return 2
+    code, doc = rest.call("GET", "/apis/apps/v1/namespaces/default/"
+                                 "controllerrevisions")
+    if code != 200:
+        return _rest_fail(doc)
+    rows = [[str(it["revision"]),
+             ", ".join(f"{k}={v}" for k, v in sorted(it["data"].items()))]
+            for it in sorted(doc["items"], key=lambda i: i["revision"])
+            if it["metadata"]["ownerReferences"][0]["kind"] == owner_kind
+            and it["metadata"]["ownerReferences"][0]["name"] == name]
+    if not rows:
+        print(f"Error: no revisions found for {args.target}",
+              file=sys.stderr)
+        return 1
+    print(_fmt_table(["REVISION", "TEMPLATE"], rows))
+    return 0
+
+
 def cmd_get_leases(rest: RestClient, args) -> int:
     """kubectl get leases (coordination.k8s.io/v1): HA state over REST —
     who holds each lock and how fresh the renewal is."""
@@ -670,7 +730,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         cv = sub.add_parser(verb)
         cv.add_argument("name")
     ro = sub.add_parser("rollout")
-    ro.add_argument("verb", choices=["status"])
+    ro.add_argument("verb", choices=["status", "history"])
     ro.add_argument("target")  # deployment/NAME
     sc = sub.add_parser("scale")
     sc.add_argument("target")  # deployment/NAME
@@ -686,6 +746,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         except ValueError:
             p.error(f"--api-server must be HOST:PORT, got {args.api_server!r}")
         try:
+            if args.verb == "history":
+                return cmd_rollout_history(rest, args)
             return cmd_rollout_status(rest, args)
         except OSError as e:
             print(f"Error: cannot reach API server {args.api_server}: {e}",
@@ -696,7 +758,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                                            "namespaces", "ns",
                                            "deployments", "deploy",
                                            "csr", "configmaps", "cm",
-                                           "serviceaccounts", "sa"):
+                                           "serviceaccounts", "sa",
+                                           "daemonsets", "ds",
+                                           "statefulsets", "sts"):
         if not args.api_server:
             p.error(f"get {args.kind} requires --api-server")
         try:
@@ -716,6 +780,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 return cmd_get_configmaps(rest, args)
             if args.kind in ("serviceaccounts", "sa"):
                 return cmd_get_serviceaccounts(rest, args)
+            if args.kind in ("daemonsets", "ds"):
+                return cmd_get_daemonsets(rest, args)
+            if args.kind in ("statefulsets", "sts"):
+                return cmd_get_statefulsets(rest, args)
             return cmd_get_events(rest, args)
         except OSError as e:
             print(f"Error: cannot reach API server {args.api_server}: {e}",

@@ -149,7 +149,11 @@ GROUPS = {
     APPS_GROUP: (("deployments", "Deployment", True,
                   ("create", "delete", "get", "list", "patch", "update")),
                  ("deployments/scale", "Scale", True, ("get", "update")),
-                 ("replicasets", "ReplicaSet", True, ("get", "list"))),
+                 ("replicasets", "ReplicaSet", True, ("get", "list")),
+                 ("daemonsets", "DaemonSet", True, ("get", "list")),
+                 ("statefulsets", "StatefulSet", True, ("get", "list")),
+                 ("controllerrevisions", "ControllerRevision", True,
+                  ("list",))),
     CERT_GROUP: (("certificatesigningrequests",
                   "CertificateSigningRequest", False, ("get", "list")),),
 }
@@ -1115,21 +1119,91 @@ class RestServer:
             # namespaces legitimately have an EMPTY list of the KNOWN
             # kinds — but an unknown resource is 404, not a mislabeled
             # empty list
-            if seg == ["deployments"] or seg == ["replicasets"]:
+            empty_kinds = {"deployments": "DeploymentList",
+                           "replicasets": "ReplicaSetList",
+                           "daemonsets": "DaemonSetList",
+                           "statefulsets": "StatefulSetList",
+                           "controllerrevisions": "ControllerRevisionList"}
+            if len(seg) == 1 and seg[0] in empty_kinds:
                 return h._respond(200, {
-                    "kind": ("DeploymentList" if seg == ["deployments"]
-                             else "ReplicaSetList"),
+                    "kind": empty_kinds[seg[0]],
                     "apiVersion": "apps/v1",
                     "metadata": {"resourceVersion": str(hub._revision)},
                     "items": []})
             return h._fail(404, "NotFound", h.path)
-        for kind, registry, doc in (
-                ("deployments", hub.deployments, deploy_doc),
-                ("replicasets", hub.replicasets, rs_doc)):
+        def ds_doc(ds):
+            live = [k for k in ds.live if k in hub.truth_pods]
+            current = [k for k in live
+                       if hub.truth_pods[k].labels.get("rev")
+                       == str(ds.template_rev)]
+            return _with_rv({
+                "metadata": {"name": ds.name, "namespace": "default"},
+                "spec": {
+                    "updateStrategy": {"type": "RollingUpdate",
+                                       "rollingUpdate": {"maxUnavailable":
+                                                         ds.max_unavailable}},
+                    "template": {"spec": {"nodeSelector":
+                                          dict(ds.node_selector)}},
+                },
+                "status": {
+                    "desiredNumberScheduled": len(ds.live),
+                    "numberReady": sum(
+                        1 for k in live if hub.truth_pods[k].node_name),
+                    "updatedNumberScheduled": len(current),
+                    "observedRevision": ds.template_rev,
+                },
+            }, hub, f"daemonsets/{ds.name}")
+
+        def sts_doc(ss):
+            pods = [p for p in hub.truth_pods.values()
+                    if p.labels.get("ss") == ss.name]
+            return _with_rv({
+                "metadata": {"name": ss.name, "namespace": "default"},
+                "spec": {
+                    "replicas": ss.replicas,
+                    "updateStrategy": {"type": "RollingUpdate",
+                                       "rollingUpdate": {"partition":
+                                                         ss.partition}},
+                },
+                "status": {
+                    "replicas": len(pods),
+                    "readyReplicas": sum(1 for p in pods if p.node_name),
+                    "updatedReplicas": sum(
+                        1 for p in pods
+                        if p.labels.get("rev") == str(ss.template_rev)),
+                    "observedRevision": ss.template_rev,
+                },
+            }, hub, f"statefulsets/{ss.name}")
+
+        def cr_doc(cr):
+            return _with_rv({
+                "metadata": {"name": f"{cr.owner_name}-{cr.revision}",
+                             "namespace": "default",
+                             "ownerReferences": [{"kind": cr.owner_kind,
+                                                  "name": cr.owner_name}]},
+                "revision": cr.revision,
+                "data": dict(cr.data),
+            }, hub, f"controllerrevisions/{cr.key()}")
+
+        if seg == ["controllerrevisions"]:
+            items = [cr_doc(cr) for _, cr in
+                     sorted(hub.controller_revisions.items())]
+            return h._respond(200, {
+                "kind": "ControllerRevisionList", "apiVersion": "apps/v1",
+                "metadata": {"resourceVersion": str(hub._revision)},
+                "items": items,
+            })
+        for kind, registry, doc, list_kind in (
+                ("deployments", hub.deployments, deploy_doc,
+                 "DeploymentList"),
+                ("replicasets", hub.replicasets, rs_doc,
+                 "ReplicaSetList"),
+                ("daemonsets", hub.daemonsets, ds_doc, "DaemonSetList"),
+                ("statefulsets", hub.statefulsets, sts_doc,
+                 "StatefulSetList")):
             if seg == [kind]:
                 return h._respond(200, {
-                    "kind": ("DeploymentList" if kind == "deployments"
-                             else "ReplicaSetList"),
+                    "kind": list_kind,
                     "apiVersion": "apps/v1",
                     "metadata": {"resourceVersion": str(hub._revision)},
                     "items": [doc(o) for _, o in sorted(registry.items())],

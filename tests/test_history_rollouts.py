@@ -109,6 +109,57 @@ def test_revisions_of_deleted_owner_are_dropped():
                    for cr in hub.controller_revisions.values())
 
 
+def test_apps_ds_sts_served_and_rollout_history(capsys):
+    """DS/STS status + ControllerRevisions over REST, and the operator
+    verbs: ktpu get ds/sts, ktpu rollout history."""
+    from kubernetes_tpu.kubectl import main as ktpu
+    from kubernetes_tpu.restapi import RestServer
+    from tests.test_restapi import req
+
+    hub = _hub()
+    hub.daemonsets["agent"] = DaemonSet("agent", cpu_milli=100)
+    hub.statefulsets["db"] = StatefulSet("db", replicas=2)
+    _settle(hub, 6)
+    hub.daemonsets["agent"].rollout(cpu_milli=150)
+    hub.step()
+    srv = RestServer(hub, port=0)
+    port = srv.serve()
+    try:
+        code, doc = req(port, "GET",
+                        "/apis/apps/v1/namespaces/default/daemonsets")
+        assert code == 200 and doc["kind"] == "DaemonSetList"
+        st = doc["items"][0]["status"]
+        assert st["desiredNumberScheduled"] == 3
+        assert st["observedRevision"] == 2
+        code, doc = req(
+            port, "GET",
+            "/apis/apps/v1/namespaces/default/statefulsets/db")
+        assert code == 200 and doc["status"]["readyReplicas"] == 2
+        code, doc = req(
+            port, "GET",
+            "/apis/apps/v1/namespaces/default/controllerrevisions")
+        assert code == 200
+        agent_revs = [i["revision"] for i in doc["items"]
+                      if i["metadata"]["ownerReferences"][0]["name"]
+                      == "agent"]
+        assert sorted(agent_revs) == [1, 2]
+
+        api = ["--api-server", f"127.0.0.1:{port}"]
+        assert ktpu(api + ["get", "ds"]) == 0
+        out = capsys.readouterr().out
+        assert "agent" in out and "DESIRED" in out
+        assert ktpu(api + ["get", "sts"]) == 0
+        out = capsys.readouterr().out
+        assert "db" in out and "2/2" in out
+        assert ktpu(api + ["rollout", "history", "daemonset/agent"]) == 0
+        out = capsys.readouterr().out
+        assert "cpu_milli=100" in out and "cpu_milli=150" in out
+        # unknown target errors loudly
+        assert ktpu(api + ["rollout", "history", "daemonset/ghost"]) == 1
+    finally:
+        srv.close()
+
+
 def test_rollback_unknown_revision_is_loud():
     hub = _hub(1)
     hub.daemonsets["agent"] = DaemonSet("agent")

@@ -789,8 +789,11 @@ def test_discovery_and_openapi_surface():
         # fixtures for the r5 read-only item routes ({name} -> d0):
         hub.put_configmap("default", "d0", {"k": "v"})
         from kubernetes_tpu.certificates import CertificateSigningRequest
+        from kubernetes_tpu.sim import DaemonSet, StatefulSet
 
         hub.create_csr(CertificateSigningRequest(name="d0"))
+        hub.daemonsets["d0"] = DaemonSet("d0")
+        hub.statefulsets["d0"] = StatefulSet("d0", replicas=1)
         ops = sorted(
             ((method, route)
              for route, methods in spec["paths"].items()
