@@ -183,6 +183,9 @@ class CertificateController:
                 self.approved_total += 1
                 self.hub._commit(f"certificatesigningrequests/{csr.name}",
                                  "MODIFIED", csr)
+                self.hub.record_controller_event(
+                    "CSRApproved", f"default/{csr.name}",
+                    csr.approval_message)
                 return
             self.denied_ignored_total += 1
 

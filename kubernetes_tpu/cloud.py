@@ -209,6 +209,9 @@ class ServiceLBController:
             if svc.load_balancer_ingress != ingress:
                 svc.load_balancer_ingress = ingress
                 hub._commit(f"services/{key}", "MODIFIED", svc)
+                hub.record_controller_event(
+                    "EnsuredLoadBalancer", key,
+                    f"Ensured load balancer at {ingress}")
         # needsCleanup: balancers whose service is gone or no longer
         # Type=LoadBalancer (the hub's delete_service cannot know about
         # cloud state — this pass owns the teardown)
@@ -261,7 +264,7 @@ class RouteController:
             if routes.get(name) != cidr:
                 try:
                     self.cloud.create_route(self.cluster, name, cidr)
-                except Exception:
+                except Exception as e:
                     # no working route: RAISE the condition (the
                     # CheckNodeCondition predicate keeps pods off this
                     # node) — updateNetworkingCondition's failure half;
@@ -270,6 +273,10 @@ class RouteController:
                     # dataplane that does not exist
                     self.create_failures += 1
                     self._set_network_unavailable(name, True)
+                    hub.record_controller_event(
+                        "FailedToCreateRoute", f"default/{name}",
+                        f"Could not create route {cidr}: {e}",
+                        type_="Warning")
                     continue
             self._set_network_unavailable(name, False)
 

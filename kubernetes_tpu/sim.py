@@ -833,6 +833,32 @@ class HollowCluster:
             self._compacted_rev = self._revision
         return self._revision
 
+    def record_controller_event(self, reason: str, object_key: str,
+                                message: str,
+                                type_: str = "Normal") -> None:
+        """Controller-manager event seam (the recorder each reference
+        controller carries): aggregate-upsert an Event about any object
+        into the hub store — visible via the v1 EventList and
+        ``ktpu get events`` like every other event."""
+        import hashlib
+
+        from kubernetes_tpu.events import Event
+
+        now = self.clock.t
+        ev = Event(type=type_, reason=reason, object_key=object_key,
+                   message=message, first_timestamp=now,
+                   last_timestamp=now)
+        # aggregate with the stored series the way the recorder would
+        # (same derivation as _store_event's key)
+        series = hashlib.sha1(
+            f"{object_key}|{reason}|{message}".encode()).hexdigest()[:10]
+        ns, _, name = object_key.partition("/")
+        prior = self.events_v1.get(f"{ns}/{name}.{series}")
+        if prior is not None:
+            ev.count = prior.count + 1
+            ev.first_timestamp = prior.first_timestamp
+        self._store_event(ev)
+
     def _store_event(self, ev) -> None:
         """Upsert an (aggregated) Event into the hub store — the
         events-registry write client-go's recorder performs; same key for
@@ -1940,6 +1966,10 @@ class HollowCluster:
             for cj in self.cronjobs.values():
                 if name in cj.spawned:
                     cj.spawned.remove(name)
+            self.record_controller_event(
+                "SuccessfulDelete", f"default/{name}",
+                f"Deleted job {name} past its "
+                f"ttlSecondsAfterFinished={j.ttl_seconds_after_finished:g}")
 
     def attach_cloud(self, cloud) -> None:
         """Run the cluster under an external cloud provider: the cloud

@@ -103,6 +103,10 @@ def test_route_create_failure_raises_network_unavailable():
     assert cloud.list_routes("ktpu")  # retried and installed
     assert all(not nd.conditions.network_unavailable
                for nd in hub.truth_nodes.values())
+    # the failure was recorded as a Warning event on the node
+    assert any(ev.reason == "FailedToCreateRoute"
+               and ev.type == "Warning"
+               for ev in hub.events_v1.values())
 
 
 def test_replication_controller_keeps_replicas():
