@@ -184,3 +184,112 @@ def test_long_soak_mixed_control_plane():
     assert len(hub._history) < 2000
     assert hub.pending_count() <= 2
     assert len(hub.truth_pods) < 120
+
+
+def test_long_soak_round5_subsystems():
+    """Round-5 soak: the identity/cloud/GC controllers under 300 ticks
+    of churn on a cloud-attached kubeadm cluster — DS/STS rollouts
+    mid-flight, run-to-completion pods against the GC threshold, TTL'd
+    jobs on a cadence, CSR issue/expiry, PVC-protection deletes of
+    in-use claims, instance termination taking a node (and its routes)
+    away — consistency + controller invariants at intervals."""
+    from kubernetes_tpu.api.types import (
+        BINDING_IMMEDIATE,
+        PersistentVolume,
+        PersistentVolumeClaim,
+        PodVolume,
+        StorageClass,
+        is_pod_terminated,
+    )
+    from kubernetes_tpu.bootstrap import init_cluster, join_node
+    from kubernetes_tpu.certificates import node_bootstrap_csr
+    from kubernetes_tpu.cloud import FakeCloud, Instance
+    from kubernetes_tpu.proxy import Service
+    from kubernetes_tpu.testing import make_pod
+
+    rng = random.Random(5050)
+    hub, token = init_cluster()
+    hub.terminated_pod_threshold = 4
+    hub.cert_controller.cert_duration_s = 600.0  # certs expire mid-soak
+    cloud = FakeCloud()
+    hub.attach_cloud(cloud)
+    for i in range(8):
+        name = f"w{i}"
+        cloud.add_instance(Instance(name, zone=f"z{i % 2}"))
+        join_node(hub, token, make_node(name, cpu_milli=8000,
+                                        memory=16 * 2**30, pods=32))
+    hub.add_daemonset(DaemonSet("agent"))
+    hub.add_statefulset(StatefulSet("db", replicas=3))
+    hub.add_replication_controller("rc-web", replicas=3)
+    hub.add_service(Service("web", selector={"rc": "rc-web"},
+                            type="LoadBalancer"))
+    hub.add_storage_class(StorageClass("std", BINDING_IMMEDIATE))
+    hub.add_pv(PersistentVolume("pv-a", kind="gce-pd", handle="a",
+                                storage_class="std"))
+    hub.add_pvc(PersistentVolumeClaim("data", storage_class="std"))
+    hub.create_pod(make_pod("pvc-user", cpu_milli=100,
+                            volumes=(PodVolume(pvc="data"),)))
+
+    killed_instance = None
+    for tick in range(300):
+        if tick % 10 == 3:  # batch work arriving
+            hub.create_pod(make_pod(f"batch-{tick}", cpu_milli=100,
+                                    run_duration_s=30.0))
+        if tick % 40 == 7:  # TTL'd job cadence
+            hub.jobs[f"job-{tick}"] = Job(
+                f"job-{tick}", completions=2, parallelism=2,
+                duration_s=30.0, ttl_seconds_after_finished=120.0)
+        if tick % 60 == 13:  # CSR churn under the bootstrap identity
+            user = hub.credential_user(token)
+            name = f"w{rng.randrange(8)}-{tick}"
+            hub.create_csr(node_bootstrap_csr(
+                name, username=user.name, groups=user.groups))
+        if tick == 80:  # DS rollout mid-soak
+            hub.daemonsets["agent"].rollout(cpu_milli=75)
+        if tick == 140:  # STS rollout
+            hub.statefulsets["db"].rollout(cpu_milli=150)
+        if tick == 170:  # delete the in-use PVC: protection must defer
+            assert hub.delete_pvc("default/data") is False
+        if tick == 180:
+            hub.delete_pod("default/pvc-user")  # releases the claim
+        if tick == 200 and killed_instance is None:
+            killed_instance = f"w{rng.randrange(8)}"
+            cloud.terminate(killed_instance)
+        if tick % 25 == 20:
+            hub.churn(kill_pods=rng.randrange(0, 2))
+        hub.step(dt=15.0)
+        if tick % 50 == 49:
+            hub.check_consistency()
+
+    for _ in range(8):
+        hub.step(dt=15.0)
+    hub.check_consistency()
+    check_controller_invariants(hub)
+    # GC threshold held
+    terminal = [k for k, p in hub.truth_pods.items()
+                if is_pod_terminated(p)]
+    assert len(terminal) <= 4
+    # protection finalized the released claim; its PV is Available
+    assert "default/data" not in hub.pvcs
+    assert hub.pvs["pv-a"].claim_ref == ""
+    # the terminated instance's node AND route are gone
+    assert killed_instance not in hub.truth_nodes
+    assert killed_instance not in cloud.list_routes("ktpu")
+    # rollouts completed: every daemon/db pod on the current revision
+    for p in hub.truth_pods.values():
+        if p.labels.get("ds") == "agent":
+            assert p.labels.get("rev") == str(
+                hub.daemonsets["agent"].template_rev)
+        if p.labels.get("ss") == "db":
+            assert p.labels.get("rev") == str(
+                hub.statefulsets["db"].template_rev)
+    # TTL'd jobs age out; CSR cleaner + cert expiry bound the registries
+    assert sum(1 for j in hub.jobs.values()
+               if j.ttl_seconds_after_finished is not None) <= 2
+    assert len(hub.csrs) <= 6
+    # LB backend set tracks the live node set
+    lb = cloud.load_balancers["default/web"]
+    assert set(lb["nodes"]) == set(hub.truth_nodes) - {"control-plane"}
+    # bounded growth
+    assert len(hub._history) < 2000
+    assert len(hub.truth_pods) < 150
