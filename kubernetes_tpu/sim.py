@@ -326,11 +326,17 @@ class DaemonSet:
     #: at most this many nodes may be without a CURRENT-revision daemon
     #: pod due to the update at once
     max_unavailable: int = 1
+    #: (revision, template) pairs not yet drained into the hub's
+    #: ControllerRevision registry — rollout() records SYNCHRONOUSLY
+    #: here so a revision current for zero reconcile passes (two
+    #: rollouts between ticks) is never lost from history
+    pending_revisions: List[Tuple[int, Dict]] = field(default_factory=list)
 
     def rollout(self, cpu_milli=None, memory=None, priority=None) -> None:
         """Template update (apps/v1 RollingUpdate updateStrategy): stale
         daemon pods are replaced node by node under max_unavailable; the
         history pass records a ControllerRevision per template."""
+        self.pending_revisions.append((self.template_rev, self.template()))
         if cpu_milli is not None:
             self.cpu_milli = cpu_milli
         if memory is not None:
@@ -396,9 +402,16 @@ class StatefulSet:
     #: current template revision (updateRevision); pods carry it as the
     #: controller-revision-hash label analog
     template_rev: int = 1
+    #: status.currentRevision: the revision BELOW-partition pods are
+    #: recreated at (the canary boundary's other half — reference
+    #: recreates them at currentRevision, not updateRevision); advanced
+    #: to template_rev when the rollout completes
+    current_rev: int = 1
     #: RollingUpdate partition (stateful_set_control.go: only ordinals
     #: >= partition update; a canary knob — 0 = update everything)
     partition: int = 0
+    #: see DaemonSet.pending_revisions
+    pending_revisions: List[Tuple[int, Dict]] = field(default_factory=list)
 
     def pod_name(self, ordinal: int) -> str:
         return f"{self.name}-{ordinal}"
@@ -407,6 +420,7 @@ class StatefulSet:
         """Template update (apps/v1 RollingUpdate): stale pods with
         ordinal >= partition are replaced highest-first, one per sync,
         each waiting for its successor to run (OrderedReady)."""
+        self.pending_revisions.append((self.template_rev, self.template()))
         if cpu_milli is not None:
             self.cpu_milli = cpu_milli
         if memory is not None:
@@ -840,37 +854,39 @@ class HollowCluster:
         controller carries): aggregate-upsert an Event about any object
         into the hub store — visible via the v1 EventList and
         ``ktpu get events`` like every other event."""
-        import hashlib
-
         from kubernetes_tpu.events import Event
 
         now = self.clock.t
         ev = Event(type=type_, reason=reason, object_key=object_key,
                    message=message, first_timestamp=now,
                    last_timestamp=now)
-        # aggregate with the stored series the way the recorder would
-        # (same derivation as _store_event's key)
-        series = hashlib.sha1(
-            f"{object_key}|{reason}|{message}".encode()).hexdigest()[:10]
-        ns, _, name = object_key.partition("/")
-        prior = self.events_v1.get(f"{ns}/{name}.{series}")
+        # aggregate with the stored series (one shared key derivation
+        # with _store_event — two copies would silently skew)
+        prior = self.events_v1.get(self._event_series_key(ev))
         if prior is not None:
             ev.count = prior.count + 1
             ev.first_timestamp = prior.first_timestamp
         self._store_event(ev)
+
+    @staticmethod
+    def _event_series_key(ev) -> str:
+        """The store key of an Event's aggregation series: same
+        (object, reason, message) => same key, so recurrences bump
+        count/resourceVersion instead of multiplying objects."""
+        import hashlib
+
+        series = hashlib.sha1(
+            f"{ev.object_key}|{ev.reason}|{ev.message}".encode()
+        ).hexdigest()[:10]
+        ns, _, name = ev.object_key.partition("/")
+        return f"{ns}/{name}.{series}"
 
     def _store_event(self, ev) -> None:
         """Upsert an (aggregated) Event into the hub store — the
         events-registry write client-go's recorder performs; same key for
         the same (object, reason, message) series so aggregation bumps
         resourceVersion instead of multiplying objects."""
-        import hashlib
-
-        series = hashlib.sha1(
-            f"{ev.object_key}|{ev.reason}|{ev.message}".encode()
-        ).hexdigest()[:10]
-        ns = ev.object_key.split("/", 1)[0]
-        key = f"{ns}/{ev.object_key.split('/', 1)[1]}.{series}"
+        key = self._event_series_key(ev)
         verb = "MODIFIED" if key in self.events_v1 else "ADDED"
         self.events_v1[key] = ev
         # bounded like the recorder (and like etcd's event TTL): evict the
@@ -2144,6 +2160,14 @@ class HollowCluster:
         )
         live = set()
         for kind, name, obj in owners:
+            # drain revisions recorded synchronously at rollout() time —
+            # a revision current for zero passes is still history
+            for rev, data in obj.pending_revisions:
+                pkey = f"{kind}/{name}/{rev}"
+                if pkey not in self.controller_revisions:
+                    self.controller_revisions[pkey] = ControllerRevision(
+                        kind, name, rev, data)
+            obj.pending_revisions.clear()
             key = f"{kind}/{name}/{obj.template_rev}"
             if key not in self.controller_revisions:
                 self.controller_revisions[key] = ControllerRevision(
@@ -2152,8 +2176,13 @@ class HollowCluster:
                 (cr for cr in self.controller_revisions.values()
                  if cr.owner_kind == kind and cr.owner_name == name),
                 key=lambda cr: cr.revision)
+            # never GC a revision pods can still be created AT: the
+            # update revision, and (STS) the currentRevision a canary
+            # partition recreates below-boundary pods from
+            keep = {obj.template_rev,
+                    getattr(obj, "current_rev", obj.template_rev)}
             while (len(per_owner) > self.revision_history_limit
-                   and per_owner[0].revision != obj.template_rev):
+                   and per_owner[0].revision not in keep):
                 del self.controller_revisions[per_owner.pop(0).key()]
             live.update(cr.key() for cr in per_owner)
         for key in [k for k in self.controller_revisions if k not in live]:
@@ -2170,6 +2199,11 @@ class HollowCluster:
                 f"{kind.lower()}s {name!r} has no revision {to_revision}")
         obj = (self.daemonsets if kind == "DaemonSet"
                else self.statefulsets)[name]
+        if cr.data == obj.template():
+            # undo to the template already running: the reference
+            # short-circuits ("skipped rollback") — bumping anyway would
+            # roll-restart every pod for zero change
+            return
         obj.rollout(**cr.data)
 
     def reconcile_controllers(self) -> None:
@@ -2489,12 +2523,32 @@ class HollowCluster:
                 if stale:
                     self.delete_pod(by_ord[max(stale)].key())
                     continue
+                if (not stale and len(by_ord) == ss.replicas
+                        and ss.current_rev != ss.template_rev
+                        and ss.partition == 0):
+                    # rollout complete: status.currentRevision catches
+                    # up to updateRevision (updateStatefulSetStatus)
+                    ss.current_rev = ss.template_rev
             for o in range(ss.replicas):
                 p = by_ord.get(o)
                 if p is None:
-                    pod = make_pod(ss.pod_name(o), cpu_milli=ss.cpu_milli,
-                                   memory=ss.memory, priority=ss.priority,
-                                   labels={"ss": ss.name, "rev": want_rev},
+                    if o < ss.partition:
+                        # below the canary boundary: recreate at the
+                        # CURRENT revision's template, not the update's
+                        # (the reference recreates at currentRevision)
+                        cur = self.controller_revisions.get(
+                            f"StatefulSet/{ss.name}/{ss.current_rev}")
+                        tpl = cur.data if cur is not None else ss.template()
+                        rev_label = str(ss.current_rev)
+                    else:
+                        tpl = ss.template()
+                        rev_label = want_rev
+                    pod = make_pod(ss.pod_name(o),
+                                   cpu_milli=tpl["cpu_milli"],
+                                   memory=tpl["memory"],
+                                   priority=tpl["priority"],
+                                   labels={"ss": ss.name,
+                                           "rev": rev_label},
                                    owner_refs=(OwnerReference(
                                        "StatefulSet", ss.name),))
                     try:

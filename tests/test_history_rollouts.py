@@ -168,3 +168,56 @@ def test_rollback_unknown_revision_is_loud():
 
     with pytest.raises(KeyError):
         hub.rollback("DaemonSet", "agent", 99)
+
+
+def test_below_partition_recreation_keeps_current_revision():
+    """Review r5: a below-partition pod deleted for unrelated reasons
+    (node death, eviction) must come back at the CURRENT revision with
+    the OLD template — the canary boundary holds under churn
+    (the reference recreates at status.currentRevision)."""
+    hub = _hub()
+    hub.statefulsets["db"] = StatefulSet("db", replicas=3, cpu_milli=100)
+    _settle(hub)
+    hub.statefulsets["db"].partition = 1
+    hub.statefulsets["db"].rollout(cpu_milli=250)
+    _settle(hub, 10)  # ordinals 1-2 updated; 0 is the canary holdout
+    hub.delete_pod("default/db-0")  # unrelated churn
+    _settle(hub, 4)
+    p0 = hub.truth_pods["default/db-0"]
+    assert p0.labels.get("rev") == "1"
+    assert p0.requests.cpu_milli == 100  # OLD template, not the update
+
+
+def test_every_revision_recorded_even_between_ticks():
+    """Review r5: two rollouts between reconcile passes must both land
+    in history — rollout() records synchronously, the pass drains."""
+    hub = _hub(1)
+    ds = DaemonSet("agent", cpu_milli=100)
+    hub.daemonsets["agent"] = ds
+    ds.rollout(cpu_milli=110)   # rev 1 -> 2 before ANY reconcile
+    ds.rollout(cpu_milli=120)   # rev 2 -> 3, still before a pass
+    hub.step()
+    revs = sorted(cr.revision for cr in hub.controller_revisions.values()
+                  if cr.owner_name == "agent")
+    assert revs == [1, 2, 3]
+    assert hub.controller_revisions[
+        "DaemonSet/agent/1"].data["cpu_milli"] == 100
+    # ...so the ORIGINAL template is rollback-reachable
+    hub.rollback("DaemonSet", "agent", 1)
+    assert ds.cpu_milli == 100
+
+
+def test_rollback_to_identical_template_is_a_noop():
+    """Undo to the template already running must not roll-restart
+    everything (the reference's 'skipped rollback')."""
+    hub = _hub(1)
+    ds = DaemonSet("agent", cpu_milli=100)
+    hub.daemonsets["agent"] = ds
+    hub.step()
+    ds.rollout(cpu_milli=200)
+    hub.step()
+    ds.rollout(cpu_milli=100)  # back to the original template (rev 3)
+    hub.step()
+    before = ds.template_rev
+    hub.rollback("DaemonSet", "agent", 1)  # rev-1 template == current
+    assert ds.template_rev == before  # no bump, no restart
