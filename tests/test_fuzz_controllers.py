@@ -122,6 +122,14 @@ def test_controller_fuzz_campaign():
                 if tick == 9 and rng.random() < 0.3 and hub.truth_nodes:
                     victim = rng.choice(sorted(hub.truth_nodes))
                     hub.kill_kubelet(victim)
+                if tick == 6 and rng.random() < 0.4:
+                    # rolling-update actor (r5): a DS/STS template
+                    # update races the same churn everything else does
+                    rollables = (list(hub.daemonsets.values())
+                                 + list(hub.statefulsets.values()))
+                    if rollables:
+                        rng.choice(rollables).rollout(
+                            cpu_milli=rng.choice([60, 90, 120]))
                 hub.step(dt=15.0)
             # settle: quiesce the control plane with no new disruptions
             for _ in range(6):
