@@ -187,6 +187,66 @@ class DefaultTolerationSeconds:
             pod, tolerations=pod.tolerations + tuple(extra))
 
 
+@dataclass
+class LimitRange:
+    """v1.LimitRange slice (plugin/pkg/admission/limitranger): per-
+    namespace container defaults and min/max bounds for cpu/memory
+    requests. ``default_*`` fill a container that declares nothing;
+    ``min_*``/``max_*`` reject out-of-bounds requests (0 = unbounded)."""
+
+    namespace: str = "default"
+    default_cpu_milli: float = 0.0
+    default_memory: float = 0.0
+    min_cpu_milli: float = 0.0
+    min_memory: float = 0.0
+    max_cpu_milli: float = 0.0
+    max_memory: float = 0.0
+
+
+class LimitRanger:
+    """limitranger/admission.go Admit: apply the namespace's LimitRange
+    defaults to request-less pods, then validate min/max. Runs BEFORE
+    quota (the reference's ordering) so defaulted requests are what
+    quota charges — without that ordering a request-less pod would
+    charge zero and then consume a defaulted amount."""
+
+    def __init__(self, limit_ranges: List[LimitRange]) -> None:
+        self.limit_ranges = limit_ranges
+
+    def admit(self, pod: Pod) -> Pod:
+        for lr in self.limit_ranges:
+            if lr.namespace != pod.namespace:
+                continue
+            req = pod.requests
+            cpu, mem = req.cpu_milli, req.memory
+            if not cpu and lr.default_cpu_milli:
+                cpu = lr.default_cpu_milli
+            if not mem and lr.default_memory:
+                mem = lr.default_memory
+            if lr.min_cpu_milli and cpu < lr.min_cpu_milli:
+                raise AdmissionError(
+                    f"pods \"{pod.name}\" is forbidden: minimum cpu "
+                    f"usage per Container is {lr.min_cpu_milli:g}m")
+            if lr.max_cpu_milli and cpu > lr.max_cpu_milli:
+                raise AdmissionError(
+                    f"pods \"{pod.name}\" is forbidden: maximum cpu "
+                    f"usage per Container is {lr.max_cpu_milli:g}m")
+            if lr.min_memory and mem < lr.min_memory:
+                raise AdmissionError(
+                    f"pods \"{pod.name}\" is forbidden: minimum memory "
+                    f"usage per Container is {lr.min_memory:g}")
+            if lr.max_memory and mem > lr.max_memory:
+                raise AdmissionError(
+                    f"pods \"{pod.name}\" is forbidden: maximum memory "
+                    f"usage per Container is {lr.max_memory:g}")
+            if (cpu, mem) != (req.cpu_milli, req.memory):
+                pod = dataclasses.replace(
+                    pod, requests=dataclasses.replace(
+                        req, cpu_milli=cpu, memory=mem,
+                        scalars=dict(req.scalars)))
+        return pod
+
+
 class ResourceQuotaAdmission:
     """resourcequota/admission.go: evaluate the pod against every quota
     in its namespace; any breach rejects; success charges them all."""
@@ -268,14 +328,18 @@ class QuotaController:
 def default_chain(namespaces: Dict[str, Namespace],
                   classes: Dict[str, PriorityClass],
                   quotas: List[ResourceQuota],
-                  strict_namespaces: bool = False) -> AdmissionChain:
+                  strict_namespaces: bool = False,
+                  limit_ranges: Optional[List[LimitRange]] = None,
+                  ) -> AdmissionChain:
     """The default plugin order — the slice of
     ``kubeapiserver/options/plugins.go`` AllOrderedPlugins this hub
-    enforces (NamespaceLifecycle first, quota last, like the real
+    enforces (NamespaceLifecycle first, LimitRanger BEFORE quota so
+    defaulted requests are what quota charges, quota last — the real
     ordering)."""
     return AdmissionChain([
         NamespaceLifecycle(namespaces, strict_namespaces),
         PriorityAdmission(classes),
         DefaultTolerationSeconds(),
+        LimitRanger(limit_ranges if limit_ranges is not None else []),
         ResourceQuotaAdmission(quotas),
     ])

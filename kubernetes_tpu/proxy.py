@@ -286,6 +286,40 @@ class ServiceProxy:
 # ---------------------------------------------------------------------------
 
 
+class NodePortAllocator:
+    """Sequential allocator over the service node-port range
+    (``pkg/registry/core/service/portallocator``; default 30000-32767):
+    unique ports, explicit reservations honored, release on delete,
+    exhaustion error."""
+
+    def __init__(self, lo: int = 30000, hi: int = 32767) -> None:
+        self.lo, self.hi = lo, hi
+        self._used: set = set()
+        self._next = lo
+
+    def allocate(self) -> int:
+        n = self._next if self.lo <= self._next <= self.hi else self.lo
+        for _ in range(self.hi - self.lo + 1):
+            if n not in self._used:
+                self._used.add(n)
+                self._next = n + 1
+                return n
+            n = n + 1 if n < self.hi else self.lo
+        raise RuntimeError("node-port range exhausted")
+
+    def reserve(self, port: int) -> None:
+        """An explicit spec.ports[].nodePort outside-range or duplicate
+        reservation is the caller's validation problem (the apiserver
+        422s it); in-range ones claim the bitmap slot."""
+        if self.lo <= port <= self.hi:
+            self._used.add(port)
+
+    def release(self, port: int) -> None:
+        self._used.discard(port)
+        if self.lo <= port <= self.hi:
+            self._next = min(self._next, port)
+
+
 class ClusterIPAllocator:
     """Sequential allocator over a /16 service CIDR — the slice of
     ``pkg/registry/core/service/ipallocator`` the hub needs: unique IPs,

@@ -60,6 +60,7 @@ from kubernetes_tpu.debugger import compare
 from kubernetes_tpu.proxy import (
     ClusterIPAllocator,
     EndpointsController,
+    NodePortAllocator,
     ServiceProxy,
 )
 from kubernetes_tpu.scheduler import Scheduler
@@ -749,6 +750,7 @@ class HollowCluster:
         self.endpoints: Dict[str, object] = {}
         self.proxies: Dict[str, object] = {}
         self.ip_alloc = ClusterIPAllocator()
+        self.nodeport_alloc = NodePortAllocator()
         self.endpoints_controller = EndpointsController(self)
         # apiserver admission chain (kubernetes_tpu/admission.py) —
         # opt-in like --enable-admission-plugins; when off, creates land
@@ -764,8 +766,12 @@ class HollowCluster:
         self.bootstrap_tokens: Dict[str, object] = {}
         self.priority_classes: Dict[str, object] = {}
         self.quotas: List = []
+        #: v1 LimitRanges — the LimitRanger admission plugin reads this
+        #: container live (add_limit_range appends)
+        self.limit_ranges: List = []
         self.admission = (
-            default_chain(self.namespaces, self.priority_classes, self.quotas)
+            default_chain(self.namespaces, self.priority_classes,
+                          self.quotas, limit_ranges=self.limit_ranges)
             if admission else None
         )
         self.quota_controller = QuotaController(self)
@@ -1106,7 +1112,7 @@ class HollowCluster:
         "replicasets", "deployments", "jobs", "daemonsets",
         "statefulsets", "cronjobs", "hpas", "pdbs",
         "services", "endpoints", "namespaces", "priority_classes",
-        "quotas", "ip_alloc", "events_v1",
+        "quotas", "ip_alloc", "nodeport_alloc", "events_v1",
         "heartbeats", "dead_kubelets", "_taint_time",
         "_bound_at", "_started_at", "app_health",
         "attachments", "service_accounts", "sa_tokens",
@@ -1118,6 +1124,7 @@ class HollowCluster:
         "bootstrap_tokens", "cluster_roles", "cluster_role_bindings",
         "cluster_ca", "_created_at", "_term_grace", "_terminal_gone",
         "terminated_pod_threshold", "controller_revisions",
+        "limit_ranges",
     )
 
     def _semantic_config(self) -> dict:
@@ -1266,8 +1273,8 @@ class HollowCluster:
                 if attr in ("namespaces", "priority_classes"):
                     cur.clear()
                     cur.update(new)
-                elif attr in ("quotas", "pdbs"):
-                    cur[:] = new
+                elif attr in ("quotas", "pdbs", "limit_ranges"):
+                    cur[:] = new  # captured-at-construction containers
                 else:
                     setattr(self, attr, new)
             # rebuild the per-node agents (live objects, not state)
@@ -2027,6 +2034,11 @@ class HollowCluster:
         self.quotas.append(quota)
         self.quota_controller.reconcile()
 
+    def add_limit_range(self, lr) -> None:
+        """Install a LimitRange; the admission chain's LimitRanger reads
+        the live container (defaults/bounds apply to the NEXT create)."""
+        self.limit_ranges.append(lr)
+
     def reconcile_namespaces(self) -> None:
         """The namespace controller's deletion pass: drain EVERY
         namespaced resource (pods, services+endpoints, events, leases,
@@ -2065,11 +2077,26 @@ class HollowCluster:
 
     def add_service(self, svc) -> None:
         """Create a Service; the hub assigns the ClusterIP like the
-        apiserver's service-ip allocator (pkg/registry/core/service)."""
+        apiserver's service-ip allocator (pkg/registry/core/service),
+        and NodePort/LoadBalancer services get node ports from the
+        port allocator for every port that didn't pick its own."""
+        import dataclasses
+
         if not svc.cluster_ip:
             svc.cluster_ip = self.ip_alloc.allocate()
         else:
             self.ip_alloc.reserve(svc.cluster_ip)
+        if getattr(svc, "type", "ClusterIP") in ("NodePort",
+                                                 "LoadBalancer"):
+            ports = []
+            for p in svc.ports:
+                if p.node_port:
+                    self.nodeport_alloc.reserve(p.node_port)
+                    ports.append(p)
+                else:
+                    ports.append(dataclasses.replace(
+                        p, node_port=self.nodeport_alloc.allocate()))
+            svc.ports = tuple(ports)
         self.services[svc.key()] = svc
         self._commit(f"services/{svc.key()}", "ADDED", svc)
 
@@ -2078,6 +2105,9 @@ class HollowCluster:
         if svc is not None:
             if svc.cluster_ip:
                 self.ip_alloc.release(svc.cluster_ip)
+            for p in svc.ports:
+                if p.node_port:
+                    self.nodeport_alloc.release(p.node_port)
             self._commit(f"services/{key}", "DELETED", None)
 
     def put_endpoints(self, ep) -> None:

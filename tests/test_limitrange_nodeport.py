@@ -1,0 +1,83 @@
+"""LimitRanger admission (plugin/pkg/admission/limitranger) and the
+node-port allocator (pkg/registry/core/service/portallocator): defaults
+applied BEFORE quota charges them; min/max bounds reject; NodePort/LB
+services allocate unique in-range node ports, released on delete."""
+
+import pytest
+
+from kubernetes_tpu.admission import AdmissionError, LimitRange, ResourceQuota
+from kubernetes_tpu.proxy import NodePortAllocator, Service, ServicePort
+from kubernetes_tpu.sim import HollowCluster
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _hub():
+    hub = HollowCluster(seed=97, admission=True,
+                        scheduler_kw={"enable_preemption": False})
+    hub.add_node(make_node("n0", cpu_milli=8000))
+    return hub
+
+
+def test_limitrange_defaults_requestless_pods():
+    hub = _hub()
+    hub.add_limit_range(LimitRange(default_cpu_milli=250,
+                                   default_memory=512 * 2**20))
+    hub.create_pod(make_pod("bare"))  # declares nothing
+    p = hub.truth_pods["default/bare"]
+    assert p.requests.cpu_milli == 250
+    assert p.requests.memory == 512 * 2**20
+    # a pod that declares its own requests keeps them
+    hub.create_pod(make_pod("sized", cpu_milli=100, memory=2**20))
+    assert hub.truth_pods["default/sized"].requests.cpu_milli == 100
+
+
+def test_limitrange_bounds_reject():
+    hub = _hub()
+    hub.add_limit_range(LimitRange(min_cpu_milli=50, max_cpu_milli=1000))
+    with pytest.raises(AdmissionError):
+        hub.create_pod(make_pod("tiny", cpu_milli=10))
+    with pytest.raises(AdmissionError):
+        hub.create_pod(make_pod("huge", cpu_milli=4000))
+    hub.create_pod(make_pod("ok", cpu_milli=500))  # in bounds
+
+
+def test_limitrange_defaults_are_what_quota_charges():
+    """The reference's plugin ORDER (LimitRanger before ResourceQuota):
+    a request-less pod must charge its DEFAULTED request, or quota
+    enforcement is fiction for defaulted pods."""
+    hub = _hub()
+    hub.add_limit_range(LimitRange(default_cpu_milli=600))
+    hub.add_quota(ResourceQuota("q", namespace="default", hard_cpu_milli=1000))
+    hub.create_pod(make_pod("a"))       # charges 600 defaulted
+    with pytest.raises(AdmissionError):
+        hub.create_pod(make_pod("b"))   # 600 more would exceed 1000
+
+
+def test_nodeport_allocation_and_release():
+    hub = HollowCluster(seed=98, scheduler_kw={"enable_preemption": False})
+    hub.add_service(Service("a", selector={"x": "1"}, type="NodePort",
+                            ports=(ServicePort(port=80),
+                                   ServicePort(port=443))))
+    ports = [p.node_port for p in hub.services["default/a"].ports]
+    assert all(30000 <= p <= 32767 for p in ports)
+    assert len(set(ports)) == 2
+    # explicit nodePort reserved; ClusterIP services get none
+    hub.add_service(Service("b", selector={"x": "2"}, type="NodePort",
+                            ports=(ServicePort(port=80,
+                                               node_port=30100),)))
+    hub.add_service(Service("c", selector={"x": "3"},
+                            ports=(ServicePort(port=80),)))
+    assert hub.services["default/b"].ports[0].node_port == 30100
+    assert hub.services["default/c"].ports[0].node_port == 0
+    # release on delete: the freed port is reallocatable
+    hub.delete_service("default/a")
+    hub.add_service(Service("d", selector={"x": "4"}, type="NodePort",
+                            ports=(ServicePort(port=80),)))
+    assert hub.services["default/d"].ports[0].node_port == min(ports)
+
+
+def test_nodeport_exhaustion_is_loud():
+    alloc = NodePortAllocator(lo=31000, hi=31002)
+    assert [alloc.allocate() for _ in range(3)] == [31000, 31001, 31002]
+    with pytest.raises(RuntimeError):
+        alloc.allocate()
