@@ -286,14 +286,16 @@ class ServiceProxy:
 # ---------------------------------------------------------------------------
 
 
-class NodePortAllocator:
-    """Sequential allocator over the service node-port range
-    (``pkg/registry/core/service/portallocator``; default 30000-32767):
-    unique ports, explicit reservations honored, release on delete,
-    exhaustion error."""
+class _RangeAllocator:
+    """The one sequential integer-range allocator both service
+    allocators ride (the reference's shared
+    ``pkg/registry/core/service/allocator`` bitmap): unique values,
+    wrap-scan allocate, conflict-checked reservation, release with
+    revisit, exhaustion error."""
 
-    def __init__(self, lo: int = 30000, hi: int = 32767) -> None:
+    def __init__(self, lo: int, hi: int, what: str) -> None:
         self.lo, self.hi = lo, hi
+        self.what = what
         self._used: set = set()
         self._next = lo
 
@@ -305,51 +307,66 @@ class NodePortAllocator:
                 self._next = n + 1
                 return n
             n = n + 1 if n < self.hi else self.lo
-        raise RuntimeError("node-port range exhausted")
+        raise RuntimeError(f"{self.what} exhausted")
 
-    def reserve(self, port: int) -> None:
-        """An explicit spec.ports[].nodePort outside-range or duplicate
-        reservation is the caller's validation problem (the apiserver
-        422s it); in-range ones claim the bitmap slot."""
-        if self.lo <= port <= self.hi:
-            self._used.add(port)
+    def reserve(self, n: int) -> None:
+        """Claim a caller-chosen value; a DUPLICATE claim raises — the
+        apiserver 422s 'provided port is already allocated' instead of
+        silently sharing (silent sharing also corrupts release: the
+        first delete would free the slot under the survivor)."""
+        if not (self.lo <= n <= self.hi):
+            return
+        if n in self._used:
+            raise ValueError(f"provided {self.what} {n} is already "
+                             "allocated")
+        self._used.add(n)
 
-    def release(self, port: int) -> None:
-        self._used.discard(port)
-        if self.lo <= port <= self.hi:
-            self._next = min(self._next, port)
+    def release(self, n: int) -> None:
+        self._used.discard(n)
+        if self.lo <= n <= self.hi:
+            self._next = min(self._next, n)  # released slots revisited
+
+
+class NodePortAllocator(_RangeAllocator):
+    """Service node-port range
+    (``pkg/registry/core/service/portallocator``; default 30000-32767)."""
+
+    def __init__(self, lo: int = 30000, hi: int = 32767) -> None:
+        super().__init__(lo, hi, "node-port range")
 
 
 class ClusterIPAllocator:
     """Sequential allocator over a /16 service CIDR — the slice of
     ``pkg/registry/core/service/ipallocator`` the hub needs: unique IPs,
-    release on delete, exhaustion error."""
+    release on delete, exhaustion error. Rides :class:`_RangeAllocator`
+    with the IP-string encoding on top."""
 
     def __init__(self, prefix: str = "10.96") -> None:
         self.prefix = prefix
-        self._used: set = set()
-        self._next = 1
+        self._core = _RangeAllocator(1, 65534, "service CIDR")
+
+    def _decode(self, ip: str) -> Optional[int]:
+        parts = ip.split(".")
+        if len(parts) == 4 and f"{parts[0]}.{parts[1]}" == self.prefix:
+            return (int(parts[2]) << 8) | int(parts[3])
+        return None
 
     def allocate(self) -> str:
-        n = self._next if 1 <= self._next <= 65534 else 1
-        for _ in range(65534):
-            if n not in self._used:
-                self._used.add(n)
-                self._next = n + 1
-                return f"{self.prefix}.{n >> 8}.{n & 0xFF}"
-            n = n % 65534 + 1
-        raise RuntimeError("service CIDR exhausted")
+        n = self._core.allocate()
+        return f"{self.prefix}.{n >> 8}.{n & 0xFF}"
 
     def reserve(self, ip: str) -> None:
-        """Mark a caller-chosen VIP used (the apiserver honors an explicit
-        spec.clusterIP by reserving it in the allocator bitmap)."""
-        parts = ip.split(".")
-        if len(parts) == 4 and f"{parts[0]}.{parts[1]}" == self.prefix:
-            self._used.add((int(parts[2]) << 8) | int(parts[3]))
+        """Mark a caller-chosen VIP used (the apiserver honors an
+        explicit spec.clusterIP by reserving it in the allocator
+        bitmap). Unlike node ports, a repeat reservation of the SAME
+        VIP is tolerated here: checkpoint restore and same-IP
+        re-creates re-reserve legitimately (the reference repairs the
+        bitmap from stored services on startup)."""
+        n = self._decode(ip)
+        if n is not None:
+            self._core._used.add(n)
 
     def release(self, ip: str) -> None:
-        parts = ip.split(".")
-        if len(parts) == 4 and f"{parts[0]}.{parts[1]}" == self.prefix:
-            n = (int(parts[2]) << 8) | int(parts[3])
-            self._used.discard(n)
-            self._next = min(self._next, n)  # released IPs are revisited
+        n = self._decode(ip)
+        if n is not None:
+            self._core.release(n)

@@ -2082,21 +2082,24 @@ class HollowCluster:
         port allocator for every port that didn't pick its own."""
         import dataclasses
 
+        wants_node_ports = getattr(svc, "type", "ClusterIP") in (
+            "NodePort", "LoadBalancer")
+        if wants_node_ports:
+            # validate explicit picks FIRST (a duplicate raises the
+            # apiserver's 'already allocated' 422 analog) so a rejected
+            # create leaks neither a ClusterIP nor earlier ports
+            for p in svc.ports:
+                if p.node_port:
+                    self.nodeport_alloc.reserve(p.node_port)
         if not svc.cluster_ip:
             svc.cluster_ip = self.ip_alloc.allocate()
         else:
             self.ip_alloc.reserve(svc.cluster_ip)
-        if getattr(svc, "type", "ClusterIP") in ("NodePort",
-                                                 "LoadBalancer"):
-            ports = []
-            for p in svc.ports:
-                if p.node_port:
-                    self.nodeport_alloc.reserve(p.node_port)
-                    ports.append(p)
-                else:
-                    ports.append(dataclasses.replace(
-                        p, node_port=self.nodeport_alloc.allocate()))
-            svc.ports = tuple(ports)
+        if wants_node_ports:
+            svc.ports = tuple(
+                p if p.node_port else dataclasses.replace(
+                    p, node_port=self.nodeport_alloc.allocate())
+                for p in svc.ports)
         self.services[svc.key()] = svc
         self._commit(f"services/{svc.key()}", "ADDED", svc)
 

@@ -81,3 +81,25 @@ def test_nodeport_exhaustion_is_loud():
     assert [alloc.allocate() for _ in range(3)] == [31000, 31001, 31002]
     with pytest.raises(RuntimeError):
         alloc.allocate()
+
+
+def test_duplicate_explicit_nodeport_rejected():
+    """Review r5: an explicit nodePort already held by another service
+    must be REJECTED (the apiserver's 'provided port is already
+    allocated' 422) — silent sharing would also corrupt release (the
+    first delete frees the slot under the survivor)."""
+    hub = HollowCluster(seed=99, scheduler_kw={"enable_preemption": False})
+    hub.add_service(Service("a", selector={"x": "1"}, type="NodePort",
+                            ports=(ServicePort(port=80,
+                                               node_port=30500),)))
+    with pytest.raises(ValueError):
+        hub.add_service(Service("b", selector={"x": "2"}, type="NodePort",
+                                ports=(ServicePort(port=80,
+                                                   node_port=30500),)))
+    # the rejected create leaked nothing: 'b' absent, port still a's
+    assert "default/b" not in hub.services
+    hub.delete_service("default/a")
+    hub.add_service(Service("c", selector={"x": "3"}, type="NodePort",
+                            ports=(ServicePort(port=80,
+                                               node_port=30500),)))
+    assert hub.services["default/c"].ports[0].node_port == 30500
