@@ -211,3 +211,77 @@ def _dataclass_to_doc(obj) -> dict:
             v = _dataclass_to_doc(v)
         out[f.name] = v
     return out
+
+
+class Unstructured:
+    """apimachinery's unstructured.Unstructured analog
+    (apimachinery/pkg/apis/meta/v1/unstructured/unstructured.go:41): a
+    dict-backed object for kinds no typed codec is registered for —
+    what dynamic clients and the GC's partial-metadata reads decode
+    into. The document IS the object; accessors read the well-known
+    metadata paths without requiring them."""
+
+    def __init__(self, doc: dict) -> None:
+        if not isinstance(doc, dict):
+            raise SchemeError(["unstructured: expected a mapping"])
+        self.doc = dict(doc)
+
+    @property
+    def api_version(self) -> str:
+        return self.doc.get("apiVersion", "")
+
+    @property
+    def kind(self) -> str:
+        return self.doc.get("kind", "")
+
+    @property
+    def name(self) -> str:
+        return (self.doc.get("metadata") or {}).get("name", "")
+
+    @property
+    def namespace(self) -> str:
+        return (self.doc.get("metadata") or {}).get("namespace", "")
+
+    @property
+    def labels(self) -> dict:
+        return dict((self.doc.get("metadata") or {}).get("labels") or {})
+
+    def get(self, *path, default=None):
+        """NestedFieldNoCopy (unstructured helpers): walk a field path,
+        None-safe — ``u.get("spec", "replicas")``."""
+        cur = self.doc
+        for p in path:
+            if not isinstance(cur, dict) or p not in cur:
+                return default
+            cur = cur[p]
+        return cur
+
+    def to_doc(self) -> dict:
+        return dict(self.doc)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Unstructured) and self.doc == other.doc
+
+    def __repr__(self) -> str:
+        return f"Unstructured({self.api_version}/{self.kind} {self.name})"
+
+
+def decode_unstructured(scheme: Scheme, doc: dict):
+    """UnstructuredJSONScheme's decode split (the dynamic client's
+    codec): a registered (apiVersion, kind) routes through the TYPED
+    strict pipeline (built + defaulted at its versioned type — the
+    caller converts onward when it wants an internal form); anything
+    else becomes :class:`Unstructured`. apiVersion/kind are still
+    required — the reference's unstructured decoder rejects kind-less
+    documents too."""
+    if not isinstance(doc, dict):
+        raise SchemeError(["document: expected a mapping"])
+    api_version = doc.get("apiVersion", "")
+    kind = doc.get("kind", "")
+    if not api_version or not kind:
+        raise SchemeError(["apiVersion and kind are required"])
+    if not scheme.recognizes(api_version, kind):
+        return Unstructured(doc)
+    body = {k: v for k, v in doc.items()
+            if k not in ("apiVersion", "kind")}
+    return scheme.default(scheme.build(api_version, kind, body))

@@ -291,3 +291,40 @@ def test_union_annotations_unwrap_for_strict_build():
         assert isinstance(built.x, _UnwrapInner) and built.x.a == 3
         with pytest.raises(SchemeError, match="unknown field"):
             _build_dataclass(outer, {"x": {"bogus": 1}}, "spec")
+
+
+def test_unstructured_decode_split():
+    """decode_unstructured (unstructured.go:41 + the dynamic client's
+    UnstructuredJSONScheme): registered kinds go typed+strict, unknown
+    kinds become dict-backed Unstructured with None-safe path access;
+    kind-less documents are rejected either way."""
+    import pytest
+
+    from kubernetes_tpu.api.core_v1 import new_scheme
+    from kubernetes_tpu.api.scheme import (
+        SchemeError,
+        Unstructured,
+        decode_unstructured,
+    )
+
+    scheme = new_scheme()
+    # unknown kind -> Unstructured, document preserved verbatim
+    doc = {"apiVersion": "stable.example.com/v1", "kind": "CronTab",
+           "metadata": {"name": "my-tab", "namespace": "team-a",
+                        "labels": {"app": "x"}},
+           "spec": {"cronSpec": "* * * * */5", "replicas": 3}}
+    u = decode_unstructured(scheme, doc)
+    assert isinstance(u, Unstructured)
+    assert (u.kind, u.name, u.namespace) == ("CronTab", "my-tab", "team-a")
+    assert u.labels == {"app": "x"}
+    assert u.get("spec", "replicas") == 3
+    assert u.get("spec", "missing", "deep") is None
+    assert u.to_doc() == doc
+    # registered kind -> the TYPED strict pipeline (unknown field errors)
+    with pytest.raises(SchemeError):
+        decode_unstructured(scheme, {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p"}, "bogusField": 1})
+    # kind-less rejected
+    with pytest.raises(SchemeError):
+        decode_unstructured(scheme, {"metadata": {"name": "x"}})
