@@ -114,13 +114,7 @@ class Scheme:
     def decode(self, doc: dict, internal_type: Type):
         """The full decode pipeline: recognize -> build versioned (strict)
         -> default -> convert to ``internal_type``."""
-        if not isinstance(doc, dict):
-            raise SchemeError(["document: expected a mapping"])
-        api_version = doc.get("apiVersion", "")
-        kind = doc.get("kind", "")
-        if not api_version or not kind:
-            raise SchemeError(["apiVersion and kind are required"])
-        body = {k: v for k, v in doc.items() if k not in ("apiVersion", "kind")}
+        api_version, kind, body = _split_doc(doc)
         versioned = self.build(api_version, kind, body)
         versioned = self.default(versioned)
         return self.convert(versioned, internal_type)
@@ -213,6 +207,22 @@ def _dataclass_to_doc(obj) -> dict:
     return out
 
 
+def _split_doc(doc: dict):
+    """(apiVersion, kind, body) of a wire document — the one recognize+
+    strip both codecs (typed Scheme.decode and decode_unstructured)
+    validate through, so the dynamic and typed paths can never drift on
+    what counts as a decodable document."""
+    if not isinstance(doc, dict):
+        raise SchemeError(["document: expected a mapping"])
+    api_version = doc.get("apiVersion", "")
+    kind = doc.get("kind", "")
+    if not api_version or not kind:
+        raise SchemeError(["apiVersion and kind are required"])
+    body = {k: v for k, v in doc.items()
+            if k not in ("apiVersion", "kind")}
+    return api_version, kind, body
+
+
 class Unstructured:
     """apimachinery's unstructured.Unstructured analog
     (apimachinery/pkg/apis/meta/v1/unstructured/unstructured.go:41): a
@@ -274,14 +284,7 @@ def decode_unstructured(scheme: Scheme, doc: dict):
     else becomes :class:`Unstructured`. apiVersion/kind are still
     required — the reference's unstructured decoder rejects kind-less
     documents too."""
-    if not isinstance(doc, dict):
-        raise SchemeError(["document: expected a mapping"])
-    api_version = doc.get("apiVersion", "")
-    kind = doc.get("kind", "")
-    if not api_version or not kind:
-        raise SchemeError(["apiVersion and kind are required"])
+    api_version, kind, body = _split_doc(doc)
     if not scheme.recognizes(api_version, kind):
         return Unstructured(doc)
-    body = {k: v for k, v in doc.items()
-            if k not in ("apiVersion", "kind")}
     return scheme.default(scheme.build(api_version, kind, body))
