@@ -43,6 +43,7 @@ __all__ = [
     "match_fields",
     "pod_fields",
     "node_fields",
+    "event_fields",
 ]
 
 
@@ -229,16 +230,41 @@ def node_fields(node) -> Dict[str, str]:
     }
 
 
+def event_fields(key: str, ev) -> Dict[str, str]:
+    """The v1 Event selectable fields kubectl's --field-selector rides
+    (registry/core/event/strategy.go GetAttrs ToSelectableFields):
+    involvedObject identity + reason + type. ``key`` is the event's
+    store key ("ns/name.series")."""
+    ns, _, name = key.partition("/")
+    obj_ns, _, obj_name = ev.object_key.partition("/")
+    return {
+        "metadata.name": name,
+        "metadata.namespace": ns,
+        "involvedObject.name": obj_name,
+        "involvedObject.namespace": obj_ns,
+        "reason": ev.reason,
+        "type": ev.type,
+    }
+
+
 def validate_field_keys(reqs: Sequence[Requirement], kind: str) -> None:
     """Reject unsupported field labels at REQUEST/CONSTRUCTION time, not
-    per object (ListOptions decoding semantics). ``kind``: "pods" or
-    "nodes". The one shared probe for every field-selector consumer
-    (REST list/watch, Reflector) — the selectable surface lives only in
-    pod_fields/node_fields."""
+    per object (ListOptions decoding semantics). ``kind``: "pods",
+    "nodes", or "events". The one shared probe for every field-selector
+    consumer (REST list/watch, Reflector) — the selectable surface
+    lives only in pod_fields/node_fields/event_fields."""
     if not reqs:
         return
     from kubernetes_tpu.api.types import Node, Pod
 
-    probe = (pod_fields(Pod(name="probe")) if kind == "pods"
-             else node_fields(Node(name="probe")))
+    if kind == "events":
+        from kubernetes_tpu.events import Event
+
+        probe = event_fields("probe/probe.x", Event(
+            type="Normal", reason="", object_key="probe/probe",
+            message=""))
+    elif kind == "pods":
+        probe = pod_fields(Pod(name="probe"))
+    else:
+        probe = node_fields(Node(name="probe"))
     match_fields(reqs, probe)

@@ -350,6 +350,10 @@ def cmd_get_events(rest: RestClient, args) -> int:
     last, kubectl's column shape; -A/--all-namespaces widens the scope."""
     path = ("/api/v1/events" if args.all_namespaces
             else f"/api/v1/namespaces/{args.namespace}/events")
+    if getattr(args, "field_selector", ""):
+        from urllib.parse import quote
+
+        path += f"?fieldSelector={quote(args.field_selector)}"
     code, doc = rest.call("GET", path)
     if code != 200:
         return _rest_fail(doc)
@@ -711,6 +715,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     g.add_argument("kind")
     g.add_argument("-n", "--namespace", default="default")
     g.add_argument("-A", "--all-namespaces", action="store_true")
+    g.add_argument("--field-selector", default="",
+                   help="server-side field filter (events: reason=..., "
+                        "involvedObject.name=..., type=...)")
     t = sub.add_parser("top")
     t.add_argument("kind", choices=["nodes"])
     d = sub.add_parser("describe")

@@ -923,12 +923,32 @@ class RestServer:
                 "items": items,
             })
         if seg == ["events"]:
+            from kubernetes_tpu.api.selectors import event_fields
+
+            # field selectors (reason=..., involvedObject.name=... — the
+            # kubectl --field-selector workflow); events carry no labels
+            # so a labelSelector matches only when empty. Ordering stays
+            # lastTimestamp (kubectl's newest-last), so the paginated
+            # _serve_list pipeline (key-ordered) deliberately does not
+            # serve this kind.
+            try:
+                fsel = parse_field_selector(
+                    (parse_qs(url.query).get("fieldSelector") or [""])[0])
+                validate_field_keys(fsel, "events")
+                lsel = parse_label_selector(
+                    (parse_qs(url.query).get("labelSelector") or [""])[0])
+            except SelectorError as e:
+                return h._fail(400, "BadRequest", str(e))
             items = []
             for key, ev in sorted(
                     getattr(hub, "events_v1", {}).items(),
                     key=lambda kv: kv[1].last_timestamp):
                 ev_ns, name = key.split("/", 1)
                 if ns is not None and ev_ns != ns:
+                    continue
+                if fsel and not match_fields(fsel, event_fields(key, ev)):
+                    continue
+                if lsel and not match_labels(lsel, {}):
                     continue
                 items.append(_with_rv({
                     "metadata": {"name": name, "namespace": ev_ns},

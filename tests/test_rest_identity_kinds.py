@@ -121,3 +121,40 @@ def test_ktpu_get_identity_kinds(capsys):
         assert "kube-system" in out and "default" in out
     finally:
         srv.close()
+
+
+def test_event_field_selectors(capsys):
+    """Server-side event field selectors (event/strategy.go
+    ToSelectableFields): reason=, involvedObject.name=, type= filter at
+    the hub before serialization; unsupported keys are 400; ktpu get
+    events --field-selector rides the same query."""
+    from kubernetes_tpu.kubectl import main as ktpu
+    from kubernetes_tpu.testing import make_node, make_pod
+
+    hub = HollowCluster(seed=64, scheduler_kw={"enable_preemption": False})
+    hub.record_controller_event("CSRApproved", "default/csr-a", "ok")
+    hub.record_controller_event("FailedToCreateRoute", "default/n0",
+                                "quota", type_="Warning")
+    hub.record_controller_event("FailedToCreateRoute", "default/n1",
+                                "quota", type_="Warning")
+    srv, port = start(hub)
+    try:
+        code, doc = req(
+            port, "GET",
+            "/api/v1/events?fieldSelector=reason%3DFailedToCreateRoute")
+        assert code == 200 and len(doc["items"]) == 2
+        code, doc = req(
+            port, "GET",
+            "/api/v1/events?fieldSelector=type%3DWarning,"
+            "involvedObject.name%3Dn0")
+        assert code == 200 and len(doc["items"]) == 1
+        assert doc["items"][0]["involvedObject"]["name"] == "n0"
+        code, doc = req(
+            port, "GET", "/api/v1/events?fieldSelector=bogus%3Dx")
+        assert code == 400
+        rc = ktpu(["--api-server", f"127.0.0.1:{port}", "get", "events",
+                   "-A", "--field-selector", "reason=CSRApproved"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "csr-a" in out and "n0" not in out
+    finally:
+        srv.close()
