@@ -127,9 +127,9 @@ RESOURCES = (
     ("nodes", "Node", False,
      ("create", "delete", "get", "list", "patch", "update", "watch")),
     ("namespaces", "Namespace", False, ("create", "delete", "get", "list")),
-    ("services", "Service", True, ("list",)),
-    ("endpoints", "Endpoints", True, ("list",)),
-    ("events", "Event", True, ("list",)),
+    ("services", "Service", True, ("list", "watch")),
+    ("endpoints", "Endpoints", True, ("list", "watch")),
+    ("events", "Event", True, ("list", "watch")),
     ("serviceaccounts", "ServiceAccount", True, ("list",)),
     ("configmaps", "ConfigMap", True, ("get", "list")),
 )
@@ -352,6 +352,73 @@ def apps_scale_doc(hub, d) -> dict:
         "status": {"replicas": sum(len(rs.live) for rs in owned),
                    "selector": f"app={d.name}"},
     }
+
+
+def svc_to_doc(hub, key: str, svc) -> dict:
+    """v1.Service wire doc — one builder for lists AND watch frames."""
+    s_ns, name = key.split("/", 1)
+    return _with_rv({
+        "metadata": {"name": name, "namespace": s_ns},
+        "spec": {
+            "selector": dict(svc.selector),
+            "clusterIP": svc.cluster_ip,
+            "ports": [
+                # v1 defaulting: targetPort falls back to port
+                # (the apiserver's service defaulting)
+                {"port": p.port,
+                 "targetPort": p.target_port or p.port,
+                 "protocol": p.protocol,
+                 **({"nodePort": p.node_port} if p.node_port else {})}
+                for p in svc.ports
+            ],
+            "sessionAffinity": svc.session_affinity,
+            "type": getattr(svc, "type", "ClusterIP"),
+        },
+        **({"status": {"loadBalancer": {"ingress": [
+            {"ip": svc.load_balancer_ingress}]}}}
+           if getattr(svc, "load_balancer_ingress", "") else {}),
+    }, hub, f"services/{key}")
+
+
+def _ep_target_ref(a) -> dict:
+    a_ns, a_name = a.pod_key.split("/", 1)
+    return {"kind": "Pod", "name": a_name, "namespace": a_ns}
+
+
+def ep_to_doc(hub, key: str, ep) -> dict:
+    """v1.Endpoints wire doc — one builder for lists AND watch frames."""
+    e_ns, name = key.split("/", 1)
+    return _with_rv({
+        "metadata": {"name": name, "namespace": e_ns},
+        "subsets": [{
+            "addresses": [
+                {"nodeName": a.node_name, "targetRef": _ep_target_ref(a)}
+                for a in ep.ready
+            ],
+            "notReadyAddresses": [
+                {"targetRef": _ep_target_ref(a)} for a in ep.not_ready
+            ],
+        }],
+    }, hub, f"endpoints/{key}")
+
+
+def event_to_doc(hub, key: str, ev) -> dict:
+    """v1.Event wire doc — one builder for lists AND watch frames."""
+    ev_ns, name = key.split("/", 1)
+    return _with_rv({
+        "metadata": {"name": name, "namespace": ev_ns},
+        "involvedObject": {
+            "kind": "Pod",
+            "namespace": ev.object_key.split("/", 1)[0],
+            "name": ev.object_key.split("/", 1)[1],
+        },
+        "type": ev.type,
+        "reason": ev.reason,
+        "message": ev.message,
+        "count": ev.count,
+        "firstTimestamp": ev.first_timestamp,
+        "lastTimestamp": ev.last_timestamp,
+    }, hub, f"events/{key}")
 
 
 def status_doc(code: int, reason: str, message: str) -> dict:
@@ -860,63 +927,18 @@ class RestServer:
         if seg[0] == "namespaces" and len(seg) >= 3:
             ns, seg = seg[1], seg[2:]
         if seg == ["services"]:
-            items = []
-            for key, svc in sorted(hub.services.items()):
-                s_ns, name = key.split("/", 1)
-                if ns is not None and s_ns != ns:
-                    continue
-                items.append(_with_rv({
-                    "metadata": {"name": name, "namespace": s_ns},
-                    "spec": {
-                        "selector": dict(svc.selector),
-                        "clusterIP": svc.cluster_ip,
-                        "ports": [
-                            # v1 defaulting: targetPort falls back to port
-                            # (the apiserver's service defaulting)
-                            {"port": p.port,
-                             "targetPort": p.target_port or p.port,
-                             "protocol": p.protocol,
-                             **({"nodePort": p.node_port}
-                                if p.node_port else {})}
-                            for p in svc.ports
-                        ],
-                        "sessionAffinity": svc.session_affinity,
-                        "type": getattr(svc, "type", "ClusterIP"),
-                    },
-                    **({"status": {"loadBalancer": {"ingress": [
-                        {"ip": svc.load_balancer_ingress}]}}}
-                       if getattr(svc, "load_balancer_ingress", "")
-                       else {}),
-                }, hub, f"services/{key}"))
+            items = [svc_to_doc(hub, key, svc)
+                     for key, svc in sorted(hub.services.items())
+                     if ns is None or key.split("/", 1)[0] == ns]
             return h._respond(200, {
                 "kind": "ServiceList", "apiVersion": "v1",
                 "metadata": {"resourceVersion": str(hub._revision)},
                 "items": items,
             })
         if seg == ["endpoints"]:
-            def target_ref(a):
-                a_ns, a_name = a.pod_key.split("/", 1)
-                return {"kind": "Pod", "name": a_name, "namespace": a_ns}
-
-            items = []
-            for key, ep in sorted(hub.endpoints.items()):
-                e_ns, name = key.split("/", 1)
-                if ns is not None and e_ns != ns:
-                    continue
-                items.append(_with_rv({
-                    "metadata": {"name": name, "namespace": e_ns},
-                    "subsets": [{
-                        "addresses": [
-                            {"nodeName": a.node_name,
-                             "targetRef": target_ref(a)}
-                            for a in ep.ready
-                        ],
-                        "notReadyAddresses": [
-                            {"targetRef": target_ref(a)}
-                            for a in ep.not_ready
-                        ],
-                    }],
-                }, hub, f"endpoints/{key}"))
+            items = [ep_to_doc(hub, key, ep)
+                     for key, ep in sorted(hub.endpoints.items())
+                     if ns is None or key.split("/", 1)[0] == ns]
             return h._respond(200, {
                 "kind": "EndpointsList", "apiVersion": "v1",
                 "metadata": {"resourceVersion": str(hub._revision)},
@@ -950,20 +972,7 @@ class RestServer:
                     continue
                 if lsel and not match_labels(lsel, {}):
                     continue
-                items.append(_with_rv({
-                    "metadata": {"name": name, "namespace": ev_ns},
-                    "involvedObject": {
-                        "kind": "Pod",
-                        "namespace": ev.object_key.split("/", 1)[0],
-                        "name": ev.object_key.split("/", 1)[1],
-                    },
-                    "type": ev.type,
-                    "reason": ev.reason,
-                    "message": ev.message,
-                    "count": ev.count,
-                    "firstTimestamp": ev.first_timestamp,
-                    "lastTimestamp": ev.last_timestamp,
-                }, hub, f"events/{key}"))
+                items.append(event_to_doc(hub, key, ev))
             return h._respond(200, {
                 "kind": "EventList", "apiVersion": "v1",
                 "metadata": {"resourceVersion": str(hub._revision)},
@@ -1347,9 +1356,11 @@ class RestServer:
         stateless poll-watch cannot, so such frames may be sent — an
         informer cache ignores deletes of unknown keys, so the contract
         holds."""
-        if seg not in (["pods"], ["nodes"]):
+        if seg not in (["pods"], ["nodes"], ["services"], ["endpoints"],
+                       ["events"]):
             return h._fail(404, "NotFound", "/".join(seg))
         kind = seg[0]
+        selectable = kind in ("pods", "nodes")
         try:
             rv = int((query.get("resourceVersion") or ["0"])[0])
         except ValueError:
@@ -1360,11 +1371,27 @@ class RestServer:
                 (query.get("labelSelector") or [""])[0])
             fsel = parse_field_selector(
                 (query.get("fieldSelector") or [""])[0])
-            validate_field_keys(fsel, kind)
+            if selectable:
+                validate_field_keys(fsel, kind)
+            elif kind == "events":
+                validate_field_keys(fsel, "events")
+                if lsel:
+                    return h._fail(
+                        400, "BadRequest",
+                        "events carry no labels; labelSelector is not "
+                        "supported on the events watch")
+            elif lsel or fsel:
+                return h._fail(
+                    400, "BadRequest",
+                    f"selectors are not supported on the {kind} watch")
         except SelectorError as e:
             return h._fail(400, "BadRequest", str(e))
 
-        def selects(obj) -> bool:
+        from kubernetes_tpu.api.selectors import event_fields
+
+        def selects(store_key, obj) -> bool:
+            if kind == "events":
+                return match_fields(fsel, event_fields(store_key, obj))
             fields = pod_fields(obj) if kind == "pods" else node_fields(obj)
             return (match_labels(lsel, obj.labels)
                     and match_fields(fsel, fields))
@@ -1377,17 +1404,17 @@ class RestServer:
         for rev, obj_key, etype, obj in events:
             if not obj_key.startswith(kind + "/"):
                 continue
+            rest = obj_key.split("/", 1)[1]
             if (lsel or fsel) and obj is not None:
-                if not selects(obj):
+                if not selects(rest, obj):
                     if etype == "ADDED":
                         continue  # never matched this watcher's scope
                     etype, obj = "DELETED", None  # left the selector
             if obj is None:
-                # pod keys are "pods/ns/name" — a DELETED frame must carry
-                # namespace and name separately or informer caches keyed
-                # on (ns, name) never evict the entry
-                rest = obj_key.split("/", 1)[1]
-                if kind == "pods" and "/" in rest:
+                # namespaced keys are "<kind>/ns/name" — a DELETED frame
+                # must carry namespace and name separately or informer
+                # caches keyed on (ns, name) never evict the entry
+                if kind != "nodes" and "/" in rest:
                     ns, name = rest.split("/", 1)
                     meta = {"name": name, "namespace": ns}
                 else:
@@ -1395,15 +1422,25 @@ class RestServer:
                 meta["resourceVersion"] = str(rev)
                 doc = {"metadata": meta}
             else:
-                doc = pod_to_json(obj) if kind == "pods" else node_to_json(obj)
+                builder = {
+                    "pods": lambda: pod_to_json(obj),
+                    "nodes": lambda: node_to_json(obj),
+                    "services": lambda: svc_to_doc(self.hub, rest, obj),
+                    "endpoints": lambda: ep_to_doc(self.hub, rest, obj),
+                    "events": lambda: event_to_doc(self.hub, rest, obj),
+                }[kind]
+                doc = builder()
                 doc.setdefault("metadata", {})["resourceVersion"] = str(rev)
             lines.append(json.dumps({"type": etype, "object": doc}))
         if (query.get("allowWatchBookmarks") or ["false"])[0] in (
                 "true", "1"):
             mark = events[-1][0] if events else self.hub._revision
+            kind_name = {"pods": "Pod", "nodes": "Node",
+                         "services": "Service", "endpoints": "Endpoints",
+                         "events": "Event"}[kind]
             lines.append(json.dumps({
                 "type": "BOOKMARK",
-                "object": {"kind": "Pod" if kind == "pods" else "Node",
+                "object": {"kind": kind_name,
                            "apiVersion": "v1",
                            "metadata": {"resourceVersion": str(mark)}},
             }))

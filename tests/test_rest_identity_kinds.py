@@ -158,3 +158,62 @@ def test_event_field_selectors(capsys):
         assert rc == 0 and "csr-a" in out and "n0" not in out
     finally:
         srv.close()
+
+
+def test_watch_services_endpoints_events():
+    """The watch surface beyond pods/nodes (the reference watches every
+    kind): service/endpoints/event frames ride the same NDJSON feed
+    with full wire docs; the events watch takes the same field
+    selectors as the list; selector-less kinds reject selectors loudly."""
+    import http.client
+
+    from kubernetes_tpu.proxy import Service, ServicePort
+    from kubernetes_tpu.testing import make_node, make_pod
+
+    hub = HollowCluster(seed=66, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+
+    def watch(path):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", path)
+        r = conn.getresponse()
+        raw = r.read()
+        conn.close()
+        if r.status != 200:
+            import json as _json
+
+            return r.status, _json.loads(raw)
+        import json as _json
+
+        return r.status, [_json.loads(l) for l in raw.splitlines() if l]
+
+    try:
+        rv0 = hub._revision
+        hub.add_node(make_node("n0", cpu_milli=4000))
+        hub.add_service(Service("web", selector={"app": "w"},
+                                ports=(ServicePort(port=80),)))
+        hub.create_pod(make_pod("w1", cpu_milli=100, labels={"app": "w"}))
+        hub.step()
+        hub.settle()
+        code, frames = watch(f"/api/v1/watch/services?resourceVersion={rv0}")
+        assert code == 200 and frames
+        assert frames[0]["object"]["spec"]["clusterIP"].startswith("10.96.")
+        code, frames = watch(
+            f"/api/v1/watch/endpoints?resourceVersion={rv0}")
+        assert code == 200 and frames
+        assert any(f["object"].get("subsets") for f in frames)
+        hub.record_controller_event("CSRApproved", "default/x", "ok")
+        hub.record_controller_event("SuccessfulDelete", "default/y", "bye")
+        code, frames = watch(
+            f"/api/v1/watch/events?resourceVersion={rv0}"
+            "&fieldSelector=reason%3DCSRApproved")
+        assert code == 200
+        reasons = {f["object"]["reason"] for f in frames}
+        assert reasons == {"CSRApproved"}
+        # selector-less kinds reject selectors loudly, never silently
+        code, doc = watch(
+            f"/api/v1/watch/services?resourceVersion={rv0}"
+            "&labelSelector=app%3Dw")
+        assert code == 400
+    finally:
+        srv.close()
