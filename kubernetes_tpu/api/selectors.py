@@ -240,6 +240,7 @@ def event_fields(key: str, ev) -> Dict[str, str]:
     return {
         "metadata.name": name,
         "metadata.namespace": ns,
+        "involvedObject.kind": getattr(ev, "involved_kind", "Pod"),
         "involvedObject.name": obj_name,
         "involvedObject.namespace": obj_ns,
         "reason": ev.reason,

@@ -185,7 +185,8 @@ class CertificateController:
                                  "MODIFIED", csr)
                 self.hub.record_controller_event(
                     "CSRApproved", f"default/{csr.name}",
-                    csr.approval_message)
+                    csr.approval_message,
+                    involved_kind="CertificateSigningRequest")
                 return
             self.denied_ignored_total += 1
 

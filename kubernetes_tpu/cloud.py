@@ -211,7 +211,8 @@ class ServiceLBController:
                 hub._commit(f"services/{key}", "MODIFIED", svc)
                 hub.record_controller_event(
                     "EnsuredLoadBalancer", key,
-                    f"Ensured load balancer at {ingress}")
+                    f"Ensured load balancer at {ingress}",
+                    involved_kind="Service")
         # needsCleanup: balancers whose service is gone or no longer
         # Type=LoadBalancer (the hub's delete_service cannot know about
         # cloud state — this pass owns the teardown)
@@ -276,7 +277,7 @@ class RouteController:
                     hub.record_controller_event(
                         "FailedToCreateRoute", f"default/{name}",
                         f"Could not create route {cidr}: {e}",
-                        type_="Warning")
+                        type_="Warning", involved_kind="Node")
                     continue
             self._set_network_unavailable(name, False)
 

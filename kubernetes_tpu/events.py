@@ -35,11 +35,15 @@ _REASON_TYPE = {
 class Event:
     type: str
     reason: str
-    object_key: str  # namespace/name of the involved pod
+    object_key: str  # namespace/name of the involved object
     message: str
     count: int = 1
     first_timestamp: float = 0.0
     last_timestamp: float = 0.0
+    #: involvedObject.kind — the scheduler's recorder events are about
+    #: Pods; controller-manager events name their own kind (Node for
+    #: routes, Service for balancers, Job for TTL deletes, ...)
+    involved_kind: str = "Pod"
 
 
 class EventRecorder:

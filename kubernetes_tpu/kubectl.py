@@ -761,6 +761,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
 
+    if (args.cmd == "get" and getattr(args, "field_selector", "")
+            and args.kind != "events"):
+        p.error("--field-selector is only supported for 'get events' "
+                "(other kinds read the gRPC snapshot, which is "
+                "unfiltered by design)")
     if args.cmd == "get" and args.kind in ("events", "leases",
                                            "namespaces", "ns",
                                            "deployments", "deploy",

@@ -408,7 +408,7 @@ def event_to_doc(hub, key: str, ev) -> dict:
     return _with_rv({
         "metadata": {"name": name, "namespace": ev_ns},
         "involvedObject": {
-            "kind": "Pod",
+            "kind": getattr(ev, "involved_kind", "Pod"),
             "namespace": ev.object_key.split("/", 1)[0],
             "name": ev.object_key.split("/", 1)[1],
         },
@@ -926,21 +926,40 @@ class RestServer:
         ns = None
         if seg[0] == "namespaces" and len(seg) >= 3:
             ns, seg = seg[1], seg[2:]
-        if seg == ["services"]:
-            items = [svc_to_doc(hub, key, svc)
-                     for key, svc in sorted(hub.services.items())
-                     if ns is None or key.split("/", 1)[0] == ns]
+        if seg in (["services"], ["endpoints"]):
+            # selector semantics mirror the watch side exactly (the
+            # informer list+watch pair must accept identical options):
+            # these kinds carry no labels, so a non-empty labelSelector
+            # selects nothing; fields are metadata-only
+            try:
+                q = parse_qs(url.query)
+                lsel = parse_label_selector(
+                    (q.get("labelSelector") or [""])[0])
+                fsel = parse_field_selector(
+                    (q.get("fieldSelector") or [""])[0])
+                match_fields(fsel, {"metadata.name": "probe",
+                                    "metadata.namespace": "probe"})
+            except SelectorError as e:
+                return h._fail(400, "BadRequest", str(e))
+            registry = (hub.services if seg == ["services"]
+                        else hub.endpoints)
+            to_doc = svc_to_doc if seg == ["services"] else ep_to_doc
+            items = []
+            for key, obj in sorted(registry.items()):
+                k_ns, _, k_name = key.partition("/")
+                if ns is not None and k_ns != ns:
+                    continue
+                if lsel and not match_labels(lsel, {}):
+                    continue
+                if fsel and not match_fields(fsel, {
+                        "metadata.name": k_name,
+                        "metadata.namespace": k_ns}):
+                    continue
+                items.append(to_doc(hub, key, obj))
             return h._respond(200, {
-                "kind": "ServiceList", "apiVersion": "v1",
-                "metadata": {"resourceVersion": str(hub._revision)},
-                "items": items,
-            })
-        if seg == ["endpoints"]:
-            items = [ep_to_doc(hub, key, ep)
-                     for key, ep in sorted(hub.endpoints.items())
-                     if ns is None or key.split("/", 1)[0] == ns]
-            return h._respond(200, {
-                "kind": "EndpointsList", "apiVersion": "v1",
+                "kind": ("ServiceList" if seg == ["services"]
+                         else "EndpointsList"),
+                "apiVersion": "v1",
                 "metadata": {"resourceVersion": str(hub._revision)},
                 "items": items,
             })
@@ -1375,23 +1394,32 @@ class RestServer:
                 validate_field_keys(fsel, kind)
             elif kind == "events":
                 validate_field_keys(fsel, "events")
-                if lsel:
-                    return h._fail(
-                        400, "BadRequest",
-                        "events carry no labels; labelSelector is not "
-                        "supported on the events watch")
-            elif lsel or fsel:
-                return h._fail(
-                    400, "BadRequest",
-                    f"selectors are not supported on the {kind} watch")
+            else:
+                # services/endpoints: metadata-only selectable fields
+                # (strategy ToSelectableFields); unknown keys error at
+                # request time like every other kind
+                match_fields(fsel, {"metadata.name": "probe",
+                                    "metadata.namespace": "probe"})
         except SelectorError as e:
             return h._fail(400, "BadRequest", str(e))
 
         from kubernetes_tpu.api.selectors import event_fields
 
         def selects(store_key, obj) -> bool:
+            # label-less kinds (events/services/endpoints in this model)
+            # match a labelSelector against {} — a non-empty selector
+            # selects nothing, same as the list side and the reference's
+            # semantics for unlabeled objects (never a 400: the standard
+            # informer list+watch pair must accept identical options)
             if kind == "events":
-                return match_fields(fsel, event_fields(store_key, obj))
+                return (match_labels(lsel, {})
+                        and match_fields(fsel, event_fields(store_key, obj)))
+            if kind in ("services", "endpoints"):
+                s_ns, _, s_name = store_key.partition("/")
+                return (match_labels(lsel, {})
+                        and match_fields(fsel, {
+                            "metadata.name": s_name,
+                            "metadata.namespace": s_ns}))
             fields = pod_fields(obj) if kind == "pods" else node_fields(obj)
             return (match_labels(lsel, obj.labels)
                     and match_fields(fsel, fields))

@@ -855,7 +855,8 @@ class HollowCluster:
 
     def record_controller_event(self, reason: str, object_key: str,
                                 message: str,
-                                type_: str = "Normal") -> None:
+                                type_: str = "Normal",
+                                involved_kind: str = "Pod") -> None:
         """Controller-manager event seam (the recorder each reference
         controller carries): aggregate-upsert an Event about any object
         into the hub store — visible via the v1 EventList and
@@ -865,7 +866,7 @@ class HollowCluster:
         now = self.clock.t
         ev = Event(type=type_, reason=reason, object_key=object_key,
                    message=message, first_timestamp=now,
-                   last_timestamp=now)
+                   last_timestamp=now, involved_kind=involved_kind)
         # aggregate with the stored series (one shared key derivation
         # with _store_event — two copies would silently skew)
         prior = self.events_v1.get(self._event_series_key(ev))
@@ -1992,7 +1993,8 @@ class HollowCluster:
             self.record_controller_event(
                 "SuccessfulDelete", f"default/{name}",
                 f"Deleted job {name} past its "
-                f"ttlSecondsAfterFinished={j.ttl_seconds_after_finished:g}")
+                f"ttlSecondsAfterFinished={j.ttl_seconds_after_finished:g}",
+                involved_kind="Job")
 
     def attach_cloud(self, cloud) -> None:
         """Run the cluster under an external cloud provider: the cloud

@@ -129,7 +129,6 @@ def test_event_field_selectors(capsys):
     the hub before serialization; unsupported keys are 400; ktpu get
     events --field-selector rides the same query."""
     from kubernetes_tpu.kubectl import main as ktpu
-    from kubernetes_tpu.testing import make_node, make_pod
 
     hub = HollowCluster(seed=64, scheduler_kw={"enable_preemption": False})
     hub.record_controller_event("CSRApproved", "default/csr-a", "ok")
@@ -210,10 +209,26 @@ def test_watch_services_endpoints_events():
         assert code == 200
         reasons = {f["object"]["reason"] for f in frames}
         assert reasons == {"CSRApproved"}
-        # selector-less kinds reject selectors loudly, never silently
-        code, doc = watch(
+        # label-less kinds: a labelSelector matches nothing (the
+        # reference's semantics for unlabeled objects) — identical on
+        # list and watch, so the informer pair accepts the same options
+        code, frames = watch(
             f"/api/v1/watch/services?resourceVersion={rv0}"
             "&labelSelector=app%3Dw")
+        assert code == 200
+        assert not any(f["type"] == "ADDED" and "spec" in f["object"]
+                       for f in frames)
+        code, doc = req(port, "GET",
+                        "/api/v1/services?labelSelector=app%3Dw")
+        assert code == 200 and doc["items"] == []
+        # metadata field selectors DO select on these kinds
+        code, doc = req(
+            port, "GET",
+            "/api/v1/services?fieldSelector=metadata.name%3Dweb")
+        assert code == 200 and len(doc["items"]) == 1
+        # unknown field keys error at request time
+        code, doc = req(port, "GET",
+                        "/api/v1/services?fieldSelector=spec.bogus%3Dx")
         assert code == 400
     finally:
         srv.close()
