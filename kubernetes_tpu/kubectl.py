@@ -636,6 +636,64 @@ def cmd_rollout_status(rest: RestClient, args) -> int:
     return 1
 
 
+def cmd_describe_apps(rest: RestClient, args) -> int:
+    """kubectl describe deployment/daemonset/statefulset over REST:
+    spec + rollout status, the owned-ReplicaSet breakdown (deployments),
+    and the object's recent events — the operator's one-stop rollout
+    view."""
+    kind_map = {"deployment": "deployments", "deploy": "deployments",
+                "daemonset": "daemonsets", "ds": "daemonsets",
+                "statefulset": "statefulsets", "sts": "statefulsets"}
+    resource = kind_map[args.kind]
+    code, doc = rest.call(
+        "GET", f"/apis/apps/v1/namespaces/default/{resource}/{args.name}")
+    if code != 200:
+        return _rest_fail(doc)
+    print(f"Name:       {args.name}")
+    st = doc.get("status", {})
+    if resource == "deployments":
+        spec = doc["spec"]
+        print(f"Replicas:   {spec.get('replicas', 0)} desired | "
+              f"{st.get('updatedReplicas', 0)} updated | "
+              f"{st.get('readyReplicas', 0)} ready")
+        strategy = spec.get("strategy", "")
+        if isinstance(strategy, dict):  # tolerate both doc shapes
+            strategy = strategy.get("type", "RollingUpdate")
+        if strategy:
+            print(f"Strategy:   {strategy}")
+        code, rss = rest.call(
+            "GET", "/apis/apps/v1/namespaces/default/replicasets")
+        if code == 200:
+            owned = [it for it in rss["items"]
+                     if it["metadata"].get("ownerReferences",
+                                           [{}])[0].get("name")
+                     == args.name]
+            if owned:
+                print("ReplicaSets:")
+                for it in owned:
+                    m, s = it["metadata"], it.get("status", {})
+                    print(f"  {m['name']}: {s.get('replicas', 0)} replicas,"
+                          f" revision {it.get('revision', '?')}")
+    elif resource == "daemonsets":
+        print(f"Desired:    {st.get('desiredNumberScheduled', 0)} | "
+              f"ready {st.get('numberReady', 0)} | "
+              f"updated {st.get('updatedNumberScheduled', 0)} "
+              f"(rev {st.get('observedRevision', '?')})")
+    else:
+        print(f"Replicas:   {st.get('readyReplicas', 0)}/"
+              f"{doc['spec'].get('replicas', 0)} ready | "
+              f"updated {st.get('updatedReplicas', 0)} "
+              f"(rev {st.get('observedRevision', '?')})")
+    code, evs = rest.call(
+        "GET", "/api/v1/events?fieldSelector="
+               f"involvedObject.name%3D{args.name}")
+    if code == 200 and evs["items"]:
+        print("Events:")
+        for it in evs["items"]:
+            print(f"  {it['type']}\t{it['reason']}\t{it['message'][:70]}")
+    return 0
+
+
 def cmd_get_namespaces(rest: RestClient, args) -> int:
     """kubectl get namespaces: lifecycle phases over REST."""
     code, doc = rest.call("GET", "/api/v1/namespaces")
@@ -827,6 +885,23 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"Error: cannot reach API server {args.api_server}: {e}",
                   file=sys.stderr)
             return 1
+
+    if (args.cmd == "describe" and args.kind in (
+            "deployment", "deploy", "daemonset", "ds",
+            "statefulset", "sts")):
+        if not args.api_server:
+            p.error(f"describe {args.kind} requires --api-server")
+        try:
+            rest = RestClient(args.api_server, token=args.token)
+        except ValueError:
+            p.error(f"--api-server must be HOST:PORT, got "
+                    f"{args.api_server!r}")
+        try:
+            return cmd_describe_apps(rest, args)
+        except OSError as e:
+            print(f"Error: cannot reach API server {args.api_server}: {e}",
+                  file=sys.stderr)
+            return 2
 
     if not args.server:
         p.error(f"{args.cmd} requires --server")

@@ -221,3 +221,33 @@ def test_rollback_to_identical_template_is_a_noop():
     before = ds.template_rev
     hub.rollback("DaemonSet", "agent", 1)  # rev-1 template == current
     assert ds.template_rev == before  # no bump, no restart
+
+
+def test_ktpu_describe_apps(capsys):
+    """ktpu describe deployment/ds/sts over REST: rollout state, the
+    RS breakdown, and the object's events (via the involvedObject
+    field selector)."""
+    from kubernetes_tpu.kubectl import main as ktpu
+    from kubernetes_tpu.restapi import RestServer
+    from kubernetes_tpu.sim import Deployment
+
+    hub = _hub()
+    hub.add_deployment(Deployment("web", replicas=3))
+    hub.daemonsets["agent"] = DaemonSet("agent")
+    _settle(hub, 4)
+    hub.record_controller_event("ScalingReplicaSet", "default/web",
+                                "Scaled up replica set web-rs-1 to 3",
+                                involved_kind="Deployment")
+    srv = RestServer(hub, port=0)
+    port = srv.serve()
+    try:
+        api = ["--api-server", f"127.0.0.1:{port}"]
+        assert ktpu(api + ["describe", "deployment", "web"]) == 0
+        out = capsys.readouterr().out
+        assert "3 desired" in out and "ReplicaSets:" in out
+        assert "ScalingReplicaSet" in out  # events via field selector
+        assert ktpu(api + ["describe", "ds", "agent"]) == 0
+        out = capsys.readouterr().out
+        assert "Desired:" in out and "rev 1" in out
+    finally:
+        srv.close()
