@@ -108,6 +108,26 @@ class LeaderElectionConfigurationV1alpha1:
 
 
 @dataclass
+class RobustnessConfigurationV1alpha1:
+    """Versioned spelling of the degradation-ladder knobs
+    (config.RobustnessConfig): camelCase, durations as metav1.Duration
+    strings like every other versioned time field."""
+
+    cycleDeadline: Optional[str] = None
+    solverRetries: Optional[int] = None
+    transportRetries: Optional[int] = None
+    retryBackoffBase: Optional[str] = None
+    retryBackoffMax: Optional[str] = None
+    retryJitter: Optional[float] = None
+    breakerFailureThreshold: Optional[int] = None
+    breakerOpenDuration: Optional[str] = None
+    breakerHalfOpenProbes: Optional[int] = None
+    validateResults: Optional[bool] = None
+    fallbackChain: Optional[list] = None
+    extenderDegradeToIgnorable: Optional[bool] = None
+
+
+@dataclass
 class KubeSchedulerConfigurationV1alpha1:
     schedulerName: Optional[str] = None
     algorithmSource: "SchedulerAlgorithmSource" = field(
@@ -130,6 +150,8 @@ class KubeSchedulerConfigurationV1alpha1:
     perNodeCap: Optional[int] = None
     maxRounds: Optional[int] = None
     maxBatch: Optional[int] = None
+    robustness: "RobustnessConfigurationV1alpha1" = field(
+        default_factory=RobustnessConfigurationV1alpha1)
 
 
 # -- defaulting (v1alpha1/defaults.go:42) -----------------------------------
@@ -170,21 +192,46 @@ def set_defaults_kube_scheduler_configuration(
         obj.maxRounds = 128
     if obj.maxBatch is None:
         obj.maxBatch = 8192
+    rb = obj.robustness
+    if rb.cycleDeadline is None:
+        rb.cycleDeadline = "0s"  # 0 = unbounded (the internal default)
+    if rb.solverRetries is None:
+        rb.solverRetries = 1
+    if rb.transportRetries is None:
+        rb.transportRetries = 2
+    if rb.retryBackoffBase is None:
+        rb.retryBackoffBase = "50ms"
+    if rb.retryBackoffMax is None:
+        rb.retryBackoffMax = "2s"
+    if rb.retryJitter is None:
+        rb.retryJitter = 0.2
+    if rb.breakerFailureThreshold is None:
+        rb.breakerFailureThreshold = 3
+    if rb.breakerOpenDuration is None:
+        rb.breakerOpenDuration = "30s"
+    if rb.breakerHalfOpenProbes is None:
+        rb.breakerHalfOpenProbes = 1
+    if rb.validateResults is None:
+        rb.validateResults = True
+    if rb.fallbackChain is None:
+        rb.fallbackChain = ["batch-cpu", "greedy"]
+    if rb.extenderDegradeToIgnorable is None:
+        rb.extenderDegradeToIgnorable = True
     return obj
 
 
 # -- conversions (v1alpha1/zz_generated.conversion.go shape) ----------------
 
 
-def _dur(field_name: str, value) -> float:
+def _dur(field_name: str, value, prefix: str = "leaderElection") -> float:
     """parse_duration with the FIELD PATH stamped into the error — the
     module's error contract; a bare 'duration: invalid' gives the user
-    no way to locate which of three duration fields failed."""
+    no way to locate which of several duration fields failed."""
     try:
         return parse_duration(value)
     except SchemeError:
         raise SchemeError([
-            f"leaderElection.{field_name}: invalid duration {value!r}"
+            f"{prefix}.{field_name}: invalid duration {value!r}"
         ])
 
 
@@ -269,11 +316,43 @@ def _to_internal(v: KubeSchedulerConfigurationV1alpha1) -> KubeSchedulerConfigur
         per_node_cap=v.perNodeCap,
         max_rounds=v.maxRounds,
         max_batch=v.maxBatch,
+        robustness=_robustness_to_internal(v.robustness),
+    )
+
+
+def _robustness_to_internal(rb: RobustnessConfigurationV1alpha1):
+    from kubernetes_tpu.config import RobustnessConfig
+
+    chain = rb.fallbackChain
+    if not (isinstance(chain, list)
+            and all(isinstance(t, str) for t in chain)):
+        raise SchemeError([
+            "robustness.fallbackChain: expected a list of tier names "
+            f"(got {type(chain).__name__})"
+        ])
+    return RobustnessConfig(
+        cycle_deadline_s=_dur("cycleDeadline", rb.cycleDeadline,
+                              "robustness"),
+        solver_retries=rb.solverRetries,
+        transport_retries=rb.transportRetries,
+        retry_backoff_base_s=_dur("retryBackoffBase", rb.retryBackoffBase,
+                                  "robustness"),
+        retry_backoff_max_s=_dur("retryBackoffMax", rb.retryBackoffMax,
+                                 "robustness"),
+        retry_jitter=rb.retryJitter,
+        breaker_failure_threshold=rb.breakerFailureThreshold,
+        breaker_open_duration_s=_dur("breakerOpenDuration",
+                                     rb.breakerOpenDuration, "robustness"),
+        breaker_half_open_probes=rb.breakerHalfOpenProbes,
+        validate_results=rb.validateResults,
+        fallback_chain=tuple(chain),
+        extender_degrade_to_ignorable=rb.extenderDegradeToIgnorable,
     )
 
 
 def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV1alpha1:
     le = c.leader_election
+    rc = c.robustness
     gates = c.feature_gates.overrides() or None
     return KubeSchedulerConfigurationV1alpha1(
         schedulerName=c.scheduler_name,
@@ -301,6 +380,20 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
         perNodeCap=c.per_node_cap,
         maxRounds=c.max_rounds,
         maxBatch=c.max_batch,
+        robustness=RobustnessConfigurationV1alpha1(
+            cycleDeadline=format_duration(rc.cycle_deadline_s),
+            solverRetries=rc.solver_retries,
+            transportRetries=rc.transport_retries,
+            retryBackoffBase=format_duration(rc.retry_backoff_base_s),
+            retryBackoffMax=format_duration(rc.retry_backoff_max_s),
+            retryJitter=rc.retry_jitter,
+            breakerFailureThreshold=rc.breaker_failure_threshold,
+            breakerOpenDuration=format_duration(rc.breaker_open_duration_s),
+            breakerHalfOpenProbes=rc.breaker_half_open_probes,
+            validateResults=rc.validate_results,
+            fallbackChain=list(rc.fallback_chain),
+            extenderDegradeToIgnorable=rc.extender_degrade_to_ignorable,
+        ),
     )
 
 

@@ -183,8 +183,10 @@ class CertificateController:
                 self.approved_total += 1
                 self.hub._commit(f"certificatesigningrequests/{csr.name}",
                                  "MODIFIED", csr)
+                # CSRs are cluster-scoped: empty namespace segment (the
+                # reference's involvedObject.namespace is "" here)
                 self.hub.record_controller_event(
-                    "CSRApproved", f"default/{csr.name}",
+                    "CSRApproved", f"/{csr.name}",
                     csr.approval_message,
                     involved_kind="CertificateSigningRequest")
                 return

@@ -32,6 +32,7 @@ from kubernetes_tpu.config import (
     FeatureGates,
     KubeSchedulerConfiguration,
     LeaderElectionConfig,
+    RobustnessConfig,
     load_policy,
 )
 
@@ -102,6 +103,29 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         errs.append("maxRounds: must be at least 1")
     if cfg.max_batch < 1:
         errs.append("maxBatch: must be at least 1")
+    rc = cfg.robustness
+    if rc.cycle_deadline_s < 0:
+        errs.append("robustness.cycleDeadlineSeconds: must be non-negative")
+    if rc.solver_retries < 0 or rc.transport_retries < 0:
+        errs.append("robustness.retries: must be non-negative")
+    if rc.retry_backoff_base_s < 0 or rc.retry_backoff_max_s < 0:
+        errs.append("robustness.retryBackoff: must be non-negative")
+    if not 0 <= rc.retry_jitter <= 1:
+        errs.append(
+            f"robustness.retryJitter: Invalid value {rc.retry_jitter}: "
+            "not in valid range 0-1"
+        )
+    if rc.breaker_failure_threshold < 1:
+        errs.append("robustness.breakerFailureThreshold: must be at least 1")
+    if rc.breaker_half_open_probes < 1:
+        errs.append("robustness.breakerHalfOpenProbes: must be at least 1")
+    bad_tiers = [t for t in rc.fallback_chain
+                 if t not in VALID_SOLVERS + ("batch-cpu",)]
+    if bad_tiers:
+        errs.append(
+            f"robustness.fallbackChain: unsupported tier(s) {bad_tiers}: "
+            f"supported: {', '.join(VALID_SOLVERS + ('batch-cpu',))}"
+        )
     # unknown feature gates are rejected earlier, at FeatureGates
     # construction (featuregate.Set errors on unknown names)
     return errs
@@ -109,6 +133,7 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
 
 _CONFIG_FIELDS = {f.name for f in dataclasses.fields(KubeSchedulerConfiguration)}
 _LE_FIELDS = {f.name for f in dataclasses.fields(LeaderElectionConfig)}
+_ROB_FIELDS = {f.name for f in dataclasses.fields(RobustnessConfig)}
 
 
 def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
@@ -159,6 +184,20 @@ def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
                 kw["feature_gates"] = FeatureGates(overrides=dict(val))
             except ValueError as e:
                 errs.append(f"featureGates: {e}")
+        elif key == "robustness":
+            if not isinstance(val, dict):
+                errs.append("robustness: expected a mapping")
+                continue
+            unknown = set(val) - _ROB_FIELDS
+            if unknown:
+                errs.append(
+                    f"robustness: unknown field(s) {sorted(unknown)}"
+                )
+                continue
+            rkw = dict(val)
+            if "fallback_chain" in rkw:
+                rkw["fallback_chain"] = tuple(rkw["fallback_chain"])
+            kw["robustness"] = RobustnessConfig(**rkw)
         elif key == "policy":
             kw["policy"] = load_policy(val)
         elif key in _CONFIG_FIELDS:

@@ -274,8 +274,12 @@ class RouteController:
                     # dataplane that does not exist
                     self.create_failures += 1
                     self._set_network_unavailable(name, True)
+                    # cluster-scoped involved object (Node): empty
+                    # namespace segment, so involvedObject.namespace
+                    # field selectors match the reference's "" instead
+                    # of a fabricated "default"
                     hub.record_controller_event(
-                        "FailedToCreateRoute", f"default/{name}",
+                        "FailedToCreateRoute", f"/{name}",
                         f"Could not create route {cidr}: {e}",
                         type_="Warning", involved_kind="Node")
                     continue

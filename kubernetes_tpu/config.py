@@ -106,6 +106,44 @@ class LeaderElectionConfig:
 
 
 @dataclass
+class RobustnessConfig:
+    """Degradation-ladder knobs (no reference analog — the resilience
+    layer around the out-of-process batch solver, kubernetes_tpu/faults
+    + scheduler._solve_ladder). All times ride the scheduler's injected
+    clock, so sim/chaos runs stay deterministic."""
+
+    #: wall-clock budget for one scheduling cycle; 0 disables. Once the
+    #: deadline passes, the ladder skips intermediate tiers straight to
+    #: the terminal sequential oracle, and extender calls are shed.
+    cycle_deadline_s: float = 0.0
+    #: bounded in-cycle retries per solver tier before falling through
+    solver_retries: int = 1
+    #: transport retries (HTTP extender / gRPC shim) per request
+    transport_retries: int = 2
+    retry_backoff_base_s: float = 0.05
+    retry_backoff_max_s: float = 2.0
+    #: +/- fractional jitter applied to each backoff interval
+    retry_jitter: float = 0.2
+    #: consecutive failed cycles before a tier's breaker opens
+    breaker_failure_threshold: int = 3
+    #: how long an open breaker sheds load before half-opening
+    breaker_open_duration_s: float = 30.0
+    #: trial calls admitted per half-open episode (the health probes)
+    breaker_half_open_probes: int = 1
+    #: validate solver results (shape/finiteness/range/capacity) before
+    #: trusting them — what keeps a lying solver from binding an
+    #: infeasible pod
+    validate_results: bool = True
+    #: tiers tried after the configured solver fails; "greedy" is the
+    #: sequential oracle floor and terminates the chain
+    fallback_chain: Tuple[str, ...] = ("batch-cpu", "greedy")
+    #: an open extender breaker (or blown deadline) skips the extender
+    #: like an Ignorable one instead of failing its pods — progress over
+    #: strictness while the remote is down
+    extender_degrade_to_ignorable: bool = True
+
+
+@dataclass
 class KubeSchedulerConfiguration:
     """The typed component config. Reference fields keep their meanings;
     the ``solver``/``per_node_cap``/``max_batch`` block is this
@@ -137,6 +175,8 @@ class KubeSchedulerConfiguration:
     per_node_cap: int = 4
     max_rounds: int = 128
     max_batch: int = 8192
+    #: degradation ladder / fault-tolerance knobs
+    robustness: RobustnessConfig = field(default_factory=RobustnessConfig)
 
 
 # ---------------------------------------------------------------------------

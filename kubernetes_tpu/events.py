@@ -23,12 +23,36 @@ TYPE_WARNING = "Warning"
 REASON_SCHEDULED = "Scheduled"
 REASON_FAILED = "FailedScheduling"
 REASON_PREEMPTED = "Preempted"
+#: degradation-ladder transitions (kubernetes_tpu/faults.py breakers):
+#: a solver tier / extender breaker opened (solves now route to a
+#: fallback tier) or closed again after a successful half-open probe
+REASON_DEGRADED = "SchedulerDegraded"
+REASON_RECOVERED = "SchedulerRecovered"
 
 _REASON_TYPE = {
     REASON_SCHEDULED: TYPE_NORMAL,
     REASON_FAILED: TYPE_WARNING,
     REASON_PREEMPTED: TYPE_WARNING,
+    REASON_DEGRADED: TYPE_WARNING,
+    REASON_RECOVERED: TYPE_NORMAL,
 }
+
+
+@dataclass(frozen=True)
+class ObjectRef:
+    """A minimal involved-object handle for events about things that are
+    not Pods (the scheduler component itself, a solver tier, an extender
+    endpoint). Carries exactly what the recorder reads: ``key()`` and
+    ``involved_kind``. Cluster-scoped refs keep an empty namespace, so
+    ``involvedObject.namespace`` serves as ``""`` like the reference's
+    cluster-scoped events."""
+
+    name: str
+    namespace: str = ""
+    involved_kind: str = "Scheduler"
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
 
 
 @dataclass
@@ -82,6 +106,9 @@ class EventRecorder:
                 message=message,
                 first_timestamp=now,
                 last_timestamp=now,
+                # non-Pod involved objects (ObjectRef, nodes) carry their
+                # kind; plain Pods keep the default
+                involved_kind=getattr(pod, "involved_kind", "Pod"),
             )
             self._events[key] = ev
         for sink in self.sinks:

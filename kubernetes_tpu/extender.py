@@ -177,18 +177,37 @@ class ExtenderError(Exception):
 class HTTPExtender:
     """core/extender.go:42 — POSTs JSON to urlPrefix/verb. ``transport``
     is injectable for tests (callable(url, payload_dict, timeout) ->
-    response dict); the default uses urllib."""
+    response dict); the default uses urllib.
+
+    Robustness seams (kubernetes_tpu/faults.py): ``retry`` — a
+    RetryPolicy applying bounded exponential backoff + jitter around the
+    transport call (the scheduler wires its shared policy in when left
+    None); ``fault_injector`` — the chaos harness hook, consulted before
+    each send (may raise timeouts/connection errors) and after (may
+    corrupt the decoded response); :meth:`set_call_budget` — the
+    scheduler's per-cycle deadline propagation, clamping the next calls'
+    transport timeout to the remaining cycle budget."""
 
     def __init__(
         self,
         config: ExtenderConfig,
         transport: Optional[Callable[[str, dict, float], dict]] = None,
+        retry=None,
+        fault_injector=None,
     ) -> None:
         self.config = config
         self._transport = transport or _urllib_transport
+        self.retry = retry
+        self.fault_injector = fault_injector
+        self._call_budget_s: Optional[float] = None
 
     def name(self) -> str:
         return self.config.url_prefix
+
+    def set_call_budget(self, seconds: float) -> None:
+        """Clamp subsequent transport timeouts to the caller's remaining
+        cycle budget (consumed per send; re-armed each cycle)."""
+        self._call_budget_s = max(float(seconds), 1e-3)
 
     def is_ignorable(self) -> bool:
         return self.config.ignorable
@@ -209,7 +228,25 @@ class HTTPExtender:
 
     def _send(self, verb: str, args: dict) -> dict:
         url = self.config.url_prefix.rstrip("/") + "/" + verb
-        return self._transport(url, args, self.config.http_timeout_s)
+        timeout = self.config.http_timeout_s
+        if self._call_budget_s is not None:
+            timeout = min(timeout, self._call_budget_s)
+
+        def once() -> dict:
+            kind = None
+            if self.fault_injector is not None:
+                # may raise (timeout/connection/truncated) or return a
+                # corruption to apply to the decoded response
+                kind = self.fault_injector.transport_fault(
+                    f"extender:{verb}")
+            resp = self._transport(url, args, timeout)
+            if kind is not None:
+                resp = self.fault_injector.corrupt_response(kind, resp)
+            return resp
+
+        if self.retry is not None:
+            return self.retry.call(once)
+        return once()
 
     # -- verbs -------------------------------------------------------------
 
@@ -232,17 +269,27 @@ class HTTPExtender:
             result = self._send(self.config.filter_verb, args)
         except Exception as e:
             raise ExtenderError(str(e))
-        if result.get("error"):
-            raise ExtenderError(result["error"])
-        if self.config.node_cache_capable and result.get("nodenames") is not None:
-            names = list(result["nodenames"])
-        elif result.get("nodes") is not None:
-            names = [
-                item["metadata"]["name"] for item in result["nodes"].get("items", [])
-            ]
-        else:
-            names = list(node_names)
-        return names, dict(result.get("failedNodes") or {})
+        # parse hardening: a corrupt/mistyped response is a remote error
+        # (ExtenderError, so the Ignorable policy applies) — it must
+        # never escape as a TypeError that aborts the whole cycle
+        try:
+            if result.get("error"):
+                raise ExtenderError(result["error"])
+            if (self.config.node_cache_capable
+                    and result.get("nodenames") is not None):
+                names = [str(n) for n in result["nodenames"]]
+            elif result.get("nodes") is not None:
+                names = [
+                    item["metadata"]["name"]
+                    for item in result["nodes"].get("items", [])
+                ]
+            else:
+                names = list(node_names)
+            return names, dict(result.get("failedNodes") or {})
+        except ExtenderError:
+            raise
+        except Exception as e:
+            raise ExtenderError(f"malformed filter response: {e}")
 
     def prioritize(
         self, pod: Pod, node_names: Sequence[str], nodes_by_name: Dict[str, object]
@@ -262,7 +309,10 @@ class HTTPExtender:
             result = self._send(self.config.prioritize_verb, args)
         except Exception as e:
             raise ExtenderError(str(e))
-        scores = {hp["host"]: float(hp["score"]) for hp in (result or [])}
+        try:
+            scores = {hp["host"]: float(hp["score"]) for hp in (result or [])}
+        except Exception as e:
+            raise ExtenderError(f"malformed prioritize response: {e}")
         return scores, self.config.weight
 
     def bind(self, pod: Pod, node_name: str) -> None:
@@ -329,5 +379,8 @@ def _urllib_transport(url: str, payload: dict, timeout: float) -> dict:
 def build_extenders(
     configs: Sequence[ExtenderConfig],
     transport: Optional[Callable] = None,
+    retry=None,
+    fault_injector=None,
 ) -> List[HTTPExtender]:
-    return [HTTPExtender(c, transport) for c in configs]
+    return [HTTPExtender(c, transport, retry=retry,
+                         fault_injector=fault_injector) for c in configs]

@@ -122,13 +122,17 @@ class TpuSchedulerService:
     """Service implementation over a live Scheduler (its cache is the
     resident snapshot the deltas feed)."""
 
-    def __init__(self, scheduler) -> None:
+    def __init__(self, scheduler, fault_injector=None) -> None:
         self.scheduler = scheduler
         self.extender = ExtenderServer(scheduler)
         #: deltas serialize against verbs; a service-side cycle loop must
         #: hold this too (sync_state mutates the same cache/queue)
         self.lock = threading.Lock()
         self.revision = 0
+        #: chaos seam (kubernetes_tpu/faults.py): fires per served verb
+        #: ("grpc-service:filter", ...) — a raising fault rides the
+        #: verb's error-result path, simulating a crashing service
+        self.fault_injector = fault_injector
 
     # -- SyncState (bidi stream) -------------------------------------------
 
@@ -190,6 +194,8 @@ class TpuSchedulerService:
             if request.node_names:
                 payload["nodenames"] = list(request.node_names)
             try:
+                if self.fault_injector is not None:
+                    self.fault_injector.transport_fault("grpc-service:filter")
                 r = self.extender.handle("filter", payload)
             except Exception as e:  # verb errors ride the result message
                 return pb.ExtenderFilterResult(error=str(e))
@@ -205,6 +211,9 @@ class TpuSchedulerService:
             if request.node_names:
                 payload["nodenames"] = list(request.node_names)
             try:
+                if self.fault_injector is not None:
+                    self.fault_injector.transport_fault(
+                        "grpc-service:prioritize")
                 r = self.extender.handle("prioritize", payload)
             except Exception as e:
                 return pb.HostPriorityList(error=str(e))
@@ -468,21 +477,46 @@ class GrpcSchedulerClient:
     """The Go-side shim's view: typed stubs over a channel (what a
     generated *_pb2_grpc.Stub provides). ``token`` attaches
     `authorization: Bearer <token>` metadata to every call (the client
-    half of the seam's authentication)."""
+    half of the seam's authentication).
 
-    def __init__(self, target: str, token: "str | None" = None):
+    Robustness seams (kubernetes_tpu/faults.py): ``retry`` — a
+    RetryPolicy applying bounded exponential backoff + jitter around
+    each unary call (transient UNAVAILABLE/DEADLINE_EXCEEDED survive a
+    retry; the stream is NOT retried here — reconnect-and-resume is the
+    bridge's job via acked revisions); ``fault_injector`` — the chaos
+    harness hook, firing per-verb before the wire call ("grpc:Filter",
+    "grpc:Bind", ...)."""
+
+    def __init__(self, target: str, token: "str | None" = None,
+                 retry=None, fault_injector=None):
         self.target = target
         self.channel = grpc.insecure_channel(target)
+        self.retry = retry
+        self.fault_injector = fault_injector
         self._md = ([("authorization", f"Bearer {token}")]
                     if token else None)
 
-        def with_md(callable_):
-            if self._md is None:
+        def with_md(callable_, verb: str = "", unary: bool = False):
+            inj, md = self.fault_injector, self._md
+            plain = inj is None and not (unary and retry is not None)
+            if md is None and plain:
                 return callable_
 
             def call(*a, **kw):
-                kw.setdefault("metadata", self._md)
-                return callable_(*a, **kw)
+                if md is not None:
+                    kw.setdefault("metadata", md)
+
+                def once():
+                    if inj is not None:
+                        # raising kinds only on this typed seam: a
+                        # corrupt frame fails protobuf decode, which
+                        # grpc surfaces as an RpcError anyway
+                        inj.transport_fault(f"grpc:{verb}")
+                    return callable_(*a, **kw)
+
+                if unary and self.retry is not None:
+                    return self.retry.call(once)
+                return once()
 
             return call
 
@@ -491,27 +525,27 @@ class GrpcSchedulerClient:
             base + "SyncState",
             request_serializer=pb.SnapshotDelta.SerializeToString,
             response_deserializer=pb.SyncAck.FromString,
-        ))
+        ), "SyncState")
         self.filter = with_md(self.channel.unary_unary(
             base + "Filter",
             request_serializer=pb.ExtenderArgs.SerializeToString,
             response_deserializer=pb.ExtenderFilterResult.FromString,
-        ))
+        ), "Filter", unary=True)
         self.prioritize = with_md(self.channel.unary_unary(
             base + "Prioritize",
             request_serializer=pb.ExtenderArgs.SerializeToString,
             response_deserializer=pb.HostPriorityList.FromString,
-        ))
+        ), "Prioritize", unary=True)
         self.bind = with_md(self.channel.unary_unary(
             base + "Bind",
             request_serializer=pb.Binding.SerializeToString,
             response_deserializer=pb.BindResult.FromString,
-        ))
+        ), "Bind", unary=True)
         self.get_state = with_md(self.channel.unary_unary(
             base + "GetState",
             request_serializer=pb.StateRequest.SerializeToString,
             response_deserializer=pb.StateSnapshot.FromString,
-        ))
+        ), "GetState", unary=True)
 
     def close(self) -> None:
         self.channel.close()

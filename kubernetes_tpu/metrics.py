@@ -119,12 +119,14 @@ class Histogram(_Metric):
         out = []
         for k in sorted(self._n):
             for i, b in enumerate(self.buckets):
+                le = 'le="%s"' % b
                 out.append(
-                    f"{self.name}_bucket{self._fmt_labels(k, f'le=\"{b}\"')} "
+                    f"{self.name}_bucket{self._fmt_labels(k, le)} "
                     f"{self._counts[k][i]}"
                 )
+            le_inf = 'le="+Inf"'
             out.append(
-                f"{self.name}_bucket{self._fmt_labels(k, 'le=\"+Inf\"')} {self._n[k]}"
+                f"{self.name}_bucket{self._fmt_labels(k, le_inf)} {self._n[k]}"
             )
             out.append(f"{self.name}_sum{self._fmt_labels(k)} {self._sum[k]}")
             out.append(f"{self.name}_count{self._fmt_labels(k)} {self._n[k]}")
@@ -164,8 +166,9 @@ class Summary(_Metric):
         out = []
         for k in sorted(self._n):
             for q in self.objectives:
+                qlabel = 'quantile="%s"' % q
                 out.append(
-                    f"{self.name}{self._fmt_labels(k, f'quantile=\"{q}\"')} "
+                    f"{self.name}{self._fmt_labels(k, qlabel)} "
                     f"{self.quantile(q, **dict(zip(self.label_names, k)))}"
                 )
             out.append(f"{self.name}_sum{self._fmt_labels(k)} {self._sum[k]}")
@@ -246,4 +249,42 @@ class SchedulerMetrics:
             "scheduler_pending_pods",
             "Number of pending pods, by the queue type.",
             ["queue"],
+        ))
+        # -- degradation-ladder observability (no reference analog; the
+        # robustness layer around the out-of-process batch solver) -----
+        self.solver_fallbacks = r.register(Counter(
+            "scheduler_solver_fallback_total",
+            "Solve attempts that fell from one ladder tier to the next.",
+            ["from_tier", "to_tier"],
+        ))
+        self.breaker_state = r.register(Gauge(
+            "scheduler_circuit_breaker_state",
+            "Circuit breaker state per target (0=closed, 1=half-open, "
+            "2=open).",
+            ["target"],
+        ))
+        self.solver_tier_duration = r.register(Histogram(
+            "scheduler_solver_tier_duration_seconds",
+            "Solve latency per degradation-ladder tier.",
+            ["tier"],
+        ))
+        self.solver_rejections = r.register(Counter(
+            "scheduler_solver_result_rejections_total",
+            "Solver results rejected by validation, by tier and reason.",
+            ["tier", "reason"],
+        ))
+        self.solver_retries = r.register(Counter(
+            "scheduler_solver_retries_total",
+            "In-cycle solver retries before falling through, by tier.",
+            ["tier"],
+        ))
+        self.extender_degraded = r.register(Counter(
+            "scheduler_extender_degraded_total",
+            "Extender calls shed by an open breaker or a blown cycle "
+            "deadline.",
+            ["extender"],
+        ))
+        self.deadline_exceeded = r.register(Counter(
+            "scheduler_cycle_deadline_exceeded_total",
+            "Cycles whose deadline expired before the ladder finished.",
         ))

@@ -231,23 +231,36 @@ def greedy_assign(
     no_ports: bool = False,
     no_pod_affinity: bool = False,
     no_spread: bool = False,
+    fault_hook=None,
+    fault_site: str = "solve:greedy",
 ) -> Tuple[jnp.ndarray, UsageState]:
     """Serial-parity solver. Returns (assigned node row per pod or -1,
     final usage). ``extra_mask`` (P, N) ANDs into feasibility — the driver
     feeds the nominated-pods pass-A mask through it (podFitsOnNode's
     two-pass rule, generic_scheduler.go:610). ``skip_priorities``: names
     from :func:`~kubernetes_tpu.ops.priorities.empty_priorities`, whose
-    kernels are replaced by their exact constants (static jit key)."""
+    kernels are replaced by their exact constants (static jit key).
+
+    ``fault_hook(site, assigned, usage, rounds, n_nodes)`` is the
+    solver-entry fault-injection seam (kubernetes_tpu/faults.py): called
+    with the would-be result, it may raise a SolverFault or return a
+    poisoned triple — exactly what an out-of-process solver timing out
+    or lying over the wire would look like to the driver."""
     key = tuple(sorted(weights.items())) if weights is not None else None
     if extra_mask is None:
         extra_mask = jnp.ones(
             (pods.req.shape[0], nodes.allocatable.shape[0]), bool
         )
-    return _greedy_impl(pods, nodes, sel, topo, vol, key, extra_mask,
-                        static_vol, enabled_mask, extra_score,
-                        skip_key=tuple(skip_priorities), no_ports=no_ports,
-                        no_pod_affinity=no_pod_affinity,
-                        no_spread=no_spread)
+    assigned, u = _greedy_impl(pods, nodes, sel, topo, vol, key, extra_mask,
+                               static_vol, enabled_mask, extra_score,
+                               skip_key=tuple(skip_priorities),
+                               no_ports=no_ports,
+                               no_pod_affinity=no_pod_affinity,
+                               no_spread=no_spread)
+    if fault_hook is not None:
+        assigned, u, _ = fault_hook(fault_site, assigned, u, 0,
+                                    nodes.allocatable.shape[0])
+    return assigned, u
 
 
 def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray:
@@ -584,6 +597,8 @@ def batch_assign(
     no_spread: bool = False,
     fused_score: bool = True,
     auto_sinkhorn: bool = True,
+    fault_hook=None,
+    fault_site: str = "solve:batch",
 ) -> Tuple[jnp.ndarray, UsageState, jnp.ndarray]:
     """Fast batched solver. Returns (assigned row per pod or -1, final
     usage, rounds executed). ``per_node_cap`` bounds admissions per node per
@@ -609,9 +624,82 @@ def batch_assign(
         from kubernetes_tpu.ops.fused_score import use_pallas
 
         fused_score = use_pallas()
-    return _batch_impl(pods, nodes, sel, topo, key, max_rounds, per_node_cap,
-                       extra_mask, vol, static_vol, enabled_mask, extra_score,
-                       use_sinkhorn, skip_key=tuple(skip_priorities),
-                       no_ports=no_ports, no_pod_affinity=no_pod_affinity,
-                       no_spread=no_spread, fused_score=fused_score,
-                       auto_sinkhorn=auto_sinkhorn)
+    assigned, u, rounds = _batch_impl(
+        pods, nodes, sel, topo, key, max_rounds, per_node_cap,
+        extra_mask, vol, static_vol, enabled_mask, extra_score,
+        use_sinkhorn, skip_key=tuple(skip_priorities),
+        no_ports=no_ports, no_pod_affinity=no_pod_affinity,
+        no_spread=no_spread, fused_score=fused_score,
+        auto_sinkhorn=auto_sinkhorn)
+    if fault_hook is not None:
+        # the fault-injection seam (see greedy_assign): the hook stands
+        # where an out-of-process solver's response would be decoded
+        assigned, u, rounds = fault_hook(fault_site, assigned, u, rounds,
+                                         nodes.allocatable.shape[0])
+    return assigned, u, rounds
+
+
+def validate_solution(
+    assigned, usage: UsageState, pods: DevicePods, nodes: DeviceNodes,
+    enabled_mask: Optional[int] = None,
+) -> Tuple[bool, str]:
+    """Trust-but-verify for a solver result before any pod is assumed —
+    the check that keeps a lying/corrupted solver (or a stale-snapshot
+    race) from binding an infeasible pod. Returns (ok, reason) with
+    ``reason`` one of shape | dtype | range | invalid-node | finiteness
+    | capacity.
+
+    Deliberately cheap (O(P·R + N·R) host numpy): shape and index-range
+    sanity, claimed-usage finiteness, and a full per-node capacity
+    recomputation from the assignment itself (never trusting the
+    solver's usage for feasibility). Capacity is only enforced when the
+    PodFitsResources predicate is (the Policy-bypass rule the solvers
+    themselves follow), and only blames nodes that were within
+    allocatable BEFORE this batch — force-bound overcommit from the
+    cache is not the solver's lie."""
+    import numpy as np
+
+    from kubernetes_tpu.ops.predicates import BIT
+
+    P = pods.req.shape[0]
+    try:
+        a = np.asarray(assigned)
+    except Exception:
+        return False, "dtype"
+    if a.ndim != 1 or a.shape[0] < P:
+        return False, "shape"
+    a = a[:P]
+    if not np.issubdtype(a.dtype, np.integer):
+        if not np.all(np.isfinite(a)):
+            return False, "finiteness"
+        if np.any(a != np.floor(a)):
+            return False, "dtype"
+        a = a.astype(np.int64)
+    valid = np.asarray(pods.valid)
+    nvalid = np.asarray(nodes.valid)
+    N = nvalid.shape[0]
+    if np.any(valid & ((a < -1) | (a >= N))):
+        return False, "range"
+    sel = valid & (a >= 0)
+    if np.any(sel & ~nvalid[np.clip(a, 0, N - 1)]):
+        return False, "invalid-node"
+    if not bool(np.all(np.isfinite(np.asarray(usage.requested)))):
+        return False, "finiteness"
+    res_on = enabled_mask is None or bool(
+        enabled_mask & (1 << BIT["PodFitsResources"])
+    )
+    if res_on and np.any(sel):
+        req = np.asarray(pods.req)
+        base = np.asarray(nodes.requested)
+        alloc = np.asarray(nodes.allocatable)
+        add = np.zeros_like(base)
+        np.add.at(add, a[sel], req[sel])
+        # relative tolerance: float32 scatter-add drift scales with the
+        # magnitude (memory columns are bytes), so an absolute epsilon
+        # would false-positive on honest results
+        tol = 1e-5 * np.maximum(alloc, 1.0) + 1e-6
+        pre_ok = base <= alloc + tol
+        over = (base + add > alloc + tol) & nvalid[:, None] & (add > 0)
+        if np.any(over & pre_ok):
+            return False, "capacity"
+    return True, ""

@@ -386,11 +386,14 @@ def _ep_target_ref(a) -> dict:
 
 
 def ep_to_doc(hub, key: str, ep) -> dict:
-    """v1.Endpoints wire doc — one builder for lists AND watch frames."""
+    """v1.Endpoints wire doc — one builder for lists AND watch frames.
+    An Endpoints with no addresses at all serializes ``subsets: []``
+    (the reference drops empty subsets, it never emits a subset whose
+    address lists are both empty)."""
     e_ns, name = key.split("/", 1)
-    return _with_rv({
-        "metadata": {"name": name, "namespace": e_ns},
-        "subsets": [{
+    subsets = []
+    if ep.ready or ep.not_ready:
+        subsets = [{
             "addresses": [
                 {"nodeName": a.node_name, "targetRef": _ep_target_ref(a)}
                 for a in ep.ready
@@ -398,7 +401,10 @@ def ep_to_doc(hub, key: str, ep) -> dict:
             "notReadyAddresses": [
                 {"targetRef": _ep_target_ref(a)} for a in ep.not_ready
             ],
-        }],
+        }]
+    return _with_rv({
+        "metadata": {"name": name, "namespace": e_ns},
+        "subsets": subsets,
     }, hub, f"endpoints/{key}")
 
 
@@ -1870,12 +1876,17 @@ class RestServer:
                                "limits", "ports", "restartPolicy",
                                "topologySpreadConstraints",
                                "priorityClassName")
+                    # exact dotted-path SEGMENTS, not substring: an
+                    # unmodeled field whose name merely contains a
+                    # guarded token ("hostPorts", "volumesAttached")
+                    # keeps the documented lenient drop-as-POST-dropped
+                    # behavior instead of a spurious 422
                     fk = [
                         p
                         for part in ("spec", "status")
                         for p in foreign_keys(merged.get(part),
                                               canon.get(part))
-                        if any(g in p for g in guarded)
+                        if any(seg in guarded for seg in p.split("."))
                     ]
                     same = (
                         dataclasses.replace(a, labels=b.labels) == b

@@ -121,6 +121,11 @@ class CycleResult:
     nominations: Dict[str, str] = field(default_factory=dict)  # pod -> node
     waiting: int = 0  # pods parked by Permit plugins this cycle
     elapsed_s: float = 0.0
+    #: which degradation-ladder tier produced this cycle's placements
+    #: ("" = empty cycle; "batch" is the healthy fast path)
+    solver_tier: str = ""
+    #: tier-to-tier fallbacks taken this cycle (0 on the healthy path)
+    solver_fallbacks: int = 0
 
 
 class Scheduler:
@@ -151,7 +156,12 @@ class Scheduler:
         percentage_of_nodes_to_score: Optional[int] = None,
         volume_binder=None,
         scheduler_name: str = "default-scheduler",
+        robustness=None,
+        fault_injector=None,
+        retry_sleep: Callable[[float], None] = time.sleep,
     ) -> None:
+        from kubernetes_tpu.config import RobustnessConfig
+        from kubernetes_tpu.faults import CircuitBreaker, RetryPolicy
         from kubernetes_tpu.framework import Framework
         from kubernetes_tpu.metrics import SchedulerMetrics
         from kubernetes_tpu.nodetree import NodeTree
@@ -168,6 +178,38 @@ class Scheduler:
         #: filter/score passes for interested pods
         self.extenders = list(extenders)
         self.metrics = metrics or SchedulerMetrics()
+        #: degradation-ladder knobs (config.RobustnessConfig): per-cycle
+        #: deadline, bounded retries, breaker thresholds, fallback chain,
+        #: result validation — the resilience layer for an out-of-process
+        #: (TPU-service) solver that may time out, crash, or lie
+        self.robustness = (robustness if robustness is not None
+                           else RobustnessConfig())
+        #: faults.FaultInjector (or None): the seeded chaos harness wired
+        #: into the solver entry and the extender/shim transports
+        self.fault_injector = fault_injector
+        rc = self.robustness
+        #: bounded-backoff policy shared by the transport seams; ``sleep``
+        #: injectable so fake-clock tests never block
+        self._transport_retry = RetryPolicy(
+            max_retries=rc.transport_retries,
+            base_s=rc.retry_backoff_base_s,
+            max_s=rc.retry_backoff_max_s,
+            jitter=rc.retry_jitter,
+            sleep=retry_sleep,
+        )
+        for e in self.extenders:
+            # wire retry + fault hooks into transports that expose the
+            # seam (HTTPExtender); duck-typed so test fakes stay valid
+            if getattr(e, "retry", "absent") is None:
+                e.retry = self._transport_retry
+            if (fault_injector is not None
+                    and getattr(e, "fault_injector", "absent") is None):
+                e.fault_injector = fault_injector
+        #: per-target circuit breakers ("solver:batch",
+        #: "extender:<url>"), created lazily against this clock
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        #: absolute deadline of the cycle in flight (None = unbounded)
+        self._cycle_deadline: Optional[float] = None
         #: cycles slower than this log their step trace (utiltrace
         #: LogIfLong; default is cycle-scale, not the reference's per-pod
         #: 100ms, since one cycle schedules a whole batch)
@@ -248,6 +290,7 @@ class Scheduler:
         kw.setdefault("max_rounds", cfg.max_rounds)
         kw.setdefault("max_batch", cfg.max_batch)
         kw.setdefault("scheduler_name", cfg.scheduler_name)
+        kw.setdefault("robustness", cfg.robustness)
         if getattr(cfg, "plugins", ()) and "framework" not in kw:
             # config-driven framework assembly (the NewFramework path,
             # framework.go:88: registry factories + per-plugin args from
@@ -433,6 +476,13 @@ class Scheduler:
 
         t0 = self.clock()
         res = CycleResult()
+        # per-cycle deadline (robustness.cycle_deadline_s): propagated to
+        # the solver ladder (skip-to-oracle once blown) and the extender
+        # calls (shed) so one wedged dependency can't stall the queue
+        self._cycle_deadline = (
+            t0 + self.robustness.cycle_deadline_s
+            if self.robustness.cycle_deadline_s > 0 else None
+        )
         trace = Trace("Scheduling cycle", clock=self.clock)
         self.queue.tick()
         self.cache.cleanup_expired()
@@ -629,35 +679,23 @@ class Scheduler:
                     "using round solver"
                 )
                 solver = "batch"
-        if solver == "greedy":
-            assigned, usage = greedy_assign(
-                dp, dn, ds, self.weights, topo=dt, extra_mask=extra_mask,
-                vol=dv, static_vol=sv, enabled_mask=self.pred_mask,
-                extra_score=extra_score, skip_priorities=skip_prio,
-                no_ports=no_ports, no_pod_affinity=no_pod_aff,
-                no_spread=no_spread,
-            )
-            rounds = len(batch)
-        elif solver == "exact":
-            assigned, usage, rounds = self._exact_solve(
-                dp, dn, ds, dt, base_fr, extra_mask, extra_score
-            )
-        else:
-            assigned, usage, rounds = batch_assign(
-                dp, dn, ds, self.weights,
-                max_rounds=self.max_rounds,
-                per_node_cap=self.per_node_cap,
-                topo=dt,
-                extra_mask=extra_mask,
-                vol=dv,
-                static_vol=sv,
-                enabled_mask=self.pred_mask,
-                extra_score=extra_score,
-                use_sinkhorn=(solver == "sinkhorn"),
-                skip_priorities=skip_prio,
-                no_ports=no_ports, no_pod_affinity=no_pod_aff,
-                no_spread=no_spread,
-            )
+        ladder = self._solve_ladder(
+            solver, batch, dp, dn, ds, dt, dv, sv, base_fr, extra_mask,
+            extra_score, skip_prio, no_ports, no_pod_aff, no_spread, res,
+        )
+        if ladder is None:
+            # every tier failed (even the in-process oracle — a total
+            # solver outage): fail the whole batch through the standard
+            # error path so pods requeue with backoff instead of the
+            # cycle stalling or binding garbage
+            for pod in batch:
+                self._fail(pod, cycle, res, ("SolverUnavailable",))
+            res.elapsed_s = self.clock() - t0
+            self._record_metrics(res)
+            trace.log_if_long(self.trace_threshold_s)
+            return res
+        assigned, usage, rounds, tier_used = ladder
+        res.solver_tier = tier_used
         assigned = np.array(assigned)[: len(batch)]  # writable copy
 
         # gang scheduling (PodGroup all-or-nothing; the coscheduling-plugin
@@ -689,7 +727,7 @@ class Scheduler:
                 jnp.asarray(np.maximum(pad_assigned, 0)),
                 jnp.asarray(pad_assigned >= 0) & dp.valid,
             )
-        res.rounds = int(rounds) if solver != "greedy" else rounds
+        res.rounds = int(rounds)
         solve_s = trace.total_s()
         trace.step(f"solve done ({res.rounds} rounds)")
         self.metrics.algorithm_duration.observe(solve_s)
@@ -829,6 +867,201 @@ class Scheduler:
         for q, depth in self.queue.pending_counts().items():
             m.pending_pods.set(depth, queue=q)
 
+    # -- degradation ladder ------------------------------------------------
+
+    def _breaker(self, target: str):
+        """Lazily create the circuit breaker for a ladder tier or
+        extender endpoint, wired to the breaker-state gauge and the
+        SchedulerDegraded/SchedulerRecovered events."""
+        br = self._breakers.get(target)
+        if br is None:
+            from functools import partial as _partial
+
+            from kubernetes_tpu.faults import CircuitBreaker
+
+            rc = self.robustness
+            br = CircuitBreaker(
+                failure_threshold=rc.breaker_failure_threshold,
+                open_duration_s=rc.breaker_open_duration_s,
+                half_open_probes=rc.breaker_half_open_probes,
+                clock=self.clock,
+                on_transition=_partial(self._on_breaker_transition, target),
+            )
+            self._breakers[target] = br
+            self.metrics.breaker_state.set(0, target=target)
+        return br
+
+    def _on_breaker_transition(self, target: str, old: str, new: str) -> None:
+        from kubernetes_tpu.events import (
+            REASON_DEGRADED,
+            REASON_RECOVERED,
+            ObjectRef,
+        )
+        from kubernetes_tpu.faults import CLOSED, OPEN, STATE_CODE
+
+        self.metrics.breaker_state.set(STATE_CODE[new], target=target)
+        ref = ObjectRef(name=self.scheduler_name, involved_kind="Scheduler")
+        if new == OPEN:
+            klog.warning("circuit breaker %s: %s -> open (degraded mode)",
+                         target, old)
+            self.event_sink(
+                REASON_DEGRADED, ref,
+                f"circuit breaker for {target} opened; "
+                "routing around it (degraded mode)",
+            )
+        elif new == CLOSED and old != CLOSED:
+            klog.V(2).info("circuit breaker %s: %s -> closed", target, old)
+            self.event_sink(
+                REASON_RECOVERED, ref,
+                f"circuit breaker for {target} closed; full service restored",
+            )
+
+    def _run_tier(self, tier, batch, dp, dn, ds, dt, dv, sv, base_fr,
+                  extra_mask, extra_score, skip_prio, no_ports, no_pod_aff,
+                  no_spread):
+        """One solve attempt on one ladder tier. Returns
+        (assigned, usage, rounds); exceptions propagate to the ladder."""
+        from kubernetes_tpu.ops.assign import batch_assign, greedy_assign
+
+        hook = (self.fault_injector.solver_hook
+                if self.fault_injector is not None else None)
+        if tier == "greedy":
+            a, u = greedy_assign(
+                dp, dn, ds, self.weights, topo=dt, extra_mask=extra_mask,
+                vol=dv, static_vol=sv, enabled_mask=self.pred_mask,
+                extra_score=extra_score, skip_priorities=skip_prio,
+                no_ports=no_ports, no_pod_affinity=no_pod_aff,
+                no_spread=no_spread, fault_hook=hook,
+                fault_site="solve:greedy",
+            )
+            return a, u, len(batch)
+        if tier == "exact":
+            out = self._exact_solve(
+                dp, dn, ds, dt, base_fr, extra_mask, extra_score
+            )
+            if hook is not None:
+                out = hook("solve:exact", *out, dn.valid.shape[0])
+            return out
+        if tier == "batch-cpu":
+            # host-backend fallback: re-pin every input to the local CPU
+            # device so the identical solve re-runs off-accelerator (on a
+            # CPU-only install this is a clean re-execution — the seam a
+            # TPU deployment uses to survive a wedged chip)
+            cpu = jax.local_devices(backend="cpu")[0]
+
+            def put(t):
+                return jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, cpu), t)
+
+            return batch_assign(
+                put(dp), put(dn), put(ds), self.weights,
+                max_rounds=self.max_rounds, per_node_cap=self.per_node_cap,
+                topo=put(dt), extra_mask=put(extra_mask), vol=put(dv),
+                static_vol=put(sv), enabled_mask=self.pred_mask,
+                extra_score=put(extra_score), use_sinkhorn=False,
+                skip_priorities=skip_prio, no_ports=no_ports,
+                no_pod_affinity=no_pod_aff, no_spread=no_spread,
+                fault_hook=hook, fault_site="solve:batch-cpu",
+            )
+        return batch_assign(
+            dp, dn, ds, self.weights,
+            max_rounds=self.max_rounds, per_node_cap=self.per_node_cap,
+            topo=dt, extra_mask=extra_mask, vol=dv, static_vol=sv,
+            enabled_mask=self.pred_mask, extra_score=extra_score,
+            use_sinkhorn=(tier == "sinkhorn"), skip_priorities=skip_prio,
+            no_ports=no_ports, no_pod_affinity=no_pod_aff,
+            no_spread=no_spread, fault_hook=hook,
+            fault_site=f"solve:{tier}",
+        )
+
+    def _solve_ladder(self, solver, batch, dp, dn, ds, dt, dv, sv, base_fr,
+                      extra_mask, extra_score, skip_prio, no_ports,
+                      no_pod_aff, no_spread, res):
+        """The degradation ladder: try the configured solver tier, then
+        each tier of ``robustness.fallback_chain`` (TPU batch → CPU-JAX
+        batch → the greedy sequential oracle), with per-tier circuit
+        breakers, bounded in-cycle retries, deadline-aware skip-to-oracle,
+        and result validation so a lying solver can never bind an
+        infeasible pod. Returns (assigned, usage, rounds, tier) or None
+        when every tier failed (the caller requeues the whole batch)."""
+        from kubernetes_tpu.faults import SolverResultInvalid
+        from kubernetes_tpu.ops.assign import validate_solution
+
+        rc = self.robustness
+        tiers = [solver]
+        for t in rc.fallback_chain:
+            if t not in tiers:
+                tiers.append(t)
+        if "greedy" in tiers:
+            # the sequential oracle is the trust floor — nothing below it
+            tiers = tiers[: tiers.index("greedy") + 1]
+        terminal = tiers[-1]
+        m = self.metrics
+        deadline = self._cycle_deadline
+        deadline_counted = False
+
+        i = 0
+        while i < len(tiers):
+            tier = tiers[i]
+            if (deadline is not None and tier != terminal
+                    and self.clock() >= deadline):
+                # budget blown: no time for intermediate tiers — jump to
+                # the oracle floor so the cycle still makes progress
+                if not deadline_counted:
+                    m.deadline_exceeded.inc()
+                    deadline_counted = True
+                m.solver_fallbacks.inc(from_tier=tier, to_tier=terminal)
+                res.solver_fallbacks += 1
+                i = len(tiers) - 1
+                continue
+            br = self._breaker(f"solver:{tier}")
+            if not br.allow() and i + 1 < len(tiers):
+                # open breaker sheds the tier without burning latency;
+                # the terminal tier is always attempted regardless
+                m.solver_fallbacks.inc(from_tier=tier, to_tier=tiers[i + 1])
+                res.solver_fallbacks += 1
+                i += 1
+                continue
+            attempts = 1 + max(0, rc.solver_retries)
+            result = last_err = None
+            for attempt in range(attempts):
+                ts = self.clock()
+                try:
+                    out = self._run_tier(
+                        tier, batch, dp, dn, ds, dt, dv, sv, base_fr,
+                        extra_mask, extra_score, skip_prio, no_ports,
+                        no_pod_aff, no_spread,
+                    )
+                    if rc.validate_results:
+                        ok, why = validate_solution(
+                            out[0], out[1], dp, dn, self.pred_mask)
+                        if not ok:
+                            m.solver_rejections.inc(tier=tier, reason=why)
+                            raise SolverResultInvalid(f"{tier}: {why}")
+                    result = out
+                    break
+                except Exception as e:
+                    last_err = e
+                finally:
+                    m.solver_tier_duration.observe(
+                        self.clock() - ts, tier=tier)
+                if attempt + 1 < attempts and not (
+                        deadline is not None and self.clock() >= deadline):
+                    m.solver_retries.inc(tier=tier)
+                    continue
+                break
+            if result is not None:
+                br.record_success()
+                return result[0], result[1], int(result[2]), tier
+            br.record_failure()
+            klog.warning("solver tier %s failed (%s); falling back",
+                         tier, last_err)
+            if i + 1 < len(tiers):
+                m.solver_fallbacks.inc(from_tier=tier, to_tier=tiers[i + 1])
+                res.solver_fallbacks += 1
+            i += 1
+        return None
+
     def _exact_solve(self, dp, dn, ds, dt, base_fr, extra_mask, extra_score):
         """Exact one-shot assignment: device filter+score once, then the
         native Hungarian solver with per-node slot capacities
@@ -941,12 +1174,34 @@ class Scheduler:
         nodes_by_name = {nd.name: nd for nd in self.cache.nodes()}
         em = np.ones(base.shape, bool)
         es = np.zeros(base.shape, np.float32)
+        rc = self.robustness
         for i, pod in interested:
             feasible = [n for n in node_order if base[i, rows[n]]]
             allowed = set(feasible)
             for ext in self.extenders:
                 if not ext.is_interested(pod):
                     continue
+                ename = ext.name() if hasattr(ext, "name") else repr(ext)
+                br = self._breaker(f"extender:{ename}")
+                # degraded mode: an open breaker (the endpoint is known
+                # down) or a blown cycle deadline sheds the call — the
+                # pod schedules on built-in filters alone rather than
+                # failing for as long as the remote is dead
+                shed = (self._cycle_deadline is not None
+                        and self.clock() >= self._cycle_deadline)
+                if shed or not br.allow():
+                    if rc.extender_degrade_to_ignorable:
+                        self.metrics.extender_degraded.inc(extender=ename)
+                        continue
+                    allowed = set()
+                    early_fail[i] = f"Extender:{ename} unavailable"
+                    break
+                # clamp the transport timeout to the remaining cycle
+                # budget (deadline propagation across the HTTP seam)
+                if (self._cycle_deadline is not None
+                        and hasattr(ext, "set_call_budget")):
+                    ext.set_call_budget(
+                        max(self._cycle_deadline - self.clock(), 1e-3))
                 try:
                     names, _failed = ext.filter(
                         pod, [n for n in feasible if n in allowed], nodes_by_name
@@ -955,10 +1210,12 @@ class Scheduler:
                     scores, weight = ext.prioritize(
                         pod, sorted(allowed), nodes_by_name
                     )
+                    br.record_success()
                     for n, sc in scores.items():
                         if n in rows:
                             es[i, rows[n]] += weight * sc
                 except ExtenderError as e:
+                    br.record_failure()
                     if ext.is_ignorable():
                         continue  # skip this extender (extender.go:124)
                     allowed = set()

@@ -1270,11 +1270,17 @@ class HollowCluster:
                 # the admission chain captured the namespaces/priority-
                 # class/quota CONTAINERS at construction (default_chain)
                 # — those must be updated IN PLACE or admission keeps
-                # enforcing against pre-restore state
-                if attr in ("namespaces", "priority_classes"):
+                # enforcing against pre-restore state. Same class:
+                # RBACAuthorizer reads the cluster_roles/-bindings dicts
+                # LIVE and the bootstrap-token authenticator its dict —
+                # an authorizer wired before restore must see post-
+                # restore state, not the fresh hub's empty containers.
+                if attr in ("namespaces", "priority_classes",
+                            "cluster_roles", "bootstrap_tokens"):
                     cur.clear()
                     cur.update(new)
-                elif attr in ("quotas", "pdbs", "limit_ranges"):
+                elif attr in ("quotas", "pdbs", "limit_ranges",
+                              "cluster_role_bindings"):
                     cur[:] = new  # captured-at-construction containers
                 else:
                     setattr(self, attr, new)
@@ -2089,10 +2095,28 @@ class HollowCluster:
         if wants_node_ports:
             # validate explicit picks FIRST (a duplicate raises the
             # apiserver's 'already allocated' 422 analog) so a rejected
-            # create leaks neither a ClusterIP nor earlier ports
-            for p in svc.ports:
-                if p.node_port:
-                    self.nodeport_alloc.reserve(p.node_port)
+            # create leaks neither a ClusterIP nor earlier ports. Ports
+            # reserved before a later one conflicts roll back — the
+            # reference apiserver releases allocations on failed create
+            # — and a port repeated WITHIN the service is the same 422
+            # (it would double-release on delete otherwise).
+            reserved = []
+            seen = set()
+            try:
+                for p in svc.ports:
+                    if p.node_port:
+                        if p.node_port in seen:
+                            raise ValueError(
+                                f"provided node-port range {p.node_port} "
+                                "is already allocated (duplicated within "
+                                "the service)")
+                        seen.add(p.node_port)
+                        self.nodeport_alloc.reserve(p.node_port)
+                        reserved.append(p.node_port)
+            except Exception:
+                for n in reserved:
+                    self.nodeport_alloc.release(n)
+                raise
         if not svc.cluster_ip:
             svc.cluster_ip = self.ip_alloc.allocate()
         else:

@@ -478,3 +478,33 @@ def test_pod_patch_metadata_split_semantics():
         assert hub.truth_pods["default/p0"].labels == {"app": "web"}
     finally:
         srv.close()
+
+
+def test_pod_patch_guard_matches_path_segments_not_substrings():
+    """ADVICE r5 low (restapi PATCH foreign-key guard): an unmodeled
+    field whose NAME merely contains a guarded token as a substring
+    ('volumesAttached', 'hostPorts' under status) keeps the documented
+    lenient drop-as-POST-dropped behavior — only exact dotted-path
+    segments ('volumes', 'ports') still 422."""
+    from tests.test_restapi import make_pod_doc
+
+    hub, srv, port = cluster()
+    try:
+        req(port, "POST", "/api/v1/namespaces/default/pods",
+            make_pod_doc("p0"))
+        # substring-only collisions: lenient no-op, like POST dropped them
+        for patch in (
+            {"status": {"volumesAttached": [{"name": "pv0"}]}},
+            {"spec": {"hostPorts": [8080]}},
+        ):
+            code, doc = patch_req(
+                port, "/api/v1/namespaces/default/pods/p0", patch)
+            assert code == 200, (patch, code, doc)
+        # exact guarded segment still rejects
+        code, doc = patch_req(
+            port, "/api/v1/namespaces/default/pods/p0",
+            {"spec": {"volumes": [{"persistentVolumeClaim":
+                                   {"claimName": "c"}}]}})
+        assert code == 422, (code, doc)
+    finally:
+        srv.close()

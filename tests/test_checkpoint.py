@@ -261,3 +261,43 @@ class EvilPayload:
         import os
 
         return (os.system, ("true",))
+
+
+def test_restore_updates_rbac_and_token_containers_in_place(tmp_path):
+    """ADVICE r5 low (sim.py restore_checkpoint): RBACAuthorizer reads
+    the hub's role/binding containers LIVE — an authorizer (and a
+    bootstrap-token authenticator) wired BEFORE restore must see
+    post-restore state, exactly like the admission chain's namespaces/
+    quota containers."""
+    from kubernetes_tpu.auth import (
+        ALLOW,
+        Attributes,
+        ClusterRole,
+        ClusterRoleBinding,
+        PolicyRule,
+        RBACAuthorizer,
+        UserInfo,
+    )
+
+    hub = HollowCluster(seed=61, scheduler_kw={"enable_preemption": False})
+    hub.cluster_roles["pods-reader"] = ClusterRole(
+        "pods-reader",
+        rules=[PolicyRule(verbs=("get",), resources=("pods",))])
+    hub.cluster_role_bindings.append(
+        ClusterRoleBinding(role="pods-reader", subjects=("devs",)))
+    path = str(tmp_path / "rbac.ckpt")
+    hub.save_checkpoint(path)
+
+    cold = HollowCluster(seed=62, scheduler_kw={"enable_preemption": False})
+    # wired BEFORE restore, against the fresh hub's (empty) live dicts
+    authz = RBACAuthorizer(cold.cluster_roles, cold.cluster_role_bindings)
+    attrs = Attributes(user=UserInfo(name="alice", groups=("devs",)),
+                       verb="get", resource="pods", namespace="default",
+                       name="", path="")
+    assert authz.authorize(attrs) != ALLOW  # nothing restored yet
+    cold.restore_checkpoint(path)
+    # the SAME authorizer sees the restored roles/bindings (in-place
+    # clear()/update() and [:], not container replacement)
+    assert authz.authorize(attrs) == ALLOW
+    assert cold.cluster_roles is authz.roles
+    assert cold.cluster_role_bindings is authz.bindings

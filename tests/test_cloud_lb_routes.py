@@ -166,3 +166,26 @@ def test_rc_and_rs_same_name_do_not_collide():
     # only the RC's pods cascaded
     assert all(k in hub.truth_pods for k in rs_pods)
     assert not any(k in hub.truth_pods for k in rc_pods)
+
+
+def test_cluster_scoped_node_events_carry_empty_namespace():
+    """ADVICE r5 low (cloud.py): events about cluster-scoped Nodes must
+    record an EMPTY involvedObject.namespace (the reference's shape for
+    cluster-scoped involved objects), not a fabricated 'default' — so
+    involvedObject.namespace field selectors match kubectl expectations."""
+    from kubernetes_tpu.api.selectors import event_fields
+
+    hub, cloud = _cloud_hub()
+    cloud.fail_routes = True
+    hub.step()
+    hub.step()
+    evs = [(k, ev) for k, ev in hub.events_v1.items()
+           if ev.reason == "FailedToCreateRoute"]
+    assert evs
+    for key, ev in evs:
+        assert ev.involved_kind == "Node"
+        ns, _, name = ev.object_key.partition("/")
+        assert ns == "" and name in hub.truth_nodes
+        fields = event_fields(key, ev)
+        assert fields["involvedObject.namespace"] == ""
+        assert fields["involvedObject.name"] == name

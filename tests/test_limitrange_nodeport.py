@@ -103,3 +103,45 @@ def test_duplicate_explicit_nodeport_rejected():
                             ports=(ServicePort(port=80,
                                                node_port=30500),)))
     assert hub.services["default/c"].ports[0].node_port == 30500
+
+
+def test_multiport_nodeport_conflict_rolls_back_earlier_reservations():
+    """ADVICE r5 medium (sim.py add_service): a multi-port service whose
+    LATER port conflicts must release the ports it reserved before the
+    failure — the reference apiserver releases allocations on failed
+    create; leaking 30200 here would poison every future service that
+    picks it."""
+    hub = HollowCluster(seed=97, scheduler_kw={"enable_preemption": False})
+    hub.add_service(Service("a", selector={"x": "1"}, type="NodePort",
+                            ports=(ServicePort(port=80,
+                                               node_port=30100),)))
+    with pytest.raises(ValueError):
+        hub.add_service(Service("b", selector={"x": "2"}, type="NodePort",
+                                ports=(ServicePort(port=80,
+                                                   node_port=30200),
+                                       ServicePort(port=443,
+                                                   node_port=30100))))
+    assert "default/b" not in hub.services
+    # 30200 was rolled back: a fresh service reserves it cleanly
+    hub.add_service(Service("c", selector={"x": "3"}, type="NodePort",
+                            ports=(ServicePort(port=80,
+                                               node_port=30200),)))
+    assert hub.services["default/c"].ports[0].node_port == 30200
+
+
+def test_nodeport_duplicated_within_service_rejected_without_leak():
+    """Two ports of ONE service naming the same nodePort is the same
+    'already allocated' 422 (silent sharing would double-release on
+    delete) — and the rejected create leaks nothing."""
+    hub = HollowCluster(seed=96, scheduler_kw={"enable_preemption": False})
+    with pytest.raises(ValueError):
+        hub.add_service(Service("d", selector={"x": "4"}, type="NodePort",
+                                ports=(ServicePort(port=80,
+                                                   node_port=30300),
+                                       ServicePort(port=443,
+                                                   node_port=30300))))
+    assert "default/d" not in hub.services
+    hub.add_service(Service("e", selector={"x": "5"}, type="NodePort",
+                            ports=(ServicePort(port=80,
+                                               node_port=30300),)))
+    assert hub.services["default/e"].ports[0].node_port == 30300

@@ -962,3 +962,25 @@ def test_namespace_validation_protection_and_full_drain():
         hub.check_consistency()
     finally:
         srv.close()
+
+
+def test_endpoints_with_no_addresses_serialize_empty_subsets():
+    """ADVICE r5 low: an Endpoints whose address lists are both empty
+    must emit ``subsets: []`` — the reference never publishes a subset
+    with no addresses (a selector-matching Service with zero ready pods
+    shows an empty-subsets Endpoints, not a husk subset)."""
+    from kubernetes_tpu.proxy import Service, ServicePort
+
+    hub = HollowCluster(seed=55, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    try:
+        hub.add_service(Service("lonely", selector={"app": "nobody"},
+                                ports=(ServicePort(port=80),)))
+        hub.step()
+        code, doc = req(port, "GET",
+                        "/api/v1/namespaces/default/endpoints")
+        assert code == 200
+        items = {i["metadata"]["name"]: i for i in doc["items"]}
+        assert items["lonely"]["subsets"] == []
+    finally:
+        srv.close()
