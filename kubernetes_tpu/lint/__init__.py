@@ -1,0 +1,65 @@
+"""graftlint — AST-based tracer-safety / determinism / host-sync linter.
+
+The jit-compiled ops layer only surfaces tracer leaks, host↔device syncs
+and retrace storms at runtime, on the shapes a test happened to exercise.
+graftlint moves those checks to parse time: a cross-file jit call graph
+decides which functions run under tracing, an interprocedural taint pass
+decides which values are traced there, and six rule classes (R1–R6, plus
+R0 suppression hygiene) turn the hazards into findings a tier-1 test can
+enforce.
+
+Rule classes
+------------
+
+==== =================================================================
+R0   suppression hygiene — every inline disable needs a justification
+R1   tracer-unsafe Python in jit-compiled code (``if``/``while``/
+     ``bool()``/``int()``/``float()``/``.item()``/iteration on traced)
+R2   host↔device sync in hot paths (``np.asarray``/``np.array``/
+     ``device_get``/``.item()`` inside the per-cycle solve loop)
+R3   retrace hazards (``jax.jit`` constructed per call; bogus
+     ``static_argnames``)
+R4   non-determinism (bare ``random.*``/``np.random.*`` global state,
+     ``time.time()``, argless ``datetime.now()``)
+R5   dtype drift (float64 in device-math modules)
+R6   Py3.10 f-string backslash (the seed-breaking SyntaxError class)
+==== =================================================================
+
+Suppression forms (justification after ``--`` is mandatory, R0-checked)::
+
+    x = np.asarray(dev)  # graftlint: disable=R2 -- deliberate readback
+    # graftlint: disable=R4 -- wall time is the payload here
+    stamp = time.time()
+    # graftlint: disable-scope=R2 -- host oracle: CPU math by design
+    def _exact_solve(...): ...
+
+Programmatic entry points: :func:`run_lint` (paths → findings) and
+:func:`lint_source` (one source string → findings, used by
+``kubernetes_tpu.testing.lint_clean``).
+"""
+
+from kubernetes_tpu.lint.engine import (
+    Finding,
+    Project,
+    lint_source,
+    run_lint,
+)
+from kubernetes_tpu.lint.report import (
+    load_baseline,
+    render_json,
+    render_text,
+    subtract_baseline,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "Project",
+    "lint_source",
+    "run_lint",
+    "load_baseline",
+    "render_json",
+    "render_text",
+    "subtract_baseline",
+    "write_baseline",
+]

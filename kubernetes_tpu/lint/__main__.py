@@ -1,0 +1,106 @@
+"""graftlint CLI — ``python -m kubernetes_tpu.lint [paths...]``.
+
+Exit codes: 0 clean (after suppressions + baseline), 1 findings, 2 usage
+error. Tier-1 runs this (via tests/test_static_analysis.py) with the
+committed baseline, so `exit 0` here is a merge gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from kubernetes_tpu.lint.engine import RULE_IDS, run_lint
+from kubernetes_tpu.lint.report import (
+    load_baseline,
+    render_json,
+    render_text,
+    subtract_baseline,
+    write_baseline,
+)
+
+DEFAULT_PATHS = ("kubernetes_tpu/", "scripts/", "tests/")
+DEFAULT_BASELINE = ".graftlint-baseline.json"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.lint",
+        description="AST-based tracer-safety / determinism / host-sync "
+                    "linter for the jax_graft scheduler (rules R0-R6; see "
+                    "docs/lint.md).",
+    )
+    parser.add_argument("paths", nargs="*", default=None,
+                        help=f"files/dirs to lint (default: {' '.join(DEFAULT_PATHS)})")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--select", default=None, metavar="R1,R2",
+                        help="comma-separated rule ids to run (default: all)")
+    parser.add_argument("--baseline", default=None, metavar="FILE",
+                        help="baseline JSON of grandfathered findings "
+                             f"(default: {DEFAULT_BASELINE} if present)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline file")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline from current findings "
+                             "and exit 0")
+    parser.add_argument("--root", default=None,
+                        help="path findings are reported relative to "
+                             "(default: cwd)")
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.root or os.getcwd())
+    if args.paths:
+        # an explicitly named path that doesn't exist is a usage error,
+        # not a clean run — a typo'd path in CI must fail the gate loudly
+        missing = [p for p in args.paths if not os.path.exists(p)]
+        if missing:
+            print(f"graftlint: path(s) do not exist: {' '.join(missing)}",
+                  file=sys.stderr)
+            return 2
+        paths = args.paths
+    else:
+        paths = [p for p in
+                 (os.path.join(root, d) for d in DEFAULT_PATHS)
+                 if os.path.exists(p)]
+    if not paths:
+        print("graftlint: no existing paths to lint", file=sys.stderr)
+        return 2
+
+    select = None
+    if args.select:
+        select = [s.strip().upper() for s in args.select.split(",") if s.strip()]
+        bad = [s for s in select if s not in RULE_IDS]
+        if bad:
+            print(f"graftlint: unknown rule id(s) {bad}; known: "
+                  f"{', '.join(RULE_IDS)}", file=sys.stderr)
+            return 2
+
+    findings = run_lint(paths, root=root, select=select)
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    if args.write_baseline:
+        write_baseline(findings, baseline_path)
+        print(f"graftlint: wrote {len(findings)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    baselined = 0
+    if not args.no_baseline and os.path.exists(baseline_path):
+        try:
+            baseline = load_baseline(baseline_path)
+        except ValueError as e:
+            print(f"graftlint: {e}", file=sys.stderr)
+            return 2
+        findings, baselined = subtract_baseline(findings, baseline)
+
+    if args.format == "json":
+        sys.stdout.write(render_json(findings, baselined))
+    else:
+        print(render_text(findings, baselined))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

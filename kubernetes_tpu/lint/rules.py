@@ -1,0 +1,980 @@
+"""graftlint rule implementations.
+
+R1 is the deep one: a cross-file, interprocedural taint pass that starts
+from every jit root's non-static parameters and follows values through
+assignments, pytree field access and first-party call edges, flagging
+the Python constructs whose *truthiness/host conversion* a tracer cannot
+survive. The other rules are syntactic scans scoped by the same jit call
+graph (R2) or by file class (R4/R5) — cheap by design so tier-1 can
+afford to run the whole thing on every change.
+
+Taint lattice: ``None < "pytree" < "maybe" < "array"``.
+
+- ``"array"`` — definitely a traced array (jnp/jax result, field access
+  on a traced bundle). Everything flags: truthiness, conversion,
+  iteration, membership.
+- ``"maybe"`` — unknown (unannotated parameter, element of a mixed
+  container, opaque call result). Truthiness and conversions flag;
+  iteration does not — iterating a NamedTuple of tracers
+  (``DevicePods(*[f(x) for x in pods])``) is legal and common.
+- ``"pytree"`` — definitely a container of traced leaves (dict/tuple
+  literal, ``dict()``-family ctor). Containers have host truthiness, so
+  only element access re-taints.
+
+Parameter type annotations refine the entry kind: ``x: jnp.ndarray`` →
+array, ``hoisted: Dict[...] | None`` → pytree, ``reverse: bool`` /
+``name: str`` → host (annotated bools/strs are trace-time constants in
+this codebase — jit would have to be told they're static anyway).
+Comparisons against string constants are host metadata checks
+(``kind == "full"``) and never taint.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from kubernetes_tpu.lint.engine import (
+    RULE_IDS,
+    FileInfo,
+    Finding,
+    FuncRecord,
+    Project,
+    dotted_name,
+    register_rule,
+    resolve_dotted,
+)
+
+# --- taint lattice ---------------------------------------------------------
+
+_ORDER = {None: 0, "pytree": 1, "maybe": 2, "array": 3}
+
+#: kinds whose truthiness / host conversion a tracer cannot survive
+_HAZARD_KINDS = ("maybe", "array")
+
+
+def _join(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    return a if _ORDER[a] >= _ORDER[b] else b
+
+
+#: annotation leaf name -> entry taint kind. None means host value
+#: (trusted untraced); absent leaves mean "maybe".
+_ANNOTATION_KINDS = {
+    "ndarray": "array", "array": "array", "jaxarray": "array",
+    "arraylike": "array",
+    "dict": "pytree", "mapping": "pytree", "defaultdict": "pytree",
+    "list": "pytree", "tuple": "pytree", "sequence": "pytree",
+    "set": "pytree", "frozenset": "pytree", "iterable": "pytree",
+    "bool": None, "str": None, "bytes": None, "callable": None,
+    "none": None, "nonetype": None,
+}
+
+
+def _annotation_kind(ann: Optional[ast.expr]) -> Tuple[Optional[str], bool]:
+    """(entry kind, recognized?) for a parameter annotation. Optional[X]
+    and ``X | None`` unwrap to X; unions join their parts."""
+    if ann is None:
+        return "maybe", False
+    if isinstance(ann, ast.Constant):
+        if ann.value is None:  # the `| None` / Optional member
+            return None, True
+        if isinstance(ann.value, str):  # string annotation
+            leaf = ann.value.split("[")[0].split(".")[-1].strip().lower()
+            if leaf in _ANNOTATION_KINDS:
+                return _ANNOTATION_KINDS[leaf], True
+        return "maybe", False
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        k1, r1 = _annotation_kind(ann.left)
+        k2, r2 = _annotation_kind(ann.right)
+        if r1 and r2:
+            return _join(k1, k2), True
+        # `DevicePods | None`: an unrecognized union member means the
+        # value can be anything — do not let the recognized side pin it
+        return "maybe", False
+    if isinstance(ann, ast.Subscript):
+        base = dotted_name(ann.value)
+        leaf = (base or "").split(".")[-1].lower()
+        if leaf in ("optional", "union"):
+            parts = (ann.slice.elts if isinstance(ann.slice, ast.Tuple)
+                     else [ann.slice])
+            kind: Optional[str] = None
+            recognized = True
+            for p in parts:
+                k, r = _annotation_kind(p)
+                recognized &= r
+                kind = _join(kind, k)
+            return (kind, True) if recognized else ("maybe", False)
+        return _annotation_kind(ann.value)
+    name = dotted_name(ann)
+    if name is not None:
+        leaf = name.split(".")[-1].lower()
+        if leaf in _ANNOTATION_KINDS:
+            return _ANNOTATION_KINDS[leaf], True
+    return "maybe", False
+
+
+def _param_pins(rec: FuncRecord) -> Dict[str, Tuple[Optional[str], bool]]:
+    """Per-parameter (annotation kind, recognized) for a function."""
+    a = rec.node.args
+    return {p.arg: _annotation_kind(p.annotation)
+            for p in a.posonlyargs + a.args + a.kwonlyargs}
+
+
+#: attribute projections of a tracer that are plain host values (safe to
+#: branch on): the static trace-time metadata
+STATIC_ATTRS = {
+    "shape", "dtype", "ndim", "size", "itemsize", "nbytes", "weak_type",
+    "aval", "sharding", "dims",
+}
+
+#: builtins whose result is a host value even on traced input
+_HOST_RESULT_CALLS = {
+    "len", "range", "isinstance", "issubclass", "type", "id", "repr",
+    "str", "callable", "print", "format", "hasattr",
+}
+
+#: conversions that force a concrete value out of a tracer (R1)
+_CONVERSIONS = {"bool", "int", "float", "complex"}
+
+#: method names that pull device values to host (R1 in jit, R2 in hot host)
+_SYNC_METHODS = {"item", "tolist"}
+
+#: call targets that read a whole device buffer back (R2)
+_SYNC_CALLS = {
+    "numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
+    "jax.device_get",
+}
+
+#: host functions that form the per-cycle solve loop (R2 hot scope) in
+#: addition to every jit-context function. schedule_cycle itself is the
+#: documented host boundary (results must come back to bind) and is
+#: deliberately NOT in this set — see docs/lint.md.
+HOT_FUNC_NAMES = {
+    "Scheduler._run_tier", "Scheduler._solve_ladder", "Scheduler._exact_solve",
+    "validate_solution", "greedy_assign", "batch_assign",
+}
+
+#: one-line rule summaries (lint_report / docs surface these)
+RULE_SUMMARIES = {
+    "R0": "suppression hygiene: every disable needs a justification",
+    "R1": "tracer-unsafe Python in jit-compiled code",
+    "R2": "host-device sync inside the per-cycle solve loop",
+    "R3": "retrace hazards (jit-per-call, bogus static_argnames)",
+    "R4": "non-determinism (global RNG, wall clock, argless now())",
+    "R5": "dtype drift: float64 in device-math modules",
+    "R6": "syntax gate: Py3.10 f-string backslash / parse errors",
+}
+
+#: modules whose arrays must stay float32 (R5): the device-math layer
+#: plus the host oracles that feed it
+_DTYPE_SCOPE_MARKERS = ("/ops/", "/parallel/")
+_DTYPE_SCOPE_FILES = ("native.py",)
+
+_F64_ATTRS = {
+    "numpy.float64", "numpy.double", "numpy.float128", "numpy.longdouble",
+    "numpy.complex128", "jax.numpy.float64", "jax.numpy.complex128",
+}
+
+
+# ==========================================================================
+# R1 — tracer safety (interprocedural taint)
+# ==========================================================================
+
+class _FnAnalysis:
+    """Analyze one function under a parameter-taint assignment.
+
+    Flow-sensitive single-environment walk. Loop bodies are walked
+    twice so loop-carried taint settles (`a = x` at the bottom of the
+    body reaches an `if a:` at the top on the second walk); the hazard
+    dict is keyed by (line, col, message), so re-walks never duplicate
+    findings. Nested defs/lambdas are walked inline with their
+    parameters tainted "maybe" (annotation-refined) — inside a jit trace
+    they are almost always scan/while/cond callbacks receiving tracers.
+    """
+
+    def __init__(self, rec: FuncRecord, param_taint: Dict[str, Optional[str]],
+                 project: Project) -> None:
+        self.rec = rec
+        self.fi = rec.file
+        self.project = project
+        self.param_taint = {k: v for k, v in param_taint.items() if v}
+        self.env: Dict[str, Optional[str]] = {}
+        self.calls: Dict[str, Dict[str, str]] = {}  # callee qual -> param taint
+        self.callee_recs: Dict[str, FuncRecord] = {}
+        self.hazards: Dict[Tuple[int, int, str], Finding] = {}
+        self.collect = False
+
+    # -- driver --
+
+    def run(self, collect: bool) -> None:
+        self.collect = collect
+        self.env = dict(self.param_taint)
+        for stmt in self.rec.node.body:
+            self.stmt(stmt)
+
+    def findings(self) -> List[Finding]:
+        return [self.hazards[k] for k in sorted(self.hazards)]
+
+    def _flag(self, node: ast.AST, message: str) -> None:
+        if not self.collect:
+            return
+        key = (node.lineno, node.col_offset, message)
+        self.hazards[key] = self.fi.finding(
+            node, "R1", f"{message} in jit-compiled `{self.rec.name}`"
+        )
+
+    # -- statements --
+
+    def stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = node.value
+            kind = self.eval(value) if value is not None else None
+            if isinstance(node, ast.AugAssign):
+                kind = _join(kind, self.eval_target_as_expr(node.target))
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                self.bind(t, kind)
+        elif isinstance(node, (ast.Expr, ast.Return)):
+            if node.value is not None:
+                self.eval(node.value)
+        elif isinstance(node, ast.If):
+            self.truthiness(node.test, "`if` branch on traced value")
+            for s in node.body + node.orelse:
+                self.stmt(s)
+        elif isinstance(node, ast.While):
+            self.truthiness(node.test, "`while` condition on traced value")
+            for _ in range(2):
+                for s in node.body:
+                    self.stmt(s)
+                # the condition re-runs on loop-carried taint
+                self.truthiness(node.test,
+                                "`while` condition on traced value")
+            for s in node.orelse:
+                self.stmt(s)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            k = self.eval(node.iter)
+            if k == "array":
+                self._flag(node.iter, "iteration over a traced array "
+                                      "(use lax.scan / lax.fori_loop)")
+            for _ in range(2):
+                self.bind(node.target,
+                          "array" if k == "array" else ("maybe" if k else None))
+                for s in node.body:
+                    self.stmt(s)
+            for s in node.orelse:
+                self.stmt(s)
+        elif isinstance(node, ast.Match):
+            k = self.eval(node.subject)
+            if k in _HAZARD_KINDS:
+                self._flag(node.subject,
+                           "`match` on a traced value (pattern matching "
+                           "concretizes the tracer — use lax.switch)")
+            for case in node.cases:
+                self._bind_pattern(case.pattern,
+                                   "maybe" if k in _HAZARD_KINDS else None)
+                if case.guard is not None:
+                    self.truthiness(case.guard, "`case` guard on traced value")
+                for s in case.body:
+                    self.stmt(s)
+        elif isinstance(node, ast.Assert):
+            self.truthiness(node.test, "`assert` on traced value")
+            if node.msg is not None:
+                self.eval(node.msg)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                k = self.eval(item.context_expr)
+                if item.optional_vars is not None:
+                    self.bind(item.optional_vars, k)
+            for s in node.body:
+                self.stmt(s)
+        elif isinstance(node, ast.Try):
+            for s in node.body + node.orelse + node.finalbody:
+                self.stmt(s)
+            for h in node.handlers:
+                for s in h.body:
+                    self.stmt(s)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                kind, known = _annotation_kind(p.annotation)
+                self.env[p.arg] = kind if known else "maybe"
+            for s in node.body:
+                self.stmt(s)
+            self.env[node.name] = None
+        elif isinstance(node, ast.ClassDef):
+            for s in node.body:
+                self.stmt(s)
+        elif isinstance(node, (ast.Delete,)):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.env[t.id] = None
+        # Pass/Break/Continue/Import/Global/Nonlocal/Raise: nothing to do
+        elif isinstance(node, ast.Raise):
+            if node.exc is not None:
+                self.eval(node.exc)
+
+    def _bind_pattern(self, pat: ast.pattern, kind: Optional[str]) -> None:
+        """Bind capture names of a match-case pattern; destructuring a
+        traced subject yields traced pieces."""
+        if isinstance(pat, ast.MatchAs):
+            if pat.pattern is not None:
+                self._bind_pattern(pat.pattern, kind)
+            if pat.name:
+                self.env[pat.name] = kind
+        elif isinstance(pat, ast.MatchStar):
+            if pat.name:
+                self.env[pat.name] = kind
+        elif isinstance(pat, ast.MatchMapping):
+            for p in pat.patterns:
+                self._bind_pattern(p, kind)
+            if pat.rest:
+                self.env[pat.rest] = kind
+        elif isinstance(pat, (ast.MatchSequence, ast.MatchOr)):
+            for p in pat.patterns:
+                self._bind_pattern(p, kind)
+        elif isinstance(pat, ast.MatchClass):
+            for p in list(pat.patterns) + list(pat.kwd_patterns):
+                self._bind_pattern(p, kind)
+        elif isinstance(pat, ast.MatchValue):
+            self.eval(pat.value)
+
+    def bind(self, target: ast.AST, kind: Optional[str]) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = kind
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elt_kind = kind if kind is None else (
+                "array" if kind == "array" else "maybe"
+            )
+            for e in target.elts:
+                self.bind(e, elt_kind)
+        elif isinstance(target, ast.Starred):
+            self.bind(target.value, "maybe" if kind else None)
+        # Attribute/Subscript targets mutate containers: no new name taint
+
+    def eval_target_as_expr(self, target: ast.AST) -> Optional[str]:
+        if isinstance(target, ast.Name):
+            return self.env.get(target.id)
+        return None
+
+    # -- truthiness contexts --
+
+    def truthiness(self, test: ast.expr, message: str) -> None:
+        if isinstance(test, ast.Compare) and all(
+            isinstance(o, (ast.Is, ast.IsNot)) for o in test.ops
+        ):
+            # `x is None` never calls __bool__ on a tracer — the blessed
+            # Optional-arg branch form
+            for v in [test.left] + test.comparators:
+                self.eval(v)
+            return
+        if isinstance(test, ast.BoolOp):
+            for v in test.values:
+                self.truthiness(v, message)
+            return
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            self.truthiness(test.operand, message)
+            return
+        k = self.eval(test)
+        if k in _HAZARD_KINDS:
+            self._flag(test, message + " (use jnp.where / lax.cond)")
+
+    # -- expressions --
+
+    def eval(self, node: ast.expr) -> Optional[str]:  # noqa: C901
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, ast.Constant):
+            return None
+        if isinstance(node, ast.Attribute):
+            base = self.eval(node.value)
+            if node.attr in STATIC_ATTRS:
+                return None
+            if base in _HAZARD_KINDS:
+                return "array"
+            return "maybe" if base else None
+        if isinstance(node, ast.Subscript):
+            base = self.eval(node.value)
+            self.eval(node.slice)
+            if base == "array":
+                return "array"
+            return "maybe" if base else None
+        if isinstance(node, ast.Call):
+            return self.eval_call(node)
+        if isinstance(node, ast.BinOp):
+            return _join(self.eval(node.left), self.eval(node.right))
+        if isinstance(node, ast.UnaryOp):
+            k = self.eval(node.operand)
+            if isinstance(node.op, ast.Not):
+                if k in _HAZARD_KINDS:
+                    self._flag(node, "`not` on traced value")
+                return None
+            return k
+        if isinstance(node, ast.BoolOp):
+            # `a and b` outside an `if` still calls bool(a)
+            out: Optional[str] = None
+            for i, v in enumerate(node.values):
+                k = self.eval(v)
+                if k in _HAZARD_KINDS and i < len(node.values) - 1:
+                    self._flag(v, "`and`/`or` short-circuit on traced value")
+                out = _join(out, k)
+            return out
+        if isinstance(node, ast.Compare):
+            operands = [node.left] + list(node.comparators)
+            kinds = [self.eval(v) for v in operands]
+            if all(isinstance(o, (ast.Is, ast.IsNot)) for o in node.ops):
+                return None
+            if any(isinstance(v, ast.Constant) and isinstance(v.value, str)
+                   for v in operands):
+                # comparing against a string constant is a host metadata
+                # check (`kind == "full"`) — arrays don't compare to str
+                return None
+            if any(kinds) and len(node.ops) > 1:
+                self._flag(node, "chained comparison on traced values "
+                                 "(implicit `and` calls bool())")
+            if "array" in kinds and any(isinstance(o, (ast.In, ast.NotIn))
+                                        for o in node.ops):
+                self._flag(node, "membership test on traced value")
+            if "array" in kinds:
+                return "array"
+            return "maybe" if "maybe" in kinds else None
+        if isinstance(node, ast.IfExp):
+            self.truthiness(node.test, "conditional expression on traced value")
+            return _join(self.eval(node.body), self.eval(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            kinds = [self.eval(e) for e in node.elts]
+            return "pytree" if any(kinds) else None
+        if isinstance(node, ast.Dict):
+            kinds = [self.eval(v) for v in node.values if v is not None]
+            kinds += [self.eval(k) for k in node.keys if k is not None]
+            return "pytree" if any(kinds) else None
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            self._comp_generators(node.generators)
+            k = self.eval(node.elt)
+            return "pytree" if k else None
+        if isinstance(node, ast.DictComp):
+            self._comp_generators(node.generators)
+            k = _join(self.eval(node.key), self.eval(node.value))
+            return "pytree" if k else None
+        if isinstance(node, ast.Lambda):
+            a = node.args
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                self.env[p.arg] = "maybe"
+            self.eval(node.body)
+            return None
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value)
+        if isinstance(node, ast.NamedExpr):
+            k = self.eval(node.value)
+            self.bind(node.target, k)
+            return k
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                self.eval(v)
+            return None
+        if isinstance(node, ast.FormattedValue):
+            self.eval(node.value)
+            return None
+        if isinstance(node, (ast.Await, ast.YieldFrom)):
+            return self.eval(node.value)
+        if isinstance(node, ast.Yield):
+            return self.eval(node.value) if node.value else None
+        if isinstance(node, ast.Slice):
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    self.eval(part)
+            return None
+        return None
+
+    def _comp_generators(self, gens) -> None:
+        for g in gens:
+            k = self.eval(g.iter)
+            if k == "array":
+                self._flag(g.iter, "iteration over a traced array "
+                                   "(use lax.scan / jnp ops)")
+            self.bind(g.target,
+                      "array" if k == "array" else ("maybe" if k else None))
+            for cond in g.ifs:
+                self.truthiness(cond, "comprehension filter on traced value")
+
+    def eval_call(self, node: ast.Call) -> Optional[str]:
+        arg_kinds = [self.eval(a) for a in node.args]
+        kw_kinds = {kw.arg: self.eval(kw.value) for kw in node.keywords}
+        all_kinds = arg_kinds + list(kw_kinds.values())
+        any_taint = any(all_kinds)
+        hazard_arg = any(k in _HAZARD_KINDS for k in all_kinds)
+        name = dotted_name(node.func)
+        full = resolve_dotted(name, self.fi.imports)
+
+        # self.meth(...) / cls.meth(...): resolve within the enclosing
+        # class and thread argument taint through like any first-party
+        # call — without this, interprocedural R1/R2 stops dead at every
+        # method boundary of class-structured jit code
+        if (isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in ("self", "cls")
+                and "." in self.rec.name):
+            cls_prefix = self.rec.name.rsplit(".", 1)[0] + "."
+            meth = self.fi.functions.get(cls_prefix + node.func.attr)
+            if meth is not None:
+                recv = self.env.get(node.func.value.id)
+                taints: Dict[str, str] = {}
+                if recv and meth.params:
+                    taints[meth.params[0]] = recv  # receiver slot
+                for i, k in enumerate(arg_kinds):
+                    if (k and i + 1 < len(meth.params)
+                            and not any(isinstance(a, ast.Starred)
+                                        for a in node.args[: i + 1])):
+                        taints[meth.params[i + 1]] = k
+                for kwname, k in kw_kinds.items():
+                    if k and kwname and kwname in meth.params:
+                        taints[kwname] = k
+                if taints:
+                    merged = self.calls.setdefault(meth.qual, {})
+                    for p, k in taints.items():
+                        merged[p] = _join(merged.get(p), k) or k
+                    self.callee_recs[meth.qual] = meth
+                return "maybe" if (any_taint or recv) else None
+
+        # method-style: base.method(...)
+        if isinstance(node.func, ast.Attribute):
+            base = self.eval(node.func.value)
+            if node.func.attr in _SYNC_METHODS and base in _HAZARD_KINDS:
+                self._flag(node, f"`.{node.func.attr}()` forces a traced "
+                                 "value to host")
+                return None
+            if base in _HAZARD_KINDS:
+                return "array"
+            if base == "pytree":
+                # dict/tuple methods (.items(), .get(), .keys()) return
+                # host iterables whose elements may be traced
+                return "maybe"
+
+        if full in _HOST_RESULT_CALLS:
+            return None
+        if full in _CONVERSIONS:
+            if hazard_arg:
+                self._flag(node, f"`{full}()` on a traced value")
+            return None
+        if full in ("dict", "list", "tuple", "set", "frozenset", "sorted",
+                    "reversed", "zip", "enumerate"):
+            return "pytree" if any_taint else None
+        if full and (full.startswith("jax.") or full.startswith("numpy.")
+                     or full == "jax"):
+            # higher-order transforms (lax.scan/while_loop/cond, vmap, …)
+            # trace their callbacks: a first-party function passed by name
+            # into ANY jax call runs with traced parameters
+            for a in node.args:
+                if isinstance(a, (ast.Name, ast.Attribute)):
+                    cb = self.project.resolve_name(dotted_name(a), self.fi)
+                    if cb is not None and cb.params:
+                        merged = self.calls.setdefault(cb.qual, {})
+                        for p in cb.params:
+                            merged.setdefault(p, "maybe")
+                        self.callee_recs[cb.qual] = cb
+            # numpy on tracers raises/constant-folds; R2 reports the sync
+            # aspect, taint-wise the result is device-shaped either way
+            return "array" if any_taint else None
+
+        callee = self.project.resolve_call(node, self.fi)
+        if callee is not None:
+            taints: Dict[str, str] = {}
+            for i, k in enumerate(arg_kinds):
+                if k and not any(isinstance(a, ast.Starred)
+                                 for a in node.args[: i + 1]):
+                    if i < len(callee.params):
+                        taints[callee.params[i]] = k
+            for kwname, k in kw_kinds.items():
+                if k and kwname and kwname in callee.params:
+                    taints[kwname] = k
+            if taints:
+                merged = self.calls.setdefault(callee.qual, {})
+                for p, k in taints.items():
+                    merged[p] = _join(merged.get(p), k) or k
+                self.callee_recs[callee.qual] = callee
+        return "maybe" if any_taint else None
+
+
+#: test hook: when set, overrides the computed fixpoint iteration budget
+_FIXPOINT_LIMIT: Optional[int] = None
+
+
+def _jit_taint_state(project: Project) -> Dict[str, Tuple[FuncRecord, Dict[str, str]]]:
+    """Fixed-point interprocedural propagation from jit roots. Returns
+    qual -> (record, param taints) for every function that runs in jit
+    context. Cached on the project (R1 and R2 share it)."""
+    cached = getattr(project, "_graftlint_jit_state", None)
+    if cached is not None:
+        return cached
+    state: Dict[str, Tuple[FuncRecord, Dict[str, str]]] = {}
+    work: deque = deque()
+    for rec in project.jit_roots():
+        pins = _param_pins(rec)
+        taint = {}
+        for p in rec.params:
+            if p in rec.static_params:
+                continue
+            kind, known = pins.get(p, ("maybe", False))
+            kind = kind if known else "maybe"
+            if kind:
+                taint[p] = kind
+        state[rec.qual] = (rec, taint)
+        work.append(rec.qual)
+    # monotone 4-level lattice: each function re-enters the worklist at
+    # most a few times, so pops are bounded by ~levels × call edges. The
+    # guard only exists to catch an analysis bug — tripping it must be
+    # LOUD, never a silent truncation of R1/R2 coverage that lets the
+    # tier-1 gate pass with unanalyzed functions
+    guard = 0
+    guard_limit = _FIXPOINT_LIMIT or max(
+        2000, 8 * sum(len(fi.functions) for fi in project.files)
+    )
+    while work:
+        guard += 1
+        if guard > guard_limit:
+            raise RuntimeError(
+                f"graftlint: interprocedural taint fixpoint exceeded "
+                f"{guard_limit} iterations (still {len(work)} pending) — "
+                "analysis bug or pathological call graph; refusing to "
+                "report partial R1/R2 coverage as clean"
+            )
+        qual = work.popleft()
+        rec, taint = state[qual]
+        an = _FnAnalysis(rec, dict(taint), project)
+        an.run(collect=False)
+        for callee_qual, ptaints in an.calls.items():
+            callee = an.callee_recs[callee_qual]
+            pins = _param_pins(callee)
+            if callee.jit_root:
+                # statics of a root stay static even when inline-traced
+                ptaints = {p: k for p, k in ptaints.items()
+                           if p not in callee.static_params}
+            prev = state.get(callee_qual)
+            cur = dict(prev[1]) if prev else {}
+            changed = prev is None
+            for p, k in ptaints.items():
+                kind, known = pins.get(p, ("maybe", False))
+                if known:
+                    # a recognized annotation pins the entry kind: the
+                    # author's declared contract beats call-site guessing
+                    k = kind
+                    if not k:
+                        continue
+                nk = _join(cur.get(p), k)
+                if nk != cur.get(p):
+                    cur[p] = nk or k
+                    changed = True
+            if changed:
+                state[callee_qual] = (callee, cur)
+                work.append(callee_qual)
+    project._graftlint_jit_state = state
+    return state
+
+
+@register_rule("R1")
+def rule_r1_tracer_safety(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for qual, (rec, taint) in sorted(_jit_taint_state(project).items()):
+        an = _FnAnalysis(rec, dict(taint), project)
+        an.run(collect=True)
+        findings.extend(an.findings())
+    return findings
+
+
+# ==========================================================================
+# R2 — host↔device sync in hot paths
+# ==========================================================================
+
+@register_rule("R2")
+def rule_r2_host_sync(project: Project) -> List[Finding]:
+    jit_funcs = _jit_taint_state(project)
+    findings: List[Finding] = []
+    for fi in project.files:
+        if fi.tree is None:
+            continue
+        for rec in fi.functions.values():
+            hot = rec.qual in jit_funcs or rec.name in HOT_FUNC_NAMES \
+                or rec.name.split(".")[-1] in HOT_FUNC_NAMES
+            if not hot:
+                continue
+            where = ("jit-compiled" if rec.qual in jit_funcs
+                     else "hot-path") + f" `{rec.name}`"
+            for node in ast.walk(rec.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                full = resolve_dotted(dotted_name(node.func), fi.imports)
+                if full in _SYNC_CALLS:
+                    findings.append(fi.finding(
+                        node, "R2",
+                        f"`{full}` forces a host↔device sync inside {where} "
+                        "(keep device values on device; move readback to "
+                        "the cycle boundary)",
+                    ))
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in _SYNC_METHODS
+                      and rec.qual not in jit_funcs):
+                    # in jit context R1 already reports tainted .item()
+                    findings.append(fi.finding(
+                        node, "R2",
+                        f"`.{node.func.attr}()` is a per-element device "
+                        f"sync inside {where}",
+                    ))
+    return findings
+
+
+# ==========================================================================
+# R3 — retrace hazards
+# ==========================================================================
+
+@register_rule("R3")
+def rule_r3_retrace(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in project.files:
+        if fi.tree is None:
+            continue
+        findings.extend(_r3_jit_in_body(fi))
+        for rec in fi.functions.values():
+            if not rec.jit_root or not rec.static_params:
+                continue
+            a = rec.node.args
+            has_kwargs = a.kwarg is not None
+            missing = sorted(rec.static_params - set(rec.params))
+            if missing and not has_kwargs:
+                findings.append(fi.finding(
+                    rec.node, "R3",
+                    f"static_argnames {missing} name no parameter of "
+                    f"`{rec.name}` — silent retrace/TypeError hazard",
+                ))
+    return findings
+
+
+def _r3_jit_in_body(fi: FileInfo) -> List[Finding]:
+    """``jax.jit(...)`` constructed inside a function or loop builds a
+    fresh wrapper (empty compile cache) per call — the classic retrace
+    storm. Decorators and module-scope wrappers are the blessed forms."""
+    findings: List[Finding] = []
+
+    def walk(node: ast.AST, in_def: bool, in_loop: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                walk(dec, in_def, in_loop)
+            for s in node.body:
+                walk(s, True, False)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            in_loop = True
+        if isinstance(node, ast.Call):
+            full = resolve_dotted(dotted_name(node.func), fi.imports)
+            if full in ("jax.jit", "jax.api.jit") and (in_def or in_loop):
+                site = "a loop" if in_loop else "a function body"
+                findings.append(fi.finding(
+                    node, "R3",
+                    f"jax.jit constructed inside {site}: every call "
+                    "builds a fresh wrapper with an empty compile "
+                    "cache — hoist to module scope or memoize",
+                ))
+        for child in ast.iter_child_nodes(node):
+            walk(child, in_def, in_loop)
+
+    walk(fi.tree, False, False)
+    return findings
+
+
+# ==========================================================================
+# R4 — determinism
+# ==========================================================================
+
+_RANDOM_OK = {"Random", "SystemRandom", "getstate", "setstate"}
+_NP_RANDOM_OK = {
+    "default_rng", "Generator", "RandomState", "SeedSequence", "PCG64",
+    "Philox", "bit_generator",
+}
+_DATETIME_NOW = {
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.date.today", "datetime.datetime.today",
+}
+
+
+@register_rule("R4")
+def rule_r4_determinism(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in project.files:
+        if fi.tree is None:
+            continue
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            full = resolve_dotted(dotted_name(node.func), fi.imports)
+            if not full:
+                continue
+            if full.startswith("random.") and full.count(".") == 1:
+                leaf = full.split(".")[1]
+                if leaf not in _RANDOM_OK:
+                    findings.append(fi.finding(
+                        node, "R4",
+                        f"`{full}()` uses the global random state — seed a "
+                        "`random.Random(seed)` instance and thread it "
+                        "through (the sim/faults idiom)",
+                    ))
+            elif full.startswith("numpy.random."):
+                leaf = full.split(".")[2]
+                if leaf not in _NP_RANDOM_OK:
+                    findings.append(fi.finding(
+                        node, "R4",
+                        f"`{full}()` uses numpy's global RNG — use "
+                        "`np.random.default_rng(seed)`",
+                    ))
+            elif full == "time.time":
+                findings.append(fi.finding(
+                    node, "R4",
+                    "`time.time()` is wall-clock — inject a clock "
+                    "(`clock: Callable[[], float] = time.monotonic`) so "
+                    "sim/chaos runs stay deterministic",
+                ))
+            elif full in _DATETIME_NOW and not node.args and not node.keywords:
+                findings.append(fi.finding(
+                    node, "R4",
+                    f"argless `{full}()` — inject a clock or pass an "
+                    "explicit timezone/timestamp",
+                ))
+    return findings
+
+
+# ==========================================================================
+# R5 — dtype drift in device-math modules
+# ==========================================================================
+
+def _in_dtype_scope(fi: FileInfo) -> bool:
+    rel = "/" + fi.relpath
+    return (any(m in rel for m in _DTYPE_SCOPE_MARKERS)
+            or any(rel.endswith("/" + f) for f in _DTYPE_SCOPE_FILES))
+
+
+@register_rule("R5")
+def rule_r5_dtype(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in project.files:
+        if fi.tree is None or not _in_dtype_scope(fi):
+            continue
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.Attribute):
+                full = resolve_dotted(dotted_name(node), fi.imports)
+                if full in _F64_ATTRS:
+                    findings.append(fi.finding(
+                        node, "R5",
+                        f"`{full}` in a device-math module — the solver "
+                        "rides float32 end to end; widening silently "
+                        "doubles memory traffic and splits jit caches",
+                    ))
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg == "dtype":
+                        v = kw.value
+                        if isinstance(v, ast.Name) and v.id == "float":
+                            findings.append(fi.finding(
+                                v, "R5",
+                                "`dtype=float` is float64 — spell the "
+                                "narrow dtype (np.float32) explicitly",
+                            ))
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if (isinstance(arg, ast.Constant)
+                            and arg.value in ("float64", "complex128")):
+                        findings.append(fi.finding(
+                            arg, "R5",
+                            f"dtype string '{arg.value}' in a device-math "
+                            "module — use float32",
+                        ))
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "astype" and node.args):
+                    a0 = node.args[0]
+                    if isinstance(a0, ast.Name) and a0.id == "float":
+                        findings.append(fi.finding(
+                            a0, "R5",
+                            "`.astype(float)` is float64 — use np.float32",
+                        ))
+    return findings
+
+
+# ==========================================================================
+# R6 — syntax gate: Py3.10 f-string backslash (the seed breaker)
+# ==========================================================================
+
+@register_rule("R6")
+def rule_r6_fstring_backslash(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in project.files:
+        if fi.parse_error is not None:
+            line = getattr(fi.parse_error, "lineno", None) or 1
+            if _looks_like_fstring_backslash(fi, line):
+                findings.append(Finding(
+                    fi.relpath, line, 0, "R6",
+                    "f-string expression contains a backslash — a "
+                    "SyntaxError on Python 3.10 (the class that broke the "
+                    "seed's metrics.py); pull the escape into a variable",
+                    fi.line_text(line),
+                ))
+            else:
+                findings.append(Finding(
+                    fi.relpath, line, 0, "R6",
+                    f"file does not parse: {fi.parse_error}",
+                    fi.line_text(line),
+                ))
+            continue
+        # forward-compat: on interpreters where the construct parses
+        # (3.12+, PEP 701), catch it from the AST so the repo stays
+        # 3.10-loadable. Before 3.12 every FormattedValue in a joined
+        # string shares the whole string's span (adjacent `\n` literals
+        # would false-positive) — and the construct cannot parse there
+        # anyway, so the parse_error path above is the real check.
+        if sys.version_info < (3, 12):
+            continue
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.FormattedValue):
+                seg = ast.get_source_segment(fi.source, node)
+                if seg and "\\" in seg:
+                    findings.append(fi.finding(
+                        node, "R6",
+                        "backslash inside an f-string expression — "
+                        "SyntaxError on Python 3.10; pull the escape into "
+                        "a variable",
+                    ))
+    return findings
+
+
+def _looks_like_fstring_backslash(fi: FileInfo, around_line: int) -> bool:
+    import re
+
+    pat = re.compile(r"""[fF][rRbB]?(['"]).*{[^{}]*\\[^}]*}.*\1""")
+    lo = max(0, around_line - 3)
+    hi = min(len(fi.lines), around_line + 2)
+    return any(pat.search(text) for text in fi.lines[lo:hi])
+
+
+# ==========================================================================
+# R0 — suppression hygiene
+# ==========================================================================
+
+@register_rule("R0")
+def rule_r0_suppression_hygiene(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in project.files:
+        for d in fi.suppressions.hygiene:
+            if d.form == "malformed":
+                msg = ("malformed graftlint directive — expected "
+                       "`# graftlint: disable=R2 -- justification`")
+            elif not d.why.strip():
+                msg = (f"suppression of {','.join(d.rules) or '?'} has no "
+                       "justification — add ` -- <why this is safe>`")
+            elif any(r not in RULE_IDS for r in d.rules):
+                msg = f"unknown rule id in suppression: {d.rules}"
+            else:
+                msg = ("disable-scope directive is not attached to a "
+                       "def/class header")
+            findings.append(Finding(fi.relpath, d.line, 0, "R0", msg,
+                                    fi.line_text(d.line)))
+    return findings
+
+
+def ensure_registered() -> None:
+    """Importing this module registers every rule; hook for the engine."""

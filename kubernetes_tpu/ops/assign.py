@@ -639,6 +639,9 @@ def batch_assign(
     return assigned, u, rounds
 
 
+# graftlint: disable-scope=R2 -- the deliberate host boundary: trust-but-
+# verify reads the solver's claimed result back ONCE per cycle to check it
+# before any pod binds; cheap O(P*R + N*R) numpy by design (see docstring)
 def validate_solution(
     assigned, usage: UsageState, pods: DevicePods, nodes: DeviceNodes,
     enabled_mask: Optional[int] = None,

@@ -220,6 +220,8 @@ def _pallas_compiles(bp: int, bn: int, P: int, N: int) -> bool:
     real call's config — `_block_shapes` is a fixed point on padded
     shapes, so `_scale_pallas` inside recomputes the identical tiling."""
     try:
+        # graftlint: disable=R3 -- one-time compile probe, memoized by the
+        # lru_cache above: the wrapper is built once per (block, shape) key
         u, v = jax.jit(functools.partial(
             _scale_pallas, iters=1, block_p=bp, block_n=bn))(
             jnp.zeros((P, N), jnp.float32),

@@ -1062,6 +1062,10 @@ class Scheduler:
             i += 1
         return None
 
+    # graftlint: disable-scope=R2 -- host oracle by design: the exact tier
+    # runs the Hungarian solver on CPU, so the one filter+score result is
+    # read back wholesale here; the ladder only enters this tier when
+    # quality beats wall-clock (gang/offline packing)
     def _exact_solve(self, dp, dn, ds, dt, base_fr, extra_mask, extra_score):
         """Exact one-shot assignment: device filter+score once, then the
         native Hungarian solver with per-node slot capacities

@@ -1,6 +1,7 @@
 """Test object builders — the analog of the reference's
 ``pkg/scheduler/testing/wrappers.go`` pod/node wrappers used throughout its
-unit suites."""
+unit suites — plus :func:`lint_clean`, the graftlint assertion future ops
+kernels use to pin their own tracer-safety."""
 
 from __future__ import annotations
 
@@ -92,3 +93,59 @@ def node_affinity_preferred(*weighted: Tuple[int, Sequence[Requirement]]) -> Aff
             for w, t in weighted
         )
     )
+
+
+def lint_clean(
+    source,
+    rules: Sequence[str] = ("R1", "R2", "R3", "R5", "R6"),
+    filename: str = "<kernel>",
+    jit_all: bool = True,
+) -> None:
+    """Assert a kernel's source passes graftlint — the tracer-safety
+    analog of the wrappers above: a new ops kernel pins its own
+    discipline with one line in its unit test::
+
+        from kubernetes_tpu.testing import lint_clean
+        import kubernetes_tpu.ops.mykernel as mk
+        def test_mykernel_tracer_safe():
+            lint_clean(mk)
+
+    ``source`` is a source string, a module, or any object
+    ``inspect.getsource`` accepts (function, class). ``jit_all=True``
+    treats every *uncalled* top-level function as a jit entry point, so
+    the check covers kernels whose ``jax.jit`` wrapper lives in the
+    caller; helpers the source itself calls are judged by their real
+    call-site taint (``_block_shapes(*x.shape)`` stays host). Pass
+    ``jit_all=False`` for modules that mix kernels with deliberate
+    host-side functions (``ops/assign.py``'s trust-but-verify
+    ``validate_solution``) to lint via the module's real jit roots. The
+    default rule set is the device-side discipline (tracer safety,
+    host syncs, retrace, dtype); pass ``rules=None`` for everything.
+
+    Raises AssertionError listing every finding; returns None when clean.
+    """
+    import inspect
+    import os
+
+    from kubernetes_tpu.lint import lint_source
+    from kubernetes_tpu.lint.report import render_text
+
+    if not isinstance(source, str):
+        filename = getattr(source, "__file__", None) or filename
+        source = inspect.getsource(source)
+    # R5 scopes by path: make bare snippet names look like ops/ files so
+    # the dtype rule engages for kernel sources passed as strings
+    if "/" not in filename.replace(os.sep, "/"):
+        filename = f"ops/{filename.lstrip('<').rstrip('>') or 'kernel'}.py"
+    # R6 is always on: every OTHER rule is vacuous on source that does
+    # not parse, so without the syntax gate a broken kernel would pass
+    select = tuple(dict.fromkeys(tuple(rules) + ("R6",))) \
+        if rules is not None else None
+    findings = lint_source(
+        source, filename=filename, select=select, jit_all=jit_all,
+    )
+    if findings:
+        raise AssertionError(
+            "graftlint found tracer-safety problems:\n"
+            + render_text(findings)
+        )

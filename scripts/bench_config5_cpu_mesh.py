@@ -43,18 +43,18 @@ out = {
     "batches": [],
 }
 
-t0 = time.time()
+t0 = time.perf_counter()
 w = ShardedWorkload(
     build_variant("base", N_NODES, 0, BATCH * N_BATCHES), make_mesh()
 )
-out["build_pack_shard_s"] = round(time.time() - t0, 1)
+out["build_pack_shard_s"] = round(time.perf_counter() - t0, 1)
 
 dn_cur = w.dn
 usage = None
 placed_total = 0
 for b in range(N_BATCHES):
     chunk = w.pending[b * BATCH : (b + 1) * BATCH]
-    t0 = time.time()
+    t0 = time.perf_counter()
     dp, dv = w.device_batch(chunk, BATCH)
     # feature gates included since round 3 (benchres/config5_cpu_mesh.json
     # was recorded BEFORE gating — expect a faster number on re-measure)
@@ -64,7 +64,7 @@ for b in range(N_BATCHES):
         no_spread=w.no_spread,
     )
     a = np.asarray(assigned)[: len(chunk)]
-    dt = time.time() - t0
+    dt = time.perf_counter() - t0
     placed = int((a >= 0).sum())
     placed_total += placed
     dn_cur = nodes_with_usage(dn_cur, usage)

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""graftlint per-rule summarizer — counts now, and trends over time.
+
+Runs the linter (pre-baseline, so the report shows the WHOLE picture
+including grandfathered findings) and prints a per-rule table. With
+``--history FILE`` it appends a JSONL record labeled by the current git
+commit and shows deltas against the previous record, so per-rule counts
+can be tracked across PRs::
+
+    python scripts/lint_report.py --history benchres/lint_history.jsonl
+
+With ``--json-in FILE`` it summarizes a saved ``--format json`` payload
+instead of re-running the linter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from kubernetes_tpu.lint import run_lint  # noqa: E402
+from kubernetes_tpu.lint.engine import RULE_IDS  # noqa: E402
+from kubernetes_tpu.lint.report import per_rule_counts  # noqa: E402
+from kubernetes_tpu.lint.rules import RULE_SUMMARIES  # noqa: E402
+
+DEFAULT_PATHS = ("kubernetes_tpu", "scripts", "tests")
+
+
+def git_label() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "log", "-1", "--format=%h %cI"], cwd=REPO_ROOT,
+            capture_output=True, text=True, timeout=10,
+        )
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return "unknown"
+
+
+def load_previous(history: str) -> Optional[Dict]:
+    if not os.path.exists(history):
+        return None
+    last = None
+    with open(history, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                last = json.loads(line)
+    return last
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument("--json-in", default=None, metavar="FILE",
+                    help="summarize a saved `--format json` payload")
+    ap.add_argument("--history", default=None, metavar="FILE",
+                    help="JSONL trend file to append to / diff against")
+    args = ap.parse_args(argv)
+
+    baselined = 0
+    if args.json_in:
+        with open(args.json_in, "r", encoding="utf-8") as fh:
+            payload = json.load(fh)
+        counts = {str(k): int(v) for k, v in payload.get("counts", {}).items()}
+        total = sum(counts.values())
+        # a payload saved from a baselined CLI run holds POST-baseline
+        # counts — label it honestly instead of claiming the whole picture
+        baselined = int(payload.get("baselined", 0))
+    else:
+        paths = args.paths or [os.path.join(REPO_ROOT, p)
+                               for p in DEFAULT_PATHS]
+        findings = run_lint([p for p in paths if os.path.exists(p)],
+                            root=REPO_ROOT)
+        counts = per_rule_counts(findings)
+        total = len(findings)
+
+    prev = load_previous(args.history) if args.history else None
+    prev_counts = (prev or {}).get("counts", {})
+    if prev is not None and bool(prev.get("baselined", 0)) != bool(baselined):
+        # pre- vs post-baseline counts are different metrics: a delta
+        # between them would read as progress (or regression) that never
+        # happened, so suppress the comparison instead of lying
+        print("note: previous history record has a different baseline "
+              "scope — prev column suppressed", file=sys.stderr)
+        prev, prev_counts = None, {}
+
+    scope = (f"post-baseline ({baselined} grandfathered subtracted)"
+             if baselined else "pre-baseline")
+    print(f"graftlint report — {total} finding(s) {scope}")
+    print(f"{'rule':<5} {'count':>5} {'prev':>5}  summary")
+    for rule in RULE_IDS:
+        n = counts.get(rule, 0)
+        p = prev_counts.get(rule, "-") if prev else "-"
+        print(f"{rule:<5} {n:>5} {str(p):>5}  {RULE_SUMMARIES[rule]}")
+
+    if args.history:
+        record = {"label": git_label(), "counts": counts, "total": total,
+                  "baselined": baselined}
+        os.makedirs(os.path.dirname(os.path.abspath(args.history)),
+                    exist_ok=True)
+        with open(args.history, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(record, sort_keys=True) + "\n")
+        print(f"\nappended to {args.history} (label: {record['label']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

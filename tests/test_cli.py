@@ -161,9 +161,9 @@ def test_cli_boots_server_from_config_file(tmp_path):
         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
     )
     try:
-        deadline = time.time() + 60
+        deadline = time.monotonic() + 60
         body = None
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             if proc.poll() is not None:
                 raise AssertionError(
                     f"process exited rc={proc.returncode}: "

@@ -1,0 +1,728 @@
+"""graftlint rule fixtures — one positive (catches) and one negative
+(stays quiet) snippet per rule class, plus suppression, scope/file
+directives, baseline round-trips, and the cross-file jit call graph.
+
+These pin the linter's *judgment*: which idioms are hazards and which
+are the codebase's blessed forms (`x is None` branches, `.shape`
+projections, seeded `random.Random`, injected clocks, annotated
+trace-time bools). Tier-1, CPU-only, no jax import needed.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from kubernetes_tpu.lint import (
+    Finding,
+    lint_source,
+    load_baseline,
+    run_lint,
+    subtract_baseline,
+    write_baseline,
+)
+from kubernetes_tpu.lint.report import per_rule_counts, render_json, render_text
+
+
+def lint(src, **kw):
+    return lint_source(textwrap.dedent(src), **kw)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# --------------------------------------------------------------------------
+# R1 — tracer safety
+# --------------------------------------------------------------------------
+
+JIT_HEADER = """\
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+"""
+
+
+def test_r1_flags_branch_conversion_iteration_sync():
+    findings = lint(JIT_HEADER + """
+    @jax.jit
+    def f(x, y):
+        if x > 0:              # branch
+            y = y + 1
+        z = float(x)           # conversion
+        s = x.sum()
+        for v in s:            # iteration over definite array
+            y = y + v
+        while y.any():         # while
+            break
+        b = x.item()           # host sync
+        return y, z, b
+    """)
+    assert rules_of(findings) == ["R1"] * 5
+    messages = " | ".join(f.message for f in findings)
+    for needle in ("`if` branch", "`float()`", "iteration over a traced",
+                   "`while` condition", ".item()"):
+        assert needle in messages
+
+
+def test_r1_blessed_idioms_stay_quiet():
+    findings = lint(JIT_HEADER + """
+    from typing import Dict, Optional
+
+    @partial(jax.jit, static_argnames=("flag",))
+    def f(x, mask: jnp.ndarray, flag=False,
+          hoisted: Optional[Dict[str, tuple]] = None,
+          extra=None):
+        hoisted = hoisted or {}          # container truthiness
+        if flag:                         # static_argnames
+            x = x + 1
+        if extra is not None:            # `is` check on dynamic arg
+            x = x + extra
+        if x.shape[0] > 4:               # shape projection
+            x = x * 2
+        for name in hoisted:             # container iteration
+            kind, val = hoisted[name]
+            if kind == "full":           # str-constant compare
+                x = x + val
+        n = len(mask)                    # len() is static
+        return x + n
+    """)
+    assert findings == []
+
+
+def test_r1_namedtuple_field_iteration_semantics():
+    # iterating the *bundle* is fine (rebuild-the-pytree idiom);
+    # iterating a *field* (definite array) is not
+    findings = lint(JIT_HEADER + """
+    @jax.jit
+    def f(pods):
+        rebuilt = [t for t in pods]          # fine: container-or-array unknown
+        total = 0.0
+        for row in pods.req:                 # field access -> array
+            total = total + row
+        return rebuilt, total
+    """)
+    assert rules_of(findings) == ["R1"]
+    assert "iteration" in findings[0].message
+
+
+def test_r1_transitive_call_graph_and_annotation_pin():
+    findings = lint(JIT_HEADER + """
+    def helper(a, reverse: bool):
+        if reverse:           # bool annotation: trace-time constant
+            return a
+        if a.max() > 0:       # traced via the call edge from f
+            return a + 1
+        return a
+
+    @jax.jit
+    def f(q):
+        return helper(q, True)
+    """)
+    assert rules_of(findings) == ["R1"]
+    assert findings[0].message.endswith("`helper`")
+
+
+def test_r1_value_jit_and_nested_callback():
+    findings = lint(JIT_HEADER + """
+    def body(carry, _):
+        acc, i = carry
+        if i == 0:            # traced scan carry
+            acc = acc + 1
+        return (acc, i + 1), None
+
+    def g(x):
+        out, _ = jax.lax.scan(body, (x, 0), None, length=4)
+        return out
+
+    g_fast = jax.jit(g)
+    """)
+    assert rules_of(findings) == ["R1"]
+    assert "`if` branch" in findings[0].message
+
+
+# --------------------------------------------------------------------------
+# R2 — host sync in hot paths
+# --------------------------------------------------------------------------
+
+def test_r2_flags_numpy_readback_in_jit_and_hot_funcs():
+    findings = lint(JIT_HEADER + """
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        return np.asarray(x)
+
+    def batch_assign(pods, nodes):
+        a = np.array(pods)
+        b = jax.device_get(nodes)
+        c = a.item()
+        return a, b, c
+
+    def cold_helper(x):
+        return np.asarray(x)   # not hot: allowed
+    """)
+    assert rules_of(findings) == ["R2", "R2", "R2", "R2"]
+    assert {f.line for f in findings} == {9, 12, 13, 14}
+
+
+def test_r2_negative_device_code_is_quiet():
+    findings = lint(JIT_HEADER + """
+    @jax.jit
+    def f(x, mask):
+        return jnp.where(mask, x, 0.0).sum(axis=1)
+    """)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------
+# R3 — retrace hazards
+# --------------------------------------------------------------------------
+
+def test_r3_jit_in_function_and_loop():
+    findings = lint(JIT_HEADER + """
+    def profile(fns):
+        out = []
+        for fn in fns:
+            out.append(jax.jit(fn)())     # loop: fresh wrapper per iter
+        g = jax.jit(lambda x: x + 1)      # function body
+        return out, g
+    """)
+    assert rules_of(findings) == ["R3", "R3"]
+    assert "inside a loop" in findings[0].message
+    assert "inside a function body" in findings[1].message
+
+
+def test_r3_module_scope_jit_is_blessed():
+    findings = lint(JIT_HEADER + """
+    def _impl(x):
+        return x + 1
+
+    fast = jax.jit(_impl)
+
+    @partial(jax.jit, static_argnames=("k",))
+    def g(x, k=2):
+        return x * k
+    """)
+    assert findings == []
+
+
+def test_r3_static_argnames_typo():
+    findings = lint(JIT_HEADER + """
+    @partial(jax.jit, static_argnames=("weights_key", "no_prots"))
+    def solve(pods, nodes, weights_key=None, no_ports=False):
+        return pods
+    """)
+    assert rules_of(findings) == ["R3"]
+    assert "no_prots" in findings[0].message
+
+
+# --------------------------------------------------------------------------
+# R4 — determinism
+# --------------------------------------------------------------------------
+
+def test_r4_flags_global_rng_wallclock_datetime():
+    findings = lint("""
+    import random
+    import time
+    import numpy as np
+    from datetime import datetime
+
+    def jitter():
+        return random.random() * time.time()
+
+    def spread(xs):
+        np.random.shuffle(xs)
+        return xs
+
+    def stamp():
+        return datetime.now()
+    """)
+    assert per_rule_counts(findings) == {"R4": 4}
+
+
+def test_r4_blessed_forms_stay_quiet():
+    findings = lint("""
+    import random
+    import time
+    import numpy as np
+    from typing import Callable
+    from datetime import datetime, timezone
+
+    class FaultInjector:
+        def __init__(self, seed: int = 0,
+                     clock: Callable[[], float] = time.monotonic):
+            self.rng = random.Random(seed)
+            self.clock = clock
+
+        def roll(self):
+            return self.rng.random() < 0.5, self.clock()
+
+    def gen(seed):
+        return np.random.default_rng(seed).normal()
+
+    def stamp(now=None):
+        return now or datetime.now(timezone.utc)
+    """)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------
+# R5 — dtype drift (scoped to device-math paths)
+# --------------------------------------------------------------------------
+
+def test_r5_flags_float64_in_ops_scope_only():
+    src = """
+    import numpy as np
+    import jax.numpy as jnp
+
+    A = np.zeros((4,), np.float64)
+    B = jnp.asarray([1.0], dtype="float64")
+    C = np.arange(4, dtype=float)
+    D = A.astype(float)
+    """
+    in_scope = lint(src, filename="kubernetes_tpu/ops/kernel.py")
+    assert per_rule_counts(in_scope) == {"R5": 4}
+    out_of_scope = lint(src, filename="kubernetes_tpu/sim.py")
+    assert out_of_scope == []
+
+
+def test_r5_float32_is_quiet():
+    findings = lint("""
+    import numpy as np
+    A = np.zeros((4,), np.float32)
+    B = np.arange(4, dtype=np.int32)
+    """, filename="kubernetes_tpu/ops/kernel.py")
+    assert findings == []
+
+
+# --------------------------------------------------------------------------
+# R6 — syntax gate / f-string backslash
+# --------------------------------------------------------------------------
+
+def test_r6_fstring_backslash_is_caught_not_crashed():
+    # the seed's metrics.py failure class: on 3.10 this does not parse,
+    # and the linter must DIAGNOSE it (R6) rather than fall over
+    findings = lint('''
+    def render(rows):
+        return f"{'\\n'.join(rows)} done"
+    ''')
+    assert rules_of(findings) == ["R6"]
+    assert "backslash" in findings[0].message.lower()
+
+
+def test_r6_generic_syntax_error_still_reports():
+    findings = lint("""
+    def f(:
+        pass
+    """)
+    assert rules_of(findings) == ["R6"]
+    assert "does not parse" in findings[0].message
+
+
+def test_r6_legal_fstrings_are_quiet():
+    findings = lint("""
+    NL = "\\n"
+    def render(rows, name):
+        return f"{NL.join(rows)} {name} ok\\n"
+    """)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------
+# suppressions + R0 hygiene
+# --------------------------------------------------------------------------
+
+SUPPRESSIBLE = """
+    import time
+
+    def f():
+        return time.time()  # graftlint: disable=R4 -- %s
+"""
+
+
+def test_suppression_with_justification_works():
+    findings = lint(SUPPRESSIBLE % "wall time is the payload here")
+    assert findings == []
+
+
+def test_suppression_without_justification_is_r0_and_inert():
+    findings = lint("""
+    import time
+
+    def f():
+        return time.time()  # graftlint: disable=R4
+    """)
+    assert sorted(rules_of(findings)) == ["R0", "R4"]
+
+
+def test_suppression_unknown_rule_is_r0():
+    findings = lint("""
+    import time
+
+    def f():
+        return time.time()  # graftlint: disable=R99 -- because
+    """)
+    assert sorted(rules_of(findings)) == ["R0", "R4"]
+
+
+def test_standalone_suppression_skips_comment_continuation():
+    findings = lint("""
+    import time
+
+    def f():
+        # graftlint: disable=R4 -- wall time is the payload; the
+        # justification wraps over two comment lines
+        return time.time()
+    """)
+    assert findings == []
+
+
+def test_disable_scope_covers_whole_function():
+    findings = lint("""
+    import numpy as np
+    import jax
+
+    # graftlint: disable-scope=R2 -- deliberate host boundary (fixture)
+    def validate_solution(assigned, usage):
+        a = np.asarray(assigned)
+        b = np.asarray(usage)
+        return a, b
+    """)
+    assert findings == []
+
+
+def test_disable_scope_not_on_def_is_r0():
+    findings = lint("""
+    import time
+
+    # graftlint: disable-scope=R4 -- dangling
+    x = 1
+    """)
+    assert rules_of(findings) == ["R0"]
+
+
+def test_disable_file_covers_everything():
+    findings = lint("""
+    # graftlint: disable-file=R4 -- profiler: wall time is the product
+    import time
+
+    def a():
+        return time.time()
+
+    def b():
+        return time.time()
+    """)
+    assert findings == []
+
+
+def test_suppression_does_not_leak_to_other_rules():
+    findings = lint("""
+    import time
+    import random
+
+    def f():
+        # graftlint: disable=R4 -- only the clock is justified
+        return time.time(), random.random()
+    """)
+    # both calls are on the suppressed line and both are R4 — but a
+    # different-rule finding on the same line must survive
+    assert findings == []
+    findings2 = lint("""
+    import numpy as np
+    import jax
+
+    @jax.jit
+    def f(x):
+        return np.asarray(x)  # graftlint: disable=R4 -- wrong rule id
+    """)
+    assert rules_of(findings2) == ["R2"]
+
+
+# --------------------------------------------------------------------------
+# baseline workflow
+# --------------------------------------------------------------------------
+
+def test_baseline_roundtrip_and_line_drift(tmp_path):
+    src_v1 = "import time\n\ndef f():\n    return time.time()\n"
+    p = tmp_path / "mod.py"
+    p.write_text(src_v1)
+    findings = run_lint([str(p)], root=str(tmp_path))
+    assert rules_of(findings) == ["R4"]
+
+    bl = tmp_path / "baseline.json"
+    write_baseline(findings, str(bl))
+    loaded = load_baseline(str(bl))
+    fresh, matched = subtract_baseline(findings, loaded)
+    assert fresh == [] and matched == 1
+
+    # unrelated edits above the finding shift its line; the fingerprint
+    # (rule+path+snippet+occurrence) still matches
+    p.write_text("import time\n\nPAD = 1\nPAD2 = 2\n\ndef f():\n    return time.time()\n")
+    drifted = run_lint([str(p)], root=str(tmp_path))
+    assert rules_of(drifted) == ["R4"] and drifted[0].line == 7
+    fresh, matched = subtract_baseline(drifted, loaded)
+    assert fresh == [] and matched == 1
+
+    # a genuinely NEW finding of the same shape is not absorbed
+    p.write_text("import time\n\ndef f():\n    return time.time()\n\n"
+                 "def g():\n    return time.time()\n")
+    doubled = run_lint([str(p)], root=str(tmp_path))
+    assert len(doubled) == 2
+    fresh, matched = subtract_baseline(doubled, loaded)
+    assert matched == 1 and len(fresh) == 1
+
+
+def test_render_json_shape():
+    f = Finding("a.py", 3, 0, "R4", "msg", "time.time()")
+    payload = json.loads(render_json([f], baselined=2))
+    assert payload["counts"] == {"R4": 1}
+    assert payload["baselined"] == 2
+    assert payload["findings"][0]["fingerprint"] == f.fingerprint()
+    assert "a.py:3:1: R4 msg" in render_text([f])
+
+
+# --------------------------------------------------------------------------
+# testing.lint_clean helper
+# --------------------------------------------------------------------------
+
+def test_lint_clean_accepts_clean_kernel_source():
+    from kubernetes_tpu.testing import lint_clean
+
+    lint_clean(textwrap.dedent("""
+    import jax.numpy as jnp
+
+    def kernel(x, mask):
+        return jnp.where(mask, x, 0.0).sum(axis=1)
+    """))
+
+
+def test_lint_clean_raises_with_findings_listed():
+    from kubernetes_tpu.testing import lint_clean
+
+    with pytest.raises(AssertionError) as e:
+        lint_clean(textwrap.dedent("""
+        def kernel(x):
+            if x > 0:
+                return x
+            return -x
+        """))
+    assert "R1" in str(e.value)
+
+
+def test_lint_clean_on_real_ops_module():
+    # the flagship device modules must satisfy their own discipline.
+    # Kernel-only modules pass with the one-liner default: jit_all roots
+    # only uncalled defs, so host helpers like the block-shape arithmetic
+    # are judged by their real call-site taint (`*x.shape` → host ints).
+    # assign.py mixes kernels with deliberate host-boundary functions
+    # (validate_solution), so it lints via its real jit roots instead.
+    import kubernetes_tpu.ops.assign as assign
+    import kubernetes_tpu.ops.fused_score as fused_score
+    import kubernetes_tpu.ops.sinkhorn as sinkhorn
+    from kubernetes_tpu.testing import lint_clean
+
+    lint_clean(sinkhorn)
+    lint_clean(fused_score)
+    lint_clean(assign, rules=("R1", "R3", "R5"), jit_all=False)
+
+
+def test_lint_clean_jit_all_uses_call_site_taint_for_called_helpers():
+    # a helper the snippet calls is NOT force-rooted: it inherits taint
+    # from its call sites, so branching on a static shape is fine ...
+    from kubernetes_tpu.testing import lint_clean
+
+    src = textwrap.dedent("""
+    def _pick_block(n):
+        if n > 128:
+            return 128
+        return n
+
+    def kernel(x):
+        return x * _pick_block(x.shape[0])
+    """)
+    lint_clean(src)
+    # ... but a tracer flowing into the same helper is still caught
+    with pytest.raises(AssertionError) as e:
+        lint_clean(src.replace("_pick_block(x.shape[0])", "_pick_block(x)"))
+    assert "R1" in str(e.value)
+
+
+def test_r1_match_statement_bodies_are_walked():
+    # Py3.10 structural pattern matching: the subject concretizes a
+    # tracer, case bodies are analyzed, and captured pieces stay tainted
+    findings = lint(JIT_HEADER + """
+    @jax.jit
+    def f(x, mode: int):
+        match mode:
+            case 1:
+                if x > 0:          # hazard inside a case body
+                    x = x + 1
+        match x:                   # match ON a tracer
+            case [a, b]:
+                if a > 0:          # captured piece is traced
+                    return b
+        return x
+    """)
+    msgs = " | ".join(f.message for f in findings)
+    assert "`match` on a traced value" in msgs
+    assert msgs.count("`if` branch on traced value") == 2
+
+
+def test_r1_match_on_static_subject_stays_quiet():
+    findings = lint(JIT_HEADER + """
+    @partial(jax.jit, static_argnames=("mode",))
+    def f(x, mode):
+        match mode:
+            case "double":
+                x = x * 2
+            case _:
+                x = x + 1
+        return x
+    """)
+    assert findings == []
+
+
+def test_disable_covers_multiline_statement():
+    # a trailing directive on ANY line of a wrapped statement governs the
+    # whole statement — findings anchor to the offending node's own line
+    assert lint("""
+    import time
+
+    def poll():
+        return time.time(
+        )  # graftlint: disable=R4 -- replayed log stamp, never ordered
+    """) == []
+    # standalone form above the statement reaches inner-line findings too
+    assert lint("""
+    import time
+
+    def poll():
+        # graftlint: disable=R4 -- replayed log stamp, never ordered
+        return (1,
+                time.time())
+    """) == []
+    # but a directive trailing a compound header can NOT blanket the body
+    findings = lint("""
+    import random
+
+    def loop():
+        for i in range(3):  # graftlint: disable=R4 -- header only
+            x = random.random()
+        return x
+    """)
+    assert rules_of(findings) == ["R4"]
+
+
+def test_lint_clean_never_passes_unparseable_source():
+    # every rule but R6 is vacuous on source that does not parse, so the
+    # helper forces the syntax gate into ANY rule selection — a broken
+    # kernel (incl. the seed's f-string-backslash class) can't pass
+    from kubernetes_tpu.testing import lint_clean
+
+    for bad in ("def kernel(x:\n    pass\n",
+                "def render(rows):\n    return f\"{'\\n'.join(rows)}\"\n"):
+        with pytest.raises(AssertionError) as e:
+            lint_clean(bad, rules=("R1",))
+        assert "R6" in str(e.value)
+
+
+def test_baseline_sibling_ambiguity_is_labeled():
+    # line-free fingerprints can't tell identical snippets apart: when a
+    # NEW copy of a baselined snippet appears, which line gets blamed is
+    # positional — the surviving finding must say so explicitly
+    src1 = "import time\n\ndef a():\n    return time.time()\n"
+    base_entries = {
+        f.fingerprint(): {"rule": f.rule, "path": f.path,
+                          "snippet": " ".join(f.snippet.split()),
+                          "occurrence": f.occurrence}
+        for f in lint_source(src1, filename="t.py", select=("R4",))
+    }
+    src2 = ("import time\n\ndef z():\n    return time.time()\n\n"
+            "def a():\n    return time.time()\n")
+    fresh, matched = subtract_baseline(
+        lint_source(src2, filename="t.py", select=("R4",)), base_entries
+    )
+    assert matched == 1 and len(fresh) == 1
+    assert "identical baselined occurrence" in fresh[0].message
+    # no siblings -> no warning noise
+    fresh2, _ = subtract_baseline(
+        lint_source(src1, filename="other.py", select=("R4",)), base_entries
+    )
+    assert "identical baselined" not in fresh2[0].message
+
+
+def test_taint_fixpoint_guard_fails_loud(monkeypatch):
+    # the iteration guard is a backstop against analysis bugs: tripping
+    # it must raise, never silently report partial R1/R2 coverage clean
+    from kubernetes_tpu.lint import rules as rules_mod
+
+    chain = "import jax\n\n@jax.jit\ndef f0(x):\n    return f1(x)\n" + "".join(
+        f"\ndef f{i}(x):\n    return f{i + 1}(x)\n" for i in range(1, 10)
+    ) + "\ndef f10(x):\n    return x\n"
+    monkeypatch.setattr(rules_mod, "_FIXPOINT_LIMIT", 3)
+    with pytest.raises(RuntimeError, match="fixpoint exceeded"):
+        lint_source(chain, filename="c.py", select=("R1",), jit_all=False)
+    monkeypatch.setattr(rules_mod, "_FIXPOINT_LIMIT", None)
+    assert lint_source(chain, filename="c.py", select=("R1",),
+                       jit_all=False) == []
+
+
+def test_r1_loop_carried_taint_settles():
+    # `a` is host on iteration 1 but traced from iteration 2 on — the
+    # walker re-walks loop bodies so the carried taint reaches the `if`
+    findings = lint(JIT_HEADER + """
+    @jax.jit
+    def f(x):
+        a = 0
+        for _ in range(3):
+            if a:
+                x = x + 1
+            a = x
+        return x
+    """)
+    assert [(f.rule, "`if` branch" in f.message) for f in findings] == [
+        ("R1", True)]
+    findings = lint(JIT_HEADER + """
+    @jax.jit
+    def f(x):
+        done = False
+        while done:
+            done = x.any()
+        return x
+    """)
+    assert rules_of(findings) == ["R1"]
+
+
+def test_r1_r2_taint_crosses_method_boundaries():
+    # self.helper(x) resolves within the class: interprocedural analysis
+    # must not stop dead at method boundaries of class-structured code
+    findings = lint(JIT_HEADER + """
+    import numpy as np
+
+    class S:
+        @jax.jit
+        def step(self, x):
+            return self.helper(x)
+
+        def helper(self, x):
+            if x > 0:
+                return np.asarray(x)
+            return x
+    """)
+    assert sorted(rules_of(findings)) == ["R1", "R2"]
+
+
+def test_r1_positional_partial_args_are_static():
+    # jax.jit(partial(g, 3)) closes over 3: concrete at trace time, so
+    # branching on it is fine — keyword-bound partials already were
+    assert lint(JIT_HEADER + """
+    def g(n, x):
+        if n > 0:
+            return x + n
+        return x
+
+    step = jax.jit(partial(g, 3))
+    """) == []

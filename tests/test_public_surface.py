@@ -176,3 +176,35 @@ def test_round5_controller_surface():
             and RBACAuthorizer and ClusterRole
             and is_node_client_csr and node_bootstrap_csr
             and bootstrap_signer and token_cleaner)
+
+
+def test_lint_surface():
+    """The graftlint contract README.md and docs/lint.md promise: the
+    programmatic API, the rule registry, and the kernel-test helper."""
+    from kubernetes_tpu.lint import (
+        Finding,
+        lint_source,
+        load_baseline,
+        run_lint,
+        subtract_baseline,
+        write_baseline,
+    )
+    from kubernetes_tpu.lint.engine import RULE_IDS
+    from kubernetes_tpu.lint.rules import RULE_SUMMARIES
+    from kubernetes_tpu.testing import lint_clean
+
+    assert RULE_IDS == ("R0", "R1", "R2", "R3", "R4", "R5", "R6")
+    assert set(RULE_SUMMARIES) == set(RULE_IDS)
+    sig = inspect.signature(run_lint)
+    for kw in ("root", "select", "respect_suppressions"):
+        assert kw in sig.parameters, kw
+    sig = inspect.signature(lint_source)
+    for kw in ("filename", "select", "jit_all"):
+        assert kw in sig.parameters, kw
+    sig = inspect.signature(lint_clean)
+    for kw in ("rules", "filename", "jit_all"):
+        assert kw in sig.parameters, kw
+    f = Finding("a.py", 1, 0, "R1", "m", "x = 1")
+    assert f.fingerprint() and f.as_dict()["rule"] == "R1"
+    assert callable(load_baseline) and callable(write_baseline)
+    assert callable(subtract_baseline)
