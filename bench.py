@@ -143,6 +143,45 @@ RESULT = {
     "errors": [],
 }
 
+#: the run's observability trace (kubernetes_tpu.obs.trace.Trace), armed
+#: in main() AFTER backend init — importing the obs package pulls in jax,
+#: which must not initialize before init_platform's probe dance
+BENCH_TRACE = None
+
+
+@contextmanager
+def tspan(name: str):
+    """Span on the bench trace when armed; no-op before backend init."""
+    if BENCH_TRACE is None:
+        yield
+        return
+    with BENCH_TRACE.span(name):
+        yield
+
+
+def trace_out_path() -> str:
+    """Destination of the Chrome trace artifact (open in chrome://tracing
+    or Perfetto). Empty BENCH_TRACE_OUT disables — the cpu_ratio child
+    uses that so it cannot clobber the parent's artifact."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    default = os.path.join(here, "benchres", "bench_trace.json")
+    return os.environ.get("BENCH_TRACE_OUT", default)
+
+
+def write_trace_artifact() -> None:
+    path = trace_out_path()
+    if not path or BENCH_TRACE is None:
+        return
+    try:
+        from kubernetes_tpu.obs.trace import chrome_trace_json
+
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(chrome_trace_json([BENCH_TRACE]), f)
+            f.write("\n")
+    except Exception as e:
+        RESULT["errors"].append(f"trace-artifact write failed: {short_err(e)}")
+
 
 _EMITTED = False
 _EMIT_LOCK = threading.Lock()
@@ -249,6 +288,7 @@ def _emit_payload() -> bool:
     print(line)
     sys.stdout.flush()
     write_full_record()
+    write_trace_artifact()
     return True
 
 
@@ -449,7 +489,8 @@ class Workload:
 
 
 def run_batched(w: Workload, batch: int, cap: int, use_sinkhorn: bool = False,
-                latency: bool = False, return_assigned: bool = False):
+                latency: bool = False, return_assigned: bool = False,
+                trace=None):
     """Schedule w.pending in device batches; returns dict of metrics.
     Usage carries forward batch-to-batch (assume-then-commit,
     cache.go:275).
@@ -487,18 +528,39 @@ def run_batched(w: Workload, batch: int, cap: int, use_sinkhorn: bool = False,
     lat: list = []
     for start in range(0, len(pending), batch):
         chunk = pending[start : start + batch]
-        tp = time.perf_counter()
-        dp, dv = w.device_batch(chunk, batch)
-        pack_s += time.perf_counter() - tp
-        ts = time.perf_counter()
-        assigned, usage, rounds = batch_assign(
-            dp, dn_cur, w.ds, topo=w.dt, vol=dv, per_node_cap=cap,
-            use_sinkhorn=use_sinkhorn, skip_priorities=w.skip_prio,
-            no_ports=w.no_ports, no_pod_affinity=w.no_pod_affinity,
-            no_spread=w.no_spread,
-        )
-        a = np.asarray(assigned)[: len(chunk)]  # device sync + readback
-        solve_s += time.perf_counter() - ts
+        chunk_span = (trace.begin_span(f"batch@{start}", pods=len(chunk))
+                      if trace is not None else None)
+        # try/finally: a deadline TimeoutError mid-solve is an expected
+        # path here, and precisely the run whose trace artifact gets
+        # inspected — its spans must close rather than export as dur=0
+        try:
+            tp = time.perf_counter()
+            if chunk_span is not None:
+                pack_span = trace.begin_span("pack")
+            try:
+                dp, dv = w.device_batch(chunk, batch)
+            finally:
+                if chunk_span is not None:
+                    trace.end_span(pack_span)
+            pack_s += time.perf_counter() - tp
+            ts = time.perf_counter()
+            if chunk_span is not None:
+                solve_span = trace.begin_span("solve")
+            try:
+                assigned, usage, rounds = batch_assign(
+                    dp, dn_cur, w.ds, topo=w.dt, vol=dv, per_node_cap=cap,
+                    use_sinkhorn=use_sinkhorn, skip_priorities=w.skip_prio,
+                    no_ports=w.no_ports, no_pod_affinity=w.no_pod_affinity,
+                    no_spread=w.no_spread,
+                )
+                a = np.asarray(assigned)[: len(chunk)]  # device sync + readback
+            finally:
+                if chunk_span is not None:
+                    trace.end_span(solve_span)
+            solve_s += time.perf_counter() - ts
+        finally:
+            if chunk_span is not None:
+                trace.end_span(chunk_span)
         assigned_all[start : start + len(chunk)] = a
         n_placed = int((a >= 0).sum())
         scheduled += n_placed
@@ -670,6 +732,7 @@ def run_cpu_ratio(n_nodes, n_existing, n_pending, batch, timeout_s=1200.0):
         # child must not clobber the parent's benchres/ record
         "BENCH_EMIT": "full",
         "BENCH_FULL_OUT": "",
+        "BENCH_TRACE_OUT": "",
     })
     env.pop("XLA_FLAGS", None)  # no virtual-device splitting: one CPU "chip"
     r = subprocess.run(
@@ -697,6 +760,12 @@ def main() -> None:
     signal.signal(signal.SIGTERM, on_sigterm)
     dscale = float(os.environ.get("BENCH_DEADLINE_SCALE", 1.0))
     platform = init_platform()
+    # arm the run trace now that the backend is initialized (obs.trace is
+    # stdlib-only but the obs package import pulls in jax)
+    global BENCH_TRACE
+    from kubernetes_tpu.obs.trace import Trace
+
+    BENCH_TRACE = Trace("bench", platform=platform)
     RESULT["extras"]["platform"] = platform
     log(f"platform={platform}")
 
@@ -738,9 +807,10 @@ def main() -> None:
 
     # ---- headline: 5k nodes x 30k pods, cap=8 ----
     try:
-        with deadline(900 * dscale):
+        with deadline(900 * dscale), tspan("headline"):
             w = build_variant("base", n_nodes, n_existing, n_pending)
-            head = run_batched(w, batch, cap=8, latency=True)
+            head = run_batched(w, batch, cap=8, latency=True,
+                               trace=BENCH_TRACE)
         RESULT["metric"] = (
             f"pods scheduled/sec, {n_nodes}-node/{n_pending}-pod "
             "scheduler_perf-style batch workload"
@@ -768,7 +838,7 @@ def main() -> None:
             raise InterruptedError
         cn = int(os.environ.get("BENCH_CONTENDED_NODES", 1000))
         cp = int(os.environ.get("BENCH_CONTENDED_PODS", 4000 if light else 30000))
-        with deadline(600 * dscale):
+        with deadline(600 * dscale), tspan("cap_sweep"):
             wc = build_variant("base", cn, 0, cp)
             sweep = {"nodes": cn, "pods": cp}
             for cap in (1, 4, 8):
@@ -794,7 +864,7 @@ def main() -> None:
         try:
             rn = int(os.environ.get("BENCH_RATIO_NODES", 1000))
             rp = int(os.environ.get("BENCH_RATIO_PODS", 4000))
-            with deadline(1500 * dscale):  # child timeout is 1200
+            with deadline(1500 * dscale), tspan("cpu_ratio"):  # child timeout is 1200
                 wm = build_variant("base", rn, rn // 2, rp)
                 tpu_mini = run_batched(wm, min(rp, batch), cap=8)
                 del wm
@@ -823,7 +893,7 @@ def main() -> None:
             raise InterruptedError
         pn = int(os.environ.get("BENCH_PARITY_NODES", 1000))
         pp = int(os.environ.get("BENCH_PARITY_PODS", 5000))
-        with deadline(600 * dscale):
+        with deadline(600 * dscale), tspan("score_parity"):
             wp = build_variant("base", pn, pn // 5, pp)
             seq = run_sequential(wp)
         parity = {"nodes": pn, "pods": pp, "sequential": seq}
@@ -864,7 +934,7 @@ def main() -> None:
             c5n = int(os.environ.get("BENCH_C5_NODES", 50000))
             c5p = int(os.environ.get("BENCH_C5_PODS", 200000))
             c5b = int(os.environ.get("BENCH_C5_BATCH", 4096))
-            with deadline(900 * dscale):
+            with deadline(900 * dscale), tspan("config5"):
                 w5 = ShardedWorkload(build_variant("base", c5n, 0, c5p),
                                      make_mesh())
                 r5 = run_batched(w5, c5b, cap=8, latency=True)
@@ -899,7 +969,7 @@ def main() -> None:
         # recorded up front so a timeout on argmax keeps the sinkhorn run
         RESULT["extras"][f"gang_{gg}x{gsz}"] = gang
         for sname, sk in (("sinkhorn", True), ("argmax", False)):
-            with deadline(450 * dscale):
+            with deadline(450 * dscale), tspan(f"gang/{sname}"):
                 wg = Workload(gnodes, [], gpods)
                 r = run_batched(wg, min(len(gpods), batch), cap=8,
                                 use_sinkhorn=sk, return_assigned=True)
@@ -940,7 +1010,8 @@ def main() -> None:
             # scale with node count: the 5000-node grid pairs legitimately
             # take longer to compile+solve than the default 1000-node pair,
             # and a slow-but-healthy backend must not read as wedged
-            with deadline(240 * dscale * max(1, vn // 1000)):
+            with deadline(240 * dscale * max(1, vn // 1000)), \
+                    tspan(f"variant:{name}/{vn}x{vex}"):
                 wv = build_variant(name, vn, vex, vpods)
                 # argmax rounds for every entry, gang included: measured
                 # identical placements/score at 4-5x less solve cost

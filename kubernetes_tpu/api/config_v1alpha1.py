@@ -128,6 +128,22 @@ class RobustnessConfigurationV1alpha1:
 
 
 @dataclass
+class ObservabilityConfigurationV1alpha1:
+    """Versioned spelling of the observability knobs
+    (config.ObservabilityConfig): camelCase, the trace threshold as a
+    metav1.Duration string like every other versioned time field."""
+
+    enabled: Optional[bool] = None
+    traceThreshold: Optional[str] = None
+    traceSampling: Optional[float] = None
+    recorderCapacity: Optional[int] = None
+    traceRingCapacity: Optional[int] = None
+    retraceStormThreshold: Optional[int] = None
+    retraceStormWindow: Optional[int] = None
+    sinkhornTelemetry: Optional[bool] = None
+
+
+@dataclass
 class KubeSchedulerConfigurationV1alpha1:
     schedulerName: Optional[str] = None
     algorithmSource: "SchedulerAlgorithmSource" = field(
@@ -152,6 +168,8 @@ class KubeSchedulerConfigurationV1alpha1:
     maxBatch: Optional[int] = None
     robustness: "RobustnessConfigurationV1alpha1" = field(
         default_factory=RobustnessConfigurationV1alpha1)
+    observability: "ObservabilityConfigurationV1alpha1" = field(
+        default_factory=ObservabilityConfigurationV1alpha1)
 
 
 # -- defaulting (v1alpha1/defaults.go:42) -----------------------------------
@@ -217,6 +235,23 @@ def set_defaults_kube_scheduler_configuration(
         rb.fallbackChain = ["batch-cpu", "greedy"]
     if rb.extenderDegradeToIgnorable is None:
         rb.extenderDegradeToIgnorable = True
+    ob = obj.observability
+    if ob.enabled is None:
+        ob.enabled = True
+    if ob.traceThreshold is None:
+        ob.traceThreshold = "1s"
+    if ob.traceSampling is None:
+        ob.traceSampling = 1.0
+    if ob.recorderCapacity is None:
+        ob.recorderCapacity = 256
+    if ob.traceRingCapacity is None:
+        ob.traceRingCapacity = 64
+    if ob.retraceStormThreshold is None:
+        ob.retraceStormThreshold = 8
+    if ob.retraceStormWindow is None:
+        ob.retraceStormWindow = 64
+    if ob.sinkhornTelemetry is None:
+        ob.sinkhornTelemetry = True
     return obj
 
 
@@ -317,6 +352,23 @@ def _to_internal(v: KubeSchedulerConfigurationV1alpha1) -> KubeSchedulerConfigur
         max_rounds=v.maxRounds,
         max_batch=v.maxBatch,
         robustness=_robustness_to_internal(v.robustness),
+        observability=_observability_to_internal(v.observability),
+    )
+
+
+def _observability_to_internal(ob: ObservabilityConfigurationV1alpha1):
+    from kubernetes_tpu.config import ObservabilityConfig
+
+    return ObservabilityConfig(
+        enabled=ob.enabled,
+        trace_threshold_s=_dur("traceThreshold", ob.traceThreshold,
+                               "observability"),
+        trace_sampling=ob.traceSampling,
+        recorder_capacity=ob.recorderCapacity,
+        trace_ring_capacity=ob.traceRingCapacity,
+        retrace_storm_threshold=ob.retraceStormThreshold,
+        retrace_storm_window=ob.retraceStormWindow,
+        sinkhorn_telemetry=ob.sinkhornTelemetry,
     )
 
 
@@ -393,6 +445,16 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
             validateResults=rc.validate_results,
             fallbackChain=list(rc.fallback_chain),
             extenderDegradeToIgnorable=rc.extender_degrade_to_ignorable,
+        ),
+        observability=ObservabilityConfigurationV1alpha1(
+            enabled=c.observability.enabled,
+            traceThreshold=format_duration(c.observability.trace_threshold_s),
+            traceSampling=c.observability.trace_sampling,
+            recorderCapacity=c.observability.recorder_capacity,
+            traceRingCapacity=c.observability.trace_ring_capacity,
+            retraceStormThreshold=c.observability.retrace_storm_threshold,
+            retraceStormWindow=c.observability.retrace_storm_window,
+            sinkhornTelemetry=c.observability.sinkhorn_telemetry,
         ),
     )
 

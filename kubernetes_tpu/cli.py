@@ -32,6 +32,7 @@ from kubernetes_tpu.config import (
     FeatureGates,
     KubeSchedulerConfiguration,
     LeaderElectionConfig,
+    ObservabilityConfig,
     RobustnessConfig,
     load_policy,
 )
@@ -126,6 +127,22 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
             f"robustness.fallbackChain: unsupported tier(s) {bad_tiers}: "
             f"supported: {', '.join(VALID_SOLVERS + ('batch-cpu',))}"
         )
+    oc = cfg.observability
+    if oc.trace_threshold_s < 0:
+        errs.append("observability.traceThreshold: must be non-negative")
+    if not 0 <= oc.trace_sampling <= 1:
+        errs.append(
+            f"observability.traceSampling: Invalid value {oc.trace_sampling}: "
+            "not in valid range 0-1"
+        )
+    if oc.recorder_capacity < 1:
+        errs.append("observability.recorderCapacity: must be at least 1")
+    if oc.trace_ring_capacity < 1:
+        errs.append("observability.traceRingCapacity: must be at least 1")
+    if oc.retrace_storm_threshold < 1:
+        errs.append("observability.retraceStormThreshold: must be at least 1")
+    if oc.retrace_storm_window < 1:
+        errs.append("observability.retraceStormWindow: must be at least 1")
     # unknown feature gates are rejected earlier, at FeatureGates
     # construction (featuregate.Set errors on unknown names)
     return errs
@@ -134,6 +151,7 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
 _CONFIG_FIELDS = {f.name for f in dataclasses.fields(KubeSchedulerConfiguration)}
 _LE_FIELDS = {f.name for f in dataclasses.fields(LeaderElectionConfig)}
 _ROB_FIELDS = {f.name for f in dataclasses.fields(RobustnessConfig)}
+_OBS_FIELDS = {f.name for f in dataclasses.fields(ObservabilityConfig)}
 
 
 def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
@@ -198,6 +216,17 @@ def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
             if "fallback_chain" in rkw:
                 rkw["fallback_chain"] = tuple(rkw["fallback_chain"])
             kw["robustness"] = RobustnessConfig(**rkw)
+        elif key == "observability":
+            if not isinstance(val, dict):
+                errs.append("observability: expected a mapping")
+                continue
+            unknown = set(val) - _OBS_FIELDS
+            if unknown:
+                errs.append(
+                    f"observability: unknown field(s) {sorted(unknown)}"
+                )
+                continue
+            kw["observability"] = ObservabilityConfig(**val)
         elif key == "policy":
             kw["policy"] = load_policy(val)
         elif key in _CONFIG_FIELDS:

@@ -144,6 +144,37 @@ class RobustnessConfig:
 
 
 @dataclass
+class ObservabilityConfig:
+    """Observability knobs (kubernetes_tpu/obs): cycle tracing, the JAX
+    compile/retrace telemetry, and the flight recorder. All times ride
+    the scheduler's injected clock; sampling is deterministic
+    (counter-based), so traced runs replay bit-identically."""
+
+    #: master switch for the flight recorder + trace retention. The
+    #: threshold-gated slow-cycle log (utiltrace LogIfLong) stays on
+    #: either way — it is the cheap always-on profiler.
+    enabled: bool = True
+    #: cycles slower than this log their span breakdown (LogIfLong).
+    trace_threshold_s: float = 1.0
+    #: fraction of cycles whose full trace is RETAINED for /debug/traces
+    #: and the Chrome exporter (1.0 = every cycle, 0 = none). Retention
+    #: is deterministic: cycle k keeps its trace when floor(k*rate)
+    #: advances.
+    trace_sampling: float = 1.0
+    #: flight-recorder ring capacity (cycles); oldest records evict.
+    recorder_capacity: int = 256
+    #: retained-trace ring capacity (traces held for export).
+    trace_ring_capacity: int = 64
+    #: retraces at one call site within the window that count as a storm
+    retrace_storm_threshold: int = 8
+    #: storm window, in calls at that site (count-based, no wall clock)
+    retrace_storm_window: int = 64
+    #: capture per-cycle Sinkhorn convergence stats (iteration count,
+    #: final residual) when the sinkhorn tier solves a cycle
+    sinkhorn_telemetry: bool = True
+
+
+@dataclass
 class KubeSchedulerConfiguration:
     """The typed component config. Reference fields keep their meanings;
     the ``solver``/``per_node_cap``/``max_batch`` block is this
@@ -177,6 +208,9 @@ class KubeSchedulerConfiguration:
     max_batch: int = 8192
     #: degradation ladder / fault-tolerance knobs
     robustness: RobustnessConfig = field(default_factory=RobustnessConfig)
+    #: cycle tracing / JAX telemetry / flight-recorder knobs
+    observability: ObservabilityConfig = field(
+        default_factory=ObservabilityConfig)
 
 
 # ---------------------------------------------------------------------------

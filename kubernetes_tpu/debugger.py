@@ -13,7 +13,9 @@ from typing import Dict, List, Tuple
 
 def dump(scheduler) -> str:
     """dumper.go:40 — a readable snapshot of cached nodes (+ usage),
-    assumed pods, and queue depths."""
+    assumed pods, and queue depths, plus the flight-recorder ring (the
+    postmortem view: which ladder tier served recent cycles, their span
+    timings, any fallback/retry/breaker activity)."""
     cache = scheduler.cache
     lines: List[str] = ["Dump of cached NodeInfo:"]
     for nd in cache.nodes():
@@ -31,6 +33,9 @@ def dump(scheduler) -> str:
     lines.append("Dump of scheduling queue:")
     for q, depth in scheduler.queue.pending_counts().items():
         lines.append(f"  {q}: {depth}")
+    obs = getattr(scheduler, "obs", None)
+    if obs is not None:
+        lines.append(obs.recorder.dump())
     return "\n".join(lines)
 
 

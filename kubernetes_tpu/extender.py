@@ -194,20 +194,45 @@ class HTTPExtender:
         transport: Optional[Callable[[str, dict, float], dict]] = None,
         retry=None,
         fault_injector=None,
+        clock: Callable[[], float] = None,
+        obs=None,
     ) -> None:
+        import time
+
         self.config = config
         self._transport = transport or _urllib_transport
         self.retry = retry
         self.fault_injector = fault_injector
+        self._clock = clock or time.monotonic
+        #: True when no clock was injected — the scheduler then adopts
+        #: this extender onto its own clock (fake-clock tests stay
+        #: deterministic across the budget-deadline math)
+        self._clock_defaulted = clock is None
+        #: observability facade (kubernetes_tpu/obs): per-verb transport
+        #: spans on the in-flight cycle trace; the scheduler wires it in
+        #: like retry/fault_injector (None stays silent)
+        self.obs = obs
         self._call_budget_s: Optional[float] = None
+        #: absolute deadline on self._clock derived from the last
+        #: set_call_budget — what bounds the RETRY loop and refreshes the
+        #: per-attempt timeout clamp (a fixed budget snapshot would let
+        #: attempt 3 run with attempt 1's generous clamp)
+        self._budget_deadline: Optional[float] = None
 
     def name(self) -> str:
         return self.config.url_prefix
 
-    def set_call_budget(self, seconds: float) -> None:
+    def set_call_budget(self, seconds: Optional[float]) -> None:
         """Clamp subsequent transport timeouts to the caller's remaining
-        cycle budget (consumed per send; re-armed each cycle)."""
+        cycle budget; re-armed per verb by the scheduler. ``None``
+        clears the clamp (unbounded cycle) — required so a clamp from a
+        deadline-bearing cycle can't leak into later verbs/cycles."""
+        if seconds is None:
+            self._call_budget_s = None
+            self._budget_deadline = None
+            return
         self._call_budget_s = max(float(seconds), 1e-3)
+        self._budget_deadline = self._clock() + self._call_budget_s
 
     def is_ignorable(self) -> bool:
         return self.config.ignorable
@@ -227,12 +252,19 @@ class HTTPExtender:
         return any(name in managed for name in pod.requests.scalars)
 
     def _send(self, verb: str, args: dict) -> dict:
+        from contextlib import nullcontext
+
         url = self.config.url_prefix.rstrip("/") + "/" + verb
-        timeout = self.config.http_timeout_s
-        if self._call_budget_s is not None:
-            timeout = min(timeout, self._call_budget_s)
 
         def once() -> dict:
+            # per-ATTEMPT timeout clamp, refreshed from the remaining
+            # budget at each retry — the static snapshot it replaces let
+            # later attempts run on a stale (too-generous) clamp and
+            # blow the cycle deadline (ROADMAP bug (b))
+            timeout = self.config.http_timeout_s
+            if self._budget_deadline is not None:
+                timeout = min(
+                    timeout, max(self._budget_deadline - self._clock(), 1e-3))
             kind = None
             if self.fault_injector is not None:
                 # may raise (timeout/connection/truncated) or return a
@@ -244,9 +276,17 @@ class HTTPExtender:
                 resp = self.fault_injector.corrupt_response(kind, resp)
             return resp
 
-        if self.retry is not None:
-            return self.retry.call(once)
-        return once()
+        span = (self.obs.span(f"extender:{verb}", url=url)
+                if self.obs is not None else nullcontext())
+        with span:
+            if self.retry is not None:
+                # retries bounded by the same budget deadline: a backoff
+                # that would land past it propagates the error instead
+                # of burning cycle time the caller no longer has
+                return self.retry.call(once,
+                                       deadline_s=self._budget_deadline,
+                                       clock=self._clock)
+            return once()
 
     # -- verbs -------------------------------------------------------------
 
@@ -381,6 +421,9 @@ def build_extenders(
     transport: Optional[Callable] = None,
     retry=None,
     fault_injector=None,
+    clock=None,
+    obs=None,
 ) -> List[HTTPExtender]:
     return [HTTPExtender(c, transport, retry=retry,
-                         fault_injector=fault_injector) for c in configs]
+                         fault_injector=fault_injector, clock=clock,
+                         obs=obs) for c in configs]

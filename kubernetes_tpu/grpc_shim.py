@@ -194,16 +194,26 @@ class TpuSchedulerService:
             if request.node_names:
                 payload["nodenames"] = list(request.node_names)
             try:
+                kind = None
                 if self.fault_injector is not None:
-                    self.fault_injector.transport_fault("grpc-service:filter")
+                    kind = self.fault_injector.transport_fault(
+                        "grpc-service:filter")
                 r = self.extender.handle("filter", payload)
+                if kind is not None:
+                    # ROADMAP bug (d): the armed corruption must actually
+                    # poison the response (a discarded kind was a no-op
+                    # that still consumed shots); a corrupted shape then
+                    # fails result construction below and rides the
+                    # error-result path like any remote failure
+                    r = self.fault_injector.corrupt_response(kind, r)
+                result = pb.ExtenderFilterResult(
+                    node_names=r.get("nodenames") or [],
+                    failed_nodes=r.get("failedNodes") or {},
+                    error=r.get("error", ""),
+                )
             except Exception as e:  # verb errors ride the result message
                 return pb.ExtenderFilterResult(error=str(e))
-        return pb.ExtenderFilterResult(
-            node_names=r.get("nodenames", []),
-            failed_nodes=r.get("failedNodes", {}),
-            error=r.get("error", ""),
-        )
+        return result
 
     def prioritize(self, request: pb.ExtenderArgs, context) -> pb.HostPriorityList:
         with self.lock:
@@ -211,15 +221,21 @@ class TpuSchedulerService:
             if request.node_names:
                 payload["nodenames"] = list(request.node_names)
             try:
+                kind = None
                 if self.fault_injector is not None:
-                    self.fault_injector.transport_fault(
+                    kind = self.fault_injector.transport_fault(
                         "grpc-service:prioritize")
                 r = self.extender.handle("prioritize", payload)
+                if kind is not None:
+                    # bug (d) as above: apply the corruption; a mistyped
+                    # payload fails the item loop and becomes the verb's
+                    # error result
+                    r = self.fault_injector.corrupt_response(kind, r)
+                out = pb.HostPriorityList()
+                for item in r:
+                    out.items.add(host=item["host"], score=item["score"])
             except Exception as e:
                 return pb.HostPriorityList(error=str(e))
-        out = pb.HostPriorityList()
-        for item in r:
-            out.items.add(host=item["host"], score=item["score"])
         return out
 
     def get_state(self, request: pb.StateRequest, context) -> pb.StateSnapshot:
@@ -488,21 +504,27 @@ class GrpcSchedulerClient:
     "grpc:Bind", ...)."""
 
     def __init__(self, target: str, token: "str | None" = None,
-                 retry=None, fault_injector=None):
+                 retry=None, fault_injector=None, obs=None):
         self.target = target
         self.channel = grpc.insecure_channel(target)
         self.retry = retry
         self.fault_injector = fault_injector
+        #: observability facade (kubernetes_tpu/obs): per-verb transport
+        #: spans on the caller's in-flight cycle trace (None = silent)
+        self.obs = obs
         self._md = ([("authorization", f"Bearer {token}")]
                     if token else None)
 
         def with_md(callable_, verb: str = "", unary: bool = False):
             inj, md = self.fault_injector, self._md
-            plain = inj is None and not (unary and retry is not None)
+            plain = (inj is None and obs is None
+                     and not (unary and retry is not None))
             if md is None and plain:
                 return callable_
 
             def call(*a, **kw):
+                from contextlib import nullcontext
+
                 if md is not None:
                     kw.setdefault("metadata", md)
 
@@ -514,9 +536,12 @@ class GrpcSchedulerClient:
                         inj.transport_fault(f"grpc:{verb}")
                     return callable_(*a, **kw)
 
-                if unary and self.retry is not None:
-                    return self.retry.call(once)
-                return once()
+                span = (self.obs.span(f"grpc:{verb}")
+                        if self.obs is not None else nullcontext())
+                with span:
+                    if unary and self.retry is not None:
+                        return self.retry.call(once)
+                    return once()
 
             return call
 

@@ -288,3 +288,51 @@ class SchedulerMetrics:
             "scheduler_cycle_deadline_exceeded_total",
             "Cycles whose deadline expired before the ladder finished.",
         ))
+        # -- runtime JAX telemetry (kubernetes_tpu/obs): the dynamic twin
+        # of graftlint's static R3 rule, plus host-boundary transfer
+        # accounting and Sinkhorn convergence ---------------------------
+        self.jax_compile_cache = r.register(Counter(
+            "scheduler_jax_compile_cache_total",
+            "Jitted-call observations by site and class (hit = abstract "
+            "signature seen before; compile = site's first signature; "
+            "retrace = NEW signature at a warmed site, i.e. an XLA "
+            "recompile).",
+            ["site", "result"],
+        ))
+        self.jax_retraces = r.register(Counter(
+            "scheduler_jax_retrace_total",
+            "Retraces (new abstract signature at an already-compiled call "
+            "site) — each one is a synchronous XLA recompile on the hot "
+            "path.",
+            ["site"],
+        ))
+        self.jax_retrace_storms = r.register(Counter(
+            "scheduler_jax_retrace_storm_total",
+            "Retrace storms: threshold-many retraces at one site within "
+            "the call window (bucketed batch shapes exist to keep this 0).",
+            ["site"],
+        ))
+        self.host_transfers = r.register(Counter(
+            "scheduler_host_transfer_total",
+            "Device<->host transfers at declared host boundaries, by site "
+            "and direction (h2d upload / d2h readback).",
+            ["site", "direction"],
+        ))
+        self.host_transfer_bytes = r.register(Counter(
+            "scheduler_host_transfer_bytes_total",
+            "Bytes moved across the device boundary at declared host "
+            "boundaries.",
+            ["site", "direction"],
+        ))
+        self.sinkhorn_iterations = r.register(Histogram(
+            "scheduler_sinkhorn_iterations",
+            "Sinkhorn scaling iterations until the row-potential delta "
+            "dropped under tolerance (== configured iters when it never "
+            "converged).",
+            buckets=[1, 2, 4, 8, 16, 32, 64, 128],
+        ))
+        self.sinkhorn_residual = r.register(Gauge(
+            "scheduler_sinkhorn_final_residual",
+            "Final max row-potential delta of the last Sinkhorn solve "
+            "(log-domain; lower is more converged).",
+        ))

@@ -1,0 +1,45 @@
+"""Observability for the batched solve path.
+
+Three cooperating pieces, all host-side (the hot path stays jit-clean —
+every capture happens at the host boundaries graftlint already blesses):
+
+- :mod:`kubernetes_tpu.obs.trace` — the ``k8s.io/utils/trace`` analog
+  grown up: nested spans, threshold-gated klog dump, and a Chrome
+  trace-event JSON exporter so a scheduling cycle opens in
+  ``chrome://tracing`` / Perfetto.
+- :mod:`kubernetes_tpu.obs.jaxtel` — runtime JAX telemetry: compile-cache
+  hit/miss and retrace-storm counters keyed by call-site + abstract
+  shapes (host-side shape digests; zero host syncs inside jitted code),
+  plus device<->host transfer accounting at declared host boundaries.
+- :mod:`kubernetes_tpu.obs.recorder` — a bounded ring-buffer flight
+  recorder of recent cycle records (batch shape digest, ladder tier,
+  fallback/retry/breaker transitions, span timings), dumpable via
+  debugger.py / SIGUSR2 and the ``/debug/flightrecorder`` endpoint.
+
+:class:`kubernetes_tpu.obs.core.Observability` is the facade the
+scheduler owns; config rides :class:`kubernetes_tpu.config.
+ObservabilityConfig` (and its v1alpha1 block).
+"""
+
+from kubernetes_tpu.obs.core import Observability
+from kubernetes_tpu.obs.jaxtel import JaxTelemetry, abstract_digest, tree_nbytes
+from kubernetes_tpu.obs.recorder import CycleRecord, FlightRecorder
+from kubernetes_tpu.obs.trace import (
+    DEFAULT_THRESHOLD_S,
+    Span,
+    Trace,
+    chrome_trace_json,
+)
+
+__all__ = [
+    "Observability",
+    "JaxTelemetry",
+    "abstract_digest",
+    "tree_nbytes",
+    "CycleRecord",
+    "FlightRecorder",
+    "Span",
+    "Trace",
+    "DEFAULT_THRESHOLD_S",
+    "chrome_trace_json",
+]

@@ -1,0 +1,212 @@
+"""The Observability facade the scheduler owns: one object tying the
+cycle tracer, the JAX telemetry counters, and the flight recorder to the
+typed config (:class:`kubernetes_tpu.config.ObservabilityConfig`) and
+the metrics registry.
+
+Lifecycle per scheduling cycle::
+
+    trace = obs.begin_cycle(cycle_no)     # always returns a Trace
+    with obs.span("snapshot"): ...        # nested spans on that trace
+    obs.note_batch_shape("P8xN5")         # scratch notes for the record
+    obs.end_cycle(res)                    # -> CycleRecord + trace ring
+
+Trace retention is SAMPLED (``trace_sampling`` — deterministic,
+counter-based, no RNG: the k-th EVENTFUL cycle is retained when
+``floor(k*rate)`` advances; idle polls don't consume sampling slots),
+but the trace object itself always exists so
+``log_if_long`` keeps its always-on cheap-profiler role. Everything
+runs on the injected clock; nothing here touches device values except
+:meth:`end_cycle`'s single sinkhorn-stats readback, which happens at the
+cycle's host boundary alongside the driver's own readbacks."""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager, nullcontext
+from typing import Callable, List, Optional
+
+from kubernetes_tpu.obs.jaxtel import JaxTelemetry
+from kubernetes_tpu.obs.recorder import CycleRecord, FlightRecorder
+from kubernetes_tpu.obs.trace import Trace, chrome_trace_json
+
+
+class Observability:
+    def __init__(self, config=None, metrics=None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if config is None:
+            from kubernetes_tpu.config import ObservabilityConfig
+
+            config = ObservabilityConfig()
+        self.config = config
+        self.metrics = metrics
+        self.clock = clock
+        self.jax = JaxTelemetry(
+            metrics=metrics,
+            storm_threshold=config.retrace_storm_threshold,
+            storm_window=config.retrace_storm_window,
+        )
+        self.recorder = FlightRecorder(config.recorder_capacity)
+        self.traces: deque = deque(maxlen=max(1, config.trace_ring_capacity))
+        #: guards the traces ring: the scheduler thread appends while the
+        #: /debug/traces handler thread snapshots (deque iteration during
+        #: an append raises RuntimeError)
+        self._traces_lock = threading.Lock()
+        self.current_trace: Optional[Trace] = None
+        self.last_trace: Optional[Trace] = None
+        #: EVENTFUL cycles seen — the trace-sampling sequence. Idle
+        #: serve-loop polls must not consume sampling slots: a workload
+        #: phase-locked with the poll period (pods landing every other
+        #: poll) would otherwise park every eventful cycle on the
+        #: unsampled phase and retain nothing, forever.
+        self._eventful_seq = 0
+        # per-cycle scratch, reset by begin_cycle
+        self._scratch: dict = {}
+        self._sinkhorn_stats = None  # device (2,) [iters, residual]
+        self._retraces_at_begin = 0
+
+    # -- cycle lifecycle ----------------------------------------------------
+
+    def _sampled(self, seq: int) -> bool:
+        rate = min(max(float(self.config.trace_sampling), 0.0), 1.0)
+        if rate <= 0.0:
+            return False
+        return math.floor(seq * rate) > math.floor((seq - 1) * rate)
+
+    def begin_cycle(self, cycle: int = 0) -> Trace:
+        self._scratch = {"cycle": cycle, "t": self.clock(),
+                         "breakers": [], "retries": 0,
+                         "deadline_exceeded": False}
+        self._sinkhorn_stats = None
+        self._retraces_at_begin = self.jax.retrace_total()
+        self.current_trace = Trace("Scheduling cycle", clock=self.clock,
+                                   cycle=cycle)
+        return self.current_trace
+
+    def span(self, name: str, **fields):
+        """Nested span on the in-flight cycle trace (no-op outside a
+        cycle — extender/shim instrumentation stays safe standalone)."""
+        if self.current_trace is None:
+            return nullcontext()
+        return self.current_trace.span(name, **fields)
+
+    def step(self, msg: str) -> None:
+        if self.current_trace is not None:
+            self.current_trace.step(msg)
+
+    # -- scratch notes (cycle-scoped inputs to the flight record) -----------
+
+    def note_cycle(self, cycle: int) -> None:
+        """Stamp the real cycle number (known only after pop_batch —
+        begin_cycle ran before the queue incremented it) on the record
+        AND the in-flight trace, so /debug/traces and
+        /debug/flightrecorder agree on which cycle a span belongs to."""
+        self._scratch["cycle"] = cycle
+        tr = self.current_trace
+        if tr is not None:
+            tr.fields["cycle"] = cycle
+            tr.root.fields["cycle"] = cycle
+
+    def note_batch_shape(self, digest: str) -> None:
+        self._scratch["batch_shape"] = digest
+
+    def note_breaker(self, target: str, old: str, new: str) -> None:
+        if "breakers" in self._scratch:
+            self._scratch["breakers"].append((target, old, new))
+
+    def note_retry(self) -> None:
+        self._scratch["retries"] = self._scratch.get("retries", 0) + 1
+
+    def note_deadline_exceeded(self) -> None:
+        self._scratch["deadline_exceeded"] = True
+
+    def note_sinkhorn(self, stats) -> None:
+        """Stash the solver's (iters, residual) device pair; read back
+        once at end_cycle (the cycle's host boundary)."""
+        self._sinkhorn_stats = stats
+
+    # -- cycle close --------------------------------------------------------
+
+    def end_cycle(self, res=None) -> Optional[CycleRecord]:
+        trace = self.current_trace
+        self.current_trace = None
+        if trace is None:
+            return None
+        trace.finish()
+        self.last_trace = trace
+        sk_iters = sk_resid = -1.0
+        if self._sinkhorn_stats is not None:
+            # the one device readback this module performs — at the host
+            # boundary, next to the driver's own result readbacks.
+            # [-1, -1] is the solver's "plan never engaged" sentinel
+            # (argmax rounds all the way): not a convergence sample.
+            arr = self.jax.readback("sinkhorn-stats", self._sinkhorn_stats)
+            if float(arr[0]) >= 0:
+                sk_iters, sk_resid = float(arr[0]), float(arr[1])
+                if self.metrics is not None:
+                    self.metrics.sinkhorn_iterations.observe(sk_iters)
+                    self.metrics.sinkhorn_residual.set(sk_resid)
+            self._sinkhorn_stats = None
+        if not self.config.enabled:
+            return None
+        s = self._scratch
+        # idle poll cycles (empty batch, nothing attempted, no incident
+        # activity) are not black-box material: recording them would let
+        # ~a minute of idle 0.25s serve-loop polls evict every record of
+        # the incident the recorder exists to explain
+        attempted = getattr(res, "attempted", 0) if res is not None else 0
+        eventful = bool(
+            attempted
+            or s.get("retries", 0)
+            or s.get("deadline_exceeded", False)
+            or s.get("breakers")
+        )
+        if not eventful:
+            return None
+        rec = CycleRecord(
+            cycle=s.get("cycle", 0),
+            t=s.get("t", 0.0),
+            batch_shape=s.get("batch_shape", ""),
+            tier=getattr(res, "solver_tier", "") if res is not None else "",
+            fallbacks=(getattr(res, "solver_fallbacks", 0)
+                       if res is not None else 0),
+            retries=s.get("retries", 0),
+            deadline_exceeded=s.get("deadline_exceeded", False),
+            breaker_transitions=list(s.get("breakers", ())),
+            attempted=getattr(res, "attempted", 0) if res is not None else 0,
+            scheduled=getattr(res, "scheduled", 0) if res is not None else 0,
+            unschedulable=(getattr(res, "unschedulable", 0)
+                           if res is not None else 0),
+            elapsed_s=getattr(res, "elapsed_s", 0.0) if res is not None else 0.0,
+            spans=trace.span_durations(),
+            retraces=self.jax.retrace_total() - self._retraces_at_begin,
+            sinkhorn_iters=sk_iters,
+            sinkhorn_residual=sk_resid,
+        )
+        self.recorder.record(rec)
+        self._eventful_seq += 1
+        if self._sampled(self._eventful_seq):
+            with self._traces_lock:
+                self.traces.append(trace)
+        return rec
+
+    # -- export / debug endpoints -------------------------------------------
+
+    def chrome_trace(self) -> dict:
+        """Chrome trace-event document over the retained trace ring."""
+        with self._traces_lock:
+            traces = list(self.traces)
+        return chrome_trace_json(traces)
+
+    def export_chrome_trace(self) -> str:
+        return json.dumps(self.chrome_trace())
+
+    def debug_payload(self) -> dict:
+        """The /debug/flightrecorder body: recorder ring + JAX telemetry."""
+        return {
+            "flight_recorder": self.recorder.to_json(),
+            "jax": self.jax.snapshot(),
+        }

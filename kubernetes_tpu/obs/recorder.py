@@ -1,0 +1,141 @@
+"""Flight recorder — a bounded ring buffer of recent scheduling-cycle
+records, the black box read AFTER something went wrong.
+
+Metrics aggregate away the shape of an incident; the recorder keeps the
+last N cycles verbatim: batch shape digest, which ladder tier actually
+produced the placements, every fallback/retry/breaker transition taken,
+and the cycle's span timings. Dump paths: ``debugger.dump`` (SIGUSR2),
+the ``/debug/flightrecorder`` endpoint on server.py, or
+:meth:`FlightRecorder.dump` directly in a postmortem shell.
+
+Capacity is hard-bounded (``collections.deque(maxlen=...)``) so an
+incident that lasts hours cannot grow memory — the newest record evicts
+the oldest. Timestamps ride the owner's injected clock (monotonic by
+default): deterministic under fake clocks, R4-clean."""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+
+@dataclass
+class CycleRecord:
+    """One cycle's black-box row."""
+
+    cycle: int = 0
+    t: float = 0.0  # cycle start, owner clock
+    batch_shape: str = ""  # e.g. "P8xN2+topo" (padded pods x nodes)
+    tier: str = ""  # ladder tier that produced the placements
+    fallbacks: int = 0
+    retries: int = 0
+    deadline_exceeded: bool = False
+    #: (target, old_state, new_state) breaker flips observed this cycle
+    breaker_transitions: List[Tuple[str, str, str]] = field(
+        default_factory=list)
+    attempted: int = 0
+    scheduled: int = 0
+    unschedulable: int = 0
+    elapsed_s: float = 0.0
+    #: span name -> seconds (Trace.span_durations of the cycle trace)
+    spans: Dict[str, float] = field(default_factory=dict)
+    #: JAX telemetry deltas worth keeping per cycle
+    retraces: int = 0
+    sinkhorn_iters: float = -1.0  # -1 = sinkhorn not engaged
+    sinkhorn_residual: float = -1.0
+
+    def to_json(self) -> dict:
+        return {
+            "cycle": self.cycle,
+            "t": round(self.t, 6),
+            "batch_shape": self.batch_shape,
+            "tier": self.tier,
+            "fallbacks": self.fallbacks,
+            "retries": self.retries,
+            "deadline_exceeded": self.deadline_exceeded,
+            "breaker_transitions": [list(x) for x in self.breaker_transitions],
+            "attempted": self.attempted,
+            "scheduled": self.scheduled,
+            "unschedulable": self.unschedulable,
+            "elapsed_s": round(self.elapsed_s, 6),
+            "spans": {k: round(v, 6) for k, v in self.spans.items()},
+            "retraces": self.retraces,
+            **({"sinkhorn_iters": self.sinkhorn_iters,
+                "sinkhorn_residual": self.sinkhorn_residual}
+               if self.sinkhorn_iters >= 0 else {}),
+        }
+
+
+class FlightRecorder:
+    """Bounded ring of :class:`CycleRecord`."""
+
+    def __init__(self, capacity: int = 256) -> None:
+        self.capacity = max(1, int(capacity))
+        self._buf: deque = deque(maxlen=self.capacity)
+        #: serializes the scheduler thread's appends against snapshot
+        #: reads from the /debug handler thread and the SIGUSR2 dump —
+        #: iterating a deque mid-append raises RuntimeError
+        self._lock = threading.Lock()
+        #: lifetime count (so eviction is observable: recorded - len)
+        self.recorded = 0
+
+    def record(self, rec: CycleRecord) -> None:
+        with self._lock:
+            self._buf.append(rec)
+            self.recorded += 1
+
+    def records(self) -> List[CycleRecord]:
+        with self._lock:
+            return list(self._buf)
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def to_json(self) -> dict:
+        with self._lock:
+            recs = list(self._buf)
+            recorded = self.recorded
+        return {
+            "capacity": self.capacity,
+            "recorded": recorded,
+            "evicted": max(0, recorded - len(recs)),
+            "records": [r.to_json() for r in recs],
+        }
+
+    def dump(self) -> str:
+        """Readable postmortem text (the SIGUSR2 / debugger.dump shape)."""
+        with self._lock:
+            recs = list(self._buf)
+            recorded = self.recorded
+        lines = [
+            f"Flight recorder: {len(recs)}/{self.capacity} records "
+            f"({max(0, recorded - len(recs))} evicted)"
+        ]
+        for r in recs:
+            flags = []
+            if r.deadline_exceeded:
+                flags.append("DEADLINE")
+            if r.fallbacks:
+                flags.append(f"fallbacks={r.fallbacks}")
+            if r.retries:
+                flags.append(f"retries={r.retries}")
+            for tgt, old, new in r.breaker_transitions:
+                flags.append(f"breaker[{tgt}]:{old}->{new}")
+            spans = " ".join(
+                f"{k}={v*1000:.1f}ms" for k, v in sorted(r.spans.items()))
+            lines.append(
+                f"  cycle {r.cycle} t={r.t:.3f} {r.batch_shape or '-'} "
+                f"tier={r.tier or '-'} "
+                f"attempted={r.attempted} scheduled={r.scheduled} "
+                f"unsched={r.unschedulable} {r.elapsed_s*1000:.1f}ms"
+                + (f" [{' '.join(flags)}]" if flags else "")
+            )
+            if spans:
+                lines.append(f"    {spans}")
+        return "\n".join(lines)

@@ -274,12 +274,13 @@ def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray
 @partial(jax.jit, static_argnames=("weights_key", "max_rounds", "per_node_cap",
                                    "use_sinkhorn", "skip_key", "no_ports",
                                    "no_pod_affinity", "no_spread",
-                                   "fused_score", "auto_sinkhorn"))
+                                   "fused_score", "auto_sinkhorn",
+                                   "with_stats"))
 def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
                 extra_mask, vol=None, static_vol=None, enabled_mask=None,
                 extra_score=None, use_sinkhorn=False, skip_key=(),
                 no_ports=False, no_pod_affinity=False, no_spread=False,
-                fused_score=True, auto_sinkhorn=True):
+                fused_score=True, auto_sinkhorn=True, with_stats=False):
     weights = dict(weights_key) if weights_key is not None else None
     # trace-time routing gate: no preference kernel live -> no possible
     # asymmetric tie cohort -> compile the router (and the plan branch)
@@ -332,7 +333,7 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
         sens = None
 
     def round_body(carry):
-        assigned, u, _, rnd, use_plan = carry
+        assigned, u, _, rnd, use_plan, sk_stats = carry
         cur = nodes_with_usage(nodes, u)
         active = (assigned == -1) & pods.valid
         mask = (
@@ -410,21 +411,30 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
             # choose from the entropic-OT transport plan instead of the raw
             # per-pod argmax: the plan balances the whole batch against node
             # capacities, so contended pods pre-spread instead of colliding
-            # (ops/sinkhorn.py; SURVEY.md §7.2 step 5)
+            # (ops/sinkhorn.py; SURVEY.md §7.2 step 5). Convergence stats
+            # (iterations-to-tolerance, final residual) ride the carry so
+            # the driver can surface them per cycle without a host sync;
+            # with_stats is a static key, so disabling telemetry compiles
+            # the stats scan out entirely.
             from kubernetes_tpu.ops.sinkhorn import sinkhorn_plan
 
-            plan = sinkhorn_plan(masked, mask, slots)
+            if with_stats:
+                plan, stats = sinkhorn_plan(masked, mask, slots,
+                                            with_stats=True)
+            else:
+                plan = sinkhorn_plan(masked, mask, slots)
+                stats = jnp.full((2,), -1.0, jnp.float32)
             # identical pods get identical plan rows (Sinkhorn scaling
             # preserves row identity), so the plan argmax needs the same
             # rotation tie-break as the raw-score branch or a uniform
             # cohort herds onto one node at per_node_cap pods/round
             pmasked = jnp.where(mask, plan, -1.0)
             prowmax = jnp.max(pmasked, axis=1, keepdims=True)
-            return mask & (pmasked >= prowmax)
+            return mask & (pmasked >= prowmax), stats
 
         argmax_tied = mask & (score >= rowmax)
         if use_sinkhorn:
-            tied = plan_tied(column_slots())
+            tied, sk_stats = plan_tied(column_slots())
         elif auto_sinkhorn:
             # ---- per-batch solver routing (VERDICT r4 item 5) ----
             # Decide ONCE, from round 0's structures: the plan wins only
@@ -466,9 +476,11 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
             prev_decision = use_plan
             use_plan = jax.lax.cond(rnd == 0, detect,
                                     lambda: prev_decision)
-            tied = jax.lax.cond(use_plan,
-                                lambda: plan_tied(slots),
-                                lambda: argmax_tied)
+            prev_stats = sk_stats
+            tied, sk_stats = jax.lax.cond(
+                use_plan,
+                lambda: plan_tied(slots),
+                lambda: (argmax_tied, prev_stats))
         else:
             tied = argmax_tied
         # tie-position bookkeeping: counts are bounded by N, so the (P, N)
@@ -564,17 +576,20 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
         new_assigned = jnp.where(accepted, choice, assigned)
         u = _apply_batch(u, pods, jnp.where(accepted, choice, 0), accepted)
         progressed = jnp.any(accepted)
-        return new_assigned, u, progressed, rnd + 1, use_plan
+        return new_assigned, u, progressed, rnd + 1, use_plan, sk_stats
 
     def cond(carry):
-        _, _, progressed, rnd, _ = carry
+        _, _, progressed, rnd, _, _ = carry
         return progressed & (rnd < max_rounds)
 
+    # sk_stats: [-1, -1] = sinkhorn never engaged this solve; otherwise
+    # the LAST round's [iterations-to-converge, final residual]
     init = (jnp.full((P,), -1, jnp.int32), usage_from_nodes(nodes),
             jnp.asarray(True), jnp.asarray(0, jnp.int32),
-            jnp.asarray(False))
-    assigned, u, _, rounds, _ = jax.lax.while_loop(cond, round_body, init)
-    return assigned, u, rounds
+            jnp.asarray(False), jnp.full((2,), -1.0, jnp.float32))
+    assigned, u, _, rounds, _, sk_stats = jax.lax.while_loop(
+        cond, round_body, init)
+    return assigned, u, rounds, sk_stats
 
 
 def batch_assign(
@@ -599,12 +614,19 @@ def batch_assign(
     auto_sinkhorn: bool = True,
     fault_hook=None,
     fault_site: str = "solve:batch",
+    stats_out: bool = False,
 ) -> Tuple[jnp.ndarray, UsageState, jnp.ndarray]:
     """Fast batched solver. Returns (assigned row per pod or -1, final
     usage, rounds executed). ``per_node_cap`` bounds admissions per node per
     round (see _batch_impl); with P pending pods and N nodes expect about
     ceil(P / (N * cap)) rounds on uniform workloads. ``extra_mask`` as in
     :func:`greedy_assign`.
+
+    ``stats_out`` appends a 4th element: a (2,) f32 device array
+    [sinkhorn iterations-to-converge, final residual] from the last
+    round that ran the transport plan, or [-1, -1] when the plan never
+    engaged (argmax path). Stays a device value — the observability
+    layer reads it back once per cycle at the host boundary.
 
     ``fused_score`` (feature flag, default on): collapse the two hoisted
     normalize-reduce scoring kernels into one single-output pass per
@@ -624,18 +646,20 @@ def batch_assign(
         from kubernetes_tpu.ops.fused_score import use_pallas
 
         fused_score = use_pallas()
-    assigned, u, rounds = _batch_impl(
+    assigned, u, rounds, sk_stats = _batch_impl(
         pods, nodes, sel, topo, key, max_rounds, per_node_cap,
         extra_mask, vol, static_vol, enabled_mask, extra_score,
         use_sinkhorn, skip_key=tuple(skip_priorities),
         no_ports=no_ports, no_pod_affinity=no_pod_affinity,
         no_spread=no_spread, fused_score=fused_score,
-        auto_sinkhorn=auto_sinkhorn)
+        auto_sinkhorn=auto_sinkhorn, with_stats=stats_out)
     if fault_hook is not None:
         # the fault-injection seam (see greedy_assign): the hook stands
         # where an out-of-process solver's response would be decoded
         assigned, u, rounds = fault_hook(fault_site, assigned, u, rounds,
                                          nodes.allocatable.shape[0])
+    if stats_out:
+        return assigned, u, rounds, sk_stats
     return assigned, u, rounds
 
 

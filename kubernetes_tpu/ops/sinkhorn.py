@@ -62,22 +62,52 @@ def _col_lse(logk: jnp.ndarray, u: jnp.ndarray) -> jnp.ndarray:
     return jax.scipy.special.logsumexp(logk + u[:, None], axis=0)
 
 
-def _scale_jnp(logk, log_r, log_c, iters):
-    """Alternating log-domain scaling; columns clipped at 0 (inequality)."""
+#: convergence tolerance for the telemetry scan: max |u - u_prev| under
+#: this counts the iteration as converged (log-domain, so ~relative)
+STATS_TOL = 1e-3
 
-    def body(carry, _):
-        u, v = carry
+
+def _stats_scan(step, u0, v0, iters, tol=STATS_TOL):
+    """Run ``step`` for ``iters`` iterations while tracking convergence:
+    returns (u, v, stats) with stats = [first iteration whose max row-
+    potential delta dropped under ``tol`` (or ``iters`` if never),
+    final delta]. Same math as the plain scan — the extra carry is a
+    scalar counter and a (P,)-sized masked subtraction per iteration."""
+
+    def body(carry, i):
+        u, v, conv = carry
+        u2, v2 = step(u, v)
+        finite = (u2 > NEG_INF / 2) & (u > NEG_INF / 2)
+        delta = jnp.max(jnp.where(finite, jnp.abs(u2 - u), 0.0))
+        conv = jnp.where((conv < 0) & (delta < tol), i + 1, conv)
+        return (u2, v2, conv), delta
+
+    (u, v, conv), deltas = jax.lax.scan(
+        body, (u0, v0, jnp.asarray(-1, jnp.int32)),
+        jnp.arange(iters, dtype=jnp.int32))
+    iters_used = jnp.where(conv < 0, iters, conv).astype(jnp.float32)
+    return u, v, jnp.stack([iters_used, deltas[-1].astype(jnp.float32)])
+
+
+def _scale_jnp(logk, log_r, log_c, iters, with_stats=False):
+    """Alternating log-domain scaling; columns clipped at 0 (inequality).
+    Returns (u, v, stats) — stats is None unless ``with_stats``."""
+
+    def step(u, v):
         u = log_r - _row_lse(logk, v)
         u = jnp.where(jnp.isfinite(u), u, NEG_INF)
         v = jnp.minimum(log_c - _col_lse(logk, u), 0.0)
         v = jnp.where(jnp.isfinite(v), v, 0.0)
-        return (u, v), None
+        return u, v
 
     P, N = logk.shape
+    u0, v0 = jnp.zeros((P,)), jnp.zeros((N,))
+    if with_stats:
+        return _stats_scan(step, u0, v0, iters)
     (u, v), _ = jax.lax.scan(
-        body, (jnp.zeros((P,)), jnp.zeros((N,))), None, length=iters
+        lambda carry, _: (step(*carry), None), (u0, v0), None, length=iters
     )
-    return u, v
+    return u, v, None
 
 
 # ---------------------------------------------------------------------------
@@ -155,7 +185,7 @@ def _block_shapes(P0: int, N0: int, block_p: int = BLOCK_P,
 
 
 def _scale_pallas(logk, log_r, log_c, iters, block_p=BLOCK_P, block_n=BLOCK_N,
-                  interpret=False):
+                  interpret=False, with_stats=False):
     from jax.experimental import pallas as pl
 
     P0, N0 = logk.shape
@@ -195,18 +225,20 @@ def _scale_pallas(logk, log_r, log_c, iters, block_p=BLOCK_P, block_n=BLOCK_N,
     log_r2 = log_r[None, :]
     log_c2 = log_c[None, :]
 
-    def body(carry, _):
-        u, v = carry
+    def step(u, v):
         u = u_call(logk, v, log_r2)
         v = v_call(logk, u, log_c2)
-        return (u, v), None
+        return u, v
 
+    u0 = jnp.zeros((1, P), logk.dtype)
+    v0 = jnp.zeros((1, N), logk.dtype)
+    if with_stats:
+        u, v, stats = _stats_scan(step, u0, v0, iters)
+        return u[0, :P0], v[0, :N0], stats
     (u, v), _ = jax.lax.scan(
-        body,
-        (jnp.zeros((1, P), logk.dtype), jnp.zeros((1, N), logk.dtype)),
-        None, length=iters,
+        lambda carry, _: (step(*carry), None), (u0, v0), None, length=iters,
     )
-    return u[0, :P0], v[0, :N0]
+    return u[0, :P0], v[0, :N0], None
 
 
 @functools.lru_cache(maxsize=64)
@@ -222,8 +254,9 @@ def _pallas_compiles(bp: int, bn: int, P: int, N: int) -> bool:
     try:
         # graftlint: disable=R3 -- one-time compile probe, memoized by the
         # lru_cache above: the wrapper is built once per (block, shape) key
-        u, v = jax.jit(functools.partial(
-            _scale_pallas, iters=1, block_p=bp, block_n=bn))(
+        u, v, _ = jax.jit(functools.partial(
+            _scale_pallas, iters=1, block_p=bp, block_n=bn,
+            with_stats=False))(
             jnp.zeros((P, N), jnp.float32),
             jnp.zeros((P,), jnp.float32),
             jnp.zeros((N,), jnp.float32),
@@ -253,10 +286,17 @@ def sinkhorn_plan(
     iters: int = 25,
     pallas: Optional[bool] = None,
     interpret: Optional[bool] = None,
+    with_stats: bool = False,
 ) -> jnp.ndarray:
     """Transport plan (P, N): plan[p, j] ≈ how much of pod p's unit demand
     node j serves at equilibrium. Row sums <= 1 (== 1 when the pod fits
     anywhere with spare capacity); column sums <= capacity + O(tolerance).
+
+    ``with_stats`` additionally returns a (2,) f32 device array
+    [iterations-to-converge (== ``iters`` when the tolerance was never
+    reached), final max row-potential delta] — the per-solve convergence
+    telemetry the observability layer surfaces (obs/core.py reads it back
+    once per cycle at the host boundary). Same scaling math either way.
     """
     score = score.astype(jnp.float32)
     row_ok = jnp.any(mask, axis=1)
@@ -273,10 +313,15 @@ def sinkhorn_plan(
             # compile error out of the caller's jit
             pallas = _pallas_compiles(*_block_shapes(*logk.shape))
     if pallas:
-        u, v = _scale_pallas(logk, log_r, log_c, iters, interpret=interp)
+        u, v, stats = _scale_pallas(logk, log_r, log_c, iters,
+                                    interpret=interp, with_stats=with_stats)
     else:
-        u, v = _scale_jnp(logk, log_r, log_c, iters)
+        u, v, stats = _scale_jnp(logk, log_r, log_c, iters,
+                                 with_stats=with_stats)
     plan = jnp.exp(
         jnp.clip(logk + u[:, None] + v[None, :], NEG_INF, 30.0)
     )
-    return jnp.where(mask, plan, 0.0)
+    plan = jnp.where(mask, plan, 0.0)
+    if with_stats:
+        return plan, stats
+    return plan

@@ -159,12 +159,14 @@ class Scheduler:
         robustness=None,
         fault_injector=None,
         retry_sleep: Callable[[float], None] = time.sleep,
+        observability=None,
     ) -> None:
-        from kubernetes_tpu.config import RobustnessConfig
+        from kubernetes_tpu.config import ObservabilityConfig, RobustnessConfig
         from kubernetes_tpu.faults import CircuitBreaker, RetryPolicy
         from kubernetes_tpu.framework import Framework
         from kubernetes_tpu.metrics import SchedulerMetrics
         from kubernetes_tpu.nodetree import NodeTree
+        from kubernetes_tpu.obs import Observability
 
         #: which pods this scheduler is responsible for
         #: (eventhandlers.go:328 responsibleForPod — the multi-scheduler
@@ -178,6 +180,13 @@ class Scheduler:
         #: filter/score passes for interested pods
         self.extenders = list(extenders)
         self.metrics = metrics or SchedulerMetrics()
+        #: observability layer (kubernetes_tpu/obs): cycle tracer + flight
+        #: recorder + runtime JAX telemetry, on the scheduler's clock
+        self.obs = Observability(
+            observability if observability is not None
+            else ObservabilityConfig(trace_threshold_s=trace_threshold_s),
+            metrics=self.metrics, clock=clock,
+        )
         #: degradation-ladder knobs (config.RobustnessConfig): per-cycle
         #: deadline, bounded retries, breaker thresholds, fallback chain,
         #: result validation — the resilience layer for an out-of-process
@@ -198,13 +207,19 @@ class Scheduler:
             sleep=retry_sleep,
         )
         for e in self.extenders:
-            # wire retry + fault hooks into transports that expose the
-            # seam (HTTPExtender); duck-typed so test fakes stay valid
+            # wire retry + fault + observability hooks into transports
+            # that expose the seam (HTTPExtender); duck-typed so test
+            # fakes stay valid
             if getattr(e, "retry", "absent") is None:
                 e.retry = self._transport_retry
             if (fault_injector is not None
                     and getattr(e, "fault_injector", "absent") is None):
                 e.fault_injector = fault_injector
+            if getattr(e, "obs", "absent") is None:
+                e.obs = self.obs
+            if getattr(e, "_clock_defaulted", False):
+                e._clock = clock
+                e._clock_defaulted = False
         #: per-target circuit breakers ("solver:batch",
         #: "extender:<url>"), created lazily against this clock
         self._breakers: Dict[str, CircuitBreaker] = {}
@@ -212,8 +227,13 @@ class Scheduler:
         self._cycle_deadline: Optional[float] = None
         #: cycles slower than this log their step trace (utiltrace
         #: LogIfLong; default is cycle-scale, not the reference's per-pod
-        #: 100ms, since one cycle schedules a whole batch)
-        self.trace_threshold_s = trace_threshold_s
+        #: 100ms, since one cycle schedules a whole batch). A provided
+        #: ObservabilityConfig owns the knob; the legacy ctor param stays
+        #: the fallback.
+        self.trace_threshold_s = (
+            observability.trace_threshold_s if observability is not None
+            else trace_threshold_s
+        )
         #: enabled-predicate bitmask (config.Policy.predicate_mask);
         #: None = every implemented predicate enforced
         self.pred_mask = pred_mask
@@ -291,6 +311,7 @@ class Scheduler:
         kw.setdefault("max_batch", cfg.max_batch)
         kw.setdefault("scheduler_name", cfg.scheduler_name)
         kw.setdefault("robustness", cfg.robustness)
+        kw.setdefault("observability", cfg.observability)
         if getattr(cfg, "plugins", ()) and "framework" not in kw:
             # config-driven framework assembly (the NewFramework path,
             # framework.go:88: registry factories + per-plugin args from
@@ -472,7 +493,6 @@ class Scheduler:
         from kubernetes_tpu.ops.predicates import decode_reasons
 
         from kubernetes_tpu.framework import CycleState
-        from kubernetes_tpu.utils.trace import Trace
 
         t0 = self.clock()
         res = CycleResult()
@@ -483,7 +503,7 @@ class Scheduler:
             t0 + self.robustness.cycle_deadline_s
             if self.robustness.cycle_deadline_s > 0 else None
         )
-        trace = Trace("Scheduling cycle", clock=self.clock)
+        trace = self.obs.begin_cycle(self.queue.scheduling_cycle)
         self.queue.tick()
         self.cache.cleanup_expired()
         self._process_waiting(res)
@@ -491,8 +511,10 @@ class Scheduler:
         if not batch:
             res.elapsed_s = self.clock() - t0
             self._record_metrics(res)
+            self.obs.end_cycle(res)
             return res
         cycle = self.queue.scheduling_cycle
+        self.obs.note_cycle(cycle)
         # skipPodSchedule (scheduler.go:335): a pod already marked for
         # deletion is dropped from the cycle, not retried — its DELETED
         # event (kubelet kill or pod-GC) is the terminal outcome
@@ -515,36 +537,47 @@ class Scheduler:
         if not batch:
             res.elapsed_s = self.clock() - t0
             self._record_metrics(res)
+            self.obs.end_cycle(res)
             return res
 
         # pack: pods first (their programs grow universes), then snapshot
-        pk = self.cache.packer
-        batch_keys = {p.key() for p in batch}
-        nominated = self._nominated_pods(exclude=batch_keys)
-        for p in batch:
-            pk.intern_pod(p)
-        for p, _ in nominated:
-            pk.intern_pod(p)
-        nt = self.cache.snapshot()
-        node_order = self.cache.node_order()
-        pt = pk.pack_pods(batch)
-        # host-side feature gates: priorities whose inputs are absent from
-        # THIS snapshot are replaced by their exact constants inside the
-        # solve, and the port-conflict matmuls are skipped for port-free
-        # batches (static jit keys; ops/priorities.empty_priorities,
-        # ops/predicates.pods_have_no_ports)
-        skip_prio, no_ports, no_pod_aff, no_spread = solver_gates(nt, pt)
-        dn = nodes_to_device(nt)
-        dp = pods_to_device(pt, pad_to=bucket_size(max(len(batch), 1)))
-        ds = selectors_to_device(pk.pack_selector_tables())
-        dt = topology_to_device(pk.pack_topology_tables()) if _has_topo(pk.u) else None
-        dv = sv = None
-        if any(p.volumes for p in batch):
-            from kubernetes_tpu.ops.arrays import volumes_to_device
+        with self.obs.span("snapshot"):
+            pk = self.cache.packer
+            batch_keys = {p.key() for p in batch}
+            nominated = self._nominated_pods(exclude=batch_keys)
+            for p in batch:
+                pk.intern_pod(p)
+            for p, _ in nominated:
+                pk.intern_pod(p)
+            nt = self.cache.snapshot()
+            node_order = self.cache.node_order()
+            pt = pk.pack_pods(batch)
+            # host-side feature gates: priorities whose inputs are absent
+            # from THIS snapshot are replaced by their exact constants
+            # inside the solve, and the port-conflict matmuls are skipped
+            # for port-free batches (static jit keys;
+            # ops/priorities.empty_priorities,
+            # ops/predicates.pods_have_no_ports)
+            skip_prio, no_ports, no_pod_aff, no_spread = solver_gates(nt, pt)
+            dn = nodes_to_device(nt)
+            dp = pods_to_device(pt, pad_to=bucket_size(max(len(batch), 1)))
+            ds = selectors_to_device(pk.pack_selector_tables())
+            dt = (topology_to_device(pk.pack_topology_tables())
+                  if _has_topo(pk.u) else None)
+            dv = sv = None
+            if any(p.volumes for p in batch):
+                from kubernetes_tpu.ops.arrays import volumes_to_device
 
-            dv = volumes_to_device(pk.pack_volume_tables(batch))
-            sv = _static_vol_pass(dp, dn, ds, dv)
-        trace.step(f"snapshot packed ({len(batch)} pods, {nt.n} nodes)")
+                dv = volumes_to_device(pk.pack_volume_tables(batch))
+                sv = _static_vol_pass(dp, dn, ds, dv)
+            trace.step(f"snapshot packed ({len(batch)} pods, {nt.n} nodes)")
+        # h2d accounting + the batch-shape digest for the flight recorder
+        self.obs.jax.record_upload("snapshot", dp, dn, ds, dt, dv)
+        self.obs.note_batch_shape(
+            f"P{dp.valid.shape[0]}xN{dn.valid.shape[0]}"
+            + ("+topo" if dt is not None else "")
+            + ("+vol" if dv is not None else "")
+        )
 
         # framework Filter/Score contributions: device batch plugins give
         # whole (P, N) matrices; host plugins evaluate per (pod, nodeName)
@@ -612,7 +645,9 @@ class Scheduler:
         # scheduler extenders (generic_scheduler.go:539-566: after built-in
         # predicates; prioritize adds weight*score to the totals :799-829)
         if self.extenders:
-            em, es = self._run_extenders(batch, base_fr, node_order, early_fail)
+            with self.obs.span("extenders"):
+                em, es = self._run_extenders(
+                    batch, base_fr, node_order, early_fail)
             if em is not None:
                 fw_mask = em if fw_mask is None else (fw_mask & em)
             if es is not None:
@@ -679,6 +714,16 @@ class Scheduler:
                     "using round solver"
                 )
                 solver = "batch"
+        # retrace telemetry: classify this solve's abstract signature at
+        # the host boundary BEFORE the jitted call — a new signature at a
+        # warmed site means XLA recompiles underneath (zero host syncs:
+        # the digest reads shape/dtype metadata only)
+        self.obs.jax.record_call(
+            "solve", dp, dn, ds, dt, dv,
+            static=(solver, tuple(skip_prio), no_ports, no_pod_aff,
+                    no_spread, self.pred_mask, self.per_node_cap,
+                    self.max_rounds),
+        )
         ladder = self._solve_ladder(
             solver, batch, dp, dn, ds, dt, dv, sv, base_fr, extra_mask,
             extra_score, skip_prio, no_ports, no_pod_aff, no_spread, res,
@@ -693,10 +738,13 @@ class Scheduler:
             res.elapsed_s = self.clock() - t0
             self._record_metrics(res)
             trace.log_if_long(self.trace_threshold_s)
+            self.obs.end_cycle(res)
             return res
         assigned, usage, rounds, tier_used = ladder
         res.solver_tier = tier_used
-        assigned = np.array(assigned)[: len(batch)]  # writable copy
+        # d2h readback of the solver's answer — the declared host boundary
+        assigned = self.obs.jax.readback(
+            "solve-result", assigned)[: len(batch)].copy()  # writable
 
         # gang scheduling (PodGroup all-or-nothing; the coscheduling-plugin
         # semantics BASELINE config 4 targets): a group binds only when ALL
@@ -745,7 +793,7 @@ class Scheduler:
             fr = _filter_pass(
                 dp, nodes_with_usage(dn, usage), ds, dt, dv, sv, self.pred_mask
             )
-            rmat = np.asarray(fr.reasons)
+            rmat = self.obs.jax.readback("failure-reasons", fr.reasons)
             nvalid = np.asarray(dn.valid)
             free = np.asarray(dn.allocatable) - np.asarray(usage.requested)
             reqs = np.asarray(dp.req)
@@ -766,6 +814,7 @@ class Scheduler:
 
         from kubernetes_tpu.framework import WAIT as _WAIT
 
+        bind_span = trace.begin_span("bind")
         for i, pod in enumerate(batch):
             target = int(assigned[i])
             if target < 0:
@@ -830,6 +879,7 @@ class Scheduler:
                 continue
             self._bind_pod(pod, node_name, st, res)
 
+        trace.end_span(bind_span)
         trace.step(f"bound {res.scheduled}, failed {res.unschedulable}")
 
         # preemption (scheduler.go:493 -> preempt, §3.3): failed pods try to
@@ -837,7 +887,9 @@ class Scheduler:
         preemptable_idx = [i for i in failed_idx if i not in gang_failed]
         if self.enable_preemption and preemptable_idx and rmat is not None:
             pt0 = self.clock()
-            self._run_preemption(batch, preemptable_idx, rmat, node_order, res)
+            with self.obs.span("preemption"):
+                self._run_preemption(
+                    batch, preemptable_idx, rmat, node_order, res)
             self.metrics.preemption_duration.observe(self.clock() - pt0)
             trace.step(f"preemption ({res.preempted} victims)")
         res.elapsed_s = self.clock() - t0
@@ -848,6 +900,7 @@ class Scheduler:
         )
         self._record_metrics(res, solve_s)
         trace.log_if_long(self.trace_threshold_s)
+        self.obs.end_cycle(res)
         return res
 
     def _record_metrics(self, res: CycleResult, solve_s: float = 0.0) -> None:
@@ -900,6 +953,7 @@ class Scheduler:
         from kubernetes_tpu.faults import CLOSED, OPEN, STATE_CODE
 
         self.metrics.breaker_state.set(STATE_CODE[new], target=target)
+        self.obs.note_breaker(target, old, new)
         ref = ObjectRef(name=self.scheduler_name, involved_kind="Scheduler")
         if new == OPEN:
             klog.warning("circuit breaker %s: %s -> open (degraded mode)",
@@ -963,7 +1017,10 @@ class Scheduler:
                 no_pod_affinity=no_pod_aff, no_spread=no_spread,
                 fault_hook=hook, fault_site="solve:batch-cpu",
             )
-        return batch_assign(
+        # sinkhorn convergence telemetry rides the solve as a (2,) device
+        # pair (stays on device; obs reads it back once at cycle end)
+        want_stats = self.obs.config.sinkhorn_telemetry
+        out = batch_assign(
             dp, dn, ds, self.weights,
             max_rounds=self.max_rounds, per_node_cap=self.per_node_cap,
             topo=dt, extra_mask=extra_mask, vol=dv, static_vol=sv,
@@ -971,8 +1028,13 @@ class Scheduler:
             use_sinkhorn=(tier == "sinkhorn"), skip_priorities=skip_prio,
             no_ports=no_ports, no_pod_affinity=no_pod_aff,
             no_spread=no_spread, fault_hook=hook,
-            fault_site=f"solve:{tier}",
+            fault_site=f"solve:{tier}", stats_out=want_stats,
         )
+        if want_stats:
+            assigned, usage, rounds, sk_stats = out
+            self.obs.note_sinkhorn(sk_stats)
+            return assigned, usage, rounds
+        return out
 
     def _solve_ladder(self, solver, batch, dp, dn, ds, dt, dv, sv, base_fr,
                       extra_mask, extra_score, skip_prio, no_ports,
@@ -1009,6 +1071,7 @@ class Scheduler:
                 # the oracle floor so the cycle still makes progress
                 if not deadline_counted:
                     m.deadline_exceeded.inc()
+                    self.obs.note_deadline_exceeded()
                     deadline_counted = True
                 m.solver_fallbacks.inc(from_tier=tier, to_tier=terminal)
                 res.solver_fallbacks += 1
@@ -1026,28 +1089,32 @@ class Scheduler:
             result = last_err = None
             for attempt in range(attempts):
                 ts = self.clock()
-                try:
-                    out = self._run_tier(
-                        tier, batch, dp, dn, ds, dt, dv, sv, base_fr,
-                        extra_mask, extra_score, skip_prio, no_ports,
-                        no_pod_aff, no_spread,
-                    )
-                    if rc.validate_results:
-                        ok, why = validate_solution(
-                            out[0], out[1], dp, dn, self.pred_mask)
-                        if not ok:
-                            m.solver_rejections.inc(tier=tier, reason=why)
-                            raise SolverResultInvalid(f"{tier}: {why}")
-                    result = out
+                with self.obs.span(f"solve:{tier}", attempt=attempt):
+                    try:
+                        out = self._run_tier(
+                            tier, batch, dp, dn, ds, dt, dv, sv, base_fr,
+                            extra_mask, extra_score, skip_prio, no_ports,
+                            no_pod_aff, no_spread,
+                        )
+                        if rc.validate_results:
+                            with self.obs.span("validate"):
+                                ok, why = validate_solution(
+                                    out[0], out[1], dp, dn, self.pred_mask)
+                            if not ok:
+                                m.solver_rejections.inc(tier=tier, reason=why)
+                                raise SolverResultInvalid(f"{tier}: {why}")
+                        result = out
+                    except Exception as e:
+                        last_err = e
+                    finally:
+                        m.solver_tier_duration.observe(
+                            self.clock() - ts, tier=tier)
+                if result is not None:
                     break
-                except Exception as e:
-                    last_err = e
-                finally:
-                    m.solver_tier_duration.observe(
-                        self.clock() - ts, tier=tier)
                 if attempt + 1 < attempts and not (
                         deadline is not None and self.clock() >= deadline):
                     m.solver_retries.inc(tier=tier)
+                    self.obs.note_retry()
                     continue
                 break
             if result is not None:
@@ -1194,18 +1261,28 @@ class Scheduler:
                 shed = (self._cycle_deadline is not None
                         and self.clock() >= self._cycle_deadline)
                 if shed or not br.allow():
-                    if rc.extender_degrade_to_ignorable:
+                    # ROADMAP bug (a): a config-Ignorable extender must
+                    # never fail pods — shedding it is exactly the
+                    # "unreachable Ignorable extender" case the flag
+                    # covers (extender.go:124), independent of the
+                    # degrade-to-ignorable robustness override
+                    if rc.extender_degrade_to_ignorable or ext.is_ignorable():
                         self.metrics.extender_degraded.inc(extender=ename)
                         continue
                     allowed = set()
                     early_fail[i] = f"Extender:{ename} unavailable"
                     break
                 # clamp the transport timeout to the remaining cycle
-                # budget (deadline propagation across the HTTP seam)
-                if (self._cycle_deadline is not None
-                        and hasattr(ext, "set_call_budget")):
-                    ext.set_call_budget(
-                        max(self._cycle_deadline - self.clock(), 1e-3))
+                # budget (deadline propagation across the HTTP seam);
+                # re-armed per verb group — and explicitly CLEARED on
+                # unbounded cycles so a clamp from a deadline-bearing
+                # cycle can't leak into this one (ROADMAP bug (b))
+                if hasattr(ext, "set_call_budget"):
+                    if self._cycle_deadline is not None:
+                        ext.set_call_budget(
+                            max(self._cycle_deadline - self.clock(), 1e-3))
+                    else:
+                        ext.set_call_budget(None)
                 try:
                     names, _failed = ext.filter(
                         pod, [n for n in feasible if n in allowed], nodes_by_name
@@ -1276,6 +1353,16 @@ class Scheduler:
                 if ext.is_binder() and ext.is_interested(pod):
                     binder = ext
                     break
+            # ROADMAP bug (b): re-arm the transport budget for the BIND
+            # verb from the remaining cycle deadline — without this the
+            # bind call inherits whatever clamp the filter verb left
+            # behind (stale, and from a different point in the cycle)
+            if hasattr(binder, "set_call_budget"):
+                if self._cycle_deadline is not None:
+                    binder.set_call_budget(
+                        max(self._cycle_deadline - self.clock(), 1e-3))
+                else:
+                    binder.set_call_budget(None)
             try:
                 binder.bind(pod, node_name)
             except Exception as e:  # bind RPC failed -> Forget + retry

@@ -214,6 +214,25 @@ def serve_scheduler(
 
                 self._respond(200, json.dumps(version_info()).encode(),
                               "application/json")
+            elif self.path == "/debug/traces":
+                # Chrome trace-event document over the retained cycle
+                # traces — save and open in chrome://tracing / Perfetto
+                obs = getattr(sched, "obs", None)
+                if obs is None:
+                    self._respond(404, b"no observability layer",
+                                  "text/plain")
+                else:
+                    self._respond(200, obs.export_chrome_trace().encode(),
+                                  "application/json")
+            elif self.path == "/debug/flightrecorder":
+                obs = getattr(sched, "obs", None)
+                if obs is None:
+                    self._respond(404, b"no observability layer",
+                                  "text/plain")
+                else:
+                    self._respond(
+                        200, json.dumps(obs.debug_payload()).encode(),
+                        "application/json")
             else:
                 self._respond(404, b"not found", "text/plain")
 

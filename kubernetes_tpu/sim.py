@@ -2092,17 +2092,21 @@ class HollowCluster:
 
         wants_node_ports = getattr(svc, "type", "ClusterIP") in (
             "NodePort", "LoadBalancer")
-        if wants_node_ports:
-            # validate explicit picks FIRST (a duplicate raises the
-            # apiserver's 'already allocated' 422 analog) so a rejected
-            # create leaks neither a ClusterIP nor earlier ports. Ports
-            # reserved before a later one conflicts roll back — the
-            # reference apiserver releases allocations on failed create
-            # — and a port repeated WITHIN the service is the same 422
-            # (it would double-release on delete otherwise).
-            reserved = []
-            seen = set()
-            try:
+        # Every allocation this create performs is tracked and rolled
+        # back if ANY later step rejects it (ROADMAP bug (c): explicit
+        # node-port reservations used to leak when the ClusterIP reserve
+        # or a later allocator exhaustion raised) — the reference
+        # apiserver releases allocations on failed create the same way.
+        reserved_ports = []  # explicit + auto node ports taken here
+        allocated_ip = ""  # ClusterIP WE allocated (cleared on rollback)
+        reserved_ip = ""  # caller VIP WE reserved (released, field kept)
+        try:
+            if wants_node_ports:
+                # validate explicit picks FIRST (a duplicate raises the
+                # apiserver's 'already allocated' 422 analog); a port
+                # repeated WITHIN the service is the same 422 (it would
+                # double-release on delete otherwise)
+                seen = set()
                 for p in svc.ports:
                     if p.node_port:
                         if p.node_port in seen:
@@ -2112,20 +2116,34 @@ class HollowCluster:
                                 "the service)")
                         seen.add(p.node_port)
                         self.nodeport_alloc.reserve(p.node_port)
-                        reserved.append(p.node_port)
-            except Exception:
-                for n in reserved:
-                    self.nodeport_alloc.release(n)
-                raise
-        if not svc.cluster_ip:
-            svc.cluster_ip = self.ip_alloc.allocate()
-        else:
-            self.ip_alloc.reserve(svc.cluster_ip)
-        if wants_node_ports:
-            svc.ports = tuple(
-                p if p.node_port else dataclasses.replace(
-                    p, node_port=self.nodeport_alloc.allocate())
-                for p in svc.ports)
+                        reserved_ports.append(p.node_port)
+            if not svc.cluster_ip:
+                svc.cluster_ip = self.ip_alloc.allocate()
+                allocated_ip = svc.cluster_ip
+            else:
+                self.ip_alloc.reserve(svc.cluster_ip)
+                reserved_ip = svc.cluster_ip
+            if wants_node_ports:
+                ports = []
+                for p in svc.ports:
+                    if not p.node_port:
+                        auto = self.nodeport_alloc.allocate()
+                        reserved_ports.append(auto)
+                        p = dataclasses.replace(p, node_port=auto)
+                    ports.append(p)
+                svc.ports = tuple(ports)
+        except Exception:
+            for n in reserved_ports:
+                self.nodeport_alloc.release(n)
+            if allocated_ip:
+                self.ip_alloc.release(allocated_ip)
+                svc.cluster_ip = ""
+            elif reserved_ip:
+                # caller-specified VIP: release OUR reservation (the CIDR
+                # slot must not leak) but keep the field — it is the
+                # caller's requested spec, not something we minted
+                self.ip_alloc.release(reserved_ip)
+            raise
         self.services[svc.key()] = svc
         self._commit(f"services/{svc.key()}", "ADDED", svc)
 

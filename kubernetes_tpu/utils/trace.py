@@ -1,55 +1,18 @@
-"""Operation tracing — the ``k8s.io/utils/trace`` analog the reference
-wraps around every scheduling cycle (``generic_scheduler.go:185``:
-``utiltrace.New(...)`` + steps + ``LogIfLong(100ms)``).
+"""Operation tracing — the ``k8s.io/utils/trace`` analog.
 
-A Trace records named steps with timestamps; ``log_if_long`` emits the
-step breakdown through ``logging`` only when total duration exceeds the
-threshold — the cheap always-on profiler for slow cycles."""
+The implementation moved to :mod:`kubernetes_tpu.obs.trace` when it grew
+nested spans and the Chrome trace-event exporter (PR 3); this module
+stays the stable import path for the flat utiltrace surface
+(``Trace(name, clock=...)`` + ``step`` + ``log_if_long``) so existing
+callers and tests keep working against the SAME class — two trace
+implementations drifting apart would be an observability bug factory.
+"""
 
-from __future__ import annotations
+from kubernetes_tpu.obs.trace import (  # noqa: F401
+    DEFAULT_THRESHOLD_S,
+    Span,
+    Trace,
+    logger,
+)
 
-import logging
-import time
-from typing import Callable, List, Optional, Tuple
-
-logger = logging.getLogger("kubernetes_tpu.trace")
-
-#: the reference logs steps that took >= 50% of a (threshold/len) share;
-#: we keep it simple: log everything when over threshold.
-DEFAULT_THRESHOLD_S = 0.1  # LogIfLong(100*time.Millisecond)
-
-
-class Trace:
-    def __init__(
-        self,
-        name: str,
-        clock: Callable[[], float] = time.monotonic,
-        **fields,
-    ) -> None:
-        self.name = name
-        self.fields = fields
-        self.clock = clock
-        self.start = clock()
-        self.steps: List[Tuple[float, str]] = []
-
-    def step(self, msg: str) -> None:
-        self.steps.append((self.clock(), msg))
-
-    def total_s(self) -> float:
-        return self.clock() - self.start
-
-    def format(self) -> str:
-        fields = ",".join(f"{k}={v}" for k, v in self.fields.items())
-        lines = [f'Trace "{self.name}" ({fields}) total={self.total_s()*1000:.1f}ms:']
-        prev = self.start
-        for t, msg in self.steps:
-            lines.append(f"  +{(t - prev)*1000:.1f}ms {msg}")
-            prev = t
-        return "\n".join(lines)
-
-    def log_if_long(self, threshold_s: float = DEFAULT_THRESHOLD_S) -> Optional[str]:
-        if self.total_s() >= threshold_s:
-            text = self.format()
-            logger.info(text)
-            return text
-        return None
+__all__ = ["Trace", "Span", "DEFAULT_THRESHOLD_S", "logger"]

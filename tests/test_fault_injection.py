@@ -503,3 +503,127 @@ def test_grpc_client_unary_retry_wraps_transient_faults():
     assert wrapped() == "response"
     assert hits["n"] == 1 and rp.retries == 1
     assert inj.fired[("grpc:Filter", "connection")] == 1
+
+
+def test_shed_path_honors_config_ignorable_extender():
+    """ROADMAP bug (a): an extender the CONFIG marks Ignorable must never
+    fail pods — including on the shed path (open breaker / blown
+    deadline) with ``extender_degrade_to_ignorable=False``. Before the
+    fix the robustness override decided alone and a config-Ignorable
+    extender failed every interested pod while its breaker was open."""
+    events = []
+
+    def transport(url, payload, timeout):
+        raise ConnectionError("refused")
+
+    exts = build_extenders([_ext_cfg(ignorable=True)], transport)
+    rc = RobustnessConfig(solver_retries=0, transport_retries=0,
+                          breaker_failure_threshold=1,
+                          breaker_open_duration_s=1e9,
+                          extender_degrade_to_ignorable=False)
+    s, clk = _sched(rc=rc, events=events, extenders=exts)
+    s.on_node_add(make_node("n0", cpu_milli=8000))
+    s.on_pod_add(make_pod("p0", cpu_milli=100))
+    # closed breaker, failing transport: Ignorable policy drops the
+    # extender and the pod schedules (extender.go:124)
+    res = s.schedule_cycle()
+    assert res.scheduled == 1
+    ename = exts[0].name()
+    assert s._breakers[f"extender:{ename}"].state == OPEN
+    # OPEN breaker -> the shed path. degrade_to_ignorable is OFF, but the
+    # extender is config-Ignorable: its pod must still schedule
+    s.on_pod_add(make_pod("p1", cpu_milli=100))
+    res2 = s.schedule_cycle()
+    assert res2.scheduled == 1
+    assert "default/p1" not in res2.failure_reasons
+    assert s.metrics.extender_degraded.value(extender=ename) >= 1
+
+
+def test_extender_retries_bounded_by_call_budget_deadline():
+    """ROADMAP bug (b), retry half: with a call budget armed, the retry
+    loop must stop when the next backoff would cross the budget deadline
+    instead of burning attempts the cycle no longer has."""
+    clk = FakeClock()
+    calls = {"n": 0, "timeouts": []}
+
+    def transport(url, payload, timeout):
+        calls["n"] += 1
+        calls["timeouts"].append(timeout)
+        clk.advance(0.4)  # each attempt consumes wall-clock
+        raise ConnectionError("refused")
+
+    rp = RetryPolicy(max_retries=5, base_s=0.3, jitter=0.0,
+                     sleep=lambda s: clk.advance(s))
+    ext = HTTPExtender(_ext_cfg(http_timeout_s=30.0), transport, retry=rp,
+                       clock=clk)
+    ext.set_call_budget(1.0)
+    with pytest.raises(ExtenderError):
+        ext.filter(make_pod("p"), ["n0"], {})
+    # attempt 1 at t=0 (0.4s), backoff 0.3 -> attempt 2 at 0.7 (ends
+    # 1.1, past deadline); the NEXT backoff would cross 1.0 -> stop at 2
+    # attempts, not 6
+    assert calls["n"] == 2
+    # per-attempt timeout clamp REFRESHED from the remaining budget:
+    # attempt 2's clamp is tighter than attempt 1's
+    assert calls["timeouts"][0] == pytest.approx(1.0)
+    assert calls["timeouts"][1] == pytest.approx(0.3)
+
+
+def test_extender_call_budget_rearmed_per_verb_and_clearable():
+    """ROADMAP bug (b), leak half: the filter verb's clamp must not leak
+    into a later bind verb — set_call_budget(None) clears, and each verb
+    re-arms from the caller's remaining deadline."""
+    clk = FakeClock()
+    seen = []
+
+    def transport(url, payload, timeout):
+        seen.append((url.rsplit("/", 1)[-1], timeout))
+        return {"nodenames": ["n0"]}
+
+    ext = HTTPExtender(_ext_cfg(bind_verb="bind", http_timeout_s=30.0),
+                       transport, clock=clk)
+    ext.set_call_budget(0.25)
+    ext.filter(make_pod("p"), ["n0"], {})
+    assert seen[-1] == ("filter", pytest.approx(0.25))
+    # unbounded cycle: the clamp is cleared, full http timeout returns
+    ext.set_call_budget(None)
+    ext.bind(make_pod("p"), "n0")
+    assert seen[-1] == ("bind", pytest.approx(30.0))
+    # re-armed for bind from a fresh remaining budget
+    ext.set_call_budget(2.0)
+    ext.bind(make_pod("p"), "n0")
+    assert seen[-1] == ("bind", pytest.approx(2.0))
+
+
+def test_grpc_service_hooks_apply_armed_corruption():
+    """ROADMAP bug (d): an armed corruption kind on the service-side
+    hooks must actually poison the response (observable as the verb's
+    error result), not be discarded while still consuming shots."""
+    import json as _json
+
+    from kubernetes_tpu.extender import pod_to_json
+    from kubernetes_tpu.grpc_shim import TpuSchedulerService
+    from kubernetes_tpu.proto import extender_pb2 as pb
+
+    inj = FaultInjector(seed=7)
+    inj.arm("grpc-service:filter", "corrupt", count=1)
+    inj.arm("grpc-service:prioritize", "error-field", count=1)
+    s, _clk = _sched()
+    s.on_node_add(make_node("n0", cpu_milli=8000))
+    svc = TpuSchedulerService(s, fault_injector=inj)
+    args = pb.ExtenderArgs(
+        pod_json=_json.dumps(pod_to_json(make_pod("p", cpu_milli=100))),
+        node_names=["n0"],
+    )
+    fr = svc.filter(args, None)
+    assert fr.error  # corrupted shape fails result construction
+    assert not fr.node_names
+    assert inj.fired[("grpc-service:filter", "corrupt")] == 1
+    pr = svc.prioritize(args, None)
+    assert pr.error
+    assert inj.fired[("grpc-service:prioritize", "error-field")] == 1
+    # shots exhausted: the next calls are clean and succeed
+    fr2 = svc.filter(args, None)
+    assert not fr2.error and list(fr2.node_names) == ["n0"]
+    pr2 = svc.prioritize(args, None)
+    assert not pr2.error and len(pr2.items) == 1

@@ -145,3 +145,88 @@ def test_nodeport_duplicated_within_service_rejected_without_leak():
                             ports=(ServicePort(port=80,
                                                node_port=30300),)))
     assert hub.services["default/e"].ports[0].node_port == 30300
+
+
+def test_add_service_rolls_back_ports_on_clusterip_failure():
+    """ROADMAP bug (c): explicit node-port reservations must roll back
+    when the ClusterIP allocation (or a later port allocation) rejects
+    the create — a leaked reservation blocks every later service that
+    legitimately wants that port."""
+    hub = HollowCluster(seed=99, scheduler_kw={"enable_preemption": False})
+
+    def exploding_allocate():
+        raise ValueError("service CIDR exhausted")
+
+    orig = hub.ip_alloc.allocate
+    hub.ip_alloc.allocate = exploding_allocate
+    with pytest.raises(ValueError):
+        hub.add_service(Service("a", selector={"x": "1"}, type="NodePort",
+                                ports=(ServicePort(port=80,
+                                                   node_port=30400),)))
+    hub.ip_alloc.allocate = orig
+    assert "default/a" not in hub.services
+    # the explicit reservation was released: a later service can take it
+    hub.add_service(Service("b", selector={"x": "2"}, type="NodePort",
+                            ports=(ServicePort(port=80, node_port=30400),)))
+    assert hub.services["default/b"].ports[0].node_port == 30400
+
+
+def test_add_service_rolls_back_ip_and_ports_on_port_exhaustion():
+    """Same rollback for the later-allocator-exhaustion path: the
+    ClusterIP WE allocated and every port taken so far (explicit + auto)
+    release when the auto node-port allocator runs dry mid-create."""
+    hub = HollowCluster(seed=100, scheduler_kw={"enable_preemption": False})
+    ips_before = len(hub.ip_alloc._core._used)
+
+    calls = {"n": 0}
+    orig_alloc = hub.nodeport_alloc.allocate
+
+    def exhausted_after_one():
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise ValueError("node-port range exhausted")
+        return orig_alloc()
+
+    hub.nodeport_alloc.allocate = exhausted_after_one
+    with pytest.raises(ValueError):
+        # one explicit + two autos: the second auto explodes
+        hub.add_service(Service("c", selector={"x": "3"}, type="NodePort",
+                                ports=(ServicePort(port=80,
+                                                   node_port=30500),
+                                       ServicePort(port=81),
+                                       ServicePort(port=82))))
+    hub.nodeport_alloc.allocate = orig_alloc
+    assert "default/c" not in hub.services
+    # every allocation rolled back: ip pool unchanged, explicit port and
+    # the first auto port retakeable
+    assert len(hub.ip_alloc._core._used) == ips_before
+    hub.add_service(Service("d", selector={"x": "4"}, type="NodePort",
+                            ports=(ServicePort(port=80, node_port=30500),)))
+    assert hub.services["default/d"].ports[0].node_port == 30500
+
+
+def test_add_service_releases_explicit_clusterip_on_port_failure():
+    """A caller-SPECIFIED ClusterIP we reserved must release when a later
+    node-port allocation rejects the create — otherwise every failed
+    create permanently burns a service-CIDR slot (and a retry of the
+    same manifest 422s on its own leaked VIP)."""
+    hub = HollowCluster(seed=101, scheduler_kw={"enable_preemption": False})
+    vip = hub.ip_alloc.allocate()
+    hub.ip_alloc.release(vip)  # a known-valid in-range VIP, now free
+
+    def exploding_allocate():
+        raise ValueError("node-port range exhausted")
+
+    orig = hub.nodeport_alloc.allocate
+    hub.nodeport_alloc.allocate = exploding_allocate
+    with pytest.raises(ValueError):
+        hub.add_service(Service("v", selector={"x": "1"}, type="NodePort",
+                                cluster_ip=vip,
+                                ports=(ServicePort(port=80),)))
+    hub.nodeport_alloc.allocate = orig
+    assert "default/v" not in hub.services
+    # the reservation rolled back: the SAME manifest succeeds on retry
+    hub.add_service(Service("v2", selector={"x": "2"}, type="NodePort",
+                            cluster_ip=vip,
+                            ports=(ServicePort(port=80),)))
+    assert hub.services["default/v2"].cluster_ip == vip

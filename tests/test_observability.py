@@ -1,7 +1,15 @@
-"""Observability-layer unit tests: the metrics registry's exposition and
-quantile math (pkg/scheduler/metrics + prometheus client semantics) and
-the klog-style leveled logger (vendor/k8s.io/klog V-gates)."""
+"""Observability-layer tests: the metrics registry's exposition and
+quantile math (pkg/scheduler/metrics + prometheus client semantics), the
+klog-style leveled logger (vendor/k8s.io/klog V-gates), and the PR-3
+obs/ stack — nested cycle tracing with the Chrome trace-event exporter,
+runtime JAX compile/retrace telemetry, the flight recorder ring, and
+the end-to-end acceptance gate (a full scheduling cycle's exported
+trace + the retrace counter on a forced batch-shape change).
 
+Deterministic throughout: fake clocks for every timing assertion
+(monotonic/perf_counter only underneath — graftlint R4 stays clean)."""
+
+import json
 import logging
 
 import pytest
@@ -161,3 +169,495 @@ def test_v_gate_guards_expensive_formatting():
 
     gate.info("%s", Exploding())  # disabled: must not format
     assert calls == []
+
+
+# ---------------------------------------------------------------------------
+# obs.trace: nested spans, threshold dump, Chrome export
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt: float):
+        self.t += dt
+
+
+def _nested_trace(clk):
+    from kubernetes_tpu.obs.trace import Trace
+
+    tr = Trace("cycle", clock=clk, cycle=7)
+    with tr.span("snapshot"):
+        clk.advance(0.010)
+    with tr.span("solve:batch"):
+        clk.advance(0.020)
+        with tr.span("validate"):
+            clk.advance(0.005)
+        clk.advance(0.001)
+    with tr.span("bind"):
+        clk.advance(0.002)
+    tr.finish()
+    return tr
+
+
+def test_trace_nested_spans_and_durations():
+    clk = FakeClock()
+    tr = _nested_trace(clk)
+    durs = tr.span_durations()
+    assert durs["snapshot"] == pytest.approx(0.010)
+    assert durs["solve:batch"] == pytest.approx(0.026)
+    assert durs["validate"] == pytest.approx(0.005)
+    assert durs["bind"] == pytest.approx(0.002)
+    # nesting: validate is a child of solve:batch, not of the root
+    root = tr.root
+    names = [c.name for c in root.children]
+    assert names == ["snapshot", "solve:batch", "bind"]
+    solve = root.children[1]
+    assert [c.name for c in solve.children] == ["validate"]
+
+
+def test_trace_threshold_dump_includes_spans():
+    clk = FakeClock()
+    tr = _nested_trace(clk)
+    # total 38ms: over a 10ms threshold, under a 1s one
+    text = tr.log_if_long(0.010)
+    assert text is not None
+    assert "solve:batch" in text and "validate" in text
+    assert tr.log_if_long(1.0) is None
+
+
+def test_trace_span_closes_on_exception():
+    from kubernetes_tpu.obs.trace import Trace
+
+    clk = FakeClock()
+    tr = Trace("cycle", clock=clk)
+    with pytest.raises(RuntimeError):
+        with tr.span("solve:batch"):
+            clk.advance(0.5)
+            raise RuntimeError("solver died")
+    # the frame closed with the failure's duration; later spans nest at
+    # the root, not inside the dead frame
+    assert tr.root.children[0].end is not None
+    with tr.span("bind"):
+        clk.advance(0.1)
+    assert [c.name for c in tr.root.children] == ["solve:batch", "bind"]
+
+
+def test_chrome_export_round_trip_consistent_ts_dur():
+    from kubernetes_tpu.obs.trace import chrome_trace_json
+
+    clk = FakeClock()
+    tr = _nested_trace(clk)
+    doc = json.loads(json.dumps(chrome_trace_json([tr])))
+    events = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert {"cycle", "snapshot", "solve:batch", "validate",
+            "bind"} <= set(events)
+    root = events["cycle"]
+    for name in ("snapshot", "solve:batch", "validate", "bind"):
+        e = events[name]
+        assert e["ts"] >= root["ts"]
+        assert e["ts"] + e["dur"] <= root["ts"] + root["dur"] + 1e-3
+    v, s = events["validate"], events["solve:batch"]
+    assert v["ts"] >= s["ts"] and v["ts"] + v["dur"] <= s["ts"] + s["dur"] + 1e-3
+    # args survive the round trip (labels)
+    assert events["cycle"]["args"]["cycle"] == "7"
+
+
+def test_utils_trace_is_the_obs_trace():
+    # one implementation: the seed import path must alias, not fork
+    from kubernetes_tpu.obs.trace import Trace as ObsTrace
+    from kubernetes_tpu.utils.trace import Trace as UtilTrace
+
+    assert UtilTrace is ObsTrace
+
+
+# ---------------------------------------------------------------------------
+# obs.jaxtel: compile-cache classification, retrace storms, transfers
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_counter_classification():
+    import numpy as np
+
+    from kubernetes_tpu.obs.jaxtel import JaxTelemetry
+
+    tel = JaxTelemetry()
+    a = np.zeros((8, 4), np.float32)
+    assert tel.record_call("solve", a, static=("batch",)) == "compile"
+    assert tel.record_call("solve", np.ones((8, 4), np.float32),
+                           static=("batch",)) == "hit"  # same signature
+    # forced shape change: exactly one retrace
+    assert tel.record_call("solve", np.zeros((16, 4), np.float32),
+                           static=("batch",)) == "retrace"
+    assert tel.retrace_total("solve") == 1
+    # a static-key change is a retrace too (jit cache keys on it)
+    assert tel.record_call("solve", a, static=("greedy",)) == "retrace"
+    assert tel.retrace_total("solve") == 2
+    # dtype change as well
+    assert tel.record_call("solve", np.zeros((8, 4), np.int32),
+                           static=("batch",)) == "retrace"
+    assert tel.compiles["solve"] == 1 and tel.hits["solve"] == 1
+
+
+def test_retrace_storm_fires_once_per_window_crossing():
+    import numpy as np
+
+    from kubernetes_tpu.obs.jaxtel import JaxTelemetry
+
+    tel = JaxTelemetry(storm_threshold=3, storm_window=100)
+    for i in range(7):  # 1 compile + 6 retraces
+        tel.record_call("solve", np.zeros((8 + i,), np.float32))
+    # 6 retraces / threshold 3 -> the window cleared twice
+    assert tel.storms["solve"] == 2
+
+
+def test_signature_set_is_bounded_lru():
+    """A sustained retrace storm mints a new signature every call; the
+    per-site set must stay capped (recorder/trace rings are hard-bounded
+    for the same reason) while recent signatures still classify as
+    hits."""
+    import numpy as np
+
+    from kubernetes_tpu.obs.jaxtel import JaxTelemetry
+
+    tel = JaxTelemetry(signature_capacity=4)
+    for i in range(1, 50):
+        tel.record_call("solve", np.zeros((i,), np.float32))
+    assert len(tel._seen["solve"]) == 4
+    # a recent signature is still a hit; an evicted one re-counts as a
+    # retrace (under a storm it effectively is one)
+    assert tel.record_call("solve", np.zeros((49,), np.float32)) == "hit"
+    assert tel.record_call("solve", np.zeros((1,), np.float32)) == "retrace"
+
+
+def test_transfer_accounting():
+    import numpy as np
+
+    from kubernetes_tpu.obs.jaxtel import JaxTelemetry, tree_nbytes
+
+    tel = JaxTelemetry()
+    x = np.zeros((4, 4), np.float32)
+    back = tel.readback("solve-result", x)
+    assert back.shape == (4, 4)
+    assert tel.transfers[("solve-result", "d2h")] == [1, 64]
+    tel.record_upload("snapshot", {"a": x, "b": np.zeros((2,), np.int64)})
+    assert tel.transfers[("snapshot", "h2d")] == [1, 64 + 16]
+    assert tree_nbytes(None) == 0
+
+
+# ---------------------------------------------------------------------------
+# obs.recorder: ring capacity / eviction
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_capacity_and_eviction():
+    from kubernetes_tpu.obs.recorder import CycleRecord, FlightRecorder
+
+    fr = FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.record(CycleRecord(cycle=i, tier="batch"))
+    assert len(fr) == 4
+    assert [r.cycle for r in fr.records()] == [6, 7, 8, 9]  # oldest evicted
+    j = fr.to_json()
+    assert j["recorded"] == 10 and j["evicted"] == 6
+    text = fr.dump()
+    assert "cycle 9" in text and "cycle 5" not in text
+
+
+def test_flight_recorder_dump_carries_incident_flags():
+    from kubernetes_tpu.obs.recorder import CycleRecord, FlightRecorder
+
+    fr = FlightRecorder(capacity=8)
+    fr.record(CycleRecord(
+        cycle=3, tier="greedy", fallbacks=2, retries=1,
+        deadline_exceeded=True,
+        breaker_transitions=[("solver:batch", "closed", "open")],
+        spans={"solve:batch": 0.5, "solve:greedy": 0.01},
+    ))
+    text = fr.dump()
+    assert "DEADLINE" in text and "fallbacks=2" in text
+    assert "breaker[solver:batch]:closed->open" in text
+    assert "solve:greedy" in text
+
+
+# ---------------------------------------------------------------------------
+# obs.core: deterministic sampling + record assembly
+# ---------------------------------------------------------------------------
+
+
+class _Res:
+    """Minimal cycle-result stand-in: one pod attempted (an EVENTFUL
+    cycle — idle empty cycles are deliberately not recorded)."""
+
+    attempted = 1
+    scheduled = 1
+    unschedulable = 0
+    elapsed_s = 0.001
+    solver_tier = "batch"
+    solver_fallbacks = 0
+
+
+def test_trace_sampling_is_deterministic():
+    from kubernetes_tpu.config import ObservabilityConfig
+    from kubernetes_tpu.obs import Observability
+
+    clk = FakeClock()
+    obs = Observability(ObservabilityConfig(trace_sampling=0.5), clock=clk)
+    kept = []
+    for i in range(8):
+        obs.begin_cycle(i)
+        obs.end_cycle(_Res())
+        kept.append(len(obs.traces))
+    # every other cycle retained: 8 cycles -> 4 traces, monotone
+    assert kept[-1] == 4
+    # recorder still records EVERY eventful cycle (sampling gates traces)
+    assert len(obs.recorder) == 8
+
+
+def test_sampling_counts_only_eventful_cycles():
+    """Idle polls must not consume sampling slots: a workload
+    phase-locked with the serve-loop poll period (work on every second
+    poll) would otherwise land every eventful cycle on the unsampled
+    phase and retain zero traces forever."""
+    from kubernetes_tpu.config import ObservabilityConfig
+    from kubernetes_tpu.obs import Observability
+
+    clk = FakeClock()
+    obs = Observability(ObservabilityConfig(trace_sampling=0.5), clock=clk)
+    for i in range(40):
+        obs.begin_cycle(i)
+        obs.end_cycle(_Res() if i % 2 == 1 else None)
+    # 20 eventful cycles at rate 0.5 -> 10 retained, not 0
+    assert len(obs.traces) == 10
+
+
+def test_trace_and_flight_record_agree_on_cycle_number():
+    """note_cycle restamps the in-flight trace (begin_cycle ran before
+    pop_batch incremented the queue counter) so /debug/traces and
+    /debug/flightrecorder attribute spans to the same cycle."""
+    from kubernetes_tpu.config import ObservabilityConfig
+    from kubernetes_tpu.obs import Observability
+
+    clk = FakeClock()
+    obs = Observability(ObservabilityConfig(), clock=clk)
+    obs.begin_cycle(4)  # pre-increment value
+    obs.note_cycle(5)  # the real cycle number, post pop_batch
+    rec = obs.end_cycle(_Res())
+    assert rec.cycle == 5
+    doc = obs.chrome_trace()
+    root = [e for e in doc["traceEvents"]
+            if e["name"] == "Scheduling cycle"][0]
+    assert root["args"]["cycle"] == "5"
+
+
+def test_open_span_exports_honest_duration():
+    """A span leaked open by an exception unwinding past begin_span (a
+    deadline timeout mid-solve) exports with its duration up to the
+    trace end, not dur=0 — that slow span is exactly what the trace of a
+    timed-out run must show."""
+    from kubernetes_tpu.obs.trace import Trace
+
+    clk = FakeClock()
+    tr = Trace("t", clock=clk)
+    tr.begin_span("leaked")
+    clk.advance(2.0)
+    tr.finish()
+    ev = [e for e in tr.to_chrome_events() if e["name"] == "leaked"][0]
+    assert ev["dur"] == pytest.approx(2e6)
+
+
+def test_idle_empty_cycles_do_not_flood_the_recorder():
+    """The serve loop polls schedule_cycle ~4x/s when idle; those empty
+    cycles must not evict incident records (the recorder is the black
+    box read AFTER something went wrong) or fill the trace ring."""
+    from kubernetes_tpu.config import ObservabilityConfig
+    from kubernetes_tpu.obs import Observability
+
+    clk = FakeClock()
+    obs = Observability(ObservabilityConfig(recorder_capacity=4), clock=clk)
+    obs.begin_cycle(1)
+    assert obs.end_cycle(_Res()) is not None  # the incident cycle
+    for i in range(2, 100):  # ~25s of idle polling
+        obs.begin_cycle(i)
+        assert obs.end_cycle(None) is None
+    recs = obs.recorder.records()
+    assert [r.cycle for r in recs] == [1]
+    assert len(obs.traces) == 1
+    # but an empty cycle WITH incident activity is still black-box
+    # material (a breaker flip while the queue is drained)
+    obs.begin_cycle(100)
+    obs.note_breaker("solve:batch", "closed", "open")
+    assert obs.end_cycle(None) is not None
+    assert [r.cycle for r in obs.recorder.records()] == [1, 100]
+
+
+def test_observability_disabled_keeps_logif_long_but_records_nothing():
+    from kubernetes_tpu.config import ObservabilityConfig
+    from kubernetes_tpu.obs import Observability
+
+    clk = FakeClock()
+    obs = Observability(ObservabilityConfig(enabled=False), clock=clk)
+    tr = obs.begin_cycle(1)
+    clk.advance(5.0)
+    assert tr.log_if_long(1.0)  # the always-on slow-cycle profiler
+    obs.end_cycle(None)
+    assert len(obs.recorder) == 0 and len(obs.traces) == 0
+
+
+# ---------------------------------------------------------------------------
+# E2E acceptance: a real scheduling cycle's exported trace + retrace gate
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def driven_scheduler():
+    """One real Scheduler driven through three cycles: two at one batch
+    bucket (compile, then cache hit), one at a larger bucket (the forced
+    shape change). Module-scoped: the XLA compiles are the expensive
+    part and every E2E assertion below reads the same run."""
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import make_node, make_pod
+
+    s = Scheduler(enable_preemption=False)
+    for i in range(3):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=32000))
+    for i in range(4):
+        s.on_pod_add(make_pod(f"a{i}", cpu_milli=100))
+    r1 = s.schedule_cycle()
+    for i in range(4):
+        s.on_pod_add(make_pod(f"b{i}", cpu_milli=100))
+    r2 = s.schedule_cycle()  # same padded bucket -> compile-cache hit
+    for i in range(40):
+        s.on_pod_add(make_pod(f"c{i}", cpu_milli=100))
+    r3 = s.schedule_cycle()  # larger bucket -> exactly one retrace
+    return s, (r1, r2, r3)
+
+
+def test_cycle_chrome_trace_has_nested_spans(driven_scheduler):
+    s, (r1, _, _) = driven_scheduler
+    assert r1.scheduled == 4 and r1.solver_tier == "batch"
+    doc = json.loads(s.obs.export_chrome_trace())
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    by_name = {}
+    for e in spans:
+        by_name.setdefault(e["name"], []).append(e)
+    for needed in ("Scheduling cycle", "snapshot", "solve:batch",
+                   "validate", "bind"):
+        assert needed in by_name, f"missing span {needed}"
+    # per cycle: snapshot -> solve(tier) -> validate -> bind nest inside
+    # the root with consistent ts/dur (containment is how Perfetto
+    # reconstructs the stack)
+    for root in by_name["Scheduling cycle"]:
+        t0, t1 = root["ts"], root["ts"] + root["dur"]
+        inner = [e for e in spans
+                 if e is not root and t0 <= e["ts"]
+                 and e["ts"] + e["dur"] <= t1 + 1e-3]
+        names = {e["name"] for e in inner}
+        if not names:
+            continue  # another cycle's root
+        assert {"snapshot", "solve:batch", "validate", "bind"} <= names
+        # ordering along the cycle: snapshot before solve before bind
+        first = {n: min(e["ts"] for e in inner if e["name"] == n)
+                 for n in ("snapshot", "solve:batch", "bind")}
+        assert first["snapshot"] <= first["solve:batch"] <= first["bind"]
+        # validate nests INSIDE its solve attempt
+        v = min(e["ts"] for e in inner if e["name"] == "validate")
+        sv = [e for e in inner if e["name"] == "solve:batch"
+              and e["ts"] <= v <= e["ts"] + e["dur"]]
+        assert sv, "validate span not contained in a solve span"
+    # at least one retained root per traced cycle
+    assert len(by_name["Scheduling cycle"]) == 3
+
+
+def test_retrace_counter_increments_exactly_once_on_shape_change(
+        driven_scheduler):
+    s, _ = driven_scheduler
+    solve = s.obs.jax.snapshot()["sites"]["solve"]
+    # cycle 1 compiles, cycle 2 hits (same padded bucket), cycle 3 is THE
+    # retrace — exactly one
+    assert solve["calls"] == 3
+    assert solve["compiles"] == 1
+    assert solve["hits"] == 1
+    assert solve["retraces"] == 1
+    assert s.obs.jax.retrace_total("solve") == 1
+    # and the metric counters agree
+    assert s.metrics.jax_retraces.value(site="solve") == 1
+    assert s.metrics.jax_compile_cache.value(site="solve", result="hit") == 1
+
+
+def test_flight_recorder_captured_every_cycle(driven_scheduler):
+    s, (r1, r2, r3) = driven_scheduler
+    recs = s.obs.recorder.records()
+    assert [r.cycle for r in recs] == [1, 2, 3]
+    assert all(r.tier == "batch" for r in recs)
+    assert recs[0].batch_shape != "" and "N" in recs[0].batch_shape
+    # the forced shape change is visible in the black box
+    assert recs[2].batch_shape != recs[1].batch_shape
+    assert recs[2].retraces == 1 and recs[1].retraces == 0
+    for r in recs:
+        assert {"snapshot", "solve:batch", "validate",
+                "bind"} <= set(r.spans)
+    # h2d + d2h transfer accounting ran at the declared boundaries
+    tr = s.obs.jax.transfers
+    assert tr[("snapshot", "h2d")][0] == 3
+    assert tr[("solve-result", "d2h")][0] == 3
+
+
+def test_debugger_dump_includes_flight_recorder(driven_scheduler):
+    from kubernetes_tpu import debugger
+
+    s, _ = driven_scheduler
+    text = debugger.dump(s)
+    assert "Flight recorder" in text
+    assert "tier=batch" in text
+
+
+def test_debug_http_endpoints(driven_scheduler):
+    import urllib.request
+
+    from kubernetes_tpu.server import serve_scheduler
+
+    s, _ = driven_scheduler
+    srv = serve_scheduler(s, port=0)
+    host, port = srv.server_address[:2]
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/debug/traces", timeout=10) as r:
+            doc = json.loads(r.read().decode())
+        assert any(e["name"] == "Scheduling cycle"
+                   for e in doc["traceEvents"])
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/debug/flightrecorder",
+                timeout=10) as r:
+            fr = json.loads(r.read().decode())
+        assert len(fr["flight_recorder"]["records"]) == 3
+        assert "solve" in fr["jax"]["sites"]
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=10) as r:
+            body = r.read().decode()
+        assert "scheduler_jax_compile_cache_total" in body
+    finally:
+        srv.shutdown()
+
+
+def test_sinkhorn_convergence_telemetry_surfaces():
+    """A sinkhorn-tier cycle records (iterations, residual) through the
+    one host-boundary readback at cycle end."""
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import make_node, make_pod
+
+    s = Scheduler(solver="sinkhorn", enable_preemption=False)
+    for i in range(3):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=32000))
+    for i in range(6):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=100))
+    r = s.schedule_cycle()
+    assert r.scheduled == 6 and r.solver_tier == "sinkhorn"
+    rec = s.obs.recorder.records()[-1]
+    assert rec.sinkhorn_iters >= 1
+    assert rec.sinkhorn_residual >= 0.0
+    assert s.metrics.sinkhorn_iterations.count() == 1
