@@ -490,7 +490,7 @@ class Workload:
 
 def run_batched(w: Workload, batch: int, cap: int, use_sinkhorn: bool = False,
                 latency: bool = False, return_assigned: bool = False,
-                trace=None):
+                trace=None, explain: bool = False):
     """Schedule w.pending in device batches; returns dict of metrics.
     Usage carries forward batch-to-batch (assume-then-commit,
     cache.go:275).
@@ -502,11 +502,30 @@ def run_batched(w: Workload, batch: int, cap: int, use_sinkhorn: bool = False,
     batched analog of queue-add→bind, e2e_scheduling_duration_seconds,
     metrics/metrics.go:89); percentiles come both exact (np.percentile)
     and through the bucketed Histogram in kubernetes_tpu.metrics to prove
-    the metrics wiring matches."""
+    the metrics wiring matches.
+
+    With ``explain=True`` each batch with unplaced pods additionally runs
+    the scheduler's failure-reason filter pass against the post-assignment
+    usage plus the obs/explain.py why-pending reduction (per-reason
+    exclusion counts + blocked-pod histogram), read back alongside the
+    assignment — the batched analog of the driver's explain path. The
+    extra time counts INTO the measured throughput, and the accumulated
+    cluster breakdown lands in ``unschedulable_breakdown``. Note this is
+    an UPPER bound on the explain subsystem's real marginal cost: the
+    driver pays the failure filter pass regardless (events/preemption
+    need it), while the explain-off bench run skips it entirely."""
     import numpy as np
     import jax
+    import jax.numpy as jnp
 
     from kubernetes_tpu.ops.assign import batch_assign, nodes_with_usage
+
+    if explain:
+        from kubernetes_tpu.obs.explain import N_REASONS, explain_reduce
+        from kubernetes_tpu.scheduler import _filter_pass
+
+        expl_pairs = np.zeros(N_REASONS, np.int64)
+        expl_pods = np.zeros(N_REASONS, np.int64)
 
     pending = w.pending
     # warmup compile on the first batch shape (excluded from timing)
@@ -517,6 +536,15 @@ def run_batched(w: Workload, batch: int, cap: int, use_sinkhorn: bool = False,
                            no_pod_affinity=w.no_pod_affinity,
                            no_spread=w.no_spread)
     jax.block_until_ready(a)
+    if explain:
+        # warm the explain path's compiles too (filter pass + reduction)
+        # so the measured delta is steady-state, not first-compile
+        fr0 = _filter_pass(dp0, nodes_with_usage(w.dn, u), w.ds, w.dt,
+                           dv0, None, None)
+        ex0 = explain_reduce(
+            fr0.reasons, w.dn.valid,
+            jnp.zeros((dp0.valid.shape[0],), bool))
+        jax.block_until_ready(ex0.pair_hist)
 
     t0 = time.perf_counter()
     scheduled = 0
@@ -563,8 +591,22 @@ def run_batched(w: Workload, batch: int, cap: int, use_sinkhorn: bool = False,
                 trace.end_span(chunk_span)
         assigned_all[start : start + len(chunk)] = a
         n_placed = int((a >= 0).sum())
-        scheduled += n_placed
         dn_cur = nodes_with_usage(dn_cur, usage)
+        if explain and n_placed < len(chunk):
+            ex_span = (trace.begin_span("explain") if trace is not None
+                       else None)
+            try:
+                fm = np.zeros((dp.valid.shape[0],), bool)
+                fm[: len(chunk)][a < 0] = True
+                fr = _filter_pass(dp, dn_cur, w.ds, w.dt, dv, None, None)
+                ex = explain_reduce(fr.reasons, dn_cur.valid,
+                                    jnp.asarray(fm))
+                expl_pairs += np.asarray(ex.pair_hist, np.int64)
+                expl_pods += np.asarray(ex.pods_blocked, np.int64)
+            finally:
+                if ex_span is not None:
+                    trace.end_span(ex_span)
+        scheduled += n_placed
         rounds_total += int(rounds)
         if latency:
             lat.extend([time.perf_counter() - t0] * n_placed)
@@ -605,9 +647,41 @@ def run_batched(w: Workload, batch: int, cap: int, use_sinkhorn: bool = False,
             np.asarray(dn_cur.allocatable), np.asarray(usage.requested),
             assigned_all,
         )
+    if explain:
+        from kubernetes_tpu.ops.predicates import PREDICATE_BITS
+
+        out["unschedulable_breakdown"] = {
+            PREDICATE_BITS[b]: {
+                "pods": int(expl_pods[b]),
+                "node_exclusions": int(expl_pairs[b]),
+            }
+            for b in range(len(PREDICATE_BITS)) if expl_pods[b]
+        }
     if return_assigned:
         out["_assigned"] = assigned_all  # popped by the caller (not JSON)
     return out
+
+
+def measure_explain_overhead(n_nodes: int, n_pods: int, batch: int,
+                             cap: int = 8):
+    """Explain-on vs explain-off on a CONTENDED workload (pods exceed
+    capacity, so the why-pending pass fires on every batch — the
+    worst case; the uncontended headline pays ~nothing). One Workload
+    serves both runs (run_batched never mutates it), so the only delta
+    is the explain filter pass + reduction + readback. Returns both run
+    dicts plus ``overhead_frac`` = (off - on) / off in pods/sec."""
+    w = build_variant("base", n_nodes, 0, n_pods)
+    off = run_batched(w, batch, cap=cap)
+    on = run_batched(w, batch, cap=cap, explain=True)
+    off_pps = off["pods_per_sec"]
+    return {
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "explain_off": off,
+        "explain_on": on,
+        "overhead_frac": round(
+            (off_pps - on["pods_per_sec"]) / max(off_pps, 1e-9), 4),
+    }
 
 
 def run_sequential(w: Workload):
@@ -809,8 +883,12 @@ def main() -> None:
     try:
         with deadline(900 * dscale), tspan("headline"):
             w = build_variant("base", n_nodes, n_existing, n_pending)
+            # explain=True: the headline records its own unschedulable
+            # breakdown (usually empty — the workload fits), and the
+            # throughput number carries the explain path's cost so the
+            # <3% overhead budget is measured where it matters
             head = run_batched(w, batch, cap=8, latency=True,
-                               trace=BENCH_TRACE)
+                               trace=BENCH_TRACE, explain=True)
         RESULT["metric"] = (
             f"pods scheduled/sec, {n_nodes}-node/{n_pending}-pod "
             "scheduler_perf-style batch workload"
@@ -851,6 +929,31 @@ def main() -> None:
     except Exception as e:
         RESULT["errors"].append(f"cap_sweep: {short_err(e)}")
         log(f"cap_sweep FAILED: {short_err(e)}")
+
+    # ---- explain overhead: why-pending analytics on vs off ----
+    # The observability budget for the PR-4 explainer: on a contended
+    # workload (every batch leaves pods unplaced, so the explain filter
+    # pass + reduction fire each batch) the throughput delta must stay
+    # under 3% of the explain-off number. This measures the worst case —
+    # the real driver pays the failure filter pass anyway, so its
+    # marginal explain cost is lower still.
+    try:
+        if over_budget("explain_overhead"):
+            raise InterruptedError
+        en = int(os.environ.get("BENCH_EXPLAIN_NODES", 50 if light else 250))
+        ep = int(os.environ.get("BENCH_EXPLAIN_PODS",
+                                3000 if light else 20000))
+        with deadline(600 * dscale), tspan("explain_overhead"):
+            ov = measure_explain_overhead(en, ep, min(ep, batch), cap=8)
+        RESULT["extras"]["explain_overhead"] = ov
+        log(f"explain_overhead @{en}x{ep}: frac={ov['overhead_frac']} "
+            f"(off={ov['explain_off']['pods_per_sec']} "
+            f"on={ov['explain_on']['pods_per_sec']})")
+    except InterruptedError:
+        pass
+    except Exception as e:
+        RESULT["errors"].append(f"explain_overhead: {short_err(e)}")
+        log(f"explain_overhead FAILED: {short_err(e)}")
 
     # ---- same workload on CPU → TPU/CPU ratio ----
     # Measured at a COMMON shape both backends can finish (default

@@ -141,6 +141,8 @@ class ObservabilityConfigurationV1alpha1:
     retraceStormThreshold: Optional[int] = None
     retraceStormWindow: Optional[int] = None
     sinkhornTelemetry: Optional[bool] = None
+    explain: Optional[bool] = None
+    explainTopK: Optional[int] = None
 
 
 @dataclass
@@ -252,6 +254,10 @@ def set_defaults_kube_scheduler_configuration(
         ob.retraceStormWindow = 64
     if ob.sinkhornTelemetry is None:
         ob.sinkhornTelemetry = True
+    if ob.explain is None:
+        ob.explain = True
+    if ob.explainTopK is None:
+        ob.explainTopK = 3
     return obj
 
 
@@ -369,6 +375,8 @@ def _observability_to_internal(ob: ObservabilityConfigurationV1alpha1):
         retrace_storm_threshold=ob.retraceStormThreshold,
         retrace_storm_window=ob.retraceStormWindow,
         sinkhorn_telemetry=ob.sinkhornTelemetry,
+        explain=ob.explain,
+        explain_top_k=ob.explainTopK,
     )
 
 
@@ -455,6 +463,8 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
             retraceStormThreshold=c.observability.retrace_storm_threshold,
             retraceStormWindow=c.observability.retrace_storm_window,
             sinkhornTelemetry=c.observability.sinkhorn_telemetry,
+            explain=c.observability.explain,
+            explainTopK=c.observability.explain_top_k,
         ),
     )
 

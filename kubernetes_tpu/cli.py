@@ -143,6 +143,8 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         errs.append("observability.retraceStormThreshold: must be at least 1")
     if oc.retrace_storm_window < 1:
         errs.append("observability.retraceStormWindow: must be at least 1")
+    if oc.explain_top_k < 1:
+        errs.append("observability.explainTopK: must be at least 1")
     # unknown feature gates are rejected earlier, at FeatureGates
     # construction (featuregate.Set errors on unknown names)
     return errs

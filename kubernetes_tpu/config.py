@@ -172,6 +172,16 @@ class ObservabilityConfig:
     #: capture per-cycle Sinkhorn convergence stats (iteration count,
     #: final residual) when the sinkhorn tier solves a cycle
     sinkhorn_telemetry: bool = True
+    #: batched schedulability explainer (obs/explain.py): reduce the
+    #: cycle's (pod x node) failure bitmask into per-pod reason node
+    #: counts, the cluster reason histogram, and one-bit-away
+    #: relaxations — feeds /debug/why, the flight recorder's top
+    #: reasons, and scheduler_unschedulable_* metrics. The reduction is
+    #: jitted and read back at the cycle's existing host boundary; off
+    #: drops the analytics but keeps the FitError event text.
+    explain: bool = True
+    #: relaxations kept per pod and reasons kept per flight record
+    explain_top_k: int = 3
 
 
 @dataclass

@@ -74,18 +74,34 @@ class EventRecorder:
     """Aggregating recorder: events with the same (object, reason, message)
     within the aggregation window bump ``count`` (the
     EventAggregator/eventBroadcaster behavior that keeps event storms from
-    flooding etcd)."""
+    flooding etcd).
+
+    Sink fan-out is SPAM-FILTERED like the reference correlator
+    (client-go record/events_cache.go EventSourceObjectSpamFilter): a
+    recurrence of the same series bumps ``count`` in place, but sinks —
+    the API writes — are notified only at exponentially spaced counts
+    (1, 2, 4, 8, ...) or after ``sink_refresh_s`` of silence on the
+    series. An unschedulable pod failing 50 consecutive cycles used to
+    cost 50 identical FailedScheduling sink posts; now it costs 6 while
+    ``count`` (and any stored Event reference — the sink hands out the
+    live object) still reads 50."""
 
     def __init__(
         self,
         clock: Callable[[], float] = time.monotonic,
         sinks: Optional[List[Callable[[Event], None]]] = None,
         max_events: int = 10000,
+        sink_refresh_s: float = 300.0,
     ) -> None:
         self.clock = clock
         self.sinks = sinks or []
         self.max_events = max_events
+        #: a quiet series re-notifies sinks after this long even between
+        #: count milestones, so slow drips still reach the hub fresh
+        self.sink_refresh_s = sink_refresh_s
         self._events: Dict[Tuple[str, str, str], Event] = {}
+        #: series key -> (next count milestone, last sink-notify time)
+        self._sink_state: Dict[Tuple[str, str, str], Tuple[int, float]] = {}
 
     def event(self, reason: str, pod: Pod, message: str) -> Event:
         now = self.clock()
@@ -99,6 +115,7 @@ class EventRecorder:
                 # drop the oldest (bounded store; the hub is the real sink)
                 oldest = min(self._events, key=lambda k: self._events[k].last_timestamp)
                 del self._events[oldest]
+                self._sink_state.pop(oldest, None)
             ev = Event(
                 type=_REASON_TYPE.get(reason, TYPE_NORMAL),
                 reason=reason,
@@ -111,8 +128,11 @@ class EventRecorder:
                 involved_kind=getattr(pod, "involved_kind", "Pod"),
             )
             self._events[key] = ev
-        for sink in self.sinks:
-            sink(ev)
+        milestone, last_notify = self._sink_state.get(key, (1, -1e18))
+        if ev.count >= milestone or now - last_notify >= self.sink_refresh_s:
+            self._sink_state[key] = (max(milestone, ev.count * 2), now)
+            for sink in self.sinks:
+                sink(ev)
         return ev
 
     def sink(self) -> Callable[[str, Pod, str], None]:

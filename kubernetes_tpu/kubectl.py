@@ -178,6 +178,45 @@ def cmd_top(client, args) -> int:
     return 0
 
 
+def _pending_breakdown(failed_nodes: Dict[str, str], n_total: int,
+                       feasible: int) -> List[str]:
+    """kubectl-describe enrichment for a pending pod: aggregate the
+    filter verb's per-node failure reasons into the reference's
+    "0/N nodes are available: <count> <reason>, ..." line (FitError
+    shape, per-reason NODE counts) plus the top one-bit-away
+    relaxations — a node whose failure set is a single predicate is
+    opened by relaxing exactly that predicate (obs/explain.py
+    semantics, recomputed client-side from the wire reasons)."""
+    from kubernetes_tpu.obs.explain import reason_message
+    from kubernetes_tpu.ops.predicates import PREDICATE_BITS
+
+    predicates = set(PREDICATE_BITS)
+    per_reason: Dict[str, int] = {}
+    one_bit: Dict[str, int] = {}
+    for _node, why in failed_nodes.items():
+        names = [w for w in why.split(",") if w]
+        for nm in names:
+            per_reason[nm] = per_reason.get(nm, 0) + 1
+        # wire sentinels ("infeasible", "node not in snapshot") stay in
+        # the 0/N line but are not predicates — "relax infeasible" is
+        # not actionable advice
+        if len(names) == 1 and names[0] in predicates:
+            one_bit[names[0]] = one_bit.get(names[0], 0) + 1
+    lines: List[str] = []
+    if not feasible and per_reason:
+        parts = sorted(
+            f"{c} {reason_message(n)}" for n, c in per_reason.items())
+        lines.append(
+            f"Status: 0/{n_total} nodes are available: "
+            f"{', '.join(parts)}.")
+    if not feasible and one_bit:
+        lines.append("One-bit-away (single relaxation -> nodes opened):")
+        for nm, c in sorted(one_bit.items(),
+                            key=lambda kv: (-kv[1], kv[0]))[:3]:
+            lines.append(f"  relax {nm}: +{c} node(s)")
+    return lines
+
+
 def cmd_describe(client, args) -> int:
     from kubernetes_tpu.proto import extender_pb2 as pb
 
@@ -202,6 +241,11 @@ def cmd_describe(client, args) -> int:
             print("\nScheduling explanation (Filter):")
             if fr.error:
                 print(f"  error: {fr.error}")
+            for line in _pending_breakdown(
+                    dict(fr.failed_nodes),
+                    len(fr.node_names) + len(fr.failed_nodes),
+                    len(fr.node_names)):
+                print(line)
             for n in fr.node_names:
                 print(f"  {n}: feasible")
             for n, why in sorted(fr.failed_nodes.items()):

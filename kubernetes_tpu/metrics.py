@@ -12,8 +12,22 @@ test/e2e/framework/metrics/latencies.go:257) keep working:
 - per-phase algorithm histograms, binding latency, preemption counters,
   ``scheduler_pending_pods{queue}`` gauges.
 
+Beyond the reference set: degradation-ladder telemetry (fallbacks,
+breaker states, per-tier latency), runtime JAX telemetry
+(compile/retrace/transfer counters), and the PR-4 explainability +
+queue-observability block —
+``scheduler_unschedulable_pods_total{reason}`` /
+``scheduler_unschedulable_node_counts{reason}`` (from the batched
+why-pending reduction, ``obs/explain.py``),
+``scheduler_queue_pod_age_seconds{queue}`` sub-queue residency
+histograms, the ``scheduler_pod_scheduling_attempts`` histogram, and
+``scheduler_queue_incoming_pods_total{event}`` queue-event counters.
+
 Implementation is a small text-exposition registry (no client library in
-the image); histograms use the reference's bucket layouts.
+the image); histograms use the reference's bucket layouts. Exposition
+follows the text-format grammar (HELP/TYPE before samples, cumulative
+buckets with ``+Inf`` == ``_count``, label-value escaping) — pinned by
+the conformance test in ``tests/test_metrics_exposition.py``.
 """
 
 from __future__ import annotations
@@ -30,6 +44,14 @@ def exponential_buckets(start: float, factor: float, count: int) -> List[float]:
 _DEF_BUCKETS = exponential_buckets(0.001, 2, 15)  # metrics.go:91 et al.
 
 
+def escape_label_value(v: str) -> str:
+    """Prometheus text-format label escaping (backslash, quote, newline)
+    — free-text labels (solver rejection reasons, extender names) must
+    never break the exposition line grammar."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 class _Metric:
     def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
         self.name = name
@@ -40,7 +62,10 @@ class _Metric:
         return tuple(labels.get(k, "") for k in self.label_names)
 
     def _fmt_labels(self, key: Tuple[str, ...], extra: str = "") -> str:
-        parts = [f'{k}="{v}"' for k, v in zip(self.label_names, key)]
+        parts = [
+            f'{k}="{escape_label_value(v)}"'
+            for k, v in zip(self.label_names, key)
+        ]
         if extra:
             parts.append(extra)
         return "{" + ",".join(parts) + "}" if parts else ""
@@ -335,4 +360,44 @@ class SchedulerMetrics:
             "scheduler_sinkhorn_final_residual",
             "Final max row-potential delta of the last Sinkhorn solve "
             "(log-domain; lower is more converged).",
+        ))
+        # -- schedulability explainer (obs/explain.py): the batched
+        # why-pending reduction over the (pod x node) failure bitmask ---
+        self.unschedulable_pods = r.register(Counter(
+            "scheduler_unschedulable_pods_total",
+            "Unschedulable pod observations per cycle, by the predicate "
+            "that blocked them on at least one node (one pod can count "
+            "under several reasons).",
+            ["reason"],
+        ))
+        self.unschedulable_node_counts = r.register(Gauge(
+            "scheduler_unschedulable_node_counts",
+            "Last cycle's total (pod, node) predicate-failure pairs per "
+            "reason — how many node exclusions each constraint class "
+            "caused across the residual queue.",
+            ["reason"],
+        ))
+        # -- queue observability (scheduler_queue.go metrics parity) ----
+        self.queue_pod_age = r.register(Histogram(
+            "scheduler_queue_pod_age_seconds",
+            "Time pods spent in a scheduling sub-queue before leaving it "
+            "(observed at queue exit), by sub-queue.",
+            ["queue"],
+            # residency runs minutes-to-hours (the unschedulable flush
+            # alone is 60s), so the default 1ms..16s latency layout
+            # would collapse every sample into +Inf — span 10ms..~87min
+            buckets=exponential_buckets(0.01, 2, 20),
+        ))
+        self.pod_scheduling_attempts = r.register(Histogram(
+            "scheduler_pod_scheduling_attempts",
+            "Number of attempts it took to successfully schedule a pod.",
+            buckets=[1, 2, 4, 8, 16],
+        ))
+        self.queue_incoming_pods = r.register(Counter(
+            "scheduler_queue_incoming_pods_total",
+            "Pods added to scheduling queues, by the event that moved "
+            "them (PodAdd, PodUpdate, ScheduleAttemptFailure, "
+            "BackoffComplete, UnschedulableTimeout, MoveAllToActive, "
+            "MovePodsToActive).",
+            ["event"],
         ))

@@ -15,6 +15,11 @@ every capture happens at the host boundaries graftlint already blesses):
   recorder of recent cycle records (batch shape digest, ladder tier,
   fallback/retry/breaker transitions, span timings), dumpable via
   debugger.py / SIGUSR2 and the ``/debug/flightrecorder`` endpoint.
+- :mod:`kubernetes_tpu.obs.explain` — the batched schedulability
+  explainer: one jitted reduction turns the cycle's (P, N) predicate
+  failure bitmask into per-pod reason node counts, the cluster-wide
+  reason histogram, and one-bit-away relaxations; surfaced on
+  ``/debug/why``, the flight recorder, metrics, and ``kubectl``.
 
 :class:`kubernetes_tpu.obs.core.Observability` is the facade the
 scheduler owns; config rides :class:`kubernetes_tpu.config.
@@ -22,6 +27,13 @@ ObservabilityConfig` (and its v1alpha1 block).
 """
 
 from kubernetes_tpu.obs.core import Observability
+from kubernetes_tpu.obs.explain import (
+    ExplainResult,
+    PodExplanation,
+    UnschedulableReport,
+    build_report,
+    explain_reduce,
+)
 from kubernetes_tpu.obs.jaxtel import JaxTelemetry, abstract_digest, tree_nbytes
 from kubernetes_tpu.obs.recorder import CycleRecord, FlightRecorder
 from kubernetes_tpu.obs.trace import (
@@ -33,6 +45,11 @@ from kubernetes_tpu.obs.trace import (
 
 __all__ = [
     "Observability",
+    "ExplainResult",
+    "PodExplanation",
+    "UnschedulableReport",
+    "build_report",
+    "explain_reduce",
     "JaxTelemetry",
     "abstract_digest",
     "tree_nbytes",

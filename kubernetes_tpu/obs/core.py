@@ -128,6 +128,12 @@ class Observability:
         once at end_cycle (the cycle's host boundary)."""
         self._sinkhorn_stats = stats
 
+    def note_explain(self, report) -> None:
+        """Stash the cycle's UnschedulableReport (already decoded at the
+        host boundary by the driver); the flight record keeps its top-K
+        reasons."""
+        self._scratch["explain"] = report
+
     # -- cycle close --------------------------------------------------------
 
     def end_cycle(self, res=None) -> Optional[CycleRecord]:
@@ -185,6 +191,11 @@ class Observability:
             retraces=self.jax.retrace_total() - self._retraces_at_begin,
             sinkhorn_iters=sk_iters,
             sinkhorn_residual=sk_resid,
+            top_reasons=(
+                s["explain"].top_reasons(
+                    getattr(self.config, "explain_top_k", 3))
+                if s.get("explain") is not None else []
+            ),
         )
         self.recorder.record(rec)
         self._eventful_seq += 1

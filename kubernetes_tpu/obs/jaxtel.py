@@ -188,12 +188,19 @@ class JaxTelemetry:
             self.metrics.host_transfers.inc(site=site, direction=direction)
 
     def readback(self, site: str, x):
-        """The declared d2h host boundary: materialize ``x`` on host
-        (np.asarray — the same sync the caller was about to do) and
-        account the bytes."""
-        arr = np.asarray(x)
-        self.record_transfer(site, "d2h", arr.nbytes)
-        return arr
+        """The declared d2h host boundary: materialize ``x`` — a single
+        array or a pytree of arrays (NamedTuple structure preserved) —
+        on host in one ``jax.device_get`` and account the total bytes as
+        ONE transfer at the site, instead of one sync + one accounting
+        entry per leaf."""
+        import jax
+
+        host = jax.device_get(x)
+        nbytes = sum(
+            np.asarray(leaf).nbytes
+            for leaf in jax.tree_util.tree_leaves(host))
+        self.record_transfer(site, "d2h", nbytes)
+        return host
 
     def record_upload(self, site: str, *trees) -> None:
         """Account an h2d upload from array metadata (no sync)."""

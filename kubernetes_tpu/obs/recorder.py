@@ -45,6 +45,10 @@ class CycleRecord:
     retraces: int = 0
     sinkhorn_iters: float = -1.0  # -1 = sinkhorn not engaged
     sinkhorn_residual: float = -1.0
+    #: top-K unschedulability reasons this cycle — (predicate name,
+    #: blocked-pod count) from the explain reduction (obs/explain.py);
+    #: empty when nothing failed or the explainer is off
+    top_reasons: List[Tuple[str, int]] = field(default_factory=list)
 
     def to_json(self) -> dict:
         return {
@@ -65,6 +69,8 @@ class CycleRecord:
             **({"sinkhorn_iters": self.sinkhorn_iters,
                 "sinkhorn_residual": self.sinkhorn_residual}
                if self.sinkhorn_iters >= 0 else {}),
+            **({"top_reasons": [list(x) for x in self.top_reasons]}
+               if self.top_reasons else {}),
         }
 
 
@@ -127,6 +133,9 @@ class FlightRecorder:
                 flags.append(f"retries={r.retries}")
             for tgt, old, new in r.breaker_transitions:
                 flags.append(f"breaker[{tgt}]:{old}->{new}")
+            if r.top_reasons:
+                flags.append("why=" + ",".join(
+                    f"{name}:{n}" for name, n in r.top_reasons))
             spans = " ".join(
                 f"{k}={v*1000:.1f}ms" for k, v in sorted(r.spans.items()))
             lines.append(

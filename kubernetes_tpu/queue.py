@@ -66,6 +66,11 @@ class PodBackoffMap:
         d = min(self.initial * (2.0 ** (n - 1)), self.maximum)
         return self._last_update[key] + d
 
+    def attempts(self, key: str) -> int:
+        """Failed attempts recorded for the pod (the explain/metrics
+        surface: scheduling attempts = failures + the current try)."""
+        return self._attempts.get(key, 0)
+
     def clear_pod(self, key: str) -> None:
         self._attempts.pop(key, None)
         self._last_update.pop(key, None)
@@ -144,6 +149,7 @@ class SchedulingQueue:
         self,
         clock: Callable[[], float] = time.monotonic,
         less: Optional[Callable[[Pod, Pod], bool]] = None,
+        metrics=None,
     ) -> None:
         self.clock = clock
         self._seq = itertools.count()
@@ -159,6 +165,50 @@ class SchedulingQueue:
         #: custom QueueSort comparator (framework queue-sort plugin,
         #: interface.go:131); None = priority desc then arrival asc.
         self._less = less
+        #: optional SchedulerMetrics: the queue drives
+        #: scheduler_queue_incoming_pods_total{event}, the per-sub-queue
+        #: scheduler_queue_pod_age_seconds{queue} residency histograms,
+        #: and keeps scheduler_pending_pods{queue} fresh on EVERY
+        #: mutation (not just at cycle boundaries). The scheduler
+        #: attaches its metrics object; standalone queues stay silent.
+        self.metrics = metrics
+        #: key -> (sub-queue, enter time) for residency accounting
+        self._entered: Dict[str, Tuple[str, float]] = {}
+
+    # -- metrics plumbing --------------------------------------------------
+
+    def _note_enter(self, key: str, queue: str) -> None:
+        prev = self._entered.get(key)
+        if prev is not None and prev[0] == queue:
+            # in-place update / re-add within the same sub-queue: the pod
+            # never left, so no exit sample and the original stamp stands
+            # (same reason update() preserves queued_at)
+            return
+        if prev is not None and self.metrics is not None:
+            q, t = prev
+            self.metrics.queue_pod_age.observe(
+                max(self.clock() - t, 0.0), queue=q)
+        self._entered[key] = (queue, self.clock())
+
+    def _note_exit(self, key: str) -> None:
+        ent = self._entered.pop(key, None)
+        if ent is not None and self.metrics is not None:
+            q, t = ent
+            self.metrics.queue_pod_age.observe(
+                max(self.clock() - t, 0.0), queue=q)
+
+    def _incoming(self, event: str, n: int = 1) -> None:
+        if self.metrics is not None and n:
+            self.metrics.queue_incoming_pods.inc(n, event=event)
+
+    def _sync_gauges(self) -> None:
+        """scheduler_pending_pods{queue} refresh — the ONE place the
+        gauge is set, called after every membership mutation so scrapes
+        between cycles see the live depths."""
+        if self.metrics is None:
+            return
+        for q, depth in self.pending_counts().items():
+            self.metrics.pending_pods.set(depth, queue=q)
 
     # -- internal ----------------------------------------------------------
 
@@ -169,11 +219,13 @@ class SchedulingQueue:
             key = _CmpKey(self._less, pod, next(self._seq))
         heapq.heappush(self._active, _ActiveEntry(key, pod))
         self._in_active[pod.key()] = pod
+        self._note_enter(pod.key(), "active")
 
     def _push_backoff(self, pod: Pod) -> None:
         expiry = self.backoff_map.backoff_time(pod.key())
         heapq.heappush(self._backoff, (expiry, next(self._seq), pod.key()))
         self._in_backoff[pod.key()] = pod
+        self._note_enter(pod.key(), "backoff")
 
     def pending_pods(self) -> Dict[str, List[Pod]]:
         """Snapshot of queued pods by sub-queue (tooling/state dumps)."""
@@ -200,9 +252,16 @@ class SchedulingQueue:
         removes stale copies from the other queues."""
         if not pod.queued_at:
             pod.queued_at = self.clock()
-        self._remove_everywhere(pod.key())
+        # an informer relist re-adds every queued pod: that is not a
+        # departure (keep the residency stamp — the same-queue guard in
+        # _note_enter reuses it) and not a second PodAdd
+        readd = self._contains(pod.key())
+        self._remove_everywhere(pod.key(), observe=not readd)
         self._push_active(pod)
         self.nominated.add(pod)
+        if not readd:
+            self._incoming("PodAdd")
+        self._sync_gauges()
 
     def add_if_not_present(self, pod: Pod) -> None:
         if self._contains(pod.key()):
@@ -221,6 +280,9 @@ class SchedulingQueue:
             self._push_backoff(pod)
         else:
             self._unschedulable[pod.key()] = (pod, self.clock())
+            self._note_enter(pod.key(), "unschedulable")
+        self._incoming("ScheduleAttemptFailure")
+        self._sync_gauges()
 
     def record_failure(self, pod: Pod) -> None:
         """Bump the pod's backoff clock (the driver calls this on every
@@ -238,9 +300,11 @@ class SchedulingQueue:
             if self._in_active.get(e.pod.key()) is not e.pod:
                 continue  # superseded entry
             del self._in_active[e.pod.key()]
+            self._note_exit(e.pod.key())
             out.append(e.pod)
         if out:
             self.scheduling_cycle += 1
+            self._sync_gauges()
         return out
 
     def update(self, old_key: str, pod: Pod) -> None:
@@ -267,6 +331,9 @@ class SchedulingQueue:
             self._push_active(pod)
         else:
             self.add(pod)
+            return
+        self._incoming("PodUpdate")
+        self._sync_gauges()
 
     def delete(self, pod_key: str) -> None:
         self._remove_everywhere(pod_key)
@@ -276,29 +343,41 @@ class SchedulingQueue:
             ns, name = pod_key.split("/", 1)
             self.nominated.delete(Pod(name=name, namespace=ns))
         self.backoff_map.clear_pod(pod_key)
+        self._sync_gauges()
 
-    def _remove_everywhere(self, key: str) -> None:
+    def _remove_everywhere(self, key: str, observe: bool = True) -> None:
         self._in_active.pop(key, None)
         self._in_backoff.pop(key, None)
         self._unschedulable.pop(key, None)
+        if observe:
+            # observe=False: the caller is about to re-insert the pod
+            # (relist re-add), so the residency stamp must survive
+            self._note_exit(key)
 
     def move_all_to_active(self) -> None:
         """MoveAllToActiveQueue (scheduling_queue.go:519): every
         unschedulable pod moves to activeQ — or backoffQ if still backing
         off — and the move-request cycle is stamped."""
         now = self.clock()
+        moved = 0
         for key, (pod, _) in list(self._unschedulable.items()):
             del self._unschedulable[key]
             if self.backoff_map.backoff_time(key) > now:
                 self._push_backoff(pod)
             else:
                 self._push_active(pod)
+            moved += 1
         self.move_request_cycle = self.scheduling_cycle
+        self._incoming("MoveAllToActive", moved)
+        self._sync_gauges()
 
-    def move_pods_to_active(self, keys: Sequence[str]) -> None:
+    def move_pods_to_active(self, keys: Sequence[str],
+                            event: str = "MovePodsToActive") -> None:
         """Subset move (movePodsToActiveQueue) — used by AssignedPodAdded to
-        wake only pods with matching affinity terms."""
+        wake only pods with matching affinity terms. ``event`` labels the
+        incoming-pods counter with what triggered the move."""
         now = self.clock()
+        moved = 0
         for key in keys:
             ent = self._unschedulable.pop(key, None)
             if ent is None:
@@ -308,7 +387,10 @@ class SchedulingQueue:
                 self._push_backoff(pod)
             else:
                 self._push_active(pod)
+            moved += 1
         self.move_request_cycle = self.scheduling_cycle
+        self._incoming(event, moved)
+        self._sync_gauges()
 
     def assigned_pod_added(self, pod: Pod) -> None:
         """AssignedPodAdded (scheduling_queue.go): an assigned pod appearing
@@ -321,16 +403,21 @@ class SchedulingQueue:
             if _affinity_could_match(u, pod)
         ]
         if keys:
-            self.move_pods_to_active(keys)
+            self.move_pods_to_active(keys, event="AssignedPodAdded")
 
     def flush_backoff_completed(self) -> None:
         """flushBackoffQCompleted (scheduling_queue.go:334) — run each tick."""
         now = self.clock()
+        moved = 0
         while self._backoff and self._backoff[0][0] <= now:
             _, _, key = heapq.heappop(self._backoff)
             pod = self._in_backoff.pop(key, None)
             if pod is not None:
                 self._push_active(pod)
+                moved += 1
+        if moved:
+            self._incoming("BackoffComplete", moved)
+            self._sync_gauges()
 
     def flush_unschedulable_leftover(self) -> None:
         """flushUnschedulableQLeftover (scheduling_queue.go:368): pods stuck
@@ -342,7 +429,7 @@ class SchedulingQueue:
             if now - added >= UNSCHEDULABLEQ_FLUSH_S
         ]
         if keys:
-            self.move_pods_to_active(keys)
+            self.move_pods_to_active(keys, event="UnschedulableTimeout")
 
     def tick(self) -> None:
         """One maintenance sweep = the reference's periodic flush goroutines."""

@@ -126,6 +126,11 @@ class CycleResult:
     solver_tier: str = ""
     #: tier-to-tier fallbacks taken this cycle (0 on the healthy path)
     solver_fallbacks: int = 0
+    #: per-cycle UnschedulableReport (obs/explain.py) — why the
+    #: residual pods stayed pending: per-pod reason node counts, the
+    #: cluster reason histogram, one-bit-away relaxations. None when the
+    #: explainer is off or the cycle ended before the solve.
+    explain: Optional[object] = None
 
 
 class Scheduler:
@@ -240,9 +245,28 @@ class Scheduler:
         #: per-pod CycleState, alive from prefilter to bind/fail
         self._cycle_states: Dict[str, object] = {}
         self.cache = cache or SchedulerCache(clock=clock)
-        self.queue = queue or SchedulingQueue(
-            clock=clock, less=self.framework.queue_sort_less()
+        # explicit None check: SchedulingQueue defines __len__, so a
+        # caller-provided EMPTY queue is falsy and `queue or ...` would
+        # silently replace it with a fresh one
+        self.queue = queue if queue is not None else SchedulingQueue(
+            clock=clock, less=self.framework.queue_sort_less(),
+            metrics=self.metrics,
         )
+        # an externally built queue gets this scheduler's metrics so the
+        # queue-observability surface (incoming counters, sub-queue age
+        # histograms, mutation-fresh pending_pods gauges) stays live;
+        # duck-typed so queue fakes without the attribute stay valid
+        if getattr(self.queue, "metrics", "absent") is None:
+            self.queue.metrics = self.metrics
+        #: latest explanation per still-pending pod (the /debug/why
+        #: surface): updated each cycle from the UnschedulableReport,
+        #: dropped when the pod binds or leaves
+        self.why_pending: Dict[str, object] = {}
+        #: the most recent cycle's UnschedulableReport (cluster summary)
+        self.last_explain = None
+        #: reason labels ever exported on the unschedulable gauges —
+        #: lets a cycle zero out reasons that stopped firing
+        self._explain_reasons_seen: set = set()
         #: node-search truncation (percentageOfNodesToScore): None =
         #: evaluate every node (the dense solver's natural mode); 0 =
         #: the reference's adaptive 50%→5% rule; 1-99 = fixed percent.
@@ -437,6 +461,7 @@ class Scheduler:
             self.queue.delete(key)
         self.cache.packer.forget_pod(key)
         self._cycle_states.pop(key, None)
+        self.why_pending.pop(key, None)
 
     def on_node_add(self, node) -> None:
         self.cache.add_node(node)
@@ -511,6 +536,7 @@ class Scheduler:
         if not batch:
             res.elapsed_s = self.clock() - t0
             self._record_metrics(res)
+            self._explain_retire_if_drained()
             self.obs.end_cycle(res)
             return res
         cycle = self.queue.scheduling_cycle
@@ -535,7 +561,13 @@ class Scheduler:
                 self._fail(p, cycle, res, (f"PreFilter:{status.message}",))
         batch = kept
         if not batch:
+            # every popped pod failed PreFilter: they still get report
+            # rows (status reasons, no device analytics) and the reason
+            # gauges roll over to this cycle instead of going stale
             res.elapsed_s = self.clock() - t0
+            if getattr(self.obs.config, "explain", True):
+                self._build_explain_report(
+                    cycle, [], [], None, self.cache.node_count(), res)
             self._record_metrics(res)
             self.obs.end_cycle(res)
             return res
@@ -786,6 +818,7 @@ class Scheduler:
         reasons_row: Dict[int, Tuple[str, ...]] = {}
         fit_msgs: Dict[int, str] = {}
         rmat = None
+        ex_host = None
         if failed_idx:
             from kubernetes_tpu.ops.predicates import fit_error_message
             from kubernetes_tpu.snapshot import FIXED_RESOURCE_NAMES
@@ -793,6 +826,17 @@ class Scheduler:
             fr = _filter_pass(
                 dp, nodes_with_usage(dn, usage), ds, dt, dv, sv, self.pred_mask
             )
+            if getattr(self.obs.config, "explain", True):
+                # the why-pending reduction rides the SAME jitted reasons
+                # matrix and is read back at the same host boundary as
+                # the failure-reason sync below — the solve path gains no
+                # synchronization point (graftlint R2/R3 stay clean)
+                from kubernetes_tpu.obs.explain import explain_reduce
+
+                fm = np.zeros((dp.valid.shape[0],), bool)
+                fm[failed_idx] = True
+                ex = explain_reduce(fr.reasons, dn.valid, jnp.asarray(fm))
+                ex_host = self.obs.jax.readback("explain", ex)._asdict()
             rmat = self.obs.jax.readback("failure-reasons", fr.reasons)
             nvalid = np.asarray(dn.valid)
             free = np.asarray(dn.allocatable) - np.asarray(usage.requested)
@@ -882,6 +926,15 @@ class Scheduler:
         trace.end_span(bind_span)
         trace.step(f"bound {res.scheduled}, failed {res.unschedulable}")
 
+        # schedulability explainer: decode the read-back reduction into
+        # the cycle's UnschedulableReport — every _fail'd pod gets a row
+        # (filter failures carry device analytics; plugin/gang/bind
+        # failures carry their status reasons), feeding /debug/why, the
+        # flight recorder's top-K, and the unschedulable metrics
+        if getattr(self.obs.config, "explain", True):
+            self._build_explain_report(
+                cycle, batch, failed_idx, ex_host, nt.n, res)
+
         # preemption (scheduler.go:493 -> preempt, §3.3): failed pods try to
         # evict lower-priority pods; winners get a nominated node and retry
         preemptable_idx = [i for i in failed_idx if i not in gang_failed]
@@ -917,8 +970,82 @@ class Scheduler:
         if res.attempted or res.scheduled or res.unschedulable:
             m.e2e_scheduling_duration.observe(res.elapsed_s)
             m.scheduling_duration.observe(solve_s, operation="scheduling_algorithm")
-        for q, depth in self.queue.pending_counts().items():
-            m.pending_pods.set(depth, queue=q)
+        # pending_pods gauge freshness is the QUEUE's job (set in one
+        # place per mutation — _sync_gauges); the cycle-boundary call
+        # here only covers queue fakes without the metrics plumbing
+        sync = getattr(self.queue, "_sync_gauges", None)
+        if sync is not None:
+            sync()
+        else:
+            for q, depth in self.queue.pending_counts().items():
+                m.pending_pods.set(depth, queue=q)
+
+    def _explain_retire_if_drained(self) -> None:
+        """An idle cycle popped nothing: when every pod the last report
+        analyzed has since left (bind and delete both drop their
+        why_pending rows), the cluster summary and the reason gauges
+        would otherwise keep reporting them forever — retire the report
+        and zero the gauges. Pods merely parked in backoff/unschedulable
+        still hold why_pending rows, so their analysis stays visible
+        between retries."""
+        if not getattr(self.obs.config, "explain", True):
+            return
+        if self.why_pending or self.last_explain is None:
+            return
+        if not (self.last_explain.pods
+                or self.last_explain.reason_node_counts):
+            return
+        from kubernetes_tpu.obs.explain import UnschedulableReport
+
+        self.last_explain = UnschedulableReport(
+            cycle=self.queue.scheduling_cycle,
+            n_nodes=self.last_explain.n_nodes)
+        for reason in self._explain_reasons_seen:
+            self.metrics.unschedulable_node_counts.set(0, reason=reason)
+
+    def _build_explain_report(self, cycle, batch, failed_idx, ex_host,
+                              n_nodes, res: CycleResult) -> None:
+        """Assemble the cycle's UnschedulableReport from the read-back
+        explain arrays + the driver-level failure reasons, then fan it
+        out: CycleResult, /debug/why state, flight record, metrics."""
+        from kubernetes_tpu.obs.explain import PodExplanation, build_report
+
+        top_k = getattr(self.obs.config, "explain_top_k", 3)
+        keys = [p.key() for p in batch]
+        report = build_report(cycle, n_nodes, keys, failed_idx, ex_host,
+                              top_k)
+        # pods that failed OUTSIDE the filter pass (prefilter, plugins,
+        # gang rollback, volume/permit/bind errors) still get a row
+        for key in res.failure_reasons:
+            if key not in report.pods:
+                report.pods[key] = PodExplanation(key=key)
+        now = self.clock()
+        for key, pe in report.pods.items():
+            pe.reasons = res.failure_reasons.get(key, ())
+            pe.message = res.fit_errors.get(key, "")
+            pe.attempts = self.queue.backoff_map.attempts(key)
+            pod = self.queue.pod(key)
+            if pod is not None:
+                # the queue stamps queued_at on add (0.0 is a valid
+                # fake-clock enqueue time, not "unset")
+                pe.queue_residency_s = max(
+                    now - getattr(pod, "queued_at", now), 0.0)
+        res.explain = report
+        self.last_explain = report
+        for key, pe in report.pods.items():
+            self.why_pending[key] = pe
+        self.obs.note_explain(report)
+        m = self.metrics
+        for reason, npods in report.reason_pods.items():
+            m.unschedulable_pods.inc(npods, reason=reason)
+        # gauges show THIS cycle's exclusion counts; reasons that fired
+        # before but not now drop to zero instead of going stale
+        for reason in self._explain_reasons_seen - set(
+                report.reason_node_counts):
+            m.unschedulable_node_counts.set(0, reason=reason)
+        for reason, pairs in report.reason_node_counts.items():
+            m.unschedulable_node_counts.set(pairs, reason=reason)
+            self._explain_reasons_seen.add(reason)
 
     # -- degradation ladder ------------------------------------------------
 
@@ -1372,6 +1499,13 @@ class Scheduler:
         self.metrics.binding_duration.observe(self.clock() - bt0)
         self.cache.finish_binding(pod.key())
         self.queue.nominated.delete(pod)
+        # scheduling-attempt count for the landed pod (failures recorded
+        # in the backoff map + this successful try), then reset so a
+        # recreated pod with the same key starts fresh
+        self.metrics.pod_scheduling_attempts.observe(
+            self.queue.backoff_map.attempts(pod.key()) + 1)
+        self.queue.backoff_map.clear_pod(pod.key())
+        self.why_pending.pop(pod.key(), None)
         res.scheduled += 1
         res.assignments[pod.key()] = node_name
         fw.run_postbind(st, pod, node_name)

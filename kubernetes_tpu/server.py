@@ -180,6 +180,60 @@ class ExtenderServer:
         return out
 
 
+def why_payload(sched, path: str):
+    """The ``/debug/why`` body (schedulability explainer surface,
+    obs/explain.py): ``?pod=<ns/name or name>`` returns that pod's
+    latest explanation — per-predicate node exclusion counts, scheduling
+    attempts, queue residency, and the top one-bit-away relaxations;
+    without an argument, the latest cycle's cluster summary. Returns
+    ``(status, json-able dict)``."""
+    import heapq
+    from urllib.parse import parse_qs, urlparse
+
+    q = parse_qs(urlparse(path).query)
+    pod = (q.get("pod") or [""])[0]
+    why = getattr(sched, "why_pending", None)
+    if why is None:
+        return 404, {"error": "no explain surface on this scheduler"}
+    # the handler runs on the HTTP thread while the scheduling loop
+    # mutates why_pending: dict() is a GIL-atomic C-level copy (str
+    # keys, no callbacks), so iteration below can't race the scheduler
+    why = dict(why)
+    if pod:
+        pe = why.get(pod)
+        if pe is None and "/" not in pod:
+            # bare names resolve like kubectl's default namespace, then
+            # by suffix across namespaces
+            pe = why.get(f"default/{pod}")
+            if pe is None:
+                hits = [k for k in why if k.endswith(f"/{pod}")]
+                pe = why[hits[0]] if len(hits) == 1 else None
+        if pe is None:
+            return 404, {
+                "error": f"no pending-pod explanation for {pod!r}",
+                "known": heapq.nsmallest(50, why),
+            }
+        return 200, pe.to_json()
+    rep = getattr(sched, "last_explain", None)
+    # cap the key listing like the 404 path — at bench scale the
+    # residual queue is tens of thousands of pods and a poll must not
+    # serialize a multi-MB document; pending_total carries the real size
+    if rep is None:
+        return 200, {"unschedulable": 0, "pending_total": len(why),
+                     "pending_known": heapq.nsmallest(50, why),
+                     "note": "no unschedulable pods analyzed yet"}
+    from kubernetes_tpu.obs.explain import summarize_breakdown
+
+    doc = rep.to_json()
+    # same 50-key cap as pending_known: "unschedulable" carries the real
+    # per-cycle count, so the sample is informational only
+    doc["pods"] = heapq.nsmallest(50, rep.pods)
+    doc["summary"] = summarize_breakdown(rep.reason_pods, rep.n_nodes)
+    doc["pending_total"] = len(why)
+    doc["pending_known"] = heapq.nsmallest(50, why)
+    return 200, doc
+
+
 def serve_scheduler(
     scheduler,
     host: str = "127.0.0.1",
@@ -233,6 +287,10 @@ def serve_scheduler(
                     self._respond(
                         200, json.dumps(obs.debug_payload()).encode(),
                         "application/json")
+            elif self.path.split("?", 1)[0] == "/debug/why":
+                code, doc = why_payload(sched, self.path)
+                self._respond(code, json.dumps(doc).encode(),
+                              "application/json")
             else:
                 self._respond(404, b"not found", "text/plain")
 

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Perf-regression detector over the committed bench records.
+
+Compares the two most recent ``benchres/bench_r*.json`` full-result
+documents (the files ``bench.py`` writes via BENCH_FULL_OUT) and exits
+non-zero when the headline regressed — the manual CI gate run next to
+``scripts/lint_report.py`` before a perf-sensitive PR lands::
+
+    python scripts/bench_compare.py                     # text verdict
+    python scripts/bench_compare.py --format json       # machine shape
+    python scripts/bench_compare.py --threshold 0.05    # 5% tolerance
+    python scripts/bench_compare.py old.json new.json   # explicit pair
+
+Checks, each tolerance-gated (``--threshold``, default 10% — bench hosts
+are shared and noisy; tighten for dedicated hardware):
+
+- headline pods/sec must not drop more than the threshold;
+- headline p99 scheduling latency must not grow more than the threshold;
+- every variant-grid entry present in BOTH records is compared the same
+  way (pods/sec only — variants don't record latency);
+- the explain-overhead section (PR-4 observability budget) must stay
+  under ``--explain-threshold`` (default 3%) in the NEW record alone.
+
+Records carrying errors in the compared sections are skipped with a
+warning rather than failing the gate — a partial bench record is a bench
+problem, not a perf regression.
+
+Exit codes: 0 ok (or not enough records), 1 regression, 2 usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def find_records(directory: str) -> List[str]:
+    """bench_r*.json sorted by round number then name — the newest
+    record is the comparison subject."""
+
+    def round_key(path: str) -> Tuple[int, str]:
+        m = re.search(r"bench_r(\d+)", os.path.basename(path))
+        return (int(m.group(1)) if m else -1, os.path.basename(path))
+
+    return sorted(glob.glob(os.path.join(directory, "bench_r*.json")),
+                  key=round_key)
+
+
+def load(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _num(x) -> Optional[float]:
+    try:
+        v = float(x)
+    except (TypeError, ValueError):
+        return None
+    return v if v == v else None  # NaN -> None
+
+
+def compare(prev: dict, cur: dict, threshold: float,
+            explain_threshold: float) -> dict:
+    """Pure comparison core (unit-tested): returns the verdict document
+    {checks: [...], regressions: [...], warnings: [...]}"""
+    checks, regressions, warnings = [], [], []
+
+    def check(name: str, prev_v, cur_v, lower_is_better: bool = False):
+        pv, cv = _num(prev_v), _num(cur_v)
+        if pv is None or cv is None or pv <= 0:
+            warnings.append(f"{name}: not comparable "
+                            f"(prev={prev_v!r}, cur={cur_v!r})")
+            return
+        delta = (cv - pv) / pv
+        bad = delta > threshold if lower_is_better else delta < -threshold
+        row = {"check": name, "prev": pv, "cur": cv,
+               "delta_frac": round(delta, 4), "regressed": bad}
+        checks.append(row)
+        if bad:
+            regressions.append(row)
+
+    check("headline.pods_per_sec", prev.get("value"), cur.get("value"))
+    ph = (prev.get("extras", {}).get("headline") or {})
+    ch = (cur.get("extras", {}).get("headline") or {})
+    check("headline.p99_latency_s",
+          (ph.get("latency_s") or {}).get("p99"),
+          (ch.get("latency_s") or {}).get("p99"),
+          lower_is_better=True)
+
+    pv_variants = prev.get("extras", {}).get("variants") or {}
+    cv_variants = cur.get("extras", {}).get("variants") or {}
+    for name in sorted(set(pv_variants) & set(cv_variants)):
+        check(f"variant.{name}.pods_per_sec",
+              (pv_variants[name] or {}).get("pods_per_sec"),
+              (cv_variants[name] or {}).get("pods_per_sec"))
+    only = sorted(set(pv_variants) ^ set(cv_variants))
+    if only:
+        warnings.append(f"variants present in one record only "
+                        f"(skipped): {', '.join(only)}")
+
+    # explain overhead is an absolute budget on the NEW record, not a
+    # delta: the why-pending analytics must stay under the threshold of
+    # headline throughput wherever the bench ran
+    ov = cur.get("extras", {}).get("explain_overhead") or {}
+    frac = _num(ov.get("overhead_frac"))
+    if frac is not None:
+        bad = frac > explain_threshold
+        row = {"check": "explain_overhead.overhead_frac", "prev": None,
+               "cur": frac, "delta_frac": frac, "regressed": bad}
+        checks.append(row)
+        if bad:
+            regressions.append(row)
+
+    for rec, label in ((prev, "prev"), (cur, "cur")):
+        errs = rec.get("errors") or []
+        if errs:
+            warnings.append(f"{label} record carries {len(errs)} bench "
+                            f"error(s); affected sections may be absent")
+    return {"checks": checks, "regressions": regressions,
+            "warnings": warnings}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("records", nargs="*",
+                    help="explicit OLD NEW record pair (default: the two "
+                         "newest benchres/bench_r*.json)")
+    ap.add_argument("--dir", default=os.path.join(REPO_ROOT, "benchres"))
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="fractional tolerance per check (default 0.10)")
+    ap.add_argument("--explain-threshold", type=float, default=0.03,
+                    help="absolute budget for explain_overhead.overhead_"
+                         "frac in the new record (default 0.03)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    if args.records and len(args.records) != 2:
+        print("error: pass exactly two records (OLD NEW) or none",
+              file=sys.stderr)
+        return 2
+    if args.records:
+        prev_path, cur_path = args.records
+    else:
+        found = find_records(args.dir)
+        if len(found) < 2:
+            msg = (f"not enough bench records in {args.dir} "
+                   f"({len(found)} found; need 2) — nothing to gate")
+            if args.format == "json":
+                print(json.dumps({"status": "skipped", "reason": msg}))
+            else:
+                print(msg)
+            return 0
+        prev_path, cur_path = found[-2], found[-1]
+    try:
+        prev, cur = load(prev_path), load(cur_path)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: cannot load records: {e}", file=sys.stderr)
+        return 2
+
+    verdict = compare(prev, cur, args.threshold, args.explain_threshold)
+    verdict.update({
+        "prev_record": os.path.relpath(prev_path, REPO_ROOT),
+        "cur_record": os.path.relpath(cur_path, REPO_ROOT),
+        "threshold": args.threshold,
+        "status": "regression" if verdict["regressions"] else "ok",
+    })
+    if args.format == "json":
+        print(json.dumps(verdict, indent=1))
+    else:
+        print(f"bench compare: {verdict['prev_record']} -> "
+              f"{verdict['cur_record']} (threshold "
+              f"{args.threshold:.0%})")
+        for row in verdict["checks"]:
+            mark = "REGRESSED" if row["regressed"] else "ok"
+            prev_s = "-" if row["prev"] is None else f"{row['prev']:g}"
+            print(f"  {row['check']:<40} {prev_s:>10} -> "
+                  f"{row['cur']:g} ({row['delta_frac']:+.1%}) {mark}")
+        for w in verdict["warnings"]:
+            print(f"  warning: {w}")
+        print(f"verdict: {verdict['status']}")
+    return 1 if verdict["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,805 @@
+"""PR-4 schedulability-explainer suite (ISSUE 4 acceptance gates):
+
+- device-side reason aggregation (`obs/explain.explain_reduce`) matches
+  a pure-Python reference on a randomized (P, N) bitmask;
+- one-bit-away picks the provably best single relaxation;
+- `/debug/why` returns the breakdown for a driven unschedulable pod;
+- the explain path adds zero host syncs inside jitted code (graftlint
+  via `testing.lint_clean` on `obs/explain.py`);
+- the bench explain-overhead section runs and reports its delta;
+
+plus the satellite pins: queue-observability metrics (sub-queue age
+histograms, incoming-event counters, mutation-fresh pending_pods
+gauges), the pod-scheduling-attempts histogram, FailedScheduling
+sink-call aggregation, and the bench_compare regression detector.
+
+Deterministic: fake clocks everywhere timing matters; the randomized
+bitmask uses a fixed seed.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.obs.explain import (
+    N_REASONS,
+    build_report,
+    explain_reduce,
+)
+from kubernetes_tpu.ops.predicates import BIT, PREDICATE_BITS
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import lint_clean, make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# device reduction vs pure-Python reference
+# ---------------------------------------------------------------------------
+
+
+def _py_reference(reasons, node_valid, pod_mask):
+    """The obvious O(P*N*B) host loop explain_reduce must reproduce."""
+    P, N = reasons.shape
+    per_pod = np.zeros((P, N_REASONS), np.int64)
+    one_bit = np.zeros((P, N_REASONS), np.int64)
+    feasible = np.zeros(P, np.int64)
+    for p in range(P):
+        if not pod_mask[p]:
+            continue
+        for n in range(N):
+            if not node_valid[n]:
+                continue
+            r = int(reasons[p, n])
+            for b in range(N_REASONS):
+                if r >> b & 1:
+                    per_pod[p, b] += 1
+            if r == 0:
+                feasible[p] += 1
+            elif r & (r - 1) == 0:  # exactly one bit set
+                one_bit[p, int(np.log2(r))] += 1
+    return {
+        "per_pod": per_pod,
+        "one_bit": one_bit,
+        "feasible": feasible,
+        "pair_hist": per_pod.sum(axis=0),
+        "pods_blocked": (per_pod > 0).sum(axis=0),
+    }
+
+
+def test_explain_reduce_matches_python_reference_randomized():
+    rng = np.random.default_rng(42)
+    P, N = 17, 23
+    reasons = rng.integers(0, 1 << N_REASONS, (P, N)).astype(np.int32)
+    # sprinkle exact-one-bit and zero rows so every output has signal
+    for _ in range(30):
+        p, n = rng.integers(0, P), rng.integers(0, N)
+        reasons[p, n] = np.int32(1 << int(rng.integers(0, N_REASONS)))
+    for _ in range(10):
+        reasons[rng.integers(0, P), rng.integers(0, N)] = 0
+    node_valid = rng.random(N) > 0.25
+    pod_mask = rng.random(P) > 0.3
+    ref = _py_reference(reasons, node_valid, pod_mask)
+
+    ex = explain_reduce(jnp.asarray(reasons), jnp.asarray(node_valid),
+                        jnp.asarray(pod_mask))
+    assert (np.asarray(ex.per_pod) == ref["per_pod"]).all()
+    assert (np.asarray(ex.one_bit) == ref["one_bit"]).all()
+    assert (np.asarray(ex.feasible) == ref["feasible"]).all()
+    assert (np.asarray(ex.pair_hist) == ref["pair_hist"]).all()
+    assert (np.asarray(ex.pods_blocked) == ref["pods_blocked"]).all()
+    # best_bit/best_gain agree with the reference argmax (ties resolve to
+    # the lowest bit, numpy argmax semantics both sides)
+    assert (np.asarray(ex.best_gain) == ref["one_bit"].max(axis=1)).all()
+    assert (np.asarray(ex.best_bit) == ref["one_bit"].argmax(axis=1)).all()
+
+
+def test_one_bit_away_picks_provably_best_relaxation():
+    """Relaxing ONE predicate opens exactly the nodes whose failure set
+    is that single predicate; the explainer's best_bit must match the
+    brute-force best over all B candidate relaxations."""
+    P, N = 3, 8
+    taints = 1 << BIT["PodToleratesNodeTaints"]
+    res = 1 << BIT["PodFitsResources"]
+    sel = 1 << BIT["PodMatchNodeSelector"]
+    reasons = np.zeros((P, N), np.int32)
+    # pod 0: 5 nodes blocked ONLY by taints, 2 only by resources, 1 by
+    # both (no single relaxation opens it) -> best = taints, gain 5
+    reasons[0, :5] = taints
+    reasons[0, 5:7] = res
+    reasons[0, 7] = taints | res
+    # pod 1: every node blocked by two predicates -> no single
+    # relaxation opens anything
+    reasons[1, :] = taints | sel
+    # pod 2: selector everywhere -> best = selector, gain N
+    reasons[2, :] = sel
+
+    ex = explain_reduce(jnp.asarray(reasons),
+                        jnp.ones(N, bool), jnp.ones(P, bool))
+    one = np.asarray(ex.one_bit)
+    # brute force: for each pod, each candidate bit b opens the nodes
+    # whose mask clears to zero when b is removed
+    for p in range(P):
+        for b in range(N_REASONS):
+            opened = sum(
+                1 for n in range(N)
+                if reasons[p, n] and (reasons[p, n] & ~(1 << b)) == 0
+            )
+            assert one[p, b] == opened, (p, PREDICATE_BITS[b])
+    assert np.asarray(ex.best_bit)[0] == BIT["PodToleratesNodeTaints"]
+    assert np.asarray(ex.best_gain)[0] == 5
+    assert np.asarray(ex.best_gain)[1] == 0
+    assert np.asarray(ex.best_bit)[2] == BIT["PodMatchNodeSelector"]
+    assert np.asarray(ex.best_gain)[2] == N
+
+
+def test_build_report_decodes_and_ranks():
+    per_pod = np.zeros((2, N_REASONS), np.int64)
+    one_bit = np.zeros((2, N_REASONS), np.int64)
+    per_pod[0, BIT["PodFitsResources"]] = 4
+    per_pod[0, BIT["PodToleratesNodeTaints"]] = 2
+    one_bit[0, BIT["PodFitsResources"]] = 3
+    one_bit[0, BIT["PodToleratesNodeTaints"]] = 1
+    ex = {
+        "per_pod": per_pod, "one_bit": one_bit,
+        "feasible": np.array([1, 0]),
+        "pair_hist": per_pod.sum(axis=0),
+        "pods_blocked": (per_pod > 0).sum(axis=0),
+    }
+    rep = build_report(7, 5, ["default/a", "default/b"], [0], ex)
+    pe = rep.pods["default/a"]
+    assert pe.reason_node_counts == {"PodFitsResources": 4,
+                                     "PodToleratesNodeTaints": 2}
+    assert pe.relaxations[0] == ("PodFitsResources", 3)
+    assert pe.feasible_nodes == 1
+    assert rep.reason_pods == {"PodFitsResources": 1,
+                               "PodToleratesNodeTaints": 1}
+    assert rep.top_reasons(1) == [("PodFitsResources", 1)]
+    assert "default/b" not in rep.pods  # only analyzed rows decode
+
+
+# ---------------------------------------------------------------------------
+# zero host syncs inside jitted code (acceptance gate)
+# ---------------------------------------------------------------------------
+
+
+def test_explain_module_lints_clean():
+    import kubernetes_tpu.obs.explain as explain_mod
+
+    # jit_all=False: the module mixes the jitted reduction with the
+    # deliberate host-side report decoding; lint walks the REAL jit
+    # roots (@jax.jit explain_reduce), so a host sync sneaking into the
+    # traced path fails tier-1 here
+    lint_clean(explain_mod, jit_all=False)
+
+
+# ---------------------------------------------------------------------------
+# driven scheduler: report, /debug/why, recorder, metrics, gating
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def driven():
+    """One unschedulable + one schedulable pod over three small nodes,
+    driven two cycles so attempts accumulate. Module-scoped: the XLA
+    compile dominates and every assertion reads the same run."""
+    clk = FakeClock()
+    s = Scheduler(clock=clk, enable_preemption=False)
+    for i in range(3):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=1000))
+    s.on_pod_add(make_pod("big", cpu_milli=64000))  # fits nowhere
+    s.on_pod_add(make_pod("ok", cpu_milli=100))
+    r1 = s.schedule_cycle()
+    clk.advance(120.0)  # past the 60s unschedulable flush
+    s.queue.tick()
+    r2 = s.schedule_cycle()
+    return s, clk, (r1, r2)
+
+
+def test_cycle_report_for_driven_unschedulable_pod(driven):
+    s, _clk, (r1, r2) = driven
+    assert r1.scheduled == 1 and r1.unschedulable == 1
+    rep = r2.explain
+    assert rep is not None
+    pe = rep.pods["default/big"]
+    # all three nodes excluded by resources, and relaxing resources
+    # alone would open all three
+    assert pe.reason_node_counts == {"PodFitsResources": 3}
+    assert pe.relaxations == [("PodFitsResources", 3)]
+    assert pe.feasible_nodes == 0
+    assert pe.attempts == 2  # failed in both driven cycles
+    assert pe.queue_residency_s > 100.0
+    assert pe.message.startswith("0/3 nodes are available")
+    assert rep.reason_pods == {"PodFitsResources": 1}
+    assert rep.reason_node_counts == {"PodFitsResources": 3}
+
+
+def test_flight_recorder_carries_top_reasons(driven):
+    s, _, _ = driven
+    recs = s.obs.recorder.records()
+    assert recs and recs[-1].top_reasons == [("PodFitsResources", 1)]
+    assert "PodFitsResources" in s.obs.recorder.dump()
+    assert recs[-1].to_json()["top_reasons"] == [["PodFitsResources", 1]]
+
+
+def test_unschedulable_metrics(driven):
+    s, _, _ = driven
+    m = s.metrics
+    # one blocked pod per driven cycle
+    assert m.unschedulable_pods.value(reason="PodFitsResources") == 2
+    # gauge shows the LAST cycle's (pod, node) exclusion pairs
+    assert m.unschedulable_node_counts.value(
+        reason="PodFitsResources") == 3
+
+
+def test_debug_why_endpoint(driven):
+    from kubernetes_tpu.server import serve_scheduler
+
+    s, _, _ = driven
+    srv = serve_scheduler(s, port=0)
+    host, port = srv.server_address[:2]
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}{path}", timeout=10) as r:
+                return r.status, json.loads(r.read().decode())
+
+        # per-pod: full breakdown, attempts, residency, relaxations
+        code, doc = get("/debug/why?pod=default/big")
+        assert code == 200
+        assert doc["reason_node_counts"] == {"PodFitsResources": 3}
+        assert doc["relaxations"] == [
+            {"reason": "PodFitsResources", "nodes_opened": 3}]
+        assert doc["attempts"] == 2
+        assert doc["queue_residency_s"] > 100.0
+        # bare name resolves through the default namespace
+        code, doc2 = get("/debug/why?pod=big")
+        assert code == 200 and doc2["pod"] == "default/big"
+        # cluster summary without an argument
+        code, summary = get("/debug/why")
+        assert code == 200
+        assert summary["unschedulable"] == 1
+        assert summary["reason_pods"] == {"PodFitsResources": 1}
+        assert "PodFitsResources" in summary["summary"]
+        assert summary["pending_known"] == ["default/big"]
+        # unknown pod -> 404 with the known keys
+        try:
+            get("/debug/why?pod=nope")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert "default/big" in json.loads(e.read().decode())["known"]
+    finally:
+        srv.shutdown()
+
+
+def test_why_state_clears_when_pod_schedules():
+    clk = FakeClock()
+    s = Scheduler(clock=clk, enable_preemption=False)
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    s.on_pod_add(make_pod("p", cpu_milli=4000))  # too big for now
+    s.schedule_cycle()
+    assert "default/p" in s.why_pending
+    s.on_node_add(make_node("n1", cpu_milli=8000))  # room appears
+    clk.advance(2.0)  # clear the 1s failure backoff
+    r = s.schedule_cycle()
+    assert r.scheduled == 1
+    assert "default/p" not in s.why_pending
+    # the successful schedule observed its attempt count (1 failure + 1)
+    assert s.metrics.pod_scheduling_attempts.count() == 1
+    assert s.metrics.pod_scheduling_attempts.quantile(0.5) <= 2.0
+
+
+def test_explain_gate_off_skips_analytics():
+    from kubernetes_tpu.config import ObservabilityConfig
+
+    s = Scheduler(enable_preemption=False,
+                  observability=ObservabilityConfig(explain=False))
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    s.on_pod_add(make_pod("big", cpu_milli=64000))
+    r = s.schedule_cycle()
+    assert r.unschedulable == 1
+    assert r.explain is None
+    assert s.why_pending == {} and s.last_explain is None
+    # the FitError event text survives the gate (explain is analytics
+    # ON TOP of the reporting path, not a replacement)
+    assert r.fit_errors["default/big"].startswith("0/1 nodes")
+    # flight record carries no reasons
+    assert s.obs.recorder.records()[-1].top_reasons == []
+
+
+def test_v1alpha1_observability_block_round_trips_explain():
+    from kubernetes_tpu.api.config_v1alpha1 import (
+        GROUP_VERSION,
+        KIND,
+        SCHEME,
+    )
+    from kubernetes_tpu.config import KubeSchedulerConfiguration
+
+    doc = {
+        "apiVersion": GROUP_VERSION,
+        "kind": KIND,
+        "observability": {"explain": False, "explainTopK": 5},
+    }
+    cfg = SCHEME.decode(doc, KubeSchedulerConfiguration)
+    assert cfg.observability.explain is False
+    assert cfg.observability.explain_top_k == 5
+    back = SCHEME.encode(cfg, GROUP_VERSION, KIND)
+    assert back["observability"]["explain"] is False
+    assert back["observability"]["explainTopK"] == 5
+    # defaulting: an empty block lands on (True, 3)
+    cfg2 = SCHEME.decode(
+        {"apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
+         "kind": "KubeSchedulerConfiguration"},
+        KubeSchedulerConfiguration)
+    assert cfg2.observability.explain is True
+    assert cfg2.observability.explain_top_k == 3
+
+
+def test_validate_config_rejects_bad_explain_top_k():
+    from kubernetes_tpu.cli import validate_config
+    from kubernetes_tpu.config import (
+        KubeSchedulerConfiguration,
+        ObservabilityConfig,
+    )
+
+    cfg = KubeSchedulerConfiguration(
+        observability=ObservabilityConfig(explain_top_k=0))
+    errs = validate_config(cfg)
+    assert any("explainTopK" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# kubectl describe enrichment (client-side recompute from wire reasons)
+# ---------------------------------------------------------------------------
+
+
+def test_kubectl_pending_breakdown_lines():
+    from kubernetes_tpu.kubectl import _pending_breakdown
+
+    failed = {
+        "n0": "PodFitsResources",
+        "n1": "PodFitsResources",
+        "n2": "PodToleratesNodeTaints",
+        "n3": "PodFitsResources,PodToleratesNodeTaints",
+    }
+    lines = _pending_breakdown(failed, 4, feasible=0)
+    assert lines[0].startswith("Status: 0/4 nodes are available: ")
+    assert "3 Insufficient" not in lines[0]  # counts are per-NODE here
+    assert "3 " in lines[0] and "2 " in lines[0]
+    joined = "\n".join(lines)
+    assert "One-bit-away" in joined
+    # 2 nodes open by relaxing resources alone, 1 by tolerating taints
+    assert "relax PodFitsResources: +2 node(s)" in joined
+    assert "relax PodToleratesNodeTaints: +1 node(s)" in joined
+    # a feasible node suppresses the 0/N headline (pod is schedulable)
+    assert _pending_breakdown(failed, 5, feasible=1) == []
+
+
+# ---------------------------------------------------------------------------
+# queue observability satellites
+# ---------------------------------------------------------------------------
+
+
+def _queue(clk):
+    from kubernetes_tpu.metrics import SchedulerMetrics
+    from kubernetes_tpu.queue import SchedulingQueue
+
+    m = SchedulerMetrics()
+    return SchedulingQueue(clock=clk, metrics=m), m
+
+
+def _gauge_matches(q, m):
+    return all(
+        m.pending_pods.value(queue=name) == depth
+        for name, depth in q.pending_counts().items()
+    )
+
+
+def test_pending_pods_gauge_fresh_after_every_mutation():
+    """The satellite pin: scheduler_pending_pods{queue} must be correct
+    BETWEEN cycles — after move_all_to_active, backoff flushes, and
+    add_unschedulable_if_not_present — not just at cycle boundaries."""
+    clk = FakeClock()
+    q, m = _queue(clk)
+    for i in range(4):
+        q.add(make_pod(f"p{i}"))
+    assert _gauge_matches(q, m) and m.pending_pods.value(queue="active") == 4
+
+    popped = q.pop_batch()
+    assert len(popped) == 4
+    assert m.pending_pods.value(queue="active") == 0
+
+    # two failures: one goes to unschedulableQ, then a move request makes
+    # the next one land in backoff
+    q.record_failure(popped[0])
+    q.add_unschedulable_if_not_present(popped[0], q.scheduling_cycle)
+    assert m.pending_pods.value(queue="unschedulable") == 1
+    q.move_all_to_active()  # pod still backing off -> backoffQ
+    assert m.pending_pods.value(queue="unschedulable") == 0
+    assert m.pending_pods.value(queue="backoff") == 1
+    assert _gauge_matches(q, m)
+
+    # backoff flush moves it back to active — gauge follows immediately
+    clk.advance(30.0)
+    q.flush_backoff_completed()
+    assert m.pending_pods.value(queue="backoff") == 0
+    assert m.pending_pods.value(queue="active") == 1
+    assert _gauge_matches(q, m)
+
+    q.delete(popped[0].key())
+    assert m.pending_pods.value(queue="active") == 0
+    assert _gauge_matches(q, m)
+
+    # the 60s leftover flush path: the pod's cycle must POSTDATE the
+    # move request stamped by move_all_to_active above, or the queue
+    # (correctly) routes it to backoff instead
+    q.record_failure(popped[1])
+    q.add_unschedulable_if_not_present(popped[1], q.scheduling_cycle + 1)
+    clk.advance(120.0)
+    q.flush_unschedulable_leftover()
+    assert m.pending_pods.value(queue="unschedulable") == 0
+    assert m.pending_pods.value(queue="active") == 1
+    assert _gauge_matches(q, m)
+
+
+def test_queue_incoming_events_and_age_histograms():
+    clk = FakeClock()
+    q, m = _queue(clk)
+    q.add(make_pod("a"))
+    assert m.queue_incoming_pods.value(event="PodAdd") == 1
+    clk.advance(5.0)
+    (pod,) = q.pop_batch()
+    # active residency observed at pop: 5s into the active histogram
+    assert m.queue_pod_age.count(queue="active") == 1
+    assert m.queue_pod_age.quantile(0.5, queue="active") <= 8.0
+    q.record_failure(pod)
+    q.add_unschedulable_if_not_present(pod, q.scheduling_cycle)
+    assert m.queue_incoming_pods.value(event="ScheduleAttemptFailure") == 1
+    clk.advance(70.0)
+    q.flush_unschedulable_leftover()
+    assert m.queue_incoming_pods.value(event="UnschedulableTimeout") == 1
+    # unschedulable residency (70s) observed when it left the sub-queue
+    assert m.queue_pod_age.count(queue="unschedulable") == 1
+    q.update(pod.key(), make_pod("a"))
+    assert m.queue_incoming_pods.value(event="PodUpdate") == 1
+
+
+def test_scheduler_attaches_metrics_to_external_queue():
+    from kubernetes_tpu.queue import SchedulingQueue
+
+    clk = FakeClock()
+    q = SchedulingQueue(clock=clk)
+    s = Scheduler(clock=clk, queue=q, enable_preemption=False)
+    assert q.metrics is s.metrics
+    q.add(make_pod("x"))
+    assert s.metrics.pending_pods.value(queue="active") == 1
+
+
+# ---------------------------------------------------------------------------
+# events satellite: duplicate FailedScheduling sink aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_failed_scheduling_sink_calls_aggregate():
+    """50 identical failures = ONE aggregated event with count 50 but
+    only log-many sink posts (kube correlator semantics) — previously
+    every failed cycle posted a duplicate to every sink."""
+    from kubernetes_tpu.events import EventRecorder
+
+    clk = FakeClock()
+    posts = []
+    rec = EventRecorder(clock=clk, sinks=[posts.append])
+    pod = make_pod("stuck")
+    for _ in range(50):
+        clk.advance(1.0)
+        ev = rec.event("FailedScheduling", pod, "0/3 nodes are available")
+    assert ev.count == 50
+    evs = rec.events("default/stuck")
+    assert len(evs) == 1 and evs[0].count == 50
+    # sink posts at counts 1, 2, 4, 8, 16, 32 — six, not fifty
+    assert len(posts) == 6
+    # the sink hands out the LIVE object, so the stored copy reads the
+    # real count even between notifications (the hub-store behavior)
+    assert posts[-1] is evs[0] and posts[-1].count == 50
+
+
+def test_quiet_series_renotifies_after_refresh_window():
+    from kubernetes_tpu.events import EventRecorder
+
+    clk = FakeClock()
+    posts = []
+    rec = EventRecorder(clock=clk, sinks=[posts.append],
+                        sink_refresh_s=300.0)
+    pod = make_pod("drip")
+    rec.event("FailedScheduling", pod, "m")   # count 1 -> notify
+    rec.event("FailedScheduling", pod, "m")   # count 2 -> milestone
+    rec.event("FailedScheduling", pod, "m")   # count 3 -> suppressed
+    assert len(posts) == 2
+    clk.advance(301.0)
+    rec.event("FailedScheduling", pod, "m")   # stale -> refresh notify
+    assert len(posts) == 3
+    # distinct messages are distinct series: no cross-suppression
+    rec.event("FailedScheduling", pod, "other")
+    assert len(posts) == 4
+
+
+# ---------------------------------------------------------------------------
+# bench: explain-overhead section + regression detector
+# ---------------------------------------------------------------------------
+
+
+def test_bench_explain_overhead_section_runs():
+    """The bench section end-to-end at test scale: a contended workload
+    (pods >> capacity) where the explain pass fires on every batch. At
+    bench scale the recorded overhead stays under the 3% budget; at this
+    tiny scale per-dispatch noise dominates, so the pin here is the
+    mechanism — the section runs, the breakdown is exact, and the
+    overhead is a sane fraction."""
+    import bench
+
+    # 2 nodes x 4000m / 100m-per-pod = 80 slots for 200 pods: the last
+    # batches leave pods unplaced, so the explain pass really runs
+    ov = bench.measure_explain_overhead(2, 200, batch=64)
+    assert set(ov) >= {"explain_off", "explain_on", "overhead_frac"}
+    on = ov["explain_on"]
+    assert 0 < on["placed"] < on["pods"]
+    bd = on["unschedulable_breakdown"]
+    assert bd, "failed pods must produce a breakdown"
+    # every unplaced pod is blocked by at least one predicate (here:
+    # resources), and blocked-pod totals can only exceed the residual
+    # via multi-reason pods
+    assert sum(v["pods"] for v in bd.values()) >= on["pods"] - on["placed"]
+    assert bd["PodFitsResources"]["pods"] == on["pods"] - on["placed"]
+    assert np.isfinite(ov["overhead_frac"])
+
+
+def test_bench_explain_breakdown_matches_contended_workload():
+    """Exactness at a shape where the outcome is known: 2 one-slot nodes
+    (pods cap 1), 5 pending pods -> 2 place, 3 blocked by the pod-count
+    cap (PodFitsResources)."""
+    import bench
+
+    nodes = [make_node(f"n{i}", cpu_milli=32000, pods=1) for i in range(2)]
+    pods = [make_pod(f"p{i}", cpu_milli=10) for i in range(5)]
+    w = bench.Workload(nodes, [], pods)
+    r = bench.run_batched(w, batch=8, cap=8, explain=True)
+    assert r["placed"] == 2
+    bd = r["unschedulable_breakdown"]
+    assert bd["PodFitsResources"]["pods"] == 3
+    assert bd["PodFitsResources"]["node_exclusions"] == 6  # 3 pods x 2 nodes
+
+
+def test_bench_compare_detects_regressions(tmp_path):
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "bench_compare.py"))
+    bc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bc)
+
+    def record(pps, p99, variants=None, explain_frac=None):
+        extras = {"headline": {"pods_per_sec": pps,
+                               "latency_s": {"p99": p99}}}
+        if variants:
+            extras["variants"] = variants
+        if explain_frac is not None:
+            extras["explain_overhead"] = {"overhead_frac": explain_frac}
+        return {"value": pps, "extras": extras, "errors": []}
+
+    prev = record(1000.0, 2.0, {"gang/1000x1000": {"pods_per_sec": 500.0}})
+    # healthy: small wobble under the threshold
+    v = bc.compare(prev, record(
+        980.0, 2.05, {"gang/1000x1000": {"pods_per_sec": 510.0}},
+        explain_frac=0.01), 0.10, 0.03)
+    assert v["regressions"] == []
+    # throughput regression
+    v = bc.compare(prev, record(800.0, 2.0), 0.10, 0.03)
+    assert any(r["check"] == "headline.pods_per_sec"
+               for r in v["regressions"])
+    # latency regression (lower is better)
+    v = bc.compare(prev, record(1000.0, 3.0), 0.10, 0.03)
+    assert any(r["check"] == "headline.p99_latency_s"
+               for r in v["regressions"])
+    # per-variant regression
+    v = bc.compare(prev, record(
+        1000.0, 2.0, {"gang/1000x1000": {"pods_per_sec": 100.0}}),
+        0.10, 0.03)
+    assert any(r["check"].startswith("variant.gang")
+               for r in v["regressions"])
+    # explain budget is absolute on the new record
+    v = bc.compare(prev, record(1000.0, 2.0, explain_frac=0.08), 0.10, 0.03)
+    assert any(r["check"] == "explain_overhead.overhead_frac"
+               for r in v["regressions"])
+
+    # CLI contract: two records on disk, JSON verdict, exit codes
+    p1, p2 = tmp_path / "bench_r01.json", tmp_path / "bench_r02.json"
+    p1.write_text(json.dumps(prev))
+    p2.write_text(json.dumps(record(800.0, 2.0)))
+    assert bc.main(["--dir", str(tmp_path), "--format", "json"]) == 1
+    p2.write_text(json.dumps(record(990.0, 2.0)))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    # a lone record is a skip, not a failure
+    p2.unlink()
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# review-hardening pins: stale-state retirement, early-return rows,
+# in-place-update age integrity, single-readback pytree boundary
+# ---------------------------------------------------------------------------
+
+
+def test_explain_state_retires_after_analyzed_pods_leave():
+    """Gauges and the /debug/why cluster summary must not keep reporting
+    pods that were deleted: the next idle cycle retires the report and
+    zeroes the per-reason gauges."""
+    clk = FakeClock()
+    s = Scheduler(clock=clk, enable_preemption=False)
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    s.on_pod_add(make_pod("big", cpu_milli=64000))
+    s.schedule_cycle()
+    assert s.metrics.unschedulable_node_counts.value(
+        reason="PodFitsResources") == 1
+    assert s.last_explain.pods
+
+    s.on_pod_delete(make_pod("big", cpu_milli=64000))
+    assert "default/big" not in s.why_pending
+    r = s.schedule_cycle()  # idle: pops nothing
+    assert r.attempted == 0
+    assert s.metrics.unschedulable_node_counts.value(
+        reason="PodFitsResources") == 0
+    assert s.last_explain.pods == {}
+    assert s.last_explain.reason_node_counts == {}
+    # pods parked in backoff must NOT be retired by idle polls
+    s.on_pod_add(make_pod("big2", cpu_milli=64000))
+    s.schedule_cycle()
+    assert s.last_explain.pods
+    s.schedule_cycle()  # big2 is backing off -> idle pop
+    assert "default/big2" in s.why_pending
+    assert s.last_explain.pods  # analysis survives the idle cycle
+
+
+def test_prefilter_only_cycle_still_produces_rows():
+    """A cycle where EVERY popped pod fails PreFilter returns early —
+    those pods must still get PodExplanation rows (status reasons, no
+    device analytics) and stale reason gauges must roll to zero."""
+    from kubernetes_tpu.framework import Framework, Plugin, Status
+    from kubernetes_tpu.framework import UNSCHEDULABLE
+
+    class RejectAll(Plugin):
+        def pre_filter(self, state, pod):
+            return Status(UNSCHEDULABLE, "quota")
+
+    clk = FakeClock()
+    s = Scheduler(framework=Framework(plugins=[RejectAll()], clock=clk),
+                  clock=clk, enable_preemption=False)
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    s.on_pod_add(make_pod("p", cpu_milli=100))
+    r = s.schedule_cycle()
+    assert r.unschedulable == 1 and r.explain is not None
+    pe = s.why_pending["default/p"]
+    assert pe.reason_node_counts == {}  # never reached the device
+    assert any("PreFilter" in x for x in pe.reasons)
+    assert s.last_explain.cycle == r.explain.cycle
+
+
+def test_inplace_update_keeps_subqueue_age_stamp():
+    """An in-place update of a pod already in activeQ must not emit a
+    spurious 'exit' age sample nor reset the residency stamp — the pod
+    never left the sub-queue."""
+    clk = FakeClock()
+    q, m = _queue(clk)
+    q.add(make_pod("a"))
+    for _ in range(6):  # a pending pod updated every 10s for a minute
+        clk.advance(10.0)
+        q.update("default/a", make_pod("a"))
+    assert m.queue_pod_age.count(queue="active") == 0
+    clk.advance(40.0)
+    q.pop_batch()
+    # the single exit sample carries the FULL 100s residency (the sum,
+    # not quantile — 100s overflows the largest finite bucket)
+    assert m.queue_pod_age.count(queue="active") == 1
+    assert m.queue_pod_age._sum[("active",)] == pytest.approx(100.0)
+
+
+def test_readback_pytree_is_one_accounted_transfer():
+    """The explain readback fetches the whole ExplainResult in ONE
+    declared d2h boundary: structure preserved, bytes summed, a single
+    transfer accounting entry (not one per field)."""
+    from kubernetes_tpu.obs.explain import ExplainResult
+    from kubernetes_tpu.obs.jaxtel import JaxTelemetry
+
+    tel = JaxTelemetry()
+    ex = explain_reduce(
+        jnp.zeros((4, 5), jnp.int32), jnp.ones((5,), bool),
+        jnp.ones((4,), bool))
+    host = tel.readback("explain", ex)
+    assert isinstance(host, ExplainResult)
+    assert all(isinstance(v, np.ndarray) for v in host._asdict().values())
+    entry = tel.snapshot()["transfers"]["explain:d2h"]
+    assert entry["count"] == 1
+    assert entry["bytes"] == sum(np.asarray(v).nbytes for v in host)
+
+
+def test_kubectl_breakdown_ignores_wire_sentinels():
+    """The filter verb emits 'infeasible' / 'node not in snapshot' when a
+    node carries no reason bits — they belong in the 0/N line but must
+    never surface as one-bit-away relaxation advice."""
+    from kubernetes_tpu.kubectl import _pending_breakdown
+
+    lines = _pending_breakdown(
+        {"n0": "infeasible", "n1": "node not in snapshot",
+         "n2": "PodFitsResources"}, 3, feasible=0)
+    joined = "\n".join(lines)
+    assert "relax infeasible" not in joined
+    assert "relax node not in snapshot" not in joined
+    assert "relax PodFitsResources: +1 node(s)" in joined
+    assert "1 infeasible" in lines[0]  # still counted in the 0/N line
+
+
+def test_queue_age_buckets_resolve_minute_scale_residency():
+    """scheduler_queue_pod_age_seconds must resolve minutes, not clip at
+    the 16s latency layout: a 70s unschedulable residency lands in a
+    finite bucket and the quantile reads back above the old ceiling."""
+    clk = FakeClock()
+    q, m = _queue(clk)
+    q.add(make_pod("a"))
+    (pod,) = q.pop_batch()
+    q.record_failure(pod)
+    q.add_unschedulable_if_not_present(pod, q.scheduling_cycle)
+    clk.advance(70.0)
+    q.flush_unschedulable_leftover()
+    est = m.queue_pod_age.quantile(0.5, queue="unschedulable")
+    assert 16.5 < est <= 82.0  # inside the 40.96..81.92 bucket
+
+
+def test_debug_why_summary_caps_pending_listing(driven):
+    from kubernetes_tpu.server import why_payload
+
+    s, _, _ = driven
+    saved = dict(s.why_pending)
+    try:
+        for i in range(120):
+            s.why_pending[f"ns/p{i}"] = saved["default/big"]
+        code, doc = why_payload(s, "/debug/why")
+        assert code == 200
+        assert doc["pending_total"] == len(s.why_pending)
+        assert len(doc["pending_known"]) == 50
+    finally:
+        s.why_pending.clear()
+        s.why_pending.update(saved)
+
+
+def test_relist_readd_keeps_residency_and_counts_podadd_once():
+    """An informer relist re-adds every queued pod via add(): that must
+    not emit a departure age sample, reset the residency stamp, or bump
+    PodAdd again — one pod queued at t=0, relisted at t=100, popped at
+    t=160 is ONE 160s active residency and ONE PodAdd."""
+    clk = FakeClock()
+    q, m = _queue(clk)
+    q.add(make_pod("a"))
+    clk.advance(100.0)
+    q.add(make_pod("a"))  # relist
+    clk.advance(60.0)
+    q.pop_batch()
+    assert m.queue_incoming_pods.value(event="PodAdd") == 1
+    assert m.queue_pod_age.count(queue="active") == 1
+    assert m.queue_pod_age._sum[("active",)] == pytest.approx(160.0)

@@ -1,0 +1,199 @@
+"""Prometheus text-exposition conformance (PR-4 satellite): the full
+``/metrics`` document must satisfy the text-format line grammar so a
+real Prometheus scraper never chokes on drift in ``metrics.py``:
+
+- every sample's family declares ``# HELP`` and ``# TYPE`` BEFORE its
+  first sample line;
+- sample lines match ``name{labels} value`` with float-parseable
+  values and properly escaped label values;
+- histograms: bucket counts are cumulative-monotone in ``le``, the
+  ``+Inf`` bucket exists and equals ``_count``, and ``_sum``/``_count``
+  are present per label set;
+- label values with quotes/backslashes/newlines are escaped (the
+  solver-rejection ``reason`` and extender-name labels carry free
+  text).
+
+The parser below is written from the exposition-format spec, not from
+metrics.py internals — it is the drift detector.
+"""
+
+import re
+
+import pytest
+
+from kubernetes_tpu import metrics as m
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_SAMPLE_RE = re.compile(rf"^({_NAME})(?:\{{(.*)\}})? (\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\.)*)"')
+
+
+def _family_of(name: str, types: dict) -> str:
+    """Map a sample name to its declared family: histogram/summary
+    samples append _bucket/_sum/_count to the family name."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix) and name[: -len(suffix)] in types:
+            base = name[: -len(suffix)]
+            if types[base] in ("histogram", "summary"):
+                return base
+    return name
+
+
+def _parse_labels(raw: str):
+    """Strict label-body parse: the concatenation of matched
+    ``name="value"`` pairs joined by commas must reproduce the input —
+    anything unparsed (an unescaped quote, a bare newline) fails."""
+    if raw is None or raw == "":
+        return {}
+    pairs = []
+    rebuilt = []
+    for match in _LABEL_RE.finditer(raw):
+        pairs.append((match.group(1), match.group(2)))
+        rebuilt.append(match.group(0))
+    assert ",".join(rebuilt) == raw, f"unparseable label body: {raw!r}"
+    return dict(pairs)
+
+
+def parse_exposition(text: str):
+    """Returns (types, samples) where samples are
+    (family, name, labels-dict, value) in document order; asserts the
+    HELP/TYPE-before-samples ordering on the way."""
+    types, helps, samples = {}, {}, []
+    seen_sample_of = set()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            assert len(parts) >= 3, f"line {lineno}: malformed HELP"
+            helps[parts[2]] = parts[3] if len(parts) > 3 else ""
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4, f"line {lineno}: malformed TYPE"
+            _, _, fam, kind = parts
+            assert kind in ("counter", "gauge", "histogram", "summary",
+                            "untyped"), f"line {lineno}: bad type {kind}"
+            assert fam not in seen_sample_of, (
+                f"line {lineno}: TYPE for {fam} after its samples")
+            types[fam] = kind
+            continue
+        assert not line.startswith("#"), f"line {lineno}: stray comment"
+        match = _SAMPLE_RE.match(line)
+        assert match, f"line {lineno}: unparseable sample: {line!r}"
+        name, raw_labels, raw_value = match.groups()
+        labels = _parse_labels(raw_labels)
+        value = float(raw_value)  # raises on garbage
+        assert value == value, f"line {lineno}: NaN sample value"
+        fam = _family_of(name, types)
+        assert fam in types, f"line {lineno}: sample {name} has no TYPE"
+        assert fam in helps, f"line {lineno}: sample {name} has no HELP"
+        seen_sample_of.add(fam)
+        samples.append((fam, name, labels, value))
+    return types, samples
+
+
+def check_histograms(types: dict, samples) -> int:
+    """The histogram invariants, per family and label set (le aside)."""
+    from collections import defaultdict
+
+    grouped = defaultdict(dict)  # (fam, labelkey) -> {"buckets": [...]}
+    for fam, name, labels, value in samples:
+        if types.get(fam) != "histogram":
+            continue
+        lk = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+        slot = grouped.setdefault((fam, lk), {"buckets": []})
+        if name.endswith("_bucket"):
+            slot["buckets"].append((labels.get("le"), value))
+        elif name.endswith("_sum"):
+            slot["sum"] = value
+        elif name.endswith("_count"):
+            slot["count"] = value
+    assert grouped, "no histogram families exposed"
+    for (fam, lk), slot in grouped.items():
+        where = f"{fam}{dict(lk)}"
+        assert "sum" in slot and "count" in slot, f"{where}: no _sum/_count"
+        les = [le for le, _ in slot["buckets"]]
+        assert les and les[-1] == "+Inf", f"{where}: missing +Inf bucket"
+        finite = [float(le) for le in les[:-1]]
+        assert finite == sorted(finite), f"{where}: le values unsorted"
+        counts = [v for _, v in slot["buckets"]]
+        assert counts == sorted(counts), (
+            f"{where}: bucket counts not cumulative-monotone: {counts}")
+        assert counts[-1] == slot["count"], (
+            f"{where}: +Inf bucket {counts[-1]} != _count {slot['count']}")
+    return len(grouped)
+
+
+@pytest.fixture(scope="module")
+def scraped():
+    """A real scheduler driven through success + failure so counters,
+    gauges, histograms, and labeled families all carry samples — then
+    one free-text label injected to exercise escaping."""
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import make_node, make_pod
+
+    s = Scheduler(enable_preemption=False)
+    s.on_node_add(make_node("n0", cpu_milli=2000))
+    s.on_pod_add(make_pod("fits", cpu_milli=100))
+    s.on_pod_add(make_pod("huge", cpu_milli=64000))
+    s.schedule_cycle()
+    # free-text labels from the wild: rejection reasons and extender
+    # names are arbitrary strings and MUST escape
+    s.metrics.solver_rejections.inc(
+        tier="batch", reason='cap "exceeded"\nsee\\log')
+    return s.metrics, s.metrics.registry.expose()
+
+
+def test_exposition_grammar_and_ordering(scraped):
+    _metrics, text = scraped
+    types, samples = parse_exposition(text)
+    assert samples, "empty exposition"
+    # the PR-4 families are present and sampled
+    fams = {f for f, _, _, _ in samples}
+    for needed in ("scheduler_pending_pods",
+                   "scheduler_unschedulable_pods_total",
+                   "scheduler_unschedulable_node_counts",
+                   "scheduler_queue_pod_age_seconds",
+                   "scheduler_queue_incoming_pods_total",
+                   "scheduler_e2e_scheduling_duration_seconds"):
+        assert needed in fams, f"{needed} missing from /metrics"
+    assert types["scheduler_pending_pods"] == "gauge"
+    assert types["scheduler_unschedulable_pods_total"] == "counter"
+    assert types["scheduler_queue_pod_age_seconds"] == "histogram"
+
+
+def test_histogram_invariants(scraped):
+    _metrics, text = scraped
+    types, samples = parse_exposition(text)
+    n = check_histograms(types, samples)
+    assert n >= 3  # e2e duration, queue age (per queue), attempts, ...
+
+
+def test_label_escaping_round_trips(scraped):
+    _metrics, text = scraped
+    types, samples = parse_exposition(text)
+    rejections = [
+        (labels, v) for fam, name, labels, v in samples
+        if fam == "scheduler_solver_result_rejections_total"
+    ]
+    assert rejections, "injected free-text sample missing"
+    labels, value = rejections[0]
+    # the parser unescapes what expose() escaped — the raw specials
+    # round-trip through the wire format
+    raw = labels["reason"].replace("\\n", "\n").replace('\\"', '"') \
+                          .replace("\\\\", "\\")
+    assert raw == 'cap "exceeded"\nsee\\log'
+    assert value == 1.0
+    # and the document itself never carries a bare newline mid-sample
+    for line in text.splitlines():
+        assert line.count('"') % 2 == 0 or "\\\"" in line
+
+
+def test_summary_exposes_quantiles(scraped):
+    _metrics, text = scraped
+    types, samples = parse_exposition(text)
+    q = [labels.get("quantile") for fam, name, labels, _ in samples
+         if fam == "scheduler_scheduling_duration_seconds"
+         and not name.endswith(("_sum", "_count"))]
+    assert {"0.5", "0.9", "0.99"} <= set(q)
