@@ -146,6 +146,17 @@ class ObservabilityConfigurationV1alpha1:
 
 
 @dataclass
+class WarmupConfigurationV1alpha1:
+    """Versioned spelling of the AOT-warmup block (config.WarmupConfig):
+    camelCase keys, explicit bucket list."""
+
+    enabled: Optional[bool] = None
+    podBuckets: Optional[list] = None
+    minBucket: Optional[int] = None
+    includeFilter: Optional[bool] = None
+
+
+@dataclass
 class KubeSchedulerConfigurationV1alpha1:
     schedulerName: Optional[str] = None
     algorithmSource: "SchedulerAlgorithmSource" = field(
@@ -168,6 +179,13 @@ class KubeSchedulerConfigurationV1alpha1:
     perNodeCap: Optional[int] = None
     maxRounds: Optional[int] = None
     maxBatch: Optional[int] = None
+    # pipelined cycle executor + incremental device-resident snapshot
+    pipelineDepth: Optional[int] = None
+    pipelineChunk: Optional[int] = None
+    deviceResidentSnapshot: Optional[bool] = None
+    snapshotMaxDirtyFrac: Optional[float] = None
+    warmup: "WarmupConfigurationV1alpha1" = field(
+        default_factory=WarmupConfigurationV1alpha1)
     robustness: "RobustnessConfigurationV1alpha1" = field(
         default_factory=RobustnessConfigurationV1alpha1)
     observability: "ObservabilityConfigurationV1alpha1" = field(
@@ -212,6 +230,23 @@ def set_defaults_kube_scheduler_configuration(
         obj.maxRounds = 128
     if obj.maxBatch is None:
         obj.maxBatch = 8192
+    if obj.pipelineDepth is None:
+        obj.pipelineDepth = 2
+    if obj.pipelineChunk is None:
+        obj.pipelineChunk = 4096
+    if obj.deviceResidentSnapshot is None:
+        obj.deviceResidentSnapshot = True
+    if obj.snapshotMaxDirtyFrac is None:
+        obj.snapshotMaxDirtyFrac = 0.25
+    wu = obj.warmup
+    if wu.enabled is None:
+        wu.enabled = False
+    if wu.podBuckets is None:
+        wu.podBuckets = []
+    if wu.minBucket is None:
+        wu.minBucket = 256
+    if wu.includeFilter is None:
+        wu.includeFilter = True
     rb = obj.robustness
     if rb.cycleDeadline is None:
         rb.cycleDeadline = "0s"  # 0 = unbounded (the internal default)
@@ -357,8 +392,32 @@ def _to_internal(v: KubeSchedulerConfigurationV1alpha1) -> KubeSchedulerConfigur
         per_node_cap=v.perNodeCap,
         max_rounds=v.maxRounds,
         max_batch=v.maxBatch,
+        pipeline_depth=v.pipelineDepth,
+        pipeline_chunk=v.pipelineChunk,
+        device_resident_snapshot=v.deviceResidentSnapshot,
+        snapshot_max_dirty_frac=v.snapshotMaxDirtyFrac,
+        warmup=_warmup_to_internal(v.warmup),
         robustness=_robustness_to_internal(v.robustness),
         observability=_observability_to_internal(v.observability),
+    )
+
+
+def _warmup_to_internal(wu: WarmupConfigurationV1alpha1):
+    from kubernetes_tpu.config import WarmupConfig
+
+    buckets = wu.podBuckets
+    if not (isinstance(buckets, list)
+            and all(isinstance(b, int) and not isinstance(b, bool)
+                    for b in buckets)):
+        raise SchemeError([
+            "warmup.podBuckets: expected a list of integers "
+            f"(got {type(buckets).__name__})"
+        ])
+    return WarmupConfig(
+        enabled=wu.enabled,
+        pod_buckets=tuple(buckets),
+        min_bucket=wu.minBucket,
+        include_filter=wu.includeFilter,
     )
 
 
@@ -440,6 +499,16 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
         perNodeCap=c.per_node_cap,
         maxRounds=c.max_rounds,
         maxBatch=c.max_batch,
+        pipelineDepth=c.pipeline_depth,
+        pipelineChunk=c.pipeline_chunk,
+        deviceResidentSnapshot=c.device_resident_snapshot,
+        snapshotMaxDirtyFrac=c.snapshot_max_dirty_frac,
+        warmup=WarmupConfigurationV1alpha1(
+            enabled=c.warmup.enabled,
+            podBuckets=list(c.warmup.pod_buckets),
+            minBucket=c.warmup.min_bucket,
+            includeFilter=c.warmup.include_filter,
+        ),
         robustness=RobustnessConfigurationV1alpha1(
             cycleDeadline=format_duration(rc.cycle_deadline_s),
             solverRetries=rc.solver_retries,

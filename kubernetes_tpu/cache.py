@@ -24,6 +24,7 @@ directly:
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -55,6 +56,7 @@ class SchedulerCache:
         packer: Optional[SnapshotPacker] = None,
         ttl_s: float = DEFAULT_ASSUME_TTL_S,
         clock: Callable[[], float] = time.monotonic,
+        max_dirty_frac: float = 0.25,
     ) -> None:
         self.packer = packer or SnapshotPacker()
         self.ttl_s = ttl_s
@@ -70,6 +72,34 @@ class SchedulerCache:
         self._table: Optional[NodeTable] = None
         self._row_of: Dict[str, int] = {}
         self._widths_key: Optional[Tuple] = None
+        # ---- device-resident snapshot state (device_snapshot) ------------
+        #: dirty-row fraction above which patching the resident device
+        #: table costs more than re-uploading it (the delta pack + scatter
+        #: approach full-pack cost as the fraction grows)
+        self.max_dirty_frac = max_dirty_frac
+        self._dev = None  # resident ops.arrays.DeviceNodes
+        self._dev_pad: int = 0  # its padded row count
+        #: host refreshes the device hasn't applied yet: [(idx, sub)]
+        #: deltas queued by _refresh_host (a host-only snapshot() caller
+        #: consumes the dirty set; the device drains this queue later)
+        self._pending_dev: List[Tuple[List[int], NodeTable]] = []
+        #: a full host repack happened since the device last uploaded
+        self._dev_stale: bool = True
+        #: serializes snapshot refreshes: the cache is thread-free by
+        #: design for MUTATIONS (driver loop), but server.py's
+        #: extender-serving handler threads call the host snapshot()
+        #: concurrently with the scheduler's device_snapshot() — without
+        #: this lock a half-patched host table could be uploaded and
+        #: then persist as the resident device snapshot
+        self._snap_lock = threading.RLock()
+        #: how the last device_snapshot() was produced: full | delta | clean
+        self.last_snapshot_mode: str = ""
+        #: host rows actually (re)packed + uploaded by the last call — the
+        #: observability surface for "cost proportional to what changed"
+        self.last_upload_rows: int = 0
+        #: bytes the last call moved across the device boundary (full
+        #: table or delta rows) — feeds the h2d transfer accounting
+        self.last_upload_nbytes: int = 0
 
     # -- introspection -----------------------------------------------------
 
@@ -242,17 +272,44 @@ class SchedulerCache:
         happens at mutation time (add/update/assume), so a clean-cache call
         is O(1) — the width comparison below catches any universe growth
         those mutations (or the driver interning pending pods) caused."""
-        wkey = tuple(sorted(self.packer.widths().items()))
+        table, _mode, _idx, _sub = self._refresh_host()
+        return table
+
+    def _refresh_host(self):
+        with self._snap_lock:
+            return self._refresh_host_locked()
+
+    def _refresh_host_locked(self):
+        """Bring the cached host NodeTable up to date. Returns
+        ``(table, mode, idx, sub)`` where mode is ``full`` | ``clean`` |
+        ``delta``; on ``delta``, ``idx`` is the patched row indices and
+        ``sub`` the delta NodeTable whose row j landed at ``idx[j]``.
+
+        Device coherence: every host mutation is ALSO queued on
+        ``_pending_dev`` (deltas) / flagged on ``_dev_stale`` (fulls), so
+        a host-only ``snapshot()`` caller (server.py's extender-serving
+        path) consuming the dirty set can never leave the resident
+        device table silently stale — device_snapshot() drains the queue
+        it missed."""
+        # EXACT universe signature, not the bucketed widths: interner
+        # growth WITHIN a power-of-two bucket still changes clean rows
+        # (a pending pod interning a new selector pair must light
+        # pair_mh on every node carrying that label) — the delta-vs-full
+        # property test caught exactly that staleness against the old
+        # widths-only key.
+        wkey = self.packer.universe_node_sig()
 
         if (
             self._shape_dirty
             or self._table is None
             or wkey != self._widths_key
         ):
-            return self._full_repack(wkey)
+            self._dev_stale = True
+            self._pending_dev.clear()
+            return self._full_repack(), "full", None, None
 
         if not self._dirty:
-            return self._table
+            return self._table, "clean", None, None
 
         # incremental: repack only dirty rows. pack_nodes row computation is
         # node-local (cross-node info lives in the shared universe), so a
@@ -260,13 +317,19 @@ class SchedulerCache:
         dirty = [n for n in self._dirty if n in self._nodes]
         sub_nodes = [self._nodes[n] for n in dirty]
         sub_pods = [p for n in dirty for p in self._pods_by_node.get(n, {}).values()]
-        sub = self.packer.pack_nodes(sub_nodes, sub_pods)
-        if tuple(sorted(self.packer.widths().items())) != wkey:
+        sub = self.packer.pack_nodes_delta(sub_nodes, sub_pods)
+        if self.packer.universe_node_sig() != wkey:
             # packing grew a universe mid-flight — fall back to full
-            return self._full_repack(tuple(sorted(self.packer.widths().items())))
+            self._dev_stale = True
+            self._pending_dev.clear()
+            return (
+                self._full_repack(), "full", None, None,
+            )
         t = self._table
+        idx = []
         for j, name in enumerate(dirty):
             i = self._row_of[name]
+            idx.append(i)
             for f in dataclasses.fields(NodeTable):
                 if f.name in ("n", "zone_valid"):
                     continue
@@ -274,9 +337,92 @@ class SchedulerCache:
         # zone_valid is universe-shaped; refresh from the subset pack
         self._table = dataclasses.replace(t, zone_valid=sub.zone_valid)
         self._dirty.clear()
-        return self._table
+        if self._dev is not None and not self._dev_stale:
+            self._pending_dev.append((idx, sub))
+        return self._table, "delta", idx, sub
 
-    def _full_repack(self, wkey: Tuple) -> NodeTable:
+    def device_snapshot(self):
+        """The device-resident snapshot: returns ``(table, dev, mode)``
+        where ``dev`` is a DeviceNodes that lives on device ACROSS cycles.
+
+        Steady-state cost is proportional to what changed: a clean cache
+        returns the resident arrays untouched; a small dirty set re-packs
+        only those rows on host and patches them in with one jitted
+        scatter (buffer-donated, so no reallocation); a full rebuild
+        happens only on node-set shape changes, universe width growth,
+        explicit invalidation, or when the dirty fraction exceeds
+        ``max_dirty_frac`` (patching would cost more than re-uploading).
+        The delta-vs-full property test pins bit-identical arrays."""
+        import numpy as np
+
+        from kubernetes_tpu.ops.arrays import nodes_to_device, scatter_node_rows
+        from kubernetes_tpu.utils.interner import bucket_size
+
+        from kubernetes_tpu.obs.jaxtel import tree_nbytes
+
+        # the SAME lock _refresh_host takes (RLock): branch selection,
+        # pending-queue drain, and the upload itself must see one
+        # consistent host table even while server handler threads call
+        # the host-only snapshot() concurrently
+        with self._snap_lock:
+            return self._device_snapshot_locked(tree_nbytes)
+
+    def _device_snapshot_locked(self, tree_nbytes):
+        import numpy as np
+
+        from kubernetes_tpu.ops.arrays import nodes_to_device, scatter_node_rows
+        from kubernetes_tpu.utils.interner import bucket_size
+
+        table, _mode, _idx, _sub = self._refresh_host()
+        n_pad = bucket_size(max(table.n, 1))
+        self.last_upload_rows = 0
+        self.last_upload_nbytes = 0
+        pending_rows = sum(len(i) for i, _ in self._pending_dev)
+        if (self._dev is None or self._dev_stale or n_pad != self._dev_pad
+                or pending_rows > self.max_dirty_frac * max(table.n, 1)):
+            # clear BEFORE the upload: a delta appended concurrently by a
+            # host-only snapshot() (server.py runs in a handler thread)
+            # then survives for the next drain — re-applying rows the
+            # full table already carries is idempotent; dropping a delta
+            # queued mid-upload would not be
+            self._pending_dev.clear()
+            self._dev = nodes_to_device(table, pad_to=n_pad)
+            self._dev_pad = n_pad
+            self._dev_stale = False
+            self.last_snapshot_mode = "full"
+            self.last_upload_rows = table.n
+            self.last_upload_nbytes = tree_nbytes(self._dev)
+        elif not self._pending_dev:
+            self.last_snapshot_mode = "clean"
+        else:
+            # delta: convert ONLY the queued dirty rows to device layout
+            # and scatter them into the resident arrays (one jitted call
+            # per queued host refresh — usually exactly one per cycle);
+            # padded index slots point out of bounds and are dropped.
+            # Pop-drain, never iterate-then-clear: a delta appended
+            # concurrently must survive for the next drain instead of
+            # being discarded unapplied.
+            while self._pending_dev:
+                idx, sub = self._pending_dev.pop(0)
+                d_pad = bucket_size(max(len(idx), 1), 4)
+                sub_dev = nodes_to_device(sub, pad_to=d_pad)
+                pidx = np.full((d_pad,), n_pad, np.int32)
+                pidx[: len(idx)] = idx
+                self._dev = scatter_node_rows(self._dev, sub_dev, pidx)
+                self.last_upload_rows += len(idx)
+                self.last_upload_nbytes += tree_nbytes(sub_dev)
+            self.last_snapshot_mode = "delta"
+        return table, self._dev, self.last_snapshot_mode
+
+    def drop_device_snapshot(self) -> None:
+        """Release the resident device table (tests / memory pressure);
+        the next device_snapshot() re-uploads in full."""
+        self._dev = None
+        self._dev_pad = 0
+        self._dev_stale = True
+        self._pending_dev.clear()
+
+    def _full_repack(self) -> NodeTable:
         nodes = list(self._nodes.values())
         pods = [
             p
@@ -285,7 +431,9 @@ class SchedulerCache:
         ]
         self._table = self.packer.pack_nodes(nodes, pods)
         self._row_of = {nd.name: i for i, nd in enumerate(nodes)}
-        self._widths_key = tuple(sorted(self.packer.widths().items()))
+        # the pack itself may intern (first sight of a node's taints /
+        # images) — the stored signature must be the POST-pack state
+        self._widths_key = self.packer.universe_node_sig()
         self._dirty.clear()
         self._shape_dirty = False
         return self._table

@@ -34,6 +34,7 @@ from kubernetes_tpu.config import (
     LeaderElectionConfig,
     ObservabilityConfig,
     RobustnessConfig,
+    WarmupConfig,
     load_policy,
 )
 
@@ -104,6 +105,20 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         errs.append("maxRounds: must be at least 1")
     if cfg.max_batch < 1:
         errs.append("maxBatch: must be at least 1")
+    if cfg.pipeline_depth < 1:
+        errs.append("pipelineDepth: must be at least 1")
+    if cfg.pipeline_chunk < 1:
+        errs.append("pipelineChunk: must be at least 1")
+    if not 0 <= cfg.snapshot_max_dirty_frac <= 1:
+        errs.append(
+            f"snapshotMaxDirtyFrac: Invalid value "
+            f"{cfg.snapshot_max_dirty_frac}: not in valid range 0-1"
+        )
+    wu = cfg.warmup
+    if wu.min_bucket < 1:
+        errs.append("warmup.minBucket: must be at least 1")
+    if any(b < 1 for b in wu.pod_buckets):
+        errs.append("warmup.podBuckets: buckets must be at least 1")
     rc = cfg.robustness
     if rc.cycle_deadline_s < 0:
         errs.append("robustness.cycleDeadlineSeconds: must be non-negative")
@@ -154,6 +169,7 @@ _CONFIG_FIELDS = {f.name for f in dataclasses.fields(KubeSchedulerConfiguration)
 _LE_FIELDS = {f.name for f in dataclasses.fields(LeaderElectionConfig)}
 _ROB_FIELDS = {f.name for f in dataclasses.fields(RobustnessConfig)}
 _OBS_FIELDS = {f.name for f in dataclasses.fields(ObservabilityConfig)}
+_WARMUP_FIELDS = {f.name for f in dataclasses.fields(WarmupConfig)}
 
 
 def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
@@ -229,6 +245,18 @@ def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
                 )
                 continue
             kw["observability"] = ObservabilityConfig(**val)
+        elif key == "warmup":
+            if not isinstance(val, dict):
+                errs.append("warmup: expected a mapping")
+                continue
+            unknown = set(val) - _WARMUP_FIELDS
+            if unknown:
+                errs.append(f"warmup: unknown field(s) {sorted(unknown)}")
+                continue
+            wkw = dict(val)
+            if "pod_buckets" in wkw:
+                wkw["pod_buckets"] = tuple(wkw["pod_buckets"])
+            kw["warmup"] = WarmupConfig(**wkw)
         elif key == "policy":
             kw["policy"] = load_policy(val)
         elif key in _CONFIG_FIELDS:
@@ -287,6 +315,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scheduler-name", default=None)
     p.add_argument("--solver", default=None, choices=VALID_SOLVERS)
     p.add_argument("--per-node-cap", type=int, default=None)
+    p.add_argument("--pipeline-depth", type=int, default=None,
+                   help="pipelined cycle executor depth (1 = monolithic)")
+    p.add_argument("--pipeline-chunk", type=int, default=None,
+                   help="sub-batch size of the pipelined executor")
+    p.add_argument("--warmup", default=None, choices=("true", "false"),
+                   help="AOT-compile the bucketed solve shapes at startup")
     p.add_argument("--percentage-of-nodes-to-score", type=int, default=None)
     p.add_argument("--leader-elect", default=None, choices=("true", "false"))
     p.add_argument("--lock-file", default=None,
@@ -320,6 +354,13 @@ def resolve_config(args) -> KubeSchedulerConfiguration:
         overlay["solver"] = args.solver
     if args.per_node_cap is not None:
         overlay["per_node_cap"] = args.per_node_cap
+    if args.pipeline_depth is not None:
+        overlay["pipeline_depth"] = args.pipeline_depth
+    if args.pipeline_chunk is not None:
+        overlay["pipeline_chunk"] = args.pipeline_chunk
+    if args.warmup is not None:
+        overlay["warmup"] = dataclasses.replace(
+            cfg.warmup, enabled=args.warmup == "true")
     if args.percentage_of_nodes_to_score is not None:
         overlay["percentage_of_nodes_to_score"] = args.percentage_of_nodes_to_score
     if args.leader_elect is not None:
@@ -373,11 +414,23 @@ def run(cfg: KubeSchedulerConfiguration, args, stop_event=None) -> None:
             lock=lock,
             config=cfg.leader_election,
         )
+    #: AOT warmup is LAZY — it must wait for the first node sync, or
+    #: every warmed shape carries an empty-cluster node bucket that no
+    #: real cycle will ever match (the compile would land on the first
+    #: pod's critical path anyway, the exact latency the flag removes)
+    warmup_pending = cfg.warmup.enabled
     try:
         while not stop.is_set():
             if elector is not None and not elector.tick():
                 stop.wait(cfg.leader_election.retry_period_s)
                 continue
+            if warmup_pending and sched.cache.node_count():
+                pp = getattr(sched.queue, "pending_pods", None)
+                sample = pp().get("active", [])[:64] if pp else []
+                n = sched.warmup(sample_pods=sample)
+                print(f"warmup: compiled {n} bucketed solve shapes",
+                      file=sys.stderr)
+                warmup_pending = False
             r = sched.schedule_cycle()
             if r.attempted == 0:
                 stop.wait(args.cycle_interval)

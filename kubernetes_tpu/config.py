@@ -185,6 +185,28 @@ class ObservabilityConfig:
 
 
 @dataclass
+class WarmupConfig:
+    """Ahead-of-time compile warmup (no reference analog): precompile the
+    solver at the bucketed batch shapes the driver will hit, so first-pod
+    latency never pays an XLA compile and queue-length churn cannot cause
+    retraces (the shapes are already in the jit cache). Runs at startup
+    (cli.run) / on demand (Scheduler.warmup); zero-valid synthetic pod
+    batches make each warm call one cheap no-progress round."""
+
+    enabled: bool = False
+    #: pod-axis bucket sizes to precompile; empty = geometric x2 steps
+    #: from ``min_bucket`` up to ``bucket_size(max_batch)`` (the same
+    #: bucketing pods_to_device applies, so every runtime shape is
+    #: covered by construction)
+    pod_buckets: Tuple[int, ...] = ()
+    #: smallest bucket warmed when ``pod_buckets`` is empty
+    min_bucket: int = 256
+    #: also warm the standalone filter pass (the failure-reason /
+    #: explain path, compiled separately from the solver)
+    include_filter: bool = True
+
+
+@dataclass
 class KubeSchedulerConfiguration:
     """The typed component config. Reference fields keep their meanings;
     the ``solver``/``per_node_cap``/``max_batch`` block is this
@@ -216,6 +238,28 @@ class KubeSchedulerConfiguration:
     per_node_cap: int = 4
     max_rounds: int = 128
     max_batch: int = 8192
+    # ---- pipelined cycle executor (scheduler._pipelined_tail) ----------
+    #: 1 = today's monolithic cycle (the seqref-parity mode); >= 2 =
+    #: batches larger than ``pipeline_chunk`` execute as fixed-size
+    #: chunks with host packing of chunk k+1 and binding of chunk k-1
+    #: overlapped with chunk k's device solve (double buffering).
+    #: Chunking and data dependencies are identical at every depth >= 2,
+    #: so placements are depth-invariant by construction.
+    pipeline_depth: int = 2
+    #: sub-batch size of the pipelined executor; batches at or under it
+    #: stay monolithic. One fixed chunk shape per cycle also pins the
+    #: solver's jit signature (last chunk pads to the same bucket).
+    pipeline_chunk: int = 4096
+    # ---- incremental device-resident snapshot (cache.device_snapshot) --
+    #: keep the packed NodeTable resident on device across cycles,
+    #: patching only dirty rows with a jitted scatter; False = legacy
+    #: full host pack + upload every cycle
+    device_resident_snapshot: bool = True
+    #: dirty-row fraction above which the delta patch falls back to a
+    #: full re-upload (patch cost approaches full-pack cost)
+    snapshot_max_dirty_frac: float = 0.25
+    #: AOT compile warmup of the bucketed solve shapes
+    warmup: WarmupConfig = field(default_factory=WarmupConfig)
     #: degradation ladder / fault-tolerance knobs
     robustness: RobustnessConfig = field(default_factory=RobustnessConfig)
     #: cycle tracing / JAX telemetry / flight-recorder knobs

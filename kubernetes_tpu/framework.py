@@ -210,6 +210,12 @@ class Framework:
     def has_host_scores(self) -> bool:
         return bool(self._with("score"))
 
+    def has_batch_filters(self) -> bool:
+        return bool(self._with("filter_batch"))
+
+    def has_batch_scores(self) -> bool:
+        return bool(self._with("score_batch"))
+
     def run_prefilter(self, state: CycleState, pod: Pod) -> Status:
         for p in self._with("pre_filter"):
             s = status_of(p.pre_filter(state, pod))

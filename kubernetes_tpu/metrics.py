@@ -361,6 +361,30 @@ class SchedulerMetrics:
             "Final max row-potential delta of the last Sinkhorn solve "
             "(log-domain; lower is more converged).",
         ))
+        # -- incremental snapshot + pipelined executor (PR 5) -----------
+        self.snapshot_packs = r.register(Counter(
+            "scheduler_snapshot_packs_total",
+            "Device snapshot refreshes by mode: full = whole-table pack "
+            "+ upload; delta = dirty rows re-packed and scattered into "
+            "the resident device table; clean = nothing changed, the "
+            "resident arrays were reused untouched.",
+            ["mode"],
+        ))
+        self.snapshot_rows_packed = r.register(Counter(
+            "scheduler_snapshot_rows_packed_total",
+            "Node rows re-packed on host and uploaded across snapshot "
+            "refreshes — steady-state cost proportional to what changed.",
+        ))
+        self.pipeline_chunks = r.register(Counter(
+            "scheduler_pipeline_chunks_total",
+            "Sub-batches executed by the pipelined cycle executor "
+            "(pack/solve/readback/bind overlapped across chunks).",
+        ))
+        self.warmup_compiles = r.register(Counter(
+            "scheduler_warmup_compiles_total",
+            "Bucketed solve shapes compiled ahead of time by the warmup "
+            "pass (cli --warmup / Scheduler.warmup).",
+        ))
         # -- schedulability explainer (obs/explain.py): the batched
         # why-pending reduction over the (pod x node) failure bitmask ---
         self.unschedulable_pods = r.register(Counter(

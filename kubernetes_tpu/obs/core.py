@@ -123,6 +123,13 @@ class Observability:
     def note_deadline_exceeded(self) -> None:
         self._scratch["deadline_exceeded"] = True
 
+    def note_snapshot(self, mode: str, rows: int) -> None:
+        """How the cycle's device snapshot was produced (full | delta |
+        clean) and how many node rows it re-packed — the per-cycle
+        observability of 'cost proportional to what changed'."""
+        self._scratch["snapshot_mode"] = mode
+        self._scratch["snapshot_rows"] = rows
+
     def note_sinkhorn(self, stats) -> None:
         """Stash the solver's (iters, residual) device pair; read back
         once at end_cycle (the cycle's host boundary)."""
@@ -196,6 +203,10 @@ class Observability:
                     getattr(self.config, "explain_top_k", 3))
                 if s.get("explain") is not None else []
             ),
+            snapshot_mode=s.get("snapshot_mode", ""),
+            snapshot_rows=s.get("snapshot_rows", 0),
+            pipeline_chunks=(getattr(res, "pipeline_chunks", 0)
+                             if res is not None else 0),
         )
         self.recorder.record(rec)
         self._eventful_seq += 1

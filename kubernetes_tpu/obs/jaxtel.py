@@ -128,9 +128,13 @@ class JaxTelemetry:
 
     # -- compile cache ------------------------------------------------------
 
-    def record_call(self, site: str, *trees, static=None) -> str:
+    def record_call(self, site: str, *trees, static=None,
+                    warmup: bool = False) -> str:
         """Record one jitted-call observation; returns the class
-        ("hit" | "compile" | "retrace")."""
+        ("hit" | "compile" | "retrace"). ``warmup=True`` registers an
+        AHEAD-OF-TIME compile (Scheduler.warmup's bucket sweep): a new
+        signature there counts as a deliberate compile, never a retrace —
+        retraces exist to flag recompiles sneaking onto the hot path."""
         digest = abstract_digest(*trees, static=static)
         with self._lock:
             seen = self._seen.setdefault(site, {})
@@ -141,7 +145,7 @@ class JaxTelemetry:
                 kind = "hit"
                 self.hits[site] = self.hits.get(site, 0) + 1
                 seen.pop(digest)  # re-inserted below as most-recent
-            elif not seen and not self.compiles.get(site):
+            elif warmup or (not seen and not self.compiles.get(site)):
                 kind = "compile"
                 self.compiles[site] = self.compiles.get(site, 0) + 1
             else:

@@ -49,6 +49,14 @@ class CycleRecord:
     #: blocked-pod count) from the explain reduction (obs/explain.py);
     #: empty when nothing failed or the explainer is off
     top_reasons: List[Tuple[str, int]] = field(default_factory=list)
+    #: how the cycle's snapshot was produced (full | delta | clean on
+    #: the device-resident path, "host" = legacy full pack + upload;
+    #: "" = the cycle never reached the snapshot) and how many node
+    #: rows were re-packed for it
+    snapshot_mode: str = ""
+    snapshot_rows: int = 0
+    #: sub-batches the pipelined executor ran (0 = monolithic cycle)
+    pipeline_chunks: int = 0
 
     def to_json(self) -> dict:
         return {
@@ -71,6 +79,11 @@ class CycleRecord:
                if self.sinkhorn_iters >= 0 else {}),
             **({"top_reasons": [list(x) for x in self.top_reasons]}
                if self.top_reasons else {}),
+            **({"snapshot": {"mode": self.snapshot_mode,
+                             "rows": self.snapshot_rows}}
+               if self.snapshot_mode else {}),
+            **({"pipeline_chunks": self.pipeline_chunks}
+               if self.pipeline_chunks else {}),
         }
 
 
@@ -136,6 +149,10 @@ class FlightRecorder:
             if r.top_reasons:
                 flags.append("why=" + ",".join(
                     f"{name}:{n}" for name, n in r.top_reasons))
+            if r.snapshot_mode:
+                flags.append(f"snap={r.snapshot_mode}:{r.snapshot_rows}")
+            if r.pipeline_chunks:
+                flags.append(f"chunks={r.pipeline_chunks}")
             spans = " ".join(
                 f"{k}={v*1000:.1f}ms" for k, v in sorted(r.spans.items()))
             lines.append(

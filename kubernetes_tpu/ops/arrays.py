@@ -6,8 +6,10 @@ re-listing via incremental snapshots, cache.go:211)."""
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -296,6 +298,45 @@ def pods_to_device(t: PodTable, pad_to: int | None = None) -> DevicePods:
         vol_error=jnp.asarray(_pad_rows(t.vol_error, p_pad, False)),
         limits=f32(t.limits),
     )
+
+
+#: DeviceNodes fields that are NOT (N,)-row-shaped and therefore must not
+#: be row-scattered by the delta patch: ``valid`` is resident state (row
+#: membership only changes on full rebuilds), ``zone_valid`` is
+#: universe-shaped and is refreshed wholesale from the delta pack.
+_NODE_NON_ROW_FIELDS = ("valid", "zone_valid")
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_node_rows_donated(resident: DeviceNodes, sub: DeviceNodes,
+                               idx: jnp.ndarray) -> DeviceNodes:
+    """Patch dirty rows of the resident device NodeTable in place.
+
+    ``sub`` carries the re-packed rows (any padding rows beyond the real
+    dirty count point their ``idx`` out of bounds and XLA ``mode="drop"``
+    discards them); ``resident`` is donated so XLA aliases the output
+    onto the existing buffers — the steady-state cycle never reallocates
+    or re-uploads the full table. The caller (SchedulerCache) is the sole
+    owner of the resident arrays, which is what makes donation safe."""
+    out = {}
+    for name in DeviceNodes._fields:
+        if name in _NODE_NON_ROW_FIELDS:
+            continue
+        r = getattr(resident, name)
+        s = getattr(sub, name)
+        out[name] = r.at[idx].set(s, mode="drop")
+    return DeviceNodes(valid=resident.valid, zone_valid=sub.zone_valid,
+                       **out)
+
+
+def scatter_node_rows(resident: DeviceNodes, sub: DeviceNodes,
+                      idx: np.ndarray) -> DeviceNodes:
+    """Jitted row-scatter entry: ``idx`` (D,) host indices aligned with
+    ``sub``'s rows; entries >= resident row count are dropped (padding).
+    Returns the patched DeviceNodes; the resident argument's buffers are
+    donated and must not be used afterwards."""
+    return _scatter_node_rows_donated(resident, sub,
+                                      jnp.asarray(idx, jnp.int32))
 
 
 def selectors_to_device(t: SelectorTables) -> DeviceSelectors:

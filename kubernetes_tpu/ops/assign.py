@@ -579,8 +579,16 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
         return new_assigned, u, progressed, rnd + 1, use_plan, sk_stats
 
     def cond(carry):
-        _, _, progressed, rnd, _, _ = carry
-        return progressed & (rnd < max_rounds)
+        assigned, _, progressed, rnd, _, _ = carry
+        # three exits: a no-progress round (contention fixpoint), the
+        # round budget, or — the hot-path case — NOTHING LEFT TO PLACE.
+        # Without the third check every fully-placed batch pays one dead
+        # full-matrix round just to discover it made no progress (the
+        # uncontended headline's entire round 2); the (P,) reduction here
+        # is noise next to the (P, N) passes it skips. Placements are
+        # untouched: a round with zero active pods cannot change anything.
+        return (progressed & (rnd < max_rounds)
+                & jnp.any((assigned == -1) & pods.valid))
 
     # sk_stats: [-1, -1] = sinkhorn never engaged this solve; otherwise
     # the LAST round's [iterations-to-converge, final residual]

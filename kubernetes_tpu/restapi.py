@@ -580,8 +580,32 @@ class RestServer:
             def log_message(self, *a):  # quiet
                 pass
 
+            def _drain_body(self) -> None:
+                """Discard any unread request body before responding.
+                An early rejection (404 on an unknown path, 401, bad
+                verb) that never touched rfile leaves the POSTed body in
+                the socket's receive buffer; closing the connection with
+                unread data makes the kernel send RST instead of FIN,
+                and the client's in-flight response read then fails with
+                ECONNRESET — a timing-dependent flake the REST fuzz test
+                catches. Bounded (1 MiB) so a hostile Content-Length
+                cannot wedge a handler thread."""
+                if getattr(self, "_body_read", False):
+                    return
+                self._body_read = True
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                except (TypeError, ValueError):
+                    return
+                if 0 < n <= 1 << 20:
+                    try:
+                        self.rfile.read(n)
+                    except OSError:
+                        pass
+
             def _send_raw(self, code: int, ctype: str, body: bytes) -> None:
                 self._code = code  # for the audit trail
+                self._drain_body()
                 if getattr(self, "_buffer_mode", False):
                     # built under the hub lock, WRITTEN outside it — a
                     # slow client must never wedge the hub on socket I/O
@@ -711,6 +735,7 @@ class RestServer:
         h._code = 0
         h._audit_body = None
         h._user = None
+        h._body_read = False  # this request's body not yet consumed
 
     def _auth(self, h, http_verb: str) -> bool:
         """The authentication -> authorization filter pair, ahead of all
@@ -818,6 +843,7 @@ class RestServer:
         """Parsed JSON body, or None (after a 400 response) on garbage."""
         n = int(h.headers.get("Content-Length", 0))
         raw = h.rfile.read(n) or b"{}"
+        h._body_read = True  # _drain_body must not read the socket again
         try:
             doc = json.loads(raw)
         except ValueError:

@@ -131,6 +131,13 @@ class CycleResult:
     #: cluster reason histogram, one-bit-away relaxations. None when the
     #: explainer is off or the cycle ended before the solve.
     explain: Optional[object] = None
+    #: how the cycle's snapshot was produced: full | delta | clean
+    #: (device-resident modes), "host" = legacy full host pack + upload
+    #: (device_resident_snapshot off), "" = the cycle ended before the
+    #: snapshot (empty queue / all-prefilter batches)
+    snapshot_mode: str = ""
+    #: sub-batches the pipelined executor ran (0 = monolithic cycle)
+    pipeline_chunks: int = 0
 
 
 class Scheduler:
@@ -165,6 +172,11 @@ class Scheduler:
         fault_injector=None,
         retry_sleep: Callable[[float], None] = time.sleep,
         observability=None,
+        pipeline_depth: int = 2,
+        pipeline_chunk: int = 4096,
+        device_resident_snapshot: bool = True,
+        snapshot_max_dirty_frac: Optional[float] = None,
+        warmup=None,
     ) -> None:
         from kubernetes_tpu.config import ObservabilityConfig, RobustnessConfig
         from kubernetes_tpu.faults import CircuitBreaker, RetryPolicy
@@ -245,6 +257,22 @@ class Scheduler:
         #: per-pod CycleState, alive from prefilter to bind/fail
         self._cycle_states: Dict[str, object] = {}
         self.cache = cache or SchedulerCache(clock=clock)
+        #: pipelined cycle executor: batches larger than pipeline_chunk
+        #: split into fixed-size chunks; depth >= 2 overlaps host packing
+        #: of chunk k+1 and binding of chunk k-1 with chunk k's device
+        #: solve (JAX async dispatch). Depth 1 keeps today's monolithic
+        #: cycle — the seqref-parity mode.
+        self.pipeline_depth = pipeline_depth
+        self.pipeline_chunk = pipeline_chunk
+        #: device-resident snapshot: keep the packed NodeTable on device
+        #: across cycles, patching dirty rows with a jitted scatter
+        self.device_resident_snapshot = device_resident_snapshot
+        if snapshot_max_dirty_frac is not None:
+            self.cache.max_dirty_frac = snapshot_max_dirty_frac
+        #: AOT warmup config (config.WarmupConfig or None)
+        from kubernetes_tpu.config import WarmupConfig
+
+        self.warmup_config = warmup if warmup is not None else WarmupConfig()
         # explicit None check: SchedulingQueue defines __len__, so a
         # caller-provided EMPTY queue is falsy and `queue or ...` would
         # silently replace it with a fresh one
@@ -336,6 +364,11 @@ class Scheduler:
         kw.setdefault("scheduler_name", cfg.scheduler_name)
         kw.setdefault("robustness", cfg.robustness)
         kw.setdefault("observability", cfg.observability)
+        kw.setdefault("pipeline_depth", cfg.pipeline_depth)
+        kw.setdefault("pipeline_chunk", cfg.pipeline_chunk)
+        kw.setdefault("device_resident_snapshot", cfg.device_resident_snapshot)
+        kw.setdefault("snapshot_max_dirty_frac", cfg.snapshot_max_dirty_frac)
+        kw.setdefault("warmup", cfg.warmup)
         if getattr(cfg, "plugins", ()) and "framework" not in kw:
             # config-driven framework assembly (the NewFramework path,
             # framework.go:88: registry factories + per-plugin args from
@@ -432,6 +465,15 @@ class Scheduler:
             # the whole unschedulableQ (eventhandlers.go)
             self.queue.assigned_pod_added(new)
         elif self.responsible_for(new):
+            if new != old:
+                # a pending pod updated IN PLACE (same uid — labels or
+                # selector edited through PATCH): the packer's per-pod
+                # ref cache and the pack-table memo are keyed by
+                # (key, uid) + universe signature, and a changed spec
+                # whose values are all already interned moves neither —
+                # forget the pod so the next pack re-interns and the
+                # memoized tables (pack epoch) invalidate
+                self.cache.packer.forget_pod(new.key())
             self.queue.update(old.key(), new)
         elif self.responsible_for(old):
             # responsible -> not-responsible transition: the reference's
@@ -581,7 +623,16 @@ class Scheduler:
                 pk.intern_pod(p)
             for p, _ in nominated:
                 pk.intern_pod(p)
-            nt = self.cache.snapshot()
+            if self.device_resident_snapshot:
+                # incremental device-resident snapshot: the packed node
+                # table lives on device across cycles; dirty rows patch
+                # in with a jitted scatter, full rebuilds only on shape/
+                # width changes or explicit invalidation (cache.py)
+                nt, dn, snap_mode = self.cache.device_snapshot()
+            else:
+                nt = self.cache.snapshot()
+                dn = None
+                snap_mode = "host"
             node_order = self.cache.node_order()
             pt = pk.pack_pods(batch)
             # host-side feature gates: priorities whose inputs are absent
@@ -591,25 +642,59 @@ class Scheduler:
             # ops/priorities.empty_priorities,
             # ops/predicates.pods_have_no_ports)
             skip_prio, no_ports, no_pod_aff, no_spread = solver_gates(nt, pt)
-            dn = nodes_to_device(nt)
-            dp = pods_to_device(pt, pad_to=bucket_size(max(len(batch), 1)))
+            if dn is None:
+                dn = nodes_to_device(nt)
+            use_pipeline = self._pipeline_eligible(batch, nominated)
+            dp = (None if use_pipeline else
+                  pods_to_device(pt, pad_to=bucket_size(max(len(batch), 1))))
             ds = selectors_to_device(pk.pack_selector_tables())
             dt = (topology_to_device(pk.pack_topology_tables())
                   if _has_topo(pk.u) else None)
             dv = sv = None
-            if any(p.volumes for p in batch):
+            if dp is not None and any(p.volumes for p in batch):
                 from kubernetes_tpu.ops.arrays import volumes_to_device
 
                 dv = volumes_to_device(pk.pack_volume_tables(batch))
                 sv = _static_vol_pass(dp, dn, ds, dv)
-            trace.step(f"snapshot packed ({len(batch)} pods, {nt.n} nodes)")
-        # h2d accounting + the batch-shape digest for the flight recorder
-        self.obs.jax.record_upload("snapshot", dp, dn, ds, dt, dv)
+            trace.step(f"snapshot packed ({len(batch)} pods, {nt.n} nodes,"
+                       f" {snap_mode})")
+        res.snapshot_mode = snap_mode
+        # host mode never touches the cache's device bookkeeping: it
+        # packs+uploads the whole table right here, every cycle
+        snap_rows = (nt.n if snap_mode == "host"
+                     else self.cache.last_upload_rows)
+        self.metrics.snapshot_packs.inc(mode=snap_mode)
+        self.metrics.snapshot_rows_packed.inc(snap_rows)
+        self.obs.note_snapshot(snap_mode, snap_rows)
+        # h2d accounting (only what actually crossed the boundary: full
+        # uploads count the whole resident table, delta cycles count the
+        # scattered rows via the cache's byte ledger, clean cycles count
+        # nothing) + the batch-shape digest for the flight recorder
+        uploads = [t for t in (dp, ds, dt, dv) if t is not None]
+        if snap_mode in ("host", "full"):
+            uploads.append(dn)  # the whole node table crossed over
+        elif self.cache.last_upload_nbytes:
+            # delta: only the scattered rows crossed — charge the
+            # cache's byte ledger, not the resident table's full size
+            self.obs.jax.record_transfer(
+                "snapshot", "h2d", self.cache.last_upload_nbytes)
+        self.obs.jax.record_upload("snapshot", *uploads)
         self.obs.note_batch_shape(
-            f"P{dp.valid.shape[0]}xN{dn.valid.shape[0]}"
+            f"P{dp.valid.shape[0] if dp is not None else len(batch)}"
+            f"xN{dn.valid.shape[0]}"
             + ("+topo" if dt is not None else "")
             + ("+vol" if dv is not None else "")
+            + (f"+pipe{self.pipeline_chunk}" if use_pipeline else "")
         )
+
+        if use_pipeline:
+            # the pipelined cycle executor owns the rest of the cycle on
+            # the clean fast path (no extenders / host plugins / gang /
+            # nominated pods — _pipeline_eligible)
+            return self._pipelined_tail(
+                batch, cycle, res, t0, trace, nt, dn, ds, dt, node_order,
+                skip_prio, no_ports, no_pod_aff, no_spread,
+            )
 
         # framework Filter/Score contributions: device batch plugins give
         # whole (P, N) matrices; host plugins evaluate per (pod, nodeName)
@@ -856,8 +941,6 @@ class Scheduler:
                         rmat[i], nvalid, reqs[i], free, ready, netun, res_names
                     )
 
-        from kubernetes_tpu.framework import WAIT as _WAIT
-
         bind_span = trace.begin_span("bind")
         for i, pod in enumerate(batch):
             target = int(assigned[i])
@@ -877,51 +960,7 @@ class Scheduler:
                        else None)
                 self._fail(pod, cycle, res, reasons, message=msg)
                 continue
-            node_name = node_order[target]
-            st = self._cycle_states.get(pod.key()) or CycleState()
-            # AssumePodVolumes (scheduler.go:523 assumeVolumes, before
-            # Reserve): reserve a PV per unbound delayed-binding claim for
-            # THIS node; a racing claimant earlier in the batch may have
-            # taken the last one — then this pod fails and requeues.
-            # A reservation held from a PREVIOUS cycle (Permit-parked pod
-            # popped again) must survive this attempt's failure paths.
-            vols_held_before = pod.key() in self.volume_binder.assumed
-            vok, vmsg = self.volume_binder.assume_pod_volumes(
-                pod, self.cache.node(node_name)
-            )
-            if not vok:
-                self._fail(pod, cycle, res, (f"VolumeBinding:{vmsg}",))
-                continue
-            # Reserve (scheduler.go:531 RunReservePlugins, before assume)
-            rs = fw.run_reserve(st, pod, node_name)
-            if not rs.is_success():
-                if not vols_held_before:
-                    self.volume_binder.forget_pod_volumes(pod.key())
-                fw.run_unreserve(st, pod, node_name)
-                self._fail(pod, cycle, res, (f"Reserve:{rs.message}",))
-                continue
-            try:
-                self.cache.assume_pod(pod, node_name)
-            except Exception:
-                # already in cache (e.g. duplicate queue entry) — requeue
-                if not vols_held_before:
-                    self.volume_binder.forget_pod_volumes(pod.key())
-                fw.run_unreserve(st, pod, node_name)
-                self._fail(pod, cycle, res, ("AssumeError",))
-                continue
-            # Permit (scheduler.go:561): Wait parks the pod (still assumed,
-            # capacity held) until allow/reject/timeout
-            ps = fw.run_permit(st, pod, node_name)
-            if ps.code == _WAIT:
-                res.waiting += 1
-                continue
-            if not ps.is_success():
-                self.cache.forget_pod(pod.key())
-                self.volume_binder.forget_pod_volumes(pod.key())
-                fw.run_unreserve(st, pod, node_name)
-                self._fail(pod, cycle, res, (f"Permit:{ps.message}",))
-                continue
-            self._bind_pod(pod, node_name, st, res)
+            self._admit_pod(pod, node_order[target], cycle, res)
 
         trace.end_span(bind_span)
         trace.step(f"bound {res.scheduled}, failed {res.unschedulable}")
@@ -945,10 +984,18 @@ class Scheduler:
                     batch, preemptable_idx, rmat, node_order, res)
             self.metrics.preemption_duration.observe(self.clock() - pt0)
             trace.step(f"preemption ({res.preempted} victims)")
+        return self._finish_cycle(res, cycle, t0, solve_s, trace)
+
+    def _finish_cycle(self, res: CycleResult, cycle: int, t0: float,
+                      solve_s: float, trace, label: str = "") -> CycleResult:
+        """The shared end-of-cycle bookkeeping (monolithic AND pipelined
+        paths): elapsed stamp, summary log, metrics, slow-cycle trace
+        log, flight record. New finalization steps belong HERE so the
+        two executors cannot silently diverge."""
         res.elapsed_s = self.clock() - t0
         klog.V(3).info(
-            "cycle %d: attempted=%d scheduled=%d unschedulable=%d "
-            "rounds=%d %.3fs", cycle, res.attempted, res.scheduled,
+            "cycle %d%s: attempted=%d scheduled=%d unschedulable=%d "
+            "rounds=%d %.3fs", cycle, label, res.attempted, res.scheduled,
             res.unschedulable, res.rounds, res.elapsed_s,
         )
         self._record_metrics(res, solve_s)
@@ -1354,6 +1401,318 @@ class Scheduler:
         )
         return jnp.asarray(assigned_final), usage, rounds
 
+    # -- pipelined cycle executor ------------------------------------------
+
+    def _pipeline_eligible(self, batch, nominated) -> bool:
+        """The pipelined executor covers the clean high-throughput path:
+        features that need whole-batch host coupling (extenders, host/
+        batch plugins, gang groups, nominated-pod pass A, node-search
+        truncation) or a host-resident solver (exact) keep the monolithic
+        cycle. Depth 1 is the explicit off switch — today's behavior."""
+        if self.pipeline_depth < 2 or self.pipeline_chunk < 1:
+            return False
+        if len(batch) <= self.pipeline_chunk:
+            return False
+        if self.solver not in ("batch", "sinkhorn", "greedy"):
+            return False
+        if self.extenders or nominated:
+            return False
+        fw = self.framework
+        if (fw.has_host_filters() or fw.has_host_scores()
+                or fw.has_batch_filters() or fw.has_batch_scores()):
+            return False
+        if self.percentage_of_nodes_to_score is not None:
+            return False
+        if any(p.pod_group for p in batch):
+            return False
+        return True
+
+    def _pipelined_tail(self, batch, cycle, res, t0, trace, nt, dn, ds, dt,
+                        node_order, skip_prio, no_ports, no_pod_aff,
+                        no_spread) -> CycleResult:
+        """Double-buffered pack→solve→readback→bind pipeline over fixed
+        sub-batches (SURVEY §7.2 step 9): while chunk k's solve runs on
+        device (JAX async dispatch), the host packs chunk k+1 and applies
+        chunk k−1's binds. Chunking and the usage-chain data dependencies
+        are identical at every depth ≥ 2 — only host scheduling overlaps —
+        so placements are depth-invariant by construction (pinned by
+        tests/test_pipeline.py). Every chunk pads to ONE bucket, so the
+        whole cycle runs a single solver jit signature."""
+        import numpy as np
+
+        from kubernetes_tpu.faults import SolverResultInvalid
+        from kubernetes_tpu.ops.assign import (
+            batch_assign,
+            greedy_assign,
+            nodes_with_usage,
+            validate_solution,
+        )
+        from kubernetes_tpu.ops.arrays import volumes_to_device
+        from kubernetes_tpu.ops.predicates import (
+            decode_reasons,
+            fit_error_message,
+        )
+        from kubernetes_tpu.snapshot import FIXED_RESOURCE_NAMES
+
+        pk = self.cache.packer
+        C = self.pipeline_chunk
+        chunks = [batch[i:i + C] for i in range(0, len(batch), C)]
+        res.pipeline_chunks = len(chunks)
+        self.metrics.pipeline_chunks.inc(len(chunks))
+        chunk_pad = bucket_size(C)
+        explain_on = getattr(self.obs.config, "explain", True)
+        rc = self.robustness
+        solver = self.solver
+        statics = (solver, tuple(skip_prio), no_ports, no_pod_aff,
+                   no_spread, self.pred_mask, self.per_node_cap,
+                   self.max_rounds)
+        hook = (self.fault_injector.solver_hook
+                if self.fault_injector is not None else None)
+
+        dn_cur = dn
+        solve_s = 0.0
+        tier_last = solver
+        failed_global: List[int] = []
+        reasons_row: Dict[int, Tuple[str, ...]] = {}
+        fit_msgs: Dict[int, str] = {}
+        rmat_rows: Dict[int, np.ndarray] = {}
+        ex_parts: List[Tuple[int, int, dict]] = []  # (offset, n, ex dict)
+
+        def pack_chunk(k):
+            with self.obs.span(f"pipeline:pack@{k}", pods=len(chunks[k])):
+                dp_c = pods_to_device(pk.pack_pods(chunks[k]),
+                                      pad_to=chunk_pad)
+                dv_c = sv_c = None
+                if any(p.volumes for p in chunks[k]):
+                    dv_c = volumes_to_device(pk.pack_volume_tables(chunks[k]))
+                    sv_c = _static_vol_pass(dp_c, dn, ds, dv_c)
+                # per-chunk h2d accounting: the pod tables are the
+                # steady-state cycle's largest upload
+                self.obs.jax.record_upload(
+                    "snapshot", dp_c,
+                    *([dv_c] if dv_c is not None else []))
+                return dp_c, dv_c, sv_c
+
+        def dispatch(k, packed, dn_in):
+            """Queue chunk k's solve on device (async); returns the
+            device triple or None when the breaker/deadline sheds it
+            straight to the ladder."""
+            dp_c, dv_c, sv_c = packed
+            if not self._breaker(f"solver:{solver}").allow():
+                return None
+            if (self._cycle_deadline is not None
+                    and self.clock() >= self._cycle_deadline):
+                return None
+            with self.obs.span(f"pipeline:dispatch@{k}", tier=solver):
+                self.obs.jax.record_call("solve", dp_c, dn_in, ds, dt, dv_c,
+                                         static=statics)
+                if solver == "greedy":
+                    a, u = greedy_assign(
+                        dp_c, dn_in, ds, self.weights, topo=dt, vol=dv_c,
+                        static_vol=sv_c, enabled_mask=self.pred_mask,
+                        skip_priorities=skip_prio, no_ports=no_ports,
+                        no_pod_affinity=no_pod_aff, no_spread=no_spread,
+                        fault_hook=hook, fault_site="solve:greedy",
+                    )
+                    return a, u, len(chunks[k])
+                # stats_out matches the monolithic tier's static key so
+                # warmed/monolithic/pipelined solves share ONE compiled
+                # program per shape; the last chunk's sinkhorn stats ride
+                # to end_cycle like the monolith's single solve
+                want_stats = self.obs.config.sinkhorn_telemetry
+                out = batch_assign(
+                    dp_c, dn_in, ds, self.weights,
+                    max_rounds=self.max_rounds,
+                    per_node_cap=self.per_node_cap, topo=dt, vol=dv_c,
+                    static_vol=sv_c, enabled_mask=self.pred_mask,
+                    use_sinkhorn=(solver == "sinkhorn"),
+                    skip_priorities=skip_prio, no_ports=no_ports,
+                    no_pod_affinity=no_pod_aff, no_spread=no_spread,
+                    fault_hook=hook, fault_site=f"solve:{solver}",
+                    stats_out=want_stats,
+                )
+                if want_stats:
+                    assigned_d, usage_d, rounds_d, sk_stats = out
+                    self.obs.note_sinkhorn(sk_stats)
+                    return assigned_d, usage_d, rounds_d
+                return out
+
+        def settle(k, packed, out, dn_in):
+            """Block on chunk k's result, validate it, and fall back to
+            the full degradation ladder on any failure (the chunk then
+            runs with depth-1 semantics). Returns (assigned host array or
+            None, usage, tier)."""
+            nonlocal solve_s
+            chunk = chunks[k]
+            dp_c, dv_c, sv_c = packed
+            br = self._breaker(f"solver:{solver}")
+            ts = self.clock()
+            if out is not None:
+                try:
+                    a_dev, u_dev, rounds = out
+                    with self.obs.span(f"pipeline:readback@{k}"):
+                        a = self.obs.jax.readback(
+                            "solve-result", a_dev)[: len(chunk)].copy()
+                    if rc.validate_results:
+                        with self.obs.span("validate"):
+                            ok, why = validate_solution(
+                                a_dev, u_dev, dp_c, dn_in, self.pred_mask)
+                        if not ok:
+                            self.metrics.solver_rejections.inc(
+                                tier=solver, reason=why)
+                            raise SolverResultInvalid(f"{solver}: {why}")
+                    br.record_success()
+                    res.rounds += int(rounds)
+                    solve_s += self.clock() - ts
+                    return a, u_dev, solver
+                except Exception as e:
+                    br.record_failure()
+                    klog.warning(
+                        "pipelined chunk %d solve failed (%s); ladder", k, e)
+            # shed (open breaker / blown deadline) or failed readback:
+            # this chunk re-solves through the full ladder — retries,
+            # CPU fallback, greedy oracle, per-tier breakers included
+            ladder = self._solve_ladder(
+                solver, chunk, dp_c, dn_in, ds, dt, dv_c, sv_c, None,
+                None, None, skip_prio, no_ports, no_pod_aff, no_spread,
+                res,
+            )
+            if ladder is None:
+                for pod in chunk:
+                    self._fail(pod, cycle, res, ("SolverUnavailable",))
+                solve_s += self.clock() - ts
+                return None, None, ""
+            a_dev, u_dev, rounds, tier = ladder
+            a = self.obs.jax.readback(
+                "solve-result", a_dev)[: len(chunk)].copy()
+            res.rounds += int(rounds)
+            solve_s += self.clock() - ts
+            return a, u_dev, tier
+
+        def chunk_failures(k, offset, a, packed):
+            """Failure reasons + explain for chunk k's unplaced pods,
+            evaluated against the post-chunk usage view (what the serial
+            loop would have seen last)."""
+            failed_idx = [i for i, t in enumerate(a) if t < 0]
+            if not failed_idx:
+                return
+            dp_c, dv_c, sv_c = packed
+            fr = _filter_pass(dp_c, dn_cur, ds, dt, dv_c, sv_c,
+                              self.pred_mask)
+            if explain_on:
+                from kubernetes_tpu.obs.explain import explain_reduce
+
+                fm = np.zeros((dp_c.valid.shape[0],), bool)
+                fm[failed_idx] = True
+                ex = explain_reduce(fr.reasons, dn_cur.valid,
+                                    jnp.asarray(fm))
+                ex_parts.append(
+                    (offset, len(chunks[k]),
+                     self.obs.jax.readback("explain", ex)._asdict()))
+            rmat = self.obs.jax.readback("failure-reasons", fr.reasons)
+            nvalid = np.asarray(dn_cur.valid)
+            free = (np.asarray(dn_cur.allocatable)
+                    - np.asarray(dn_cur.requested))
+            reqs = np.asarray(dp_c.req)
+            ready = np.asarray(dn_cur.ready)
+            netun = np.asarray(dn_cur.network_unavailable)
+            res_names = (list(FIXED_RESOURCE_NAMES)
+                         + pk.u.scalar_resources.items())[: reqs.shape[1]]
+            for i in failed_idx:
+                g = offset + i
+                bits = (int(np.bitwise_or.reduce(rmat[i][nvalid]))
+                        if nvalid.any() else 0)
+                reasons_row[g] = decode_reasons(bits)
+                rmat_rows[g] = rmat[i]
+                failed_global.append(g)
+                if bits:
+                    fit_msgs[g] = fit_error_message(
+                        rmat[i], nvalid, reqs[i], free, ready, netun,
+                        res_names)
+
+        def bind_chunk(k, offset, a):
+            with self.obs.span(f"pipeline:bind@{k}"):
+                for i, pod in enumerate(chunks[k]):
+                    t = int(a[i])
+                    if t < 0:
+                        g = offset + i
+                        self._fail(pod, cycle, res, reasons_row.get(g, ()),
+                                   message=fit_msgs.get(g))
+                    else:
+                        self._admit_pod(pod, node_order[t], cycle, res)
+
+        # ---- the pipeline proper ----
+        offset = 0
+        packed = pack_chunk(0)
+        pend = (packed, dispatch(0, packed, dn_cur), dn_cur)
+        for k in range(len(chunks)):
+            # pack chunk k+1 NOW: the host packs while chunk k's solve
+            # runs on device (the overlap the executor exists for)
+            nxt = (pack_chunk(k + 1)
+                   if k + 1 < len(chunks) else None)
+            packed_k, out_k, dn_in = pend
+            a, u_dev, tier = settle(k, packed_k, out_k, dn_in)
+            if tier:
+                tier_last = tier
+            if u_dev is not None:
+                dn_cur = nodes_with_usage(dn_in, u_dev)
+            if a is not None:
+                # the failure passes ride the device queue BEFORE chunk
+                # k+1's solve so their readback never waits behind it
+                chunk_failures(k, offset, a, packed_k)
+            if nxt is not None:
+                pend = (nxt, dispatch(k + 1, nxt, dn_cur), dn_cur)
+            if a is not None:
+                # bind on host while chunk k+1 solves on device
+                bind_chunk(k, offset, a)
+            offset += len(chunks[k])
+
+        res.solver_tier = tier_last
+        self.metrics.algorithm_duration.observe(solve_s)
+        trace.step(
+            f"pipeline done ({len(chunks)} chunks, {res.rounds} rounds)")
+
+        if explain_on:
+            ex_host = None
+            if ex_parts:
+                P = len(batch)
+                B = int(ex_parts[0][2]["pair_hist"].shape[0])
+                ex_host = {
+                    "per_pod": np.zeros((P, B), np.int32),
+                    "one_bit": np.zeros((P, B), np.int32),
+                    "best_bit": np.zeros((P,), np.int32),
+                    "best_gain": np.zeros((P,), np.int32),
+                    "feasible": np.zeros((P,), np.int32),
+                    "pair_hist": np.zeros((B,), np.int64),
+                    "pods_blocked": np.zeros((B,), np.int64),
+                }
+                for off, n, part in ex_parts:
+                    for f in ("per_pod", "one_bit", "best_bit",
+                              "best_gain", "feasible"):
+                        ex_host[f][off:off + n] = np.asarray(part[f])[:n]
+                    ex_host["pair_hist"] += np.asarray(
+                        part["pair_hist"], np.int64)
+                    ex_host["pods_blocked"] += np.asarray(
+                        part["pods_blocked"], np.int64)
+            self._build_explain_report(
+                cycle, batch, sorted(failed_global), ex_host, nt.n, res)
+
+        preempt_idx = [g for g in sorted(failed_global) if g in rmat_rows]
+        if self.enable_preemption and preempt_idx:
+            width = next(iter(rmat_rows.values())).shape[0]
+            rmat_full = np.zeros((len(batch), width), np.int64)
+            for g, row in rmat_rows.items():
+                rmat_full[g] = row
+            pt0 = self.clock()
+            with self.obs.span("preemption"):
+                self._run_preemption(
+                    batch, preempt_idx, rmat_full, node_order, res)
+            self.metrics.preemption_duration.observe(self.clock() - pt0)
+            trace.step(f"preemption ({res.preempted} victims)")
+
+        return self._finish_cycle(res, cycle, t0, solve_s, trace,
+                                  label=f" (pipelined x{len(chunks)})")
+
     def _run_extenders(self, batch, base_fr, node_order, early_fail):
         """Call each extender's Filter then Prioritize for interested pods
         against the built-in-feasible node set (``base_fr`` — the shared
@@ -1434,6 +1793,59 @@ class Scheduler:
                 keep[rows[n]] = True
             em[i] = keep
         return jnp.asarray(em), jnp.asarray(es)
+
+    def _admit_pod(self, pod: Pod, node_name: str, cycle: int,
+                   res: CycleResult) -> None:
+        """The per-pod admission tail for a PLACED pod: AssumePodVolumes →
+        Reserve → cache assume → Permit → bind. Shared by the monolithic
+        bind loop and the pipelined executor's per-chunk bind stage."""
+        from kubernetes_tpu.framework import WAIT as _WAIT, CycleState
+
+        fw = self.framework
+        st = self._cycle_states.get(pod.key()) or CycleState()
+        # AssumePodVolumes (scheduler.go:523 assumeVolumes, before
+        # Reserve): reserve a PV per unbound delayed-binding claim for
+        # THIS node; a racing claimant earlier in the batch may have
+        # taken the last one — then this pod fails and requeues.
+        # A reservation held from a PREVIOUS cycle (Permit-parked pod
+        # popped again) must survive this attempt's failure paths.
+        vols_held_before = pod.key() in self.volume_binder.assumed
+        vok, vmsg = self.volume_binder.assume_pod_volumes(
+            pod, self.cache.node(node_name)
+        )
+        if not vok:
+            self._fail(pod, cycle, res, (f"VolumeBinding:{vmsg}",))
+            return
+        # Reserve (scheduler.go:531 RunReservePlugins, before assume)
+        rs = fw.run_reserve(st, pod, node_name)
+        if not rs.is_success():
+            if not vols_held_before:
+                self.volume_binder.forget_pod_volumes(pod.key())
+            fw.run_unreserve(st, pod, node_name)
+            self._fail(pod, cycle, res, (f"Reserve:{rs.message}",))
+            return
+        try:
+            self.cache.assume_pod(pod, node_name)
+        except Exception:
+            # already in cache (e.g. duplicate queue entry) — requeue
+            if not vols_held_before:
+                self.volume_binder.forget_pod_volumes(pod.key())
+            fw.run_unreserve(st, pod, node_name)
+            self._fail(pod, cycle, res, ("AssumeError",))
+            return
+        # Permit (scheduler.go:561): Wait parks the pod (still assumed,
+        # capacity held) until allow/reject/timeout
+        ps = fw.run_permit(st, pod, node_name)
+        if ps.code == _WAIT:
+            res.waiting += 1
+            return
+        if not ps.is_success():
+            self.cache.forget_pod(pod.key())
+            self.volume_binder.forget_pod_volumes(pod.key())
+            fw.run_unreserve(st, pod, node_name)
+            self._fail(pod, cycle, res, (f"Permit:{ps.message}",))
+            return
+        self._bind_pod(pod, node_name, st, res)
 
     def _bind_pod(self, pod: Pod, node_name: str, st, res: CycleResult) -> bool:
         """PreBind -> Bind (plugins, else default binder) -> PostBind —
@@ -1629,6 +2041,122 @@ class Scheduler:
         # status text
         self.event_sink("FailedScheduling", pod,
                         message if message is not None else ",".join(reasons))
+
+    def warmup(self, sample_pods=(), node_count: Optional[int] = None) -> int:
+        """AOT warmup (config.WarmupConfig): compile the solver — and the
+        standalone filter pass — at every bucketed pod-batch shape the
+        driver can hit, so first-pod latency never pays an XLA compile
+        and queue-length churn across bucket boundaries causes no
+        retraces (`scheduler_jax_retrace_total` stays flat).
+
+        ``sample_pods`` (optional but recommended) seeds the universes
+        and derives the host-side solver gates exactly as real cycles
+        will; without a sample the clean-batch gate set is warmed. The
+        node axis uses the cache's current cluster (its bucket is fixed
+        per cluster) or ``node_count`` before any node has synced.
+        Signatures are pre-registered with the JAX telemetry, so the
+        first real cycle classifies as a cache hit, not a compile.
+        Returns the number of bucketed shapes compiled."""
+        import jax
+
+        from kubernetes_tpu.ops.assign import batch_assign, greedy_assign
+
+        wu = self.warmup_config
+        pk = self.cache.packer
+        sample = list(sample_pods)
+        for p in sample:
+            pk.intern_pod(p)
+        if self.cache.node_count():
+            if self.device_resident_snapshot:
+                nt, dn, _ = self.cache.device_snapshot()
+            else:
+                nt = self.cache.snapshot()
+                dn = nodes_to_device(nt)
+        elif node_count:
+            # no cluster yet: widths-complete zero-row table, padded to
+            # the caller's expected node bucket
+            nt = pk.pack_nodes([])
+            dn = nodes_to_device(nt, pad_to=bucket_size(max(node_count, 1)))
+        else:
+            # no cluster AND no expected size: warming now would compile
+            # (and pre-register) shapes with an empty-cluster node bucket
+            # no real cycle can match — the first solve would then pay a
+            # hot-path compile AND read as a retrace. Callers defer until
+            # the informer has synced (cli.run warms lazily).
+            klog.warning("warmup skipped: no nodes synced and no "
+                         "node_count given — call again after the first "
+                         "node sync")
+            return 0
+        ds = selectors_to_device(pk.pack_selector_tables())
+        dt = (topology_to_device(pk.pack_topology_tables())
+              if _has_topo(pk.u) else None)
+        pt_all = pk.pack_pods(sample)
+        skip_prio, no_ports, no_pod_aff, no_spread = solver_gates(nt, pt_all)
+        solver = self.solver if self.solver != "exact" else "batch"
+        statics = (solver, tuple(skip_prio), no_ports, no_pod_aff,
+                   no_spread, self.pred_mask, self.per_node_cap,
+                   self.max_rounds)
+        buckets = tuple(wu.pod_buckets)
+        if not buckets:
+            # geometric x2 steps up to bucket_size(max_batch) — the
+            # largest shape ANY cycle can present. Pipelined cycles pad
+            # chunks to bucket_size(pipeline_chunk) (a power of two, so
+            # it's in this sweep), but feature batches forced monolithic
+            # (extenders, gang, nominated pods...) still pad the whole
+            # batch, so capping at the chunk bucket would leave their
+            # first cycle paying a hot-path compile
+            top = bucket_size(max(self.max_batch, 1))
+            out = []
+            b = bucket_size(max(min(wu.min_bucket, top), 1))
+            while b <= top:
+                out.append(b)
+                b *= 2
+            buckets = tuple(out)
+        has_vol_sample = any(p.volumes for p in sample)
+        compiled = 0
+        for P in buckets:
+            dp = pods_to_device(pk.pack_pods(sample[:P]), pad_to=P)
+            dv = sv = None
+            if has_vol_sample:
+                # a volume-bearing sample warms the volume-bearing solve
+                # signature real cycles will record (dv in the digest);
+                # row-table shapes scale with the batch's volume rows, so
+                # coverage is exact only when the sample is representative
+                from kubernetes_tpu.ops.arrays import volumes_to_device
+
+                dv = volumes_to_device(pk.pack_volume_tables(sample[:P]))
+                sv = _static_vol_pass(dp, dn, ds, dv)
+            self.obs.jax.record_call("solve", dp, dn, ds, dt, dv,
+                                     static=statics, warmup=True)
+            if solver == "greedy":
+                a, _u = greedy_assign(
+                    dp, dn, ds, self.weights, topo=dt, vol=dv,
+                    static_vol=sv,
+                    enabled_mask=self.pred_mask, skip_priorities=skip_prio,
+                    no_ports=no_ports, no_pod_affinity=no_pod_aff,
+                    no_spread=no_spread,
+                )
+            else:
+                out = batch_assign(
+                    dp, dn, ds, self.weights, max_rounds=self.max_rounds,
+                    per_node_cap=self.per_node_cap, topo=dt, vol=dv,
+                    static_vol=sv, enabled_mask=self.pred_mask,
+                    use_sinkhorn=(solver == "sinkhorn"),
+                    skip_priorities=skip_prio, no_ports=no_ports,
+                    no_pod_affinity=no_pod_aff, no_spread=no_spread,
+                    stats_out=self.obs.config.sinkhorn_telemetry,
+                )
+                a = out[0]
+            jax.block_until_ready(a)
+            if wu.include_filter:
+                fr = _filter_pass(dp, dn, ds, dt, dv, sv,
+                                  self.pred_mask)
+                jax.block_until_ready(fr.mask)
+            compiled += 1
+            self.metrics.warmup_compiles.inc()
+        klog.V(2).info("warmup: compiled %d bucketed solve shapes "
+                       "(nodes bucket %d)", compiled, dn.valid.shape[0])
+        return compiled
 
     def run_until_settled(self, max_cycles: int = 50) -> List[CycleResult]:
         """Drive cycles until nothing schedules (tests + sim harness)."""

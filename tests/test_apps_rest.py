@@ -317,6 +317,36 @@ def test_pod_patch_preserves_non_wire_fields_and_scopes_to_metadata():
         srv.close()
 
 
+def test_pod_patch_fk_guard_matches_exact_path_segments():
+    """ADVICE r5 (restapi.py:1902, verified already fixed — this pins
+    it): the PATCH foreign-key guard compares GUARDED names against
+    exact dotted-path segments. An unmodeled field whose name merely
+    CONTAINS a guarded token ('volumesAttached' ⊃ 'volumes',
+    'hostPorts' ⊃ 'Ports') keeps the documented lenient
+    drop-as-POST-dropped behavior (200); a genuinely guarded path
+    ('spec.tolerations') still 422s."""
+    from kubernetes_tpu.testing import make_pod
+
+    hub, srv, port = cluster()
+    try:
+        hub.create_pod(make_pod("web", cpu_milli=100))
+        code, _ = patch_req(
+            port, "/api/v1/namespaces/default/pods/web",
+            {"status": {"volumesAttached": [{"name": "pv1"}]}})
+        assert code == 200  # substring of 'volumes' — NOT guarded
+        code, _ = patch_req(
+            port, "/api/v1/namespaces/default/pods/web",
+            {"spec": {"hostPorts": [8080]}})
+        assert code == 200  # substring of 'ports' — NOT guarded
+        assert hub.truth_pods["default/web"].requests.cpu_milli == 100
+        code, doc = patch_req(
+            port, "/api/v1/namespaces/default/pods/web",
+            {"spec": {"tolerations": [{"key": "k", "operator": "Exists"}]}})
+        assert code == 422  # exact guarded segment
+    finally:
+        srv.close()
+
+
 def test_ktpu_apply_create_then_configure(tmp_path, capsys):
     """kubectl apply analog: absent -> created; present -> merge-patched
     ('configured'); a deployment apply drives a real scale + rollout."""

@@ -1,0 +1,322 @@
+"""Pipelined cycle executor + shape bucketing + AOT warmup (PR 5).
+
+Pins the executor's core contracts: placements are depth-invariant
+(chunking and the usage-chain data dependencies are identical at every
+depth >= 2; greedy chunks reproduce the monolithic serial semantics
+exactly), bucket padding never changes placements, warmed buckets keep
+``scheduler_jax_retrace_total`` flat under queue-length churn, feature
+batches that need whole-batch host coupling fall back to the monolithic
+cycle, and the new config fields round-trip through v1alpha1."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def _scheduler(n_nodes=16, cpu=4000, pods_cap=110, **kw):
+    kw.setdefault("enable_preemption", False)
+    s = Scheduler(**kw)
+    for i in range(n_nodes):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=cpu,
+                                memory=32 * 2**30, pods=pods_cap))
+    return s
+
+
+def _queue_pods(s, n, cpu=100, prefix="p"):
+    for i in range(n):
+        s.queue.add(make_pod(f"{prefix}{i}", cpu_milli=cpu,
+                             memory=256 * 2**20, priority=i % 3))
+
+
+def test_pipeline_engages_and_is_depth_invariant():
+    runs = {}
+    for depth in (2, 3, 5):
+        s = _scheduler(pipeline_depth=depth, pipeline_chunk=32)
+        _queue_pods(s, 150)
+        r = s.schedule_cycle()
+        assert r.scheduled == 150 and r.unschedulable == 0
+        assert r.pipeline_chunks == 5  # ceil(150/32)
+        runs[depth] = r.assignments
+    assert runs[2] == runs[3] == runs[5]
+
+
+def test_depth_one_is_monolithic():
+    s = _scheduler(pipeline_depth=1, pipeline_chunk=32)
+    _queue_pods(s, 150)
+    r = s.schedule_cycle()
+    assert r.scheduled == 150
+    assert r.pipeline_chunks == 0  # today's single-solve cycle
+
+
+def test_greedy_chunked_equals_monolithic_serial_semantics():
+    """The seqref-parity contract: greedy_assign IS the serial
+    scheduleOne loop (differential-pinned by tests/test_assign.py), and
+    chunked greedy must reproduce the monolithic greedy bit for bit —
+    chunks are queue-order prefixes, so the pod-at-a-time usage chain is
+    the same sequence either way."""
+    base = None
+    for depth in (1, 2):
+        s = _scheduler(solver="greedy", pipeline_depth=depth,
+                       pipeline_chunk=32)
+        _queue_pods(s, 100)
+        r = s.schedule_cycle()
+        assert r.scheduled == 100
+        if base is None:
+            base = r.assignments
+        else:
+            assert r.assignments == base
+            assert r.pipeline_chunks == 4
+
+
+def test_pipeline_contention_failures_and_explain_rows():
+    """Contended pipelined cycle: the residual pods get failure reasons,
+    FitError text, why-pending rows, and requeue — the same error path
+    the monolithic cycle feeds — and placements stay depth-invariant."""
+    runs = {}
+    for depth in (2, 4):
+        s = _scheduler(n_nodes=4, cpu=1000, pipeline_depth=depth,
+                       pipeline_chunk=16)
+        _queue_pods(s, 64, cpu=500)  # 4 nodes x 2 fit -> 8 land
+        r = s.schedule_cycle()
+        assert r.scheduled == 8 and r.unschedulable == 56
+        runs[depth] = dict(r.assignments)
+        some = next(iter(r.failure_reasons.values()))
+        assert "Insufficient cpu" in " ".join(some) or some
+        assert r.fit_errors  # FitError-shaped messages exist
+        assert "Insufficient cpu" in next(iter(r.fit_errors.values()))
+        # explain rows + cluster rollup flowed through the merged report
+        assert r.explain is not None and len(r.explain.pods) == 56
+        pe = next(iter(r.explain.pods.values()))
+        assert pe.reason_node_counts.get("PodFitsResources", 0) > 0
+        assert s.why_pending and len(s.why_pending) == 56
+        # failed pods are requeued with backoff, not lost
+        assert len(s.queue) == 56
+    assert runs[2] == runs[4]
+
+
+def test_pipeline_ineligible_features_fall_back_to_monolithic():
+    # node-search truncation needs the whole-batch host path
+    s = _scheduler(percentage_of_nodes_to_score=50, pipeline_chunk=16)
+    _queue_pods(s, 64)
+    r = s.schedule_cycle()
+    assert r.scheduled == 64 and r.pipeline_chunks == 0
+    # gang pods couple across chunks -> monolithic
+    s2 = _scheduler(pipeline_chunk=16)
+    from kubernetes_tpu.models.cluster import make_gang_pods
+
+    for p in make_gang_pods(4, 8):
+        s2.queue.add(p)
+    r2 = s2.schedule_cycle()
+    assert r2.pipeline_chunks == 0 and r2.scheduled == 32
+
+
+def test_pipeline_flight_record_carries_chunks_and_snapshot_mode():
+    s = _scheduler(pipeline_chunk=32)
+    _queue_pods(s, 100)
+    s.schedule_cycle()
+    recs = s.obs.recorder.records()
+    assert recs and recs[-1].pipeline_chunks == 4
+    assert recs[-1].snapshot_mode == "full"
+    assert s.metrics.pipeline_chunks.value() == 4
+    # pipeline spans made it into the cycle trace
+    spans = recs[-1].spans
+    assert any(k.startswith("pipeline:pack@") for k in spans)
+    assert any(k.startswith("pipeline:dispatch@") for k in spans)
+    assert any(k.startswith("pipeline:readback@") for k in spans)
+    assert any(k.startswith("pipeline:bind@") for k in spans)
+
+
+def test_bucket_padding_never_changes_placements():
+    """Padding the pod axis to a LARGER bucket (what AOT warmup and the
+    fixed chunk shape rely on) must not change a single placement:
+    padded rows are invalid and every predicate rejects them."""
+    from kubernetes_tpu.ops.arrays import (
+        nodes_to_device,
+        pods_to_device,
+        selectors_to_device,
+    )
+    from kubernetes_tpu.ops.assign import batch_assign
+    from kubernetes_tpu.snapshot import SnapshotPacker
+
+    nodes = [make_node(f"n{i}", cpu_milli=4000, memory=32 * 2**30)
+             for i in range(8)]
+    pods = [make_pod(f"p{i}", cpu_milli=300, memory=256 * 2**20,
+                     priority=i % 4) for i in range(50)]
+    pk = SnapshotPacker()
+    for p in pods:
+        pk.intern_pod(p)
+    nt = pk.pack_nodes(nodes, [])
+    dn = nodes_to_device(nt)
+    ds = selectors_to_device(pk.pack_selector_tables())
+    pt = pk.pack_pods(pods)
+    outs = []
+    for pad in (64, 128, 512):
+        dp = pods_to_device(pt, pad_to=pad)
+        a, _u, _r = batch_assign(dp, dn, ds, per_node_cap=4)
+        outs.append(np.asarray(a)[: len(pods)])
+    assert np.array_equal(outs[0], outs[1])
+    assert np.array_equal(outs[0], outs[2])
+
+
+def test_warmup_pins_retraces_flat_under_queue_churn():
+    """The first-compile fix: warm the bucket set once, then cycles at
+    queue lengths crossing bucket boundaries classify as jit-cache HITS
+    at the solve site — scheduler_jax_retrace_total stays flat."""
+    from kubernetes_tpu.config import WarmupConfig
+
+    s = _scheduler(warmup=WarmupConfig(enabled=True, min_bucket=64),
+                   max_batch=256, pipeline_depth=1)
+    sample = [make_pod("warm0", cpu_milli=100, memory=256 * 2**20)]
+    compiled = s.warmup(sample_pods=sample)
+    assert compiled == 3  # buckets 64, 128, 256
+    assert s.metrics.warmup_compiles.value() == 3
+    for i, n in enumerate((60, 200, 40)):  # buckets 64, 256, 64
+        _queue_pods(s, n, prefix=f"c{i}-")
+        r = s.schedule_cycle()
+        assert r.scheduled == n
+    sites = s.obs.jax.snapshot()["sites"]["solve"]
+    assert sites["retraces"] == 0
+    assert s.obs.jax.retrace_total() == 0
+    # every post-warmup solve was a signature hit, not a compile
+    assert sites["compiles"] == 3 and sites["hits"] >= 3
+
+
+def test_warmup_respects_explicit_buckets():
+    from kubernetes_tpu.config import WarmupConfig
+
+    s = _scheduler(warmup=WarmupConfig(enabled=True, pod_buckets=(32,)))
+    assert s.warmup() == 1
+
+
+def test_warmup_covers_volume_bearing_solve_signature():
+    """Review finding (r6): a volume-bearing sample must warm the
+    volume-bearing solve signature (dv rides the telemetry digest) —
+    otherwise the first PVC batch pays a hot-path compile counted as a
+    retrace."""
+    from kubernetes_tpu.config import WarmupConfig
+    from kubernetes_tpu.models.cluster import make_pv_pods
+
+    pods, pvcs, pvs = make_pv_pods(12, kind="gce-pd")  # bucket 16
+    s = _scheduler(n_nodes=8, warmup=WarmupConfig(enabled=True,
+                                                  pod_buckets=(16,)))
+    s.set_volume_state(pvcs, pvs)
+    assert s.warmup(sample_pods=pods) == 1
+    for p in pods:
+        s.queue.add(p)
+    r = s.schedule_cycle()
+    assert r.scheduled == 12
+    sites = s.obs.jax.snapshot()["sites"]["solve"]
+    assert sites["retraces"] == 0 and sites["hits"] >= 1
+
+
+def test_warmup_noops_without_nodes_or_node_count():
+    """Warming an empty cluster would register empty-bucket node shapes
+    no real cycle can match (the first solve would then read as a
+    retrace) — it must defer instead (cli.run warms lazily after the
+    first node sync)."""
+    from kubernetes_tpu.config import WarmupConfig
+    from kubernetes_tpu.scheduler import Scheduler
+
+    s = Scheduler(enable_preemption=False,
+                  warmup=WarmupConfig(enabled=True, pod_buckets=(16,)))
+    assert s.warmup() == 0
+    assert s.metrics.warmup_compiles.value() == 0
+
+
+def test_new_config_fields_roundtrip_v1alpha1():
+    from kubernetes_tpu.api.config_v1alpha1 import decode, encode
+    from kubernetes_tpu.config import KubeSchedulerConfiguration
+
+    doc = {
+        "apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
+        "kind": "KubeSchedulerConfiguration",
+        "pipelineDepth": 3,
+        "pipelineChunk": 1024,
+        "deviceResidentSnapshot": False,
+        "snapshotMaxDirtyFrac": 0.5,
+        "warmup": {"enabled": True, "podBuckets": [128, 512],
+                   "minBucket": 64, "includeFilter": False},
+    }
+    cfg = decode(doc)
+    assert cfg.pipeline_depth == 3
+    assert cfg.pipeline_chunk == 1024
+    assert cfg.device_resident_snapshot is False
+    assert cfg.snapshot_max_dirty_frac == 0.5
+    assert cfg.warmup.enabled and cfg.warmup.pod_buckets == (128, 512)
+    assert cfg.warmup.min_bucket == 64 and not cfg.warmup.include_filter
+    back = encode(cfg)
+    assert back["pipelineDepth"] == 3
+    assert back["warmup"]["podBuckets"] == [128, 512]
+    # defaults land when the block is absent
+    d2 = decode({"apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
+                 "kind": "KubeSchedulerConfiguration"})
+    assert d2.pipeline_depth == 2 and d2.pipeline_chunk == 4096
+    assert d2.device_resident_snapshot is True
+    assert d2.warmup.enabled is False
+
+
+def test_validate_config_gates_new_fields():
+    from kubernetes_tpu.cli import validate_config
+    from kubernetes_tpu.config import (
+        KubeSchedulerConfiguration,
+        WarmupConfig,
+    )
+
+    bad = KubeSchedulerConfiguration(
+        pipeline_depth=0, pipeline_chunk=0, snapshot_max_dirty_frac=1.5,
+        warmup=WarmupConfig(min_bucket=0, pod_buckets=(0,)),
+    )
+    errs = "\n".join(validate_config(bad))
+    for needle in ("pipelineDepth", "pipelineChunk",
+                   "snapshotMaxDirtyFrac", "warmup.minBucket",
+                   "warmup.podBuckets"):
+        assert needle in errs
+    # native snake_case file decode accepts the new block
+    from kubernetes_tpu.cli import decode_config
+
+    cfg = decode_config({"pipeline_depth": 4,
+                         "warmup": {"enabled": True,
+                                    "pod_buckets": [64]}})
+    assert cfg.pipeline_depth == 4 and cfg.warmup.pod_buckets == (64,)
+
+
+def test_bench_compare_retrace_and_pack_gates():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "bench_compare.py"))
+    bc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bc)
+
+    def rec(pps, pack_s, retraces):
+        return {
+            "value": pps,
+            "extras": {
+                "headline": {"pods_per_sec": pps, "pack_s": pack_s,
+                             "jax": {"retraces": retraces},
+                             "latency_s": {"p99": 0.1}},
+                "variants": {
+                    "base/1000x1000": {"pods_per_sec": pps,
+                                       "pack_s": pack_s,
+                                       "jax": {"retraces": retraces}},
+                },
+            },
+        }
+
+    # warm record with zero retraces and flat pack -> ok
+    v = bc.compare(rec(10000, 0.05, 0), rec(10500, 0.04, 0), 0.10, 0.03)
+    assert not v["regressions"]
+    # retraces on the new record's warm run -> regression
+    v = bc.compare(rec(10000, 0.05, 0), rec(10500, 0.04, 2), 0.10, 0.03)
+    assert any("retraces" in r["check"] for r in v["regressions"])
+    # pack_s growing 3x past the floor -> regression
+    v = bc.compare(rec(10000, 0.02, 0), rec(10000, 0.06, 0), 0.10, 0.03)
+    assert any(r["check"].endswith("pack_s") for r in v["regressions"])
+    # both sides under the noise floor -> exempt
+    v = bc.compare(rec(10000, 0.001, 0), rec(10000, 0.004, 0), 0.10, 0.03)
+    assert not any(r["check"].endswith("pack_s") for r in v["regressions"])
