@@ -157,6 +157,25 @@ class WarmupConfigurationV1alpha1:
 
 
 @dataclass
+class ServingConfigurationV1alpha1:
+    """Versioned spelling of the streaming-serving block
+    (config.ServingConfig): camelCase, windows as metav1.Duration
+    strings like every other versioned time field."""
+
+    enabled: Optional[bool] = None
+    minWait: Optional[str] = None
+    maxWait: Optional[str] = None
+    targetBucket: Optional[int] = None
+    idleWait: Optional[str] = None
+    flowConcurrency: Optional[int] = None
+    watchConcurrency: Optional[int] = None
+    flowQueueLength: Optional[int] = None
+    queueTimeout: Optional[str] = None
+    retryAfter: Optional[str] = None
+    watchBuffer: Optional[int] = None
+
+
+@dataclass
 class KubeSchedulerConfigurationV1alpha1:
     schedulerName: Optional[str] = None
     algorithmSource: "SchedulerAlgorithmSource" = field(
@@ -190,6 +209,8 @@ class KubeSchedulerConfigurationV1alpha1:
         default_factory=RobustnessConfigurationV1alpha1)
     observability: "ObservabilityConfigurationV1alpha1" = field(
         default_factory=ObservabilityConfigurationV1alpha1)
+    serving: "ServingConfigurationV1alpha1" = field(
+        default_factory=ServingConfigurationV1alpha1)
 
 
 # -- defaulting (v1alpha1/defaults.go:42) -----------------------------------
@@ -293,6 +314,29 @@ def set_defaults_kube_scheduler_configuration(
         ob.explain = True
     if ob.explainTopK is None:
         ob.explainTopK = 3
+    sv = obj.serving
+    if sv.enabled is None:
+        sv.enabled = False
+    if sv.minWait is None:
+        sv.minWait = "5ms"
+    if sv.maxWait is None:
+        sv.maxWait = "50ms"
+    if sv.targetBucket is None:
+        sv.targetBucket = 1024
+    if sv.idleWait is None:
+        sv.idleWait = "500ms"
+    if sv.flowConcurrency is None:
+        sv.flowConcurrency = 16
+    if sv.watchConcurrency is None:
+        sv.watchConcurrency = 8
+    if sv.flowQueueLength is None:
+        sv.flowQueueLength = 64
+    if sv.queueTimeout is None:
+        sv.queueTimeout = "1s"
+    if sv.retryAfter is None:
+        sv.retryAfter = "1s"
+    if sv.watchBuffer is None:
+        sv.watchBuffer = 4096
     return obj
 
 
@@ -399,6 +443,25 @@ def _to_internal(v: KubeSchedulerConfigurationV1alpha1) -> KubeSchedulerConfigur
         warmup=_warmup_to_internal(v.warmup),
         robustness=_robustness_to_internal(v.robustness),
         observability=_observability_to_internal(v.observability),
+        serving=_serving_to_internal(v.serving),
+    )
+
+
+def _serving_to_internal(sv: ServingConfigurationV1alpha1):
+    from kubernetes_tpu.config import ServingConfig
+
+    return ServingConfig(
+        enabled=sv.enabled,
+        min_wait_s=_dur("minWait", sv.minWait, "serving"),
+        max_wait_s=_dur("maxWait", sv.maxWait, "serving"),
+        target_bucket=sv.targetBucket,
+        idle_wait_s=_dur("idleWait", sv.idleWait, "serving"),
+        flow_concurrency=sv.flowConcurrency,
+        watch_concurrency=sv.watchConcurrency,
+        flow_queue_length=sv.flowQueueLength,
+        queue_timeout_s=_dur("queueTimeout", sv.queueTimeout, "serving"),
+        retry_after_s=_dur("retryAfter", sv.retryAfter, "serving"),
+        watch_buffer=sv.watchBuffer,
     )
 
 
@@ -534,6 +597,19 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
             sinkhornTelemetry=c.observability.sinkhorn_telemetry,
             explain=c.observability.explain,
             explainTopK=c.observability.explain_top_k,
+        ),
+        serving=ServingConfigurationV1alpha1(
+            enabled=c.serving.enabled,
+            minWait=format_duration(c.serving.min_wait_s),
+            maxWait=format_duration(c.serving.max_wait_s),
+            targetBucket=c.serving.target_bucket,
+            idleWait=format_duration(c.serving.idle_wait_s),
+            flowConcurrency=c.serving.flow_concurrency,
+            watchConcurrency=c.serving.watch_concurrency,
+            flowQueueLength=c.serving.flow_queue_length,
+            queueTimeout=format_duration(c.serving.queue_timeout_s),
+            retryAfter=format_duration(c.serving.retry_after_s),
+            watchBuffer=c.serving.watch_buffer,
         ),
     )
 

@@ -34,6 +34,7 @@ from kubernetes_tpu.config import (
     LeaderElectionConfig,
     ObservabilityConfig,
     RobustnessConfig,
+    ServingConfig,
     WarmupConfig,
     load_policy,
 )
@@ -160,6 +161,27 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         errs.append("observability.retraceStormWindow: must be at least 1")
     if oc.explain_top_k < 1:
         errs.append("observability.explainTopK: must be at least 1")
+    sc = cfg.serving
+    if sc.min_wait_s < 0:
+        errs.append("serving.minWait: must be non-negative")
+    if sc.max_wait_s < sc.min_wait_s:
+        errs.append("serving.maxWait: must be at least minWait")
+    if sc.target_bucket < 1:
+        errs.append("serving.targetBucket: must be at least 1")
+    if sc.idle_wait_s <= 0:
+        errs.append("serving.idleWait: must be greater than zero")
+    if sc.flow_concurrency < 1:
+        errs.append("serving.flowConcurrency: must be at least 1")
+    if sc.watch_concurrency < 1:
+        errs.append("serving.watchConcurrency: must be at least 1")
+    if sc.flow_queue_length < 0:
+        errs.append("serving.flowQueueLength: must be non-negative")
+    if sc.queue_timeout_s < 0:
+        errs.append("serving.queueTimeout: must be non-negative")
+    if sc.retry_after_s <= 0:
+        errs.append("serving.retryAfter: must be greater than zero")
+    if sc.watch_buffer < 1:
+        errs.append("serving.watchBuffer: must be at least 1")
     # unknown feature gates are rejected earlier, at FeatureGates
     # construction (featuregate.Set errors on unknown names)
     return errs
@@ -170,6 +192,7 @@ _LE_FIELDS = {f.name for f in dataclasses.fields(LeaderElectionConfig)}
 _ROB_FIELDS = {f.name for f in dataclasses.fields(RobustnessConfig)}
 _OBS_FIELDS = {f.name for f in dataclasses.fields(ObservabilityConfig)}
 _WARMUP_FIELDS = {f.name for f in dataclasses.fields(WarmupConfig)}
+_SERVING_FIELDS = {f.name for f in dataclasses.fields(ServingConfig)}
 
 
 def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
@@ -257,6 +280,15 @@ def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
             if "pod_buckets" in wkw:
                 wkw["pod_buckets"] = tuple(wkw["pod_buckets"])
             kw["warmup"] = WarmupConfig(**wkw)
+        elif key == "serving":
+            if not isinstance(val, dict):
+                errs.append("serving: expected a mapping")
+                continue
+            unknown = set(val) - _SERVING_FIELDS
+            if unknown:
+                errs.append(f"serving: unknown field(s) {sorted(unknown)}")
+                continue
+            kw["serving"] = ServingConfig(**val)
         elif key == "policy":
             kw["policy"] = load_policy(val)
         elif key in _CONFIG_FIELDS:
@@ -335,7 +367,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--version", action="store_true",
                    help="print version info and exit (pkg/version analog)")
     p.add_argument("--cycle-interval", type=float, default=0.25,
-                   help="seconds between scheduling cycles when idle")
+                   help="seconds between scheduling cycles when idle "
+                        "(legacy mode; --serving replaces the timer "
+                        "with wake-on-event)")
+    p.add_argument("--serving", default=None, choices=("true", "false"),
+                   help="event-driven micro-batch serving loop "
+                        "(doorbell + accumulation window) instead of "
+                        "the fixed-interval cycle timer")
+    p.add_argument("--serving-max-wait", type=float, default=None,
+                   help="micro-batch window latency ceiling, seconds")
     return p
 
 
@@ -361,6 +401,14 @@ def resolve_config(args) -> KubeSchedulerConfiguration:
     if args.warmup is not None:
         overlay["warmup"] = dataclasses.replace(
             cfg.warmup, enabled=args.warmup == "true")
+    serving_overlay = {}
+    if getattr(args, "serving", None) is not None:
+        serving_overlay["enabled"] = args.serving == "true"
+    if getattr(args, "serving_max_wait", None) is not None:
+        serving_overlay["max_wait_s"] = args.serving_max_wait
+    if serving_overlay:
+        overlay["serving"] = dataclasses.replace(
+            cfg.serving, **serving_overlay)
     if args.percentage_of_nodes_to_score is not None:
         overlay["percentage_of_nodes_to_score"] = args.percentage_of_nodes_to_score
     if args.leader_elect is not None:
@@ -394,7 +442,27 @@ def run(cfg: KubeSchedulerConfiguration, args, stop_event=None) -> None:
     from kubernetes_tpu.server import serve_scheduler
 
     sched = Scheduler.from_config(cfg)
-    srv = serve_scheduler(sched, host=args.bind_address, port=args.port)
+    fairness = None
+    if cfg.serving.enabled:
+        # serving mode installs the APF-style filter on the component's
+        # own HTTP surface: extender POSTs classify mutating and shed
+        # with 429 + Retry-After under the configured seats/queues,
+        # while healthz/metrics/debug stay exempt
+        from kubernetes_tpu.serving.fairness import (
+            FlowController,
+            default_flows,
+        )
+
+        fairness = FlowController(
+            flows=default_flows(
+                concurrency=cfg.serving.flow_concurrency,
+                queue_length=cfg.serving.flow_queue_length,
+                watch_concurrency=cfg.serving.watch_concurrency,
+                queue_timeout_s=cfg.serving.queue_timeout_s),
+            retry_after_s=cfg.serving.retry_after_s,
+            metrics=sched.metrics)
+    srv = serve_scheduler(sched, host=args.bind_address, port=args.port,
+                          fairness=fairness)
     host, port = srv.server_address[:2]
     print(f"serving healthz/metrics on {host}:{port}", file=sys.stderr)
 
@@ -403,8 +471,14 @@ def run(cfg: KubeSchedulerConfiguration, args, stop_event=None) -> None:
     def _sig(_s, _f):
         stop.set()
 
-    signal.signal(signal.SIGTERM, _sig)
-    signal.signal(signal.SIGINT, _sig)
+    try:
+        signal.signal(signal.SIGTERM, _sig)
+        signal.signal(signal.SIGINT, _sig)
+    except ValueError:
+        # signal handlers can only be installed on the main thread; an
+        # embedded run (tests, a host process driving the loop on a
+        # worker thread) relies on stop_event instead
+        pass
 
     elector = None
     if cfg.leader_election.leader_elect:
@@ -419,21 +493,59 @@ def run(cfg: KubeSchedulerConfiguration, args, stop_event=None) -> None:
     #: real cycle will ever match (the compile would land on the first
     #: pod's critical path anyway, the exact latency the flag removes)
     warmup_pending = cfg.warmup.enabled
+    from kubernetes_tpu.serving import Doorbell
+
+    # both modes carry the doorbell: the serving loop blocks on it, and
+    # the legacy loop uses it to tell "idle" from "work arrived while I
+    # was solving" (the empty-queue skip below)
+    bell = sched.attach_doorbell(Doorbell())
+    if (cfg.serving.enabled and cfg.warmup.enabled
+            and not cfg.warmup.pod_buckets):
+        # the streaming path presents SMALL buckets (micro-batches pad
+        # to bucket_size(depth), floor 8); the batch-mode default
+        # min_bucket=256 would leave them unwarmed and every trickle
+        # cycle under churn would retrace — extend the warmed grid down
+        sched.warmup_config = dataclasses.replace(cfg.warmup, min_bucket=8)
+
+    def gate() -> bool:
+        """Per-iteration admission for both loops: leader election
+        (a non-leader keeps serving healthz and ticking the elector)
+        and the lazy AOT warmup."""
+        nonlocal warmup_pending
+        if elector is not None and not elector.tick():
+            stop.wait(cfg.leader_election.retry_period_s)
+            return False
+        if warmup_pending and sched.cache.node_count():
+            pp = getattr(sched.queue, "pending_pods", None)
+            sample = pp().get("active", [])[:64] if pp else []
+            n = sched.warmup(sample_pods=sample)
+            print(f"warmup: compiled {n} bucketed solve shapes",
+                  file=sys.stderr)
+            warmup_pending = False
+        return True
+
     try:
-        while not stop.is_set():
-            if elector is not None and not elector.tick():
-                stop.wait(cfg.leader_election.retry_period_s)
-                continue
-            if warmup_pending and sched.cache.node_count():
-                pp = getattr(sched.queue, "pending_pods", None)
-                sample = pp().get("active", [])[:64] if pp else []
-                n = sched.warmup(sample_pods=sample)
-                print(f"warmup: compiled {n} bucketed solve shapes",
-                      file=sys.stderr)
-                warmup_pending = False
-            r = sched.schedule_cycle()
-            if r.attempted == 0:
-                stop.wait(args.cycle_interval)
+        if cfg.serving.enabled:
+            from kubernetes_tpu.serving import ServingLoop
+
+            ServingLoop(sched, bell, cfg.serving).run(stop, gate=gate)
+        else:
+            while not stop.is_set():
+                if not gate():
+                    continue
+                # idle fast path: an empty activeQ with no doorbell
+                # activity since the last look means a solve could only
+                # be empty — skip it (no trace, no CycleRecord, no
+                # metrics churn) and run queue maintenance instead, so
+                # long informer gaps stop minting empty cycle artifacts
+                if (sched.queue.pending_counts().get("active", 0) == 0
+                        and not bell.consume()):
+                    sched.idle_tick()
+                    stop.wait(args.cycle_interval)
+                    continue
+                r = sched.schedule_cycle()
+                if r.attempted == 0:
+                    stop.wait(args.cycle_interval)
     finally:
         srv.shutdown()
 

@@ -207,6 +207,44 @@ class WarmupConfig:
 
 
 @dataclass
+class ServingConfig:
+    """Streaming serving mode (kubernetes_tpu/serving): the event-driven
+    micro-batch loop that replaces the fixed ``--cycle-interval`` sleep,
+    plus the APF-style load-shedding knobs for the REST facades. All
+    windows are seconds; the accumulation targets snap to the same
+    power-of-two bucket grid the AOT warmup compiles, so steady-state
+    churn never retraces."""
+
+    #: run the event-driven serving loop instead of the fixed-interval
+    #: legacy loop in cli.run
+    enabled: bool = False
+    #: shortest accumulation after the first pending pod — the burst-
+    #: coalescing debounce (a bucket-fill may still flush at min_wait)
+    min_wait_s: float = 0.005
+    #: latency ceiling: the window always flushes by max_wait
+    max_wait_s: float = 0.05
+    #: accumulation cap in pods, snapped DOWN to a warmed bucket; the
+    #: window flushes immediately at this depth
+    target_bucket: int = 1024
+    #: doorbell park time while the queue is idle (each timeout runs
+    #: one idle_tick so backoff flushes still happen)
+    idle_wait_s: float = 0.5
+    #: APF-style per-flow seats (readonly/mutating flows)
+    flow_concurrency: int = 16
+    #: seats for the watch flow (fan-out is the expensive class)
+    watch_concurrency: int = 8
+    #: bounded FIFO of waiters per flow; full queue -> 429
+    flow_queue_length: int = 64
+    #: longest a queued request waits for a seat before shedding
+    queue_timeout_s: float = 1.0
+    #: Retry-After answered on 429s
+    retry_after_s: float = 1.0
+    #: per-watcher send-buffer bound: a watcher this far behind is
+    #: disconnected with 410 Gone (relist) instead of stalling the hub
+    watch_buffer: int = 4096
+
+
+@dataclass
 class KubeSchedulerConfiguration:
     """The typed component config. Reference fields keep their meanings;
     the ``solver``/``per_node_cap``/``max_batch`` block is this
@@ -265,6 +303,9 @@ class KubeSchedulerConfiguration:
     #: cycle tracing / JAX telemetry / flight-recorder knobs
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
+    #: streaming serving mode (event-driven micro-batch loop + APF-style
+    #: load shedding)
+    serving: ServingConfig = field(default_factory=ServingConfig)
 
 
 # ---------------------------------------------------------------------------

@@ -21,7 +21,16 @@ queue-observability block —
 why-pending reduction, ``obs/explain.py``),
 ``scheduler_queue_pod_age_seconds{queue}`` sub-queue residency
 histograms, the ``scheduler_pod_scheduling_attempts`` histogram, and
-``scheduler_queue_incoming_pods_total{event}`` queue-event counters.
+``scheduler_queue_incoming_pods_total{event}`` queue-event counters; plus
+the streaming-serving block (``kubernetes_tpu/serving``) —
+``scheduler_doorbell_rings_total{reason}``,
+``scheduler_microbatch_flushes_total{trigger}`` /
+``scheduler_microbatch_window_seconds``,
+``scheduler_flowcontrol_{rejected_requests_total,current_inflight_requests}``,
+and ``scheduler_watch_evictions_total``. Note
+``scheduler_e2e_scheduling_duration_seconds`` observes PER-POD
+create-to-bind latency (queue-add stamp to bind) since the serving PR,
+matching the reference's per-pod scheduleOne observation.
 
 Implementation is a small text-exposition registry (no client library in
 the image); histograms use the reference's bucket layouts. Exposition
@@ -400,6 +409,46 @@ class SchedulerMetrics:
             "reason — how many node exclusions each constraint class "
             "caused across the residual queue.",
             ["reason"],
+        ))
+        # -- streaming serving mode (kubernetes_tpu/serving): doorbell,
+        # micro-batch window, APF-style load shedding, watch fan-out ----
+        self.doorbell_rings = r.register(Counter(
+            "scheduler_doorbell_rings_total",
+            "Doorbell rings by source (queue events, informer sweeps, "
+            "REST mutations) — what wakes the event-driven serving loop "
+            "instead of a fixed-interval timer.",
+            ["reason"],
+        ))
+        self.microbatch_flushes = r.register(Counter(
+            "scheduler_microbatch_flushes_total",
+            "Micro-batch window flushes by trigger (bucket-fill = the "
+            "accumulated depth hit a warmed power-of-two bucket; "
+            "max-wait = the latency ceiling expired).",
+            ["trigger"],
+        ))
+        self.microbatch_window = r.register(Histogram(
+            "scheduler_microbatch_window_seconds",
+            "How long the serving loop's accumulation window held "
+            "before flushing into a cycle.",
+            buckets=exponential_buckets(0.001, 2, 12),
+        ))
+        self.apf_rejected = r.register(Counter(
+            "scheduler_flowcontrol_rejected_requests_total",
+            "Requests shed by the APF-style flow controller (answered "
+            "429 + Retry-After), by flow and shed reason (queue-full, "
+            "timeout, saturated).",
+            ["flow", "reason"],
+        ))
+        self.apf_inflight = r.register(Gauge(
+            "scheduler_flowcontrol_current_inflight_requests",
+            "Requests currently holding a seat per flow schema.",
+            ["flow"],
+        ))
+        self.watch_evictions = r.register(Counter(
+            "scheduler_watch_evictions_total",
+            "Watchers disconnected (410 Gone -> relist) because their "
+            "bounded send buffer overflowed — slow consumers are cut "
+            "loose instead of stalling the fan-out hub.",
         ))
         # -- queue observability (scheduler_queue.go metrics parity) ----
         self.queue_pod_age = r.register(Histogram(

@@ -130,6 +130,14 @@ class Observability:
         self._scratch["snapshot_mode"] = mode
         self._scratch["snapshot_rows"] = rows
 
+    def note_microbatch(self, trigger: str, window_s: float) -> None:
+        """The serving loop's micro-batch provenance for this cycle:
+        what flushed the accumulation window (bucket-fill | max-wait)
+        and how long it held — so a latency incident in the flight
+        record separates window time from solve time."""
+        self._scratch["flush_trigger"] = trigger
+        self._scratch["window_s"] = window_s
+
     def note_sinkhorn(self, stats) -> None:
         """Stash the solver's (iters, residual) device pair; read back
         once at end_cycle (the cycle's host boundary)."""
@@ -207,6 +215,8 @@ class Observability:
             snapshot_rows=s.get("snapshot_rows", 0),
             pipeline_chunks=(getattr(res, "pipeline_chunks", 0)
                              if res is not None else 0),
+            flush_trigger=s.get("flush_trigger", ""),
+            window_s=s.get("window_s", 0.0),
         )
         self.recorder.record(rec)
         self._eventful_seq += 1

@@ -57,6 +57,11 @@ class CycleRecord:
     snapshot_rows: int = 0
     #: sub-batches the pipelined executor ran (0 = monolithic cycle)
     pipeline_chunks: int = 0
+    #: what flushed the serving loop's micro-batch window into this
+    #: cycle ("bucket-fill" | "max-wait"; "" = not a serving cycle) and
+    #: how long the window accumulated before flushing
+    flush_trigger: str = ""
+    window_s: float = 0.0
 
     def to_json(self) -> dict:
         return {
@@ -84,6 +89,9 @@ class CycleRecord:
                if self.snapshot_mode else {}),
             **({"pipeline_chunks": self.pipeline_chunks}
                if self.pipeline_chunks else {}),
+            **({"microbatch": {"trigger": self.flush_trigger,
+                               "window_s": round(self.window_s, 6)}}
+               if self.flush_trigger else {}),
         }
 
 
@@ -153,6 +161,9 @@ class FlightRecorder:
                 flags.append(f"snap={r.snapshot_mode}:{r.snapshot_rows}")
             if r.pipeline_chunks:
                 flags.append(f"chunks={r.pipeline_chunks}")
+            if r.flush_trigger:
+                flags.append(
+                    f"win={r.flush_trigger}:{r.window_s*1000:.1f}ms")
             spans = " ".join(
                 f"{k}={v*1000:.1f}ms" for k, v in sorted(r.spans.items()))
             lines.append(

@@ -174,6 +174,14 @@ class SchedulingQueue:
         self.metrics = metrics
         #: key -> (sub-queue, enter time) for residency accounting
         self._entered: Dict[str, Tuple[str, float]] = {}
+        #: optional serving.Doorbell — rung on every incoming event that
+        #: ADDS schedulable work (PodAdd/PodUpdate/BackoffComplete/
+        #: the move-to-active sweeps). ScheduleAttemptFailure does not
+        #: ring: it is the scheduler's own output, and ringing on it
+        #: would spin the serving loop against pods no cluster event
+        #: has made schedulable. The scheduler attaches it
+        #: (Scheduler.attach_doorbell); standalone queues stay silent.
+        self.doorbell = None
 
     # -- metrics plumbing --------------------------------------------------
 
@@ -198,6 +206,9 @@ class SchedulingQueue:
                 max(self.clock() - t, 0.0), queue=q)
 
     def _incoming(self, event: str, n: int = 1) -> None:
+        if n and self.doorbell is not None \
+                and event != "ScheduleAttemptFailure":
+            self.doorbell.ring(f"queue:{event}")
         if self.metrics is not None and n:
             self.metrics.queue_incoming_pods.inc(n, event=event)
 

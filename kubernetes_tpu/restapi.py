@@ -541,18 +541,46 @@ class RestServer:
     #: compaction for a while; beyond it they get 410 and relist)
     WATCH_WINDOW = 2000
 
+    #: per-watcher send-buffer bound: more events than this pending for
+    #: one poll-watcher means it fell too far behind — it is answered
+    #: 410 Gone (relist) instead of the hub serializing an unbounded
+    #: drain under its lock (serving/fairness.py WatchHub semantics,
+    #: adapted to the stateless poll-watch)
+    WATCH_MAX_DRAIN = 4096
+
     def __init__(self, hub: HollowCluster, host: str = "127.0.0.1",
                  port: int = 0, audit: "AuditLog | None" = None,
-                 authn=None, authz=None) -> None:
+                 authn=None, authz=None, fairness=None,
+                 watch_max_drain: "int | None" = None,
+                 metrics=None) -> None:
         """``authn``/``authz`` install the reference's request filter
         chain in its order — authentication, then authorization, then
         the handler (admission runs inside create paths), per
         DefaultBuildHandlerChain (apiserver pkg/server/config.go:639).
         ``authn=None`` (default) keeps the facade open — the reference's
         --anonymous-auth + AlwaysAllow development posture. ``authz``
-        defaults to AlwaysAllow when only ``authn`` is given."""
+        defaults to AlwaysAllow when only ``authn`` is given.
+
+        ``fairness`` (a serving.fairness.FlowController) installs the
+        APF-style admission filter AHEAD of the chain: requests are
+        classified into flow schemas (exempt/watch/readonly/mutating),
+        seats are bounded per flow with a bounded FIFO of waiters, and
+        overload answers 429 TooManyRequests + Retry-After instead of
+        piling up handler threads (the reference's priority-and-fairness
+        filter position, config.go WithPriorityAndFairness)."""
         self.hub = hub
         self.audit = audit
+        self.fairness = fairness
+        self.watch_max_drain = (self.WATCH_MAX_DRAIN
+                                if watch_max_drain is None
+                                else int(watch_max_drain))
+        #: watchers answered 410 for falling behind the drain bound
+        self.watch_evictions = 0
+        #: optional SchedulerMetrics — drives
+        #: scheduler_watch_evictions_total (falls back to the fairness
+        #: controller's attached metrics so one wiring covers both)
+        self.metrics = metrics if metrics is not None else getattr(
+            fairness, "metrics", None)
         if authz is not None and authn is None:
             # an authorizer without an authenticator would silently
             # enforce NOTHING (no identity to authorize) — refuse the
@@ -603,17 +631,23 @@ class RestServer:
                     except OSError:
                         pass
 
-            def _send_raw(self, code: int, ctype: str, body: bytes) -> None:
+            def _send_raw(self, code: int, ctype: str, body: bytes,
+                          headers=None) -> None:
                 self._code = code  # for the audit trail
                 self._drain_body()
                 if getattr(self, "_buffer_mode", False):
                     # built under the hub lock, WRITTEN outside it — a
                     # slow client must never wedge the hub on socket I/O
-                    self._buffered = (code, ctype, body)
+                    self._buffered = (code, ctype, body, headers)
                     return
+                self._write_response(code, ctype, body, headers)
+
+            def _write_response(self, code, ctype, body, headers) -> None:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -621,25 +655,23 @@ class RestServer:
                 buffered, self._buffered = getattr(self, "_buffered", None), None
                 self._buffer_mode = False
                 if buffered is not None:
-                    code, ctype, body = buffered
-                    self.send_response(code)
-                    self.send_header("Content-Type", ctype)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._write_response(*buffered)
 
-            def _respond(self, code: int, doc) -> None:
+            def _respond(self, code: int, doc, headers=None) -> None:
                 self._send_raw(code, "application/json",
-                               json.dumps(doc).encode())
+                               json.dumps(doc).encode(), headers)
 
-            def _fail(self, code: int, reason: str, message: str) -> None:
-                self._respond(code, status_doc(code, reason, message))
+            def _fail(self, code: int, reason: str, message: str,
+                      headers=None) -> None:
+                self._respond(code, status_doc(code, reason, message),
+                              headers)
 
             def do_GET(self):
                 outer._begin(self)
                 t0 = time.perf_counter()
+                seat = outer._admit(self, "GET")
                 try:
-                    if not outer._auth(self, "GET"):
+                    if seat is None or not outer._auth(self, "GET"):
                         return
                     # reads hold the same lock as mutations (and as
                     # hub.step()): a list comprehension over a hub dict
@@ -651,50 +683,59 @@ class RestServer:
                         outer._get(self)
                     self._flush_buffered()
                 finally:
+                    outer._release(seat)
                     outer._record_audit(self, "get", t0)
 
             def do_POST(self):
                 outer._begin(self)
                 t0 = time.perf_counter()
+                seat = outer._admit(self, "POST")
                 try:
-                    if not outer._auth(self, "POST"):
+                    if seat is None or not outer._auth(self, "POST"):
                         return
                     with outer._lock:
                         outer._post(self)
                 finally:
+                    outer._release(seat)
                     outer._record_audit(self, "create", t0)
 
             def do_PUT(self):
                 outer._begin(self)
                 t0 = time.perf_counter()
+                seat = outer._admit(self, "PUT")
                 try:
-                    if not outer._auth(self, "PUT"):
+                    if seat is None or not outer._auth(self, "PUT"):
                         return
                     with outer._lock:
                         outer._put(self)
                 finally:
+                    outer._release(seat)
                     outer._record_audit(self, "update", t0)
 
             def do_DELETE(self):
                 outer._begin(self)
                 t0 = time.perf_counter()
+                seat = outer._admit(self, "DELETE")
                 try:
-                    if not outer._auth(self, "DELETE"):
+                    if seat is None or not outer._auth(self, "DELETE"):
                         return
                     with outer._lock:
                         outer._delete(self)
                 finally:
+                    outer._release(seat)
                     outer._record_audit(self, "delete", t0)
 
             def do_PATCH(self):
                 outer._begin(self)
                 t0 = time.perf_counter()
+                seat = outer._admit(self, "PATCH")
                 try:
-                    if not outer._auth(self, "PATCH"):
+                    if seat is None or not outer._auth(self, "PATCH"):
                         return
                     with outer._lock:
                         outer._patch(self)
                 finally:
+                    outer._release(seat)
                     outer._record_audit(self, "patch", t0)
 
         self._closed = False
@@ -721,10 +762,49 @@ class RestServer:
         return self.port
 
     def _trim(self) -> None:
-        """Advance the compaction pin, keeping at most WATCH_WINDOW
-        revisions of history alive regardless of request mix."""
-        self._anchor.rev = max(self._anchor.rev,
-                               self.hub._revision - self.WATCH_WINDOW)
+        """Advance the compaction pin AND enforce it, keeping at most
+        ~WATCH_WINDOW revisions of history alive regardless of request
+        mix. Moving only the anchor (the pre-serving behavior) merely
+        ALLOWED a sim-driven ``hub.step()`` to compact; a REST-only hub
+        never stepped, so sustained churn grew the watch history without
+        bound — the compaction now happens here, batched (one sweep per
+        WATCH_WINDOW/8 revisions) so a hot request path never pays an
+        O(history) filter per request. Watchers that fall behind the
+        floor get the clean 410 Gone + relist answer from ``_watch``,
+        never a silently truncated drain. This deliberately overrides
+        the hub's slowest-open-cursor auto-compaction (sim.step): an
+        in-process cursor (Reflector) lagging more than WATCH_WINDOW
+        revisions gets Compacted and relists — the reference's bounded
+        watch cache makes exactly that trade, and relist-on-Compacted
+        is the Reflector contract."""
+        pin = self.hub._revision - self.WATCH_WINDOW
+        self._anchor.rev = max(self._anchor.rev, pin)
+        if pin - self.hub._compacted_rev >= max(self.WATCH_WINDOW // 8, 1):
+            with self._lock:
+                self.hub.compact(pin)
+
+    def _admit(self, h, http_verb: str):
+        """APF-style admission, ahead of authn (the filter-chain slot of
+        WithPriorityAndFairness): classify into a flow schema, take a
+        seat (bounded FIFO wait), or answer 429 + Retry-After. Returns
+        the seat to pass to :meth:`_release` — "" when no fairness
+        filter is installed, None when the request was shed."""
+        if self.fairness is None:
+            return ""
+        from kubernetes_tpu.serving.fairness import RequestRejected
+
+        flow = self.fairness.classify(http_verb, h.path)
+        try:
+            return self.fairness.acquire(flow)
+        except RequestRejected as e:
+            h._fail(429, "TooManyRequests", str(e),
+                    headers={"Retry-After":
+                             str(max(int(round(e.retry_after_s)), 1))})
+            return None
+
+    def _release(self, seat) -> None:
+        if seat and self.fairness is not None:
+            self.fairness.release(seat)
 
     def _begin(self, h) -> None:
         """Per-request entry: trim history and clear per-request handler
@@ -1456,14 +1536,46 @@ class RestServer:
             return (match_labels(lsel, obj.labels)
                     and match_fields(fsel, fields))
 
+        if rv > self.hub._revision:
+            # a future rv (stale client state from another hub
+            # incarnation / a restored checkpoint) can never be served:
+            # silently answering an empty drain would let the client
+            # believe it is caught up at a revision this server has
+            # never reached. 410 forces the clean relist the reference
+            # reaches via its "too large resource version" timeout.
+            return h._fail(
+                410, "Expired",
+                f"resourceVersion {rv} is ahead of this server "
+                f"(current {self.hub._revision}); relist and re-watch "
+                "from the returned resourceVersion")
         try:
             events = self.hub.watch(rv).poll()
-        except Compacted as e:
-            return h._fail(410, "Expired", str(e))
+        except Compacted:
+            # the reference's exact wire text ("too old resource
+            # version: requested (floor)") — client-go Reflectors key
+            # their relist on it; a bare internal message would still be
+            # a 410 but loses the hint
+            return h._fail(
+                410, "Expired",
+                f"too old resource version: {rv} "
+                f"({self.hub._compacted_rev})")
+        matched = [e for e in events if e[1].startswith(kind + "/")]
+        if len(matched) > self.watch_max_drain:
+            # bounded per-watcher send buffer (serving/fairness.py
+            # WatchHub semantics on the stateless poll-watch): a watcher
+            # this far behind would serialize an unbounded drain under
+            # the hub lock, stalling every other client — disconnect it
+            # with the relist signal instead
+            self.watch_evictions += 1
+            if self.metrics is not None:
+                self.metrics.watch_evictions.inc()
+            return h._fail(
+                410, "Expired",
+                f"watcher too far behind: {len(matched)} pending events "
+                f"exceed the {self.watch_max_drain}-event send buffer; "
+                "relist and re-watch")
         lines = []
-        for rev, obj_key, etype, obj in events:
-            if not obj_key.startswith(kind + "/"):
-                continue
+        for rev, obj_key, etype, obj in matched:
             rest = obj_key.split("/", 1)[1]
             if (lsel or fsel) and obj is not None:
                 if not selects(rest, obj):

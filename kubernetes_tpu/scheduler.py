@@ -138,6 +138,16 @@ class CycleResult:
     snapshot_mode: str = ""
     #: sub-batches the pipelined executor ran (0 = monolithic cycle)
     pipeline_chunks: int = 0
+    #: per-pod create-to-bind latency (pod key -> seconds, queue-add
+    #: stamp to bind) for every pod bound this cycle — the admission
+    #: timestamps the serving mode's p99 rides; each value lands in
+    #: scheduler_e2e_scheduling_duration_seconds
+    e2e_latency_s: Dict[str, float] = field(default_factory=dict)
+    #: what flushed the micro-batch window into this cycle
+    #: ("bucket-fill" | "max-wait"; "" = not a serving-loop cycle)
+    flush_trigger: str = ""
+    #: how long the micro-batch window accumulated before flushing
+    window_s: float = 0.0
 
 
 class Scheduler:
@@ -333,6 +343,9 @@ class Scheduler:
         from kubernetes_tpu.volumes import VolumeBinder
 
         self.volume_binder = volume_binder or VolumeBinder(self.cache.packer)
+        #: serving doorbell (serving/doorbell.py) — None until a serving
+        #: loop attaches one via attach_doorbell
+        self.doorbell = None
 
     @classmethod
     def from_config(cls, cfg, **kw) -> "Scheduler":
@@ -548,8 +561,14 @@ class Scheduler:
 
     # -- the cycle ---------------------------------------------------------
 
-    def schedule_cycle(self) -> CycleResult:
-        """One batched scheduling pass over everything in activeQ."""
+    def schedule_cycle(self, flush_trigger: str = "",
+                       window_s: float = 0.0) -> CycleResult:
+        """One batched scheduling pass over everything in activeQ.
+
+        ``flush_trigger``/``window_s`` are the serving loop's micro-batch
+        provenance (what flushed the accumulation window and how long it
+        held) — threaded onto the CycleResult and the flight record so a
+        latency incident can distinguish window time from solve time."""
         from kubernetes_tpu.ops.assign import (
             _apply_batch,
             batch_assign,
@@ -562,7 +581,7 @@ class Scheduler:
         from kubernetes_tpu.framework import CycleState
 
         t0 = self.clock()
-        res = CycleResult()
+        res = CycleResult(flush_trigger=flush_trigger, window_s=window_s)
         # per-cycle deadline (robustness.cycle_deadline_s): propagated to
         # the solver ladder (skip-to-oracle once blown) and the extender
         # calls (shed) so one wedged dependency can't stall the queue
@@ -571,6 +590,8 @@ class Scheduler:
             if self.robustness.cycle_deadline_s > 0 else None
         )
         trace = self.obs.begin_cycle(self.queue.scheduling_cycle)
+        if flush_trigger:
+            self.obs.note_microbatch(flush_trigger, window_s)
         self.queue.tick()
         self.cache.cleanup_expired()
         self._process_waiting(res)
@@ -1014,8 +1035,17 @@ class Scheduler:
             max(res.unschedulable - res.bind_errors, 0), result=m.UNSCHEDULABLE
         )
         m.schedule_attempts.inc(res.bind_errors, result=m.ERROR)
-        if res.attempted or res.scheduled or res.unschedulable:
+        # e2e latency is PER POD create-to-bind (the reference's
+        # scheduleOne observes once per pod): every bound pod's
+        # queue-add -> bind delta lands in the histogram. Cycles that
+        # attempted but bound nothing keep the legacy cycle-elapsed
+        # observation so failure latency stays visible.
+        if res.e2e_latency_s:
+            for v in res.e2e_latency_s.values():
+                m.e2e_scheduling_duration.observe(v)
+        elif res.attempted or res.scheduled or res.unschedulable:
             m.e2e_scheduling_duration.observe(res.elapsed_s)
+        if res.attempted or res.scheduled or res.unschedulable:
             m.scheduling_duration.observe(solve_s, operation="scheduling_algorithm")
         # pending_pods gauge freshness is the QUEUE's job (set in one
         # place per mutation — _sync_gauges); the cycle-boundary call
@@ -1920,6 +1950,11 @@ class Scheduler:
         self.why_pending.pop(pod.key(), None)
         res.scheduled += 1
         res.assignments[pod.key()] = node_name
+        # admission timestamp -> bind: the pod's create-to-bind latency
+        # (queued_at is the queue-add stamp on this scheduler's clock;
+        # 0.0 is a valid fake-clock enqueue time, not "unset")
+        res.e2e_latency_s[pod.key()] = max(
+            self.clock() - getattr(pod, "queued_at", self.clock()), 0.0)
         fw.run_postbind(st, pod, node_name)
         self._cycle_states.pop(pod.key(), None)
         self.event_sink("Scheduled", pod, node_name)
@@ -2157,6 +2192,40 @@ class Scheduler:
         klog.V(2).info("warmup: compiled %d bucketed solve shapes "
                        "(nodes bucket %d)", compiled, dn.valid.shape[0])
         return compiled
+
+    def attach_doorbell(self, bell):
+        """Wire a serving doorbell into this scheduler: the queue rings
+        it on every work-adding incoming event (which covers the
+        informer paths — node/volume events ring through their
+        move-to-active sweeps), and it gains this scheduler's metrics
+        for scheduler_doorbell_rings_total. Returns the bell."""
+        self.doorbell = bell
+        if getattr(bell, "metrics", "absent") is None:
+            bell.metrics = self.metrics
+        # duck-typed like the metrics attach: queue fakes without the
+        # attribute stay valid
+        if getattr(self.queue, "doorbell", "absent") is None:
+            self.queue.doorbell = bell
+        return bell
+
+    def idle_tick(self) -> None:
+        """Queue maintenance WITHOUT a scheduling cycle — the idle path
+        of both serve loops (legacy fixed-interval and serving mode).
+        Runs the periodic flushes (backoff-complete, unschedulable-
+        leftover — each rings the doorbell when it moves pods), expires
+        stale cache assumptions, and resolves Permit waits, but begins
+        no cycle: no trace, no CycleRecord, no solve, no metrics churn.
+        This is what stops an idle cluster from minting empty cycle
+        artifacts every --cycle-interval."""
+        self.queue.tick()
+        self.cache.cleanup_expired()
+        res = CycleResult()
+        self._process_waiting(res)
+        if res.unschedulable or res.scheduled:
+            # a Permit wait resolved while idle: its outcome must still
+            # reach the metrics (the cycle path records via
+            # _record_metrics; the idle path owns that here)
+            self._record_metrics(res)
 
     def run_until_settled(self, max_cycles: int = 50) -> List[CycleResult]:
         """Drive cycles until nothing schedules (tests + sim harness)."""

@@ -239,10 +239,17 @@ def serve_scheduler(
     host: str = "127.0.0.1",
     port: int = 0,
     extender: Optional[ExtenderServer] = None,
+    fairness=None,
 ) -> ThreadingHTTPServer:
     """Start the healthz/metrics (+ optional extender) server on a daemon
     thread; returns the server (``.server_address`` has the bound port,
-    ``.shutdown()`` stops it)."""
+    ``.shutdown()`` stops it).
+
+    ``fairness`` (serving.fairness.FlowController) installs APF-style
+    load shedding ahead of the handlers: extender POSTs ride the
+    mutating flow and are shed with 429 + Retry-After on overload, while
+    /healthz, /metrics and the /debug endpoints classify exempt — the
+    probes that diagnose an overload must survive it."""
 
     sched = scheduler
 
@@ -250,14 +257,43 @@ def serve_scheduler(
         def log_message(self, *a):  # quiet
             pass
 
-        def _respond(self, code: int, body: bytes, ctype: str) -> None:
+        def _respond(self, code: int, body: bytes, ctype: str,
+                     headers=None) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
+        def _admit(self, verb: str):
+            """Flow seat or None after a 429 was sent ("" = no filter)."""
+            if fairness is None:
+                return ""
+            from kubernetes_tpu.serving.fairness import RequestRejected
+
+            try:
+                return fairness.acquire(fairness.classify(verb, self.path))
+            except RequestRejected as e:
+                body = json.dumps({"error": str(e)}).encode()
+                self._respond(
+                    429, body, "application/json",
+                    headers={"Retry-After":
+                             str(max(int(round(e.retry_after_s)), 1))})
+                return None
+
         def do_GET(self):
+            seat = self._admit("GET")
+            if seat is None:
+                return
+            try:
+                self._do_get()
+            finally:
+                if seat and fairness is not None:
+                    fairness.release(seat)
+
+        def _do_get(self):
             if self.path == "/healthz":
                 self._respond(200, b"ok", "text/plain")
             elif self.path == "/metrics":
@@ -295,14 +331,22 @@ def serve_scheduler(
                 self._respond(404, b"not found", "text/plain")
 
         def do_POST(self):
-            if extender is None:
-                self._respond(404, b"no extender", "text/plain")
+            seat = self._admit("POST")
+            if seat is None:
                 return
-            n = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(n).decode() or "{}")
-            verb = self.path.strip("/").split("/")[-1]
-            result = extender.handle(verb, payload)
-            self._respond(200, json.dumps(result).encode(), "application/json")
+            try:
+                if extender is None:
+                    self._respond(404, b"no extender", "text/plain")
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n).decode() or "{}")
+                verb = self.path.strip("/").split("/")[-1]
+                result = extender.handle(verb, payload)
+                self._respond(200, json.dumps(result).encode(),
+                              "application/json")
+            finally:
+                if seat and fairness is not None:
+                    fairness.release(seat)
 
     srv = ThreadingHTTPServer((host, port), Handler)
     t = threading.Thread(target=srv.serve_forever, daemon=True)

@@ -1,0 +1,46 @@
+"""Streaming serving mode — the event-driven layer between the queue and
+the batched solver (ROADMAP item 3: cycles -> a streaming scheduler under
+production churn).
+
+Three pieces, each usable standalone:
+
+- :mod:`kubernetes_tpu.serving.doorbell` — a condition-variable doorbell
+  the SchedulingQueue, informer/bind paths, and REST mutation handlers
+  ring on activity; replaces the fixed-interval sleep in ``cli.run`` with
+  wake-on-event.
+- :mod:`kubernetes_tpu.serving.microbatch` — the adaptive accumulation
+  window (min/max wait, flush targets snapped to the PR-5 AOT warmup
+  buckets so steady-state churn never retraces) and the
+  :class:`ServingLoop` that drives ``Scheduler`` cycles from it.
+- :mod:`kubernetes_tpu.serving.fairness` — API-priority-and-fairness-
+  style load shedding for the REST facades (per-flow-schema concurrency
+  limits, bounded FIFO queues, 429 + Retry-After on overload) and the
+  bounded-buffer watch fan-out hub (a slow watcher is disconnected with
+  410 Gone instead of stalling the publisher).
+"""
+
+from kubernetes_tpu.serving.doorbell import Doorbell
+from kubernetes_tpu.serving.fairness import (
+    FlowController,
+    FlowSchema,
+    RequestRejected,
+    WatcherGone,
+    WatchHub,
+)
+from kubernetes_tpu.serving.microbatch import (
+    MicroBatchWindow,
+    ServingLoop,
+    WindowDecision,
+)
+
+__all__ = [
+    "Doorbell",
+    "FlowController",
+    "FlowSchema",
+    "MicroBatchWindow",
+    "RequestRejected",
+    "ServingLoop",
+    "WatcherGone",
+    "WatchHub",
+    "WindowDecision",
+]

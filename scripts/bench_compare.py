@@ -33,6 +33,11 @@ are shared and noisy; tighten for dedicated hardware):
   bucketing + AOT warmup exist precisely to pin
   ``scheduler_jax_retrace_total`` flat under queue churn.
 
+Sustained-churn gates ride alongside (scripts/bench_churn.py records):
+the two newest ``benchres/churn_r*.json`` are diffed on the serving
+arm's p99 create-to-bind + throughput and the overload arm's shed rate.
+Absence is tolerated — pre-serving benchres directories keep passing.
+
 Records carrying errors in the compared sections are skipped with a
 warning rather than failing the gate — a partial bench record is a bench
 problem, not a perf regression.
@@ -62,6 +67,19 @@ def find_records(directory: str) -> List[str]:
         return (int(m.group(1)) if m else -1, os.path.basename(path))
 
     return sorted(glob.glob(os.path.join(directory, "bench_r*.json")),
+                  key=round_key)
+
+
+def find_churn_records(directory: str) -> List[str]:
+    """churn_r*.json (scripts/bench_churn.py records) sorted by round —
+    the sustained-churn gate's inputs. Absence is tolerated: old
+    benchres directories predate the serving mode."""
+
+    def round_key(path: str) -> Tuple[int, str]:
+        m = re.search(r"churn_r(\d+)", os.path.basename(path))
+        return (int(m.group(1)) if m else -1, os.path.basename(path))
+
+    return sorted(glob.glob(os.path.join(directory, "churn_r*.json")),
                   key=round_key)
 
 
@@ -172,6 +190,53 @@ def compare(prev: dict, cur: dict, threshold: float,
             "warnings": warnings}
 
 
+def compare_churn(prev: dict, cur: dict, threshold: float) -> dict:
+    """Sustained-churn gates over two churn_r*.json records (pure,
+    unit-tested): the serving arm's p99 create-to-bind must not grow
+    past the threshold, the serving throughput must not drop, and the
+    overload arm's shed RATE must not grow past the threshold (more
+    shedding at the same offered load means the sustainable rate
+    regressed). Absent sections are warnings, never failures — a churn
+    record from an older round may predate an arm."""
+    checks, regressions, warnings = [], [], []
+
+    def check(name: str, prev_v, cur_v, lower_is_better: bool = False):
+        pv, cv = _num(prev_v), _num(cur_v)
+        if pv is None or cv is None:
+            warnings.append(f"{name}: not comparable "
+                            f"(prev={prev_v!r}, cur={cur_v!r})")
+            return
+        if pv <= 0:
+            # shed_rate can legitimately be ~0; delta ratios there are
+            # meaningless — compare absolutely against the threshold
+            bad = lower_is_better and cv > threshold
+            delta = cv - pv
+        else:
+            delta = (cv - pv) / pv
+            bad = (delta > threshold if lower_is_better
+                   else delta < -threshold)
+        row = {"check": name, "prev": pv, "cur": cv,
+               "delta_frac": round(delta, 4), "regressed": bad}
+        checks.append(row)
+        if bad:
+            regressions.append(row)
+
+    pa = prev.get("arms") or {}
+    ca = cur.get("arms") or {}
+    check("churn.serving.p99_s",
+          (pa.get("serving") or {}).get("p99_s"),
+          (ca.get("serving") or {}).get("p99_s"), lower_is_better=True)
+    check("churn.serving.ops_per_sec",
+          (pa.get("serving") or {}).get("ops_per_sec"),
+          (ca.get("serving") or {}).get("ops_per_sec"))
+    check("churn.overload.shed_rate",
+          (pa.get("overload") or {}).get("shed_rate"),
+          (ca.get("overload") or {}).get("shed_rate"),
+          lower_is_better=True)
+    return {"checks": checks, "regressions": regressions,
+            "warnings": warnings}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("records", nargs="*",
@@ -196,39 +261,66 @@ def main(argv=None) -> int:
         print("error: pass exactly two records (OLD NEW) or none",
               file=sys.stderr)
         return 2
+    prev_path = cur_path = None
     if args.records:
         prev_path, cur_path = args.records
     else:
         found = find_records(args.dir)
-        if len(found) < 2:
-            msg = (f"not enough bench records in {args.dir} "
-                   f"({len(found)} found; need 2) — nothing to gate")
-            if args.format == "json":
-                print(json.dumps({"status": "skipped", "reason": msg}))
-            else:
-                print(msg)
-            return 0
-        prev_path, cur_path = found[-2], found[-1]
-    try:
-        prev, cur = load(prev_path), load(cur_path)
-    except (OSError, json.JSONDecodeError) as e:
-        print(f"error: cannot load records: {e}", file=sys.stderr)
-        return 2
-
-    verdict = compare(prev, cur, args.threshold, args.explain_threshold,
-                      args.pack_floor)
+        if len(found) >= 2:
+            prev_path, cur_path = found[-2], found[-1]
+    verdict = {"checks": [], "regressions": [], "warnings": []}
+    if prev_path is not None:
+        try:
+            prev, cur = load(prev_path), load(cur_path)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot load records: {e}", file=sys.stderr)
+            return 2
+        verdict = compare(prev, cur, args.threshold,
+                          args.explain_threshold, args.pack_floor)
+        verdict.update({
+            "prev_record": os.path.relpath(prev_path, REPO_ROOT),
+            "cur_record": os.path.relpath(cur_path, REPO_ROOT),
+        })
+    else:
+        verdict["warnings"].append(
+            f"not enough bench records in {args.dir} — headline gates "
+            "skipped")
+    # sustained-churn gates (scripts/bench_churn.py records) — absence
+    # tolerated so pre-serving benchres directories keep passing
+    churn_found = find_churn_records(args.dir)
+    if len(churn_found) >= 2:
+        try:
+            cprev, ccur = load(churn_found[-2]), load(churn_found[-1])
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot load churn records: {e}",
+                  file=sys.stderr)
+            return 2
+        cv = compare_churn(cprev, ccur, args.threshold)
+        verdict["checks"].extend(cv["checks"])
+        verdict["regressions"].extend(cv["regressions"])
+        verdict["warnings"].extend(cv["warnings"])
+        verdict["churn_records"] = [
+            os.path.relpath(p, REPO_ROOT) for p in churn_found[-2:]]
+    elif churn_found:
+        verdict["warnings"].append(
+            "only one churn record — churn gates need two to compare")
+    if prev_path is None and len(churn_found) < 2:
+        msg = (f"not enough records in {args.dir} — nothing to gate")
+        if args.format == "json":
+            print(json.dumps({"status": "skipped", "reason": msg}))
+        else:
+            print(msg)
+        return 0
     verdict.update({
-        "prev_record": os.path.relpath(prev_path, REPO_ROOT),
-        "cur_record": os.path.relpath(cur_path, REPO_ROOT),
         "threshold": args.threshold,
         "status": "regression" if verdict["regressions"] else "ok",
     })
     if args.format == "json":
         print(json.dumps(verdict, indent=1))
     else:
-        print(f"bench compare: {verdict['prev_record']} -> "
-              f"{verdict['cur_record']} (threshold "
-              f"{args.threshold:.0%})")
+        pair = (f"{verdict['prev_record']} -> {verdict['cur_record']}"
+                if "prev_record" in verdict else "(churn records only)")
+        print(f"bench compare: {pair} (threshold {args.threshold:.0%})")
         for row in verdict["checks"]:
             mark = "REGRESSED" if row["regressed"] else "ok"
             prev_s = "-" if row["prev"] is None else f"{row['prev']:g}"

@@ -984,3 +984,50 @@ def test_endpoints_with_no_addresses_serialize_empty_subsets():
         assert items["lonely"]["subsets"] == []
     finally:
         srv.close()
+
+
+def test_watch_trim_compacts_and_answers_clean_410_relist():
+    """Regression pin (serving PR): the watch-trim path must ENFORCE the
+    WATCH_WINDOW — a REST-only hub (no sim step loop) compacts through
+    _trim itself — and a watcher resuming from below the floor gets the
+    clean 410 Gone with the reference's relist hint ("too old resource
+    version: requested (floor)"), never a silent empty drain. A future
+    resourceVersion (stale client state from another hub incarnation) is
+    also 410, not a forever-empty 200 stream."""
+    hub = HollowCluster(seed=77, scheduler_kw={"enable_preemption": False})
+    srv, port = start(hub)
+    srv.WATCH_WINDOW = 16  # instance override so the boundary is cheap
+    try:
+        code, doc = req(port, "GET", "/api/v1/nodes")
+        rv0 = int(doc["metadata"]["resourceVersion"])
+        # mint > WATCH_WINDOW revisions purely through REST mutations
+        for i in range(40):
+            req(port, "POST", "/api/v1/namespaces/default/pods",
+                make_pod_doc(f"churn-{i}"))
+        srv._trim()  # what the per-request _begin and the 1 s trimmer run
+        assert hub._compacted_rev > rv0, \
+            "REST-only hub never compacted: watch history is unbounded"
+        # the boundary: at/above the floor drains fine (NDJSON frames)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", f"/api/v1/watch/pods?resourceVersion="
+                            f"{hub._compacted_rev}")
+        r = conn.getresponse()
+        frames = [json.loads(l) for l in r.read().splitlines() if l]
+        conn.close()
+        assert r.status == 200 and frames
+        # ...below it is the clean 410 + relist hint
+        code, doc = req(port, "GET",
+                        f"/api/v1/watch/pods?resourceVersion={rv0}")
+        assert code == 410 and doc["reason"] == "Expired"
+        assert f"too old resource version: {rv0}" in doc["message"]
+        assert str(hub._compacted_rev) in doc["message"]
+        # a FUTURE rv can never be served silently
+        code, doc = req(port, "GET",
+                        f"/api/v1/watch/pods?resourceVersion="
+                        f"{hub._revision + 1000}")
+        assert code == 410 and doc["reason"] == "Expired"
+        assert "relist" in doc["message"]
+        # the compaction stayed bounded, not total: recent history lives
+        assert len(hub._history) > 0
+    finally:
+        srv.close()
