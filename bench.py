@@ -13,7 +13,7 @@ this bench captures only a fixed-size TAIL of stdout (~4 KB), and for four
 rounds the single giant result line overflowed it — ``"parsed": null`` in
 every BENCH_r0*.json, so the machine-readable record NEVER carried the
 headline. The full result document is therefore written to
-``benchres/bench_r06.json`` (override: BENCH_FULL_OUT; empty disables) and
+``benchres/bench_r07.json`` (override: BENCH_FULL_OUT; empty disables) and
 stdout gets a compact summary (platform, headline pods/s, p99, score
 parity, truncated errors, pointer to the full record) sized well under
 the tail window. ``BENCH_EMIT=full`` restores the old full-line emit —
@@ -198,7 +198,7 @@ def full_record_path() -> str:
     BENCH_FULL_OUT disables the file write — the cpu_ratio child uses
     that so it cannot clobber the parent's record."""
     here = os.path.dirname(os.path.abspath(__file__))
-    default = os.path.join(here, "benchres", "bench_r06.json")
+    default = os.path.join(here, "benchres", "bench_r07.json")
     p = os.environ.get("BENCH_FULL_OUT", default)
     return p
 
@@ -609,11 +609,15 @@ def run_batched(w: Workload, batch: int, cap: int, use_sinkhorn: bool = False,
                       if trace is not None else None)
         tr = time.perf_counter()
         try:
-            a = np.asarray(assigned)[: len(chunk)]  # device sync + readback
+            full = np.asarray(assigned)  # device sync + readback
+            a = full[: len(chunk)]
         finally:
             if chunk_span is not None:
                 trace.end_span(chunk_span)
         readback_s += time.perf_counter() - tr
+        # d2h byte accounting: the per-cycle readback budget the
+        # bench_compare gate pins — what actually crossed the boundary
+        tel.record_transfer("bench-solve", "d2h", full.nbytes)
         tb = time.perf_counter()
         assigned_all[start : start + len(chunk)] = a
         n_placed = int((a >= 0).sum())
@@ -678,7 +682,9 @@ def run_batched(w: Workload, batch: int, cap: int, use_sinkhorn: bool = False,
     while inflight:
         drain_one()
     elapsed = time.perf_counter() - t0
-    jax_sites = tel.snapshot()["sites"].get("bench-solve", {})
+    snap = tel.snapshot()
+    jax_sites = snap["sites"].get("bench-solve", {})
+    d2h = snap["transfers"].get("bench-solve:d2h", {"bytes": 0})
     out = {
         "placed": scheduled,
         "pods": len(pending),
@@ -693,6 +699,12 @@ def run_batched(w: Workload, batch: int, cap: int, use_sinkhorn: bool = False,
         "dispatch_s": round(dispatch_s, 3),
         "readback_s": round(readback_s, 3),
         "bind_s": round(bind_s, 3),
+        # the readback budget: d2h bytes at the solve boundary — the
+        # answer is one int32 vector per chunk, so bytes-per-pod should
+        # sit near 4 (padding included) and never scale with N
+        "readback_bytes": int(d2h.get("bytes", 0)),
+        "readback_bytes_per_pod": round(
+            d2h.get("bytes", 0) / max(len(pending), 1), 2),
         "pipeline_depth": depth,
         # warm-run compile discipline: retraces must be 0 (gate in
         # scripts/bench_compare.py); the single compile is the warmup

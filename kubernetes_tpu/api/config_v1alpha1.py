@@ -123,6 +123,7 @@ class RobustnessConfigurationV1alpha1:
     breakerOpenDuration: Optional[str] = None
     breakerHalfOpenProbes: Optional[int] = None
     validateResults: Optional[bool] = None
+    hostValidate: Optional[bool] = None
     fallbackChain: Optional[list] = None
     extenderDegradeToIgnorable: Optional[bool] = None
 
@@ -289,6 +290,8 @@ def set_defaults_kube_scheduler_configuration(
         rb.breakerHalfOpenProbes = 1
     if rb.validateResults is None:
         rb.validateResults = True
+    if rb.hostValidate is None:
+        rb.hostValidate = False
     if rb.fallbackChain is None:
         rb.fallbackChain = ["batch-cpu", "greedy"]
     if rb.extenderDegradeToIgnorable is None:
@@ -527,6 +530,7 @@ def _robustness_to_internal(rb: RobustnessConfigurationV1alpha1):
                                      rb.breakerOpenDuration, "robustness"),
         breaker_half_open_probes=rb.breakerHalfOpenProbes,
         validate_results=rb.validateResults,
+        host_validate=rb.hostValidate,
         fallback_chain=tuple(chain),
         extender_degrade_to_ignorable=rb.extenderDegradeToIgnorable,
     )
@@ -583,6 +587,7 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
             breakerOpenDuration=format_duration(rc.breaker_open_duration_s),
             breakerHalfOpenProbes=rc.breaker_half_open_probes,
             validateResults=rc.validate_results,
+            hostValidate=rc.host_validate,
             fallbackChain=list(rc.fallback_chain),
             extenderDegradeToIgnorable=rc.extender_degrade_to_ignorable,
         ),

@@ -134,6 +134,13 @@ class RobustnessConfig:
     #: trusting them — what keeps a lying solver from binding an
     #: infeasible pod
     validate_results: bool = True
+    #: route result validation through the HOST checker
+    #: (ops/assign.validate_solution — the trust floor and parity oracle)
+    #: instead of the fused on-device validator whose verdict rides the
+    #: single end-of-solve readback. Host validation re-materializes the
+    #: assignment and four tables per attempt (the PR-7 readback wall);
+    #: keep it off unless debugging a suspected device-validator bug.
+    host_validate: bool = False
     #: tiers tried after the configured solver fails; "greedy" is the
     #: sequential oracle floor and terminates the chain
     fallback_chain: Tuple[str, ...] = ("batch-cpu", "greedy")

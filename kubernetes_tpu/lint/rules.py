@@ -165,6 +165,7 @@ RULE_SUMMARIES = {
     "R4": "non-determinism (global RNG, wall clock, argless now())",
     "R5": "dtype drift: float64 in device-math modules",
     "R6": "syntax gate: Py3.10 f-string backslash / parse errors",
+    "R7": "d2h readback outside a declared obs.jax.readback boundary",
 }
 
 #: modules whose arrays must stay float32 (R5): the device-math layer
@@ -949,6 +950,64 @@ def _looks_like_fstring_backslash(fi: FileInfo, around_line: int) -> bool:
     lo = max(0, around_line - 3)
     hi = min(len(fi.lines), around_line + 2)
     return any(pat.search(text) for text in fi.lines[lo:hi])
+
+
+# ==========================================================================
+# R7 — undeclared d2h readback sites
+# ==========================================================================
+
+#: modules implementing the declared boundary itself — their internal
+#: numpy materialization IS the accounting path
+_R7_BOUNDARY_MODULES = ("obs/jaxtel.py",)
+
+#: argument AST nodes that cannot be device buffers (host literals and
+#: comprehensions) — np.asarray over them is host-on-host bookkeeping
+_R7_HOST_LITERALS = (ast.List, ast.Tuple, ast.Dict, ast.Set, ast.Constant,
+                     ast.ListComp, ast.SetComp, ast.DictComp,
+                     ast.GeneratorExp, ast.JoinedStr)
+
+
+@register_rule("R7")
+def rule_r7_undeclared_readback(project: Project) -> List[Finding]:
+    """``np.asarray``/``jax.device_get`` on a potential device value
+    outside the declared ``obs.jax.readback`` boundary. The PR-7 fused
+    solve+validate work shrank the steady-state cycle's d2h traffic to
+    one small accounted transfer; this rule is the ratchet that keeps
+    new unaccounted readback sites from sneaking in silently. Scope:
+    first-party modules that import jax (pure-numpy host modules can't
+    hold device buffers); obvious host literals are exempt; remaining
+    legitimate sites carry scope suppressions with justifications or
+    live in the committed baseline — baseline-aware like R0-R6."""
+    findings: List[Finding] = []
+    for fi in project.files:
+        if fi.tree is None:
+            continue
+        rel = fi.relpath.replace("\\", "/")
+        if any(rel.endswith(m) for m in _R7_BOUNDARY_MODULES):
+            continue
+        if rel.split("/", 1)[0] in ("tests", "tests_tpu", "scripts"):
+            # offline harnesses and parity oracles read device values by
+            # design; the ratchet guards the serving/production modules
+            continue
+        if not any(v == "jax" or v.startswith("jax.")
+                   for v in fi.imports.values()):
+            continue
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            full = resolve_dotted(dotted_name(node.func), fi.imports)
+            if full not in _SYNC_CALLS:
+                continue
+            if node.args and isinstance(node.args[0], _R7_HOST_LITERALS):
+                continue
+            findings.append(fi.finding(
+                node, "R7",
+                f"`{full}` reads a (potential) device value back outside "
+                "a declared boundary — route d2h syncs through "
+                "obs.jax.readback so transfer accounting (and the "
+                "readback-budget gate) sees them",
+            ))
+    return findings
 
 
 # ==========================================================================

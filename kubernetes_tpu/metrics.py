@@ -358,6 +358,14 @@ class SchedulerMetrics:
             "boundaries.",
             ["site", "direction"],
         ))
+        self.readback_bytes = r.register(Counter(
+            "scheduler_readback_bytes_total",
+            "Device->host readback bytes per declared site — the readback "
+            "wall's dedicated meter (PR 7 shrank the steady-state cycle "
+            "to one small solve-result transfer; this is what keeps it "
+            "measurable after the fall).",
+            ["site"],
+        ))
         self.sinkhorn_iterations = r.register(Histogram(
             "scheduler_sinkhorn_iterations",
             "Sinkhorn scaling iterations until the row-potential delta "

@@ -82,6 +82,7 @@ class Observability:
                          "deadline_exceeded": False}
         self._sinkhorn_stats = None
         self._retraces_at_begin = self.jax.retrace_total()
+        self._d2h_at_begin = self.jax.d2h_bytes_total()
         self.current_trace = Trace("Scheduling cycle", clock=self.clock,
                                    cycle=cycle)
         return self.current_trace
@@ -204,6 +205,8 @@ class Observability:
             elapsed_s=getattr(res, "elapsed_s", 0.0) if res is not None else 0.0,
             spans=trace.span_durations(),
             retraces=self.jax.retrace_total() - self._retraces_at_begin,
+            readback_bytes=(self.jax.d2h_bytes_total()
+                            - getattr(self, "_d2h_at_begin", 0)),
             sinkhorn_iters=sk_iters,
             sinkhorn_residual=sk_resid,
             top_reasons=(

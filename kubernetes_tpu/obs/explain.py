@@ -66,10 +66,24 @@ class ExplainResult(NamedTuple):
     pair_hist: jnp.ndarray
     #: (B,) — pods with predicate b firing on >= 1 valid node
     pods_blocked: jnp.ndarray
+    #: (P,) — OR of every valid node's failure bits per pod (what the
+    #: driver used to read the whole (P, N) matrix back to compute)
+    pod_bits: jnp.ndarray
+    #: (P, R) — valid nodes where PodFitsResources fired AND the pod's
+    #: request for resource r exceeds the node's free amount — the
+    #: per-resource "Insufficient <res>" counts of FitError.Error()
+    #: ((P, 0) when the fit inputs weren't supplied)
+    insufficient: jnp.ndarray
+    #: (P,) — valid nodes where CheckNodeCondition fired and the node was
+    #: not ready (zeros when fit inputs weren't supplied)
+    not_ready: jnp.ndarray
+    #: (P,) — ...and where the node's network was unavailable
+    net_unavail: jnp.ndarray
 
 
 @jax.jit
-def explain_reduce(reasons, node_valid, pod_mask) -> ExplainResult:
+def explain_reduce(reasons, node_valid, pod_mask, req=None, free=None,
+                   ready=None, net_unavail=None) -> ExplainResult:
     """Reduce the cycle's failure bitmask into the explain analytics.
 
     ``reasons`` (P, N) int32 per-(pod, node) failed-predicate bits (from
@@ -78,11 +92,21 @@ def explain_reduce(reasons, node_valid, pod_mask) -> ExplainResult:
     (the cycle's unschedulable rows — placed and padded rows contribute
     nothing to the cluster rollup).
 
+    ``req`` (P, R) / ``free`` (N, R) / ``ready`` / ``net_unavail`` (N,)
+    are the FitError fidelity inputs: with them the result additionally
+    carries the per-resource Insufficient counts and the node-condition
+    splits, so the driver reconstructs ``fit_error_message`` output
+    byte-identically from these reductions (see
+    :func:`~kubernetes_tpu.ops.predicates.fit_error_message_from_counts`)
+    — the raw (P, N) bitmask never crosses the device boundary.
+
     The reason axis is static (``N_REASONS`` bits), so it unrolls as B
     passes over the (P, N) plane — the same streaming idiom as
     :func:`~kubernetes_tpu.ops.predicates.resource_fit_mask`; no
     (P, N, B) intermediate is ever materialized.
     """
+    from kubernetes_tpu.ops.predicates import BIT
+
     vmask = pod_mask[:, None] & node_valid[None, :]  # (P, N)
     per_pod_cols = []
     one_bit_cols = []
@@ -99,8 +123,34 @@ def explain_reduce(reasons, node_valid, pod_mask) -> ExplainResult:
     feasible = jnp.sum((reasons == 0) & vmask, axis=1, dtype=jnp.int32)
     pair_hist = jnp.sum(per_pod, axis=0, dtype=jnp.int32)
     pods_blocked = jnp.sum(per_pod > 0, axis=0, dtype=jnp.int32)
+    P = reasons.shape[0]
+    # OR over valid nodes — independent of pod_mask so the value matches
+    # the legacy host reduction for every failed row
+    pod_bits = jax.lax.reduce(
+        jnp.where(node_valid[None, :], reasons, 0),
+        jnp.int32(0), jax.lax.bitwise_or, dimensions=(1,))
+    if req is not None:
+        res_fired = (((reasons >> BIT["PodFitsResources"]) & 1) > 0) \
+            & node_valid[None, :]
+        insufficient = jnp.stack([
+            jnp.sum(res_fired
+                    & (req[:, r:r + 1] > free[None, :, r] + 1e-6),
+                    axis=1, dtype=jnp.int32)
+            for r in range(req.shape[1])
+        ], axis=1)  # (P, R)
+        cond_fired = (((reasons >> BIT["CheckNodeCondition"]) & 1) > 0) \
+            & node_valid[None, :]
+        not_ready = jnp.sum(cond_fired & ~ready[None, :], axis=1,
+                            dtype=jnp.int32)
+        netun = jnp.sum(cond_fired & net_unavail[None, :], axis=1,
+                        dtype=jnp.int32)
+    else:
+        insufficient = jnp.zeros((P, 0), jnp.int32)
+        not_ready = jnp.zeros((P,), jnp.int32)
+        netun = jnp.zeros((P,), jnp.int32)
     return ExplainResult(per_pod, one_bit, best_bit, best_gain,
-                         feasible, pair_hist, pods_blocked)
+                         feasible, pair_hist, pods_blocked,
+                         pod_bits, insufficient, not_ready, netun)
 
 
 # ---------------------------------------------------------------------------

@@ -190,6 +190,20 @@ class JaxTelemetry:
             self.metrics.host_transfer_bytes.inc(
                 int(nbytes), site=site, direction=direction)
             self.metrics.host_transfers.inc(site=site, direction=direction)
+            if direction == "d2h":
+                # the readback wall's dedicated meter (one label, so a
+                # dashboard sums sites without direction filtering);
+                # duck-typed so partial metrics fakes stay valid
+                rb = getattr(self.metrics, "readback_bytes", None)
+                if rb is not None:
+                    rb.inc(int(nbytes), site=site)
+
+    def d2h_bytes_total(self) -> int:
+        """Total d2h bytes across every site — the flight recorder diffs
+        this per cycle into CycleRecord.readback_bytes."""
+        with self._lock:
+            return sum(row[1] for (site, d), row in self.transfers.items()
+                       if d == "d2h")
 
     def readback(self, site: str, x):
         """The declared d2h host boundary: materialize ``x`` — a single

@@ -43,6 +43,11 @@ class CycleRecord:
     spans: Dict[str, float] = field(default_factory=dict)
     #: JAX telemetry deltas worth keeping per cycle
     retraces: int = 0
+    #: d2h bytes this cycle read back across ALL declared sites — the
+    #: per-cycle readback budget (docs/perf.md): a healthy steady-state
+    #: cycle moves ~KBs (solve-result vector + scalars); a regression to
+    #: MB-scale means a full-matrix readback snuck back in
+    readback_bytes: int = 0
     sinkhorn_iters: float = -1.0  # -1 = sinkhorn not engaged
     sinkhorn_residual: float = -1.0
     #: top-K unschedulability reasons this cycle — (predicate name,
@@ -79,6 +84,7 @@ class CycleRecord:
             "elapsed_s": round(self.elapsed_s, 6),
             "spans": {k: round(v, 6) for k, v in self.spans.items()},
             "retraces": self.retraces,
+            "readback_bytes": self.readback_bytes,
             **({"sinkhorn_iters": self.sinkhorn_iters,
                 "sinkhorn_residual": self.sinkhorn_residual}
                if self.sinkhorn_iters >= 0 else {}),
@@ -157,6 +163,8 @@ class FlightRecorder:
             if r.top_reasons:
                 flags.append("why=" + ",".join(
                     f"{name}:{n}" for name, n in r.top_reasons))
+            if r.readback_bytes:
+                flags.append(f"d2h={r.readback_bytes}B")
             if r.snapshot_mode:
                 flags.append(f"snap={r.snapshot_mode}:{r.snapshot_rows}")
             if r.pipeline_chunks:

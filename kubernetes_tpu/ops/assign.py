@@ -271,11 +271,292 @@ def _segment_prefix(values: jnp.ndarray, seg_starts: jnp.ndarray) -> jnp.ndarray
     return excl - excl[seg_starts]
 
 
+def _inverse_permutation(perm: jnp.ndarray) -> jnp.ndarray:
+    """inv[perm[i]] = i for a permutation ``perm``. ``argsort`` of a
+    permutation IS its inverse, and XLA lowers it to one vectorized sort —
+    the scatter spelling (``zeros.at[perm].set(iota)``) lowers on XLA:CPU
+    to a P-trip while loop of one-element dynamic-update-slices (profiled:
+    the two rank scatters were a measurable slice of every round)."""
+    return jnp.argsort(perm).astype(jnp.int32)
+
+
+#: lean-score support: the usage-dependent resource kernels the fused
+#: round can inline (shared-fraction form), plus EqualPriority (a
+#: constant). Everything else (or a non-integer weight, or a rebound
+#: registry name) routes the batch to the general round path.
+_LEAN_DYNAMIC = ("LeastRequestedPriority", "MostRequestedPriority",
+                 "BalancedResourceAllocation")
+
+
+def _lean_score_plan(weights_key, skip_key):
+    """Host-side (trace-time) scoring plan for the fused round: returns
+    ``(const_total, terms)`` — the exact scalar sum of all gated/constant
+    kernels plus the ordered (name, weight) list of live resource kernels
+    — or None when any active kernel falls outside the provably-exact
+    lean set. Exactness mirrors priorities._fusable: every stock kernel
+    floors to integer-valued f32 and every weight is an integer, so all
+    partial sums are exact f32 integers and regrouping cannot round."""
+    from kubernetes_tpu.ops.priorities import (
+        _ALL_STOCK_KERNELS,
+        _STOCK_KERNELS,
+        DEFAULT_WEIGHTS,
+        EMPTY_CONSTANTS,
+        PRIORITY_REGISTRY,
+    )
+
+    weights = (dict(weights_key) if weights_key is not None
+               else DEFAULT_WEIGHTS)
+    const_total = 0.0
+    terms = []
+    for name, w in weights.items():
+        if not w:
+            continue
+        if float(w) != int(w):
+            return None
+        if PRIORITY_REGISTRY.get(name) is not _ALL_STOCK_KERNELS.get(name):
+            return None  # rebound kernel: empty/lean behavior unknown
+        if (name in skip_key and name in EMPTY_CONSTANTS
+                and PRIORITY_REGISTRY[name] is _STOCK_KERNELS[name]):
+            const_total += w * EMPTY_CONSTANTS[name]
+        elif name == "EqualPriority":
+            const_total += w * 1.0
+        elif name in _LEAN_DYNAMIC:
+            terms.append((name, float(w)))
+        else:
+            return None
+    return const_total, tuple(terms)
+
+
+def _lean_masked_score(pods, nodes, u, active, static_ok, res_on, plan):
+    """The fused round's single (P, N) pass: feasibility mask and weighted
+    score in one expression, emitted as ``ms = where(mask, score, NEG)``
+    so XLA materializes exactly ONE (P, N) f32 matrix per round instead
+    of mask + per-kernel score temporaries. Arithmetic is verbatim
+    priorities.least_requested / most_requested / balanced_allocation
+    with the shared request fractions computed once (regrouped
+    accumulation exact per :func:`_lean_score_plan`)."""
+    from kubernetes_tpu.ops.predicates import resource_fit_mask
+    from kubernetes_tpu.ops.priorities import MAX_PRIORITY, _EPS, _idiv
+
+    const_total, terms = plan
+    mask = static_ok & active[:, None]
+    if res_on:
+        mask = mask & resource_fit_mask(pods.req, nodes.allocatable,
+                                        u.requested)
+    score = jnp.full((pods.req.shape[0], nodes.allocatable.shape[0]),
+                     jnp.float32(const_total))
+    if terms:
+        # shared ResourceAllocationPriority scaffold (computed once)
+        cpu_req = pods.nonzero_req[:, 0:1] + u.nonzero_req[None, :, 0]
+        mem_req = pods.nonzero_req[:, 1:2] + u.nonzero_req[None, :, 1]
+        cpu_cap = nodes.allocatable[None, :, 0]
+        mem_cap = nodes.allocatable[None, :, 1]
+
+        def capped(req, cap, s):
+            return jnp.where((cap <= 0) | (req > cap), 0.0, s)
+
+        for name, w in terms:
+            if name == "LeastRequestedPriority":
+                t = _idiv(
+                    capped(cpu_req, cpu_cap,
+                           _idiv((cpu_cap - cpu_req) * MAX_PRIORITY, cpu_cap))
+                    + capped(mem_req, mem_cap,
+                             _idiv((mem_cap - mem_req) * MAX_PRIORITY,
+                                   mem_cap)),
+                    2.0)
+            elif name == "MostRequestedPriority":
+                t = _idiv(
+                    capped(cpu_req, cpu_cap,
+                           _idiv(cpu_req * MAX_PRIORITY, cpu_cap))
+                    + capped(mem_req, mem_cap,
+                             _idiv(mem_req * MAX_PRIORITY, mem_cap)),
+                    2.0)
+            else:  # BalancedResourceAllocation
+                cf = jnp.where(cpu_cap > 0,
+                               cpu_req / jnp.maximum(cpu_cap, 1e-30), 1.0)
+                mf = jnp.where(mem_cap > 0,
+                               mem_req / jnp.maximum(mem_cap, 1e-30), 1.0)
+                t = jnp.floor((1.0 - jnp.abs(cf - mf)) * MAX_PRIORITY + _EPS)
+                t = jnp.where((cf >= 1.0) | (mf >= 1.0), 0.0, t)
+            score = score + w * t
+    return jnp.where(mask, score, NEG)
+
+
+def _blocked_pick(tied, arank):
+    """Exact (rot+1)-th-set-bit selection without the (P, N) cumsum or the
+    (P, N) argmax (profiled at 23 ms + 16 ms per round on XLA:CPU at the
+    headline shape — scan-shaped lowerings that don't vectorize): count
+    set bits per 64-column block (a fast reduce), locate the target block
+    with a (P, N/64) scan, then rank within the one gathered block. The
+    chosen column is bit-identical to the cumsum spelling; rotation
+    semantics (``rot = arank % tcount``) are unchanged."""
+    P, N = tied.shape
+    BL = min(64, N)
+    if N % BL:
+        # non-bucketed node axis (every in-repo caller pads to a
+        # power-of-two bucket, but pad_to is an open parameter): the
+        # blocked reshape can't apply — take the full-width cumsum
+        # spelling, same picks
+        pos = jnp.cumsum(tied.astype(jnp.int32), axis=1)
+        tcount = pos[:, -1]
+        rot = jnp.where(tcount > 0, arank % jnp.maximum(tcount, 1), 0)
+        pick = tied & (pos == (rot + 1)[:, None])
+        choice = jnp.argmax(pick, axis=1).astype(jnp.int32)
+        return jnp.where(tcount > 0, choice, 0), tcount
+    t3 = tied.reshape(P, N // BL, BL)
+    return _blocked_pick_core(
+        t3, lambda bidx: jnp.take_along_axis(
+            t3, bidx[:, None, None], axis=1)[:, 0, :],
+        arank)
+
+
+def _blocked_pick_core(t3, gather_block, arank):
+    """Shared core of the blocked selection. ``t3`` is the (P, N/BL, BL)
+    tied view; ``gather_block(bidx) -> (P, BL) bool`` re-derives one
+    block's tied bits (the lean path recomputes them from the gathered
+    masked-score slice so the full tied matrix is never materialized).
+    Block counts ride int8 (BL <= 64 < 127) — XLA:CPU materializes the
+    reduce's convert, and a quarter-width buffer is a quarter of that
+    traffic."""
+    P = t3.shape[0]
+    BL = t3.shape[2]
+    bc = jnp.sum(t3.astype(jnp.int8), axis=2,
+                 dtype=jnp.int8).astype(jnp.int32)  # (P, N/BL)
+    bexcl = jnp.cumsum(bc, axis=1) - bc  # exclusive block prefix
+    tcount = bexcl[:, -1] + bc[:, -1]
+    rot = jnp.where(tcount > 0, arank % jnp.maximum(tcount, 1), 0)
+    hit = (bexcl <= rot[:, None]) & (bexcl + bc > rot[:, None])
+    bidx = jnp.argmax(hit, axis=1).astype(jnp.int32)
+    blk = gather_block(bidx)
+    want = rot - jnp.take_along_axis(bexcl, bidx[:, None], axis=1)[:, 0]
+    cpos = jnp.cumsum(blk.astype(jnp.int32), axis=1)  # (P, BL) — small
+    inblk = blk & (cpos == (want + 1)[:, None])
+    off = jnp.argmax(inblk, axis=1).astype(jnp.int32)
+    choice = jnp.where(tcount > 0, bidx * BL + off, 0)
+    return choice, tcount
+
+
+def _admit_scored(choice, rank, req, free, per_node_cap, capacity_on,
+                  sorted_gate=None):
+    """Score-ordered per-node admission — ONE spelling shared by the
+    general and lean round bodies (their bit-identity is the module's
+    core claim, so the rule lives in one place): group chosen pods by
+    node (queue rank ascending within a node), admit the prefix that
+    fits remaining capacity (``capacity_on`` is the trace-time
+    PodFitsResources gate), cap admissions per node per round.
+    ``sorted_gate(order2, seg_starts) -> (P,) bool`` lets the general
+    path AND in its port-conflict guard in the same sorted frame.
+    Returns the (P,) accepted mask in original row order."""
+    P = choice.shape[0]
+    big = jnp.int32(free.shape[0] + 1)
+    ckey = jnp.where(choice >= 0, choice, big)
+    order2 = jnp.lexsort((rank, ckey))  # grouped by chosen node, rank asc
+    c_s = choice[order2]
+    ckey_s = ckey[order2]  # sorted — safe for searchsorted
+    req_s = req[order2]
+    seg_starts = jnp.searchsorted(ckey_s, ckey_s, side="left")
+    prefix = _segment_prefix(req_s, seg_starts)  # usage by earlier pods
+    free_s = free[jnp.clip(c_s, 0, free.shape[0] - 1)]
+    if capacity_on:
+        fits = jnp.all(prefix + req_s <= free_s + 1e-6, axis=1)
+    else:
+        # a Policy bypassing PodFitsResources must also bypass the
+        # in-round capacity admission guard (it exists only to keep
+        # same-round co-admissions consistent with that predicate)
+        fits = jnp.ones((P,), bool)
+    within = jnp.arange(P, dtype=jnp.int32) - seg_starts
+    acc_s = (c_s >= 0) & fits & (within < per_node_cap)
+    if sorted_gate is not None:
+        acc_s = acc_s & sorted_gate(order2, seg_starts)
+    return acc_s[_inverse_permutation(order2)]
+
+
+def _lean_rounds(pods, nodes, sel, rank, lean_plan, max_rounds,
+                 per_node_cap, enabled_mask):
+    """The fused round loop for lean batches (see the routing comment in
+    :func:`_batch_impl`). Same carry, same three-exit cond, same
+    admission rule; one materialized (P, N) matrix per round."""
+    from kubernetes_tpu.ops.predicates import BIT
+
+    P = pods.req.shape[0]
+    N = nodes.allocatable.shape[0]
+    static_reasons, _prog = static_predicate_reasons(pods, nodes, sel)
+    if enabled_mask is not None:
+        static_reasons = static_reasons & jnp.int32(enabled_mask)
+    static_ok = (static_reasons == 0) & nodes.valid[None, :] \
+        & pods.valid[:, None]
+    res_on = enabled_mask is None or bool(
+        enabled_mask & (1 << BIT["PodFitsResources"]))
+    window = N * per_node_cap
+    guard = NEG * 0.5  # real scores are finite and tiny next to NEG
+
+    def round_body(carry):
+        assigned, u, _, rnd, use_plan, sk_stats = carry
+        active = (assigned == -1) & pods.valid
+        ms = _lean_masked_score(pods, nodes, u, active, static_ok, res_on,
+                                lean_plan)
+        rowmax = jnp.max(ms, axis=1, keepdims=True)
+        feasible_any = rowmax[:, 0] > guard
+        wkey = jnp.where(active & feasible_any, rank, jnp.int32(P + 1))
+        arank = _inverse_permutation(jnp.argsort(wkey))
+        if P > window:
+            # the bidder window only binds when more pods than window
+            # slots exist — a trace-time fact, so small batches compile
+            # it out entirely (arank < window is vacuous there)
+            gate = active & feasible_any & (arank < window)
+            ms = jnp.where(gate[:, None], ms, NEG)
+            rowmax = jnp.max(ms, axis=1, keepdims=True)
+        # tied bits are derived views over ms — never materialized as a
+        # (P, N) matrix; the block gather re-derives its one (P, BL)
+        # slice from ms directly
+        N_ = ms.shape[1]
+        BL = min(64, N_)
+        row_live = rowmax > guard  # (P, 1)
+        if N_ % BL:
+            # non-bucketed node axis: materialize tied once and use the
+            # shared fallback (see _blocked_pick)
+            choice, _tc = _blocked_pick((ms >= rowmax) & row_live, arank)
+        else:
+            ms3 = ms.reshape(P, N_ // BL, BL)
+            t3 = (ms3 >= rowmax[:, :, None]) & row_live[:, :, None]
+
+            def gather_block(bidx):
+                blk_ms = jnp.take_along_axis(
+                    ms3, bidx[:, None, None], axis=1)[:, 0, :]  # (P, BL)
+                return (blk_ms >= rowmax) & row_live
+
+            choice, _tc = _blocked_pick_core(t3, gather_block, arank)
+        feasible = jnp.take_along_axis(
+            ms, choice[:, None], axis=1)[:, 0] > guard
+        choice = jnp.where(feasible, choice, -1)
+        # shared score-ordered per-node admission — the port/topology
+        # guards the lean gates prove vacuous are simply absent
+        accepted = _admit_scored(choice, rank, pods.req,
+                                 nodes.allocatable - u.requested,
+                                 per_node_cap, res_on)
+        new_assigned = jnp.where(accepted, choice, assigned)
+        u = _apply_batch(u, pods, jnp.where(accepted, choice, 0), accepted)
+        return (new_assigned, u, jnp.any(accepted), rnd + 1, use_plan,
+                sk_stats)
+
+    def cond(carry):
+        assigned, _, progressed, rnd, _, _ = carry
+        return (progressed & (rnd < max_rounds)
+                & jnp.any((assigned == -1) & pods.valid))
+
+    init = (jnp.full((P,), -1, jnp.int32), usage_from_nodes(nodes),
+            jnp.asarray(True), jnp.asarray(0, jnp.int32),
+            jnp.asarray(False), jnp.full((2,), -1.0, jnp.float32))
+    assigned, u, _, rounds, _, sk_stats = jax.lax.while_loop(
+        cond, round_body, init)
+    return assigned, u, rounds, sk_stats
+
+
 @partial(jax.jit, static_argnames=("weights_key", "max_rounds", "per_node_cap",
                                    "use_sinkhorn", "skip_key", "no_ports",
                                    "no_pod_affinity", "no_spread",
                                    "fused_score", "auto_sinkhorn",
-                                   "with_stats"))
+                                   "with_stats", "enabled_mask"))
 def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
                 extra_mask, vol=None, static_vol=None, enabled_mask=None,
                 extra_score=None, use_sinkhorn=False, skip_key=(),
@@ -290,7 +571,28 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
                                  for k in _PREFERENCE_KERNELS))
     P = pods.req.shape[0]
     perm = queue_order(pods)
-    rank = jnp.zeros((P,), jnp.int32).at[perm].set(jnp.arange(P, dtype=jnp.int32))
+    rank = _inverse_permutation(perm)
+    # ---- fused lean round path (trace-time routed) -----------------------
+    # Constraint-light batches — no topology/volume/port coupling, no
+    # extender/plugin mask or score, argmax tie-break, and a provably
+    # exact lean scoring plan — run a round body that materializes ONE
+    # (P, N) f32 matrix per round (the masked score) instead of the
+    # general path's reasons + mask + per-kernel score temporaries, and
+    # pick tied columns with the blocked selection instead of the (P, N)
+    # cumsum + argmax. Placements are bit-identical to the general path
+    # (identical mask/score arithmetic, rotation tie-break, and
+    # score-ordered admission — pinned by the tests/test_fused_validate.py
+    # parity suite): on the CPU headline shape this is the difference
+    # between losing to and beating the sequential oracle (see
+    # docs/perf.md readback budget).
+    lean_plan = None
+    if (topo is None and vol is None and static_vol is None
+            and extra_mask is None and extra_score is None and no_ports
+            and not use_sinkhorn and not auto_sinkhorn):
+        lean_plan = _lean_score_plan(weights_key, skip_key)
+    if lean_plan is not None:
+        return _lean_rounds(pods, nodes, sel, rank, lean_plan, max_rounds,
+                            per_node_cap, enabled_mask)
     # pods carrying host ports or attach-counted/conflict-checked volumes
     # are admitted at most one per node per round (conservative, exact):
     # their feasibility couples across same-round admissions to one node
@@ -343,8 +645,9 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
                            no_pod_affinity=no_pod_affinity,
                            no_spread=no_spread).mask
             & active[:, None]
-            & extra_mask
         )
+        if extra_mask is not None:
+            mask = mask & extra_mask
         score = run_priorities(pods, cur, sel, mask, weights, topo,
                                skip=skip_key, hoisted=hoisted_prio,
                                fused=fused_score)
@@ -362,10 +665,7 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
         # the batch as affinity targets land).
         feasible_any = jnp.any(mask, axis=1)
         wkey = jnp.where(active & feasible_any, rank, jnp.int32(P + 1))
-        worder = jnp.argsort(wkey)
-        arank = jnp.zeros((P,), jnp.int32).at[worder].set(
-            jnp.arange(P, dtype=jnp.int32)
-        )
+        arank = _inverse_permutation(jnp.argsort(wkey))
         window = nodes.allocatable.shape[0] * per_node_cap
         # pre-window feasibility, kept for the auto-router: the window
         # admits only the next K bidders, so a tie-contention cohort
@@ -483,59 +783,37 @@ def _batch_impl(pods, nodes, sel, topo, weights_key, max_rounds, per_node_cap,
                 lambda: (argmax_tied, prev_stats))
         else:
             tied = argmax_tied
-        # tie-position bookkeeping: counts are bounded by N, so the (P, N)
-        # cumsum rides int16 when N fits (half the memory traffic of the
-        # bandwidth-bound pass — profile finding, solver_profile_cpu.json)
-        # and the row total is the cumsum's last column instead of a
-        # second full reduction; integer arithmetic, bit-identical picks
-        cdtype = (jnp.int16 if nodes.allocatable.shape[0] <= 32766
-                  else jnp.int32)
-        pos = jnp.cumsum(tied.astype(cdtype), axis=1)  # (P, N)
-        tcount = pos[:, -1].astype(jnp.int32)
-        rot = jnp.where(tcount > 0, arank % jnp.maximum(tcount, 1), 0)
-        pick = tied & (pos == (rot + 1)[:, None].astype(cdtype))
-        choice = jnp.argmax(pick, axis=1).astype(jnp.int32)  # (P,)
+        # rotation pick via the blocked two-level selection (bit-identical
+        # to the old full-width cumsum + argmax, which profiled at
+        # 23 ms + 16 ms per round on XLA:CPU — see _blocked_pick)
+        choice, _tcount = _blocked_pick(tied, arank)  # (P,)
         feasible = jnp.take_along_axis(mask, choice[:, None], axis=1)[:, 0]
         choice = jnp.where(feasible, choice, -1)
 
         # ---- per-node acceptance: highest-priority prefix that fits ----
-        big = jnp.int32(nodes.allocatable.shape[0] + 1)
-        ckey = jnp.where(choice >= 0, choice, big)
-        order2 = jnp.lexsort((rank, ckey))  # grouped by chosen node, rank asc
-        c_s = choice[order2]
-        ckey_s = ckey[order2]  # sorted — safe for searchsorted
-        req_s = pods.req[order2]
-        seg_starts = jnp.searchsorted(ckey_s, ckey_s, side="left")
-        prefix = _segment_prefix(req_s, seg_starts)  # (P, R) usage by earlier pods
-        free = (nodes.allocatable - u.requested)  # (N, R)
-        free_s = free[jnp.clip(c_s, 0, free.shape[0] - 1)]
-        fits = jnp.all(prefix + req_s <= free_s + 1e-6, axis=1)
-        if enabled_mask is not None:
-            # a Policy that bypasses PodFitsResources must also bypass the
-            # in-round capacity admission guard (it exists only to keep
-            # same-round co-admissions consistent with that predicate)
-            from kubernetes_tpu.ops.predicates import BIT as _BIT
-
-            res_enforced = (
-                jnp.int32(enabled_mask) & jnp.int32(1 << _BIT["PodFitsResources"])
-            ) > 0
-            fits = fits | ~res_enforced
-        # admission cap: at most `per_node_cap` pods land on a node per
-        # round. All pods in a round score against the SAME usage state, so
+        # (shared spelling: _admit_scored). The admission cap exists
+        # because all pods in a round score against the SAME usage state:
         # unbounded admission herds the whole queue onto the current-best
         # node (usage-sensitive scores — LeastRequested, SelectorSpread —
-        # only update between rounds). A small cap turns each round into an
-        # auction step: nodes admit their best bidders, usage updates, the
-        # rest re-bid. cap=1 approaches the serial loop's packing quality;
-        # larger caps trade score fidelity for fewer rounds.
-        within = jnp.arange(P, dtype=jnp.int32) - seg_starts
-        cap_ok = within < per_node_cap
-        # one port-bearing pod per node per round (conservative, exact)
-        hp_s = has_port[order2].astype(jnp.int32)
-        hp_prefix = _segment_prefix(hp_s[:, None], seg_starts)[:, 0]
-        port_ok = (hp_s == 0) | (hp_prefix == 0)
-        acc_s = (c_s >= 0) & fits & cap_ok & port_ok
-        accepted = jnp.zeros((P,), bool).at[order2].set(acc_s)
+        # only update between rounds). A small cap turns each round into
+        # an auction step: nodes admit their best bidders, usage updates,
+        # the rest re-bid. cap=1 approaches the serial loop's packing
+        # quality; larger caps trade score fidelity for fewer rounds.
+        from kubernetes_tpu.ops.predicates import BIT as _BIT
+
+        res_on = enabled_mask is None or bool(
+            enabled_mask & (1 << _BIT["PodFitsResources"]))
+
+        def port_gate(order2, seg_starts):
+            # one port-bearing pod per node per round (conservative, exact)
+            hp_s = has_port[order2].astype(jnp.int32)
+            hp_prefix = _segment_prefix(hp_s[:, None], seg_starts)[:, 0]
+            return (hp_s == 0) | (hp_prefix == 0)
+
+        accepted = _admit_scored(choice, rank, pods.req,
+                                 nodes.allocatable - u.requested,
+                                 per_node_cap, res_on,
+                                 sorted_gate=port_gate)
 
         if sens is not None:
             from kubernetes_tpu.ops.topology import self_escape_active
@@ -641,12 +919,13 @@ def batch_assign(
     round (ops/priorities.py _fused_pair_normalize). Only engages when
     the regrouped accumulation is provably exact (all-stock kernels,
     integer weights) — bit-identical placements either way, pinned by
-    tests/test_priorities.py."""
+    tests/test_priorities.py.
+
+    ``extra_mask=None`` is a TRACE-TIME fact (not substituted with an
+    all-true matrix): clean batches route to the fused lean round path
+    (see _batch_impl) whose per-round device work — and therefore the
+    d2h readback wait at the host boundary — is several times smaller."""
     key = tuple(sorted(weights.items())) if weights is not None else None
-    if extra_mask is None:
-        extra_mask = jnp.ones(
-            (pods.req.shape[0], nodes.allocatable.shape[0]), bool
-        )
     if fused_score:
         # resolve the backend policy HERE so it becomes part of the jit
         # key: use_pallas() reads env + backend at call time, and a
@@ -671,7 +950,7 @@ def batch_assign(
     return assigned, u, rounds
 
 
-# graftlint: disable-scope=R2 -- the deliberate host boundary: trust-but-
+# graftlint: disable-scope=R2,R7 -- the deliberate host boundary: trust-but-
 # verify reads the solver's claimed result back ONCE per cycle to check it
 # before any pod binds; cheap O(P*R + N*R) numpy by design (see docstring)
 def validate_solution(
@@ -738,3 +1017,96 @@ def validate_solution(
         if np.any(over & pre_ok):
             return False, "capacity"
     return True, ""
+
+
+#: device_validate verdict-code vocabulary, in the same precedence order
+#: the host checker reports (index 0 = ok). Host-side decode:
+#: ``VALIDATE_REASONS[int(code)]``.
+VALIDATE_REASONS = ("", "shape", "dtype", "range", "invalid-node",
+                    "finiteness", "capacity")
+
+
+@partial(jax.jit, static_argnames=("enabled_mask",))
+def _device_validate_impl(assigned, usage_requested, pods, nodes,
+                          enabled_mask=None):
+    """Device half of :func:`device_validate`: every check
+    :func:`validate_solution` runs, as one jitted reduction over the
+    assignment — the verdict stays a pair of device scalars until the
+    driver's single end-of-solve readback."""
+    from kubernetes_tpu.ops.predicates import BIT
+
+    P = pods.req.shape[0]
+    valid = pods.valid
+    nvalid = nodes.valid
+    N = nvalid.shape[0]
+    a = assigned[:P]
+    if not jnp.issubdtype(a.dtype, jnp.integer):
+        # a lying solver returning floats: finiteness first, then the
+        # integer-valuedness check, then proceed on the floored values —
+        # the same precedence the host checker applies
+        fin_a_bad = ~jnp.all(jnp.isfinite(a))
+        dtype_bad = jnp.any(jnp.where(jnp.isfinite(a), a != jnp.floor(a),
+                                      False))
+        a = jnp.where(jnp.isfinite(a), a, -2.0).astype(jnp.int32)
+    else:
+        fin_a_bad = jnp.asarray(False)
+        dtype_bad = jnp.asarray(False)
+        a = a.astype(jnp.int32)
+    range_bad = jnp.any(valid & ((a < -1) | (a >= N)))
+    sel = valid & (a >= 0)
+    ac = jnp.clip(a, 0, N - 1)
+    invalid_node = jnp.any(sel & ~nvalid[ac])
+    fin_bad = ~jnp.all(jnp.isfinite(usage_requested))
+    res_on = enabled_mask is None or bool(
+        enabled_mask & (1 << BIT["PodFitsResources"]))
+    if res_on:
+        req = pods.req
+        base = nodes.requested
+        alloc = nodes.allocatable
+        w = sel.astype(req.dtype)[:, None]
+        add = jnp.zeros_like(base).at[jnp.where(sel, ac, 0)].add(req * w)
+        tol = 1e-5 * jnp.maximum(alloc, 1.0) + 1e-6
+        pre_ok = base <= alloc + tol
+        over = (base + add > alloc + tol) & nvalid[:, None] & (add > 0)
+        cap_bad = jnp.any(over & pre_ok)
+    else:
+        cap_bad = jnp.asarray(False)
+    code = jnp.where(
+        fin_a_bad, 5, jnp.where(
+            dtype_bad, 2, jnp.where(
+                range_bad, 3, jnp.where(
+                    invalid_node, 4, jnp.where(
+                        fin_bad, 5, jnp.where(cap_bad, 6, 0))))))
+    return code.astype(jnp.int32), jnp.sum(sel, dtype=jnp.int32)
+
+
+def device_validate(assigned, usage: UsageState, pods: DevicePods,
+                    nodes: DeviceNodes,
+                    enabled_mask: Optional[int] = None):
+    """Fused on-device twin of :func:`validate_solution` — the readback
+    killer: instead of materializing the assignment, the claimed usage,
+    and four node/pod tables on host to re-check capacity (six device
+    syncs per cycle), the whole verdict is computed on device and rides
+    the driver's ONE end-of-solve readback as two int32 scalars
+    ``(code, valid_count)``; decode with :data:`VALIDATE_REASONS`.
+
+    Semantics are bit-matched to the host checker (pinned by the
+    randomized parity suite in tests/test_fused_validate.py) with two
+    host-visible shortcuts kept on host because they read metadata only:
+    a result that is not array-like at all, or whose shape cannot cover
+    the batch, never reaches the device. The one caveat: the capacity
+    recomputation's f32 scatter-add may associate differently than the
+    host's sequential ``np.add.at``, so verdicts within one float ulp of
+    the relative tolerance boundary can differ — the host checker stays
+    the trust floor (``robustness.host_validate``) and the parity oracle.
+
+    Like the host checker this never trusts the solver's claimed usage
+    for feasibility — capacity is recomputed from the assignment itself;
+    the claimed usage is only checked for finiteness."""
+    shape = getattr(assigned, "shape", None)
+    dtype = getattr(assigned, "dtype", None)
+    if shape is None or dtype is None or len(shape) != 1 \
+            or shape[0] < pods.req.shape[0]:
+        return None  # host verdict: (False, "shape") — caller falls back
+    return _device_validate_impl(assigned, usage.requested, pods, nodes,
+                                 enabled_mask=enabled_mask)

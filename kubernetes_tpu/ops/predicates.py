@@ -111,7 +111,7 @@ def fit_error_message(rrow, nvalid, req, free, ready, net_unavail,
     import numpy as np
 
     hist: dict = {}
-    r = np.asarray(rrow)[nvalid]
+    r = np.asarray(rrow)[nvalid]  # graftlint: disable=R7 -- rows already read back at the declared boundary
     n = int(np.count_nonzero(nvalid))
     for name, b in BIT.items():
         fired = ((r >> b) & 1).astype(bool)
@@ -153,6 +153,56 @@ def fit_error_message(rrow, nvalid, req, free, ready, net_unavail,
             hist[msg] = hist.get(msg, 0) + cnt
     parts = sorted(f"{v} {k}" for k, v in hist.items())
     return f"0/{n} nodes are available: {', '.join(parts)}."
+
+
+def fit_error_message_from_counts(counts_row, insufficient_row, not_ready,
+                                  net_unavail, n_valid, req,
+                                  res_names) -> str:
+    """:func:`fit_error_message` rebuilt from the obs/explain.py device
+    reductions instead of the raw (P, N) reasons row — byte-identical
+    output (regression-pinned by tests/test_fused_validate.py), with the
+    per-node bit matrix never crossing the device boundary.
+
+    ``counts_row`` (B,) per-reason valid-node counts
+    (ExplainResult.per_pod[i]); ``insufficient_row`` (R,) the
+    per-resource Insufficient counts (ExplainResult.insufficient[i]);
+    ``not_ready``/``net_unavail`` the CheckNodeCondition splits;
+    ``n_valid`` the valid-node count; ``req`` (R,) the pod's request row
+    (host pack table)."""
+    hist: dict = {}
+    for name, b in BIT.items():
+        cnt = int(counts_row[b])
+        if not cnt:
+            continue
+        if name == "PodFitsResources":
+            nonzero = any(
+                req[ri] > 0 for ri in range(len(res_names))
+                if res_names[ri] != "pods"
+            )
+            cols = (
+                range(len(res_names)) if nonzero
+                else [res_names.index("pods")]
+            )
+            for ri in cols:
+                c = int(insufficient_row[ri])
+                if c:
+                    key = f"Insufficient {res_names[ri]}"
+                    hist[key] = hist.get(key, 0) + c
+        elif name == "CheckNodeCondition":
+            c_nr, c_nu = int(not_ready), int(net_unavail)
+            if c_nr:
+                hist["node(s) were not ready"] = (
+                    hist.get("node(s) were not ready", 0) + c_nr
+                )
+            if c_nu:
+                hist["node(s) had unavailable network"] = (
+                    hist.get("node(s) had unavailable network", 0) + c_nu
+                )
+        else:
+            msg = REASON_MESSAGES[name]
+            hist[msg] = hist.get(msg, 0) + cnt
+    parts = sorted(f"{v} {k}" for k, v in hist.items())
+    return f"0/{n_valid} nodes are available: {', '.join(parts)}."
 
 
 def selector_program_match(sel: DeviceSelectors, nodes: DeviceNodes) -> jnp.ndarray:

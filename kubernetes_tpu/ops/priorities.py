@@ -399,7 +399,7 @@ def empty_priorities(node_table, pod_table) -> tuple:
     if (node_table.avoid_mh.size == 0 or node_table.avoid_mh.sum() == 0
             or (pod_table.owner_uid_id < 0).all()):
         out.append("NodePreferAvoidPodsPriority")
-    if pod_table.limits is None or np.asarray(pod_table.limits).max(initial=0) <= 0:
+    if pod_table.limits is None or np.asarray(pod_table.limits).max(initial=0) <= 0:  # graftlint: disable=R7 -- host pack table, no device sync
         out.append("ResourceLimitsPriority")
     # topology scores: gate only with full evidence — no (anti)affinity on
     # any batch pod AND zero node-side anti/sym term counts (symmetry

@@ -35,7 +35,7 @@ NODE_AXIS = "nodes"
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """1-D mesh over all (or given) devices; the single axis shards nodes."""
     devices = list(devices) if devices is not None else jax.devices()
-    return Mesh(np.asarray(devices), (NODE_AXIS,))
+    return Mesh(np.asarray(devices), (NODE_AXIS,))  # graftlint: disable=R7 -- device HANDLES (host objects), not buffers
 
 
 def shard_nodes(nodes: DeviceNodes, mesh: Mesh) -> DeviceNodes:

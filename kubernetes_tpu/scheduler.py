@@ -858,9 +858,15 @@ class Scheduler:
         # the digest reads shape/dtype metadata only)
         self.obs.jax.record_call(
             "solve", dp, dn, ds, dt, dv,
+            # extra_mask/extra_score None-ness joins the digest: a clean
+            # batch routes to the fused lean round path (ops/assign.py),
+            # a different compiled program than the extender/plugin-fed
+            # one — without the flags an alternation would recompile
+            # invisibly to the retrace telemetry
             static=(solver, tuple(skip_prio), no_ports, no_pod_aff,
                     no_spread, self.pred_mask, self.per_node_cap,
-                    self.max_rounds),
+                    self.max_rounds, extra_mask is None,
+                    extra_score is None),
         )
         ladder = self._solve_ladder(
             solver, batch, dp, dn, ds, dt, dv, sv, base_fr, extra_mask,
@@ -880,9 +886,9 @@ class Scheduler:
             return res
         assigned, usage, rounds, tier_used = ladder
         res.solver_tier = tier_used
-        # d2h readback of the solver's answer — the declared host boundary
-        assigned = self.obs.jax.readback(
-            "solve-result", assigned)[: len(batch)].copy()  # writable
+        # the ladder already read the (validated) answer back as ONE
+        # fused d2h transfer — slice off the padding rows, writable copy
+        assigned = assigned[: len(batch)].copy()
 
         # gang scheduling (PodGroup all-or-nothing; the coscheduling-plugin
         # semantics BASELINE config 4 targets): a group binds only when ALL
@@ -919,69 +925,91 @@ class Scheduler:
         self.metrics.algorithm_duration.observe(solve_s)
 
         # reasons for the unplaced: one more filter pass against the
-        # post-assignment usage (what the serial loop would have seen last)
+        # post-assignment usage (what the serial loop would have seen
+        # last). EVERYTHING the host needs — per-pod reason bits,
+        # per-reason node counts, the per-resource Insufficient splits,
+        # the one-bit-away relaxations — is reduced ON DEVICE
+        # (obs/explain.explain_reduce) and read back as one small
+        # transfer; the raw (P, N) reasons matrix never crosses the
+        # boundary. Only preemption still needs per-node bits, gathered
+        # for exactly the pods that will attempt it (readback
+        # proportional to the answer, not the problem).
         failed_idx = [i for i, a in enumerate(assigned) if a < 0]
+        preemptable_idx = [i for i in failed_idx if i not in gang_failed]
         reasons_row: Dict[int, Tuple[str, ...]] = {}
         fit_msgs: Dict[int, str] = {}
-        rmat = None
+        ex = None
         ex_host = None
+        preempt_rows_dev = None
         if failed_idx:
-            from kubernetes_tpu.ops.predicates import fit_error_message
-            from kubernetes_tpu.snapshot import FIXED_RESOURCE_NAMES
+            from kubernetes_tpu.obs.explain import explain_reduce
 
             fr = _filter_pass(
                 dp, nodes_with_usage(dn, usage), ds, dt, dv, sv, self.pred_mask
             )
-            if getattr(self.obs.config, "explain", True):
-                # the why-pending reduction rides the SAME jitted reasons
-                # matrix and is read back at the same host boundary as
-                # the failure-reason sync below — the solve path gains no
-                # synchronization point (graftlint R2/R3 stay clean)
-                from kubernetes_tpu.obs.explain import explain_reduce
+            fm = np.zeros((dp.valid.shape[0],), bool)
+            fm[failed_idx] = True
+            ex = explain_reduce(
+                fr.reasons, dn.valid, jnp.asarray(fm), dp.req,
+                dn.allocatable - usage.requested, dn.ready,
+                dn.network_unavailable)
+            if self.enable_preemption and preemptable_idx:
+                preempt_rows_dev = jnp.take(
+                    fr.reasons,
+                    jnp.asarray(preemptable_idx, dtype=jnp.int32), axis=0)
 
-                fm = np.zeros((dp.valid.shape[0],), bool)
-                fm[failed_idx] = True
-                ex = explain_reduce(fr.reasons, dn.valid, jnp.asarray(fm))
+        bind_span = trace.begin_span("bind")
+        # bind the placed pods FIRST: admission is pure host work, so it
+        # overlaps the failure reductions still executing on device (JAX
+        # async dispatch — the monolithic cycle's readback overlap; the
+        # pipelined executor's pipeline:readback@k spans are the
+        # chunked analog)
+        for i, pod in enumerate(batch):
+            if int(assigned[i]) >= 0:
+                self._admit_pod(pod, node_order[int(assigned[i])], cycle,
+                                res)
+        if ex is not None:
+            from kubernetes_tpu.ops.predicates import (
+                fit_error_message_from_counts,
+            )
+            from kubernetes_tpu.snapshot import FIXED_RESOURCE_NAMES
+
+            with self.obs.span("pipeline:readback@reasons"):
                 ex_host = self.obs.jax.readback("explain", ex)._asdict()
-            rmat = self.obs.jax.readback("failure-reasons", fr.reasons)
-            nvalid = np.asarray(dn.valid)
-            free = np.asarray(dn.allocatable) - np.asarray(usage.requested)
-            reqs = np.asarray(dp.req)
-            ready = np.asarray(dn.ready)
-            netun = np.asarray(dn.network_unavailable)
             res_names = (list(FIXED_RESOURCE_NAMES)
-                         + pk.u.scalar_resources.items())[: reqs.shape[1]]
+                         + pk.u.scalar_resources.items())[: pt.req.shape[1]]
             for i in failed_idx:
-                # a pod's reason set = union over valid nodes of failed bits
-                bits = int(np.bitwise_or.reduce(rmat[i][nvalid])) if nvalid.any() else 0
+                # a pod's reason set = union over valid nodes of failed
+                # bits, reduced on device (zero when no node is valid)
+                bits = int(ex_host["pod_bits"][i])
                 reasons_row[i] = decode_reasons(bits)
                 if bits:
                     # FitError-shaped event text with per-reason node
-                    # counts ("2 Insufficient cpu, 3 node(s) had taints...")
-                    fit_msgs[i] = fit_error_message(
-                        rmat[i], nvalid, reqs[i], free, ready, netun, res_names
+                    # counts ("2 Insufficient cpu, 3 node(s) had
+                    # taints...") — byte-identical to the raw-matrix
+                    # construction, from the reductions alone
+                    fit_msgs[i] = fit_error_message_from_counts(
+                        ex_host["per_pod"][i], ex_host["insufficient"][i],
+                        ex_host["not_ready"][i], ex_host["net_unavail"][i],
+                        nt.n, pt.req[i], res_names,
                     )
-
-        bind_span = trace.begin_span("bind")
         for i, pod in enumerate(batch):
-            target = int(assigned[i])
-            if target < 0:
-                if i in early_fail:
-                    reasons = (early_fail[i],)
-                elif i in gang_failed:
-                    reasons = (gang_failed[i],)
-                else:
-                    reasons = reasons_row.get(i, ())
-                # only filter-pass failures carry the FitError text; gang
-                # rollbacks and plugin failures keep their own status (a
-                # gang member may fit everywhere — a fabricated "0/N nodes
-                # are available" would be a lie)
-                msg = (fit_msgs.get(i)
-                       if i not in early_fail and i not in gang_failed
-                       else None)
-                self._fail(pod, cycle, res, reasons, message=msg)
+            if int(assigned[i]) >= 0:
                 continue
-            self._admit_pod(pod, node_order[target], cycle, res)
+            if i in early_fail:
+                reasons = (early_fail[i],)
+            elif i in gang_failed:
+                reasons = (gang_failed[i],)
+            else:
+                reasons = reasons_row.get(i, ())
+            # only filter-pass failures carry the FitError text; gang
+            # rollbacks and plugin failures keep their own status (a
+            # gang member may fit everywhere — a fabricated "0/N nodes
+            # are available" would be a lie)
+            msg = (fit_msgs.get(i)
+                   if i not in early_fail and i not in gang_failed
+                   else None)
+            self._fail(pod, cycle, res, reasons, message=msg)
 
         trace.end_span(bind_span)
         trace.step(f"bound {res.scheduled}, failed {res.unschedulable}")
@@ -996,9 +1024,17 @@ class Scheduler:
                 cycle, batch, failed_idx, ex_host, nt.n, res)
 
         # preemption (scheduler.go:493 -> preempt, §3.3): failed pods try to
-        # evict lower-priority pods; winners get a nominated node and retry
-        preemptable_idx = [i for i in failed_idx if i not in gang_failed]
-        if self.enable_preemption and preemptable_idx and rmat is not None:
+        # evict lower-priority pods; winners get a nominated node and retry.
+        # The per-node reason bits preemption needs are gathered on device
+        # for exactly the preemptable rows — (F, N) across the boundary,
+        # zero bytes on cycles where nothing failed
+        if (self.enable_preemption and preemptable_idx
+                and preempt_rows_dev is not None):
+            with self.obs.span("pipeline:readback@preempt"):
+                rows = self.obs.jax.readback("preempt-reasons",
+                                             preempt_rows_dev)
+            rmat = np.zeros((len(batch), rows.shape[1]), rows.dtype)
+            rmat[preemptable_idx] = rows
             pt0 = self.clock()
             with self.obs.span("preemption"):
                 self._run_preemption(
@@ -1240,6 +1276,65 @@ class Scheduler:
             return assigned, usage, rounds
         return out
 
+    def _validated_readback(self, tier, out, dp, dn):
+        """Validate one tier's result and read it back as ONE d2h
+        transfer — the fused solve+validate boundary. The verdict is
+        computed ON DEVICE (ops/assign.device_validate: range /
+        invalid-node / finiteness / capacity recomputation, never
+        trusting the solver's claimed usage) and rides the same readback
+        as the assignment and round count, so a healthy cycle's solve
+        path syncs exactly once. The host checker
+        (ops/assign.validate_solution) remains the trust floor: it takes
+        over when the result can't even reach the device (shape),
+        whenever ``robustness.host_validate`` forces it, and as the
+        parity oracle in tests/test_fused_validate.py.
+
+        Returns ``(assigned_host, usage_dev, rounds_int)`` or raises
+        SolverResultInvalid with the same reason vocabulary the host
+        checker uses (the verdict gates binding exactly as before)."""
+        from kubernetes_tpu.faults import SolverResultInvalid
+        from kubernetes_tpu.ops.assign import (
+            VALIDATE_REASONS,
+            device_validate,
+            validate_solution,
+        )
+
+        rc = self.robustness
+        a_dev, u_dev, rounds = out
+        dv_out = None
+        if rc.validate_results and not rc.host_validate:
+            with self.obs.span("validate"):
+                dv_out = device_validate(a_dev, u_dev, dp, dn,
+                                         self.pred_mask)
+                if dv_out is None:
+                    # not array-shaped enough to reach the device: the
+                    # host checker renders the verdict (shape/dtype)
+                    ok, why = validate_solution(a_dev, u_dev, dp, dn,
+                                                self.pred_mask)
+                    if not ok:
+                        self.metrics.solver_rejections.inc(
+                            tier=tier, reason=why)
+                        raise SolverResultInvalid(f"{tier}: {why}")
+        elif rc.validate_results:
+            with self.obs.span("validate"):
+                ok, why = validate_solution(a_dev, u_dev, dp, dn,
+                                            self.pred_mask)
+                if not ok:
+                    self.metrics.solver_rejections.inc(tier=tier,
+                                                       reason=why)
+                    raise SolverResultInvalid(f"{tier}: {why}")
+        payload = {"assigned": a_dev, "rounds": rounds}
+        if dv_out is not None:
+            payload["code"], payload["valid"] = dv_out
+        host = self.obs.jax.readback("solve-result", payload)
+        code = int(host.get("code", 0))
+        if code:
+            why = VALIDATE_REASONS[code]
+            self.metrics.solver_rejections.inc(tier=tier, reason=why)
+            raise SolverResultInvalid(f"{tier}: {why}")
+        # device_get already materialized host["assigned"] as numpy
+        return host["assigned"], u_dev, int(host["rounds"])
+
     def _solve_ladder(self, solver, batch, dp, dn, ds, dt, dv, sv, base_fr,
                       extra_mask, extra_score, skip_prio, no_ports,
                       no_pod_aff, no_spread, res):
@@ -1248,10 +1343,11 @@ class Scheduler:
         batch → the greedy sequential oracle), with per-tier circuit
         breakers, bounded in-cycle retries, deadline-aware skip-to-oracle,
         and result validation so a lying solver can never bind an
-        infeasible pod. Returns (assigned, usage, rounds, tier) or None
-        when every tier failed (the caller requeues the whole batch)."""
+        infeasible pod. Returns (assigned_host, usage, rounds, tier) —
+        the assignment ALREADY read back (one fused d2h transfer, see
+        :meth:`_validated_readback`) — or None when every tier failed
+        (the caller requeues the whole batch)."""
         from kubernetes_tpu.faults import SolverResultInvalid
-        from kubernetes_tpu.ops.assign import validate_solution
 
         rc = self.robustness
         tiers = [solver]
@@ -1300,14 +1396,10 @@ class Scheduler:
                             extra_mask, extra_score, skip_prio, no_ports,
                             no_pod_aff, no_spread,
                         )
-                        if rc.validate_results:
-                            with self.obs.span("validate"):
-                                ok, why = validate_solution(
-                                    out[0], out[1], dp, dn, self.pred_mask)
-                            if not ok:
-                                m.solver_rejections.inc(tier=tier, reason=why)
-                                raise SolverResultInvalid(f"{tier}: {why}")
-                        result = out
+                        # fused validate + single readback (raises
+                        # SolverResultInvalid on a lying solver, exactly
+                        # as the host checker did)
+                        result = self._validated_readback(tier, out, dp, dn)
                     except Exception as e:
                         last_err = e
                     finally:
@@ -1333,7 +1425,7 @@ class Scheduler:
             i += 1
         return None
 
-    # graftlint: disable-scope=R2 -- host oracle by design: the exact tier
+    # graftlint: disable-scope=R2,R7 -- host oracle by design: the exact tier
     # runs the Hungarian solver on CPU, so the one filter+score result is
     # read back wholesale here; the ladder only enters this tier when
     # quality beats wall-clock (gang/offline packing)
@@ -1470,18 +1562,13 @@ class Scheduler:
         whole cycle runs a single solver jit signature."""
         import numpy as np
 
-        from kubernetes_tpu.faults import SolverResultInvalid
         from kubernetes_tpu.ops.assign import (
             batch_assign,
             greedy_assign,
             nodes_with_usage,
-            validate_solution,
         )
         from kubernetes_tpu.ops.arrays import volumes_to_device
-        from kubernetes_tpu.ops.predicates import (
-            decode_reasons,
-            fit_error_message,
-        )
+        from kubernetes_tpu.ops.predicates import decode_reasons
         from kubernetes_tpu.snapshot import FIXED_RESOURCE_NAMES
 
         pk = self.cache.packer
@@ -1495,7 +1582,7 @@ class Scheduler:
         solver = self.solver
         statics = (solver, tuple(skip_prio), no_ports, no_pod_aff,
                    no_spread, self.pred_mask, self.per_node_cap,
-                   self.max_rounds)
+                   self.max_rounds, True, True)  # no extra mask/score
         hook = (self.fault_injector.solver_hook
                 if self.fault_injector is not None else None)
 
@@ -1568,10 +1655,11 @@ class Scheduler:
                 return out
 
         def settle(k, packed, out, dn_in):
-            """Block on chunk k's result, validate it, and fall back to
-            the full degradation ladder on any failure (the chunk then
-            runs with depth-1 semantics). Returns (assigned host array or
-            None, usage, tier)."""
+            """Block on chunk k's result — validated on device, verdict
+            riding the chunk's ONE readback (_validated_readback) — and
+            fall back to the full degradation ladder on any failure (the
+            chunk then runs with depth-1 semantics). Returns (assigned
+            host array or None, usage, tier)."""
             nonlocal solve_s
             chunk = chunks[k]
             dp_c, dv_c, sv_c = packed
@@ -1579,20 +1667,12 @@ class Scheduler:
             ts = self.clock()
             if out is not None:
                 try:
-                    a_dev, u_dev, rounds = out
                     with self.obs.span(f"pipeline:readback@{k}"):
-                        a = self.obs.jax.readback(
-                            "solve-result", a_dev)[: len(chunk)].copy()
-                    if rc.validate_results:
-                        with self.obs.span("validate"):
-                            ok, why = validate_solution(
-                                a_dev, u_dev, dp_c, dn_in, self.pred_mask)
-                        if not ok:
-                            self.metrics.solver_rejections.inc(
-                                tier=solver, reason=why)
-                            raise SolverResultInvalid(f"{solver}: {why}")
+                        a, u_dev, rounds = self._validated_readback(
+                            solver, out, dp_c, dn_in)
+                    a = a[: len(chunk)].copy()
                     br.record_success()
-                    res.rounds += int(rounds)
+                    res.rounds += rounds
                     solve_s += self.clock() - ts
                     return a, u_dev, solver
                 except Exception as e:
@@ -1612,9 +1692,8 @@ class Scheduler:
                     self._fail(pod, cycle, res, ("SolverUnavailable",))
                 solve_s += self.clock() - ts
                 return None, None, ""
-            a_dev, u_dev, rounds, tier = ladder
-            a = self.obs.jax.readback(
-                "solve-result", a_dev)[: len(chunk)].copy()
+            a_host, u_dev, rounds, tier = ladder
+            a = a_host[: len(chunk)].copy()
             res.rounds += int(rounds)
             solve_s += self.clock() - ts
             return a, u_dev, tier
@@ -1622,43 +1701,53 @@ class Scheduler:
         def chunk_failures(k, offset, a, packed):
             """Failure reasons + explain for chunk k's unplaced pods,
             evaluated against the post-chunk usage view (what the serial
-            loop would have seen last)."""
+            loop would have seen last). Everything is reduced on device
+            (obs/explain.explain_reduce) and read back small; per-node
+            bit rows are gathered for the failed pods only — preemption
+            fodder proportional to the failures, not the chunk."""
             failed_idx = [i for i, t in enumerate(a) if t < 0]
             if not failed_idx:
                 return
             dp_c, dv_c, sv_c = packed
+            from kubernetes_tpu.obs.explain import explain_reduce
+            from kubernetes_tpu.ops.predicates import (
+                fit_error_message_from_counts,
+            )
+
             fr = _filter_pass(dp_c, dn_cur, ds, dt, dv_c, sv_c,
                               self.pred_mask)
+            fm = np.zeros((dp_c.valid.shape[0],), bool)
+            fm[failed_idx] = True
+            ex = explain_reduce(
+                fr.reasons, dn_cur.valid, jnp.asarray(fm), dp_c.req,
+                dn_cur.allocatable - dn_cur.requested, dn_cur.ready,
+                dn_cur.network_unavailable)
+            rows_dev = None
+            if self.enable_preemption:
+                rows_dev = jnp.take(
+                    fr.reasons, jnp.asarray(failed_idx, dtype=jnp.int32),
+                    axis=0)
+            ex_h = self.obs.jax.readback("explain", ex)._asdict()
             if explain_on:
-                from kubernetes_tpu.obs.explain import explain_reduce
-
-                fm = np.zeros((dp_c.valid.shape[0],), bool)
-                fm[failed_idx] = True
-                ex = explain_reduce(fr.reasons, dn_cur.valid,
-                                    jnp.asarray(fm))
-                ex_parts.append(
-                    (offset, len(chunks[k]),
-                     self.obs.jax.readback("explain", ex)._asdict()))
-            rmat = self.obs.jax.readback("failure-reasons", fr.reasons)
-            nvalid = np.asarray(dn_cur.valid)
-            free = (np.asarray(dn_cur.allocatable)
-                    - np.asarray(dn_cur.requested))
-            reqs = np.asarray(dp_c.req)
-            ready = np.asarray(dn_cur.ready)
-            netun = np.asarray(dn_cur.network_unavailable)
+                ex_parts.append((offset, len(chunks[k]), ex_h))
+            if rows_dev is not None:
+                rows = self.obs.jax.readback("preempt-reasons", rows_dev)
+            n_valid = nt.n
+            pt_c = pk.pack_pods(chunks[k])  # host rows (pack memo hit)
             res_names = (list(FIXED_RESOURCE_NAMES)
-                         + pk.u.scalar_resources.items())[: reqs.shape[1]]
-            for i in failed_idx:
+                         + pk.u.scalar_resources.items())[: pt_c.req.shape[1]]
+            for j, i in enumerate(failed_idx):
                 g = offset + i
-                bits = (int(np.bitwise_or.reduce(rmat[i][nvalid]))
-                        if nvalid.any() else 0)
+                bits = int(ex_h["pod_bits"][i])
                 reasons_row[g] = decode_reasons(bits)
-                rmat_rows[g] = rmat[i]
+                if rows_dev is not None:
+                    rmat_rows[g] = rows[j]
                 failed_global.append(g)
                 if bits:
-                    fit_msgs[g] = fit_error_message(
-                        rmat[i], nvalid, reqs[i], free, ready, netun,
-                        res_names)
+                    fit_msgs[g] = fit_error_message_from_counts(
+                        ex_h["per_pod"][i], ex_h["insufficient"][i],
+                        ex_h["not_ready"][i], ex_h["net_unavail"][i],
+                        n_valid, pt_c.req[i], res_names)
 
         def bind_chunk(k, offset, a):
             with self.obs.span(f"pipeline:bind@{k}"):
@@ -1717,13 +1806,14 @@ class Scheduler:
                     "pods_blocked": np.zeros((B,), np.int64),
                 }
                 for off, n, part in ex_parts:
+                    # parts are host arrays already (readback output)
                     for f in ("per_pod", "one_bit", "best_bit",
                               "best_gain", "feasible"):
-                        ex_host[f][off:off + n] = np.asarray(part[f])[:n]
-                    ex_host["pair_hist"] += np.asarray(
-                        part["pair_hist"], np.int64)
-                    ex_host["pods_blocked"] += np.asarray(
-                        part["pods_blocked"], np.int64)
+                        ex_host[f][off:off + n] = part[f][:n]
+                    ex_host["pair_hist"] += part["pair_hist"].astype(
+                        np.int64)
+                    ex_host["pods_blocked"] += part["pods_blocked"].astype(
+                        np.int64)
             self._build_explain_report(
                 cycle, batch, sorted(failed_global), ex_host, nt.n, res)
 
@@ -1756,7 +1846,9 @@ class Scheduler:
         ]
         if not interested:
             return None, None
-        base = np.asarray(base_fr.mask)
+        # the built-in-feasible mask crosses to host for the extender
+        # HTTP fan-out — a real d2h boundary, declared + accounted
+        base = self.obs.jax.readback("extender-mask", base_fr.mask)
         rows = {n: j for j, n in enumerate(node_order)}
         nodes_by_name = {nd.name: nd for nd in self.cache.nodes()}
         em = np.ones(base.shape, bool)
@@ -2094,7 +2186,11 @@ class Scheduler:
         Returns the number of bucketed shapes compiled."""
         import jax
 
-        from kubernetes_tpu.ops.assign import batch_assign, greedy_assign
+        from kubernetes_tpu.ops.assign import (
+            batch_assign,
+            device_validate,
+            greedy_assign,
+        )
 
         wu = self.warmup_config
         pk = self.cache.packer
@@ -2130,7 +2226,7 @@ class Scheduler:
         solver = self.solver if self.solver != "exact" else "batch"
         statics = (solver, tuple(skip_prio), no_ports, no_pod_aff,
                    no_spread, self.pred_mask, self.per_node_cap,
-                   self.max_rounds)
+                   self.max_rounds, True, True)  # no extra mask/score
         buckets = tuple(wu.pod_buckets)
         if not buckets:
             # geometric x2 steps up to bucket_size(max_batch) — the
@@ -2164,7 +2260,7 @@ class Scheduler:
             self.obs.jax.record_call("solve", dp, dn, ds, dt, dv,
                                      static=statics, warmup=True)
             if solver == "greedy":
-                a, _u = greedy_assign(
+                a, wu_usage = greedy_assign(
                     dp, dn, ds, self.weights, topo=dt, vol=dv,
                     static_vol=sv,
                     enabled_mask=self.pred_mask, skip_priorities=skip_prio,
@@ -2181,7 +2277,16 @@ class Scheduler:
                     no_pod_affinity=no_pod_aff, no_spread=no_spread,
                     stats_out=self.obs.config.sinkhorn_telemetry,
                 )
-                a = out[0]
+                a, wu_usage = out[0], out[1]
+            if (self.robustness.validate_results
+                    and not self.robustness.host_validate):
+                # the fused validator rides every production cycle's
+                # readback — compile its program per bucket here too, or
+                # the first real cycle pays it on the hot path
+                dv_out = device_validate(a, wu_usage, dp, dn,
+                                         self.pred_mask)
+                if dv_out is not None:
+                    jax.block_until_ready(dv_out[0])
             jax.block_until_ready(a)
             if wu.include_filter:
                 fr = _filter_pass(dp, dn, ds, dt, dv, sv,

@@ -31,7 +31,12 @@ are shared and noisy; tighten for dedicated hardware):
   section that carries the per-run ``jax`` telemetry (headline +
   variant grid) must show ZERO retraces on its warm run — shape
   bucketing + AOT warmup exist precisely to pin
-  ``scheduler_jax_retrace_total`` flat under queue churn.
+  ``scheduler_jax_retrace_total`` flat under queue churn;
+- readback gate (PR 7): headline ``readback_s`` and d2h
+  ``readback_bytes_per_pod`` must not regress — the fused
+  solve+validate boundary keeps the per-cycle transfer proportional to
+  the answer, and this gate keeps it that way (absence-tolerant for
+  records predating the accounting).
 
 Sustained-churn gates ride alongside (scripts/bench_churn.py records):
 the two newest ``benchres/churn_r*.json`` are diffed on the serving
@@ -137,6 +142,24 @@ def compare(prev: dict, cur: dict, threshold: float,
         check(f"{name}.pack_s", pv, cv, lower_is_better=True)
 
     check_pack("headline", ph, ch)
+
+    def check_readback(name: str, prev_sec, cur_sec):
+        """Readback gate (PR 7): headline readback_s and d2h
+        bytes-per-pod must not regress — the fused solve+validate
+        boundary's win must not silently erode. Absence-tolerant like
+        the churn gates: records predating the byte accounting (or the
+        split) skip silently."""
+        pv, cv = _num((prev_sec or {}).get("readback_s")), \
+            _num((cur_sec or {}).get("readback_s"))
+        if pv is not None and cv is not None:
+            check(f"{name}.readback_s", pv, cv, lower_is_better=True)
+        pb = _num((prev_sec or {}).get("readback_bytes_per_pod"))
+        cb = _num((cur_sec or {}).get("readback_bytes_per_pod"))
+        if pb is not None and cb is not None:
+            check(f"{name}.readback_bytes_per_pod", pb, cb,
+                  lower_is_better=True)
+
+    check_readback("headline", ph, ch)
 
     pv_variants = prev.get("extras", {}).get("variants") or {}
     cv_variants = cur.get("extras", {}).get("variants") or {}

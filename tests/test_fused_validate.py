@@ -1,0 +1,438 @@
+"""Fused on-device solve+validate — the readback-wall suite (PR 7).
+
+Three contracts, all seeded and deterministic:
+
+1. **Validator parity**: for fuzzed solutions — honest ones and every
+   corruption class a lying solver can produce (floats, NaN, range,
+   invalid node, over-capacity, truncated shape) — the on-device verdict
+   (``ops/assign.device_validate``) must match the host trust floor
+   (``validate_solution``) bit-for-bit, verdict AND reason string.
+2. **Lean-round parity**: the fused lean round path (one materialized
+   matrix per round) must place bit-identically to the general round
+   path — forced by handing the general path an all-true ``extra_mask``
+   (a no-op input whose mere presence routes around the lean branch).
+3. **Explain fidelity**: FitError messages rebuilt from the device
+   reductions (``fit_error_message_from_counts``) must be byte-identical
+   to the raw-matrix construction, and the driver's /debug/why rows +
+   event texts must carry exactly those bytes — the raw (P, N) reasons
+   matrix never crosses the boundary on the hot path.
+
+Plus the chaos-suite entry: a corrupted result rejected by the FUSED
+verdict still demotes through the PR-1 ladder to the oracle, with the
+host checker available as the configured fallback (host_validate).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import pyref  # noqa: F401  (sys.path side effect, like the sibling suites)
+from kubernetes_tpu.config import RobustnessConfig
+from kubernetes_tpu.faults import FaultInjector
+from kubernetes_tpu.obs.explain import explain_reduce
+from kubernetes_tpu.ops.arrays import (
+    nodes_to_device,
+    pods_to_device,
+    selectors_to_device,
+)
+from kubernetes_tpu.ops.assign import (
+    VALIDATE_REASONS,
+    batch_assign,
+    device_validate,
+    usage_from_nodes,
+    validate_solution,
+    _apply_batch,
+)
+from kubernetes_tpu.ops.predicates import (
+    fit_error_message,
+    fit_error_message_from_counts,
+)
+from kubernetes_tpu.scheduler import Scheduler, _filter_pass
+from kubernetes_tpu.snapshot import FIXED_RESOURCE_NAMES
+from kubernetes_tpu.testing import make_node, make_pod
+from test_predicates import random_cluster
+
+
+def build(nodes, scheduled, pending):
+    from kubernetes_tpu.snapshot import SnapshotPacker
+
+    pk = SnapshotPacker()
+    for p in list(scheduled) + list(pending):
+        pk.intern_pod(p)
+    nt = pk.pack_nodes(nodes, scheduled)
+    pt = pk.pack_pods(pending)
+    st = pk.pack_selector_tables()
+    return (nodes_to_device(nt), pods_to_device(pt),
+            selectors_to_device(st), nt, pt, pk)
+
+
+def _solve(dp, dn, ds, **kw):
+    a, u, _ = batch_assign(dp, dn, ds, **kw)
+    return np.asarray(a), u
+
+
+def _dev_verdict(assigned, usage, dp, dn, enabled_mask=None):
+    out = device_validate(assigned, usage, dp, dn, enabled_mask)
+    if out is None:
+        return False, "shape"
+    code, _count = out
+    code = int(code)
+    return code == 0, VALIDATE_REASONS[code]
+
+
+# ---------------------------------------------------------------------------
+# 1. validator parity (device verdict == host verdict, bit for bit)
+# ---------------------------------------------------------------------------
+
+
+def _corruptions(rng, a, n_nodes, n_valid_nodes):
+    """(tag, corrupted assignment) pairs covering every verdict class."""
+    P = a.shape[0]
+    i = rng.randrange(P)
+    yield "honest", a
+    fa = a.astype(np.float32)
+    yield "float-integral", fa  # floats, but integer-valued: still valid
+    nf = fa.copy()
+    nf[i] = 0.5
+    yield "float-fractional", nf
+    nn = fa.copy()
+    nn[i] = np.nan
+    yield "nan", nn
+    hi = a.copy()
+    hi[i] = n_nodes + 3
+    yield "range-high", hi
+    lo = a.copy()
+    lo[i] = -7
+    yield "range-low", lo
+    if n_valid_nodes < n_nodes:  # padding rows exist
+        pad = a.copy()
+        pad[i] = n_nodes - 1
+        yield "invalid-node", pad
+    yield "herd", np.zeros_like(a)  # everyone to node 0: capacity lie
+    yield "truncated", a[: max(1, P // 2)]
+
+
+def test_device_validator_matches_host_bit_for_bit():
+    for seed in range(6):
+        rng = random.Random(900 + seed)
+        nodes, scheduled, pending = random_cluster(
+            rng, n_nodes=6, n_sched=8, n_pending=12)
+        dn, dp, ds, nt, pt, _pk = build(nodes, scheduled, pending)
+        a, usage = _solve(dp, dn, ds)
+        for tag, bad in _corruptions(rng, a, dn.valid.shape[0], nt.n):
+            want = validate_solution(bad, usage, dp, dn)
+            got = _dev_verdict(bad, usage, dp, dn)
+            assert got == want, (seed, tag, got, want)
+        # NaN poisoning of the claimed usage -> finiteness, both sides
+        bad_u = usage._replace(
+            requested=usage.requested.at[0, 0].set(jnp.nan))
+        want = validate_solution(a, bad_u, dp, dn)
+        got = _dev_verdict(a, bad_u, dp, dn)
+        assert got == want == (False, "finiteness")
+
+
+def test_device_validator_respects_resource_policy_bypass():
+    # a Policy without PodFitsResources must not reject over-capacity
+    # results — on device exactly as on host
+    from kubernetes_tpu.ops.predicates import BIT
+
+    nodes = [make_node(f"n{i}", cpu_milli=1000) for i in range(3)]
+    pending = [make_pod(f"p{i}", cpu_milli=900) for i in range(9)]
+    dn, dp, ds, nt, pt, _pk = build(nodes, [], pending)
+    herd = np.zeros((dp.valid.shape[0],), np.int32)  # 9 x 900m on node 0
+    u = _apply_batch(
+        usage_from_nodes(dn), dp, jnp.asarray(herd),
+        jnp.asarray(np.ones_like(herd, bool)) & dp.valid)
+    em = ~(1 << BIT["PodFitsResources"]) & ((1 << 18) - 1)
+    assert validate_solution(herd, u, dp, dn) == (False, "capacity")
+    assert _dev_verdict(herd, u, dp, dn) == (False, "capacity")
+    assert validate_solution(herd, u, dp, dn, em) == (True, "")
+    assert _dev_verdict(herd, u, dp, dn, em) == (True, "")
+
+
+# ---------------------------------------------------------------------------
+# 2. lean-round parity (fused path == general path, bit for bit)
+# ---------------------------------------------------------------------------
+
+
+def _resource_batch(rng, n_pods, big_frac=0.3):
+    pods = []
+    for i in range(n_pods):
+        big = rng.random() < big_frac
+        pods.append(make_pod(
+            f"q{i}",
+            cpu_milli=rng.choice([100, 250, 500, 1500] if not big
+                                 else [2000, 3000]),
+            memory=rng.choice([128, 512, 1024]) * 2**20,
+        ))
+        pods[-1].priority = rng.choice([0, 0, 10, 100])
+    return pods
+
+
+@pytest.mark.parametrize("cap,n_nodes,n_pods", [
+    (8, 16, 40),     # uncontended, one round
+    (1, 4, 48),      # windowed (P > N*cap), many rounds
+    (4, 3, 30),      # contended, capacity binds
+])
+def test_lean_round_places_bit_identically_to_general(cap, n_nodes, n_pods):
+    from kubernetes_tpu.ops.priorities import solver_gates
+
+    for seed in range(4):
+        rng = random.Random(700 + seed)
+        nodes = [make_node(f"n{i}", cpu_milli=4000, memory=8192 * 2**20)
+                 for i in range(n_nodes)]
+        pending = _resource_batch(rng, n_pods)
+        dn, dp, ds, nt, pt, _pk = build(nodes, [], pending)
+        skip, no_ports, no_aff, no_spread = solver_gates(nt, pt)
+        kw = dict(per_node_cap=cap, skip_priorities=skip,
+                  no_ports=no_ports, no_pod_affinity=no_aff,
+                  no_spread=no_spread)
+        a_lean, u_lean = _solve(dp, dn, ds, **kw)
+        ones = jnp.ones((dp.valid.shape[0], dn.valid.shape[0]), bool)
+        a_gen, u_gen = _solve(dp, dn, ds, extra_mask=ones, **kw)
+        assert (a_lean == a_gen).all(), seed
+        np.testing.assert_allclose(np.asarray(u_lean.requested),
+                                   np.asarray(u_gen.requested))
+
+
+def test_non_bucketed_node_axis_takes_cumsum_fallback():
+    # pad_to is an open parameter: a 96-wide node axis (not a multiple
+    # of the 64-column block) must route through the cumsum fallback in
+    # _blocked_pick instead of crashing the reshape — and still place
+    # identically on both round paths
+    from kubernetes_tpu.ops.priorities import solver_gates
+    from kubernetes_tpu.snapshot import SnapshotPacker
+
+    rng = random.Random(11)
+    nodes = [make_node(f"n{i}", cpu_milli=2000) for i in range(90)]
+    pending = _resource_batch(rng, 30)
+    pk = SnapshotPacker()
+    for p in pending:
+        pk.intern_pod(p)
+    nt = pk.pack_nodes(nodes, [])
+    pt = pk.pack_pods(pending)
+    dn = nodes_to_device(nt, pad_to=96)  # 96 % 64 != 0
+    dp = pods_to_device(pt)
+    ds = selectors_to_device(pk.pack_selector_tables())
+    skip, no_ports, no_aff, no_spread = solver_gates(nt, pt)
+    kw = dict(per_node_cap=4, skip_priorities=skip, no_ports=no_ports,
+              no_pod_affinity=no_aff, no_spread=no_spread)
+    a_lean, _ = _solve(dp, dn, ds, **kw)
+    ones = jnp.ones((dp.valid.shape[0], 96), bool)
+    a_gen, _ = _solve(dp, dn, ds, extra_mask=ones, **kw)
+    assert (a_lean == a_gen).all()
+    # every pod that fits a 2000m node places (3000m whales legitimately
+    # don't; what matters above is the two paths agreeing bit-for-bit)
+    want = sum(1 for p in pending if p.requests.cpu_milli <= 2000)
+    assert (a_lean >= 0).sum() == want
+
+
+def test_lean_round_respects_predicate_mask():
+    # enabled_mask without PodFitsResources: both paths must over-admit
+    # identically (the admission guard bypass is part of the contract)
+    from kubernetes_tpu.ops.predicates import BIT
+    from kubernetes_tpu.ops.priorities import solver_gates
+
+    rng = random.Random(7)
+    nodes = [make_node(f"n{i}", cpu_milli=500) for i in range(3)]
+    pending = _resource_batch(rng, 24)
+    dn, dp, ds, nt, pt, _pk = build(nodes, [], pending)
+    skip, no_ports, no_aff, no_spread = solver_gates(nt, pt)
+    em = ~(1 << BIT["PodFitsResources"]) & ((1 << 18) - 1)
+    kw = dict(per_node_cap=4, enabled_mask=em, skip_priorities=skip,
+              no_ports=no_ports, no_pod_affinity=no_aff,
+              no_spread=no_spread)
+    a_lean, _ = _solve(dp, dn, ds, **kw)
+    ones = jnp.ones((dp.valid.shape[0], dn.valid.shape[0]), bool)
+    a_gen, _ = _solve(dp, dn, ds, extra_mask=ones, **kw)
+    assert (a_lean == a_gen).all()
+    assert (a_lean >= 0).sum() == 24  # capacity really was bypassed
+
+
+# ---------------------------------------------------------------------------
+# 3. explain fidelity: messages from reductions == messages from raw rows
+# ---------------------------------------------------------------------------
+
+
+def test_fit_error_message_from_counts_byte_identical():
+    for seed in range(5):
+        rng = random.Random(300 + seed)
+        nodes, scheduled, pending = random_cluster(
+            rng, n_nodes=7, n_sched=6, n_pending=10)
+        # oversize some pods so PodFitsResources fires with per-resource
+        # Insufficient splits
+        for p in pending[::2]:
+            p.cpu_milli = 64000
+        dn, dp, ds, nt, pt, _pk = build(nodes, scheduled, pending)
+        fr = _filter_pass(dp, dn, ds, None, None, None, None)
+        usage = usage_from_nodes(dn)
+        free_dev = dn.allocatable - usage.requested
+        fm = np.zeros((dp.valid.shape[0],), bool)
+        fm[: len(pending)] = True
+        ex = explain_reduce(fr.reasons, dn.valid, jnp.asarray(fm), dp.req,
+                            free_dev, dn.ready, dn.network_unavailable)
+        rmat = np.asarray(fr.reasons)
+        nvalid = np.asarray(dn.valid)
+        free = np.asarray(dn.allocatable) - np.asarray(usage.requested)
+        reqs = np.asarray(dp.req)
+        ready = np.asarray(dn.ready)
+        netun = np.asarray(dn.network_unavailable)
+        res_names = (list(FIXED_RESOURCE_NAMES)
+                     + _pk.u.scalar_resources.items())[: reqs.shape[1]]
+        per_pod = np.asarray(ex.per_pod)
+        insuff = np.asarray(ex.insufficient)
+        nr = np.asarray(ex.not_ready)
+        nu = np.asarray(ex.net_unavail)
+        pod_bits = np.asarray(ex.pod_bits)
+        for i in range(len(pending)):
+            bits = (int(np.bitwise_or.reduce(rmat[i][nvalid]))
+                    if nvalid.any() else 0)
+            assert bits == int(pod_bits[i]), (seed, i)
+            if not bits:
+                continue
+            want = fit_error_message(rmat[i], nvalid, reqs[i], free,
+                                     ready, netun, res_names)
+            got = fit_error_message_from_counts(
+                per_pod[i], insuff[i], nr[i], nu[i], nt.n, pt.req[i],
+                res_names)
+            assert got == want, (seed, i)
+
+
+def test_cycle_fit_errors_and_why_pending_byte_identical():
+    """End-to-end regression pin: the driver's event text and /debug/why
+    message for an unschedulable pod must be byte-identical to the
+    legacy raw-matrix construction (recomputed here from a test-side
+    readback of the same filter pass)."""
+    s = Scheduler(enable_preemption=False)
+    for i in range(3):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=1000, memory=2048 * 2**20))
+    s.on_pod_add(make_pod("fits", cpu_milli=100))
+    s.on_pod_add(make_pod("whale", cpu_milli=64000))
+    res = s.schedule_cycle()
+    assert res.scheduled == 1 and res.unschedulable == 1
+    key = "default/whale"
+    msg = res.fit_errors[key]
+    # legacy reconstruction from the raw matrix (test-side readback)
+    from kubernetes_tpu.cache import SchedulerCache  # noqa: F401
+
+    pk = s.cache.packer
+    nt, dn, _mode = s.cache.device_snapshot()
+    batch = [s.queue.pod(key)]
+    pt = pk.pack_pods(batch)
+    from kubernetes_tpu.utils.interner import bucket_size
+
+    dp = pods_to_device(pt, pad_to=bucket_size(1))
+    ds = selectors_to_device(pk.pack_selector_tables())
+    fr = _filter_pass(dp, dn, ds, None, None, None, None)
+    rmat = np.asarray(fr.reasons)
+    nvalid = np.asarray(dn.valid)
+    free = np.asarray(dn.allocatable) - np.asarray(dn.requested)
+    res_names = (list(FIXED_RESOURCE_NAMES)
+                 + pk.u.scalar_resources.items())[: pt.req.shape[1]]
+    want = fit_error_message(
+        rmat[0], nvalid, np.asarray(dp.req)[0], free,
+        np.asarray(dn.ready), np.asarray(dn.network_unavailable),
+        res_names)
+    assert msg == want
+    # /debug/why row carries the same bytes
+    assert s.why_pending[key].message == msg
+    assert "Insufficient cpu" in msg
+
+
+# ---------------------------------------------------------------------------
+# 4. chaos entry: corrupted fused verdict demotes through the ladder
+# ---------------------------------------------------------------------------
+
+
+def _sched(injector=None, rc=None):
+    clk = [0.0]
+
+    def clock():
+        return clk[0]
+
+    s = Scheduler(
+        clock=clock, fault_injector=injector,
+        robustness=rc or RobustnessConfig(solver_retries=0),
+        retry_sleep=lambda _s: None, enable_preemption=False,
+    )
+    return s
+
+
+def test_corrupted_fused_verdict_demotes_through_ladder():
+    # "garbage" poisons the batch tiers' assignments with out-of-range
+    # node ids; the FUSED verdict (host_validate defaults False) must
+    # reject both batch tiers and the oracle must still bind everything —
+    # the PR-1 lying-solver contract survives the readback fusion
+    assert not RobustnessConfig().host_validate  # fused is the default
+    inj = FaultInjector(seed=23).arm("solve:batch*", "garbage")
+    s = _sched(injector=inj)
+    for i in range(4):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=4000))
+    for i in range(12):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=300))
+    res = s.schedule_cycle()
+    assert res.scheduled == 12
+    assert res.solver_tier == "greedy" and res.solver_fallbacks == 2
+    rejected = {k[1] for k in s.metrics.solver_rejections._values}
+    # the device verdict speaks the host checker's reason vocabulary
+    assert rejected <= set(VALIDATE_REASONS) and rejected
+
+
+def test_host_validate_escape_hatch_still_catches_liars():
+    inj = FaultInjector(seed=29).arm("solve:batch*", "garbage")
+    s = _sched(injector=inj, rc=RobustnessConfig(
+        solver_retries=0, host_validate=True))
+    for i in range(3):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=4000))
+    for i in range(6):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=300))
+    res = s.schedule_cycle()
+    assert res.scheduled == 6
+    assert res.solver_tier == "greedy"
+
+
+def test_v1alpha1_host_validate_roundtrip():
+    from kubernetes_tpu.api.config_v1alpha1 import decode, encode
+
+    doc = {
+        "apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
+        "kind": "KubeSchedulerConfiguration",
+        "robustness": {"hostValidate": True},
+    }
+    cfg = decode(doc)
+    assert cfg.robustness.host_validate is True
+    out = encode(cfg)
+    assert out["robustness"]["hostValidate"] is True
+    # defaulting: absent -> False (fused validation is the default)
+    cfg2 = decode({
+        "apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
+        "kind": "KubeSchedulerConfiguration",
+    })
+    assert cfg2.robustness.host_validate is False
+
+
+# ---------------------------------------------------------------------------
+# 5. the readback budget is observable
+# ---------------------------------------------------------------------------
+
+
+def test_cycle_readback_bytes_recorded_and_small():
+    s = Scheduler(enable_preemption=False)
+    for i in range(4):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=4000))
+    for i in range(8):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=100))
+    res = s.schedule_cycle()
+    assert res.scheduled == 8
+    recs = s.obs.recorder.records()
+    assert recs and recs[-1].readback_bytes > 0
+    # an uncontended cycle reads back ONE assignment vector + scalars:
+    # order-of-KB, never the (P, N) plane (which would be ~128 KiB even
+    # at this toy shape)
+    assert recs[-1].readback_bytes < 16 * 1024
+    # the dedicated counter saw the same site
+    vals = s.metrics.readback_bytes._values
+    assert any(k == ("solve-result",) for k in vals)

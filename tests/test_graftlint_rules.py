@@ -162,10 +162,13 @@ def test_r2_flags_numpy_readback_in_jit_and_hot_funcs():
         return a, b, c
 
     def cold_helper(x):
-        return np.asarray(x)   # not hot: allowed
+        return np.asarray(x)   # not hot: R7 still wants a boundary
     """)
-    assert rules_of(findings) == ["R2", "R2", "R2", "R2"]
-    assert {f.line for f in findings} == {9, 12, 13, 14}
+    assert sorted(rules_of(findings)) == ["R2"] * 4 + ["R7"] * 4
+    assert {f.line for f in findings if f.rule == "R2"} == {9, 12, 13, 14}
+    # R7 rides every asarray/device_get in a jax-importing module —
+    # .item() (line 14) is R2-only, the cold helper is R7-only
+    assert {f.line for f in findings if f.rule == "R7"} == {9, 12, 13, 18}
 
 
 def test_r2_negative_device_code_is_quiet():
@@ -385,7 +388,7 @@ def test_disable_scope_covers_whole_function():
     import numpy as np
     import jax
 
-    # graftlint: disable-scope=R2 -- deliberate host boundary (fixture)
+    # graftlint: disable-scope=R2,R7 -- deliberate host boundary (fixture)
     def validate_solution(assigned, usage):
         a = np.asarray(assigned)
         b = np.asarray(usage)
@@ -438,7 +441,79 @@ def test_suppression_does_not_leak_to_other_rules():
     def f(x):
         return np.asarray(x)  # graftlint: disable=R4 -- wrong rule id
     """)
-    assert rules_of(findings2) == ["R2"]
+    assert sorted(rules_of(findings2)) == ["R2", "R7"]
+
+
+# --------------------------------------------------------------------------
+# R7 — undeclared d2h readback sites
+# --------------------------------------------------------------------------
+
+def test_r7_flags_readback_outside_boundary():
+    findings = lint("""
+    import numpy as np
+    import jax
+
+    def decode(result):
+        return np.asarray(result)
+
+    def pull(x):
+        return jax.device_get(x)
+    """, select=["R7"])
+    assert rules_of(findings) == ["R7", "R7"]
+
+
+def test_r7_host_literals_are_quiet():
+    # literals/comprehensions can't be device buffers — host bookkeeping
+    assert lint("""
+    import numpy as np
+    import jax
+
+    def pack(idx):
+        a = np.asarray([1, 2, 3])
+        b = np.asarray((0,))
+        c = np.asarray([i for i in idx])
+        return a, b, c
+    """, select=["R7"]) == []
+
+
+def test_r7_numpy_only_modules_are_out_of_scope():
+    # a module that never imports jax cannot hold device buffers
+    assert lint("""
+    import numpy as np
+
+    def pack(rows):
+        return np.asarray(rows)
+    """, select=["R7"]) == []
+
+
+def test_r7_boundary_and_test_modules_exempt():
+    src = """
+    import numpy as np
+    import jax
+
+    def readback(site, x):
+        return np.asarray(jax.device_get(x))
+    """
+    assert lint(src, select=["R7"],
+                filename="kubernetes_tpu/obs/jaxtel.py") == []
+    assert lint(src, select=["R7"],
+                filename="tests/test_something.py") == []
+    assert lint(src, select=["R7"],
+                filename="scripts/bench_foo.py") == []
+    # the same code in a production module is the ratchet's target
+    assert rules_of(lint(src, select=["R7"],
+                         filename="kubernetes_tpu/driver2.py")) == ["R7", "R7"]
+
+
+def test_r7_scope_suppression_with_justification():
+    assert lint("""
+    import numpy as np
+    import jax
+
+    # graftlint: disable-scope=R7 -- host oracle by design (fixture)
+    def validate(assigned):
+        return np.asarray(assigned)
+    """, select=["R7"]) == []
 
 
 # --------------------------------------------------------------------------
@@ -712,7 +787,7 @@ def test_r1_r2_taint_crosses_method_boundaries():
                 return np.asarray(x)
             return x
     """)
-    assert sorted(rules_of(findings)) == ["R1", "R2"]
+    assert sorted(rules_of(findings)) == ["R1", "R2", "R7"]
 
 
 def test_r1_positional_partial_args_are_static():
