@@ -129,6 +129,19 @@ class RobustnessConfigurationV1alpha1:
 
 
 @dataclass
+class RecoveryConfigurationV1alpha1:
+    """Versioned spelling of the crash/failover/device-loss recovery
+    knobs (config.RecoveryConfig): camelCase, the cooloff as a
+    metav1.Duration string like every other versioned time field."""
+
+    fencedBinds: Optional[bool] = None
+    reconcileOnTakeover: Optional[bool] = None
+    releaseLeaseOnShutdown: Optional[bool] = None
+    deviceResetLimit: Optional[int] = None
+    deviceCooloff: Optional[str] = None
+
+
+@dataclass
 class ObservabilityConfigurationV1alpha1:
     """Versioned spelling of the observability knobs
     (config.ObservabilityConfig): camelCase, the trace threshold as a
@@ -208,6 +221,8 @@ class KubeSchedulerConfigurationV1alpha1:
         default_factory=WarmupConfigurationV1alpha1)
     robustness: "RobustnessConfigurationV1alpha1" = field(
         default_factory=RobustnessConfigurationV1alpha1)
+    recovery: "RecoveryConfigurationV1alpha1" = field(
+        default_factory=RecoveryConfigurationV1alpha1)
     observability: "ObservabilityConfigurationV1alpha1" = field(
         default_factory=ObservabilityConfigurationV1alpha1)
     serving: "ServingConfigurationV1alpha1" = field(
@@ -296,6 +311,17 @@ def set_defaults_kube_scheduler_configuration(
         rb.fallbackChain = ["batch-cpu", "greedy"]
     if rb.extenderDegradeToIgnorable is None:
         rb.extenderDegradeToIgnorable = True
+    rv = obj.recovery
+    if rv.fencedBinds is None:
+        rv.fencedBinds = True
+    if rv.reconcileOnTakeover is None:
+        rv.reconcileOnTakeover = True
+    if rv.releaseLeaseOnShutdown is None:
+        rv.releaseLeaseOnShutdown = True
+    if rv.deviceResetLimit is None:
+        rv.deviceResetLimit = 2
+    if rv.deviceCooloff is None:
+        rv.deviceCooloff = "5s"
     ob = obj.observability
     if ob.enabled is None:
         ob.enabled = True
@@ -445,8 +471,22 @@ def _to_internal(v: KubeSchedulerConfigurationV1alpha1) -> KubeSchedulerConfigur
         snapshot_max_dirty_frac=v.snapshotMaxDirtyFrac,
         warmup=_warmup_to_internal(v.warmup),
         robustness=_robustness_to_internal(v.robustness),
+        recovery=_recovery_to_internal(v.recovery),
         observability=_observability_to_internal(v.observability),
         serving=_serving_to_internal(v.serving),
+    )
+
+
+def _recovery_to_internal(rv: RecoveryConfigurationV1alpha1):
+    from kubernetes_tpu.config import RecoveryConfig
+
+    return RecoveryConfig(
+        fenced_binds=rv.fencedBinds,
+        reconcile_on_takeover=rv.reconcileOnTakeover,
+        release_lease_on_shutdown=rv.releaseLeaseOnShutdown,
+        device_reset_limit=rv.deviceResetLimit,
+        device_cooloff_s=_dur("deviceCooloff", rv.deviceCooloff,
+                              "recovery"),
     )
 
 
@@ -590,6 +630,13 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
             hostValidate=rc.host_validate,
             fallbackChain=list(rc.fallback_chain),
             extenderDegradeToIgnorable=rc.extender_degrade_to_ignorable,
+        ),
+        recovery=RecoveryConfigurationV1alpha1(
+            fencedBinds=c.recovery.fenced_binds,
+            reconcileOnTakeover=c.recovery.reconcile_on_takeover,
+            releaseLeaseOnShutdown=c.recovery.release_lease_on_shutdown,
+            deviceResetLimit=c.recovery.device_reset_limit,
+            deviceCooloff=format_duration(c.recovery.device_cooloff_s),
         ),
         observability=ObservabilityConfigurationV1alpha1(
             enabled=c.observability.enabled,

@@ -100,6 +100,11 @@ class SchedulerCache:
         #: bytes the last call moved across the device boundary (full
         #: table or delta rows) — feeds the h2d transfer accounting
         self.last_upload_nbytes: int = 0
+        #: faults.FaultInjector (or None): the chaos seam for the
+        #: device-resident snapshot — "snapshot:device" rules
+        #: (device_lost / device_oom) raise from device_snapshot(),
+        #: exercising the scheduler's resident-rebuild recovery
+        self.fault_injector = None
 
     # -- introspection -----------------------------------------------------
 
@@ -114,6 +119,13 @@ class SchedulerCache:
 
     def is_assumed(self, pod_key: str) -> bool:
         return self._pod_state.get(pod_key) in (_ASSUMED, _EXPIRING)
+
+    def assumed_keys(self) -> List[str]:
+        """Keys of every pod still in an assumed state (ASSUMED or
+        EXPIRING) — what a takeover reconciliation diffs against the
+        relisted hub truth, and what a deposed leader drains."""
+        return [k for k, s in self._pod_state.items()
+                if s in (_ASSUMED, _EXPIRING)]
 
     def pod_count(self) -> int:
         return sum(len(m) for m in self._pods_by_node.values())
@@ -155,18 +167,30 @@ class SchedulerCache:
             raise CacheError(f"pod {pod_key} is not assumed")
         self._drop_pod(pod_key)
 
-    def cleanup_expired(self) -> List[str]:
-        """cache.go:674 cleanupAssumedPods — expire overdue assumptions;
-        returns the expired keys (the driver logs/metrics them)."""
+    def pop_expired(self) -> List[Pod]:
+        """cache.go:674 cleanupAssumedPods — expire overdue assumptions,
+        returning the expired POD OBJECTS (node_name still carrying the
+        node they were assumed onto) so the driver can log, count, emit
+        an event, and requeue them instead of letting the pod vanish
+        silently (scheduler._reap_expired_assumptions)."""
         now = self.clock()
-        expired = [
+        expired_keys = [
             k
             for k, d in self._pod_deadline.items()
             if d <= now and self._pod_state.get(k) == _EXPIRING
         ]
-        for k in expired:
+        out: List[Pod] = []
+        for k in expired_keys:
+            p = self.pod(k)
             self._drop_pod(k)
-        return expired
+            if p is not None:
+                out.append(p)
+        return out
+
+    def cleanup_expired(self) -> List[str]:
+        """Key-returning wrapper over :meth:`pop_expired` (the original
+        surface — existing callers and tests pin the key list)."""
+        return [p.key() for p in self.pop_expired()]
 
     # -- watch-driven mutations -------------------------------------------
 
@@ -373,6 +397,12 @@ class SchedulerCache:
         from kubernetes_tpu.ops.arrays import nodes_to_device, scatter_node_rows
         from kubernetes_tpu.utils.interner import bucket_size
 
+        if self.fault_injector is not None:
+            # chaos seam: an armed device_lost/device_oom rule raises
+            # here, standing in for a real XLA device error during the
+            # scatter/upload — the driver's recovery drops the resident
+            # table and rebuilds from the host mirror
+            self.fault_injector.device_hook("snapshot:device")
         table, _mode, _idx, _sub = self._refresh_host()
         n_pad = bucket_size(max(table.n, 1))
         self.last_upload_rows = 0

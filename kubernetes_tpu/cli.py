@@ -33,6 +33,7 @@ from kubernetes_tpu.config import (
     KubeSchedulerConfiguration,
     LeaderElectionConfig,
     ObservabilityConfig,
+    RecoveryConfig,
     RobustnessConfig,
     ServingConfig,
     WarmupConfig,
@@ -143,6 +144,11 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
             f"robustness.fallbackChain: unsupported tier(s) {bad_tiers}: "
             f"supported: {', '.join(VALID_SOLVERS + ('batch-cpu',))}"
         )
+    rv = cfg.recovery
+    if rv.device_reset_limit < 0:
+        errs.append("recovery.deviceResetLimit: must be non-negative")
+    if rv.device_cooloff_s < 0:
+        errs.append("recovery.deviceCooloff: must be non-negative")
     oc = cfg.observability
     if oc.trace_threshold_s < 0:
         errs.append("observability.traceThreshold: must be non-negative")
@@ -190,6 +196,7 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
 _CONFIG_FIELDS = {f.name for f in dataclasses.fields(KubeSchedulerConfiguration)}
 _LE_FIELDS = {f.name for f in dataclasses.fields(LeaderElectionConfig)}
 _ROB_FIELDS = {f.name for f in dataclasses.fields(RobustnessConfig)}
+_REC_FIELDS = {f.name for f in dataclasses.fields(RecoveryConfig)}
 _OBS_FIELDS = {f.name for f in dataclasses.fields(ObservabilityConfig)}
 _WARMUP_FIELDS = {f.name for f in dataclasses.fields(WarmupConfig)}
 _SERVING_FIELDS = {f.name for f in dataclasses.fields(ServingConfig)}
@@ -257,6 +264,15 @@ def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
             if "fallback_chain" in rkw:
                 rkw["fallback_chain"] = tuple(rkw["fallback_chain"])
             kw["robustness"] = RobustnessConfig(**rkw)
+        elif key == "recovery":
+            if not isinstance(val, dict):
+                errs.append("recovery: expected a mapping")
+                continue
+            unknown = set(val) - _REC_FIELDS
+            if unknown:
+                errs.append(f"recovery: unknown field(s) {sorted(unknown)}")
+                continue
+            kw["recovery"] = RecoveryConfig(**val)
         elif key == "observability":
             if not isinstance(val, dict):
                 errs.append("observability: expected a mapping")
@@ -488,6 +504,10 @@ def run(cfg: KubeSchedulerConfiguration, args, stop_event=None) -> None:
             lock=lock,
             config=cfg.leader_election,
         )
+        # recovery wiring: the elector fences every bind, gaining the
+        # lease runs takeover reconciliation (requeue + resident-
+        # snapshot rebuild + re-warm), losing it drains in-flight state
+        sched.attach_elector(elector)
     #: AOT warmup is LAZY — it must wait for the first node sync, or
     #: every warmed shape carries an empty-cluster node bucket that no
     #: real cycle will ever match (the compile would land on the first
@@ -507,18 +527,40 @@ def run(cfg: KubeSchedulerConfiguration, args, stop_event=None) -> None:
         # cycle under churn would retrace — extend the warmed grid down
         sched.warmup_config = dataclasses.replace(cfg.warmup, min_bucket=8)
 
+    serving_loop = None
+    if cfg.serving.enabled:
+        from kubernetes_tpu.serving import ServingLoop
+
+        serving_loop = ServingLoop(sched, bell, cfg.serving)
+
+    import contextlib
+
+    def _ingest_guard():
+        """Leadership transitions run recovery side-effects — takeover
+        reconciliation, the stopped-leading drain, warmup — that mutate
+        the queue/cache. In serving mode producers feed those same
+        structures from other threads through the loop's ingest lock,
+        so the elector tick (and the lazy warmup) must hold it too; the
+        legacy loop is single-threaded and needs no guard."""
+        return (serving_loop.lock if serving_loop is not None
+                else contextlib.nullcontext())
+
     def gate() -> bool:
         """Per-iteration admission for both loops: leader election
         (a non-leader keeps serving healthz and ticking the elector)
         and the lazy AOT warmup."""
         nonlocal warmup_pending
-        if elector is not None and not elector.tick():
-            stop.wait(cfg.leader_election.retry_period_s)
-            return False
+        if elector is not None:
+            with _ingest_guard():
+                leading = elector.tick()
+            if not leading:
+                stop.wait(cfg.leader_election.retry_period_s)
+                return False
         if warmup_pending and sched.cache.node_count():
-            pp = getattr(sched.queue, "pending_pods", None)
-            sample = pp().get("active", [])[:64] if pp else []
-            n = sched.warmup(sample_pods=sample)
+            with _ingest_guard():
+                pp = getattr(sched.queue, "pending_pods", None)
+                sample = pp().get("active", [])[:64] if pp else []
+                n = sched.warmup(sample_pods=sample)
             print(f"warmup: compiled {n} bucketed solve shapes",
                   file=sys.stderr)
             warmup_pending = False
@@ -526,9 +568,7 @@ def run(cfg: KubeSchedulerConfiguration, args, stop_event=None) -> None:
 
     try:
         if cfg.serving.enabled:
-            from kubernetes_tpu.serving import ServingLoop
-
-            ServingLoop(sched, bell, cfg.serving).run(stop, gate=gate)
+            serving_loop.run(stop, gate=gate)
         else:
             while not stop.is_set():
                 if not gate():
@@ -547,6 +587,12 @@ def run(cfg: KubeSchedulerConfiguration, args, stop_event=None) -> None:
                 if r.attempted == 0:
                     stop.wait(args.cycle_interval)
     finally:
+        if (elector is not None and cfg.recovery.release_lease_on_shutdown
+                and elector.is_leader()):
+            # graceful failover: CAS an expired lease record so the
+            # standby acquires on its next tick instead of waiting out
+            # the full lease duration
+            elector.release()
         srv.shutdown()
 
 

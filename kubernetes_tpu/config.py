@@ -151,6 +151,35 @@ class RobustnessConfig:
 
 
 @dataclass
+class RecoveryConfig:
+    """Crash/failover/device-loss recovery knobs (no reference analog —
+    the process-level resilience layer above the PR-1 solver ladder):
+    fenced binds, takeover reconciliation, and resident-snapshot rebuild
+    after an accelerator loss. All times ride the scheduler's injected
+    clock, so chaos runs stay deterministic."""
+
+    #: gate every hub write (cache assume -> bind) on the elector's
+    #: fencing check (LeaderElector.allow_bind): a deposed or
+    #: renew-stalled leader's in-flight binds abort and requeue instead
+    #: of racing the new leader at the hub CAS
+    fenced_binds: bool = True
+    #: on (re)gaining leadership, reconcile against the relisted hub
+    #: truth: adopt pods a dead incarnation bound, forget assumptions
+    #: the API contradicts, requeue unbound pods, rebuild the resident
+    #: device snapshot, re-arm warmup
+    reconcile_on_takeover: bool = True
+    #: CAS an expired lease record on shutdown so the standby takes over
+    #: immediately instead of waiting out the full lease duration
+    release_lease_on_shutdown: bool = True
+    #: consecutive resident-snapshot rebuild attempts per cycle after a
+    #: device error before falling back to host-mode snapshots
+    device_reset_limit: int = 2
+    #: how long to stay on host-mode snapshots after the rebuild budget
+    #: is exhausted before probing the device again (the heal probe)
+    device_cooloff_s: float = 5.0
+
+
+@dataclass
 class ObservabilityConfig:
     """Observability knobs (kubernetes_tpu/obs): cycle tracing, the JAX
     compile/retrace telemetry, and the flight recorder. All times ride
@@ -307,6 +336,9 @@ class KubeSchedulerConfiguration:
     warmup: WarmupConfig = field(default_factory=WarmupConfig)
     #: degradation ladder / fault-tolerance knobs
     robustness: RobustnessConfig = field(default_factory=RobustnessConfig)
+    #: crash / failover / device-loss recovery knobs (fenced binds,
+    #: takeover reconciliation, resident-snapshot rebuild)
+    recovery: RecoveryConfig = field(default_factory=RecoveryConfig)
     #: cycle tracing / JAX telemetry / flight-recorder knobs
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)

@@ -28,6 +28,10 @@ REASON_PREEMPTED = "Preempted"
 #: fallback tier) or closed again after a successful half-open probe
 REASON_DEGRADED = "SchedulerDegraded"
 REASON_RECOVERED = "SchedulerRecovered"
+#: an assumed pod's bind confirmation never arrived within the assume
+#: TTL — the cache freed its capacity and the driver requeued it
+#: (scheduler._reap_expired_assumptions)
+REASON_ASSUMPTION_EXPIRED = "AssumptionExpired"
 
 _REASON_TYPE = {
     REASON_SCHEDULED: TYPE_NORMAL,
@@ -35,6 +39,7 @@ _REASON_TYPE = {
     REASON_PREEMPTED: TYPE_WARNING,
     REASON_DEGRADED: TYPE_WARNING,
     REASON_RECOVERED: TYPE_NORMAL,
+    REASON_ASSUMPTION_EXPIRED: TYPE_WARNING,
 }
 
 

@@ -67,6 +67,20 @@ class SolverResultInvalid(SolverFault):
     """The solver answered, but validation rejected the result."""
 
 
+class DeviceLost(SolverFault):
+    """The accelerator went away under us (XLA "device lost" class):
+    resident buffers — the device snapshot, in-flight solves — are gone.
+    Recovery rebuilds the resident table from the host mirror
+    (cache.drop_device_snapshot) and the ladder absorbs the solve
+    outage (batch -> batch-cpu -> greedy) until the device heals."""
+
+
+class DeviceOOM(SolverFault):
+    """Device allocation failure (RESOURCE_EXHAUSTED class). Same
+    recovery path as :class:`DeviceLost`: drop residents, rebuild from
+    host, degrade to the CPU tiers meanwhile."""
+
+
 # ---------------------------------------------------------------------------
 # Circuit breaker (closed -> open -> half-open)
 # ---------------------------------------------------------------------------
@@ -227,6 +241,17 @@ _SOLVER_RAISING = {
     "connection": lambda site: SolverCrash(
         f"injected solver connection loss at {site}"),
     "crash": lambda site: SolverCrash(f"injected solver crash at {site}"),
+    "device_lost": lambda site: DeviceLost(
+        f"injected device loss at {site}"),
+    "device_oom": lambda site: DeviceOOM(
+        f"injected device OOM at {site}"),
+}
+
+#: kinds the device-site hook (snapshot scatter / warmup compile) raises —
+#: the accelerator-loss class, distinct from solver-result corruption
+_DEVICE_RAISING = {
+    "device_lost": _SOLVER_RAISING["device_lost"],
+    "device_oom": _SOLVER_RAISING["device_oom"],
 }
 
 
@@ -307,6 +332,19 @@ class FaultInjector:
             # decoded as JSON
             return {}
         return resp
+
+    # -- device seam (snapshot scatter / warmup compile) -------------------
+
+    def device_hook(self, site: str) -> Optional[str]:
+        """Raise for the accelerator-loss kinds (``device_lost``,
+        ``device_oom``) armed at a device site — the snapshot scatter
+        ("snapshot:device") and the AOT warmup ("warmup:compile") call
+        this before touching the device; other kinds are returned for
+        the caller to interpret (usually ignored)."""
+        kind = self.pick(site)
+        if kind in _DEVICE_RAISING:
+            raise _DEVICE_RAISING[kind](site)
+        return kind
 
     # -- solver seam (ops/assign.py fault_hook) ----------------------------
 

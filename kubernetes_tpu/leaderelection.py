@@ -147,9 +147,67 @@ class LeaderElector:
         self._leading = False
         self._observed: Optional[LeaderElectionRecord] = None
         self._observed_at: float = 0.0
+        #: fencing token: bumps on every not-leading -> leading
+        #: transition, so work stamped with an older epoch is provably
+        #: from a deposed incarnation (the Lamport/ZooKeeper fencing
+        #: pattern; the reference gets the same property from the Lease
+        #: resourceVersion its writes CAS against)
+        self.epoch = 0
 
     def is_leader(self) -> bool:
         return self._leading
+
+    # -- bind fencing ------------------------------------------------------
+
+    def allow_bind(self) -> bool:
+        """The fencing check the scheduler's bind path consults: may a
+        side-effecting write go out NOW? True only while leading AND the
+        lease, as last successfully renewed on our clock, is younger
+        than ``renew_deadline_s`` — the reference's rule that a leader
+        unable to renew by renewDeadline must stop acting
+        (leaderelection.go:278 renew loop). A wedged leader that missed
+        its ticks therefore fences ITSELF before the lease even expires,
+        closing the window where a deposed leader's in-flight binds race
+        the new leader's."""
+        if not self._leading or self._observed is None:
+            return False
+        horizon = min(self.config.renew_deadline_s,
+                      self._observed.lease_duration_s)
+        return self.clock() < self._observed_at + horizon
+
+    def release(self) -> bool:
+        """Graceful lease release on shutdown (leaderelection.go:295
+        release): CAS an already-expired anonymous record so a standby's
+        next tick acquires immediately instead of waiting out the full
+        lease duration. Returns True when the release wrote (we were
+        leading and the CAS won); a lost CAS means someone already took
+        over — nothing to release."""
+        if not self._leading:
+            return False
+        cur = self.lock.get()
+        now = self.clock()
+        if cur is None or cur.holder_identity != self.identity:
+            # the lease is no longer OURS (a successor already acquired
+            # while our local flag was stale — e.g. a wedged leader
+            # SIGTERMed after the standby took over): clobbering the
+            # live record with an expired one would re-open the
+            # double-leader window release() exists to avoid. Step down
+            # locally, write nothing.
+            self._set_leading(False)
+            return False
+        rec = LeaderElectionRecord(
+            holder_identity="",
+            lease_duration_s=0.0,
+            acquire_time=now,
+            renew_time=now,
+            leader_transitions=(cur.leader_transitions
+                                if cur is not None else 0),
+        )
+        wrote = self.lock.create_or_update(rec, cur)
+        self._observed = rec if wrote else None
+        self._observed_at = now
+        self._set_leading(False)
+        return wrote
 
     def tick(self) -> bool:
         """tryAcquireOrRenew (leaderelection.go:317). Returns leading."""
@@ -191,6 +249,7 @@ class LeaderElector:
     def _set_leading(self, leading: bool) -> None:
         if leading and not self._leading:
             self._leading = True
+            self.epoch += 1
             self.on_started_leading()
         elif not leading and self._leading:
             self._leading = False

@@ -27,7 +27,10 @@ the streaming-serving block (``kubernetes_tpu/serving``) —
 ``scheduler_microbatch_flushes_total{trigger}`` /
 ``scheduler_microbatch_window_seconds``,
 ``scheduler_flowcontrol_{rejected_requests_total,current_inflight_requests}``,
-and ``scheduler_watch_evictions_total``. Note
+and ``scheduler_watch_evictions_total``; plus the crash/failover
+recovery block — ``scheduler_recovery_*_total`` (takeovers, adopted /
+forgotten / requeued / drained pods, fenced binds, device resets) and
+``scheduler_cache_expired_assumptions_total``. Note
 ``scheduler_e2e_scheduling_duration_seconds`` observes PER-POD
 create-to-bind latency (queue-add stamp to bind) since the serving PR,
 matching the reference's per-pod scheduleOne observation.
@@ -321,6 +324,51 @@ class SchedulerMetrics:
         self.deadline_exceeded = r.register(Counter(
             "scheduler_cycle_deadline_exceeded_total",
             "Cycles whose deadline expired before the ladder finished.",
+        ))
+        # -- crash / failover / device-loss recovery (config.Recovery-
+        # Config; scheduler.reconcile + fenced binds + resident rebuild)
+        self.cache_expired_assumptions = r.register(Counter(
+            "scheduler_cache_expired_assumptions_total",
+            "Assumed pods whose bind confirmation never arrived within "
+            "the assume TTL — capacity freed and the pod requeued.",
+        ))
+        self.recovery_takeovers = r.register(Counter(
+            "scheduler_recovery_takeovers_total",
+            "Leadership takeover / cold-start reconciliations run "
+            "(relist truth, adopt, forget, requeue, rebuild residents).",
+        ))
+        self.recovery_adopted = r.register(Counter(
+            "scheduler_recovery_adopted_pods_total",
+            "Bound pods adopted from the relisted hub truth during a "
+            "takeover reconciliation (bound by a dead incarnation or "
+            "another writer).",
+        ))
+        self.recovery_forgotten = r.register(Counter(
+            "scheduler_recovery_forgotten_assumptions_total",
+            "Cached assumptions the relisted hub truth contradicted "
+            "(pod gone, recreated uid, or bound elsewhere) — forgotten "
+            "during takeover reconciliation.",
+        ))
+        self.recovery_requeued = r.register(Counter(
+            "scheduler_recovery_requeued_pods_total",
+            "Unbound responsible pods (re)queued by a takeover "
+            "reconciliation so every schedulable pod is eventually "
+            "bound.",
+        ))
+        self.recovery_drained = r.register(Counter(
+            "scheduler_recovery_drained_pods_total",
+            "In-flight pods (Permit-parked or assumed) drained and "
+            "requeued when this scheduler stopped leading.",
+        ))
+        self.recovery_fenced_binds = r.register(Counter(
+            "scheduler_recovery_fenced_binds_total",
+            "Binds aborted by the lease fence (deposed or renew-stalled "
+            "leader) instead of racing the new leader at the hub.",
+        ))
+        self.recovery_device_resets = r.register(Counter(
+            "scheduler_recovery_device_resets_total",
+            "Resident device snapshot drops + rebuilds after a device "
+            "error (device lost / OOM).",
         ))
         # -- runtime JAX telemetry (kubernetes_tpu/obs): the dynamic twin
         # of graftlint's static R3 rule, plus host-boundary transfer

@@ -67,6 +67,10 @@ class Observability:
         self._scratch: dict = {}
         self._sinkhorn_stats = None  # device (2,) [iters, residual]
         self._retraces_at_begin = 0
+        #: takeover reconciliation happens BETWEEN cycles — the flag
+        #: parks here until the next begin_cycle stamps it onto that
+        #: cycle's record (value = elector epoch, or 1 when unknown)
+        self._pending_takeover = 0
 
     # -- cycle lifecycle ----------------------------------------------------
 
@@ -79,7 +83,10 @@ class Observability:
     def begin_cycle(self, cycle: int = 0) -> Trace:
         self._scratch = {"cycle": cycle, "t": self.clock(),
                          "breakers": [], "retries": 0,
-                         "deadline_exceeded": False}
+                         "deadline_exceeded": False,
+                         "takeover": self._pending_takeover,
+                         "device_resets": 0, "fenced_binds": 0}
+        self._pending_takeover = 0
         self._sinkhorn_stats = None
         self._retraces_at_begin = self.jax.retrace_total()
         self._d2h_at_begin = self.jax.d2h_bytes_total()
@@ -139,6 +146,27 @@ class Observability:
         self._scratch["flush_trigger"] = trigger
         self._scratch["window_s"] = window_s
 
+    def note_takeover(self, epoch: int = 1) -> None:
+        """A takeover / cold-start reconciliation ran (between cycles):
+        flag the NEXT cycle's flight record with ``takeover=epoch...``
+        so a postmortem can see which cycles ran under which
+        incarnation."""
+        self._pending_takeover = max(int(epoch), 1)
+
+    def note_device_reset(self) -> None:
+        """The resident device snapshot was dropped + rebuilt after a
+        device error this cycle (``device_reset=`` flight-record flag)."""
+        if "device_resets" in self._scratch:
+            self._scratch["device_resets"] = (
+                self._scratch.get("device_resets", 0) + 1)
+
+    def note_fenced_bind(self) -> None:
+        """A bind was aborted by the lease fence this cycle (``fenced=``
+        flight-record flag)."""
+        if "fenced_binds" in self._scratch:
+            self._scratch["fenced_binds"] = (
+                self._scratch.get("fenced_binds", 0) + 1)
+
     def note_sinkhorn(self, stats) -> None:
         """Stash the solver's (iters, residual) device pair; read back
         once at end_cycle (the cycle's host boundary)."""
@@ -185,6 +213,9 @@ class Observability:
             or s.get("retries", 0)
             or s.get("deadline_exceeded", False)
             or s.get("breakers")
+            or s.get("takeover", 0)
+            or s.get("device_resets", 0)
+            or s.get("fenced_binds", 0)
         )
         if not eventful:
             return None
@@ -220,6 +251,9 @@ class Observability:
                              if res is not None else 0),
             flush_trigger=s.get("flush_trigger", ""),
             window_s=s.get("window_s", 0.0),
+            takeover=s.get("takeover", 0),
+            device_resets=s.get("device_resets", 0),
+            fenced_binds=s.get("fenced_binds", 0),
         )
         self.recorder.record(rec)
         self._eventful_seq += 1

@@ -67,6 +67,14 @@ class CycleRecord:
     #: how long the window accumulated before flushing
     flush_trigger: str = ""
     window_s: float = 0.0
+    #: recovery provenance: this is the first cycle after a takeover /
+    #: cold-start reconciliation (elector epoch when known, else 1)
+    takeover: int = 0
+    #: resident device snapshot drops + rebuilds this cycle (device
+    #: lost / OOM recovery)
+    device_resets: int = 0
+    #: binds aborted by the lease fence this cycle (deposed leader)
+    fenced_binds: int = 0
 
     def to_json(self) -> dict:
         return {
@@ -98,6 +106,11 @@ class CycleRecord:
             **({"microbatch": {"trigger": self.flush_trigger,
                                "window_s": round(self.window_s, 6)}}
                if self.flush_trigger else {}),
+            **({"takeover": self.takeover} if self.takeover else {}),
+            **({"device_resets": self.device_resets}
+               if self.device_resets else {}),
+            **({"fenced_binds": self.fenced_binds}
+               if self.fenced_binds else {}),
         }
 
 
@@ -172,6 +185,12 @@ class FlightRecorder:
             if r.flush_trigger:
                 flags.append(
                     f"win={r.flush_trigger}:{r.window_s*1000:.1f}ms")
+            if r.takeover:
+                flags.append(f"takeover=epoch{r.takeover}")
+            if r.device_resets:
+                flags.append(f"device_reset={r.device_resets}")
+            if r.fenced_binds:
+                flags.append(f"fenced={r.fenced_binds}")
             spans = " ".join(
                 f"{k}={v*1000:.1f}ms" for k, v in sorted(r.spans.items()))
             lines.append(

@@ -179,6 +179,7 @@ class Scheduler:
         volume_binder=None,
         scheduler_name: str = "default-scheduler",
         robustness=None,
+        recovery=None,
         fault_injector=None,
         retry_sleep: Callable[[float], None] = time.sleep,
         observability=None,
@@ -188,7 +189,11 @@ class Scheduler:
         snapshot_max_dirty_frac: Optional[float] = None,
         warmup=None,
     ) -> None:
-        from kubernetes_tpu.config import ObservabilityConfig, RobustnessConfig
+        from kubernetes_tpu.config import (
+            ObservabilityConfig,
+            RecoveryConfig,
+            RobustnessConfig,
+        )
         from kubernetes_tpu.faults import CircuitBreaker, RetryPolicy
         from kubernetes_tpu.framework import Framework
         from kubernetes_tpu.metrics import SchedulerMetrics
@@ -220,6 +225,20 @@ class Scheduler:
         #: (TPU-service) solver that may time out, crash, or lie
         self.robustness = (robustness if robustness is not None
                            else RobustnessConfig())
+        #: crash/failover/device-loss knobs (config.RecoveryConfig):
+        #: fenced binds, takeover reconciliation, resident rebuild
+        self.recovery = recovery if recovery is not None else RecoveryConfig()
+        #: the bind fence (LeaderElector via attach_elector, or any
+        #: object with allow_bind()/epoch): None = unfenced (single-
+        #: writer deployments, tests)
+        self.fence = None
+        #: truth lister for takeover reconciliation (attach_elector):
+        #: () -> iterable of hub-truth Pods; None = local-only reconcile
+        self._lister = None
+        #: host-mode snapshot fallback window after a device-loss
+        #: recovery exhausted its per-cycle rebuild budget (monotonic
+        #: deadline; 0 = device considered healthy)
+        self._device_cooloff_until = 0.0
         #: faults.FaultInjector (or None): the seeded chaos harness wired
         #: into the solver entry and the extender/shim transports
         self.fault_injector = fault_injector
@@ -267,6 +286,11 @@ class Scheduler:
         #: per-pod CycleState, alive from prefilter to bind/fail
         self._cycle_states: Dict[str, object] = {}
         self.cache = cache or SchedulerCache(clock=clock)
+        # the device-snapshot chaos seam rides the same injector as the
+        # solver/transport seams (duck-typed attach, like the extenders)
+        if (fault_injector is not None
+                and getattr(self.cache, "fault_injector", "absent") is None):
+            self.cache.fault_injector = fault_injector
         #: pipelined cycle executor: batches larger than pipeline_chunk
         #: split into fixed-size chunks; depth >= 2 overlaps host packing
         #: of chunk k+1 and binding of chunk k-1 with chunk k's device
@@ -376,6 +400,7 @@ class Scheduler:
         kw.setdefault("max_batch", cfg.max_batch)
         kw.setdefault("scheduler_name", cfg.scheduler_name)
         kw.setdefault("robustness", cfg.robustness)
+        kw.setdefault("recovery", cfg.recovery)
         kw.setdefault("observability", cfg.observability)
         kw.setdefault("pipeline_depth", cfg.pipeline_depth)
         kw.setdefault("pipeline_chunk", cfg.pipeline_chunk)
@@ -559,6 +584,280 @@ class Scheduler:
         self.cache.invalidate_snapshot()
         self.queue.move_all_to_active()
 
+    # -- crash / failover / device-loss recovery ---------------------------
+
+    def attach_elector(self, elector, lister=None):
+        """Wire leader election into the scheduler's recovery protocol:
+        the elector becomes the bind fence (its ``allow_bind`` gates
+        every hub write when ``recovery.fenced_binds``), gaining
+        leadership runs takeover reconciliation (:meth:`reconcile`), and
+        losing it drains in-flight state (:meth:`on_stopped_leading`).
+        ``lister`` (optional, ``() -> iterable of truth Pods``) gives the
+        reconciliation an authoritative relist source; without one the
+        informer feed is trusted and reconciliation is local-only.
+        Pre-existing elector callbacks are preserved (chained after
+        ours). Returns the elector."""
+        self.fence = elector
+        self._lister = lister
+        prev_start = elector.on_started_leading
+        prev_stop = elector.on_stopped_leading
+
+        def started():
+            self.on_started_leading()
+            prev_start()
+
+        def stopped():
+            self.on_stopped_leading()
+            prev_stop()
+
+        elector.on_started_leading = started
+        elector.on_stopped_leading = stopped
+        return elector
+
+    def on_started_leading(self) -> None:
+        """OnStartedLeading (app/server.go:261): this incarnation just
+        became the writer. Reconcile before the first cycle so a crash
+        of the previous leader between its hub commit and its local
+        ``finish_binding`` converges instead of leaking."""
+        if not self.recovery.reconcile_on_takeover:
+            return
+        pods = None
+        if self._lister is not None:
+            pods = list(self._lister())
+        self.reconcile(pods)
+
+    def on_stopped_leading(self) -> None:
+        """Deposed (lease lost or released): drain in-flight cycle
+        state. Permit-parked pods are rejected and requeued (their
+        capacity would otherwise be held forever — the fence blocks
+        their eventual bind anyway), local assumptions are forgotten and
+        their pods requeued (if the bind DID commit at the hub, the
+        watch MODIFIED event deletes them from the queue; if it did
+        not, the new leader — or this one, re-elected — binds them).
+        The queues themselves stay: informers run on standbys."""
+        import dataclasses as _dc
+
+        fw = self.framework
+        drained = 0
+        res = CycleResult()
+        for wp in list(fw.waiting.items()):
+            key = wp.pod.key()
+            fw.waiting.remove(key)
+            self.cache.forget_pod(key)
+            self.volume_binder.forget_pod_volumes(key)
+            fw.run_unreserve(
+                self._cycle_states.get(key) or _new_cycle_state(),
+                wp.pod, wp.node_name)
+            self._fail(wp.pod, self.queue.scheduling_cycle, res,
+                       ("Permit:lost leadership",))
+            self._cycle_states.pop(key, None)
+            drained += 1
+        for key in self.cache.assumed_keys():
+            pod = self.cache.pod(key)
+            self.cache.forget_pod(key)
+            self.volume_binder.forget_pod_volumes(key)
+            if pod is not None and self.responsible_for(pod):
+                self.queue.add_if_not_present(
+                    _dc.replace(pod, node_name=""))
+            drained += 1
+        if drained:
+            klog.warning("stopped leading: drained %d in-flight pods",
+                         drained)
+            self.metrics.recovery_drained.inc(drained)
+            self._record_metrics(res)
+
+    def reconcile(self, pods=None) -> Dict[str, int]:
+        """Takeover / cold-start reconciliation — converge local state
+        with the hub truth so the invariant triple holds across a crash:
+        no pod double-bound, no assumption leaked, every schedulable pod
+        eventually bound.
+
+        With ``pods`` (the relisted truth): adopt bound pods this cache
+        does not know (bound by a dead incarnation or another writer),
+        forget assumptions the API contradicts (pod gone, recreated
+        under a new uid, or bound elsewhere), and requeue responsible
+        unbound pods that fell out of the queues. Always: resweep the
+        unschedulable queue, drop + rebuild the device-resident
+        snapshot (a new leader's resident arrays may predate the old
+        leader's last commits; after a crash they don't exist), and
+        re-arm the AOT warmup. Returns the action counts."""
+        from kubernetes_tpu.api.types import is_pod_terminated
+
+        adopted = forgotten = requeued = 0
+        if pods is not None:
+            truth = {p.key(): p for p in pods}
+            for key in list(self.cache.assumed_keys()):
+                cached = self.cache.pod(key)
+                tp = truth.get(key)
+                ok = (
+                    tp is not None
+                    and tp.node_name
+                    and cached is not None
+                    and tp.uid == cached.uid
+                    and tp.node_name == cached.node_name
+                )
+                if ok:
+                    # truth agrees with the assumption: the bind DID
+                    # commit (possibly by our dead predecessor) —
+                    # confirm it instead of waiting out the TTL
+                    self.cache.add_pod(tp)
+                    adopted += 1
+                else:
+                    self.cache.forget_pod(key)
+                    self.volume_binder.forget_pod_volumes(key)
+                    forgotten += 1
+            for key, tp in truth.items():
+                if is_pod_terminated(tp):
+                    continue
+                if tp.node_name:
+                    cached = self.cache.pod(key)
+                    if cached is None or cached.uid != tp.uid \
+                            or cached.node_name != tp.node_name:
+                        if cached is not None:
+                            self.cache.remove_pod(key)
+                        self.cache.add_pod(tp)
+                        adopted += 1
+                    # bound at the hub: whatever a stale queue thinks,
+                    # this pod must never be scheduled again here
+                    self.queue.delete(key)
+                elif self.responsible_for(tp):
+                    queued = self.queue.pod(key)
+                    if (queued is not None and queued.uid == tp.uid) \
+                            or self.framework.waiting.get(key) is not None:
+                        continue  # already queued/parked with the live uid
+                    if self.cache.pod(key) is not None:
+                        # we think it's placed, the API says unbound:
+                        # a half-crashed bind — forget and retry
+                        if self.cache.is_assumed(key):
+                            self.volume_binder.forget_pod_volumes(key)
+                        self.cache.remove_pod(key)
+                        forgotten += 1
+                    if queued is not None:
+                        # recreated under the same key with a new uid:
+                        # the stale queued object must never be adopted
+                        # or bound — the truth object replaces it
+                        self.queue.delete(key)
+                    self.queue.add_if_not_present(tp)
+                    requeued += 1
+            # pods the truth no longer contains must leave the queues
+            # (duck-typed: queue fakes without the dump surface skip)
+            pp = getattr(self.queue, "pending_pods", None)
+            if pp is not None:
+                for qpods in pp().values():
+                    for p in qpods:
+                        if p.key() not in truth:
+                            self.queue.delete(p.key())
+        # local convergence, truth or not: resweep parked pods (this
+        # incarnation may have missed move events), rebuild the
+        # device-resident snapshot from the host mirror, re-warm
+        self.queue.move_all_to_active()
+        self.cache.invalidate_snapshot()
+        self.cache.drop_device_snapshot()
+        self._device_cooloff_until = 0.0
+        epoch = getattr(self.fence, "epoch", 0) or 1
+        self.metrics.recovery_takeovers.inc()
+        if adopted:
+            self.metrics.recovery_adopted.inc(adopted)
+        if forgotten:
+            self.metrics.recovery_forgotten.inc(forgotten)
+        if requeued:
+            self.metrics.recovery_requeued.inc(requeued)
+        self.obs.note_takeover(epoch)
+        klog.V(2).info(
+            "takeover reconciliation (epoch %d): adopted=%d forgotten=%d "
+            "requeued=%d", epoch, adopted, forgotten, requeued)
+        if self.warmup_config.enabled and self.cache.node_count():
+            # re-arm AOT warmup: the jit cache survives in-process
+            # re-election (cheap no-op), but a cold-started incarnation
+            # recompiles here instead of on the first cycle's hot path
+            pp = getattr(self.queue, "pending_pods", None)
+            sample = pp().get("active", [])[:64] if pp else []
+            self.warmup(sample_pods=sample)
+        return {"adopted": adopted, "forgotten": forgotten,
+                "requeued": requeued}
+
+    def _fence_ok(self) -> bool:
+        """May a hub write (assume -> bind) go out now? Unfenced
+        schedulers (no elector attached / fencing disabled) always may."""
+        if self.fence is None or not self.recovery.fenced_binds:
+            return True
+        return self.fence.allow_bind()
+
+    def _fenced(self, pod: Pod, cycle: int, res: CycleResult) -> None:
+        """Abort one pod's bind at the fence: count it, flag the flight
+        record, requeue through the standard error path (the NEW leader
+        binds it; this one must not race the hub CAS)."""
+        self.metrics.recovery_fenced_binds.inc()
+        self.obs.note_fenced_bind()
+        self._fail(pod, cycle, res, ("FencedBind:lease lost",))
+
+    def _reap_expired_assumptions(self) -> None:
+        """Drive cache TTL expiry and HANDLE the result (satellite of
+        the recovery PR — both call sites previously discarded it): log,
+        count, emit an AssumptionExpired event, and requeue the pod so
+        a lost bind confirmation converges instead of stranding the pod
+        out of every queue. If the pod actually IS bound (watch merely
+        slow), the eventual MODIFIED event deletes it from the queue;
+        until then a re-bind attempt fails the hub CAS harmlessly."""
+        import dataclasses as _dc
+
+        expired = self.cache.pop_expired()
+        if not expired:
+            return
+        self.metrics.cache_expired_assumptions.inc(len(expired))
+        for p in expired:
+            klog.warning(
+                "assumed pod %s on %s expired (bind confirmation never "
+                "arrived within %.0fs); requeueing", p.key(), p.node_name,
+                self.cache.ttl_s)
+            self.volume_binder.forget_pod_volumes(p.key())
+            pending = _dc.replace(p, node_name="")
+            self.event_sink(
+                "AssumptionExpired", pending,
+                f"binding to {p.node_name} was never confirmed within "
+                f"{self.cache.ttl_s:.0f}s; capacity freed, pod requeued")
+            if self.responsible_for(pending):
+                self.queue.add_if_not_present(pending)
+
+    def _device_snapshot_recovering(self):
+        """``cache.device_snapshot()`` with device-loss recovery: any
+        error from the resident path (a lost/OOMed accelerator — or the
+        injected ``snapshot:device`` chaos rules standing in for one)
+        drops the resident arrays and rebuilds them from the host
+        mirror, up to ``recovery.device_reset_limit`` attempts per
+        cycle; past the budget the scheduler falls back to host-mode
+        snapshots for ``device_cooloff_s`` (the ladder meanwhile absorbs
+        solve failures: batch -> batch-cpu -> greedy), then probes the
+        device again. Returns ``(table, dev_or_None, mode)`` exactly
+        like the call sites expect (``dev=None`` + mode "host" on the
+        fallback path)."""
+        if self.clock() < self._device_cooloff_until:
+            return self.cache.snapshot(), None, "host"
+        attempts = 0
+        while True:
+            try:
+                out = self.cache.device_snapshot()
+                if attempts:
+                    klog.V(2).info("device snapshot rebuilt after %d "
+                                   "reset(s)", attempts)
+                return out
+            except Exception as e:
+                attempts += 1
+                self.metrics.recovery_device_resets.inc()
+                self.obs.note_device_reset()
+                klog.warning("device snapshot failed (%s); dropping "
+                             "resident table (reset %d/%d)", e, attempts,
+                             self.recovery.device_reset_limit)
+                self.cache.drop_device_snapshot()
+                if attempts > self.recovery.device_reset_limit:
+                    self._device_cooloff_until = (
+                        self.clock() + self.recovery.device_cooloff_s)
+                    klog.warning(
+                        "device snapshot rebuild budget exhausted; "
+                        "host-mode snapshots for %.1fs",
+                        self.recovery.device_cooloff_s)
+                    return self.cache.snapshot(), None, "host"
+
     # -- the cycle ---------------------------------------------------------
 
     def schedule_cycle(self, flush_trigger: str = "",
@@ -593,7 +892,7 @@ class Scheduler:
         if flush_trigger:
             self.obs.note_microbatch(flush_trigger, window_s)
         self.queue.tick()
-        self.cache.cleanup_expired()
+        self._reap_expired_assumptions()
         self._process_waiting(res)
         batch = self.queue.pop_batch(self.max_batch)
         if not batch:
@@ -648,8 +947,11 @@ class Scheduler:
                 # incremental device-resident snapshot: the packed node
                 # table lives on device across cycles; dirty rows patch
                 # in with a jitted scatter, full rebuilds only on shape/
-                # width changes or explicit invalidation (cache.py)
-                nt, dn, snap_mode = self.cache.device_snapshot()
+                # width changes or explicit invalidation (cache.py).
+                # Device errors recover via drop + host-mirror rebuild
+                # (_device_snapshot_recovering — "host" mode fallback
+                # while the device is cooling off)
+                nt, dn, snap_mode = self._device_snapshot_recovering()
             else:
                 nt = self.cache.snapshot()
                 dn = None
@@ -1923,6 +2225,12 @@ class Scheduler:
         bind loop and the pipelined executor's per-chunk bind stage."""
         from kubernetes_tpu.framework import WAIT as _WAIT, CycleState
 
+        if not self._fence_ok():
+            # deposed mid-cycle: abort BEFORE assuming — the new leader
+            # owns this pod now; racing its bind at the hub CAS is the
+            # exact split-brain window the fence closes
+            self._fenced(pod, cycle, res)
+            return
         fw = self.framework
         st = self._cycle_states.get(pod.key()) or CycleState()
         # AssumePodVolumes (scheduler.go:523 assumeVolumes, before
@@ -1978,6 +2286,18 @@ class Scheduler:
 
         fw = self.framework
         cycle = self.queue.scheduling_cycle
+
+        if not self._fence_ok():
+            # the Permit-resume path reaches here without _admit_pod's
+            # gate; the assumption is already held — release it, then
+            # take the shared fenced-abort path (the bind RPC itself
+            # must never leave a deposed leader)
+            self.cache.forget_pod(pod.key())
+            self.volume_binder.forget_pod_volumes(pod.key())
+            fw.run_unreserve(st, pod, node_name)
+            self._fenced(pod, cycle, res)
+            self._cycle_states.pop(pod.key(), None)
+            return False
 
         def reject(reason: str) -> bool:
             klog.warning("bind of %s to %s failed: %s", pod.key(),
@@ -2184,14 +2504,6 @@ class Scheduler:
         Signatures are pre-registered with the JAX telemetry, so the
         first real cycle classifies as a cache hit, not a compile.
         Returns the number of bucketed shapes compiled."""
-        import jax
-
-        from kubernetes_tpu.ops.assign import (
-            batch_assign,
-            device_validate,
-            greedy_assign,
-        )
-
         wu = self.warmup_config
         pk = self.cache.packer
         sample = list(sample_pods)
@@ -2199,7 +2511,9 @@ class Scheduler:
             pk.intern_pod(p)
         if self.cache.node_count():
             if self.device_resident_snapshot:
-                nt, dn, _ = self.cache.device_snapshot()
+                nt, dn, _ = self._device_snapshot_recovering()
+                if dn is None:  # device cooling off: warm on host tables
+                    dn = nodes_to_device(nt)
             else:
                 nt = self.cache.snapshot()
                 dn = nodes_to_device(nt)
@@ -2246,57 +2560,94 @@ class Scheduler:
         has_vol_sample = any(p.volumes for p in sample)
         compiled = 0
         for P in buckets:
-            dp = pods_to_device(pk.pack_pods(sample[:P]), pad_to=P)
-            dv = sv = None
-            if has_vol_sample:
-                # a volume-bearing sample warms the volume-bearing solve
-                # signature real cycles will record (dv in the digest);
-                # row-table shapes scale with the batch's volume rows, so
-                # coverage is exact only when the sample is representative
-                from kubernetes_tpu.ops.arrays import volumes_to_device
-
-                dv = volumes_to_device(pk.pack_volume_tables(sample[:P]))
-                sv = _static_vol_pass(dp, dn, ds, dv)
-            self.obs.jax.record_call("solve", dp, dn, ds, dt, dv,
-                                     static=statics, warmup=True)
-            if solver == "greedy":
-                a, wu_usage = greedy_assign(
-                    dp, dn, ds, self.weights, topo=dt, vol=dv,
-                    static_vol=sv,
-                    enabled_mask=self.pred_mask, skip_priorities=skip_prio,
-                    no_ports=no_ports, no_pod_affinity=no_pod_aff,
-                    no_spread=no_spread,
-                )
-            else:
-                out = batch_assign(
-                    dp, dn, ds, self.weights, max_rounds=self.max_rounds,
-                    per_node_cap=self.per_node_cap, topo=dt, vol=dv,
-                    static_vol=sv, enabled_mask=self.pred_mask,
-                    use_sinkhorn=(solver == "sinkhorn"),
-                    skip_priorities=skip_prio, no_ports=no_ports,
-                    no_pod_affinity=no_pod_aff, no_spread=no_spread,
-                    stats_out=self.obs.config.sinkhorn_telemetry,
-                )
-                a, wu_usage = out[0], out[1]
-            if (self.robustness.validate_results
-                    and not self.robustness.host_validate):
-                # the fused validator rides every production cycle's
-                # readback — compile its program per bucket here too, or
-                # the first real cycle pays it on the hot path
-                dv_out = device_validate(a, wu_usage, dp, dn,
-                                         self.pred_mask)
-                if dv_out is not None:
-                    jax.block_until_ready(dv_out[0])
-            jax.block_until_ready(a)
-            if wu.include_filter:
-                fr = _filter_pass(dp, dn, ds, dt, dv, sv,
-                                  self.pred_mask)
-                jax.block_until_ready(fr.mask)
-            compiled += 1
-            self.metrics.warmup_compiles.inc()
+            try:
+                if self.fault_injector is not None:
+                    # device-loss chaos seam for the compile below
+                    self.fault_injector.device_hook("warmup:compile")
+                compiled += self._warm_bucket(
+                    P, pk, sample, dn, ds, dt, solver, statics,
+                    (skip_prio, no_ports, no_pod_aff, no_spread),
+                    has_vol_sample, wu)
+            except Exception as e:
+                # a lost/OOMed device during an AOT compile (injected
+                # OR a real XLA runtime error — warmup runs inside the
+                # takeover reconciliation, where crashing the new
+                # leader is the worst outcome): abort cleanly with what
+                # compiled so far. The hot path degrades via
+                # _device_snapshot_recovering / the ladder, and the
+                # next re-arm (reconcile, lazy-warm gate) retries.
+                self.metrics.recovery_device_resets.inc()
+                self.obs.note_device_reset()
+                self.cache.drop_device_snapshot()
+                klog.warning("warmup aborted at bucket %d: %s", P, e)
+                return compiled
         klog.V(2).info("warmup: compiled %d bucketed solve shapes "
                        "(nodes bucket %d)", compiled, dn.valid.shape[0])
         return compiled
+
+    def _warm_bucket(self, P, pk, sample, dn, ds, dt, solver, statics,
+                     gates, has_vol_sample, wu) -> int:
+        """Compile one bucketed solve shape (the body of the warmup
+        sweep); returns 1. Split out so the sweep's device-loss
+        handling wraps the WHOLE per-bucket compile — injected chaos
+        AND real XLA runtime errors abort the sweep identically."""
+        import jax
+
+        from kubernetes_tpu.ops.assign import (
+            batch_assign,
+            device_validate,
+            greedy_assign,
+        )
+
+        skip_prio, no_ports, no_pod_aff, no_spread = gates
+        dp = pods_to_device(pk.pack_pods(sample[:P]), pad_to=P)
+        dv = sv = None
+        if has_vol_sample:
+            # a volume-bearing sample warms the volume-bearing solve
+            # signature real cycles will record (dv in the digest);
+            # row-table shapes scale with the batch's volume rows, so
+            # coverage is exact only when the sample is representative
+            from kubernetes_tpu.ops.arrays import volumes_to_device
+
+            dv = volumes_to_device(pk.pack_volume_tables(sample[:P]))
+            sv = _static_vol_pass(dp, dn, ds, dv)
+        self.obs.jax.record_call("solve", dp, dn, ds, dt, dv,
+                                 static=statics, warmup=True)
+        if solver == "greedy":
+            a, wu_usage = greedy_assign(
+                dp, dn, ds, self.weights, topo=dt, vol=dv,
+                static_vol=sv,
+                enabled_mask=self.pred_mask, skip_priorities=skip_prio,
+                no_ports=no_ports, no_pod_affinity=no_pod_aff,
+                no_spread=no_spread,
+            )
+        else:
+            out = batch_assign(
+                dp, dn, ds, self.weights, max_rounds=self.max_rounds,
+                per_node_cap=self.per_node_cap, topo=dt, vol=dv,
+                static_vol=sv, enabled_mask=self.pred_mask,
+                use_sinkhorn=(solver == "sinkhorn"),
+                skip_priorities=skip_prio, no_ports=no_ports,
+                no_pod_affinity=no_pod_aff, no_spread=no_spread,
+                stats_out=self.obs.config.sinkhorn_telemetry,
+            )
+            a, wu_usage = out[0], out[1]
+        if (self.robustness.validate_results
+                and not self.robustness.host_validate):
+            # the fused validator rides every production cycle's
+            # readback — compile its program per bucket here too, or
+            # the first real cycle pays it on the hot path
+            dv_out = device_validate(a, wu_usage, dp, dn,
+                                     self.pred_mask)
+            if dv_out is not None:
+                jax.block_until_ready(dv_out[0])
+        jax.block_until_ready(a)
+        if wu.include_filter:
+            fr = _filter_pass(dp, dn, ds, dt, dv, sv,
+                              self.pred_mask)
+            jax.block_until_ready(fr.mask)
+        self.metrics.warmup_compiles.inc()
+        return 1
 
     def attach_doorbell(self, bell):
         """Wire a serving doorbell into this scheduler: the queue rings
@@ -2323,7 +2674,7 @@ class Scheduler:
         This is what stops an idle cluster from minting empty cycle
         artifacts every --cycle-interval."""
         self.queue.tick()
-        self.cache.cleanup_expired()
+        self._reap_expired_assumptions()
         res = CycleResult()
         self._process_waiting(res)
         if res.unschedulable or res.scheduled:

@@ -15,6 +15,12 @@ record so rounds stay comparable::
               APF-style flow controller: excess creates shed with
               429-equivalent rejections while admitted pods keep a
               bounded p99 and the scheduler queue stays bounded
+    failover  kill-the-leader mid-churn: two replicas share a lease
+              (fenced binds + takeover reconciliation attached); the
+              leader is hard-killed at 40% of the run and the arm
+              reports takeover time (kill -> standby's first bind) and
+              post-recovery p99 create-to-bind, with a CAS'd shared
+              truth proving zero double-binds across the handover
 
 Usage::
 
@@ -59,11 +65,13 @@ POD_CPU = 50.0
 POD_MEM = 128 * 2**20
 
 
-def build_scheduler(n_nodes: int, warm_buckets, solver: str = "batch"):
+def build_scheduler(n_nodes: int, warm_buckets, solver: str = "batch",
+                    binder=None):
     """A fresh scheduler + AOT warmup over the serving bucket grid."""
     s = Scheduler(
         enable_preemption=False,
         solver=solver,
+        binder=binder,
         warmup=WarmupConfig(enabled=True, pod_buckets=tuple(warm_buckets)),
     )
     for i in range(n_nodes):
@@ -332,6 +340,190 @@ def run_serving_arm(rate: float, duration: float, n_nodes: int,
     return out
 
 
+class MiniTruth:
+    """The hub's Binding subresource, miniaturized for the bench: a
+    CAS'd shared truth both replicas bind through. A second bind of the
+    same key raises — so ``double_bind_attempts`` staying 0 across a
+    leader kill IS the no-double-bind invariant, measured."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.bound: dict = {}
+        self.double_bind_attempts = 0
+
+    def binder(self):
+        truth = self
+
+        class _Binder:
+            def bind(self, pod, node_name):
+                with truth.lock:
+                    if pod.key() in truth.bound:
+                        truth.double_bind_attempts += 1
+                        raise RuntimeError(
+                            f"{pod.key()} already bound to "
+                            f"{truth.bound[pod.key()]}")
+                    truth.bound[pod.key()] = node_name
+
+        return _Binder()
+
+
+def run_failover_arm(rate: float, duration: float, n_nodes: int,
+                     warm_buckets, serving_cfg: ServingConfig,
+                     kill_frac: float = 0.4) -> dict:
+    """Kill-the-leader mid-churn. Two serving replicas share an
+    in-memory lease; both are fed every create (informer parity) and
+    the leader's binds are relayed to the standby as watch MODIFIED
+    events. At ``kill_frac`` of the run the leader is hard-killed (its
+    loop stops; no graceful release — the worst case), the standby
+    steals the lease after decay, reconciles, and finishes the queue.
+    Reports takeover time (kill -> standby's first bind), post-recovery
+    p99 create-to-bind, and the double-bind count from the CAS'd shared
+    truth."""
+    from kubernetes_tpu.config import LeaderElectionConfig
+    from kubernetes_tpu.leaderelection import InMemoryLock, LeaderElector
+
+    lease_s = min(2.0, max(duration / 2.0, 0.5))
+    le_cfg = LeaderElectionConfig(
+        lease_duration_s=lease_s, renew_deadline_s=lease_s * 0.7,
+        retry_period_s=lease_s * 0.15)
+    truth = MiniTruth()
+    lock = InMemoryLock()
+
+    class Replica:
+        def __init__(self, name):
+            self.name = name
+            self.sched, self.compiled, self.warm_s = build_scheduler(
+                n_nodes, warm_buckets, binder=truth.binder())
+            self.bell = self.sched.attach_doorbell(Doorbell())
+            self.elector = LeaderElector(name, lock, le_cfg)
+            self.sched.attach_elector(self.elector)
+            self.loop = ServingLoop(self.sched, self.bell, serving_cfg)
+            self.stop = threading.Event()
+            self.results: list = []  # (wall stamp, CycleResult)
+            self.dead = False
+            self.other = None
+
+        def on_cycle(self, res):
+            self.results.append((time.monotonic(), res))
+            # relay binds to the standby — the watch MODIFIED fan-out
+            # that keeps its queue from re-scheduling bound pods
+            peer = self.other
+            if peer is not None and not peer.dead and res.assignments:
+                for key, node in res.assignments.items():
+                    ns, pname = key.split("/", 1)
+                    old = make_pod(pname, namespace=ns, cpu_milli=POD_CPU,
+                                   memory=POD_MEM)
+                    new = make_pod(pname, namespace=ns, cpu_milli=POD_CPU,
+                                   memory=POD_MEM, node_name=node)
+                    peer.loop.ingest(peer.sched.on_pod_update, old, new)
+
+        def gate(self):
+            # tick under the ingest lock: the acquire/depose callbacks
+            # (reconcile, drain) mutate the queue/cache the producer
+            # thread feeds through the same lock
+            with self.loop.lock:
+                leading = self.elector.tick()
+            if leading:
+                return True
+            self.stop.wait(le_cfg.retry_period_s)
+            return False
+
+        def run(self):
+            self.loop.on_cycle = self.on_cycle
+            self.loop.run(self.stop, gate=self.gate)
+
+        def kill(self):
+            """Hard death: the loop stops, the lease decays on its own
+            (no release — the crash case, not the SIGTERM case)."""
+            self.dead = True
+            self.stop.set()
+
+    a, b = Replica("a"), Replica("b")
+    a.other, b.other = b, a
+    assert a.elector.tick()  # 'a' is the established leader
+
+    threads = [threading.Thread(target=r.run, daemon=True) for r in (a, b)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    kill_at = t0 + duration * kill_frac
+    created = 0
+    burst_s = 0.1
+    next_burst = t0
+    kill_t = None
+    create_rate = rate / 2.0  # ops = creates+deletes elsewhere; pure creates
+    while True:
+        now = time.monotonic()
+        if now - t0 >= duration:
+            break
+        if kill_t is None and now >= kill_at:
+            a.kill()
+            kill_t = time.monotonic()
+        if now < next_burst:
+            time.sleep(next_burst - now)
+        next_burst += burst_s
+        target = int(create_rate * (min(time.monotonic(), t0 + duration)
+                                    - t0))
+        while created < target:
+            pod_name = f"fo-{created}"
+            for r in (a, b):
+                if not r.dead:
+                    r.loop.ingest(
+                        r.sched.on_pod_add,
+                        make_pod(pod_name, cpu_milli=POD_CPU,
+                                 memory=POD_MEM))
+            created += 1
+    if kill_t is None:  # tiny smoke runs: kill after the paced window
+        a.kill()
+        kill_t = time.monotonic()
+    drained = drain(b.sched, timeout_s=max(15.0, 3 * lease_s))
+    wall = time.monotonic() - t0
+    for r in (a, b):
+        r.stop.set()
+    for t in threads:
+        t.join(timeout=10)
+
+    takeover_s = None
+    post_p99 = None
+    post_window = [res for t, res in b.results if t > kill_t
+                   and res.scheduled]
+    if post_window:
+        first_bind_t = min(t for t, res in b.results
+                           if t > kill_t and res.scheduled)
+        takeover_s = first_bind_t - kill_t
+        settle = first_bind_t + max(1.0, 0.15 * duration)
+        lats = [v for t, res in b.results if t >= settle
+                for v in res.e2e_latency_s.values()]
+        if not lats:  # smoke runs: everything bound inside the settle
+            lats = [v for t, res in b.results if t > kill_t
+                    for v in res.e2e_latency_s.values()]
+        post_p99 = round(float(np.percentile(np.asarray(lats), 99)), 4)
+
+    pre_lats = [v for t, res in a.results for v in res.e2e_latency_s.values()]
+    return {
+        "mode": "failover",
+        "wall_s": round(wall, 2),
+        "created": created,
+        "bound": len(truth.bound),
+        "drained": drained,
+        "lease_duration_s": lease_s,
+        "kill_after_s": round(kill_t - t0, 2),
+        "leader_cycles_before_kill": len(a.results),
+        "standby_cycles_after_kill": len(post_window),
+        "takeover_s": (round(takeover_s, 3)
+                       if takeover_s is not None else None),
+        "post_recovery_p99_s": post_p99,
+        "pre_kill_p99_s": (round(float(np.percentile(
+            np.asarray(pre_lats), 99)), 4) if pre_lats else None),
+        "double_bind_attempts": truth.double_bind_attempts,
+        "takeovers": int(
+            b.sched.metrics.recovery_takeovers.value()),
+        "fenced_binds": int(
+            a.sched.metrics.recovery_fenced_binds.value()
+            + b.sched.metrics.recovery_fenced_binds.value()),
+    }
+
+
 def run_fixed_arm(rate: float, duration: float, n_nodes: int,
                   warm_buckets, cycle_interval: float = 0.25) -> dict:
     """The legacy baseline: cli.run's pre-serving loop verbatim — solve
@@ -376,6 +568,9 @@ def main(argv=None) -> int:
                     help="seconds of sustained churn per arm (default 65)")
     ap.add_argument("--overload-factor", type=float, default=4.0)
     ap.add_argument("--overload-duration", type=float, default=25.0)
+    ap.add_argument("--failover-duration", type=float, default=30.0,
+                    help="kill-the-leader arm length (leader dies at "
+                         "40%% of it)")
     ap.add_argument("--nodes", type=int, default=64)
     ap.add_argument("--max-wait", type=float, default=0.02,
                     help="micro-batch window ceiling (default 20ms)")
@@ -390,6 +585,7 @@ def main(argv=None) -> int:
     if args.smoke:
         args.duration = 2.0
         args.overload_duration = 2.0
+        args.failover_duration = 4.0
         args.rate = min(args.rate, 200.0)
         args.nodes = min(args.nodes, 8)
     warm_buckets = (8, 16, 32, 64, 128, 256) if not args.smoke else (8, 16, 32)
@@ -430,11 +626,20 @@ def main(argv=None) -> int:
         ("overload", lambda: run_serving_arm(
             args.rate, args.overload_duration, args.nodes, warm_buckets,
             serving_cfg, overload=True)),
+        ("failover", lambda: run_failover_arm(
+            args.rate, args.failover_duration, args.nodes, warm_buckets,
+            serving_cfg)),
     ):
         print(f"  arm {name}...", file=sys.stderr)
         try:
             record["arms"][name] = fn()
             a = record["arms"][name]
+            if name == "failover":
+                print(f"    takeover={a.get('takeover_s')}s "
+                      f"post_p99={a.get('post_recovery_p99_s')}s "
+                      f"double_binds={a.get('double_bind_attempts')}",
+                      file=sys.stderr)
+                continue
             print(f"    {a.get('ops_per_sec', 0)} ops/s  "
                   f"p50={a['p50_s']}s p99={a['p99_s']}s "
                   f"retraces={a['jax'].get('retraces')} "
@@ -448,7 +653,22 @@ def main(argv=None) -> int:
     sv = record["arms"].get("serving") or {}
     fx = record["arms"].get("fixed") or {}
     ov = record["arms"].get("overload") or {}
+    fo = record["arms"].get("failover") or {}
+    lease = fo.get("lease_duration_s", 2.0) or 2.0
     record["criteria"] = {
+        # failover: the standby bound within a small multiple of the
+        # lease decay, every created pod landed, and the CAS'd truth
+        # saw zero double-bind attempts across the handover
+        "failover_takeover_ok": bool(
+            fo.get("takeover_s") is not None
+            and fo["takeover_s"] < 3 * lease + 2.0),
+        "failover_no_double_binds": bool(
+            fo.get("double_bind_attempts", 1) == 0),
+        "failover_drained_ok": bool(
+            fo.get("drained") and fo.get("bound") == fo.get("created")),
+        "failover_post_p99_bounded_ok": bool(
+            fo.get("post_recovery_p99_s") is not None
+            and fo["post_recovery_p99_s"] < 2.0),
         "sustained_rate_ok": bool(
             sv.get("ops_per_sec", 0) >= args.rate * 0.95
             and sv.get("wall_s", 0) >= args.duration

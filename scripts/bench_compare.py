@@ -43,6 +43,13 @@ the two newest ``benchres/churn_r*.json`` are diffed on the serving
 arm's p99 create-to-bind + throughput and the overload arm's shed rate.
 Absence is tolerated — pre-serving benchres directories keep passing.
 
+Recovery gates (the crash/failover PR) ride the same churn records:
+the kill-the-leader arm's ``takeover_s`` (leader death -> standby's
+first bind) and ``post_recovery_p99_s`` must not regress, and its
+``double_bind_attempts`` must stay 0 in the NEW record. Absence is
+tolerated — churn records predating the failover arm skip with a
+warning, never a failure.
+
 Records carrying errors in the compared sections are skipped with a
 warning rather than failing the gate — a partial bench record is a bench
 problem, not a perf regression.
@@ -256,6 +263,27 @@ def compare_churn(prev: dict, cur: dict, threshold: float) -> dict:
           (pa.get("overload") or {}).get("shed_rate"),
           (ca.get("overload") or {}).get("shed_rate"),
           lower_is_better=True)
+    # recovery gates (kill-the-leader arm): takeover time and
+    # post-recovery p99 must not regress; absence-tolerant like every
+    # churn gate (records predating the failover arm warn and pass)
+    check("churn.failover.takeover_s",
+          (pa.get("failover") or {}).get("takeover_s"),
+          (ca.get("failover") or {}).get("takeover_s"),
+          lower_is_better=True)
+    check("churn.failover.post_recovery_p99_s",
+          (pa.get("failover") or {}).get("post_recovery_p99_s"),
+          (ca.get("failover") or {}).get("post_recovery_p99_s"),
+          lower_is_better=True)
+    # absolute invariant on the NEW record alone: a single double-bind
+    # attempt across the handover is a correctness bug, not a perf delta
+    db = _num((ca.get("failover") or {}).get("double_bind_attempts"))
+    if db is not None:
+        row = {"check": "churn.failover.double_bind_attempts",
+               "prev": None, "cur": db, "delta_frac": db,
+               "regressed": db > 0}
+        checks.append(row)
+        if db > 0:
+            regressions.append(row)
     return {"checks": checks, "regressions": regressions,
             "warnings": warnings}
 

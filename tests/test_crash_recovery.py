@@ -1,0 +1,725 @@
+"""Crash, failover, and device-loss recovery — the chaos suites for the
+process-level invariant triple:
+
+1. no pod is ever double-bound at the hub truth,
+2. no assumption is ever leaked after convergence,
+3. every schedulable pod is eventually bound.
+
+Covers: the seeded :class:`~kubernetes_tpu.chaos.CrashLoop` (kill/
+restart at randomized bind/solve/commit fault points, >= 3 seeds), the
+dual-scheduler failover suite (lease CAS races, leader kills, graceful
+release), fenced binds, takeover reconciliation, device-loss recovery
+(resident rebuild + host-mode cooloff + ladder absorption), the three
+``confirm_binding`` Conflict flavors, the expired-assumption reaping
+satellite, the serving-idle Permit-timeout satellite, and the
+``recovery:`` config block round-trip."""
+
+import dataclasses
+
+import pytest
+
+from kubernetes_tpu.cache import SchedulerCache
+from kubernetes_tpu.chaos import CrashLoop, HAReplica, SchedulerKilled
+from kubernetes_tpu.config import (
+    KubeSchedulerConfiguration,
+    LeaderElectionConfig,
+    RecoveryConfig,
+    WarmupConfig,
+)
+from kubernetes_tpu.faults import DeviceLost, FaultInjector
+from kubernetes_tpu.leaderelection import InMemoryLock, LeaderElector
+from kubernetes_tpu.scheduler import RecordingBinder, Scheduler
+from kubernetes_tpu.sim import Conflict, HollowCluster
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# CrashLoop: kill/restart at randomized fault points, seeded
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_crashloop_invariant_triple(seed):
+    """Kill the scheduler at seeded bind/solve/commit fault points and
+    restart it against the same hub: every pod binds exactly once, no
+    assumption survives convergence, nothing is stranded."""
+    hub = HollowCluster(seed=seed)
+    loop = CrashLoop(hub, seed=seed, kill_rate=0.25, max_kills=5)
+    rep = loop.run(n_pods=24, n_nodes=5)
+    # the chaos actually happened
+    assert rep["kills"] == 5 and rep["incarnations"] == rep["kills"] + 1
+    # invariant 3: every schedulable pod bound
+    assert rep["all_bound"], rep["bound"]
+    # invariant 1: the hub committed each pod exactly once and no retry
+    # ever raced the CAS
+    assert rep["bound_total"] == rep["n_pods"]
+    assert rep["conflicts"] == 0
+    # invariant 2: zero leaked assumptions after convergence
+    assert rep["leaked_assumptions"] == []
+    hub.check_consistency()
+
+
+def test_crashloop_covers_commit_window():
+    """Across the three pinned seeds the plan must exercise the
+    bind-side crash windows — including the post-commit one (killed
+    between the hub commit and finish_binding), the window takeover
+    reconciliation exists for."""
+    sites = set()
+    for seed in (1, 2, 3):
+        hub = HollowCluster(seed=seed)
+        loop = CrashLoop(hub, seed=seed, kill_rate=0.25, max_kills=5)
+        loop.run(n_pods=24, n_nodes=5)
+        sites |= set(loop.plan.fired)
+    assert "bind:post" in sites and "bind:pre" in sites, sites
+
+
+def test_crashloop_restart_adopts_committed_bind():
+    """The exact ISSUE window, deterministically: the scheduler dies
+    AFTER confirm_binding committed at the hub but BEFORE
+    finish_binding — the next incarnation must adopt the bind from the
+    relist (cache knows it, queue does not), never re-bind it."""
+    hub = HollowCluster(seed=7)
+    loop = CrashLoop(hub, seed=7, kill_rate=0.0, max_kills=1)
+    loop.plan.kill_rate = 1.0
+    loop.plan.sites = {"bind:post"}  # only the post-commit window
+    for i in range(3):
+        hub.add_node(make_node(f"n{i}", cpu_milli=4000))
+    sched = loop.new_incarnation()
+    hub.create_pod(make_pod("victim", cpu_milli=500))
+    with pytest.raises(SchedulerKilled):
+        sched.schedule_cycle()
+    # hub committed; the dead incarnation never ran finish_binding
+    assert hub.truth_pods["default/victim"].node_name
+    assert hub.bound_total == 1
+    sched2 = loop.new_incarnation()  # relist + reconcile
+    assert sched2.cache.pod("default/victim") is not None
+    assert not sched2.cache.is_assumed("default/victim")
+    assert sched2.queue.pod("default/victim") is None
+    assert sched2.metrics.recovery_adopted.value() >= 1
+    r = sched2.schedule_cycle()  # nothing to do; nothing re-bound
+    assert r.attempted == 0 and hub.bound_total == 1
+    assert hub.binder.conflicts == 0
+
+
+# ---------------------------------------------------------------------------
+# Dual-scheduler failover: leader kills, CAS races, graceful release
+# ---------------------------------------------------------------------------
+
+_LE = LeaderElectionConfig(lease_duration_s=15, renew_deadline_s=10,
+                           retry_period_s=2)
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_failover_leader_kill_mid_churn(seed):
+    """Two replicas share the hub Lease; the leader dies mid-churn. The
+    standby must take over (after lease decay), reconcile, and finish
+    the queue — zero double-binds, zero leaks, everything bound."""
+    hub = HollowCluster(seed=seed)
+    for i in range(4):
+        hub.add_node(make_node(f"n{i}", cpu_milli=4000))
+    clk = hub.clock
+    a = HAReplica("a", hub, _LE)
+    b = HAReplica("b", hub, _LE)
+    for i in range(6):
+        hub.create_pod(make_pod(f"pre{i}", cpu_milli=500))
+    for _ in range(3):
+        a.tick()
+        b.tick()
+        clk.advance(2)
+    assert a.cycles > 0 and b.cycles == 0
+    # mid-churn: more pods land while the leader is dying
+    for i in range(6):
+        hub.create_pod(make_pod(f"mid{i}", cpu_milli=500))
+    a.kill()
+    for _ in range(14):
+        b.tick()
+        clk.advance(2)
+    assert b.elector.is_leader() and b.cycles > 0
+    # takeover ran a reconciliation with the relisted truth
+    assert b.sched.metrics.recovery_takeovers.value() >= 1
+    assert hub.bound_total == 12
+    assert all(p.node_name for p in hub.truth_pods.values())
+    assert hub.binder.conflicts == 0
+    assert a.sched.cache.assumed_keys() == []
+    assert b.sched.cache.assumed_keys() == []
+    hub.check_consistency()
+
+
+def test_failover_graceful_release_skips_lease_decay():
+    """A clean shutdown releases the lease: the standby acquires on its
+    very next tick instead of waiting out lease_duration."""
+    hub = HollowCluster(seed=21)
+    clk = hub.clock
+    a = HAReplica("a", hub, _LE)
+    b = HAReplica("b", hub, _LE)
+    a.tick()
+    b.tick()
+    assert a.elector.is_leader() and not b.elector.is_leader()
+    a.shutdown()  # SIGTERM path: drain + release
+    assert not a.elector.is_leader()
+    # NO clock advance: the release record is already expired
+    b.tick()
+    assert b.elector.is_leader()
+    rec, _ = hub.get_lease("kube-system", "kube-scheduler")
+    assert rec.holder_identity == "b"
+    clk.advance(0)  # determinism: nothing depended on time passing
+
+
+def test_failover_cas_race_rejects_cleanly():
+    """A competing writer binds a pod behind the leader's back; the
+    leader's own bind hits the hub CAS (Conflict: already assigned) and
+    must take the reject path — forget + requeue — while the truth
+    stays single-bound on the competitor's node."""
+    hub = HollowCluster(seed=22)
+    hub.add_node(make_node("n0", cpu_milli=4000))
+    hub.add_node(make_node("n1", cpu_milli=4000))
+    a = HAReplica("a", hub, _LE)
+    a.tick()  # established leader (acquire-time reconcile runs empty)
+    hub.create_pod(make_pod("raced", cpu_milli=100))
+    a.reflector.pump()
+    # the competing writer wins the race at the hub
+    hub.confirm_binding(hub.truth_pods["default/raced"], "n1")
+    # the leader schedules BEFORE its informer pumps the competitor's
+    # MODIFIED event — the stale-view race, deterministically
+    assert a.elector.tick()
+    a.sched.schedule_cycle()
+    assert hub.truth_pods["default/raced"].node_name == "n1"
+    assert hub.bound_total == 1  # single-bound, competitor's write
+    assert hub.binder.conflicts >= 1
+    assert not a.sched.cache.is_assumed("default/raced")
+    # the watch MODIFIED (from the competitor's bind) removes the pod
+    # from the queue; the next cycles stay quiet
+    for _ in range(3):
+        hub.clock.advance(2)
+        a.tick()
+    assert a.sched.queue.pod("default/raced") is None
+    hub.check_consistency()
+
+
+def test_stopped_leading_drains_in_flight_state():
+    """A deposed leader must drain Permit-parked pods and local
+    assumptions — capacity freed, pods requeued — so nothing it held
+    in flight leaks while the new leader owns the queue."""
+    from kubernetes_tpu.framework import WAIT, Framework, Plugin, Status
+
+    class Gate(Plugin):
+        def permit(self, state, pod, node_name):
+            return Status(WAIT, ""), 100.0
+
+    clk = FakeClock()
+    s = Scheduler(framework=Framework(plugins=[Gate()], clock=clk),
+                  clock=clk, enable_preemption=False)
+    lock = InMemoryLock()
+    el = LeaderElector("me", lock, _LE, clk)
+    s.attach_elector(el)
+    assert el.tick()
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("parked"))
+    res = s.schedule_cycle()
+    assert res.waiting == 1 and s.cache.is_assumed("default/parked")
+    # a rival steals the lease: it must first OBSERVE the record, then
+    # wait out the lease duration from its own observation
+    rival = LeaderElector("rival", lock, _LE, clk)
+    assert not rival.tick()
+    clk.advance(16)
+    assert rival.tick()
+    assert not el.tick()  # deposed -> on_stopped_leading -> drain
+    assert s.framework.waiting.get("default/parked") is None
+    assert not s.cache.is_assumed("default/parked")
+    assert s.cache.assumed_keys() == []
+    assert s.queue.pod("default/parked") is not None  # requeued
+    assert s.metrics.recovery_drained.value() >= 1
+
+
+def test_fenced_bind_aborts_deposed_leader():
+    """The fence closes the split-brain window: a leader whose lease
+    expired under it (renew stalled) must abort its in-flight binds —
+    the binder is never called, the pod requeues for the new leader."""
+    clk = FakeClock()
+    binder = RecordingBinder()
+    s = Scheduler(binder=binder, clock=clk, enable_preemption=False)
+    el = LeaderElector("me", InMemoryLock(), _LE, clk)
+    s.attach_elector(el)
+    assert el.tick()
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("p0"))
+    # the lease goes stale mid-cycle: no renew within renew_deadline
+    clk.advance(11)
+    res = s.schedule_cycle()
+    assert binder.bindings == []  # the write never left the building
+    assert res.scheduled == 0 and res.unschedulable == 1
+    assert res.failure_reasons["default/p0"] == ("FencedBind:lease lost",)
+    assert s.metrics.recovery_fenced_binds.value() == 1
+    assert not s.cache.is_assumed("default/p0")
+    assert s.queue.pod("default/p0") is not None
+    # flight record carries the fenced= flag
+    rec = s.obs.recorder.records()[-1]
+    assert rec.fenced_binds == 1
+    # renewing the lease un-fences: the pod binds next cycle
+    clk.advance(60)  # backoff drains; the stale lease window passed
+    assert el.tick()  # fresh renew -> allow_bind() true again
+    s.queue.move_all_to_active()
+    s.queue.tick()
+    res2 = s.schedule_cycle()
+    assert res2.scheduled == 1 and binder.bindings
+
+
+def test_fence_disabled_by_config():
+    clk = FakeClock()
+    s = Scheduler(clock=clk, enable_preemption=False,
+                  recovery=RecoveryConfig(fenced_binds=False))
+    el = LeaderElector("me", InMemoryLock(), _LE, clk)
+    s.attach_elector(el)
+    assert el.tick()
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("p0"))
+    clk.advance(11)  # stale lease, but fencing is off
+    res = s.schedule_cycle()
+    assert res.scheduled == 1
+
+
+# ---------------------------------------------------------------------------
+# Takeover reconciliation
+# ---------------------------------------------------------------------------
+
+
+def test_reconcile_forgets_contradicted_assumption_and_requeues():
+    """Truth says the pod is unbound; the cache says assumed. The
+    assumption is a leftover of a half-crashed bind — reconcile must
+    forget it and requeue the pod."""
+    clk = FakeClock()
+    s = Scheduler(clock=clk, enable_preemption=False)
+    s.on_node_add(make_node("n0"))
+    p = make_pod("p0", cpu_milli=100, uid="u1")
+    s.cache.assume_pod(p, "n0")
+    s.cache.finish_binding(p.key())
+    truth = [dataclasses.replace(p, node_name="")]
+    out = s.reconcile(truth)
+    assert out["forgotten"] == 1 and out["requeued"] == 1
+    assert not s.cache.is_assumed("default/p0")
+    assert s.queue.pod("default/p0") is not None
+    assert s.metrics.recovery_takeovers.value() == 1
+    assert s.metrics.recovery_forgotten.value() == 1
+
+
+def test_reconcile_adopts_agreeing_assumption():
+    """Truth agrees with the assumption (the dead leader's bind DID
+    commit): reconcile confirms it instead of waiting out the TTL."""
+    clk = FakeClock()
+    s = Scheduler(clock=clk, enable_preemption=False)
+    s.on_node_add(make_node("n0"))
+    p = make_pod("p0", cpu_milli=100, uid="u1")
+    s.cache.assume_pod(p, "n0")
+    truth = [dataclasses.replace(p, node_name="n0")]
+    out = s.reconcile(truth)
+    assert out["adopted"] == 1 and out["forgotten"] == 0
+    assert not s.cache.is_assumed("default/p0")  # confirmed, not assumed
+    assert s.cache.pod("default/p0") is not None
+    clk.advance(10_000)
+    assert s.cache.cleanup_expired() == []  # nothing to expire
+
+
+def test_reconcile_drops_deleted_pods_from_queue():
+    """A pod the truth no longer contains must leave the queues."""
+    s = Scheduler(clock=FakeClock(), enable_preemption=False)
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("ghost"))
+    assert s.queue.pod("default/ghost") is not None
+    s.reconcile([])
+    assert s.queue.pod("default/ghost") is None
+
+
+def test_reconcile_rebuilds_device_snapshot_and_flags_record():
+    """Reconcile drops the resident device table (full re-upload next
+    cycle) and the next flight record carries takeover=epoch."""
+    clk = FakeClock()
+    s = Scheduler(clock=clk, enable_preemption=False)
+    el = LeaderElector("me", InMemoryLock(), _LE, clk)
+    s.attach_elector(el)
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("p0"))
+    assert el.tick()  # acquire -> on_started_leading -> reconcile
+    res = s.schedule_cycle()
+    assert res.scheduled == 1
+    assert res.snapshot_mode == "full"  # resident table was dropped
+    rec = s.obs.recorder.records()[-1]
+    assert rec.takeover == el.epoch == 1
+
+
+# ---------------------------------------------------------------------------
+# Device-loss recovery
+# ---------------------------------------------------------------------------
+
+
+def test_device_loss_rebuilds_resident_snapshot():
+    """One injected device loss at the snapshot site: the resident
+    table drops, rebuilds from the host mirror within the same cycle,
+    and the cycle completes normally."""
+    fi = FaultInjector(seed=0).arm("snapshot:device", "device_lost",
+                                   count=1)
+    clk = FakeClock()
+    s = Scheduler(clock=clk, enable_preemption=False, fault_injector=fi)
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("p0"))
+    res = s.schedule_cycle()
+    assert res.scheduled == 1
+    assert res.snapshot_mode == "full"  # rebuilt after the drop
+    assert s.metrics.recovery_device_resets.value() == 1
+    assert s.obs.recorder.records()[-1].device_resets == 1
+    assert fi.fired_total("snapshot:device") == 1
+
+
+def test_device_loss_cooloff_then_heal():
+    """A persistent device outage exhausts the per-cycle rebuild budget
+    -> host-mode snapshots for device_cooloff_s; once the cooloff
+    passes AND the device heals, the resident path resumes."""
+    fi = FaultInjector(seed=0).arm("snapshot:device", "device_lost",
+                                   count=4)
+    clk = FakeClock()
+    s = Scheduler(clock=clk, enable_preemption=False, fault_injector=fi,
+                  recovery=RecoveryConfig(device_reset_limit=1,
+                                          device_cooloff_s=5.0))
+    s.on_node_add(make_node("n0", cpu_milli=64000, pods=200))
+    modes = []
+    for i in range(4):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=10))
+        res = s.schedule_cycle()
+        assert res.scheduled == 1
+        modes.append(res.snapshot_mode)
+        clk.advance(6)  # past the cooloff before each next cycle
+    # cycle 0: 2 failed rebuilds (shots 1-2) -> host fallback;
+    # cycle 1: cooloff expired, probe fails again (shots 3-4) -> host;
+    # cycles 2-3: the injector is exhausted — the device healed and the
+    # resident path resumed (a 1-node cluster's dirty fraction is
+    # always 1.0, so "full" rather than "delta" is expected here)
+    assert modes[0] == "host" and modes[1] == "host"
+    assert modes[2] == "full" and modes[3] != "host"
+    assert s.metrics.recovery_device_resets.value() == 4
+
+
+def test_device_loss_in_solver_absorbed_by_ladder():
+    """device_lost at the solve site: the PR-1 ladder absorbs it —
+    batch fails, batch-cpu (re-pinned to the CPU device) answers."""
+    fi = FaultInjector(seed=0).arm("solve:batch", "device_lost")
+    s = Scheduler(clock=FakeClock(), enable_preemption=False,
+                  fault_injector=fi)
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("p0"))
+    res = s.schedule_cycle()
+    assert res.scheduled == 1
+    assert res.solver_tier == "batch-cpu"
+    assert res.solver_fallbacks >= 1
+
+
+def test_device_loss_aborts_warmup_cleanly():
+    fi = FaultInjector(seed=0).arm("warmup:compile", "device_oom",
+                                   count=1)
+    s = Scheduler(clock=FakeClock(), enable_preemption=False,
+                  fault_injector=fi,
+                  warmup=WarmupConfig(enabled=True, pod_buckets=(8, 16)))
+    s.on_node_add(make_node("n0"))
+    compiled = s.warmup(sample_pods=[make_pod("w", cpu_milli=10)])
+    assert compiled == 0  # aborted at the first bucket, no crash
+    assert s.metrics.recovery_device_resets.value() == 1
+    # the device healed (shot spent): warmup completes on re-arm
+    assert s.warmup(sample_pods=[make_pod("w", cpu_milli=10)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# confirm_binding Conflict flavors (satellite): deleted / recreated-uid /
+# already-bound must all take the reject path without corrupting the
+# device-resident snapshot
+# ---------------------------------------------------------------------------
+
+
+def _stale_view_scheduler(hub):
+    """A scheduler binding through the hub but fed manually — hub
+    mutations do NOT reach it, giving it a deliberately stale view
+    (the delayed-informer race, deterministically)."""
+    s = Scheduler(binder=hub.binder, clock=hub.clock,
+                  cache=SchedulerCache(clock=hub.clock),
+                  enable_preemption=False)
+    for n in hub.truth_nodes.values():
+        s.on_node_add(n)
+    return s
+
+
+def test_conflict_pod_deleted_mid_bind():
+    hub = HollowCluster(seed=31)
+    hub.add_node(make_node("n0", cpu_milli=4000))
+    s = _stale_view_scheduler(hub)
+    hub.create_pod(make_pod("gone", cpu_milli=100))
+    s.on_pod_add(dataclasses.replace(hub.truth_pods["default/gone"]))
+    hub.delete_pod("default/gone")  # deleted before the bind lands
+    res = s.schedule_cycle()
+    assert res.bind_errors == 1 and res.scheduled == 0
+    assert hub.binder.conflicts == 1
+    assert not s.cache.is_assumed("default/gone")
+    assert s.queue.pod("default/gone") is not None  # requeued
+    # the resident snapshot survived the reject: a fresh pod binds
+    # cleanly on the delta path next cycle
+    hub.create_pod(make_pod("fresh", cpu_milli=100))
+    s.on_pod_add(dataclasses.replace(hub.truth_pods["default/fresh"]))
+    hub.clock.advance(60)
+    res2 = s.schedule_cycle()
+    assert res2.scheduled >= 1
+    assert res2.snapshot_mode != "host"  # resident path still healthy
+    assert hub.truth_pods["default/fresh"].node_name
+
+
+def test_conflict_pod_recreated_uid_changed():
+    """Recreated under the same key with a new uid: the bind rejects,
+    and the NEXT RELIST must not adopt the stale pod — the truth object
+    (new uid) replaces the queued one and binds."""
+    hub = HollowCluster(seed=32)
+    hub.add_node(make_node("n0", cpu_milli=4000))
+    s = _stale_view_scheduler(hub)
+    hub.create_pod(make_pod("reborn", cpu_milli=100))
+    old = hub.truth_pods["default/reborn"]
+    s.on_pod_add(dataclasses.replace(old))
+    hub.delete_pod("default/reborn")
+    hub.create_pod(make_pod("reborn", cpu_milli=100))
+    new = hub.truth_pods["default/reborn"]
+    assert new.uid != old.uid
+    res = s.schedule_cycle()
+    assert res.bind_errors == 1 and hub.binder.conflicts == 1
+    assert not s.cache.is_assumed("default/reborn")
+    # relist: reconcile against truth — the stale (old-uid) queue entry
+    # is replaced, never adopted
+    s.reconcile(list(hub.truth_pods.values()))
+    assert s.cache.pod("default/reborn") is None
+    assert s.queue.pod("default/reborn").uid == new.uid
+    hub.clock.advance(60)
+    s.queue.tick()
+    res2 = s.schedule_cycle()
+    assert res2.scheduled == 1
+    assert hub.truth_pods["default/reborn"].node_name
+    assert hub.truth_pods["default/reborn"].uid == new.uid
+    assert hub.bound_total == 1
+
+
+def test_conflict_already_bound_by_other_writer():
+    hub = HollowCluster(seed=33)
+    hub.add_node(make_node("n0", cpu_milli=4000))
+    hub.add_node(make_node("n1", cpu_milli=4000))
+    s = _stale_view_scheduler(hub)
+    hub.create_pod(make_pod("taken", cpu_milli=100))
+    s.on_pod_add(dataclasses.replace(hub.truth_pods["default/taken"]))
+    hub.confirm_binding(hub.truth_pods["default/taken"], "n1")
+    res = s.schedule_cycle()
+    assert res.bind_errors == 1 and hub.binder.conflicts == 1
+    assert hub.truth_pods["default/taken"].node_name == "n1"
+    assert hub.bound_total == 1  # single-bound: the competitor's write
+    assert not s.cache.is_assumed("default/taken")
+    # reconcile adopts the competitor's bind and clears the queue
+    s.reconcile(list(hub.truth_pods.values()))
+    assert s.queue.pod("default/taken") is None
+    cached = s.cache.pod("default/taken")
+    assert cached is not None and cached.node_name == "n1"
+
+
+# ---------------------------------------------------------------------------
+# Satellite: expired assumptions are logged, counted, evented, requeued
+# ---------------------------------------------------------------------------
+
+
+def test_expired_assumption_requeues_counts_and_events():
+    """An assumed pod whose bind confirmation never arrives must not
+    vanish: TTL expiry frees the capacity AND requeues the pod, counts
+    it, and emits AssumptionExpired (regression-pin for the discarded
+    cleanup_expired() return)."""
+    clk = FakeClock()
+    events = []
+    s = Scheduler(clock=clk, enable_preemption=False,
+                  event_sink=lambda r, p, m: events.append((r, p.key(), m)))
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    s.on_pod_add(make_pod("p0", cpu_milli=800))
+    res = s.schedule_cycle()
+    assert res.scheduled == 1  # bound via RecordingBinder; no watch ever
+    assert s.cache.is_assumed("default/p0")
+    clk.advance(31)  # past DEFAULT_ASSUME_TTL_S
+    s.idle_tick()  # the serving loop's idle path drives the reaping
+    assert s.metrics.cache_expired_assumptions.value() == 1
+    assert not s.cache.is_assumed("default/p0")
+    assert s.queue.pod("default/p0") is not None  # requeued
+    assert ("AssumptionExpired", "default/p0") in [
+        (r, k) for r, k, _ in events]
+    # capacity actually freed: a same-size pod binds again
+    s.queue.move_all_to_active()
+    res2 = s.schedule_cycle()
+    assert res2.scheduled == 1
+
+
+def test_expired_assumption_reaped_in_cycle_path_too():
+    clk = FakeClock()
+    s = Scheduler(clock=clk, enable_preemption=False)
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("p0"))
+    assert s.schedule_cycle().scheduled == 1
+    clk.advance(31)
+    # the cycle path reaps BEFORE popping: the requeued pod re-enters
+    # activeQ and the very same cycle re-binds it — convergence in one
+    res2 = s.schedule_cycle()
+    assert s.metrics.cache_expired_assumptions.value() == 1
+    assert res2.scheduled == 1
+    assert s.cache.is_assumed("default/p0")  # re-bound, TTL re-armed
+
+
+# ---------------------------------------------------------------------------
+# Satellite: serving-idle starvation — Permit timeout fires from
+# idle_tick, without any new work arriving
+# ---------------------------------------------------------------------------
+
+
+def test_idle_tick_times_out_permit_parked_pod():
+    """A Permit-parked pod on an otherwise-idle serving loop must time
+    out and requeue purely from idle_tick maintenance (fake clock, no
+    cycles): assumption freed, pod back in a queue, failure recorded."""
+    from kubernetes_tpu.framework import WAIT, Framework, Plugin, Status
+
+    class Gate(Plugin):
+        def permit(self, state, pod, node_name):
+            return Status(WAIT, ""), 5.0  # 5s wait deadline
+
+    clk = FakeClock()
+    s = Scheduler(framework=Framework(plugins=[Gate()], clock=clk),
+                  clock=clk, enable_preemption=False)
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("parked"))
+    res = s.schedule_cycle()
+    assert res.waiting == 1 and s.cache.is_assumed("default/parked")
+    before = s.metrics.schedule_attempts.value(
+        result=s.metrics.UNSCHEDULABLE)
+    # idle serving loop: doorbell timeouts -> idle_tick only, no cycles
+    clk.advance(6)
+    s.idle_tick()
+    assert s.framework.waiting.get("default/parked") is None
+    assert not s.cache.is_assumed("default/parked")
+    assert s.queue.pod("default/parked") is not None  # requeued
+    after = s.metrics.schedule_attempts.value(
+        result=s.metrics.UNSCHEDULABLE)
+    assert after == before + 1  # the idle path recorded the outcome
+
+
+# ---------------------------------------------------------------------------
+# Lease fencing primitives + recovery: config block
+# ---------------------------------------------------------------------------
+
+
+def test_elector_epoch_and_allow_bind_lifecycle():
+    clk = FakeClock()
+    lock = InMemoryLock()
+    el = LeaderElector("me", lock, _LE, clk)
+    assert el.epoch == 0 and not el.allow_bind()
+    assert el.tick()
+    assert el.epoch == 1 and el.allow_bind()
+    clk.advance(9)
+    assert el.allow_bind()  # within renew_deadline of the last renew
+    clk.advance(2)
+    assert not el.allow_bind()  # renew stalled: self-fenced BEFORE expiry
+    assert el.tick()  # renew succeeds (lease never left us)
+    assert el.allow_bind() and el.epoch == 1  # same incarnation
+    # deposed, then re-elected: new epoch
+    rival = LeaderElector("rival", lock, _LE, clk)
+    assert not rival.tick()  # first observation starts its expiry clock
+    clk.advance(16)
+    assert rival.tick()
+    assert not el.tick()
+    clk.advance(16)
+    assert el.tick()
+    assert el.epoch == 2
+
+
+def test_elector_release_is_observable_and_immediate():
+    clk = FakeClock()
+    lock = InMemoryLock()
+    a = LeaderElector("a", lock, _LE, clk)
+    b = LeaderElector("b", lock, _LE, clk)
+    assert a.tick() and not b.tick()
+    assert a.release()
+    assert not a.is_leader() and not a.allow_bind()
+    assert b.tick()  # immediately, no decay wait
+    assert b.is_leader()
+    assert not a.release()  # idempotent: not leading -> no-op
+
+
+def test_release_never_clobbers_successor_lease():
+    """A wedged ex-leader whose local flag is stale-True gets SIGTERMed
+    AFTER the standby already acquired: release() must notice the lease
+    is no longer its own and write NOTHING — clobbering the successor's
+    live record with an expired one would re-open the double-leader
+    window (a third replica could acquire while the successor still
+    passes allow_bind)."""
+    clk = FakeClock()
+    lock = InMemoryLock()
+    a = LeaderElector("a", lock, _LE, clk)
+    b = LeaderElector("b", lock, _LE, clk)
+    assert a.tick()
+    # 'a' wedges (never ticks again); 'b' observes, waits out the lease
+    assert not b.tick()
+    clk.advance(16)
+    assert b.tick() and b.is_leader()
+    # the wedged 'a' is now SIGTERMed; its local flag is stale-True
+    assert a._leading
+    assert not a.release()  # must refuse: the lease is b's now
+    rec, _ = lock.get(), None
+    assert lock.get().holder_identity == "b"  # live record untouched
+    assert not a.is_leader()  # but 'a' did step down locally
+    clk.advance(1)
+    assert b.tick()  # b renews undisturbed
+
+
+def test_recovery_config_native_decode_and_validation():
+    from kubernetes_tpu.cli import decode_config, validate_config
+
+    cfg = decode_config({"recovery": {"fenced_binds": False,
+                                      "device_reset_limit": 4,
+                                      "device_cooloff_s": 2.5}})
+    assert cfg.recovery.fenced_binds is False
+    assert cfg.recovery.device_reset_limit == 4
+    assert cfg.recovery.device_cooloff_s == 2.5
+    assert validate_config(cfg) == []
+    bad = KubeSchedulerConfiguration(
+        recovery=RecoveryConfig(device_reset_limit=-1,
+                                device_cooloff_s=-2))
+    errs = validate_config(bad)
+    assert any("deviceResetLimit" in e for e in errs)
+    assert any("deviceCooloff" in e for e in errs)
+    with pytest.raises(Exception):
+        decode_config({"recovery": {"nope": 1}})
+
+
+def test_recovery_config_v1alpha1_round_trip():
+    from kubernetes_tpu.api.config_v1alpha1 import decode, encode
+
+    doc = {
+        "apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
+        "kind": "KubeSchedulerConfiguration",
+        "recovery": {"fencedBinds": False, "deviceCooloff": "1m30s",
+                     "deviceResetLimit": 7,
+                     "releaseLeaseOnShutdown": False},
+    }
+    cfg = decode(doc)
+    assert cfg.recovery.fenced_binds is False
+    assert cfg.recovery.device_cooloff_s == 90.0
+    assert cfg.recovery.device_reset_limit == 7
+    assert cfg.recovery.release_lease_on_shutdown is False
+    assert cfg.recovery.reconcile_on_takeover is True  # defaulted
+    enc = encode(cfg)
+    assert enc["recovery"]["deviceCooloff"] == "1m30s"
+    assert enc["recovery"]["fencedBinds"] is False
+    assert decode(enc).recovery == cfg.recovery
+    # Scheduler.from_config threads the block through
+    s = Scheduler.from_config(cfg)
+    assert s.recovery.device_reset_limit == 7

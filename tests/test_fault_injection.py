@@ -229,7 +229,13 @@ def test_breaker_opens_emits_degraded_event_and_recovers():
     clk.advance(60.0)
     s.on_pod_add(make_pod("probe", cpu_milli=100))
     res = s.schedule_cycle()
-    assert res.solver_tier == "batch" and res.scheduled == 1
+    # the 60s jump also expired p0-p2's unconfirmed assumptions (no
+    # watch feed here): the recovery PR now REQUEUES expired pods
+    # instead of silently dropping them, so this probe cycle re-binds
+    # all three alongside the probe pod
+    assert res.solver_tier == "batch" and res.scheduled == 4
+    assert "default/probe" in res.assignments
+    assert s.metrics.cache_expired_assumptions.value() == 3
     assert br.state == CLOSED
     assert s.metrics.breaker_state.value(target="solver:batch") == 0
     assert any(r == REASON_RECOVERED for r, _, _ in events)
