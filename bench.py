@@ -422,14 +422,25 @@ def node_resources_score(alloc, requested, assigned):
 
 
 class ShardedWorkload:
-    """Wraps a Workload for mesh execution: nodes sharded along the node
-    axis, pods/selectors replicated (parallel/mesh.py design; BASELINE
-    config 5). run_batched works unchanged — GSPMD splits the (P x N)
-    kernels along the sharded axis and inserts the collectives."""
+    """Wraps a Workload for mesh execution on the FIRST-CLASS backend
+    placement path: the mesh resolves through ``parallel.mesh_from_spec``
+    (the same resolver the scheduler's ``parallel:`` config block uses)
+    and the tables place exactly as the sharded resident snapshot does —
+    nodes sharded along the node axis, pods/selectors/topology
+    replicated. run_batched works unchanged: GSPMD splits the (P x N)
+    kernels along the sharded axis and inserts the collectives. This
+    used to be a bench-only fork of the placement rules; since the mesh
+    PR it is a thin veneer over ``kubernetes_tpu.parallel``."""
 
-    def __init__(self, w, mesh):
-        from kubernetes_tpu.parallel import replicate, shard_nodes
+    def __init__(self, w, mesh="auto"):
+        from kubernetes_tpu.parallel import (
+            mesh_from_spec,
+            replicate,
+            shard_nodes,
+        )
 
+        if not hasattr(mesh, "devices"):  # "auto" | N | an actual Mesh
+            mesh = mesh_from_spec(mesh)
         self._w = w
         self._mesh = mesh
         self._replicate = replicate

@@ -171,6 +171,16 @@ class WarmupConfigurationV1alpha1:
 
 
 @dataclass
+class ParallelConfigurationV1alpha1:
+    """Versioned spelling of the sharded-execution block
+    (config.ParallelConfig): ``mesh`` is ``"off"`` | ``"auto"`` | an
+    integer device count, same vocabulary as the internal type (no
+    duration fields to re-spell)."""
+
+    mesh: Optional[object] = None  # "off" | "auto" | int
+
+
+@dataclass
 class ServingConfigurationV1alpha1:
     """Versioned spelling of the streaming-serving block
     (config.ServingConfig): camelCase, windows as metav1.Duration
@@ -227,6 +237,8 @@ class KubeSchedulerConfigurationV1alpha1:
         default_factory=ObservabilityConfigurationV1alpha1)
     serving: "ServingConfigurationV1alpha1" = field(
         default_factory=ServingConfigurationV1alpha1)
+    parallel: "ParallelConfigurationV1alpha1" = field(
+        default_factory=ParallelConfigurationV1alpha1)
 
 
 # -- defaulting (v1alpha1/defaults.go:42) -----------------------------------
@@ -366,6 +378,9 @@ def set_defaults_kube_scheduler_configuration(
         sv.retryAfter = "1s"
     if sv.watchBuffer is None:
         sv.watchBuffer = 4096
+    pl = obj.parallel
+    if pl.mesh is None:
+        pl.mesh = "off"
     return obj
 
 
@@ -474,7 +489,22 @@ def _to_internal(v: KubeSchedulerConfigurationV1alpha1) -> KubeSchedulerConfigur
         recovery=_recovery_to_internal(v.recovery),
         observability=_observability_to_internal(v.observability),
         serving=_serving_to_internal(v.serving),
+        parallel=_parallel_to_internal(v.parallel),
     )
+
+
+def _parallel_to_internal(pl: ParallelConfigurationV1alpha1):
+    from kubernetes_tpu.config import ParallelConfig
+
+    mesh = pl.mesh
+    ok = mesh in ("off", "auto") or (
+        isinstance(mesh, int) and not isinstance(mesh, bool) and mesh >= 1)
+    if not ok:
+        raise SchemeError([
+            f"parallel.mesh: invalid value {mesh!r}: expected 'off', "
+            "'auto', or a positive device count"
+        ])
+    return ParallelConfig(mesh=mesh)
 
 
 def _recovery_to_internal(rv: RecoveryConfigurationV1alpha1):
@@ -663,6 +693,7 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
             retryAfter=format_duration(c.serving.retry_after_s),
             watchBuffer=c.serving.watch_buffer,
         ),
+        parallel=ParallelConfigurationV1alpha1(mesh=c.parallel.mesh),
     )
 
 

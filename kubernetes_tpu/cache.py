@@ -105,6 +105,14 @@ class SchedulerCache:
         #: (device_lost / device_oom) raise from device_snapshot(),
         #: exercising the scheduler's resident-rebuild recovery
         self.fault_injector = None
+        #: jax.sharding.Mesh (or None): the sharded execution backend's
+        #: node-axis mesh (set_mesh). When set, the resident DeviceNodes
+        #: lives SHARDED along N across the mesh: full uploads place via
+        #: parallel.shard_nodes, and the delta scatter patches each
+        #: shard locally (the re-packed rows + indices replicate; the
+        #: donated scatter keeps the resident sharding, so no cross-
+        #: device traffic beyond the small replicated delta)
+        self.mesh = None
 
     # -- introspection -----------------------------------------------------
 
@@ -405,6 +413,11 @@ class SchedulerCache:
             self.fault_injector.device_hook("snapshot:device")
         table, _mode, _idx, _sub = self._refresh_host()
         n_pad = bucket_size(max(table.n, 1))
+        if self.mesh is not None:
+            # the node bucket must divide across the mesh: both are
+            # powers of two, so padding up to the device count suffices
+            # (a 2-node cluster on an 8-device mesh rides 8 rows)
+            n_pad = max(n_pad, int(self.mesh.devices.size))
         self.last_upload_rows = 0
         self.last_upload_nbytes = 0
         pending_rows = sum(len(i) for i, _ in self._pending_dev)
@@ -416,7 +429,19 @@ class SchedulerCache:
             # full table already carries is idempotent; dropping a delta
             # queued mid-upload would not be
             self._pending_dev.clear()
-            self._dev = nodes_to_device(table, pad_to=n_pad)
+            if self.mesh is not None:
+                # full rebuilds, interner-growth repacks, and post-
+                # device-loss rebuilds all re-place onto the mesh here —
+                # one seam (parallel.place_node_table, shared with the
+                # non-resident scheduler paths), so no recovery path can
+                # resurrect a single-device resident table under a
+                # mesh-on scheduler
+                from kubernetes_tpu.parallel.mesh import place_node_table
+
+                self._dev = place_node_table(table, self.mesh,
+                                             pad_to=n_pad)
+            else:
+                self._dev = nodes_to_device(table, pad_to=n_pad)
             self._dev_pad = n_pad
             self._dev_stale = False
             self.last_snapshot_mode = "full"
@@ -438,11 +463,28 @@ class SchedulerCache:
                 sub_dev = nodes_to_device(sub, pad_to=d_pad)
                 pidx = np.full((d_pad,), n_pad, np.int32)
                 pidx[: len(idx)] = idx
+                if self.mesh is not None:
+                    # replicate the delta rows so each shard applies its
+                    # own slice locally (the donated scatter preserves
+                    # the resident node-axis sharding; rows landing on
+                    # other shards drop out of this shard's window)
+                    from kubernetes_tpu.parallel.mesh import replicate
+
+                    sub_dev = replicate(sub_dev, self.mesh)
                 self._dev = scatter_node_rows(self._dev, sub_dev, pidx)
                 self.last_upload_rows += len(idx)
                 self.last_upload_nbytes += tree_nbytes(sub_dev)
             self.last_snapshot_mode = "delta"
         return table, self._dev, self.last_snapshot_mode
+
+    def set_mesh(self, mesh) -> None:
+        """Attach (or detach, with ``None``) the node-axis device mesh.
+        Changing the mesh invalidates the resident table: its buffers
+        live on the old device set, and the next device_snapshot()
+        re-places in full onto the new one."""
+        if mesh is not self.mesh:
+            self.mesh = mesh
+            self.drop_device_snapshot()
 
     def drop_device_snapshot(self) -> None:
         """Release the resident device table (tests / memory pressure);

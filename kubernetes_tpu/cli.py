@@ -33,6 +33,7 @@ from kubernetes_tpu.config import (
     KubeSchedulerConfiguration,
     LeaderElectionConfig,
     ObservabilityConfig,
+    ParallelConfig,
     RecoveryConfig,
     RobustnessConfig,
     ServingConfig,
@@ -188,6 +189,23 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         errs.append("serving.retryAfter: must be greater than zero")
     if sc.watch_buffer < 1:
         errs.append("serving.watchBuffer: must be at least 1")
+    pl = cfg.parallel
+    mesh = pl.mesh
+    if isinstance(mesh, bool) or not (
+            mesh in ("off", "auto")
+            or (isinstance(mesh, int) and mesh >= 1)):
+        errs.append(
+            f"parallel.mesh: Unsupported value {mesh!r}: supported "
+            "values: 'off', 'auto', or a positive device count")
+    elif isinstance(mesh, int) and mesh & (mesh - 1):
+        # the node axis pads to power-of-two buckets and a divisor of a
+        # power of two is a power of two — any other count can never
+        # divide a bucket and would fail as an opaque XLA shape error
+        # mid-solve (make_mesh's runtime fallback covers odd DISCOVERED
+        # device sets; a declared count is rejected up front)
+        errs.append(
+            f"parallel.mesh: Invalid value {mesh}: a device count must "
+            "divide the power-of-two node buckets — use a power of two")
     # unknown feature gates are rejected earlier, at FeatureGates
     # construction (featuregate.Set errors on unknown names)
     return errs
@@ -200,6 +218,7 @@ _REC_FIELDS = {f.name for f in dataclasses.fields(RecoveryConfig)}
 _OBS_FIELDS = {f.name for f in dataclasses.fields(ObservabilityConfig)}
 _WARMUP_FIELDS = {f.name for f in dataclasses.fields(WarmupConfig)}
 _SERVING_FIELDS = {f.name for f in dataclasses.fields(ServingConfig)}
+_PAR_FIELDS = {f.name for f in dataclasses.fields(ParallelConfig)}
 
 
 def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
@@ -305,6 +324,15 @@ def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
                 errs.append(f"serving: unknown field(s) {sorted(unknown)}")
                 continue
             kw["serving"] = ServingConfig(**val)
+        elif key == "parallel":
+            if not isinstance(val, dict):
+                errs.append("parallel: expected a mapping")
+                continue
+            unknown = set(val) - _PAR_FIELDS
+            if unknown:
+                errs.append(f"parallel: unknown field(s) {sorted(unknown)}")
+                continue
+            kw["parallel"] = ParallelConfig(**val)
         elif key == "policy":
             kw["policy"] = load_policy(val)
         elif key in _CONFIG_FIELDS:
@@ -369,6 +397,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sub-batch size of the pipelined executor")
     p.add_argument("--warmup", default=None, choices=("true", "false"),
                    help="AOT-compile the bucketed solve shapes at startup")
+    p.add_argument("--mesh", default=None,
+                   help="sharded execution backend: off | auto | N "
+                        "(1-D device mesh over the node axis)")
     p.add_argument("--percentage-of-nodes-to-score", type=int, default=None)
     p.add_argument("--leader-elect", default=None, choices=("true", "false"))
     p.add_argument("--lock-file", default=None,
@@ -417,6 +448,14 @@ def resolve_config(args) -> KubeSchedulerConfiguration:
     if args.warmup is not None:
         overlay["warmup"] = dataclasses.replace(
             cfg.warmup, enabled=args.warmup == "true")
+    if getattr(args, "mesh", None) is not None:
+        spec = args.mesh
+        if spec not in ("off", "auto"):
+            try:
+                spec = int(spec)
+            except ValueError:
+                pass  # validate_config rejects with the field path
+        overlay["parallel"] = dataclasses.replace(cfg.parallel, mesh=spec)
     serving_overlay = {}
     if getattr(args, "serving", None) is not None:
         serving_overlay["enabled"] = args.serving == "true"

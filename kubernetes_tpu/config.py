@@ -243,6 +243,24 @@ class WarmupConfig:
 
 
 @dataclass
+class ParallelConfig:
+    """Sharded execution backend (kubernetes_tpu/parallel): shard the
+    node axis of the device-resident snapshot — and with it the (P, N)
+    plane of every solve/validate/explain kernel — across a 1-D
+    ``jax.sharding.Mesh``. Pods and selector tables replicate; GSPMD
+    inserts the cross-device collectives (per-pod vectors only — no
+    (P, N) matrix ever crosses ICI, see parallel/costmodel.py)."""
+
+    #: ``"off"`` = single-device (today's behavior); ``"auto"`` = a mesh
+    #: over every local device; an integer N = a mesh over the first N
+    #: devices. N must be a power of two (validate_config rejects other
+    #: counts — they cannot divide the power-of-two node buckets);
+    #: ``make_mesh`` additionally falls back to the largest power-of-two
+    #: subset when handed an odd device set at runtime.
+    mesh: object = "off"  # "off" | "auto" | int
+
+
+@dataclass
 class ServingConfig:
     """Streaming serving mode (kubernetes_tpu/serving): the event-driven
     micro-batch loop that replaces the fixed ``--cycle-interval`` sleep,
@@ -345,6 +363,8 @@ class KubeSchedulerConfiguration:
     #: streaming serving mode (event-driven micro-batch loop + APF-style
     #: load shedding)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    #: sharded execution backend (node-axis device mesh)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
 
 
 # ---------------------------------------------------------------------------

@@ -166,6 +166,7 @@ RULE_SUMMARIES = {
     "R5": "dtype drift: float64 in device-math modules",
     "R6": "syntax gate: Py3.10 f-string backslash / parse errors",
     "R7": "d2h readback outside a declared obs.jax.readback boundary",
+    "R8": "sharded-value gather in a mesh-aware (parallel-importing) module",
 }
 
 #: modules whose arrays must stay float32 (R5): the device-math layer
@@ -1007,6 +1008,103 @@ def rule_r7_undeclared_readback(project: Project) -> List[Finding]:
                 "obs.jax.readback so transfer accounting (and the "
                 "readback-budget gate) sees them",
             ))
+    return findings
+
+
+# ==========================================================================
+# R8 — sharded-value gather in mesh-aware modules
+# ==========================================================================
+
+#: gather-ish method calls on a (potentially sharded) device value: the
+#: per-element syncs plus the per-shard buffer access that implies the
+#: caller is about to assemble the full array on host
+_R8_GATHER_METHODS = _SYNC_METHODS | {"addressable_data"}
+
+#: argument forms np.asarray may legitimately take in mesh-aware modules
+#: without touching a device buffer (host literals + comprehensions)
+_R8_HOST_ONLY = _R7_HOST_LITERALS
+
+_R8_SCOPE_PREFIX = "kubernetes_tpu.parallel"
+
+
+def _imports_parallel(fi: FileInfo) -> bool:
+    """Does this module import the mesh layer (any form, any level)?
+    ``fi.imports`` alone is not enough: the engine maps a bare
+    ``import a.b.c`` to its top-level name only, so the scope check
+    walks the AST for Import/ImportFrom nodes too."""
+    if any(v == _R8_SCOPE_PREFIX or v.startswith(_R8_SCOPE_PREFIX + ".")
+           for v in fi.imports.values()):
+        return True
+    for node in ast.walk(fi.tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == _R8_SCOPE_PREFIX
+                   or a.name.startswith(_R8_SCOPE_PREFIX + ".")
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if (node.module == _R8_SCOPE_PREFIX
+                    or node.module.startswith(_R8_SCOPE_PREFIX + ".")):
+                return True
+    return False
+
+
+@register_rule("R8")
+def rule_r8_mesh_gather(project: Project) -> List[Finding]:
+    """``jax.device_get``/``np.asarray``/per-element sync on a potential
+    device value inside a PRODUCTION module that imports
+    ``kubernetes_tpu.parallel`` — i.e. a module whose values may be
+    node-axis-sharded or (P, N)-shaped across the mesh. There, an
+    undeclared materialization is not just an unaccounted d2h transfer
+    (R7's concern): GSPMD inserts an ALL-GATHER to assemble the full
+    array first, so one stray ``np.asarray`` silently moves a
+    (P, N)-sized matrix across ICI and then over PCIe — the exact
+    transfer the collective cost model (parallel/costmodel.py) claims
+    never happens. This rule turns that falsifiable claim into a
+    parse-time gate: every d2h in a mesh-aware module must ride the
+    declared ``obs.jax.readback`` boundary (which gathers ONCE, with
+    byte accounting) or carry a justified suppression. Scope mirrors
+    R7 (tests/scripts/boundary modules exempt; host literals exempt);
+    baseline-aware and tier-1-enforced like R0-R7."""
+    findings: List[Finding] = []
+    for fi in project.files:
+        if fi.tree is None:
+            continue
+        rel = fi.relpath.replace("\\", "/")
+        if any(rel.endswith(m) for m in _R7_BOUNDARY_MODULES):
+            continue
+        if rel.split("/", 1)[0] in ("tests", "tests_tpu", "scripts"):
+            # parity oracles and offline harnesses gather by design;
+            # the gate guards the production cycle
+            continue
+        if "/parallel/" in "/" + rel:
+            # the placement layer itself (device_put, never a gather)
+            continue
+        if not _imports_parallel(fi):
+            continue
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            full = resolve_dotted(dotted_name(node.func), fi.imports)
+            if full in _SYNC_CALLS:
+                if node.args and isinstance(node.args[0], _R8_HOST_ONLY):
+                    continue
+                findings.append(fi.finding(
+                    node, "R8",
+                    f"`{full}` materializes a (potentially node-axis-"
+                    "sharded) value on host in a mesh-aware module — "
+                    "GSPMD all-gathers the full array first; route the "
+                    "readback through obs.jax.readback so the gather is "
+                    "deliberate, single, and byte-accounted",
+                ))
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _R8_GATHER_METHODS):
+                findings.append(fi.finding(
+                    node, "R8",
+                    f"`.{node.func.attr}()` on a (potentially sharded) "
+                    "device value in a mesh-aware module — a per-shard/"
+                    "per-element gather outside the declared "
+                    "obs.jax.readback boundary",
+                ))
     return findings
 
 

@@ -450,6 +450,12 @@ class SchedulerMetrics:
             "Bucketed solve shapes compiled ahead of time by the warmup "
             "pass (cli --warmup / Scheduler.warmup).",
         ))
+        # -- sharded execution backend (kubernetes_tpu/parallel) --------
+        self.mesh_devices = r.register(Gauge(
+            "scheduler_mesh_devices",
+            "Devices in the node-axis mesh of the sharded execution "
+            "backend (parallel.mesh config; 0 = single-device mode).",
+        ))
         # -- schedulability explainer (obs/explain.py): the batched
         # why-pending reduction over the (pod x node) failure bitmask ---
         self.unschedulable_pods = r.register(Counter(

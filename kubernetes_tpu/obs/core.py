@@ -71,6 +71,11 @@ class Observability:
         #: parks here until the next begin_cycle stamps it onto that
         #: cycle's record (value = elector epoch, or 1 when unknown)
         self._pending_takeover = 0
+        #: sharded-backend provenance: device count of the scheduler's
+        #: node-axis mesh (0 = single-device). Set once at construction
+        #: (note_mesh); stamped on every cycle's flight record so a
+        #: postmortem knows which records ran sharded.
+        self.mesh_devices = 0
 
     # -- cycle lifecycle ----------------------------------------------------
 
@@ -167,6 +172,18 @@ class Observability:
             self._scratch["fenced_binds"] = (
                 self._scratch.get("fenced_binds", 0) + 1)
 
+    def note_mesh(self, devices: int) -> None:
+        """The sharded execution backend's mesh size (``mesh=N`` flag on
+        every flight record; 0 = single-device)."""
+        self.mesh_devices = int(devices)
+
+    def note_mesh_cycle(self, devices: int) -> None:
+        """What THIS cycle actually ran on: 0 during the device-loss
+        cooloff's single-device host-mode fallback even when the
+        scheduler owns a mesh — so the flight record's ``mesh=`` flag
+        stays truthful per cycle, not per construction."""
+        self._scratch["mesh"] = int(devices)
+
     def note_sinkhorn(self, stats) -> None:
         """Stash the solver's (iters, residual) device pair; read back
         once at end_cycle (the cycle's host boundary)."""
@@ -254,6 +271,7 @@ class Observability:
             takeover=s.get("takeover", 0),
             device_resets=s.get("device_resets", 0),
             fenced_binds=s.get("fenced_binds", 0),
+            mesh=s.get("mesh", self.mesh_devices),
         )
         self.recorder.record(rec)
         self._eventful_seq += 1

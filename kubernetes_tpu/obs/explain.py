@@ -108,14 +108,29 @@ def explain_reduce(reasons, node_valid, pod_mask, req=None, free=None,
     from kubernetes_tpu.ops.predicates import BIT
 
     vmask = pod_mask[:, None] & node_valid[None, :]  # (P, N)
+    P = reasons.shape[0]
     per_pod_cols = []
     one_bit_cols = []
+    # OR over valid nodes, assembled bit-by-bit from boolean
+    # any-reductions: sum_b (1 << b) * any(bit b fired) is exactly the
+    # bitwise OR (each term owns its bit). The direct int32
+    # lax.bitwise_or reduce this replaces is NOT a collective XLA:CPU
+    # can lower when the node axis is mesh-sharded (s32 `or`
+    # all-reduce: "Unsupported reduction computation"); boolean any()
+    # is — and the per-bit `fired` planes are computed for the counts
+    # below anyway. Independent of pod_mask so the value matches the
+    # legacy host reduction for every failed row.
+    pod_bits = jnp.zeros((P,), jnp.int32)
     for b in range(N_REASONS):
         fired = ((reasons >> b) & 1) > 0
         per_pod_cols.append(
             jnp.sum(fired & vmask, axis=1, dtype=jnp.int32))
         only = (reasons == jnp.int32(1 << b)) & vmask
         one_bit_cols.append(jnp.sum(only, axis=1, dtype=jnp.int32))
+        pod_bits = pod_bits + (
+            jnp.int32(1 << b)
+            * jnp.any(fired & node_valid[None, :], axis=1
+                      ).astype(jnp.int32))
     per_pod = jnp.stack(per_pod_cols, axis=1)  # (P, B)
     one_bit = jnp.stack(one_bit_cols, axis=1)  # (P, B)
     best_bit = jnp.argmax(one_bit, axis=1).astype(jnp.int32)
@@ -123,12 +138,6 @@ def explain_reduce(reasons, node_valid, pod_mask, req=None, free=None,
     feasible = jnp.sum((reasons == 0) & vmask, axis=1, dtype=jnp.int32)
     pair_hist = jnp.sum(per_pod, axis=0, dtype=jnp.int32)
     pods_blocked = jnp.sum(per_pod > 0, axis=0, dtype=jnp.int32)
-    P = reasons.shape[0]
-    # OR over valid nodes — independent of pod_mask so the value matches
-    # the legacy host reduction for every failed row
-    pod_bits = jax.lax.reduce(
-        jnp.where(node_valid[None, :], reasons, 0),
-        jnp.int32(0), jax.lax.bitwise_or, dimensions=(1,))
     if req is not None:
         res_fired = (((reasons >> BIT["PodFitsResources"]) & 1) > 0) \
             & node_valid[None, :]

@@ -75,6 +75,9 @@ class CycleRecord:
     device_resets: int = 0
     #: binds aborted by the lease fence this cycle (deposed leader)
     fenced_binds: int = 0
+    #: sharded-backend provenance: node-axis mesh device count the
+    #: scheduler ran this cycle under (0 = single-device mode)
+    mesh: int = 0
 
     def to_json(self) -> dict:
         return {
@@ -111,6 +114,7 @@ class CycleRecord:
                if self.device_resets else {}),
             **({"fenced_binds": self.fenced_binds}
                if self.fenced_binds else {}),
+            **({"mesh": self.mesh} if self.mesh else {}),
         }
 
 

@@ -1,7 +1,12 @@
 from kubernetes_tpu.parallel.mesh import (  # noqa: F401
     NODE_AXIS,
+    largest_pow2,
     make_mesh,
+    mesh_from_spec,
+    mesh_size,
+    place_node_table,
     replicate,
     shard_cluster,
     shard_nodes,
+    shard_usage,
 )

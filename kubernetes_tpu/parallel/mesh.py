@@ -21,21 +21,85 @@ the mesh over DCN via ``jax.distributed`` initialization.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Union
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubernetes_tpu.ops.arrays import DeviceNodes, DevicePods, DeviceSelectors
+from kubernetes_tpu.utils import klog
 
 NODE_AXIS = "nodes"
 
 
+def largest_pow2(n: int) -> int:
+    """Largest power of two <= n (n >= 1)."""
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """1-D mesh over all (or given) devices; the single axis shards nodes."""
+    """1-D mesh over all (or given) devices; the single axis shards nodes.
+
+    The node axis is padded to power-of-two buckets
+    (utils/interner.bucket_size), and a divisor of a power of two must
+    itself be a power of two — so a 3- or 6-device slice can never
+    divide ANY bucket and would die mid-solve with an opaque XLA shape
+    error. Validated here instead: a non-power-of-two device count falls
+    back to the largest dividing power-of-two subset with a logged
+    warning (config-declared counts are additionally rejected up front
+    by cli.validate_config)."""
     devices = list(devices) if devices is not None else jax.devices()
+    if not devices:
+        raise ValueError("make_mesh: no devices")
+    keep = largest_pow2(len(devices))
+    if keep != len(devices):
+        klog.warning(
+            "mesh: %d devices cannot divide the power-of-two node "
+            "buckets; using the first %d (a power-of-two subset)",
+            len(devices), keep)
+        devices = devices[:keep]
     return Mesh(np.asarray(devices), (NODE_AXIS,))  # graftlint: disable=R7 -- device HANDLES (host objects), not buffers
+
+
+def mesh_from_spec(
+    spec: Union[str, int, None],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Optional[Mesh]:
+    """Resolve the ``parallel.mesh`` config spec into a Mesh (or None).
+
+    - ``"off"`` / ``None`` / ``0`` → None (single-device mode; never
+      touches the backend, so mesh-off schedulers stay constructible
+      before any device initializes);
+    - ``"auto"`` → a mesh over every local device (power-of-two
+      fallback as in :func:`make_mesh`);
+    - an int ``N`` → a mesh over the first N local devices; more than
+      available clamps with a warning, non-power-of-two falls back.
+
+    This is THE resolver: the scheduler backend, the bench harness, and
+    the weak-scaling script all build their meshes here, so "sharded"
+    means the same placement everywhere."""
+    if spec is None or spec == "off" or spec == 0 or spec is False:
+        return None
+    if spec == "auto":
+        return make_mesh(devices)
+    n = int(spec)
+    if n < 1:
+        raise ValueError(f"parallel.mesh: invalid device count {spec!r}")
+    avail = list(devices) if devices is not None else jax.devices()
+    if n > len(avail):
+        klog.warning("mesh: %d devices requested, %d available; using %d",
+                     n, len(avail), len(avail))
+        n = len(avail)
+    return make_mesh(avail[:n])
+
+
+def mesh_size(mesh: Optional[Mesh]) -> int:
+    """Device count of a mesh; 0 for None (the single-device mode)."""
+    return int(mesh.devices.size) if mesh is not None else 0
 
 
 def shard_nodes(nodes: DeviceNodes, mesh: Mesh) -> DeviceNodes:
@@ -43,6 +107,14 @@ def shard_nodes(nodes: DeviceNodes, mesh: Mesh) -> DeviceNodes:
     (zone_valid) replicated. Node buckets are powers of two, so any
     power-of-two device count divides them."""
     n = nodes.allocatable.shape[0]
+    d = int(mesh.devices.size)
+    if n % max(d, 1):
+        # a clear error instead of the opaque XLA one: callers pad the
+        # node bucket up to the mesh size (both are powers of two, so
+        # max(bucket, devices) always divides)
+        raise ValueError(
+            f"shard_nodes: node axis {n} not divisible by {d} mesh "
+            f"devices — pad the node bucket to at least {d} rows")
     sharded = NamedSharding(mesh, P(NODE_AXIS))
     replicated = NamedSharding(mesh, P())
 
@@ -53,6 +125,35 @@ def shard_nodes(nodes: DeviceNodes, mesh: Mesh) -> DeviceNodes:
         return jax.device_put(a, spec)
 
     return DeviceNodes(*[place(f) for f in nodes])
+
+
+def place_node_table(table, mesh: Mesh, pad_to: Optional[int] = None):
+    """Host ``NodeTable`` -> mesh-sharded ``DeviceNodes`` in one call:
+    pad the node bucket up to the mesh size (both are powers of two, so
+    the shard split is always legal), upload, shard along N. The ONE
+    placement seam for every non-resident path — the cache's full
+    rebuild, the legacy per-cycle host pack, and warmup all route here,
+    so a future padding-rule change cannot miss a site and resurrect
+    the opaque XLA shape error :func:`shard_nodes` guards against."""
+    from kubernetes_tpu.ops.arrays import nodes_to_device
+    from kubernetes_tpu.utils.interner import bucket_size
+
+    n_pad = pad_to or bucket_size(max(table.n, 1))
+    n_pad = max(n_pad, int(mesh.devices.size))
+    return shard_nodes(nodes_to_device(table, pad_to=n_pad), mesh)
+
+
+def shard_usage(u, mesh: Mesh):
+    """Shard a node-axis usage pytree (ops/assign.UsageState — every
+    leaf is (N, ...) row-shaped) along the mesh, matching the resident
+    DeviceNodes placement. The re-pinning ladder tiers (batch-single /
+    batch-cpu) route their usage back through this before the cycle's
+    failure-reason pass recombines it with the sharded node table."""
+    def place(a):
+        spec = NamedSharding(mesh, P(NODE_AXIS, *([None] * (a.ndim - 1))))
+        return jax.device_put(a, spec)
+
+    return type(u)(*[place(f) for f in u])
 
 
 def replicate(tree, mesh: Mesh):

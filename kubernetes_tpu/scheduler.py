@@ -188,6 +188,7 @@ class Scheduler:
         device_resident_snapshot: bool = True,
         snapshot_max_dirty_frac: Optional[float] = None,
         warmup=None,
+        parallel=None,
     ) -> None:
         from kubernetes_tpu.config import (
             ObservabilityConfig,
@@ -304,9 +305,32 @@ class Scheduler:
         if snapshot_max_dirty_frac is not None:
             self.cache.max_dirty_frac = snapshot_max_dirty_frac
         #: AOT warmup config (config.WarmupConfig or None)
-        from kubernetes_tpu.config import WarmupConfig
+        from kubernetes_tpu.config import ParallelConfig, WarmupConfig
 
         self.warmup_config = warmup if warmup is not None else WarmupConfig()
+        #: sharded execution backend (config.ParallelConfig): when the
+        #: mesh is on, the node axis of the resident snapshot — and with
+        #: it the (P, N) plane of every solve/validate/explain kernel —
+        #: shards across a 1-D device mesh built HERE, at construction;
+        #: pods/selector/topology/volume tables replicate (_place) and
+        #: GSPMD inserts the collectives (parallel/mesh.py design). Off
+        #: ("off", the default) never touches the backend.
+        self.parallel = parallel if parallel is not None else ParallelConfig()
+        from kubernetes_tpu.parallel.mesh import mesh_from_spec, mesh_size
+
+        self.mesh = mesh_from_spec(self.parallel.mesh)
+        set_mesh = getattr(self.cache, "set_mesh", None)
+        if set_mesh is not None:  # duck-typed: cache fakes stay valid
+            set_mesh(self.mesh)
+        mesh_gauge = getattr(self.metrics, "mesh_devices", None)
+        if mesh_gauge is not None:  # duck-typed: metrics fakes stay valid
+            mesh_gauge.set(mesh_size(self.mesh))
+        self.obs.note_mesh(mesh_size(self.mesh))
+        #: whether THIS cycle's device tables live on the mesh (False
+        #: during the device-loss cooloff, when snapshots fall back to
+        #: single-device host mode — a lost shard must not keep pulling
+        #: the whole mesh into every upload)
+        self._mesh_live = False
         # explicit None check: SchedulingQueue defines __len__, so a
         # caller-provided EMPTY queue is falsy and `queue or ...` would
         # silently replace it with a fresh one
@@ -407,6 +431,7 @@ class Scheduler:
         kw.setdefault("device_resident_snapshot", cfg.device_resident_snapshot)
         kw.setdefault("snapshot_max_dirty_frac", cfg.snapshot_max_dirty_frac)
         kw.setdefault("warmup", cfg.warmup)
+        kw.setdefault("parallel", cfg.parallel)
         if getattr(cfg, "plugins", ()) and "framework" not in kw:
             # config-driven framework assembly (the NewFramework path,
             # framework.go:88: registry factories + per-plugin args from
@@ -858,6 +883,19 @@ class Scheduler:
                         self.recovery.device_cooloff_s)
                     return self.cache.snapshot(), None, "host"
 
+    def _place(self, t):
+        """Replicate a device pytree across the node-axis mesh —
+        identity when the sharded backend is off OR this cycle fell
+        back to single-device host-mode snapshots (device cooloff).
+        The pod/selector/topology/volume tables all ride this: the
+        (P, N) kernels then see replicated-P x sharded-N operands and
+        GSPMD partitions them along N."""
+        if t is None or not self._mesh_live:
+            return t
+        from kubernetes_tpu.parallel.mesh import replicate
+
+        return replicate(t, self.mesh)
+
     # -- the cycle ---------------------------------------------------------
 
     def schedule_cycle(self, flush_trigger: str = "",
@@ -965,19 +1003,36 @@ class Scheduler:
             # ops/priorities.empty_priorities,
             # ops/predicates.pods_have_no_ports)
             skip_prio, no_ports, no_pod_aff, no_spread = solver_gates(nt, pt)
+            # mesh liveness for THIS cycle: resident snapshots come back
+            # already sharded (cache.set_mesh); the legacy per-cycle
+            # host pack re-places onto the mesh below; only the device-
+            # loss cooloff (resident on, dev None) stays single-device —
+            # a lost shard must not be re-engaged until the heal probe
+            self._mesh_live = (self.mesh is not None
+                               and (dn is not None
+                                    or not self.device_resident_snapshot))
+            self.obs.note_mesh_cycle(
+                int(self.mesh.devices.size) if self._mesh_live else 0)
             if dn is None:
-                dn = nodes_to_device(nt)
+                if self._mesh_live:
+                    from kubernetes_tpu.parallel.mesh import (
+                        place_node_table,
+                    )
+
+                    dn = place_node_table(nt, self.mesh)
+                else:
+                    dn = nodes_to_device(nt)
             use_pipeline = self._pipeline_eligible(batch, nominated)
-            dp = (None if use_pipeline else
-                  pods_to_device(pt, pad_to=bucket_size(max(len(batch), 1))))
-            ds = selectors_to_device(pk.pack_selector_tables())
-            dt = (topology_to_device(pk.pack_topology_tables())
-                  if _has_topo(pk.u) else None)
+            dp = (None if use_pipeline else self._place(
+                  pods_to_device(pt, pad_to=bucket_size(max(len(batch), 1)))))
+            ds = self._place(selectors_to_device(pk.pack_selector_tables()))
+            dt = self._place(topology_to_device(pk.pack_topology_tables())
+                             if _has_topo(pk.u) else None)
             dv = sv = None
             if dp is not None and any(p.volumes for p in batch):
                 from kubernetes_tpu.ops.arrays import volumes_to_device
 
-                dv = volumes_to_device(pk.pack_volume_tables(batch))
+                dv = self._place(volumes_to_device(pk.pack_volume_tables(batch)))
                 sv = _static_vol_pass(dp, dn, ds, dv)
             trace.step(f"snapshot packed ({len(batch)} pods, {nt.n} nodes,"
                        f" {snap_mode})")
@@ -1008,6 +1063,8 @@ class Scheduler:
             + ("+topo" if dt is not None else "")
             + ("+vol" if dv is not None else "")
             + (f"+pipe{self.pipeline_chunk}" if use_pipeline else "")
+            + (f"+mesh{int(self.mesh.devices.size)}"
+               if self._mesh_live else "")
         )
 
         if use_pipeline:
@@ -1104,7 +1161,7 @@ class Scheduler:
         if nominated:
             row_of = {name: i for i, name in enumerate(node_order)}
             nom_pods = [p for p, _ in nominated]
-            dpn = pods_to_device(pk.pack_pods(nom_pods))
+            dpn = self._place(pods_to_device(pk.pack_pods(nom_pods)))
             nom_rows = np.zeros((dpn.valid.shape[0],), np.int32)
             nom_ok = np.zeros((dpn.valid.shape[0],), bool)
             for j, (_, node) in enumerate(nominated):
@@ -1168,7 +1225,12 @@ class Scheduler:
             static=(solver, tuple(skip_prio), no_ports, no_pod_aff,
                     no_spread, self.pred_mask, self.per_node_cap,
                     self.max_rounds, extra_mask is None,
-                    extra_score is None),
+                    extra_score is None,
+                    # mesh liveness joins the digest: sharding is part
+                    # of XLA's compile key but invisible to the shape/
+                    # dtype digest — a cooloff flip to single-device
+                    # would otherwise recompile unseen by the telemetry
+                    self._mesh_live),
         )
         ladder = self._solve_ladder(
             solver, batch, dp, dn, ds, dt, dv, sv, base_fr, extra_mask,
@@ -1516,7 +1578,11 @@ class Scheduler:
                   extra_mask, extra_score, skip_prio, no_ports, no_pod_aff,
                   no_spread):
         """One solve attempt on one ladder tier. Returns
-        (assigned, usage, rounds); exceptions propagate to the ladder."""
+        ``((assigned, usage, rounds), dp_used, dn_used)`` — the re-
+        pinning tiers (batch-single, batch-cpu) hand back the tables
+        they actually solved against, so the fused validator never
+        mixes a single-device result with mesh-sharded tables in one
+        jitted call. Exceptions propagate to the ladder."""
         from kubernetes_tpu.ops.assign import batch_assign, greedy_assign
 
         hook = (self.fault_injector.solver_hook
@@ -1530,35 +1596,46 @@ class Scheduler:
                 no_spread=no_spread, fault_hook=hook,
                 fault_site="solve:greedy",
             )
-            return a, u, len(batch)
+            return (a, u, len(batch)), dp, dn
         if tier == "exact":
             out = self._exact_solve(
                 dp, dn, ds, dt, base_fr, extra_mask, extra_score
             )
             if hook is not None:
                 out = hook("solve:exact", *out, dn.valid.shape[0])
-            return out
-        if tier == "batch-cpu":
-            # host-backend fallback: re-pin every input to the local CPU
-            # device so the identical solve re-runs off-accelerator (on a
-            # CPU-only install this is a clean re-execution — the seam a
-            # TPU deployment uses to survive a wedged chip)
-            cpu = jax.local_devices(backend="cpu")[0]
+            return out, dp, dn
+        if tier in ("batch-single", "batch-cpu"):
+            if tier == "batch-single":
+                # mesh-ladder rung: the identical solve re-pinned onto
+                # ONE device of the mesh — survives a sick collective /
+                # wedged shard without leaving the accelerator class
+                # (batch-cpu and greedy remain unchanged below it)
+                one = (self.mesh.devices.flat[0] if self.mesh is not None
+                       else jax.devices()[0])
+            else:
+                # host-backend fallback: re-pin every input to the local
+                # CPU device so the identical solve re-runs
+                # off-accelerator (on a CPU-only install this is a clean
+                # re-execution — the seam a TPU deployment uses to
+                # survive a wedged chip)
+                one = jax.local_devices(backend="cpu")[0]
 
             def put(t):
-                return jax.tree_util.tree_map(
-                    lambda x: jax.device_put(x, cpu), t)
+                return (None if t is None else jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, one), t))
 
-            return batch_assign(
-                put(dp), put(dn), put(ds), self.weights,
+            dp_p, dn_p = put(dp), put(dn)
+            out = batch_assign(
+                dp_p, dn_p, put(ds), self.weights,
                 max_rounds=self.max_rounds, per_node_cap=self.per_node_cap,
                 topo=put(dt), extra_mask=put(extra_mask), vol=put(dv),
                 static_vol=put(sv), enabled_mask=self.pred_mask,
                 extra_score=put(extra_score), use_sinkhorn=False,
                 skip_priorities=skip_prio, no_ports=no_ports,
                 no_pod_affinity=no_pod_aff, no_spread=no_spread,
-                fault_hook=hook, fault_site="solve:batch-cpu",
+                fault_hook=hook, fault_site=f"solve:{tier}",
             )
+            return out, dp_p, dn_p
         # sinkhorn convergence telemetry rides the solve as a (2,) device
         # pair (stays on device; obs reads it back once at cycle end)
         want_stats = self.obs.config.sinkhorn_telemetry
@@ -1575,8 +1652,8 @@ class Scheduler:
         if want_stats:
             assigned, usage, rounds, sk_stats = out
             self.obs.note_sinkhorn(sk_stats)
-            return assigned, usage, rounds
-        return out
+            return (assigned, usage, rounds), dp, dn
+        return out, dp, dn
 
     def _validated_readback(self, tier, out, dp, dn):
         """Validate one tier's result and read it back as ONE d2h
@@ -1653,6 +1730,12 @@ class Scheduler:
 
         rc = self.robustness
         tiers = [solver]
+        if self._mesh_live and solver in ("batch", "sinkhorn", "greedy"):
+            # the mesh-aware rung: a failing SHARDED solve retries on
+            # one device (same backend, inputs re-pinned off the mesh)
+            # before the ladder leaves the accelerator entirely —
+            # sharded -> single-device -> batch-cpu -> greedy
+            tiers.append("batch-single")
         for t in rc.fallback_chain:
             if t not in tiers:
                 tiers.append(t)
@@ -1693,15 +1776,18 @@ class Scheduler:
                 ts = self.clock()
                 with self.obs.span(f"solve:{tier}", attempt=attempt):
                     try:
-                        out = self._run_tier(
+                        out, dp_t, dn_t = self._run_tier(
                             tier, batch, dp, dn, ds, dt, dv, sv, base_fr,
                             extra_mask, extra_score, skip_prio, no_ports,
                             no_pod_aff, no_spread,
                         )
                         # fused validate + single readback (raises
                         # SolverResultInvalid on a lying solver, exactly
-                        # as the host checker did)
-                        result = self._validated_readback(tier, out, dp, dn)
+                        # as the host checker did) — against the tables
+                        # THIS tier solved on (a re-pinning tier's
+                        # result must not meet mesh-sharded tables)
+                        result = self._validated_readback(tier, out,
+                                                          dp_t, dn_t)
                     except Exception as e:
                         last_err = e
                     finally:
@@ -1717,7 +1803,16 @@ class Scheduler:
                 break
             if result is not None:
                 br.record_success()
-                return result[0], result[1], int(result[2]), tier
+                usage = result[1]
+                if self._mesh_live and tier in ("batch-single",
+                                                "batch-cpu"):
+                    # a re-pinned tier's usage lives on one device; the
+                    # cycle's failure-reason pass recombines it with the
+                    # SHARDED node table — re-place it onto the mesh
+                    from kubernetes_tpu.parallel.mesh import shard_usage
+
+                    usage = shard_usage(usage, self.mesh)
+                return result[0], usage, int(result[2]), tier
             br.record_failure()
             klog.warning("solver tier %s failed (%s); falling back",
                          tier, last_err)
@@ -1727,10 +1822,11 @@ class Scheduler:
             i += 1
         return None
 
-    # graftlint: disable-scope=R2,R7 -- host oracle by design: the exact tier
-    # runs the Hungarian solver on CPU, so the one filter+score result is
-    # read back wholesale here; the ladder only enters this tier when
-    # quality beats wall-clock (gang/offline packing)
+    # graftlint: disable-scope=R2,R7,R8 -- host oracle by design: the exact
+    # tier runs the Hungarian solver on CPU, so the one filter+score result
+    # is read back wholesale here (a deliberate full gather when the mesh is
+    # on); the ladder only enters this tier when quality beats wall-clock
+    # (gang/offline packing)
     def _exact_solve(self, dp, dn, ds, dt, base_fr, extra_mask, extra_score):
         """Exact one-shot assignment: device filter+score once, then the
         native Hungarian solver with per-node slot capacities
@@ -1884,7 +1980,8 @@ class Scheduler:
         solver = self.solver
         statics = (solver, tuple(skip_prio), no_ports, no_pod_aff,
                    no_spread, self.pred_mask, self.per_node_cap,
-                   self.max_rounds, True, True)  # no extra mask/score
+                   self.max_rounds, True, True,  # no extra mask/score
+                   self._mesh_live)
         hook = (self.fault_injector.solver_hook
                 if self.fault_injector is not None else None)
 
@@ -1899,11 +1996,12 @@ class Scheduler:
 
         def pack_chunk(k):
             with self.obs.span(f"pipeline:pack@{k}", pods=len(chunks[k])):
-                dp_c = pods_to_device(pk.pack_pods(chunks[k]),
-                                      pad_to=chunk_pad)
+                dp_c = self._place(pods_to_device(pk.pack_pods(chunks[k]),
+                                                  pad_to=chunk_pad))
                 dv_c = sv_c = None
                 if any(p.volumes for p in chunks[k]):
-                    dv_c = volumes_to_device(pk.pack_volume_tables(chunks[k]))
+                    dv_c = self._place(
+                        volumes_to_device(pk.pack_volume_tables(chunks[k])))
                     sv_c = _static_vol_pass(dp_c, dn, ds, dv_c)
                 # per-chunk h2d accounting: the pod tables are the
                 # steady-state cycle's largest upload
@@ -2509,19 +2607,35 @@ class Scheduler:
         sample = list(sample_pods)
         for p in sample:
             pk.intern_pod(p)
+        self._mesh_live = self.mesh is not None
         if self.cache.node_count():
             if self.device_resident_snapshot:
                 nt, dn, _ = self._device_snapshot_recovering()
                 if dn is None:  # device cooling off: warm on host tables
                     dn = nodes_to_device(nt)
+                    self._mesh_live = False
             else:
                 nt = self.cache.snapshot()
-                dn = nodes_to_device(nt)
+                if self._mesh_live:
+                    from kubernetes_tpu.parallel.mesh import (
+                        place_node_table,
+                    )
+
+                    dn = place_node_table(nt, self.mesh)
+                else:
+                    dn = nodes_to_device(nt)
         elif node_count:
             # no cluster yet: widths-complete zero-row table, padded to
-            # the caller's expected node bucket
+            # the caller's expected node bucket (and at least the mesh
+            # size, so the warmed shapes match the sharded cycle's)
             nt = pk.pack_nodes([])
-            dn = nodes_to_device(nt, pad_to=bucket_size(max(node_count, 1)))
+            pad = bucket_size(max(node_count, 1))
+            if self._mesh_live:
+                from kubernetes_tpu.parallel.mesh import place_node_table
+
+                dn = place_node_table(nt, self.mesh, pad_to=pad)
+            else:
+                dn = nodes_to_device(nt, pad_to=pad)
         else:
             # no cluster AND no expected size: warming now would compile
             # (and pre-register) shapes with an empty-cluster node bucket
@@ -2532,15 +2646,16 @@ class Scheduler:
                          "node_count given — call again after the first "
                          "node sync")
             return 0
-        ds = selectors_to_device(pk.pack_selector_tables())
-        dt = (topology_to_device(pk.pack_topology_tables())
-              if _has_topo(pk.u) else None)
+        ds = self._place(selectors_to_device(pk.pack_selector_tables()))
+        dt = self._place(topology_to_device(pk.pack_topology_tables())
+                         if _has_topo(pk.u) else None)
         pt_all = pk.pack_pods(sample)
         skip_prio, no_ports, no_pod_aff, no_spread = solver_gates(nt, pt_all)
         solver = self.solver if self.solver != "exact" else "batch"
         statics = (solver, tuple(skip_prio), no_ports, no_pod_aff,
                    no_spread, self.pred_mask, self.per_node_cap,
-                   self.max_rounds, True, True)  # no extra mask/score
+                   self.max_rounds, True, True,  # no extra mask/score
+                   self._mesh_live)
         buckets = tuple(wu.pod_buckets)
         if not buckets:
             # geometric x2 steps up to bucket_size(max_batch) — the
@@ -2600,7 +2715,7 @@ class Scheduler:
         )
 
         skip_prio, no_ports, no_pod_aff, no_spread = gates
-        dp = pods_to_device(pk.pack_pods(sample[:P]), pad_to=P)
+        dp = self._place(pods_to_device(pk.pack_pods(sample[:P]), pad_to=P))
         dv = sv = None
         if has_vol_sample:
             # a volume-bearing sample warms the volume-bearing solve
@@ -2609,7 +2724,8 @@ class Scheduler:
             # coverage is exact only when the sample is representative
             from kubernetes_tpu.ops.arrays import volumes_to_device
 
-            dv = volumes_to_device(pk.pack_volume_tables(sample[:P]))
+            dv = self._place(volumes_to_device(pk.pack_volume_tables(
+                sample[:P])))
             sv = _static_vol_pass(dp, dn, ds, dv)
         self.obs.jax.record_call("solve", dp, dn, ds, dt, dv,
                                  static=statics, warmup=True)

@@ -95,6 +95,19 @@ def find_churn_records(directory: str) -> List[str]:
                   key=round_key)
 
 
+def find_mesh_records(directory: str) -> List[str]:
+    """mesh_r*.json (scripts/bench_mesh_scale.py records) sorted by
+    round — the sharded-backend gate's inputs. Absence is tolerated:
+    benchres directories predating the mesh backend keep passing."""
+
+    def round_key(path: str) -> Tuple[int, str]:
+        m = re.search(r"mesh_r(\d+)", os.path.basename(path))
+        return (int(m.group(1)) if m else -1, os.path.basename(path))
+
+    return sorted(glob.glob(os.path.join(directory, "mesh_r*.json")),
+                  key=round_key)
+
+
 def load(path: str) -> dict:
     with open(path, "r", encoding="utf-8") as fh:
         return json.load(fh)
@@ -288,6 +301,89 @@ def compare_churn(prev: dict, cur: dict, threshold: float) -> dict:
             "warnings": warnings}
 
 
+def compare_mesh(prev: dict, cur: dict, threshold: float,
+                 readback_budget: float = 16.0) -> dict:
+    """Sharded-backend gates over two mesh_r*.json records (pure,
+    unit-tested). Three promises the mesh backend must keep:
+
+    - the 5000x30000 headline pods/sec must not drop past the
+      threshold (the scale shape the backend exists for);
+    - weak-scaling efficiency at the widest (8-device) point must not
+      regress — both the analytical-model figure and the measured
+      pods/sec at 8 devices are gated when present;
+    - per-pod readback bytes on the sharded path must stay within the
+      PR-7 budget: gated as an ABSOLUTE bound on the NEW record
+      (``readback_budget`` bytes/pod — the fused solve+validate
+      boundary reads one int32 per pod plus scalars, so ~4 bytes/pod
+      with padding headroom) and as a non-regression delta.
+
+    Absent sections are warnings, never failures — records predating a
+    section skip it (same posture as the churn/recovery gates)."""
+    checks, regressions, warnings = [], [], []
+
+    def check(name: str, prev_v, cur_v, lower_is_better: bool = False):
+        pv, cv = _num(prev_v), _num(cur_v)
+        if pv is None or cv is None or pv <= 0:
+            warnings.append(f"{name}: not comparable "
+                            f"(prev={prev_v!r}, cur={cur_v!r})")
+            return
+        delta = (cv - pv) / pv
+        bad = delta > threshold if lower_is_better else delta < -threshold
+        row = {"check": name, "prev": pv, "cur": cv,
+               "delta_frac": round(delta, 4), "regressed": bad}
+        checks.append(row)
+        if bad:
+            regressions.append(row)
+
+    ph = (prev.get("headline") or {})
+    ch = (cur.get("headline") or {})
+    check("mesh.headline.pods_per_sec",
+          ph.get("pods_per_sec"), ch.get("pods_per_sec"))
+    check("mesh.headline.readback_bytes_per_pod",
+          ph.get("readback_bytes_per_pod"),
+          ch.get("readback_bytes_per_pod"), lower_is_better=True)
+
+    def widest(rec: dict):
+        pts = [p for p in (rec.get("weak_scaling") or [])
+               if _num(p.get("devices"))]
+        return max(pts, key=lambda p: p["devices"]) if pts else {}
+
+    pw, cw = widest(prev), widest(cur)
+    if cw and pw and pw.get("devices") == cw.get("devices"):
+        check(f"mesh.weak_scaling@{int(cw['devices'])}.pods_per_sec",
+              pw.get("pods_per_sec"), cw.get("pods_per_sec"))
+        check(f"mesh.weak_scaling@{int(cw['devices'])}.model_efficiency",
+              pw.get("model_efficiency"), cw.get("model_efficiency"))
+    elif cw or pw:
+        warnings.append("mesh.weak_scaling: widest device points differ "
+                        "between records (skipped)")
+
+    # absolute readback budget on the NEW record alone: every sharded
+    # section (headline + each weak-scaling point) must stay under it —
+    # one (P, N)-sized gather would blow it by orders of magnitude
+    sections = [("mesh.headline", ch)] + [
+        (f"mesh.weak_scaling@{int(p['devices'])}", p)
+        for p in (cur.get("weak_scaling") or []) if _num(p.get("devices"))
+    ]
+    for name, sec in sections:
+        bpp = _num(sec.get("readback_bytes_per_pod"))
+        if bpp is None:
+            continue
+        row = {"check": f"{name}.readback_budget", "prev": None,
+               "cur": bpp, "delta_frac": bpp, "regressed":
+               bpp > readback_budget}
+        checks.append(row)
+        if row["regressed"]:
+            regressions.append(row)
+    for rec, label in ((prev, "prev"), (cur, "cur")):
+        errs = rec.get("errors") or []
+        if errs:
+            warnings.append(f"{label} mesh record carries {len(errs)} "
+                            f"error(s); affected sections may be absent")
+    return {"checks": checks, "regressions": regressions,
+            "warnings": warnings}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("records", nargs="*",
@@ -301,6 +397,11 @@ def main(argv=None) -> int:
                          "frac in the new record (default 0.10; rebased "
                          "from 0.03 in PR 5 — same absolute explain "
                          "cost over a ~2x faster baseline)")
+    ap.add_argument("--mesh-readback-budget", type=float, default=16.0,
+                    help="absolute d2h bytes-per-pod bound for the "
+                         "sharded path in the new mesh record (default "
+                         "16.0 — the PR-7 answer-sized boundary is ~4 "
+                         "B/pod plus padding headroom)")
     ap.add_argument("--pack-floor", type=float, default=0.005,
                     help="absolute pack_s (seconds) under which the "
                          "pack-breakdown ratio check is skipped as noise "
@@ -355,7 +456,42 @@ def main(argv=None) -> int:
     elif churn_found:
         verdict["warnings"].append(
             "only one churn record — churn gates need two to compare")
-    if prev_path is None and len(churn_found) < 2:
+    # sharded-backend gates (scripts/bench_mesh_scale.py records) —
+    # absence tolerated so pre-mesh benchres directories keep passing
+    mesh_found = find_mesh_records(args.dir)
+    if len(mesh_found) >= 2:
+        try:
+            mprev, mcur = load(mesh_found[-2]), load(mesh_found[-1])
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot load mesh records: {e}", file=sys.stderr)
+            return 2
+        mv = compare_mesh(mprev, mcur, args.threshold,
+                          args.mesh_readback_budget)
+        verdict["checks"].extend(mv["checks"])
+        verdict["regressions"].extend(mv["regressions"])
+        verdict["warnings"].extend(mv["warnings"])
+        verdict["mesh_records"] = [
+            os.path.relpath(p, REPO_ROOT) for p in mesh_found[-2:]]
+    elif mesh_found:
+        verdict["warnings"].append(
+            "only one mesh record — mesh gates need two to compare "
+            "(the absolute readback budget still applies)")
+        try:
+            mcur = load(mesh_found[-1])
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot load mesh records: {e}", file=sys.stderr)
+            return 2
+        mv = compare_mesh({}, mcur, args.threshold,
+                          args.mesh_readback_budget)
+        # with no prev record only the absolute budget rows are real
+        keep = [r for r in mv["checks"]
+                if r["check"].endswith("readback_budget")]
+        verdict["checks"].extend(keep)
+        verdict["regressions"].extend(
+            [r for r in keep if r["regressed"]])
+        verdict["mesh_records"] = [
+            os.path.relpath(mesh_found[-1], REPO_ROOT)]
+    if prev_path is None and len(churn_found) < 2 and not mesh_found:
         msg = (f"not enough records in {args.dir} — nothing to gate")
         if args.format == "json":
             print(json.dumps({"status": "skipped", "reason": msg}))

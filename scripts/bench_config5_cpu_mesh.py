@@ -30,7 +30,6 @@ import numpy as np
 
 from bench import ShardedWorkload, Workload, build_variant, node_resources_score
 from kubernetes_tpu.ops.assign import batch_assign, nodes_with_usage
-from kubernetes_tpu.parallel import make_mesh
 
 N_NODES = int(os.environ.get("C5_NODES", 50000))
 BATCH = int(os.environ.get("C5_BATCH", 4096))
@@ -44,9 +43,11 @@ out = {
 }
 
 t0 = time.perf_counter()
-w = ShardedWorkload(
-    build_variant("base", N_NODES, 0, BATCH * N_BATCHES), make_mesh()
-)
+# "auto" routes through parallel.mesh_from_spec — the same resolver the
+# scheduler's `parallel:` config block uses (the first-class backend
+# path; this script stopped being a placement fork in the mesh PR)
+w = ShardedWorkload(build_variant("base", N_NODES, 0, BATCH * N_BATCHES),
+                    "auto")
 out["build_pack_shard_s"] = round(time.perf_counter() - t0, 1)
 
 dn_cur = w.dn

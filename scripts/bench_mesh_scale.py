@@ -1,0 +1,153 @@
+"""Sharded-backend benchmark: the 5000x30000 headline on the device
+mesh plus a 1→2→4→8-device weak-scaling curve (ROADMAP item 2; the mesh
+PR's committed evidence). Run:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python scripts/bench_mesh_scale.py > benchres/mesh_r01.json
+
+Placement rides the FIRST-CLASS backend path (bench.ShardedWorkload →
+parallel.mesh_from_spec / shard_nodes — the same helpers the
+scheduler's ``parallel:`` config block uses), so the numbers measure
+the production sharding, not a bench fork.
+
+Weak scaling: the node axis grows with the device count
+(``MESH_NODES_PER_DEV`` nodes and 4x that many pods per device), so
+each device holds a constant shard — the classic weak-scaling setup.
+On the CPU host the 8 "devices" timeshare one core, so MEASURED wall
+time grows ~linearly with d and says nothing about real scale-out;
+what the curve pins is (a) the collectives stay vector-shaped — the
+analytic ``model_efficiency`` from parallel/costmodel.py, whose
+falsifiable claim a real multi-chip run can break — and (b) the
+readback budget: ``readback_bytes_per_pod`` must stay ~4 B/pod at
+every width (no (P, N)-sized gather ever crosses to host; graftlint R8
+enforces the same claim at parse time). ``scripts/bench_compare.py``
+gates the headline, the widest point's model efficiency, and the
+absolute readback budget over the two newest ``benchres/mesh_r*.json``.
+
+Headline: 5000 nodes x 30000 pods (the paper's scheduler_perf shape)
+on the full 8-device mesh, batch 4096, cap 8 — recorded with the same
+run_batched instrumentation (pods/s, pack/dispatch/readback split, d2h
+bytes, retrace count) as the single-device headline in bench.py.
+"""
+
+import json
+import os
+import resource
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    (os.environ.get("XLA_FLAGS", "")
+     + " --xla_force_host_platform_device_count=8").strip())
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import ShardedWorkload, build_variant, run_batched  # noqa: E402
+from kubernetes_tpu.parallel import mesh_from_spec  # noqa: E402
+from kubernetes_tpu.parallel.costmodel import CollectiveCostModel  # noqa: E402
+from kubernetes_tpu.utils.interner import bucket_size  # noqa: E402
+
+HEAD_NODES = int(os.environ.get("MESH_HEAD_NODES", 5000))
+HEAD_PODS = int(os.environ.get("MESH_HEAD_PODS", 30000))
+BATCH = int(os.environ.get("MESH_BATCH", 4096))
+NODES_PER_DEV = int(os.environ.get("MESH_NODES_PER_DEV", 256))
+WIDTHS = [int(x) for x in
+          os.environ.get("MESH_WIDTHS", "1,2,4,8").split(",")]
+CAP = int(os.environ.get("MESH_CAP", 8))
+
+
+def log(msg):
+    print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+def model_efficiency(devices: int, pods: int, nodes: int) -> float:
+    """The analytic scale-out efficiency for this shape (the
+    falsifiable figure a real multi-chip run can break; see
+    parallel/costmodel.py for the ICI envelope)."""
+    if devices < 2:
+        return 1.0
+    m = CollectiveCostModel(devices=devices,
+                            pods_per_batch=min(pods, BATCH),
+                            nodes_padded=bucket_size(max(nodes, 1)))
+    return float(m.predict()["scaleout_efficiency_cpu_anchor"])
+
+
+out = {
+    "metric": "sharded-backend weak scaling + 5000x30000 headline",
+    "platform": jax.default_backend(),
+    "devices_available": len(jax.devices()),
+    "batch": BATCH,
+    "per_node_cap": CAP,
+    "weak_scaling": [],
+    "errors": [],
+}
+
+# ---- weak-scaling curve: constant shard per device ----
+for d in WIDTHS:
+    n_nodes = NODES_PER_DEV * d
+    n_pods = 4 * n_nodes
+    try:
+        t0 = time.perf_counter()
+        w = ShardedWorkload(build_variant("base", n_nodes, 0, n_pods),
+                            mesh_from_spec(d))
+        build_s = time.perf_counter() - t0
+        r = run_batched(w, min(BATCH, n_pods), cap=CAP)
+        point = {
+            "devices": d,
+            "nodes": n_nodes,
+            "pods": n_pods,
+            "build_s": round(build_s, 2),
+            "wall_s": r["elapsed_s"],
+            "pods_per_sec": r["pods_per_sec"],
+            "placed": r["placed"],
+            "rounds": r["rounds"],
+            "readback_bytes_per_pod": r["readback_bytes_per_pod"],
+            "retraces": r["jax"]["retraces"],
+            "model_efficiency": round(
+                model_efficiency(d, n_pods, n_nodes), 5),
+        }
+        out["weak_scaling"].append(point)
+        log(f"weak d={d}: {point}")
+    except Exception as e:  # record what we have; the gate tolerates holes
+        out["errors"].append(f"weak_scaling d={d}: {e!r:.300}")
+        log(f"weak d={d} FAILED: {e!r}")
+
+# ---- 5000x30000 headline on the full mesh ----
+try:
+    t0 = time.perf_counter()
+    w = ShardedWorkload(build_variant("base", HEAD_NODES, 0, HEAD_PODS),
+                        "auto")
+    build_s = time.perf_counter() - t0
+    r = run_batched(w, BATCH, cap=CAP, latency=True)
+    out["headline"] = {
+        "devices": len(jax.devices()),
+        "nodes": HEAD_NODES,
+        "pods": HEAD_PODS,
+        "build_s": round(build_s, 2),
+        "pods_per_sec": r["pods_per_sec"],
+        "placed": r["placed"],
+        "elapsed_s": r["elapsed_s"],
+        "pack_s": r["pack_s"],
+        "dispatch_s": r["dispatch_s"],
+        "readback_s": r["readback_s"],
+        "rounds": r["rounds"],
+        "readback_bytes_per_pod": r["readback_bytes_per_pod"],
+        "retraces": r["jax"]["retraces"],
+        "latency_s": r.get("latency_s"),
+        "model_efficiency": round(
+            model_efficiency(len(jax.devices()), HEAD_PODS, HEAD_NODES), 5),
+    }
+    log(f"headline: {out['headline']}")
+except Exception as e:
+    out["errors"].append(f"headline: {e!r:.300}")
+    log(f"headline FAILED: {e!r}")
+
+out["peak_rss_gb"] = round(
+    resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 2)
+print(json.dumps(out, indent=1))

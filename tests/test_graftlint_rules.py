@@ -517,6 +517,104 @@ def test_r7_scope_suppression_with_justification():
 
 
 # --------------------------------------------------------------------------
+# R8 — sharded-value gather in mesh-aware modules
+# --------------------------------------------------------------------------
+
+R8_SRC = """
+    import numpy as np
+    import jax
+    from kubernetes_tpu.parallel import shard_nodes
+
+    def pull(sharded):
+        a = np.asarray(sharded)
+        b = jax.device_get(sharded)
+        c = sharded.tolist()
+        return a, b, c
+"""
+
+
+def test_r8_flags_gather_in_parallel_importing_module():
+    findings = lint(R8_SRC, select=["R8"],
+                    filename="kubernetes_tpu/driver2.py")
+    assert rules_of(findings) == ["R8", "R8", "R8"]
+
+
+def test_r8_needs_the_parallel_import():
+    # the identical gathers in a module that never imports the mesh
+    # layer are R7's business, not R8's — the rule scopes to modules
+    # whose values can actually be node-axis-sharded
+    src = R8_SRC.replace(
+        "from kubernetes_tpu.parallel import shard_nodes", "")
+    assert lint(src, select=["R8"],
+                filename="kubernetes_tpu/driver2.py") == []
+
+
+def test_r8_bare_import_forms_are_in_scope():
+    # the engine maps a bare `import a.b.c` to its top-level name, so
+    # the rule's scope check must walk the AST, not just fi.imports
+    for imp in ("import kubernetes_tpu.parallel",
+                "import kubernetes_tpu.parallel.mesh"):
+        findings = lint(f"""
+    import numpy as np
+    import jax
+    {imp}
+
+    def pull(sharded):
+        return np.asarray(sharded)
+    """, select=["R8"], filename="kubernetes_tpu/driver2.py")
+        assert rules_of(findings) == ["R8"], imp
+
+
+def test_r8_function_level_import_is_in_scope():
+    # the production modules import the placement helpers lazily inside
+    # functions (scheduler/cache) — scope detection must see those
+    findings = lint("""
+    import numpy as np
+    import jax
+
+    def pull(sharded):
+        from kubernetes_tpu.parallel.mesh import replicate
+        return np.asarray(sharded)
+    """, select=["R8"], filename="kubernetes_tpu/driver2.py")
+    assert rules_of(findings) == ["R8"]
+
+
+def test_r8_exempt_scopes_and_host_literals():
+    # tests/scripts/the placement layer itself gather by design
+    for fn in ("tests/test_x.py", "scripts/bench_x.py",
+               "kubernetes_tpu/parallel/mesh.py"):
+        assert lint(R8_SRC, select=["R8"], filename=fn) == []
+    assert lint("""
+    import numpy as np
+    import jax
+    from kubernetes_tpu.parallel import shard_nodes
+
+    def pack():
+        return np.asarray([1, 2, 3])
+    """, select=["R8"], filename="kubernetes_tpu/driver2.py") == []
+
+
+def test_r8_declared_boundary_and_suppression_quiet():
+    assert lint("""
+    import numpy as np
+    import jax
+    from kubernetes_tpu.parallel import shard_nodes
+
+    def pull(obs, sharded):
+        return obs.jax.readback("solve-result", sharded)
+    """, select=["R8"], filename="kubernetes_tpu/driver2.py") == []
+    assert lint("""
+    import numpy as np
+    import jax
+    from kubernetes_tpu.parallel import shard_nodes
+
+    # graftlint: disable-scope=R8 -- deliberate full gather (fixture)
+    def exact_oracle(sharded):
+        return np.asarray(sharded)
+    """, select=["R8"], filename="kubernetes_tpu/driver2.py") == []
+
+
+# --------------------------------------------------------------------------
 # baseline workflow
 # --------------------------------------------------------------------------
 

@@ -1,0 +1,491 @@
+"""Sharded execution backend (the mesh PR): the ``parallel:`` config
+block, validated mesh construction, the sharded device-resident
+snapshot, the mesh-aware degradation ladder, and the sharded-vs-single
+**bit-parity** contract — collectives change the execution plan, never
+the answer (the analog of the reference asserting identical scheduling
+decisions regardless of goroutine fan-out, and the production
+promotion of the test_parallel.py dryrun).
+
+Runs on the 8-virtual-device CPU mesh tests/conftest.py forces."""
+
+import dataclasses
+import importlib.util
+import os
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetes_tpu.cache import SchedulerCache
+from kubernetes_tpu.config import (
+    KubeSchedulerConfiguration,
+    ParallelConfig,
+    RecoveryConfig,
+)
+from kubernetes_tpu.faults import FaultInjector
+from kubernetes_tpu.models.cluster import make_gang_pods, make_nodes, make_pods
+from kubernetes_tpu.ops.arrays import (
+    nodes_to_device,
+    pods_to_device,
+    selectors_to_device,
+)
+from kubernetes_tpu.ops.assign import batch_assign
+from kubernetes_tpu.parallel import (
+    largest_pow2,
+    make_mesh,
+    mesh_from_spec,
+    mesh_size,
+    shard_cluster,
+)
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.snapshot import SnapshotPacker
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def mesh_of(d):
+    return make_mesh(jax.devices()[:d])
+
+
+# ---------------------------------------------------------------------------
+# Mesh construction: power-of-two validation + the spec resolver
+# ---------------------------------------------------------------------------
+
+
+def test_largest_pow2():
+    assert [largest_pow2(n) for n in (1, 2, 3, 5, 6, 7, 8, 9)] == \
+        [1, 2, 2, 4, 4, 4, 8, 8]
+
+
+@pytest.mark.parametrize("given,kept", [(3, 2), (6, 4), (8, 8), (1, 1)])
+def test_make_mesh_falls_back_to_pow2_subset(given, kept):
+    """A 3- or 6-device set can never divide the power-of-two node
+    buckets; make_mesh keeps the largest dividing subset instead of
+    dying with an opaque XLA shape error mid-solve."""
+    m = make_mesh(jax.devices()[:given])
+    assert int(m.devices.size) == kept
+
+
+def test_mesh_from_spec_vocabulary():
+    assert mesh_from_spec("off") is None
+    assert mesh_from_spec(None) is None
+    assert mesh_size(mesh_from_spec("auto")) == 8
+    assert mesh_size(mesh_from_spec(4)) == 4
+    # more than available clamps (with a logged warning)
+    assert mesh_size(mesh_from_spec(64)) == 8
+    with pytest.raises(ValueError):
+        mesh_from_spec(-1)
+
+
+# ---------------------------------------------------------------------------
+# Config: the parallel block, native + v1alpha1 round-trip + validation
+# ---------------------------------------------------------------------------
+
+
+def test_parallel_block_native_decode_and_validation():
+    from kubernetes_tpu.cli import decode_config, validate_config
+
+    cfg = decode_config({"parallel": {"mesh": "auto"}})
+    assert cfg.parallel.mesh == "auto"
+    assert validate_config(cfg) == []
+    assert validate_config(decode_config({"parallel": {"mesh": 8}})) == []
+    errs = validate_config(decode_config({"parallel": {"mesh": 3}}))
+    assert any("parallel.mesh" in e and "power of two" in e for e in errs)
+    errs = validate_config(decode_config({"parallel": {"mesh": "sideways"}}))
+    assert any("parallel.mesh" in e for e in errs)
+    with pytest.raises(Exception):
+        decode_config({"parallel": {"lanes": 2}})  # unknown field
+
+
+def test_parallel_block_v1alpha1_round_trip():
+    from kubernetes_tpu.api.config_v1alpha1 import decode, encode
+
+    cfg = KubeSchedulerConfiguration(parallel=ParallelConfig(mesh=4))
+    doc = encode(cfg)
+    assert doc["parallel"] == {"mesh": 4}
+    back = decode(doc)
+    assert back.parallel == ParallelConfig(mesh=4)
+    # versioned defaulting: an absent block decodes to "off"
+    doc2 = encode(KubeSchedulerConfiguration())
+    doc2.pop("parallel")
+    assert decode(doc2).parallel.mesh == "off"
+
+
+def test_cli_mesh_flag_overlay():
+    from kubernetes_tpu.cli import build_parser, resolve_config
+
+    args = build_parser().parse_args(["--mesh", "4"])
+    assert resolve_config(args).parallel.mesh == 4
+    args = build_parser().parse_args(["--mesh", "auto"])
+    assert resolve_config(args).parallel.mesh == "auto"
+    from kubernetes_tpu.cli import ConfigError
+
+    with pytest.raises(ConfigError):
+        resolve_config(build_parser().parse_args(["--mesh", "3"]))
+
+
+# ---------------------------------------------------------------------------
+# Sharded-vs-single bit parity: randomized fuzz across mesh sizes
+# {1, 2, 4, 8}, the contended/gang/pred-mask variants, and the
+# asymmetric 512x137 shape from the dryrun
+# ---------------------------------------------------------------------------
+
+
+def _fuzz_workload(seed: int, n_nodes=48, n_pending=96):
+    """Randomized cluster from a fixed vocabulary (stable buckets):
+    heterogeneous node sizes + existing load + mixed pod requests, so
+    scores are non-trivial and ties real."""
+    rng = random.Random(seed)
+    nodes = [
+        make_node(
+            f"n{i}",
+            cpu_milli=rng.choice([4000, 8000, 16000]),
+            memory=rng.choice([8 * 2**30, 32 * 2**30]),
+            pods=rng.choice([16, 110]),
+            zone=f"z{i % 4}",
+        )
+        for i in range(n_nodes)
+    ]
+    existing = [
+        make_pod(f"old{i}", cpu_milli=rng.choice([100, 500]),
+                 memory=2**28, node_name=f"n{rng.randrange(n_nodes)}")
+        for i in range(n_nodes // 2)
+    ]
+    pending = [
+        make_pod(f"p{i}", cpu_milli=rng.choice([100, 250, 500]),
+                 memory=rng.choice([2**27, 2**28]),
+                 priority=rng.choice([0, 0, 10]))
+        for i in range(n_pending)
+    ]
+    pk = SnapshotPacker()
+    for p in existing + pending:
+        pk.intern_pod(p)
+    dn = nodes_to_device(pk.pack_nodes(nodes, existing))
+    dp = pods_to_device(pk.pack_pods(pending))
+    ds = selectors_to_device(pk.pack_selector_tables())
+    return dp, dn, ds
+
+
+@pytest.mark.parametrize("d", [1, 2, 4, 8])
+def test_sharded_bit_parity_fuzz(d):
+    dp, dn, ds = _fuzz_workload(seed=20260804 + d)
+    want, _, _ = batch_assign(dp, dn, ds, per_node_cap=4)
+    sdp, sdn, sds = shard_cluster(dp, dn, ds, mesh_of(d))
+    got, _, _ = batch_assign(sdp, sdn, sds, per_node_cap=4)
+    assert (np.asarray(got) == np.asarray(want)).all()
+
+
+def test_sharded_bit_parity_contended():
+    """Capacity-bound workload: multiple auction rounds, per-node
+    admission prefix sums, and the rotation tie-break all reduce over
+    the sharded axis."""
+    dp, dn, ds = _fuzz_workload(seed=7, n_nodes=16, n_pending=96)
+    want, _, r1 = batch_assign(dp, dn, ds, per_node_cap=2)
+    sdp, sdn, sds = shard_cluster(dp, dn, ds, mesh_of(8))
+    got, _, r2 = batch_assign(sdp, sdn, sds, per_node_cap=2)
+    assert int(r1) == int(r2) > 1  # genuinely contended, same rounds
+    assert (np.asarray(got) == np.asarray(want)).all()
+
+
+def test_sharded_bit_parity_pred_mask():
+    """A Policy-style predicate bitmask is a static jit key — the
+    sharded compile must honor the same mask bit-for-bit."""
+    from kubernetes_tpu.config import default_predicate_mask
+    from kubernetes_tpu.ops.predicates import BIT
+
+    mask = default_predicate_mask() & ~(1 << BIT["PodFitsResources"])
+    dp, dn, ds = _fuzz_workload(seed=11, n_nodes=16, n_pending=64)
+    want, _, _ = batch_assign(dp, dn, ds, enabled_mask=mask)
+    sdp, sdn, sds = shard_cluster(dp, dn, ds, mesh_of(8))
+    got, _, _ = batch_assign(sdp, sdn, sds, enabled_mask=mask)
+    assert (np.asarray(got) == np.asarray(want)).all()
+
+
+def test_sharded_bit_parity_asymmetric_512x137():
+    """The dryrun's asymmetric shape: 137 nodes pad to a 256 bucket, so
+    shards carry uneven VALID populations — padding rows must stay
+    rejected on every shard."""
+    nodes = make_nodes(137, zones=4)
+    pending = make_pods(512, "asym")
+    pk = SnapshotPacker()
+    for p in pending:
+        pk.intern_pod(p)
+    dn = nodes_to_device(pk.pack_nodes(nodes, []))
+    dp = pods_to_device(pk.pack_pods(pending))
+    ds = selectors_to_device(pk.pack_selector_tables())
+    want, _, _ = batch_assign(dp, dn, ds, per_node_cap=4)
+    sdp, sdn, sds = shard_cluster(dp, dn, ds, mesh_of(8))
+    got, _, _ = batch_assign(sdp, sdn, sds, per_node_cap=4)
+    w = np.asarray(want)
+    assert (np.asarray(got) == w).all()
+    assert (w[: len(pending)] < 137).all()  # never a padding node
+
+
+def _drive(parallel, pods_fn, n_nodes=8, cycles=1):
+    s = Scheduler(clock=FakeClock(), enable_preemption=False,
+                  parallel=parallel)
+    for i in range(n_nodes):
+        s.on_node_add(make_node(f"node-{i}", cpu_milli=8000, pods=32))
+    out = []
+    for c in range(cycles):
+        for p in pods_fn(c):
+            s.on_pod_add(p)
+        out.append(s.schedule_cycle())
+    return s, out
+
+
+def test_sharded_bit_parity_gang_driver():
+    """Driver-level gang (all-or-nothing) parity: group rollback and
+    the usage rebuild after it run against the sharded table."""
+
+    def pods(_c):
+        ok = make_gang_pods(2, 4, name_prefix="g")
+        # a group that cannot fully place (more members than the
+        # cluster's pod slots allow at once) rolls back atomically
+        big = make_gang_pods(1, 8, name_prefix="huge")
+        for p in big:
+            p.requests = dataclasses.replace(
+                p.requests, cpu_milli=40000)  # no node fits
+        return ok + big
+
+    s_off, r_off = _drive(None, pods)
+    s_on, r_on = _drive(ParallelConfig(mesh=8), pods)
+    assert r_off[0].assignments == r_on[0].assignments
+    assert r_off[0].scheduled == r_on[0].scheduled == 8
+    assert r_off[0].unschedulable == r_on[0].unschedulable == 8
+
+
+# ---------------------------------------------------------------------------
+# Sharded resident snapshot: delta-scatter-after-churn == full rebuild
+# ---------------------------------------------------------------------------
+
+
+def _churned_caches(mesh):
+    c = SchedulerCache()
+    c.set_mesh(mesh)
+    for i in range(64):
+        c.add_node(make_node(f"n{i}"))
+    _, dev0, mode0 = c.device_snapshot()
+    assert mode0 == "full"
+    # churn a small dirty set (update, assume, confirm) — under the 25%
+    # delta threshold
+    c.update_node(make_node("n3", cpu_milli=1234))
+    c.assume_pod(make_pod("a", cpu_milli=100), "n7")
+    c.add_pod(make_pod("b", cpu_milli=50, node_name="n9"))
+    _, dev_delta, mode1 = c.device_snapshot()
+    assert mode1 == "delta"
+    # the oracle: a fresh cache packing the SAME final state in full
+    c2 = SchedulerCache()
+    c2.set_mesh(mesh)
+    for i in range(64):
+        c2.add_node(make_node(
+            f"n{i}", cpu_milli=(1234 if i == 3 else 32000)))
+    c2.assume_pod(make_pod("a", cpu_milli=100), "n7")
+    c2.add_pod(make_pod("b", cpu_milli=50, node_name="n9"))
+    _, dev_full, _ = c2.device_snapshot()
+    return dev_delta, dev_full
+
+
+def test_sharded_delta_scatter_matches_full_rebuild():
+    mesh = mesh_of(8)
+    dev_delta, dev_full = _churned_caches(mesh)
+    for name, a, b in zip(type(dev_delta)._fields, dev_delta, dev_full):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), name
+    # the scatter must PRESERVE the node-axis sharding (a silent
+    # fallback to single-device would still be bit-correct)
+    from kubernetes_tpu.parallel.mesh import NODE_AXIS
+
+    spec = dev_delta.allocatable.sharding.spec
+    assert spec[0] == NODE_AXIS
+
+
+def test_set_mesh_change_invalidates_resident():
+    c = SchedulerCache()
+    c.set_mesh(mesh_of(4))
+    c.add_node(make_node("n0"))
+    _, _, mode = c.device_snapshot()
+    assert mode == "full"
+    _, _, mode = c.device_snapshot()
+    assert mode == "clean"
+    c.set_mesh(mesh_of(2))  # mesh change drops the resident table
+    _, dev, mode = c.device_snapshot()
+    assert mode == "full"
+    assert int(dev.allocatable.sharding.mesh.devices.size) == 2
+
+
+def test_tiny_cluster_pads_node_bucket_to_mesh():
+    """A 1-node cluster on an 8-device mesh pads its bucket up to 8
+    rows so the shard split stays legal."""
+    c = SchedulerCache()
+    c.set_mesh(mesh_of(8))
+    c.add_node(make_node("only"))
+    _, dev, _ = c.device_snapshot()
+    assert dev.allocatable.shape[0] == 8
+
+
+# ---------------------------------------------------------------------------
+# Scheduler end-to-end under the mesh: steady-state modes, provenance,
+# zero retraces, warmup, ladder, chaos
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_mesh_steady_state_and_provenance():
+    def pods(c):
+        return [make_pod(f"p{c}-{i}", cpu_milli=100) for i in range(4)]
+
+    s_off, r_off = _drive(None, pods, n_nodes=32, cycles=3)
+    s_on, r_on = _drive(ParallelConfig(mesh=8), pods, n_nodes=32, cycles=3)
+    for a, b in zip(r_off, r_on):
+        assert a.assignments == b.assignments
+        assert a.scheduled == b.scheduled == 4
+    # steady state: full upload once, then delta scatters on churn
+    assert [r.snapshot_mode for r in r_on] == ["full", "delta", "delta"]
+    assert s_on.metrics.mesh_devices.value() == 8
+    rec = s_on.obs.recorder.records()[-1]
+    assert rec.mesh == 8
+    assert "+mesh8" in rec.batch_shape
+    # cycles 2..3 hit warmed shapes: zero retraces at the solve site
+    assert s_on.obs.jax.retrace_total("solve") == 0
+    assert s_off.metrics.mesh_devices.value() == 0
+    assert s_off.obs.recorder.records()[-1].mesh == 0
+
+
+def test_scheduler_mesh_warmup_registers_sharded_shapes():
+    from kubernetes_tpu.config import WarmupConfig
+
+    s = Scheduler(clock=FakeClock(), enable_preemption=False,
+                  parallel=ParallelConfig(mesh=8),
+                  warmup=WarmupConfig(enabled=True, pod_buckets=(8,)))
+    for i in range(16):
+        s.on_node_add(make_node(f"node-{i}"))
+    assert s.warmup(sample_pods=[make_pod("w", cpu_milli=10)]) == 1
+    s.on_pod_add(make_pod("real", cpu_milli=10))
+    res = s.schedule_cycle()
+    assert res.scheduled == 1
+    # the warmed sharded signature served the real cycle: no retrace
+    assert s.obs.jax.retrace_total("solve") == 0
+
+
+def test_mesh_ladder_single_device_rung():
+    """device_lost at the sharded solve site: the mesh-aware ladder
+    demotes sharded -> batch-single (one device of the mesh) before
+    batch-cpu/greedy, and the cycle still binds."""
+    fi = FaultInjector(seed=0).arm("solve:batch", "device_lost")
+    s = Scheduler(clock=FakeClock(), enable_preemption=False,
+                  fault_injector=fi, parallel=ParallelConfig(mesh=8))
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("p0"))
+    res = s.schedule_cycle()
+    assert res.scheduled == 1
+    assert res.solver_tier == "batch-single"
+    assert res.solver_fallbacks >= 1
+
+
+def test_mesh_device_loss_cooloff_demotes_then_heals_sharded():
+    """A lost shard at the snapshot seam exhausts the rebuild budget ->
+    single-device host-mode snapshots for the cooloff; once it passes
+    and the device heals, the resident table re-places ONTO THE MESH
+    (the chaos entry of the ISSUE's test satellite)."""
+    fi = FaultInjector(seed=0).arm("snapshot:device", "device_lost",
+                                   count=2)
+    clk = FakeClock()
+    s = Scheduler(clock=clk, enable_preemption=False, fault_injector=fi,
+                  parallel=ParallelConfig(mesh=8),
+                  recovery=RecoveryConfig(device_reset_limit=1,
+                                          device_cooloff_s=5.0))
+    s.on_node_add(make_node("n0", cpu_milli=64000, pods=200))
+    modes, recs = [], []
+    for i in range(3):
+        s.on_pod_add(make_pod(f"q{i}", cpu_milli=10))
+        res = s.schedule_cycle()
+        assert res.scheduled == 1
+        modes.append(res.snapshot_mode)
+        recs.append(s.obs.recorder.records()[-1])
+        clk.advance(6)
+    # cycle 0: budget exhausted -> host (single-device) fallback;
+    # cycles 1-2: cooloff expired, injector spent -> sharded resident
+    assert modes == ["host", "full", "full"]
+    # the flight record's mesh flag is truthful PER CYCLE: the cooloff
+    # cycle ran single-device even though the scheduler owns a mesh
+    assert [r.mesh for r in recs] == [0, 8, 8]
+    assert s.metrics.recovery_device_resets.value() == 2
+    _, dev, _ = s.cache.device_snapshot()
+    assert int(dev.allocatable.sharding.mesh.devices.size) == 8
+
+
+def test_reconcile_replaces_resident_onto_mesh():
+    """Takeover reconciliation drops + rebuilds the resident table —
+    under a mesh it must come back SHARDED (the PR-8 recovery path is
+    mesh-aware by construction: one re-place seam in the cache)."""
+    s = Scheduler(clock=FakeClock(), enable_preemption=False,
+                  parallel=ParallelConfig(mesh=4))
+    s.on_node_add(make_node("n0"))
+    s.on_pod_add(make_pod("p0"))
+    s.schedule_cycle()
+    s.reconcile([])
+    s.on_pod_add(make_pod("p1"))
+    res = s.schedule_cycle()
+    assert res.snapshot_mode == "full"  # resident was dropped
+    _, dev, _ = s.cache.device_snapshot()
+    assert int(dev.allocatable.sharding.mesh.devices.size) == 4
+
+
+# ---------------------------------------------------------------------------
+# bench_compare mesh gates (contract test)
+# ---------------------------------------------------------------------------
+
+
+def _load_bench_compare():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "bench_compare.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _mesh_record(pps=3000.0, eff=0.999, bpp=4.5):
+    return {
+        "headline": {"pods_per_sec": pps, "readback_bytes_per_pod": bpp},
+        "weak_scaling": [
+            {"devices": 1, "pods_per_sec": pps / 4,
+             "model_efficiency": 1.0, "readback_bytes_per_pod": bpp},
+            {"devices": 8, "pods_per_sec": pps,
+             "model_efficiency": eff, "readback_bytes_per_pod": bpp},
+        ],
+    }
+
+
+def test_bench_compare_mesh_gates():
+    bc = _load_bench_compare()
+    ok = bc.compare_mesh(_mesh_record(), _mesh_record(), 0.10)
+    assert ok["regressions"] == []
+    # headline throughput drop past the threshold regresses
+    bad = bc.compare_mesh(_mesh_record(), _mesh_record(pps=2000.0), 0.10)
+    assert any(r["check"] == "mesh.headline.pods_per_sec"
+               for r in bad["regressions"])
+    # weak-scaling efficiency at the widest point regresses
+    bad = bc.compare_mesh(_mesh_record(), _mesh_record(eff=0.5), 0.10)
+    assert any("model_efficiency" in r["check"] for r in bad["regressions"])
+    # the absolute readback budget fires on the NEW record alone — a
+    # (P, N)-sized gather would be ~N x over it
+    bad = bc.compare_mesh(_mesh_record(), _mesh_record(bpp=4096.0), 0.10)
+    assert any(r["check"].endswith("readback_budget")
+               for r in bad["regressions"])
+    # absence-tolerant: an empty prev record warns, never fails
+    warnonly = bc.compare_mesh({}, _mesh_record(), 0.10)
+    assert not [r for r in warnonly["regressions"]
+                if not r["check"].endswith("readback_budget")]

@@ -193,7 +193,8 @@ def test_lint_surface():
     from kubernetes_tpu.lint.rules import RULE_SUMMARIES
     from kubernetes_tpu.testing import lint_clean
 
-    assert RULE_IDS == ("R0", "R1", "R2", "R3", "R4", "R5", "R6", "R7")
+    assert RULE_IDS == ("R0", "R1", "R2", "R3", "R4", "R5", "R6", "R7",
+                        "R8")
     assert set(RULE_SUMMARIES) == set(RULE_IDS)
     sig = inspect.signature(run_lint)
     for kw in ("root", "select", "respect_suppressions"):
