@@ -168,6 +168,7 @@ class WarmupConfigurationV1alpha1:
     podBuckets: Optional[list] = None
     minBucket: Optional[int] = None
     includeFilter: Optional[bool] = None
+    hostFallback: Optional[bool] = None
 
 
 @dataclass
@@ -197,6 +198,8 @@ class ServingConfigurationV1alpha1:
     queueTimeout: Optional[str] = None
     retryAfter: Optional[str] = None
     watchBuffer: Optional[int] = None
+    shedQueueBound: Optional[int] = None
+    degradedPressureFactor: Optional[float] = None
 
 
 @dataclass
@@ -296,6 +299,8 @@ def set_defaults_kube_scheduler_configuration(
         wu.minBucket = 256
     if wu.includeFilter is None:
         wu.includeFilter = True
+    if wu.hostFallback is None:
+        wu.hostFallback = False
     rb = obj.robustness
     if rb.cycleDeadline is None:
         rb.cycleDeadline = "0s"  # 0 = unbounded (the internal default)
@@ -378,6 +383,10 @@ def set_defaults_kube_scheduler_configuration(
         sv.retryAfter = "1s"
     if sv.watchBuffer is None:
         sv.watchBuffer = 4096
+    if sv.shedQueueBound is None:
+        sv.shedQueueBound = 0
+    if sv.degradedPressureFactor is None:
+        sv.degradedPressureFactor = 4.0
     pl = obj.parallel
     if pl.mesh is None:
         pl.mesh = "off"
@@ -535,6 +544,8 @@ def _serving_to_internal(sv: ServingConfigurationV1alpha1):
         queue_timeout_s=_dur("queueTimeout", sv.queueTimeout, "serving"),
         retry_after_s=_dur("retryAfter", sv.retryAfter, "serving"),
         watch_buffer=sv.watchBuffer,
+        shed_queue_bound=sv.shedQueueBound,
+        degraded_pressure_factor=sv.degradedPressureFactor,
     )
 
 
@@ -554,6 +565,7 @@ def _warmup_to_internal(wu: WarmupConfigurationV1alpha1):
         pod_buckets=tuple(buckets),
         min_bucket=wu.minBucket,
         include_filter=wu.includeFilter,
+        host_fallback=wu.hostFallback,
     )
 
 
@@ -645,6 +657,7 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
             podBuckets=list(c.warmup.pod_buckets),
             minBucket=c.warmup.min_bucket,
             includeFilter=c.warmup.include_filter,
+            hostFallback=c.warmup.host_fallback,
         ),
         robustness=RobustnessConfigurationV1alpha1(
             cycleDeadline=format_duration(rc.cycle_deadline_s),
@@ -692,6 +705,8 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
             queueTimeout=format_duration(c.serving.queue_timeout_s),
             retryAfter=format_duration(c.serving.retry_after_s),
             watchBuffer=c.serving.watch_buffer,
+            shedQueueBound=c.serving.shed_queue_bound,
+            degradedPressureFactor=c.serving.degraded_pressure_factor,
         ),
         parallel=ParallelConfigurationV1alpha1(mesh=c.parallel.mesh),
     )

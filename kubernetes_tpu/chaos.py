@@ -222,6 +222,84 @@ class CrashLoop:
         }
 
 
+class MeshChaos:
+    """Shard-loss chaos for the sharded backend, arm-able MID-CHURN.
+
+    The mesh tests lose a device between fake-clock cycles; the
+    composed serving mode needs the same fault while a real serving
+    loop is draining a doorbell on another thread. This helper owns a
+    :class:`~kubernetes_tpu.faults.FaultInjector` wired into the
+    scheduler's device seam and arms a bounded ``shard_lost`` burst on
+    demand: the next ``recovery.device_reset_limit + 1`` snapshots
+    raise :class:`~kubernetes_tpu.faults.ShardLost`, which exhausts the
+    per-cycle rebuild budget and pushes the scheduler into host-mode
+    snapshots for ``device_cooloff_s`` — after which the heal probe
+    re-places the resident table SHARDED (cache.set_mesh seam). The
+    doorbell loop never stalls: the fault surfaces inside
+    ``_device_snapshot_recovering``, which falls back instead of
+    raising out of the cycle.
+
+    Arming mutates only the injector's rule list (appends; the GIL
+    makes that safe against a concurrent ``pick``), so callers may arm
+    from a producer thread without the ingest lock. ``observe`` feeds
+    per-cycle snapshot provenance in; :meth:`report` summarizes the
+    loss -> host-mode -> healed-sharded arc for bench records."""
+
+    def __init__(self, sched, shard: int = 0) -> None:
+        from kubernetes_tpu.faults import FaultInjector
+
+        if sched.fault_injector is None:
+            sched.fault_injector = FaultInjector(seed=0)
+            # the cache hook is normally attached at construction;
+            # late-attached injectors need the same seam
+            if getattr(sched.cache, "fault_injector", "absent") is None:
+                sched.cache.fault_injector = sched.fault_injector
+        self.sched = sched
+        self.injector = sched.fault_injector
+        self.shard = shard
+        self.lost_at: Optional[float] = None
+        self.host_cycles = 0
+        self.healed_at: Optional[float] = None
+        self._was_lost = False
+
+    def lose_shard(self, clock_now: Optional[float] = None) -> None:
+        """Arm the loss: enough one-shot ``shard_lost`` faults at the
+        snapshot seam to blow the rebuild budget in one cycle (budget
+        + 1 — the scheduler retries the rebuild ``device_reset_limit``
+        times before cooling off)."""
+        shots = self.sched.recovery.device_reset_limit + 1
+        self.injector.arm("snapshot:device", "shard_lost", count=shots,
+                          shard=self.shard)
+        self.lost_at = clock_now
+        self._was_lost = True
+        self.healed_at = None
+
+    def observe(self, res, clock_now: Optional[float] = None) -> None:
+        """Feed one CycleResult: tracks host-mode cycles and stamps the
+        heal (first sharded-resident snapshot after a loss)."""
+        if not self._was_lost or self.healed_at is not None:
+            return
+        if res.snapshot_mode == "host":
+            self.host_cycles += 1
+        elif res.snapshot_mode in ("full", "delta", "clean") \
+                and self.host_cycles:
+            self.healed_at = clock_now
+
+    def report(self) -> dict:
+        heal_s = None
+        if (self.healed_at is not None and self.lost_at is not None):
+            heal_s = self.healed_at - self.lost_at
+        return {
+            "shard": self.shard,
+            "shard_losses_fired": self.injector.fired_total(
+                "snapshot:device"),
+            "host_mode_cycles": self.host_cycles,
+            "healed_sharded": self.healed_at is not None,
+            "shard_heal_s": (round(heal_s, 3)
+                             if heal_s is not None else None),
+        }
+
+
 class HAReplica:
     """One member of a dual-scheduler failover pair: elector
     (``LeaseLock`` CASing the hub's coordination Lease), reflector-fed

@@ -189,6 +189,11 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         errs.append("serving.retryAfter: must be greater than zero")
     if sc.watch_buffer < 1:
         errs.append("serving.watchBuffer: must be at least 1")
+    if sc.shed_queue_bound < 0:
+        errs.append("serving.shedQueueBound: must be non-negative "
+                    "(0 = auto: twice the accumulation target)")
+    if sc.degraded_pressure_factor < 1:
+        errs.append("serving.degradedPressureFactor: must be at least 1")
     pl = cfg.parallel
     mesh = pl.mesh
     if isinstance(mesh, bool) or not (
@@ -497,25 +502,23 @@ def run(cfg: KubeSchedulerConfiguration, args, stop_event=None) -> None:
     from kubernetes_tpu.server import serve_scheduler
 
     sched = Scheduler.from_config(cfg)
+    runtime = None
     fairness = None
     if cfg.serving.enabled:
-        # serving mode installs the APF-style filter on the component's
-        # own HTTP surface: extender POSTs classify mutating and shed
-        # with 429 + Retry-After under the configured seats/queues,
-        # while healthz/metrics/debug stay exempt
-        from kubernetes_tpu.serving.fairness import (
-            FlowController,
-            default_flows,
-        )
+        # the COMPOSED serving runtime (serving/compose.py): doorbell +
+        # micro-batch loop + APF admission with the backend-pressure
+        # saturation probe + watch hub, adapted to the scheduler's mesh
+        # (serving warmup grid, host-fallback shapes). The APF filter
+        # lands on the component's own HTTP surface: extender POSTs
+        # classify mutating and shed with 429 + Retry-After under the
+        # configured seats/queues, while healthz/metrics/debug stay
+        # exempt — and the mutating flow sheds from the scheduler's
+        # ACTUAL state (ladder tier + queue depth), not queue length
+        # alone.
+        from kubernetes_tpu.serving import ServingRuntime
 
-        fairness = FlowController(
-            flows=default_flows(
-                concurrency=cfg.serving.flow_concurrency,
-                queue_length=cfg.serving.flow_queue_length,
-                watch_concurrency=cfg.serving.watch_concurrency,
-                queue_timeout_s=cfg.serving.queue_timeout_s),
-            retry_after_s=cfg.serving.retry_after_s,
-            metrics=sched.metrics)
+        runtime = ServingRuntime(sched, cfg.serving, warmup=cfg.warmup)
+        fairness = runtime.flow
     srv = serve_scheduler(sched, host=args.bind_address, port=args.port,
                           fairness=fairness)
     host, port = srv.server_address[:2]
@@ -545,69 +548,53 @@ def run(cfg: KubeSchedulerConfiguration, args, stop_event=None) -> None:
         )
         # recovery wiring: the elector fences every bind, gaining the
         # lease runs takeover reconciliation (requeue + resident-
-        # snapshot rebuild + re-warm), losing it drains in-flight state
-        sched.attach_elector(elector)
+        # snapshot rebuild onto the mesh + re-warm), losing it drains
+        # in-flight state; the composed runtime additionally relists
+        # its watchers across every leadership change
+        if runtime is not None:
+            runtime.attach_elector(elector)
+        else:
+            sched.attach_elector(elector)
     #: AOT warmup is LAZY — it must wait for the first node sync, or
     #: every warmed shape carries an empty-cluster node bucket that no
     #: real cycle will ever match (the compile would land on the first
-    #: pod's critical path anyway, the exact latency the flag removes)
+    #: pod's critical path anyway, the exact latency the flag removes).
+    #: The serving runtime owns its own pending flag (warm_if_pending,
+    #: run under the ingest lock by its gate); this one is the LEGACY
+    #: loop's.
     warmup_pending = cfg.warmup.enabled
     from kubernetes_tpu.serving import Doorbell
 
-    # both modes carry the doorbell: the serving loop blocks on it, and
-    # the legacy loop uses it to tell "idle" from "work arrived while I
-    # was solving" (the empty-queue skip below)
-    bell = sched.attach_doorbell(Doorbell())
-    if (cfg.serving.enabled and cfg.warmup.enabled
-            and not cfg.warmup.pod_buckets):
-        # the streaming path presents SMALL buckets (micro-batches pad
-        # to bucket_size(depth), floor 8); the batch-mode default
-        # min_bucket=256 would leave them unwarmed and every trickle
-        # cycle under churn would retrace — extend the warmed grid down
-        sched.warmup_config = dataclasses.replace(cfg.warmup, min_bucket=8)
-
-    serving_loop = None
-    if cfg.serving.enabled:
-        from kubernetes_tpu.serving import ServingLoop
-
-        serving_loop = ServingLoop(sched, bell, cfg.serving)
-
-    import contextlib
-
-    def _ingest_guard():
-        """Leadership transitions run recovery side-effects — takeover
-        reconciliation, the stopped-leading drain, warmup — that mutate
-        the queue/cache. In serving mode producers feed those same
-        structures from other threads through the loop's ingest lock,
-        so the elector tick (and the lazy warmup) must hold it too; the
-        legacy loop is single-threaded and needs no guard."""
-        return (serving_loop.lock if serving_loop is not None
-                else contextlib.nullcontext())
+    # both modes carry the doorbell: the serving loop blocks on it
+    # (runtime.bell), and the legacy loop uses it to tell "idle" from
+    # "work arrived while I was solving" (the empty-queue skip below)
+    bell = (runtime.bell if runtime is not None
+            else sched.attach_doorbell(Doorbell()))
 
     def gate() -> bool:
-        """Per-iteration admission for both loops: leader election
+        """The LEGACY loop's per-iteration admission: leader election
         (a non-leader keeps serving healthz and ticking the elector)
-        and the lazy AOT warmup."""
+        and the lazy AOT warmup. Single-threaded, so no ingest guard;
+        the serving path uses runtime.gate, which serializes the tick
+        and the warmup against the loop's ingest lock."""
         nonlocal warmup_pending
         if elector is not None:
-            with _ingest_guard():
-                leading = elector.tick()
-            if not leading:
+            if not elector.tick():
                 stop.wait(cfg.leader_election.retry_period_s)
                 return False
         if warmup_pending and sched.cache.node_count():
-            with _ingest_guard():
-                pp = getattr(sched.queue, "pending_pods", None)
-                sample = pp().get("active", [])[:64] if pp else []
-                n = sched.warmup(sample_pods=sample)
+            pp = getattr(sched.queue, "pending_pods", None)
+            sample = pp().get("active", [])[:64] if pp else []
+            n = sched.warmup(sample_pods=sample)
             print(f"warmup: compiled {n} bucketed solve shapes",
                   file=sys.stderr)
             warmup_pending = False
         return True
 
     try:
-        if cfg.serving.enabled:
-            serving_loop.run(stop, gate=gate)
+        if runtime is not None:
+            runtime.run(stop, elector=elector,
+                        retry_period_s=cfg.leader_election.retry_period_s)
         else:
             while not stop.is_set():
                 if not gate():

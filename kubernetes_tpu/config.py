@@ -240,6 +240,12 @@ class WarmupConfig:
     #: also warm the standalone filter pass (the failure-reason /
     #: explain path, compiled separately from the solver)
     include_filter: bool = True
+    #: under a mesh, ALSO warm the single-device host-mode signatures —
+    #: the shapes a device-loss cooloff cycle presents. Without it the
+    #: first cycle after a lost shard pays a hot-path compile and reads
+    #: as a retrace; the composed serving-on-mesh mode turns this on so
+    #: shard loss mid-churn stays retrace-free end to end.
+    host_fallback: bool = False
 
 
 @dataclass
@@ -296,6 +302,17 @@ class ServingConfig:
     #: per-watcher send-buffer bound: a watcher this far behind is
     #: disconnected with 410 Gone (relist) instead of stalling the hub
     watch_buffer: int = 4096
+    #: backend-pressure shed bound for the mutating flow: admission
+    #: sheds with 429 while ``Scheduler.backend_pressure()`` (active-
+    #: queue depth, inflated when the solver ladder is degraded or the
+    #: device is cooling off) exceeds it. 0 = auto: twice the
+    #: accumulation target — two full micro-batches of headroom.
+    shed_queue_bound: int = 0
+    #: multiplier applied to the queue depth inside backend_pressure()
+    #: while the backend is degraded (last cycle solved below the
+    #: configured tier, or host-mode snapshots during a device cooloff):
+    #: a limping solver sheds earlier at the same queue depth
+    degraded_pressure_factor: float = 4.0
 
 
 @dataclass

@@ -81,6 +81,20 @@ class DeviceOOM(SolverFault):
     host, degrade to the CPU tiers meanwhile."""
 
 
+class ShardLost(DeviceLost):
+    """ONE device of a mesh went away (a single-chip loss on a multi-
+    chip slice). Sharded resident buffers have a shard on every mesh
+    device, so losing any one of them poisons every collective — the
+    recovery path is the DeviceLost path (drop residents, host-mode
+    snapshots through the cooloff), and the heal probe re-places
+    SHARDED once the mesh answers again. ``shard`` carries the lost
+    device's mesh index for the chaos reports."""
+
+    def __init__(self, message: str, shard: int = 0) -> None:
+        super().__init__(message)
+        self.shard = int(shard)
+
+
 # ---------------------------------------------------------------------------
 # Circuit breaker (closed -> open -> half-open)
 # ---------------------------------------------------------------------------
@@ -245,6 +259,8 @@ _SOLVER_RAISING = {
         f"injected device loss at {site}"),
     "device_oom": lambda site: DeviceOOM(
         f"injected device OOM at {site}"),
+    "shard_lost": lambda site: ShardLost(
+        f"injected mesh shard loss at {site}"),
 }
 
 #: kinds the device-site hook (snapshot scatter / warmup compile) raises —
@@ -252,18 +268,22 @@ _SOLVER_RAISING = {
 _DEVICE_RAISING = {
     "device_lost": _SOLVER_RAISING["device_lost"],
     "device_oom": _SOLVER_RAISING["device_oom"],
+    "shard_lost": _SOLVER_RAISING["shard_lost"],
 }
 
 
 @dataclass
 class FaultRule:
     """One armed fault: fnmatch ``site`` pattern, fault ``kind``, firing
-    probability ``rate``, optional bounded ``remaining`` shot count."""
+    probability ``rate``, optional bounded ``remaining`` shot count.
+    ``shard`` rides along for ``shard_lost`` rules so the raised
+    :class:`ShardLost` names the lost mesh device."""
 
     site: str
     kind: str
     rate: float = 1.0
     remaining: Optional[int] = None
+    shard: Optional[int] = None
 
 
 class FaultInjector:
@@ -284,15 +304,16 @@ class FaultInjector:
         self.fired: Dict[Tuple[str, str], int] = {}
 
     def arm(self, site: str, kind: str, rate: float = 1.0,
-            count: Optional[int] = None) -> "FaultInjector":
-        self.rules.append(FaultRule(site, kind, rate, count))
+            count: Optional[int] = None,
+            shard: Optional[int] = None) -> "FaultInjector":
+        self.rules.append(FaultRule(site, kind, rate, count, shard))
         return self
 
     def fired_total(self, site_pattern: str = "*") -> int:
         return sum(n for (s, _), n in self.fired.items()
                    if fnmatch.fnmatch(s, site_pattern))
 
-    def pick(self, site: str) -> Optional[str]:
+    def pick_rule(self, site: str) -> Optional[FaultRule]:
         """First armed, matching, non-exhausted rule that passes its
         rate roll; records the firing and decrements bounded shots."""
         for rule in self.rules:
@@ -304,8 +325,13 @@ class FaultInjector:
                 rule.remaining -= 1
             key = (site, rule.kind)
             self.fired[key] = self.fired.get(key, 0) + 1
-            return rule.kind
+            return rule
         return None
+
+    def pick(self, site: str) -> Optional[str]:
+        """Kind-only view of :meth:`pick_rule` (the original surface)."""
+        rule = self.pick_rule(site)
+        return rule.kind if rule is not None else None
 
     # -- transport seam (HTTP extender / gRPC shim) ------------------------
 
@@ -337,14 +363,21 @@ class FaultInjector:
 
     def device_hook(self, site: str) -> Optional[str]:
         """Raise for the accelerator-loss kinds (``device_lost``,
-        ``device_oom``) armed at a device site — the snapshot scatter
-        ("snapshot:device") and the AOT warmup ("warmup:compile") call
-        this before touching the device; other kinds are returned for
-        the caller to interpret (usually ignored)."""
-        kind = self.pick(site)
-        if kind in _DEVICE_RAISING:
-            raise _DEVICE_RAISING[kind](site)
-        return kind
+        ``device_oom``, ``shard_lost``) armed at a device site — the
+        snapshot scatter ("snapshot:device") and the AOT warmup
+        ("warmup:compile") call this before touching the device; other
+        kinds are returned for the caller to interpret (usually
+        ignored). A ``shard_lost`` rule's ``shard`` index rides the
+        raised exception."""
+        rule = self.pick_rule(site)
+        if rule is None:
+            return None
+        if rule.kind == "shard_lost":
+            raise ShardLost(f"injected mesh shard loss at {site}",
+                            shard=rule.shard or 0)
+        if rule.kind in _DEVICE_RAISING:
+            raise _DEVICE_RAISING[rule.kind](site)
+        return rule.kind
 
     # -- solver seam (ops/assign.py fault_hook) ----------------------------
 

@@ -394,6 +394,14 @@ class Scheduler:
         #: serving doorbell (serving/doorbell.py) — None until a serving
         #: loop attaches one via attach_doorbell
         self.doorbell = None
+        #: the ladder tier that produced the most recent non-empty
+        #: cycle ("" before the first solve) and how many tier-to-tier
+        #: fallbacks that cycle took — the backend_pressure probe reads
+        #: the FALLBACK count to tell a healthy backend from a limping
+        #: one (tier NAME comparison would misread the exact solver's
+        #: deliberate hazard routing to "batch" as degradation)
+        self.last_solver_tier = ""
+        self.last_solver_fallbacks = 0
 
     @classmethod
     def from_config(cls, cfg, **kw) -> "Scheduler":
@@ -1414,6 +1422,9 @@ class Scheduler:
         log, flight record. New finalization steps belong HERE so the
         two executors cannot silently diverge."""
         res.elapsed_s = self.clock() - t0
+        if res.solver_tier:
+            self.last_solver_tier = res.solver_tier
+            self.last_solver_fallbacks = res.solver_fallbacks
         klog.V(3).info(
             "cycle %d%s: attempted=%d scheduled=%d unschedulable=%d "
             "rounds=%d %.3fs", cycle, label, res.attempted, res.scheduled,
@@ -2696,6 +2707,43 @@ class Scheduler:
                 self.cache.drop_device_snapshot()
                 klog.warning("warmup aborted at bucket %d: %s", P, e)
                 return compiled
+        if wu.host_fallback and self.mesh is not None and self._mesh_live:
+            # ALSO warm the single-device host-mode signatures — the
+            # shapes a device-loss cooloff cycle presents (resident
+            # table dropped, host-mirror pack, _mesh_live False). The
+            # composed serving mode turns this on so a shard lost
+            # mid-churn degrades to host-mode WITHOUT a hot-path
+            # compile: the cooloff cycles hit the jit cache and the
+            # retrace counter stays flat through the whole
+            # loss -> cooloff -> heal-sharded arc.
+            self._mesh_live = False
+            try:
+                host_n = nt.n if nt.n else (node_count or 1)
+                dn_h = nodes_to_device(nt,
+                                       pad_to=bucket_size(max(host_n, 1)))
+                ds_h = selectors_to_device(pk.pack_selector_tables())
+                dt_h = (topology_to_device(pk.pack_topology_tables())
+                        if _has_topo(pk.u) else None)
+                statics_h = statics[:-1] + (False,)
+                for P in buckets:
+                    try:
+                        if self.fault_injector is not None:
+                            self.fault_injector.device_hook(
+                                "warmup:compile")
+                        compiled += self._warm_bucket(
+                            P, pk, sample, dn_h, ds_h, dt_h, solver,
+                            statics_h,
+                            (skip_prio, no_ports, no_pod_aff, no_spread),
+                            has_vol_sample, wu)
+                    except Exception as e:
+                        self.metrics.recovery_device_resets.inc()
+                        self.obs.note_device_reset()
+                        self.cache.drop_device_snapshot()
+                        klog.warning("host-fallback warmup aborted at "
+                                     "bucket %d: %s", P, e)
+                        return compiled
+            finally:
+                self._mesh_live = self.mesh is not None
         klog.V(2).info("warmup: compiled %d bucketed solve shapes "
                        "(nodes bucket %d)", compiled, dn.valid.shape[0])
         return compiled
@@ -2764,6 +2812,39 @@ class Scheduler:
             jax.block_until_ready(fr.mask)
         self.metrics.warmup_compiles.inc()
         return 1
+
+    def is_degraded(self) -> bool:
+        """Is the backend limping? True while the device is in its
+        post-loss cooloff (host-mode snapshots), while the most recent
+        solve had to FALL THROUGH the ladder to reach a result, or
+        while the configured tier's circuit breaker is open. The
+        fallback COUNT is the signal, not the tier name: the exact
+        solver deliberately routes hazardous batches to the round
+        solver as a healthy path, and that must not read as
+        degradation. The APF saturation probe reads this so shedding
+        engages from the scheduler's ACTUAL state, not only from queue
+        length."""
+        from kubernetes_tpu.faults import OPEN
+
+        if self.clock() < self._device_cooloff_until:
+            return True
+        if self.last_solver_fallbacks > 0:
+            return True
+        br = self._breakers.get(f"solver:{self.solver}")
+        return br is not None and br.state == OPEN
+
+    def backend_pressure(self, degraded_factor: float = 4.0) -> float:
+        """Backend-pressure probe for APF shedding
+        (serving/fairness.FlowController.set_saturation): the active-
+        queue depth, multiplied by ``degraded_factor`` while
+        :meth:`is_degraded` — a solver running on a fallback tier (or a
+        device cooling off after a shard loss) clears its queue slower,
+        so admission must shed EARLIER at the same depth. Cheap enough
+        to call per mutating request (two dict reads and a clock)."""
+        depth = float(self.queue.pending_counts().get("active", 0))
+        if depth and self.is_degraded():
+            depth *= max(degraded_factor, 1.0)
+        return depth
 
     def attach_doorbell(self, bell):
         """Wire a serving doorbell into this scheduler: the queue rings

@@ -2,7 +2,7 @@
 the batched solver (ROADMAP item 3: cycles -> a streaming scheduler under
 production churn).
 
-Three pieces, each usable standalone:
+Four pieces, each usable standalone:
 
 - :mod:`kubernetes_tpu.serving.doorbell` — a condition-variable doorbell
   the SchedulingQueue, informer/bind paths, and REST mutation handlers
@@ -17,8 +17,15 @@ Three pieces, each usable standalone:
   limits, bounded FIFO queues, 429 + Retry-After on overload) and the
   bounded-buffer watch fan-out hub (a slow watcher is disconnected with
   410 Gone instead of stalling the publisher).
+- :mod:`kubernetes_tpu.serving.compose` — :class:`ServingRuntime`, the
+  COMPOSED production posture: the serving loop on the sharded mesh
+  backend with the crash/failover protocol, APF shedding wired to the
+  scheduler's real backend pressure, and takeover-relisted watch
+  fan-out — one constructor shared by ``cli.run --serving`` and the
+  churn benches.
 """
 
+from kubernetes_tpu.serving.compose import ServingRuntime
 from kubernetes_tpu.serving.doorbell import Doorbell
 from kubernetes_tpu.serving.fairness import (
     FlowController,
@@ -40,6 +47,7 @@ __all__ = [
     "MicroBatchWindow",
     "RequestRejected",
     "ServingLoop",
+    "ServingRuntime",
     "WatcherGone",
     "WatchHub",
     "WindowDecision",

@@ -266,22 +266,37 @@ class WatcherGone(Exception):
 class Watcher:
     """One consumer's bounded send buffer on a :class:`WatchHub`."""
 
-    __slots__ = ("_hub", "buf", "gone", "delivered")
+    __slots__ = ("_hub", "buf", "gone", "gone_reason", "dropped",
+                 "delivered")
 
     def __init__(self, hub: "WatchHub") -> None:
         self._hub = hub
         self.buf: deque = deque()
         self.gone = False
+        #: why the hub cut this watcher loose ("" while live) — carried
+        #: into the WatcherGone message so the 410 answer names the
+        #: right relist cause (buffer overflow vs. takeover relist)
+        self.gone_reason = ""
+        #: buffered-but-never-delivered events discarded at eviction —
+        #: the accounting that makes the drop VISIBLE (it used to
+        #: vanish: eviction cleared the buffer and counted nothing)
+        self.dropped = 0
         self.delivered = 0
 
     def poll(self) -> list:
         """Drain buffered events; raises :class:`WatcherGone` once the
-        hub evicted this watcher (consumer must relist + re-register)."""
+        hub evicted this watcher (consumer must relist + re-register).
+        The raise is sticky: EVERY poll after eviction raises — an
+        eviction racing a concurrent drain can therefore never read as
+        a clean empty stream."""
         with self._hub._lock:
             if self.gone:
+                reason = self.gone_reason or (
+                    f"send buffer overflowed (bound {self._hub.buffer})")
                 raise WatcherGone(
-                    "watcher evicted: send buffer overflowed "
-                    f"(bound {self._hub.buffer}); relist and re-watch")
+                    f"watcher evicted: {reason} "
+                    f"({self.dropped} buffered events dropped); "
+                    "relist and re-watch")
             out = list(self.buf)
             self.buf.clear()
             self.delivered += len(out)
@@ -306,6 +321,10 @@ class WatchHub:
         self._watchers: List[Watcher] = []
         self.published = 0
         self.evicted = 0
+        #: buffered events discarded by evictions (accounting for what
+        #: eviction drops — the relist covers the GAP, but the hub must
+        #: still know how much it threw away)
+        self.events_dropped = 0
         self.max_lag = 0
 
     def register(self) -> Watcher:
@@ -321,6 +340,19 @@ class WatchHub:
             except ValueError:
                 pass
 
+    def _evict_locked(self, w: Watcher, reason: str) -> None:
+        """Cut one watcher loose (callers hold ``_lock``): sticky Gone
+        with the reason the 410 should carry, dropped-event accounting
+        instead of a silent clear."""
+        w.gone = True
+        w.gone_reason = reason
+        w.dropped += len(w.buf)
+        self.events_dropped += len(w.buf)
+        w.buf.clear()
+        self.evicted += 1
+        if self.metrics is not None:
+            self.metrics.watch_evictions.inc()
+
     def publish(self, event) -> None:
         with self._lock:
             self.published += 1
@@ -329,16 +361,35 @@ class WatchHub:
                     continue
                 if len(w.buf) >= self.buffer:
                     # the slow watcher is cut loose, never the hub: its
-                    # buffer is dropped and its next poll gets Gone
-                    w.gone = True
-                    w.buf.clear()
-                    self.evicted += 1
-                    if self.metrics is not None:
-                        self.metrics.watch_evictions.inc()
+                    # buffer is dropped (counted) and every later poll
+                    # gets Gone with the overflow reason
+                    self._evict_locked(
+                        w, f"send buffer overflowed (bound {self.buffer})")
                     continue
                 w.buf.append(event)
                 if len(w.buf) > self.max_lag:
                     self.max_lag = len(w.buf)
+
+    def evict_all(self, reason: str) -> int:
+        """Evict EVERY live watcher with ``reason`` — the takeover /
+        deposition relist broadcast: a leadership change splices two
+        write histories, so a watcher that straddles it must relist
+        from truth rather than trust its buffered tail. Each evicted
+        watcher's next poll raises :class:`WatcherGone` carrying the
+        reason (the 410 + relist-hint answer), never a silent drop —
+        and the race with a concurrent in-flight ``poll`` is benign by
+        construction: both sides serialize on the hub lock, and the
+        Gone flag is sticky, so the watcher either drains first and
+        gets Gone on its NEXT poll, or gets Gone immediately.
+        Returns how many watchers were evicted."""
+        with self._lock:
+            n = 0
+            for w in self._watchers:
+                if w.gone:
+                    continue
+                self._evict_locked(w, reason)
+                n += 1
+            return n
 
     def stats(self) -> dict:
         with self._lock:
@@ -346,5 +397,6 @@ class WatchHub:
                 "watchers": len(self._watchers),
                 "published": self.published,
                 "evicted": self.evicted,
+                "events_dropped": self.events_dropped,
                 "max_lag": self.max_lag,
             }

@@ -139,6 +139,7 @@ class ServingLoop:
         doorbell,
         config=None,
         on_cycle: Optional[Callable] = None,
+        clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if config is None:
             from kubernetes_tpu.config import ServingConfig
@@ -147,7 +148,10 @@ class ServingLoop:
         self.sched = sched
         self.bell = doorbell
         self.config = config
-        self.clock = time.monotonic
+        #: injectable for fake-clock tests (the window's flush decisions
+        #: ride it); the DOORBELL waits stay real-time — a fake-clock
+        #: caller drives run_once directly instead of blocking in run()
+        self.clock = clock
         self.window = MicroBatchWindow(
             clock=self.clock,
             min_wait_s=config.min_wait_s,

@@ -22,6 +22,23 @@ record so rounds stay comparable::
               post-recovery p99 create-to-bind, with a CAS'd shared
               truth proving zero double-binds across the handover
 
+With ``--mesh N`` the record becomes the COMPOSED serving-on-mesh
+family (``benchres/churn_mesh_r*.json``, default 5000 nodes — the
+paper's scheduler_perf count) built on serving.ServingRuntime::
+
+    serving     sustained churn through doorbell micro-batches solving
+                under GSPMD on the node-sharded resident snapshot,
+                thousands of WatchHub watchers fanning out every bind,
+                creates admitted through the APF mutating flow whose
+                saturation probe is Scheduler.backend_pressure
+    failover    kill-the-leader with BOTH replicas on the mesh: the
+                standby re-places the resident snapshot SHARDED,
+                re-warms, relists its watchers, zero double binds
+    shard_loss  one mesh device lost mid-churn (chaos.MeshChaos):
+                cooloff -> host-mode cycles (warmed host-fallback
+                shapes, zero retraces) -> heal back to sharded, the
+                doorbell loop never stalling
+
 Usage::
 
     python scripts/bench_churn.py                      # full (~3 min)
@@ -41,11 +58,26 @@ import sys
 import threading
 import time
 
+# the --mesh arm family needs the virtual-device CPU mesh; defaults
+# only (a real TPU env var wins), set BEFORE jax initializes
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
-from kubernetes_tpu.config import ServingConfig, WarmupConfig  # noqa: E402
+from kubernetes_tpu.chaos import MeshChaos  # noqa: E402
+from kubernetes_tpu.config import (  # noqa: E402
+    ParallelConfig,
+    RecoveryConfig,
+    ServingConfig,
+    WarmupConfig,
+)
 from kubernetes_tpu.scheduler import Scheduler  # noqa: E402
 from kubernetes_tpu.serving import (  # noqa: E402
     Doorbell,
@@ -53,6 +85,7 @@ from kubernetes_tpu.serving import (  # noqa: E402
     FlowSchema,
     RequestRejected,
     ServingLoop,
+    ServingRuntime,
     WatchHub,
 )
 from kubernetes_tpu.testing import make_node, make_pod  # noqa: E402
@@ -340,6 +373,382 @@ def run_serving_arm(rate: float, duration: float, n_nodes: int,
     return out
 
 
+# ---------------------------------------------------------------------------
+# composed serving-on-mesh arm family (--mesh): the production posture —
+# ServingRuntime (serving loop + APF backend-pressure shedding + watch
+# hub) over the node-sharded backend at the scheduler_perf node count,
+# with kill-the-leader and kill-one-shard chaos arms
+# ---------------------------------------------------------------------------
+
+
+def build_runtime(n_nodes: int, warm_buckets, serving_cfg: ServingConfig,
+                  mesh: int = 0, binder=None, recovery=None):
+    """A fresh COMPOSED replica: mesh-backed scheduler + ServingRuntime
+    (doorbell, loop, APF flow with the backend-pressure probe, watch
+    hub) + AOT warmup over the serving grid — sharded AND host-fallback
+    shapes, so neither micro-batch churn nor a shard-loss cooloff ever
+    retraces."""
+    kw = {}
+    if mesh:
+        kw["parallel"] = ParallelConfig(mesh=mesh)
+    if recovery is not None:
+        kw["recovery"] = recovery
+    s = Scheduler(
+        enable_preemption=False,
+        solver="batch",
+        binder=binder,
+        warmup=WarmupConfig(enabled=True, pod_buckets=tuple(warm_buckets)),
+        **kw,
+    )
+    for i in range(n_nodes):
+        s.on_node_add(make_node(f"node-{i}", cpu_milli=64000,
+                                memory=256 * 2**30, pods=500))
+    rt = ServingRuntime(s, serving_cfg)
+    t0 = time.monotonic()
+    compiled = rt.warm_if_pending(
+        sample_pods=[make_pod("warm-sample", cpu_milli=POD_CPU,
+                              memory=POD_MEM)])
+    return rt, compiled, time.monotonic() - t0
+
+
+def _watcher_fleet(hub, n_watchers: int, stuck: int = 5):
+    """Register ``n_watchers`` live watchers (drained by a few poller
+    threads round-robin — thousands of sockets timeshare a handful of
+    handler threads in any real deployment) plus ``stuck`` watchers
+    that never poll: the hub must evict them instead of stalling."""
+    watchers = [hub.register() for _ in range(n_watchers)]
+    stuck_ws = [hub.register() for _ in range(stuck)]
+    stop = threading.Event()
+    threads = []
+
+    def poller(group):
+        while not stop.is_set():
+            for w in group:
+                try:
+                    w.poll()
+                except Exception:
+                    pass  # evicted mid-run: the relist case, keep going
+            stop.wait(0.05)
+
+    k = max(1, min(4, n_watchers))
+    for i in range(k):
+        t = threading.Thread(target=poller, args=(watchers[i::k],),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+
+    def shutdown():
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+    return stuck_ws, shutdown
+
+
+def _mesh_summary(rt, prod, wall: float, compiled: int, warm_s: float,
+                  mesh: int) -> dict:
+    sched = rt.sched
+    out = summarize(prod, wall, sched)
+    bound = max(out["bound"], 1)
+    out.update({
+        "mesh": mesh,
+        "creates_per_sec": round(prod.created / max(wall, 1e-9), 1),
+        "warmup": {"compiled": compiled, "seconds": round(warm_s, 1)},
+        "doorbell_rings": sched.doorbell.rings_total,
+        # d2h bytes per BOUND pod across the whole arm — the PR-7
+        # answer-sized boundary, now sharded (one int32 per padded pod
+        # slot + per-cycle scalars; nothing (P, N)-shaped crosses)
+        "readback_bytes_per_pod": round(
+            sched.obs.jax.d2h_bytes_total() / bound, 2),
+        "snapshot_modes": dict(prod.snapshot_modes),
+    })
+    return out
+
+
+class MeshChurnProducer(ChurnProducer):
+    """ChurnProducer that also histograms per-cycle snapshot modes and
+    stamps cycle completion times (the doorbell-stall evidence)."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.snapshot_modes: dict = {}
+        self.cycle_stamps: list = []
+
+    def on_cycle(self, res) -> None:
+        super().on_cycle(res)
+        self.cycle_stamps.append(time.monotonic())
+        if res.snapshot_mode:
+            self.snapshot_modes[res.snapshot_mode] = \
+                self.snapshot_modes.get(res.snapshot_mode, 0) + 1
+
+
+def run_mesh_serving_arm(rate: float, duration: float, n_nodes: int,
+                         warm_buckets, serving_cfg: ServingConfig,
+                         mesh: int, n_watchers: int) -> dict:
+    """Sustained churn through the composed runtime at the
+    scheduler_perf node count: doorbell-driven micro-batches solving
+    under GSPMD on the node-sharded resident snapshot, thousands of
+    WatchHub watchers fanning out every bind, and creates admitted
+    through the APF mutating flow whose saturation probe is the
+    scheduler's REAL backend pressure."""
+    rt, compiled, warm_s = build_runtime(n_nodes, warm_buckets,
+                                         serving_cfg, mesh=mesh)
+    sched = rt.sched
+
+    def admit(pod):
+        seat = rt.flow.acquire("mutating")
+        rt.flow.release(seat)
+
+    prod = MeshChurnProducer(sched, rt.loop.lock, rate, duration,
+                             admit=admit, hub=rt.hub, name="msv")
+    rt.loop.on_cycle = lambda res: (
+        prod.on_cycle(res),
+        [rt.hub.publish(("BOUND", k)) for k in res.assignments],
+    )
+    stuck_ws, shutdown_watchers = _watcher_fleet(rt.hub, n_watchers)
+    stop = threading.Event()
+    loop_t = threading.Thread(target=rt.loop.run, args=(stop,),
+                              daemon=True)
+    t0 = time.monotonic()
+    loop_t.start()
+    prod.run()
+    drained = drain(sched, timeout_s=30.0)
+    wall = time.monotonic() - t0
+    stop.set()
+    loop_t.join(timeout=10)
+    shutdown_watchers()
+    out = _mesh_summary(rt, prod, wall, compiled, warm_s, mesh)
+    out.update({
+        "mode": "mesh_serving",
+        "drained": drained,
+        "watchers": n_watchers,
+        "watch": rt.hub.stats(),
+        "watch_stuck_evicted": sum(1 for w in stuck_ws if w.gone),
+        "shed_429": prod.shed,
+        "shed_bound": rt.shed_bound(),
+        "flowcontrol": rt.flow.stats(),
+    })
+    return out
+
+
+def run_mesh_shard_loss_arm(rate: float, duration: float, n_nodes: int,
+                            warm_buckets, serving_cfg: ServingConfig,
+                            mesh: int, loss_frac: float = 0.4,
+                            cooloff_s: float = 2.0) -> dict:
+    """Kill-one-shard mid-churn: at ``loss_frac`` of the run a mesh
+    device is lost (chaos.MeshChaos arms ShardLost at the snapshot
+    seam). The scheduler must take the existing cooloff -> host-mode ->
+    heal-sharded path WITHOUT stalling the doorbell loop: producers
+    keep feeding, host-mode cycles keep binding (single-device, warmed
+    by the host-fallback sweep — zero retraces), and after the cooloff
+    the resident table re-places SHARDED. Reports the heal time and the
+    longest cycle-to-cycle gap through the whole arc."""
+    rt, compiled, warm_s = build_runtime(
+        n_nodes, warm_buckets, serving_cfg, mesh=mesh,
+        recovery=RecoveryConfig(device_reset_limit=1,
+                                device_cooloff_s=cooloff_s))
+    sched = rt.sched
+    chaos = MeshChaos(sched)
+    prod = MeshChurnProducer(sched, rt.loop.lock, rate, duration,
+                             name="msl")
+
+    def on_cycle(res):
+        prod.on_cycle(res)
+        chaos.observe(res, time.monotonic())
+
+    rt.loop.on_cycle = on_cycle
+    stop = threading.Event()
+    loop_t = threading.Thread(target=rt.loop.run, args=(stop,),
+                              daemon=True)
+    t0 = time.monotonic()
+    loss_at = t0 + duration * loss_frac
+    def arm_loss():
+        delay = loss_at - time.monotonic()
+        if delay > 0 and stop.wait(delay):
+            return  # the run ended before the loss point
+        chaos.lose_shard(time.monotonic())
+
+    arm_t = threading.Thread(target=arm_loss, daemon=True)
+    loop_t.start()
+    arm_t.start()
+    prod.run()
+    drained = drain(sched, timeout_s=max(30.0, 3 * cooloff_s))
+    wall = time.monotonic() - t0
+    stop.set()
+    loop_t.join(timeout=10)
+    arm_t.join(timeout=5)
+    out = _mesh_summary(rt, prod, wall, compiled, warm_s, mesh)
+    stamps = prod.cycle_stamps
+    max_gap = max((b - a for a, b in zip(stamps, stamps[1:])),
+                  default=0.0)
+    out.update({
+        "mode": "mesh_shard_loss",
+        "drained": drained,
+        "loss_at_s": round((chaos.lost_at or t0) - t0, 2),
+        "cooloff_s": cooloff_s,
+        # the longest stall between consecutive cycle completions —
+        # spanning the loss, the host-mode window, and the sharded heal
+        "doorbell_max_gap_s": round(max_gap, 3),
+        **chaos.report(),
+    })
+    return out
+
+
+def run_mesh_failover_arm(rate: float, duration: float, n_nodes: int,
+                          warm_buckets, serving_cfg: ServingConfig,
+                          mesh: int, kill_frac: float = 0.4) -> dict:
+    """Kill-the-leader with BOTH replicas on the mesh: the standby's
+    takeover must re-place the resident snapshot SHARDED (reconcile ->
+    cache re-place seam), re-warm the sharded buckets (in-process jit
+    cache makes it a cheap no-op here; a cold standby recompiles off
+    the hot path), relist its watchers (the composed runtime's
+    eviction broadcast), and keep double_bind_attempts at 0 through
+    the handover — the elector tick, reconcile, and mesh re-placement
+    all serialize on the ingest lock via ServingRuntime.gate."""
+    from kubernetes_tpu.config import LeaderElectionConfig
+    from kubernetes_tpu.leaderelection import InMemoryLock, LeaderElector
+
+    lease_s = min(2.0, max(duration / 2.0, 0.5))
+    le_cfg = LeaderElectionConfig(
+        lease_duration_s=lease_s, renew_deadline_s=lease_s * 0.7,
+        retry_period_s=lease_s * 0.15)
+    truth = MiniTruth()
+    lock = InMemoryLock()
+
+    class Replica:
+        def __init__(self, name):
+            self.name = name
+            self.rt, self.compiled, self.warm_s = build_runtime(
+                n_nodes, warm_buckets, serving_cfg, mesh=mesh,
+                binder=truth.binder())
+            self.sched = self.rt.sched
+            self.elector = LeaderElector(name, lock, le_cfg)
+            self.rt.attach_elector(self.elector)
+            # a couple of watchers per replica: the takeover must
+            # 410-relist them, not silently splice histories
+            self.watchers = [self.rt.hub.register() for _ in range(3)]
+            self.stop = threading.Event()
+            self.results: list = []
+            self.dead = False
+            self.other = None
+
+        def on_cycle(self, res):
+            self.results.append((time.monotonic(), res))
+            for k in res.assignments:
+                self.rt.hub.publish(("BOUND", k))
+            peer = self.other
+            if peer is not None and not peer.dead and res.assignments:
+                for key, node in res.assignments.items():
+                    ns, pname = key.split("/", 1)
+                    old = make_pod(pname, namespace=ns, cpu_milli=POD_CPU,
+                                   memory=POD_MEM)
+                    new = make_pod(pname, namespace=ns, cpu_milli=POD_CPU,
+                                   memory=POD_MEM, node_name=node)
+                    peer.rt.loop.ingest(peer.sched.on_pod_update, old, new)
+
+        def run(self):
+            self.rt.loop.on_cycle = self.on_cycle
+            self.rt.run(self.stop, elector=self.elector,
+                        retry_period_s=le_cfg.retry_period_s)
+
+        def kill(self):
+            self.dead = True
+            self.stop.set()
+
+    a, b = Replica("a"), Replica("b")
+    a.other, b.other = b, a
+    assert a.elector.tick()  # 'a' is the established leader
+
+    threads = [threading.Thread(target=r.run, daemon=True) for r in (a, b)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    kill_at = t0 + duration * kill_frac
+    created = 0
+    burst_s = 0.1
+    next_burst = t0
+    kill_t = None
+    create_rate = rate / 2.0
+    while True:
+        now = time.monotonic()
+        if now - t0 >= duration:
+            break
+        if kill_t is None and now >= kill_at:
+            a.kill()
+            kill_t = time.monotonic()
+        if now < next_burst:
+            time.sleep(next_burst - now)
+        next_burst += burst_s
+        target = int(create_rate * (min(time.monotonic(), t0 + duration)
+                                    - t0))
+        while created < target:
+            pod_name = f"mfo-{created}"
+            for r in (a, b):
+                if not r.dead:
+                    r.rt.loop.ingest(
+                        r.sched.on_pod_add,
+                        make_pod(pod_name, cpu_milli=POD_CPU,
+                                 memory=POD_MEM))
+            created += 1
+    if kill_t is None:
+        a.kill()
+        kill_t = time.monotonic()
+    drained = drain(b.sched, timeout_s=max(30.0, 3 * lease_s))
+    wall = time.monotonic() - t0
+    for r in (a, b):
+        r.stop.set()
+    for t in threads:
+        t.join(timeout=10)
+
+    takeover_s = None
+    post_p99 = None
+    post_window = [res for t, res in b.results if t > kill_t
+                   and res.scheduled]
+    if post_window:
+        first_bind_t = min(t for t, res in b.results
+                           if t > kill_t and res.scheduled)
+        takeover_s = first_bind_t - kill_t
+        settle = first_bind_t + max(1.0, 0.15 * duration)
+        lats = [v for t, res in b.results if t >= settle
+                for v in res.e2e_latency_s.values()]
+        if not lats:
+            lats = [v for t, res in b.results if t > kill_t
+                    for v in res.e2e_latency_s.values()]
+        post_p99 = round(float(np.percentile(np.asarray(lats), 99)), 4)
+
+    # takeover onto the MESH, verified: the standby's resident table is
+    # sharded across the full device set after the handover
+    _, dev, _ = b.sched.cache.device_snapshot()
+    standby_mesh = int(dev.allocatable.sharding.mesh.devices.size) \
+        if dev is not None else 0
+    return {
+        "mode": "mesh_failover",
+        "mesh": mesh,
+        "wall_s": round(wall, 2),
+        "created": created,
+        "bound": len(truth.bound),
+        "drained": drained,
+        "lease_duration_s": lease_s,
+        "kill_after_s": round(kill_t - t0, 2),
+        "leader_cycles_before_kill": len(a.results),
+        "standby_cycles_after_kill": len(post_window),
+        "takeover_s": (round(takeover_s, 3)
+                       if takeover_s is not None else None),
+        "post_recovery_p99_s": post_p99,
+        "double_bind_attempts": truth.double_bind_attempts,
+        "takeovers": int(b.sched.metrics.recovery_takeovers.value()),
+        "fenced_binds": int(
+            a.sched.metrics.recovery_fenced_binds.value()
+            + b.sched.metrics.recovery_fenced_binds.value()),
+        "standby_resident_mesh": standby_mesh,
+        "standby_retraces": b.sched.obs.jax.retrace_total(),
+        # satellite evidence: the handover relisted the watchers (410 +
+        # relist hint), never a silent history splice
+        "watchers_evicted_on_takeover": b.rt.hub.stats()["evicted"],
+        "jax": {"retraces": b.sched.obs.jax.retrace_total()},
+    }
+
+
 class MiniTruth:
     """The hub's Binding subresource, miniaturized for the bench: a
     CAS'd shared truth both replicas bind through. A second bind of the
@@ -560,10 +969,71 @@ def run_fixed_arm(rate: float, duration: float, n_nodes: int,
     return out
 
 
+def _write_record(record: dict, out_path: str) -> None:
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(record, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(f"wrote {out_path}", file=sys.stderr)
+
+
+def finish_mesh_record(record: dict, args) -> int:
+    """Criteria + write for the --mesh arm family (the composed
+    serving-on-mesh acceptance): sustained rate held at the 5000-node
+    shape, p99 bounded, zero post-warmup retraces EVERYWHERE (the
+    shard-loss arm's host-mode cycles included — that is what the
+    host-fallback warmup buys), takeover ~ lease decay with the
+    standby's resident table sharded across the full mesh and zero
+    double binds, the lost shard healing back to sharded without
+    stalling the doorbell loop, readback inside the answer-sized
+    budget, and the watcher fleet served with only the stuck watchers
+    evicted."""
+    sv = record["arms"].get("serving") or {}
+    fo = record["arms"].get("failover") or {}
+    sl = record["arms"].get("shard_loss") or {}
+    lease = fo.get("lease_duration_s", 2.0) or 2.0
+    cooloff = sl.get("cooloff_s", 2.0) or 2.0
+    record["criteria"] = {
+        "mesh_sustained_rate_ok": bool(
+            sv.get("ops_per_sec", 0) >= record["rate_ops_s"] * 0.9
+            and sv.get("drained")),
+        "mesh_p99_bounded_ok": bool(sv.get("p99_s", 1e9) < 2.0),
+        "mesh_zero_retraces_ok": bool(
+            sv.get("jax", {}).get("retraces", 1) == 0
+            and fo.get("jax", {}).get("retraces", 1) == 0
+            and sl.get("jax", {}).get("retraces", 1) == 0),
+        "mesh_readback_ok": bool(
+            0 < sv.get("readback_bytes_per_pod", 1e9) <= 16.0
+            and 0 < sl.get("readback_bytes_per_pod", 1e9) <= 16.0),
+        "mesh_watchers_ok": bool(
+            sv.get("watch", {}).get("watchers", 0) >= args.watchers
+            and sv.get("watch_stuck_evicted", 0) > 0),
+        "mesh_takeover_ok": bool(
+            fo.get("takeover_s") is not None
+            and fo["takeover_s"] < 3 * lease + 2.0),
+        "mesh_no_double_binds": bool(
+            fo.get("double_bind_attempts", 1) == 0),
+        "mesh_failover_drained_ok": bool(
+            fo.get("drained") and fo.get("bound") == fo.get("created")),
+        "mesh_takeover_sharded_ok": bool(
+            fo.get("standby_resident_mesh", 0) == record["mesh"]),
+        "mesh_shard_healed_ok": bool(
+            sl.get("healed_sharded") and sl.get("drained")),
+        "mesh_doorbell_no_stall_ok": bool(
+            0 < sl.get("doorbell_max_gap_s", 1e9) < cooloff + 3.0),
+    }
+    _write_record(record, args.out)
+    print(json.dumps(record["criteria"], indent=1))
+    ok = all(record["criteria"].values()) and not record["errors"]
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--rate", type=float, default=500.0,
-                    help="target creates+deletes per second (default 500)")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="target creates+deletes per second (default "
+                         "500; 300 with --mesh — the 8-virtual-device "
+                         "CPU mesh timeshares one socket)")
     ap.add_argument("--duration", type=float, default=65.0,
                     help="seconds of sustained churn per arm (default 65)")
     ap.add_argument("--overload-factor", type=float, default=4.0)
@@ -571,34 +1041,71 @@ def main(argv=None) -> int:
     ap.add_argument("--failover-duration", type=float, default=30.0,
                     help="kill-the-leader arm length (leader dies at "
                          "40%% of it)")
-    ap.add_argument("--nodes", type=int, default=64)
-    ap.add_argument("--max-wait", type=float, default=0.02,
-                    help="micro-batch window ceiling (default 20ms)")
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="composed serving-on-mesh arm family: run the "
+                         "mesh_serving / mesh_failover / mesh_shard_loss "
+                         "arms on an N-device node-axis mesh (default "
+                         "nodes become 5000, out becomes "
+                         "churn_mesh_r01.json)")
+    ap.add_argument("--watchers", type=int, default=2000,
+                    help="WatchHub watchers registered in the "
+                         "mesh_serving arm (default 2000)")
+    ap.add_argument("--shard-loss-duration", type=float, default=30.0,
+                    help="kill-one-shard arm length (the shard dies at "
+                         "40%% of it)")
+    ap.add_argument("--nodes", type=int, default=None,
+                    help="cluster size (default 64; 5000 with --mesh — "
+                         "the paper's scheduler_perf node count)")
+    ap.add_argument("--max-wait", type=float, default=None,
+                    help="micro-batch window ceiling (default 20ms; "
+                         "50ms with --mesh)")
     ap.add_argument("--cycle-interval", type=float, default=0.25,
                     help="the fixed arm's idle sleep (the legacy default)")
     ap.add_argument("--smoke", action="store_true",
                     help="~6 s sanity run (2 s arms, tiny buckets)")
-    ap.add_argument("--out", default=os.path.join(
-        REPO_ROOT, "benchres", "churn_r01.json"))
+    ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
+    if args.nodes is None:
+        args.nodes = 5000 if args.mesh else 64
+    if args.rate is None:
+        args.rate = 300.0 if args.mesh else 500.0
+    if args.max_wait is None:
+        args.max_wait = 0.05 if args.mesh else 0.02
+    if args.out is None:
+        args.out = os.path.join(
+            REPO_ROOT, "benchres",
+            "churn_mesh_r01.json" if args.mesh else "churn_r01.json")
     if args.smoke:
         args.duration = 2.0
         args.overload_duration = 2.0
         args.failover_duration = 4.0
+        args.shard_loss_duration = 4.0
         args.rate = min(args.rate, 200.0)
-        args.nodes = min(args.nodes, 8)
-    warm_buckets = (8, 16, 32, 64, 128, 256) if not args.smoke else (8, 16, 32)
+        args.nodes = min(args.nodes, 64 if args.mesh else 8)
+        args.watchers = min(args.watchers, 50)
+    if args.mesh:
+        # the composed arms present micro-batch buckets only; the cap
+        # keeps the warmed sharded grid small (4 shapes x {sharded,
+        # host-fallback}) at the 8192-row node bucket
+        warm_buckets = (8, 16, 32, 64) if not args.smoke else (8, 16)
+    else:
+        warm_buckets = ((8, 16, 32, 64, 128, 256) if not args.smoke
+                        else (8, 16, 32))
 
     serving_cfg = ServingConfig(
         enabled=True, min_wait_s=0.002, max_wait_s=args.max_wait,
-        target_bucket=max(warm_buckets), idle_wait_s=0.1)
+        target_bucket=max(warm_buckets), idle_wait_s=0.1,
+        # mesh mode bounds each watcher's send buffer tighter: the
+        # stuck-watcher eviction must engage inside one bench run
+        watch_buffer=1024 if args.mesh else 4096)
 
     record = {
-        "name": "churn",
+        "name": "churn_mesh" if args.mesh else "churn",
         "rate_ops_s": args.rate,
         "duration_s": args.duration,
         "nodes": args.nodes,
+        "mesh": args.mesh,
         "warm_buckets": list(warm_buckets),
         "serving_config": {"min_wait_s": serving_cfg.min_wait_s,
                            "max_wait_s": serving_cfg.max_wait_s,
@@ -611,25 +1118,41 @@ def main(argv=None) -> int:
         import jax
 
         record["platform"]["jax_backend"] = jax.default_backend()
+        record["platform"]["devices"] = len(jax.devices())
     except Exception:
         pass
 
+    if args.mesh:
+        arm_plan = (
+            ("serving", lambda: run_mesh_serving_arm(
+                args.rate, args.duration, args.nodes, warm_buckets,
+                serving_cfg, args.mesh, args.watchers)),
+            ("failover", lambda: run_mesh_failover_arm(
+                args.rate, args.failover_duration, args.nodes,
+                warm_buckets, serving_cfg, args.mesh)),
+            ("shard_loss", lambda: run_mesh_shard_loss_arm(
+                args.rate, args.shard_loss_duration, args.nodes,
+                warm_buckets, serving_cfg, args.mesh)),
+        )
+    else:
+        arm_plan = (
+            ("serving", lambda: run_serving_arm(
+                args.rate, args.duration, args.nodes, warm_buckets,
+                serving_cfg)),
+            ("fixed", lambda: run_fixed_arm(
+                args.rate, args.duration, args.nodes, warm_buckets,
+                cycle_interval=args.cycle_interval)),
+            ("overload", lambda: run_serving_arm(
+                args.rate, args.overload_duration, args.nodes,
+                warm_buckets, serving_cfg, overload=True)),
+            ("failover", lambda: run_failover_arm(
+                args.rate, args.failover_duration, args.nodes,
+                warm_buckets, serving_cfg)),
+        )
     print(f"churn bench: {args.rate:.0f} ops/s x {args.duration:.0f}s "
-          f"per arm, {args.nodes} nodes", file=sys.stderr)
-    for name, fn in (
-        ("serving", lambda: run_serving_arm(
-            args.rate, args.duration, args.nodes, warm_buckets,
-            serving_cfg)),
-        ("fixed", lambda: run_fixed_arm(
-            args.rate, args.duration, args.nodes, warm_buckets,
-            cycle_interval=args.cycle_interval)),
-        ("overload", lambda: run_serving_arm(
-            args.rate, args.overload_duration, args.nodes, warm_buckets,
-            serving_cfg, overload=True)),
-        ("failover", lambda: run_failover_arm(
-            args.rate, args.failover_duration, args.nodes, warm_buckets,
-            serving_cfg)),
-    ):
+          f"per arm, {args.nodes} nodes"
+          + (f", mesh={args.mesh}" if args.mesh else ""), file=sys.stderr)
+    for name, fn in arm_plan:
         print(f"  arm {name}...", file=sys.stderr)
         try:
             record["arms"][name] = fn()
@@ -638,6 +1161,13 @@ def main(argv=None) -> int:
                 print(f"    takeover={a.get('takeover_s')}s "
                       f"post_p99={a.get('post_recovery_p99_s')}s "
                       f"double_binds={a.get('double_bind_attempts')}",
+                      file=sys.stderr)
+                continue
+            if name == "shard_loss":
+                print(f"    heal={a.get('shard_heal_s')}s "
+                      f"host_cycles={a.get('host_mode_cycles')} "
+                      f"max_gap={a.get('doorbell_max_gap_s')}s "
+                      f"retraces={a['jax'].get('retraces')}",
                       file=sys.stderr)
                 continue
             print(f"    {a.get('ops_per_sec', 0)} ops/s  "
@@ -650,6 +1180,8 @@ def main(argv=None) -> int:
             traceback.print_exc()
             record["errors"].append(f"{name}: {e!r}")
 
+    if args.mesh:
+        return finish_mesh_record(record, args)
     sv = record["arms"].get("serving") or {}
     fx = record["arms"].get("fixed") or {}
     ov = record["arms"].get("overload") or {}
@@ -690,11 +1222,7 @@ def main(argv=None) -> int:
     # exit code is all(criteria.values()) and a 0.0 ratio must not fail
     record["p99_ratio_vs_fixed"] = round(
         sv.get("p99_s", 0) / max(fx.get("p99_s", 1e-9), 1e-9), 3)
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out, "w", encoding="utf-8") as fh:
-        json.dump(record, fh, indent=1, sort_keys=True)
-        fh.write("\n")
-    print(f"wrote {args.out}", file=sys.stderr)
+    _write_record(record, args.out)
     print(json.dumps(record["criteria"], indent=1))
     ok = all(record["criteria"].values()) and not record["errors"]
     return 0 if ok else 1

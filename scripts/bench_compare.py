@@ -50,6 +50,19 @@ first bind) and ``post_recovery_p99_s`` must not regress, and its
 tolerated — churn records predating the failover arm skip with a
 warning, never a failure.
 
+Composed serving-on-mesh gates (the production posture) ride the two
+newest ``benchres/churn_mesh_r*.json`` (scripts/bench_churn.py --mesh):
+sustained creates/sec + p99 create-to-bind at the 5000-node shape,
+kill-the-leader ``takeover_s``, kill-one-shard ``shard_heal_s`` +
+doorbell stall gap — plus absolute invariants on the new record alone
+(``double_bind_attempts == 0`` on every arm reporting it, zero
+post-warmup retraces, d2h readback within the budget). One record is
+enough for the absolute invariants; deltas need two.
+
+``--list-gates`` prints every active gate family (name, record source,
+what it enforces) — the docs reference this output instead of
+hand-maintaining the list.
+
 Records carrying errors in the compared sections are skipped with a
 warning rather than failing the gate — a partial bench record is a bench
 problem, not a perf regression.
@@ -92,6 +105,21 @@ def find_churn_records(directory: str) -> List[str]:
         return (int(m.group(1)) if m else -1, os.path.basename(path))
 
     return sorted(glob.glob(os.path.join(directory, "churn_r*.json")),
+                  key=round_key)
+
+
+def find_churn_mesh_records(directory: str) -> List[str]:
+    """churn_mesh_r*.json (scripts/bench_churn.py --mesh records) sorted
+    by round — the composed serving-on-mesh gate's inputs. Absence is
+    tolerated: benchres directories predating the composed mode keep
+    passing. Disjoint from find_churn_records by glob (churn_r* does
+    not match churn_mesh_r*)."""
+
+    def round_key(path: str) -> Tuple[int, str]:
+        m = re.search(r"churn_mesh_r(\d+)", os.path.basename(path))
+        return (int(m.group(1)) if m else -1, os.path.basename(path))
+
+    return sorted(glob.glob(os.path.join(directory, "churn_mesh_r*.json")),
                   key=round_key)
 
 
@@ -301,6 +329,98 @@ def compare_churn(prev: dict, cur: dict, threshold: float) -> dict:
             "warnings": warnings}
 
 
+def compare_churn_mesh(prev: dict, cur: dict, threshold: float,
+                       readback_budget: float = 16.0) -> dict:
+    """Composed serving-on-mesh gates over two churn_mesh_r*.json
+    records (pure, unit-tested) — the production-posture promises:
+
+    - the mesh serving arm's sustained creates/sec must not drop and
+      its p99 create-to-bind must not grow past the threshold (the
+      5000-node churn headline);
+    - the kill-the-leader arm's ``takeover_s`` (leader death -> the
+      standby's first bind ONTO THE MESH) must not regress;
+    - the kill-one-shard arm's ``shard_heal_s`` (shard loss -> first
+      sharded-resident cycle after the cooloff) must not regress, and
+      its ``doorbell_max_gap_s`` (longest cycle-to-cycle stall through
+      the loss) must not grow — the doorbell loop must keep draining
+      through the degradation;
+    - ABSOLUTE invariants on the NEW record alone:
+      ``double_bind_attempts == 0`` wherever an arm reports it (one
+      attempt across a handover is a correctness bug, not a delta),
+      zero post-warmup retraces on every arm carrying jax telemetry
+      (shard loss included — the host-fallback warmup exists precisely
+      so the cooloff never recompiles), and the serving arm's d2h
+      ``readback_bytes_per_pod`` within ``readback_budget`` (the PR-7
+      answer-sized boundary, sharded).
+
+    Absent sections are warnings, never failures — records predating
+    an arm skip it (same posture as every other gate family)."""
+    checks, regressions, warnings = [], [], []
+
+    def check(name: str, prev_v, cur_v, lower_is_better: bool = False):
+        pv, cv = _num(prev_v), _num(cur_v)
+        if pv is None or cv is None or pv <= 0:
+            warnings.append(f"{name}: not comparable "
+                            f"(prev={prev_v!r}, cur={cur_v!r})")
+            return
+        delta = (cv - pv) / pv
+        bad = delta > threshold if lower_is_better else delta < -threshold
+        row = {"check": name, "prev": pv, "cur": cv,
+               "delta_frac": round(delta, 4), "regressed": bad}
+        checks.append(row)
+        if bad:
+            regressions.append(row)
+
+    def absolute(name: str, cur_v, bad: bool):
+        row = {"check": name, "prev": None, "cur": cur_v,
+               "delta_frac": cur_v, "regressed": bad}
+        checks.append(row)
+        if bad:
+            regressions.append(row)
+
+    pa = prev.get("arms") or {}
+    ca = cur.get("arms") or {}
+    check("churn_mesh.serving.creates_per_sec",
+          (pa.get("serving") or {}).get("creates_per_sec"),
+          (ca.get("serving") or {}).get("creates_per_sec"))
+    check("churn_mesh.serving.p99_s",
+          (pa.get("serving") or {}).get("p99_s"),
+          (ca.get("serving") or {}).get("p99_s"), lower_is_better=True)
+    check("churn_mesh.failover.takeover_s",
+          (pa.get("failover") or {}).get("takeover_s"),
+          (ca.get("failover") or {}).get("takeover_s"),
+          lower_is_better=True)
+    check("churn_mesh.shard_loss.shard_heal_s",
+          (pa.get("shard_loss") or {}).get("shard_heal_s"),
+          (ca.get("shard_loss") or {}).get("shard_heal_s"),
+          lower_is_better=True)
+    check("churn_mesh.shard_loss.doorbell_max_gap_s",
+          (pa.get("shard_loss") or {}).get("doorbell_max_gap_s"),
+          (ca.get("shard_loss") or {}).get("doorbell_max_gap_s"),
+          lower_is_better=True)
+    # absolute invariants on the NEW record alone
+    for arm_name, arm in sorted(ca.items()):
+        db = _num((arm or {}).get("double_bind_attempts"))
+        if db is not None:
+            absolute(f"churn_mesh.{arm_name}.double_bind_attempts",
+                     db, db > 0)
+        rt = _num(((arm or {}).get("jax") or {}).get("retraces"))
+        if rt is not None:
+            absolute(f"churn_mesh.{arm_name}.jax.retraces", rt, rt > 0)
+    bpp = _num((ca.get("serving") or {}).get("readback_bytes_per_pod"))
+    if bpp is not None:
+        absolute("churn_mesh.serving.readback_budget", bpp,
+                 bpp > readback_budget)
+    for rec, label in ((prev, "prev"), (cur, "cur")):
+        errs = rec.get("errors") or []
+        if errs:
+            warnings.append(f"{label} churn_mesh record carries "
+                            f"{len(errs)} error(s); affected sections "
+                            "may be absent")
+    return {"checks": checks, "regressions": regressions,
+            "warnings": warnings}
+
+
 def compare_mesh(prev: dict, cur: dict, threshold: float,
                  readback_budget: float = 16.0) -> dict:
     """Sharded-backend gates over two mesh_r*.json records (pure,
@@ -384,6 +504,32 @@ def compare_mesh(prev: dict, cur: dict, threshold: float,
             "warnings": warnings}
 
 
+#: every active gate family: (name, record glob, what it enforces) —
+#: the --list-gates surface the docs reference. Keep one row per
+#: compare_* section so a new gate family cannot land invisibly.
+GATE_FAMILIES = [
+    ("headline", "bench_r*.json",
+     "pods/sec, p99 latency, variant grid, pack_s growth"),
+    ("explain", "bench_r*.json",
+     "explain_overhead.overhead_frac absolute budget (new record)"),
+    ("retrace", "bench_r*.json",
+     "zero retraces on every warm section (new record)"),
+    ("readback", "bench_r*.json",
+     "readback_s + d2h bytes-per-pod non-regression"),
+    ("churn", "churn_r*.json",
+     "serving p99 + throughput, overload shed rate"),
+    ("recovery", "churn_r*.json",
+     "failover takeover_s + post-recovery p99; double_bind_attempts==0"),
+    ("mesh", "mesh_r*.json",
+     "sharded headline, weak-scaling efficiency, absolute readback "
+     "budget"),
+    ("churn_mesh", "churn_mesh_r*.json",
+     "composed serving-on-mesh: creates/sec + p99, takeover_s, "
+     "shard_heal_s + doorbell gap, double_bind_attempts==0, zero "
+     "retraces, absolute readback budget"),
+]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("records", nargs="*",
@@ -407,7 +553,20 @@ def main(argv=None) -> int:
                          "pack-breakdown ratio check is skipped as noise "
                          "(default 0.005)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-gates", action="store_true",
+                    help="print every active gate family (name, record "
+                         "source, what it enforces) and exit 0")
     args = ap.parse_args(argv)
+
+    if args.list_gates:
+        if args.format == "json":
+            print(json.dumps([
+                {"family": n, "records": g, "enforces": e}
+                for n, g, e in GATE_FAMILIES], indent=1))
+        else:
+            for n, g, e in GATE_FAMILIES:
+                print(f"{n:<12} {g:<22} {e}")
+        return 0
 
     if args.records and len(args.records) != 2:
         print("error: pass exactly two records (OLD NEW) or none",
@@ -456,6 +615,35 @@ def main(argv=None) -> int:
     elif churn_found:
         verdict["warnings"].append(
             "only one churn record — churn gates need two to compare")
+    # composed serving-on-mesh gates (scripts/bench_churn.py --mesh
+    # records) — absence tolerated so benchres directories predating
+    # the composed mode keep passing; one record still enforces the
+    # absolute invariants (double binds, retraces, readback budget)
+    cm_found = find_churn_mesh_records(args.dir)
+    if cm_found:
+        try:
+            cm_prev = load(cm_found[-2]) if len(cm_found) >= 2 else {}
+            cm_cur = load(cm_found[-1])
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot load churn_mesh records: {e}",
+                  file=sys.stderr)
+            return 2
+        cmv = compare_churn_mesh(cm_prev, cm_cur, args.threshold,
+                                 args.mesh_readback_budget)
+        if len(cm_found) < 2:
+            verdict["warnings"].append(
+                "only one churn_mesh record — delta gates need two to "
+                "compare (the absolute invariants still apply)")
+            # with no prev record only the absolute rows are real
+            cmv["checks"] = [r for r in cmv["checks"]
+                             if r["prev"] is None]
+            cmv["regressions"] = [r for r in cmv["checks"]
+                                  if r["regressed"]]
+        verdict["checks"].extend(cmv["checks"])
+        verdict["regressions"].extend(cmv["regressions"])
+        verdict["warnings"].extend(cmv["warnings"])
+        verdict["churn_mesh_records"] = [
+            os.path.relpath(p, REPO_ROOT) for p in cm_found[-2:]]
     # sharded-backend gates (scripts/bench_mesh_scale.py records) —
     # absence tolerated so pre-mesh benchres directories keep passing
     mesh_found = find_mesh_records(args.dir)
@@ -491,7 +679,8 @@ def main(argv=None) -> int:
             [r for r in keep if r["regressed"]])
         verdict["mesh_records"] = [
             os.path.relpath(mesh_found[-1], REPO_ROOT)]
-    if prev_path is None and len(churn_found) < 2 and not mesh_found:
+    if prev_path is None and len(churn_found) < 2 and not mesh_found \
+            and not cm_found:
         msg = (f"not enough records in {args.dir} — nothing to gate")
         if args.format == "json":
             print(json.dumps({"status": "skipped", "reason": msg}))

@@ -465,6 +465,86 @@ def test_watch_hub_bounded_buffer_evicts_slow_watcher():
     assert again.poll() == [("ADDED", 5)]
 
 
+def test_watch_hub_eviction_accounting_and_reasons():
+    """Eviction is never a silent drop (the takeover satellite): the
+    buffered events an eviction discards are COUNTED (per watcher and
+    hub-wide), and the WatcherGone message names the eviction's actual
+    reason plus the relist hint — an overflow reads differently from a
+    takeover relist."""
+    hub = WatchHub(buffer=2)
+    slow = hub.register()
+    for i in range(3):
+        hub.publish(("ADDED", i))  # third publish overflows slow
+    with pytest.raises(WatcherGone) as ei:
+        slow.poll()
+    msg = str(ei.value)
+    assert "send buffer overflowed" in msg and "relist" in msg
+    assert "2 buffered events dropped" in msg  # the cleared buffer
+    assert slow.dropped == 2
+    st = hub.stats()
+    assert st["events_dropped"] == 2 and st["evicted"] == 1
+    # takeover relist: evict_all carries ITS reason into the 410
+    w = hub.register()
+    hub.publish(("ADDED", 9))
+    assert hub.evict_all("leadership change (takeover): relist") == 1
+    with pytest.raises(WatcherGone) as ei:
+        w.poll()
+    assert "leadership change (takeover)" in str(ei.value)
+    assert "relist" in str(ei.value)
+    assert hub.stats()["events_dropped"] == 3
+
+
+def test_watch_hub_eviction_races_concurrent_takeover_drain():
+    """The race the satellite pins: watchers drained by consumer
+    threads WHILE the standby's takeover reconciliation broadcasts the
+    relist eviction (evict_all). No interleaving may end with a
+    watcher that neither saw WatcherGone nor kept its events: every
+    published event is either delivered or counted dropped, and every
+    watcher observes the sticky Gone with the relist hint."""
+    hub = WatchHub(buffer=10_000)
+    n_watchers, n_events = 8, 400
+    watchers = [hub.register() for _ in range(n_watchers)]
+    delivered = [0] * n_watchers
+    gone_msgs: list = [None] * n_watchers
+    start = threading.Barrier(n_watchers + 2)
+
+    def consume(i):
+        start.wait()
+        while True:
+            try:
+                delivered[i] += len(watchers[i].poll())
+            except WatcherGone as e:
+                gone_msgs[i] = str(e)
+                return
+
+    def publish():
+        start.wait()
+        for k in range(n_events):
+            hub.publish(("BOUND", k))
+
+    threads = [threading.Thread(target=consume, args=(i,))
+               for i in range(n_watchers)]
+    threads.append(threading.Thread(target=publish))
+    for t in threads:
+        t.start()
+    start.wait()  # everyone running: reconcile fires mid-stream
+    hub.evict_all("takeover: relist")
+    for t in threads:
+        t.join(timeout=10)
+    assert all(not t.is_alive() for t in threads)
+    # every watcher got the sticky Gone with the relist hint — none
+    # ended as a silent empty stream
+    assert all(m is not None and "takeover: relist" in m
+               for m in gone_msgs)
+    # accounting closes: per watcher, delivered + dropped == what the
+    # hub appended to its buffer before the eviction cut it off
+    st = hub.stats()
+    for i, w in enumerate(watchers):
+        assert delivered[i] + w.dropped <= n_events
+    assert st["events_dropped"] == sum(w.dropped for w in watchers)
+    assert st["evicted"] == n_watchers
+
+
 def test_rest_watch_drain_bound_evicts_lagging_watcher():
     from kubernetes_tpu.restapi import RestServer
     from kubernetes_tpu.sim import HollowCluster

@@ -1,0 +1,477 @@
+"""The composed production posture, tier-1: ServingLoop driving the
+node-sharded mesh backend through churn + one takeover + one shard
+loss, all on a fake clock (no real sleeps — the loop is driven by
+run_once with an immediate-flush window).
+
+What the smoke pins (the ISSUE's composed-path test satellite):
+
+- zero double binds across the leader kill (a CAS'd shared truth
+  raises on any second bind of the same key);
+- zero retraces after warmup, INCLUDING the host-mode cycles inside
+  the shard-loss cooloff (warmup.host_fallback pre-compiles the
+  single-device signatures) and the standby's post-takeover cycles;
+- sharded-vs-single bind parity: the same churn schedule replayed on
+  a mesh-off scheduler produces the identical pod -> node map;
+- the takeover re-places the resident snapshot SHARDED and the shard
+  loss heals back to sharded after the cooloff.
+
+Satellites pinned alongside: the APF saturation probe rides
+Scheduler.backend_pressure (ladder tier + queue depth, not bare queue
+length), the composed runtime adapts the warmup grid (min bucket 8 +
+host-fallback under a mesh), takeover relists the watch hub, and the
+bench_compare churn_mesh gate family + --list-gates contracts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from kubernetes_tpu.chaos import MeshChaos
+from kubernetes_tpu.config import (
+    LeaderElectionConfig,
+    ParallelConfig,
+    RecoveryConfig,
+    ServingConfig,
+    WarmupConfig,
+)
+from kubernetes_tpu.leaderelection import InMemoryLock, LeaderElector
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.serving import RequestRejected, ServingRuntime
+from kubernetes_tpu.testing import make_node, make_pod
+
+POD_CPU = 50.0
+POD_MEM = 128 * 2**20
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class Truth:
+    """CAS'd shared bind truth (the hub's Binding subresource,
+    miniaturized): a second bind of the same key raises, so
+    ``double_bind_attempts == 0`` IS the invariant, measured."""
+
+    def __init__(self) -> None:
+        self.bound: dict = {}
+        self.created: dict = {}
+        self.double_bind_attempts = 0
+
+    def binder(self):
+        truth = self
+
+        class _B:
+            def bind(self, pod, node_name):
+                if pod.key() in truth.bound:
+                    truth.double_bind_attempts += 1
+                    raise RuntimeError(f"{pod.key()} double bind")
+                truth.bound[pod.key()] = node_name
+
+        return _B()
+
+    def lister(self):
+        """Relist source for takeover reconciliation: every created
+        pod, with its committed node when bound."""
+        out = []
+        for key, pod in self.created.items():
+            node = self.bound.get(key, "")
+            out.append(dataclasses.replace(pod, node_name=node))
+        return out
+
+
+def _replica(mesh, clk, truth, nodes=8):
+    s = Scheduler(
+        clock=clk,
+        enable_preemption=False,
+        binder=truth.binder(),
+        parallel=ParallelConfig(mesh=mesh),
+        recovery=RecoveryConfig(device_reset_limit=1, device_cooloff_s=5.0),
+        warmup=WarmupConfig(enabled=True, pod_buckets=(8, 16)),
+    )
+    for i in range(nodes):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=64000,
+                                memory=256 * 2**30, pods=500))
+    # max_wait 0: every observe with pending depth flushes immediately,
+    # so run_once never parks on the (real-time) doorbell
+    rt = ServingRuntime(
+        s, ServingConfig(enabled=True, min_wait_s=0.0, max_wait_s=0.0,
+                         target_bucket=16, idle_wait_s=0.05),
+        clock=clk)
+    compiled = rt.warm_if_pending(
+        sample_pods=[make_pod("warm", cpu_milli=POD_CPU, memory=POD_MEM)])
+    assert compiled > 0
+    return rt
+
+
+@pytest.mark.parametrize("mesh", [2, 4])
+def test_composed_churn_takeover_shard_loss_smoke(mesh):
+    clk = FakeClock()
+    truth = Truth()
+    le = LeaderElectionConfig(lease_duration_s=2.0, renew_deadline_s=1.4,
+                              retry_period_s=0.3)
+    lock = InMemoryLock()
+
+    a = _replica(mesh, clk, truth)
+    b = _replica(mesh, clk, truth)
+    ea = LeaderElector("a", lock, le, clk)
+    eb = LeaderElector("b", lock, le, clk)
+    a.attach_elector(ea, lister=truth.lister)
+    b.attach_elector(eb, lister=truth.lister)
+    # a couple of standby-side watchers: the takeover must relist them
+    b_watchers = [b.hub.register() for _ in range(2)]
+
+    with a.loop.lock:
+        assert ea.tick()  # 'a' leads
+
+    seq = 0
+
+    def churn(rt, n_pods, peer=None):
+        """One deterministic churn step: ingest n_pods creates, tick
+        the elector under the ingest lock (the PR-8 serialization),
+        flush one micro-batch, fan binds out to the peer's informer."""
+        nonlocal seq
+        batch = []
+        for _ in range(n_pods):
+            p = make_pod(f"c{seq}", cpu_milli=POD_CPU, memory=POD_MEM)
+            truth.created[p.key()] = p
+            seq += 1
+            batch.append(p)
+        for rep in (rt, peer) if peer is not None else (rt,):
+            for p in batch:
+                rep.loop.ingest(rep.sched.on_pod_add, p)
+        res = rt.loop.run_once()
+        assert res is not None and res.scheduled == n_pods
+        if peer is not None:
+            for key, node in res.assignments.items():
+                old = truth.created[key]
+                peer.loop.ingest(peer.sched.on_pod_update, old,
+                                 dataclasses.replace(old, node_name=node))
+        clk.advance(0.25)
+        return res
+
+    # -- phase 1: churn on the leader, standby fed by informer ----------
+    for n in (3, 5, 8, 2):
+        with a.loop.lock:
+            assert ea.tick()
+        churn(a, n, peer=b)
+
+    # -- phase 2: kill the leader; the standby takes over ONTO the mesh
+    evicted_before = b.hub.stats()["evicted"]
+    clk.advance(3.0)  # past the lease decay (no graceful release)
+    # the standby must OBSERVE the stale record for a lease duration
+    # before stealing (leaderelection.go semantics) — tick through it
+    acquired = False
+    for _ in range(30):
+        with b.loop.lock:
+            if eb.tick():  # acquires + reconciles against the relist
+                acquired = True
+                break
+        clk.advance(le.retry_period_s)
+    assert acquired
+    assert b.sched.metrics.recovery_takeovers.value() >= 1
+    # takeover relisted the standby's watchers (410 + relist, satellite)
+    assert b.hub.stats()["evicted"] >= evicted_before + len(b_watchers)
+    # resident snapshot re-placed SHARDED by the takeover rebuild
+    _, dev, mode = b.sched.cache.device_snapshot()
+    assert mode in ("full", "clean")
+    assert int(dev.allocatable.sharding.mesh.devices.size) == mesh
+    for n in (4, 8):
+        with b.loop.lock:
+            assert eb.tick()
+        churn(b, n)
+
+    # -- phase 3: lose one mesh shard mid-churn --------------------------
+    chaos = MeshChaos(b.sched, shard=1)
+    chaos.lose_shard(clk())
+    with b.loop.lock:
+        assert eb.tick()
+    res = churn(b, 6)
+    chaos.observe(res, clk())
+    assert res.snapshot_mode == "host"  # cooloff: single-device cycles
+    assert res.scheduled == 6  # ...that still bind (no doorbell stall)
+    clk.advance(6.0)  # past device_cooloff_s: the heal probe fires
+    with b.loop.lock:
+        assert eb.tick()
+    res = churn(b, 5)
+    chaos.observe(res, clk())
+    assert res.snapshot_mode == "full"  # healed: resident re-placed
+    rep = chaos.report()
+    assert rep["healed_sharded"] and rep["host_mode_cycles"] == 1
+    _, dev, _ = b.sched.cache.device_snapshot()
+    assert int(dev.allocatable.sharding.mesh.devices.size) == mesh
+
+    # -- the invariant triple, composed ----------------------------------
+    assert truth.double_bind_attempts == 0
+    assert set(truth.bound) == set(truth.created)
+    # zero retraces after warmup — across the takeover AND the
+    # host-mode cooloff (the host-fallback warmup's whole point)
+    assert a.sched.obs.jax.retrace_total() == 0
+    assert b.sched.obs.jax.retrace_total() == 0
+
+    # -- sharded-vs-single bind parity ------------------------------------
+    single_truth = Truth()
+    s = Scheduler(clock=FakeClock(), enable_preemption=False,
+                  binder=single_truth.binder(),
+                  warmup=WarmupConfig(enabled=True, pod_buckets=(8, 16)))
+    for i in range(8):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=64000,
+                                memory=256 * 2**30, pods=500))
+    # replay the identical batch schedule (same pod names, same batch
+    # boundaries — takeover and shard loss included, since neither
+    # changed WHICH pods a batch carried)
+    replay = iter(sorted(truth.created, key=lambda k: int(
+        truth.created[k].name[1:])))
+    for n in (3, 5, 8, 2, 4, 8, 6, 5):
+        for _ in range(n):
+            key = next(replay)
+            s.on_pod_add(truth.created[key])
+        r = s.schedule_cycle()
+        assert r.scheduled == n
+    assert single_truth.bound == truth.bound
+
+
+# ---------------------------------------------------------------------------
+# satellite: APF shedding from the scheduler's ACTUAL state
+# ---------------------------------------------------------------------------
+
+
+def test_backend_pressure_reads_ladder_and_queue():
+    clk = FakeClock()
+    s = Scheduler(clock=clk, enable_preemption=False)
+    s.on_node_add(make_node("n0"))
+    for i in range(6):
+        s.queue.add(make_pod(f"p{i}", cpu_milli=10))
+    # healthy: pressure == active depth
+    assert s.backend_pressure() == 6.0
+    # degraded via the ladder: the last cycle FELL THROUGH to a
+    # fallback rung (the count is the signal, not the tier name — the
+    # exact solver's deliberate hazard routing must stay healthy)
+    s.last_solver_tier = "greedy"
+    s.last_solver_fallbacks = 2
+    assert s.is_degraded()
+    assert s.backend_pressure(degraded_factor=4.0) == 24.0
+    s.last_solver_tier = "batch"  # e.g. solver='exact' hazard routing:
+    s.last_solver_fallbacks = 0   # a different tier, ZERO fallbacks
+    assert not s.is_degraded()
+    # degraded via device cooloff (the shard-loss window)
+    s._device_cooloff_until = clk() + 10
+    assert s.is_degraded()
+    assert s.backend_pressure(degraded_factor=10.0) == 60.0
+
+
+def test_serving_runtime_wires_saturation_to_backend_pressure():
+    """Regression pin for the satellite: the composed runtime's
+    mutating flow sheds from Scheduler.backend_pressure — queue depth
+    AND degradation — not from queue length alone."""
+    clk = FakeClock()
+    s = Scheduler(clock=clk, enable_preemption=False)
+    s.on_node_add(make_node("n0"))
+    rt = ServingRuntime(
+        s, ServingConfig(enabled=True, target_bucket=16,
+                         shed_queue_bound=8,
+                         degraded_pressure_factor=10.0),
+        clock=clk)
+    assert rt.shed_bound() == 8
+    # below the bound, healthy: admitted
+    for i in range(4):
+        s.queue.add(make_pod(f"q{i}", cpu_milli=10))
+    rt.flow.release(rt.flow.acquire("mutating"))
+    # same depth, DEGRADED backend: 4 * 10 > 8 -> shed with 429
+    s._device_cooloff_until = clk() + 60
+    with pytest.raises(RequestRejected):
+        rt.flow.acquire("mutating")
+    # healed: admitted again at the same queue depth
+    s._device_cooloff_until = 0.0
+    rt.flow.release(rt.flow.acquire("mutating"))
+    # healthy but PAST the bound on raw depth: shed
+    for i in range(8):
+        s.queue.add(make_pod(f"r{i}", cpu_milli=10))
+    with pytest.raises(RequestRejected):
+        rt.flow.acquire("mutating")
+
+
+def test_runtime_auto_shed_bound_and_warmup_adaptation():
+    """The composed runtime adapts the warmup grid: serving extends it
+    down to the micro-batch floor, and a mesh-backed scheduler gains
+    the host-fallback sweep (shard loss must not compile on the hot
+    path). Auto shed bound = two accumulation targets."""
+    s = Scheduler(clock=FakeClock(), enable_preemption=False,
+                  parallel=ParallelConfig(mesh=2),
+                  warmup=WarmupConfig(enabled=True))
+    rt = ServingRuntime(
+        s, ServingConfig(enabled=True, target_bucket=64), clock=FakeClock())
+    assert s.warmup_config.min_bucket == 8
+    assert s.warmup_config.host_fallback is True
+    assert rt.shed_bound() == 128
+
+
+def test_host_fallback_warmup_covers_cooloff_cycles():
+    """Direct pin of the warmup satellite mechanics: with
+    host_fallback on, a device-loss cooloff cycle solves on
+    PRE-REGISTERED single-device signatures — zero retraces; the same
+    scenario without host_fallback recompiles (the gap the flag
+    closes)."""
+    from kubernetes_tpu.faults import FaultInjector
+
+    def run(host_fallback):
+        fi = FaultInjector(seed=0)
+        clk = FakeClock()
+        s = Scheduler(clock=clk, enable_preemption=False,
+                      fault_injector=fi,
+                      parallel=ParallelConfig(mesh=4),
+                      recovery=RecoveryConfig(device_reset_limit=1,
+                                              device_cooloff_s=5.0),
+                      warmup=WarmupConfig(enabled=True, pod_buckets=(8,),
+                                          host_fallback=host_fallback))
+        s.on_node_add(make_node("n0", cpu_milli=64000, pods=200))
+        s.warmup(sample_pods=[make_pod("w", cpu_milli=10)])
+        # arm AFTER the warmup — the loss must land on the hot path
+        fi.arm("snapshot:device", "shard_lost", count=2)
+        s.on_pod_add(make_pod("p0", cpu_milli=10))
+        res = s.schedule_cycle()  # shard lost -> host-mode cycle
+        assert res.snapshot_mode == "host" and res.scheduled == 1
+        return s.obs.jax.retrace_total()
+
+    assert run(host_fallback=True) == 0
+    assert run(host_fallback=False) > 0
+
+
+def test_shard_lost_carries_mesh_index():
+    """A shard_lost rule's armed index rides the raised ShardLost —
+    the chaos reports name the actual lost device, not a constant 0."""
+    from kubernetes_tpu.faults import FaultInjector, ShardLost
+
+    fi = FaultInjector().arm("snapshot:device", "shard_lost", count=1,
+                             shard=3)
+    with pytest.raises(ShardLost) as ei:
+        fi.device_hook("snapshot:device")
+    assert ei.value.shard == 3
+    assert fi.device_hook("snapshot:device") is None  # shot spent
+
+
+def test_warmup_host_fallback_config_round_trips():
+    from kubernetes_tpu.api.config_v1alpha1 import decode, encode
+    from kubernetes_tpu.cli import decode_config, validate_config
+
+    cfg = decode_config({
+        "warmup": {"enabled": True, "host_fallback": True},
+        "serving": {"enabled": True, "shed_queue_bound": 32,
+                    "degraded_pressure_factor": 2.5},
+    })
+    assert cfg.warmup.host_fallback is True
+    assert cfg.serving.shed_queue_bound == 32
+    assert cfg.serving.degraded_pressure_factor == 2.5
+    assert validate_config(cfg) == []
+    # versioned round trip
+    doc = encode(cfg)
+    assert doc["warmup"]["hostFallback"] is True
+    assert doc["serving"]["shedQueueBound"] == 32
+    assert doc["serving"]["degradedPressureFactor"] == 2.5
+    back = decode(doc)
+    assert back.warmup == cfg.warmup
+    assert back.serving == cfg.serving
+    # validation gates
+    bad = decode_config({"serving": {"shed_queue_bound": -1}})
+    assert any("shedQueueBound" in e for e in validate_config(bad))
+    bad = decode_config({"serving": {"degraded_pressure_factor": 0.5}})
+    assert any("degradedPressureFactor" in e for e in validate_config(bad))
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: churn_mesh gate family + --list-gates contracts
+# ---------------------------------------------------------------------------
+
+
+def _load_bench_compare():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "bench_compare.py")
+    spec = importlib.util.spec_from_file_location("bench_compare_cm", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _cm_record(creates=150.0, p99=0.1, takeover=2.0, heal=2.5, gap=0.5,
+               db=0, retraces=0, bpp=4.5):
+    return {
+        "name": "churn_mesh",
+        "arms": {
+            "serving": {"creates_per_sec": creates, "p99_s": p99,
+                        "readback_bytes_per_pod": bpp,
+                        "jax": {"retraces": retraces}},
+            "failover": {"takeover_s": takeover,
+                         "double_bind_attempts": db},
+            "shard_loss": {"shard_heal_s": heal,
+                           "doorbell_max_gap_s": gap,
+                           "jax": {"retraces": 0}},
+        },
+    }
+
+
+def test_bench_compare_churn_mesh_gates():
+    bc = _load_bench_compare()
+    ok = bc.compare_churn_mesh(_cm_record(), _cm_record(), 0.10)
+    assert not ok["regressions"]
+    # throughput drop, p99 growth, slower heal -> regressions
+    bad = bc.compare_churn_mesh(
+        _cm_record(),
+        _cm_record(creates=100.0, p99=0.2, heal=5.0), 0.10)
+    names = {r["check"] for r in bad["regressions"]}
+    assert "churn_mesh.serving.creates_per_sec" in names
+    assert "churn_mesh.serving.p99_s" in names
+    assert "churn_mesh.shard_loss.shard_heal_s" in names
+    # absolute invariants on the NEW record alone
+    bad = bc.compare_churn_mesh(_cm_record(),
+                                _cm_record(db=1, retraces=2, bpp=40.0),
+                                0.10)
+    names = {r["check"] for r in bad["regressions"]}
+    assert "churn_mesh.failover.double_bind_attempts" in names
+    assert "churn_mesh.serving.jax.retraces" in names
+    assert "churn_mesh.serving.readback_budget" in names
+    # absence tolerated: an old record without the arms warns, never fails
+    ok = bc.compare_churn_mesh({}, _cm_record(), 0.10)
+    assert not ok["regressions"] and ok["warnings"]
+
+
+def test_bench_compare_picks_up_churn_mesh_records(tmp_path, capsys):
+    bc = _load_bench_compare()
+    for i, heal in ((1, 2.0), (2, 2.1)):
+        (tmp_path / f"churn_mesh_r0{i}.json").write_text(
+            json.dumps(_cm_record(heal=heal)))
+    rc = bc.main(["--dir", str(tmp_path), "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["status"] == "ok"
+    assert any(c["check"].startswith("churn_mesh.")
+               for c in out["checks"])
+    assert out["churn_mesh_records"]
+    # a single record still enforces the absolute invariants
+    (tmp_path / "churn_mesh_r02.json").unlink()
+    (tmp_path / "churn_mesh_r01.json").write_text(
+        json.dumps(_cm_record(db=3)))
+    rc = bc.main(["--dir", str(tmp_path), "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(c["check"] == "churn_mesh.failover.double_bind_attempts"
+               for c in out["regressions"])
+
+
+def test_bench_compare_list_gates_names_every_family(capsys):
+    bc = _load_bench_compare()
+    assert bc.main(["--list-gates"]) == 0
+    out = capsys.readouterr().out
+    for family in ("headline", "explain", "retrace", "readback",
+                   "churn", "recovery", "mesh", "churn_mesh"):
+        assert family in out
