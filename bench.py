@@ -392,33 +392,16 @@ def init_platform(timeout_s: float = 240.0) -> str:
 
 
 def node_resources_score(alloc, requested, assigned):
-    """Aggregate NodeResources score of a solution: mean over PLACED pods
-    of their node's LeastRequested + BalancedResourceAllocation score at
-    the FINAL usage state (same rule for every solver, so solutions are
-    comparable). Mirrors resource_allocation.go:39 arithmetic:
-    LeastRequested = ((cap-req)*10/cap averaged over cpu,mem);
-    Balanced = 10 - |cpuFrac - memFrac|*10."""
-    import numpy as np
+    """Aggregate NodeResources score of a solution — DELEGATES to the
+    one source of truth in ``kubernetes_tpu.scenarios.quality`` (the
+    scenario-pack PR moved the arithmetic there so this bench and
+    ``scripts/sinkhorn_quality.py`` can never drift apart on what
+    ``mean_score``/``balanced`` mean)."""
+    from kubernetes_tpu.scenarios.quality import (
+        node_resources_score as _shared,
+    )
 
-    from kubernetes_tpu.snapshot import RES_CPU, RES_MEM
-
-    alloc = np.asarray(alloc, np.float64)
-    req = np.asarray(requested, np.float64)
-    placed = assigned[assigned >= 0]
-    if placed.size == 0:
-        return {"mean_score": 0.0, "least_requested": 0.0, "balanced": 0.0}
-    cap_cpu = np.maximum(alloc[:, RES_CPU], 1e-9)
-    cap_mem = np.maximum(alloc[:, RES_MEM], 1e-9)
-    fr_cpu = np.clip(req[:, RES_CPU] / cap_cpu, 0.0, 1.0)
-    fr_mem = np.clip(req[:, RES_MEM] / cap_mem, 0.0, 1.0)
-    lr = ((1.0 - fr_cpu) * 10.0 + (1.0 - fr_mem) * 10.0) / 2.0
-    ba = 10.0 - np.abs(fr_cpu - fr_mem) * 10.0
-    per_node = lr + ba
-    return {
-        "mean_score": round(float(per_node[placed].mean()), 4),
-        "least_requested": round(float(lr[placed].mean()), 4),
-        "balanced": round(float(ba[placed].mean()), 4),
-    }
+    return _shared(alloc, requested, assigned)
 
 
 class ShardedWorkload:

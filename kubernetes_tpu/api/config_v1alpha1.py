@@ -182,6 +182,21 @@ class ParallelConfigurationV1alpha1:
 
 
 @dataclass
+class ScenarioConfigurationV1alpha1:
+    """Versioned spelling of the scenario-pack block
+    (config.ScenarioConfig): camelCase; the pack vocabulary is the
+    internal one (no duration fields to re-spell)."""
+
+    pack: Optional[str] = None
+    costWeight: Optional[float] = None
+    fillBlock: Optional[int] = None
+    preemptInBatch: Optional[bool] = None
+    cascadeMaxPods: Optional[int] = None
+    superpod: Optional[int] = None
+    quality: Optional[bool] = None
+
+
+@dataclass
 class ServingConfigurationV1alpha1:
     """Versioned spelling of the streaming-serving block
     (config.ServingConfig): camelCase, windows as metav1.Duration
@@ -242,6 +257,8 @@ class KubeSchedulerConfigurationV1alpha1:
         default_factory=ServingConfigurationV1alpha1)
     parallel: "ParallelConfigurationV1alpha1" = field(
         default_factory=ParallelConfigurationV1alpha1)
+    scenario: "ScenarioConfigurationV1alpha1" = field(
+        default_factory=ScenarioConfigurationV1alpha1)
 
 
 # -- defaulting (v1alpha1/defaults.go:42) -----------------------------------
@@ -390,6 +407,21 @@ def set_defaults_kube_scheduler_configuration(
     pl = obj.parallel
     if pl.mesh is None:
         pl.mesh = "off"
+    sn = obj.scenario
+    if sn.pack is None:
+        sn.pack = ""
+    if sn.costWeight is None:
+        sn.costWeight = 4.0
+    if sn.fillBlock is None:
+        sn.fillBlock = 64
+    if sn.preemptInBatch is None:
+        sn.preemptInBatch = True
+    if sn.cascadeMaxPods is None:
+        sn.cascadeMaxPods = 1024
+    if sn.superpod is None:
+        sn.superpod = 4
+    if sn.quality is None:
+        sn.quality = True
     return obj
 
 
@@ -499,6 +531,26 @@ def _to_internal(v: KubeSchedulerConfigurationV1alpha1) -> KubeSchedulerConfigur
         observability=_observability_to_internal(v.observability),
         serving=_serving_to_internal(v.serving),
         parallel=_parallel_to_internal(v.parallel),
+        scenario=_scenario_to_internal(v.scenario),
+    )
+
+
+def _scenario_to_internal(sn: ScenarioConfigurationV1alpha1):
+    from kubernetes_tpu.config import ScenarioConfig
+
+    if not isinstance(sn.pack, str):
+        raise SchemeError([
+            f"scenario.pack: invalid value {sn.pack!r}: expected a pack "
+            "name string ('' = off)"
+        ])
+    return ScenarioConfig(
+        pack=sn.pack,
+        cost_weight=sn.costWeight,
+        fill_block=sn.fillBlock,
+        preempt_in_batch=sn.preemptInBatch,
+        cascade_max_pods=sn.cascadeMaxPods,
+        superpod=sn.superpod,
+        quality=sn.quality,
     )
 
 
@@ -709,6 +761,15 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
             degradedPressureFactor=c.serving.degraded_pressure_factor,
         ),
         parallel=ParallelConfigurationV1alpha1(mesh=c.parallel.mesh),
+        scenario=ScenarioConfigurationV1alpha1(
+            pack=c.scenario.pack,
+            costWeight=c.scenario.cost_weight,
+            fillBlock=c.scenario.fill_block,
+            preemptInBatch=c.scenario.preempt_in_batch,
+            cascadeMaxPods=c.scenario.cascade_max_pods,
+            superpod=c.scenario.superpod,
+            quality=c.scenario.quality,
+        ),
     )
 
 

@@ -36,6 +36,7 @@ from kubernetes_tpu.config import (
     ParallelConfig,
     RecoveryConfig,
     RobustnessConfig,
+    ScenarioConfig,
     ServingConfig,
     WarmupConfig,
     load_policy,
@@ -211,6 +212,23 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         errs.append(
             f"parallel.mesh: Invalid value {mesh}: a device count must "
             "divide the power-of-two node buckets — use a power of two")
+    sn = cfg.scenario
+    if sn.pack:
+        from kubernetes_tpu.scenarios import SCENARIO_REGISTRY
+
+        if sn.pack not in SCENARIO_REGISTRY:
+            errs.append(
+                f"scenario.pack: Unsupported value {sn.pack!r}: "
+                f"supported values: '', "
+                f"{', '.join(sorted(SCENARIO_REGISTRY))}")
+    if sn.cost_weight < 0:
+        errs.append("scenario.costWeight: must be non-negative")
+    if sn.fill_block < 1:
+        errs.append("scenario.fillBlock: must be at least 1")
+    if sn.cascade_max_pods < 1:
+        errs.append("scenario.cascadeMaxPods: must be at least 1")
+    if sn.superpod < 1:
+        errs.append("scenario.superpod: must be at least 1")
     # unknown feature gates are rejected earlier, at FeatureGates
     # construction (featuregate.Set errors on unknown names)
     return errs
@@ -224,6 +242,7 @@ _OBS_FIELDS = {f.name for f in dataclasses.fields(ObservabilityConfig)}
 _WARMUP_FIELDS = {f.name for f in dataclasses.fields(WarmupConfig)}
 _SERVING_FIELDS = {f.name for f in dataclasses.fields(ServingConfig)}
 _PAR_FIELDS = {f.name for f in dataclasses.fields(ParallelConfig)}
+_SCN_FIELDS = {f.name for f in dataclasses.fields(ScenarioConfig)}
 
 
 def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
@@ -338,6 +357,15 @@ def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
                 errs.append(f"parallel: unknown field(s) {sorted(unknown)}")
                 continue
             kw["parallel"] = ParallelConfig(**val)
+        elif key == "scenario":
+            if not isinstance(val, dict):
+                errs.append("scenario: expected a mapping")
+                continue
+            unknown = set(val) - _SCN_FIELDS
+            if unknown:
+                errs.append(f"scenario: unknown field(s) {sorted(unknown)}")
+                continue
+            kw["scenario"] = ScenarioConfig(**val)
         elif key == "policy":
             kw["policy"] = load_policy(val)
         elif key in _CONFIG_FIELDS:
@@ -405,6 +433,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", default=None,
                    help="sharded execution backend: off | auto | N "
                         "(1-D device mesh over the node axis)")
+    p.add_argument("--scenario", default=None,
+                   help="scenario pack: consolidation | gang-topology "
+                        "(pluggable solve objective + quality scores; "
+                        "empty string turns the pack off)")
     p.add_argument("--percentage-of-nodes-to-score", type=int, default=None)
     p.add_argument("--leader-elect", default=None, choices=("true", "false"))
     p.add_argument("--lock-file", default=None,
@@ -461,6 +493,9 @@ def resolve_config(args) -> KubeSchedulerConfiguration:
             except ValueError:
                 pass  # validate_config rejects with the field path
         overlay["parallel"] = dataclasses.replace(cfg.parallel, mesh=spec)
+    if getattr(args, "scenario", None) is not None:
+        overlay["scenario"] = dataclasses.replace(
+            cfg.scenario, pack=args.scenario)
     serving_overlay = {}
     if getattr(args, "serving", None) is not None:
         serving_overlay["enabled"] = args.serving == "true"

@@ -267,6 +267,38 @@ class ParallelConfig:
 
 
 @dataclass
+class ScenarioConfig:
+    """Scenario packs (kubernetes_tpu/scenarios): swap the solve
+    objective for a paper workload — constraint-based consolidation
+    packing ("Priority Matters") or topology-aware DL gangs (Tesserae)
+    — with device-computed placement-quality scores riding the cycle's
+    existing readback (docs/scenarios.md)."""
+
+    #: "" = scenario mode off (the stock spreading objective);
+    #: "consolidation" | "gang-topology" select a pack
+    pack: str = ""
+    #: weight of the pack's extra (P, N) cost term (consolidation's
+    #: occupied-node bias; the gang pack's points per ICI hop saved)
+    cost_weight: float = 4.0
+    #: consolidation only: nodes per fill block of the blocked
+    #: fill-order tie-break (ties persist within a block so a round
+    #: still admits ~fill_block * perNodeCap pods; smaller packs
+    #: tighter, larger solves in fewer rounds)
+    fill_block: int = 64
+    #: consolidation only: solve priority-aware preemption cascades
+    #: IN-BATCH — victims and displaced pods re-enter one dense solve in
+    #: the same cycle instead of the per-pod nominate-and-wait loop
+    preempt_in_batch: bool = True
+    #: cap on preemptors + displaced pods entering one cascade re-solve
+    cascade_max_pods: int = 1024
+    #: gang pack only: consecutive slice (zone) indices per superpod —
+    #: the middle tier of the hierarchical ICI distance
+    superpod: int = 4
+    #: compute + read back the per-cycle placement-quality vector
+    quality: bool = True
+
+
+@dataclass
 class ServingConfig:
     """Streaming serving mode (kubernetes_tpu/serving): the event-driven
     micro-batch loop that replaces the fixed ``--cycle-interval`` sleep,
@@ -382,6 +414,8 @@ class KubeSchedulerConfiguration:
     serving: ServingConfig = field(default_factory=ServingConfig)
     #: sharded execution backend (node-axis device mesh)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    #: scenario packs (pluggable solve objective + quality scores)
+    scenario: ScenarioConfig = field(default_factory=ScenarioConfig)
 
 
 # ---------------------------------------------------------------------------

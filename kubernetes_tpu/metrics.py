@@ -30,7 +30,11 @@ the streaming-serving block (``kubernetes_tpu/serving``) —
 and ``scheduler_watch_evictions_total``; plus the crash/failover
 recovery block — ``scheduler_recovery_*_total`` (takeovers, adopted /
 forgotten / requeued / drained pods, fenced binds, device resets) and
-``scheduler_cache_expired_assumptions_total``. Note
+``scheduler_cache_expired_assumptions_total``; plus the scenario-pack
+block (``kubernetes_tpu/scenarios``) —
+``scheduler_scenario_quality{score}`` placement-quality gauges and the
+in-batch preemption-cascade counters
+``scheduler_scenario_{cascade_victims,displaced_replaced}_total``. Note
 ``scheduler_e2e_scheduling_duration_seconds`` observes PER-POD
 create-to-bind latency (queue-add stamp to bind) since the serving PR,
 matching the reference's per-pod scheduleOne observation.
@@ -455,6 +459,25 @@ class SchedulerMetrics:
             "scheduler_mesh_devices",
             "Devices in the node-axis mesh of the sharded execution "
             "backend (parallel.mesh config; 0 = single-device mode).",
+        ))
+        # -- scenario packs (kubernetes_tpu/scenarios) ------------------
+        self.scenario_quality = r.register(Gauge(
+            "scheduler_scenario_quality",
+            "Last cycle's placement-quality scores under the active "
+            "scenario pack (nodes_used, headroom, fragmentation, "
+            "gang_success_rate, ... — docs/scenarios.md quality table).",
+            ["score"],
+        ))
+        self.scenario_cascade_victims = r.register(Counter(
+            "scheduler_scenario_cascade_victims_total",
+            "Victims evicted by the in-batch preemption cascade "
+            "(scenario packs; the per-pod path counts under "
+            "scheduler_preemption_victims_total).",
+        ))
+        self.scenario_displaced_replaced = r.register(Counter(
+            "scheduler_scenario_displaced_replaced_total",
+            "Cascade victims that re-placed onto another node in the "
+            "SAME cycle's dense re-solve (migrated rather than lost).",
         ))
         # -- schedulability explainer (obs/explain.py): the batched
         # why-pending reduction over the (pod x node) failure bitmask ---

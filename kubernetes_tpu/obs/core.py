@@ -189,6 +189,12 @@ class Observability:
         once at end_cycle (the cycle's host boundary)."""
         self._sinkhorn_stats = stats
 
+    def note_scenario(self, scores: dict) -> None:
+        """The cycle's scenario placement-quality scores (already
+        decoded at the host boundary by the driver); land on the flight
+        record as the ``scenario`` block."""
+        self._scratch["scenario"] = dict(scores)
+
     def note_explain(self, report) -> None:
         """Stash the cycle's UnschedulableReport (already decoded at the
         host boundary by the driver); the flight record keeps its top-K
@@ -272,6 +278,7 @@ class Observability:
             device_resets=s.get("device_resets", 0),
             fenced_binds=s.get("fenced_binds", 0),
             mesh=s.get("mesh", self.mesh_devices),
+            scenario=s.get("scenario", {}),
         )
         self.recorder.record(rec)
         self._eventful_seq += 1

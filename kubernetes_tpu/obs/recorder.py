@@ -78,6 +78,9 @@ class CycleRecord:
     #: sharded-backend provenance: node-axis mesh device count the
     #: scheduler ran this cycle under (0 = single-device mode)
     mesh: int = 0
+    #: scenario-pack placement-quality scores for this cycle (empty =
+    #: scenario mode off / quality gated off)
+    scenario: Dict[str, float] = field(default_factory=dict)
 
     def to_json(self) -> dict:
         return {
@@ -115,6 +118,7 @@ class CycleRecord:
             **({"fenced_binds": self.fenced_binds}
                if self.fenced_binds else {}),
             **({"mesh": self.mesh} if self.mesh else {}),
+            **({"scenario": dict(self.scenario)} if self.scenario else {}),
         }
 
 

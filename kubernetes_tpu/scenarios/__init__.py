@@ -1,0 +1,36 @@
+"""Scenario packs: pluggable solve objectives + quality-gated
+placement scores over the dense (P, N) formulation (docs/scenarios.md).
+
+Device cost kernels and the quality reduction live in
+:mod:`kubernetes_tpu.ops.scenario_cost` (graftlint R2/R3/R7
+discipline); this package is the host orchestration: pack definitions
+(packs.py), the in-batch preemption cascade (cascade.py), and the
+quality decode / gang bookkeeping / shared solution scores
+(quality.py)."""
+
+from kubernetes_tpu.scenarios.cascade import CascadeSelection, select_cascade
+from kubernetes_tpu.scenarios.packs import (
+    SCENARIO_REGISTRY,
+    ConsolidationPack,
+    GangTopologyPack,
+    ScenarioPack,
+    resolve_pack,
+)
+from kubernetes_tpu.scenarios.quality import (
+    decode_quality,
+    gang_stats,
+    node_resources_score,
+)
+
+__all__ = [
+    "SCENARIO_REGISTRY",
+    "CascadeSelection",
+    "ConsolidationPack",
+    "GangTopologyPack",
+    "ScenarioPack",
+    "decode_quality",
+    "gang_stats",
+    "node_resources_score",
+    "resolve_pack",
+    "select_cascade",
+]

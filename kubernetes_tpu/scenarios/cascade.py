@@ -1,0 +1,108 @@
+"""In-batch preemption cascade — the scenario-pack replacement for the
+per-pod nominate-and-wait preemption loop.
+
+Division of labor (the parity contract tests/test_scenarios.py pins):
+
+- **victim SELECTION stays exact and shared**: each preemptor runs the
+  reference-faithful machinery from :mod:`kubernetes_tpu.preemption`
+  (candidate pruning by resolvable reason bits, selectVictimsOnNode's
+  reprieve loop, PDB splits, the 6-tier node pick) — one source of
+  truth, so a single-pod batch selects BIT-IDENTICAL victim sets to the
+  stock path by construction. The cascade part: preemptors process in
+  priority order against ONE shared hypothetical state, so an earlier
+  preemptor's evictions are visible to later ones (no double-claiming a
+  victim, no phantom capacity).
+- **re-entry is the dense solve**: instead of nominating each preemptor
+  and parking it for a future cycle while victims terminate one-by-one,
+  the driver evacuates every selected victim (grace 0 — the scenario
+  pack's batch-consolidation semantics), then runs preemptors AND
+  displaced victims through ONE additional dense solve in the SAME
+  cycle (the full ladder: validation, fallback tiers, fused readback).
+  Displaced pods that re-place migrate; those that cannot requeue
+  through the standard error path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.preemption import preempt
+
+
+@dataclass
+class CascadeSelection:
+    """What the shared-state selection pass decided."""
+
+    #: preemptor pod key -> node chosen for it (the evacuated node)
+    chosen: Dict[str, str] = field(default_factory=dict)
+    #: every victim selected across the cascade, in eviction order
+    victims: List[Pod] = field(default_factory=list)
+    #: victim key -> the preemptor key that claimed it
+    victim_of: Dict[str, str] = field(default_factory=dict)
+    #: pods whose lower-priority nominations must clear (stock semantics)
+    clear_nominations: List[Pod] = field(default_factory=list)
+    num_pdb_violations: int = 0
+
+
+def select_cascade(
+    preemptors: List[Tuple[Pod, Dict[str, int]]],
+    nodes,
+    node_pods_of: Dict[str, List[Pod]],
+    pdbs=(),
+    nominated_pods_of: Optional[Dict[str, List[Pod]]] = None,
+    vol_state=None,
+    extenders=(),
+    enable_non_preempting: bool = False,
+    max_preemptions: int = 16,
+    on_attempt=None,
+) -> CascadeSelection:
+    """Run victim selection for every preemptor against one shared
+    state. ``preemptors`` is [(pod, reason_bits_by_node)] already in
+    priority-descending order (the caller sorts — same order the stock
+    loop uses). Selected victims leave the shared ``node_pods_of`` view
+    before the next preemptor runs, which IS the cascade.
+    ``on_attempt`` fires once per pod PROCESSED (after the cap check) —
+    the same accounting the stock per-pod loop gives
+    ``scheduler_preemption_attempts_total``."""
+    sel = CascadeSelection()
+    state = {k: list(v) for k, v in node_pods_of.items()}
+    # the nominated view EVOLVES like the stock loop's (which re-reads
+    # queue.nominated every iteration): each successful preemptor joins
+    # as a phantom occupant of its chosen node, and its cleared
+    # lower-priority nominations leave — otherwise a later preemptor
+    # would see the evacuated capacity as free and over-evict victims
+    # an earlier preemptor is about to occupy
+    nom = {k: list(v) for k, v in (nominated_pods_of or {}).items()}
+    done = 0
+    for pod, reason_bits in preemptors:
+        if done >= max_preemptions:
+            break
+        if on_attempt is not None:
+            on_attempt()
+        result = preempt(
+            pod, nodes, state, reason_bits, pdbs,
+            nominated_pods_of=nom,
+            vol_state=vol_state,
+            extenders=extenders,
+            enable_non_preempting=enable_non_preempting,
+        )
+        if result is None:
+            continue
+        sel.chosen[pod.key()] = result.node_name
+        sel.num_pdb_violations += result.num_pdb_violations
+        sel.clear_nominations.extend(result.clear_nominations)
+        for v in result.victims:
+            sel.victims.append(v)
+            sel.victim_of[v.key()] = pod.key()
+            state[result.node_name] = [
+                p for p in state[result.node_name] if p.key() != v.key()
+            ]
+        cleared = {p.key() for p in result.clear_nominations}
+        nom[result.node_name] = [
+            p for p in nom.get(result.node_name, [])
+            if p.key() not in cleared
+        ] + [pod]
+        done += 1
+    return sel

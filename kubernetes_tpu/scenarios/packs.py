@@ -1,0 +1,188 @@
+"""Scenario packs — pluggable solve objectives over the dense (P, N)
+formulation (docs/scenarios.md; ROADMAP item 4, "schedule what the
+papers schedule").
+
+A :class:`ScenarioPack` owns three seams the scheduler threads through
+its EXISTING machinery (no solver forks):
+
+- **weights** — a priority-weight override; the re-weighted kernels are
+  recomputed per round by every tier of the degradation ladder, so the
+  objective survives batch -> batch-single -> batch-cpu -> greedy
+  unchanged;
+- **cost** — an optional (P, N) device term folded into ``extra_score``
+  (the same seam extenders and score plugins use), built by the jitted
+  kernels in :mod:`kubernetes_tpu.ops.scenario_cost`;
+- **quality** — the per-cycle placement-quality readback
+  (ops/scenario_cost.quality_reduce -> scenarios/quality.decode) plus
+  host-side gang bookkeeping, landing on CycleResult / the flight
+  record / ``scheduler_scenario_quality``.
+
+Two packs ship:
+
+- ``consolidation`` — "Priority Matters"-style bin packing: minimize
+  nodes used / maximize priority-weighted headroom. MostRequested
+  replaces the stock spreading objective, a flat occupied-node bias
+  covers the open-a-new-node step, and priority tiers ride the queue
+  order the solvers already honor. Preemption runs as an IN-BATCH
+  cascade (scenarios/cascade.py): victims and displaced pods re-enter
+  one dense solve in the same cycle instead of looping per-pod through
+  the nominate-and-wait path.
+- ``gang-topology`` — Tesserae-style DL placement: multi-slice TPU
+  gangs score nodes by hierarchical slice distance to a per-gang home
+  slice (biggest gang -> freest slice, host-side greedy over the host
+  mirror — no readback), with the scheduler's existing all-or-nothing
+  group semantics enforcing atomicity at scale.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class ScenarioPack:
+    """Base pack: no cost term, no weight override, quality on."""
+
+    name = ""
+    #: route preemption through the in-batch cascade when the scenario
+    #: config asks for it (consolidation turns this on)
+    wants_cascade = False
+
+    def __init__(self, config) -> None:
+        self.config = config
+
+    def weights(self, base: Optional[Dict[str, float]]
+                ) -> Optional[Dict[str, float]]:
+        """Priority-weight override (None = keep the configured set)."""
+        return base
+
+    def cost(self, batch, nt, node_order, dp, dn):
+        """Optional (P, N) device score term for THIS cycle's solve.
+        ``batch``/``nt``/``node_order`` are host-side (the pack may
+        derive small per-pod arrays from them — uploads only, never a
+        readback); ``dp``/``dn`` are the cycle's device tables (mesh
+        placement included, so the term inherits the node-axis
+        sharding). None = no term (the lean fast path stays open)."""
+        return None
+
+    def quality_host(self, batch, assigned, nt) -> Dict[str, float]:
+        """Pack-specific host-side scores over the final assignment
+        (already read back — zero extra readback bytes)."""
+        return {}
+
+
+class ConsolidationPack(ScenarioPack):
+    """Minimize-nodes-used / maximize-headroom under priority tiers."""
+
+    name = "consolidation"
+
+    @property
+    def wants_cascade(self) -> bool:
+        return self.config.preempt_in_batch
+
+    def weights(self, base):
+        # the packing objective REPLACES the spreading one: fill the
+        # fullest feasible node (MostRequested), keep node-local
+        # balance so cpu/mem exhaust together, drop every spreading
+        # kernel. The bias term below covers the open-a-new-node step.
+        return {
+            "MostRequestedPriority": 3,
+            "BalancedResourceAllocation": 1,
+        }
+
+    def cost(self, batch, nt, node_order, dp, dn):
+        import jax.numpy as jnp
+
+        from kubernetes_tpu.ops.scenario_cost import consolidation_bias
+
+        return consolidation_bias(
+            dp.valid, dn, jnp.float32(self.config.cost_weight),
+            fill_block=self.config.fill_block)
+
+
+class GangTopologyPack(ScenarioPack):
+    """Topology-aware DL gangs: slice-distance cost to per-gang home
+    slices, all-or-nothing groups (the scheduler's gang rollback)."""
+
+    name = "gang-topology"
+
+    # graftlint: disable-scope=R7 -- nt is the HOST-mirror NodeTable
+    # (numpy arrays the packer built on host); no device value ever
+    # crosses here — the home-zone greedy is upload-only by design
+    def _home_zones(self, batch, nt) -> np.ndarray:
+        """(P,) int32 home slice per pod (-1 = gangless). Host-side
+        greedy over the HOST mirror: gangs by total CPU demand
+        descending pick the slice with the most remaining free CPU;
+        each pick debits the slice so later gangs see the cascade.
+        Cheap (G x Z) work on arrays the packer already built."""
+        zone = np.asarray(nt.zone_id)[: nt.n]
+        from kubernetes_tpu.snapshot import RES_CPU
+
+        free = np.maximum(
+            np.asarray(nt.allocatable)[: nt.n, RES_CPU]
+            - np.asarray(nt.requested)[: nt.n, RES_CPU], 0.0)
+        n_zones = int(zone.max()) + 1 if zone.size and zone.max() >= 0 else 0
+        zfree = np.zeros((max(n_zones, 1),), np.float64)
+        for z in range(n_zones):
+            zfree[z] = free[zone == z].sum()
+        gangs: Dict[str, List[int]] = {}
+        demand: Dict[str, float] = {}
+        for i, p in enumerate(batch):
+            if p.pod_group:
+                gangs.setdefault(p.pod_group, []).append(i)
+                demand[p.pod_group] = (demand.get(p.pod_group, 0.0)
+                                       + p.requests.cpu_milli)
+        home = np.full((len(batch),), -1, np.int32)
+        if not gangs or n_zones == 0:
+            return home
+        for g in sorted(gangs, key=lambda g: (-demand[g], g)):
+            z = int(np.argmax(zfree))
+            zfree[z] -= demand[g]
+            for i in gangs[g]:
+                home[i] = z
+        return home
+
+    def cost(self, batch, nt, node_order, dp, dn):
+        import jax.numpy as jnp
+
+        from kubernetes_tpu.ops.scenario_cost import gang_topology_score
+
+        home = self._home_zones(batch, nt)
+        P = dp.valid.shape[0]
+        if P > home.shape[0]:  # padding rows are gangless
+            home = np.concatenate(
+                [home, np.full((P - home.shape[0],), -1, np.int32)])
+        return gang_topology_score(
+            jnp.asarray(home), dn, jnp.float32(self.config.cost_weight),
+            superpod=self.config.superpod)
+
+    # graftlint: disable-scope=R7 -- nt is the HOST-mirror NodeTable
+    # (numpy); gang bookkeeping reads host arrays only
+    def quality_host(self, batch, assigned, nt) -> Dict[str, float]:
+        from kubernetes_tpu.scenarios.quality import gang_stats
+
+        return gang_stats(batch, assigned,
+                          zone_of_node=np.asarray(nt.zone_id)[: nt.n],
+                          superpod=self.config.superpod)
+
+
+#: pack name -> class; "" stays unregistered (scenario mode off)
+SCENARIO_REGISTRY = {
+    ConsolidationPack.name: ConsolidationPack,
+    GangTopologyPack.name: GangTopologyPack,
+}
+
+
+def resolve_pack(config) -> Optional[ScenarioPack]:
+    """ScenarioConfig -> pack instance (None when ``pack`` is empty).
+    Unknown names fail loudly — ``cli.validate_config`` rejects them
+    up front; this guard covers direct constructor callers."""
+    if config is None or not getattr(config, "pack", ""):
+        return None
+    cls = SCENARIO_REGISTRY.get(config.pack)
+    if cls is None:
+        raise ValueError(
+            f"scenario.pack: unknown pack {config.pack!r} "
+            f"(known: {sorted(SCENARIO_REGISTRY)})")
+    return cls(config)

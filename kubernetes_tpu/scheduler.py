@@ -148,6 +148,11 @@ class CycleResult:
     flush_trigger: str = ""
     #: how long the micro-batch window accumulated before flushing
     window_s: float = 0.0
+    #: scenario-pack placement-quality scores for this cycle (empty =
+    #: scenario mode off / quality gated off): the device-reduced
+    #: nodes_used / headroom / fragmentation vector plus the pack's
+    #: host-side gang bookkeeping (docs/scenarios.md quality table)
+    scenario_quality: Dict[str, float] = field(default_factory=dict)
 
 
 class Scheduler:
@@ -189,6 +194,7 @@ class Scheduler:
         snapshot_max_dirty_frac: Optional[float] = None,
         warmup=None,
         parallel=None,
+        scenario=None,
     ) -> None:
         from kubernetes_tpu.config import (
             ObservabilityConfig,
@@ -364,6 +370,23 @@ class Scheduler:
         self.binder = binder or RecordingBinder()
         self.weights = weights
         self.solver = solver
+        #: scenario pack (config.ScenarioConfig -> scenarios.resolve_pack):
+        #: a pack swaps the solve objective — its weight override lands
+        #: HERE so every ladder tier (and warmup) sees the scenario
+        #: weights, and its (P, N) cost term joins extra_score per cycle
+        #: (docs/scenarios.md). None = stock objective, zero overhead.
+        from kubernetes_tpu.config import ScenarioConfig
+        from kubernetes_tpu.scenarios import resolve_pack
+
+        self.scenario = scenario if scenario is not None else ScenarioConfig()
+        self.scenario_pack = resolve_pack(self.scenario)
+        if self.scenario_pack is not None:
+            self.weights = self.scenario_pack.weights(self.weights)
+        #: score labels ever exported on the scenario-quality gauge —
+        #: lets a cycle zero scores that stopped being reported (e.g.
+        #: gang_locality after a gangless cycle), same freshness rule
+        #: as the explain reason gauges
+        self._scenario_scores_seen: set = set()
         #: count of exact->round auto-fallbacks (port/volume/topology batches)
         self.exact_fallbacks = 0
         #: NonPreemptingPriority feature gate: honor preemption_policy=Never
@@ -440,6 +463,7 @@ class Scheduler:
         kw.setdefault("snapshot_max_dirty_frac", cfg.snapshot_max_dirty_frac)
         kw.setdefault("warmup", cfg.warmup)
         kw.setdefault("parallel", cfg.parallel)
+        kw.setdefault("scenario", cfg.scenario)
         if getattr(cfg, "plugins", ()) and "framework" not in kw:
             # config-driven framework assembly (the NewFramework path,
             # framework.go:88: registry factories + per-plugin args from
@@ -1159,6 +1183,19 @@ class Scheduler:
                 extra_score = es if extra_score is None else extra_score + es
             trace.step("extenders done")
 
+        # scenario-pack objective: the pack's (P, N) cost term joins the
+        # framework/extender score seam, so it rides every ladder tier
+        # (sharded batch, batch-single, batch-cpu, the greedy oracle)
+        # AND the exact solver unchanged — objective selection through
+        # the ladder, not a solver fork (docs/scenarios.md)
+        if self.scenario_pack is not None:
+            with self.obs.span("scenario:cost"):
+                sc_cost = self.scenario_pack.cost(batch, nt, node_order,
+                                                  dp, dn)
+            if sc_cost is not None:
+                extra_score = (sc_cost if extra_score is None
+                               else extra_score + sc_cost)
+
         # nominated-pods pass A (podFitsOnNode two-pass rule,
         # generic_scheduler.go:610): feasibility must ALSO hold with the
         # nominated pods counted onto their nodes. Divergence from the
@@ -1291,6 +1328,19 @@ class Scheduler:
                 jnp.asarray(np.maximum(pad_assigned, 0)),
                 jnp.asarray(pad_assigned >= 0) & dp.valid,
             )
+        # scenario quality: dispatch the device reduction NOW (final
+        # usage + final assignment, gang rollbacks applied) so it
+        # executes while the host binds; its ~28 B vector is read back
+        # after the bind loop alongside the failure readbacks
+        q_dev = None
+        if self.scenario_pack is not None and self.scenario.quality:
+            from kubernetes_tpu.ops.scenario_cost import quality_reduce
+
+            pad_a = np.full((dp.valid.shape[0],), -1, np.int32)
+            pad_a[: len(batch)] = assigned
+            q_dev = quality_reduce(jnp.asarray(pad_a), usage.requested,
+                                   dp, dn)
+
         res.rounds = int(rounds)
         solve_s = trace.total_s()
         trace.step(f"solve done ({res.rounds} rounds)")
@@ -1386,6 +1436,17 @@ class Scheduler:
         trace.end_span(bind_span)
         trace.step(f"bound {res.scheduled}, failed {res.unschedulable}")
 
+        if q_dev is not None:
+            with self.obs.span("pipeline:readback@quality"):
+                qvec = self.obs.jax.readback("scenario-quality", q_dev)
+            from kubernetes_tpu.scenarios.quality import decode_quality
+
+            quality = decode_quality(qvec)
+            quality.update(
+                self.scenario_pack.quality_host(batch, assigned, nt))
+            res.scenario_quality = quality
+            self._publish_scenario_quality(quality)
+
         # schedulability explainer: decode the read-back reduction into
         # the cycle's UnschedulableReport — every _fail'd pod gets a row
         # (filter failures carry device analytics; plugin/gang/bind
@@ -1409,8 +1470,16 @@ class Scheduler:
             rmat[preemptable_idx] = rows
             pt0 = self.clock()
             with self.obs.span("preemption"):
-                self._run_preemption(
-                    batch, preemptable_idx, rmat, node_order, res)
+                if (self.scenario_pack is not None
+                        and self.scenario_pack.wants_cascade):
+                    # scenario packs: victims + displaced pods re-enter
+                    # ONE dense solve in this same cycle instead of the
+                    # per-pod nominate-and-wait loop
+                    self._run_preemption_cascade(
+                        batch, preemptable_idx, rmat, node_order, res)
+                else:
+                    self._run_preemption(
+                        batch, preemptable_idx, rmat, node_order, res)
             self.metrics.preemption_duration.observe(self.clock() - pt0)
             trace.step(f"preemption ({res.preempted} victims)")
         return self._finish_cycle(res, cycle, t0, solve_s, trace)
@@ -1953,6 +2022,11 @@ class Scheduler:
                 or fw.has_batch_filters() or fw.has_batch_scores()):
             return False
         if self.percentage_of_nodes_to_score is not None:
+            return False
+        if self.scenario_pack is not None:
+            # scenario packs are whole-batch features: the cost term
+            # rides extra_score, the quality reduction wants the final
+            # monolithic usage, and the cascade re-solves in-cycle
             return False
         if any(p.pod_group for p in batch):
             return False
@@ -2582,6 +2656,295 @@ class Scheduler:
             # unschedulableQ until the 60 s leftover flush
             self.queue.move_all_to_active()
 
+    def _run_preemption_cascade(self, batch, failed_idx, rmat, node_order,
+                                res) -> None:
+        """In-batch preemption cascade (scenario packs; docs/scenarios.md):
+        victim SELECTION runs the exact per-node machinery from
+        preemption.py — shared state across preemptors, so earlier
+        evictions are visible to later ones — and then victims AND
+        displaced pods re-enter one dense solve in THIS cycle
+        (:meth:`_cascade_solve`) instead of the stock path's per-pod
+        nominate-and-wait loop. Single-pod batches select bit-identical
+        victim sets to :meth:`_run_preemption` by construction (pinned
+        by the seeded parity test in tests/test_scenarios.py)."""
+        import dataclasses as _dc
+
+        from kubernetes_tpu.scenarios.cascade import select_cascade
+
+        nodes = self.cache.nodes()
+        node_pods_of = {nd.name: self.cache.pods_on(nd.name)
+                        for nd in nodes}
+        pdbs = list(self.pdb_lister())
+        order = sorted(failed_idx, key=lambda i: -batch[i].priority)
+        preemptors = [(batch[i], {
+            name: int(rmat[i, r])
+            for r, name in enumerate(node_order) if name
+        }) for i in order]
+        sel = select_cascade(
+            preemptors, nodes, node_pods_of, pdbs,
+            nominated_pods_of=dict(self.queue.nominated.items()),
+            vol_state=self.cache.packer.resolve_volumes,
+            extenders=[e for e in self.extenders
+                       if e.supports_preemption()],
+            enable_non_preempting=self.enable_non_preempting,
+            max_preemptions=self.max_preemptions_per_cycle,
+            # same per-processed-pod accounting as the stock loop
+            on_attempt=self.metrics.preemption_attempts.inc,
+        )
+        if not sel.chosen:
+            return
+        now = self.clock()
+        if sel.victims:
+            self.metrics.preemption_victims.inc(len(sel.victims))
+            self.metrics.scenario_cascade_victims.inc(len(sel.victims))
+        # preemptors that actually RE-SOLVE this cycle (gang members
+        # never do — binding one member solo would sidestep the
+        # all-or-nothing rollback; they keep stock nominations)
+        solve_keys = {batch[i].key() for i in order
+                      if batch[i].key() in sel.chosen
+                      and not batch[i].pod_group}
+        displaced = []
+        requeue_only = []
+        for v in sel.victims:
+            v.deletion_timestamp = now
+            self.event_sink(
+                "Preempted", v, f"by {sel.victim_of[v.key()]} (cascade)")
+            if self.victim_deleter is not None:
+                # deletion goes through the hub; the victim holds its
+                # capacity as terminating until the watch delete lands,
+                # so it CANNOT re-enter this cycle's solve — the
+                # preemptors keep the stock nomination semantics below
+                self.victim_deleter(v)
+            else:
+                self.cache.remove_pod(v.key())
+                if not self.responsible_for(v):
+                    continue
+                pending = _dc.replace(v, node_name="",
+                                      deletion_timestamp=0.0)
+                if sel.victim_of[v.key()] in solve_keys:
+                    displaced.append(pending)
+                else:
+                    # the evacuated capacity is PROMISED to a
+                    # nominated-only preemptor — re-solving this victim
+                    # now could retake it (the cascade solve has no
+                    # pass-A phantom occupancy); requeue instead, like
+                    # the stock path's victims-then-retry flow
+                    requeue_only.append(pending)
+        for p in sel.clear_nominations:
+            p.nominated_node_name = ""
+            self.queue.nominated.delete(p)
+        res.preempted += len(sel.victims)
+        # the cascade re-solve: preemptors first (priority order is the
+        # queue comparator inside the solve anyway), displaced victims
+        # riding the same dense batch, bounded by the config budget.
+        # GANG preemptors are excluded: binding one member through the
+        # cascade would sidestep the all-or-nothing rollback and could
+        # leave a partially-bound gang — they keep the stock nomination
+        # semantics (victims evicted now, the whole gang re-solves next
+        # cycle under the gang check). Displaced gang members may still
+        # re-place: their gang-mates remain bound, so migration keeps
+        # the group whole (the stock path would just kill them).
+        resolve_pods = [batch[i] for i in order
+                        if batch[i].key() in solve_keys]
+        budget = max(self.scenario.cascade_max_pods, 1)
+        overflow = (resolve_pods + displaced)[budget:]
+        resolve_pods = (resolve_pods + displaced)[:budget]
+        if self.victim_deleter is not None or not sel.victims:
+            # nothing newly USABLE was freed: in hub-deleter mode the
+            # victims hold their capacity as terminating, and a
+            # victimless win (pick_one_node's no-victims fast path)
+            # evacuated nothing — the re-solve could not place anything
+            # the main solve didn't, so skip straight to the
+            # nominations instead of paying a second full ladder solve
+            placed, q2 = set(), None
+        else:
+            placed, q2 = self._cascade_solve(resolve_pods, res)
+        for p in requeue_only:
+            self._fail(p, self.queue.scheduling_cycle, res,
+                       ("CascadeUnplaced",))
+        for p in overflow:
+            # a displaced pod the budget truncated was already evicted
+            # from its node — it MUST requeue through the standard
+            # error path, not silently vanish (preemptors in the
+            # overflow keep their existing failure row + nomination)
+            if p.key() not in res.failure_reasons:
+                self._fail(p, self.queue.scheduling_cycle, res,
+                           ("CascadeUnplaced",))
+        for p in displaced:
+            if p.key() in placed:
+                self.metrics.scenario_displaced_replaced.inc()
+        if q2:
+            # the cascade changed the cluster: re-publish the
+            # CLUSTER-STATE quality fields from the cascade solve's
+            # final usage (nodes_used/headroom/fragmentation/free);
+            # batch-relative fields (placed, nodes_used_batch,
+            # priority_headroom) keep describing the main solve
+            for k in ("nodes_used", "headroom", "fragmentation",
+                      "free_cpu_frac"):
+                res.scenario_quality[k] = q2[k]
+            self._publish_scenario_quality(res.scenario_quality)
+        # preemptors the re-solve could not place (victimless win,
+        # hub-delete mode, or a cascade interaction took their spot)
+        # keep the stock semantics: nominated onto the chosen node,
+        # retried next cycle
+        for i in order:
+            key = batch[i].key()
+            if key in sel.chosen and key not in placed:
+                batch[i].nominated_node_name = sel.chosen[key]
+                self.queue.nominated.add(batch[i], sel.chosen[key])
+                res.nominations[key] = sel.chosen[key]
+        if sel.victims and self.victim_deleter is None:
+            # inline victim deletes (grace 0): the watch-delete wakeup
+            # the stock path performs must happen here too
+            self.queue.move_all_to_active()
+
+    def _publish_scenario_quality(self, quality) -> None:
+        """Fan one cycle's quality dict out to the flight record and
+        the gauge family — scores that stopped being reported (a
+        gangless cycle after a gang cycle) drop to zero instead of
+        going stale, the explain-gauge freshness rule."""
+        self.obs.note_scenario(quality)
+        for k in self._scenario_scores_seen - set(quality):
+            self.metrics.scenario_quality.set(0.0, score=k)
+        for k, v in quality.items():
+            self.metrics.scenario_quality.set(float(v), score=k)
+            self._scenario_scores_seen.add(k)
+
+    def _cascade_pad(self, n: int) -> int:
+        """Pod-bucket for a cascade re-solve. With warmup on, snap UP
+        to a bucket the warm sweep covered (the smallest explicit
+        bucket that fits, or at least ``min_bucket`` for the geometric
+        default sweep) so a cascade never pays a hot-path compile —
+        cascades bigger than every warmed bucket keep their natural
+        bucket (a one-time compile, logged by the retrace telemetry)."""
+        pad = bucket_size(max(n, 1))
+        wu = self.warmup_config
+        if not wu.enabled:
+            return pad
+        explicit = sorted(b for b in wu.pod_buckets if b >= pad)
+        if explicit:
+            return explicit[0]
+        if not wu.pod_buckets:
+            return max(pad, bucket_size(max(min(wu.min_bucket,
+                                                self.max_batch), 1)))
+        return pad
+
+    def _cascade_solve(self, pods_list, res: CycleResult):
+        """One dense solve over the cascade's preemptors + displaced
+        pods against the evacuated cluster — a fresh snapshot (the
+        victims' rows are dirty, so the resident path patches them with
+        the usual delta scatter), the full degradation ladder with
+        fused validation, the pack's cost term, and the standard
+        admission tail per placed pod. Returns ``(placed_keys,
+        quality_or_None)`` — the quality vector re-reduced from the
+        cascade's FINAL usage, so cascade cycles report the true
+        post-cascade cluster state."""
+        from kubernetes_tpu.ops.arrays import volumes_to_device
+
+        placed: set = set()
+        if not pods_list:
+            return placed, None
+        pk = self.cache.packer
+        for p in pods_list:
+            pk.intern_pod(p)
+        if self.device_resident_snapshot:
+            nt, dn, _ = self._device_snapshot_recovering()
+        else:
+            nt = self.cache.snapshot()
+            dn = None
+        node_order = self.cache.node_order()
+        pt = pk.pack_pods(pods_list)
+        skip_prio, no_ports, no_pod_aff, no_spread = solver_gates(nt, pt)
+        if dn is None:
+            if self._mesh_live:
+                from kubernetes_tpu.parallel.mesh import place_node_table
+
+                dn = place_node_table(nt, self.mesh)
+            else:
+                dn = nodes_to_device(nt)
+        dp = self._place(pods_to_device(
+            pt, pad_to=self._cascade_pad(len(pods_list))))
+        ds = self._place(selectors_to_device(pk.pack_selector_tables()))
+        dt = self._place(topology_to_device(pk.pack_topology_tables())
+                         if _has_topo(pk.u) else None)
+        dv = sv = None
+        if any(p.volumes for p in pods_list):
+            dv = self._place(volumes_to_device(
+                pk.pack_volume_tables(pods_list)))
+            sv = _static_vol_pass(dp, dn, ds, dv)
+        extra_score = None
+        if self.scenario_pack is not None:
+            extra_score = self.scenario_pack.cost(
+                pods_list, nt, node_order, dp, dn)
+        solver = self.solver if self.solver != "exact" else "batch"
+        self.obs.jax.record_call(
+            "solve", dp, dn, ds, dt, dv,
+            static=(solver, tuple(skip_prio), no_ports, no_pod_aff,
+                    no_spread, self.pred_mask, self.per_node_cap,
+                    self.max_rounds, True, extra_score is None,
+                    self._mesh_live),
+        )
+        ladder = self._solve_ladder(
+            solver, pods_list, dp, dn, ds, dt, dv, sv, None, None,
+            extra_score, skip_prio, no_ports, no_pod_aff, no_spread, res,
+        )
+        cycle = self.queue.scheduling_cycle
+        if ladder is None:
+            for p in pods_list:
+                if p.key() not in res.failure_reasons:
+                    self._fail(p, cycle, res, ("SolverUnavailable",))
+            return placed, None
+        assigned, usage2, _rounds, _tier = ladder
+        q2 = None
+        if self.scenario.quality:
+            from kubernetes_tpu.ops.scenario_cost import quality_reduce
+            from kubernetes_tpu.scenarios.quality import decode_quality
+
+            pad_a = np.full((dp.valid.shape[0],), -1, np.int32)
+            pad_a[: len(pods_list)] = assigned[: len(pods_list)]
+            with self.obs.span("pipeline:readback@quality"):
+                q2 = decode_quality(self.obs.jax.readback(
+                    "scenario-quality",
+                    quality_reduce(jnp.asarray(pad_a), usage2.requested,
+                                   dp, dn)))
+        assigned = assigned[: len(pods_list)]
+        for i, p in enumerate(pods_list):
+            t = int(assigned[i])
+            if t < 0:
+                # displaced pods requeue through the standard error
+                # path; an unplaced preemptor keeps the failure row the
+                # main bind loop already recorded (no double count) and
+                # gets its nomination from the caller
+                if p.key() not in res.failure_reasons:
+                    self._fail(p, cycle, res, ("CascadeUnplaced",))
+                continue
+            # a preemptor was already _fail'd by the main bind loop —
+            # its stale queue entry and failure row are superseded by
+            # the cascade bind
+            self.queue.delete(p.key())
+            had_row = p.key() in res.failure_reasons
+            before_sched = res.scheduled
+            before_unsched = res.unschedulable
+            before_wait = res.waiting
+            self._admit_pod(p, node_order[t], cycle, res)
+            if res.scheduled > before_sched or res.waiting > before_wait:
+                # bound — or PARKED by a Permit plugin (assumed in
+                # cache, capacity held): either way the pod left the
+                # unschedulable state and must NOT also be nominated
+                # (a nominated + assumed pod would double-count its
+                # capacity in next cycle's pass A)
+                placed.add(p.key())
+                if had_row:
+                    res.unschedulable -= 1
+                    res.failure_reasons.pop(p.key(), None)
+                    res.fit_errors.pop(p.key(), None)
+                    self.why_pending.pop(p.key(), None)
+            elif had_row and res.unschedulable > before_unsched:
+                # the admission tail _fail'd a pod the main bind loop
+                # already counted — one pod, one unschedulable
+                res.unschedulable -= 1
+        return placed, q2
+
     def _fail(self, pod: Pod, cycle: int, res: CycleResult, reasons,
               message: str = None) -> None:
         res.unschedulable += 1
@@ -2665,7 +3028,11 @@ class Scheduler:
         solver = self.solver if self.solver != "exact" else "batch"
         statics = (solver, tuple(skip_prio), no_ports, no_pod_aff,
                    no_spread, self.pred_mask, self.per_node_cap,
-                   self.max_rounds, True, True,  # no extra mask/score
+                   self.max_rounds, True,
+                   # a scenario pack fills extra_score every cycle; the
+                   # warmed signature must carry the same trace-time
+                   # fact or the first real cycle recompiles
+                   self.scenario_pack is None,
                    self._mesh_live)
         buckets = tuple(wu.pod_buckets)
         if not buckets:
@@ -2691,7 +3058,7 @@ class Scheduler:
                     # device-loss chaos seam for the compile below
                     self.fault_injector.device_hook("warmup:compile")
                 compiled += self._warm_bucket(
-                    P, pk, sample, dn, ds, dt, solver, statics,
+                    P, pk, sample, nt, dn, ds, dt, solver, statics,
                     (skip_prio, no_ports, no_pod_aff, no_spread),
                     has_vol_sample, wu)
             except Exception as e:
@@ -2731,7 +3098,7 @@ class Scheduler:
                             self.fault_injector.device_hook(
                                 "warmup:compile")
                         compiled += self._warm_bucket(
-                            P, pk, sample, dn_h, ds_h, dt_h, solver,
+                            P, pk, sample, nt, dn_h, ds_h, dt_h, solver,
                             statics_h,
                             (skip_prio, no_ports, no_pod_aff, no_spread),
                             has_vol_sample, wu)
@@ -2748,7 +3115,7 @@ class Scheduler:
                        "(nodes bucket %d)", compiled, dn.valid.shape[0])
         return compiled
 
-    def _warm_bucket(self, P, pk, sample, dn, ds, dt, solver, statics,
+    def _warm_bucket(self, P, pk, sample, nt, dn, ds, dt, solver, statics,
                      gates, has_vol_sample, wu) -> int:
         """Compile one bucketed solve shape (the body of the warmup
         sweep); returns 1. Split out so the sweep's device-loss
@@ -2775,13 +3142,27 @@ class Scheduler:
             dv = self._place(volumes_to_device(pk.pack_volume_tables(
                 sample[:P])))
             sv = _static_vol_pass(dp, dn, ds, dv)
+        extra_score = None
+        if self.scenario_pack is not None:
+            # the pack's cost kernel builds the warm extra_score through
+            # the SAME jitted path real cycles use (dtype + sharding
+            # included) — a zeros placeholder would warm a different
+            # compiled program and the first scenario cycle would
+            # recompile on the hot path
+            extra_score = self.scenario_pack.cost(
+                sample[:P], nt, self.cache.node_order(), dp, dn)
+        # the extra-score static must mirror what the WARM cost call
+        # actually produced (a pack whose cost() returns None would
+        # otherwise pre-register a signature no real cycle presents)
+        statics = statics[:9] + (extra_score is None,) + statics[10:]
         self.obs.jax.record_call("solve", dp, dn, ds, dt, dv,
                                  static=statics, warmup=True)
         if solver == "greedy":
             a, wu_usage = greedy_assign(
                 dp, dn, ds, self.weights, topo=dt, vol=dv,
                 static_vol=sv,
-                enabled_mask=self.pred_mask, skip_priorities=skip_prio,
+                enabled_mask=self.pred_mask, extra_score=extra_score,
+                skip_priorities=skip_prio,
                 no_ports=no_ports, no_pod_affinity=no_pod_aff,
                 no_spread=no_spread,
             )
@@ -2790,6 +3171,7 @@ class Scheduler:
                 dp, dn, ds, self.weights, max_rounds=self.max_rounds,
                 per_node_cap=self.per_node_cap, topo=dt, vol=dv,
                 static_vol=sv, enabled_mask=self.pred_mask,
+                extra_score=extra_score,
                 use_sinkhorn=(solver == "sinkhorn"),
                 skip_priorities=skip_prio, no_ports=no_ports,
                 no_pod_affinity=no_pod_aff, no_spread=no_spread,
@@ -2805,6 +3187,16 @@ class Scheduler:
                                      self.pred_mask)
             if dv_out is not None:
                 jax.block_until_ready(dv_out[0])
+        if self.scenario_pack is not None and self.scenario.quality:
+            # the per-cycle quality reduction rides every scenario
+            # cycle's readback — compile its program per bucket here
+            # too, with the host-built assignment vector real cycles
+            # upload (same placement, same signature)
+            from kubernetes_tpu.ops.scenario_cost import quality_reduce
+
+            pad_a = jnp.asarray(np.full((P,), -1, np.int32))
+            jax.block_until_ready(
+                quality_reduce(pad_a, wu_usage.requested, dp, dn))
         jax.block_until_ready(a)
         if wu.include_filter:
             fr = _filter_pass(dp, dn, ds, dt, dv, sv,

@@ -59,6 +59,16 @@ doorbell stall gap — plus absolute invariants on the new record alone
 post-warmup retraces, d2h readback within the budget). One record is
 enough for the absolute invariants; deltas need two.
 
+Scenario QUALITY gates (scripts/bench_scenarios.py records) ride the
+two newest ``benchres/scenario_r*.json``: placement-quality regressions
+gate exactly like perf regressions — the consolidation pack's
+nodes-used and throughput, the gang pack's success rate and slice
+locality — plus absolute invariants on the new record alone (the pack
+strictly beats the stock objective on nodes-used at equal feasibility,
+gang atomicity violations == 0, zero retraces, readback within the
+budget). Single-record runs pass gracefully: the deltas skip, the
+absolutes still enforce.
+
 ``--list-gates`` prints every active gate family (name, record source,
 what it enforces) — the docs reference this output instead of
 hand-maintaining the list.
@@ -133,6 +143,20 @@ def find_mesh_records(directory: str) -> List[str]:
         return (int(m.group(1)) if m else -1, os.path.basename(path))
 
     return sorted(glob.glob(os.path.join(directory, "mesh_r*.json")),
+                  key=round_key)
+
+
+def find_scenario_records(directory: str) -> List[str]:
+    """scenario_r*.json (scripts/bench_scenarios.py records) sorted by
+    round — the scenario quality-gate family's inputs. Absence is
+    tolerated: benchres directories predating the scenario packs keep
+    passing."""
+
+    def round_key(path: str) -> Tuple[int, str]:
+        m = re.search(r"scenario_r(\d+)", os.path.basename(path))
+        return (int(m.group(1)) if m else -1, os.path.basename(path))
+
+    return sorted(glob.glob(os.path.join(directory, "scenario_r*.json")),
                   key=round_key)
 
 
@@ -504,6 +528,107 @@ def compare_mesh(prev: dict, cur: dict, threshold: float,
             "warnings": warnings}
 
 
+def compare_scenario(prev: dict, cur: dict, threshold: float,
+                     readback_budget: float = 16.0) -> dict:
+    """Scenario quality gates over two scenario_r*.json records (pure,
+    unit-tested) — placement QUALITY regressions gate exactly like perf
+    regressions (ROADMAP item 4's contract):
+
+    - delta gates (need two records): the consolidation pack's
+      ``nodes_used`` must not GROW past the threshold, its throughput
+      must not drop, and the gang pack's ``gang_success_rate`` and
+      ``gang_locality`` must not drop;
+    - ABSOLUTE invariants on the NEW record alone (a single record is
+      enough — single-record runs pass gracefully on the deltas):
+      the consolidation pack STRICTLY beats the stock objective on
+      nodes-used at equal feasibility, gang atomicity violations
+      (``gang_partial_binds``) == 0, gang success rate == 1.0 where
+      reported, zero retraces on every arm, and d2h readback within
+      ``readback_budget`` bytes/pod (the quality vector must ride the
+      existing boundary, not widen it).
+
+    Absent sections are warnings, never failures — same posture as
+    every other gate family."""
+    checks, regressions, warnings = [], [], []
+
+    def check(name: str, prev_v, cur_v, lower_is_better: bool = False):
+        pv, cv = _num(prev_v), _num(cur_v)
+        if pv is None or cv is None or pv <= 0:
+            warnings.append(f"{name}: not comparable "
+                            f"(prev={prev_v!r}, cur={cur_v!r})")
+            return
+        delta = (cv - pv) / pv
+        bad = delta > threshold if lower_is_better else delta < -threshold
+        row = {"check": name, "prev": pv, "cur": cv,
+               "delta_frac": round(delta, 4), "regressed": bad}
+        checks.append(row)
+        if bad:
+            regressions.append(row)
+
+    def absolute(name: str, cur_v, bad: bool):
+        row = {"check": name, "prev": None, "cur": cur_v,
+               "delta_frac": cur_v, "regressed": bad}
+        checks.append(row)
+        if bad:
+            regressions.append(row)
+
+    pc = (prev.get("consolidation") or {})
+    cc = (cur.get("consolidation") or {})
+    check("scenario.consolidation.nodes_used",
+          (pc.get("pack") or {}).get("nodes_used"),
+          (cc.get("pack") or {}).get("nodes_used"), lower_is_better=True)
+    check("scenario.consolidation.pods_per_sec",
+          (pc.get("pack") or {}).get("pods_per_sec"),
+          (cc.get("pack") or {}).get("pods_per_sec"))
+    pg = (prev.get("gang") or {}).get("pack") or {}
+    cg = (cur.get("gang") or {}).get("pack") or {}
+    check("scenario.gang.gang_success_rate",
+          pg.get("gang_success_rate"), cg.get("gang_success_rate"))
+    check("scenario.gang.gang_locality",
+          pg.get("gang_locality"), cg.get("gang_locality"))
+    check("scenario.gang.pods_per_sec",
+          pg.get("pods_per_sec"), cg.get("pods_per_sec"))
+
+    # absolute invariants on the NEW record alone
+    stock_nodes = _num((cc.get("stock") or {}).get("nodes_used"))
+    pack_nodes = _num((cc.get("pack") or {}).get("nodes_used"))
+    if stock_nodes is not None and pack_nodes is not None:
+        absolute("scenario.consolidation.beats_stock_nodes_used",
+                 pack_nodes, pack_nodes >= stock_nodes)
+        eq = cc.get("equal_feasibility")
+        if eq is not None:
+            absolute("scenario.consolidation.equal_feasibility",
+                     1.0 if eq else 0.0, not eq)
+    pb = _num(cg.get("gang_partial_binds"))
+    if pb is not None:
+        # the atomicity invariant: ONE partially-bound gang is a
+        # correctness bug, never a tolerable delta
+        absolute("scenario.gang.gang_partial_binds", pb, pb > 0)
+    sr = _num(cg.get("gang_success_rate"))
+    if sr is not None:
+        absolute("scenario.gang.gang_success_rate_1", sr, sr < 1.0)
+    for arm_name, arm in (("consolidation.stock", cc.get("stock")),
+                          ("consolidation.pack", cc.get("pack")),
+                          ("gang.pack", cg),
+                          ("gang.stock", (cur.get("gang") or {}
+                                          ).get("stock"))):
+        rt = _num((arm or {}).get("retraces"))
+        if rt is not None:
+            absolute(f"scenario.{arm_name}.retraces", rt, rt > 0)
+        bpp = _num((arm or {}).get("readback_bytes_per_pod"))
+        if bpp is not None:
+            absolute(f"scenario.{arm_name}.readback_budget", bpp,
+                     bpp > readback_budget)
+    for rec, label in ((prev, "prev"), (cur, "cur")):
+        errs = rec.get("errors") or []
+        if errs:
+            warnings.append(f"{label} scenario record carries "
+                            f"{len(errs)} error(s); affected sections "
+                            "may be absent")
+    return {"checks": checks, "regressions": regressions,
+            "warnings": warnings}
+
+
 #: every active gate family: (name, record glob, what it enforces) —
 #: the --list-gates surface the docs reference. Keep one row per
 #: compare_* section so a new gate family cannot land invisibly.
@@ -527,6 +652,11 @@ GATE_FAMILIES = [
      "composed serving-on-mesh: creates/sec + p99, takeover_s, "
      "shard_heal_s + doorbell gap, double_bind_attempts==0, zero "
      "retraces, absolute readback budget"),
+    ("scenario", "scenario_r*.json",
+     "scenario-pack quality: consolidation beats stock on nodes-used "
+     "at equal feasibility, gang success rate + locality, gang "
+     "atomicity violations==0, zero retraces, absolute readback "
+     "budget"),
 ]
 
 
@@ -644,6 +774,35 @@ def main(argv=None) -> int:
         verdict["warnings"].extend(cmv["warnings"])
         verdict["churn_mesh_records"] = [
             os.path.relpath(p, REPO_ROOT) for p in cm_found[-2:]]
+    # scenario quality gates (scripts/bench_scenarios.py records) —
+    # absence tolerated so benchres directories predating the scenario
+    # packs keep passing; a single record still enforces the absolute
+    # invariants (strict consolidation win, gang atomicity == 0, zero
+    # retraces, readback budget)
+    sc_found = find_scenario_records(args.dir)
+    if sc_found:
+        try:
+            sc_prev = load(sc_found[-2]) if len(sc_found) >= 2 else {}
+            sc_cur = load(sc_found[-1])
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot load scenario records: {e}",
+                  file=sys.stderr)
+            return 2
+        scv = compare_scenario(sc_prev, sc_cur, args.threshold,
+                               args.mesh_readback_budget)
+        if len(sc_found) < 2:
+            verdict["warnings"].append(
+                "only one scenario record — delta gates need two to "
+                "compare (the absolute invariants still apply)")
+            scv["checks"] = [r for r in scv["checks"]
+                            if r["prev"] is None]
+            scv["regressions"] = [r for r in scv["checks"]
+                                  if r["regressed"]]
+        verdict["checks"].extend(scv["checks"])
+        verdict["regressions"].extend(scv["regressions"])
+        verdict["warnings"].extend(scv["warnings"])
+        verdict["scenario_records"] = [
+            os.path.relpath(p, REPO_ROOT) for p in sc_found[-2:]]
     # sharded-backend gates (scripts/bench_mesh_scale.py records) —
     # absence tolerated so pre-mesh benchres directories keep passing
     mesh_found = find_mesh_records(args.dir)
@@ -680,7 +839,7 @@ def main(argv=None) -> int:
         verdict["mesh_records"] = [
             os.path.relpath(mesh_found[-1], REPO_ROOT)]
     if prev_path is None and len(churn_found) < 2 and not mesh_found \
-            and not cm_found:
+            and not cm_found and not sc_found:
         msg = (f"not enough records in {args.dir} — nothing to gate")
         if args.format == "json":
             print(json.dumps({"status": "skipped", "reason": msg}))

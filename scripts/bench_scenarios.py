@@ -1,0 +1,267 @@
+#!/usr/bin/env python
+"""Scenario-pack quality benchmark: both packs at paper scale on the
+sharded mesh backend, with placement-QUALITY criteria gated exactly
+like perf (scripts/bench_compare.py ``scenario`` gate family). Run:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python scripts/bench_scenarios.py > benchres/scenario_r01.json
+
+Arms (each drives a REAL Scheduler — cost term through the ladder,
+fused validation, quality readback, the production path end to end —
+on a 5000-node cluster over the 8-virtual-device CPU mesh):
+
+- **consolidation** — 12288 uniform pods, stock spreading objective vs
+  the consolidation pack. The claim under gate: the pack STRICTLY
+  beats stock on nodes-used at EQUAL feasibility (same placed count).
+  Nodes-used is measured host-side from the bindings (independent of
+  the pack's own device-reduced quality vector, which is also
+  recorded and must agree).
+- **gang-topology** — 12288 pods in 768 gangs of 16 across 128 slices
+  (zones), all-or-nothing groups. The claims under gate: gang success
+  rate 1.0 with ZERO partial binds (atomicity), and slice locality
+  reported (pack vs stock contrast — the pack co-locates gangs onto
+  home slices).
+
+Cross-arm absolutes (same posture as the mesh bench): zero retraces
+after warmup on every arm, d2h readback bytes/pod within the PR-7
+budget (the quality vector rides the existing boundary — ~28 B per
+cycle, invisible at this scale). Exit code: 0 when every criterion
+holds, 1 otherwise (the record is still printed)."""
+
+import json
+import os
+import resource
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    (os.environ.get("XLA_FLAGS", "")
+     + " --xla_force_host_platform_device_count=8").strip())
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_tpu.config import (  # noqa: E402
+    ParallelConfig,
+    ScenarioConfig,
+    WarmupConfig,
+)
+from kubernetes_tpu.scheduler import Scheduler  # noqa: E402
+from kubernetes_tpu.testing import make_node, make_pod  # noqa: E402
+
+NODES = int(os.environ.get("SCN_NODES", 5000))
+PODS = int(os.environ.get("SCN_PODS", 12288))
+BATCH = int(os.environ.get("SCN_BATCH", 4096))
+ZONES = int(os.environ.get("SCN_ZONES", 128))
+GANG = int(os.environ.get("SCN_GANG", 16))
+CAP = int(os.environ.get("SCN_CAP", 8))
+FILL_BLOCK = int(os.environ.get("SCN_FILL_BLOCK", 64))
+POD_CPU = 4000.0
+POD_MEM = 8 * 2**30
+NODE_CPU = 32000.0
+NODE_MEM = 64 * 2**30
+READBACK_BUDGET = float(os.environ.get("SCN_READBACK_BUDGET", 16.0))
+
+
+def log(msg):
+    print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+def build_scheduler(scenario=None, zones=0):
+    s = Scheduler(
+        scenario=scenario,
+        parallel=ParallelConfig(mesh="auto"),
+        warmup=WarmupConfig(enabled=True, pod_buckets=(BATCH,)),
+        max_batch=BATCH,
+        per_node_cap=CAP,
+        enable_preemption=False,
+    )
+    for i in range(NODES):
+        zone = f"slice-{i % zones:03d}" if zones else None
+        s.on_node_add(make_node(
+            f"n{i:05d}", cpu_milli=NODE_CPU, memory=NODE_MEM, pods=110,
+            zone=zone))
+    return s
+
+
+def run_arm(s, pods, label):
+    """Feed ``pods``, warm, then drive cycles to drain — measuring only
+    the post-warmup scheduling work (retraces must stay 0 across it)."""
+    for p in pods:
+        s.on_pod_add(p)
+    sample = pods[:64]
+    t0 = time.perf_counter()
+    compiled = s.warmup(sample_pods=sample)
+    warm_s = time.perf_counter() - t0
+    rt0 = s.obs.jax.retrace_total()
+    d2h0 = s.obs.jax.d2h_bytes_total()
+    t0 = time.perf_counter()
+    cycles = []
+    while True:
+        r = s.schedule_cycle()
+        if r.attempted == 0:
+            break
+        cycles.append(r)
+    elapsed = time.perf_counter() - t0
+    placed = sum(r.scheduled for r in cycles)
+    bindings = {}
+    for r in cycles:
+        bindings.update(r.assignments)
+    quality = cycles[-1].scenario_quality if cycles else {}
+    out = {
+        "label": label,
+        "compiled_shapes": compiled,
+        "warmup_s": round(warm_s, 2),
+        "cycles": len(cycles),
+        "rounds": sum(r.rounds for r in cycles),
+        "elapsed_s": round(elapsed, 3),
+        "pods_per_sec": round(placed / max(elapsed, 1e-9), 1),
+        "placed": placed,
+        "unschedulable": sum(r.unschedulable for r in cycles),
+        "nodes_used": len(set(bindings.values())),
+        "retraces": s.obs.jax.retrace_total() - rt0,
+        "readback_bytes_per_pod": round(
+            (s.obs.jax.d2h_bytes_total() - d2h0) / max(placed, 1), 2),
+    }
+    if quality:
+        out["quality"] = quality
+    log(f"{label}: {out}")
+    return out, bindings
+
+
+def gang_locality_from_bindings(pods, bindings, zone_of_node, superpod=4):
+    """Independent host-side gang bookkeeping from the bindings map —
+    cross-checks the pack's quality_host numbers. Same hierarchical
+    metric as ops/scenario_cost.slice_distance (2.0 = whole gang on one
+    slice)."""
+    gangs = {}
+    for p in pods:
+        gangs.setdefault(p.pod_group, []).append(p)
+    total = placed = partial = 0
+    loc = []
+    for members in gangs.values():
+        total += 1
+        zs = [zone_of_node.get(bindings.get(m.key())) for m in members]
+        bound = [z for z in zs if z is not None]
+        if len(bound) == len(members):
+            placed += 1
+            pair = []
+            for i in range(len(bound)):
+                for j in range(i + 1, len(bound)):
+                    za, zb = bound[i], bound[j]
+                    d = (0 if za == zb
+                         else (1 if za // superpod == zb // superpod else 2))
+                    pair.append(2.0 - d)
+            if pair:
+                loc.append(sum(pair) / len(pair))
+        elif bound:
+            partial += 1
+    return {
+        "gangs": total,
+        "gangs_placed": placed,
+        "gang_success_rate": round(placed / max(total, 1), 4),
+        "gang_partial_binds": partial,
+        "gang_locality": round(sum(loc) / max(len(loc), 1), 4),
+    }
+
+
+def main():
+    out = {
+        "metric": ("scenario packs: consolidation + gang-topology quality "
+                   f"benches at {NODES} nodes on the mesh"),
+        "platform": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "nodes": NODES,
+        "pods": PODS,
+        "batch": BATCH,
+        "per_node_cap": CAP,
+        "errors": [],
+    }
+
+    # ---- consolidation: stock objective vs the pack -------------------
+    try:
+        pods = [make_pod(f"c{i:05d}", cpu_milli=POD_CPU, memory=POD_MEM)
+                for i in range(PODS)]
+        stock, _ = run_arm(build_scheduler(), pods, "consolidation/stock")
+        pods = [make_pod(f"c{i:05d}", cpu_milli=POD_CPU, memory=POD_MEM)
+                for i in range(PODS)]
+        pack, _ = run_arm(
+            build_scheduler(ScenarioConfig(pack="consolidation",
+                                           fill_block=FILL_BLOCK)),
+            pods, "consolidation/pack")
+        out["consolidation"] = {
+            "stock": stock,
+            "pack": pack,
+            "nodes_used_ratio": round(
+                pack["nodes_used"] / max(stock["nodes_used"], 1), 4),
+            "equal_feasibility": pack["placed"] == stock["placed"],
+        }
+    except Exception as e:
+        out["errors"].append(f"consolidation: {e!r:.300}")
+        log(f"consolidation FAILED: {e!r}")
+
+    # ---- gang-topology: all-or-nothing gangs across slices ------------
+    try:
+        def gang_pods():
+            return [
+                make_pod(f"g{i // GANG:04d}m{i % GANG:02d}",
+                         cpu_milli=POD_CPU, memory=POD_MEM,
+                         pod_group=f"gang{i // GANG:04d}",
+                         pod_group_min_available=GANG)
+                for i in range(PODS)
+            ]
+
+        zone_of_node = {f"n{i:05d}": i % ZONES for i in range(NODES)}
+        s = build_scheduler(
+            ScenarioConfig(pack="gang-topology"), zones=ZONES)
+        gp, bindings = run_arm(s, gang_pods(), "gang/pack")
+        gp.update(gang_locality_from_bindings(
+            gang_pods(), bindings, zone_of_node))
+        s2 = build_scheduler(zones=ZONES)
+        gs, bindings2 = run_arm(s2, gang_pods(), "gang/stock")
+        gs.update(gang_locality_from_bindings(
+            gang_pods(), bindings2, zone_of_node))
+        out["gang"] = {
+            "zones": ZONES,
+            "gang_size": GANG,
+            "gangs": PODS // GANG,
+            "pack": gp,
+            "stock": gs,
+        }
+    except Exception as e:
+        out["errors"].append(f"gang: {e!r:.300}")
+        log(f"gang FAILED: {e!r}")
+
+    con = out.get("consolidation", {})
+    gang = out.get("gang", {}).get("pack", {})
+    # EVERY arm is under the retrace + readback criteria — the same
+    # set compare_scenario gates, so the bench can never bless a
+    # record the CI gate then fails
+    arms = [con.get("stock", {}), con.get("pack", {}), gang,
+            out.get("gang", {}).get("stock", {})]
+    out["criteria"] = {
+        "consolidation_beats_stock_nodes_used": bool(
+            con.get("pack", {}).get("nodes_used", 1 << 30)
+            < con.get("stock", {}).get("nodes_used", 0)),
+        "equal_feasibility": bool(con.get("equal_feasibility")),
+        "gang_success_rate_1": gang.get("gang_success_rate") == 1.0,
+        "gang_zero_partial_binds": gang.get("gang_partial_binds") == 0,
+        "zero_retraces": all(a.get("retraces") == 0 for a in arms if a),
+        "readback_within_budget": all(
+            a.get("readback_bytes_per_pod", 1e9) <= READBACK_BUDGET
+            for a in arms if a),
+        "no_errors": not out["errors"],
+    }
+    out["peak_rss_gb"] = round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 2)
+    print(json.dumps(out, indent=1))
+    return 0 if all(out["criteria"].values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,17 +58,31 @@ def main():
     pk = SnapshotPacker()
     for p in pods:
         pk.intern_pod(p)
-    a, _, _ = batch_assign(pods_to_device(pk.pack_pods(pods)),
-                           nodes_to_device(pk.pack_nodes(nodes, [])),
+    nt = pk.pack_nodes(nodes, [])
+    pt = pk.pack_pods(pods)
+    a, _, _ = batch_assign(pods_to_device(pt),
+                           nodes_to_device(nt),
                            selectors_to_device(pk.pack_selector_tables()),
                            per_node_cap=2)
-    default_points = points(np.asarray(a)[:len(pods)])
+    assigned = np.asarray(a)[:len(pods)]
+    default_points = points(assigned)
 
+    # solution scores via the ONE source of truth
+    # (kubernetes_tpu/scenarios/quality.py — the scenario-pack PR moved
+    # mean_score/balanced there; this script used to have no comparable
+    # figure and bench.py carried a private copy of the arithmetic)
+    from kubernetes_tpu.scenarios.quality import node_resources_score
+
+    sel = assigned >= 0
+    final_req = np.asarray(nt.requested).copy()
+    np.add.at(final_req, assigned[sel], np.asarray(pt.req)[:len(pods)][sel])
     out = {
         "workload": sizes,
         "argmax_points": results[False],
         "sinkhorn_points": results[True],
         "default_config_points": default_points,
+        "default_config_scores": node_resources_score(
+            np.asarray(nt.allocatable), final_req, assigned),
         "auto_router_engaged": default_points == results[True],
         "verdict": ("sinkhorn_wins" if results[True] > results[False]
                     else ("identical" if results[True] == results[False]

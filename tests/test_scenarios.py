@@ -1,0 +1,748 @@
+"""Scenario packs (kubernetes_tpu/scenarios + ops/scenario_cost): the
+pluggable-objective subsystem's tier-1 acceptance.
+
+- consolidation pack strictly beats the stock objective on nodes-used
+  at equal feasibility, quality scores land on CycleResult / flight
+  record / metrics;
+- quality_reduce device-vs-numpy reference parity (randomized, seeded);
+- the in-batch preemption cascade selects BIT-IDENTICAL victim sets to
+  the stock per-pod path for single-pod batches (seeded parity — the
+  satellite contract) and re-places displaced victims in the SAME
+  cycle;
+- gang-topology pack co-locates whole gangs onto home slices with
+  all-or-nothing semantics;
+- scenario: config block (native decode, validate_config gates,
+  v1alpha1 round-trip, --scenario flag);
+- the bench_compare ``scenario`` quality-gate family contract
+  (regressions + absolute invariants + single-record tolerance +
+  --list-gates registration);
+- graftlint coverage extends to kubernetes_tpu/scenarios/ (parse set +
+  kernel lint_clean — quality reductions must not introduce undeclared
+  readbacks);
+- one source of truth for mean_score/balanced (bench.py delegates to
+  scenarios/quality.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.config import ScenarioConfig
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    path = os.path.join(REPO_ROOT, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _cluster(s, n=8, cpu=4000.0, mem=8 * 2**30, zones=0):
+    for i in range(n):
+        zone = f"slice-{i % zones}" if zones else None
+        s.on_node_add(make_node(f"n{i}", cpu_milli=cpu, memory=mem,
+                                pods=110, zone=zone))
+
+
+# ---------------------------------------------------------------------------
+# consolidation pack
+# ---------------------------------------------------------------------------
+
+
+def test_consolidation_beats_stock_nodes_used():
+    def run(scenario):
+        s = Scheduler(scenario=scenario, enable_preemption=False)
+        _cluster(s, n=8)
+        for i in range(12):
+            s.on_pod_add(make_pod(f"p{i}", cpu_milli=500, memory=2**30))
+        return s, s.schedule_cycle()
+
+    s_pack, r_pack = run(ScenarioConfig(pack="consolidation",
+                                        fill_block=1))
+    s_stock, r_stock = run(None)
+    assert r_pack.scheduled == r_stock.scheduled == 12  # equal feasibility
+    used_pack = len(set(r_pack.assignments.values()))
+    used_stock = len(set(r_stock.assignments.values()))
+    assert used_pack < used_stock  # the strict quality win
+    # the device-reduced quality vector agrees with the host count
+    q = r_pack.scenario_quality
+    assert q["nodes_used"] == used_pack
+    assert q["placed"] == 12
+    assert 0.0 <= q["headroom"] <= 1.0
+    assert 0.0 <= q["fragmentation"] <= 1.0
+    # ... and landed on the flight record + the metrics gauge
+    rec = s_pack.obs.recorder.records()[-1]
+    assert rec.scenario["nodes_used"] == used_pack
+    assert "scenario" in rec.to_json()
+    assert s_pack.metrics.scenario_quality.value(
+        score="nodes_used") == used_pack
+    # stock cycles carry no quality block (zero overhead when off)
+    assert r_stock.scenario_quality == {}
+
+
+def test_consolidation_objective_rides_greedy_tier():
+    """Objective selection THROUGH the ladder: the pack's weights +
+    cost term produce packed placements on the greedy oracle tier too,
+    not only the batch solver."""
+    s = Scheduler(scenario=ScenarioConfig(pack="consolidation",
+                                          fill_block=1),
+                  solver="greedy", enable_preemption=False)
+    _cluster(s, n=8)
+    for i in range(12):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=500, memory=2**30))
+    r = s.schedule_cycle()
+    assert r.solver_tier == "greedy"
+    assert r.scheduled == 12
+    assert len(set(r.assignments.values())) <= 3
+
+
+def test_scenario_pack_overrides_weights():
+    s = Scheduler(scenario=ScenarioConfig(pack="consolidation"))
+    assert s.weights == {"MostRequestedPriority": 3,
+                         "BalancedResourceAllocation": 1}
+    assert s.scenario_pack is not None
+    # off = stock objective, no pack object at all
+    s2 = Scheduler()
+    assert s2.scenario_pack is None
+
+
+# ---------------------------------------------------------------------------
+# quality reduction: device vs numpy reference
+# ---------------------------------------------------------------------------
+
+
+def _ref_quality(assigned, usage_req, pods_valid, pods_req, pods_pri,
+                 nodes_valid, alloc):
+    from kubernetes_tpu.snapshot import RES_CPU, RES_MEM, RES_PODS
+
+    assigned = np.asarray(assigned)
+    placed_mask = pods_valid & (assigned >= 0)
+    ac = np.clip(assigned, 0, nodes_valid.shape[0] - 1)
+    nodes_used = int(np.sum(nodes_valid & (usage_req[:, RES_PODS] > 0)))
+    got = np.zeros(nodes_valid.shape[0], bool)
+    got[ac[placed_mask]] = True
+    nodes_used_batch = int(np.sum(got & nodes_valid))
+    placed = int(np.sum(placed_mask))
+    cap_cpu = np.maximum(alloc[:, RES_CPU], 1e-9)
+    cap_mem = np.maximum(alloc[:, RES_MEM], 1e-9)
+    free_cpu = np.maximum(alloc[:, RES_CPU] - usage_req[:, RES_CPU], 0.0)
+    free_mem = np.maximum(alloc[:, RES_MEM] - usage_req[:, RES_MEM], 0.0)
+    mff = np.minimum(free_cpu / cap_cpu, free_mem / cap_mem)
+    n_valid = max(int(np.sum(nodes_valid)), 1)
+    headroom = float(np.sum(np.where(nodes_valid, mff, 0.0)) / n_valid)
+    mean_req = float(np.sum(np.where(pods_valid[:, None], pods_req,
+                                     0.0)[:, RES_CPU])
+                     / max(int(np.sum(pods_valid)), 1))
+    total_free = float(np.sum(np.where(nodes_valid, free_cpu, 0.0)))
+    stranded = float(np.sum(np.where(
+        nodes_valid & (free_cpu < max(mean_req, 1e-9)), free_cpu, 0.0)))
+    frag = stranded / max(total_free, 1e-9)
+    pri = pods_pri.astype(np.float64)
+    if placed:
+        pri_min = pri[placed_mask].min()
+        w = np.where(placed_mask, pri - pri_min + 1.0, 0.0)
+        ph = float(np.sum(w * mff[ac]) / max(np.sum(w), 1e-9))
+    else:
+        ph = 0.0
+    return {"nodes_used": nodes_used, "nodes_used_batch": nodes_used_batch,
+            "placed": placed, "headroom": headroom, "fragmentation": frag,
+            "priority_headroom": ph}
+
+
+def test_quality_reduce_matches_numpy_reference():
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.ops.arrays import nodes_to_device, pods_to_device
+    from kubernetes_tpu.ops.scenario_cost import quality_reduce
+    from kubernetes_tpu.scenarios.quality import decode_quality
+    from kubernetes_tpu.snapshot import SnapshotPacker
+
+    rng = np.random.RandomState(7)
+    for _ in range(3):
+        n, p = rng.randint(4, 12), rng.randint(3, 20)
+        nodes = [make_node(f"n{i}", cpu_milli=float(rng.randint(2, 8)) * 1000,
+                           memory=float(rng.randint(4, 16)) * 2**30)
+                 for i in range(n)]
+        pods = [make_pod(f"p{i}", cpu_milli=float(rng.randint(1, 20)) * 100,
+                         memory=float(rng.randint(1, 4)) * 2**28,
+                         priority=int(rng.randint(0, 3) * 50))
+                for i in range(p)]
+        pk = SnapshotPacker()
+        for q in pods:
+            pk.intern_pod(q)
+        nt = pk.pack_nodes(nodes, [])
+        pt = pk.pack_pods(pods)
+        dn = nodes_to_device(nt)
+        dp = pods_to_device(pt)
+        P, N = dp.valid.shape[0], dn.valid.shape[0]
+        assigned = np.where(rng.rand(P) < 0.7,
+                            rng.randint(0, n, size=P), -1).astype(np.int32)
+        assigned[p:] = -1
+        # final usage from the assignment (requested starts at zero)
+        usage = np.asarray(dn.requested).copy()
+        sel = (assigned >= 0) & np.asarray(dp.valid)
+        np.add.at(usage, assigned[sel], np.asarray(dp.req)[sel])
+        got = decode_quality(quality_reduce(
+            jnp.asarray(assigned), jnp.asarray(usage), dp, dn))
+        want = _ref_quality(assigned, usage, np.asarray(dp.valid),
+                            np.asarray(dp.req),
+                            np.asarray(dp.priority),
+                            np.asarray(dn.valid),
+                            np.asarray(dn.allocatable))
+        for k, v in want.items():
+            assert got[k] == pytest.approx(v, abs=2e-4), (k, got, want)
+
+
+def test_slice_distance_hierarchy():
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.ops.scenario_cost import slice_distance
+    from kubernetes_tpu.scenarios.quality import slice_distance_host
+
+    za = jnp.asarray([0, 0, 0, 5, -1])
+    zb = jnp.asarray([0, 3, 4, 7, 2])
+    # superpod=4: slices 0-3 share a superpod, 4-7 the next
+    assert np.asarray(slice_distance(za, zb, superpod=4)).tolist() == \
+        [0, 1, 2, 1, 2]
+    # the host twin (what gang_stats reports) is parity-pinned against
+    # the device kernel (what the solve optimizes) across a grid
+    grid = np.arange(-1, 12, dtype=np.int32)
+    for sp in (1, 2, 4, 8):
+        dev = np.asarray(slice_distance(
+            jnp.asarray(grid)[:, None], jnp.asarray(grid)[None, :],
+            superpod=sp))
+        host = slice_distance_host(grid[:, None], grid[None, :], sp)
+        assert (dev == host).all(), sp
+
+
+# ---------------------------------------------------------------------------
+# in-batch preemption cascade
+# ---------------------------------------------------------------------------
+
+
+def _preemption_cluster(seed):
+    """Seeded cluster with bound low-priority pods and one high-priority
+    pod that cannot fit anywhere without eviction. Bound pods are fed
+    PRE-BOUND (node_name set) so stock and cascade schedulers start
+    from the identical state regardless of objective."""
+    rng = np.random.RandomState(seed)
+    n = rng.randint(3, 6)
+    nodes = [make_node(f"n{i}", cpu_milli=2000, memory=4 * 2**30, pods=10)
+             for i in range(n)]
+    bound = []
+    for i in range(n):
+        for j in range(rng.randint(1, 3)):
+            bound.append(make_pod(
+                f"low{i}{j}", cpu_milli=float(rng.choice([600, 900, 1200])),
+                memory=2**28, priority=int(rng.randint(0, 3)),
+                node_name=f"n{i}", start_time=float(j)))
+    high = make_pod("high", cpu_milli=1800, memory=2**28, priority=100)
+    return nodes, bound, high
+
+
+def _run_preemption(scenario, seed):
+    events = []
+    s = Scheduler(scenario=scenario,
+                  event_sink=lambda r, p, m:
+                  events.append((r, getattr(p, "name", ""), m)))
+    nodes, bound, high = _preemption_cluster(seed)
+    for nd in nodes:
+        s.on_node_add(nd)
+    for p in bound:
+        s.on_pod_add(p)
+    s.on_pod_add(high)
+    r = s.schedule_cycle()
+    victims = sorted(n for e, n, _ in events if e == "Preempted")
+    return s, r, victims
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_cascade_victim_parity_single_pod_batches(seed):
+    """The satellite contract: for single-pod batches the in-batch
+    cascade and the per-pod preemption.py path agree on victim sets
+    (selection shares one source of truth — preemption.preempt)."""
+    _, r_stock, v_stock = _run_preemption(None, seed)
+    _, r_casc, v_casc = _run_preemption(
+        ScenarioConfig(pack="consolidation", preempt_in_batch=True), seed)
+    assert v_casc == v_stock
+    assert r_casc.preempted == r_stock.preempted
+    if v_stock:
+        # the stock path NOMINATES and waits; the cascade binds the
+        # preemptor in the SAME cycle (grace-0 batch semantics)
+        assert "default/high" in r_casc.assignments
+        assert "default/high" not in r_stock.assignments
+        assert r_stock.nominations.get("default/high")
+
+
+def test_cascade_displaced_pods_replace_same_cycle():
+    """Victims with room elsewhere MIGRATE within the cycle: the
+    displaced pods re-enter the dense solve and bind onto other
+    nodes — nothing waits for a next cycle."""
+    s = Scheduler(scenario=ScenarioConfig(pack="consolidation",
+                                          fill_block=1))
+    s.on_node_add(make_node("n0", cpu_milli=2000, memory=4 * 2**30))
+    # n1 is too small for high but big enough for both displaced lows
+    s.on_node_add(make_node("n1", cpu_milli=1700, memory=4 * 2**30))
+    # n0 holds two small low-priority pods; n1 stays empty
+    for j in range(2):
+        s.on_pod_add(make_pod(f"low{j}", cpu_milli=800, memory=2**28,
+                              priority=0, node_name="n0"))
+    # high fits NOWHERE without eviction: n0 free 400, n1 total 1700
+    s.on_pod_add(make_pod("high", cpu_milli=1900, memory=2**28,
+                          priority=100))
+    r = s.schedule_cycle()
+    assert r.assignments.get("default/high") == "n0"
+    assert r.preempted == 2
+    # both displaced pods re-placed onto n1 in the SAME cycle
+    assert r.assignments.get("default/low0") == "n1"
+    assert r.assignments.get("default/low1") == "n1"
+    assert r.unschedulable == 0
+    assert s.metrics.scenario_displaced_replaced.value() == 2
+    assert s.metrics.scenario_cascade_victims.value() == 2
+    # capacity invariant: nothing over-committed after the migration
+    for nd in s.cache.nodes():
+        used = sum(p.requests.cpu_milli for p in s.cache.pods_on(nd.name))
+        assert used <= nd.allocatable.cpu_milli + 1e-6
+
+
+def test_cascade_multi_preemptor_victims_match_stock():
+    """Review pin: the cascade's nominated view must EVOLVE like the
+    stock loop's (each successful preemptor becomes a phantom occupant
+    of its chosen node) — otherwise a second preemptor sees the first's
+    evacuated capacity as free and the victim sets diverge."""
+    def build(scenario):
+        events = []
+        s = Scheduler(scenario=scenario,
+                      event_sink=lambda r, p, m:
+                      events.append((r, getattr(p, "name", ""))))
+        s.on_node_add(make_node("x", cpu_milli=2000, memory=4 * 2**30))
+        s.on_node_add(make_node("y", cpu_milli=2000, memory=4 * 2**30))
+        for j in range(2):
+            s.on_pod_add(make_pod(f"low{j}", cpu_milli=800, memory=2**28,
+                                  priority=0, node_name="x"))
+            s.on_pod_add(make_pod(f"mid{j}", cpu_milli=800, memory=2**28,
+                                  priority=50, node_name="y"))
+        # two preemptors contending: P1 takes x (cheapest victims);
+        # with x promised, P2 must evict the mids on y — a cascade that
+        # forgot the phantom P1 would hand P2 the evacuated x for free
+        s.on_pod_add(make_pod("p1", cpu_milli=1900, memory=2**28,
+                              priority=200))
+        s.on_pod_add(make_pod("p2", cpu_milli=1900, memory=2**28,
+                              priority=100))
+        s.schedule_cycle()
+        return sorted(n for e, n in events if e == "Preempted")
+
+    v_stock = build(None)
+    v_casc = build(ScenarioConfig(pack="consolidation",
+                                  preempt_in_batch=True))
+    assert v_stock == ["low0", "low1", "mid0", "mid1"]
+    assert v_casc == v_stock
+
+
+def test_cascade_never_binds_gang_members_solo():
+    """Review pin: a GANG preemptor must not bind through the cascade
+    re-solve (that would sidestep the all-or-nothing rollback and could
+    leave a partially-bound gang) — it keeps the stock nomination
+    semantics while its victims evacuate."""
+    s = Scheduler(scenario=ScenarioConfig(pack="consolidation",
+                                          fill_block=1))
+    s.on_node_add(make_node("n0", cpu_milli=2000, memory=4 * 2**30))
+    for j in range(2):
+        s.on_pod_add(make_pod(f"low{j}", cpu_milli=800, memory=2**28,
+                              priority=0, node_name="n0"))
+    # a 2-member gang where only ONE member can ever fit (one node):
+    # the fitting member must not bind alone via the cascade
+    for m in range(2):
+        s.on_pod_add(make_pod(f"gm{m}", cpu_milli=1900, memory=2**28,
+                              priority=100, pod_group="gang0",
+                              pod_group_min_available=2))
+    r = s.schedule_cycle()
+    bound_gang = [k for k in r.assignments if "gm" in k]
+    assert bound_gang == []  # atomicity held through the cascade
+    assert r.scenario_quality.get("gang_partial_binds", 0) == 0
+    # the evicted lows must NOT retake the capacity promised to the
+    # nominated gang preemptor — they requeue instead of re-solving
+    assert not any("low" in k for k in r.assignments)
+    assert s.queue.pod("default/low0") is not None
+    assert r.nominations
+
+
+def test_cascade_budget_overflow_requeues_displaced():
+    """Review pin: displaced victims truncated by cascade_max_pods are
+    already evicted — they must requeue through the standard error
+    path, never silently vanish."""
+    s = Scheduler(scenario=ScenarioConfig(pack="consolidation",
+                                          fill_block=1,
+                                          cascade_max_pods=1))
+    s.on_node_add(make_node("n0", cpu_milli=2000, memory=4 * 2**30))
+    s.on_node_add(make_node("n1", cpu_milli=1700, memory=4 * 2**30))
+    for j in range(2):
+        s.on_pod_add(make_pod(f"low{j}", cpu_milli=800, memory=2**28,
+                              priority=0, node_name="n0"))
+    s.on_pod_add(make_pod("high", cpu_milli=1900, memory=2**28,
+                          priority=100))
+    r = s.schedule_cycle()
+    assert r.preempted == 2
+    # budget 1: the preemptor takes the one re-solve slot; both
+    # displaced lows overflow — each must carry a failure row and sit
+    # in the queue for the next cycle
+    for j in range(2):
+        key = f"default/low{j}"
+        assert key in r.failure_reasons
+        assert s.queue.pod(key) is not None
+    # counts stay one-per-pod: high bound, two lows unschedulable
+    assert r.assignments.get("default/high") == "n0"
+    assert r.unschedulable == 2
+
+
+def test_cascade_victimless_win_still_nominates(monkeypatch):
+    """Review pin: pick_one_node lets a node with NO victims win
+    immediately (all candidates reprieved / an extender shrank the
+    list) — the cascade must still nominate the preemptor like the
+    stock path instead of dropping the win on the empty victim set."""
+    import kubernetes_tpu.scenarios.cascade as cascade_mod
+    from kubernetes_tpu.scenarios.cascade import CascadeSelection
+
+    def fake_select(preemptors, *a, **k):
+        sel = CascadeSelection()
+        sel.chosen[preemptors[0][0].key()] = "n0"
+        return sel
+
+    monkeypatch.setattr(cascade_mod, "select_cascade", fake_select)
+    s = Scheduler(scenario=ScenarioConfig(pack="consolidation"))
+    s.on_node_add(make_node("n0", cpu_milli=2000, memory=4 * 2**30))
+    s.on_pod_add(make_pod("low", cpu_milli=1500, memory=2**28,
+                          priority=0, node_name="n0"))
+    s.on_pod_add(make_pod("high", cpu_milli=1900, memory=2**28,
+                          priority=100))
+    r = s.schedule_cycle()
+    assert r.nominations.get("default/high") == "n0"
+    assert r.preempted == 0
+
+
+def test_scenario_quality_gauge_freshness():
+    """Review pin: a score that stops being reported (gang_locality
+    after a gangless cycle) drops to zero on the gauge instead of
+    reading as current — the explain-gauge freshness rule."""
+    s = Scheduler(scenario=ScenarioConfig(pack="gang-topology"),
+                  enable_preemption=False)
+    _cluster(s, n=4, cpu=8000, mem=16 * 2**30, zones=2)
+    for m in range(2):
+        s.on_pod_add(make_pod(f"gm{m}", cpu_milli=1000, memory=2**30,
+                              pod_group="gang0",
+                              pod_group_min_available=2))
+    s.schedule_cycle()
+    assert s.metrics.scenario_quality.value(score="gang_locality") == 2.0
+    s.on_pod_add(make_pod("solo", cpu_milli=1000, memory=2**30))
+    s.schedule_cycle()  # gangless cycle: locality is not reported
+    assert s.metrics.scenario_quality.value(score="gang_locality") == 0.0
+
+
+def test_cascade_off_keeps_stock_path():
+    """preempt_in_batch=False: the pack objective runs but preemption
+    stays the per-pod nominate-and-wait loop."""
+    _, r, victims = _run_preemption(
+        ScenarioConfig(pack="consolidation", preempt_in_batch=False), 1)
+    if victims:
+        assert "default/high" not in r.assignments
+        assert r.nominations.get("default/high")
+
+
+# ---------------------------------------------------------------------------
+# gang-topology pack
+# ---------------------------------------------------------------------------
+
+
+def test_gang_topology_colocates_whole_gangs():
+    s = Scheduler(scenario=ScenarioConfig(pack="gang-topology"),
+                  enable_preemption=False)
+    _cluster(s, n=8, cpu=8000, mem=16 * 2**30, zones=4)
+    for g in range(2):
+        for m in range(4):
+            s.on_pod_add(make_pod(
+                f"g{g}m{m}", cpu_milli=1000, memory=2**30,
+                pod_group=f"gang{g}", pod_group_min_available=4))
+    r = s.schedule_cycle()
+    assert r.scheduled == 8
+    q = r.scenario_quality
+    assert q["gang_groups"] == 2
+    assert q["gang_success_rate"] == 1.0
+    assert q["gang_partial_binds"] == 0
+    assert q["gang_locality"] == 2.0  # every gang whole on one slice
+    # gangs landed on DIFFERENT home slices (greedy spreads demand);
+    # _cluster puts node i in zone i % 4
+    gang_zones = {}
+    for k, n in r.assignments.items():
+        gang_zones.setdefault(k.split("/")[-1][:2], set()).add(
+            int(n[1:]) % 4)
+    assert all(len(z) == 1 for z in gang_zones.values())
+    assert gang_zones["g0"] != gang_zones["g1"]
+
+
+def test_gang_all_or_nothing_with_pack():
+    """A gang that cannot fully fit binds NOTHING under the pack (the
+    scheduler's rollback), and the quality block reports the failure
+    honestly: zero partial binds, success rate 0."""
+    s = Scheduler(scenario=ScenarioConfig(pack="gang-topology"),
+                  enable_preemption=False)
+    _cluster(s, n=2, cpu=2000, mem=4 * 2**30, zones=2)
+    for m in range(8):  # demands 8000m; cluster holds 4000m
+        s.on_pod_add(make_pod(f"gm{m}", cpu_milli=1000, memory=2**28,
+                              pod_group="gang0",
+                              pod_group_min_available=8))
+    r = s.schedule_cycle()
+    assert r.scheduled == 0
+    q = r.scenario_quality
+    assert q["gang_partial_binds"] == 0
+    assert q["gang_success_rate"] == 0.0
+    assert q["gangs_placed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# config: native decode, validation, v1alpha1 round-trip, CLI flag
+# ---------------------------------------------------------------------------
+
+
+def test_scenario_config_native_decode_and_validation():
+    from kubernetes_tpu.cli import ConfigError, decode_config, validate_config
+
+    cfg = decode_config({"scenario": {"pack": "consolidation",
+                                      "cost_weight": 2.0,
+                                      "fill_block": 32}})
+    assert cfg.scenario.pack == "consolidation"
+    assert cfg.scenario.fill_block == 32
+    assert validate_config(cfg) == []
+    # unknown field rejected
+    with pytest.raises(ConfigError):
+        decode_config({"scenario": {"packk": "x"}})
+    # unknown pack name, bad knobs -> field-path errors
+    bad = decode_config({"scenario": {"pack": "nope", "cost_weight": -1,
+                                      "cascade_max_pods": 0,
+                                      "superpod": 0, "fill_block": 0}})
+    errs = validate_config(bad)
+    assert any("scenario.pack" in e for e in errs)
+    assert any("scenario.costWeight" in e for e in errs)
+    assert any("scenario.cascadeMaxPods" in e for e in errs)
+    assert any("scenario.superpod" in e for e in errs)
+    assert any("scenario.fillBlock" in e for e in errs)
+
+
+def test_scenario_v1alpha1_roundtrip():
+    from kubernetes_tpu.api.config_v1alpha1 import decode, encode
+    from kubernetes_tpu.config import KubeSchedulerConfiguration
+
+    doc = {
+        "apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
+        "kind": "KubeSchedulerConfiguration",
+        "scenario": {"pack": "gang-topology", "costWeight": 6.0,
+                     "preemptInBatch": False, "cascadeMaxPods": 256,
+                     "superpod": 8, "fillBlock": 16, "quality": False},
+    }
+    cfg = decode(doc)
+    sn = cfg.scenario
+    assert sn.pack == "gang-topology"
+    assert sn.cost_weight == 6.0
+    assert sn.preempt_in_batch is False
+    assert sn.cascade_max_pods == 256
+    assert sn.superpod == 8
+    assert sn.fill_block == 16
+    assert sn.quality is False
+    wire = encode(cfg)
+    assert wire["scenario"]["pack"] == "gang-topology"
+    assert decode(wire) == cfg
+    # defaulting: an absent block decodes to the off config
+    cfg2 = decode({"apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
+                   "kind": "KubeSchedulerConfiguration"})
+    assert cfg2.scenario == KubeSchedulerConfiguration().scenario
+
+
+def test_scenario_cli_flag():
+    from kubernetes_tpu.cli import build_parser, resolve_config
+
+    args = build_parser().parse_args(["--scenario", "consolidation"])
+    cfg = resolve_config(args)
+    assert cfg.scenario.pack == "consolidation"
+    from kubernetes_tpu.cli import ConfigError
+
+    args = build_parser().parse_args(["--scenario", "bogus"])
+    with pytest.raises(ConfigError):
+        resolve_config(args)
+
+
+# ---------------------------------------------------------------------------
+# bench_compare: the scenario quality-gate family
+# ---------------------------------------------------------------------------
+
+
+def _scenario_record(nodes_used=1500, stock_nodes=5000, equal=True,
+                     success=1.0, partial=0, locality=2.0, retraces=0,
+                     bpp=4.4, pps=10000.0):
+    return {
+        "consolidation": {
+            "stock": {"nodes_used": stock_nodes, "placed": 12288,
+                      "retraces": retraces,
+                      "readback_bytes_per_pod": bpp},
+            "pack": {"nodes_used": nodes_used, "placed": 12288,
+                     "pods_per_sec": pps, "retraces": retraces,
+                     "readback_bytes_per_pod": bpp},
+            "equal_feasibility": equal,
+        },
+        "gang": {
+            "pack": {"gang_success_rate": success,
+                     "gang_partial_binds": partial,
+                     "gang_locality": locality, "pods_per_sec": pps,
+                     "retraces": retraces,
+                     "readback_bytes_per_pod": bpp},
+        },
+        "errors": [],
+    }
+
+
+def test_bench_compare_scenario_gates():
+    bc = _load_script("bench_compare")
+    ok = bc.compare_scenario(_scenario_record(), _scenario_record(), 0.10)
+    assert not ok["regressions"], ok["regressions"]
+
+    # quality regression: nodes_used grew past the threshold
+    worse = bc.compare_scenario(
+        _scenario_record(nodes_used=1500),
+        _scenario_record(nodes_used=2000), 0.10)
+    assert any(r["check"] == "scenario.consolidation.nodes_used"
+               for r in worse["regressions"])
+
+    # absolute: the pack must STRICTLY beat stock on the new record
+    tie = bc.compare_scenario(
+        _scenario_record(), _scenario_record(nodes_used=5000), 0.10)
+    assert any(
+        r["check"] == "scenario.consolidation.beats_stock_nodes_used"
+        for r in tie["regressions"])
+
+    # absolute: one partially-bound gang is a correctness bug
+    part = bc.compare_scenario(
+        _scenario_record(), _scenario_record(partial=1, success=0.99),
+        0.10)
+    names = {r["check"] for r in part["regressions"]}
+    assert "scenario.gang.gang_partial_binds" in names
+    assert "scenario.gang.gang_success_rate_1" in names
+
+    # absolute: retraces + readback budget
+    rb = bc.compare_scenario(
+        _scenario_record(), _scenario_record(retraces=2, bpp=40.0), 0.10)
+    names = {r["check"] for r in rb["regressions"]}
+    assert "scenario.gang.pack.retraces" in names
+    assert "scenario.gang.pack.readback_budget" in names
+
+    # single-record tolerance: empty prev -> deltas warn, absolutes run
+    single = bc.compare_scenario({}, _scenario_record(), 0.10)
+    assert not single["regressions"]
+    assert any("not comparable" in w for w in single["warnings"])
+
+
+def test_bench_compare_lists_scenario_gate_family():
+    bc = _load_script("bench_compare")
+    assert any(n == "scenario" for n, _, _ in bc.GATE_FAMILIES)
+    # the CLI surface agrees (what docs/scenarios.md references)
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bc.main(["--list-gates"])
+    assert rc == 0
+    assert "scenario" in buf.getvalue()
+    assert "scenario_r*.json" in buf.getvalue()
+
+
+def test_bench_compare_end_to_end_with_scenario_records(tmp_path):
+    bc = _load_script("bench_compare")
+    d = tmp_path / "benchres"
+    d.mkdir()
+    (d / "scenario_r01.json").write_text(json.dumps(_scenario_record()))
+    (d / "scenario_r02.json").write_text(
+        json.dumps(_scenario_record(nodes_used=1400)))
+    assert bc.main(["--dir", str(d)]) == 0
+    (d / "scenario_r03.json").write_text(
+        json.dumps(_scenario_record(partial=3, success=0.5)))
+    assert bc.main(["--dir", str(d)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# lint + parse coverage, one-source-of-truth folds
+# ---------------------------------------------------------------------------
+
+
+def test_scenario_kernels_lint_clean():
+    """The quality reductions and cost kernels must not introduce
+    undeclared readbacks or tracer hazards (graftlint R2/R3 + the
+    R7 discipline rides the repo-wide gate in test_static_analysis)."""
+    import kubernetes_tpu.ops.scenario_cost as sc
+    from kubernetes_tpu.testing import lint_clean
+
+    lint_clean(sc)
+
+
+def test_scenarios_package_in_parse_and_lint_roots():
+    """kubernetes_tpu/scenarios/ rides the repo-wide parse + lint gates
+    (recursive discovery) — pinned so a future root reshuffle cannot
+    silently drop the package."""
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tests"))
+    from test_static_analysis import _first_party_files
+
+    files = {os.path.relpath(f, REPO_ROOT) for f in _first_party_files()}
+    for rel in ("kubernetes_tpu/scenarios/packs.py",
+                "kubernetes_tpu/scenarios/quality.py",
+                "kubernetes_tpu/scenarios/cascade.py",
+                "kubernetes_tpu/ops/scenario_cost.py",
+                "scripts/bench_scenarios.py"):
+        assert rel in files, rel
+
+
+def test_node_resources_score_single_source():
+    """bench.py's mean_score/balanced delegates to scenarios/quality —
+    the one source of truth the sinkhorn_quality script also uses."""
+    import bench as bench_mod
+    from kubernetes_tpu.scenarios.quality import node_resources_score
+
+    alloc = np.asarray([[4000.0, 8.0, 0.0, 110.0]])
+    req = np.asarray([[1000.0, 2.0, 0.0, 2.0]])
+    assigned = np.asarray([0, 0, -1])
+    assert (bench_mod.node_resources_score(alloc, req, assigned)
+            == node_resources_score(alloc, req, assigned))
+    src = __import__("inspect").getsource(bench_mod.node_resources_score)
+    assert "scenarios.quality" in src
+
+
+# ---------------------------------------------------------------------------
+# warmup: scenario cycles stay retrace-free
+# ---------------------------------------------------------------------------
+
+
+def test_scenario_warmup_covers_cost_and_quality():
+    from kubernetes_tpu.config import WarmupConfig
+
+    s = Scheduler(scenario=ScenarioConfig(pack="consolidation",
+                                          fill_block=1),
+                  warmup=WarmupConfig(enabled=True, pod_buckets=(8,)),
+                  enable_preemption=False)
+    _cluster(s, n=4)
+    compiled = s.warmup(sample_pods=[
+        make_pod("warm", cpu_milli=500, memory=2**30)])
+    assert compiled >= 1
+    for i in range(6):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=500, memory=2**30))
+    r = s.schedule_cycle()
+    assert r.scheduled == 6
+    assert r.scenario_quality["placed"] == 6
+    assert s.obs.jax.retrace_total() == 0

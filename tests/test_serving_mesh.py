@@ -473,5 +473,92 @@ def test_bench_compare_list_gates_names_every_family(capsys):
     assert bc.main(["--list-gates"]) == 0
     out = capsys.readouterr().out
     for family in ("headline", "explain", "retrace", "readback",
-                   "churn", "recovery", "mesh", "churn_mesh"):
+                   "churn", "recovery", "mesh", "churn_mesh", "scenario"):
         assert family in out
+
+
+# ---------------------------------------------------------------------------
+# scenario satellite: gang atomicity under shard loss
+# ---------------------------------------------------------------------------
+
+
+def test_gang_atomicity_under_shard_loss():
+    """A ShardLost mid-cycle must never leave a partially-bound gang:
+    the gang-topology pack churns all-or-nothing gangs through a live
+    shard loss — the loss -> host-mode cooloff -> healed-sharded arc —
+    and after EVERY cycle each gang is either fully bound or not bound
+    at all (the composed chaos pattern, gang workload edition)."""
+    from kubernetes_tpu.config import RecoveryConfig, ScenarioConfig
+
+    clk = FakeClock()
+    truth = Truth()
+    s = Scheduler(
+        clock=clk, enable_preemption=False, binder=truth.binder(),
+        parallel=ParallelConfig(mesh=2),
+        recovery=RecoveryConfig(device_reset_limit=1, device_cooloff_s=5.0),
+        warmup=WarmupConfig(enabled=True, pod_buckets=(8,),
+                            host_fallback=True),
+        scenario=ScenarioConfig(pack="gang-topology"),
+    )
+    for i in range(8):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=64000,
+                                memory=256 * 2**30, pods=500,
+                                zone=f"slice-{i % 4}"))
+    assert s.warmup(sample_pods=[
+        make_pod("warm", cpu_milli=POD_CPU, memory=POD_MEM)]) > 0
+
+    GANG = 8
+    gid = 0
+
+    def churn_one_gang():
+        nonlocal gid
+        batch = [make_pod(f"g{gid}m{m}", cpu_milli=POD_CPU,
+                          memory=POD_MEM, pod_group=f"gang{gid}",
+                          pod_group_min_available=GANG)
+                 for m in range(GANG)]
+        gid += 1
+        for p in batch:
+            truth.created[p.key()] = p
+            s.on_pod_add(p)
+        r = s.schedule_cycle()
+        clk.advance(0.25)
+        return r
+
+    def assert_atomic():
+        per_gang = {}
+        for key in truth.created:
+            g = key.split("/")[-1].split("m")[0]
+            per_gang.setdefault(g, [0, 0])
+            per_gang[g][0] += 1
+            per_gang[g][1] += 1 if key in truth.bound else 0
+        for g, (total, bound) in per_gang.items():
+            assert bound in (0, total), (g, bound, total)
+
+    chaos = MeshChaos(s, shard=1)
+    for _ in range(2):  # healthy sharded cycles
+        r = churn_one_gang()
+        chaos.observe(r, clk())
+        assert r.scheduled == GANG
+        assert_atomic()
+    chaos.lose_shard(clk())  # the next snapshot raises ShardLost
+    r = churn_one_gang()  # mid-loss cycle: host-mode fallback
+    chaos.observe(r, clk())
+    assert r.snapshot_mode == "host"
+    assert r.scheduled == GANG  # the gang still bound, whole
+    assert r.scenario_quality["gang_partial_binds"] == 0
+    assert_atomic()
+    clk.advance(6.0)  # past the cooloff: heal probe re-shards
+    r = churn_one_gang()
+    chaos.observe(r, clk())
+    assert r.snapshot_mode in ("full", "delta", "clean")
+    assert r.scheduled == GANG
+    assert_atomic()
+    rep = chaos.report()
+    assert rep["healed_sharded"] and rep["host_mode_cycles"] == 1
+    assert truth.double_bind_attempts == 0
+    # every quality block across the arc reported atomicity held
+    for rec in s.obs.recorder.records():
+        if rec.scenario:
+            assert rec.scenario.get("gang_partial_binds", 0) == 0
+    # zero solve-site retraces across loss + heal (host_fallback warm)
+    assert s.obs.jax.retrace_total() == 0
