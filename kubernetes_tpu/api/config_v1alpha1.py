@@ -172,6 +172,21 @@ class WarmupConfigurationV1alpha1:
 
 
 @dataclass
+class IncrementalConfigurationV1alpha1:
+    """Versioned spelling of the incremental-solve block
+    (config.IncrementalConfig): camelCase; fractions stay raw floats
+    (no duration fields to re-spell)."""
+
+    enabled: Optional[bool] = None
+    candidateBucket: Optional[int] = None
+    maxBatchFrac: Optional[float] = None
+    maxDirtyFrac: Optional[float] = None
+    warmPotentials: Optional[bool] = None
+    warmTol: Optional[float] = None
+    qualityDelta: Optional[float] = None
+
+
+@dataclass
 class ParallelConfigurationV1alpha1:
     """Versioned spelling of the sharded-execution block
     (config.ParallelConfig): ``mesh`` is ``"off"`` | ``"auto"`` | an
@@ -245,6 +260,8 @@ class KubeSchedulerConfigurationV1alpha1:
     pipelineChunk: Optional[int] = None
     deviceResidentSnapshot: Optional[bool] = None
     snapshotMaxDirtyFrac: Optional[float] = None
+    incremental: "IncrementalConfigurationV1alpha1" = field(
+        default_factory=IncrementalConfigurationV1alpha1)
     warmup: "WarmupConfigurationV1alpha1" = field(
         default_factory=WarmupConfigurationV1alpha1)
     robustness: "RobustnessConfigurationV1alpha1" = field(
@@ -307,6 +324,21 @@ def set_defaults_kube_scheduler_configuration(
         obj.deviceResidentSnapshot = True
     if obj.snapshotMaxDirtyFrac is None:
         obj.snapshotMaxDirtyFrac = 0.25
+    inc = obj.incremental
+    if inc.enabled is None:
+        inc.enabled = False
+    if inc.candidateBucket is None:
+        inc.candidateBucket = 256
+    if inc.maxBatchFrac is None:
+        inc.maxBatchFrac = 0.5
+    if inc.maxDirtyFrac is None:
+        inc.maxDirtyFrac = 0.25
+    if inc.warmPotentials is None:
+        inc.warmPotentials = True
+    if inc.warmTol is None:
+        inc.warmTol = 1e-3
+    if inc.qualityDelta is None:
+        inc.qualityDelta = 0.02
     wu = obj.warmup
     if wu.enabled is None:
         wu.enabled = False
@@ -525,6 +557,7 @@ def _to_internal(v: KubeSchedulerConfigurationV1alpha1) -> KubeSchedulerConfigur
         pipeline_chunk=v.pipelineChunk,
         device_resident_snapshot=v.deviceResidentSnapshot,
         snapshot_max_dirty_frac=v.snapshotMaxDirtyFrac,
+        incremental=_incremental_to_internal(v.incremental),
         warmup=_warmup_to_internal(v.warmup),
         robustness=_robustness_to_internal(v.robustness),
         recovery=_recovery_to_internal(v.recovery),
@@ -551,6 +584,20 @@ def _scenario_to_internal(sn: ScenarioConfigurationV1alpha1):
         cascade_max_pods=sn.cascadeMaxPods,
         superpod=sn.superpod,
         quality=sn.quality,
+    )
+
+
+def _incremental_to_internal(inc: IncrementalConfigurationV1alpha1):
+    from kubernetes_tpu.config import IncrementalConfig
+
+    return IncrementalConfig(
+        enabled=inc.enabled,
+        candidate_bucket=inc.candidateBucket,
+        max_batch_frac=inc.maxBatchFrac,
+        max_dirty_frac=inc.maxDirtyFrac,
+        warm_potentials=inc.warmPotentials,
+        warm_tol=inc.warmTol,
+        quality_delta=inc.qualityDelta,
     )
 
 
@@ -704,6 +751,15 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
         pipelineChunk=c.pipeline_chunk,
         deviceResidentSnapshot=c.device_resident_snapshot,
         snapshotMaxDirtyFrac=c.snapshot_max_dirty_frac,
+        incremental=IncrementalConfigurationV1alpha1(
+            enabled=c.incremental.enabled,
+            candidateBucket=c.incremental.candidate_bucket,
+            maxBatchFrac=c.incremental.max_batch_frac,
+            maxDirtyFrac=c.incremental.max_dirty_frac,
+            warmPotentials=c.incremental.warm_potentials,
+            warmTol=c.incremental.warm_tol,
+            qualityDelta=c.incremental.quality_delta,
+        ),
         warmup=WarmupConfigurationV1alpha1(
             enabled=c.warmup.enabled,
             podBuckets=list(c.warmup.pod_buckets),

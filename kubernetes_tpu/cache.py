@@ -105,6 +105,35 @@ class SchedulerCache:
         #: (device_lost / device_oom) raise from device_snapshot(),
         #: exercising the scheduler's resident-rebuild recovery
         self.fault_injector = None
+        # ---- incremental-solve score cache (ops/fused_score) ---------
+        #: device-resident NodeSummary aligned row-for-row with the
+        #: resident DeviceNodes: the per-node slice of the score/
+        #: feasibility plane the restricted solve picks candidates
+        #: from. Maintained HERE, next to the snapshot, under the same
+        #: full-vs-delta discipline — full uploads invalidate it
+        #: (rebuilt lazily from the new resident table), delta cycles
+        #: patch exactly the scattered rows with the same donated-
+        #: scatter, clean cycles touch nothing — so it can never drift
+        #: from the table it summarizes.
+        self._summary = None
+        #: bumps whenever the summary's row universe is rebuilt (full
+        #: upload, drop, mesh change, enable) — the scheduler keys its
+        #: warm-solve state (Sinkhorn potentials) on it so takeover /
+        #: device-loss / epoch-growth invalidation is one comparison
+        self.summary_generation = 0
+        #: node COLUMNS patched by the last device_snapshot() call (the
+        #: cycle's dirty frontier — candidate selection boosts them);
+        #: empty on clean cycles, meaningless on full rebuilds (the
+        #: whole plane was recomputed)
+        self.last_patched_idx: List[int] = []
+        self._score_cache_on = False
+        self._summary_flags = {"honor_conditions": True,
+                               "prefer_packed": False}
+        #: the last score_summary() call had to REBUILD the plane from
+        #: scratch (post-drop lazy build) — the scheduler reports zero
+        #: reuse for that cycle instead of pretending the fresh plane
+        #: was cached
+        self.last_summary_rebuilt = False
         #: jax.sharding.Mesh (or None): the sharded execution backend's
         #: node-axis mesh (set_mesh). When set, the resident DeviceNodes
         #: lives SHARDED along N across the mesh: full uploads place via
@@ -420,6 +449,7 @@ class SchedulerCache:
             n_pad = max(n_pad, int(self.mesh.devices.size))
         self.last_upload_rows = 0
         self.last_upload_nbytes = 0
+        self.last_patched_idx = []
         pending_rows = sum(len(i) for i, _ in self._pending_dev)
         if (self._dev is None or self._dev_stale or n_pad != self._dev_pad
                 or pending_rows > self.max_dirty_frac * max(table.n, 1)):
@@ -447,6 +477,13 @@ class SchedulerCache:
             self.last_snapshot_mode = "full"
             self.last_upload_rows = table.n
             self.last_upload_nbytes = tree_nbytes(self._dev)
+            if self._score_cache_on:
+                # full rebuild: the whole score plane is recomputed —
+                # drop the summary (rebuilt lazily from the new resident
+                # table) and bump the generation so warm-solve state
+                # keyed on it (Sinkhorn potentials) is invalidated too
+                self._summary = None
+                self.summary_generation += 1
         elif not self._pending_dev:
             self.last_snapshot_mode = "clean"
         else:
@@ -471,9 +508,23 @@ class SchedulerCache:
                     from kubernetes_tpu.parallel.mesh import replicate
 
                     sub_dev = replicate(sub_dev, self.mesh)
+                if self._score_cache_on and self._summary is not None:
+                    # patch the score summary's SAME rows from the SAME
+                    # delta pack — clean columns of the cached plane are
+                    # reused untouched, only the dirty columns recompute
+                    # (O(churn))
+                    from kubernetes_tpu.ops.fused_score import (
+                        node_summary,
+                        patch_node_summary,
+                    )
+
+                    sub_sum = node_summary(sub_dev, **self._summary_flags)
+                    self._summary = patch_node_summary(
+                        self._summary, sub_sum, pidx)
                 self._dev = scatter_node_rows(self._dev, sub_dev, pidx)
                 self.last_upload_rows += len(idx)
                 self.last_upload_nbytes += tree_nbytes(sub_dev)
+                self.last_patched_idx.extend(idx)
             self.last_snapshot_mode = "delta"
         return table, self._dev, self.last_snapshot_mode
 
@@ -488,11 +539,70 @@ class SchedulerCache:
 
     def drop_device_snapshot(self) -> None:
         """Release the resident device table (tests / memory pressure);
-        the next device_snapshot() re-uploads in full."""
+        the next device_snapshot() re-uploads in full. The score-cache
+        summary drops with it — every invalidation edge that lands here
+        (takeover reconcile, device-loss recovery, mesh change) also
+        drops the cached score plane and bumps its generation."""
         self._dev = None
         self._dev_pad = 0
         self._dev_stale = True
         self._pending_dev.clear()
+        self._summary = None
+        self.last_patched_idx = []
+        self.summary_generation += 1
+
+    # -- incremental-solve score cache --------------------------------------
+
+    def enable_score_cache(self, honor_conditions: bool = True,
+                           prefer_packed: bool = False) -> None:
+        """Turn the device-resident score/feasibility summary on (the
+        scheduler does this when ``incremental.enabled``). The flags pin
+        the summary's semantics to the scheduler's Policy/objective:
+        whether node-condition predicates gate candidate eligibility,
+        and whether the candidate ranking prefers packed (fullest-first)
+        columns. Off by default — non-incremental users pay nothing."""
+        self._score_cache_on = True
+        self._summary_flags = {"honor_conditions": bool(honor_conditions),
+                               "prefer_packed": bool(prefer_packed)}
+        self._summary = None
+        self.summary_generation += 1
+
+    def drop_score_summary(self) -> None:
+        """Drop ONLY the cached score plane (the resident node table is
+        still coherent): the next score_summary() rebuilds from the
+        resident table, and the generation bump kills any warm-solve
+        state keyed on the old plane. The scheduler's dirty-frac blowout
+        route lands here — the snapshot's own blowout goes through the
+        full-upload branch above."""
+        with self._snap_lock:
+            self._summary = None
+            self.summary_generation += 1
+
+    def has_score_summary(self) -> bool:
+        """Whether a cached score plane currently exists (no lazy
+        build) — the scheduler's invalidation accounting asks before
+        counting a drop that would be a no-op."""
+        return self._summary is not None
+
+    def score_summary(self):
+        """The device-resident NodeSummary aligned row-for-row with the
+        resident DeviceNodes (None when the cache is off or no resident
+        table exists — e.g. host-mode snapshots during a device
+        cooloff). Built lazily from the resident table on first demand
+        after a full rebuild; thereafter patched in place by the delta
+        path above. ``last_summary_rebuilt`` reports which of the two
+        happened."""
+        with self._snap_lock:
+            self.last_summary_rebuilt = False
+            if not self._score_cache_on or self._dev is None:
+                return None
+            if self._summary is None:
+                from kubernetes_tpu.ops.fused_score import node_summary
+
+                self._summary = node_summary(self._dev,
+                                             **self._summary_flags)
+                self.last_summary_rebuilt = True
+            return self._summary
 
     def _full_repack(self) -> NodeTable:
         nodes = list(self._nodes.values())

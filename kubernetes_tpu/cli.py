@@ -30,6 +30,7 @@ from typing import List, Optional
 from kubernetes_tpu.config import (
     DEFAULT_FEATURE_GATES,
     FeatureGates,
+    IncrementalConfig,
     KubeSchedulerConfiguration,
     LeaderElectionConfig,
     ObservabilityConfig,
@@ -123,6 +124,23 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         errs.append("warmup.minBucket: must be at least 1")
     if any(b < 1 for b in wu.pod_buckets):
         errs.append("warmup.podBuckets: buckets must be at least 1")
+    inc = cfg.incremental
+    if inc.candidate_bucket < 1:
+        errs.append("incremental.candidateBucket: must be at least 1")
+    if not 0 < inc.max_batch_frac <= 1:
+        errs.append(
+            f"incremental.maxBatchFrac: Invalid value {inc.max_batch_frac}: "
+            "not in valid range (0, 1]"
+        )
+    if not 0 <= inc.max_dirty_frac <= 1:
+        errs.append(
+            f"incremental.maxDirtyFrac: Invalid value {inc.max_dirty_frac}: "
+            "not in valid range 0-1"
+        )
+    if inc.warm_tol <= 0:
+        errs.append("incremental.warmTol: must be greater than zero")
+    if inc.quality_delta < 0:
+        errs.append("incremental.qualityDelta: must be non-negative")
     rc = cfg.robustness
     if rc.cycle_deadline_s < 0:
         errs.append("robustness.cycleDeadlineSeconds: must be non-negative")
@@ -240,6 +258,7 @@ _ROB_FIELDS = {f.name for f in dataclasses.fields(RobustnessConfig)}
 _REC_FIELDS = {f.name for f in dataclasses.fields(RecoveryConfig)}
 _OBS_FIELDS = {f.name for f in dataclasses.fields(ObservabilityConfig)}
 _WARMUP_FIELDS = {f.name for f in dataclasses.fields(WarmupConfig)}
+_INC_FIELDS = {f.name for f in dataclasses.fields(IncrementalConfig)}
 _SERVING_FIELDS = {f.name for f in dataclasses.fields(ServingConfig)}
 _PAR_FIELDS = {f.name for f in dataclasses.fields(ParallelConfig)}
 _SCN_FIELDS = {f.name for f in dataclasses.fields(ScenarioConfig)}
@@ -339,6 +358,17 @@ def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
             if "pod_buckets" in wkw:
                 wkw["pod_buckets"] = tuple(wkw["pod_buckets"])
             kw["warmup"] = WarmupConfig(**wkw)
+        elif key == "incremental":
+            if not isinstance(val, dict):
+                errs.append("incremental: expected a mapping")
+                continue
+            unknown = set(val) - _INC_FIELDS
+            if unknown:
+                errs.append(
+                    f"incremental: unknown field(s) {sorted(unknown)}"
+                )
+                continue
+            kw["incremental"] = IncrementalConfig(**val)
         elif key == "serving":
             if not isinstance(val, dict):
                 errs.append("serving: expected a mapping")
@@ -430,6 +460,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sub-batch size of the pipelined executor")
     p.add_argument("--warmup", default=None, choices=("true", "false"),
                    help="AOT-compile the bucketed solve shapes at startup")
+    p.add_argument("--incremental", default=None,
+                   choices=("true", "false"),
+                   help="incremental solve: device-resident score cache "
+                        "+ restricted candidate-column solves + warm "
+                        "Sinkhorn potentials (steady-state cycle cost "
+                        "O(churn), cold solve stays the fallback)")
     p.add_argument("--mesh", default=None,
                    help="sharded execution backend: off | auto | N "
                         "(1-D device mesh over the node axis)")
@@ -485,6 +521,9 @@ def resolve_config(args) -> KubeSchedulerConfiguration:
     if args.warmup is not None:
         overlay["warmup"] = dataclasses.replace(
             cfg.warmup, enabled=args.warmup == "true")
+    if getattr(args, "incremental", None) is not None:
+        overlay["incremental"] = dataclasses.replace(
+            cfg.incremental, enabled=args.incremental == "true")
     if getattr(args, "mesh", None) is not None:
         spec = args.mesh
         if spec not in ("off", "auto"):

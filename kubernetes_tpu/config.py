@@ -249,6 +249,50 @@ class WarmupConfig:
 
 
 @dataclass
+class IncrementalConfig:
+    """Incremental solve (docs/perf.md "incremental solve"): make the
+    steady-state cycle cost proportional to CHURN instead of the full
+    (P x N) plane. Three coupled pieces ride this block: the
+    device-resident per-node score/feasibility cache (cache.py +
+    ops/fused_score.py — clean node columns reused across cycles, dirty
+    columns patched with the same donated-scatter discipline as the
+    PR-5 snapshot delta), the restricted solve (the micro-batch solves
+    against a bounded candidate-column bucket gathered from the cached
+    plane instead of every node), and warm-started Sinkhorn potentials
+    carried across cycles. The full cold solve remains the correctness
+    fallback the ladder already knows how to take — on takeover,
+    device-loss heal, pack-epoch growth, or dirty-frac blowout the
+    cache drops and the next cycle solves cold."""
+
+    enabled: bool = False
+    #: candidate node columns the restricted solve gathers (snapped UP
+    #: to a power of two so the (P, C) solve shapes stay in the warmed
+    #: bucket grid — zero retraces under churn). Cycles where the
+    #: padded cluster is not strictly larger than the bucket take the
+    #: cold solve (restriction would not shrink anything).
+    candidate_bucket: int = 256
+    #: restricted solves admit at most candidate_bucket * this many
+    #: pods per cycle (larger micro-batches could exhaust the candidate
+    #: columns' capacity and under-place vs the cold solve)
+    max_batch_frac: float = 0.5
+    #: dirty-column fraction above which the score cache is dropped and
+    #: the cycle solves cold (patching approaches full-recompute cost —
+    #: the same blowout rule as the snapshot delta)
+    max_dirty_frac: float = 0.25
+    #: carry the previous solve's Sinkhorn potentials across cycles
+    #: (ops/sinkhorn.py warm start) when the sinkhorn tier engages
+    warm_potentials: bool = True
+    #: early-exit tolerance for warm-started Sinkhorn scaling: when the
+    #: warm residual is already under it, the solve exits after one
+    #: verification iteration instead of the full budget
+    warm_tol: float = 1e-3
+    #: documented bound on the warm-vs-cold placement-quality delta
+    #: (mean lean score, fraction) — the bench_compare incremental gate
+    #: enforces it on every churn_incr record
+    quality_delta: float = 0.02
+
+
+@dataclass
 class ParallelConfig:
     """Sharded execution backend (kubernetes_tpu/parallel): shard the
     node axis of the device-resident snapshot — and with it the (P, N)
@@ -399,6 +443,10 @@ class KubeSchedulerConfiguration:
     #: dirty-row fraction above which the delta patch falls back to a
     #: full re-upload (patch cost approaches full-pack cost)
     snapshot_max_dirty_frac: float = 0.25
+    #: incremental solve: device-resident score/feasibility cache,
+    #: restricted candidate-column solves, warm-started potentials —
+    #: steady-state cycle cost O(churn), not O(P x N)
+    incremental: IncrementalConfig = field(default_factory=IncrementalConfig)
     #: AOT compile warmup of the bucketed solve shapes
     warmup: WarmupConfig = field(default_factory=WarmupConfig)
     #: degradation ladder / fault-tolerance knobs

@@ -34,7 +34,12 @@ forgotten / requeued / drained pods, fenced binds, device resets) and
 block (``kubernetes_tpu/scenarios``) —
 ``scheduler_scenario_quality{score}`` placement-quality gauges and the
 in-batch preemption-cascade counters
-``scheduler_scenario_{cascade_victims,displaced_replaced}_total``. Note
+``scheduler_scenario_{cascade_victims,displaced_replaced}_total``; plus
+the incremental-solve block (docs/perf.md §5) —
+``scheduler_incremental_cycles_total{scope}`` (restricted | full |
+declined | under-placed), the ``scheduler_incremental_reuse_fraction``
+gauge, and
+``scheduler_incremental_invalidations_total{reason}``. Note
 ``scheduler_e2e_scheduling_duration_seconds`` observes PER-POD
 create-to-bind latency (queue-add stamp to bind) since the serving PR,
 matching the reference's per-pod scheduleOne observation.
@@ -453,6 +458,33 @@ class SchedulerMetrics:
             "scheduler_warmup_compiles_total",
             "Bucketed solve shapes compiled ahead of time by the warmup "
             "pass (cli --warmup / Scheduler.warmup).",
+        ))
+        # -- incremental solve (restricted candidate-column cycles) -----
+        self.incremental_cycles = r.register(Counter(
+            "scheduler_incremental_cycles_total",
+            "Scheduling cycles by solve scope under the incremental "
+            "mode: restricted = solved against the cached score plane's "
+            "candidate columns (O(churn)); full = the cold dense solve "
+            "(fallback or ineligible); declined = a restricted attempt "
+            "that errored/failed validation; under-placed = a restricted "
+            "attempt that could not place every pod (both re-solve cold "
+            "in the same cycle and ALSO count under full).",
+            ["scope"],
+        ))
+        self.incremental_reuse_fraction = r.register(Gauge(
+            "scheduler_incremental_reuse_fraction",
+            "Fraction of the score plane's node columns REUSED from the "
+            "device-resident cache by the last cycle (1 - recomputed/"
+            "live; 0 on full solves) — cost proportional to churn, "
+            "measured.",
+        ))
+        self.incremental_invalidations = r.register(Counter(
+            "scheduler_incremental_invalidations_total",
+            "Score-cache + warm-potential drops by invalidation edge: "
+            "full-snapshot (node-set/interner/pack-epoch growth), "
+            "dirty-frac blowout, takeover reconciliation, device-loss "
+            "recovery, restricted-error.",
+            ["reason"],
         ))
         # -- sharded execution backend (kubernetes_tpu/parallel) --------
         self.mesh_devices = r.register(Gauge(

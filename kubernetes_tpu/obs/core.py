@@ -143,6 +143,13 @@ class Observability:
         self._scratch["snapshot_mode"] = mode
         self._scratch["snapshot_rows"] = rows
 
+    def note_solve_scope(self, scope: str, reuse_frac: float = 0.0) -> None:
+        """Which solve the cycle ran (restricted | full) and how much of
+        the cached score plane it reused — the incremental-solve
+        provenance (``scope=`` flight-record flag)."""
+        self._scratch["solve_scope"] = scope
+        self._scratch["reuse_frac"] = float(reuse_frac)
+
     def note_microbatch(self, trigger: str, window_s: float) -> None:
         """The serving loop's micro-batch provenance for this cycle:
         what flushed the accumulation window (bucket-fill | max-wait)
@@ -270,6 +277,8 @@ class Observability:
             ),
             snapshot_mode=s.get("snapshot_mode", ""),
             snapshot_rows=s.get("snapshot_rows", 0),
+            solve_scope=s.get("solve_scope", ""),
+            reuse_frac=s.get("reuse_frac", 0.0),
             pipeline_chunks=(getattr(res, "pipeline_chunks", 0)
                              if res is not None else 0),
             flush_trigger=s.get("flush_trigger", ""),

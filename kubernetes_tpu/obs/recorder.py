@@ -60,6 +60,12 @@ class CycleRecord:
     #: rows were re-packed for it
     snapshot_mode: str = ""
     snapshot_rows: int = 0
+    #: which solve the cycle ran ("restricted" = the incremental
+    #: candidate-column solve over the cached score plane, "full" =
+    #: the cold dense solve; "" = no solve) and what fraction of the
+    #: score plane's node columns the cycle REUSED from the cache
+    solve_scope: str = ""
+    reuse_frac: float = 0.0
     #: sub-batches the pipelined executor ran (0 = monolithic cycle)
     pipeline_chunks: int = 0
     #: what flushed the serving loop's micro-batch window into this
@@ -107,6 +113,9 @@ class CycleRecord:
             **({"snapshot": {"mode": self.snapshot_mode,
                              "rows": self.snapshot_rows}}
                if self.snapshot_mode else {}),
+            **({"solve_scope": self.solve_scope,
+                "reuse_frac": round(self.reuse_frac, 4)}
+               if self.solve_scope else {}),
             **({"pipeline_chunks": self.pipeline_chunks}
                if self.pipeline_chunks else {}),
             **({"microbatch": {"trigger": self.flush_trigger,
@@ -188,6 +197,9 @@ class FlightRecorder:
                 flags.append(f"d2h={r.readback_bytes}B")
             if r.snapshot_mode:
                 flags.append(f"snap={r.snapshot_mode}:{r.snapshot_rows}")
+            if r.solve_scope:
+                flags.append(
+                    f"scope={r.solve_scope}:{r.reuse_frac:.0%}")
             if r.pipeline_chunks:
                 flags.append(f"chunks={r.pipeline_chunks}")
             if r.flush_trigger:

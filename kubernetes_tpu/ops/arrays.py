@@ -339,6 +339,54 @@ def scatter_node_rows(resident: DeviceNodes, sub: DeviceNodes,
                                       jnp.asarray(idx, jnp.int32))
 
 
+@jax.jit
+def gather_node_rows(nodes: DeviceNodes, idx: jnp.ndarray) -> DeviceNodes:
+    """The restricted solve's candidate-column view: gather ``idx``
+    (C,) node rows out of the (possibly mesh-resident) table into a
+    small (C, ·) DeviceNodes the existing solver kernels run on
+    unchanged. Out-of-range indices (the candidate_columns padding
+    sentinel == N) fill with zeros — ``valid`` fills False, so padded
+    rows reject every predicate exactly like bucket-padding rows do.
+    ``zone_valid`` is universe-shaped and passes through whole. The
+    output is answer-sized (C ≤ the candidate bucket), so under a mesh
+    the implied cross-shard gather moves O(C·R) bytes, never the
+    (P, N) plane — the readback-budget contract holds."""
+    out = {}
+    for name in DeviceNodes._fields:
+        a = getattr(nodes, name)
+        if name == "zone_valid":
+            out[name] = a
+            continue
+        out[name] = jnp.take(a, idx, axis=0, mode="fill", fill_value=0)
+    return DeviceNodes(**out)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def gather_candidates(summary, dirty_mask: jnp.ndarray,
+                      nodes: DeviceNodes, k: int):
+    """Fused candidate pick + row gather — ONE dispatch for the
+    restricted solve's column selection (ops/fused_score.
+    candidate_columns composed with :func:`gather_node_rows`; separate
+    dispatches measurably tax small-cluster cycles on CPU). Returns
+    ``(cand_idx, sub_nodes)``."""
+    from kubernetes_tpu.ops.fused_score import candidate_columns
+
+    cand = candidate_columns(summary, dirty_mask, k)
+    return cand, gather_node_rows(nodes, cand)
+
+
+@jax.jit
+def map_restricted_assignment(assigned_local: jnp.ndarray,
+                              cand_idx: jnp.ndarray) -> jnp.ndarray:
+    """Candidate-local assignment rows -> global node rows, on device:
+    the mapped vector rides the cycle's single solve-result readback so
+    the candidate index list itself never crosses the host boundary
+    (keeping d2h at the answer-sized ~4 B/pod budget)."""
+    safe = jnp.clip(assigned_local, 0, cand_idx.shape[0] - 1)
+    return jnp.where(assigned_local >= 0,
+                     cand_idx[safe].astype(jnp.int32), jnp.int32(-1))
+
+
 def selectors_to_device(t: SelectorTables) -> DeviceSelectors:
     def pack(n_e, n_t, e_term, e_op, e_pairs, e_key, e_lit, t_prog, t_w=None):
         e_pad = bucket_size(max(n_e, 1))

@@ -195,3 +195,123 @@ def fused_pair_normalize_device(raw_fwd, raw_rev, mask, w_fwd, w_rev):
         return None
     return _pair_pallas(raw_fwd, raw_rev, mask, w_fwd, w_rev,
                         interpret=interp)
+
+
+# ---------------------------------------------------------------------------
+# Incremental-solve score/feasibility cache (docs/perf.md "incremental
+# solve"): a device-resident per-node summary of the score plane, kept
+# coherent with the resident NodeTable by the SAME full-vs-delta
+# discipline (SchedulerCache maintains it right where it maintains the
+# snapshot — full rebuilds recompute it wholesale, delta cycles patch
+# exactly the scattered rows with a donated scatter, clean cycles touch
+# nothing). The restricted solve then picks its candidate node columns
+# from this cached plane in O(N log C) instead of re-scoring the full
+# (P, N) plane: clean columns are REUSED across cycles; only dirty
+# columns (bind/delete/update-touched nodes) were recomputed.
+# ---------------------------------------------------------------------------
+
+from typing import NamedTuple
+
+
+class NodeSummary(NamedTuple):
+    """The cached per-node slice of the score/feasibility plane.
+
+    ``eligible`` — the pod-independent feasibility column: node valid,
+    schedulable, condition-clean (when the Policy enforces the
+    condition predicates), and with at least one free pod slot. The
+    pod-CONDITIONED predicate residual (selectors, taints, resources
+    against the actual request) is re-evaluated by the restricted solve
+    itself on the gathered candidate columns — this column only decides
+    which columns are worth gathering.
+
+    ``rank`` — the candidate ranking score (generic lean objective over
+    free-capacity fractions; sign flipped under a packing objective).
+    Ineligible columns carry ``-inf`` so they can never out-rank a live
+    one."""
+
+    eligible: jnp.ndarray  # (N,) bool
+    rank: jnp.ndarray  # (N,) f32, -inf on ineligible columns
+
+
+#: rank boost that guarantees dirty columns survive the top-k cut —
+#: finite (padding-safe) but far above any free-fraction rank in [0, 1]
+DIRTY_BOOST = 1e6
+
+_NEG = -3e38  # ineligible-column rank (finite: top_k handles -inf fine,
+# but a finite sentinel keeps the padded-index arithmetic NaN-free)
+
+
+@functools.partial(jax.jit, static_argnames=("honor_conditions",
+                                             "prefer_packed"))
+def node_summary(nodes, honor_conditions=True, prefer_packed=False):
+    """Compute the per-node summary from a DeviceNodes table (full
+    rebuild) or from a delta sub-table (whose rows then scatter in via
+    :func:`patch_node_summary`). One streaming pass over the (N, R)
+    usage columns and the (N,) condition bits; no (P, N) work.
+
+    ``honor_conditions`` mirrors whether the Policy enforces the node
+    condition predicates — when it does not, pressured/not-ready nodes
+    stay candidate-eligible exactly as the cold solve would admit them.
+    ``prefer_packed`` flips the ranking for packing-style objectives
+    (MostRequestedPriority outweighing LeastRequested): fullest-first
+    instead of freest-first."""
+    from kubernetes_tpu.snapshot import RES_CPU, RES_MEM, RES_PODS
+
+    free = nodes.allocatable - nodes.requested  # (N, R)
+    eligible = nodes.valid
+    if honor_conditions:
+        eligible = (eligible & nodes.schedulable & nodes.ready
+                    & ~nodes.network_unavailable & ~nodes.mem_pressure
+                    & ~nodes.disk_pressure & ~nodes.pid_pressure)
+    # a column with no free pod slot cannot admit anything this cycle —
+    # not worth a candidate slot even under a packing objective
+    eligible = eligible & (free[:, RES_PODS] >= 1.0)
+
+    def frac(col):
+        cap = nodes.allocatable[:, col]
+        return jnp.where(cap > 0, jnp.maximum(free[:, col], 0.0)
+                         / jnp.maximum(cap, 1e-30), 0.0)
+
+    rank = 0.5 * (frac(RES_CPU) + frac(RES_MEM))
+    if prefer_packed:
+        rank = 1.0 - rank
+    return NodeSummary(eligible=eligible,
+                       rank=jnp.where(eligible, rank, _NEG))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _patch_node_summary_donated(summary, sub, idx):
+    """Scatter delta rows into the resident summary — the same donated
+    single-scatter discipline as ops/arrays._scatter_node_rows_donated
+    (XLA aliases the output onto the existing buffers, preserving the
+    resident sharding on a mesh; padded idx slots point out of bounds
+    and drop)."""
+    return NodeSummary(
+        eligible=summary.eligible.at[idx].set(sub.eligible, mode="drop"),
+        rank=summary.rank.at[idx].set(sub.rank, mode="drop"),
+    )
+
+
+def patch_node_summary(summary, sub, idx):
+    """Jitted row-patch entry: ``idx`` (D,) host indices aligned with
+    ``sub``'s rows; entries >= the resident row count drop (padding).
+    The resident ``summary``'s buffers are donated — do not reuse."""
+    return _patch_node_summary_donated(summary, sub,
+                                       jnp.asarray(idx, jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def candidate_columns(summary, dirty_mask, k):
+    """Top-``k`` candidate node columns for the restricted solve: the
+    best-ranked eligible columns, with every DIRTY eligible column
+    (bind/delete/update-touched this cycle — the churn frontier)
+    guaranteed a slot via a rank boost. O(N log k), the only full-N
+    work an incremental cycle performs. Returns (k,) int32 column
+    indices; slots that fell on ineligible columns point one past the
+    table (== N) so downstream gathers treat them as padding."""
+    n = summary.rank.shape[0]
+    score = summary.rank + jnp.where(dirty_mask & summary.eligible,
+                                     DIRTY_BOOST, 0.0)
+    vals, idx = jax.lax.top_k(score, k)
+    return jnp.where(vals > _NEG / 2, idx.astype(jnp.int32),
+                     jnp.int32(n))

@@ -89,9 +89,42 @@ def _stats_scan(step, u0, v0, iters, tol=STATS_TOL):
     return u, v, jnp.stack([iters_used, deltas[-1].astype(jnp.float32)])
 
 
-def _scale_jnp(logk, log_r, log_c, iters, with_stats=False):
+def _tol_scan(step, u0, v0, iters, tol):
+    """Tolerance-gated scaling loop — the WARM-START companion of
+    :func:`_stats_scan`: run ``step`` until the max row-potential delta
+    drops under ``tol`` (or the ``iters`` budget runs out). A warm start
+    whose residual is already under tolerance exits after ONE
+    verification iteration instead of paying the full budget — the
+    incremental-solve early-exit (docs/perf.md). Returns (u, v, stats)
+    with the same [iterations-used, final-delta] stats vector."""
+
+    def cond(carry):
+        _u, _v, i, delta = carry
+        return (i < iters) & (delta >= tol)
+
+    def body(carry):
+        u, v, i, _ = carry
+        u2, v2 = step(u, v)
+        finite = (u2 > NEG_INF / 2) & (u > NEG_INF / 2)
+        delta = jnp.max(jnp.where(finite, jnp.abs(u2 - u), 0.0))
+        return (u2, v2, i + 1, delta)
+
+    u, v, i, delta = jax.lax.while_loop(
+        cond, body,
+        (u0, v0, jnp.asarray(0, jnp.int32),
+         jnp.asarray(jnp.inf, jnp.float32)))
+    return u, v, jnp.stack([i.astype(jnp.float32),
+                            delta.astype(jnp.float32)])
+
+
+def _scale_jnp(logk, log_r, log_c, iters, with_stats=False, u0=None,
+               v0=None, tol=None):
     """Alternating log-domain scaling; columns clipped at 0 (inequality).
-    Returns (u, v, stats) — stats is None unless ``with_stats``."""
+    Returns (u, v, stats) — stats is None unless ``with_stats`` or
+    ``tol`` is set. ``u0``/``v0`` warm-start the potentials (a previous
+    solve's equilibrium — Sinkhorn scaling converges from any start, so
+    warm starts change only the iteration count, not the fixpoint);
+    ``tol`` switches to the tolerance-gated loop (:func:`_tol_scan`)."""
 
     def step(u, v):
         u = log_r - _row_lse(logk, v)
@@ -101,7 +134,12 @@ def _scale_jnp(logk, log_r, log_c, iters, with_stats=False):
         return u, v
 
     P, N = logk.shape
-    u0, v0 = jnp.zeros((P,)), jnp.zeros((N,))
+    if u0 is None:
+        u0 = jnp.zeros((P,))
+    if v0 is None:
+        v0 = jnp.zeros((N,))
+    if tol is not None:
+        return _tol_scan(step, u0, v0, iters, tol)
     if with_stats:
         return _stats_scan(step, u0, v0, iters)
     (u, v), _ = jax.lax.scan(
@@ -185,7 +223,7 @@ def _block_shapes(P0: int, N0: int, block_p: int = BLOCK_P,
 
 
 def _scale_pallas(logk, log_r, log_c, iters, block_p=BLOCK_P, block_n=BLOCK_N,
-                  interpret=False, with_stats=False):
+                  interpret=False, with_stats=False, u0=None, v0=None):
     from jax.experimental import pallas as pl
 
     P0, N0 = logk.shape
@@ -230,8 +268,12 @@ def _scale_pallas(logk, log_r, log_c, iters, block_p=BLOCK_P, block_n=BLOCK_N,
         v = v_call(logk, u, log_c2)
         return u, v
 
-    u0 = jnp.zeros((1, P), logk.dtype)
-    v0 = jnp.zeros((1, N), logk.dtype)
+    # warm-start potentials pad with 0 (padded rows ship nothing — their
+    # first u pass lands on NEG_INF regardless of the start)
+    u0 = (jnp.zeros((1, P), logk.dtype) if u0 is None
+          else jnp.pad(u0, (0, P - u0.shape[0]))[None, :].astype(logk.dtype))
+    v0 = (jnp.zeros((1, N), logk.dtype) if v0 is None
+          else jnp.pad(v0, (0, N - v0.shape[0]))[None, :].astype(logk.dtype))
     if with_stats:
         u, v, stats = _stats_scan(step, u0, v0, iters)
         return u[0, :P0], v[0, :N0], stats
@@ -287,6 +329,9 @@ def sinkhorn_plan(
     pallas: Optional[bool] = None,
     interpret: Optional[bool] = None,
     with_stats: bool = False,
+    init: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    tol: Optional[float] = None,
+    return_potentials: bool = False,
 ) -> jnp.ndarray:
     """Transport plan (P, N): plan[p, j] ≈ how much of pod p's unit demand
     node j serves at equilibrium. Row sums <= 1 (== 1 when the pod fits
@@ -297,14 +342,36 @@ def sinkhorn_plan(
     reached), final max row-potential delta] — the per-solve convergence
     telemetry the observability layer surfaces (obs/core.py reads it back
     once per cycle at the host boundary). Same scaling math either way.
+
+    Warm start (the incremental-solve carry, docs/perf.md): ``init`` is
+    a ``(u0, v0)`` potential pair from a previous solve — scaling
+    converges from any start to the same fixpoint, so a warm start
+    changes only the iteration count. ``tol`` switches to the
+    tolerance-gated loop: iterate until the max row-potential delta
+    drops under ``tol`` (a warm start already under it exits after one
+    verification iteration). The tolerance loop runs the jnp scaling on
+    every backend (the Pallas kernels keep their fixed-iteration scans
+    — a data-dependent trip count would defeat their pipelining).
+    ``return_potentials`` appends the final ``(u, v)`` pair so the
+    caller can carry it into the next solve.
     """
     score = score.astype(jnp.float32)
     row_ok = jnp.any(mask, axis=1)
     logk = jnp.where(mask, score / eps, NEG_INF)
     log_r = jnp.where(row_ok, 0.0, NEG_INF)  # demand 1 per schedulable pod
     log_c = jnp.where(capacity > 0, jnp.log(jnp.maximum(capacity, 1e-30)), NEG_INF)
+    u0 = v0 = None
+    if init is not None:
+        u0, v0 = init
+        # sanitize a foreign start: non-finite rows restart from zero
+        # (a NEG_INF row potential from a previously-infeasible pod
+        # would wedge its row at zero mass forever)
+        u0 = jnp.where(jnp.isfinite(u0) & (u0 > NEG_INF / 2), u0, 0.0)
+        v0 = jnp.where(jnp.isfinite(v0) & (v0 > NEG_INF / 2), v0, 0.0)
     if pallas is None:
         pallas = use_pallas()
+    if tol is not None:
+        pallas = False  # the tolerance loop is jnp-only (see docstring)
     if pallas:
         interp = (jax.default_backend() != "tpu") if interpret is None else interpret
         if not interp:
@@ -314,14 +381,19 @@ def sinkhorn_plan(
             pallas = _pallas_compiles(*_block_shapes(*logk.shape))
     if pallas:
         u, v, stats = _scale_pallas(logk, log_r, log_c, iters,
-                                    interpret=interp, with_stats=with_stats)
+                                    interpret=interp, with_stats=with_stats,
+                                    u0=u0, v0=v0)
     else:
         u, v, stats = _scale_jnp(logk, log_r, log_c, iters,
-                                 with_stats=with_stats)
+                                 with_stats=with_stats, u0=u0, v0=v0,
+                                 tol=tol)
     plan = jnp.exp(
         jnp.clip(logk + u[:, None] + v[None, :], NEG_INF, 30.0)
     )
     plan = jnp.where(mask, plan, 0.0)
+    out = (plan,)
     if with_stats:
-        return plan, stats
-    return plan
+        out = out + (stats,)
+    if return_potentials:
+        out = out + ((u, v),)
+    return out if len(out) > 1 else plan

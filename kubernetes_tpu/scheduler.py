@@ -136,6 +136,21 @@ class CycleResult:
     #: (device_resident_snapshot off), "" = the cycle ended before the
     #: snapshot (empty queue / all-prefilter batches)
     snapshot_mode: str = ""
+    #: which solve the cycle ran: "restricted" = the incremental
+    #: candidate-column solve over the cached score plane (O(churn));
+    #: "full" = the cold dense solve; "" = the cycle ended before any
+    #: solve. The cold solve is the correctness fallback — a restricted
+    #: attempt that under-places or fails validation re-solves "full"
+    #: in the SAME cycle and reports "full" here.
+    solve_scope: str = ""
+    #: fraction of the score plane's node columns REUSED from the cache
+    #: this cycle (1 - recomputed/live; 0.0 on full solves) — the
+    #: "cost proportional to churn" provenance
+    reuse_frac: float = 0.0
+    #: device solve time for the cycle (the span total the scheduling_
+    #: algorithm histogram observes) — split by solve_scope in the
+    #: churn bench so warm-start wins are visible per cycle
+    solve_s: float = 0.0
     #: sub-batches the pipelined executor ran (0 = monolithic cycle)
     pipeline_chunks: int = 0
     #: per-pod create-to-bind latency (pod key -> seconds, queue-add
@@ -195,6 +210,7 @@ class Scheduler:
         warmup=None,
         parallel=None,
         scenario=None,
+        incremental=None,
     ) -> None:
         from kubernetes_tpu.config import (
             ObservabilityConfig,
@@ -311,9 +327,30 @@ class Scheduler:
         if snapshot_max_dirty_frac is not None:
             self.cache.max_dirty_frac = snapshot_max_dirty_frac
         #: AOT warmup config (config.WarmupConfig or None)
-        from kubernetes_tpu.config import ParallelConfig, WarmupConfig
+        from kubernetes_tpu.config import (
+            IncrementalConfig,
+            ParallelConfig,
+            WarmupConfig,
+        )
 
         self.warmup_config = warmup if warmup is not None else WarmupConfig()
+        #: incremental solve (config.IncrementalConfig): steady-state
+        #: cycles cost O(churn) — candidate columns come from the
+        #: device-resident score cache (cache.score_summary, patched per
+        #: delta), the solve restricts to a bounded (P, C) plane, and
+        #: Sinkhorn potentials warm-start across cycles. The cold dense
+        #: solve stays the correctness fallback (docs/perf.md).
+        self.incremental = (incremental if incremental is not None
+                            else IncrementalConfig())
+        #: warm Sinkhorn potential carry: (key, (u, v)) where key is
+        #: (pod bucket, candidate bucket, cache.summary_generation) —
+        #: any invalidation edge (takeover, device loss, epoch growth,
+        #: full rebuild) bumps the generation and the carry dies with it
+        self._sk_warm_pot = None
+        #: restricted service has engaged since the last invalidation —
+        #: the signal that makes an invalidation drop COUNTABLE (see
+        #: _drop_incremental)
+        self._incr_active = False
         #: sharded execution backend (config.ParallelConfig): when the
         #: mesh is on, the node axis of the resident snapshot — and with
         #: it the (P, N) plane of every solve/validate/explain kernel —
@@ -387,6 +424,32 @@ class Scheduler:
         #: gang_locality after a gangless cycle), same freshness rule
         #: as the explain reason gauges
         self._scenario_scores_seen: set = set()
+        if self.incremental.enabled:
+            # arm the device-resident score cache, pinned to THIS
+            # scheduler's Policy and objective: candidate eligibility
+            # honors the node-condition predicates only when the Policy
+            # enforces them (a permissive Policy's cold solve admits
+            # pressured nodes — candidates must too), and the ranking
+            # flips fullest-first under a packing objective
+            from kubernetes_tpu.ops.predicates import BIT as _BIT
+            from kubernetes_tpu.ops.priorities import DEFAULT_WEIGHTS
+
+            cond_names = ("CheckNodeCondition", "CheckNodeUnschedulable",
+                          "CheckNodeMemoryPressure",
+                          "CheckNodeDiskPressure", "CheckNodePIDPressure")
+            honor = self.pred_mask is None or all(
+                self.pred_mask & (1 << _BIT[n]) for n in cond_names)
+            w = self.weights if self.weights is not None else DEFAULT_WEIGHTS
+            packed = (w.get("MostRequestedPriority", 0)
+                      > w.get("LeastRequestedPriority", 0))
+            self._summary_flags = {"honor_conditions": honor,
+                                   "prefer_packed": packed}
+            enable = getattr(self.cache, "enable_score_cache", None)
+            if enable is not None:  # duck-typed: cache fakes stay valid
+                enable(honor_conditions=honor, prefer_packed=packed)
+        else:
+            self._summary_flags = {"honor_conditions": True,
+                                   "prefer_packed": False}
         #: count of exact->round auto-fallbacks (port/volume/topology batches)
         self.exact_fallbacks = 0
         #: NonPreemptingPriority feature gate: honor preemption_policy=Never
@@ -464,6 +527,7 @@ class Scheduler:
         kw.setdefault("warmup", cfg.warmup)
         kw.setdefault("parallel", cfg.parallel)
         kw.setdefault("scenario", cfg.scenario)
+        kw.setdefault("incremental", cfg.incremental)
         if getattr(cfg, "plugins", ()) and "framework" not in kw:
             # config-driven framework assembly (the NewFramework path,
             # framework.go:88: registry factories + per-plugin args from
@@ -810,6 +874,10 @@ class Scheduler:
         self.queue.move_all_to_active()
         self.cache.invalidate_snapshot()
         self.cache.drop_device_snapshot()
+        # warm-solve state (score cache already died with the resident
+        # table; potentials must die too — they summarize a plane the
+        # old incarnation solved, not the relisted truth)
+        self._drop_incremental("takeover")
         self._device_cooloff_until = 0.0
         epoch = getattr(self.fence, "epoch", 0) or 1
         self.metrics.recovery_takeovers.inc()
@@ -906,6 +974,9 @@ class Scheduler:
                              "resident table (reset %d/%d)", e, attempts,
                              self.recovery.device_reset_limit)
                 self.cache.drop_device_snapshot()
+                # the score cache died with the resident table; the
+                # potential carry must not survive the device either
+                self._drop_incremental("device-loss")
                 if attempts > self.recovery.device_reset_limit:
                     self._device_cooloff_until = (
                         self.clock() + self.recovery.device_cooloff_s)
@@ -1107,6 +1178,20 @@ class Scheduler:
                 batch, cycle, res, t0, trace, nt, dn, ds, dt, node_order,
                 skip_prio, no_ports, no_pod_aff, no_spread,
             )
+
+        # incremental solve: a steady-state micro-batch on a clean/delta
+        # resident snapshot solves RESTRICTED — candidate columns from
+        # the cached score plane instead of the full (P, N) dense pass.
+        # A declined/under-placed/invalid attempt falls through to the
+        # cold solve below (the correctness fallback).
+        if self._incremental_eligible(batch, nominated, dn, dt, dv,
+                                      snap_mode, no_ports, no_pod_aff,
+                                      no_spread, nt):
+            inc_out = self._restricted_tail(
+                batch, cycle, res, t0, trace, nt, dn, ds, dp, node_order,
+                skip_prio)
+            if inc_out is not None:
+                return inc_out
 
         # framework Filter/Score contributions: device batch plugins give
         # whole (P, N) matrices; host plugins evaluate per (pod, nodeName)
@@ -1491,6 +1576,19 @@ class Scheduler:
         log, flight record. New finalization steps belong HERE so the
         two executors cannot silently diverge."""
         res.elapsed_s = self.clock() - t0
+        res.solve_s = solve_s
+        if res.solver_tier and not res.solve_scope:
+            res.solve_scope = "full"
+        if res.solve_scope:
+            self.obs.note_solve_scope(res.solve_scope, res.reuse_frac)
+            if self.incremental.enabled:
+                m = getattr(self.metrics, "incremental_cycles", None)
+                if m is not None:
+                    m.inc(scope=res.solve_scope)
+                g = getattr(self.metrics, "incremental_reuse_fraction",
+                            None)
+                if g is not None:
+                    g.set(res.reuse_frac)
         if res.solver_tier:
             self.last_solver_tier = res.solver_tier
             self.last_solver_fallbacks = res.solver_fallbacks
@@ -2031,6 +2129,259 @@ class Scheduler:
         if any(p.pod_group for p in batch):
             return False
         return True
+
+    # -- incremental solve (restricted candidate-column cycles) ------------
+
+    def _drop_incremental(self, reason: str) -> None:
+        """One invalidation edge for ALL warm-solve state: the cached
+        score plane drops (rebuilt lazily from the resident table) and
+        the Sinkhorn potential carry dies with the generation bump. The
+        next cycle solves cold. Reasons: takeover | device-loss |
+        dirty-frac | full-snapshot (epoch/interner growth and node-set
+        changes all surface as full snapshot rebuilds).
+
+        Counted only when warm state actually EXISTED to drop (the
+        cache held a summary, a potential carry was live, or restricted
+        service had engaged) — a scheduler whose every cycle takes full
+        uploads must not mint one phantom invalidation per cycle."""
+        has = getattr(self.cache, "has_score_summary", None)
+        had = (self._incr_active or self._sk_warm_pot is not None
+               or bool(has() if has is not None else False))
+        self._sk_warm_pot = None
+        self._incr_active = False
+        drop = getattr(self.cache, "drop_score_summary", None)
+        if drop is not None and (has is None or has()):
+            # drop only a LIVE summary: the takeover/device-loss paths
+            # arrive after drop_device_snapshot already cleared it (and
+            # bumped the generation) — a second bump would be noise
+            drop()
+        if had and self.incremental.enabled:
+            m = getattr(self.metrics, "incremental_invalidations", None)
+            if m is not None:
+                m.inc(reason=reason)
+
+    def _candidate_bucket(self, n_pad: int) -> int:
+        """The restricted solve's candidate-column bucket: the config
+        value snapped UP to a power of two so the (P, C) solve shapes
+        stay inside the warmed grid."""
+        return bucket_size(max(self.incremental.candidate_bucket, 1))
+
+    def _incremental_eligible(self, batch, nominated, dn, dt, dv,
+                              snap_mode, no_ports, no_pod_aff, no_spread,
+                              nt) -> bool:
+        """May THIS cycle take the restricted solve? The gates mirror
+        the fused lean route's trace-time facts (whole-batch host
+        coupling and cross-node constraint classes need the full plane)
+        plus the incremental-specific ones: a live resident snapshot in
+        clean/delta mode (a full rebuild recomputed the whole score
+        plane — nothing to reuse), a micro-batch small enough for the
+        candidate bucket, and a dirty frontier under the blowout
+        threshold. Ineligible cycles take the cold solve; blowouts also
+        drop the cache (the documented invalidation edges)."""
+        inc = self.incremental
+        if not inc.enabled:
+            return False
+        if self.solver not in ("batch", "sinkhorn"):
+            return False
+        if snap_mode == "full":
+            # the whole plane was just recomputed (node-set change,
+            # interner/pack-epoch growth, explicit invalidation, dirty
+            # blowout at the snapshot layer) — warm state is dead
+            self._drop_incremental("full-snapshot")
+            return False
+        if snap_mode not in ("clean", "delta") or dn is None:
+            return False
+        if self.extenders or nominated:
+            return False
+        fw = self.framework
+        if (fw.has_host_filters() or fw.has_host_scores()
+                or fw.has_batch_filters() or fw.has_batch_scores()):
+            return False
+        if self.percentage_of_nodes_to_score is not None:
+            return False
+        if self.scenario_pack is not None:
+            return False
+        if any(p.pod_group for p in batch):
+            return False
+        # constraint classes that couple across the FULL node axis:
+        # ports/volumes couple in-batch per node (excluded outright);
+        # topology masks reduce over whole topology groups — only safe
+        # to drop when the batch-scoped gates prove them vacuous
+        if dv is not None or not no_ports:
+            return False
+        if dt is not None and not (no_pod_aff and no_spread):
+            return False
+        n_pad = dn.valid.shape[0]
+        C = self._candidate_bucket(n_pad)
+        if C >= n_pad:
+            return False  # restriction would not shrink the plane
+        if len(batch) > inc.max_batch_frac * C:
+            return False
+        dirty = len(getattr(self.cache, "last_patched_idx", ()))
+        if dirty > inc.max_dirty_frac * max(nt.n, 1):
+            self._drop_incremental("dirty-frac")
+            return False
+        return True
+
+    def _restricted_tail(self, batch, cycle, res, t0, trace, nt, dn, ds,
+                         dp, node_order, skip_prio):
+        """The incremental cycle's solve + bind tail: pick candidate
+        columns from the cached score plane (O(N log C) — the only
+        full-N work), gather them into a (P, C) view, solve with the
+        stock kernels, validate on device, and read back ONE global
+        assignment vector (the candidate index list never crosses —
+        d2h stays at the answer-sized budget). The result is accepted
+        only when EVERY pod placed: an under-placed batch (a pod may be
+        feasible on a non-candidate column, and the failure analytics
+        need the full plane) and any solve/validation error return None
+        so the caller re-solves cold — the PR-1 ladder's correctness
+        fallback, unchanged."""
+        from kubernetes_tpu.faults import SolverResultInvalid
+        from kubernetes_tpu.ops.arrays import (
+            gather_candidates,
+            map_restricted_assignment,
+        )
+        from kubernetes_tpu.ops.assign import (
+            VALIDATE_REASONS,
+            batch_assign,
+            device_validate,
+            validate_solution,
+        )
+
+        inc = self.incremental
+        summary = None
+        get_summary = getattr(self.cache, "score_summary", None)
+        if get_summary is not None:
+            summary = get_summary()
+        if summary is None:
+            return None
+        n_pad = dn.valid.shape[0]
+        C = self._candidate_bucket(n_pad)
+        idxs = [int(i) for i in getattr(self.cache, "last_patched_idx",
+                                        ())]
+        dirty = np.zeros((n_pad,), bool)
+        if idxs:
+            dirty[idxs] = True  # host ints from the cache's delta ledger
+        # a post-drop lazy rebuild recomputed the WHOLE plane this
+        # cycle — honest reuse is zero, not 1 - dirty/live
+        if getattr(self.cache, "last_summary_rebuilt", False):
+            reuse = 0.0
+        else:
+            reuse = max(0.0, 1.0 - len(idxs) / max(nt.n, 1))
+        rc = self.robustness
+        use_sk = self.solver == "sinkhorn"
+        want_stats = bool(self.obs.config.sinkhorn_telemetry and use_sk)
+        warm = bool(inc.warm_potentials and use_sk)
+        gen = getattr(self.cache, "summary_generation", 0)
+        pot_key = (dp.valid.shape[0], C, gen)
+        sk_init = None
+        if warm and self._sk_warm_pot is not None \
+                and self._sk_warm_pot[0] == pot_key:
+            sk_init = self._sk_warm_pot[1]
+        hook = (self.fault_injector.solver_hook
+                if self.fault_injector is not None else None)
+        # retrace telemetry: the candidate/gather program and the
+        # restricted solve program are distinct compiled sites — both
+        # registered so the zero-retrace contract covers them
+        self.obs.jax.record_call(
+            "incremental", summary.rank, static=(C, n_pad,
+                                                 self._mesh_live))
+        try:
+            with self.obs.span("solve:restricted"):
+                cand, sub_dn = gather_candidates(summary,
+                                                 jnp.asarray(dirty), dn, C)
+                self.obs.jax.record_call(
+                    "solve", dp, sub_dn, ds,
+                    static=("restricted", self.solver, tuple(skip_prio),
+                            self.pred_mask, self.per_node_cap,
+                            self.max_rounds, sk_init is None,
+                            self._mesh_live),
+                )
+                out = batch_assign(
+                    dp, sub_dn, ds, self.weights,
+                    max_rounds=self.max_rounds,
+                    per_node_cap=self.per_node_cap,
+                    enabled_mask=self.pred_mask, use_sinkhorn=use_sk,
+                    skip_priorities=skip_prio, no_ports=True,
+                    no_pod_affinity=True, no_spread=True,
+                    fault_hook=hook, fault_site="solve:restricted",
+                    stats_out=want_stats,
+                    sk_init=sk_init,
+                    sk_tol=(inc.warm_tol if warm else None),
+                    potentials_out=warm,
+                )
+                a_local, u_local, rounds = out[0], out[1], out[2]
+                k = 3
+                if want_stats:
+                    self.obs.note_sinkhorn(out[k])
+                    k += 1
+                potentials = out[k] if warm else None
+                payload = {"rounds": rounds}
+                dv_out = None
+                if rc.validate_results and not rc.host_validate:
+                    with self.obs.span("validate"):
+                        dv_out = device_validate(a_local, u_local, dp,
+                                                 sub_dn, self.pred_mask)
+                    if dv_out is not None:
+                        payload["code"], payload["valid"] = dv_out
+                if rc.validate_results and dv_out is None:
+                    # host trust floor (host_validate / unshippable
+                    # result): same checker, candidate-local frame
+                    ok, why = validate_solution(a_local, u_local, dp,
+                                                sub_dn, self.pred_mask)
+                    if not ok:
+                        raise SolverResultInvalid(f"restricted: {why}")
+                payload["assigned"] = map_restricted_assignment(
+                    a_local, cand)
+                host = self.obs.jax.readback("solve-result", payload)
+                code = int(host.get("code", 0))
+                if code:
+                    raise SolverResultInvalid(
+                        f"restricted: {VALIDATE_REASONS[code]}")
+                assigned = host["assigned"]
+        except Exception as e:
+            # ANY restricted failure — a lying solver, a device error,
+            # a validation verdict — declines the attempt; the caller
+            # re-solves cold through the full ladder (which owns the
+            # breaker/retry/fallback machinery)
+            klog.warning("restricted solve declined (%s); cold solve", e)
+            self._drop_incremental("restricted-error")
+            m = getattr(self.metrics, "incremental_cycles", None)
+            if m is not None:
+                m.inc(scope="declined")
+            return None
+        placed = assigned[: len(batch)]
+        if (placed < 0).any():
+            # a pod the candidate set could not place might fit on a
+            # non-candidate column — only the cold solve can say (and
+            # produce the failure analytics / preemption inputs)
+            m = getattr(self.metrics, "incremental_cycles", None)
+            if m is not None:
+                m.inc(scope="under-placed")
+            return None
+        if warm and potentials is not None:
+            self._sk_warm_pot = (pot_key, potentials)
+        self._incr_active = True
+        res.rounds = int(host["rounds"])
+        res.solver_tier = self.solver
+        res.solve_scope = "restricted"
+        res.reuse_frac = round(reuse, 4)
+        solve_s = trace.total_s()
+        trace.step(f"restricted solve done ({res.rounds} rounds, "
+                   f"C={C}, reuse={reuse:.3f})")
+        self.metrics.algorithm_duration.observe(solve_s)
+        bind_span = trace.begin_span("bind")
+        for i, pod in enumerate(batch):
+            self._admit_pod(pod, node_order[int(placed[i])], cycle, res)
+        trace.end_span(bind_span)
+        trace.step(f"bound {res.scheduled}, failed {res.unschedulable}")
+        if getattr(self.obs.config, "explain", True):
+            # no filter-pass failures by construction (everything
+            # placed), but admission-tail failures still get report
+            # rows and the reason gauges roll over to this cycle
+            self._build_explain_report(cycle, batch, [], None, nt.n, res)
+        return self._finish_cycle(res, cycle, t0, solve_s, trace,
+                                  label=" (restricted)")
 
     def _pipelined_tail(self, batch, cycle, res, t0, trace, nt, dn, ds, dt,
                         node_order, skip_prio, no_ports, no_pod_aff,
@@ -3074,6 +3425,28 @@ class Scheduler:
                 self.cache.drop_device_snapshot()
                 klog.warning("warmup aborted at bucket %d: %s", P, e)
                 return compiled
+        if self.device_resident_snapshot and self.mesh is None:
+            # pre-compile the PR-5 delta scatter at the dirty-row
+            # buckets steady churn presents — left to first sight it
+            # costs a ~0.5s XLA compile on the hot path, exactly the
+            # p99 spike the warmup contract exists to kill (mesh mode
+            # keeps first-sight: the replicated-sub/sharded-resident
+            # layout is built per mesh and cheap to compile there)
+            try:
+                self._warm_delta_scatter(dn)
+            except Exception as e:
+                klog.warning("delta-scatter warmup aborted: %s", e)
+        if self.incremental.enabled:
+            # pre-compile the restricted-solve signatures (candidate
+            # pick + gather + (P, C) solve + fused validate + global
+            # mapping) so incremental cycles stay zero-retrace: the
+            # serving loop's micro-batches flush at warmed pod buckets,
+            # and the candidate bucket C is one static shape
+            try:
+                compiled += self._warm_incremental(buckets, pk, sample,
+                                                   dn, ds, skip_prio)
+            except Exception as e:
+                klog.warning("incremental warmup aborted: %s", e)
         if wu.host_fallback and self.mesh is not None and self._mesh_live:
             # ALSO warm the single-device host-mode signatures — the
             # shapes a device-loss cooloff cycle presents (resident
@@ -3109,6 +3482,16 @@ class Scheduler:
                         klog.warning("host-fallback warmup aborted at "
                                      "bucket %d: %s", P, e)
                         return compiled
+                if self.incremental.enabled:
+                    # the restricted signatures in host mode too — the
+                    # heal boundary's first post-cooloff cycles must not
+                    # pay a hot-path compile either
+                    try:
+                        compiled += self._warm_incremental(
+                            buckets, pk, sample, dn_h, ds_h, skip_prio)
+                    except Exception as e:
+                        klog.warning("incremental host-fallback warmup "
+                                     "aborted: %s", e)
             finally:
                 self._mesh_live = self.mesh is not None
         klog.V(2).info("warmup: compiled %d bucketed solve shapes "
@@ -3204,6 +3587,136 @@ class Scheduler:
             jax.block_until_ready(fr.mask)
         self.metrics.warmup_compiles.inc()
         return 1
+
+    def _warm_delta_scatter(self, dn) -> int:
+        """Compile the donated delta-scatter programs for the small
+        dirty-row buckets (the same geometric family the cache's delta
+        path buckets to). The resident template is a throwaway COPY of
+        the warm table — the scatter donates its buffers, and donating
+        the cache's real resident arrays would invalidate them."""
+        import jax
+
+        from kubernetes_tpu.ops.arrays import (
+            gather_node_rows,
+            scatter_node_rows,
+        )
+
+        n_pad = dn.valid.shape[0]
+        compiled = 0
+        for dpb in (4, 8, 16, 32, 64):
+            sub = gather_node_rows(dn, jnp.zeros((dpb,), jnp.int32))
+            resident = jax.tree_util.tree_map(jnp.copy, dn)
+            out = scatter_node_rows(resident, sub,
+                                    np.full((dpb,), n_pad, np.int32))
+            jax.block_until_ready(out.requested)
+            compiled += 1
+        return compiled
+
+    def _warm_incremental(self, buckets, pk, sample, dn, ds,
+                          skip_prio) -> int:
+        """Pre-compile the restricted-solve programs for every pod
+        bucket that can take the incremental route: the candidate pick
+        (top-k over the cached plane), the node-row gather, the (P, C)
+        solve — cold AND (for the sinkhorn solver) warm-started — the
+        fused validator, the global mapping, and one delta-bucket
+        summary patch. Signatures pre-register with the telemetry so
+        the first incremental cycle classifies as a cache hit."""
+        import jax
+
+        from kubernetes_tpu.ops.arrays import (
+            gather_candidates,
+            gather_node_rows,
+            map_restricted_assignment,
+        )
+        from kubernetes_tpu.ops.assign import batch_assign, device_validate
+        from kubernetes_tpu.ops.fused_score import (
+            node_summary,
+            patch_node_summary,
+        )
+
+        inc = self.incremental
+        n_pad = dn.valid.shape[0]
+        C = self._candidate_bucket(n_pad)
+        if C >= n_pad:
+            return 0
+        flags = self._summary_flags
+        summary = node_summary(dn, **flags)
+        self.obs.jax.record_call("incremental", summary.rank,
+                                 static=(C, n_pad, self._mesh_live),
+                                 warmup=True)
+        cand, sub_dn = gather_candidates(summary,
+                                         jnp.zeros((n_pad,), bool), dn, C)
+        # summary patches at the delta buckets steady churn actually
+        # presents (the scatter programs bucket geometrically exactly
+        # like the PR-5 snapshot delta — an unwarmed bucket would
+        # compile mid-churn and spike that cycle's latency)
+        for dpb in (4, 8, 16, 32, 64):
+            sub = gather_node_rows(dn, jnp.zeros((dpb,), jnp.int32))
+            patched = patch_node_summary(
+                node_summary(dn, **flags), node_summary(sub, **flags),
+                np.full((dpb,), n_pad, np.int32))
+            jax.block_until_ready(patched.rank)
+        use_sk = self.solver == "sinkhorn"
+        warm = bool(inc.warm_potentials and use_sk)
+        want_stats = bool(self.obs.config.sinkhorn_telemetry and use_sk)
+        compiled = 0
+        limit = inc.max_batch_frac * C
+        smallest_bucket = bucket_size(1)
+        for P in buckets:
+            # warm P iff SOME eligible batch pads to it: the runtime
+            # gate compares the RAW batch size (<= maxBatchFrac*C)
+            # before padding, so the bucket covering floor(limit) must
+            # be warmed even when the bucket itself exceeds the limit
+            smallest_in_bucket = 1 if P <= smallest_bucket else P // 2 + 1
+            if smallest_in_bucket > limit:
+                continue  # no eligible batch can pad to this bucket
+            dp = self._place(pods_to_device(pk.pack_pods(sample[:P]),
+                                            pad_to=P))
+            self.obs.jax.record_call(
+                "solve", dp, sub_dn, ds,
+                static=("restricted", self.solver, tuple(skip_prio),
+                        self.pred_mask, self.per_node_cap,
+                        self.max_rounds, True, self._mesh_live),
+                warmup=True)
+            variants = [dict(sk_init=None)]
+            if warm:
+                # the warm-started program is a DIFFERENT signature
+                # (potential operands join the trace) — compile it too
+                # or the second incremental cycle retraces
+                zp = (jnp.zeros((P,), jnp.float32),
+                      jnp.zeros((C,), jnp.float32))
+                variants.append(dict(sk_init=zp))
+                self.obs.jax.record_call(
+                    "solve", dp, sub_dn, ds,
+                    static=("restricted", self.solver, tuple(skip_prio),
+                            self.pred_mask, self.per_node_cap,
+                            self.max_rounds, False, self._mesh_live),
+                    warmup=True)
+            for var in variants:
+                out = batch_assign(
+                    dp, sub_dn, ds, self.weights,
+                    max_rounds=self.max_rounds,
+                    per_node_cap=self.per_node_cap,
+                    enabled_mask=self.pred_mask, use_sinkhorn=use_sk,
+                    skip_priorities=skip_prio, no_ports=True,
+                    no_pod_affinity=True, no_spread=True,
+                    stats_out=want_stats,
+                    sk_tol=(inc.warm_tol if warm else None),
+                    potentials_out=warm, **var)
+                a, wu_usage = out[0], out[1]
+                if (self.robustness.validate_results
+                        and not self.robustness.host_validate):
+                    dv_out = device_validate(a, wu_usage, dp, sub_dn,
+                                             self.pred_mask)
+                    if dv_out is not None:
+                        jax.block_until_ready(dv_out[0])
+                jax.block_until_ready(
+                    map_restricted_assignment(a, cand))
+            compiled += 1
+            self.metrics.warmup_compiles.inc()
+        klog.V(2).info("incremental warmup: compiled %d restricted "
+                       "(P, %d) solve shapes", compiled, C)
+        return compiled
 
     def is_degraded(self) -> bool:
         """Is the backend limping? True while the device is in its

@@ -69,6 +69,16 @@ gang atomicity violations == 0, zero retraces, readback within the
 budget). Single-record runs pass gracefully: the deltas skip, the
 absolutes still enforce.
 
+Incremental-solve gates (scripts/bench_churn.py --incr-sweep records)
+ride the two newest ``benchres/churn_incr_r*.json``: the warm arm's
+steady-state cycle-cost growth across the cluster-size sweep must stay
+flat (``flatness.warm_growth`` ≤ 1.3 — the O(churn) tentpole claim)
+while the cold arm grows measurably faster, warm cells must actually
+run restricted, the seeded warm-vs-cold placement-quality delta must
+stay inside the record's documented bound, and zero retraces + the
+absolute readback budget hold on every cell. Deltas (warm cycle cost,
+flatness ratio) need two records; the absolutes enforce on one.
+
 ``--list-gates`` prints every active gate family (name, record source,
 what it enforces) — the docs reference this output instead of
 hand-maintaining the list.
@@ -143,6 +153,21 @@ def find_mesh_records(directory: str) -> List[str]:
         return (int(m.group(1)) if m else -1, os.path.basename(path))
 
     return sorted(glob.glob(os.path.join(directory, "mesh_r*.json")),
+                  key=round_key)
+
+
+def find_churn_incr_records(directory: str) -> List[str]:
+    """churn_incr_r*.json (scripts/bench_churn.py --incr-sweep records)
+    sorted by round — the incremental-solve gate family's inputs.
+    Absence is tolerated: benchres directories predating the
+    incremental mode keep passing. Disjoint from find_churn_records by
+    glob (churn_r* does not match churn_incr_r*)."""
+
+    def round_key(path: str) -> Tuple[int, str]:
+        m = re.search(r"churn_incr_r(\d+)", os.path.basename(path))
+        return (int(m.group(1)) if m else -1, os.path.basename(path))
+
+    return sorted(glob.glob(os.path.join(directory, "churn_incr_r*.json")),
                   key=round_key)
 
 
@@ -629,6 +654,128 @@ def compare_scenario(prev: dict, cur: dict, threshold: float,
             "warnings": warnings}
 
 
+def compare_churn_incr(prev: dict, cur: dict, threshold: float,
+                       readback_budget: float = 16.0) -> dict:
+    """Incremental-solve gates over two churn_incr_r*.json records
+    (pure, unit-tested) — the O(churn) contract of the incremental mode
+    (docs/perf.md "incremental solve"):
+
+    - ABSOLUTE invariants on the NEW record alone (single-record runs
+      pass gracefully on the deltas): the warm arm's steady-state
+      cycle-cost growth across the cluster-size sweep stays FLAT
+      (``flatness.warm_growth`` ≤ 1.3 — the tentpole claim), the cold
+      arm grows measurably faster than the warm arm, the warm cells
+      actually ran restricted (≥ 0.8 of solve cycles), the seeded
+      warm-vs-cold quality delta stays inside the record's documented
+      bound with placed counts equal, zero retraces on every cell, and
+      d2h readback within ``readback_budget`` bytes/pod;
+    - delta gates (need two records): the warm arm's steady-state
+      cycle cost and flatness ratio must not regress.
+
+    Absent sections are warnings, never failures — same posture as
+    every other gate family."""
+    checks, regressions, warnings = [], [], []
+
+    def check(name: str, prev_v, cur_v, lower_is_better: bool = False):
+        pv, cv = _num(prev_v), _num(cur_v)
+        if pv is None or cv is None or pv <= 0:
+            warnings.append(f"{name}: not comparable "
+                            f"(prev={prev_v!r}, cur={cur_v!r})")
+            return
+        delta = (cv - pv) / pv
+        bad = delta > threshold if lower_is_better else delta < -threshold
+        row = {"check": name, "prev": pv, "cur": cv,
+               "delta_frac": round(delta, 4), "regressed": bad}
+        checks.append(row)
+        if bad:
+            regressions.append(row)
+
+    def absolute(name: str, cur_v, bad: bool):
+        row = {"check": name, "prev": None, "cur": cur_v,
+               "delta_frac": cur_v, "regressed": bad}
+        checks.append(row)
+        if bad:
+            regressions.append(row)
+
+    cf = cur.get("flatness") or {}
+    pf = prev.get("flatness") or {}
+    warm_g = _num(cf.get("warm_growth"))
+    cold_g = _num(cf.get("cold_growth"))
+    if warm_g is not None:
+        # the tentpole claim: steady-state cycle cost flat (≤ 1.3x)
+        # while the cluster grows ≥ 4x at fixed churn rate
+        absolute("incremental.flatness.warm_growth", warm_g,
+                 warm_g > 1.3)
+        if cold_g is not None:
+            absolute("incremental.flatness.cold_grows", cold_g,
+                     cold_g <= warm_g + 0.2)
+    else:
+        warnings.append("incremental: no flatness section in the new "
+                        "record")
+    cells = cur.get("cells") or {}
+    warm_cells = {k: v for k, v in cells.items() if k.startswith("warm_")}
+    for label, cell in sorted(cells.items()):
+        # retraces_total spans every recorded site (solve AND the
+        # restricted path's candidate/gather site); older records fall
+        # back to the solve-site count
+        rt = _num(cell.get("retraces_total",
+                           (cell.get("jax") or {}).get("retraces")))
+        if rt is not None:
+            absolute(f"incremental.{label}.retraces", rt, rt > 0)
+        bpp = _num(cell.get("readback_bytes_per_pod"))
+        if bpp is not None:
+            absolute(f"incremental.{label}.readback_budget", bpp,
+                     bpp > readback_budget)
+    for label, cell in sorted(warm_cells.items()):
+        rf = _num(cell.get("restricted_frac"))
+        if rf is not None:
+            absolute(f"incremental.{label}.restricted_frac", rf,
+                     rf < 0.8)
+    q = cur.get("quality") or {}
+    if q:
+        absolute("incremental.quality.placed_equal",
+                 1.0 if q.get("placed_equal") else 0.0,
+                 not q.get("placed_equal"))
+        if "restricted_engaged" in q:
+            # a quality pass where the warm arm silently solved cold
+            # proves nothing — the comparison must have exercised the
+            # restricted path
+            absolute("incremental.quality.restricted_engaged",
+                     1.0 if q.get("restricted_engaged") else 0.0,
+                     not q.get("restricted_engaged"))
+        qd = _num(q.get("score_delta_frac_max"))
+        bound = _num(cur.get("quality_bound")) or 0.02
+        if qd is not None:
+            absolute("incremental.quality.score_delta", qd, qd > bound)
+    else:
+        warnings.append("incremental: no quality section in the new "
+                        "record")
+    # delta gates — the warm arm's cost and flatness must not erode
+    if pf:
+        check("incremental.flatness.warm_growth_delta",
+              pf.get("warm_growth"), cf.get("warm_growth"),
+              lower_is_better=True)
+        sizes = cur.get("sizes") or []
+        psizes = prev.get("sizes") or []
+        for n in sizes:
+            if n not in psizes:
+                continue
+            check(f"incremental.warm_{n}.steady_mean_solve_s",
+                  ((prev.get("cells") or {}).get(f"warm_{n}") or {}
+                   ).get("steady_mean_solve_s"),
+                  (cells.get(f"warm_{n}") or {}
+                   ).get("steady_mean_solve_s"),
+                  lower_is_better=True)
+    for rec, label in ((prev, "prev"), (cur, "cur")):
+        errs = rec.get("errors") or []
+        if errs:
+            warnings.append(f"{label} churn_incr record carries "
+                            f"{len(errs)} error(s); affected sections "
+                            "may be absent")
+    return {"checks": checks, "regressions": regressions,
+            "warnings": warnings}
+
+
 #: every active gate family: (name, record glob, what it enforces) —
 #: the --list-gates surface the docs reference. Keep one row per
 #: compare_* section so a new gate family cannot land invisibly.
@@ -657,6 +804,11 @@ GATE_FAMILIES = [
      "at equal feasibility, gang success rate + locality, gang "
      "atomicity violations==0, zero retraces, absolute readback "
      "budget"),
+    ("incremental", "churn_incr_r*.json",
+     "incremental solve: steady-state cycle-cost flatness (warm_growth "
+     "<= 1.3 across the cluster-size sweep) while the cold arm grows, "
+     "restricted engagement, warm-vs-cold quality delta within the "
+     "documented bound, zero retraces, absolute readback budget"),
 ]
 
 
@@ -803,6 +955,35 @@ def main(argv=None) -> int:
         verdict["warnings"].extend(scv["warnings"])
         verdict["scenario_records"] = [
             os.path.relpath(p, REPO_ROOT) for p in sc_found[-2:]]
+    # incremental-solve gates (scripts/bench_churn.py --incr-sweep
+    # records) — absence tolerated so benchres directories predating the
+    # incremental mode keep passing; a single record still enforces the
+    # absolute invariants (flatness, restricted engagement, quality
+    # bound, zero retraces, readback budget)
+    ci_found = find_churn_incr_records(args.dir)
+    if ci_found:
+        try:
+            ci_prev = load(ci_found[-2]) if len(ci_found) >= 2 else {}
+            ci_cur = load(ci_found[-1])
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot load churn_incr records: {e}",
+                  file=sys.stderr)
+            return 2
+        civ = compare_churn_incr(ci_prev, ci_cur, args.threshold,
+                                 args.mesh_readback_budget)
+        if len(ci_found) < 2:
+            verdict["warnings"].append(
+                "only one churn_incr record — delta gates need two to "
+                "compare (the absolute invariants still apply)")
+            civ["checks"] = [r for r in civ["checks"]
+                             if r["prev"] is None]
+            civ["regressions"] = [r for r in civ["checks"]
+                                  if r["regressed"]]
+        verdict["checks"].extend(civ["checks"])
+        verdict["regressions"].extend(civ["regressions"])
+        verdict["warnings"].extend(civ["warnings"])
+        verdict["churn_incr_records"] = [
+            os.path.relpath(p, REPO_ROOT) for p in ci_found[-2:]]
     # sharded-backend gates (scripts/bench_mesh_scale.py records) —
     # absence tolerated so pre-mesh benchres directories keep passing
     mesh_found = find_mesh_records(args.dir)
@@ -839,7 +1020,7 @@ def main(argv=None) -> int:
         verdict["mesh_records"] = [
             os.path.relpath(mesh_found[-1], REPO_ROOT)]
     if prev_path is None and len(churn_found) < 2 and not mesh_found \
-            and not cm_found and not sc_found:
+            and not cm_found and not sc_found and not ci_found:
         msg = (f"not enough records in {args.dir} — nothing to gate")
         if args.format == "json":
             print(json.dumps({"status": "skipped", "reason": msg}))
