@@ -142,6 +142,24 @@ class RecoveryConfigurationV1alpha1:
 
 
 @dataclass
+class LedgerConfigurationV1alpha1:
+    """Versioned spelling of the perf-ledger / SLO-watchdog block
+    (config.LedgerConfig): camelCase, the objective and windows as
+    metav1.Duration strings like every other versioned time field."""
+
+    enabled: Optional[bool] = None
+    history: Optional[int] = None
+    distWindow: Optional[int] = None
+    baselineDecay: Optional[float] = None
+    e2eP99Objective: Optional[str] = None  # "0s" = objective off
+    costDriftRatio: Optional[float] = None  # 0 = objective off
+    fastWindow: Optional[str] = None
+    slowWindow: Optional[str] = None
+    burnThreshold: Optional[float] = None
+    engagePressure: Optional[bool] = None
+
+
+@dataclass
 class ObservabilityConfigurationV1alpha1:
     """Versioned spelling of the observability knobs
     (config.ObservabilityConfig): camelCase, the trace threshold as a
@@ -157,6 +175,8 @@ class ObservabilityConfigurationV1alpha1:
     sinkhornTelemetry: Optional[bool] = None
     explain: Optional[bool] = None
     explainTopK: Optional[int] = None
+    ledger: "LedgerConfigurationV1alpha1" = field(
+        default_factory=LedgerConfigurationV1alpha1)
 
 
 @dataclass
@@ -409,6 +429,27 @@ def set_defaults_kube_scheduler_configuration(
         ob.explain = True
     if ob.explainTopK is None:
         ob.explainTopK = 3
+    lg = ob.ledger
+    if lg.enabled is None:
+        lg.enabled = True
+    if lg.history is None:
+        lg.history = 256
+    if lg.distWindow is None:
+        lg.distWindow = 256
+    if lg.baselineDecay is None:
+        lg.baselineDecay = 0.05
+    if lg.e2eP99Objective is None:
+        lg.e2eP99Objective = "0s"  # objective off (the internal default)
+    if lg.costDriftRatio is None:
+        lg.costDriftRatio = 0.0
+    if lg.fastWindow is None:
+        lg.fastWindow = "1m0s"
+    if lg.slowWindow is None:
+        lg.slowWindow = "10m0s"
+    if lg.burnThreshold is None:
+        lg.burnThreshold = 1.0
+    if lg.engagePressure is None:
+        lg.engagePressure = True
     sv = obj.serving
     if sv.enabled is None:
         sv.enabled = False
@@ -669,8 +710,9 @@ def _warmup_to_internal(wu: WarmupConfigurationV1alpha1):
 
 
 def _observability_to_internal(ob: ObservabilityConfigurationV1alpha1):
-    from kubernetes_tpu.config import ObservabilityConfig
+    from kubernetes_tpu.config import LedgerConfig, ObservabilityConfig
 
+    lg = ob.ledger
     return ObservabilityConfig(
         enabled=ob.enabled,
         trace_threshold_s=_dur("traceThreshold", ob.traceThreshold,
@@ -683,6 +725,21 @@ def _observability_to_internal(ob: ObservabilityConfigurationV1alpha1):
         sinkhorn_telemetry=ob.sinkhornTelemetry,
         explain=ob.explain,
         explain_top_k=ob.explainTopK,
+        ledger=LedgerConfig(
+            enabled=lg.enabled,
+            history=lg.history,
+            dist_window=lg.distWindow,
+            baseline_decay=lg.baselineDecay,
+            e2e_p99_objective_s=_dur("ledger.e2eP99Objective",
+                                     lg.e2eP99Objective, "observability"),
+            cost_drift_ratio=lg.costDriftRatio,
+            fast_window_s=_dur("ledger.fastWindow", lg.fastWindow,
+                               "observability"),
+            slow_window_s=_dur("ledger.slowWindow", lg.slowWindow,
+                               "observability"),
+            burn_threshold=lg.burnThreshold,
+            engage_pressure=lg.engagePressure,
+        ),
     )
 
 
@@ -800,6 +857,21 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
             sinkhornTelemetry=c.observability.sinkhorn_telemetry,
             explain=c.observability.explain,
             explainTopK=c.observability.explain_top_k,
+            ledger=LedgerConfigurationV1alpha1(
+                enabled=c.observability.ledger.enabled,
+                history=c.observability.ledger.history,
+                distWindow=c.observability.ledger.dist_window,
+                baselineDecay=c.observability.ledger.baseline_decay,
+                e2eP99Objective=format_duration(
+                    c.observability.ledger.e2e_p99_objective_s),
+                costDriftRatio=c.observability.ledger.cost_drift_ratio,
+                fastWindow=format_duration(
+                    c.observability.ledger.fast_window_s),
+                slowWindow=format_duration(
+                    c.observability.ledger.slow_window_s),
+                burnThreshold=c.observability.ledger.burn_threshold,
+                engagePressure=c.observability.ledger.engage_pressure,
+            ),
         ),
         serving=ServingConfigurationV1alpha1(
             enabled=c.serving.enabled,

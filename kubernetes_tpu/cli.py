@@ -33,6 +33,7 @@ from kubernetes_tpu.config import (
     IncrementalConfig,
     KubeSchedulerConfiguration,
     LeaderElectionConfig,
+    LedgerConfig,
     ObservabilityConfig,
     ParallelConfig,
     RecoveryConfig,
@@ -187,6 +188,32 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         errs.append("observability.retraceStormWindow: must be at least 1")
     if oc.explain_top_k < 1:
         errs.append("observability.explainTopK: must be at least 1")
+    lg = oc.ledger
+    if lg.history < 1:
+        errs.append("observability.ledger.history: must be at least 1")
+    if lg.dist_window < 1:
+        errs.append("observability.ledger.distWindow: must be at least 1")
+    if not 0 < lg.baseline_decay <= 1:
+        errs.append(
+            f"observability.ledger.baselineDecay: Invalid value "
+            f"{lg.baseline_decay}: not in valid range (0, 1]")
+    if lg.e2e_p99_objective_s < 0:
+        errs.append(
+            "observability.ledger.e2eP99Objective: must be non-negative "
+            "(0 = objective off)")
+    if lg.cost_drift_ratio < 0:
+        errs.append(
+            "observability.ledger.costDriftRatio: must be non-negative "
+            "(0 = objective off)")
+    if lg.fast_window_s <= 0:
+        errs.append(
+            "observability.ledger.fastWindow: must be greater than zero")
+    if lg.slow_window_s < lg.fast_window_s:
+        errs.append(
+            "observability.ledger.slowWindow: must be at least fastWindow")
+    if lg.burn_threshold <= 0:
+        errs.append(
+            "observability.ledger.burnThreshold: must be greater than zero")
     sc = cfg.serving
     if sc.min_wait_s < 0:
         errs.append("serving.minWait: must be non-negative")
@@ -257,6 +284,7 @@ _LE_FIELDS = {f.name for f in dataclasses.fields(LeaderElectionConfig)}
 _ROB_FIELDS = {f.name for f in dataclasses.fields(RobustnessConfig)}
 _REC_FIELDS = {f.name for f in dataclasses.fields(RecoveryConfig)}
 _OBS_FIELDS = {f.name for f in dataclasses.fields(ObservabilityConfig)}
+_LEDGER_FIELDS = {f.name for f in dataclasses.fields(LedgerConfig)}
 _WARMUP_FIELDS = {f.name for f in dataclasses.fields(WarmupConfig)}
 _INC_FIELDS = {f.name for f in dataclasses.fields(IncrementalConfig)}
 _SERVING_FIELDS = {f.name for f in dataclasses.fields(ServingConfig)}
@@ -345,7 +373,20 @@ def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
                     f"observability: unknown field(s) {sorted(unknown)}"
                 )
                 continue
-            kw["observability"] = ObservabilityConfig(**val)
+            okw = dict(val)
+            if "ledger" in okw:
+                lval = okw["ledger"]
+                if not isinstance(lval, dict):
+                    errs.append("observability.ledger: expected a mapping")
+                    continue
+                lunknown = set(lval) - _LEDGER_FIELDS
+                if lunknown:
+                    errs.append(
+                        f"observability.ledger: unknown field(s) "
+                        f"{sorted(lunknown)}")
+                    continue
+                okw["ledger"] = LedgerConfig(**lval)
+            kw["observability"] = ObservabilityConfig(**okw)
         elif key == "warmup":
             if not isinstance(val, dict):
                 errs.append("warmup: expected a mapping")

@@ -180,6 +180,45 @@ class RecoveryConfig:
 
 
 @dataclass
+class LedgerConfig:
+    """Perf ledger + SLO watchdog (obs/ledger.py): per-cycle
+    measured-vs-modeled cost accounting and multi-window burn-rate
+    objectives. Rides the observability block (``observability.ledger``)
+    because it consumes ``end_cycle`` — the recorder's master switch
+    gates it too."""
+
+    #: fold each eventful cycle into the ledger (measured phase
+    #: distributions, model efficiency, watchdog). Off = zero per-cycle
+    #: cost beyond the flight record that already exists.
+    enabled: bool = True
+    #: ledger entry ring capacity (cycles); oldest entries evict
+    history: int = 256
+    #: retained samples per (phase x scope x mesh) distribution cell
+    dist_window: int = 256
+    #: EWMA decay for the phase trends AND the watchdog's rolling
+    #: cycle-cost baseline (higher = faster re-basing after a change)
+    baseline_decay: float = 0.05
+    #: create-to-bind p99 objective, seconds (0 = objective off): the
+    #: watchdog burns when more than 1% of bound pods exceed it
+    e2e_p99_objective_s: float = 0.0
+    #: cycle-cost drift objective (0 = off): a cycle whose solve cost
+    #: exceeds ratio x the rolling per-scope baseline is a violation;
+    #: more than 10% violating cycles in a window burns
+    cost_drift_ratio: float = 0.0
+    #: burn-rate windows (seconds, on the scheduler's clock): the
+    #: watchdog trips only when BOTH windows burn (SRE multi-window
+    #: rule) and recovers when the FAST window clears
+    fast_window_s: float = 60.0
+    slow_window_s: float = 600.0
+    #: burn rate (violating fraction / error budget) at which a window
+    #: counts as burning
+    burn_threshold: float = 1.0
+    #: while burning, report the scheduler degraded so APF admission
+    #: sheds earlier at the same queue depth (backend_pressure)
+    engage_pressure: bool = True
+
+
+@dataclass
 class ObservabilityConfig:
     """Observability knobs (kubernetes_tpu/obs): cycle tracing, the JAX
     compile/retrace telemetry, and the flight recorder. All times ride
@@ -218,6 +257,9 @@ class ObservabilityConfig:
     explain: bool = True
     #: relaxations kept per pod and reasons kept per flight record
     explain_top_k: int = 3
+    #: perf ledger + SLO watchdog (obs/ledger.py): per-cycle
+    #: measured-vs-modeled accounting, burn-rate objectives
+    ledger: LedgerConfig = field(default_factory=LedgerConfig)
 
 
 @dataclass

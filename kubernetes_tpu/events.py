@@ -32,6 +32,13 @@ REASON_RECOVERED = "SchedulerRecovered"
 #: TTL — the cache freed its capacity and the driver requeued it
 #: (scheduler._reap_expired_assumptions)
 REASON_ASSUMPTION_EXPIRED = "AssumptionExpired"
+#: the perf ledger's SLO watchdog (obs/ledger.py): an objective's
+#: multi-window burn rate crossed the threshold (create-to-bind p99 or
+#: cycle-cost drift), and the later fast-window recovery. Emitted on
+#: state TRANSITIONS only, then spam-filtered by the recorder like
+#: every other series — a burning hour costs a handful of sink posts.
+REASON_SLO_BURN = "SchedulerSLOBurn"
+REASON_SLO_RECOVERED = "SchedulerSLORecovered"
 
 _REASON_TYPE = {
     REASON_SCHEDULED: TYPE_NORMAL,
@@ -40,6 +47,8 @@ _REASON_TYPE = {
     REASON_DEGRADED: TYPE_WARNING,
     REASON_RECOVERED: TYPE_NORMAL,
     REASON_ASSUMPTION_EXPIRED: TYPE_WARNING,
+    REASON_SLO_BURN: TYPE_WARNING,
+    REASON_SLO_RECOVERED: TYPE_NORMAL,
 }
 
 

@@ -39,7 +39,13 @@ the incremental-solve block (docs/perf.md §5) —
 ``scheduler_incremental_cycles_total{scope}`` (restricted | full |
 declined | under-placed), the ``scheduler_incremental_reuse_fraction``
 gauge, and
-``scheduler_incremental_invalidations_total{reason}``. Note
+``scheduler_incremental_invalidations_total{reason}``; plus the
+perf-ledger block (``obs/ledger.py``) —
+``scheduler_cycle_model_efficiency`` /
+``scheduler_cycle_modeled_cost_seconds`` measured-vs-modeled gauges,
+``scheduler_cycle_phase_seconds{phase}`` per-phase attribution (stale
+phases read 0, the explain-gauge freshness rule), and
+``scheduler_slo_burn_rate{objective,window}``. Note
 ``scheduler_e2e_scheduling_duration_seconds`` observes PER-POD
 create-to-bind latency (queue-add stamp to bind) since the serving PR,
 matching the reference's per-pod scheduleOne observation.
@@ -491,6 +497,39 @@ class SchedulerMetrics:
             "scheduler_mesh_devices",
             "Devices in the node-axis mesh of the sharded execution "
             "backend (parallel.mesh config; 0 = single-device mode).",
+        ))
+        # -- perf ledger + SLO watchdog (obs/ledger.py) -----------------
+        self.cycle_model_efficiency = r.register(Gauge(
+            "scheduler_cycle_model_efficiency",
+            "Last cycle's modeled/measured solve-cost ratio (1 = the "
+            "cost model's prediction matched the measured solve; <1 = "
+            "the cycle ran slower than the model claims — the runtime "
+            "confrontation of parallel/costmodel.py with reality; -1 = "
+            "the last cycle ran no solve, so no verdict).",
+        ))
+        self.cycle_modeled_cost = r.register(Gauge(
+            "scheduler_cycle_modeled_cost_seconds",
+            "The cost model's predicted solve seconds for the last "
+            "cycle's batch shape (XLA cost_analysis flops when "
+            "captured at warmup, analytic P*N plane otherwise, with "
+            "the collective model folded in under a mesh; -1 = the "
+            "last cycle ran no solve).",
+        ))
+        self.cycle_phase_seconds = r.register(Gauge(
+            "scheduler_cycle_phase_seconds",
+            "Last cycle's measured wall seconds per canonical phase "
+            "(snapshot, pack, dispatch, solve, validate, readback, "
+            "bind, ...) — per-phase attribution of where the cycle "
+            "went; phases the last cycle did not run read 0.",
+            ["phase"],
+        ))
+        self.slo_burn_rate = r.register(Gauge(
+            "scheduler_slo_burn_rate",
+            "Multi-window SLO burn rate per objective (violating "
+            "fraction / error budget; >= the configured threshold in "
+            "BOTH windows trips SchedulerSLOBurn and engages APF "
+            "backpressure).",
+            ["objective", "window"],
         ))
         # -- scenario packs (kubernetes_tpu/scenarios) ------------------
         self.scenario_quality = r.register(Gauge(

@@ -20,6 +20,11 @@ every capture happens at the host boundaries graftlint already blesses):
   failure bitmask into per-pod reason node counts, the cluster-wide
   reason histogram, and one-bit-away relaxations; surfaced on
   ``/debug/why``, the flight recorder, metrics, and ``kubectl``.
+- :mod:`kubernetes_tpu.obs.ledger` — the perf ledger: per-cycle
+  measured phase-cost distributions confronted with the cost model's
+  prediction (``scheduler_cycle_model_efficiency``) plus the
+  multi-window SLO burn-rate watchdog; surfaced on ``/debug/ledger``,
+  the flight recorder's ``eff=``/``slo=`` flags, and the benches.
 
 :class:`kubernetes_tpu.obs.core.Observability` is the facade the
 scheduler owns; config rides :class:`kubernetes_tpu.config.
@@ -35,6 +40,12 @@ from kubernetes_tpu.obs.explain import (
     explain_reduce,
 )
 from kubernetes_tpu.obs.jaxtel import JaxTelemetry, abstract_digest, tree_nbytes
+from kubernetes_tpu.obs.ledger import (
+    CycleCostModel,
+    LedgerEntry,
+    PerfLedger,
+    SLOWatchdog,
+)
 from kubernetes_tpu.obs.recorder import CycleRecord, FlightRecorder
 from kubernetes_tpu.obs.trace import (
     DEFAULT_THRESHOLD_S,
@@ -53,6 +64,10 @@ __all__ = [
     "JaxTelemetry",
     "abstract_digest",
     "tree_nbytes",
+    "CycleCostModel",
+    "LedgerEntry",
+    "PerfLedger",
+    "SLOWatchdog",
     "CycleRecord",
     "FlightRecorder",
     "Span",

@@ -30,6 +30,7 @@ from contextlib import contextmanager, nullcontext
 from typing import Callable, List, Optional
 
 from kubernetes_tpu.obs.jaxtel import JaxTelemetry
+from kubernetes_tpu.obs.ledger import PerfLedger
 from kubernetes_tpu.obs.recorder import CycleRecord, FlightRecorder
 from kubernetes_tpu.obs.trace import Trace, chrome_trace_json
 
@@ -50,6 +51,13 @@ class Observability:
             storm_window=config.retrace_storm_window,
         )
         self.recorder = FlightRecorder(config.recorder_capacity)
+        #: perf ledger + SLO watchdog (obs/ledger.py): consumes each
+        #: eventful cycle's record at end_cycle — measured phase
+        #: distributions, measured-vs-modeled efficiency, burn-rate
+        #: objectives. getattr: duck-typed config fakes stay valid;
+        #: PerfLedger itself defaults a None config to LedgerConfig().
+        self.ledger = PerfLedger(getattr(config, "ledger", None),
+                                 metrics=metrics, clock=clock)
         self.traces: deque = deque(maxlen=max(1, config.trace_ring_capacity))
         #: guards the traces ring: the scheduler thread appends while the
         #: /debug/traces handler thread snapshots (deque iteration during
@@ -289,6 +297,26 @@ class Observability:
             mesh=s.get("mesh", self.mesh_devices),
             scenario=s.get("scenario", {}),
         )
+        # perf ledger (obs/ledger.py): fold the cycle's measured phase
+        # costs in, confront them with the cost model, run the SLO
+        # watchdog — then stamp the verdict back onto the record, the
+        # CycleResult, and the trace's Perfetto counter track. Pure
+        # host math over the spans already collected: zero new syncs.
+        # Phase attribution uses CHILD-EXCLUSIVE durations (a validate
+        # nested inside solve:batch counts once); the record keeps the
+        # inclusive view it documents.
+        entry = self.ledger.observe_cycle(rec, res,
+                                          spans=trace.self_durations())
+        if entry is not None:
+            rec.slo = entry.slo
+            if entry.efficiency >= 0:
+                rec.modeled_s = entry.modeled_s
+                rec.model_efficiency = entry.efficiency
+                rec.model_basis = entry.model_basis
+                if res is not None:
+                    res.modeled_s = entry.modeled_s
+                    res.model_efficiency = entry.efficiency
+                trace.counter("model_efficiency", eff=entry.efficiency)
         self.recorder.record(rec)
         self._eventful_seq += 1
         if self._sampled(self._eventful_seq):

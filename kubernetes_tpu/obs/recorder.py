@@ -87,6 +87,18 @@ class CycleRecord:
     #: scenario-pack placement-quality scores for this cycle (empty =
     #: scenario mode off / quality gated off)
     scenario: Dict[str, float] = field(default_factory=dict)
+    #: perf-ledger verdict (obs/ledger.py): the cost model's predicted
+    #: solve seconds for this cycle's shape, modeled/measured efficiency
+    #: (-1 = model not populated — no solve, or ledger off), and which
+    #: model basis produced the prediction (xla-cost | calibrated |
+    #: anchor)
+    modeled_s: float = -1.0
+    model_efficiency: float = -1.0
+    model_basis: str = ""
+    #: comma-joined SLO objectives burning as of this cycle ("" = ok) —
+    #: SIGUSR2 dumps and /debug/flightrecorder show efficiency + SLO
+    #: history without scraping metrics
+    slo: str = ""
 
     def to_json(self) -> dict:
         return {
@@ -128,6 +140,11 @@ class CycleRecord:
                if self.fenced_binds else {}),
             **({"mesh": self.mesh} if self.mesh else {}),
             **({"scenario": dict(self.scenario)} if self.scenario else {}),
+            **({"modeled_s": round(self.modeled_s, 6),
+                "model_efficiency": round(self.model_efficiency, 4),
+                "model_basis": self.model_basis}
+               if self.model_efficiency >= 0 else {}),
+            **({"slo": self.slo} if self.slo else {}),
         }
 
 
@@ -211,6 +228,10 @@ class FlightRecorder:
                 flags.append(f"device_reset={r.device_resets}")
             if r.fenced_binds:
                 flags.append(f"fenced={r.fenced_binds}")
+            if r.model_efficiency >= 0:
+                flags.append(f"eff={r.model_efficiency:.2f}")
+            if r.slo:
+                flags.append(f"slo={r.slo}")
             spans = " ".join(
                 f"{k}={v*1000:.1f}ms" for k, v in sorted(r.spans.items()))
             lines.append(

@@ -68,6 +68,11 @@ class Trace:
         self._stack: List[Span] = [self.root]
         #: flat (timestamp, msg) list — the seed-compat view of steps
         self.steps: List[Tuple[float, str]] = []
+        #: (timestamp, track name, {series: value}) counter samples —
+        #: the Chrome trace "C" events (Perfetto counter tracks); the
+        #: perf ledger stamps model efficiency here so it renders
+        #: alongside the cycle's spans
+        self.counters: List[Tuple[float, str, Dict[str, float]]] = []
 
     # -- utiltrace surface --------------------------------------------------
 
@@ -140,6 +145,13 @@ class Trace:
         finally:
             self.end_span(sp)
 
+    def counter(self, name: str, **values: float) -> None:
+        """Record a counter-track sample (Chrome trace "C" event) at
+        the current clock — values render as a stacked counter track in
+        Perfetto, aligned with this trace's spans."""
+        self.counters.append(
+            (self.clock(), name, {k: float(v) for k, v in values.items()}))
+
     def finish(self) -> None:
         """Close the root frame (idempotent)."""
         if self.root.end is None:
@@ -154,6 +166,25 @@ class Trace:
 
         def walk(sp: Span) -> None:
             out[sp.name] = out.get(sp.name, 0.0) + sp.duration_s(now)
+            for c in sp.children:
+                walk(c)
+
+        walk(self.root)
+        return out
+
+    def self_durations(self) -> Dict[str, float]:
+        """Flat {span name: seconds EXCLUSIVE of child spans} — the
+        perf ledger's phase-attribution view: a ``validate`` nested
+        inside ``solve:batch`` counts once, so phase sums are disjoint
+        slices of the cycle wall. ``span_durations`` keeps the
+        inclusive view the flight recorder documents."""
+        out: Dict[str, float] = {}
+        now = self.clock()
+
+        def walk(sp: Span) -> None:
+            d = sp.duration_s(now) - sum(
+                c.duration_s(now) for c in sp.children)
+            out[sp.name] = out.get(sp.name, 0.0) + max(d, 0.0)
             for c in sp.children:
                 walk(c)
 
@@ -194,6 +225,11 @@ class Trace:
                 walk(c)
 
         walk(self.root)
+        for t, name, values in self.counters:
+            events.append({
+                "name": name, "ph": "C", "pid": pid, "tid": tid,
+                "ts": round(t * 1e6, 3), "args": values,
+            })
         return events
 
 

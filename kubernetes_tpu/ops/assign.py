@@ -971,7 +971,6 @@ def batch_assign(
     final ``(u, v)`` pair to the return so the caller can carry it into
     the next cycle. All three leave the stock cold-start path untouched
     when unset."""
-    key = tuple(sorted(weights.items())) if weights is not None else None
     if fused_score:
         # resolve the backend policy HERE so it becomes part of the jit
         # key: use_pallas() reads env + backend at call time, and a
@@ -979,14 +978,13 @@ def batch_assign(
         from kubernetes_tpu.ops.fused_score import use_pallas
 
         fused_score = use_pallas()
-    out = _batch_impl(
-        pods, nodes, sel, topo, key, max_rounds, per_node_cap,
+    args, kw = _batch_impl_call(
+        pods, nodes, sel, weights, max_rounds, per_node_cap, topo,
         extra_mask, vol, static_vol, enabled_mask, extra_score,
-        use_sinkhorn, skip_key=tuple(skip_priorities),
-        no_ports=no_ports, no_pod_affinity=no_pod_affinity,
-        no_spread=no_spread, fused_score=fused_score,
-        auto_sinkhorn=auto_sinkhorn, with_stats=stats_out,
-        sk_init=sk_init, sk_tol=sk_tol, potentials_out=potentials_out)
+        use_sinkhorn, skip_priorities, no_ports, no_pod_affinity,
+        no_spread, fused_score, auto_sinkhorn, stats_out,
+        sk_init, sk_tol, potentials_out)
+    out = _batch_impl(*args, **kw)
     potentials = out[4] if potentials_out else None
     assigned, u, rounds, sk_stats = out[:4]
     if fault_hook is not None:
@@ -1000,6 +998,71 @@ def batch_assign(
     if potentials_out:
         ret = ret + (potentials,)
     return ret
+
+
+def _batch_impl_call(pods, nodes, sel, weights, max_rounds, per_node_cap,
+                     topo, extra_mask, vol, static_vol, enabled_mask,
+                     extra_score, use_sinkhorn, skip_priorities, no_ports,
+                     no_pod_affinity, no_spread, fused_score, auto_sinkhorn,
+                     stats_out, sk_init=None, sk_tol=None,
+                     potentials_out=False):
+    """THE one spelling of the ``_batch_impl`` invocation — returns
+    ``(args, kwargs)`` for both the live call (:func:`batch_assign`)
+    and the AOT lowering (:func:`solve_cost_analysis`), so the cost
+    capture can never silently lower a different program than the one
+    live cycles run (a new kwarg added in one place and missed in the
+    other would skew model_efficiency without failing anything)."""
+    key = tuple(sorted(weights.items())) if weights is not None else None
+    args = (pods, nodes, sel, topo, key, max_rounds, per_node_cap,
+            extra_mask, vol, static_vol, enabled_mask, extra_score,
+            use_sinkhorn)
+    kw = dict(skip_key=tuple(skip_priorities), no_ports=no_ports,
+              no_pod_affinity=no_pod_affinity, no_spread=no_spread,
+              fused_score=fused_score, auto_sinkhorn=auto_sinkhorn,
+              with_stats=stats_out, sk_init=sk_init, sk_tol=sk_tol,
+              potentials_out=potentials_out)
+    return args, kw
+
+
+def solve_cost_analysis(
+    pods: DevicePods,
+    nodes: DeviceNodes,
+    sel: DeviceSelectors,
+    weights: Optional[Dict[str, float]] = None,
+    *,
+    max_rounds: int = 256,
+    per_node_cap: int = 1,
+    topo=None,
+    vol=None,
+    static_vol: Optional[jnp.ndarray] = None,
+    enabled_mask: Optional[int] = None,
+    extra_score: Optional[jnp.ndarray] = None,
+    use_sinkhorn: bool = False,
+    skip_priorities=(),
+    no_ports: bool = False,
+    no_pod_affinity: bool = False,
+    no_spread: bool = False,
+    stats_out: bool = False,
+) -> Optional[dict]:
+    """XLA cost analysis of the dense batch solve at this exact
+    signature — the perf ledger's model-side capture (obs/ledger.py):
+    warmup lowers the SAME jitted program :func:`batch_assign` runs
+    (identical static keys) and reads the compiled executable's
+    ``cost_analysis()`` flops / bytes-accessed. Best-effort by
+    contract: returns ``{"flops": ..., "bytes_accessed": ...}`` or
+    ``None`` when the backend (or this jax version) declines AOT
+    analysis — warmup must never fail for its accountant. Host-side
+    AOT only; never on the cycle path."""
+    from kubernetes_tpu.ops.fused_score import use_pallas
+
+    from kubernetes_tpu.obs.ledger import capture_cost_analysis
+
+    args, kw = _batch_impl_call(
+        pods, nodes, sel, weights, max_rounds, per_node_cap, topo,
+        None, vol, static_vol, enabled_mask, extra_score,
+        use_sinkhorn, skip_priorities, no_ports, no_pod_affinity,
+        no_spread, use_pallas(), True, stats_out)
+    return capture_cost_analysis(lambda: _batch_impl.lower(*args, **kw))
 
 
 # graftlint: disable-scope=R2,R7 -- the deliberate host boundary: trust-but-

@@ -155,3 +155,27 @@ def config5_model(devices: int = 8) -> CollectiveCostModel:
     batches, v5e-8 mesh."""
     return CollectiveCostModel(devices=devices, pods_per_batch=4096,
                                nodes_padded=65536)
+
+
+def model_efficiency(devices: int, pods: int, nodes: int,
+                     batch: int = 4096) -> float:
+    """THE analytic scale-out efficiency for a (devices, pods, nodes)
+    shape — the single figure every surface must agree on: the
+    weak-scaling bench (``scripts/bench_mesh_scale.py``), the runtime
+    perf ledger's mesh-cycle predictions (``obs/ledger.py``), and the
+    committed ``mesh_r*.json`` records all call HERE, so bench and
+    runtime can never disagree on what "the model" claims (pinned by
+    the parity test in tests/test_ledger.py).
+
+    ``pods`` is capped at ``batch`` (the per-cycle solve shape) and
+    ``nodes`` pads to the same power-of-two bucket the device tables
+    use. Single-device shapes are 1.0 by definition — there is nothing
+    to scale out."""
+    if devices < 2:
+        return 1.0
+    from kubernetes_tpu.utils.interner import bucket_size
+
+    m = CollectiveCostModel(devices=devices,
+                            pods_per_batch=max(min(pods, batch), 1),
+                            nodes_padded=bucket_size(max(nodes, 1)))
+    return float(m.predict()["scaleout_efficiency_cpu_anchor"])

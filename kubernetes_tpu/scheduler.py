@@ -168,6 +168,12 @@ class CycleResult:
     #: nodes_used / headroom / fragmentation vector plus the pack's
     #: host-side gang bookkeeping (docs/scenarios.md quality table)
     scenario_quality: Dict[str, float] = field(default_factory=dict)
+    #: perf-ledger verdict (obs/ledger.py), stamped at end_cycle: the
+    #: cost model's predicted solve seconds for this cycle's batch
+    #: shape and the modeled/measured efficiency (-1 = not populated —
+    #: no solve ran, or the ledger is off)
+    modeled_s: float = -1.0
+    model_efficiency: float = -1.0
 
 
 class Scheduler:
@@ -462,6 +468,12 @@ class Scheduler:
         #: Preempted (scheduler.go:274,:335,:457); wired to the events
         #: recorder by the host shim.
         self.event_sink = event_sink or (lambda *_: None)
+        # the SLO watchdog (obs/ledger.py) emits SchedulerSLOBurn /
+        # SchedulerSLORecovered through the same recorder sink as every
+        # other scheduler event — late-bound so a sink attached after
+        # construction still receives them
+        self.obs.ledger.event_sink = (
+            lambda reason, obj, msg: self.event_sink(reason, obj, msg))
         self.enable_preemption = enable_preemption
         self.max_preemptions_per_cycle = max_preemptions_per_cycle
         #: PDBs come from a lister (the disruption controller maintains
@@ -3411,7 +3423,7 @@ class Scheduler:
                 compiled += self._warm_bucket(
                     P, pk, sample, nt, dn, ds, dt, solver, statics,
                     (skip_prio, no_ports, no_pod_aff, no_spread),
-                    has_vol_sample, wu)
+                    has_vol_sample, wu, anchor=(compiled == 0))
             except Exception as e:
                 # a lost/OOMed device during an AOT compile (injected
                 # OR a real XLA runtime error — warmup runs inside the
@@ -3499,11 +3511,17 @@ class Scheduler:
         return compiled
 
     def _warm_bucket(self, P, pk, sample, nt, dn, ds, dt, solver, statics,
-                     gates, has_vol_sample, wu) -> int:
+                     gates, has_vol_sample, wu, anchor: bool = False) -> int:
         """Compile one bucketed solve shape (the body of the warmup
         sweep); returns 1. Split out so the sweep's device-loss
         handling wraps the WHOLE per-bucket compile — injected chaos
-        AND real XLA runtime errors abort the sweep identically."""
+        AND real XLA runtime errors abort the sweep identically.
+
+        ``anchor=True`` (the sweep's first bucket) additionally feeds
+        the perf ledger's model side (obs/ledger.py): the compiled
+        signature's XLA ``cost_analysis`` flops and ONE timed warm
+        replay as the per-round rate anchor every live prediction
+        scales from."""
         import jax
 
         from kubernetes_tpu.ops.assign import (
@@ -3550,8 +3568,8 @@ class Scheduler:
                 no_spread=no_spread,
             )
         else:
-            out = batch_assign(
-                dp, dn, ds, self.weights, max_rounds=self.max_rounds,
+            solve_kwargs = dict(
+                max_rounds=self.max_rounds,
                 per_node_cap=self.per_node_cap, topo=dt, vol=dv,
                 static_vol=sv, enabled_mask=self.pred_mask,
                 extra_score=extra_score,
@@ -3560,7 +3578,10 @@ class Scheduler:
                 no_pod_affinity=no_pod_aff, no_spread=no_spread,
                 stats_out=self.obs.config.sinkhorn_telemetry,
             )
+            out = batch_assign(dp, dn, ds, self.weights, **solve_kwargs)
             a, wu_usage = out[0], out[1]
+            if anchor and self.obs.ledger.enabled:
+                self._anchor_cost_model(dp, dn, ds, a, solve_kwargs)
         if (self.robustness.validate_results
                 and not self.robustness.host_validate):
             # the fused validator rides every production cycle's
@@ -3587,6 +3608,54 @@ class Scheduler:
             jax.block_until_ready(fr.mask)
         self.metrics.warmup_compiles.inc()
         return 1
+
+    def _anchor_cost_model(self, dp, dn, ds, warm_a, solve_kwargs) -> None:
+        """The perf ledger's model-side warmup capture (obs/ledger.py):
+        (a) the compiled solve signature's XLA ``cost_analysis`` flops /
+        bytes-accessed (best-effort AOT — some backends decline), and
+        (b) one TIMED warm replay of the just-compiled solve as the
+        per-round rate anchor. The replay solves the real warmup
+        sample over the full (P, N) plane and can take more than one
+        assignment round, so the anchor records the EXECUTED round
+        count (one warmup-only scalar readback) — crediting a
+        multi-round wall to rounds=1 would inflate the per-round rate
+        and flatter every live prediction. Failures are swallowed: the
+        ledger self-anchors on the first live cycle instead, and
+        warmup must never die for its accountant."""
+        import time as _time  # perf_counter only (graftlint R4)
+
+        import jax
+
+        from kubernetes_tpu.ops.assign import (
+            batch_assign,
+            solve_cost_analysis,
+        )
+
+        ledger = self.obs.ledger
+        P_pad = int(dp.valid.shape[0])
+        N_pad = int(dn.valid.shape[0])
+        mesh = int(self.mesh.devices.size) if self._mesh_live else 0
+        try:
+            ca = solve_cost_analysis(dp, dn, ds, self.weights,
+                                     **solve_kwargs)
+            if ca is not None:
+                ledger.model.record_signature(
+                    P_pad, N_pad, ca["flops"], ca["bytes_accessed"])
+            jax.block_until_ready(warm_a)  # the compile, not the replay
+            t0 = _time.perf_counter()
+            out = batch_assign(dp, dn, ds, self.weights, **solve_kwargs)
+            jax.block_until_ready(out[0])
+            elapsed = _time.perf_counter() - t0
+            # batch_assign's 3rd output is the executed round count —
+            # the replay solves real sample pods and can take >1 round,
+            # and an R-round wall credited to rounds=1 would inflate
+            # the per-round rate R× (warmup-only scalar, declared site)
+            rounds = int(self.obs.jax.readback("ledger-anchor", out[2]))
+            ledger.model.record_anchor(
+                "full", P_pad, N_pad, mesh,
+                elapsed, rounds=max(rounds, 1))
+        except Exception as e:
+            klog.V(2).info("ledger cost-model capture skipped: %s", e)
 
     def _warm_delta_scatter(self, dn) -> int:
         """Compile the donated delta-scatter programs for the small
@@ -3726,12 +3795,18 @@ class Scheduler:
         fallback COUNT is the signal, not the tier name: the exact
         solver deliberately routes hazardous batches to the round
         solver as a healthy path, and that must not read as
-        degradation. The APF saturation probe reads this so shedding
+        degradation. A sustained SLO burn (obs/ledger.py watchdog,
+        ``ledger.engage_pressure``) also reads degraded: eroding
+        create-to-bind p99 means the backend clears its queue slower
+        than admission assumes, so shedding must engage EARLIER at the
+        same depth. The APF saturation probe reads this so shedding
         engages from the scheduler's ACTUAL state, not only from queue
         length."""
         from kubernetes_tpu.faults import OPEN
 
         if self.clock() < self._device_cooloff_until:
+            return True
+        if self.obs.ledger.pressure_engaged():
             return True
         if self.last_solver_fallbacks > 0:
             return True
@@ -3777,6 +3852,10 @@ class Scheduler:
         artifacts every --cycle-interval."""
         self.queue.tick()
         self._reap_expired_assumptions()
+        # keep the SLO burn-rate windows (and the recovery transition)
+        # live while idle — eventful cycles may never come to run the
+        # watchdog's state machine after the queue drains
+        self.obs.ledger.tick()
         res = CycleResult()
         self._process_waiting(res)
         if res.unschedulable or res.scheduled:

@@ -323,6 +323,21 @@ def serve_scheduler(
                     self._respond(
                         200, json.dumps(obs.debug_payload()).encode(),
                         "application/json")
+            elif self.path == "/debug/ledger":
+                # the perf ledger (obs/ledger.py): per-cycle measured
+                # phase distributions, measured-vs-modeled efficiency,
+                # cost-model anchors, SLO watchdog state. snapshot() is
+                # thread-safe like /debug/why — the scheduler thread
+                # keeps observing while this handler serializes.
+                obs = getattr(sched, "obs", None)
+                ledger = getattr(obs, "ledger", None)
+                if ledger is None:
+                    self._respond(404, b"no perf ledger on this scheduler",
+                                  "text/plain")
+                else:
+                    self._respond(
+                        200, json.dumps(ledger.snapshot()).encode(),
+                        "application/json")
             elif self.path.split("?", 1)[0] == "/debug/why":
                 code, doc = why_payload(sched, self.path)
                 self._respond(code, json.dumps(doc).encode(),

@@ -22,8 +22,11 @@ What composing changes (vs. the pieces in isolation):
   compile or a retrace;
 - **APF shedding**: the mutating flow's saturation probe is
   :meth:`Scheduler.backend_pressure` — active-queue depth INFLATED
-  while the ladder runs degraded or the device cools off — not bare
-  queue length, so a limping backend sheds earlier at the same depth;
+  while the ladder runs degraded, the device cools off, or the perf
+  ledger's SLO watchdog is burning (obs/ledger.py: eroding
+  create-to-bind p99 or drifting cycle cost reads as a degraded
+  backend) — not bare queue length, so a limping backend sheds
+  earlier at the same depth;
 - **takeover**: ``attach_elector`` chains the scheduler's recovery
   callbacks (fenced binds, reconcile-onto-the-mesh, stopped-leading
   drain) AND the watch hub's relist eviction — watchers of a deposed
@@ -104,6 +107,15 @@ class ServingRuntime:
             "mutating",
             lambda: sched.backend_pressure(degraded_factor=factor),
             maximum=float(self.shed_bound()))
+        # -- perf ledger / SLO watchdog ------------------------------------
+        #: the composed runtime's SLO surface (obs/ledger.py): the
+        #: serving loop's per-pod create-to-bind latencies feed the
+        #: watchdog through end_cycle, and a sustained burn inflates
+        #: the backend_pressure probe wired above — the online "p99 is
+        #: eroding" -> "shed earlier" loop. Exposed here so benches and
+        #: operators reach the arm summary without digging through obs.
+        #: getattr: duck-typed scheduler fakes stay valid.
+        self.ledger = getattr(getattr(sched, "obs", None), "ledger", None)
         # -- watch fan-out -------------------------------------------------
         self.hub = WatchHub(buffer=self.config.watch_buffer,
                             metrics=sched.metrics)

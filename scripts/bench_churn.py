@@ -256,7 +256,16 @@ def summarize(producer: ChurnProducer, wall_s: float, sched) -> dict:
         for k, v in sorted(by_scope.items())
     }
     sites = sched.obs.jax.snapshot()["sites"].get("solve", {})
+    # the perf ledger's per-arm summary (obs/ledger.py): measured-vs-
+    # modeled efficiency, per-phase attribution shares, SLO burn count —
+    # the bench_compare `ledger` gate family reads exactly this shape,
+    # so the next churn record carries the falsification evidence per
+    # arm. getattr: older schedulers / fakes without a ledger skip it.
+    ledger = getattr(sched.obs, "ledger", None)
+    ledger_out = (ledger.arm_summary()
+                  if ledger is not None and ledger.enabled else None)
     return {
+        **({"ledger": ledger_out} if ledger_out else {}),
         "solve_s_by_scope": scope_out,
         "wall_s": round(wall_s, 2),
         "created": producer.created,

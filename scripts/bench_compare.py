@@ -79,6 +79,17 @@ stay inside the record's documented bound, and zero retraces + the
 absolute readback budget hold on every cell. Deltas (warm cycle cost,
 flatness ratio) need two records; the absolutes enforce on one.
 
+Perf-ledger gates (obs/ledger.py; the per-arm ``ledger`` block the
+churn bench records) enforce ABSOLUTE invariants on the newest
+``churn_r*.json`` alone: the measured-vs-modeled ``model_efficiency``
+p50 must stay above the floor (``--ledger-efficiency-floor``, default
+0.2 — the model may flatter the hardware, but a collapse means the
+cost model stopped describing reality), clean arms (serving, fixed)
+must report ZERO SLO burns, and the per-phase attribution shares must
+be sane (sum in (0, 1.25] — phases are disjoint spans of the cycle
+wall). Absence is tolerated — records predating the ledger warn and
+pass, like every other family.
+
 ``--list-gates`` prints every active gate family (name, record source,
 what it enforces) — the docs reference this output instead of
 hand-maintaining the list.
@@ -98,6 +109,7 @@ import json
 import os
 import re
 import sys
+from functools import partial
 from typing import List, Optional, Tuple
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -188,6 +200,18 @@ def find_scenario_records(directory: str) -> List[str]:
 def load(path: str) -> dict:
     with open(path, "r", encoding="utf-8") as fh:
         return json.load(fh)
+
+
+def _absolute_check(checks: list, regressions: list, name: str, cur_v,
+                    bad: bool) -> None:
+    """Absolute (single-record) gate row shared by every gate family:
+    no prev baseline, regressed iff ``bad``. Bind per family with
+    ``absolute = partial(_absolute_check, checks, regressions)``."""
+    row = {"check": name, "prev": None, "cur": cur_v,
+           "delta_frac": cur_v, "regressed": bad}
+    checks.append(row)
+    if bad:
+        regressions.append(row)
 
 
 def _num(x) -> Optional[float]:
@@ -420,12 +444,7 @@ def compare_churn_mesh(prev: dict, cur: dict, threshold: float,
         if bad:
             regressions.append(row)
 
-    def absolute(name: str, cur_v, bad: bool):
-        row = {"check": name, "prev": None, "cur": cur_v,
-               "delta_frac": cur_v, "regressed": bad}
-        checks.append(row)
-        if bad:
-            regressions.append(row)
+    absolute = partial(_absolute_check, checks, regressions)
 
     pa = prev.get("arms") or {}
     ca = cur.get("arms") or {}
@@ -590,12 +609,7 @@ def compare_scenario(prev: dict, cur: dict, threshold: float,
         if bad:
             regressions.append(row)
 
-    def absolute(name: str, cur_v, bad: bool):
-        row = {"check": name, "prev": None, "cur": cur_v,
-               "delta_frac": cur_v, "regressed": bad}
-        checks.append(row)
-        if bad:
-            regressions.append(row)
+    absolute = partial(_absolute_check, checks, regressions)
 
     pc = (prev.get("consolidation") or {})
     cc = (cur.get("consolidation") or {})
@@ -690,12 +704,7 @@ def compare_churn_incr(prev: dict, cur: dict, threshold: float,
         if bad:
             regressions.append(row)
 
-    def absolute(name: str, cur_v, bad: bool):
-        row = {"check": name, "prev": None, "cur": cur_v,
-               "delta_frac": cur_v, "regressed": bad}
-        checks.append(row)
-        if bad:
-            regressions.append(row)
+    absolute = partial(_absolute_check, checks, regressions)
 
     cf = cur.get("flatness") or {}
     pf = prev.get("flatness") or {}
@@ -776,6 +785,60 @@ def compare_churn_incr(prev: dict, cur: dict, threshold: float,
             "warnings": warnings}
 
 
+#: churn arms with no chaos / no deliberate overload: an SLO burn
+#: there is a regression, not an experiment outcome
+LEDGER_CLEAN_ARMS = ("serving", "fixed")
+
+
+def compare_ledger(cur: dict, efficiency_floor: float = 0.2) -> dict:
+    """Perf-ledger gates over the NEWEST churn record alone (pure,
+    unit-tested; absence-tolerant): each arm carrying the per-arm
+    ``ledger`` block (obs/ledger.py ``arm_summary``) enforces
+
+    - ``model_efficiency.p50 >= efficiency_floor`` — measured-vs-
+      modeled collapse means the cost model stopped describing the
+      hardware (the ROADMAP-1 falsification signal, gated);
+    - ``slo.burns == 0`` on CLEAN arms (serving, fixed) — an SLO burn
+      without injected chaos or deliberate overload is a regression;
+    - phase-attribution sanity: the per-phase shares must sum into
+      (0, 1.25] — phases are disjoint spans of the cycle wall, so a
+      sum near 0 means attribution broke and >1.25 means double
+      counting.
+
+    One record is enough — every check is absolute. Arms without a
+    ledger block warn and pass (records predating the ledger)."""
+    checks, regressions, warnings = [], [], []
+
+    absolute = partial(_absolute_check, checks, regressions)
+
+    arms = cur.get("arms") or {}
+    seen = 0
+    for arm_name, arm in sorted(arms.items()):
+        led = (arm or {}).get("ledger")
+        if not isinstance(led, dict):
+            continue
+        seen += 1
+        eff = _num((led.get("model_efficiency") or {}).get("p50"))
+        if eff is not None:
+            absolute(f"ledger.{arm_name}.model_efficiency_p50", eff,
+                     eff < efficiency_floor)
+        burns = _num((led.get("slo") or {}).get("burns"))
+        if burns is not None and arm_name in LEDGER_CLEAN_ARMS:
+            absolute(f"ledger.{arm_name}.slo_burns", burns, burns > 0)
+        shares = led.get("phase_share") or {}
+        vals = [v for v in (_num(x) for x in shares.values())
+                if v is not None]
+        if vals:
+            total = sum(vals)
+            absolute(f"ledger.{arm_name}.phase_share_sum",
+                     round(total, 4), not 0 < total <= 1.25)
+    if not seen:
+        warnings.append("ledger: no arm carries a ledger block "
+                        "(record predates the perf ledger) — skipped")
+    return {"checks": checks, "regressions": regressions,
+            "warnings": warnings}
+
+
 #: every active gate family: (name, record glob, what it enforces) —
 #: the --list-gates surface the docs reference. Keep one row per
 #: compare_* section so a new gate family cannot land invisibly.
@@ -809,6 +872,10 @@ GATE_FAMILIES = [
      "<= 1.3 across the cluster-size sweep) while the cold arm grows, "
      "restricted engagement, warm-vs-cold quality delta within the "
      "documented bound, zero retraces, absolute readback budget"),
+    ("ledger", "churn_r*.json",
+     "perf ledger: per-arm measured-vs-modeled model_efficiency p50 "
+     "above the floor, SLO burns == 0 on clean arms, phase-attribution "
+     "shares sum sane (new record alone)"),
 ]
 
 
@@ -830,6 +897,11 @@ def main(argv=None) -> int:
                          "sharded path in the new mesh record (default "
                          "16.0 — the PR-7 answer-sized boundary is ~4 "
                          "B/pod plus padding headroom)")
+    ap.add_argument("--ledger-efficiency-floor", type=float, default=0.2,
+                    help="absolute floor for each churn arm's perf-"
+                         "ledger model_efficiency p50 (default 0.2 — "
+                         "the measured-vs-modeled collapse alarm; the "
+                         "ledger gate family)")
     ap.add_argument("--pack-floor", type=float, default=0.005,
                     help="absolute pack_s (seconds) under which the "
                          "pack-breakdown ratio check is skipped as noise "
@@ -879,11 +951,21 @@ def main(argv=None) -> int:
             f"not enough bench records in {args.dir} — headline gates "
             "skipped")
     # sustained-churn gates (scripts/bench_churn.py records) — absence
-    # tolerated so pre-serving benchres directories keep passing
+    # tolerated so pre-serving benchres directories keep passing. The
+    # newest record loads ONCE: the delta gates (two records) and the
+    # perf-ledger absolutes (one record) both read it.
     churn_found = find_churn_records(args.dir)
+    ccur = None
+    if churn_found:
+        try:
+            ccur = load(churn_found[-1])
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot load churn records: {e}",
+                  file=sys.stderr)
+            return 2
     if len(churn_found) >= 2:
         try:
-            cprev, ccur = load(churn_found[-2]), load(churn_found[-1])
+            cprev = load(churn_found[-2])
         except (OSError, json.JSONDecodeError) as e:
             print(f"error: cannot load churn records: {e}",
                   file=sys.stderr)
@@ -897,6 +979,14 @@ def main(argv=None) -> int:
     elif churn_found:
         verdict["warnings"].append(
             "only one churn record — churn gates need two to compare")
+    # perf-ledger gates (obs/ledger.py per-arm blocks) enforce on the
+    # NEWEST churn record alone — every check is absolute, so one
+    # record is enough; absence of the block warns and passes
+    if ccur is not None:
+        lv = compare_ledger(ccur, args.ledger_efficiency_floor)
+        verdict["checks"].extend(lv["checks"])
+        verdict["regressions"].extend(lv["regressions"])
+        verdict["warnings"].extend(lv["warnings"])
     # composed serving-on-mesh gates (scripts/bench_churn.py --mesh
     # records) — absence tolerated so benchres directories predating
     # the composed mode keep passing; one record still enforces the
@@ -1019,7 +1109,9 @@ def main(argv=None) -> int:
             [r for r in keep if r["regressed"]])
         verdict["mesh_records"] = [
             os.path.relpath(mesh_found[-1], REPO_ROOT)]
-    if prev_path is None and len(churn_found) < 2 and not mesh_found \
+    # a single churn record is still gateable: the ledger family's
+    # checks are absolute (new record alone)
+    if prev_path is None and not churn_found and not mesh_found \
             and not cm_found and not sc_found and not ci_found:
         msg = (f"not enough records in {args.dir} — nothing to gate")
         if args.format == "json":

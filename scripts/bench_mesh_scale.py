@@ -50,8 +50,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import ShardedWorkload, build_variant, run_batched  # noqa: E402
 from kubernetes_tpu.parallel import mesh_from_spec  # noqa: E402
-from kubernetes_tpu.parallel.costmodel import CollectiveCostModel  # noqa: E402
-from kubernetes_tpu.utils.interner import bucket_size  # noqa: E402
+from kubernetes_tpu.parallel.costmodel import model_efficiency as _model_eff  # noqa: E402
 
 HEAD_NODES = int(os.environ.get("MESH_HEAD_NODES", 5000))
 HEAD_PODS = int(os.environ.get("MESH_HEAD_PODS", 30000))
@@ -67,15 +66,13 @@ def log(msg):
 
 
 def model_efficiency(devices: int, pods: int, nodes: int) -> float:
-    """The analytic scale-out efficiency for this shape (the
-    falsifiable figure a real multi-chip run can break; see
-    parallel/costmodel.py for the ICI envelope)."""
-    if devices < 2:
-        return 1.0
-    m = CollectiveCostModel(devices=devices,
-                            pods_per_batch=min(pods, BATCH),
-                            nodes_padded=bucket_size(max(nodes, 1)))
-    return float(m.predict()["scaleout_efficiency_cpu_anchor"])
+    """The analytic scale-out efficiency for this shape — delegates to
+    ``parallel.costmodel.model_efficiency``, the ONE implementation the
+    runtime perf ledger (obs/ledger.py) also predicts with, so this
+    record and the live ``scheduler_cycle_model_efficiency`` gauge can
+    never disagree on what the model claims (parity-pinned by
+    tests/test_ledger.py)."""
+    return _model_eff(devices, pods, nodes, batch=BATCH)
 
 
 out = {

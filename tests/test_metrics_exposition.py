@@ -197,3 +197,50 @@ def test_summary_exposes_quantiles(scraped):
          if fam == "scheduler_scheduling_duration_seconds"
          and not name.endswith(("_sum", "_count"))]
     assert {"0.5", "0.9", "0.99"} <= set(q)
+
+
+def test_ledger_metric_block_conforms(scraped):
+    """The perf-ledger block (obs/ledger.py) rides the same strict
+    exposition grammar: the efficiency + phase gauges carry samples
+    after one driven cycle, and the SLO burn-rate family is declared
+    (HELP/TYPE) even while no objective is configured."""
+    _metrics, text = scraped
+    types, samples = parse_exposition(text)
+    fams = {f for f, _, _, _ in samples}
+    assert "scheduler_cycle_model_efficiency" in fams
+    assert "scheduler_cycle_modeled_cost_seconds" in fams
+    assert "scheduler_cycle_phase_seconds" in fams
+    assert types["scheduler_cycle_model_efficiency"] == "gauge"
+    assert types["scheduler_cycle_phase_seconds"] == "gauge"
+    assert types["scheduler_slo_burn_rate"] == "gauge"
+    # the driven cycle ran a solve: efficiency populated in [0, 8],
+    # and the phase gauge is labeled per canonical phase
+    eff = [v for f, _, _, v in samples
+           if f == "scheduler_cycle_model_efficiency"]
+    assert eff and 0.0 <= eff[0] <= 8.0
+    phases = {labels["phase"] for f, _, labels, _ in samples
+              if f == "scheduler_cycle_phase_seconds"}
+    assert "solve" in phases and "snapshot" in phases
+
+
+def test_ledger_phase_gauge_freshness_zeroes_stale_series():
+    """The explain-gauge freshness rule applied to the new block: a
+    phase the last cycle did not run must read 0, not the stale value
+    of whichever cycle last ran it."""
+    from kubernetes_tpu.config import LedgerConfig
+    from kubernetes_tpu.metrics import SchedulerMetrics
+    from kubernetes_tpu.obs.ledger import PerfLedger
+    from kubernetes_tpu.obs.recorder import CycleRecord
+
+    metrics = SchedulerMetrics()
+    ledger = PerfLedger(LedgerConfig(), metrics=metrics)
+    ledger.observe_cycle(CycleRecord(
+        cycle=1, batch_shape="P8xN8", tier="batch", elapsed_s=0.02,
+        spans={"snapshot": 0.004, "solve:batch": 0.01,
+               "preemption": 0.002}))
+    assert metrics.cycle_phase_seconds.value(phase="preemption") > 0
+    ledger.observe_cycle(CycleRecord(
+        cycle=2, batch_shape="P8xN8", tier="batch", elapsed_s=0.015,
+        spans={"snapshot": 0.004, "solve:batch": 0.01}))
+    assert metrics.cycle_phase_seconds.value(phase="preemption") == 0.0
+    assert metrics.cycle_phase_seconds.value(phase="solve") > 0
