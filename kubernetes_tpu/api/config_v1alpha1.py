@@ -126,6 +126,8 @@ class RobustnessConfigurationV1alpha1:
     hostValidate: Optional[bool] = None
     fallbackChain: Optional[list] = None
     extenderDegradeToIgnorable: Optional[bool] = None
+    bindVerifyRetries: Optional[int] = None
+    watchProgressDeadline: Optional[str] = None  # "0s" = stall det. off
 
 
 @dataclass
@@ -175,6 +177,7 @@ class ObservabilityConfigurationV1alpha1:
     sinkhornTelemetry: Optional[bool] = None
     explain: Optional[bool] = None
     explainTopK: Optional[int] = None
+    auditInterval: Optional[str] = None  # "0s" = serving auditor off
     ledger: "LedgerConfigurationV1alpha1" = field(
         default_factory=LedgerConfigurationV1alpha1)
 
@@ -397,6 +400,10 @@ def set_defaults_kube_scheduler_configuration(
         rb.fallbackChain = ["batch-cpu", "greedy"]
     if rb.extenderDegradeToIgnorable is None:
         rb.extenderDegradeToIgnorable = True
+    if rb.bindVerifyRetries is None:
+        rb.bindVerifyRetries = 3
+    if rb.watchProgressDeadline is None:
+        rb.watchProgressDeadline = "30s"
     rv = obj.recovery
     if rv.fencedBinds is None:
         rv.fencedBinds = True
@@ -429,6 +436,8 @@ def set_defaults_kube_scheduler_configuration(
         ob.explain = True
     if ob.explainTopK is None:
         ob.explainTopK = 3
+    if ob.auditInterval is None:
+        ob.auditInterval = "0s"  # serving-runtime auditor off (internal default)
     lg = ob.ledger
     if lg.enabled is None:
         lg.enabled = True
@@ -725,6 +734,8 @@ def _observability_to_internal(ob: ObservabilityConfigurationV1alpha1):
         sinkhorn_telemetry=ob.sinkhornTelemetry,
         explain=ob.explain,
         explain_top_k=ob.explainTopK,
+        audit_interval_s=_dur("auditInterval", ob.auditInterval,
+                              "observability"),
         ledger=LedgerConfig(
             enabled=lg.enabled,
             history=lg.history,
@@ -771,6 +782,10 @@ def _robustness_to_internal(rb: RobustnessConfigurationV1alpha1):
         host_validate=rb.hostValidate,
         fallback_chain=tuple(chain),
         extender_degrade_to_ignorable=rb.extenderDegradeToIgnorable,
+        bind_verify_retries=rb.bindVerifyRetries,
+        watch_progress_deadline_s=_dur("watchProgressDeadline",
+                                       rb.watchProgressDeadline,
+                                       "robustness"),
     )
 
 
@@ -838,6 +853,9 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
             hostValidate=rc.host_validate,
             fallbackChain=list(rc.fallback_chain),
             extenderDegradeToIgnorable=rc.extender_degrade_to_ignorable,
+            bindVerifyRetries=rc.bind_verify_retries,
+            watchProgressDeadline=format_duration(
+                rc.watch_progress_deadline_s),
         ),
         recovery=RecoveryConfigurationV1alpha1(
             fencedBinds=c.recovery.fenced_binds,
@@ -857,6 +875,8 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
             sinkhornTelemetry=c.observability.sinkhorn_telemetry,
             explain=c.observability.explain,
             explainTopK=c.observability.explain_top_k,
+            auditInterval=format_duration(
+                c.observability.audit_interval_s),
             ledger=LedgerConfigurationV1alpha1(
                 enabled=c.observability.ledger.enabled,
                 history=c.observability.ledger.history,

@@ -164,6 +164,16 @@ class SchedulerCache:
         return [k for k, s in self._pod_state.items()
                 if s in (_ASSUMED, _EXPIRING)]
 
+    def pod_states(self) -> Dict[str, str]:
+        """key -> "assumed" | "bound" for every cached pod — the
+        state-conservation auditor's view (obs/audit.py): assumed covers
+        ASSUMED and EXPIRING (bind in flight / TTL armed), bound is the
+        watch-confirmed ADDED state."""
+        return {
+            k: ("assumed" if s in (_ASSUMED, _EXPIRING) else "bound")
+            for k, s in self._pod_state.items()
+        }
+
     def pod_count(self) -> int:
         return sum(len(m) for m in self._pods_by_node.values())
 

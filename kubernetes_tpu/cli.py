@@ -154,6 +154,11 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
             f"robustness.retryJitter: Invalid value {rc.retry_jitter}: "
             "not in valid range 0-1"
         )
+    if rc.bind_verify_retries < 0:
+        errs.append("robustness.bindVerifyRetries: must be non-negative")
+    if rc.watch_progress_deadline_s < 0:
+        errs.append("robustness.watchProgressDeadline: must be "
+                    "non-negative (0 = stall detection off)")
     if rc.breaker_failure_threshold < 1:
         errs.append("robustness.breakerFailureThreshold: must be at least 1")
     if rc.breaker_half_open_probes < 1:
@@ -188,6 +193,9 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         errs.append("observability.retraceStormWindow: must be at least 1")
     if oc.explain_top_k < 1:
         errs.append("observability.explainTopK: must be at least 1")
+    if oc.audit_interval_s < 0:
+        errs.append("observability.auditInterval: must be non-negative "
+                    "(0 = the serving runtime's auditor off)")
     lg = oc.ledger
     if lg.history < 1:
         errs.append("observability.ledger.history: must be at least 1")

@@ -148,6 +148,20 @@ class RobustnessConfig:
     #: like an Ignorable one instead of failing its pods — progress over
     #: strictness while the remote is down
     extender_degrade_to_ignorable: bool = True
+    #: read-your-write verification retries when a bind RPC times out
+    #: AMBIGUOUSLY (faults.RPCTimeout — the hub may have committed): the
+    #: scheduler GETs the pod and compares uid+nodeName to adopt or
+    #: requeue instead of blind-retrying a bind that may have landed;
+    #: this bounds the verification GETs per attempt (full-jitter
+    #: backoff between them). Unresolvable verifications park the pod
+    #: (still assumed) and re-probe each cycle / idle tick.
+    bind_verify_retries: int = 3
+    #: informer stall detection (sim.Reflector and any reflector built
+    #: on it): a watch that delivers NOTHING for this long while the hub
+    #: has advanced revisions is treated as silently stalled and forced
+    #: to relist (with full-jitter backoff between forced relists so
+    #: replicas cannot stampede a recovering hub). 0 disables.
+    watch_progress_deadline_s: float = 30.0
 
 
 @dataclass
@@ -257,6 +271,13 @@ class ObservabilityConfig:
     explain: bool = True
     #: relaxations kept per pod and reasons kept per flight record
     explain_top_k: int = 3
+    #: state-conservation auditor (obs/audit.py): assert every pod sits
+    #: in exactly one of {queued, assumed, bound, gone}, node capacity
+    #: is never exceeded by committed binds, and no pod is lost or
+    #: zombie-queued across audits. >0 = run it inside the serving
+    #: runtime every this-many seconds (cheap: O(pods) host dict walks);
+    #: 0 = off there (chaos suites run it continuously regardless).
+    audit_interval_s: float = 0.0
     #: perf ledger + SLO watchdog (obs/ledger.py): per-cycle
     #: measured-vs-modeled accounting, burn-rate objectives
     ledger: LedgerConfig = field(default_factory=LedgerConfig)

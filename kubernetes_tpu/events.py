@@ -39,6 +39,12 @@ REASON_ASSUMPTION_EXPIRED = "AssumptionExpired"
 #: every other series — a burning hour costs a handful of sink posts.
 REASON_SLO_BURN = "SchedulerSLOBurn"
 REASON_SLO_RECOVERED = "SchedulerSLORecovered"
+#: the state-conservation auditor (obs/audit.py) found a pod in two
+#: states at once, a node over-committed by committed binds, a lost or
+#: zombie-queued pod — always a correctness bug; spam-filtered by the
+#: recorder like every other series so a persistent violation costs a
+#: handful of sink posts, not one per audit
+REASON_INVARIANT_VIOLATION = "InvariantViolation"
 
 _REASON_TYPE = {
     REASON_SCHEDULED: TYPE_NORMAL,
@@ -49,6 +55,7 @@ _REASON_TYPE = {
     REASON_ASSUMPTION_EXPIRED: TYPE_WARNING,
     REASON_SLO_BURN: TYPE_WARNING,
     REASON_SLO_RECOVERED: TYPE_NORMAL,
+    REASON_INVARIANT_VIOLATION: TYPE_WARNING,
 }
 
 

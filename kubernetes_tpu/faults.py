@@ -40,6 +40,26 @@ truncated   torn wire frame → ValueError from the transport
 error-field remote verb error → extender error-result path
 corrupt     mistyped payload → response-parse hardening (ExtenderError)
 ========== ============================================================
+
+The NETWORK fault kinds (the hub/REST/watch seam, PR 15) ride the same
+injector through :meth:`FaultInjector.rpc_hook`:
+
+=========== ===========================================================
+rpc_error    the RPC definitely failed before the server acted →
+             :class:`RPCError`; a blind retry is safe
+rpc_timeout  the RPC timed out AMBIGUOUSLY — the server may or may not
+             have committed → :class:`RPCTimeout`; the scheduler's bind
+             protocol resolves it by read-your-write verification (GET
+             the pod, compare uid+nodeName, adopt or requeue — never a
+             blind re-bind that could double-place)
+latency      the call succeeds after an injected delay (rule.latency_s)
+drop /       watch-stream faults (chaos.FuzzedCursor at "watch:event" /
+duplicate /  "watch:batch"): events vanish, repeat, or arrive out of
+reorder      order — the Reflector's resourceVersion-monotonic dedupe
+             must make them no-ops
+compacted    a forced 410/Compacted on the watch — the relist-storm
+             trigger
+=========== ===========================================================
 """
 
 from __future__ import annotations
@@ -53,6 +73,22 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 class SolverFault(Exception):
     """Base of the injected/derived solver failures the ladder catches."""
+
+
+class RPCError(Exception):
+    """A hub RPC failed DEFINITELY before the server acted (connection
+    refused, 5xx before the handler ran). The operation did not commit;
+    retrying through the normal requeue path is safe."""
+
+
+class RPCTimeout(Exception):
+    """A hub RPC timed out with an AMBIGUOUS outcome: the server may or
+    may not have committed the operation before the response was lost.
+    For a bind this is the dangerous class — a blind retry could bind a
+    pod that IS already bound (a hub CAS conflict at best, a double
+    placement with a less careful store). The scheduler resolves it by
+    read-your-write verification (GET the pod, compare uid + nodeName,
+    then adopt or requeue — scheduler._resolve_ambiguous_bind)."""
 
 
 class SolverTimeout(SolverFault):
@@ -277,13 +313,18 @@ class FaultRule:
     """One armed fault: fnmatch ``site`` pattern, fault ``kind``, firing
     probability ``rate``, optional bounded ``remaining`` shot count.
     ``shard`` rides along for ``shard_lost`` rules so the raised
-    :class:`ShardLost` names the lost mesh device."""
+    :class:`ShardLost` names the lost mesh device; ``latency_s`` is the
+    injected delay of a ``latency`` rule; ``commit_rate`` is the
+    probability an ambiguous ``rpc_timeout`` DID commit server-side
+    before the response was lost."""
 
     site: str
     kind: str
     rate: float = 1.0
     remaining: Optional[int] = None
     shard: Optional[int] = None
+    latency_s: float = 0.0
+    commit_rate: float = 0.5
 
 
 class FaultInjector:
@@ -305,19 +346,32 @@ class FaultInjector:
 
     def arm(self, site: str, kind: str, rate: float = 1.0,
             count: Optional[int] = None,
-            shard: Optional[int] = None) -> "FaultInjector":
-        self.rules.append(FaultRule(site, kind, rate, count, shard))
+            shard: Optional[int] = None,
+            latency_s: float = 0.0,
+            commit_rate: float = 0.5) -> "FaultInjector":
+        self.rules.append(FaultRule(site, kind, rate, count, shard,
+                                    latency_s, commit_rate))
         return self
 
     def fired_total(self, site_pattern: str = "*") -> int:
         return sum(n for (s, _), n in self.fired.items()
                    if fnmatch.fnmatch(s, site_pattern))
 
-    def pick_rule(self, site: str) -> Optional[FaultRule]:
+    def pick_rule(self, site: str,
+                  kinds: Optional[Tuple[str, ...]] = None
+                  ) -> Optional[FaultRule]:
         """First armed, matching, non-exhausted rule that passes its
-        rate roll; records the firing and decrements bounded shots."""
+        rate roll; records the firing and decrements bounded shots.
+        ``kinds`` restricts the roll to rules of those kinds — callers
+        whose site hosts several kinds with different applicability
+        (watch:batch: a 410 fires on any poll, a reorder only when
+        there are >= 2 frames to shuffle) roll each separately so an
+        inapplicable pick never burns a bounded rule's shot or records
+        a firing that did nothing."""
         for rule in self.rules:
             if rule.remaining == 0 or not fnmatch.fnmatch(site, rule.site):
+                continue
+            if kinds is not None and rule.kind not in kinds:
                 continue
             if rule.rate < 1.0 and self.rng.random() >= rule.rate:
                 continue
@@ -328,9 +382,10 @@ class FaultInjector:
             return rule
         return None
 
-    def pick(self, site: str) -> Optional[str]:
+    def pick(self, site: str,
+             kinds: Optional[Tuple[str, ...]] = None) -> Optional[str]:
         """Kind-only view of :meth:`pick_rule` (the original surface)."""
-        rule = self.pick_rule(site)
+        rule = self.pick_rule(site, kinds)
         return rule.kind if rule is not None else None
 
     # -- transport seam (HTTP extender / gRPC shim) ------------------------
@@ -378,6 +433,34 @@ class FaultInjector:
         if rule.kind in _DEVICE_RAISING:
             raise _DEVICE_RAISING[rule.kind](site)
         return rule.kind
+
+    # -- hub RPC seam (binder / REST facade / pod-reader GET) --------------
+
+    def rpc_hook(self, site: str):
+        """Network-fault decision for one hub RPC (the bind commit, a
+        verification GET, a REST verb). Returns ``None`` (no fault) or a
+        triple ``(kind, rule, committed)``:
+
+        - ``("rpc_error", rule, False)`` — the caller must raise
+          :class:`RPCError` WITHOUT performing the server-side effect;
+        - ``("rpc_timeout", rule, committed)`` — the AMBIGUOUS kind: the
+          caller performs the server-side effect iff ``committed`` (the
+          rule's ``commit_rate`` coin, rolled on the injector's private
+          stream so runs replay), then raises :class:`RPCTimeout` either
+          way — the client can never tell the two apart;
+        - ``("latency", rule, True)`` — delay ``rule.latency_s`` then
+          proceed normally.
+
+        Other kinds armed at an rpc site are returned verbatim for the
+        caller to interpret (site-specific extensions)."""
+        rule = self.pick_rule(site)
+        if rule is None:
+            return None
+        if rule.kind == "rpc_timeout":
+            return (rule.kind, rule, self.rng.random() < rule.commit_rate)
+        if rule.kind == "rpc_error":
+            return (rule.kind, rule, False)
+        return (rule.kind, rule, True)
 
     # -- solver seam (ops/assign.py fault_hook) ----------------------------
 

@@ -45,7 +45,12 @@ perf-ledger block (``obs/ledger.py``) —
 ``scheduler_cycle_modeled_cost_seconds`` measured-vs-modeled gauges,
 ``scheduler_cycle_phase_seconds{phase}`` per-phase attribution (stale
 phases read 0, the explain-gauge freshness rule), and
-``scheduler_slo_burn_rate{objective,window}``. Note
+``scheduler_slo_burn_rate{objective,window}``; plus the network-fault
+robustness block (PR 15) —
+``scheduler_bind_ambiguous_total{resolution}`` (the ambiguous-RPC bind
+protocol's read-your-write verdicts) and
+``scheduler_invariant_violations_total{invariant}`` (the
+state-conservation auditor, ``obs/audit.py``). Note
 ``scheduler_e2e_scheduling_duration_seconds`` observes PER-POD
 create-to-bind latency (queue-add stamp to bind) since the serving PR,
 matching the reference's per-pod scheduleOne observation.
@@ -384,6 +389,29 @@ class SchedulerMetrics:
             "scheduler_recovery_device_resets_total",
             "Resident device snapshot drops + rebuilds after a device "
             "error (device lost / OOM).",
+        ))
+        # -- network-fault robustness (PR 15): the ambiguous-RPC bind
+        # protocol and the state-conservation auditor ------------------
+        self.bind_ambiguous = r.register(Counter(
+            "scheduler_bind_ambiguous_total",
+            "Ambiguously timed-out bind RPCs by read-your-write "
+            "resolution: adopted (the hub HAD committed — confirmed, "
+            "never re-bound), requeued (verified not committed — safe "
+            "retry), conflict (bound elsewhere / recreated uid), gone "
+            "(pod deleted mid-bind), deferred (verification itself "
+            "unreachable — pod parked assumed, re-probed later). "
+            "expired-* variants are the same verdicts reached from an "
+            "assume-TTL expiry (lost watch confirmation) instead of an "
+            "in-cycle bind timeout.",
+            ["resolution"],
+        ))
+        self.invariant_violations = r.register(Counter(
+            "scheduler_invariant_violations_total",
+            "State-conservation auditor violations by invariant "
+            "(multi-state, capacity, lost-pod, double-bind-risk, "
+            "stale-entry — obs/audit.py). Any nonzero value is a "
+            "correctness bug, never noise.",
+            ["invariant"],
         ))
         # -- runtime JAX telemetry (kubernetes_tpu/obs): the dynamic twin
         # of graftlint's static R3 rule, plus host-boundary transfer

@@ -25,12 +25,19 @@ every capture happens at the host boundaries graftlint already blesses):
   prediction (``scheduler_cycle_model_efficiency``) plus the
   multi-window SLO burn-rate watchdog; surfaced on ``/debug/ledger``,
   the flight recorder's ``eff=``/``slo=`` flags, and the benches.
+- :mod:`kubernetes_tpu.obs.audit` — the state-conservation auditor:
+  every pod in exactly one of {queued, assumed, bound, gone}, node
+  capacity never exceeded by committed binds, per-audit deltas
+  conserving pods; violations land on
+  ``scheduler_invariant_violations_total{invariant}``, a spam-filtered
+  ``InvariantViolation`` event, and the ``invariants=`` flight flag.
 
 :class:`kubernetes_tpu.obs.core.Observability` is the facade the
 scheduler owns; config rides :class:`kubernetes_tpu.config.
 ObservabilityConfig` (and its v1alpha1 block).
 """
 
+from kubernetes_tpu.obs.audit import INVARIANTS, StateAuditor, Violation
 from kubernetes_tpu.obs.core import Observability
 from kubernetes_tpu.obs.explain import (
     ExplainResult,
@@ -55,6 +62,9 @@ from kubernetes_tpu.obs.trace import (
 )
 
 __all__ = [
+    "INVARIANTS",
+    "StateAuditor",
+    "Violation",
     "Observability",
     "ExplainResult",
     "PodExplanation",

@@ -79,6 +79,11 @@ class Observability:
         #: parks here until the next begin_cycle stamps it onto that
         #: cycle's record (value = elector epoch, or 1 when unknown)
         self._pending_takeover = 0
+        #: state-conservation audits run BETWEEN cycles too (the serving
+        #: runtime's low-frequency sweep, the chaos harnesses) — their
+        #: violation count parks here until the next record, same
+        #: between-cycles pattern as the takeover flag
+        self._pending_invariants = 0
         #: sharded-backend provenance: device count of the scheduler's
         #: node-axis mesh (0 = single-device). Set once at construction
         #: (note_mesh); stamped on every cycle's flight record so a
@@ -98,8 +103,11 @@ class Observability:
                          "breakers": [], "retries": 0,
                          "deadline_exceeded": False,
                          "takeover": self._pending_takeover,
-                         "device_resets": 0, "fenced_binds": 0}
+                         "device_resets": 0, "fenced_binds": 0,
+                         "invariant_violations": self._pending_invariants,
+                         "ambiguous_binds": 0}
         self._pending_takeover = 0
+        self._pending_invariants = 0
         self._sinkhorn_stats = None
         self._retraces_at_begin = self.jax.retrace_total()
         self._d2h_at_begin = self.jax.d2h_bytes_total()
@@ -187,6 +195,25 @@ class Observability:
             self._scratch["fenced_binds"] = (
                 self._scratch.get("fenced_binds", 0) + 1)
 
+    def note_invariant_violations(self, n: int = 1) -> None:
+        """The state-conservation auditor (obs/audit.py) found ``n``
+        violations — stamp the in-flight cycle's record (``invariants=``
+        flag), or park for the next one when the audit ran between
+        cycles (the serving runtime's low-frequency sweep)."""
+        if "invariant_violations" in self._scratch and \
+                self.current_trace is not None:
+            self._scratch["invariant_violations"] = (
+                self._scratch.get("invariant_violations", 0) + int(n))
+        else:
+            self._pending_invariants += int(n)
+
+    def note_ambiguous_bind(self) -> None:
+        """A bind RPC timed out ambiguously this cycle and went through
+        read-your-write resolution (``ambig=`` flight-record flag)."""
+        if "ambiguous_binds" in self._scratch:
+            self._scratch["ambiguous_binds"] = (
+                self._scratch.get("ambiguous_binds", 0) + 1)
+
     def note_mesh(self, devices: int) -> None:
         """The sharded execution backend's mesh size (``mesh=N`` flag on
         every flight record; 0 = single-device)."""
@@ -254,6 +281,8 @@ class Observability:
             or s.get("takeover", 0)
             or s.get("device_resets", 0)
             or s.get("fenced_binds", 0)
+            or s.get("invariant_violations", 0)
+            or s.get("ambiguous_binds", 0)
         )
         if not eventful:
             return None
@@ -294,6 +323,8 @@ class Observability:
             takeover=s.get("takeover", 0),
             device_resets=s.get("device_resets", 0),
             fenced_binds=s.get("fenced_binds", 0),
+            invariant_violations=s.get("invariant_violations", 0),
+            ambiguous_binds=s.get("ambiguous_binds", 0),
             mesh=s.get("mesh", self.mesh_devices),
             scenario=s.get("scenario", {}),
         )

@@ -81,6 +81,12 @@ class CycleRecord:
     device_resets: int = 0
     #: binds aborted by the lease fence this cycle (deposed leader)
     fenced_binds: int = 0
+    #: state-conservation auditor violations stamped onto this cycle
+    #: (obs/audit.py; nonzero is a correctness bug, never noise)
+    invariant_violations: int = 0
+    #: bind RPCs that timed out ambiguously this cycle and went through
+    #: the read-your-write resolution protocol
+    ambiguous_binds: int = 0
     #: sharded-backend provenance: node-axis mesh device count the
     #: scheduler ran this cycle under (0 = single-device mode)
     mesh: int = 0
@@ -138,6 +144,10 @@ class CycleRecord:
                if self.device_resets else {}),
             **({"fenced_binds": self.fenced_binds}
                if self.fenced_binds else {}),
+            **({"invariant_violations": self.invariant_violations}
+               if self.invariant_violations else {}),
+            **({"ambiguous_binds": self.ambiguous_binds}
+               if self.ambiguous_binds else {}),
             **({"mesh": self.mesh} if self.mesh else {}),
             **({"scenario": dict(self.scenario)} if self.scenario else {}),
             **({"modeled_s": round(self.modeled_s, 6),
@@ -228,6 +238,10 @@ class FlightRecorder:
                 flags.append(f"device_reset={r.device_resets}")
             if r.fenced_binds:
                 flags.append(f"fenced={r.fenced_binds}")
+            if r.invariant_violations:
+                flags.append(f"invariants={r.invariant_violations}")
+            if r.ambiguous_binds:
+                flags.append(f"ambig={r.ambiguous_binds}")
             if r.model_efficiency >= 0:
                 flags.append(f"eff={r.model_efficiency:.2f}")
             if r.slo:

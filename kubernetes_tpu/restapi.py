@@ -552,7 +552,7 @@ class RestServer:
                  port: int = 0, audit: "AuditLog | None" = None,
                  authn=None, authz=None, fairness=None,
                  watch_max_drain: "int | None" = None,
-                 metrics=None) -> None:
+                 metrics=None, fault_injector=None) -> None:
         """``authn``/``authz`` install the reference's request filter
         chain in its order — authentication, then authorization, then
         the handler (admission runs inside create paths), per
@@ -571,6 +571,14 @@ class RestServer:
         self.hub = hub
         self.audit = audit
         self.fairness = fairness
+        #: faults.FaultInjector (or None): the NETWORK chaos seam —
+        #: ``rest:{VERB}`` rules fire ahead of the filter chain.
+        #: ``rpc_error`` answers 500 before the handler acts (definite
+        #: failure); ``latency`` delays; ``rpc_timeout`` lets the
+        #: handler run but kills the RESPONSE on the wire — the client
+        #: sees a dead socket while the server-side state mutated, the
+        #: exact ambiguity the scheduler's bind protocol must resolve.
+        self.fault_injector = fault_injector
         self.watch_max_drain = (self.WATCH_MAX_DRAIN
                                 if watch_max_drain is None
                                 else int(watch_max_drain))
@@ -643,6 +651,14 @@ class RestServer:
                 self._write_response(code, ctype, body, headers)
 
             def _write_response(self, code, ctype, body, headers) -> None:
+                if getattr(self, "_suppress_response", False):
+                    # injected ambiguous timeout (rpc_timeout at the
+                    # rest seam): the handler ran and the state
+                    # mutated, but the answer dies on the wire — close
+                    # without responding so the client observes exactly
+                    # what a timed-out RPC observes
+                    self.close_connection = True
+                    return
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
@@ -668,6 +684,8 @@ class RestServer:
 
             def do_GET(self):
                 outer._begin(self)
+                if outer._net_fault(self):
+                    return
                 t0 = time.perf_counter()
                 seat = outer._admit(self, "GET")
                 try:
@@ -688,6 +706,8 @@ class RestServer:
 
             def do_POST(self):
                 outer._begin(self)
+                if outer._net_fault(self):
+                    return
                 t0 = time.perf_counter()
                 seat = outer._admit(self, "POST")
                 try:
@@ -701,6 +721,8 @@ class RestServer:
 
             def do_PUT(self):
                 outer._begin(self)
+                if outer._net_fault(self):
+                    return
                 t0 = time.perf_counter()
                 seat = outer._admit(self, "PUT")
                 try:
@@ -714,6 +736,8 @@ class RestServer:
 
             def do_DELETE(self):
                 outer._begin(self)
+                if outer._net_fault(self):
+                    return
                 t0 = time.perf_counter()
                 seat = outer._admit(self, "DELETE")
                 try:
@@ -727,6 +751,8 @@ class RestServer:
 
             def do_PATCH(self):
                 outer._begin(self)
+                if outer._net_fault(self):
+                    return
                 t0 = time.perf_counter()
                 seat = outer._admit(self, "PATCH")
                 try:
@@ -744,6 +770,30 @@ class RestServer:
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
+
+    def _net_fault(self, handler) -> bool:
+        """Injected network fault for one request (site
+        ``rest:{METHOD}``). Returns True when the request was fully
+        answered here (``rpc_error`` → 500 before any handler state
+        changed); ``latency`` sleeps then proceeds; ``rpc_timeout``
+        marks the handler's RESPONSE for suppression and proceeds —
+        the ambiguous class at the HTTP layer."""
+        inj = self.fault_injector
+        if inj is None:
+            return False
+        out = inj.rpc_hook(f"rest:{handler.command}")
+        if out is None:
+            return False
+        kind, rule, _committed = out
+        if kind == "rpc_error":
+            handler._fail(500, "InternalError",
+                          "injected rpc error (nothing committed)")
+            return True
+        if kind == "latency":
+            time.sleep(min(max(rule.latency_s, 0.0), 1.0))
+        elif kind == "rpc_timeout":
+            handler._suppress_response = True
+        return False
 
     def serve(self) -> int:
         self._thread.start()

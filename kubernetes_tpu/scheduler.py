@@ -208,6 +208,8 @@ class Scheduler:
         recovery=None,
         fault_injector=None,
         retry_sleep: Callable[[float], None] = time.sleep,
+        pod_reader: Optional[Callable[[str], Optional[Pod]]] = None,
+        jitter_seed: Optional[int] = None,
         observability=None,
         pipeline_depth: int = 2,
         pipeline_chunk: int = 4096,
@@ -272,6 +274,19 @@ class Scheduler:
         #: into the solver entry and the extender/shim transports
         self.fault_injector = fault_injector
         rc = self.robustness
+        # PER-REPLICA jitter seed at the hub seam (full jitter): two
+        # replicas sharing one RetryPolicy CONFIG must not share the
+        # jitter STREAM — a shared default seed makes their backoff
+        # trains lockstep, so every retry wave from every replica lands
+        # on a recovering hub at the same instant. Derived from process
+        # + instance identity unless the caller pins one (tests).
+        if jitter_seed is None:
+            import os as _os
+            import random as _random
+
+            jitter_seed = (_random.SystemRandom().randrange(1 << 30)
+                           ^ _os.getpid() ^ (id(self) & 0xFFFF))
+        self._jitter_seed = int(jitter_seed)
         #: bounded-backoff policy shared by the transport seams; ``sleep``
         #: injectable so fake-clock tests never block
         self._transport_retry = RetryPolicy(
@@ -279,8 +294,35 @@ class Scheduler:
             base_s=rc.retry_backoff_base_s,
             max_s=rc.retry_backoff_max_s,
             jitter=rc.retry_jitter,
+            seed=self._jitter_seed,
             sleep=retry_sleep,
         )
+        #: hub GET for the ambiguous-bind read-your-write verification
+        #: (``key -> Pod | None``, raising on transport failure). None =
+        #: no reader: an ambiguous bind parks on the assume TTL instead
+        #: (the watch confirm / TTL reap resolve it eventually).
+        self.pod_reader = pod_reader
+        #: bounded verification GETs per ambiguous bind, full jitter on
+        #: the same per-replica stream
+        self._bind_verify_retry = RetryPolicy(
+            max_retries=rc.bind_verify_retries,
+            base_s=rc.retry_backoff_base_s,
+            max_s=rc.retry_backoff_max_s,
+            jitter=rc.retry_jitter,
+            seed=self._jitter_seed + 1,
+            sleep=retry_sleep,
+        )
+        #: ambiguous binds whose verification GET was itself unreachable:
+        #: key -> (pod, node_name, cycle_state). The pod stays ASSUMED
+        #: (capacity held, no TTL) — requeueing it could re-bind a pod
+        #: the hub already committed — and every cycle / idle tick
+        #: re-probes until the hub answers (_verify_ambiguous_binds).
+        self._ambiguous_binds: Dict[str, Tuple] = {}
+        #: state-conservation auditor (obs/audit.py) — None until
+        #: attach_auditor; when attached, legitimate pod exits (watch
+        #: deletes, terminating skips, reconcile drops) are reported so
+        #: conservation never counts them lost
+        self.auditor = None
         for e in self.extenders:
             # wire retry + fault + observability hooks into transports
             # that expose the seam (HTTPExtender); duck-typed so test
@@ -656,6 +698,18 @@ class Scheduler:
 
     def on_pod_delete(self, pod: Pod) -> None:
         key = pod.key()
+        self._note_gone(key)
+        # a bind whose ambiguous verification was parked resolves by
+        # deletion: the pod is gone whatever the RPC did — release the
+        # held assumption (parked pods carry no TTL, so nothing else
+        # would free this capacity)
+        parked = self._ambiguous_binds.pop(key, None)
+        if parked is not None and self.cache.is_assumed(key):
+            apod, anode, ast = parked
+            self.cache.forget_pod(key)
+            self.volume_binder.forget_pod_volumes(key)
+            self.framework.run_unreserve(
+                ast or _new_cycle_state(), apod, anode)
         # a Permit-parked pod is assumed in the cache and holds capacity —
         # deletion must release both the wait entry and the assumption
         wp = self.framework.waiting.get(key)
@@ -747,6 +801,29 @@ class Scheduler:
         elector.on_stopped_leading = stopped
         return elector
 
+    def attach_auditor(self, auditor):
+        """Wire a state-conservation auditor (obs/audit.py): the
+        scheduler reports legitimate pod exits (watch deletes,
+        terminating skips, reconcile drops) via ``note_gone`` so the
+        auditor's per-audit conservation rule never counts an explained
+        exit as a lost pod. Attaches metrics / event sink / obs when the
+        auditor has none. Returns the auditor."""
+        self.auditor = auditor
+        if getattr(auditor, "metrics", "absent") is None:
+            auditor.metrics = self.metrics
+        if getattr(auditor, "event_sink", "absent") is None:
+            auditor.event_sink = (
+                lambda reason, obj, msg: self.event_sink(reason, obj, msg))
+        if getattr(auditor, "obs", "absent") is None:
+            auditor.obs = self.obs
+        return auditor
+
+    def _note_gone(self, key: str) -> None:
+        """A pod legitimately left the state machine — tell the
+        attached auditor (no-op without one)."""
+        if self.auditor is not None:
+            self.auditor.note_gone(key)
+
     def on_started_leading(self) -> None:
         """OnStartedLeading (app/server.go:261): this incarnation just
         became the writer. Reconcile before the first cycle so a crash
@@ -785,6 +862,11 @@ class Scheduler:
                        ("Permit:lost leadership",))
             self._cycle_states.pop(key, None)
             drained += 1
+        # ambiguous-bind parks are assumed pods too: the sweep below
+        # drains the assumption; the NEW leader's reconcile resolves
+        # what the hub actually committed (its relist truth is the
+        # read-your-write answer)
+        self._ambiguous_binds.clear()
         for key in self.cache.assumed_keys():
             pod = self.cache.pod(key)
             self.cache.forget_pod(key)
@@ -818,6 +900,12 @@ class Scheduler:
 
         adopted = forgotten = requeued = 0
         if pods is not None:
+            # the relisted truth IS the read-your-write answer for any
+            # parked ambiguous bind — the assumed-keys sweep below
+            # settles them (adopt or forget), so the parks are moot.
+            # Truthless reconciles keep them parked: clearing without a
+            # verdict would leak the TTL-less assumption forever.
+            self._ambiguous_binds.clear()
             truth = {p.key(): p for p in pods}
             for key in list(self.cache.assumed_keys()):
                 cached = self.cache.pod(key)
@@ -880,6 +968,7 @@ class Scheduler:
                     for p in qpods:
                         if p.key() not in truth:
                             self.queue.delete(p.key())
+                            self._note_gone(p.key())
         # local convergence, truth or not: resweep parked pods (this
         # incarnation may have missed move events), rebuild the
         # device-resident snapshot from the host mirror, re-warm
@@ -931,11 +1020,19 @@ class Scheduler:
     def _reap_expired_assumptions(self) -> None:
         """Drive cache TTL expiry and HANDLE the result (satellite of
         the recovery PR — both call sites previously discarded it): log,
-        count, emit an AssumptionExpired event, and requeue the pod so
-        a lost bind confirmation converges instead of stranding the pod
-        out of every queue. If the pod actually IS bound (watch merely
-        slow), the eventual MODIFIED event deletes it from the queue;
-        until then a re-bind attempt fails the hub CAS harmlessly."""
+        count, emit an AssumptionExpired event, and converge the pod.
+
+        An expired assumption is the SAME ambiguity class as a timed-out
+        bind: the commit very likely landed and only the watch
+        confirmation was lost. With a ``pod_reader`` the expiry resolves
+        by read-your-write verification — adopt a hub-confirmed binding,
+        requeue only when verified unbound, park (re-assumed, no TTL)
+        while the hub is unreachable — so the reap never blind-requeues
+        a pod whose retry would re-bind at the hub. Without a reader the
+        legacy optimistic path remains: requeue, and if the pod actually
+        IS bound (watch merely slow) the eventual MODIFIED event deletes
+        it from the queue; until then a re-bind attempt fails the hub
+        CAS harmlessly."""
         import dataclasses as _dc
 
         expired = self.cache.pop_expired()
@@ -943,11 +1040,44 @@ class Scheduler:
             return
         self.metrics.cache_expired_assumptions.inc(len(expired))
         for p in expired:
+            key = p.key()
+            if self.pod_reader is not None:
+                resolution = self._resolve_ambiguous_bind(p, p.node_name)
+                self.metrics.bind_ambiguous.inc(
+                    resolution=f"expired-{resolution or 'deferred'}")
+                if resolution == "adopted":
+                    # the hub HAS our binding — the confirmation was
+                    # merely lost; re-add bound (capacity re-held)
+                    self.cache.add_pod(p)
+                    klog.V(2).info(
+                        "assumed pod %s expired but the hub confirms "
+                        "the binding to %s — adopted, not requeued",
+                        key, p.node_name)
+                    continue
+                if resolution is None:
+                    # verification unreachable too: park assumed (no
+                    # TTL) and re-probe each cycle / idle tick — a
+                    # requeue during a hub outage is exactly the blind
+                    # retry the protocol forbids
+                    self.cache.assume_pod(p, p.node_name)
+                    self._ambiguous_binds[key] = (p, p.node_name, None)
+                    klog.warning(
+                        "assumed pod %s expired and verification is "
+                        "unreachable; parked assumed", key)
+                    continue
+                if resolution in ("conflict", "gone"):
+                    # deleted, recreated under a new uid, or bound by
+                    # another writer: drop the stale local copy — the
+                    # watch/relist delivers the truth object
+                    self.volume_binder.forget_pod_volumes(key)
+                    self._note_gone(key)
+                    continue
+                # "requeued": verified unbound — safe to retry below
             klog.warning(
                 "assumed pod %s on %s expired (bind confirmation never "
-                "arrived within %.0fs); requeueing", p.key(), p.node_name,
+                "arrived within %.0fs); requeueing", key, p.node_name,
                 self.cache.ttl_s)
-            self.volume_binder.forget_pod_volumes(p.key())
+            self.volume_binder.forget_pod_volumes(key)
             pending = _dc.replace(p, node_name="")
             self.event_sink(
                 "AssumptionExpired", pending,
@@ -1046,6 +1176,7 @@ class Scheduler:
             self.obs.note_microbatch(flush_trigger, window_s)
         self.queue.tick()
         self._reap_expired_assumptions()
+        self._verify_ambiguous_binds()
         self._process_waiting(res)
         batch = self.queue.pop_batch(self.max_batch)
         if not batch:
@@ -1058,7 +1189,12 @@ class Scheduler:
         self.obs.note_cycle(cycle)
         # skipPodSchedule (scheduler.go:335): a pod already marked for
         # deletion is dropped from the cycle, not retried — its DELETED
-        # event (kubelet kill or pod-GC) is the terminal outcome
+        # event (kubelet kill or pod-GC) is the terminal outcome; the
+        # auditor's conservation rule learns the exit NOW so the window
+        # until that event is not read as a lost pod
+        for p in batch:
+            if p.deletion_timestamp:
+                self._note_gone(p.key())
         batch = [p for p in batch if not p.deletion_timestamp]
         res.attempted = len(batch)
         fw = self.framework
@@ -2892,8 +3028,21 @@ class Scheduler:
                     binder.set_call_budget(None)
             try:
                 binder.bind(pod, node_name)
-            except Exception as e:  # bind RPC failed -> Forget + retry
-                return reject(f"BindError:{e}")
+            except Exception as e:
+                if self._bind_ambiguous(e):
+                    # the AMBIGUOUS class: the hub may have committed
+                    # before the response was lost. NEVER blind-retry —
+                    # resolve by read-your-write verification instead
+                    # (GET the pod, compare uid+nodeName, adopt or
+                    # requeue; park when the GET itself is unreachable).
+                    verdict = self._handle_ambiguous_bind(
+                        pod, node_name, st, res, e, reject)
+                    if verdict is not True:
+                        return bool(verdict)
+                    # adopted: the bind DID land — fall through to the
+                    # normal success tail (finish_binding, events, ...)
+                else:  # definite failure -> Forget + retry
+                    return reject(f"BindError:{e}")
         elif not bs.is_success():
             return reject(f"Bind:{bs.message}")
         self.metrics.binding_duration.observe(self.clock() - bt0)
@@ -2917,6 +3066,194 @@ class Scheduler:
         self._cycle_states.pop(pod.key(), None)
         self.event_sink("Scheduled", pod, node_name)
         return True
+
+    # -- ambiguous-outcome bind protocol (network-fault robustness) --------
+
+    def _bind_ambiguous(self, e: Exception) -> bool:
+        """Is this bind failure the AMBIGUOUS class (the hub may have
+        committed before the response was lost)? ``faults.RPCTimeout``
+        always is; raw transport timeouts (socket.timeout /
+        TimeoutError) are too, but only a scheduler WITH a hub reader
+        can do better than the legacy reject-and-requeue for them — so
+        without one their behavior stays exactly as before."""
+        import socket
+
+        from kubernetes_tpu.faults import RPCTimeout
+
+        if isinstance(e, RPCTimeout):
+            return True
+        return (self.pod_reader is not None
+                and isinstance(e, (socket.timeout, TimeoutError)))
+
+    def _resolve_ambiguous_bind(self, pod: Pod, node_name: str):
+        """Read-your-write verification of an ambiguously timed-out
+        bind: GET the pod from the hub (bounded retries, full jitter on
+        the per-replica stream) and compare uid + nodeName.
+
+        Returns ``"adopted"`` (the hub HAS our binding — confirm, never
+        re-bind), ``"requeued"`` (verified unbound — a retry through
+        the normal requeue path is safe), ``"conflict"`` (bound
+        elsewhere or recreated under a new uid), ``"gone"`` (deleted
+        mid-bind), ``"ttl-parked"`` (no reader attached — fall back to
+        the assume TTL / watch confirmation), or ``None`` when the
+        verification GET itself stayed unreachable (the caller parks
+        the pod and re-probes later)."""
+        if self.pod_reader is None:
+            return "ttl-parked"
+        key = pod.key()
+        # the cycle deadline bounds IN-CYCLE verification; on the idle
+        # paths (parked re-probes, TTL-expiry verification) the last
+        # cycle's absolute deadline is stale — already in the past —
+        # and would silently zero the retry budget
+        deadline = self._cycle_deadline
+        if deadline is not None and self.clock() >= deadline:
+            deadline = None
+        try:
+            cur = self._bind_verify_retry.call(
+                lambda: self.pod_reader(key),
+                deadline_s=deadline, clock=self.clock)
+        except Exception as e:
+            klog.warning("ambiguous bind of %s -> %s: verification GET "
+                         "failed (%s); parking", key, node_name, e)
+            return None
+        if cur is None:
+            return "gone"
+        if getattr(cur, "uid", None) != pod.uid:
+            return "conflict"
+        if cur.node_name == node_name:
+            return "adopted"
+        if cur.node_name:
+            return "conflict"
+        return "requeued"
+
+    def _handle_ambiguous_bind(self, pod: Pod, node_name: str, st, res,
+                               exc: Exception, reject) -> object:
+        """Resolve one in-cycle ambiguous bind timeout. Returns ``True``
+        when the hub turned out to have committed (the caller proceeds
+        to the normal success tail), ``False`` when the pod was
+        requeued, parked, or dropped here."""
+        key = pod.key()
+        self.obs.note_ambiguous_bind()
+        resolution = self._resolve_ambiguous_bind(pod, node_name)
+        self.metrics.bind_ambiguous.inc(
+            resolution=resolution or "deferred")
+        if resolution is None:
+            # the hub is unreachable for verification too: the pod
+            # stays ASSUMED (capacity held, NO TTL — a TTL reap would
+            # requeue and risk re-binding a committed pod) and every
+            # cycle / idle tick re-probes until the hub answers
+            klog.warning("bind of %s -> %s timed out ambiguously and "
+                         "verification is unreachable; parked assumed",
+                         key, node_name)
+            self._ambiguous_binds[key] = (pod, node_name, st)
+            self._cycle_states.pop(key, None)
+            return False
+        if resolution == "adopted":
+            klog.V(2).info("ambiguous bind of %s -> %s resolved: hub "
+                           "committed — adopted, not re-bound",
+                           key, node_name)
+            return True
+        if resolution == "ttl-parked":
+            # no reader: optimistic fallback — arm the assume TTL; the
+            # watch MODIFIED confirms a committed bind, the TTL reap
+            # requeues an uncommitted one (a late re-bind then fails
+            # the hub CAS harmlessly)
+            self.cache.finish_binding(key)
+            self._cycle_states.pop(key, None)
+            return False
+        if resolution == "requeued":
+            reject(f"BindAmbiguous:verified not committed ({exc})")
+            return False
+        # conflict / gone: same forget-and-requeue path as a definite
+        # bind error; the watch (or reconcile) drops stale queue entries
+        reject(f"BindError:ambiguous bind resolved as {resolution}: "
+               f"{exc}")
+        return False
+
+    def _verify_ambiguous_binds(self) -> None:
+        """Re-probe every parked ambiguous bind (cycle path AND
+        idle_tick): the watch may have settled it meanwhile (confirmed
+        add or delete), else the verification GET is retried and the
+        pod adopted / requeued exactly like the in-cycle resolution."""
+        if not self._ambiguous_binds:
+            return
+        res = CycleResult()
+        resolved = False
+        for key, (pod, node_name, st) in list(
+                self._ambiguous_binds.items()):
+            # st is None ONLY for a park made by the TTL reap — that
+            # pod's ORIGINAL bind already ran the success tail
+            # (postbind, Scheduled event, scheduling metrics), so an
+            # adoption here must confirm the cache and nothing else;
+            # its verdicts keep the expired-* metric labeling so the
+            # TTL-expiry series stays distinguishable from in-cycle
+            # bind timeouts
+            reap_origin = st is None
+            watch_settled = not self.cache.is_assumed(key)
+            if watch_settled:
+                # the watch answered first: a confirmed add flipped the
+                # assumption to bound (a delete pops the park in
+                # on_pod_delete and reconcile clears parks wholesale,
+                # so bound is the only live way here) — an adoption
+                # whose read-your-write answer is the hub's own stream;
+                # an IN-CYCLE park still owes the full success tail,
+                # which its original bind never reached
+                del self._ambiguous_binds[key]
+                if self.cache.pod(key) is None:
+                    continue  # settled out-of-band; nothing to finish
+                resolution = "adopted"
+            else:
+                resolution = self._resolve_ambiguous_bind(pod, node_name)
+                if resolution is None:
+                    continue  # hub still unreachable: stay parked
+                del self._ambiguous_binds[key]
+            self.metrics.bind_ambiguous.inc(
+                resolution=(f"expired-{resolution}" if reap_origin
+                            else resolution))
+            resolved = True
+            st = st or _new_cycle_state()
+            if resolution in ("adopted", "ttl-parked"):
+                if resolution == "ttl-parked":
+                    # reader detached: back to TTL semantics
+                    self.cache.finish_binding(key)
+                    continue
+                if not watch_settled:
+                    # the verification GET is hub truth exactly like a
+                    # relist — confirm the binding outright
+                    # (reconcile's adopt), never arm a TTL whose reap
+                    # would requeue a pod we just PROVED the hub bound
+                    self.cache.add_pod(self.cache.pod(key) or pod)
+                if reap_origin:
+                    klog.V(2).info("parked expired assumption of %s -> "
+                                   "%s resolved: adopted", key, node_name)
+                    continue
+                self.queue.nominated.delete(pod)
+                self.metrics.pod_scheduling_attempts.observe(
+                    self.queue.backoff_map.attempts(key) + 1)
+                self.queue.backoff_map.clear_pod(key)
+                self.why_pending.pop(key, None)
+                res.scheduled += 1
+                res.assignments[key] = node_name
+                res.e2e_latency_s[key] = max(
+                    self.clock() - getattr(pod, "queued_at",
+                                           self.clock()), 0.0)
+                self.framework.run_postbind(st, pod, node_name)
+                self.event_sink("Scheduled", pod, node_name)
+                klog.V(2).info("parked ambiguous bind of %s -> %s "
+                               "resolved: adopted", key, node_name)
+            else:
+                self.cache.forget_pod(key)
+                self.volume_binder.forget_pod_volumes(key)
+                self.framework.run_unreserve(st, pod, node_name)
+                res.bind_errors += 1
+                if resolution == "requeued":
+                    reasons = ("BindAmbiguous:verified not committed",)
+                else:
+                    reasons = ("BindError:ambiguous bind resolved as "
+                               f"{resolution}",)
+                self._fail(pod, self.queue.scheduling_cycle, res, reasons)
+        if resolved:
+            self._record_metrics(res)
 
     def _process_waiting(self, res: CycleResult) -> None:
         """Resolve Permit waits (waiting_pods_map.go consumers): allowed
@@ -3852,6 +4189,7 @@ class Scheduler:
         artifacts every --cycle-interval."""
         self.queue.tick()
         self._reap_expired_assumptions()
+        self._verify_ambiguous_binds()
         # keep the SLO burn-rate windows (and the recovery transition)
         # live while idle — eventful cycles may never come to run the
         # watchdog's state machine after the queue drains

@@ -119,6 +119,39 @@ class ServingRuntime:
         # -- watch fan-out -------------------------------------------------
         self.hub = WatchHub(buffer=self.config.watch_buffer,
                             metrics=sched.metrics)
+        # -- state-conservation auditor (obs/audit.py) ---------------------
+        #: runs the structural invariants (multi-state, capacity,
+        #: truthless conservation) every ``observability.
+        #: audit_interval_s`` seconds BETWEEN loop iterations, under the
+        #: ingest lock (never mid-cycle). 0 = off (the default: chaos
+        #: suites and benches attach their own). Violations land on
+        #: scheduler_invariant_violations_total, a spam-filtered
+        #: InvariantViolation event, and the invariants= flight flag.
+        self.auditor = None
+        obs_cfg = getattr(getattr(sched, "obs", None), "config", None)
+        self._audit_interval = float(
+            getattr(obs_cfg, "audit_interval_s", 0.0) or 0.0)
+        self._next_audit = 0.0
+        if self._audit_interval > 0:
+            from kubernetes_tpu.obs.audit import StateAuditor
+
+            self.auditor = sched.attach_auditor(StateAuditor())
+            self.loop.maintenance = self.maybe_audit
+
+    def maybe_audit(self) -> int:
+        """The low-frequency state-conservation sweep: run the
+        structural invariants when the interval elapsed, under the
+        ingest lock so producers and leadership side-effects are
+        quiesced. Returns violations found this call (0 = clean or not
+        due yet)."""
+        if self.auditor is None:
+            return 0
+        now = self.clock()
+        if now < self._next_audit:
+            return 0
+        self._next_audit = now + self._audit_interval
+        with self.loop.lock:
+            return len(self.auditor.audit(self.sched))
 
     def shed_bound(self) -> int:
         """The mutating flow's pressure bound: configured, or auto =

@@ -170,6 +170,11 @@ class ServingLoop:
                                   self.window.target_bucket)
         #: per-flush callback (bench/tests): receives the CycleResult
         self.on_cycle = on_cycle
+        #: per-iteration maintenance hook run by :meth:`run` BETWEEN
+        #: run_once iterations (never mid-cycle): the composed runtime
+        #: parks its low-frequency state-conservation audit here so it
+        #: survives benches overwriting ``on_cycle``
+        self.maintenance: Optional[Callable[[], None]] = None
         self.cycles = 0
         #: serializes the solve against cross-thread event feeds: the
         #: scheduler's queue/cache are single-writer structures, so an
@@ -227,3 +232,5 @@ class ServingLoop:
             if gate is not None and not gate():
                 continue
             self.run_once()
+            if self.maintenance is not None:
+                self.maintenance()

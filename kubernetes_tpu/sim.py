@@ -3072,11 +3072,35 @@ class Reflector:
       sink delivery, and a MODIFIED pod that leaves the selector is
       delivered as a DELETE (watch-cache selector semantics), never
       silently retained.
+
+    Network-fault hardening (PR 15):
+
+    - **resourceVersion-monotonic dedupe** — every delivered event
+      carries the hub revision; an event at or below the object's last
+      delivered revision is a NO-OP (``deduped`` counts them). This is
+      what makes duplicated and reordered watch frames harmless: a
+      stale MODIFIED reordered after its object's DELETE can never
+      resurrect the object (the reference informer's resourceVersion
+      comparison in the DeltaFIFO/store seam).
+    - **progress deadline** — a watch that delivers NOTHING for
+      ``progress_deadline_s`` while the hub has advanced revisions is
+      treated as silently stalled (half-open connection class) and
+      forced to relist instead of idling forever; forced relists (and
+      Compacted storms) back off with FULL JITTER per replica
+      (``relist_backoff``) so a fleet can't stampede a recovering hub.
+      Both need an injected ``clock``; without one the behavior is
+      exactly the pre-hardening Reflector.
+    - ``cursor_wrap`` — chaos seam: wraps the watch cursor at relist
+      time (chaos.FuzzedCursor injects drop/duplicate/reorder/410).
     """
 
     def __init__(self, hub: HollowCluster, sink,
                  pod_label_selector: str = "",
-                 pod_field_selector: str = "") -> None:
+                 pod_field_selector: str = "",
+                 clock: Optional[Callable[[], float]] = None,
+                 progress_deadline_s: Optional[float] = None,
+                 relist_backoff=None,
+                 cursor_wrap=None) -> None:
         from kubernetes_tpu.api.selectors import (
             match_fields,
             match_labels,
@@ -3092,6 +3116,46 @@ class Reflector:
         self.nodes: Dict[str, Node] = {}
         self.relists = 0
         self._cursor: Optional[WatchCursor] = None
+        # -- network-fault hardening state --------------------------------
+        self.clock = clock
+        if progress_deadline_s is None:
+            # robustness.watchProgressDeadline: a Scheduler sink carries
+            # its config — the knob governs every reflector built on it
+            # unless the caller pins a deadline explicitly (0 = off);
+            # sinks without a robustness block keep detection off
+            progress_deadline_s = getattr(
+                getattr(sink, "robustness", None),
+                "watch_progress_deadline_s", 0.0)
+        self.progress_deadline_s = float(progress_deadline_s or 0.0)
+        progress_deadline_s = self.progress_deadline_s
+        if relist_backoff is None and progress_deadline_s > 0:
+            # full jitter on a PER-REPLICA stream (SystemRandom seed):
+            # two replicas stalling together must not relist in lockstep
+            from kubernetes_tpu.faults import RetryPolicy
+
+            relist_backoff = RetryPolicy(
+                base_s=1.0, max_s=30.0, jitter=0.5,
+                seed=random.SystemRandom().randrange(1 << 30))
+        self._relist_backoff = relist_backoff
+        self._cursor_wrap = cursor_wrap
+        #: per-object last DELIVERED revision (the dedupe floor)
+        self._obj_rev: Dict[str, int] = {}
+        #: duplicated / reordered-stale events dropped as no-ops
+        self.deduped = 0
+        #: relists forced by the progress deadline (stalled watch)
+        self.stalled_relists = 0
+        #: highest revision actually RECEIVED from the stream (the
+        #: stall detector compares the hub's head against it — the
+        #: cursor position alone can lie when frames are being eaten)
+        self._delivered_rev = 0
+        #: a 410 observed DURING the relist cool-down: the relist is
+        #: owed once the window opens — a real compacted cursor would
+        #: re-raise every poll, but an injected one-shot 410
+        #: (chaos.FuzzedCursor) fires exactly once and must not be lost
+        self._pending_compacted = False
+        self._last_progress = clock() if clock is not None else 0.0
+        self._next_relist_ok = 0.0
+        self._stall_attempts = 0
         self._lsel = parse_label_selector(pod_label_selector)
         self._fsel = parse_field_selector(pod_field_selector)
         validate_field_keys(self._fsel, "pods")
@@ -3136,21 +3200,96 @@ class Reflector:
             if key not in pods:
                 self.sink.on_pod_delete(old)
         self.nodes, self.pods = nodes, pods
-        self._cursor = self.hub.watch(rev)
+        # the dedupe floor COMPACTS at every relist: the fresh cursor
+        # starts AT rev, so no frame at or below rev can ever arrive
+        # again — live objects keep a floor of rev and entries for
+        # objects gone from the listing (every deleted pod ever seen)
+        # are dropped, bounding the map to the live set instead of
+        # growing with total objects ever delivered (a reflector
+        # under sustained create/delete churn would otherwise leak)
+        self._obj_rev = {f"nodes/{n}": rev for n in nodes}
+        self._obj_rev.update({f"pods/{k}": rev for k in pods})
+        cur = self.hub.watch(rev)
+        if self._cursor_wrap is not None:
+            cur = self._cursor_wrap(cur)
+        self._cursor = cur
+        self._delivered_rev = max(self._delivered_rev, rev)
+        if self.clock is not None:
+            self._last_progress = self.clock()
+
+    def _arm_relist_backoff(self, now) -> None:
+        """Jittered cool-down before the NEXT forced relist — the
+        anti-stampede half of the stall/storm handling."""
+        if now is None or self._relist_backoff is None:
+            return
+        self._next_relist_ok = now + self._relist_backoff.backoff_s(
+            self._stall_attempts)
+        self._stall_attempts += 1
 
     def pump(self) -> int:
-        """Deliver pending watch events; relist on compaction. Returns the
-        number of events delivered (relist counts as one)."""
+        """Deliver pending watch events; relist on compaction or on a
+        detected silent stall. Returns the number of events received
+        (relist counts as one). Duplicated / reordered-stale events are
+        dropped by the per-object resourceVersion dedupe (``deduped``)
+        but still count as stream liveness."""
         if self._cursor is None:
+            self.list_and_watch()
+            return 1
+        now = self.clock() if self.clock is not None else None
+        if self._pending_compacted:
+            if now is not None and now < self._next_relist_ok:
+                return 0  # still cooling down; the relist stays owed
+            self._pending_compacted = False
+            self.relists += 1
+            self._arm_relist_backoff(now)
             self.list_and_watch()
             return 1
         try:
             events = self._cursor.poll()
         except Compacted:
+            if now is not None and now < self._next_relist_ok:
+                # a 410 storm already forced a relist inside this
+                # jittered cool-down; wait it out instead of joining
+                # the stampede — but REMEMBER the compaction (a one-
+                # shot injected 410 will not re-raise next poll)
+                self._pending_compacted = True
+                return 0
             self.relists += 1
+            self._arm_relist_backoff(now)
             self.list_and_watch()
             return 1
-        for _, obj_key, etype, obj in events:
+        if events:
+            self._delivered_rev = max(
+                self._delivered_rev, max(e[0] for e in events))
+            if now is not None:
+                self._last_progress = now
+            self._stall_attempts = 0
+        elif now is not None:
+            if self.hub._revision <= self._delivered_rev:
+                # genuinely idle: nothing new exists to deliver
+                self._last_progress = now
+            elif (self.progress_deadline_s > 0
+                    and now - self._last_progress
+                    >= self.progress_deadline_s
+                    and now >= self._next_relist_ok):
+                # SILENT STALL: the hub advanced revisions but this
+                # stream delivered nothing past the deadline (half-open
+                # connection / event-eating middlebox class). Force a
+                # relist with jittered backoff instead of idling forever.
+                self.stalled_relists += 1
+                self.relists += 1
+                self._arm_relist_backoff(now)
+                self.list_and_watch()
+                return 1
+        for rev, obj_key, etype, obj in events:
+            if rev <= self._obj_rev.get(obj_key, 0):
+                # duplicate or reordered-stale frame: the object already
+                # reflects a revision at/after this one — a no-op by the
+                # resourceVersion-monotonic rule (NEVER re-applied: a
+                # stale MODIFIED after a DELETE would resurrect)
+                self.deduped += 1
+                continue
+            self._obj_rev[obj_key] = rev
             kind, _, ident = obj_key.partition("/")
             if kind not in ("nodes", "pods"):
                 # the history is shared across kinds (events, services,

@@ -779,6 +779,324 @@ def run_mesh_failover_arm(rate: float, duration: float, n_nodes: int,
     }
 
 
+# ---------------------------------------------------------------------------
+# network-chaos arm (--net-chaos): serving on the mesh under injected
+# network faults — ambiguous bind timeouts (the hub may have committed),
+# duplicated/reordered/dropped watch confirmations, and a mid-run relist
+# storm — with the state-conservation auditor running at the configured
+# low frequency inside ServingRuntime. Record family:
+# benchres/churn_net_r*.json, gated by bench_compare's `netchaos` family.
+# ---------------------------------------------------------------------------
+
+
+class NetTruth:
+    """CAS'd truth with an injected NETWORK between it and the
+    scheduler: the bind RPC is :class:`chaos.AmbiguousBinder` (the ONE
+    implementation of the rpc_error / commit-coin rpc_timeout dispatch
+    and the double-bind-attempt meter) pointed at this thread-safe
+    truth store instead of a sim hub; ``rpc:get`` rules make the
+    read-your-write verification GET flaky the same way
+    (chaos.raise_injected_rpc)."""
+
+    def __init__(self, injector) -> None:
+        import threading as _th
+
+        self.injector = injector
+        self.lock = _th.Lock()
+        self.uids: dict = {}      # key -> uid (every created pod)
+        self.bound: dict = {}     # key -> node
+        self.deleted: set = set()
+
+    def register(self, pod) -> None:
+        """Admission-side registration (the producer's admit hook)."""
+        with self.lock:
+            self.uids[pod.key()] = getattr(pod, "uid", "")
+
+    def delete(self, key: str) -> None:
+        with self.lock:
+            self.deleted.add(key)
+
+    def binder(self):
+        from kubernetes_tpu.chaos import AmbiguousBinder
+
+        truth = self
+
+        class _Binder(AmbiguousBinder):
+            """AmbiguousBinder whose truth is the bench's dict store:
+            only the commit differs — the fault dispatch, the
+            commit-coin, and double_bind_attempts accounting are the
+            tested chaos.py implementation."""
+
+            def __init__(self):
+                super().__init__(hub=None, injector=truth.injector)
+
+            def _commit(self, pod, node_name):
+                with truth.lock:
+                    key = pod.key()
+                    if key in truth.bound:
+                        self.double_bind_attempts += 1
+                        raise RuntimeError(
+                            f"{key} already bound to {truth.bound[key]}")
+                    truth.bound[key] = node_name
+                    self.commits += 1
+
+        return _Binder()
+
+    def reader(self):
+        """The scheduler's ``pod_reader`` — a GET against this truth,
+        riding the same faulty network (``rpc:get``)."""
+        from kubernetes_tpu.chaos import raise_injected_rpc
+
+        truth = self
+
+        def read(key):
+            from types import SimpleNamespace
+
+            raise_injected_rpc(truth.injector, "rpc:get")
+            with truth.lock:
+                if key in truth.deleted or key not in truth.uids:
+                    return None
+                return SimpleNamespace(uid=truth.uids[key],
+                                       node_name=truth.bound.get(key, ""))
+
+        return read
+
+    def list_pods(self):
+        """The relist source (reconcile's truth list): every live pod
+        as a schedulable object, bound ones carrying their node."""
+        with self.lock:
+            out = []
+            for key, uid in self.uids.items():
+                if key in self.deleted:
+                    continue
+                ns, name = key.split("/", 1)
+                p = make_pod(name, namespace=ns, cpu_milli=POD_CPU,
+                             memory=POD_MEM,
+                             node_name=self.bound.get(key, ""))
+                p.uid = uid
+                out.append(p)
+            return out
+
+
+class NetChurnProducer(MeshChurnProducer):
+    """MeshChurnProducer that keeps the NetTruth registry in sync:
+    creates register (the admit hook handles that), deletes mark the
+    truth so the reader answers "gone" and the relist excludes them."""
+
+    def __init__(self, *a, truth=None, **kw):
+        super().__init__(*a, **kw)
+        self.truth = truth
+
+    def _delete_some(self, n: int) -> None:
+        for _ in range(n):
+            if not self.bound_backlog:
+                return
+            key, node = self.bound_backlog.pop(0)
+            self.truth.delete(key)
+            ns, pname = key.split("/", 1)
+            gone = make_pod(pname, namespace=ns, cpu_milli=POD_CPU,
+                            memory=POD_MEM, node_name=node)
+            with self.lock:
+                self.sched.on_pod_delete(gone)
+            if self.hub is not None:
+                self.hub.publish(("DELETED", key))
+            self.deleted += 1
+
+
+def run_net_chaos_arm(rate: float, duration: float, n_nodes: int,
+                      warm_buckets, serving_cfg: ServingConfig,
+                      mesh: int, bind_timeout_rate: float = 0.03,
+                      bind_error_rate: float = 0.02,
+                      get_timeout_rate: float = 0.05,
+                      dup_rate: float = 0.08,
+                      reorder_rate: float = 0.15,
+                      drop_rate: float = 0.02,
+                      storm_frac: float = 0.5,
+                      audit_interval_s: float = 0.5) -> dict:
+    """Sustained churn through the composed serving runtime (on the
+    mesh) while the NETWORK misbehaves: a configured fraction of bind
+    RPCs times out ambiguously (the truth may have committed — the
+    read-your-write protocol must adopt, never re-bind), bind
+    confirmations relay back duplicated/reordered/occasionally dropped,
+    and one mid-run RELIST STORM re-delivers the whole truth at once
+    (scheduler.reconcile — which also heals any dropped
+    confirmations well inside the assume TTL). The ServingRuntime's
+    state-conservation auditor sweeps at ``audit_interval_s``; the arm
+    ends with a settled truth-mode double-audit. The acceptance bar:
+    zero double-bind attempts, zero invariant violations, every created
+    pod bound, zero retraces."""
+    import random as _random
+
+    from kubernetes_tpu.config import ObservabilityConfig, ParallelConfig
+    from kubernetes_tpu.faults import FaultInjector
+    from kubernetes_tpu.serving import ServingRuntime as _SR
+
+    injector = FaultInjector(seed=7)
+    injector.arm("rpc:bind", "rpc_timeout", rate=bind_timeout_rate)
+    injector.arm("rpc:bind", "rpc_error", rate=bind_error_rate)
+    injector.arm("rpc:get", "rpc_timeout", rate=get_timeout_rate)
+    injector.arm("watch:event", "duplicate", rate=dup_rate)
+    injector.arm("watch:event", "drop", rate=drop_rate)
+    injector.arm("watch:batch", "reorder", rate=reorder_rate)
+    truth = NetTruth(injector)
+    binder = truth.binder()
+    kw = {}
+    if mesh:
+        kw["parallel"] = ParallelConfig(mesh=mesh)
+    sched = Scheduler(
+        enable_preemption=False,
+        solver="batch",
+        binder=binder,
+        pod_reader=truth.reader(),
+        observability=ObservabilityConfig(
+            audit_interval_s=audit_interval_s),
+        warmup=WarmupConfig(enabled=True,
+                            pod_buckets=tuple(warm_buckets)),
+        **kw,
+    )
+    for i in range(n_nodes):
+        sched.on_node_add(make_node(f"node-{i}", cpu_milli=64000,
+                                    memory=256 * 2**30, pods=500))
+    rt = _SR(sched, serving_cfg)
+    t0w = time.monotonic()
+    compiled = rt.warm_if_pending(
+        sample_pods=[make_pod("warm-sample", cpu_milli=POD_CPU,
+                              memory=POD_MEM)])
+    warm_s = time.monotonic() - t0w
+    prod = NetChurnProducer(sched, rt.loop.lock, rate, duration,
+                            admit=truth.register, hub=rt.hub,
+                            name="net", truth=truth)
+    rng = _random.Random(7)
+    dropped_confirms: list = []  # keys to heal at the relist storm
+
+    def relay_binds(res):
+        """Bind confirmations fan back as watch MODIFIEDs through the
+        injected network: duplicated, reordered, occasionally dropped
+        (the relist storm re-delivers the dropped ones)."""
+        events = []
+        for key, node in res.assignments.items():
+            kind = injector.pick("watch:event")
+            if kind == "drop":
+                dropped_confirms.append(key)
+                continue
+            events.append((key, node))
+            if kind == "duplicate":
+                events.append((key, node))
+        if len(events) > 1 and injector.pick("watch:batch") == "reorder":
+            rng.shuffle(events)
+        for key, node in events:
+            ns, pname = key.split("/", 1)
+            old = make_pod(pname, namespace=ns, cpu_milli=POD_CPU,
+                           memory=POD_MEM)
+            new = make_pod(pname, namespace=ns, cpu_milli=POD_CPU,
+                           memory=POD_MEM, node_name=node)
+            rt.loop.ingest(sched.on_pod_update, old, new)
+
+    def on_cycle(res):
+        # relay the confirmations BEFORE publishing the result to the
+        # producer: on_cycle runs outside the ingest lock, so the
+        # producer could otherwise learn of a bind, delete the pod, and
+        # have the still-undelivered MODIFIED resurrect it — an
+        # ordering a real informer stream (DELETE after MODIFIED in
+        # resourceVersion order) can never produce
+        relay_binds(res)
+        for k in res.assignments:
+            rt.hub.publish(("BOUND", k))
+        prod.on_cycle(res)
+
+    rt.loop.on_cycle = on_cycle
+    stop = threading.Event()
+    loop_t = threading.Thread(target=rt.loop.run, args=(stop,),
+                              daemon=True)
+    storms = {"count": 0}
+
+    def relist_storm():
+        """The forced-410 analog: the WHOLE truth re-delivered at once
+        (reconcile = the Reflector's Replace pass), healing any dropped
+        confirmations — well inside the assume TTL."""
+        delay = duration * storm_frac
+        if stop.wait(delay):
+            return
+        # list the truth AT reconcile time, under the ingest lock — a
+        # snapshot taken at enqueue time goes stale against binds that
+        # commit before the lock is acquired, and reconcile would
+        # forget-and-requeue an already-committed bind (a double-bind
+        # attempt a real relist, always freshly served, cannot cause)
+        rt.loop.ingest(lambda: sched.reconcile(truth.list_pods()))
+        storms["count"] += 1
+
+    storm_t = threading.Thread(target=relist_storm, daemon=True)
+    t0 = time.monotonic()
+    loop_t.start()
+    storm_t.start()
+    prod.run()
+    # settle: the fault window CLOSES (a real outage ends too). With
+    # the injector disarmed, one relist resurfaces the pods the
+    # ambiguity protocol sent to the unschedulable queue (its 60-second
+    # leftover flush outlives the bench window) and adopts every
+    # binding whose confirmation was dropped; the drain then converges
+    # the rest on a now-clean network. The acceptance bar (all bound,
+    # nothing leaked or parked, zero double binds, zero violations) is
+    # judged on this settled state — convergence-after-faults is the
+    # invariant, not convergence-despite-ongoing-faults-forever.
+    injector.rules.clear()
+    rt.loop.ingest(lambda: sched.reconcile(truth.list_pods()))
+    drained = drain(sched, timeout_s=30.0)
+    wall = time.monotonic() - t0
+    stop.set()
+    loop_t.join(timeout=10)
+    storm_t.join(timeout=5)
+    # settled truth-mode double-audit: the two-strike checks need their
+    # confirming pass on a stable state
+    final_violations = 0
+    with rt.loop.lock:
+        for _ in range(2):
+            final_violations += len(rt.auditor.audit(
+                sched, truth_pods=truth.list_pods()))
+    out = _mesh_summary(rt, prod, wall, compiled, warm_s, mesh)
+    ambiguous = binder.timeouts_committed + binder.timeouts_uncommitted
+    out.update({
+        "mode": "net_chaos",
+        "drained": drained,
+        "fault_rates": {
+            "bind_timeout": bind_timeout_rate,
+            "bind_error": bind_error_rate,
+            "get_timeout": get_timeout_rate,
+            "watch_duplicate": dup_rate,
+            "watch_reorder": reorder_rate,
+            "watch_drop": drop_rate,
+        },
+        "faults_fired": {f"{s}:{k}": n
+                         for (s, k), n in injector.fired.items()},
+        "ambiguous_bind_timeouts": ambiguous,
+        "timeouts_committed": binder.timeouts_committed,
+        "timeouts_uncommitted": binder.timeouts_uncommitted,
+        "bind_rpc_errors": binder.rpc_errors,
+        "ambiguous_frac_of_binds": round(
+            ambiguous / max(len(truth.bound), 1), 4),
+        "bind_ambiguous_resolutions": {
+            r: int(sched.metrics.bind_ambiguous.value(resolution=r))
+            for base in ("adopted", "requeued", "conflict", "gone",
+                         "deferred")
+            for r in (base, f"expired-{base}")
+            if sched.metrics.bind_ambiguous.value(resolution=r)
+        },
+        "double_bind_attempts": binder.double_bind_attempts,
+        "bound_truth": len(truth.bound),
+        "created": prod.created,
+        "relist_storms": storms["count"],
+        "dropped_confirmations": len(dropped_confirms),
+        "audits": rt.auditor.audits,
+        "invariant_violations": (rt.auditor.violations_total
+                                 if rt.auditor else -1),
+        "violations_recent": rt.auditor.report()["recent"],
+        "final_truth_audit_violations": final_violations,
+        "leaked_assumptions": len(sched.cache.assumed_keys()),
+        "parked_ambiguous": len(sched._ambiguous_binds),
+    })
+    return out
+
+
 class MiniTruth:
     """The hub's Binding subresource, miniaturized for the bench: a
     CAS'd shared truth both replicas bind through. A second bind of the
@@ -1297,6 +1615,46 @@ def _write_record(record: dict, out_path: str) -> None:
     print(f"wrote {out_path}", file=sys.stderr)
 
 
+def finish_net_record(record: dict, args) -> int:
+    """Criteria + write for the --net-chaos record (the network-fault
+    acceptance, ISSUE 15): faults demonstrably injected (ambiguous
+    timeouts on >= 1% of binds, watch duplicates AND reorders fired,
+    exactly one mid-run relist storm), yet zero bind RPCs reached the
+    truth for an already-bound pod, zero state-conservation violations
+    (runtime sweeps AND the settled truth-mode double-audit), every
+    created pod bound, nothing leaked or parked, zero retraces, and
+    the p99 create-to-bind still bounded under the fault load."""
+    nc = record["arms"].get("net_chaos") or {}
+    record["criteria"] = {
+        "net_no_double_binds": bool(
+            nc.get("double_bind_attempts", 1) == 0),
+        "net_zero_invariant_violations": bool(
+            nc.get("invariant_violations", 1) == 0
+            and nc.get("final_truth_audit_violations", 1) == 0
+            and nc.get("audits", 0) > 0),
+        "net_all_bound": bool(
+            nc.get("drained")
+            and nc.get("bound_truth", -1) == nc.get("created", -2)
+            and nc.get("leaked_assumptions", 1) == 0
+            and nc.get("parked_ambiguous", 1) == 0),
+        "net_ambiguous_rate_ok": bool(
+            nc.get("ambiguous_frac_of_binds", 0) >= 0.01),
+        "net_watch_fuzz_ok": bool(
+            nc.get("faults_fired", {}).get("watch:event:duplicate", 0) > 0
+            and nc.get("faults_fired", {}).get("watch:batch:reorder", 0)
+            > 0),
+        "net_relist_storm_ok": bool(nc.get("relist_storms", 0) >= 1),
+        "net_zero_retraces_ok": bool(
+            nc.get("retraces_total",
+                   nc.get("jax", {}).get("retraces", 1)) == 0),
+        "net_p99_bounded_ok": bool(nc.get("p99_s", 1e9) < 2.0),
+    }
+    _write_record(record, args.out)
+    print(json.dumps(record["criteria"], indent=1))
+    ok = all(record["criteria"].values()) and not record["errors"]
+    return 0 if ok else 1
+
+
 def finish_mesh_record(record: dict, args) -> int:
     """Criteria + write for the --mesh arm family (the composed
     serving-on-mesh acceptance): sustained rate held at the 5000-node
@@ -1381,6 +1739,15 @@ def main(argv=None) -> int:
                          "50ms with --mesh)")
     ap.add_argument("--cycle-interval", type=float, default=0.25,
                     help="the fixed arm's idle sleep (the legacy default)")
+    ap.add_argument("--net-chaos", action="store_true",
+                    help="network-chaos arm: serving on the mesh under "
+                         "ambiguous bind timeouts, fuzzed watch "
+                         "confirmations, and a mid-run relist storm, "
+                         "with the state-conservation auditor sweeping "
+                         "(record family churn_net_r*.json)")
+    ap.add_argument("--net-bind-timeout-rate", type=float, default=0.03,
+                    help="fraction of bind RPCs that time out "
+                         "ambiguously (the ISSUE bar is >= 0.01)")
     ap.add_argument("--incr-sweep", action="store_true",
                     help="incremental-solve cluster-size sweep: warm "
                          "(incremental) vs cold cells at each size, "
@@ -1399,16 +1766,21 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
+    if args.net_chaos and args.mesh == 0:
+        args.mesh = 2  # "serving on the mesh" — light 2-way default
     if args.nodes is None:
-        args.nodes = 5000 if args.mesh else 64
+        args.nodes = (512 if args.net_chaos
+                      else 5000 if args.mesh else 64)
     if args.rate is None:
-        args.rate = 300.0 if args.mesh else 500.0
+        args.rate = (200.0 if args.net_chaos
+                     else 300.0 if args.mesh else 500.0)
     if args.max_wait is None:
         args.max_wait = 0.05 if args.mesh else 0.02
     if args.out is None:
         args.out = os.path.join(
             REPO_ROOT, "benchres",
-            "churn_incr_r01.json" if args.incr_sweep
+            "churn_net_r01.json" if args.net_chaos
+            else "churn_incr_r01.json" if args.incr_sweep
             else "churn_mesh_r01.json" if args.mesh
             else "churn_r01.json")
     if args.smoke:
@@ -1450,7 +1822,8 @@ def main(argv=None) -> int:
         watch_buffer=1024 if args.mesh else 4096)
 
     record = {
-        "name": "churn_mesh" if args.mesh else "churn",
+        "name": ("churn_net" if args.net_chaos
+                 else "churn_mesh" if args.mesh else "churn"),
         "rate_ops_s": args.rate,
         "duration_s": args.duration,
         "nodes": args.nodes,
@@ -1471,7 +1844,14 @@ def main(argv=None) -> int:
     except Exception:
         pass
 
-    if args.mesh:
+    if args.net_chaos:
+        arm_plan = (
+            ("net_chaos", lambda: run_net_chaos_arm(
+                args.rate, args.duration, args.nodes, warm_buckets,
+                serving_cfg, args.mesh,
+                bind_timeout_rate=args.net_bind_timeout_rate)),
+        )
+    elif args.mesh:
         arm_plan = (
             ("serving", lambda: run_mesh_serving_arm(
                 args.rate, args.duration, args.nodes, warm_buckets,
@@ -1512,6 +1892,14 @@ def main(argv=None) -> int:
                       f"double_binds={a.get('double_bind_attempts')}",
                       file=sys.stderr)
                 continue
+            if name == "net_chaos":
+                print(f"    bound={a.get('bound_truth')}/"
+                      f"{a.get('created')} "
+                      f"ambiguous={a.get('ambiguous_bind_timeouts')} "
+                      f"double_binds={a.get('double_bind_attempts')} "
+                      f"violations={a.get('invariant_violations')} "
+                      f"p99={a.get('p99_s')}s", file=sys.stderr)
+                continue
             if name == "shard_loss":
                 print(f"    heal={a.get('shard_heal_s')}s "
                       f"host_cycles={a.get('host_mode_cycles')} "
@@ -1529,6 +1917,8 @@ def main(argv=None) -> int:
             traceback.print_exc()
             record["errors"].append(f"{name}: {e!r}")
 
+    if args.net_chaos:
+        return finish_net_record(record, args)
     if args.mesh:
         return finish_mesh_record(record, args)
     sv = record["arms"].get("serving") or {}

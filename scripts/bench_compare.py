@@ -79,6 +79,18 @@ stay inside the record's documented bound, and zero retraces + the
 absolute readback budget hold on every cell. Deltas (warm cycle cost,
 flatness ratio) need two records; the absolutes enforce on one.
 
+Network-fault gates (scripts/bench_churn.py --net-chaos records) ride
+the two newest ``benchres/churn_net_r*.json``: ABSOLUTE invariants on
+the new record alone (``double_bind_attempts == 0``,
+``invariant_violations == 0`` with the state-conservation auditor
+demonstrably running, every created pod bound with nothing left
+assumed or parked, the faults demonstrably injected — ambiguous bind
+timeouts on ≥ 1% of binds, watch duplicates AND reorders fired, ≥ 1
+relist storm — and zero retraces) plus delta gates on the bound p99
+create-to-bind UNDER FAULTS and the sustained creates/sec. Absence is
+tolerated — benchres directories predating the net-chaos arm keep
+passing.
+
 Perf-ledger gates (obs/ledger.py; the per-arm ``ledger`` block the
 churn bench records) enforce ABSOLUTE invariants on the newest
 ``churn_r*.json`` alone: the measured-vs-modeled ``model_efficiency``
@@ -183,6 +195,21 @@ def find_churn_incr_records(directory: str) -> List[str]:
                   key=round_key)
 
 
+def find_churn_net_records(directory: str) -> List[str]:
+    """churn_net_r*.json (scripts/bench_churn.py --net-chaos records)
+    sorted by round — the network-fault gate family's inputs. Absence
+    is tolerated: benchres directories predating the net-chaos arm keep
+    passing. Disjoint from find_churn_records by glob (churn_r* does
+    not match churn_net_r*)."""
+
+    def round_key(path: str) -> Tuple[int, str]:
+        m = re.search(r"churn_net_r(\d+)", os.path.basename(path))
+        return (int(m.group(1)) if m else -1, os.path.basename(path))
+
+    return sorted(glob.glob(os.path.join(directory, "churn_net_r*.json")),
+                  key=round_key)
+
+
 def find_scenario_records(directory: str) -> List[str]:
     """scenario_r*.json (scripts/bench_scenarios.py records) sorted by
     round — the scenario quality-gate family's inputs. Absence is
@@ -220,6 +247,28 @@ def _num(x) -> Optional[float]:
     except (TypeError, ValueError):
         return None
     return v if v == v else None  # NaN -> None
+
+
+def _delta_check(checks: list, regressions: list, warnings: list,
+                 threshold: float, name: str, prev_v, cur_v,
+                 lower_is_better: bool = False) -> None:
+    """Two-record delta gate row — the shared body of the per-family
+    ``check()`` closures (the delta twin of :func:`_absolute_check`).
+    Bind per family with ``check = partial(_delta_check, checks,
+    regressions, warnings, threshold)``. New families use this instead
+    of growing another verbatim closure copy."""
+    pv, cv = _num(prev_v), _num(cur_v)
+    if pv is None or cv is None or pv <= 0:
+        warnings.append(f"{name}: not comparable "
+                        f"(prev={prev_v!r}, cur={cur_v!r})")
+        return
+    delta = (cv - pv) / pv
+    bad = delta > threshold if lower_is_better else delta < -threshold
+    row = {"check": name, "prev": pv, "cur": cv,
+           "delta_frac": round(delta, 4), "regressed": bad}
+    checks.append(row)
+    if bad:
+        regressions.append(row)
 
 
 def compare(prev: dict, cur: dict, threshold: float,
@@ -785,6 +834,88 @@ def compare_churn_incr(prev: dict, cur: dict, threshold: float,
             "warnings": warnings}
 
 
+def compare_churn_net(prev: dict, cur: dict, threshold: float) -> dict:
+    """Network-fault gates over churn_net_r*.json records (pure,
+    unit-tested; absence-tolerant) — the correctness-under-network-
+    chaos contract (docs/robustness.md "Network faults & the bind
+    ambiguity protocol"):
+
+    - ABSOLUTE invariants on the NEW record alone (one record is
+      enough): ``double_bind_attempts == 0`` (no bind RPC ever reached
+      the truth for an already-bound pod, the never-double-place
+      invariant), ``invariant_violations == 0`` AND the settled
+      truth-mode double-audit clean with the auditor demonstrably
+      running (``audits > 0``), every created pod bound with nothing
+      left assumed or parked, faults demonstrably injected (ambiguous
+      timeouts on >= 1% of binds, watch duplicates and reorders fired,
+      >= 1 relist storm), and zero retraces;
+    - delta gates (need two records): the bound p99 create-to-bind
+      UNDER FAULTS and the sustained creates/sec must not regress.
+
+    Absent sections are warnings, never failures — same posture as
+    every other gate family."""
+    checks, regressions, warnings = [], [], []
+    check = partial(_delta_check, checks, regressions, warnings,
+                    threshold)
+    absolute = partial(_absolute_check, checks, regressions)
+
+    nc = (cur.get("arms") or {}).get("net_chaos") or {}
+    if not nc:
+        warnings.append("netchaos: no net_chaos arm in the new record")
+        return {"checks": checks, "regressions": regressions,
+                "warnings": warnings}
+    dbl = _num(nc.get("double_bind_attempts"))
+    if dbl is not None:
+        absolute("netchaos.double_bind_attempts", dbl, dbl > 0)
+    viol = _num(nc.get("invariant_violations"))
+    fviol = _num(nc.get("final_truth_audit_violations"))
+    audits = _num(nc.get("audits")) or 0
+    if viol is not None:
+        absolute("netchaos.invariant_violations", viol,
+                 viol > 0 or audits <= 0)
+    if fviol is not None:
+        absolute("netchaos.final_truth_audit_violations", fviol,
+                 fviol > 0)
+    bound_ok = (nc.get("drained")
+                and nc.get("bound_truth", -1) == nc.get("created", -2)
+                and not nc.get("leaked_assumptions")
+                and not nc.get("parked_ambiguous"))
+    absolute("netchaos.all_bound", 1.0 if bound_ok else 0.0,
+             not bound_ok)
+    amb = _num(nc.get("ambiguous_frac_of_binds"))
+    if amb is not None:
+        # a clean run with no faults injected proves nothing — the
+        # record must show the network actually misbehaved
+        absolute("netchaos.ambiguous_frac_of_binds", amb, amb < 0.01)
+    fired = nc.get("faults_fired") or {}
+    fuzz_ok = (fired.get("watch:event:duplicate", 0) > 0
+               and fired.get("watch:batch:reorder", 0) > 0)
+    absolute("netchaos.watch_fuzz_fired", 1.0 if fuzz_ok else 0.0,
+             not fuzz_ok)
+    storms = _num(nc.get("relist_storms"))
+    if storms is not None:
+        absolute("netchaos.relist_storms", storms, storms < 1)
+    rt = _num(nc.get("retraces_total",
+                     (nc.get("jax") or {}).get("retraces")))
+    if rt is not None:
+        absolute("netchaos.retraces", rt, rt > 0)
+    # delta gates — latency and throughput UNDER FAULTS must not erode
+    pnc = (prev.get("arms") or {}).get("net_chaos") or {}
+    if pnc:
+        check("netchaos.p99_s", pnc.get("p99_s"), nc.get("p99_s"),
+              lower_is_better=True)
+        check("netchaos.creates_per_sec", pnc.get("creates_per_sec"),
+              nc.get("creates_per_sec"))
+    for rec, label in ((prev, "prev"), (cur, "cur")):
+        errs = rec.get("errors") or []
+        if errs:
+            warnings.append(f"{label} churn_net record carries "
+                            f"{len(errs)} error(s); affected sections "
+                            "may be absent")
+    return {"checks": checks, "regressions": regressions,
+            "warnings": warnings}
+
+
 #: churn arms with no chaos / no deliberate overload: an SLO burn
 #: there is a regression, not an experiment outcome
 LEDGER_CLEAN_ARMS = ("serving", "fixed")
@@ -876,6 +1007,12 @@ GATE_FAMILIES = [
      "perf ledger: per-arm measured-vs-modeled model_efficiency p50 "
      "above the floor, SLO burns == 0 on clean arms, phase-attribution "
      "shares sum sane (new record alone)"),
+    ("netchaos", "churn_net_r*.json",
+     "network chaos: double_bind_attempts==0 and invariant_violations"
+     "==0 absolutes with the auditor demonstrably running, all pods "
+     "bound with nothing leaked/parked, faults demonstrably injected "
+     "(ambiguous binds >= 1%, watch dup+reorder, >= 1 relist storm), "
+     "zero retraces; p99-under-faults + creates/sec deltas"),
 ]
 
 
@@ -1045,6 +1182,34 @@ def main(argv=None) -> int:
         verdict["warnings"].extend(scv["warnings"])
         verdict["scenario_records"] = [
             os.path.relpath(p, REPO_ROOT) for p in sc_found[-2:]]
+    # network-fault gates (scripts/bench_churn.py --net-chaos records)
+    # — absence tolerated so benchres directories predating the
+    # net-chaos arm keep passing; a single record still enforces every
+    # absolute invariant (double binds, auditor violations, all bound,
+    # faults demonstrably injected, zero retraces)
+    cn_found = find_churn_net_records(args.dir)
+    if cn_found:
+        try:
+            cn_prev = load(cn_found[-2]) if len(cn_found) >= 2 else {}
+            cn_cur = load(cn_found[-1])
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot load churn_net records: {e}",
+                  file=sys.stderr)
+            return 2
+        cnv = compare_churn_net(cn_prev, cn_cur, args.threshold)
+        if len(cn_found) < 2:
+            verdict["warnings"].append(
+                "only one churn_net record — delta gates need two to "
+                "compare (the absolute invariants still apply)")
+            cnv["checks"] = [r for r in cnv["checks"]
+                             if r["prev"] is None]
+            cnv["regressions"] = [r for r in cnv["checks"]
+                                  if r["regressed"]]
+        verdict["checks"].extend(cnv["checks"])
+        verdict["regressions"].extend(cnv["regressions"])
+        verdict["warnings"].extend(cnv["warnings"])
+        verdict["churn_net_records"] = [
+            os.path.relpath(p, REPO_ROOT) for p in cn_found[-2:]]
     # incremental-solve gates (scripts/bench_churn.py --incr-sweep
     # records) — absence tolerated so benchres directories predating the
     # incremental mode keep passing; a single record still enforces the
@@ -1112,7 +1277,8 @@ def main(argv=None) -> int:
     # a single churn record is still gateable: the ledger family's
     # checks are absolute (new record alone)
     if prev_path is None and not churn_found and not mesh_found \
-            and not cm_found and not sc_found and not ci_found:
+            and not cm_found and not sc_found and not ci_found \
+            and not cn_found:
         msg = (f"not enough records in {args.dir} — nothing to gate")
         if args.format == "json":
             print(json.dumps({"status": "skipped", "reason": msg}))
