@@ -232,6 +232,8 @@ class ScenarioConfigurationV1alpha1:
     cascadeMaxPods: Optional[int] = None
     superpod: Optional[int] = None
     quality: Optional[bool] = None
+    repackInterval: Optional[str] = None  # duration; "0s" = off
+    repackMaxPods: Optional[int] = None
 
 
 @dataclass
@@ -504,6 +506,10 @@ def set_defaults_kube_scheduler_configuration(
         sn.superpod = 4
     if sn.quality is None:
         sn.quality = True
+    if sn.repackInterval is None:
+        sn.repackInterval = "0s"
+    if sn.repackMaxPods is None:
+        sn.repackMaxPods = 64
     return obj
 
 
@@ -634,6 +640,9 @@ def _scenario_to_internal(sn: ScenarioConfigurationV1alpha1):
         cascade_max_pods=sn.cascadeMaxPods,
         superpod=sn.superpod,
         quality=sn.quality,
+        repack_interval_s=_dur("repackInterval", sn.repackInterval,
+                               "scenario"),
+        repack_max_pods=sn.repackMaxPods,
     )
 
 
@@ -917,6 +926,8 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
             cascadeMaxPods=c.scenario.cascade_max_pods,
             superpod=c.scenario.superpod,
             quality=c.scenario.quality,
+            repackInterval=format_duration(c.scenario.repack_interval_s),
+            repackMaxPods=c.scenario.repack_max_pods,
         ),
     )
 

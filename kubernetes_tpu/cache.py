@@ -177,6 +177,17 @@ class SchedulerCache:
     def pod_count(self) -> int:
         return sum(len(m) for m in self._pods_by_node.values())
 
+    def group_members(self, group: str) -> int:
+        """Count of cached pods (assumed or bound) carrying
+        ``pod_group == group`` — the gang gate's credit for members
+        placed in EARLIER cycles. Without it a gang member whose bind
+        failed transiently re-queues ALONE and can never satisfy
+        minMember from inside its own batch: the group reads
+        incomplete forever while its siblings run (a livelock, not a
+        guard)."""
+        return sum(1 for m in self._pods_by_node.values()
+                   for p in m.values() if p.pod_group == group)
+
     def pod(self, key: str) -> Optional[Pod]:
         node = self._pod_node.get(key)
         if node is None:

@@ -321,6 +321,46 @@ class MeshChaos:
         }
 
 
+#: the sites the composed network-fault load arms — the disarm half of
+#: the phase window removes exactly these, leaving any other rules
+#: (shard bursts, crash plans) untouched
+NET_FAULT_SITES = ("rpc:bind", "rpc:get", "watch:event", "watch:batch")
+
+
+def arm_net_fault_load(injector, bind_timeout_rate: float = 0.10,
+                       bind_error_rate: float = 0.05,
+                       get_timeout_rate: float = 0.08,
+                       drop_rate: float = 0.04,
+                       dup_rate: float = 0.06,
+                       reorder_rate: float = 0.15) -> int:
+    """Arm the full network-fault load (ambiguous bind timeouts, bind
+    errors, read timeouts, watch drop/duplicate/reorder) on an EXISTING
+    injector — the phase-scoped entry half of the window a soak phase
+    opens; :func:`disarm_net_fault_load` is the exit half. A zero rate
+    skips its rule. Returns the number of rules armed."""
+    n0 = len(injector.rules)
+    if bind_timeout_rate > 0:
+        injector.arm("rpc:bind", "rpc_timeout", rate=bind_timeout_rate)
+    if bind_error_rate > 0:
+        injector.arm("rpc:bind", "rpc_error", rate=bind_error_rate)
+    if get_timeout_rate > 0:
+        injector.arm("rpc:get", "rpc_timeout", rate=get_timeout_rate)
+    if dup_rate > 0:
+        injector.arm("watch:event", "duplicate", rate=dup_rate)
+    if drop_rate > 0:
+        injector.arm("watch:event", "drop", rate=drop_rate)
+    if reorder_rate > 0:
+        injector.arm("watch:batch", "reorder", rate=reorder_rate)
+    return len(injector.rules) - n0
+
+
+def disarm_net_fault_load(injector) -> int:
+    """Close the network-fault window: remove every rule on the
+    :data:`NET_FAULT_SITES` sites (all kinds), whoever armed them.
+    Other sites' rules survive. Returns rules removed."""
+    return sum(injector.disarm(site) for site in NET_FAULT_SITES)
+
+
 def raise_injected_rpc(injector, site: str) -> None:
     """Roll the injector at a read/GET RPC site: raise the injected
     :class:`~kubernetes_tpu.faults.RPCError` / ``RPCTimeout``, or
@@ -489,18 +529,12 @@ class NetChaos:
 
         self.hub = hub
         inj = FaultInjector(seed=seed)
-        if bind_timeout_rate > 0:
-            inj.arm("rpc:bind", "rpc_timeout", rate=bind_timeout_rate)
-        if bind_error_rate > 0:
-            inj.arm("rpc:bind", "rpc_error", rate=bind_error_rate)
-        if get_timeout_rate > 0:
-            inj.arm("rpc:get", "rpc_timeout", rate=get_timeout_rate)
-        if dup_rate > 0:
-            inj.arm("watch:event", "duplicate", rate=dup_rate)
-        if drop_rate > 0:
-            inj.arm("watch:event", "drop", rate=drop_rate)
-        if reorder_rate > 0:
-            inj.arm("watch:batch", "reorder", rate=reorder_rate)
+        arm_net_fault_load(
+            inj, bind_timeout_rate=bind_timeout_rate,
+            bind_error_rate=bind_error_rate,
+            get_timeout_rate=get_timeout_rate,
+            drop_rate=drop_rate, dup_rate=dup_rate,
+            reorder_rate=reorder_rate)
         self.injector = inj
         self.binder = AmbiguousBinder(hub, inj)
 

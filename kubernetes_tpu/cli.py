@@ -282,6 +282,10 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         errs.append("scenario.cascadeMaxPods: must be at least 1")
     if sn.superpod < 1:
         errs.append("scenario.superpod: must be at least 1")
+    if sn.repack_interval_s < 0:
+        errs.append("scenario.repackInterval: must be non-negative")
+    if sn.repack_max_pods < 1:
+        errs.append("scenario.repackMaxPods: must be at least 1")
     # unknown feature gates are rejected earlier, at FeatureGates
     # construction (featuregate.Set errors on unknown names)
     return errs

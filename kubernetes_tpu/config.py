@@ -309,6 +309,14 @@ class WarmupConfig:
     #: as a retrace; the composed serving-on-mesh mode turns this on so
     #: shard loss mid-churn stays retrace-free end to end.
     host_fallback: bool = False
+    #: when preemption is enabled, ALSO warm the nominated-pods solve
+    #: variant: the cycle after a preemption carries a (P, N)
+    #: feasibility mask (podFitsOnNode pass A — nominated pods counted
+    #: onto their nodes), and ``extra_mask`` joins the solve's compile
+    #: key. Left unwarmed, the FIRST post-preemption cycle pays a
+    #: hot-path XLA compile and reads as a retrace — precisely when the
+    #: cluster is tightest on capacity.
+    nominated_variant: bool = True
 
 
 @dataclass
@@ -403,6 +411,16 @@ class ScenarioConfig:
     superpod: int = 4
     #: compute + read back the per-cycle placement-quality vector
     quality: bool = True
+    #: steady-state consolidation re-pack cadence (seconds; 0 = off,
+    #: the pre-soak behavior where consolidation acts only at
+    #: admission): every interval the scheduler drains the least-
+    #: utilized occupied nodes whose pods the rest of the cluster can
+    #: absorb and requeues them through the normal cycle, so sustained
+    #: churn cannot ratchet fragmentation up between admissions
+    repack_interval_s: float = 0.0
+    #: per-repack cap on drained pods (bounds one repack's requeue
+    #: burst; the cascade budget bounds the re-solve the same way)
+    repack_max_pods: int = 64
 
 
 @dataclass

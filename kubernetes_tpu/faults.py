@@ -357,6 +357,40 @@ class FaultInjector:
         return sum(n for (s, _), n in self.fired.items()
                    if fnmatch.fnmatch(s, site_pattern))
 
+    def disarm(self, site_pattern: str = "*",
+               kind: Optional[str] = None) -> int:
+        """Remove armed rules matching ``site_pattern`` (and ``kind``,
+        when given); returns how many were removed. The phase-scoped
+        fault window: a soak phase arms its rules at entry and disarms
+        exactly its own at exit, leaving any longer-lived rules (a
+        whole-soak background latency rule) in place — clearing
+        ``rules`` wholesale would close those too. Firing counters
+        survive disarm: per-phase deltas stay attributable."""
+        keep = [r for r in self.rules
+                if not (fnmatch.fnmatch(r.site, site_pattern)
+                        and (kind is None or r.kind == kind))]
+        removed = len(self.rules) - len(keep)
+        self.rules[:] = keep
+        return removed
+
+    def window(self, site: str, kind: str, **kw):
+        """Context manager: arm one rule on entry, disarm THAT rule on
+        exit (even on error) — the bracket a :class:`soak.SoakPhase`'s
+        arm/disarm hooks are built from."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _window():
+            self.arm(site, kind, **kw)
+            rule = self.rules[-1]
+            try:
+                yield rule
+            finally:
+                with contextlib.suppress(ValueError):
+                    self.rules.remove(rule)
+
+        return _window()
+
     def pick_rule(self, site: str,
                   kinds: Optional[Tuple[str, ...]] = None
                   ) -> Optional[FaultRule]:

@@ -127,7 +127,16 @@ class Counter(_Metric):
 class Gauge(Counter):
     kind = "gauge"
 
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        #: monotone write counter — freshness probes (soak sentinels)
+        #: need "was this gauge WRITTEN recently", and a value
+        #: fingerprint alone can't tell maintained-and-idle (depth set
+        #: back to 0 every cycle) from abandoned (nobody sets it)
+        self.writes = 0
+
     def set(self, value: float, **labels) -> None:
+        self.writes += 1
         self._values[self._key(labels)] = float(value)
 
 
@@ -577,6 +586,16 @@ class SchedulerMetrics:
             "scheduler_scenario_displaced_replaced_total",
             "Cascade victims that re-placed onto another node in the "
             "SAME cycle's dense re-solve (migrated rather than lost).",
+        ))
+        self.scenario_repacks = r.register(Counter(
+            "scheduler_scenario_repacks_total",
+            "Steady-state consolidation re-pack sweeps that drained at "
+            "least one pod (scenario.repackInterval cadence).",
+        ))
+        self.scenario_repack_drained = r.register(Counter(
+            "scheduler_scenario_repack_drained_total",
+            "Pods drained off under-utilized nodes by the steady-state "
+            "re-pack cadence and requeued for consolidation.",
         ))
         # -- schedulability explainer (obs/explain.py): the batched
         # why-pending reduction over the (pod x node) failure bitmask ---

@@ -226,6 +226,19 @@ class JaxTelemetry:
 
     # -- introspection ------------------------------------------------------
 
+    def signature_count(self, site: Optional[str] = None) -> int:
+        """Retained signature-LRU size — per site, or summed across all
+        sites. Locked: the soak sentinel samples from the maintenance
+        thread while record_call inserts on the scheduler thread. Each
+        per-site set is capped at ``signature_capacity``, so this total
+        is bounded by sites x capacity; the sentinel watches it anyway
+        because an unexpected NEW site minted per phase would still grow
+        it without bound."""
+        with self._lock:
+            if site is not None:
+                return len(self._seen.get(site, ()))
+            return sum(len(s) for s in self._seen.values())
+
     def snapshot(self) -> dict:
         """JSON-shaped view for /debug endpoints and the flight
         recorder; locked — the handler thread reads while the scheduler

@@ -394,7 +394,17 @@ class SLOWatchdog:
     probe still sheds on raw queue depth (``backend_pressure``'s base
     term) even after the degraded multiplier drops. Transitions emit
     events through the installed sink and count in ``burns`` so the
-    benches can assert clean arms stayed at zero."""
+    benches can assert clean arms stayed at zero.
+
+    Trips require FRESH evidence: only an evaluation riding an
+    ``_observe`` (a cycle just folded samples in) may flip an
+    objective to burning; the clock-driven re-evaluations (idle tick,
+    pressure probe) pass ``allow_trip=False`` and may only recover.
+    Without this, a quiet period after a loud one can page on stale
+    samples: as the fast window drains oldest-first, the violating
+    FRACTION of what remains can rise and cross the threshold with no
+    new traffic at all (the soak's clean window after the
+    network-fault phase caught exactly this flap)."""
 
     def __init__(self, config, clock: Callable[[], float] = time.monotonic,
                  metrics=None) -> None:
@@ -455,11 +465,13 @@ class SLOWatchdog:
                       scope: str) -> str:
         """Fold one cycle's evidence in, run the state machine, return
         the comma-joined burning-objective string for the records."""
+        observed = False
         with self._lock:
             if self.config.e2e_p99_objective_s > 0 and e2e_latencies:
                 target = self.config.e2e_p99_objective_s
                 bad = sum(1 for v in e2e_latencies if v > target)
                 self._observe("e2e_p99", t, bad, len(e2e_latencies))
+                observed = True
             if self.config.cost_drift_ratio > 0 and solve_s > 0:
                 scope = scope or "full"
                 base = self._baseline.get(scope)
@@ -467,6 +479,7 @@ class SLOWatchdog:
                 if base is not None and base > 0:
                     violated = solve_s > self.config.cost_drift_ratio * base
                     self._observe("cost_drift", t, int(violated), 1)
+                    observed = True
                 a = min(max(self.config.baseline_decay, 1e-6), 1.0)
                 if violated:
                     # slow the re-base 10x while violating: a step
@@ -480,12 +493,16 @@ class SLOWatchdog:
                     a *= 0.1
                 self._baseline[scope] = (solve_s if base is None
                                          else a * solve_s + (1 - a) * base)
-        return self.evaluate(t)
+        # an eventful cycle that folded NOTHING in (no latencies, no
+        # solve) is clock, not evidence — recovery-only, like the ticks
+        return self.evaluate(t, allow_trip=observed)
 
-    def evaluate(self, now: float) -> str:
+    def evaluate(self, now: float, allow_trip: bool = True) -> str:
         """Run the state machine over both windows. Safe from ANY
         thread (locked); events emit after the lock drops so a sink
-        calling back into the ledger cannot deadlock."""
+        calling back into the ledger cannot deadlock.
+        ``allow_trip=False`` (the clock-driven callers) restricts the
+        machine to recovery — a burn never STARTS on window expiry."""
         burning: List[str] = []
         emissions: List[Tuple[str, str, str]] = []
         gauges: List[Tuple[float, str, str]] = []
@@ -501,7 +518,7 @@ class SLOWatchdog:
                 gauges.append((round(slow, 4), objective, "slow"))
                 was = self._burning.get(objective, False)
                 thr = self.config.burn_threshold
-                if not was and fast >= thr and slow >= thr:
+                if not was and allow_trip and fast >= thr and slow >= thr:
                     self._burning[objective] = True
                     self.burns[objective] = (
                         self.burns.get(objective, 0) + 1)
@@ -626,15 +643,17 @@ class PerfLedger:
             now = self.clock()
             if now - self._last_probe_eval >= PRESSURE_EVAL_INTERVAL_S:
                 self._last_probe_eval = now
-                self.watchdog.evaluate(now)
+                self.watchdog.evaluate(now, allow_trip=False)
         return self.watchdog.burning()
 
     def tick(self) -> None:
         """Idle-path evaluation (Scheduler.idle_tick): keep the
         burn-rate windows — and the recovery transition — live while no
-        eventful cycle arrives to run observe_cycle."""
+        eventful cycle arrives to run observe_cycle. Recovery only
+        (``allow_trip=False``): idle window drainage must never START
+        a burn on stale samples."""
         if self.enabled and self.watchdog.objectives():
-            self.watchdog.evaluate(self.clock())
+            self.watchdog.evaluate(self.clock(), allow_trip=False)
 
     # -- per-cycle accounting ----------------------------------------------
 

@@ -51,7 +51,7 @@ from kubernetes_tpu.ops.predicates import run_predicates
 from kubernetes_tpu.ops.priorities import solver_gates
 from kubernetes_tpu.queue import SchedulingQueue
 from kubernetes_tpu.utils import klog
-from kubernetes_tpu.utils.interner import bucket_size
+from kubernetes_tpu.utils.interner import Interner, bucket_size
 
 
 @jax.jit
@@ -196,6 +196,7 @@ class Scheduler:
         max_preemptions_per_cycle: int = 16,
         pdb_lister: Optional[Callable[[], List]] = None,
         victim_deleter: Optional[Callable[[Pod], None]] = None,
+        repack_evictor: Optional[Callable[[Pod], None]] = None,
         framework=None,
         pred_mask: Optional[int] = None,
         extenders=(),
@@ -526,6 +527,15 @@ class Scheduler:
         #: A hub integration instead posts the delete and lets the watch
         #: remove it, keeping the victim visible as terminating meanwhile.
         self.victim_deleter = victim_deleter
+        #: repack_evictor(pod): issue a steady-state re-pack drain for a
+        #: BOUND pod (scenario.repack_interval_s). Default: unbind
+        #: locally and requeue (sim-style, zero-grace). A hub
+        #: integration instead posts the unbind/delete+recreate and
+        #: lets the watch stream converge the local state.
+        self.repack_evictor = repack_evictor
+        #: clock of the last re-pack sweep; None = cadence not started
+        #: (the first interval elapses before the first drain)
+        self._last_repack_at: Optional[float] = None
         #: delayed-binding PVC lifecycle (volume_binder.go:30): assume at
         #: assume time, commit at bind time, roll back on any forget
         from kubernetes_tpu.volumes import VolumeBinder
@@ -693,8 +703,13 @@ class Scheduler:
             # FilteringResourceEventHandler turns this into a Delete, so
             # the stale spec must leave our queues (schedulerName is
             # immutable in the real API, but this ingestion surface takes
-            # arbitrary updates)
+            # arbitrary updates). Pod-keyed side state must leave with it
+            # or it outlives the pod (the soak sentinels watch exactly
+            # these dicts for monotonic growth)
             self.queue.delete(old.key())
+            self._cycle_states.pop(old.key(), None)
+            self.why_pending.pop(old.key(), None)
+            self._note_gone(old.key())
 
     def on_pod_delete(self, pod: Pod) -> None:
         key = pod.key()
@@ -871,6 +886,10 @@ class Scheduler:
             pod = self.cache.pod(key)
             self.cache.forget_pod(key)
             self.volume_binder.forget_pod_volumes(key)
+            # the per-attempt cycle state dies with the assumption: the
+            # requeued pod starts a fresh attempt, and a row kept here
+            # survives every later leadership flip (leak, sentinel-pinned)
+            self._cycle_states.pop(key, None)
             if pod is not None and self.responsible_for(pod):
                 self.queue.add_if_not_present(
                     _dc.replace(pod, node_name=""))
@@ -939,8 +958,12 @@ class Scheduler:
                         self.cache.add_pod(tp)
                         adopted += 1
                     # bound at the hub: whatever a stale queue thinks,
-                    # this pod must never be scheduled again here
+                    # this pod must never be scheduled again here — and
+                    # its pending-explanation row retires with it (the
+                    # normal bind paths pop it; adoption must too)
                     self.queue.delete(key)
+                    self.why_pending.pop(key, None)
+                    self._cycle_states.pop(key, None)
                 elif self.responsible_for(tp):
                     queued = self.queue.pod(key)
                     if (queued is not None and queued.uid == tp.uid) \
@@ -969,6 +992,12 @@ class Scheduler:
                         if p.key() not in truth:
                             self.queue.delete(p.key())
                             self._note_gone(p.key())
+                            # exit path parity with on_pod_delete: the
+                            # pod-keyed side state leaves with the pod,
+                            # or churn between relists grows it forever
+                            self._cycle_states.pop(p.key(), None)
+                            self.why_pending.pop(p.key(), None)
+                            self.cache.packer.forget_pod(p.key())
         # local convergence, truth or not: resweep parked pods (this
         # incarnation may have missed move events), rebuild the
         # device-resident snapshot from the host mirror, re-warm
@@ -1177,6 +1206,10 @@ class Scheduler:
         self.queue.tick()
         self._reap_expired_assumptions()
         self._verify_ambiguous_binds()
+        # cadence re-pack BEFORE the batch pops: pods drained here
+        # re-enter this same cycle's solve under the consolidation
+        # objective instead of waiting out another interval
+        self.maybe_repack()
         self._process_waiting(res)
         batch = self.queue.pop_batch(self.max_batch)
         if not batch:
@@ -1544,7 +1577,14 @@ class Scheduler:
                 gang_groups.setdefault(gp.pod_group, []).append(gi)
         for gname, idxs in gang_groups.items():
             need = max([batch[gi].pod_group_min_available for gi in idxs] + [0])
-            incomplete = len(idxs) < need or any(assigned[gi] < 0 for gi in idxs)
+            # members the cache already placed in EARLIER cycles count
+            # toward minMember: a member whose bind failed transiently
+            # re-queues ALONE, and crediting only batch-present members
+            # would park it at this gate forever (GangIncomplete every
+            # cycle) while its siblings run — a livelock, not a guard
+            placed = self.cache.group_members(gname)
+            incomplete = (len(idxs) + placed < need
+                          or any(assigned[gi] < 0 for gi in idxs))
             if incomplete:
                 for gi in idxs:
                     if assigned[gi] >= 0:
@@ -3510,6 +3550,99 @@ class Scheduler:
             self.metrics.scenario_quality.set(float(v), score=k)
             self._scenario_scores_seen.add(k)
 
+    def maybe_repack(self) -> int:
+        """Steady-state consolidation re-pack
+        (``scenario.repackInterval``): every interval, drain the pods
+        off the least-utilized FULLY-emptiable nodes — nodes holding
+        only this scheduler's bound, non-assumed, non-terminating pods,
+        whose load the rest of the occupied cluster can absorb — and
+        requeue them, so the next cycles' consolidation objective packs
+        them tight again. Admission-time consolidation alone ratchets:
+        sustained churn strands capacity on nodes that emptied BELOW
+        the pack's fill order after their pods bound, and nothing ever
+        revisits them. Bounded per sweep by ``scenario.repackMaxPods``;
+        returns the number of pods drained (0 off-cadence / packless).
+
+        Callers: the serving maintenance hook (between cycles, under
+        the loop lock) and :meth:`idle_tick` for the legacy loop."""
+        import dataclasses as _dc
+
+        interval = self.scenario.repack_interval_s
+        if interval <= 0 or self.scenario_pack is None:
+            return 0
+        now = self.clock()
+        if self._last_repack_at is None:
+            # cadence starts at first observation: a full interval of
+            # real churn elapses before the first drain
+            self._last_repack_at = now
+            return 0
+        if now - self._last_repack_at < interval:
+            return 0
+        self._last_repack_at = now
+        free: Dict[str, Tuple[float, int]] = {}
+        occupied = []
+        for nd in self.cache.nodes():
+            pods = self.cache.pods_on(nd.name)
+            used = sum(p.requests.cpu_milli for p in pods)
+            free[nd.name] = (nd.allocatable.cpu_milli - used,
+                             nd.allocatable.pods - len(pods))
+            if pods:
+                occupied.append(
+                    (used / max(nd.allocatable.cpu_milli, 1.0),
+                     nd.name, pods))
+        if len(occupied) < 2:
+            return 0  # nothing to consolidate INTO
+        occupied.sort(key=lambda t: (t[0], t[1]))
+        budget = max(self.scenario.repack_max_pods, 1)
+        emptied: set = set()
+        drained = 0
+        for _util, name, pods in occupied:
+            movable = [
+                p for p in pods
+                if self.responsible_for(p)
+                and not self.cache.is_assumed(p.key())
+                and not p.deletion_timestamp
+            ]
+            if len(movable) != len(pods):
+                continue  # foreign / in-flight pods pin the node
+            if not movable or len(movable) > budget - drained:
+                continue
+            need_cpu = sum(p.requests.cpu_milli for p in movable)
+            # feasibility heuristic only — the SOLVER places; this just
+            # avoids draining pods the remaining occupied nodes cannot
+            # possibly hold (they would bounce back, or worse, land on
+            # the node just emptied)
+            absorb_cpu = absorb_slots = 0
+            for _u2, n2, pods2 in occupied:
+                if n2 == name or n2 in emptied:
+                    continue
+                c, s = free[n2]
+                absorb_cpu += max(c, 0)
+                absorb_slots += max(s, 0)
+            if need_cpu > absorb_cpu or len(movable) > absorb_slots:
+                continue
+            for p in movable:
+                if self.repack_evictor is not None:
+                    # hub integration: post the unbind and let the
+                    # watch stream converge local state
+                    self.repack_evictor(p)
+                else:
+                    self.cache.remove_pod(p.key())
+                    self.queue.add_if_not_present(_dc.replace(
+                        p, node_name="", deletion_timestamp=0.0))
+            emptied.add(name)
+            drained += len(movable)
+            if drained >= budget:
+                break
+        if drained:
+            self.metrics.scenario_repacks.inc()
+            self.metrics.scenario_repack_drained.inc(drained)
+            self.queue.move_all_to_active()
+            klog.V(2).info(
+                "steady-state re-pack: drained %d pods off %d nodes",
+                drained, len(emptied))
+        return drained
+
     def _cascade_pad(self, n: int) -> int:
         """Pod-bucket for a cascade re-solve. With warmup on, snap UP
         to a bucket the warm sweep covered (the smallest explicit
@@ -3939,10 +4072,51 @@ class Scheduler:
             jax.block_until_ready(
                 quality_reduce(pad_a, wu_usage.requested, dp, dn))
         jax.block_until_ready(a)
+        fr_mask = None
         if wu.include_filter:
             fr = _filter_pass(dp, dn, ds, dt, dv, sv,
                               self.pred_mask)
             jax.block_until_ready(fr.mask)
+            fr_mask = fr.mask
+        if wu.nominated_variant and self.enable_preemption:
+            # the nominated-pods variant (podFitsOnNode pass A): the
+            # cycle after a preemption feeds a (P, N) feasibility mask
+            # and ``extra_mask is None`` flips in the solve digest — a
+            # DIFFERENT compiled program. Warm it here or the first
+            # post-preemption cycle pays the compile on the hot path
+            # (and the stall can blow the lease-freshness fence, turning
+            # one preemption into fenced binds). The mask comes from the
+            # same filter pass the real nominated path runs, so dtype,
+            # shape, and sharding match the live signature exactly.
+            if fr_mask is None:
+                fr_mask = _filter_pass(dp, dn, ds, dt, dv, sv,
+                                       self.pred_mask).mask
+            self.obs.jax.record_call(
+                "solve", dp, dn, ds, dt, dv,
+                static=statics[:8] + (False,) + statics[9:],
+                warmup=True)
+            if solver == "greedy":
+                a_m, _ = greedy_assign(
+                    dp, dn, ds, self.weights, topo=dt,
+                    extra_mask=fr_mask, vol=dv, static_vol=sv,
+                    enabled_mask=self.pred_mask, extra_score=extra_score,
+                    skip_priorities=skip_prio, no_ports=no_ports,
+                    no_pod_affinity=no_pod_aff, no_spread=no_spread,
+                )
+            else:
+                out_m = batch_assign(
+                    dp, dn, ds, self.weights,
+                    max_rounds=self.max_rounds,
+                    per_node_cap=self.per_node_cap, topo=dt,
+                    extra_mask=fr_mask, vol=dv, static_vol=sv,
+                    enabled_mask=self.pred_mask, extra_score=extra_score,
+                    use_sinkhorn=(solver == "sinkhorn"),
+                    skip_priorities=skip_prio, no_ports=no_ports,
+                    no_pod_affinity=no_pod_aff, no_spread=no_spread,
+                    stats_out=self.obs.config.sinkhorn_telemetry,
+                )
+                a_m = out_m[0]
+            jax.block_until_ready(a_m)
         self.metrics.warmup_compiles.inc()
         return 1
 
@@ -4190,6 +4364,7 @@ class Scheduler:
         self.queue.tick()
         self._reap_expired_assumptions()
         self._verify_ambiguous_binds()
+        self.maybe_repack()
         # keep the SLO burn-rate windows (and the recovery transition)
         # live while idle — eventful cycles may never come to run the
         # watchdog's state machine after the queue drains
@@ -4201,6 +4376,48 @@ class Scheduler:
             # reach the metrics (the cycle path records via
             # _record_metrics; the idle path owns that here)
             self._record_metrics(res)
+
+    def state_sizes(self) -> Dict[str, int]:
+        """Sizes of every unbounded-unless-maintained structure this
+        scheduler owns — the leak-sentinel surface (soak.SoakSentinels).
+        Pure dict-length reads: cheap enough for a maintenance-cadence
+        sample, and safe under the serving loop's lock (the soak calls
+        it from the maintenance hook, which already holds it). Keys are
+        stable: the soak record and /debug/soak serialize them as-is,
+        and the flatness gate in bench_compare diffs them by name."""
+        packer = self.cache.packer
+        u = packer.u
+        interned = sum(
+            len(v) for v in vars(u).values() if isinstance(v, Interner))
+        return {
+            # per-pod side state — exit paths must pop these
+            "why_pending": len(self.why_pending),
+            "ambiguous_binds": len(self._ambiguous_binds),
+            "cycle_states": len(self._cycle_states),
+            "waiting_pods": len(self.framework.waiting),
+            # bounded-by-construction state — watched anyway, because a
+            # bound that silently stopped binding is exactly what only
+            # a soak catches
+            "breakers": len(self._breakers),
+            "explain_reasons_seen": len(self._explain_reasons_seen),
+            "sk_warm_potentials": 0 if self._sk_warm_pot is None else 1,
+            "queue_pending": sum(self.queue.pending_counts().values()),
+            "cache_assumed": len(self.cache.assumed_keys()),
+            "cache_pods": self.cache.pod_count(),
+            # packer per-pod caches (forget_pod-cleaned) + LRU memos
+            "packer_pod_refs": len(packer._pod_refs),
+            "packer_vol_cache": len(packer._vol_cache),
+            "packer_vol_pods": len(packer._vol_pods),
+            "packer_vec_cache": len(packer._vec_cache),
+            "packer_pod_table_memo": len(packer._pod_table_memo),
+            "packer_vol_table_memo": len(packer._vol_table_memo),
+            # interner dedupe floors: grow with VOCABULARY (distinct
+            # labels/images/selectors), not with churn — a churn-shaped
+            # slope here means something interns per-pod-unique tokens
+            "interned_items": interned,
+            "universe_matcher_memo": len(u._matcher_row_memo),
+            "universe_owner_sets_memo": len(u._owner_sets_memo),
+        }
 
     def run_until_settled(self, max_cycles: int = 50) -> List[CycleResult]:
         """Drive cycles until nothing schedules (tests + sim harness)."""

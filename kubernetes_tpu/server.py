@@ -338,6 +338,20 @@ def serve_scheduler(
                     self._respond(
                         200, json.dumps(ledger.snapshot()).encode(),
                         "application/json")
+            elif self.path == "/debug/soak":
+                # the day-in-the-life soak engine (soak.py), attached
+                # via SoakEngine.attach(sched): current phase, per-
+                # phase verdicts so far, live sentinel snapshot.
+                # status() is thread-safe like /debug/ledger — the
+                # soak thread keeps phasing while this serializes.
+                soak = getattr(sched, "soak", None)
+                if soak is None:
+                    self._respond(404, b"no soak engine attached",
+                                  "text/plain")
+                else:
+                    self._respond(
+                        200, json.dumps(soak.status()).encode(),
+                        "application/json")
             elif self.path.split("?", 1)[0] == "/debug/why":
                 code, doc = why_payload(sched, self.path)
                 self._respond(code, json.dumps(doc).encode(),

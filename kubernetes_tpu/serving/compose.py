@@ -136,7 +136,25 @@ class ServingRuntime:
             from kubernetes_tpu.obs.audit import StateAuditor
 
             self.auditor = sched.attach_auditor(StateAuditor())
-            self.loop.maintenance = self.maybe_audit
+            self.add_maintenance(self.maybe_audit)
+
+    def add_maintenance(self, fn: Callable[[], object]) -> Callable:
+        """CHAIN a per-iteration maintenance hook onto the serving loop
+        (run between run_once iterations, never mid-cycle). Chaining —
+        not assignment — is the contract: the audit sweep, the soak
+        engine's sentinel cadence, and a bench's own probe must
+        compose on one runtime without knowing about each other (the
+        same prev-then-ours idiom attach_elector uses for leadership
+        callbacks). Hooks run in attachment order. Returns ``fn``."""
+        prev = self.loop.maintenance
+
+        def chained() -> None:
+            if prev is not None:
+                prev()
+            fn()
+
+        self.loop.maintenance = chained
+        return fn
 
     def maybe_audit(self) -> int:
         """The low-frequency state-conservation sweep: run the

@@ -35,6 +35,7 @@ import heapq
 import random
 import threading
 import weakref
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -3138,8 +3139,21 @@ class Reflector:
                 seed=random.SystemRandom().randrange(1 << 30))
         self._relist_backoff = relist_backoff
         self._cursor_wrap = cursor_wrap
-        #: per-object last DELIVERED revision (the dedupe floor)
+        #: per-object last DELIVERED revision (the dedupe floor) —
+        #: LIVE objects only; deleted objects move to the tombstone LRU
         self._obj_rev: Dict[str, int] = {}
+        #: dedupe floors for objects DELETED since the last relist,
+        #: kept apart from the live map and LRU-bounded: between
+        #: relists every churned-away pod would otherwise keep a floor
+        #: entry forever (growth ∝ total churn — the soak sentinel's
+        #: original finding). The floor cannot simply be dropped at the
+        #: DELETE: a reordered stale MODIFIED arriving after it would
+        #: resurrect the object. Evicting the OLDEST tombstone only
+        #: narrows that reorder-protection window to the most recent
+        #: ``tombstone_capacity`` deletions — the same bounded-window
+        #: trade the jaxtel signature LRU makes.
+        self._gone_rev: "OrderedDict[str, int]" = OrderedDict()
+        self.tombstone_capacity = 4096
         #: duplicated / reordered-stale events dropped as no-ops
         self.deduped = 0
         #: relists forced by the progress deadline (stalled watch)
@@ -3209,6 +3223,9 @@ class Reflector:
         # under sustained create/delete churn would otherwise leak)
         self._obj_rev = {f"nodes/{n}": rev for n in nodes}
         self._obj_rev.update({f"pods/{k}": rev for k in pods})
+        # tombstones compact with the floor: the fresh cursor starts AT
+        # rev, so no stale frame for a dead object can arrive either
+        self._gone_rev.clear()
         cur = self.hub.watch(rev)
         if self._cursor_wrap is not None:
             cur = self._cursor_wrap(cur)
@@ -3282,14 +3299,30 @@ class Reflector:
                 self.list_and_watch()
                 return 1
         for rev, obj_key, etype, obj in events:
-            if rev <= self._obj_rev.get(obj_key, 0):
+            floor = self._obj_rev.get(obj_key)
+            if floor is None:
+                floor = self._gone_rev.get(obj_key, 0)
+            if rev <= floor:
                 # duplicate or reordered-stale frame: the object already
                 # reflects a revision at/after this one — a no-op by the
                 # resourceVersion-monotonic rule (NEVER re-applied: a
                 # stale MODIFIED after a DELETE would resurrect)
                 self.deduped += 1
                 continue
-            self._obj_rev[obj_key] = rev
+            if etype == "DELETED":
+                # the floor migrates to the bounded tombstone LRU: live
+                # map stays sized to the live set, yet a reordered
+                # stale MODIFIED still dedupes against the delete's rev
+                self._obj_rev.pop(obj_key, None)
+                self._gone_rev.pop(obj_key, None)
+                self._gone_rev[obj_key] = rev
+                while len(self._gone_rev) > self.tombstone_capacity:
+                    self._gone_rev.popitem(last=False)
+            else:
+                # a frame PAST the tombstone is a recreation (the hub
+                # mints monotonic revs): the object is live again
+                self._gone_rev.pop(obj_key, None)
+                self._obj_rev[obj_key] = rev
             kind, _, ident = obj_key.partition("/")
             if kind not in ("nodes", "pods"):
                 # the history is shared across kinds (events, services,

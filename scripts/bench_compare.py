@@ -224,6 +224,20 @@ def find_scenario_records(directory: str) -> List[str]:
                   key=round_key)
 
 
+def find_soak_records(directory: str) -> List[str]:
+    """soak_r*.json (scripts/bench_soak.py records) sorted by round —
+    the day-in-the-life soak gate family's inputs. Absence is
+    tolerated: benchres directories predating the soak harness keep
+    passing."""
+
+    def round_key(path: str) -> Tuple[int, str]:
+        m = re.search(r"soak_r(\d+)", os.path.basename(path))
+        return (int(m.group(1)) if m else -1, os.path.basename(path))
+
+    return sorted(glob.glob(os.path.join(directory, "soak_r*.json")),
+                  key=round_key)
+
+
 def load(path: str) -> dict:
     with open(path, "r", encoding="utf-8") as fh:
         return json.load(fh)
@@ -916,6 +930,97 @@ def compare_churn_net(prev: dict, cur: dict, threshold: float) -> dict:
             "warnings": warnings}
 
 
+def compare_soak(prev: dict, cur: dict, threshold: float) -> dict:
+    """Day-in-the-life soak gates over soak_r*.json records (pure,
+    unit-tested; absence-tolerant) — the everything-composes-and-
+    nothing-leaks contract (docs/robustness.md "Day-in-the-life
+    soak"):
+
+    - ABSOLUTE invariants on the NEW record alone (one record is
+      enough): the headline numbers as their own rows
+      (``double_bind_attempts == 0``, ``invariant_violations == 0``
+      with the auditor demonstrably running, ``retraces == 0``, the
+      intra-run p99 drift within its bound), plus EVERY ``soak_*``
+      criterion the driver computed — sentinel flatness over the
+      clean-phase boundary series, clean-phase counter deltas == 0
+      (SLO burns, fenced binds, preemptions), every chaos phase
+      demonstrably engaged (repack, preemption cascade, leader
+      takeover, shard heal, network faults), and all pods bound with
+      nothing leaked or parked at end of life;
+    - delta gates (need two records): the end-of-run traffic phase's
+      p99 and the sustained creates/sec must not regress run-over-run.
+
+    Absent sections are warnings, never failures — same posture as
+    every other gate family."""
+    checks, regressions, warnings = [], [], []
+    check = partial(_delta_check, checks, regressions, warnings,
+                    threshold)
+    absolute = partial(_absolute_check, checks, regressions)
+
+    sv = (cur.get("soak") or {}).get("verdict") or {}
+    if not sv:
+        warnings.append("soak: no soak verdict in the new record")
+        return {"checks": checks, "regressions": regressions,
+                "warnings": warnings}
+    # headline invariants as numeric rows — the gate table should show
+    # the VALUES, not just criterion booleans
+    dbl = _num(cur.get("double_bind_attempts"))
+    if dbl is not None:
+        absolute("soak.double_bind_attempts", dbl, dbl > 0)
+    viol = _num(cur.get("invariant_violations"))
+    audits = _num(cur.get("audits")) or 0
+    if viol is not None:
+        absolute("soak.invariant_violations", viol,
+                 viol > 0 or audits <= 0)
+    fviol = _num(cur.get("final_truth_audit_violations"))
+    if fviol is not None:
+        absolute("soak.final_truth_audit_violations", fviol, fviol > 0)
+    rt = _num(cur.get("retraces_total"))
+    if rt is not None:
+        absolute("soak.retraces", rt, rt > 0)
+    drift = _num(sv.get("p99_drift"))
+    if drift is not None:
+        absolute("soak.p99_drift", round(drift, 4),
+                 not sv.get("p99_drift_ok", False))
+    # every driver criterion is a gate row (soak_phases_ok carries the
+    # clean-phase burn==0 + gauge-freshness verdicts, soak_sentinels_
+    # flat the leak verdict, soak_*_engaged the phase-coverage proofs)
+    # — new criteria added to the driver land here without a
+    # bench_compare edit, so the soak contract cannot silently shrink
+    for name, ok in sorted((cur.get("criteria") or {}).items()):
+        absolute(f"soak.{name}", 1.0 if bool(ok) else 0.0, not ok)
+    leaking = sv.get("leaking") or []
+    if leaking:
+        warnings.append("soak: leaking sentinels: " + ", ".join(
+            str(x) for x in leaking))
+
+    def _phase_p99(rec: dict, name: str):
+        for ph in (rec.get("soak") or {}).get("phases") or []:
+            if ph.get("name") == name:
+                return (ph.get("probe") or {}).get("p99_s")
+        return None
+
+    # delta gates — end-of-life latency and sustained throughput must
+    # not erode run-over-run
+    if (prev.get("soak") or {}).get("verdict"):
+        check("soak.traffic2_p99_s", _phase_p99(prev, "traffic-2"),
+              _phase_p99(cur, "traffic-2"), lower_is_better=True)
+        pw, cw = _num(prev.get("wall_s")), _num(cur.get("wall_s"))
+        pc, cc = _num(prev.get("created")), _num(cur.get("created"))
+        if pw and cw:
+            check("soak.creates_per_sec",
+                  None if pc is None else pc / pw,
+                  None if cc is None else cc / cw)
+    for rec, label in ((prev, "prev"), (cur, "cur")):
+        errs = rec.get("errors") or []
+        if errs:
+            warnings.append(f"{label} soak record carries "
+                            f"{len(errs)} error(s); affected sections "
+                            "may be absent")
+    return {"checks": checks, "regressions": regressions,
+            "warnings": warnings}
+
+
 #: churn arms with no chaos / no deliberate overload: an SLO burn
 #: there is a regression, not an experiment outcome
 LEDGER_CLEAN_ARMS = ("serving", "fixed")
@@ -1013,6 +1118,14 @@ GATE_FAMILIES = [
      "bound with nothing leaked/parked, faults demonstrably injected "
      "(ambiguous binds >= 1%, watch dup+reorder, >= 1 relist storm), "
      "zero retraces; p99-under-faults + creates/sec deltas"),
+    ("soak", "soak_r*.json",
+     "day-in-the-life soak: sentinel flatness over clean-phase "
+     "boundaries, clean-phase counter deltas==0 (SLO burns, fenced "
+     "binds, preemptions), auditor violations==0, double binds==0, "
+     "zero retraces, intra-run p99 drift bound, every phase "
+     "demonstrably engaged (repack, cascade, takeover, shard heal, "
+     "net faults), all pods bound at end of life; traffic-2 p99 + "
+     "creates/sec deltas"),
 ]
 
 
@@ -1210,6 +1323,34 @@ def main(argv=None) -> int:
         verdict["warnings"].extend(cnv["warnings"])
         verdict["churn_net_records"] = [
             os.path.relpath(p, REPO_ROOT) for p in cn_found[-2:]]
+    # day-in-the-life soak gates (scripts/bench_soak.py records) —
+    # absence tolerated so benchres directories predating the soak
+    # harness keep passing; a single record still enforces every
+    # absolute invariant (sentinel flatness, clean-phase burns==0,
+    # violations==0, p99 drift bound, zero retraces, phase coverage)
+    sk_found = find_soak_records(args.dir)
+    if sk_found:
+        try:
+            sk_prev = load(sk_found[-2]) if len(sk_found) >= 2 else {}
+            sk_cur = load(sk_found[-1])
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot load soak records: {e}",
+                  file=sys.stderr)
+            return 2
+        skv = compare_soak(sk_prev, sk_cur, args.threshold)
+        if len(sk_found) < 2:
+            verdict["warnings"].append(
+                "only one soak record — delta gates need two to "
+                "compare (the absolute invariants still apply)")
+            skv["checks"] = [r for r in skv["checks"]
+                             if r["prev"] is None]
+            skv["regressions"] = [r for r in skv["checks"]
+                                  if r["regressed"]]
+        verdict["checks"].extend(skv["checks"])
+        verdict["regressions"].extend(skv["regressions"])
+        verdict["warnings"].extend(skv["warnings"])
+        verdict["soak_records"] = [
+            os.path.relpath(p, REPO_ROOT) for p in sk_found[-2:]]
     # incremental-solve gates (scripts/bench_churn.py --incr-sweep
     # records) — absence tolerated so benchres directories predating the
     # incremental mode keep passing; a single record still enforces the
@@ -1278,7 +1419,7 @@ def main(argv=None) -> int:
     # checks are absolute (new record alone)
     if prev_path is None and not churn_found and not mesh_found \
             and not cm_found and not sc_found and not ci_found \
-            and not cn_found:
+            and not cn_found and not sk_found:
         msg = (f"not enough records in {args.dir} — nothing to gate")
         if args.format == "json":
             print(json.dumps({"status": "skipped", "reason": msg}))

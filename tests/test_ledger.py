@@ -32,6 +32,7 @@ from kubernetes_tpu.config import (
 from kubernetes_tpu.obs.ledger import (
     CycleCostModel,
     PerfLedger,
+    SLOWatchdog,
     parse_batch_shape,
     phase_of,
 )
@@ -307,6 +308,42 @@ def test_burn_recovers_while_idle_without_eventful_cycles():
     clk.advance(120.0)
     assert s.backend_pressure(degraded_factor=4.0) == 1.0
     assert not s.obs.ledger.watchdog.burning()
+
+
+def test_burn_never_trips_on_stale_window_drainage():
+    """The soak's clean-window flap: after a loud phase, the fast
+    window drains oldest-first, so the violating FRACTION of what
+    remains can cross the threshold with zero new traffic (the good
+    bulk expires before a bad tail). The clock-driven evaluations
+    (idle tick, pressure probe, sample-free cycles) are recovery-only:
+    a burn may only START on fresh evidence."""
+    clk = FakeClock()
+    wd = SLOWatchdog(_ledger_cfg(), clock=clk)
+    good, bad = 0.01, 0.2
+    # chaos phase: legitimately trips on fresh evidence, then recovers
+    # once the violating bulk leaves the 60s fast window
+    wd.observe_cycle(0.0, [good] * 50 + [bad] * 50, 0.0, "full")
+    assert wd.burning() and wd.burns.get("e2e_p99") == 1
+    wd.observe_cycle(30.0, [good] * 200, 0.0, "full")
+    wd.observe_cycle(90.0, [good] * 200, 0.0, "full")
+    wd.observe_cycle(95.0, [good, bad], 0.0, "full")
+    assert not wd.burning()
+    # clean phase: traffic stops. Past t=150 the t=90 good bulk has
+    # expired from the fast window, whose survivors are 1 bad of 2 —
+    # and the slow window still holds the whole chaos phase, so BOTH
+    # windows read over threshold on stale samples alone.
+    for t in range(96, 152, 5):
+        wd.evaluate(float(t), allow_trip=False)  # the idle-tick path
+        assert not wd.burning(), f"tripped on stale drainage at t={t}"
+    assert wd.burns.get("e2e_p99") == 1
+    # an eventful cycle that folds NOTHING in is clock, not evidence
+    wd.observe_cycle(152.0, [], 0.0, "full")
+    assert not wd.burning()
+    # positive control: the window STATE is trip-capable right now
+    # (fast = the bad tail alone, slow = the whole chaos phase) — only
+    # the evidence-freshness gate held the flap back
+    wd.evaluate(153.0)
+    assert wd.burning() and wd.burns.get("e2e_p99") == 2
 
 
 def test_efficiency_gauge_freshness_on_solve_free_cycle():
