@@ -162,6 +162,18 @@ class LedgerConfigurationV1alpha1:
 
 
 @dataclass
+class LockSanitizerConfigurationV1alpha1:
+    """Versioned spelling of the instrumented-lock sanitizer block
+    (sanitize.LockSanitizerConfig): camelCase, the hold budget as a
+    metav1.Duration string like every other versioned time field."""
+
+    enabled: Optional[bool] = None
+    holdBudget: Optional[str] = None  # "0s" = hold check off
+    debugGuards: Optional[bool] = None
+    maxFindings: Optional[int] = None
+
+
+@dataclass
 class ObservabilityConfigurationV1alpha1:
     """Versioned spelling of the observability knobs
     (config.ObservabilityConfig): camelCase, the trace threshold as a
@@ -180,6 +192,8 @@ class ObservabilityConfigurationV1alpha1:
     auditInterval: Optional[str] = None  # "0s" = serving auditor off
     ledger: "LedgerConfigurationV1alpha1" = field(
         default_factory=LedgerConfigurationV1alpha1)
+    lockSanitizer: "LockSanitizerConfigurationV1alpha1" = field(
+        default_factory=LockSanitizerConfigurationV1alpha1)
 
 
 @dataclass
@@ -461,6 +475,15 @@ def set_defaults_kube_scheduler_configuration(
         lg.burnThreshold = 1.0
     if lg.engagePressure is None:
         lg.engagePressure = True
+    ls = ob.lockSanitizer
+    if ls.enabled is None:
+        ls.enabled = False  # plain threading locks by default
+    if ls.holdBudget is None:
+        ls.holdBudget = "250ms"
+    if ls.debugGuards is None:
+        ls.debugGuards = True
+    if ls.maxFindings is None:
+        ls.maxFindings = 256
     sv = obj.serving
     if sv.enabled is None:
         sv.enabled = False
@@ -729,8 +752,10 @@ def _warmup_to_internal(wu: WarmupConfigurationV1alpha1):
 
 def _observability_to_internal(ob: ObservabilityConfigurationV1alpha1):
     from kubernetes_tpu.config import LedgerConfig, ObservabilityConfig
+    from kubernetes_tpu.sanitize import LockSanitizerConfig
 
     lg = ob.ledger
+    ls = ob.lockSanitizer
     return ObservabilityConfig(
         enabled=ob.enabled,
         trace_threshold_s=_dur("traceThreshold", ob.traceThreshold,
@@ -759,6 +784,13 @@ def _observability_to_internal(ob: ObservabilityConfigurationV1alpha1):
                                "observability"),
             burn_threshold=lg.burnThreshold,
             engage_pressure=lg.engagePressure,
+        ),
+        lock_sanitizer=LockSanitizerConfig(
+            enabled=ls.enabled,
+            hold_budget_s=_dur("lockSanitizer.holdBudget", ls.holdBudget,
+                               "observability"),
+            debug_guards=ls.debugGuards,
+            max_findings=ls.maxFindings,
         ),
     )
 
@@ -900,6 +932,13 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
                     c.observability.ledger.slow_window_s),
                 burnThreshold=c.observability.ledger.burn_threshold,
                 engagePressure=c.observability.ledger.engage_pressure,
+            ),
+            lockSanitizer=LockSanitizerConfigurationV1alpha1(
+                enabled=c.observability.lock_sanitizer.enabled,
+                holdBudget=format_duration(
+                    c.observability.lock_sanitizer.hold_budget_s),
+                debugGuards=c.observability.lock_sanitizer.debug_guards,
+                maxFindings=c.observability.lock_sanitizer.max_findings,
             ),
         ),
         serving=ServingConfigurationV1alpha1(

@@ -24,13 +24,13 @@ directly:
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
 from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.sanitize import assert_held, make_lock
 from kubernetes_tpu.snapshot import NodeTable, SnapshotPacker
 
 #: cache.go — factory.NewConfigFactory wires a 30 s assumed-pod TTL.
@@ -57,6 +57,7 @@ class SchedulerCache:
         ttl_s: float = DEFAULT_ASSUME_TTL_S,
         clock: Callable[[], float] = time.monotonic,
         max_dirty_frac: float = 0.25,
+        lock_factory=None,
     ) -> None:
         self.packer = packer or SnapshotPacker()
         self.ttl_s = ttl_s
@@ -91,7 +92,7 @@ class SchedulerCache:
         #: concurrently with the scheduler's device_snapshot() — without
         #: this lock a half-patched host table could be uploaded and
         #: then persist as the resident device snapshot
-        self._snap_lock = threading.RLock()
+        self._snap_lock = make_lock(lock_factory, "cache.snap", "rlock")
         #: how the last device_snapshot() was produced: full | delta | clean
         self.last_snapshot_mode: str = ""
         #: host rows actually (re)packed + uploaded by the last call — the
@@ -373,6 +374,7 @@ class SchedulerCache:
         path) consuming the dirty set can never leave the resident
         device table silently stale — device_snapshot() drains the queue
         it missed."""
+        assert_held(self._snap_lock, "cache._refresh_host_locked")
         # EXACT universe signature, not the bucketed widths: interner
         # growth WITHIN a power-of-two bucket still changes clean rows
         # (a pending pod interning a new selector pair must light
@@ -450,6 +452,7 @@ class SchedulerCache:
             return self._device_snapshot_locked(tree_nbytes)
 
     def _device_snapshot_locked(self, tree_nbytes):
+        assert_held(self._snap_lock, "cache._device_snapshot_locked")
         import numpy as np
 
         from kubernetes_tpu.ops.arrays import nodes_to_device, scatter_node_rows

@@ -222,6 +222,14 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
     if lg.burn_threshold <= 0:
         errs.append(
             "observability.ledger.burnThreshold: must be greater than zero")
+    ls = oc.lock_sanitizer
+    if ls.hold_budget_s < 0:
+        errs.append(
+            "observability.lockSanitizer.holdBudget: must be non-negative "
+            "(0 = hold check off)")
+    if ls.max_findings < 1:
+        errs.append(
+            "observability.lockSanitizer.maxFindings: must be at least 1")
     sc = cfg.serving
     if sc.min_wait_s < 0:
         errs.append("serving.minWait: must be non-negative")

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from kubernetes_tpu.ops.predicates import BIT, PREDICATE_BITS
+from kubernetes_tpu.sanitize import LockSanitizerConfig
 
 # ---------------------------------------------------------------------------
 # Feature gates (pkg/features/kube_features.go @ v1.16 defaults, scheduler-
@@ -281,6 +282,11 @@ class ObservabilityConfig:
     #: perf ledger + SLO watchdog (obs/ledger.py): per-cycle
     #: measured-vs-modeled accounting, burn-rate objectives
     ledger: LedgerConfig = field(default_factory=LedgerConfig)
+    #: instrumented-lock runtime sanitizer (sanitize.py): acquisition-
+    #: order cycle detection, hold budgets, dynamic guarded-by checks —
+    #: off by default (plain threading locks, zero overhead)
+    lock_sanitizer: LockSanitizerConfig = field(
+        default_factory=LockSanitizerConfig)
 
 
 @dataclass

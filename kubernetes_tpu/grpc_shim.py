@@ -183,7 +183,11 @@ class TpuSchedulerService:
                             s.on_pod_add(pod)
                 self.revision = max(self.revision, delta.revision)
                 n_nodes = s.cache.node_count()
-            yield pb.SyncAck(revision=self.revision,
+                # snapshot while still locked: acking a revision some
+                # OTHER stream advanced to would claim deltas this
+                # stream never applied
+                ack_rev = self.revision
+            yield pb.SyncAck(revision=ack_rev,
                             nodes_in_snapshot=n_nodes)
 
     # -- unary verbs --------------------------------------------------------
@@ -275,9 +279,21 @@ class TpuSchedulerService:
             try:
                 s.queue.delete(key)
                 s.cache.assume_pod(pod, request.node)
-                s.binder.bind(pod, request.node)
-                s.cache.finish_binding(key)
             except Exception as e:
+                s.queue.add(pod)
+                return pb.BindResult(ok=False, error=str(e))
+        # the binder may be a real network hop (the chaos harness wraps
+        # it in injected latency/timeouts) — holding the service lock
+        # across it would stall every other verb for the round trip.
+        # The ASSUME above already reserves the pod optimistically
+        # (scheduler.go's assume-then-bind design), so concurrent binds
+        # of the same key fail the cache.pod() check either way.
+        try:
+            s.binder.bind(pod, request.node)
+            with self.lock:
+                s.cache.finish_binding(key)
+        except Exception as e:
+            with self.lock:
                 try:
                     s.cache.forget_pod(key)
                 except Exception:
@@ -286,7 +302,7 @@ class TpuSchedulerService:
                 # dropping the pod from both queue and cache would strand
                 # it until the client re-sends an ADD delta
                 s.queue.add(pod)
-                return pb.BindResult(ok=False, error=str(e))
+            return pb.BindResult(ok=False, error=str(e))
         return pb.BindResult(ok=True, error="")
 
 

@@ -4,9 +4,9 @@ The jit-compiled ops layer only surfaces tracer leaks, host↔device syncs
 and retrace storms at runtime, on the shapes a test happened to exercise.
 graftlint moves those checks to parse time: a cross-file jit call graph
 decides which functions run under tracing, an interprocedural taint pass
-decides which values are traced there, and six rule classes (R1–R6, plus
-R0 suppression hygiene) turn the hazards into findings a tier-1 test can
-enforce.
+decides which values are traced there, and the rule classes (R1–R10,
+plus R0 suppression hygiene) turn the hazards into findings a tier-1
+test can enforce.
 
 Rule classes
 ------------
@@ -23,6 +23,12 @@ R4   non-determinism (bare ``random.*``/``np.random.*`` global state,
      ``time.time()``, argless ``datetime.now()``)
 R5   dtype drift (float64 in device-math modules)
 R6   Py3.10 f-string backslash (the seed-breaking SyntaxError class)
+R7   d2h readback outside the declared ``obs.jax.readback`` boundary
+R8   sharded-value gather in a mesh-aware module
+R9   lock discipline — ``# guarded-by:`` (declared or inferred) state
+     accessed without its lock
+R10  blocking under a lock (hub RPC verbs, ``time.sleep``, readback,
+     ``.result()``/``block_until_ready``, event-sink emission)
 ==== =================================================================
 
 Suppression forms (justification after ``--`` is mandatory, R0-checked)::

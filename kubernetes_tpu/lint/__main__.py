@@ -28,9 +28,9 @@ DEFAULT_BASELINE = ".graftlint-baseline.json"
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m kubernetes_tpu.lint",
-        description="AST-based tracer-safety / determinism / host-sync "
-                    "linter for the jax_graft scheduler (rules R0-R6; see "
-                    "docs/lint.md).",
+        description="AST-based tracer-safety / determinism / host-sync / "
+                    "concurrency linter for the jax_graft scheduler "
+                    "(rules R0-R10; see docs/lint.md).",
     )
     parser.add_argument("paths", nargs="*", default=None,
                         help=f"files/dirs to lint (default: {' '.join(DEFAULT_PATHS)})")

@@ -20,7 +20,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tupl
 
 #: every rule class the engine knows; report/CLI validate --select and
 #: suppression comments against this
-RULE_IDS = ("R0", "R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8")
+RULE_IDS = ("R0", "R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9", "R10")
 
 
 @dataclass(frozen=True)

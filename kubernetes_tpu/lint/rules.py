@@ -32,6 +32,7 @@ Comparisons against string constants are host metadata checks
 from __future__ import annotations
 
 import ast
+import re
 import sys
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Set, Tuple
@@ -167,6 +168,8 @@ RULE_SUMMARIES = {
     "R6": "syntax gate: Py3.10 f-string backslash / parse errors",
     "R7": "d2h readback outside a declared obs.jax.readback boundary",
     "R8": "sharded-value gather in a mesh-aware (parallel-importing) module",
+    "R9": "lock discipline: guarded state accessed off-lock",
+    "R10": "blocking call (RPC/sleep/readback/event emit) under a held lock",
 }
 
 #: modules whose arrays must stay float32 (R5): the device-math layer
@@ -1106,6 +1109,544 @@ def rule_r8_mesh_gather(project: Project) -> List[Finding]:
                     "obs.jax.readback boundary",
                 ))
     return findings
+
+
+# ==========================================================================
+# R9 / R10 — lock discipline + blocking-under-lock
+# ==========================================================================
+
+#: lock constructors recognized on ``self.X = threading.Lock()`` — plus
+#: any injectable factory whose name mentions "lock" (the sanitize.py
+#: seam: ``self._lock = lock_factory("cache.snap")``)
+_LOCK_CTOR_LEAVES = {
+    "lock", "rlock", "condition", "semaphore", "boundedsemaphore",
+}
+
+#: ``# guarded-by: self._lock`` — the explicit declaration form; the
+#: lock name normalizes through a leading ``self.``
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*(?P<lock>[A-Za-z_][\w.]*)")
+
+#: container mutations that count as WRITES for guard inference — in
+#: this codebase shared state is mostly dicts/deques mutated in place,
+#: not rebound
+_MUTATOR_METHODS = {
+    "append", "appendleft", "add", "insert", "extend", "update", "pop",
+    "popleft", "popitem", "remove", "discard", "clear", "setdefault",
+}
+
+#: files R9/R10 never look at: test fakes and offline harnesses are
+#: single-threaded by design, same scoping as R7/R8
+_LOCK_EXEMPT_TOPDIRS = ("tests", "tests_tpu", "scripts")
+
+#: directly-blocking operations for R10 — exactly the shapes that have
+#: bitten this repo: hub RPC verbs, the declared d2h boundary, sleeps,
+#: event-sink emission, and device syncs
+_R10_BLOCKING_DOTTED = {"time.sleep"}
+_R10_BLOCKING_METHODS = {"result", "block_until_ready", "readback"}
+_R10_HUB_VERBS = {
+    "bind", "bind_pod", "create_pod", "update_pod", "delete_pod",
+    "patch_pod", "list_pods", "get_pod",
+}
+_R10_SINK_NAMES = {"event_sink"}
+_R10_SINK_DESC = "event-sink emission"
+
+
+def _r10_blocking_desc(node: ast.Call, imports: Dict[str, str]) -> Optional[str]:
+    """Human description when this call is a known-blocking op, else
+    None. Callers exclude intraclass ``self.meth()`` calls first —
+    a class invoking its OWN ``delete_pod`` is in-process bookkeeping,
+    not a stub RPC."""
+    func = node.func
+    name = dotted_name(func)
+    full = resolve_dotted(name, imports)
+    leaf = (name or "").split(".")[-1]
+    if full in _R10_BLOCKING_DOTTED:
+        return f"`{full}()`"
+    if leaf == "block_until_ready" or (
+            full and full.endswith(".block_until_ready")):
+        return "`block_until_ready` (device sync)"
+    if isinstance(func, ast.Attribute) and func.attr in _R10_BLOCKING_METHODS:
+        return (f"`.{func.attr}()` "
+                + ("(declared d2h readback)" if func.attr == "readback"
+                   else "(device/future sync)"))
+    if isinstance(func, ast.Attribute) and func.attr in _R10_HUB_VERBS:
+        return f"hub RPC `.{func.attr}()`"
+    if leaf in _R10_SINK_NAMES:
+        return _R10_SINK_DESC
+    return None
+
+
+#: name tokens that mean "this is a lock" — token-wise so ``clock`` /
+#: ``blocked`` never match
+_LOCKISH_TOKENS = {"lock", "rlock", "mutex", "cond", "condition"}
+
+
+def _lockish_name(leaf: str) -> bool:
+    tokens = leaf.lower().strip("_").split("_")
+    return any(t in _LOCKISH_TOKENS for t in tokens)
+
+
+def _is_lock_ctor(call: ast.Call, imports: Dict[str, str]) -> bool:
+    name = dotted_name(call.func)
+    full = resolve_dotted(name, imports) or ""
+    leaf = full.split(".")[-1].lower()
+    if full.startswith("threading.") and leaf in _LOCK_CTOR_LEAVES:
+        return True
+    # injectable lock factories (kubernetes_tpu/sanitize.py seam)
+    return _lockish_name((name or "").split(".")[-1])
+
+
+def _lockish_expr(expr: ast.expr, locks: Set[str]) -> Optional[str]:
+    """Dotted name of a with-item that acquires a lock, else None.
+    ``self.X`` for a known class lock always counts; otherwise the last
+    segment must look lock-like (lock / cond / mutex)."""
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    parts = name.split(".")
+    if len(parts) == 2 and parts[0] == "self" and parts[1] in locks:
+        return name
+    if _lockish_name(parts[-1]):
+        return name
+    return None
+
+
+class _MethodLockScan:
+    """One method's lock-relevant events: attribute accesses (with the
+    self-locks held at that point), intraclass ``self.meth()`` call
+    sites, and R10-relevant blocking calls (with every held lock expr,
+    including non-self ones like ``loop.lock``)."""
+
+    def __init__(self, cls: "_ClassLockInfo", meth_name: str,
+                 node: ast.AST) -> None:
+        self.cls = cls
+        self.name = meth_name
+        self.node = node
+        #: (attr, is_write, frozenset(held self-locks), node)
+        self.accesses: List[Tuple[str, bool, frozenset, ast.AST]] = []
+        #: (callee method leaf name, frozenset(held self-locks),
+        #:  tuple(held lock exprs), node)
+        self.self_calls: List[Tuple[str, frozenset, Tuple[str, ...], ast.AST]] = []
+        #: (description, tuple(held lock exprs), node) — direct blocking
+        self.blocking: List[Tuple[str, Tuple[str, ...], ast.AST]] = []
+        #: does this method directly call the event sink?
+        self.emits = False
+
+    # -- walk ---------------------------------------------------------------
+
+    def run(self) -> None:
+        for stmt in self.node.body:
+            self._stmt(stmt, frozenset(), ())
+
+    def _stmt(self, node: ast.stmt, held: frozenset,
+              held_exprs: Tuple[str, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # a nested def runs LATER, on whatever thread calls it —
+            # never under the locks held at definition time
+            for s in getattr(node, "body", ()):
+                self._stmt(s, frozenset(), ())
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = set(held)
+            new_exprs = list(held_exprs)
+            for item in node.items:
+                self._expr(item.context_expr, held, held_exprs)
+                lk = _lockish_expr(item.context_expr, self.cls.locks)
+                if lk is not None:
+                    new_exprs.append(lk)
+                    parts = lk.split(".")
+                    if (len(parts) == 2 and parts[0] == "self"
+                            and parts[1] in self.cls.locks):
+                        new_held = new_held | {parts[1]}
+            for s in node.body:
+                self._stmt(s, frozenset(new_held), tuple(new_exprs))
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            if node.value is not None:
+                self._expr(node.value, held, held_exprs)
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                self._target(t, held, held_exprs,
+                             aug=isinstance(node, ast.AugAssign))
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._target(t, held, held_exprs)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._stmt(child, held, held_exprs)
+            elif isinstance(child, ast.expr):
+                self._expr(child, held, held_exprs)
+            elif isinstance(child, ast.excepthandler):
+                for s in child.body:
+                    self._stmt(s, held, held_exprs)
+
+    def _self_attr(self, node: ast.AST) -> Optional[str]:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+
+    def _target(self, node: ast.AST, held: frozenset,
+                held_exprs: Tuple[str, ...], aug: bool = False) -> None:
+        attr = self._self_attr(node)
+        if attr is not None:
+            self._record(attr, True, held, node)
+            return
+        if isinstance(node, ast.Subscript):
+            base = self._self_attr(node.value)
+            if base is not None:
+                # self.A[k] = v mutates A in place
+                self._record(base, True, held, node.value)
+            else:
+                self._expr(node.value, held, held_exprs)
+            self._expr(node.slice, held, held_exprs)
+            return
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                self._target(e, held, held_exprs)
+            return
+        if isinstance(node, ast.Starred):
+            self._target(node.value, held, held_exprs)
+            return
+        if isinstance(node, ast.Attribute):
+            self._expr(node.value, held, held_exprs)
+
+    def _record(self, attr: str, is_write: bool, held: frozenset,
+                node: ast.AST) -> None:
+        if attr in self.cls.locks or attr in self.cls.method_names:
+            return
+        self.accesses.append((attr, is_write, held, node))
+
+    def _expr(self, node: ast.expr, held: frozenset,
+              held_exprs: Tuple[str, ...]) -> None:
+        if isinstance(node, (ast.Lambda,)):
+            # runs later, lock-free (same as nested defs)
+            self._expr(node.body, frozenset(), ())
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, held, held_exprs)
+            return
+        attr = self._self_attr(node)
+        if attr is not None:
+            self._record(attr, False, held, node)
+            self._expr(node.value, held, held_exprs)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, held, held_exprs)
+
+    def _call(self, node: ast.Call, held: frozenset,
+              held_exprs: Tuple[str, ...]) -> None:
+        func = node.func
+        meth = self._self_attr(func)
+        intraclass = meth is not None and meth in self.cls.method_names
+        desc = (None if intraclass
+                else _r10_blocking_desc(node, self.cls.fi.imports))
+        if desc is not None and desc == _R10_SINK_DESC:
+            self.emits = True
+        if desc is not None and held_exprs:
+            self.blocking.append((desc, held_exprs, node))
+        # intraclass call edge: self.meth(...) — an in-process call, not
+        # a stub RPC, even when the method name is a hub verb; whatever
+        # blocking IT does is reached through the entry/emitter closures
+        if intraclass:
+            self.self_calls.append((meth, held, held_exprs, node))
+        # `self.A.append(x)` mutates A in place: a WRITE for guard
+        # inference — the dominant shape for this codebase's shared
+        # deques/dicts, which are mutated, not rebound
+        if isinstance(func, ast.Attribute) and not intraclass:
+            base = self._self_attr(func.value)
+            if base is not None:
+                self._record(base, func.attr in _MUTATOR_METHODS,
+                             held, func.value)
+            else:
+                self._expr(func.value, held, held_exprs)
+        for a in node.args:
+            self._expr(a, held, held_exprs)
+        for kw in node.keywords:
+            self._expr(kw.value, held, held_exprs)
+
+
+class _ClassLockInfo:
+    """Per-class lock model: which attributes are locks, which state
+    they guard (declared or inferred), and which methods are only ever
+    entered with a lock already held."""
+
+    def __init__(self, fi: FileInfo, node: ast.ClassDef) -> None:
+        self.fi = fi
+        self.node = node
+        self.locks: Set[str] = set()
+        self.declared: Dict[str, str] = {}  # attr -> lock attr
+        self.method_names: Set[str] = set()
+        self.scans: Dict[str, _MethodLockScan] = {}
+        #: attr -> (lock, "declared"|"inferred", locked_writes, writes)
+        self.guarded: Dict[str, Tuple[str, str, int, int]] = {}
+        #: method leaf name -> self-locks guaranteed held on entry
+        self.entry: Dict[str, frozenset] = {}
+        #: methods that (transitively, intraclass) emit events
+        self.emitters: Set[str] = set()
+
+    # -- construction -------------------------------------------------------
+
+    def build(self) -> None:
+        methods = [n for n in self.node.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        self.method_names = {m.name for m in methods}
+        for m in methods:
+            self._find_locks_and_declarations(m)
+        # class-level ``# guarded-by:`` annotations on assignments
+        for n in self.node.body:
+            if isinstance(n, (ast.Assign, ast.AnnAssign)):
+                self._declare_from_line(n)
+        if not self.locks:
+            return
+        for m in methods:
+            if m.name in ("__init__", "__post_init__"):
+                continue
+            scan = _MethodLockScan(self, m.name, m)
+            scan.run()
+            self.scans[m.name] = scan
+        self._infer_guards()
+        self._entry_closure()
+        self._emitter_closure()
+
+    def _find_locks_and_declarations(self, meth: ast.AST) -> None:
+        for node in ast.walk(meth):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and _is_lock_ctor(node.value, self.fi.imports)):
+                        self.locks.add(t.attr)
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                self._declare_from_line(node)
+
+    def _declare_from_line(self, node: ast.stmt) -> None:
+        for ln in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+            if not 1 <= ln <= len(self.fi.lines):
+                continue
+            m = _GUARDED_BY_RE.search(self.fi.lines[ln - 1])
+            if m is None:
+                continue
+            lock = m.group("lock")
+            if lock.startswith("self."):
+                lock = lock[len("self."):]
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    self.declared[t.attr] = lock
+                elif isinstance(t, ast.Name):
+                    self.declared[t.id] = lock
+            return
+
+    # -- guard inference ----------------------------------------------------
+
+    def _infer_guards(self) -> None:
+        for attr, lock in self.declared.items():
+            if lock in self.locks:
+                self.guarded[attr] = (lock, "declared", 0, 0)
+        writes: Dict[str, List[frozenset]] = {}
+        for scan in self.scans.values():
+            for attr, is_write, held, _node in scan.accesses:
+                if is_write:
+                    writes.setdefault(attr, []).append(held)
+        for attr, helds in writes.items():
+            if attr in self.guarded:
+                continue
+            total = len(helds)
+            best_lock, best_k = None, 0
+            for lock in self.locks:
+                k = sum(1 for h in helds if lock in h)
+                if k > best_k:
+                    best_lock, best_k = lock, k
+            if best_lock is not None and total and best_k / total >= 0.8:
+                self.guarded[attr] = (best_lock, "inferred", best_k, total)
+
+    # -- interprocedural closures (intraclass call graph) -------------------
+
+    def _entry_closure(self) -> None:
+        # *_locked is the codebase's declared caller-holds-the-lock
+        # convention (cache._refresh_host_locked); everything else starts
+        # lock-free and is promoted only when EVERY intraclass call site
+        # provably holds the lock
+        for name in self.scans:
+            self.entry[name] = (frozenset(self.locks)
+                                if name.endswith("_locked") else frozenset())
+        sites: Dict[str, List[Tuple[str, frozenset]]] = {}
+        for scan in self.scans.values():
+            for callee, held, _exprs, _node in scan.self_calls:
+                sites.setdefault(callee, []).append((scan.name, held))
+        for _ in range(len(self.scans) + 2):
+            changed = False
+            for name, scan in self.scans.items():
+                if name.endswith("_locked"):
+                    continue
+                calls = sites.get(name)
+                if not calls:
+                    continue
+                new = frozenset.intersection(*[
+                    held | self.entry.get(caller, frozenset())
+                    for caller, held in calls
+                ])
+                if new != self.entry[name]:
+                    self.entry[name] = new
+                    changed = True
+            if not changed:
+                break
+
+    def _emitter_closure(self) -> None:
+        self.emitters = {n for n, s in self.scans.items() if s.emits}
+        for _ in range(len(self.scans) + 2):
+            grown = False
+            for name, scan in self.scans.items():
+                if name in self.emitters:
+                    continue
+                if any(callee in self.emitters
+                       for callee, _h, _e, _n in scan.self_calls):
+                    self.emitters.add(name)
+                    grown = True
+            if not grown:
+                break
+
+
+def _lock_state(project: Project) -> List[_ClassLockInfo]:
+    """Per-class lock models for every production file; cached on the
+    project (R9 and R10 share it, like the R1/R2 jit-taint cache)."""
+    cached = getattr(project, "_graftlint_lock_state", None)
+    if cached is not None:
+        return cached
+    out: List[_ClassLockInfo] = []
+    for fi in project.files:
+        if fi.tree is None:
+            continue
+        rel = fi.relpath.replace("\\", "/")
+        if rel.split("/", 1)[0] in _LOCK_EXEMPT_TOPDIRS:
+            continue
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.ClassDef):
+                info = _ClassLockInfo(fi, node)
+                info.build()
+                if info.locks:
+                    out.append(info)
+    project._graftlint_lock_state = out
+    return out
+
+
+@register_rule("R9")
+def rule_r9_lock_discipline(project: Project) -> List[Finding]:
+    """Guarded state accessed off-lock. An attribute is guarded by a
+    lock when a ``# guarded-by: self._lock`` comment says so, or when
+    >= 80% of its writes (rebinds AND in-place container mutations,
+    ``__init__`` excluded — construction precedes sharing) happen under
+    ``with self._lock``. Every other access — reads included, because
+    unlocked snapshot reads were exactly the PR-8/PR-14 bug class —
+    must hold that lock, either lexically or by being a method whose
+    every intraclass call site holds it (``self._helper()`` under the
+    lock, the ``*_locked`` naming convention)."""
+    findings: List[Finding] = []
+    for info in _lock_state(project):
+        for scan in info.scans.values():
+            entry = info.entry.get(scan.name, frozenset())
+            for attr, is_write, held, node in scan.accesses:
+                g = info.guarded.get(attr)
+                if g is None:
+                    continue
+                lock, how, k, n = g
+                if lock in held or lock in entry:
+                    continue
+                basis = ("declared guarded-by" if how == "declared"
+                         else f"inferred from {k}/{n} locked writes")
+                verb = "written" if is_write else "read"
+                findings.append(info.fi.finding(
+                    node, "R9",
+                    f"`self.{attr}` is guarded by `self.{lock}` ({basis}) "
+                    f"but {verb} here without holding it — a data race "
+                    f"with the locked writers (torn reads / lost updates)",
+                ))
+    return findings
+
+
+@register_rule("R10")
+def rule_r10_blocking_under_lock(project: Project) -> List[Finding]:
+    """Known-blocking operations while a lock is statically held — the
+    exact shape of the PR-14 watchdog-events bug (events emitted inside
+    the watchdog mutex, deadlocking any sink that calls back into the
+    ledger). Blocking set: hub RPC verbs, ``obs.jax.readback``,
+    ``time.sleep``, event-sink emission, ``.result()`` /
+    ``block_until_ready``. Held means: inside ``with <lock>`` (any
+    lock-named context manager, self or not), or in a method whose
+    every intraclass call site holds one (incl. ``*_locked``).
+    Collect what you need under the lock, drop it, THEN block."""
+    findings: List[Finding] = []
+    for info in _lock_state(project):
+        for scan in info.scans.values():
+            # blocking ops under a lexically held with-lock
+            for desc, held_exprs, node in scan.blocking:
+                locks = ", ".join(f"`{e}`" for e in held_exprs)
+                findings.append(info.fi.finding(
+                    node, "R10",
+                    f"{desc} while holding {locks} — blocking under a "
+                    "lock stalls every thread contending for it (and an "
+                    "emission sink calling back in deadlocks); collect "
+                    "under the lock, release, then block",
+                ))
+            # blocking ops in methods whose every intraclass call site
+            # holds a lock (incl. *_locked), and emitter methods invoked
+            # under a lexically held lock
+            entry = info.entry.get(scan.name, frozenset())
+            if entry:
+                locks = ", ".join(f"`self.{l}`" for l in sorted(entry))
+                for node in _r10_unlocked_blocking_nodes(scan):
+                    findings.append(info.fi.finding(
+                        node[1], "R10",
+                        f"{node[0]} in `{scan.name}`, which is only ever "
+                        f"called with {locks} held — blocking under a "
+                        "caller-held lock; hoist the blocking work out "
+                        "of the locked region",
+                    ))
+            for callee, held, held_exprs, node in scan.self_calls:
+                if held_exprs and callee in info.emitters:
+                    locks = ", ".join(f"`{e}`" for e in held_exprs)
+                    findings.append(info.fi.finding(
+                        node, "R10",
+                        f"`self.{callee}()` emits events and is called "
+                        f"while holding {locks} — the watchdog-events "
+                        "bug shape; emit after the lock drops",
+                    ))
+    return findings
+
+
+def _r10_unlocked_blocking_nodes(scan: _MethodLockScan):
+    """Blocking calls in a scan that are NOT under a lexical with-lock
+    (those already reported) — used for caller-held-lock methods."""
+    out = []
+    seen_lex = {id(n) for _d, _e, n in scan.blocking}
+
+    class _V(ast.NodeVisitor):
+        def visit_Call(self, node: ast.Call) -> None:
+            if id(node) not in seen_lex:
+                func = node.func
+                intraclass = (isinstance(func, ast.Attribute)
+                              and isinstance(func.value, ast.Name)
+                              and func.value.id == "self"
+                              and func.attr in scan.cls.method_names)
+                if not intraclass:
+                    desc = _r10_blocking_desc(node, scan.cls.fi.imports)
+                    if desc is not None:
+                        out.append((desc, node))
+            self.generic_visit(node)
+
+    _V().visit(scan.node)
+    return out
 
 
 # ==========================================================================

@@ -422,6 +422,17 @@ class SchedulerMetrics:
             "correctness bug, never noise.",
             ["invariant"],
         ))
+        self.lock_sanitizer_findings = r.register(Counter(
+            "scheduler_lock_sanitizer_findings_total",
+            "Instrumented-lock sanitizer findings by kind (order-cycle "
+            "= the acquisition-order graph gained a cycle, a potential "
+            "deadlock; held-too-long = a lock exceeded its hold budget; "
+            "guard-violation = an assert_held declaration was false — "
+            "kubernetes_tpu/sanitize.py). Only emitted when "
+            "observability.lockSanitizer armed the sanitizer; any "
+            "order-cycle or guard-violation is a correctness bug.",
+            ["kind"],
+        ))
         # -- runtime JAX telemetry (kubernetes_tpu/obs): the dynamic twin
         # of graftlint's static R3 rule, plus host-boundary transfer
         # accounting and Sinkhorn convergence ---------------------------

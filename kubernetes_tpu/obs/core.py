@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import json
 import math
-import threading
 import time
 from collections import deque
 from contextlib import contextmanager, nullcontext
@@ -33,11 +32,13 @@ from kubernetes_tpu.obs.jaxtel import JaxTelemetry
 from kubernetes_tpu.obs.ledger import PerfLedger
 from kubernetes_tpu.obs.recorder import CycleRecord, FlightRecorder
 from kubernetes_tpu.obs.trace import Trace, chrome_trace_json
+from kubernetes_tpu.sanitize import make_lock
 
 
 class Observability:
     def __init__(self, config=None, metrics=None,
-                 clock: Callable[[], float] = time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic,
+                 lock_sanitizer=None) -> None:
         if config is None:
             from kubernetes_tpu.config import ObservabilityConfig
 
@@ -45,24 +46,32 @@ class Observability:
         self.config = config
         self.metrics = metrics
         self.clock = clock
+        #: runtime lock sanitizer (kubernetes_tpu/sanitize.py) — when the
+        #: scheduler armed one, every obs-side lock is built through it
+        #: so the acquisition-order graph covers the whole facade
+        self.lock_sanitizer = lock_sanitizer
+        lf = lock_sanitizer.factory() if lock_sanitizer is not None else None
         self.jax = JaxTelemetry(
             metrics=metrics,
             storm_threshold=config.retrace_storm_threshold,
             storm_window=config.retrace_storm_window,
+            lock_factory=lf,
         )
-        self.recorder = FlightRecorder(config.recorder_capacity)
+        self.recorder = FlightRecorder(config.recorder_capacity,
+                                       lock_factory=lf)
         #: perf ledger + SLO watchdog (obs/ledger.py): consumes each
         #: eventful cycle's record at end_cycle — measured phase
         #: distributions, measured-vs-modeled efficiency, burn-rate
         #: objectives. getattr: duck-typed config fakes stay valid;
         #: PerfLedger itself defaults a None config to LedgerConfig().
         self.ledger = PerfLedger(getattr(config, "ledger", None),
-                                 metrics=metrics, clock=clock)
+                                 metrics=metrics, clock=clock,
+                                 lock_factory=lf)
         self.traces: deque = deque(maxlen=max(1, config.trace_ring_capacity))
         #: guards the traces ring: the scheduler thread appends while the
         #: /debug/traces handler thread snapshots (deque iteration during
         #: an append raises RuntimeError)
-        self._traces_lock = threading.Lock()
+        self._traces_lock = make_lock(lf, "obs.traces")
         self.current_trace: Optional[Trace] = None
         self.last_trace: Optional[Trace] = None
         #: EVENTFUL cycles seen — the trace-sampling sequence. Idle
@@ -111,6 +120,9 @@ class Observability:
         self._sinkhorn_stats = None
         self._retraces_at_begin = self.jax.retrace_total()
         self._d2h_at_begin = self.jax.d2h_bytes_total()
+        self._lockfind_at_begin = (
+            self.lock_sanitizer.total_findings()
+            if self.lock_sanitizer is not None else 0)
         self.current_trace = Trace("Scheduling cycle", clock=self.clock,
                                    cycle=cycle)
         return self.current_trace
@@ -273,6 +285,10 @@ class Observability:
         # ~a minute of idle 0.25s serve-loop polls evict every record of
         # the incident the recorder exists to explain
         attempted = getattr(res, "attempted", 0) if res is not None else 0
+        lock_findings = (
+            self.lock_sanitizer.total_findings()
+            - getattr(self, "_lockfind_at_begin", 0)
+            if self.lock_sanitizer is not None else 0)
         eventful = bool(
             attempted
             or s.get("retries", 0)
@@ -283,6 +299,7 @@ class Observability:
             or s.get("fenced_binds", 0)
             or s.get("invariant_violations", 0)
             or s.get("ambiguous_binds", 0)
+            or lock_findings
         )
         if not eventful:
             return None
@@ -325,6 +342,7 @@ class Observability:
             fenced_binds=s.get("fenced_binds", 0),
             invariant_violations=s.get("invariant_violations", 0),
             ambiguous_binds=s.get("ambiguous_binds", 0),
+            lock_findings=lock_findings,
             mesh=s.get("mesh", self.mesh_devices),
             scenario=s.get("scenario", {}),
         )

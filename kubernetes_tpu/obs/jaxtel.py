@@ -22,11 +22,12 @@ declares, charging the bytes moved to a named site, and
 
 from __future__ import annotations
 
-import threading
 from collections import deque
 from typing import Dict, Optional, Tuple
 
 import numpy as np
+
+from kubernetes_tpu.sanitize import make_lock
 
 
 def _leaf_sig(x) -> object:
@@ -97,7 +98,8 @@ class JaxTelemetry:
 
     def __init__(self, metrics=None, storm_threshold: int = 8,
                  storm_window: int = 64,
-                 signature_capacity: int = 4096) -> None:
+                 signature_capacity: int = 4096,
+                 lock_factory=None) -> None:
         self.metrics = metrics
         self.storm_threshold = max(1, int(storm_threshold))
         self.storm_window = max(1, int(storm_window))
@@ -115,7 +117,7 @@ class JaxTelemetry:
         #: /debug/flightrecorder handler thread — an unlocked dict
         #: iteration there can raise "dictionary changed size during
         #: iteration" mid-incident
-        self._lock = threading.Lock()
+        self._lock = make_lock(lock_factory, "obs.jaxtel")
         self.calls: Dict[str, int] = {}
         self.hits: Dict[str, int] = {}
         self.compiles: Dict[str, int] = {}

@@ -58,11 +58,12 @@ from __future__ import annotations
 
 import math
 import re
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu.sanitize import make_lock
 
 #: span name -> canonical phase. Pipeline spans carry their chunk index
 #: (``pipeline:pack@3``) — the phase is the stage name; ladder spans
@@ -203,8 +204,8 @@ class CycleCostModel:
     mesh width with parallel/costmodel.py's collective model folded
     in."""
 
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
+    def __init__(self, lock_factory=None) -> None:
+        self._lock = make_lock(lock_factory, "obs.costmodel")
         #: (P, N) -> {"flops": float, "bytes_accessed": float}
         self._sig: Dict[Tuple[int, int], Dict[str, float]] = {}
         #: scope -> (P, N, mesh, solve_s, rounds) — the BEST observed
@@ -231,7 +232,7 @@ class CycleCostModel:
         if solve_s <= 0 or P <= 0:
             return False
         scope = scope or "full"
-        work = self._work(P, N, mesh, scope, False, rounds)
+        work = self._work(P, N, mesh, scope, None, rounds)
         if work <= 0:
             return False
         rate = float(solve_s) / work
@@ -239,7 +240,7 @@ class CycleCostModel:
             cur = self._anchor.get(scope)
             if cur is not None:
                 cP, cN, cMesh, cS, cR = cur
-                cur_work = self._work(cP, cN, cMesh, scope, False, cR)
+                cur_work = self._work(cP, cN, cMesh, scope, None, cR)
                 if cur_work > 0 and rate >= cS / cur_work:
                     return False
             self._anchor[scope] = (int(P), int(N), int(mesh),
@@ -247,15 +248,17 @@ class CycleCostModel:
             return True
 
     def _work(self, P: int, N: int, mesh: int, scope: str,
-              use_flops: bool, rounds: int) -> float:
+              flops: Optional[float], rounds: int) -> float:
         """Single-device-equivalent work units for one solve: the
-        per-round plane cost (captured flops or the analytic P·N) times
-        the round count, divided across the mesh and discounted by the
-        collective model."""
+        per-round plane cost (captured ``flops``, read out of ``_sig``
+        under the caller's lock — this helper runs locked AND unlocked,
+        so it must not touch shared state itself — or the analytic P·N)
+        times the round count, divided across the mesh and discounted
+        by the collective model."""
         from kubernetes_tpu.parallel.costmodel import model_efficiency
 
-        if use_flops:
-            base = self._sig[(P, N)]["flops"]
+        if flops is not None:
+            base = flops
         elif scope == "restricted":
             # the restricted solve gathers a FIXED candidate bucket:
             # cost scales with the batch, not the node axis
@@ -282,8 +285,12 @@ class CycleCostModel:
             use_flops = (scope != "restricted"
                          and (P, N) in self._sig
                          and (aP, aN) in self._sig)
-        work = self._work(P, N, mesh, scope, use_flops, rounds)
-        anchor_work = self._work(aP, aN, aMesh, scope, use_flops, aRounds)
+            # snapshot the flops while still under the lock: _work runs
+            # unlocked and a concurrent record_signature replaces entries
+            q_flops = self._sig[(P, N)]["flops"] if use_flops else None
+            a_flops = self._sig[(aP, aN)]["flops"] if use_flops else None
+        work = self._work(P, N, mesh, scope, q_flops, rounds)
+        anchor_work = self._work(aP, aN, aMesh, scope, a_flops, aRounds)
         if anchor_work <= 0:
             return None, ""
         basis = "xla-cost" if use_flops else "calibrated"
@@ -407,7 +414,7 @@ class SLOWatchdog:
     network-fault phase caught exactly this flap)."""
 
     def __init__(self, config, clock: Callable[[], float] = time.monotonic,
-                 metrics=None) -> None:
+                 metrics=None, lock_factory=None) -> None:
         self.config = config
         self.clock = clock
         self.metrics = metrics
@@ -418,7 +425,7 @@ class SLOWatchdog:
         #: while /debug/ledger snapshots AND request threads re-evaluate
         #: through pressure_engaged — an unlocked dict iteration there
         #: can raise "dictionary changed size during iteration"
-        self._lock = threading.Lock()
+        self._lock = make_lock(lock_factory, "obs.watchdog")
         #: objective name -> (fast, slow) _BurnWindow pair
         self._samples: Dict[str, Tuple[_BurnWindow, _BurnWindow]] = {}
         #: objective name -> burning?
@@ -583,7 +590,8 @@ class PerfLedger:
     ``/debug/ledger``."""
 
     def __init__(self, config=None, metrics=None,
-                 clock: Callable[[], float] = time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic,
+                 lock_factory=None) -> None:
         if config is None:
             from kubernetes_tpu.config import LedgerConfig
 
@@ -591,9 +599,10 @@ class PerfLedger:
         self.config = config
         self.metrics = metrics
         self.clock = clock
-        self.model = CycleCostModel()
-        self.watchdog = SLOWatchdog(config, clock=clock, metrics=metrics)
-        self._lock = threading.Lock()
+        self.model = CycleCostModel(lock_factory=lock_factory)
+        self.watchdog = SLOWatchdog(config, clock=clock, metrics=metrics,
+                                    lock_factory=lock_factory)
+        self._lock = make_lock(lock_factory, "obs.ledger")
         self.entries: deque = deque(maxlen=max(1, int(config.history)))
         #: (phase, scope, mesh) -> RollingDist
         self._dists: Dict[Tuple[str, str, int], RollingDist] = {}

@@ -15,10 +15,11 @@ default): deterministic under fake clocks, R4-clean."""
 
 from __future__ import annotations
 
-import threading
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
+
+from kubernetes_tpu.sanitize import make_lock
 
 
 @dataclass
@@ -87,6 +88,11 @@ class CycleRecord:
     #: bind RPCs that timed out ambiguously this cycle and went through
     #: the read-your-write resolution protocol
     ambiguous_binds: int = 0
+    #: lock-sanitizer findings (order cycles / held-too-long / guard
+    #: violations, kubernetes_tpu/sanitize.py) first observed during
+    #: this cycle — nonzero marks the cycle eventful: a latent deadlock
+    #: hazard is black-box material even if nothing else happened
+    lock_findings: int = 0
     #: sharded-backend provenance: node-axis mesh device count the
     #: scheduler ran this cycle under (0 = single-device mode)
     mesh: int = 0
@@ -148,6 +154,8 @@ class CycleRecord:
                if self.invariant_violations else {}),
             **({"ambiguous_binds": self.ambiguous_binds}
                if self.ambiguous_binds else {}),
+            **({"lock_findings": self.lock_findings}
+               if self.lock_findings else {}),
             **({"mesh": self.mesh} if self.mesh else {}),
             **({"scenario": dict(self.scenario)} if self.scenario else {}),
             **({"modeled_s": round(self.modeled_s, 6),
@@ -161,13 +169,13 @@ class CycleRecord:
 class FlightRecorder:
     """Bounded ring of :class:`CycleRecord`."""
 
-    def __init__(self, capacity: int = 256) -> None:
+    def __init__(self, capacity: int = 256, lock_factory=None) -> None:
         self.capacity = max(1, int(capacity))
         self._buf: deque = deque(maxlen=self.capacity)
         #: serializes the scheduler thread's appends against snapshot
         #: reads from the /debug handler thread and the SIGUSR2 dump —
         #: iterating a deque mid-append raises RuntimeError
-        self._lock = threading.Lock()
+        self._lock = make_lock(lock_factory, "obs.recorder")
         #: lifetime count (so eviction is observable: recorded - len)
         self.recorded = 0
 
@@ -181,7 +189,8 @@ class FlightRecorder:
             return list(self._buf)
 
     def __len__(self) -> int:
-        return len(self._buf)
+        with self._lock:
+            return len(self._buf)
 
     def clear(self) -> None:
         with self._lock:
@@ -242,6 +251,8 @@ class FlightRecorder:
                 flags.append(f"invariants={r.invariant_violations}")
             if r.ambiguous_binds:
                 flags.append(f"ambig={r.ambiguous_binds}")
+            if r.lock_findings:
+                flags.append(f"lockfind={r.lock_findings}")
             if r.model_efficiency >= 0:
                 flags.append(f"eff={r.model_efficiency:.2f}")
             if r.slo:

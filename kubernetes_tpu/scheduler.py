@@ -244,12 +244,30 @@ class Scheduler:
         #: filter/score passes for interested pods
         self.extenders = list(extenders)
         self.metrics = metrics or SchedulerMetrics()
+        obs_config = (observability if observability is not None
+                      else ObservabilityConfig(
+                          trace_threshold_s=trace_threshold_s))
+        #: instrumented-lock runtime sanitizer (kubernetes_tpu/sanitize):
+        #: armed by observability.lockSanitizer.enabled. When on, every
+        #: lock the scheduler's obs stack / cache / serving loop builds
+        #: is wrapped to maintain the acquisition-order graph; findings
+        #: increment scheduler_lock_sanitizer_findings_total{kind} and
+        #: mark the cycle eventful in the flight record. getattr:
+        #: duck-typed config fakes without the field stay valid.
+        self.lock_sanitizer = None
+        ls_config = getattr(obs_config, "lock_sanitizer", None)
+        if ls_config is not None and ls_config.enabled:
+            from kubernetes_tpu.sanitize import LockSanitizer
+
+            self.lock_sanitizer = LockSanitizer(
+                ls_config, clock=clock,
+                on_finding=lambda kind: (
+                    self.metrics.lock_sanitizer_findings.inc(kind=kind)))
         #: observability layer (kubernetes_tpu/obs): cycle tracer + flight
         #: recorder + runtime JAX telemetry, on the scheduler's clock
         self.obs = Observability(
-            observability if observability is not None
-            else ObservabilityConfig(trace_threshold_s=trace_threshold_s),
-            metrics=self.metrics, clock=clock,
+            obs_config, metrics=self.metrics, clock=clock,
+            lock_sanitizer=self.lock_sanitizer,
         )
         #: degradation-ladder knobs (config.RobustnessConfig): per-cycle
         #: deadline, bounded retries, breaker thresholds, fallback chain,
@@ -357,7 +375,10 @@ class Scheduler:
         self.pred_mask = pred_mask
         #: per-pod CycleState, alive from prefilter to bind/fail
         self._cycle_states: Dict[str, object] = {}
-        self.cache = cache or SchedulerCache(clock=clock)
+        self.cache = cache or SchedulerCache(
+            clock=clock,
+            lock_factory=(self.lock_sanitizer.factory()
+                          if self.lock_sanitizer is not None else None))
         # the device-snapshot chaos seam rides the same injector as the
         # solver/transport seams (duck-typed attach, like the extenders)
         if (fault_injector is not None

@@ -181,8 +181,13 @@ class ServingLoop:
         #: informer pump (or a bench producer) running on another thread
         #: must ingest through this lock (use :meth:`ingest`). Doorbell
         #: waits happen OUTSIDE it — feeding never blocks on a solve's
-        #: wall time only on its critical sections.
-        self.lock = threading.RLock()
+        #: wall time only on its critical sections. Built through the
+        #: scheduler's lock sanitizer when one is armed: this is the
+        #: outermost lock in the serving stack, exactly where a
+        #: cross-class ordering inversion would close a deadlock cycle.
+        san = getattr(sched, "lock_sanitizer", None)
+        self.lock = (san.make_lock("serving.loop", "rlock")
+                     if san is not None else threading.RLock())
 
     def ingest(self, fn, *args, **kwargs):
         """Run an event-feed callable (scheduler.on_pod_add, ...) under

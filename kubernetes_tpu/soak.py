@@ -128,7 +128,8 @@ class SoakSentinels:
     def collect(self) -> Dict[str, float]:
         """One flat snapshot of every watched size. Key namespaces:
         ``sched.*`` (state_sizes), ``obs.*`` (rings), ``jax.*``
-        (signature LRUs), ``reflector.N.*`` (dedupe floors),
+        (signature LRUs), ``lock.*`` (runtime lock-sanitizer finding
+        counts, when armed), ``reflector.N.*`` (dedupe floors),
         ``rss_kb``."""
         out: Dict[str, float] = {"rss_kb": float(self.rss_reader())}
         s = self.sched
@@ -151,6 +152,20 @@ class SoakSentinels:
                 sig = getattr(jx, "signature_count", None)
                 if sig is not None:
                     out["jax.signatures"] = float(sig())
+            san = getattr(s, "lock_sanitizer", None)
+            if san is not None:
+                # monotonic finding counts: the clean-window contract
+                # pins order_cycles and guard_violations at zero delta —
+                # a deadlock-shaped acquisition order found mid-soak is
+                # a bug whatever the RSS curve says
+                counts = san.counts()
+                out["lock.order_cycles"] = float(
+                    counts.get("order-cycle", 0))
+                out["lock.held_too_long"] = float(
+                    counts.get("held-too-long", 0))
+                out["lock.guard_violations"] = float(
+                    counts.get("guard-violation", 0))
+                out["lock.total"] = float(san.total_findings())
         for i, r in enumerate(self.reflectors):
             out[f"reflector.{i}.obj_rev"] = float(
                 len(getattr(r, "_obj_rev", ())))

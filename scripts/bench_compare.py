@@ -1075,6 +1075,60 @@ def compare_ledger(cur: dict, efficiency_floor: float = 0.2) -> dict:
             "warnings": warnings}
 
 
+def compare_lock(soak_cur: dict) -> dict:
+    """Concurrency-discipline gates (pure, unit-tested via the soak
+    half; absence-tolerant) — the static + runtime lock contract
+    (docs/robustness.md "Lock sanitizer"):
+
+    - the newest soak record's ``lock_sanitizer`` block must carry
+      ZERO ``order-cycle`` and ZERO ``guard-violation`` findings —
+      absolute on one record: a deadlock-shaped acquisition order
+      found once is a bug forever after;
+    - the merged tree must be graftlint-clean with R9/R10 enabled and
+      an empty baseline (the static half of the same contract, run
+      in-process so the gate cannot drift from the linter).
+
+    Records predating the sanitizer (no ``lock_sanitizer`` block)
+    warn and pass, same posture as every other family."""
+    checks, regressions, warnings = [], [], []
+    absolute = partial(_absolute_check, checks, regressions)
+
+    san = (soak_cur or {}).get("lock_sanitizer")
+    if isinstance(san, dict):
+        counts = san.get("counts") or {}
+        for kind in ("order-cycle", "guard-violation"):
+            n = _num(counts.get(kind))
+            if n is not None:
+                absolute(f"lock.soak_{kind.replace('-', '_')}s",
+                         n, n > 0)
+    else:
+        warnings.append("lock: no lock_sanitizer block in the soak "
+                        "record (predates the sanitizer) — runtime "
+                        "half skipped")
+    try:
+        if REPO_ROOT not in sys.path:
+            # the other gates only read JSON records; this one imports
+            # the linter, and the script may be run from anywhere
+            sys.path.insert(0, REPO_ROOT)
+        from kubernetes_tpu.lint.engine import Project, lint_project
+
+        # no baseline on purpose: the lock rules ship with zero
+        # grandfathered findings, and this gate keeps it that way
+        project = Project.from_paths(
+            [os.path.join(REPO_ROOT, "kubernetes_tpu")], REPO_ROOT)
+        findings = lint_project(project, select=("R9", "R10"))
+        absolute("lock.lint_findings", float(len(findings)),
+                 bool(findings))
+        for f in findings[:10]:
+            warnings.append(f"lock: graftlint {f.rule} "
+                            f"{f.path}:{f.line}: {f.message}")
+    except Exception as e:  # lint must never crash the gate runner
+        warnings.append(f"lock: graftlint sweep failed ({e!r}) — "
+                        "static half skipped")
+    return {"checks": checks, "regressions": regressions,
+            "warnings": warnings}
+
+
 #: every active gate family: (name, record glob, what it enforces) —
 #: the --list-gates surface the docs reference. Keep one row per
 #: compare_* section so a new gate family cannot land invisibly.
@@ -1126,6 +1180,11 @@ GATE_FAMILIES = [
      "demonstrably engaged (repack, cascade, takeover, shard heal, "
      "net faults), all pods bound at end of life; traffic-2 p99 + "
      "creates/sec deltas"),
+    ("lock", "soak_r*.json",
+     "concurrency discipline: soak lock-sanitizer order-cycles==0 and "
+     "guard-violations==0 absolutes (new record alone), plus a merged-"
+     "tree graftlint R9/R10 sweep that must come back empty with no "
+     "baseline"),
 ]
 
 
@@ -1351,6 +1410,15 @@ def main(argv=None) -> int:
         verdict["warnings"].extend(skv["warnings"])
         verdict["soak_records"] = [
             os.path.relpath(p, REPO_ROOT) for p in sk_found[-2:]]
+    # concurrency-discipline gates: the runtime half reads the newest
+    # soak record's lock_sanitizer block (absent on older records —
+    # warns and passes); the static half sweeps the merged tree with
+    # graftlint R9/R10 and needs no record at all, so the family runs
+    # even in benchres directories with no soak history
+    lv = compare_lock(sk_cur if sk_found else {})
+    verdict["checks"].extend(lv["checks"])
+    verdict["regressions"].extend(lv["regressions"])
+    verdict["warnings"].extend(lv["warnings"])
     # incremental-solve gates (scripts/bench_churn.py --incr-sweep
     # records) — absence tolerated so benchres directories predating the
     # incremental mode keep passing; a single record still enforces the

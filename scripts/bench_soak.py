@@ -96,6 +96,7 @@ from kubernetes_tpu.leaderelection import (  # noqa: E402
     LeaderElectionRecord,
     LeaderElector,
 )
+from kubernetes_tpu.sanitize import LockSanitizerConfig  # noqa: E402
 from kubernetes_tpu.scheduler import Scheduler  # noqa: E402
 from kubernetes_tpu.serving import ServingRuntime  # noqa: E402
 from kubernetes_tpu.soak import (  # noqa: E402
@@ -391,7 +392,15 @@ def build_soak(args):
         observability=ObservabilityConfig(
             audit_interval_s=args.audit_interval,
             ledger=LedgerConfig(e2e_p99_objective_s=args.p99_objective,
-                                cost_drift_ratio=20.0)),
+                                cost_drift_ratio=20.0),
+            # runtime lock sanitizer armed for the whole soak: every
+            # obs/cache/serving lock is instrumented; the clean-window
+            # contract below requires zero order cycles and zero
+            # guard violations. The hold budget is generous — the soak
+            # runs compilation-heavy phases on CPU jax where a cycle
+            # under the serving lock legitimately takes seconds.
+            lock_sanitizer=LockSanitizerConfig(enabled=True,
+                                               hold_budget_s=0.0)),
         warmup=WarmupConfig(enabled=True,
                             pod_buckets=tuple(args.warm_buckets)),
     )
@@ -682,11 +691,16 @@ def main(argv=None) -> int:
                 sched.metrics.scenario_repacks.value()),
             "takeovers": lambda: float(
                 sched.metrics.recovery_takeovers.value()),
+            "lock_order_cycles": lambda: float(
+                sched.lock_sanitizer.counts().get("order-cycle", 0)),
+            "lock_guard_violations": lambda: float(
+                sched.lock_sanitizer.counts().get("guard-violation", 0)),
         })
     engine = SoakEngine(
         phases, sentinels, counters=counters,
         clean_zero=("slo_burns", "auditor_violations", "double_binds",
-                    "retraces", "fenced_binds", "preempted"),
+                    "retraces", "fenced_binds", "preempted",
+                    "lock_order_cycles", "lock_guard_violations"),
         step_s=args.step_s, sample_every_s=args.sample_every,
         p99_drift_bound=args.p99_drift_bound,
         log=lambda m: print(f"  {m}", file=sys.stderr))
@@ -792,6 +806,8 @@ def main(argv=None) -> int:
         "ledger": (rt.ledger.arm_summary()
                    if rt.ledger is not None and rt.ledger.enabled
                    else None),
+        "lock_sanitizer": (sched.lock_sanitizer.snapshot()
+                           if sched.lock_sanitizer is not None else None),
     })
     ran = set(record["phases_run"])
     full = not args.phases  # criteria that need a specific phase gate
@@ -831,6 +847,14 @@ def main(argv=None) -> int:
         "soak_min_duration_ok": bool(
             args.smoke or not full
             or record["wall_s"] >= args.minutes * 60 * 0.85),
+        # absolute, not delta: one deadlock-shaped acquisition order or
+        # one false assert_held anywhere in the run is a bug
+        "soak_lock_sanitizer_clean": bool(
+            record["lock_sanitizer"] is not None
+            and record["lock_sanitizer"]["counts"].get(
+                "order-cycle", 0) == 0
+            and record["lock_sanitizer"]["counts"].get(
+                "guard-violation", 0) == 0),
     }
     _write_record(record, args.out)
     print(json.dumps({"verdict": verdict,

@@ -97,15 +97,26 @@ def main(argv=None) -> int:
     scope = (f"post-baseline ({baselined} grandfathered subtracted)"
              if baselined else "pre-baseline")
     print(f"graftlint report — {total} finding(s) {scope}")
+    # counts only carry NONZERO rules, so "absent from prev counts"
+    # cannot distinguish "was clean" from "didn't exist yet" — each
+    # record also stores the rule universe it ran with ("rules"); a
+    # rule outside the previous record's universe is labeled NEW.
+    # Records predating the field fall back to "every rule known".
+    prev_rules = (prev or {}).get("rules")
     print(f"{'rule':<5} {'count':>5} {'prev':>5}  summary")
     for rule in RULE_IDS:
         n = counts.get(rule, 0)
-        p = prev_counts.get(rule, "-") if prev else "-"
+        if prev is None:
+            p = "-"
+        elif prev_rules is not None and rule not in prev_rules:
+            p = "new"
+        else:
+            p = prev_counts.get(rule, 0)
         print(f"{rule:<5} {n:>5} {str(p):>5}  {RULE_SUMMARIES[rule]}")
 
     if args.history:
         record = {"label": git_label(), "counts": counts, "total": total,
-                  "baselined": baselined}
+                  "baselined": baselined, "rules": list(RULE_IDS)}
         os.makedirs(os.path.dirname(os.path.abspath(args.history)),
                     exist_ok=True)
         with open(args.history, "a", encoding="utf-8") as fh:

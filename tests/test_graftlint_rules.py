@@ -899,3 +899,436 @@ def test_r1_positional_partial_args_are_static():
 
     step = jax.jit(partial(g, 3))
     """) == []
+
+
+# --------------------------------------------------------------------------
+# R9 — lock discipline (guarded state accessed off-lock)
+# --------------------------------------------------------------------------
+
+def test_r9_declared_guard_flags_offlock_access():
+    findings = lint("""
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.pending = []  # guarded-by: _lock
+
+        def add(self, x):
+            with self._lock:
+                self.pending.append(x)
+
+        def peek(self):
+            return self.pending[0]
+    """)
+    assert rules_of(findings) == ["R9"]
+    assert "pending" in findings[0].message
+    assert "_lock" in findings[0].message
+
+
+def test_r9_inference_from_locked_write_majority():
+    # no declaration, but every write sits under the lock: the guard is
+    # inferred and the unlocked read flags — the PR-8 elector-tick shape
+    findings = lint("""
+    import threading
+
+    class Elector:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.pending = []
+
+        def enqueue(self, fn):
+            with self._lock:
+                self.pending.append(fn)
+
+        def clear(self):
+            with self._lock:
+                self.pending = []
+
+        def tick(self):
+            for fn in self.pending:
+                fn()
+    """)
+    assert rules_of(findings) == ["R9"]
+    assert "inferred" in findings[0].message
+
+
+def test_r9_inference_below_threshold_stays_quiet():
+    # half the writes are unlocked: no majority, no inferred guard —
+    # the class just isn't lock-disciplined and R9 must not guess
+    assert lint("""
+    import threading
+
+    class Sloppy:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def locked_inc(self):
+            with self._lock:
+                self.n += 1
+
+        def unlocked_inc(self):
+            self.n += 1
+
+        def read(self):
+            return self.n
+    """) == []
+
+
+def test_r9_interprocedural_helper_under_lock_is_covered():
+    # the helper only ever runs with the lock held (every intraclass
+    # call site holds it): its accesses are NOT off-lock
+    assert lint("""
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = {}  # guarded-by: _lock
+
+        def put(self, k, v):
+            with self._lock:
+                self._store(k, v)
+
+        def _store(self, k, v):
+            self.items[k] = v
+    """) == []
+
+
+def test_r9_locked_suffix_convention_assumes_locks_held():
+    # *_locked names declare "caller holds the lock" — the runtime twin
+    # is sanitize.assert_held; the static rule honors the convention
+    assert lint("""
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = {}  # guarded-by: _lock
+
+        def put(self, k, v):
+            with self._lock:
+                self._store_locked(k, v)
+
+        def _store_locked(self, k, v):
+            self.items[k] = v
+    """) == []
+
+
+def test_r9_helper_also_called_offlock_flags():
+    findings = lint("""
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = {}  # guarded-by: _lock
+
+        def put(self, k, v):
+            with self._lock:
+                self._store(k, v)
+
+        def sneak(self, k, v):
+            self._store(k, v)
+
+        def _store(self, k, v):
+            self.items[k] = v
+    """)
+    assert rules_of(findings) == ["R9"]
+
+
+def test_r9_init_writes_do_not_need_the_lock():
+    assert lint("""
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = {}  # guarded-by: _lock
+            self.items["warm"] = 1
+
+        def put(self, k, v):
+            with self._lock:
+                self.items[k] = v
+    """) == []
+
+
+def test_r9_unguarded_class_stays_quiet():
+    assert lint("""
+    class Free:
+        def __init__(self):
+            self.items = {}
+
+        def put(self, k, v):
+            self.items[k] = v
+    """) == []
+
+
+# --------------------------------------------------------------------------
+# R10 — blocking calls under a held lock
+# --------------------------------------------------------------------------
+
+def test_r10_flags_sleep_result_readback_under_lock():
+    findings = lint("""
+    import threading
+    import time
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def bad_sleep(self):
+            with self._lock:
+                time.sleep(0.1)
+
+        def bad_result(self, fut):
+            with self._lock:
+                return fut.result()
+
+        def bad_readback(self, obs, x):
+            with self._lock:
+                return obs.jax.readback("site", x)
+    """)
+    assert rules_of(findings) == ["R10", "R10", "R10"]
+
+
+def test_r10_flags_hub_rpc_verb_under_lock():
+    findings = lint("""
+    import threading
+
+    class Service:
+        def __init__(self, hub):
+            self._lock = threading.Lock()
+            self.hub = hub
+
+        def rebind(self, pod, node):
+            with self._lock:
+                self.hub.bind_pod(pod, node)
+    """)
+    assert rules_of(findings) == ["R10"]
+    assert "bind_pod" in findings[0].message
+
+
+def test_r10_intraclass_verb_named_methods_are_not_rpcs():
+    # sim.py's hub calls its OWN delete_pod (an in-memory table op):
+    # self-calls are never blocking RPCs whatever they are named
+    assert lint("""
+    import threading
+
+    class Hub:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.pods = {}
+
+        def delete_pod(self, key):
+            self.pods.pop(key, None)
+
+        def gc(self, keys):
+            with self._lock:
+                for k in keys:
+                    self.delete_pod(k)
+    """) == []
+
+
+def test_r10_event_emission_under_lock_flags():
+    findings = lint("""
+    import threading
+
+    class Watchdog:
+        def __init__(self, sink):
+            self._lock = threading.Lock()
+            self.event_sink = sink
+
+        def observe(self, x):
+            with self._lock:
+                if x > 1:
+                    self.event_sink("Burn", None, "over budget")
+    """)
+    assert rules_of(findings) == ["R10"]
+
+
+def test_r10_emitter_closure_pr14_watchdog_shape():
+    # the PR-14 bug shape: observe() holds the lock and calls a helper
+    # that emits — the emission still happens under the lock even
+    # though no sink call is lexically inside the with block
+    findings = lint("""
+    import threading
+
+    class Watchdog:
+        def __init__(self, sink):
+            self._lock = threading.Lock()
+            self.event_sink = sink
+            self.burning = False
+
+        def observe(self, x):
+            with self._lock:
+                self._flip(x)
+
+        def _flip(self, x):
+            self.burning = x > 1
+            if self.burning:
+                self.event_sink("Burn", None, "over budget")
+    """)
+    assert findings and all(r == "R10" for r in rules_of(findings))
+
+
+def test_r10_emit_outside_lock_is_the_blessed_form():
+    # collect under the lock, emit after release — the shape the
+    # codebase's watchdog actually uses
+    assert lint("""
+    import threading
+
+    class Watchdog:
+        def __init__(self, sink):
+            self._lock = threading.Lock()
+            self.event_sink = sink
+
+        def observe(self, x):
+            with self._lock:
+                burn = x > 1
+            if burn:
+                self.event_sink("Burn", None, "over budget")
+    """) == []
+
+
+def test_r10_sleep_outside_lock_stays_quiet():
+    assert lint("""
+    import threading
+    import time
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def pace(self):
+            with self._lock:
+                n = 1
+            time.sleep(n)
+    """) == []
+
+
+# --------------------------------------------------------------------------
+# R9/R10 suppression, scope, and baseline round-trips
+# --------------------------------------------------------------------------
+
+R9_POSITIVE = """
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = []  # guarded-by: _lock
+
+    def add(self, x):
+        with self._lock:
+            self.pending.append(x)
+
+    def peek(self):
+        return self.pending[0]
+"""
+
+
+def test_r9_inline_disable_with_reason():
+    src = R9_POSITIVE.replace(
+        "return self.pending[0]",
+        "return self.pending[0]"
+        "  # graftlint: disable=R9 -- single-writer init path")
+    assert lint(src) == []
+
+
+def test_r10_scope_disable_with_reason():
+    findings = lint("""
+    import threading
+    import time
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        # graftlint: disable-scope=R10 -- deliberate paced drain
+        def pace(self):
+            with self._lock:
+                time.sleep(0.01)
+
+        def bad(self):
+            with self._lock:
+                time.sleep(0.01)
+    """)
+    assert rules_of(findings) == ["R10"]
+    assert findings[0].line > 0
+
+
+def test_r9_disable_without_reason_trips_hygiene():
+    # a justification-free disable is no suppression at all: the R9
+    # finding survives AND the hygiene rule flags the naked directive
+    src = R9_POSITIVE.replace(
+        "return self.pending[0]",
+        "return self.pending[0]  # graftlint: disable=R9")
+    assert sorted(rules_of(lint(src))) == ["R0", "R9"]
+
+
+def test_r9_r10_baseline_roundtrip(tmp_path):
+    findings = lint(R9_POSITIVE)
+    assert rules_of(findings) == ["R9"]
+    path = tmp_path / "baseline.json"
+    write_baseline(findings, str(path))
+    kept, baselined = subtract_baseline(findings, load_baseline(str(path)))
+    assert kept == [] and baselined == 1
+
+
+# --------------------------------------------------------------------------
+# Regression pins: the exact bug shapes the PR-17 tree sweep fixed.
+# The real files are kept clean by the merged-tree sweep gate; these
+# fixtures pin that the RULES keep catching the same bug classes.
+# --------------------------------------------------------------------------
+
+def test_r9_catches_the_work_helper_offlock_shape():
+    # obs/ledger.py pre-fix: a helper reading a guarded dict was called
+    # both under the lock (record_anchor) and outside it (predict's
+    # tail) — fixed by snapshotting under the lock and passing the
+    # value in. The rule must keep flagging the pre-fix shape.
+    findings = lint("""
+    import threading
+
+    class Model:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.sig = {}  # guarded-by: _lock
+
+        def record(self, k, v):
+            with self._lock:
+                self.sig[k] = v
+
+        def anchored(self, k):
+            with self._lock:
+                return self._work(k)
+
+        def predict(self, k):
+            return self._work(k)
+
+        def _work(self, k):
+            return self.sig.get(k, 0)
+    """)
+    assert "R9" in rules_of(findings)
+
+
+def test_r9_catches_the_ack_revision_offlock_shape():
+    # grpc_shim.py pre-fix: the sync stream read self.revision for the
+    # ack AFTER the with block released the lock — another stream could
+    # advance it first, acking deltas this stream never applied.
+    findings = lint("""
+    import threading
+
+    class Stream:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.revision = 0
+
+        def apply(self, delta):
+            with self.lock:
+                self.revision = max(self.revision, delta)
+            return self.revision
+    """)
+    assert rules_of(findings) == ["R9"]
+    assert "revision" in findings[0].message

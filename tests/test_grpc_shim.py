@@ -324,3 +324,62 @@ def test_grpc_bearer_token_gates_every_rpc():
         c.close()
     finally:
         open_server.stop(grace=None)
+
+
+def test_bind_releases_service_lock_across_the_binder_hop():
+    """Regression pin (graftlint R10 sweep): the binder may be a real
+    network hop — the chaos harness wraps it in injected latency and
+    timeouts — so bind() must NOT hold the service lock across it, or
+    every other verb (filter, prioritize, delta ingest) stalls for the
+    round trip. The assume-then-bind design makes the release safe:
+    the pod is already reserved when the lock drops."""
+    from kubernetes_tpu.grpc_shim import TpuSchedulerService
+
+    sched = Scheduler(clock=FakeClock(), enable_preemption=False)
+    service = TpuSchedulerService(sched)
+    lock_free_during_bind = []
+
+    class ProbeBinder:
+        bindings = []
+
+        def bind(self, pod, node):
+            # on the old shape this acquire fails: bind() held the lock
+            got = service.lock.acquire(blocking=False)
+            if got:
+                service.lock.release()
+            lock_free_during_bind.append(got)
+            self.bindings.append((pod.key(), node))
+
+    sched.binder = ProbeBinder()
+    sched.on_node_add(make_node("n0", cpu_milli=4000))
+    sched.queue.add(make_pod("w", cpu_milli=100))
+    r = service.bind(pb.Binding(pod_key="default/w", node="n0"), None)
+    assert r.ok, r.error
+    assert lock_free_during_bind == [True]
+    # and the assume still protects against a concurrent double bind
+    assert sched.cache.pod("default/w") is not None
+
+
+def test_bind_assumes_before_releasing_the_lock():
+    """Companion pin: when the binder runs, the pod must already be
+    ASSUMED in the cache (the optimistic reservation that makes
+    dropping the lock safe) and gone from the queue."""
+    from kubernetes_tpu.grpc_shim import TpuSchedulerService
+
+    sched = Scheduler(clock=FakeClock(), enable_preemption=False)
+    service = TpuSchedulerService(sched)
+    seen = {}
+
+    class ProbeBinder:
+        bindings = []
+
+        def bind(self, pod, node):
+            seen["assumed"] = sched.cache.is_assumed("default/w")
+            seen["queued"] = sched.queue.pod("default/w") is not None
+
+    sched.binder = ProbeBinder()
+    sched.on_node_add(make_node("n0", cpu_milli=4000))
+    sched.queue.add(make_pod("w", cpu_milli=100))
+    r = service.bind(pb.Binding(pod_key="default/w", node="n0"), None)
+    assert r.ok, r.error
+    assert seen == {"assumed": True, "queued": False}

@@ -194,7 +194,7 @@ def test_lint_surface():
     from kubernetes_tpu.testing import lint_clean
 
     assert RULE_IDS == ("R0", "R1", "R2", "R3", "R4", "R5", "R6", "R7",
-                        "R8")
+                        "R8", "R9", "R10")
     assert set(RULE_SUMMARIES) == set(RULE_IDS)
     sig = inspect.signature(run_lint)
     for kw in ("root", "select", "respect_suppressions"):

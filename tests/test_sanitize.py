@@ -1,0 +1,488 @@
+"""Instrumented-lock runtime sanitizer (kubernetes_tpu/sanitize.py).
+
+The static rules (graftlint R9/R10, tests/test_graftlint_rules.py)
+prove discipline for acquisitions the linter can see lexically; these
+tests prove the runtime half: the acquisition-order graph catches a
+deadlock-SHAPED interleaving with plain sequential execution (no live
+contention needed), hold budgets run on the injected clock, dynamic
+guarded-by declarations are enforced, and the whole thing is a plain
+``threading`` lock when unarmed.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.sanitize import (
+    InstrumentedLock,
+    LockSanitizer,
+    LockSanitizerConfig,
+    assert_held,
+    make_lock,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def run_in_thread(fn) -> None:
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+# -- order-cycle detection --------------------------------------------------
+
+
+def test_two_thread_lock_order_cycle_detected_sequentially():
+    """The seeded deadlock shape: thread 1 takes A then B, thread 2
+    takes B then A. Nothing ever blocks (the threads run one after the
+    other), but the order GRAPH gains the cycle A->B->A — exactly the
+    hazard a real interleaving would deadlock on."""
+    san = LockSanitizer(LockSanitizerConfig(enabled=True))
+    a = san.make_lock("A")
+    b = san.make_lock("B")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    run_in_thread(t1)
+    assert san.counts()["order-cycle"] == 0  # one order alone is fine
+    run_in_thread(t2)
+    assert san.counts()["order-cycle"] == 1
+    (f,) = [x for x in san.findings() if x.kind == "order-cycle"]
+    assert set(f.locks) == {"A", "B"}
+    assert "deadlock" in f.detail
+
+
+def test_three_lock_cycle_detected_through_transitive_edges():
+    san = LockSanitizer(LockSanitizerConfig(enabled=True))
+    a, b, c = (san.make_lock(n) for n in "ABC")
+
+    def chain(x, y):
+        def go():
+            with x:
+                with y:
+                    pass
+        return go
+
+    run_in_thread(chain(a, b))
+    run_in_thread(chain(b, c))
+    assert san.counts()["order-cycle"] == 0
+    run_in_thread(chain(c, a))  # closes A->B->C->A
+    assert san.counts()["order-cycle"] == 1
+
+
+def test_consistent_order_never_flags():
+    san = LockSanitizer(LockSanitizerConfig(enabled=True))
+    a = san.make_lock("A")
+    b = san.make_lock("B")
+    for _ in range(3):
+        def ordered():
+            with a:
+                with b:
+                    pass
+        run_in_thread(ordered)
+    assert san.total_findings() == 0
+
+
+def test_cycle_findings_dedupe():
+    """One bad pattern in a hot loop is one finding, not a flood."""
+    san = LockSanitizer(LockSanitizerConfig(enabled=True))
+    a = san.make_lock("A")
+    b = san.make_lock("B")
+
+    def inverted():
+        with b:
+            with a:
+                pass
+
+    def ordered():
+        with a:
+            with b:
+                pass
+
+    run_in_thread(ordered)
+    for _ in range(5):
+        run_in_thread(inverted)
+    assert san.counts()["order-cycle"] == 1
+
+
+def test_rlock_reentrancy_is_not_a_cycle():
+    san = LockSanitizer(LockSanitizerConfig(enabled=True))
+    r = san.make_lock("R", kind="rlock")
+    with r:
+        with r:  # re-entering the SAME lock is not an ordering edge
+            pass
+    assert san.total_findings() == 0
+
+
+# -- held-too-long ----------------------------------------------------------
+
+
+def test_held_too_long_on_fake_clock():
+    clock = FakeClock()
+    san = LockSanitizer(
+        LockSanitizerConfig(enabled=True, hold_budget_s=0.25), clock=clock)
+    lk = san.make_lock("slow")
+    with lk:
+        clock.advance(0.3)
+    assert san.counts()["held-too-long"] == 1
+    (f,) = san.findings()
+    assert f.locks == ("slow",)
+    # within budget: no new finding, and the first one stays deduped
+    with lk:
+        clock.advance(0.1)
+    with lk:
+        clock.advance(0.9)
+    assert san.counts()["held-too-long"] == 1
+
+
+def test_hold_budget_zero_disables_the_check():
+    clock = FakeClock()
+    san = LockSanitizer(
+        LockSanitizerConfig(enabled=True, hold_budget_s=0.0), clock=clock)
+    lk = san.make_lock("slow")
+    with lk:
+        clock.advance(60.0)
+    assert san.total_findings() == 0
+
+
+def test_reentrant_hold_timed_at_outermost_release():
+    clock = FakeClock()
+    san = LockSanitizer(
+        LockSanitizerConfig(enabled=True, hold_budget_s=0.25), clock=clock)
+    r = san.make_lock("R", kind="rlock")
+    with r:
+        with r:
+            pass
+        clock.advance(0.3)  # after inner release, still held
+    assert san.counts()["held-too-long"] == 1
+
+
+# -- guard violations -------------------------------------------------------
+
+
+def test_assert_held_flags_unheld_declaration():
+    san = LockSanitizer(LockSanitizerConfig(enabled=True))
+    lk = san.make_lock("cache.snap", kind="rlock")
+    with lk:
+        assert_held(lk, "site.locked_path")  # true declaration: quiet
+    assert san.total_findings() == 0
+    assert_held(lk, "site.locked_path")  # false declaration
+    assert san.counts()["guard-violation"] == 1
+    (f,) = san.findings()
+    assert "site.locked_path" in f.detail
+    assert_held(lk, "site.locked_path")  # same site: deduped
+    assert san.counts()["guard-violation"] == 1
+    assert_held(lk, "site.other")  # new site: new finding
+    assert san.counts()["guard-violation"] == 2
+
+
+def test_debug_guards_off_suppresses_guard_findings():
+    san = LockSanitizer(
+        LockSanitizerConfig(enabled=True, debug_guards=False))
+    lk = san.make_lock("L")
+    assert_held(lk, "anywhere")
+    assert san.total_findings() == 0
+
+
+def test_assert_held_noops_on_plain_locks():
+    assert_held(threading.Lock(), "anywhere")
+    assert_held(threading.RLock(), "anywhere")
+
+
+# -- off-by-default / zero-cost seam ----------------------------------------
+
+
+def test_make_lock_without_factory_returns_plain_threading_locks():
+    lk = make_lock(None, "x")
+    rk = make_lock(None, "x", "rlock")
+    assert not isinstance(lk, InstrumentedLock)
+    assert not isinstance(rk, InstrumentedLock)
+    # the plain objects still do their job
+    with lk:
+        pass
+    with rk:
+        with rk:
+            pass
+
+
+def test_make_lock_with_factory_returns_instrumented():
+    san = LockSanitizer(LockSanitizerConfig(enabled=True))
+    lk = make_lock(san.factory(), "obs.test")
+    assert isinstance(lk, InstrumentedLock)
+    assert lk.name == "obs.test"
+    rk = make_lock(san.factory("pfx."), "inner", "rlock")
+    assert rk.name == "pfx.inner"
+
+
+def test_scheduler_off_by_default_uses_plain_locks():
+    from kubernetes_tpu.scheduler import Scheduler
+
+    s = Scheduler()
+    assert s.lock_sanitizer is None
+    assert not isinstance(s.cache._snap_lock, InstrumentedLock)
+    assert not isinstance(s.obs.jax._lock, InstrumentedLock)
+    assert not isinstance(s.obs.recorder._lock, InstrumentedLock)
+
+
+# -- instrumented lock surface ----------------------------------------------
+
+
+def test_instrumented_lock_acquire_release_surface():
+    san = LockSanitizer(LockSanitizerConfig(enabled=True))
+    lk = san.make_lock("L")
+    assert lk.acquire()
+    assert lk.held_by_me()
+    assert san.held_names() == ("L",)
+    lk.release()
+    assert not lk.held_by_me()
+    assert san.held_names() == ()
+    # non-blocking acquire on a lock another thread holds fails clean
+    lk.acquire()
+    got = []
+    run_in_thread(lambda: got.append(lk.acquire(blocking=False)))
+    assert got == [False]
+    lk.release()
+
+
+def test_on_finding_callback_receives_kind_and_may_lock():
+    """The metrics wiring: on_finding is invoked OUTSIDE the
+    sanitizer's meta-lock, so a callback that itself takes a lock
+    (a metrics registry does) cannot close a cycle through us."""
+    san_holder = {}
+    kinds = []
+    cb_lock = threading.Lock()
+
+    def cb(kind):
+        with cb_lock:
+            # re-entering the sanitizer from the callback must not
+            # deadlock on _meta
+            san_holder["san"].counts()
+            kinds.append(kind)
+
+    san = LockSanitizer(LockSanitizerConfig(enabled=True), on_finding=cb)
+    san_holder["san"] = san
+    a = san.make_lock("A")
+    b = san.make_lock("B")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    run_in_thread(t1)
+    run_in_thread(t2)
+    assert kinds == ["order-cycle"]
+
+
+def test_findings_ring_is_bounded_but_counts_accumulate():
+    san = LockSanitizer(
+        LockSanitizerConfig(enabled=True, max_findings=2))
+    lk = san.make_lock("L")
+    for i in range(5):
+        assert_held(lk, f"site{i}")
+    assert san.counts()["guard-violation"] == 5
+    assert len(san.findings()) == 2
+    snap = san.snapshot()
+    assert snap["counts"]["guard-violation"] == 5
+    assert len(snap["findings"]) == 2
+
+
+# -- scheduler / observability integration ----------------------------------
+
+
+def armed_scheduler(**kw):
+    from kubernetes_tpu.config import ObservabilityConfig
+    from kubernetes_tpu.scheduler import Scheduler
+
+    return Scheduler(observability=ObservabilityConfig(
+        lock_sanitizer=LockSanitizerConfig(enabled=True, **kw)))
+
+
+def test_armed_scheduler_instruments_the_lock_inventory():
+    s = armed_scheduler()
+    assert s.lock_sanitizer is not None
+    for lk, name in [
+        (s.cache._snap_lock, "cache.snap"),
+        (s.obs.jax._lock, "obs.jaxtel"),
+        (s.obs.recorder._lock, "obs.recorder"),
+        (s.obs._traces_lock, "obs.traces"),
+        (s.obs.ledger._lock, "obs.ledger"),
+        (s.obs.ledger.watchdog._lock, "obs.watchdog"),
+        (s.obs.ledger.model._lock, "obs.costmodel"),
+    ]:
+        assert isinstance(lk, InstrumentedLock), name
+        assert lk.name == name
+
+
+def test_armed_scheduler_findings_hit_the_metric_counter():
+    s = armed_scheduler()
+    a = s.lock_sanitizer.make_lock("test.A")
+    b = s.lock_sanitizer.make_lock("test.B")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    run_in_thread(t1)
+    run_in_thread(t2)
+    assert s.metrics.lock_sanitizer_findings.value(
+        kind="order-cycle") == 1.0
+
+
+def test_lock_findings_mark_the_cycle_eventful_in_the_flight_record():
+    """A finding during an otherwise-idle cycle must still produce a
+    CycleRecord — a latent deadlock hazard is black-box material."""
+    s = armed_scheduler()
+    obs = s.obs
+    obs.begin_cycle(1)
+    lk = s.lock_sanitizer.make_lock("test.L")
+    assert_held(lk, "test.site")  # guard violation mid-cycle
+    obs.end_cycle(None)
+    recs = obs.recorder.records()
+    assert len(recs) == 1
+    assert recs[0].lock_findings == 1
+    assert recs[0].to_json()["lock_findings"] == 1
+    assert "lockfind=1" in obs.recorder.dump()
+    # a clean idle cycle still records nothing
+    obs.begin_cycle(2)
+    obs.end_cycle(None)
+    assert len(obs.recorder.records()) == 1
+
+
+def test_serving_loop_lock_rides_the_sanitizer():
+    from kubernetes_tpu.config import ServingConfig
+    from kubernetes_tpu.serving.microbatch import ServingLoop
+
+    s = armed_scheduler()
+    loop = ServingLoop(s, ServingConfig(enabled=True))
+    assert isinstance(loop.lock, InstrumentedLock)
+    assert loop.lock.name == "serving.loop"
+
+
+def test_soak_sentinels_sample_lock_namespace():
+    from kubernetes_tpu.soak import SoakSentinels
+
+    s = armed_scheduler()
+    lk = s.lock_sanitizer.make_lock("test.L")
+    assert_held(lk, "test.site")
+    sent = SoakSentinels(sched=s)
+    out = sent.collect()
+    assert out["lock.guard_violations"] == 1.0
+    assert out["lock.order_cycles"] == 0.0
+    assert out["lock.total"] == 1.0
+    # unarmed scheduler: no lock.* keys at all
+    from kubernetes_tpu.scheduler import Scheduler
+
+    out2 = SoakSentinels(sched=Scheduler()).collect()
+    assert not [k for k in out2 if k.startswith("lock.")]
+
+
+def test_armed_schedule_cycle_stays_clean():
+    """The acceptance shape in miniature: a real scheduling cycle with
+    every lock instrumented produces zero findings."""
+    from kubernetes_tpu.testing import make_node, make_pod
+
+    s = armed_scheduler(hold_budget_s=0.0)
+    s.on_node_add(make_node("n0", cpu_milli=4000, memory=8 * 2**30,
+                            pods=10))
+    s.on_pod_add(make_pod("p0", cpu_milli=100, memory=2**20))
+    res = s.schedule_cycle()
+    assert res.scheduled == 1
+    assert s.lock_sanitizer.total_findings() == 0
+
+
+def test_config_roundtrip_arms_the_sanitizer():
+    from kubernetes_tpu.api.config_v1alpha1 import decode, encode
+
+    cfg = decode({
+        "apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
+        "kind": "KubeSchedulerConfiguration",
+        "observability": {"lockSanitizer": {
+            "enabled": True, "holdBudget": "100ms",
+            "debugGuards": False, "maxFindings": 8}},
+    })
+    ls = cfg.observability.lock_sanitizer
+    assert ls.enabled is True
+    assert ls.hold_budget_s == pytest.approx(0.1)
+    assert ls.debug_guards is False
+    assert ls.max_findings == 8
+    back = encode(cfg)["observability"]["lockSanitizer"]
+    assert back["enabled"] is True
+    assert back["holdBudget"] == "100ms"
+
+
+def test_flight_recorder_len_takes_the_lock():
+    """Regression pin (R9 sweep): ``len(recorder)`` reads the deque the
+    scheduler thread appends to — it must go through the lock like
+    every other reader, not race the append."""
+    from kubernetes_tpu.obs.recorder import CycleRecord, FlightRecorder
+
+    acquisitions = []
+
+    class SpyLock:
+        def __enter__(self):
+            acquisitions.append("acquire")
+            return self
+
+        def __exit__(self, *exc):
+            return None
+
+    rec = FlightRecorder(capacity=4,
+                         lock_factory=lambda name, kind="lock": SpyLock())
+    rec.record(CycleRecord(cycle=1))
+    acquisitions.clear()
+    assert len(rec) == 1
+    assert acquisitions == ["acquire"]
+
+
+def test_validate_config_rejects_bad_sanitizer_budgets():
+    """cli.validate_config covers the lockSanitizer block like every
+    other observability knob: a negative hold budget or a zero findings
+    ring is a config error, not a silent misarm."""
+    from kubernetes_tpu.cli import validate_config
+    from kubernetes_tpu.config import (
+        KubeSchedulerConfiguration,
+        ObservabilityConfig,
+    )
+
+    cfg = KubeSchedulerConfiguration(
+        observability=ObservabilityConfig(
+            lock_sanitizer=LockSanitizerConfig(
+                hold_budget_s=-1.0, max_findings=0)))
+    joined = "\n".join(validate_config(cfg))
+    assert "lockSanitizer.holdBudget" in joined
+    assert "lockSanitizer.maxFindings" in joined
+    # the defaults stay valid
+    assert validate_config(KubeSchedulerConfiguration()) == []

@@ -132,3 +132,29 @@ def test_lint_cli_json_exit_codes(tmp_path):
     typo = run(tmp_path / "no_such_dir")
     assert typo.returncode == 2, (typo.stdout, typo.stderr)
     assert "do not exist" in typo.stderr
+
+
+def test_lint_cli_unknown_select_rule_is_usage_error(tmp_path):
+    """``--select`` with a rule id the engine doesn't know is a usage
+    error (exit 2) naming the known rules — a typo like ``--select R01``
+    in CI must fail loudly, not silently lint nothing and pass."""
+    import subprocess
+    import sys
+
+    from kubernetes_tpu.lint.engine import RULE_IDS
+
+    target = tmp_path / "ok.py"
+    target.write_text("X = 1\n")
+
+    bad = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu.lint", str(target),
+         "--select", "R9,R99", "--no-baseline", "--root", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert bad.returncode == 2, (bad.stdout, bad.stderr)
+    assert "unknown rule id" in bad.stderr and "R99" in bad.stderr
+    # the error message must enumerate the valid universe so the fix is
+    # one glance away
+    for rule in RULE_IDS:
+        assert rule in bad.stderr
