@@ -162,6 +162,21 @@ class LedgerConfigurationV1alpha1:
 
 
 @dataclass
+class MemoryLedgerConfigurationV1alpha1:
+    """Versioned spelling of the device-memory ledger block
+    (config.MemoryLedgerConfig): camelCase, the sample interval as a
+    metav1.Duration string like every other versioned time field."""
+
+    enabled: Optional[bool] = None
+    sampleInterval: Optional[str] = None  # "0s" = every cycle boundary
+    preflight: Optional[bool] = None
+    headroomFrac: Optional[float] = None
+    limitBytes: Optional[int] = None  # 0 = device-reported limit
+    history: Optional[int] = None
+    censusLimit: Optional[int] = None
+
+
+@dataclass
 class LockSanitizerConfigurationV1alpha1:
     """Versioned spelling of the instrumented-lock sanitizer block
     (sanitize.LockSanitizerConfig): camelCase, the hold budget as a
@@ -192,6 +207,8 @@ class ObservabilityConfigurationV1alpha1:
     auditInterval: Optional[str] = None  # "0s" = serving auditor off
     ledger: "LedgerConfigurationV1alpha1" = field(
         default_factory=LedgerConfigurationV1alpha1)
+    memoryLedger: "MemoryLedgerConfigurationV1alpha1" = field(
+        default_factory=MemoryLedgerConfigurationV1alpha1)
     lockSanitizer: "LockSanitizerConfigurationV1alpha1" = field(
         default_factory=LockSanitizerConfigurationV1alpha1)
 
@@ -475,6 +492,23 @@ def set_defaults_kube_scheduler_configuration(
         lg.burnThreshold = 1.0
     if lg.engagePressure is None:
         lg.engagePressure = True
+    mlg = ob.memoryLedger
+    if mlg.enabled is None:
+        mlg.enabled = True
+    # internal default: census off the per-cycle path ("0s" opts into
+    # every-boundary sampling)
+    if mlg.sampleInterval is None:
+        mlg.sampleInterval = "500ms"
+    if mlg.preflight is None:
+        mlg.preflight = True
+    if mlg.headroomFrac is None:
+        mlg.headroomFrac = 0.9
+    if mlg.limitBytes is None:
+        mlg.limitBytes = 0  # device-reported limit
+    if mlg.history is None:
+        mlg.history = 128
+    if mlg.censusLimit is None:
+        mlg.censusLimit = 4096
     ls = ob.lockSanitizer
     if ls.enabled is None:
         ls.enabled = False  # plain threading locks by default
@@ -751,10 +785,15 @@ def _warmup_to_internal(wu: WarmupConfigurationV1alpha1):
 
 
 def _observability_to_internal(ob: ObservabilityConfigurationV1alpha1):
-    from kubernetes_tpu.config import LedgerConfig, ObservabilityConfig
+    from kubernetes_tpu.config import (
+        LedgerConfig,
+        MemoryLedgerConfig,
+        ObservabilityConfig,
+    )
     from kubernetes_tpu.sanitize import LockSanitizerConfig
 
     lg = ob.ledger
+    mlg = ob.memoryLedger
     ls = ob.lockSanitizer
     return ObservabilityConfig(
         enabled=ob.enabled,
@@ -784,6 +823,16 @@ def _observability_to_internal(ob: ObservabilityConfigurationV1alpha1):
                                "observability"),
             burn_threshold=lg.burnThreshold,
             engage_pressure=lg.engagePressure,
+        ),
+        memory_ledger=MemoryLedgerConfig(
+            enabled=mlg.enabled,
+            sample_interval_s=_dur("memoryLedger.sampleInterval",
+                                   mlg.sampleInterval, "observability"),
+            preflight=mlg.preflight,
+            headroom_frac=mlg.headroomFrac,
+            limit_bytes=mlg.limitBytes,
+            history=mlg.history,
+            census_limit=mlg.censusLimit,
         ),
         lock_sanitizer=LockSanitizerConfig(
             enabled=ls.enabled,
@@ -932,6 +981,16 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
                     c.observability.ledger.slow_window_s),
                 burnThreshold=c.observability.ledger.burn_threshold,
                 engagePressure=c.observability.ledger.engage_pressure,
+            ),
+            memoryLedger=MemoryLedgerConfigurationV1alpha1(
+                enabled=c.observability.memory_ledger.enabled,
+                sampleInterval=format_duration(
+                    c.observability.memory_ledger.sample_interval_s),
+                preflight=c.observability.memory_ledger.preflight,
+                headroomFrac=c.observability.memory_ledger.headroom_frac,
+                limitBytes=c.observability.memory_ledger.limit_bytes,
+                history=c.observability.memory_ledger.history,
+                censusLimit=c.observability.memory_ledger.census_limit,
             ),
             lockSanitizer=LockSanitizerConfigurationV1alpha1(
                 enabled=c.observability.lock_sanitizer.enabled,

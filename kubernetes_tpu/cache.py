@@ -106,6 +106,13 @@ class SchedulerCache:
         #: (device_lost / device_oom) raise from device_snapshot(),
         #: exercising the scheduler's resident-rebuild recovery
         self.fault_injector = None
+        #: obs.memledger.MemoryLedger (or None): device-memory
+        #: accounting for the resident table + score summary — the
+        #: scheduler attaches it post-construction (duck-typed, same
+        #: contract as the injector above). Registrations ride the
+        #: cache's OWN upload/drop edges so the ledger can never show
+        #: a resident this cache already dropped
+        self.memledger = None
         # ---- incremental-solve score cache (ops/fused_score) ---------
         #: device-resident NodeSummary aligned row-for-row with the
         #: resident DeviceNodes: the per-node slice of the score/
@@ -501,6 +508,8 @@ class SchedulerCache:
             self.last_snapshot_mode = "full"
             self.last_upload_rows = table.n
             self.last_upload_nbytes = tree_nbytes(self._dev)
+            self._mem_register("cache.node_table", self.last_upload_nbytes,
+                               shape=f"N{n_pad}")
             if self._score_cache_on:
                 # full rebuild: the whole score plane is recomputed —
                 # drop the summary (rebuilt lazily from the new resident
@@ -508,6 +517,7 @@ class SchedulerCache:
                 # keyed on it (Sinkhorn potentials) is invalidated too
                 self._summary = None
                 self.summary_generation += 1
+                self._mem_deregister("cache.score_summary")
         elif not self._pending_dev:
             self.last_snapshot_mode = "clean"
         else:
@@ -574,6 +584,28 @@ class SchedulerCache:
         self._summary = None
         self.last_patched_idx = []
         self.summary_generation += 1
+        # every ledger byte this cache owns dies with the drop — a
+        # registration surviving here is exactly the leak the soak's
+        # mem_residents sentinel exists to catch
+        self._mem_deregister("cache.node_table", "cache.score_summary")
+
+    def has_device_snapshot(self) -> bool:
+        """Whether a resident device table currently exists (no upload,
+        no lazy build) — the scheduler's state_sizes device keys and the
+        drop-audit tests read this."""
+        return self._dev is not None
+
+    def _mem_register(self, name: str, nbytes: int, shape: str = "") -> None:
+        """Duck-typed memory-ledger registration (no-op unattached)."""
+        ml = self.memledger
+        if ml is not None and getattr(ml, "enabled", False):
+            ml.register(name, nbytes, shape=shape)
+
+    def _mem_deregister(self, *names: str) -> None:
+        ml = self.memledger
+        if ml is not None and getattr(ml, "enabled", False):
+            for n in names:
+                ml.deregister(n)
 
     # -- incremental-solve score cache --------------------------------------
 
@@ -590,6 +622,7 @@ class SchedulerCache:
                                "prefer_packed": bool(prefer_packed)}
         self._summary = None
         self.summary_generation += 1
+        self._mem_deregister("cache.score_summary")
 
     def drop_score_summary(self) -> None:
         """Drop ONLY the cached score plane (the resident node table is
@@ -601,6 +634,7 @@ class SchedulerCache:
         with self._snap_lock:
             self._summary = None
             self.summary_generation += 1
+            self._mem_deregister("cache.score_summary")
 
     def has_score_summary(self) -> bool:
         """Whether a cached score plane currently exists (no lazy
@@ -621,11 +655,15 @@ class SchedulerCache:
             if not self._score_cache_on or self._dev is None:
                 return None
             if self._summary is None:
+                from kubernetes_tpu.obs.jaxtel import tree_nbytes
                 from kubernetes_tpu.ops.fused_score import node_summary
 
                 self._summary = node_summary(self._dev,
                                              **self._summary_flags)
                 self.last_summary_rebuilt = True
+                self._mem_register("cache.score_summary",
+                                   tree_nbytes(self._summary),
+                                   shape=f"N{self._dev_pad}")
             return self._summary
 
     def _full_repack(self) -> NodeTable:

@@ -34,6 +34,7 @@ from kubernetes_tpu.config import (
     KubeSchedulerConfiguration,
     LeaderElectionConfig,
     LedgerConfig,
+    MemoryLedgerConfig,
     ObservabilityConfig,
     ParallelConfig,
     RecoveryConfig,
@@ -222,6 +223,25 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
     if lg.burn_threshold <= 0:
         errs.append(
             "observability.ledger.burnThreshold: must be greater than zero")
+    mlg = oc.memory_ledger
+    if mlg.sample_interval_s < 0:
+        errs.append(
+            "observability.memoryLedger.sampleInterval: must be "
+            "non-negative (0 = sample every cycle boundary)")
+    if not 0 < mlg.headroom_frac <= 1:
+        errs.append(
+            f"observability.memoryLedger.headroomFrac: Invalid value "
+            f"{mlg.headroom_frac}: not in valid range (0, 1]")
+    if mlg.limit_bytes < 0:
+        errs.append(
+            "observability.memoryLedger.limitBytes: must be non-negative "
+            "(0 = use the device-reported limit)")
+    if mlg.history < 1:
+        errs.append(
+            "observability.memoryLedger.history: must be at least 1")
+    if mlg.census_limit < 1:
+        errs.append(
+            "observability.memoryLedger.censusLimit: must be at least 1")
     ls = oc.lock_sanitizer
     if ls.hold_budget_s < 0:
         errs.append(
@@ -305,6 +325,7 @@ _ROB_FIELDS = {f.name for f in dataclasses.fields(RobustnessConfig)}
 _REC_FIELDS = {f.name for f in dataclasses.fields(RecoveryConfig)}
 _OBS_FIELDS = {f.name for f in dataclasses.fields(ObservabilityConfig)}
 _LEDGER_FIELDS = {f.name for f in dataclasses.fields(LedgerConfig)}
+_MEMLEDGER_FIELDS = {f.name for f in dataclasses.fields(MemoryLedgerConfig)}
 _WARMUP_FIELDS = {f.name for f in dataclasses.fields(WarmupConfig)}
 _INC_FIELDS = {f.name for f in dataclasses.fields(IncrementalConfig)}
 _SERVING_FIELDS = {f.name for f in dataclasses.fields(ServingConfig)}
@@ -406,6 +427,19 @@ def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
                         f"{sorted(lunknown)}")
                     continue
                 okw["ledger"] = LedgerConfig(**lval)
+            if "memory_ledger" in okw:
+                mval = okw["memory_ledger"]
+                if not isinstance(mval, dict):
+                    errs.append(
+                        "observability.memoryLedger: expected a mapping")
+                    continue
+                munknown = set(mval) - _MEMLEDGER_FIELDS
+                if munknown:
+                    errs.append(
+                        f"observability.memoryLedger: unknown field(s) "
+                        f"{sorted(munknown)}")
+                    continue
+                okw["memory_ledger"] = MemoryLedgerConfig(**mval)
             kw["observability"] = ObservabilityConfig(**okw)
         elif key == "warmup":
             if not isinstance(val, dict):

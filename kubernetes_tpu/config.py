@@ -234,6 +234,49 @@ class LedgerConfig:
 
 
 @dataclass
+class MemoryLedgerConfig:
+    """Device-memory ledger (obs/memledger.py): HBM accounting with
+    three faces — modeled resident-byte accounting for every
+    device-resident structure, a measured side sampled at cycle
+    boundaries only (``device.memory_stats()`` where the backend
+    provides it, a bounded ``jax.live_arrays`` census otherwise), and
+    the warmup-captured per-bucket peak table the capacity preflight
+    judges each cycle's shape against. Rides the observability block
+    (``observability.memoryLedger``) like the perf ledger does."""
+
+    #: account resident structures + sample the measured side at cycle
+    #: boundaries/idle ticks. Off = zero per-cycle cost and the
+    #: preflight never engages.
+    enabled: bool = True
+    #: min seconds (owner clock) between measured-side samples; 0 =
+    #: every cycle boundary. The sample is host-only metadata reads —
+    #: never a device sync inside jit — but the CPU fallback's
+    #: live-array census walk is O(live arrays) (~ms at bench scale),
+    #: so the default keeps it off the per-cycle path: watermarks are
+    #: a trend instrument, not a per-cycle one.
+    sample_interval_s: float = 0.5
+    #: capacity preflight: capture ``memory_analysis()`` per warmed
+    #: bucket and judge each cycle's (P, N, mesh) against
+    #: limit x headroom_frac, splitting to a smaller warmed bucket or
+    #: shedding the batch instead of OOMing
+    preflight: bool = True
+    #: fraction of the device limit the preflight budgets (the rest is
+    #: headroom for XLA scratch the per-bucket analysis undercounts)
+    headroom_frac: float = 0.9
+    #: device memory limit in bytes for the preflight budget and the
+    #: ``limit`` gauge series. 0 = take the backend's
+    #: ``memory_stats()['bytes_limit']`` when it reports one (CPU
+    #: backends report none — the preflight then never fires unless a
+    #: limit is configured here)
+    limit_bytes: int = 0
+    #: ledger entry ring capacity (cycles) and watermark history length
+    history: int = 128
+    #: max arrays the ``jax.live_arrays`` census walks per sample (the
+    #: bounded fallback measured side on backends without memory_stats)
+    census_limit: int = 4096
+
+
+@dataclass
 class ObservabilityConfig:
     """Observability knobs (kubernetes_tpu/obs): cycle tracing, the JAX
     compile/retrace telemetry, and the flight recorder. All times ride
@@ -282,6 +325,10 @@ class ObservabilityConfig:
     #: perf ledger + SLO watchdog (obs/ledger.py): per-cycle
     #: measured-vs-modeled accounting, burn-rate objectives
     ledger: LedgerConfig = field(default_factory=LedgerConfig)
+    #: device-memory ledger (obs/memledger.py): modeled-vs-measured
+    #: resident-byte accounting, capacity preflight, OOM forensics
+    memory_ledger: MemoryLedgerConfig = field(
+        default_factory=MemoryLedgerConfig)
     #: instrumented-lock runtime sanitizer (sanitize.py): acquisition-
     #: order cycle detection, hold budgets, dynamic guarded-by checks —
     #: off by default (plain threading locks, zero overhead)

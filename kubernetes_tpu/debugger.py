@@ -36,6 +36,11 @@ def dump(scheduler) -> str:
     obs = getattr(scheduler, "obs", None)
     if obs is not None:
         lines.append(obs.recorder.dump())
+        memledger = getattr(obs, "memledger", None)
+        if memledger is not None and memledger.enabled:
+            # the device-memory view of the same postmortem: ranked
+            # residents, watermarks, preflight verdicts, OOM forensics
+            lines.append(memledger.dump())
     return "\n".join(lines)
 
 

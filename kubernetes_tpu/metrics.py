@@ -45,7 +45,15 @@ perf-ledger block (``obs/ledger.py``) —
 ``scheduler_cycle_modeled_cost_seconds`` measured-vs-modeled gauges,
 ``scheduler_cycle_phase_seconds{phase}`` per-phase attribution (stale
 phases read 0, the explain-gauge freshness rule), and
-``scheduler_slo_burn_rate{objective,window}``; plus the network-fault
+``scheduler_slo_burn_rate{objective,window}``; plus the device-memory
+ledger block (``obs/memledger.py``) —
+``scheduler_device_memory_bytes{kind,device}`` (resident | peak |
+limit measured per device, modeled = the ledger's resident
+registrations; stale device series read 0),
+``scheduler_memory_model_efficiency`` (modeled/measured bytes at the
+last sampled cycle boundary, -1 sentinel on sample-free cycles), and
+``scheduler_memory_preflight_total{action}`` (ok | split | shed
+capacity-preflight verdicts); plus the network-fault
 robustness block (PR 15) —
 ``scheduler_bind_ambiguous_total{resolution}`` (the ambiguous-RPC bind
 protocol's read-your-write verdicts) and
@@ -578,6 +586,35 @@ class SchedulerMetrics:
             "BOTH windows trips SchedulerSLOBurn and engages APF "
             "backpressure).",
             ["objective", "window"],
+        ))
+        # -- device-memory ledger (obs/memledger.py) --------------------
+        self.device_memory_bytes = r.register(Gauge(
+            "scheduler_device_memory_bytes",
+            "Device memory by kind: resident = measured bytes in use "
+            "per device (memory_stats; the bounded live-array census "
+            "on backends without it, device=\"census\"), peak = the "
+            "allocator's high watermark, limit = the device capacity "
+            "(0 = unknown), modeled = the ledger's summed resident "
+            "registrations (device=\"all\"). Devices that stop "
+            "reporting read 0 (freshness rule).",
+            ["kind", "device"],
+        ))
+        self.memory_model_efficiency = r.register(Gauge(
+            "scheduler_memory_model_efficiency",
+            "Modeled resident bytes / measured bytes in use at the "
+            "last sampled cycle boundary (1 = the byte model explains "
+            "everything the allocator holds; low = untracked device "
+            "memory — a leak or an unregistered resident; -1 = the "
+            "last boundary took no sample, same sentinel rule as "
+            "scheduler_cycle_model_efficiency).",
+        ))
+        self.memory_preflight = r.register(Counter(
+            "scheduler_memory_preflight_total",
+            "Capacity-preflight verdicts per cycle shape against the "
+            "warmed per-bucket memory_analysis table: ok = fits (or "
+            "not judgeable), split = trimmed to a smaller warmed "
+            "bucket, shed = requeued whole rather than OOMing.",
+            ["action"],
         ))
         # -- scenario packs (kubernetes_tpu/scenarios) ------------------
         self.scenario_quality = r.register(Gauge(

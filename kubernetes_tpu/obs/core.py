@@ -30,6 +30,7 @@ from typing import Callable, List, Optional
 
 from kubernetes_tpu.obs.jaxtel import JaxTelemetry
 from kubernetes_tpu.obs.ledger import PerfLedger
+from kubernetes_tpu.obs.memledger import MemoryLedger
 from kubernetes_tpu.obs.recorder import CycleRecord, FlightRecorder
 from kubernetes_tpu.obs.trace import Trace, chrome_trace_json
 from kubernetes_tpu.sanitize import make_lock
@@ -67,6 +68,14 @@ class Observability:
         self.ledger = PerfLedger(getattr(config, "ledger", None),
                                  metrics=metrics, clock=clock,
                                  lock_factory=lf)
+        #: device-memory ledger (obs/memledger.py): modeled resident
+        #: accounting + cycle-boundary measured sampling + the
+        #: preflight peak table + OOM forensics. Same duck-typed
+        #: config attach as the perf ledger.
+        self.memledger = MemoryLedger(getattr(config, "memory_ledger",
+                                              None),
+                                      metrics=metrics, clock=clock,
+                                      lock_factory=lf)
         self.traces: deque = deque(maxlen=max(1, config.trace_ring_capacity))
         #: guards the traces ring: the scheduler thread appends while the
         #: /debug/traces handler thread snapshots (deque iteration during
@@ -93,6 +102,10 @@ class Observability:
         #: violation count parks here until the next record, same
         #: between-cycles pattern as the takeover flag
         self._pending_invariants = 0
+        #: OOM forensic flags captured BETWEEN cycles (warmup aborts)
+        #: park here until the next begin_cycle stamps them, same
+        #: pattern as the takeover flag
+        self._pending_oom = ""
         #: sharded-backend provenance: device count of the scheduler's
         #: node-axis mesh (0 = single-device). Set once at construction
         #: (note_mesh); stamped on every cycle's flight record so a
@@ -114,9 +127,11 @@ class Observability:
                          "takeover": self._pending_takeover,
                          "device_resets": 0, "fenced_binds": 0,
                          "invariant_violations": self._pending_invariants,
-                         "ambiguous_binds": 0}
+                         "ambiguous_binds": 0,
+                         "oom_forensic": self._pending_oom}
         self._pending_takeover = 0
         self._pending_invariants = 0
+        self._pending_oom = ""
         self._sinkhorn_stats = None
         self._retraces_at_begin = self.jax.retrace_total()
         self._d2h_at_begin = self.jax.d2h_bytes_total()
@@ -238,6 +253,23 @@ class Observability:
         stays truthful per cycle, not per construction."""
         self._scratch["mesh"] = int(devices)
 
+    def note_preflight(self, action: str) -> None:
+        """The memory preflight's verdict for this cycle's shape
+        (ok | split | shed — ``preflight=`` flight-record flag when it
+        engaged)."""
+        self._scratch["preflight"] = action
+
+    def note_oom_forensic(self, flag: str) -> None:
+        """A DeviceOOM / device-loss forensic record was captured this
+        cycle (obs/memledger.record_oom): its ``mem=`` flag text lands
+        on the cycle's flight record, routing a postmortem to
+        /debug/memory. Between-cycles captures (warmup aborts) park for
+        the next record, same pattern as the takeover flag."""
+        if self.current_trace is not None:
+            self._scratch["oom_forensic"] = flag
+        else:
+            self._pending_oom = flag
+
     def note_sinkhorn(self, stats) -> None:
         """Stash the solver's (iters, residual) device pair; read back
         once at end_cycle (the cycle's host boundary)."""
@@ -299,6 +331,7 @@ class Observability:
             or s.get("fenced_binds", 0)
             or s.get("invariant_violations", 0)
             or s.get("ambiguous_binds", 0)
+            or s.get("oom_forensic", "")
             or lock_findings
         )
         if not eventful:
@@ -345,6 +378,8 @@ class Observability:
             lock_findings=lock_findings,
             mesh=s.get("mesh", self.mesh_devices),
             scenario=s.get("scenario", {}),
+            preflight=s.get("preflight", ""),
+            oom_forensic=s.get("oom_forensic", ""),
         )
         # perf ledger (obs/ledger.py): fold the cycle's measured phase
         # costs in, confront them with the cost model, run the SLO
@@ -366,6 +401,15 @@ class Observability:
                     res.modeled_s = entry.modeled_s
                     res.model_efficiency = entry.efficiency
                 trace.counter("model_efficiency", eff=entry.efficiency)
+        # device-memory ledger (obs/memledger.py): the cycle-boundary
+        # measured sample + the modeled-vs-measured confrontation —
+        # host metadata reads only, zero new syncs (the freshness/-1
+        # sentinel rules mirror the perf ledger's verdict above)
+        mentry = self.memledger.observe_cycle(rec)
+        if mentry is not None:
+            rec.mem_modeled_bytes = mentry["modeled_bytes"]
+            rec.mem_measured_bytes = mentry["measured_bytes"]
+            rec.mem_efficiency = mentry["efficiency"]
         self.recorder.record(rec)
         self._eventful_seq += 1
         if self._sampled(self._eventful_seq):

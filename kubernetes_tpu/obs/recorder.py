@@ -111,6 +111,20 @@ class CycleRecord:
     #: SIGUSR2 dumps and /debug/flightrecorder show efficiency + SLO
     #: history without scraping metrics
     slo: str = ""
+    #: memory-ledger verdict (obs/memledger.py): modeled resident bytes
+    #: at this cycle's boundary, the measured-side sample (-1 = the
+    #: boundary fell inside the sample interval — no sample), and the
+    #: modeled/measured confrontation (-1 = no verdict, same sentinel
+    #: rule as model_efficiency above)
+    mem_modeled_bytes: int = -1
+    mem_measured_bytes: int = -1
+    mem_efficiency: float = -1.0
+    #: memory preflight verdict for this cycle's shape ("" = preflight
+    #: never ran; ok | split | shed)
+    preflight: str = ""
+    #: OOM forensic flag (memledger.record_oom ran this cycle — the
+    #: ``mem=`` dump flag routes the postmortem to /debug/memory)
+    oom_forensic: str = ""
 
     def to_json(self) -> dict:
         return {
@@ -163,6 +177,13 @@ class CycleRecord:
                 "model_basis": self.model_basis}
                if self.model_efficiency >= 0 else {}),
             **({"slo": self.slo} if self.slo else {}),
+            **({"mem": {"modeled_bytes": self.mem_modeled_bytes,
+                        "measured_bytes": self.mem_measured_bytes,
+                        "efficiency": round(self.mem_efficiency, 4)}}
+               if self.mem_modeled_bytes >= 0 else {}),
+            **({"preflight": self.preflight} if self.preflight else {}),
+            **({"oom_forensic": self.oom_forensic}
+               if self.oom_forensic else {}),
         }
 
 
@@ -257,6 +278,15 @@ class FlightRecorder:
                 flags.append(f"eff={r.model_efficiency:.2f}")
             if r.slo:
                 flags.append(f"slo={r.slo}")
+            if r.oom_forensic:
+                flags.append(f"mem={r.oom_forensic}")
+            elif r.mem_modeled_bytes >= 0:
+                flags.append(
+                    f"mem={r.mem_modeled_bytes}B"
+                    + (f"/{r.mem_measured_bytes}B"
+                       if r.mem_measured_bytes >= 0 else ""))
+            if r.preflight and r.preflight != "ok":
+                flags.append(f"preflight={r.preflight}")
             spans = " ".join(
                 f"{k}={v*1000:.1f}ms" for k, v in sorted(r.spans.items()))
             lines.append(

@@ -1065,6 +1065,50 @@ def solve_cost_analysis(
     return capture_cost_analysis(lambda: _batch_impl.lower(*args, **kw))
 
 
+def solve_memory_analysis(
+    pods: DevicePods,
+    nodes: DeviceNodes,
+    sel: DeviceSelectors,
+    weights: Optional[Dict[str, float]] = None,
+    *,
+    max_rounds: int = 256,
+    per_node_cap: int = 1,
+    topo=None,
+    vol=None,
+    static_vol: Optional[jnp.ndarray] = None,
+    enabled_mask: Optional[int] = None,
+    extra_score: Optional[jnp.ndarray] = None,
+    use_sinkhorn: bool = False,
+    skip_priorities=(),
+    no_ports: bool = False,
+    no_pod_affinity: bool = False,
+    no_spread: bool = False,
+    stats_out: bool = False,
+) -> Optional[dict]:
+    """XLA memory analysis of the dense batch solve at this exact
+    signature — the memory ledger's preflight capture
+    (obs/memledger.py): warmup lowers the SAME jitted program
+    :func:`batch_assign` runs (identical static keys, via
+    :func:`_batch_impl_call` like :func:`solve_cost_analysis`) and
+    reads the compiled executable's ``memory_analysis()``
+    argument/output/temp bytes. Best-effort by contract: returns the
+    byte dict or ``None`` when the backend declines — warmup must
+    never fail for its accountant. Host-side AOT only; never on the
+    cycle path (``memory_analysis`` exists only on the COMPILED
+    stage, so each capture pays one AOT compile at warmup)."""
+    from kubernetes_tpu.ops.fused_score import use_pallas
+
+    from kubernetes_tpu.obs.memledger import capture_memory_analysis
+
+    args, kw = _batch_impl_call(
+        pods, nodes, sel, weights, max_rounds, per_node_cap, topo,
+        None, vol, static_vol, enabled_mask, extra_score,
+        use_sinkhorn, skip_priorities, no_ports, no_pod_affinity,
+        no_spread, use_pallas(), True, stats_out)
+    return capture_memory_analysis(
+        lambda: _batch_impl.lower(*args, **kw))
+
+
 # graftlint: disable-scope=R2,R7 -- the deliberate host boundary: trust-but-
 # verify reads the solver's claimed result back ONCE per cycle to check it
 # before any pod binds; cheap O(P*R + N*R) numpy by design (see docstring)

@@ -384,6 +384,12 @@ class Scheduler:
         if (fault_injector is not None
                 and getattr(self.cache, "fault_injector", "absent") is None):
             self.cache.fault_injector = fault_injector
+        # the device-memory ledger's cache seam (obs/memledger.py):
+        # resident-table / score-plane byte registrations ride the
+        # cache's own upload/drop edges (duck-typed attach, like the
+        # injector above — cache fakes without the attribute stay valid)
+        if getattr(self.cache, "memledger", "absent") is None:
+            self.cache.memledger = self.obs.memledger
         #: pipelined cycle executor: batches larger than pipeline_chunk
         #: split into fixed-size chunks; depth >= 2 overlaps host packing
         #: of chunk k+1 and binding of chunk k-1 with chunk k's device
@@ -1162,6 +1168,15 @@ class Scheduler:
                 attempts += 1
                 self.metrics.recovery_device_resets.inc()
                 self.obs.note_device_reset()
+                # forensic snapshot BEFORE the drop below deregisters
+                # the residents — the ranked record must show what was
+                # on the device at the moment it was lost
+                ml = self.obs.memledger
+                if ml.enabled:
+                    oomrec = ml.record_oom(
+                        "snapshot:device", error=str(e),
+                        cycle=self.queue.scheduling_cycle)
+                    self.obs.note_oom_forensic(ml.oom_flag(oomrec))
                 klog.warning("device snapshot failed (%s); dropping "
                              "resident table (reset %d/%d)", e, attempts,
                              self.recovery.device_reset_limit)
@@ -1328,8 +1343,60 @@ class Scheduler:
                 else:
                     dn = nodes_to_device(nt)
             use_pipeline = self._pipeline_eligible(batch, nominated)
-            dp = (None if use_pipeline else self._place(
-                  pods_to_device(pt, pad_to=bucket_size(max(len(batch), 1)))))
+            # capacity preflight (obs/memledger.py): check this cycle's
+            # padded solve shape against the warmed per-bucket peak
+            # table BEFORE materialising the padded pod batch. An
+            # over-budget shape splits down to the largest warmed
+            # bucket that fits (tail requeued for the next cycle) or
+            # sheds the whole batch — a deliberate requeue beats a
+            # device OOM mid-solve. Pipelined cycles solve at the chunk
+            # shape, so they preflight that and shed rather than split
+            # (the chunk is already the smallest unit).
+            preflight_shed = False
+            pad_p = 0  # preflight's dp-padding override (0 = default)
+            ml = self.obs.memledger
+            if ml.preflight_on and batch:
+                eff_p = bucket_size(max(
+                    min(len(batch), self.pipeline_chunk) if use_pipeline
+                    else len(batch), 1))
+                act, split_p, verdict = ml.preflight(
+                    eff_p, int(dn.valid.shape[0]),
+                    int(self.mesh.devices.size) if self._mesh_live else 0)
+                self.obs.note_preflight(act)
+                if act == "split" and not use_pipeline and split_p > 0:
+                    if split_p < len(batch):
+                        for p in batch[split_p:]:
+                            self._cycle_states.pop(p.key(), None)
+                            self.queue.add_if_not_present(p)
+                        trace.step(
+                            f"preflight split {len(batch)} -> {split_p}"
+                            f" pods ({verdict})")
+                        batch = batch[:split_p]
+                        res.attempted = len(batch)
+                        # pt was packed for the full batch above — re-
+                        # pack at the trimmed shape (the gate flags from
+                        # the superset pack stay valid: they can only be
+                        # conservative for a subset)
+                        pt = pk.pack_pods(batch)
+                    # the default padding (bucket_size of the remaining
+                    # batch) may still round UP past the budget — e.g. a
+                    # 4-pod batch whose geometric bucket is 8 when only
+                    # the warmed P=4 shape fits. Pin dp to the warmed
+                    # bucket the preflight actually cleared.
+                    pad_p = split_p
+                elif act == "shed" or (act == "split" and use_pipeline):
+                    for p in batch:
+                        self._cycle_states.pop(p.key(), None)
+                        self.queue.add_if_not_present(p)
+                    trace.step(f"preflight shed {len(batch)} pods"
+                               f" ({verdict})")
+                    batch = []
+                    res.attempted = 0
+                    preflight_shed = True
+                    use_pipeline = False
+            dp = (None if use_pipeline or preflight_shed else self._place(
+                  pods_to_device(pt, pad_to=(
+                      pad_p or bucket_size(max(len(batch), 1))))))
             ds = self._place(selectors_to_device(pk.pack_selector_tables()))
             dt = self._place(topology_to_device(pk.pack_topology_tables())
                              if _has_topo(pk.u) else None)
@@ -1339,6 +1406,11 @@ class Scheduler:
 
                 dv = self._place(volumes_to_device(pk.pack_volume_tables(batch)))
                 sv = _static_vol_pass(dp, dn, ds, dv)
+            if ml.enabled:
+                # this cycle's padded operand tables (pods + selector/
+                # topology/volume memos) — re-registered every cycle at
+                # the current shape, popped when the cycle sheds
+                ml.register_tree("scheduler.pod_batch", dp, ds, dt, dv)
             trace.step(f"snapshot packed ({len(batch)} pods, {nt.n} nodes,"
                        f" {snap_mode})")
         res.snapshot_mode = snap_mode
@@ -1371,6 +1443,15 @@ class Scheduler:
             + (f"+mesh{int(self.mesh.devices.size)}"
                if self._mesh_live else "")
         )
+        if preflight_shed:
+            # the whole batch was requeued by the capacity preflight —
+            # the cycle still flushes its snapshot accounting and flight
+            # record (action=shed rode note_preflight above), it just
+            # never builds the padded batch or touches the solver
+            res.elapsed_s = self.clock() - t0
+            self._record_metrics(res)
+            self.obs.end_cycle(res)
+            return res
 
         if use_pipeline:
             # the pipelined cycle executor owns the rest of the cycle on
@@ -2357,6 +2438,11 @@ class Scheduler:
         had = (self._incr_active or self._sk_warm_pot is not None
                or bool(has() if has is not None else False))
         self._sk_warm_pot = None
+        # release the scheduler-side ledger registrations (the warm
+        # potential carry AND the last pod-batch upload): on a
+        # device-loss edge those arrays are gone; on the other edges
+        # the next cycle re-registers what it re-uploads
+        self.obs.memledger.deregister_prefix("scheduler.")
         self._incr_active = False
         drop = getattr(self.cache, "drop_score_summary", None)
         if drop is not None and (has is None or has()):
@@ -2570,6 +2656,11 @@ class Scheduler:
             return None
         if warm and potentials is not None:
             self._sk_warm_pot = (pot_key, potentials)
+            # the carry is device-resident state: on the ledger until
+            # the next invalidation edge (_drop_incremental)
+            self.obs.memledger.register_tree(
+                "scheduler.sk_warm_potentials", potentials,
+                shape=f"P{pot_key[0]}xC{pot_key[1]}")
         self._incr_active = True
         res.rounds = int(host["rounds"])
         res.solver_tier = self.solver
@@ -3925,7 +4016,21 @@ class Scheduler:
                 # next re-arm (reconcile, lazy-warm gate) retries.
                 self.metrics.recovery_device_resets.inc()
                 self.obs.note_device_reset()
+                ml = self.obs.memledger
+                if ml.enabled:
+                    # ranked forensic record BEFORE the drops deregister
+                    # the residents (parks on _pending_oom — warmup runs
+                    # between cycles)
+                    oomrec = ml.record_oom(
+                        "warmup:compile", error=str(e),
+                        shapes=f"P{P}xN{int(dn.valid.shape[0])}",
+                        cycle=self.queue.scheduling_cycle)
+                    self.obs.note_oom_forensic(ml.oom_flag(oomrec))
                 self.cache.drop_device_snapshot()
+                # the drop above only kills the resident node table; the
+                # score cache and warm-potential carry reference device
+                # state and must not survive it into the cooloff cycles
+                self._drop_incremental("device-loss")
                 klog.warning("warmup aborted at bucket %d: %s", P, e)
                 return compiled
         if self.device_resident_snapshot and self.mesh is None:
@@ -3981,7 +4086,17 @@ class Scheduler:
                     except Exception as e:
                         self.metrics.recovery_device_resets.inc()
                         self.obs.note_device_reset()
+                        ml = self.obs.memledger
+                        if ml.enabled:
+                            oomrec = ml.record_oom(
+                                "warmup:compile", error=str(e),
+                                shapes=f"P{P}(host)",
+                                cycle=self.queue.scheduling_cycle)
+                            self.obs.note_oom_forensic(ml.oom_flag(oomrec))
                         self.cache.drop_device_snapshot()
+                        # same contract as the sharded sweep above: the
+                        # score/potential carries die with the table
+                        self._drop_incremental("device-loss")
                         klog.warning("host-fallback warmup aborted at "
                                      "bucket %d: %s", P, e)
                         return compiled
@@ -4073,6 +4188,12 @@ class Scheduler:
             a, wu_usage = out[0], out[1]
             if anchor and self.obs.ledger.enabled:
                 self._anchor_cost_model(dp, dn, ds, a, solve_kwargs)
+            if self.obs.memledger.preflight_on:
+                # EVERY bucket feeds the capacity preflight's per-shape
+                # peak table (the anchor above only samples the first) —
+                # the preflight can only split down to shapes it has a
+                # measured budget for
+                self._capture_bucket_memory(dp, dn, ds, solve_kwargs)
         if (self.robustness.validate_results
                 and not self.robustness.host_validate):
             # the fused validator rides every production cycle's
@@ -4188,6 +4309,29 @@ class Scheduler:
                 elapsed, rounds=max(rounds, 1))
         except Exception as e:
             klog.V(2).info("ledger cost-model capture skipped: %s", e)
+
+    def _capture_bucket_memory(self, dp, dn, ds, solve_kwargs) -> None:
+        """The memory ledger's per-bucket peak capture (obs/memledger):
+        AOT-lower the solve at THIS warmed (P, N) shape and read the
+        compiled program's ``memory_analysis`` — argument/output/temp
+        bytes — into the preflight's per-shape peak table. Rides the
+        warmup sweep only (one extra AOT compile per bucket, zero
+        hot-path cost); failures are swallowed like the cost-model
+        capture above — the preflight simply reports those shapes
+        ``unwarmed`` and never splits TO them."""
+        from kubernetes_tpu.ops.assign import solve_memory_analysis
+
+        ml = self.obs.memledger
+        try:
+            ma = solve_memory_analysis(dp, dn, ds, self.weights,
+                                       **solve_kwargs)
+            if ma is not None:
+                ml.record_bucket_memory(
+                    int(dp.valid.shape[0]), int(dn.valid.shape[0]),
+                    int(self.mesh.devices.size) if self._mesh_live else 0,
+                    ma)
+        except Exception as e:
+            klog.V(2).info("memledger bucket capture skipped: %s", e)
 
     def _warm_delta_scatter(self, dn) -> int:
         """Compile the donated delta-scatter programs for the small
@@ -4390,6 +4534,9 @@ class Scheduler:
         # live while idle — eventful cycles may never come to run the
         # watchdog's state machine after the queue drains
         self.obs.ledger.tick()
+        # memory ledger's idle-path sample: the other declared measured
+        # boundary besides cycle end (interval-gated inside)
+        self.obs.memledger.tick()
         res = CycleResult()
         self._process_waiting(res)
         if res.unschedulable or res.scheduled:
@@ -4438,6 +4585,16 @@ class Scheduler:
             "interned_items": interned,
             "universe_matcher_memo": len(u._matcher_row_memo),
             "universe_owner_sets_memo": len(u._owner_sets_memo),
+            # device-side state — the drop edges (drop_device_snapshot,
+            # _drop_incremental, host-mode demotion) must zero these;
+            # a resident surviving its drop is device-memory leaked
+            # even when host dict lengths stay flat
+            "dev_node_table": (
+                1 if self.cache.has_device_snapshot() else 0),
+            "dev_score_summary": (
+                1 if self.cache.has_score_summary() else 0),
+            "mem_residents": self.obs.memledger.resident_count(),
+            "mem_census_arrays": self.obs.memledger.census_count(),
         }
 
     def run_until_settled(self, max_cycles: int = 50) -> List[CycleResult]:

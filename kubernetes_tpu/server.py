@@ -338,6 +338,22 @@ def serve_scheduler(
                     self._respond(
                         200, json.dumps(ledger.snapshot()).encode(),
                         "application/json")
+            elif self.path == "/debug/memory":
+                # the device-memory ledger (obs/memledger.py): ranked
+                # residents, modeled-vs-measured watermarks, per-bucket
+                # compiled peaks, preflight verdicts, and the OOM
+                # forensic ring. snapshot() is thread-safe like
+                # /debug/ledger.
+                obs = getattr(sched, "obs", None)
+                memledger = getattr(obs, "memledger", None)
+                if memledger is None:
+                    self._respond(404,
+                                  b"no memory ledger on this scheduler",
+                                  "text/plain")
+                else:
+                    self._respond(
+                        200, json.dumps(memledger.snapshot()).encode(),
+                        "application/json")
             elif self.path == "/debug/soak":
                 # the day-in-the-life soak engine (soak.py), attached
                 # via SoakEngine.attach(sched): current phase, per-

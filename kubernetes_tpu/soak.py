@@ -71,10 +71,27 @@ DEFAULT_TOLERANCE: Dict[str, float] = {
     "sched.packer_vol_table_memo": 1024,
     "sched.breakers": 8,           # lazily minted per target, bounded
     "sched.explain_reasons_seen": 32,      # label vocabulary
+    # device-side flags/counters state_sizes exports for the memory
+    # ledger (mirrored mem.* rows below carry the rationale)
+    "sched.dev_node_table": 1,     # 0/1 flag: resident by design
+    "sched.dev_score_summary": 1,  # 0/1 flag: resident by design
+    "sched.mem_residents": 8,
+    "sched.mem_census_arrays": 4096,
     "jax.signatures": 512,         # per-site LRU-capped upstream
     "obs.recorder_len": 4096,      # deque maxlen-capped upstream
     "obs.trace_ring_len": 4096,
     "reflector.": 8192,            # tombstone-LRU-capped upstream
+    # device-memory ledger: the census plateaus once JAX's constant /
+    # executable pools are fully warmed (shape grid, like jax.
+    # signatures); modeled bytes plateau at the largest warmed
+    # bucket's operand tables (the resident node table + score plane
+    # persist across cycles BY DESIGN — that's what resident caching
+    # is). mem.residents is a fixed name set (~4 structures): growth
+    # past it means a drop edge leaked a registration
+    "mem.residents": 8,
+    "mem.census_arrays": 4096,
+    "mem.modeled_bytes": 1 << 24,  # 16 MB: bucket-shape settling
+    "mem.oom_records": 16,         # ring maxlen-capped upstream
 }
 
 
@@ -152,6 +169,21 @@ class SoakSentinels:
                 sig = getattr(jx, "signature_count", None)
                 if sig is not None:
                     out["jax.signatures"] = float(sig())
+                memledger = getattr(obs, "memledger", None)
+                if memledger is not None and getattr(
+                        memledger, "enabled", False):
+                    # device-memory sentinels: a clean window must
+                    # return modeled resident bytes (and the census)
+                    # to baseline — a resident surviving its drop edge
+                    # is a device leak the host dicts can't see
+                    out["mem.residents"] = float(
+                        memledger.resident_count())
+                    out["mem.modeled_bytes"] = float(
+                        memledger.resident_bytes())
+                    out["mem.census_arrays"] = float(
+                        memledger.census_count())
+                    out["mem.oom_records"] = float(
+                        len(memledger.oom_records()))
             san = getattr(s, "lock_sanitizer", None)
             if san is not None:
                 # monotonic finding counts: the clean-window contract

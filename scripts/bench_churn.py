@@ -264,8 +264,17 @@ def summarize(producer: ChurnProducer, wall_s: float, sched) -> dict:
     ledger = getattr(sched.obs, "ledger", None)
     ledger_out = (ledger.arm_summary()
                   if ledger is not None and ledger.enabled else None)
+    # the device-memory ledger's per-arm summary (obs/memledger.py):
+    # modeled-vs-measured resident bytes, watermark peak, preflight
+    # verdict counts, OOM forensic ring — the bench_compare `memory`
+    # gate family reads exactly this shape (absence-tolerant, same
+    # contract as the perf-ledger block above)
+    memledger = getattr(sched.obs, "memledger", None)
+    memory_out = (memledger.arm_summary()
+                  if memledger is not None and memledger.enabled else None)
     return {
         **({"ledger": ledger_out} if ledger_out else {}),
+        **({"memory": memory_out} if memory_out else {}),
         "solve_s_by_scope": scope_out,
         "wall_s": round(wall_s, 2),
         "created": producer.created,

@@ -102,6 +102,17 @@ be sane (sum in (0, 1.25] — phases are disjoint spans of the cycle
 wall). Absence is tolerated — records predating the ledger warn and
 pass, like every other family.
 
+Device-memory gates (obs/memledger.py; the per-arm ``memory`` block
+the churn bench records) enforce ABSOLUTE invariants on the newest
+``churn_r*.json`` alone: the modeled-vs-measured byte
+``model_efficiency`` p50 must stay above the floor
+(``--memory-efficiency-floor``, default 0.05 — deliberately low on
+CPU, where the live-array census also measures constant pools the
+ledger does not model), the peak watermark must stay at or under the
+device limit whenever one is known, and clean arms must report ZERO
+OOM forensic records. Absence is tolerated — records predating the
+memory ledger warn and pass.
+
 ``--list-gates`` prints every active gate family (name, record source,
 what it enforces) — the docs reference this output instead of
 hand-maintaining the list.
@@ -1075,6 +1086,68 @@ def compare_ledger(cur: dict, efficiency_floor: float = 0.2) -> dict:
             "warnings": warnings}
 
 
+def compare_memory(cur: dict, efficiency_floor: float = 0.05) -> dict:
+    """Device-memory gates over the NEWEST churn record alone (pure,
+    unit-tested; absence-tolerant): each arm carrying the per-arm
+    ``memory`` block (obs/memledger.py ``arm_summary``) enforces
+
+    - ``model_efficiency.p50 >= efficiency_floor`` when sampled cycles
+      produced one — a modeled-vs-measured collapse means the byte
+      model stopped describing the residents. The default floor is
+      0.05, far below the perf ledger's 0.2: on CPU the measured side
+      is the ``jax.live_arrays()`` census, which also sees constant
+      pools and executable scratch the ledger deliberately does not
+      model;
+    - the peak watermark stays at or under the device limit whenever a
+      limit is known (``limit_bytes > 0``) — a watermark crossing the
+      limit means the capacity preflight never engaged where it had
+      to;
+    - ``oom_records == 0`` on CLEAN arms (serving, fixed) — a device
+      OOM forensic record without injected chaos is a regression
+      outright.
+
+    One record is enough — every check is absolute. Arms without a
+    memory block warn and pass (records predating the memory
+    ledger)."""
+    checks, regressions, warnings = [], [], []
+
+    absolute = partial(_absolute_check, checks, regressions)
+
+    arms = cur.get("arms") or {}
+    seen = 0
+    for arm_name, arm in sorted(arms.items()):
+        mem = (arm or {}).get("memory")
+        if not isinstance(mem, dict):
+            continue
+        seen += 1
+        eff = _num((mem.get("model_efficiency") or {}).get("p50"))
+        if eff is not None and eff >= 0:
+            absolute(f"memory.{arm_name}.model_efficiency_p50", eff,
+                     eff < efficiency_floor)
+        limit = _num(mem.get("limit_bytes"))
+        peak = _num((mem.get("resident_bytes") or {}).get("peak"))
+        if limit is not None and limit > 0 and peak is not None:
+            absolute(f"memory.{arm_name}.peak_vs_limit_bytes", peak,
+                     peak > limit)
+        ooms = _num(mem.get("oom_records"))
+        if ooms is not None and arm_name in LEDGER_CLEAN_ARMS:
+            absolute(f"memory.{arm_name}.oom_records", ooms, ooms > 0)
+        pf = mem.get("preflight") or {}
+        verdicts = sum(v for v in (_num(pf.get(k))
+                                   for k in ("ok", "split", "shed"))
+                       if v is not None)
+        if not verdicts:
+            warnings.append(
+                f"memory: arm {arm_name} ran zero preflight verdicts "
+                "(preflight off or no warmed buckets) — capacity gate "
+                "not exercised")
+    if not seen:
+        warnings.append("memory: no arm carries a memory block "
+                        "(record predates the memory ledger) — skipped")
+    return {"checks": checks, "regressions": regressions,
+            "warnings": warnings}
+
+
 def compare_lock(soak_cur: dict) -> dict:
     """Concurrency-discipline gates (pure, unit-tested via the soak
     half; absence-tolerant) — the static + runtime lock contract
@@ -1166,6 +1239,11 @@ GATE_FAMILIES = [
      "perf ledger: per-arm measured-vs-modeled model_efficiency p50 "
      "above the floor, SLO burns == 0 on clean arms, phase-attribution "
      "shares sum sane (new record alone)"),
+    ("memory", "churn_r*.json",
+     "device-memory ledger: per-arm modeled-vs-measured byte "
+     "efficiency p50 above the floor, peak watermark <= device limit "
+     "when known, OOM forensic records == 0 on clean arms (new record "
+     "alone)"),
     ("netchaos", "churn_net_r*.json",
      "network chaos: double_bind_attempts==0 and invariant_violations"
      "==0 absolutes with the auditor demonstrably running, all pods "
@@ -1211,6 +1289,12 @@ def main(argv=None) -> int:
                          "ledger model_efficiency p50 (default 0.2 — "
                          "the measured-vs-modeled collapse alarm; the "
                          "ledger gate family)")
+    ap.add_argument("--memory-efficiency-floor", type=float, default=0.05,
+                    help="absolute floor for each churn arm's device-"
+                         "memory model_efficiency p50 (default 0.05 — "
+                         "deliberately low on CPU, where the live-array "
+                         "census measures pools the ledger does not "
+                         "model; the memory gate family)")
     ap.add_argument("--pack-floor", type=float, default=0.005,
                     help="absolute pack_s (seconds) under which the "
                          "pack-breakdown ratio check is skipped as noise "
@@ -1296,6 +1380,12 @@ def main(argv=None) -> int:
         verdict["checks"].extend(lv["checks"])
         verdict["regressions"].extend(lv["regressions"])
         verdict["warnings"].extend(lv["warnings"])
+        # device-memory gates (obs/memledger.py per-arm blocks): same
+        # newest-record-alone posture as the perf-ledger family above
+        mv = compare_memory(ccur, args.memory_efficiency_floor)
+        verdict["checks"].extend(mv["checks"])
+        verdict["regressions"].extend(mv["regressions"])
+        verdict["warnings"].extend(mv["warnings"])
     # composed serving-on-mesh gates (scripts/bench_churn.py --mesh
     # records) — absence tolerated so benchres directories predating
     # the composed mode keep passing; one record still enforces the

@@ -806,6 +806,9 @@ def main(argv=None) -> int:
         "ledger": (rt.ledger.arm_summary()
                    if rt.ledger is not None and rt.ledger.enabled
                    else None),
+        "memory": (sched.obs.memledger.arm_summary()
+                   if getattr(sched.obs, "memledger", None) is not None
+                   and sched.obs.memledger.enabled else None),
         "lock_sanitizer": (sched.lock_sanitizer.snapshot()
                            if sched.lock_sanitizer is not None else None),
     })

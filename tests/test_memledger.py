@@ -1,0 +1,535 @@
+"""Device-memory ledger (obs/memledger.py) — the tier-1 acceptance
+suite:
+
+- modeled resident accounting: register/deregister through the
+  cache/warmup seams, ranked forensic ordering, last-write-wins;
+- measured side: cycle-boundary samples are interval-gated on the
+  owner clock, sample-free boundaries publish the -1 sentinel;
+- capacity preflight: warmup lands the per-bucket
+  ``memory_analysis()`` peak table; an over-budget shape SPLITS to the
+  largest warmed smaller bucket or SHEDS back to the queue — driven
+  cycles with a tight limit schedule everything with ZERO device OOMs;
+- OOM forensics: injected device_oom chaos (snapshot and warmup
+  sites) lands a ranked forensic record on the ring, the flight
+  recorder's ``mem=`` flag, /debug/memory, and the debugger dump —
+  and the recovery path RELEASES every registered resident (the
+  satellite drop-audit);
+- the config block round-trips native AND v1alpha1,
+  ``validate_config`` gates it, the bench_compare ``memory`` gate
+  family honors its contract, SoakSentinels watch the ``mem.*``
+  namespace, and graftlint stays clean over the module.
+"""
+
+import dataclasses
+import json
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.config import (
+    MemoryLedgerConfig,
+    ObservabilityConfig,
+    WarmupConfig,
+)
+from kubernetes_tpu.obs.memledger import OOM_RING, MemoryLedger
+from kubernetes_tpu.faults import FaultInjector
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _mlcfg(**kw):
+    kw.setdefault("sample_interval_s", 0.0)  # sample every boundary
+    return MemoryLedgerConfig(**kw)
+
+
+def _scheduler(n_nodes=4, **kw):
+    kw.setdefault("observability",
+                  ObservabilityConfig(memory_ledger=_mlcfg()))
+    s = Scheduler(enable_preemption=False, **kw)
+    for i in range(n_nodes):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=16000))
+    return s
+
+
+def _drive(s, n_pods=8, cycles=2, prefix="p"):
+    out = []
+    for c in range(cycles):
+        for i in range(n_pods):
+            s.on_pod_add(make_pod(f"{prefix}{c}-{i}", cpu_milli=50))
+        out.append(s.schedule_cycle())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# modeled side: resident accounting
+# ---------------------------------------------------------------------------
+
+
+def test_register_deregister_and_forensic_ranking():
+    ml = MemoryLedger(_mlcfg(), clock=FakeClock())
+    ml.register("cache.node_table", 4096, shape="N64")
+    ml.register("cache.score_summary", 1024, shape="N64")
+    ml.register("scheduler.pod_batch", 8192)
+    assert ml.resident_count() == 3
+    assert ml.resident_bytes() == 4096 + 1024 + 8192
+    # ranked largest-first (the forensic ordering), top truncates
+    assert [n for n, _, _ in ml.ranked_residents()] == [
+        "scheduler.pod_batch", "cache.node_table", "cache.score_summary"]
+    assert len(ml.ranked_residents(top=2)) == 2
+    # re-register: last write wins; zero bytes drops the row
+    ml.register("cache.node_table", 100)
+    assert dict((n, b) for n, b, _ in ml.ranked_residents())[
+        "cache.node_table"] == 100
+    ml.register("scheduler.pod_batch", 0)
+    assert ml.resident_count() == 2
+    ml.deregister("cache.node_table")
+    assert ml.deregister_prefix("cache.") == 1
+    assert ml.resident_count() == 0
+
+
+def test_disabled_ledger_is_inert():
+    ml = MemoryLedger(_mlcfg(enabled=False), clock=FakeClock())
+    ml.register("x", 100)
+    assert ml.resident_count() == 0
+    assert ml.observe_cycle() is None
+    assert not ml.preflight_on
+    assert ml.preflight(8, 8, 0)[0] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# measured side: interval gating + the -1 sentinel
+# ---------------------------------------------------------------------------
+
+
+def test_sample_interval_gates_on_owner_clock():
+    from kubernetes_tpu.metrics import SchedulerMetrics
+
+    clk = FakeClock()
+    metrics = SchedulerMetrics()
+    ml = MemoryLedger(MemoryLedgerConfig(sample_interval_s=10.0),
+                      metrics=metrics, clock=clk)
+    ml.register("r", 1000)
+    e1 = ml.observe_cycle()
+    assert ml.samples == 1  # first boundary always samples
+    assert e1["modeled_bytes"] == 1000
+    # within the interval: no sample, the sentinel publishes
+    clk.advance(1.0)
+    e2 = ml.observe_cycle()
+    assert ml.samples == 1
+    assert e2["measured_bytes"] == -1 and e2["efficiency"] == -1.0
+    assert metrics.memory_model_efficiency.value() == -1.0
+    # past the interval: sampled again, watermark history grows
+    clk.advance(10.0)
+    ml.observe_cycle()
+    assert ml.samples == 2
+    assert len(ml.snapshot()["watermarks"]) == 2
+
+
+def test_census_fallback_measures_live_arrays():
+    """CPU backends report no memory_stats: the bounded live-array
+    census stands in, so measured bytes are populated and efficiency
+    is judgeable on the laptop."""
+    import jax.numpy as jnp
+
+    keep = jnp.ones((128, 128))  # ensure at least one live array
+    ml = MemoryLedger(_mlcfg(), clock=FakeClock())
+    ml.register("r", int(keep.nbytes))
+    e = ml.observe_cycle()
+    assert ml.census_count() >= 1
+    assert e["measured_bytes"] >= keep.nbytes
+    assert 0.0 <= e["efficiency"] <= 8.0
+    snap = ml.snapshot()
+    assert snap["devices"].get("census", {}).get("resident", 0) > 0
+    assert snap["peak_bytes"] >= e["measured_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# capacity preflight: the per-bucket peak table
+# ---------------------------------------------------------------------------
+
+
+def test_preflight_verdicts_against_bucket_table():
+    from kubernetes_tpu.metrics import SchedulerMetrics
+
+    metrics = SchedulerMetrics()
+    ml = MemoryLedger(_mlcfg(limit_bytes=1000, headroom_frac=0.9),
+                      metrics=metrics, clock=FakeClock())
+    stats = {"argument_bytes": 0, "output_bytes": 0, "temp_bytes": 0,
+             "code_bytes": 0, "alias_bytes": 0}
+    ml.record_bucket_memory(4, 8, 0, dict(stats, total_bytes=500))
+    ml.record_bucket_memory(8, 8, 0, dict(stats, total_bytes=880))
+    ml.record_bucket_memory(16, 8, 0, dict(stats, total_bytes=2000))
+
+    # fits: need <= limit x headroom
+    act, split, v = ml.preflight(8, 8, 0)
+    assert (act, split, v["basis"]) == ("ok", 8, "fits")
+    assert v["budget"] == 900 and v["need"] == 880
+    # over budget, a smaller warmed bucket fits: split to the LARGEST
+    act, split, v = ml.preflight(16, 8, 0)
+    assert (act, split, v["basis"]) == ("split", 8, "over-budget")
+    # unwarmed shape: absence-tolerant ok — never shed on a guess
+    act, _, v = ml.preflight(32, 64, 0)
+    assert (act, v["basis"]) == ("ok", "unwarmed")
+    # over budget, nothing smaller warmed at this (N, mesh): shed
+    ml2 = MemoryLedger(_mlcfg(limit_bytes=100), clock=FakeClock())
+    ml2.record_bucket_memory(4, 8, 0, dict(stats, total_bytes=500))
+    act, split, v = ml2.preflight(4, 8, 0)
+    assert (act, split, v["basis"]) == ("shed", 0,
+                                        "over-budget-no-bucket")
+    # verdicts count on the ledger AND the metrics counter
+    assert ml.preflights == {"ok": 2, "split": 1, "shed": 0}
+    assert metrics.memory_preflight.value(action="split") == 1
+
+
+def test_preflight_without_limit_never_fires():
+    ml = MemoryLedger(_mlcfg(), clock=FakeClock())  # limit unknown (CPU)
+    ml.record_bucket_memory(8, 8, 0, {"total_bytes": 10**12})
+    act, _, v = ml.preflight(8, 8, 0)
+    assert (act, v["basis"]) == ("ok", "no-limit")
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics: the ranked record + ring bound
+# ---------------------------------------------------------------------------
+
+
+def test_record_oom_ranked_record_and_flag():
+    clk = FakeClock()
+    ml = MemoryLedger(_mlcfg(limit_bytes=10000), clock=clk)
+    ml.register("cache.node_table", 5000, shape="N64")
+    ml.register("cache.score_summary", 300)
+    ml.observe_cycle()
+    ml.preflight(8, 8, 0)
+    rec = ml.record_oom("snapshot:device", error="RESOURCE_EXHAUSTED",
+                        shapes="P8xN64", cycle=7)
+    assert rec["site"] == "snapshot:device" and rec["cycle"] == 7
+    assert rec["modeled_bytes"] == 5300
+    assert rec["limit_bytes"] == 10000
+    assert rec["top_residents"][0] == {
+        "name": "cache.node_table", "bytes": 5000, "shape": "N64"}
+    assert rec["watermarks"] and rec["preflight"]["action"] == "ok"
+    assert ml.oom_flag(rec) == \
+        "oom@snapshot:device top=cache.node_table:5000B"
+    # the ring is bounded: an OOM storm must not grow memory while the
+    # process is already memory-sick
+    for i in range(OOM_RING + 5):
+        ml.record_oom("warmup:compile", cycle=i)
+    assert len(ml.oom_records()) == OOM_RING
+    # the dump shows the forensic lines (SIGUSR2 surface)
+    assert "Memory ledger: modeled=" in ml.dump()
+    assert "OOM @warmup:compile" in ml.dump()
+
+
+# ---------------------------------------------------------------------------
+# driven integration: residents, state_sizes, warmup capture
+# ---------------------------------------------------------------------------
+
+
+def test_driven_cycles_register_residents_and_state_sizes():
+    s = _scheduler()
+    _drive(s, n_pods=8, cycles=2)
+    ml = s.obs.memledger
+    names = {n for n, _, _ in ml.ranked_residents()}
+    assert "cache.node_table" in names
+    assert "scheduler.pod_batch" in names
+    sizes = s.state_sizes()
+    assert sizes["dev_node_table"] == 1
+    assert sizes["mem_residents"] >= 2
+    assert sizes["mem_census_arrays"] >= 1
+    # boundary entries exist, the dump line carries the mem= byte flag
+    assert ml.snapshot()["observed"] == 2
+    assert "mem=" in s.obs.recorder.dump()
+    # dropping the snapshot releases the cache-side registrations
+    s.cache.drop_device_snapshot()
+    assert "cache.node_table" not in {
+        n for n, _, _ in ml.ranked_residents()}
+
+
+def test_warmup_lands_bucket_memory_table():
+    s = _scheduler(warmup=WarmupConfig(enabled=True, pod_buckets=(4, 8)))
+    compiled = s.warmup(sample_pods=[make_pod("w", cpu_milli=50)])
+    assert compiled >= 2
+    table = s.obs.memledger.bucket_table()
+    ps = sorted(p for p, _, _ in table)
+    assert ps == [4, 8]
+    for entry in table.values():
+        assert entry["total_bytes"] > 0
+        assert entry["argument_bytes"] > 0
+    # the larger pod bucket needs more bytes — the table is judgeable
+    (k4, k8) = sorted(table, key=lambda k: k[0])
+    assert table[k8]["total_bytes"] > table[k4]["total_bytes"]
+
+
+def test_soak_sentinels_watch_mem_namespace():
+    from kubernetes_tpu.soak import SoakSentinels
+
+    s = _scheduler()
+    _drive(s, n_pods=4, cycles=1)
+    out = SoakSentinels(sched=s).collect()
+    assert out["mem.residents"] >= 2
+    assert out["mem.modeled_bytes"] > 0
+    assert out["mem.oom_records"] == 0
+
+
+# ---------------------------------------------------------------------------
+# preflight on the cycle path: split / shed with ZERO device OOMs
+# ---------------------------------------------------------------------------
+
+
+def test_over_budget_batch_splits_to_warmed_bucket():
+    """8 pods against a limit only the P4 bucket fits: the cycle trims
+    to 4, requeues 4, and the next cycle schedules the rest — zero
+    OOMs, the preflight verdict on the flight records."""
+    s = _scheduler(warmup=WarmupConfig(enabled=True, pod_buckets=(4, 8)))
+    assert s.warmup(sample_pods=[make_pod("w", cpu_milli=50)]) >= 2
+    ml = s.obs.memledger
+    table = ml.bucket_table()
+    (k4, k8) = sorted(table, key=lambda k: k[0])
+    frac = ml.config.headroom_frac
+    # budget exactly covers the P4 bucket, not the P8 one
+    ml.config.limit_bytes = int(table[k4]["total_bytes"] / frac) + 2
+    assert ml.preflight(k8[0], k8[1], k8[2])[0] == "split"
+
+    for i in range(8):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=50))
+    r1 = s.schedule_cycle()
+    assert r1.attempted == 4 and r1.scheduled == 4
+    r2 = s.schedule_cycle()
+    assert r2.scheduled == 4  # the requeued half lands next cycle
+    assert ml.preflights["split"] >= 1
+    assert s.metrics.recovery_device_resets.value() == 0
+    assert ml.oom_records() == []
+    recs = s.obs.recorder.records()
+    assert any(r.preflight == "split" for r in recs)
+
+
+def test_over_budget_batch_sheds_whole_when_no_bucket_fits():
+    s = _scheduler(warmup=WarmupConfig(enabled=True, pod_buckets=(8,)))
+    assert s.warmup(sample_pods=[make_pod("w", cpu_milli=50)]) >= 1
+    ml = s.obs.memledger
+    ml.config.limit_bytes = 100  # nothing fits
+    for i in range(4):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=50))
+    r = s.schedule_cycle()
+    assert r.attempted == 0 and r.scheduled == 0
+    assert ml.preflights["shed"] >= 1
+    # requeued whole, not dropped
+    assert sum(s.queue.pending_counts().values()) == 4
+    assert ml.oom_records() == []
+    # lifting the limit drains the queue — the shed was a deferral
+    ml.config.limit_bytes = 0
+    assert s.schedule_cycle().scheduled == 4
+
+
+# ---------------------------------------------------------------------------
+# chaos: injected device_oom becomes an incident record, and the
+# drop-audit — recovery releases every registered resident
+# ---------------------------------------------------------------------------
+
+
+def test_device_oom_at_snapshot_leaves_forensic_record():
+    fi = FaultInjector(seed=0).arm("snapshot:device", "device_oom",
+                                   count=1)
+    s = _scheduler(fault_injector=fi)
+    res = _drive(s, n_pods=4, cycles=2)
+    assert sum(r.scheduled for r in res) == 8  # recovered, no crash
+    ml = s.obs.memledger
+    recs = ml.oom_records()
+    assert recs and recs[0]["site"] == "snapshot:device"
+    assert "mem=oom@snapshot:device" in s.obs.recorder.dump()
+    # the ranked record reaches the debugger dump too
+    from kubernetes_tpu import debugger
+
+    text = debugger.dump(s)
+    assert "Memory ledger:" in text and "OOM @snapshot:device" in text
+
+
+def test_warmup_oom_releases_residents_and_parks_flag():
+    """The satellite drop-audit: a warmup abort must deregister every
+    device resident (score cache, warm potentials, node table) — and
+    its forensic flag, captured BETWEEN cycles, parks for the next
+    flight record."""
+    fi = FaultInjector(seed=0).arm("warmup:compile", "device_oom",
+                                   count=1)
+    s = _scheduler(fault_injector=fi,
+                   warmup=WarmupConfig(enabled=True, pod_buckets=(4,)))
+    _drive(s, n_pods=4, cycles=1)  # populate residents first
+    ml = s.obs.memledger
+    assert ml.resident_count() >= 2
+    assert s.warmup(sample_pods=[make_pod("w", cpu_milli=50)]) == 0
+    assert ml.resident_count() == 0, (
+        "warmup abort leaked ledger registrations: "
+        f"{ml.ranked_residents()}")
+    assert s._sk_warm_pot is None
+    recs = ml.oom_records()
+    assert recs and recs[-1]["site"] == "warmup:compile"
+    # the parked flag stamps the NEXT cycle's record
+    _drive(s, n_pods=2, cycles=1, prefix="after")
+    assert any(r.oom_forensic.startswith("oom@warmup:compile")
+               for r in s.obs.recorder.records())
+
+
+# ---------------------------------------------------------------------------
+# /debug/memory + config round-trips + bench_compare contract
+# ---------------------------------------------------------------------------
+
+
+def test_debug_memory_endpoint():
+    from kubernetes_tpu.server import serve_scheduler
+
+    s = _scheduler()
+    _drive(s, n_pods=4, cycles=2)
+    srv = serve_scheduler(s, port=0)
+    try:
+        host, port = srv.server_address[:2]
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/debug/memory", timeout=5).read()
+        doc = json.loads(body)
+        assert doc["enabled"] and doc["observed"] == 2
+        assert doc["residents"][0]["bytes"] > 0
+        assert doc["modeled_bytes"] > 0
+        assert "preflight" in doc and "oom_records" in doc
+        assert doc["model_efficiency"]["n"] >= 1
+    finally:
+        srv.shutdown()
+
+
+def test_memledger_config_native_and_v1alpha1_round_trip():
+    from kubernetes_tpu.api.config_v1alpha1 import decode, encode
+    from kubernetes_tpu.cli import ConfigError, decode_config, \
+        validate_config
+
+    # native nested block, strict unknown-field rejection
+    cfg = decode_config({"observability": {"memory_ledger": {
+        "sample_interval_s": 2.0, "headroom_frac": 0.8,
+        "limit_bytes": 1 << 30}}})
+    mlg = cfg.observability.memory_ledger
+    assert (mlg.sample_interval_s, mlg.headroom_frac,
+            mlg.limit_bytes) == (2.0, 0.8, 1 << 30)
+    with pytest.raises(ConfigError):
+        decode_config({"observability": {"memory_ledger": {"bogus": 1}}})
+
+    # v1alpha1: camelCase + duration strings, encode(decode) is stable
+    doc = {"apiVersion": "kubescheduler.config.k8s.io/v1alpha1",
+           "kind": "KubeSchedulerConfiguration",
+           "observability": {"memoryLedger": {"sampleInterval": "2s",
+                                              "headroomFrac": 0.8,
+                                              "limitBytes": 1 << 30}}}
+    internal = decode(doc)
+    vml = internal.observability.memory_ledger
+    assert vml.sample_interval_s == pytest.approx(2.0)
+    assert vml.headroom_frac == pytest.approx(0.8)
+    assert vml.preflight is True  # default
+    assert decode(encode(internal)).observability.memory_ledger == vml
+
+    # validate_config gates the block with camelCase field paths
+    bad = dataclasses.replace(
+        internal, observability=dataclasses.replace(
+            internal.observability,
+            memory_ledger=dataclasses.replace(
+                vml, headroom_frac=1.5, sample_interval_s=-1.0,
+                history=0)))
+    errs = validate_config(bad)
+    assert any("memoryLedger.headroomFrac" in e for e in errs)
+    assert any("memoryLedger.sampleInterval" in e for e in errs)
+    assert any("memoryLedger.history" in e for e in errs)
+
+
+def _load_bench_compare():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "bench_compare.py"))
+    bc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bc)
+    return bc
+
+
+def _mem_record(eff_p50=0.6, peak=1000, limit=0, ooms=0,
+                preflights=None, with_memory=True):
+    mem = {"cycles": 50,
+           "resident_bytes": {"modeled": 900, "measured": 1000,
+                              "peak": peak},
+           "model_efficiency": {"n": 50, "p50": eff_p50, "p99": 1.0},
+           "limit_bytes": limit,
+           "preflight": preflights if preflights is not None
+           else {"ok": 50, "split": 0, "shed": 0},
+           "oom_records": ooms}
+    arm = {"p50_s": 0.01, "p99_s": 0.05, "ops_per_sec": 500.0,
+           "jax": {"retraces": 0}}
+    if with_memory:
+        arm["memory"] = mem
+    return {"name": "churn", "arms": {"serving": dict(arm),
+                                      "overload": dict(arm)},
+            "errors": []}
+
+
+def test_bench_compare_memory_gate_contract():
+    bc = _load_bench_compare()
+    # registered in --list-gates
+    assert any(n == "memory" for n, _, _ in bc.GATE_FAMILIES)
+
+    # clean record passes
+    v = bc.compare_memory(_mem_record())
+    assert v["regressions"] == [] and v["checks"]
+
+    # efficiency collapse fails the floor (untracked device memory)
+    v = bc.compare_memory(_mem_record(eff_p50=0.01))
+    assert any(r["check"] == "memory.serving.model_efficiency_p50"
+               for r in v["regressions"])
+
+    # peak watermark past a KNOWN limit fails; unknown limit tolerated
+    v = bc.compare_memory(_mem_record(peak=2000, limit=1500))
+    assert any(r["check"].endswith("peak_vs_limit_bytes")
+               for r in v["regressions"])
+    v = bc.compare_memory(_mem_record(peak=2000, limit=0))
+    assert not any("peak_vs_limit" in r["check"]
+                   for r in v["regressions"])
+
+    # forensic records on a CLEAN arm fail
+    v = bc.compare_memory(_mem_record(ooms=1))
+    assert any(r["check"] == "memory.serving.oom_records"
+               for r in v["regressions"])
+
+    # absence-tolerant: a pre-ledger record warns, never fails
+    v = bc.compare_memory(_mem_record(with_memory=False))
+    assert v["regressions"] == [] and v["warnings"]
+
+
+# ---------------------------------------------------------------------------
+# budgets + lint
+# ---------------------------------------------------------------------------
+
+
+def test_zero_new_retraces_with_memledger_on():
+    s = _scheduler()
+    _drive(s, n_pods=8, cycles=4)
+    assert s.obs.jax.retrace_total() == 0, (
+        "the memory ledger must not perturb the solve signatures")
+
+
+def test_memledger_module_lints_clean():
+    """graftlint over obs/memledger.py: the device-discipline rules
+    (R2 host syncs, R3 jit-in-loop, R7 undeclared readbacks, R8
+    sharded gathers) — the module is host code by construction; its
+    two measured-side boundaries (memory_stats, the live-array census)
+    carry declared-boundary pragmas."""
+    import kubernetes_tpu.obs.memledger as memledger_mod
+    from kubernetes_tpu.testing import lint_clean
+
+    lint_clean(memledger_mod, rules=("R2", "R3", "R7", "R8"),
+               jit_all=False)

@@ -244,3 +244,59 @@ def test_ledger_phase_gauge_freshness_zeroes_stale_series():
         spans={"snapshot": 0.004, "solve:batch": 0.01}))
     assert metrics.cycle_phase_seconds.value(phase="preemption") == 0.0
     assert metrics.cycle_phase_seconds.value(phase="solve") > 0
+
+
+def test_memledger_metric_block_conforms(scraped):
+    """The device-memory block (obs/memledger.py) rides the same
+    strict grammar: the byte gauge carries {kind,device}-labeled
+    samples after one driven cycle (modeled + the census fallback on
+    CPU), efficiency sits in the sentinel-or-[0,8] range, and the
+    preflight counter sampled its ok verdict."""
+    _metrics, text = scraped
+    types, samples = parse_exposition(text)
+    fams = {f for f, _, _, _ in samples}
+    assert "scheduler_device_memory_bytes" in fams
+    assert "scheduler_memory_model_efficiency" in fams
+    assert "scheduler_memory_preflight_total" in fams
+    assert types["scheduler_device_memory_bytes"] == "gauge"
+    assert types["scheduler_memory_model_efficiency"] == "gauge"
+    assert types["scheduler_memory_preflight_total"] == "counter"
+    rows = [(labels, v) for f, _, labels, v in samples
+            if f == "scheduler_device_memory_bytes"]
+    assert all(set(labels) == {"kind", "device"} for labels, _ in rows)
+    by_kind = {labels["kind"]: v for labels, v in rows}
+    assert by_kind.get("modeled", 0) > 0  # the driven cycle registered
+    assert by_kind.get("resident", 0) > 0  # census fallback measured
+    eff = [v for f, _, _, v in samples
+           if f == "scheduler_memory_model_efficiency"]
+    assert eff and (eff[0] == -1.0 or 0.0 <= eff[0] <= 8.0)
+    pf = {labels["action"]: v for f, _, labels, v in samples
+          if f == "scheduler_memory_preflight_total"}
+    assert pf.get("ok", 0) >= 1
+
+
+def test_memledger_gauge_freshness_zeroes_stale_device_series():
+    """The explain-gauge freshness rule on the byte gauge: a device
+    that stops reporting (mesh change, lost shard) must read 0, not
+    its last measurement."""
+    from kubernetes_tpu.config import MemoryLedgerConfig
+    from kubernetes_tpu.metrics import SchedulerMetrics
+    from kubernetes_tpu.obs.memledger import MemoryLedger
+
+    metrics = SchedulerMetrics()
+    ml = MemoryLedger(MemoryLedgerConfig(), metrics=metrics,
+                      clock=lambda: 0.0)
+    ml._last_measured = {"3": {"resident": 100, "peak": 120,
+                               "limit": 1000}}
+    ml._publish(50, 0.5)
+    g = metrics.device_memory_bytes
+    assert g.value(kind="resident", device="3") == 100.0
+    assert g.value(kind="modeled", device="all") == 50.0
+    assert metrics.memory_model_efficiency.value() == 0.5
+    # the device disappears: its series zero instead of going stale
+    ml._last_measured = {}
+    ml._publish(50, -1.0)
+    assert g.value(kind="resident", device="3") == 0.0
+    assert g.value(kind="peak", device="3") == 0.0
+    assert g.value(kind="modeled", device="all") == 50.0
+    assert metrics.memory_model_efficiency.value() == -1.0
