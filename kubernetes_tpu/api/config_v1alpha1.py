@@ -177,6 +177,37 @@ class MemoryLedgerConfigurationV1alpha1:
 
 
 @dataclass
+class JourneysConfigurationV1alpha1:
+    """Versioned spelling of the per-pod journey tracer block
+    (config.JourneysConfig): camelCase, the retention window as a
+    metav1.Duration string like every other versioned time field."""
+
+    enabled: Optional[bool] = None
+    slowK: Optional[int] = None
+    sampleEvery: Optional[int] = None  # 0 = completion sampling off
+    window: Optional[str] = None
+    maxPending: Optional[int] = None
+    maxEvents: Optional[int] = None
+
+
+@dataclass
+class IncidentsConfigurationV1alpha1:
+    """Versioned spelling of the incident-autopsy block
+    (config.IncidentsConfig): camelCase (no duration fields — the
+    cooldown and flight window are cycle counts by design)."""
+
+    enabled: Optional[bool] = None
+    capacity: Optional[int] = None
+    flightWindow: Optional[int] = None
+    journeysK: Optional[int] = None
+    cooldownCycles: Optional[int] = None
+    fallbackBurstThreshold: Optional[int] = None  # 0 = trigger off
+    profileCycles: Optional[int] = None  # 0 = incident-armed off
+    profileDir: Optional[str] = None  # "" = profiling off entirely
+    maxProfiles: Optional[int] = None
+
+
+@dataclass
 class LockSanitizerConfigurationV1alpha1:
     """Versioned spelling of the instrumented-lock sanitizer block
     (sanitize.LockSanitizerConfig): camelCase, the hold budget as a
@@ -209,6 +240,10 @@ class ObservabilityConfigurationV1alpha1:
         default_factory=LedgerConfigurationV1alpha1)
     memoryLedger: "MemoryLedgerConfigurationV1alpha1" = field(
         default_factory=MemoryLedgerConfigurationV1alpha1)
+    journeys: "JourneysConfigurationV1alpha1" = field(
+        default_factory=JourneysConfigurationV1alpha1)
+    incidents: "IncidentsConfigurationV1alpha1" = field(
+        default_factory=IncidentsConfigurationV1alpha1)
     lockSanitizer: "LockSanitizerConfigurationV1alpha1" = field(
         default_factory=LockSanitizerConfigurationV1alpha1)
 
@@ -509,6 +544,38 @@ def set_defaults_kube_scheduler_configuration(
         mlg.history = 128
     if mlg.censusLimit is None:
         mlg.censusLimit = 4096
+    jy = ob.journeys
+    if jy.enabled is None:
+        jy.enabled = True
+    if jy.slowK is None:
+        jy.slowK = 8
+    if jy.sampleEvery is None:
+        jy.sampleEvery = 100
+    if jy.window is None:
+        jy.window = "5m0s"
+    if jy.maxPending is None:
+        jy.maxPending = 4096
+    if jy.maxEvents is None:
+        jy.maxEvents = 64
+    ic = ob.incidents
+    if ic.enabled is None:
+        ic.enabled = True
+    if ic.capacity is None:
+        ic.capacity = 16
+    if ic.flightWindow is None:
+        ic.flightWindow = 16
+    if ic.journeysK is None:
+        ic.journeysK = 4
+    if ic.cooldownCycles is None:
+        ic.cooldownCycles = 64
+    if ic.fallbackBurstThreshold is None:
+        ic.fallbackBurstThreshold = 3
+    if ic.profileCycles is None:
+        ic.profileCycles = 0  # incident-armed profiling off
+    if ic.profileDir is None:
+        ic.profileDir = ""  # profiling off entirely
+    if ic.maxProfiles is None:
+        ic.maxProfiles = 4
     ls = ob.lockSanitizer
     if ls.enabled is None:
         ls.enabled = False  # plain threading locks by default
@@ -786,6 +853,8 @@ def _warmup_to_internal(wu: WarmupConfigurationV1alpha1):
 
 def _observability_to_internal(ob: ObservabilityConfigurationV1alpha1):
     from kubernetes_tpu.config import (
+        IncidentsConfig,
+        JourneysConfig,
         LedgerConfig,
         MemoryLedgerConfig,
         ObservabilityConfig,
@@ -794,6 +863,8 @@ def _observability_to_internal(ob: ObservabilityConfigurationV1alpha1):
 
     lg = ob.ledger
     mlg = ob.memoryLedger
+    jy = ob.journeys
+    ic = ob.incidents
     ls = ob.lockSanitizer
     return ObservabilityConfig(
         enabled=ob.enabled,
@@ -833,6 +904,25 @@ def _observability_to_internal(ob: ObservabilityConfigurationV1alpha1):
             limit_bytes=mlg.limitBytes,
             history=mlg.history,
             census_limit=mlg.censusLimit,
+        ),
+        journeys=JourneysConfig(
+            enabled=jy.enabled,
+            slow_k=jy.slowK,
+            sample_every=jy.sampleEvery,
+            window_s=_dur("journeys.window", jy.window, "observability"),
+            max_pending=jy.maxPending,
+            max_events=jy.maxEvents,
+        ),
+        incidents=IncidentsConfig(
+            enabled=ic.enabled,
+            capacity=ic.capacity,
+            flight_window=ic.flightWindow,
+            journeys_k=ic.journeysK,
+            cooldown_cycles=ic.cooldownCycles,
+            fallback_burst_threshold=ic.fallbackBurstThreshold,
+            profile_cycles=ic.profileCycles,
+            profile_dir=ic.profileDir,
+            max_profiles=ic.maxProfiles,
         ),
         lock_sanitizer=LockSanitizerConfig(
             enabled=ls.enabled,
@@ -991,6 +1081,27 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
                 limitBytes=c.observability.memory_ledger.limit_bytes,
                 history=c.observability.memory_ledger.history,
                 censusLimit=c.observability.memory_ledger.census_limit,
+            ),
+            journeys=JourneysConfigurationV1alpha1(
+                enabled=c.observability.journeys.enabled,
+                slowK=c.observability.journeys.slow_k,
+                sampleEvery=c.observability.journeys.sample_every,
+                window=format_duration(
+                    c.observability.journeys.window_s),
+                maxPending=c.observability.journeys.max_pending,
+                maxEvents=c.observability.journeys.max_events,
+            ),
+            incidents=IncidentsConfigurationV1alpha1(
+                enabled=c.observability.incidents.enabled,
+                capacity=c.observability.incidents.capacity,
+                flightWindow=c.observability.incidents.flight_window,
+                journeysK=c.observability.incidents.journeys_k,
+                cooldownCycles=c.observability.incidents.cooldown_cycles,
+                fallbackBurstThreshold=(
+                    c.observability.incidents.fallback_burst_threshold),
+                profileCycles=c.observability.incidents.profile_cycles,
+                profileDir=c.observability.incidents.profile_dir,
+                maxProfiles=c.observability.incidents.max_profiles,
             ),
             lockSanitizer=LockSanitizerConfigurationV1alpha1(
                 enabled=c.observability.lock_sanitizer.enabled,

@@ -30,7 +30,9 @@ from typing import List, Optional
 from kubernetes_tpu.config import (
     DEFAULT_FEATURE_GATES,
     FeatureGates,
+    IncidentsConfig,
     IncrementalConfig,
+    JourneysConfig,
     KubeSchedulerConfiguration,
     LeaderElectionConfig,
     LedgerConfig,
@@ -242,6 +244,45 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
     if mlg.census_limit < 1:
         errs.append(
             "observability.memoryLedger.censusLimit: must be at least 1")
+    jc = oc.journeys
+    if jc.slow_k < 1:
+        errs.append("observability.journeys.slowK: must be at least 1")
+    if jc.sample_every < 0:
+        errs.append(
+            "observability.journeys.sampleEvery: must be non-negative "
+            "(0 = completion sampling off)")
+    if jc.window_s <= 0:
+        errs.append(
+            "observability.journeys.window: must be greater than zero")
+    if jc.max_pending < 1:
+        errs.append(
+            "observability.journeys.maxPending: must be at least 1")
+    if jc.max_events < 2:
+        errs.append(
+            "observability.journeys.maxEvents: must be at least 2")
+    ic = oc.incidents
+    if ic.capacity < 1:
+        errs.append("observability.incidents.capacity: must be at least 1")
+    if ic.flight_window < 0:
+        errs.append(
+            "observability.incidents.flightWindow: must be non-negative")
+    if ic.journeys_k < 0:
+        errs.append(
+            "observability.incidents.journeysK: must be non-negative")
+    if ic.cooldown_cycles < 0:
+        errs.append(
+            "observability.incidents.cooldownCycles: must be non-negative")
+    if ic.fallback_burst_threshold < 0:
+        errs.append(
+            "observability.incidents.fallbackBurstThreshold: must be "
+            "non-negative (0 = trigger off)")
+    if ic.profile_cycles < 0:
+        errs.append(
+            "observability.incidents.profileCycles: must be non-negative "
+            "(0 = incident-armed profiling off)")
+    if ic.max_profiles < 0:
+        errs.append(
+            "observability.incidents.maxProfiles: must be non-negative")
     ls = oc.lock_sanitizer
     if ls.hold_budget_s < 0:
         errs.append(
@@ -326,6 +367,8 @@ _REC_FIELDS = {f.name for f in dataclasses.fields(RecoveryConfig)}
 _OBS_FIELDS = {f.name for f in dataclasses.fields(ObservabilityConfig)}
 _LEDGER_FIELDS = {f.name for f in dataclasses.fields(LedgerConfig)}
 _MEMLEDGER_FIELDS = {f.name for f in dataclasses.fields(MemoryLedgerConfig)}
+_JOURNEYS_FIELDS = {f.name for f in dataclasses.fields(JourneysConfig)}
+_INCIDENTS_FIELDS = {f.name for f in dataclasses.fields(IncidentsConfig)}
 _WARMUP_FIELDS = {f.name for f in dataclasses.fields(WarmupConfig)}
 _INC_FIELDS = {f.name for f in dataclasses.fields(IncrementalConfig)}
 _SERVING_FIELDS = {f.name for f in dataclasses.fields(ServingConfig)}
@@ -440,6 +483,32 @@ def decode_config(doc: dict, path: str = "") -> KubeSchedulerConfiguration:
                         f"{sorted(munknown)}")
                     continue
                 okw["memory_ledger"] = MemoryLedgerConfig(**mval)
+            if "journeys" in okw:
+                jval = okw["journeys"]
+                if not isinstance(jval, dict):
+                    errs.append(
+                        "observability.journeys: expected a mapping")
+                    continue
+                junknown = set(jval) - _JOURNEYS_FIELDS
+                if junknown:
+                    errs.append(
+                        f"observability.journeys: unknown field(s) "
+                        f"{sorted(junknown)}")
+                    continue
+                okw["journeys"] = JourneysConfig(**jval)
+            if "incidents" in okw:
+                ival = okw["incidents"]
+                if not isinstance(ival, dict):
+                    errs.append(
+                        "observability.incidents: expected a mapping")
+                    continue
+                iunknown = set(ival) - _INCIDENTS_FIELDS
+                if iunknown:
+                    errs.append(
+                        f"observability.incidents: unknown field(s) "
+                        f"{sorted(iunknown)}")
+                    continue
+                okw["incidents"] = IncidentsConfig(**ival)
             kw["observability"] = ObservabilityConfig(**okw)
         elif key == "warmup":
             if not isinstance(val, dict):
@@ -591,6 +660,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "the fixed-interval cycle timer")
     p.add_argument("--serving-max-wait", type=float, default=None,
                    help="micro-batch window latency ceiling, seconds")
+    p.add_argument("--journeys", default=None, choices=("true", "false"),
+                   help="per-pod journey tracer (phase-attributed "
+                        "tail-latency timelines at /debug/journeys)")
+    p.add_argument("--profile-dir", default=None,
+                   help="artifact directory for triggered jax.profiler "
+                        "captures (empty = profiling off); arms "
+                        "incident-triggered and /debug/profile captures")
     return p
 
 
@@ -638,6 +714,16 @@ def resolve_config(args) -> KubeSchedulerConfiguration:
     if serving_overlay:
         overlay["serving"] = dataclasses.replace(
             cfg.serving, **serving_overlay)
+    obs_overlay = {}
+    if getattr(args, "journeys", None) is not None:
+        obs_overlay["journeys"] = dataclasses.replace(
+            cfg.observability.journeys, enabled=args.journeys == "true")
+    if getattr(args, "profile_dir", None) is not None:
+        obs_overlay["incidents"] = dataclasses.replace(
+            cfg.observability.incidents, profile_dir=args.profile_dir)
+    if obs_overlay:
+        overlay["observability"] = dataclasses.replace(
+            cfg.observability, **obs_overlay)
     if args.percentage_of_nodes_to_score is not None:
         overlay["percentage_of_nodes_to_score"] = args.percentage_of_nodes_to_score
     if args.leader_elect is not None:

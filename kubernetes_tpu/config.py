@@ -277,6 +277,72 @@ class MemoryLedgerConfig:
 
 
 @dataclass
+class JourneysConfig:
+    """Per-pod journey tracer (obs/journey.py): decompose each bound
+    pod's end-to-end latency into phase shares (queue-wait, backoff,
+    solve, bind-rpc, ambiguous, permit) from the driver's existing host
+    seams. Rides the observability block (``observability.journeys``)
+    because completion feeds the flight-record vocabulary and the
+    incident bundles."""
+
+    #: track journeys (pure host bookkeeping, one lock, zero device
+    #: syncs). Off = the seams no-op and /debug/journeys 404s.
+    enabled: bool = True
+    #: completed journeys retained per rolling window: the K slowest
+    slow_k: int = 8
+    #: unconditional completion sampling — every N-th bound pod is
+    #: retained regardless of slowness (0 = off); keeps healthy
+    #: representative timelines next to the tail
+    sample_every: int = 100
+    #: rolling retention window (seconds, owner clock) for the
+    #: slowest-K tier
+    window_s: float = 300.0
+    #: max in-flight journeys tracked; pods beyond the cap are counted
+    #: (``dropped``) but not tracked — pending state must stay bounded
+    #: even under an unbounded backlog
+    max_pending: int = 4096
+    #: per-journey event/attempt row cap (beyond: counted as elided)
+    max_events: int = 64
+
+
+@dataclass
+class IncidentsConfig:
+    """Incident autopsies (obs/incidents.py): on an SLO-watchdog burn,
+    auditor violation, OOM forensic, retrace storm, or ladder-fallback
+    burst, capture ONE correlated bundle — flight window, ledger +
+    memory + queue snapshots, slowest in-flight journeys, top reasons —
+    onto a bounded ring (``/debug/incidents``, SIGUSR2). Rides the
+    observability block (``observability.incidents``)."""
+
+    #: evaluate triggers at each eventful cycle close. Off = zero cost.
+    enabled: bool = True
+    #: incident-bundle ring capacity; oldest bundles evict
+    capacity: int = 16
+    #: flight records kept per bundle: every record within this many
+    #: cycles of the trigger cycle
+    flight_window: int = 16
+    #: slowest in-flight journeys embedded per bundle
+    journeys_k: int = 4
+    #: per-trigger suppression: a trigger that fired within this many
+    #: cycles of its last bundle is dropped (a sustained burn yields
+    #: one bundle, not one per cycle)
+    cooldown_cycles: int = 64
+    #: cycles a single ladder solve may fall back before the
+    #: ``ladder-fallback`` trigger fires (0 = trigger off)
+    fallback_burst_threshold: int = 3
+    #: arm a ``jax.profiler.start_trace`` capture of this many cycles
+    #: when an incident fires (0 = never profile automatically;
+    #: /debug/profile can still arm one on demand)
+    profile_cycles: int = 0
+    #: artifact directory for profiler captures; empty = profiling off
+    #: entirely (automatic AND on-demand)
+    profile_dir: str = ""
+    #: max profiler captures per process — the artifact dir is bounded
+    #: even under a trigger flood
+    max_profiles: int = 4
+
+
+@dataclass
 class ObservabilityConfig:
     """Observability knobs (kubernetes_tpu/obs): cycle tracing, the JAX
     compile/retrace telemetry, and the flight recorder. All times ride
@@ -329,6 +395,12 @@ class ObservabilityConfig:
     #: resident-byte accounting, capacity preflight, OOM forensics
     memory_ledger: MemoryLedgerConfig = field(
         default_factory=MemoryLedgerConfig)
+    #: per-pod journey tracer (obs/journey.py): e2e latency decomposed
+    #: into phase shares, /debug/journeys
+    journeys: JourneysConfig = field(default_factory=JourneysConfig)
+    #: incident autopsies (obs/incidents.py): correlated trigger
+    #: bundles, /debug/incidents, optional profiler capture
+    incidents: IncidentsConfig = field(default_factory=IncidentsConfig)
     #: instrumented-lock runtime sanitizer (sanitize.py): acquisition-
     #: order cycle detection, hold budgets, dynamic guarded-by checks —
     #: off by default (plain threading locks, zero overhead)

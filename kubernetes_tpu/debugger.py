@@ -41,6 +41,11 @@ def dump(scheduler) -> str:
             # the device-memory view of the same postmortem: ranked
             # residents, watermarks, preflight verdicts, OOM forensics
             lines.append(memledger.dump())
+        incidents = getattr(obs, "incidents", None)
+        if incidents is not None and incidents.enabled:
+            # the correlated-incident view: one line per captured
+            # bundle, pointing the postmortem at /debug/incidents
+            lines.append(incidents.dump())
     return "\n".join(lines)
 
 

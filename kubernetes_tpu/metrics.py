@@ -72,6 +72,7 @@ the conformance test in ``tests/test_metrics_exposition.py``.
 
 from __future__ import annotations
 
+import bisect
 import math
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -159,13 +160,37 @@ class Histogram(_Metric):
         self._n: Dict[Tuple[str, ...], int] = {}
 
     def observe(self, value: float, **labels) -> None:
+        # per-bucket (non-cumulative) storage + one bisect insert: the
+        # hot path is O(log buckets), not O(buckets) — per-pod callers
+        # (e2e latency, the six journey-phase observes per bound pod)
+        # sit on the bind path and pay this on every pod. The slot past
+        # the last bucket holds the +Inf overflow; expose()/quantile()
+        # rebuild the cumulative view on the cold path.
         k = self._key(labels)
-        counts = self._counts.setdefault(k, [0] * len(self.buckets))
-        for i, b in enumerate(self.buckets):
-            if value <= b:
-                counts[i] += 1
+        counts = self._counts.get(k)
+        if counts is None:
+            counts = self._counts[k] = [0] * (len(self.buckets) + 1)
+        counts[bisect.bisect_left(self.buckets, value)] += 1
         self._sum[k] = self._sum.get(k, 0.0) + value
         self._n[k] = self._n.get(k, 0) + 1
+
+    def child(self, **labels):
+        """Precomputed-label observe handle for per-pod hot paths (the
+        journey tracker's six phase observes per bound pod): binds the
+        label key once, so each call is one bisect + three dict writes
+        instead of re-deriving the key tuple from kwargs."""
+        k = self._key(labels)
+        counts = self._counts.get(k)
+        if counts is None:
+            counts = self._counts[k] = [0] * (len(self.buckets) + 1)
+        buckets = self.buckets
+
+        def observe(value: float) -> None:
+            counts[bisect.bisect_left(buckets, value)] += 1
+            self._sum[k] = self._sum.get(k, 0.0) + value
+            self._n[k] = self._n.get(k, 0) + 1
+
+        return observe
 
     def count(self, **labels) -> int:
         return self._n.get(self._key(labels), 0)
@@ -180,23 +205,25 @@ class Histogram(_Metric):
         target = q * n
         counts = self._counts[k]
         lo = 0.0
-        prev = 0
+        cum = 0
         for i, b in enumerate(self.buckets):
-            if counts[i] >= target:
-                in_bucket = counts[i] - prev
-                frac = (target - prev) / max(in_bucket, 1)
+            prev = cum
+            cum += counts[i]
+            if cum >= target:
+                frac = (target - prev) / max(counts[i], 1)
                 return lo + (b - lo) * min(frac, 1.0)
-            lo, prev = b, counts[i]
+            lo = b
         return self.buckets[-1]
 
     def expose(self) -> List[str]:
         out = []
         for k in sorted(self._n):
+            cum = 0
             for i, b in enumerate(self.buckets):
+                cum += self._counts[k][i]
                 le = 'le="%s"' % b
                 out.append(
-                    f"{self.name}_bucket{self._fmt_labels(k, le)} "
-                    f"{self._counts[k][i]}"
+                    f"{self.name}_bucket{self._fmt_labels(k, le)} {cum}"
                 )
             le_inf = 'le="+Inf"'
             out.append(
@@ -615,6 +642,36 @@ class SchedulerMetrics:
             "not judgeable), split = trimmed to a smaller warmed "
             "bucket, shed = requeued whole rather than OOMing.",
             ["action"],
+        ))
+        # -- pod journeys & incident autopsies (obs/journey.py,
+        # obs/incidents.py): where each bound pod's e2e seconds went,
+        # and the correlated-bundle trigger counts ----------------------
+        self.pod_journey_phase_seconds = r.register(Histogram(
+            "scheduler_pod_journey_phase_seconds",
+            "Per-phase share of each bound pod's create-to-bind "
+            "latency (queue-wait | backoff | solve | bind-rpc | "
+            "ambiguous | permit — disjoint; a pod's phases sum to its "
+            "e2e latency). Every bound pod observes EVERY phase, zeros "
+            "included, so per-phase sample counts stay comparable.",
+            ["phase"],
+            buckets=exponential_buckets(0.001, 2, 15),
+        ))
+        self.pod_journeys_total = r.register(Counter(
+            "scheduler_pod_journeys_total",
+            "Completed pod journeys by outcome: bound = confirmed "
+            "bind, gone = left unbound (deleted, terminating, pruned "
+            "by reconcile, taken by another writer).",
+            ["outcome"],
+        ))
+        self.incidents_total = r.register(Counter(
+            "scheduler_incidents_total",
+            "Incident bundles captured by trigger (slo-burn | "
+            "invariant-violation | oom | retrace-storm | "
+            "ladder-fallback); cooldown-suppressed repeats don't "
+            "count. Each bundle correlates the flight window, ledger "
+            "+ memory + queue snapshots, and the slowest in-flight "
+            "journeys at /debug/incidents.",
+            ["trigger"],
         ))
         # -- scenario packs (kubernetes_tpu/scenarios) ------------------
         self.scenario_quality = r.register(Gauge(

@@ -28,7 +28,9 @@ from collections import deque
 from contextlib import contextmanager, nullcontext
 from typing import Callable, List, Optional
 
+from kubernetes_tpu.obs.incidents import IncidentRecorder
 from kubernetes_tpu.obs.jaxtel import JaxTelemetry
+from kubernetes_tpu.obs.journey import JourneyTracker
 from kubernetes_tpu.obs.ledger import PerfLedger
 from kubernetes_tpu.obs.memledger import MemoryLedger
 from kubernetes_tpu.obs.recorder import CycleRecord, FlightRecorder
@@ -76,6 +78,20 @@ class Observability:
                                               None),
                                       metrics=metrics, clock=clock,
                                       lock_factory=lf)
+        #: per-pod journey tracer (obs/journey.py): fed by the queue
+        #: and driver seams, read by /debug/journeys and the incident
+        #: bundles. Same duck-typed config attach as the ledgers.
+        self.journeys = JourneyTracker(getattr(config, "journeys", None),
+                                       metrics=metrics, clock=clock,
+                                       lock_factory=lf)
+        #: incident autopsies (obs/incidents.py): evaluates its five
+        #: triggers against each eventful cycle record at end_cycle;
+        #: the evidence sources are the sibling sub-objects above.
+        self.incidents = IncidentRecorder(
+            getattr(config, "incidents", None), metrics=metrics,
+            clock=clock, lock_factory=lf, recorder=self.recorder,
+            ledger=self.ledger, memledger=self.memledger, jaxtel=self.jax,
+            journeys=self.journeys)
         self.traces: deque = deque(maxlen=max(1, config.trace_ring_capacity))
         #: guards the traces ring: the scheduler thread appends while the
         #: /debug/traces handler thread snapshots (deque iteration during
@@ -411,6 +427,13 @@ class Observability:
             rec.mem_measured_bytes = mentry["measured_bytes"]
             rec.mem_efficiency = mentry["efficiency"]
         self.recorder.record(rec)
+        # incident triggers (obs/incidents.py): every trigger is
+        # derived from state already in hand — the watchdog's burn
+        # counter, the jaxtel storm counters, and the record's own
+        # violation/OOM/fallback fields — so evaluation adds no
+        # scheduler seams and no syncs. Runs AFTER recorder.record so
+        # the bundle's flight window includes the trigger cycle itself.
+        self.incidents.observe_cycle(rec)
         self._eventful_seq += 1
         if self._sampled(self._eventful_seq):
             with self._traces_lock:

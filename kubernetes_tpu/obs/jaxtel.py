@@ -179,6 +179,15 @@ class JaxTelemetry:
                 return self.retraces.get(site, 0)
             return sum(self.retraces.values())
 
+    def storm_total(self, site: Optional[str] = None) -> int:
+        """Storm detections across all sites (or one) — the incident
+        recorder's per-cycle delta source, same locking as
+        :meth:`retrace_total`."""
+        with self._lock:
+            if site is not None:
+                return self.storms.get(site, 0)
+            return sum(self.storms.values())
+
     # -- transfers ----------------------------------------------------------
 
     def record_transfer(self, site: str, direction: str, nbytes: int) -> None:

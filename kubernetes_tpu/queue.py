@@ -182,6 +182,12 @@ class SchedulingQueue:
         #: has made schedulable. The scheduler attaches it
         #: (Scheduler.attach_doorbell); standalone queues stay silent.
         self.doorbell = None
+        #: optional obs.journey.JourneyTracker — fed the pod's
+        #: sub-queue transitions (the phase boundaries of queue-wait /
+        #: backoff time) and the pop-into-cycle edge. The scheduler
+        #: attaches it (same duck pattern as metrics/doorbell);
+        #: standalone queues stay silent.
+        self.journeys = None
 
     # -- metrics plumbing --------------------------------------------------
 
@@ -197,6 +203,8 @@ class SchedulingQueue:
             self.metrics.queue_pod_age.observe(
                 max(self.clock() - t, 0.0), queue=q)
         self._entered[key] = (queue, self.clock())
+        if self.journeys is not None:
+            self.journeys.note_queue(key, queue)
 
     def _note_exit(self, key: str) -> None:
         ent = self._entered.pop(key, None)
@@ -267,6 +275,9 @@ class SchedulingQueue:
         # departure (keep the residency stamp — the same-queue guard in
         # _note_enter reuses it) and not a second PodAdd
         readd = self._contains(pod.key())
+        if not readd and self.journeys is not None:
+            self.journeys.note_created(pod.key(),
+                                       getattr(pod, "uid", ""))
         self._remove_everywhere(pod.key(), observe=not readd)
         self._push_active(pod)
         self.nominated.add(pod)
@@ -315,6 +326,10 @@ class SchedulingQueue:
             out.append(e.pod)
         if out:
             self.scheduling_cycle += 1
+            if self.journeys is not None:
+                for p in out:
+                    self.journeys.note_popped(p.key(),
+                                              self.scheduling_cycle)
             self._sync_gauges()
         return out
 

@@ -463,6 +463,13 @@ class Scheduler:
         # duck-typed so queue fakes without the attribute stay valid
         if getattr(self.queue, "metrics", "absent") is None:
             self.queue.metrics = self.metrics
+        # the journey tracer rides the queue's residency seams (add /
+        # sub-queue transitions / pop) — same duck attach as metrics
+        if getattr(self.queue, "journeys", "absent") is None:
+            self.queue.journeys = self.obs.journeys
+        # the incident bundles embed the queue depths at trigger time
+        if getattr(self.obs, "incidents", None) is not None:
+            self.obs.incidents.queue_snapshot = self.queue.pending_counts
         #: latest explanation per still-pending pod (the /debug/why
         #: surface): updated each cycle from the UnschedulableReport,
         #: dropped when the pod binds or leaves
@@ -711,6 +718,10 @@ class Scheduler:
             # peer, competing scheduler) would be scheduled again here and
             # double-booked.
             self.queue.delete(new.key())
+            # journey: our own bind already completed it at the success
+            # tail (this no-ops); a COMPETING writer's bind closes it
+            # here as gone — it never bound through this scheduler
+            self.obs.journeys.note_gone(new.key())
             # AssignedPodUpdated: wake only affinity-matching waiters, not
             # the whole unschedulableQ (eventhandlers.go)
             self.queue.assigned_pod_added(new)
@@ -862,9 +873,10 @@ class Scheduler:
 
     def _note_gone(self, key: str) -> None:
         """A pod legitimately left the state machine — tell the
-        attached auditor (no-op without one)."""
+        attached auditor (no-op without one) and close its journey."""
         if self.auditor is not None:
             self.auditor.note_gone(key)
+        self.obs.journeys.note_gone(key)
 
     def on_started_leading(self) -> None:
         """OnStartedLeading (app/server.go:261): this incarnation just
@@ -991,6 +1003,10 @@ class Scheduler:
                     self.queue.delete(key)
                     self.why_pending.pop(key, None)
                     self._cycle_states.pop(key, None)
+                    # a reconcile-adopted bind never went through THIS
+                    # incarnation's bind tail: close the journey as
+                    # gone (no bogus e2e sample, no bound outcome)
+                    self.obs.journeys.note_gone(key)
                 elif self.responsible_for(tp):
                     queued = self.queue.pod(key)
                     if (queued is not None and queued.uid == tp.uid) \
@@ -1071,6 +1087,7 @@ class Scheduler:
         binds it; this one must not race the hub CAS)."""
         self.metrics.recovery_fenced_binds.inc()
         self.obs.note_fenced_bind()
+        self.obs.journeys.note_fenced(pod.key())
         self._fail(pod, cycle, res, ("FencedBind:lease lost",))
 
     def _reap_expired_assumptions(self) -> None:
@@ -1117,6 +1134,8 @@ class Scheduler:
                     # retry the protocol forbids
                     self.cache.assume_pod(p, p.node_name)
                     self._ambiguous_binds[key] = (p, p.node_name, None)
+                    self.obs.journeys.note_ambiguous_park(
+                        key, "assume-expired")
                     klog.warning(
                         "assumed pod %s expired and verification is "
                         "unreachable; parked assumed", key)
@@ -1887,6 +1906,10 @@ class Scheduler:
             "rounds=%d %.3fs", cycle, label, res.attempted, res.scheduled,
             res.unschedulable, res.rounds, res.elapsed_s,
         )
+        # backfill the ladder tier + solve scope onto the journey
+        # attempt rows this cycle touched (known only now)
+        self.obs.journeys.finish_cycle(cycle, res.solver_tier,
+                                       res.solve_scope)
         self._record_metrics(res, solve_s)
         trace.log_if_long(self.trace_threshold_s)
         self.obs.end_cycle(res)
@@ -1907,11 +1930,16 @@ class Scheduler:
         # scheduleOne observes once per pod): every bound pod's
         # queue-add -> bind delta lands in the histogram. Cycles that
         # attempted but bound nothing keep the legacy cycle-elapsed
-        # observation so failure latency stays visible.
+        # observation so failure latency stays visible. The fallback is
+        # gated on res.attempted ALONE: off-cycle callers (the parked
+        # ambiguous-bind verifier, the stopped-leading Permit drain)
+        # hand in a fresh CycleResult whose elapsed_s was never stamped,
+        # and their unschedulable/bind_errors counts must not emit a
+        # bogus near-zero e2e sample.
         if res.e2e_latency_s:
             for v in res.e2e_latency_s.values():
                 m.e2e_scheduling_duration.observe(v)
-        elif res.attempted or res.scheduled or res.unschedulable:
+        elif res.attempted:
             m.e2e_scheduling_duration.observe(res.elapsed_s)
         if res.attempted or res.scheduled or res.unschedulable:
             m.scheduling_duration.observe(solve_s, operation="scheduling_algorithm")
@@ -3102,6 +3130,7 @@ class Scheduler:
         ps = fw.run_permit(st, pod, node_name)
         if ps.code == _WAIT:
             res.waiting += 1
+            self.obs.journeys.note_permit_park(pod.key())
             return
         if not ps.is_success():
             self.cache.forget_pod(pod.key())
@@ -3158,6 +3187,7 @@ class Scheduler:
         s = fw.run_prebind(st, pod, node_name)
         if not s.is_success():
             return reject(f"PreBind:{s.message}")
+        self.obs.journeys.note_bind_start(pod.key())
         bt0 = self.clock()
         bs = fw.run_bind(st, pod, node_name)
         if bs.code == _SKIP:
@@ -3214,6 +3244,7 @@ class Scheduler:
         # 0.0 is a valid fake-clock enqueue time, not "unset")
         res.e2e_latency_s[pod.key()] = max(
             self.clock() - getattr(pod, "queued_at", self.clock()), 0.0)
+        self.obs.journeys.note_bound(pod.key(), cycle)
         fw.run_postbind(st, pod, node_name)
         self._cycle_states.pop(pod.key(), None)
         self.event_sink("Scheduled", pod, node_name)
@@ -3298,6 +3329,7 @@ class Scheduler:
                          "verification is unreachable; parked assumed",
                          key, node_name)
             self._ambiguous_binds[key] = (pod, node_name, st)
+            self.obs.journeys.note_ambiguous_park(key, "bind-timeout")
             self._cycle_states.pop(key, None)
             return False
         if resolution == "adopted":
@@ -3389,6 +3421,8 @@ class Scheduler:
                 res.e2e_latency_s[key] = max(
                     self.clock() - getattr(pod, "queued_at",
                                            self.clock()), 0.0)
+                self.obs.journeys.note_bound(
+                    key, self.queue.scheduling_cycle)
                 self.framework.run_postbind(st, pod, node_name)
                 self.event_sink("Scheduled", pod, node_name)
                 klog.V(2).info("parked ambiguous bind of %s -> %s "
@@ -3478,6 +3512,7 @@ class Scheduler:
             for v in result.victims:
                 v.deletion_timestamp = now
                 self.event_sink("Preempted", v, f"by {pod.key()}")
+                self.obs.journeys.note_evicted(v.key(), pod.key())
                 if self.victim_deleter is not None:
                     # deletion goes through the hub; the victim stays in the
                     # cache as terminating until the watch delete arrives
@@ -3561,6 +3596,8 @@ class Scheduler:
             v.deletion_timestamp = now
             self.event_sink(
                 "Preempted", v, f"by {sel.victim_of[v.key()]} (cascade)")
+            self.obs.journeys.note_evicted(
+                v.key(), sel.victim_of[v.key()])
             if self.victim_deleter is not None:
                 # deletion goes through the hub; the victim holds its
                 # capacity as terminating until the watch delete lands,
@@ -3896,6 +3933,10 @@ class Scheduler:
         res.failure_reasons[pod.key()] = tuple(reasons)
         if message is not None:
             res.fit_errors[pod.key()] = message
+        # journey attempt row (tier/scope backfilled at _finish_cycle);
+        # the queue re-add below then closes the solve phase
+        self.obs.journeys.note_attempt_failed(
+            pod.key(), cycle, reasons[0] if reasons else "")
         self._cycle_states.pop(pod.key(), None)  # cycle over for this pod
         self.queue.record_failure(pod)
         self.queue.add_unschedulable_if_not_present(pod, cycle)
@@ -4595,6 +4636,11 @@ class Scheduler:
                 1 if self.cache.has_score_summary() else 0),
             "mem_residents": self.obs.memledger.resident_count(),
             "mem_census_arrays": self.obs.memledger.census_count(),
+            # journey/incident retention — pending journeys must DRAIN
+            # with traffic, the completed tiers and the incident ring
+            # must plateau at their caps
+            **self.obs.journeys.sizes(),
+            **self.obs.incidents.sizes(),
         }
 
     def run_until_settled(self, max_cycles: int = 50) -> List[CycleResult]:

@@ -234,6 +234,68 @@ def why_payload(sched, path: str):
     return 200, doc
 
 
+def journeys_payload(sched, path: str):
+    """The ``/debug/journeys`` body (per-pod journey tracer,
+    obs/journey.py): ``?pod=<ns/name or name>`` returns that pod's full
+    timeline — phase decomposition, attempt rows, raw events; without
+    an argument, the slowest-K completed table plus the oldest
+    in-flight journeys. Returns ``(status, json-able dict)``."""
+    import heapq
+    from urllib.parse import parse_qs, urlparse
+
+    q = parse_qs(urlparse(path).query)
+    pod = (q.get("pod") or [""])[0]
+    obs = getattr(sched, "obs", None)
+    journeys = getattr(obs, "journeys", None)
+    if journeys is None or not getattr(journeys, "enabled", False):
+        return 404, {"error": "no journey tracker on this scheduler"}
+    if not pod:
+        return 200, journeys.snapshot()
+    doc = journeys.timeline(pod)
+    if doc is None and "/" not in pod:
+        # bare names resolve like /debug/why: default namespace first,
+        # then a unique suffix match across namespaces
+        doc = journeys.timeline(f"default/{pod}")
+        if doc is None:
+            known = journeys.keys()
+            hits = [k for k in known if k.endswith(f"/{pod}")]
+            doc = journeys.timeline(hits[0]) if len(hits) == 1 else None
+    if doc is None:
+        return 404, {
+            "error": f"no journey retained for {pod!r}",
+            "known": heapq.nsmallest(50, journeys.keys()),
+        }
+    return 200, doc
+
+
+def profile_payload(sched, path: str):
+    """The ``/debug/profile`` body: arm an on-demand
+    ``jax.profiler`` capture of the next ``?cycles=N`` cycle closes
+    (obs/incidents.py — bounded by the incidents config's profile_dir
+    and max_profiles). Returns ``(status, json-able dict)``."""
+    from urllib.parse import parse_qs, urlparse
+
+    q = parse_qs(urlparse(path).query)
+    obs = getattr(sched, "obs", None)
+    incidents = getattr(obs, "incidents", None)
+    if incidents is None:
+        return 404, {"error": "no incident recorder on this scheduler"}
+    try:
+        cycles = int((q.get("cycles") or ["8"])[0])
+    except ValueError:
+        return 400, {"error": "cycles must be an integer"}
+    started = incidents.arm_profile(cycles, tag="debug")
+    return (200 if started else 409), {
+        "started": started,
+        "cycles": cycles,
+        "profile_dir": str(getattr(incidents.config, "profile_dir", "")),
+        "profiles_taken": incidents.profiles_taken,
+        "note": ("" if started else
+                 "not started: profiling disabled (empty profile_dir), "
+                 "a capture is already active, or max_profiles reached"),
+    }
+
+
 def serve_scheduler(
     scheduler,
     host: str = "127.0.0.1",
@@ -370,6 +432,33 @@ def serve_scheduler(
                         "application/json")
             elif self.path.split("?", 1)[0] == "/debug/why":
                 code, doc = why_payload(sched, self.path)
+                self._respond(code, json.dumps(doc).encode(),
+                              "application/json")
+            elif self.path.split("?", 1)[0] == "/debug/journeys":
+                # per-pod journey tracer (obs/journey.py): bare = the
+                # slowest-K completed table + oldest in-flight rows;
+                # ?pod= = one pod's full phase-decomposed timeline
+                code, doc = journeys_payload(sched, self.path)
+                self._respond(code, json.dumps(doc).encode(),
+                              "application/json")
+            elif self.path == "/debug/incidents":
+                # incident autopsies (obs/incidents.py): the bounded
+                # ring of correlated trigger bundles. snapshot() is
+                # thread-safe like /debug/ledger.
+                obs = getattr(sched, "obs", None)
+                incidents = getattr(obs, "incidents", None)
+                if incidents is None:
+                    self._respond(
+                        404, b"no incident recorder on this scheduler",
+                        "text/plain")
+                else:
+                    self._respond(
+                        200, json.dumps(incidents.snapshot()).encode(),
+                        "application/json")
+            elif self.path.split("?", 1)[0] == "/debug/profile":
+                # on-demand jax.profiler capture of the next N cycles
+                # (gated by observability.incidents.profileDir)
+                code, doc = profile_payload(sched, self.path)
                 self._respond(code, json.dumps(doc).encode(),
                               "application/json")
             else:

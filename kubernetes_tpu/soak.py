@@ -92,6 +92,24 @@ DEFAULT_TOLERANCE: Dict[str, float] = {
     "mem.census_arrays": 4096,
     "mem.modeled_bytes": 1 << 24,  # 16 MB: bucket-shape settling
     "mem.oom_records": 16,         # ring maxlen-capped upstream
+    # pod-journey tracer: pending journeys are pod-keyed side state
+    # and must RETURN to baseline when traffic does (tolerance 0, made
+    # explicit); the completed tiers are capped upstream (slowest by
+    # slow_k, sampled by its deque maxlen) and legitimately plateau as
+    # the tail fills in. Mirrored sched.* rows: state_sizes() exports
+    # the same numbers under its own namespace.
+    "journey.pending": 0,
+    "journey.slowest": 64,
+    "journey.sampled": 64,
+    "sched.journey_pending": 0,
+    "sched.journey_slowest": 64,
+    "sched.journey_sampled": 64,
+    # incident ring: occupancy is deque maxlen-capped upstream and
+    # plateaus once it first fills; NEW bundles during a clean window
+    # are caught by the clean_zero `incidents` counter, not by ring
+    # occupancy (an at-capacity ring stays the same length)
+    "incident.ring": 64,
+    "sched.incident_ring": 64,
 }
 
 
@@ -184,6 +202,25 @@ class SoakSentinels:
                         memledger.census_count())
                     out["mem.oom_records"] = float(
                         len(memledger.oom_records()))
+                journeys = getattr(obs, "journeys", None)
+                if journeys is not None and getattr(
+                        journeys, "enabled", False):
+                    # per-pod journey retention: pending must drain
+                    # with the queues; the completed tiers are capped
+                    # upstream (slow_k / deque maxlen)
+                    jsz = journeys.sizes()
+                    out["journey.pending"] = float(
+                        jsz.get("journey_pending", 0))
+                    out["journey.slowest"] = float(
+                        jsz.get("journey_slowest", 0))
+                    out["journey.sampled"] = float(
+                        jsz.get("journey_sampled", 0))
+                incidents = getattr(obs, "incidents", None)
+                if incidents is not None and getattr(
+                        incidents, "enabled", False):
+                    # ring OCCUPANCY only — `total` is a cumulative
+                    # counter and belongs to the clean_zero contract
+                    out["incident.ring"] = float(len(incidents))
             san = getattr(s, "lock_sanitizer", None)
             if san is not None:
                 # monotonic finding counts: the clean-window contract
@@ -507,6 +544,17 @@ def standard_counters(sched, auditor=None, extra=None
         "fenced_binds": lambda: float(
             sched.metrics.recovery_fenced_binds.value()),
     }
+    incidents = getattr(obs, "incidents", None)
+    if incidents is not None and getattr(incidents, "enabled", False):
+        # captured incident bundles: monotonic, joins the clean-window
+        # zero contract — a clean phase that trips ANY incident trigger
+        # is not clean, whatever the sentinel occupancies say
+        counters["incidents"] = lambda: float(incidents.total)
+    journeys = getattr(obs, "journeys", None)
+    if journeys is not None and getattr(journeys, "enabled", False):
+        # journeys dropped at the max_pending cap: monotonic; movement
+        # means the backlog outran the tracer's bounded pending table
+        counters["journey_drops"] = lambda: float(journeys.dropped_total)
     if auditor is not None:
         counters["auditor_violations"] = (
             lambda: float(auditor.violations_total))

@@ -700,7 +700,10 @@ def main(argv=None) -> int:
         phases, sentinels, counters=counters,
         clean_zero=("slo_burns", "auditor_violations", "double_binds",
                     "retraces", "fenced_binds", "preempted",
-                    "lock_order_cycles", "lock_guard_violations"),
+                    "lock_order_cycles", "lock_guard_violations",
+                    # a clean window must not capture incident bundles
+                    # nor drop journeys at the pending cap
+                    "incidents", "journey_drops"),
         step_s=args.step_s, sample_every_s=args.sample_every,
         p99_drift_bound=args.p99_drift_bound,
         log=lambda m: print(f"  {m}", file=sys.stderr))

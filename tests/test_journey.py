@@ -1,0 +1,637 @@
+"""Pod journeys & incident autopsies (ISSUE 19) — the tier-1
+acceptance suite:
+
+- the :class:`JourneyTracker` decomposes a driven slow pod's e2e
+  latency into phase shares that sum to ~1.0, end-to-end through
+  ``/debug/journeys?pod=`` (the tentpole acceptance pin);
+- e2e latency provenance on the PR-15 ambiguous paths: an adopted
+  ambiguous bind observes create→bind (not park→resolve), and the
+  off-cycle verifier never emits a bogus near-zero sample;
+- an induced mid-phase SLO burn captures EXACTLY ONE incident bundle
+  whose journeys, flight window, and ledger snapshot reference the
+  same trigger cycle; the cooldown suppresses re-burns and expires;
+- every trigger seam (slo-burn, invariant-violation, oom,
+  retrace-storm, ladder-fallback) fires from duck-typed cycle
+  records; the ring stays bounded; the profiler capture arms and
+  disarms within its budget;
+- retention: all pending (capped + drop-counted), slowest-K per
+  rolling window, 1-in-N sampling; ``state_sizes()`` and the soak
+  sentinel/counter tables carry the new keys;
+- journeys-on overhead < 2% of a contended cycle, zero retraces, and
+  graftlint R2/R3/R7/R9/R10 clean over both new modules.
+"""
+
+from __future__ import annotations
+
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from kubernetes_tpu.config import (
+    IncidentsConfig,
+    JourneysConfig,
+    LedgerConfig,
+    ObservabilityConfig,
+)
+from kubernetes_tpu.faults import RPCError, RPCTimeout
+from kubernetes_tpu.obs.incidents import TRIGGERS, IncidentRecorder
+from kubernetes_tpu.obs.journey import PHASES, JourneyTracker
+from kubernetes_tpu.scheduler import CycleResult, Scheduler
+from kubernetes_tpu.server import journeys_payload, profile_payload
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+class Clock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class Truth:
+    """The test_net_chaos scriptable hub truth: a binder that can
+    commit-then-timeout (the ambiguous class) and a reader the
+    scheduler verifies against."""
+
+    def __init__(self) -> None:
+        self.bound: dict = {}
+        self.uids: dict = {}
+        self.script: list = []
+        self.reader_down = False
+
+    def bind(self, pod, node_name: str) -> None:
+        self.uids[pod.key()] = pod.uid
+        action = self.script.pop(0) if self.script else "ok"
+        if action == "error":
+            raise RPCError("injected: definitely not committed")
+        if action == "timeout_committed":
+            self.bound[pod.key()] = node_name
+            raise RPCTimeout("injected: committed, response lost")
+        if action == "timeout_lost":
+            raise RPCTimeout("injected: not committed, looks identical")
+        self.bound[pod.key()] = node_name
+
+    def read(self, key: str):
+        if self.reader_down:
+            raise RPCTimeout("injected: verification GET unreachable")
+        if key not in self.uids:
+            return None
+        return SimpleNamespace(uid=self.uids[key],
+                               node_name=self.bound.get(key, ""))
+
+
+def _sched(truth: Truth, clock=None, **kw):
+    clock = clock or Clock()
+    s = Scheduler(
+        binder=truth, clock=clock, enable_preemption=False,
+        retry_sleep=lambda _s: None, jitter_seed=1,
+        pod_reader=truth.read, **kw)
+    s.on_node_add(make_node("n0", cpu_milli=8000))
+    return s, clock
+
+
+# ---------------------------------------------------------------------------
+# tracker unit layer (fake clock, driven directly)
+# ---------------------------------------------------------------------------
+
+
+def test_phase_decomposition_sums_to_e2e():
+    clk = Clock()
+    jt = JourneyTracker(JourneysConfig(), clock=clk)
+    jt.note_created("d/p", "u1")
+    clk.advance(2.0)                      # queue-wait
+    jt.note_popped("d/p", 1)
+    clk.advance(0.5)                      # solve
+    jt.note_bind_start("d/p")
+    clk.advance(0.25)                     # bind-rpc
+    jt.note_bound("d/p", 1)
+    doc = jt.timeline("d/p")
+    assert doc["outcome"] == "bound"
+    assert doc["e2e_s"] == pytest.approx(2.75)
+    assert sum(doc["phases_s"].values()) == pytest.approx(doc["e2e_s"])
+    assert sum(doc["phase_share"].values()) == pytest.approx(1.0, abs=2e-3)
+    assert doc["phases_s"]["queue-wait"] == pytest.approx(2.0)
+    assert doc["phases_s"]["solve"] == pytest.approx(0.5)
+    assert doc["phases_s"]["bind-rpc"] == pytest.approx(0.25)
+
+
+def test_retention_slowest_k_rolling_window_and_sampling():
+    clk = Clock()
+    jt = JourneyTracker(
+        JourneysConfig(slow_k=2, sample_every=3, window_s=100.0),
+        clock=clk)
+    for i in range(6):
+        key = f"d/p{i}"
+        jt.note_created(key, "u")
+        clk.advance(float(i))             # e2e grows with i
+        jt.note_bound(key, i)
+    sz = jt.sizes()
+    assert sz["journey_slowest"] == 2     # slowest-K cap
+    assert sz["journey_sampled"] == 2     # completions 3 and 6
+    slow = [j["pod"] for j in jt.snapshot()["slowest"]]
+    assert slow == ["d/p5", "d/p4"]       # the two slowest, ordered
+    # the rolling window expires the old tail: after window_s of quiet
+    # the next completion retains only itself
+    clk.advance(200.0)
+    jt.note_created("d/late", "u")
+    clk.advance(1.0)
+    jt.note_bound("d/late", 9)
+    assert [j["pod"] for j in jt.snapshot()["slowest"]] == ["d/late"]
+    # completed journeys stay resolvable through the retention tiers
+    assert jt.timeline("d/late")["done"]
+
+
+def test_pending_cap_counts_drops_and_gone_closes():
+    jt = JourneyTracker(JourneysConfig(max_pending=2), clock=Clock())
+    jt.note_created("d/a", "u")
+    jt.note_created("d/b", "u")
+    jt.note_created("d/c", "u")           # over the cap: counted, untracked
+    assert jt.dropped_total == 1
+    assert jt.sizes()["journey_pending"] == 2
+    jt.note_gone("d/a")                   # watch delete / reconcile prune
+    assert jt.gone_total == 1
+    assert jt.sizes()["journey_pending"] == 1
+    assert jt.timeline("d/a") is None     # gone journeys are not retained
+
+
+def test_event_ring_elides_beyond_max_events():
+    clk = Clock()
+    jt = JourneyTracker(JourneysConfig(max_events=4), clock=clk)
+    jt.note_created("d/p", "u")
+    for i in range(10):
+        jt.note_queue("d/p", "backoff" if i % 2 else "active")
+    doc = jt.timeline("d/p")
+    assert len(doc["events"]) == 4
+    assert doc["events_elided"] > 0
+
+
+def test_disabled_tracker_is_inert():
+    jt = JourneyTracker(JourneysConfig(enabled=False), clock=Clock())
+    jt.note_created("d/p", "u")
+    jt.note_bound("d/p", 1)
+    assert jt.sizes() == {"journey_pending": 0, "journey_slowest": 0,
+                          "journey_sampled": 0}
+    assert jt.snapshot()["enabled"] is False
+    assert jt.created_total == jt.bound_total == 0
+
+
+# ---------------------------------------------------------------------------
+# tentpole acceptance: a driven slow pod, end to end through /debug
+# ---------------------------------------------------------------------------
+
+
+def test_slow_pod_journey_explains_e2e_latency():
+    """The acceptance pin: the pod fails its first bind, serves a
+    backoff window, and lands on retry — ``/debug/journeys?pod=``
+    must decompose its e2e latency into phase shares summing to ~1.0
+    with the seconds attributed where they were actually spent."""
+    truth = Truth()
+    s, clk = _sched(truth)
+    s.on_pod_add(make_pod("slow", cpu_milli=100))
+    clk.advance(1.0)                      # queue-wait before the cycle
+    truth.script = ["error"]
+    res = s.schedule_cycle()              # bind error -> unschedulableQ
+    assert res.scheduled == 0
+    clk.advance(0.5)                      # parked unschedulable
+    # a cluster event moves the pod: still inside its backoff window,
+    # so it lands in the backoffQ and serves the rest there
+    s.on_node_add(make_node("n1", cpu_milli=8000))
+    clk.advance(3.0)                      # backoffQ residency
+    res = s.schedule_cycle()
+    assert res.scheduled == 1
+
+    code, doc = journeys_payload(s, "/debug/journeys?pod=default/slow")
+    assert code == 200
+    assert doc["outcome"] == "bound"
+    assert doc["e2e_s"] == pytest.approx(4.5)
+    share = doc["phase_share"]
+    assert sum(share.values()) == pytest.approx(1.0, abs=2e-3)
+    # the seconds went where the harness put them: 1.0 pre-cycle +
+    # 0.5 unschedulable accrue to queue-wait, the 3.0 in the backoffQ
+    # to backoff
+    assert doc["phases_s"]["backoff"] == pytest.approx(3.0)
+    assert doc["phases_s"]["queue-wait"] == pytest.approx(1.5)
+    # the attempt rows carry the failure and the landing, with the
+    # ladder tier backfilled at cycle close
+    outcomes = [(a["outcome"], a["tier"] != "") for a in doc["attempts"]]
+    assert ("failed", True) in outcomes and ("bound", True) in outcomes
+    # e2e metric agrees with the journey (create -> bind, fake clock):
+    # the failed cycle contributed the legacy cycle-elapsed fallback
+    # sample (0.0 on the fake clock), the bind the 4.5s pod sample
+    h = s.metrics.e2e_scheduling_duration
+    assert h.count() == 2
+    assert sum(h._sum.values()) == pytest.approx(4.5)
+    # the phase histogram observed EVERY phase for the bound pod —
+    # per-phase sample counts stay comparable
+    counts = {ph: s.metrics.pod_journey_phase_seconds.count(phase=ph)
+              for ph in PHASES}
+    assert set(counts.values()) == {1}
+    assert s.metrics.pod_journeys_total.value(outcome="bound") == 1
+
+
+def test_debug_journeys_bare_name_and_unknown_pod():
+    truth = Truth()
+    s, clk = _sched(truth)
+    s.on_pod_add(make_pod("web", cpu_milli=100))
+    clk.advance(0.5)
+    s.schedule_cycle()
+    # bare snapshot: counters + slowest table
+    code, doc = journeys_payload(s, "/debug/journeys")
+    assert code == 200 and doc["bound"] == 1
+    assert doc["slowest"][0]["pod"] == "default/web"
+    # bare-name resolution: "web" -> default/web
+    code, doc = journeys_payload(s, "/debug/journeys?pod=web")
+    assert code == 200 and doc["pod"] == "default/web"
+    # unknown pod: 404 with the resolvable keys listed
+    code, doc = journeys_payload(s, "/debug/journeys?pod=nope")
+    assert code == 404 and "default/web" in doc["known"]
+
+
+def test_debug_journeys_404_when_disabled():
+    s = Scheduler(
+        enable_preemption=False,
+        observability=ObservabilityConfig(
+            journeys=JourneysConfig(enabled=False)))
+    code, doc = journeys_payload(s, "/debug/journeys")
+    assert code == 404 and "error" in doc
+
+
+def test_state_sizes_exports_journey_and_incident_occupancy():
+    truth = Truth()
+    s, _clk = _sched(truth)
+    sizes = s.state_sizes()
+    for key in ("journey_pending", "journey_slowest", "journey_sampled",
+                "incident_ring"):
+        assert key in sizes, f"{key} missing from state_sizes()"
+
+
+# ---------------------------------------------------------------------------
+# e2e latency provenance on the PR-15 ambiguous paths (satellite pin)
+# ---------------------------------------------------------------------------
+
+
+def test_adopted_ambiguous_bind_observes_create_to_bind():
+    """In-cycle adoption: the hub committed before the response was
+    lost. The e2e sample must span create->bind — the pod waited in
+    the queue like any other — not just the resolution round-trip."""
+    truth = Truth()
+    s, clk = _sched(truth)
+    s.on_pod_add(make_pod("amb", cpu_milli=100))
+    clk.advance(3.0)
+    truth.script = ["timeout_committed"]
+    res = s.schedule_cycle()
+    assert res.scheduled == 1
+    h = s.metrics.e2e_scheduling_duration
+    assert h.count() == 1
+    assert sum(h._sum.values()) == pytest.approx(3.0)
+
+
+def test_parked_adoption_observes_create_to_bind():
+    """Parked adoption (verification GET unreachable at bind time):
+    when the hub finally answers, the adopted pod's e2e sample anchors
+    on its queue-add stamp — the park time COUNTS, it is latency the
+    pod actually suffered."""
+    truth = Truth()
+    s, clk = _sched(truth)
+    s.on_pod_add(make_pod("amb", cpu_milli=100))
+    clk.advance(1.0)
+    truth.script = ["timeout_committed"]
+    truth.reader_down = True
+    res = s.schedule_cycle()              # parks assumed, nothing bound
+    assert res.scheduled == 0
+    h = s.metrics.e2e_scheduling_duration
+    before = h.count()                    # in-cycle fallback only (0.0s)
+    assert sum(h._sum.values()) == pytest.approx(0.0)
+    clk.advance(6.0)
+    truth.reader_down = False
+    s.idle_tick()                         # re-probe resolves: adopted
+    assert h.count() == before + 1
+    assert sum(h._sum.values()) == pytest.approx(7.0)
+    # the journey closed bound, with the park attributed to ambiguous
+    doc = s.obs.journeys.timeline("default/amb")
+    assert doc["outcome"] == "bound"
+    assert doc["phases_s"]["ambiguous"] == pytest.approx(6.0)
+    assert sum(doc["phase_share"].values()) == pytest.approx(1.0, abs=2e-3)
+
+
+def test_offcycle_requeue_emits_no_bogus_near_zero_sample():
+    """The regression this PR fixes: the off-cycle verifier hands
+    ``_record_metrics`` a fresh CycleResult whose ``elapsed_s`` was
+    never stamped. A verified-unbound requeue must NOT observe a
+    near-zero e2e sample through the legacy cycle-elapsed fallback."""
+    truth = Truth()
+    s, clk = _sched(truth)
+    s.on_pod_add(make_pod("lost", cpu_milli=100))
+    truth.script = ["timeout_lost"]
+    truth.reader_down = True
+    res = s.schedule_cycle()              # parks (verification down)
+    assert res.scheduled == 0
+    truth.reader_down = False             # hub answers: NOT committed
+    before = s.metrics.e2e_scheduling_duration.count()
+    s.idle_tick()                         # off-cycle verify -> requeue
+    assert "default/lost" not in s._ambiguous_binds
+    assert s.metrics.e2e_scheduling_duration.count() == before, (
+        "off-cycle requeue leaked a bogus e2e sample")
+    # the pod is back in the queue, journey still open
+    assert not s.obs.journeys.timeline("default/lost")["done"]
+
+
+# ---------------------------------------------------------------------------
+# incident autopsies: the mid-phase SLO burn captures ONE bundle
+# ---------------------------------------------------------------------------
+
+
+def _ledger_cfg(**kw):
+    base = dict(e2e_p99_objective_s=0.05, fast_window_s=60.0,
+                slow_window_s=600.0, burn_threshold=1.0)
+    base.update(kw)
+    return LedgerConfig(**base)
+
+
+def _feed_cycle(s, clk, cycle, latencies, solve_s=0.001):
+    obs = s.obs
+    obs.begin_cycle(cycle)
+    obs.note_batch_shape("P8xN8")
+    with obs.span("solve:batch"):
+        clk.advance(solve_s)
+    res = CycleResult(
+        attempted=max(len(latencies), 1), scheduled=len(latencies),
+        rounds=1, solver_tier="batch",
+        e2e_latency_s={f"e{cycle}-{i}": v
+                       for i, v in enumerate(latencies)})
+    return obs.end_cycle(res)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def test_mid_phase_slo_burn_yields_exactly_one_correlated_bundle():
+    """The acceptance pin + the fake-clock soak pin: a latency burn in
+    the middle of a driven phase captures EXACTLY ONE bundle whose
+    journeys, flight window, and ledger snapshot all reference the
+    same trigger cycle; sustained burning and a re-burn inside the
+    cooldown add nothing; a re-burn past the cooldown captures one
+    more."""
+    clk = FakeClock()
+    s = Scheduler(
+        enable_preemption=False, clock=clk,
+        observability=ObservabilityConfig(ledger=_ledger_cfg()))
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    # an in-flight pod for the bundle's journey slice
+    s.queue.add(make_pod("parked", cpu_milli=100))
+
+    for c in range(3):                    # healthy traffic
+        _feed_cycle(s, clk, c, [0.01, 0.02])
+        clk.advance(1.0)
+    assert len(s.obs.incidents) == 0
+
+    rec = _feed_cycle(s, clk, 10, [0.2, 0.3, 0.4])   # the burn
+    assert rec.slo == "e2e_p99"
+    inc = s.obs.incidents
+    assert inc.total == 1 and len(inc) == 1
+    b = inc.incidents()[0]
+    assert b["trigger"] == "slo-burn"
+    # correlation: bundle, flight window, and evidence snapshots all
+    # reference the trigger cycle
+    assert b["cycle"] == rec.cycle == 10
+    assert any(r["cycle"] == b["cycle"] for r in b["flight_window"])
+    assert b["ledger"] is not None
+    assert b["queues"] is not None and b["queues"].get("active") == 1
+    assert [j["pod"] for j in b["journeys"]] == ["default/parked"]
+    assert s.metrics.incidents_total.value(trigger="slo-burn") == 1
+
+    # sustained burning: burns_total does not advance -> no new bundle
+    for c in (11, 12):
+        _feed_cycle(s, clk, c, [0.2, 0.3])
+    assert inc.total == 1
+
+    # recover, then re-burn INSIDE the cooldown: suppressed
+    clk.advance(120.0)
+    _feed_cycle(s, clk, 20, [0.01])
+    _feed_cycle(s, clk, 30, [0.3, 0.3, 0.3])
+    assert inc.total == 1, "cooldown must suppress the near re-burn"
+
+    # recover, then re-burn PAST the cooldown: one more bundle
+    clk.advance(120.0)
+    _feed_cycle(s, clk, 90, [0.01])
+    clk.advance(1.0)
+    _feed_cycle(s, clk, 110, [0.3, 0.3, 0.3])
+    assert inc.total == 2
+    # the SIGUSR2 dump carries the ring
+    from kubernetes_tpu.debugger import dump
+    assert "incident ring" in dump(s)
+
+
+def _rec(cycle, **kw):
+    base = dict(cycle=cycle, invariant_violations=0, oom_forensic="",
+                fallbacks=0, top_reasons=[])
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+def test_each_trigger_seam_fires_from_the_cycle_record():
+    cases = [
+        ("invariant-violation", dict(invariant_violations=2)),
+        ("oom", dict(oom_forensic="DeviceOOM@c1")),
+        ("ladder-fallback", dict(fallbacks=3)),
+    ]
+    for trigger, fields in cases:
+        ir = IncidentRecorder(IncidentsConfig())
+        out = ir.observe_cycle(_rec(1, **fields))
+        assert [b["trigger"] for b in out] == [trigger]
+        assert ir.by_trigger[trigger] == 1
+    # the delta-detected pair: watchdog burns and jaxtel storms
+    led = SimpleNamespace(
+        watchdog=SimpleNamespace(burns_total=lambda: 1), enabled=False)
+    ir = IncidentRecorder(IncidentsConfig(), ledger=led)
+    assert [b["trigger"] for b in ir.observe_cycle(_rec(1))] == ["slo-burn"]
+    jt = SimpleNamespace(storm_total=lambda: 2)
+    ir = IncidentRecorder(IncidentsConfig(), jaxtel=jt)
+    assert ([b["trigger"] for b in ir.observe_cycle(_rec(1))]
+            == ["retrace-storm"])
+    assert set(ir.by_trigger) == set(TRIGGERS)
+
+
+def test_fallback_burst_threshold_zero_disables_the_trigger():
+    ir = IncidentRecorder(IncidentsConfig(fallback_burst_threshold=0))
+    assert ir.observe_cycle(_rec(1, fallbacks=50)) == []
+
+
+def test_cooldown_suppression_per_trigger_and_expiry():
+    ir = IncidentRecorder(IncidentsConfig(cooldown_cycles=4))
+    assert len(ir.observe_cycle(_rec(1, invariant_violations=1))) == 1
+    assert ir.observe_cycle(_rec(3, invariant_violations=1)) == []
+    # a DIFFERENT trigger is not suppressed by the first one's cooldown
+    assert len(ir.observe_cycle(_rec(3, oom_forensic="x"))) == 1
+    # the first trigger fires again once its own cooldown elapses
+    assert len(ir.observe_cycle(_rec(5, invariant_violations=1))) == 1
+    assert ir.total == 3
+
+
+def test_ring_stays_bounded_and_disabled_recorder_is_inert():
+    ir = IncidentRecorder(IncidentsConfig(capacity=2, cooldown_cycles=0))
+    for c in range(5):
+        ir.observe_cycle(_rec(c * 10, invariant_violations=1))
+    assert len(ir) == 2 and ir.total == 5
+    assert ir.snapshot()["capacity"] == 2
+    off = IncidentRecorder(IncidentsConfig(enabled=False))
+    assert off.observe_cycle(_rec(1, invariant_violations=1)) == []
+    assert off.snapshot()["enabled"] is False
+
+
+def test_profiler_capture_arms_ticks_and_respects_budget(tmp_path):
+    ir = IncidentRecorder(IncidentsConfig(
+        profile_dir=str(tmp_path), max_profiles=1))
+    ok = ir.arm_profile(2, tag="t")
+    if not ok:
+        # jax.profiler unavailable/failed here: best-effort contract —
+        # the failure is counted, never raised
+        assert ir.profile_errors == 1
+        return
+    assert ir.snapshot()["profile_active"]
+    assert ir.arm_profile(2) is False     # already active
+    ir._profile_tick()
+    ir._profile_tick()                    # capture window closed
+    assert not ir.snapshot()["profile_active"]
+    assert ir.arm_profile(2) is False     # max_profiles budget spent
+    assert ir.profiles_taken == 1
+
+
+def test_profile_arm_denied_without_artifact_dir():
+    ir = IncidentRecorder(IncidentsConfig(profile_dir=""))
+    assert ir.arm_profile(4) is False
+    assert ir.profiles_taken == 0
+
+
+def test_debug_profile_endpoint_payloads():
+    s = Scheduler(enable_preemption=False)
+    code, doc = profile_payload(s, "/debug/profile?cycles=abc")
+    assert code == 400
+    # no profile_dir configured: the arm is refused, not an error
+    code, doc = profile_payload(s, "/debug/profile?cycles=4")
+    assert code == 409 and doc["started"] is False
+
+
+# ---------------------------------------------------------------------------
+# soak integration: sentinel tolerances + clean-window counters
+# ---------------------------------------------------------------------------
+
+
+def test_soak_sentinels_and_counters_carry_the_new_namespaces():
+    from kubernetes_tpu.soak import (
+        DEFAULT_TOLERANCE,
+        SoakSentinels,
+        standard_counters,
+    )
+
+    for key in ("journey.pending", "sched.journey_pending",
+                "incident.ring", "sched.incident_ring"):
+        assert key in DEFAULT_TOLERANCE
+    # pending journeys are pod-keyed side state: zero tolerance
+    assert DEFAULT_TOLERANCE["journey.pending"] == 0
+    truth = Truth()
+    s, clk = _sched(truth)
+    s.on_pod_add(make_pod("p", cpu_milli=100))
+    clk.advance(0.1)
+    s.schedule_cycle()
+    sample = SoakSentinels(sched=s).collect()
+    assert sample["journey.pending"] == 0.0   # drained with the queue
+    assert "incident.ring" in sample
+    counters = standard_counters(s)
+    assert counters["incidents"]() == 0.0
+    assert counters["journey_drops"]() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# budgets: overhead < 2% of a contended cycle, zero retraces, lint
+# ---------------------------------------------------------------------------
+
+
+def test_journey_overhead_under_budget_on_contended_cycle():
+    """The ledger-overhead-style budget: the per-pod notes a cycle
+    itself executes for every pod it binds (pop, bind start, bound
+    with all six phase observes and retention), scaled to the batch
+    the cycle bound, against the cycle's measured wall time.
+
+    The production criterion is < 2% on the headline bench, enforced
+    on the committed bench records (benchres/churn_*.json) where the
+    machine is dedicated. Here the threshold is 10%: loose enough to
+    survive shared-CI noise (pure-Python microbenchmarks and XLA
+    cycle times do not co-vary under co-tenant load), tight enough to
+    catch the algorithmic-regression class this pin exists for — the
+    O(buckets)-per-observe histogram bug measured 13% on this very
+    harness. note_created/note_queue run on the watch/add path,
+    outside the cycle's elapsed_s."""
+    from kubernetes_tpu.metrics import SchedulerMetrics
+
+    s = Scheduler(enable_preemption=False)
+    for i in range(8):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=160000))
+    for i in range(192):
+        s.on_pod_add(make_pod(f"w{i}", cpu_milli=50))
+    s.schedule_cycle()                    # cold (compiles)
+    for i in range(192):
+        s.on_pod_add(make_pod(f"x{i}", cpu_milli=50))
+    res = s.schedule_cycle()              # warm, contended
+    rec = s.obs.recorder.records()[-1]
+    assert rec.elapsed_s > 0 and res.scheduled == 192
+
+    n = 2000
+    best = float("inf")
+    for _rep in range(3):                 # best-of-3 damps CI noise
+        fresh = JourneyTracker(JourneysConfig(),
+                               metrics=SchedulerMetrics())
+        keys = [f"d/p{i}" for i in range(n)]
+        for k in keys:
+            fresh.note_created(k, "u")
+            fresh.note_queue(k, "active")
+        t0 = time.perf_counter()
+        for i, k in enumerate(keys):
+            fresh.note_popped(k, i)
+            fresh.note_bind_start(k)
+            fresh.note_bound(k, i)
+        best = min(best, (time.perf_counter() - t0) / n)
+    overhead = best * res.scheduled / rec.elapsed_s
+    assert overhead < 0.10, (
+        f"journeys cost {overhead:.2%} of a contended cycle "
+        f"({best*1e6:.1f}us/pod x {res.scheduled} pods vs "
+        f"{rec.elapsed_s*1e3:.1f}ms)")
+
+
+def test_zero_new_retraces_with_journeys_on():
+    truth = Truth()
+    s, clk = _sched(truth)
+    for c in range(4):
+        for i in range(8):
+            s.on_pod_add(make_pod(f"c{c}-{i}", cpu_milli=10))
+        clk.advance(0.1)
+        s.schedule_cycle()
+    assert s.obs.jax.retrace_total() == 0, (
+        "the journey tracker must not perturb the solve signatures")
+
+
+def test_journey_and_incident_modules_lint_clean():
+    """R2/R3/R7 (readback discipline) + R9/R10 (lock discipline) over
+    both new modules — pure host bookkeeping, no device access, no
+    blocking calls under a lock."""
+    from kubernetes_tpu.obs import incidents as incidents_mod
+    from kubernetes_tpu.obs import journey as journey_mod
+    from kubernetes_tpu.testing import lint_clean
+
+    lint_clean(journey_mod, rules=("R2", "R3", "R7", "R9", "R10"),
+               jit_all=False)
+    lint_clean(incidents_mod, rules=("R2", "R3", "R7", "R9", "R10"),
+               jit_all=False)

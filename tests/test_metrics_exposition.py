@@ -300,3 +300,59 @@ def test_memledger_gauge_freshness_zeroes_stale_device_series():
     assert g.value(kind="peak", device="3") == 0.0
     assert g.value(kind="modeled", device="all") == 50.0
     assert metrics.memory_model_efficiency.value() == -1.0
+
+
+def test_journey_metric_block_conforms(scraped):
+    """The journey/incident block (obs/journey.py, obs/incidents.py)
+    rides the same strict grammar: the per-phase latency histogram
+    carries one sample per phase for the driven bound pod (the closed
+    phase vocabulary, equal counts — the comparability contract), the
+    journey outcome counter sampled the bind, and the incident counter
+    family is declared (HELP/TYPE) even while nothing has triggered."""
+    from kubernetes_tpu.obs.journey import PHASES
+
+    _metrics, text = scraped
+    types, samples = parse_exposition(text)
+    fams = {f for f, _, _, _ in samples}
+    assert "scheduler_pod_journey_phase_seconds" in fams
+    assert "scheduler_pod_journeys_total" in fams
+    assert types["scheduler_pod_journey_phase_seconds"] == "histogram"
+    assert types["scheduler_pod_journeys_total"] == "counter"
+    assert types["scheduler_incidents_total"] == "counter"
+    # every phase of the closed vocabulary exposed, none invented
+    counts = {labels["phase"]: v for f, name, labels, v in samples
+              if f == "scheduler_pod_journey_phase_seconds"
+              and name.endswith("_count")}
+    assert set(counts) == set(PHASES)
+    # zeros included per bound pod: per-phase sample counts are equal
+    assert len(set(counts.values())) == 1 and counts["solve"] >= 1
+    outcomes = {labels["outcome"]: v for f, _, labels, v in samples
+                if f == "scheduler_pod_journeys_total"}
+    assert outcomes.get("bound", 0) >= 1
+    # the clean fixture triggered nothing: declared, zero samples
+    assert not any(f == "scheduler_incidents_total" and v > 0
+                   for f, _, _, v in samples)
+
+
+def test_journey_histogram_rebuilds_cumulative_buckets():
+    """The phase histogram stores per-bucket (non-cumulative) counts
+    with a +Inf overflow slot so the per-pod observe is one bisect;
+    expose() must rebuild a monotone-cumulative bucket series whose
+    +Inf equals _count — including values past the last finite le."""
+    from kubernetes_tpu.metrics import SchedulerMetrics
+
+    m = SchedulerMetrics()
+    h = m.pod_journey_phase_seconds
+    for v in (0.0005, 0.003, 0.02, 5.0, 1e9):  # under, mid, mid, high, +Inf
+        h.observe(v, phase="solve")
+    text = m.registry.expose()
+    types, samples = parse_exposition(text)
+    assert check_histograms(types, samples) >= 1
+    inf = [v for f, name, labels, v in samples
+           if f == "scheduler_pod_journey_phase_seconds"
+           and name.endswith("_bucket") and labels.get("le") == "+Inf"]
+    assert inf == [5.0]
+    assert h.count(phase="solve") == 5
+    # median sample is 0.02 -> interpolated inside its (0.016, 0.032]
+    # bucket from the rebuilt cumulative view
+    assert h.quantile(0.5, phase="solve") == pytest.approx(0.02, rel=0.5)

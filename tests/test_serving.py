@@ -801,6 +801,19 @@ def test_bench_compare_churn_gates(tmp_path):
     bad = bc.compare_churn(_churn_rec(0.06, 0.2), _churn_rec(0.06, 0.9),
                            threshold=0.10)
     assert any("shed_rate" in r["check"] for r in bad["regressions"])
+    # takeover gate: one lease-retry tick (0.15 x lease_duration_s) of
+    # absolute slack — the standby only attempts acquisition every
+    # retry tick, so a delta inside one tick is phase alignment, not a
+    # regression; a delta past the tick still trips.
+    def _fo(takeover):
+        rec = _churn_rec(0.06, 0.5)
+        rec["arms"]["failover"] = {"takeover_s": takeover,
+                                   "lease_duration_s": 2.0}
+        return rec
+    ok = bc.compare_churn(_fo(2.488), _fo(2.77), threshold=0.10)
+    assert not any("takeover" in r["check"] for r in ok["regressions"]), ok
+    bad = bc.compare_churn(_fo(2.488), _fo(2.80), threshold=0.10)
+    assert any("takeover" in r["check"] for r in bad["regressions"])
     # absence tolerance: zero or one churn record must not fail the gate
     assert bc.find_churn_records(str(tmp_path)) == []
     (tmp_path / "churn_r01.json").write_text(json.dumps(_churn_rec(0.06,
