@@ -273,6 +273,10 @@ class IncrementalConfigurationV1alpha1:
     warmPotentials: Optional[bool] = None
     warmTol: Optional[float] = None
     qualityDelta: Optional[float] = None
+    primary: Optional[bool] = None
+    coldBlocks: Optional[int] = None
+    autoTune: Optional[bool] = None
+    groupQuotaFrac: Optional[float] = None
 
 
 @dataclass
@@ -430,6 +434,14 @@ def set_defaults_kube_scheduler_configuration(
         inc.warmTol = 1e-3
     if inc.qualityDelta is None:
         inc.qualityDelta = 0.02
+    if inc.primary is None:
+        inc.primary = False
+    if inc.coldBlocks is None:
+        inc.coldBlocks = 0
+    if inc.autoTune is None:
+        inc.autoTune = False
+    if inc.groupQuotaFrac is None:
+        inc.groupQuotaFrac = 0.5
     wu = obj.warmup
     if wu.enabled is None:
         wu.enabled = False
@@ -781,6 +793,10 @@ def _incremental_to_internal(inc: IncrementalConfigurationV1alpha1):
         warm_potentials=inc.warmPotentials,
         warm_tol=inc.warmTol,
         quality_delta=inc.qualityDelta,
+        primary=inc.primary,
+        cold_blocks=inc.coldBlocks,
+        auto_tune=inc.autoTune,
+        group_quota_frac=inc.groupQuotaFrac,
     )
 
 
@@ -1011,6 +1027,10 @@ def _from_internal(c: KubeSchedulerConfiguration) -> KubeSchedulerConfigurationV
             warmPotentials=c.incremental.warm_potentials,
             warmTol=c.incremental.warm_tol,
             qualityDelta=c.incremental.quality_delta,
+            primary=c.incremental.primary,
+            coldBlocks=c.incremental.cold_blocks,
+            autoTune=c.incremental.auto_tune,
+            groupQuotaFrac=c.incremental.group_quota_frac,
         ),
         warmup=WarmupConfigurationV1alpha1(
             enabled=c.warmup.enabled,

@@ -145,6 +145,16 @@ def validate_config(cfg: KubeSchedulerConfiguration) -> List[str]:
         errs.append("incremental.warmTol: must be greater than zero")
     if inc.quality_delta < 0:
         errs.append("incremental.qualityDelta: must be non-negative")
+    if inc.cold_blocks < 0:
+        errs.append("incremental.coldBlocks: must be non-negative "
+                    "(0 selects the automatic block count)")
+    if not 0 < inc.group_quota_frac <= 1:
+        errs.append(
+            f"incremental.groupQuotaFrac: Invalid value "
+            f"{inc.group_quota_frac}: not in valid range (0, 1]")
+    if inc.primary and not inc.enabled:
+        errs.append("incremental.primary: requires incremental.enabled "
+                    "(the sparsity-first route rides the score cache)")
     rc = cfg.robustness
     if rc.cycle_deadline_s < 0:
         errs.append("robustness.cycleDeadlineSeconds: must be non-negative")
@@ -630,6 +640,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "+ restricted candidate-column solves + warm "
                         "Sinkhorn potentials (steady-state cycle cost "
                         "O(churn), cold solve stays the fallback)")
+    p.add_argument("--sparse-primary", default=None,
+                   choices=("true", "false"),
+                   help="sparsity-first solve: restricted candidate "
+                        "routing as the PRIMARY path (implies "
+                        "--incremental true; full-snapshot cycles "
+                        "rebuild the score plane and still solve "
+                        "restricted, the cold path runs partitioned, "
+                        "the candidate bucket auto-tunes; the dense "
+                        "solve stays the correctness oracle)")
     p.add_argument("--mesh", default=None,
                    help="sharded execution backend: off | auto | N "
                         "(1-D device mesh over the node axis)")
@@ -695,6 +714,12 @@ def resolve_config(args) -> KubeSchedulerConfiguration:
     if getattr(args, "incremental", None) is not None:
         overlay["incremental"] = dataclasses.replace(
             cfg.incremental, enabled=args.incremental == "true")
+    if getattr(args, "sparse_primary", None) is not None:
+        base = overlay.get("incremental", cfg.incremental)
+        on = args.sparse_primary == "true"
+        overlay["incremental"] = dataclasses.replace(
+            base, enabled=base.enabled or on, primary=on,
+            auto_tune=on)
     if getattr(args, "mesh", None) is not None:
         spec = args.mesh
         if spec not in ("off", "auto"):

@@ -486,6 +486,32 @@ class IncrementalConfig:
     #: (mean lean score, fraction) — the bench_compare incremental gate
     #: enforces it on every churn_incr record
     quality_delta: float = 0.02
+    #: sparsity-first routing (docs/perf.md "Sparsity-first solve"):
+    #: the restricted candidate solve is the PRIMARY route at scale —
+    #: full-snapshot cycles lazily rebuild the score plane and still
+    #: solve restricted, and the cold/full-rebuild path runs as
+    #: capacity-balanced restricted BLOCKS plus one final remainder
+    #: pass (partitioned cold) instead of one dense N-wide solve. The
+    #: dense solve stays as the correctness oracle and the fallback for
+    #: declined/under-placed attempts.
+    primary: bool = False
+    #: partition block count for the partitioned cold solve; 0 = auto
+    #: (the padded node bucket over the candidate bucket, capped at 8 —
+    #: enough blocks that no block solve sees more than ~N/8 columns,
+    #: few enough that an adversarial batch can't multiply solves)
+    cold_blocks: int = 0
+    #: auto-tune the candidate bucket from observed micro-batch sizes
+    #: and placement-depth telemetry (how deep in the candidate list
+    #: accepted assignments actually land). The tuner only ever picks a
+    #: bucket the warmup sweep compiled (zero retraces by
+    #: construction); without a warmed ladder it stays pinned to
+    #: ``candidate_bucket``.
+    auto_tune: bool = False
+    #: fraction of the candidate bucket that group-quota hints (a
+    #: gang's home-slice columns, a scenario pack's candidate hint) may
+    #: claim; a batch whose hint set exceeds the quota declines to the
+    #: cold solve rather than starving the rank-picked candidates
+    group_quota_frac: float = 0.5
 
 
 @dataclass

@@ -27,10 +27,12 @@ faces, one :class:`MemoryLedger` facade that
 - **Capacity preflight** — warmup AOT-lowers every bucket; the
   compiled executable's ``memory_analysis()`` (argument / output /
   temp bytes) lands in a per-shape peak table
-  (:meth:`record_bucket_memory`), and the scheduler preflights each
-  cycle's (P, N, mesh) against ``limit x headroom_frac``
-  (:meth:`preflight`) — splitting the batch down to a smaller warmed
-  bucket or shedding it back to the queue *instead of* OOMing
+  (:meth:`record_bucket_memory`) — under the sparsity-first mode the
+  restricted (P, C) frame rows join the dense (P, N) buckets — and
+  the scheduler preflights each cycle's (P, N, mesh) against
+  ``limit x headroom_frac`` (:meth:`preflight`) — splitting the batch
+  down to a smaller warmed bucket or shedding it back to the queue
+  *instead of* OOMing
   (``scheduler_memory_preflight_total{action=ok|split|shed}``).
 - **OOM forensics** — the device-loss/DeviceOOM recovery path calls
   :meth:`record_oom` BEFORE dropping the resident table: a ranked

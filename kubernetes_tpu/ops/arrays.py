@@ -361,17 +361,25 @@ def gather_node_rows(nodes: DeviceNodes, idx: jnp.ndarray) -> DeviceNodes:
     return DeviceNodes(**out)
 
 
-@partial(jax.jit, static_argnames=("k",))
+@partial(jax.jit, static_argnames=("k", "num_shards", "hint_quota"))
 def gather_candidates(summary, dirty_mask: jnp.ndarray,
-                      nodes: DeviceNodes, k: int):
+                      nodes: DeviceNodes, k: int, hint_mask=None,
+                      num_shards: int = 1, hint_quota: int = 0):
     """Fused candidate pick + row gather — ONE dispatch for the
     restricted solve's column selection (ops/fused_score.
     candidate_columns composed with :func:`gather_node_rows`; separate
     dispatches measurably tax small-cluster cycles on CPU). Returns
-    ``(cand_idx, sub_nodes)``."""
+    ``(cand_idx, sub_nodes)``. ``hint_mask`` reserves group-quota
+    columns (gang home slices / pack hints) — with ``hint_quota > 0``
+    as a reserved split capped at quota slots; ``num_shards > 1`` takes
+    the mesh-sharded two-stage pick — per-shard local top-k, then a
+    replicated merge of only the (S, k) winner frame, never a dense
+    plane (bit-identical to the single-pass pick on any shard
+    count)."""
     from kubernetes_tpu.ops.fused_score import candidate_columns
 
-    cand = candidate_columns(summary, dirty_mask, k)
+    cand = candidate_columns(summary, dirty_mask, k, hint_mask,
+                             num_shards, hint_quota)
     return cand, gather_node_rows(nodes, cand)
 
 

@@ -237,6 +237,12 @@ class NodeSummary(NamedTuple):
 #: finite (padding-safe) but far above any free-fraction rank in [0, 1]
 DIRTY_BOOST = 1e6
 
+#: rank boost for group-hinted columns (a gang's home-slice columns, a
+#: scenario pack's candidate hint): guaranteed a slot ahead of every
+#: plain rank but BELOW the dirty boost — the churn frontier always
+#: wins the quota contest (docs/perf.md "Sparsity-first solve")
+HINT_BOOST = 1e5
+
 _NEG = -3e38  # ineligible-column rank (finite: top_k handles -inf fine,
 # but a finite sentinel keeps the padded-index arithmetic NaN-free)
 
@@ -300,18 +306,123 @@ def patch_node_summary(summary, sub, idx):
                                        jnp.asarray(idx, jnp.int32))
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
-def candidate_columns(summary, dirty_mask, k):
+def _candidate_score(summary, dirty_mask, hint_mask):
+    """The shared candidate ranking: plain rank + the dirty-frontier
+    boost + (optionally) the group-quota hint boost. Hinted ineligible
+    columns stay at the sentinel — a quota can widen the cut, never
+    resurrect a dead column."""
+    score = summary.rank + jnp.where(dirty_mask & summary.eligible,
+                                     DIRTY_BOOST, 0.0)
+    if hint_mask is not None:
+        score = score + jnp.where(hint_mask & summary.eligible,
+                                  HINT_BOOST, 0.0)
+    return score
+
+
+def _merge_local_topk(vals, idx, k):
+    """Replicated merge of the per-shard winners: lexicographic sort by
+    (value desc, global index asc) over the (shards * k,) pool, take
+    the first k. The index tie-break matches ``jax.lax.top_k``'s
+    (lower index first), which is what makes the sharded pick
+    bit-identical to the single-pass one."""
+    neg, sidx = jax.lax.sort((jnp.negative(vals.reshape(-1)),
+                              idx.reshape(-1)), num_keys=2)
+    return jnp.negative(neg[:k]), sidx[:k]
+
+
+def _sharded_topk(score, k, num_shards):
+    """Top-``k`` of a (N,) score plane, mesh-shardable: ``num_shards >
+    1`` selects the two-stage pick — the plane reshapes to (S, N/S), a
+    zero-collective VIEW of the node-sharded resident layout (each row
+    one shard's contiguous block), each shard top-k's LOCALLY, and only
+    the (S, k) winner frame merges replicated
+    (:func:`_merge_local_topk`). The global top-k set can take at most
+    k entries from any one shard, and both stages break ties on the
+    lower global index, so the result is BIT-IDENTICAL to the
+    single-pass pick on any shard count — the mesh-parity contract the
+    fuzz suite pins. A dense (S, N) or (P, N) plane never
+    materializes. Shapes that cannot shard evenly (or k too large for
+    a lossless local pick) take the single-pass path."""
+    n = score.shape[0]
+    if num_shards > 1 and n % num_shards == 0 and k <= n // num_shards:
+        local = n // num_shards
+        lvals, lidx = jax.lax.top_k(score.reshape(num_shards, local), k)
+        offs = (jnp.arange(num_shards, dtype=jnp.int32) * local)[:, None]
+        return _merge_local_topk(lvals, lidx.astype(jnp.int32) + offs, k)
+    return jax.lax.top_k(score, k)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "num_shards", "hint_quota"))
+def candidate_columns(summary, dirty_mask, k, hint_mask=None,
+                      num_shards=1, hint_quota=0):
     """Top-``k`` candidate node columns for the restricted solve: the
     best-ranked eligible columns, with every DIRTY eligible column
     (bind/delete/update-touched this cycle — the churn frontier)
-    guaranteed a slot via a rank boost. O(N log k), the only full-N
-    work an incremental cycle performs. Returns (k,) int32 column
-    indices; slots that fell on ineligible columns point one past the
-    table (== N) so downstream gathers treat them as padding."""
+    guaranteed a slot via a rank boost, and every HINTED eligible
+    column (a gang's home-slice quota, a scenario pack's candidate
+    hint) a slot right behind it. O(N log k), the only full-N work an
+    incremental cycle performs. Returns (k,) int32 column indices;
+    slots that fell on ineligible columns point one past the table
+    (== N) so downstream gathers treat them as padding.
+
+    ``hint_quota > 0`` switches the hint from a boost to a RESERVED
+    SPLIT: the first ``hint_quota`` slots hold the top hinted columns
+    (dirty boost still applies within the segment), the remaining
+    ``k - hint_quota`` hold the top UNHINTED columns — disjoint by
+    construction, so a large hint set (a whole home slice) can never
+    crowd plain-ranked candidates out of the frame. Quota slots a
+    too-small hint set cannot fill come out as padding sentinels
+    (harmless: gathered rows reject every predicate).
+
+    The pick shards on the mesh via :func:`_sharded_topk` — per-shard
+    local top-k, replicated merge of the (S, k) winners, bit-identical
+    to single-pass on any shard count."""
     n = summary.rank.shape[0]
-    score = summary.rank + jnp.where(dirty_mask & summary.eligible,
-                                     DIRTY_BOOST, 0.0)
-    vals, idx = jax.lax.top_k(score, k)
+    if hint_mask is not None and 0 < hint_quota < k:
+        base = _candidate_score(summary, dirty_mask, None)
+        hv, hi = _sharded_topk(jnp.where(hint_mask, base, _NEG),
+                               hint_quota, num_shards)
+        uv, ui = _sharded_topk(jnp.where(hint_mask, _NEG, base),
+                               k - hint_quota, num_shards)
+        vals = jnp.concatenate([hv, uv])
+        idx = jnp.concatenate([hi, ui])
+    else:
+        score = _candidate_score(summary, dirty_mask, hint_mask)
+        vals, idx = _sharded_topk(score, k, num_shards)
     return jnp.where(vals > _NEG / 2, idx.astype(jnp.int32),
                      jnp.int32(n))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_blocks", "block_width",
+                                    "num_shards"))
+def partition_columns(summary, dirty_mask, n_blocks, block_width,
+                      num_shards=1):
+    """Capacity-balanced column blocks for the PARTITIONED COLD solve
+    (docs/perf.md "Sparsity-first solve"): take the top
+    ``n_blocks * block_width`` columns by rank (one sharded top-k —
+    still nothing (P, N)-shaped) and deal them round-robin into
+    ``n_blocks`` blocks of ``block_width`` columns each. Two things
+    follow from the shape choice:
+
+    - ``block_width`` is the restricted path's candidate bucket C, so
+      every block solves through the ALREADY-COMPILED (P, C)
+      restricted program — a partitioned cold cycle adds zero new
+      solver shapes (the zero-retrace contract);
+    - the round-robin deal balances capacity: block b holds ranks
+      b, b+B, b+2B, ... so every block spans the rank spectrum and
+      block 0 owns the single best column — the first block solve
+      places most of a cold batch on an uncontended frame.
+
+    Cold cost stops scaling linearly with N: O(N log(B·C)) selection
+    plus B fixed-size (P, C) solves, vs the dense solve's O(P·N)
+    plane. Ineligible columns map to the padding sentinel (== N)
+    exactly like :func:`candidate_columns` slots. Returns
+    (n_blocks, block_width) int32."""
+    n = summary.rank.shape[0]
+    score = _candidate_score(summary, dirty_mask, None)
+    vals, order = _sharded_topk(score, n_blocks * block_width, num_shards)
+    idx = jnp.where(vals > _NEG / 2, order.astype(jnp.int32),
+                    jnp.int32(n))
+    return idx.reshape(block_width, n_blocks).T

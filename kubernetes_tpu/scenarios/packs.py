@@ -48,6 +48,13 @@ class ScenarioPack:
     #: route preemption through the in-batch cascade when the scenario
     #: config asks for it (consolidation turns this on)
     wants_cascade = False
+    #: the pack's cost term survives restriction to a candidate-column
+    #: frame — i.e. ``cost`` depends on the node table rows alone (a
+    #: gathered (P, C) sub-table sees the same per-column values), not
+    #: on global cross-column structure. Packs that opt in ride the
+    #: sparsity-first restricted/pipelined paths; the default keeps
+    #: unknown packs on the dense oracle.
+    restricted_ok = False
 
     def __init__(self, config) -> None:
         self.config = config
@@ -71,11 +78,22 @@ class ScenarioPack:
         (already read back — zero extra readback bytes)."""
         return {}
 
+    def candidate_hint(self, batch, nt, node_order) -> Optional[np.ndarray]:
+        """(N,) bool mask of columns the restricted path should keep in
+        the candidate frame for this batch (HINT_BOOST seam), or None.
+        Host-side only — the mask is uploaded, never read back. Packs
+        whose cost term concentrates on specific columns (e.g. a gang's
+        home slice) use this so top-C restriction cannot starve them."""
+        return None
+
 
 class ConsolidationPack(ScenarioPack):
     """Minimize-nodes-used / maximize-headroom under priority tiers."""
 
     name = "consolidation"
+    # consolidation_bias is a per-column function of dn (occupancy +
+    # headroom) — restricting to candidate columns preserves it exactly
+    restricted_ok = True
 
     @property
     def wants_cascade(self) -> bool:
@@ -106,6 +124,10 @@ class GangTopologyPack(ScenarioPack):
     slices, all-or-nothing groups (the scheduler's gang rollback)."""
 
     name = "gang-topology"
+    # gang_topology_score is per-column (slice distance of each node's
+    # zone to the pod's home zone); candidate_hint below keeps the home
+    # slices' columns in the frame so restriction can't strand a gang
+    restricted_ok = True
 
     # graftlint: disable-scope=R7 -- nt is the HOST-mirror NodeTable
     # (numpy arrays the packer built on host); no device value ever
@@ -156,6 +178,20 @@ class GangTopologyPack(ScenarioPack):
         return gang_topology_score(
             jnp.asarray(home), dn, jnp.float32(self.config.cost_weight),
             superpod=self.config.superpod)
+
+    # graftlint: disable-scope=R7 -- nt is the HOST-mirror NodeTable
+    # (numpy); the hint mask is derived host-side and uploaded only
+    def candidate_hint(self, batch, nt, node_order) -> Optional[np.ndarray]:
+        """Keep every column inside a gang's home slice: the top-C
+        rank order knows nothing about slice distance, so without the
+        hint a hot-but-remote candidate set could leave a gang zero
+        feasible home-slice columns and force the dense fallback."""
+        home = self._home_zones(batch, nt)
+        zones = np.unique(home[home >= 0])
+        if zones.size == 0:
+            return None
+        zone = np.asarray(nt.zone_id)[: nt.n]
+        return np.isin(zone, zones)
 
     # graftlint: disable-scope=R7 -- nt is the HOST-mirror NodeTable
     # (numpy); gang bookkeeping reads host arrays only

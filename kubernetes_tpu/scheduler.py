@@ -147,6 +147,11 @@ class CycleResult:
     #: this cycle (1 - recomputed/live; 0.0 on full solves) — the
     #: "cost proportional to churn" provenance
     reuse_frac: float = 0.0
+    #: capacity-balanced blocks the PARTITIONED cold solve ran (0 =
+    #: not a partitioned cycle): solve_scope == "partitioned" cycles
+    #: solved B fixed-width restricted frames instead of the dense
+    #: (P, N) plane — the sparsity-first cold path (docs/perf.md)
+    cold_blocks: int = 0
     #: device solve time for the cycle (the span total the scheduling_
     #: algorithm histogram observes) — split by solve_scope in the
     #: churn bench so warm-start wins are visible per cycle
@@ -427,6 +432,17 @@ class Scheduler:
         #: the signal that makes an invalidation drop COUNTABLE (see
         #: _drop_incremental)
         self._incr_active = False
+        #: candidate-bucket auto-tuner state (incremental.auto_tune):
+        #: the warmed-C ladder _warm_incremental compiled (the tuner
+        #: may ONLY pick from this set — an unwarmed C would retrace on
+        #: the hot path, breaking the zero-retrace contract), the
+        #: recent raw micro-batch sizes (sliding window, host ints),
+        #: and the deepest candidate-frame position any restricted
+        #: solve placed into (the placement-rank telemetry — one
+        #: device-side scalar riding the solve-result readback)
+        self._warmed_cbuckets: set = set()
+        self._tuner_batch_obs: List[int] = []
+        self._tuner_depth_max = 0
         #: sharded execution backend (config.ParallelConfig): when the
         #: mesh is on, the node axis of the resident snapshot — and with
         #: it the (P, N) plane of every solve/validate/explain kernel —
@@ -1495,6 +1511,23 @@ class Scheduler:
             if inc_out is not None:
                 return inc_out
 
+        # sparsity-first PRIMARY mode: cycles the restricted warm route
+        # did not take (full-snapshot rebuilds, oversized batches,
+        # declined attempts) solve PARTITIONED — capacity-balanced
+        # fixed-width column blocks through the warmed restricted
+        # program — before the dense plane is ever materialized. The
+        # dense ladder below stays the correctness oracle: a
+        # partitioned attempt that cannot place its whole batch binds
+        # nothing and falls through.
+        if self._partitioned_cold_eligible(batch, nominated, dn, dt, dv,
+                                           no_ports, no_pod_aff,
+                                           no_spread):
+            cold_out = self._partitioned_cold_tail(
+                batch, cycle, res, t0, trace, nt, dn, ds, dp, node_order,
+                skip_prio)
+            if cold_out is not None:
+                return cold_out
+
         # framework Filter/Score contributions: device batch plugins give
         # whole (P, N) matrices; host plugins evaluate per (pod, nodeName)
         # once per cycle (the non-tensorizable escape hatch)
@@ -2439,12 +2472,20 @@ class Scheduler:
             return False
         if self.percentage_of_nodes_to_score is not None:
             return False
-        if self.scenario_pack is not None:
-            # scenario packs are whole-batch features: the cost term
-            # rides extra_score, the quality reduction wants the final
-            # monolithic usage, and the cascade re-solves in-cycle
+        if self.scenario_pack is not None and (
+                not self.scenario_pack.restricted_ok
+                or self.scenario.quality):
+            # capability-driven (mirrors _incremental_eligible): a
+            # restricted_ok pack's cost term is per-column, so it
+            # evaluates per CHUNK bit-for-bit and rides the pipeline.
+            # The quality reduction is the remaining whole-batch
+            # coupling — it wants the final monolithic usage — so
+            # quality-on scenario cycles keep the monolithic executor,
+            # as does any pack needing global cross-column structure.
             return False
         if any(p.pod_group for p in batch):
+            # gangs stay monolithic: all-or-nothing groups straddling
+            # chunk boundaries would need cross-chunk rollback
             return False
         return True
 
@@ -2483,11 +2524,44 @@ class Scheduler:
             if m is not None:
                 m.inc(reason=reason)
 
+    def _note_tuner_batch(self, raw: int) -> None:
+        """Feed one observed raw micro-batch size into the candidate
+        auto-tuner's sliding window (last 64 cycles)."""
+        self._tuner_batch_obs.append(raw)
+        if len(self._tuner_batch_obs) > 64:
+            del self._tuner_batch_obs[:-64]
+
     def _candidate_bucket(self, n_pad: int) -> int:
         """The restricted solve's candidate-column bucket: the config
         value snapped UP to a power of two so the (P, C) solve shapes
-        stay inside the warmed grid."""
-        return bucket_size(max(self.incremental.candidate_bucket, 1))
+        stay inside the warmed grid.
+
+        With ``incremental.autoTune`` on AND a warmed C ladder, the
+        bucket is instead READ from observed telemetry: the smallest
+        warmed C that (a) admits the recent micro-batch sizes under
+        maxBatchFrac and (b) leaves 2x headroom over the deepest
+        candidate-frame position restricted solves actually placed
+        into (pods landing deep in the frame means the rank order is
+        being fought — widen before under-placement starts declining
+        cycles). Every ladder rung was compiled (and its signatures
+        pre-registered) by _warm_incremental, so a tuner move NEVER
+        retraces; without a warmed ladder the tuner stays pinned to
+        the configured bucket."""
+        inc = self.incremental
+        c0 = bucket_size(max(inc.candidate_bucket, 1))
+        if not inc.auto_tune or not self._warmed_cbuckets:
+            return c0
+        need = max(
+            max(self._tuner_batch_obs, default=1)
+            / max(inc.max_batch_frac, 1e-6),
+            2 * self._tuner_depth_max,
+            1,
+        )
+        ladder = sorted(self._warmed_cbuckets)
+        for c in ladder:
+            if c >= need:
+                return c
+        return ladder[-1]
 
     def _incremental_eligible(self, batch, nominated, dn, dt, dv,
                               snap_mode, no_ports, no_pod_aff, no_spread,
@@ -2522,10 +2596,21 @@ class Scheduler:
             return False
         if self.percentage_of_nodes_to_score is not None:
             return False
-        if self.scenario_pack is not None:
+        if (self.scenario_pack is not None
+                and not self.scenario_pack.restricted_ok):
+            # capability-driven, not blanket: a pack whose cost term is
+            # per-column (restricted_ok — it survives restriction to a
+            # gathered (P, C) frame bit-for-bit) rides the restricted
+            # path, its cost joining the frame's extra_score and its
+            # candidate_hint reserving quota columns; packs that need
+            # global cross-column structure keep the dense oracle
             return False
-        if any(p.pod_group for p in batch):
-            return False
+        # gangs RIDE the restricted path (their members' candidates
+        # union in the frame; the gang-topology pack's hint reserves
+        # home-slice columns): _restricted_tail re-checks all-or-
+        # nothing after the solve and declines to the dense ladder —
+        # which owns rollback + failure analytics — on any incomplete
+        # group. No blanket exclusion.
         # constraint classes that couple across the FULL node axis:
         # ports/volumes couple in-batch per node (excluded outright);
         # topology masks reduce over whole topology groups — only safe
@@ -2534,6 +2619,10 @@ class Scheduler:
             return False
         if dt is not None and not (no_pod_aff and no_spread):
             return False
+        # the tuner observes the RAW batch size BEFORE the C compare:
+        # batches bounced for being too big are exactly the evidence
+        # that should widen the bucket next cycle
+        self._note_tuner_batch(len(batch))
         n_pad = dn.valid.shape[0]
         C = self._candidate_bucket(n_pad)
         if C >= n_pad:
@@ -2572,6 +2661,23 @@ class Scheduler:
         )
 
         inc = self.incremental
+        # gang minMember pre-check (host ints only): a group that cannot
+        # meet its quorum even counting cache-placed members will be
+        # rolled back whoever solves it — decline NOW so the dense
+        # ladder produces the proper per-pod GangIncomplete analytics
+        # instead of burning a restricted solve first
+        gang_need: Dict[str, List[int]] = {}
+        for gp in batch:
+            if gp.pod_group:
+                g = gang_need.setdefault(gp.pod_group, [0, 0])
+                g[0] += 1
+                g[1] = max(g[1], gp.pod_group_min_available)
+        for gname, (cnt, need) in gang_need.items():
+            if cnt + self.cache.group_members(gname) < need:
+                m = getattr(self.metrics, "incremental_cycles", None)
+                if m is not None:
+                    m.inc(scope="declined")
+                return None
         summary = None
         get_summary = getattr(self.cache, "score_summary", None)
         if get_summary is not None:
@@ -2603,28 +2709,57 @@ class Scheduler:
             sk_init = self._sk_warm_pot[1]
         hook = (self.fault_injector.solver_hook
                 if self.fault_injector is not None else None)
+        # mesh-sharded candidate pick: per-shard local top-C over the
+        # node-sharded resident plane, replicated merge of the (S, C)
+        # winners — bit-identical to the single-pass pick (the parity
+        # suite pins it across mesh {1, 2, 4, 8})
+        ns = int(self.mesh.devices.size) if self._mesh_live else 1
+        # group-quota hint: the pack's candidate columns (a gang's home
+        # slice) get a RESERVED split of the frame, capped at
+        # groupQuotaFrac so a whole hinted zone can never crowd the
+        # plain-ranked candidates out
+        hint = hq = None
+        if self.scenario_pack is not None:
+            hm = self.scenario_pack.candidate_hint(batch, nt, node_order)
+            if hm is not None:
+                h = np.zeros((n_pad,), bool)
+                h[: hm.shape[0]] = hm
+                hint = jnp.asarray(h)
+                hq = max(int(inc.group_quota_frac * C), 1)
         # retrace telemetry: the candidate/gather program and the
         # restricted solve program are distinct compiled sites — both
         # registered so the zero-retrace contract covers them
         self.obs.jax.record_call(
-            "incremental", summary.rank, static=(C, n_pad,
-                                                 self._mesh_live))
+            "incremental", summary.rank,
+            static=(C, n_pad, self._mesh_live, ns, hint is None, hq))
         try:
             with self.obs.span("solve:restricted"):
-                cand, sub_dn = gather_candidates(summary,
-                                                 jnp.asarray(dirty), dn, C)
+                cand, sub_dn = gather_candidates(
+                    summary, jnp.asarray(dirty), dn, C, hint_mask=hint,
+                    num_shards=ns, hint_quota=(hq or 0))
+                # restricted_ok pack cost on the GATHERED frame: the
+                # term is per-column by the capability contract, so
+                # cost over sub_dn equals the dense term restricted to
+                # the candidate columns — the objective survives the
+                # sparsity-first route unchanged
+                extra_score = None
+                if self.scenario_pack is not None:
+                    with self.obs.span("scenario:cost"):
+                        extra_score = self.scenario_pack.cost(
+                            batch, nt, node_order, dp, sub_dn)
                 self.obs.jax.record_call(
                     "solve", dp, sub_dn, ds,
                     static=("restricted", self.solver, tuple(skip_prio),
                             self.pred_mask, self.per_node_cap,
                             self.max_rounds, sk_init is None,
-                            self._mesh_live),
+                            extra_score is None, self._mesh_live),
                 )
                 out = batch_assign(
                     dp, sub_dn, ds, self.weights,
                     max_rounds=self.max_rounds,
                     per_node_cap=self.per_node_cap,
                     enabled_mask=self.pred_mask, use_sinkhorn=use_sk,
+                    extra_score=extra_score,
                     skip_priorities=skip_prio, no_ports=True,
                     no_pod_affinity=True, no_spread=True,
                     fault_hook=hook, fault_site="solve:restricted",
@@ -2639,7 +2774,16 @@ class Scheduler:
                     self.obs.note_sinkhorn(out[k])
                     k += 1
                 potentials = out[k] if warm else None
-                payload = {"rounds": rounds}
+                # placement-rank telemetry: the deepest candidate-frame
+                # position any pod placed into, reduced to ONE device
+                # scalar riding the existing solve-result readback (a
+                # (P,) position vector would cost +4 B/pod and breach
+                # the answer-sized budget). The auto-tuner reads it to
+                # decide when the frame is running hot.
+                payload = {"rounds": rounds,
+                           "depth": jnp.max(jnp.where(
+                               dp.valid & (a_local >= 0), a_local,
+                               jnp.int32(-1)))}
                 dv_out = None
                 if rc.validate_results and not rc.host_validate:
                     with self.obs.span("validate"):
@@ -2673,15 +2817,29 @@ class Scheduler:
             if m is not None:
                 m.inc(scope="declined")
             return None
+        self._tuner_depth_max = max(self._tuner_depth_max,
+                                    int(host.get("depth", -1)) + 1)
         placed = assigned[: len(batch)]
         if (placed < 0).any():
             # a pod the candidate set could not place might fit on a
             # non-candidate column — only the cold solve can say (and
-            # produce the failure analytics / preemption inputs)
+            # produce the failure analytics / preemption inputs). For a
+            # gang member this is ALSO the all-or-nothing edge: the
+            # dense re-solve owns the rollback + GangIncomplete
+            # analytics, so one decline covers both contracts.
             m = getattr(self.metrics, "incremental_cycles", None)
             if m is not None:
                 m.inc(scope="under-placed")
             return None
+        # ledger coverage for the cycle's candidate-frame residents:
+        # the (C, ·) gathered sub-table + the (C,) index map (top-k
+        # temporaries are XLA-internal — the warmup memory_analysis
+        # capture accounts those). Re-registered per restricted cycle
+        # (same name = overwrite); the scheduler. prefix dies on every
+        # invalidation edge with the rest of the warm state.
+        self.obs.memledger.register_tree(
+            "scheduler.candidate_frame", sub_dn, cand,
+            shape=f"C{C}of{n_pad}")
         if warm and potentials is not None:
             self._sk_warm_pot = (pot_key, potentials)
             # the carry is device-resident state: on the ledger until
@@ -2690,6 +2848,17 @@ class Scheduler:
                 "scheduler.sk_warm_potentials", potentials,
                 shape=f"P{pot_key[0]}xC{pot_key[1]}")
         self._incr_active = True
+        # scenario quality on the restricted route: the reduction runs
+        # over the CANDIDATE FRAME (every placement lands inside it, so
+        # nodes_used/gang stats are exact; the capacity-shaped scores
+        # are frame-local — docs/scenarios.md). Dispatched now so the
+        # device works while the host binds, read back after.
+        q_dev = None
+        if self.scenario_pack is not None and self.scenario.quality:
+            from kubernetes_tpu.ops.scenario_cost import quality_reduce
+
+            q_dev = quality_reduce(a_local.astype(jnp.int32),
+                                   u_local.requested, dp, sub_dn)
         res.rounds = int(host["rounds"])
         res.solver_tier = self.solver
         res.solve_scope = "restricted"
@@ -2703,6 +2872,15 @@ class Scheduler:
             self._admit_pod(pod, node_order[int(placed[i])], cycle, res)
         trace.end_span(bind_span)
         trace.step(f"bound {res.scheduled}, failed {res.unschedulable}")
+        if q_dev is not None:
+            qvec = self.obs.jax.readback("scenario-quality", q_dev)
+            from kubernetes_tpu.scenarios.quality import decode_quality
+
+            quality = decode_quality(qvec)
+            quality.update(
+                self.scenario_pack.quality_host(batch, assigned, nt))
+            res.scenario_quality = quality
+            self._publish_scenario_quality(quality)
         if getattr(self.obs.config, "explain", True):
             # no filter-pass failures by construction (everything
             # placed), but admission-tail failures still get report
@@ -2710,6 +2888,247 @@ class Scheduler:
             self._build_explain_report(cycle, batch, [], None, nt.n, res)
         return self._finish_cycle(res, cycle, t0, solve_s, trace,
                                   label=" (restricted)")
+
+    def _cold_blocks(self, n_pad: int, C: int) -> int:
+        """How many capacity-balanced blocks the partitioned cold solve
+        runs: ``incremental.coldBlocks``, or (0 = auto) the padded node
+        bucket over the candidate bucket capped at 8 — wide enough that
+        B·C covers thousands of columns at 50k nodes, bounded so cold
+        latency stays a handful of fixed-size solves. Always clamped so
+        B·C fits the table (the top-(B·C) pick must be a real cut)."""
+        inc = self.incremental
+        b = inc.cold_blocks or min(8, n_pad // max(C, 1))
+        return max(min(b, n_pad // max(C, 1)), 0)
+
+    def _partitioned_cold_eligible(self, batch, nominated, dn, dt, dv,
+                                   no_ports, no_pod_aff,
+                                   no_spread) -> bool:
+        """May THIS cycle take the PARTITIONED cold solve (sparsity-
+        first primary mode)? Engages when the restricted warm route did
+        not take the cycle — a full-snapshot rebuild, an oversized
+        batch, a declined/under-placed restricted attempt — and the
+        same trace-time facts hold that make a candidate frame
+        complete: no whole-batch host coupling, no cross-node
+        constraint classes, no gangs or scenario packs (both keep the
+        dense oracle's monolithic cold semantics — gang rollback and
+        pack quality want the full plane when solving cold). The dense
+        solve remains the correctness fallback: a partitioned attempt
+        that cannot place its whole batch declines rather than binding
+        a partial answer."""
+        inc = self.incremental
+        if not (inc.enabled and inc.primary):
+            return False
+        if self.solver not in ("batch", "sinkhorn"):
+            return False
+        if dn is None or not batch:
+            return False
+        if self.extenders or nominated:
+            return False
+        fw = self.framework
+        if (fw.has_host_filters() or fw.has_host_scores()
+                or fw.has_batch_filters() or fw.has_batch_scores()):
+            return False
+        if self.percentage_of_nodes_to_score is not None:
+            return False
+        if self.scenario_pack is not None:
+            return False
+        if any(p.pod_group for p in batch):
+            return False
+        if dv is not None or not no_ports:
+            return False
+        if dt is not None and not (no_pod_aff and no_spread):
+            return False
+        n_pad = dn.valid.shape[0]
+        C = self._candidate_bucket(n_pad)
+        return C < n_pad and self._cold_blocks(n_pad, C) >= 2
+
+    def _partitioned_cold_tail(self, batch, cycle, res, t0, trace, nt,
+                               dn, ds, dp, node_order, skip_prio):
+        """The partitioned cold solve: rank every column once (the only
+        full-N work), deal the top B·C columns round-robin into B
+        capacity-balanced blocks of WIDTH C — the restricted path's
+        candidate bucket, so every block runs the ALREADY-COMPILED
+        (P, C) restricted program and a cold cycle adds zero new solver
+        shapes — then solve the blocks in sequence, masking placed pods
+        out of each next block's pod validity (blocks are column-
+        disjoint, so no cross-block usage updates exist to miss).
+        Unplaced remainder takes ONE final restricted pass over a fresh
+        top-C pick from the usage-overlaid table (earlier placements
+        debited). Placements accumulate HOST-side and bind only when
+        the WHOLE batch placed; anything less declines to the dense
+        ladder, which owns failure analytics and preemption. Cold cost:
+        O(N log(B·C)) selection + (≤ B + 1) fixed (P, C) solves —
+        sublinear in N, vs the dense plane's O(P·N)."""
+        from kubernetes_tpu.faults import SolverResultInvalid
+        from kubernetes_tpu.ops.arrays import (
+            gather_candidates,
+            gather_node_rows,
+            map_restricted_assignment,
+        )
+        from kubernetes_tpu.ops.assign import (
+            VALIDATE_REASONS,
+            _apply_batch,
+            batch_assign,
+            device_validate,
+            nodes_with_usage,
+            usage_from_nodes,
+        )
+        from kubernetes_tpu.ops.fused_score import (
+            node_summary,
+            partition_columns,
+        )
+
+        inc = self.incremental
+        n_pad = dn.valid.shape[0]
+        P_pad = dp.valid.shape[0]
+        C = self._candidate_bucket(n_pad)
+        B = self._cold_blocks(n_pad, C)
+        ns = int(self.mesh.devices.size) if self._mesh_live else 1
+        flags = self._summary_flags
+        get_summary = getattr(self.cache, "score_summary", None)
+        summary = get_summary() if get_summary is not None else None
+        if summary is None:
+            # no live cache (full rebuild just invalidated it): one
+            # fresh O(N) summary pass — still nothing (P, N)-shaped
+            summary = node_summary(dn, **flags)
+        rc = self.robustness
+        use_sk = self.solver == "sinkhorn"
+        warm = bool(inc.warm_potentials and use_sk)
+        want_stats = bool(self.obs.config.sinkhorn_telemetry and use_sk)
+        hook = (self.fault_injector.solver_hook
+                if self.fault_injector is not None else None)
+        solve_statics = ("restricted", self.solver, tuple(skip_prio),
+                         self.pred_mask, self.per_node_cap,
+                         self.max_rounds, True, True, self._mesh_live)
+        self.obs.jax.record_call(
+            "partition", summary.rank,
+            static=(B, C, n_pad, ns, self._mesh_live))
+        pending = np.zeros((P_pad,), bool)
+        pending[: len(batch)] = True
+        assigned = np.full((len(batch),), -1, np.int64)
+        zeros_dirty = jnp.zeros((n_pad,), bool)
+
+        def solve_frame(dp_f, sub_dn, cand, site):
+            """One (P, C) frame solve + validate + global mapping; ONE
+            readback per frame (the declared cold-block boundary)."""
+            self.obs.jax.record_call("solve", dp_f, sub_dn, ds,
+                                     static=solve_statics)
+            out = batch_assign(
+                dp_f, sub_dn, ds, self.weights,
+                max_rounds=self.max_rounds,
+                per_node_cap=self.per_node_cap,
+                enabled_mask=self.pred_mask, use_sinkhorn=use_sk,
+                skip_priorities=skip_prio, no_ports=True,
+                no_pod_affinity=True, no_spread=True,
+                fault_hook=hook, fault_site="solve:partitioned",
+                stats_out=want_stats,
+                sk_tol=(inc.warm_tol if warm else None),
+                potentials_out=warm,
+            )
+            a_local, u_local, rounds = out[0], out[1], out[2]
+            k = 3
+            if want_stats:
+                self.obs.note_sinkhorn(out[k])
+            payload = {"rounds": rounds}
+            if rc.validate_results and not rc.host_validate:
+                dv_out = device_validate(a_local, u_local, dp_f, sub_dn,
+                                         self.pred_mask)
+                if dv_out is not None:
+                    payload["code"], payload["valid"] = dv_out
+            payload["assigned"] = map_restricted_assignment(a_local,
+                                                            cand)
+            host = self.obs.jax.readback(site, payload)
+            code = int(host.get("code", 0))
+            if code:
+                raise SolverResultInvalid(
+                    f"partitioned: {VALIDATE_REASONS[code]}")
+            return host
+
+        try:
+            with self.obs.span("solve:partitioned", blocks=B):
+                blocks = partition_columns(summary, zeros_dirty, B, C,
+                                           ns)
+                for b in range(B):
+                    if not pending[: len(batch)].any():
+                        break
+                    dp_b = dp._replace(
+                        valid=dp.valid & jnp.asarray(pending))
+                    sub_dn = gather_node_rows(dn, blocks[b])
+                    host = solve_frame(dp_b, sub_dn, blocks[b],
+                                       "cold-block")
+                    res.rounds += int(host["rounds"])
+                    got = host["assigned"]
+                    for i in range(len(batch)):
+                        if pending[i] and got[i] >= 0:
+                            assigned[i] = got[i]
+                            pending[i] = False
+                if pending[: len(batch)].any():
+                    # remainder pass: one fresh top-C frame over the
+                    # usage-OVERLAID table (every block placement
+                    # debited — blocks were column-disjoint, so this is
+                    # the first moment cross-block state must meet)
+                    acc = np.full((P_pad,), -1, np.int64)
+                    acc[: len(batch)] = assigned
+                    u = _apply_batch(
+                        usage_from_nodes(dn), dp,
+                        jnp.asarray(np.maximum(acc, 0)),
+                        jnp.asarray(acc >= 0) & dp.valid)
+                    dn_u = nodes_with_usage(dn, u)
+                    sum_u = node_summary(dn_u, **flags)
+                    self.obs.jax.record_call(
+                        "incremental", sum_u.rank,
+                        static=(C, n_pad, self._mesh_live, ns, True,
+                                None))
+                    dp_r = dp._replace(
+                        valid=dp.valid & jnp.asarray(pending))
+                    cand, sub_dn = gather_candidates(
+                        sum_u, zeros_dirty, dn_u, C, num_shards=ns)
+                    host = solve_frame(dp_r, sub_dn, cand,
+                                       "cold-block")
+                    res.rounds += int(host["rounds"])
+                    got = host["assigned"]
+                    for i in range(len(batch)):
+                        if pending[i] and got[i] >= 0:
+                            assigned[i] = got[i]
+                            pending[i] = False
+        except Exception as e:
+            # any failure — a lying solver, device error, validation
+            # verdict — declines the whole attempt; the dense ladder
+            # owns breakers/retries/fallbacks (nothing bound yet, so
+            # the decline is free of rollback)
+            klog.warning("partitioned cold solve declined (%s); dense "
+                         "solve", e)
+            m = getattr(self.metrics, "incremental_cycles", None)
+            if m is not None:
+                m.inc(scope="declined")
+            return None
+        if pending[: len(batch)].any():
+            # under-placed: a remainder pod may fit on a column outside
+            # every frame — only the dense solve can say, and the
+            # failure analytics / preemption inputs need the full plane
+            m = getattr(self.metrics, "incremental_cycles", None)
+            if m is not None:
+                m.inc(scope="under-placed")
+            return None
+        res.solver_tier = self.solver
+        res.solve_scope = "partitioned"
+        res.cold_blocks = B
+        res.reuse_frac = 0.0
+        solve_s = trace.total_s()
+        trace.step(f"partitioned cold solve done ({res.rounds} rounds, "
+                   f"B={B}, C={C})")
+        self.metrics.algorithm_duration.observe(solve_s)
+        bind_span = trace.begin_span("bind")
+        for i, pod in enumerate(batch):
+            self._admit_pod(pod, node_order[int(assigned[i])], cycle,
+                            res)
+        trace.end_span(bind_span)
+        trace.step(f"bound {res.scheduled}, failed {res.unschedulable}")
+        if getattr(self.obs.config, "explain", True):
+            self._build_explain_report(cycle, batch, [], None, nt.n,
+                                       res)
+        return self._finish_cycle(res, cycle, t0, solve_s, trace,
+                                  label=" (partitioned)")
 
     def _pipelined_tail(self, batch, cycle, res, t0, trace, nt, dn, ds, dt,
                         node_order, skip_prio, no_ports, no_pod_aff,
@@ -2742,10 +3161,15 @@ class Scheduler:
         explain_on = getattr(self.obs.config, "explain", True)
         rc = self.robustness
         solver = self.solver
+        # a restricted_ok scenario pack's per-column cost term joins
+        # each chunk's solve as extra_score (the _pipeline_eligible
+        # capability contract); the statics score flag flips with it so
+        # warmed/monolithic/pipelined signatures stay coherent
+        pack = self.scenario_pack
         statics = (solver, tuple(skip_prio), no_ports, no_pod_aff,
                    no_spread, self.pred_mask, self.per_node_cap,
-                   self.max_rounds, True, True,  # no extra mask/score
-                   self._mesh_live)
+                   self.max_rounds, True,  # no extra mask
+                   pack is None, self._mesh_live)
         hook = (self.fault_injector.solver_hook
                 if self.fault_injector is not None else None)
 
@@ -2784,6 +3208,15 @@ class Scheduler:
             if (self._cycle_deadline is not None
                     and self.clock() >= self._cycle_deadline):
                 return None
+            sc = None
+            if pack is not None:
+                # per-chunk pack cost on THIS chunk's pod table against
+                # the chunk's node view — per-column by the
+                # restricted_ok contract, so chunking preserves the
+                # objective exactly
+                with self.obs.span(f"scenario:cost@{k}"):
+                    sc = pack.cost(chunks[k], nt, node_order, dp_c,
+                                   dn_in)
             with self.obs.span(f"pipeline:dispatch@{k}", tier=solver):
                 self.obs.jax.record_call("solve", dp_c, dn_in, ds, dt, dv_c,
                                          static=statics)
@@ -2791,6 +3224,7 @@ class Scheduler:
                     a, u = greedy_assign(
                         dp_c, dn_in, ds, self.weights, topo=dt, vol=dv_c,
                         static_vol=sv_c, enabled_mask=self.pred_mask,
+                        extra_score=sc,
                         skip_priorities=skip_prio, no_ports=no_ports,
                         no_pod_affinity=no_pod_aff, no_spread=no_spread,
                         fault_hook=hook, fault_site="solve:greedy",
@@ -2806,6 +3240,7 @@ class Scheduler:
                     max_rounds=self.max_rounds,
                     per_node_cap=self.per_node_cap, topo=dt, vol=dv_c,
                     static_vol=sv_c, enabled_mask=self.pred_mask,
+                    extra_score=sc,
                     use_sinkhorn=(solver == "sinkhorn"),
                     skip_priorities=skip_prio, no_ports=no_ports,
                     no_pod_affinity=no_pod_aff, no_spread=no_spread,
@@ -2845,10 +3280,14 @@ class Scheduler:
                         "pipelined chunk %d solve failed (%s); ladder", k, e)
             # shed (open breaker / blown deadline) or failed readback:
             # this chunk re-solves through the full ladder — retries,
-            # CPU fallback, greedy oracle, per-tier breakers included
+            # CPU fallback, greedy oracle, per-tier breakers included.
+            # The pack cost is rebuilt so the objective survives the
+            # fallback tiers exactly as it does the monolithic ladder.
+            sc = (pack.cost(chunk, nt, node_order, dp_c, dn_in)
+                  if pack is not None else None)
             ladder = self._solve_ladder(
                 solver, chunk, dp_c, dn_in, ds, dt, dv_c, sv_c, None,
-                None, None, skip_prio, no_ports, no_pod_aff, no_spread,
+                None, sc, skip_prio, no_ports, no_pod_aff, no_spread,
                 res,
             )
             if ladder is None:
@@ -4093,7 +4532,7 @@ class Scheduler:
             # and the candidate bucket C is one static shape
             try:
                 compiled += self._warm_incremental(buckets, pk, sample,
-                                                   dn, ds, skip_prio)
+                                                   nt, dn, ds, skip_prio)
             except Exception as e:
                 klog.warning("incremental warmup aborted: %s", e)
         if wu.host_fallback and self.mesh is not None and self._mesh_live:
@@ -4147,7 +4586,8 @@ class Scheduler:
                     # pay a hot-path compile either
                     try:
                         compiled += self._warm_incremental(
-                            buckets, pk, sample, dn_h, ds_h, skip_prio)
+                            buckets, pk, sample, nt, dn_h, ds_h,
+                            skip_prio)
                     except Exception as e:
                         klog.warning("incremental host-fallback warmup "
                                      "aborted: %s", e)
@@ -4398,15 +4838,28 @@ class Scheduler:
             compiled += 1
         return compiled
 
-    def _warm_incremental(self, buckets, pk, sample, dn, ds,
+    def _warm_incremental(self, buckets, pk, sample, nt, dn, ds,
                           skip_prio) -> int:
         """Pre-compile the restricted-solve programs for every pod
-        bucket that can take the incremental route: the candidate pick
-        (top-k over the cached plane), the node-row gather, the (P, C)
-        solve — cold AND (for the sinkhorn solver) warm-started — the
-        fused validator, the global mapping, and one delta-bucket
-        summary patch. Signatures pre-register with the telemetry so
-        the first incremental cycle classifies as a cache hit."""
+        bucket that can take the incremental route: the (mesh-sharded)
+        candidate pick — top-k over the cached plane, per-shard local
+        pick + replicated merge when the mesh is live — the node-row
+        gather, the (P, C) solve — cold AND (for the sinkhorn solver)
+        warm-started AND (for a restricted_ok scenario pack) cost-fed —
+        the fused validator, the global mapping, one delta-bucket
+        summary patch, the group-quota hint split, and (primary mode)
+        the partitioned cold selection. Signatures pre-register with
+        the telemetry so the first incremental cycle classifies as a
+        cache hit.
+
+        With ``incremental.autoTune`` the sweep compiles a C LADDER —
+        {C/2, C, 2C} snapped to legal sizes — and records it in
+        ``_warmed_cbuckets``: the auto-tuner may only ever move between
+        warmed rungs, which is what makes a tuner move retrace-free by
+        construction. Each warmed (P, C) shape also feeds the memory
+        ledger's preflight peak table, so the capacity preflight can
+        split an over-budget dense solve DOWN to a restricted shape it
+        has a measured budget for."""
         import jax
 
         from kubernetes_tpu.ops.arrays import (
@@ -4417,21 +4870,23 @@ class Scheduler:
         from kubernetes_tpu.ops.assign import batch_assign, device_validate
         from kubernetes_tpu.ops.fused_score import (
             node_summary,
+            partition_columns,
             patch_node_summary,
         )
 
         inc = self.incremental
         n_pad = dn.valid.shape[0]
-        C = self._candidate_bucket(n_pad)
-        if C >= n_pad:
+        c0 = bucket_size(max(inc.candidate_bucket, 1))
+        ladder = [c0]
+        if inc.auto_tune:
+            ladder = sorted({max(c0 // 2, 16), c0, c0 * 2})
+        ladder = [c for c in ladder if c < n_pad]
+        if not ladder:
             return 0
+        ns = int(self.mesh.devices.size) if self._mesh_live else 1
         flags = self._summary_flags
         summary = node_summary(dn, **flags)
-        self.obs.jax.record_call("incremental", summary.rank,
-                                 static=(C, n_pad, self._mesh_live),
-                                 warmup=True)
-        cand, sub_dn = gather_candidates(summary,
-                                         jnp.zeros((n_pad,), bool), dn, C)
+        zeros_dirty = jnp.zeros((n_pad,), bool)
         # summary patches at the delta buckets steady churn actually
         # presents (the scatter programs bucket geometrically exactly
         # like the PR-5 snapshot delta — an unwarmed bucket would
@@ -4445,42 +4900,79 @@ class Scheduler:
         use_sk = self.solver == "sinkhorn"
         warm = bool(inc.warm_potentials and use_sk)
         want_stats = bool(self.obs.config.sinkhorn_telemetry and use_sk)
+        pack = (self.scenario_pack
+                if (self.scenario_pack is not None
+                    and self.scenario_pack.restricted_ok) else None)
+        node_order = self.cache.node_order()
         compiled = 0
-        limit = inc.max_batch_frac * C
         smallest_bucket = bucket_size(1)
-        for P in buckets:
-            # warm P iff SOME eligible batch pads to it: the runtime
-            # gate compares the RAW batch size (<= maxBatchFrac*C)
-            # before padding, so the bucket covering floor(limit) must
-            # be warmed even when the bucket itself exceeds the limit
-            smallest_in_bucket = 1 if P <= smallest_bucket else P // 2 + 1
-            if smallest_in_bucket > limit:
-                continue  # no eligible batch can pad to this bucket
-            dp = self._place(pods_to_device(pk.pack_pods(sample[:P]),
-                                            pad_to=P))
+        dps: Dict[int, object] = {}
+        for C in ladder:
             self.obs.jax.record_call(
-                "solve", dp, sub_dn, ds,
-                static=("restricted", self.solver, tuple(skip_prio),
-                        self.pred_mask, self.per_node_cap,
-                        self.max_rounds, True, self._mesh_live),
+                "incremental", summary.rank,
+                static=(C, n_pad, self._mesh_live, ns, True, None),
                 warmup=True)
-            variants = [dict(sk_init=None)]
-            if warm:
-                # the warm-started program is a DIFFERENT signature
-                # (potential operands join the trace) — compile it too
-                # or the second incremental cycle retraces
-                zp = (jnp.zeros((P,), jnp.float32),
-                      jnp.zeros((C,), jnp.float32))
-                variants.append(dict(sk_init=zp))
+            cand, sub_dn = gather_candidates(
+                summary, zeros_dirty, dn, C, num_shards=ns)
+            if pack is not None:
+                # the group-quota hint split is a DIFFERENT compiled
+                # pick (two disjoint segment top-k's) — warm it with a
+                # placeholder mask so the first hinted cycle hits cache
+                hq = max(int(inc.group_quota_frac * C), 1)
                 self.obs.jax.record_call(
-                    "solve", dp, sub_dn, ds,
-                    static=("restricted", self.solver, tuple(skip_prio),
-                            self.pred_mask, self.per_node_cap,
-                            self.max_rounds, False, self._mesh_live),
+                    "incremental", summary.rank,
+                    static=(C, n_pad, self._mesh_live, ns, False, hq),
                     warmup=True)
-            for var in variants:
-                out = batch_assign(
-                    dp, sub_dn, ds, self.weights,
+                jax.block_until_ready(gather_candidates(
+                    summary, zeros_dirty, dn, C,
+                    hint_mask=jnp.zeros((n_pad,), bool),
+                    num_shards=ns, hint_quota=hq)[0])
+            part_warm = False
+            if inc.primary:
+                # partitioned cold selection: the block deal + one
+                # block gather (block solves reuse the (P, C) cold
+                # programs compiled below — identical shapes)
+                B = self._cold_blocks(n_pad, C)
+                if B >= 2:
+                    part_warm = True
+                    self.obs.jax.record_call(
+                        "partition", summary.rank,
+                        static=(B, C, n_pad, ns, self._mesh_live),
+                        warmup=True)
+                    blocks = partition_columns(summary, zeros_dirty, B,
+                                               C, ns)
+                    jax.block_until_ready(
+                        gather_node_rows(dn, blocks[0]).requested)
+            limit = inc.max_batch_frac * C
+            for P in buckets:
+                # warm P iff SOME eligible batch pads to it: the
+                # runtime gate compares the RAW batch size
+                # (<= maxBatchFrac*C) before padding, so the bucket
+                # covering floor(limit) must be warmed even when the
+                # bucket itself exceeds the limit
+                smallest_in_bucket = (1 if P <= smallest_bucket
+                                      else P // 2 + 1)
+                over_limit = smallest_in_bucket > limit
+                if over_limit and not part_warm:
+                    continue  # no eligible batch can pad to this bucket
+                # over-limit buckets are still reachable through the
+                # PARTITIONED route: block frames solve the FULL batch
+                # against a C-wide block (the maxBatchFrac gate is
+                # restricted-only), so their cold (P, C) program must
+                # compile here too — but only the cold variant;
+                # partitioned never warm-starts or feeds extra_score
+                if P not in dps:
+                    dps[P] = self._place(pods_to_device(
+                        pk.pack_pods(sample[:P]), pad_to=P))
+                dp = dps[P]
+                extra = None
+                if pack is not None and not over_limit:
+                    # the pack's cost on the gathered frame — same
+                    # jitted kernel, dtype and sharding as real
+                    # restricted cycles feed extra_score with
+                    extra = pack.cost(sample[:P], nt, node_order, dp,
+                                      sub_dn)
+                solve_kwargs = dict(
                     max_rounds=self.max_rounds,
                     per_node_cap=self.per_node_cap,
                     enabled_mask=self.pred_mask, use_sinkhorn=use_sk,
@@ -4488,20 +4980,71 @@ class Scheduler:
                     no_pod_affinity=True, no_spread=True,
                     stats_out=want_stats,
                     sk_tol=(inc.warm_tol if warm else None),
-                    potentials_out=warm, **var)
-                a, wu_usage = out[0], out[1]
-                if (self.robustness.validate_results
-                        and not self.robustness.host_validate):
-                    dv_out = device_validate(a, wu_usage, dp, sub_dn,
-                                             self.pred_mask)
-                    if dv_out is not None:
-                        jax.block_until_ready(dv_out[0])
-                jax.block_until_ready(
-                    map_restricted_assignment(a, cand))
-            compiled += 1
-            self.metrics.warmup_compiles.inc()
+                    potentials_out=warm)
+                variants = [dict(sk_init=None, extra_score=None)]
+                if warm and not over_limit:
+                    # the warm-started program is a DIFFERENT signature
+                    # (potential operands join the trace) — compile it
+                    # too or the second incremental cycle retraces
+                    zp = (jnp.zeros((P,), jnp.float32),
+                          jnp.zeros((C,), jnp.float32))
+                    variants.append(dict(sk_init=zp, extra_score=None))
+                if extra is not None:
+                    variants.append(dict(sk_init=None,
+                                         extra_score=extra))
+                    if warm:
+                        zp = (jnp.zeros((P,), jnp.float32),
+                              jnp.zeros((C,), jnp.float32))
+                        variants.append(dict(sk_init=zp,
+                                             extra_score=extra))
+                for var in variants:
+                    self.obs.jax.record_call(
+                        "solve", dp, sub_dn, ds,
+                        static=("restricted", self.solver,
+                                tuple(skip_prio), self.pred_mask,
+                                self.per_node_cap, self.max_rounds,
+                                var["sk_init"] is None,
+                                var["extra_score"] is None,
+                                self._mesh_live),
+                        warmup=True)
+                    out = batch_assign(dp, sub_dn, ds, self.weights,
+                                       **solve_kwargs, **var)
+                    a, wu_usage = out[0], out[1]
+                    if (self.robustness.validate_results
+                            and not self.robustness.host_validate):
+                        dv_out = device_validate(a, wu_usage, dp,
+                                                 sub_dn, self.pred_mask)
+                        if dv_out is not None:
+                            jax.block_until_ready(dv_out[0])
+                    jax.block_until_ready(
+                        map_restricted_assignment(a, cand))
+                if (pack is not None and self.scenario.quality
+                        and not over_limit):
+                    # the frame-local quality reduction rides every
+                    # scenario restricted cycle's readback — compile
+                    # its (P, C) program here too
+                    from kubernetes_tpu.ops.scenario_cost import (
+                        quality_reduce,
+                    )
+
+                    jax.block_until_ready(quality_reduce(
+                        a.astype(jnp.int32), wu_usage.requested, dp,
+                        sub_dn))
+                if self.obs.memledger.preflight_on:
+                    # the preflight's peak table learns the restricted
+                    # shapes too — (P, C) rows are what an over-budget
+                    # dense 50k solve splits DOWN to instead of OOMing
+                    # (warm-start extras are solve-only knobs the AOT
+                    # analysis signature does not take)
+                    self._capture_bucket_memory(
+                        dp, sub_dn, ds,
+                        {k: v for k, v in solve_kwargs.items()
+                         if k not in ("sk_tol", "potentials_out")})
+                compiled += 1
+                self.metrics.warmup_compiles.inc()
+            self._warmed_cbuckets.add(C)
         klog.V(2).info("incremental warmup: compiled %d restricted "
-                       "(P, %d) solve shapes", compiled, C)
+                       "solve shapes (C ladder %s)", compiled, ladder)
         return compiled
 
     def is_degraded(self) -> bool:

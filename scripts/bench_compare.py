@@ -79,6 +79,21 @@ stay inside the record's documented bound, and zero retraces + the
 absolute readback budget hold on every cell. Deltas (warm cycle cost,
 flatness ratio) need two records; the absolutes enforce on one.
 
+Sparsity-first gates (scripts/bench_churn.py --sparse-sweep records)
+ride the two newest ``benchres/churn_sparse_r*.json``: the sparse
+(restricted-primary) arm's steady-state cycle-cost growth across the
+cluster-size sweep must stay flat (``flatness.sparse_growth`` ≤ 1.3 —
+the sparsity-first tentpole claim), the PARTITIONED cold route's
+cost-vs-size slope must stay sublinear against the dense oracle's
+(``cold_slope.ratio`` ≤ 0.6), the sparse cells must demonstrably ride
+the sparsity-first routes (≥ 0.9 of solve cycles restricted/
+partitioned, every cold probe scope ``partitioned``), the seeded
+sparse-vs-dense quality delta must stay inside the record's bound,
+and zero retraces + an 8 B/pod readback budget hold on every cell.
+Deltas (per-size steady cycle cost, flatness) need two records; the
+absolutes enforce on one. Smoke records skip the scale-claim
+absolutes with a warning.
+
 Network-fault gates (scripts/bench_churn.py --net-chaos records) ride
 the two newest ``benchres/churn_net_r*.json``: ABSOLUTE invariants on
 the new record alone (``double_bind_attempts == 0``,
@@ -215,6 +230,22 @@ def find_churn_incr_records(directory: str) -> List[str]:
         return (int(m.group(1)) if m else -1, os.path.basename(path))
 
     return sorted(glob.glob(os.path.join(directory, "churn_incr_r*.json")),
+                  key=round_key)
+
+
+def find_churn_sparse_records(directory: str) -> List[str]:
+    """churn_sparse_r*.json (scripts/bench_churn.py --sparse-sweep
+    records) sorted by round — the sparsity-first gate family's inputs.
+    Absence is tolerated: benchres directories predating the
+    restricted-primary mode keep passing. Disjoint from
+    find_churn_records by glob (churn_r* does not match
+    churn_sparse_r*)."""
+
+    def round_key(path: str) -> Tuple[int, str]:
+        m = re.search(r"churn_sparse_r(\d+)", os.path.basename(path))
+        return (int(m.group(1)) if m else -1, os.path.basename(path))
+
+    return sorted(glob.glob(os.path.join(directory, "churn_sparse_r*.json")),
                   key=round_key)
 
 
@@ -892,6 +923,136 @@ def compare_churn_incr(prev: dict, cur: dict, threshold: float,
             "warnings": warnings}
 
 
+def compare_churn_sparse(prev: dict, cur: dict, threshold: float,
+                         readback_budget: float = 12.0) -> dict:
+    """Sparsity-first gates over two churn_sparse_r*.json records
+    (pure, unit-tested) — the restricted-PRIMARY contract of the
+    sparsity-first mode (docs/perf.md "Sparsity-first solve"):
+
+    - ABSOLUTE invariants on the NEW record alone (single-record runs
+      pass gracefully on the deltas): the sparse arm's steady-state
+      ROUTE-cost growth across the cluster-size sweep stays FLAT
+      (``flatness.sparse_growth`` ≤ 1.3 on the per-cycle ``solve:*``
+      span — the tentpole claim at fixed churn rate, with the O(N)
+      snapshot patch both arms share excluded from the basis), the
+      PARTITIONED cold route's cost-vs-size slope
+      stays sublinear against the dense oracle's
+      (``cold_slope.ratio`` ≤ 0.6), every sparse cell actually rode
+      the sparsity-first routes (``restricted_frac`` ≥ 0.9 of solve
+      cycles AND every cold probe took scope ``partitioned`` — a
+      silent dense fall-through fails the gate even when the numbers
+      look fine), the seeded sparse-vs-dense quality delta stays
+      inside the record's documented bound with placed counts equal
+      and the restricted path demonstrably engaged, zero retraces on
+      every cell (the warmed C ladder + hint/quota + partition
+      signatures all held), and d2h readback within
+      ``readback_budget`` bytes/pod (default 12.0 — TIGHTER than the
+      16-byte mesh budget: the restricted answer is one int32 per pod
+      plus per-cycle fixed scalars amortized over the batch);
+    - delta gates (need two records): the sparse arm's per-size
+      steady-state cycle cost and the flatness ratio must not
+      regress.
+
+    Smoke records (``smoke: true``) skip the scale-claim absolutes
+    with a warning — seconds-long smoke cells validate the harness,
+    not the flatness claim. Absent sections are warnings, never
+    failures — same posture as every other gate family."""
+    checks, regressions, warnings = [], [], []
+    check = partial(_delta_check, checks, regressions, warnings,
+                    threshold)
+    absolute = partial(_absolute_check, checks, regressions)
+
+    smoke = bool(cur.get("smoke"))
+    if smoke:
+        warnings.append("sparse: newest record is a smoke run — "
+                        "scale-claim absolutes (flatness, cold slope, "
+                        "readback) skipped")
+    cf = cur.get("flatness") or {}
+    sparse_g = _num(cf.get("sparse_growth"))
+    if sparse_g is not None and not smoke:
+        # the tentpole claim, arm 1: sparse steady-state cycle cost
+        # flat (≤ 1.3x) while the cluster grows ≥ 4x at fixed churn
+        absolute("sparse.flatness.sparse_growth", sparse_g,
+                 sparse_g > 1.3)
+    elif not smoke:
+        warnings.append("sparse: no flatness section in the new "
+                        "record")
+    ratio = _num((cur.get("cold_slope") or {}).get("ratio"))
+    if ratio is not None and not smoke:
+        # the tentpole claim, arm 2: the partitioned cold route's
+        # cost-vs-size slope sublinear against the dense oracle's
+        absolute("sparse.cold_slope.ratio", ratio, ratio > 0.6)
+    cells = cur.get("cells") or {}
+    sparse_cells = {k: v for k, v in cells.items()
+                    if k.startswith("sparse_")}
+    for label, cell in sorted(cells.items()):
+        rt = _num(cell.get("retraces_total",
+                           (cell.get("jax") or {}).get("retraces")))
+        if rt is not None:
+            absolute(f"sparse.{label}.retraces", rt, rt > 0)
+    for label, cell in sorted(sparse_cells.items()):
+        rf = _num(cell.get("restricted_frac"))
+        if rf is not None:
+            # engagement: ≥ 0.9 of the sparse arm's solve cycles rode
+            # restricted/partitioned — primary means PRIMARY
+            absolute(f"sparse.{label}.restricted_frac", rf, rf < 0.9)
+        bpp = _num(cell.get("readback_bytes_per_pod"))
+        if bpp is not None and not smoke:
+            absolute(f"sparse.{label}.readback_budget", bpp,
+                     not 0 < bpp <= readback_budget)
+    for label, probe in sorted((cur.get("cold") or {}).items()):
+        if not label.startswith("sparse_"):
+            continue
+        scopes = probe.get("scopes") or []
+        if scopes:
+            # every sparse cold probe must take the partitioned route;
+            # a dense fall-through is a routing regression even when
+            # the latency happens to be fine
+            ok = all(s == "partitioned" for s in scopes)
+            absolute(f"sparse.{label}.cold_partitioned",
+                     1.0 if ok else 0.0, not ok)
+    q = cur.get("quality") or {}
+    if q:
+        absolute("sparse.quality.placed_equal",
+                 1.0 if q.get("placed_equal") else 0.0,
+                 not q.get("placed_equal"))
+        if "restricted_engaged" in q:
+            absolute("sparse.quality.restricted_engaged",
+                     1.0 if q.get("restricted_engaged") else 0.0,
+                     not q.get("restricted_engaged"))
+        qd = _num(q.get("score_delta_frac_max"))
+        bound = _num(cur.get("quality_bound")) or 0.02
+        if qd is not None:
+            absolute("sparse.quality.score_delta", qd, qd > bound)
+    else:
+        warnings.append("sparse: no quality section in the new "
+                        "record")
+    # delta gates — the sparse arm's cost and flatness must not erode
+    pf = prev.get("flatness") or {}
+    if pf:
+        check("sparse.flatness.sparse_growth_delta",
+              pf.get("sparse_growth"), cf.get("sparse_growth"),
+              lower_is_better=True)
+        psizes = prev.get("sizes") or []
+        for n in cur.get("sizes") or []:
+            if n not in psizes:
+                continue
+            check(f"sparse.sparse_{n}.steady_mean_solve_s",
+                  ((prev.get("cells") or {}).get(f"sparse_{n}") or {}
+                   ).get("steady_mean_solve_s"),
+                  (cells.get(f"sparse_{n}") or {}
+                   ).get("steady_mean_solve_s"),
+                  lower_is_better=True)
+    for rec, label in ((prev, "prev"), (cur, "cur")):
+        errs = rec.get("errors") or []
+        if errs:
+            warnings.append(f"{label} churn_sparse record carries "
+                            f"{len(errs)} error(s); affected sections "
+                            "may be absent")
+    return {"checks": checks, "regressions": regressions,
+            "warnings": warnings}
+
+
 def compare_churn_net(prev: dict, cur: dict, threshold: float) -> dict:
     """Network-fault gates over churn_net_r*.json records (pure,
     unit-tested; absence-tolerant) — the correctness-under-network-
@@ -1331,6 +1492,13 @@ GATE_FAMILIES = [
      "<= 1.3 across the cluster-size sweep) while the cold arm grows, "
      "restricted engagement, warm-vs-cold quality delta within the "
      "documented bound, zero retraces, absolute readback budget"),
+    ("sparse", "churn_sparse_r*.json",
+     "sparsity-first solve: sparse steady-state flatness (sparse_"
+     "growth <= 1.3 across the sweep), partitioned cold-route slope "
+     "sublinear vs the dense oracle (ratio <= 0.6), restricted/"
+     "partitioned engagement >= 0.9 with every cold probe partitioned, "
+     "sparse-vs-dense quality delta within the documented bound, zero "
+     "retraces, absolute 8 B/pod readback budget"),
     ("ledger", "churn_r*.json",
      "perf ledger: per-arm measured-vs-modeled model_efficiency p50 "
      "above the floor, SLO burns == 0 on clean arms, phase-attribution "
@@ -1395,6 +1563,13 @@ def main(argv=None) -> int:
                          "deliberately low on CPU, where the live-array "
                          "census measures pools the ledger does not "
                          "model; the memory gate family)")
+    ap.add_argument("--sparse-readback-budget", type=float,
+                    default=12.0,
+                    help="absolute d2h bytes-per-pod bound for the "
+                         "sparse arm in the new churn_sparse record "
+                         "(default 12.0 — tighter than the mesh "
+                         "budget: the restricted answer is one int32 "
+                         "per pod plus per-cycle fixed scalars)")
     ap.add_argument("--pack-floor", type=float, default=0.005,
                     help="absolute pack_s (seconds) under which the "
                          "pack-breakdown ratio check is skipped as noise "
@@ -1646,6 +1821,36 @@ def main(argv=None) -> int:
         verdict["warnings"].extend(civ["warnings"])
         verdict["churn_incr_records"] = [
             os.path.relpath(p, REPO_ROOT) for p in ci_found[-2:]]
+    # sparsity-first gates (scripts/bench_churn.py --sparse-sweep
+    # records) — absence tolerated so benchres directories predating
+    # the restricted-primary mode keep passing; a single record still
+    # enforces the absolute invariants (flatness, cold-slope
+    # sublinearity, engagement, quality bound, zero retraces, the
+    # 8 B/pod readback budget)
+    cs_found = find_churn_sparse_records(args.dir)
+    if cs_found:
+        try:
+            cs_prev = load(cs_found[-2]) if len(cs_found) >= 2 else {}
+            cs_cur = load(cs_found[-1])
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot load churn_sparse records: {e}",
+                  file=sys.stderr)
+            return 2
+        csv = compare_churn_sparse(cs_prev, cs_cur, args.threshold,
+                                   args.sparse_readback_budget)
+        if len(cs_found) < 2:
+            verdict["warnings"].append(
+                "only one churn_sparse record — delta gates need two "
+                "to compare (the absolute invariants still apply)")
+            csv["checks"] = [r for r in csv["checks"]
+                             if r["prev"] is None]
+            csv["regressions"] = [r for r in csv["checks"]
+                                  if r["regressed"]]
+        verdict["checks"].extend(csv["checks"])
+        verdict["regressions"].extend(csv["regressions"])
+        verdict["warnings"].extend(csv["warnings"])
+        verdict["churn_sparse_records"] = [
+            os.path.relpath(p, REPO_ROOT) for p in cs_found[-2:]]
     # sharded-backend gates (scripts/bench_mesh_scale.py records) —
     # absence tolerated so pre-mesh benchres directories keep passing
     mesh_found = find_mesh_records(args.dir)
@@ -1685,7 +1890,7 @@ def main(argv=None) -> int:
     # checks are absolute (new record alone)
     if prev_path is None and not churn_found and not mesh_found \
             and not cm_found and not sc_found and not ci_found \
-            and not cn_found and not sk_found:
+            and not cn_found and not sk_found and not cs_found:
         msg = (f"not enough records in {args.dir} — nothing to gate")
         if args.format == "json":
             print(json.dumps({"status": "skipped", "reason": msg}))

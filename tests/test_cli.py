@@ -181,7 +181,12 @@ def test_cli_boots_server_from_config_file(tmp_path):
             f"http://127.0.0.1:{port}/metrics", timeout=5
         ).read().decode()
         assert "scheduler_schedule_attempts_total" in metrics
-        assert lock.exists()  # leader elected via the file lock
+        # Leader elected via the file lock. The elector ticks on its own
+        # cadence after the server is already answering /healthz, so poll —
+        # asserting immediately races the first tick under load.
+        while not lock.exists() and time.monotonic() < deadline:
+            time.sleep(0.3)
+        assert lock.exists()
         proc.send_signal(signal.SIGTERM)
         proc.wait(timeout=30)
         assert proc.returncode == 0

@@ -516,6 +516,34 @@ def test_r7_scope_suppression_with_justification():
     """, select=["R7"]) == []
 
 
+def test_r7_cold_block_frame_loop_declares_per_frame_readback():
+    """The partitioned cold solve's shape (PR 20): a loop of per-block
+    frame solves whose ONE readback per frame rides the declared
+    ``obs.jax.readback(site, payload)`` boundary is quiet; hauling each
+    block's result out with a bare np.asarray inside the loop is
+    exactly the unaccounted per-frame d2h the rule exists to catch."""
+    assert lint("""
+    import jax
+
+    def solve_blocks(self, blocks, dp):
+        for b in blocks:
+            payload = {"assigned": solve_one(dp, b)}
+            host = self.obs.jax.readback("cold-block", payload)
+            consume(host)
+    """, select=["R7"]) == []
+    findings = lint("""
+    import numpy as np
+    import jax
+
+    def solve_blocks(blocks, dp):
+        out = []
+        for b in blocks:
+            out.append(np.asarray(solve_one(dp, b)))
+        return out
+    """, select=["R7"])
+    assert rules_of(findings) == ["R7"]
+
+
 # --------------------------------------------------------------------------
 # R8 — sharded-value gather in mesh-aware modules
 # --------------------------------------------------------------------------

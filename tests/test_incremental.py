@@ -141,17 +141,46 @@ def test_under_placed_batch_falls_back_to_cold():
 
 
 def test_ineligible_features_take_cold_solve():
-    """Whole-batch host coupling (a gang group here) keeps the cold
+    """Cross-node in-batch coupling (host ports here) keeps the cold
     path even in steady state."""
     s = build()
     churn_pods(s, 2, "a")
     s.schedule_cycle()
     for i in range(2):
-        s.on_pod_add(make_pod(f"g{i}", cpu_milli=10, pod_group="gang",
-                              pod_group_min_available=2))
+        s.on_pod_add(make_pod(f"hp{i}", cpu_milli=10,
+                              host_ports=(("TCP", "", 8080 + i),)))
     r = s.schedule_cycle()
     assert r.solve_scope == "full"
     assert r.scheduled == 2
+
+
+def test_gangs_ride_restricted():
+    """Gangs are NO LONGER blanket-excluded: a complete gang whose
+    members all fit rides the restricted path and binds atomically
+    (the all-or-nothing re-check happens inside the tail)."""
+    s = build()
+    churn_pods(s, 2, "a")
+    s.schedule_cycle()
+    for i in range(3):
+        s.on_pod_add(make_pod(f"g{i}", cpu_milli=10, pod_group="gang",
+                              pod_group_min_available=3))
+    r = s.schedule_cycle()
+    assert r.solve_scope == "restricted"
+    assert r.scheduled == 3
+
+
+def test_incomplete_gang_declines_restricted():
+    """A gang whose minMember can't be met by the PRESENT batch
+    declines the restricted attempt up front — the dense ladder owns
+    the gang-rollback failure analytics."""
+    s = build()
+    churn_pods(s, 2, "a")
+    s.schedule_cycle()
+    s.on_pod_add(make_pod("g0", cpu_milli=10, pod_group="gang",
+                          pod_group_min_available=3))
+    r = s.schedule_cycle()
+    assert r.solve_scope == "full"
+    assert s.metrics.incremental_cycles.value(scope="declined") >= 1
 
 
 def test_small_cluster_never_restricts():

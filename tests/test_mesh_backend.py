@@ -246,6 +246,45 @@ def _drive(parallel, pods_fn, n_nodes=8, cycles=1):
     return s, out
 
 
+def test_restricted_primary_placement_parity_across_mesh():
+    """Sparsity-first placements on the LIVE mesh: the same cold
+    (partitioned) + steady (restricted) churn at widths {1, 2, 4, 8}
+    reproduces the single-device assignments bit-for-bit, and every
+    cycle keeps its sparsity-first scope — the placement-level
+    complement of the kernel parity fuzz in
+    tests/test_sparse_primary.py."""
+    from kubernetes_tpu.config import IncrementalConfig
+
+    def drive(parallel):
+        s = Scheduler(clock=FakeClock(), enable_preemption=False,
+                      parallel=parallel,
+                      incremental=IncrementalConfig(
+                          enabled=True, primary=True,
+                          candidate_bucket=8))
+        # heterogeneous sizes so the rank order (and therefore the
+        # candidate cut) is contended, not alphabetical
+        for i in range(64):
+            s.on_node_add(make_node(f"node-{i}",
+                                    cpu_milli=(4000 if i % 2 else 8000),
+                                    pods=32, zone=f"z{i % 4}"))
+        out = []
+        for c in range(2):
+            for i in range(4):
+                s.on_pod_add(make_pod(f"c{c}-{i}",
+                                      cpu_milli=300 + 100 * i))
+            out.append(s.schedule_cycle())
+        return out
+
+    ref = drive(None)
+    assert [r.solve_scope for r in ref] == ["partitioned", "restricted"]
+    for d in (1, 2, 4, 8):
+        got = drive(ParallelConfig(mesh=d))
+        assert [r.solve_scope for r in got] == \
+            ["partitioned", "restricted"], d
+        for rg, rr in zip(got, ref):
+            assert rg.assignments == rr.assignments, d
+
+
 def test_sharded_bit_parity_gang_driver():
     """Driver-level gang (all-or-nothing) parity: group rollback and
     the usage rebuild after it run against the sharded table."""

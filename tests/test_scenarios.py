@@ -491,6 +491,34 @@ def test_gang_topology_colocates_whole_gangs():
     assert gang_zones["g0"] != gang_zones["g1"]
 
 
+def test_gang_topology_rides_restricted_with_home_slice_hint():
+    """Sparsity-first integration (restricted_ok + candidate_hint): a
+    steady gang cycle under the gang-topology pack (quality off — the
+    quality reduction is the remaining whole-batch coupling) rides the
+    RESTRICTED path, and the pack's home-slice hint keeps the gang
+    co-located even though the top-C rank cut knows nothing about
+    slice distance."""
+    from kubernetes_tpu.config import IncrementalConfig
+
+    s = Scheduler(scenario=ScenarioConfig(pack="gang-topology",
+                                          quality=False),
+                  incremental=IncrementalConfig(enabled=True,
+                                                primary=True,
+                                                candidate_bucket=8),
+                  enable_preemption=False)
+    _cluster(s, n=32, cpu=8000, mem=16 * 2**30, zones=4)
+    s.on_pod_add(make_pod("warm0", cpu_milli=100, memory=2**28))
+    s.schedule_cycle()  # the cold cycle builds the resident summary
+    for m in range(3):
+        s.on_pod_add(make_pod(f"gm{m}", cpu_milli=1000, memory=2**30,
+                              pod_group="dl", pod_group_min_available=3))
+    r = s.schedule_cycle()
+    assert r.solve_scope == "restricted"
+    assert r.scheduled == 3
+    zones = {int(n[1:]) % 4 for n in r.assignments.values()}
+    assert len(zones) == 1  # the whole gang on one home slice
+
+
 def test_gang_all_or_nothing_with_pack():
     """A gang that cannot fully fit binds NOTHING under the pack (the
     scheduler's rollback), and the quality block reports the failure
